@@ -1,0 +1,14 @@
+"""emqx_tpu — a TPU-native messaging framework with the capabilities of EMQ X.
+
+The data plane centerpiece is a TPU-resident topic-matching automaton
+(`emqx_tpu.models.engine.TopicMatchEngine`): subscription filters are mirrored
+into flattened hash tables in HBM and publish batches are matched with
+fully-static-shape JAX kernels (`emqx_tpu.ops.match`), sharded across a device
+mesh (`emqx_tpu.parallel`).  The host control plane (`emqx_tpu.broker`)
+provides the MQTT codec, channel FSM, sessions/QoS, hooks, authn/authz,
+retainer, shared subscriptions and the asyncio listeners.
+
+Reference structural blueprint: /root/repo/SURVEY.md (EMQ X 5.0.0-beta.3).
+"""
+
+__version__ = "0.1.0"

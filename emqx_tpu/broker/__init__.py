@@ -1,0 +1,1 @@
+"""Host control plane: MQTT codec, topics, sessions, channels, dispatch."""

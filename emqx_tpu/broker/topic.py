@@ -1,0 +1,135 @@
+"""MQTT topic utilities: split/join/validate/wildcard/match.
+
+Semantics mirror the reference's topic layer (see SURVEY.md §2.1 "Topic utils",
+reference `apps/emqx/src/emqx_topic.erl`): levels are '/'-separated words,
+``+`` matches exactly one level, ``#`` matches any number of trailing levels
+(including zero), and topics whose first level begins with ``$`` are never
+matched by a wildcard at the root level.
+
+This module is the host-side golden implementation; the TPU engine
+(`emqx_tpu.ops.match`) must agree with :func:`match` on every input.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+MAX_TOPIC_LEN = 65535
+
+PLUS = "+"
+HASH = "#"
+
+SHARE_PREFIX = "$share"
+QUEUE_PREFIX = "$queue"
+
+
+def words(topic: str) -> List[str]:
+    """Split a topic into its levels. ``"a//b"`` has an empty middle level."""
+    return topic.split("/")
+
+
+def join(ws: List[str]) -> str:
+    return "/".join(ws)
+
+
+def levels(topic: str) -> int:
+    return len(words(topic))
+
+
+def wildcard(topic: str) -> bool:
+    """True if the filter contains any wildcard level."""
+    return any(w in (PLUS, HASH) for w in words(topic))
+
+
+def is_sys(topic: str) -> bool:
+    return topic.startswith("$")
+
+
+def validate_filter(topic: str) -> bool:
+    """Validate a subscription filter (wildcards allowed)."""
+    if not topic or len(topic.encode("utf-8", "surrogatepass")) > MAX_TOPIC_LEN:
+        return False
+    if "\x00" in topic:
+        return False
+    ws = words(topic)
+    for i, w in enumerate(ws):
+        if HASH in w:
+            # '#' must occupy a whole level and be the last level
+            if w != HASH or i != len(ws) - 1:
+                return False
+        if PLUS in w and w != PLUS:
+            return False
+    return True
+
+
+def validate_name(topic: str) -> bool:
+    """Validate a publish topic name (no wildcards)."""
+    if not topic or len(topic.encode("utf-8", "surrogatepass")) > MAX_TOPIC_LEN:
+        return False
+    if "\x00" in topic:
+        return False
+    return not wildcard(topic)
+
+
+def match_words(name: List[str], filt: List[str]) -> bool:
+    """Match topic-name words against filter words (both pre-split)."""
+    # Root-level wildcard never matches a $-topic.
+    if name and name[0].startswith("$") and filt and filt[0] in (PLUS, HASH):
+        return False
+    i = 0
+    n, m = len(name), len(filt)
+    while i < m:
+        fw = filt[i]
+        if fw == HASH:
+            return True  # '#' matches the remaining levels, including zero
+        if i >= n:
+            # name exhausted: only a trailing '#' can still match
+            return False
+        if fw != PLUS and fw != name[i]:
+            return False
+        i += 1
+    # Filter exhausted: match iff the name is exhausted too, or the next
+    # (and only remaining) filter level would have been '#'. Handled above.
+    return i == n
+
+
+def match(name: str, filt: str) -> bool:
+    """Does topic `name` match subscription `filt`?"""
+    return match_words(words(name), words(filt))
+
+
+def parse_share(topic: str) -> Tuple[Optional[str], str]:
+    """Parse a shared-subscription filter.
+
+    ``$share/<group>/<real-filter>`` -> (group, real-filter)
+    ``$queue/<real-filter>``         -> ("$queue", real-filter)
+    Anything else                    -> (None, topic)
+    """
+    if topic.startswith(SHARE_PREFIX + "/"):
+        rest = topic[len(SHARE_PREFIX) + 1 :]
+        group, sep, real = rest.partition("/")
+        if sep and group and real:
+            return group, real
+        return None, topic
+    if topic.startswith(QUEUE_PREFIX + "/"):
+        real = topic[len(QUEUE_PREFIX) + 1 :]
+        if real:
+            return QUEUE_PREFIX, real
+    return None, topic
+
+
+def feed_var(var: str, value: str, topic: str) -> str:
+    """Substitute a placeholder level (e.g. ``%c``/``%u``) in a topic."""
+    return join([value if w == var else w for w in words(topic)])
+
+
+def prepend_mountpoint(mountpoint: Optional[str], topic: str) -> str:
+    if not mountpoint:
+        return topic
+    return mountpoint + topic
+
+
+def strip_mountpoint(mountpoint: Optional[str], topic: str) -> str:
+    if mountpoint and topic.startswith(mountpoint):
+        return topic[len(mountpoint) :]
+    return topic

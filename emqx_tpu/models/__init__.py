@@ -1,0 +1,1 @@
+"""Match-engine frontends: canonical host store + device mirror."""

@@ -1,0 +1,186 @@
+"""TopicMatchEngine — the flagship: a TPU-resident topic-match automaton.
+
+This is the TPU-native replacement for the reference's route/trie core
+(`emqx_router:match_routes/1`, `emqx_trie:match/1` — SURVEY.md §1.7/§3.3).
+Canonical truth lives on the host (`MatchTables` + python dicts, the analog of
+mnesia/ETS); the device arrays are a cache rebuilt or patched from host truth
+(SURVEY.md §5.4 failure model), versioned by an epoch counter.
+
+API:
+    fid = engine.add_filter("sensors/+/temp")      # refcounted
+    engine.remove_filter("sensors/+/temp")
+    sets = engine.match(["sensors/3/temp", ...])   # -> List[Set[fid]]
+
+Filters deeper than the device level cap fall back to a host-side trie —
+the same escape hatch as the reference's depth-bounding compaction
+(`emqx_trie.erl:202-233`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..broker import topic as topiclib
+from ..ops import hashing
+from ..ops.match import DeviceTables, TopicBatch, apply_delta, match_batch_jit
+from ..ops.tables import MatchTables
+from .reference import CpuTrieIndex
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class TopicMatchEngine:
+    def __init__(
+        self,
+        space: Optional[hashing.HashSpace] = None,
+        device=None,
+        min_batch: int = 64,
+    ):
+        self.space = space or hashing.HashSpace()
+        self.tables = MatchTables(self.space)
+        self.device = device
+        self.min_batch = min_batch
+
+        self._fids: Dict[str, int] = {}  # filter str -> fid
+        self._refs: Dict[int, int] = {}  # fid -> refcount
+        self._words: Dict[int, List[str]] = {}
+        self._next_fid = 0
+        self._free_fids: List[int] = []
+
+        # host fallback for filters deeper than the device level cap
+        self._deep = CpuTrieIndex()
+        self._deep_fids: Set[int] = set()
+
+        self.epoch = 0  # bumps on every device-visible mutation
+        self._dev: Optional[DeviceTables] = None
+        self._dev_stale = True
+
+    # ------------------------------------------------------------ mutation
+
+    def fid_of(self, filt: str) -> Optional[int]:
+        return self._fids.get(filt)
+
+    def add_filter(self, filt: str) -> int:
+        fid = self._fids.get(filt)
+        if fid is not None:
+            self._refs[fid] += 1
+            return fid
+        fid = self._free_fids.pop() if self._free_fids else self._alloc_fid()
+        ws = topiclib.words(filt)
+        self._fids[filt] = fid
+        self._refs[fid] = 1
+        self._words[fid] = ws
+        if self._is_deep(ws):
+            self._deep.insert(filt, fid)
+            self._deep_fids.add(fid)
+        else:
+            self.tables.insert(ws, fid)
+        self.epoch += 1
+        return fid
+
+    def remove_filter(self, filt: str) -> Optional[int]:
+        """Drop one reference; returns the fid if it was fully removed."""
+        fid = self._fids.get(filt)
+        if fid is None:
+            return None
+        self._refs[fid] -= 1
+        if self._refs[fid] > 0:
+            return None
+        del self._refs[fid]
+        del self._fids[filt]
+        del self._words[fid]
+        if fid in self._deep_fids:
+            self._deep_fids.discard(fid)
+            self._deep.delete(filt, fid)
+        else:
+            self.tables.delete(fid)
+        self._free_fids.append(fid)
+        self.epoch += 1
+        return fid
+
+    def _alloc_fid(self) -> int:
+        self._next_fid += 1
+        return self._next_fid - 1
+
+    def _is_deep(self, ws: Sequence[str]) -> bool:
+        shape = self.space.shape_of(ws)
+        return shape.plen > self.space.max_levels
+
+    @property
+    def n_filters(self) -> int:
+        return len(self._fids)
+
+    # --------------------------------------------------------------- sync
+
+    def sync_device(self) -> DeviceTables:
+        """Bring the HBM mirror up to date with host truth."""
+        delta = self.tables.drain_delta()
+        if self._dev is None or delta.rebuilt:
+            self._dev = DeviceTables.from_host(self.tables, self.device)
+            return self._dev
+        if delta.desc_dirty:
+            import jax
+
+            put = lambda a: jax.device_put(a, self.device)
+            self._dev = self._dev._replace(
+                incl=put(self.tables.incl),
+                k_a=put(self.tables.k_a),
+                k_b=put(self.tables.k_b),
+                min_len=put(self.tables.min_len),
+                max_len=put(self.tables.max_len),
+                wild_root=put(self.tables.wild_root),
+                valid=put(self.tables.valid),
+            )
+        if delta.slots:
+            k = _next_pow2(max(len(delta.slots), 16))
+            slots = np.full(k, -1, dtype=np.int32)
+            ka = np.zeros(k, dtype=np.uint32)
+            kb = np.zeros(k, dtype=np.uint32)
+            vv = np.zeros(k, dtype=np.int32)
+            n = len(delta.slots)
+            slots[:n] = delta.slots
+            ka[:n] = delta.key_a
+            kb[:n] = delta.key_b
+            vv[:n] = delta.val
+            self._dev = apply_delta(self._dev, slots, ka, kb, vv)
+        return self._dev
+
+    # -------------------------------------------------------------- match
+
+    def match(self, topics: Sequence[str]) -> List[Set[int]]:
+        """Match a publish batch; returns the set of fids per topic."""
+        word_lists = [topiclib.words(t) for t in topics]
+        out: List[Set[int]] = [set() for _ in topics]
+
+        if self.tables.n_entries:
+            dev = self.sync_device()
+            B = max(self.min_batch, _next_pow2(len(topics)))
+            ta, tb, ln, dl = hashing.hash_topic_batch(self.space, word_lists)
+            if B > len(topics):
+                pad = B - len(topics)
+                ta = np.pad(ta, ((0, pad), (0, 0)))
+                tb = np.pad(tb, ((0, pad), (0, 0)))
+                ln = np.pad(ln, (0, pad), constant_values=-1)
+                dl = np.pad(dl, (0, pad))
+            import jax
+
+            put = lambda a: jax.device_put(a, self.device)
+            batch = TopicBatch(put(ta), put(tb), put(ln), put(dl))
+            matched = np.asarray(match_batch_jit(dev, batch))[: len(topics)]
+            for i in range(len(topics)):
+                row = matched[i]
+                hits = row[row >= 0]
+                if hits.size:
+                    out[i].update(int(f) for f in hits)
+
+        if self._deep_fids:
+            for i, t in enumerate(topics):
+                out[i] |= self._deep.match(t) & self._deep_fids
+        return out
+
+    def match_one(self, name: str) -> Set[int]:
+        return self.match([name])[0]

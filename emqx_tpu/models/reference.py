@@ -1,0 +1,105 @@
+"""Brute-force host reference matcher — the correctness oracle.
+
+Used by tests to validate the TPU engine, and by benchmarks as the "CPU
+baseline" in the spirit of the reference's in-tree microbench
+(`apps/emqx/src/emqx_broker_bench.erl:25-107`, InsertRps/LookupRps).
+
+Also contains a faithful CPU *trie* implementation (dict-based, matching the
+semantics of `apps/emqx/src/emqx_trie.erl`) so the baseline isn't a strawman
+linear scan.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set
+
+from ..broker import topic as topiclib
+
+
+class BruteForceIndex:
+    """O(n_filters) per lookup. Only for tests on small populations."""
+
+    def __init__(self) -> None:
+        self.filters: Dict[str, int] = {}
+
+    def insert(self, filt: str, fid: int) -> None:
+        self.filters[filt] = fid
+
+    def delete(self, filt: str) -> None:
+        self.filters.pop(filt, None)
+
+    def match(self, name: str) -> Set[int]:
+        nw = topiclib.words(name)
+        return {
+            fid
+            for f, fid in self.filters.items()
+            if topiclib.match_words(nw, topiclib.words(f))
+        }
+
+
+class _TrieNode:
+    __slots__ = ("children", "fids")
+
+    def __init__(self) -> None:
+        self.children: Dict[str, _TrieNode] = {}
+        self.fids: Set[int] = set()
+
+
+class CpuTrieIndex:
+    """Dict-based topic trie with the reference's match semantics.
+
+    Mirrors the walk of `emqx_trie.erl:272-334`: at each level follow the
+    exact child, the '+' child, and collect any '#' child; '#' also matches
+    zero trailing levels; root-level wildcards skip $-topics.
+    """
+
+    def __init__(self) -> None:
+        self.root = _TrieNode()
+        self.count = 0
+
+    def insert(self, filt: str, fid: int) -> None:
+        node = self.root
+        for w in topiclib.words(filt):
+            node = node.children.setdefault(w, _TrieNode())
+        node.fids.add(fid)
+        self.count += 1
+
+    def delete(self, filt: str, fid: int) -> None:
+        path: List[_TrieNode] = [self.root]
+        ws = topiclib.words(filt)
+        node = self.root
+        for w in ws:
+            node = node.children.get(w)
+            if node is None:
+                return
+            path.append(node)
+        node.fids.discard(fid)
+        self.count -= 1
+        # prune empty branches
+        for i in range(len(ws) - 1, -1, -1):
+            child = path[i + 1]
+            if child.fids or child.children:
+                break
+            del path[i].children[ws[i]]
+
+    def match(self, name: str) -> Set[int]:
+        ws = topiclib.words(name)
+        out: Set[int] = set()
+        dollar = bool(ws) and ws[0].startswith("$")
+
+        def walk(node: _TrieNode, i: int, root: bool) -> None:
+            h = node.children.get("#")
+            if h is not None and not (root and dollar):
+                out.update(h.fids)
+            if i == len(ws):
+                out.update(node.fids)
+                return
+            c = node.children.get(ws[i])
+            if c is not None:
+                walk(c, i + 1, False)
+            p = node.children.get("+")
+            if p is not None and not (root and dollar):
+                walk(p, i + 1, False)
+
+        walk(self.root, 0, True)
+        return out

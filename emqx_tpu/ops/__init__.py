@@ -1,0 +1,1 @@
+"""Device-side kernels and host-side table builders for the topic engine."""

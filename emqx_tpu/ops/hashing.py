@@ -1,0 +1,172 @@
+"""Topic/filter hashing for the flattened TPU match tables.
+
+The reference walks a per-level trie with branching on ``+``/``#``
+(`apps/emqx/src/emqx_trie.erl:272-334`).  That shape-dynamic walk is hostile to
+XLA, so the TPU engine replaces it with *pattern-hash enumeration*:
+
+* every subscription filter has a **wildcard shape** — a bitmask of which
+  levels are ``+`` plus an optional ``#`` cut point;
+* a filter is stored once in an open-addressed hash table under the hash of
+  its word sequence with ``+`` levels replaced by a sentinel;
+* matching a topic = for each *distinct shape present in the table* (typically
+  tens, even with millions of filters), compute the topic's hash under that
+  shape's mask and probe the table.  All shapes are static; the per-shape
+  plus-substitutions and ``#`` marker fold into one precomputed additive
+  constant per shape, so the device only ever combines per-(topic, level)
+  terms with a masked sum.
+
+Hash construction (all mod 2**32, two independent lanes a/b):
+
+    term_a[l]  = ((word_a[l] ^ C_a[l]) * R_a[l])          # per topic level
+    h_a(shape) = sum_{l < plen, l not plus} term_a[l] + K_a[shape]
+    K_a(shape) = sum_{l plus} ((PLUS_a ^ C_a[l]) * R_a[l]) + (#? HM_a * HR_a[plen])
+
+The host computes the same formula when inserting filters; host and device
+agree bit-for-bit because both use wrapping 32-bit arithmetic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+# Maximum topic levels handled by the device fast path. Deeper topics fall
+# back to the host matcher (see models/engine.py); the reference bounds trie
+# depth the same way via prefix compaction (emqx_trie.erl:202-233).
+DEFAULT_MAX_LEVELS = 16
+
+_U32 = 0xFFFFFFFF
+_PERTURB = 0xD6E8FEB86659FD93  # avoid hash('') == 0
+
+
+def word_hash64(word: str) -> int:
+    """Stable-within-process 64-bit hash of one topic level."""
+    return (hash(word) ^ _PERTURB) & 0xFFFFFFFFFFFFFFFF
+
+
+class HashSpace:
+    """Per-level mixing constants shared by host builder and device kernels."""
+
+    def __init__(self, max_levels: int = DEFAULT_MAX_LEVELS, seed: int = 0x5EED):
+        self.max_levels = max_levels
+        rng = np.random.RandomState(seed)
+
+        def u32s(n):
+            return rng.randint(0, 1 << 32, size=n, dtype=np.uint64).astype(np.uint32)
+
+        # Per-level xor constants and odd multipliers, one pair of lanes.
+        self.C = np.stack([u32s(max_levels), u32s(max_levels)])  # [2, L]
+        self.R = np.stack([u32s(max_levels) | 1, u32s(max_levels) | 1])  # [2, L]
+        # '#'-marker multipliers indexed by prefix length (0..L inclusive).
+        self.HR = np.stack([u32s(max_levels + 1) | 1, u32s(max_levels + 1) | 1])
+        self.PLUS = u32s(2)  # sentinel word-hash lanes for '+'
+        self.HM = u32s(2)  # '#' marker lanes
+
+    # -- host-side scalar helpers (match device arithmetic bit-for-bit) ----
+
+    def _term(self, lane: int, w: int, level: int) -> int:
+        return ((w ^ int(self.C[lane, level])) * int(self.R[lane, level])) & _U32
+
+    def word_lanes(self, word: str) -> Tuple[int, int]:
+        h = word_hash64(word)
+        return h & _U32, (h >> 32) & _U32
+
+    def topic_terms(self, words: Sequence[str]) -> np.ndarray:
+        """[2, L] per-level terms for a topic (zero-padded past len(words))."""
+        out = np.zeros((2, self.max_levels), dtype=np.uint32)
+        for l, w in enumerate(words[: self.max_levels]):
+            a, b = self.word_lanes(w)
+            out[0, l] = self._term(0, a, l)
+            out[1, l] = self._term(1, b, l)
+        return out
+
+    def shape_of(self, filter_words: Sequence[str]) -> "Shape":
+        """Extract the wildcard shape of a filter."""
+        has_hash = bool(filter_words) and filter_words[-1] == "#"
+        body = filter_words[:-1] if has_hash else list(filter_words)
+        plus_mask = 0
+        for l, w in enumerate(body):
+            if w == "+":
+                plus_mask |= 1 << l
+        return Shape(plen=len(body), plus_mask=plus_mask, has_hash=has_hash)
+
+    def shape_const(self, shape: "Shape") -> Tuple[int, int]:
+        """Per-shape additive constant K (both lanes)."""
+        ka = kb = 0
+        for l in range(shape.plen):
+            if shape.plus_mask >> l & 1:
+                ka = (ka + self._term(0, int(self.PLUS[0]), l)) & _U32
+                kb = (kb + self._term(1, int(self.PLUS[1]), l)) & _U32
+        if shape.has_hash:
+            ka = (ka + int(self.HM[0]) * int(self.HR[0, shape.plen])) & _U32
+            kb = (kb + int(self.HM[1]) * int(self.HR[1, shape.plen])) & _U32
+        return ka, kb
+
+    def filter_key(self, filter_words: Sequence[str]) -> Tuple[int, int, "Shape"]:
+        """Full (h_a, h_b) table key of a subscription filter + its shape."""
+        shape = self.shape_of(filter_words)
+        ka, kb = self.shape_const(shape)
+        ha, hb = ka, kb
+        for l in range(shape.plen):
+            if not (shape.plus_mask >> l & 1):
+                a, b = self.word_lanes(filter_words[l])
+                ha = (ha + self._term(0, a, l)) & _U32
+                hb = (hb + self._term(1, b, l)) & _U32
+        if ha == 0 and hb == 0:  # (0,0) is the empty-slot sentinel
+            hb = 1
+        return ha, hb, shape
+
+
+@dataclass(frozen=True)
+class Shape:
+    """A wildcard shape: which levels are '+', and the '#' cut point."""
+
+    plen: int  # number of explicit levels (excluding '#')
+    plus_mask: int  # bit l set => level l is '+'
+    has_hash: bool
+
+    @property
+    def wild_root(self) -> bool:
+        """Shape has a wildcard at level 0 (never matches $-topics)."""
+        return bool(self.plus_mask & 1) or (self.has_hash and self.plen == 0)
+
+    def min_len(self) -> int:
+        return self.plen
+
+    def max_len(self, max_levels: int) -> int:
+        # '#' matches any number of trailing levels: a topic deeper than the
+        # device level cap still matches, since only the first plen(<=cap)
+        # levels contribute to the hash.
+        return (1 << 30) if self.has_hash else self.plen
+
+
+def hash_topic_batch(
+    space: HashSpace, topics: List[List[str]]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side preparation of a publish batch for the device kernel.
+
+    Returns (terms_a [B, L] u32, terms_b [B, L] u32, lengths [B] i32,
+    dollar [B] bool).  This is the hot host loop; see ops/native for the C++
+    fast path.
+    """
+    B = len(topics)
+    L = space.max_levels
+    ta = np.zeros((B, L), dtype=np.uint32)
+    tb = np.zeros((B, L), dtype=np.uint32)
+    ln = np.zeros(B, dtype=np.int32)
+    dl = np.zeros(B, dtype=bool)
+    Ca = [int(x) for x in space.C[0]]
+    Cb = [int(x) for x in space.C[1]]
+    Ra = [int(x) for x in space.R[0]]
+    Rb = [int(x) for x in space.R[1]]
+    for i, ws in enumerate(topics):
+        ln[i] = len(ws)
+        dl[i] = bool(ws) and ws[0].startswith("$")
+        for l, w in enumerate(ws[:L]):
+            h = word_hash64(w)
+            a, b = h & _U32, (h >> 32) & _U32
+            ta[i, l] = ((a ^ Ca[l]) * Ra[l]) & _U32
+            tb[i, l] = ((b ^ Cb[l]) * Rb[l]) & _U32
+    return ta, tb, ln, dl

@@ -1,0 +1,129 @@
+"""Device-side topic-match kernels (single chip).
+
+The hot loop the reference runs per-publish over ETS
+(`apps/emqx/src/emqx_trie.erl:272-334` + `emqx_router.erl:127-144`) becomes a
+batched, fully static-shape computation:
+
+    matched[b, m] = filter-id hit by topic b under wildcard-shape m (or -1)
+
+All arrays are fixed capacity; churn mutates them via scatter
+(:func:`apply_delta`) without recompilation.  Multi-chip sharding lives in
+`emqx_tpu.parallel`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tables import MatchTables, PROBE, _MIX1, _MIX2
+
+
+class DeviceTables(NamedTuple):
+    """HBM-resident mirror of :class:`~emqx_tpu.ops.tables.MatchTables`."""
+
+    key_a: jax.Array  # [cap] u32, 0/0 = empty
+    key_b: jax.Array  # [cap] u32
+    val: jax.Array  # [cap] i32 filter id, -1 = empty
+    incl: jax.Array  # [M, L] u32 0/1 level-inclusion mask
+    k_a: jax.Array  # [M] u32 per-shape additive constant
+    k_b: jax.Array  # [M] u32
+    min_len: jax.Array  # [M] i32
+    max_len: jax.Array  # [M] i32
+    wild_root: jax.Array  # [M] bool
+    valid: jax.Array  # [M] bool
+
+    @staticmethod
+    def from_host(t: MatchTables, device=None) -> "DeviceTables":
+        arrs = t.device_arrays()
+        put = lambda a: jax.device_put(a, device)
+        return DeviceTables(**{k: put(v) for k, v in arrs.items()})
+
+
+class TopicBatch(NamedTuple):
+    """A hashed publish batch (host-prepared, see ops.hashing)."""
+
+    terms_a: jax.Array  # [B, L] u32 per-level hash terms
+    terms_b: jax.Array  # [B, L] u32
+    length: jax.Array  # [B] i32 true level count
+    dollar: jax.Array  # [B] bool first level starts with '$'
+
+
+def pattern_hashes(t: DeviceTables, batch: TopicBatch):
+    """[B, M] u32 lane-a/lane-b hashes of every topic under every shape."""
+    # Masked wrap-around sum over levels. incl is 0/1 so multiply == select.
+    ha = (batch.terms_a[:, None, :] * t.incl[None, :, :]).sum(
+        axis=-1, dtype=jnp.uint32
+    ) + t.k_a[None, :]
+    hb = (batch.terms_b[:, None, :] * t.incl[None, :, :]).sum(
+        axis=-1, dtype=jnp.uint32
+    ) + t.k_b[None, :]
+    return ha, hb
+
+
+def match_batch(t: DeviceTables, batch: TopicBatch) -> jax.Array:
+    """Match a topic batch against the table.
+
+    Returns ``matched [B, M] i32``: the filter id matched by topic ``b``
+    under shape ``m``, or -1.  (Each shape can hit at most one filter — a
+    topic has exactly one masked hash per shape.)
+    """
+    cap = t.key_a.shape[0]
+    log2cap = int(cap).bit_length() - 1
+    ha, hb = pattern_hashes(t, batch)
+
+    mixed = (ha + hb * jnp.uint32(_MIX1)) * jnp.uint32(_MIX2)
+    home = (mixed >> jnp.uint32(32 - log2cap)).astype(jnp.int32)  # [B, M]
+
+    offs = jnp.arange(PROBE, dtype=jnp.int32)
+    slots = (home[:, :, None] + offs[None, None, :]) & (cap - 1)  # [B, M, P]
+
+    ka = jnp.take(t.key_a, slots, axis=0)
+    kb = jnp.take(t.key_b, slots, axis=0)
+    vv = jnp.take(t.val, slots, axis=0)
+    hit = (ka == ha[:, :, None]) & (kb == hb[:, :, None]) & (vv >= 0)
+    fid = jnp.max(jnp.where(hit, vv, -1), axis=-1)  # [B, M]
+
+    ok = (
+        t.valid[None, :]
+        & (batch.length[:, None] >= t.min_len[None, :])
+        & (batch.length[:, None] <= t.max_len[None, :])
+        & ~(batch.dollar[:, None] & t.wild_root[None, :])
+    )
+    return jnp.where(ok, fid, -1)
+
+
+match_batch_jit = jax.jit(match_batch)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_delta(
+    t: DeviceTables,
+    slots: jax.Array,  # [K] i32 (may be padded with -1 -> dropped)
+    key_a: jax.Array,  # [K] u32
+    key_b: jax.Array,  # [K] u32
+    val: jax.Array,  # [K] i32
+) -> DeviceTables:
+    """Scatter incremental subscribe/unsubscribe deltas into the HBM mirror.
+
+    The churn path: route mutations (`emqx_router.erl:106-123`) become a
+    single fused scatter on donated buffers — no reallocation, no re-upload.
+    """
+    cap = t.key_a.shape[0]
+    # Padding entries (slot == -1) are routed out of range and dropped by the
+    # scatter, so they can never race a real update on the same slot.
+    safe = jnp.where(slots >= 0, slots, cap)
+    return t._replace(
+        key_a=t.key_a.at[safe].set(key_a, mode="drop"),
+        key_b=t.key_b.at[safe].set(key_b, mode="drop"),
+        val=t.val.at[safe].set(val, mode="drop"),
+    )
+
+
+def make_topic_batch(ta: np.ndarray, tb: np.ndarray, ln: np.ndarray, dl: np.ndarray, device=None) -> TopicBatch:
+    put = lambda a: jax.device_put(a, device)
+    return TopicBatch(put(ta), put(tb), put(ln), put(dl))

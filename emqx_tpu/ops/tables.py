@@ -1,0 +1,274 @@
+"""Host-side builder for the flattened match tables mirrored into HBM.
+
+Plays the role of the reference's route/trie mutation path
+(`apps/emqx/src/emqx_router.erl:106-123`, `emqx_trie.erl:115-120`) but
+produces fixed-shape arrays:
+
+* an open-addressed hash table (``key_a``/``key_b``/``val``) over filter
+  pattern hashes, probe window ``PROBE`` slots, load factor <= 1/2;
+* a dense descriptor block for the distinct wildcard shapes present
+  (``incl``/``k_a``/``k_b``/``min_len``/``max_len``/``wild_root``/``valid``).
+
+All mutations are applied to the numpy mirror *and* recorded as deltas so the
+engine can scatter them into the device copy without re-uploading the table
+(the churn requirement: BASELINE.json config #5, 5%/sec subscribe/unsubscribe).
+Capacity growth doubles the table and invalidates the device mirror (rare,
+amortized) — the analog of the reference's transactional trie rebuild.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .hashing import HashSpace, Shape
+
+PROBE = 8  # fixed probe window; every key lives within PROBE slots of home
+_U32 = 0xFFFFFFFF
+_MIX1 = 0x85EBCA77
+_MIX2 = 0x9E3779B1
+
+
+def bucket_of(ha: int, hb: int, log2cap: int) -> int:
+    """Home slot for a key — must match the device computation bit-for-bit."""
+    m = (ha + hb * _MIX1) & _U32
+    return ((m * _MIX2) & _U32) >> (32 - log2cap)
+
+
+class GrowNeeded(Exception):
+    """Raised when an insert cannot be placed; caller must grow()."""
+
+
+@dataclass
+class Delta:
+    """Pending device-mirror updates since the last drain."""
+
+    slots: List[int] = field(default_factory=list)
+    key_a: List[int] = field(default_factory=list)
+    key_b: List[int] = field(default_factory=list)
+    val: List[int] = field(default_factory=list)
+    desc_dirty: bool = False  # descriptor block changed (tiny; re-upload whole)
+    rebuilt: bool = False  # table arrays replaced wholesale
+
+    def empty(self) -> bool:
+        return not self.slots and not self.desc_dirty and not self.rebuilt
+
+
+class MatchTables:
+    """Numpy mirror of the device tables + incremental mutation log."""
+
+    def __init__(
+        self,
+        space: Optional[HashSpace] = None,
+        log2cap: int = 10,
+        desc_cap: int = 32,
+    ):
+        self.space = space or HashSpace()
+        self.log2cap = log2cap
+        self.desc_cap = desc_cap
+        L = self.space.max_levels
+
+        cap = 1 << log2cap
+        self.key_a = np.zeros(cap, dtype=np.uint32)
+        self.key_b = np.zeros(cap, dtype=np.uint32)
+        self.val = np.full(cap, -1, dtype=np.int32)
+
+        self.incl = np.zeros((desc_cap, L), dtype=np.uint32)
+        self.k_a = np.zeros(desc_cap, dtype=np.uint32)
+        self.k_b = np.zeros(desc_cap, dtype=np.uint32)
+        self.min_len = np.zeros(desc_cap, dtype=np.int32)
+        self.max_len = np.zeros(desc_cap, dtype=np.int32)
+        self.wild_root = np.zeros(desc_cap, dtype=bool)
+        self.valid = np.zeros(desc_cap, dtype=bool)
+
+        self.n_entries = 0
+        # shape -> (descriptor index, refcount)
+        self._shapes: Dict[Shape, Tuple[int, int]] = {}
+        self._free_desc: List[int] = list(range(desc_cap - 1, -1, -1))
+        # fid -> (ha, hb, shape) for rebuilds and deletes
+        self._entries: Dict[int, Tuple[int, int, Shape]] = {}
+        self.delta = Delta()
+
+    # ------------------------------------------------------------- shapes
+
+    def _shape_incl_row(self, shape: Shape) -> np.ndarray:
+        L = self.space.max_levels
+        row = np.zeros(L, dtype=np.uint32)
+        for l in range(min(shape.plen, L)):
+            if not (shape.plus_mask >> l & 1):
+                row[l] = 1
+        return row
+
+    def _acquire_shape(self, shape: Shape) -> int:
+        ent = self._shapes.get(shape)
+        if ent is not None:
+            idx, rc = ent
+            self._shapes[shape] = (idx, rc + 1)
+            return idx
+        if not self._free_desc:
+            raise GrowNeeded("descriptor block full")
+        idx = self._free_desc.pop()
+        ka, kb = self.space.shape_const(shape)
+        self.incl[idx] = self._shape_incl_row(shape)
+        self.k_a[idx] = ka
+        self.k_b[idx] = kb
+        self.min_len[idx] = shape.min_len()
+        self.max_len[idx] = shape.max_len(self.space.max_levels)
+        self.wild_root[idx] = shape.wild_root
+        self.valid[idx] = True
+        self._shapes[shape] = (idx, 1)
+        self.delta.desc_dirty = True
+        return idx
+
+    def _release_shape(self, shape: Shape) -> None:
+        idx, rc = self._shapes[shape]
+        if rc > 1:
+            self._shapes[shape] = (idx, rc - 1)
+            return
+        del self._shapes[shape]
+        self.valid[idx] = False
+        self._free_desc.append(idx)
+        self.delta.desc_dirty = True
+
+    @property
+    def n_shapes(self) -> int:
+        return len(self._shapes)
+
+    # ------------------------------------------------------------ entries
+
+    def _place(self, ha: int, hb: int, fid: int) -> int:
+        cap = 1 << self.log2cap
+        home = bucket_of(ha, hb, self.log2cap)
+        for off in range(PROBE):
+            slot = (home + off) & (cap - 1)
+            if self.val[slot] == -1:
+                self.key_a[slot] = ha
+                self.key_b[slot] = hb
+                self.val[slot] = fid
+                self.delta.slots.append(slot)
+                self.delta.key_a.append(ha)
+                self.delta.key_b.append(hb)
+                self.delta.val.append(fid)
+                return slot
+        raise GrowNeeded("probe window exhausted")
+
+    def insert(self, filter_words: Sequence[str], fid: int) -> None:
+        """Insert filter with id `fid`. Grows tables automatically."""
+        ha, hb, shape = self.space.filter_key(filter_words)
+        while True:
+            try:
+                self._acquire_shape(shape)
+                break
+            except GrowNeeded:
+                self._grow_desc()
+        while True:
+            try:
+                self._place(ha, hb, fid)
+                break
+            except GrowNeeded:
+                self._grow_table()
+        self._entries[fid] = (ha, hb, shape)
+        self.n_entries += 1
+        if self.n_entries * 2 > (1 << self.log2cap):
+            self._grow_table()
+
+    def delete(self, fid: int) -> None:
+        ha, hb, shape = self._entries.pop(fid)
+        cap = 1 << self.log2cap
+        home = bucket_of(ha, hb, self.log2cap)
+        for off in range(PROBE):
+            slot = (home + off) & (cap - 1)
+            if (
+                self.val[slot] == fid
+                and self.key_a[slot] == ha
+                and self.key_b[slot] == hb
+            ):
+                # Fixed-window probing always scans all PROBE slots, so a
+                # cleared slot needs no tombstone.
+                self.key_a[slot] = 0
+                self.key_b[slot] = 0
+                self.val[slot] = -1
+                self.delta.slots.append(slot)
+                self.delta.key_a.append(0)
+                self.delta.key_b.append(0)
+                self.delta.val.append(-1)
+                break
+        else:  # pragma: no cover - entry bookkeeping guarantees presence
+            raise KeyError(f"filter id {fid} not found in table")
+        self._release_shape(shape)
+        self.n_entries -= 1
+
+    # ------------------------------------------------------------- growth
+
+    def _grow_table(self) -> None:
+        self.log2cap += 1
+        cap = 1 << self.log2cap
+        while True:
+            self.key_a = np.zeros(cap, dtype=np.uint32)
+            self.key_b = np.zeros(cap, dtype=np.uint32)
+            self.val = np.full(cap, -1, dtype=np.int32)
+            try:
+                for fid, (ha, hb, _shape) in self._entries.items():
+                    home = bucket_of(ha, hb, self.log2cap)
+                    for off in range(PROBE):
+                        slot = (home + off) & (cap - 1)
+                        if self.val[slot] == -1:
+                            self.key_a[slot] = ha
+                            self.key_b[slot] = hb
+                            self.val[slot] = fid
+                            break
+                    else:
+                        raise GrowNeeded
+                break
+            except GrowNeeded:
+                self.log2cap += 1
+                cap = 1 << self.log2cap
+        self.delta = Delta(rebuilt=True, desc_dirty=True)
+
+    def _grow_desc(self) -> None:
+        old = self.desc_cap
+        self.desc_cap *= 2
+        L = self.space.max_levels
+        for name, fill in (
+            ("incl", 0),
+            ("k_a", 0),
+            ("k_b", 0),
+            ("min_len", 0),
+            ("max_len", 0),
+            ("wild_root", False),
+            ("valid", False),
+        ):
+            arr = getattr(self, name)
+            shape = (self.desc_cap, L) if arr.ndim == 2 else (self.desc_cap,)
+            new = np.full(shape, fill, dtype=arr.dtype)
+            new[:old] = arr
+            setattr(self, name, new)
+        self._free_desc = [
+            i for i in range(self.desc_cap - 1, old - 1, -1)
+        ] + self._free_desc
+        self.delta.desc_dirty = True
+        self.delta.rebuilt = True  # shapes changed size; device must re-init
+
+    # -------------------------------------------------------------- sync
+
+    def drain_delta(self) -> Delta:
+        d = self.delta
+        self.delta = Delta()
+        return d
+
+    def device_arrays(self) -> Dict[str, np.ndarray]:
+        """The full array set to mirror into HBM."""
+        return {
+            "key_a": self.key_a,
+            "key_b": self.key_b,
+            "val": self.val,
+            "incl": self.incl,
+            "k_a": self.k_a,
+            "k_b": self.k_b,
+            "min_len": self.min_len,
+            "max_len": self.max_len,
+            "wild_root": self.wild_root,
+            "valid": self.valid,
+        }

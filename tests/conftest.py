@@ -1,0 +1,19 @@
+"""Force JAX onto a virtual 8-device CPU mesh for the whole test session.
+
+The image's sitecustomize pre-imports jax with JAX_PLATFORMS=axon (real TPU
+tunnel); tests must run on CPU with 8 virtual devices to exercise the
+multi-chip sharding paths without hardware.  jax.config.update works
+post-import as long as no backend has been initialized yet.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

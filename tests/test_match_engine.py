@@ -1,0 +1,170 @@
+"""TopicMatchEngine correctness vs the brute-force oracle.
+
+The device pattern-hash engine must agree with `emqx_tpu.broker.topic.match`
+on every (topic, filter) pair — the same golden contract the reference pins
+with `emqx_trie_SUITE`.
+"""
+
+import random
+
+import pytest
+
+from emqx_tpu.models.engine import TopicMatchEngine
+from emqx_tpu.models.reference import BruteForceIndex, CpuTrieIndex
+
+
+def make_pair():
+    eng = TopicMatchEngine()
+    ref = BruteForceIndex()
+    return eng, ref
+
+
+def check(eng, ref, topics):
+    got = eng.match(topics)
+    for t, g in zip(topics, got):
+        assert g == ref.match(t), f"mismatch for topic {t!r}"
+
+
+GOLDEN_FILTERS = [
+    "a/b/c",
+    "a/+/c",
+    "a/#",
+    "#",
+    "+",
+    "+/+",
+    "+/b/#",
+    "$SYS/#",
+    "$SYS/+/alarms",
+    "sensors/+/temp",
+    "sensors/#",
+    "a//c",
+    "/",
+    "+/",
+]
+
+GOLDEN_TOPICS = [
+    "a/b/c",
+    "a/x/c",
+    "a/b",
+    "a",
+    "b",
+    "a/b/c/d",
+    "$SYS/broker/alarms",
+    "$SYS/x",
+    "sensors/3/temp",
+    "sensors/3/hum",
+    "a//c",
+    "/",
+    "x/",
+    "",
+]
+
+
+def test_golden():
+    eng, ref = make_pair()
+    for i, f in enumerate(GOLDEN_FILTERS):
+        eng.add_filter(f)
+        ref.insert(f, eng.fid_of(f))
+    check(eng, ref, GOLDEN_TOPICS)
+
+
+def test_refcount():
+    eng = TopicMatchEngine()
+    f1 = eng.add_filter("a/+")
+    f2 = eng.add_filter("a/+")
+    assert f1 == f2
+    assert eng.remove_filter("a/+") is None  # still one ref
+    assert eng.match_one("a/x") == {f1}
+    assert eng.remove_filter("a/+") == f1
+    assert eng.match_one("a/x") == set()
+
+
+def _rand_word(rng):
+    return rng.choice(["a", "b", "c", "dd", "e1", "", "x-y", "zzz"])
+
+
+def _rand_filter(rng):
+    n = rng.randint(1, 6)
+    ws = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.2:
+            ws.append("+")
+        else:
+            ws.append(_rand_word(rng))
+    if rng.random() < 0.25:
+        ws.append("#")
+    return "/".join(ws)
+
+
+def _rand_topic(rng):
+    n = rng.randint(1, 7)
+    ws = [_rand_word(rng) for _ in range(n)]
+    if rng.random() < 0.1:
+        ws[0] = "$SYS"
+    return "/".join(ws)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_randomized_vs_oracle(seed):
+    rng = random.Random(seed)
+    eng, ref = make_pair()
+    live = []
+    for round_ in range(30):
+        # mutate: some inserts, some deletes
+        for _ in range(rng.randint(1, 20)):
+            f = _rand_filter(rng)
+            eng.add_filter(f)
+            ref.insert(f, eng.fid_of(f))
+            live.append(f)
+        for _ in range(rng.randint(0, 8)):
+            if not live:
+                break
+            f = live.pop(rng.randrange(len(live)))
+            if eng.remove_filter(f) is not None:
+                ref.delete(f)
+        topics = [_rand_topic(rng) for _ in range(17)]
+        check(eng, ref, topics)
+
+
+def test_deep_topics_and_filters():
+    """Filters/topics beyond the device level cap use the host fallback."""
+    eng, ref = make_pair()
+    deep_filter = "/".join(["l"] * 20) + "/#"
+    shallow = "a/#"
+    for f in [deep_filter, shallow, "#"]:
+        eng.add_filter(f)
+        ref.insert(f, eng.fid_of(f))
+    deep_topic = "/".join(["l"] * 25)
+    long_a = "a/" + "/".join(["x"] * 30)
+    check(eng, ref, [deep_topic, long_a, "a/b", "l/l"])
+
+
+def test_growth():
+    """Insert enough filters to force table + descriptor growth."""
+    eng, ref = make_pair()
+    rng = random.Random(7)
+    for i in range(3000):
+        f = f"g/{i}/{rng.randint(0,5)}" + ("/#" if i % 3 == 0 else "")
+        eng.add_filter(f)
+        ref.insert(f, eng.fid_of(f))
+    topics = [f"g/{rng.randint(0, 3100)}/{rng.randint(0,5)}" for _ in range(50)]
+    check(eng, ref, topics)
+
+
+def test_cpu_trie_matches_oracle():
+    rng = random.Random(11)
+    trie = CpuTrieIndex()
+    ref = BruteForceIndex()
+    for i in range(200):
+        f = _rand_filter(rng)
+        trie.insert(f, i)
+        ref.insert(f, i)
+        ref_fids = {}  # brute force stores filter->fid, dedupe below
+    # BruteForceIndex dedupes by filter string; rebuild trie accordingly
+    trie2 = CpuTrieIndex()
+    for f, fid in ref.filters.items():
+        trie2.insert(f, fid)
+    for _ in range(100):
+        t = _rand_topic(rng)
+        assert trie2.match(t) == ref.match(t)

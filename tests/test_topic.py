@@ -1,0 +1,109 @@
+"""Golden-semantics tests for topic utilities.
+
+Cases mirror the reference's `emqx_topic_SUITE` / MQTT spec semantics:
+'+' matches exactly one level, '#' matches any number of trailing levels
+(including zero), root wildcards never match $-topics.
+"""
+
+import pytest
+
+from emqx_tpu.broker import topic as t
+
+MATCH_CASES = [
+    ("a/b/c", "a/b/c", True),
+    ("a/b/c", "a/+/c", True),
+    ("a/b/c", "a/#", True),
+    ("a/b/c", "#", True),
+    ("a/b/c", "+/+/+", True),
+    ("a/b/c", "+/+", False),
+    ("a/b/c", "a/b", False),
+    ("a/b", "a/b/c", False),
+    ("a/b", "a/b/#", True),  # '#' matches zero levels
+    ("a", "a/#", True),
+    ("a", "a/+", False),
+    ("a", "+", True),
+    ("a", "#", True),
+    ("a/b/c/d/e", "a/#", True),
+    ("a//c", "a/+/c", True),  # '+' matches an empty level
+    ("a//c", "a//c", True),
+    ("/a", "+/a", True),
+    ("/a", "#", True),
+    ("a/b/c", "a/b/c/#", True),
+    ("a/b/c", "a/b/c/d", False),
+    ("aa/b", "a/+", False),  # no prefix confusion
+    ("a/b", "aa/+", False),
+    # $-topics: never matched by root-level wildcards
+    ("$SYS/broker", "#", False),
+    ("$SYS/broker", "+/broker", False),
+    ("$SYS/broker", "$SYS/#", True),
+    ("$SYS/broker", "$SYS/+", True),
+    ("$SYS/broker", "$SYS/broker", True),
+    ("$share/g/t", "#", False),
+    # non-root wildcards are fine on $-topics
+    ("$SYS/a/b", "$SYS/+/b", True),
+    ("$SYS/a/b", "$SYS/a/#", True),
+]
+
+
+@pytest.mark.parametrize("name,filt,expected", MATCH_CASES)
+def test_match(name, filt, expected):
+    assert t.match(name, filt) is expected
+
+
+def test_validate_filter():
+    assert t.validate_filter("a/b/c")
+    assert t.validate_filter("a/+/c")
+    assert t.validate_filter("a/#")
+    assert t.validate_filter("#")
+    assert t.validate_filter("+")
+    assert t.validate_filter("/")
+    assert t.validate_filter("a//b")
+    assert not t.validate_filter("")
+    assert not t.validate_filter("a/#/b")  # '#' must be last
+    assert not t.validate_filter("a/b#")  # '#' must be a whole level
+    assert not t.validate_filter("a/#b")
+    assert not t.validate_filter("a/b+/c")  # '+' must be a whole level
+    assert not t.validate_filter("a/+b/c")
+    assert not t.validate_filter("a\x00b")
+    assert not t.validate_filter("x" * 70000)
+
+
+def test_validate_name():
+    assert t.validate_name("a/b/c")
+    assert t.validate_name("$SYS/broker")
+    assert not t.validate_name("a/+/c")
+    assert not t.validate_name("a/#")
+    assert not t.validate_name("")
+
+
+def test_wildcard():
+    assert not t.wildcard("a/b/c")
+    assert t.wildcard("a/+/c")
+    assert t.wildcard("a/#")
+    assert not t.wildcard("a/b+")  # '+' only counts as a whole level
+
+
+def test_words_join():
+    assert t.words("a/b/c") == ["a", "b", "c"]
+    assert t.words("a//c") == ["a", "", "c"]
+    assert t.words("/") == ["", ""]
+    assert t.join(["a", "b"]) == "a/b"
+
+
+def test_parse_share():
+    assert t.parse_share("$share/g1/tops/+") == ("g1", "tops/+")
+    assert t.parse_share("$queue/tops/a") == ("$queue", "tops/a")
+    assert t.parse_share("tops/a") == (None, "tops/a")
+    assert t.parse_share("$share/") == (None, "$share/")
+    assert t.parse_share("$share/g") == (None, "$share/g")
+
+
+def test_mountpoint():
+    assert t.prepend_mountpoint("dev/", "a/b") == "dev/a/b"
+    assert t.prepend_mountpoint(None, "a/b") == "a/b"
+    assert t.strip_mountpoint("dev/", "dev/a/b") == "a/b"
+    assert t.strip_mountpoint("dev/", "x/a") == "x/a"
+
+
+def test_feed_var():
+    assert t.feed_var("%c", "client1", "a/%c/b") == "a/client1/b"
