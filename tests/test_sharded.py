@@ -1,0 +1,115 @@
+"""Sharded (multi-chip) engine on the virtual 8-device CPU mesh."""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from emqx_tpu.models.reference import BruteForceIndex
+from emqx_tpu.parallel.mesh import make_mesh
+from emqx_tpu.parallel.sharded import ShardedMatchEngine
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 cpu devices"
+    return make_mesh()
+
+
+def test_sharded_fids_vs_oracle(mesh):
+    rng = random.Random(42)
+    eng = ShardedMatchEngine(mesh=mesh, n_sub_shards=64)
+    ref = BruteForceIndex()
+    filters = []
+    for i in range(500):
+        parts = [rng.choice(["a", "b", "c", "+", "d1"]) for _ in range(rng.randint(1, 5))]
+        if rng.random() < 0.3:
+            parts.append("#")
+        f = "/".join(parts)
+        fid = eng.add_filter(f)
+        ref.insert(f, fid)
+        filters.append(f)
+    topics = [
+        "/".join(rng.choice(["a", "b", "c", "d1", "x"]) for _ in range(rng.randint(1, 6)))
+        for _ in range(100)
+    ]
+    got = eng.match_fids(topics)
+    for t, g in zip(topics, got):
+        assert g == ref.match(t), t
+
+
+def test_sharded_counts(mesh):
+    eng = ShardedMatchEngine(mesh=mesh, n_sub_shards=64)
+    # three filters matching 'a/b', each pinned to a distinct subscriber shard
+    eng.add_filter("a/b", sub_shard=3)
+    eng.add_filter("a/+", sub_shard=5)
+    eng.add_filter("#", sub_shard=3)  # same shard as first -> count 2
+    counts = eng.match_counts(["a/b", "zzz", "$sys/x"])
+    assert counts.shape == (3, 64)
+    assert counts[0, 3] == 2
+    assert counts[0, 5] == 1
+    assert counts[0].sum() == 3
+    assert counts[1, 3] == 1  # only '#'
+    assert counts[1].sum() == 1
+    assert counts[2].sum() == 0  # $-topic matches no root wildcard
+
+
+def test_sharded_deep_filter_fallback(mesh):
+    eng = ShardedMatchEngine(mesh=mesh, n_sub_shards=64)
+    deep = "/".join(["l"] * 20) + "/#"
+    fid_deep = eng.add_filter(deep, sub_shard=7)
+    fid_a = eng.add_filter("a/#", sub_shard=3)
+    assert eng.n_filters == 2
+    deep_topic = "/".join(["l"] * 25)
+    got = eng.match_fids([deep_topic, "a/x"])
+    assert got[0] == {fid_deep}
+    assert got[1] == {fid_a}
+    counts = eng.match_counts([deep_topic])
+    assert counts[0, 7] == 1 and counts[0].sum() == 1
+    assert eng.remove_filter(deep) == fid_deep
+    assert eng.match_fids([deep_topic])[0] == set()
+
+
+def test_sharded_step_adopts_tables(mesh):
+    """The fused donate-step must leave the engine cache usable."""
+    eng = ShardedMatchEngine(mesh=mesh, n_sub_shards=64)
+    eng.add_filter("a/b", sub_shard=1)
+    c1 = eng.step(["a/b"])
+    assert c1[0, 1] == 1
+    # churn between steps goes through the delta path on donated buffers
+    eng.add_filter("a/+", sub_shard=2)
+    c2 = eng.step(["a/b", "a/z"])
+    assert c2[0, 1] == 1 and c2[0, 2] == 1
+    assert c2[1, 2] == 1 and c2[1, 1] == 0
+    eng.remove_filter("a/b")
+    c3 = eng.step(["a/b"])
+    assert c3[0, 1] == 0 and c3[0, 2] == 1
+    # plain match paths still work after donation steps
+    assert eng.match_fids(["a/q"]) == [{1}]
+
+
+def test_sharded_churn(mesh):
+    rng = random.Random(9)
+    eng = ShardedMatchEngine(mesh=mesh, n_sub_shards=64)
+    ref = BruteForceIndex()
+    live = []
+    for r in range(5):
+        for _ in range(60):
+            f = "/".join(
+                rng.choice(["s", "t", "+", "u"]) for _ in range(rng.randint(1, 4))
+            )
+            fid = eng.add_filter(f)
+            ref.insert(f, fid)
+            live.append(f)
+        for _ in range(25):
+            f = live.pop(rng.randrange(len(live)))
+            if eng.remove_filter(f) is not None:
+                ref.delete(f)
+        topics = [
+            "/".join(rng.choice(["s", "t", "u", "v"]) for _ in range(rng.randint(1, 4)))
+            for _ in range(23)
+        ]
+        got = eng.match_fids(topics)
+        for t, g in zip(topics, got):
+            assert g == ref.match(t), (r, t)
