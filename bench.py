@@ -101,7 +101,12 @@ def main() -> None:
     from emqx_tpu.ops import hashing
     from emqx_tpu.ops.match import TopicBatch, match_batch_jit
 
-    dev = jax.devices()[0]
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError as e:
+        log(f"TPU backend unavailable ({e}); falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
+        dev = jax.devices()[0]
     log(f"device: {dev.platform} {dev}")
 
     eng = TopicMatchEngine()

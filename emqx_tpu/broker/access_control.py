@@ -1,0 +1,110 @@
+"""Authentication/authorization facade with result caching.
+
+Analog of `emqx_access_control.erl` (`apps/emqx/src/emqx_access_control.erl:31-68`):
+both checks run hook chains ('client.authenticate' / 'client.authorize') so
+provider chains (emqx_tpu.authn / emqx_tpu.authz) and external bridges plug
+in uniformly; authorize verdicts are cached per client like
+`emqx_authz_cache`.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from .hooks import Hooks
+
+ALLOW, DENY = "allow", "deny"
+PUB, SUB = "publish", "subscribe"
+
+
+@dataclass
+class ClientInfo:
+    clientid: str = ""
+    username: Optional[str] = None
+    password: Optional[bytes] = None
+    peerhost: str = ""
+    protocol: str = "mqtt"
+    proto_ver: int = 4
+    mountpoint: Optional[str] = None
+    zone: str = "default"
+    is_superuser: bool = False
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+class AuthResult(Exception):
+    def __init__(self, reason_code: int):
+        super().__init__(hex(reason_code))
+        self.reason_code = reason_code
+
+
+class AccessControl:
+    def __init__(self, hooks: Hooks, cache_size: int = 32, cache_ttl: float = 60.0):
+        self.hooks = hooks
+        self.cache_size = cache_size
+        self.cache_ttl = cache_ttl
+
+    # -- authenticate -----------------------------------------------------
+
+    def authenticate(self, clientinfo: ClientInfo) -> Dict[str, Any]:
+        """Run the authenticate chain.
+
+        Result dict: {"result": allow|deny, "reason_code": rc, ...extras
+        (is_superuser, expire_at)}. Default (no hooks) = allow, mirroring
+        the reference's allow_anonymous default.
+        """
+        acc = {"result": ALLOW}
+        out = self.hooks.run_fold("client.authenticate", (clientinfo,), acc)
+        return out if isinstance(out, dict) else acc
+
+    # -- authorize --------------------------------------------------------
+
+    def authorize(
+        self,
+        clientinfo: ClientInfo,
+        action: str,
+        topic: str,
+        cache: Optional["AuthzCache"] = None,
+    ) -> str:
+        if clientinfo.is_superuser:
+            return ALLOW
+        if cache is not None:
+            hit = cache.get(action, topic)
+            if hit is not None:
+                return hit
+        verdict = self.hooks.run_fold(
+            "client.authorize", (clientinfo, action, topic), ALLOW
+        )
+        if verdict not in (ALLOW, DENY):
+            verdict = ALLOW
+        if cache is not None:
+            cache.put(action, topic, verdict)
+        return verdict
+
+
+class AuthzCache:
+    """Per-channel LRU of authorize verdicts (`emqx_authz_cache` analog)."""
+
+    def __init__(self, max_size: int = 32, ttl: float = 60.0):
+        self.max_size = max_size
+        self.ttl = ttl
+        self._d: Dict[Tuple[str, str], Tuple[str, float]] = {}
+
+    def get(self, action: str, topic: str) -> Optional[str]:
+        ent = self._d.get((action, topic))
+        if ent is None:
+            return None
+        verdict, ts = ent
+        if time.monotonic() - ts > self.ttl:
+            del self._d[(action, topic)]
+            return None
+        return verdict
+
+    def put(self, action: str, topic: str, verdict: str) -> None:
+        if len(self._d) >= self.max_size:
+            self._d.pop(next(iter(self._d)))
+        self._d[(action, topic)] = (verdict, time.monotonic())
+
+    def drain(self) -> None:
+        self._d.clear()

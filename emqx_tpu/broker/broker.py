@@ -1,0 +1,204 @@
+"""Broker core: subscribe/publish/dispatch over the TPU match engine.
+
+Analog of `emqx_broker.erl` + `emqx_router.erl` (SURVEY.md §1.7, §3.3-3.4),
+redesigned around batched device matching:
+
+* subscriptions feed the `TopicMatchEngine` (the HBM route/trie mirror) and
+  host-side fid -> subscriber maps (the ETS `emqx_subscriber` analog);
+* a publish batch is matched on device in one shot; the broker expands
+  matched fids to sessions, applies shared-subscription picks host-side,
+  and drives per-channel delivery;
+* every stage runs its hook points ('message.publish', 'message.dropped',
+  'message.delivered', 'session.subscribed', ...) so the extension layer
+  (rule engine, exhook bridge, retainer) composes exactly like the
+  reference's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import topic as topiclib
+from .cm import ConnectionManager
+from .hooks import Hooks
+from .message import Message
+from .metrics import Metrics
+from .packet import SubOpts
+from .retainer import Retainer
+from .session import Session
+from .shared_sub import SharedSub
+from ..models.engine import TopicMatchEngine
+
+
+@dataclass
+class Route:
+    """Host-side fan-out record for one unique filter (one fid)."""
+
+    filt: str
+    direct: Set[str] = field(default_factory=set)  # clientids
+    groups: Set[str] = field(default_factory=set)  # shared groups
+
+
+class Broker:
+    def __init__(
+        self,
+        engine: Optional[TopicMatchEngine] = None,
+        cm: Optional[ConnectionManager] = None,
+        hooks: Optional[Hooks] = None,
+        retainer: Optional[Retainer] = None,
+        shared: Optional[SharedSub] = None,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.engine = engine or TopicMatchEngine()
+        self.cm = cm or ConnectionManager()
+        self.hooks = hooks or Hooks()
+        self.retainer = retainer or Retainer()
+        self.shared = shared or SharedSub()
+        self.metrics = metrics or Metrics()
+        self._routes: Dict[int, Route] = {}  # fid -> fan-out record
+
+    # -------------------------------------------------------- subscribe
+
+    def subscribe(self, clientid: str, filt: str, opts: SubOpts) -> None:
+        """Register one subscription (parses $share/$queue prefixes)."""
+        group, real = topiclib.parse_share(filt)
+        fid = self.engine.add_filter(real)
+        route = self._routes.get(fid)
+        if route is None:
+            route = self._routes[fid] = Route(filt=real)
+        if group is None:
+            route.direct.add(clientid)
+        else:
+            self.shared.subscribe(group, real, clientid)
+            route.groups.add(group)
+        self.metrics.gauge_set("subscriptions.count", self.subscription_count)
+        self.hooks.run("session.subscribed", (clientid, filt, opts))
+
+    def unsubscribe(self, clientid: str, filt: str) -> None:
+        group, real = topiclib.parse_share(filt)
+        fid = self.engine.fid_of(real)
+        if fid is None:
+            return
+        route = self._routes.get(fid)
+        if route is not None:
+            if group is None:
+                route.direct.discard(clientid)
+            else:
+                if self.shared.unsubscribe(group, real, clientid):
+                    route.groups.discard(group)
+            if not route.direct and not route.groups:
+                del self._routes[fid]
+        self.engine.remove_filter(real)
+        self.metrics.gauge_set("subscriptions.count", self.subscription_count)
+        self.hooks.run("session.unsubscribed", (clientid, filt))
+
+    def client_down(self, clientid: str, filters: Sequence[str]) -> None:
+        """Clean a dead client's routes (`emqx_broker_helper:clean_down`)."""
+        for f in list(filters):
+            self.unsubscribe(clientid, f)
+        self.shared.drop_member(clientid)
+
+    @property
+    def subscription_count(self) -> int:
+        n = 0
+        for r in self._routes.values():
+            n += len(r.direct) + len(r.groups)
+        return n
+
+    @property
+    def route_count(self) -> int:
+        return len(self._routes)
+
+    # ---------------------------------------------------------- publish
+
+    def publish(self, msg: Message) -> int:
+        """Publish one message; returns the number of deliveries."""
+        return self.publish_many([msg])[0]
+
+    def publish_many(self, msgs: Sequence[Message]) -> List[int]:
+        """Batched publish — the TPU hot path (`emqx_broker:publish`).
+
+        Runs 'message.publish' hooks, retains, matches the whole batch on
+        device in one kernel, then dispatches host-side.
+        """
+        todo: List[Tuple[int, Message]] = []
+        results = [0] * len(msgs)
+        for i, msg in enumerate(msgs):
+            msg = self.hooks.run_fold("message.publish", (), msg)
+            if msg is None or msg.headers.get("allow_publish") is False:
+                self.metrics.inc("messages.dropped")
+                self.hooks.run("message.dropped", (msg, "publish_denied"))
+                continue
+            self.retainer.on_publish(msg)
+            self.metrics.inc("messages.received")
+            todo.append((i, msg))
+        if not todo:
+            return results
+        matched = self.engine.match([m.topic for _, m in todo])
+        for (i, msg), fids in zip(todo, matched):
+            n = self._dispatch(msg, fids)
+            results[i] = n
+            if n == 0:
+                self.metrics.inc("messages.dropped.no_subscribers")
+                self.hooks.run("message.dropped", (msg, "no_subscribers"))
+        return results
+
+    def _dispatch(self, msg: Message, fids: Set[int]) -> int:
+        """Expand matched fids to receivers and deliver (`do_dispatch`)."""
+        # receiver -> list of matched filters (a client may match many)
+        per_client: Dict[str, List[str]] = {}
+        for fid in fids:
+            route = self._routes.get(fid)
+            if route is None:
+                continue
+            for cid in route.direct:
+                per_client.setdefault(cid, []).append(route.filt)
+            for group in route.groups:
+                pick = self.shared.pick(group, route.filt, msg.topic, msg.from_client)
+                if pick is not None:
+                    # deliver under the client's own subscription key
+                    # ($share/<g>/<filt>) so session subopts/QoS apply
+                    per_client.setdefault(pick, []).append(
+                        topiclib.join_share(group, route.filt)
+                    )
+        n = 0
+        for cid, filts in per_client.items():
+            n += self._deliver_to(cid, filts, msg)
+        return n
+
+    def _deliver_to(self, cid: str, filts: List[str], msg: Message) -> int:
+        ch = self.cm.lookup(cid)
+        if ch is not None:
+            ch.deliver([(f, msg) for f in filts])
+            self.metrics.inc("messages.delivered", len(filts))
+            self.hooks.run("message.delivered", (cid, msg))
+            return len(filts)
+        session = self.cm.lookup_session(cid)
+        if session is None:
+            return 0
+        # offline persistent session: queue per matched filter
+        n = 0
+        for f in filts:
+            opts = session.subscriptions.get(f)
+            if opts is None:
+                continue
+            qos = min(msg.qos, opts.qos)
+            from dataclasses import replace
+
+            session.enqueue(replace(msg, qos=qos))
+            n += 1
+        if n:
+            self.metrics.inc("messages.queued", n)
+        return n
+
+    # ------------------------------------------------- retained delivery
+
+    def retained_for(self, filt: str, rh: int, is_new_sub: bool) -> List[Message]:
+        """Retained messages to deliver on subscribe (v5 retain-handling)."""
+        group, real = topiclib.parse_share(filt)
+        if group is not None:
+            return []  # shared subscriptions never get retained messages
+        if rh == 2 or (rh == 1 and not is_new_sub):
+            return []
+        return self.retainer.match_filter(real)

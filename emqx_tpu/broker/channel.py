@@ -1,0 +1,581 @@
+"""Per-client MQTT protocol state machine.
+
+Analog of `emqx_channel.erl` (1,837 LoC pure-functional FSM, SURVEY.md §1.5):
+drives CONNECT/auth/session-open, the publish/subscribe pipelines with authz
+and topic-alias handling, QoS ack flows, will messages, and disconnect.
+Transport-agnostic: `handle_in(packet)` returns a list of actions the
+connection executes (('send', pkt) / ('close', reason) / ...), mirroring the
+reference's `{ok, Replies, Channel}` returns.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import packet as pkt
+from . import topic as topiclib
+from .access_control import ALLOW, AccessControl, AuthzCache, ClientInfo, DENY, PUB, SUB
+from .broker import Broker
+from .message import Message
+from .packet import PacketType, Property, ReasonCode, SubOpts
+from .session import Session, SessionError
+
+Action = Tuple[str, Any]  # ('send', Packet) | ('close', rc|None) | ('connected',)
+
+IDLE, CONNECTED, DISCONNECTED = "idle", "connected", "disconnected"
+
+
+@dataclass
+class ChannelConfig:
+    max_inflight: int = 32
+    max_mqueue: int = 1000
+    max_awaiting_rel: int = 100
+    await_rel_timeout: float = 300.0
+    retry_interval: float = 30.0
+    upgrade_qos: bool = False
+    max_qos_allowed: int = 2
+    retain_available: bool = True
+    wildcard_sub_available: bool = True
+    shared_sub_available: bool = True
+    max_topic_levels: int = 128
+    max_session_expiry: int = 7200
+    max_topic_alias: int = 65535
+    server_keepalive: Optional[int] = None
+    max_clientid_len: int = 65535
+    mountpoint: Optional[str] = None
+
+
+class Channel:
+    def __init__(
+        self,
+        broker: Broker,
+        access: Optional[AccessControl] = None,
+        config: Optional[ChannelConfig] = None,
+        peername: str = "",
+        conn_mod: str = "tcp",
+    ):
+        self.broker = broker
+        self.access = access or AccessControl(broker.hooks)
+        self.cfg = config or ChannelConfig()
+        self.state = IDLE
+        self.peername = peername
+        self.conn_mod = conn_mod
+
+        self.clientinfo = ClientInfo(peerhost=peername)
+        self.session: Optional[Session] = None
+        self.clientid: str = ""
+        self.proto_ver = pkt.MQTT_V4
+        self.keepalive = 0
+        self.clean_start = True
+        self.expiry_interval = 0
+        self.will_msg: Optional[Message] = None
+        self.will_delay = 0
+        self.authz_cache = AuthzCache()
+        self.alias_in: Dict[int, str] = {}  # inbound topic aliases (v5)
+        self.alias_out: Dict[str, int] = {}
+        self.connected_at: Optional[float] = None
+        self.disconnect_reason: Optional[int] = None
+        self._takeover = False
+        # connection layer integration: out_cb receives actions produced
+        # outside handle_in (broker deliveries, kicks); tests collect them.
+        self.out_cb = lambda actions: None
+        self.on_kick = None
+        self._will_on_normal = False
+
+    # ------------------------------------------------------------- helpers
+
+    @property
+    def v5(self) -> bool:
+        return self.proto_ver == pkt.MQTT_V5
+
+    def _m(self, name: str, n: int = 1) -> None:
+        self.broker.metrics.inc(name, n)
+
+    def _close(self, rc: Optional[int], send_disconnect: bool = False) -> List[Action]:
+        acts: List[Action] = []
+        if send_disconnect and self.v5 and self.state == CONNECTED and rc is not None:
+            acts.append(("send", pkt.Disconnect(reason_code=rc)))
+            self._m("packets.disconnect.sent")
+        acts.append(("close", rc))
+        return acts
+
+    # ------------------------------------------------------------ inbound
+
+    def handle_in(self, p: pkt.Packet) -> List[Action]:
+        self._m("packets.received")
+        t = p.type
+        if self.state == IDLE and t != PacketType.CONNECT:
+            return self._close(ReasonCode.PROTOCOL_ERROR)
+        if self.state == CONNECTED and t == PacketType.CONNECT:
+            return self._close(ReasonCode.PROTOCOL_ERROR, send_disconnect=True)
+        handler = {
+            PacketType.CONNECT: self._in_connect,
+            PacketType.PUBLISH: self._in_publish,
+            PacketType.PUBACK: self._in_puback,
+            PacketType.PUBREC: self._in_pubrec,
+            PacketType.PUBREL: self._in_pubrel,
+            PacketType.PUBCOMP: self._in_pubcomp,
+            PacketType.SUBSCRIBE: self._in_subscribe,
+            PacketType.UNSUBSCRIBE: self._in_unsubscribe,
+            PacketType.PINGREQ: self._in_pingreq,
+            PacketType.DISCONNECT: self._in_disconnect,
+            PacketType.AUTH: self._in_auth,
+        }.get(t)
+        if handler is None:
+            return self._close(ReasonCode.PROTOCOL_ERROR)
+        return handler(p)
+
+    # -- CONNECT ----------------------------------------------------------
+
+    def _connack_fail(self, rc: int) -> List[Action]:
+        self._m("packets.connack.sent")
+        self._m("client.connack")
+        ack = pkt.Connack(session_present=False, reason_code=rc)
+        return [("send", ack)] + self._close(rc)
+
+    def _in_connect(self, p: pkt.Connect) -> List[Action]:
+        self._m("packets.connect.received")
+        self._m("client.connect")
+        self.proto_ver = p.proto_ver
+        self.clean_start = p.clean_start
+        self.keepalive = p.keepalive
+
+        clientid = p.clientid
+        if len(clientid) > self.cfg.max_clientid_len:
+            return self._connack_fail(ReasonCode.CLIENT_IDENTIFIER_NOT_VALID)
+        assigned = False
+        if not clientid:
+            if self.proto_ver == pkt.MQTT_V5 or p.clean_start:
+                clientid = "auto-" + uuid.uuid4().hex[:16]
+                assigned = True
+            else:
+                return self._connack_fail(ReasonCode.CLIENT_IDENTIFIER_NOT_VALID)
+
+        if self.v5:
+            self.expiry_interval = int(
+                min(
+                    p.properties.get(Property.SESSION_EXPIRY_INTERVAL, 0),
+                    self.cfg.max_session_expiry,
+                )
+            )
+        else:
+            self.expiry_interval = 0 if p.clean_start else self.cfg.max_session_expiry
+
+        self.clientinfo = ClientInfo(
+            clientid=clientid,
+            username=p.username,
+            password=p.password,
+            peerhost=self.peername,
+            proto_ver=p.proto_ver,
+            mountpoint=self.cfg.mountpoint,
+        )
+
+        auth = self.access.authenticate(self.clientinfo)
+        if auth.get("result") != ALLOW:
+            self._m("authentication.failure")
+            return self._connack_fail(
+                auth.get("reason_code", ReasonCode.NOT_AUTHORIZED)
+            )
+        self._m("authentication.success")
+        self.clientinfo.is_superuser = bool(auth.get("is_superuser"))
+
+        if self.broker.hooks.run_fold("client.connect", (self.clientinfo,), ALLOW) == DENY:
+            return self._connack_fail(ReasonCode.BANNED)
+
+        # will message
+        if p.will_flag:
+            if p.will_qos > self.cfg.max_qos_allowed:
+                return self._connack_fail(ReasonCode.QOS_NOT_SUPPORTED)
+            self.will_delay = int(p.will_props.get(Property.WILL_DELAY_INTERVAL, 0))
+            self.will_msg = Message(
+                topic=topiclib.prepend_mountpoint(self.cfg.mountpoint, p.will_topic or ""),
+                payload=p.will_payload or b"",
+                qos=p.will_qos,
+                retain=p.will_retain,
+                from_client=clientid,
+                from_username=p.username,
+                properties=dict(p.will_props),
+            )
+
+        self.clientid = clientid
+        session, present = self.broker.cm.open_session(
+            p.clean_start, clientid, self._make_session
+        )
+        self.session = session
+        self._m("session.resumed" if present else "session.created")
+        self.state = CONNECTED
+        self.connected_at = time.time()
+        self.broker.cm.register_channel(self)
+
+        props: pkt.Properties = {}
+        if self.v5:
+            if assigned:
+                props[Property.ASSIGNED_CLIENT_IDENTIFIER] = clientid
+            if self.cfg.server_keepalive is not None:
+                props[Property.SERVER_KEEP_ALIVE] = self.cfg.server_keepalive
+                self.keepalive = self.cfg.server_keepalive
+            if self.cfg.max_qos_allowed < 2:
+                props[Property.MAXIMUM_QOS] = self.cfg.max_qos_allowed
+            if not self.cfg.retain_available:
+                props[Property.RETAIN_AVAILABLE] = 0
+            if not self.cfg.wildcard_sub_available:
+                props[Property.WILDCARD_SUBSCRIPTION_AVAILABLE] = 0
+            if not self.cfg.shared_sub_available:
+                props[Property.SHARED_SUBSCRIPTION_AVAILABLE] = 0
+            props[Property.TOPIC_ALIAS_MAXIMUM] = self.cfg.max_topic_alias
+            if self.expiry_interval != int(
+                p.properties.get(Property.SESSION_EXPIRY_INTERVAL, 0)
+            ):
+                props[Property.SESSION_EXPIRY_INTERVAL] = self.expiry_interval
+
+        self._m("packets.connack.sent")
+        self._m("client.connack")
+        self._m("client.connected")
+        self.broker.hooks.run("client.connected", (self.clientinfo,))
+        acts: List[Action] = [
+            ("send", pkt.Connack(session_present=present, reason_code=0, properties=props)),
+            ("connected",),
+        ]
+        if present:
+            for d in session.replay():
+                acts.extend(self._deliveries_out([d]))
+        return acts
+
+    def _make_session(self) -> Session:
+        return Session(
+            clientid=self.clientid,
+            clean_start=self.clean_start,
+            expiry_interval=self.expiry_interval,
+            max_inflight=self.cfg.max_inflight,
+            max_mqueue=self.cfg.max_mqueue,
+            upgrade_qos=self.cfg.upgrade_qos,
+            retry_interval=self.cfg.retry_interval,
+            max_awaiting_rel=self.cfg.max_awaiting_rel,
+            await_rel_timeout=self.cfg.await_rel_timeout,
+        )
+
+    # -- PUBLISH ----------------------------------------------------------
+
+    def _resolve_alias(self, p: pkt.Publish) -> Optional[str]:
+        if not self.v5:
+            return p.topic
+        alias = p.properties.get(Property.TOPIC_ALIAS)
+        if alias is not None:
+            if alias == 0 or alias > self.cfg.max_topic_alias:
+                return None
+            if p.topic:
+                self.alias_in[alias] = p.topic
+                return p.topic
+            return self.alias_in.get(alias)
+        return p.topic
+
+    def _in_publish(self, p: pkt.Publish) -> List[Action]:
+        self._m("packets.publish.received")
+        self._m(f"messages.qos{p.qos}.received")
+        topic = self._resolve_alias(p)
+        if topic is None:
+            return self._close(ReasonCode.TOPIC_ALIAS_INVALID, send_disconnect=True)
+        if not topiclib.validate_name(topic):
+            return self._puberr(p, ReasonCode.TOPIC_NAME_INVALID)
+        if p.qos > self.cfg.max_qos_allowed:
+            return self._close(ReasonCode.QOS_NOT_SUPPORTED, send_disconnect=True)
+        if p.retain and not self.cfg.retain_available:
+            return self._close(ReasonCode.RETAIN_NOT_SUPPORTED, send_disconnect=True)
+        if topiclib.levels(topic) > self.cfg.max_topic_levels:
+            return self._puberr(p, ReasonCode.TOPIC_NAME_INVALID)
+
+        if self.access.authorize(self.clientinfo, PUB, topic, self.authz_cache) == DENY:
+            self._m("authorization.deny")
+            return self._puberr(p, ReasonCode.NOT_AUTHORIZED)
+        self._m("authorization.allow")
+
+        full_topic = topiclib.prepend_mountpoint(self.cfg.mountpoint, topic)
+        msg = Message(
+            topic=full_topic,
+            payload=p.payload,
+            qos=p.qos,
+            retain=p.retain,
+            from_client=self.clientid,
+            from_username=self.clientinfo.username,
+            properties={
+                k: v for k, v in p.properties.items() if k != Property.TOPIC_ALIAS
+            },
+        )
+
+        if p.qos == 0:
+            self.broker.publish(msg)
+            return []
+        if p.qos == 1:
+            n = self.broker.publish(msg)
+            rc = 0 if n else (ReasonCode.NO_MATCHING_SUBSCRIBERS if self.v5 else 0)
+            self._m("packets.puback.sent")
+            return [("send", pkt.PubAck(packet_id=p.packet_id, reason_code=rc))]
+        # qos 2
+        try:
+            self.session.publish_qos2(p.packet_id)
+        except SessionError as e:
+            return [("send", pkt.PubRec(packet_id=p.packet_id, reason_code=e.reason_code))]
+        n = self.broker.publish(msg)
+        rc = 0 if n else (ReasonCode.NO_MATCHING_SUBSCRIBERS if self.v5 else 0)
+        return [("send", pkt.PubRec(packet_id=p.packet_id, reason_code=rc))]
+
+    def _puberr(self, p: pkt.Publish, rc: int) -> List[Action]:
+        """Error response appropriate to the publish qos/version."""
+        if p.qos == 0:
+            if rc in (ReasonCode.TOPIC_NAME_INVALID,):
+                return self._close(rc, send_disconnect=True)
+            return []  # silently drop (authz deny on qos0)
+        cls = pkt.PubAck if p.qos == 1 else pkt.PubRec
+        if self.v5:
+            return [("send", cls(packet_id=p.packet_id, reason_code=rc))]
+        # v3: no way to signal; disconnect on protocol violations
+        if rc == ReasonCode.TOPIC_NAME_INVALID:
+            return self._close(rc)
+        return []
+
+    # -- acks -------------------------------------------------------------
+
+    def _in_puback(self, p: pkt.PubAck) -> List[Action]:
+        self._m("packets.puback.received")
+        try:
+            msg, more = self.session.puback(p.packet_id)
+            self._m("messages.acked")
+            self.broker.hooks.run("message.acked", (self.clientid, msg))
+            return self._deliveries_out(more)
+        except SessionError:
+            self._m("packets.puback.missed")
+            return []
+
+    def _in_pubrec(self, p: pkt.PubRec) -> List[Action]:
+        self._m("packets.pubrec.received")
+        try:
+            msg = self.session.pubrec(p.packet_id)
+            self._m("messages.acked")
+            self.broker.hooks.run("message.acked", (self.clientid, msg))
+            self._m("packets.pubrel.sent")
+            return [("send", pkt.PubRel(packet_id=p.packet_id))]
+        except SessionError as e:
+            self._m("packets.pubrec.missed")
+            if self.v5:
+                return [("send", pkt.PubRel(packet_id=p.packet_id, reason_code=e.reason_code))]
+            return [("send", pkt.PubRel(packet_id=p.packet_id))]
+
+    def _in_pubrel(self, p: pkt.PubRel) -> List[Action]:
+        self._m("packets.pubrel.received")
+        found = self.session.pubrel(p.packet_id)
+        rc = 0 if found else ReasonCode.PACKET_IDENTIFIER_NOT_FOUND
+        if not found:
+            self._m("packets.pubrel.missed")
+        self._m("packets.pubcomp.sent")
+        return [("send", pkt.PubComp(packet_id=p.packet_id, reason_code=rc if self.v5 else 0))]
+
+    def _in_pubcomp(self, p: pkt.PubComp) -> List[Action]:
+        self._m("packets.pubcomp.received")
+        try:
+            more = self.session.pubcomp(p.packet_id)
+            return self._deliveries_out(more)
+        except SessionError:
+            self._m("packets.pubcomp.missed")
+            return []
+
+    # -- SUBSCRIBE / UNSUBSCRIBE ------------------------------------------
+
+    def _check_sub(self, tf: str, opts: SubOpts) -> int:
+        group, real = topiclib.parse_share(tf)
+        if group is not None and not self.cfg.shared_sub_available:
+            return ReasonCode.SHARED_SUBSCRIPTIONS_NOT_SUPPORTED
+        if not topiclib.validate_filter(real):
+            return ReasonCode.TOPIC_FILTER_INVALID
+        if topiclib.levels(real) > self.cfg.max_topic_levels:
+            return ReasonCode.TOPIC_FILTER_INVALID
+        if topiclib.wildcard(real) and not self.cfg.wildcard_sub_available:
+            return ReasonCode.WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED
+        if group is not None and opts.no_local:
+            # v5 spec: no_local on a shared subscription is a protocol error
+            return ReasonCode.PROTOCOL_ERROR
+        if self.access.authorize(self.clientinfo, SUB, real, self.authz_cache) == DENY:
+            self._m("authorization.deny")
+            return ReasonCode.NOT_AUTHORIZED
+        return min(opts.qos, self.cfg.max_qos_allowed)
+
+    def _in_subscribe(self, p: pkt.Subscribe) -> List[Action]:
+        self._m("packets.subscribe.received")
+        self._m("client.subscribe")
+        filters = self.broker.hooks.run_fold(
+            "client.subscribe", (self.clientinfo, p.properties), p.topic_filters
+        )
+        codes: List[int] = []
+        acts: List[Action] = []
+        sub_id = None
+        if self.v5:
+            sids = p.properties.get(Property.SUBSCRIPTION_IDENTIFIER)
+            if sids:
+                sub_id = sids[0] if isinstance(sids, list) else sids
+        for tf, opts in filters:
+            rc = self._check_sub(tf, opts)
+            codes.append(rc)
+            if rc > 2:
+                continue
+            granted = replace(opts, qos=rc, sub_id=sub_id)
+            mounted = topiclib.mount_filter(self.cfg.mountpoint, tf)
+            is_new = self.session.subscribe(mounted, granted)
+            if is_new:
+                # re-subscribes only update session opts; the engine
+                # refcount must stay one per live subscription
+                self.broker.subscribe(self.clientid, mounted, granted)
+            else:
+                self.broker.hooks.run(
+                    "session.subscribed", (self.clientid, mounted, granted)
+                )
+            # retained messages (v5 retain-handling; v3 always sends)
+            rh = granted.retain_handling if self.v5 else 0
+            for rmsg in self.broker.retained_for(mounted, rh, is_new):
+                rmsg = replace(rmsg, headers=dict(rmsg.headers, retained=True))
+                _g, real = topiclib.parse_share(mounted)
+                for d in self.session.deliver([(real, rmsg)]):
+                    acts.extend(self._delivery_to_send(d))
+        self._m("packets.suback.sent")
+        return [("send", pkt.SubAck(packet_id=p.packet_id, reason_codes=codes))] + acts
+
+    def _in_unsubscribe(self, p: pkt.Unsubscribe) -> List[Action]:
+        self._m("packets.unsubscribe.received")
+        self._m("client.unsubscribe")
+        codes: List[int] = []
+        for tf in p.topic_filters:
+            mounted = topiclib.mount_filter(self.cfg.mountpoint, tf)
+            if self.session.unsubscribe(mounted) is not None:
+                self.broker.unsubscribe(self.clientid, mounted)
+                codes.append(0)
+            else:
+                codes.append(ReasonCode.NO_SUBSCRIPTION_EXISTED)
+        self._m("packets.unsuback.sent")
+        return [("send", pkt.UnsubAck(packet_id=p.packet_id, reason_codes=codes))]
+
+    # -- PING / DISCONNECT / AUTH -----------------------------------------
+
+    def _in_pingreq(self, p: pkt.PingReq) -> List[Action]:
+        self._m("packets.pingreq.received")
+        self._m("packets.pingresp.sent")
+        return [("send", pkt.PingResp())]
+
+    def _in_disconnect(self, p: pkt.Disconnect) -> List[Action]:
+        self._m("packets.disconnect.received")
+        if self.v5:
+            exp = p.properties.get(Property.SESSION_EXPIRY_INTERVAL)
+            if exp is not None:
+                if self.expiry_interval == 0 and exp > 0:
+                    return self._close(ReasonCode.PROTOCOL_ERROR, send_disconnect=True)
+                self.expiry_interval = min(exp, self.cfg.max_session_expiry)
+                if self.session:
+                    self.session.expiry_interval = self.expiry_interval
+        if p.reason_code == ReasonCode.DISCONNECT_WITH_WILL:
+            self._will_on_normal = True  # MQTT-3.14.2-10: publish the will
+        else:
+            self.will_msg = None  # normal disconnect discards the will
+        self.disconnect_reason = p.reason_code
+        return [("close", None)]
+
+    def _in_auth(self, p: pkt.Auth) -> List[Action]:
+        self._m("packets.auth.received")
+        # Enhanced (SASL-style) auth: delegated to the 'client.enhanced_auth'
+        # chain; without a registered provider it is a protocol error, like
+        # a reference broker with no matching authenticator.
+        out = self.broker.hooks.run_fold("client.enhanced_auth", (self.clientinfo, p), None)
+        if out is None:
+            return self._close(ReasonCode.BAD_AUTHENTICATION_METHOD, send_disconnect=True)
+        action, payload = out
+        if action == "ok":
+            return [("send", pkt.Auth(reason_code=0, properties=payload or {}))]
+        if action == "continue":
+            self._m("packets.auth.sent")
+            return [("send", pkt.Auth(reason_code=ReasonCode.CONTINUE_AUTHENTICATION, properties=payload or {}))]
+        return self._close(ReasonCode.NOT_AUTHORIZED, send_disconnect=True)
+
+    # ----------------------------------------------------------- outbound
+
+    def deliver(self, delivers: List[Tuple[str, Message]]) -> None:
+        """Called by the broker dispatch; pushes actions to the connection."""
+        acts = self._deliveries_out(self.session.deliver(delivers))
+        if acts:
+            self.out_cb(acts)
+
+    def _deliveries_out(self, ds) -> List[Action]:
+        acts: List[Action] = []
+        for d in ds:
+            acts.extend(self._delivery_to_send(d))
+        return acts
+
+    def _delivery_to_send(self, d) -> List[Action]:
+        if d.message is None:  # pubrel resend
+            self._m("packets.pubrel.sent")
+            return [("send", pkt.PubRel(packet_id=d.packet_id))]
+        msg = d.message
+        props = dict(msg.properties)
+        if self.v5 and d.sub_ids:
+            props[Property.SUBSCRIPTION_IDENTIFIER] = list(d.sub_ids)
+        topic = topiclib.strip_mountpoint(self.cfg.mountpoint, msg.topic)
+        self._m("packets.publish.sent")
+        self._m("messages.sent")
+        return [
+            (
+                "send",
+                pkt.Publish(
+                    topic=topic,
+                    payload=msg.payload,
+                    qos=d.qos,
+                    retain=d.retain,
+                    dup=d.dup,
+                    packet_id=d.packet_id,
+                    properties=props,
+                ),
+            )
+        ]
+
+    # ------------------------------------------------------------- timers
+
+    def handle_retry(self) -> List[Action]:
+        if self.session is None:
+            return []
+        return self._deliveries_out(self.session.retry())
+
+    def handle_expire_awaiting_rel(self) -> List[Action]:
+        if self.session:
+            dead = self.session.expire_awaiting_rel()
+            if dead:
+                self._m("messages.dropped.await_pubrel_timeout", len(dead))
+        return []
+
+    # ---------------------------------------------------------- lifecycle
+
+    def kick(self, reason_code: int) -> None:
+        """Forced close (takeover/admin). Connection observes via callback."""
+        self.state = DISCONNECTED
+        self._takeover = reason_code == ReasonCode.SESSION_TAKEN_OVER
+        if self.on_kick:
+            self.on_kick(reason_code)
+
+    def terminate(self, normal: bool) -> None:
+        """Connection gone: unregister, maybe publish will, park session."""
+        if self.state == DISCONNECTED and self._takeover:
+            # session stolen by a new connection: nothing to clean
+            self._m("session.takenover")
+            return
+        was_connected = self.state == CONNECTED
+        self.state = DISCONNECTED
+        if self.session is not None:
+            if (not normal or self._will_on_normal) and self.will_msg is not None:
+                self.broker.publish(self.will_msg)
+                self.will_msg = None
+            if self.session.expiry_interval == 0:
+                # session dies with the connection: clean routes
+                self.broker.client_down(
+                    self.clientid, list(self.session.subscriptions)
+                )
+                self._m("session.terminated")
+            self.broker.cm.disconnect_channel(self)
+        if was_connected:
+            self._m("client.disconnected")
+            self.broker.hooks.run("client.disconnected", (self.clientinfo, normal))

@@ -1,0 +1,141 @@
+"""Connection/session manager: clientid -> channel registry, takeover.
+
+Analog of `emqx_cm.erl` (SURVEY.md §1.6): open_session with clean-start
+discard vs resume, session takeover when a clientid reconnects while a live
+channel exists (`emqx_cm.erl:225-285,320-361`), and expiry of disconnected
+persistent sessions.  Single-node in-process registry; the cluster layer
+wraps it with a distributed registry + per-clientid locks.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Protocol, Tuple
+
+from .packet import ReasonCode
+from .session import Session
+
+
+class ChannelLike(Protocol):
+    clientid: str
+    session: Session
+
+    def kick(self, reason_code: int) -> None: ...
+    def deliver(self, delivers) -> None: ...
+
+
+class ConnectionManager:
+    def __init__(self) -> None:
+        self.channels: Dict[str, ChannelLike] = {}
+        # disconnected persistent sessions: clientid -> (session, expire_at)
+        self.pending: Dict[str, Tuple[Session, float]] = {}
+        self.on_discard: Optional[Callable[[Session], None]] = None
+
+    # ------------------------------------------------------------- open
+
+    def open_session(
+        self,
+        clean_start: bool,
+        clientid: str,
+        make_session: Callable[[], Session],
+    ) -> Tuple[Session, bool]:
+        """Returns (session, session_present).
+
+        Mirrors `emqx_cm:open_session`: clean_start discards any existing
+        state; otherwise a live channel is taken over (its session is
+        stolen and the old connection kicked) or a pending disconnected
+        session is resumed.
+        """
+        old = self.channels.get(clientid)
+        if clean_start:
+            if old is not None:
+                self._kick(old, ReasonCode.SESSION_TAKEN_OVER)
+            dropped = self.pending.pop(clientid, None)
+            if dropped and self.on_discard:
+                self.on_discard(dropped[0])
+            return make_session(), False
+        if old is not None:
+            session = old.session
+            self._kick(old, ReasonCode.SESSION_TAKEN_OVER)
+            return session, True
+        ent = self.pending.pop(clientid, None)
+        if ent is not None:
+            session, expire_at = ent
+            if time.time() < expire_at or session.expiry_interval == 0xFFFFFFFF:
+                return session, True
+            if self.on_discard:
+                self.on_discard(session)
+        return make_session(), False
+
+    def _kick(self, ch: ChannelLike, rc: int) -> None:
+        self.channels.pop(ch.clientid, None)
+        try:
+            ch.kick(rc)
+        except Exception:
+            pass
+
+    # --------------------------------------------------------- registry
+
+    def register_channel(self, ch: ChannelLike) -> None:
+        self.channels[ch.clientid] = ch
+
+    def unregister_channel(self, ch: ChannelLike) -> None:
+        cur = self.channels.get(ch.clientid)
+        if cur is ch:
+            del self.channels[ch.clientid]
+
+    def disconnect_channel(self, ch: ChannelLike) -> None:
+        """Connection closed: park the session if it has an expiry."""
+        self.unregister_channel(ch)
+        s = ch.session
+        if s.expiry_interval > 0:
+            ttl = (
+                float("inf")
+                if s.expiry_interval == 0xFFFFFFFF
+                else s.expiry_interval
+            )
+            self.pending[ch.clientid] = (s, time.time() + ttl)
+        elif self.on_discard:
+            self.on_discard(s)
+
+    def lookup(self, clientid: str) -> Optional[ChannelLike]:
+        return self.channels.get(clientid)
+
+    def lookup_session(self, clientid: str) -> Optional[Session]:
+        ch = self.channels.get(clientid)
+        if ch is not None:
+            return ch.session
+        ent = self.pending.get(clientid)
+        return ent[0] if ent else None
+
+    def discard_session(self, clientid: str) -> None:
+        old = self.channels.get(clientid)
+        if old is not None:
+            self._kick(old, ReasonCode.SESSION_TAKEN_OVER)
+        ent = self.pending.pop(clientid, None)
+        if ent and self.on_discard:
+            self.on_discard(ent[0])
+
+    def kick_session(self, clientid: str, rc: int = ReasonCode.ADMINISTRATIVE_ACTION) -> bool:
+        old = self.channels.get(clientid)
+        if old is not None:
+            self._kick(old, rc)
+            return True
+        return self.pending.pop(clientid, None) is not None
+
+    def evict_expired(self, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.time()
+        dead = [cid for cid, (_s, exp) in self.pending.items() if exp <= now]
+        for cid in dead:
+            s, _ = self.pending.pop(cid)
+            if self.on_discard:
+                self.on_discard(s)
+        return len(dead)
+
+    @property
+    def connection_count(self) -> int:
+        return len(self.channels)
+
+    @property
+    def session_count(self) -> int:
+        return len(self.channels) + len(self.pending)

@@ -1,0 +1,575 @@
+"""MQTT wire codec: incremental parser + serializer for v3.1/3.1.1/5.0.
+
+The Python analog of the reference's `emqx_frame.erl` (continuation-state
+binary parser, `apps/emqx/src/emqx_frame.erl:114-169,221+`) — property-tested
+round-trip like `prop_emqx_frame`.  A C++ fast path can replace the byte
+loops behind the same API (see ops/native).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import List, Optional, Tuple
+
+from . import packet as pkt
+from .packet import PacketType, Property, PROPERTY_TYPES, ReasonCode
+
+MAX_REMAINING = 268_435_455  # 4-byte varint max
+DEFAULT_MAX_SIZE = 1_048_576  # matches reference default max_packet_size 1MB
+
+
+class FrameError(Exception):
+    def __init__(self, reason_code: int, msg: str = ""):
+        super().__init__(msg or hex(reason_code))
+        self.reason_code = reason_code
+
+
+MALFORMED = ReasonCode.MALFORMED_PACKET
+PROTO_ERR = ReasonCode.PROTOCOL_ERROR
+
+
+# ------------------------------------------------------------------ reader
+
+class _Reader:
+    __slots__ = ("buf", "pos", "end")
+
+    def __init__(self, buf: bytes, pos: int = 0, end: Optional[int] = None):
+        self.buf = buf
+        self.pos = pos
+        self.end = len(buf) if end is None else end
+
+    def remaining(self) -> int:
+        return self.end - self.pos
+
+    def u8(self) -> int:
+        if self.pos + 1 > self.end:
+            raise FrameError(MALFORMED, "truncated u8")
+        v = self.buf[self.pos]
+        self.pos += 1
+        return v
+
+    def u16(self) -> int:
+        if self.pos + 2 > self.end:
+            raise FrameError(MALFORMED, "truncated u16")
+        v = int.from_bytes(self.buf[self.pos : self.pos + 2], "big")
+        self.pos += 2
+        return v
+
+    def u32(self) -> int:
+        if self.pos + 4 > self.end:
+            raise FrameError(MALFORMED, "truncated u32")
+        v = int.from_bytes(self.buf[self.pos : self.pos + 4], "big")
+        self.pos += 4
+        return v
+
+    def varint(self) -> int:
+        mult, val = 1, 0
+        for _ in range(4):
+            b = self.u8()
+            val += (b & 0x7F) * mult
+            if not b & 0x80:
+                return val
+            mult *= 128
+        raise FrameError(MALFORMED, "varint too long")
+
+    def bin(self) -> bytes:
+        n = self.u16()
+        if self.pos + n > self.end:
+            raise FrameError(MALFORMED, "truncated binary")
+        v = bytes(self.buf[self.pos : self.pos + n])
+        self.pos += n
+        return v
+
+    def utf8(self) -> str:
+        try:
+            return self.bin().decode("utf-8")
+        except UnicodeDecodeError:
+            raise FrameError(MALFORMED, "invalid utf8")
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > self.end:
+            raise FrameError(MALFORMED, "truncated bytes")
+        v = bytes(self.buf[self.pos : self.pos + n])
+        self.pos += n
+        return v
+
+    def rest(self) -> bytes:
+        v = bytes(self.buf[self.pos : self.end])
+        self.pos = self.end
+        return v
+
+
+# -------------------------------------------------------------- properties
+
+def _parse_properties(r: _Reader) -> pkt.Properties:
+    total = r.varint()
+    end = r.pos + total
+    if end > r.end:
+        raise FrameError(MALFORMED, "truncated properties")
+    props: pkt.Properties = {}
+    sub = _Reader(r.buf, r.pos, end)
+    while sub.remaining() > 0:
+        pid = sub.varint()
+        try:
+            prop = Property(pid)
+        except ValueError:
+            raise FrameError(MALFORMED, f"unknown property {pid:#x}")
+        t = PROPERTY_TYPES[prop]
+        if t == "byte":
+            v = sub.u8()
+        elif t == "u16":
+            v = sub.u16()
+        elif t == "u32":
+            v = sub.u32()
+        elif t == "varint":
+            v = sub.varint()
+        elif t == "utf8":
+            v = sub.utf8()
+        elif t == "bin":
+            v = sub.bin()
+        else:  # utf8pair
+            v = (sub.utf8(), sub.utf8())
+        if prop == Property.USER_PROPERTY:
+            props.setdefault(prop, []).append(v)
+        elif prop == Property.SUBSCRIPTION_IDENTIFIER:
+            props.setdefault(prop, []).append(v)
+        elif prop in props:
+            raise FrameError(PROTO_ERR, f"duplicate property {prop}")
+        else:
+            props[prop] = v
+    r.pos = end
+    return props
+
+
+def _varint_bytes(n: int) -> bytes:
+    if n < 0 or n > MAX_REMAINING:
+        raise FrameError(MALFORMED, "varint out of range")
+    out = bytearray()
+    while True:
+        b = n % 128
+        n //= 128
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _utf8_bytes(s: str) -> bytes:
+    b = s.encode("utf-8")
+    if len(b) > 0xFFFF:
+        raise FrameError(MALFORMED, "string too long")
+    return struct.pack(">H", len(b)) + b
+
+
+def _bin_bytes(b: bytes) -> bytes:
+    if len(b) > 0xFFFF:
+        raise FrameError(MALFORMED, "binary too long")
+    return struct.pack(">H", len(b)) + b
+
+
+def _serialize_properties(props: pkt.Properties) -> bytes:
+    body = bytearray()
+    for pid, v in props.items():
+        prop = Property(pid)
+        t = PROPERTY_TYPES[prop]
+        vals = v if prop in (Property.USER_PROPERTY, Property.SUBSCRIPTION_IDENTIFIER) and isinstance(v, list) else [v]
+        for val in vals:
+            body += _varint_bytes(int(prop))
+            if t == "byte":
+                body.append(int(val) & 0xFF)
+            elif t == "u16":
+                body += struct.pack(">H", int(val))
+            elif t == "u32":
+                body += struct.pack(">I", int(val))
+            elif t == "varint":
+                body += _varint_bytes(int(val))
+            elif t == "utf8":
+                body += _utf8_bytes(val)
+            elif t == "bin":
+                body += _bin_bytes(val)
+            else:  # utf8pair
+                k, vv = val
+                body += _utf8_bytes(k) + _utf8_bytes(vv)
+    return _varint_bytes(len(body)) + bytes(body)
+
+
+# ----------------------------------------------------------------- parser
+
+class Parser:
+    """Incremental MQTT parser with continuation state.
+
+    feed(data) -> list of parsed packets; partial packets are buffered.
+    The protocol version is latched from the CONNECT packet (like
+    `emqx_frame:parse` threading `#{version := Ver}` options).
+    """
+
+    def __init__(self, version: int = pkt.MQTT_V4, max_size: int = DEFAULT_MAX_SIZE, strict: bool = True):
+        self.version = version
+        self.max_size = max_size
+        self.strict = strict
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[pkt.Packet]:
+        self._buf += data
+        out = []
+        while True:
+            parsed = self._try_parse_one()
+            if parsed is None:
+                return out
+            out.append(parsed)
+
+    def _try_parse_one(self) -> Optional[pkt.Packet]:
+        buf = self._buf
+        if len(buf) < 2:
+            return None
+        # remaining-length varint: bytes 1..4 after the header byte
+        rl, mult, idx = 0, 1, 1
+        while True:
+            if idx >= len(buf):
+                return None  # need more data for length
+            b = buf[idx]
+            rl += (b & 0x7F) * mult
+            idx += 1
+            if not b & 0x80:
+                break
+            if idx > 4:
+                raise FrameError(MALFORMED, "remaining length varint too long")
+            mult *= 128
+        total = idx + rl
+        if total > self.max_size:
+            raise FrameError(ReasonCode.PACKET_TOO_LARGE, f"packet {total} > max {self.max_size}")
+        if len(buf) < total:
+            return None
+        header = buf[0]
+        body = bytes(buf[idx:total])
+        del self._buf[:total]
+        return self._parse_packet(header, body)
+
+    # -- per-type body parsing
+
+    def _parse_packet(self, header: int, body: bytes) -> pkt.Packet:
+        ptype = header >> 4
+        flags = header & 0x0F
+        r = _Reader(body)
+        try:
+            t = PacketType(ptype)
+        except ValueError:
+            raise FrameError(MALFORMED, f"bad packet type {ptype}")
+
+        if t == PacketType.PUBLISH:
+            return self._parse_publish(flags, r)
+        if self.strict:
+            want = (
+                0x2
+                if t in (PacketType.PUBREL, PacketType.SUBSCRIBE, PacketType.UNSUBSCRIBE)
+                else 0x0
+            )
+            if flags != want:
+                raise FrameError(MALFORMED, f"bad flags {flags:#x} for {t.name}")
+
+        if t == PacketType.CONNECT:
+            return self._parse_connect(r)
+        if t == PacketType.CONNACK:
+            return self._parse_connack(r)
+        if t in (PacketType.PUBACK, PacketType.PUBREC, PacketType.PUBREL, PacketType.PUBCOMP):
+            return self._parse_puback_like(t, r)
+        if t == PacketType.SUBSCRIBE:
+            return self._parse_subscribe(r)
+        if t == PacketType.SUBACK:
+            return self._parse_suback(r)
+        if t == PacketType.UNSUBSCRIBE:
+            return self._parse_unsubscribe(r)
+        if t == PacketType.UNSUBACK:
+            return self._parse_unsuback(r)
+        if t == PacketType.PINGREQ:
+            return pkt.PingReq()
+        if t == PacketType.PINGRESP:
+            return pkt.PingResp()
+        if t == PacketType.DISCONNECT:
+            return self._parse_disconnect(r)
+        if t == PacketType.AUTH:
+            return self._parse_auth(r)
+        raise FrameError(MALFORMED, f"unhandled type {t}")
+
+    def _parse_connect(self, r: _Reader) -> pkt.Connect:
+        proto_name = r.utf8()
+        proto_ver = r.u8()
+        if (proto_name, proto_ver) not in (("MQIsdp", 3), ("MQTT", 4), ("MQTT", 5)):
+            raise FrameError(
+                ReasonCode.UNSUPPORTED_PROTOCOL_VERSION,
+                f"unsupported protocol {proto_name!r} v{proto_ver}",
+            )
+        self.version = proto_ver
+        flags = r.u8()
+        if self.strict and flags & 0x01:
+            raise FrameError(MALFORMED, "reserved connect flag set")
+        has_user = bool(flags >> 7 & 1)
+        has_pass = bool(flags >> 6 & 1)
+        will_retain = bool(flags >> 5 & 1)
+        will_qos = flags >> 3 & 0x3
+        will_flag = bool(flags >> 2 & 1)
+        clean_start = bool(flags >> 1 & 1)
+        if self.strict and not will_flag and (will_qos or will_retain):
+            raise FrameError(MALFORMED, "will flags without will")
+        if self.strict and will_qos > 2:
+            raise FrameError(MALFORMED, "bad will qos")
+        keepalive = r.u16()
+        props: pkt.Properties = {}
+        if proto_ver == pkt.MQTT_V5:
+            props = _parse_properties(r)
+        clientid = r.utf8()
+        will_props: pkt.Properties = {}
+        will_topic = will_payload = None
+        if will_flag:
+            if proto_ver == pkt.MQTT_V5:
+                will_props = _parse_properties(r)
+            will_topic = r.utf8()
+            will_payload = r.bin()
+        username = r.utf8() if has_user else None
+        password = r.bin() if has_pass else None
+        if self.strict and r.remaining():
+            raise FrameError(MALFORMED, "trailing bytes in CONNECT")
+        return pkt.Connect(
+            proto_name=proto_name,
+            proto_ver=proto_ver,
+            clean_start=clean_start,
+            keepalive=keepalive,
+            clientid=clientid,
+            username=username,
+            password=password,
+            will_flag=will_flag,
+            will_qos=will_qos,
+            will_retain=will_retain,
+            will_topic=will_topic,
+            will_payload=will_payload,
+            will_props=will_props,
+            properties=props,
+        )
+
+    def _parse_connack(self, r: _Reader) -> pkt.Connack:
+        ack = r.u8()
+        if self.strict and ack & 0xFE:
+            raise FrameError(MALFORMED, "bad connack flags")
+        rc = r.u8()
+        props: pkt.Properties = {}
+        if self.version == pkt.MQTT_V5:
+            props = _parse_properties(r)
+        return pkt.Connack(session_present=bool(ack & 1), reason_code=rc, properties=props)
+
+    def _parse_publish(self, flags: int, r: _Reader) -> pkt.Publish:
+        dup = bool(flags >> 3 & 1)
+        qos = flags >> 1 & 0x3
+        retain = bool(flags & 1)
+        if qos == 3:
+            raise FrameError(MALFORMED, "bad publish qos")
+        topic = r.utf8()
+        packet_id = r.u16() if qos > 0 else None
+        if packet_id == 0:
+            raise FrameError(MALFORMED, "zero packet id")
+        props: pkt.Properties = {}
+        if self.version == pkt.MQTT_V5:
+            props = _parse_properties(r)
+        return pkt.Publish(
+            topic=topic,
+            payload=r.rest(),
+            qos=qos,
+            retain=retain,
+            dup=dup,
+            packet_id=packet_id,
+            properties=props,
+        )
+
+    def _parse_puback_like(self, t: PacketType, r: _Reader):
+        cls = {
+            PacketType.PUBACK: pkt.PubAck,
+            PacketType.PUBREC: pkt.PubRec,
+            PacketType.PUBREL: pkt.PubRel,
+            PacketType.PUBCOMP: pkt.PubComp,
+        }[t]
+        packet_id = r.u16()
+        rc, props = 0, {}
+        if self.version == pkt.MQTT_V5 and r.remaining():
+            rc = r.u8()
+            if r.remaining():
+                props = _parse_properties(r)
+        return cls(packet_id=packet_id, reason_code=rc, properties=props)
+
+    def _parse_subscribe(self, r: _Reader) -> pkt.Subscribe:
+        packet_id = r.u16()
+        props: pkt.Properties = {}
+        if self.version == pkt.MQTT_V5:
+            props = _parse_properties(r)
+        filters: List[Tuple[str, pkt.SubOpts]] = []
+        while r.remaining():
+            tf = r.utf8()
+            ob = r.u8()
+            if self.strict and self.version == pkt.MQTT_V5 and ob & 0xC0:
+                raise FrameError(MALFORMED, "reserved subopts bits")
+            opts = pkt.SubOpts.from_byte(ob if self.version == pkt.MQTT_V5 else ob & 0x3)
+            if self.strict and opts.qos > 2:
+                raise FrameError(MALFORMED, "bad sub qos")
+            filters.append((tf, opts))
+        if not filters and self.strict:
+            raise FrameError(PROTO_ERR, "empty subscribe")
+        return pkt.Subscribe(packet_id=packet_id, topic_filters=filters, properties=props)
+
+    def _parse_suback(self, r: _Reader) -> pkt.SubAck:
+        packet_id = r.u16()
+        props: pkt.Properties = {}
+        if self.version == pkt.MQTT_V5:
+            props = _parse_properties(r)
+        codes = list(r.rest())
+        return pkt.SubAck(packet_id=packet_id, reason_codes=codes, properties=props)
+
+    def _parse_unsubscribe(self, r: _Reader) -> pkt.Unsubscribe:
+        packet_id = r.u16()
+        props: pkt.Properties = {}
+        if self.version == pkt.MQTT_V5:
+            props = _parse_properties(r)
+        filters = []
+        while r.remaining():
+            filters.append(r.utf8())
+        if not filters and self.strict:
+            raise FrameError(PROTO_ERR, "empty unsubscribe")
+        return pkt.Unsubscribe(packet_id=packet_id, topic_filters=filters, properties=props)
+
+    def _parse_unsuback(self, r: _Reader) -> pkt.UnsubAck:
+        packet_id = r.u16()
+        props: pkt.Properties = {}
+        codes: List[int] = []
+        if self.version == pkt.MQTT_V5:
+            props = _parse_properties(r)
+            codes = list(r.rest())
+        return pkt.UnsubAck(packet_id=packet_id, reason_codes=codes, properties=props)
+
+    def _parse_disconnect(self, r: _Reader) -> pkt.Disconnect:
+        if self.version != pkt.MQTT_V5 or r.remaining() == 0:
+            return pkt.Disconnect()
+        rc = r.u8()
+        props = _parse_properties(r) if r.remaining() else {}
+        return pkt.Disconnect(reason_code=rc, properties=props)
+
+    def _parse_auth(self, r: _Reader) -> pkt.Auth:
+        if self.version != pkt.MQTT_V5:
+            raise FrameError(PROTO_ERR, "AUTH requires v5")
+        if r.remaining() == 0:
+            return pkt.Auth()
+        rc = r.u8()
+        props = _parse_properties(r) if r.remaining() else {}
+        return pkt.Auth(reason_code=rc, properties=props)
+
+
+# -------------------------------------------------------------- serializer
+
+def serialize(p: pkt.Packet, version: int = pkt.MQTT_V4) -> bytes:
+    t = p.type
+    v5 = version == pkt.MQTT_V5
+    flags = 0
+    body = bytearray()
+
+    if t == PacketType.CONNECT:
+        version = p.proto_ver
+        v5 = version == pkt.MQTT_V5
+        body += _utf8_bytes(p.proto_name)
+        body.append(p.proto_ver)
+        cf = (
+            (int(p.username is not None) << 7)
+            | (int(p.password is not None) << 6)
+            | (int(p.will_retain) << 5)
+            | ((p.will_qos & 0x3) << 3)
+            | (int(p.will_flag) << 2)
+            | (int(p.clean_start) << 1)
+        )
+        body.append(cf)
+        body += struct.pack(">H", p.keepalive)
+        if v5:
+            body += _serialize_properties(p.properties)
+        body += _utf8_bytes(p.clientid)
+        if p.will_flag:
+            if v5:
+                body += _serialize_properties(p.will_props)
+            body += _utf8_bytes(p.will_topic or "")
+            body += _bin_bytes(p.will_payload or b"")
+        if p.username is not None:
+            body += _utf8_bytes(p.username)
+        if p.password is not None:
+            body += _bin_bytes(p.password)
+
+    elif t == PacketType.CONNACK:
+        body.append(int(p.session_present))
+        body.append(
+            p.reason_code if v5 else pkt.compat_connack_v3(p.reason_code)
+        )
+        if v5:
+            body += _serialize_properties(p.properties)
+
+    elif t == PacketType.PUBLISH:
+        flags = (int(p.dup) << 3) | ((p.qos & 0x3) << 1) | int(p.retain)
+        body += _utf8_bytes(p.topic)
+        if p.qos > 0:
+            if not p.packet_id:
+                raise FrameError(PROTO_ERR, "qos>0 publish needs packet_id")
+            body += struct.pack(">H", p.packet_id)
+        if v5:
+            body += _serialize_properties(p.properties)
+        body += p.payload
+
+    elif t in (PacketType.PUBACK, PacketType.PUBREC, PacketType.PUBREL, PacketType.PUBCOMP):
+        if t == PacketType.PUBREL:
+            flags = 0x2
+        body += struct.pack(">H", p.packet_id)
+        if v5 and (p.reason_code or p.properties):
+            body.append(p.reason_code)
+            if p.properties:
+                body += _serialize_properties(p.properties)
+
+    elif t == PacketType.SUBSCRIBE:
+        flags = 0x2
+        body += struct.pack(">H", p.packet_id)
+        if v5:
+            body += _serialize_properties(p.properties)
+        for tf, opts in p.topic_filters:
+            body += _utf8_bytes(tf)
+            body.append(opts.to_byte() if v5 else opts.qos & 0x3)
+
+    elif t == PacketType.SUBACK:
+        body += struct.pack(">H", p.packet_id)
+        if v5:
+            body += _serialize_properties(p.properties)
+        body += bytes(p.reason_codes)
+
+    elif t == PacketType.UNSUBSCRIBE:
+        flags = 0x2
+        body += struct.pack(">H", p.packet_id)
+        if v5:
+            body += _serialize_properties(p.properties)
+        for tf in p.topic_filters:
+            body += _utf8_bytes(tf)
+
+    elif t == PacketType.UNSUBACK:
+        body += struct.pack(">H", p.packet_id)
+        if v5:
+            body += _serialize_properties(p.properties)
+            body += bytes(p.reason_codes)
+
+    elif t in (PacketType.PINGREQ, PacketType.PINGRESP):
+        pass
+
+    elif t == PacketType.DISCONNECT:
+        if v5 and (p.reason_code or p.properties):
+            body.append(p.reason_code)
+            if p.properties:
+                body += _serialize_properties(p.properties)
+
+    elif t == PacketType.AUTH:
+        if p.reason_code or p.properties:
+            body.append(p.reason_code)
+            if p.properties:
+                body += _serialize_properties(p.properties)
+    else:
+        raise FrameError(MALFORMED, f"cannot serialize {t}")
+
+    header = (int(t) << 4) | flags
+    return bytes([header]) + _varint_bytes(len(body)) + bytes(body)

@@ -1,0 +1,61 @@
+"""Priority-ordered hook chains with stop/fold semantics.
+
+Analog of `emqx_hooks.erl` (`run/2`, `run_fold/3`,
+`apps/emqx/src/emqx_hooks.erl:162-231`): callbacks registered per hookpoint
+with a priority (higher runs first); a callback may stop the chain and/or
+transform an accumulator.  This is the extension boundary every subsystem
+(authn, authz, rule engine, exhook bridge, retainer, ...) plugs into.
+
+Callback protocol (pythonized):
+  run(point, args):        cb(*args) -> None to continue, hooks.STOP to halt
+  run_fold(point, args, acc): cb(*args, acc) -> None (keep acc), (CONTINUE, new_acc),
+                              STOP, or (STOP, new_acc)
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+STOP = "stop"
+CONTINUE = "ok"
+
+
+class Hooks:
+    def __init__(self) -> None:
+        # point -> list of (-priority, seq, callback); kept sorted
+        self._chains: Dict[str, List[Tuple[int, int, Callable]]] = {}
+        self._seq = 0
+
+    def put(self, point: str, cb: Callable, priority: int = 0) -> None:
+        chain = self._chains.setdefault(point, [])
+        self._seq += 1
+        bisect.insort(chain, (-priority, self._seq, cb))
+
+    def delete(self, point: str, cb: Callable) -> None:
+        chain = self._chains.get(point, [])
+        self._chains[point] = [e for e in chain if e[2] is not cb]
+
+    def callbacks(self, point: str) -> List[Callable]:
+        return [cb for _, _, cb in self._chains.get(point, [])]
+
+    def run(self, point: str, args: Tuple = ()) -> None:
+        for cb in self.callbacks(point):
+            if cb(*args) == STOP:
+                return
+
+    def run_fold(self, point: str, args: Tuple, acc: Any) -> Any:
+        for cb in self.callbacks(point):
+            r = cb(*args, acc)
+            if r is None:
+                continue
+            if r == STOP:
+                return acc
+            if isinstance(r, tuple) and len(r) == 2:
+                action, acc = r
+                if action == STOP:
+                    return acc
+            # any other value: treat as new acc (convenience)
+            elif r != CONTINUE:
+                acc = r
+        return acc

@@ -1,0 +1,54 @@
+"""Unacked-message window, insertion-ordered.
+
+Analog of `emqx_inflight.erl` (gb_tree keyed by packet id): bounded window of
+QoS1/2 deliveries awaiting PUBACK/PUBREC/PUBCOMP; iteration order is insertion
+(= retry/replay order).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+
+@dataclass
+class InflightEntry:
+    phase: str  # 'wait_ack' (qos1), 'wait_rec' (qos2 publish), 'wait_comp' (pubrel sent)
+    message: Any = None
+    ts: float = field(default_factory=time.monotonic)
+    retries: int = 0
+
+
+class Inflight:
+    def __init__(self, max_size: int = 32):
+        self.max_size = max_size
+        self._d: Dict[int, InflightEntry] = {}  # python dict preserves order
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def is_full(self) -> bool:
+        return self.max_size > 0 and len(self._d) >= self.max_size
+
+    def contain(self, pid: int) -> bool:
+        return pid in self._d
+
+    def insert(self, pid: int, entry: InflightEntry) -> None:
+        if pid in self._d:
+            raise KeyError(f"packet id {pid} already inflight")
+        self._d[pid] = entry
+
+    def get(self, pid: int) -> Optional[InflightEntry]:
+        return self._d.get(pid)
+
+    def update(self, pid: int, entry: InflightEntry) -> None:
+        if pid not in self._d:
+            raise KeyError(pid)
+        self._d[pid] = entry  # keeps original position
+
+    def delete(self, pid: int) -> Optional[InflightEntry]:
+        return self._d.pop(pid, None)
+
+    def items(self) -> Iterator[Tuple[int, InflightEntry]]:
+        return iter(list(self._d.items()))

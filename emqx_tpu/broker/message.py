@@ -1,0 +1,52 @@
+"""Broker-internal message representation + GUID generation.
+
+Analog of the reference's `#message{}` record (`apps/emqx/include/emqx.hrl`)
+and `emqx_guid.erl` (time-ordered unique ids).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+_seq = itertools.count()
+_node_salt = os.urandom(6)
+
+
+def guid() -> bytes:
+    """16-byte time-ordered unique id (ts_us | node salt | seq)."""
+    ts = time.time_ns() // 1000
+    return ts.to_bytes(8, "big") + _node_salt + (next(_seq) & 0xFFFF).to_bytes(2, "big")
+
+
+def now_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+@dataclass
+class Message:
+    topic: str
+    payload: bytes = b""
+    qos: int = 0
+    retain: bool = False
+    dup: bool = False
+    from_client: str = ""
+    from_username: Optional[str] = None
+    mid: bytes = field(default_factory=guid)
+    timestamp: int = field(default_factory=now_ms)
+    properties: Dict = field(default_factory=dict)
+    headers: Dict[str, Any] = field(default_factory=dict)  # peername, proto, allow_publish...
+
+    def expired(self, now: Optional[int] = None) -> bool:
+        from .packet import Property
+
+        exp = self.properties.get(Property.MESSAGE_EXPIRY_INTERVAL)
+        if exp is None:
+            return False
+        return ((now or now_ms()) - self.timestamp) / 1000.0 >= exp
+
+    def is_sys(self) -> bool:
+        return self.topic.startswith("$SYS/")

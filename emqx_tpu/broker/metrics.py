@@ -1,0 +1,92 @@
+"""Broker metrics: named counters + gauges.
+
+Analog of `emqx_metrics.erl` (preallocated counters array,
+`apps/emqx/src/emqx_metrics.erl:78,216-268`) and `emqx_stats.erl` gauges.
+Python ints are atomic under the GIL, so a dict of counters plays the role
+of the `counters` array; the fixed name registry is kept for API parity and
+Prometheus export.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+# the reference's predefined metric names (subset; extended at runtime)
+PREDEFINED = [
+    "bytes.received",
+    "bytes.sent",
+    "packets.received",
+    "packets.sent",
+    "packets.connect.received",
+    "packets.connack.sent",
+    "packets.publish.received",
+    "packets.publish.sent",
+    "packets.puback.received",
+    "packets.puback.sent",
+    "packets.subscribe.received",
+    "packets.suback.sent",
+    "packets.unsubscribe.received",
+    "packets.unsuback.sent",
+    "packets.pingreq.received",
+    "packets.pingresp.sent",
+    "packets.disconnect.received",
+    "packets.disconnect.sent",
+    "packets.auth.received",
+    "packets.auth.sent",
+    "messages.received",
+    "messages.sent",
+    "messages.qos0.received",
+    "messages.qos1.received",
+    "messages.qos2.received",
+    "messages.delivered",
+    "messages.queued",
+    "messages.retained",
+    "messages.dropped",
+    "messages.dropped.no_subscribers",
+    "messages.dropped.await_pubrel_timeout",
+    "messages.acked",
+    "authentication.success",
+    "authentication.failure",
+    "authorization.allow",
+    "authorization.deny",
+    "session.created",
+    "session.resumed",
+    "session.takenover",
+    "session.discarded",
+    "session.terminated",
+    "client.connect",
+    "client.connack",
+    "client.connected",
+    "client.disconnected",
+    "client.subscribe",
+    "client.unsubscribe",
+]
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.counters: Dict[str, int] = {name: 0 for name in PREDEFINED}
+        self.gauges: Dict[str, float] = {}
+        self.created_at = time.time()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        return self.counters.get(name, 0)
+
+    def gauge_set(self, name: str, v: float) -> None:
+        self.gauges[name] = v
+
+    def gauge(self, name: str) -> float:
+        return self.gauges.get(name, 0.0)
+
+    def all(self) -> Dict[str, float]:
+        out: Dict[str, float] = dict(self.counters)
+        out.update(self.gauges)
+        return out
+
+    def reset(self) -> None:
+        for k in self.counters:
+            self.counters[k] = 0

@@ -1,0 +1,78 @@
+"""Bounded priority message queue with drop-oldest policy.
+
+Analog of `emqx_mqueue.erl`/`emqx_pqueue.erl` (SURVEY.md §2.1): buffers
+messages for offline sessions or when the inflight window is full; per-topic
+priorities; optional QoS0 buffering; drop-oldest within the lowest occupied
+priority when full.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+from .message import Message
+
+
+class MQueue:
+    def __init__(
+        self,
+        max_len: int = 1000,
+        store_qos0: bool = True,
+        priorities: Optional[Dict[str, int]] = None,
+        default_priority: int = 0,
+    ):
+        self.max_len = max_len
+        self.store_qos0 = store_qos0
+        self.priorities = priorities or {}
+        self.default_priority = default_priority
+        self._qs: Dict[int, deque] = {}
+        self._len = 0
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    def _prio(self, m: Message) -> int:
+        return self.priorities.get(m.topic, self.default_priority)
+
+    def insert(self, m: Message) -> Optional[Message]:
+        """Queue a message; returns a dropped message if any.
+
+        QoS0 messages are dropped immediately when store_qos0 is off.  When
+        full, the oldest message in the lowest occupied priority is dropped
+        (the new message itself if its priority is lowest).
+        """
+        if m.qos == 0 and not self.store_qos0:
+            self.dropped += 1
+            return m
+        dropped = None
+        if self.max_len > 0 and self._len >= self.max_len:
+            low = min(self._qs)
+            if self._prio(m) < low:
+                self.dropped += 1
+                return m
+            dropped = self._qs[low].popleft()
+            if not self._qs[low]:
+                del self._qs[low]
+            self._len -= 1
+            self.dropped += 1
+        self._qs.setdefault(self._prio(m), deque()).append(m)
+        self._len += 1
+        return dropped
+
+    def pop(self) -> Optional[Message]:
+        if not self._len:
+            return None
+        hi = max(self._qs)
+        m = self._qs[hi].popleft()
+        if not self._qs[hi]:
+            del self._qs[hi]
+        self._len -= 1
+        return m
+
+    def peek_all(self) -> List[Message]:
+        out: List[Message] = []
+        for p in sorted(self._qs, reverse=True):
+            out.extend(self._qs[p])
+        return out

@@ -1,0 +1,356 @@
+"""MQTT packet model: types, flags, v5 properties, reason codes.
+
+Dataclass equivalents of the reference's packet records
+(`apps/emqx/include/emqx_mqtt.hrl`, helpers `apps/emqx/src/emqx_packet.erl`,
+reason codes `emqx_reason_codes.erl`).  Wire codec lives in
+`emqx_tpu.broker.frame`.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+
+class PacketType(enum.IntEnum):
+    CONNECT = 1
+    CONNACK = 2
+    PUBLISH = 3
+    PUBACK = 4
+    PUBREC = 5
+    PUBREL = 6
+    PUBCOMP = 7
+    SUBSCRIBE = 8
+    SUBACK = 9
+    UNSUBSCRIBE = 10
+    UNSUBACK = 11
+    PINGREQ = 12
+    PINGRESP = 13
+    DISCONNECT = 14
+    AUTH = 15
+
+
+# protocol versions
+MQTT_V3 = 3  # MQIsdp 3.1
+MQTT_V4 = 4  # MQTT 3.1.1
+MQTT_V5 = 5  # MQTT 5.0
+
+PROTO_NAMES = {MQTT_V3: "MQIsdp", MQTT_V4: "MQTT", MQTT_V5: "MQTT"}
+
+QOS_0, QOS_1, QOS_2 = 0, 1, 2
+
+
+class ReasonCode(enum.IntEnum):
+    """MQTT v5 reason codes (subset used across packet types)."""
+
+    SUCCESS = 0x00
+    GRANTED_QOS_1 = 0x01
+    GRANTED_QOS_2 = 0x02
+    DISCONNECT_WITH_WILL = 0x04
+    NO_MATCHING_SUBSCRIBERS = 0x10
+    NO_SUBSCRIPTION_EXISTED = 0x11
+    CONTINUE_AUTHENTICATION = 0x18
+    REAUTHENTICATE = 0x19
+    UNSPECIFIED_ERROR = 0x80
+    MALFORMED_PACKET = 0x81
+    PROTOCOL_ERROR = 0x82
+    IMPLEMENTATION_SPECIFIC = 0x83
+    UNSUPPORTED_PROTOCOL_VERSION = 0x84
+    CLIENT_IDENTIFIER_NOT_VALID = 0x85
+    BAD_USERNAME_OR_PASSWORD = 0x86
+    NOT_AUTHORIZED = 0x87
+    SERVER_UNAVAILABLE = 0x88
+    SERVER_BUSY = 0x89
+    BANNED = 0x8A
+    SERVER_SHUTTING_DOWN = 0x8B
+    BAD_AUTHENTICATION_METHOD = 0x8C
+    KEEP_ALIVE_TIMEOUT = 0x8D
+    SESSION_TAKEN_OVER = 0x8E
+    TOPIC_FILTER_INVALID = 0x8F
+    TOPIC_NAME_INVALID = 0x90
+    PACKET_IDENTIFIER_IN_USE = 0x91
+    PACKET_IDENTIFIER_NOT_FOUND = 0x92
+    RECEIVE_MAXIMUM_EXCEEDED = 0x93
+    TOPIC_ALIAS_INVALID = 0x94
+    PACKET_TOO_LARGE = 0x95
+    MESSAGE_RATE_TOO_HIGH = 0x96
+    QUOTA_EXCEEDED = 0x97
+    ADMINISTRATIVE_ACTION = 0x98
+    PAYLOAD_FORMAT_INVALID = 0x99
+    RETAIN_NOT_SUPPORTED = 0x9A
+    QOS_NOT_SUPPORTED = 0x9B
+    USE_ANOTHER_SERVER = 0x9C
+    SERVER_MOVED = 0x9D
+    SHARED_SUBSCRIPTIONS_NOT_SUPPORTED = 0x9E
+    CONNECTION_RATE_EXCEEDED = 0x9F
+    MAXIMUM_CONNECT_TIME = 0xA0
+    SUBSCRIPTION_IDENTIFIERS_NOT_SUPPORTED = 0xA1
+    WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED = 0xA2
+
+
+# v3 CONNACK return codes (emqx_reason_codes:compat/2 analog)
+CONNACK_V3 = {
+    ReasonCode.SUCCESS: 0,
+    ReasonCode.UNSUPPORTED_PROTOCOL_VERSION: 1,
+    ReasonCode.CLIENT_IDENTIFIER_NOT_VALID: 2,
+    ReasonCode.SERVER_UNAVAILABLE: 3,
+    ReasonCode.BAD_USERNAME_OR_PASSWORD: 4,
+    ReasonCode.NOT_AUTHORIZED: 5,
+}
+
+
+def compat_connack_v3(rc: int) -> int:
+    """Map a v5 CONNACK reason code to a v3 return code."""
+    return CONNACK_V3.get(ReasonCode(rc) if rc in ReasonCode._value2member_map_ else rc, 3)
+
+
+# ---------------------------------------------------------------- properties
+
+class Property(enum.IntEnum):
+    PAYLOAD_FORMAT_INDICATOR = 0x01
+    MESSAGE_EXPIRY_INTERVAL = 0x02
+    CONTENT_TYPE = 0x03
+    RESPONSE_TOPIC = 0x08
+    CORRELATION_DATA = 0x09
+    SUBSCRIPTION_IDENTIFIER = 0x0B
+    SESSION_EXPIRY_INTERVAL = 0x11
+    ASSIGNED_CLIENT_IDENTIFIER = 0x12
+    SERVER_KEEP_ALIVE = 0x13
+    AUTHENTICATION_METHOD = 0x15
+    AUTHENTICATION_DATA = 0x16
+    REQUEST_PROBLEM_INFORMATION = 0x17
+    WILL_DELAY_INTERVAL = 0x18
+    REQUEST_RESPONSE_INFORMATION = 0x19
+    RESPONSE_INFORMATION = 0x1A
+    SERVER_REFERENCE = 0x1C
+    REASON_STRING = 0x1F
+    RECEIVE_MAXIMUM = 0x21
+    TOPIC_ALIAS_MAXIMUM = 0x22
+    TOPIC_ALIAS = 0x23
+    MAXIMUM_QOS = 0x24
+    RETAIN_AVAILABLE = 0x25
+    USER_PROPERTY = 0x26
+    MAXIMUM_PACKET_SIZE = 0x27
+    WILDCARD_SUBSCRIPTION_AVAILABLE = 0x28
+    SUBSCRIPTION_IDENTIFIER_AVAILABLE = 0x29
+    SHARED_SUBSCRIPTION_AVAILABLE = 0x2A
+
+
+# wire type of each property: byte|u16|u32|varint|utf8|bin|utf8pair
+PROPERTY_TYPES: Dict[int, str] = {
+    Property.PAYLOAD_FORMAT_INDICATOR: "byte",
+    Property.MESSAGE_EXPIRY_INTERVAL: "u32",
+    Property.CONTENT_TYPE: "utf8",
+    Property.RESPONSE_TOPIC: "utf8",
+    Property.CORRELATION_DATA: "bin",
+    Property.SUBSCRIPTION_IDENTIFIER: "varint",
+    Property.SESSION_EXPIRY_INTERVAL: "u32",
+    Property.ASSIGNED_CLIENT_IDENTIFIER: "utf8",
+    Property.SERVER_KEEP_ALIVE: "u16",
+    Property.AUTHENTICATION_METHOD: "utf8",
+    Property.AUTHENTICATION_DATA: "bin",
+    Property.REQUEST_PROBLEM_INFORMATION: "byte",
+    Property.WILL_DELAY_INTERVAL: "u32",
+    Property.REQUEST_RESPONSE_INFORMATION: "byte",
+    Property.RESPONSE_INFORMATION: "utf8",
+    Property.SERVER_REFERENCE: "utf8",
+    Property.REASON_STRING: "utf8",
+    Property.RECEIVE_MAXIMUM: "u16",
+    Property.TOPIC_ALIAS_MAXIMUM: "u16",
+    Property.TOPIC_ALIAS: "u16",
+    Property.MAXIMUM_QOS: "byte",
+    Property.RETAIN_AVAILABLE: "byte",
+    Property.USER_PROPERTY: "utf8pair",
+    Property.MAXIMUM_PACKET_SIZE: "u32",
+    Property.WILDCARD_SUBSCRIPTION_AVAILABLE: "byte",
+    Property.SUBSCRIPTION_IDENTIFIER_AVAILABLE: "byte",
+    Property.SHARED_SUBSCRIPTION_AVAILABLE: "byte",
+}
+
+# Properties: dict {Property: value}; USER_PROPERTY maps to list[(k, v)];
+# SUBSCRIPTION_IDENTIFIER may appear multiple times -> list[int].
+Properties = Dict[int, Union[int, str, bytes, List]]
+
+
+# ------------------------------------------------------------------ packets
+
+@dataclass
+class SubOpts:
+    """Subscription options (v5 3.8.3.1; v3 carries only qos).
+
+    `sub_id` is the v5 Subscription Identifier granted at subscribe time —
+    session state, not part of the wire byte.
+    """
+
+    qos: int = 0
+    no_local: bool = False
+    retain_as_published: bool = False
+    retain_handling: int = 0
+    sub_id: Optional[int] = None
+
+    def to_byte(self) -> int:
+        return (
+            (self.qos & 0x3)
+            | (int(self.no_local) << 2)
+            | (int(self.retain_as_published) << 3)
+            | ((self.retain_handling & 0x3) << 4)
+        )
+
+    @staticmethod
+    def from_byte(b: int) -> "SubOpts":
+        return SubOpts(
+            qos=b & 0x3,
+            no_local=bool(b >> 2 & 1),
+            retain_as_published=bool(b >> 3 & 1),
+            retain_handling=b >> 4 & 0x3,
+        )
+
+
+@dataclass
+class Connect:
+    proto_name: str = "MQTT"
+    proto_ver: int = MQTT_V4
+    clean_start: bool = True
+    keepalive: int = 60
+    clientid: str = ""
+    username: Optional[str] = None
+    password: Optional[bytes] = None
+    will_flag: bool = False
+    will_qos: int = 0
+    will_retain: bool = False
+    will_topic: Optional[str] = None
+    will_payload: Optional[bytes] = None
+    will_props: Properties = field(default_factory=dict)
+    properties: Properties = field(default_factory=dict)
+
+    type: PacketType = PacketType.CONNECT
+
+
+@dataclass
+class Connack:
+    session_present: bool = False
+    reason_code: int = 0
+    properties: Properties = field(default_factory=dict)
+
+    type: PacketType = PacketType.CONNACK
+
+
+@dataclass
+class Publish:
+    topic: str = ""
+    payload: bytes = b""
+    qos: int = 0
+    retain: bool = False
+    dup: bool = False
+    packet_id: Optional[int] = None
+    properties: Properties = field(default_factory=dict)
+
+    type: PacketType = PacketType.PUBLISH
+
+
+@dataclass
+class PubAck:
+    packet_id: int = 0
+    reason_code: int = 0
+    properties: Properties = field(default_factory=dict)
+    type: PacketType = PacketType.PUBACK
+
+
+@dataclass
+class PubRec:
+    packet_id: int = 0
+    reason_code: int = 0
+    properties: Properties = field(default_factory=dict)
+    type: PacketType = PacketType.PUBREC
+
+
+@dataclass
+class PubRel:
+    packet_id: int = 0
+    reason_code: int = 0
+    properties: Properties = field(default_factory=dict)
+    type: PacketType = PacketType.PUBREL
+
+
+@dataclass
+class PubComp:
+    packet_id: int = 0
+    reason_code: int = 0
+    properties: Properties = field(default_factory=dict)
+    type: PacketType = PacketType.PUBCOMP
+
+
+@dataclass
+class Subscribe:
+    packet_id: int = 0
+    topic_filters: List[Tuple[str, SubOpts]] = field(default_factory=list)
+    properties: Properties = field(default_factory=dict)
+    type: PacketType = PacketType.SUBSCRIBE
+
+
+@dataclass
+class SubAck:
+    packet_id: int = 0
+    reason_codes: List[int] = field(default_factory=list)
+    properties: Properties = field(default_factory=dict)
+    type: PacketType = PacketType.SUBACK
+
+
+@dataclass
+class Unsubscribe:
+    packet_id: int = 0
+    topic_filters: List[str] = field(default_factory=list)
+    properties: Properties = field(default_factory=dict)
+    type: PacketType = PacketType.UNSUBSCRIBE
+
+
+@dataclass
+class UnsubAck:
+    packet_id: int = 0
+    reason_codes: List[int] = field(default_factory=list)
+    properties: Properties = field(default_factory=dict)
+    type: PacketType = PacketType.UNSUBACK
+
+
+@dataclass
+class PingReq:
+    type: PacketType = PacketType.PINGREQ
+
+
+@dataclass
+class PingResp:
+    type: PacketType = PacketType.PINGRESP
+
+
+@dataclass
+class Disconnect:
+    reason_code: int = 0
+    properties: Properties = field(default_factory=dict)
+    type: PacketType = PacketType.DISCONNECT
+
+
+@dataclass
+class Auth:
+    reason_code: int = 0
+    properties: Properties = field(default_factory=dict)
+    type: PacketType = PacketType.AUTH
+
+
+Packet = Union[
+    Connect,
+    Connack,
+    Publish,
+    PubAck,
+    PubRec,
+    PubRel,
+    PubComp,
+    Subscribe,
+    SubAck,
+    Unsubscribe,
+    UnsubAck,
+    PingReq,
+    PingResp,
+    Disconnect,
+    Auth,
+]

@@ -1,0 +1,143 @@
+"""Retained-message store with wildcard lookup on subscribe.
+
+Analog of `apps/emqx_retainer` (`emqx_retainer.erl:85-150`,
+`emqx_retainer_mnesia.erl`): PUBLISH with retain=true stores the message
+(empty payload deletes); on SUBSCRIBE the filter is matched against stored
+topic names and matching messages are re-delivered, honoring the v5
+retain-handling subscription option.
+
+The lookup direction is the reverse of the publish hot path (wildcard filter
+vs concrete stored names), so it uses a host-side topic-name trie rather
+than the device tables; retained populations are small relative to
+subscription populations and mutate rarely.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import topic as topiclib
+from .message import Message
+
+
+class _Node:
+    __slots__ = ("children", "msg")
+
+    def __init__(self):
+        self.children: Dict[str, _Node] = {}
+        self.msg: Optional[Message] = None
+
+
+class Retainer:
+    def __init__(self, max_retained: int = 0, max_payload: int = 0, enable: bool = True):
+        self.root = _Node()
+        self.count = 0
+        self.max_retained = max_retained  # 0 = unlimited
+        self.max_payload = max_payload
+        self.enable = enable
+
+    # ------------------------------------------------------------- store
+
+    def on_publish(self, msg: Message) -> None:
+        if not self.enable or not msg.retain:
+            return
+        if not msg.payload:
+            self.delete(msg.topic)
+            return
+        if self.max_payload and len(msg.payload) > self.max_payload:
+            return
+        if self.max_retained and self.count >= self.max_retained and self.get(msg.topic) is None:
+            return
+        self._insert(msg)
+
+    def _insert(self, msg: Message) -> None:
+        node = self.root
+        for w in topiclib.words(msg.topic):
+            node = node.children.setdefault(w, _Node())
+        if node.msg is None:
+            self.count += 1
+        node.msg = msg
+
+    def get(self, topic: str) -> Optional[Message]:
+        node = self.root
+        for w in topiclib.words(topic):
+            node = node.children.get(w)
+            if node is None:
+                return None
+        return node.msg
+
+    def delete(self, topic: str) -> bool:
+        ws = topiclib.words(topic)
+        path = [self.root]
+        node = self.root
+        for w in ws:
+            node = node.children.get(w)
+            if node is None:
+                return False
+            path.append(node)
+        if node.msg is None:
+            return False
+        node.msg = None
+        self.count -= 1
+        for i in range(len(ws) - 1, -1, -1):
+            child = path[i + 1]
+            if child.msg is not None or child.children:
+                break
+            del path[i].children[ws[i]]
+        return True
+
+    # ------------------------------------------------------------ lookup
+
+    def match_filter(self, filt: str) -> List[Message]:
+        """All retained messages whose topic matches the filter."""
+        fw = topiclib.words(filt)
+        out: List[Message] = []
+
+        def walk(node: _Node, i: int, root: bool) -> None:
+            if i == len(fw):
+                if node.msg is not None:
+                    out.append(node.msg)
+                return
+            w = fw[i]
+            if w == "#":
+                # matches zero or more levels (but not $-roots from a root #)
+                def subtree(n: _Node, at_root: bool) -> None:
+                    if n.msg is not None:
+                        out.append(n.msg)
+                    for name, c in n.children.items():
+                        if at_root and root and name.startswith("$"):
+                            continue
+                        subtree(c, False)
+
+                subtree(node, True)
+                return
+            if w == "+":
+                for name, c in node.children.items():
+                    if root and name.startswith("$"):
+                        continue
+                    walk(c, i + 1, False)
+            else:
+                c = node.children.get(w)
+                if c is not None:
+                    walk(c, i + 1, False)
+
+        walk(self.root, 0, True)
+        out = [m for m in out if not m.expired()]
+        return out
+
+    def clean_expired(self) -> int:
+        """GC expired retained messages; returns count removed."""
+        removed = 0
+
+        def collect(node: _Node, prefix: List[str]) -> List[str]:
+            topics = []
+            if node.msg is not None and node.msg.expired():
+                topics.append("/".join(prefix))
+            for name, c in list(node.children.items()):
+                topics.extend(collect(c, prefix + [name]))
+            return topics
+
+        for t in collect(self.root, []):
+            if self.delete(t):
+                removed += 1
+        return removed

@@ -1,0 +1,257 @@
+"""MQTT session: subscriptions, QoS 0/1/2 delivery state machine.
+
+Analog of `emqx_session.erl` (SURVEY.md §2.1): inflight window for unacked
+QoS1/2 deliveries, bounded mqueue for overflow/offline buffering,
+awaiting_rel for inbound QoS2 exactly-once, packet-id allocation, retry and
+resume replay.  Pure data structure — no I/O, no clocks of its own (callers
+pass `now` where relevant), so it is trivially testable and serializable
+(checkpoint/resume, takeover).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .inflight import Inflight, InflightEntry
+from .message import Message
+from .mqueue import MQueue
+from .packet import Property, Publish, ReasonCode, SubOpts
+
+
+class SessionError(Exception):
+    def __init__(self, reason_code: int, msg: str = ""):
+        super().__init__(msg or hex(reason_code))
+        self.reason_code = reason_code
+
+
+@dataclass
+class Delivery:
+    """An outbound publish decided by the session (wire-ready fields)."""
+
+    packet_id: Optional[int]
+    message: Message
+    qos: int
+    dup: bool = False
+    retain: bool = False
+    sub_ids: List[int] = field(default_factory=list)
+
+
+class Session:
+    def __init__(
+        self,
+        clientid: str,
+        clean_start: bool = True,
+        expiry_interval: int = 0,  # seconds; 0 = ends with connection
+        max_inflight: int = 32,
+        max_mqueue: int = 1000,
+        store_qos0: bool = True,
+        upgrade_qos: bool = False,
+        retry_interval: float = 30.0,
+        max_awaiting_rel: int = 100,
+        await_rel_timeout: float = 300.0,
+        created_at: Optional[float] = None,
+    ):
+        self.clientid = clientid
+        self.clean_start = clean_start
+        self.expiry_interval = expiry_interval
+        self.upgrade_qos = upgrade_qos
+        self.retry_interval = retry_interval
+        self.max_awaiting_rel = max_awaiting_rel
+        self.await_rel_timeout = await_rel_timeout
+        self.created_at = created_at if created_at is not None else time.time()
+
+        self.subscriptions: Dict[str, SubOpts] = {}
+        self.inflight = Inflight(max_inflight)
+        self.mqueue = MQueue(max_len=max_mqueue, store_qos0=store_qos0)
+        self.awaiting_rel: Dict[int, float] = {}  # inbound qos2 packet ids
+        self._next_pid = 1
+
+    # ------------------------------------------------------ subscriptions
+
+    def subscribe(self, filt: str, opts: SubOpts) -> bool:
+        """Returns True if this is a new subscription (vs an update)."""
+        is_new = filt not in self.subscriptions
+        self.subscriptions[filt] = opts
+        return is_new
+
+    def unsubscribe(self, filt: str) -> Optional[SubOpts]:
+        return self.subscriptions.pop(filt, None)
+
+    # ------------------------------------------------- inbound QoS2 dedup
+
+    def publish_qos2(self, packet_id: int) -> None:
+        """Register an inbound QoS2 publish awaiting PUBREL."""
+        if packet_id in self.awaiting_rel:
+            raise SessionError(ReasonCode.PACKET_IDENTIFIER_IN_USE)
+        if 0 < self.max_awaiting_rel <= len(self.awaiting_rel):
+            raise SessionError(ReasonCode.RECEIVE_MAXIMUM_EXCEEDED)
+        self.awaiting_rel[packet_id] = time.monotonic()
+
+    def pubrel(self, packet_id: int) -> bool:
+        return self.awaiting_rel.pop(packet_id, None) is not None
+
+    def expire_awaiting_rel(self, now: Optional[float] = None) -> List[int]:
+        now = now if now is not None else time.monotonic()
+        dead = [
+            pid
+            for pid, ts in self.awaiting_rel.items()
+            if now - ts >= self.await_rel_timeout
+        ]
+        for pid in dead:
+            del self.awaiting_rel[pid]
+        return dead
+
+    # ------------------------------------------------------ outbound path
+
+    def _alloc_pid(self) -> int:
+        for _ in range(65535):
+            pid = self._next_pid
+            self._next_pid = pid % 65535 + 1
+            if not self.inflight.contain(pid):
+                return pid
+        raise SessionError(ReasonCode.QUOTA_EXCEEDED, "no free packet id")
+
+    def _effective_qos(self, msg: Message, opts: SubOpts) -> int:
+        if self.upgrade_qos:
+            return max(msg.qos, opts.qos)
+        return min(msg.qos, opts.qos)
+
+    def deliver(
+        self, delivers: List[Tuple[str, Message]]
+    ) -> List[Delivery]:
+        """Route matched messages through QoS logic.
+
+        `delivers` pairs the matched subscription filter with the message
+        (mirrors the reference's `{deliver, Topic, Msg}`,
+        `emqx_session:deliver` `apps/emqx/src/emqx_session.erl:485`).
+        Returns wire-ready deliveries; overflow goes to the mqueue.
+        """
+        out: List[Delivery] = []
+        for filt, msg in delivers:
+            opts = self.subscriptions.get(filt)
+            if opts is None:
+                # $queue/$share deliveries pass the real filter; direct
+                # matches always exist. Unknown filter -> best effort qos0.
+                opts = SubOpts(qos=0)
+            if opts.no_local and msg.from_client == self.clientid:
+                continue
+            qos = self._effective_qos(msg, opts)
+            retain = msg.retain if (opts.retain_as_published or msg.headers.get("retained")) else False
+            sub_ids = [opts.sub_id] if opts.sub_id is not None else []
+            if qos == 0:
+                out.append(Delivery(None, msg, 0, retain=retain, sub_ids=sub_ids))
+            elif self.inflight.is_full():
+                self.mqueue.insert(self._with_qos(msg, qos))
+            else:
+                pid = self._alloc_pid()
+                phase = "wait_ack" if qos == 1 else "wait_rec"
+                self.inflight.insert(
+                    pid, InflightEntry(phase=phase, message=self._with_qos(msg, qos))
+                )
+                out.append(Delivery(pid, msg, qos, retain=retain, sub_ids=sub_ids))
+        return out
+
+    @staticmethod
+    def _with_qos(msg: Message, qos: int) -> Message:
+        if msg.qos == qos:
+            return msg
+        from dataclasses import replace
+
+        return replace(msg, qos=qos)
+
+    def enqueue(self, msg: Message) -> Optional[Message]:
+        return self.mqueue.insert(msg)
+
+    # acks ----------------------------------------------------------------
+
+    def puback(self, packet_id: int) -> Tuple[Optional[Message], List[Delivery]]:
+        e = self.inflight.get(packet_id)
+        if e is None or e.phase != "wait_ack":
+            raise SessionError(ReasonCode.PACKET_IDENTIFIER_NOT_FOUND)
+        self.inflight.delete(packet_id)
+        return e.message, self.dequeue()
+
+    def pubrec(self, packet_id: int) -> Optional[Message]:
+        e = self.inflight.get(packet_id)
+        if e is None:
+            raise SessionError(ReasonCode.PACKET_IDENTIFIER_NOT_FOUND)
+        if e.phase == "wait_comp":
+            raise SessionError(ReasonCode.PACKET_IDENTIFIER_IN_USE)
+        msg = e.message
+        self.inflight.update(
+            packet_id, InflightEntry(phase="wait_comp", message=None, ts=e.ts)
+        )
+        return msg
+
+    def pubcomp(self, packet_id: int) -> List[Delivery]:
+        e = self.inflight.get(packet_id)
+        if e is None or e.phase != "wait_comp":
+            raise SessionError(ReasonCode.PACKET_IDENTIFIER_NOT_FOUND)
+        self.inflight.delete(packet_id)
+        return self.dequeue()
+
+    def dequeue(self) -> List[Delivery]:
+        """Move queued messages into the freed inflight window."""
+        out: List[Delivery] = []
+        while not self.inflight.is_full():
+            msg = self.mqueue.pop()
+            if msg is None:
+                break
+            if msg.expired():
+                continue
+            if msg.qos == 0:
+                out.append(Delivery(None, msg, 0))
+            else:
+                pid = self._alloc_pid()
+                phase = "wait_ack" if msg.qos == 1 else "wait_rec"
+                self.inflight.insert(pid, InflightEntry(phase=phase, message=msg))
+                out.append(Delivery(pid, msg, msg.qos))
+        return out
+
+    # retry / replay ------------------------------------------------------
+
+    def retry(self, now: Optional[float] = None) -> List[Delivery]:
+        """Re-deliver unacked inflight entries past the retry interval."""
+        if self.retry_interval <= 0:
+            return []
+        now = now if now is not None else time.monotonic()
+        out: List[Delivery] = []
+        for pid, e in self.inflight.items():
+            if now - e.ts < self.retry_interval:
+                continue
+            e.ts = now
+            e.retries += 1
+            if e.phase == "wait_comp":
+                out.append(Delivery(pid, None, 2, dup=False))  # resend PUBREL
+            elif e.message is not None and e.message.expired():
+                self.inflight.delete(pid)
+            else:
+                out.append(Delivery(pid, e.message, e.message.qos, dup=True))
+        return out
+
+    def replay(self) -> List[Delivery]:
+        """On resume: re-send all pending inflight (dup) then drain queue."""
+        out: List[Delivery] = []
+        for pid, e in self.inflight.items():
+            if e.phase == "wait_comp":
+                out.append(Delivery(pid, None, 2))
+            elif e.message is not None:
+                out.append(Delivery(pid, e.message, e.message.qos, dup=True))
+        out.extend(self.dequeue())
+        return out
+
+    # info ----------------------------------------------------------------
+
+    def info(self) -> Dict:
+        return {
+            "clientid": self.clientid,
+            "clean_start": self.clean_start,
+            "subscriptions_cnt": len(self.subscriptions),
+            "inflight_cnt": len(self.inflight),
+            "mqueue_len": len(self.mqueue),
+            "mqueue_dropped": self.mqueue.dropped,
+            "awaiting_rel_cnt": len(self.awaiting_rel),
+            "created_at": self.created_at,
+        }

@@ -1,0 +1,85 @@
+"""Shared-subscription group dispatch.
+
+Analog of `emqx_shared_sub.erl` (SURVEY.md §1.7): `$share/<group>/<filter>`
+(and `$queue/<filter>`) subscribers form a group; each matched publish is
+delivered to ONE member, picked by a configurable strategy
+(`emqx_shared_sub.erl:61-66,234-288`).  Strategy state (round-robin cursors,
+sticky picks) is host-side by design — the device returns candidate sets
+only (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+STRATEGIES = ("random", "round_robin", "sticky", "hash_clientid", "hash_topic")
+
+
+class SharedSub:
+    def __init__(self, strategy: str = "random", seed: Optional[int] = None):
+        assert strategy in STRATEGIES, strategy
+        self.strategy = strategy
+        self._rng = random.Random(seed)
+        # (group, filter) -> ordered member clientids
+        self._groups: Dict[Tuple[str, str], List[str]] = {}
+        self._rr: Dict[Tuple[str, str], int] = {}
+        self._sticky: Dict[Tuple[str, str], str] = {}
+
+    def subscribe(self, group: str, filt: str, clientid: str) -> bool:
+        """Returns True if this (group, filter) is new (needs a route)."""
+        key = (group, filt)
+        members = self._groups.setdefault(key, [])
+        if clientid not in members:
+            members.append(clientid)
+        return len(members) == 1
+
+    def unsubscribe(self, group: str, filt: str, clientid: str) -> bool:
+        """Returns True if the group became empty (route removal)."""
+        key = (group, filt)
+        members = self._groups.get(key)
+        if not members:
+            return False
+        if clientid in members:
+            members.remove(clientid)
+        if self._sticky.get(key) == clientid:
+            del self._sticky[key]
+        if not members:
+            self._groups.pop(key, None)
+            self._rr.pop(key, None)
+            return True
+        return False
+
+    def drop_member(self, clientid: str) -> None:
+        """Remove a dead subscriber from every group (nodedown/kick analog)."""
+        for key in list(self._groups):
+            self.unsubscribe(key[0], key[1], clientid)
+
+    def groups_for(self, filt: str) -> List[Tuple[str, str]]:
+        return [k for k in self._groups if k[1] == filt]
+
+    def pick(
+        self, group: str, filt: str, topic: str, from_client: str
+    ) -> Optional[str]:
+        """Pick the receiving member for one publish (None if group empty)."""
+        key = (group, filt)
+        members = self._groups.get(key)
+        if not members:
+            return None
+        s = self.strategy
+        if s == "random":
+            return self._rng.choice(members)
+        if s == "round_robin":
+            i = self._rr.get(key, 0) % len(members)
+            self._rr[key] = i + 1
+            return members[i]
+        if s == "sticky":
+            cur = self._sticky.get(key)
+            if cur in members:
+                return cur
+            cur = self._rng.choice(members)
+            self._sticky[key] = cur
+            return cur
+        if s == "hash_clientid":
+            return members[hash(from_client) % len(members)]
+        return members[hash(topic) % len(members)]  # hash_topic

@@ -123,6 +123,27 @@ def feed_var(var: str, value: str, topic: str) -> str:
     return join([value if w == var else w for w in words(topic)])
 
 
+def join_share(group: Optional[str], real: str) -> str:
+    """Inverse of :func:`parse_share`."""
+    if group is None:
+        return real
+    if group == QUEUE_PREFIX:
+        return f"{QUEUE_PREFIX}/{real}"
+    return f"{SHARE_PREFIX}/{group}/{real}"
+
+
+def mount_filter(mountpoint: Optional[str], filt: str) -> str:
+    """Prepend the mountpoint to the *real* filter inside any $share prefix.
+
+    `$share/g/t` with mountpoint `mp/` -> `$share/g/mp/t` (the reference
+    mounts the inner topic, not the share wrapper — emqx_mountpoint.erl).
+    """
+    if not mountpoint:
+        return filt
+    group, real = parse_share(filt)
+    return join_share(group, mountpoint + real)
+
+
 def prepend_mountpoint(mountpoint: Optional[str], topic: str) -> str:
     if not mountpoint:
         return topic

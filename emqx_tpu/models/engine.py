@@ -24,13 +24,15 @@ import numpy as np
 
 from ..broker import topic as topiclib
 from ..ops import hashing
-from ..ops.match import DeviceTables, TopicBatch, apply_delta, match_batch_jit
+from ..ops.match import (
+    DeviceTables,
+    TopicBatch,
+    apply_delta,
+    match_batch_jit,
+    next_pow2 as _next_pow2,
+)
 from ..ops.tables import MatchTables
 from .reference import CpuTrieIndex
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1).bit_length())
 
 
 class TopicMatchEngine:

@@ -199,6 +199,7 @@ class ShardedMatchEngine:
         self._fids: Dict[str, int] = {}
         self._refs: Dict[int, int] = {}
         self._next_fid = 0
+        self._free_fids: List[int] = []
         self._dest_cap = 1024
         self._dest = np.zeros(self._dest_cap, dtype=np.int32)
         self._dest_dirty = True
@@ -216,7 +217,7 @@ class ShardedMatchEngine:
         if fid is not None:
             self._refs[fid] += 1
             return fid
-        fid = self._next_fid
+        fid = self._free_fids[-1] if self._free_fids else self._next_fid
         ws = topiclib.words(filt)
         if self.space.shape_of(ws).plen > self.space.max_levels:
             self._deep.insert(filt, fid)
@@ -224,7 +225,10 @@ class ShardedMatchEngine:
         else:
             self.shards[fid % self.D].insert(ws, fid)
         # registry updated only after a successful insert
-        self._next_fid += 1
+        if self._free_fids:
+            self._free_fids.pop()
+        else:
+            self._next_fid += 1
         self._fids[filt] = fid
         self._refs[fid] = 1
         if fid >= self._dest_cap:
@@ -250,6 +254,7 @@ class ShardedMatchEngine:
             self._deep.delete(filt, fid)
         else:
             self.shards[fid % self.D].delete(fid)
+        self._free_fids.append(fid)
         return fid
 
     @property

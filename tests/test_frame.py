@@ -1,0 +1,266 @@
+"""Codec tests: golden packets + randomized round-trip property tests.
+
+The round-trip property mirrors the reference's `prop_emqx_frame` PropEr
+suite: serialize(parse(x)) == x for all generated packets, across protocol
+versions, plus incremental-feed reassembly.
+"""
+
+import random
+
+import pytest
+
+from emqx_tpu.broker import frame, packet as pkt
+from emqx_tpu.broker.frame import FrameError, Parser, serialize
+from emqx_tpu.broker.packet import MQTT_V3, MQTT_V4, MQTT_V5, Property, SubOpts
+
+
+def roundtrip(p, version):
+    data = serialize(p, version)
+    parser = Parser(version=version)
+    out = parser.feed(data)
+    assert len(out) == 1, (p, out)
+    assert not parser._buf
+    return out[0]
+
+
+def test_connect_roundtrip_v4():
+    c = pkt.Connect(
+        proto_ver=MQTT_V4,
+        clientid="client-1",
+        keepalive=30,
+        clean_start=True,
+        username="u",
+        password=b"pw",
+        will_flag=True,
+        will_qos=1,
+        will_retain=True,
+        will_topic="will/t",
+        will_payload=b"gone",
+    )
+    got = roundtrip(c, MQTT_V4)
+    assert got == c
+
+
+def test_connect_roundtrip_v5_props():
+    c = pkt.Connect(
+        proto_ver=MQTT_V5,
+        clientid="c5",
+        properties={
+            Property.SESSION_EXPIRY_INTERVAL: 3600,
+            Property.RECEIVE_MAXIMUM: 20,
+            Property.USER_PROPERTY: [("a", "b"), ("a", "c")],
+        },
+        will_flag=True,
+        will_topic="w",
+        will_payload=b"",
+        will_props={Property.WILL_DELAY_INTERVAL: 5},
+    )
+    got = roundtrip(c, MQTT_V5)
+    assert got == c
+
+
+def test_connect_v3():
+    c = pkt.Connect(proto_name="MQIsdp", proto_ver=MQTT_V3, clientid="old")
+    got = roundtrip(c, MQTT_V3)
+    assert got.proto_ver == MQTT_V3 and got.clientid == "old"
+
+
+def test_connect_bad_proto():
+    c = serialize(pkt.Connect(proto_ver=MQTT_V4, clientid="x"), MQTT_V4)
+    bad = c.replace(b"MQTT", b"MQTX")
+    with pytest.raises(FrameError):
+        Parser().feed(bad)
+
+
+def test_publish_roundtrip():
+    for ver in (MQTT_V4, MQTT_V5):
+        p = pkt.Publish(topic="a/b", payload=b"\x00\x01data", qos=1, packet_id=77, retain=True)
+        if ver == MQTT_V5:
+            p.properties = {Property.TOPIC_ALIAS: 3, Property.MESSAGE_EXPIRY_INTERVAL: 60}
+        assert roundtrip(p, ver) == p
+
+
+def test_publish_qos0_no_pid():
+    p = pkt.Publish(topic="t", payload=b"x", qos=0)
+    got = roundtrip(p, MQTT_V4)
+    assert got.packet_id is None
+
+
+def test_puback_v5_reason():
+    p = pkt.PubAck(packet_id=5, reason_code=0x10)
+    got = roundtrip(p, MQTT_V5)
+    assert got == p
+    # v4: reason code not on the wire
+    got4 = roundtrip(pkt.PubAck(packet_id=5), MQTT_V4)
+    assert got4.packet_id == 5 and got4.reason_code == 0
+
+
+def test_subscribe_roundtrip():
+    s = pkt.Subscribe(
+        packet_id=9,
+        topic_filters=[
+            ("a/+", SubOpts(qos=1)),
+            ("b/#", SubOpts(qos=2, no_local=True, retain_as_published=True, retain_handling=2)),
+        ],
+        properties={Property.SUBSCRIPTION_IDENTIFIER: [42]},
+    )
+    assert roundtrip(s, MQTT_V5) == s
+    s4 = pkt.Subscribe(packet_id=9, topic_filters=[("a/+", SubOpts(qos=1))])
+    assert roundtrip(s4, MQTT_V4) == s4
+
+
+def test_suback_unsub_roundtrip():
+    assert roundtrip(pkt.SubAck(packet_id=3, reason_codes=[0, 1, 0x80]), MQTT_V4).reason_codes == [0, 1, 0x80]
+    u = pkt.Unsubscribe(packet_id=4, topic_filters=["x", "y/#"])
+    assert roundtrip(u, MQTT_V5) == u
+    ua = pkt.UnsubAck(packet_id=4, reason_codes=[0, 0x11])
+    assert roundtrip(ua, MQTT_V5) == ua
+
+
+def test_ping_disconnect_auth():
+    assert isinstance(roundtrip(pkt.PingReq(), MQTT_V4), pkt.PingReq)
+    assert isinstance(roundtrip(pkt.PingResp(), MQTT_V4), pkt.PingResp)
+    assert roundtrip(pkt.Disconnect(), MQTT_V4) == pkt.Disconnect()
+    d = pkt.Disconnect(reason_code=0x8E, properties={Property.REASON_STRING: "taken"})
+    assert roundtrip(d, MQTT_V5) == d
+    a = pkt.Auth(reason_code=0x18, properties={Property.AUTHENTICATION_METHOD: "SCRAM"})
+    assert roundtrip(a, MQTT_V5) == a
+
+
+def test_incremental_feed():
+    """Packets split at every possible byte boundary must reassemble."""
+    p = pkt.Publish(topic="t/x", payload=b"payload", qos=1, packet_id=2)
+    data = serialize(p, MQTT_V4) * 3
+    for cut in range(1, len(data)):
+        parser = Parser(version=MQTT_V4)
+        got = parser.feed(data[:cut]) + parser.feed(data[cut:])
+        assert len(got) == 3
+        assert all(g == p for g in got)
+
+
+def test_max_size():
+    parser = Parser(version=MQTT_V4, max_size=64)
+    big = pkt.Publish(topic="t", payload=b"x" * 100, qos=0)
+    with pytest.raises(FrameError) as ei:
+        parser.feed(serialize(big, MQTT_V4))
+    assert ei.value.reason_code == pkt.ReasonCode.PACKET_TOO_LARGE
+
+
+def test_bad_flags_strict():
+    data = bytearray(serialize(pkt.PingReq(), MQTT_V4))
+    data[0] |= 0x05  # set reserved flag bits
+    with pytest.raises(FrameError):
+        Parser(version=MQTT_V4).feed(bytes(data))
+
+
+def test_version_latch_from_connect():
+    parser = Parser()
+    parser.feed(serialize(pkt.Connect(proto_ver=MQTT_V5, clientid="v5c"), MQTT_V5))
+    assert parser.version == MQTT_V5
+    # subsequent packets parsed as v5
+    p = pkt.Publish(topic="a", payload=b"", qos=1, packet_id=1,
+                    properties={Property.PAYLOAD_FORMAT_INDICATOR: 1})
+    assert parser.feed(serialize(p, MQTT_V5)) == [p]
+
+
+# ------------------------- randomized property test -------------------------
+
+def _rand_str(rng, n=8):
+    return "".join(rng.choice("abcXYZ019/+#$-_.~é漢") for _ in range(rng.randint(0, n)))
+
+
+def _rand_props(rng, will=False):
+    pool = [
+        (Property.PAYLOAD_FORMAT_INDICATOR, lambda: rng.randint(0, 1)),
+        (Property.MESSAGE_EXPIRY_INTERVAL, lambda: rng.randint(0, 2**32 - 1)),
+        (Property.CONTENT_TYPE, lambda: _rand_str(rng)),
+        (Property.RESPONSE_TOPIC, lambda: _rand_str(rng)),
+        (Property.CORRELATION_DATA, lambda: bytes(rng.randrange(256) for _ in range(rng.randint(0, 5)))),
+        (Property.USER_PROPERTY, lambda: [(_rand_str(rng), _rand_str(rng)) for _ in range(rng.randint(1, 3))]),
+    ]
+    props = {}
+    for prop, gen in pool:
+        if rng.random() < 0.3:
+            props[prop] = gen()
+    return props
+
+
+def _rand_packet(rng, ver):
+    v5 = ver == MQTT_V5
+    choice = rng.randrange(10)
+    if choice == 0:
+        return pkt.Connect(
+            proto_name="MQIsdp" if ver == MQTT_V3 else "MQTT",
+            proto_ver=ver,
+            clientid=_rand_str(rng),
+            keepalive=rng.randint(0, 65535),
+            clean_start=rng.random() < 0.5,
+            username=_rand_str(rng) if rng.random() < 0.5 else None,
+            password=b"pw" if rng.random() < 0.5 else None,
+            properties=_rand_props(rng) if v5 else {},
+        )
+    if choice == 1:
+        qos = rng.randint(0, 2)
+        return pkt.Publish(
+            topic=_rand_str(rng, 12) or "t",
+            payload=bytes(rng.randrange(256) for _ in range(rng.randint(0, 32))),
+            qos=qos,
+            retain=rng.random() < 0.5,
+            dup=rng.random() < 0.2 and qos > 0,
+            packet_id=rng.randint(1, 65535) if qos else None,
+            properties=_rand_props(rng) if v5 else {},
+        )
+    if choice == 2:
+        return pkt.PubAck(packet_id=rng.randint(1, 65535),
+                          reason_code=rng.choice([0, 0x10, 0x80]) if v5 else 0)
+    if choice == 3:
+        return pkt.Subscribe(
+            packet_id=rng.randint(1, 65535),
+            topic_filters=[
+                (_rand_str(rng, 10) or "t",
+                 SubOpts(qos=rng.randint(0, 2),
+                         no_local=v5 and rng.random() < 0.5,
+                         retain_as_published=v5 and rng.random() < 0.5,
+                         retain_handling=rng.randint(0, 2) if v5 else 0))
+                for _ in range(rng.randint(1, 4))
+            ],
+        )
+    if choice == 4:
+        return pkt.SubAck(packet_id=rng.randint(1, 65535),
+                          reason_codes=[rng.choice([0, 1, 2, 0x80]) for _ in range(rng.randint(1, 4))])
+    if choice == 5:
+        return pkt.Unsubscribe(packet_id=rng.randint(1, 65535),
+                               topic_filters=[_rand_str(rng, 10) or "t" for _ in range(rng.randint(1, 4))])
+    if choice == 6:
+        return pkt.UnsubAck(packet_id=rng.randint(1, 65535),
+                            reason_codes=[rng.choice([0, 0x11]) for _ in range(rng.randint(1, 4))] if v5 else [])
+    if choice == 7:
+        return rng.choice([pkt.PingReq(), pkt.PingResp()])
+    if choice == 8:
+        return pkt.Disconnect(reason_code=rng.choice([0, 0x04, 0x8E]) if v5 else 0,
+                              properties=_rand_props(rng) if v5 and rng.random() < 0.5 else {})
+    return pkt.PubRel(packet_id=rng.randint(1, 65535))
+
+
+@pytest.mark.parametrize("ver", [MQTT_V3, MQTT_V4, MQTT_V5])
+def test_roundtrip_property(ver):
+    rng = random.Random(100 + ver)
+    for _ in range(300):
+        p = _rand_packet(rng, ver)
+        got = roundtrip(p, ver)
+        assert got == p, f"v{ver} roundtrip failed"
+
+
+def test_stream_of_random_packets_chunked():
+    rng = random.Random(7)
+    packets = [_rand_packet(rng, MQTT_V5) for _ in range(40)]
+    packets = [p for p in packets if not isinstance(p, pkt.Connect)]
+    blob = b"".join(serialize(p, MQTT_V5) for p in packets)
+    parser = Parser(version=MQTT_V5)
+    got = []
+    i = 0
+    while i < len(blob):
+        n = rng.randint(1, 13)
+        got += parser.feed(blob[i : i + n])
+        i += n
+    assert got == packets
