@@ -45,6 +45,10 @@ class AccessControl:
         self.cache_size = cache_size
         self.cache_ttl = cache_ttl
 
+    def make_cache(self) -> "AuthzCache":
+        """Per-channel verdict cache honoring this facade's settings."""
+        return AuthzCache(self.cache_size, self.cache_ttl)
+
     # -- authenticate -----------------------------------------------------
 
     def authenticate(self, clientinfo: ClientInfo) -> Dict[str, Any]:

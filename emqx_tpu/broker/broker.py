@@ -57,6 +57,13 @@ class Broker:
         self.shared = shared or SharedSub()
         self.metrics = metrics or Metrics()
         self._routes: Dict[int, Route] = {}  # fid -> fan-out record
+        self._sub_count = 0
+        self.cm.on_discard = self._on_discard_session
+
+    def _on_discard_session(self, session: Session) -> None:
+        """Discarded session: drop its routes (kicked channels skip this)."""
+        self.client_down(session.clientid, list(session.subscriptions))
+        self.metrics.inc("session.discarded")
 
     # -------------------------------------------------------- subscribe
 
@@ -68,11 +75,15 @@ class Broker:
         if route is None:
             route = self._routes[fid] = Route(filt=real)
         if group is None:
+            if clientid not in route.direct:
+                self._sub_count += 1
             route.direct.add(clientid)
         else:
+            if not self.shared.is_member(group, real, clientid):
+                self._sub_count += 1
             self.shared.subscribe(group, real, clientid)
             route.groups.add(group)
-        self.metrics.gauge_set("subscriptions.count", self.subscription_count)
+        self.metrics.gauge_set("subscriptions.count", self._sub_count)
         self.hooks.run("session.subscribed", (clientid, filt, opts))
 
     def unsubscribe(self, clientid: str, filt: str) -> None:
@@ -83,14 +94,18 @@ class Broker:
         route = self._routes.get(fid)
         if route is not None:
             if group is None:
+                if clientid in route.direct:
+                    self._sub_count -= 1
                 route.direct.discard(clientid)
             else:
+                if self.shared.is_member(group, real, clientid):
+                    self._sub_count -= 1
                 if self.shared.unsubscribe(group, real, clientid):
                     route.groups.discard(group)
             if not route.direct and not route.groups:
                 del self._routes[fid]
         self.engine.remove_filter(real)
-        self.metrics.gauge_set("subscriptions.count", self.subscription_count)
+        self.metrics.gauge_set("subscriptions.count", self._sub_count)
         self.hooks.run("session.unsubscribed", (clientid, filt))
 
     def client_down(self, clientid: str, filters: Sequence[str]) -> None:
@@ -101,10 +116,7 @@ class Broker:
 
     @property
     def subscription_count(self) -> int:
-        n = 0
-        for r in self._routes.values():
-            n += len(r.direct) + len(r.groups)
-        return n
+        return self._sub_count
 
     @property
     def route_count(self) -> int:
@@ -177,13 +189,16 @@ class Broker:
         session = self.cm.lookup_session(cid)
         if session is None:
             return 0
-        # offline persistent session: queue per matched filter
+        # offline persistent session: queue per matched filter, honoring
+        # the same subopts Session.deliver applies online
         n = 0
         for f in filts:
             opts = session.subscriptions.get(f)
             if opts is None:
                 continue
-            qos = min(msg.qos, opts.qos)
+            if opts.no_local and msg.from_client == session.clientid:
+                continue
+            qos = max(msg.qos, opts.qos) if session.upgrade_qos else min(msg.qos, opts.qos)
             from dataclasses import replace
 
             session.enqueue(replace(msg, qos=qos))

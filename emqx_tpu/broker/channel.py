@@ -73,7 +73,7 @@ class Channel:
         self.expiry_interval = 0
         self.will_msg: Optional[Message] = None
         self.will_delay = 0
-        self.authz_cache = AuthzCache()
+        self.authz_cache = self.access.make_cache()
         self.alias_in: Dict[int, str] = {}  # inbound topic aliases (v5)
         self.alias_out: Dict[str, int] = {}
         self.connected_at: Optional[float] = None
@@ -189,6 +189,10 @@ class Channel:
         if p.will_flag:
             if p.will_qos > self.cfg.max_qos_allowed:
                 return self._connack_fail(ReasonCode.QOS_NOT_SUPPORTED)
+            if not topiclib.validate_name(p.will_topic or ""):
+                return self._connack_fail(ReasonCode.TOPIC_NAME_INVALID)
+            if p.will_retain and not self.cfg.retain_available:
+                return self._connack_fail(ReasonCode.RETAIN_NOT_SUPPORTED)
             self.will_delay = int(p.will_props.get(Property.WILL_DELAY_INTERVAL, 0))
             self.will_msg = Message(
                 topic=topiclib.prepend_mountpoint(self.cfg.mountpoint, p.will_topic or ""),
@@ -567,7 +571,14 @@ class Channel:
         self.state = DISCONNECTED
         if self.session is not None:
             if (not normal or self._will_on_normal) and self.will_msg is not None:
-                self.broker.publish(self.will_msg)
+                # the will passes the same authz gate as a live PUBLISH
+                if (
+                    self.access.authorize(
+                        self.clientinfo, PUB, self.will_msg.topic, self.authz_cache
+                    )
+                    == ALLOW
+                ):
+                    self.broker.publish(self.will_msg)
                 self.will_msg = None
             if self.session.expiry_interval == 0:
                 # session dies with the connection: clean routes

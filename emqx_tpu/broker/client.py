@@ -1,0 +1,236 @@
+"""Asyncio MQTT client — the in-repo `emqtt` analog.
+
+Used by integration tests to drive real listeners (the role emqtt plays in
+the reference's CT suites, e.g. `emqx_client_SUITE`), by the MQTT data
+bridge, and by gateway tests.  Supports v3.1.1/v5, QoS 0/1/2 both
+directions, wills, and properties.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from . import packet as pkt
+from .frame import FrameError, Parser, serialize
+from .packet import MQTT_V4, MQTT_V5, PacketType, Property, SubOpts
+
+
+class MqttError(Exception):
+    pass
+
+
+class MqttClient:
+    def __init__(
+        self,
+        clientid: str = "",
+        proto_ver: int = MQTT_V5,
+        clean_start: bool = True,
+        keepalive: int = 60,
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+        properties: Optional[dict] = None,
+        will: Optional[pkt.Connect] = None,
+        auto_ack: bool = True,
+    ):
+        self.clientid = clientid
+        self.proto_ver = proto_ver
+        self.clean_start = clean_start
+        self.keepalive = keepalive
+        self.username = username
+        self.password = password
+        self.properties = properties or {}
+        self.auto_ack = auto_ack
+        self.will: Optional[Tuple[str, bytes, int, bool]] = None
+
+        self.messages: asyncio.Queue = asyncio.Queue()
+        self.connack: Optional[pkt.Connack] = None
+        self.disconnect_packet: Optional[pkt.Disconnect] = None
+
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._parser = Parser()
+        self._read_task: Optional[asyncio.Task] = None
+        self._pending: Dict[Tuple[int, int], asyncio.Future] = {}
+        self._next_pid = 1
+        self._connected = asyncio.Event()
+        self.closed = asyncio.Event()
+
+    # ------------------------------------------------------------ connect
+
+    async def connect(self, host: str = "127.0.0.1", port: int = 1883) -> pkt.Connack:
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+        self._parser = Parser(version=self.proto_ver)
+        c = pkt.Connect(
+            proto_name="MQIsdp" if self.proto_ver == 3 else "MQTT",
+            proto_ver=self.proto_ver,
+            clientid=self.clientid,
+            clean_start=self.clean_start,
+            keepalive=self.keepalive,
+            username=self.username,
+            password=self.password,
+            properties=dict(self.properties),
+        )
+        if self.will:
+            topic, payload, qos, retain = self.will
+            c.will_flag = True
+            c.will_topic = topic
+            c.will_payload = payload
+            c.will_qos = qos
+            c.will_retain = retain
+        self._send(c)
+        self._read_task = asyncio.create_task(self._read_loop())
+        await asyncio.wait_for(self._connected.wait(), 10)
+        assert self.connack is not None
+        if self.connack.reason_code != 0:
+            raise MqttError(f"connack rc={self.connack.reason_code:#x}")
+        return self.connack
+
+    def _send(self, p) -> None:
+        assert self._writer is not None
+        self._writer.write(serialize(p, self.proto_ver))
+
+    def _alloc_pid(self) -> int:
+        pid = self._next_pid
+        self._next_pid = pid % 65535 + 1
+        return pid
+
+    # ---------------------------------------------------------- read loop
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                data = await self._reader.read(65536)
+                if not data:
+                    break
+                for p in self._parser.feed(data):
+                    await self._handle(p)
+        except (FrameError, ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self._connected.set()  # unblock connect() on immediate close
+            self.closed.set()
+            for f in self._pending.values():
+                if not f.done():
+                    f.set_exception(MqttError("connection closed"))
+            self._pending.clear()
+
+    async def _handle(self, p) -> None:
+        t = p.type
+        if t == PacketType.CONNACK:
+            self.connack = p
+            self._connected.set()
+        elif t == PacketType.PUBLISH:
+            if p.qos == 0:
+                await self.messages.put(p)
+            elif p.qos == 1:
+                await self.messages.put(p)
+                if self.auto_ack:
+                    self._send(pkt.PubAck(packet_id=p.packet_id))
+            else:
+                if self.auto_ack:
+                    self._send(pkt.PubRec(packet_id=p.packet_id))
+                await self.messages.put(p)
+        elif t == PacketType.PUBREL:
+            if self.auto_ack:
+                self._send(pkt.PubComp(packet_id=p.packet_id))
+        elif t in (PacketType.PUBACK, PacketType.PUBCOMP, PacketType.SUBACK,
+                   PacketType.UNSUBACK, PacketType.PUBREC):
+            if t == PacketType.PUBREC:
+                self._send(pkt.PubRel(packet_id=p.packet_id))
+                return  # wait for PUBCOMP to resolve the future
+            f = self._pending.pop((int(t), p.packet_id), None) or self._pending.pop(
+                (int(PacketType.PUBACK), p.packet_id), None
+            )
+            if f is None and t == PacketType.PUBCOMP:
+                f = self._pending.pop((int(PacketType.PUBREC), p.packet_id), None)
+            if f and not f.done():
+                f.set_result(p)
+        elif t == PacketType.DISCONNECT:
+            self.disconnect_packet = p
+        elif t == PacketType.PINGRESP:
+            pass
+
+    def _expect(self, ptype: PacketType, pid: int) -> asyncio.Future:
+        f = asyncio.get_event_loop().create_future()
+        self._pending[(int(ptype), pid)] = f
+        return f
+
+    # ------------------------------------------------------------ actions
+
+    async def subscribe(
+        self, filters, qos: int = 0, properties: Optional[dict] = None
+    ) -> List[int]:
+        if isinstance(filters, str):
+            filters = [(filters, SubOpts(qos=qos))]
+        filters = [
+            (f, SubOpts(qos=qos)) if isinstance(f, str) else (f[0], f[1])
+            for f in filters
+        ]
+        pid = self._alloc_pid()
+        f = self._expect(PacketType.SUBACK, pid)
+        self._send(pkt.Subscribe(packet_id=pid, topic_filters=filters,
+                                 properties=properties or {}))
+        ack = await asyncio.wait_for(f, 10)
+        return ack.reason_codes
+
+    async def unsubscribe(self, filters) -> List[int]:
+        if isinstance(filters, str):
+            filters = [filters]
+        pid = self._alloc_pid()
+        f = self._expect(PacketType.UNSUBACK, pid)
+        self._send(pkt.Unsubscribe(packet_id=pid, topic_filters=filters))
+        ack = await asyncio.wait_for(f, 10)
+        return ack.reason_codes
+
+    async def publish(
+        self,
+        topic: str,
+        payload: bytes = b"",
+        qos: int = 0,
+        retain: bool = False,
+        properties: Optional[dict] = None,
+    ) -> Optional[int]:
+        """Returns the terminal reason code for qos>0 (None for qos0)."""
+        if qos == 0:
+            self._send(pkt.Publish(topic=topic, payload=payload, qos=0,
+                                   retain=retain, properties=properties or {}))
+            await self._writer.drain()
+            return None
+        pid = self._alloc_pid()
+        wait_t = PacketType.PUBACK if qos == 1 else PacketType.PUBREC
+        f = self._expect(wait_t, pid)
+        self._send(pkt.Publish(topic=topic, payload=payload, qos=qos,
+                               retain=retain, packet_id=pid,
+                               properties=properties or {}))
+        ack = await asyncio.wait_for(f, 10)
+        return ack.reason_code
+
+    async def ping(self) -> None:
+        self._send(pkt.PingReq())
+        await self._writer.drain()
+
+    async def recv(self, timeout: float = 5.0) -> pkt.Publish:
+        return await asyncio.wait_for(self.messages.get(), timeout)
+
+    async def disconnect(self, reason_code: int = 0, properties: Optional[dict] = None) -> None:
+        try:
+            self._send(pkt.Disconnect(reason_code=reason_code,
+                                      properties=properties or {}))
+            await self._writer.drain()
+        except Exception:
+            pass
+        await self.close()
+
+    async def close(self) -> None:
+        """Hard close (no DISCONNECT — triggers the will on the broker)."""
+        if self._read_task:
+            self._read_task.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+        self.closed.set()

@@ -50,6 +50,11 @@ class ConnectionManager:
         if clean_start:
             if old is not None:
                 self._kick(old, ReasonCode.SESSION_TAKEN_OVER)
+                if self.on_discard:
+                    # the kicked channel's terminate() skips cleanup (it
+                    # believes its session was taken over), so the broker
+                    # must clean its routes here
+                    self.on_discard(old.session)
             dropped = self.pending.pop(clientid, None)
             if dropped and self.on_discard:
                 self.on_discard(dropped[0])
@@ -112,6 +117,8 @@ class ConnectionManager:
         old = self.channels.get(clientid)
         if old is not None:
             self._kick(old, ReasonCode.SESSION_TAKEN_OVER)
+            if self.on_discard:
+                self.on_discard(old.session)
         ent = self.pending.pop(clientid, None)
         if ent and self.on_discard:
             self.on_discard(ent[0])

@@ -23,6 +23,9 @@ class FrameError(Exception):
     def __init__(self, reason_code: int, msg: str = ""):
         super().__init__(msg or hex(reason_code))
         self.reason_code = reason_code
+        # packets successfully parsed from the same feed() call before the
+        # error — the caller should process these before disconnecting
+        self.packets: List["pkt.Packet"] = []
 
 
 MALFORMED = ReasonCode.MALFORMED_PACKET
@@ -213,9 +216,13 @@ class Parser:
 
     def feed(self, data: bytes) -> List[pkt.Packet]:
         self._buf += data
-        out = []
+        out: List[pkt.Packet] = []
         while True:
-            parsed = self._try_parse_one()
+            try:
+                parsed = self._try_parse_one()
+            except FrameError as e:
+                e.packets = out  # don't lose wire-valid packets before the error
+                raise
             if parsed is None:
                 return out
             out.append(parsed)

@@ -1,0 +1,218 @@
+"""Asyncio TCP/WebSocket listeners + per-connection driver.
+
+Analog of `emqx_listeners.erl` + `emqx_connection.erl` (SURVEY.md §1.3-1.4):
+where the reference runs one Erlang process per socket, the TPU-native host
+plane runs one asyncio task per connection around the shared event loop —
+connections are cheap coroutines, and publish batching across connections
+feeds the device matcher (`PublishBatcher`).
+
+Connection loop: read bytes -> Parser.feed -> Channel.handle_in -> actions
+(send/close) -> writer.  Keepalive enforcement mirrors the reference's
+1.5x window.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import packet as pkt
+from .broker import Broker
+from .channel import Action, Channel, ChannelConfig
+from .frame import FrameError, Parser, serialize
+from .message import Message
+
+log = logging.getLogger("emqx_tpu.listener")
+
+
+class Connection:
+    """Owns one client socket; drives its Channel."""
+
+    def __init__(
+        self,
+        broker: Broker,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        config: Optional[ChannelConfig] = None,
+        max_packet_size: int = 1_048_576,
+    ):
+        peer = writer.get_extra_info("peername")
+        peername = f"{peer[0]}:{peer[1]}" if peer else "?"
+        self.reader = reader
+        self.writer = writer
+        self.parser = Parser(max_size=max_packet_size)
+        self.channel = Channel(broker, config=config, peername=peername)
+        self.channel.out_cb = self._send_actions
+        self.channel.on_kick = self._on_kick
+        self._closing: Optional[int] = None
+        self._normal = False
+        self._last_rx = time.monotonic()
+        self._retry_task: Optional[asyncio.Task] = None
+
+    # -- outbound ---------------------------------------------------------
+
+    def _send_actions(self, actions: List[Action]) -> None:
+        for action in actions:
+            kind = action[0]
+            arg = action[1] if len(action) > 1 else None
+            if kind == "send":
+                try:
+                    data = serialize(arg, self.channel.proto_ver)
+                    self.writer.write(data)
+                    self.channel.broker.metrics.inc("bytes.sent", len(data))
+                except Exception:
+                    log.exception("serialize/send failed")
+            elif kind == "close":
+                self._closing = arg if arg is not None else -1
+                self._normal = arg is None
+            # 'connected' is informational
+
+    def _on_kick(self, rc: int) -> None:
+        if self.channel.v5:
+            try:
+                self.writer.write(
+                    serialize(pkt.Disconnect(reason_code=rc), pkt.MQTT_V5)
+                )
+            except Exception:
+                pass
+        self._closing = rc
+        self._normal = False
+        # wake the read loop
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    # -- main loop --------------------------------------------------------
+
+    async def run(self) -> None:
+        m = self.channel.broker.metrics
+        try:
+            while self._closing is None:
+                timeout = self._keepalive_timeout()
+                try:
+                    data = await asyncio.wait_for(self.reader.read(65536), timeout)
+                except asyncio.TimeoutError:
+                    if self._keepalive_expired():
+                        log.info("keepalive timeout %s", self.channel.clientid)
+                        break
+                    continue
+                if not data:
+                    break
+                self._last_rx = time.monotonic()
+                m.inc("bytes.received", len(data))
+                try:
+                    packets = self.parser.feed(data)
+                except FrameError as e:
+                    log.info("frame error from %s: %s", self.channel.peername, e)
+                    # process wire-valid packets parsed before the error
+                    for p in e.packets:
+                        self._send_actions(self.channel.handle_in(p))
+                    if self.channel.v5 and self.channel.state == "connected":
+                        self.writer.write(
+                            serialize(
+                                pkt.Disconnect(reason_code=e.reason_code), pkt.MQTT_V5
+                            )
+                        )
+                    self._normal = False
+                    break
+                for p in packets:
+                    self._send_actions(self.channel.handle_in(p))
+                    if self._closing is not None:
+                        break
+                await self._drain()
+        except (ConnectionResetError, BrokenPipeError):
+            self._normal = False
+        finally:
+            await self._shutdown()
+
+    async def _drain(self) -> None:
+        try:
+            await self.writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            self._closing = self._closing or -1
+
+    def _keepalive_timeout(self) -> Optional[float]:
+        ka = self.channel.keepalive
+        if not ka or self.channel.state != "connected":
+            return 30.0
+        return ka * 1.5 - (time.monotonic() - self._last_rx) + 0.05
+
+    def _keepalive_expired(self) -> bool:
+        ka = self.channel.keepalive
+        if not ka or self.channel.state != "connected":
+            return False
+        return time.monotonic() - self._last_rx >= ka * 1.5
+
+    async def _shutdown(self) -> None:
+        try:
+            await self._drain()
+        except Exception:
+            pass
+        self.channel.terminate(normal=self._normal)
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+
+
+class Listener:
+    """One TCP listening socket fanning out Connections."""
+
+    def __init__(
+        self,
+        broker: Broker,
+        host: str = "127.0.0.1",
+        port: int = 1883,
+        config: Optional[ChannelConfig] = None,
+        max_connections: int = 0,
+    ):
+        self.broker = broker
+        self.host = host
+        self.port = port
+        self.config = config
+        self.max_connections = max_connections
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port
+        )
+        addr = self._server.sockets[0].getsockname()
+        self.port = addr[1]  # resolve port 0
+        log.info("mqtt listener on %s:%s", self.host, self.port)
+
+    async def _on_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        if self.max_connections and len(self._conns) >= self.max_connections:
+            writer.close()
+            return
+        conn = Connection(self.broker, reader, writer, self.config)
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            await conn.run()
+        finally:
+            self._conns.discard(task)
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+        # Python 3.12: Server.wait_closed() waits for all connection
+        # handlers, so live connections must be cancelled first.
+        tasks = list(self._conns)
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        if self._server:
+            await self._server.wait_closed()
+
+    @property
+    def current_connections(self) -> int:
+        return len(self._conns)

@@ -26,6 +26,9 @@ class SharedSub:
         self._rr: Dict[Tuple[str, str], int] = {}
         self._sticky: Dict[Tuple[str, str], str] = {}
 
+    def is_member(self, group: str, filt: str, clientid: str) -> bool:
+        return clientid in self._groups.get((group, filt), ())
+
     def subscribe(self, group: str, filt: str, clientid: str) -> bool:
         """Returns True if this (group, filter) is new (needs a route)."""
         key = (group, filt)

@@ -55,6 +55,26 @@ class Delta:
     def empty(self) -> bool:
         return not self.slots and not self.desc_dirty and not self.rebuilt
 
+    def compressed(self) -> "Delta":
+        """Last-write-wins per slot.
+
+        A delete + reinsert of the same slot between device syncs must not
+        reach the scatter as duplicate indices (jax .at[].set application
+        order is undefined for duplicates).
+        """
+        if len(set(self.slots)) == len(self.slots):
+            return self
+        last: Dict[int, int] = {s: i for i, s in enumerate(self.slots)}
+        keep = sorted(last.values())
+        return Delta(
+            slots=[self.slots[i] for i in keep],
+            key_a=[self.key_a[i] for i in keep],
+            key_b=[self.key_b[i] for i in keep],
+            val=[self.val[i] for i in keep],
+            desc_dirty=self.desc_dirty,
+            rebuilt=self.rebuilt,
+        )
+
 
 class MatchTables:
     """Numpy mirror of the device tables + incremental mutation log."""
@@ -262,7 +282,7 @@ class MatchTables:
     # -------------------------------------------------------------- sync
 
     def drain_delta(self) -> Delta:
-        d = self.delta
+        d = self.delta.compressed()
         self.delta = Delta()
         return d
 

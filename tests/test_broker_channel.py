@@ -367,6 +367,58 @@ def test_subscription_identifier_v5(h):
     assert d.properties.get(Property.SUBSCRIPTION_IDENTIFIER) == [7]
 
 
+def test_clean_start_discard_cleans_routes(h):
+    """Routes of a discarded session must not leak (misdelivery bug)."""
+    c1 = h.connect("leak", props={Property.SESSION_EXPIRY_INTERVAL: 300}, clean_start=False)
+    c1.handle_in(pkt.Subscribe(packet_id=1, topic_filters=[("lk/t", SubOpts(qos=0))]))
+    assert h.broker.route_count == 1
+    c2 = h.connect("leak", clean_start=True)  # discards old session
+    assert h.broker.route_count == 0
+    assert h.broker.engine.fid_of("lk/t") is None
+    h.clear(c2)
+    p = h.connect("leak-pub")
+    p.handle_in(pkt.Publish(topic="lk/t", payload=b"x", qos=0))
+    assert not h.sent(c2, PacketType.PUBLISH)  # no phantom delivery
+
+
+def test_expired_pending_session_cleans_routes(h):
+    c1 = h.connect("exp", props={Property.SESSION_EXPIRY_INTERVAL: 1}, clean_start=False)
+    c1.handle_in(pkt.Subscribe(packet_id=1, topic_filters=[("ex/t", SubOpts(qos=0))]))
+    c1.terminate(normal=True)
+    assert h.broker.route_count == 1  # parked with routes
+    import time as _t
+
+    h.broker.cm.evict_expired(now=_t.time() + 5)
+    assert h.broker.route_count == 0
+
+
+def test_slot_reuse_between_syncs():
+    """unsubscribe+subscribe reusing a hash slot within one sync must land."""
+    from emqx_tpu.models.engine import TopicMatchEngine
+
+    eng = TopicMatchEngine()
+    eng.add_filter("slot/a")
+    assert eng.match_one("slot/a") == {0}
+    # same slot freed and refilled before the next device sync
+    eng.remove_filter("slot/a")
+    fid2 = eng.add_filter("slot/a")
+    got = eng.match_one("slot/a")
+    assert got == {fid2}
+
+
+def test_will_topic_validation(h):
+    ch = Channel(h.broker)
+    ch.outbox = []
+    ch.out_cb = ch.outbox.extend
+    acts = ch.handle_in(
+        pkt.Connect(proto_ver=MQTT_V5, clientid="wbad", will_flag=True,
+                    will_topic="bad/#", will_payload=b"x")
+    )
+    sent = [a[1] for a in acts if a[0] == "send"]
+    assert sent[0].type == PacketType.CONNACK
+    assert sent[0].reason_code == ReasonCode.TOPIC_NAME_INVALID
+
+
 def test_metrics_counting(h):
     c = h.connect("mx")
     c.handle_in(pkt.Publish(topic="m/t", payload=b"x", qos=0))

@@ -1,0 +1,202 @@
+"""Socket-level integration: real TCP listener driven by the in-repo client.
+
+The analog of the reference's emqtt-driven CT suites (`emqx_client_SUITE`,
+`emqx_takeover_SUITE`): full broker stack over real localhost sockets.
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient, MqttError
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.broker.packet import MQTT_V4, MQTT_V5, Property, ReasonCode, SubOpts
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+async def start_broker():
+    broker = Broker()
+    lst = Listener(broker, port=0)
+    await lst.start()
+    return broker, lst
+
+
+def test_connect_pub_sub_over_tcp(run):
+    async def main():
+        broker, lst = await start_broker()
+        sub = MqttClient(clientid="tcp-sub")
+        await sub.connect(port=lst.port)
+        assert (await sub.subscribe("t/#", qos=1)) == [1]
+
+        p = MqttClient(clientid="tcp-pub")
+        await p.connect(port=lst.port)
+        await p.publish("t/1", b"hello", qos=0)
+        m = await sub.recv()
+        assert (m.topic, m.payload, m.qos) == ("t/1", b"hello", 0)
+
+        rc = await p.publish("t/2", b"q1", qos=1)
+        assert rc == 0
+        m = await sub.recv()
+        assert (m.topic, m.payload, m.qos) == ("t/2", b"q1", 1)
+
+        rc = await p.publish("t/3", b"q2", qos=2)
+        assert rc == 0
+        m = await sub.recv()
+        assert (m.payload, m.qos) == (b"q2", 1)  # granted sub qos caps at 1
+
+        await p.disconnect()
+        await sub.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_v4_client(run):
+    async def main():
+        broker, lst = await start_broker()
+        c = MqttClient(clientid="v4c", proto_ver=MQTT_V4)
+        ack = await c.connect(port=lst.port)
+        assert ack.reason_code == 0
+        await c.subscribe("x", qos=0)
+        await c.publish("x", b"self", qos=1)
+        m = await c.recv()
+        assert m.payload == b"self"
+        await c.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_will_over_tcp(run):
+    async def main():
+        broker, lst = await start_broker()
+        obs = MqttClient(clientid="obs")
+        await obs.connect(port=lst.port)
+        await obs.subscribe("will/t")
+
+        w = MqttClient(clientid="wclient")
+        w.will = ("will/t", b"died", 0, False)
+        await w.connect(port=lst.port)
+        await w.close()  # hard close, no DISCONNECT
+        m = await obs.recv()
+        assert m.payload == b"died"
+        await obs.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_takeover_over_tcp(run):
+    async def main():
+        broker, lst = await start_broker()
+        c1 = MqttClient(clientid="same", clean_start=False,
+                        properties={Property.SESSION_EXPIRY_INTERVAL: 120})
+        await c1.connect(port=lst.port)
+        await c1.subscribe("keep/+", qos=1)
+
+        c2 = MqttClient(clientid="same", clean_start=False,
+                        properties={Property.SESSION_EXPIRY_INTERVAL: 120})
+        ack = await c2.connect(port=lst.port)
+        assert ack.session_present
+        # old connection must be kicked with a v5 DISCONNECT
+        await asyncio.wait_for(c1.closed.wait(), 5)
+        assert c1.disconnect_packet is not None
+        assert c1.disconnect_packet.reason_code == ReasonCode.SESSION_TAKEN_OVER
+
+        # inherited subscription still works
+        p = MqttClient(clientid="tp")
+        await p.connect(port=lst.port)
+        await p.publish("keep/1", b"x", qos=1)
+        m = await c2.recv()
+        assert m.payload == b"x"
+        await lst.stop()
+
+    run(main())
+
+
+def test_offline_queue_resume_over_tcp(run):
+    async def main():
+        broker, lst = await start_broker()
+        c1 = MqttClient(clientid="off1", clean_start=False,
+                        properties={Property.SESSION_EXPIRY_INTERVAL: 120})
+        await c1.connect(port=lst.port)
+        await c1.subscribe("of/+", qos=1)
+        await c1.disconnect()
+
+        p = MqttClient(clientid="opp")
+        await p.connect(port=lst.port)
+        await p.publish("of/9", b"missed", qos=1)
+
+        c2 = MqttClient(clientid="off1", clean_start=False,
+                        properties={Property.SESSION_EXPIRY_INTERVAL: 120})
+        ack = await c2.connect(port=lst.port)
+        assert ack.session_present
+        m = await c2.recv()
+        assert m.payload == b"missed" and m.qos == 1
+        await lst.stop()
+
+    run(main())
+
+
+def test_retained_over_tcp(run):
+    async def main():
+        broker, lst = await start_broker()
+        p = MqttClient(clientid="rp")
+        await p.connect(port=lst.port)
+        await p.publish("state/x", b"42", retain=True)
+        c = MqttClient(clientid="rc")
+        await c.connect(port=lst.port)
+        await c.subscribe("state/#")
+        m = await c.recv()
+        assert m.payload == b"42"
+        await lst.stop()
+
+    run(main())
+
+
+def test_bad_connack_rc(run):
+    async def main():
+        broker, lst = await start_broker()
+
+        def deny(clientinfo, acc):
+            return ("stop", {"result": "deny",
+                             "reason_code": ReasonCode.NOT_AUTHORIZED})
+
+        broker.hooks.put("client.authenticate", deny)
+        c = MqttClient(clientid="nope")
+        with pytest.raises(MqttError):
+            await c.connect(port=lst.port)
+        await c.close()
+        await lst.stop()
+
+    run(main())
+
+
+def test_many_clients_fanout(run):
+    async def main():
+        broker, lst = await start_broker()
+        subs = []
+        for i in range(20):
+            c = MqttClient(clientid=f"fan{i}")
+            await c.connect(port=lst.port)
+            await c.subscribe("fan/+")
+            subs.append(c)
+        p = MqttClient(clientid="fp")
+        await p.connect(port=lst.port)
+        await p.publish("fan/1", b"all", qos=0)
+        for c in subs:
+            m = await c.recv()
+            assert m.payload == b"all"
+        assert broker.metrics.get("messages.delivered") >= 20
+        await lst.stop()
+
+    run(main())
+
+    # NOTE: run() wraps with wait_for; sockets torn down with the loop.
