@@ -1,0 +1,276 @@
+"""Authentication chains + providers.
+
+Analog of `apps/emqx_authn` + `emqx_authentication.erl` (SURVEY.md §1.11):
+an ordered chain of authenticator providers runs on 'client.authenticate';
+each provider returns allow / deny / ignore (continue down the chain), like
+the reference's per-listener chains with provider behaviors
+(`emqx_authentication.erl:126-204`).
+
+Providers: built-in database (password_hash pbkdf2/sha256/bcrypt-compatible
+iterations), JWT (HS256/none-forbidden), HTTP (pluggable transport so tests
+inject a fake server), and a static allow/deny list.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .broker.access_control import ALLOW, DENY, ClientInfo
+from .broker.hooks import Hooks, STOP
+from .broker.packet import ReasonCode
+
+IGNORE = "ignore"
+
+
+class Authenticator:
+    """Provider behavior: authenticate -> (ALLOW|DENY|IGNORE, extras)."""
+
+    name = "base"
+    enabled = True
+
+    def authenticate(self, ci: ClientInfo) -> Tuple[str, Dict[str, Any]]:
+        raise NotImplementedError
+
+
+# -------------------------------------------------------------- built-in db
+
+def hash_password(
+    password: bytes,
+    salt: bytes,
+    algorithm: str = "pbkdf2_sha256",
+    iterations: int = 10_000,
+) -> str:
+    if algorithm == "pbkdf2_sha256":
+        dk = hashlib.pbkdf2_hmac("sha256", password, salt, iterations)
+    elif algorithm == "sha256":
+        dk = hashlib.sha256(salt + password).digest()
+    elif algorithm == "sha512":
+        dk = hashlib.sha512(salt + password).digest()
+    elif algorithm == "plain":
+        dk = password
+    else:
+        raise ValueError(f"unsupported hash algorithm {algorithm}")
+    return dk.hex()
+
+
+@dataclass
+class UserRecord:
+    user_id: str
+    password_hash: str
+    salt: bytes
+    algorithm: str = "pbkdf2_sha256"
+    iterations: int = 10_000
+    is_superuser: bool = False
+
+
+class BuiltInAuthenticator(Authenticator):
+    """User store keyed by username or clientid (`emqx_authn_mnesia` analog)."""
+
+    name = "built_in_database"
+
+    def __init__(self, user_id_type: str = "username"):
+        assert user_id_type in ("username", "clientid")
+        self.user_id_type = user_id_type
+        self.users: Dict[str, UserRecord] = {}
+
+    def add_user(
+        self,
+        user_id: str,
+        password: str,
+        is_superuser: bool = False,
+        algorithm: str = "pbkdf2_sha256",
+    ) -> UserRecord:
+        salt = os.urandom(16)
+        rec = UserRecord(
+            user_id=user_id,
+            password_hash=hash_password(password.encode(), salt, algorithm),
+            salt=salt,
+            algorithm=algorithm,
+            is_superuser=is_superuser,
+        )
+        self.users[user_id] = rec
+        return rec
+
+    def delete_user(self, user_id: str) -> bool:
+        return self.users.pop(user_id, None) is not None
+
+    def authenticate(self, ci: ClientInfo) -> Tuple[str, Dict[str, Any]]:
+        uid = ci.username if self.user_id_type == "username" else ci.clientid
+        if not uid:
+            return IGNORE, {}
+        rec = self.users.get(uid)
+        if rec is None:
+            return IGNORE, {}
+        if ci.password is None:
+            return DENY, {"reason_code": ReasonCode.BAD_USERNAME_OR_PASSWORD}
+        got = hash_password(ci.password, rec.salt, rec.algorithm, rec.iterations)
+        if hmac.compare_digest(got, rec.password_hash):
+            return ALLOW, {"is_superuser": rec.is_superuser}
+        return DENY, {"reason_code": ReasonCode.BAD_USERNAME_OR_PASSWORD}
+
+
+# --------------------------------------------------------------------- jwt
+
+def b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+class JwtAuthenticator(Authenticator):
+    """HS256 JWT verification from the password field (`emqx_authn_jwt`)."""
+
+    name = "jwt"
+
+    def __init__(
+        self,
+        secret: bytes,
+        from_field: str = "password",
+        verify_claims: Optional[Dict[str, str]] = None,
+        acl_claim_name: str = "acl",
+    ):
+        self.secret = secret
+        self.from_field = from_field
+        self.verify_claims = verify_claims or {}
+        self.acl_claim_name = acl_claim_name
+
+    def authenticate(self, ci: ClientInfo) -> Tuple[str, Dict[str, Any]]:
+        token = (
+            ci.password.decode("utf-8", "replace")
+            if self.from_field == "password" and ci.password
+            else (ci.username or "")
+        )
+        if token.count(".") != 2:
+            return IGNORE, {}
+        head_b64, payload_b64, sig_b64 = token.split(".")
+        try:
+            header = json.loads(b64url_decode(head_b64))
+            if header.get("alg") != "HS256":
+                return DENY, {"reason_code": ReasonCode.NOT_AUTHORIZED}
+            expect = hmac.new(
+                self.secret, f"{head_b64}.{payload_b64}".encode(), hashlib.sha256
+            ).digest()
+            if not hmac.compare_digest(expect, b64url_decode(sig_b64)):
+                return DENY, {"reason_code": ReasonCode.NOT_AUTHORIZED}
+            claims = json.loads(b64url_decode(payload_b64))
+        except Exception:
+            return DENY, {"reason_code": ReasonCode.NOT_AUTHORIZED}
+        if "exp" in claims and time.time() >= float(claims["exp"]):
+            return DENY, {"reason_code": ReasonCode.NOT_AUTHORIZED}
+        for k, want in self.verify_claims.items():
+            want = want.replace("${clientid}", ci.clientid).replace(
+                "${username}", ci.username or ""
+            )
+            if str(claims.get(k)) != want:
+                return DENY, {"reason_code": ReasonCode.NOT_AUTHORIZED}
+        extras: Dict[str, Any] = {"is_superuser": bool(claims.get("is_superuser"))}
+        if self.acl_claim_name in claims:
+            extras["acl"] = claims[self.acl_claim_name]
+        if "exp" in claims:
+            extras["expire_at"] = float(claims["exp"])
+        return ALLOW, extras
+
+
+# -------------------------------------------------------------------- http
+
+class HttpAuthenticator(Authenticator):
+    """POST {clientid, username, password...} to an HTTP endpoint.
+
+    The transport is injectable: `request_fn(body_dict) -> (status, body)`.
+    Default uses urllib in a thread-unsafe sync call — production deploys
+    swap in a pooled client; tests inject a stub (matching the reference's
+    `emqx_authn_http` semantics: 200 {"result": "allow"/"deny"/"ignore"}).
+    """
+
+    name = "http"
+
+    def __init__(self, url: str, request_fn: Optional[Callable] = None, timeout: float = 5.0):
+        self.url = url
+        self.timeout = timeout
+        self.request_fn = request_fn or self._default_request
+
+    def _default_request(self, body: Dict[str, Any]) -> Tuple[int, bytes]:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.status, resp.read()
+
+    def authenticate(self, ci: ClientInfo) -> Tuple[str, Dict[str, Any]]:
+        body = {
+            "clientid": ci.clientid,
+            "username": ci.username,
+            "password": ci.password.decode("utf-8", "replace") if ci.password else None,
+            "peerhost": ci.peerhost,
+        }
+        try:
+            status, raw = self.request_fn(body)
+        except Exception:
+            return DENY, {"reason_code": ReasonCode.SERVER_UNAVAILABLE}
+        if status == 204:
+            return ALLOW, {}
+        if status != 200:
+            return IGNORE, {}
+        try:
+            data = json.loads(raw)
+        except Exception:
+            return IGNORE, {}
+        result = data.get("result", "ignore")
+        if result == "allow":
+            return ALLOW, {"is_superuser": bool(data.get("is_superuser"))}
+        if result == "deny":
+            return DENY, {"reason_code": ReasonCode.NOT_AUTHORIZED}
+        return IGNORE, {}
+
+
+# ------------------------------------------------------------------- chain
+
+class AuthChain:
+    """Ordered authenticator chain registered on 'client.authenticate'."""
+
+    def __init__(self, allow_anonymous: bool = True):
+        self.authenticators: List[Authenticator] = []
+        self.allow_anonymous = allow_anonymous
+
+    def add(self, a: Authenticator, front: bool = False) -> None:
+        if front:
+            self.authenticators.insert(0, a)
+        else:
+            self.authenticators.append(a)
+
+    def remove(self, name: str) -> None:
+        self.authenticators = [a for a in self.authenticators if a.name != name]
+
+    def __call__(self, ci: ClientInfo, acc):
+        ran_any = False
+        for a in self.authenticators:
+            if not a.enabled:
+                continue
+            ran_any = True
+            verdict, extras = a.authenticate(ci)
+            if verdict == ALLOW:
+                return (STOP, {"result": ALLOW, **extras})
+            if verdict == DENY:
+                rc = extras.get("reason_code", ReasonCode.NOT_AUTHORIZED)
+                return (STOP, {"result": DENY, "reason_code": rc})
+        if ran_any and not self.allow_anonymous:
+            return (
+                STOP,
+                {"result": DENY, "reason_code": ReasonCode.NOT_AUTHORIZED},
+            )
+        return None  # fall through (anonymous allowed / no authenticators)
+
+    def install(self, hooks: Hooks, priority: int = 0) -> None:
+        hooks.put("client.authenticate", self, priority)
+
+    def uninstall(self, hooks: Hooks) -> None:
+        hooks.delete("client.authenticate", self)

@@ -1,0 +1,213 @@
+"""Authorization (ACL) sources.
+
+Analog of `apps/emqx_authz` (SURVEY.md §1.11): an ordered list of sources
+evaluated on 'client.authorize'; each source returns allow/deny/nomatch.
+Rule model mirrors the reference's acl.conf/built-in-database rules:
+
+    Rule(permission, who, action, topics)
+      who:    all | {clientid: x} | {username: x} | {ipaddr: prefix}
+      action: publish | subscribe | all
+      topics: filters with %c/%u placeholders; "eq " prefix = literal match
+
+plus a per-client ACL claim source (JWT 'acl' claim) and an HTTP source
+with injectable transport.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .broker import topic as topiclib
+from .broker.access_control import ALLOW, DENY, PUB, SUB, ClientInfo
+from .broker.hooks import Hooks, STOP
+
+NOMATCH = "nomatch"
+
+
+@dataclass
+class Rule:
+    permission: str  # allow | deny
+    who: Any = "all"  # "all" | ("clientid", x) | ("username", x) | ("ipaddr", p)
+    action: str = "all"  # publish | subscribe | all
+    topics: List[str] = field(default_factory=list)
+
+    def match_who(self, ci: ClientInfo) -> bool:
+        if self.who == "all":
+            return True
+        kind, val = self.who
+        if kind == "clientid":
+            return ci.clientid == val
+        if kind == "username":
+            return ci.username == val
+        if kind == "ipaddr":
+            host = ci.peerhost.split(":")[0]
+            return fnmatch.fnmatch(host, val)
+        return False
+
+    def match_action(self, action: str) -> bool:
+        return self.action in ("all", action)
+
+    def match_topic(self, ci: ClientInfo, topic: str) -> bool:
+        for t in self.topics:
+            t = t.replace("%c", ci.clientid).replace("%u", ci.username or "")
+            if t.startswith("eq "):
+                if t[3:] == topic:
+                    return True
+            elif topiclib.match(topic, t) or topic == t:
+                return True
+        return False
+
+    def check(self, ci: ClientInfo, action: str, topic: str) -> str:
+        if self.match_who(ci) and self.match_action(action) and self.match_topic(ci, topic):
+            return self.permission
+        return NOMATCH
+
+
+class AuthzSource:
+    name = "base"
+    enabled = True
+
+    def authorize(self, ci: ClientInfo, action: str, topic: str) -> str:
+        raise NotImplementedError
+
+
+class FileSource(AuthzSource):
+    """Static rule list (`emqx_authz_file` / acl.conf analog)."""
+
+    name = "file"
+
+    def __init__(self, rules: Optional[List[Rule]] = None):
+        self.rules = rules or []
+
+    def authorize(self, ci: ClientInfo, action: str, topic: str) -> str:
+        for r in self.rules:
+            v = r.check(ci, action, topic)
+            if v != NOMATCH:
+                return v
+        return NOMATCH
+
+
+class BuiltInSource(AuthzSource):
+    """Per-client/user rule store (`emqx_authz_mnesia` analog)."""
+
+    name = "built_in_database"
+
+    def __init__(self):
+        self.by_clientid: Dict[str, List[Rule]] = {}
+        self.by_username: Dict[str, List[Rule]] = {}
+        self.all_rules: List[Rule] = []
+
+    def authorize(self, ci: ClientInfo, action: str, topic: str) -> str:
+        for ruleset in (
+            self.by_clientid.get(ci.clientid, ()),
+            self.by_username.get(ci.username or "", ()),
+            self.all_rules,
+        ):
+            for r in ruleset:
+                v = r.check(ci, action, topic)
+                if v != NOMATCH:
+                    return v
+        return NOMATCH
+
+
+class ClientAclSource(AuthzSource):
+    """ACL from authentication extras (JWT acl claim; `acl` in clientinfo).
+
+    Claim format (reference-compatible): {"pub": [...], "sub": [...],
+    "all": [...]} of topic filters with %c/%u placeholders.
+    """
+
+    name = "client_acl"
+
+    def authorize(self, ci: ClientInfo, action: str, topic: str) -> str:
+        acl = ci.attrs.get("acl")
+        if not acl:
+            return NOMATCH
+        key = "pub" if action == PUB else "sub"
+        allowed = list(acl.get(key, [])) + list(acl.get("all", []))
+        for t in allowed:
+            t = t.replace("%c", ci.clientid).replace("%u", ci.username or "")
+            if topiclib.match(topic, t) or topic == t:
+                return ALLOW
+        return DENY  # an ACL claim is a whitelist
+
+
+class HttpSource(AuthzSource):
+    name = "http"
+
+    def __init__(self, url: str, request_fn: Optional[Callable] = None, timeout: float = 5.0):
+        self.url = url
+        self.timeout = timeout
+        self.request_fn = request_fn or self._default_request
+
+    def _default_request(self, body: Dict[str, Any]) -> Tuple[int, bytes]:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.status, resp.read()
+
+    def authorize(self, ci: ClientInfo, action: str, topic: str) -> str:
+        try:
+            status, raw = self.request_fn(
+                {
+                    "clientid": ci.clientid,
+                    "username": ci.username,
+                    "action": action,
+                    "topic": topic,
+                }
+            )
+        except Exception:
+            return NOMATCH
+        if status == 204:
+            return ALLOW
+        if status != 200:
+            return NOMATCH
+        try:
+            result = json.loads(raw).get("result", "ignore")
+        except Exception:
+            return NOMATCH
+        return {"allow": ALLOW, "deny": DENY}.get(result, NOMATCH)
+
+
+class AuthzChain:
+    """Source list evaluated in order; default verdict on no match.
+
+    Registered on 'client.authorize' (the facade's hook,
+    `emqx_access_control.erl:31-68`).
+    """
+
+    def __init__(self, default: str = ALLOW):
+        self.sources: List[AuthzSource] = []
+        self.default = default
+
+    def add(self, s: AuthzSource, front: bool = False) -> None:
+        if front:
+            self.sources.insert(0, s)
+        else:
+            self.sources.append(s)
+
+    def remove(self, name: str) -> None:
+        self.sources = [s for s in self.sources if s.name != name]
+
+    def __call__(self, ci: ClientInfo, action: str, topic: str, acc):
+        for s in self.sources:
+            if not s.enabled:
+                continue
+            v = s.authorize(ci, action, topic)
+            if v in (ALLOW, DENY):
+                return (STOP, v)
+        return (STOP, self.default)
+
+    def install(self, hooks: Hooks, priority: int = 0) -> None:
+        hooks.put("client.authorize", self, priority)
+
+    def uninstall(self, hooks: Hooks) -> None:
+        hooks.delete("client.authorize", self)

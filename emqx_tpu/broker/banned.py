@@ -1,0 +1,108 @@
+"""Ban table + flapping detection.
+
+Analog of `emqx_banned.erl` / `emqx_flapping.erl` (SURVEY.md §2.1): banned
+clientids/usernames/peerhosts are rejected at CONNECT via the
+'client.connect' hook; clients that connect/disconnect too fast get
+auto-banned for a cooldown window.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from .access_control import ALLOW, DENY, ClientInfo
+from .hooks import Hooks, STOP
+
+
+@dataclass
+class BanEntry:
+    kind: str  # clientid | username | peerhost
+    value: str
+    reason: str = ""
+    by: str = "admin"
+    until: float = float("inf")
+
+
+class Banned:
+    def __init__(self) -> None:
+        self._t: Dict[Tuple[str, str], BanEntry] = {}
+
+    def create(self, kind: str, value: str, reason: str = "", by: str = "admin",
+               duration: Optional[float] = None) -> BanEntry:
+        until = time.time() + duration if duration else float("inf")
+        e = BanEntry(kind, value, reason, by, until)
+        self._t[(kind, value)] = e
+        return e
+
+    def delete(self, kind: str, value: str) -> bool:
+        return self._t.pop((kind, value), None) is not None
+
+    def look_up(self, kind: str, value: str) -> Optional[BanEntry]:
+        e = self._t.get((kind, value))
+        if e and e.until <= time.time():
+            del self._t[(kind, value)]
+            return None
+        return e
+
+    def check(self, ci: ClientInfo) -> bool:
+        """True if the client is banned."""
+        host = ci.peerhost.split(":")[0]
+        return any(
+            self.look_up(k, v) is not None
+            for k, v in (
+                ("clientid", ci.clientid),
+                ("username", ci.username or ""),
+                ("peerhost", host),
+            )
+        )
+
+    def all(self):
+        now = time.time()
+        return [e for e in self._t.values() if e.until > now]
+
+    def __call__(self, ci: ClientInfo, acc):
+        if self.check(ci):
+            return (STOP, DENY)
+        return None
+
+    def install(self, hooks: Hooks, priority: int = 100) -> None:
+        hooks.put("client.connect", self, priority)
+
+
+class Flapping:
+    """Detect rapid reconnect cycles and auto-ban (`emqx_flapping.erl`)."""
+
+    def __init__(
+        self,
+        banned: Banned,
+        max_count: int = 15,
+        window: float = 60.0,
+        ban_duration: float = 300.0,
+    ):
+        self.banned = banned
+        self.max_count = max_count
+        self.window = window
+        self.ban_duration = ban_duration
+        self._hits: Dict[str, list] = {}
+
+    def on_disconnect(self, ci: ClientInfo, *_args) -> None:
+        now = time.time()
+        hits = self._hits.setdefault(ci.clientid, [])
+        hits.append(now)
+        cutoff = now - self.window
+        while hits and hits[0] < cutoff:
+            hits.pop(0)
+        if len(hits) >= self.max_count:
+            self.banned.create(
+                "clientid",
+                ci.clientid,
+                reason="flapping",
+                by="flapping_detector",
+                duration=self.ban_duration,
+            )
+            del self._hits[ci.clientid]
+
+    def install(self, hooks: Hooks) -> None:
+        hooks.put("client.disconnected", self.on_disconnect)

@@ -1,0 +1,103 @@
+"""Publish batcher: aggregate concurrent publishes into one device match.
+
+This is the TPU-native replacement for the reference's per-message hot loop
+(`emqx_broker:publish` -> `emqx_router:match_routes`, one ETS walk per
+message): publishes from all connections are drained into a tick batch and
+matched on device in a single static-shape kernel call (BASELINE.json: "on
+each tick the plugin drains the publish mailbox, ships a batch of topic
+strings to a TPU-resident topic-matching automaton").
+
+Latency/throughput trade: a batch closes either when `max_batch` messages
+are pending or `max_delay` elapses after the first message of the tick —
+the small-tick policy that keeps p99 inside the latency budget
+(SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional, Tuple
+
+from .broker import Broker
+from .message import Message
+
+
+class PublishBatcher:
+    def __init__(
+        self,
+        broker: Broker,
+        max_batch: int = 4096,
+        max_delay: float = 0.002,
+    ):
+        self.broker = broker
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+        self._q: List[Tuple[Message, asyncio.Future]] = []
+        self._wakeup: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+        self.ticks = 0
+        self.batched_messages = 0
+
+    def start(self) -> None:
+        if self._task is None:
+            self._wakeup = asyncio.Event()
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        self._flush_now()
+
+    def submit(self, msg: Message) -> "asyncio.Future[int]":
+        """Queue a message for the next tick; resolves to delivery count."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._q.append((msg, fut))
+        if self._task is None or self._task.done():
+            self._task = None  # restart after a crashed tick
+            self.start()
+        self._wakeup.set()
+        if len(self._q) >= self.max_batch:
+            self._flush_now()
+        return fut
+
+    def _flush_now(self) -> None:
+        batch, self._q = self._q, []
+        if not batch:
+            return
+        self.ticks += 1
+        self.batched_messages += len(batch)
+        try:
+            results = self.broker.publish_many([m for m, _ in batch])
+        except Exception as e:
+            # a failed tick must never strand futures (acks would hang)
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        for (m, fut), n in zip(batch, results):
+            if not fut.done():
+                fut.set_result(n)
+
+    async def _run(self) -> None:
+        import logging
+
+        log = logging.getLogger("emqx_tpu.batcher")
+        while True:
+            await self._wakeup.wait()
+            self._wakeup.clear()
+            if not self._q:
+                continue
+            # tick window: let concurrent publishers join the batch
+            try:
+                await asyncio.sleep(self.max_delay)
+                self._flush_now()
+            except asyncio.CancelledError:
+                self._flush_now()
+                raise
+            except Exception:  # keep the batcher alive at all costs
+                log.exception("batch tick failed")

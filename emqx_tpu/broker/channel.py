@@ -84,6 +84,10 @@ class Channel:
         self.out_cb = lambda actions: None
         self.on_kick = None
         self._will_on_normal = False
+        # Optional async publish path (PublishBatcher.submit). When set,
+        # publish acks are deferred via ('ack_async', future, builder)
+        # actions so a whole tick of publishes shares one device match.
+        self.publish_fn = None
 
     # ------------------------------------------------------------- helpers
 
@@ -181,6 +185,9 @@ class Channel:
             )
         self._m("authentication.success")
         self.clientinfo.is_superuser = bool(auth.get("is_superuser"))
+        for k in ("acl", "expire_at"):
+            if k in auth:
+                self.clientinfo.attrs[k] = auth[k]
 
         if self.broker.hooks.run_fold("client.connect", (self.clientinfo,), ALLOW) == DENY:
             return self._connack_fail(ReasonCode.BANNED)
@@ -310,21 +317,31 @@ class Channel:
         )
 
         if p.qos == 0:
-            self.broker.publish(msg)
+            if self.publish_fn is not None:
+                self.publish_fn(msg)  # batched; no ack to produce
+            else:
+                self.broker.publish(msg)
             return []
         if p.qos == 1:
-            n = self.broker.publish(msg)
-            rc = 0 if n else (ReasonCode.NO_MATCHING_SUBSCRIBERS if self.v5 else 0)
-            self._m("packets.puback.sent")
-            return [("send", pkt.PubAck(packet_id=p.packet_id, reason_code=rc))]
+            return self._pub_ack(msg, p.packet_id, pkt.PubAck, "packets.puback.sent")
         # qos 2
         try:
             self.session.publish_qos2(p.packet_id)
         except SessionError as e:
             return [("send", pkt.PubRec(packet_id=p.packet_id, reason_code=e.reason_code))]
-        n = self.broker.publish(msg)
-        rc = 0 if n else (ReasonCode.NO_MATCHING_SUBSCRIBERS if self.v5 else 0)
-        return [("send", pkt.PubRec(packet_id=p.packet_id, reason_code=rc))]
+        return self._pub_ack(msg, p.packet_id, pkt.PubRec, "packets.pubrec.sent")
+
+    def _pub_ack(self, msg: Message, packet_id: int, cls, metric: str) -> List[Action]:
+        """Ack a qos>0 publish; deferred when the batched path is active."""
+
+        def mk(n: int):
+            self._m(metric)
+            rc = 0 if n else (ReasonCode.NO_MATCHING_SUBSCRIBERS if self.v5 else 0)
+            return cls(packet_id=packet_id, reason_code=rc)
+
+        if self.publish_fn is not None:
+            return [("ack_async", self.publish_fn(msg), mk)]
+        return [("send", mk(self.broker.publish(msg)))]
 
     def _puberr(self, p: pkt.Publish, rc: int) -> List[Action]:
         """Error response appropriate to the publish qos/version."""

@@ -50,6 +50,8 @@ class Connection:
         self._normal = False
         self._last_rx = time.monotonic()
         self._retry_task: Optional[asyncio.Task] = None
+        # asyncio allows only one drain() waiter per transport
+        self._drain_lock = asyncio.Lock()
 
     # -- outbound ---------------------------------------------------------
 
@@ -64,10 +66,29 @@ class Connection:
                     self.channel.broker.metrics.inc("bytes.sent", len(data))
                 except Exception:
                     log.exception("serialize/send failed")
+            elif kind == "ack_async":
+                fut, builder = action[1], action[2]
+                asyncio.ensure_future(self._ack_when_done(fut, builder))
             elif kind == "close":
                 self._closing = arg if arg is not None else -1
                 self._normal = arg is None
             # 'connected' is informational
+
+    async def _ack_when_done(self, fut, builder) -> None:
+        """Deferred publish ack: wait for the batched match, then respond."""
+        try:
+            n = await fut
+        except Exception:
+            n = 0
+        p = builder(n)
+        if p is not None and self._closing is None:
+            try:
+                data = serialize(p, self.channel.proto_ver)
+                self.writer.write(data)
+                self.channel.broker.metrics.inc("bytes.sent", len(data))
+                await self._drain()
+            except Exception:
+                pass
 
     def _on_kick(self, rc: int) -> None:
         if self.channel.v5:
@@ -130,7 +151,8 @@ class Connection:
 
     async def _drain(self) -> None:
         try:
-            await self.writer.drain()
+            async with self._drain_lock:
+                await self.writer.drain()
         except (ConnectionResetError, BrokenPipeError):
             self._closing = self._closing or -1
 
@@ -169,14 +191,19 @@ class Listener:
         port: int = 1883,
         config: Optional[ChannelConfig] = None,
         max_connections: int = 0,
+        batcher=None,  # PublishBatcher: batch publishes across connections
+        housekeeping_interval: float = 1.0,
     ):
         self.broker = broker
         self.host = host
         self.port = port
         self.config = config
         self.max_connections = max_connections
+        self.batcher = batcher
+        self.housekeeping_interval = housekeeping_interval
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
+        self._hk_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -184,7 +211,45 @@ class Listener:
         )
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]  # resolve port 0
+        if self.batcher is not None:
+            self.batcher.start()
+        # broker-global timers run once per broker, not once per listener
+        if getattr(self.broker, "_hk_owner", None) is None:
+            self.broker._hk_owner = self
+            self._hk_task = asyncio.create_task(self._housekeeping())
         log.info("mqtt listener on %s:%s", self.host, self.port)
+
+    async def _housekeeping(self) -> None:
+        """Periodic broker timers: QoS retries, awaiting-rel expiry, auth
+        expiry, pending-session eviction, retained GC (`emqx_session`
+        timers + `emqx_cm`/retainer GC processes in the reference)."""
+        n = 0
+        while True:
+            await asyncio.sleep(self.housekeeping_interval)
+            n += 1
+            try:
+                now = time.time()
+                for ch in list(self.broker.cm.channels.values()):
+                    try:
+                        exp = ch.clientinfo.attrs.get("expire_at")
+                        if exp is not None and now >= exp:
+                            # credential expired: force disconnect
+                            self.broker.cm.kick_session(
+                                ch.clientid, pkt.ReasonCode.NOT_AUTHORIZED
+                            )
+                            continue
+                        acts = ch.handle_retry() + ch.handle_expire_awaiting_rel()
+                        if acts:
+                            ch.out_cb(acts)
+                    except Exception:
+                        log.exception(
+                            "housekeeping for %s", getattr(ch, "clientid", "?")
+                        )
+                self.broker.cm.evict_expired()
+                if n % 60 == 0:
+                    self.broker.retainer.clean_expired()
+            except Exception:
+                log.exception("housekeeping tick failed")
 
     async def _on_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -193,6 +258,8 @@ class Listener:
             writer.close()
             return
         conn = Connection(self.broker, reader, writer, self.config)
+        if self.batcher is not None:
+            conn.channel.publish_fn = self.batcher.submit
         task = asyncio.current_task()
         self._conns.add(task)
         try:
@@ -201,6 +268,12 @@ class Listener:
             self._conns.discard(task)
 
     async def stop(self) -> None:
+        if self._hk_task:
+            self._hk_task.cancel()
+            if getattr(self.broker, "_hk_owner", None) is self:
+                self.broker._hk_owner = None
+        if self.batcher is not None:
+            await self.batcher.stop()
         if self._server:
             self._server.close()
         # Python 3.12: Server.wait_closed() waits for all connection

@@ -1,0 +1,3 @@
+"""Typed config system: schema, store, zones, env overrides."""
+
+from .config import Config, ConfigError, SCHEMA  # noqa: F401

@@ -1,0 +1,316 @@
+"""Config schema + store.
+
+Analog of `emqx_config.erl` + `emqx_schema.erl` + zones (SURVEY.md §5.6):
+
+* a typed schema tree (field name -> Field(type, default, validator));
+* `Config.load(dict)` checks/translates raw config against the schema;
+* environment overrides: `EMQX_TPU__MQTT__MAX_PACKET_SIZE=2097152`
+  (double-underscore path separator, mirroring EMQX_<PATH> env overrides);
+* dotted-path get/put with change-handler callbacks
+  (`emqx_config_handler` analog);
+* zones: named overlays over the `mqtt` namespace applied per listener
+  (`emqx_config.erl:61-66`, `emqx_zone_schema.erl`).
+
+The same schema drives the REST API's config endpoints and their OpenAPI
+description (`emqx_dashboard_swagger.erl:57-76` single-source-of-truth).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from dataclasses import dataclass, field as dfield
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass
+class Field:
+    type: str  # int | float | bool | str | enum | map | list | duration | bytesize
+    default: Any = None
+    enum: Optional[List[str]] = None
+    min: Optional[float] = None
+    max: Optional[float] = None
+    desc: str = ""
+
+    def check(self, path: str, value: Any) -> Any:
+        t = self.type
+        try:
+            if t == "int":
+                if isinstance(value, str):
+                    value = int(value)
+                if isinstance(value, bool) or not isinstance(value, int):
+                    raise ConfigError(f"{path}: expected int, got {value!r}")
+            elif t == "float":
+                value = float(value)
+            elif t == "bool":
+                if isinstance(value, str):
+                    value = value.lower() in ("true", "1", "on", "yes")
+                value = bool(value)
+            elif t == "str":
+                value = str(value)
+            elif t == "enum":
+                value = str(value)
+                if self.enum and value not in self.enum:
+                    raise ConfigError(f"{path}: {value!r} not in {self.enum}")
+            elif t == "duration":  # "30s" / "5m" / "1h" -> seconds
+                value = parse_duration(value)
+            elif t == "bytesize":  # "1MB" -> bytes
+                value = parse_bytesize(value)
+            elif t == "map":
+                if isinstance(value, str):
+                    value = json.loads(value)
+                if not isinstance(value, dict):
+                    raise ConfigError(f"{path}: expected map")
+            elif t == "list":
+                if isinstance(value, str):
+                    value = json.loads(value)
+                if not isinstance(value, list):
+                    raise ConfigError(f"{path}: expected list")
+        except (ValueError, json.JSONDecodeError) as e:
+            raise ConfigError(f"{path}: {e}")
+        if self.min is not None and value < self.min:
+            raise ConfigError(f"{path}: {value} < min {self.min}")
+        if self.max is not None and value > self.max:
+            raise ConfigError(f"{path}: {value} > max {self.max}")
+        return value
+
+
+def parse_duration(v: Union[str, int, float]) -> float:
+    if isinstance(v, (int, float)):
+        return float(v)
+    units = {"ms": 0.001, "s": 1, "m": 60, "h": 3600, "d": 86400}
+    for suffix in sorted(units, key=len, reverse=True):
+        if v.endswith(suffix):
+            return float(v[: -len(suffix)]) * units[suffix]
+    return float(v)
+
+
+def parse_bytesize(v: Union[str, int]) -> int:
+    if isinstance(v, int):
+        return v
+    units = {"KB": 1 << 10, "MB": 1 << 20, "GB": 1 << 30, "B": 1}
+    up = v.upper()
+    for suffix in ("KB", "MB", "GB", "B"):
+        if up.endswith(suffix):
+            return int(float(up[: -len(suffix)]) * units[suffix])
+    return int(v)
+
+
+# ------------------------------------------------------------------ schema
+
+SCHEMA: Dict[str, Dict[str, Field]] = {
+    "mqtt": {
+        "max_packet_size": Field("bytesize", 1 << 20, desc="max MQTT packet size"),
+        "max_clientid_len": Field("int", 65535, min=23),
+        "max_topic_levels": Field("int", 128, min=1),
+        "max_qos_allowed": Field("int", 2, min=0, max=2),
+        "max_topic_alias": Field("int", 65535, min=0),
+        "retain_available": Field("bool", True),
+        "wildcard_subscription": Field("bool", True),
+        "shared_subscription": Field("bool", True),
+        "max_inflight": Field("int", 32, min=1),
+        "max_mqueue_len": Field("int", 1000, min=0),
+        "mqueue_store_qos0": Field("bool", True),
+        "upgrade_qos": Field("bool", False),
+        "retry_interval": Field("duration", 30.0),
+        "max_awaiting_rel": Field("int", 100, min=0),
+        "await_rel_timeout": Field("duration", 300.0),
+        "session_expiry_interval": Field("duration", 7200.0),
+        "keepalive_backoff": Field("float", 1.5, min=0.5),
+        "server_keepalive": Field("int", 0, min=0, desc="0 = client value"),
+        "idle_timeout": Field("duration", 15.0),
+    },
+    "broker": {
+        "shared_subscription_strategy": Field(
+            "enum",
+            "random",
+            enum=["random", "round_robin", "sticky", "hash_clientid", "hash_topic"],
+        ),
+        "batch_max": Field("int", 4096, min=1, desc="publish batch tick size"),
+        "batch_delay": Field("duration", 0.002),
+        "sys_msg_interval": Field("duration", 60.0),
+        "sys_heartbeat_interval": Field("duration", 30.0),
+    },
+    "engine": {
+        "max_levels": Field("int", 16, min=4, max=32, desc="device trie level cap"),
+        "min_batch": Field("int", 64, min=1),
+        "n_sub_shards": Field("int", 1024, min=8),
+    },
+    "retainer": {
+        "enable": Field("bool", True),
+        "max_retained_messages": Field("int", 0, min=0),
+        "max_payload_size": Field("bytesize", 1 << 20),
+    },
+    "delayed": {"enable": Field("bool", True), "max_delayed_messages": Field("int", 0)},
+    "authn": {"enable": Field("bool", False), "allow_anonymous": Field("bool", True)},
+    "authz": {
+        "enable": Field("bool", False),
+        "no_match": Field("enum", "allow", enum=["allow", "deny"]),
+        "deny_action": Field("enum", "ignore", enum=["ignore", "disconnect"]),
+        "cache_enable": Field("bool", True),
+        "cache_max_size": Field("int", 32, min=1),
+        "cache_ttl": Field("duration", 60.0),
+    },
+    "flapping_detect": {
+        "enable": Field("bool", False),
+        "max_count": Field("int", 15),
+        "window_time": Field("duration", 60.0),
+        "ban_time": Field("duration", 300.0),
+    },
+    "force_shutdown": {
+        "enable": Field("bool", True),
+        "max_message_queue_len": Field("int", 10000),
+    },
+    "stats": {"enable": Field("bool", True)},
+    "dashboard": {
+        "listen_port": Field("int", 18083),
+        "default_username": Field("str", "admin"),
+        "default_password": Field("str", "public"),
+        "token_expired_time": Field("duration", 3600.0),
+    },
+}
+
+ENV_PREFIX = "EMQX_TPU__"
+
+
+class Config:
+    """Checked config store with zones + change handlers."""
+
+    def __init__(self, raw: Optional[Dict[str, Any]] = None, env: bool = True):
+        self._conf: Dict[str, Dict[str, Any]] = {}
+        self._zones: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self._handlers: Dict[str, List[Callable]] = {}
+        self.load(raw or {}, env=env)
+
+    # ------------------------------------------------------------- load
+
+    def load(self, raw: Dict[str, Any], env: bool = True) -> None:
+        conf: Dict[str, Dict[str, Any]] = {}
+        for ns, fields in SCHEMA.items():
+            conf[ns] = {}
+            raw_ns = raw.get(ns, {})
+            unknown = set(raw_ns) - set(fields)
+            if unknown:
+                raise ConfigError(f"unknown config keys in {ns}: {sorted(unknown)}")
+            for name, f in fields.items():
+                if name in raw_ns:
+                    conf[ns][name] = f.check(f"{ns}.{name}", raw_ns[name])
+                else:
+                    conf[ns][name] = copy.deepcopy(f.default)
+        self._conf = conf
+        # zones: named overlays over 'mqtt'
+        self._zones = {}
+        for zname, overrides in (raw.get("zones") or {}).items():
+            self._add_zone(zname, overrides)
+        if env:
+            self._apply_env()
+
+    def _add_zone(self, zname: str, overrides: Dict[str, Any]) -> None:
+        zconf: Dict[str, Dict[str, Any]] = {}
+        for ns, kv in overrides.items():
+            if ns not in SCHEMA:
+                raise ConfigError(f"zone {zname}: unknown namespace {ns}")
+            zconf[ns] = {}
+            for name, value in kv.items():
+                if name not in SCHEMA[ns]:
+                    raise ConfigError(f"zone {zname}: unknown key {ns}.{name}")
+                zconf[ns][name] = SCHEMA[ns][name].check(f"{zname}.{ns}.{name}", value)
+        self._zones[zname] = zconf
+
+    def _apply_env(self) -> None:
+        for key, val in os.environ.items():
+            if not key.startswith(ENV_PREFIX):
+                continue
+            path = key[len(ENV_PREFIX):].lower().split("__")
+            if len(path) != 2:
+                continue
+            ns, name = path
+            if ns in SCHEMA and name in SCHEMA[ns]:
+                self._conf[ns][name] = SCHEMA[ns][name].check(f"{ns}.{name}", val)
+
+    # -------------------------------------------------------------- get
+
+    def get(self, path: str, zone: Optional[str] = None, default: Any = None) -> Any:
+        ns, _, name = path.partition(".")
+        if not name:
+            out = dict(self._conf.get(ns, {}))
+            if zone and zone in self._zones:
+                out.update(self._zones[zone].get(ns, {}))
+            return out
+        if zone and zone in self._zones:
+            zv = self._zones[zone].get(ns, {})
+            if name in zv:
+                return zv[name]
+        return self._conf.get(ns, {}).get(name, default)
+
+    def put(self, path: str, value: Any) -> Any:
+        ns, _, name = path.partition(".")
+        if ns not in SCHEMA or name not in SCHEMA[ns]:
+            raise ConfigError(f"unknown config path {path}")
+        value = SCHEMA[ns][name].check(path, value)
+        old = self._conf[ns].get(name)
+        self._conf[ns][name] = value
+        for prefix in (ns, path):
+            for h in self._handlers.get(prefix, []):
+                h(path, old, value)
+        return value
+
+    def dump(self) -> Dict[str, Dict[str, Any]]:
+        return copy.deepcopy(self._conf)
+
+    def zones(self) -> List[str]:
+        return list(self._zones)
+
+    # --------------------------------------------------- change handlers
+
+    def on_change(self, path_prefix: str, handler: Callable) -> None:
+        """handler(path, old, new) on put() under the prefix
+        (`emqx_config_handler` analog)."""
+        self._handlers.setdefault(path_prefix, []).append(handler)
+
+    # -------------------------------------------------------- describe
+
+    @staticmethod
+    def describe() -> Dict[str, Any]:
+        """Schema description — drives the REST config API docs."""
+        out: Dict[str, Any] = {}
+        for ns, fields in SCHEMA.items():
+            out[ns] = {
+                name: {
+                    "type": f.type,
+                    "default": f.default,
+                    **({"enum": f.enum} if f.enum else {}),
+                    **({"desc": f.desc} if f.desc else {}),
+                }
+                for name, f in fields.items()
+            }
+        return out
+
+
+def channel_config_from(conf: Config, zone: Optional[str] = None):
+    """Build a ChannelConfig from the mqtt namespace (+zone overlay)."""
+    from ..broker.channel import ChannelConfig
+
+    m = conf.get("mqtt", zone=zone)
+    return ChannelConfig(
+        max_inflight=m["max_inflight"],
+        max_mqueue=m["max_mqueue_len"],
+        max_awaiting_rel=m["max_awaiting_rel"],
+        await_rel_timeout=m["await_rel_timeout"],
+        retry_interval=m["retry_interval"],
+        upgrade_qos=m["upgrade_qos"],
+        max_qos_allowed=m["max_qos_allowed"],
+        retain_available=m["retain_available"],
+        wildcard_sub_available=m["wildcard_subscription"],
+        shared_sub_available=m["shared_subscription"],
+        max_topic_levels=m["max_topic_levels"],
+        max_session_expiry=int(m["session_expiry_interval"]),
+        max_topic_alias=m["max_topic_alias"],
+        server_keepalive=m["server_keepalive"] or None,
+        max_clientid_len=m["max_clientid_len"],
+    )

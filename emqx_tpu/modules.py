@@ -1,0 +1,202 @@
+"""Built-in broker modules: delayed publish, topic rewrite, auto-subscribe,
+topic metrics.
+
+Analog of `apps/emqx_modules` (SURVEY.md §2.2): each module is a small
+hook-driven component over the broker core.
+"""
+
+from __future__ import annotations
+
+import heapq
+import re
+import time
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Tuple
+
+from .broker import topic as topiclib
+from .broker.broker import Broker
+from .broker.hooks import Hooks
+from .broker.message import Message
+from .broker.packet import SubOpts
+
+
+# ------------------------------------------------------------ delayed pub
+
+class DelayedPublish:
+    """`$delayed/<sec>/<topic>` scheduling (`emqx_delayed.erl`).
+
+    A publish to `$delayed/5/a/b` is withheld and re-published to `a/b`
+    after 5 seconds.  Driven either by `tick()` (tests, housekeeping loop)
+    or an asyncio runner.
+    """
+
+    PREFIX = "$delayed/"
+    MAX_DELAY = 4294967.0
+
+    def __init__(self, broker: Broker, enable: bool = True):
+        self.broker = broker
+        self.enable = enable
+        self._heap: List[Tuple[float, int, Message]] = []
+        self._seq = 0
+
+    def on_message_publish(self, msg: Message):
+        if not self.enable or not isinstance(msg, Message):
+            return None
+        if not msg.topic.startswith(self.PREFIX):
+            return None
+        rest = msg.topic[len(self.PREFIX):]
+        delay_s, sep, real = rest.partition("/")
+        try:
+            delay = min(float(delay_s), self.MAX_DELAY)
+        except ValueError:
+            return None
+        if not sep or not real:
+            return None
+        out = replace(msg, topic=real, headers=dict(msg.headers, allow_publish=False, delayed=delay))
+        self._seq += 1
+        heapq.heappush(self._heap, (time.time() + delay, self._seq, replace(out, headers=dict(msg.headers))))
+        return out  # fold: broker sees allow_publish=False and drops it now
+
+    def tick(self, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.time()
+        n = 0
+        while self._heap and self._heap[0][0] <= now:
+            _, _, msg = heapq.heappop(self._heap)
+            self.broker.publish(msg)
+            n += 1
+        return n
+
+    @property
+    def pending(self) -> int:
+        return len(self._heap)
+
+    def install(self, hooks: Hooks) -> None:
+        hooks.put("message.publish", self.on_message_publish, priority=50)
+
+
+# ---------------------------------------------------------- topic rewrite
+
+@dataclass
+class RewriteRule:
+    action: str  # publish | subscribe | all
+    source: str  # topic filter selecting affected topics
+    regex: str
+    dest: str  # template with \1 backrefs + %c/%u
+
+
+class TopicRewrite:
+    """`emqx_rewrite.erl`: regex rewrite of publish topics and
+    subscribe filters."""
+
+    def __init__(self, rules: Optional[List[RewriteRule]] = None):
+        self.rules = rules or []
+
+    def _rewrite(self, topic: str, action: str, clientid: str = "", username: str = "") -> str:
+        for r in self.rules:
+            if r.action not in ("all", action):
+                continue
+            if not topiclib.match(topic, r.source):
+                continue
+            m = re.match(r.regex, topic)
+            if m:
+                dest = r.dest.replace("%c", clientid).replace("%u", username or "")
+                try:
+                    return m.expand(dest.replace("$", "\\"))
+                except re.error:
+                    return dest
+        return topic
+
+    def on_message_publish(self, msg: Message):
+        if not isinstance(msg, Message):
+            return None
+        new_topic = self._rewrite(msg.topic, "publish", msg.from_client, msg.from_username or "")
+        if new_topic != msg.topic:
+            return replace(msg, topic=new_topic)
+        return None
+
+    def on_client_subscribe(self, clientinfo, props, filters):
+        out = []
+        for tf, opts in filters:
+            out.append(
+                (self._rewrite(tf, "subscribe", clientinfo.clientid, clientinfo.username or ""), opts)
+            )
+        return out
+
+    def install(self, hooks: Hooks) -> None:
+        hooks.put("message.publish", self.on_message_publish, priority=60)
+        hooks.put("client.subscribe", self.on_client_subscribe, priority=60)
+
+
+# --------------------------------------------------------- auto-subscribe
+
+class AutoSubscribe:
+    """Server-side subscriptions applied at connect
+    (`apps/emqx_auto_subscribe`)."""
+
+    def __init__(self, broker: Broker, topics: List[Tuple[str, SubOpts]]):
+        self.broker = broker
+        self.topics = topics
+
+    def on_client_connected(self, clientinfo, *_):
+        ch = self.broker.cm.lookup(clientinfo.clientid)
+        if ch is None or ch.session is None:
+            return None
+        for tf, opts in self.topics:
+            tf = tf.replace("%c", clientinfo.clientid).replace(
+                "%u", clientinfo.username or ""
+            )
+            if ch.session.subscribe(tf, opts):
+                self.broker.subscribe(clientinfo.clientid, tf, opts)
+        return None
+
+    def install(self, hooks: Hooks) -> None:
+        hooks.put("client.connected", self.on_client_connected)
+
+
+# ---------------------------------------------------------- topic metrics
+
+class TopicMetrics:
+    """Per-registered-topic counters (`emqx_topic_metrics.erl`)."""
+
+    MAX_TOPICS = 512
+
+    def __init__(self):
+        self.topics: Dict[str, Dict[str, int]] = {}
+
+    def register(self, topic: str) -> bool:
+        if len(self.topics) >= self.MAX_TOPICS:
+            return False
+        self.topics.setdefault(
+            topic, {"messages.in": 0, "messages.out": 0, "messages.qos0.in": 0,
+                    "messages.qos1.in": 0, "messages.qos2.in": 0, "messages.dropped": 0}
+        )
+        return True
+
+    def unregister(self, topic: str) -> None:
+        self.topics.pop(topic, None)
+
+    def on_message_publish(self, msg: Message):
+        if isinstance(msg, Message):
+            m = self.topics.get(msg.topic)
+            if m is not None:
+                m["messages.in"] += 1
+                m[f"messages.qos{msg.qos}.in"] += 1
+        return None
+
+    def on_message_delivered(self, clientid, msg):
+        m = self.topics.get(msg.topic)
+        if m is not None:
+            m["messages.out"] += 1
+        return None
+
+    def on_message_dropped(self, msg, reason):
+        if msg is not None:
+            m = self.topics.get(msg.topic)
+            if m is not None:
+                m["messages.dropped"] += 1
+        return None
+
+    def install(self, hooks: Hooks) -> None:
+        hooks.put("message.publish", self.on_message_publish, priority=40)
+        hooks.put("message.delivered", self.on_message_delivered)
+        hooks.put("message.dropped", self.on_message_dropped)

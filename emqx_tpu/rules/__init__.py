@@ -1,0 +1,4 @@
+"""Rule engine: SQL over broker event streams (apps/emqx_rule_engine analog)."""
+
+from .engine import Rule, RuleEngine  # noqa: F401
+from .sql import parse_sql, SqlError  # noqa: F401

@@ -1,0 +1,421 @@
+"""Rule engine runtime: events -> SQL eval -> outputs.
+
+Analog of `emqx_rule_engine` (`emqx_rule_runtime.erl:48-143` apply_rules,
+`emqx_rule_events.erl` event->topic mapping): rules select over broker
+events; matching events are transformed by the SQL selection and fed to
+outputs (republish, console, or arbitrary python callables — the bridge
+integration point).
+
+Event topics (reference-compatible):
+    t/# ...                 -> 'message.publish' on matching topics
+    $events/message_delivered, $events/message_acked,
+    $events/message_dropped, $events/client_connected,
+    $events/client_disconnected, $events/session_subscribed,
+    $events/session_unsubscribed
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import logging
+import time
+from dataclasses import dataclass, field as dfield
+from typing import Any, Callable, Dict, List, Optional
+
+from ..broker import topic as topiclib
+from ..broker.broker import Broker
+from ..broker.message import Message
+from .funcs import FUNCS
+from .sql import BinOp, Call, Case, Field, Lit, Not, Query, SelectItem, SqlError, parse_sql
+
+log = logging.getLogger("emqx_tpu.rules")
+
+EVENT_TOPICS = {
+    "$events/message_delivered": "message.delivered",
+    "$events/message_acked": "message.acked",
+    "$events/message_dropped": "message.dropped",
+    "$events/client_connected": "client.connected",
+    "$events/client_disconnected": "client.disconnected",
+    "$events/session_subscribed": "session.subscribed",
+    "$events/session_unsubscribed": "session.unsubscribed",
+}
+
+
+# ------------------------------------------------------------- evaluation
+
+class EvalError(Exception):
+    pass
+
+
+def _get_path(env: Dict[str, Any], path: List[str]) -> Any:
+    cur: Any = env
+    for i, seg in enumerate(path):
+        if isinstance(cur, (bytes, str)) and i > 0:
+            # auto-decode json payloads on nested access (reference behavior)
+            try:
+                cur = json.loads(cur if isinstance(cur, str) else cur.decode())
+            except Exception:
+                return None
+        if isinstance(cur, dict):
+            cur = cur.get(seg)
+        elif isinstance(cur, list):
+            try:
+                cur = cur[int(seg)]
+            except (ValueError, IndexError):
+                return None
+        else:
+            return None
+    if isinstance(cur, bytes):
+        try:
+            cur = cur.decode("utf-8")
+        except UnicodeDecodeError:
+            pass
+    return cur
+
+
+def eval_expr(node: Any, env: Dict[str, Any]) -> Any:
+    if isinstance(node, Lit):
+        return node.value
+    if isinstance(node, Field):
+        return _get_path(env, node.path)
+    if isinstance(node, Not):
+        return not eval_expr(node.expr, env)
+    if isinstance(node, Case):
+        for cond, val in node.whens:
+            if eval_expr(cond, env):
+                return eval_expr(val, env)
+        return eval_expr(node.default, env) if node.default is not None else None
+    if isinstance(node, Call):
+        f = FUNCS.get(node.fn)
+        if f is None:
+            raise EvalError(f"unknown function {node.fn!r}")
+        if node.fn == "-":  # unary minus encoded as 0 - x
+            a, b = (eval_expr(x, env) for x in node.args)
+            return a - b
+        return f(*[eval_expr(a, env) for a in node.args])
+    if isinstance(node, BinOp):
+        op = node.op
+        if op == "and":
+            return bool(eval_expr(node.left, env)) and bool(eval_expr(node.right, env))
+        if op == "or":
+            return bool(eval_expr(node.left, env)) or bool(eval_expr(node.right, env))
+        l = eval_expr(node.left, env)
+        r = eval_expr(node.right, env)
+        if op == "=":
+            return _loose_eq(l, r)
+        if op == "!=":
+            return not _loose_eq(l, r)
+        if op == "like":
+            return fnmatch.fnmatch(str(l), str(r).replace("%", "*"))
+        try:
+            if op == ">":
+                return l > r
+            if op == "<":
+                return l < r
+            if op == ">=":
+                return l >= r
+            if op == "<=":
+                return l <= r
+            if op == "+":
+                if isinstance(l, str) or isinstance(r, str):
+                    return f"{l}{r}"
+                return l + r
+            if op == "-":
+                return l - r
+            if op == "*":
+                return l * r
+            if op == "/":
+                return l / r
+            if op == "div":
+                return int(l) // int(r)
+            if op == "mod":
+                return int(l) % int(r)
+        except TypeError:
+            return None
+        raise EvalError(f"unknown operator {op!r}")
+    raise EvalError(f"bad AST node {node!r}")
+
+
+def _loose_eq(l: Any, r: Any) -> bool:
+    if isinstance(l, (int, float)) and isinstance(r, str):
+        try:
+            return float(r) == l
+        except ValueError:
+            return False
+    if isinstance(r, (int, float)) and isinstance(l, str):
+        try:
+            return float(l) == r
+        except ValueError:
+            return False
+    return l == r
+
+
+def run_select(q: Query, env: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Apply WHERE + selection; returns the output map or None."""
+    if q.where is not None and not eval_expr(q.where, env):
+        return None
+    if not q.selection:
+        return {k: v for k, v in env.items() if not k.startswith("__")}
+    out: Dict[str, Any] = {}
+    for item in q.selection:
+        val = eval_expr(item.expr, env)
+        if item.alias:
+            out[item.alias] = val
+        elif isinstance(item.expr, Field):
+            out[item.expr.path[-1]] = val
+        else:
+            out[f"col{len(out)}"] = val
+    return out
+
+
+# ----------------------------------------------------------------- outputs
+
+@dataclass
+class Republish:
+    topic_template: str  # ${field} placeholders
+    payload_template: str = "${payload}"
+    qos: int = 0
+    retain: bool = False
+
+    def __call__(self, broker: Broker, selected: Dict[str, Any], env: Dict[str, Any]) -> None:
+        topic = render_template(self.topic_template, selected, env)
+        payload = render_template(self.payload_template, selected, env)
+        broker.publish(
+            Message(
+                topic=topic,
+                payload=payload.encode() if isinstance(payload, str) else payload,
+                qos=self.qos,
+                retain=self.retain,
+                from_client="rule_engine",
+                headers={"republish_by": "rule"},
+            )
+        )
+
+
+@dataclass
+class Console:
+    sink: List = dfield(default_factory=list)
+
+    def __call__(self, broker: Broker, selected: Dict[str, Any], env: Dict[str, Any]) -> None:
+        self.sink.append(selected)
+        log.info("[rule console] %s", selected)
+
+
+def render_template(tpl: str, selected: Dict[str, Any], env: Dict[str, Any]) -> str:
+    """`${a.b}` placeholder substitution (emqx_placeholder analog)."""
+    import re
+
+    def sub(m):
+        path = m.group(1).split(".")
+        v = _get_path(selected, path)
+        if v is None:
+            v = _get_path(env, path)
+        if v is None:
+            return ""
+        if isinstance(v, bytes):
+            return v.decode("utf-8", "replace")
+        if isinstance(v, (dict, list)):
+            return json.dumps(v)
+        return str(v)
+
+    if tpl == "${.}":
+        return json.dumps(selected)
+    return re.sub(r"\$\{([^}]+)\}", sub, tpl)
+
+
+# -------------------------------------------------------------------- rule
+
+@dataclass
+class Rule:
+    rule_id: str
+    sql: str
+    outputs: List[Callable] = dfield(default_factory=list)
+    enabled: bool = True
+    description: str = ""
+    query: Query = None  # parsed lazily
+    metrics: Dict[str, int] = dfield(
+        default_factory=lambda: {"matched": 0, "passed": 0, "failed": 0, "no_result": 0}
+    )
+
+    def __post_init__(self):
+        if self.query is None:
+            self.query = parse_sql(self.sql)
+
+
+class RuleEngine:
+    def __init__(self, broker: Broker):
+        self.broker = broker
+        self.rules: Dict[str, Rule] = {}
+        self._installed = False
+
+    # management ----------------------------------------------------------
+
+    def create_rule(
+        self,
+        rule_id: str,
+        sql: str,
+        outputs: List[Callable],
+        description: str = "",
+    ) -> Rule:
+        rule = Rule(rule_id=rule_id, sql=sql, outputs=outputs, description=description)
+        self.rules[rule_id] = rule
+        self._ensure_hooks()
+        return rule
+
+    def delete_rule(self, rule_id: str) -> bool:
+        return self.rules.pop(rule_id, None) is not None
+
+    def get_rule(self, rule_id: str) -> Optional[Rule]:
+        return self.rules.get(rule_id)
+
+    # hook plumbing -------------------------------------------------------
+
+    def _ensure_hooks(self) -> None:
+        if self._installed:
+            return
+        h = self.broker.hooks
+        h.put("message.publish", self._on_publish, priority=-10)
+        h.put("message.delivered", self._on_delivered)
+        h.put("message.acked", self._on_acked)
+        h.put("message.dropped", self._on_dropped)
+        h.put("client.connected", self._on_connected)
+        h.put("client.disconnected", self._on_disconnected)
+        h.put("session.subscribed", self._on_subscribed)
+        h.put("session.unsubscribed", self._on_unsubscribed)
+        self._installed = True
+
+    # event adapters ------------------------------------------------------
+
+    def _msg_env(self, msg: Message, event: str) -> Dict[str, Any]:
+        return {
+            "event": event,
+            "id": msg.mid.hex(),
+            "topic": msg.topic,
+            "payload": msg.payload,
+            "qos": msg.qos,
+            "retain": msg.retain,
+            "clientid": msg.from_client,
+            "username": msg.from_username,
+            "flags": {"retain": msg.retain, "dup": msg.dup},
+            "timestamp": msg.timestamp,
+            "publish_received_at": msg.timestamp,
+            "node": "local",
+        }
+
+    def _on_publish(self, msg):
+        if (
+            isinstance(msg, Message)
+            and not msg.topic.startswith("$events/")
+            # a rule's own republish must not re-trigger rules (loop guard,
+            # mirrors the reference's republish flag check)
+            and msg.headers.get("republish_by") != "rule"
+        ):
+            self._apply("message.publish", self._msg_env(msg, "message.publish"), msg.topic)
+        return None
+
+    def _on_delivered(self, clientid, msg):
+        env = self._msg_env(msg, "message.delivered")
+        env["to_clientid"] = clientid
+        self._apply("message.delivered", env)
+
+    def _on_acked(self, clientid, msg):
+        env = self._msg_env(msg, "message.acked")
+        env["to_clientid"] = clientid
+        self._apply("message.acked", env)
+
+    def _on_dropped(self, msg, reason):
+        if msg is None:
+            return
+        env = self._msg_env(msg, "message.dropped")
+        env["reason"] = reason
+        self._apply("message.dropped", env)
+
+    def _on_connected(self, clientinfo, *_):
+        self._apply(
+            "client.connected",
+            {
+                "event": "client.connected",
+                "clientid": clientinfo.clientid,
+                "username": clientinfo.username,
+                "peerhost": clientinfo.peerhost,
+                "proto_ver": clientinfo.proto_ver,
+                "timestamp": int(time.time() * 1000),
+                "node": "local",
+            },
+        )
+
+    def _on_disconnected(self, clientinfo, normal=True, *_):
+        self._apply(
+            "client.disconnected",
+            {
+                "event": "client.disconnected",
+                "clientid": clientinfo.clientid,
+                "username": clientinfo.username,
+                "reason": "normal" if normal else "abnormal",
+                "timestamp": int(time.time() * 1000),
+                "node": "local",
+            },
+        )
+
+    def _on_subscribed(self, clientid, filt, opts):
+        self._apply(
+            "session.subscribed",
+            {
+                "event": "session.subscribed",
+                "clientid": clientid,
+                "topic": filt,
+                "qos": getattr(opts, "qos", 0),
+                "timestamp": int(time.time() * 1000),
+                "node": "local",
+            },
+        )
+
+    def _on_unsubscribed(self, clientid, filt):
+        self._apply(
+            "session.unsubscribed",
+            {
+                "event": "session.unsubscribed",
+                "clientid": clientid,
+                "topic": filt,
+                "timestamp": int(time.time() * 1000),
+                "node": "local",
+            },
+        )
+
+    # core ----------------------------------------------------------------
+
+    def _rule_matches_event(self, rule: Rule, event: str, topic: Optional[str]) -> bool:
+        for t in rule.query.topics:
+            mapped = EVENT_TOPICS.get(t)
+            if mapped is not None:
+                if mapped == event:
+                    return True
+            elif event == "message.publish" and topic is not None:
+                if topiclib.match(topic, t):
+                    return True
+        return False
+
+    def _apply(self, event: str, env: Dict[str, Any], topic: Optional[str] = None) -> None:
+        for rule in self.rules.values():
+            if not rule.enabled:
+                continue
+            if not self._rule_matches_event(rule, event, topic):
+                continue
+            rule.metrics["matched"] += 1
+            try:
+                selected = run_select(rule.query, env)
+            except Exception:
+                rule.metrics["failed"] += 1
+                log.exception("rule %s SQL failed", rule.rule_id)
+                continue
+            if selected is None:
+                rule.metrics["no_result"] += 1
+                continue
+            rule.metrics["passed"] += 1
+            for out in rule.outputs:
+                try:
+                    out(self.broker, selected, env)
+                except Exception:
+                    rule.metrics["failed"] += 1
+                    log.exception("rule %s output failed", rule.rule_id)

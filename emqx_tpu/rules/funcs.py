@@ -1,0 +1,146 @@
+"""SQL function stdlib for the rule engine (`emqx_rule_funcs.erl` analog)."""
+
+from __future__ import annotations
+
+import base64
+import fnmatch
+import hashlib
+import json
+import time
+import uuid
+from typing import Any, Callable, Dict
+
+from ..broker import topic as topiclib
+
+
+def _num(x: Any) -> float:
+    if isinstance(x, bool):
+        return int(x)
+    if isinstance(x, (int, float)):
+        return x
+    return float(x)
+
+
+FUNCS: Dict[str, Callable] = {}
+
+
+def fn(name):
+    def deco(f):
+        FUNCS[name] = f
+        return f
+
+    return deco
+
+
+# strings ---------------------------------------------------------------
+fn("upper")(lambda s: str(s).upper())
+fn("lower")(lambda s: str(s).lower())
+fn("trim")(lambda s: str(s).strip())
+fn("ltrim")(lambda s: str(s).lstrip())
+fn("rtrim")(lambda s: str(s).rstrip())
+fn("reverse")(lambda s: str(s)[::-1])
+fn("strlen")(lambda s: len(str(s)))
+fn("concat")(lambda *a: "".join(str(x) for x in a))
+
+
+@fn("substr")
+def _substr(s, start, length=None):
+    s = str(s)
+    start = int(start)
+    return s[start : start + int(length)] if length is not None else s[start:]
+
+
+@fn("split")
+def _split(s, sep=" ", index=None):
+    parts = str(s).split(str(sep))
+    return parts if index is None else parts[int(index)]
+
+
+fn("replace")(lambda s, a, b: str(s).replace(str(a), str(b)))
+fn("regex_match")(lambda s, p: __import__("re").search(p, str(s)) is not None)
+fn("regex_replace")(lambda s, p, r: __import__("re").sub(p, r, str(s)))
+fn("ascii")(lambda c: ord(str(c)[0]))
+fn("find")(lambda s, sub: str(s).find(str(sub)))
+fn("pad")(lambda s, n, c=" ": str(s).ljust(int(n), str(c)))
+fn("sprintf")(lambda f, *a: str(f) % a)
+
+# numbers ---------------------------------------------------------------
+fn("abs")(lambda x: abs(_num(x)))
+fn("ceil")(lambda x: __import__("math").ceil(_num(x)))
+fn("floor")(lambda x: __import__("math").floor(_num(x)))
+fn("round")(lambda x: round(_num(x)))
+fn("sqrt")(lambda x: __import__("math").sqrt(_num(x)))
+fn("power")(lambda x, y: _num(x) ** _num(y))
+fn("random")(lambda: __import__("random").random())
+fn("range")(lambda a, b: list(range(int(a), int(b) + 1)))
+
+# type conversion -------------------------------------------------------
+fn("str")(lambda x: x.decode("utf-8", "replace") if isinstance(x, bytes) else str(x))
+fn("int")(lambda x: int(_num(x)))
+fn("float")(lambda x: float(_num(x)))
+fn("bool")(lambda x: bool(x))
+fn("is_null")(lambda x: x is None)
+fn("is_not_null")(lambda x: x is not None)
+fn("is_num")(lambda x: isinstance(x, (int, float)) and not isinstance(x, bool))
+fn("is_str")(lambda x: isinstance(x, str))
+fn("is_bool")(lambda x: isinstance(x, bool))
+fn("is_map")(lambda x: isinstance(x, dict))
+fn("is_array")(lambda x: isinstance(x, list))
+
+
+@fn("coalesce")
+def _coalesce(*args):
+    for a in args:
+        if a is not None and a != "":
+            return a
+    return None
+
+
+# maps / arrays ---------------------------------------------------------
+fn("map_get")(lambda k, m, default=None: (m or {}).get(k, default))
+fn("map_put")(lambda k, v, m: {**(m or {}), k: v})
+fn("map_keys")(lambda m: list((m or {}).keys()))
+fn("map_values")(lambda m: list((m or {}).values()))
+fn("contains")(lambda x, arr: x in (arr or []))
+fn("nth")(lambda i, arr: (arr or [])[int(i) - 1])  # 1-indexed like the reference
+fn("length")(lambda arr: len(arr or []))
+fn("sublist")(lambda n, arr: (arr or [])[: int(n)])
+fn("first")(lambda arr: (arr or [None])[0])
+fn("last")(lambda arr: (arr or [None])[-1])
+
+# json ------------------------------------------------------------------
+fn("json_decode")(lambda s: json.loads(s if isinstance(s, str) else bytes(s).decode()))
+fn("json_encode")(lambda x: json.dumps(x))
+
+# hashing / encoding ----------------------------------------------------
+def _to_bytes(x):
+    return x if isinstance(x, bytes) else str(x).encode()
+
+fn("md5")(lambda x: hashlib.md5(_to_bytes(x)).hexdigest())
+fn("sha")(lambda x: hashlib.sha1(_to_bytes(x)).hexdigest())
+fn("sha256")(lambda x: hashlib.sha256(_to_bytes(x)).hexdigest())
+fn("base64_encode")(lambda x: base64.b64encode(_to_bytes(x)).decode())
+fn("base64_decode")(lambda x: base64.b64decode(x))
+fn("bin2hexstr")(lambda x: _to_bytes(x).hex())
+fn("hexstr2bin")(lambda s: bytes.fromhex(str(s)))
+
+# time / id -------------------------------------------------------------
+fn("now_timestamp")(lambda unit="second": int(time.time() * (1000 if unit == "millisecond" else 1)))
+fn("timezone_to_second")(lambda tz: 0)
+fn("uuid_v4")(lambda: str(uuid.uuid4()))
+
+# topic -----------------------------------------------------------------
+fn("topic_match")(lambda name, filt: topiclib.match(str(name), str(filt)))
+
+
+@fn("nth_topic_level")
+def _nth_topic_level(i, topic):
+    ws = topiclib.words(str(topic))
+    i = int(i)
+    return ws[i - 1] if 1 <= i <= len(ws) else None
+
+
+# operators used internally --------------------------------------------
+@fn("__in__")
+def _in(x, *items):
+    return x in items

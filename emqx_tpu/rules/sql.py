@@ -1,0 +1,337 @@
+"""SQL parser for rules — the `rulesql` dependency analog.
+
+Grammar subset (mirrors the reference's rule SQL):
+
+    SELECT <selection> FROM <topics> [WHERE <condition>]
+
+    selection := * | expr [AS alias] {, expr [AS alias]}
+    topics    := "str" {, "str"}
+    expr      := literal | field path (payload.x.y, topic, clientid...)
+               | fn(args...) | expr op expr | (expr)
+    ops       := = != <> > < >= <= + - * / div mod and or not like
+
+Produces an AST evaluated by `emqx_tpu.rules.engine` against event maps.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple, Union
+
+
+class SqlError(Exception):
+    pass
+
+
+# ------------------------------------------------------------------ lexer
+
+TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<string>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
+  | (?P<number>\d+\.\d+|\d+)
+  | (?P<op><>|>=|<=|!=|=|>|<|\+|-|\*|/|\(|\)|,|\.)
+  | (?P<name>[A-Za-z_$][A-Za-z0-9_$]*)
+""",
+    re.VERBOSE,
+)
+
+KEYWORDS = {"select", "from", "where", "as", "and", "or", "not", "div", "mod",
+            "like", "in", "true", "false", "null", "case", "when", "then",
+            "else", "end"}
+
+
+@dataclass
+class Tok:
+    kind: str  # string|number|op|name|kw
+    val: str
+
+
+def tokenize(sql: str) -> List[Tok]:
+    out: List[Tok] = []
+    pos = 0
+    while pos < len(sql):
+        m = TOKEN_RE.match(sql, pos)
+        if not m:
+            raise SqlError(f"bad character at {pos}: {sql[pos:pos+10]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        val = m.group()
+        if kind == "name" and val.lower() in KEYWORDS:
+            out.append(Tok("kw", val.lower()))
+        else:
+            out.append(Tok(kind, val))
+    return out
+
+
+# ------------------------------------------------------------------- AST
+
+@dataclass
+class Lit:
+    value: Any
+
+
+@dataclass
+class Field:
+    path: List[str]  # e.g. ["payload", "temp"]
+
+
+@dataclass
+class Call:
+    fn: str
+    args: List[Any]
+
+
+@dataclass
+class BinOp:
+    op: str
+    left: Any
+    right: Any
+
+
+@dataclass
+class Not:
+    expr: Any
+
+
+@dataclass
+class Case:
+    whens: List[Tuple[Any, Any]]
+    default: Optional[Any]
+
+
+@dataclass
+class SelectItem:
+    expr: Any
+    alias: Optional[str]  # None for '*'
+
+
+@dataclass
+class Query:
+    selection: List[SelectItem]  # empty = SELECT *
+    topics: List[str]
+    where: Optional[Any]
+
+
+# ----------------------------------------------------------------- parser
+
+class _Parser:
+    def __init__(self, toks: List[Tok]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self) -> Optional[Tok]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> Tok:
+        t = self.peek()
+        if t is None:
+            raise SqlError("unexpected end of SQL")
+        self.i += 1
+        return t
+
+    def expect_kw(self, kw: str) -> None:
+        t = self.next()
+        if t.kind != "kw" or t.val != kw:
+            raise SqlError(f"expected {kw.upper()}, got {t.val!r}")
+
+    def accept_kw(self, kw: str) -> bool:
+        t = self.peek()
+        if t and t.kind == "kw" and t.val == kw:
+            self.i += 1
+            return True
+        return False
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t and t.kind == "op" and t.val == op:
+            self.i += 1
+            return True
+        return False
+
+    # grammar ------------------------------------------------------------
+
+    def parse_query(self) -> Query:
+        self.expect_kw("select")
+        selection = self.parse_selection()
+        self.expect_kw("from")
+        topics = self.parse_topics()
+        where = None
+        if self.accept_kw("where"):
+            where = self.parse_expr()
+        if self.peek() is not None:
+            raise SqlError(f"trailing tokens at {self.peek().val!r}")
+        return Query(selection, topics, where)
+
+    def parse_selection(self) -> List[SelectItem]:
+        if self.accept_op("*"):
+            items: List[SelectItem] = []
+            if self.accept_op(","):
+                items = self.parse_select_items()
+            return items  # [] = select-all
+        return self.parse_select_items()
+
+    def parse_select_items(self) -> List[SelectItem]:
+        items = [self.parse_select_item()]
+        while self.accept_op(","):
+            if self.accept_op("*"):
+                continue
+            items.append(self.parse_select_item())
+        return items
+
+    def parse_select_item(self) -> SelectItem:
+        expr = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            t = self.next()
+            if t.kind not in ("name", "string"):
+                raise SqlError(f"bad alias {t.val!r}")
+            alias = _unquote(t.val) if t.kind == "string" else t.val
+        return SelectItem(expr, alias)
+
+    def parse_topics(self) -> List[str]:
+        topics = []
+        while True:
+            t = self.next()
+            if t.kind == "string":
+                topics.append(_unquote(t.val))
+            elif t.kind == "name":
+                topics.append(t.val)
+            else:
+                raise SqlError(f"bad FROM topic {t.val!r}")
+            if not self.accept_op(","):
+                return topics
+
+    # precedence: or < and < not < cmp < add < mul < unary < primary
+    def parse_expr(self) -> Any:
+        return self.parse_or()
+
+    def parse_or(self) -> Any:
+        e = self.parse_and()
+        while self.accept_kw("or"):
+            e = BinOp("or", e, self.parse_and())
+        return e
+
+    def parse_and(self) -> Any:
+        e = self.parse_not()
+        while self.accept_kw("and"):
+            e = BinOp("and", e, self.parse_not())
+        return e
+
+    def parse_not(self) -> Any:
+        if self.accept_kw("not"):
+            return Not(self.parse_not())
+        return self.parse_cmp()
+
+    def parse_cmp(self) -> Any:
+        e = self.parse_add()
+        t = self.peek()
+        if t and t.kind == "op" and t.val in ("=", "!=", "<>", ">", "<", ">=", "<="):
+            self.i += 1
+            op = "!=" if t.val == "<>" else t.val
+            return BinOp(op, e, self.parse_add())
+        if t and t.kind == "kw" and t.val == "like":
+            self.i += 1
+            return BinOp("like", e, self.parse_add())
+        if t and t.kind == "kw" and t.val == "in":
+            self.i += 1
+            if not self.accept_op("("):
+                raise SqlError("expected ( after IN")
+            items = [self.parse_expr()]
+            while self.accept_op(","):
+                items.append(self.parse_expr())
+            if not self.accept_op(")"):
+                raise SqlError("expected ) after IN list")
+            return Call("__in__", [e, *items])
+        return e
+
+    def parse_add(self) -> Any:
+        e = self.parse_mul()
+        while True:
+            t = self.peek()
+            if t and t.kind == "op" and t.val in ("+", "-"):
+                self.i += 1
+                e = BinOp(t.val, e, self.parse_mul())
+            else:
+                return e
+
+    def parse_mul(self) -> Any:
+        e = self.parse_unary()
+        while True:
+            t = self.peek()
+            if t and ((t.kind == "op" and t.val in ("*", "/")) or (t.kind == "kw" and t.val in ("div", "mod"))):
+                self.i += 1
+                e = BinOp(t.val, e, self.parse_unary())
+            else:
+                return e
+
+    def parse_unary(self) -> Any:
+        if self.accept_op("-"):
+            return Call("-", [Lit(0), self.parse_unary()])
+        return self.parse_primary()
+
+    def parse_primary(self) -> Any:
+        t = self.next()
+        if t.kind == "string":
+            return Lit(_unquote(t.val))
+        if t.kind == "number":
+            return Lit(float(t.val) if "." in t.val else int(t.val))
+        if t.kind == "kw":
+            if t.val == "true":
+                return Lit(True)
+            if t.val == "false":
+                return Lit(False)
+            if t.val == "null":
+                return Lit(None)
+            if t.val == "case":
+                return self.parse_case()
+            raise SqlError(f"unexpected keyword {t.val!r}")
+        if t.kind == "op" and t.val == "(":
+            e = self.parse_expr()
+            if not self.accept_op(")"):
+                raise SqlError("expected )")
+            return e
+        if t.kind == "name":
+            # function call?
+            if self.accept_op("("):
+                args: List[Any] = []
+                if not self.accept_op(")"):
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                    if not self.accept_op(")"):
+                        raise SqlError("expected ) after args")
+                return Call(t.val, args)
+            # dotted field path
+            path = [t.val]
+            while self.accept_op("."):
+                nt = self.next()
+                if nt.kind not in ("name", "number"):
+                    raise SqlError(f"bad path segment {nt.val!r}")
+                path.append(nt.val)
+            return Field(path)
+        raise SqlError(f"unexpected token {t.val!r}")
+
+    def parse_case(self) -> Case:
+        whens = []
+        default = None
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            whens.append((cond, self.parse_expr()))
+        if self.accept_kw("else"):
+            default = self.parse_expr()
+        self.expect_kw("end")
+        return Case(whens, default)
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    return re.sub(r"\\(.)", r"\1", body)
+
+
+def parse_sql(sql: str) -> Query:
+    return _Parser(tokenize(sql)).parse_query()

@@ -1,0 +1,265 @@
+"""Authn chains, authz sources, ban/flapping, and built-in modules."""
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+
+import pytest
+
+from emqx_tpu.authn import (
+    AuthChain,
+    BuiltInAuthenticator,
+    HttpAuthenticator,
+    JwtAuthenticator,
+)
+from emqx_tpu.authz import (
+    AuthzChain,
+    BuiltInSource,
+    ClientAclSource,
+    FileSource,
+    HttpSource,
+    Rule,
+)
+from emqx_tpu.broker import packet as pkt
+from emqx_tpu.broker.access_control import ALLOW, DENY, ClientInfo
+from emqx_tpu.broker.banned import Banned, Flapping
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import MQTT_V5, PacketType, ReasonCode, SubOpts
+from emqx_tpu.modules import (
+    AutoSubscribe,
+    DelayedPublish,
+    RewriteRule,
+    TopicMetrics,
+    TopicRewrite,
+)
+
+
+def make_channel(broker, clientid="c", username=None, password=None):
+    ch = Channel(broker)
+    ch.outbox = []
+    ch.out_cb = ch.outbox.extend
+    inner = ch.handle_in
+    def wrapped(p):
+        acts = inner(p)
+        ch.outbox.extend(acts)
+        return acts
+    ch.handle_in = wrapped
+    ch.handle_in(pkt.Connect(proto_ver=MQTT_V5, clientid=clientid,
+                             username=username, password=password))
+    return ch
+
+
+def connack_rc(ch):
+    for a in ch.outbox:
+        if a[0] == "send" and a[1].type == PacketType.CONNACK:
+            return a[1].reason_code
+    return None
+
+
+# ----------------------------------------------------------------- authn
+
+def test_builtin_authn():
+    b = Broker()
+    chain = AuthChain(allow_anonymous=False)
+    auth = BuiltInAuthenticator()
+    auth.add_user("alice", "secret", is_superuser=True)
+    chain.add(auth)
+    chain.install(b.hooks)
+
+    ok = make_channel(b, "c1", username="alice", password=b"secret")
+    assert connack_rc(ok) == 0
+    assert ok.clientinfo.is_superuser
+
+    bad = make_channel(b, "c2", username="alice", password=b"wrong")
+    assert connack_rc(bad) == ReasonCode.BAD_USERNAME_OR_PASSWORD
+
+    anon = make_channel(b, "c3")
+    assert connack_rc(anon) == ReasonCode.NOT_AUTHORIZED  # anonymous denied
+
+
+def test_authn_chain_ignore_falls_through():
+    b = Broker()
+    chain = AuthChain(allow_anonymous=False)
+    a1 = BuiltInAuthenticator()  # knows nobody -> ignore
+    a2 = BuiltInAuthenticator(user_id_type="clientid")
+    a2.add_user("dev1", "pw")
+    chain.add(a1)
+    chain.add(a2)
+    chain.install(b.hooks)
+    ok = make_channel(b, "dev1", username="x", password=b"pw")
+    assert connack_rc(ok) == 0
+
+
+def make_jwt(secret, claims):
+    h = base64.urlsafe_b64encode(json.dumps({"alg": "HS256", "typ": "JWT"}).encode()).rstrip(b"=")
+    p = base64.urlsafe_b64encode(json.dumps(claims).encode()).rstrip(b"=")
+    sig = hmac.new(secret, h + b"." + p, hashlib.sha256).digest()
+    s = base64.urlsafe_b64encode(sig).rstrip(b"=")
+    return (h + b"." + p + b"." + s).decode()
+
+
+def test_jwt_authn():
+    b = Broker()
+    chain = AuthChain(allow_anonymous=False)
+    chain.add(JwtAuthenticator(secret=b"k3y", verify_claims={"sub": "${clientid}"}))
+    chain.install(b.hooks)
+
+    tok = make_jwt(b"k3y", {"sub": "dev9", "exp": time.time() + 60})
+    ok = make_channel(b, "dev9", username="ignored", password=tok.encode())
+    assert connack_rc(ok) == 0
+
+    expired = make_jwt(b"k3y", {"sub": "dev9", "exp": time.time() - 1})
+    bad = make_channel(b, "dev9", password=expired.encode())
+    assert connack_rc(bad) == ReasonCode.NOT_AUTHORIZED
+
+    forged = tok[:-4] + "AAAA"
+    bad2 = make_channel(b, "dev9", password=forged.encode())
+    assert connack_rc(bad2) == ReasonCode.NOT_AUTHORIZED
+
+
+def test_http_authn_stub():
+    b = Broker()
+    chain = AuthChain(allow_anonymous=False)
+    seen = {}
+
+    def fake(body):
+        seen.update(body)
+        if body["username"] == "good":
+            return 200, json.dumps({"result": "allow"}).encode()
+        return 200, json.dumps({"result": "deny"}).encode()
+
+    chain.add(HttpAuthenticator("http://auth.local/check", request_fn=fake))
+    chain.install(b.hooks)
+    ok = make_channel(b, "h1", username="good", password=b"x")
+    assert connack_rc(ok) == 0 and seen["clientid"] == "h1"
+    bad = make_channel(b, "h2", username="evil", password=b"x")
+    assert connack_rc(bad) == ReasonCode.NOT_AUTHORIZED
+
+
+# ----------------------------------------------------------------- authz
+
+def test_authz_file_rules():
+    b = Broker()
+    chain = AuthzChain(default=DENY)
+    chain.add(FileSource([
+        Rule("allow", "all", "subscribe", ["pub/#", "own/%c/#"]),
+        Rule("allow", ("username", "svc"), "publish", ["pub/#"]),
+        Rule("deny", "all", "all", ["#"]),
+    ]))
+    chain.install(b.hooks)
+
+    svc = make_channel(b, "svc1", username="svc")
+    acts = svc.handle_in(pkt.Publish(topic="pub/x", payload=b"1", qos=1, packet_id=1))
+    ALLOWED = (0, ReasonCode.NO_MATCHING_SUBSCRIBERS)
+    assert acts[0][1].reason_code in ALLOWED
+
+    other = make_channel(b, "o1", username="other")
+    acts = other.handle_in(pkt.Publish(topic="pub/x", payload=b"1", qos=1, packet_id=1))
+    assert acts[0][1].reason_code == ReasonCode.NOT_AUTHORIZED
+
+    acts = other.handle_in(pkt.Subscribe(packet_id=2, topic_filters=[
+        ("pub/#", SubOpts(qos=0)), ("own/o1/data", SubOpts(qos=0)),
+        ("own/sv2/data", SubOpts(qos=0))]))
+    assert acts[0][1].reason_codes == [0, 0, ReasonCode.NOT_AUTHORIZED]
+
+
+def test_authz_client_acl_from_jwt():
+    b = Broker()
+    auth_chain = AuthChain(allow_anonymous=False)
+    auth_chain.add(JwtAuthenticator(secret=b"s"))
+    auth_chain.install(b.hooks)
+    az = AuthzChain(default=ALLOW)
+    az.add(ClientAclSource())
+    az.install(b.hooks)
+
+    tok = make_jwt(b"s", {"acl": {"pub": ["data/%c"], "sub": ["cmd/#"]}})
+    ch = make_channel(b, "dev3", password=tok.encode())
+    assert connack_rc(ch) == 0
+    # ACL must have been attached to clientinfo
+    assert "acl" in ch.clientinfo.attrs
+    ok = ch.handle_in(pkt.Publish(topic="data/dev3", payload=b"1", qos=1, packet_id=1))
+    assert ok[0][1].reason_code in (0, ReasonCode.NO_MATCHING_SUBSCRIBERS)
+    bad = ch.handle_in(pkt.Publish(topic="data/other", payload=b"1", qos=1, packet_id=2))
+    assert bad[0][1].reason_code == ReasonCode.NOT_AUTHORIZED
+
+
+def test_banned_and_flapping():
+    b = Broker()
+    banned = Banned()
+    banned.install(b.hooks)
+    banned.create("clientid", "evil")
+    ch = make_channel(b, "evil")
+    assert connack_rc(ch) == ReasonCode.BANNED
+
+    flap = Flapping(banned, max_count=3, window=60, ban_duration=100)
+    flap.install(b.hooks)
+    for _ in range(3):
+        c = make_channel(b, "flappy")
+        assert connack_rc(c) == 0
+        c.terminate(normal=False)
+    c = make_channel(b, "flappy")
+    assert connack_rc(c) == ReasonCode.BANNED
+
+
+# --------------------------------------------------------------- modules
+
+def test_delayed_publish():
+    b = Broker()
+    d = DelayedPublish(b)
+    d.install(b.hooks)
+    sub = make_channel(b, "ds")
+    sub.handle_in(pkt.Subscribe(packet_id=1, topic_filters=[("late/t", SubOpts(qos=0))]))
+    sub.outbox.clear()
+    p = make_channel(b, "dp")
+    p.handle_in(pkt.Publish(topic="$delayed/5/late/t", payload=b"soon", qos=0))
+    assert not [a for a in sub.outbox if a[0] == "send"]  # withheld
+    assert d.pending == 1
+    assert d.tick(now=time.time() + 10) == 1
+    pubs = [a[1] for a in sub.outbox if a[0] == "send" and a[1].type == PacketType.PUBLISH]
+    assert pubs and pubs[0].topic == "late/t" and pubs[0].payload == b"soon"
+
+
+def test_topic_rewrite():
+    b = Broker()
+    rw = TopicRewrite([RewriteRule("all", "x/#", r"x/(.+)", r"y/\1")])
+    rw.install(b.hooks)
+    sub = make_channel(b, "rs")
+    sub.handle_in(pkt.Subscribe(packet_id=1, topic_filters=[("x/1", SubOpts(qos=0))]))
+    assert "y/1" in sub.session.subscriptions  # filter rewritten
+    sub.outbox.clear()
+    p = make_channel(b, "rp")
+    p.handle_in(pkt.Publish(topic="x/1", payload=b"m", qos=0))
+    pubs = [a[1] for a in sub.outbox if a[0] == "send" and a[1].type == PacketType.PUBLISH]
+    assert pubs and pubs[0].topic == "y/1"
+
+
+def test_auto_subscribe():
+    b = Broker()
+    asub = AutoSubscribe(b, [("inbox/%c", SubOpts(qos=1))])
+    asub.install(b.hooks)
+    ch = make_channel(b, "auto1")
+    assert "inbox/auto1" in ch.session.subscriptions
+    ch.outbox.clear()
+    p = make_channel(b, "ap")
+    p.handle_in(pkt.Publish(topic="inbox/auto1", payload=b"hi", qos=0))
+    pubs = [a[1] for a in ch.outbox if a[0] == "send" and a[1].type == PacketType.PUBLISH]
+    assert pubs and pubs[0].payload == b"hi"
+
+
+def test_topic_metrics():
+    b = Broker()
+    tm = TopicMetrics()
+    tm.install(b.hooks)
+    tm.register("tm/t")
+    sub = make_channel(b, "tms")
+    sub.handle_in(pkt.Subscribe(packet_id=1, topic_filters=[("tm/t", SubOpts(qos=0))]))
+    p = make_channel(b, "tmp")
+    p.handle_in(pkt.Publish(topic="tm/t", payload=b"1", qos=1, packet_id=1))
+    p.handle_in(pkt.Publish(topic="tm/other", payload=b"1", qos=0))
+    assert tm.topics["tm/t"]["messages.in"] == 1
+    assert tm.topics["tm/t"]["messages.qos1.in"] == 1
+    assert tm.topics["tm/t"]["messages.out"] == 1

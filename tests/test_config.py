@@ -1,0 +1,76 @@
+"""Config system: schema checking, zones, env overrides, change handlers."""
+
+import pytest
+
+from emqx_tpu.config import Config, ConfigError
+from emqx_tpu.config.config import channel_config_from, parse_bytesize, parse_duration
+
+
+def test_defaults():
+    c = Config(env=False)
+    assert c.get("mqtt.max_inflight") == 32
+    assert c.get("mqtt.max_packet_size") == 1 << 20
+    assert c.get("broker.shared_subscription_strategy") == "random"
+
+
+def test_load_and_translate():
+    c = Config({"mqtt": {"max_packet_size": "2MB", "retry_interval": "10s",
+                         "upgrade_qos": "true"}}, env=False)
+    assert c.get("mqtt.max_packet_size") == 2 << 20
+    assert c.get("mqtt.retry_interval") == 10.0
+    assert c.get("mqtt.upgrade_qos") is True
+
+
+def test_validation_errors():
+    with pytest.raises(ConfigError):
+        Config({"mqtt": {"max_qos_allowed": 5}}, env=False)
+    with pytest.raises(ConfigError):
+        Config({"mqtt": {"nonsense_key": 1}}, env=False)
+    with pytest.raises(ConfigError):
+        Config({"broker": {"shared_subscription_strategy": "alphabetical"}}, env=False)
+
+
+def test_zones():
+    c = Config(
+        {
+            "mqtt": {"max_inflight": 32},
+            "zones": {"external": {"mqtt": {"max_inflight": 8, "upgrade_qos": True}}},
+        },
+        env=False,
+    )
+    assert c.get("mqtt.max_inflight") == 32
+    assert c.get("mqtt.max_inflight", zone="external") == 8
+    assert c.get("mqtt.upgrade_qos", zone="external") is True
+    assert c.get("mqtt.max_qos_allowed", zone="external") == 2  # falls through
+    cc = channel_config_from(c, zone="external")
+    assert cc.max_inflight == 8 and cc.upgrade_qos
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("EMQX_TPU__MQTT__MAX_INFLIGHT", "7")
+    c = Config()
+    assert c.get("mqtt.max_inflight") == 7
+
+
+def test_put_and_handlers():
+    c = Config(env=False)
+    seen = []
+    c.on_change("mqtt", lambda p, old, new: seen.append((p, old, new)))
+    c.put("mqtt.max_inflight", 64)
+    assert c.get("mqtt.max_inflight") == 64
+    assert seen == [("mqtt.max_inflight", 32, 64)]
+    with pytest.raises(ConfigError):
+        c.put("mqtt.bogus", 1)
+
+
+def test_units():
+    assert parse_duration("500ms") == 0.5
+    assert parse_duration("2h") == 7200
+    assert parse_bytesize("4KB") == 4096
+    assert parse_bytesize(123) == 123
+
+
+def test_describe_covers_schema():
+    d = Config.describe()
+    assert d["mqtt"]["max_inflight"]["type"] == "int"
+    assert "enum" in d["broker"]["shared_subscription_strategy"]

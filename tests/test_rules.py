@@ -1,0 +1,145 @@
+"""Rule engine: SQL parse/eval, event matching, outputs."""
+
+import json
+
+import pytest
+
+from emqx_tpu.broker import packet as pkt
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import MQTT_V5, PacketType, SubOpts
+from emqx_tpu.rules.engine import Console, Republish, RuleEngine, run_select
+from emqx_tpu.rules.sql import SqlError, parse_sql
+
+
+def ev(**kw):
+    base = {"topic": "t/1", "payload": b'{"temp": 21.5, "ok": true}', "qos": 1,
+            "clientid": "c1", "username": "u1", "event": "message.publish"}
+    base.update(kw)
+    return base
+
+
+def test_select_star():
+    q = parse_sql('SELECT * FROM "t/#"')
+    out = run_select(q, ev())
+    assert out["topic"] == "t/1" and out["qos"] == 1
+
+
+def test_select_fields_alias_payload_path():
+    q = parse_sql('SELECT payload.temp as temp, clientid, upper(username) as U FROM "t/#"')
+    out = run_select(q, ev())
+    assert out == {"temp": 21.5, "clientid": "c1", "U": "U1"}
+
+
+def test_where_filtering():
+    q = parse_sql('SELECT clientid FROM "t/#" WHERE payload.temp > 20 and qos = 1')
+    assert run_select(q, ev()) == {"clientid": "c1"}
+    q2 = parse_sql('SELECT clientid FROM "t/#" WHERE payload.temp > 30')
+    assert run_select(q2, ev()) is None
+
+
+def test_where_like_in_case():
+    q = parse_sql("""SELECT case when qos = 1 then 'one' else 'other' end as q
+                     FROM "t/#" WHERE clientid like 'c%' and qos in (1, 2)""")
+    assert run_select(q, ev())["q"] == "one"
+
+
+def test_arith_and_funcs():
+    q = parse_sql('SELECT payload.temp * 2 + 1 as x, strlen(clientid) as n, '
+                  'nth_topic_level(2, topic) as lvl FROM "t/#"')
+    out = run_select(q, ev())
+    assert out == {"x": 44.0, "n": 2, "lvl": "1"}
+
+
+def test_bad_sql():
+    with pytest.raises(SqlError):
+        parse_sql("SELEKT * FROM x")
+    with pytest.raises(SqlError):
+        parse_sql('SELECT * FROM "t" WHERE (a = 1')
+
+
+def make_channel(broker, clientid):
+    ch = Channel(broker)
+    ch.outbox = []
+    ch.out_cb = ch.outbox.extend
+    inner = ch.handle_in
+    def wrapped(p):
+        acts = inner(p)
+        ch.outbox.extend(acts)
+        return acts
+    ch.handle_in = wrapped
+    ch.handle_in(pkt.Connect(proto_ver=MQTT_V5, clientid=clientid))
+    return ch
+
+
+def test_rule_republish_end_to_end():
+    b = Broker()
+    eng = RuleEngine(b)
+    eng.create_rule(
+        "r1",
+        'SELECT payload.temp as temp, topic FROM "sensors/#" WHERE payload.temp > 30',
+        [Republish(topic_template="alerts/${topic}",
+                   payload_template='{"hot": ${temp}}', qos=1)],
+    )
+    sub = make_channel(b, "alertee")
+    sub.handle_in(pkt.Subscribe(packet_id=1, topic_filters=[("alerts/#", SubOpts(qos=1))]))
+    sub.outbox.clear()
+    p = make_channel(b, "sensor")
+    p.handle_in(pkt.Publish(topic="sensors/room1", payload=b'{"temp": 35}', qos=0))
+    pubs = [a[1] for a in sub.outbox if a[0] == "send" and a[1].type == PacketType.PUBLISH]
+    assert len(pubs) == 1
+    assert pubs[0].topic == "alerts/sensors/room1"
+    assert json.loads(pubs[0].payload) == {"hot": 35}
+    # below threshold: no republish
+    sub.outbox.clear()
+    p.handle_in(pkt.Publish(topic="sensors/room1", payload=b'{"temp": 20}', qos=0))
+    assert not [a for a in sub.outbox if a[0] == "send"]
+    m = eng.get_rule("r1").metrics
+    assert m["matched"] == 2 and m["passed"] == 1 and m["no_result"] == 1
+
+
+def test_rule_event_client_connected():
+    b = Broker()
+    eng = RuleEngine(b)
+    console = Console()
+    eng.create_rule(
+        "r2",
+        'SELECT clientid, peerhost FROM "$events/client_connected"',
+        [console],
+    )
+    make_channel(b, "evc")
+    assert len(console.sink) == 1
+    assert console.sink[0]["clientid"] == "evc"
+
+
+def test_rule_session_subscribed_event():
+    b = Broker()
+    eng = RuleEngine(b)
+    console = Console()
+    eng.create_rule(
+        "r3",
+        'SELECT clientid, topic FROM "$events/session_subscribed" WHERE topic_match(topic, \'gps/#\')',
+        [console],
+    )
+    ch = make_channel(b, "s1")
+    ch.handle_in(pkt.Subscribe(packet_id=1, topic_filters=[("gps/car1", SubOpts(qos=0))]))
+    ch.handle_in(pkt.Subscribe(packet_id=2, topic_filters=[("other/t", SubOpts(qos=0))]))
+    assert len(console.sink) == 1
+    assert console.sink[0] == {"clientid": "s1", "topic": "gps/car1"}
+
+
+def test_rule_no_republish_loop():
+    """A republish rule matching its own output must not loop forever."""
+    b = Broker()
+    eng = RuleEngine(b)
+    eng.create_rule(
+        "loopy",
+        'SELECT * FROM "loop/#"',
+        [Republish(topic_template="loop/again", payload_template="x")],
+    )
+    # Message from rule_engine republished once; its own republish is
+    # suppressed by the republish_by header guard.
+    b.publish(Message(topic="loop/start", payload=b"go"))
+    m = eng.get_rule("loopy").metrics
+    assert m["passed"] <= 2
