@@ -59,6 +59,10 @@ class Broker:
         self._routes: Dict[int, Route] = {}  # fid -> fan-out record
         self._sub_count = 0
         self.cm.on_discard = self._on_discard_session
+        # route-table change callbacks (cluster layer announces these to
+        # peers — the `emqx_router:do_add_route` replication point)
+        self.on_route_added: Optional[callable] = None
+        self.on_route_removed: Optional[callable] = None
 
     def _on_discard_session(self, session: Session) -> None:
         """Discarded session: drop its routes (kicked channels skip this)."""
@@ -74,6 +78,8 @@ class Broker:
         route = self._routes.get(fid)
         if route is None:
             route = self._routes[fid] = Route(filt=real)
+            if self.on_route_added is not None:
+                self.on_route_added(real)
         if group is None:
             if clientid not in route.direct:
                 self._sub_count += 1
@@ -104,6 +110,8 @@ class Broker:
                     route.groups.discard(group)
             if not route.direct and not route.groups:
                 del self._routes[fid]
+                if self.on_route_removed is not None:
+                    self.on_route_removed(real)
         self.engine.remove_filter(real)
         self.metrics.gauge_set("subscriptions.count", self._sub_count)
         self.hooks.run("session.unsubscribed", (clientid, filt))
@@ -134,6 +142,14 @@ class Broker:
         Runs 'message.publish' hooks, retains, matches the whole batch on
         device in one kernel, then dispatches host-side.
         """
+        todo, results = self._prepare_publish(msgs)
+        self._match_dispatch(todo, results)
+        return results
+
+    def _prepare_publish(
+        self, msgs: Sequence[Message]
+    ) -> Tuple[List[Tuple[int, Message]], List[int]]:
+        """Hook + retain stage; returns the accepted (index, msg) list."""
         todo: List[Tuple[int, Message]] = []
         results = [0] * len(msgs)
         for i, msg in enumerate(msgs):
@@ -145,8 +161,14 @@ class Broker:
             self.retainer.on_publish(msg)
             self.metrics.inc("messages.received")
             todo.append((i, msg))
+        return todo, results
+
+    def _match_dispatch(
+        self, todo: List[Tuple[int, Message]], results: List[int]
+    ) -> None:
+        """Device-match the accepted batch and deliver locally."""
         if not todo:
-            return results
+            return
         matched = self.engine.match([m.topic for _, m in todo])
         for (i, msg), fids in zip(todo, matched):
             n = self._dispatch(msg, fids)
@@ -154,7 +176,6 @@ class Broker:
             if n == 0:
                 self.metrics.inc("messages.dropped.no_subscribers")
                 self.hooks.run("message.dropped", (msg, "no_subscribers"))
-        return results
 
     def _dispatch(self, msg: Message, fids: Set[int]) -> int:
         """Expand matched fids to receivers and deliver (`do_dispatch`)."""

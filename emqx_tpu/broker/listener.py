@@ -213,7 +213,11 @@ class Listener:
         self.port = addr[1]  # resolve port 0
         if self.batcher is not None:
             self.batcher.start()
-        # broker-global timers run once per broker, not once per listener
+        # broker-global timers run once per broker, not once per listener;
+        # the listener set lets ownership hand over when the owner stops
+        if not hasattr(self.broker, "_listeners"):
+            self.broker._listeners = set()
+        self.broker._listeners.add(self)
         if getattr(self.broker, "_hk_owner", None) is None:
             self.broker._hk_owner = self
             self._hk_task = asyncio.create_task(self._housekeeping())
@@ -268,10 +272,20 @@ class Listener:
             self._conns.discard(task)
 
     async def stop(self) -> None:
+        getattr(self.broker, "_listeners", set()).discard(self)
         if self._hk_task:
             self._hk_task.cancel()
+            self._hk_task = None
             if getattr(self.broker, "_hk_owner", None) is self:
                 self.broker._hk_owner = None
+                # hand broker housekeeping to a surviving listener
+                for other in getattr(self.broker, "_listeners", set()):
+                    if other._server is not None:
+                        self.broker._hk_owner = other
+                        other._hk_task = asyncio.create_task(
+                            other._housekeeping()
+                        )
+                        break
         if self.batcher is not None:
             await self.batcher.stop()
         if self._server:

@@ -1,0 +1,31 @@
+"""Cluster layer: membership, replicated routes, message forwarding.
+
+TPU-native redesign of the reference's three distribution planes
+(SURVEY.md §1.8, §5.8):
+
+1. Erlang distribution (control)  -> asyncio TCP peer links + RPC
+   (`emqx_tpu.cluster.transport`);
+2. gen_rpc data plane (forwards)  -> binary FORWARD frames, sync/async
+   modes (`emqx_tpu.cluster.node.ClusterNode.forward*`);
+3. mria rlog table replication    -> per-node sequenced route oplog with
+   snapshot catch-up (`emqx_tpu.cluster.routes`).
+
+Rather than a global mnesia trie, every node keeps TWO match engines:
+its local subscription engine (the Broker's) and a second
+`TopicMatchEngine` holding *remote* filters mapped to node sets — both
+run the same batched TPU match kernel, so a publish batch resolves local
+deliveries and remote forwards in two device calls.
+"""
+
+from .node import ClusterBroker, ClusterNode
+from .routes import RemoteRoutes
+from .transport import PeerLink, RpcError, Transport
+
+__all__ = [
+    "ClusterBroker",
+    "ClusterNode",
+    "RemoteRoutes",
+    "PeerLink",
+    "RpcError",
+    "Transport",
+]

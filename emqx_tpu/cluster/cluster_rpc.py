@@ -1,0 +1,105 @@
+"""Cluster-wide serialized operations — the `emqx_cluster_rpc` analog.
+
+Reference (`apps/emqx_conf/src/emqx_cluster_rpc.erl`, SURVEY.md §5.6):
+cluster config mutations are serialized through a replicated MFA log
+with a per-node commit cursor and catch-up recovery.
+
+Redesign: a deterministic coordinator (lowest node name among up peers,
+self included) assigns sequence numbers.  `multicall(op, params)` sends
+the op to the coordinator, which appends it to its log and broadcasts
+`cluster_apply`; every node applies ops strictly in order through its
+registered handler table and keeps a cursor.  A node that detects a gap
+pulls the log tail from the coordinator (`cluster_catchup`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .node import ClusterNode
+from .transport import RpcError
+
+
+class ClusterRpc:
+    def __init__(self, node: ClusterNode):
+        self.node = node
+        self.handlers: Dict[str, Callable[[dict], None]] = {}
+        # full replicated log: every node appends entries as it applies
+        # them, so any node can take over as coordinator with history
+        # intact (the reference keeps the MFA log in a replicated mnesia
+        # table for the same reason)
+        self.log: List[Tuple[int, str, dict]] = []
+        self.cursor = 0  # last applied seq
+        node.transport.rpc_handlers["cluster_commit"] = self._rpc_commit
+        node.transport.rpc_handlers["cluster_apply"] = self._rpc_apply
+        node.transport.rpc_handlers["cluster_catchup"] = self._rpc_catchup
+
+    def register(self, op: str, handler: Callable[[dict], None]) -> None:
+        self.handlers[op] = handler
+
+    def coordinator(self) -> str:
+        return min([self.node.name] + self.node.up_peers())
+
+    async def multicall(self, op: str, params: dict) -> int:
+        """Commit one op cluster-wide; returns its sequence number."""
+        coord = self.coordinator()
+        if coord == self.node.name:
+            return await self._commit(op, params)
+        resp = await self.node.call(coord, "cluster_commit", {"op": op, "params": params})
+        return resp["seq"]
+
+    async def _commit(self, op: str, params: dict) -> int:
+        seq = self.cursor + 1
+        self._apply_entry(seq, op, params)
+        entry = {"seq": seq, "op": op, "params": params}
+        for peer in self.node.up_peers():
+            try:
+                await self.node.call(peer, "cluster_apply", entry)
+            except RpcError:
+                pass  # the peer catches up on its next gap detection
+        return seq
+
+    def _apply_entry(self, seq: int, op: str, params: dict) -> bool:
+        if seq != self.cursor + 1:
+            return False
+        handler = self.handlers.get(op)
+        if handler is not None:
+            try:
+                handler(params)
+            except Exception:
+                pass  # handler failure must not wedge the log cursor
+        self.log.append((seq, op, params))
+        self.cursor = seq
+        return True
+
+    # --------------------------------------------------------- rpc handlers
+
+    async def _rpc_commit(self, peer: str, params: dict) -> dict:
+        if self.coordinator() != self.node.name:
+            raise RpcError("not the coordinator")
+        seq = await self._commit(params["op"], params["params"])
+        return {"seq": seq}
+
+    async def _rpc_apply(self, peer: str, entry: dict) -> dict:
+        ok = self._apply_entry(entry["seq"], entry["op"], entry["params"])
+        if not ok and entry["seq"] > self.cursor:
+            await self.catchup(peer)
+        return {"cursor": self.cursor}
+
+    async def catchup(self, coord: Optional[str] = None) -> None:
+        coord = coord or self.coordinator()
+        if coord == self.node.name:
+            return
+        try:
+            resp = await self.node.call(
+                coord, "cluster_catchup", {"from": self.cursor}
+            )
+        except RpcError:
+            return
+        for seq, op, params in resp.get("entries", []):
+            self._apply_entry(seq, op, params)
+
+    def _rpc_catchup(self, peer: str, params: dict) -> dict:
+        frm = params.get("from", 0)
+        return {"entries": [e for e in self.log if e[0] > frm]}

@@ -1,0 +1,351 @@
+"""ClusterNode: membership + route replication + publish forwarding.
+
+Reference behavior being matched (SURVEY.md §1.8, §3.3):
+  * static-seed membership with heartbeat failure detection (ekka
+    static discovery + `monitor_node`);
+  * route table replication (`emqx_router:do_add_route` ->
+    `?ROUTE_SHARD` rlog) — here a per-owner sequenced oplog with
+    snapshot bootstrap (`RemoteRoutes`);
+  * publish forwarding to nodes holding matching routes
+    (`emqx_broker:forward`, gen_rpc sync/async modes) — here binary
+    FORWARD frames, fire-and-forget by default, awaitable acks in
+    "sync" mode;
+  * route purge on nodedown (`emqx_router_helper:cleanup_routes`).
+
+Topology is a full mesh over the configured peer map — the reference's
+static cluster discovery (`emqx_conf_schema.erl:148-230`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..broker.broker import Broker
+from ..broker.message import Message
+from . import transport as tp
+from .routes import RemoteRoutes
+from .transport import PeerLink, RpcError, Transport
+
+
+class ClusterBroker(Broker):
+    """Broker whose publish path also forwards to matching peers."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.cluster: Optional[ClusterNode] = None
+
+    def publish_many(self, msgs: Sequence[Message]) -> List[int]:
+        todo, results = self._prepare_publish(msgs)
+        if self.cluster is not None and todo:
+            self.cluster.forward_publish([m for _, m in todo])
+        self._match_dispatch(todo, results)
+        return results
+
+    def dispatch_forwarded(self, msg: Message) -> int:
+        """Receiving side of a remote forward: local match+dispatch only —
+        no 'message.publish' hooks, no retain, no re-forward (those ran on
+        the origin node; mirrors `emqx_broker:dispatch/2` on the target)."""
+        fids = self.engine.match([msg.topic])[0]
+        n = self._dispatch(msg, fids)
+        self.metrics.inc("messages.forward.in")
+        return n
+
+
+def message_to_wire(msg: Message) -> Tuple[dict, bytes]:
+    header = {
+        "topic": msg.topic,
+        "qos": msg.qos,
+        "retain": msg.retain,
+        "dup": msg.dup,
+        "from": msg.from_client,
+        "username": msg.from_username,
+        "mid": msg.mid.hex(),
+        "ts": msg.timestamp,
+        "props": {str(k): v for k, v in msg.properties.items()
+                  if isinstance(v, (int, str, float, bool))},
+    }
+    return header, msg.payload
+
+
+def message_from_wire(header: dict, payload: bytes) -> Message:
+    props = {}
+    for k, v in (header.get("props") or {}).items():
+        try:
+            props[int(k)] = v
+        except ValueError:
+            props[k] = v
+    return Message(
+        topic=header["topic"],
+        payload=payload,
+        qos=header.get("qos", 0),
+        retain=header.get("retain", False),
+        dup=header.get("dup", False),
+        from_client=header.get("from", ""),
+        from_username=header.get("username"),
+        mid=bytes.fromhex(header["mid"]) if header.get("mid") else b"",
+        timestamp=header.get("ts", 0),
+        properties=props,
+    )
+
+
+class ClusterNode:
+    def __init__(
+        self,
+        name: str,
+        broker: ClusterBroker,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        peers: Optional[Dict[str, Tuple[str, int]]] = None,
+        heartbeat_ivl: float = 1.0,
+        miss_limit: int = 3,
+        rpc_mode: str = "async",  # forward mode: async | sync
+    ):
+        self.name = name
+        self.broker = broker
+        broker.cluster = self
+        self.incarnation = time.time_ns()
+        self.transport = Transport(name, host, port)
+        self.remote = RemoteRoutes()
+        self.peers_cfg: Dict[str, Tuple[str, int]] = dict(peers or {})
+        self.links: Dict[str, PeerLink] = {}
+        self.heartbeat_ivl = heartbeat_ivl
+        self.miss_limit = miss_limit
+        self.rpc_mode = rpc_mode
+
+        # local route oplog (this node is its single writer)
+        self.seq = 0
+        self._local_filters: Set[str] = set()
+        self._status: Dict[str, str] = {}  # peer -> up|down
+        self._resyncing: Set[str] = set()
+        self._hb_task: Optional[asyncio.Task] = None
+        self._misses: Dict[str, int] = {}
+
+        broker.on_route_added = self._route_added
+        broker.on_route_removed = self._route_removed
+        t = self.transport
+        t.on_hello = self._on_hello
+        t.on_route_op = self._on_route_op
+        t.on_snapshot_req = self._on_snapshot_req
+        t.on_forward = self._on_forward
+        t.rpc_handlers["publish"] = self._rpc_publish
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        await self.transport.start()
+        for peer, addr in self.peers_cfg.items():
+            self._add_link(peer, addr)
+        self._hb_task = asyncio.get_running_loop().create_task(self._heartbeat())
+
+    async def stop(self) -> None:
+        if self._hb_task:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        for link in self.links.values():
+            await link.stop()
+        await self.transport.stop()
+
+    def join(self, peer: str, addr: Tuple[str, int]) -> None:
+        """Add a peer at runtime (manual `cluster join`)."""
+        self.peers_cfg[peer] = addr
+        if peer not in self.links:
+            self._add_link(peer, addr)
+
+    def leave(self, peer: str) -> None:
+        self.peers_cfg.pop(peer, None)
+        link = self.links.pop(peer, None)
+        if link is not None:
+            asyncio.get_running_loop().create_task(link.stop())
+        self._node_down(peer)
+
+    def _add_link(self, peer: str, addr: Tuple[str, int]) -> None:
+        link = PeerLink(
+            self.name,
+            peer,
+            addr,
+            self.incarnation,
+            on_up=self._link_up,
+            on_down=lambda l: self._node_down(l.peer),
+        )
+        self.links[peer] = link
+        self._status.setdefault(peer, "down")
+        link.start()
+
+    # ----------------------------------------------------------- membership
+
+    def _link_up(self, link: PeerLink, hello: dict) -> None:
+        self._status[link.peer] = "up"
+        self._misses[link.peer] = 0
+        self.broker.hooks.run("node.up", (link.peer,))
+        # bootstrap that peer's routes
+        asyncio.get_running_loop().create_task(self._resync(link.peer))
+
+    def _node_down(self, peer: str) -> None:
+        if self._status.get(peer) == "down":
+            return
+        self._status[peer] = "down"
+        purged = self.remote.purge_node(peer)
+        self.broker.hooks.run("node.down", (peer, purged))
+
+    async def _heartbeat(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_ivl)
+            for peer, link in list(self.links.items()):
+                if not link.connected:
+                    continue
+                try:
+                    await link.request(tp.PING, {}, timeout=self.heartbeat_ivl * 2)
+                    self._misses[peer] = 0
+                except (RpcError, Exception):
+                    self._misses[peer] = self._misses.get(peer, 0) + 1
+                    if self._misses[peer] >= self.miss_limit:
+                        self._node_down(peer)
+
+    def status(self) -> Dict[str, str]:
+        return dict(self._status)
+
+    def up_peers(self) -> List[str]:
+        return [p for p, s in self._status.items() if s == "up"]
+
+    # -------------------------------------------------------- route oplog
+
+    def _route_added(self, filt: str) -> None:
+        self._local_filters.add(filt)
+        self.seq += 1
+        self._broadcast_op("add", filt)
+
+    def _route_removed(self, filt: str) -> None:
+        self._local_filters.discard(filt)
+        self.seq += 1
+        self._broadcast_op("del", filt)
+
+    def _broadcast_op(self, op: str, filt: str) -> None:
+        frame = tp.pack_json(
+            tp.ROUTE_OP,
+            {
+                "node": self.name,
+                "incarnation": self.incarnation,
+                "seq": self.seq,
+                "op": op,
+                "filt": filt,
+            },
+        )
+        for link in self.links.values():
+            link.send_nowait(frame)
+
+    def _on_route_op(self, peer: str, obj: dict) -> None:
+        ok = self.remote.apply_op(
+            obj["node"], obj["incarnation"], obj["seq"], obj["op"], obj["filt"]
+        )
+        if not ok:
+            asyncio.get_running_loop().create_task(self._resync(obj["node"]))
+
+    async def _resync(self, peer: str) -> None:
+        """Fetch a full route snapshot from a peer (rlog bootstrap)."""
+        if peer in self._resyncing:
+            return
+        link = self.links.get(peer)
+        if link is None or not link.connected:
+            return
+        self._resyncing.add(peer)
+        try:
+            resp = await link.request(tp.SNAPSHOT_REQ, {"node": self.name})
+            self.remote.load_snapshot(
+                peer, resp["incarnation"], resp["seq"], resp["filters"]
+            )
+            if self._status.get(peer) != "up":
+                self._status[peer] = "up"
+        except (RpcError, Exception):
+            pass
+        finally:
+            self._resyncing.discard(peer)
+
+    def _on_hello(self, peer: str, hello: dict) -> dict:
+        return {"incarnation": self.incarnation}
+
+    def _on_snapshot_req(self, peer: str, obj: dict) -> dict:
+        return {
+            "incarnation": self.incarnation,
+            "seq": self.seq,
+            "filters": sorted(self._local_filters),
+        }
+
+    # ----------------------------------------------------------- forwarding
+
+    def forward_publish(self, msgs: Sequence[Message]) -> int:
+        """Async-mode forward of a publish batch (one remote match kernel).
+
+        Fire-and-forget like `forward_async` (`emqx_broker.erl:277-292`);
+        for acked forwarding use `forward_publish_sync`.
+        """
+        per_node = self._match_remote(msgs)
+        n = 0
+        for node, node_msgs in per_node.items():
+            link = self.links.get(node)
+            if link is None or not link.connected:
+                self.broker.metrics.inc("messages.forward.dropped", len(node_msgs))
+                continue
+            for msg in node_msgs:
+                header, payload = message_to_wire(msg)
+                if link.send_nowait(tp.pack_forward(header, payload)):
+                    n += 1
+        if n:
+            self.broker.metrics.inc("messages.forward.out", n)
+        return n
+
+    async def forward_publish_sync(self, msgs: Sequence[Message]) -> int:
+        """Sync-mode forward: awaits per-message dispatch acks."""
+        per_node = self._match_remote(msgs)
+        delivered = 0
+        for node, node_msgs in per_node.items():
+            link = self.links.get(node)
+            if link is None:
+                continue
+            for msg in node_msgs:
+                header, payload = message_to_wire(msg)
+                try:
+                    ack = await link.forward_request(header, payload)
+                except RpcError:
+                    continue
+                if ack is not None:
+                    delivered += ack.get("n", 0)
+        if delivered:
+            self.broker.metrics.inc("messages.forward.out", delivered)
+        return delivered
+
+    def _match_remote(
+        self, msgs: Sequence[Message]
+    ) -> Dict[str, List[Message]]:
+        per_node: Dict[str, List[Message]] = {}
+        for msg, nodes in zip(msgs, self.remote.match([m.topic for m in msgs])):
+            for node in nodes:
+                per_node.setdefault(node, []).append(msg)
+        return per_node
+
+    def _on_forward(self, peer: str, header: dict, payload: bytes):
+        msg = message_from_wire(header, payload)
+        n = self.broker.dispatch_forwarded(msg)
+        return {"n": n} if header.get("id") is not None else None
+
+    # ------------------------------------------------------------ rpc plane
+
+    async def call(self, peer: str, method: str, params: dict, timeout: float = 5.0) -> dict:
+        link = self.links.get(peer)
+        if link is None:
+            raise RpcError(f"unknown peer {peer!r}")
+        return await link.rpc(method, params, timeout)
+
+    def _rpc_publish(self, peer: str, params: dict) -> dict:
+        """Remote-origin publish (management API proxying)."""
+        msg = Message(
+            topic=params["topic"],
+            payload=params.get("payload", "").encode(),
+            qos=params.get("qos", 0),
+            retain=params.get("retain", False),
+        )
+        return {"n": self.broker.publish(msg)}

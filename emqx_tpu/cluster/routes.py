@@ -1,0 +1,119 @@
+"""Replicated remote-route table — the mria rlog analog.
+
+Reference: `emqx_router.erl` keeps a global mria `emqx_route` bag
+(topic -> node) replicated to every core node, with wildcard filters
+additionally indexed in the mnesia trie (SURVEY.md §1.7-1.8).
+
+TPU redesign: each node is the single writer for its OWN route set and
+broadcasts a per-node monotonically-sequenced oplog (add/del filter).
+Receivers mirror each peer's set into ONE shared `TopicMatchEngine`
+(fid -> node set), so remote matching for a publish batch is the same
+batched device kernel as local matching.  Gaps or peer restarts
+(incarnation change) trigger a full snapshot fetch — the rlog
+"bootstrap then replay" recovery, with the engine as the HBM cache of
+host truth (SURVEY.md §5.4 failure model).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Set, Tuple
+
+from ..models.engine import TopicMatchEngine
+
+
+class RemoteRoutes:
+    def __init__(self, engine: TopicMatchEngine | None = None):
+        self.engine = engine or TopicMatchEngine()
+        # fid -> set of node names holding that filter
+        self._nodes_of: Dict[int, Set[str]] = {}
+        # node -> its filter set (host truth for purge/snapshot diff)
+        self._filters_of: Dict[str, Set[str]] = {}
+        # node -> (incarnation, last applied oplog seq)
+        self.applied: Dict[str, Tuple[int, int]] = {}
+
+    # ----------------------------------------------------------- mutation
+
+    def add(self, node: str, filt: str) -> None:
+        filters = self._filters_of.setdefault(node, set())
+        if filt in filters:
+            return
+        filters.add(filt)
+        fid = self.engine.add_filter(filt)
+        self._nodes_of.setdefault(fid, set()).add(node)
+
+    def delete(self, node: str, filt: str) -> None:
+        filters = self._filters_of.get(node)
+        if filters is None or filt not in filters:
+            return
+        filters.discard(filt)
+        fid = self.engine.fid_of(filt)
+        self.engine.remove_filter(filt)
+        if fid is not None:
+            nodes = self._nodes_of.get(fid)
+            if nodes is not None:
+                nodes.discard(node)
+                if not nodes:
+                    del self._nodes_of[fid]
+
+    def purge_node(self, node: str) -> int:
+        """Drop all routes of a dead node (`emqx_router_helper` cleanup)."""
+        filters = list(self._filters_of.get(node, set()))
+        for filt in filters:
+            self.delete(node, filt)
+        self._filters_of.pop(node, None)
+        self.applied.pop(node, None)
+        return len(filters)
+
+    def load_snapshot(
+        self, node: str, incarnation: int, seq: int, filters: Sequence[str]
+    ) -> None:
+        """Replace a peer's mirrored set wholesale (bootstrap/catch-up)."""
+        old = self._filters_of.get(node, set())
+        new = set(filters)
+        for filt in old - new:
+            self.delete(node, filt)
+        for filt in new - old:
+            self.add(node, filt)
+        self.applied[node] = (incarnation, seq)
+
+    def apply_op(self, node: str, incarnation: int, seq: int, op: str, filt: str) -> bool:
+        """Apply one oplog entry; False => gap/restart, caller must resync."""
+        inc, applied = self.applied.get(node, (None, None))
+        if inc != incarnation or applied is None or seq != applied + 1:
+            return False
+        if op == "add":
+            self.add(node, filt)
+        else:
+            self.delete(node, filt)
+        self.applied[node] = (incarnation, seq)
+        return True
+
+    # ------------------------------------------------------------ queries
+
+    def match(self, topics: Sequence[str]) -> List[Set[str]]:
+        """Batched device match -> set of remote nodes per topic."""
+        out: List[Set[str]] = [set() for _ in topics]
+        if not self._nodes_of:
+            return out
+        for i, fids in enumerate(self.engine.match(list(topics))):
+            for fid in fids:
+                out[i] |= self._nodes_of.get(fid, set())
+        return out
+
+    def filters_of(self, node: str) -> Set[str]:
+        return set(self._filters_of.get(node, set()))
+
+    def nodes(self) -> List[str]:
+        return [n for n, f in self._filters_of.items() if f]
+
+    @property
+    def route_count(self) -> int:
+        return sum(len(f) for f in self._filters_of.values())
+
+    def topics(self) -> Dict[str, Set[str]]:
+        """filter -> node set (REST /routes view)."""
+        out: Dict[str, Set[str]] = {}
+        for node, filters in self._filters_of.items():
+            for filt in filters:
+                out.setdefault(filt, set()).add(node)
+        return out

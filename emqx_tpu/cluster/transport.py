@@ -1,0 +1,343 @@
+"""Inter-node transport: framed asyncio TCP with RPC + push channels.
+
+The gen_rpc analog (reference `emqx_rpc.erl`, gen_rpc dep — SURVEY.md
+§1.8): every node runs one TCP server; for each peer it also dials ONE
+outbound link used for all of its originated traffic (route ops, pings,
+forwards, rpc requests).  Responses ride back on the same socket, so a
+pair of nodes uses two sockets total — one per direction — and there is
+no head-of-line blocking between control RPC and the forward data plane
+beyond the socket itself (frames are small and length-prefixed).
+
+Frame layout:  u32 len | u8 type | body
+  JSON frames: body = utf-8 JSON
+  FORWARD:     body = u16 hlen | JSON header | raw payload bytes
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import struct
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+# frame types
+HELLO = 1
+PING = 2
+PONG = 3
+ROUTE_OP = 4
+SNAPSHOT_REQ = 5
+SNAPSHOT = 6
+FORWARD = 7
+FORWARD_ACK = 8
+RPC_REQ = 9
+RPC_RESP = 10
+
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class RpcError(Exception):
+    pass
+
+
+def _pack(ftype: int, body: bytes) -> bytes:
+    return struct.pack("!IB", len(body) + 1, ftype) + body
+
+
+def pack_json(ftype: int, obj: dict) -> bytes:
+    return _pack(ftype, json.dumps(obj, separators=(",", ":")).encode())
+
+
+def pack_forward(header: dict, payload: bytes) -> bytes:
+    h = json.dumps(header, separators=(",", ":")).encode()
+    return _pack(FORWARD, struct.pack("!H", len(h)) + h + payload)
+
+
+def unpack_forward(body: bytes) -> Tuple[dict, bytes]:
+    (hlen,) = struct.unpack_from("!H", body)
+    header = json.loads(body[2 : 2 + hlen])
+    return header, body[2 + hlen :]
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Tuple[int, bytes]:
+    hdr = await reader.readexactly(4)
+    (n,) = struct.unpack("!I", hdr)
+    if not 1 <= n <= MAX_FRAME:
+        raise ConnectionError(f"bad frame length {n}")
+    body = await reader.readexactly(n)
+    return body[0], body[1:]
+
+
+class PeerLink:
+    """Outbound connection to one peer; owns reconnect + request matching."""
+
+    def __init__(
+        self,
+        self_node: str,
+        peer: str,
+        addr: Tuple[str, int],
+        incarnation: int,
+        on_up: Callable[["PeerLink", dict], None],
+        on_down: Callable[["PeerLink"], None],
+        reconnect_ivl: float = 0.5,
+    ):
+        self.self_node = self_node
+        self.peer = peer
+        self.addr = addr
+        self.incarnation = incarnation
+        self.on_up = on_up
+        self.on_down = on_down
+        self.reconnect_ivl = reconnect_ivl
+        self.connected = False
+        self.peer_hello: dict = {}
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reqs: Dict[int, asyncio.Future] = {}
+        self._req_id = itertools.count(1)
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._teardown()
+
+    async def _run(self) -> None:
+        while not self._stopped:
+            try:
+                reader, writer = await asyncio.open_connection(*self.addr)
+                self._writer = writer
+                writer.write(
+                    pack_json(
+                        HELLO,
+                        {"node": self.self_node, "incarnation": self.incarnation},
+                    )
+                )
+                await writer.drain()
+                ftype, body = await read_frame(reader)
+                if ftype != HELLO:
+                    raise ConnectionError("expected HELLO")
+                self.peer_hello = json.loads(body)
+                self.connected = True
+                self.on_up(self, self.peer_hello)
+                await self._read_loop(reader)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                pass
+            was_up = self.connected
+            self._teardown()
+            if was_up:
+                self.on_down(self)
+            if not self._stopped:
+                await asyncio.sleep(self.reconnect_ivl)
+
+    def _teardown(self) -> None:
+        self.connected = False
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+        for fut in self._reqs.values():
+            if not fut.done():
+                fut.set_exception(RpcError(f"link to {self.peer} lost"))
+        self._reqs.clear()
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        while True:
+            ftype, body = await read_frame(reader)
+            if ftype in (PONG, RPC_RESP, SNAPSHOT, FORWARD_ACK):
+                obj = json.loads(body)
+                fut = self._reqs.pop(obj.get("id", -1), None)
+                if fut is not None and not fut.done():
+                    if obj.get("error"):
+                        fut.set_exception(RpcError(obj["error"]))
+                    else:
+                        fut.set_result(obj)
+
+    # ------------------------------------------------------------ sending
+
+    def send_nowait(self, frame: bytes) -> bool:
+        """Fire-and-forget (async forward mode). False if link is down."""
+        if not self.connected or self._writer is None:
+            return False
+        try:
+            self._writer.write(frame)
+            return True
+        except Exception:
+            return False
+
+    async def request(self, ftype: int, obj: dict, timeout: float = 5.0) -> dict:
+        """Send a JSON frame and await the matching response by id."""
+        if not self.connected or self._writer is None:
+            raise RpcError(f"link to {self.peer} down")
+        rid = next(self._req_id)
+        obj = dict(obj, id=rid)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._reqs[rid] = fut
+        self._writer.write(pack_json(ftype, obj))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._reqs.pop(rid, None)
+            raise RpcError(f"timeout waiting on {self.peer}")
+
+    async def rpc(self, method: str, params: dict, timeout: float = 5.0) -> dict:
+        resp = await self.request(
+            RPC_REQ, {"method": method, "params": params}, timeout
+        )
+        return resp.get("result", {})
+
+    async def forward_request(
+        self, header: dict, payload: bytes, timeout: float = 5.0
+    ) -> Optional[dict]:
+        """Acked (sync-mode) forward; None if the link was down."""
+        if not self.connected or self._writer is None:
+            return None
+        rid = next(self._req_id)
+        header = dict(header, id=rid)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._reqs[rid] = fut
+        if not self.send_nowait(pack_forward(header, payload)):
+            self._reqs.pop(rid, None)
+            return None
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._reqs.pop(rid, None)
+            raise RpcError(f"forward timeout on {self.peer}")
+
+
+class Transport:
+    """Server side: accepts inbound links, dispatches frames to handlers.
+
+    Handlers (set by ClusterNode):
+      on_hello(peer_name, hello) -> dict          greeting response fields
+      on_route_op(peer_name, obj)
+      on_snapshot_req(peer_name, obj) -> dict
+      on_forward(peer_name, header, payload) -> Optional[dict]  ack fields
+      rpc_handlers[method](peer_name, params) -> dict | Awaitable[dict]
+    """
+
+    def __init__(self, node: str, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self.host = host
+        self.port = port
+        self.on_hello: Callable[[str, dict], dict] = lambda p, h: {}
+        self.on_route_op: Callable[[str, dict], None] = lambda p, o: None
+        self.on_snapshot_req: Callable[[str, dict], dict] = lambda p, o: {}
+        self.on_forward: Callable[[str, dict, bytes], Optional[dict]] = (
+            lambda p, h, b: None
+        )
+        self.rpc_handlers: Dict[str, Callable] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._inbound: set = set()  # live inbound writers, closed on stop
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for w in list(self._inbound):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer_name = "?"
+        self._inbound.add(writer)
+        rpc_tasks: set = set()
+        # RPC handlers may themselves RPC back over other links (e.g.
+        # cluster_commit -> cluster_apply -> cluster_catchup), so they run
+        # as tasks — the read loop keeps draining PING/FORWARD/ROUTE_OP
+        # frames meanwhile; wlock serializes interleaved response writes
+        wlock = asyncio.Lock()
+
+        async def run_rpc_bg(obj: dict) -> None:
+            resp = await self._run_rpc(peer_name, obj)
+            async with wlock:
+                writer.write(pack_json(RPC_RESP, resp))
+                await writer.drain()
+
+        try:
+            ftype, body = await read_frame(reader)
+            if ftype != HELLO:
+                return
+            hello = json.loads(body)
+            peer_name = hello.get("node", "?")
+            greeting = {"node": self.node}
+            greeting.update(self.on_hello(peer_name, hello) or {})
+            writer.write(pack_json(HELLO, greeting))
+            await writer.drain()
+            while True:
+                ftype, body = await read_frame(reader)
+                if ftype == RPC_REQ:
+                    t = asyncio.get_running_loop().create_task(
+                        run_rpc_bg(json.loads(body))
+                    )
+                    rpc_tasks.add(t)
+                    t.add_done_callback(rpc_tasks.discard)
+                    continue
+                async with wlock:
+                    if ftype == PING:
+                        obj = json.loads(body)
+                        writer.write(pack_json(PONG, {"id": obj.get("id")}))
+                    elif ftype == ROUTE_OP:
+                        self.on_route_op(peer_name, json.loads(body))
+                    elif ftype == SNAPSHOT_REQ:
+                        obj = json.loads(body)
+                        resp = self.on_snapshot_req(peer_name, obj)
+                        resp["id"] = obj.get("id")
+                        writer.write(pack_json(SNAPSHOT, resp))
+                    elif ftype == FORWARD:
+                        header, payload = unpack_forward(body)
+                        ack = self.on_forward(peer_name, header, payload)
+                        if ack is not None and header.get("id") is not None:
+                            ack["id"] = header["id"]
+                            writer.write(pack_json(FORWARD_ACK, ack))
+                    await writer.drain()
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            asyncio.CancelledError,
+        ):
+            pass
+        finally:
+            for t in rpc_tasks:
+                t.cancel()
+            self._inbound.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _run_rpc(self, peer_name: str, obj: dict) -> dict:
+        method = obj.get("method", "")
+        handler = self.rpc_handlers.get(method)
+        if handler is None:
+            return {"id": obj.get("id"), "error": f"no such method {method!r}"}
+        try:
+            result = handler(peer_name, obj.get("params") or {})
+            if isinstance(result, Awaitable):
+                result = await result
+            return {"id": obj.get("id"), "result": result or {}}
+        except Exception as e:  # rpc errors propagate to the caller
+            return {"id": obj.get("id"), "error": f"{type(e).__name__}: {e}"}

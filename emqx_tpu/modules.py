@@ -55,7 +55,12 @@ class DelayedPublish:
         out = replace(msg, topic=real, headers=dict(msg.headers, allow_publish=False, delayed=delay))
         self._seq += 1
         heapq.heappush(self._heap, (time.time() + delay, self._seq, replace(out, headers=dict(msg.headers))))
-        return out  # fold: broker sees allow_publish=False and drops it now
+        # STOP the fold (like emqx_delayed): downstream publish hooks (rule
+        # engine, metrics) must not observe the withheld message now — they
+        # run when tick() republishes it
+        from .broker.hooks import STOP
+
+        return (STOP, out)  # broker sees allow_publish=False and drops it
 
     def tick(self, now: Optional[float] = None) -> int:
         now = now if now is not None else time.time()

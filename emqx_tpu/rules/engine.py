@@ -87,12 +87,12 @@ def eval_expr(node: Any, env: Dict[str, Any]) -> Any:
                 return eval_expr(val, env)
         return eval_expr(node.default, env) if node.default is not None else None
     if isinstance(node, Call):
+        if node.fn == "-":  # unary minus encoded as 0 - x (not in FUNCS)
+            a, b = (eval_expr(x, env) for x in node.args)
+            return a - b
         f = FUNCS.get(node.fn)
         if f is None:
             raise EvalError(f"unknown function {node.fn!r}")
-        if node.fn == "-":  # unary minus encoded as 0 - x
-            a, b = (eval_expr(x, env) for x in node.args)
-            return a - b
         return f(*[eval_expr(a, env) for a in node.args])
     if isinstance(node, BinOp):
         op = node.op

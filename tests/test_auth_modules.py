@@ -263,3 +263,19 @@ def test_topic_metrics():
     assert tm.topics["tm/t"]["messages.in"] == 1
     assert tm.topics["tm/t"]["messages.qos1.in"] == 1
     assert tm.topics["tm/t"]["messages.out"] == 1
+
+
+def test_delayed_publish_stops_fold():
+    """Downstream message.publish hooks must NOT see the withheld message
+    (the reference's emqx_delayed returns {stop, ...})."""
+    b = Broker()
+    d = DelayedPublish(b)
+    d.install(b.hooks)
+    seen = []
+    b.hooks.put("message.publish", lambda m: seen.append(m.topic), priority=-10)
+    p = make_channel(b, "dp2")
+    p.handle_in(pkt.Publish(topic="$delayed/5/late/u", payload=b"x", qos=0))
+    assert seen == []  # fold stopped before low-priority hooks
+    assert d.pending == 1
+    d.tick(now=time.time() + 10)
+    assert seen == ["late/u"]  # republish runs the full chain

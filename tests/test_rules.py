@@ -143,3 +143,10 @@ def test_rule_no_republish_loop():
     b.publish(Message(topic="loop/start", payload=b"go"))
     m = eng.get_rule("loopy").metrics
     assert m["passed"] <= 2
+
+
+def test_unary_minus_in_where():
+    q = parse_sql('SELECT clientid FROM "t/#" WHERE payload.temp > -5')
+    assert run_select(q, ev()) == {"clientid": "c1"}
+    q2 = parse_sql('SELECT -qos as n FROM "t/#"')
+    assert run_select(q2, ev()) == {"n": -1}
