@@ -1,0 +1,40 @@
+"""exhook: out-of-process hook provider boundary.
+
+The reference's extension boundary (`apps/emqx_exhook`, SURVEY.md §1.9,
+§3.5): a broker bridges its 19 hookpoints to an external "HookProvider"
+service over gRPC; the provider answers valued hooks (authenticate /
+authorize / message.publish) with continue/stop decisions and observes
+the rest.  This is the integration point the TPU match engine was
+designed to ride (SURVEY.md §7.2 step 4).
+
+This package implements BOTH sides:
+
+* `manager.ExhookManager` — broker side (`emqx_exhook_server` analog):
+  per-server connection pool, OnProviderLoaded hook negotiation with
+  refcounted registration, request timeouts, failed_action deny|ignore.
+* `server.ProviderServer` — provider side: hosts a provider object
+  (e.g. `provider.TpuMatchProvider`, which mirrors subscriptions into a
+  `TopicMatchEngine` and answers publish hooks with device-matched
+  subscriber sets).
+
+Transport: length-prefixed JSON frames over TCP (`wire.py`) carrying
+the exhook.proto request/response vocabulary (same hook names, same
+valued-response semantics).  grpcio is not available in this image; if
+it is present at runtime a gRPC transport can be slotted in behind the
+same `HookClient` interface (`wire.GRPC_AVAILABLE` gates it).
+"""
+
+from .manager import ExhookManager, ExhookServerConfig
+from .provider import TpuMatchProvider
+from .server import ProviderServer, ProviderServerThread
+from .wire import HOOKPOINTS, VALUED_HOOKS
+
+__all__ = [
+    "ExhookManager",
+    "ExhookServerConfig",
+    "TpuMatchProvider",
+    "ProviderServer",
+    "ProviderServerThread",
+    "HOOKPOINTS",
+    "VALUED_HOOKS",
+]

@@ -1,0 +1,301 @@
+"""Broker-side exhook manager — `emqx_exhook_mgr`/`emqx_exhook_server` analog.
+
+Loads configured provider servers, negotiates their hook lists
+(OnProviderLoaded), bridges the broker's hookpoints to provider calls
+with refcounted registration (`emqx_exhook_server.erl:211-234`), and
+applies the per-server failure policy `failed_action: deny | ignore`
+with `request_timeout` (`:89-90,310-311`).
+
+Call semantics (`emqx_exhook.erl:38-80`):
+  * valued hooks (authenticate / authorize / message.publish) fold over
+    servers in declaration order; a "stop" response ends the chain; a
+    failed request maps to deny (or is skipped under ignore);
+  * all other hookpoints are events: shipped fire-and-forget through a
+    background dispatch thread so the broker's hot path never blocks on
+    a provider (the reference blocks its per-client process instead —
+    an asyncio broker cannot afford that).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..broker.access_control import ALLOW, DENY, ClientInfo
+from ..broker.hooks import STOP, Hooks
+from ..broker.message import Message
+from ..broker.packet import ReasonCode
+from .wire import HOOKPOINTS, VALUED_HOOKS, SyncConn
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ExhookServerConfig:
+    name: str
+    host: str
+    port: int
+    pool_size: int = 4
+    request_timeout: float = 5.0
+    failed_action: str = "deny"  # deny | ignore
+    enable: bool = True
+
+
+class _ServerState:
+    def __init__(self, cfg: ExhookServerConfig):
+        self.cfg = cfg
+        self.pool = [
+            SyncConn((cfg.host, cfg.port), cfg.request_timeout)
+            for _ in range(cfg.pool_size)
+        ]
+        self.locks = [threading.Lock() for _ in self.pool]
+        self._rr = 0
+        self.enabled_hooks: List[str] = []
+
+    def call(self, hook: str, data: dict) -> dict:
+        """One pooled request (round-robin member, per-member lock)."""
+        i = self._rr = (self._rr + 1) % len(self.pool)
+        with self.locks[i]:
+            return self.pool[i].call(hook, data)
+
+    def close(self) -> None:
+        for conn in self.pool:
+            conn.close()
+
+
+def _clientinfo_data(ci: ClientInfo) -> dict:
+    d = dataclasses.asdict(ci)
+    d.pop("attrs", None)
+    return {k: v for k, v in d.items() if isinstance(v, (str, int, bool, float, type(None)))}
+
+
+def _message_data(msg: Message) -> dict:
+    return {
+        "topic": msg.topic,
+        "payload": base64.b64encode(msg.payload).decode(),
+        "qos": msg.qos,
+        "retain": msg.retain,
+        "from": msg.from_client,
+        "mid": msg.mid.hex(),
+        "timestamp": msg.timestamp,
+    }
+
+
+class ExhookManager:
+    def __init__(self, hooks: Hooks, metrics=None, queue_size: int = 10_000):
+        self.hooks = hooks
+        self.metrics = metrics
+        self.servers: List[_ServerState] = []
+        self._installed: Dict[str, Any] = {}  # hookpoint -> bridge callback
+        self._events: "queue.Queue[Tuple[str, dict]]" = queue.Queue(queue_size)
+        self._dispatcher: Optional[threading.Thread] = None
+        self._stopping = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def load_server(self, cfg: ExhookServerConfig) -> List[str]:
+        """Connect + OnProviderLoaded; returns the negotiated hook list."""
+        st = _ServerState(cfg)
+        resp = st.call("provider.loaded", {"broker": "emqx_tpu"})
+        wanted = [h for h in (resp.get("value") or []) if h in HOOKPOINTS]
+        st.enabled_hooks = wanted
+        self.servers.append(st)
+        for point in wanted:
+            self._ensure_hook(point)
+        self._ensure_dispatcher()
+        log.info("exhook server %s loaded hooks=%s", cfg.name, wanted)
+        return wanted
+
+    def unload_server(self, name: str) -> None:
+        for st in list(self.servers):
+            if st.cfg.name == name:
+                try:
+                    st.call("provider.unloaded", {})
+                except Exception:
+                    pass
+                self.servers.remove(st)
+                st.close()
+        self._gc_hooks()
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._events.put(("__stop__", {}))
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout=5)
+        for st in self.servers:
+            st.close()
+        self.servers.clear()
+        self._gc_hooks()
+
+    def _ensure_hook(self, point: str) -> None:
+        """Refcounted install (`ensure_hooks`): one bridge cb per point."""
+        if point in self._installed:
+            return
+        if point in VALUED_HOOKS:
+            cb = self._make_valued_cb(point)
+        else:
+            cb = self._make_event_cb(point)
+        self.hooks.put(point, cb, priority=100)  # exhook runs first
+        self._installed[point] = cb
+
+    def _gc_hooks(self) -> None:
+        still_wanted = {h for st in self.servers for h in st.enabled_hooks}
+        for point in list(self._installed):
+            if point not in still_wanted:
+                self.hooks.delete(point, self._installed.pop(point))
+
+    def _servers_for(self, point: str) -> List[_ServerState]:
+        return [st for st in self.servers if point in st.enabled_hooks and st.cfg.enable]
+
+    # ---------------------------------------------------------- valued path
+
+    def _make_valued_cb(self, point: str):
+        if point == "client.authenticate":
+            def cb(clientinfo, acc):
+                return self._fold_authenticate(clientinfo, acc)
+        elif point == "client.authorize":
+            def cb(clientinfo, action, topic, acc):
+                return self._fold_authorize(clientinfo, action, topic, acc)
+        else:  # message.publish
+            def cb(msg):
+                return self._fold_publish(msg)
+        return cb
+
+    def _fold_authenticate(self, clientinfo: ClientInfo, acc):
+        data = {"clientinfo": _clientinfo_data(clientinfo)}
+        for st in self._servers_for("client.authenticate"):
+            try:
+                resp = st.call("client.authenticate", data)
+            except Exception:
+                if st.cfg.failed_action == "deny":
+                    return (STOP, {"result": DENY,
+                                   "reason_code": ReasonCode.NOT_AUTHORIZED})
+                continue
+            value = resp.get("value")
+            verdict = None
+            if isinstance(value, bool):
+                verdict = (
+                    {"result": ALLOW}
+                    if value
+                    else {"result": DENY, "reason_code": ReasonCode.NOT_AUTHORIZED}
+                )
+            if resp.get("type") == "stop" and verdict is not None:
+                return (STOP, verdict)
+            if verdict is not None:
+                acc = verdict
+        return acc
+
+    def _fold_authorize(self, clientinfo: ClientInfo, action: str, topic: str, acc):
+        data = {
+            "clientinfo": _clientinfo_data(clientinfo),
+            "action": action,
+            "topic": topic,
+        }
+        for st in self._servers_for("client.authorize"):
+            try:
+                resp = st.call("client.authorize", data)
+            except Exception:
+                if st.cfg.failed_action == "deny":
+                    return (STOP, DENY)
+                continue
+            value = resp.get("value")
+            if isinstance(value, bool):
+                verdict = ALLOW if value else DENY
+                if resp.get("type") == "stop":
+                    return (STOP, verdict)
+                acc = verdict
+        return acc
+
+    def _fold_publish(self, msg: Message):
+        from dataclasses import replace
+
+        for st in self._servers_for("message.publish"):
+            try:
+                resp = st.call("message.publish", _message_data(msg))
+            except Exception:
+                if st.cfg.failed_action == "deny":
+                    return (STOP, replace(
+                        msg, headers=dict(msg.headers, allow_publish=False)
+                    ))
+                continue
+            value = resp.get("value")
+            if isinstance(value, dict):
+                msg = replace(
+                    msg,
+                    topic=value.get("topic", msg.topic),
+                    payload=base64.b64decode(value["payload"])
+                    if "payload" in value
+                    else msg.payload,
+                    qos=value.get("qos", msg.qos),
+                    retain=value.get("retain", msg.retain),
+                    headers=dict(
+                        msg.headers, **(value.get("headers") or {})
+                    ),
+                )
+            if resp.get("type") == "stop":
+                return (STOP, msg)
+        return msg
+
+    # ----------------------------------------------------------- event path
+
+    def _make_event_cb(self, point: str):
+        def cb(*args):
+            data = self._encode_event(point, args)
+            try:
+                self._events.put_nowait((point, data))
+            except queue.Full:
+                if self.metrics is not None:
+                    self.metrics.inc("exhook.events.dropped")
+            return None
+
+        return cb
+
+    @staticmethod
+    def _encode_event(point: str, args: tuple) -> dict:
+        data: Dict[str, Any] = {}
+        for a in args:
+            if isinstance(a, ClientInfo):
+                data["clientinfo"] = _clientinfo_data(a)
+            elif isinstance(a, Message):
+                data["message"] = _message_data(a)
+            elif isinstance(a, str):
+                data.setdefault("args", []).append(a)
+            elif isinstance(a, bool):
+                data["flag"] = a
+            elif dataclasses.is_dataclass(a) and not isinstance(a, type):
+                try:
+                    data["opts"] = {
+                        k: v
+                        for k, v in dataclasses.asdict(a).items()
+                        if isinstance(v, (str, int, bool, float, type(None)))
+                    }
+                except Exception:
+                    pass
+        return data
+
+    def _ensure_dispatcher(self) -> None:
+        if self._dispatcher is not None and self._dispatcher.is_alive():
+            return
+        self._stopping = False
+        self._dispatcher = threading.Thread(target=self._dispatch_loop, daemon=True)
+        self._dispatcher.start()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            point, data = self._events.get()
+            if point == "__stop__" or self._stopping:
+                return
+            for st in self._servers_for(point):
+                try:
+                    st.call(point, data)
+                except Exception:
+                    if self.metrics is not None:
+                        self.metrics.inc("exhook.events.failed")
+
+    def pending_events(self) -> int:
+        return self._events.qsize()

@@ -1,0 +1,104 @@
+"""TpuMatchProvider — the north-star exhook provider (SURVEY.md §7.2 #4).
+
+An out-of-process hook provider that mirrors a broker's subscription
+table into a `TopicMatchEngine` (the HBM route/trie mirror) via the
+session.subscribed / session.unsubscribed hook stream, and answers
+message.publish hooks with the device-matched subscriber set attached
+to the message headers.  Against a stock reference broker this is the
+"TPU sidecar" deployment: the broker keeps its own dispatch, and the
+provider supplies accelerated match verdicts; against our own broker it
+doubles as an integration-test provider for the exhook boundary.
+
+State here is a cache over the hook stream — on restart the broker's
+session.subscribed replay (or a fresh OnProviderLoaded negotiation)
+rebuilds it, matching the reference's device-state-is-a-cache failure
+model (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from ..models.engine import TopicMatchEngine
+
+
+class TpuMatchProvider:
+    def __init__(self, engine: Optional[TopicMatchEngine] = None):
+        self.engine = engine or TopicMatchEngine()
+        self._subs: Dict[int, Set[str]] = {}  # fid -> clientids
+        self._lock = threading.Lock()  # pool conns call concurrently
+        self.stats = {"publish": 0, "subscribed": 0, "unsubscribed": 0}
+
+    def hooks(self) -> List[str]:
+        return [
+            "session.subscribed",
+            "session.unsubscribed",
+            "session.terminated",
+            "message.publish",
+        ]
+
+    # ------------------------------------------------------- oplog ingest
+
+    def on_session_subscribed(self, data: dict) -> None:
+        args = data.get("args") or []
+        if len(args) < 2:
+            return
+        clientid, filt = args[0], args[1]
+        with self._lock:
+            fid = self.engine.add_filter(filt)
+            self._subs.setdefault(fid, set()).add(clientid)
+            self.stats["subscribed"] += 1
+
+    def on_session_unsubscribed(self, data: dict) -> None:
+        args = data.get("args") or []
+        if len(args) < 2:
+            return
+        clientid, filt = args[0], args[1]
+        with self._lock:
+            fid = self.engine.fid_of(filt)
+            if fid is None:
+                return
+            members = self._subs.get(fid)
+            if members is not None:
+                members.discard(clientid)
+                if not members:
+                    del self._subs[fid]
+            self.engine.remove_filter(filt)
+            self.stats["unsubscribed"] += 1
+
+    def on_session_terminated(self, data: dict) -> None:
+        """Best-effort cleanup when a session dies without unsubscribes."""
+        args = data.get("args") or []
+        if not args:
+            return
+        clientid = args[0]
+        with self._lock:
+            rev = {fid: f for f, fid in self.engine._fids.items()}
+            for fid in list(self._subs):
+                members = self._subs[fid]
+                if clientid not in members:
+                    continue
+                members.discard(clientid)
+                if not members:
+                    del self._subs[fid]
+                filt = rev.get(fid)
+                if filt is not None:
+                    # one engine ref was taken per (clientid, filter)
+                    self.engine.remove_filter(filt)
+
+    # ------------------------------------------------------------- publish
+
+    def on_message_publish(self, data: dict):
+        """Match one message; return it with the matched subscriber set."""
+        with self._lock:
+            fids = self.engine.match_one(data.get("topic", ""))
+            matched = sorted({c for f in fids for c in self._subs.get(f, ())})
+            self.stats["publish"] += 1
+        return ("continue", {"headers": {"tpu_matched": matched}})
+
+    # -------------------------------------------------------------- stats
+
+    @property
+    def n_filters(self) -> int:
+        return self.engine.n_filters

@@ -1,0 +1,132 @@
+"""Provider-side server: hosts a provider object behind the wire protocol.
+
+The standalone-service half of the exhook boundary — what the reference
+calls the "HookProvider server" (external process implementing
+exhook.proto).  A provider object exposes:
+
+  hooks() -> list[str]                    which hookpoints to bridge
+                                          (OnProviderLoaded's hook list)
+  on_<hook_with_underscores>(data) ->     per-hook handler; valued hooks
+      None | bool | dict                  return a verdict/new message,
+                                          event hooks return None
+
+Runs in its own asyncio loop; `ProviderServerThread` wraps it in a
+daemon thread so tests (and same-process deployments) get the real
+out-of-process call pattern — the broker side blocks on a socket while
+the provider answers from another thread, exactly like the gRPC hop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+import threading
+from typing import Optional
+
+from .wire import MAX_FRAME, VALUED_HOOKS
+
+
+class ProviderServer:
+    def __init__(self, provider, host: str = "127.0.0.1", port: int = 0):
+        self.provider = provider
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for w in list(self._conns):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (n,) = struct.unpack("!I", hdr)
+                if not 0 < n <= MAX_FRAME:
+                    return
+                req = json.loads(await reader.readexactly(n))
+                resp = self._dispatch(req)
+                body = json.dumps(resp, separators=(",", ":")).encode()
+                writer.write(struct.pack("!I", len(body)) + body)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _dispatch(self, req: dict) -> dict:
+        rid = req.get("id")
+        hook = req.get("hook", "")
+        data = req.get("data") or {}
+        if hook == "provider.loaded":
+            return {"id": rid, "type": "continue", "value": self.provider.hooks()}
+        method = getattr(self.provider, "on_" + hook.replace(".", "_"), None)
+        if method is None:
+            return {"id": rid, "type": "continue", "value": None}
+        try:
+            result = method(data)
+        except Exception as e:
+            return {"id": rid, "type": "continue", "error": f"{type(e).__name__}: {e}"}
+        if hook not in VALUED_HOOKS or result is None:
+            return {"id": rid, "type": "continue", "value": None}
+        # valued hook verdicts: (type, value) | bool | replacement message
+        if isinstance(result, tuple):
+            typ, value = result
+            return {"id": rid, "type": typ, "value": value}
+        return {"id": rid, "type": "continue", "value": result}
+
+
+class ProviderServerThread:
+    """Run a ProviderServer on a dedicated loop in a daemon thread."""
+
+    def __init__(self, provider, host: str = "127.0.0.1", port: int = 0):
+        self.server = ProviderServer(provider, host, port)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ProviderServerThread":
+        started = threading.Event()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            self._loop = loop
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(self.server.start())
+            started.set()
+            loop.run_forever()
+            loop.run_until_complete(self.server.stop())
+            loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        if not started.wait(10):
+            raise RuntimeError("provider server failed to start")
+        return self
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)

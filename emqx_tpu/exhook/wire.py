@@ -1,0 +1,121 @@
+"""exhook wire protocol: hookpoint vocabulary + framed JSON transport.
+
+Mirrors the request/response vocabulary of the reference's
+`exhook.proto` (HookProvider service: OnProviderLoaded, OnClientConnect,
+... OnMessageAcked) without gRPC: frames are `u32 length | JSON` over
+TCP.  Each request is `{"id": n, "hook": name, "data": {...}}`; each
+response `{"id": n, "type": "continue"|"stop", "value": ...}` — the
+ValuedResponse semantics of the proto (`type` maps to its
+`StopOrContinue`, `value` to the bool/message oneof).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+try:  # pragma: no cover - not present in this image
+    import grpc  # noqa: F401
+
+    GRPC_AVAILABLE = True
+except ImportError:
+    GRPC_AVAILABLE = False
+
+# the 19 bridged hookpoints (`emqx_exhook.hrl` ?ENABLED_HOOKS)
+HOOKPOINTS = (
+    "client.connect",
+    "client.connack",
+    "client.connected",
+    "client.disconnected",
+    "client.authenticate",
+    "client.authorize",
+    "client.subscribe",
+    "client.unsubscribe",
+    "session.created",
+    "session.subscribed",
+    "session.unsubscribed",
+    "session.resumed",
+    "session.discarded",
+    "session.takenover",
+    "session.terminated",
+    "message.publish",
+    "message.delivered",
+    "message.acked",
+    "message.dropped",
+)
+
+# hooks whose provider response feeds back into the chain
+# (ValuedResponse in the proto; deny semantics on failure)
+VALUED_HOOKS = frozenset(
+    {"client.authenticate", "client.authorize", "message.publish"}
+)
+
+MAX_FRAME = 16 * 1024 * 1024
+
+
+def pack(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    return struct.pack("!I", len(body)) + body
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def read_obj(sock: socket.socket) -> dict:
+    (n,) = struct.unpack("!I", recv_exact(sock, 4))
+    if not 0 < n <= MAX_FRAME:
+        raise ConnectionError(f"bad frame length {n}")
+    return json.loads(recv_exact(sock, n))
+
+
+class SyncConn:
+    """One pooled blocking connection to a provider (client side).
+
+    The reference's per-server gRPC channel pool is pool_size =
+    schedulers (`emqx_exhook_server.erl:89-117`); here each pooled
+    member is a plain socket with a request timeout.
+    """
+
+    def __init__(self, addr: Tuple[str, int], timeout: float):
+        self.addr = addr
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._next_id = 0
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self.addr, timeout=self.timeout)
+            s.settimeout(self.timeout)
+            self._sock = s
+        return self._sock
+
+    def call(self, hook: str, data: dict) -> dict:
+        self._next_id += 1
+        req = {"id": self._next_id, "hook": hook, "data": data}
+        try:
+            s = self._ensure()
+            s.sendall(pack(req))
+            while True:
+                resp = read_obj(s)
+                if resp.get("id") == self._next_id:
+                    return resp
+        except (OSError, ConnectionError, socket.timeout):
+            self.close()
+            raise
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

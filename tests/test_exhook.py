@@ -1,0 +1,258 @@
+"""exhook boundary tests: broker <-> out-of-process provider.
+
+Mirrors the reference's exhook suites: hook negotiation on load,
+valued-hook verdicts (authenticate/authorize/message.publish),
+failed_action deny|ignore on a dead server, event-stream mirroring
+into the TPU match provider.
+"""
+
+import time
+
+import pytest
+
+from emqx_tpu.broker.access_control import ALLOW, DENY, AccessControl, ClientInfo
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.exhook import (
+    ExhookManager,
+    ExhookServerConfig,
+    ProviderServerThread,
+    TpuMatchProvider,
+)
+
+
+def wait_for(pred, timeout=5.0):
+    t0 = time.time()
+    while not pred():
+        if time.time() - t0 > timeout:
+            raise AssertionError("condition not reached")
+        time.sleep(0.02)
+
+
+class RecordingProvider:
+    """Scriptable provider for verdict tests."""
+
+    def __init__(self, hook_list, auth=None, authz=None, publish=None):
+        self.hook_list = hook_list
+        self.auth = auth
+        self.authz = authz
+        self.pub = publish
+        self.events = []
+
+    def hooks(self):
+        return self.hook_list
+
+    def on_client_authenticate(self, data):
+        self.events.append(("authenticate", data))
+        return self.auth
+
+    def on_client_authorize(self, data):
+        self.events.append(("authorize", data))
+        return self.authz
+
+    def on_message_publish(self, data):
+        self.events.append(("publish", data))
+        return self.pub
+
+    def on_client_connected(self, data):
+        self.events.append(("connected", data))
+
+    def on_session_subscribed(self, data):
+        self.events.append(("subscribed", data))
+
+
+def load(mgr, thread, **cfg):
+    base = dict(name="s1", host="127.0.0.1", port=thread.port, pool_size=2)
+    base.update(cfg)
+    return mgr.load_server(ExhookServerConfig(**base))
+
+
+def test_provider_loaded_negotiates_hooks():
+    prov = RecordingProvider(["client.authenticate", "message.publish", "bogus.hook"])
+    th = ProviderServerThread(prov).start()
+    try:
+        b = Broker()
+        mgr = ExhookManager(b.hooks, b.metrics)
+        hooks = load(mgr, th)
+        assert hooks == ["client.authenticate", "message.publish"]
+        assert set(mgr._installed) == {"client.authenticate", "message.publish"}
+        mgr.stop()
+        assert mgr._installed == {}
+    finally:
+        th.stop()
+
+
+def test_authenticate_stop_deny():
+    prov = RecordingProvider(["client.authenticate"], auth=("stop", False))
+    th = ProviderServerThread(prov).start()
+    try:
+        b = Broker()
+        mgr = ExhookManager(b.hooks, b.metrics)
+        load(mgr, th)
+        ac = AccessControl(b.hooks)
+        out = ac.authenticate(ClientInfo(clientid="c1", username="u"))
+        assert out["result"] == DENY
+        assert prov.events and prov.events[0][1]["clientinfo"]["clientid"] == "c1"
+        mgr.stop()
+    finally:
+        th.stop()
+
+
+def test_authorize_verdicts():
+    prov = RecordingProvider(["client.authorize"], authz=("stop", False))
+    th = ProviderServerThread(prov).start()
+    try:
+        b = Broker()
+        mgr = ExhookManager(b.hooks, b.metrics)
+        load(mgr, th)
+        ac = AccessControl(b.hooks)
+        ci = ClientInfo(clientid="c1")
+        assert ac.authorize(ci, "publish", "a/b") == DENY
+        prov.authz = ("stop", True)
+        assert ac.authorize(ci, "publish", "a/c") == ALLOW
+        mgr.stop()
+    finally:
+        th.stop()
+
+
+def test_message_publish_rewrite_and_deny():
+    import base64
+
+    prov = RecordingProvider(
+        ["message.publish"],
+        publish=("continue", {"topic": "rewritten/t",
+                              "payload": base64.b64encode(b"new").decode()}),
+    )
+    th = ProviderServerThread(prov).start()
+    try:
+        b = Broker()
+        mgr = ExhookManager(b.hooks, b.metrics)
+        load(mgr, th)
+        got = []
+
+        class Sink:
+            clientid = "s"
+            session = None
+
+            def deliver(self, items):
+                got.extend(items)
+
+            def kick(self, rc=0):
+                pass
+
+        from emqx_tpu.broker.session import Session
+
+        sink = Sink()
+        sink.session = Session(clientid="s")
+        sink.session.subscriptions["rewritten/t"] = SubOpts(qos=0)
+        b.cm.register_channel(sink)
+        b.subscribe("s", "rewritten/t", SubOpts(qos=0))
+        b.publish(Message(topic="orig/t", payload=b"old"))
+        assert got and got[0][1].topic == "rewritten/t"
+        assert got[0][1].payload == b"new"
+
+        # deny via allow_publish=false header
+        prov.pub = ("stop", {"headers": {"allow_publish": False}})
+        n = b.publish(Message(topic="orig/t", payload=b"x"))
+        assert n == 0
+        assert b.metrics.get("messages.dropped") == 1
+        mgr.stop()
+    finally:
+        th.stop()
+
+
+def test_failed_action_deny_vs_ignore():
+    prov = RecordingProvider(["client.authenticate"], auth=("stop", True))
+    th = ProviderServerThread(prov).start()
+    b = Broker()
+    mgr = ExhookManager(b.hooks, b.metrics)
+    load(mgr, th, request_timeout=0.5)
+    th.stop()  # kill the provider -> requests now fail
+    ac = AccessControl(b.hooks)
+    out = ac.authenticate(ClientInfo(clientid="c1"))
+    assert out["result"] == DENY  # failed_action=deny (default)
+    mgr.stop()
+
+    prov2 = RecordingProvider(["client.authenticate"], auth=("stop", False))
+    th2 = ProviderServerThread(prov2).start()
+    b2 = Broker()
+    mgr2 = ExhookManager(b2.hooks, b2.metrics)
+    load(mgr2, th2, failed_action="ignore", request_timeout=0.5)
+    th2.stop()
+    ac2 = AccessControl(b2.hooks)
+    out2 = ac2.authenticate(ClientInfo(clientid="c1"))
+    assert out2["result"] == ALLOW  # failure ignored -> chain default
+    mgr2.stop()
+
+
+def test_event_stream_fire_and_forget():
+    prov = RecordingProvider(["client.connected", "session.subscribed"])
+    th = ProviderServerThread(prov).start()
+    try:
+        b = Broker()
+        mgr = ExhookManager(b.hooks, b.metrics)
+        load(mgr, th)
+        b.hooks.run("client.connected", (ClientInfo(clientid="cx"),))
+        b.subscribe("cx", "e/1", SubOpts(qos=0))
+        wait_for(lambda: len(prov.events) >= 2)
+        kinds = [k for k, _ in prov.events]
+        assert "connected" in kinds and "subscribed" in kinds
+        sub = dict(prov.events)["subscribed"]
+        assert sub["args"][:2] == ["cx", "e/1"]
+        mgr.stop()
+    finally:
+        th.stop()
+
+
+def test_tpu_match_provider_mirror_and_match():
+    prov = TpuMatchProvider()
+    th = ProviderServerThread(prov).start()
+    try:
+        b = Broker()
+        mgr = ExhookManager(b.hooks, b.metrics)
+        hooks = load(mgr, th)
+        assert "message.publish" in hooks
+        b.subscribe("alice", "room/+/temp", SubOpts(qos=0))
+        b.subscribe("bob", "room/#", SubOpts(qos=0))
+        wait_for(lambda: prov.n_filters == 2)
+
+        # publish through the broker: provider annotates the matched set
+        out = {}
+        b.hooks.put(
+            "message.publish",
+            lambda m: out.update(hdr=m.headers) or None,
+            priority=-100,
+        )
+        b.publish(Message(topic="room/3/temp", payload=b"t"))
+        assert out["hdr"].get("tpu_matched") == ["alice", "bob"]
+
+        b.unsubscribe("alice", "room/+/temp")
+        wait_for(lambda: prov.n_filters == 1)
+        b.publish(Message(topic="room/3/temp", payload=b"t"))
+        assert out["hdr"].get("tpu_matched") == ["bob"]
+        mgr.stop()
+    finally:
+        th.stop()
+
+
+def test_multi_server_fold_order():
+    """Two providers: first rewrites, second sees the rewrite (fold order)."""
+    import base64
+
+    p1 = RecordingProvider(
+        ["message.publish"], publish=("continue", {"topic": "step1"})
+    )
+    p2 = RecordingProvider(["message.publish"], publish=None)
+    t1, t2 = ProviderServerThread(p1).start(), ProviderServerThread(p2).start()
+    try:
+        b = Broker()
+        mgr = ExhookManager(b.hooks, b.metrics)
+        mgr.load_server(ExhookServerConfig(name="a", host="127.0.0.1", port=t1.port))
+        mgr.load_server(ExhookServerConfig(name="b", host="127.0.0.1", port=t2.port))
+        b.publish(Message(topic="step0", payload=b""))
+        assert p2.events and p2.events[0][1]["topic"] == "step1"
+        mgr.stop()
+    finally:
+        t1.stop()
+        t2.stop()
