@@ -226,6 +226,9 @@ class Broker:
             n += 1
         if n:
             self.metrics.inc("messages.queued", n)
+            p = getattr(self, "persistence", None)
+            if p is not None:
+                p.mark_dirty(cid)
         return n
 
     # ------------------------------------------------- retained delivery

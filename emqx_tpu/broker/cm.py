@@ -30,6 +30,10 @@ class ConnectionManager:
         # disconnected persistent sessions: clientid -> (session, expire_at)
         self.pending: Dict[str, Tuple[Session, float]] = {}
         self.on_discard: Optional[Callable[[Session], None]] = None
+        # fires when a disconnected session is parked (persistence point)
+        self.on_park: Optional[Callable[[str, Session, float], None]] = None
+        # fires when a parked session is resumed by a reconnect
+        self.on_resume: Optional[Callable[[str], None]] = None
 
     # ------------------------------------------------------------- open
 
@@ -67,6 +71,8 @@ class ConnectionManager:
         if ent is not None:
             session, expire_at = ent
             if time.time() < expire_at or session.expiry_interval == 0xFFFFFFFF:
+                if self.on_resume:
+                    self.on_resume(clientid)
                 return session, True
             if self.on_discard:
                 self.on_discard(session)
@@ -99,7 +105,10 @@ class ConnectionManager:
                 if s.expiry_interval == 0xFFFFFFFF
                 else s.expiry_interval
             )
-            self.pending[ch.clientid] = (s, time.time() + ttl)
+            expire_at = time.time() + ttl
+            self.pending[ch.clientid] = (s, expire_at)
+            if self.on_park:
+                self.on_park(ch.clientid, s, expire_at)
         elif self.on_discard:
             self.on_discard(s)
 

@@ -250,6 +250,9 @@ class Listener:
                             "housekeeping for %s", getattr(ch, "clientid", "?")
                         )
                 self.broker.cm.evict_expired()
+                p = getattr(self.broker, "persistence", None)
+                if p is not None:
+                    p.tick()
                 if n % 60 == 0:
                     self.broker.retainer.clean_expired()
             except Exception:

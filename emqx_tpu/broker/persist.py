@@ -1,0 +1,286 @@
+"""Persistent sessions: checkpoint/resume across broker restarts.
+
+Analog of `emqx_persistent_session.erl` + its mnesia ram/disc backends
+(SURVEY.md §5.4): disconnected sessions with a nonzero expiry interval
+are checkpointed (subscriptions, inflight window, message queue,
+QoS2 dedup set) and restored on boot — routes re-enter the match
+engine, pending messages replay to the resuming client.
+
+Redesign notes:
+  * the engine's HBM tables are a cache over host truth; host truth is
+    rebuilt from this store on restart (`restore()`), so the device
+    state needs no checkpoint of its own — the failure model the
+    reference applies to mnesia-vs-trie applies to host-vs-HBM here;
+  * instead of per-message mnesia tables + marker-based replay, each
+    parked session snapshots atomically to one JSON file (temp+rename);
+    offline enqueues mark the session dirty and `tick()` (driven by the
+    listener housekeeping loop) re-snapshots — crash loses at most one
+    tick of offline messages, the same at-most-once window the
+    reference's async rlog persistence has;
+  * GC of expired stored sessions mirrors `emqx_persistent_session_gc`.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .inflight import InflightEntry
+from .message import Message
+from .packet import SubOpts
+from .session import Session
+
+
+# ------------------------------------------------------- serialization
+
+def message_to_dict(msg: Message) -> dict:
+    return {
+        "topic": msg.topic,
+        "payload": base64.b64encode(msg.payload).decode(),
+        "qos": msg.qos,
+        "retain": msg.retain,
+        "dup": msg.dup,
+        "from": msg.from_client,
+        "username": msg.from_username,
+        "mid": msg.mid.hex(),
+        "ts": msg.timestamp,
+        "props": {
+            str(k): v
+            for k, v in msg.properties.items()
+            if isinstance(v, (int, float, str, bool))
+        },
+    }
+
+
+def message_from_dict(d: dict) -> Message:
+    props = {}
+    for k, v in (d.get("props") or {}).items():
+        try:
+            props[int(k)] = v
+        except ValueError:
+            props[k] = v
+    return Message(
+        topic=d["topic"],
+        payload=base64.b64decode(d.get("payload", "")),
+        qos=d.get("qos", 0),
+        retain=d.get("retain", False),
+        dup=d.get("dup", False),
+        from_client=d.get("from", ""),
+        from_username=d.get("username"),
+        mid=bytes.fromhex(d["mid"]) if d.get("mid") else b"",
+        timestamp=d.get("ts", 0),
+        properties=props,
+    )
+
+
+def session_to_dict(s: Session, expire_at: float) -> dict:
+    return {
+        "clientid": s.clientid,
+        "expiry_interval": s.expiry_interval,
+        "expire_at": None if expire_at == float("inf") else expire_at,
+        "upgrade_qos": s.upgrade_qos,
+        "retry_interval": s.retry_interval,
+        "max_awaiting_rel": s.max_awaiting_rel,
+        "await_rel_timeout": s.await_rel_timeout,
+        "created_at": s.created_at,
+        "next_pid": s._next_pid,
+        "max_inflight": s.inflight.max_size,
+        "max_mqueue": s.mqueue.max_len,
+        "store_qos0": s.mqueue.store_qos0,
+        "subscriptions": {
+            f: dataclasses.asdict(o) for f, o in s.subscriptions.items()
+        },
+        "mqueue": [message_to_dict(m) for m in s.mqueue.peek_all()],
+        "inflight": [
+            {
+                "pid": pid,
+                "phase": e.phase,
+                "message": message_to_dict(e.message) if e.message else None,
+            }
+            for pid, e in s.inflight.items()
+        ],
+        "awaiting_rel": list(s.awaiting_rel.keys()),
+    }
+
+
+def session_from_dict(d: dict) -> Session:
+    s = Session(
+        clientid=d["clientid"],
+        clean_start=False,
+        expiry_interval=d.get("expiry_interval", 0),
+        max_inflight=d.get("max_inflight", 32),
+        max_mqueue=d.get("max_mqueue", 1000),
+        store_qos0=d.get("store_qos0", True),
+        upgrade_qos=d.get("upgrade_qos", False),
+        retry_interval=d.get("retry_interval", 30.0),
+        max_awaiting_rel=d.get("max_awaiting_rel", 100),
+        await_rel_timeout=d.get("await_rel_timeout", 300.0),
+        created_at=d.get("created_at"),
+    )
+    s._next_pid = d.get("next_pid", 1)
+    for f, o in (d.get("subscriptions") or {}).items():
+        s.subscriptions[f] = SubOpts(**o)
+    for m in d.get("mqueue") or []:
+        s.mqueue.insert(message_from_dict(m))
+    now = time.monotonic()
+    for e in d.get("inflight") or []:
+        s.inflight.insert(
+            e["pid"],
+            InflightEntry(
+                phase=e["phase"],
+                message=message_from_dict(e["message"]) if e["message"] else None,
+                ts=now,
+            ),
+        )
+    for pid in d.get("awaiting_rel") or []:
+        s.awaiting_rel[pid] = now
+    return s
+
+
+# ------------------------------------------------------------- backends
+
+class RamBackend:
+    """In-memory store (`emqx_persistent_session_mnesia_ram_backend`)."""
+
+    def __init__(self) -> None:
+        self._d: Dict[str, dict] = {}
+
+    def save(self, clientid: str, data: dict) -> None:
+        self._d[clientid] = data
+
+    def delete(self, clientid: str) -> None:
+        self._d.pop(clientid, None)
+
+    def load_all(self) -> List[dict]:
+        return list(self._d.values())
+
+    def clear(self) -> None:
+        self._d.clear()
+
+
+class DiscBackend:
+    """One JSON file per session, atomic temp+rename writes."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, clientid: str) -> str:
+        name = base64.urlsafe_b64encode(clientid.encode()).decode().rstrip("=")
+        return os.path.join(self.dir, name + ".session.json")
+
+    def save(self, clientid: str, data: dict) -> None:
+        path = self._path(clientid)
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(data, f, separators=(",", ":"))
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def delete(self, clientid: str) -> None:
+        try:
+            os.unlink(self._path(clientid))
+        except FileNotFoundError:
+            pass
+
+    def load_all(self) -> List[dict]:
+        out = []
+        for name in os.listdir(self.dir):
+            if not name.endswith(".session.json"):
+                continue
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    out.append(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                continue
+        return out
+
+    def clear(self) -> None:
+        for name in os.listdir(self.dir):
+            if name.endswith(".session.json"):
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+
+
+# -------------------------------------------------------------- manager
+
+class SessionPersistence:
+    def __init__(self, broker, backend=None):
+        self.broker = broker
+        self.backend = backend if backend is not None else RamBackend()
+        self._dirty: set = set()
+        self._orig_on_discard = broker.cm.on_discard
+        broker.cm.on_park = self._on_park
+        broker.cm.on_discard = self._on_discard
+        broker.cm.on_resume = self.on_resume
+        broker.persistence = self
+
+    # ------------------------------------------------------- write points
+
+    def _on_park(self, clientid: str, session: Session, expire_at: float) -> None:
+        self.backend.save(clientid, session_to_dict(session, expire_at))
+        self._dirty.discard(clientid)
+
+    def _on_discard(self, session: Session) -> None:
+        self.backend.delete(session.clientid)
+        self._dirty.discard(session.clientid)
+        if self._orig_on_discard is not None:
+            self._orig_on_discard(session)
+
+    def mark_dirty(self, clientid: str) -> None:
+        if clientid in self.broker.cm.pending:
+            self._dirty.add(clientid)
+
+    def on_resume(self, clientid: str) -> None:
+        """Client reconnected: the live channel owns the session now."""
+        self.backend.delete(clientid)
+        self._dirty.discard(clientid)
+
+    def tick(self, now: Optional[float] = None) -> int:
+        """Flush dirty parked sessions + GC expired store entries."""
+        n = 0
+        for cid in list(self._dirty):
+            ent = self.broker.cm.pending.get(cid)
+            if ent is None:
+                self._dirty.discard(cid)
+                continue
+            session, expire_at = ent
+            self.backend.save(cid, session_to_dict(session, expire_at))
+            self._dirty.discard(cid)
+            n += 1
+        return n
+
+    # ------------------------------------------------------------ restore
+
+    def restore(self, now: Optional[float] = None) -> int:
+        """Rebuild cm.pending + engine routes from the store (boot path)."""
+        now = now if now is not None else time.time()
+        restored = 0
+        for data in self.backend.load_all():
+            expire_at = data.get("expire_at")
+            if expire_at is not None and expire_at <= now:
+                self.backend.delete(data["clientid"])
+                continue
+            session = session_from_dict(data)
+            cid = session.clientid
+            self.broker.cm.pending[cid] = (
+                session,
+                expire_at if expire_at is not None else float("inf"),
+            )
+            for filt, opts in session.subscriptions.items():
+                self.broker.subscribe(cid, filt, opts)
+            restored += 1
+        return restored

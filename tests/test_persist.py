@@ -1,0 +1,177 @@
+"""Persistent sessions: checkpoint/resume (`emqx_persistent_session` analog).
+
+Covers serialization round-trips, disc backend atomicity, offline
+message flushing, expiry GC, and the headline scenario: broker process
+"restarts" (new Broker + restore from the same directory), the client
+reconnects with clean_start=False and replays its pending messages.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import MQTT_V5, Property, SubOpts
+from emqx_tpu.broker.persist import (
+    DiscBackend,
+    RamBackend,
+    SessionPersistence,
+    message_from_dict,
+    message_to_dict,
+    session_from_dict,
+    session_to_dict,
+)
+from emqx_tpu.broker.session import Session
+from emqx_tpu.broker.inflight import InflightEntry
+
+
+def test_message_roundtrip():
+    m = Message(
+        topic="a/b",
+        payload=b"\x00\xffbin",
+        qos=2,
+        retain=True,
+        from_client="c1",
+        from_username="u",
+        properties={Property.MESSAGE_EXPIRY_INTERVAL: 60},
+    )
+    m2 = message_from_dict(message_to_dict(m))
+    assert (m2.topic, m2.payload, m2.qos, m2.retain) == ("a/b", b"\x00\xffbin", 2, True)
+    assert m2.properties[Property.MESSAGE_EXPIRY_INTERVAL] == 60
+    assert m2.mid == m.mid
+
+
+def test_session_roundtrip_full_state():
+    s = Session(clientid="c1", expiry_interval=300, max_inflight=5)
+    s.subscriptions["t/+"] = SubOpts(qos=1, no_local=True, sub_id=7)
+    s.mqueue.insert(Message(topic="q/1", payload=b"p1", qos=1))
+    s.inflight.insert(3, InflightEntry(phase="wait_ack", message=Message(topic="i/1", qos=1)))
+    s.inflight.insert(4, InflightEntry(phase="wait_comp", message=None))
+    s.awaiting_rel[9] = time.monotonic()
+    s._next_pid = 42
+
+    s2 = session_from_dict(session_to_dict(s, time.time() + 300))
+    assert s2.clientid == "c1" and s2.expiry_interval == 300
+    assert s2.subscriptions["t/+"] == SubOpts(qos=1, no_local=True, sub_id=7)
+    assert len(s2.mqueue) == 1 and s2.mqueue.peek_all()[0].payload == b"p1"
+    assert s2.inflight.get(3).phase == "wait_ack"
+    assert s2.inflight.get(3).message.topic == "i/1"
+    assert s2.inflight.get(4).phase == "wait_comp"
+    assert 9 in s2.awaiting_rel and s2._next_pid == 42
+    assert s2.inflight.max_size == 5
+
+
+def test_disc_backend(tmp_path):
+    be = DiscBackend(str(tmp_path))
+    be.save("client/with/slashes", {"clientid": "client/with/slashes", "x": 1})
+    be.save("c2", {"clientid": "c2"})
+    assert {d["clientid"] for d in be.load_all()} == {"client/with/slashes", "c2"}
+    be.delete("c2")
+    assert len(be.load_all()) == 1
+    be.clear()
+    assert be.load_all() == []
+
+
+def test_park_save_resume_delete():
+    b = Broker()
+    p = SessionPersistence(b, RamBackend())
+
+    class Ch:
+        clientid = "c1"
+        session = Session(clientid="c1", expiry_interval=120)
+
+        def kick(self, rc=0):
+            pass
+
+        def deliver(self, items):
+            pass
+
+    ch = Ch()
+    ch.session.subscriptions["a/#"] = SubOpts(qos=1)
+    b.cm.register_channel(ch)
+    b.cm.disconnect_channel(ch)  # park -> snapshot
+    assert len(p.backend.load_all()) == 1
+
+    # offline enqueue -> dirty -> tick flushes
+    b.cm.pending["c1"][0].enqueue(Message(topic="a/x", payload=b"off", qos=1))
+    p.mark_dirty("c1")
+    assert p.tick() == 1
+    stored = p.backend.load_all()[0]
+    assert stored["mqueue"][0]["topic"] == "a/x"
+
+    # resume removes the store entry (live channel owns the session)
+    s, present = b.cm.open_session(False, "c1", lambda: Session(clientid="c1"))
+    assert present and len(p.backend.load_all()) == 0
+
+
+def test_restore_rebuilds_routes_and_drops_expired(tmp_path):
+    be = DiscBackend(str(tmp_path))
+    b1 = Broker()
+    SessionPersistence(b1, be)
+    live = Session(clientid="keeper", expiry_interval=300)
+    live.subscriptions["k/+"] = SubOpts(qos=1)
+    be.save("keeper", session_to_dict(live, time.time() + 300))
+    dead = Session(clientid="expired", expiry_interval=1)
+    be.save("expired", session_to_dict(dead, time.time() - 10))
+
+    b2 = Broker()
+    p2 = SessionPersistence(b2, be)
+    assert p2.restore() == 1
+    assert "keeper" in b2.cm.pending and "expired" not in b2.cm.pending
+    assert b2.route_count == 1  # engine route rebuilt
+    assert len(be.load_all()) == 1  # expired entry GCed from disk
+    # offline delivery works right after restore
+    assert b2.publish(Message(topic="k/1", payload=b"x", qos=1)) == 1
+    assert len(b2.cm.pending["keeper"][0].mqueue) == 1
+
+
+def test_end_to_end_restart_resume(tmp_path):
+    """Full restart: listener+client, broker dies, new broker restores,
+    client resumes and replays offline messages (the reference's
+    persistent-session CT scenario)."""
+
+    loop = asyncio.new_event_loop()
+    run = lambda c: loop.run_until_complete(asyncio.wait_for(c, 30))
+
+    async def phase1():
+        b = Broker()
+        SessionPersistence(b, DiscBackend(str(tmp_path)))
+        lst = Listener(b, port=0)
+        await lst.start()
+        c = MqttClient(
+            clientid="dur",
+            clean_start=True,
+            properties={Property.SESSION_EXPIRY_INTERVAL: 3600},
+        )
+        await c.connect(port=lst.port)
+        await c.subscribe("d/#", qos=1)
+        await c.disconnect()  # parks + persists the session
+        await asyncio.sleep(0.05)
+        # broker publishes while the client is away
+        b.publish(Message(topic="d/1", payload=b"while-away", qos=1))
+        b.persistence.tick()  # flush the offline enqueue
+        await lst.stop()
+
+    async def phase2():
+        b = Broker()  # fresh process analog: nothing in memory
+        p = SessionPersistence(b, DiscBackend(str(tmp_path)))
+        assert p.restore() == 1
+        lst = Listener(b, port=0)
+        await lst.start()
+        c = MqttClient(clientid="dur", clean_start=False)
+        connack = await c.connect(port=lst.port)
+        assert connack.session_present
+        m = await asyncio.wait_for(c.recv(), 5)
+        assert (m.topic, m.payload, m.qos) == ("d/1", b"while-away", 1)
+        await c.disconnect()
+        await lst.stop()
+
+    try:
+        run(phase1())
+        run(phase2())
+    finally:
+        loop.close()
