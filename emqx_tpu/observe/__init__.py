@@ -1,0 +1,21 @@
+"""Observability: stats, $SYS heartbeats, alarms, tracing, slow
+subscribers, Prometheus/StatsD export (SURVEY.md §1.13, §5.5).
+"""
+
+from .alarm import Alarm, AlarmManager
+from .slow_subs import LatencyStats, SlowSubs
+from .stats import Stats
+from .sysmon import SysHeartbeat, OsMon
+from .trace import TraceManager, TraceSpec
+
+__all__ = [
+    "Alarm",
+    "AlarmManager",
+    "LatencyStats",
+    "SlowSubs",
+    "Stats",
+    "SysHeartbeat",
+    "OsMon",
+    "TraceManager",
+    "TraceSpec",
+]

@@ -1,0 +1,78 @@
+"""Alarms — `emqx_alarm` analog.
+
+activate/deactivate named alarms with details; deactivated alarms keep
+a bounded history; transitions publish to
+`$SYS/brokers/<node>/alarms/activate|deactivate` so subscribed ops
+tooling sees them (the reference publishes the same topics).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Alarm:
+    name: str
+    details: dict = field(default_factory=dict)
+    message: str = ""
+    activated_at: float = field(default_factory=time.time)
+    deactivated_at: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "details": self.details,
+            "message": self.message,
+            "activated_at": self.activated_at,
+            "deactivated_at": self.deactivated_at,
+        }
+
+
+class AlarmManager:
+    def __init__(self, broker=None, node: str = "emqx_tpu", history_size: int = 1000):
+        self.broker = broker
+        self.node = node
+        self.history_size = history_size
+        self.active: Dict[str, Alarm] = {}
+        self.history: List[Alarm] = []
+
+    def activate(self, name: str, details: Optional[dict] = None, message: str = "") -> bool:
+        """Returns False if already active (`{error, already_existed}`)."""
+        if name in self.active:
+            return False
+        alarm = Alarm(name=name, details=details or {}, message=message or name)
+        self.active[name] = alarm
+        self._publish("activate", alarm)
+        return True
+
+    def deactivate(self, name: str) -> bool:
+        alarm = self.active.pop(name, None)
+        if alarm is None:
+            return False
+        alarm.deactivated_at = time.time()
+        self.history.append(alarm)
+        del self.history[: -self.history_size]
+        self._publish("deactivate", alarm)
+        return True
+
+    def is_active(self, name: str) -> bool:
+        return name in self.active
+
+    def delete_all_deactivated(self) -> None:
+        self.history.clear()
+
+    def _publish(self, kind: str, alarm: Alarm) -> None:
+        if self.broker is None:
+            return
+        from ..broker.message import Message
+
+        self.broker.publish(
+            Message(
+                topic=f"$SYS/brokers/{self.node}/alarms/{kind}",
+                payload=json.dumps(alarm.to_dict()).encode(),
+            )
+        )

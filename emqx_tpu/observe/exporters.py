@@ -1,0 +1,80 @@
+"""Metric exporters: Prometheus exposition + push, StatsD UDP.
+
+`emqx_prometheus` pushes to a pushgateway on a timer and serves the
+standard exposition format; `emqx_statsd` emits counter/gauge lines
+over UDP.  Both are reproduced on the stdlib only (urllib / socket).
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+from typing import Dict, Optional
+from urllib import request as urlrequest
+
+
+def _san(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def render_prometheus(
+    metrics: Dict[str, float],
+    stats: Optional[Dict[str, float]] = None,
+    prefix: str = "emqx",
+) -> str:
+    """Prometheus text exposition of the counter + gauge tables."""
+    lines = []
+    for name, value in sorted(metrics.items()):
+        mn = f"{prefix}_{_san(name)}"
+        lines.append(f"# TYPE {mn} counter")
+        lines.append(f"{mn} {value}")
+    for name, value in sorted((stats or {}).items()):
+        mn = f"{prefix}_{_san(name)}"
+        lines.append(f"# TYPE {mn} gauge")
+        lines.append(f"{mn} {value}")
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusPush:
+    """Push-gateway exporter (`emqx_prometheus.erl` push mode)."""
+
+    def __init__(self, gateway_url: str, job: str = "emqx_tpu", timeout: float = 5.0):
+        self.url = gateway_url.rstrip("/") + f"/metrics/job/{job}"
+        self.timeout = timeout
+
+    def push(self, metrics: Dict[str, float], stats: Optional[Dict[str, float]] = None) -> bool:
+        body = render_prometheus(metrics, stats).encode()
+        req = urlrequest.Request(self.url, data=body, method="POST")
+        req.add_header("Content-Type", "text/plain")
+        try:
+            with urlrequest.urlopen(req, timeout=self.timeout) as resp:
+                return 200 <= resp.status < 300
+        except Exception:
+            return False
+
+
+class StatsdExporter:
+    """StatsD line protocol over UDP (`emqx_statsd` analog)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125, prefix: str = "emqx"):
+        self.addr = (host, port)
+        self.prefix = prefix
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def flush(self, metrics: Dict[str, float], stats: Optional[Dict[str, float]] = None) -> int:
+        n = 0
+        for name, value in metrics.items():
+            n += self._send(f"{self.prefix}.{name}:{value}|c")
+        for name, value in (stats or {}).items():
+            n += self._send(f"{self.prefix}.{name}:{value}|g")
+        return n
+
+    def _send(self, line: str) -> int:
+        try:
+            self._sock.sendto(line.encode(), self.addr)
+            return 1
+        except OSError:
+            return 0
+
+    def close(self) -> None:
+        self._sock.close()

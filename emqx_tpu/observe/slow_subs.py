@@ -1,0 +1,89 @@
+"""Slow-subscriber tracking — `emqx_slow_subs` analog.
+
+Per-session EMA + peak delivery latency
+(`emqx_message_latency_stats.erl`) feeding a bounded top-K table of the
+slowest subscribers; entries expire so recovered clients drop out.
+Latency = deliver time - message timestamp, the same definition the
+reference uses for its `latency_stats`.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class LatencyStats:
+    ema_ms: float = 0.0
+    peak_ms: float = 0.0
+    samples: int = 0
+    alpha: float = 0.3  # reference's default smoothing
+
+    def update(self, latency_ms: float) -> None:
+        self.samples += 1
+        if self.samples == 1:
+            self.ema_ms = latency_ms
+        else:
+            self.ema_ms = self.alpha * latency_ms + (1 - self.alpha) * self.ema_ms
+        self.peak_ms = max(self.peak_ms, latency_ms)
+
+
+class SlowSubs:
+    def __init__(
+        self,
+        top_k: int = 10,
+        threshold_ms: float = 500.0,
+        expire_s: float = 300.0,
+    ):
+        self.top_k = top_k
+        self.threshold_ms = threshold_ms
+        self.expire_s = expire_s
+        self.stats: Dict[str, LatencyStats] = {}
+        self._table: Dict[str, Tuple[float, float]] = {}  # cid -> (ema, ts)
+
+    def install(self, hooks) -> None:
+        hooks.put("message.delivered", self._on_delivered, priority=-400)
+
+    def _on_delivered(self, clientid: str, msg) -> None:
+        now_ms = time.time() * 1000.0
+        if not msg.timestamp:
+            return
+        self.record(clientid, max(now_ms - msg.timestamp, 0.0))
+
+    def record(self, clientid: str, latency_ms: float) -> None:
+        st = self.stats.setdefault(clientid, LatencyStats())
+        st.update(latency_ms)
+        if st.ema_ms >= self.threshold_ms:
+            self._table[clientid] = (st.ema_ms, time.time())
+            self._trim()
+
+    def _trim(self) -> None:
+        if len(self._table) <= self.top_k:
+            return
+        ranked = sorted(self._table.items(), key=lambda kv: -kv[1][0])
+        self._table = dict(ranked[: self.top_k])
+
+    def clear_client(self, clientid: str) -> None:
+        self.stats.pop(clientid, None)
+        self._table.pop(clientid, None)
+
+    def top(self, now: Optional[float] = None) -> List[dict]:
+        """Slowest subscribers, expired entries pruned."""
+        now = now if now is not None else time.time()
+        for cid, (_, ts) in list(self._table.items()):
+            if now - ts > self.expire_s:
+                del self._table[cid]
+        out = []
+        for cid, (ema, ts) in sorted(self._table.items(), key=lambda kv: -kv[1][0]):
+            st = self.stats.get(cid)
+            out.append(
+                {
+                    "clientid": cid,
+                    "ema_ms": round(ema, 3),
+                    "peak_ms": round(st.peak_ms, 3) if st else None,
+                    "last_update": ts,
+                }
+            )
+        return out

@@ -1,0 +1,49 @@
+"""Periodic gauges — the `emqx_stats` analog.
+
+The reference keeps a gauge ETS updated by timers (connections.count,
+routes.count, subscriptions.count, retained.count...) plus historical
+maxima.  Here `collect()` pulls the current values straight from the
+broker's components; `setstat` allows ad-hoc gauges; `.max` values
+track high-water marks like the reference's `connections.max`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class Stats:
+    def __init__(self, broker=None):
+        self.broker = broker
+        self._gauges: Dict[str, float] = {}
+        self._maxima: Dict[str, float] = {}
+
+    def setstat(self, name: str, value: float) -> None:
+        self._gauges[name] = value
+        mx = name + ".max"
+        if value > self._maxima.get(mx, float("-inf")):
+            self._maxima[mx] = value
+
+    def getstat(self, name: str) -> Optional[float]:
+        if name.endswith(".max"):
+            return self._maxima.get(name)
+        return self._gauges.get(name)
+
+    def collect(self) -> Dict[str, float]:
+        """Refresh broker-derived gauges and return the full table."""
+        b = self.broker
+        if b is not None:
+            cm = b.cm
+            self.setstat("connections.count", cm.connection_count)
+            self.setstat("sessions.count", cm.session_count)
+            self.setstat("subscriptions.count", b.subscription_count)
+            self.setstat("topics.count", b.route_count)
+            self.setstat("routes.count", b.route_count)
+            self.setstat("retained.count", b.retainer.count)
+            cluster = getattr(b, "cluster", None)
+            if cluster is not None:
+                self.setstat("cluster.routes.count", cluster.remote.route_count)
+                self.setstat("cluster.nodes.up", len(cluster.up_peers()))
+        out = dict(self._gauges)
+        out.update(self._maxima)
+        return out

@@ -1,0 +1,113 @@
+"""$SYS heartbeats + OS monitoring — `emqx_sys`/`emqx_os_mon` analog.
+
+`SysHeartbeat.tick()` publishes broker version/uptime/datetime plus the
+stats and metrics tables under `$SYS/brokers/<node>/...`, exactly the
+topic families the reference emits on its sys_interval timer.
+
+`OsMon.check()` samples /proc (linux) for memory + load and raises or
+clears alarms against configured thresholds (`emqx_os_mon` semantics;
+the reference alarms at 70% sysmem / 5% procmem / load 0.8 defaults).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+from .alarm import AlarmManager
+
+VERSION = "5.0.0-tpu.1"
+
+
+class SysHeartbeat:
+    def __init__(self, broker, stats=None, node: str = "emqx_tpu"):
+        self.broker = broker
+        self.stats = stats
+        self.node = node
+        self.started_at = time.time()
+
+    @property
+    def uptime_s(self) -> float:
+        return time.time() - self.started_at
+
+    def _pub(self, suffix: str, payload) -> None:
+        from ..broker.message import Message
+
+        if not isinstance(payload, (bytes, bytearray)):
+            payload = (
+                payload.encode()
+                if isinstance(payload, str)
+                else json.dumps(payload).encode()
+            )
+        self.broker.publish(
+            Message(topic=f"$SYS/brokers/{self.node}/{suffix}", payload=payload)
+        )
+
+    def tick(self) -> None:
+        """One sys_interval heartbeat."""
+        self._pub("version", VERSION)
+        self._pub("uptime", str(int(self.uptime_s)))
+        self._pub("datetime", time.strftime("%Y-%m-%d %H:%M:%S"))
+        if self.stats is not None:
+            self._pub("stats", self.stats.collect())
+        self._pub("metrics", self.broker.metrics.all())
+
+
+class OsMon:
+    def __init__(
+        self,
+        alarms: AlarmManager,
+        mem_high_watermark: float = 0.70,
+        load_high_watermark: float = 0.80,
+    ):
+        self.alarms = alarms
+        self.mem_high = mem_high_watermark
+        self.load_high = load_high_watermark
+
+    @staticmethod
+    def mem_usage() -> Optional[float]:
+        try:
+            info: Dict[str, int] = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, rest = line.partition(":")
+                    info[k] = int(rest.split()[0])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", 0)
+            if not total:
+                return None
+            return 1.0 - avail / total
+        except (OSError, ValueError, IndexError):
+            return None
+
+    @staticmethod
+    def load_per_core() -> Optional[float]:
+        try:
+            import os
+
+            with open("/proc/loadavg") as f:
+                load1 = float(f.read().split()[0])
+            return load1 / max(os.cpu_count() or 1, 1)
+        except (OSError, ValueError, IndexError):
+            return None
+
+    def check(self) -> None:
+        mem = self.mem_usage()
+        if mem is not None:
+            if mem >= self.mem_high:
+                self.alarms.activate(
+                    "high_system_memory_usage",
+                    {"usage": round(mem, 3), "high_watermark": self.mem_high},
+                )
+            else:
+                self.alarms.deactivate("high_system_memory_usage")
+        load = self.load_per_core()
+        if load is not None:
+            if load >= self.load_high:
+                self.alarms.activate(
+                    "high_cpu_load",
+                    {"load_per_core": round(load, 3), "high_watermark": self.load_high},
+                )
+            else:
+                self.alarms.deactivate("high_cpu_load")

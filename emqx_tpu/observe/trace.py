@@ -1,0 +1,136 @@
+"""Managed trace sessions — `emqx_trace`/`emqx_trace_handler` analog.
+
+A trace spec filters by clientid, topic filter, or peer IP and streams
+matching broker events (publish/subscribe/connect/deliver...) to its
+own log file, with start/stop lifecycle and bounded concurrent traces —
+the reference installs per-trace OTP logger handlers with the same
+three filter kinds (`emqx_trace_handler.erl:34-36,63-90`).
+
+Wired in as hook callbacks, so it sees exactly what extensions see.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..broker import topic as topiclib
+
+
+@dataclass
+class TraceSpec:
+    name: str
+    kind: str  # clientid | topic | ip
+    value: str
+    path: str
+    start_at: float = field(default_factory=time.time)
+    end_at: Optional[float] = None
+
+    def matches(self, clientid: str, topic: Optional[str], ip: Optional[str]) -> bool:
+        if self.kind == "clientid":
+            return clientid == self.value
+        if self.kind == "topic":
+            return topic is not None and topiclib.match(topic, self.value)
+        if self.kind == "ip":
+            return ip == self.value
+        return False
+
+
+class TraceManager:
+    MAX_TRACES = 30  # reference caps concurrent traces
+
+    def __init__(self, hooks, directory: str = "trace"):
+        self.hooks = hooks
+        self.dir = directory
+        self.traces: Dict[str, TraceSpec] = {}
+        self._files: Dict[str, object] = {}
+        self._installed = False
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start_trace(
+        self, name: str, kind: str, value: str, end_at: Optional[float] = None
+    ) -> TraceSpec:
+        if name in self.traces:
+            raise ValueError(f"trace {name!r} already exists")
+        if len(self.traces) >= self.MAX_TRACES:
+            raise ValueError("too many traces")
+        if kind not in ("clientid", "topic", "ip"):
+            raise ValueError(f"bad trace kind {kind!r}")
+        os.makedirs(self.dir, exist_ok=True)
+        path = os.path.join(self.dir, f"trace_{name}.log")
+        spec = TraceSpec(name=name, kind=kind, value=value, path=path, end_at=end_at)
+        self.traces[name] = spec
+        self._files[name] = open(path, "a", buffering=1)
+        self._ensure_hooks()
+        return spec
+
+    def stop_trace(self, name: str) -> bool:
+        spec = self.traces.pop(name, None)
+        f = self._files.pop(name, None)
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+        return spec is not None
+
+    def list_traces(self) -> List[TraceSpec]:
+        return list(self.traces.values())
+
+    def stop_all(self) -> None:
+        for name in list(self.traces):
+            self.stop_trace(name)
+
+    # -------------------------------------------------------------- events
+
+    def _ensure_hooks(self) -> None:
+        if self._installed:
+            return
+        self.hooks.put("message.publish", self._on_publish, priority=-500)
+        self.hooks.put("session.subscribed", self._on_subscribed, priority=-500)
+        self.hooks.put("session.unsubscribed", self._on_unsubscribed, priority=-500)
+        self.hooks.put("client.connected", self._on_connected, priority=-500)
+        self.hooks.put("message.delivered", self._on_delivered, priority=-500)
+        self._installed = True
+
+    def _emit(self, event: str, clientid: str, topic: Optional[str],
+              ip: Optional[str], extra: dict) -> None:
+        now = time.time()
+        for name, spec in list(self.traces.items()):
+            if spec.end_at is not None and now >= spec.end_at:
+                self.stop_trace(name)
+                continue
+            if not spec.matches(clientid, topic, ip):
+                continue
+            rec = {"ts": round(now, 6), "event": event, "clientid": clientid}
+            if topic is not None:
+                rec["topic"] = topic
+            rec.update(extra)
+            f = self._files.get(name)
+            if f is not None:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+
+    def _on_publish(self, msg):
+        ip = msg.headers.get("peername") if isinstance(msg.headers, dict) else None
+        self._emit(
+            "PUBLISH", msg.from_client, msg.topic, ip,
+            {"qos": msg.qos, "retain": msg.retain, "payload_len": len(msg.payload)},
+        )
+        return None  # fold passthrough
+
+    def _on_subscribed(self, clientid, filt, *a):
+        self._emit("SUBSCRIBE", clientid, filt, None, {})
+
+    def _on_unsubscribed(self, clientid, filt, *a):
+        self._emit("UNSUBSCRIBE", clientid, filt, None, {})
+
+    def _on_connected(self, clientinfo, *a):
+        ip = getattr(clientinfo, "peername", None)
+        self._emit("CONNECTED", clientinfo.clientid, None, ip, {})
+
+    def _on_delivered(self, clientid, msg):
+        self._emit("DELIVER", clientid, msg.topic, None, {"qos": msg.qos})

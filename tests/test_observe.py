@@ -1,0 +1,187 @@
+"""Observability stack: stats, $SYS, alarms, trace, slow subs, exporters."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.session import Session
+from emqx_tpu.observe import (
+    AlarmManager,
+    LatencyStats,
+    OsMon,
+    SlowSubs,
+    Stats,
+    SysHeartbeat,
+    TraceManager,
+)
+from emqx_tpu.observe.exporters import StatsdExporter, render_prometheus
+
+
+class Sink:
+    def __init__(self, clientid, session):
+        self.clientid = clientid
+        self.session = session
+        self.got = []
+
+    def deliver(self, items):
+        self.got.extend(items)
+
+    def kick(self, rc=0):
+        pass
+
+
+def attach(b, clientid, filt, qos=0):
+    s = Session(clientid=clientid)
+    s.subscriptions[filt] = SubOpts(qos=qos)
+    sink = Sink(clientid, s)
+    b.cm.register_channel(sink)
+    b.subscribe(clientid, filt, SubOpts(qos=qos))
+    return sink
+
+
+def test_stats_collect():
+    b = Broker()
+    attach(b, "c1", "a/#")
+    attach(b, "c2", "b/+")
+    b.publish(Message(topic="r/t", payload=b"x", retain=True))
+    st = Stats(b)
+    out = st.collect()
+    assert out["connections.count"] == 2
+    assert out["subscriptions.count"] == 2
+    assert out["routes.count"] == 2
+    assert out["retained.count"] == 1
+    # high-water mark survives drops
+    b.cm.kick_session("c1")
+    b.cm.kick_session("c2")
+    out = st.collect()
+    assert out["connections.count"] == 0
+    assert out["connections.count.max"] == 2
+
+
+def test_sys_heartbeat_topics():
+    b = Broker()
+    sink = attach(b, "ops", "$SYS/brokers/#")
+    hb = SysHeartbeat(b, Stats(b), node="n0")
+    hb.tick()
+    topics = [m.topic for _, m in sink.got]
+    assert "$SYS/brokers/n0/version" in topics
+    assert "$SYS/brokers/n0/uptime" in topics
+    stats_msgs = [m for _, m in sink.got if m.topic.endswith("/stats")]
+    assert stats_msgs and "connections.count" in json.loads(stats_msgs[0].payload)
+
+
+def test_alarm_lifecycle_and_sys_publish():
+    b = Broker()
+    sink = attach(b, "ops", "$SYS/brokers/n0/alarms/+")
+    am = AlarmManager(b, node="n0")
+    assert am.activate("conn_congestion", {"limit": 100})
+    assert not am.activate("conn_congestion")  # already active
+    assert am.is_active("conn_congestion")
+    assert am.deactivate("conn_congestion")
+    assert not am.deactivate("conn_congestion")
+    assert len(am.history) == 1
+    kinds = [m.topic.rsplit("/", 1)[1] for _, m in sink.got]
+    assert kinds == ["activate", "deactivate"]
+
+
+def test_os_mon_thresholds():
+    am = AlarmManager()
+    mon = OsMon(am, mem_high_watermark=0.0, load_high_watermark=0.0)
+    mon.check()  # any usage >= 0.0 -> both alarms fire
+    assert am.is_active("high_system_memory_usage")
+    assert am.is_active("high_cpu_load")
+    mon2 = OsMon(am, mem_high_watermark=1.01, load_high_watermark=1e9)
+    mon2.check()
+    assert not am.is_active("high_system_memory_usage")
+    assert not am.is_active("high_cpu_load")
+
+
+def test_trace_by_clientid_and_topic(tmp_path):
+    b = Broker()
+    tm = TraceManager(b.hooks, directory=str(tmp_path))
+    tm.start_trace("t1", "clientid", "alice")
+    tm.start_trace("t2", "topic", "sensors/#")
+    attach(b, "bob", "sensors/+")
+    b.publish(Message(topic="sensors/1", payload=b"x", from_client="alice"))
+    b.publish(Message(topic="other/1", payload=b"y", from_client="alice"))
+    b.publish(Message(topic="sensors/2", payload=b"z", from_client="carol"))
+    tm.stop_all()
+
+    t1 = [json.loads(l) for l in open(tmp_path / "trace_t1.log")]
+    assert {r["topic"] for r in t1 if r["event"] == "PUBLISH"} == {"sensors/1", "other/1"}
+    t2 = [json.loads(l) for l in open(tmp_path / "trace_t2.log")]
+    pubs = {r["topic"] for r in t2 if r["event"] == "PUBLISH"}
+    assert pubs == {"sensors/1", "sensors/2"}
+    # delivery to bob traced under topic filter too
+    assert any(r["event"] == "DELIVER" and r["clientid"] == "bob" for r in t2)
+
+
+def test_trace_limits(tmp_path):
+    b = Broker()
+    tm = TraceManager(b.hooks, directory=str(tmp_path))
+    tm.start_trace("dup", "clientid", "x")
+    with pytest.raises(ValueError):
+        tm.start_trace("dup", "clientid", "x")
+    with pytest.raises(ValueError):
+        tm.start_trace("bad", "nope", "x")
+    tm.stop_all()
+
+
+def test_slow_subs_topk_and_expiry():
+    ss = SlowSubs(top_k=2, threshold_ms=100.0, expire_s=10.0)
+    ss.record("fast", 5.0)
+    ss.record("slow1", 500.0)
+    ss.record("slow2", 300.0)
+    ss.record("slow3", 800.0)
+    top = ss.top()
+    assert [e["clientid"] for e in top] == ["slow3", "slow1"]  # top-2 only
+    # expiry prunes
+    ss._table["slow3"] = (ss._table["slow3"][0], time.time() - 60)
+    assert [e["clientid"] for e in ss.top()] == ["slow1"]
+
+
+def test_slow_subs_hook_integration():
+    b = Broker()
+    ss = SlowSubs(threshold_ms=0.0)
+    ss.install(b.hooks)
+    attach(b, "sub", "l/#")
+    old = Message(topic="l/1", payload=b"x")
+    old.timestamp -= 1000  # 1s old -> latency ~1000ms
+    b.publish(old)
+    assert ss.stats["sub"].ema_ms >= 900
+
+
+def test_latency_ema():
+    st = LatencyStats()
+    st.update(100.0)
+    assert st.ema_ms == 100.0
+    st.update(200.0)
+    assert 100.0 < st.ema_ms < 200.0 and st.peak_ms == 200.0
+
+
+def test_prometheus_rendering():
+    out = render_prometheus(
+        {"messages.received": 5}, {"connections.count": 2}
+    )
+    assert "# TYPE emqx_messages_received counter" in out
+    assert "emqx_messages_received 5" in out
+    assert "emqx_connections_count 2" in out
+
+
+def test_statsd_udp():
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5)
+    port = rx.getsockname()[1]
+    ex = StatsdExporter(port=port)
+    n = ex.flush({"m.one": 3}, {"g.two": 7.5})
+    assert n == 2
+    got = {rx.recv(1024).decode() for _ in range(2)}
+    assert got == {"emqx.m.one:3|c", "emqx.g.two:7.5|g"}
+    ex.close()
+    rx.close()
