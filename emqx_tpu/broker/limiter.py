@@ -1,0 +1,175 @@
+"""Rate limiting + overload protection — `emqx_limiter`/`emqx_olp` analog.
+
+The reference runs a hierarchical token bucket server: per-client
+buckets refill from shared zone buckets, limiting connection rate,
+inbound message rate, and inbound bytes (SURVEY.md §2.1 Limiter row).
+`emqx_olp` defers load (new connections, GC) when the VM is congested;
+`emqx_congestion` raises alarms when a socket's send buffer backs up.
+
+Redesign for the asyncio host plane:
+  * `TokenBucket` — monotonic-clock lazy refill, optional parent chain
+    (child consume draws from every ancestor, the htb topology);
+  * `Limiter` — named root buckets per zone with `client()` children;
+  * an over-budget connection coroutine simply `await`s its wait time —
+    the per-task analog of the reference parking a process in the
+    limiter server's queue;
+  * `Olp` — event-loop lag watermark gate for new connections;
+  * `Congestion` — write-buffer watermark alarms per connection.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class TokenBucket:
+    def __init__(
+        self,
+        rate: float,
+        burst: Optional[float] = None,
+        parent: Optional["TokenBucket"] = None,
+    ):
+        """rate: tokens/second; burst: bucket capacity (default = rate)."""
+        self.rate = float(rate)
+        self.capacity = float(burst if burst is not None else rate)
+        self.parent = parent
+        self.tokens = self.capacity
+        self._t = time.monotonic()
+
+    def _refill(self, now: float) -> None:
+        dt = now - self._t
+        if dt > 0:
+            self.tokens = min(self.capacity, self.tokens + dt * self.rate)
+            self._t = now
+
+    def try_consume(self, n: float = 1.0, now: Optional[float] = None) -> bool:
+        """Atomically take n tokens from self and all ancestors."""
+        now = now if now is not None else time.monotonic()
+        chain = []
+        node: Optional[TokenBucket] = self
+        while node is not None:
+            node._refill(now)
+            if node.tokens < n:
+                return False
+            chain.append(node)
+            node = node.parent
+        for node in chain:
+            node.tokens -= n
+        return True
+
+    def wait_time(self, n: float = 1.0, now: Optional[float] = None) -> float:
+        """Seconds until n tokens could be available along the chain."""
+        now = now if now is not None else time.monotonic()
+        worst = 0.0
+        node: Optional[TokenBucket] = self
+        while node is not None:
+            node._refill(now)
+            if node.tokens < n:
+                if node.rate <= 0:
+                    return float("inf")
+                worst = max(worst, (n - node.tokens) / node.rate)
+            node = node.parent
+        return worst
+
+
+class Limiter:
+    """Zone-level shared buckets with per-client children.
+
+    kinds mirror the reference's limiter types: "connection" (accept
+    rate), "message_in" (PUBLISH/s), "bytes_in" (inbound bytes/s).
+    rate <= 0 disables a kind (infinite).
+    """
+
+    KINDS = ("connection", "message_in", "bytes_in")
+
+    def __init__(self, **rates: Optional[dict]):
+        # rates: kind -> {"rate": r, "burst": b, "client_rate": cr,
+        #                 "client_burst": cb}
+        self.roots: Dict[str, TokenBucket] = {}
+        self.client_cfg: Dict[str, dict] = {}
+        for kind in self.KINDS:
+            cfg = rates.get(kind)
+            if not cfg or cfg.get("rate", 0) <= 0:
+                continue
+            self.roots[kind] = TokenBucket(cfg["rate"], cfg.get("burst"))
+            self.client_cfg[kind] = cfg
+
+    def enabled(self, kind: str) -> bool:
+        return kind in self.roots
+
+    def check(self, kind: str, n: float = 1.0) -> bool:
+        """Zone-level check (connection accepts use this directly)."""
+        root = self.roots.get(kind)
+        return True if root is None else root.try_consume(n)
+
+    def client(self, kind: str) -> Optional[TokenBucket]:
+        """A fresh per-client bucket chained to the zone root."""
+        root = self.roots.get(kind)
+        if root is None:
+            return None
+        cfg = self.client_cfg[kind]
+        rate = cfg.get("client_rate") or cfg["rate"]
+        burst = cfg.get("client_burst") or cfg.get("burst")
+        return TokenBucket(rate, burst, parent=root)
+
+
+class Olp:
+    """Overload protection: shed new connections under event-loop lag.
+
+    The reference's `lc` flags the VM overloaded from run-queue length;
+    here the listener housekeeping loop reports its own scheduling lag
+    (`note_lag`), and while the high watermark was crossed recently,
+    `should_accept()` answers False (`emqx_olp:backoff_new_conn`).
+    """
+
+    def __init__(self, lag_high_s: float = 0.5, cooldown_s: float = 5.0):
+        self.lag_high = lag_high_s
+        self.cooldown = cooldown_s
+        self._overloaded_until = 0.0
+        self.shed_count = 0
+
+    def note_lag(self, lag_s: float, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.monotonic()
+        if lag_s >= self.lag_high:
+            self._overloaded_until = now + self.cooldown
+
+    @property
+    def overloaded(self) -> bool:
+        return time.monotonic() < self._overloaded_until
+
+    def should_accept(self) -> bool:
+        if self.overloaded:
+            self.shed_count += 1
+            return False
+        return True
+
+
+class Congestion:
+    """Per-connection TCP send-buffer congestion alarms
+    (`emqx_congestion.erl`): alarm when the asyncio transport's write
+    buffer exceeds the high watermark, clear once fully drained."""
+
+    def __init__(self, alarms=None, high_watermark: int = 1_048_576):
+        self.alarms = alarms
+        self.high = high_watermark
+        self.congested: set = set()
+
+    def check(self, clientid: str, writer) -> bool:
+        try:
+            size = writer.transport.get_write_buffer_size()
+        except Exception:
+            return False
+        if size > self.high and clientid not in self.congested:
+            self.congested.add(clientid)
+            if self.alarms is not None:
+                self.alarms.activate(
+                    f"conn_congestion/{clientid}",
+                    {"buffer": size, "high_watermark": self.high},
+                )
+            return True
+        if size == 0 and clientid in self.congested:
+            self.congested.discard(clientid)
+            if self.alarms is not None:
+                self.alarms.deactivate(f"conn_congestion/{clientid}")
+        return clientid in self.congested

@@ -37,12 +37,16 @@ class Connection:
         writer: asyncio.StreamWriter,
         config: Optional[ChannelConfig] = None,
         max_packet_size: int = 1_048_576,
+        limiter=None,
     ):
         peer = writer.get_extra_info("peername")
         peername = f"{peer[0]}:{peer[1]}" if peer else "?"
         self.reader = reader
         self.writer = writer
         self.parser = Parser(max_size=max_packet_size)
+        # per-client token buckets chained to the listener's zone roots
+        self._bytes_bucket = limiter.client("bytes_in") if limiter else None
+        self._msg_bucket = limiter.client("message_in") if limiter else None
         self.channel = Channel(broker, config=config, peername=peername)
         self.channel.out_cb = self._send_actions
         self.channel.on_kick = self._on_kick
@@ -124,6 +128,8 @@ class Connection:
                     break
                 self._last_rx = time.monotonic()
                 m.inc("bytes.received", len(data))
+                if self._bytes_bucket is not None:
+                    await self._acquire(self._bytes_bucket, len(data), "bytes_in")
                 try:
                     packets = self.parser.feed(data)
                 except FrameError as e:
@@ -140,6 +146,11 @@ class Connection:
                     self._normal = False
                     break
                 for p in packets:
+                    if (
+                        self._msg_bucket is not None
+                        and getattr(p, "type", None) == pkt.PacketType.PUBLISH
+                    ):
+                        await self._acquire(self._msg_bucket, 1, "message_in")
                     self._send_actions(self.channel.handle_in(p))
                     if self._closing is not None:
                         break
@@ -148,6 +159,14 @@ class Connection:
             self._normal = False
         finally:
             await self._shutdown()
+
+    async def _acquire(self, bucket, n: float, kind: str) -> None:
+        """Park this connection's coroutine until n tokens are granted —
+        the asyncio analog of the reference parking a client process in
+        the limiter server's queue (backpressure, never drops)."""
+        while not bucket.try_consume(n):
+            self.channel.broker.metrics.inc(f"olp.delayed.{kind}")
+            await asyncio.sleep(min(max(bucket.wait_time(n), 0.001), 5.0))
 
     async def _drain(self) -> None:
         try:
@@ -193,6 +212,8 @@ class Listener:
         max_connections: int = 0,
         batcher=None,  # PublishBatcher: batch publishes across connections
         housekeeping_interval: float = 1.0,
+        limiter=None,
+        olp=None,
     ):
         self.broker = broker
         self.host = host
@@ -201,6 +222,8 @@ class Listener:
         self.max_connections = max_connections
         self.batcher = batcher
         self.housekeeping_interval = housekeeping_interval
+        self.limiter = limiter
+        self.olp = olp
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
         self._hk_task: Optional[asyncio.Task] = None
@@ -229,7 +252,12 @@ class Listener:
         timers + `emqx_cm`/retainer GC processes in the reference)."""
         n = 0
         while True:
+            t0 = time.monotonic()
             await asyncio.sleep(self.housekeeping_interval)
+            if self.olp is not None:
+                # scheduling lag of this loop = how overloaded the host is
+                lag = time.monotonic() - t0 - self.housekeeping_interval
+                self.olp.note_lag(lag)
             n += 1
             try:
                 now = time.time()
@@ -264,7 +292,18 @@ class Listener:
         if self.max_connections and len(self._conns) >= self.max_connections:
             writer.close()
             return
-        conn = Connection(self.broker, reader, writer, self.config)
+        if self.olp is not None and not self.olp.should_accept():
+            # overloaded: shed before any protocol work (emqx_olp)
+            self.broker.metrics.inc("olp.new_conn.shed")
+            writer.close()
+            return
+        if self.limiter is not None and not self.limiter.check("connection"):
+            self.broker.metrics.inc("olp.new_conn.rate_limited")
+            writer.close()
+            return
+        conn = Connection(
+            self.broker, reader, writer, self.config, limiter=self.limiter
+        )
         if self.batcher is not None:
             conn.channel.publish_fn = self.batcher.submit
         task = asyncio.current_task()

@@ -1,0 +1,139 @@
+"""Limiter / OLP / congestion tests (`emqx_limiter`, `emqx_olp` analogs)."""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient, MqttError
+from emqx_tpu.broker.limiter import Congestion, Limiter, Olp, TokenBucket
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.observe import AlarmManager
+
+
+def test_token_bucket_basic():
+    b = TokenBucket(rate=10, burst=5)
+    now = time.monotonic()
+    assert all(b.try_consume(1, now) for _ in range(5))  # burst drained
+    assert not b.try_consume(1, now)
+    assert 0.0 < b.wait_time(1, now) <= 0.2
+    assert b.try_consume(1, now + 0.2)  # refilled 2 tokens
+
+
+def test_token_bucket_hierarchy():
+    parent = TokenBucket(rate=1, burst=3)
+    c1 = TokenBucket(rate=100, burst=100, parent=parent)
+    c2 = TokenBucket(rate=100, burst=100, parent=parent)
+    now = time.monotonic()
+    assert c1.try_consume(2, now)
+    assert c2.try_consume(1, now)
+    # shared parent exhausted even though children have local tokens
+    assert not c2.try_consume(1, now)
+    assert c2.wait_time(1, now) > 0.5
+
+
+def test_limiter_kinds_and_clients():
+    lim = Limiter(
+        connection={"rate": 2, "burst": 2},
+        bytes_in={"rate": 1000, "client_rate": 100},
+    )
+    assert lim.enabled("connection") and lim.enabled("bytes_in")
+    assert not lim.enabled("message_in")
+    assert lim.check("connection") and lim.check("connection")
+    assert not lim.check("connection")  # burst of 2 spent
+    cb = lim.client("bytes_in")
+    assert cb is not None and cb.parent is lim.roots["bytes_in"]
+    assert lim.client("message_in") is None
+    assert lim.check("message_in")  # disabled kind always allows
+
+
+def test_olp_shedding():
+    olp = Olp(lag_high_s=0.1, cooldown_s=0.2)
+    assert olp.should_accept()
+    olp.note_lag(0.05)
+    assert olp.should_accept()
+    olp.note_lag(0.5)
+    assert olp.overloaded and not olp.should_accept()
+    assert olp.shed_count == 1
+    time.sleep(0.25)
+    assert olp.should_accept()
+
+
+def test_congestion_alarm():
+    class FakeTransport:
+        def __init__(self):
+            self.size = 0
+
+        def get_write_buffer_size(self):
+            return self.size
+
+    class FakeWriter:
+        def __init__(self):
+            self.transport = FakeTransport()
+
+    am = AlarmManager()
+    cg = Congestion(am, high_watermark=100)
+    w = FakeWriter()
+    assert not cg.check("c1", w)
+    w.transport.size = 500
+    assert cg.check("c1", w)
+    assert am.is_active("conn_congestion/c1")
+    w.transport.size = 0
+    assert not cg.check("c1", w)
+    assert not am.is_active("conn_congestion/c1")
+
+
+def test_connection_rate_limit_over_tcp():
+    loop = asyncio.new_event_loop()
+
+    async def main():
+        b = Broker()
+        lim = Limiter(connection={"rate": 0.001, "burst": 1})
+        lst = Listener(b, port=0, limiter=lim)
+        await lst.start()
+        c1 = MqttClient(clientid="ok")
+        await c1.connect(port=lst.port)  # first conn takes the only token
+        c2 = MqttClient(clientid="shed")
+        # rejected pre-CONNACK: the client sees a closed/empty handshake
+        with pytest.raises(Exception):
+            await asyncio.wait_for(c2.connect(port=lst.port), 3)
+        assert b.metrics.get("olp.new_conn.rate_limited") == 1
+        await c1.disconnect()
+        await lst.stop()
+
+    try:
+        loop.run_until_complete(asyncio.wait_for(main(), 30))
+    finally:
+        loop.close()
+
+
+def test_message_rate_limit_delays_not_drops():
+    loop = asyncio.new_event_loop()
+
+    async def main():
+        b = Broker()
+        lim = Limiter(message_in={"rate": 5, "burst": 2})
+        lst = Listener(b, port=0, limiter=lim)
+        await lst.start()
+        sub = MqttClient(clientid="s")
+        await sub.connect(port=lst.port)
+        await sub.subscribe("r/#", qos=0)
+        p = MqttClient(clientid="p")
+        await p.connect(port=lst.port)
+        t0 = time.monotonic()
+        for i in range(6):
+            await p.publish("r/x", b"m%d" % i, qos=0)
+        # all 6 delivered (throttled, never dropped)
+        got = [await asyncio.wait_for(sub.recv(), 10) for _ in range(6)]
+        assert len(got) == 6
+        assert time.monotonic() - t0 >= 0.5  # 4 over-burst @5/s
+        assert b.metrics.get("olp.delayed.message_in") >= 1
+        await p.disconnect()
+        await sub.disconnect()
+        await lst.stop()
+
+    try:
+        loop.run_until_complete(asyncio.wait_for(main(), 30))
+    finally:
+        loop.close()
