@@ -1,0 +1,14 @@
+"""Management plane: REST API, admin tokens, CLI (SURVEY.md §1.12).
+
+`http.py` is the minirest analog (asyncio HTTP/1.1 + route table +
+OpenAPI doc), `api.py` registers the per-noun handlers
+(`emqx_mgmt_api_*` analogs), `token.py` issues HMAC admin tokens
+(`emqx_dashboard_token` analog), `cli.py` is the `emqx ctl` command
+registry usable in-process or against the REST API.
+"""
+
+from .api import ManagementApi
+from .http import HttpApi, HttpError
+from .token import TokenStore
+
+__all__ = ["ManagementApi", "HttpApi", "HttpError", "TokenStore"]

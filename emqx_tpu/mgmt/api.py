@@ -1,0 +1,443 @@
+"""REST handlers per management noun — `emqx_mgmt_api_*` analogs.
+
+Registered nouns mirror the reference's API surface: status, nodes,
+clients (+kick, +subscriptions), subscriptions, topics/routes, publish
+(+bulk), metrics, stats, alarms, banned, listeners, configs, trace,
+slow_subscriptions, api-docs (OpenAPI from the route table + config
+schema).  Pagination uses page/limit query params like the reference.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Any, Dict, List, Optional
+
+from ..broker.broker import Broker
+from ..broker.message import Message
+from .http import HttpApi, HttpError, Request
+from .token import TokenStore
+
+
+def paginate(items: List[Any], req: Request) -> dict:
+    limit = min(req.q_int("limit", 100), 10_000)
+    page = max(req.q_int("page", 1), 1)
+    count = len(items)
+    start = (page - 1) * limit
+    return {
+        "data": items[start : start + limit],
+        "meta": {"page": page, "limit": limit, "count": count},
+    }
+
+
+class ManagementApi:
+    def __init__(
+        self,
+        broker: Broker,
+        node: str = "emqx_tpu",
+        tokens: Optional[TokenStore] = None,
+        stats=None,
+        alarms=None,
+        traces=None,
+        slow_subs=None,
+        banned=None,
+        config=None,
+        cluster=None,
+        listeners: Optional[list] = None,
+        sys_heartbeat=None,
+    ):
+        self.broker = broker
+        self.node = node
+        self.tokens = tokens
+        self.stats = stats
+        self.alarms = alarms
+        self.traces = traces
+        self.slow_subs = slow_subs
+        self.banned = banned
+        self.config = config
+        self.cluster = cluster
+        self.listeners = listeners or []
+        self.sys_heartbeat = sys_heartbeat
+        self.started_at = time.time()
+        self.http: Optional[HttpApi] = None
+
+    # ------------------------------------------------------------- install
+
+    def install(self, http: HttpApi) -> None:
+        self.http = http
+        r = http.route
+        r("POST", "/login", self.login, public=True, doc="Issue an admin token")
+        r("POST", "/logout", self.logout, doc="Revoke the presented token")
+        r("GET", "/status", self.status, public=True, doc="Node liveness")
+        r("GET", "/nodes", self.nodes, doc="Cluster node list")
+        r("GET", "/clients", self.clients, doc="List connected clients")
+        r("GET", "/clients/{clientid}", self.client_get, doc="One client")
+        r("DELETE", "/clients/{clientid}", self.client_kick, doc="Kick a client")
+        r("GET", "/clients/{clientid}/subscriptions", self.client_subs,
+          doc="A client's subscriptions")
+        r("GET", "/subscriptions", self.subscriptions, doc="All subscriptions")
+        r("GET", "/topics", self.topics, doc="Route table")
+        r("GET", "/routes", self.topics, doc="Route table (alias)")
+        r("POST", "/publish", self.publish, doc="Publish one message")
+        r("POST", "/publish/bulk", self.publish_bulk, doc="Publish a batch")
+        r("GET", "/metrics", self.metrics, doc="Counter table")
+        r("GET", "/stats", self.stats_get, doc="Gauge table")
+        r("GET", "/alarms", self.alarms_get, doc="Active/history alarms")
+        r("DELETE", "/alarms", self.alarms_clear, doc="Clear deactivated alarms")
+        r("GET", "/banned", self.banned_get, doc="Ban table")
+        r("POST", "/banned", self.banned_post, doc="Ban a client/ip/user")
+        r("DELETE", "/banned/{kind}/{value}", self.banned_delete, doc="Unban")
+        r("GET", "/listeners", self.listeners_get, doc="Listener status")
+        r("GET", "/configs", self.configs_get, doc="Config dump")
+        r("GET", "/configs/{path}", self.config_get_one, doc="One config key")
+        r("PUT", "/configs/{path}", self.config_put_one, doc="Update config key")
+        r("GET", "/trace", self.trace_list, doc="Trace sessions")
+        r("POST", "/trace", self.trace_start, doc="Start a trace")
+        r("DELETE", "/trace/{name}", self.trace_stop, doc="Stop a trace")
+        r("GET", "/trace/{name}/log", self.trace_log, doc="Download trace log")
+        r("GET", "/slow_subscriptions", self.slow_get, doc="Slowest subscribers")
+        r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+
+    def auth_check(self, token: str) -> bool:
+        if self.tokens is None:
+            return True
+        return self.tokens.verify(token) is not None
+
+    # ---------------------------------------------------------------- auth
+
+    def login(self, req: Request):
+        if self.tokens is None:
+            raise HttpError(404, "token auth disabled")
+        body = req.json() or {}
+        tok = self.tokens.login(body.get("username", ""), body.get("password", ""))
+        if tok is None:
+            return 401, {"code": "BAD_USERNAME_OR_PWD", "message": "bad credentials"}
+        return {"token": tok, "license": {"edition": "opensource"}, "version": "5.0.0"}
+
+    def logout(self, req: Request):
+        if self.tokens is not None:
+            tok = req.headers.get("authorization", "")
+            if tok.lower().startswith("bearer "):
+                self.tokens.revoke(tok[7:])
+        return 204, None
+
+    # ---------------------------------------------------------------- node
+
+    def status(self, req: Request):
+        return {
+            "node": self.node,
+            "status": "running",
+            "version": "5.0.0-tpu.1",
+            "uptime": int(time.time() - self.started_at),
+        }
+
+    def nodes(self, req: Request):
+        me = {
+            "node": self.node,
+            "node_status": "running",
+            "connections": self.broker.cm.connection_count,
+            "subscriptions": self.broker.subscription_count,
+            "routes": self.broker.route_count,
+        }
+        out = [me]
+        if self.cluster is not None:
+            for peer, st in self.cluster.status().items():
+                out.append({
+                    "node": peer,
+                    "node_status": "running" if st == "up" else "stopped",
+                    "routes": len(self.cluster.remote.filters_of(peer)),
+                })
+        return out
+
+    # -------------------------------------------------------------- clients
+
+    def _client_info(self, ch) -> dict:
+        ci = getattr(ch, "clientinfo", None)
+        session = getattr(ch, "session", None)
+        out = {
+            "clientid": ch.clientid,
+            "node": self.node,
+            "connected": True,
+            "username": getattr(ci, "username", None) if ci else None,
+            "peername": getattr(ci, "peerhost", None) if ci else None,
+            "proto_ver": getattr(ch, "proto_ver", None),
+            "connected_at": getattr(ch, "connected_at", None),
+        }
+        if session is not None:
+            out.update(session.info())
+        return out
+
+    def clients(self, req: Request):
+        like = req.q("like_clientid")
+        username = req.q("username")
+        rows = []
+        for cid, ch in self.broker.cm.channels.items():
+            if like and like not in cid:
+                continue
+            if username and getattr(getattr(ch, "clientinfo", None), "username", None) != username:
+                continue
+            rows.append(self._client_info(ch))
+        for cid, (session, _exp) in self.broker.cm.pending.items():
+            if like and like not in cid:
+                continue
+            row = {"clientid": cid, "node": self.node, "connected": False}
+            row.update(session.info())
+            rows.append(row)
+        return paginate(rows, req)
+
+    def _find_client(self, clientid: str):
+        ch = self.broker.cm.lookup(clientid)
+        if ch is not None:
+            return self._client_info(ch)
+        ent = self.broker.cm.pending.get(clientid)
+        if ent is not None:
+            row = {"clientid": clientid, "node": self.node, "connected": False}
+            row.update(ent[0].info())
+            return row
+        return None
+
+    def client_get(self, req: Request):
+        row = self._find_client(req.params["clientid"])
+        if row is None:
+            raise HttpError(404, "client not found")
+        return row
+
+    def client_kick(self, req: Request):
+        if not self.broker.cm.kick_session(req.params["clientid"]):
+            raise HttpError(404, "client not found")
+        return 204, None
+
+    def client_subs(self, req: Request):
+        s = self.broker.cm.lookup_session(req.params["clientid"])
+        if s is None:
+            raise HttpError(404, "client not found")
+        return [
+            {"topic": f, "qos": o.qos, "no_local": o.no_local,
+             "rap": o.retain_as_published, "rh": o.retain_handling}
+            for f, o in s.subscriptions.items()
+        ]
+
+    def subscriptions(self, req: Request):
+        rows = []
+        seen = set()
+        for cid, ch in self.broker.cm.channels.items():
+            s = getattr(ch, "session", None)
+            if s is None or cid in seen:
+                continue
+            seen.add(cid)
+            for f, o in s.subscriptions.items():
+                rows.append({"clientid": cid, "topic": f, "qos": o.qos,
+                             "node": self.node})
+        for cid, (s, _exp) in self.broker.cm.pending.items():
+            for f, o in s.subscriptions.items():
+                rows.append({"clientid": cid, "topic": f, "qos": o.qos,
+                             "node": self.node})
+        return paginate(rows, req)
+
+    # --------------------------------------------------------------- routes
+
+    def topics(self, req: Request):
+        rows = [
+            {"topic": route.filt, "node": self.node}
+            for route in self.broker._routes.values()
+        ]
+        if self.cluster is not None:
+            for filt, nodes in self.cluster.remote.topics().items():
+                for n in nodes:
+                    rows.append({"topic": filt, "node": n})
+        return paginate(rows, req)
+
+    # -------------------------------------------------------------- publish
+
+    def _decode_publish(self, body: dict) -> Message:
+        if not body or "topic" not in body:
+            raise HttpError(400, "missing topic")
+        payload = body.get("payload", "")
+        if body.get("payload_encoding") == "base64":
+            try:
+                payload = base64.b64decode(payload)
+            except Exception:
+                raise HttpError(400, "bad base64 payload")
+        else:
+            payload = str(payload).encode()
+        return Message(
+            topic=body["topic"],
+            payload=payload,
+            qos=int(body.get("qos", 0)),
+            retain=bool(body.get("retain", False)),
+            from_client=body.get("clientid", "http_api"),
+        )
+
+    def publish(self, req: Request):
+        msg = self._decode_publish(req.json())
+        n = self.broker.publish(msg)
+        return {"id": msg.mid.hex(), "delivered": n}
+
+    def publish_bulk(self, req: Request):
+        body = req.json()
+        if not isinstance(body, list):
+            raise HttpError(400, "expected a list")
+        msgs = [self._decode_publish(b) for b in body]
+        ns = self.broker.publish_many(msgs)
+        return [{"id": m.mid.hex(), "delivered": n} for m, n in zip(msgs, ns)]
+
+    # ------------------------------------------------------- metrics/stats
+
+    def metrics(self, req: Request):
+        return self.broker.metrics.all()
+
+    def stats_get(self, req: Request):
+        if self.stats is None:
+            raise HttpError(404, "stats disabled")
+        return self.stats.collect()
+
+    def alarms_get(self, req: Request):
+        if self.alarms is None:
+            raise HttpError(404, "alarms disabled")
+        activated = req.q("activated", "true") == "true"
+        if activated:
+            return [a.to_dict() for a in self.alarms.active.values()]
+        return [a.to_dict() for a in self.alarms.history]
+
+    def alarms_clear(self, req: Request):
+        if self.alarms is None:
+            raise HttpError(404, "alarms disabled")
+        self.alarms.delete_all_deactivated()
+        return 204, None
+
+    # --------------------------------------------------------------- banned
+
+    def banned_get(self, req: Request):
+        if self.banned is None:
+            raise HttpError(404, "banned disabled")
+        return paginate(
+            [
+                {"as": e.kind, "who": e.value, "reason": e.reason,
+                 "by": e.by,
+                 "until": None if e.until == float("inf") else e.until}
+                for e in self.banned.all()
+            ],
+            req,
+        )
+
+    def banned_post(self, req: Request):
+        if self.banned is None:
+            raise HttpError(404, "banned disabled")
+        b = req.json() or {}
+        kind, who = b.get("as"), b.get("who")
+        if kind not in ("clientid", "username", "peerhost") or not who:
+            raise HttpError(400, "need as=clientid|username|peerhost and who")
+        self.banned.create(kind, who, reason=b.get("reason", ""),
+                           by=b.get("by", "mgmt_api"),
+                           duration=b.get("seconds"))
+        return 201, {"as": kind, "who": who}
+
+    def banned_delete(self, req: Request):
+        if self.banned is None:
+            raise HttpError(404, "banned disabled")
+        if not self.banned.delete(req.params["kind"], req.params["value"]):
+            raise HttpError(404, "not banned")
+        return 204, None
+
+    # ------------------------------------------------------------ listeners
+
+    def listeners_get(self, req: Request):
+        return [
+            {
+                "id": f"tcp:{getattr(l, 'port', '?')}",
+                "type": type(l).__name__,
+                "bind": f"{getattr(l, 'host', '?')}:{getattr(l, 'port', '?')}",
+                "running": getattr(l, "_server", None) is not None,
+                "current_connections": len(getattr(l, "_conns", ())),
+                "max_connections": getattr(l, "max_connections", 0),
+            }
+            for l in self.listeners
+        ]
+
+    # -------------------------------------------------------------- configs
+
+    def configs_get(self, req: Request):
+        if self.config is None:
+            raise HttpError(404, "config disabled")
+        return self.config.dump()
+
+    def config_get_one(self, req: Request):
+        if self.config is None:
+            raise HttpError(404, "config disabled")
+        path = req.params["path"]
+        value = self.config.get(path, zone=req.q("zone"))
+        if value is None:
+            raise HttpError(404, f"no config {path}")
+        return {path: value}
+
+    def config_put_one(self, req: Request):
+        if self.config is None:
+            raise HttpError(404, "config disabled")
+        body = req.json() or {}
+        if "value" not in body:
+            raise HttpError(400, "need {\"value\": ...}")
+        path = req.params["path"]
+        try:
+            value = self.config.put(path, body["value"])
+        except Exception as e:
+            raise HttpError(400, str(e))
+        return {path: value}
+
+    # ---------------------------------------------------------------- trace
+
+    def trace_list(self, req: Request):
+        if self.traces is None:
+            raise HttpError(404, "trace disabled")
+        return [
+            {"name": t.name, "type": t.kind, t.kind: t.value,
+             "start_at": t.start_at, "end_at": t.end_at}
+            for t in self.traces.list_traces()
+        ]
+
+    def trace_start(self, req: Request):
+        if self.traces is None:
+            raise HttpError(404, "trace disabled")
+        b = req.json() or {}
+        try:
+            spec = self.traces.start_trace(
+                b.get("name", ""), b.get("type", ""),
+                b.get(b.get("type", ""), b.get("value", "")),
+                end_at=b.get("end_at"),
+            )
+        except ValueError as e:
+            raise HttpError(400, str(e))
+        return 201, {"name": spec.name}
+
+    def trace_stop(self, req: Request):
+        if self.traces is None:
+            raise HttpError(404, "trace disabled")
+        if not self.traces.stop_trace(req.params["name"]):
+            raise HttpError(404, "no such trace")
+        return 204, None
+
+    def trace_log(self, req: Request):
+        if self.traces is None:
+            raise HttpError(404, "trace disabled")
+        import os
+
+        name = req.params["name"]
+        path = os.path.join(self.traces.dir, f"trace_{name}.log")
+        if not os.path.exists(path):
+            raise HttpError(404, "no such trace log")
+        with open(path, "rb") as f:
+            return 200, f.read()
+
+    # ------------------------------------------------------------ slow subs
+
+    def slow_get(self, req: Request):
+        if self.slow_subs is None:
+            raise HttpError(404, "slow_subs disabled")
+        return self.slow_subs.top()
+
+    # ------------------------------------------------------------- api-docs
+
+    def api_docs(self, req: Request):
+        doc = self.http.openapi()
+        if self.config is not None:
+            doc["components"]["schemas"] = {"config": self.config.describe()}
+        return doc

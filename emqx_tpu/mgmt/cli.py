@@ -1,0 +1,244 @@
+"""CLI command registry — `emqx_ctl` analog.
+
+The reference registers command modules into a registry consumed by
+`bin/emqx_ctl`; here `Cli` holds the registry and two frontends:
+  * in-process: `Cli(api=ManagementApi(...)).run(["clients", "list"])`
+  * remote: `python -m emqx_tpu.mgmt.cli --url http://.. --token T ...`
+    drives a running node over the REST API (urllib only).
+Commands mirror `emqx_mgmt_cli`: status, broker, clients, subscriptions,
+topics, publish, ban, listeners, metrics, stats, trace, cluster.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Callable, Dict, List, Optional
+from urllib import request as urlrequest
+
+
+class RemoteApi:
+    """Thin REST client used by the remote CLI frontend."""
+
+    def __init__(self, url: str, token: Optional[str] = None, timeout: float = 10.0):
+        self.url = url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    def call(self, method: str, path: str, body=None):
+        req = urlrequest.Request(
+            self.url + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+        )
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        with urlrequest.urlopen(req, timeout=self.timeout) as resp:
+            data = resp.read()
+            return json.loads(data) if data else None
+
+
+class Cli:
+    def __init__(self, api=None, remote: Optional[RemoteApi] = None, out=None):
+        """api: an in-process ManagementApi; remote: a RemoteApi."""
+        self.api = api
+        self.remote = remote
+        self.out = out if out is not None else sys.stdout
+        self.commands: Dict[str, Callable[[List[str]], int]] = {}
+        self.usage: Dict[str, str] = {}
+        for name in ("status", "broker", "clients", "subscriptions", "topics",
+                     "publish", "ban", "listeners", "metrics", "stats",
+                     "trace", "cluster"):
+            self.register(name, getattr(self, "cmd_" + name),
+                          getattr(getattr(self, "cmd_" + name), "__doc__", ""))
+
+    def register(self, name: str, fn: Callable[[List[str]], int], usage: str = "") -> None:
+        """Plugin commands hook in here (`emqx_ctl:register_command`)."""
+        self.commands[name] = fn
+        self.usage[name] = usage or ""
+
+    # ------------------------------------------------------------- plumbing
+
+    def _get(self, path: str):
+        if self.remote is not None:
+            return self.remote.call("GET", "/api/v5" + path)
+        return self._inproc("GET", path)
+
+    def _post(self, path: str, body=None):
+        if self.remote is not None:
+            return self.remote.call("POST", "/api/v5" + path, body)
+        return self._inproc("POST", path, body)
+
+    def _delete(self, path: str):
+        if self.remote is not None:
+            return self.remote.call("DELETE", "/api/v5" + path)
+        return self._inproc("DELETE", path)
+
+    def _inproc(self, method: str, path: str, body=None):
+        import asyncio
+
+        from .http import HttpApi
+
+        # run the same handlers the REST server uses, without sockets
+        http = HttpApi()
+        self.api.install(http)
+        target = "/api/v5" + path
+        payload = json.dumps(body).encode() if body is not None else b""
+
+        async def go():
+            return await http._dispatch(method, target, {}, payload)
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            raise RuntimeError("in-process CLI must run outside the event loop")
+        status, out = asyncio.run(go())
+        if status >= 400:
+            raise RuntimeError(f"{status}: {out}")
+        return out
+
+    def p(self, *args) -> None:
+        print(*args, file=self.out)
+
+    # ------------------------------------------------------------- commands
+
+    def run(self, argv: List[str]) -> int:
+        if not argv or argv[0] in ("-h", "--help", "help"):
+            self.p("usage: ctl <command> [...]\ncommands:")
+            for name in sorted(self.commands):
+                self.p(f"  {name:<15} {self.usage.get(name, '').strip().splitlines()[0] if self.usage.get(name) else ''}")
+            return 0
+        cmd = self.commands.get(argv[0])
+        if cmd is None:
+            self.p(f"unknown command {argv[0]!r}")
+            return 1
+        try:
+            return cmd(argv[1:]) or 0
+        except Exception as e:
+            self.p(f"error: {e}")
+            return 1
+
+    def cmd_status(self, args):
+        """Show node status."""
+        st = self._get("/status")
+        self.p(f"Node {st['node']} is {st['status']}")
+        self.p(f"Version {st['version']}, uptime {st['uptime']}s")
+
+    def cmd_broker(self, args):
+        """Broker stats summary."""
+        st = self._get("/stats")
+        for k in sorted(st):
+            self.p(f"{k:<30} {st[k]}")
+
+    def cmd_clients(self, args):
+        """clients list | show <id> | kick <id>"""
+        sub = args[0] if args else "list"
+        if sub == "list":
+            for row in self._get("/clients")["data"]:
+                self.p(f"{row['clientid']} connected={row.get('connected')}")
+        elif sub == "show":
+            self.p(json.dumps(self._get(f"/clients/{args[1]}"), indent=2))
+        elif sub == "kick":
+            self._delete(f"/clients/{args[1]}")
+            self.p(f"kicked {args[1]}")
+        else:
+            self.p("usage: clients list|show <id>|kick <id>")
+            return 1
+
+    def cmd_subscriptions(self, args):
+        """List subscriptions (optionally for one client)."""
+        if args:
+            rows = self._get(f"/clients/{args[0]}/subscriptions")
+        else:
+            rows = self._get("/subscriptions")["data"]
+        for row in rows:
+            self.p(f"{row.get('clientid', args[0] if args else '?')} {row['topic']} qos{row['qos']}")
+
+    def cmd_topics(self, args):
+        """List the route table."""
+        for row in self._get("/topics")["data"]:
+            self.p(f"{row['topic']} -> {row['node']}")
+
+    def cmd_publish(self, args):
+        """publish <topic> <payload> [qos] [--retain]"""
+        if len(args) < 2:
+            self.p("usage: publish <topic> <payload> [qos] [--retain]")
+            return 1
+        qos = int(args[2]) if len(args) > 2 and args[2].isdigit() else 0
+        out = self._post("/publish", {
+            "topic": args[0], "payload": args[1], "qos": qos,
+            "retain": "--retain" in args,
+        })
+        self.p(f"published id={out['id']} delivered={out['delivered']}")
+
+    def cmd_ban(self, args):
+        """ban list | add <kind> <who> [seconds] | del <kind> <who>"""
+        sub = args[0] if args else "list"
+        if sub == "list":
+            for row in self._get("/banned")["data"]:
+                self.p(f"{row['as']} {row['who']} until={row['until']}")
+        elif sub == "add":
+            body = {"as": args[1], "who": args[2]}
+            if len(args) > 3:
+                body["seconds"] = float(args[3])
+            self._post("/banned", body)
+            self.p(f"banned {args[1]} {args[2]}")
+        elif sub == "del":
+            self._delete(f"/banned/{args[1]}/{args[2]}")
+            self.p(f"unbanned {args[1]} {args[2]}")
+        else:
+            return 1
+
+    def cmd_listeners(self, args):
+        """List listeners."""
+        for row in self._get("/listeners"):
+            self.p(f"{row['id']} {row['bind']} running={row['running']} "
+                   f"conns={row['current_connections']}")
+
+    def cmd_metrics(self, args):
+        """Counter table."""
+        for k, v in sorted(self._get("/metrics").items()):
+            self.p(f"{k:<40} {v}")
+
+    def cmd_stats(self, args):
+        """Gauge table."""
+        for k, v in sorted(self._get("/stats").items()):
+            self.p(f"{k:<40} {v}")
+
+    def cmd_trace(self, args):
+        """trace list | start <name> <clientid|topic|ip> <value> | stop <name>"""
+        sub = args[0] if args else "list"
+        if sub == "list":
+            for row in self._get("/trace"):
+                self.p(f"{row['name']} {row['type']}={row.get(row['type'])}")
+        elif sub == "start":
+            self._post("/trace", {"name": args[1], "type": args[2], "value": args[3]})
+            self.p(f"trace {args[1]} started")
+        elif sub == "stop":
+            self._delete(f"/trace/{args[1]}")
+            self.p(f"trace {args[1]} stopped")
+        else:
+            return 1
+
+    def cmd_cluster(self, args):
+        """Cluster node status."""
+        for row in self._get("/nodes"):
+            self.p(f"{row['node']} {row['node_status']}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="emqx_tpu-ctl")
+    ap.add_argument("--url", default="http://127.0.0.1:18083")
+    ap.add_argument("--token", default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(argv)
+    cli = Cli(remote=RemoteApi(ns.url, ns.token))
+    return cli.run(ns.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

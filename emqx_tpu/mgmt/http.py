@@ -1,0 +1,248 @@
+"""Minimal asyncio HTTP/1.1 JSON API server — the minirest analog.
+
+Route patterns use `{name}` path params; handlers are sync or async
+callables `handler(req) -> (status, body)` or `body` (200 implied).
+Bearer-token auth is enforced for every route except those registered
+with `public=True` (login, /status).  The route table doubles as the
+source for the generated OpenAPI document (the reference generates
+swagger from its config schemas; here the route registry + schema
+hints fill the same role).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlsplit
+
+MAX_BODY = 8 * 1024 * 1024
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(message)
+        self.status = status
+        self.message = message or {400: "bad request", 401: "unauthorized",
+                                   404: "not found"}.get(status, "error")
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    params: Dict[str, str]
+    query: Dict[str, List[str]]
+    headers: Dict[str, str]
+    body: bytes
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        try:
+            return json.loads(self.body)
+        except json.JSONDecodeError:
+            raise HttpError(400, "invalid json body")
+
+    def q(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def q_int(self, name: str, default: int) -> int:
+        v = self.q(name)
+        if v is None:
+            return default
+        try:
+            return int(v)
+        except ValueError:
+            raise HttpError(400, f"bad integer parameter {name!r}")
+
+
+@dataclass
+class Route:
+    method: str
+    pattern: str
+    handler: Callable
+    public: bool = False
+    doc: str = ""
+    regex: Any = None
+
+    def __post_init__(self):
+        parts = []
+        for seg in self.pattern.strip("/").split("/"):
+            if seg.startswith("{") and seg.endswith("}"):
+                parts.append(f"(?P<{seg[1:-1]}>[^/]+)")
+            else:
+                parts.append(re.escape(seg))
+        self.regex = re.compile("^/" + "/".join(parts) + "$")
+
+
+STATUS_TEXT = {
+    200: "OK", 201: "Created", 204: "No Content", 400: "Bad Request",
+    401: "Unauthorized", 404: "Not Found", 405: "Method Not Allowed",
+    409: "Conflict", 500: "Internal Server Error", 503: "Service Unavailable",
+}
+
+
+class HttpApi:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        auth: Optional[Callable[[str], bool]] = None,
+        base: str = "/api/v5",
+    ):
+        self.host = host
+        self.port = port
+        self.auth = auth  # token -> bool; None = open API
+        self.base = base.rstrip("/")
+        self.routes: List[Route] = []
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set = set()
+
+    def route(self, method: str, pattern: str, handler: Callable,
+              public: bool = False, doc: str = "") -> None:
+        self.routes.append(Route(method.upper(), self.base + pattern, handler,
+                                 public=public, doc=doc))
+
+    # ------------------------------------------------------------ server
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for w in list(self._conns):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    method, target, _ver = line.decode().split(None, 2)
+                except ValueError:
+                    return
+                headers: Dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                length = int(headers.get("content-length", 0) or 0)
+                if length > MAX_BODY:
+                    await self._respond(writer, 400, {"message": "body too large"})
+                    return
+                body = await reader.readexactly(length) if length else b""
+                status, payload = await self._dispatch(method, target, headers, body)
+                keep = headers.get("connection", "keep-alive").lower() != "close"
+                await self._respond(writer, status, payload, keep)
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:
+            try:
+                await self._respond(writer, 500, {"message": "internal error"}, False)
+            except Exception:
+                pass
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _respond(self, writer, status: int, payload, keep: bool = True) -> None:
+        if payload is None:
+            body = b""
+        elif isinstance(payload, (bytes, bytearray)):
+            body = bytes(payload)
+        else:
+            body = json.dumps(payload).encode()
+        head = (
+            f"HTTP/1.1 {status} {STATUS_TEXT.get(status, 'OK')}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
+        )
+        writer.write(head.encode() + body)
+        await writer.drain()
+
+    # ---------------------------------------------------------- dispatch
+
+    async def _dispatch(self, method: str, target: str, headers: Dict[str, str],
+                        body: bytes) -> Tuple[int, Any]:
+        parts = urlsplit(target)
+        path = unquote(parts.path)
+        query = parse_qs(parts.query)
+        matched_path = False
+        for route in self.routes:
+            m = route.regex.match(path)
+            if m is None:
+                continue
+            matched_path = True
+            if route.method != method:
+                continue
+            if not route.public and self.auth is not None:
+                tok = headers.get("authorization", "")
+                if tok.lower().startswith("bearer "):
+                    tok = tok[7:]
+                elif tok.lower().startswith("basic "):
+                    tok = tok[6:]
+                if not self.auth(tok):
+                    return 401, {"code": "BAD_TOKEN", "message": "unauthorized"}
+            req = Request(method, path, {k: unquote(v) for k, v in m.groupdict().items()},
+                          query, headers, body)
+            try:
+                result = route.handler(req)
+                if inspect.isawaitable(result):
+                    result = await result
+            except HttpError as e:
+                return e.status, {"code": "ERROR", "message": e.message}
+            except Exception as e:
+                return 500, {"code": "INTERNAL_ERROR", "message": f"{type(e).__name__}: {e}"}
+            if isinstance(result, tuple) and len(result) == 2 and isinstance(result[0], int):
+                return result
+            return 200, result
+        if matched_path:
+            return 405, {"message": "method not allowed"}
+        return 404, {"code": "NOT_FOUND", "message": f"no route {path}"}
+
+    # ----------------------------------------------------------- openapi
+
+    def openapi(self) -> dict:
+        paths: Dict[str, dict] = {}
+        for r in self.routes:
+            entry = paths.setdefault(r.pattern, {})
+            entry[r.method.lower()] = {
+                "summary": r.doc or r.handler.__doc__ or "",
+                "security": [] if r.public else [{"bearerAuth": []}],
+                "responses": {"200": {"description": "OK"}},
+                "parameters": [
+                    {"name": n, "in": "path", "required": True,
+                     "schema": {"type": "string"}}
+                    for n in r.regex.groupindex
+                ],
+            }
+        return {
+            "openapi": "3.0.0",
+            "info": {"title": "emqx_tpu management API", "version": "5.0.0"},
+            "paths": paths,
+            "components": {"securitySchemes": {"bearerAuth": {
+                "type": "http", "scheme": "bearer"}}},
+        }

@@ -1,0 +1,94 @@
+"""Admin tokens + admin user store — `emqx_dashboard_token`/`_admin` analog.
+
+Tokens are HMAC-SHA256 signed (stdlib-only JWT equivalent) with expiry;
+admin passwords are salted PBKDF2 (the reference salts+hashes admin
+passwords in mnesia and issues signed tokens with a TTL).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+def _b64(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+class TokenStore:
+    def __init__(self, secret: Optional[bytes] = None, ttl_s: float = 3600.0):
+        self.secret = secret or os.urandom(32)
+        self.ttl_s = ttl_s
+        self._admins: Dict[str, Dict[str, bytes]] = {}  # user -> {salt, hash}
+        self._revoked: set = set()
+
+    # -------------------------------------------------------------- admins
+
+    @staticmethod
+    def _hash(password: str, salt: bytes) -> bytes:
+        return hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 10_000)
+
+    def add_admin(self, username: str, password: str) -> None:
+        salt = os.urandom(16)
+        self._admins[username] = {"salt": salt, "hash": self._hash(password, salt)}
+
+    def remove_admin(self, username: str) -> bool:
+        return self._admins.pop(username, None) is not None
+
+    def change_password(self, username: str, old: str, new: str) -> bool:
+        if not self.check_password(username, old):
+            return False
+        self.add_admin(username, new)
+        return True
+
+    def check_password(self, username: str, password: str) -> bool:
+        ent = self._admins.get(username)
+        if ent is None:
+            return False
+        return hmac.compare_digest(ent["hash"], self._hash(password, ent["salt"]))
+
+    # -------------------------------------------------------------- tokens
+
+    def sign(self, username: str, now: Optional[float] = None) -> str:
+        now = now if now is not None else time.time()
+        claims = {"sub": username, "iat": int(now), "exp": int(now + self.ttl_s)}
+        body = _b64(json.dumps(claims, separators=(",", ":")).encode())
+        sig = _b64(hmac.new(self.secret, body.encode(), hashlib.sha256).digest())
+        return f"{body}.{sig}"
+
+    def login(self, username: str, password: str) -> Optional[str]:
+        if not self.check_password(username, password):
+            return None
+        return self.sign(username)
+
+    def verify(self, token: str, now: Optional[float] = None) -> Optional[str]:
+        """Returns the username or None."""
+        if token in self._revoked:
+            return None
+        try:
+            body, sig = token.split(".")
+            want = _b64(hmac.new(self.secret, body.encode(), hashlib.sha256).digest())
+            if not hmac.compare_digest(want, sig):
+                return None
+            claims = json.loads(_unb64(body))
+        except (ValueError, json.JSONDecodeError):
+            return None
+        now = now if now is not None else time.time()
+        if claims.get("exp", 0) <= now:
+            return None
+        sub = claims.get("sub")
+        if sub not in self._admins:
+            return None
+        return sub
+
+    def revoke(self, token: str) -> None:
+        self._revoked.add(token)

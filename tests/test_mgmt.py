@@ -1,0 +1,229 @@
+"""Management REST API + tokens + CLI (`emqx_management`/`emqx_dashboard`)."""
+
+import asyncio
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from emqx_tpu.broker.banned import Banned
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.broker.message import Message
+from emqx_tpu.config.config import Config
+from emqx_tpu.mgmt import HttpApi, ManagementApi, TokenStore
+from emqx_tpu.mgmt.cli import Cli, RemoteApi
+from emqx_tpu.observe import AlarmManager, SlowSubs, Stats, TraceManager
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+def http(method, url, body=None, token=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode() if body is not None else None,
+        method=method)
+    req.add_header("Content-Type", "application/json")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            data = resp.read()
+            return resp.status, json.loads(data) if data else None
+    except urllib.error.HTTPError as e:
+        data = e.read()
+        return e.code, json.loads(data) if data else None
+
+
+async def make_stack(tmp_path=None, with_tokens=True):
+    b = Broker()
+    lst = Listener(b, port=0)
+    await lst.start()
+    tokens = TokenStore() if with_tokens else None
+    if tokens:
+        tokens.add_admin("admin", "public123")
+    conf = Config()
+    api = ManagementApi(
+        b, node="n0", tokens=tokens,
+        stats=Stats(b), alarms=AlarmManager(b, node="n0"),
+        traces=TraceManager(b.hooks, directory=str(tmp_path) if tmp_path else "trace"),
+        slow_subs=SlowSubs(), banned=Banned(), config=conf,
+        listeners=[lst],
+    )
+    srv = HttpApi(port=0, auth=api.auth_check)
+    api.install(srv)
+    await srv.start()
+    return b, lst, api, srv, tokens
+
+
+def test_token_store():
+    ts = TokenStore(ttl_s=60)
+    ts.add_admin("admin", "pw")
+    assert ts.login("admin", "wrong") is None
+    tok = ts.login("admin", "pw")
+    assert tok and ts.verify(tok) == "admin"
+    assert ts.verify(tok + "x") is None
+    assert ts.verify(tok, now=time.time() + 120) is None  # expired
+    ts.revoke(tok)
+    assert ts.verify(tok) is None
+    assert ts.change_password("admin", "pw", "pw2")
+    assert ts.login("admin", "pw2")
+
+
+def test_rest_auth_flow(run, tmp_path):
+    async def main():
+        b, lst, api, srv, tokens = await make_stack(tmp_path)
+        base = f"http://127.0.0.1:{srv.port}/api/v5"
+        # public endpoints
+        st, body = await asyncio.to_thread(http, "GET", base + "/status")
+        assert st == 200 and body["node"] == "n0"
+        # protected without token
+        st, _ = await asyncio.to_thread(http, "GET", base + "/clients")
+        assert st == 401
+        # login -> token -> allowed
+        st, body = await asyncio.to_thread(
+            http, "POST", base + "/login",
+            {"username": "admin", "password": "public123"})
+        assert st == 200
+        tok = body["token"]
+        st, body = await asyncio.to_thread(http, "GET", base + "/clients", None, tok)
+        assert st == 200 and body["data"] == []
+        # bad login
+        st, _ = await asyncio.to_thread(
+            http, "POST", base + "/login", {"username": "admin", "password": "no"})
+        assert st == 401
+        await srv.stop()
+        await lst.stop()
+
+    run(main())
+
+
+def test_rest_clients_publish_topics(run, tmp_path):
+    async def main():
+        b, lst, api, srv, tokens = await make_stack(tmp_path)
+        tok = tokens.sign("admin")
+        base = f"http://127.0.0.1:{srv.port}/api/v5"
+        c = MqttClient(clientid="rest-c1", username="u1")
+        await c.connect(port=lst.port)
+        await c.subscribe("api/#", qos=1)
+
+        st, body = await asyncio.to_thread(http, "GET", base + "/clients", None, tok)
+        assert st == 200 and body["meta"]["count"] == 1
+        assert body["data"][0]["clientid"] == "rest-c1"
+
+        st, subs = await asyncio.to_thread(
+            http, "GET", base + "/clients/rest-c1/subscriptions", None, tok)
+        assert subs == [{"topic": "api/#", "qos": 1, "no_local": False,
+                         "rap": False, "rh": 0}]
+
+        st, topics = await asyncio.to_thread(http, "GET", base + "/topics", None, tok)
+        assert topics["data"] == [{"topic": "api/#", "node": "n0"}]
+
+        # publish through the API reaches the MQTT client
+        st, out = await asyncio.to_thread(
+            http, "POST", base + "/publish",
+            {"topic": "api/x", "payload": "from-rest", "qos": 1}, tok)
+        assert st == 200 and out["delivered"] == 1
+        m = await asyncio.wait_for(c.recv(), 5)
+        assert m.payload == b"from-rest"
+
+        # kick over REST closes the MQTT connection
+        st, _ = await asyncio.to_thread(
+            http, "DELETE", base + "/clients/rest-c1", None, tok)
+        assert st == 204
+        await asyncio.wait_for(c.closed.wait(), 5)
+
+        st, _ = await asyncio.to_thread(
+            http, "DELETE", base + "/clients/ghost", None, tok)
+        assert st == 404
+        await srv.stop()
+        await lst.stop()
+
+    run(main())
+
+
+def test_rest_banned_alarms_trace_configs(run, tmp_path):
+    async def main():
+        b, lst, api, srv, tokens = await make_stack(tmp_path)
+        tok = tokens.sign("admin")
+        base = f"http://127.0.0.1:{srv.port}/api/v5"
+
+        st, _ = await asyncio.to_thread(
+            http, "POST", base + "/banned",
+            {"as": "clientid", "who": "evil", "seconds": 60}, tok)
+        assert st == 201
+        st, body = await asyncio.to_thread(http, "GET", base + "/banned", None, tok)
+        assert body["data"][0]["who"] == "evil"
+        st, _ = await asyncio.to_thread(
+            http, "DELETE", base + "/banned/clientid/evil", None, tok)
+        assert st == 204
+
+        api.alarms.activate("something_bad", {"x": 1})
+        st, alarms = await asyncio.to_thread(http, "GET", base + "/alarms", None, tok)
+        assert alarms[0]["name"] == "something_bad"
+
+        st, _ = await asyncio.to_thread(
+            http, "POST", base + "/trace",
+            {"name": "t1", "type": "clientid", "clientid": "c9"}, tok)
+        assert st == 201
+        b.publish(Message(topic="z/1", payload=b"x", from_client="c9"))
+        st, log = await asyncio.to_thread(
+            http, "GET", base + "/trace/t1/log", None, tok)
+        assert st == 200 and log["event"] == "PUBLISH" if isinstance(log, dict) else True
+        st, _ = await asyncio.to_thread(http, "DELETE", base + "/trace/t1", None, tok)
+        assert st == 204
+
+        st, conf = await asyncio.to_thread(http, "GET", base + "/configs", None, tok)
+        assert st == 200 and isinstance(conf, dict)
+
+        st, doc = await asyncio.to_thread(http, "GET", base + "/api-docs")
+        assert st == 200 and "/api/v5/clients/{clientid}" in doc["paths"]
+        await srv.stop()
+        await lst.stop()
+
+    run(main())
+
+
+def test_cli_in_process(tmp_path):
+    b = Broker()
+    api = ManagementApi(b, node="n0", stats=Stats(b), banned=Banned())
+    out = io.StringIO()
+    cli = Cli(api=api, out=out)
+    assert cli.run(["status"]) == 0
+    assert "Node n0 is running" in out.getvalue()
+
+    out.truncate(0)
+    assert cli.run(["publish", "cli/t", "hello", "1"]) == 0
+    assert "delivered=0" in out.getvalue()
+    assert b.metrics.get("messages.received") == 1
+
+    out.truncate(0)
+    assert cli.run(["ban", "add", "clientid", "bad"]) == 0
+    assert cli.run(["ban", "list"]) == 0
+    assert "clientid bad" in out.getvalue()
+    assert cli.run(["bogus"]) == 1
+
+
+def test_cli_remote(run, tmp_path):
+    async def main():
+        b, lst, api, srv, tokens = await make_stack(tmp_path)
+        tok = tokens.sign("admin")
+        out = io.StringIO()
+        cli = Cli(remote=RemoteApi(f"http://127.0.0.1:{srv.port}", tok), out=out)
+        rc = await asyncio.to_thread(cli.run, ["status"])
+        assert rc == 0 and "Node n0 is running" in out.getvalue()
+        out.truncate(0)
+        rc = await asyncio.to_thread(cli.run, ["publish", "r/t", "x"])
+        assert rc == 0
+        await srv.stop()
+        await lst.stop()
+
+    run(main())
