@@ -1,0 +1,27 @@
+"""Gateways: non-MQTT protocol front-ends onto the broker core.
+
+Analog of `apps/emqx_gateway` (SURVEY.md §1.10): the reference defines
+impl/channel/frame/conn behaviors plus a per-gateway CM, and each
+protocol (STOMP, MQTT-SN, CoAP, LwM2M, ExProto) adapts its sessions
+onto the broker's pub/sub via `emqx_gateway_ctx`.
+
+Here `core.GatewayContext` is that ctx: gateway channels authenticate,
+subscribe, and publish through the SAME broker facade (hooks, authz,
+retainer, TPU matcher) as MQTT clients, and register in a per-gateway
+`ConnectionManager`.  Implemented protocols: STOMP 1.2 over TCP
+(`stomp.py`), MQTT-SN 1.2 over UDP (`mqttsn.py`).  ExProto's
+gRPC-stream adapter is gated on grpcio availability (absent in this
+image), matching the exhook transport gating.
+"""
+
+from .core import GatewayContext, GatewayRegistry
+from .mqttsn import MqttSnGateway
+from .stomp import StompFrame, StompGateway
+
+__all__ = [
+    "GatewayContext",
+    "GatewayRegistry",
+    "MqttSnGateway",
+    "StompFrame",
+    "StompGateway",
+]

@@ -1,0 +1,146 @@
+"""Gateway framework: context + registry (`emqx_gateway_ctx`/`_registry`).
+
+`GatewayContext` is the narrow facade every protocol channel uses:
+authenticate (broker authn chain + banned check), authorize, connect
+(per-gateway CM registration with takeover), subscribe/unsubscribe
+(broker route tables -> TPU matcher), publish (hooks + retain +
+batched match), disconnect.  Gateway clients are full broker citizens:
+an MQTT client can subscribe to topics a STOMP client publishes and
+vice versa — same equivalence the reference gets by routing every
+gateway through emqx_broker.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..broker.access_control import AccessControl, ALLOW, DENY, ClientInfo
+from ..broker.broker import Broker
+from ..broker.cm import ConnectionManager
+from ..broker.message import Message
+from ..broker.packet import SubOpts
+from ..broker.session import Session
+
+log = logging.getLogger("emqx_tpu.gateway")
+
+
+class GatewayContext:
+    def __init__(self, broker: Broker, gateway: str, mountpoint: str = ""):
+        self.broker = broker
+        self.gateway = gateway
+        # per-gateway clientid namespace + CM (emqx_gateway_cm)
+        self.cm = ConnectionManager()
+        self.cm.on_discard = self._on_discard
+        self.access = AccessControl(broker.hooks)
+        self.mountpoint = mountpoint
+
+    def _on_discard(self, session: Session) -> None:
+        self.broker.client_down(
+            self._scoped(session.clientid), list(session.subscriptions)
+        )
+
+    def _scoped(self, clientid: str) -> str:
+        """Broker-side id, namespaced per gateway like the reference's
+        per-gateway clientid registries."""
+        return f"{self.gateway}:{clientid}"
+
+    # ----------------------------------------------------------- lifecycle
+
+    def authenticate(self, clientinfo: ClientInfo) -> bool:
+        out = self.access.authenticate(clientinfo)
+        return out.get("result", ALLOW) == ALLOW
+
+    def open_session(self, clean_start: bool, clientinfo: ClientInfo,
+                     channel) -> Tuple[Session, bool]:
+        session, present = self.cm.open_session(
+            clean_start, clientinfo.clientid,
+            lambda: Session(clientid=clientinfo.clientid),
+        )
+        channel.session = session
+        channel.clientid = clientinfo.clientid
+        self.cm.register_channel(channel)
+        self.broker.hooks.run("client.connected", (clientinfo,))
+        return session, present
+
+    def close_session(self, channel, normal: bool = True) -> None:
+        ci = getattr(channel, "clientinfo", None)
+        self.cm.disconnect_channel(channel)
+        if channel.session is not None and channel.session.expiry_interval == 0:
+            pass  # on_discard already cleaned routes
+        if ci is not None:
+            self.broker.hooks.run("client.disconnected", (ci, normal))
+
+    # ------------------------------------------------------------- pub/sub
+
+    def authorize(self, clientinfo: ClientInfo, action: str, topic: str) -> bool:
+        return self.access.authorize(clientinfo, action, topic) == ALLOW
+
+    def subscribe(self, channel, filt: str, qos: int = 0) -> bool:
+        scoped = self._scoped(channel.clientid)
+        opts = SubOpts(qos=qos)
+        channel.session.subscribe(filt, opts)
+        self.broker.subscribe(scoped, filt, opts)
+        # route deliveries for the scoped id back to the gateway channel
+        self.broker.cm.register_channel(
+            _ScopedChannel(scoped, channel)
+        )
+        return True
+
+    def unsubscribe(self, channel, filt: str) -> bool:
+        scoped = self._scoped(channel.clientid)
+        if channel.session.unsubscribe(filt) is None:
+            return False
+        self.broker.unsubscribe(scoped, filt)
+        return True
+
+    def publish(self, clientinfo: ClientInfo, topic: str, payload: bytes,
+                qos: int = 0, retain: bool = False,
+                properties: Optional[dict] = None) -> int:
+        msg = Message(
+            topic=topic, payload=payload, qos=qos, retain=retain,
+            from_client=clientinfo.clientid,
+            from_username=clientinfo.username,
+            headers={"proto": self.gateway},
+            properties=properties or {},
+        )
+        return self.broker.publish(msg)
+
+
+class _ScopedChannel:
+    """Adapter registered in the BROKER cm under the scoped id; relays
+    deliveries to the gateway channel (which speaks its own protocol)."""
+
+    def __init__(self, clientid: str, target):
+        self.clientid = clientid
+        self.target = target
+        self.session = target.session
+
+    def deliver(self, delivers) -> None:
+        self.target.deliver(delivers)
+
+    def kick(self, rc: int = 0) -> None:
+        kick = getattr(self.target, "kick", None)
+        if kick is not None:
+            kick(rc)
+
+
+class GatewayRegistry:
+    """Named gateway instances (`emqx_gateway_registry`)."""
+
+    def __init__(self):
+        self._gateways: Dict[str, object] = {}
+
+    def register(self, name: str, gw) -> None:
+        if name in self._gateways:
+            raise ValueError(f"gateway {name!r} already registered")
+        self._gateways[name] = gw
+
+    def unregister(self, name: str):
+        return self._gateways.pop(name, None)
+
+    def lookup(self, name: str):
+        return self._gateways.get(name)
+
+    def list(self) -> List[str]:
+        return sorted(self._gateways)

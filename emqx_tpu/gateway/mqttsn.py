@@ -1,0 +1,318 @@
+"""MQTT-SN 1.2 gateway over UDP — `apps/emqx_gateway/src/mqttsn` analog.
+
+Wire format per the MQTT-SN 1.2 spec: 1-byte (or 3-byte escaped)
+length, message type, variable part.  Supported message set mirrors
+the reference gateway's core path: SEARCHGW/GWINFO, CONNECT/CONNACK,
+REGISTER/REGACK (both directions), PUBLISH/PUBACK (QoS 0/1),
+SUBSCRIBE/SUBACK, UNSUBSCRIBE/UNSUBACK, PINGREQ/PINGRESP, DISCONNECT.
+Topic-id registry per client; topic-id type 0 = registered, 1 =
+predefined, 2 = two-char short names.  Subscriptions/publishes flow
+through `GatewayContext`, so MQTT-SN sensors interoperate with MQTT
+and STOMP clients on the same broker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Dict, Optional, Tuple
+
+from ..broker.access_control import ClientInfo
+from ..broker.broker import Broker
+from .core import GatewayContext
+
+log = logging.getLogger("emqx_tpu.gateway.mqttsn")
+
+# message types
+SEARCHGW = 0x01
+GWINFO = 0x02
+CONNECT = 0x04
+CONNACK = 0x05
+REGISTER = 0x0A
+REGACK = 0x0B
+PUBLISH = 0x0C
+PUBACK = 0x0D
+SUBSCRIBE = 0x12
+SUBACK = 0x13
+UNSUBSCRIBE = 0x14
+UNSUBACK = 0x15
+PINGREQ = 0x16
+PINGRESP = 0x17
+DISCONNECT = 0x18
+
+RC_ACCEPTED = 0x00
+RC_INVALID_TOPIC = 0x02
+RC_NOT_SUPPORTED = 0x03
+
+FLAG_DUP = 0x80
+FLAG_QOS_MASK = 0x60
+FLAG_RETAIN = 0x10
+FLAG_CLEAN = 0x04
+FLAG_TOPIC_TYPE = 0x03
+
+TOPIC_NORMAL = 0  # registered topic id
+TOPIC_PREDEF = 1
+TOPIC_SHORT = 2
+
+
+def mk(msg_type: int, body: bytes) -> bytes:
+    n = len(body) + 2
+    if n < 256:
+        return bytes([n, msg_type]) + body
+    return b"\x01" + struct.pack("!H", n + 2) + bytes([msg_type]) + body
+
+
+def parse(datagram: bytes) -> Tuple[int, bytes]:
+    if not datagram:
+        raise ValueError("empty datagram")
+    if datagram[0] == 0x01:
+        (n,) = struct.unpack_from("!H", datagram, 1)
+        if len(datagram) < n or n < 4:
+            raise ValueError("bad length")
+        return datagram[3], datagram[4:n]
+    n = datagram[0]
+    if len(datagram) < n or n < 2:
+        raise ValueError("bad length")
+    return datagram[1], datagram[2:n]
+
+
+def qos_of(flags: int) -> int:
+    q = (flags & FLAG_QOS_MASK) >> 5
+    return 0 if q == 3 else q  # 0b11 = QoS -1 (publish-only) -> treat as 0
+
+
+class SnClient:
+    def __init__(self, addr, clientid: str):
+        self.addr = addr
+        self.clientid = clientid
+        self.session = None
+        self.clientinfo: Optional[ClientInfo] = None
+        self.connected = False
+        # topic registry, both directions
+        self.topic_by_id: Dict[int, str] = {}
+        self.id_by_topic: Dict[str, int] = {}
+        self._next_topic_id = 1
+        self._next_msg_id = 1
+        self.gateway: Optional["MqttSnGateway"] = None
+
+    def reg_topic(self, topic: str) -> int:
+        tid = self.id_by_topic.get(topic)
+        if tid is None:
+            tid = self._next_topic_id
+            self._next_topic_id += 1
+            self.id_by_topic[topic] = tid
+            self.topic_by_id[tid] = topic
+        return tid
+
+    def next_msg_id(self) -> int:
+        mid = self._next_msg_id
+        self._next_msg_id = mid % 0xFFFF + 1
+        return mid
+
+    # ChannelLike: broker -> datagrams
+    def deliver(self, delivers) -> None:
+        if self.gateway is None:
+            return
+        for _filt, msg in delivers:
+            self.gateway.deliver_publish(self, msg)
+
+    def kick(self, rc: int = 0) -> None:
+        if self.gateway is not None:
+            self.gateway.send(self.addr, mk(DISCONNECT, b""))
+            self.gateway.drop_client(self)
+
+
+class MqttSnGateway(asyncio.DatagramProtocol):
+    def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
+                 gateway_id: int = 1, predefined: Optional[Dict[int, str]] = None):
+        self.ctx = GatewayContext(broker, "mqttsn")
+        self.host = host
+        self.port = port
+        self.gateway_id = gateway_id
+        self.predefined = predefined or {}
+        self.clients: Dict[tuple, SnClient] = {}
+        self.transport: Optional[asyncio.DatagramTransport] = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, local_addr=(self.host, self.port)
+        )
+        self.port = self.transport.get_extra_info("sockname")[1]
+        log.info("mqtt-sn gateway on %s:%s", self.host, self.port)
+
+    async def stop(self) -> None:
+        for client in list(self.clients.values()):
+            if client.connected:
+                self.ctx.close_session(client)
+        self.clients.clear()
+        if self.transport is not None:
+            self.transport.close()
+            self.transport = None
+
+    def send(self, addr, datagram: bytes) -> None:
+        if self.transport is not None:
+            self.transport.sendto(datagram, addr)
+
+    def drop_client(self, client: SnClient) -> None:
+        self.clients.pop(client.addr, None)
+
+    # ------------------------------------------------------------ datagrams
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            msg_type, body = parse(data)
+        except ValueError:
+            return
+        handler = {
+            SEARCHGW: self._searchgw,
+            CONNECT: self._connect,
+            REGISTER: self._register,
+            PUBLISH: self._publish,
+            SUBSCRIBE: self._subscribe,
+            UNSUBSCRIBE: self._unsubscribe,
+            PINGREQ: self._pingreq,
+            DISCONNECT: self._disconnect,
+            REGACK: lambda a, b: None,
+            PUBACK: lambda a, b: None,
+        }.get(msg_type)
+        if handler is not None:
+            try:
+                handler(addr, body)
+            except Exception:
+                log.exception("mqtt-sn handler failed (type=%#x)", msg_type)
+
+    def _searchgw(self, addr, body: bytes) -> None:
+        self.send(addr, mk(GWINFO, bytes([self.gateway_id])))
+
+    def _connect(self, addr, body: bytes) -> None:
+        if len(body) < 4:
+            return
+        flags, _proto, _duration = body[0], body[1], struct.unpack_from("!H", body, 2)[0]
+        clientid = body[4:].decode("utf-8", "replace") or f"sn-{addr[0]}-{addr[1]}"
+        client = SnClient(addr, clientid)
+        client.gateway = self
+        ci = ClientInfo(clientid=clientid, peerhost=addr[0], protocol="mqtt-sn")
+        client.clientinfo = ci
+        if not self.ctx.authenticate(ci):
+            self.send(addr, mk(CONNACK, bytes([RC_NOT_SUPPORTED])))
+            return
+        self.ctx.open_session(bool(flags & FLAG_CLEAN), ci, client)
+        client.connected = True
+        self.clients[addr] = client
+        self.send(addr, mk(CONNACK, bytes([RC_ACCEPTED])))
+
+    def _register(self, addr, body: bytes) -> None:
+        client = self.clients.get(addr)
+        if client is None or len(body) < 4:
+            return
+        _tid, msg_id = struct.unpack_from("!HH", body)
+        topic = body[4:].decode("utf-8", "replace")
+        tid = client.reg_topic(topic)
+        self.send(addr, mk(REGACK, struct.pack("!HHB", tid, msg_id, RC_ACCEPTED)))
+
+    def _resolve_topic(self, client: SnClient, flags: int, tid_bytes: bytes) -> Optional[str]:
+        ttype = flags & FLAG_TOPIC_TYPE
+        if ttype == TOPIC_SHORT:
+            return tid_bytes.decode("utf-8", "replace").rstrip("\x00")
+        (tid,) = struct.unpack("!H", tid_bytes)
+        if ttype == TOPIC_PREDEF:
+            return self.predefined.get(tid)
+        return client.topic_by_id.get(tid)
+
+    def _publish(self, addr, body: bytes) -> None:
+        client = self.clients.get(addr)
+        if len(body) < 5:
+            return
+        flags = body[0]
+        msg_id = struct.unpack_from("!H", body, 3)[0]
+        if client is None:
+            return  # QoS -1 anonymous publish unsupported without predefined
+        topic = self._resolve_topic(client, flags, body[1:3])
+        qos = qos_of(flags)
+        if topic is None:
+            self.send(addr, mk(PUBACK, body[1:3] + struct.pack("!HB", msg_id, RC_INVALID_TOPIC)))
+            return
+        if not self.ctx.authorize(client.clientinfo, "publish", topic):
+            self.send(addr, mk(PUBACK, body[1:3] + struct.pack("!HB", msg_id, RC_NOT_SUPPORTED)))
+            return
+        self.ctx.publish(client.clientinfo, topic, body[5:], qos=qos,
+                         retain=bool(flags & FLAG_RETAIN))
+        if qos >= 1:
+            self.send(addr, mk(PUBACK, body[1:3] + struct.pack("!HB", msg_id, RC_ACCEPTED)))
+
+    def _subscribe(self, addr, body: bytes) -> None:
+        client = self.clients.get(addr)
+        if client is None or len(body) < 3:
+            return
+        flags = body[0]
+        (msg_id,) = struct.unpack_from("!H", body, 1)
+        ttype = flags & FLAG_TOPIC_TYPE
+        tid = 0
+        if ttype == TOPIC_NORMAL:
+            topic = body[3:].decode("utf-8", "replace")
+            if "+" not in topic and "#" not in topic:
+                tid = client.reg_topic(topic)
+        else:
+            topic = self._resolve_topic(client, flags, body[3:5])
+        qos = qos_of(flags)
+        if topic is None or not self.ctx.authorize(client.clientinfo, "subscribe", topic):
+            self.send(addr, mk(SUBACK, struct.pack("!BHHB", 0, 0, msg_id, RC_INVALID_TOPIC)))
+            return
+        self.ctx.subscribe(client, topic, qos=qos)
+        self.send(addr, mk(
+            SUBACK, struct.pack("!BHHB", (qos << 5), tid, msg_id, RC_ACCEPTED)
+        ))
+
+    def _unsubscribe(self, addr, body: bytes) -> None:
+        client = self.clients.get(addr)
+        if client is None or len(body) < 3:
+            return
+        flags = body[0]
+        (msg_id,) = struct.unpack_from("!H", body, 1)
+        if flags & FLAG_TOPIC_TYPE == TOPIC_NORMAL:
+            topic = body[3:].decode("utf-8", "replace")
+        else:
+            topic = self._resolve_topic(client, flags, body[3:5])
+        if topic is not None:
+            self.ctx.unsubscribe(client, topic)
+        self.send(addr, mk(UNSUBACK, struct.pack("!H", msg_id)))
+
+    def _pingreq(self, addr, body: bytes) -> None:
+        self.send(addr, mk(PINGRESP, b""))
+
+    def _disconnect(self, addr, body: bytes) -> None:
+        client = self.clients.pop(addr, None)
+        if client is not None and client.connected:
+            self.ctx.close_session(client)
+        self.send(addr, mk(DISCONNECT, b""))
+
+    # ------------------------------------------------------------ outbound
+
+    def deliver_publish(self, client: SnClient, msg) -> None:
+        """Broker delivery -> REGISTER (if unknown topic id) + PUBLISH."""
+        topic = msg.topic
+        if len(topic) == 2 and "+" not in topic and "#" not in topic:
+            flags = TOPIC_SHORT
+            tid_bytes = topic.encode()
+        else:
+            if topic not in client.id_by_topic:
+                tid = client.reg_topic(topic)
+                self.send(client.addr, mk(
+                    REGISTER,
+                    struct.pack("!HH", tid, client.next_msg_id()) + topic.encode(),
+                ))
+            flags = TOPIC_NORMAL
+            tid_bytes = struct.pack("!H", client.id_by_topic[topic])
+        qos = min(msg.qos, 1)
+        flags |= qos << 5
+        if msg.retain:
+            flags |= FLAG_RETAIN
+        msg_id = client.next_msg_id() if qos else 0
+        self.send(client.addr, mk(
+            PUBLISH,
+            bytes([flags]) + tid_bytes + struct.pack("!H", msg_id) + msg.payload,
+        ))

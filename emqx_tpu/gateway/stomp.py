@@ -1,0 +1,323 @@
+"""STOMP 1.2 gateway — `apps/emqx_gateway/src/stomp` analog.
+
+Frame codec: command line, header lines (with STOMP 1.2 escaping),
+blank line, body terminated by NUL; content-length bodies may contain
+NULs.  Channel: CONNECT/STOMP -> CONNECTED (with login check through
+the broker authn chain), SEND -> publish, SUBSCRIBE/UNSUBSCRIBE with
+client subscription ids, MESSAGE delivery with subscription header,
+RECEIPT for any frame carrying `receipt`, DISCONNECT, ERROR on
+violations.  Destinations are MQTT topics verbatim (the reference maps
+STOMP destinations straight onto topics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..broker.access_control import ClientInfo
+from ..broker.broker import Broker
+from .core import GatewayContext
+
+log = logging.getLogger("emqx_tpu.gateway.stomp")
+
+_ESCAPES = {"\\n": "\n", "\\c": ":", "\\r": "\r", "\\\\": "\\"}
+
+
+def _unescape(s: str) -> str:
+    out, i = [], 0
+    while i < len(s):
+        pair = s[i : i + 2]
+        if pair in _ESCAPES:
+            out.append(_ESCAPES[pair])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def _escape(s: str) -> str:
+    return (
+        s.replace("\\", "\\\\").replace("\r", "\\r").replace("\n", "\\n").replace(":", "\\c")
+    )
+
+
+class StompFrame:
+    def __init__(self, command: str, headers: Optional[Dict[str, str]] = None,
+                 body: bytes = b""):
+        self.command = command
+        self.headers = headers or {}
+        self.body = body
+
+    def serialize(self) -> bytes:
+        lines = [self.command]
+        headers = dict(self.headers)
+        if self.body and "content-length" not in headers:
+            headers["content-length"] = str(len(self.body))
+        for k, v in headers.items():
+            lines.append(f"{_escape(k)}:{_escape(str(v))}")
+        return ("\n".join(lines) + "\n\n").encode() + self.body + b"\x00"
+
+    def __repr__(self):
+        return f"StompFrame({self.command}, {self.headers}, {self.body!r})"
+
+
+class StompParser:
+    """Incremental parser with content-length support."""
+
+    def __init__(self, max_frame: int = 1_048_576):
+        self.buf = b""
+        self.max_frame = max_frame
+
+    def feed(self, data: bytes) -> List[StompFrame]:
+        self.buf += data
+        if len(self.buf) > self.max_frame:
+            raise ValueError("frame too large")
+        out = []
+        while True:
+            frame = self._try_parse()
+            if frame is None:
+                return out
+            if frame != "heartbeat":
+                out.append(frame)
+
+    def _try_parse(self):
+        # heart-beats are bare EOLs between frames
+        while self.buf[:1] in (b"\n", b"\r"):
+            self.buf = self.buf[1:]
+            return "heartbeat"
+        if not self.buf:
+            return None
+        head_end = self.buf.find(b"\n\n")
+        sep = 2
+        if head_end < 0:
+            head_end = self.buf.find(b"\r\n\r\n")
+            sep = 4
+            if head_end < 0:
+                return None
+        head = self.buf[:head_end].decode("utf-8", "replace")
+        lines = [l.rstrip("\r") for l in head.split("\n")]
+        command = lines[0].strip()
+        headers: Dict[str, str] = {}
+        for line in lines[1:]:
+            if not line:
+                continue
+            k, _, v = line.partition(":")
+            k = _unescape(k)
+            if k not in headers:  # first occurrence wins (spec)
+                headers[k] = _unescape(v)
+        body_start = head_end + sep
+        clen = headers.get("content-length")
+        if clen is not None:
+            n = int(clen)
+            if len(self.buf) < body_start + n + 1:
+                return None
+            body = self.buf[body_start : body_start + n]
+            if self.buf[body_start + n : body_start + n + 1] != b"\x00":
+                raise ValueError("missing NUL after content-length body")
+            self.buf = self.buf[body_start + n + 1 :]
+        else:
+            nul = self.buf.find(b"\x00", body_start)
+            if nul < 0:
+                return None
+            body = self.buf[body_start:nul]
+            self.buf = self.buf[nul + 1 :]
+        return StompFrame(command, headers, body)
+
+
+class StompChannel:
+    def __init__(self, ctx: GatewayContext, writer: asyncio.StreamWriter,
+                 peername: str):
+        self.ctx = ctx
+        self.writer = writer
+        self.peername = peername
+        self.clientid = ""
+        self.session = None
+        self.clientinfo: Optional[ClientInfo] = None
+        self.connected = False
+        self.closing = False
+        # subscription id -> (destination, ack mode)
+        self.subs: Dict[str, Tuple[str, str]] = {}
+        self._msg_seq = 0
+
+    # ------------------------------------------------------------ outbound
+
+    def send(self, frame: StompFrame) -> None:
+        try:
+            self.writer.write(frame.serialize())
+        except Exception:
+            pass
+
+    def error(self, message: str, receipt: Optional[str] = None) -> None:
+        headers = {"message": message}
+        if receipt:
+            headers["receipt-id"] = receipt
+        self.send(StompFrame("ERROR", headers, message.encode()))
+        self.closing = True
+
+    def deliver(self, delivers) -> None:
+        """Broker deliveries -> MESSAGE frames (ChannelLike protocol)."""
+        for filt, msg in delivers:
+            for sub_id, (dest, _ack) in self.subs.items():
+                if dest == filt:
+                    self._msg_seq += 1
+                    self.send(StompFrame(
+                        "MESSAGE",
+                        {
+                            "subscription": sub_id,
+                            "message-id": f"{self.clientid}-{self._msg_seq}",
+                            "destination": msg.topic,
+                            "content-type": "text/plain",
+                        },
+                        msg.payload,
+                    ))
+                    break
+
+    def kick(self, rc: int = 0) -> None:
+        self.error("kicked")
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- inbound
+
+    def handle(self, frame: StompFrame) -> None:
+        receipt = frame.headers.get("receipt")
+        cmd = frame.command
+        if not self.connected and cmd in ("CONNECT", "STOMP"):
+            self._connect(frame)
+            return
+        if not self.connected:
+            self.error("not connected")
+            return
+        if cmd == "SEND":
+            self._send_cmd(frame)
+        elif cmd == "SUBSCRIBE":
+            self._subscribe(frame)
+        elif cmd == "UNSUBSCRIBE":
+            self._unsubscribe(frame)
+        elif cmd == "DISCONNECT":
+            self.closing = True
+        elif cmd in ("ACK", "NACK", "BEGIN", "COMMIT", "ABORT"):
+            pass  # transactions/acks accepted as no-ops (client mode auto)
+        else:
+            self.error(f"unknown command {cmd!r}", receipt)
+            return
+        if receipt and not self.closing:
+            self.send(StompFrame("RECEIPT", {"receipt-id": receipt}))
+        elif receipt and cmd == "DISCONNECT":
+            self.send(StompFrame("RECEIPT", {"receipt-id": receipt}))
+
+    def _connect(self, frame: StompFrame) -> None:
+        login = frame.headers.get("login")
+        passcode = frame.headers.get("passcode")
+        self.clientid = frame.headers.get("client-id") or f"stomp-{id(self):x}"
+        ci = ClientInfo(
+            clientid=self.clientid,
+            username=login,
+            password=passcode.encode() if passcode else None,
+            peerhost=self.peername,
+            protocol="stomp",
+        )
+        self.clientinfo = ci
+        if not self.ctx.authenticate(ci):
+            self.error("authentication failed")
+            return
+        self.ctx.open_session(True, ci, self)
+        self.connected = True
+        self.send(StompFrame("CONNECTED", {
+            "version": "1.2",
+            "server": "emqx_tpu-stomp",
+            "heart-beat": "0,0",
+            "session": self.clientid,
+        }))
+
+    def _send_cmd(self, frame: StompFrame) -> None:
+        dest = frame.headers.get("destination")
+        if not dest:
+            self.error("SEND needs destination")
+            return
+        if not self.ctx.authorize(self.clientinfo, "publish", dest):
+            self.error(f"publish to {dest} denied")
+            return
+        self.ctx.publish(self.clientinfo, dest, frame.body)
+
+    def _subscribe(self, frame: StompFrame) -> None:
+        dest = frame.headers.get("destination")
+        sub_id = frame.headers.get("id")
+        if not dest or sub_id is None:
+            self.error("SUBSCRIBE needs destination and id")
+            return
+        if not self.ctx.authorize(self.clientinfo, "subscribe", dest):
+            self.error(f"subscribe to {dest} denied")
+            return
+        self.subs[sub_id] = (dest, frame.headers.get("ack", "auto"))
+        self.ctx.subscribe(self, dest)
+
+    def _unsubscribe(self, frame: StompFrame) -> None:
+        sub_id = frame.headers.get("id")
+        ent = self.subs.pop(sub_id, None)
+        if ent is not None:
+            self.ctx.unsubscribe(self, ent[0])
+
+
+class StompGateway:
+    def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
+                 mountpoint: str = ""):
+        self.ctx = GatewayContext(broker, "stomp", mountpoint)
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("stomp gateway on %s:%s", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for t in list(self._conns):
+                t.cancel()
+            if self._conns:
+                await asyncio.gather(*self._conns, return_exceptions=True)
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._conns.add(task)
+        peer = writer.get_extra_info("peername")
+        ch = StompChannel(self.ctx, writer, peer[0] if peer else "?")
+        parser = StompParser()
+        try:
+            while not ch.closing:
+                data = await reader.read(65536)
+                if not data:
+                    break
+                try:
+                    frames = parser.feed(data)
+                except ValueError as e:
+                    ch.error(str(e))
+                    break
+                for f in frames:
+                    ch.handle(f)
+                    if ch.closing:
+                        break
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass
+        finally:
+            self._conns.discard(task)
+            if ch.connected:
+                self.ctx.close_session(ch)
+            try:
+                writer.close()
+            except Exception:
+                pass

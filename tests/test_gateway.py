@@ -1,0 +1,297 @@
+"""Gateway tests: STOMP (TCP) + MQTT-SN (UDP) + cross-protocol interop."""
+
+import asyncio
+import struct
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.gateway import MqttSnGateway, StompFrame, StompGateway
+from emqx_tpu.gateway import mqttsn as sn
+from emqx_tpu.gateway.stomp import StompParser
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+# ------------------------------------------------------------ STOMP codec
+
+def test_stomp_frame_roundtrip():
+    f = StompFrame("SEND", {"destination": "a/b", "x:y": "v\nw"}, b"body")
+    p = StompParser()
+    frames = p.feed(f.serialize())
+    assert len(frames) == 1
+    g = frames[0]
+    assert g.command == "SEND" and g.body == b"body"
+    assert g.headers["destination"] == "a/b"
+    assert g.headers["x:y"] == "v\nw"  # header escaping survived
+
+
+def test_stomp_parser_partial_and_binary_body():
+    f = StompFrame("SEND", {"destination": "t"}, b"nul\x00inside")
+    raw = f.serialize()  # has content-length so NUL in body is fine
+    p = StompParser()
+    assert p.feed(raw[:5]) == []
+    frames = p.feed(raw[5:])
+    assert frames[0].body == b"nul\x00inside"
+    # heart-beat newlines between frames are ignored
+    assert p.feed(b"\n\n") == []
+
+
+# ----------------------------------------------------------- STOMP client
+
+class StompTestClient:
+    def __init__(self):
+        self.parser = StompParser()
+        self.frames = asyncio.Queue()
+
+    async def connect(self, port, headers=None):
+        self.reader, self.writer = await asyncio.open_connection("127.0.0.1", port)
+        self.task = asyncio.create_task(self._read())
+        h = {"accept-version": "1.2", "host": "/"}
+        h.update(headers or {})
+        self.send(StompFrame("CONNECT", h))
+        f = await asyncio.wait_for(self.frames.get(), 5)
+        return f
+
+    def send(self, frame):
+        self.writer.write(frame.serialize())
+
+    async def _read(self):
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    return
+                for f in self.parser.feed(data):
+                    await self.frames.put(f)
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+
+    async def recv(self):
+        return await asyncio.wait_for(self.frames.get(), 5)
+
+    async def close(self):
+        self.task.cancel()
+        self.writer.close()
+
+
+def test_stomp_pubsub(run):
+    async def main():
+        b = Broker()
+        gw = StompGateway(b, port=0)
+        await gw.start()
+        c1 = StompTestClient()
+        f = await c1.connect(gw.port, {"client-id": "s1"})
+        assert f.command == "CONNECTED" and f.headers["version"] == "1.2"
+
+        c1.send(StompFrame("SUBSCRIBE", {"id": "0", "destination": "stomp/t",
+                                         "receipt": "r1"}))
+        r = await c1.recv()
+        assert r.command == "RECEIPT" and r.headers["receipt-id"] == "r1"
+
+        c2 = StompTestClient()
+        await c2.connect(gw.port, {"client-id": "s2"})
+        c2.send(StompFrame("SEND", {"destination": "stomp/t"}, b"hello stomp"))
+        m = await c1.recv()
+        assert m.command == "MESSAGE"
+        assert m.headers["destination"] == "stomp/t"
+        assert m.headers["subscription"] == "0"
+        assert m.body == b"hello stomp"
+
+        # unsubscribe stops delivery
+        c1.send(StompFrame("UNSUBSCRIBE", {"id": "0", "receipt": "r2"}))
+        await c1.recv()
+        c2.send(StompFrame("SEND", {"destination": "stomp/t"}, b"gone"))
+        await asyncio.sleep(0.1)
+        assert c1.frames.empty()
+        await c1.close()
+        await c2.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_stomp_mqtt_interop(run):
+    async def main():
+        b = Broker()
+        gw = StompGateway(b, port=0)
+        await gw.start()
+        lst = Listener(b, port=0)
+        await lst.start()
+
+        mqtt = MqttClient(clientid="m1")
+        await mqtt.connect(port=lst.port)
+        await mqtt.subscribe("bridge/#", qos=0)
+
+        st = StompTestClient()
+        await st.connect(gw.port, {"client-id": "s1"})
+        st.send(StompFrame("SUBSCRIBE", {"id": "7", "destination": "bridge/stomp"}))
+
+        # STOMP -> MQTT
+        st.send(StompFrame("SEND", {"destination": "bridge/x"}, b"from stomp"))
+        m = await asyncio.wait_for(mqtt.recv(), 5)
+        assert (m.topic, m.payload) == ("bridge/x", b"from stomp")
+
+        # MQTT -> STOMP
+        await mqtt.publish("bridge/stomp", b"from mqtt", qos=0)
+        f = await st.recv()
+        assert f.command == "MESSAGE" and f.body == b"from mqtt"
+
+        await st.close()
+        await mqtt.disconnect()
+        await lst.stop()
+        await gw.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------- MQTT-SN
+
+class SnTestClient(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+
+    def datagram_received(self, data, addr):
+        self.inbox.put_nowait(sn.parse(data))
+
+    async def start(self, port):
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, remote_addr=("127.0.0.1", port))
+        return self
+
+    def send(self, msg_type, body):
+        self.transport.sendto(sn.mk(msg_type, body))
+
+    async def recv(self, want=None):
+        while True:
+            t, body = await asyncio.wait_for(self.inbox.get(), 5)
+            if want is None or t == want:
+                return t, body
+
+    def close(self):
+        self.transport.close()
+
+
+def test_mqttsn_codec():
+    d = sn.mk(sn.CONNECT, b"\x04\x01\x00\x3cdev1")
+    t, body = sn.parse(d)
+    assert t == sn.CONNECT and body.endswith(b"dev1")
+    big = sn.mk(sn.PUBLISH, b"\x00" * 300)
+    t, body = sn.parse(big)
+    assert t == sn.PUBLISH and len(body) == 300
+
+
+def test_mqttsn_connect_register_publish_subscribe(run):
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0)
+        await gw.start()
+
+        sub = await SnTestClient().start(gw.port)
+        sub.send(sn.CONNECT, bytes([sn.FLAG_CLEAN, 0x01]) + struct.pack("!H", 60) + b"sn-sub")
+        t, body = await sub.recv(sn.CONNACK)
+        assert body[0] == sn.RC_ACCEPTED
+
+        # subscribe with a literal topic name
+        sub.send(sn.SUBSCRIBE, bytes([0x20]) + struct.pack("!H", 1) + b"sensors/1")
+        t, body = await sub.recv(sn.SUBACK)
+        flags, tid, msg_id, rc = struct.unpack("!BHHB", body)
+        assert rc == sn.RC_ACCEPTED and msg_id == 1 and tid != 0
+
+        pub = await SnTestClient().start(gw.port)
+        pub.send(sn.CONNECT, bytes([sn.FLAG_CLEAN, 0x01]) + struct.pack("!H", 60) + b"sn-pub")
+        await pub.recv(sn.CONNACK)
+        # REGISTER the topic, then PUBLISH qos1
+        pub.send(sn.REGISTER, struct.pack("!HH", 0, 2) + b"sensors/1")
+        t, body = await pub.recv(sn.REGACK)
+        ptid, pmid, prc = struct.unpack("!HHB", body)
+        assert prc == sn.RC_ACCEPTED
+        pub.send(sn.PUBLISH,
+                 bytes([0x20]) + struct.pack("!H", ptid) + struct.pack("!H", 3) + b"21.5")
+        t, body = await pub.recv(sn.PUBACK)
+        assert body[4] == sn.RC_ACCEPTED
+
+        # subscriber gets the PUBLISH (its own topic id, qos1)
+        t, body = await sub.recv(sn.PUBLISH)
+        flags = body[0]
+        (rtid,) = struct.unpack_from("!H", body, 1)
+        assert body[5:] == b"21.5"
+        assert rtid == tid  # the id SUBACK granted for this topic
+        sub.close()
+        pub.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_mqttsn_wildcard_gets_register(run):
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0)
+        await gw.start()
+        c = await SnTestClient().start(gw.port)
+        c.send(sn.CONNECT, bytes([sn.FLAG_CLEAN, 0x01]) + struct.pack("!H", 60) + b"sn-w")
+        await c.recv(sn.CONNACK)
+        c.send(sn.SUBSCRIBE, bytes([0x00]) + struct.pack("!H", 9) + b"room/+")
+        t, body = await c.recv(sn.SUBACK)
+        _f, tid, _mid, rc = struct.unpack("!BHHB", body)
+        assert rc == sn.RC_ACCEPTED and tid == 0  # wildcard: no topic id yet
+
+        b.publish(__import__("emqx_tpu.broker.message", fromlist=["Message"])
+                  .Message(topic="room/7", payload=b"x"))
+        # server must REGISTER the concrete topic first, then PUBLISH
+        t, body = await c.recv(sn.REGISTER)
+        rtid, _mid2 = struct.unpack_from("!HH", body)
+        assert body[4:] == b"room/7"
+        t, body = await c.recv(sn.PUBLISH)
+        (ptid,) = struct.unpack_from("!H", body, 1)
+        assert ptid == rtid and body[5:] == b"x"
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_mqttsn_searchgw_ping_disconnect(run):
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0, gateway_id=7)
+        await gw.start()
+        c = await SnTestClient().start(gw.port)
+        c.send(sn.SEARCHGW, b"\x00")
+        t, body = await c.recv(sn.GWINFO)
+        assert body[0] == 7
+        c.send(sn.PINGREQ, b"")
+        await c.recv(sn.PINGRESP)
+        c.send(sn.CONNECT, bytes([sn.FLAG_CLEAN, 0x01]) + struct.pack("!H", 60) + b"sn-d")
+        await c.recv(sn.CONNACK)
+        c.send(sn.DISCONNECT, b"")
+        await c.recv(sn.DISCONNECT)
+        assert gw.clients == {}
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_gateway_registry():
+    from emqx_tpu.gateway import GatewayRegistry
+
+    reg = GatewayRegistry()
+    b = Broker()
+    gw = StompGateway(b)
+    reg.register("stomp", gw)
+    assert reg.lookup("stomp") is gw
+    assert reg.list() == ["stomp"]
+    with pytest.raises(ValueError):
+        reg.register("stomp", gw)
+    assert reg.unregister("stomp") is gw
+    assert reg.list() == []
