@@ -1,0 +1,22 @@
+"""Data integration: resource lifecycle, connectors, bridges.
+
+Analog of `emqx_resource` + `emqx_connector` + `emqx_bridge`
+(SURVEY.md §1.9): resources are supervised instances with health
+checks and auto-restart; connectors implement the transport (HTTP,
+MQTT); bridges wire broker traffic to connectors (egress: local
+publishes out; ingress: remote messages in) with ${placeholder}
+templating and a bounded retry buffer (the replayq analog).
+"""
+
+from .bridge import EgressBridge, IngressBridge
+from .connectors import HttpConnector, MqttConnector
+from .resource import ResourceManager, ResourceStatus
+
+__all__ = [
+    "EgressBridge",
+    "IngressBridge",
+    "HttpConnector",
+    "MqttConnector",
+    "ResourceManager",
+    "ResourceStatus",
+]

@@ -1,0 +1,177 @@
+"""Bridges: broker traffic <-> connectors — `emqx_bridge` analog.
+
+Egress: a 'message.publish' hook matches a local topic filter, renders
+${placeholder} templates (topic/payload/qos/clientid...), and enqueues
+the render into a bounded buffer drained by an async worker that calls
+the connector — send failures retry with backoff, overflow drops oldest
+(the replayq-backed buffering model, in memory).
+
+Ingress: the connector subscribes remotely; arriving messages are
+re-published locally under a templated topic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from ..broker import topic as topiclib
+from ..broker.broker import Broker
+from ..broker.message import Message
+from ..rules.engine import render_template
+
+log = logging.getLogger("emqx_tpu.bridge")
+
+
+def _msg_env(msg: Message) -> Dict:
+    return {
+        "topic": msg.topic,
+        "payload": msg.payload.decode("utf-8", "replace"),
+        "qos": msg.qos,
+        "retain": msg.retain,
+        "clientid": msg.from_client,
+        "username": msg.from_username,
+        "id": msg.mid.hex(),
+        "timestamp": msg.timestamp,
+    }
+
+
+class EgressBridge:
+    def __init__(
+        self,
+        broker: Broker,
+        connector,
+        local_filter: str,
+        remote_topic: str = "${topic}",
+        payload_template: str = "${payload}",
+        qos: int = 0,
+        max_buffer: int = 10_000,
+        retry_interval: float = 1.0,
+        send: Optional[Callable] = None,
+    ):
+        self.broker = broker
+        self.connector = connector
+        self.local_filter = local_filter
+        self.remote_topic = remote_topic
+        self.payload_template = payload_template
+        self.qos = qos
+        self.buffer: deque = deque(maxlen=max_buffer)
+        self.retry_interval = retry_interval
+        self.dropped = 0
+        self.sent = 0
+        self.failed = 0
+        self._send = send or self._send_default
+        self._worker: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self.broker.hooks.put("message.publish", self._on_publish, priority=-300)
+        self._worker = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self.broker.hooks.delete("message.publish", self._on_publish)
+        if self._worker:
+            self._worker.cancel()
+            try:
+                await self._worker
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    # -------------------------------------------------------------- egress
+
+    def _on_publish(self, msg):
+        if not isinstance(msg, Message) or msg.headers.get("bridged"):
+            return None
+        if not topiclib.match(msg.topic, self.local_filter):
+            return None
+        env = _msg_env(msg)
+        item = (
+            render_template(self.remote_topic, env, env),
+            render_template(self.payload_template, env, env).encode(),
+        )
+        if len(self.buffer) == self.buffer.maxlen:
+            self.dropped += 1
+        self.buffer.append(item)
+        self._wake.set()
+        return None
+
+    async def _run(self) -> None:
+        while True:
+            if not self.buffer:
+                self._wake.clear()
+                await self._wake.wait()
+            topic, payload = self.buffer[0]
+            try:
+                await self._send(topic, payload)
+                self.buffer.popleft()
+                self.sent += 1
+            except Exception as e:
+                self.failed += 1
+                log.debug("bridge send failed: %s", e)
+                await asyncio.sleep(self.retry_interval)
+
+    async def _send_default(self, topic: str, payload: bytes) -> None:
+        await self.connector.publish(topic, payload, qos=self.qos)
+
+    def stats(self) -> dict:
+        return {
+            "sent": self.sent,
+            "failed": self.failed,
+            "dropped": self.dropped,
+            "buffered": len(self.buffer),
+        }
+
+
+class HttpEgressBridge(EgressBridge):
+    """Egress variant posting JSON to an HttpConnector path (webhook)."""
+
+    def __init__(self, broker, connector, local_filter: str, path: str = "/",
+                 **kw):
+        super().__init__(broker, connector, local_filter, send=self._post, **kw)
+        self.path = path
+
+    async def _post(self, topic: str, payload: bytes) -> None:
+        status, _ = await self.connector.post_json(
+            self.path, {"topic": topic, "payload": payload.decode("utf-8", "replace")}
+        )
+        if status >= 300:
+            raise ConnectionError(f"webhook status {status}")
+
+
+class IngressBridge:
+    def __init__(
+        self,
+        broker: Broker,
+        connector,
+        remote_filter: str,
+        local_topic: str = "${topic}",
+        qos: int = 0,
+    ):
+        self.broker = broker
+        self.connector = connector
+        self.remote_filter = remote_filter
+        self.local_topic = local_topic
+        self.qos = qos
+        self.received = 0
+
+    async def start(self) -> None:
+        self.connector.on_message = self._on_remote
+        await self.connector.subscribe(self.remote_filter, qos=self.qos)
+
+    def _on_remote(self, msg) -> None:
+        env = {
+            "topic": msg.topic,
+            "payload": msg.payload.decode("utf-8", "replace"),
+            "qos": msg.qos,
+        }
+        self.received += 1
+        self.broker.publish(Message(
+            topic=render_template(self.local_topic, env, env),
+            payload=msg.payload,
+            qos=self.qos,
+            headers={"bridged": True},  # loop guard for paired bridges
+        ))

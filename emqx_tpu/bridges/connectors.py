@@ -1,0 +1,183 @@
+"""Connector implementations — `emqx_connector` analogs (HTTP, MQTT).
+
+HttpConnector: minimal asyncio HTTP/1.1 client with keep-alive
+(`emqx_connector_http`/ehttpc analog).  MqttConnector: a client session
+to a remote broker built on the in-repo MqttClient, supporting egress
+publish and ingress subscriptions (`emqx_connector_mqtt` analog).
+Database connectors (MySQL/PgSQL/Mongo/Redis/LDAP) need drivers absent
+from this image; they register as unavailable stubs so configs naming
+them fail loud at create time rather than silently.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+from ..broker.client import MqttClient
+
+
+class HttpConnector:
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 headers: Optional[Dict[str, str]] = None):
+        parts = urlsplit(base_url)
+        if parts.scheme != "http":
+            raise ValueError("only http:// supported (no TLS stack configured)")
+        self.host = parts.hostname or "127.0.0.1"
+        self.port = parts.port or 80
+        self.base_path = parts.path.rstrip("/")
+        self.timeout = timeout
+        self.headers = headers or {}
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    async def start(self) -> None:
+        await self._ensure()
+
+    async def stop(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._reader = self._writer = None
+
+    async def _ensure(self) -> None:
+        if self._writer is None or self._writer.is_closing():
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), self.timeout
+            )
+
+    async def health_check(self) -> bool:
+        try:
+            await self._ensure()
+            return True
+        except Exception:
+            return False
+
+    async def request(self, method: str, path: str, body: Optional[bytes] = None,
+                      headers: Optional[Dict[str, str]] = None) -> Tuple[int, bytes]:
+        async with self._lock:  # keep-alive conn: serialize requests
+            await self._ensure()
+            h = {
+                "Host": f"{self.host}:{self.port}",
+                "Content-Length": str(len(body or b"")),
+                "Connection": "keep-alive",
+            }
+            h.update(self.headers)
+            h.update(headers or {})
+            head = f"{method} {self.base_path}{path} HTTP/1.1\r\n"
+            head += "".join(f"{k}: {v}\r\n" for k, v in h.items()) + "\r\n"
+            try:
+                self._writer.write(head.encode() + (body or b""))
+                await self._writer.drain()
+                return await asyncio.wait_for(self._read_response(), self.timeout)
+            except (ConnectionError, asyncio.IncompleteReadError):
+                await self.stop()
+                raise
+
+    async def _read_response(self) -> Tuple[int, bytes]:
+        status_line = await self._reader.readline()
+        status = int(status_line.split()[1])
+        headers: Dict[str, str] = {}
+        while True:
+            line = await self._reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        n = int(headers.get("content-length", 0) or 0)
+        body = await self._reader.readexactly(n) if n else b""
+        if headers.get("connection", "").lower() == "close":
+            await self.stop()
+        return status, body
+
+    async def post_json(self, path: str, obj) -> Tuple[int, bytes]:
+        return await self.request(
+            "POST", path, json.dumps(obj).encode(),
+            {"Content-Type": "application/json"},
+        )
+
+
+class MqttConnector:
+    """Session to a remote MQTT broker (bridge transport)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 1883,
+                 clientid: str = "emqx_tpu_bridge", username: Optional[str] = None,
+                 password: Optional[bytes] = None, keepalive: int = 60):
+        self.host = host
+        self.port = port
+        self.clientid = clientid
+        self.username = username
+        self.password = password
+        self.keepalive = keepalive
+        self.client: Optional[MqttClient] = None
+        self.on_message: Optional[Callable] = None
+        self._subs: List[Tuple[str, int]] = []
+        self._pump: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self.client = MqttClient(
+            clientid=self.clientid, username=self.username,
+            password=self.password, keepalive=self.keepalive,
+        )
+        await self.client.connect(host=self.host, port=self.port)
+        for filt, qos in self._subs:
+            await self.client.subscribe(filt, qos=qos)
+        self._pump = asyncio.get_running_loop().create_task(self._pump_loop())
+
+    async def stop(self) -> None:
+        if self._pump:
+            self._pump.cancel()
+            self._pump = None
+        if self.client is not None:
+            try:
+                await self.client.disconnect()
+            except Exception:
+                pass
+            self.client = None
+
+    async def health_check(self) -> bool:
+        return self.client is not None and not self.client.closed.is_set()
+
+    async def subscribe(self, filt: str, qos: int = 0) -> None:
+        self._subs.append((filt, qos))
+        if self.client is not None:
+            await self.client.subscribe(filt, qos=qos)
+
+    async def publish(self, topic: str, payload: bytes, qos: int = 0,
+                      retain: bool = False) -> None:
+        if self.client is None:
+            raise ConnectionError("bridge not connected")
+        await self.client.publish(topic, payload, qos=qos, retain=retain)
+
+    async def _pump_loop(self) -> None:
+        try:
+            while True:
+                msg = await self.client.recv()
+                if self.on_message is not None:
+                    r = self.on_message(msg)
+                    if asyncio.iscoroutine(r):
+                        await r
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
+_UNAVAILABLE = ("mysql", "pgsql", "mongodb", "redis", "ldap")
+
+
+def make_connector(kind: str, **cfg):
+    """Connector factory keyed like the reference's connector types."""
+    if kind == "http":
+        return HttpConnector(**cfg)
+    if kind == "mqtt":
+        return MqttConnector(**cfg)
+    if kind in _UNAVAILABLE:
+        raise NotImplementedError(
+            f"{kind} connector needs a database driver not present in this "
+            f"environment; gate the bridge config on driver availability"
+        )
+    raise ValueError(f"unknown connector kind {kind!r}")

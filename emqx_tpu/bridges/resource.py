@@ -1,0 +1,140 @@
+"""Resource instance lifecycle — `emqx_resource_instance` analog.
+
+A resource is any object with async `start()`, `stop()`,
+`health_check() -> bool`.  The manager tracks per-resource status
+(connected / disconnected / stopped), runs periodic health checks, and
+auto-restarts unhealthy resources (`emqx_resource_health_check`
+semantics), counting successes/failures for the management API.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger("emqx_tpu.resource")
+
+
+class ResourceStatus(str, enum.Enum):
+    CONNECTING = "connecting"
+    CONNECTED = "connected"
+    DISCONNECTED = "disconnected"
+    STOPPED = "stopped"
+
+
+class _Entry:
+    def __init__(self, resource, health_interval: float, auto_restart: bool):
+        self.resource = resource
+        self.health_interval = health_interval
+        self.auto_restart = auto_restart
+        self.status = ResourceStatus.CONNECTING
+        self.task: Optional[asyncio.Task] = None
+        self.restarts = 0
+        self.last_error: Optional[str] = None
+        self.started_at = time.time()
+
+
+class ResourceManager:
+    def __init__(self):
+        self._r: Dict[str, _Entry] = {}
+
+    async def create(self, resource_id: str, resource,
+                     health_interval: float = 15.0,
+                     auto_restart: bool = True) -> ResourceStatus:
+        if resource_id in self._r:
+            raise ValueError(f"resource {resource_id!r} exists")
+        ent = _Entry(resource, health_interval, auto_restart)
+        self._r[resource_id] = ent
+        await self._start(resource_id, ent)
+        ent.task = asyncio.get_running_loop().create_task(
+            self._health_loop(resource_id, ent)
+        )
+        return ent.status
+
+    async def _start(self, rid: str, ent: _Entry) -> None:
+        try:
+            await ent.resource.start()
+            ok = await ent.resource.health_check()
+            ent.status = (
+                ResourceStatus.CONNECTED if ok else ResourceStatus.DISCONNECTED
+            )
+            ent.last_error = None
+        except Exception as e:
+            ent.status = ResourceStatus.DISCONNECTED
+            ent.last_error = f"{type(e).__name__}: {e}"
+
+    async def _health_loop(self, rid: str, ent: _Entry) -> None:
+        while True:
+            await asyncio.sleep(ent.health_interval)
+            if ent.status == ResourceStatus.STOPPED:
+                continue
+            try:
+                ok = await ent.resource.health_check()
+            except Exception as e:
+                ok = False
+                ent.last_error = f"{type(e).__name__}: {e}"
+            if ok:
+                ent.status = ResourceStatus.CONNECTED
+            else:
+                ent.status = ResourceStatus.DISCONNECTED
+                if ent.auto_restart:
+                    log.info("restarting unhealthy resource %s", rid)
+                    try:
+                        await ent.resource.stop()
+                    except Exception:
+                        pass
+                    ent.restarts += 1
+                    await self._start(rid, ent)
+
+    async def remove(self, resource_id: str) -> bool:
+        ent = self._r.pop(resource_id, None)
+        if ent is None:
+            return False
+        if ent.task:
+            ent.task.cancel()
+            try:
+                await ent.task
+            except (asyncio.CancelledError, Exception):
+                pass
+        try:
+            await ent.resource.stop()
+        except Exception:
+            pass
+        ent.status = ResourceStatus.STOPPED
+        return True
+
+    async def restart(self, resource_id: str) -> ResourceStatus:
+        ent = self._r[resource_id]
+        try:
+            await ent.resource.stop()
+        except Exception:
+            pass
+        ent.restarts += 1
+        await self._start(resource_id, ent)
+        return ent.status
+
+    def status(self, resource_id: str) -> Optional[ResourceStatus]:
+        ent = self._r.get(resource_id)
+        return ent.status if ent else None
+
+    def get(self, resource_id: str):
+        ent = self._r.get(resource_id)
+        return ent.resource if ent else None
+
+    def list(self) -> Dict[str, dict]:
+        return {
+            rid: {
+                "status": ent.status.value,
+                "restarts": ent.restarts,
+                "last_error": ent.last_error,
+                "uptime": time.time() - ent.started_at,
+            }
+            for rid, ent in self._r.items()
+        }
+
+    async def stop_all(self) -> None:
+        for rid in list(self._r):
+            await self.remove(rid)

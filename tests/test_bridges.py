@@ -1,0 +1,217 @@
+"""Resource lifecycle + connectors + bridges (`emqx_resource`/`_bridge`)."""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.bridges import (
+    EgressBridge,
+    HttpConnector,
+    IngressBridge,
+    MqttConnector,
+    ResourceManager,
+    ResourceStatus,
+)
+from emqx_tpu.bridges.bridge import HttpEgressBridge
+from emqx_tpu.bridges.connectors import make_connector
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.broker.message import Message
+from emqx_tpu.mgmt.http import HttpApi
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+class FlakyResource:
+    def __init__(self):
+        self.started = 0
+        self.healthy = True
+
+    async def start(self):
+        self.started += 1
+
+    async def stop(self):
+        pass
+
+    async def health_check(self):
+        return self.healthy
+
+
+def test_resource_lifecycle_and_auto_restart(run):
+    async def main():
+        rm = ResourceManager()
+        res = FlakyResource()
+        st = await rm.create("r1", res, health_interval=0.05)
+        assert st == ResourceStatus.CONNECTED
+        # goes unhealthy -> auto restart flips it back
+        res.healthy = False
+        await asyncio.sleep(0.12)
+        assert res.started >= 2  # restarted at least once
+        res.healthy = True
+        await asyncio.sleep(0.12)
+        assert rm.status("r1") == ResourceStatus.CONNECTED
+        info = rm.list()["r1"]
+        assert info["restarts"] >= 1
+        assert await rm.remove("r1")
+        assert rm.status("r1") is None
+        with pytest.raises(KeyError):
+            await rm.restart("r1")
+        await rm.stop_all()
+
+    run(main())
+
+
+def test_make_connector_gating():
+    with pytest.raises(NotImplementedError):
+        make_connector("mysql")
+    with pytest.raises(ValueError):
+        make_connector("bogus")
+    assert isinstance(make_connector("http", base_url="http://127.0.0.1:1"),
+                      HttpConnector)
+
+
+def test_http_connector_roundtrip(run):
+    async def main():
+        srv = HttpApi(port=0, base="")
+        seen = []
+        srv.route("POST", "/hook", lambda req: seen.append(req.json()) or {"ok": 1},
+                  public=True)
+        await srv.start()
+        c = HttpConnector(f"http://127.0.0.1:{srv.port}")
+        await c.start()
+        assert await c.health_check()
+        status, body = await c.post_json("/hook", {"x": 1})
+        assert status == 200 and json.loads(body) == {"ok": 1}
+        # keep-alive: second request on the same conn
+        status, _ = await c.post_json("/hook", {"x": 2})
+        assert status == 200 and [d["x"] for d in seen] == [1, 2]
+        await c.stop()
+        await srv.stop()
+
+    run(main())
+
+
+def test_http_egress_webhook(run):
+    async def main():
+        srv = HttpApi(port=0, base="")
+        seen = []
+        srv.route("POST", "/webhook", lambda req: seen.append(req.json()) or {},
+                  public=True)
+        await srv.start()
+        b = Broker()
+        c = HttpConnector(f"http://127.0.0.1:{srv.port}")
+        await c.start()
+        br = HttpEgressBridge(b, c, "web/#", path="/webhook")
+        br.start()
+        b.publish(Message(topic="web/1", payload=b"data", from_client="c9"))
+        b.publish(Message(topic="other/1", payload=b"no"))
+        for _ in range(100):
+            if br.sent == 1:
+                break
+            await asyncio.sleep(0.02)
+        assert br.sent == 1 and seen == [{"topic": "web/1", "payload": "data"}]
+        await br.stop()
+        await c.stop()
+        await srv.stop()
+
+    run(main())
+
+
+def test_mqtt_bridge_egress_and_ingress(run):
+    async def main():
+        # local and remote brokers with real listeners
+        local, remote = Broker(), Broker()
+        l_lst, r_lst = Listener(local, port=0), Listener(remote, port=0)
+        await l_lst.start()
+        await r_lst.start()
+
+        # remote subscriber watches what egress forwards
+        watcher = MqttClient(clientid="watcher")
+        await watcher.connect(port=r_lst.port)
+        await watcher.subscribe("up/#", qos=0)
+
+        conn = MqttConnector(port=r_lst.port, clientid="bridge1")
+        rm = ResourceManager()
+        await rm.create("mqtt:remote", conn, health_interval=5)
+        assert rm.status("mqtt:remote") == ResourceStatus.CONNECTED
+
+        egress = EgressBridge(
+            local, conn, "sensor/#",
+            remote_topic="up/${topic}", payload_template="${payload}",
+        )
+        egress.start()
+        local.publish(Message(topic="sensor/1", payload=b"21.5"))
+        m = await asyncio.wait_for(watcher.recv(), 5)
+        assert (m.topic, m.payload) == ("up/sensor/1", b"21.5")
+
+        # ingress: remote publishes appear locally under a prefix
+        ingress = IngressBridge(local, conn, "cmd/#", local_topic="down/${topic}")
+        await ingress.start()
+        got = []
+
+        class Sink:
+            clientid = "lsub"
+            session = None
+
+            def deliver(self, items):
+                got.extend(items)
+
+            def kick(self, rc=0):
+                pass
+
+        from emqx_tpu.broker.packet import SubOpts
+        from emqx_tpu.broker.session import Session
+
+        sk = Sink()
+        sk.session = Session(clientid="lsub")
+        sk.session.subscriptions["down/#"] = SubOpts(qos=0)
+        local.cm.register_channel(sk)
+        local.subscribe("lsub", "down/#", SubOpts(qos=0))
+
+        pubr = MqttClient(clientid="rpub")
+        await pubr.connect(port=r_lst.port)
+        await pubr.publish("cmd/go", b"now", qos=0)
+        for _ in range(100):
+            if got:
+                break
+            await asyncio.sleep(0.02)
+        assert got and got[0][1].topic == "down/cmd/go"
+        assert got[0][1].payload == b"now"
+
+        await egress.stop()
+        await pubr.disconnect()
+        await watcher.disconnect()
+        await rm.stop_all()
+        await l_lst.stop()
+        await r_lst.stop()
+
+    run(main())
+
+
+def test_egress_buffer_retry_on_dead_connector(run):
+    async def main():
+        b = Broker()
+
+        class DeadConn:
+            async def publish(self, *a, **kw):
+                raise ConnectionError("down")
+
+        br = EgressBridge(b, DeadConn(), "q/#", retry_interval=0.02, max_buffer=2)
+        br.start()
+        for i in range(4):
+            b.publish(Message(topic="q/x", payload=b"%d" % i))
+        await asyncio.sleep(0.1)
+        st = br.stats()
+        assert st["failed"] >= 1
+        assert st["dropped"] >= 1  # overflow dropped oldest
+        assert st["buffered"] <= 2
+        await br.stop()
+
+    run(main())
