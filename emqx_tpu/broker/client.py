@@ -59,8 +59,14 @@ class MqttClient:
 
     # ------------------------------------------------------------ connect
 
-    async def connect(self, host: str = "127.0.0.1", port: int = 1883) -> pkt.Connack:
-        self._reader, self._writer = await asyncio.open_connection(host, port)
+    async def connect(self, host: str = "127.0.0.1", port: int = 1883,
+                      streams=None) -> pkt.Connack:
+        """`streams=(reader, writer)` runs MQTT over a pre-established
+        transport (e.g. a WebSocket adapter) instead of dialing TCP."""
+        if streams is not None:
+            self._reader, self._writer = streams
+        else:
+            self._reader, self._writer = await asyncio.open_connection(host, port)
         self._parser = Parser(version=self.proto_ver)
         c = pkt.Connect(
             proto_name="MQIsdp" if self.proto_ver == 3 else "MQTT",

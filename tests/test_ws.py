@@ -1,0 +1,126 @@
+"""MQTT over WebSocket: RFC6455 codec + full client/server roundtrip."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker import ws as wslib
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.broker.ws import WsListener, ws_connect
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+def test_frame_codec_lengths_and_masking():
+    for n in (0, 1, 125, 126, 65535, 65536):
+        payload = bytes(range(256)) * (n // 256) + bytes(range(n % 256))
+        raw = wslib.encode_frame(wslib.OP_BINARY, payload, mask=True)
+
+        class R:
+            def __init__(self, buf):
+                self.buf = buf
+
+            async def readexactly(self, k):
+                out, self.buf = self.buf[:k], self.buf[k:]
+                assert len(out) == k
+                return out
+
+        opcode, fin, got = asyncio.run(wslib.read_frame(R(raw)))
+        assert opcode == wslib.OP_BINARY and fin and got == payload
+
+
+def test_accept_key_rfc_vector():
+    # the example vector from RFC 6455 §1.3
+    assert wslib.accept_key("dGhlIHNhbXBsZSBub25jZQ==") == \
+        "s3pPLMBiTxaQ9kYGzzhZRbK+xOo="
+
+
+def test_mqtt_over_ws_end_to_end(run):
+    async def main():
+        b = Broker()
+        ws = WsListener(b, port=0)
+        await ws.start()
+        tcp = Listener(b, port=0)
+        await tcp.start()
+
+        # subscriber over WS
+        streams = await ws_connect("127.0.0.1", ws.port)
+        sub = MqttClient(clientid="ws-sub")
+        await sub.connect(streams=streams)
+        assert (await sub.subscribe("ws/#", qos=1)) == [1]
+
+        # publisher over plain TCP: same broker, cross-transport delivery
+        pub = MqttClient(clientid="tcp-pub")
+        await pub.connect(port=tcp.port)
+        await pub.publish("ws/1", b"over websocket", qos=1)
+        m = await asyncio.wait_for(sub.recv(), 5)
+        assert (m.topic, m.payload, m.qos) == ("ws/1", b"over websocket", 1)
+
+        # WS publisher -> WS subscriber
+        streams2 = await ws_connect("127.0.0.1", ws.port)
+        pub2 = MqttClient(clientid="ws-pub")
+        await pub2.connect(streams=streams2)
+        await pub2.publish("ws/2", b"ws to ws", qos=0)
+        m = await asyncio.wait_for(sub.recv(), 5)
+        assert m.payload == b"ws to ws"
+
+        await pub.disconnect()
+        await pub2.disconnect()
+        await sub.disconnect()
+        await ws.stop()
+        await tcp.stop()
+
+    run(main())
+
+
+def test_ws_handshake_rejects_bad_requests(run):
+    async def main():
+        b = Broker()
+        ws = WsListener(b, port=0)
+        await ws.start()
+        # wrong path
+        with pytest.raises(ConnectionError):
+            await ws_connect("127.0.0.1", ws.port, path="/nope")
+        # not an upgrade at all
+        r, w = await asyncio.open_connection("127.0.0.1", ws.port)
+        w.write(b"GET /mqtt HTTP/1.1\r\nHost: x\r\n\r\n")
+        await w.drain()
+        line = await r.readline()
+        assert b"400" in line
+        w.close()
+        await ws.stop()
+
+    run(main())
+
+
+def test_ws_ping_is_answered(run):
+    async def main():
+        b = Broker()
+        ws = WsListener(b, port=0)
+        await ws.start()
+        reader, writer = await asyncio.open_connection("127.0.0.1", ws.port)
+        import base64, os
+
+        key = base64.b64encode(os.urandom(16)).decode()
+        writer.write((
+            f"GET /mqtt HTTP/1.1\r\nHost: h\r\nUpgrade: websocket\r\n"
+            f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        await writer.drain()
+        while (await reader.readline()) not in (b"\r\n", b""):
+            pass
+        writer.write(wslib.encode_frame(wslib.OP_PING, b"hi", mask=True))
+        await writer.drain()
+        opcode, fin, payload = await asyncio.wait_for(wslib.read_frame(reader), 5)
+        assert opcode == wslib.OP_PONG and payload == b"hi"
+        writer.close()
+        await ws.stop()
+
+    run(main())
