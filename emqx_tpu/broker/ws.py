@@ -57,7 +57,15 @@ def encode_frame(opcode: int, payload: bytes, mask: bool = False,
     return head + payload
 
 
-async def read_frame(reader: asyncio.StreamReader) -> Tuple[int, bool, bytes]:
+MAX_MESSAGE_SIZE = 1_048_576  # match Parser(max_size) on the TCP path
+
+
+class FrameTooLarge(Exception):
+    pass
+
+
+async def read_frame(reader: asyncio.StreamReader,
+                     max_size: int = MAX_MESSAGE_SIZE) -> Tuple[int, bool, bytes]:
     """-> (opcode, fin, payload); unmasks client frames."""
     b1, b2 = await reader.readexactly(2)
     fin = bool(b1 & 0x80)
@@ -68,6 +76,9 @@ async def read_frame(reader: asyncio.StreamReader) -> Tuple[int, bool, bytes]:
         (n,) = struct.unpack("!H", await reader.readexactly(2))
     elif n == 127:
         (n,) = struct.unpack("!Q", await reader.readexactly(8))
+    if n > max_size:
+        # reject before buffering: a declared 8GB frame must not OOM us
+        raise FrameTooLarge(n)
     key = await reader.readexactly(4) if masked else None
     payload = await reader.readexactly(n) if n else b""
     if key:
@@ -83,9 +94,11 @@ class WsReader:
     Control frames are answered inline (ping->pong, close->echo).
     """
 
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 max_message_size: int = MAX_MESSAGE_SIZE):
         self._reader = reader
         self._writer = writer
+        self._max_message_size = max_message_size
         self.closed = False
         # frames are pumped by a background task so a cancelled read()
         # (keepalive timeout) can never desync the frame stream
@@ -96,11 +109,15 @@ class WsReader:
         frag = b""
         try:
             while True:
-                opcode, fin, payload = await read_frame(self._reader)
+                opcode, fin, payload = await read_frame(
+                    self._reader, self._max_message_size)
                 if opcode in (OP_BINARY, OP_TEXT, OP_CONT):
                     frag += payload
+                    if len(frag) > self._max_message_size:
+                        raise FrameTooLarge(len(frag))  # fragmented overrun
                     if fin:
-                        self._q.put_nowait(frag)
+                        if frag:  # b"" would read as the EOF sentinel
+                            self._q.put_nowait(frag)
                         frag = b""
                 elif opcode == OP_PING:
                     try:
@@ -117,6 +134,8 @@ class WsReader:
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
             pass
+        except FrameTooLarge as e:
+            log.warning("ws: dropping connection, frame too large (%s bytes)", e)
         finally:
             self.closed = True
             self._q.put_nowait(b"")  # EOF marker wakes a blocked read()
@@ -163,14 +182,7 @@ class WsListener(Listener):
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
-        try:
-            ok = await asyncio.wait_for(self._handshake(reader, writer), 10)
-        except (asyncio.TimeoutError, ConnectionError, asyncio.IncompleteReadError):
-            writer.close()
-            return
-        if not ok:
-            writer.close()
-            return
+        # shed BEFORE any protocol work, same ordering as the TCP listener
         if self.max_connections and len(self._conns) >= self.max_connections:
             writer.close()
             return
@@ -180,6 +192,16 @@ class WsListener(Listener):
             return
         if self.limiter is not None and not self.limiter.check("connection"):
             self.broker.metrics.inc("olp.new_conn.rate_limited")
+            writer.close()
+            return
+        try:
+            ok = await asyncio.wait_for(self._handshake(reader, writer), 10)
+        except (asyncio.TimeoutError, ConnectionError,
+                asyncio.IncompleteReadError, ValueError):
+            # ValueError covers LimitOverrunError from over-long header lines
+            writer.close()
+            return
+        if not ok:
             writer.close()
             return
         ws_reader = WsReader(reader, writer)

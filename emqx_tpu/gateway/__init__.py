@@ -9,16 +9,20 @@ Here `core.GatewayContext` is that ctx: gateway channels authenticate,
 subscribe, and publish through the SAME broker facade (hooks, authz,
 retainer, TPU matcher) as MQTT clients, and register in a per-gateway
 `ConnectionManager`.  Implemented protocols: STOMP 1.2 over TCP
-(`stomp.py`), MQTT-SN 1.2 over UDP (`mqttsn.py`).  ExProto's
-gRPC-stream adapter is gated on grpcio availability (absent in this
-image), matching the exhook transport gating.
+(`stomp.py`), MQTT-SN 1.2 over UDP (`mqttsn.py`), CoAP over UDP
+(`coap.py`, RFC 7252 + pubsub draft), and LwM2M over CoAP (`lwm2m.py`).
 """
 
+from .coap import CoapGateway, CoapMessage
 from .core import GatewayContext, GatewayRegistry
+from .lwm2m import Lwm2mGateway
 from .mqttsn import MqttSnGateway
 from .stomp import StompFrame, StompGateway
 
 __all__ = [
+    "CoapGateway",
+    "CoapMessage",
+    "Lwm2mGateway",
     "GatewayContext",
     "GatewayRegistry",
     "MqttSnGateway",

@@ -1,0 +1,434 @@
+"""CoAP gateway over UDP — `apps/emqx_gateway/src/coap` analog.
+
+RFC 7252 message codec (4-byte header, token, delta-encoded options,
+0xFF payload marker) plus the two handlers the reference exposes
+(`emqx_coap_pubsub_handler.erl`, `emqx_coap_mqtt_handler.erl`):
+
+- **PubSub handler** (`ps/{+topic}` URI space, per
+  draft-ietf-core-coap-pubsub): POST publishes (2.04 Changed), GET with
+  Observe=0 subscribes (2.05 Content + observe notifications), GET with
+  Observe=1 unsubscribes (2.07 Deleted analog -> 2.05).
+- **MQTT/connection handler** (`mqtt/connection` URI): POST opens an
+  authenticated "connection" and returns a session token; DELETE closes
+  it.  When `connection_required` is on, every ps/ request must carry
+  matching `clientid` + `token` uri-queries or is rejected 4.01
+  (`emqx_coap_channel.erl:349-368` check_token semantics).
+
+Query-string options mirror the reference's Shared Options: clientid,
+username, password, qos, retain, token.  Observe notifications carry an
+incrementing Observe sequence per subscription.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..broker.access_control import ClientInfo
+from ..broker.broker import Broker
+from .core import GatewayContext
+
+log = logging.getLogger("emqx_tpu.gateway.coap")
+
+VERSION = 1
+
+# message types
+CON, NON, ACK, RST = 0, 1, 2, 3
+
+# method / response codes: (class, detail) packed as class*32+detail
+GET, POST, PUT, DELETE = 1, 2, 3, 4
+CREATED = 0x41    # 2.01
+DELETED = 0x42    # 2.02
+VALID = 0x43      # 2.03
+CHANGED = 0x44    # 2.04
+CONTENT = 0x45    # 2.05
+BAD_REQUEST = 0x80      # 4.00
+UNAUTHORIZED = 0x81     # 4.01
+FORBIDDEN = 0x83        # 4.03
+NOT_FOUND = 0x84        # 4.04
+NOT_ALLOWED = 0x85      # 4.05
+INTERNAL_ERROR = 0xA0   # 5.00
+
+# option numbers (emqx_coap_frame.erl:36-53)
+OPT_OBSERVE = 6
+OPT_URI_PATH = 11
+OPT_CONTENT_FORMAT = 12
+OPT_MAX_AGE = 14
+OPT_URI_QUERY = 15
+
+
+class CoapMessage:
+    def __init__(self, mtype: int = CON, code: int = GET, msg_id: int = 0,
+                 token: bytes = b"", options: Optional[List[Tuple[int, bytes]]] = None,
+                 payload: bytes = b""):
+        self.type = mtype
+        self.code = code
+        self.msg_id = msg_id
+        self.token = token
+        self.options = options or []
+        self.payload = payload
+
+    # ------------------------------------------------------------ helpers
+
+    def uri_path(self) -> List[str]:
+        return [v.decode("utf-8", "replace") for n, v in self.options if n == OPT_URI_PATH]
+
+    def uri_queries(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for n, v in self.options:
+            if n == OPT_URI_QUERY:
+                s = v.decode("utf-8", "replace")
+                k, _, val = s.partition("=")
+                out[k] = val
+        return out
+
+    def observe(self) -> Optional[int]:
+        for n, v in self.options:
+            if n == OPT_OBSERVE:
+                return int.from_bytes(v, "big") if v else 0
+        return None
+
+
+def _opt_ext(x: int) -> Tuple[int, bytes]:
+    """Option delta/length nibble + extended bytes per RFC 7252 §3.1."""
+    if x < 13:
+        return x, b""
+    if x < 269:
+        return 13, bytes([x - 13])
+    return 14, struct.pack("!H", x - 269)
+
+
+def serialize(msg: CoapMessage) -> bytes:
+    tkl = len(msg.token)
+    if tkl > 8:
+        raise ValueError("token too long")
+    out = bytearray()
+    out.append((VERSION << 6) | (msg.type << 4) | tkl)
+    out.append(msg.code)
+    out += struct.pack("!H", msg.msg_id)
+    out += msg.token
+    prev = 0
+    for num, val in sorted(msg.options, key=lambda o: o[0]):
+        dn, dext = _opt_ext(num - prev)
+        ln, lext = _opt_ext(len(val))
+        out.append((dn << 4) | ln)
+        out += dext + lext + val
+        prev = num
+    if msg.payload:
+        out.append(0xFF)
+        out += msg.payload
+    return bytes(out)
+
+
+def parse(data: bytes) -> CoapMessage:
+    if len(data) < 4:
+        raise ValueError("short datagram")
+    b0 = data[0]
+    if b0 >> 6 != VERSION:
+        raise ValueError("bad version")
+    mtype = (b0 >> 4) & 0x3
+    tkl = b0 & 0xF
+    if tkl > 8:
+        raise ValueError("bad TKL")
+    code = data[1]
+    (msg_id,) = struct.unpack_from("!H", data, 2)
+    pos = 4
+    token = data[pos:pos + tkl]
+    pos += tkl
+    options: List[Tuple[int, bytes]] = []
+    num = 0
+    while pos < len(data):
+        if data[pos] == 0xFF:
+            pos += 1
+            break
+        dn, ln = data[pos] >> 4, data[pos] & 0xF
+        pos += 1
+        if dn == 13:
+            dn = data[pos] + 13
+            pos += 1
+        elif dn == 14:
+            dn = struct.unpack_from("!H", data, pos)[0] + 269
+            pos += 2
+        elif dn == 15:
+            raise ValueError("reserved option delta")
+        if ln == 13:
+            ln = data[pos] + 13
+            pos += 1
+        elif ln == 14:
+            ln = struct.unpack_from("!H", data, pos)[0] + 269
+            pos += 2
+        elif ln == 15:
+            raise ValueError("reserved option length")
+        num += dn
+        options.append((num, data[pos:pos + ln]))
+        pos += ln
+    return CoapMessage(mtype, code, msg_id, token, options, data[pos:])
+
+
+class CoapClient:
+    """Per-peer state: broker session + observe registry + token."""
+
+    def __init__(self, addr, clientid: str):
+        self.addr = addr
+        self.clientid = clientid
+        self.session = None
+        self.clientinfo: Optional[ClientInfo] = None
+        self.connected = False
+        self.token: Optional[str] = None
+        self.heartbeat_at = time.monotonic()
+        # topic filter -> (observe token from subscribe request, seq counter)
+        self.observes: Dict[str, Tuple[bytes, int]] = {}
+        self.gateway: Optional["CoapGateway"] = None
+        self._next_msg_id = 1
+
+    def next_msg_id(self) -> int:
+        mid = self._next_msg_id
+        self._next_msg_id = mid % 0xFFFF + 1
+        return mid
+
+    # ChannelLike
+    def deliver(self, delivers) -> None:
+        if self.gateway is None:
+            return
+        for filt, msg in delivers:
+            self.gateway.deliver_publish(self, filt, msg)
+
+    def kick(self, rc: int = 0) -> None:
+        if self.gateway is not None:
+            self.gateway.drop_client(self)
+
+
+class CoapGateway(asyncio.DatagramProtocol):
+    def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
+                 connection_required: bool = False, heartbeat: float = 30.0):
+        self.ctx = GatewayContext(broker, "coap")
+        self.host = host
+        self.port = port
+        self.connection_required = connection_required
+        self.heartbeat = heartbeat
+        self.clients: Dict[tuple, CoapClient] = {}
+        self.transport: Optional[asyncio.DatagramTransport] = None
+        self._sweeper: Optional[asyncio.Task] = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, local_addr=(self.host, self.port)
+        )
+        self.port = self.transport.get_extra_info("sockname")[1]
+        self._sweeper = loop.create_task(self._sweep_loop())
+        log.info("coap gateway on %s:%s", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+            self._sweeper = None
+        for client in list(self.clients.values()):
+            if client.connected:
+                self.ctx.close_session(client)
+        self.clients.clear()
+        if self.transport is not None:
+            self.transport.close()
+            self.transport = None
+
+    async def _sweep_loop(self) -> None:
+        """Evict clients idle past the heartbeat window; without this,
+        connectionless peers (one per NATed source port) pile up forever."""
+        while True:
+            await asyncio.sleep(self.heartbeat / 2)
+            deadline = time.monotonic() - self.heartbeat * 1.5
+            for client in list(self.clients.values()):
+                if client.heartbeat_at < deadline:
+                    if client.connected:
+                        self.ctx.close_session(client)
+                        client.connected = False
+                    self.drop_client(client)
+
+    def send(self, addr, msg: CoapMessage) -> None:
+        if self.transport is not None:
+            self.transport.sendto(serialize(msg), addr)
+
+    def drop_client(self, client: CoapClient) -> None:
+        self.clients.pop(client.addr, None)
+
+    # ------------------------------------------------------------ inbound
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            msg = parse(data)
+        except ValueError:
+            return
+        try:
+            self._handle(addr, msg)
+        except Exception:
+            log.exception("coap handler failed")
+            self._reply(addr, msg, INTERNAL_ERROR)
+
+    def _reply(self, addr, req: CoapMessage, code: int, payload: bytes = b"",
+               options: Optional[List[Tuple[int, bytes]]] = None) -> None:
+        mtype = ACK if req.type == CON else NON
+        self.send(addr, CoapMessage(mtype, code, req.msg_id, req.token,
+                                    options or [], payload))
+
+    def _handle(self, addr, msg: CoapMessage) -> None:
+        if msg.code == 0:  # empty message: ping (CON) or ack/reset — heartbeat
+            client = self.clients.get(addr)
+            if client is not None:
+                client.heartbeat_at = time.monotonic()
+            if msg.type == CON:
+                self.send(addr, CoapMessage(RST, 0, msg.msg_id))
+            return
+        if msg.code not in (GET, POST, PUT, DELETE):
+            return  # response from peer (observe ack etc.)
+        path = msg.uri_path()
+        if len(path) >= 2 and path[0] == "mqtt" and path[1] == "connection":
+            self._handle_connection(addr, msg)
+        elif path and path[0] == "ps":
+            self._handle_pubsub(addr, msg, "/".join(path[1:]))
+        else:
+            self._reply(addr, msg, NOT_FOUND)
+
+    # -------------------------------------------------- mqtt/connection mode
+
+    def _handle_connection(self, addr, msg: CoapMessage) -> None:
+        queries = msg.uri_queries()
+        if msg.code == POST:
+            old = self.clients.pop(addr, None)
+            if old is not None and old.connected:
+                self.ctx.close_session(old)
+            clientid = queries.get("clientid") or f"coap-{addr[0]}-{addr[1]}"
+            ci = ClientInfo(
+                clientid=clientid, username=queries.get("username"),
+                password=queries.get("password"), peerhost=addr[0],
+                protocol="coap",
+            )
+            if not self.ctx.authenticate(ci):
+                self._reply(addr, msg, UNAUTHORIZED)
+                return
+            client = CoapClient(addr, clientid)
+            client.gateway = self
+            client.clientinfo = ci
+            client.token = secrets.token_hex(8)
+            self.ctx.open_session(True, ci, client)
+            client.connected = True
+            self.clients[addr] = client
+            self._reply(addr, msg, CREATED, payload=client.token.encode())
+        elif msg.code == DELETE:
+            client = self.clients.pop(addr, None)
+            if client is not None and client.connected:
+                self.ctx.close_session(client)
+            self._reply(addr, msg, DELETED)
+        else:
+            self._reply(addr, msg, NOT_ALLOWED)
+
+    def _check_token(self, client: Optional[CoapClient],
+                     queries: Dict[str, str]) -> bool:
+        """`emqx_coap_channel.erl:349-368`: in connection mode the request
+        must name the connected clientid with its session token."""
+        if not self.connection_required:
+            return True
+        if client is None or not client.connected:
+            return False
+        return (queries.get("clientid") == client.clientid
+                and queries.get("token") == client.token)
+
+    # ------------------------------------------------------- pubsub handler
+
+    def _ensure_client(self, addr, queries: Dict[str, str]) -> Optional[CoapClient]:
+        """Connectionless mode: autoconnect on first ps/ request, keyed by
+        peer address (the reference generates a guid clientid)."""
+        client = self.clients.get(addr)
+        if client is not None:
+            return client
+        clientid = queries.get("clientid") or f"coap-{addr[0]}-{addr[1]}"
+        ci = ClientInfo(
+            clientid=clientid, username=queries.get("username"),
+            password=queries.get("password"), peerhost=addr[0], protocol="coap",
+        )
+        if not self.ctx.authenticate(ci):
+            return None
+        client = CoapClient(addr, clientid)
+        client.gateway = self
+        client.clientinfo = ci
+        self.ctx.open_session(True, ci, client)
+        client.connected = True
+        self.clients[addr] = client
+        return client
+
+    def _handle_pubsub(self, addr, msg: CoapMessage, topic: str) -> None:
+        queries = msg.uri_queries()
+        if not topic:
+            self._reply(addr, msg, BAD_REQUEST)
+            return
+        existing = self.clients.get(addr)
+        if self.connection_required:
+            if not self._check_token(existing, queries):
+                self._reply(addr, msg, UNAUTHORIZED)
+                return
+            client: Optional[CoapClient] = existing
+        else:
+            client = self._ensure_client(addr, queries)
+        if client is None:
+            self._reply(addr, msg, UNAUTHORIZED)
+            return
+        client.heartbeat_at = time.monotonic()
+
+        if msg.code == POST or msg.code == PUT:  # publish
+            if not self.ctx.authorize(client.clientinfo, "publish", topic):
+                self._reply(addr, msg, FORBIDDEN)
+                return
+            qos = int(queries.get("qos", "0") or 0)
+            retain = queries.get("retain", "false").lower() in ("1", "true")
+            self.ctx.publish(client.clientinfo, topic, msg.payload,
+                             qos=min(qos, 2), retain=retain)
+            self._reply(addr, msg, CHANGED)
+        elif msg.code == GET:
+            obs = msg.observe()
+            if obs == 0:  # subscribe
+                filt = topic
+                if not self.ctx.authorize(client.clientinfo, "subscribe", filt):
+                    self._reply(addr, msg, FORBIDDEN)
+                    return
+                qos = int(queries.get("qos", "0") or 0)
+                self.ctx.subscribe(client, filt, qos=min(qos, 2))
+                client.observes[filt] = (msg.token, 0)
+                self._reply(addr, msg, CONTENT,
+                            options=[(OPT_OBSERVE, b"\x00")])
+            elif obs == 1:  # unsubscribe
+                client.observes.pop(topic, None)
+                self.ctx.unsubscribe(client, topic)
+                self._reply(addr, msg, CONTENT)
+            else:
+                self._reply(addr, msg, BAD_REQUEST)
+        else:
+            self._reply(addr, msg, NOT_ALLOWED)
+
+    # ------------------------------------------------------------ outbound
+
+    def deliver_publish(self, client: CoapClient, filt: str, msg) -> None:
+        """Observe notification: NON 2.05 with the subscription's token and
+        an incrementing Observe sequence (RFC 7641)."""
+        entry = client.observes.get(filt)
+        if entry is None:
+            # subscription made via another filter form; best-effort match
+            if client.observes:
+                filt, entry = next(iter(client.observes.items()))
+            else:
+                return
+        token, seq = entry
+        seq = (seq + 1) % (1 << 24)
+        client.observes[filt] = (token, seq)
+        out = CoapMessage(
+            NON, CONTENT, client.next_msg_id(), token,
+            options=[(OPT_OBSERVE, seq.to_bytes(3, "big").lstrip(b"\x00") or b"\x00"),
+                     (OPT_URI_PATH, b"ps")] +
+                    [(OPT_URI_PATH, seg.encode()) for seg in msg.topic.split("/")],
+            payload=msg.payload,
+        )
+        self.send(client.addr, out)

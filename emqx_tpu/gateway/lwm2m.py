@@ -1,0 +1,512 @@
+"""LwM2M gateway over CoAP/UDP — `apps/emqx_gateway/src/lwm2m` analog.
+
+Implements the LwM2M 1.0 client-registration interface and the
+MQTT<->LwM2M command mapping of the reference
+(`emqx_lwm2m_channel.erl`, `emqx_lwm2m_session.erl`, `emqx_lwm2m_cmd.erl`):
+
+- **Register**: `POST /rd?ep={endpoint}&lt={lifetime}&lwm2m={ver}&b={binding}`
+  with a CoRE link-format payload of object instances.  Replies 2.01
+  Created + `Location-Path: rd/{loc}`.  Update `POST /rd/{loc}` -> 2.04;
+  deregister `DELETE /rd/{loc}` -> 2.02.
+- **Uplink topics** (`emqx_lwm2m_session.erl:640-652`):
+  register/update events -> `lwm2m/{ep}/up/resp`; observe notifications
+  -> `lwm2m/{ep}/up/notify`.
+- **Downlink**: the gateway subscribes each endpoint to
+  `lwm2m/{ep}/dn/#`.  JSON commands `{reqID, msgType, data:{path,...}}`
+  with msgType read/write/execute/discover/observe/cancel-observe are
+  translated to CoAP requests to the device; device responses come back
+  on `up/resp` as `{reqID, msgType, data:{code, codeMsg, content}}`.
+- **TLV**: `application/vnd.oma.lwm2m+tlv` (ct=11542) payloads are
+  decoded with an OMA-TLV codec (`emqx_lwm2m_tlv.erl` analog) into
+  `{type, id, value}` entries; other content-formats pass through as
+  text.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import struct
+import time
+from typing import Dict, List, Optional, Tuple, Union
+
+from ..broker.access_control import ClientInfo
+from ..broker.broker import Broker
+from .coap import (
+    ACK, CON, NON, RST,
+    GET, POST, PUT, DELETE,
+    CREATED, CHANGED, CONTENT, DELETED, BAD_REQUEST, UNAUTHORIZED, NOT_FOUND,
+    OPT_OBSERVE, OPT_URI_PATH, OPT_URI_QUERY, OPT_CONTENT_FORMAT,
+    CoapMessage, parse, serialize,
+)
+from .core import GatewayContext
+
+log = logging.getLogger("emqx_tpu.gateway.lwm2m")
+
+OPT_LOCATION_PATH = 8
+OPT_ACCEPT = 17
+
+CT_LINK_FORMAT = 40
+CT_LWM2M_TLV = 11542
+CT_LWM2M_JSON = 11543
+
+# TLV identifier types (OMA-TS-LightweightM2M §6.3.3)
+TLV_OBJECT_INSTANCE = 0
+TLV_RESOURCE_INSTANCE = 1
+TLV_MULTI_RESOURCE = 2
+TLV_RESOURCE = 3
+
+_TLV_NAMES = {
+    TLV_OBJECT_INSTANCE: "obj_inst",
+    TLV_RESOURCE_INSTANCE: "res_inst",
+    TLV_MULTI_RESOURCE: "multi_res",
+    TLV_RESOURCE: "resource",
+}
+
+
+# ------------------------------------------------------------------ TLV codec
+
+TlvEntry = Dict[str, Union[int, str, bytes, list]]
+
+
+def tlv_decode(data: bytes) -> List[TlvEntry]:
+    """Decode OMA-TLV into [{type, id, value}] (nested for containers)."""
+    out: List[TlvEntry] = []
+    pos = 0
+    while pos < len(data):
+        b0 = data[pos]
+        pos += 1
+        ident_type = (b0 >> 6) & 0x3
+        ident_len = 2 if b0 & 0x20 else 1
+        len_type = (b0 >> 3) & 0x3
+        ident = int.from_bytes(data[pos:pos + ident_len], "big")
+        pos += ident_len
+        if len_type == 0:
+            length = b0 & 0x7
+        else:
+            length = int.from_bytes(data[pos:pos + len_type], "big")
+            pos += len_type
+        val = data[pos:pos + length]
+        if len(val) != length:
+            raise ValueError("truncated TLV")
+        pos += length
+        entry: TlvEntry = {"type": _TLV_NAMES[ident_type], "id": ident}
+        if ident_type in (TLV_OBJECT_INSTANCE, TLV_MULTI_RESOURCE):
+            entry["value"] = tlv_decode(val)
+        else:
+            entry["value"] = _tlv_value(val)
+        out.append(entry)
+    return out
+
+
+def _tlv_value(val: bytes) -> Union[int, str]:
+    """Leaf values: decode as UTF-8 when printable, else big-endian int."""
+    try:
+        s = val.decode("utf-8")
+        if s.isprintable():
+            return s
+    except UnicodeDecodeError:
+        pass
+    return int.from_bytes(val, "big") if val else 0
+
+
+def tlv_encode(entries: List[TlvEntry]) -> bytes:
+    out = bytearray()
+    names = {v: k for k, v in _TLV_NAMES.items()}
+    for e in entries:
+        ident_type = names[str(e["type"])]
+        ident = int(e["id"])  # type: ignore[arg-type]
+        v = e["value"]
+        if isinstance(v, list):
+            payload = tlv_encode(v)
+        elif isinstance(v, bytes):
+            payload = v
+        elif isinstance(v, int):
+            n = max(1, (v.bit_length() + 7) // 8)
+            payload = v.to_bytes(n, "big")
+        else:
+            payload = str(v).encode()
+        b0 = ident_type << 6
+        if ident > 0xFF:
+            b0 |= 0x20
+            ident_b = struct.pack("!H", ident)
+        else:
+            ident_b = bytes([ident])
+        n = len(payload)
+        if n < 8:
+            out += bytes([b0 | n]) + ident_b
+        elif n < 256:
+            out += bytes([b0 | 0x08]) + ident_b + bytes([n])
+        elif n < 65536:
+            out += bytes([b0 | 0x10]) + ident_b + struct.pack("!H", n)
+        else:
+            out += bytes([b0 | 0x18]) + ident_b + n.to_bytes(3, "big")
+        out += payload
+    return bytes(out)
+
+
+def code_str(code: int) -> Tuple[str, str]:
+    """CoAP response code -> ("2.05", "content") like emqx_lwm2m_cmd."""
+    cls, detail = code >> 5, code & 0x1F
+    names = {
+        0x41: "created", 0x42: "deleted", 0x43: "valid", 0x44: "changed",
+        0x45: "content", 0x80: "bad_request", 0x81: "unauthorized",
+        0x83: "forbidden", 0x84: "not_found", 0x85: "method_not_allowed",
+        0xA0: "internal_server_error",
+    }
+    return f"{cls}.{detail:02d}", names.get(code, "unknown")
+
+
+# ------------------------------------------------------------------ endpoint
+
+class Lwm2mEndpoint:
+    """One registered device: broker session + pending downlink commands."""
+
+    def __init__(self, addr, endpoint: str, location: str):
+        self.addr = addr
+        self.endpoint = endpoint
+        self.location = location
+        self.lifetime = 86400
+        self.version = "1.0"
+        self.binding = "U"
+        self.object_list: List[str] = []
+        self.registered_at = time.monotonic()
+        self.session = None
+        self.clientid = endpoint
+        self.clientinfo: Optional[ClientInfo] = None
+        self.connected = False
+        # coap token -> (reqID, msgType, observe-path or None)
+        self.pending: Dict[bytes, Tuple[object, str, Optional[str]]] = {}
+        # observe path -> token
+        self.observations: Dict[str, bytes] = {}
+        self.gateway: Optional["Lwm2mGateway"] = None
+        self._next_token = 1
+        self._next_msg_id = 1
+
+    def alive(self) -> bool:
+        return time.monotonic() - self.registered_at < self.lifetime
+
+    def new_token(self) -> bytes:
+        t = self._next_token
+        self._next_token = (t + 1) % 0xFFFFFF or 1
+        return t.to_bytes(3, "big")
+
+    def next_msg_id(self) -> int:
+        mid = self._next_msg_id
+        self._next_msg_id = mid % 0xFFFF + 1
+        return mid
+
+    # ChannelLike: downlink MQTT messages -> CoAP commands
+    def deliver(self, delivers) -> None:
+        if self.gateway is None:
+            return
+        for _filt, msg in delivers:
+            self.gateway.send_command(self, msg)
+
+    def kick(self, rc: int = 0) -> None:
+        if self.gateway is not None:
+            self.gateway.drop_endpoint(self)
+
+
+class Lwm2mGateway(asyncio.DatagramProtocol):
+    """UDP server on the LwM2M port (default 5683 in the reference conf)."""
+
+    def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
+                 mountpoint: str = "lwm2m", qos: int = 0):
+        self.ctx = GatewayContext(broker, "lwm2m")
+        self.host = host
+        self.port = port
+        self.mountpoint = mountpoint
+        self.qos = qos
+        self.by_addr: Dict[tuple, Lwm2mEndpoint] = {}
+        self.by_location: Dict[str, Lwm2mEndpoint] = {}
+        self.transport: Optional[asyncio.DatagramTransport] = None
+        self._next_loc = 1
+        self._sweeper: Optional[asyncio.Task] = None
+        self.sweep_interval = 30.0
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, local_addr=(self.host, self.port)
+        )
+        self.port = self.transport.get_extra_info("sockname")[1]
+        self._sweeper = loop.create_task(self._sweep_loop())
+        log.info("lwm2m gateway on %s:%s", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+            self._sweeper = None
+        for ep in list(self.by_addr.values()):
+            if ep.connected:
+                self.ctx.close_session(ep)
+        self.by_addr.clear()
+        self.by_location.clear()
+        if self.transport is not None:
+            self.transport.close()
+            self.transport = None
+
+    async def _sweep_loop(self) -> None:
+        """Expire registrations whose lifetime lapsed without an update."""
+        while True:
+            await asyncio.sleep(self.sweep_interval)
+            for ep in list(self.by_location.values()):
+                if not ep.alive():
+                    if ep.connected:
+                        self.ctx.close_session(ep)
+                        ep.connected = False
+                    self.drop_endpoint(ep)
+
+    def send(self, addr, msg: CoapMessage) -> None:
+        if self.transport is not None:
+            self.transport.sendto(serialize(msg), addr)
+
+    def drop_endpoint(self, ep: Lwm2mEndpoint) -> None:
+        self.by_addr.pop(ep.addr, None)
+        self.by_location.pop(ep.location, None)
+
+    # ------------------------------------------------------------- topics
+
+    def up_topic(self, ep: Lwm2mEndpoint, kind: str) -> str:
+        sub = "up/notify" if kind == "notify" else "up/resp"
+        return f"{self.mountpoint}/{ep.endpoint}/{sub}"
+
+    def dn_filter(self, ep: Lwm2mEndpoint) -> str:
+        return f"{self.mountpoint}/{ep.endpoint}/dn/#"
+
+    def publish_up(self, ep: Lwm2mEndpoint, kind: str, body: dict) -> None:
+        self.ctx.publish(ep.clientinfo, self.up_topic(ep, kind),
+                         json.dumps(body).encode(), qos=self.qos)
+
+    # ------------------------------------------------------------- inbound
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            msg = parse(data)
+        except ValueError:
+            return
+        try:
+            if msg.code in (GET, POST, PUT, DELETE):
+                self._handle_request(addr, msg)
+            elif msg.code == 0:
+                if msg.type == CON:
+                    self.send(addr, CoapMessage(RST, 0, msg.msg_id))
+            else:
+                self._handle_response(addr, msg)
+        except Exception:
+            log.exception("lwm2m handler failed")
+
+    def _reply(self, addr, req: CoapMessage, code: int,
+               options=None, payload: bytes = b"") -> None:
+        mtype = ACK if req.type == CON else NON
+        self.send(addr, CoapMessage(mtype, code, req.msg_id, req.token,
+                                    options or [], payload))
+
+    # -------------------------------------------------- registration iface
+
+    def _handle_request(self, addr, msg: CoapMessage) -> None:
+        path = msg.uri_path()
+        if not path or path[0] != "rd":
+            self._reply(addr, msg, NOT_FOUND)
+            return
+        if msg.code == POST and len(path) == 1:
+            self._register(addr, msg)
+        elif msg.code == POST and len(path) == 2:
+            self._update(addr, msg, path[1])
+        elif msg.code == DELETE and len(path) == 2:
+            self._deregister(addr, msg, path[1])
+        else:
+            self._reply(addr, msg, BAD_REQUEST)
+
+    def _register(self, addr, msg: CoapMessage) -> None:
+        q = msg.uri_queries()
+        endpoint = q.get("ep")
+        if not endpoint:
+            self._reply(addr, msg, BAD_REQUEST)
+            return
+        old = self.by_addr.get(addr)
+        if old is not None and old.connected:
+            self.ctx.close_session(old)
+            self.drop_endpoint(old)
+        loc = str(self._next_loc)
+        self._next_loc += 1
+        ep = Lwm2mEndpoint(addr, endpoint, loc)
+        ep.gateway = self
+        ep.lifetime = int(q.get("lt", "86400") or 86400)
+        ep.version = q.get("lwm2m", "1.0")
+        ep.binding = q.get("b", "U")
+        ep.object_list = [
+            s.strip().strip("<>;") for s in msg.payload.decode("utf-8", "replace").split(",")
+            if s.strip()
+        ]
+        ci = ClientInfo(clientid=endpoint, username=q.get("imei") or endpoint,
+                        peerhost=addr[0], protocol="lwm2m")
+        ep.clientinfo = ci
+        if not self.ctx.authenticate(ci):
+            self._reply(addr, msg, UNAUTHORIZED)
+            return
+        self.ctx.open_session(True, ci, ep)
+        ep.connected = True
+        self.by_addr[addr] = ep
+        self.by_location[loc] = ep
+        # subscribe the endpoint to its downlink command topic
+        self.ctx.subscribe(ep, self.dn_filter(ep), qos=self.qos)
+        self._reply(addr, msg, CREATED,
+                    options=[(OPT_LOCATION_PATH, b"rd"),
+                             (OPT_LOCATION_PATH, loc.encode())])
+        self.publish_up(ep, "register", {
+            "msgType": "register",
+            "data": {
+                "ep": ep.endpoint, "lt": ep.lifetime, "lwm2m": ep.version,
+                "b": ep.binding, "alternatePath": "/",
+                "objectList": ep.object_list,
+            },
+        })
+
+    def _update(self, addr, msg: CoapMessage, loc: str) -> None:
+        ep = self.by_location.get(loc)
+        if ep is None:
+            self._reply(addr, msg, NOT_FOUND)
+            return
+        q = msg.uri_queries()
+        if "lt" in q:
+            ep.lifetime = int(q["lt"] or ep.lifetime)
+        ep.registered_at = time.monotonic()
+        if ep.addr != addr:  # NAT rebind: retire the old address key
+            self.by_addr.pop(ep.addr, None)
+        ep.addr = addr
+        self.by_addr[addr] = ep
+        if msg.payload:
+            ep.object_list = [
+                s.strip().strip("<>;") for s in msg.payload.decode("utf-8", "replace").split(",")
+                if s.strip()
+            ]
+        self._reply(addr, msg, CHANGED)
+        self.publish_up(ep, "update", {
+            "msgType": "update",
+            "data": {"ep": ep.endpoint, "lt": ep.lifetime,
+                     "objectList": ep.object_list},
+        })
+
+    def _deregister(self, addr, msg: CoapMessage, loc: str) -> None:
+        ep = self.by_location.get(loc)
+        if ep is None:
+            self._reply(addr, msg, NOT_FOUND)
+            return
+        self._reply(addr, msg, DELETED)
+        if ep.connected:
+            self.ctx.close_session(ep)
+            ep.connected = False
+        self.drop_endpoint(ep)
+
+    # ----------------------------------------------- downlink MQTT -> CoAP
+
+    def send_command(self, ep: Lwm2mEndpoint, msg) -> None:
+        """Translate `lwm2m/{ep}/dn` JSON command to a CoAP request
+        (`emqx_lwm2m_cmd.erl` mqtt_to_coap semantics)."""
+        try:
+            cmd = json.loads(msg.payload.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            log.warning("lwm2m: bad downlink payload for %s", ep.endpoint)
+            return
+        msg_type = cmd.get("msgType", "")
+        data = cmd.get("data", {}) or {}
+        path = str(data.get("path", "")).strip()
+        if not path.startswith("/"):
+            path = "/" + path
+        req_id = cmd.get("reqID")
+        segs = [s for s in path.split("/") if s]
+        opts: List[Tuple[int, bytes]] = [(OPT_URI_PATH, s.encode()) for s in segs]
+        token = ep.new_token()
+        observe_path: Optional[str] = None
+
+        if msg_type == "read":
+            code = GET
+            payload = b""
+        elif msg_type == "discover":
+            code = GET
+            opts.append((OPT_ACCEPT, bytes([CT_LINK_FORMAT])))
+            payload = b""
+        elif msg_type == "write":
+            code = PUT
+            payload = str(data.get("value", "")).encode()
+            opts.append((OPT_CONTENT_FORMAT, b""))  # text/plain (0)
+        elif msg_type == "execute":
+            code = POST
+            payload = str(data.get("args", "")).encode()
+        elif msg_type == "observe":
+            code = GET
+            payload = b""
+            opts.append((OPT_OBSERVE, b""))  # register (0)
+            observe_path = path
+        elif msg_type == "cancel-observe":
+            code = GET
+            payload = b""
+            opts.append((OPT_OBSERVE, b"\x01"))
+            old = ep.observations.pop(path, None)
+            if old is not None:
+                ep.pending.pop(old, None)
+        else:
+            self.publish_up(ep, "resp", {
+                "reqID": req_id, "msgType": msg_type,
+                "data": {"code": "4.00", "codeMsg": "bad_request",
+                         "content": f"unknown msgType {msg_type!r}"},
+            })
+            return
+        ep.pending[token] = (req_id, msg_type, observe_path)
+        self.send(ep.addr, CoapMessage(CON, code, ep.next_msg_id(), token,
+                                       opts, payload))
+
+    # ----------------------------------------------- device CoAP responses
+
+    def _decode_content(self, msg: CoapMessage):
+        ct = 0
+        for n, v in msg.options:
+            if n == OPT_CONTENT_FORMAT:
+                ct = int.from_bytes(v, "big") if v else 0
+        if ct == CT_LWM2M_TLV:
+            try:
+                return tlv_decode(msg.payload)
+            except ValueError:
+                return msg.payload.hex()
+        if ct == CT_LINK_FORMAT:
+            return [s.strip() for s in msg.payload.decode("utf-8", "replace").split(",") if s]
+        try:
+            return msg.payload.decode("utf-8")
+        except UnicodeDecodeError:
+            return msg.payload.hex()
+
+    def _handle_response(self, addr, msg: CoapMessage) -> None:
+        ep = self.by_addr.get(addr)
+        if ep is None:
+            return
+        pend = ep.pending.get(msg.token)
+        if pend is None:
+            return
+        req_id, msg_type, observe_path = pend
+        is_notify = msg.observe() is not None and observe_path is not None
+        code, code_msg = code_str(msg.code)
+        body = {
+            "reqID": req_id, "msgType": msg_type,
+            "data": {"code": code, "codeMsg": code_msg,
+                     "content": self._decode_content(msg)},
+        }
+        if is_notify:
+            # first response = observe ack (up/resp); later ones = notify
+            if observe_path in ep.observations:
+                body["seqNum"] = msg.observe()
+                self.publish_up(ep, "notify", body)
+            else:
+                ep.observations[observe_path] = msg.token
+                self.publish_up(ep, "resp", body)
+            if msg.type == CON:
+                self.send(addr, CoapMessage(ACK, 0, msg.msg_id))
+            return
+        ep.pending.pop(msg.token, None)
+        self.publish_up(ep, "resp", body)
+        if msg.type == CON:
+            self.send(addr, CoapMessage(ACK, 0, msg.msg_id))

@@ -1,0 +1,264 @@
+"""CoAP gateway tests: RFC 7252 codec + pubsub/connection handlers."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.gateway import coap
+from emqx_tpu.gateway.coap import (
+    ACK, CON, NON, RST, GET, POST, DELETE,
+    CREATED, CHANGED, CONTENT, DELETED, UNAUTHORIZED, NOT_FOUND,
+    OPT_OBSERVE, OPT_URI_PATH, OPT_URI_QUERY,
+    CoapGateway, CoapMessage, parse, serialize,
+)
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+# --------------------------------------------------------------- codec
+
+def test_codec_roundtrip_options_and_payload():
+    msg = CoapMessage(
+        CON, POST, 0x1234, b"\xaa\xbb",
+        options=[(OPT_URI_PATH, b"ps"), (OPT_URI_PATH, b"sensors"),
+                 (OPT_URI_QUERY, b"clientid=c1"), (OPT_OBSERVE, b"\x00")],
+        payload=b"hello",
+    )
+    out = parse(serialize(msg))
+    assert out.type == CON and out.code == POST and out.msg_id == 0x1234
+    assert out.token == b"\xaa\xbb"
+    assert out.uri_path() == ["ps", "sensors"]
+    assert out.uri_queries() == {"clientid": "c1"}
+    assert out.observe() == 0
+    assert out.payload == b"hello"
+
+
+def test_codec_extended_option_delta_and_length():
+    # option number > 269 and a value > 13 bytes exercise extended nibbles
+    msg = CoapMessage(NON, GET, 7, b"", options=[(500, b"x" * 300)])
+    out = parse(serialize(msg))
+    assert out.options == [(500, b"x" * 300)]
+
+
+def test_codec_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse(b"")
+    with pytest.raises(ValueError):
+        parse(b"\xff\x01\x00\x00")  # bad version
+
+
+# --------------------------------------------------------------- client
+
+class CoapTestClient(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+        self._mid = 0
+
+    def datagram_received(self, data, addr):
+        self.inbox.put_nowait(parse(data))
+
+    async def start(self, port):
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, remote_addr=("127.0.0.1", port))
+        return self
+
+    def request(self, code, path, queries=(), token=b"", payload=b"",
+                observe=None, mtype=CON):
+        self._mid += 1
+        opts = [(OPT_URI_PATH, seg.encode()) for seg in path.split("/")]
+        opts += [(OPT_URI_QUERY, q.encode()) for q in queries]
+        if observe is not None:
+            opts.append((OPT_OBSERVE, bytes([observe]) if observe else b""))
+        self.transport.sendto(serialize(
+            CoapMessage(mtype, code, self._mid, token, opts, payload)))
+
+    async def recv(self):
+        return await asyncio.wait_for(self.inbox.get(), 5)
+
+    def close(self):
+        self.transport.close()
+
+
+# -------------------------------------------------------------- handlers
+
+def test_coap_publish_reaches_broker(run):
+    async def main():
+        b = Broker()
+        got = []
+        b.hooks.put("message.publish", lambda msg: got.append(msg) or msg)
+        gw = CoapGateway(b, port=0)
+        await gw.start()
+        c = await CoapTestClient().start(gw.port)
+        c.request(POST, "ps/sensors/1", payload=b"42")
+        rsp = await c.recv()
+        assert rsp.type == ACK and rsp.code == CHANGED
+        assert got and got[-1].topic == "sensors/1" and got[-1].payload == b"42"
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_coap_observe_subscribe_and_notify(run):
+    async def main():
+        b = Broker()
+        gw = CoapGateway(b, port=0)
+        await gw.start()
+        c = await CoapTestClient().start(gw.port)
+        c.request(GET, "ps/room/+", token=b"\x01\x02", observe=0)
+        rsp = await c.recv()
+        assert rsp.code == CONTENT
+
+        b.publish(Message(topic="room/7", payload=b"21c"))
+        note = await c.recv()
+        assert note.code == CONTENT and note.token == b"\x01\x02"
+        assert note.payload == b"21c"
+        assert note.uri_path() == ["ps", "room", "7"]
+        seq1 = note.observe()
+
+        b.publish(Message(topic="room/8", payload=b"22c"))
+        note2 = await c.recv()
+        assert note2.observe() > seq1  # RFC 7641 ordering
+
+        # observe=1 unsubscribes
+        c.request(GET, "ps/room/+", observe=1)
+        rsp = await c.recv()
+        assert rsp.code == CONTENT
+        b.publish(Message(topic="room/9", payload=b"x"))
+        await asyncio.sleep(0.05)
+        assert c.inbox.empty()
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_coap_connection_mode_token_enforced(run):
+    async def main():
+        b = Broker()
+        gw = CoapGateway(b, port=0, connection_required=True)
+        await gw.start()
+        c = await CoapTestClient().start(gw.port)
+
+        # ps/ request without a connection -> 4.01
+        c.request(POST, "ps/t", payload=b"x")
+        rsp = await c.recv()
+        assert rsp.code == UNAUTHORIZED
+
+        # open connection -> token in payload
+        c.request(POST, "mqtt/connection", queries=["clientid=dev9"])
+        rsp = await c.recv()
+        assert rsp.code == CREATED
+        token = rsp.payload.decode()
+
+        # wrong token still rejected
+        c.request(POST, "ps/t", queries=["clientid=dev9", "token=nope"], payload=b"x")
+        assert (await c.recv()).code == UNAUTHORIZED
+
+        # right clientid+token accepted
+        c.request(POST, "ps/t",
+                  queries=["clientid=dev9", f"token={token}"], payload=b"x")
+        assert (await c.recv()).code == CHANGED
+
+        # close connection
+        c.request(DELETE, "mqtt/connection")
+        assert (await c.recv()).code == DELETED
+        c.request(POST, "ps/t",
+                  queries=["clientid=dev9", f"token={token}"], payload=b"x")
+        assert (await c.recv()).code == UNAUTHORIZED
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_coap_ping_and_unknown_path(run):
+    async def main():
+        b = Broker()
+        gw = CoapGateway(b, port=0)
+        await gw.start()
+        c = await CoapTestClient().start(gw.port)
+        # empty CON -> RST (CoAP ping)
+        c.transport.sendto(serialize(CoapMessage(CON, 0, 99)))
+        rsp = await c.recv()
+        assert rsp.type == RST and rsp.msg_id == 99
+        # unknown path -> 4.04
+        c.request(GET, "nope/path")
+        assert (await c.recv()).code == NOT_FOUND
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_coap_interop_with_mqtt_side(run):
+    """CoAP publish must reach an MQTT-side broker subscriber and vice versa."""
+    async def main():
+        b = Broker()
+        gw = CoapGateway(b, port=0)
+        await gw.start()
+
+        # CoAP observer
+        c = await CoapTestClient().start(gw.port)
+        c.request(GET, "ps/bridge/down", token=b"\x07", observe=0)
+        assert (await c.recv()).code == CONTENT
+
+        # broker-side publish lands on the CoAP observer
+        b.publish(Message(topic="bridge/down", payload=b"cmd"))
+        note = await c.recv()
+        assert note.payload == b"cmd"
+
+        # CoAP publish lands on a broker-side subscriber
+        got = asyncio.Queue()
+
+        class Chan:
+            clientid = "mqtt-sub"
+            session = None
+
+            def deliver(self, delivers):
+                for f, m in delivers:
+                    got.put_nowait(m)
+
+        from emqx_tpu.broker.packet import SubOpts
+        b.subscribe("mqtt-sub", "bridge/up", SubOpts(qos=0))
+        b.cm.register_channel(Chan())
+        c.request(POST, "ps/bridge/up", payload=b"report")
+        assert (await c.recv()).code == CHANGED
+        m = await asyncio.wait_for(got.get(), 5)
+        assert m.topic == "bridge/up" and m.payload == b"report"
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_coap_reconnect_replaces_old_session(run):
+    """Re-POST /mqtt/connection from the same addr must close the old
+    session (and its routes) instead of leaking it."""
+    async def main():
+        b = Broker()
+        gw = CoapGateway(b, port=0)
+        await gw.start()
+        c = await CoapTestClient().start(gw.port)
+        c.request(POST, "mqtt/connection", queries=["clientid=A"])
+        assert (await c.recv()).code == CREATED
+        c.request(GET, "ps/old/t", observe=0)
+        assert (await c.recv()).code == CONTENT
+        assert b.route_count == 1  # A's route exists
+
+        c.request(POST, "mqtt/connection", queries=["clientid=B"])
+        assert (await c.recv()).code == CREATED
+        assert b.route_count == 0  # A's routes were cleaned up
+        assert gw.clients[c.transport.get_extra_info("sockname")].clientid == "B"
+        c.close()
+        await gw.stop()
+
+    run(main())

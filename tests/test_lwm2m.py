@@ -1,0 +1,266 @@
+"""LwM2M gateway tests: register/update/deregister, command round-trips, TLV."""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.gateway.coap import (
+    ACK, CON, GET, POST, PUT, DELETE,
+    CREATED, CHANGED, CONTENT, DELETED,
+    OPT_CONTENT_FORMAT, OPT_OBSERVE, OPT_URI_PATH, OPT_URI_QUERY,
+    CoapMessage, parse, serialize,
+)
+from emqx_tpu.gateway.lwm2m import (
+    CT_LWM2M_TLV, OPT_LOCATION_PATH,
+    Lwm2mGateway, tlv_decode, tlv_encode,
+)
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+# ----------------------------------------------------------------- TLV codec
+
+def test_tlv_roundtrip_nested():
+    entries = [
+        {"type": "obj_inst", "id": 0, "value": [
+            {"type": "resource", "id": 0, "value": "Open Mobile Alliance"},
+            {"type": "resource", "id": 1, "value": 1},
+            {"type": "multi_res", "id": 6, "value": [
+                {"type": "res_inst", "id": 0, "value": 1},
+                {"type": "res_inst", "id": 1, "value": 5},
+            ]},
+        ]},
+    ]
+    raw = tlv_encode(entries)
+    out = tlv_decode(raw)
+    assert out == entries
+
+
+def test_tlv_long_value_and_wide_id():
+    entries = [{"type": "resource", "id": 300, "value": "x" * 300}]
+    out = tlv_decode(tlv_encode(entries))
+    assert out == entries
+
+
+def test_tlv_truncated_raises():
+    with pytest.raises(ValueError):
+        tlv_decode(b"\xc8\x00\x10abc")  # claims 16 bytes, has 3
+
+
+# ----------------------------------------------------------- device fixture
+
+class FakeDevice(asyncio.DatagramProtocol):
+    """Plays the LwM2M client role over UDP."""
+
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+        self._mid = 0
+
+    def datagram_received(self, data, addr):
+        self.inbox.put_nowait(parse(data))
+
+    async def start(self, port):
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, remote_addr=("127.0.0.1", port))
+        return self
+
+    def send(self, msg):
+        self.transport.sendto(serialize(msg))
+
+    def request(self, code, path, queries=(), payload=b""):
+        self._mid += 1
+        opts = [(OPT_URI_PATH, s.encode()) for s in path.split("/") if s]
+        opts += [(OPT_URI_QUERY, q.encode()) for q in queries]
+        self.send(CoapMessage(CON, code, self._mid, b"", opts, payload))
+
+    async def recv(self):
+        return await asyncio.wait_for(self.inbox.get(), 5)
+
+    def close(self):
+        self.transport.close()
+
+
+class UpCollector:
+    """Broker-side subscriber for lwm2m/{ep}/up/# topics."""
+
+    def __init__(self, broker, ep="ep1"):
+        self.msgs = asyncio.Queue()
+        self.clientid = f"collector-{ep}"
+        self.session = None
+        broker.subscribe(self.clientid, f"lwm2m/{ep}/up/#", SubOpts(qos=0))
+        broker.cm.register_channel(self)
+
+    def deliver(self, delivers):
+        for f, m in delivers:
+            self.msgs.put_nowait((m.topic, json.loads(m.payload)))
+
+    async def recv(self):
+        return await asyncio.wait_for(self.msgs.get(), 5)
+
+
+async def register(gw, dev, ep="ep1", lt="300"):
+    dev.request(POST, "rd", queries=[f"ep={ep}", f"lt={lt}", "lwm2m=1.0", "b=U"],
+                payload=b"</1/0>,</3/0>,</3303/0>")
+    rsp = await dev.recv()
+    assert rsp.code == CREATED
+    loc = [v.decode() for n, v in rsp.options if n == OPT_LOCATION_PATH]
+    assert loc[0] == "rd"
+    return loc[1]
+
+
+# -------------------------------------------------------------------- tests
+
+def test_register_update_deregister(run):
+    async def main():
+        b = Broker()
+        gw = Lwm2mGateway(b, port=0)
+        await gw.start()
+        up = UpCollector(b)
+        dev = await FakeDevice().start(gw.port)
+
+        loc = await register(gw, dev)
+        topic, body = await up.recv()
+        assert topic == "lwm2m/ep1/up/resp"
+        assert body["msgType"] == "register"
+        assert body["data"]["ep"] == "ep1" and body["data"]["lt"] == 300
+        assert "/3303/0" in body["data"]["objectList"]
+
+        # update with new lifetime
+        dev.request(POST, f"rd/{loc}", queries=["lt=900"])
+        rsp = await dev.recv()
+        assert rsp.code == CHANGED
+        topic, body = await up.recv()
+        assert body["msgType"] == "update" and body["data"]["lt"] == 900
+
+        # deregister
+        dev.request(DELETE, f"rd/{loc}")
+        rsp = await dev.recv()
+        assert rsp.code == DELETED
+        assert gw.by_location.get(loc) is None
+        dev.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_read_command_roundtrip(run):
+    async def main():
+        b = Broker()
+        gw = Lwm2mGateway(b, port=0)
+        await gw.start()
+        up = UpCollector(b)
+        dev = await FakeDevice().start(gw.port)
+        await register(gw, dev)
+        await up.recv()  # drop register event
+
+        # MQTT side sends a READ command on the downlink topic
+        b.publish(Message(topic="lwm2m/ep1/dn", payload=json.dumps({
+            "reqID": "42", "msgType": "read", "data": {"path": "/3/0/0"},
+        }).encode()))
+
+        req = await dev.recv()
+        assert req.code == GET
+        assert req.uri_path() == ["3", "0", "0"]
+        # device answers 2.05 text
+        dev.send(CoapMessage(ACK, CONTENT, req.msg_id, req.token,
+                             [(OPT_CONTENT_FORMAT, b"")], b"EMQ-device"))
+
+        topic, body = await up.recv()
+        assert topic == "lwm2m/ep1/up/resp"
+        assert body["reqID"] == "42" and body["msgType"] == "read"
+        assert body["data"]["code"] == "2.05"
+        assert body["data"]["codeMsg"] == "content"
+        assert body["data"]["content"] == "EMQ-device"
+        dev.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_write_and_execute_commands(run):
+    async def main():
+        b = Broker()
+        gw = Lwm2mGateway(b, port=0)
+        await gw.start()
+        up = UpCollector(b)
+        dev = await FakeDevice().start(gw.port)
+        await register(gw, dev)
+        await up.recv()
+
+        b.publish(Message(topic="lwm2m/ep1/dn", payload=json.dumps({
+            "reqID": 1, "msgType": "write",
+            "data": {"path": "/3/0/14", "type": "String", "value": "+02:00"},
+        }).encode()))
+        req = await dev.recv()
+        assert req.code == PUT and req.payload == b"+02:00"
+        dev.send(CoapMessage(ACK, CHANGED, req.msg_id, req.token))
+        _, body = await up.recv()
+        assert body["data"]["code"] == "2.04"
+
+        b.publish(Message(topic="lwm2m/ep1/dn", payload=json.dumps({
+            "reqID": 2, "msgType": "execute",
+            "data": {"path": "/3/0/4", "args": "0"},
+        }).encode()))
+        req = await dev.recv()
+        assert req.code == POST and req.payload == b"0"
+        dev.send(CoapMessage(ACK, CHANGED, req.msg_id, req.token))
+        _, body = await up.recv()
+        assert body["reqID"] == 2 and body["data"]["codeMsg"] == "changed"
+        dev.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_observe_notify_flow_with_tlv(run):
+    async def main():
+        b = Broker()
+        gw = Lwm2mGateway(b, port=0)
+        await gw.start()
+        up = UpCollector(b)
+        dev = await FakeDevice().start(gw.port)
+        await register(gw, dev)
+        await up.recv()
+
+        b.publish(Message(topic="lwm2m/ep1/dn", payload=json.dumps({
+            "reqID": 7, "msgType": "observe", "data": {"path": "/3303/0/5700"},
+        }).encode()))
+        req = await dev.recv()
+        assert req.code == GET and req.observe() == 0
+
+        # observe ack (seq 1) -> up/resp
+        dev.send(CoapMessage(ACK, CONTENT, req.msg_id, req.token,
+                             [(OPT_OBSERVE, b"\x01"), (OPT_CONTENT_FORMAT, b"")],
+                             b"21.5"))
+        topic, body = await up.recv()
+        assert topic == "lwm2m/ep1/up/resp" and body["reqID"] == 7
+
+        # subsequent notify (seq 2, TLV content) -> up/notify
+        tlv = tlv_encode([{"type": "resource", "id": 5700, "value": "22.1"}])
+        dev.send(CoapMessage(
+            CON, CONTENT, 999, req.token,
+            [(OPT_OBSERVE, b"\x02"),
+             (OPT_CONTENT_FORMAT, CT_LWM2M_TLV.to_bytes(2, "big"))],
+            tlv))
+        topic, body = await up.recv()
+        assert topic == "lwm2m/ep1/up/notify"
+        assert body["seqNum"] == 2
+        assert body["data"]["content"] == [
+            {"type": "resource", "id": 5700, "value": "22.1"}]
+        # gateway acks the CON notify
+        ack = await dev.recv()
+        assert ack.type == ACK and ack.msg_id == 999
+        dev.close()
+        await gw.stop()
+
+    run(main())

@@ -124,3 +124,57 @@ def test_ws_ping_is_answered(run):
         await ws.stop()
 
     run(main())
+
+
+def test_ws_oversized_frame_drops_connection(run):
+    """A declared 8GB frame must be rejected before buffering (DoS guard)."""
+    async def main():
+        b = Broker()
+        ws = WsListener(b, port=0)
+        await ws.start()
+        reader, writer = await asyncio.open_connection("127.0.0.1", ws.port)
+        import base64, os, struct
+
+        key = base64.b64encode(os.urandom(16)).decode()
+        writer.write((
+            f"GET /mqtt HTTP/1.1\r\nHost: h\r\nUpgrade: websocket\r\n"
+            f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        await writer.drain()
+        while (await reader.readline()) not in (b"\r\n", b""):
+            pass
+        # header claiming an 8 GiB masked binary frame
+        writer.write(bytes([0x80 | wslib.OP_BINARY, 0x80 | 127])
+                     + struct.pack("!Q", 8 << 30) + b"\x00" * 4)
+        await writer.drain()
+        # server must drop us without waiting for the payload
+        got = await asyncio.wait_for(reader.read(), 5)
+        writer.close()
+        await ws.stop()
+
+    run(main())
+
+
+def test_ws_empty_binary_frame_is_not_eof(run):
+    """Zero-length binary messages are legal WS; must not kill the session."""
+    async def main():
+        from emqx_tpu.broker.message import Message
+
+        b = Broker()
+        ws = WsListener(b, port=0)
+        await ws.start()
+        streams = await ws_connect("127.0.0.1", ws.port)
+        c = MqttClient(clientid="ws-empty")
+        await c.connect(streams=streams)
+        # raw empty binary frame straight onto the socket
+        streams[1]._writer.write(wslib.encode_frame(wslib.OP_BINARY, b"", mask=True))
+        await streams[1].drain()
+        # session still alive: subscribe + roundtrip works afterwards
+        await c.subscribe("still/alive")
+        b.publish(Message(topic="still/alive", payload=b"yes"))
+        m = await asyncio.wait_for(c.recv(), 5)
+        assert m.payload == b"yes"
+        await c.disconnect()
+        await ws.stop()
+
+    run(main())
