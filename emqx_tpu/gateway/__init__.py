@@ -10,11 +10,15 @@ subscribe, and publish through the SAME broker facade (hooks, authz,
 retainer, TPU matcher) as MQTT clients, and register in a per-gateway
 `ConnectionManager`.  Implemented protocols: STOMP 1.2 over TCP
 (`stomp.py`), MQTT-SN 1.2 over UDP (`mqttsn.py`), CoAP over UDP
-(`coap.py`, RFC 7252 + pubsub draft), and LwM2M over CoAP (`lwm2m.py`).
+(`coap.py`, RFC 7252 + pubsub draft), LwM2M over CoAP (`lwm2m.py`), and
+ExProto (`exproto.py`) — custom protocols out of process over the same
+framed wire transport the exhook boundary uses (grpcio is absent in
+this image).
 """
 
 from .coap import CoapGateway, CoapMessage
 from .core import GatewayContext, GatewayRegistry
+from .exproto import ExProtoGateway
 from .lwm2m import Lwm2mGateway
 from .mqttsn import MqttSnGateway
 from .stomp import StompFrame, StompGateway
@@ -22,6 +26,7 @@ from .stomp import StompFrame, StompGateway
 __all__ = [
     "CoapGateway",
     "CoapMessage",
+    "ExProtoGateway",
     "Lwm2mGateway",
     "GatewayContext",
     "GatewayRegistry",

@@ -1,0 +1,368 @@
+"""ExProto gateway — `apps/emqx_gateway/src/exproto` analog.
+
+The reference lets users implement ANY custom TCP protocol out of
+process: the broker streams socket events to a user-supplied gRPC
+`ConnectionHandler` service and exposes a `ConnectionAdapter` service
+the handler calls back into (`exproto.proto:23-60`).
+
+grpcio is absent in this image, so both services ride the same framed
+transport the exhook boundary uses (`exhook/wire.py`: u32 length | JSON
+frames) over ONE duplex TCP stream:
+
+- gateway -> handler, stream events (ConnectionHandler):
+  `{"stream": "OnSocketCreated"|"OnSocketClosed"|"OnReceivedBytes"|
+    "OnTimerTimeout"|"OnReceivedMessages", "data": {...}}`
+- handler -> gateway, unary calls (ConnectionAdapter):
+  `{"id": n, "method": "send"|"close"|"authenticate"|"start_timer"|
+    "publish"|"subscribe"|"unsubscribe", "params": {...}}`
+  answered with `{"id": n, "code": ResultCode, "message": str}`.
+
+Raw socket bytes are base64 in the JSON frames.  ResultCodes mirror the
+proto enum: 0 SUCCESS, 1 UNKNOWN, 2 CONN_PROCESS_NOT_ALIVE,
+3 REQUIRED_PARAMS_MISSED, 5 PERMISSION_DENY.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import itertools
+import json
+import logging
+import time
+from typing import Dict, Optional
+
+from ..broker.access_control import ClientInfo
+from ..broker.broker import Broker
+from ..exhook.wire import MAX_FRAME, pack
+from .core import GatewayContext
+
+log = logging.getLogger("emqx_tpu.gateway.exproto")
+
+SUCCESS = 0
+UNKNOWN = 1
+CONN_PROCESS_NOT_ALIVE = 2
+REQUIRED_PARAMS_MISSED = 3
+PARAMS_TYPE_ERROR = 4
+PERMISSION_DENY = 5
+
+KEEPALIVE = "KEEPALIVE"
+
+
+async def read_frame(reader: asyncio.StreamReader) -> dict:
+    head = await reader.readexactly(4)
+    n = int.from_bytes(head, "big")
+    if not 0 < n <= MAX_FRAME:
+        raise ConnectionError(f"bad frame length {n}")
+    return json.loads(await reader.readexactly(n))
+
+
+class ExProtoConn:
+    """One raw device socket owned by the gateway (the reference's
+    per-connection emqx_exproto channel process)."""
+
+    def __init__(self, conn_id: str, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.conn_id = conn_id
+        self.reader = reader
+        self.writer = writer
+        self.session = None
+        self.clientid: Optional[str] = None
+        self.clientinfo: Optional[ClientInfo] = None
+        self.authenticated = False
+        self.keepalive: float = 0.0
+        self.last_rx = time.monotonic()
+        self.gateway: Optional["ExProtoGateway"] = None
+        self.closed = False
+
+    # ChannelLike: broker deliveries -> OnReceivedMessages stream event
+    def deliver(self, delivers) -> None:
+        if self.gateway is None:
+            return
+        msgs = [
+            {
+                "id": getattr(m, "msg_id", "") or "",
+                "qos": m.qos,
+                "from": m.from_client or "",
+                "topic": m.topic,
+                "payload": base64.b64encode(m.payload).decode(),
+                "timestamp": int(m.timestamp * 1000) if getattr(m, "timestamp", None) else 0,
+            }
+            for _f, m in delivers
+        ]
+        self.gateway.emit("OnReceivedMessages",
+                          {"conn": self.conn_id, "messages": msgs})
+
+    def kick(self, rc: int = 0) -> None:
+        if self.gateway is not None:
+            self.gateway.close_conn(self, reason="kicked")
+
+
+class ExProtoGateway:
+    """Two TCP servers: one for raw device sockets, one for the handler
+    service connection (the ConnectionHandler/Adapter duplex stream)."""
+
+    def __init__(self, broker: Broker, host: str = "127.0.0.1",
+                 port: int = 0, handler_port: int = 0):
+        self.ctx = GatewayContext(broker, "exproto")
+        self.host = host
+        self.port = port
+        self.handler_port = handler_port
+        self.conns: Dict[str, ExProtoConn] = {}
+        self._ids = itertools.count(1)
+        self._device_srv: Optional[asyncio.AbstractServer] = None
+        self._handler_srv: Optional[asyncio.AbstractServer] = None
+        self._handler_writer: Optional[asyncio.StreamWriter] = None
+        self._sweeper: Optional[asyncio.Task] = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self._device_srv = await asyncio.start_server(
+            self._on_device, self.host, self.port)
+        self.port = self._device_srv.sockets[0].getsockname()[1]
+        self._handler_srv = await asyncio.start_server(
+            self._on_handler, self.host, self.handler_port)
+        self.handler_port = self._handler_srv.sockets[0].getsockname()[1]
+        self._sweeper = asyncio.get_running_loop().create_task(self._sweep_loop())
+        log.info("exproto gateway: devices on :%s, handler on :%s",
+                 self.port, self.handler_port)
+
+    async def stop(self) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+            self._sweeper = None
+        for conn in list(self.conns.values()):
+            self.close_conn(conn, reason="gateway_stopped", notify=False)
+        if self._handler_writer is not None:
+            self._handler_writer.close()
+            self._handler_writer = None
+        for srv in (self._device_srv, self._handler_srv):
+            if srv is not None:
+                srv.close()
+                await srv.wait_closed()
+        self._device_srv = self._handler_srv = None
+
+    async def _sweep_loop(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            now = time.monotonic()
+            for conn in list(self.conns.values()):
+                if conn.keepalive and now - conn.last_rx > conn.keepalive * 1.5:
+                    self.emit("OnTimerTimeout",
+                              {"conn": conn.conn_id, "type": KEEPALIVE})
+                    self.close_conn(conn, reason="keepalive_timeout")
+
+    # ---------------------------------------------------- device side (raw)
+
+    async def _on_device(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        conn_id = f"exproto-{next(self._ids)}"
+        conn = ExProtoConn(conn_id, reader, writer)
+        conn.gateway = self
+        self.conns[conn_id] = conn
+        peer = writer.get_extra_info("peername") or ("?", 0)
+        self.emit("OnSocketCreated", {
+            "conn": conn_id,
+            "conninfo": {"peername": {"host": peer[0], "port": peer[1]},
+                         "socktype": "tcp"},
+        })
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    break
+                conn.last_rx = time.monotonic()
+                self.emit("OnReceivedBytes", {
+                    "conn": conn_id,
+                    "bytes": base64.b64encode(data).decode(),
+                })
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            self.close_conn(conn, reason="sock_closed")
+
+    def close_conn(self, conn: ExProtoConn, reason: str = "",
+                   notify: bool = True) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        self.conns.pop(conn.conn_id, None)
+        if conn.authenticated:
+            self.ctx.close_session(conn)
+        try:
+            conn.writer.close()
+        except Exception:
+            pass
+        if notify:
+            self.emit("OnSocketClosed", {"conn": conn.conn_id, "reason": reason})
+
+    # ------------------------------------------------- handler side (duplex)
+
+    def emit(self, stream: str, data: dict) -> None:
+        """ConnectionHandler stream event -> the connected handler."""
+        w = self._handler_writer
+        if w is None or w.is_closing():
+            return
+        try:
+            w.write(pack({"stream": stream, "data": data}))
+        except Exception:
+            log.exception("exproto: emit failed")
+
+    async def _on_handler(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        old = self._handler_writer
+        self._handler_writer = writer
+        if old is not None and not old.is_closing():
+            old.close()
+        try:
+            while True:
+                req = await read_frame(reader)
+                rsp = self._dispatch(req)
+                if rsp is not None:
+                    writer.write(pack(rsp))
+                    await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError, ValueError):
+            pass
+        finally:
+            if self._handler_writer is writer:
+                self._handler_writer = None
+            writer.close()
+
+    # ------------------------------------------- ConnectionAdapter methods
+
+    def _dispatch(self, req: dict) -> Optional[dict]:
+        rid = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params", {}) or {}
+        fn = getattr(self, f"_rpc_{method}", None)
+        if fn is None:
+            return {"id": rid, "code": PARAMS_TYPE_ERROR,
+                    "message": f"unknown method {method!r}"}
+        conn = None
+        if method != "noop":
+            conn = self.conns.get(params.get("conn", ""))
+            if conn is None:
+                return {"id": rid, "code": CONN_PROCESS_NOT_ALIVE,
+                        "message": "connection not alive"}
+        try:
+            code, message = fn(conn, params)
+        except KeyError as e:
+            code, message = REQUIRED_PARAMS_MISSED, f"missing param {e}"
+        except Exception as e:  # pragma: no cover
+            log.exception("exproto rpc %s failed", method)
+            code, message = UNKNOWN, str(e)
+        return {"id": rid, "code": code, "message": message}
+
+    def _rpc_send(self, conn: ExProtoConn, params: dict):
+        data = base64.b64decode(params["bytes"])
+        conn.writer.write(data)
+        return SUCCESS, ""
+
+    def _rpc_close(self, conn: ExProtoConn, params: dict):
+        self.close_conn(conn, reason="handler_closed")
+        return SUCCESS, ""
+
+    def _rpc_authenticate(self, conn: ExProtoConn, params: dict):
+        info = params["clientinfo"]
+        clientid = info.get("clientid", "")
+        if not clientid:
+            return REQUIRED_PARAMS_MISSED, "clientid required"
+        ci = ClientInfo(
+            clientid=clientid,
+            username=info.get("username") or None,
+            password=params.get("password") or None,
+            peerhost=(conn.writer.get_extra_info("peername") or ("?",))[0],
+            protocol=info.get("proto_name", "exproto"),
+        )
+        if not self.ctx.authenticate(ci):
+            return PERMISSION_DENY, "authentication failed"
+        conn.clientinfo = ci
+        self.ctx.open_session(True, ci, conn)
+        conn.authenticated = True
+        conn.keepalive = float(info.get("keepalive", 0) or 0)
+        return SUCCESS, ""
+
+    def _rpc_start_timer(self, conn: ExProtoConn, params: dict):
+        if params.get("type", KEEPALIVE) != KEEPALIVE:
+            return PARAMS_TYPE_ERROR, "unsupported timer type"
+        conn.keepalive = float(params["interval"])
+        conn.last_rx = time.monotonic()
+        return SUCCESS, ""
+
+    def _rpc_publish(self, conn: ExProtoConn, params: dict):
+        if not conn.authenticated:
+            return PERMISSION_DENY, "not authenticated"
+        topic = params["topic"]
+        if not self.ctx.authorize(conn.clientinfo, "publish", topic):
+            return PERMISSION_DENY, "publish denied"
+        self.ctx.publish(conn.clientinfo, topic,
+                         base64.b64decode(params.get("payload", "")),
+                         qos=int(params.get("qos", 0)))
+        return SUCCESS, ""
+
+    def _rpc_subscribe(self, conn: ExProtoConn, params: dict):
+        if not conn.authenticated:
+            return PERMISSION_DENY, "not authenticated"
+        topic = params["topic"]
+        if not self.ctx.authorize(conn.clientinfo, "subscribe", topic):
+            return PERMISSION_DENY, "subscribe denied"
+        self.ctx.subscribe(conn, topic, qos=int(params.get("qos", 0)))
+        return SUCCESS, ""
+
+    def _rpc_unsubscribe(self, conn: ExProtoConn, params: dict):
+        if not conn.authenticated:
+            return PERMISSION_DENY, "not authenticated"
+        self.ctx.unsubscribe(conn, params["topic"])
+        return SUCCESS, ""
+
+
+class HandlerClient:
+    """Async helper for writing ConnectionHandler services in Python
+    (test harness + reference implementation for users)."""
+
+    def __init__(self):
+        self.events: asyncio.Queue = asyncio.Queue()
+        self._responses: Dict[int, asyncio.Future] = {}
+        self._ids = itertools.count(1)
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def connect(self, host: str, port: int) -> "HandlerClient":
+        self.reader, self.writer = await asyncio.open_connection(host, port)
+        self._task = asyncio.get_running_loop().create_task(self._read_loop())
+        return self
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self.reader)
+                if "stream" in frame:
+                    self.events.put_nowait(frame)
+                else:
+                    fut = self._responses.pop(frame.get("id"), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(frame)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+
+    async def call(self, method: str, **params) -> dict:
+        rid = next(self._ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._responses[rid] = fut
+        self.writer.write(pack({"id": rid, "method": method, "params": params}))
+        await self.writer.drain()
+        return await asyncio.wait_for(fut, 5)
+
+    async def next_event(self, stream: Optional[str] = None, timeout: float = 5):
+        while True:
+            ev = await asyncio.wait_for(self.events.get(), timeout)
+            if stream is None or ev["stream"] == stream:
+                return ev
+
+    def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        if self.writer is not None:
+            self.writer.close()

@@ -1,0 +1,172 @@
+"""ExProto gateway tests: a tiny line-based custom protocol out of process."""
+
+import asyncio
+import base64
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.gateway.exproto import (
+    CONN_PROCESS_NOT_ALIVE, PERMISSION_DENY, SUCCESS,
+    ExProtoGateway, HandlerClient,
+)
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+def b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def test_exproto_full_lifecycle(run):
+    """Device socket -> handler events -> adapter calls -> broker pub/sub."""
+    async def main():
+        b = Broker()
+        gw = ExProtoGateway(b, port=0, handler_port=0)
+        await gw.start()
+        h = await HandlerClient().connect("127.0.0.1", gw.handler_port)
+
+        # raw device connects
+        dr, dw = await asyncio.open_connection("127.0.0.1", gw.port)
+        ev = await h.next_event("OnSocketCreated")
+        conn = ev["data"]["conn"]
+        assert ev["data"]["conninfo"]["socktype"] == "tcp"
+
+        # device sends its hello; handler authenticates it
+        dw.write(b"LOGIN dev7\n")
+        await dw.drain()
+        ev = await h.next_event("OnReceivedBytes")
+        assert base64.b64decode(ev["data"]["bytes"]) == b"LOGIN dev7\n"
+        rsp = await h.call("authenticate", conn=conn,
+                           clientinfo={"clientid": "dev7", "proto_name": "line"},
+                           password="")
+        assert rsp["code"] == SUCCESS
+
+        # handler subscribes the device and publishes on its behalf
+        assert (await h.call("subscribe", conn=conn, topic="dn/dev7", qos=1))["code"] == SUCCESS
+        assert (await h.call("publish", conn=conn, topic="up/dev7",
+                             qos=0, payload=b64(b"hello")))["code"] == SUCCESS
+
+        # broker-side subscriber sees the uplink
+        got = asyncio.Queue()
+
+        class Chan:
+            clientid = "mqtt-side"
+            session = None
+
+            def deliver(self, delivers):
+                for f, m in delivers:
+                    got.put_nowait(m)
+
+        b.subscribe("mqtt-side", "up/#", SubOpts(qos=0))
+        b.cm.register_channel(Chan())
+        assert (await h.call("publish", conn=conn, topic="up/dev7",
+                             qos=0, payload=b64(b"data2")))["code"] == SUCCESS
+        m = await asyncio.wait_for(got.get(), 5)
+        assert m.payload == b"data2" and m.from_client == "dev7"
+
+        # downlink: broker publish -> OnReceivedMessages -> handler sends bytes
+        b.publish(Message(topic="dn/dev7", payload=b"reboot", qos=1))
+        ev = await h.next_event("OnReceivedMessages")
+        msg = ev["data"]["messages"][0]
+        assert msg["topic"] == "dn/dev7"
+        assert base64.b64decode(msg["payload"]) == b"reboot"
+        assert (await h.call("send", conn=conn,
+                             bytes=b64(b"CMD reboot\n")))["code"] == SUCCESS
+        line = await asyncio.wait_for(dr.readline(), 5)
+        assert line == b"CMD reboot\n"
+
+        # handler closes the device socket
+        assert (await h.call("close", conn=conn))["code"] == SUCCESS
+        ev = await h.next_event("OnSocketClosed")
+        assert ev["data"]["conn"] == conn
+        assert await asyncio.wait_for(dr.read(), 5) == b""
+
+        # calls against a dead conn -> CONN_PROCESS_NOT_ALIVE
+        rsp = await h.call("send", conn=conn, bytes=b64(b"x"))
+        assert rsp["code"] == CONN_PROCESS_NOT_ALIVE
+
+        h.close()
+        dw.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_exproto_requires_authentication(run):
+    async def main():
+        b = Broker()
+        gw = ExProtoGateway(b, port=0, handler_port=0)
+        await gw.start()
+        h = await HandlerClient().connect("127.0.0.1", gw.handler_port)
+        dr, dw = await asyncio.open_connection("127.0.0.1", gw.port)
+        ev = await h.next_event("OnSocketCreated")
+        conn = ev["data"]["conn"]
+        # pub/sub before authenticate -> PERMISSION_DENY
+        assert (await h.call("publish", conn=conn, topic="t",
+                             payload=b64(b"x")))["code"] == PERMISSION_DENY
+        assert (await h.call("subscribe", conn=conn, topic="t"))["code"] == PERMISSION_DENY
+        h.close()
+        dw.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_exproto_socket_close_cleans_up(run):
+    async def main():
+        b = Broker()
+        gw = ExProtoGateway(b, port=0, handler_port=0)
+        await gw.start()
+        h = await HandlerClient().connect("127.0.0.1", gw.handler_port)
+        dr, dw = await asyncio.open_connection("127.0.0.1", gw.port)
+        ev = await h.next_event("OnSocketCreated")
+        conn = ev["data"]["conn"]
+        await h.call("authenticate", conn=conn,
+                     clientinfo={"clientid": "ephemeral"}, password="")
+        await h.call("subscribe", conn=conn, topic="x/y")
+        assert b.route_count == 1
+        # device drops the socket -> OnSocketClosed + session/routes cleaned
+        dw.close()
+        ev = await h.next_event("OnSocketClosed")
+        assert ev["data"]["conn"] == conn
+        for _ in range(50):
+            if b.route_count == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert b.route_count == 0
+        h.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_exproto_keepalive_timeout(run):
+    async def main():
+        b = Broker()
+        gw = ExProtoGateway(b, port=0, handler_port=0)
+        await gw.start()
+        gw_sweep_conns = gw.conns
+        h = await HandlerClient().connect("127.0.0.1", gw.handler_port)
+        dr, dw = await asyncio.open_connection("127.0.0.1", gw.port)
+        ev = await h.next_event("OnSocketCreated")
+        conn = ev["data"]["conn"]
+        # 0.2s keepalive, no traffic -> OnTimerTimeout then OnSocketClosed
+        assert (await h.call("start_timer", conn=conn, type="KEEPALIVE",
+                             interval=0.2))["code"] == SUCCESS
+        ev = await h.next_event("OnTimerTimeout", timeout=10)
+        assert ev["data"]["conn"] == conn and ev["data"]["type"] == "KEEPALIVE"
+        ev = await h.next_event("OnSocketClosed", timeout=10)
+        assert conn not in gw_sweep_conns
+        h.close()
+        dw.close()
+        await gw.stop()
+
+    run(main())
