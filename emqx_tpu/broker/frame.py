@@ -217,6 +217,8 @@ class Parser:
     def feed(self, data: bytes) -> List[pkt.Packet]:
         self._buf += data
         out: List[pkt.Packet] = []
+        if self._fast_scan(out):
+            return out
         while True:
             try:
                 parsed = self._try_parse_one()
@@ -226,6 +228,44 @@ class Parser:
             if parsed is None:
                 return out
             out.append(parsed)
+
+    def _fast_scan(self, out: List[pkt.Packet]) -> bool:
+        """C++ frame-boundary scan (native/matchhash.cc etpu_scan_frames);
+        returns False to fall back to the Python loop."""
+        from ..ops import native
+
+        while True:
+            if len(self._buf) < 2:
+                return True
+            scan = native.scan_frames(bytes(self._buf), self.max_size)
+            if scan is None:
+                return False  # no native lib
+            buf = bytes(self._buf[: scan.consumed])
+            del self._buf[: scan.consumed]
+            try:
+                for i in range(scan.count):
+                    off = scan.body_offs[i]
+                    out.append(self._parse_packet(
+                        int(scan.headers[i]),
+                        buf[off:off + scan.body_lens[i]],
+                    ))
+            except FrameError as e:
+                e.packets = out
+                raise
+            if scan.err == 1:
+                e = FrameError(MALFORMED, "remaining length varint too long")
+                e.packets = out
+                # drop the poisoned tail; the connection closes on this error
+                self._buf.clear()
+                raise e
+            if scan.err == 2:
+                e = FrameError(ReasonCode.PACKET_TOO_LARGE,
+                               f"packet > max {self.max_size}")
+                e.packets = out
+                self._buf.clear()
+                raise e
+            if scan.count == 0:
+                return True  # incomplete frame left buffered
 
     def _try_parse_one(self) -> Optional[pkt.Packet]:
         buf = self._buf

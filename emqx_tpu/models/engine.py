@@ -155,14 +155,13 @@ class TopicMatchEngine:
 
     def match(self, topics: Sequence[str]) -> List[Set[int]]:
         """Match a publish batch; returns the set of fids per topic."""
-        word_lists = [topiclib.words(t) for t in topics]
         out: List[Set[int]] = [set() for _ in topics]
 
         if self.tables.n_entries:
             dev = self.sync_device()
-            from ..ops.match import prepare_topic_batch
+            from ..ops.match import prepare_topics_raw
 
-            nb, _n = prepare_topic_batch(self.space, word_lists, self.min_batch)
+            nb, _n = prepare_topics_raw(self.space, topics, self.min_batch)
             import jax
 
             batch = TopicBatch(*(jax.device_put(a, self.device) for a in nb))

@@ -39,11 +39,21 @@ DEFAULT_MAX_LEVELS = 16
 
 _U32 = 0xFFFFFFFF
 _PERTURB = 0xD6E8FEB86659FD93  # avoid hash('') == 0
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
 
 
 def word_hash64(word: str) -> int:
-    """Stable-within-process 64-bit hash of one topic level."""
-    return (hash(word) ^ _PERTURB) & 0xFFFFFFFFFFFFFFFF
+    """Deterministic 64-bit hash of one topic level (FNV-1a ^ perturb).
+
+    Deterministic across processes — unlike Python's randomized `hash()` —
+    so cluster peers and checkpoint restores agree on table keys.  The
+    native batch path (native/matchhash.cc) computes the identical value.
+    """
+    h = _FNV_OFFSET
+    for byte in word.encode("utf-8"):
+        h = ((h ^ byte) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h ^ _PERTURB
 
 
 class HashSpace:
@@ -170,3 +180,23 @@ def hash_topic_batch(
             ta[i, l] = ((a ^ Ca[l]) * Ra[l]) & _U32
             tb[i, l] = ((b ^ Cb[l]) * Rb[l]) & _U32
     return ta, tb, ln, dl
+
+
+def hash_topics(
+    space: HashSpace, topics: List[str]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Prepare a publish batch straight from topic STRINGS.
+
+    Uses the C++ fast path (native/matchhash.cc etpu_prep_topics: split +
+    fnv1a64 + mix terms in one pass over the packed batch) when available,
+    else splits on '/' and runs the Python loop above.
+    """
+    from . import native
+
+    out = native.prep_topics(
+        topics, space.max_levels,
+        space.C[0], space.C[1], space.R[0], space.R[1],
+    )
+    if out is not None:
+        return out
+    return hash_topic_batch(space, [t.split("/") for t in topics])

@@ -144,11 +144,24 @@ def prepare_topic_batch(space, word_lists, min_batch: int = 64):
     from . import hashing
 
     ta, tb, ln, dl = hashing.hash_topic_batch(space, word_lists)
-    B = max(min_batch, next_pow2(len(word_lists)))
-    if B > len(word_lists):
-        pad = B - len(word_lists)
+    return _pad_batch(ta, tb, ln, dl, len(word_lists), min_batch)
+
+
+def prepare_topics_raw(space, topics, min_batch: int = 64):
+    """Like prepare_topic_batch but straight from topic strings, using the
+    C++ split+hash fast path when available."""
+    from . import hashing
+
+    ta, tb, ln, dl = hashing.hash_topics(space, list(topics))
+    return _pad_batch(ta, tb, ln, dl, len(topics), min_batch)
+
+
+def _pad_batch(ta, tb, ln, dl, n: int, min_batch: int):
+    B = max(min_batch, next_pow2(n))
+    if B > n:
+        pad = B - n
         ta = np.pad(ta, ((0, pad), (0, 0)))
         tb = np.pad(tb, ((0, pad), (0, 0)))
         ln = np.pad(ln, (0, pad), constant_values=-1)
         dl = np.pad(dl, (0, pad))
-    return TopicBatch(ta, tb, ln, dl), len(word_lists)
+    return TopicBatch(ta, tb, ln, dl), n

@@ -1,0 +1,174 @@
+"""ctypes loader for the native host hot paths (native/matchhash.cc).
+
+The reference keeps its data-plane hot loops in C NIFs (jiffy JSON,
+quicer QUIC, bcrypt — SURVEY.md §2.3); here the equivalents are the
+topic-batch hashing that feeds the TPU match kernel and the MQTT frame
+boundary scan.  The library is built on demand with g++ (no pip deps);
+every caller falls back to pure Python when it is unavailable, so the
+framework stays importable on machines without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("emqx_tpu.native")
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libemqxtpu.so")
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "matchhash.cc")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_lock = threading.Lock()
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _build() -> bool:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-Wall", "-fPIC", "-std=c++17", "-shared",
+             "-o", _LIB_PATH, src],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.info("native build unavailable: %s", e)
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.etpu_fnv1a64.restype = ctypes.c_uint64
+    lib.etpu_fnv1a64.argtypes = [_u8p, ctypes.c_uint64]
+    lib.etpu_prep_topics.restype = None
+    lib.etpu_prep_topics.argtypes = [
+        _u8p, _i64p, ctypes.c_int32, ctypes.c_int32,
+        _u32p, _u32p, _u32p, _u32p,
+        _u32p, _u32p, _i32p, _u8p,
+    ]
+    lib.etpu_scan_frames.restype = ctypes.c_int32
+    lib.etpu_scan_frames.argtypes = [
+        _u8p, ctypes.c_int64, ctypes.c_int64,
+        _u8p, _i64p, _i64p, ctypes.c_int32, _i64p, _i32p,
+    ]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if absent."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+            ):
+                _build()
+            if os.path.exists(_LIB_PATH):
+                _lib = _bind(ctypes.CDLL(_LIB_PATH))
+                log.info("native hot paths loaded (%s)", _LIB_PATH)
+        except OSError as e:
+            log.info("native load failed: %s", e)
+        _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# -------------------------------------------------------------- wrappers
+
+def fnv1a64(data: bytes) -> int:
+    lib = get_lib()
+    if lib is None:
+        h = 0xCBF29CE484222325
+        for byte in data:
+            h = ((h ^ byte) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+        return h
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else (ctypes.c_uint8 * 1)()
+    return lib.etpu_fnv1a64(buf, len(data))
+
+
+def prep_topics(
+    topics: List[str], max_levels: int,
+    Ca: np.ndarray, Cb: np.ndarray, Ra: np.ndarray, Rb: np.ndarray,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Native topic-batch prep: (terms_a, terms_b, lengths, dollar) or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(topics)
+    blobs = [t.encode("utf-8") for t in topics]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    for i, b in enumerate(blobs):
+        offsets[i + 1] = offsets[i] + len(b)
+    data = b"".join(blobs)
+    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(1, dtype=np.uint8)
+    buf = np.ascontiguousarray(buf)
+
+    ta = np.zeros((n, max_levels), dtype=np.uint32)
+    tb = np.zeros((n, max_levels), dtype=np.uint32)
+    ln = np.zeros(n, dtype=np.int32)
+    dl = np.zeros(n, dtype=np.uint8)
+    c = np.ascontiguousarray
+    lib.etpu_prep_topics(
+        buf.ctypes.data_as(_u8p), c(offsets).ctypes.data_as(_i64p),
+        n, max_levels,
+        c(Ca).ctypes.data_as(_u32p), c(Cb).ctypes.data_as(_u32p),
+        c(Ra).ctypes.data_as(_u32p), c(Rb).ctypes.data_as(_u32p),
+        ta.ctypes.data_as(_u32p), tb.ctypes.data_as(_u32p),
+        ln.ctypes.data_as(_i32p), dl.ctypes.data_as(_u8p),
+    )
+    return ta, tb, ln, dl.astype(bool)
+
+
+class FrameScan:
+    __slots__ = ("count", "headers", "body_offs", "body_lens", "consumed", "err")
+
+    def __init__(self, count, headers, body_offs, body_lens, consumed, err):
+        self.count = count
+        self.headers = headers
+        self.body_offs = body_offs
+        self.body_lens = body_lens
+        self.consumed = consumed
+        self.err = err  # 0 ok, 1 malformed varint, 2 oversize
+
+
+def scan_frames(buf: bytes, max_size: int, max_frames: int = 256) -> Optional[FrameScan]:
+    """Native MQTT frame-boundary scan; None when the lib is absent."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(buf)
+    arr = np.frombuffer(buf, dtype=np.uint8) if n else np.zeros(1, dtype=np.uint8)
+    arr = np.ascontiguousarray(arr)
+    headers = np.zeros(max_frames, dtype=np.uint8)
+    offs = np.zeros(max_frames, dtype=np.int64)
+    lens = np.zeros(max_frames, dtype=np.int64)
+    consumed = ctypes.c_int64(0)
+    err = ctypes.c_int32(0)
+    count = lib.etpu_scan_frames(
+        arr.ctypes.data_as(_u8p), n, max_size,
+        headers.ctypes.data_as(_u8p), offs.ctypes.data_as(_i64p),
+        lens.ctypes.data_as(_i64p), max_frames,
+        ctypes.byref(consumed), ctypes.byref(err),
+    )
+    return FrameScan(count, headers, offs, lens, consumed.value, err.value)

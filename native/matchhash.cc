@@ -1,0 +1,131 @@
+// Native host hot paths for emqx_tpu — the analog of the reference's C NIF
+// deps (jiffy/quicer/bcrypt pattern: Erlang control plane, C data plane;
+// rebar.config:46-73).  Compiled to a shared library and loaded via ctypes
+// (emqx_tpu/ops/native.py); every entry point has a pure-Python fallback.
+//
+// Contents:
+//   * fnv1a64            — deterministic word hash (shared with Python impl)
+//   * etpu_prep_topics   — split a packed batch of topic strings on '/',
+//                          hash each level, and emit the per-level mix terms
+//                          consumed by the TPU match kernel
+//                          (ops/hashing.py hash_topic_batch semantics)
+//   * etpu_scan_frames   — MQTT fixed-header scan: frame boundaries +
+//                          malformed/oversize detection (broker/frame.py
+//                          Parser.feed hot loop)
+//
+// Build: see native/Makefile (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+// ---------------------------------------------------------------- fnv1a64
+
+static const uint64_t FNV_OFFSET = 0xcbf29ce484222325ULL;
+static const uint64_t FNV_PRIME = 0x100000001b3ULL;
+// ops/hashing.py _PERTURB: keeps hash("") != 0
+static const uint64_t PERTURB = 0xD6E8FEB86659FD93ULL;
+
+static inline uint64_t fnv1a64(const uint8_t* s, uint64_t n) {
+    uint64_t h = FNV_OFFSET;
+    for (uint64_t i = 0; i < n; i++) {
+        h ^= (uint64_t)s[i];
+        h *= FNV_PRIME;
+    }
+    return h;
+}
+
+uint64_t etpu_fnv1a64(const uint8_t* s, uint64_t n) { return fnv1a64(s, n); }
+
+// ------------------------------------------------------------ prep_topics
+
+// Split each topic on '/', hash each level (fnv1a64 ^ PERTURB), and emit
+// mix terms  term[l] = ((lane ^ C[l]) * R[l]) mod 2^32  for both lanes.
+//
+//   data      packed UTF-8 topic bytes, topics concatenated
+//   offsets   [n_topics+1] byte offsets into data
+//   max_levels, Ca/Cb/Ra/Rb  the HashSpace constants ([max_levels] u32 each)
+//   ta, tb    out [n_topics * max_levels] u32, zero-filled by caller
+//   ln        out [n_topics] i32: level count (NOT capped; caller compares
+//             against shape lengths, deeper topics still match '#' shapes)
+//   dl        out [n_topics] u8: 1 if topic starts with '$'
+//
+// Topic-level semantics match broker/topic.py words(): splitting "a//b"
+// yields an empty middle level whose hash is fnv1a64("") ^ PERTURB.
+void etpu_prep_topics(const uint8_t* data, const int64_t* offsets,
+                      int32_t n_topics, int32_t max_levels,
+                      const uint32_t* Ca, const uint32_t* Cb,
+                      const uint32_t* Ra, const uint32_t* Rb,
+                      uint32_t* ta, uint32_t* tb, int32_t* ln, uint8_t* dl) {
+    for (int32_t i = 0; i < n_topics; i++) {
+        const uint8_t* t = data + offsets[i];
+        int64_t n = offsets[i + 1] - offsets[i];
+        dl[i] = (n > 0 && t[0] == '$') ? 1 : 0;
+        uint32_t* ra = ta + (int64_t)i * max_levels;
+        uint32_t* rb = tb + (int64_t)i * max_levels;
+        int32_t level = 0;
+        int64_t start = 0;
+        for (int64_t p = 0; p <= n; p++) {
+            if (p == n || t[p] == '/') {
+                if (level < max_levels) {
+                    uint64_t h = fnv1a64(t + start, (uint64_t)(p - start)) ^ PERTURB;
+                    uint32_t a = (uint32_t)h;
+                    uint32_t b = (uint32_t)(h >> 32);
+                    ra[level] = (a ^ Ca[level]) * Ra[level];
+                    rb[level] = (b ^ Cb[level]) * Rb[level];
+                }
+                level++;
+                start = p + 1;
+            }
+        }
+        // "" splits to one empty level, like Python "".split("/") == [""]
+        ln[i] = (n == 0) ? 1 : level;
+    }
+}
+
+// ------------------------------------------------------------ scan_frames
+
+// Scan an MQTT byte stream for complete frames.
+//
+// Returns the number of complete frames found (<= max_frames) and fills,
+// per frame: header byte, body offset, body length.  *consumed is the
+// number of bytes covered by complete frames; *err is 0 ok, 1 malformed
+// varint (>4 bytes), 2 frame exceeds max_size.
+// On error the frames found before the bad frame remain valid.
+int32_t etpu_scan_frames(const uint8_t* buf, int64_t n, int64_t max_size,
+                         uint8_t* headers, int64_t* body_offs,
+                         int64_t* body_lens, int32_t max_frames,
+                         int64_t* consumed, int32_t* err) {
+    int32_t count = 0;
+    int64_t pos = 0;
+    *err = 0;
+    while (pos < n && count < max_frames) {
+        // fixed header byte + up-to-4-byte varint remaining length
+        int64_t p = pos + 1;
+        int64_t rl = 0;
+        int shift = 0;
+        bool complete = false;
+        while (p < n) {
+            uint8_t b = buf[p++];
+            rl |= (int64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) { complete = true; break; }
+            shift += 7;
+            if (shift > 21) { *err = 1; *consumed = pos; return count; }
+        }
+        if (!complete) break;                     // need more bytes
+        if (1 + (p - pos - 1) + rl > max_size) {  // whole-packet cap
+            *err = 2; *consumed = pos; return count;
+        }
+        if (p + rl > n) break;                    // body incomplete
+        headers[count] = buf[pos];
+        body_offs[count] = p;
+        body_lens[count] = rl;
+        count++;
+        pos = p + rl;
+    }
+    *consumed = pos;
+    return count;
+}
+
+}  // extern "C"

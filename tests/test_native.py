@@ -1,0 +1,157 @@
+"""Native (C++) hot-path tests: bit-parity with the Python fallbacks."""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.broker import frame
+from emqx_tpu.broker import packet as pkt
+from emqx_tpu.ops import hashing, native
+
+
+def test_native_lib_builds_and_loads():
+    # g++ is part of this image's baked toolchain; the lib must build
+    assert native.available(), "native library failed to build/load"
+
+
+def test_fnv1a64_matches_python():
+    py = lambda data: hashing.word_hash64(data.decode()) ^ hashing._PERTURB
+    for s in [b"", b"a", b"sensors", b"\xe6\xb8\xa9\xe5\xba\xa6", b"x" * 1000]:
+        want = 0xCBF29CE484222325
+        for byte in s:
+            want = ((want ^ byte) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+        assert native.fnv1a64(s) == want
+
+
+def test_prep_topics_matches_python_batch():
+    space = hashing.HashSpace(max_levels=8)
+    topics = [
+        "a/b/c",
+        "sensors/3/temp",
+        "",               # one empty level
+        "a//c",           # empty middle level
+        "$SYS/brokers",   # dollar topic
+        "温度/房间/7",      # unicode
+        "deep/" * 12 + "end",  # deeper than max_levels
+        "x",
+    ]
+    got = native.prep_topics(
+        topics, space.max_levels, space.C[0], space.C[1], space.R[0], space.R[1])
+    assert got is not None
+    ta, tb, ln, dl = got
+    pta, ptb, pln, pdl = hashing.hash_topic_batch(
+        space, [t.split("/") for t in topics])
+    np.testing.assert_array_equal(ta, pta)
+    np.testing.assert_array_equal(tb, ptb)
+    np.testing.assert_array_equal(ln, pln)
+    np.testing.assert_array_equal(dl, pdl)
+
+
+def test_hash_topics_wrapper_agrees_with_filter_keys():
+    """End-to-end: a filter inserted via filter_key must hash-match the
+    native topic prep for a concrete matching topic."""
+    space = hashing.HashSpace(max_levels=8)
+    ha, hb, shape = space.filter_key(["room", "+", "temp"])
+    ta, tb, ln, dl = hashing.hash_topics(space, ["room/7/temp"])
+    ka, kb = space.shape_const(shape)
+    # sum non-plus level terms + shape const == stored key, both lanes
+    got_a = (int(ta[0, 0]) + int(ta[0, 2]) + ka) & 0xFFFFFFFF
+    got_b = (int(tb[0, 0]) + int(tb[0, 2]) + kb) & 0xFFFFFFFF
+    assert (got_a, got_b) == (ha, hb)
+
+
+def _varint(n):
+    out = b""
+    while True:
+        b = n % 128
+        n //= 128
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _mk_publish(topic=b"t", payload=b"p"):
+    # minimal MQTT 3.1.1 PUBLISH qos0
+    body = len(topic).to_bytes(2, "big") + topic + payload
+    return bytes([0x30]) + _varint(len(body)) + body
+
+
+def _pingreq():
+    return bytes([0xC0, 0x00])
+
+
+def test_scan_frames_boundaries():
+    stream = _mk_publish(b"a/b", b"x" * 10) + _pingreq() + _mk_publish(b"c", b"y")
+    scan = native.scan_frames(stream, max_size=1 << 20)
+    assert scan is not None and scan.err == 0
+    assert scan.count == 3
+    assert scan.consumed == len(stream)
+    assert [int(h) for h in scan.headers[:3]] == [0x30, 0xC0, 0x30]
+    # partial tail frame stays unconsumed
+    scan = native.scan_frames(stream + b"\x30\x40partial", max_size=1 << 20)
+    assert scan.count == 3 and scan.consumed == len(stream)
+
+
+def test_scan_frames_error_codes():
+    # 5-byte varint -> malformed
+    bad = bytes([0x30, 0x80, 0x80, 0x80, 0x80, 0x01])
+    scan = native.scan_frames(bad, max_size=1 << 20)
+    assert scan.err == 1 and scan.count == 0
+    # oversize frame
+    scan = native.scan_frames(_mk_publish(b"t", b"z" * 100), max_size=16)
+    assert scan.err == 2
+
+
+def test_parser_native_vs_python_identical(monkeypatch):
+    """The same byte stream must yield identical packets through the
+    native fast scan and the pure-Python loop."""
+    stream = b"".join([
+        _mk_publish(b"room/1", b"hello"),
+        _pingreq(),
+        _mk_publish(b"room/2", b"world" * 50),
+    ])
+
+    p_native = frame.Parser()
+    chunks = [stream[i:i + 7] for i in range(0, len(stream), 7)]
+    native_pkts = []
+    for ch in chunks:
+        native_pkts.extend(p_native.feed(ch))
+
+    monkeypatch.setattr(native, "scan_frames", lambda *a, **k: None)
+    p_py = frame.Parser()
+    py_pkts = []
+    for ch in chunks:
+        py_pkts.extend(p_py.feed(ch))
+
+    assert len(native_pkts) == len(py_pkts) == 3
+    for a, b in zip(native_pkts, py_pkts):
+        assert type(a) is type(b)
+        if isinstance(a, pkt.Publish):
+            assert (a.topic, a.payload, a.qos) == (b.topic, b.payload, b.qos)
+
+
+def test_parser_native_raises_same_errors(monkeypatch):
+    good_then_bad = _mk_publish(b"ok", b"1") + bytes([0x30, 0x80, 0x80, 0x80, 0x80, 0x01])
+    p = frame.Parser()
+    with pytest.raises(frame.FrameError) as ei:
+        p.feed(good_then_bad)
+    # the wire-valid packet before the error is preserved
+    assert len(ei.value.packets) == 1
+
+    p2 = frame.Parser(max_size=16)
+    with pytest.raises(frame.FrameError):
+        p2.feed(_mk_publish(b"t", b"z" * 100))
+
+
+def test_engine_match_uses_native_path():
+    from emqx_tpu.models.engine import TopicMatchEngine
+
+    eng = TopicMatchEngine()
+    eng.add_filter("room/+/temp")
+    eng.add_filter("room/#")
+    eng.add_filter("$SYS/#")
+    sets = eng.match(["room/7/temp", "room/7/hum", "$SYS/x", "other"])
+    f1, f2, f3 = (eng.fid_of(f) for f in ("room/+/temp", "room/#", "$SYS/#"))
+    assert sets[0] == {f1, f2}
+    assert sets[1] == {f2}
+    assert sets[2] == {f3}  # root wildcards never match $-topics
+    assert sets[3] == set()
