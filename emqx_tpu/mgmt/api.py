@@ -45,6 +45,9 @@ class ManagementApi:
         cluster=None,
         listeners: Optional[list] = None,
         sys_heartbeat=None,
+        plugins=None,
+        psk=None,
+        telemetry=None,
     ):
         self.broker = broker
         self.node = node
@@ -58,6 +61,9 @@ class ManagementApi:
         self.cluster = cluster
         self.listeners = listeners or []
         self.sys_heartbeat = sys_heartbeat
+        self.plugins = plugins
+        self.psk = psk
+        self.telemetry = telemetry
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -96,7 +102,97 @@ class ManagementApi:
         r("DELETE", "/trace/{name}", self.trace_stop, doc="Stop a trace")
         r("GET", "/trace/{name}/log", self.trace_log, doc="Download trace log")
         r("GET", "/slow_subscriptions", self.slow_get, doc="Slowest subscribers")
+        r("GET", "/plugins", self.plugins_get, doc="Installed plugins")
+        r("POST", "/plugins/{name_vsn}/install", self.plugin_install,
+          doc="Install a plugin package")
+        r("PUT", "/plugins/{name_vsn}/{action}", self.plugin_action,
+          doc="start|stop|enable|disable a plugin")
+        r("DELETE", "/plugins/{name_vsn}", self.plugin_uninstall,
+          doc="Uninstall a plugin")
+        r("GET", "/psk", self.psk_get, doc="TLS-PSK identities")
+        r("POST", "/psk", self.psk_post, doc="Add a PSK identity")
+        r("DELETE", "/psk/{psk_id}", self.psk_delete, doc="Remove a PSK identity")
+        r("GET", "/telemetry/status", self.telemetry_status, doc="Telemetry on/off")
+        r("PUT", "/telemetry/status", self.telemetry_set, doc="Toggle telemetry")
+        r("GET", "/telemetry/data", self.telemetry_data, doc="Telemetry report")
         r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+
+
+    # -------------------------------------------------------------- plugins
+
+    def _need(self, attr: str):
+        obj = getattr(self, attr)
+        if obj is None:
+            raise HttpError(404, f"{attr} subsystem not configured")
+        return obj
+
+    def plugins_get(self, req: Request):
+        return self._need("plugins").list()
+
+    def plugin_install(self, req: Request):
+        from ..plugins import PluginError
+
+        try:
+            st = self._need("plugins").ensure_installed(req.params["name_vsn"])
+        except PluginError as e:
+            raise HttpError(400, str(e))
+        return {"name_vsn": st.name_vsn, **st.manifest}
+
+    def plugin_action(self, req: Request):
+        from ..plugins import PluginError
+
+        pm = self._need("plugins")
+        nv = req.params["name_vsn"]
+        action = req.params["action"]
+        fn = {"start": pm.ensure_started, "stop": pm.ensure_stopped,
+              "enable": pm.ensure_enabled, "disable": pm.ensure_disabled}.get(action)
+        if fn is None:
+            raise HttpError(400, f"unknown action {action!r}")
+        try:
+            fn(nv)
+        except PluginError as e:
+            raise HttpError(400, str(e))
+        return 204, None
+
+    def plugin_uninstall(self, req: Request):
+        from ..plugins import PluginError
+
+        try:
+            self._need("plugins").ensure_uninstalled(req.params["name_vsn"])
+        except PluginError as e:
+            raise HttpError(400, str(e))
+        return 204, None
+
+    # ------------------------------------------------------------------ psk
+
+    def psk_get(self, req: Request):
+        return {"ids": self._need("psk").all_ids()}
+
+    def psk_post(self, req: Request):
+        body = req.json() or {}
+        psk_id, secret = body.get("psk_id"), body.get("secret")
+        if not psk_id or secret is None:
+            raise HttpError(400, "psk_id and secret required")
+        self._need("psk").insert(psk_id, secret.encode())
+        return 204, None
+
+    def psk_delete(self, req: Request):
+        if not self._need("psk").delete(req.params["psk_id"]):
+            raise HttpError(404, "unknown psk_id")
+        return 204, None
+
+    # ------------------------------------------------------------ telemetry
+
+    def telemetry_status(self, req: Request):
+        return {"enable": self._need("telemetry").enable}
+
+    def telemetry_set(self, req: Request):
+        body = req.json() or {}
+        self._need("telemetry").set_enabled(bool(body.get("enable", True)))
+        return 204, None
+
+    def telemetry_data(self, req: Request):
+        return self._need("telemetry").get_telemetry()
 
     def auth_check(self, token: str) -> bool:
         if self.tokens is None:

@@ -50,7 +50,7 @@ class Cli:
         self.usage: Dict[str, str] = {}
         for name in ("status", "broker", "clients", "subscriptions", "topics",
                      "publish", "ban", "listeners", "metrics", "stats",
-                     "trace", "cluster"):
+                     "trace", "cluster", "plugins", "telemetry"):
             self.register(name, getattr(self, "cmd_" + name),
                           getattr(getattr(self, "cmd_" + name), "__doc__", ""))
 
@@ -75,6 +75,11 @@ class Cli:
         if self.remote is not None:
             return self.remote.call("DELETE", "/api/v5" + path)
         return self._inproc("DELETE", path)
+
+    def _put(self, path: str, body=None):
+        if self.remote is not None:
+            return self.remote.call("PUT", "/api/v5" + path, body)
+        return self._inproc("PUT", path, body)
 
     def _inproc(self, method: str, path: str, body=None):
         import asyncio
@@ -228,6 +233,39 @@ class Cli:
         """Cluster node status."""
         for row in self._get("/nodes"):
             self.p(f"{row['node']} {row['node_status']}")
+
+
+    def cmd_plugins(self, args):
+        """plugins list | install|start|stop|enable|disable|uninstall <name-vsn>"""
+        sub = args[0] if args else "list"
+        if sub == "list":
+            for row in self._get("/plugins"):
+                state = "running" if row["running"] else (
+                    "enabled" if row["enabled"] else "installed")
+                self.p(f"{row['name_vsn']:<30} {state}")
+        elif sub == "install":
+            self._post(f"/plugins/{args[1]}/install")
+        elif sub == "uninstall":
+            self._delete(f"/plugins/{args[1]}")
+        elif sub in ("start", "stop", "enable", "disable"):
+            self._put(f"/plugins/{args[1]}/{sub}")
+        else:
+            self.p(self.usage["plugins"])
+            return 1
+
+    def cmd_telemetry(self, args):
+        """telemetry status | enable | disable | data"""
+        sub = args[0] if args else "status"
+        if sub == "status":
+            st = self._get("/telemetry/status")
+            self.p("enabled" if st["enable"] else "disabled")
+        elif sub in ("enable", "disable"):
+            self._put("/telemetry/status", {"enable": sub == "enable"})
+        elif sub == "data":
+            self.p(json.dumps(self._get("/telemetry/data"), indent=2))
+        else:
+            self.p(self.usage["telemetry"])
+            return 1
 
 
 def main(argv: Optional[List[str]] = None) -> int:

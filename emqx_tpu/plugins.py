@@ -1,0 +1,235 @@
+"""External plugin packages — `apps/emqx_plugins` analog.
+
+The reference installs `.tar.gz` packages (name-vsn dirs with a
+`release.json` manifest) into an install dir, keeps an *ordered* enabled
+list in config, and starts/stops the contained apps
+(`emqx_plugins.erl`: ensure_installed/uninstalled/enabled/disabled/
+started/stopped).
+
+Here a package is `<name>-<vsn>.tar.gz` containing::
+
+    <name>-<vsn>/release.json    {"name": ..., "rel_vsn": ..., ...}
+    <name>-<vsn>/<name>.py       module with on_load(ctx) / on_unload(ctx)
+
+`on_load` receives a `PluginContext` exposing the broker facade (hooks,
+publish, subscribe) — the same surface reference plugins get via the
+emqx application.  State transitions mirror the reference: a plugin must
+be installed to be enabled, and uninstall refuses while running.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import logging
+import os
+import tarfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+log = logging.getLogger("emqx_tpu.plugins")
+
+
+class PluginError(Exception):
+    pass
+
+
+@dataclass
+class PluginContext:
+    """What a plugin sees (`emqx.erl` facade subset)."""
+
+    broker: object
+    config: dict = field(default_factory=dict)
+
+    @property
+    def hooks(self):
+        return self.broker.hooks
+
+
+@dataclass
+class PluginState:
+    name_vsn: str
+    manifest: dict
+    enabled: bool = False
+    running: bool = False
+    module: Optional[object] = None
+
+
+def _split_name_vsn(name_vsn: str):
+    name, sep, vsn = name_vsn.rpartition("-")
+    if not sep or not name:
+        raise PluginError(f"bad name-vsn {name_vsn!r}")
+    return name, vsn
+
+
+class PluginManager:
+    def __init__(self, broker, install_dir: str):
+        self.broker = broker
+        self.install_dir = install_dir
+        os.makedirs(install_dir, exist_ok=True)
+        self._plugins: Dict[str, PluginState] = {}
+        # ordered enabled list, persisted like the reference's config entry
+        self._state_path = os.path.join(install_dir, "plugins_state.json")
+        self._enabled_order: List[str] = []
+        self._load_state()
+        self._scan_installed()
+
+    # ---------------------------------------------------------- persistence
+
+    def _load_state(self) -> None:
+        if os.path.exists(self._state_path):
+            with open(self._state_path, "r", encoding="utf-8") as f:
+                self._enabled_order = json.load(f).get("enabled", [])
+
+    def _save_state(self) -> None:
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"enabled": self._enabled_order}, f)
+        os.replace(tmp, self._state_path)
+
+    def _scan_installed(self) -> None:
+        for entry in sorted(os.listdir(self.install_dir)):
+            manifest = os.path.join(self.install_dir, entry, "release.json")
+            if os.path.isfile(manifest):
+                with open(manifest, "r", encoding="utf-8") as f:
+                    self._plugins[entry] = PluginState(entry, json.load(f))
+        for nv in self._enabled_order:
+            if nv in self._plugins:
+                self._plugins[nv].enabled = True
+
+    # --------------------------------------------------------------- install
+
+    def ensure_installed(self, name_vsn: str) -> PluginState:
+        """Extract `<name_vsn>.tar.gz` from install_dir (`emqx_plugins.erl`
+        do_ensure_installed)."""
+        if name_vsn in self._plugins:
+            return self._plugins[name_vsn]
+        tar_path = os.path.join(self.install_dir, name_vsn + ".tar.gz")
+        if not os.path.exists(tar_path):
+            raise PluginError(f"package not found: {tar_path}")
+        with tarfile.open(tar_path, "r:gz") as tf:
+            root = os.path.realpath(self.install_dir)
+            for m in tf.getmembers():  # refuse path escapes
+                dest = os.path.realpath(os.path.join(root, m.name))
+                if not dest.startswith(root + os.sep):
+                    raise PluginError(f"unsafe member path {m.name!r}")
+            tf.extractall(self.install_dir, filter="data")
+        manifest_path = os.path.join(self.install_dir, name_vsn, "release.json")
+        if not os.path.isfile(manifest_path):
+            raise PluginError(f"package {name_vsn} lacks release.json")
+        with open(manifest_path, "r", encoding="utf-8") as f:
+            st = PluginState(name_vsn, json.load(f))
+        self._plugins[name_vsn] = st
+        return st
+
+    def ensure_uninstalled(self, name_vsn: str) -> None:
+        st = self._plugins.get(name_vsn)
+        if st is None:
+            return
+        if st.running:
+            raise PluginError(f"{name_vsn} is running; stop it first")
+        if st.enabled:
+            raise PluginError(f"{name_vsn} is enabled; disable it first")
+        import shutil
+
+        shutil.rmtree(os.path.join(self.install_dir, name_vsn),
+                      ignore_errors=True)
+        del self._plugins[name_vsn]
+
+    # ---------------------------------------------------------- enable order
+
+    def ensure_enabled(self, name_vsn: str, position: str = "rear") -> None:
+        """position: 'front' | 'rear' | 'before:<name-vsn>'
+        (`emqx_plugins:ensure_enabled/2`)."""
+        if name_vsn not in self._plugins:
+            raise PluginError(f"{name_vsn} not installed")
+        if name_vsn in self._enabled_order:
+            self._enabled_order.remove(name_vsn)
+        if position == "front":
+            self._enabled_order.insert(0, name_vsn)
+        elif position == "rear":
+            self._enabled_order.append(name_vsn)
+        elif position.startswith("before:"):
+            anchor = position.split(":", 1)[1]
+            if anchor not in self._enabled_order:
+                raise PluginError(f"anchor {anchor} not enabled")
+            self._enabled_order.insert(self._enabled_order.index(anchor), name_vsn)
+        else:
+            raise PluginError(f"bad position {position!r}")
+        self._plugins[name_vsn].enabled = True
+        self._save_state()
+
+    def ensure_disabled(self, name_vsn: str) -> None:
+        if name_vsn in self._enabled_order:
+            self._enabled_order.remove(name_vsn)
+            self._save_state()
+        if name_vsn in self._plugins:
+            self._plugins[name_vsn].enabled = False
+
+    # --------------------------------------------------------------- running
+
+    def _load_module(self, st: PluginState):
+        name, _vsn = _split_name_vsn(st.name_vsn)
+        path = os.path.join(self.install_dir, st.name_vsn, f"{name}.py")
+        if not os.path.isfile(path):
+            raise PluginError(f"{st.name_vsn}: entry module {name}.py missing")
+        spec = importlib.util.spec_from_file_location(
+            f"emqx_tpu_plugin_{st.name_vsn.replace('-', '_').replace('.', '_')}",
+            path,
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def ensure_started(self, name_vsn: Optional[str] = None) -> None:
+        """Start one plugin, or every enabled plugin in configured order."""
+        targets = [name_vsn] if name_vsn else list(self._enabled_order)
+        for nv in targets:
+            st = self._plugins.get(nv)
+            if st is None:
+                raise PluginError(f"{nv} not installed")
+            if st.running:
+                continue
+            st.module = self._load_module(st)
+            ctx = PluginContext(broker=self.broker,
+                                config=st.manifest.get("config", {}))
+            on_load = getattr(st.module, "on_load", None)
+            if on_load is not None:
+                on_load(ctx)
+            st.running = True
+            log.info("plugin started: %s", nv)
+
+    def ensure_stopped(self, name_vsn: Optional[str] = None) -> None:
+        targets = [name_vsn] if name_vsn else [
+            nv for nv in reversed(self._enabled_order)
+        ]
+        for nv in targets:
+            st = self._plugins.get(nv)
+            if st is None or not st.running:
+                continue
+            on_unload = getattr(st.module, "on_unload", None)
+            if on_unload is not None:
+                try:
+                    on_unload(PluginContext(broker=self.broker))
+                except Exception:
+                    log.exception("plugin %s on_unload failed", nv)
+            st.running = False
+            st.module = None
+            log.info("plugin stopped: %s", nv)
+
+    # ------------------------------------------------------------ inspection
+
+    def list(self) -> List[dict]:
+        out = []
+        for nv, st in sorted(self._plugins.items()):
+            out.append({
+                "name_vsn": nv,
+                "enabled": st.enabled,
+                "running": st.running,
+                **{k: st.manifest[k] for k in ("name", "rel_vsn", "description")
+                   if k in st.manifest},
+            })
+        return out
+
+    def get(self, name_vsn: str) -> Optional[PluginState]:
+        return self._plugins.get(name_vsn)

@@ -1,0 +1,113 @@
+"""TLS-PSK identity store — `apps/emqx_psk` analog.
+
+The reference keeps `psk_id -> shared_secret` entries in an mnesia table
+(`emqx_psk.erl` #psk_entry record), bootstraps them from an init file of
+`psk_id:secret` lines, and answers `on_psk_lookup` during the TLS
+handshake (`emqx_tls_psk.erl`).  Here the store is the same shape:
+in-memory dict + optional JSON snapshot persistence, file import with
+the same line format, and a lookup callback shaped for
+`ssl.SSLContext.set_psk_server_callback` (available from CPython 3.13;
+on older runtimes the store still serves gateway/authn lookups).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+from typing import Dict, Optional
+
+log = logging.getLogger("emqx_tpu.psk")
+
+SEPARATOR = ":"
+
+
+class PskStore:
+    def __init__(self, init_file: Optional[str] = None,
+                 persist_path: Optional[str] = None, enable: bool = True):
+        self.enable = enable
+        self._entries: Dict[str, bytes] = {}
+        self._persist_path = persist_path
+        if persist_path and os.path.exists(persist_path):
+            with open(persist_path, "r", encoding="utf-8") as f:
+                self._entries = {
+                    k: bytes.fromhex(v) for k, v in json.load(f).items()
+                }
+        if init_file:
+            self.import_file(init_file)
+
+    # ------------------------------------------------------------- access
+
+    def lookup(self, psk_id: str) -> Optional[bytes]:
+        """`on_psk_lookup` (`emqx_psk.erl`): None = unknown identity."""
+        if not self.enable:
+            return None
+        return self._entries.get(psk_id)
+
+    def insert(self, psk_id: str, secret: bytes) -> None:
+        self._entries[psk_id] = secret
+        self._save()
+
+    def delete(self, psk_id: str) -> bool:
+        existed = self._entries.pop(psk_id, None) is not None
+        if existed:
+            self._save()
+        return existed
+
+    def all_ids(self):
+        return sorted(self._entries)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ------------------------------------------------------------- import
+
+    def import_file(self, path: str) -> int:
+        """`psk_id:secret` per line, reference import format
+        (`emqx_psk.erl` import/1).  Returns entries imported."""
+        count = 0
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                psk_id, sep, secret = line.partition(SEPARATOR)
+                if not sep or not psk_id:
+                    log.warning("psk: skipping malformed line %r", line[:40])
+                    continue
+                self._entries[psk_id] = secret.encode("utf-8")
+                count += 1
+        self._save()
+        return count
+
+    def _save(self) -> None:
+        if not self._persist_path:
+            return
+        tmp = self._persist_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({k: v.hex() for k, v in self._entries.items()}, f)
+        os.replace(tmp, self._persist_path)
+
+    # ----------------------------------------------------- TLS integration
+
+    def ssl_callback(self):
+        """Callback for `SSLContext.set_psk_server_callback`: returns the
+        shared secret, or b"" to reject (per the ssl module contract)."""
+        def cb(identity: Optional[str]) -> bytes:
+            secret = self.lookup(identity or "")
+            if secret is None:
+                log.info("psk: unknown identity %r", identity)
+                return b""
+            return secret
+        return cb
+
+    def install(self, ctx: ssl.SSLContext) -> bool:
+        """Attach to an SSLContext when the runtime supports server PSK."""
+        setter = getattr(ctx, "set_psk_server_callback", None)
+        if setter is None:
+            log.warning("psk: ssl module lacks set_psk_server_callback "
+                        "(needs CPython >= 3.13); store-only mode")
+            return False
+        setter(self.ssl_callback())
+        return True

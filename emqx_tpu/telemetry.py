@@ -1,0 +1,124 @@
+"""Anonymized usage telemetry — `emqx_modules/src/emqx_telemetry.erl` analog.
+
+Builds the same report shape as the reference (uuid, version, os info,
+uptime, active plugins/modules, client count, message counters
+`emqx_telemetry.erl:301-314`), persists a stable node UUID, and reports
+on a long interval (the reference uses 7 days).  Transport is a
+pluggable callback — this environment has zero egress, so the default
+reporter only logs; operators can opt out entirely (`enable=False`),
+matching the reference's disable API.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import platform
+import time
+import uuid as uuidlib
+from typing import Callable, List, Optional
+
+log = logging.getLogger("emqx_tpu.telemetry")
+
+REPORT_INTERVAL = 7 * 86400.0  # seconds, like ?REPORT_INTERVAR
+
+VERSION = "0.1.0"
+
+
+class Telemetry:
+    def __init__(self, broker=None, enable: bool = True,
+                 uuid_path: Optional[str] = None,
+                 reporter: Optional[Callable[[dict], None]] = None,
+                 plugins=None):
+        self.broker = broker
+        self.enable = enable
+        self.plugins = plugins
+        self.reporter = reporter or (lambda rep: log.info(
+            "telemetry report (not sent, no egress): %s",
+            json.dumps(rep)[:512]))
+        self._uuid_path = uuid_path
+        self.uuid = self._load_or_create_uuid()
+        self._started_at = time.time()
+        self._last_report: Optional[dict] = None
+        self._next_report_at = time.time() + REPORT_INTERVAL
+
+    def _load_or_create_uuid(self) -> str:
+        if self._uuid_path and os.path.exists(self._uuid_path):
+            with open(self._uuid_path, "r", encoding="utf-8") as f:
+                val = f.read().strip()
+                if val:
+                    return val
+        val = str(uuidlib.uuid4())
+        if self._uuid_path:
+            tmp = self._uuid_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(val)
+            os.replace(tmp, self._uuid_path)
+        return val
+
+    # ------------------------------------------------------------- report
+
+    def get_telemetry(self) -> dict:
+        """Report payload (`emqx_telemetry.erl:299-314` field parity)."""
+        metrics = getattr(self.broker, "metrics", None)
+        get = (lambda k: metrics.get(k)) if metrics is not None else (lambda k: 0)
+        active_plugins: List[str] = []
+        if self.plugins is not None:
+            active_plugins = [
+                p["name_vsn"] for p in self.plugins.list() if p["running"]
+            ]
+        return {
+            "emqx_version": VERSION,
+            "license": {"edition": "opensource"},
+            "os_name": platform.system(),
+            "os_version": platform.release(),
+            "otp_version": platform.python_version(),  # runtime analog
+            "up_time": round(time.time() - self._started_at, 3),
+            "uuid": self.uuid,
+            "nodes_uuid": [],
+            "active_plugins": active_plugins,
+            "active_modules": [],
+            "num_clients": self._num_clients(),
+            "messages_received": get("messages.received"),
+            "messages_sent": get("messages.sent"),
+        }
+
+    def _num_clients(self) -> int:
+        cm = getattr(self.broker, "cm", None)
+        if cm is None:
+            return 0
+        for attr in ("channel_count", "count"):
+            v = getattr(cm, attr, None)
+            if callable(v):
+                return v()
+            if isinstance(v, int):
+                return v
+        chans = getattr(cm, "channels", None)
+        return len(chans) if chans is not None else 0
+
+    # ------------------------------------------------------------ control
+
+    def report_now(self) -> Optional[dict]:
+        if not self.enable:
+            return None
+        rep = self.get_telemetry()
+        self._last_report = rep
+        self._next_report_at = time.time() + REPORT_INTERVAL
+        try:
+            self.reporter(rep)
+        except Exception:
+            log.exception("telemetry reporter failed")
+        return rep
+
+    def tick(self, now: Optional[float] = None) -> Optional[dict]:
+        """Housekeeping-driven timer (the reference uses a 7-day timer)."""
+        now = time.time() if now is None else now
+        if self.enable and now >= self._next_report_at:
+            return self.report_now()
+        return None
+
+    def set_enabled(self, on: bool) -> None:
+        self.enable = on
+        if on:
+            self._next_report_at = time.time() + REPORT_INTERVAL

@@ -1,0 +1,238 @@
+"""PSK store, plugin manager, and telemetry tests."""
+
+import io
+import json
+import os
+import tarfile
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.plugins import PluginError, PluginManager
+from emqx_tpu.psk import PskStore
+from emqx_tpu.telemetry import Telemetry
+
+
+# ------------------------------------------------------------------- PSK
+
+def test_psk_import_lookup_persist(tmp_path):
+    init = tmp_path / "init.psk"
+    init.write_text(
+        "# comment line\n"
+        "client1:secret1\n"
+        "gateway-7:dead:beef\n"   # secret itself may contain ':'
+        "malformed_line_no_sep\n"
+        "\n"
+    )
+    persist = tmp_path / "store.json"
+    store = PskStore(init_file=str(init), persist_path=str(persist))
+    assert len(store) == 2
+    assert store.lookup("client1") == b"secret1"
+    assert store.lookup("gateway-7") == b"dead:beef"
+    assert store.lookup("nope") is None
+
+    store.insert("extra", b"\x01\x02")
+    store.delete("client1")
+    # reload from the snapshot
+    store2 = PskStore(persist_path=str(persist))
+    assert store2.lookup("extra") == b"\x01\x02"
+    assert store2.lookup("client1") is None
+    assert store2.lookup("gateway-7") == b"dead:beef"
+
+
+def test_psk_disabled_and_callback():
+    store = PskStore()
+    store.insert("id1", b"s")
+    cb = store.ssl_callback()
+    assert cb("id1") == b"s"
+    assert cb("unknown") == b""   # reject per ssl contract
+    store.enable = False
+    assert store.lookup("id1") is None
+
+
+# --------------------------------------------------------------- plugins
+
+def make_plugin_pkg(install_dir: str, name="demo", vsn="1.0.0",
+                    body=None) -> str:
+    name_vsn = f"{name}-{vsn}"
+    body = body or (
+        "LOADED = []\n"
+        "def on_load(ctx):\n"
+        "    def tap(msg):\n"
+        "        LOADED.append(msg.topic)\n"
+        "        return msg\n"
+        "    ctx.hooks.put('message.publish', tap)\n"
+        "    ctx._tap = tap\n"
+        "def on_unload(ctx):\n"
+        "    pass\n"
+    )
+    manifest = json.dumps({"name": name, "rel_vsn": vsn,
+                           "description": "demo plugin"})
+    tar_path = os.path.join(install_dir, name_vsn + ".tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for fname, content in [("release.json", manifest), (f"{name}.py", body)]:
+            data = content.encode()
+            info = tarfile.TarInfo(f"{name_vsn}/{fname}")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return name_vsn
+
+
+def test_plugin_install_enable_start_lifecycle(tmp_path):
+    b = Broker()
+    pm = PluginManager(b, str(tmp_path))
+    nv = make_plugin_pkg(str(tmp_path))
+
+    st = pm.ensure_installed(nv)
+    assert st.manifest["name"] == "demo"
+    pm.ensure_enabled(nv)
+    pm.ensure_started()
+    assert pm.get(nv).running
+
+    # the plugin's hook actually runs on publish
+    b.publish(Message(topic="seen/by/plugin", payload=b"x"))
+    assert "seen/by/plugin" in pm.get(nv).module.LOADED
+
+    # uninstall refuses while running/enabled (reference semantics)
+    with pytest.raises(PluginError):
+        pm.ensure_uninstalled(nv)
+    pm.ensure_stopped(nv)
+    with pytest.raises(PluginError):
+        pm.ensure_uninstalled(nv)
+    pm.ensure_disabled(nv)
+    pm.ensure_uninstalled(nv)
+    assert pm.get(nv) is None
+
+
+def test_plugin_enable_order_and_persistence(tmp_path):
+    b = Broker()
+    pm = PluginManager(b, str(tmp_path))
+    a = make_plugin_pkg(str(tmp_path), name="aaa")
+    c = make_plugin_pkg(str(tmp_path), name="ccc")
+    d = make_plugin_pkg(str(tmp_path), name="ddd")
+    for nv in (a, c, d):
+        pm.ensure_installed(nv)
+    pm.ensure_enabled(a)
+    pm.ensure_enabled(c, position="front")
+    pm.ensure_enabled(d, position=f"before:{a}")
+    assert pm._enabled_order == [c, d, a]
+
+    # a fresh manager on the same dir restores installed + enabled state
+    pm2 = PluginManager(b, str(tmp_path))
+    assert pm2._enabled_order == [c, d, a]
+    assert pm2.get(a).enabled and pm2.get(c).enabled
+    listing = {p["name_vsn"]: p for p in pm2.list()}
+    assert listing[a]["enabled"] and not listing[a]["running"]
+
+
+def test_plugin_tar_path_escape_rejected(tmp_path):
+    b = Broker()
+    pm = PluginManager(b, str(tmp_path))
+    evil = os.path.join(str(tmp_path), "evil-1.0.tar.gz")
+    with tarfile.open(evil, "w:gz") as tf:
+        data = b"boom"
+        info = tarfile.TarInfo("../../escape.txt")
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+    with pytest.raises(PluginError):
+        pm.ensure_installed("evil-1.0")
+
+
+# -------------------------------------------------------------- telemetry
+
+def test_telemetry_report_shape_and_uuid_stability(tmp_path):
+    b = Broker()
+    upath = str(tmp_path / "uuid")
+    reports = []
+    t = Telemetry(broker=b, uuid_path=upath, reporter=reports.append)
+    rep = t.report_now()
+    assert rep is not None and reports == [rep]
+    for key in ("emqx_version", "uuid", "up_time", "num_clients",
+                "messages_received", "messages_sent", "active_plugins",
+                "os_name"):
+        assert key in rep
+    # uuid survives restart
+    t2 = Telemetry(broker=b, uuid_path=upath)
+    assert t2.uuid == t.uuid
+
+
+def test_telemetry_disable_and_tick(tmp_path):
+    t = Telemetry(broker=Broker(), enable=False)
+    assert t.report_now() is None
+    t.set_enabled(True)
+    assert t.tick(now=0) is None          # not due yet
+    assert t.tick(now=1e18) is not None   # overdue -> reports
+
+
+def test_telemetry_counts_running_plugins(tmp_path):
+    b = Broker()
+    pm = PluginManager(b, str(tmp_path))
+    nv = make_plugin_pkg(str(tmp_path))
+    pm.ensure_installed(nv)
+    pm.ensure_enabled(nv)
+    pm.ensure_started()
+    t = Telemetry(broker=b, plugins=pm)
+    assert t.get_telemetry()["active_plugins"] == [nv]
+
+
+# ---------------------------------------------- REST/CLI surface integration
+
+def test_mgmt_api_and_cli_surface(tmp_path):
+    """Plugins/PSK/telemetry are manageable over the REST API + CLI."""
+    import asyncio
+    import io
+
+    from emqx_tpu.mgmt import HttpApi, ManagementApi
+    from emqx_tpu.mgmt.cli import Cli
+
+    async def main():
+        b = Broker()
+        pm = PluginManager(b, str(tmp_path / "plugins"))
+        nv = make_plugin_pkg(str(tmp_path / "plugins"))
+        psk = PskStore()
+        tel = Telemetry(broker=b, plugins=pm, reporter=lambda r: None)
+        api = ManagementApi(b, node="n0", plugins=pm, psk=psk, telemetry=tel)
+        httpd = HttpApi(host="127.0.0.1", port=0)
+        api.install(httpd)
+        await httpd.start()
+        base = f"http://127.0.0.1:{httpd.port}/api/v5"
+
+        from tests.test_mgmt import http
+
+        st, body = await asyncio.to_thread(http, "POST", f"{base}/plugins/{nv}/install")
+        assert st == 200 and body["name"] == "demo"
+        for action in ("enable", "start"):
+            st, _ = await asyncio.to_thread(http, "PUT", f"{base}/plugins/{nv}/{action}")
+            assert st == 204
+        st, rows = await asyncio.to_thread(http, "GET", f"{base}/plugins")
+        assert rows[0]["running"]
+
+        st, _ = await asyncio.to_thread(http, "POST", f"{base}/psk", {"psk_id": "d1", "secret": "s3cr3t"})
+        assert st == 204 and psk.lookup("d1") == b"s3cr3t"
+        st, body = await asyncio.to_thread(http, "GET", f"{base}/psk")
+        assert body["ids"] == ["d1"]
+        st, _ = await asyncio.to_thread(http, "DELETE", f"{base}/psk/zzz")
+        assert st == 404
+
+        st, body = await asyncio.to_thread(http, "GET", f"{base}/telemetry/data")
+        assert body["active_plugins"] == [nv]
+        st, _ = await asyncio.to_thread(http, "PUT", f"{base}/telemetry/status", {"enable": False})
+        assert st == 204 and tel.enable is False
+
+        await httpd.stop()
+        return api, pm, nv
+
+    loop = asyncio.new_event_loop()
+    api, pm, nv = loop.run_until_complete(asyncio.wait_for(main(), 30))
+    loop.close()
+
+    # CLI drives the same endpoints in-process (must run outside a loop)
+    out = io.StringIO()
+    cli = Cli(api=api, out=out)
+    assert cli.run(["plugins", "list"]) == 0
+    assert "running" in out.getvalue()
+    assert cli.run(["telemetry", "status"]) == 0
+    assert "disabled" in out.getvalue()
+    assert cli.run(["plugins", "stop", nv]) == 0
+    assert not pm.get(nv).running
