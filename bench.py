@@ -2,18 +2,29 @@
 
 Reproduces the reference's in-tree microbench methodology
 (`apps/emqx/src/emqx_broker_bench.erl`: N subscribers insert filters, M
-publishers measure LookupRps) on BASELINE.md config #2: 100k subscriptions,
-6-level topics, 20% single-level '+' wildcards.
+publishers measure LookupRps) across the five workload configs of
+`BASELINE.json`:
 
-Prints ONE JSON line:
+  1  1k exact-match subs, single-level topics
+  2  100k subs, 6-level topics, 20% single-level '+' wildcards  (HEADLINE)
+  3  1M subs, mixed '+'/'#' wildcards, shared-subscription groups
+  4  10M subs, Zipf-skewed publish topic distribution
+  5  10M subs with 5%/sec subscribe/unsubscribe churn
+
+Default run = config 2 and prints ONE JSON line (the driver contract):
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-vs_baseline = TPU route-lookups/sec over the CPU dict-trie baseline
-(the reference's ETS-trie analog) measured in the same process.
+  python bench.py --config 3        # one JSON line for config 3
+  python bench.py --all             # all 5 -> BENCH_TABLE.md + headline line
+  python bench.py --all --subs 1000000   # cap the big configs' table size
+
+vs_baseline = TPU route-lookups/sec over the CPU dict-trie baseline (the
+reference's ETS-trie analog) measured in the same process.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import random
 import sys
@@ -21,9 +32,7 @@ import time
 
 import numpy as np
 
-N_SUBS = 100_000
 BATCH = 4096
-N_BATCHES = 8
 ITERS = 40
 CPU_LOOKUPS = 3000
 
@@ -32,10 +41,18 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def build_population(rng: random.Random):
-    """100k filters over 6-level topic space, 20% '+' wildcards."""
+# ------------------------------------------------------------- populations
+
+def pop_exact_1k(rng):
+    filters = [f"chan{i}" for i in range(1_000)]
+    topics = lambda: [f"chan{rng.randint(0, 999)}" for _ in range(BATCH)]
+    return filters, topics
+
+
+def pop_wild_100k(rng, n=100_000):
+    """6-level topics, 20% '+', 5% '#' (the original headline config)."""
     filters = []
-    for i in range(N_SUBS):
+    for i in range(n):
         ws = [
             "device",
             str(rng.randint(0, 999)),
@@ -45,58 +62,108 @@ def build_population(rng: random.Random):
             str(i % 4096),
         ]
         r = rng.random()
-        if r < 0.20:  # single-level wildcard somewhere
+        if r < 0.20:
             ws[rng.randint(1, 5)] = "+"
-        elif r < 0.25:  # a few multi-level
-            cut = rng.randint(2, 5)
-            ws = ws[:cut] + ["#"]
+        elif r < 0.25:
+            ws = ws[: rng.randint(2, 5)] + ["#"]
         filters.append("/".join(ws))
-    return filters
+    # uniqueness: suffix duplicates with an id level (the table holds one
+    # entry per unique filter; the broker refcounts duplicate subscribers)
+    seen, out = set(), []
+    for i, f in enumerate(filters):
+        if f in seen:
+            f = f + f"/u{i}"
+        seen.add(f)
+        out.append(f)
 
-
-def make_topics(rng: random.Random, n: int):
-    return [
-        [
-            "device",
-            str(rng.randint(0, 999)),
-            rng.choice(["temp", "hum", "acc", "gps"]),
-            str(rng.randint(0, 99)),
-            rng.choice(["raw", "agg"]),
-            str(rng.randint(0, 4095)),
+    def topics():
+        return [
+            "/".join([
+                "device", str(rng.randint(0, 999)),
+                rng.choice(["temp", "hum", "acc", "gps"]),
+                str(rng.randint(0, 99)), rng.choice(["raw", "agg"]),
+                str(rng.randint(0, 4095)),
+            ])
+            for _ in range(BATCH)
         ]
-        for _ in range(n)
-    ]
+
+    return out, topics
 
 
-def main() -> None:
-    rng = random.Random(1234)
-    t0 = time.time()
-    filters = build_population(rng)
+def pop_mixed(rng, n):
+    """Config 3: mixed '+'/'#' + shared-subscription groups.
 
-    # ---- CPU baseline: dict trie (ETS-trie analog) ----
+    Shared subs ($share/<group>/<filter>) route on the inner filter
+    (`emqx_shared_sub.erl`); group pick happens host-side after match, so
+    the match-engine workload is the deduped inner filter set.
+    """
+    filters = []
+    for i in range(n):
+        r = rng.random()
+        base = ["site", str(i % 997), "line", str(rng.randint(0, 99)),
+                "sensor", str(i)]
+        if r < 0.30:
+            base[rng.choice([1, 3])] = "+"
+        if r < 0.10:
+            base = base[:4] + ["#"]
+        filters.append("/".join(base) + (f"/u{i}" if r >= 0.10 and r < 0.30 else ""))
+    seen, out = set(), []
+    for i, f in enumerate(filters):
+        if f in seen:
+            f = f + f"/u{i}"
+        seen.add(f)
+        out.append(f)
+
+    def topics():
+        return [
+            f"site/{rng.randint(0, 996)}/line/{rng.randint(0, 99)}/sensor/{rng.randint(0, n)}"
+            for _ in range(BATCH)
+        ]
+
+    return out, topics
+
+
+def pop_zipf(rng, n):
+    """Config 4: big sub table, Zipf-skewed publish topics (hot topics
+    dominate, like production MQTT fan-in)."""
+    filters, topics_fn = pop_mixed(rng, n)
+    zipf_ids = np.random.default_rng(5).zipf(1.3, size=200_000)
+
+    def topics():
+        idx = np.random.default_rng(rng.randint(0, 1 << 30)).integers(
+            0, len(zipf_ids), BATCH)
+        return [
+            f"site/{int(zipf_ids[i]) % 997}/line/{int(zipf_ids[i]) % 100}/sensor/{int(zipf_ids[i]) % n}"
+            for i in idx
+        ]
+
+    return filters, topics
+
+
+# ------------------------------------------------------------ measurement
+
+def cpu_baseline(filters, topics_fn):
     from emqx_tpu.models.reference import CpuTrieIndex
 
     trie = CpuTrieIndex()
     ins0 = time.time()
     for i, f in enumerate(filters):
         trie.insert(f, i)
-    cpu_insert_rps = N_SUBS / (time.time() - ins0)
-
-    cpu_topics = ["/".join(w) for w in make_topics(rng, CPU_LOOKUPS)]
+    cpu_insert_rps = len(filters) / (time.time() - ins0)
+    cpu_topics = topics_fn()[:CPU_LOOKUPS]
     m0 = time.time()
     hits = 0
     for t in cpu_topics:
         hits += len(trie.match(t))
-    cpu_rps = CPU_LOOKUPS / (time.time() - m0)
-    log(
-        f"cpu baseline: insert {cpu_insert_rps:,.0f}/s, "
-        f"lookup {cpu_rps:,.0f}/s ({hits} hits), build {time.time()-t0:.1f}s"
-    )
+    cpu_rps = len(cpu_topics) / (time.time() - m0)
+    log(f"cpu baseline: insert {cpu_insert_rps:,.0f}/s, lookup {cpu_rps:,.0f}/s "
+        f"({hits} hits)")
+    return cpu_insert_rps, cpu_rps
 
-    # ---- TPU engine ----
+
+def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     import jax
 
-    from emqx_tpu.broker import topic as topiclib
     from emqx_tpu.models.engine import TopicMatchEngine
     from emqx_tpu.ops import hashing
     from emqx_tpu.ops.match import TopicBatch, match_batch_jit
@@ -111,17 +178,18 @@ def main() -> None:
 
     eng = TopicMatchEngine()
     ins0 = time.time()
-    for f in filters:
-        eng.add_filter(f)
-    log(f"engine insert: {N_SUBS/(time.time()-ins0):,.0f}/s")
+    eng.add_filters(filters)
+    insert_rps = len(filters) / (time.time() - ins0)
+    log(f"engine insert (bulk): {insert_rps:,.0f}/s")
     tables = eng.sync_device()
 
     # pre-hash topic batches (host hashing measured separately; the data
     # plane rate is the device matcher)
     batches = []
     hash_secs = 0.0
-    for _ in range(N_BATCHES):
-        ts = ["/".join(w) for w in make_topics(rng, BATCH)]
+    n_batches = 8
+    for _ in range(n_batches):
+        ts = topics_fn()
         h0 = time.time()
         # C++ fast path (split+fnv+mix in one pass) when built, else Python
         ta, tb, ln, dl = hashing.hash_topics(eng.space, ts)
@@ -129,37 +197,122 @@ def main() -> None:
         batches.append(
             TopicBatch(*(jax.device_put(x, dev) for x in (ta, tb, ln, dl)))
         )
-    host_hash_rps = N_BATCHES * BATCH / hash_secs
+    host_hash_rps = n_batches * BATCH / hash_secs
 
     c0 = time.time()
     out = match_batch_jit(tables, batches[0])
     out.block_until_ready()
     log(f"first compile+run: {time.time()-c0:.1f}s")
 
+    lat = []
+    churn_events = 0
     r0 = time.time()
     for i in range(ITERS):
-        out = match_batch_jit(tables, batches[i % N_BATCHES])
-    out.block_until_ready()
+        if churn_frac and churn_pool:
+            # config 5: subscribe/unsubscribe between ticks, then resync
+            k = max(1, int(len(filters) * churn_frac / ITERS))
+            for j in range(k):
+                f = churn_pool[(i * k + j) % len(churn_pool)]
+                if eng.fid_of(f) is None:
+                    eng.add_filter(f)
+                else:
+                    eng.remove_filter(f)
+            churn_events += k
+            tables = eng.sync_device()
+        b0 = time.time()
+        out = match_batch_jit(tables, batches[i % n_batches])
+        out.block_until_ready()
+        lat.append(time.time() - b0)
     elapsed = time.time() - r0
     tpu_rps = ITERS * BATCH / elapsed
+    p99_ms = float(np.percentile(np.array(lat) * 1e3, 99))
 
     matched = np.asarray(out)
-    log(
-        f"tpu: {tpu_rps:,.0f} lookups/s ({elapsed*1e3/ITERS:.2f} ms/batch of "
-        f"{BATCH}); host hash {host_hash_rps:,.0f}/s; "
-        f"sample hits {(matched >= 0).sum()}"
-    )
+    log(f"tpu: {tpu_rps:,.0f} lookups/s ({elapsed*1e3/ITERS:.2f} ms/batch of "
+        f"{BATCH}, p99 {p99_ms:.2f} ms); host hash {host_hash_rps:,.0f}/s; "
+        f"churn events {churn_events}; sample hits {(matched >= 0).sum()}")
+    return {
+        "tpu_rps": tpu_rps,
+        "p99_ms": p99_ms,
+        "insert_rps": insert_rps,
+        "host_hash_rps": host_hash_rps,
+    }
 
-    print(
-        json.dumps(
-            {
-                "metric": "route_lookups_per_sec_100k_subs",
-                "value": round(tpu_rps),
-                "unit": "lookups/sec",
-                "vs_baseline": round(tpu_rps / cpu_rps, 2),
-            }
-        )
-    )
+
+CONFIGS = {
+    1: ("exact_1k", "1k exact subs, single-level topics"),
+    2: ("wild_100k", "100k subs, 6-level, 20% '+' wildcards"),
+    3: ("mixed_1m", "1M subs, mixed '+'/'#', shared groups"),
+    4: ("zipf_10m", "10M subs, Zipf-skewed publishes"),
+    5: ("churn_10m", "10M subs, 5%/sec churn"),
+}
+
+
+def run_config(n: int, subs_cap: int | None):
+    rng = random.Random(1234 + n)
+    churn_frac, churn_pool = 0.0, None
+    if n == 1:
+        filters, topics_fn = pop_exact_1k(rng)
+    elif n == 2:
+        filters, topics_fn = pop_wild_100k(rng)
+    elif n == 3:
+        filters, topics_fn = pop_mixed(rng, subs_cap or 1_000_000)
+    elif n == 4:
+        filters, topics_fn = pop_zipf(rng, subs_cap or 10_000_000)
+    elif n == 5:
+        filters, topics_fn = pop_mixed(rng, subs_cap or 10_000_000)
+        churn_frac = 0.05
+        churn_pool = [f"churn/{i}/+" for i in range(50_000)]
+    else:
+        raise SystemExit(f"unknown config {n}")
+    log(f"== config {n}: {CONFIGS[n][1]} ({len(filters):,} filters) ==")
+    cpu_insert, cpu_rps = cpu_baseline(filters, topics_fn)
+    stats = run_engine(filters, topics_fn, churn_frac, churn_pool)
+    stats.update({"cpu_rps": cpu_rps, "cpu_insert_rps": cpu_insert,
+                  "n_filters": len(filters)})
+    return stats
+
+
+def headline_json(n: int, stats: dict) -> str:
+    return json.dumps({
+        "metric": f"route_lookups_per_sec_{CONFIGS[n][0]}",
+        "value": round(stats["tpu_rps"]),
+        "unit": "lookups/sec",
+        "vs_baseline": round(stats["tpu_rps"] / stats["cpu_rps"], 2),
+    })
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=2, choices=sorted(CONFIGS))
+    ap.add_argument("--all", action="store_true",
+                    help="run all 5 configs, write BENCH_TABLE.md")
+    ap.add_argument("--subs", type=int, default=None,
+                    help="cap filter count for configs 3-5")
+    ns = ap.parse_args()
+
+    if not ns.all:
+        stats = run_config(ns.config, ns.subs)
+        print(headline_json(ns.config, stats))
+        return
+
+    rows = {}
+    for n in sorted(CONFIGS):
+        rows[n] = run_config(n, ns.subs)
+    with open("BENCH_TABLE.md", "w", encoding="utf-8") as f:
+        f.write("# BASELINE.json workload table\n\n")
+        f.write("| # | config | filters | cpu lookups/s | tpu lookups/s | "
+                "speedup | p99 ms | insert/s |\n")
+        f.write("|---|--------|---------|---------------|---------------|"
+                "---------|--------|----------|\n")
+        for n, s in rows.items():
+            f.write(
+                f"| {n} | {CONFIGS[n][1]} | {s['n_filters']:,} "
+                f"| {s['cpu_rps']:,.0f} | {s['tpu_rps']:,.0f} "
+                f"| {s['tpu_rps']/s['cpu_rps']:.1f}x | {s['p99_ms']:.2f} "
+                f"| {s['insert_rps']:,.0f} |\n")
+    log("wrote BENCH_TABLE.md")
+    print(headline_json(2, rows[2]))
 
 
 if __name__ == "__main__":

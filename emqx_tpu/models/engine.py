@@ -60,6 +60,26 @@ class TopicMatchEngine:
         self.epoch = 0  # bumps on every device-visible mutation
         self._dev: Optional[DeviceTables] = None
         self._dev_stale = True
+        self._match_fn = match_batch_jit
+        self._try_pallas()
+
+    def _try_pallas(self) -> None:
+        """Opt into the Pallas hash-contraction kernel (EMQX_TPU_PALLAS=1);
+        keep the XLA path if Mosaic rejects this platform."""
+        import os
+
+        if os.environ.get("EMQX_TPU_PALLAS", "") != "1":
+            return
+        from ..ops import pallas_match
+
+        def fn(dev, batch, _self=self):
+            try:
+                return pallas_match.match_batch_pallas_jit(dev, batch)
+            except Exception:  # lowering failure -> permanent XLA fallback
+                _self._match_fn = match_batch_jit
+                return match_batch_jit(dev, batch)
+
+        self._match_fn = fn
 
     # ------------------------------------------------------------ mutation
 
@@ -83,6 +103,35 @@ class TopicMatchEngine:
             self.tables.insert(ws, fid)
         self.epoch += 1
         return fid
+
+    def add_filters(self, filts: Sequence[str]) -> List[int]:
+        """Bulk add (route-table bootstrap): one native key pass + one
+        device rebuild instead of len(filts) incremental inserts."""
+        fids: List[int] = []
+        new_strs: List[str] = []
+        new_fids: List[int] = []
+        for filt in filts:
+            fid = self._fids.get(filt)
+            if fid is not None:
+                self._refs[fid] += 1
+                fids.append(fid)
+                continue
+            fid = self._free_fids.pop() if self._free_fids else self._alloc_fid()
+            ws = topiclib.words(filt)
+            self._fids[filt] = fid
+            self._refs[fid] = 1
+            self._words[fid] = ws
+            fids.append(fid)
+            if self._is_deep(ws):
+                self._deep.insert(filt, fid)
+                self._deep_fids.add(fid)
+            else:
+                new_strs.append(filt)
+                new_fids.append(fid)
+        if new_strs:
+            self.tables.bulk_insert(new_strs, new_fids)
+        self.epoch += 1
+        return fids
 
     def remove_filter(self, filt: str) -> Optional[int]:
         """Drop one reference; returns the fid if it was fully removed."""
@@ -165,7 +214,7 @@ class TopicMatchEngine:
             import jax
 
             batch = TopicBatch(*(jax.device_put(a, self.device) for a in nb))
-            matched = np.asarray(match_batch_jit(dev, batch))[: len(topics)]
+            matched = np.asarray(self._match_fn(dev, batch))[: len(topics)]
             for i in range(len(topics)):
                 row = matched[i]
                 hits = row[row >= 0]

@@ -64,6 +64,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _u8p, ctypes.c_int64, ctypes.c_int64,
         _u8p, _i64p, _i64p, ctypes.c_int32, _i64p, _i32p,
     ]
+    lib.etpu_filter_keys.restype = None
+    lib.etpu_filter_keys.argtypes = [
+        _u8p, _i64p, ctypes.c_int32, ctypes.c_int32,
+        _u32p, _u32p, _u32p, _u32p,
+        _u32p, _u32p, _u32p, _u32p,
+        _u32p, _u32p, _i32p, _u32p, _u8p,
+    ]
+    lib.etpu_bulk_place.restype = ctypes.c_int32
+    lib.etpu_bulk_place.argtypes = [
+        _u32p, _u32p, _i32p, ctypes.c_int32, ctypes.c_int32,
+        _u32p, _u32p, _i32p, ctypes.c_int32,
+    ]
     return lib
 
 
@@ -172,3 +184,63 @@ def scan_frames(buf: bytes, max_size: int, max_frames: int = 256) -> Optional[Fr
         ctypes.byref(consumed), ctypes.byref(err),
     )
     return FrameScan(count, headers, offs, lens, consumed.value, err.value)
+
+
+def _pack_strs(strs):
+    blobs = [s.encode("utf-8") for s in strs]
+    offsets = np.zeros(len(blobs) + 1, dtype=np.int64)
+    for i, b in enumerate(blobs):
+        offsets[i + 1] = offsets[i] + len(b)
+    data = b"".join(blobs)
+    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(1, dtype=np.uint8)
+    return np.ascontiguousarray(buf), offsets
+
+
+def filter_keys(filters, max_levels: int, space):
+    """Native batch filter_key: (ha, hb, plen, plus_mask, has_hash) arrays,
+    or None when the lib is absent."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(filters)
+    buf, offsets = _pack_strs(filters)
+    ha = np.zeros(n, dtype=np.uint32)
+    hb = np.zeros(n, dtype=np.uint32)
+    plen = np.zeros(n, dtype=np.int32)
+    plus_mask = np.zeros(n, dtype=np.uint32)
+    has_hash = np.zeros(n, dtype=np.uint8)
+    c = np.ascontiguousarray
+    hra = c(space.HR[0]); hrb = c(space.HR[1])
+    lib.etpu_filter_keys(
+        buf.ctypes.data_as(_u8p), c(offsets).ctypes.data_as(_i64p),
+        n, max_levels,
+        c(space.C[0]).ctypes.data_as(_u32p), c(space.C[1]).ctypes.data_as(_u32p),
+        c(space.R[0]).ctypes.data_as(_u32p), c(space.R[1]).ctypes.data_as(_u32p),
+        c(space.PLUS).ctypes.data_as(_u32p), c(space.HM).ctypes.data_as(_u32p),
+        hra.ctypes.data_as(_u32p), hrb.ctypes.data_as(_u32p),
+        ha.ctypes.data_as(_u32p), hb.ctypes.data_as(_u32p),
+        plen.ctypes.data_as(_i32p), plus_mask.ctypes.data_as(_u32p),
+        has_hash.ctypes.data_as(_u8p),
+    )
+    return ha, hb, plen, plus_mask, has_hash.astype(bool)
+
+
+def bulk_place(key_a: np.ndarray, key_b: np.ndarray, val: np.ndarray,
+               log2cap: int, probe: int,
+               ha: np.ndarray, hb: np.ndarray, fids: np.ndarray):
+    """In-place open-addressed placement; returns index of first failure or
+    len(ha).  None when the lib is absent."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    assert key_a.flags.c_contiguous and val.flags.c_contiguous
+    c = np.ascontiguousarray
+    ha = c(ha.astype(np.uint32, copy=False))
+    hb = c(hb.astype(np.uint32, copy=False))
+    fids = c(fids.astype(np.int32, copy=False))
+    return lib.etpu_bulk_place(
+        key_a.ctypes.data_as(_u32p), key_b.ctypes.data_as(_u32p),
+        val.ctypes.data_as(_i32p), log2cap, probe,
+        ha.ctypes.data_as(_u32p), hb.ctypes.data_as(_u32p),
+        fids.ctypes.data_as(_i32p), len(ha),
+    )

@@ -1,0 +1,113 @@
+"""Pallas TPU kernel for the pattern-hash contraction.
+
+The match hot op (`ops/match.py pattern_hashes`) is a masked wrap-around
+sum: ``h[b, m] = sum_l terms[b, l] * incl[m, l] + k[m]`` over u32 — a
+[B, L] x [M, L] contraction, the device-side analog of the per-level
+trie walk in `emqx_trie.erl:272-334`.  XLA already fuses this well; the
+Pallas version tiles it explicitly over (B, M) so both operand tiles sit
+in VMEM and the two lanes (a/b) are computed in one pass over the terms
+tile, halving HBM reads of `incl`.
+
+The kernel is exact u32 wraparound arithmetic, bit-identical to the XLA
+path (tests compare both).  `match_batch_pallas` drops into the same
+probe/compare epilogue as `match_batch` — dynamic gathers stay in XLA,
+which lowers them natively.
+
+Enable per call (`match_batch_pallas`) or process-wide via the
+``EMQX_TPU_PALLAS=1`` environment variable (`pattern_hashes_auto`).
+Falls back to the XLA path on platforms without Mosaic support.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .match import DeviceTables, TopicBatch, PROBE, _MIX1, _MIX2
+
+
+def _hash_kernel(ta_ref, tb_ref, incl_ref, ka_ref, kb_ref, ha_ref, hb_ref):
+    """One (B-tile, M-tile) block: both lanes in a single pass."""
+    ta = ta_ref[:]          # [bB, L] u32
+    tb = tb_ref[:]          # [bB, L] u32
+    incl = incl_ref[:]      # [bM, L] u32 (0/1)
+    # u32 multiply-add wraps mod 2^32 — exactly the host/table arithmetic
+    ha = (ta[:, None, :] * incl[None, :, :]).sum(axis=-1, dtype=jnp.uint32)
+    hb = (tb[:, None, :] * incl[None, :, :]).sum(axis=-1, dtype=jnp.uint32)
+    ha_ref[:] = ha + ka_ref[:][None, :]
+    hb_ref[:] = hb + kb_ref[:][None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("block_b", "block_m", "interpret"))
+def pattern_hashes_pallas(
+    t: DeviceTables, batch: TopicBatch,
+    block_b: int = 256, block_m: int = 128, interpret: bool = False,
+):
+    """[B, M] u32 hashes of every topic under every shape (Pallas path)."""
+    B, L = batch.terms_a.shape
+    M = t.incl.shape[0]
+    bB = min(block_b, B)
+    bM = min(block_m, M)
+    # grid must tile exactly: B and M are already powers of two (the batch
+    # is padded by _pad_batch; table capacities are pow2), so any smaller
+    # pow2 block divides them
+    assert B % bB == 0 and M % bM == 0, (B, bB, M, bM)
+    grid = (B // bB, M // bM)
+    ha, hb = pl.pallas_call(
+        _hash_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bB, L), lambda i, j: (i, 0)),
+            pl.BlockSpec((bB, L), lambda i, j: (i, 0)),
+            pl.BlockSpec((bM, L), lambda i, j: (j, 0)),
+            pl.BlockSpec((bM,), lambda i, j: (j,)),
+            pl.BlockSpec((bM,), lambda i, j: (j,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bB, bM), lambda i, j: (i, j)),
+            pl.BlockSpec((bB, bM), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, M), jnp.uint32),
+            jax.ShapeDtypeStruct((B, M), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(batch.terms_a, batch.terms_b, t.incl, t.k_a, t.k_b)
+    return ha, hb
+
+
+def match_batch_pallas(t: DeviceTables, batch: TopicBatch,
+                       interpret: bool = False) -> jax.Array:
+    """`match_batch` with the hash contraction on the Pallas path."""
+    cap = t.key_a.shape[0]
+    log2cap = int(cap).bit_length() - 1
+    ha, hb = pattern_hashes_pallas(t, batch, interpret=interpret)
+
+    mixed = (ha + hb * jnp.uint32(_MIX1)) * jnp.uint32(_MIX2)
+    home = (mixed >> jnp.uint32(32 - log2cap)).astype(jnp.int32)
+    offs = jnp.arange(PROBE, dtype=jnp.int32)
+    slots = (home[:, :, None] + offs[None, None, :]) & (cap - 1)
+    ka = jnp.take(t.key_a, slots, axis=0)
+    kb = jnp.take(t.key_b, slots, axis=0)
+    vv = jnp.take(t.val, slots, axis=0)
+    hit = (ka == ha[:, :, None]) & (kb == hb[:, :, None]) & (vv >= 0)
+    fid = jnp.max(jnp.where(hit, vv, -1), axis=-1)
+    ok = (
+        t.valid[None, :]
+        & (batch.length[:, None] >= t.min_len[None, :])
+        & (batch.length[:, None] <= t.max_len[None, :])
+        & ~(batch.dollar[:, None] & t.wild_root[None, :])
+    )
+    return jnp.where(ok, fid, -1)
+
+
+match_batch_pallas_jit = jax.jit(match_batch_pallas,
+                                 static_argnames=("interpret",))
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("EMQX_TPU_PALLAS", "") == "1"

@@ -26,6 +26,7 @@ import numpy as np
 from .hashing import HashSpace, Shape
 
 PROBE = 8  # fixed probe window; every key lives within PROBE slots of home
+MAX_LOG2CAP = 30  # growth guard: past this, growth can't be the fix
 _U32 = 0xFFFFFFFF
 _MIX1 = 0x85EBCA77
 _MIX2 = 0x9E3779B1
@@ -174,6 +175,18 @@ class MatchTables:
                 return slot
         raise GrowNeeded("probe window exhausted")
 
+    def _window_is_duplicates(self, ha: int, hb: int) -> bool:
+        """True when the probe window is full of THIS key: growth rehashes
+        them to the same home, so growing can never help — fail fast."""
+        cap = 1 << self.log2cap
+        home = bucket_of(ha, hb, self.log2cap)
+        for off in range(PROBE):
+            slot = (home + off) & (cap - 1)
+            if not (self.val[slot] != -1 and self.key_a[slot] == ha
+                    and self.key_b[slot] == hb):
+                return False
+        return True
+
     def insert(self, filter_words: Sequence[str], fid: int) -> None:
         """Insert filter with id `fid`. Grows tables automatically."""
         ha, hb, shape = self.space.filter_key(filter_words)
@@ -188,11 +201,118 @@ class MatchTables:
                 self._place(ha, hb, fid)
                 break
             except GrowNeeded:
+                if self._window_is_duplicates(ha, hb):
+                    raise RuntimeError(
+                        "duplicate filter key inserted >%d times — callers "
+                        "must refcount per unique filter (models/engine.py)"
+                        % PROBE)
                 self._grow_table()
         self._entries[fid] = (ha, hb, shape)
         self.n_entries += 1
         if self.n_entries * 2 > (1 << self.log2cap):
             self._grow_table()
+
+    def bulk_insert(self, filters: Sequence[str], fids: Sequence[int]) -> None:
+        """Insert many filters at once (route-table bootstrap / resync).
+
+        Uses the native batch key computation + placement
+        (native/matchhash.cc etpu_filter_keys/etpu_bulk_place) and a single
+        device-mirror rebuild, instead of n Python-loop inserts — the bulk
+        analog of the reference's transactional trie load.  Falls back to
+        per-filter insert() when the native lib is absent or the batch is
+        small enough that delta-tracking is cheaper than a rebuild.
+        """
+        from . import native
+
+        n = len(filters)
+        out = None
+        if n >= 512:
+            out = native.filter_keys(list(filters), self.space.max_levels,
+                                     self.space)
+        if out is None:
+            for f, fid in zip(filters, fids):
+                self.insert(f.split("/"), fid)
+            return
+        ha, hb, plen, plus_mask, has_hash = out
+
+        # shape bookkeeping, one acquire per DISTINCT shape
+        trip = np.stack([plen.astype(np.int64),
+                         plus_mask.astype(np.int64),
+                         has_hash.astype(np.int64)])
+        uniq, counts = np.unique(trip, axis=1, return_counts=True)
+        for j in range(uniq.shape[1]):
+            shape = Shape(plen=int(uniq[0, j]), plus_mask=int(uniq[1, j]),
+                          has_hash=bool(uniq[2, j]))
+            cnt = int(counts[j])
+            ent = self._shapes.get(shape)
+            if ent is not None:
+                idx, rc = ent
+                self._shapes[shape] = (idx, rc + cnt)
+                continue
+            while True:
+                try:
+                    self._acquire_shape(shape)
+                    break
+                except GrowNeeded:
+                    self._grow_desc()
+            idx, _one = self._shapes[shape]
+            self._shapes[shape] = (idx, cnt)
+        shape_cache: Dict[Tuple[int, int, bool], Shape] = {}
+        for i in range(n):
+            key = (int(plen[i]), int(plus_mask[i]), bool(has_hash[i]))
+            shape = shape_cache.get(key)
+            if shape is None:
+                shape = Shape(plen=key[0], plus_mask=key[1], has_hash=key[2])
+                shape_cache[key] = shape
+            self._entries[fids[i]] = (int(ha[i]), int(hb[i]), shape)
+        self.n_entries += n
+        while self.n_entries * 2 > (1 << self.log2cap):
+            self.log2cap += 1
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        """Re-place every entry into fresh arrays at the current capacity,
+        growing until placement succeeds; native path when available."""
+        from . import native
+
+        n = len(self._entries)
+        fids = np.fromiter(self._entries.keys(), dtype=np.int32, count=n)
+        ha = np.fromiter((e[0] for e in self._entries.values()),
+                         dtype=np.uint32, count=n)
+        hb = np.fromiter((e[1] for e in self._entries.values()),
+                         dtype=np.uint32, count=n)
+        while True:
+            cap = 1 << self.log2cap
+            self.key_a = np.zeros(cap, dtype=np.uint32)
+            self.key_b = np.zeros(cap, dtype=np.uint32)
+            self.val = np.full(cap, -1, dtype=np.int32)
+            r = native.bulk_place(self.key_a, self.key_b, self.val,
+                                  self.log2cap, PROBE, ha, hb, fids)
+            if r is None:  # no native lib: python placement loop
+                try:
+                    for i in range(n):
+                        home = bucket_of(int(ha[i]), int(hb[i]), self.log2cap)
+                        for off in range(PROBE):
+                            slot = (home + off) & (cap - 1)
+                            if self.val[slot] == -1:
+                                self.key_a[slot] = ha[i]
+                                self.key_b[slot] = hb[i]
+                                self.val[slot] = fids[i]
+                                break
+                        else:
+                            raise GrowNeeded
+                    break
+                except GrowNeeded:
+                    self.log2cap += 1
+                    if self.log2cap > MAX_LOG2CAP:
+                        raise RuntimeError("match-table growth runaway")
+                    continue
+            if r == n:
+                break
+            self.log2cap += 1
+            if self.log2cap > MAX_LOG2CAP:
+                raise RuntimeError("match-table growth runaway")
+        self.delta = Delta(rebuilt=True, desc_dirty=True)
 
     def delete(self, fid: int) -> None:
         ha, hb, shape = self._entries.pop(fid)
@@ -224,6 +344,11 @@ class MatchTables:
 
     def _grow_table(self) -> None:
         self.log2cap += 1
+        if self.log2cap > MAX_LOG2CAP:
+            raise RuntimeError(
+                "match-table growth runaway: >%d duplicate keys in one probe "
+                "window (duplicate filter inserts? callers must refcount "
+                "per unique filter like models/engine.py)" % PROBE)
         cap = 1 << self.log2cap
         while True:
             self.key_a = np.zeros(cap, dtype=np.uint32)
@@ -244,6 +369,8 @@ class MatchTables:
                 break
             except GrowNeeded:
                 self.log2cap += 1
+                if self.log2cap > MAX_LOG2CAP:
+                    raise RuntimeError("match-table growth runaway")
                 cap = 1 << self.log2cap
         self.delta = Delta(rebuilt=True, desc_dirty=True)
 

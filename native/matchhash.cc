@@ -129,3 +129,106 @@ int32_t etpu_scan_frames(const uint8_t* buf, int64_t n, int64_t max_size,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// ------------------------------------------------------------ filter_keys
+
+// Compute the table key + wildcard shape of each subscription filter —
+// ops/hashing.py HashSpace.filter_key semantics, bit-for-bit:
+//   * trailing "#" level sets has_hash and is excluded from plen
+//   * "+" levels set plus_mask bits and contribute the PLUS sentinel term
+//     via the per-shape constant K (added here directly)
+//   * (ha, hb) == (0, 0) is remapped to (0, 1): empty-slot sentinel
+// Caller guarantees plen <= max_levels (deeper filters take the host-trie
+// fallback path, models/engine.py _is_deep).
+void etpu_filter_keys(
+    const uint8_t* data, const int64_t* offsets, int32_t n_filters,
+    int32_t max_levels,
+    const uint32_t* Ca, const uint32_t* Cb,
+    const uint32_t* Ra, const uint32_t* Rb,
+    const uint32_t* PLUS,            // [2]
+    const uint32_t* HM,              // [2]
+    const uint32_t* HRa, const uint32_t* HRb,  // [max_levels+1]
+    uint32_t* ha_out, uint32_t* hb_out,
+    int32_t* plen_out, uint32_t* plus_mask_out, uint8_t* has_hash_out) {
+    for (int32_t i = 0; i < n_filters; i++) {
+        const uint8_t* f = data + offsets[i];
+        int64_t n = offsets[i + 1] - offsets[i];
+        // split into levels
+        int32_t plen = 0;
+        uint32_t plus_mask = 0;
+        uint32_t ha = 0, hb = 0;
+        uint8_t has_hash = 0;
+        int64_t start = 0;
+        int32_t level = 0;
+        for (int64_t p = 0; p <= n; p++) {
+            if (p == n || f[p] == '/') {
+                int64_t wlen = p - start;
+                bool last = (p == n);
+                if (last && wlen == 1 && f[start] == '#') {
+                    has_hash = 1;
+                } else {
+                    if (wlen == 1 && f[start] == '+') {
+                        if (level < 32) plus_mask |= 1u << level;
+                        if (level < max_levels) {
+                            ha += (PLUS[0] ^ Ca[level]) * Ra[level];
+                            hb += (PLUS[1] ^ Cb[level]) * Rb[level];
+                        }
+                    } else if (level < max_levels) {
+                        uint64_t h = fnv1a64(f + start, (uint64_t)wlen) ^ PERTURB;
+                        ha += ((uint32_t)h ^ Ca[level]) * Ra[level];
+                        hb += ((uint32_t)(h >> 32) ^ Cb[level]) * Rb[level];
+                    }
+                    level++;
+                }
+                start = p + 1;
+            }
+        }
+        // "" splits to one empty level, which the loop above already hashed
+        plen = level;
+        if (has_hash && plen <= max_levels) {
+            ha += HM[0] * HRa[plen];
+            hb += HM[1] * HRb[plen];
+        }
+        if (ha == 0 && hb == 0) hb = 1;
+        ha_out[i] = ha;
+        hb_out[i] = hb;
+        plen_out[i] = plen;
+        plus_mask_out[i] = plus_mask;
+        has_hash_out[i] = has_hash;
+    }
+}
+
+// ------------------------------------------------------------- bulk_place
+
+// Open-addressed placement of n entries into the table arrays in place —
+// ops/tables.py MatchTables._place semantics (home bucket + PROBE-slot
+// linear window).  Returns the index of the first entry that could not be
+// placed (caller grows and retries), or n on success.
+int32_t etpu_bulk_place(
+    uint32_t* key_a, uint32_t* key_b, int32_t* val,
+    int32_t log2cap, int32_t probe,
+    const uint32_t* ha, const uint32_t* hb, const int32_t* fids,
+    int32_t n) {
+    uint32_t cap_mask = (1u << log2cap) - 1;
+    const uint32_t MIX1 = 0x85EBCA77u, MIX2 = 0x9E3779B1u;
+    for (int32_t i = 0; i < n; i++) {
+        uint32_t home = ((ha[i] + hb[i] * MIX1) * MIX2) >> (32 - log2cap);
+        bool placed = false;
+        for (int32_t off = 0; off < probe; off++) {
+            uint32_t slot = (home + (uint32_t)off) & cap_mask;
+            if (val[slot] == -1) {
+                key_a[slot] = ha[i];
+                key_b[slot] = hb[i];
+                val[slot] = fids[i];
+                placed = true;
+                break;
+            }
+        }
+        if (!placed) return i;
+    }
+    return n;
+}
+
+}  // extern "C"

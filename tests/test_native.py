@@ -155,3 +155,82 @@ def test_engine_match_uses_native_path():
     assert sets[1] == {f2}
     assert sets[2] == {f3}  # root wildcards never match $-topics
     assert sets[3] == set()
+
+
+def test_filter_keys_native_matches_python():
+    space = hashing.HashSpace(max_levels=8)
+    filters = ["a/b/c", "a/+/c", "a/#", "#", "+", "", "+/+/#",
+               "房间/+/温度", "x/y/z/#", "single"]
+    out = native.filter_keys(filters, space.max_levels, space)
+    assert out is not None
+    ha, hb, plen, plus_mask, has_hash = out
+    for i, f in enumerate(filters):
+        pha, phb, shape = space.filter_key(f.split("/"))
+        assert (int(ha[i]), int(hb[i])) == (pha, phb), f
+        assert int(plen[i]) == shape.plen, f
+        assert int(plus_mask[i]) == shape.plus_mask, f
+        assert bool(has_hash[i]) == shape.has_hash, f
+
+
+def test_bulk_insert_equals_loop_insert():
+    from emqx_tpu.ops.tables import MatchTables
+
+    space = hashing.HashSpace(max_levels=8)
+    rng = __import__("random").Random(42)
+    seen = set()
+    for i in range(2000):
+        ws = ["top", str(rng.randint(0, 50)), str(i)]
+        if rng.random() < 0.3:
+            ws[1] = "+"
+        if rng.random() < 0.1:
+            ws[-1] = "#"
+        # tables hold one entry per UNIQUE filter (engine refcounts dupes)
+        seen.add("/".join(ws))
+    filters = sorted(seen)
+
+    bulk = MatchTables(space)
+    bulk.bulk_insert(filters, list(range(len(filters))))
+    loop = MatchTables(space)
+    for i, f in enumerate(filters):
+        loop.insert(f.split("/"), i)
+
+    assert bulk.n_entries == loop.n_entries
+    assert bulk.n_shapes == loop.n_shapes
+    # identical match behavior over a topic batch
+    from emqx_tpu.ops.match import DeviceTables, match_batch, prepare_topics_raw
+
+    topics = [f"top/{i%60}/{i}" for i in range(300)] + ["top/3/#"[:-2] + "5"]
+    ba, _ = prepare_topics_raw(space, topics, 512)
+    got = np.asarray(match_batch(DeviceTables(**bulk.device_arrays()), ba))
+    want = np.asarray(match_batch(DeviceTables(**loop.device_arrays()), ba))
+    got_sets = [set(r[r >= 0].tolist()) for r in got]
+    want_sets = [set(r[r >= 0].tolist()) for r in want]
+    assert got_sets == want_sets
+
+
+def test_bulk_then_delete_then_match():
+    """Bulk-loaded tables must stay mutable through the incremental path."""
+    from emqx_tpu.models.engine import TopicMatchEngine
+
+    eng = TopicMatchEngine()
+    fids = eng.add_filters([f"b/{i}/+" for i in range(600)] + ["b/#"])
+    assert len(set(fids)) == 601
+    assert eng.match_one("b/5/x") == {eng.fid_of("b/5/+"), eng.fid_of("b/#")}
+    eng.remove_filter("b/5/+")
+    assert eng.match_one("b/5/x") == {eng.fid_of("b/#")}
+    # refcount: duplicate add then single remove keeps the filter
+    eng.add_filters(["b/6/+", "b/6/+"])
+    eng.remove_filter("b/6/+")
+    eng.remove_filter("b/6/+")
+    assert eng.fid_of("b/6/+") is not None  # one ref remains (from bulk load)
+
+
+def test_duplicate_key_runaway_raises():
+    """Duplicate filters under distinct fids can never fit one probe
+    window; the table must fail loudly, not grow forever."""
+    from emqx_tpu.ops.tables import MatchTables, PROBE
+
+    t = MatchTables(hashing.HashSpace(max_levels=8))
+    with pytest.raises(RuntimeError):
+        for fid in range(PROBE + 1):
+            t.insert(["dup", "+"], fid)

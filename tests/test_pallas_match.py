@@ -1,0 +1,74 @@
+"""Pallas hash-contraction kernel: bit parity with the XLA path."""
+
+import numpy as np
+import pytest
+
+from emqx_tpu.broker import topic as topiclib
+from emqx_tpu.models.engine import TopicMatchEngine
+from emqx_tpu.ops import hashing
+from emqx_tpu.ops.match import (
+    DeviceTables, match_batch, prepare_topics_raw,
+)
+from emqx_tpu.ops.pallas_match import (
+    match_batch_pallas, pattern_hashes_pallas,
+)
+from emqx_tpu.ops.tables import MatchTables
+
+
+def build(filters, topics, min_batch=64):
+    space = hashing.HashSpace()
+    tables = MatchTables(space)
+    for i, f in enumerate(filters):
+        tables.insert(topiclib.words(f), i)
+    dev = DeviceTables(**tables.device_arrays())
+    batch, n = prepare_topics_raw(space, topics, min_batch)
+    return dev, batch, n
+
+
+FILTERS = [
+    "a/b/c", "a/+/c", "a/#", "#", "+/b/#", "sensors/+/temp",
+    "$SYS/brokers/#", "x/y", "+/+", "deep/a/b/c/d/e/f/g",
+]
+TOPICS = [
+    "a/b/c", "a/z/c", "a/b", "sensors/3/temp", "$SYS/brokers/n0",
+    "x/y", "q/w", "deep/a/b/c/d/e/f/g", "", "a",
+]
+
+
+def test_pattern_hashes_parity():
+    dev, batch, _ = build(FILTERS, TOPICS)
+    from emqx_tpu.ops.match import pattern_hashes
+
+    want_a, want_b = pattern_hashes(dev, batch)
+    got_a, got_b = pattern_hashes_pallas(dev, batch, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_a), np.asarray(want_a))
+    np.testing.assert_array_equal(np.asarray(got_b), np.asarray(want_b))
+
+
+def test_match_batch_parity():
+    dev, batch, n = build(FILTERS, TOPICS)
+    want = np.asarray(match_batch(dev, batch))
+    got = np.asarray(match_batch_pallas(dev, batch, interpret=True))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_match_batch_parity_large_tiles():
+    """Batch/table bigger than one tile exercises the grid."""
+    filters = [f"room/{i}/+" for i in range(300)] + ["room/#"]
+    topics = [f"room/{i}/temp" for i in range(500)]
+    dev, batch, n = build(filters, topics, min_batch=512)
+    want = np.asarray(match_batch(dev, batch))
+    got = np.asarray(match_batch_pallas(dev, batch, interpret=True))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_engine_results_unchanged_by_pallas():
+    """End-to-end fid sets agree between both kernels."""
+    eng = TopicMatchEngine()
+    for f in FILTERS:
+        eng.add_filter(f)
+    dev = eng.sync_device()
+    batch, _ = prepare_topics_raw(eng.space, TOPICS, eng.min_batch)
+    xla = np.asarray(match_batch(dev, batch))
+    pls = np.asarray(match_batch_pallas(dev, batch, interpret=True))
+    np.testing.assert_array_equal(xla, pls)
