@@ -23,6 +23,7 @@ from . import topic as topiclib
 from .cm import ConnectionManager
 from .hooks import Hooks
 from .message import Message
+from ..observe.tracepoints import tp
 from .metrics import Metrics
 from .packet import SubOpts
 from .retainer import Retainer
@@ -160,6 +161,7 @@ class Broker:
                 continue
             self.retainer.on_publish(msg)
             self.metrics.inc("messages.received")
+            tp("publish_enter", topic=msg.topic, mid=msg.mid)
             todo.append((i, msg))
         return todo, results
 
@@ -172,6 +174,7 @@ class Broker:
         matched = self.engine.match([m.topic for _, m in todo])
         for (i, msg), fids in zip(todo, matched):
             n = self._dispatch(msg, fids)
+            tp("dispatch_done", topic=msg.topic, mid=msg.mid, receivers=n)
             results[i] = n
             if n == 0:
                 self.metrics.inc("messages.dropped.no_subscribers")

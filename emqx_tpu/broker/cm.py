@@ -14,6 +14,7 @@ from typing import Callable, Dict, Optional, Protocol, Tuple
 
 from .packet import ReasonCode
 from .session import Session
+from ..observe.tracepoints import tp
 
 
 class ChannelLike(Protocol):
@@ -53,6 +54,7 @@ class ConnectionManager:
         old = self.channels.get(clientid)
         if clean_start:
             if old is not None:
+                tp("session_discarded", clientid=clientid, live=True)
                 self._kick(old, ReasonCode.SESSION_TAKEN_OVER)
                 if self.on_discard:
                     # the kicked channel's terminate() skips cleanup (it
@@ -61,11 +63,15 @@ class ConnectionManager:
                     self.on_discard(old.session)
             dropped = self.pending.pop(clientid, None)
             if dropped and self.on_discard:
+                tp("session_discarded", clientid=clientid, live=False)
                 self.on_discard(dropped[0])
+            tp("session_created", clientid=clientid)
             return make_session(), False
         if old is not None:
             session = old.session
+            tp("session_takeover_begin", clientid=clientid)
             self._kick(old, ReasonCode.SESSION_TAKEN_OVER)
+            tp("session_takeover_end", clientid=clientid)
             return session, True
         ent = self.pending.pop(clientid, None)
         if ent is not None:
@@ -73,9 +79,12 @@ class ConnectionManager:
             if time.time() < expire_at or session.expiry_interval == 0xFFFFFFFF:
                 if self.on_resume:
                     self.on_resume(clientid)
+                tp("session_resumed", clientid=clientid)
                 return session, True
             if self.on_discard:
+                tp("session_discarded", clientid=clientid, live=False)
                 self.on_discard(session)
+        tp("session_created", clientid=clientid)
         return make_session(), False
 
     def _kick(self, ch: ChannelLike, rc: int) -> None:

@@ -1,0 +1,161 @@
+"""Structured trace points + causal trace assertions — snabbkaffe analog.
+
+The reference compiles `?tp(kind, #{...})` probes into prod code and
+asserts on the causal event stream in tests via `?check_trace` /
+`?strict_causality` (snabbkaffe 0.16.0; tracepoints in `emqx_cm.erl:129`,
+`emqx_connection.erl`, `emqx_persistent_session.erl`, consumed by
+`emqx_broker_SUITE`, `emqx_takeover_SUITE`, ... — SURVEY.md §4).
+
+Here `tp(kind, **fields)` is a near-zero-cost call (one global check)
+that records into the active collectors.  Tests wrap scenarios in
+`check_trace()` and assert on the ordered event list:
+
+    with check_trace() as t:
+        ...drive the broker...
+    t.assert_seen("session_takeover_begin", clientid="c1")
+    t.strict_causality("publish_enter", "dispatch_done",
+                       key=lambda e: e["msg_id"])
+
+Events double as production tracing: a long-running collector can be
+installed and drained (the `?tp` kinds also flow to logger in the
+reference via the snk_kind compile flag).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_collectors: List["TraceCollector"] = []
+_lock = threading.Lock()
+_active = False  # fast-path gate: tp() is one bool test when tracing is off
+
+
+def tp(kind: str, **fields: Any) -> None:
+    """Emit a structured trace event (no-op unless a collector is active)."""
+    if not _active:
+        return
+    evt = {"kind": kind, "ts": time.monotonic(), **fields}
+    with _lock:
+        for c in _collectors:
+            c._events.append(evt)
+
+
+class TraceAssertionError(AssertionError):
+    pass
+
+
+class TraceCollector:
+    def __init__(self):
+        self._events: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------- capture
+
+    def __enter__(self) -> "TraceCollector":
+        global _active
+        with _lock:
+            _collectors.append(self)
+            _active = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _active
+        with _lock:
+            if self in _collectors:
+                _collectors.remove(self)
+            _active = bool(_collectors)
+
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        with _lock:
+            return list(self._events)
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with _lock:
+            out, self._events = self._events, []
+            return out
+
+    # ------------------------------------------------------------- queries
+
+    def of_kind(self, kind: str) -> List[Dict[str, Any]]:
+        return [e for e in self.events if e["kind"] == kind]
+
+    def find(self, kind: str, **match: Any) -> List[Dict[str, Any]]:
+        out = []
+        for e in self.of_kind(kind):
+            if all(e.get(k) == v for k, v in match.items()):
+                out.append(e)
+        return out
+
+    # ---------------------------------------------------------- assertions
+
+    def assert_seen(self, kind: str, n: Optional[int] = None, **match: Any):
+        got = self.find(kind, **match)
+        if not got or (n is not None and len(got) != n):
+            raise TraceAssertionError(
+                f"expected {'%d×' % n if n is not None else ''} {kind!r} "
+                f"matching {match}, saw {len(got)} "
+                f"(kinds present: {sorted({e['kind'] for e in self.events})})")
+        return got
+
+    def assert_not_seen(self, kind: str, **match: Any) -> None:
+        got = self.find(kind, **match)
+        if got:
+            raise TraceAssertionError(f"unexpected {kind!r} events: {got[:3]}")
+
+    def assert_order(self, *kinds: str) -> None:
+        """The FIRST occurrence of each kind appears in the given order."""
+        firsts = []
+        for k in kinds:
+            evs = self.of_kind(k)
+            if not evs:
+                raise TraceAssertionError(f"kind {k!r} never seen")
+            firsts.append(evs[0]["ts"])
+        if firsts != sorted(firsts):
+            raise TraceAssertionError(
+                f"order violated: {list(zip(kinds, firsts))}")
+
+    def strict_causality(self, cause: str, effect: str,
+                         key: Callable[[Dict[str, Any]], Any]) -> None:
+        """?strict_causality: every `cause` has a LATER matching `effect`,
+        and no effect without a cause (matched by `key`)."""
+        causes: Dict[Any, float] = {}
+        for e in self.of_kind(cause):
+            causes.setdefault(key(e), e["ts"])
+        effects: Dict[Any, float] = {}
+        for e in self.of_kind(effect):
+            effects.setdefault(key(e), e["ts"])
+        for k, ts in causes.items():
+            if k not in effects:
+                raise TraceAssertionError(
+                    f"cause {cause!r} key={k!r} has no {effect!r}")
+            if effects[k] < ts:
+                raise TraceAssertionError(
+                    f"effect {effect!r} key={k!r} precedes its cause")
+        orphans = set(effects) - set(causes)
+        if orphans:
+            raise TraceAssertionError(
+                f"{effect!r} without {cause!r}: keys {sorted(orphans)[:5]}")
+
+    def pairs(self, open_kind: str, close_kind: str,
+              key: Callable[[Dict[str, Any]], Any]) -> None:
+        """Balanced open/close pairs (e.g. lock acquire/release)."""
+        depth: Dict[Any, int] = {}
+        for e in self.events:
+            if e["kind"] == open_kind:
+                depth[key(e)] = depth.get(key(e), 0) + 1
+            elif e["kind"] == close_kind:
+                k = key(e)
+                if depth.get(k, 0) <= 0:
+                    raise TraceAssertionError(
+                        f"{close_kind!r} key={k!r} without open")
+                depth[k] -= 1
+        bad = {k: d for k, d in depth.items() if d != 0}
+        if bad:
+            raise TraceAssertionError(f"unbalanced pairs: {bad}")
+
+
+def check_trace() -> TraceCollector:
+    """`?check_trace` entry point for tests."""
+    return TraceCollector()

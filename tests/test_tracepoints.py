@@ -1,0 +1,89 @@
+"""Structured trace-point assertions (snabbkaffe ?check_trace analog)."""
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.cm import ConnectionManager
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.session import Session
+from emqx_tpu.observe.tracepoints import (
+    TraceAssertionError, check_trace, tp,
+)
+
+
+class Chan:
+    def __init__(self, clientid, session=None):
+        self.clientid = clientid
+        self.session = session or Session(clientid=clientid)
+        self.kicked = []
+
+    def deliver(self, delivers):
+        pass
+
+    def kick(self, rc=0):
+        self.kicked.append(rc)
+
+
+def test_tp_is_noop_without_collector():
+    tp("never_recorded", x=1)  # must not raise or leak
+    with check_trace() as t:
+        tp("seen", x=2)
+    assert t.find("seen", x=2)
+    assert not t.find("never_recorded")
+
+
+def test_publish_dispatch_causality():
+    b = Broker()
+    b.subscribe("c1", "a/#", SubOpts(qos=0))
+    b.cm.register_channel(Chan("c1"))
+    with check_trace() as t:
+        b.publish(Message(topic="a/b", payload=b"x"))
+        b.publish(Message(topic="a/c", payload=b"y"))
+        b.publish(Message(topic="no/subs", payload=b"z"))
+    t.assert_seen("publish_enter", n=3)
+    # every accepted publish reaches dispatch, matched by message id
+    t.strict_causality("publish_enter", "dispatch_done",
+                       key=lambda e: e["mid"])
+    assert t.find("dispatch_done", topic="a/b")[0]["receivers"] == 1
+    assert t.find("dispatch_done", topic="no/subs")[0]["receivers"] == 0
+
+
+def test_takeover_trace():
+    cm = ConnectionManager()
+    with check_trace() as t:
+        s1, present = cm.open_session(False, "dev", lambda: Session(clientid="dev"))
+        assert not present
+        ch1 = Chan("dev", s1)
+        cm.register_channel(ch1)
+        # second connection with clean_start=False steals the session
+        s2, present = cm.open_session(False, "dev", lambda: Session(clientid="dev"))
+        assert present and s2 is s1
+    t.assert_order("session_created", "session_takeover_begin",
+                   "session_takeover_end")
+    t.pairs("session_takeover_begin", "session_takeover_end",
+            key=lambda e: e["clientid"])
+    assert ch1.kicked  # old channel was kicked during takeover
+
+
+def test_clean_start_discards():
+    cm = ConnectionManager()
+    done = []
+    cm.on_discard = lambda s: done.append(s)
+    with check_trace() as t:
+        s1, _ = cm.open_session(False, "d2", lambda: Session(clientid="d2"))
+        cm.register_channel(Chan("d2", s1))
+        cm.open_session(True, "d2", lambda: Session(clientid="d2"))
+    t.assert_seen("session_discarded", clientid="d2", live=True)
+    t.assert_not_seen("session_takeover_begin")
+
+
+def test_assertion_failures_are_loud():
+    with check_trace() as t:
+        tp("only_cause", mid=1)
+    with pytest.raises(TraceAssertionError):
+        t.assert_seen("missing_kind")
+    with pytest.raises(TraceAssertionError):
+        t.strict_causality("only_cause", "only_effect", key=lambda e: e["mid"])
+    with pytest.raises(TraceAssertionError):
+        t.assert_order("only_cause", "missing_kind")
