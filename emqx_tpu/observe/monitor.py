@@ -1,0 +1,83 @@
+"""Dashboard monitor time series — `emqx_dashboard_collection.erl` analog.
+
+The reference samples broker counters every 10s on whole-interval
+boundaries, keeps a bounded history, and serves it to the dashboard via
+`/monitor` (`emqx_dashboard_monitor_api.erl`).  Here `MonitorSampler`
+snapshots counters + gauges into a ring buffer; counter fields are
+emitted as per-interval deltas (message *rates*), gauges as levels.
+Driven by `tick()` from the housekeeping loop or an asyncio runner.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+# counter metrics sampled as deltas-per-interval
+COUNTER_FIELDS = {
+    "received": "messages.received",
+    "sent": "messages.sent",
+    "dropped": "messages.dropped",
+}
+
+
+class MonitorSampler:
+    def __init__(self, broker, interval: float = 10.0, retention: int = 360):
+        """retention=360 x 10s = 1h of samples, the reference's default
+        dashboard window."""
+        self.broker = broker
+        self.interval = interval
+        self.samples: Deque[Dict] = deque(maxlen=retention)
+        self._last_counters: Optional[Dict[str, int]] = None
+        self._next_at = self._align(time.time())
+
+    def _align(self, now: float) -> float:
+        """Whole-interval boundaries like the reference's next_interval."""
+        return now - (now % self.interval) + self.interval
+
+    def _counters(self) -> Dict[str, int]:
+        m = self.broker.metrics
+        return {k: int(m.get(v)) for k, v in COUNTER_FIELDS.items()}
+
+    def sample_now(self, ts: Optional[float] = None) -> Dict:
+        ts = time.time() if ts is None else ts
+        counters = self._counters()
+        prev = self._last_counters or counters
+        self._last_counters = counters
+        s = {
+            "time_stamp": int(ts * 1000),
+            "node": getattr(self.broker, "node", "emqx_tpu"),
+            # levels
+            "connections": self.broker.cm.connection_count,
+            "subscriptions": self.broker.subscription_count,
+            "topics": self.broker.route_count,
+            # per-interval deltas (dashboard draws rates)
+            **{k: counters[k] - prev[k] for k in counters},
+        }
+        self.samples.append(s)
+        return s
+
+    def tick(self, now: Optional[float] = None) -> Optional[Dict]:
+        now = time.time() if now is None else now
+        if now < self._next_at:
+            return None
+        self._next_at = self._align(now)
+        return self.sample_now(now)
+
+    # ---------------------------------------------------------------- api
+
+    def latest(self, n: int = 60) -> List[Dict]:
+        return list(self.samples)[-n:]
+
+    def current(self) -> Dict:
+        """`/monitor_current`: instantaneous levels + last-interval rates."""
+        last = self.samples[-1] if self.samples else {}
+        return {
+            "connections": self.broker.cm.connection_count,
+            "subscriptions": self.broker.subscription_count,
+            "topics": self.broker.route_count,
+            "received_rate": last.get("received", 0) / self.interval,
+            "sent_rate": last.get("sent", 0) / self.interval,
+            "dropped_rate": last.get("dropped", 0) / self.interval,
+        }
