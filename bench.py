@@ -11,8 +11,12 @@ publishers measure LookupRps) across the five workload configs of
   4  10M subs, Zipf-skewed publish topic distribution
   5  10M subs with 5%/sec subscribe/unsubscribe churn
 
-Default run = config 2 and prints ONE JSON line (the driver contract):
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Default run = config 2 and prints ONE JSON line (the driver contract plus
+informational extras):
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "device": "tpu", "p99_ms": N}
+
+Refuses to record a CPU number (exit != 0) unless BENCH_ALLOW_CPU=1.
 
   python bench.py --config 3        # one JSON line for config 3
   python bench.py --all             # all 5 -> BENCH_TABLE.md + headline line
@@ -26,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import sys
 import time
@@ -33,7 +38,8 @@ import time
 import numpy as np
 
 BATCH = 4096
-ITERS = 40
+ITERS = 200
+WARMUP = 5
 CPU_LOOKUPS = 3000
 
 
@@ -161,6 +167,54 @@ def cpu_baseline(filters, topics_fn):
     return cpu_insert_rps, cpu_rps
 
 
+_DEVICE = None
+
+
+def init_device():
+    """Find an accelerator, retrying init; never silently bench CPU.
+
+    Round-1's driver artifact recorded a CPU number because a transient
+    backend-init failure fell through to CPU.  Now: retry (clearing cached
+    backend errors between attempts), and if no accelerator appears, abort
+    unless BENCH_ALLOW_CPU=1 is set explicitly.
+    """
+    global _DEVICE
+    if _DEVICE is not None:
+        return _DEVICE
+    import jax
+
+    last = None
+    for attempt in range(5):
+        try:
+            for d in jax.devices():
+                if d.platform != "cpu":
+                    _DEVICE = d
+                    return d
+            last = f"only cpu devices visible: {jax.devices()}"
+        except RuntimeError as e:
+            last = e
+        log(f"accelerator init attempt {attempt + 1}/5 failed: {last}")
+        try:  # reset cached backends/errors so the retry is real (jax>=0.9)
+            from jax.extend.backend import clear_backends
+        except ImportError:
+            clear_backends = getattr(jax, "clear_backends", lambda: None)
+        try:
+            clear_backends()
+        except Exception as ce:
+            log(f"clear_backends failed: {ce}")
+        time.sleep(2 * (attempt + 1))
+    if os.environ.get("BENCH_ALLOW_CPU"):
+        log("BENCH_ALLOW_CPU=1: benchmarking CPU — NOT a TPU number")
+        jax.config.update("jax_platforms", "cpu")
+        _DEVICE = jax.devices()[0]
+        return _DEVICE
+    raise SystemExit(
+        f"no accelerator after 5 attempts ({last}); refusing to record a "
+        "CPU number as the driver benchmark (set BENCH_ALLOW_CPU=1 to "
+        "override for local runs)"
+    )
+
+
 def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     import jax
 
@@ -168,12 +222,7 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     from emqx_tpu.ops import hashing
     from emqx_tpu.ops.match import TopicBatch, match_batch_jit
 
-    try:
-        dev = jax.devices()[0]
-    except RuntimeError as e:
-        log(f"TPU backend unavailable ({e}); falling back to CPU")
-        jax.config.update("jax_platforms", "cpu")
-        dev = jax.devices()[0]
+    dev = init_device()
     log(f"device: {dev.platform} {dev}")
 
     eng = TopicMatchEngine()
@@ -203,6 +252,8 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     out = match_batch_jit(tables, batches[0])
     out.block_until_ready()
     log(f"first compile+run: {time.time()-c0:.1f}s")
+    for i in range(WARMUP):
+        match_batch_jit(tables, batches[i % n_batches]).block_until_ready()
 
     lat = []
     churn_events = 0
@@ -236,6 +287,7 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
         "p99_ms": p99_ms,
         "insert_rps": insert_rps,
         "host_hash_rps": host_hash_rps,
+        "device": dev.platform,
     }
 
 
@@ -279,6 +331,8 @@ def headline_json(n: int, stats: dict) -> str:
         "value": round(stats["tpu_rps"]),
         "unit": "lookups/sec",
         "vs_baseline": round(stats["tpu_rps"] / stats["cpu_rps"], 2),
+        "device": stats["device"],
+        "p99_ms": round(stats["p99_ms"], 3),
     })
 
 
@@ -290,6 +344,8 @@ def main() -> None:
     ap.add_argument("--subs", type=int, default=None,
                     help="cap filter count for configs 3-5")
     ns = ap.parse_args()
+
+    init_device()  # probe the accelerator BEFORE minutes of population build
 
     if not ns.all:
         stats = run_config(ns.config, ns.subs)
