@@ -194,6 +194,8 @@ def init_device():
         except RuntimeError as e:
             last = e
         log(f"accelerator init attempt {attempt + 1}/5 failed: {last}")
+        if attempt == 4:
+            break
         try:  # reset cached backends/errors so the retry is real (jax>=0.9)
             from jax.extend.backend import clear_backends
         except ImportError:

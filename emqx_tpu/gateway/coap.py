@@ -125,6 +125,15 @@ def serialize(msg: CoapMessage) -> bytes:
 
 
 def parse(data: bytes) -> CoapMessage:
+    try:
+        return _parse(data)
+    except (IndexError, struct.error) as e:
+        # truncated inside an extended option delta/length — treat the same
+        # as any other malformed datagram so callers' ValueError guard holds
+        raise ValueError(f"truncated datagram: {e}") from e
+
+
+def _parse(data: bytes) -> CoapMessage:
     if len(data) < 4:
         raise ValueError("short datagram")
     b0 = data[0]
@@ -138,6 +147,8 @@ def parse(data: bytes) -> CoapMessage:
     (msg_id,) = struct.unpack_from("!H", data, 2)
     pos = 4
     token = data[pos:pos + tkl]
+    if len(token) != tkl:
+        raise ValueError("short token")
     pos += tkl
     options: List[Tuple[int, bytes]] = []
     num = 0
@@ -416,9 +427,17 @@ class CoapGateway(asyncio.DatagramProtocol):
         an incrementing Observe sequence (RFC 7641)."""
         entry = client.observes.get(filt)
         if entry is None:
-            # subscription made via another filter form; best-effort match
-            if client.observes:
-                filt, entry = next(iter(client.observes.items()))
+            # subscription made via another filter form: attribute the
+            # notification to an observe entry whose filter matches the
+            # delivered topic (RFC 7641 tokens are per-registration; never
+            # borrow an unrelated registration's token/sequence)
+            from ..broker import topic as topiclib
+
+            name = topiclib.words(msg.topic)
+            for ofilt in client.observes:
+                if topiclib.match_words(name, topiclib.words(ofilt)):
+                    filt, entry = ofilt, client.observes[ofilt]
+                    break
             else:
                 return
         token, seq = entry

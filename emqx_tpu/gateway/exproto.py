@@ -177,6 +177,10 @@ class ExProtoGateway:
                     "conn": conn_id,
                     "bytes": base64.b64encode(data).decode(),
                 })
+                # backpressure: a fast device must not grow the handler
+                # writer's buffer without bound — pause this read loop until
+                # the handler drains below its high-water mark
+                await self._handler_drain()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -208,6 +212,16 @@ class ExProtoGateway:
             w.write(pack({"stream": stream, "data": data}))
         except Exception:
             log.exception("exproto: emit failed")
+
+    async def _handler_drain(self) -> None:
+        """Await the handler writer's flow control (no-op when absent)."""
+        w = self._handler_writer
+        if w is None or w.is_closing():
+            return
+        try:
+            await w.drain()
+        except ConnectionError:
+            pass
 
     async def _on_handler(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
@@ -254,8 +268,17 @@ class ExProtoGateway:
             code, message = UNKNOWN, str(e)
         return {"id": rid, "code": code, "message": message}
 
+    # a slow device past this much buffered outbound data is dropped rather
+    # than buffering without bound (the handler RPC loop must stay sync)
+    DEVICE_HIGH_WATER = 1 << 20
+
     def _rpc_send(self, conn: ExProtoConn, params: dict):
         data = base64.b64decode(params["bytes"])
+        transport = conn.writer.transport
+        if (transport.get_write_buffer_size() + len(data)
+                > self.DEVICE_HIGH_WATER):
+            self.close_conn(conn, reason="send_buffer_overflow")
+            return CONN_PROCESS_NOT_ALIVE, "device send buffer overflow"
         conn.writer.write(data)
         return SUCCESS, ""
 

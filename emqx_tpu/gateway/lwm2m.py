@@ -328,10 +328,6 @@ class Lwm2mGateway(asyncio.DatagramProtocol):
         if not endpoint:
             self._reply(addr, msg, BAD_REQUEST)
             return
-        old = self.by_addr.get(addr)
-        if old is not None and old.connected:
-            self.ctx.close_session(old)
-            self.drop_endpoint(old)
         loc = str(self._next_loc)
         self._next_loc += 1
         ep = Lwm2mEndpoint(addr, endpoint, loc)
@@ -346,9 +342,15 @@ class Lwm2mGateway(asyncio.DatagramProtocol):
         ci = ClientInfo(clientid=endpoint, username=q.get("imei") or endpoint,
                         peerhost=addr[0], protocol="lwm2m")
         ep.clientinfo = ci
+        # authenticate BEFORE touching any existing registration: a failing
+        # (spoofable-UDP) register attempt must not tear down a live session
         if not self.ctx.authenticate(ci):
             self._reply(addr, msg, UNAUTHORIZED)
             return
+        old = self.by_addr.get(addr)
+        if old is not None and old.connected:
+            self.ctx.close_session(old)
+            self.drop_endpoint(old)
         self.ctx.open_session(True, ci, ep)
         ep.connected = True
         self.by_addr[addr] = ep

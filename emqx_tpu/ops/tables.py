@@ -281,6 +281,25 @@ class MatchTables:
                          dtype=np.uint32, count=n)
         hb = np.fromiter((e[1] for e in self._entries.values()),
                          dtype=np.uint32, count=n)
+
+        worst_dup = -1  # computed lazily, once per rebuild (keys are fixed)
+
+        def _check_duplicate_keys() -> None:
+            # >PROBE entries sharing one (ha,hb) key rehash to one home at
+            # every capacity, so growing can never place them — fail fast
+            # instead of doubling to MAX_LOG2CAP (~12 GiB of arrays)
+            nonlocal worst_dup
+            if worst_dup < 0:
+                keys = ((ha.astype(np.uint64) << np.uint64(32))
+                        | hb.astype(np.uint64))
+                _, counts = np.unique(keys, return_counts=True)
+                worst_dup = int(counts.max()) if counts.size else 0
+            if worst_dup > PROBE:
+                raise RuntimeError(
+                    "duplicate filter key appears %d times (> probe window "
+                    "%d) — callers must refcount per unique filter "
+                    "(models/engine.py)" % (worst_dup, PROBE))
+
         while True:
             cap = 1 << self.log2cap
             self.key_a = np.zeros(cap, dtype=np.uint32)
@@ -303,12 +322,14 @@ class MatchTables:
                             raise GrowNeeded
                     break
                 except GrowNeeded:
+                    _check_duplicate_keys()
                     self.log2cap += 1
                     if self.log2cap > MAX_LOG2CAP:
                         raise RuntimeError("match-table growth runaway")
                     continue
             if r == n:
                 break
+            _check_duplicate_keys()
             self.log2cap += 1
             if self.log2cap > MAX_LOG2CAP:
                 raise RuntimeError("match-table growth runaway")

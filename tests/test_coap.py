@@ -54,6 +54,21 @@ def test_codec_rejects_garbage():
         parse(b"\xff\x01\x00\x00")  # bad version
 
 
+def test_codec_rejects_truncated_as_valueerror():
+    # every truncation of a valid datagram must raise ValueError (not
+    # IndexError/struct.error), or malformed UDP escapes the gateway guard
+    msg = CoapMessage(NON, GET, 7, b"tok", options=[(500, b"x" * 300)])
+    wire = serialize(msg)
+    for cut in range(1, len(wire)):
+        try:
+            parse(wire[:cut])
+        except ValueError:
+            pass
+    # token longer than the remaining bytes
+    with pytest.raises(ValueError):
+        parse(bytes([0x48, 0x01, 0x00, 0x01, 0x61]))  # tkl=8, 1 byte left
+
+
 # --------------------------------------------------------------- client
 
 class CoapTestClient(asyncio.DatagramProtocol):

@@ -168,3 +168,19 @@ def test_cpu_trie_matches_oracle():
     for _ in range(100):
         t = _rand_topic(rng)
         assert trie2.match(t) == ref.match(t)
+
+
+def test_bulk_rebuild_duplicate_key_fast_fail():
+    """>PROBE entries sharing one filter key can never place at any
+    capacity; _rebuild must fail fast instead of doubling toward
+    MAX_LOG2CAP (multi-GiB allocations)."""
+    from emqx_tpu.ops import hashing
+    from emqx_tpu.ops.tables import MatchTables, PROBE
+
+    space = hashing.HashSpace(max_levels=8)
+    t = MatchTables(space, log2cap=8, desc_cap=8)
+    # >=512 uniques forces the native bulk path + _rebuild when available
+    filters = [f"u/{i}" for i in range(600)] + ["a/b"] * (PROBE + 2)
+    with pytest.raises(RuntimeError, match="refcount per unique filter"):
+        t.bulk_insert(filters, list(range(len(filters))))
+    assert t.log2cap <= 12  # fast-fail happened before growth runaway
