@@ -63,6 +63,11 @@ class Channel:
         self.state = IDLE
         self.peername = peername
         self.conn_mod = conn_mod
+        # peer TLS cert subject (cn/dn) set by a TLS listener before CONNECT;
+        # cert_as_* mirror the listener's peer_cert_as_username/clientid opts
+        self.peer_cert: Dict[str, str] = {}
+        self.cert_as_username: Optional[str] = None
+        self.cert_as_clientid: Optional[str] = None
 
         self.clientinfo = ClientInfo(peerhost=peername)
         self.session: Optional[Session] = None
@@ -148,6 +153,10 @@ class Channel:
         self.keepalive = p.keepalive
 
         clientid = p.clientid
+        # TLS listeners may mint identity from the verified peer cert
+        # (reference: peer_cert_as_clientid/username, esockd_peercert)
+        if self.cert_as_clientid and self.peer_cert.get(self.cert_as_clientid):
+            clientid = self.peer_cert[self.cert_as_clientid]
         if len(clientid) > self.cfg.max_clientid_len:
             return self._connack_fail(ReasonCode.CLIENT_IDENTIFIER_NOT_VALID)
         assigned = False
@@ -168,14 +177,19 @@ class Channel:
         else:
             self.expiry_interval = 0 if p.clean_start else self.cfg.max_session_expiry
 
+        username = p.username
+        if self.cert_as_username and self.peer_cert.get(self.cert_as_username):
+            username = self.peer_cert[self.cert_as_username]
         self.clientinfo = ClientInfo(
             clientid=clientid,
-            username=p.username,
+            username=username,
             password=p.password,
             peerhost=self.peername,
             proto_ver=p.proto_ver,
             mountpoint=self.cfg.mountpoint,
         )
+        if self.peer_cert:
+            self.clientinfo.attrs["peer_cert"] = dict(self.peer_cert)
 
         auth = self.access.authenticate(self.clientinfo)
         if auth.get("result") != ALLOW:
@@ -207,7 +221,7 @@ class Channel:
                 qos=p.will_qos,
                 retain=p.will_retain,
                 from_client=clientid,
-                from_username=p.username,
+                from_username=username,
                 properties=dict(p.will_props),
             )
 

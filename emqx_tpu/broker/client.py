@@ -9,6 +9,7 @@ directions, wills, and properties.
 from __future__ import annotations
 
 import asyncio
+import ssl
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -60,13 +61,20 @@ class MqttClient:
     # ------------------------------------------------------------ connect
 
     async def connect(self, host: str = "127.0.0.1", port: int = 1883,
-                      streams=None) -> pkt.Connack:
+                      streams=None, ssl=None, server_hostname=None) -> pkt.Connack:
         """`streams=(reader, writer)` runs MQTT over a pre-established
-        transport (e.g. a WebSocket adapter) instead of dialing TCP."""
+        transport (e.g. a WebSocket adapter) instead of dialing TCP.
+        `ssl` takes an SSLContext (see tls.make_client_context) for mqtts."""
         if streams is not None:
             self._reader, self._writer = streams
         else:
-            self._reader, self._writer = await asyncio.open_connection(host, port)
+            kw = {}
+            if ssl is not None:
+                kw["ssl"] = ssl
+                kw["server_hostname"] = server_hostname or host
+            self._reader, self._writer = await asyncio.open_connection(
+                host, port, **kw
+            )
         self._parser = Parser(version=self.proto_ver)
         c = pkt.Connect(
             proto_name="MQIsdp" if self.proto_ver == 3 else "MQTT",
@@ -112,7 +120,9 @@ class MqttClient:
                     break
                 for p in self._parser.feed(data):
                     await self._handle(p)
-        except (FrameError, ConnectionResetError, asyncio.CancelledError):
+        except (FrameError, ConnectionResetError, asyncio.CancelledError,
+                ssl.SSLError):
+            # SSLError: server dropped a TLS transport without close_notify
             pass
         finally:
             self._connected.set()  # unblock connect() on immediate close

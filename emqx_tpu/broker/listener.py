@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import ssl
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -155,7 +156,9 @@ class Connection:
                     if self._closing is not None:
                         break
                 await self._drain()
-        except (ConnectionResetError, BrokenPipeError):
+        except (ConnectionResetError, BrokenPipeError, ssl.SSLError):
+            # SSLError: malformed records / close_notify races on a TLS
+            # listener must drop the connection, not poison the event loop
             self._normal = False
         finally:
             await self._shutdown()
@@ -214,6 +217,8 @@ class Listener:
         housekeeping_interval: float = 1.0,
         limiter=None,
         olp=None,
+        tls=None,  # TlsConfig: terminate TLS on this listener (ssl type)
+        psk_store=None,  # PskStore wired into the TLS handshake (3.13+)
     ):
         self.broker = broker
         self.host = host
@@ -224,13 +229,26 @@ class Listener:
         self.housekeeping_interval = housekeeping_interval
         self.limiter = limiter
         self.olp = olp
+        self.tls = tls
+        self.psk_store = psk_store
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
         self._hk_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
+        ssl_ctx = None
+        handshake_timeout = None
+        if self.tls is not None:
+            from .tls import make_server_context
+
+            ssl_ctx = make_server_context(self.tls, self.psk_store)
+            handshake_timeout = self.tls.handshake_timeout
         self._server = await asyncio.start_server(
-            self._on_client, self.host, self.port
+            self._on_client,
+            self.host,
+            self.port,
+            ssl=ssl_ctx,
+            ssl_handshake_timeout=handshake_timeout,
         )
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]  # resolve port 0
@@ -304,6 +322,7 @@ class Listener:
         conn = Connection(
             self.broker, reader, writer, self.config, limiter=self.limiter
         )
+        self._attach_tls_identity(conn, writer)
         if self.batcher is not None:
             conn.channel.publish_fn = self.batcher.submit
         task = asyncio.current_task()
@@ -312,6 +331,19 @@ class Listener:
             await conn.run()
         finally:
             self._conns.discard(task)
+
+    def _attach_tls_identity(self, conn: Connection, writer) -> None:
+        """Expose the verified peer cert (and the listener's cert-as-identity
+        options) to the channel; shared by the TCP and WS listener paths."""
+        if self.tls is None:
+            return
+        from .tls import peer_cert_info
+
+        conn.channel.peer_cert = peer_cert_info(
+            writer.get_extra_info("ssl_object")
+        )
+        conn.channel.cert_as_username = self.tls.peer_cert_as_username
+        conn.channel.cert_as_clientid = self.tls.peer_cert_as_clientid
 
     async def stop(self) -> None:
         getattr(self.broker, "_listeners", set()).discard(self)

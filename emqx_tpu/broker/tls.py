@@ -1,0 +1,206 @@
+"""TLS termination for listeners — `emqx_tls_lib.erl` / ssl_opts analog.
+
+The reference treats `ssl` as a first-class listener type: esockd opens
+the socket with an `ssl_options` proplist built by `emqx_tls_lib.erl`
+from the schema's ssl_opts fields (certfile/keyfile/cacertfile/verify/
+fail_if_no_peer_cert/versions/ciphers, `emqx_schema.erl` common_ssl_opts)
+and TLS-PSK callbacks come from `emqx_tls_psk.erl`.  Here the same
+surface maps onto `ssl.SSLContext`:
+
+- `TlsConfig` is the typed schema for one listener's ssl options.
+- `make_server_context` builds the context, including SNI-based cert
+  switching (one nested TlsConfig per hostname) and ALPN.
+- TLS-PSK wires `PskStore.lookup` into
+  `SSLContext.set_psk_server_callback` when the runtime provides it
+  (CPython 3.13+); on 3.12 the store still serves authn/gateway lookups
+  and `psk_supported()` reports the gap instead of failing silently.
+- `peer_cert_info` extracts the client cert CN/DN after the handshake so
+  listeners can implement the reference's `peer_cert_as_username` /
+  `peer_cert_as_clientid` options (`emqx_channel.erl` maybe_username).
+"""
+
+from __future__ import annotations
+
+import ssl
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+#: verify modes, matching the reference's `verify` enum
+VERIFY_NONE = "verify_none"
+VERIFY_PEER = "verify_peer"
+
+_TLS_VERSIONS = {
+    "tlsv1.2": ssl.TLSVersion.TLSv1_2,
+    "tlsv1.3": ssl.TLSVersion.TLSv1_3,
+}
+
+
+def psk_supported() -> bool:
+    """True when the ssl runtime can terminate TLS-PSK handshakes."""
+    return hasattr(ssl.SSLContext, "set_psk_server_callback")
+
+
+@dataclass
+class TlsConfig:
+    """One listener's ssl options (`emqx_schema.erl` common_ssl_opts)."""
+
+    certfile: Optional[str] = None
+    keyfile: Optional[str] = None
+    cacertfile: Optional[str] = None
+    key_password: Optional[str] = None
+    verify: str = VERIFY_NONE
+    fail_if_no_peer_cert: bool = False
+    versions: List[str] = field(default_factory=lambda: ["tlsv1.2", "tlsv1.3"])
+    ciphers: Optional[str] = None  # OpenSSL cipher string (TLS<=1.2 suites)
+    alpn_protocols: List[str] = field(default_factory=list)
+    handshake_timeout: float = 15.0
+    #: hostname -> TlsConfig carrying that vhost's cert/key (SNI)
+    sni_hosts: Dict[str, "TlsConfig"] = field(default_factory=dict)
+    #: enable TLS-PSK (requires runtime support; see psk_supported())
+    enable_psk: bool = False
+    psk_identity_hint: str = "emqx_psk_hint"
+    #: derive username/clientid from the peer cert (cn or dn)
+    peer_cert_as_username: Optional[str] = None  # "cn" | "dn"
+    peer_cert_as_clientid: Optional[str] = None  # "cn" | "dn"
+
+
+def _apply_common(ctx: ssl.SSLContext, cfg: TlsConfig) -> None:
+    unknown = [v for v in cfg.versions if v not in _TLS_VERSIONS]
+    if unknown:
+        raise ValueError(
+            f"unsupported TLS versions {unknown}; "
+            f"supported: {sorted(_TLS_VERSIONS)}"
+        )
+    versions = [_TLS_VERSIONS[v] for v in cfg.versions] or list(
+        _TLS_VERSIONS.values()
+    )
+    ctx.minimum_version = min(versions)
+    ctx.maximum_version = max(versions)
+    if cfg.ciphers:
+        ctx.set_ciphers(cfg.ciphers)
+    if cfg.certfile:
+        ctx.load_cert_chain(
+            cfg.certfile, cfg.keyfile or None, password=cfg.key_password
+        )
+    if cfg.cacertfile:
+        ctx.load_verify_locations(cafile=cfg.cacertfile)
+
+
+def make_server_context(
+    cfg: TlsConfig, psk_store=None
+) -> ssl.SSLContext:
+    """Build the listener-side SSLContext (`emqx_tls_lib:server_ssl_opts`)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    _apply_common(ctx, cfg)
+    if cfg.verify == VERIFY_PEER:
+        # CERT_REQUIRED aborts the handshake when no cert is presented;
+        # CERT_OPTIONAL verifies one if offered (fail_if_no_peer_cert=false)
+        ctx.verify_mode = (
+            ssl.CERT_REQUIRED if cfg.fail_if_no_peer_cert else ssl.CERT_OPTIONAL
+        )
+    else:
+        ctx.verify_mode = ssl.CERT_NONE
+    if cfg.alpn_protocols:
+        ctx.set_alpn_protocols(cfg.alpn_protocols)
+    if cfg.sni_hosts:
+        # SSL_set_SSL_CTX (what `sock.context = ...` does mid-handshake)
+        # swaps the certificate but NOT the connection's verify mode, so a
+        # stricter verify on a vhost entry would be silently unenforced —
+        # reject such configs instead of shipping an authentication bypass.
+        for name, sub in cfg.sni_hosts.items():
+            if (
+                sub.verify != cfg.verify
+                or sub.fail_if_no_peer_cert != cfg.fail_if_no_peer_cert
+                or (sub.cacertfile or None) not in (None, cfg.cacertfile)
+            ):
+                raise ValueError(
+                    f"sni_hosts[{name!r}]: verify/fail_if_no_peer_cert/"
+                    "cacertfile must match the listener config — peer "
+                    "verification is handshake-wide, only certs can vary "
+                    "per SNI name"
+                )
+        per_host = {
+            name: make_server_context(sub, psk_store)
+            for name, sub in cfg.sni_hosts.items()
+        }
+
+        def _sni_cb(sock, server_name, _ctx):
+            chosen = per_host.get(server_name)
+            if chosen is not None:
+                sock.context = chosen
+            return None  # default cert serves unknown names
+
+        ctx.sni_callback = _sni_cb
+    if cfg.enable_psk:
+        if psk_store is None:
+            raise ValueError(
+                "enable_psk=True requires a PskStore (Listener(psk_store=...))"
+            )
+        if not psk_supported():
+            raise RuntimeError(
+                "TLS-PSK requires ssl.SSLContext.set_psk_server_callback "
+                "(CPython >= 3.13); gate enable_psk on tls.psk_supported()"
+            )
+        ctx.set_psk_server_callback(
+            psk_store.ssl_callback(), cfg.psk_identity_hint
+        )
+        # PSK key exchange needs PSK-capable TLS1.2 suites alongside certs
+        if not cfg.ciphers:
+            ctx.set_ciphers("ALL:PSK")
+    return ctx
+
+
+def make_client_context(
+    cacertfile: Optional[str] = None,
+    certfile: Optional[str] = None,
+    keyfile: Optional[str] = None,
+    verify: bool = True,
+    alpn_protocols: Optional[List[str]] = None,
+) -> ssl.SSLContext:
+    """Client-side context for bridges/tests (`emqx_tls_lib:client_ssl_opts`)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if cacertfile:
+        ctx.load_verify_locations(cafile=cacertfile)
+    if not verify:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    if certfile:
+        ctx.load_cert_chain(certfile, keyfile or None)
+    if alpn_protocols:
+        ctx.set_alpn_protocols(alpn_protocols)
+    return ctx
+
+
+def _rdn_str(rdns) -> str:
+    """Flatten getpeercert()'s RDN tuples into an RFC 4514-ish string."""
+    parts = []
+    for rdn in rdns:
+        for key, value in rdn:
+            parts.append(f"{key}={value}")
+    return ",".join(parts)
+
+
+def peer_cert_info(ssl_object) -> Dict[str, str]:
+    """Extract cn/dn from the peer certificate after the handshake.
+
+    Feeds `peer_cert_as_username`/`peer_cert_as_clientid`: the reference
+    resolves these against the cert subject in `esockd_peercert` and
+    stores them in the client's conninfo.
+    """
+    info: Dict[str, str] = {}
+    if ssl_object is None:
+        return info
+    try:
+        cert = ssl_object.getpeercert()
+    except Exception:
+        return info
+    if not cert:
+        return info
+    subject = cert.get("subject", ())
+    for rdn in subject:
+        for key, value in rdn:
+            if key == "commonName" and "cn" not in info:
+                info["cn"] = value
+    if subject:
+        info["dn"] = _rdn_str(subject)
+    return info

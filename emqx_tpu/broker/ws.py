@@ -18,6 +18,7 @@ import base64
 import hashlib
 import logging
 import os
+import ssl
 import struct
 from typing import Optional, Tuple
 
@@ -132,7 +133,8 @@ class WsReader:
                     break
                 # pongs ignored
         except (asyncio.IncompleteReadError, ConnectionError,
-                asyncio.CancelledError):
+                asyncio.CancelledError, ssl.SSLError):
+            # SSLError: close_notify teardown races on a wss transport
             pass
         except FrameTooLarge as e:
             log.warning("ws: dropping connection, frame too large (%s bytes)", e)
@@ -208,6 +210,8 @@ class WsListener(Listener):
         ws_writer = WsWriter(writer)
         conn = Connection(self.broker, ws_reader, ws_writer, self.config,
                           limiter=self.limiter)
+        # wss: TLS terminated below the WS framing, cert on the raw writer
+        self._attach_tls_identity(conn, writer)
         if self.batcher is not None:
             conn.channel.publish_fn = self.batcher.submit
         task = asyncio.current_task()
@@ -259,10 +263,14 @@ class WsListener(Listener):
         return True
 
 
-async def ws_connect(host: str, port: int, path: str = "/mqtt"
-                     ) -> Tuple[WsReader, "WsClientWriter"]:
+async def ws_connect(host: str, port: int, path: str = "/mqtt", ssl=None,
+                     server_hostname=None) -> Tuple[WsReader, "WsClientWriter"]:
     """Client-side handshake + masked-frame adapters (test harness)."""
-    reader, writer = await asyncio.open_connection(host, port)
+    kw = {}
+    if ssl is not None:
+        kw["ssl"] = ssl
+        kw["server_hostname"] = server_hostname or host
+    reader, writer = await asyncio.open_connection(host, port, **kw)
     key = base64.b64encode(os.urandom(16)).decode()
     writer.write(
         (

@@ -1,0 +1,293 @@
+"""TLS listener end-to-end: certs, mutual auth, SNI, ALPN, cert-derived
+identity.  Reference surface: emqx_listeners.erl ssl type (:227-233) +
+emqx_schema common_ssl_opts + esockd_peercert username/clientid mapping.
+"""
+
+import asyncio
+import ssl
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.broker.tls import (
+    TlsConfig,
+    VERIFY_PEER,
+    make_client_context,
+    make_server_context,
+    psk_supported,
+)
+
+from tls_certs import CertKit
+
+
+@pytest.fixture(scope="module")
+def kit(tmp_path_factory):
+    return CertKit(str(tmp_path_factory.mktemp("certs")))
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+async def start_tls_broker(kit, **tls_kw):
+    cert, key = kit.issue("localhost", "server")
+    cfg = TlsConfig(certfile=cert, keyfile=key, cacertfile=kit.ca_path, **tls_kw)
+    broker = Broker()
+    lst = Listener(broker, port=0, tls=cfg)
+    await lst.start()
+    return broker, lst
+
+
+def test_mqtts_pub_sub(kit, run):
+    async def main():
+        broker, lst = await start_tls_broker(kit)
+        ctx = make_client_context(cacertfile=kit.ca_path)
+        sub = MqttClient(clientid="tls-sub")
+        await sub.connect(host="localhost", port=lst.port, ssl=ctx)
+        assert (await sub.subscribe("s/#", qos=1)) == [1]
+        pub = MqttClient(clientid="tls-pub")
+        await pub.connect(host="localhost", port=lst.port, ssl=ctx)
+        await pub.publish("s/1", b"over-tls", qos=1)
+        m = await sub.recv()
+        assert (m.topic, m.payload) == ("s/1", b"over-tls")
+        await pub.disconnect()
+        await sub.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_plaintext_client_rejected_on_tls_port(kit, run):
+    async def main():
+        broker, lst = await start_tls_broker(kit)
+        c = MqttClient(clientid="plain")
+        # server aborts the handshake; client sees EOF (no CONNACK) or reset
+        with pytest.raises((ConnectionError, OSError, AssertionError)):
+            await asyncio.wait_for(c.connect(port=lst.port), 5)
+        await lst.stop()
+
+    run(main())
+
+
+def test_untrusted_server_cert_rejected(kit, run, tmp_path):
+    async def main():
+        broker, lst = await start_tls_broker(kit)
+        other = CertKit(str(tmp_path))  # client trusts a different CA
+        ctx = make_client_context(cacertfile=other.ca_path)
+        c = MqttClient(clientid="strict")
+        with pytest.raises(ssl.SSLError):
+            await c.connect(host="localhost", port=lst.port, ssl=ctx)
+        await lst.stop()
+
+    run(main())
+
+
+def test_mutual_tls_requires_client_cert(kit, run):
+    async def main():
+        broker, lst = await start_tls_broker(
+            kit, verify=VERIFY_PEER, fail_if_no_peer_cert=True
+        )
+        # no client cert -> handshake aborted
+        bare = make_client_context(cacertfile=kit.ca_path)
+        c = MqttClient(clientid="nocert")
+        # TLS1.3: the server's cert-required alert can land after the client
+        # finished its handshake, surfacing as EOF (no CONNACK) instead
+        with pytest.raises((ssl.SSLError, ConnectionError, OSError, AssertionError)):
+            await c.connect(host="localhost", port=lst.port, ssl=bare)
+            await c.disconnect()
+        # with a CA-signed client cert -> accepted
+        ccert, ckey = kit.issue("device-7", "client7", server=False)
+        ctx = make_client_context(
+            cacertfile=kit.ca_path, certfile=ccert, keyfile=ckey
+        )
+        ok = MqttClient(clientid="withcert")
+        ack = await ok.connect(host="localhost", port=lst.port, ssl=ctx)
+        assert ack.reason_code == 0
+        await ok.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_peer_cert_as_username(kit, run):
+    async def main():
+        broker, lst = await start_tls_broker(
+            kit,
+            verify=VERIFY_PEER,
+            fail_if_no_peer_cert=True,
+            peer_cert_as_username="cn",
+        )
+        ccert, ckey = kit.issue("sensor-42", "client42", server=False)
+        ctx = make_client_context(
+            cacertfile=kit.ca_path, certfile=ccert, keyfile=ckey
+        )
+        c = MqttClient(clientid="certid")
+        await c.connect(host="localhost", port=lst.port, ssl=ctx)
+        ch = broker.cm.channels["certid"]
+        assert ch.clientinfo.username == "sensor-42"
+        assert ch.clientinfo.attrs["peer_cert"]["cn"] == "sensor-42"
+        await c.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_sni_selects_per_host_cert(kit, run):
+    async def main():
+        cert_a, key_a = kit.issue("a.example", "sni-a")
+        cert_b, key_b = kit.issue("b.example", "sni-b")
+        cfg = TlsConfig(
+            certfile=cert_a,
+            keyfile=key_a,
+            sni_hosts={"b.example": TlsConfig(certfile=cert_b, keyfile=key_b)},
+        )
+        broker = Broker()
+        lst = Listener(broker, port=0, tls=cfg)
+        await lst.start()
+
+        async def handshake_cn(server_name):
+            ctx = make_client_context(cacertfile=kit.ca_path, verify=False)
+            r, w = await asyncio.open_connection(
+                "127.0.0.1", lst.port, ssl=ctx, server_hostname=server_name
+            )
+            der = w.get_extra_info("ssl_object").getpeercert(True)
+            w.close()
+            from cryptography import x509
+
+            cert = x509.load_der_x509_certificate(der)
+            return cert.subject.rfc4514_string()
+
+        assert "a.example" in await handshake_cn("a.example")
+        assert "b.example" in await handshake_cn("b.example")
+        assert "a.example" in await handshake_cn("unknown.example")  # default
+        await lst.stop()
+
+    run(main())
+
+
+def test_alpn_negotiation(kit, run):
+    async def main():
+        broker, lst = await start_tls_broker(kit, alpn_protocols=["mqtt"])
+        ctx = make_client_context(cacertfile=kit.ca_path, alpn_protocols=["mqtt"])
+        c = MqttClient(clientid="alpn")
+        await c.connect(host="localhost", port=lst.port, ssl=ctx)
+        proto = c._writer.get_extra_info("ssl_object").selected_alpn_protocol()
+        assert proto == "mqtt"
+        await c.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_wss_pub_sub(kit, run):
+    """TLS below the WebSocket framing (wss listener type)."""
+
+    async def main():
+        from emqx_tpu.broker.ws import WsListener, ws_connect
+
+        cert, key = kit.issue("localhost", "wss-server")
+        cfg = TlsConfig(certfile=cert, keyfile=key)
+        broker = Broker()
+        lst = WsListener(broker, port=0, tls=cfg)
+        await lst.start()
+        ctx = make_client_context(cacertfile=kit.ca_path)
+        streams = await ws_connect("localhost", lst.port, ssl=ctx)
+        c = MqttClient(clientid="wss-c")
+        await c.connect(streams=streams)
+        await c.subscribe("w/1")
+        await c.publish("w/1", b"wss-bytes", qos=1)
+        m = await c.recv()
+        assert m.payload == b"wss-bytes"
+        await c.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_psk_gated_on_runtime():
+    """On 3.12 enable_psk must fail loudly, never silently downgrade."""
+    from emqx_tpu.psk import PskStore
+
+    store = PskStore()
+    store._entries["dev1"] = b"secret"
+    cfg = TlsConfig(enable_psk=True)
+    # missing store must be a config-time error regardless of runtime
+    with pytest.raises(ValueError, match="PskStore"):
+        make_server_context(cfg, None)
+    if psk_supported():
+        ctx = make_server_context(cfg, store)
+        assert ctx is not None
+    else:
+        with pytest.raises(RuntimeError, match="3.13"):
+            make_server_context(cfg, store)
+
+
+def test_tls_versions_clamped(kit):
+    cert, key = kit.issue("localhost", "vclamp")
+    cfg = TlsConfig(certfile=cert, keyfile=key, versions=["tlsv1.3"])
+    ctx = make_server_context(cfg)
+    assert ctx.minimum_version == ssl.TLSVersion.TLSv1_3
+
+
+def test_unknown_tls_version_rejected(kit):
+    cert, key = kit.issue("localhost", "vbad")
+    cfg = TlsConfig(certfile=cert, keyfile=key, versions=["tlsv1.1"])
+    with pytest.raises(ValueError, match="unsupported TLS versions"):
+        make_server_context(cfg)
+
+
+def test_sni_cannot_escalate_verify(kit):
+    """Per-SNI verify would be silently unenforced (SSL_set_SSL_CTX keeps
+    the connection's verify mode) — the config must be rejected."""
+    cert, key = kit.issue("localhost", "snk")
+    cfg = TlsConfig(
+        certfile=cert,
+        keyfile=key,
+        sni_hosts={
+            "strict.example": TlsConfig(
+                certfile=cert,
+                keyfile=key,
+                cacertfile=kit.ca_path,
+                verify=VERIFY_PEER,
+                fail_if_no_peer_cert=True,
+            )
+        },
+    )
+    with pytest.raises(ValueError, match="handshake-wide"):
+        make_server_context(cfg)
+
+
+def test_will_uses_cert_derived_username(kit, run):
+    """The will must carry the authenticated identity, not the raw
+    client-chosen CONNECT username."""
+
+    async def main():
+        broker, lst = await start_tls_broker(
+            kit,
+            verify=VERIFY_PEER,
+            fail_if_no_peer_cert=True,
+            peer_cert_as_username="cn",
+        )
+        ccert, ckey = kit.issue("will-sensor", "willc", server=False)
+        ctx = make_client_context(
+            cacertfile=kit.ca_path, certfile=ccert, keyfile=ckey
+        )
+        obs = MqttClient(clientid="will-obs")
+        await obs.connect(host="localhost", port=lst.port, ssl=ctx)
+        await obs.subscribe("will/t")
+        w = MqttClient(clientid="will-w", username="admin")
+        w.will = ("will/t", b"gone", 0, False)
+        await w.connect(host="localhost", port=lst.port, ssl=ctx)
+        assert broker.cm.channels["will-w"].will_msg.from_username == "will-sensor"
+        await w.close()  # abnormal close fires the will
+        m = await obs.recv()
+        assert m.payload == b"gone"
+        await obs.disconnect()
+        await lst.stop()
+
+    run(main())

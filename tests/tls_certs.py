@@ -1,0 +1,95 @@
+"""Test-only X.509 material, generated once per test session.
+
+The reference ships static PEM fixtures (apps/emqx/etc/certs); here the
+`cryptography` package mints a CA, server certs (SAN: localhost /
+127.0.0.1), and a client cert on demand so tests never carry key files
+in-tree.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+_ONE_DAY = datetime.timedelta(days=1)
+
+
+def _name(cn: str) -> x509.Name:
+    return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+
+def _key():
+    # EC keys: fast to generate, keeps the per-session fixture cheap
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _write_pair(dirpath, stem, cert, key):
+    cert_path = os.path.join(dirpath, f"{stem}.crt")
+    key_path = os.path.join(dirpath, f"{stem}.key")
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            )
+        )
+    return cert_path, key_path
+
+
+class CertKit:
+    """CA + helpers to issue server/client certs under a temp dir."""
+
+    def __init__(self, dirpath: str):
+        self.dir = dirpath
+        self.ca_key = _key()
+        now = datetime.datetime.now(datetime.timezone.utc)
+        self.ca_cert = (
+            x509.CertificateBuilder()
+            .subject_name(_name("emqx-tpu-test-ca"))
+            .issuer_name(_name("emqx-tpu-test-ca"))
+            .public_key(self.ca_key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + _ONE_DAY * 30)
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None), True)
+            .sign(self.ca_key, hashes.SHA256())
+        )
+        self.ca_path, self.ca_key_path = _write_pair(
+            dirpath, "ca", self.ca_cert, self.ca_key
+        )
+
+    def issue(self, cn: str, stem: str, server: bool = True):
+        """Returns (cert_path, key_path) for a CA-signed leaf."""
+        key = _key()
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(_name(cn))
+            .issuer_name(self.ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + _ONE_DAY * 30)
+        )
+        if server:
+            builder = builder.add_extension(
+                x509.SubjectAlternativeName(
+                    [
+                        x509.DNSName(cn),
+                        x509.DNSName("localhost"),
+                        x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+                    ]
+                ),
+                critical=False,
+            )
+        cert = builder.sign(self.ca_key, hashes.SHA256())
+        return _write_pair(self.dir, stem, cert, key)
