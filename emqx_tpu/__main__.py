@@ -1,0 +1,58 @@
+"""`python -m emqx_tpu` — boot one broker node (the `bin/emqx` analog).
+
+Config file is JSON with the schema namespaces of `config.config.SCHEMA`
+plus the structured `listeners` / `cluster` / `authentication` /
+`authorization` / `rewrite` / `auto_subscribe` sections consumed by
+`NodeRuntime`.  Environment overrides use `EMQX_TPU__<ns>__<key>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+
+from .config.config import Config
+from .node import NodeRuntime
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="emqx_tpu", description="TPU-native MQTT broker node"
+    )
+    ap.add_argument("--config", "-c", help="JSON config file path")
+    ap.add_argument(
+        "--print-config",
+        action="store_true",
+        help="print the checked effective config and exit",
+    )
+    ap.add_argument(
+        "--log-level", default="INFO", help="root log level (default INFO)"
+    )
+    args = ap.parse_args(argv)
+
+    raw = {}
+    if args.config:
+        with open(args.config, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+
+    if args.print_config:
+        print(json.dumps(Config(raw).dump(), indent=2, sort_keys=True))
+        return 0
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s [%(levelname)s] %(name)s: %(message)s",
+    )
+    node = NodeRuntime(raw)
+    try:
+        asyncio.run(node.run_forever())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

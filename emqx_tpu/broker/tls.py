@@ -98,8 +98,19 @@ def make_server_context(
         ctx.verify_mode = (
             ssl.CERT_REQUIRED if cfg.fail_if_no_peer_cert else ssl.CERT_OPTIONAL
         )
-    else:
+    elif cfg.verify == VERIFY_NONE:
+        if cfg.peer_cert_as_username or cfg.peer_cert_as_clientid:
+            raise ValueError(
+                "peer_cert_as_username/clientid requires verify=verify_peer "
+                "— with verify_none no client cert is ever requested and "
+                "identity would silently fall back to the CONNECT username"
+            )
         ctx.verify_mode = ssl.CERT_NONE
+    else:
+        raise ValueError(
+            f"unknown verify mode {cfg.verify!r}; "
+            f"expected {VERIFY_NONE!r} or {VERIFY_PEER!r}"
+        )
     if cfg.alpn_protocols:
         ctx.set_alpn_protocols(cfg.alpn_protocols)
     if cfg.sni_hosts:
@@ -144,9 +155,11 @@ def make_server_context(
         ctx.set_psk_server_callback(
             psk_store.ssl_callback(), cfg.psk_identity_hint
         )
-        # PSK key exchange needs PSK-capable TLS1.2 suites alongside certs
+        # PSK key exchange needs PSK-capable TLS1.2 suites alongside the
+        # authenticated defaults.  NOT "ALL:PSK": ALL drags in anonymous
+        # ADH/AECDH suites, letting a MITM handshake with no cert & no PSK.
         if not cfg.ciphers:
-            ctx.set_ciphers("ALL:PSK")
+            ctx.set_ciphers("DEFAULT:PSK")
     return ctx
 
 

@@ -101,12 +101,14 @@ class ClusterNode:
         heartbeat_ivl: float = 1.0,
         miss_limit: int = 3,
         rpc_mode: str = "async",  # forward mode: async | sync
+        cookie: str = "",  # shared secret gating peer links ("" = open)
     ):
         self.name = name
         self.broker = broker
         broker.cluster = self
         self.incarnation = time.time_ns()
-        self.transport = Transport(name, host, port)
+        self.cookie = cookie
+        self.transport = Transport(name, host, port, cookie=cookie)
         self.remote = RemoteRoutes()
         self.peers_cfg: Dict[str, Tuple[str, int]] = dict(peers or {})
         self.links: Dict[str, PeerLink] = {}
@@ -171,6 +173,7 @@ class ClusterNode:
             self.incarnation,
             on_up=self._link_up,
             on_down=lambda l: self._node_down(l.peer),
+            cookie=self.cookie,
         )
         self.links[peer] = link
         self._status.setdefault(peer, "down")

@@ -16,10 +16,15 @@ Frame layout:  u32 len | u8 type | body
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import hmac
 import itertools
 import json
+import logging
 import struct
 from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+log = logging.getLogger("emqx_tpu.cluster.transport")
 
 # frame types
 HELLO = 1
@@ -38,6 +43,23 @@ MAX_FRAME = 64 * 1024 * 1024
 
 class RpcError(Exception):
     pass
+
+
+def hello_auth(cookie: str, node: str, incarnation) -> str:
+    """Keyed proof of the shared cluster cookie for the HELLO exchange.
+
+    The reference gates node joins on the Erlang distribution cookie;
+    here the cookie never crosses the wire — each side sends
+    HMAC(cookie, node:incarnation) and verifies the peer's.
+    """
+    return hmac.new(
+        cookie.encode(), f"{node}:{incarnation}".encode(), hashlib.sha256
+    ).hexdigest()
+
+
+def check_hello_auth(cookie: str, obj: dict) -> bool:
+    want = hello_auth(cookie, obj.get("node", "?"), obj.get("incarnation"))
+    return hmac.compare_digest(want, obj.get("auth") or "")
 
 
 def _pack(ftype: int, body: bytes) -> bytes:
@@ -80,6 +102,7 @@ class PeerLink:
         on_up: Callable[["PeerLink", dict], None],
         on_down: Callable[["PeerLink"], None],
         reconnect_ivl: float = 0.5,
+        cookie: str = "",
     ):
         self.self_node = self_node
         self.peer = peer
@@ -88,6 +111,8 @@ class PeerLink:
         self.on_up = on_up
         self.on_down = on_down
         self.reconnect_ivl = reconnect_ivl
+        self.cookie = cookie
+        self._auth_warned = False
         self.connected = False
         self.peer_hello: dict = {}
         self._writer: Optional[asyncio.StreamWriter] = None
@@ -114,17 +139,37 @@ class PeerLink:
             try:
                 reader, writer = await asyncio.open_connection(*self.addr)
                 self._writer = writer
-                writer.write(
-                    pack_json(
-                        HELLO,
-                        {"node": self.self_node, "incarnation": self.incarnation},
+                my_hello = {
+                    "node": self.self_node,
+                    "incarnation": self.incarnation,
+                }
+                if self.cookie:
+                    my_hello["auth"] = hello_auth(
+                        self.cookie, self.self_node, self.incarnation
                     )
-                )
+                writer.write(pack_json(HELLO, my_hello))
                 await writer.drain()
                 ftype, body = await read_frame(reader)
                 if ftype != HELLO:
                     raise ConnectionError("expected HELLO")
-                self.peer_hello = json.loads(body)
+                greeting = json.loads(body)
+                if greeting.get("error"):
+                    if not self._auth_warned:
+                        self._auth_warned = True
+                        log.warning(
+                            "peer %s rejected hello: %s",
+                            self.peer,
+                            greeting["error"],
+                        )
+                    raise ConnectionError(f"hello rejected: {greeting['error']}")
+                if self.cookie and not check_hello_auth(self.cookie, greeting):
+                    if not self._auth_warned:
+                        self._auth_warned = True
+                        log.warning(
+                            "peer %s failed cookie verification", self.peer
+                        )
+                    raise ConnectionError("peer failed cookie verification")
+                self.peer_hello = greeting
                 self.connected = True
                 self.on_up(self, self.peer_hello)
                 await self._read_loop(reader)
@@ -228,10 +273,12 @@ class Transport:
       rpc_handlers[method](peer_name, params) -> dict | Awaitable[dict]
     """
 
-    def __init__(self, node: str, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node: str, host: str = "127.0.0.1", port: int = 0,
+                 cookie: str = ""):
         self.node = node
         self.host = host
         self.port = port
+        self.cookie = cookie
         self.on_hello: Callable[[str, dict], dict] = lambda p, h: {}
         self.on_route_op: Callable[[str, dict], None] = lambda p, o: None
         self.on_snapshot_req: Callable[[str, dict], dict] = lambda p, o: {}
@@ -283,8 +330,19 @@ class Transport:
                 return
             hello = json.loads(body)
             peer_name = hello.get("node", "?")
+            if self.cookie and not check_hello_auth(self.cookie, hello):
+                log.warning(
+                    "rejecting link from %s: bad cluster cookie", peer_name
+                )
+                writer.write(pack_json(HELLO, {"error": "bad_cookie"}))
+                await writer.drain()
+                return
             greeting = {"node": self.node}
             greeting.update(self.on_hello(peer_name, hello) or {})
+            if self.cookie:
+                greeting["auth"] = hello_auth(
+                    self.cookie, self.node, greeting.get("incarnation")
+                )
             writer.write(pack_json(HELLO, greeting))
             await writer.drain()
             while True:

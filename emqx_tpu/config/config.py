@@ -167,6 +167,20 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "max_message_queue_len": Field("int", 10000),
     },
     "stats": {"enable": Field("bool", True)},
+    "node": {
+        "name": Field("str", "emqx_tpu@127.0.0.1"),
+        "data_dir": Field("str", "data"),
+        "cookie": Field("str", "emqxsecretcookie", desc="cluster shared secret"),
+    },
+    "persistent_session_store": {
+        "enable": Field("bool", False),
+        "on_disc": Field("bool", False),
+    },
+    "limiter": {
+        "connection_rate": Field("float", 0.0, desc="0 = unlimited"),
+        "message_in_rate": Field("float", 0.0),
+        "bytes_in_rate": Field("float", 0.0),
+    },
     "dashboard": {
         "listen_port": Field("int", 18083),
         "default_username": Field("str", "admin"),

@@ -1,0 +1,413 @@
+"""Node boot orchestration — the `emqx_machine` analog.
+
+The reference boots a node via `emqx_machine_boot:post_boot/0`
+(`apps/emqx_machine/src/emqx_machine_boot.erl:29-47`): start all OTP apps
+in dependency order, then kick autocluster; `emqx_sup` (one_for_all)
+owns the kernel/router/broker/cm/sys trees (`emqx_sup.erl:64-80`).
+
+`NodeRuntime` is the same composition root for the TPU-native stack: one
+object builds config -> broker core (TPU match engine inside) ->
+security chains -> modules -> observability -> listeners (tcp/ssl/ws/
+wss) -> management REST -> cluster link-up, starts them in dependency
+order, and stops them in reverse.  `python -m emqx_tpu --config
+node.json` is the `bin/emqx start` equivalent.
+
+Structured sections the typed schema does not model (lists of listener
+blocks, cluster peer maps) ride in the same raw dict under "listeners" /
+"cluster" and are validated here, the way the reference keeps listener
+proplists outside the zone schema.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+from typing import Any, Dict, List, Optional
+
+from .authn import AuthChain, BuiltInAuthenticator, JwtAuthenticator
+from .authz import AuthzChain, BuiltInSource, ClientAclSource, FileSource
+from .broker.banned import Banned, Flapping
+from .broker.batcher import PublishBatcher
+from .broker.broker import Broker
+from .broker.limiter import Limiter, Olp
+from .broker.listener import Listener
+from .broker.persist import DiscBackend, RamBackend, SessionPersistence
+from .broker.ws import WsListener
+from .config.config import Config, ConfigError, channel_config_from
+from .mgmt import HttpApi, ManagementApi, TokenStore
+from .modules import AutoSubscribe, DelayedPublish, TopicMetrics, TopicRewrite
+from .observe import AlarmManager, SlowSubs, Stats, TraceManager
+from .observe.monitor import MonitorSampler
+from .observe.sysmon import SysHeartbeat
+from .psk import PskStore
+
+log = logging.getLogger("emqx_tpu.node")
+
+
+def _tls_from_dict(d: Dict[str, Any]):
+    from .broker.tls import TlsConfig
+
+    sni = {
+        name: _tls_from_dict(sub) for name, sub in (d.get("sni_hosts") or {}).items()
+    }
+    kw = {k: v for k, v in d.items() if k != "sni_hosts"}
+    return TlsConfig(sni_hosts=sni, **kw)
+
+
+class NodeRuntime:
+    """Composition root + ordered lifecycle for one broker node."""
+
+    def __init__(self, raw: Optional[Dict[str, Any]] = None):
+        raw = raw or {}
+        self.conf = Config(raw)
+        self.raw = raw
+        self.node_name = self.conf.get("node.name")
+
+        # ---- broker core (layer 1.7 + device engine) ------------------
+        from .broker.retainer import Retainer
+
+        retainer = Retainer(
+            max_retained=self.conf.get("retainer.max_retained_messages"),
+            max_payload=self.conf.get("retainer.max_payload_size"),
+            enable=self.conf.get("retainer.enable"),
+        )
+        cluster_cfg = raw.get("cluster") or {}
+        self.cluster = None
+        if cluster_cfg.get("enable"):
+            from .cluster.node import ClusterBroker, ClusterNode
+
+            self.broker: Broker = ClusterBroker(retainer=retainer)
+            peers = {
+                name: (addr[0], int(addr[1]))
+                for name, addr in (cluster_cfg.get("peers") or {}).items()
+            }
+            self.cluster = ClusterNode(
+                self.node_name,
+                self.broker,
+                host=cluster_cfg.get("host", "127.0.0.1"),
+                port=int(cluster_cfg.get("port", 0)),
+                peers=peers,
+                rpc_mode=cluster_cfg.get("rpc_mode", "async"),
+                cookie=self.conf.get("node.cookie"),
+            )
+        else:
+            self.broker = Broker(retainer=retainer)
+
+        # ---- persistence (5.4 checkpoint/resume) -----------------------
+        self.persistence = None
+        if self.conf.get("persistent_session_store.enable"):
+            if self.conf.get("persistent_session_store.on_disc"):
+                pdir = os.path.join(self.conf.get("node.data_dir"), "persist")
+                backend = DiscBackend(pdir)
+            else:
+                backend = RamBackend()
+            self.persistence = SessionPersistence(self.broker, backend)
+
+        # ---- security chains (1.11) ------------------------------------
+        self.banned = Banned()
+        self.banned.install(self.broker.hooks)
+        self.flapping = None
+        if self.conf.get("flapping_detect.enable"):
+            self.flapping = Flapping(
+                self.banned,
+                max_count=self.conf.get("flapping_detect.max_count"),
+                window=self.conf.get("flapping_detect.window_time"),
+                ban_duration=self.conf.get("flapping_detect.ban_time"),
+            )
+            self.flapping.install(self.broker.hooks)
+        self.authn = None
+        if self.conf.get("authn.enable"):
+            self.authn = AuthChain(
+                allow_anonymous=self.conf.get("authn.allow_anonymous")
+            )
+            self._build_authenticators(raw.get("authentication") or [])
+            self.authn.install(self.broker.hooks)
+        self.authz = None
+        if self.conf.get("authz.enable"):
+            self.authz = AuthzChain(default=self.conf.get("authz.no_match"))
+            self._build_authz_sources(raw.get("authorization") or [])
+            self.authz.install(self.broker.hooks)
+
+        # ---- modules (emqx_modules) ------------------------------------
+        self.delayed = DelayedPublish(
+            self.broker, enable=self.conf.get("delayed.enable")
+        )
+        self.delayed.install(self.broker.hooks)
+        from .broker.packet import SubOpts
+        from .modules import RewriteRule
+
+        self.rewrite = TopicRewrite(
+            [
+                RewriteRule(
+                    action=r.get("action", "all"),
+                    source=r["source_topic"],
+                    regex=r["re"],
+                    dest=r["dest_topic"],
+                )
+                for r in raw.get("rewrite") or []
+            ]
+        )
+        self.rewrite.install(self.broker.hooks)
+        self.auto_subscribe = AutoSubscribe(
+            self.broker,
+            [
+                (t["topic"], SubOpts(qos=int(t.get("qos", 0))))
+                for t in raw.get("auto_subscribe") or []
+            ],
+        )
+        self.auto_subscribe.install(self.broker.hooks)
+        self.topic_metrics = TopicMetrics()
+        self.topic_metrics.install(self.broker.hooks)
+
+        # ---- observability (1.13) ---------------------------------------
+        self.stats = Stats(self.broker)
+        self.alarms = AlarmManager(self.broker, node=self.node_name)
+        self.slow_subs = SlowSubs()
+        self.slow_subs.install(self.broker.hooks)
+        trace_dir = os.path.join(self.conf.get("node.data_dir"), "trace")
+        self.traces = TraceManager(self.broker.hooks, directory=trace_dir)
+        self.sys_heartbeat = SysHeartbeat(
+            self.broker, stats=self.stats, node=self.node_name
+        )
+        self.monitor = MonitorSampler(self.broker)
+
+        # ---- flow control ------------------------------------------------
+        self.limiter = self._build_limiter()
+        self.olp = Olp()
+        self.psk = PskStore()
+
+        # ---- listeners (1.3) ---------------------------------------------
+        self.batcher = PublishBatcher(
+            self.broker,
+            max_batch=self.conf.get("broker.batch_max"),
+            max_delay=self.conf.get("broker.batch_delay"),
+        )
+        self.listeners: List[Listener] = []
+        for ldef in raw.get("listeners") or [{"type": "tcp", "port": 1883}]:
+            self.listeners.append(self._build_listener(ldef))
+
+        # ---- management REST (1.12) ---------------------------------------
+        self.tokens = TokenStore(
+            ttl_s=self.conf.get("dashboard.token_expired_time")
+        )
+        self.tokens.add_admin(
+            self.conf.get("dashboard.default_username"),
+            self.conf.get("dashboard.default_password"),
+        )
+        self.api = ManagementApi(
+            self.broker,
+            node=self.node_name,
+            tokens=self.tokens,
+            stats=self.stats,
+            alarms=self.alarms,
+            traces=self.traces,
+            slow_subs=self.slow_subs,
+            banned=self.banned,
+            config=self.conf,
+            cluster=self.cluster,
+            listeners=self.listeners,
+            sys_heartbeat=self.sys_heartbeat,
+            psk=self.psk,
+        )
+        self.http = HttpApi(
+            port=self.conf.get("dashboard.listen_port"),
+            auth=self.api.auth_check,
+        )
+        self.api.install(self.http)
+
+        self._tick_task: Optional[asyncio.Task] = None
+        self._stop_evt: Optional[asyncio.Event] = None
+        self.started = False
+
+    # ------------------------------------------------------------ builders
+
+    def _build_limiter(self) -> Optional[Limiter]:
+        rates = {}
+        for kind in Limiter.KINDS:
+            r = self.conf.get(f"limiter.{kind}_rate")
+            if r and r > 0:
+                rates[kind] = {"rate": r, "burst": r}
+        return Limiter(**rates) if rates else None
+
+    def _build_listener(self, ldef: Dict[str, Any]) -> Listener:
+        kind = ldef.get("type", "tcp")
+        zone = ldef.get("zone")
+        chan_cfg = channel_config_from(self.conf, zone=zone)
+        chan_cfg.mountpoint = ldef.get("mountpoint")
+        common = dict(
+            host=ldef.get("host", "0.0.0.0"),
+            port=int(ldef.get("port", 1883)),
+            config=chan_cfg,
+            max_connections=int(ldef.get("max_connections", 0)),
+            batcher=self.batcher,
+            limiter=self.limiter,
+            olp=self.olp,
+        )
+        tls = None
+        if kind in ("ssl", "wss") or ldef.get("ssl"):
+            ssl_block = ldef.get("ssl")
+            if not ssl_block:
+                raise ConfigError(
+                    f"listener type {kind!r} requires an 'ssl' block"
+                )
+            tls = _tls_from_dict(ssl_block)
+        if kind in ("tcp", "ssl"):
+            return Listener(self.broker, tls=tls, psk_store=self.psk, **common)
+        if kind in ("ws", "wss"):
+            return WsListener(
+                self.broker,
+                path=ldef.get("path", "/mqtt"),
+                tls=tls,
+                psk_store=self.psk,
+                **common,
+            )
+        raise ConfigError(f"unknown listener type {kind!r}")
+
+    def _build_authenticators(self, defs: List[Dict[str, Any]]) -> None:
+        for d in defs:
+            mech = d.get("mechanism", "password_based")
+            backend = d.get("backend", "built_in_database")
+            if backend == "built_in_database":
+                a = BuiltInAuthenticator(
+                    user_id_type=d.get("user_id_type", "username")
+                )
+                for u in d.get("users") or []:
+                    a.add_user(
+                        u["user_id"],
+                        u["password"],
+                        is_superuser=bool(u.get("is_superuser")),
+                    )
+            elif backend == "jwt" or mech == "jwt":
+                a = JwtAuthenticator(secret=(d.get("secret") or "").encode())
+            else:
+                raise ConfigError(f"unsupported authenticator backend {backend!r}")
+            self.authn.add(a)
+
+    def _build_authz_sources(self, defs: List[Dict[str, Any]]) -> None:
+        from .authz import Rule
+
+        for d in defs:
+            t = d.get("type", "built_in_database")
+            if t == "built_in_database":
+                self.authz.add(BuiltInSource())
+            elif t == "client_acl":
+                self.authz.add(ClientAclSource())
+            elif t == "file":
+                rules = [
+                    Rule(
+                        permission=r.get("permission", "allow"),
+                        who=tuple(r["who"]) if isinstance(r.get("who"), list) else r.get("who", "all"),
+                        action=r.get("action", "all"),
+                        topics=list(r.get("topics") or []),
+                    )
+                    for r in d.get("rules") or []
+                ]
+                self.authz.add(FileSource(rules))
+            else:
+                raise ConfigError(f"unsupported authz source {t!r}")
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        """Ordered startup.  A component failure tears down everything
+        started so far before re-raising — no leaked sockets/tasks."""
+        log.info("node %s booting", self.node_name)
+        try:
+            if self.cluster is not None:
+                await self.cluster.start()
+            for lst in self.listeners:
+                await lst.start()
+            await self.http.start()
+            self._stop_evt = asyncio.Event()
+            self._tick_task = asyncio.create_task(self._ticker())
+        except BaseException:
+            await self._shutdown()
+            raise
+        self.started = True
+        log.info(
+            "node %s up: %s, dashboard :%d",
+            self.node_name,
+            ", ".join(
+                f"{type(l).__name__.lower()}:{l.port}" for l in self.listeners
+            ),
+            self.http.port,
+        )
+
+    async def stop(self) -> None:
+        """Reverse-order shutdown (`emqx_machine_terminator` analog)."""
+        if not self.started:
+            return
+        self.started = False
+        await self._shutdown()
+        log.info("node %s stopped", self.node_name)
+
+    async def _shutdown(self) -> None:
+        """Stop every component that is running; safe on partial starts
+        (each component's stop() tolerates never-started state)."""
+        if self._tick_task:
+            self._tick_task.cancel()
+            try:
+                await self._tick_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._tick_task = None
+        await self.http.stop()
+        for lst in reversed(self.listeners):
+            try:
+                await lst.stop()
+            except Exception:
+                log.exception("stopping listener on port %s", lst.port)
+        if self.cluster is not None:
+            await self.cluster.stop()
+        if self.persistence is not None:
+            self.persistence.tick()  # final dirty-page flush
+        self.traces.stop_all()
+
+    async def _ticker(self) -> None:
+        """Node-level periodic work: $SYS heartbeats, dashboard sampler,
+        delayed-publish scheduler, stats gauges.  (Connection-level timers
+        live in the listener housekeeping loop.)"""
+        hb_ivl = self.conf.get("broker.sys_heartbeat_interval")
+        last_hb = 0.0
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                now = asyncio.get_running_loop().time()
+                self.delayed.tick()
+                self.monitor.tick()
+                self._refresh_stats()
+                if now - last_hb >= hb_ivl:
+                    last_hb = now
+                    self.sys_heartbeat.tick()
+            except Exception:
+                log.exception("node ticker")
+
+    def _refresh_stats(self) -> None:
+        """Periodic gauges (`emqx_stats` setstat points)."""
+        b = self.broker
+        self.stats.setstat("connections.count", len(b.cm.channels))
+        self.stats.setstat(
+            "sessions.count", len(b.cm.channels) + len(b.cm.pending)
+        )
+        self.stats.setstat("subscriptions.count", b.subscription_count)
+        self.stats.setstat("topics.count", b.route_count)
+        self.stats.setstat("retained.count", b.retainer.count)
+
+    # ------------------------------------------------------------ run-until
+
+    async def run_forever(self) -> None:
+        """Start, then block until SIGINT/SIGTERM (bin/emqx foreground)."""
+        await self.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # non-unix
+                pass
+        try:
+            await stop.wait()
+        finally:
+            await self.stop()

@@ -267,3 +267,27 @@ def test_cluster_rpc_catchup_after_missed_entries(run):
         await stop_all(nodes)
 
     run(main())
+
+
+def test_cluster_cookie_auth(run):
+    """Nodes only link when their cookies match (`node.cookie` gate);
+    the cookie itself never crosses the wire (HMAC challenge)."""
+
+    async def main():
+        b0, b1, b2 = ClusterBroker(), ClusterBroker(), ClusterBroker()
+        n0 = ClusterNode("c0", b0, heartbeat_ivl=0.2, cookie="secret-a")
+        n1 = ClusterNode("c1", b1, heartbeat_ivl=0.2, cookie="secret-a")
+        bad = ClusterNode("cx", b2, heartbeat_ivl=0.2, cookie="wrong")
+        for x in (n0, n1, bad):
+            await x.start()
+        n0.join("c1", ("127.0.0.1", n1.transport.port))
+        n1.join("c0", ("127.0.0.1", n0.transport.port))
+        bad.join("c0", ("127.0.0.1", n0.transport.port))
+        await wait_until(lambda: "c1" in n0.up_peers() and "c0" in n1.up_peers())
+        # the mismatched node never links, in either direction
+        await asyncio.sleep(0.6)
+        assert "c0" not in bad.up_peers()
+        assert "cx" not in n0.up_peers()
+        await stop_all([n0, n1, bad])
+
+    run(main())

@@ -1,0 +1,274 @@
+"""Node boot orchestrator e2e — `emqx_machine_boot` analog.
+
+One NodeRuntime boots the full stack (listeners incl. TLS, REST,
+modules, stats ticker), serves real MQTT + HTTP traffic, and shuts down
+in reverse order.  Reference: emqx_machine_boot.erl:29-47, emqx_sup.erl.
+"""
+
+import asyncio
+import json
+import subprocess
+import sys
+
+import pytest
+
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.tls import make_client_context
+from emqx_tpu.config.config import ConfigError
+from emqx_tpu.node import NodeRuntime
+
+from tls_certs import CertKit
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+def http(method, url, body=None, token=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method,
+    )
+    req.add_header("Content-Type", "application/json")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            data = resp.read()
+            return resp.status, json.loads(data) if data else None
+    except urllib.error.HTTPError as e:
+        data = e.read()
+        return e.code, json.loads(data) if data else None
+
+
+BASE_CONF = {
+    "listeners": [{"type": "tcp", "host": "127.0.0.1", "port": 0}],
+    "dashboard": {"listen_port": 0, "default_password": "boot-secret1"},
+    "node": {"name": "boot-test@local"},
+}
+
+
+def test_boot_mqtt_rest_shutdown(run, tmp_path):
+    """The VERDICT's done-condition: boot, MQTT connect, REST hit, clean
+    shutdown."""
+
+    async def main():
+        conf = dict(BASE_CONF)
+        conf["node"] = {"name": "boot-test@local", "data_dir": str(tmp_path)}
+        node = NodeRuntime(conf)
+        await node.start()
+        port = node.listeners[0].port
+        assert port != 0
+
+        c = MqttClient(clientid="boot-c1")
+        await c.connect(port=port)
+        await c.subscribe("boot/#", qos=1)
+        await c.publish("boot/x", b"hello-node", qos=1)
+        m = await c.recv()
+        assert m.payload == b"hello-node"
+
+        base = f"http://127.0.0.1:{node.http.port}/api/v5"
+        st, body = await asyncio.to_thread(http, "GET", f"{base}/status")
+        assert st == 200
+        st, body = await asyncio.to_thread(
+            http,
+            "POST",
+            f"{base}/login",
+            {"username": "admin", "password": "boot-secret1"},
+        )
+        assert st == 200
+        token = body["token"]
+        st, clients = await asyncio.to_thread(
+            http, "GET", f"{base}/clients", None, token
+        )
+        assert st == 200
+        ids = [c_["clientid"] for c_ in clients["data"]]
+        assert "boot-c1" in ids
+
+        await c.disconnect()
+        await node.stop()
+        # listener socket actually released
+        with pytest.raises((ConnectionError, OSError, AssertionError)):
+            c2 = MqttClient(clientid="late")
+            await asyncio.wait_for(c2.connect(port=port), 3)
+
+    run(main())
+
+
+def test_boot_with_tls_listener(run, tmp_path):
+    async def main():
+        kit = CertKit(str(tmp_path))
+        cert, key = kit.issue("localhost", "nodecert")
+        conf = {
+            "listeners": [
+                {"type": "tcp", "host": "127.0.0.1", "port": 0},
+                {
+                    "type": "ssl",
+                    "host": "127.0.0.1",
+                    "port": 0,
+                    "ssl": {"certfile": cert, "keyfile": key},
+                },
+            ],
+            "dashboard": {"listen_port": 0},
+            "node": {"data_dir": str(tmp_path)},
+        }
+        node = NodeRuntime(conf)
+        await node.start()
+        tcp, tls = node.listeners
+        ctx = make_client_context(cacertfile=kit.ca_path)
+        a = MqttClient(clientid="n-tls")
+        await a.connect(host="localhost", port=tls.port, ssl=ctx)
+        b = MqttClient(clientid="n-tcp")
+        await b.connect(port=tcp.port)
+        await b.subscribe("mix/#")
+        await a.publish("mix/1", b"cross-listener", qos=1)
+        m = await b.recv()
+        assert m.payload == b"cross-listener"
+        await a.disconnect()
+        await b.disconnect()
+        await node.stop()
+
+    run(main())
+
+
+def test_boot_authn_and_modules(run, tmp_path):
+    """authn chain + delayed publish + rewrite are live after boot."""
+
+    async def main():
+        conf = {
+            "listeners": [{"type": "tcp", "host": "127.0.0.1", "port": 0}],
+            "dashboard": {"listen_port": 0},
+            "node": {"data_dir": str(tmp_path)},
+            "authn": {"enable": True, "allow_anonymous": False},
+            "authentication": [
+                {
+                    "backend": "built_in_database",
+                    "users": [{"user_id": "u1", "password": "pw1"}],
+                }
+            ],
+            "rewrite": [
+                {
+                    "action": "publish",
+                    "source_topic": "legacy/#",
+                    "re": "^legacy/(.+)$",
+                    "dest_topic": "modern/\\1",
+                }
+            ],
+        }
+        node = NodeRuntime(conf)
+        await node.start()
+        port = node.listeners[0].port
+
+        bad = MqttClient(clientid="anon")
+        with pytest.raises(Exception):
+            await bad.connect(port=port)
+
+        good = MqttClient(clientid="authed", username="u1", password=b"pw1")
+        await good.connect(port=port)
+        await good.subscribe("modern/#")
+        await good.publish("legacy/x", b"rewritten", qos=1)
+        m = await good.recv()
+        assert m.topic == "modern/x"
+
+        # delayed publish through the node ticker (1s tick)
+        await good.publish("$delayed/1/modern/later", b"delayed", qos=1)
+        m = await asyncio.wait_for(good.recv(), 5)
+        assert (m.topic, m.payload) == ("modern/later", b"delayed")
+
+        await good.disconnect()
+        await node.stop()
+
+    run(main())
+
+
+def test_stats_ticker_and_sys_heartbeat(run, tmp_path):
+    async def main():
+        conf = {
+            "listeners": [{"type": "tcp", "host": "127.0.0.1", "port": 0}],
+            "dashboard": {"listen_port": 0},
+            "node": {"data_dir": str(tmp_path)},
+            "broker": {"sys_heartbeat_interval": "1s"},
+        }
+        node = NodeRuntime(conf)
+        await node.start()
+        c = MqttClient(clientid="sys-obs")
+        await c.connect(port=node.listeners[0].port)
+        await c.subscribe("$SYS/#")
+        m = await asyncio.wait_for(c.recv(), 10)
+        assert m.topic.startswith("$SYS/")
+        node._refresh_stats()
+        assert node.stats.getstat("connections.count") == 1
+        await c.disconnect()
+        await node.stop()
+
+    run(main())
+
+
+def test_bad_listener_type_rejected(tmp_path):
+    with pytest.raises(ConfigError):
+        NodeRuntime(
+            {
+                "listeners": [{"type": "quic", "port": 0}],
+                "node": {"data_dir": str(tmp_path)},
+            }
+        )
+    with pytest.raises(ConfigError):
+        NodeRuntime(
+            {
+                "listeners": [{"type": "ssl", "port": 0}],  # no ssl block
+                "node": {"data_dir": str(tmp_path)},
+            }
+        )
+
+
+def test_cli_print_config(tmp_path):
+    cfgfile = tmp_path / "node.json"
+    cfgfile.write_text(json.dumps({"mqtt": {"max_inflight": 7}}))
+    out = subprocess.run(
+        [sys.executable, "-m", "emqx_tpu", "-c", str(cfgfile), "--print-config"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr
+    eff = json.loads(out.stdout)
+    assert eff["mqtt"]["max_inflight"] == 7
+    assert eff["node"]["name"]
+
+
+def test_partial_start_failure_leaks_nothing(run, tmp_path):
+    """If listener N fails to bind, everything started before it must be
+    torn down (no leaked sockets) and start() re-raises."""
+
+    async def main():
+        hog = await asyncio.start_server(lambda r, w: None, "127.0.0.1", 0)
+        taken = hog.sockets[0].getsockname()[1]
+        conf = {
+            "listeners": [
+                {"type": "tcp", "host": "127.0.0.1", "port": 0},
+                {"type": "tcp", "host": "127.0.0.1", "port": taken},
+            ],
+            "dashboard": {"listen_port": 0},
+            "node": {"data_dir": str(tmp_path)},
+        }
+        node = NodeRuntime(conf)
+        with pytest.raises(OSError):
+            await node.start()
+        assert not node.started
+        port1 = node.listeners[0].port
+        # first listener's socket must be released after the failed boot
+        with pytest.raises((ConnectionError, OSError, AssertionError)):
+            c = MqttClient(clientid="ghost")
+            await asyncio.wait_for(c.connect(port=port1), 3)
+        hog.close()
+        await hog.wait_closed()
+
+    run(main())

@@ -234,6 +234,20 @@ def test_tls_versions_clamped(kit):
     assert ctx.minimum_version == ssl.TLSVersion.TLSv1_3
 
 
+def test_unknown_verify_mode_rejected(kit):
+    cert, key = kit.issue("localhost", "vmode")
+    with pytest.raises(ValueError, match="unknown verify mode"):
+        make_server_context(TlsConfig(certfile=cert, keyfile=key, verify="peer"))
+
+
+def test_cert_identity_requires_verify_peer(kit):
+    cert, key = kit.issue("localhost", "vid")
+    with pytest.raises(ValueError, match="verify_peer"):
+        make_server_context(
+            TlsConfig(certfile=cert, keyfile=key, peer_cert_as_username="cn")
+        )
+
+
 def test_unknown_tls_version_rejected(kit):
     cert, key = kit.issue("localhost", "vbad")
     cfg = TlsConfig(certfile=cert, keyfile=key, versions=["tlsv1.1"])
