@@ -60,6 +60,10 @@ class Broker:
         self._routes: Dict[int, Route] = {}  # fid -> fan-out record
         self._sub_count = 0
         self.cm.on_discard = self._on_discard_session
+        # exact-match guarantee: surface discarded hash collisions
+        self.engine.on_collision = lambda topic, fid: self.metrics.inc(
+            "match.hash_collision"
+        )
         # route-table change callbacks (cluster layer announces these to
         # peers — the `emqx_router:do_add_route` replication point)
         self.on_route_added: Optional[callable] = None
@@ -67,7 +71,9 @@ class Broker:
 
     def _on_discard_session(self, session: Session) -> None:
         """Discarded session: drop its routes (kicked channels skip this)."""
-        self.client_down(session.clientid, list(session.subscriptions))
+        self.client_down(
+            session.clientid, list(session.subscriptions), session=session
+        )
         self.metrics.inc("session.discarded")
 
     # -------------------------------------------------------- subscribe
@@ -117,8 +123,15 @@ class Broker:
         self.metrics.gauge_set("subscriptions.count", self._sub_count)
         self.hooks.run("session.unsubscribed", (clientid, filt))
 
-    def client_down(self, clientid: str, filters: Sequence[str]) -> None:
-        """Clean a dead client's routes (`emqx_broker_helper:clean_down`)."""
+    def client_down(
+        self, clientid: str, filters: Sequence[str], session=None
+    ) -> None:
+        """Clean a dead client's routes (`emqx_broker_helper:clean_down`).
+
+        When the dying session is supplied, its undelivered shared-group
+        messages are redispatched to surviving members first."""
+        if session is not None:
+            self.redispatch_shared_pending(session)
         for f in list(filters):
             self.unsubscribe(clientid, f)
         self.shared.drop_member(clientid)
@@ -190,17 +203,103 @@ class Broker:
                 continue
             for cid in route.direct:
                 per_client.setdefault(cid, []).append(route.filt)
-            for group in route.groups:
-                pick = self.shared.pick(group, route.filt, msg.topic, msg.from_client)
-                if pick is not None:
-                    # deliver under the client's own subscription key
-                    # ($share/<g>/<filt>) so session subopts/QoS apply
-                    per_client.setdefault(pick, []).append(
-                        topiclib.join_share(group, route.filt)
-                    )
         n = 0
         for cid, filts in per_client.items():
             n += self._deliver_to(cid, filts, msg)
+        # shared groups deliver one-at-a-time with failover so a dead
+        # pick redispatches to a peer (`emqx_shared_sub:dispatch` retry)
+        for fid in fids:
+            route = self._routes.get(fid)
+            if route is None:
+                continue
+            for group in route.groups:
+                n += self._dispatch_shared(msg, group, route.filt)
+        return n
+
+    def _dispatch_shared(
+        self,
+        msg: Message,
+        group: str,
+        filt: str,
+        exclude: Optional[Set[str]] = None,
+    ) -> int:
+        """Deliver to ONE group member, failing over across members until
+        a delivery lands (`emqx_shared_sub.erl:118-130`).  The delivered
+        copy is tagged with its (group, filter) so pending copies can be
+        redispatched if the member dies before acking."""
+        from dataclasses import replace
+
+        tried: Set[str] = set(exclude or ())
+        skey = topiclib.join_share(group, filt)
+        tagged = replace(
+            msg, headers={**msg.headers, "shared": (group, filt)}
+        )
+        parked_fallback: Optional[str] = None
+        while True:
+            pick = self.shared.pick(
+                group, filt, msg.topic, msg.from_client, exclude=tried
+            )
+            if pick is None:
+                break
+            if self.cm.lookup(pick) is None:
+                # disconnected member: prefer a live one; remember the
+                # first parked persistent session as last resort
+                if (
+                    parked_fallback is None
+                    and self.cm.lookup_session(pick) is not None
+                ):
+                    parked_fallback = pick
+                tried.add(pick)
+                self.shared.member_failed(group, filt, pick)
+                continue
+            # deliver under the client's own subscription key
+            # ($share/<g>/<filt>) so session subopts/QoS apply
+            n = self._deliver_to(pick, [skey], tagged)
+            if n > 0:
+                return n
+            tried.add(pick)
+            self.shared.member_failed(group, filt, pick)
+        if parked_fallback is not None:
+            n = self._deliver_to(parked_fallback, [skey], tagged)
+            if n > 0:
+                return n
+        self.metrics.inc("messages.dropped.no_shared_member")
+        return 0
+
+    def redispatch_shared_pending(self, session) -> int:
+        """A member died with undelivered shared messages: hand its
+        pending copies (mqueue + unacked inflight) to other members
+        (`emqx_shared_sub:redispatch`, session-terminate path).
+
+        wait_comp entries are excluded — the receiver already holds the
+        QoS2 message; redispatching would duplicate it.
+
+        Entries are CONSUMED from the dying session as they are handed
+        over, so a second sweep over the same session (terminate and
+        discard can both fire) redispatches nothing twice."""
+        dead = session.clientid
+        pending: List[Message] = []
+        for m in session.mqueue.drain_all():
+            if m.headers.get("shared"):
+                pending.append(m)
+        for pid, ent in list(session.inflight.items()):
+            m = ent.message
+            if (
+                ent.phase in ("wait_ack", "wait_rec")
+                and m is not None
+                and m.headers.get("shared")
+            ):
+                session.inflight.delete(pid)
+                pending.append(m)
+        n = 0
+        for m in pending:
+            group, filt = m.headers["shared"]
+            if self.shared.is_member(group, filt, dead):
+                # membership not yet dropped (redispatch before clean)
+                n += self._dispatch_shared(m, group, filt, exclude={dead})
+            else:
+                n += self._dispatch_shared(m, group, filt)
+            self.metrics.inc("messages.shared.redispatched")
         return n
 
     def _deliver_to(self, cid: str, filts: List[str], msg: Message) -> int:

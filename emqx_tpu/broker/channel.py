@@ -612,9 +612,12 @@ class Channel:
                     self.broker.publish(self.will_msg)
                 self.will_msg = None
             if self.session.expiry_interval == 0:
-                # session dies with the connection: clean routes
+                # session dies with the connection: clean routes; pending
+                # shared-group deliveries fail over to surviving members
                 self.broker.client_down(
-                    self.clientid, list(self.session.subscriptions)
+                    self.clientid,
+                    list(self.session.subscriptions),
+                    session=self.session,
                 )
                 self._m("session.terminated")
             self.broker.cm.disconnect_channel(self)

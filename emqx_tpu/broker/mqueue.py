@@ -71,6 +71,15 @@ class MQueue:
         self._len -= 1
         return m
 
+    def drain_all(self) -> List[Message]:
+        """Pop everything (session-death redispatch sweep)."""
+        out: List[Message] = []
+        while True:
+            m = self.pop()
+            if m is None:
+                return out
+            out.append(m)
+
     def peek_all(self) -> List[Message]:
         out: List[Message] = []
         for p in sorted(self._qs, reverse=True):

@@ -54,6 +54,13 @@ def message_to_dict(msg: Message) -> dict:
             for k, v in msg.properties.items()
             if isinstance(v, (int, float, str, bool))
         },
+        # headers carry routing tags (e.g. "shared" -> (group, filter)
+        # for redispatch-on-death); keep the JSON-safe ones
+        "headers": {
+            k: list(v) if isinstance(v, tuple) else v
+            for k, v in msg.headers.items()
+            if isinstance(v, (int, float, str, bool, list, tuple))
+        },
     }
 
 
@@ -75,6 +82,7 @@ def message_from_dict(d: dict) -> Message:
         mid=bytes.fromhex(d["mid"]) if d.get("mid") else b"",
         timestamp=d.get("ts", 0),
         properties=props,
+        headers=dict(d.get("headers") or {}),
     )
 
 

@@ -11,7 +11,7 @@ only (SURVEY.md §7.3).
 from __future__ import annotations
 
 import random
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 STRATEGIES = ("random", "round_robin", "sticky", "hash_clientid", "hash_topic")
 
@@ -62,11 +62,23 @@ class SharedSub:
         return [k for k in self._groups if k[1] == filt]
 
     def pick(
-        self, group: str, filt: str, topic: str, from_client: str
+        self,
+        group: str,
+        filt: str,
+        topic: str,
+        from_client: str,
+        exclude: Optional[Set[str]] = None,
     ) -> Optional[str]:
-        """Pick the receiving member for one publish (None if group empty)."""
+        """Pick the receiving member for one publish (None if none eligible).
+
+        `exclude` carries members that already failed this delivery — the
+        redispatch loop (`emqx_shared_sub:redispatch`, `:118-130`) retries
+        with the failed picks excluded until the group is exhausted.
+        """
         key = (group, filt)
         members = self._groups.get(key)
+        if exclude:
+            members = [m for m in members or () if m not in exclude]
         if not members:
             return None
         s = self.strategy
@@ -86,3 +98,11 @@ class SharedSub:
         if s == "hash_clientid":
             return members[hash(from_client) % len(members)]
         return members[hash(topic) % len(members)]  # hash_topic
+
+    def member_failed(self, group: str, filt: str, clientid: str) -> None:
+        """A delivery to this member failed: invalidate a sticky pick so
+        the next publish re-picks (`emqx_shared_sub.erl:347-350` clears
+        the sticky pid on DOWN)."""
+        key = (group, filt)
+        if self._sticky.get(key) == clientid:
+            del self._sticky[key]

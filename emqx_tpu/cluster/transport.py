@@ -21,6 +21,7 @@ import hmac
 import itertools
 import json
 import logging
+import os
 import struct
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
@@ -45,20 +46,25 @@ class RpcError(Exception):
     pass
 
 
-def hello_auth(cookie: str, node: str, incarnation) -> str:
+def hello_auth(cookie: str, node: str, incarnation, nonce: str) -> str:
     """Keyed proof of the shared cluster cookie for the HELLO exchange.
 
     The reference gates node joins on the Erlang distribution cookie;
-    here the cookie never crosses the wire — each side sends
-    HMAC(cookie, node:incarnation) and verifies the peer's.
+    here the cookie never crosses the wire — each side proves it with
+    HMAC(cookie, node:incarnation:peer_nonce).  Binding to the PEER's
+    fresh nonce makes a captured frame worthless for replay.
     """
     return hmac.new(
-        cookie.encode(), f"{node}:{incarnation}".encode(), hashlib.sha256
+        cookie.encode(),
+        f"{node}:{incarnation}:{nonce}".encode(),
+        hashlib.sha256,
     ).hexdigest()
 
 
-def check_hello_auth(cookie: str, obj: dict) -> bool:
-    want = hello_auth(cookie, obj.get("node", "?"), obj.get("incarnation"))
+def check_hello_auth(cookie: str, obj: dict, nonce: str) -> bool:
+    want = hello_auth(
+        cookie, obj.get("node", "?"), obj.get("incarnation"), nonce
+    )
     return hmac.compare_digest(want, obj.get("auth") or "")
 
 
@@ -139,16 +145,27 @@ class PeerLink:
             try:
                 reader, writer = await asyncio.open_connection(*self.addr)
                 self._writer = writer
+                # 1. server opens with HELLO{"challenge": nonce}
+                ftype, body = await read_frame(reader)
+                if ftype != HELLO:
+                    raise ConnectionError("expected server challenge")
+                server_nonce = json.loads(body).get("challenge", "")
+                # 2. our HELLO proves the cookie against the server nonce
+                #    and carries our own nonce for the server's proof
+                my_nonce = os.urandom(16).hex()
                 my_hello = {
                     "node": self.self_node,
                     "incarnation": self.incarnation,
+                    "challenge": my_nonce,
                 }
                 if self.cookie:
                     my_hello["auth"] = hello_auth(
-                        self.cookie, self.self_node, self.incarnation
+                        self.cookie, self.self_node, self.incarnation,
+                        server_nonce,
                     )
                 writer.write(pack_json(HELLO, my_hello))
                 await writer.drain()
+                # 3. greeting proves the server's cookie against our nonce
                 ftype, body = await read_frame(reader)
                 if ftype != HELLO:
                     raise ConnectionError("expected HELLO")
@@ -162,7 +179,9 @@ class PeerLink:
                             greeting["error"],
                         )
                     raise ConnectionError(f"hello rejected: {greeting['error']}")
-                if self.cookie and not check_hello_auth(self.cookie, greeting):
+                if self.cookie and not check_hello_auth(
+                    self.cookie, greeting, my_nonce
+                ):
                     if not self._auth_warned:
                         self._auth_warned = True
                         log.warning(
@@ -325,12 +344,20 @@ class Transport:
                 await writer.drain()
 
         try:
+            # 1. open with a fresh challenge; the peer's cookie proof must
+            #    be bound to it (replayed HELLOs verify against a stale
+            #    nonce and fail)
+            my_nonce = os.urandom(16).hex()
+            writer.write(pack_json(HELLO, {"challenge": my_nonce}))
+            await writer.drain()
             ftype, body = await read_frame(reader)
             if ftype != HELLO:
                 return
             hello = json.loads(body)
             peer_name = hello.get("node", "?")
-            if self.cookie and not check_hello_auth(self.cookie, hello):
+            if self.cookie and not check_hello_auth(
+                self.cookie, hello, my_nonce
+            ):
                 log.warning(
                     "rejecting link from %s: bad cluster cookie", peer_name
                 )
@@ -341,7 +368,10 @@ class Transport:
             greeting.update(self.on_hello(peer_name, hello) or {})
             if self.cookie:
                 greeting["auth"] = hello_auth(
-                    self.cookie, self.node, greeting.get("incarnation")
+                    self.cookie,
+                    self.node,
+                    greeting.get("incarnation"),
+                    hello.get("challenge", ""),
                 )
             writer.write(pack_json(HELLO, greeting))
             await writer.drain()

@@ -57,6 +57,12 @@ class TopicMatchEngine:
         self._deep = CpuTrieIndex()
         self._deep_fids: Set[int] = set()
 
+        # exact-match guarantee: verify device hash hits against stored
+        # filter words (default on; see match())
+        self.verify_matches = True
+        self.collision_count = 0
+        self.on_collision = None  # fn(topic, fid) — metrics hook
+
         self.epoch = 0  # bumps on every device-visible mutation
         self._dev: Optional[DeviceTables] = None
         self._dev_stale = True
@@ -203,7 +209,15 @@ class TopicMatchEngine:
     # -------------------------------------------------------------- match
 
     def match(self, topics: Sequence[str]) -> List[Set[int]]:
-        """Match a publish batch; returns the set of fids per topic."""
+        """Match a publish batch; returns the set of fids per topic.
+
+        Device hits are verified against host truth by default: the
+        device compares 2x32-bit lane hashes, so an astronomically-rare
+        lane collision between a topic and an unrelated same-shape filter
+        would otherwise cause a false delivery.  The reference's trie is
+        exact (`emqx_trie.erl:272-334`); `verify_matches` keeps that
+        guarantee, counting any discard in `collision_count` /
+        `on_collision`."""
         out: List[Set[int]] = [set() for _ in topics]
 
         if self.tables.n_entries:
@@ -218,7 +232,22 @@ class TopicMatchEngine:
             for i in range(len(topics)):
                 row = matched[i]
                 hits = row[row >= 0]
-                if hits.size:
+                if not hits.size:
+                    continue
+                if self.verify_matches:
+                    twords = topiclib.words(topics[i])
+                    for f in hits:
+                        fid = int(f)
+                        fwords = self._words.get(fid)
+                        if fwords is not None and topiclib.match_words(
+                            twords, fwords
+                        ):
+                            out[i].add(fid)
+                        else:
+                            self.collision_count += 1
+                            if self.on_collision is not None:
+                                self.on_collision(topics[i], fid)
+                else:
                     out[i].update(int(f) for f in hits)
 
         if self._deep_fids:

@@ -291,3 +291,37 @@ def test_cluster_cookie_auth(run):
         await stop_all([n0, n1, bad])
 
     run(main())
+
+
+def test_cluster_cookie_replay_rejected(run):
+    """A captured HELLO frame must not authenticate a replaying attacker:
+    the cookie proof is bound to a per-connection server nonce."""
+    import json as _json
+
+    from emqx_tpu.cluster import transport as tp
+
+    async def main():
+        b0 = ClusterBroker()
+        n0 = ClusterNode("r0", b0, heartbeat_ivl=0.2, cookie="sk")
+        await n0.start()
+
+        # a legitimate HELLO captured from some prior connection (attacker
+        # knows node/incarnation and an auth bound to an OLD nonce)
+        old_nonce = "deadbeef" * 4
+        captured = {
+            "node": "r1",
+            "incarnation": 123,
+            "challenge": "aa" * 16,
+            "auth": tp.hello_auth("sk", "r1", 123, old_nonce),
+        }
+        r, w = await asyncio.open_connection("127.0.0.1", n0.transport.port)
+        ftype, body = await tp.read_frame(r)
+        assert ftype == tp.HELLO and _json.loads(body)["challenge"] != old_nonce
+        w.write(tp.pack_json(tp.HELLO, captured))
+        await w.drain()
+        ftype, body = await tp.read_frame(r)
+        assert _json.loads(body).get("error") == "bad_cookie"
+        w.close()
+        await n0.stop()
+
+    run(main())

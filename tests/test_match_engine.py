@@ -184,3 +184,41 @@ def test_bulk_rebuild_duplicate_key_fast_fail():
     with pytest.raises(RuntimeError, match="refcount per unique filter"):
         t.bulk_insert(filters, list(range(len(filters))))
     assert t.log2cap <= 12  # fast-fail happened before growth runaway
+
+
+def test_injected_collision_detected():
+    """Exact-match guarantee: corrupt a filter's stored words so the
+    device hash table says 'hit' while host truth says 'no match' —
+    the hit must be discarded and counted, not delivered."""
+    eng = TopicMatchEngine()
+    fid = eng.add_filter("sensors/+/temp")
+    eng.add_filter("other/x")
+    hits = []
+    eng.on_collision = lambda topic, f: hits.append((topic, f))
+
+    assert eng.match(["sensors/3/temp"])[0] == {fid}
+
+    # simulate a lane collision: device table still hashes the original
+    # filter, but pretend fid actually belongs to an unrelated filter
+    eng._words[fid] = ["not", "related"]
+    assert eng.match(["sensors/3/temp"])[0] == set()
+    assert eng.collision_count == 1
+    assert hits == [("sensors/3/temp", fid)]
+
+    # verification off -> the (false) device hit passes through
+    eng.verify_matches = False
+    assert eng.match(["sensors/3/temp"])[0] == {fid}
+
+
+def test_broker_counts_collisions():
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.packet import SubOpts
+
+    b = Broker()
+    b.subscribe("c1", "a/+", SubOpts(qos=0))
+    fid = b.engine.fid_of("a/+")
+    b.engine._words[fid] = ["mismatch"]
+    from emqx_tpu.broker.message import Message
+
+    assert b.publish(Message(topic="a/1", payload=b"x")) == 0
+    assert b.metrics.get("match.hash_collision") == 1

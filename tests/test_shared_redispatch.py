@@ -1,0 +1,226 @@
+"""Shared-subscription redispatch — `emqx_shared_sub.erl:118-130,347-350`.
+
+Delivery failover across group members, sticky invalidation on death,
+and redispatch of unacked QoS1/2 deliveries when the picked member dies
+mid-delivery (the VERDICT #5 done-condition, over real sockets).
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.shared_sub import SharedSub
+from emqx_tpu.broker.session import Session
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_pick_exclude_and_sticky_invalidate():
+    s = SharedSub(strategy="sticky", seed=7)
+    s.subscribe("g", "t", "a")
+    s.subscribe("g", "t", "b")
+    first = s.pick("g", "t", "t", "")
+    assert s.pick("g", "t", "t", "") == first  # sticky
+    other = s.pick("g", "t", "t", "", exclude={first})
+    assert other != first
+    s.member_failed("g", "t", first)
+    # sticky re-picks after invalidation; the failed member may still be
+    # picked by chance, so force exclusion to check the re-pick path
+    assert s.pick("g", "t", "t", "", exclude={first}) == other
+
+
+def test_round_robin_skips_excluded():
+    s = SharedSub(strategy="round_robin")
+    for m in ("a", "b", "c"):
+        s.subscribe("g", "t", m)
+    seen = {s.pick("g", "t", "t", "", exclude={"b"}) for _ in range(6)}
+    assert seen == {"a", "c"}
+
+
+class _DeadChannel:
+    """ChannelLike whose deliver always lands in session (sink)."""
+
+    def __init__(self, broker, clientid):
+        self.clientid = clientid
+        self.session = Session(clientid)
+        self.delivered = []
+        broker.cm.channels[clientid] = self
+
+    def deliver(self, delivers):
+        self.delivered.extend(delivers)
+
+    def kick(self, rc):
+        pass
+
+
+def test_broker_failover_to_live_member():
+    b = Broker()
+    b.shared.strategy = "sticky"
+    alive = _DeadChannel(b, "alive")
+    b.subscribe("alive", "$share/g/s/1", SubOpts(qos=1))
+    # dead member: in the group, but no channel/session behind it
+    b.shared.subscribe("g", "s/1", "ghost")
+    b.shared._sticky[("g", "s/1")] = "ghost"  # force the dead pick first
+    n = b.publish(Message(topic="s/1", payload=b"x", qos=1))
+    assert n == 1
+    assert alive.delivered and alive.delivered[0][0] == "$share/g/s/1"
+
+
+def test_parked_member_used_only_as_last_resort():
+    b = Broker()
+    # parked persistent member (subscribed, then its connection parked)
+    b.subscribe("parked", "$share/g/p/1", SubOpts(qos=1))
+    parked = Session("parked", expiry_interval=300)
+    parked.subscribe("$share/g/p/1", SubOpts(qos=1))
+    b.cm.pending["parked"] = (parked, float("inf"))
+
+    live = _DeadChannel(b, "live")
+    b.subscribe("live", "$share/g/p/1", SubOpts(qos=1))
+    for _ in range(8):
+        b.publish(Message(topic="p/1", payload=b"x", qos=1))
+    assert len(live.delivered) == 8  # all to the live member
+    assert len(parked.mqueue) == 0
+
+    # live member gone -> parked persistent member gets the message
+    b.cm.channels.pop("live")
+    b.client_down("live", ["$share/g/p/1"])
+    b.publish(Message(topic="p/1", payload=b"park-it", qos=1))
+    assert len(parked.mqueue) == 1
+
+
+# ------------------------------------------------------------- sockets
+
+
+async def start_broker():
+    broker = Broker()
+    lst = Listener(broker, port=0)
+    await lst.start()
+    return broker, lst
+
+
+def test_kill_picked_member_mid_delivery_qos1(run):
+    """QoS1 delivered to member A, A dies without acking -> the same
+    message arrives at member B."""
+
+    async def main():
+        broker, lst = await start_broker()
+        broker.shared.strategy = "sticky"
+
+        a = MqttClient(clientid="m-a", auto_ack=False)
+        await a.connect(port=lst.port)
+        await a.subscribe("$share/grp/job/+", qos=1)
+        b = MqttClient(clientid="m-b")
+        await b.connect(port=lst.port)
+        await b.subscribe("$share/grp/job/+", qos=1)
+
+        pub = MqttClient(clientid="m-pub")
+        await pub.connect(port=lst.port)
+        broker.shared._sticky[("grp", "job/+")] = "m-a"
+        await pub.publish("job/1", b"task-1", qos=1)
+
+        m = await asyncio.wait_for(a.recv(), 5)
+        assert m.payload == b"task-1"  # A got it, never acks
+
+        await a.close()  # hard kill mid-delivery
+        m = await asyncio.wait_for(b.recv(), 5)
+        assert m.payload == b"task-1"  # redispatched to B
+        assert broker.metrics.get("messages.shared.redispatched") == 1
+        # terminate + discard both sweep the session — exactly once
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(b.recv(), 0.5)
+
+        await b.disconnect()
+        await pub.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_mqueued_shared_messages_redispatch_on_death(run):
+    """Messages still queued (inflight full) also fail over."""
+
+    async def main():
+        broker, lst = await start_broker()
+        broker.shared.strategy = "sticky"
+        from emqx_tpu.broker.channel import ChannelConfig
+
+        lst.config = ChannelConfig(max_inflight=1)
+
+        a = MqttClient(clientid="q-a", auto_ack=False)
+        await a.connect(port=lst.port)
+        await a.subscribe("$share/g2/q/+", qos=1)
+        b = MqttClient(clientid="q-b")
+        await b.connect(port=lst.port)
+        await b.subscribe("$share/g2/q/+", qos=1)
+
+        pub = MqttClient(clientid="q-pub")
+        await pub.connect(port=lst.port)
+        broker.shared._sticky[("g2", "q/+")] = "q-a"
+        # 1 fills A's inflight window; 2..3 park in A's mqueue
+        for i in range(3):
+            await pub.publish(f"q/{i}", f"m{i}".encode(), qos=1)
+        await asyncio.wait_for(a.recv(), 5)
+
+        await a.close()
+        got = set()
+        for _ in range(3):
+            m = await asyncio.wait_for(b.recv(), 5)
+            got.add(m.payload)
+        assert got == {b"m0", b"m1", b"m2"}
+
+        await b.disconnect()
+        await pub.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_qos2_wait_comp_not_redispatched(run):
+    """A QoS2 message already PUBREC'd (receiver owns it) must NOT be
+    redispatched — that would duplicate delivery."""
+
+    async def main():
+        broker, lst = await start_broker()
+        broker.shared.strategy = "sticky"
+
+        a = MqttClient(clientid="c-a")  # auto-acks PUBREC -> wait_comp
+        await a.connect(port=lst.port)
+        await a.subscribe("$share/g3/c/+", qos=2)
+        b = MqttClient(clientid="c-b")
+        await b.connect(port=lst.port)
+        await b.subscribe("$share/g3/c/+", qos=2)
+
+        pub = MqttClient(clientid="c-pub")
+        await pub.connect(port=lst.port)
+        broker.shared._sticky[("g3", "c/+")] = "c-a"
+        await pub.publish("c/1", b"exactly-once", qos=2)
+        m = await asyncio.wait_for(a.recv(), 5)
+        assert m.payload == b"exactly-once"
+        await asyncio.sleep(0.1)  # let PUBREC/PUBREL settle to wait_comp
+
+        ch = broker.cm.channels["c-a"]
+        phases = [e.phase for _p, e in ch.session.inflight.items()]
+        assert phases in ([], ["wait_comp"]), phases
+
+        await a.close()
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(b.recv(), 1.0)
+
+        await b.disconnect()
+        await pub.disconnect()
+        await lst.stop()
+
+    run(main())
