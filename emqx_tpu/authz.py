@@ -177,6 +177,64 @@ class HttpSource(AuthzSource):
         return {"allow": ALLOW, "deny": DENY}.get(result, NOMATCH)
 
 
+class DbSource(AuthzSource):
+    """ACL rows from an injected database driver.
+
+    The analog of `emqx_authz_{mysql,pgsql,redis}.erl`: a query template
+    returns (permission, action, topic) rows evaluated in order; Redis
+    uses command("HGETALL", key) with topic->action hashes like the
+    reference's redis source.  Driver errors -> NOMATCH (fail to the
+    chain default), matching the reference's ignore-on-resource-error.
+    """
+
+    name = "db"
+
+    def __init__(self, kind: str, query: str, driver=None, **driver_cfg):
+        from . import drivers
+
+        self.kind = kind
+        self.name = kind
+        self.query = query
+        self.driver = driver if driver is not None else drivers.make_driver(
+            kind, **driver_cfg
+        )
+
+    def authorize(self, ci: ClientInfo, action: str, topic: str) -> str:
+        from . import drivers
+
+        params = drivers.render_vars(ci)
+        try:
+            if self.kind == "redis":
+                key = drivers.render_template(self.query, params)
+                row = self.driver.command("HGETALL", key) or {}
+                # topic_filter -> "publish"|"subscribe"|"all" (allow-only,
+                # like the reference's redis source)
+                for filt, act in row.items():
+                    if act not in ("publish", "subscribe", "all"):
+                        continue
+                    if act != "all" and (
+                        (act == "publish") != (action == PUB)
+                    ):
+                        continue
+                    if topiclib.match(topic, filt):
+                        return ALLOW
+                return NOMATCH
+            rows = self.driver.query(self.query, params)
+        except Exception:
+            return NOMATCH
+        for row in rows or []:
+            rule = Rule(
+                permission=row.get("permission", "allow"),
+                who="all",  # the query already filtered by client vars
+                action=row.get("action", "all"),
+                topics=[row.get("topic", "#")],
+            )
+            v = rule.check(ci, action, topic)
+            if v != NOMATCH:
+                return v
+        return NOMATCH
+
+
 class AuthzChain:
     """Source list evaluated in order; default verdict on no match.
 

@@ -1,0 +1,180 @@
+"""bcrypt ($2b$) password hashing over the native EksBlowfish core.
+
+The reference pulls bcrypt in as a C NIF (`mix.exs` bcrypt_dep;
+`emqx_passwd.erl` hash verification).  Here the hot loop lives in
+`native/bcrypt.cc`; this wrapper supplies
+
+* the Blowfish initial state, derived at first use from pi's fractional
+  hex expansion (Machin arctan series over Python bigints — the
+  canonical constants, computed rather than copied);
+* the `$2b$` wire format: bcrypt's nonstandard base64 alphabet, salt
+  generation, constant-time verification.
+
+API mirrors the familiar bcrypt package: gensalt / hashpw / checkpw.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hmac
+import os
+import threading
+from typing import Optional
+
+from .ops import native
+
+_ALPHABET = "./ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789"
+_B64_INV = {c: i for i, c in enumerate(_ALPHABET)}
+
+_N_WORDS = 18 + 4 * 256  # P-array + S-boxes
+
+_init_lock = threading.Lock()
+_initialized = False
+
+
+# ------------------------------------------------------------------ pi
+
+def _pi_fraction_words(n_words: int) -> list:
+    """First `n_words` 32-bit words of pi's fractional part in hex.
+
+    Machin's formula pi = 16*atan(1/5) - 4*atan(1/239) evaluated in
+    fixed-point integer arithmetic with guard bits.  Word 0 is
+    0x243F6A88 — the universally known leading digits 3.243F6A88...
+    """
+    bits = 32 * n_words + 64  # guard bits
+    one = 1 << bits
+
+    def atan_inv(x: int) -> int:
+        # atan(1/x) * 2^bits, alternating series over integers
+        total = 0
+        term = one // x
+        x2 = x * x
+        k = 0
+        while term:
+            total += term // (2 * k + 1) if k % 2 == 0 else -(term // (2 * k + 1))
+            term //= x2
+            k += 1
+        return total
+
+    pi = 16 * atan_inv(5) - 4 * atan_inv(239)
+    frac = pi - 3 * one  # fractional part, bits of precision
+    words = []
+    for i in range(n_words):
+        shift = bits - 32 * (i + 1)
+        words.append((frac >> shift) & 0xFFFFFFFF)
+    return words
+
+
+def _ensure_init() -> ctypes.CDLL:
+    global _initialized
+    lib = native.get_lib()
+    if lib is None:
+        raise RuntimeError(
+            "bcrypt requires the native library (native/bcrypt.cc); "
+            "g++ build failed or unavailable"
+        )
+    if not _initialized:
+        with _init_lock:
+            if not _initialized:
+                words = _pi_fraction_words(_N_WORDS)
+                assert words[0] == 0x243F6A88, hex(words[0])  # pi sanity
+                arr = (ctypes.c_uint32 * _N_WORDS)(*words)
+                lib.etpu_bcrypt_init(arr)
+                _initialized = True
+    return lib
+
+
+# ------------------------------------------------------------- base64
+
+def _b64_encode(data: bytes) -> str:
+    out = []
+    i = 0
+    while i < len(data):
+        c1 = data[i]
+        out.append(_ALPHABET[c1 >> 2])
+        c1 = (c1 & 0x03) << 4
+        if i + 1 >= len(data):
+            out.append(_ALPHABET[c1])
+            break
+        c2 = data[i + 1]
+        c1 |= c2 >> 4
+        out.append(_ALPHABET[c1])
+        c1 = (c2 & 0x0F) << 2
+        if i + 2 >= len(data):
+            out.append(_ALPHABET[c1])
+            break
+        c3 = data[i + 2]
+        c1 |= c3 >> 6
+        out.append(_ALPHABET[c1])
+        out.append(_ALPHABET[c3 & 0x3F])
+        i += 3
+    return "".join(out)
+
+
+def _b64_decode(s: str, n_bytes: int) -> bytes:
+    bits = 0
+    acc = 0
+    out = bytearray()
+    for ch in s:
+        v = _B64_INV.get(ch)
+        if v is None:
+            raise ValueError(f"invalid bcrypt base64 char {ch!r}")
+        acc = (acc << 6) | v
+        bits += 6
+        if bits >= 8:
+            bits -= 8
+            out.append((acc >> bits) & 0xFF)
+    return bytes(out[:n_bytes])
+
+
+# ----------------------------------------------------------------- api
+
+def gensalt(rounds: int = 12) -> str:
+    if not 4 <= rounds <= 31:
+        raise ValueError("bcrypt cost must be in [4, 31]")
+    return f"$2b$" + f"{rounds:02d}$" + _b64_encode(os.urandom(16))
+
+
+def _parse(salt_or_hash: str):
+    parts = salt_or_hash.split("$")
+    if len(parts) < 4 or parts[1] not in ("2b", "2a", "2y") or len(parts[3]) < 22:
+        raise ValueError("malformed bcrypt salt/hash")
+    rounds = int(parts[2])
+    salt = _b64_decode(parts[3][:22], 16)
+    return parts[1], rounds, salt
+
+
+def hashpw(password: bytes, salt: str) -> str:
+    """Hash `password` with a `$2b$NN$...` salt (or full hash) string."""
+    if isinstance(password, str):
+        password = password.encode("utf-8")
+    variant, rounds, salt_raw = _parse(salt)
+    lib = _ensure_init()
+    key = password[:72] + b"\x00"  # $2b$: cap, then trailing NUL
+    out = (ctypes.c_uint8 * 24)()
+    rc = lib.etpu_bcrypt_hash(
+        (ctypes.c_uint8 * len(key)).from_buffer_copy(key),
+        len(key),
+        (ctypes.c_uint8 * 16).from_buffer_copy(salt_raw),
+        rounds,
+        out,
+    )
+    if rc != 0:
+        raise RuntimeError("bcrypt native core rejected input")
+    digest = bytes(out)[:23]
+    return f"${variant}${rounds:02d}$" + _b64_encode(salt_raw)[:22] + _b64_encode(digest)
+
+
+def checkpw(password: bytes, hashed: str) -> bool:
+    try:
+        return hmac.compare_digest(hashpw(password, hashed), hashed)
+    except (ValueError, RuntimeError):
+        return False
+
+
+def available() -> bool:
+    try:
+        _ensure_init()
+        return True
+    except RuntimeError:
+        return False

@@ -166,18 +166,56 @@ class MqttConnector:
             pass
 
 
-_UNAVAILABLE = ("mysql", "pgsql", "mongodb", "redis", "ldap")
+class DbConnector:
+    """Resource-manager adapter over an injected database driver
+    (`emqx_connector_{mysql,pgsql,redis,mongo}` analog).  The sync
+    driver contract (emqx_tpu.drivers) is bridged onto the async
+    resource lifecycle with to_thread so a slow database cannot stall
+    the event loop."""
+
+    def __init__(self, kind: str, driver=None, **driver_cfg):
+        from .. import drivers
+
+        self.kind = kind
+        self.driver = driver if driver is not None else drivers.make_driver(
+            kind, **driver_cfg
+        )
+
+    async def start(self) -> None:
+        fn = getattr(self.driver, "start", None)
+        if fn is not None:
+            await asyncio.to_thread(fn)
+
+    async def stop(self) -> None:
+        fn = getattr(self.driver, "stop", None)
+        if fn is not None:
+            await asyncio.to_thread(fn)
+
+    async def health_check(self) -> bool:
+        try:
+            return bool(await asyncio.to_thread(self.driver.health_check))
+        except Exception:
+            return False
+
+    async def query(self, statement: str, params: Optional[dict] = None):
+        return await asyncio.to_thread(self.driver.query, statement, params or {})
+
+    async def command(self, *args):
+        return await asyncio.to_thread(self.driver.command, *args)
 
 
 def make_connector(kind: str, **cfg):
-    """Connector factory keyed like the reference's connector types."""
+    """Connector factory keyed like the reference's connector types.
+
+    DB kinds resolve through the driver registry
+    (emqx_tpu.drivers.register_driver); without a registered driver they
+    raise DriverUnavailable at create time — loud, not silent."""
+    from .. import drivers
+
     if kind == "http":
         return HttpConnector(**cfg)
     if kind == "mqtt":
         return MqttConnector(**cfg)
-    if kind in _UNAVAILABLE:
-        raise NotImplementedError(
-            f"{kind} connector needs a database driver not present in this "
-            f"environment; gate the bridge config on driver availability"
-        )
+    if kind in drivers.DB_KINDS:
+        return DbConnector(kind, **cfg)
     raise ValueError(f"unknown connector kind {kind!r}")

@@ -26,6 +26,7 @@ from .session import Session, SessionError
 Action = Tuple[str, Any]  # ('send', Packet) | ('close', rc|None) | ('connected',)
 
 IDLE, CONNECTED, DISCONNECTED = "idle", "connected", "disconnected"
+AUTHENTICATING = "authenticating"  # mid enhanced-auth handshake (v5 AUTH)
 
 
 @dataclass
@@ -83,6 +84,9 @@ class Channel:
         self.alias_out: Dict[str, int] = {}
         self.connected_at: Optional[float] = None
         self.disconnect_reason: Optional[int] = None
+        # connect-time enhanced auth: stashed CONNECT while AUTH rounds run
+        self._pending_connect: Optional[tuple] = None
+        self._auth_method: Optional[str] = None
         self._takeover = False
         # connection layer integration: out_cb receives actions produced
         # outside handle_in (broker deliveries, kicks); tests collect them.
@@ -117,6 +121,12 @@ class Channel:
         self._m("packets.received")
         t = p.type
         if self.state == IDLE and t != PacketType.CONNECT:
+            return self._close(ReasonCode.PROTOCOL_ERROR)
+        if self.state == AUTHENTICATING and t not in (
+            PacketType.AUTH,
+            PacketType.DISCONNECT,
+        ):
+            # MQTT-3.15: only AUTH/DISCONNECT may flow mid-handshake
             return self._close(ReasonCode.PROTOCOL_ERROR)
         if self.state == CONNECTED and t == PacketType.CONNECT:
             return self._close(ReasonCode.PROTOCOL_ERROR, send_disconnect=True)
@@ -191,12 +201,75 @@ class Channel:
         if self.peer_cert:
             self.clientinfo.attrs["peer_cert"] = dict(self.peer_cert)
 
-        auth = self.access.authenticate(self.clientinfo)
+        # enhanced (SASL-style) auth at CONNECT (MQTT-4.12): the v5
+        # AUTHENTICATION_METHOD property opens an AUTH-packet handshake
+        # instead of the password check (reference: emqx_channel
+        # enhanced_auth / emqx_authn SCRAM providers)
+        method = (
+            p.properties.get(Property.AUTHENTICATION_METHOD)
+            if self.v5
+            else None
+        )
+        extra_props: pkt.Properties = {}
+        if method:
+            data = p.properties.get(Property.AUTHENTICATION_DATA, b"")
+            out = self.broker.hooks.run_fold(
+                "client.enhanced_auth_start",
+                (self.clientinfo, method, data),
+                None,
+            )
+            if out is None:
+                self._m("authentication.failure")
+                return self._connack_fail(ReasonCode.BAD_AUTHENTICATION_METHOD)
+            action, payload = out
+            if action == "continue":
+                self._pending_connect = (p, clientid, username, assigned)
+                self._auth_method = method
+                self.state = AUTHENTICATING
+                self._m("packets.auth.sent")
+                return [
+                    (
+                        "send",
+                        pkt.Auth(
+                            reason_code=ReasonCode.CONTINUE_AUTHENTICATION,
+                            properties={
+                                Property.AUTHENTICATION_METHOD: method,
+                                Property.AUTHENTICATION_DATA: payload or b"",
+                            },
+                        ),
+                    )
+                ]
+            if action != "ok":
+                self._m("authentication.failure")
+                return self._connack_fail(ReasonCode.NOT_AUTHORIZED)
+            auth = {"result": ALLOW}
+            if isinstance(payload, dict):
+                auth.update(payload)
+            elif isinstance(payload, (bytes, bytearray)):
+                extra_props[Property.AUTHENTICATION_METHOD] = method
+                extra_props[Property.AUTHENTICATION_DATA] = bytes(payload)
+        else:
+            auth = self.access.authenticate(self.clientinfo)
         if auth.get("result") != ALLOW:
             self._m("authentication.failure")
             return self._connack_fail(
                 auth.get("reason_code", ReasonCode.NOT_AUTHORIZED)
             )
+        return self._connect_phase2(p, clientid, username, assigned, auth,
+                                    extra_props)
+
+    def _connect_phase2(
+        self,
+        p: pkt.Connect,
+        clientid: str,
+        username,
+        assigned: bool,
+        auth: dict,
+        extra_props: Optional[pkt.Properties] = None,
+    ) -> List[Action]:
+        """Post-authentication half of CONNECT processing: hooks, will,
+        session open, CONNACK.  Split out so the enhanced-auth handshake
+        can resume here after its AUTH rounds."""
         self._m("authentication.success")
         self.clientinfo.is_superuser = bool(auth.get("is_superuser"))
         for k in ("acl", "expire_at"):
@@ -205,6 +278,7 @@ class Channel:
 
         if self.broker.hooks.run_fold("client.connect", (self.clientinfo,), ALLOW) == DENY:
             return self._connack_fail(ReasonCode.BANNED)
+        username = self.clientinfo.username
 
         # will message
         if p.will_flag:
@@ -235,7 +309,7 @@ class Channel:
         self.connected_at = time.time()
         self.broker.cm.register_channel(self)
 
-        props: pkt.Properties = {}
+        props: pkt.Properties = dict(extra_props or {})
         if self.v5:
             if assigned:
                 props[Property.ASSIGNED_CLIENT_IDENTIFIER] = clientid
@@ -515,19 +589,69 @@ class Channel:
 
     def _in_auth(self, p: pkt.Auth) -> List[Action]:
         self._m("packets.auth.received")
-        # Enhanced (SASL-style) auth: delegated to the 'client.enhanced_auth'
-        # chain; without a registered provider it is a protocol error, like
-        # a reference broker with no matching authenticator.
-        out = self.broker.hooks.run_fold("client.enhanced_auth", (self.clientinfo, p), None)
+        # Enhanced (SASL-style) auth continuation: delegated to the
+        # 'client.enhanced_auth' chain; without a registered provider it
+        # is a protocol error, like a reference broker with no matching
+        # authenticator.  Handlers get (clientinfo, method, data, acc).
+        method = p.properties.get(Property.AUTHENTICATION_METHOD)
+        data = p.properties.get(Property.AUTHENTICATION_DATA, b"")
+        if method is not None and self._auth_method is not None and (
+            method != self._auth_method
+        ):
+            # MQTT-4.12.0-5: the method must not change mid-handshake
+            return self._auth_fail(ReasonCode.PROTOCOL_ERROR)
+        out = self.broker.hooks.run_fold(
+            "client.enhanced_auth", (self.clientinfo, method, data), None
+        )
         if out is None:
-            return self._close(ReasonCode.BAD_AUTHENTICATION_METHOD, send_disconnect=True)
+            return self._auth_fail(ReasonCode.BAD_AUTHENTICATION_METHOD)
         action, payload = out
-        if action == "ok":
-            return [("send", pkt.Auth(reason_code=0, properties=payload or {}))]
         if action == "continue":
             self._m("packets.auth.sent")
-            return [("send", pkt.Auth(reason_code=ReasonCode.CONTINUE_AUTHENTICATION, properties=payload or {}))]
-        return self._close(ReasonCode.NOT_AUTHORIZED, send_disconnect=True)
+            return [
+                (
+                    "send",
+                    pkt.Auth(
+                        reason_code=ReasonCode.CONTINUE_AUTHENTICATION,
+                        properties={
+                            Property.AUTHENTICATION_METHOD: method or "",
+                            Property.AUTHENTICATION_DATA: payload or b"",
+                        },
+                    ),
+                )
+            ]
+        if action != "ok":
+            self._m("authentication.failure")
+            return self._auth_fail(ReasonCode.NOT_AUTHORIZED)
+        final: pkt.Properties = {}
+        if method:
+            final[Property.AUTHENTICATION_METHOD] = method
+        if isinstance(payload, (bytes, bytearray)) and payload:
+            final[Property.AUTHENTICATION_DATA] = bytes(payload)
+        if self._pending_connect is not None:
+            # connect-time handshake finished: the server's final SCRAM
+            # data rides in CONNACK (MQTT-4.12.0-7)
+            pc, clientid, username, assigned = self._pending_connect
+            self._pending_connect = None
+            # the provider may have set identity fields on clientinfo
+            # (SCRAM authenticated username, superuser) — carry them over
+            auth = {
+                "result": ALLOW,
+                "is_superuser": self.clientinfo.is_superuser,
+            }
+            return self._connect_phase2(
+                pc, clientid, username, assigned, auth, final
+            )
+        # post-connect re-authentication: success AUTH closes the round
+        return [("send", pkt.Auth(reason_code=0, properties=final))]
+
+    def _auth_fail(self, rc: int) -> List[Action]:
+        """Abort an enhanced-auth handshake: CONNACK-fail pre-connect,
+        DISCONNECT post-connect."""
+        if self.state == AUTHENTICATING or self._pending_connect is not None:
+            self._pending_connect = None
+            return self._connack_fail(rc)
+        return self._close(rc, send_disconnect=True)
 
     # ----------------------------------------------------------- outbound
 

@@ -34,6 +34,7 @@ class MqttClient:
         properties: Optional[dict] = None,
         will: Optional[pkt.Connect] = None,
         auto_ack: bool = True,
+        scram=None,  # ScramClient: enhanced auth over AUTH packets
     ):
         self.clientid = clientid
         self.proto_ver = proto_ver
@@ -43,6 +44,8 @@ class MqttClient:
         self.password = password
         self.properties = properties or {}
         self.auto_ack = auto_ack
+        self.scram = scram
+        self.scram_server_verified: Optional[bool] = None
         self.will: Optional[Tuple[str, bytes, int, bool]] = None
 
         self.messages: asyncio.Queue = asyncio.Queue()
@@ -86,6 +89,15 @@ class MqttClient:
             password=self.password,
             properties=dict(self.properties),
         )
+        if self.scram is not None:
+            if self.proto_ver != MQTT_V5:
+                raise MqttError("SCRAM enhanced auth requires MQTT 5")
+            from ..scram import METHOD as SCRAM_METHOD
+
+            c.properties[pkt.Property.AUTHENTICATION_METHOD] = SCRAM_METHOD
+            c.properties[pkt.Property.AUTHENTICATION_DATA] = (
+                self.scram.client_first()
+            )
         if self.will:
             topic, payload, qos, retain = self.will
             c.will_flag = True
@@ -136,7 +148,32 @@ class MqttClient:
         t = p.type
         if t == PacketType.CONNACK:
             self.connack = p
+            if (
+                self.scram is not None
+                and p.reason_code == 0
+                and self.scram._salted is not None  # rounds actually ran
+            ):
+                data = p.properties.get(pkt.Property.AUTHENTICATION_DATA, b"")
+                self.scram_server_verified = self.scram.verify_server_final(
+                    data
+                )
             self._connected.set()
+        elif t == PacketType.AUTH:
+            if self.scram is not None and p.reason_code == 0x18:
+                data = p.properties.get(pkt.Property.AUTHENTICATION_DATA, b"")
+                from ..scram import METHOD as SCRAM_METHOD
+
+                self._send(
+                    pkt.Auth(
+                        reason_code=0x18,
+                        properties={
+                            pkt.Property.AUTHENTICATION_METHOD: SCRAM_METHOD,
+                            pkt.Property.AUTHENTICATION_DATA: (
+                                self.scram.client_final(data)
+                            ),
+                        },
+                    )
+                )
         elif t == PacketType.PUBLISH:
             if p.qos == 0:
                 await self.messages.put(p)

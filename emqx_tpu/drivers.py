@@ -1,0 +1,88 @@
+"""Database driver registry — the ecpool/epgsql/eredis/mongodb seam.
+
+The reference reaches MySQL/PgSQL/MongoDB/Redis/LDAP through pooled
+Erlang client deps (`rebar.config` ecpool/epgsql/eredis/...;
+`apps/emqx_connector/src/emqx_connector_{mysql,pgsql,redis,mongo}.erl`).
+None of those drivers exist in this image, so the framework ships the
+*contract* and an injection point instead of bundled clients:
+
+* a deployment registers a factory per kind —
+  ``register_driver("mysql", lambda **cfg: MyAdapter(cfg))`` — wrapping
+  whatever client library it has (aiomysql, asyncpg, redis-py, ...);
+* authn/authz/bridge components resolve drivers by kind at create time
+  and fail loudly when no driver is registered (matching the previous
+  explicit-unavailable behavior);
+* tests register in-memory fakes, which doubles as the contract spec.
+
+Driver contract (duck-typed; sync because the authn/authz hook chains
+run synchronously in the channel — wrap async clients accordingly):
+
+    start() -> None              optional; open pools
+    stop() -> None               optional; close pools
+    health_check() -> bool       liveness probe (resource manager)
+    query(statement: str, params: dict) -> List[dict]
+        SQL-flavored kinds: rows as dicts keyed by column name.
+        The ${var} placeholders of the reference's query templates are
+        passed through in `params` (username, clientid, peerhost, ...)
+        so the driver can bind them safely.
+    command(*args) -> Any
+        Command-flavored kinds (redis: ("HGETALL", key), mongo runs
+        find filters, ldap binds) — shape is kind-specific.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+DB_KINDS = ("mysql", "pgsql", "mongodb", "redis", "ldap")
+
+_registry: Dict[str, Callable[..., Any]] = {}
+
+
+class DriverUnavailable(NotImplementedError):
+    pass
+
+
+def register_driver(kind: str, factory: Callable[..., Any]) -> None:
+    """Install a driver factory for `kind` (overwrites any previous)."""
+    _registry[kind] = factory
+
+
+def unregister_driver(kind: str) -> None:
+    _registry.pop(kind, None)
+
+
+def driver_available(kind: str) -> bool:
+    return kind in _registry
+
+
+def make_driver(kind: str, **cfg) -> Any:
+    factory = _registry.get(kind)
+    if factory is None:
+        raise DriverUnavailable(
+            f"{kind} driver not registered: this environment ships no "
+            f"database clients — register one via "
+            f"emqx_tpu.drivers.register_driver({kind!r}, factory)"
+        )
+    return factory(**cfg)
+
+
+def render_template(template: str, params: Dict[str, str]) -> str:
+    """Substitute ${var} placeholders (redis keys, mongo filters)."""
+    for k, v in params.items():
+        template = template.replace("${" + k + "}", v)
+    return template
+
+
+def render_vars(clientinfo, extra: Optional[Dict[str, str]] = None
+                ) -> Dict[str, str]:
+    """The ${var} binding set of the reference's authn/authz templates
+    (emqx_authn_mysql: ${username}/${clientid}/${peerhost}/...)."""
+    out = {
+        "username": clientinfo.username or "",
+        "clientid": clientinfo.clientid or "",
+        "peerhost": (clientinfo.peerhost or "").split(":")[0],
+    }
+    if extra:
+        out.update(extra)
+    return out

@@ -118,6 +118,7 @@ class NodeRuntime:
             )
             self.flapping.install(self.broker.hooks)
         self.authn = None
+        self.scram = None
         if self.conf.get("authn.enable"):
             self.authn = AuthChain(
                 allow_anonymous=self.conf.get("authn.allow_anonymous")
@@ -266,9 +267,27 @@ class NodeRuntime:
         raise ConfigError(f"unknown listener type {kind!r}")
 
     def _build_authenticators(self, defs: List[Dict[str, Any]]) -> None:
+        from . import drivers as drivers_mod
+
         for d in defs:
             mech = d.get("mechanism", "password_based")
             backend = d.get("backend", "built_in_database")
+            if mech == "scram" or backend == "scram":
+                # enhanced auth rides its own hookpoints, not the chain
+                from .scram import ScramAuthenticator
+
+                s = ScramAuthenticator(
+                    iterations=int(d.get("iterations", 4096))
+                )
+                for u in d.get("users") or []:
+                    s.add_user(
+                        u["user_id"],
+                        u["password"],
+                        is_superuser=bool(u.get("is_superuser")),
+                    )
+                s.install(self.broker.hooks)
+                self.scram = s
+                continue
             if backend == "built_in_database":
                 a = BuiltInAuthenticator(
                     user_id_type=d.get("user_id_type", "username")
@@ -278,19 +297,42 @@ class NodeRuntime:
                         u["user_id"],
                         u["password"],
                         is_superuser=bool(u.get("is_superuser")),
+                        algorithm=d.get("password_hash_algorithm",
+                                        "pbkdf2_sha256"),
                     )
             elif backend == "jwt" or mech == "jwt":
                 a = JwtAuthenticator(secret=(d.get("secret") or "").encode())
+            elif backend in drivers_mod.DB_KINDS:
+                from .authn import DbAuthenticator
+
+                driver_cfg = {
+                    k: v
+                    for k, v in d.items()
+                    if k not in ("mechanism", "backend", "query",
+                                 "password_hash_algorithm", "iterations",
+                                 "user_id_type", "users")
+                }
+                a = DbAuthenticator(
+                    backend,
+                    d.get("query", ""),
+                    algorithm=d.get("password_hash_algorithm",
+                                    "pbkdf2_sha256"),
+                    iterations=int(d.get("iterations", 10_000)),
+                    **driver_cfg,
+                )
             else:
                 raise ConfigError(f"unsupported authenticator backend {backend!r}")
             self.authn.add(a)
 
     def _build_authz_sources(self, defs: List[Dict[str, Any]]) -> None:
-        from .authz import Rule
+        from . import drivers as drivers_mod
+        from .authz import DbSource, Rule
 
         for d in defs:
             t = d.get("type", "built_in_database")
-            if t == "built_in_database":
+            if t in drivers_mod.DB_KINDS:
+                self.authz.add(DbSource(t, d.get("query", "")))
+            elif t == "built_in_database":
                 self.authz.add(BuiltInSource())
             elif t == "client_acl":
                 self.authz.add(ClientAclSource())

@@ -22,7 +22,11 @@ import numpy as np
 log = logging.getLogger("emqx_tpu.native")
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libemqxtpu.so")
-_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "matchhash.cc")
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRCS = [
+    os.path.join(_SRC_DIR, "matchhash.cc"),
+    os.path.join(_SRC_DIR, "bcrypt.cc"),
+]
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -35,13 +39,13 @@ _i64p = ctypes.POINTER(ctypes.c_int64)
 
 
 def _build() -> bool:
-    src = os.path.abspath(_SRC)
-    if not os.path.exists(src):
+    srcs = [os.path.abspath(s) for s in _SRCS if os.path.exists(s)]
+    if not srcs:
         return False
     try:
         subprocess.run(
             ["g++", "-O3", "-Wall", "-fPIC", "-std=c++17", "-shared",
-             "-o", _LIB_PATH, src],
+             "-o", _LIB_PATH] + srcs,
             check=True, capture_output=True, timeout=120,
         )
         return True
@@ -76,6 +80,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _u32p, _u32p, _i32p, ctypes.c_int32, ctypes.c_int32,
         _u32p, _u32p, _i32p, ctypes.c_int32,
     ]
+    lib.etpu_bcrypt_init.restype = None
+    lib.etpu_bcrypt_init.argtypes = [_u32p]
+    lib.etpu_bcrypt_hash.restype = ctypes.c_int32
+    lib.etpu_bcrypt_hash.argtypes = [
+        _u8p, ctypes.c_int32, _u8p, ctypes.c_int32, _u8p,
+    ]
     return lib
 
 
@@ -88,15 +98,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         try:
-            if not os.path.exists(_LIB_PATH) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+            if not os.path.exists(_LIB_PATH) or any(
+                os.path.exists(s)
+                and os.path.getmtime(s) > os.path.getmtime(_LIB_PATH)
+                for s in _SRCS
             ):
                 _build()
             if os.path.exists(_LIB_PATH):
                 _lib = _bind(ctypes.CDLL(_LIB_PATH))
                 log.info("native hot paths loaded (%s)", _LIB_PATH)
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale .so missing newer symbols that
+            # could not be rebuilt — degrade to pure Python, don't crash
+            _lib = None
             log.info("native load failed: %s", e)
         _tried = True
     return _lib

@@ -1,0 +1,149 @@
+// bcrypt (EksBlowfish) password hashing — the C++ analog of the
+// reference's bcrypt C NIF dependency (mix.exs bcrypt_dep; used by
+// emqx_passwd / authn password hashing).
+//
+// Implemented from the algorithm description in Provos & Mazieres,
+// "A Future-Adaptable Password Scheme" (USENIX '99) and the OpenBSD
+// manual semantics ($2b$: 72-byte key cap, trailing NUL included).
+//
+// The Blowfish initial state (P-array + S-boxes = 1,042 words of pi's
+// fractional hex expansion) is NOT embedded here: the Python wrapper
+// derives it numerically (Machin arctan series, bcrypt_hash.py) and
+// injects it once via etpu_bcrypt_init — constants from mathematics,
+// not from someone else's source file.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+struct BlowfishState {
+    uint32_t P[18];
+    uint32_t S[4][256];
+};
+
+uint32_t g_init_P[18];
+uint32_t g_init_S[4][256];
+bool g_ready = false;
+
+inline uint32_t F(const BlowfishState& st, uint32_t x) {
+    return ((st.S[0][(x >> 24) & 0xff] + st.S[1][(x >> 16) & 0xff]) ^
+            st.S[2][(x >> 8) & 0xff]) +
+           st.S[3][x & 0xff];
+}
+
+inline void encrypt_block(const BlowfishState& st, uint32_t& L, uint32_t& R) {
+    for (int i = 0; i < 16; i += 2) {
+        L ^= st.P[i];
+        R ^= F(st, L);
+        R ^= st.P[i + 1];
+        L ^= F(st, R);
+    }
+    L ^= st.P[16];
+    R ^= st.P[17];
+    uint32_t t = L;
+    L = R;
+    R = t;
+}
+
+// Next 32 bits of the cyclic key stream (bytes, big-endian packing).
+inline uint32_t key_word(const uint8_t* key, int keylen, int& pos) {
+    uint32_t w = 0;
+    for (int i = 0; i < 4; i++) {
+        w = (w << 8) | key[pos];
+        pos = (pos + 1) % keylen;
+    }
+    return w;
+}
+
+// ExpandKey(state, salt, key).  salt == nullptr means the 128-bit zero
+// salt (the plain Blowfish key schedule).
+void expand_key(BlowfishState& st, const uint8_t* salt16, const uint8_t* key,
+                int keylen) {
+    int kp = 0;
+    for (int i = 0; i < 18; i++) st.P[i] ^= key_word(key, keylen, kp);
+
+    uint32_t sw[4] = {0, 0, 0, 0};
+    if (salt16 != nullptr) {
+        for (int h = 0; h < 4; h++)
+            sw[h] = (uint32_t(salt16[h * 4]) << 24) |
+                    (uint32_t(salt16[h * 4 + 1]) << 16) |
+                    (uint32_t(salt16[h * 4 + 2]) << 8) |
+                    uint32_t(salt16[h * 4 + 3]);
+    }
+    uint32_t L = 0, R = 0;
+    int shalf = 0;  // alternate the two 64-bit salt halves
+    for (int i = 0; i < 18; i += 2) {
+        L ^= sw[shalf * 2];
+        R ^= sw[shalf * 2 + 1];
+        shalf ^= 1;
+        encrypt_block(st, L, R);
+        st.P[i] = L;
+        st.P[i + 1] = R;
+    }
+    for (int b = 0; b < 4; b++) {
+        for (int i = 0; i < 256; i += 2) {
+            L ^= sw[shalf * 2];
+            R ^= sw[shalf * 2 + 1];
+            shalf ^= 1;
+            encrypt_block(st, L, R);
+            st.S[b][i] = L;
+            st.S[b][i + 1] = R;
+        }
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// words: 18 P words followed by 4*256 S words (pi fractional hex digits).
+void etpu_bcrypt_init(const uint32_t* words) {
+    std::memcpy(g_init_P, words, sizeof(g_init_P));
+    std::memcpy(g_init_S, words + 18, sizeof(g_init_S));
+    g_ready = true;
+}
+
+// password: key stream bytes — the wrapper passes password[:72] + NUL
+// ($2b$ semantics: cap then append, so up to 73 bytes);
+// salt16: 16 bytes; cost: log2 rounds (4..31); out24: 24-byte ciphertext
+// (callers encode the first 23, per the $2b$ format).
+// Returns 0 on success, -1 on bad input / uninitialized tables.
+int etpu_bcrypt_hash(const uint8_t* password, int pwlen,
+                     const uint8_t* salt16, int cost, uint8_t* out24) {
+    if (!g_ready || pwlen <= 0 || pwlen > 73 || cost < 4 || cost > 31)
+        return -1;
+
+    BlowfishState st;
+    std::memcpy(st.P, g_init_P, sizeof(st.P));
+    std::memcpy(st.S, g_init_S, sizeof(st.S));
+
+    // EksBlowfishSetup
+    expand_key(st, salt16, password, pwlen);
+    uint64_t rounds = 1ull << cost;
+    for (uint64_t r = 0; r < rounds; r++) {
+        expand_key(st, nullptr, password, pwlen);
+        expand_key(st, nullptr, salt16, 16);
+    }
+
+    // 64 ECB encryptions of "OrpheanBeholderScryDoubt"
+    static const char magic[25] = "OrpheanBeholderScryDoubt";
+    uint32_t blocks[6];
+    for (int i = 0; i < 6; i++)
+        blocks[i] = (uint32_t(uint8_t(magic[i * 4])) << 24) |
+                    (uint32_t(uint8_t(magic[i * 4 + 1])) << 16) |
+                    (uint32_t(uint8_t(magic[i * 4 + 2])) << 8) |
+                    uint32_t(uint8_t(magic[i * 4 + 3]));
+    for (int r = 0; r < 64; r++)
+        for (int i = 0; i < 6; i += 2) encrypt_block(st, blocks[i], blocks[i + 1]);
+
+    for (int i = 0; i < 6; i++) {
+        out24[i * 4] = uint8_t(blocks[i] >> 24);
+        out24[i * 4 + 1] = uint8_t(blocks[i] >> 16);
+        out24[i * 4 + 2] = uint8_t(blocks[i] >> 8);
+        out24[i * 4 + 3] = uint8_t(blocks[i]);
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,224 @@
+"""DB-driver seam: authn/authz/connectors against injected fake drivers.
+
+The contract spec for emqx_tpu.drivers — what a real adapter
+(aiomysql/asyncpg/redis-py) must provide.  Reference analogs:
+emqx_authn_mysql / emqx_authz_mysql / emqx_connector_mysql, redis
+variants.
+"""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from emqx_tpu import drivers
+from emqx_tpu.authn import AuthChain, DbAuthenticator, hash_password
+from emqx_tpu.authz import DbSource, AuthzChain, NOMATCH, Rule
+from emqx_tpu.broker.access_control import ALLOW, DENY, PUB, SUB, ClientInfo
+from emqx_tpu.bridges.connectors import DbConnector, make_connector
+
+
+class FakeSqlDriver:
+    """In-memory 'MySQL': one users table + one acl table."""
+
+    def __init__(self, users=None, acls=None, healthy=True):
+        self.users = users or {}
+        self.acls = acls or {}
+        self.healthy = healthy
+        self.started = False
+        self.queries = []
+
+    def start(self):
+        self.started = True
+
+    def stop(self):
+        self.started = False
+
+    def health_check(self):
+        return self.healthy
+
+    def query(self, statement, params):
+        if not self.healthy:
+            raise ConnectionError("db down")
+        self.queries.append((statement, dict(params)))
+        if "users" in statement:
+            row = self.users.get(params.get("username"))
+            return [row] if row else []
+        if "acl" in statement:
+            return self.acls.get(params.get("username"), [])
+        return []
+
+    def command(self, *args):
+        raise NotImplementedError
+
+
+class FakeRedisDriver:
+    def __init__(self, hashes=None):
+        self.hashes = hashes or {}
+
+    def health_check(self):
+        return True
+
+    def query(self, statement, params):
+        raise NotImplementedError
+
+    def command(self, cmd, key):
+        assert cmd == "HGETALL"
+        return self.hashes.get(key, {})
+
+
+def _ci(username="u1", password=b"pw", clientid="c1"):
+    return ClientInfo(clientid=clientid, username=username, password=password,
+                      peerhost="10.0.0.9:5555")
+
+
+def _user_row(password="pw", algorithm="sha256", superuser=False):
+    salt = b"\x01\x02"
+    return {
+        "password_hash": hash_password(password.encode(), salt, algorithm),
+        "salt": salt.hex(),
+        "algorithm": algorithm,
+        "is_superuser": superuser,
+    }
+
+
+def test_registry_inject_and_unavailable():
+    assert not drivers.driver_available("mysql")
+    with pytest.raises(drivers.DriverUnavailable):
+        drivers.make_driver("mysql")
+    drivers.register_driver("mysql", lambda **cfg: FakeSqlDriver())
+    try:
+        assert drivers.driver_available("mysql")
+        assert isinstance(drivers.make_driver("mysql"), FakeSqlDriver)
+    finally:
+        drivers.unregister_driver("mysql")
+    assert not drivers.driver_available("mysql")
+
+
+def test_db_authn_allow_deny_ignore():
+    drv = FakeSqlDriver(users={"u1": _user_row("pw", superuser=True)})
+    a = DbAuthenticator(
+        "mysql",
+        "SELECT password_hash, salt, is_superuser FROM users "
+        "WHERE username = ${username}",
+        driver=drv,
+    )
+    v, extra = a.authenticate(_ci("u1", b"pw"))
+    assert v == ALLOW and extra["is_superuser"]
+    v, _ = a.authenticate(_ci("u1", b"bad"))
+    assert v == DENY
+    v, _ = a.authenticate(_ci("ghost", b"pw"))
+    assert v == "ignore"
+    # the password itself never reaches the driver
+    for _stmt, params in drv.queries:
+        assert "pw" not in params.values()
+
+
+def test_db_authn_bcrypt_row():
+    from emqx_tpu import bcrypt_hash as bc
+
+    row = {
+        "password_hash": bc.hashpw(b"topsecret", bc.gensalt(4)),
+        "algorithm": "bcrypt",
+    }
+    a = DbAuthenticator(
+        "mysql", "SELECT * FROM users WHERE username = ${username}",
+        driver=FakeSqlDriver(users={"u2": row}),
+    )
+    assert a.authenticate(_ci("u2", b"topsecret"))[0] == ALLOW
+    assert a.authenticate(_ci("u2", b"nope"))[0] == DENY
+
+
+def test_db_authn_outage_is_ignore():
+    a = DbAuthenticator(
+        "mysql", "SELECT * FROM users WHERE username = ${username}",
+        driver=FakeSqlDriver(healthy=False),
+    )
+    v, extra = a.authenticate(_ci())
+    assert v == "ignore" and extra.get("error") == "db_unavailable"
+
+
+def test_db_authn_redis_hash():
+    salt = b"\x0a"
+    h = {
+        "password_hash": hash_password(b"rpw", salt, "sha256"),
+        "salt": salt.hex(),
+        "algorithm": "sha256",
+    }
+    a = DbAuthenticator(
+        "redis", "mqtt_user:${username}",
+        driver=FakeRedisDriver({"mqtt_user:ru": h}),
+    )
+    assert a.authenticate(_ci("ru", b"rpw"))[0] == ALLOW
+    assert a.authenticate(_ci("ru", b"xx"))[0] == DENY
+
+
+def test_db_authz_rows():
+    acl = [
+        {"permission": "allow", "action": "publish", "topic": "up/${none}"},
+        {"permission": "deny", "action": "all", "topic": "forbidden/#"},
+        {"permission": "allow", "action": "all", "topic": "ok/#"},
+    ]
+    # note: no per-row var templating here; rows are already client-scoped
+    acl[0]["topic"] = "up/only"
+    s = DbSource(
+        "mysql", "SELECT permission, action, topic FROM acl "
+        "WHERE username = ${username}",
+        driver=FakeSqlDriver(acls={"u1": acl}),
+    )
+    ci = _ci()
+    assert s.authorize(ci, PUB, "up/only") == ALLOW
+    assert s.authorize(ci, SUB, "up/only") == NOMATCH
+    assert s.authorize(ci, PUB, "forbidden/x") == DENY
+    assert s.authorize(ci, SUB, "ok/deep/1") == ALLOW
+    assert s.authorize(ci, PUB, "other") == NOMATCH
+
+
+def test_db_authz_redis_topics():
+    s = DbSource(
+        "redis", "mqtt_acl:${username}",
+        driver=FakeRedisDriver(
+            {"mqtt_acl:u1": {"sensors/#": "subscribe", "cmd/+": "all"}}
+        ),
+    )
+    ci = _ci()
+    assert s.authorize(ci, SUB, "sensors/1/t") == ALLOW
+    assert s.authorize(ci, PUB, "sensors/1/t") == NOMATCH
+    assert s.authorize(ci, PUB, "cmd/run") == ALLOW
+
+
+def test_db_authz_outage_falls_to_default():
+    s = DbSource(
+        "pgsql", "SELECT ... ${username}", driver=FakeSqlDriver(healthy=False)
+    )
+    chain = AuthzChain(default=DENY)
+    chain.add(s)
+    assert s.authorize(_ci(), PUB, "t") == NOMATCH
+
+
+def test_db_connector_lifecycle():
+    async def main():
+        drivers.register_driver("pgsql", lambda **cfg: FakeSqlDriver(
+            users={"u": _user_row()}))
+        try:
+            conn = make_connector("pgsql")
+            assert isinstance(conn, DbConnector)
+            await conn.start()
+            assert conn.driver.started
+            assert await conn.health_check()
+            rows = await conn.query(
+                "SELECT * FROM users WHERE username=${username}",
+                {"username": "u"},
+            )
+            assert rows and "password_hash" in rows[0]
+            await conn.stop()
+            assert not conn.driver.started
+        finally:
+            drivers.unregister_driver("pgsql")
+
+    asyncio.run(main())
+
+
+def test_make_connector_without_driver_fails_loud():
+    with pytest.raises(drivers.DriverUnavailable, match="mongodb"):
+        make_connector("mongodb")

@@ -1,0 +1,252 @@
+"""SCRAM-SHA-256 enhanced auth (RFC 5802/7677) + bcrypt password hashing.
+
+Reference surface: enhanced_authn/emqx_enhanced_authn_scram_mnesia.erl
+(SCRAM over MQTT5 AUTH packets) and the bcrypt C NIF (emqx_passwd).
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu import bcrypt_hash as bc
+from emqx_tpu.authn import AuthChain, BuiltInAuthenticator
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient, MqttError
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.scram import ScramAuthenticator, ScramClient, derive_keys
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+# ------------------------------------------------------------- scram unit
+
+
+def test_scram_pure_exchange():
+    srv = ScramAuthenticator(iterations=256)
+    srv.add_user("alice", "wonderland")
+
+    class CI:
+        def __init__(self):
+            self.username = None
+            self.is_superuser = False
+            self.attrs = {}
+
+    ci = CI()
+    cl = ScramClient("alice", "wonderland")
+    action, server_first = srv.on_start(ci, "SCRAM-SHA-256", cl.client_first(), None)[1], None
+    # on_start returns (STOP, ("continue", reply))
+    out = srv.on_start(ci, "SCRAM-SHA-256", cl.client_first(), None)
+    assert out[1][0] == "continue"
+    server_first = out[1][1]
+    final = cl.client_final(server_first)
+    out2 = srv.on_continue(ci, "SCRAM-SHA-256", final, None)
+    assert out2[1][0] == "ok"
+    assert cl.verify_server_final(out2[1][1])
+    assert ci.username == "alice"
+
+
+def test_scram_wrong_password_rejected():
+    srv = ScramAuthenticator(iterations=256)
+    srv.add_user("bob", "rightpw")
+
+    class CI:
+        def __init__(self):
+            self.username = None
+            self.is_superuser = False
+            self.attrs = {}
+
+    cl = ScramClient("bob", "wrongpw")
+    out = srv.on_start(CI(), "SCRAM-SHA-256", cl.client_first(), None)
+    server_first = out[1][1]
+    ci = CI()
+    srv.on_start(ci, "SCRAM-SHA-256", cl.client_first(), None)
+    out2 = srv.on_continue(ci, "SCRAM-SHA-256", cl.client_final(server_first), None)
+    # conversation state is per-clientinfo; reuse ci's own exchange
+    assert out2[1][0] == "fail"
+
+
+def test_scram_unknown_user_and_method_passthrough():
+    srv = ScramAuthenticator()
+
+    class CI:
+        def __init__(self):
+            self.username = None
+            self.attrs = {}
+
+    cl = ScramClient("ghost", "x")
+    out = srv.on_start(CI(), "SCRAM-SHA-256", cl.client_first(), None)
+    assert out[1][0] == "fail"
+    # different method: not claimed (another provider may handle it)
+    assert srv.on_start(CI(), "K8S-TOKEN", b"", None) is None
+
+
+def test_derive_keys_deterministic():
+    s1 = derive_keys(b"pw", b"salt" * 4, 512)
+    s2 = derive_keys(b"pw", b"salt" * 4, 512)
+    assert s1 == s2
+    assert s1 != derive_keys(b"pw2", b"salt" * 4, 512)
+
+
+# -------------------------------------------------------------- scram e2e
+
+
+def test_scram_over_mqtt5_auth_packets(run):
+    """Full connect-time handshake: CONNECT(client-first) ->
+    AUTH(server-first) -> AUTH(client-final) -> CONNACK(server-final)."""
+
+    async def main():
+        broker = Broker()
+        scram = ScramAuthenticator(iterations=256)
+        scram.add_user("deviceA", "s3cret", is_superuser=True)
+        scram.install(broker.hooks)
+        lst = Listener(broker, port=0)
+        await lst.start()
+
+        c = MqttClient(clientid="scram-c", scram=ScramClient("deviceA", "s3cret"))
+        ack = await c.connect(port=lst.port)
+        assert ack.reason_code == 0
+        assert c.scram_server_verified is True  # mutual authentication
+        ch = broker.cm.channels["scram-c"]
+        assert ch.clientinfo.username == "deviceA"
+        assert ch.clientinfo.is_superuser
+
+        # the session works normally after the handshake
+        await c.subscribe("s/#", qos=1)
+        await c.publish("s/1", b"post-scram", qos=1)
+        m = await c.recv()
+        assert m.payload == b"post-scram"
+        await c.disconnect()
+        await lst.stop()
+
+    run(main())
+
+
+def test_scram_bad_password_connack_fail(run):
+    async def main():
+        broker = Broker()
+        scram = ScramAuthenticator(iterations=256)
+        scram.add_user("deviceB", "correct")
+        scram.install(broker.hooks)
+        lst = Listener(broker, port=0)
+        await lst.start()
+
+        c = MqttClient(clientid="scram-bad", scram=ScramClient("deviceB", "wrong"))
+        with pytest.raises(MqttError, match="0x87|0x86|connack"):
+            await c.connect(port=lst.port)
+        assert "scram-bad" not in broker.cm.channels
+        await lst.stop()
+
+    run(main())
+
+
+def test_scram_method_without_provider_rejected(run):
+    async def main():
+        broker = Broker()  # no authenticator installed
+        lst = Listener(broker, port=0)
+        await lst.start()
+        c = MqttClient(clientid="no-prov", scram=ScramClient("x", "y"))
+        with pytest.raises(MqttError, match="0x8c"):
+            await c.connect(port=lst.port)
+        await lst.stop()
+
+    run(main())
+
+
+def test_publish_during_handshake_is_protocol_error(run):
+    """Only AUTH/DISCONNECT may flow while authenticating."""
+
+    async def main():
+        from emqx_tpu.broker import packet as pkt
+        from emqx_tpu.broker.frame import Parser, serialize
+        from emqx_tpu.scram import METHOD
+
+        broker = Broker()
+        scram = ScramAuthenticator(iterations=256)
+        scram.add_user("u", "p")
+        scram.install(broker.hooks)
+        lst = Listener(broker, port=0)
+        await lst.start()
+
+        r, w = await asyncio.open_connection("127.0.0.1", lst.port)
+        cl = ScramClient("u", "p")
+        con = pkt.Connect(
+            clientid="rogue",
+            proto_ver=pkt.MQTT_V5,
+            properties={
+                pkt.Property.AUTHENTICATION_METHOD: METHOD,
+                pkt.Property.AUTHENTICATION_DATA: cl.client_first(),
+            },
+        )
+        w.write(serialize(con, pkt.MQTT_V5))
+        await w.drain()
+        parser = Parser(version=pkt.MQTT_V5)
+        packets = []
+        while not packets:
+            data = await r.read(4096)
+            assert data, "server closed before AUTH"
+            packets = parser.feed(data)
+        assert packets[0].type == pkt.PacketType.AUTH
+        # now send a PUBLISH instead of the AUTH continuation
+        w.write(serialize(pkt.Publish(topic="x", payload=b"nope"), pkt.MQTT_V5))
+        await w.drain()
+        got = await r.read(4096)
+        assert got == b""  # server dropped the connection
+        w.close()
+        await lst.stop()
+
+    run(main())
+
+
+# ------------------------------------------------------------------ bcrypt
+
+
+def test_bcrypt_roundtrip_and_format():
+    h = bc.hashpw(b"hunter2", bc.gensalt(4))
+    assert h.startswith("$2b$04$") and len(h) == 60
+    assert bc.checkpw(b"hunter2", h)
+    assert not bc.checkpw(b"hunter3", h)
+
+
+def test_bcrypt_against_system_crypt():
+    crypt = pytest.importorskip("crypt")
+    if not hasattr(crypt, "METHOD_BLOWFISH") or crypt.METHOD_BLOWFISH not in crypt.methods:
+        pytest.skip("system crypt lacks bcrypt")
+    for pw in ("password", "µni¢ode ƒun", "a" * 80):
+        sys_hash = crypt.crypt(pw, crypt.mksalt(crypt.METHOD_BLOWFISH, rounds=16))
+        assert bc.hashpw(pw.encode(), sys_hash) == sys_hash
+
+
+def test_bcrypt_salt_variation():
+    h1 = bc.hashpw(b"same", bc.gensalt(4))
+    h2 = bc.hashpw(b"same", bc.gensalt(4))
+    assert h1 != h2  # different salts
+    assert bc.checkpw(b"same", h1) and bc.checkpw(b"same", h2)
+
+
+def test_authn_bcrypt_algorithm(run):
+    async def main():
+        broker = Broker()
+        chain = AuthChain(allow_anonymous=False)
+        a = BuiltInAuthenticator()
+        a.add_user("bz", "pw-bcrypt", algorithm="bcrypt", bcrypt_rounds=4)
+        chain.add(a)
+        chain.install(broker.hooks)
+        lst = Listener(broker, port=0)
+        await lst.start()
+
+        ok = MqttClient(clientid="bk", username="bz", password=b"pw-bcrypt")
+        ack = await ok.connect(port=lst.port)
+        assert ack.reason_code == 0
+        await ok.disconnect()
+
+        bad = MqttClient(clientid="bk2", username="bz", password=b"nope")
+        with pytest.raises(MqttError):
+            await bad.connect(port=lst.port)
+        await lst.stop()
+
+    run(main())
