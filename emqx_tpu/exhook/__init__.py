@@ -17,11 +17,16 @@ This package implements BOTH sides:
   `TopicMatchEngine` and answers publish hooks with device-matched
   subscriber sets).
 
-Transport: length-prefixed JSON frames over TCP (`wire.py`) carrying
-the exhook.proto request/response vocabulary (same hook names, same
-valued-response semantics).  grpcio is not available in this image; if
-it is present at runtime a gRPC transport can be slotted in behind the
-same `HookClient` interface (`wire.GRPC_AVAILABLE` gates it).
+Transports (ExhookServerConfig.driver):
+
+* `grpc` (default) — the real HookProvider gRPC service, wire-compatible
+  with the reference contract (`protos/exhook.proto`; messages generated
+  by protoc on demand, stubs hand-written in `proto.py` since the
+  grpc_tools codegen plugin is absent).  `grpc_wire.GrpcServerState` is
+  the broker-side client; `grpc_wire.GrpcProviderServer` serves any
+  provider object — including `TpuMatchProvider` — to a STOCK EMQ X.
+* `json` — length-prefixed JSON frames over TCP (`wire.py`) carrying the
+  same hook vocabulary, for hosts without grpcio/protoc.
 """
 
 from .manager import ExhookManager, ExhookServerConfig

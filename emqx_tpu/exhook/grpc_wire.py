@@ -1,0 +1,553 @@
+"""gRPC transport for the exhook boundary — both sides of the wire.
+
+The reference's north-star integration point is the `HookProvider` gRPC
+service (`emqx_exhook_server.erl:89-117` client pool;
+`exhook.proto:27-69` contract).  This module provides:
+
+* `GrpcProviderServer` — serve any provider object (e.g.
+  `TpuMatchProvider`) as a HookProvider gRPC service, so a STOCK EMQ X
+  broker can call the TPU match sidecar;
+* `GrpcServerState` — the broker-side client (channel + stub +
+  OnProviderLoaded negotiation) exposing the same `call(hook, data)`
+  interface as the JSON-TCP `_ServerState`, so `ExhookManager` drives
+  stock gRPC providers unchanged.
+
+Dict<->protobuf translation keeps the manager's JSON shapes as the
+internal lingua franca: payloads ride base64 in dicts and raw bytes in
+pb; pb header maps are str->str, so "true"/"false" round-trip to bools
+for the broker's allow_publish gate and list values ride as JSON.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import time
+from concurrent import futures
+from typing import Any, Dict, List, Optional
+
+from . import proto
+from .wire import VALUED_HOOKS
+
+log = logging.getLogger("emqx_tpu.exhook.grpc")
+
+
+# ------------------------------------------------------------ converters
+
+def _ci_to_pb(p, d: Dict[str, Any]):
+    return p.ClientInfo(
+        node=str(d.get("node", "")),
+        clientid=str(d.get("clientid", "")),
+        username=str(d.get("username") or ""),
+        password=str(d.get("password") or ""),
+        peerhost=str(d.get("peerhost", "")),
+        protocol=str(d.get("protocol", "mqtt")),
+        mountpoint=str(d.get("mountpoint") or ""),
+        is_superuser=bool(d.get("is_superuser", False)),
+        anonymous=not d.get("username"),
+        cn=str(d.get("cn", "")),
+        dn=str(d.get("dn", "")),
+    )
+
+
+def _ci_to_dict(ci) -> Dict[str, Any]:
+    return {
+        "node": ci.node,
+        "clientid": ci.clientid,
+        "username": ci.username or None,
+        "password": ci.password or None,
+        "peerhost": ci.peerhost,
+        "protocol": ci.protocol,
+        "mountpoint": ci.mountpoint or None,
+        "is_superuser": ci.is_superuser,
+        "cn": ci.cn,
+        "dn": ci.dn,
+    }
+
+
+def _headers_to_pb(headers: Dict[str, Any]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for k, v in (headers or {}).items():
+        if isinstance(v, bool):
+            out[k] = "true" if v else "false"
+        elif isinstance(v, (str, int, float)):
+            out[k] = str(v)
+        else:
+            try:
+                out[k] = json.dumps(v)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+def _headers_from_pb(headers) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in dict(headers).items():
+        if v == "true":
+            out[k] = True
+        elif v == "false":
+            out[k] = False
+        elif v[:1] in ("[", "{"):
+            try:
+                out[k] = json.loads(v)
+            except ValueError:
+                out[k] = v
+        else:
+            out[k] = v
+    return out
+
+
+def _msg_to_pb(p, d: Dict[str, Any]):
+    payload = d.get("payload", b"")
+    if isinstance(payload, str):  # manager dicts carry base64
+        payload = base64.b64decode(payload)
+    return p.Message(
+        node=str(d.get("node", "")),
+        id=str(d.get("id", d.get("mid", ""))),
+        qos=int(d.get("qos", 0)),
+        topic=str(d.get("topic", "")),
+        payload=payload,
+        timestamp=int(d.get("timestamp", 0)),
+        headers=_headers_to_pb(d.get("headers") or {}),
+        **{"from": str(d.get("from", d.get("from_client", "")))},
+    )
+
+
+def _msg_to_dict(m) -> Dict[str, Any]:
+    return {
+        "id": m.id,
+        "qos": m.qos,
+        "from": getattr(m, "from"),
+        "topic": m.topic,
+        "payload": base64.b64encode(m.payload).decode(),
+        "timestamp": m.timestamp,
+        "headers": _headers_from_pb(m.headers),
+    }
+
+
+def _build_request(p, hook: str, data: Dict[str, Any]):
+    """Manager event/valued dict -> pb request for `hook`."""
+    ci = _ci_to_pb(p, data.get("clientinfo") or {})
+    args = data.get("args") or []
+    if hook == "client.authenticate":
+        return p.ClientAuthenticateRequest(clientinfo=ci, result=True)
+    if hook == "client.authorize":
+        t = (
+            p.ClientAuthorizeRequest.PUBLISH
+            if data.get("action") in ("publish", "pub")
+            else p.ClientAuthorizeRequest.SUBSCRIBE
+        )
+        return p.ClientAuthorizeRequest(
+            clientinfo=ci, type=t, topic=data.get("topic", ""), result=True
+        )
+    if hook == "message.publish":
+        return p.MessagePublishRequest(message=_msg_to_pb(p, data))
+    if hook in ("message.delivered", "message.acked"):
+        return getattr(
+            p, "MessageDeliveredRequest"
+            if hook == "message.delivered"
+            else "MessageAckedRequest",
+        )(clientinfo=ci, message=_msg_to_pb(p, data.get("message") or data))
+    if hook == "message.dropped":
+        return p.MessageDroppedRequest(
+            message=_msg_to_pb(p, data.get("message") or data),
+            reason=args[0] if args else "",
+        )
+    if hook == "client.connect":
+        return p.ClientConnectRequest(
+            conninfo=p.ConnInfo(
+                clientid=str((data.get("clientinfo") or {}).get("clientid", "")),
+                username=str((data.get("clientinfo") or {}).get("username") or ""),
+            )
+        )
+    if hook == "client.connack":
+        return p.ClientConnackRequest(
+            conninfo=p.ConnInfo(
+                clientid=str((data.get("clientinfo") or {}).get("clientid", ""))
+            ),
+            result_code=args[0] if args else "success",
+        )
+    if hook == "client.disconnected":
+        return p.ClientDisconnectedRequest(
+            clientinfo=ci, reason=args[0] if args else ""
+        )
+    if hook in ("client.subscribe", "client.unsubscribe"):
+        cls = (
+            p.ClientSubscribeRequest
+            if hook == "client.subscribe"
+            else p.ClientUnsubscribeRequest
+        )
+        return cls(
+            clientinfo=ci,
+            topic_filters=[p.TopicFilter(name=a) for a in args],
+        )
+    if hook == "session.subscribed":
+        # event args: (clientid, filter); opts from the SubOpts dataclass
+        if not ci.clientid and args:
+            ci = p.ClientInfo(clientid=args[0])
+        opts = data.get("opts") or {}
+        return p.SessionSubscribedRequest(
+            clientinfo=ci,
+            topic=args[1] if len(args) > 1 else "",
+            subopts=p.SubOpts(
+                qos=int(opts.get("qos", 0)),
+                rh=int(opts.get("retain_handling", 0)),
+                rap=int(bool(opts.get("retain_as_published", False))),
+                nl=int(bool(opts.get("no_local", False))),
+            ),
+        )
+    if hook == "session.unsubscribed":
+        if not ci.clientid and args:
+            ci = p.ClientInfo(clientid=args[0])
+        return p.SessionUnsubscribedRequest(
+            clientinfo=ci, topic=args[1] if len(args) > 1 else ""
+        )
+    if hook == "session.terminated":
+        if not ci.clientid and args:
+            ci = p.ClientInfo(clientid=args[0])
+        return p.SessionTerminatedRequest(
+            clientinfo=ci, reason=args[-1] if args else ""
+        )
+    # session.created / resumed / discarded / takenover / connected
+    cls_name = proto.METHODS[proto.HOOK_TO_METHOD[hook]][0]
+    return getattr(p, cls_name)(clientinfo=ci)
+
+
+def _valued_to_dict(p, resp) -> Dict[str, Any]:
+    """ValuedResponse -> the manager's {"type", "value"} shape."""
+    typ = (
+        "stop"
+        if resp.type == p.ValuedResponse.STOP_AND_RETURN
+        else "continue"
+    )
+    which = resp.WhichOneof("value")
+    value: Any = None
+    if resp.type != p.ValuedResponse.IGNORE:
+        if which == "bool_result":
+            value = resp.bool_result
+        elif which == "message":
+            value = _msg_to_dict(resp.message)
+    return {"type": typ, "value": value}
+
+
+# ------------------------------------------------------- broker side
+
+class GrpcServerState:
+    """Drop-in for ExhookManager's _ServerState over gRPC.
+
+    One channel (HTTP/2 multiplexes; pool_size is satisfied by gRPC's
+    own stream concurrency, mirroring the reference's channel pool)."""
+
+    def __init__(self, cfg):
+        import grpc
+
+        self.cfg = cfg
+        self._pb = proto.pb2()
+        if self._pb is None:
+            raise RuntimeError("gRPC exhook unavailable: protoc/grpcio missing")
+        self.channel = grpc.insecure_channel(f"{cfg.host}:{cfg.port}")
+        self.stub = proto.make_stub(self.channel)
+        self.enabled_hooks: List[str] = []
+        # message-hook topic filters from HookSpec.topics ([] = all)
+        self.hook_topics: Dict[str, List[str]] = {}
+
+    def load(self, broker_info: Optional[Dict[str, Any]] = None) -> List[str]:
+        """OnProviderLoaded handshake -> hook names to register."""
+        p = self._pb
+        info = broker_info or {}
+        req = p.ProviderLoadedRequest(
+            broker=p.BrokerInfo(
+                version=str(info.get("version", "")),
+                sysdescr=str(info.get("sysdescr", "emqx_tpu")),
+                uptime=int(info.get("uptime", 0)),
+                datetime=str(info.get("datetime", "")),
+            )
+        )
+        resp = self.stub.OnProviderLoaded(
+            req, timeout=self.cfg.request_timeout
+        )
+        self.enabled_hooks = [spec.name for spec in resp.hooks]
+        self.hook_topics = {
+            spec.name: list(spec.topics) for spec in resp.hooks if spec.topics
+        }
+        return list(self.enabled_hooks)
+
+    def wants_topic(self, hook: str, topic: str) -> bool:
+        """HookSpec.topics scoping: the reference broker only fires
+        message hooks whose topic matches the provider's filters."""
+        filters = self.hook_topics.get(hook)
+        if not filters:
+            return True
+        from ..broker import topic as topiclib
+
+        return any(topiclib.match(topic, f) for f in filters)
+
+    def call(self, hook: str, data: Dict[str, Any]) -> Dict[str, Any]:
+        p = self._pb
+        method = proto.HOOK_TO_METHOD.get(hook)
+        if method is None:
+            return {"type": "continue", "value": None}
+        req = _build_request(p, hook, data)
+        resp = getattr(self.stub, method)(
+            req, timeout=self.cfg.request_timeout
+        )
+        if hook in VALUED_HOOKS:
+            return _valued_to_dict(p, resp)
+        return {"type": "continue", "value": None}
+
+    def unload(self) -> None:
+        try:
+            self.stub.OnProviderUnloaded(
+                self._pb.ProviderUnloadedRequest(), timeout=2.0
+            )
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self.unload()
+        try:
+            self.channel.close()
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------ provider side
+
+class _Servicer:
+    """pb requests -> the provider's dict-based on_<hook> methods (the
+    same API ProviderServer serves over JSON-TCP)."""
+
+    def __init__(self, provider):
+        self.provider = provider
+        self._p = proto.pb2()
+
+    # -- lifecycle
+
+    def OnProviderLoaded(self, request, context):
+        p = self._p
+        # optional hook_specs(): hook -> topic filters (HookSpec.topics)
+        specs = {}
+        fn = getattr(self.provider, "hook_specs", None)
+        if fn is not None:
+            try:
+                specs = fn() or {}
+            except Exception:
+                log.exception("provider hook_specs failed")
+        return p.LoadedResponse(
+            hooks=[
+                p.HookSpec(name=h, topics=list(specs.get(h) or ()))
+                for h in self.provider.hooks()
+            ]
+        )
+
+    def OnProviderUnloaded(self, request, context):
+        return self._p.EmptySuccess()
+
+    # -- generic dispatch helpers
+
+    def _event(self, hook: str, data: Dict[str, Any]):
+        method = getattr(self.provider, "on_" + hook.replace(".", "_"), None)
+        if method is not None:
+            try:
+                method(data)
+            except Exception:
+                log.exception("provider %s failed", hook)
+        return self._p.EmptySuccess()
+
+    def _valued(self, hook: str, data: Dict[str, Any]):
+        p = self._p
+        method = getattr(self.provider, "on_" + hook.replace(".", "_"), None)
+        if method is None:
+            return p.ValuedResponse(type=p.ValuedResponse.IGNORE)
+        try:
+            result = method(data)
+        except Exception:
+            log.exception("provider %s failed", hook)
+            return p.ValuedResponse(type=p.ValuedResponse.IGNORE)
+        if result is None:
+            return p.ValuedResponse(type=p.ValuedResponse.IGNORE)
+        typ, value = result if isinstance(result, tuple) else ("continue", result)
+        pb_type = (
+            p.ValuedResponse.STOP_AND_RETURN
+            if typ == "stop"
+            else p.ValuedResponse.CONTINUE
+        )
+        if isinstance(value, bool):
+            return p.ValuedResponse(type=pb_type, bool_result=value)
+        if isinstance(value, dict):
+            base = dict(data)
+            base_headers = dict(base.get("headers") or {})
+            base_headers.update(value.get("headers") or {})
+            merged = {**base, **value, "headers": base_headers}
+            return p.ValuedResponse(
+                type=pb_type, message=_msg_to_pb(p, merged)
+            )
+        return p.ValuedResponse(type=p.ValuedResponse.IGNORE)
+
+    # -- per-rpc adapters (hook dicts mirror manager._encode_event)
+
+    def OnClientConnect(self, request, context):
+        return self._event("client.connect", {})
+
+    def OnClientConnack(self, request, context):
+        return self._event("client.connack", {"args": [request.result_code]})
+
+    def OnClientConnected(self, request, context):
+        return self._event(
+            "client.connected", {"clientinfo": _ci_to_dict(request.clientinfo)}
+        )
+
+    def OnClientDisconnected(self, request, context):
+        return self._event(
+            "client.disconnected",
+            {
+                "clientinfo": _ci_to_dict(request.clientinfo),
+                "args": [request.reason],
+            },
+        )
+
+    def OnClientAuthenticate(self, request, context):
+        return self._valued(
+            "client.authenticate",
+            {"clientinfo": _ci_to_dict(request.clientinfo)},
+        )
+
+    def OnClientAuthorize(self, request, context):
+        p = self._p
+        return self._valued(
+            "client.authorize",
+            {
+                "clientinfo": _ci_to_dict(request.clientinfo),
+                "action": "publish"
+                if request.type == p.ClientAuthorizeRequest.PUBLISH
+                else "subscribe",
+                "topic": request.topic,
+            },
+        )
+
+    def OnClientSubscribe(self, request, context):
+        return self._event(
+            "client.subscribe",
+            {
+                "clientinfo": _ci_to_dict(request.clientinfo),
+                "args": [tf.name for tf in request.topic_filters],
+            },
+        )
+
+    def OnClientUnsubscribe(self, request, context):
+        return self._event(
+            "client.unsubscribe",
+            {
+                "clientinfo": _ci_to_dict(request.clientinfo),
+                "args": [tf.name for tf in request.topic_filters],
+            },
+        )
+
+    def OnSessionCreated(self, request, context):
+        return self._event(
+            "session.created", {"clientinfo": _ci_to_dict(request.clientinfo)}
+        )
+
+    def OnSessionSubscribed(self, request, context):
+        so = request.subopts
+        return self._event(
+            "session.subscribed",
+            {
+                "clientinfo": _ci_to_dict(request.clientinfo),
+                "args": [request.clientinfo.clientid, request.topic],
+                "opts": {
+                    "qos": so.qos,
+                    "retain_handling": so.rh,
+                    "retain_as_published": bool(so.rap),
+                    "no_local": bool(so.nl),
+                    "share": so.share,
+                },
+            },
+        )
+
+    def OnSessionUnsubscribed(self, request, context):
+        return self._event(
+            "session.unsubscribed",
+            {
+                "clientinfo": _ci_to_dict(request.clientinfo),
+                "args": [request.clientinfo.clientid, request.topic],
+            },
+        )
+
+    def OnSessionResumed(self, request, context):
+        return self._event(
+            "session.resumed", {"clientinfo": _ci_to_dict(request.clientinfo)}
+        )
+
+    def OnSessionDiscarded(self, request, context):
+        return self._event(
+            "session.discarded", {"clientinfo": _ci_to_dict(request.clientinfo)}
+        )
+
+    def OnSessionTakenover(self, request, context):
+        return self._event(
+            "session.takenover", {"clientinfo": _ci_to_dict(request.clientinfo)}
+        )
+
+    def OnSessionTerminated(self, request, context):
+        return self._event(
+            "session.terminated",
+            {
+                "clientinfo": _ci_to_dict(request.clientinfo),
+                "args": [request.clientinfo.clientid, request.reason],
+            },
+        )
+
+    def OnMessagePublish(self, request, context):
+        return self._valued("message.publish", _msg_to_dict(request.message))
+
+    def OnMessageDelivered(self, request, context):
+        return self._event(
+            "message.delivered",
+            {
+                "clientinfo": _ci_to_dict(request.clientinfo),
+                "message": _msg_to_dict(request.message),
+            },
+        )
+
+    def OnMessageDropped(self, request, context):
+        return self._event(
+            "message.dropped",
+            {"message": _msg_to_dict(request.message), "args": [request.reason]},
+        )
+
+    def OnMessageAcked(self, request, context):
+        return self._event(
+            "message.acked",
+            {
+                "clientinfo": _ci_to_dict(request.clientinfo),
+                "message": _msg_to_dict(request.message),
+            },
+        )
+
+
+class GrpcProviderServer:
+    """Serve a provider object as the HookProvider gRPC service."""
+
+    def __init__(self, provider, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 8):
+        import grpc
+
+        if proto.pb2() is None:
+            raise RuntimeError("gRPC exhook unavailable: protoc missing")
+        self.provider = provider
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        proto.add_servicer(self.server, _Servicer(provider))
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise RuntimeError(f"could not bind gRPC provider to {host}:{port}")
+
+    def start(self) -> "GrpcProviderServer":
+        self.server.start()
+        return self
+
+    def stop(self, grace: float = 0.5) -> None:
+        self.server.stop(grace).wait(timeout=5)

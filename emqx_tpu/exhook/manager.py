@@ -44,6 +44,9 @@ class ExhookServerConfig:
     request_timeout: float = 5.0
     failed_action: str = "deny"  # deny | ignore
     enable: bool = True
+    # grpc = the reference-compatible HookProvider service (default);
+    # json = the framed-TCP fallback transport for grpc-less hosts
+    driver: str = "grpc"
 
 
 class _ServerState:
@@ -63,6 +66,9 @@ class _ServerState:
         with self.locks[i]:
             return self.pool[i].call(hook, data)
 
+    def wants_topic(self, hook: str, topic: str) -> bool:
+        return True  # JSON transport has no HookSpec.topics scoping
+
     def close(self) -> None:
         for conn in self.pool:
             conn.close()
@@ -71,7 +77,12 @@ class _ServerState:
 def _clientinfo_data(ci: ClientInfo) -> dict:
     d = dataclasses.asdict(ci)
     d.pop("attrs", None)
-    return {k: v for k, v in d.items() if isinstance(v, (str, int, bool, float, type(None)))}
+    out = {k: v for k, v in d.items() if isinstance(v, (str, int, bool, float, type(None)))}
+    # the proto ClientInfo carries password as a string for authenticate
+    # providers; bytes would otherwise be dropped by the filter above
+    if isinstance(ci.password, (bytes, bytearray)):
+        out["password"] = ci.password.decode("utf-8", "replace")
+    return out
 
 
 def _message_data(msg: Message) -> dict:
@@ -100,9 +111,15 @@ class ExhookManager:
 
     def load_server(self, cfg: ExhookServerConfig) -> List[str]:
         """Connect + OnProviderLoaded; returns the negotiated hook list."""
-        st = _ServerState(cfg)
-        resp = st.call("provider.loaded", {"broker": "emqx_tpu"})
-        wanted = [h for h in (resp.get("value") or []) if h in HOOKPOINTS]
+        if cfg.driver == "grpc":
+            from .grpc_wire import GrpcServerState
+
+            st = GrpcServerState(cfg)
+            wanted = [h for h in st.load() if h in HOOKPOINTS]
+        else:
+            st = _ServerState(cfg)
+            resp = st.call("provider.loaded", {"broker": "emqx_tpu"})
+            wanted = [h for h in (resp.get("value") or []) if h in HOOKPOINTS]
         st.enabled_hooks = wanted
         self.servers.append(st)
         for point in wanted:
@@ -215,6 +232,8 @@ class ExhookManager:
         from dataclasses import replace
 
         for st in self._servers_for("message.publish"):
+            if not st.wants_topic("message.publish", msg.topic):
+                continue
             try:
                 resp = st.call("message.publish", _message_data(msg))
             except Exception:
@@ -291,6 +310,10 @@ class ExhookManager:
             if point == "__stop__" or self._stopping:
                 return
             for st in self._servers_for(point):
+                if point.startswith("message.") and not st.wants_topic(
+                    point, (data.get("message") or data).get("topic", "")
+                ):
+                    continue
                 try:
                     st.call(point, data)
                 except Exception:

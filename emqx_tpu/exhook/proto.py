@@ -1,0 +1,164 @@
+"""protoc codegen loader + hand-written gRPC service stubs.
+
+The image has `grpcio` + `protoc` but not the `grpc_tools` codegen
+plugin, so message classes come from `protoc --python_out` (generated
+on demand into this package, like the native/ C++ build) and the
+service stubs — normally emitted by the grpc plugin — are written here
+against the generic-handler API.  Method table mirrors the reference
+service (`apps/emqx_exhook/priv/protos/exhook.proto:27-69`).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger("emqx_tpu.exhook.proto")
+
+_HERE = os.path.dirname(__file__)
+_PROTO = os.path.join(_HERE, "protos", "exhook.proto")
+_PB2 = os.path.join(_HERE, "exhook_pb2.py")
+
+_lock = threading.Lock()
+_pb2 = None
+
+SERVICE = "emqx.exhook.v1.HookProvider"
+
+#: method -> (request message name, response message name)
+METHODS = {
+    "OnProviderLoaded": ("ProviderLoadedRequest", "LoadedResponse"),
+    "OnProviderUnloaded": ("ProviderUnloadedRequest", "EmptySuccess"),
+    "OnClientConnect": ("ClientConnectRequest", "EmptySuccess"),
+    "OnClientConnack": ("ClientConnackRequest", "EmptySuccess"),
+    "OnClientConnected": ("ClientConnectedRequest", "EmptySuccess"),
+    "OnClientDisconnected": ("ClientDisconnectedRequest", "EmptySuccess"),
+    "OnClientAuthenticate": ("ClientAuthenticateRequest", "ValuedResponse"),
+    "OnClientAuthorize": ("ClientAuthorizeRequest", "ValuedResponse"),
+    "OnClientSubscribe": ("ClientSubscribeRequest", "EmptySuccess"),
+    "OnClientUnsubscribe": ("ClientUnsubscribeRequest", "EmptySuccess"),
+    "OnSessionCreated": ("SessionCreatedRequest", "EmptySuccess"),
+    "OnSessionSubscribed": ("SessionSubscribedRequest", "EmptySuccess"),
+    "OnSessionUnsubscribed": ("SessionUnsubscribedRequest", "EmptySuccess"),
+    "OnSessionResumed": ("SessionResumedRequest", "EmptySuccess"),
+    "OnSessionDiscarded": ("SessionDiscardedRequest", "EmptySuccess"),
+    "OnSessionTakenover": ("SessionTakenoverRequest", "EmptySuccess"),
+    "OnSessionTerminated": ("SessionTerminatedRequest", "EmptySuccess"),
+    "OnMessagePublish": ("MessagePublishRequest", "ValuedResponse"),
+    "OnMessageDelivered": ("MessageDeliveredRequest", "EmptySuccess"),
+    "OnMessageDropped": ("MessageDroppedRequest", "EmptySuccess"),
+    "OnMessageAcked": ("MessageAckedRequest", "EmptySuccess"),
+}
+
+#: hookpoint name <-> rpc method
+HOOK_TO_METHOD = {
+    "client.connect": "OnClientConnect",
+    "client.connack": "OnClientConnack",
+    "client.connected": "OnClientConnected",
+    "client.disconnected": "OnClientDisconnected",
+    "client.authenticate": "OnClientAuthenticate",
+    "client.authorize": "OnClientAuthorize",
+    "client.subscribe": "OnClientSubscribe",
+    "client.unsubscribe": "OnClientUnsubscribe",
+    "session.created": "OnSessionCreated",
+    "session.subscribed": "OnSessionSubscribed",
+    "session.unsubscribed": "OnSessionUnsubscribed",
+    "session.resumed": "OnSessionResumed",
+    "session.discarded": "OnSessionDiscarded",
+    "session.takenover": "OnSessionTakenover",
+    "session.terminated": "OnSessionTerminated",
+    "message.publish": "OnMessagePublish",
+    "message.delivered": "OnMessageDelivered",
+    "message.dropped": "OnMessageDropped",
+    "message.acked": "OnMessageAcked",
+}
+
+
+def _generate() -> bool:
+    try:
+        subprocess.run(
+            ["protoc", f"--python_out={_HERE}", f"--proto_path={os.path.dirname(_PROTO)}",
+             _PROTO],
+            check=True, capture_output=True, timeout=60,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.info("protoc generation failed: %s", e)
+        return False
+
+
+def pb2():
+    """The generated message module (None when protoc/grpc are absent)."""
+    global _pb2
+    if _pb2 is not None:
+        return _pb2
+    with _lock:
+        if _pb2 is not None:
+            return _pb2
+        have_proto = os.path.exists(_PROTO)
+        if not os.path.exists(_PB2) or (
+            have_proto and os.path.getmtime(_PROTO) > os.path.getmtime(_PB2)
+        ):
+            if not _generate():
+                return None
+        try:
+            _pb2 = importlib.import_module("emqx_tpu.exhook.exhook_pb2")
+        except Exception as e:  # stale gencode vs runtime, etc.
+            log.info("exhook_pb2 import failed: %s", e)
+            return None
+    return _pb2
+
+
+def grpc_available() -> bool:
+    try:
+        import grpc  # noqa: F401
+    except ImportError:
+        return False
+    return pb2() is not None
+
+
+def make_stub(channel):
+    """Client stub for HookProvider, one unary-unary callable per rpc
+    (what grpc_tools' *_pb2_grpc.py would emit)."""
+    p = pb2()
+    stubs = {}
+    for method, (req_name, resp_name) in METHODS.items():
+        req = getattr(p, req_name)
+        resp = getattr(p, resp_name)
+        stubs[method] = channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=req.SerializeToString,
+            response_deserializer=resp.FromString,
+        )
+
+    class _Stub:
+        pass
+
+    stub = _Stub()
+    for name, fn in stubs.items():
+        setattr(stub, name, fn)
+    return stub
+
+
+def add_servicer(server, servicer) -> None:
+    """Register `servicer` (methods named like the rpcs) on a
+    grpc.Server via generic handlers."""
+    import grpc
+
+    p = pb2()
+    handlers = {}
+    for method, (req_name, resp_name) in METHODS.items():
+        fn = getattr(servicer, method, None)
+        if fn is None:
+            continue
+        handlers[method] = grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=getattr(p, req_name).FromString,
+            response_serializer=getattr(p, resp_name).SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )

@@ -174,6 +174,14 @@ class NodeRuntime:
         )
         self.monitor = MonitorSampler(self.broker)
 
+        # ---- exhook (out-of-process providers, gRPC or framed JSON) ------
+        self.exhook = None
+        self._exhook_defs = list(raw.get("exhook") or [])
+        if self._exhook_defs:
+            from .exhook import ExhookManager
+
+            self.exhook = ExhookManager(self.broker.hooks, self.broker.metrics)
+
         # ---- flow control ------------------------------------------------
         self.limiter = self._build_limiter()
         self.olp = Olp()
@@ -357,6 +365,24 @@ class NodeRuntime:
         started so far before re-raising — no leaked sockets/tasks."""
         log.info("node %s booting", self.node_name)
         try:
+            if self.exhook is not None:
+                from .exhook import ExhookServerConfig
+
+                for d in self._exhook_defs:
+                    if not d.get("enable", True):
+                        continue
+                    await asyncio.to_thread(
+                        self.exhook.load_server,
+                        ExhookServerConfig(
+                            name=d.get("name", "default"),
+                            host=d.get("host", "127.0.0.1"),
+                            port=int(d.get("port", 9000)),
+                            driver=d.get("driver", "grpc"),
+                            pool_size=int(d.get("pool_size", 4)),
+                            request_timeout=float(d.get("request_timeout", 5.0)),
+                            failed_action=d.get("failed_action", "deny"),
+                        ),
+                    )
             if self.cluster is not None:
                 await self.cluster.start()
             for lst in self.listeners:
@@ -403,6 +429,8 @@ class NodeRuntime:
                 log.exception("stopping listener on port %s", lst.port)
         if self.cluster is not None:
             await self.cluster.stop()
+        if self.exhook is not None:
+            await asyncio.to_thread(self.exhook.stop)
         if self.persistence is not None:
             self.persistence.tick()  # final dirty-page flush
         self.traces.stop_all()

@@ -63,7 +63,8 @@ class RecordingProvider:
 
 
 def load(mgr, thread, **cfg):
-    base = dict(name="s1", host="127.0.0.1", port=thread.port, pool_size=2)
+    base = dict(name="s1", host="127.0.0.1", port=thread.port, pool_size=2,
+                driver="json")
     base.update(cfg)
     return mgr.load_server(ExhookServerConfig(**base))
 
@@ -248,8 +249,8 @@ def test_multi_server_fold_order():
     try:
         b = Broker()
         mgr = ExhookManager(b.hooks, b.metrics)
-        mgr.load_server(ExhookServerConfig(name="a", host="127.0.0.1", port=t1.port))
-        mgr.load_server(ExhookServerConfig(name="b", host="127.0.0.1", port=t2.port))
+        mgr.load_server(ExhookServerConfig(name="a", host="127.0.0.1", port=t1.port, driver="json"))
+        mgr.load_server(ExhookServerConfig(name="b", host="127.0.0.1", port=t2.port, driver="json"))
         b.publish(Message(topic="step0", payload=b""))
         assert p2.events and p2.events[0][1]["topic"] == "step1"
         mgr.stop()
