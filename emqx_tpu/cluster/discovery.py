@@ -1,0 +1,112 @@
+"""Cluster discovery strategies — the ekka autocluster analog.
+
+The reference picks peers via `cluster.discovery_strategy`:
+static | mcast | dns | etcd | k8s (`emqx_conf_schema.erl:148-230`).
+Here a strategy is anything with `discover() -> Dict[name, (host, port)]`;
+`ClusterNode` polls it and joins newly seen peers.  DNS resolution and
+the etcd/k8s HTTP fetches are injectable for tests and for hosts where
+the backing service exists.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("emqx_tpu.cluster.discovery")
+
+Addr = Tuple[str, int]
+
+
+class StaticDiscovery:
+    """Fixed seed list (`discovery_strategy = static`)."""
+
+    def __init__(self, seeds: Dict[str, Addr]):
+        self.seeds = dict(seeds)
+
+    def discover(self) -> Dict[str, Addr]:
+        return dict(self.seeds)
+
+
+class DnsDiscovery:
+    """A/AAAA record discovery (`discovery_strategy = dns`): every
+    address behind `name` is a cluster node listening on `port`.  Node
+    names follow the reference's `<app>@<ip>` convention."""
+
+    def __init__(
+        self,
+        name: str,
+        port: int,
+        app: str = "emqx_tpu",
+        resolver: Optional[Callable[[str], List[str]]] = None,
+    ):
+        self.name = name
+        self.port = port
+        self.app = app
+        self.resolver = resolver or self._system_resolve
+
+    @staticmethod
+    def _system_resolve(name: str) -> List[str]:
+        try:
+            infos = socket.getaddrinfo(name, None, type=socket.SOCK_STREAM)
+        except OSError as e:
+            log.info("dns discovery: %s: %s", name, e)
+            return []
+        return sorted({i[4][0] for i in infos})
+
+    def discover(self) -> Dict[str, Addr]:
+        return {
+            f"{self.app}@{ip}": (ip, self.port)
+            for ip in self.resolver(self.name)
+        }
+
+
+class HttpKvDiscovery:
+    """etcd/k8s-style discovery: GET a url returning a JSON object of
+    node -> [host, port] (the etcd prefix scan / k8s endpoints shape,
+    `emqx_conf_schema.erl:190-230`).  The fetcher is injectable; the
+    default uses urllib so a real etcd/k8s proxy endpoint works when
+    reachable."""
+
+    def __init__(self, url: str, fetch: Optional[Callable[[str], bytes]] = None,
+                 timeout: float = 5.0):
+        self.url = url
+        self.timeout = timeout
+        self.fetch = fetch or self._http_get
+
+    def _http_get(self, url: str) -> bytes:
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            return r.read()
+
+    def discover(self) -> Dict[str, Addr]:
+        try:
+            obj = json.loads(self.fetch(self.url))
+        except Exception as e:
+            log.info("kv discovery %s failed: %s", self.url, e)
+            return {}
+        out: Dict[str, Addr] = {}
+        for name, addr in (obj or {}).items():
+            try:
+                out[str(name)] = (str(addr[0]), int(addr[1]))
+            except (TypeError, ValueError, IndexError):
+                continue
+        return out
+
+
+def make_discovery(kind: str, **cfg):
+    if kind == "static":
+        seeds = {
+            name: (a[0], int(a[1]))
+            for name, a in (cfg.get("seeds") or {}).items()
+        }
+        return StaticDiscovery(seeds)
+    if kind == "dns":
+        return DnsDiscovery(cfg["name"], int(cfg["port"]),
+                            app=cfg.get("app", "emqx_tpu"))
+    if kind in ("etcd", "k8s", "http"):
+        return HttpKvDiscovery(cfg["url"])
+    raise ValueError(f"unknown discovery strategy {kind!r}")

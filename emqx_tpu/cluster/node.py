@@ -19,6 +19,7 @@ static cluster discovery (`emqx_conf_schema.erl:148-230`).
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -27,6 +28,8 @@ from ..broker.message import Message
 from . import transport as tp
 from .routes import RemoteRoutes
 from .transport import PeerLink, RpcError, Transport
+
+log = logging.getLogger("emqx_tpu.cluster")
 
 
 class ClusterBroker(Broker):
@@ -102,12 +105,22 @@ class ClusterNode:
         miss_limit: int = 3,
         rpc_mode: str = "async",  # forward mode: async | sync
         cookie: str = "",  # shared secret gating peer links ("" = open)
+        role: str = "core",  # core | replicant (mria topology analog)
+        discovery=None,  # strategy with discover() -> {name: (host, port)}
+        discovery_ivl: float = 5.0,
+        advertise_host: Optional[str] = None,  # dial-back address when
+        # the bind host (e.g. 0.0.0.0) is not routable from peers
     ):
+        assert role in ("core", "replicant"), role
+        self.advertise_host = advertise_host
         self.name = name
         self.broker = broker
         broker.cluster = self
         self.incarnation = time.time_ns()
         self.cookie = cookie
+        self.role = role
+        self.discovery = discovery
+        self.discovery_ivl = discovery_ivl
         self.transport = Transport(name, host, port, cookie=cookie)
         self.remote = RemoteRoutes()
         self.peers_cfg: Dict[str, Tuple[str, int]] = dict(peers or {})
@@ -122,7 +135,9 @@ class ClusterNode:
         self._status: Dict[str, str] = {}  # peer -> up|down
         self._resyncing: Set[str] = set()
         self._hb_task: Optional[asyncio.Task] = None
+        self._disc_task: Optional[asyncio.Task] = None
         self._misses: Dict[str, int] = {}
+        self._roles: Dict[str, str] = {}  # peer -> core|replicant
 
         broker.on_route_added = self._route_added
         broker.on_route_removed = self._route_removed
@@ -132,6 +147,7 @@ class ClusterNode:
         t.on_snapshot_req = self._on_snapshot_req
         t.on_forward = self._on_forward
         t.rpc_handlers["publish"] = self._rpc_publish
+        t.rpc_handlers["remote_snapshot"] = self._rpc_remote_snapshot
 
     # ------------------------------------------------------------- lifecycle
 
@@ -140,21 +156,32 @@ class ClusterNode:
         for peer, addr in self.peers_cfg.items():
             self._add_link(peer, addr)
         self._hb_task = asyncio.get_running_loop().create_task(self._heartbeat())
+        if self.discovery is not None:
+            self._disc_task = asyncio.get_running_loop().create_task(
+                self._discovery_loop()
+            )
 
     async def stop(self) -> None:
-        if self._hb_task:
-            self._hb_task.cancel()
-            try:
-                await self._hb_task
-            except (asyncio.CancelledError, Exception):
-                pass
+        for task in (self._hb_task, self._disc_task):
+            if task:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
         for link in self.links.values():
             await link.stop()
         await self.transport.stop()
 
     def join(self, peer: str, addr: Tuple[str, int]) -> None:
-        """Add a peer at runtime (manual `cluster join`)."""
+        """Add a peer at runtime (manual `cluster join`).  A changed
+        address (peer restarted elsewhere, k8s pod move) replaces the
+        old link so reconnects chase the live endpoint."""
         self.peers_cfg[peer] = addr
+        old = self.links.get(peer)
+        if old is not None and old.addr != tuple(addr):
+            self.links.pop(peer, None)
+            asyncio.get_running_loop().create_task(old.stop())
         if peer not in self.links:
             self._add_link(peer, addr)
 
@@ -174,14 +201,68 @@ class ClusterNode:
             on_up=self._link_up,
             on_down=lambda l: self._node_down(l.peer),
             cookie=self.cookie,
+            extra_hello=self._hello_extra(),
         )
         self.links[peer] = link
         self._status.setdefault(peer, "down")
         link.start()
 
+    def _hello_extra(self) -> dict:
+        extra = {"role": self.role}
+        host = self.advertise_host or self.transport.host
+        if host not in ("0.0.0.0", "::"):
+            # a wildcard bind with no advertise_host is not dialable;
+            # omit addr so peers skip dial-back instead of dialing junk
+            extra["addr"] = [host, self.transport.port]
+        else:
+            log.warning(
+                "node %s binds %s without advertise_host: peers cannot "
+                "dial back", self.name, host,
+            )
+        return extra
+
+    async def _discovery_loop(self) -> None:
+        """Poll the discovery strategy; join newly seen peers.  Cores
+        join every discovered node; replicants join cores only — their
+        links to other nodes come from cores dialing back."""
+        while True:
+            try:
+                found = await asyncio.to_thread(self.discovery.discover)
+            except Exception:
+                log.exception("%s: discovery poll failed", self.name)
+                found = {}
+            for peer, addr in (found or {}).items():
+                if peer == self.name:
+                    continue
+                if self.role == "replicant" and (
+                    self._roles.get(peer) == "replicant"
+                ):
+                    continue
+                try:
+                    self.join(peer, (str(addr[0]), int(addr[1])))
+                except (ValueError, TypeError, IndexError):
+                    log.warning(
+                        "%s: discovery entry %r -> %r unusable",
+                        self.name, peer, addr,
+                    )
+            await asyncio.sleep(self.discovery_ivl)
+
     # ----------------------------------------------------------- membership
 
     def _link_up(self, link: PeerLink, hello: dict) -> None:
+        peer_role = hello.get("role", "core")
+        self._roles[link.peer] = peer_role
+        if self.role == "replicant" and peer_role == "replicant":
+            # replicants never mesh with each other (mria topology) —
+            # discovery could not know the role before dialing; now we
+            # do, so tear the link down and remember not to redial
+            log.info("%s: dropping replicant<->replicant link to %s",
+                     self.name, link.peer)
+            self.links.pop(link.peer, None)
+            self.peers_cfg.pop(link.peer, None)
+            self._status.pop(link.peer, None)
+            asyncio.get_running_loop().create_task(link.stop())
+            return
         self._status[link.peer] = "up"
         self._misses[link.peer] = 0
         self.broker.hooks.run("node.up", (link.peer,))
@@ -247,13 +328,28 @@ class ClusterNode:
         )
         if not ok:
             asyncio.get_running_loop().create_task(self._resync(obj["node"]))
+        # cores relay first-hop ops so nodes without a direct link to the
+        # origin (replicant<->replicant) still converge (rlog fan-out)
+        if (
+            self.role == "core"
+            and not obj.get("relayed")
+            and obj.get("node") == peer
+        ):
+            frame = tp.pack_json(tp.ROUTE_OP, {**obj, "relayed": True})
+            for name, link in self.links.items():
+                if name != peer:
+                    link.send_nowait(frame)
 
     async def _resync(self, peer: str) -> None:
-        """Fetch a full route snapshot from a peer (rlog bootstrap)."""
+        """Fetch a full route snapshot from a peer (rlog bootstrap).
+
+        Without a direct link to `peer` (replicant<->replicant), the
+        snapshot is served from a core's mirror instead."""
         if peer in self._resyncing:
             return
         link = self.links.get(peer)
         if link is None or not link.connected:
+            await self._resync_via_core(peer)
             return
         self._resyncing.add(peer)
         try:
@@ -269,7 +365,68 @@ class ClusterNode:
             self._resyncing.discard(peer)
 
     def _on_hello(self, peer: str, hello: dict) -> dict:
-        return {"incarnation": self.incarnation}
+        self._roles[peer] = hello.get("role", "core")
+        # dial back a peer we have no outbound link to (replicants dial
+        # cores; the core's return link is how forwards/relays reach
+        # them — mria's replicant attach)
+        addr = hello.get("addr")
+        if (
+            peer not in self.links
+            and isinstance(addr, (list, tuple))
+            and not (
+                self.role == "replicant"
+                and hello.get("role", "core") == "replicant"
+            )
+        ):
+            try:
+                self.join(peer, (str(addr[0]), int(addr[1])))
+            except (ValueError, TypeError):
+                pass
+        return {"incarnation": self.incarnation, "role": self.role}
+
+    async def _resync_via_core(self, origin: str) -> None:
+        """Ask an up core for its mirror of `origin`'s routes."""
+        key = f"{origin}/via-core"
+        if key in self._resyncing:
+            return
+        self._resyncing.add(key)
+        try:
+            for peer, link in list(self.links.items()):
+                if (
+                    self._roles.get(peer) != "core"
+                    or not link.connected
+                    or peer == origin
+                ):
+                    continue
+                try:
+                    resp = await link.rpc(
+                        "remote_snapshot", {"node": origin}, timeout=5.0
+                    )
+                except (RpcError, Exception):
+                    continue
+                if resp.get("known"):
+                    self.remote.load_snapshot(
+                        origin,
+                        resp["incarnation"],
+                        resp["seq"],
+                        resp["filters"],
+                    )
+                    return
+        finally:
+            self._resyncing.discard(key)
+
+    def _rpc_remote_snapshot(self, peer: str, params: dict) -> dict:
+        """Serve this core's mirror of another node's routes."""
+        node = params.get("node", "")
+        inc_seq = self.remote.applied.get(node)
+        if inc_seq is None:
+            return {"known": False}
+        return {
+            "known": True,
+            "incarnation": inc_seq[0],
+            "seq": inc_seq[1],
+            "filters": sorted(self.remote.filters_of(node)),
+        }
 
     def _on_snapshot_req(self, peer: str, obj: dict) -> dict:
         return {
@@ -290,16 +447,37 @@ class ClusterNode:
         n = 0
         for node, node_msgs in per_node.items():
             link = self.links.get(node)
+            relay = None
             if link is None or not link.connected:
-                self.broker.metrics.inc("messages.forward.dropped", len(node_msgs))
-                continue
+                # no direct link (replicant->replicant): ride via a core
+                relay = self._up_core_link(exclude=node)
+                if relay is None:
+                    self.broker.metrics.inc(
+                        "messages.forward.dropped", len(node_msgs)
+                    )
+                    continue
             for msg in node_msgs:
                 header, payload = message_to_wire(msg)
-                if link.send_nowait(tp.pack_forward(header, payload)):
+                if relay is not None:
+                    header["relay_to"] = node
+                    sent = relay.send_nowait(tp.pack_forward(header, payload))
+                else:
+                    sent = link.send_nowait(tp.pack_forward(header, payload))
+                if sent:
                     n += 1
         if n:
             self.broker.metrics.inc("messages.forward.out", n)
         return n
+
+    def _up_core_link(self, exclude: str = ""):
+        for peer, link in self.links.items():
+            if (
+                peer != exclude
+                and link.connected
+                and self._roles.get(peer) == "core"
+            ):
+                return link
+        return None
 
     async def forward_publish_sync(self, msgs: Sequence[Message]) -> int:
         """Sync-mode forward: awaits per-message dispatch acks."""
@@ -331,6 +509,19 @@ class ClusterNode:
         return per_node
 
     def _on_forward(self, peer: str, header: dict, payload: bytes):
+        relay_to = header.pop("relay_to", None)
+        if relay_to and relay_to != self.name:
+            # core relaying a forward between two unlinked nodes
+            link = self.links.get(relay_to)
+            if (
+                link is not None
+                and link.connected
+                and link.send_nowait(tp.pack_forward(header, payload))
+            ):
+                self.broker.metrics.inc("messages.forward.relayed")
+            else:
+                self.broker.metrics.inc("messages.forward.dropped")
+            return None
         msg = message_from_wire(header, payload)
         n = self.broker.dispatch_forwarded(msg)
         return {"n": n} if header.get("id") is not None else None

@@ -79,6 +79,10 @@ class RemoteRoutes:
     def apply_op(self, node: str, incarnation: int, seq: int, op: str, filt: str) -> bool:
         """Apply one oplog entry; False => gap/restart, caller must resync."""
         inc, applied = self.applied.get(node, (None, None))
+        if inc == incarnation and applied is not None and seq <= applied:
+            # duplicate: the same op arrives directly AND via a core
+            # relay (replicant fan-out) — already applied, not a gap
+            return True
         if inc != incarnation or applied is None or seq != applied + 1:
             return False
         if op == "add":

@@ -109,6 +109,7 @@ class PeerLink:
         on_down: Callable[["PeerLink"], None],
         reconnect_ivl: float = 0.5,
         cookie: str = "",
+        extra_hello: Optional[dict] = None,  # role/addr advertisement
     ):
         self.self_node = self_node
         self.peer = peer
@@ -118,6 +119,7 @@ class PeerLink:
         self.on_down = on_down
         self.reconnect_ivl = reconnect_ivl
         self.cookie = cookie
+        self.extra_hello = dict(extra_hello or {})
         self._auth_warned = False
         self.connected = False
         self.peer_hello: dict = {}
@@ -157,6 +159,7 @@ class PeerLink:
                     "node": self.self_node,
                     "incarnation": self.incarnation,
                     "challenge": my_nonce,
+                    **self.extra_hello,
                 }
                 if self.cookie:
                     my_hello["auth"] = hello_auth(

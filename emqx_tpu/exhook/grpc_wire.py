@@ -388,7 +388,18 @@ class _Servicer:
     # -- per-rpc adapters (hook dicts mirror manager._encode_event)
 
     def OnClientConnect(self, request, context):
-        return self._event("client.connect", {})
+        ci = request.conninfo
+        return self._event(
+            "client.connect",
+            {
+                "clientinfo": {
+                    "node": ci.node,
+                    "clientid": ci.clientid,
+                    "username": ci.username or None,
+                    "peerhost": ci.peerhost,
+                }
+            },
+        )
 
     def OnClientConnack(self, request, context):
         return self._event("client.connack", {"args": [request.result_code]})

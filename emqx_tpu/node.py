@@ -83,6 +83,21 @@ class NodeRuntime:
                 name: (addr[0], int(addr[1]))
                 for name, addr in (cluster_cfg.get("peers") or {}).items()
             }
+            discovery = None
+            discovery_ivl = 5.0
+            disc_cfg = cluster_cfg.get("discovery")
+            if disc_cfg:
+                from .cluster.discovery import make_discovery
+
+                discovery_ivl = float(disc_cfg.get("interval", 5.0))
+                discovery = make_discovery(
+                    disc_cfg.get("strategy", "static"),
+                    **{
+                        k: v
+                        for k, v in disc_cfg.items()
+                        if k not in ("strategy", "interval")
+                    },
+                )
             self.cluster = ClusterNode(
                 self.node_name,
                 self.broker,
@@ -91,6 +106,10 @@ class NodeRuntime:
                 peers=peers,
                 rpc_mode=cluster_cfg.get("rpc_mode", "async"),
                 cookie=self.conf.get("node.cookie"),
+                role=cluster_cfg.get("role", "core"),
+                discovery=discovery,
+                discovery_ivl=discovery_ivl,
+                advertise_host=cluster_cfg.get("advertise_host"),
             )
         else:
             self.broker = Broker(retainer=retainer)

@@ -1,0 +1,271 @@
+"""Core/replicant topology, discovery strategies, autoheal.
+
+Reference: mria's core/replicant roles + ekka discovery/autoheal
+(emqx_conf_schema.erl:148-230,328-342).  Replicants dial cores only;
+cores dial back, relay route ops and forwards so replicant<->replicant
+traffic converges without a direct link.
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.session import Session
+from emqx_tpu.cluster import ClusterBroker, ClusterNode
+from emqx_tpu.cluster.discovery import (
+    DnsDiscovery,
+    HttpKvDiscovery,
+    StaticDiscovery,
+    make_discovery,
+)
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+async def wait_until(pred, timeout=10.0, ivl=0.02):
+    t = 0.0
+    while not pred():
+        await asyncio.sleep(ivl)
+        t += ivl
+        if t > timeout:
+            raise AssertionError("condition not reached")
+
+
+class Sink:
+    def __init__(self, clientid, session):
+        self.clientid = clientid
+        self.session = session
+        self.got = []
+
+    def deliver(self, items):
+        self.got.extend(items)
+
+    def kick(self, reason_code=0):
+        pass
+
+
+def attach(node, clientid, filt, qos=0):
+    s = Session(clientid=clientid)
+    s.subscriptions[filt] = SubOpts(qos=qos)
+    sink = Sink(clientid, s)
+    node.broker.cm.register_channel(sink)
+    node.broker.subscribe(clientid, filt, SubOpts(qos=qos))
+    return sink
+
+
+async def core_replicant_cluster():
+    """One core + two replicants; replicants dial the core only."""
+    core = ClusterNode("core0", ClusterBroker(), heartbeat_ivl=0.2, role="core")
+    await core.start()
+    reps = []
+    for i in range(2):
+        r = ClusterNode(
+            f"rep{i}", ClusterBroker(), heartbeat_ivl=0.2, role="replicant"
+        )
+        await r.start()
+        r.join("core0", ("127.0.0.1", core.transport.port))
+        reps.append(r)
+    nodes = [core] + reps
+    # core dials back both replicants; replicants stay unlinked
+    await wait_until(
+        lambda: len(core.up_peers()) == 2
+        and all("core0" in r.up_peers() for r in reps)
+    )
+    assert "rep1" not in reps[0].links and "rep0" not in reps[1].links
+    return core, reps[0], reps[1], nodes
+
+
+def test_replicant_routes_relay_through_core(run):
+    async def main():
+        core, r0, r1, nodes = await core_replicant_cluster()
+        # subscriber on r1: its route must reach r0 via the core relay
+        sink = attach(r1, "c-r1", "fleet/+/pos")
+        await wait_until(
+            lambda: "fleet/+/pos" in r0.remote.filters_of("rep1"), timeout=10
+        )
+        # publish on r0 -> relayed forward through core -> r1 delivers
+        r0.broker.publish(Message(topic="fleet/7/pos", payload=b"59.3,18.1"))
+        await wait_until(lambda: len(sink.got) == 1)
+        assert sink.got[0][1].payload == b"59.3,18.1"
+        assert core.broker.metrics.get("messages.forward.relayed") == 1
+        for x in nodes:
+            await x.stop()
+
+    run(main())
+
+
+def test_replicant_late_join_snapshot_via_core(run):
+    """A replicant joining after another replicant's routes exist gets
+    them from the core's mirror (remote_snapshot rpc)."""
+
+    async def main():
+        core = ClusterNode("core0", ClusterBroker(), heartbeat_ivl=0.2)
+        await core.start()
+        r0 = ClusterNode(
+            "rep0", ClusterBroker(), heartbeat_ivl=0.2, role="replicant"
+        )
+        await r0.start()
+        r0.join("core0", ("127.0.0.1", core.transport.port))
+        attach(r0, "cx", "old/route/#")
+        await wait_until(
+            lambda: "old/route/#" in core.remote.filters_of("rep0")
+        )
+
+        late = ClusterNode(
+            "rep9", ClusterBroker(), heartbeat_ivl=0.2, role="replicant"
+        )
+        await late.start()
+        late.join("core0", ("127.0.0.1", core.transport.port))
+        await wait_until(lambda: "core0" in late.up_peers())
+        # trigger the via-core path directly (no link to rep0 exists)
+        await late._resync("rep0")
+        assert "old/route/#" in late.remote.filters_of("rep0")
+        for x in (core, r0, late):
+            await x.stop()
+
+    run(main())
+
+
+def test_autoheal_partition_resync(run):
+    """Link drop + route churn during the partition; on heal the
+    stale side resyncs to the origin's snapshot."""
+
+    async def main():
+        a = ClusterNode("a0", ClusterBroker(), heartbeat_ivl=0.2)
+        b = ClusterNode("b0", ClusterBroker(), heartbeat_ivl=0.2)
+        await a.start()
+        await b.start()
+        a.join("b0", ("127.0.0.1", b.transport.port))
+        b.join("a0", ("127.0.0.1", a.transport.port))
+        await wait_until(
+            lambda: "b0" in a.up_peers() and "a0" in b.up_peers()
+        )
+        attach(b, "c1", "t/1")
+        await wait_until(lambda: "t/1" in a.remote.filters_of("b0"))
+
+        # partition: kill a's view of b (link down both ways)
+        link = a.links["b0"]
+        await link.stop()
+        a._node_down("b0")
+        assert a.remote.filters_of("b0") == set()  # purged on nodedown
+
+        # churn on b while partitioned
+        attach(b, "c2", "t/2")
+
+        # heal: redial
+        a._add_link("b0", ("127.0.0.1", b.transport.port))
+        await wait_until(
+            lambda: a.remote.filters_of("b0") == {"t/1", "t/2"}, timeout=10
+        )
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+def test_static_and_dns_discovery(run):
+    async def main():
+        a = ClusterNode("seed0", ClusterBroker(), heartbeat_ivl=0.2)
+        await a.start()
+        disc = StaticDiscovery({"seed0": ("127.0.0.1", a.transport.port)})
+        b = ClusterNode(
+            "joiner",
+            ClusterBroker(),
+            heartbeat_ivl=0.2,
+            discovery=disc,
+            discovery_ivl=0.1,
+        )
+        await b.start()
+        await wait_until(lambda: "seed0" in b.up_peers(), timeout=10)
+        # dial-back gives the seed a link too
+        await wait_until(lambda: "joiner" in a.up_peers(), timeout=10)
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+def test_dns_discovery_resolution():
+    d = DnsDiscovery(
+        "cluster.local", 7777, resolver=lambda n: ["10.0.0.1", "10.0.0.2"]
+    )
+    assert d.discover() == {
+        "emqx_tpu@10.0.0.1": ("10.0.0.1", 7777),
+        "emqx_tpu@10.0.0.2": ("10.0.0.2", 7777),
+    }
+
+
+def test_http_kv_discovery_and_factory():
+    payload = b'{"n1": ["10.1.0.1", 1883], "bad": "x"}'
+    d = HttpKvDiscovery("http://etcd/v3/keys", fetch=lambda url: payload)
+    assert d.discover() == {"n1": ("10.1.0.1", 1883)}
+    # fetch failure -> empty, not an exception
+    boom = HttpKvDiscovery("http://x", fetch=lambda url: 1 / 0)
+    assert boom.discover() == {}
+    assert isinstance(make_discovery("static", seeds={}), StaticDiscovery)
+    assert isinstance(
+        make_discovery("dns", name="x", port=1), DnsDiscovery
+    )
+    assert isinstance(make_discovery("etcd", url="http://x"), HttpKvDiscovery)
+    with pytest.raises(ValueError):
+        make_discovery("mcast")
+
+
+def test_replicants_never_mesh_even_via_discovery(run):
+    """Discovery can hand a replicant another replicant before roles are
+    known; the link must be torn down once the hello reveals the role."""
+
+    async def main():
+        core = ClusterNode("core0", ClusterBroker(), heartbeat_ivl=0.2)
+        await core.start()
+        r0 = ClusterNode("rep0", ClusterBroker(), heartbeat_ivl=0.2,
+                         role="replicant")
+        await r0.start()
+        r0.join("core0", ("127.0.0.1", core.transport.port))
+        r1 = ClusterNode(
+            "rep1",
+            ClusterBroker(),
+            heartbeat_ivl=0.2,
+            role="replicant",
+            discovery=StaticDiscovery({
+                "core0": ("127.0.0.1", core.transport.port),
+                "rep0": ("127.0.0.1", r0.transport.port),
+            }),
+            discovery_ivl=0.1,
+        )
+        await r1.start()
+        await wait_until(lambda: "core0" in r1.up_peers())
+        await asyncio.sleep(0.5)  # a few discovery rounds
+        assert "rep0" not in r1.up_peers()
+        assert "rep1" not in r0.up_peers()
+        assert r1._roles.get("rep0") == "replicant"  # learned, not redialed
+        for x in (core, r0, r1):
+            await x.stop()
+
+    run(main())
+
+
+def test_join_refreshes_changed_address(run):
+    """A peer restarting at a new address (pod move) must be re-dialed."""
+
+    async def main():
+        a = ClusterNode("a0", ClusterBroker(), heartbeat_ivl=0.2)
+        await a.start()
+        b = ClusterNode("b0", ClusterBroker(), heartbeat_ivl=0.2)
+        await b.start()
+        a.join("b0", ("127.0.0.1", 1))  # dead address
+        await asyncio.sleep(0.3)
+        assert "b0" not in a.up_peers()
+        a.join("b0", ("127.0.0.1", b.transport.port))  # discovery refresh
+        await wait_until(lambda: "b0" in a.up_peers())
+        await a.stop()
+        await b.stop()
+
+    run(main())
