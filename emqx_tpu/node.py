@@ -193,6 +193,36 @@ class NodeRuntime:
         )
         self.monitor = MonitorSampler(self.broker)
 
+        # ---- rule engine (emqx_rule_engine) ------------------------------
+        self.rule_engine = None
+        rule_defs = raw.get("rules") or []
+        if rule_defs:
+            from .rules.engine import Console, Republish, RuleEngine
+
+            self.rule_engine = RuleEngine(self.broker)
+            for idx, rd in enumerate(rule_defs):
+                outputs = []
+                for od in rd.get("outputs") or [{"type": "console"}]:
+                    if od.get("type") == "republish":
+                        outputs.append(
+                            Republish(
+                                topic_template=od["topic"],
+                                payload_template=od.get(
+                                    "payload", "${payload}"
+                                ),
+                                qos=int(od.get("qos", 0)),
+                                retain=bool(od.get("retain", False)),
+                            )
+                        )
+                    else:
+                        outputs.append(Console())
+                self.rule_engine.create_rule(
+                    rd.get("id", f"rule{idx}"),
+                    rd["sql"],
+                    outputs,
+                    description=rd.get("description", ""),
+                )
+
         # ---- exhook (out-of-process providers, gRPC or framed JSON) ------
         self.exhook = None
         self._exhook_defs = list(raw.get("exhook") or [])

@@ -26,12 +26,15 @@ from typing import Any, Callable, Dict, List, Optional
 from ..broker import topic as topiclib
 from ..broker.broker import Broker
 from ..broker.message import Message
-from .funcs import FUNCS
+from .funcs import FUNCS, reset_proc_dict
 from .sql import BinOp, Call, Case, Field, Lit, Not, Query, SelectItem, SqlError, parse_sql
 
 log = logging.getLogger("emqx_tpu.rules")
 
 EVENT_TOPICS = {
+    # explicit alias for the publish stream (plain topic filters in FROM
+    # also select it); matches event_topic('message.publish')
+    "$events/message_publish": "message.publish",
     "$events/message_delivered": "message.delivered",
     "$events/message_acked": "message.acked",
     "$events/message_dropped": "message.dropped",
@@ -404,6 +407,7 @@ class RuleEngine:
                 continue
             rule.metrics["matched"] += 1
             try:
+                reset_proc_dict()  # proc_dict_* scope = one application
                 selected = run_select(rule.query, env)
             except Exception:
                 rule.metrics["failed"] += 1

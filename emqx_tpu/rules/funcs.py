@@ -61,7 +61,6 @@ fn("regex_match")(lambda s, p: __import__("re").search(p, str(s)) is not None)
 fn("regex_replace")(lambda s, p, r: __import__("re").sub(p, r, str(s)))
 fn("ascii")(lambda c: ord(str(c)[0]))
 fn("find")(lambda s, sub: str(s).find(str(sub)))
-fn("pad")(lambda s, n, c=" ": str(s).ljust(int(n), str(c)))
 fn("sprintf")(lambda f, *a: str(f) % a)
 
 # numbers ---------------------------------------------------------------
@@ -144,3 +143,290 @@ def _nth_topic_level(i, topic):
 @fn("__in__")
 def _in(x, *items):
     return x in items
+
+
+# trigonometry / logs (emqx_rule_funcs.erl math section) ---------------
+import math as _math
+
+for _name in ("sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+              "tanh", "asinh", "acosh", "atanh", "exp", "log10", "log2"):
+    fn(_name)(lambda x, _f=getattr(_math, _name): _f(_num(x)))
+fn("log")(lambda x: _math.log(_num(x)))
+fn("fmod")(lambda x, y: _math.fmod(_num(x), _num(y)))
+fn("mod")(lambda x, y: int(_num(x)) % int(_num(y)))
+fn("div")(lambda x, y: int(_num(x)) // int(_num(y)))
+fn("exp2")(lambda x: 2.0 ** _num(x))
+
+# bit operations --------------------------------------------------------
+fn("bitand")(lambda a, b: int(_num(a)) & int(_num(b)))
+fn("bitor")(lambda a, b: int(_num(a)) | int(_num(b)))
+fn("bitxor")(lambda a, b: int(_num(a)) ^ int(_num(b)))
+fn("bitnot")(lambda a: ~int(_num(a)))
+fn("bitsl")(lambda a, n: int(_num(a)) << int(_num(n)))
+fn("bitsr")(lambda a, n: int(_num(a)) >> int(_num(n)))
+fn("bitsize")(lambda b: len(_to_bytes(b)) * 8)
+
+
+@fn("subbits")
+def _subbits(data, *args):
+    """subbits(bytes[, len]) / subbits(bytes, start, len[, type,
+    signedness, endianness]) — bit-addressed field extraction, the
+    binary-payload decoder of `emqx_rule_funcs.erl` (do_get_subbits)."""
+    raw = _to_bytes(data)
+    if not args:
+        start, length = 1, len(raw) * 8
+        out_type, signed, endian = "integer", "unsigned", "big"
+    elif len(args) == 1:
+        start, length = 1, int(args[0])
+        out_type, signed, endian = "integer", "unsigned", "big"
+    else:
+        start, length = int(args[0]), int(args[1])
+        out_type = args[2] if len(args) > 2 else "integer"
+        signed = args[3] if len(args) > 3 else "unsigned"
+        endian = args[4] if len(args) > 4 else "big"
+    total = int.from_bytes(raw, "big")
+    nbits = len(raw) * 8
+    end = start - 1 + length  # start is 1-based
+    if end > nbits or start < 1:
+        return None
+    chunk = (total >> (nbits - end)) & ((1 << length) - 1)
+    if out_type == "bits":
+        nbytes = (length + 7) // 8
+        return (chunk << (nbytes * 8 - length)).to_bytes(nbytes, "big")
+    if endian == "little":
+        nbytes = (length + 7) // 8
+        chunk = int.from_bytes(chunk.to_bytes(nbytes, "big"), "little")
+    if out_type == "float":
+        import struct as _struct
+
+        if length == 32:
+            return _struct.unpack(">f", chunk.to_bytes(4, "big"))[0]
+        if length == 64:
+            return _struct.unpack(">d", chunk.to_bytes(8, "big"))[0]
+        return None
+    if signed == "signed" and chunk >= 1 << (length - 1):
+        chunk -= 1 << length
+    return chunk
+
+
+FUNCS["get_subbits"] = _subbits
+
+# time ------------------------------------------------------------------
+_UNIT_MS = {"second": 1, "millisecond": 1_000, "microsecond": 1_000_000,
+            "nanosecond": 1_000_000_000}
+
+
+@fn("time_unit")
+def _time_unit(val, from_unit, to_unit):
+    return int(_num(val) * _UNIT_MS[str(to_unit)] / _UNIT_MS[str(from_unit)])
+
+
+@fn("now_rfc3339")
+def _now_rfc3339(unit="second"):
+    return _unix_ts_to_rfc3339(time.time() * _UNIT_MS[str(unit)], unit)
+
+
+@fn("unix_ts_to_rfc3339")
+def _unix_ts_to_rfc3339(ts, unit="second"):
+    import datetime as _dt
+
+    secs = _num(ts) / _UNIT_MS[str(unit)]
+    dt = _dt.datetime.fromtimestamp(secs, _dt.timezone.utc)
+    if str(unit) == "second":
+        return dt.strftime("%Y-%m-%dT%H:%M:%S+00:00")
+    return dt.isoformat().replace("+00:00", "") + "+00:00"
+
+
+@fn("rfc3339_to_unix_ts")
+def _rfc3339_to_unix_ts(s, unit="second"):
+    import datetime as _dt
+
+    s = str(s)
+    if s.endswith("Z"):
+        s = s[:-1] + "+00:00"
+    dt = _dt.datetime.fromisoformat(s)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return int(dt.timestamp() * _UNIT_MS[str(unit)])
+
+
+# string extras ---------------------------------------------------------
+@fn("tokens")
+def _tokens(s, seps, nocrlf=None):
+    s = str(s)
+    if nocrlf == "nocrlf":
+        s = s.replace("\r", "").replace("\n", "")
+    out, cur = [], []
+    sepset = set(str(seps))
+    for ch in s:
+        if ch in sepset:
+            if cur:
+                out.append("".join(cur))
+                cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+@fn("pad")
+def _pad(s, n, direction="trailing", char=" "):
+    s, n, char = str(s), int(n), str(char) or " "
+    if direction == "leading":
+        return s.rjust(n, char[0])
+    if direction == "both":
+        return s.center(n, char[0])
+    return s.ljust(n, char[0])
+
+
+@fn("sprintf_s")
+def _sprintf_s(fmt, *args):
+    """Erlang io_lib-style ~s/~p/~w/~b formatting; literal text (incl.
+    braces) passes through untouched, ~~ escapes a tilde."""
+    out = []
+    it = iter(range(len(args)))
+    ai = 0
+    i = 0
+    fmt = str(fmt)
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch == "~" and i + 1 < len(fmt):
+            code = fmt[i + 1]
+            i += 2
+            if code == "~":
+                out.append("~")
+            elif code == "n":
+                out.append("\n")
+            elif code in ("s", "b"):
+                out.append(str(args[ai]) if ai < len(args) else "")
+                ai += 1
+            elif code in ("p", "w"):
+                out.append(repr(args[ai]) if ai < len(args) else "")
+                ai += 1
+            else:  # unknown directive: keep verbatim
+                out.append("~" + code)
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+fn("str_utf8")(lambda x: x.decode("utf-8") if isinstance(x, (bytes, bytearray)) else str(x))
+fn("float2str")(lambda x, prec=17: f"{float(_num(x)):.{int(prec)}g}")
+fn("eq")(lambda a, b: a == b)
+
+
+@fn("hash")
+def _hash(alg, data):
+    alg = str(alg).lower()
+    h = hashlib.new("sha1" if alg == "sha" else alg)
+    h.update(_to_bytes(data))
+    return h.hexdigest()
+
+
+# maps ------------------------------------------------------------------
+fn("map_new")(lambda: {})
+
+
+def _path_keys(k):
+    return [p for p in str(k).replace("[", ".").replace("]", "").split(".") if p]
+
+
+@fn("mget")
+def _mget(k, m, default=None):
+    cur = m or {}
+    for part in _path_keys(k):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        elif isinstance(cur, list) and part.isdigit():
+            i = int(part)
+            if 1 <= i <= len(cur):
+                cur = cur[i - 1]
+            else:
+                return default
+        else:
+            return default
+    return cur
+
+
+@fn("mput")
+def _mput(k, v, m):
+    parts = _path_keys(k)
+    if not parts:
+        return m
+    root = dict(m or {})
+    cur = root
+    for part in parts[:-1]:
+        # read the existing container at this step (1-based list index)
+        if isinstance(cur, list):
+            idx = int(part) - 1 if part.isdigit() else -1
+            nxt = cur[idx] if 0 <= idx < len(cur) else None
+        else:
+            nxt = cur.get(part)
+        # copy-on-write, preserving container kinds along the path
+        if isinstance(nxt, list):
+            nxt = list(nxt)
+        elif isinstance(nxt, dict):
+            nxt = dict(nxt)
+        else:
+            nxt = {}
+        if isinstance(cur, list):
+            if 0 <= idx < len(cur):
+                cur[idx] = nxt
+            else:
+                return root  # out-of-range list step: no-op
+        else:
+            cur[part] = nxt
+        cur = nxt
+    last = parts[-1]
+    if isinstance(cur, list) and last.isdigit() and 1 <= int(last) <= len(cur):
+        cur[int(last) - 1] = v
+    elif isinstance(cur, dict):
+        cur[last] = v
+    return root
+
+
+FUNCS["map_path"] = _mget
+
+# per-node kv store (kv_store_* of the reference; survives across rule
+# evaluations, node-local like its ets table) ---------------------------
+_KV_STORE: Dict[str, Any] = {}
+
+fn("kv_store_put")(lambda k, v: (_KV_STORE.__setitem__(str(k), v), v)[1])
+fn("kv_store_get")(lambda k, default=None: _KV_STORE.get(str(k), default))
+fn("kv_store_del")(lambda k: _KV_STORE.pop(str(k), None))
+
+# per-evaluation scratch dict (proc_dict_* — the reference's process
+# dictionary scoped to one rule application; cleared by the engine) -----
+_PROC_DICT: Dict[str, Any] = {}
+
+fn("proc_dict_put")(lambda k, v: (_PROC_DICT.__setitem__(str(k), v), v)[1])
+fn("proc_dict_get")(lambda k: _PROC_DICT.get(str(k)))
+fn("proc_dict_del")(lambda k: _PROC_DICT.pop(str(k), None))
+
+
+def reset_proc_dict() -> None:
+    """Engine calls this around each rule application."""
+    _PROC_DICT.clear()
+
+
+# term encode/decode: the reference uses Erlang external term format;
+# the portable analog here is canonical JSON bytes ----------------------
+fn("term_encode")(lambda x: json.dumps(x, sort_keys=True).encode())
+fn("term_decode")(lambda b: json.loads(_to_bytes(b).decode()))
+
+# topic helpers ---------------------------------------------------------
+# exact membership, unlike contains_topic_match's wildcard matching
+fn("contains_topic")(lambda topics, t: str(t) in [str(x) for x in (topics or [])])
+
+
+@fn("contains_topic_match")
+def _contains_topic_match(filters, t):
+    return any(topiclib.match(str(t), str(f)) for f in (filters or []))
+
+
+@fn("find_topic_filter")
+def _find_topic_filter(filters, t):
+    for f in filters or []:
+        if topiclib.match(str(t), str(f)):
+            return f
+    return None

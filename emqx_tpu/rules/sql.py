@@ -288,6 +288,20 @@ class _Parser:
                 return Lit(None)
             if t.val == "case":
                 return self.parse_case()
+            nxt = self.peek()
+            if nxt is not None and nxt.kind == "op" and nxt.val == "(":
+                # keywords doubling as stdlib function names: mod(a,b),
+                # div(a,b) work as calls like in the reference's rulesql
+                self.next()
+                args: List[Any] = []
+                if not self.accept_op(")"):
+                    while True:
+                        args.append(self.parse_expr())
+                        if self.accept_op(")"):
+                            break
+                        if not self.accept_op(","):
+                            raise SqlError("expected , or ) in call")
+                return Call(t.val, args)
             raise SqlError(f"unexpected keyword {t.val!r}")
         if t.kind == "op" and t.val == "(":
             e = self.parse_expr()

@@ -1,0 +1,181 @@
+"""Rule-engine SQL stdlib — emqx_rule_funcs.erl parity coverage.
+
+Exercised both directly and through full SQL evaluation so the parser
+-> Call -> FUNCS path is what's proven, not just the raw functions.
+"""
+
+import math
+
+import pytest
+
+from emqx_tpu.rules.engine import RuleEngine
+from emqx_tpu.rules.funcs import FUNCS
+from emqx_tpu.rules.sql import parse_sql
+from emqx_tpu.rules.engine import run_select
+
+F = FUNCS
+
+
+def sel(sql, env):
+    return run_select(parse_sql(sql), env)
+
+
+# ----------------------------------------------------------------- math
+
+
+def test_trig_and_logs():
+    assert abs(F["sin"](math.pi / 2) - 1) < 1e-12
+    assert abs(F["atan"](1) - math.pi / 4) < 1e-12
+    assert abs(F["exp"](1) - math.e) < 1e-12
+    assert F["log2"](8) == 3
+    assert F["log10"]("100") == 2
+    assert F["fmod"](7.5, 2) == 1.5
+    assert F["mod"](7, 3) == 1
+
+
+def test_bit_ops():
+    assert F["bitand"](0b1100, 0b1010) == 0b1000
+    assert F["bitor"](0b1100, 0b1010) == 0b1110
+    assert F["bitxor"](0b1100, 0b1010) == 0b0110
+    assert F["bitnot"](0) == -1
+    assert F["bitsl"](1, 4) == 16
+    assert F["bitsr"](16, 4) == 1
+    assert F["bitsize"](b"ab") == 16
+
+
+def test_subbits_binary_decode():
+    # a 4-byte sensor frame: u8 type, u16be value, s8 delta
+    frame = bytes([0x01, 0x30, 0x39, 0xFE])
+    assert F["subbits"](frame, 1, 8) == 1
+    assert F["subbits"](frame, 9, 16) == 12345
+    assert F["subbits"](frame, 25, 8, "integer", "signed") == -2
+    # little endian + float
+    import struct
+
+    fl = struct.pack(">f", 2.5)
+    assert F["subbits"](fl, 1, 32, "float") == 2.5
+    assert F["subbits"](b"\x01\x00", 1, 16, "integer", "unsigned", "little") == 1
+    assert F["subbits"](b"\xab", 9, 8) is None  # out of range
+    assert F["get_subbits"] is F["subbits"]
+
+
+# ----------------------------------------------------------------- time
+
+
+def test_time_functions():
+    ts = F["rfc3339_to_unix_ts"]("2026-01-02T03:04:05Z")
+    assert F["unix_ts_to_rfc3339"](ts).startswith("2026-01-02T03:04:05")
+    ms = F["rfc3339_to_unix_ts"]("2026-01-02T03:04:05Z", "millisecond")
+    assert ms == ts * 1000
+    assert F["time_unit"](2_000_000, "microsecond", "second") == 2
+    assert F["now_rfc3339"]().endswith("+00:00")
+    assert F["now_timestamp"]("millisecond") > 1e12
+
+
+# -------------------------------------------------------------- strings
+
+
+def test_string_extras():
+    assert F["tokens"]("a b\nc", " \n") == ["a", "b", "c"]
+    assert F["tokens"]("a\r\nb", ",", "nocrlf") == ["ab"]
+    assert F["pad"]("7", 3, "leading", "0") == "007"
+    assert F["pad"]("x", 3) == "x  "
+    assert F["float2str"](3.14, 3) == "3.14"
+    assert F["str_utf8"](b"caf\xc3\xa9") == "café"
+    assert F["eq"]("a", "a") and not F["eq"](1, 2)
+    assert F["hash"]("md5", "x") == F["md5"]("x")
+
+
+# ----------------------------------------------------------- maps / kv
+
+
+def test_map_path_ops():
+    m = {"a": {"b": [{"c": 1}, {"c": 2}]}}
+    assert F["mget"]("a.b", m) == [{"c": 1}, {"c": 2}]
+    assert F["mget"]("a.b[2].c", m) == 2
+    assert F["mget"]("a.x", m, "dflt") == "dflt"
+    out = F["mput"]("a.y", 9, {"a": {"b": 1}})
+    assert out == {"a": {"b": 1, "y": 9}}
+    assert F["map_path"] is F["mget"]
+
+
+def test_kv_and_proc_dict():
+    F["kv_store_put"]("counter", 5)
+    assert F["kv_store_get"]("counter") == 5
+    F["kv_store_del"]("counter")
+    assert F["kv_store_get"]("counter", 0) == 0
+    F["proc_dict_put"]("t", 1)
+    assert F["proc_dict_get"]("t") == 1
+    from emqx_tpu.rules.funcs import reset_proc_dict
+
+    reset_proc_dict()
+    assert F["proc_dict_get"]("t") is None
+
+
+def test_term_roundtrip():
+    v = {"k": [1, 2, {"x": True}]}
+    assert F["term_decode"](F["term_encode"](v)) == v
+
+
+def test_topic_helpers():
+    # contains_topic = exact membership; *_match applies wildcards
+    assert F["contains_topic"](["q/a/b", "x"], "q/a/b")
+    assert not F["contains_topic"](["q/#"], "q/a/b")
+    assert F["contains_topic_match"](["s/+/t", "q/#"], "q/a/b")
+    assert not F["contains_topic_match"](["s/+/t"], "other")
+    assert F["find_topic_filter"](["a/#", "+/b"], "x/b") == "+/b"
+    assert F["find_topic_filter"](["a/#"], "x/b") is None
+
+
+# --------------------------------------------------------- through SQL
+
+
+def test_funcs_through_sql():
+    env = {
+        "event": "message.publish",
+        "topic": "sensor/7/raw",
+        "payload": bytes([0x01, 0x30, 0x39, 0xFE]),
+        "qos": 1,
+        "clientid": "dev7",
+    }
+    out = sel(
+        "SELECT subbits(payload, 9, 16) as value, "
+        "mod(qos + 9, 2) as parity, "
+        "upper(clientid) as who "
+        'FROM "sensor/+/raw" WHERE subbits(payload, 1, 8) = 1',
+        env,
+    )
+    assert out == {"value": 12345, "parity": 0, "who": "DEV7"}
+    # non-matching guard
+    env2 = dict(env, payload=bytes([0x02, 0, 0, 0]))
+    assert sel(
+        'SELECT topic FROM "sensor/+/raw" WHERE subbits(payload, 1, 8) = 1',
+        env2,
+    ) is None
+
+
+def test_event_alias_message_publish():
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.message import Message
+
+    b = Broker()
+    eng = RuleEngine(b)
+    outs = []
+    eng.create_rule(
+        "r1",
+        'SELECT topic, payload FROM "$events/message_publish"',
+        [lambda broker, selected, env: outs.append(selected)],
+    )
+    b.publish(Message(topic="any/topic", payload=b"e"))
+    assert outs and outs[0]["topic"] == "any/topic"
+
+
+def test_mput_preserves_lists_and_sprintf_braces():
+    m = {"a": [{"b": 1}, {"b": 2}]}
+    assert F["mput"]("a.2.b", 99, m) == {"a": [{"b": 1}, {"b": 99}]}
+    assert m == {"a": [{"b": 1}, {"b": 2}]}  # copy-on-write
+    assert F["mput"]("a.9.b", 1, m) == {"a": [{"b": 1}, {"b": 2}]}  # no-op
+    assert F["mput"]("", 1, {"x": 2}) == {"x": 2}
+    assert F["sprintf_s"]('{"value": "~s"}', "v1") == '{"value": "v1"}'
+    assert F["sprintf_s"]("~~s ~n~p", [1]) == "~s \n[1]"
+    assert F["div"](10, 3) == 3
