@@ -345,18 +345,42 @@ def main() -> None:
                     help="run all 5 configs, write BENCH_TABLE.md")
     ap.add_argument("--subs", type=int, default=None,
                     help="cap filter count for configs 3-5")
+    ap.add_argument("--emit-stats", default=None,
+                    help="write this config's full stats JSON to a file")
     ns = ap.parse_args()
 
-    init_device()  # probe the accelerator BEFORE minutes of population build
-
     if not ns.all:
+        init_device()  # probe the accelerator BEFORE the population build
         stats = run_config(ns.config, ns.subs)
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
         print(headline_json(ns.config, stats))
         return
 
+    # One fresh interpreter per config: measured empirically, running the
+    # configs sequentially in one process degrades the steady-state match
+    # latency of every config after the first by ~1000x (per-call device
+    # overhead appears once a second table generation exists) — isolating
+    # each run keeps every number a clean single-table measurement.
+    import subprocess
+    import sys
+    import tempfile
+
     rows = {}
     for n in sorted(CONFIGS):
-        rows[n] = run_config(n, ns.subs)
+        with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+            stats_path = tf.name
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--config", str(n), "--emit-stats", stats_path]
+        if ns.subs is not None:
+            cmd += ["--subs", str(ns.subs)]
+        r = subprocess.run(cmd, stdout=subprocess.PIPE, timeout=3600)
+        if r.returncode != 0:
+            raise SystemExit(f"config {n} failed (rc={r.returncode})")
+        with open(stats_path, "r", encoding="utf-8") as f:
+            rows[n] = json.load(f)
+        os.unlink(stats_path)
     with open("BENCH_TABLE.md", "w", encoding="utf-8") as f:
         f.write("# BASELINE.json workload table\n\n")
         f.write("| # | config | filters | cpu lookups/s | tpu lookups/s | "

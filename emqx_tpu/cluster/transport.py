@@ -310,8 +310,18 @@ class Transport:
         self.rpc_handlers: Dict[str, Callable] = {}
         self._server: Optional[asyncio.base_events.Server] = None
         self._inbound: set = set()  # live inbound writers, closed on stop
+        # inbound RPCs run on a bounded pool, keyed by peer so one node's
+        # requests execute in order (the gen_server serialization the
+        # reference gets for free) and a flood cannot spawn unbounded
+        # tasks (emqx_pool analog)
+        self._rpc_pool: Optional["WorkerPool"] = None
 
     async def start(self) -> None:
+        from ..utils.pool import WorkerPool
+
+        self._rpc_pool = WorkerPool(
+            size=4, queue_size=1000, name=f"rpc@{self.node}"
+        ).start()
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port
         )
@@ -327,6 +337,9 @@ class Transport:
                     pass
             await self._server.wait_closed()
             self._server = None
+        if self._rpc_pool is not None:
+            await self._rpc_pool.stop(drain=False)
+            self._rpc_pool = None
 
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -342,9 +355,12 @@ class Transport:
 
         async def run_rpc_bg(obj: dict) -> None:
             resp = await self._run_rpc(peer_name, obj)
-            async with wlock:
-                writer.write(pack_json(RPC_RESP, resp))
-                await writer.drain()
+            try:
+                async with wlock:
+                    writer.write(pack_json(RPC_RESP, resp))
+                    await writer.drain()
+            except (ConnectionError, RuntimeError):
+                pass  # peer gone before the response could be written
 
         try:
             # 1. open with a fresh challenge; the peer's cookie proof must
@@ -381,11 +397,18 @@ class Transport:
             while True:
                 ftype, body = await read_frame(reader)
                 if ftype == RPC_REQ:
-                    t = asyncio.get_running_loop().create_task(
-                        run_rpc_bg(json.loads(body))
-                    )
-                    rpc_tasks.add(t)
-                    t.add_done_callback(rpc_tasks.discard)
+                    obj = json.loads(body)
+                    pool = self._rpc_pool
+                    if pool is None or not pool.submit_to(
+                        peer_name, lambda o=obj: run_rpc_bg(o)
+                    ):
+                        # pool gone (stopping) or saturated: inline —
+                        # backpressure via this connection's read loop
+                        t = asyncio.get_running_loop().create_task(
+                            run_rpc_bg(obj)
+                        )
+                        rpc_tasks.add(t)
+                        t.add_done_callback(rpc_tasks.discard)
                     continue
                 async with wlock:
                     if ftype == PING:

@@ -1,14 +1,23 @@
 """MQTT-SN 1.2 gateway over UDP — `apps/emqx_gateway/src/mqttsn` analog.
 
 Wire format per the MQTT-SN 1.2 spec: 1-byte (or 3-byte escaped)
-length, message type, variable part.  Supported message set mirrors
-the reference gateway's core path: SEARCHGW/GWINFO, CONNECT/CONNACK,
-REGISTER/REGACK (both directions), PUBLISH/PUBACK (QoS 0/1),
-SUBSCRIBE/SUBACK, UNSUBSCRIBE/UNSUBACK, PINGREQ/PINGRESP, DISCONNECT.
-Topic-id registry per client; topic-id type 0 = registered, 1 =
-predefined, 2 = two-char short names.  Subscriptions/publishes flow
-through `GatewayContext`, so MQTT-SN sensors interoperate with MQTT
-and STOMP clients on the same broker.
+length, message type, variable part.  Feature set mirrors the reference
+gateway (`emqx_sn_gateway.erl`):
+
+* SEARCHGW/GWINFO + periodic ADVERTISE;
+* CONNECT with will setup (WILLTOPICREQ/WILLTOPIC/WILLMSGREQ/WILLMSG)
+  and later will updates (WILLTOPICUPD/WILLMSGUPD);
+* REGISTER/REGACK both directions; predefined and short topic ids;
+* PUBLISH QoS 0/1/2 in both directions (PUBREC/PUBREL/PUBCOMP), plus
+  QoS -1 publish-without-connect on predefined/short topics;
+* SUBSCRIBE/UNSUBSCRIBE, PINGREQ/PINGRESP;
+* sleeping clients: DISCONNECT(duration) parks the session, deliveries
+  buffer, PINGREQ(clientid) drains them ("awake" cycle per spec 6.14);
+* keepalive sweep: an expired client's will is published and its
+  session closed (the reference's asleep/keepalive timers).
+
+Subscriptions/publishes flow through `GatewayContext`, so MQTT-SN
+sensors interoperate with MQTT/STOMP/CoAP clients on the same broker.
 """
 
 from __future__ import annotations
@@ -16,7 +25,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
-from typing import Dict, Optional, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
 from ..broker.access_control import ClientInfo
 from ..broker.broker import Broker
@@ -25,14 +35,22 @@ from .core import GatewayContext
 log = logging.getLogger("emqx_tpu.gateway.mqttsn")
 
 # message types
+ADVERTISE = 0x00
 SEARCHGW = 0x01
 GWINFO = 0x02
 CONNECT = 0x04
 CONNACK = 0x05
+WILLTOPICREQ = 0x06
+WILLTOPIC = 0x07
+WILLMSGREQ = 0x08
+WILLMSG = 0x09
 REGISTER = 0x0A
 REGACK = 0x0B
 PUBLISH = 0x0C
 PUBACK = 0x0D
+PUBCOMP = 0x0E
+PUBREC = 0x0F
+PUBREL = 0x10
 SUBSCRIBE = 0x12
 SUBACK = 0x13
 UNSUBSCRIBE = 0x14
@@ -40,20 +58,28 @@ UNSUBACK = 0x15
 PINGREQ = 0x16
 PINGRESP = 0x17
 DISCONNECT = 0x18
+WILLTOPICUPD = 0x1A
+WILLTOPICRESP = 0x1B
+WILLMSGUPD = 0x1C
+WILLMSGRESP = 0x1D
 
 RC_ACCEPTED = 0x00
+RC_CONGESTION = 0x01
 RC_INVALID_TOPIC = 0x02
 RC_NOT_SUPPORTED = 0x03
 
 FLAG_DUP = 0x80
 FLAG_QOS_MASK = 0x60
 FLAG_RETAIN = 0x10
+FLAG_WILL = 0x08
 FLAG_CLEAN = 0x04
 FLAG_TOPIC_TYPE = 0x03
 
 TOPIC_NORMAL = 0  # registered topic id
 TOPIC_PREDEF = 1
 TOPIC_SHORT = 2
+
+QOS_NEG1 = 3  # 0b11 in the QoS field: publish-without-connection
 
 
 def mk(msg_type: int, body: bytes) -> bytes:
@@ -67,6 +93,8 @@ def parse(datagram: bytes) -> Tuple[int, bytes]:
     if not datagram:
         raise ValueError("empty datagram")
     if datagram[0] == 0x01:
+        if len(datagram) < 4:
+            raise ValueError("truncated escaped length")
         (n,) = struct.unpack_from("!H", datagram, 1)
         if len(datagram) < n or n < 4:
             raise ValueError("bad length")
@@ -77,9 +105,16 @@ def parse(datagram: bytes) -> Tuple[int, bytes]:
     return datagram[1], datagram[2:n]
 
 
+def qos_field(flags: int) -> int:
+    return (flags & FLAG_QOS_MASK) >> 5
+
+
 def qos_of(flags: int) -> int:
-    q = (flags & FLAG_QOS_MASK) >> 5
-    return 0 if q == 3 else q  # 0b11 = QoS -1 (publish-only) -> treat as 0
+    q = qos_field(flags)
+    return 0 if q == QOS_NEG1 else q
+
+
+ACTIVE, ASLEEP, AWAKE = "active", "asleep", "awake"
 
 
 class SnClient:
@@ -89,12 +124,30 @@ class SnClient:
         self.session = None
         self.clientinfo: Optional[ClientInfo] = None
         self.connected = False
+        self.state = ACTIVE
+        self.keepalive = 0.0  # CONNECT duration (seconds)
+        self.last_rx = time.monotonic()
         # topic registry, both directions
         self.topic_by_id: Dict[int, str] = {}
         self.id_by_topic: Dict[str, int] = {}
         self._next_topic_id = 1
         self._next_msg_id = 1
         self.gateway: Optional["MqttSnGateway"] = None
+        # will state
+        self.will_topic: Optional[str] = None
+        self.will_msg: bytes = b""
+        self.will_qos = 0
+        self.will_retain = False
+        self._pending_connect: Optional[tuple] = None  # (flags, duration)
+        # QoS2 inbound: msg_id -> (topic, payload, retain)
+        self.awaiting_rel: Dict[int, tuple] = {}
+        # QoS2 outbound: msg_id -> awaiting PUBREC; then PUBCOMP
+        self.wait_rec: Dict[int, object] = {}
+        # buffered deliveries while asleep
+        self.buffer: List[object] = []
+        # True while a reconnect reuses this object: the cm's takeover
+        # kick targets the "old connection", which IS this one — ignore it
+        self.reconnecting = False
 
     def reg_topic(self, topic: str) -> int:
         tid = self.id_by_topic.get(topic)
@@ -115,9 +168,18 @@ class SnClient:
         if self.gateway is None:
             return
         for _filt, msg in delivers:
-            self.gateway.deliver_publish(self, msg)
+            if self.state == ASLEEP:
+                # spec 6.14: messages for a sleeping client are buffered
+                # at the gateway until the next awake cycle
+                self.buffer.append(msg)
+                if len(self.buffer) > self.gateway.max_sleep_buffer:
+                    self.buffer.pop(0)
+            else:
+                self.gateway.deliver_publish(self, msg)
 
     def kick(self, rc: int = 0) -> None:
+        if self.reconnecting:
+            return  # takeover kick of our own previous incarnation
         if self.gateway is not None:
             self.gateway.send(self.addr, mk(DISCONNECT, b""))
             self.gateway.drop_client(self)
@@ -125,14 +187,21 @@ class SnClient:
 
 class MqttSnGateway(asyncio.DatagramProtocol):
     def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
-                 gateway_id: int = 1, predefined: Optional[Dict[int, str]] = None):
+                 gateway_id: int = 1, predefined: Optional[Dict[int, str]] = None,
+                 advertise_interval: float = 0.0, advertise_addr=None,
+                 max_sleep_buffer: int = 100, keepalive_factor: float = 1.5):
         self.ctx = GatewayContext(broker, "mqttsn")
         self.host = host
         self.port = port
         self.gateway_id = gateway_id
-        self.predefined = predefined or {}
+        self.predefined = dict(predefined or {})
+        self.advertise_interval = advertise_interval
+        self.advertise_addr = advertise_addr
+        self.max_sleep_buffer = max_sleep_buffer
+        self.keepalive_factor = keepalive_factor
         self.clients: Dict[tuple, SnClient] = {}
         self.transport: Optional[asyncio.DatagramTransport] = None
+        self._tasks: List[asyncio.Task] = []
 
     # ----------------------------------------------------------- lifecycle
 
@@ -142,9 +211,20 @@ class MqttSnGateway(asyncio.DatagramProtocol):
             lambda: self, local_addr=(self.host, self.port)
         )
         self.port = self.transport.get_extra_info("sockname")[1]
+        self._tasks.append(loop.create_task(self._keepalive_sweep()))
+        if self.advertise_interval > 0 and self.advertise_addr is not None:
+            self._tasks.append(loop.create_task(self._advertise_loop()))
         log.info("mqtt-sn gateway on %s:%s", self.host, self.port)
 
     async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
         for client in list(self.clients.values()):
             if client.connected:
                 self.ctx.close_session(client)
@@ -152,6 +232,50 @@ class MqttSnGateway(asyncio.DatagramProtocol):
         if self.transport is not None:
             self.transport.close()
             self.transport = None
+
+    async def _advertise_loop(self) -> None:
+        """Periodic ADVERTISE (gwid + next interval), spec 6.1."""
+        body = bytes([self.gateway_id]) + struct.pack(
+            "!H", max(1, int(self.advertise_interval))
+        )
+        while True:
+            self.send(self.advertise_addr, mk(ADVERTISE, body))
+            await asyncio.sleep(self.advertise_interval)
+
+    async def _keepalive_sweep(self) -> None:
+        """Expire silent clients (active: keepalive window; asleep: the
+        sleep duration rides the same field) and reap half-open will
+        handshakes so a spoofed-source CONNECT flood cannot grow
+        self.clients without bound."""
+        while True:
+            await asyncio.sleep(1.0)
+            now = time.monotonic()
+            for client in list(self.clients.values()):
+                if not client.connected:
+                    if (
+                        client._pending_connect is not None
+                        and now - client.last_rx > 15.0
+                    ):
+                        self.drop_client(client)
+                    continue
+                ka = client.keepalive
+                if ka and now - client.last_rx > ka * self.keepalive_factor:
+                    self._lost(client)
+
+    def _lost(self, client: SnClient) -> None:
+        """Keepalive/sleep expiry: fire the will, close the session."""
+        if client.will_topic and client.clientinfo is not None:
+            if self.ctx.authorize(
+                client.clientinfo, "publish", client.will_topic
+            ):
+                self.ctx.publish(
+                    client.clientinfo, client.will_topic, client.will_msg,
+                    qos=client.will_qos, retain=client.will_retain,
+                )
+        if client.connected:
+            self.ctx.close_session(client, normal=False)
+            client.connected = False
+        self.drop_client(client)
 
     def send(self, addr, datagram: bytes) -> None:
         if self.transport is not None:
@@ -167,11 +291,21 @@ class MqttSnGateway(asyncio.DatagramProtocol):
             msg_type, body = parse(data)
         except ValueError:
             return
+        client = self.clients.get(addr)
+        if client is not None:
+            client.last_rx = time.monotonic()
         handler = {
             SEARCHGW: self._searchgw,
             CONNECT: self._connect,
+            WILLTOPIC: self._willtopic,
+            WILLMSG: self._willmsg,
+            WILLTOPICUPD: self._willtopicupd,
+            WILLMSGUPD: self._willmsgupd,
             REGISTER: self._register,
             PUBLISH: self._publish,
+            PUBREL: self._pubrel,
+            PUBREC: self._pubrec,
+            PUBCOMP: lambda a, b: None,
             SUBSCRIBE: self._subscribe,
             UNSUBSCRIBE: self._unsubscribe,
             PINGREQ: self._pingreq,
@@ -188,22 +322,113 @@ class MqttSnGateway(asyncio.DatagramProtocol):
     def _searchgw(self, addr, body: bytes) -> None:
         self.send(addr, mk(GWINFO, bytes([self.gateway_id])))
 
+    # ------------------------------------------------------------- connect
+
+    def _find_by_clientid(self, clientid: str) -> Optional[SnClient]:
+        for c in self.clients.values():
+            if c.clientid == clientid:
+                return c
+        return None
+
+    def _rebind(self, client: SnClient, addr) -> None:
+        """A known device reappears from a new source address (NAT
+        rebind): move its state, never leave a stale entry for the
+        keepalive sweep to fire the will on."""
+        if client.addr != addr:
+            self.clients.pop(client.addr, None)
+            client.addr = addr
+            self.clients[addr] = client
+
     def _connect(self, addr, body: bytes) -> None:
         if len(body) < 4:
             return
-        flags, _proto, _duration = body[0], body[1], struct.unpack_from("!H", body, 2)[0]
+        flags, _proto = body[0], body[1]
+        (duration,) = struct.unpack_from("!H", body, 2)
         clientid = body[4:].decode("utf-8", "replace") or f"sn-{addr[0]}-{addr[1]}"
-        client = SnClient(addr, clientid)
-        client.gateway = self
+        existing = self._find_by_clientid(clientid)
+        if existing is not None:
+            # returning device (possibly a waking sleeper): keep its
+            # buffered deliveries, topic registry, and will state
+            self._rebind(existing, addr)
+            client = existing
+            client.reconnecting = True
+            client.last_rx = time.monotonic()
+        else:
+            client = SnClient(addr, clientid)
+            client.gateway = self
+        client.keepalive = float(duration)
         ci = ClientInfo(clientid=clientid, peerhost=addr[0], protocol="mqtt-sn")
         client.clientinfo = ci
         if not self.ctx.authenticate(ci):
             self.send(addr, mk(CONNACK, bytes([RC_NOT_SUPPORTED])))
             return
-        self.ctx.open_session(bool(flags & FLAG_CLEAN), ci, client)
-        client.connected = True
         self.clients[addr] = client
-        self.send(addr, mk(CONNACK, bytes([RC_ACCEPTED])))
+        if flags & FLAG_WILL:
+            # three-way will setup before CONNACK (spec 6.3)
+            client._pending_connect = (flags, duration)
+            self.send(addr, mk(WILLTOPICREQ, b""))
+            return
+        self._finish_connect(client, flags)
+
+    def _finish_connect(self, client: SnClient, flags: int) -> None:
+        try:
+            self.ctx.open_session(
+                bool(flags & FLAG_CLEAN), client.clientinfo, client
+            )
+        finally:
+            client.reconnecting = False
+        client.connected = True
+        client.state = ACTIVE
+        self.send(client.addr, mk(CONNACK, bytes([RC_ACCEPTED])))
+        # returning sleeper resumed by reconnect: drain anything buffered
+        self._drain_buffer(client)
+
+    def _willtopic(self, addr, body: bytes) -> None:
+        client = self.clients.get(addr)
+        if client is None or client._pending_connect is None:
+            return
+        if body:
+            wflags = body[0]
+            client.will_topic = body[1:].decode("utf-8", "replace")
+            client.will_qos = qos_of(wflags)
+            client.will_retain = bool(wflags & FLAG_RETAIN)
+            self.send(addr, mk(WILLMSGREQ, b""))
+        else:  # empty WILLTOPIC = no will after all
+            flags, _ = client._pending_connect
+            client._pending_connect = None
+            self._finish_connect(client, flags)
+
+    def _willmsg(self, addr, body: bytes) -> None:
+        client = self.clients.get(addr)
+        if client is None or client._pending_connect is None:
+            return
+        client.will_msg = bytes(body)
+        flags, _ = client._pending_connect
+        client._pending_connect = None
+        self._finish_connect(client, flags)
+
+    def _willtopicupd(self, addr, body: bytes) -> None:
+        client = self.clients.get(addr)
+        if client is None:
+            return
+        if body:
+            wflags = body[0]
+            client.will_topic = body[1:].decode("utf-8", "replace")
+            client.will_qos = qos_of(wflags)
+            client.will_retain = bool(wflags & FLAG_RETAIN)
+        else:
+            client.will_topic = None  # empty update deletes the will
+            client.will_msg = b""
+        self.send(addr, mk(WILLTOPICRESP, bytes([RC_ACCEPTED])))
+
+    def _willmsgupd(self, addr, body: bytes) -> None:
+        client = self.clients.get(addr)
+        if client is None:
+            return
+        client.will_msg = bytes(body)
+        self.send(addr, mk(WILLMSGRESP, bytes([RC_ACCEPTED])))
+
+    # ------------------------------------------------------------ registry
 
     def _register(self, addr, body: bytes) -> None:
         client = self.clients.get(addr)
@@ -214,14 +439,17 @@ class MqttSnGateway(asyncio.DatagramProtocol):
         tid = client.reg_topic(topic)
         self.send(addr, mk(REGACK, struct.pack("!HHB", tid, msg_id, RC_ACCEPTED)))
 
-    def _resolve_topic(self, client: SnClient, flags: int, tid_bytes: bytes) -> Optional[str]:
+    def _resolve_topic(self, client: Optional[SnClient], flags: int,
+                       tid_bytes: bytes) -> Optional[str]:
         ttype = flags & FLAG_TOPIC_TYPE
         if ttype == TOPIC_SHORT:
             return tid_bytes.decode("utf-8", "replace").rstrip("\x00")
         (tid,) = struct.unpack("!H", tid_bytes)
         if ttype == TOPIC_PREDEF:
             return self.predefined.get(tid)
-        return client.topic_by_id.get(tid)
+        return client.topic_by_id.get(tid) if client is not None else None
+
+    # ------------------------------------------------------------- publish
 
     def _publish(self, addr, body: bytes) -> None:
         client = self.clients.get(addr)
@@ -230,7 +458,23 @@ class MqttSnGateway(asyncio.DatagramProtocol):
         flags = body[0]
         msg_id = struct.unpack_from("!H", body, 3)[0]
         if client is None:
-            return  # QoS -1 anonymous publish unsupported without predefined
+            # QoS -1: publish without a connection, predefined/short
+            # topics only (spec 6.8; `emqx_sn_gateway` idle-state publish)
+            if qos_field(flags) == QOS_NEG1 and (
+                flags & FLAG_TOPIC_TYPE in (TOPIC_PREDEF, TOPIC_SHORT)
+            ):
+                topic = self._resolve_topic(None, flags, body[1:3])
+                if topic:
+                    anon = ClientInfo(
+                        clientid=f"sn-anon-{addr[0]}", peerhost=addr[0],
+                        protocol="mqtt-sn",
+                    )
+                    if self.ctx.authorize(anon, "publish", topic):
+                        self.ctx.publish(
+                            anon, topic, body[5:], qos=0,
+                            retain=bool(flags & FLAG_RETAIN),
+                        )
+            return
         topic = self._resolve_topic(client, flags, body[1:3])
         qos = qos_of(flags)
         if topic is None:
@@ -239,10 +483,40 @@ class MqttSnGateway(asyncio.DatagramProtocol):
         if not self.ctx.authorize(client.clientinfo, "publish", topic):
             self.send(addr, mk(PUBACK, body[1:3] + struct.pack("!HB", msg_id, RC_NOT_SUPPORTED)))
             return
+        if qos == 2:
+            # exactly-once inbound: park until PUBREL (spec 6.13)
+            client.awaiting_rel[msg_id] = (
+                topic, body[5:], bool(flags & FLAG_RETAIN)
+            )
+            self.send(addr, mk(PUBREC, struct.pack("!H", msg_id)))
+            return
         self.ctx.publish(client.clientinfo, topic, body[5:], qos=qos,
                          retain=bool(flags & FLAG_RETAIN))
-        if qos >= 1:
+        if qos == 1:
             self.send(addr, mk(PUBACK, body[1:3] + struct.pack("!HB", msg_id, RC_ACCEPTED)))
+
+    def _pubrel(self, addr, body: bytes) -> None:
+        client = self.clients.get(addr)
+        if client is None or len(body) < 2:
+            return
+        (msg_id,) = struct.unpack_from("!H", body)
+        parked = client.awaiting_rel.pop(msg_id, None)
+        if parked is not None:
+            topic, payload, retain = parked
+            self.ctx.publish(client.clientinfo, topic, payload, qos=2,
+                             retain=retain)
+        self.send(addr, mk(PUBCOMP, struct.pack("!H", msg_id)))
+
+    def _pubrec(self, addr, body: bytes) -> None:
+        client = self.clients.get(addr)
+        if client is None or len(body) < 2:
+            return
+        (msg_id,) = struct.unpack_from("!H", body)
+        if msg_id in client.wait_rec:
+            client.wait_rec.pop(msg_id, None)
+            self.send(addr, mk(PUBREL, struct.pack("!H", msg_id)))
+
+    # ----------------------------------------------------------- subscribe
 
     def _subscribe(self, addr, body: bytes) -> None:
         client = self.clients.get(addr)
@@ -281,13 +555,49 @@ class MqttSnGateway(asyncio.DatagramProtocol):
             self.ctx.unsubscribe(client, topic)
         self.send(addr, mk(UNSUBACK, struct.pack("!H", msg_id)))
 
+    # --------------------------------------------------------- sleep cycle
+
     def _pingreq(self, addr, body: bytes) -> None:
+        if body:
+            # PINGREQ with clientid = a sleeper's awake cycle (spec 6.14):
+            # drain buffered messages, then PINGRESP, back to sleep
+            clientid = body.decode("utf-8", "replace")
+            client = self.clients.get(addr)
+            if client is None or client.clientid != clientid:
+                client = self._find_by_clientid(clientid)
+            if client is not None and client.state == ASLEEP:
+                # the device may wake from a new source port (NAT rebind):
+                # deliveries must chase the PINGREQ's address
+                self._rebind(client, addr)
+                client.state = AWAKE
+                self._drain_buffer(client)
+                client.state = ASLEEP
+                client.last_rx = time.monotonic()
         self.send(addr, mk(PINGRESP, b""))
 
+    def _drain_buffer(self, client: SnClient) -> None:
+        buffered, client.buffer = client.buffer, []
+        for msg in buffered:
+            self.deliver_publish(client, msg)
+
     def _disconnect(self, addr, body: bytes) -> None:
-        client = self.clients.pop(addr, None)
-        if client is not None and client.connected:
+        client = self.clients.get(addr)
+        if client is None:
+            self.send(addr, mk(DISCONNECT, b""))
+            return
+        if len(body) >= 2:
+            # DISCONNECT(duration): enter sleep, keep the session parked
+            (duration,) = struct.unpack_from("!H", body)
+            client.state = ASLEEP
+            client.keepalive = float(duration)
+            client.last_rx = time.monotonic()
+            self.send(addr, mk(DISCONNECT, b""))
+            return
+        self.clients.pop(addr, None)
+        if client.connected:
+            client.will_topic = None  # clean disconnect cancels the will
             self.ctx.close_session(client)
+            client.connected = False
         self.send(addr, mk(DISCONNECT, b""))
 
     # ------------------------------------------------------------ outbound
@@ -307,11 +617,13 @@ class MqttSnGateway(asyncio.DatagramProtocol):
                 ))
             flags = TOPIC_NORMAL
             tid_bytes = struct.pack("!H", client.id_by_topic[topic])
-        qos = min(msg.qos, 1)
+        qos = min(msg.qos, 2)
         flags |= qos << 5
         if msg.retain:
             flags |= FLAG_RETAIN
         msg_id = client.next_msg_id() if qos else 0
+        if qos == 2:
+            client.wait_rec[msg_id] = msg
         self.send(client.addr, mk(
             PUBLISH,
             bytes([flags]) + tid_bytes + struct.pack("!H", msg_id) + msg.payload,

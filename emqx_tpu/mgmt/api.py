@@ -48,6 +48,7 @@ class ManagementApi:
         plugins=None,
         psk=None,
         telemetry=None,
+        monitor=None,
     ):
         self.broker = broker
         self.node = node
@@ -64,6 +65,7 @@ class ManagementApi:
         self.plugins = plugins
         self.psk = psk
         self.telemetry = telemetry
+        self.monitor = monitor
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -116,6 +118,12 @@ class ManagementApi:
         r("PUT", "/telemetry/status", self.telemetry_set, doc="Toggle telemetry")
         r("GET", "/telemetry/data", self.telemetry_data, doc="Telemetry report")
         r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+        r("GET", "/monitor", self.monitor_get,
+          doc="Dashboard time series (per-interval deltas)")
+        r("GET", "/monitor_current", self.monitor_current,
+          doc="Instantaneous levels + last-interval rates")
+        r("GET", "/dashboard", self.dashboard_page, public=True,
+          doc="Minimal status dashboard (HTML)")
 
 
     # -------------------------------------------------------------- plugins
@@ -529,6 +537,71 @@ class ManagementApi:
         if self.slow_subs is None:
             raise HttpError(404, "slow_subs disabled")
         return self.slow_subs.top()
+
+    # ------------------------------------------------------------ dashboard
+
+    def monitor_get(self, req: Request):
+        """Time series for dashboard charts (`emqx_dashboard_monitor_api`)."""
+        mon = self._need("monitor")
+        try:
+            n = int(req.query.get("latest", ["60"])[0])
+        except ValueError:
+            raise HttpError(400, "latest must be an integer")
+        return {"data": mon.latest(max(1, min(n, 1000)))}
+
+    def monitor_current(self, req: Request):
+        return self._need("monitor").current()
+
+    def dashboard_page(self, req: Request):
+        """Self-contained status page — the dashboard app proper, minus
+        the reference's full SPA: live gauges polled from the same REST
+        endpoints an operator's tooling uses."""
+        from .http import RawResponse
+
+        html = f"""<!doctype html>
+<html><head><meta charset="utf-8"><title>{self.node} — emqx_tpu</title>
+<style>
+ body {{ font: 14px system-ui, sans-serif; margin: 2rem; color: #222; }}
+ h1 {{ font-size: 1.2rem; }}
+ .cards {{ display: flex; gap: 1rem; flex-wrap: wrap; }}
+ .card {{ border: 1px solid #ddd; border-radius: 8px; padding: 1rem 1.4rem;
+          min-width: 10rem; }}
+ .card b {{ display: block; font-size: 1.6rem; }}
+ small {{ color: #777; }}
+</style></head>
+<body>
+<h1>emqx_tpu node <code>{self.node}</code></h1>
+<div class="cards">
+ <div class="card"><small>connections</small><b id="c">–</b></div>
+ <div class="card"><small>subscriptions</small><b id="s">–</b></div>
+ <div class="card"><small>topics</small><b id="t">–</b></div>
+ <div class="card"><small>msgs in/s</small><b id="in">–</b></div>
+ <div class="card"><small>msgs out/s</small><b id="out">–</b></div>
+ <div class="card"><small>uptime</small><b id="up">–</b></div>
+</div>
+<p><small>Full API: <a href="api-docs">OpenAPI document</a>.  Charts feed
+from <code>GET /api/v5/monitor</code> (auth required).</small></p>
+<script>
+async function tick() {{
+  try {{
+    const st = await (await fetch('status')).json();
+    document.getElementById('up').textContent = st.uptime + 's';
+    const tok = localStorage.getItem('emqx_tpu_token');
+    if (tok) {{
+      const cur = await (await fetch('monitor_current',
+        {{headers: {{Authorization: 'Bearer ' + tok}}}})).json();
+      document.getElementById('c').textContent = cur.connections;
+      document.getElementById('s').textContent = cur.subscriptions;
+      document.getElementById('t').textContent = cur.topics;
+      document.getElementById('in').textContent = cur.received_rate.toFixed(1);
+      document.getElementById('out').textContent = cur.sent_rate.toFixed(1);
+    }}
+  }} catch (e) {{}}
+}}
+tick(); setInterval(tick, 5000);
+</script>
+</body></html>"""
+        return RawResponse(html.encode())
 
     # ------------------------------------------------------------- api-docs
 

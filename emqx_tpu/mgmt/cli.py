@@ -50,7 +50,7 @@ class Cli:
         self.usage: Dict[str, str] = {}
         for name in ("status", "broker", "clients", "subscriptions", "topics",
                      "publish", "ban", "listeners", "metrics", "stats",
-                     "trace", "cluster", "plugins", "telemetry"):
+                     "trace", "cluster", "plugins", "telemetry", "node_dump"):
             self.register(name, getattr(self, "cmd_" + name),
                           getattr(getattr(self, "cmd_" + name), "__doc__", ""))
 
@@ -126,6 +126,33 @@ class Cli:
         except Exception as e:
             self.p(f"error: {e}")
             return 1
+
+    def cmd_node_dump(self, args):
+        """node_dump [file] — full state dump for support bundles
+        (bin/node_dump + emqx_node_dump analog)."""
+        import json as _json
+        import time as _time
+
+        dump = {"generated_at": int(_time.time())}
+        for key, path in (
+            ("status", "/status"), ("stats", "/stats"),
+            ("metrics", "/metrics"), ("clients", "/clients"),
+            ("subscriptions", "/subscriptions"), ("routes", "/topics"),
+            ("listeners", "/listeners"), ("alarms", "/alarms"),
+            ("banned", "/banned"), ("configs", "/configs"),
+            ("nodes", "/nodes"),
+        ):
+            try:
+                dump[key] = self._get(path)
+            except Exception as e:
+                dump[key] = {"error": str(e)}
+        text = _json.dumps(dump, indent=2, default=str)
+        if args:
+            with open(args[0], "w", encoding="utf-8") as f:
+                f.write(text)
+            self.p(f"wrote {args[0]} ({len(text)} bytes)")
+        else:
+            self.p(text)
 
     def cmd_status(self, args):
         """Show node status."""

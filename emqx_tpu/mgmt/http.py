@@ -87,6 +87,15 @@ STATUS_TEXT = {
 }
 
 
+class RawResponse:
+    """Non-JSON handler result: raw bytes with an explicit content type
+    (the dashboard HTML page, trace log downloads, ...)."""
+
+    def __init__(self, body: bytes, content_type: str = "text/html; charset=utf-8"):
+        self.body = body
+        self.content_type = content_type
+
+
 class HttpApi:
     def __init__(
         self,
@@ -168,15 +177,19 @@ class HttpApi:
                 pass
 
     async def _respond(self, writer, status: int, payload, keep: bool = True) -> None:
+        ctype = "application/json"
         if payload is None:
             body = b""
+        elif isinstance(payload, RawResponse):
+            body = payload.body
+            ctype = payload.content_type
         elif isinstance(payload, (bytes, bytearray)):
             body = bytes(payload)
         else:
             body = json.dumps(payload).encode()
         head = (
             f"HTTP/1.1 {status} {STATUS_TEXT.get(status, 'OK')}\r\n"
-            f"Content-Type: application/json\r\n"
+            f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(body)}\r\n"
             f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
         )

@@ -246,6 +246,15 @@ class NodeRuntime:
         for ldef in raw.get("listeners") or [{"type": "tcp", "port": 1883}]:
             self.listeners.append(self._build_listener(ldef))
 
+        # ---- gateways (1.10) ----------------------------------------------
+        from .gateway.core import GatewayRegistry
+
+        self.gateways = GatewayRegistry()
+        for gd in raw.get("gateways") or []:
+            self.gateways.register(
+                gd.get("name", gd["type"]), self._build_gateway(gd)
+            )
+
         # ---- management REST (1.12) ---------------------------------------
         self.tokens = TokenStore(
             ttl_s=self.conf.get("dashboard.token_expired_time")
@@ -268,6 +277,7 @@ class NodeRuntime:
             listeners=self.listeners,
             sys_heartbeat=self.sys_heartbeat,
             psk=self.psk,
+            monitor=self.monitor,
         )
         self.http = HttpApi(
             port=self.conf.get("dashboard.listen_port"),
@@ -321,7 +331,55 @@ class NodeRuntime:
                 psk_store=self.psk,
                 **common,
             )
+        if kind == "quic":
+            # the reference itself makes QUIC optional (BUILD_WITHOUT_QUIC,
+            # rebar.config.erl:55-56); no MsQuic binding exists in this
+            # environment, so the listener type is declared, not served
+            raise ConfigError(
+                "quic listener not available in this build (the reference "
+                "gates it behind BUILD_WITHOUT_QUIC as well); use tcp/ssl/"
+                "ws/wss"
+            )
         raise ConfigError(f"unknown listener type {kind!r}")
+
+    def _build_gateway(self, gd: Dict[str, Any]):
+        kind = gd["type"]
+        kw = dict(
+            host=gd.get("host", "127.0.0.1"), port=int(gd.get("port", 0))
+        )
+        if kind == "mqttsn":
+            from .gateway.mqttsn import MqttSnGateway
+
+            return MqttSnGateway(
+                self.broker,
+                gateway_id=int(gd.get("gateway_id", 1)),
+                predefined={
+                    int(k): v
+                    for k, v in (gd.get("predefined") or {}).items()
+                },
+                **kw,
+            )
+        if kind == "stomp":
+            from .gateway.stomp import StompGateway
+
+            return StompGateway(self.broker, **kw)
+        if kind == "coap":
+            from .gateway.coap import CoapGateway
+
+            return CoapGateway(self.broker, **kw)
+        if kind == "lwm2m":
+            from .gateway.lwm2m import Lwm2mGateway
+
+            return Lwm2mGateway(self.broker, **kw)
+        if kind == "exproto":
+            from .gateway.exproto import ExProtoGateway
+
+            return ExProtoGateway(
+                self.broker,
+                handler_port=int(gd.get("handler_port", 0)),
+                **kw,
+            )
+        raise ConfigError(f"unknown gateway type {kind!r}")
 
     def _build_authenticators(self, defs: List[Dict[str, Any]]) -> None:
         from . import drivers as drivers_mod
@@ -432,10 +490,18 @@ class NodeRuntime:
                             failed_action=d.get("failed_action", "deny"),
                         ),
                     )
+            if self.persistence is not None:
+                # reload parked sessions (+ their routes) before serving;
+                # expired entries are GC'd by restore()
+                n = self.persistence.restore()
+                if n:
+                    log.info("restored %d persistent sessions", n)
             if self.cluster is not None:
                 await self.cluster.start()
             for lst in self.listeners:
                 await lst.start()
+            for name in self.gateways.list():
+                await self.gateways.lookup(name).start()
             await self.http.start()
             self._stop_evt = asyncio.Event()
             self._tick_task = asyncio.create_task(self._ticker())
@@ -471,6 +537,11 @@ class NodeRuntime:
                 pass
             self._tick_task = None
         await self.http.stop()
+        for name in self.gateways.list():
+            try:
+                await self.gateways.lookup(name).stop()
+            except Exception:
+                log.exception("stopping gateway %s", name)
         for lst in reversed(self.listeners):
             try:
                 await lst.stop()

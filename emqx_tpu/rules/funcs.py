@@ -285,7 +285,6 @@ def _sprintf_s(fmt, *args):
     """Erlang io_lib-style ~s/~p/~w/~b formatting; literal text (incl.
     braces) passes through untouched, ~~ escapes a tilde."""
     out = []
-    it = iter(range(len(args)))
     ai = 0
     i = 0
     fmt = str(fmt)

@@ -1,0 +1,6 @@
+"""Shared runtime utilities (the emqx_pool / emqx_plugin_libs analogs)."""
+
+from .pool import WorkerPool
+from .metrics_helper import MetricsHelper
+
+__all__ = ["WorkerPool", "MetricsHelper"]

@@ -1,0 +1,58 @@
+"""Named metric groups for plugins/bridges — `emqx_plugin_libs_metrics`.
+
+The reference gives each resource/rule a counter group (matched,
+success, failed, rate) registered under a namespace; this is the same
+shape over the broker's Metrics store (or standalone), with the rate
+computed over a sliding window like `emqx_plugin_libs_metrics:get_rate`.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+
+class MetricsHelper:
+    def __init__(self, namespace: str, metrics=None, window_s: float = 5.0):
+        self.namespace = namespace
+        self.metrics = metrics  # optional broker Metrics for mirroring
+        self.window_s = window_s
+        self._counters: Dict[str, int] = {}
+        # name -> recent (ts, cumulative) samples for rate estimation
+        self._hist: Dict[str, Deque[Tuple[float, int]]] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        cur = self._counters.get(name, 0) + n
+        self._counters[name] = cur
+        h = self._hist.setdefault(name, deque(maxlen=64))
+        h.append((time.monotonic(), cur))
+        if self.metrics is not None:
+            self.metrics.inc(f"{self.namespace}.{name}", n)
+
+    def get(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def rate(self, name: str, now: Optional[float] = None) -> float:
+        """Events/sec over the sliding window."""
+        h = self._hist.get(name)
+        if not h:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        cutoff = now - self.window_s
+        base_ts, base_val = h[0]
+        for ts, val in h:
+            if ts >= cutoff:
+                base_ts, base_val = ts, val
+                break
+        last_ts, last_val = h[-1]
+        if last_ts <= base_ts:
+            return 0.0
+        return (last_val - base_val) / (last_ts - base_ts)
+
+    def snapshot(self) -> Dict[str, int]:
+        return dict(self._counters)
+
+    def reset(self) -> None:
+        self._counters.clear()
+        self._hist.clear()

@@ -1,0 +1,109 @@
+"""Generic async task worker pool — the `emqx_pool` analog.
+
+The reference runs a gproc pool of gen_servers and hash-dispatches work
+(`emqx_pool:async_submit`, router/broker pools pick workers by
+phash(topic)).  The asyncio equivalent: N worker tasks each draining a
+bounded queue; `submit(fn)` round-robins, `submit_to(key, fn)` pins a
+key to a worker so per-key ordering holds (the property the reference's
+topic-hashed pools provide for route ops).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Awaitable, Callable, List, Optional
+
+log = logging.getLogger("emqx_tpu.pool")
+
+
+class WorkerPool:
+    def __init__(self, size: int = 4, queue_size: int = 10_000,
+                 name: str = "pool"):
+        assert size >= 1
+        self.size = size
+        self.name = name
+        self._queues: List[asyncio.Queue] = [
+            asyncio.Queue(queue_size) for _ in range(size)
+        ]
+        self._tasks: List[asyncio.Task] = []
+        self._rr = 0
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.dropped = 0
+
+    def start(self) -> "WorkerPool":
+        if not self._tasks:
+            loop = asyncio.get_running_loop()
+            self._tasks = [
+                loop.create_task(self._worker(q)) for q in self._queues
+            ]
+        return self
+
+    async def stop(self, drain: bool = True) -> None:
+        if drain:
+            await self.join()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+
+    async def _worker(self, q: asyncio.Queue) -> None:
+        while True:
+            fn, fut = await q.get()
+            try:
+                r = fn()
+                if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
+                    r = await r
+                self.completed += 1
+                if fut is not None and not fut.done():
+                    fut.set_result(r)
+            except Exception as e:
+                self.failed += 1
+                if fut is not None and not fut.done():
+                    fut.set_exception(e)
+                else:
+                    log.exception("%s task failed", self.name)
+            finally:
+                q.task_done()
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, fn: Callable[[], Any]) -> bool:
+        """Fire-and-forget on the next worker (async_submit)."""
+        self._rr = (self._rr + 1) % self.size
+        return self._enqueue(self._rr, fn, None)
+
+    def submit_to(self, key: Any, fn: Callable[[], Any]) -> bool:
+        """Fire-and-forget pinned to hash(key)'s worker: all work for a
+        key runs on one worker in submission order."""
+        return self._enqueue(hash(key) % self.size, fn, None)
+
+    def call(self, fn: Callable[[], Any]) -> "asyncio.Future":
+        """Submit and get a future for the result (sync_submit analog)."""
+        fut = asyncio.get_running_loop().create_future()
+        self._rr = (self._rr + 1) % self.size
+        if not self._enqueue(self._rr, fn, fut):
+            fut.set_exception(RuntimeError(f"{self.name} queue full"))
+        return fut
+
+    def _enqueue(self, i: int, fn, fut) -> bool:
+        try:
+            self._queues[i].put_nowait((fn, fut))
+        except asyncio.QueueFull:
+            self.dropped += 1
+            return False
+        self.submitted += 1
+        return True
+
+    async def join(self) -> None:
+        await asyncio.gather(*(q.join() for q in self._queues))
+
+    @property
+    def backlog(self) -> int:
+        return sum(q.qsize() for q in self._queues)

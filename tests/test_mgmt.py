@@ -227,3 +227,65 @@ def test_cli_remote(run, tmp_path):
         await lst.stop()
 
     run(main())
+
+
+def test_monitor_endpoints_and_dashboard_page(run):
+    async def main():
+        from emqx_tpu.observe.monitor import MonitorSampler
+
+        b = Broker()
+        lst = Listener(b, port=0)
+        await lst.start()
+        tokens = TokenStore()
+        tokens.add_admin("admin", "public123")
+        mon = MonitorSampler(b, interval=1.0)
+        mon.sample_now()
+        api = ManagementApi(b, node="n0", tokens=tokens, monitor=mon)
+        srv = HttpApi(port=0, auth=api.auth_check)
+        api.install(srv)
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.port}/api/v5"
+
+        st, body = await asyncio.to_thread(
+            http, "POST", base + "/login",
+            {"username": "admin", "password": "public123"})
+        tok = body["token"]
+        st, cur = await asyncio.to_thread(
+            http, "GET", base + "/monitor_current", None, tok)
+        assert st == 200 and "connections" in cur
+        st, series = await asyncio.to_thread(
+            http, "GET", base + "/monitor?latest=10", None, tok)
+        assert st == 200 and len(series["data"]) == 1
+        assert "time_stamp" in series["data"][0]
+
+        # HTML dashboard is public and text/html
+        import urllib.request
+
+        def fetch_page():
+            with urllib.request.urlopen(base + "/dashboard", timeout=5) as r:
+                return r.status, r.headers.get("Content-Type"), r.read()
+
+        stt, ctype, page = await asyncio.to_thread(fetch_page)
+        assert stt == 200 and ctype.startswith("text/html")
+        assert b"emqx_tpu node" in page
+        # unauthenticated monitor stays locked
+        st, _ = await asyncio.to_thread(http, "GET", base + "/monitor")
+        assert st == 401
+        await srv.stop()
+        await lst.stop()
+
+    run(main())
+
+
+def test_cli_node_dump(tmp_path):
+    b = Broker()
+    api = ManagementApi(b, node="n0", stats=Stats(b), banned=Banned(),
+                        config=Config())
+    out = io.StringIO()
+    cli = Cli(api=api, out=out)
+    path = str(tmp_path / "dump.json")
+    assert cli.run(["node_dump", path]) == 0
+    dump = json.load(open(path))
+    assert dump["status"]["status"] == "running"
+    assert "metrics" in dump and "configs" in dump
+    assert "listeners" in dump

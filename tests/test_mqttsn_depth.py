@@ -1,0 +1,349 @@
+"""MQTT-SN depth: wills, QoS2, sleeping clients, QoS -1, will updates.
+
+Reference behaviors from `emqx_sn_gateway.erl` (spec sections noted).
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.gateway import mqttsn as sn
+from emqx_tpu.gateway.mqttsn import MqttSnGateway
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+class SnTestClient(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+
+    def datagram_received(self, data, addr):
+        self.inbox.put_nowait(sn.parse(data))
+
+    async def start(self, port):
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, remote_addr=("127.0.0.1", port))
+        return self
+
+    def send(self, msg_type, body):
+        self.transport.sendto(sn.mk(msg_type, body))
+
+    async def recv(self, want=None):
+        while True:
+            t, body = await asyncio.wait_for(self.inbox.get(), 5)
+            if want is None or t == want:
+                return t, body
+
+    def close(self):
+        self.transport.close()
+
+
+async def connect(gw_port, clientid, flags=sn.FLAG_CLEAN, duration=60):
+    c = await SnTestClient().start(gw_port)
+    c.send(sn.CONNECT, bytes([flags, 0x01]) + struct.pack("!H", duration)
+           + clientid.encode())
+    return c
+
+
+class BrokerSub:
+    """Plain broker-side subscriber to observe gateway publishes."""
+
+    def __init__(self, broker, filt):
+        self.got = []
+        from emqx_tpu.broker.session import Session
+
+        self.clientid = "obs"
+        self.session = Session(clientid="obs")
+        self.session.subscriptions[filt] = SubOpts(qos=1)
+        broker.cm.channels["obs"] = self
+        broker.subscribe("obs", filt, SubOpts(qos=1))
+
+    def deliver(self, delivers):
+        self.got.extend(m for _f, m in delivers)
+
+    def kick(self, rc=0):
+        pass
+
+
+def test_will_setup_and_fire_on_keepalive_loss(run):
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0, keepalive_factor=0.5)
+        await gw.start()
+        obs = BrokerSub(b, "wills/#")
+
+        c = await connect(gw.port, "dev-w", flags=sn.FLAG_CLEAN | sn.FLAG_WILL,
+                          duration=1)
+        t, _ = await c.recv(sn.WILLTOPICREQ)
+        c.send(sn.WILLTOPIC, bytes([0x20]) + b"wills/dev-w")  # qos1 will
+        await c.recv(sn.WILLMSGREQ)
+        c.send(sn.WILLMSG, b"lost!")
+        t, body = await c.recv(sn.CONNACK)
+        assert body[0] == sn.RC_ACCEPTED
+
+        # stop talking: keepalive (1s * 0.5 factor) expires, will fires
+        for _ in range(100):
+            if obs.got:
+                break
+            await asyncio.sleep(0.05)
+        assert obs.got and obs.got[0].payload == b"lost!"
+        assert obs.got[0].topic == "wills/dev-w"
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_clean_disconnect_cancels_will(run):
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0, keepalive_factor=0.5)
+        await gw.start()
+        obs = BrokerSub(b, "wills/#")
+        c = await connect(gw.port, "dev-c", flags=sn.FLAG_CLEAN | sn.FLAG_WILL,
+                          duration=1)
+        await c.recv(sn.WILLTOPICREQ)
+        c.send(sn.WILLTOPIC, bytes([0]) + b"wills/dev-c")
+        await c.recv(sn.WILLMSGREQ)
+        c.send(sn.WILLMSG, b"nope")
+        await c.recv(sn.CONNACK)
+        c.send(sn.DISCONNECT, b"")
+        await c.recv(sn.DISCONNECT)
+        await asyncio.sleep(1.2)
+        assert obs.got == []
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_will_update_messages(run):
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0, keepalive_factor=0.5)
+        await gw.start()
+        obs = BrokerSub(b, "wills/#")
+        c = await connect(gw.port, "dev-u", flags=sn.FLAG_CLEAN | sn.FLAG_WILL,
+                          duration=1)
+        await c.recv(sn.WILLTOPICREQ)
+        c.send(sn.WILLTOPIC, bytes([0]) + b"wills/orig")
+        await c.recv(sn.WILLMSGREQ)
+        c.send(sn.WILLMSG, b"old")
+        await c.recv(sn.CONNACK)
+        # update topic + message post-connect (spec 6.4)
+        c.send(sn.WILLTOPICUPD, bytes([0]) + b"wills/updated")
+        t, body = await c.recv(sn.WILLTOPICRESP)
+        assert body[0] == sn.RC_ACCEPTED
+        c.send(sn.WILLMSGUPD, b"new-will")
+        await c.recv(sn.WILLMSGRESP)
+        for _ in range(100):
+            if obs.got:
+                break
+            await asyncio.sleep(0.05)
+        assert obs.got[0].topic == "wills/updated"
+        assert obs.got[0].payload == b"new-will"
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_qos2_inbound_exactly_once(run):
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0)
+        await gw.start()
+        obs = BrokerSub(b, "q2/#")
+        c = await connect(gw.port, "dev-q2")
+        await c.recv(sn.CONNACK)
+        c.send(sn.REGISTER, struct.pack("!HH", 0, 1) + b"q2/t")
+        t, body = await c.recv(sn.REGACK)
+        tid = struct.unpack_from("!H", body)[0]
+        # QoS2 publish: PUBLISH -> PUBREC -> PUBREL -> PUBCOMP
+        c.send(sn.PUBLISH, bytes([0x40]) + struct.pack("!HH", tid, 7) + b"exactly")
+        t, body = await c.recv(sn.PUBREC)
+        assert struct.unpack("!H", body)[0] == 7
+        assert obs.got == []  # not published until PUBREL
+        c.send(sn.PUBREL, struct.pack("!H", 7))
+        t, body = await c.recv(sn.PUBCOMP)
+        await asyncio.sleep(0.05)
+        assert len(obs.got) == 1 and obs.got[0].payload == b"exactly"
+        # duplicate PUBREL: PUBCOMP again, no second publish
+        c.send(sn.PUBREL, struct.pack("!H", 7))
+        await c.recv(sn.PUBCOMP)
+        await asyncio.sleep(0.05)
+        assert len(obs.got) == 1
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_qos2_outbound_handshake(run):
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0)
+        await gw.start()
+        c = await connect(gw.port, "dev-out2")
+        await c.recv(sn.CONNACK)
+        c.send(sn.SUBSCRIBE, bytes([0x40]) + struct.pack("!H", 1) + b"down/q2")
+        await c.recv(sn.SUBACK)
+        b.publish(Message(topic="down/q2", payload=b"2u", qos=2))
+        t, body = await c.recv(sn.PUBLISH)
+        assert (body[0] & sn.FLAG_QOS_MASK) >> 5 == 2
+        (mid,) = struct.unpack_from("!H", body, 3)
+        c.send(sn.PUBREC, struct.pack("!H", mid))
+        t, body = await c.recv(sn.PUBREL)
+        assert struct.unpack("!H", body)[0] == mid
+        c.send(sn.PUBCOMP, struct.pack("!H", mid))
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_sleeping_client_buffer_and_awake(run):
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0)
+        await gw.start()
+        c = await connect(gw.port, "sleepy")
+        await c.recv(sn.CONNACK)
+        c.send(sn.SUBSCRIBE, bytes([0x20]) + struct.pack("!H", 1) + b"s/t")
+        await c.recv(sn.SUBACK)
+        # go to sleep (spec 6.14)
+        c.send(sn.DISCONNECT, struct.pack("!H", 30))
+        await c.recv(sn.DISCONNECT)
+        b.publish(Message(topic="s/t", payload=b"while-asleep-1", qos=1))
+        b.publish(Message(topic="s/t", payload=b"while-asleep-2", qos=1))
+        await asyncio.sleep(0.1)
+        assert c.inbox.empty()  # nothing delivered while sleeping
+        # awake cycle: PINGREQ with clientid drains the buffer
+        c.send(sn.PINGREQ, b"sleepy")
+        t1, b1 = await c.recv(sn.PUBLISH)
+        t2, b2 = await c.recv(sn.PUBLISH)
+        assert {b1[5:], b2[5:]} == {b"while-asleep-1", b"while-asleep-2"}
+        await c.recv(sn.PINGRESP)
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_qos_neg1_publish_without_connect(run):
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0, predefined={5: "pre/t"})
+        await gw.start()
+        obs = BrokerSub(b, "pre/#")
+        c = await SnTestClient().start(gw.port)
+        # no CONNECT at all; QoS -1 (0b11) + predefined topic id 5
+        flags = (sn.QOS_NEG1 << 5) | sn.TOPIC_PREDEF
+        c.send(sn.PUBLISH, bytes([flags]) + struct.pack("!HH", 5, 0) + b"fire-and-forget")
+        await asyncio.sleep(0.1)
+        assert obs.got and obs.got[0].payload == b"fire-and-forget"
+        # normal topic type without connect stays rejected
+        c.send(sn.PUBLISH, bytes([sn.QOS_NEG1 << 5]) + struct.pack("!HH", 1, 0) + b"x")
+        await asyncio.sleep(0.1)
+        assert len(obs.got) == 1
+        c.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_advertise_loop(run):
+    async def main():
+        b = Broker()
+        listener = await SnTestClient().start(1)  # placeholder; rebound below
+        listener.close()
+        recv = SnTestClient()
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            lambda: recv, local_addr=("127.0.0.1", 0))
+        addr = transport.get_extra_info("sockname")
+        gw = MqttSnGateway(b, port=0, gateway_id=9,
+                           advertise_interval=0.1, advertise_addr=addr)
+        await gw.start()
+        t, body = await recv.recv(sn.ADVERTISE)
+        assert body[0] == 9
+        transport.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_sleeper_reconnect_keeps_buffer_and_no_spurious_will(run):
+    """Waking by reconnect (from a NEW source port) keeps buffered
+    messages and never leaves a stale entry for the will sweep."""
+
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0, keepalive_factor=0.5)
+        await gw.start()
+        obs = BrokerSub(b, "wills/#")
+
+        c = await connect(gw.port, "roamer",
+                          flags=sn.FLAG_CLEAN | sn.FLAG_WILL, duration=30)
+        await c.recv(sn.WILLTOPICREQ)
+        c.send(sn.WILLTOPIC, bytes([0]) + b"wills/roamer")
+        await c.recv(sn.WILLMSGREQ)
+        c.send(sn.WILLMSG, b"roamer-died")
+        await c.recv(sn.CONNACK)
+        c.send(sn.SUBSCRIBE, bytes([0x20]) + struct.pack("!H", 1) + b"r/t")
+        await c.recv(sn.SUBACK)
+        c.send(sn.DISCONNECT, struct.pack("!H", 60))
+        await c.recv(sn.DISCONNECT)
+        b.publish(Message(topic="r/t", payload=b"parked", qos=1))
+        await asyncio.sleep(0.05)
+        c.close()
+
+        # reconnect from a different source port
+        c2 = await connect(gw.port, "roamer", duration=1)
+        await c2.recv(sn.CONNACK)
+        t, body = await c2.recv(sn.PUBLISH)
+        assert body[5:] == b"parked"  # buffer survived the reconnect
+        assert len(gw.clients) == 1  # no stale entry from the old port
+        c2.send(sn.DISCONNECT, b"")  # clean: cancels the will
+        await c2.recv(sn.DISCONNECT)
+        await asyncio.sleep(1.2)
+        assert obs.got == []  # the sweep never fired a spurious will
+        c2.close()
+        await gw.stop()
+
+    run(main())
+
+
+def test_half_open_will_handshake_reaped():
+    """Pending-connect entries can't accumulate unboundedly."""
+    import asyncio as aio
+
+    async def main():
+        b = Broker()
+        gw = MqttSnGateway(b, port=0)
+        await gw.start()
+        # simulate an abandoned will handshake with an old timestamp
+        import time as _t
+
+        from emqx_tpu.gateway.mqttsn import SnClient
+
+        ghost = SnClient(("10.9.9.9", 1), "ghost")
+        ghost.gateway = gw
+        ghost._pending_connect = (sn.FLAG_WILL, 60)
+        ghost.last_rx = _t.monotonic() - 60
+        gw.clients[ghost.addr] = ghost
+        await aio.sleep(1.3)  # one sweep
+        assert ghost.addr not in gw.clients
+        await gw.stop()
+
+    aio.new_event_loop().run_until_complete(main())

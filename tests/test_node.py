@@ -272,3 +272,101 @@ def test_partial_start_failure_leaks_nothing(run, tmp_path):
         await hog.wait_closed()
 
     run(main())
+
+
+def test_persistent_sessions_survive_node_restart(run, tmp_path):
+    """Disc-backed sessions (+ queued messages) restore across a full
+    node restart; expired ones are GC'd at boot."""
+
+    async def main():
+        conf = {
+            "listeners": [{"type": "tcp", "host": "127.0.0.1", "port": 0}],
+            "dashboard": {"listen_port": 0},
+            "node": {"data_dir": str(tmp_path)},
+            "persistent_session_store": {"enable": True, "on_disc": True},
+        }
+        node = NodeRuntime(conf)
+        await node.start()
+        port = node.listeners[0].port
+
+        c = MqttClient(clientid="pers-1", clean_start=False,
+                       properties={17: 300})  # session-expiry 300s
+        await c.connect(port=port)
+        await c.subscribe("keep/#", qos=1)
+        await c.close()  # park the session
+        await asyncio.sleep(0.1)
+        # queue a message for the parked session, then flush to disc
+        node.broker.publish(
+            __import__("emqx_tpu.broker.message", fromlist=["Message"]).Message(
+                topic="keep/x", payload=b"offline-msg", qos=1)
+        )
+        node.persistence.tick()
+        await node.stop()
+
+        node2 = NodeRuntime(conf)
+        await node2.start()
+        assert "pers-1" in node2.broker.cm.pending
+        c2 = MqttClient(clientid="pers-1", clean_start=False)
+        ack = await c2.connect(port=node2.listeners[0].port)
+        assert ack.session_present
+        m = await asyncio.wait_for(c2.recv(), 5)
+        assert m.payload == b"offline-msg"
+        await c2.disconnect()
+        await node2.stop()
+
+    run(main())
+
+
+def test_gateways_from_config(run, tmp_path):
+    """STOMP + MQTT-SN gateways boot with the node and interop with MQTT."""
+
+    async def main():
+        import struct
+
+        from emqx_tpu.gateway import mqttsn as sn
+
+        conf = {
+            "listeners": [{"type": "tcp", "host": "127.0.0.1", "port": 0}],
+            "dashboard": {"listen_port": 0},
+            "node": {"data_dir": str(tmp_path)},
+            "gateways": [
+                {"type": "mqttsn", "port": 0, "predefined": {"7": "pre/t"}},
+                {"type": "stomp", "port": 0},
+            ],
+        }
+        node = NodeRuntime(conf)
+        await node.start()
+        snp = node.gateways.lookup("mqttsn").port
+        assert snp != 0 and node.gateways.lookup("stomp").port != 0
+
+        c = MqttClient(clientid="gw-obs")
+        await c.connect(port=node.listeners[0].port)
+        await c.subscribe("sn/#", qos=1)
+
+        class Udp(asyncio.DatagramProtocol):
+            def __init__(self):
+                self.inbox = asyncio.Queue()
+
+            def datagram_received(self, data, addr):
+                self.inbox.put_nowait(sn.parse(data))
+
+        loop = asyncio.get_running_loop()
+        udp = Udp()
+        tr, _ = await loop.create_datagram_endpoint(
+            lambda: udp, remote_addr=("127.0.0.1", snp))
+        tr.sendto(sn.mk(sn.CONNECT, bytes([sn.FLAG_CLEAN, 1])
+                        + struct.pack("!H", 60) + b"sn-dev"))
+        t, body = await asyncio.wait_for(udp.inbox.get(), 5)
+        assert t == sn.CONNACK and body[0] == sn.RC_ACCEPTED
+        tr.sendto(sn.mk(sn.REGISTER, struct.pack("!HH", 0, 1) + b"sn/data"))
+        t, body = await asyncio.wait_for(udp.inbox.get(), 5)
+        tid = struct.unpack_from("!H", body)[0]
+        tr.sendto(sn.mk(sn.PUBLISH,
+                        bytes([0x20]) + struct.pack("!HH", tid, 2) + b"from-sn"))
+        m = await asyncio.wait_for(c.recv(), 5)
+        assert (m.topic, m.payload) == ("sn/data", b"from-sn")
+        tr.close()
+        await c.disconnect()
+        await node.stop()
+
+    run(main())

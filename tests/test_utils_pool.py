@@ -1,0 +1,103 @@
+"""WorkerPool (emqx_pool analog) + MetricsHelper (plugin_libs metrics)."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.utils import MetricsHelper, WorkerPool
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+def test_pool_submit_and_call(run):
+    async def main():
+        pool = WorkerPool(size=3).start()
+        done = []
+        for i in range(20):
+            assert pool.submit(lambda i=i: done.append(i))
+        out = await pool.call(lambda: 40 + 2)
+        assert out == 42
+        await pool.join()
+        assert sorted(done) == list(range(20))
+        assert pool.completed == 21 and pool.failed == 0
+        await pool.stop()
+
+    run(main())
+
+
+def test_pool_keyed_ordering(run):
+    """submit_to pins a key to one worker: per-key FIFO holds even with
+    async tasks of varying duration."""
+
+    async def main():
+        pool = WorkerPool(size=4).start()
+        seen = {}
+
+        async def work(key, i):
+            await asyncio.sleep(0.001 * ((i * 7) % 3))
+            seen.setdefault(key, []).append(i)
+
+        for i in range(30):
+            key = f"k{i % 3}"
+            pool.submit_to(key, lambda k=key, i=i: work(k, i))
+        await pool.join()
+        for key, order in seen.items():
+            assert order == sorted(order), (key, order)
+        await pool.stop()
+
+    run(main())
+
+
+def test_pool_error_isolation_and_backpressure(run):
+    async def main():
+        pool = WorkerPool(size=1, queue_size=2).start()
+
+        def boom():
+            raise ValueError("x")
+
+        fut = pool.call(boom)
+        with pytest.raises(ValueError):
+            await fut
+        assert pool.failed == 1
+        # stuffing beyond queue_size drops, doesn't block
+        blocker = asyncio.Event()
+
+        async def wait():
+            await blocker.wait()
+
+        pool.submit(wait)
+        ok = [pool.submit(lambda: None) for _ in range(5)]
+        assert not all(ok) and pool.dropped >= 1
+        blocker.set()
+        await pool.join()
+        await pool.stop()
+
+    run(main())
+
+
+def test_metrics_helper_counts_and_rate():
+    import time
+
+    m = MetricsHelper("bridge.http", window_s=10.0)
+    for _ in range(5):
+        m.inc("success")
+    m.inc("failed", 2)
+    assert m.get("success") == 5 and m.get("failed") == 2
+    assert m.snapshot() == {"success": 5, "failed": 2}
+    assert m.rate("success") >= 0.0
+    m.reset()
+    assert m.get("success") == 0
+
+
+def test_metrics_helper_mirrors_broker_metrics():
+    from emqx_tpu.broker.metrics import Metrics
+
+    base = Metrics()
+    m = MetricsHelper("rule.r1", metrics=base)
+    m.inc("matched", 3)
+    assert base.get("rule.r1.matched") == 3
