@@ -263,13 +263,13 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     for i in range(ITERS):
         if churn_frac and churn_pool:
             # config 5: subscribe/unsubscribe between ticks, then resync
+            # (batched through the native churn pass, one delta scatter)
             k = max(1, int(len(filters) * churn_frac / ITERS))
+            adds, removes = [], []
             for j in range(k):
                 f = churn_pool[(i * k + j) % len(churn_pool)]
-                if eng.fid_of(f) is None:
-                    eng.add_filter(f)
-                else:
-                    eng.remove_filter(f)
+                (removes if eng.fid_of(f) is not None else adds).append(f)
+            eng.apply_churn(adds, removes)
             churn_events += k
             tables = eng.sync_device()
         b0 = time.time()

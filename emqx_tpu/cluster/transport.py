@@ -402,13 +402,10 @@ class Transport:
                     if pool is None or not pool.submit_to(
                         peer_name, lambda o=obj: run_rpc_bg(o)
                     ):
-                        # pool gone (stopping) or saturated: inline —
-                        # backpressure via this connection's read loop
-                        t = asyncio.get_running_loop().create_task(
-                            run_rpc_bg(obj)
-                        )
-                        rpc_tasks.add(t)
-                        t.add_done_callback(rpc_tasks.discard)
+                        # pool saturated (or stopping): run inline so the
+                        # read loop stalls — real backpressure on the
+                        # flooding peer instead of unbounded task growth
+                        await run_rpc_bg(obj)
                     continue
                 async with wlock:
                     if ftype == PING:

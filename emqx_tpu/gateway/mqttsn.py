@@ -351,7 +351,6 @@ class MqttSnGateway(asyncio.DatagramProtocol):
             # buffered deliveries, topic registry, and will state
             self._rebind(existing, addr)
             client = existing
-            client.reconnecting = True
             client.last_rx = time.monotonic()
         else:
             client = SnClient(addr, clientid)
@@ -371,6 +370,10 @@ class MqttSnGateway(asyncio.DatagramProtocol):
         self._finish_connect(client, flags)
 
     def _finish_connect(self, client: SnClient, flags: int) -> None:
+        # the takeover kick during open_session targets this same object
+        # when the device is reconnecting; scoping the flag here (not in
+        # _connect) guarantees it can never stick on an aborted handshake
+        client.reconnecting = True
         try:
             self.ctx.open_session(
                 bool(flags & FLAG_CLEAN), client.clientinfo, client

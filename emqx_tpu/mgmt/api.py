@@ -49,6 +49,7 @@ class ManagementApi:
         psk=None,
         telemetry=None,
         monitor=None,
+        rule_engine=None,
     ):
         self.broker = broker
         self.node = node
@@ -66,6 +67,7 @@ class ManagementApi:
         self.psk = psk
         self.telemetry = telemetry
         self.monitor = monitor
+        self.rule_engine = rule_engine
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -118,6 +120,12 @@ class ManagementApi:
         r("PUT", "/telemetry/status", self.telemetry_set, doc="Toggle telemetry")
         r("GET", "/telemetry/data", self.telemetry_data, doc="Telemetry report")
         r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+        r("GET", "/rules", self.rules_list, doc="Rule list with metrics")
+        r("POST", "/rules", self.rule_create, doc="Create a rule")
+        r("GET", "/rules/{rule_id}", self.rule_get, doc="One rule")
+        r("PUT", "/rules/{rule_id}", self.rule_update,
+          doc="Enable/disable or replace a rule")
+        r("DELETE", "/rules/{rule_id}", self.rule_delete, doc="Drop a rule")
         r("GET", "/monitor", self.monitor_get,
           doc="Dashboard time series (per-interval deltas)")
         r("GET", "/monitor_current", self.monitor_current,
@@ -537,6 +545,92 @@ class ManagementApi:
         if self.slow_subs is None:
             raise HttpError(404, "slow_subs disabled")
         return self.slow_subs.top()
+
+    # ---------------------------------------------------------------- rules
+
+    @staticmethod
+    def _rule_info(rule) -> dict:
+        return {
+            "id": rule.rule_id,
+            "sql": rule.sql,
+            "enabled": rule.enabled,
+            "description": rule.description,
+            "outputs": [type(o).__name__.lower() for o in rule.outputs],
+            "metrics": dict(rule.metrics),
+        }
+
+    def rules_list(self, req: Request):
+        eng = self._need("rule_engine")
+        return {"data": [self._rule_info(r) for r in eng.rules.values()]}
+
+    def rule_get(self, req: Request):
+        eng = self._need("rule_engine")
+        rule = eng.get_rule(req.params["rule_id"])
+        if rule is None:
+            raise HttpError(404, "no such rule")
+        return self._rule_info(rule)
+
+    def rule_create(self, req: Request):
+        from ..rules.engine import build_outputs
+        from ..rules.sql import SqlError
+
+        eng = self._need("rule_engine")
+        body = req.json() or {}
+        rule_id = body.get("id")
+        if rule_id is None:
+            i = len(eng.rules) + 1
+            while f"rule_{i}" in eng.rules:
+                i += 1
+            rule_id = f"rule_{i}"
+        elif rule_id in eng.rules:
+            raise HttpError(400, f"rule {rule_id!r} exists")
+        if not body.get("sql"):
+            raise HttpError(400, "sql required")
+        try:
+            rule = eng.create_rule(
+                rule_id,
+                body["sql"],
+                build_outputs(body.get("outputs")),
+                description=body.get("description", ""),
+            )
+        except SqlError as e:
+            raise HttpError(400, f"bad sql: {e}")
+        return self._rule_info(rule)
+
+    def rule_update(self, req: Request):
+        from ..rules.engine import build_outputs
+        from ..rules.sql import SqlError
+
+        eng = self._need("rule_engine")
+        rule = eng.get_rule(req.params["rule_id"])
+        if rule is None:
+            raise HttpError(404, "no such rule")
+        body = req.json() or {}
+        was_enabled = rule.enabled
+        if "sql" in body or "outputs" in body:
+            try:
+                rule = eng.create_rule(  # replace wholesale
+                    rule.rule_id,
+                    body.get("sql", rule.sql),
+                    build_outputs(body.get("outputs"))
+                    if "outputs" in body
+                    else rule.outputs,
+                    description=body.get("description", rule.description),
+                )
+            except SqlError as e:
+                raise HttpError(400, f"bad sql: {e}")
+            rule.enabled = was_enabled  # editing must not re-enable
+        if "enabled" in body:
+            rule.enabled = bool(body["enabled"])
+        if "description" in body and "sql" not in body:
+            rule.description = body["description"]
+        return self._rule_info(rule)
+
+    def rule_delete(self, req: Request):
+        eng = self._need("rule_engine")
+        if not eng.delete_rule(req.params["rule_id"]):
+            raise HttpError(404, "no such rule")
+        return None
 
     # ------------------------------------------------------------ dashboard
 

@@ -194,34 +194,17 @@ class NodeRuntime:
         self.monitor = MonitorSampler(self.broker)
 
         # ---- rule engine (emqx_rule_engine) ------------------------------
-        self.rule_engine = None
-        rule_defs = raw.get("rules") or []
-        if rule_defs:
-            from .rules.engine import Console, Republish, RuleEngine
+        from .rules.engine import RuleEngine, build_outputs
 
-            self.rule_engine = RuleEngine(self.broker)
-            for idx, rd in enumerate(rule_defs):
-                outputs = []
-                for od in rd.get("outputs") or [{"type": "console"}]:
-                    if od.get("type") == "republish":
-                        outputs.append(
-                            Republish(
-                                topic_template=od["topic"],
-                                payload_template=od.get(
-                                    "payload", "${payload}"
-                                ),
-                                qos=int(od.get("qos", 0)),
-                                retain=bool(od.get("retain", False)),
-                            )
-                        )
-                    else:
-                        outputs.append(Console())
-                self.rule_engine.create_rule(
-                    rd.get("id", f"rule{idx}"),
-                    rd["sql"],
-                    outputs,
-                    description=rd.get("description", ""),
-                )
+        # always present so the REST API can create rules at runtime
+        self.rule_engine = RuleEngine(self.broker)
+        for idx, rd in enumerate(raw.get("rules") or []):
+            self.rule_engine.create_rule(
+                rd.get("id", f"rule{idx}"),
+                rd["sql"],
+                build_outputs(rd.get("outputs")),
+                description=rd.get("description", ""),
+            )
 
         # ---- exhook (out-of-process providers, gRPC or framed JSON) ------
         self.exhook = None
@@ -278,6 +261,7 @@ class NodeRuntime:
             sys_heartbeat=self.sys_heartbeat,
             psk=self.psk,
             monitor=self.monitor,
+            rule_engine=self.rule_engine,
         )
         self.http = HttpApi(
             port=self.conf.get("dashboard.listen_port"),

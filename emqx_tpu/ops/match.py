@@ -126,6 +126,22 @@ def apply_delta_impl(
 apply_delta = jax.jit(apply_delta_impl, donate_argnums=(0,))
 
 
+def apply_delta_packed_impl(t: DeviceTables, packed: jax.Array) -> DeviceTables:
+    """apply_delta with all four delta columns in ONE [4, K] u32 array.
+
+    Over a tunneled device (axon) every host->device transfer pays a
+    round trip; packing turns a churn tick's four small puts into one.
+    """
+    slots = jax.lax.bitcast_convert_type(packed[0], jnp.int32)
+    key_a = packed[1]
+    key_b = packed[2]
+    val = jax.lax.bitcast_convert_type(packed[3], jnp.int32)
+    return apply_delta_impl(t, slots, key_a, key_b, val)
+
+
+apply_delta_packed = jax.jit(apply_delta_packed_impl, donate_argnums=(0,))
+
+
 def make_topic_batch(ta: np.ndarray, tb: np.ndarray, ln: np.ndarray, dl: np.ndarray, device=None) -> TopicBatch:
     put = lambda a: jax.device_put(a, device)
     return TopicBatch(put(ta), put(tb), put(ln), put(dl))

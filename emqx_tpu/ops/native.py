@@ -80,6 +80,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _u32p, _u32p, _i32p, ctypes.c_int32, ctypes.c_int32,
         _u32p, _u32p, _i32p, ctypes.c_int32,
     ]
+    lib.etpu_bulk_place_slots.restype = ctypes.c_int32
+    lib.etpu_bulk_place_slots.argtypes = [
+        _u32p, _u32p, _i32p, ctypes.c_int32, ctypes.c_int32,
+        _u32p, _u32p, _i32p, ctypes.c_int32, _i32p,
+    ]
     lib.etpu_bcrypt_init.restype = None
     lib.etpu_bcrypt_init.argtypes = [_u32p]
     lib.etpu_bcrypt_hash.restype = ctypes.c_int32
@@ -258,3 +263,28 @@ def bulk_place(key_a: np.ndarray, key_b: np.ndarray, val: np.ndarray,
         ha.ctypes.data_as(_u32p), hb.ctypes.data_as(_u32p),
         fids.ctypes.data_as(_i32p), len(ha),
     )
+
+
+def bulk_place_slots(key_a: np.ndarray, key_b: np.ndarray, val: np.ndarray,
+                     log2cap: int, probe: int,
+                     ha: np.ndarray, hb: np.ndarray, fids: np.ndarray):
+    """Incremental churn placement: returns (n_placed, slots[n]) where
+    slots carries each key's chosen table index (for the device-mirror
+    delta scatter), or None when the lib is absent."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    assert key_a.flags.c_contiguous and val.flags.c_contiguous
+    c = np.ascontiguousarray
+    ha = c(ha.astype(np.uint32, copy=False))
+    hb = c(hb.astype(np.uint32, copy=False))
+    fids = c(fids.astype(np.int32, copy=False))
+    out_slots = np.zeros(len(ha), dtype=np.int32)
+    n = lib.etpu_bulk_place_slots(
+        key_a.ctypes.data_as(_u32p), key_b.ctypes.data_as(_u32p),
+        val.ctypes.data_as(_i32p), log2cap, probe,
+        ha.ctypes.data_as(_u32p), hb.ctypes.data_as(_u32p),
+        fids.ctypes.data_as(_i32p), len(ha),
+        out_slots.ctypes.data_as(_i32p),
+    )
+    return n, out_slots

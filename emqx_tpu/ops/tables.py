@@ -270,6 +270,152 @@ class MatchTables:
             self.log2cap += 1
         self._rebuild()
 
+    def churn_insert(self, filters: Sequence[str], fids: Sequence[int],
+                     words: Optional[Sequence[Sequence[str]]] = None) -> None:
+        """Incremental batched insert for churn ticks.
+
+        Unlike bulk_insert (which rebuilds the whole table — right for
+        bootstrap, wrong for a 5%/s churn tick against 10M resident
+        entries), this places the batch into the live arrays with the
+        native open-addressing pass and appends the touched slots to the
+        delta, so sync_device stays one small scatter.  Falls back to
+        per-filter insert() without the native lib.
+        """
+        from . import native
+
+        n = len(filters)
+        if n == 0:
+            return
+        out = native.filter_keys(list(filters), self.space.max_levels,
+                                 self.space)
+        if out is None:
+            ws = words or [f.split("/") for f in filters]
+            for w, fid in zip(ws, fids):
+                self.insert(w, fid)
+            return
+        ha, hb, plen, plus_mask, has_hash = out
+
+        # shape bookkeeping: churn batches hold few distinct shapes —
+        # acquire each once with its count, like bulk_insert
+        trip = np.stack([plen.astype(np.int64),
+                         plus_mask.astype(np.int64),
+                         has_hash.astype(np.int64)])
+        uniq, counts = np.unique(trip, axis=1, return_counts=True)
+        shape_cache: Dict[Tuple[int, int, bool], Shape] = {}
+        for j in range(uniq.shape[1]):
+            key = (int(uniq[0, j]), int(uniq[1, j]), bool(uniq[2, j]))
+            shape = Shape(plen=key[0], plus_mask=key[1], has_hash=key[2])
+            shape_cache[key] = shape
+            cnt = int(counts[j])
+            ent = self._shapes.get(shape)
+            if ent is not None:
+                idx, rc = ent
+                self._shapes[shape] = (idx, rc + cnt)
+                continue
+            while True:
+                try:
+                    self._acquire_shape(shape)
+                    break
+                except GrowNeeded:
+                    self._grow_desc()
+            idx, _one = self._shapes[shape]
+            self._shapes[shape] = (idx, cnt)
+        entries = self._entries
+        ha_l = ha.tolist()
+        hb_l = hb.tolist()
+        plen_l = plen.tolist()
+        plus_l = plus_mask.tolist()
+        hash_l = has_hash.tolist()
+        for i, fid in enumerate(fids):
+            entries[fid] = (
+                ha_l[i],
+                hb_l[i],
+                shape_cache[(plen_l[i], plus_l[i], bool(hash_l[i]))],
+            )
+        self.n_entries += n
+
+        if self.n_entries * 2 > (1 << self.log2cap):
+            # load factor crossed: one rebuild places everything
+            # (entries above already include this batch)
+            while self.n_entries * 2 > (1 << self.log2cap):
+                self.log2cap += 1
+            self._rebuild()
+            return
+
+        fid_arr = np.asarray(list(fids), dtype=np.int32)
+        placed = native.bulk_place_slots(
+            self.key_a, self.key_b, self.val, self.log2cap, PROBE,
+            ha, hb, fid_arr,
+        )
+        if placed is None:
+            n_ok, slots = 0, np.zeros(0, dtype=np.int32)
+        else:
+            n_ok, slots = placed
+        self.delta.slots.extend(int(s) for s in slots[:n_ok])
+        self.delta.key_a.extend(int(x) for x in ha[:n_ok])
+        self.delta.key_b.extend(int(x) for x in hb[:n_ok])
+        self.delta.val.extend(int(f) for f in fid_arr[:n_ok])
+        if n_ok < n:
+            # a probe window filled: grow + native rebuild covers the
+            # remainder (their _entries are registered already) — NOT
+            # _grow_table, whose per-entry Python re-place loop would
+            # stall for tens of seconds at 10M resident entries
+            self.log2cap += 1
+            if self.log2cap > MAX_LOG2CAP:
+                raise RuntimeError("match-table growth runaway")
+            self._rebuild()
+
+    def delete_batch(self, fids: Sequence[int]) -> None:
+        """Vectorized tombstoning for churn ticks: one numpy pass finds
+        every entry's slot across its probe window instead of n Python
+        probes; shape refcounts release grouped by shape."""
+        n = len(fids)
+        if n == 0:
+            return
+        if n < 32:  # below this the numpy overhead loses
+            for fid in fids:
+                self.delete(fid)
+            return
+        cap = 1 << self.log2cap
+        ha = np.zeros(n, dtype=np.uint32)
+        hb = np.zeros(n, dtype=np.uint32)
+        farr = np.zeros(n, dtype=np.int32)
+        shape_counts: Dict[Shape, int] = {}
+        for i, fid in enumerate(fids):
+            a, b, shape = self._entries.pop(fid)
+            ha[i] = a
+            hb[i] = b
+            farr[i] = fid
+            shape_counts[shape] = shape_counts.get(shape, 0) + 1
+        mixed = (ha + hb * np.uint32(_MIX1)) * np.uint32(_MIX2)
+        home = (mixed >> np.uint32(32 - self.log2cap)).astype(np.int64)
+        windows = (home[:, None] + np.arange(PROBE)[None, :]) & (cap - 1)
+        hit = (
+            (self.val[windows] == farr[:, None])
+            & (self.key_a[windows] == ha[:, None])
+            & (self.key_b[windows] == hb[:, None])
+        )
+        if not hit.any(axis=1).all():  # pragma: no cover - bookkeeping
+            raise KeyError("filter id missing from table in delete_batch")
+        slots = windows[np.arange(n), hit.argmax(axis=1)]
+        self.key_a[slots] = 0
+        self.key_b[slots] = 0
+        self.val[slots] = -1
+        self.delta.slots.extend(slots.tolist())
+        self.delta.key_a.extend([0] * n)
+        self.delta.key_b.extend([0] * n)
+        self.delta.val.extend([-1] * n)
+        for shape, cnt in shape_counts.items():
+            idx, rc = self._shapes[shape]
+            if rc > cnt:
+                self._shapes[shape] = (idx, rc - cnt)
+            else:
+                del self._shapes[shape]
+                self.valid[idx] = False
+                self._free_desc.append(idx)
+                self.delta.desc_dirty = True
+        self.n_entries -= n
+
     def _rebuild(self) -> None:
         """Re-place every entry into fresh arrays at the current capacity,
         growing until placement succeeds; native path when available."""

@@ -205,6 +205,25 @@ class Console:
         log.info("[rule console] %s", selected)
 
 
+def build_outputs(defs) -> List[Callable]:
+    """Output definitions ({"type": "republish"|"console", ...}) ->
+    output callables — shared by node-config and REST rule creation."""
+    outs: List[Callable] = []
+    for od in defs or [{"type": "console"}]:
+        if od.get("type") == "republish":
+            outs.append(
+                Republish(
+                    topic_template=od["topic"],
+                    payload_template=od.get("payload", "${payload}"),
+                    qos=int(od.get("qos", 0)),
+                    retain=bool(od.get("retain", False)),
+                )
+            )
+        else:
+            outs.append(Console())
+    return outs
+
+
 def render_template(tpl: str, selected: Dict[str, Any], env: Dict[str, Any]) -> str:
     """`${a.b}` placeholder substitution (emqx_placeholder analog)."""
     import re

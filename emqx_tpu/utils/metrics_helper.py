@@ -40,12 +40,14 @@ class MetricsHelper:
             return 0.0
         now = time.monotonic() if now is None else now
         cutoff = now - self.window_s
+        last_ts, last_val = h[-1]
+        if last_ts < cutoff:
+            return 0.0  # source idle: nothing inside the window
         base_ts, base_val = h[0]
         for ts, val in h:
             if ts >= cutoff:
                 base_ts, base_val = ts, val
                 break
-        last_ts, last_val = h[-1]
         if last_ts <= base_ts:
             return 0.0
         return (last_val - base_val) / (last_ts - base_ts)

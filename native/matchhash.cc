@@ -231,4 +231,33 @@ int32_t etpu_bulk_place(
     return n;
 }
 
+// Incremental churn placement: like etpu_bulk_place, but records the
+// chosen slot per key in out_slots so the caller can scatter the same
+// writes into the HBM mirror (delta tracking for apply_delta).
+int32_t etpu_bulk_place_slots(
+    uint32_t* key_a, uint32_t* key_b, int32_t* val,
+    int32_t log2cap, int32_t probe,
+    const uint32_t* ha, const uint32_t* hb, const int32_t* fids,
+    int32_t n, int32_t* out_slots) {
+    uint32_t cap_mask = (1u << log2cap) - 1;
+    const uint32_t MIX1 = 0x85EBCA77u, MIX2 = 0x9E3779B1u;
+    for (int32_t i = 0; i < n; i++) {
+        uint32_t home = ((ha[i] + hb[i] * MIX1) * MIX2) >> (32 - log2cap);
+        bool placed = false;
+        for (int32_t off = 0; off < probe; off++) {
+            uint32_t slot = (home + (uint32_t)off) & cap_mask;
+            if (val[slot] == -1) {
+                key_a[slot] = ha[i];
+                key_b[slot] = hb[i];
+                val[slot] = fids[i];
+                out_slots[i] = (int32_t)slot;
+                placed = true;
+                break;
+            }
+        }
+        if (!placed) return i;
+    }
+    return n;
+}
+
 }  // extern "C"

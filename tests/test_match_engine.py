@@ -222,3 +222,67 @@ def test_broker_counts_collisions():
 
     assert b.publish(Message(topic="a/1", payload=b"x")) == 0
     assert b.metrics.get("match.hash_collision") == 1
+
+
+def test_apply_churn_matches_per_op_path():
+    """Batched churn (native pass) and the per-op path must end in
+    identical match behavior and identical device mirrors."""
+    import random
+
+    rng = random.Random(99)
+    base = [f"base/{i}/+/t" for i in range(3000)]
+    pool = [f"churn/{i}/+" for i in range(400)]
+
+    fast = TopicMatchEngine()
+    slow = TopicMatchEngine()
+    fast.add_filters(base)
+    for f in base:
+        slow.add_filter(f)
+    fast.sync_device()
+    slow.sync_device()
+
+    live = set()
+    for tick in range(12):
+        adds, removes = [], []
+        for _ in range(80):
+            f = rng.choice(pool)
+            if f in live and rng.random() < 0.5:
+                removes.append(f)
+                live.discard(f)
+            elif f not in live:
+                adds.append(f)
+                live.add(f)
+        fast.apply_churn(adds, removes)
+        for f in removes:
+            slow.remove_filter(f)
+        for f in adds:
+            slow.add_filter(f)
+        fast.sync_device()
+        slow.sync_device()
+
+        topics = [f"churn/{rng.randrange(400)}/x" for _ in range(64)]
+        topics += [f"base/{rng.randrange(3000)}/y/t" for _ in range(64)]
+        got_f = fast.match(topics)
+        got_s = slow.match(topics)
+        # fids differ between engines; compare by filter strings
+        def names(eng, sets):
+            return [
+                sorted(eng._words[f] and "/".join(eng._words[f]) for f in s)
+                for s in sets
+            ]
+        assert names(fast, got_f) == names(slow, got_s), f"tick {tick}"
+    assert fast.n_filters == slow.n_filters
+
+
+def test_apply_churn_growth_mid_tick():
+    """A churn batch that crosses the load factor triggers one rebuild
+    and stays correct."""
+    eng = TopicMatchEngine()
+    eng.add_filters([f"a/{i}" for i in range(100)])
+    eng.sync_device()
+    cap_before = eng.tables.log2cap
+    eng.apply_churn([f"g/{i}/+" for i in range(5000)], [])
+    eng.sync_device()
+    assert eng.tables.log2cap > cap_before
+    assert eng.match(["g/77/zzz"])[0] == {eng.fid_of("g/77/+")}
+    assert eng.match(["a/5"])[0] == {eng.fid_of("a/5")}

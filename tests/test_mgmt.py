@@ -289,3 +289,64 @@ def test_cli_node_dump(tmp_path):
     assert dump["status"]["status"] == "running"
     assert "metrics" in dump and "configs" in dump
     assert "listeners" in dump
+
+
+def test_rules_rest_crud(run):
+    async def main():
+        from emqx_tpu.rules.engine import RuleEngine
+
+        b = Broker()
+        lst = Listener(b, port=0)
+        await lst.start()
+        tokens = TokenStore()
+        tokens.add_admin("admin", "public123")
+        eng = RuleEngine(b)
+        api = ManagementApi(b, node="n0", tokens=tokens, rule_engine=eng)
+        srv = HttpApi(port=0, auth=api.auth_check)
+        api.install(srv)
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.port}/api/v5"
+        st, body = await asyncio.to_thread(
+            http, "POST", base + "/login",
+            {"username": "admin", "password": "public123"})
+        tok = body["token"]
+
+        # create a republish rule over REST
+        st, rule = await asyncio.to_thread(
+            http, "POST", base + "/rules",
+            {"id": "r-rest", "sql": 'SELECT topic, payload FROM "in/#"',
+             "outputs": [{"type": "republish", "topic": "out/${topic}"}]},
+            tok)
+        assert st == 200 and rule["id"] == "r-rest"
+        # bad SQL rejected
+        st, _ = await asyncio.to_thread(
+            http, "POST", base + "/rules",
+            {"id": "bad", "sql": "SELEKT nope"}, tok)
+        assert st == 400
+
+        # rule actually fires
+        c = MqttClient(clientid="rule-c")
+        await c.connect(port=lst.port)
+        await c.subscribe("out/#")
+        await c.publish("in/x", b"via-rest-rule", qos=1)
+        m = await c.recv()
+        assert m.topic == "out/in/x" and m.payload == b"via-rest-rule"
+
+        # metrics + disable + delete
+        st, got = await asyncio.to_thread(
+            http, "GET", base + "/rules/r-rest", None, tok)
+        assert got["metrics"]["matched"] >= 1
+        st, got = await asyncio.to_thread(
+            http, "PUT", base + "/rules/r-rest", {"enabled": False}, tok)
+        assert got["enabled"] is False
+        st, _ = await asyncio.to_thread(
+            http, "DELETE", base + "/rules/r-rest", None, tok)
+        assert st in (200, 204)
+        st, listing = await asyncio.to_thread(
+            http, "GET", base + "/rules", None, tok)
+        assert listing["data"] == []
+        await c.disconnect()
+        await srv.stop()
+        await lst.stop()
+
+    run(main())
