@@ -1,0 +1,89 @@
+"""Versioned RPC contracts — the BPAPI analog.
+
+The reference pins every cross-node call behind a versioned api module
+(`apps/emqx/src/proto/emqx_broker_proto_v1.erl`) and statically checks
+call sites (`apps/emqx/src/bpapi/emqx_bpapi_static_checks.erl`), so a
+rolling upgrade never sends a node an RPC it cannot serve.
+
+Here the contract table IS the registry: every cluster-visible method
+declares the versions this node can SERVE and the minimum it may CALL.
+Nodes exchange their tables in the HELLO and each side computes the
+negotiated version per method; calling a method the peer cannot serve
+fails loudly at call time instead of as an opaque remote error.
+
+`check_handlers` is the static-check analog: it verifies at startup
+that every method this node claims to serve has a registered handler.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .transport import RpcError
+
+#: method -> (min_version, max_version) this build can SERVE.
+#: Bump max when a method's semantics/shape change; keep serving old
+#: versions until every deployment has crossed the boundary.
+CONTRACTS: Dict[str, Tuple[int, int]] = {
+    "publish": (1, 1),          # management publish proxy
+    "remote_snapshot": (1, 1),  # core-mirrored route snapshot
+    "cluster_commit": (1, 1),   # cluster_rpc MFA log commit
+    "cluster_apply": (1, 1),
+    "cluster_catchup": (1, 1),
+    "lock_acquire": (1, 1),     # distributed locker (cluster/locker.py)
+    "lock_release": (1, 1),
+}
+
+
+def announce() -> Dict[str, List[int]]:
+    """The HELLO payload: method -> [min, max] served versions.
+
+    The table is static per release, like the reference's bpapi modules:
+    wiring order (ClusterRpc may attach after links come up) must not
+    change what a node advertises.  A declared-but-unwired method fails
+    at the remote as a plain RpcError, which every fan-out caller
+    already skips per-peer; `check_handlers` warns at startup."""
+    return {m: [lo, hi] for m, (lo, hi) in CONTRACTS.items()}
+
+
+def negotiate(peer_table: Optional[Dict[str, List[int]]]
+              ) -> Dict[str, int]:
+    """Per-method negotiated version against a peer's announcement.
+
+    A legacy peer that announced nothing is assumed to serve v1 of
+    everything (the pre-bpapi wire); methods with no version overlap are
+    omitted — `version_for` then refuses the call.
+    """
+    if not peer_table:
+        return {m: 1 for m in CONTRACTS}
+    out: Dict[str, int] = {}
+    for method, (lo, hi) in CONTRACTS.items():
+        peer = peer_table.get(method)
+        if peer is None:
+            continue  # peer cannot serve it at all
+        plo, phi = int(peer[0]), int(peer[1])
+        best = min(hi, phi)
+        if best >= max(lo, plo):
+            out[method] = best
+    return out
+
+
+class IncompatiblePeer(RpcError):
+    """Subclasses RpcError so per-peer `except RpcError` skip paths
+    (cluster_rpc multicall fan-out, catch-up) treat a version-skewed
+    peer like an unreachable one instead of aborting the whole round."""
+
+
+def version_for(negotiated: Dict[str, int], method: str) -> int:
+    v = negotiated.get(method)
+    if v is None:
+        raise IncompatiblePeer(
+            f"peer cannot serve rpc {method!r} at any compatible version"
+        )
+    return v
+
+
+def check_handlers(rpc_handlers: Dict[str, object]) -> List[str]:
+    """Static-check analog: every served contract needs a handler.
+    Returns the list of missing handlers (callers decide to raise/log)."""
+    return sorted(m for m in CONTRACTS if m not in rpc_handlers)

@@ -1,0 +1,132 @@
+"""Cluster-wide locks — the ekka_locker / emqx_cm_locker analog.
+
+The reference serializes session takeover per clientid with a
+distributed lock (`emqx_cm_locker:trans`, `emqx_cm.erl:225` open_session
+path).  Here lock state lives on ONE deterministic authority — the
+lexicographically-smallest live core node — and every node acquires by
+RPC (`lock_acquire` / `lock_release`, versioned in bpapi.py).  Leases
+bound the damage of a crashed holder: an expired lock is simply granted
+to the next caller, matching ekka_locker's best-effort semantics (locks
+do not survive an authority failover either — they guard short critical
+sections, not durable state).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional, Tuple
+
+from .transport import RpcError
+
+DEFAULT_LEASE_S = 15.0
+
+
+class DistLocker:
+    def __init__(self, node, default_lease: float = DEFAULT_LEASE_S):
+        self.node = node
+        self.default_lease = default_lease
+        # authority-side table: key -> (owner_node, expires_at)
+        self._held: Dict[str, Tuple[str, float]] = {}
+        node.transport.rpc_handlers["lock_acquire"] = self._rpc_acquire
+        node.transport.rpc_handlers["lock_release"] = self._rpc_release
+
+    # ---------------------------------------------------------- authority
+
+    def authority(self) -> Optional[str]:
+        """Smallest live core node name (self counts when core).
+
+        None when no core is visible — a partitioned replicant must
+        fail closed rather than self-grant, or two partitioned nodes
+        would both 'hold' the same takeover lock."""
+        cands = [
+            p for p in self.node.up_peers()
+            if self.node._roles.get(p, "core") == "core"
+        ]
+        if self.node.role == "core":
+            cands.append(self.node.name)
+        return min(cands) if cands else None
+
+    def _grant(self, key: str, owner: str, lease_s: float) -> bool:
+        now = time.monotonic()
+        cur = self._held.get(key)
+        if cur is not None and cur[1] > now and cur[0] != owner:
+            return False
+        self._held[key] = (owner, now + lease_s)
+        return True
+
+    def _rpc_acquire(self, peer: str, params: dict) -> dict:
+        ok = self._grant(
+            str(params.get("key", "")),
+            params.get("owner", peer),
+            float(params.get("lease_s", self.default_lease)),
+        )
+        return {"ok": ok}
+
+    def _rpc_release(self, peer: str, params: dict) -> dict:
+        key = str(params.get("key", ""))
+        owner = params.get("owner", peer)
+        cur = self._held.get(key)
+        if cur is not None and cur[0] == owner:
+            del self._held[key]
+            return {"ok": True}
+        return {"ok": False}
+
+    # -------------------------------------------------------------- client
+
+    async def acquire(self, key: str, lease_s: Optional[float] = None,
+                      retries: int = 0, retry_ivl: float = 0.1) -> bool:
+        lease = lease_s if lease_s is not None else self.default_lease
+        for attempt in range(retries + 1):
+            auth = self.authority()
+            if auth is None:
+                ok = False  # no visible core: fail closed
+            elif auth == self.node.name:
+                ok = self._grant(key, self.node.name, lease)
+            else:
+                try:
+                    resp = await self.node.call(
+                        auth, "lock_acquire",
+                        {"key": key, "owner": self.node.name,
+                         "lease_s": lease},
+                    )
+                    ok = bool(resp.get("ok"))
+                except (RpcError, asyncio.TimeoutError):
+                    ok = False  # authority unreachable: fail closed
+            if ok:
+                return True
+            if attempt < retries:
+                await asyncio.sleep(retry_ivl)
+        return False
+
+    async def release(self, key: str) -> bool:
+        auth = self.authority()
+        if auth is None:
+            return False  # lease expiry reclaims it on the authority
+        if auth == self.node.name:
+            cur = self._held.get(key)
+            if cur is not None and cur[0] == self.node.name:
+                del self._held[key]
+                return True
+            return False
+        try:
+            resp = await self.node.call(
+                auth, "lock_release", {"key": key, "owner": self.node.name}
+            )
+            return bool(resp.get("ok"))
+        except (RpcError, asyncio.TimeoutError):
+            return False
+
+    async def trans(self, key: str, fn, lease_s: Optional[float] = None,
+                    retries: int = 20):
+        """`emqx_cm_locker:trans` analog: run `fn` under the lock.
+        Raises TimeoutError when the lock cannot be had."""
+        if not await self.acquire(key, lease_s, retries=retries):
+            raise TimeoutError(f"could not acquire cluster lock {key!r}")
+        try:
+            r = fn()
+            if asyncio.iscoroutine(r):
+                r = await r
+            return r
+        finally:
+            await self.release(key)

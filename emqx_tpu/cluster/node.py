@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..broker.broker import Broker
 from ..broker.message import Message
+from . import bpapi
 from . import transport as tp
 from .routes import RemoteRoutes
 from .transport import PeerLink, RpcError, Transport
@@ -148,10 +149,24 @@ class ClusterNode:
         t.on_forward = self._on_forward
         t.rpc_handlers["publish"] = self._rpc_publish
         t.rpc_handlers["remote_snapshot"] = self._rpc_remote_snapshot
+        # distributed locks (ekka_locker analog) + per-peer negotiated
+        # rpc versions (bpapi analog; filled at link-up)
+        from .locker import DistLocker
+
+        self.locker = DistLocker(self)
+        self.peer_bpapi: Dict[str, Dict[str, int]] = {}
 
     # ------------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
+        # bpapi static check: contracts are per-release and announced in
+        # full; warn when a declared method has no handler wired yet
+        # (e.g. ClusterRpc not constructed) — its callers degrade to the
+        # same per-peer RpcError skip as an unreachable node
+        missing = bpapi.check_handlers(self.transport.rpc_handlers)
+        if missing:
+            log.warning("%s: declared rpc contracts without handlers: %s",
+                        self.name, missing)
         await self.transport.start()
         for peer, addr in self.peers_cfg.items():
             self._add_link(peer, addr)
@@ -208,7 +223,7 @@ class ClusterNode:
         link.start()
 
     def _hello_extra(self) -> dict:
-        extra = {"role": self.role}
+        extra = {"role": self.role, "bpapi": bpapi.announce()}
         host = self.advertise_host or self.transport.host
         if host not in ("0.0.0.0", "::"):
             # a wildcard bind with no advertise_host is not dialable;
@@ -252,6 +267,7 @@ class ClusterNode:
     def _link_up(self, link: PeerLink, hello: dict) -> None:
         peer_role = hello.get("role", "core")
         self._roles[link.peer] = peer_role
+        self.peer_bpapi[link.peer] = bpapi.negotiate(hello.get("bpapi"))
         if self.role == "replicant" and peer_role == "replicant":
             # replicants never mesh with each other (mria topology) —
             # discovery could not know the role before dialing; now we
@@ -366,6 +382,7 @@ class ClusterNode:
 
     def _on_hello(self, peer: str, hello: dict) -> dict:
         self._roles[peer] = hello.get("role", "core")
+        self.peer_bpapi[peer] = bpapi.negotiate(hello.get("bpapi"))
         # dial back a peer we have no outbound link to (replicants dial
         # cores; the core's return link is how forwards/relays reach
         # them — mria's replicant attach)
@@ -382,7 +399,11 @@ class ClusterNode:
                 self.join(peer, (str(addr[0]), int(addr[1])))
             except (ValueError, TypeError):
                 pass
-        return {"incarnation": self.incarnation, "role": self.role}
+        return {
+            "incarnation": self.incarnation,
+            "role": self.role,
+            "bpapi": bpapi.announce(),
+        }
 
     async def _resync_via_core(self, origin: str) -> None:
         """Ask an up core for its mirror of `origin`'s routes."""
@@ -532,6 +553,12 @@ class ClusterNode:
         link = self.links.get(peer)
         if link is None:
             raise RpcError(f"unknown peer {peer!r}")
+        # bpapi gate: refuse calls the peer announced it cannot serve
+        if method in bpapi.CONTRACTS:
+            negotiated = self.peer_bpapi.get(peer)
+            if negotiated is not None:
+                params = dict(params)
+                params["_v"] = bpapi.version_for(negotiated, method)
         return await link.rpc(method, params, timeout)
 
     def _rpc_publish(self, peer: str, params: dict) -> dict:

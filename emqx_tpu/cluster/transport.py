@@ -399,13 +399,18 @@ class Transport:
                 if ftype == RPC_REQ:
                     obj = json.loads(body)
                     pool = self._rpc_pool
-                    if pool is None or not pool.submit_to(
-                        peer_name, lambda o=obj: run_rpc_bg(o)
-                    ):
-                        # pool saturated (or stopping): run inline so the
-                        # read loop stalls — real backpressure on the
-                        # flooding peer instead of unbounded task growth
-                        await run_rpc_bg(obj)
+                    if pool is None:
+                        await run_rpc_bg(obj)  # stopping: best effort
+                    else:
+                        # bounded backpressure: when the worker queue is
+                        # full this awaits ADMISSION (one queued item
+                        # draining), not a handler's full runtime — so a
+                        # flood stalls this peer's reads briefly without
+                        # starving PING/FORWARD for seconds or spawning
+                        # unbounded tasks
+                        await pool.submit_to_wait(
+                            peer_name, lambda o=obj: run_rpc_bg(o)
+                        )
                     continue
                 async with wlock:
                     if ftype == PING:

@@ -595,6 +595,8 @@ class ManagementApi:
             )
         except SqlError as e:
             raise HttpError(400, f"bad sql: {e}")
+        except ValueError as e:
+            raise HttpError(400, f"bad outputs: {e}")
         return self._rule_info(rule)
 
     def rule_update(self, req: Request):
@@ -619,6 +621,8 @@ class ManagementApi:
                 )
             except SqlError as e:
                 raise HttpError(400, f"bad sql: {e}")
+            except ValueError as e:
+                raise HttpError(400, f"bad outputs: {e}")
             rule.enabled = was_enabled  # editing must not re-enable
         if "enabled" in body:
             rule.enabled = bool(body["enabled"])

@@ -210,12 +210,20 @@ def build_outputs(defs) -> List[Callable]:
     output callables — shared by node-config and REST rule creation."""
     outs: List[Callable] = []
     for od in defs or [{"type": "console"}]:
+        if not isinstance(od, dict):
+            raise ValueError(f"output definition must be an object: {od!r}")
         if od.get("type") == "republish":
+            if not od.get("topic"):
+                raise ValueError("republish output requires 'topic'")
+            try:
+                qos = int(od.get("qos", 0))
+            except (TypeError, ValueError):
+                raise ValueError(f"republish qos must be an int: {od.get('qos')!r}")
             outs.append(
                 Republish(
                     topic_template=od["topic"],
                     payload_template=od.get("payload", "${payload}"),
-                    qos=int(od.get("qos", 0)),
+                    qos=qos,
                     retain=bool(od.get("retain", False)),
                 )
             )

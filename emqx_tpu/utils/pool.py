@@ -84,6 +84,17 @@ class WorkerPool:
         key runs on one worker in submission order."""
         return self._enqueue(hash(key) % self.size, fn, None)
 
+    async def submit_to_wait(self, key: Any, fn: Callable[[], Any]) -> None:
+        """Like submit_to, but awaits queue admission when the worker's
+        queue is full — bounded backpressure (caller stalls only until
+        one queued item drains, never for a handler's full runtime)."""
+        i = hash(key) % self.size
+        try:
+            self._queues[i].put_nowait((fn, None))
+        except asyncio.QueueFull:
+            await self._queues[i].put((fn, None))
+        self.submitted += 1
+
     def call(self, fn: Callable[[], Any]) -> "asyncio.Future":
         """Submit and get a future for the result (sync_submit analog)."""
         fut = asyncio.get_running_loop().create_future()
