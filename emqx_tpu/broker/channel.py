@@ -87,6 +87,10 @@ class Channel:
         # connect-time enhanced auth: stashed CONNECT while AUTH rounds run
         self._pending_connect: Optional[tuple] = None
         self._auth_method: Optional[str] = None
+        # cross-node session sync: phase2 args stashed while the async
+        # cluster takeover/discard runs (post-auth, pre-open_session)
+        self._pending_phase2: Optional[tuple] = None
+        self._cluster_synced = False
         self._takeover = False
         # connection layer integration: out_cb receives actions produced
         # outside handle_in (broker deliveries, kicks); tests collect them.
@@ -270,6 +274,17 @@ class Channel:
         """Post-authentication half of CONNECT processing: hooks, will,
         session open, CONNACK.  Split out so the enhanced-auth handshake
         can resume here after its AUTH rounds."""
+        # cross-node session sync runs ONLY after authentication (an
+        # unauthenticated CONNECT must never be able to kick or pull
+        # another node's session); the connection awaits the RPCs and
+        # re-enters via finish_cluster_sync
+        cluster = getattr(self.broker, "cluster", None)
+        if cluster is not None and not self._cluster_synced and not assigned:
+            self._pending_phase2 = (
+                p, clientid, username, assigned, auth, extra_props
+            )
+            self.state = AUTHENTICATING  # gate other packets meanwhile
+            return [("cluster_sync", clientid, p.clean_start)]
         self._m("authentication.success")
         self.clientinfo.is_superuser = bool(auth.get("is_superuser"))
         for k in ("acl", "expire_at"):
@@ -342,6 +357,20 @@ class Channel:
             for d in session.replay():
                 acts.extend(self._deliveries_out([d]))
         return acts
+
+    def finish_cluster_sync(self) -> List[Action]:
+        """Resume CONNECT processing after the async cluster session
+        sync completed (or failed best-effort)."""
+        if self._pending_phase2 is None:
+            return []
+        p, clientid, username, assigned, auth, extra_props = (
+            self._pending_phase2
+        )
+        self._pending_phase2 = None
+        self._cluster_synced = True
+        return self._connect_phase2(
+            p, clientid, username, assigned, auth, extra_props
+        )
 
     def _make_session(self) -> Session:
         return Session(

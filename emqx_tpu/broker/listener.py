@@ -74,10 +74,30 @@ class Connection:
             elif kind == "ack_async":
                 fut, builder = action[1], action[2]
                 asyncio.ensure_future(self._ack_when_done(fut, builder))
+            elif kind == "cluster_sync":
+                asyncio.ensure_future(
+                    self._cluster_sync(action[1], action[2])
+                )
             elif kind == "close":
                 self._closing = arg if arg is not None else -1
                 self._normal = arg is None
             # 'connected' is informational
+
+    async def _cluster_sync(self, clientid: str, clean_start: bool) -> None:
+        """Run the cross-node discard/takeover (post-auth; see
+        Channel._connect_phase2), then resume the CONNECT."""
+        cluster = getattr(self.channel.broker, "cluster", None)
+        if cluster is not None:
+            try:
+                if clean_start:
+                    await cluster.discard_remote(clientid)
+                else:
+                    await cluster.import_session(clientid)
+            except Exception:
+                log.exception("cluster session sync for %s", clientid)
+        if self._closing is None:
+            self._send_actions(self.channel.finish_cluster_sync())
+            await self._drain()
 
     async def _ack_when_done(self, fut, builder) -> None:
         """Deferred publish ack: wait for the batched match, then respond."""

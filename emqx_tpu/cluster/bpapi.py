@@ -32,6 +32,7 @@ CONTRACTS: Dict[str, Tuple[int, int]] = {
     "cluster_catchup": (1, 1),
     "lock_acquire": (1, 1),     # distributed locker (cluster/locker.py)
     "lock_release": (1, 1),
+    "session_takeover": (1, 1),  # cross-node session migration
 }
 
 

@@ -149,6 +149,7 @@ class ClusterNode:
         t.on_forward = self._on_forward
         t.rpc_handlers["publish"] = self._rpc_publish
         t.rpc_handlers["remote_snapshot"] = self._rpc_remote_snapshot
+        t.rpc_handlers["session_takeover"] = self._rpc_session_takeover
         # distributed locks (ekka_locker analog) + per-peer negotiated
         # rpc versions (bpapi analog; filled at link-up)
         from .locker import DistLocker
@@ -560,6 +561,124 @@ class ClusterNode:
                 params = dict(params)
                 params["_v"] = bpapi.version_for(negotiated, method)
         return await link.rpc(method, params, timeout)
+
+    def _rpc_session_takeover(self, peer: str, params: dict) -> dict:
+        """Hand a locally-held session (live or parked) to the peer.
+
+        The serving half of cross-node takeover (`emqx_cm:takeover_session`
+        rpc, `emqx_cm.erl:320-361`): a live channel is kicked with
+        SESSION_TAKEN_OVER, the session state ships wholesale
+        (subscriptions + mqueue + inflight), and this node's routes for
+        the client are retracted so publishes chase the new owner."""
+        from ..broker.packet import ReasonCode
+        from ..broker.persist import session_to_dict
+
+        cid = str(params.get("clientid", ""))
+        cm = self.broker.cm
+        ch = cm.channels.get(cid)
+        if ch is not None and getattr(ch, "session", None) is not None:
+            session = ch.session
+            cm._kick(ch, ReasonCode.SESSION_TAKEN_OVER)
+            # a live session ships with a real deadline (expiry, or a
+            # short handoff grace for expiry-0 sessions) so an importer
+            # that dies mid-handshake cannot strand it forever
+            exp = session.expiry_interval
+            expire_at = time.time() + (exp if exp > 0 else 30.0)
+            data = session_to_dict(session, expire_at)
+            self.broker.client_down(cid, list(session.subscriptions))
+            return {"found": True, "live": True, "session": data}
+        ent = cm.pending.pop(cid, None)
+        if ent is not None:
+            session, expire_at = ent
+            if cm.on_resume:
+                # persistence hook: the on-disc copy must die with the
+                # handoff or a restart would resurrect a stale duplicate
+                cm.on_resume(cid)
+            data = session_to_dict(session, expire_at)
+            self.broker.client_down(cid, list(session.subscriptions))
+            return {"found": True, "live": False, "session": data}
+        return {"found": False}
+
+    async def import_session(self, clientid: str) -> bool:
+        """Pull `clientid`'s session from whichever peer holds it.
+
+        The calling half of cross-node takeover: runs under the cluster
+        lock (duplicate simultaneous reconnects race for it; the loser
+        finds the session already local).  Instead of a replicated
+        clientid->node registry (`emqx_cm_registry`'s mria table), the
+        owner is found by fan-out query — at broker cluster sizes the
+        connect-time RPC round is cheaper than replicating every session
+        movement into all nodes.  Returns True when a session is local
+        (imported now or already here)."""
+        from ..broker.persist import session_from_dict
+
+        cm = self.broker.cm
+        if clientid in cm.channels or clientid in cm.pending:
+            # local copy wins; still sweep remote duplicates in the
+            # background — a partition-degraded takeover can leave a
+            # second live copy elsewhere, and single-session-per-clientid
+            # must converge (registry-based emqx kicks cluster-wide)
+            asyncio.get_running_loop().create_task(
+                self.discard_remote(clientid)
+            )
+            return True
+
+        async def attempt() -> bool:
+            if clientid in cm.channels or clientid in cm.pending:
+                return True
+            found = await self._query_takeover(clientid)
+            if found is None:
+                return False
+            data = found
+            session = session_from_dict(data)
+            exp = data.get("expire_at")
+            cm.pending[clientid] = (
+                session, exp if exp is not None else float("inf")
+            )
+            for f, opts in session.subscriptions.items():
+                self.broker.subscribe(clientid, f, opts)
+            return True
+
+        try:
+            return await self.locker.trans(
+                f"takeover:{clientid}", attempt, retries=10
+            )
+        except TimeoutError:
+            # lock unavailable (authority partitioned): best effort, like
+            # ekka_locker degrading rather than refusing connects
+            return await attempt()
+
+    async def _query_takeover(self, clientid: str):
+        """Concurrent per-peer takeover query; first found wins (any
+        second copy is already removed at its origin by the RPC itself,
+        which also makes duplicates self-heal)."""
+        peers = self.up_peers()
+        if not peers:
+            return None
+        results = await asyncio.gather(
+            *(
+                self.call(
+                    p, "session_takeover", {"clientid": clientid}, timeout=3.0
+                )
+                for p in peers
+            ),
+            return_exceptions=True,
+        )
+        found = None
+        for resp in results:
+            if isinstance(resp, dict) and resp.get("found"):
+                if found is None:
+                    found = resp["session"]
+        return found
+
+    async def discard_remote(self, clientid: str) -> None:
+        """clean_start: purge any remote copy of the session so a later
+        clean_start=false reconnect cannot resurrect stale state (the
+        reference's open_session discards cluster-wide via the registry).
+        Reuses the takeover RPC — the origin retracts routes and drops
+        the session; the pulled state is simply discarded.  Queries run
+        concurrently so one slow peer does not stall CONNACK."""
+        await self._query_takeover(clientid)
 
     def _rpc_publish(self, peer: str, params: dict) -> dict:
         """Remote-origin publish (management API proxying)."""

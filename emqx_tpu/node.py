@@ -111,6 +111,10 @@ class NodeRuntime:
                 discovery_ivl=discovery_ivl,
                 advertise_host=cluster_cfg.get("advertise_host"),
             )
+            from .cluster.cluster_rpc import ClusterRpc
+
+            # cluster-wide config mutation log (emqx_conf/emqx_cluster_rpc)
+            self.cluster_rpc = ClusterRpc(self.cluster)
         else:
             self.broker = Broker(retainer=retainer)
 

@@ -1,0 +1,212 @@
+"""Cross-node session takeover — emqx_cm:takeover_session (:320-361).
+
+A client's session (subscriptions + queued messages + inflight) follows
+it between nodes; the old node's routes are retracted, the old
+connection is kicked, and delivery resumes at the new home — over real
+sockets with real MQTT clients.
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.broker.message import Message
+from emqx_tpu.cluster import ClusterBroker, ClusterNode
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+async def wait_until(pred, timeout=10.0, ivl=0.02):
+    t = 0.0
+    while not pred():
+        await asyncio.sleep(ivl)
+        t += ivl
+        if t > timeout:
+            raise AssertionError("condition not reached")
+
+
+async def two_node_brokers():
+    nodes, listeners = [], []
+    for name in ("tk-a", "tk-b"):
+        b = ClusterBroker()
+        node = ClusterNode(name, b, heartbeat_ivl=0.2)
+        await node.start()
+        lst = Listener(b, port=0)
+        await lst.start()
+        nodes.append(node)
+        listeners.append(lst)
+    a, b = nodes
+    a.join("tk-b", ("127.0.0.1", b.transport.port))
+    b.join("tk-a", ("127.0.0.1", a.transport.port))
+    await wait_until(lambda: "tk-b" in a.up_peers() and "tk-a" in b.up_peers())
+    return nodes, listeners
+
+
+def test_parked_session_follows_reconnect_across_nodes(run):
+    async def main():
+        (na, nb), (la, lb) = await two_node_brokers()
+
+        c = MqttClient(clientid="mob-1", clean_start=False,
+                       properties={17: 300})  # session expiry 300s
+        await c.connect(port=la.port)
+        await c.subscribe("inbox/mob-1/#", qos=1)
+        await c.close()  # park on node A
+        await asyncio.sleep(0.1)
+
+        # publish on node B while the client is offline: forwarded to A,
+        # queued in the parked session
+        nb.broker.publish(
+            Message(topic="inbox/mob-1/note", payload=b"while-away", qos=1)
+        )
+        await wait_until(
+            lambda: len(na.broker.cm.pending["mob-1"][0].mqueue) == 1
+        )
+
+        # reconnect on NODE B: session (sub + queued msg) must follow
+        c2 = MqttClient(clientid="mob-1", clean_start=False)
+        ack = await c2.connect(port=lb.port)
+        assert ack.session_present
+        m = await asyncio.wait_for(c2.recv(), 5)
+        assert (m.topic, m.payload) == ("inbox/mob-1/note", b"while-away")
+        assert "mob-1" not in na.broker.cm.pending  # A released it
+
+        # routes moved: node A publishes now land via forward to B
+        na.broker.publish(
+            Message(topic="inbox/mob-1/x", payload=b"post-move", qos=1)
+        )
+        m = await asyncio.wait_for(c2.recv(), 5)
+        assert m.payload == b"post-move"
+        assert na.remote.filters_of("tk-b") >= {"inbox/mob-1/#"}
+
+        await c2.disconnect()
+        for x in (la, lb):
+            await x.stop()
+        for x in (na, nb):
+            await x.stop()
+
+    run(main())
+
+
+def test_live_session_stolen_across_nodes(run):
+    async def main():
+        (na, nb), (la, lb) = await two_node_brokers()
+
+        c1 = MqttClient(clientid="roam-7", clean_start=False,
+                        properties={17: 300})
+        await c1.connect(port=la.port)
+        await c1.subscribe("r/#", qos=1)
+
+        # same clientid reconnects on node B while still live on A
+        c2 = MqttClient(clientid="roam-7", clean_start=False)
+        ack = await c2.connect(port=lb.port)
+        assert ack.session_present  # stolen, not recreated
+        # old connection got kicked (DISCONNECT 0x8e then close)
+        await wait_until(lambda: c1.closed.is_set())
+        assert "roam-7" not in na.broker.cm.channels
+
+        nb.broker.publish(Message(topic="r/1", payload=b"to-new-home", qos=1))
+        m = await asyncio.wait_for(c2.recv(), 5)
+        assert m.payload == b"to-new-home"
+
+        await c2.disconnect()
+        for x in (la, lb):
+            await x.stop()
+        for x in (na, nb):
+            await x.stop()
+
+    run(main())
+
+
+def test_clean_start_does_not_drag_sessions(run):
+    async def main():
+        (na, nb), (la, lb) = await two_node_brokers()
+        c = MqttClient(clientid="cs-1", clean_start=False, properties={17: 60})
+        await c.connect(port=la.port)
+        await c.subscribe("cs/#", qos=1)
+        await c.close()
+        await asyncio.sleep(0.1)
+
+        # clean start on B: fresh session AND the stale copy on A is
+        # purged cluster-wide (a later clean_start=false reconnect must
+        # not resurrect pre-clean state)
+        c2 = MqttClient(clientid="cs-1", clean_start=True)
+        ack = await c2.connect(port=lb.port)
+        assert not ack.session_present
+        await wait_until(lambda: "cs-1" not in na.broker.cm.pending)
+        assert na.broker.route_count == 0  # A retracted the stale route
+        await c2.disconnect()
+        for x in (la, lb):
+            await x.stop()
+        for x in (na, nb):
+            await x.stop()
+
+    run(main())
+
+
+def test_unauthenticated_connect_cannot_steal_sessions(run):
+    """The cluster sync must run AFTER authentication: a bad-credential
+    CONNECT with a victim's clientid must neither kick nor pull the
+    victim's session from its home node."""
+
+    async def main():
+        from emqx_tpu.authn import AuthChain, BuiltInAuthenticator
+
+        nodes, listeners = [], []
+        for name in ("au-a", "au-b"):
+            b = ClusterBroker()
+            chain = AuthChain(allow_anonymous=False)
+            auth = BuiltInAuthenticator()
+            auth.add_user("good", "pw")
+            chain.add(auth)
+            chain.install(b.hooks)
+            node = ClusterNode(name, b, heartbeat_ivl=0.2)
+            await node.start()
+            lst = Listener(b, port=0)
+            await lst.start()
+            nodes.append(node)
+            listeners.append(lst)
+        (na, nb), (la, lb) = nodes, listeners
+        na.join("au-b", ("127.0.0.1", nb.transport.port))
+        nb.join("au-a", ("127.0.0.1", na.transport.port))
+        await wait_until(
+            lambda: "au-b" in na.up_peers() and "au-a" in nb.up_peers()
+        )
+
+        victim = MqttClient(clientid="victim", clean_start=False,
+                            username="good", password=b"pw",
+                            properties={17: 300})
+        await victim.connect(port=la.port)
+        await victim.subscribe("v/#", qos=1)
+
+        # attacker with bad credentials, both clean_start variants
+        for clean in (True, False):
+            bad = MqttClient(clientid="victim", clean_start=clean,
+                             username="good", password=b"WRONG")
+            try:
+                await bad.connect(port=lb.port)
+                raise AssertionError("bad credentials accepted")
+            except Exception:
+                pass
+        await asyncio.sleep(0.3)
+        # victim untouched: still connected on A, session not migrated
+        assert "victim" in na.broker.cm.channels
+        assert "victim" not in nb.broker.cm.pending
+        assert not victim.closed.is_set()
+        nb.broker.publish(Message(topic="v/ok", payload=b"intact", qos=1))
+        m = await asyncio.wait_for(victim.recv(), 5)
+        assert m.payload == b"intact"
+
+        await victim.disconnect()
+        for x in listeners:
+            await x.stop()
+        for x in nodes:
+            await x.stop()
+
+    run(main())
