@@ -50,6 +50,8 @@ class ManagementApi:
         telemetry=None,
         monitor=None,
         rule_engine=None,
+        authn=None,
+        authz=None,
     ):
         self.broker = broker
         self.node = node
@@ -68,6 +70,8 @@ class ManagementApi:
         self.telemetry = telemetry
         self.monitor = monitor
         self.rule_engine = rule_engine
+        self.authn = authn
+        self.authz = authz
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -120,6 +124,18 @@ class ManagementApi:
         r("PUT", "/telemetry/status", self.telemetry_set, doc="Toggle telemetry")
         r("GET", "/telemetry/data", self.telemetry_data, doc="Telemetry report")
         r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+        r("GET", "/authentication", self.authn_list,
+          doc="Authenticator chain")
+        r("GET", "/authentication/{name}/users", self.authn_users,
+          doc="Built-in database users")
+        r("POST", "/authentication/{name}/users", self.authn_user_add,
+          doc="Add a user")
+        r("DELETE", "/authentication/{name}/users/{user_id}",
+          self.authn_user_del, doc="Delete a user")
+        r("GET", "/authorization/sources", self.authz_list,
+          doc="ACL source chain")
+        r("POST", "/authorization/sources/built_in_database/rules",
+          self.authz_rule_add, doc="Add a built-in ACL rule")
         r("GET", "/rules", self.rules_list, doc="Rule list with metrics")
         r("POST", "/rules", self.rule_create, doc="Create a rule")
         r("GET", "/rules/{rule_id}", self.rule_get, doc="One rule")
@@ -545,6 +561,111 @@ class ManagementApi:
         if self.slow_subs is None:
             raise HttpError(404, "slow_subs disabled")
         return self.slow_subs.top()
+
+    # ----------------------------------------------------------- authn/authz
+
+    def authn_list(self, req: Request):
+        chain = self._need("authn")
+        return {
+            "allow_anonymous": chain.allow_anonymous,
+            "authenticators": [
+                {"name": a.name, "backend": type(a).__name__}
+                for a in chain.authenticators
+            ],
+        }
+
+    def _builtin_authenticator(self, name: str):
+        chain = self._need("authn")
+        for a in chain.authenticators:
+            if a.name == name:
+                if not hasattr(a, "users"):
+                    raise HttpError(400, f"{name!r} has no user store")
+                return a
+        raise HttpError(404, f"no authenticator {name!r}")
+
+    def authn_users(self, req: Request):
+        a = self._builtin_authenticator(req.params["name"])
+        return paginate(
+            [
+                {"user_id": uid, "is_superuser": rec.is_superuser}
+                for uid, rec in sorted(a.users.items())
+            ],
+            req,
+        )
+
+    _HASH_ALGOS = ("pbkdf2_sha256", "sha256", "sha512", "plain", "bcrypt")
+
+    def authn_user_add(self, req: Request):
+        a = self._builtin_authenticator(req.params["name"])
+        body = req.json() or {}
+        uid, pw = body.get("user_id"), body.get("password")
+        if not isinstance(uid, str) or not uid or not isinstance(pw, str) or not pw:
+            raise HttpError(400, "user_id and password (strings) required")
+        if uid in a.users:
+            raise HttpError(400, "user exists")
+        algo = body.get("algorithm", "pbkdf2_sha256")
+        if algo not in self._HASH_ALGOS:
+            raise HttpError(
+                400, f"unsupported algorithm {algo!r}; "
+                     f"one of {list(self._HASH_ALGOS)}"
+            )
+        a.add_user(
+            uid,
+            pw,
+            is_superuser=bool(body.get("is_superuser")),
+            algorithm=algo,
+        )
+        return {"user_id": uid}
+
+    def authn_user_del(self, req: Request):
+        a = self._builtin_authenticator(req.params["name"])
+        if not a.delete_user(req.params["user_id"]):
+            raise HttpError(404, "no such user")
+        return None
+
+    def authz_list(self, req: Request):
+        chain = self._need("authz")
+        return {
+            "no_match": chain.default,
+            "sources": [
+                {"type": s.name, "enabled": s.enabled} for s in chain.sources
+            ],
+        }
+
+    def authz_rule_add(self, req: Request):
+        from ..authz import BuiltInSource, Rule
+
+        chain = self._need("authz")
+        src = next(
+            (s for s in chain.sources if isinstance(s, BuiltInSource)), None
+        )
+        if src is None:
+            raise HttpError(404, "no built_in_database authz source")
+        body = req.json() or {}
+        permission = body.get("permission", "allow")
+        if permission not in ("allow", "deny"):
+            raise HttpError(400, "permission must be 'allow' or 'deny'")
+        action = body.get("action", "all")
+        if action not in ("publish", "subscribe", "all"):
+            raise HttpError(400, "action must be publish|subscribe|all")
+        topics = body.get("topics")
+        if not isinstance(topics, list) or not topics or not all(
+            isinstance(t, str) and t for t in topics
+        ):
+            raise HttpError(400, "topics must be a non-empty list of filters")
+        rule = Rule(
+            permission=permission,
+            who="all",
+            action=action,
+            topics=list(topics),
+        )
+        if body.get("clientid"):
+            src.by_clientid.setdefault(body["clientid"], []).append(rule)
+        elif body.get("username"):
+            src.by_username.setdefault(body["username"], []).append(rule)
+        else:
+            src.all_rules.append(rule)
+        return {"ok": True}
 
     # ---------------------------------------------------------------- rules
 

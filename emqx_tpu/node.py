@@ -266,6 +266,8 @@ class NodeRuntime:
             psk=self.psk,
             monitor=self.monitor,
             rule_engine=self.rule_engine,
+            authn=self.authn,
+            authz=self.authz,
         )
         self.http = HttpApi(
             port=self.conf.get("dashboard.listen_port"),

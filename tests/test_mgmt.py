@@ -350,3 +350,114 @@ def test_rules_rest_crud(run):
         await lst.stop()
 
     run(main())
+
+
+def test_authn_authz_rest(run):
+    async def main():
+        from emqx_tpu.authn import AuthChain, BuiltInAuthenticator
+        from emqx_tpu.authz import AuthzChain, BuiltInSource
+
+        b = Broker()
+        lst = Listener(b, port=0)
+        await lst.start()
+        tokens = TokenStore()
+        tokens.add_admin("admin", "public123")
+        chain = AuthChain(allow_anonymous=False)
+        chain.add(BuiltInAuthenticator())
+        chain.install(b.hooks)
+        az = AuthzChain()
+        az.add(BuiltInSource())
+        az.install(b.hooks)
+        api = ManagementApi(b, node="n0", tokens=tokens, authn=chain, authz=az)
+        srv = HttpApi(port=0, auth=api.auth_check)
+        api.install(srv)
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.port}/api/v5"
+        st, body = await asyncio.to_thread(
+            http, "POST", base + "/login",
+            {"username": "admin", "password": "public123"})
+        tok = body["token"]
+
+        st, info = await asyncio.to_thread(
+            http, "GET", base + "/authentication", None, tok)
+        assert st == 200 and not info["allow_anonymous"]
+        name = info["authenticators"][0]["name"]
+        st, _ = await asyncio.to_thread(
+            http, "POST", f"{base}/authentication/{name}/users",
+            {"user_id": "dev1", "password": "pw1"}, tok)
+        assert st == 200
+        st, users = await asyncio.to_thread(
+            http, "GET", f"{base}/authentication/{name}/users", None, tok)
+        assert users["data"] == [{"user_id": "dev1", "is_superuser": False}]
+
+        # the REST-created user can actually connect
+        c = MqttClient(clientid="dev1", username="dev1", password=b"pw1")
+        ack = await c.connect(port=lst.port)
+        assert ack.reason_code == 0
+
+        # add a deny rule over REST and watch authz enforce it
+        st, _ = await asyncio.to_thread(
+            http, "POST",
+            base + "/authorization/sources/built_in_database/rules",
+            {"permission": "deny", "action": "subscribe",
+             "topics": ["secret/#"], "username": "dev1"}, tok)
+        assert st == 200
+        assert (await c.subscribe("secret/x"))[0] in (0x80, 0x87)  # denied
+        assert (await c.subscribe("open/x"))[0] == 0
+
+        st, _ = await asyncio.to_thread(
+            http, "DELETE", f"{base}/authentication/{name}/users/dev1",
+            None, tok)
+        assert st in (200, 204)
+        bad = MqttClient(clientid="dev2", username="dev1", password=b"pw1")
+        with pytest.raises(Exception):
+            await bad.connect(port=lst.port)
+        await c.disconnect()
+        await srv.stop()
+        await lst.stop()
+
+    run(main())
+
+
+def test_authn_authz_rest_validation(run):
+    async def main():
+        from emqx_tpu.authn import AuthChain, BuiltInAuthenticator
+        from emqx_tpu.authz import AuthzChain, BuiltInSource
+
+        b = Broker()
+        tokens = TokenStore()
+        tokens.add_admin("admin", "public123")
+        chain = AuthChain()
+        chain.add(BuiltInAuthenticator())
+        az = AuthzChain()
+        az.add(BuiltInSource())
+        api = ManagementApi(b, node="n0", tokens=tokens, authn=chain, authz=az)
+        srv = HttpApi(port=0, auth=api.auth_check)
+        api.install(srv)
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.port}/api/v5"
+        st, body = await asyncio.to_thread(
+            http, "POST", base + "/login",
+            {"username": "admin", "password": "public123"})
+        tok = body["token"]
+        name = chain.authenticators[0].name
+        # malformed user bodies -> 400, not 500
+        for bad in ({"user_id": "u", "password": "p", "algorithm": "md5"},
+                    {"user_id": "u", "password": 123},
+                    {"user_id": "", "password": "p"}):
+            st, _ = await asyncio.to_thread(
+                http, "POST", f"{base}/authentication/{name}/users", bad, tok)
+            assert st == 400, bad
+        # malformed acl rules -> 400 (a silently-inert deny is a hole)
+        for bad in ({"permission": "Deny", "topics": ["t"]},
+                    {"action": "sub", "topics": ["t"]},
+                    {"permission": "deny", "topics": "secret/#"},
+                    {"permission": "deny"}):
+            st, _ = await asyncio.to_thread(
+                http, "POST",
+                base + "/authorization/sources/built_in_database/rules",
+                bad, tok)
+            assert st == 400, bad
+        await srv.stop()
+
+    run(main())
