@@ -52,6 +52,7 @@ class ManagementApi:
         rule_engine=None,
         authn=None,
         authz=None,
+        gateways=None,
     ):
         self.broker = broker
         self.node = node
@@ -72,6 +73,7 @@ class ManagementApi:
         self.rule_engine = rule_engine
         self.authn = authn
         self.authz = authz
+        self.gateways = gateways
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -124,6 +126,10 @@ class ManagementApi:
         r("PUT", "/telemetry/status", self.telemetry_set, doc="Toggle telemetry")
         r("GET", "/telemetry/data", self.telemetry_data, doc="Telemetry report")
         r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+        r("GET", "/gateways", self.gateways_list,
+          doc="Gateway instances + listen addresses")
+        r("GET", "/gateways/{name}/clients", self.gateway_clients,
+          doc="One gateway's connected clients")
         r("GET", "/authentication", self.authn_list,
           doc="Authenticator chain")
         r("GET", "/authentication/{name}/users", self.authn_users,
@@ -561,6 +567,54 @@ class ManagementApi:
         if self.slow_subs is None:
             raise HttpError(404, "slow_subs disabled")
         return self.slow_subs.top()
+
+    # -------------------------------------------------------------- gateways
+
+    @staticmethod
+    def _gateway_cm(gw):
+        ctx = getattr(gw, "ctx", None)
+        return getattr(ctx, "cm", None)
+
+    def gateways_list(self, req: Request):
+        reg = self._need("gateways")
+        out = []
+        for name in reg.list():
+            gw = reg.lookup(name)
+            cm = self._gateway_cm(gw)
+            out.append(
+                {
+                    "name": name,
+                    "type": type(gw).__name__,
+                    "host": getattr(gw, "host", None),
+                    "port": getattr(gw, "port", None),
+                    "clients": len(cm.channels) if cm is not None else None,
+                }
+            )
+        return {"data": out}
+
+    def gateway_clients(self, req: Request):
+        reg = self._need("gateways")
+        gw = reg.lookup(req.params["name"])
+        if gw is None:
+            raise HttpError(404, "no such gateway")
+        cm = self._gateway_cm(gw)
+        if cm is None:
+            return paginate([], req)
+        rows = []
+        for cid, ch in sorted(cm.channels.items()):
+            ci = getattr(ch, "clientinfo", None)
+            rows.append(
+                {
+                    "clientid": cid,
+                    "username": getattr(ci, "username", None),
+                    "peerhost": getattr(ci, "peerhost", None),
+                    "subscriptions": len(
+                        getattr(getattr(ch, "session", None),
+                                "subscriptions", {}) or {}
+                    ),
+                }
+            )
+        return paginate(rows, req)
 
     # ----------------------------------------------------------- authn/authz
 

@@ -268,6 +268,7 @@ class NodeRuntime:
             rule_engine=self.rule_engine,
             authn=self.authn,
             authz=self.authz,
+            gateways=self.gateways,
         )
         self.http = HttpApi(
             port=self.conf.get("dashboard.listen_port"),

@@ -370,3 +370,57 @@ def test_gateways_from_config(run, tmp_path):
         await node.stop()
 
     run(main())
+
+
+def test_gateway_rest_endpoints(run, tmp_path):
+    async def main():
+        import struct
+        import urllib.request
+
+        from emqx_tpu.gateway import mqttsn as sn
+
+        conf = {
+            "listeners": [{"type": "tcp", "host": "127.0.0.1", "port": 0}],
+            "dashboard": {"listen_port": 0, "default_password": "gw-pw-123"},
+            "node": {"data_dir": str(tmp_path)},
+            "gateways": [{"type": "mqttsn", "port": 0}],
+        }
+        node = NodeRuntime(conf)
+        await node.start()
+        snp = node.gateways.lookup("mqttsn").port
+
+        class Udp(asyncio.DatagramProtocol):
+            def __init__(self):
+                self.inbox = asyncio.Queue()
+
+            def datagram_received(self, data, addr):
+                self.inbox.put_nowait(sn.parse(data))
+
+        loop = asyncio.get_running_loop()
+        udp = Udp()
+        tr, _ = await loop.create_datagram_endpoint(
+            lambda: udp, remote_addr=("127.0.0.1", snp))
+        tr.sendto(sn.mk(sn.CONNECT, bytes([sn.FLAG_CLEAN, 1])
+                        + struct.pack("!H", 60) + b"sn-rest"))
+        await asyncio.wait_for(udp.inbox.get(), 5)
+
+        base = f"http://127.0.0.1:{node.http.port}/api/v5"
+        st, body = await asyncio.to_thread(
+            http, "POST", f"{base}/login",
+            {"username": "admin", "password": "gw-pw-123"})
+        tok = body["token"]
+        st, gws = await asyncio.to_thread(
+            http, "GET", f"{base}/gateways", None, tok)
+        assert st == 200
+        entry = next(g for g in gws["data"] if g["name"] == "mqttsn")
+        assert entry["port"] == snp and entry["clients"] == 1
+        st, cl = await asyncio.to_thread(
+            http, "GET", f"{base}/gateways/mqttsn/clients", None, tok)
+        assert [c["clientid"] for c in cl["data"]] == ["sn-rest"]
+        st, _ = await asyncio.to_thread(
+            http, "GET", f"{base}/gateways/nope/clients", None, tok)
+        assert st == 404
+        tr.close()
+        await node.stop()
+
+    run(main())
