@@ -29,15 +29,18 @@ from .packet import SubOpts
 from .retainer import Retainer
 from .session import Session
 from .shared_sub import SharedSub
+from .subshard import SubscriberShards
 from ..models.engine import TopicMatchEngine
 
 
 @dataclass
 class Route:
-    """Host-side fan-out record for one unique filter (one fid)."""
+    """Host-side fan-out record for one unique filter (one fid).
+
+    Direct subscribers live in the broker's `SubscriberShards` expansion
+    layer (the `emqx_broker_helper` analog), keyed by the same fid."""
 
     filt: str
-    direct: Set[str] = field(default_factory=set)  # clientids
     groups: Set[str] = field(default_factory=set)  # shared groups
 
 
@@ -58,6 +61,7 @@ class Broker:
         self.shared = shared or SharedSub()
         self.metrics = metrics or Metrics()
         self._routes: Dict[int, Route] = {}  # fid -> fan-out record
+        self.subs = SubscriberShards()  # fid -> sharded subscriber lists
         self._sub_count = 0
         self.cm.on_discard = self._on_discard_session
         # exact-match guarantee: surface discarded hash collisions
@@ -79,7 +83,12 @@ class Broker:
     # -------------------------------------------------------- subscribe
 
     def subscribe(self, clientid: str, filt: str, opts: SubOpts) -> None:
-        """Register one subscription (parses $share/$queue prefixes)."""
+        """Register one subscription (parses $share/$queue prefixes).
+
+        The engine's filter refcount mirrors UNIQUE memberships exactly:
+        a duplicate subscribe (same client, same filter) takes no extra
+        reference, so a later unsubscribe can never free a fid that
+        routes/subscribers still use."""
         group, real = topiclib.parse_share(filt)
         fid = self.engine.add_filter(real)
         route = self._routes.get(fid)
@@ -88,14 +97,15 @@ class Broker:
             if self.on_route_added is not None:
                 self.on_route_added(real)
         if group is None:
-            if clientid not in route.direct:
-                self._sub_count += 1
-            route.direct.add(clientid)
+            added = self.subs.add(fid, clientid)
         else:
-            if not self.shared.is_member(group, real, clientid):
-                self._sub_count += 1
+            added = not self.shared.is_member(group, real, clientid)
             self.shared.subscribe(group, real, clientid)
             route.groups.add(group)
+        if added:
+            self._sub_count += 1
+        else:
+            self.engine.remove_filter(real)  # duplicate: drop the extra ref
         self.metrics.gauge_set("subscriptions.count", self._sub_count)
         self.hooks.run("session.subscribed", (clientid, filt, opts))
 
@@ -105,21 +115,24 @@ class Broker:
         if fid is None:
             return
         route = self._routes.get(fid)
+        removed = False
         if route is not None:
             if group is None:
-                if clientid in route.direct:
-                    self._sub_count -= 1
-                route.direct.discard(clientid)
+                removed = self.subs.remove(fid, clientid)
             else:
-                if self.shared.is_member(group, real, clientid):
-                    self._sub_count -= 1
+                removed = self.shared.is_member(group, real, clientid)
                 if self.shared.unsubscribe(group, real, clientid):
                     route.groups.discard(group)
-            if not route.direct and not route.groups:
+            if removed:
+                self._sub_count -= 1
+            if not self.subs.count(fid) and not route.groups:
                 del self._routes[fid]
                 if self.on_route_removed is not None:
                     self.on_route_removed(real)
-        self.engine.remove_filter(real)
+        if removed:
+            # only an actual membership drops an engine reference — an
+            # unsubscribe from a never-subscribed client is a no-op
+            self.engine.remove_filter(real)
         self.metrics.gauge_set("subscriptions.count", self._sub_count)
         self.hooks.run("session.unsubscribed", (clientid, filt))
 
@@ -194,17 +207,19 @@ class Broker:
                 self.hooks.run("message.dropped", (msg, "no_subscribers"))
 
     def _dispatch(self, msg: Message, fids: Set[int]) -> int:
-        """Expand matched fids to receivers and deliver (`do_dispatch`)."""
-        # receiver -> list of matched filters (a client may match many)
-        per_client: Dict[str, List[str]] = {}
+        """Expand matched fids to receivers and deliver (`do_dispatch`).
+
+        Expansion is vectorized through the subscriber-shard layer: one
+        concatenate over the matched fids' bucket arrays + one grouping
+        pass, so per-receiver cost is a single delivery call regardless
+        of fan-out (`emqx_broker.erl:499-524` without per-sub dict ops)."""
+        fid_filts = []
         for fid in fids:
             route = self._routes.get(fid)
-            if route is None:
-                continue
-            for cid in route.direct:
-                per_client.setdefault(cid, []).append(route.filt)
+            if route is not None:
+                fid_filts.append((fid, route.filt))
         n = 0
-        for cid, filts in per_client.items():
+        for cid, filts in self.subs.expand(fid_filts):
             n += self._deliver_to(cid, filts, msg)
         # shared groups deliver one-at-a-time with failover so a dead
         # pick redispatches to a peer (`emqx_shared_sub:dispatch` retry)

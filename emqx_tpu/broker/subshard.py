@@ -1,0 +1,226 @@
+"""Subscriber-shard expansion layer — the `emqx_broker_helper` analog.
+
+The reference splits one topic's subscriber list into shard buckets once it
+passes 1024 subscribers (`emqx_broker_helper.erl:54,82-91`), and dispatch
+folds the main table plus the shard buckets (`emqx_broker.erl:520-524`).
+Here the same layer sits host-side between the device match engine and
+session delivery:
+
+* clientids are interned to dense int32 uids (refcounted across filters);
+* each fid owns a main bucket plus, past the shard threshold, hashed
+  shard buckets — every bucket is an amortized-growth numpy array with
+  O(1) add and swap-delete;
+* expansion of matched fids to receivers is vectorized: one concatenate
+  over the bucket views + one stable argsort to group clients that match
+  several filters — per-receiver cost is a single delivery call, flat in
+  fan-out (the `emqx_broker.erl:499-524` hot loop without per-subscriber
+  dict churn).
+
+(The sharded device engine's per-fid ``dest`` ids in
+`parallel/sharded.py` are a separate, per-FID accounting dimension for
+the `psum_scatter` fan-out merge; host buckets here shard per-CLIENT.
+Dispatch uses the compact matched-fid return, not the device counts.)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+SHARD_THRESHOLD = 1024  # emqx_broker_helper.erl:54 (shard past 1024 subs)
+NSHARDS = 32  # reference: schedulers x 32; fixed host-side
+
+
+class _Bucket:
+    """Append-friendly int32 set: amortized append + swap-delete."""
+
+    __slots__ = ("arr", "n", "pos")
+
+    def __init__(self) -> None:
+        self.arr = np.empty(8, dtype=np.int32)
+        self.n = 0
+        self.pos: Dict[int, int] = {}
+
+    def add(self, uid: int) -> None:
+        if self.n == len(self.arr):
+            grown = np.empty(len(self.arr) * 2, dtype=np.int32)
+            grown[: self.n] = self.arr
+            self.arr = grown
+        self.arr[self.n] = uid
+        self.pos[uid] = self.n
+        self.n += 1
+
+    def remove(self, uid: int) -> None:
+        i = self.pos.pop(uid)
+        last = self.n - 1
+        if i != last:
+            moved = self.arr[last]
+            self.arr[i] = moved
+            self.pos[int(moved)] = i
+        self.n = last
+
+    def view(self) -> np.ndarray:
+        return self.arr[: self.n]
+
+
+class SubscriberShards:
+    """fid -> sharded subscriber-uid buckets + uid <-> clientid interning."""
+
+    def __init__(
+        self, threshold: int = SHARD_THRESHOLD, nshards: int = NSHARDS
+    ) -> None:
+        self.threshold = threshold
+        self.nshards = nshards
+        self._uids: Dict[str, int] = {}
+        self._cids: List[str] = []
+        self._uid_refs: List[int] = []
+        self._free_uids: List[int] = []
+        # fid -> [main bucket, shard buckets...] (shards appear lazily)
+        self._fids: Dict[int, List[_Bucket]] = {}
+        self._counts: Dict[int, int] = {}
+
+    # ------------------------------------------------------------- intern
+
+    def _intern(self, cid: str) -> int:
+        uid = self._uids.get(cid)
+        if uid is not None:
+            self._uid_refs[uid] += 1
+            return uid
+        if self._free_uids:
+            uid = self._free_uids.pop()
+            self._cids[uid] = cid
+            self._uid_refs[uid] = 1
+        else:
+            uid = len(self._cids)
+            self._cids.append(cid)
+            self._uid_refs.append(1)
+        self._uids[cid] = uid
+        return uid
+
+    def _release(self, uid: int) -> None:
+        self._uid_refs[uid] -= 1
+        if self._uid_refs[uid] == 0:
+            del self._uids[self._cids[uid]]
+            self._cids[uid] = ""
+            self._free_uids.append(uid)
+
+    def cid_of(self, uid: int) -> str:
+        return self._cids[uid]
+
+    # -------------------------------------------------------------- shard
+
+    def _shard_of(self, fid: int, uid: int) -> int:
+        """0 = main bucket; >0 only once the fid crossed the threshold
+        (`emqx_broker_helper:get_sub_shard/2`: existing subs stay put)."""
+        if self._counts.get(fid, 0) < self.threshold:
+            return 0
+        return 1 + (uid * 0x9E3779B1 & 0xFFFFFFFF) % self.nshards
+
+    # ----------------------------------------------------------- mutation
+
+    def add(self, fid: int, cid: str) -> bool:
+        """Returns False (no-op) when the client already subscribes."""
+        uid = self._uids.get(cid)
+        buckets = self._fids.get(fid)
+        if uid is not None and buckets is not None:
+            for b in buckets:
+                if uid in b.pos:
+                    return False
+        if buckets is None:
+            buckets = self._fids[fid] = [_Bucket()]
+        uid = self._intern(cid)
+        shard = self._shard_of(fid, uid)
+        while len(buckets) <= shard:
+            buckets.append(_Bucket())
+        buckets[shard].add(uid)
+        self._counts[fid] = self._counts.get(fid, 0) + 1
+        return True
+
+    def remove(self, fid: int, cid: str) -> bool:
+        uid = self._uids.get(cid)
+        buckets = self._fids.get(fid)
+        if uid is None or buckets is None:
+            return False
+        for b in buckets:
+            if uid in b.pos:
+                b.remove(uid)
+                self._counts[fid] -= 1
+                if self._counts[fid] == 0:
+                    del self._fids[fid]
+                    del self._counts[fid]
+                self._release(uid)
+                return True
+        return False
+
+    def contains(self, fid: int, cid: str) -> bool:
+        uid = self._uids.get(cid)
+        buckets = self._fids.get(fid)
+        if uid is None or buckets is None:
+            return False
+        return any(uid in b.pos for b in buckets)
+
+    def count(self, fid: int) -> int:
+        return self._counts.get(fid, 0)
+
+    def n_shards_of(self, fid: int) -> int:
+        return len(self._fids.get(fid, ()))
+
+    # ---------------------------------------------------------- expansion
+
+    def uids(self, fid: int) -> np.ndarray:
+        """All subscriber uids of one fid (view when unsharded)."""
+        buckets = self._fids.get(fid)
+        if buckets is None:
+            return np.empty(0, dtype=np.int32)
+        if len(buckets) == 1:
+            return buckets[0].view()
+        return np.concatenate([b.view() for b in buckets])
+
+    def clients(self, fid: int) -> Iterable[str]:
+        cids = self._cids
+        for uid in self.uids(fid).tolist():
+            yield cids[uid]
+
+    def expand(
+        self, fid_filts: Sequence[Tuple[int, str]]
+    ) -> List[Tuple[str, List[str]]]:
+        """Vectorized fan-out: matched (fid, filter) pairs -> per-receiver
+        (clientid, [matched filters]) with clients grouped across fids.
+
+        One concatenate + one stable argsort; a client subscribing to k of
+        the matched filters appears once with all k (mirrors the reference
+        delivering per SubPid after folding shard buckets)."""
+        views: List[np.ndarray] = []
+        filts: List[str] = []
+        for fid, filt in fid_filts:
+            u = self.uids(fid)
+            if u.size:
+                views.append(u)
+                filts.append(filt)
+        if not views:
+            return []
+        cids = self._cids
+        if len(views) == 1:
+            f = filts[0]
+            return [(cids[uid], [f]) for uid in views[0].tolist()]
+        all_u = np.concatenate(views)
+        seg = np.repeat(
+            np.arange(len(views)), [v.size for v in views]
+        )
+        order = np.argsort(all_u, kind="stable")
+        su = all_u[order]
+        ss = seg[order]
+        out: List[Tuple[str, List[str]]] = []
+        i = 0
+        n = su.size
+        su_l = su.tolist()
+        ss_l = ss.tolist()
+        while i < n:
+            j = i + 1
+            uid = su_l[i]
+            while j < n and su_l[j] == uid:
+                j += 1
+            out.append((cids[uid], [filts[k] for k in ss_l[i:j]]))
+            i = j
+        return out

@@ -126,6 +126,12 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "idle_timeout": Field("duration", 15.0),
     },
     "broker": {
+        "engine": Field(
+            "enum",
+            "single",
+            enum=["single", "sharded"],
+            desc="match engine: single-chip or mesh-sharded (multi-chip)",
+        ),
         "shared_subscription_strategy": Field(
             "enum",
             "random",

@@ -35,6 +35,26 @@ from ..ops.tables import MatchTables
 from .reference import CpuTrieIndex
 
 
+def verify_hits(twords, fids, words_map):
+    """Split device hash hits into (verified, collisions).
+
+    The device compares 2x32-bit lane hashes; an astronomically-rare lane
+    collision between a topic and an unrelated same-shape filter would
+    otherwise cause a false delivery.  The reference trie is exact
+    (`emqx_trie.erl:272-334`); this check keeps that guarantee for every
+    engine frontend (single-chip and sharded)."""
+    good: List[int] = []
+    bad: List[int] = []
+    for f in fids:
+        fid = int(f)
+        fwords = words_map.get(fid)
+        if fwords is not None and topiclib.match_words(twords, fwords):
+            good.append(fid)
+        else:
+            bad.append(fid)
+    return good, bad
+
+
 class TopicMatchEngine:
     def __init__(
         self,
@@ -296,18 +316,14 @@ class TopicMatchEngine:
                 if not hits.size:
                     continue
                 if self.verify_matches:
-                    twords = topiclib.words(topics[i])
-                    for f in hits:
-                        fid = int(f)
-                        fwords = self._words.get(fid)
-                        if fwords is not None and topiclib.match_words(
-                            twords, fwords
-                        ):
-                            out[i].add(fid)
-                        else:
-                            self.collision_count += 1
-                            if self.on_collision is not None:
-                                self.on_collision(topics[i], fid)
+                    good, bad = verify_hits(
+                        topiclib.words(topics[i]), hits, self._words
+                    )
+                    out[i].update(good)
+                    self.collision_count += len(bad)
+                    if self.on_collision is not None:
+                        for fid in bad:
+                            self.on_collision(topics[i], fid)
                 else:
                     out[i].update(int(f) for f in hits)
 

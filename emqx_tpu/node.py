@@ -73,12 +73,31 @@ class NodeRuntime:
             max_payload=self.conf.get("retainer.max_payload_size"),
             enable=self.conf.get("retainer.enable"),
         )
+        # engine choice: single-chip TopicMatchEngine (default) or the
+        # mesh-sharded engine over every visible device (the v5e-8 path)
+        from .ops.hashing import HashSpace
+
+        space = HashSpace(max_levels=self.conf.get("engine.max_levels"))
+        if self.conf.get("broker.engine") == "sharded":
+            from .parallel.sharded import ShardedMatchEngine
+
+            engine = ShardedMatchEngine(
+                space=space,
+                n_sub_shards=self.conf.get("engine.n_sub_shards"),
+                min_batch=self.conf.get("engine.min_batch"),
+            )
+        else:
+            from .models.engine import TopicMatchEngine
+
+            engine = TopicMatchEngine(
+                space=space, min_batch=self.conf.get("engine.min_batch")
+            )
         cluster_cfg = raw.get("cluster") or {}
         self.cluster = None
         if cluster_cfg.get("enable"):
             from .cluster.node import ClusterBroker, ClusterNode
 
-            self.broker: Broker = ClusterBroker(retainer=retainer)
+            self.broker: Broker = ClusterBroker(engine=engine, retainer=retainer)
             peers = {
                 name: (addr[0], int(addr[1]))
                 for name, addr in (cluster_cfg.get("peers") or {}).items()
@@ -116,7 +135,7 @@ class NodeRuntime:
             # cluster-wide config mutation log (emqx_conf/emqx_cluster_rpc)
             self.cluster_rpc = ClusterRpc(self.cluster)
         else:
-            self.broker = Broker(retainer=retainer)
+            self.broker = Broker(engine=engine, retainer=retainer)
 
         # ---- persistence (5.4 checkpoint/resume) -----------------------
         self.persistence = None

@@ -153,6 +153,41 @@ def sharded_step(
     )(stacked, delta_slots, delta_ka, delta_kb, delta_val, batch, dest)
 
 
+@functools.partial(jax.jit, static_argnames=("mesh", "kcap"))
+def sharded_match_compact(
+    stacked: DeviceTables,
+    batch: TopicBatch,
+    *,
+    mesh: Mesh,
+    kcap: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Dispatch-oriented device->host return: compact matched pairs.
+
+    Each chip compacts its local [B, M] shape-hit row to its top
+    ``min(kcap, M)`` fids (filter partitions are disjoint, so the union
+    across chips is exact), plus a per-topic local hit count so the host
+    can detect the rare per-chip overflow and fall back to the full
+    return.  Transfers [D, B, k] + [D, B] instead of [D, B, M] — the
+    contract `emqx_broker:dispatch` needs (matched fids), at a size the
+    tunnel can afford.
+    """
+    M = stacked.k_a.shape[-1]
+    k = min(kcap, M)
+
+    def local(st, b):
+        matched = match_batch(_unstack(st), b)  # [B, M]
+        counts = jnp.sum(matched >= 0, axis=-1, dtype=jnp.int32)
+        top, _ = jax.lax.top_k(matched, k)  # sorted desc; -1 pads
+        return top[None], counts[None]
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(FILTER_AXIS), P()),
+        out_specs=(P(FILTER_AXIS), P(FILTER_AXIS)),
+    )(stacked, batch)
+
+
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def sharded_match_fids(
     stacked: DeviceTables,
@@ -186,6 +221,7 @@ class ShardedMatchEngine:
         space: Optional[hashing.HashSpace] = None,
         n_sub_shards: int = 1024,
         min_batch: int = 64,
+        kcap: int = 128,
     ):
         self.mesh = mesh or make_mesh()
         self.space = space or hashing.HashSpace()
@@ -194,12 +230,19 @@ class ShardedMatchEngine:
             n_sub_shards += self.D - n_sub_shards % self.D
         self.n_sub = n_sub_shards
         self.min_batch = min_batch
+        self.kcap = kcap  # per-chip compact-return cap (match())
 
         self.shards = [MatchTables(self.space) for _ in range(self.D)]
         self._fids: Dict[str, int] = {}
         self._refs: Dict[int, int] = {}
+        self._words: Dict[int, List[str]] = {}
         self._next_fid = 0
         self._free_fids: List[int] = []
+
+        # exact-match guarantee (same contract as TopicMatchEngine)
+        self.verify_matches = True
+        self.collision_count = 0
+        self.on_collision = None
         self._dest_cap = 1024
         self._dest = np.zeros(self._dest_cap, dtype=np.int32)
         self._dest_dirty = True
@@ -211,6 +254,9 @@ class ShardedMatchEngine:
         self._dest_dev: Optional[jax.Array] = None
 
     # ----------------------------------------------------------- mutation
+
+    def fid_of(self, filt: str) -> Optional[int]:
+        return self._fids.get(filt)
 
     def add_filter(self, filt: str, sub_shard: Optional[int] = None) -> int:
         fid = self._fids.get(filt)
@@ -231,6 +277,7 @@ class ShardedMatchEngine:
             self._next_fid += 1
         self._fids[filt] = fid
         self._refs[fid] = 1
+        self._words[fid] = ws
         if fid >= self._dest_cap:
             self._dest_cap *= 2
             nd = np.zeros(self._dest_cap, dtype=np.int32)
@@ -249,6 +296,7 @@ class ShardedMatchEngine:
             return None
         del self._refs[fid]
         del self._fids[filt]
+        del self._words[fid]
         if fid in self._deep_fids:
             self._deep_fids.discard(fid)
             self._deep.delete(filt, fid)
@@ -408,6 +456,60 @@ class ShardedMatchEngine:
                 for fid in self._deep.match(t) & self._deep_fids:
                     counts[i, self._dest[fid]] += 1
         return counts
+
+    def match(self, topics: Sequence[str]) -> List[Set[int]]:
+        """Broker-facing match: verified fid sets per topic.
+
+        Uses the compact [D, B, k] device return (`sharded_match_compact`)
+        sized for dispatch; the rare per-chip overflow (one topic matching
+        more than ``kcap`` filters on a single chip) falls back to the
+        full [D, B, M] return for that batch.  Device hits are verified
+        against host filter words exactly like `TopicMatchEngine.match`.
+        """
+        out: List[Set[int]] = [set() for _ in topics]
+        if any(t.n_entries for t in self.shards):
+            from ..models.engine import verify_hits
+
+            stacked, _ = self.sync_device()
+            batch, n = self._prep_batch(topics)
+            hits, counts = sharded_match_compact(
+                stacked, batch, mesh=self.mesh, kcap=self.kcap
+            )
+            hits = np.asarray(hits)  # [D, B, k]
+            counts = np.asarray(counts)  # [D, B]
+            k = hits.shape[2]
+            over = (counts > k).any(axis=0)
+            full = None
+            for i in range(n):
+                if over[i]:
+                    if full is None:
+                        full = np.asarray(
+                            sharded_match_fids(stacked, batch, mesh=self.mesh)
+                        )
+                    col = full[:, i, :]
+                else:
+                    col = hits[:, i, :]
+                raw = col[col >= 0]
+                if not raw.size:
+                    continue
+                if self.verify_matches:
+                    good, bad = verify_hits(
+                        topiclib.words(topics[i]), raw, self._words
+                    )
+                    out[i].update(good)
+                    self.collision_count += len(bad)
+                    if self.on_collision is not None:
+                        for fid in bad:
+                            self.on_collision(topics[i], fid)
+                else:
+                    out[i].update(int(f) for f in raw)
+        if self._deep_fids:
+            for i, t in enumerate(topics):
+                out[i] |= self._deep.match(t) & self._deep_fids
+        return out
+
+    def match_one(self, name: str) -> Set[int]:
+        return self.match([name])[0]
 
     def match_fids(self, topics: Sequence[str]) -> List[Set[int]]:
         stacked, _ = self.sync_device()

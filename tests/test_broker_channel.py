@@ -20,9 +20,18 @@ from emqx_tpu.broker.packet import (
 )
 
 
+def make_engine(kind):
+    """'single' -> default engine; 'sharded' -> 8-device mesh engine."""
+    if kind == "sharded":
+        from emqx_tpu.parallel.sharded import ShardedMatchEngine
+
+        return ShardedMatchEngine(n_sub_shards=64, min_batch=16, kcap=8)
+    return None
+
+
 class Harness:
-    def __init__(self):
-        self.broker = Broker()
+    def __init__(self, engine=None):
+        self.broker = Broker(engine=make_engine(engine))
 
     def connect(self, clientid, ver=MQTT_V5, clean_start=True, will=None,
                 props=None, keepalive=60):
@@ -64,9 +73,12 @@ class Harness:
         ch.outbox.clear()
 
 
-@pytest.fixture
-def h():
-    return Harness()
+# the whole channel/broker suite runs against BOTH engine frontends: the
+# single-chip TopicMatchEngine and the mesh-sharded engine on the virtual
+# 8-device mesh (VERDICT round-2 #1 done-condition)
+@pytest.fixture(params=["single", "sharded"])
+def h(request):
+    return Harness(engine=request.param)
 
 
 def test_connect_connack(h):

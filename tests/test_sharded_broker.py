@@ -1,0 +1,241 @@
+"""Broker dispatch through the mesh-sharded engine (8 virtual devices).
+
+Round-2 VERDICT #1: the sharded engine behind the real broker path — the
+compact device->host dispatch contract, the subscriber-shard expansion
+layer (`emqx_broker_helper` analog), and parity with the single-chip
+broker as oracle.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.subshard import SubscriberShards
+from emqx_tpu.parallel.sharded import ShardedMatchEngine
+
+
+class Sink:
+    """ChannelLike that records deliveries."""
+
+    def __init__(self, broker, clientid):
+        self.clientid = clientid
+        self.got = []
+        broker.cm.channels[clientid] = self
+
+    def deliver(self, delivers):
+        self.got.extend(delivers)
+
+    def kick(self, rc):
+        pass
+
+
+def sharded_engine(**kw):
+    assert len(jax.devices()) == 8
+    kw.setdefault("n_sub_shards", 64)
+    kw.setdefault("min_batch", 16)
+    return ShardedMatchEngine(**kw)
+
+
+# ------------------------------------------------------------ subshards
+
+
+def test_subshard_add_remove_expand():
+    s = SubscriberShards()
+    assert s.add(1, "a") and s.add(1, "b") and s.add(2, "b")
+    assert not s.add(1, "a")  # duplicate
+    assert s.count(1) == 2 and s.count(2) == 1
+    assert s.contains(1, "a") and not s.contains(2, "a")
+    got = dict(s.expand([(1, "f1"), (2, "f2")]))
+    assert got == {"a": ["f1"], "b": ["f1", "f2"]}
+    assert s.remove(1, "a") and not s.remove(1, "a")
+    assert dict(s.expand([(1, "f1")])) == {"b": ["f1"]}
+    # uid interning: 'a' fully released, slot reused
+    assert "a" not in s._uids
+    s.add(3, "c")
+    assert s.contains(3, "c")
+
+
+def test_subshard_shard_split_past_threshold():
+    s = SubscriberShards(threshold=16, nshards=4)
+    for i in range(50):
+        s.add(7, f"c{i}")
+    assert s.count(7) == 50
+    assert s.n_shards_of(7) > 1  # split into hashed buckets
+    uids = s.uids(7)
+    assert len(uids) == 50 and len(np.unique(uids)) == 50
+    cids = {cid for cid, _ in s.expand([(7, "f")])}
+    assert cids == {f"c{i}" for i in range(50)}
+    # removal still works across buckets
+    for i in range(0, 50, 2):
+        assert s.remove(7, f"c{i}")
+    assert s.count(7) == 25
+    cids = {cid for cid, _ in s.expand([(7, "f")])}
+    assert cids == {f"c{i}" for i in range(1, 50, 2)}
+
+
+# ------------------------------------------------------- engine parity
+
+
+def test_sharded_match_vs_single_engine():
+    rng = random.Random(7)
+    sh = sharded_engine()
+    from emqx_tpu.models.engine import TopicMatchEngine
+
+    single = TopicMatchEngine()
+    filt_fids = {}
+    for i in range(400):
+        parts = [rng.choice(["a", "b", "+", "c3"]) for _ in range(rng.randint(1, 5))]
+        if rng.random() < 0.25:
+            parts.append("#")
+        f = "/".join(parts)
+        ffid = sh.add_filter(f)
+        sfid = single.add_filter(f)
+        filt_fids[f] = (ffid, sfid)
+    topics = [
+        "/".join(rng.choice(["a", "b", "c3", "z"]) for _ in range(rng.randint(1, 6)))
+        for _ in range(60)
+    ]
+    got = sh.match(topics)
+    want = single.match(topics)
+    # map fids back to filter strings for comparison
+    back_sh = {v[0]: k for k, v in filt_fids.items()}
+    back_si = {v[1]: k for k, v in filt_fids.items()}
+    for t, g, w in zip(topics, got, want):
+        assert {back_sh[f] for f in g} == {back_si[f] for f in w}, t
+
+
+def test_sharded_match_compact_overflow_fallback():
+    # kcap=1: two same-chip hits on one topic must overflow the compact
+    # return and fall back to the full [D, B, M] path
+    sh = sharded_engine(kcap=1)
+    fid0 = sh.add_filter("a/b")  # fid 0 -> chip 0
+    for i in range(7):
+        sh.add_filter(f"pad/{i}")  # fids 1..7 on chips 1..7
+    fid8 = sh.add_filter("a/+")  # fid 8 -> chip 0 again
+    got = sh.match(["a/b", "pad/3"])
+    assert got[0] == {fid0, fid8}
+    assert got[1] == {sh.fid_of("pad/3")}
+
+
+# ------------------------------------------------------ broker dispatch
+
+
+def test_broker_publish_through_sharded_engine():
+    b = Broker(engine=sharded_engine(kcap=8))
+    s1 = Sink(b, "c1")
+    s2 = Sink(b, "c2")
+    s3 = Sink(b, "c3")
+    b.subscribe("c1", "room/+/temp", SubOpts(qos=0))
+    b.subscribe("c2", "room/#", SubOpts(qos=0))
+    b.subscribe("c3", "other/x", SubOpts(qos=0))
+    n = b.publish(Message(topic="room/1/temp", payload=b"t"))
+    assert n == 2
+    assert [f for f, _ in s1.got] == ["room/+/temp"]
+    assert [f for f, _ in s2.got] == ["room/#"]
+    assert s3.got == []
+    # client matching two filters gets both in one delivery pass
+    b.subscribe("c3", "room/1/+", SubOpts(qos=0))
+    s3.got.clear()
+    b.publish(Message(topic="room/1/temp", payload=b"u"))
+    assert sorted(f for f, _ in s3.got) == ["room/1/+"]
+    b.unsubscribe("c2", "room/#")
+    s1.got.clear()
+    assert b.publish(Message(topic="room/9/temp", payload=b"v")) == 1
+    assert len(s1.got) == 1
+
+
+def test_broker_sharded_vs_single_oracle_random_ops():
+    """Same random subscribe/publish/unsubscribe trace through both
+    brokers; delivery sets must be identical."""
+    rng = random.Random(31)
+    brokers = {
+        "sh": Broker(engine=sharded_engine(kcap=4)),
+        "si": Broker(),
+    }
+    sinks = {
+        k: {f"c{i}": Sink(b, f"c{i}") for i in range(12)}
+        for k, b in brokers.items()
+    }
+    live = []
+    for step in range(6):
+        for _ in range(25):
+            cid = f"c{rng.randrange(12)}"
+            parts = [rng.choice(["s", "t", "+", "u5"]) for _ in range(rng.randint(1, 4))]
+            if rng.random() < 0.2:
+                parts.append("#")
+            f = "/".join(parts)
+            for b in brokers.values():
+                b.subscribe(cid, f, SubOpts(qos=0))
+            live.append((cid, f))
+        for _ in range(8):
+            if live:
+                cid, f = live.pop(rng.randrange(len(live)))
+                for b in brokers.values():
+                    b.unsubscribe(cid, f)
+        topics = [
+            "/".join(rng.choice(["s", "t", "u5", "w"]) for _ in range(rng.randint(1, 5)))
+            for _ in range(10)
+        ]
+        msgs = [Message(topic=t, payload=b"x") for t in topics]
+        n_sh = brokers["sh"].publish_many(msgs)
+        n_si = brokers["si"].publish_many(msgs)
+        assert n_sh == n_si, (step, topics)
+        for cid in sinks["sh"]:
+            got_sh = sorted((f, m.topic) for f, m in sinks["sh"][cid].got)
+            got_si = sorted((f, m.topic) for f, m in sinks["si"][cid].got)
+            assert got_sh == got_si, (step, cid)
+
+
+def test_unsubscribe_wrong_client_keeps_filter():
+    """An unsubscribe from a never-subscribed client must not free the
+    fid out from under live routes (engine refs mirror memberships)."""
+    b = Broker()
+    s1 = Sink(b, "c1")
+    b.subscribe("c1", "keep/+", SubOpts(qos=0))
+    b.unsubscribe("never-subbed", "keep/+")
+    assert b.engine.fid_of("keep/+") is not None
+    assert b.publish(Message(topic="keep/x", payload=b"k")) == 1
+    assert len(s1.got) == 1
+    # duplicate subscribe takes no extra engine reference
+    b.subscribe("c1", "keep/+", SubOpts(qos=0))
+    b.unsubscribe("c1", "keep/+")
+    assert b.engine.fid_of("keep/+") is None
+    # shared-group flavor of the same guard
+    b.subscribe("c1", "$share/g/sh/t", SubOpts(qos=0))
+    b.unsubscribe("other", "$share/g/sh/t")
+    assert b.engine.fid_of("sh/t") is not None
+    b.unsubscribe("c1", "$share/g/sh/t")
+    assert b.engine.fid_of("sh/t") is None
+
+
+def test_broker_sharded_shared_subscriptions():
+    b = Broker(engine=sharded_engine())
+    b.shared.strategy = "round_robin"
+    s1 = Sink(b, "m1")
+    s2 = Sink(b, "m2")
+    b.subscribe("m1", "$share/g/job/+", SubOpts(qos=0))
+    b.subscribe("m2", "$share/g/job/+", SubOpts(qos=0))
+    for i in range(6):
+        assert b.publish(Message(topic=f"job/{i}", payload=b"j")) == 1
+    assert len(s1.got) + len(s2.got) == 6
+    assert len(s1.got) == 3 and len(s2.got) == 3  # round robin
+
+
+def test_broker_sharded_fanout_expansion():
+    """A single filter with a sharded subscriber list (past threshold)
+    expands completely through the vectorized path."""
+    b = Broker(engine=sharded_engine())
+    b.subs.threshold = 64  # force the shard split at test scale
+    sinks = [Sink(b, f"f{i}") for i in range(300)]
+    for i in range(300):
+        b.subscribe(f"f{i}", "wide/topic", SubOpts(qos=0))
+    fid = b.engine.fid_of("wide/topic")
+    assert b.subs.n_shards_of(fid) > 1
+    n = b.publish(Message(topic="wide/topic", payload=b"all"))
+    assert n == 300
+    assert all(len(s.got) == 1 for s in sinks)
