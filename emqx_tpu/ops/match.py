@@ -7,14 +7,14 @@ batched, fully static-shape computation:
     matched[b, m] = filter-id hit by topic b under wildcard-shape m (or -1)
 
 All arrays are fixed capacity; churn mutates them via scatter
-(:func:`apply_delta`) without recompilation.  Multi-chip sharding lives in
-`emqx_tpu.parallel`.
+(:func:`apply_delta_packed`) without recompilation.  Multi-chip sharding
+lives in `emqx_tpu.parallel`.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple
+from typing import Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,8 +39,12 @@ class DeviceTables(NamedTuple):
 
     @staticmethod
     def from_host(t: MatchTables, device=None) -> "DeviceTables":
+        # upload COPIES: device_put is async (and may alias the numpy
+        # buffer on the CPU backend), while the host keeps mutating these
+        # arrays in place on later churn ticks — a live reference here is
+        # a data race under pipelined submits
         arrs = t.device_arrays()
-        put = lambda a: jax.device_put(a, device)
+        put = lambda a: jax.device_put(a.copy(), device)
         return DeviceTables(**{k: put(v) for k, v in arrs.items()})
 
 
@@ -72,6 +76,13 @@ def match_batch(t: DeviceTables, batch: TopicBatch) -> jax.Array:
     under shape ``m``, or -1.  (Each shape can hit at most one filter — a
     topic has exactly one masked hash per shape.)
     """
+    # Batches may carry fewer term levels than the table (upload savings:
+    # terms are the transfer payload).  Shapes deeper than the batch's
+    # level budget are killed by the min_len check below, so truncating
+    # their inclusion rows cannot create false hits.
+    Lb = batch.terms_a.shape[1]
+    if Lb < t.incl.shape[1]:
+        t = t._replace(incl=t.incl[:, :Lb])
     cap = t.key_a.shape[0]
     log2cap = int(cap).bit_length() - 1
     ha, hb = pattern_hashes(t, batch)
@@ -110,7 +121,7 @@ def apply_delta_impl(
     """Scatter incremental subscribe/unsubscribe deltas into the HBM mirror.
 
     The churn path: route mutations (`emqx_router.erl:106-123`) become a
-    single fused scatter on donated buffers — no reallocation, no re-upload.
+    single scatter — no reallocation, no re-upload.
     """
     cap = t.key_a.shape[0]
     # Padding entries (slot == -1) are routed out of range and dropped by the
@@ -121,9 +132,6 @@ def apply_delta_impl(
         key_b=t.key_b.at[safe].set(key_b, mode="drop"),
         val=t.val.at[safe].set(val, mode="drop"),
     )
-
-
-apply_delta = jax.jit(apply_delta_impl, donate_argnums=(0,))
 
 
 def apply_delta_packed_impl(t: DeviceTables, packed: jax.Array) -> DeviceTables:
@@ -139,7 +147,105 @@ def apply_delta_packed_impl(t: DeviceTables, packed: jax.Array) -> DeviceTables:
     return apply_delta_impl(t, slots, key_a, key_b, val)
 
 
-apply_delta_packed = jax.jit(apply_delta_packed_impl, donate_argnums=(0,))
+# NOT donating: pipelined _PendingMatch handles snapshot table versions
+# that must survive a later sync (see fused_step_sparse).
+apply_delta_packed = jax.jit(apply_delta_packed_impl)
+
+
+# --------------------------------------------------- packed host<->device
+#
+# The tunneled dev rig (axon) has a wildly asymmetric link — measured:
+# host->device ~1.3 GB/s, device->host ~5 MB/s with ~100 ms per get op
+# that does NOT overlap across ops.  Dispatches on resident buffers are
+# ~0.03 ms.  The e2e design therefore (a) ships the topic batch up as
+# ONE packed array, (b) returns matches as ONE sparse array sized by the
+# actual hit count (~6 bytes per lookup), and (c) starts the device->
+# host copy asynchronously at submit time.  On co-located hardware the
+# same shape discipline minimizes PCIe traffic.
+
+
+def pack_topic_batch_np(ta, tb, ln, dl) -> np.ndarray:
+    """Host-side: one [B, 2L+2] u32 array instead of four puts."""
+    B, L = ta.shape
+    out = np.empty((B, 2 * L + 2), dtype=np.uint32)
+    out[:, :L] = ta
+    out[:, L:2 * L] = tb
+    out[:, 2 * L] = ln.astype(np.int32, copy=False).view(np.uint32)
+    out[:, 2 * L + 1] = dl.astype(np.uint32)
+    return out
+
+
+def unpack_topic_batch(p: jax.Array) -> TopicBatch:
+    """Device-side (inside jit): undo pack_topic_batch_np."""
+    L = (p.shape[1] - 2) // 2
+    ta = p[:, :L]
+    tb = p[:, L:2 * L]
+    ln = jax.lax.bitcast_convert_type(p[:, 2 * L], jnp.int32)
+    dl = p[:, 2 * L + 1] != 0
+    return TopicBatch(ta, tb, ln, dl)
+
+
+def sparse_pack(matched: jax.Array, hcap: int) -> jax.Array:
+    """[B, M] shape-hit rows -> ONE [hcap + B/2 + 1] i32 result array:
+
+      [0:hcap]            matched fids, flattened row-major (left-packed)
+      [hcap:hcap+B/2]     per-topic hit counts, u16 pairs bitcast to i32
+      [-1]                total hit count (> hcap means overflow: the
+                          host must refetch the full row set)
+
+    Hits beyond hcap are dropped on device (never corrupt earlier slots).
+    Per-lookup download cost is ~(4*H/B + 2) bytes instead of 4*M.
+    Compaction is gather-based (cumsum + binary search): a B*M-element
+    scatter serializes on TPU (~1 s at 4M elements), gathers do not."""
+    B, M = matched.shape
+    flat = matched.reshape(-1)
+    hit = flat >= 0
+    cpos = jnp.cumsum(hit.astype(jnp.int32))  # hits up to and incl. j
+    total = cpos[-1]
+    # the s-th hit lives at the first j with cpos[j] == s+1
+    idx = jnp.searchsorted(
+        cpos, jnp.arange(1, hcap + 1, dtype=jnp.int32), side="left"
+    )
+    fids = jnp.where(
+        jnp.arange(hcap) < total,
+        jnp.take(flat, jnp.minimum(idx, B * M - 1)),
+        -1,
+    )
+    # u16-saturated per-topic counts; 0xFFFF tells the host to refetch
+    counts = jnp.minimum(
+        jnp.sum(matched >= 0, axis=-1, dtype=jnp.int32), 0xFFFF
+    ).astype(jnp.uint16)
+    counts2 = jax.lax.bitcast_convert_type(
+        counts.reshape(B // 2, 2), jnp.int32
+    )
+    return jnp.concatenate([fids, counts2, total[None]])
+
+
+@functools.partial(jax.jit, static_argnames=("hcap",))
+def match_batch_sparse(t: DeviceTables, pbatch: jax.Array, *, hcap: int):
+    return sparse_pack(match_batch(t, unpack_topic_batch(pbatch)), hcap)
+
+
+@functools.partial(jax.jit, static_argnames=("hcap",))
+def fused_step_sparse(
+    t: DeviceTables, packed: jax.Array, pbatch: jax.Array, *, hcap: int
+):
+    """Churn scatter + match + sparse compaction in ONE dispatch — the
+    single-chip flagship step (delta upload rides the same round trip).
+
+    Deliberately NOT buffer-donating: pipelined submits keep references
+    to earlier table versions (for the sparse-overflow refetch, which
+    must see the tables AS OF ITS OWN TICK); the non-donated scatter
+    costs one on-device table copy (~HBM bandwidth, sub-ms even at 10M
+    entries) per churn tick."""
+    t = apply_delta_packed_impl(t, packed)
+    return t, sparse_pack(match_batch(t, unpack_topic_batch(pbatch)), hcap)
+
+
+@jax.jit
+def match_batch_packed(t: DeviceTables, pbatch: jax.Array) -> jax.Array:
+    """Full [B, M] row set from a packed batch (sparse-overflow fallback)."""
+    return match_batch(t, unpack_topic_batch(pbatch))
 
 
 def make_topic_batch(ta: np.ndarray, tb: np.ndarray, ln: np.ndarray, dl: np.ndarray, device=None) -> TopicBatch:

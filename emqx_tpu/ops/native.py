@@ -45,7 +45,7 @@ def _build() -> bool:
     try:
         subprocess.run(
             ["g++", "-O3", "-Wall", "-fPIC", "-std=c++17", "-shared",
-             "-o", _LIB_PATH] + srcs,
+             "-pthread", "-o", _LIB_PATH] + srcs,
             check=True, capture_output=True, timeout=120,
         )
         return True
@@ -84,6 +84,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.etpu_bulk_place_slots.argtypes = [
         _u32p, _u32p, _i32p, ctypes.c_int32, ctypes.c_int32,
         _u32p, _u32p, _i32p, ctypes.c_int32, _i32p,
+    ]
+    lib.etpu_verify_pairs.restype = None
+    lib.etpu_verify_pairs.argtypes = [
+        _u8p, _i64p, _u8p, _i64p, _i32p, ctypes.c_int32, _u8p,
     ]
     lib.etpu_bcrypt_init.restype = None
     lib.etpu_bcrypt_init.argtypes = [_u32p]
@@ -147,13 +151,7 @@ def prep_topics(
     if lib is None:
         return None
     n = len(topics)
-    blobs = [t.encode("utf-8") for t in topics]
-    offsets = np.zeros(n + 1, dtype=np.int64)
-    for i, b in enumerate(blobs):
-        offsets[i + 1] = offsets[i] + len(b)
-    data = b"".join(blobs)
-    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(1, dtype=np.uint8)
-    buf = np.ascontiguousarray(buf)
+    buf, offsets = _pack_strs(topics)
 
     ta = np.zeros((n, max_levels), dtype=np.uint32)
     tb = np.zeros((n, max_levels), dtype=np.uint32)
@@ -206,13 +204,7 @@ def scan_frames(buf: bytes, max_size: int, max_frames: int = 256) -> Optional[Fr
 
 
 def _pack_strs(strs):
-    blobs = [s.encode("utf-8") for s in strs]
-    offsets = np.zeros(len(blobs) + 1, dtype=np.int64)
-    for i, b in enumerate(blobs):
-        offsets[i + 1] = offsets[i] + len(b)
-    data = b"".join(blobs)
-    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(1, dtype=np.uint8)
-    return np.ascontiguousarray(buf), offsets
+    return _pack_blobs([s.encode("utf-8") for s in strs])
 
 
 def filter_keys(filters, max_levels: int, space):
@@ -242,6 +234,40 @@ def filter_keys(filters, max_levels: int, space):
         has_hash.ctypes.data_as(_u8p),
     )
     return ha, hb, plen, plus_mask, has_hash.astype(bool)
+
+
+def _pack_blobs(blobs):
+    n = len(blobs)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(
+        np.fromiter(map(len, blobs), dtype=np.int64, count=n),
+        out=offsets[1:],
+    )
+    data = b"".join(blobs)
+    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(1, dtype=np.uint8)
+    return np.ascontiguousarray(buf), offsets
+
+
+def verify_pairs(topic_blobs, tidx: np.ndarray, filt_blobs):
+    """Exact per-pair topic-vs-filter match (device-hit verification).
+
+    topic_blobs: utf-8 topic strings (indexed by tidx); filt_blobs: one
+    utf-8 filter string per pair.  Returns a bool array per pair, or
+    None when the lib is absent (caller falls back to Python)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(filt_blobs)
+    tbuf, toffs = _pack_blobs(topic_blobs)
+    fbuf, foffs = _pack_blobs(filt_blobs)
+    tidx = np.ascontiguousarray(tidx.astype(np.int32, copy=False))
+    ok = np.zeros(n, dtype=np.uint8)
+    lib.etpu_verify_pairs(
+        tbuf.ctypes.data_as(_u8p), toffs.ctypes.data_as(_i64p),
+        fbuf.ctypes.data_as(_u8p), foffs.ctypes.data_as(_i64p),
+        tidx.ctypes.data_as(_i32p), n, ok.ctypes.data_as(_u8p),
+    )
+    return ok.astype(bool)
 
 
 def bulk_place(key_a: np.ndarray, key_b: np.ndarray, val: np.ndarray,

@@ -236,6 +236,7 @@ class ShardedMatchEngine:
         self._fids: Dict[str, int] = {}
         self._refs: Dict[int, int] = {}
         self._words: Dict[int, List[str]] = {}
+        self._fbytes: Dict[int, bytes] = {}
         self._next_fid = 0
         self._free_fids: List[int] = []
 
@@ -278,6 +279,7 @@ class ShardedMatchEngine:
         self._fids[filt] = fid
         self._refs[fid] = 1
         self._words[fid] = ws
+        self._fbytes[fid] = filt.encode("utf-8")
         if fid >= self._dest_cap:
             self._dest_cap *= 2
             nd = np.zeros(self._dest_cap, dtype=np.int32)
@@ -297,6 +299,7 @@ class ShardedMatchEngine:
         del self._refs[fid]
         del self._fids[filt]
         del self._words[fid]
+        del self._fbytes[fid]
         if fid in self._deep_fids:
             self._deep_fids.discard(fid)
             self._deep.delete(filt, fid)
@@ -468,41 +471,40 @@ class ShardedMatchEngine:
         """
         out: List[Set[int]] = [set() for _ in topics]
         if any(t.n_entries for t in self.shards):
-            from ..models.engine import verify_hits
+            from ..models.engine import verify_pairs_into
 
             stacked, _ = self.sync_device()
             batch, n = self._prep_batch(topics)
             hits, counts = sharded_match_compact(
                 stacked, batch, mesh=self.mesh, kcap=self.kcap
             )
-            hits = np.asarray(hits)  # [D, B, k]
-            counts = np.asarray(counts)  # [D, B]
+            hits = np.asarray(hits)[:, :n, :]  # [D, n, k]
+            counts = np.asarray(counts)[:, :n]  # [D, n]
             k = hits.shape[2]
             over = (counts > k).any(axis=0)
-            full = None
-            for i in range(n):
-                if over[i]:
-                    if full is None:
-                        full = np.asarray(
-                            sharded_match_fids(stacked, batch, mesh=self.mesh)
-                        )
-                    col = full[:, i, :]
-                else:
-                    col = hits[:, i, :]
-                raw = col[col >= 0]
-                if not raw.size:
-                    continue
-                if self.verify_matches:
-                    good, bad = verify_hits(
-                        topiclib.words(topics[i]), raw, self._words
+            if over.any():
+                # per-chip overflow: splice in the full return for those
+                full = np.asarray(
+                    sharded_match_fids(stacked, batch, mesh=self.mesh)
+                )[:, :n, :]
+                pad = full.shape[2] - k
+                if pad > 0:
+                    hits = np.concatenate(
+                        [hits, np.full(hits.shape[:2] + (pad,), -1,
+                                       dtype=hits.dtype)], axis=2
                     )
-                    out[i].update(good)
-                    self.collision_count += len(bad)
-                    if self.on_collision is not None:
-                        for fid in bad:
-                            self.on_collision(topics[i], fid)
+                hits[:, over, :] = full[:, over, :]
+            _d, bb, jj = np.nonzero(hits >= 0)
+            if bb.size:
+                fids = hits[_d, bb, jj]
+                if self.verify_matches:
+                    verify_pairs_into(
+                        topics, bb, fids, self._words, self._fbytes,
+                        out, self._collide,
+                    )
                 else:
-                    out[i].update(int(f) for f in raw)
+                    for i, f in zip(bb.tolist(), fids.tolist()):
+                        out[i].add(int(f))
         if self._deep_fids:
             for i, t in enumerate(topics):
                 out[i] |= self._deep.match(t) & self._deep_fids
@@ -510,6 +512,11 @@ class ShardedMatchEngine:
 
     def match_one(self, name: str) -> Set[int]:
         return self.match([name])[0]
+
+    def _collide(self, topic: str, fid: int) -> None:
+        self.collision_count += 1
+        if self.on_collision is not None:
+            self.on_collision(topic, fid)
 
     def match_fids(self, topics: Sequence[str]) -> List[Set[int]]:
         stacked, _ = self.sync_device()

@@ -200,7 +200,9 @@ def test_injected_collision_detected():
 
     # simulate a lane collision: device table still hashes the original
     # filter, but pretend fid actually belongs to an unrelated filter
+    # (_words drives the Python verifier, _fbytes the native one)
     eng._words[fid] = ["not", "related"]
+    eng._fbytes[fid] = b"not/related"
     assert eng.match(["sensors/3/temp"])[0] == set()
     assert eng.collision_count == 1
     assert hits == [("sensors/3/temp", fid)]
@@ -218,6 +220,7 @@ def test_broker_counts_collisions():
     b.subscribe("c1", "a/+", SubOpts(qos=0))
     fid = b.engine.fid_of("a/+")
     b.engine._words[fid] = ["mismatch"]
+    b.engine._fbytes[fid] = b"mismatch"
     from emqx_tpu.broker.message import Message
 
     assert b.publish(Message(topic="a/1", payload=b"x")) == 0
@@ -286,3 +289,52 @@ def test_apply_churn_growth_mid_tick():
     assert eng.tables.log2cap > cap_before
     assert eng.match(["g/77/zzz"])[0] == {eng.fid_of("g/77/+")}
     assert eng.match(["a/5"])[0] == {eng.fid_of("a/5")}
+
+
+def test_pipelined_submit_collect_churn_oracle():
+    """Pipelined match_submit/match_collect under interleaved churn.
+
+    Contract (eventual consistency across in-flight ticks, like the
+    reference's mria-replicated routes): a collected result must contain
+    every hit valid at BOTH submit and collect time, and nothing that was
+    valid at NEITHER.  Regression for two races: device tables aliasing
+    host arrays mutated by later churn, and the sparse-overflow refetch
+    reading tables newer than its own tick."""
+    import random
+
+    from emqx_tpu.models.reference import BruteForceIndex
+
+    rng = random.Random(11)
+    eng = TopicMatchEngine(min_batch=16)
+    ref = BruteForceIndex()
+    live, pend = [], []
+
+    def drain(force=False):
+        while pend and (force or len(pend) >= 3):
+            p, t0, e0 = pend.pop(0)
+            got = eng.match_collect(p)
+            e1 = [ref.match(t) for t in t0]
+            for t, g, ws, wc in zip(t0, got, e0, e1):
+                assert g >= (ws & wc), (t, g, ws, wc)
+                assert g <= (ws | wc), (t, g, ws, wc)
+
+    for step in range(40):
+        for _ in range(20):
+            parts = [rng.choice(["a", "b", "+", "c"]) for _ in range(rng.randint(1, 5))]
+            if rng.random() < 0.25:
+                parts.append("#")
+            f = "/".join(parts)
+            fid = eng.add_filter(f)
+            ref.insert(f, fid)
+            live.append(f)
+        for _ in range(8):
+            f = live.pop(rng.randrange(len(live)))
+            if eng.remove_filter(f) is not None:
+                ref.delete(f)
+        topics = [
+            "/".join(rng.choice(["a", "b", "c", "x"]) for _ in range(rng.randint(1, 6)))
+            for _ in range(rng.choice([3, 17, 64]))
+        ]
+        pend.append((eng.match_submit(topics), topics, [ref.match(t) for t in topics]))
+        drain()
+    drain(force=True)

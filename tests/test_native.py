@@ -234,3 +234,39 @@ def test_duplicate_key_runaway_raises():
     with pytest.raises(RuntimeError):
         for fid in range(PROBE + 1):
             t.insert(["dup", "+"], fid)
+
+
+def test_verify_pairs_matches_python_semantics():
+    """etpu_verify_pairs must agree with topic.match_words on randomized
+    topic/filter pairs, including $-topics, empty levels, and unicode."""
+    import random
+
+    from emqx_tpu.broker import topic as topiclib
+
+    assert native.available()
+    rng = random.Random(77)
+    lvl = ["a", "b", "cc", "", "d1", "$sys", "ü"]
+    topics, filters = [], []
+    for _ in range(600):
+        topics.append("/".join(rng.choice(lvl) for _ in range(rng.randint(1, 5))))
+        parts = [rng.choice(lvl + ["+", "+"]) for _ in range(rng.randint(1, 5))]
+        if rng.random() < 0.3:
+            parts.append("#")
+        filters.append("/".join(parts))
+    # fixed edge pairs
+    edge = [
+        ("a/b", "a/b"), ("a/b", "a/+"), ("a/b", "#"), ("$SYS/x", "#"),
+        ("$SYS/x", "+/x"), ("$SYS/x", "$SYS/+"), ("a", "a/#"), ("a", "a/+/#"),
+        ("a/", "a/+"), ("a//b", "a/+/b"), ("", "#"), ("", "+"),
+        ("a/b/c", "a/#"), ("a/b", "a"), ("a", "a/b"), ("x", "+"),
+    ]
+    tlist = topics + [t for t, _ in edge]
+    flist = filters + [f for _, f in edge]
+    tidx = np.arange(len(tlist), dtype=np.int32)
+    ok = native.verify_pairs(
+        [t.encode() for t in tlist], tidx, [f.encode() for f in flist]
+    )
+    assert ok is not None
+    for t, f, got in zip(tlist, flist, ok.tolist()):
+        want = topiclib.match_words(topiclib.words(t), topiclib.words(f))
+        assert got == want, (t, f, got, want)
