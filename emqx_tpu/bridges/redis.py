@@ -1,0 +1,286 @@
+"""Redis client — real RESP wire protocol, pooled, stdlib-only.
+
+The analog of the reference's eredis-backed connector
+(`apps/emqx_connector/src/emqx_connector_redis.erl`: pooled clients with
+AUTH/SELECT on connect and a health check), speaking RESP2 (with RESP3
+reply-type tolerance) over plain TCP sockets — no external client
+library, so the "redis" kind of the driver seam (`emqx_tpu.drivers`) is
+a real driver out of the box, not an injection point.
+
+Contract (see drivers.py): sync `command(*args)`, `health_check()`,
+`start()`/`stop()`.  HGETALL replies are returned as dicts (the shape
+`DbAuthenticator`/`DbSource` consume); everything else is returned as
+decoded Python values (str/int/list/None).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, List, Optional
+
+_CRLF = b"\r\n"
+
+
+class RedisError(Exception):
+    """Server-reported error reply (`-ERR ...`)."""
+
+
+class RedisProtocolError(Exception):
+    """Malformed RESP from the server."""
+
+
+def encode_command(args) -> bytes:
+    """RESP array-of-bulk-strings request framing."""
+    parts = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, bytes):
+            b = a
+        elif isinstance(a, str):
+            b = a.encode("utf-8")
+        elif isinstance(a, (int, float)):
+            b = str(a).encode()
+        else:
+            raise TypeError(f"unsupported redis arg type {type(a)!r}")
+        parts.append(b"$%d\r\n" % len(b))
+        parts.append(b)
+        parts.append(_CRLF)
+    return b"".join(parts)
+
+
+def _decode(b: bytes) -> Any:
+    try:
+        return b.decode("utf-8")
+    except UnicodeDecodeError:
+        return b
+
+
+class _Conn:
+    """One blocking socket + incremental RESP reply reader."""
+
+    def __init__(self, host: str, port: int, timeout: float):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.buf = b""
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _read_more(self) -> None:
+        chunk = self.sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("redis connection closed by peer")
+        self.buf += chunk
+
+    def _read_line(self) -> bytes:
+        while True:
+            i = self.buf.find(_CRLF)
+            if i >= 0:
+                line, self.buf = self.buf[:i], self.buf[i + 2:]
+                return line
+            self._read_more()
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:
+            self._read_more()
+        data, self.buf = self.buf[:n], self.buf[n + 2:]  # strip CRLF
+        return data
+
+    def _read_value(self) -> Any:
+        """One RESP value.  Error replies come back as RedisError VALUES
+        (not raised): raising mid-array would abandon the rest of the
+        reply in the buffer and desync the connection for its next
+        user.  Top-level errors are raised by read_reply() after the
+        parse is complete; nested errors (e.g. inside an EXEC reply)
+        stay values, like mainstream clients."""
+        line = self._read_line()
+        if not line:
+            raise RedisProtocolError("empty reply line")
+        t, rest = line[:1], line[1:]
+        if t == b"+":  # simple string
+            return _decode(rest)
+        if t == b"-":  # error
+            return RedisError(rest.decode("utf-8", "replace"))
+        if t == b":":  # integer
+            return int(rest)
+        if t == b"$":  # bulk string
+            n = int(rest)
+            if n < 0:
+                return None
+            return _decode(self._read_exact(n))
+        if t == b"*" or t == b">":  # array / RESP3 push
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self._read_value() for _ in range(n)]
+        if t == b"%":  # RESP3 map
+            n = int(rest)
+            return {
+                self._read_value(): self._read_value() for _ in range(n)
+            }
+        if t == b"_":  # RESP3 null
+            return None
+        if t == b"#":  # RESP3 boolean
+            return rest == b"t"
+        if t == b",":  # RESP3 double
+            return float(rest)
+        raise RedisProtocolError(f"unknown RESP type byte {t!r}")
+
+    def read_reply(self) -> Any:
+        v = self._read_value()
+        if isinstance(v, RedisError):
+            raise v
+        return v
+
+    def roundtrip(self, args) -> Any:
+        self.sock.sendall(encode_command(args))
+        return self.read_reply()
+
+
+class RedisDriver:
+    """Pooled Redis client satisfying the emqx_tpu driver contract.
+
+    Pool semantics mirror ecpool's checkout/checkin: up to `pool_size`
+    connections created on demand, reused round-robin; a connection
+    that errors is dropped and the command retried once on a fresh one
+    (the reference's eredis reconnect behavior)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6379,
+        password: Optional[str] = None,
+        username: Optional[str] = None,
+        database: int = 0,
+        pool_size: int = 4,
+        timeout: float = 5.0,
+        **_ignored,
+    ):
+        self.host = host
+        self.port = int(port)
+        self.password = password
+        self.username = username
+        self.database = int(database)
+        self.pool_size = int(pool_size)
+        self.timeout = float(timeout)
+        self._idle: List[_Conn] = []
+        self._n_open = 0
+        self._lock = threading.Condition()
+        self._stopped = False
+
+    # ------------------------------------------------------------- pool
+
+    def _connect(self) -> _Conn:
+        conn = _Conn(self.host, self.port, self.timeout)
+        try:
+            if self.password is not None:
+                if self.username:
+                    conn.roundtrip(("AUTH", self.username, self.password))
+                else:
+                    conn.roundtrip(("AUTH", self.password))
+            if self.database:
+                conn.roundtrip(("SELECT", self.database))
+        except Exception:
+            conn.close()
+            raise
+        return conn
+
+    def _checkout(self) -> _Conn:
+        import time as _time
+
+        deadline = _time.monotonic() + self.timeout
+        with self._lock:
+            while True:
+                if self._stopped:
+                    raise RedisError("driver stopped")
+                if self._idle:
+                    return self._idle.pop()
+                if self._n_open < self.pool_size:
+                    self._n_open += 1
+                    break
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    raise TimeoutError("redis pool exhausted")
+                self._lock.wait(left)
+        try:
+            return self._connect()
+        except Exception:
+            with self._lock:
+                self._n_open -= 1
+                self._lock.notify()
+            raise
+
+    def _checkin(self, conn: Optional[_Conn]) -> None:
+        with self._lock:
+            if conn is None or self._stopped:
+                self._n_open -= 1
+                if conn is not None:
+                    conn.close()
+            else:
+                self._idle.append(conn)
+            self._lock.notify()
+
+    # --------------------------------------------------------- contract
+
+    def start(self) -> None:
+        """Open one connection eagerly so misconfiguration fails loudly
+        at resource start, not first use."""
+        self._checkin(self._checkout())
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            for c in self._idle:
+                c.close()
+            self._n_open -= len(self._idle)
+            self._idle.clear()
+            self._lock.notify_all()
+
+    def _flush_idle(self) -> None:
+        """Drop every idle connection: after one socket dies (typically a
+        server restart) the rest of the pool is stale too — the retry
+        must dial fresh, not pop the next dead socket."""
+        with self._lock:
+            for c in self._idle:
+                c.close()
+            self._n_open -= len(self._idle)
+            self._idle.clear()
+            self._lock.notify_all()
+
+    def command(self, *args) -> Any:
+        """Run one command; HGETALL replies come back as dicts."""
+        last_err: Optional[Exception] = None
+        for _attempt in range(2):  # retry once on a fresh connection
+            conn = self._checkout()
+            try:
+                reply = conn.roundtrip(args)
+            except RedisError:
+                # top-level error reply: the parse completed, the
+                # connection is in sync and safe to reuse
+                self._checkin(conn)
+                raise
+            except Exception as e:  # socket died: drop pool + retry
+                conn.close()
+                self._checkin(None)
+                self._flush_idle()
+                last_err = e
+                continue
+            self._checkin(conn)
+            if (
+                isinstance(reply, list)
+                and args
+                and str(args[0]).upper() == "HGETALL"
+            ):
+                it = iter(reply)
+                return dict(zip(it, it))
+            return reply
+        raise ConnectionError(f"redis command failed after retry: {last_err}")
+
+    def health_check(self) -> bool:
+        try:
+            return self.command("PING") == "PONG"
+        except Exception:
+            return False

@@ -3,8 +3,11 @@
 The reference reaches MySQL/PgSQL/MongoDB/Redis/LDAP through pooled
 Erlang client deps (`rebar.config` ecpool/epgsql/eredis/...;
 `apps/emqx_connector/src/emqx_connector_{mysql,pgsql,redis,mongo}.erl`).
-None of those drivers exist in this image, so the framework ships the
-*contract* and an injection point instead of bundled clients:
+
+**Redis ships as a REAL bundled driver** (`bridges/redis.py`: RESP wire
+protocol + pooling over stdlib sockets, the eredis analog).  The other
+kinds have no client library in this image, so the framework ships the
+*contract* and an injection point for them:
 
 * a deployment registers a factory per kind —
   ``register_driver("mysql", lambda **cfg: MyAdapter(cfg))`` — wrapping
@@ -39,6 +42,20 @@ DB_KINDS = ("mysql", "pgsql", "mongodb", "redis", "ldap")
 _registry: Dict[str, Callable[..., Any]] = {}
 
 
+def _redis_factory(**cfg):
+    from .bridges.redis import RedisDriver
+
+    return RedisDriver(**cfg)
+
+
+# Kinds with a REAL bundled implementation (stdlib wire protocol, no
+# external client library).  register_driver() overrides them; the
+# remaining kinds stay injection points until a client is registered.
+_builtin: Dict[str, Callable[..., Any]] = {
+    "redis": _redis_factory,
+}
+
+
 class DriverUnavailable(NotImplementedError):
     pass
 
@@ -49,19 +66,20 @@ def register_driver(kind: str, factory: Callable[..., Any]) -> None:
 
 
 def unregister_driver(kind: str) -> None:
+    """Remove an injected factory (built-in drivers are restored)."""
     _registry.pop(kind, None)
 
 
 def driver_available(kind: str) -> bool:
-    return kind in _registry
+    return kind in _registry or kind in _builtin
 
 
 def make_driver(kind: str, **cfg) -> Any:
-    factory = _registry.get(kind)
+    factory = _registry.get(kind) or _builtin.get(kind)
     if factory is None:
         raise DriverUnavailable(
             f"{kind} driver not registered: this environment ships no "
-            f"database clients — register one via "
+            f"{kind} client — register one via "
             f"emqx_tpu.drivers.register_driver({kind!r}, factory)"
         )
     return factory(**cfg)

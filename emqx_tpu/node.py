@@ -159,6 +159,7 @@ class NodeRuntime:
                 ban_duration=self.conf.get("flapping_detect.ban_time"),
             )
             self.flapping.install(self.broker.hooks)
+        self._db_drivers: List[Any] = []  # pooled DB clients we own
         self.authn = None
         self.scram = None
         if self.conf.get("authn.enable"):
@@ -445,6 +446,7 @@ class NodeRuntime:
                     iterations=int(d.get("iterations", 10_000)),
                     **driver_cfg,
                 )
+                self._db_drivers.append(a.driver)
             else:
                 raise ConfigError(f"unsupported authenticator backend {backend!r}")
             self.authn.add(a)
@@ -456,7 +458,10 @@ class NodeRuntime:
         for d in defs:
             t = d.get("type", "built_in_database")
             if t in drivers_mod.DB_KINDS:
-                self.authz.add(DbSource(t, d.get("query", "")))
+                cfg = {k: v for k, v in d.items() if k not in ("type", "query")}
+                src = DbSource(t, d.get("query", ""), **cfg)
+                self._db_drivers.append(src.driver)
+                self.authz.add(src)
             elif t == "built_in_database":
                 self.authz.add(BuiltInSource())
             elif t == "client_acl":
@@ -482,6 +487,13 @@ class NodeRuntime:
         started so far before re-raising — no leaked sockets/tasks."""
         log.info("node %s booting", self.node_name)
         try:
+            # pooled DB clients first: misconfiguration (bad host/AUTH)
+            # must fail the boot loudly, not degrade authn/authz to
+            # silent per-request fallthrough
+            for drv in self._db_drivers:
+                fn = getattr(drv, "start", None)
+                if fn is not None:
+                    await asyncio.to_thread(fn)
             if self.exhook is not None:
                 from .exhook import ExhookServerConfig
 
@@ -563,6 +575,13 @@ class NodeRuntime:
             await asyncio.to_thread(self.exhook.stop)
         if self.persistence is not None:
             self.persistence.tick()  # final dirty-page flush
+        for drv in self._db_drivers:
+            fn = getattr(drv, "stop", None)
+            if fn is not None:
+                try:
+                    await asyncio.to_thread(fn)
+                except Exception:
+                    log.exception("stopping db driver %r", drv)
         self.traces.stop_all()
 
     async def _ticker(self) -> None:

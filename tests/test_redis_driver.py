@@ -1,0 +1,457 @@
+"""Real Redis RESP driver over scripted sockets (round-2 VERDICT #5).
+
+A threaded in-test server speaks actual RESP2 (with reply fragmentation
+to exercise the incremental parser); the bundled `RedisDriver` drives
+it through authn, authz, and the connector resource layer — no external
+services, real wire protocol both ways, mirroring the reference's
+eredis-backed `emqx_connector_redis.erl` behavior.
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+from emqx_tpu import drivers
+from emqx_tpu.authn import DbAuthenticator, hash_password
+from emqx_tpu.authz import ALLOW, DENY, NOMATCH, DbSource
+from emqx_tpu.bridges.redis import (
+    RedisDriver,
+    RedisError,
+    encode_command,
+    _Conn,
+)
+
+
+class FakeRedisServer:
+    """Minimal RESP2 server: AUTH/SELECT/PING/GET/HGETALL/LPUSH.
+
+    `fragment=True` dribbles every reply in 3-byte chunks to exercise
+    the client's incremental reply reader."""
+
+    def __init__(self, password=None, hashes=None, strings=None,
+                 fragment=False):
+        self.password = password
+        self.hashes = hashes or {}
+        self.strings = strings or {}
+        self.fragment = fragment
+        self.conn_count = 0
+        self.drop_next = False  # close the next connection mid-command
+        self.conns = []  # live client sockets (for kill_all)
+        self.srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self.srv.getsockname()[1]
+        self._stop = False
+        self._threads = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def close(self):
+        self._stop = True
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+    def kill_all(self):
+        """Server 'restart': every live client socket dies at once."""
+        for c in self.conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.conns.clear()
+
+    # ------------------------------------------------------------ wire
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                c, _ = self.srv.accept()
+            except OSError:
+                return
+            self.conn_count += 1
+            self.conns.append(c)
+            t = threading.Thread(
+                target=self._serve, args=(c,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _read_request(self, buf, c):
+        """Parse one RESP array-of-bulk request; returns (args, rest)."""
+        def need(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = c.recv(4096)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+
+        def line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                need(len(buf) + 1)
+            i = buf.find(b"\r\n")
+            l, buf = buf[:i], buf[i + 2:]
+            return l
+
+        head = line()
+        assert head[:1] == b"*", head
+        n = int(head[1:])
+        args = []
+        for _ in range(n):
+            h = line()
+            assert h[:1] == b"$"
+            ln = int(h[1:])
+            need(ln + 2)
+            args.append(buf[:ln].decode())
+            buf = buf[ln + 2:]
+        return args, buf
+
+    def _send(self, c, data: bytes):
+        if self.fragment:
+            for i in range(0, len(data), 3):
+                c.sendall(data[i:i + 3])
+                time.sleep(0.0005)
+        else:
+            c.sendall(data)
+
+    def _serve(self, c):
+        buf = b""
+        authed = self.password is None
+        try:
+            while True:
+                args, buf = self._read_request(buf, c)
+                if self.drop_next:
+                    self.drop_next = False
+                    c.close()
+                    return
+                cmd = args[0].upper()
+                if cmd == "AUTH":
+                    if args[-1] == (self.password or ""):
+                        authed = True
+                        self._send(c, b"+OK\r\n")
+                    else:
+                        self._send(c, b"-WRONGPASS invalid password\r\n")
+                    continue
+                if not authed:
+                    self._send(c, b"-NOAUTH Authentication required.\r\n")
+                    continue
+                if cmd == "PING":
+                    self._send(c, b"+PONG\r\n")
+                elif cmd == "SELECT":
+                    self._send(c, b"+OK\r\n")
+                elif cmd == "GET":
+                    v = self.strings.get(args[1])
+                    if v is None:
+                        self._send(c, b"$-1\r\n")
+                    else:
+                        b_ = v.encode()
+                        self._send(c, b"$%d\r\n%s\r\n" % (len(b_), b_))
+                elif cmd == "HGETALL":
+                    h = self.hashes.get(args[1], {})
+                    out = [b"*%d\r\n" % (2 * len(h))]
+                    for k, v in h.items():
+                        for item in (k, str(v)):
+                            bi = item.encode()
+                            out.append(b"$%d\r\n%s\r\n" % (len(bi), bi))
+                    self._send(c, b"".join(out))
+                else:
+                    self._send(c, b"-ERR unknown command\r\n")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            c.close()
+
+
+@pytest.fixture
+def server():
+    servers = []
+
+    def make(**kw):
+        s = FakeRedisServer(**kw)
+        servers.append(s)
+        return s
+
+    yield make
+    for s in servers:
+        s.close()
+
+
+# ------------------------------------------------------------- protocol
+
+
+def test_encode_command_framing():
+    assert (
+        encode_command(("HGETALL", "k:1"))
+        == b"*2\r\n$7\r\nHGETALL\r\n$3\r\nk:1\r\n"
+    )
+    assert b"$2\r\n42\r\n" in encode_command(("SELECT", 42))
+
+
+def test_reply_parser_all_types():
+    """Feed a crafted byte stream (fragmented) through the reader."""
+    stream = (
+        b"+OK\r\n"
+        b":42\r\n"
+        b"$5\r\nhello\r\n"
+        b"$-1\r\n"
+        b"*3\r\n:1\r\n$1\r\na\r\n*1\r\n+ok\r\n"
+        b"*-1\r\n"
+        b"%1\r\n$1\r\nk\r\n:7\r\n"
+        b"_\r\n"
+        b"#t\r\n"
+        b",3.5\r\n"
+        b"-ERR boom\r\n"
+    )
+
+    class FakeSock:
+        def __init__(self, data):
+            self.data = data
+
+        def recv(self, n):
+            # dribble 1 byte at a time: worst-case fragmentation
+            b, self.data = self.data[:1], self.data[1:]
+            return b
+
+    conn = _Conn.__new__(_Conn)
+    conn.sock = FakeSock(stream)
+    conn.buf = b""
+    assert conn.read_reply() == "OK"
+    assert conn.read_reply() == 42
+    assert conn.read_reply() == "hello"
+    assert conn.read_reply() is None
+    assert conn.read_reply() == [1, "a", ["ok"]]
+    assert conn.read_reply() is None
+    assert conn.read_reply() == {"k": 7}
+    assert conn.read_reply() is None
+    assert conn.read_reply() is True
+    assert conn.read_reply() == 3.5
+    with pytest.raises(RedisError, match="boom"):
+        conn.read_reply()
+
+
+def test_nested_error_does_not_desync_connection():
+    """An error INSIDE an array (EXEC-style) must come back as a value,
+    with the rest of the array consumed — raising mid-parse would leave
+    the tail bytes to corrupt the connection's next reply."""
+
+    class FakeSock:
+        def __init__(self, data):
+            self.data = data
+
+        def recv(self, n):
+            b, self.data = self.data[:n], self.data[n:]
+            return b
+
+    conn = _Conn.__new__(_Conn)
+    conn.sock = FakeSock(b"*2\r\n-ERR inner\r\n$1\r\ny\r\n+NEXT\r\n")
+    conn.buf = b""
+    reply = conn.read_reply()
+    assert isinstance(reply[0], RedisError) and reply[1] == "y"
+    assert conn.read_reply() == "NEXT"  # connection still in sync
+
+
+# --------------------------------------------------------------- driver
+
+
+def test_driver_basic_commands(server):
+    s = server(
+        hashes={"h:1": {"f": "v", "n": "2"}},
+        strings={"greet": "hi"},
+        fragment=True,  # incremental parse against a dribbling server
+    )
+    d = RedisDriver(port=s.port, pool_size=2)
+    d.start()
+    assert d.health_check() is True
+    assert d.command("GET", "greet") == "hi"
+    assert d.command("GET", "nope") is None
+    assert d.command("HGETALL", "h:1") == {"f": "v", "n": "2"}
+    assert d.command("HGETALL", "missing") == {}
+    with pytest.raises(RedisError, match="unknown command"):
+        d.command("FLUSHALL")
+    d.stop()
+
+
+def test_driver_auth_and_select(server):
+    s = server(password="sekrit")
+    bad = RedisDriver(port=s.port, password="wrong")
+    with pytest.raises(RedisError, match="WRONGPASS"):
+        bad.start()
+    # no AUTH sent: the SELECT-on-connect trips the server's auth gate
+    noauth = RedisDriver(port=s.port, database=1)
+    with pytest.raises(RedisError, match="NOAUTH"):
+        noauth.start()
+    # and without any on-connect command, the first PING reports it
+    bare = RedisDriver(port=s.port)
+    assert bare.health_check() is False
+    good = RedisDriver(port=s.port, password="sekrit", database=3)
+    good.start()
+    assert good.health_check()
+    good.stop()
+
+
+def test_driver_reconnects_after_peer_close(server):
+    s = server(strings={"k": "v"})
+    d = RedisDriver(port=s.port, pool_size=1)
+    assert d.command("GET", "k") == "v"
+    s.drop_next = True  # server closes the pooled conn mid-command
+    assert d.command("GET", "k") == "v"  # retried on a fresh connection
+    assert s.conn_count == 2
+    d.stop()
+
+
+def test_driver_survives_server_restart(server):
+    """All pooled sockets dead at once (server restart): the retry must
+    flush the stale pool and dial fresh, not pop the next dead socket."""
+    s = server(strings={"k": "v"})
+    d = RedisDriver(port=s.port, pool_size=2)
+    # open two pooled connections
+    done = threading.Barrier(2)
+
+    def hold():
+        assert d.command("GET", "k") == "v"
+        done.wait()
+
+    ts = [threading.Thread(target=hold) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert s.conn_count == 2
+    s.kill_all()
+    time.sleep(0.05)
+    assert d.command("GET", "k") == "v"  # one retry, fresh dial
+    d.stop()
+
+
+def test_node_boots_loudly_on_bad_redis_and_stops_pool(server):
+    import os
+
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    from emqx_tpu.node import NodeRuntime
+
+    s = server(password="right")
+
+    def node(pw):
+        return NodeRuntime({
+            "authn": {"enable": True, "allow_anonymous": False},
+            "authentication": [{
+                "backend": "redis", "query": "mqtt_user:${username}",
+                "host": "127.0.0.1", "port": s.port, "password": pw,
+            }],
+            "listeners": [{"type": "tcp", "port": 0}],
+            "dashboard": {"listen_port": 0},
+        })
+
+    async def main():
+        bad = node("wrong")
+        with pytest.raises(RedisError, match="WRONGPASS"):
+            await bad.start()  # boot fails loudly, teardown ran
+        good = node("right")
+        await good.start()
+        drv = good._db_drivers[0]
+        assert drv.health_check()
+        await good.stop()
+        assert drv._stopped  # pool closed with the node
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_driver_pool_bounded(server):
+    s = server()
+    d = RedisDriver(port=s.port, pool_size=2)
+    errs = []
+
+    def hammer():
+        try:
+            for _ in range(20):
+                assert d.command("PING") == "PONG"
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=hammer) for _ in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    assert s.conn_count <= 2  # never more sockets than the pool size
+    d.stop()
+
+
+# ----------------------------------------------- authn/authz/connector
+
+
+class CI:
+    def __init__(self, username=None, clientid="c1", password=None):
+        self.username = username
+        self.clientid = clientid
+        self.password = password
+        self.peerhost = "127.0.0.1:999"
+
+
+def test_db_authenticator_over_real_sockets(server):
+    salt = b"\x01\x02"
+    h = hash_password(b"pw", salt, "sha256")
+    s = server(hashes={
+        "mqtt_user:alice": {
+            "password_hash": h, "salt": salt.hex(),
+            "algorithm": "sha256", "is_superuser": "1",
+        },
+    })
+    a = DbAuthenticator(
+        "redis", "mqtt_user:${username}", port=s.port, pool_size=2,
+    )
+    ok, info = a.authenticate(CI(username="alice", password=b"pw"))
+    assert ok == "allow" and info["is_superuser"]
+    bad, info = a.authenticate(CI(username="alice", password=b"no"))
+    assert bad == "deny"
+    ig, _ = a.authenticate(CI(username="nobody", password=b"pw"))
+    assert ig == "ignore"
+
+
+def test_db_authz_over_real_sockets(server):
+    s = server(hashes={
+        "mqtt_acl:alice": {"tele/+/up": "publish", "cmd/#": "subscribe"},
+    })
+    src = DbSource("redis", "mqtt_acl:${username}", port=s.port)
+    ci = CI(username="alice")
+    assert src.authorize(ci, "publish", "tele/3/up") == ALLOW
+    assert src.authorize(ci, "publish", "cmd/x") == NOMATCH
+    assert src.authorize(ci, "subscribe", "cmd/x") == ALLOW
+    assert src.authorize(ci, "subscribe", "other") == NOMATCH
+
+
+def test_db_connector_resource_layer(server):
+    from emqx_tpu.bridges.connectors import make_connector
+
+    s = server(strings={"a": "1"})
+
+    async def main():
+        conn = make_connector("redis", port=s.port, pool_size=1)
+        await conn.start()
+        assert await conn.health_check() is True
+        assert await conn.command("GET", "a") == "1"
+        await conn.stop()
+        assert await conn.health_check() is False  # stopped pool
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_builtin_redis_registered():
+    assert drivers.driver_available("redis")
+    # injected factory overrides the builtin, unregister restores it
+    sentinel = object()
+    drivers.register_driver("redis", lambda **cfg: sentinel)
+    try:
+        assert drivers.make_driver("redis") is sentinel
+    finally:
+        drivers.unregister_driver("redis")
+    assert drivers.driver_available("redis")
+    assert isinstance(drivers.make_driver("redis"), RedisDriver)
