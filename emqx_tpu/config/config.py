@@ -79,6 +79,101 @@ class Field:
             raise ConfigError(f"{path}: {value} > max {self.max}")
         return value
 
+    def to_openapi(self) -> Dict[str, Any]:
+        """OpenAPI schema object for this field — generated from the SAME
+        definition that validates config, so the REST doc and the
+        validator cannot disagree (`emqx_dashboard_swagger.erl:57-76`
+        single-source-of-truth)."""
+        kinds = {
+            "int": {"type": "integer"},
+            "float": {"type": "number"},
+            "bool": {"type": "boolean"},
+            "str": {"type": "string"},
+            "enum": {"type": "string"},
+            "map": {"type": "object"},
+            "list": {"type": "array", "items": {}},
+            "duration": {
+                "oneOf": [{"type": "string"}, {"type": "number"}],
+                "x-format": "duration (\"30s\", \"5m\", \"1h\" or seconds)",
+            },
+            "bytesize": {
+                "oneOf": [{"type": "string"}, {"type": "integer"}],
+                "x-format": "bytesize (\"1MB\", \"512KB\" or bytes)",
+            },
+        }
+        out: Dict[str, Any] = dict(kinds[self.type])
+        if self.enum:
+            out["enum"] = list(self.enum)
+        if self.min is not None:
+            out["minimum"] = self.min
+        if self.max is not None:
+            out["maximum"] = self.max
+        if self.default is not None:
+            out["default"] = self.default
+        if self.desc:
+            out["description"] = self.desc
+        return out
+
+
+@dataclass
+class Struct:
+    """A nested object schema (listener blocks, cluster section, ...).
+
+    ``open=True`` permits unknown keys (driver/TLS passthrough blocks),
+    mirroring how the reference keeps connector-specific config outside
+    the core schema."""
+
+    fields: Dict[str, Any]  # name -> Field | Struct | ListOf
+    desc: str = ""
+    open: bool = False
+
+    def check(self, path: str, value: Any) -> Any:
+        if not isinstance(value, dict):
+            raise ConfigError(f"{path}: expected object")
+        if not self.open:
+            unknown = set(value) - set(self.fields)
+            if unknown:
+                raise ConfigError(f"{path}: unknown keys {sorted(unknown)}")
+        for name, f in self.fields.items():
+            if name in value:
+                value[name] = f.check(f"{path}.{name}", value[name])
+        return value
+
+    def to_openapi(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "type": "object",
+            "properties": {
+                n: f.to_openapi() for n, f in self.fields.items()
+            },
+            # closed structs reject unknown keys at load — the doc must
+            # say so or doc and validator disagree
+            "additionalProperties": self.open,
+        }
+        if self.desc:
+            out["description"] = self.desc
+        return out
+
+
+@dataclass
+class ListOf:
+    """A list-of-objects schema (listeners, authentication chain, ...)."""
+
+    item: Any  # Field | Struct
+    desc: str = ""
+
+    def check(self, path: str, value: Any) -> Any:
+        if not isinstance(value, list):
+            raise ConfigError(f"{path}: expected list")
+        return [
+            self.item.check(f"{path}[{i}]", v) for i, v in enumerate(value)
+        ]
+
+    def to_openapi(self) -> Dict[str, Any]:
+        out = {"type": "array", "items": self.item.to_openapi()}
+        if self.desc:
+            out["description"] = self.desc
+        return out
+
 
 def parse_duration(v: Union[str, int, float]) -> float:
     if isinstance(v, (int, float)):
@@ -195,6 +290,94 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
     },
 }
 
+# Structured sections: schema-validated at load, documented in OpenAPI
+# from the same definitions (the `emqx_schema.erl` listener/cluster/authn
+# blocks).  `open` structs pass through backend-specific keys (driver
+# connection config, TLS blocks) the way the reference nests connector
+# schemas.
+_LISTENER = Struct({
+    "type": Field("enum", "tcp", enum=["tcp", "ssl", "ws", "wss", "quic"]),
+    "host": Field("str", "0.0.0.0"),
+    "port": Field("int", 1883, min=0, max=65535),
+    "zone": Field("str", desc="mqtt config overlay zone"),
+    "mountpoint": Field("str", desc="topic prefix for this listener"),
+    "max_connections": Field("int", 0, min=0, desc="0 = unlimited"),
+    "path": Field("str", "/mqtt", desc="ws/wss HTTP path"),
+    "ssl": Struct({}, open=True, desc="TLS block (certfile/keyfile/...)"),
+}, open=True)
+
+STRUCTURED: Dict[str, Any] = {
+    "listeners": ListOf(_LISTENER, desc="MQTT listeners"),
+    "cluster": Struct({
+        "enable": Field("bool", False),
+        "host": Field("str", "127.0.0.1"),
+        "port": Field("int", 0, min=0, max=65535),
+        "advertise_host": Field("str"),
+        "role": Field("enum", "core", enum=["core", "replicant"]),
+        "rpc_mode": Field("enum", "async", enum=["sync", "async"]),
+        "peers": Field("map", desc="name -> [host, port]"),
+        "discovery": Struct({
+            "strategy": Field("enum", "static",
+                              enum=["static", "dns", "etcd"]),
+            "interval": Field("duration", 5.0),
+        }, open=True),
+    }, open=True, desc="cluster membership (mria/ekka analog)"),
+    "authentication": ListOf(Struct({
+        "mechanism": Field("enum", "password_based",
+                           enum=["password_based", "scram", "jwt"]),
+        "backend": Field("str", "built_in_database",
+                         desc="built_in_database|jwt|scram|redis|mysql|..."),
+        "query": Field("str", desc="credential lookup template (${var})"),
+        "password_hash_algorithm": Field(
+            "enum", "pbkdf2_sha256",
+            enum=["pbkdf2_sha256", "sha256", "sha512", "bcrypt", "plain"]),
+        "iterations": Field("int", 10_000, min=1),
+        "user_id_type": Field("enum", "username",
+                              enum=["username", "clientid"]),
+        "users": Field("list", desc="seed users for built_in_database"),
+        "secret": Field("str", desc="jwt hmac secret"),
+    }, open=True), desc="authenticator chain (emqx_authn analog)"),
+    "authorization": ListOf(Struct({
+        "type": Field("str", "built_in_database",
+                      desc="file|built_in_database|client_acl|redis|..."),
+        "query": Field("str", desc="ACL lookup template (${var})"),
+        "rules": Field("list", desc="file source rules"),
+    }, open=True), desc="authz source chain (emqx_authz analog)"),
+    "gateways": ListOf(Struct({
+        "type": Field("enum", "mqttsn",
+                      enum=["mqttsn", "stomp", "coap", "lwm2m", "exproto"]),
+        "name": Field("str"),
+        "host": Field("str", "127.0.0.1"),
+        "port": Field("int", 0, min=0, max=65535),
+    }, open=True), desc="protocol gateways (emqx_gateway analog)"),
+    "exhook": ListOf(Struct({
+        "name": Field("str", "default"),
+        "host": Field("str", "127.0.0.1"),
+        "port": Field("int", 9000, min=0, max=65535),
+        "driver": Field("enum", "grpc", enum=["grpc", "json"]),
+        "pool_size": Field("int", 4, min=1),
+        "request_timeout": Field("duration", 5.0),
+        "failed_action": Field("enum", "deny", enum=["deny", "ignore"]),
+        "enable": Field("bool", True),
+    }), desc="out-of-process hook providers (emqx_exhook analog)"),
+    "rules": ListOf(Struct({
+        "id": Field("str"),
+        "sql": Field("str"),
+        "description": Field("str", ""),
+        "outputs": Field("list"),
+    }, open=True), desc="rule engine rules"),
+    "rewrite": ListOf(Struct({
+        "action": Field("enum", "all", enum=["all", "publish", "subscribe"]),
+        "source_topic": Field("str"),
+        "re": Field("str"),
+        "dest_topic": Field("str"),
+    }), desc="topic rewrite rules (emqx_rewrite analog)"),
+    "auto_subscribe": ListOf(Struct({
+        "topic": Field("str"),
+        "qos": Field("int", 0, min=0, max=2),
+    }), desc="server-side subscriptions on connect"),
+}
+
 ENV_PREFIX = "EMQX_TPU__"
 
 
@@ -203,6 +386,7 @@ class Config:
 
     def __init__(self, raw: Optional[Dict[str, Any]] = None, env: bool = True):
         self._conf: Dict[str, Dict[str, Any]] = {}
+        self._structured: Dict[str, Any] = {}
         self._zones: Dict[str, Dict[str, Dict[str, Any]]] = {}
         self._handlers: Dict[str, List[Callable]] = {}
         self.load(raw or {}, env=env)
@@ -210,6 +394,8 @@ class Config:
     # ------------------------------------------------------------- load
 
     def load(self, raw: Dict[str, Any], env: bool = True) -> None:
+        """Validate-everything-then-commit: a failing load leaves the
+        previous config fully intact, and never mutates `raw`."""
         conf: Dict[str, Dict[str, Any]] = {}
         for ns, fields in SCHEMA.items():
             conf[ns] = {}
@@ -222,15 +408,26 @@ class Config:
                     conf[ns][name] = f.check(f"{ns}.{name}", raw_ns[name])
                 else:
                     conf[ns][name] = copy.deepcopy(f.default)
-        self._conf = conf
-        # zones: named overlays over 'mqtt'
-        self._zones = {}
+        # structured sections (listeners/cluster/authn/...): validated +
+        # coerced copies against the same schema that documents them
+        structured: Dict[str, Any] = {}
+        for name, schema in STRUCTURED.items():
+            if name in raw and raw[name] is not None:
+                structured[name] = schema.check(
+                    name, copy.deepcopy(raw[name])
+                )
+        zones: Dict[str, Dict[str, Dict[str, Any]]] = {}
         for zname, overrides in (raw.get("zones") or {}).items():
-            self._add_zone(zname, overrides)
+            zones[zname] = self._check_zone(zname, overrides)
+        self._conf = conf
+        self._structured = structured
+        self._zones = zones
         if env:
             self._apply_env()
 
-    def _add_zone(self, zname: str, overrides: Dict[str, Any]) -> None:
+    def _check_zone(
+        self, zname: str, overrides: Dict[str, Any]
+    ) -> Dict[str, Dict[str, Any]]:
         zconf: Dict[str, Dict[str, Any]] = {}
         for ns, kv in overrides.items():
             if ns not in SCHEMA:
@@ -240,7 +437,7 @@ class Config:
                 if name not in SCHEMA[ns]:
                     raise ConfigError(f"zone {zname}: unknown key {ns}.{name}")
                 zconf[ns][name] = SCHEMA[ns][name].check(f"{zname}.{ns}.{name}", value)
-        self._zones[zname] = zconf
+        return zconf
 
     def _apply_env(self) -> None:
         for key, val in os.environ.items():
@@ -258,6 +455,8 @@ class Config:
     def get(self, path: str, zone: Optional[str] = None, default: Any = None) -> Any:
         ns, _, name = path.partition(".")
         if not name:
+            if ns in STRUCTURED:  # listeners/cluster/authentication/...
+                return self._structured.get(ns, default)
             out = dict(self._conf.get(ns, {}))
             if zone and zone in self._zones:
                 out.update(self._zones[zone].get(ns, {}))
@@ -280,8 +479,12 @@ class Config:
                 h(path, old, value)
         return value
 
-    def dump(self) -> Dict[str, Dict[str, Any]]:
-        return copy.deepcopy(self._conf)
+    def dump(self) -> Dict[str, Any]:
+        """Everything the schema governs: typed namespaces + validated
+        structured sections (matches the documented GET /configs shape)."""
+        out: Dict[str, Any] = copy.deepcopy(self._conf)
+        out.update(copy.deepcopy(self._structured))
+        return out
 
     def zones(self) -> List[str]:
         return list(self._zones)
@@ -296,19 +499,28 @@ class Config:
     # -------------------------------------------------------- describe
 
     @staticmethod
-    def describe() -> Dict[str, Any]:
-        """Schema description — drives the REST config API docs."""
+    def openapi_schemas() -> Dict[str, Any]:
+        """OpenAPI component schemas generated from the SAME definitions
+        that validate config (typed namespaces + structured sections) —
+        the `emqx_dashboard_swagger.erl:57-76` single source of truth:
+        a key cannot be documented differently than it is validated."""
         out: Dict[str, Any] = {}
         for ns, fields in SCHEMA.items():
-            out[ns] = {
-                name: {
-                    "type": f.type,
-                    "default": f.default,
-                    **({"enum": f.enum} if f.enum else {}),
-                    **({"desc": f.desc} if f.desc else {}),
-                }
-                for name, f in fields.items()
+            out[f"config.{ns}"] = {
+                "type": "object",
+                "properties": {
+                    name: f.to_openapi() for name, f in fields.items()
+                },
             }
+        for name, schema in STRUCTURED.items():
+            out[f"config.{name}"] = schema.to_openapi()
+        out["config"] = {
+            "type": "object",
+            "properties": {
+                key.split(".", 1)[1]: {"$ref": f"#/components/schemas/{key}"}
+                for key in out
+            },
+        }
         return out
 
 

@@ -881,5 +881,22 @@ tick(); setInterval(tick, 5000);
     def api_docs(self, req: Request):
         doc = self.http.openapi()
         if self.config is not None:
-            doc["components"]["schemas"] = {"config": self.config.describe()}
+            # component schemas come from the SAME Field/Struct defs that
+            # validate config (config.py openapi_schemas) — doc and
+            # validator cannot disagree by construction
+            doc["components"]["schemas"] = self.config.openapi_schemas()
+            ref = {"$ref": "#/components/schemas/config"}
+            content = {"application/json": {"schema": ref}}
+            base = self.http.base
+            cfg_get = doc["paths"].get(base + "/configs", {}).get("get")
+            if cfg_get is not None:
+                cfg_get["responses"]["200"]["content"] = content
+            one = doc["paths"].get(base + "/configs/{path}", {})
+            if "put" in one:
+                one["put"]["requestBody"] = {
+                    "content": {"application/json": {"schema": {
+                        "description": "value for the dotted config path; "
+                        "validated against the matching field schema",
+                    }}},
+                }
         return doc

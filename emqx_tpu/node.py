@@ -92,7 +92,7 @@ class NodeRuntime:
             engine = TopicMatchEngine(
                 space=space, min_batch=self.conf.get("engine.min_batch")
             )
-        cluster_cfg = raw.get("cluster") or {}
+        cluster_cfg = self.conf.get("cluster") or {}
         self.cluster = None
         if cluster_cfg.get("enable"):
             from .cluster.node import ClusterBroker, ClusterNode
@@ -166,12 +166,12 @@ class NodeRuntime:
             self.authn = AuthChain(
                 allow_anonymous=self.conf.get("authn.allow_anonymous")
             )
-            self._build_authenticators(raw.get("authentication") or [])
+            self._build_authenticators(self.conf.get("authentication") or [])
             self.authn.install(self.broker.hooks)
         self.authz = None
         if self.conf.get("authz.enable"):
             self.authz = AuthzChain(default=self.conf.get("authz.no_match"))
-            self._build_authz_sources(raw.get("authorization") or [])
+            self._build_authz_sources(self.conf.get("authorization") or [])
             self.authz.install(self.broker.hooks)
 
         # ---- modules (emqx_modules) ------------------------------------
@@ -190,7 +190,7 @@ class NodeRuntime:
                     regex=r["re"],
                     dest=r["dest_topic"],
                 )
-                for r in raw.get("rewrite") or []
+                for r in self.conf.get("rewrite") or []
             ]
         )
         self.rewrite.install(self.broker.hooks)
@@ -198,7 +198,7 @@ class NodeRuntime:
             self.broker,
             [
                 (t["topic"], SubOpts(qos=int(t.get("qos", 0))))
-                for t in raw.get("auto_subscribe") or []
+                for t in self.conf.get("auto_subscribe") or []
             ],
         )
         self.auto_subscribe.install(self.broker.hooks)
@@ -222,7 +222,7 @@ class NodeRuntime:
 
         # always present so the REST API can create rules at runtime
         self.rule_engine = RuleEngine(self.broker)
-        for idx, rd in enumerate(raw.get("rules") or []):
+        for idx, rd in enumerate(self.conf.get("rules") or []):
             self.rule_engine.create_rule(
                 rd.get("id", f"rule{idx}"),
                 rd["sql"],
@@ -232,7 +232,7 @@ class NodeRuntime:
 
         # ---- exhook (out-of-process providers, gRPC or framed JSON) ------
         self.exhook = None
-        self._exhook_defs = list(raw.get("exhook") or [])
+        self._exhook_defs = list(self.conf.get("exhook") or [])
         if self._exhook_defs:
             from .exhook import ExhookManager
 
@@ -250,14 +250,14 @@ class NodeRuntime:
             max_delay=self.conf.get("broker.batch_delay"),
         )
         self.listeners: List[Listener] = []
-        for ldef in raw.get("listeners") or [{"type": "tcp", "port": 1883}]:
+        for ldef in self.conf.get("listeners") or [{"type": "tcp", "port": 1883}]:
             self.listeners.append(self._build_listener(ldef))
 
         # ---- gateways (1.10) ----------------------------------------------
         from .gateway.core import GatewayRegistry
 
         self.gateways = GatewayRegistry()
-        for gd in raw.get("gateways") or []:
+        for gd in self.conf.get("gateways") or []:
             self.gateways.register(
                 gd.get("name", gd["type"]), self._build_gateway(gd)
             )

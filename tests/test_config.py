@@ -70,7 +70,65 @@ def test_units():
     assert parse_bytesize(123) == 123
 
 
-def test_describe_covers_schema():
-    d = Config.describe()
-    assert d["mqtt"]["max_inflight"]["type"] == "int"
-    assert "enum" in d["broker"]["shared_subscription_strategy"]
+def test_openapi_covers_schema():
+    """Every validated namespace/field must appear in the generated
+    OpenAPI components (the single-source-of-truth guarantee)."""
+    from emqx_tpu.config.config import SCHEMA
+
+    out = Config.openapi_schemas()
+    for ns, fields in SCHEMA.items():
+        props = out[f"config.{ns}"]["properties"]
+        assert set(props) == set(fields)
+
+
+def test_structured_sections_validated():
+    from emqx_tpu.config.config import Config, ConfigError
+
+    # valid sections pass and are type-coerced
+    c = Config({
+        "listeners": [{"type": "tcp", "port": "1883"}],
+        "exhook": [{"name": "x", "request_timeout": "5s"}],
+    }, env=False)
+    # bad enum value
+    import pytest as _pytest
+    with _pytest.raises(ConfigError, match="listeners"):
+        Config({"listeners": [{"type": "carrier-pigeon"}]}, env=False)
+    # closed struct rejects unknown keys
+    with _pytest.raises(ConfigError, match="unknown keys"):
+        Config({"exhook": [{"name": "x", "bogus": 1}]}, env=False)
+    # open struct passes backend-specific keys through
+    Config({"authentication": [
+        {"backend": "redis", "query": "k:${username}", "host": "h",
+         "port": 6379, "password": "p"},
+    ]}, env=False)
+    # port range enforced inside list items
+    with _pytest.raises(ConfigError, match="65535"):
+        Config({"listeners": [{"port": 700000}]}, env=False)
+
+
+def test_openapi_schemas_generated_from_validation_schema():
+    from emqx_tpu.config.config import Config, SCHEMA, STRUCTURED
+
+    out = Config.openapi_schemas()
+    # every validated namespace and structured section is documented
+    for ns, fields in SCHEMA.items():
+        doc = out[f"config.{ns}"]
+        assert doc["type"] == "object"
+        for name, f in fields.items():
+            prop = doc["properties"][name]
+            if f.enum:
+                assert prop["enum"] == f.enum  # same list object = same truth
+            if f.min is not None:
+                assert prop["minimum"] == f.min
+            if f.type == "duration":
+                assert {"type": "string"} in prop["oneOf"]
+    for name in STRUCTURED:
+        assert f"config.{name}" in out
+    # listener item schema carries the same enum the validator enforces
+    lst = out["config.listeners"]
+    assert lst["type"] == "array"
+    assert "quic" in lst["items"]["properties"]["type"]["enum"]
+    # the root config object references every component
+    refs = {v["$ref"] for v in out["config"]["properties"].values()}
+    assert f"#/components/schemas/config.mqtt" in refs
+    assert f"#/components/schemas/config.listeners" in refs

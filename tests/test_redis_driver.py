@@ -309,18 +309,14 @@ def test_driver_survives_server_restart(server):
     flush the stale pool and dial fresh, not pop the next dead socket."""
     s = server(strings={"k": "v"})
     d = RedisDriver(port=s.port, pool_size=2)
-    # open two pooled connections
-    done = threading.Barrier(2)
-
-    def hold():
-        assert d.command("GET", "k") == "v"
-        done.wait()
-
-    ts = [threading.Thread(target=hold) for _ in range(2)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join()
+    # deterministically open two pooled connections
+    c1 = d._checkout()
+    c2 = d._checkout()
+    d._checkin(c1)
+    d._checkin(c2)
+    deadline = time.time() + 2
+    while s.conn_count < 2 and time.time() < deadline:
+        time.sleep(0.01)  # accept-loop thread may lag the TCP handshake
     assert s.conn_count == 2
     s.kill_all()
     time.sleep(0.05)
