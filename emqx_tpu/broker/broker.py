@@ -350,11 +350,17 @@ class Broker:
 
     # ------------------------------------------------- retained delivery
 
-    def retained_for(self, filt: str, rh: int, is_new_sub: bool) -> List[Message]:
-        """Retained messages to deliver on subscribe (v5 retain-handling)."""
+    def retained_iter(self, filt: str, rh: int, is_new_sub: bool):
+        """Lazily yield retained messages for a new subscription (v5
+        retain-handling); large sets are consumed in paced batches by
+        the connection (flow control, `emqx_retainer.erl:85-150`)."""
         group, real = topiclib.parse_share(filt)
         if group is not None:
-            return []  # shared subscriptions never get retained messages
+            return iter(())  # shared subs never get retained messages
         if rh == 2 or (rh == 1 and not is_new_sub):
-            return []
-        return self.retainer.match_filter(real)
+            return iter(())
+        return self.retainer.iter_filter(real)
+
+    def retained_for(self, filt: str, rh: int, is_new_sub: bool) -> List[Message]:
+        """Retained messages to deliver on subscribe (v5 retain-handling)."""
+        return list(self.retained_iter(filt, rh, is_new_sub))

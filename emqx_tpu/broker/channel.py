@@ -10,6 +10,7 @@ reference's `{ok, Replies, Channel}` returns.
 
 from __future__ import annotations
 
+import itertools
 import time
 import uuid
 from dataclasses import dataclass, replace
@@ -47,6 +48,9 @@ class ChannelConfig:
     server_keepalive: Optional[int] = None
     max_clientid_len: int = 65535
     mountpoint: Optional[str] = None
+    # retained re-delivery flow control (emqx_retainer.erl:85-150)
+    retained_batch: int = 1000
+    retained_interval: float = 0.05
 
 
 class Channel:
@@ -568,13 +572,23 @@ class Channel:
                 self.broker.hooks.run(
                     "session.subscribed", (self.clientid, mounted, granted)
                 )
-            # retained messages (v5 retain-handling; v3 always sends)
+            # retained messages (v5 retain-handling; v3 always sends).
+            # Deliveries beyond one batch are paced by the connection
+            # (flow control, `emqx_retainer.erl:85-150`) so a huge
+            # retained set cannot starve the event loop or flood the
+            # socket in one burst.
             rh = granted.retain_handling if self.v5 else 0
-            for rmsg in self.broker.retained_for(mounted, rh, is_new):
+            rit = self.broker.retained_iter(mounted, rh, is_new)
+            _g, real = topiclib.parse_share(mounted)
+            for rmsg in itertools.islice(rit, self.cfg.retained_batch):
                 rmsg = replace(rmsg, headers=dict(rmsg.headers, retained=True))
-                _g, real = topiclib.parse_share(mounted)
                 for d in self.session.deliver([(real, rmsg)]):
                     acts.extend(self._delivery_to_send(d))
+            nxt = next(rit, None)
+            if nxt is not None:  # more than one batch: pace the rest
+                acts.append(
+                    ("retained_paced", real, itertools.chain([nxt], rit))
+                )
         self._m("packets.suback.sent")
         return [("send", pkt.SubAck(packet_id=p.packet_id, reason_codes=codes))] + acts
 
@@ -582,15 +596,18 @@ class Channel:
         self._m("packets.unsubscribe.received")
         self._m("client.unsubscribe")
         codes: List[int] = []
+        acts: List[Action] = []
         for tf in p.topic_filters:
             mounted = topiclib.mount_filter(self.cfg.mountpoint, tf)
             if self.session.unsubscribe(mounted) is not None:
                 self.broker.unsubscribe(self.clientid, mounted)
+                _g, real = topiclib.parse_share(mounted)
+                acts.append(("retained_stop", real))  # halt paced tail
                 codes.append(0)
             else:
                 codes.append(ReasonCode.NO_SUBSCRIPTION_EXISTED)
         self._m("packets.unsuback.sent")
-        return [("send", pkt.UnsubAck(packet_id=p.packet_id, reason_codes=codes))]
+        return [("send", pkt.UnsubAck(packet_id=p.packet_id, reason_codes=codes))] + acts
 
     # -- PING / DISCONNECT / AUTH -----------------------------------------
 

@@ -55,6 +55,7 @@ class Connection:
         self._normal = False
         self._last_rx = time.monotonic()
         self._retry_task: Optional[asyncio.Task] = None
+        self._paced_tasks: Dict[str, asyncio.Task] = {}
         # asyncio allows only one drain() waiter per transport
         self._drain_lock = asyncio.Lock()
 
@@ -78,6 +79,26 @@ class Connection:
                 asyncio.ensure_future(
                     self._cluster_sync(action[1], action[2])
                 )
+            elif kind == "retained_paced":
+                # flow-controlled retained re-delivery on subscribe;
+                # a re-subscribe supersedes the previous paced tail
+                real = action[1]
+                old = self._paced_tasks.pop(real, None)
+                if old is not None:
+                    old.cancel()
+                t = asyncio.ensure_future(
+                    self._paced_retained(real, action[2])
+                )
+                self._paced_tasks[real] = t
+                t.add_done_callback(
+                    lambda _t, r=real: self._paced_tasks.pop(r, None)
+                    if self._paced_tasks.get(r) is _t else None
+                )
+            elif kind == "retained_stop":
+                # UNSUBSCRIBE: the remaining retained tail must not flow
+                t = self._paced_tasks.pop(action[1], None)
+                if t is not None:
+                    t.cancel()
             elif kind == "close":
                 self._closing = arg if arg is not None else -1
                 self._normal = arg is None
@@ -210,7 +231,30 @@ class Connection:
             return False
         return time.monotonic() - self._last_rx >= ka * 1.5
 
+    async def _paced_retained(self, real: str, msgs) -> None:
+        """Deliver a large retained set in paced batches from the lazy
+        trie iterator (`emqx_retainer` flow control: batch_read_number +
+        deliver interval); stops silently when the connection closes."""
+        import itertools
+        from dataclasses import replace as _replace
+
+        batch = self.channel.cfg.retained_batch
+        ivl = self.channel.cfg.retained_interval
+        while self._closing is None:
+            chunk = list(itertools.islice(msgs, batch))
+            if not chunk:
+                return
+            self.channel.deliver([
+                (real, _replace(m, headers=dict(m.headers, retained=True)))
+                for m in chunk
+            ])
+            await self._drain()
+            await asyncio.sleep(ivl)
+
     async def _shutdown(self) -> None:
+        for t in list(self._paced_tasks.values()):
+            t.cancel()
+        self._paced_tasks.clear()
         try:
             await self._drain()
         except Exception:

@@ -1,0 +1,196 @@
+"""Disc persistence for retained messages.
+
+Analog of `emqx_retainer_mnesia.erl` disc copies: retained messages
+survive a broker restart.  Implementation is an append-only binary log
+of set/delete records with compaction — on load the log is replayed
+into the live trie; when dead records dominate, the file is rewritten
+as a snapshot of the live set.
+
+Record framing (little-endian):
+    [u8 op]  1=set 2=delete
+    [u32 header_len][header json utf-8]
+    [u32 payload_len][payload bytes]     (set only)
+header: topic, qos, retain, from, username, mid(hex), ts, props.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+from typing import Dict, Iterator, Optional, Tuple
+
+from .message import Message
+
+log = logging.getLogger("emqx_tpu.retain_store")
+
+_OP_SET = 1
+_OP_DEL = 2
+
+
+def _enc_val(v):
+    """JSON-encode any v5 property value losslessly (bytes, pair lists)."""
+    if isinstance(v, (bytes, bytearray)):
+        return {"__b": bytes(v).hex()}
+    if isinstance(v, (list, tuple)):
+        return {"__l": [_enc_val(x) for x in v]}
+    return v
+
+
+def _dec_val(v):
+    if isinstance(v, dict):
+        if "__b" in v:
+            return bytes.fromhex(v["__b"])
+        if "__l" in v:
+            return [_dec_val(x) for x in v["__l"]]
+    return v
+
+
+def _msg_header(msg: Message) -> bytes:
+    props = {str(k): _enc_val(v) for k, v in msg.properties.items()}
+    return json.dumps({
+        "topic": msg.topic,
+        "qos": msg.qos,
+        "from": msg.from_client,
+        "username": msg.from_username,
+        "mid": msg.mid.hex(),
+        "ts": msg.timestamp,
+        "props": props,
+    }).encode("utf-8")
+
+
+def _msg_from(header: dict, payload: bytes) -> Message:
+    props = {}
+    for k, v in (header.get("props") or {}).items():
+        v = _dec_val(v)
+        try:
+            props[int(k)] = v
+        except ValueError:
+            props[k] = v
+    return Message(
+        topic=header["topic"],
+        payload=payload,
+        qos=header.get("qos", 0),
+        retain=True,
+        from_client=header.get("from", ""),
+        from_username=header.get("username"),
+        mid=bytes.fromhex(header["mid"]),
+        timestamp=header.get("ts", 0),
+        properties=props,
+    )
+
+
+class DiscRetainStore:
+    """Append-log + compaction store (write-through from the Retainer)."""
+
+    def __init__(self, path: str, compact_ratio: int = 4):
+        self.path = path
+        self.compact_ratio = compact_ratio
+        self._records = 0  # total records in the log file
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+
+    # ------------------------------------------------------------- write
+
+    def set(self, msg: Message) -> None:
+        """Buffered append (no per-message flush: retained publish rides
+        the event loop; the node ticker calls flush())."""
+        hdr = _msg_header(msg)
+        self._f.write(struct.pack("<BI", _OP_SET, len(hdr)))
+        self._f.write(hdr)
+        self._f.write(struct.pack("<I", len(msg.payload)))
+        self._f.write(msg.payload)
+        self._records += 1
+
+    def delete(self, topic: str) -> None:
+        hdr = json.dumps({"topic": topic}).encode("utf-8")
+        self._f.write(struct.pack("<BI", _OP_DEL, len(hdr)))
+        self._f.write(hdr)
+        self._records += 1
+
+    def flush(self) -> None:
+        try:
+            self._f.flush()
+        except OSError:
+            log.exception("retain store flush")
+
+    def needs_compact(self, live_count: int) -> bool:
+        """True when dead records dominate — the Retainer then streams
+        its live set through compact() (bounds the log between restarts,
+        not just at load)."""
+        return self._records > self.compact_ratio * max(live_count, 1)
+
+    def compact(self, messages) -> None:
+        self._compact({m.topic: m for m in messages})
+
+    def close(self) -> None:
+        try:
+            self._f.flush()
+            self._f.close()
+        except OSError:
+            pass
+
+    # -------------------------------------------------------------- load
+
+    def _replay(self) -> Iterator[Tuple[int, dict, bytes]]:
+        with open(self.path, "rb") as f:
+            while True:
+                head = f.read(5)
+                if len(head) < 5:
+                    if head:
+                        log.warning("truncated record tail in %s", self.path)
+                    return
+                op, hlen = struct.unpack("<BI", head)
+                hdr_raw = f.read(hlen)
+                if len(hdr_raw) < hlen:
+                    log.warning("truncated header in %s", self.path)
+                    return
+                try:
+                    hdr = json.loads(hdr_raw)
+                except ValueError:
+                    log.warning("corrupt header in %s", self.path)
+                    return
+                payload = b""
+                if op == _OP_SET:
+                    plen_raw = f.read(4)
+                    if len(plen_raw) < 4:
+                        return
+                    (plen,) = struct.unpack("<I", plen_raw)
+                    payload = f.read(plen)
+                    if len(payload) < plen:
+                        return
+                yield op, hdr, payload
+
+    def load(self) -> Dict[str, Message]:
+        """Replay the log; compacts the file when dead records dominate."""
+        if not os.path.exists(self.path):
+            return {}
+        live: Dict[str, Message] = {}
+        n = 0
+        for op, hdr, payload in self._replay():
+            n += 1
+            topic = hdr.get("topic", "")
+            if op == _OP_SET:
+                live[topic] = _msg_from(hdr, payload)
+            else:
+                live.pop(topic, None)
+        self._records = n
+        live = {t: m for t, m in live.items() if not m.expired()}
+        if n > self.compact_ratio * max(len(live), 1):
+            self._compact(live)
+        return live
+
+    def _compact(self, live: Dict[str, Message]) -> None:
+        tmp = self.path + ".tmp"
+        self._f.close()
+        self._f = open(tmp, "wb")
+        self._records = 0
+        try:
+            for msg in live.values():
+                self.set(msg)
+            self._f.close()
+            os.replace(tmp, self.path)
+        finally:
+            self._f = open(self.path, "ab")
+        log.info("compacted %s to %d retained messages", self.path, len(live))

@@ -29,12 +29,19 @@ class _Node:
 
 
 class Retainer:
-    def __init__(self, max_retained: int = 0, max_payload: int = 0, enable: bool = True):
+    def __init__(self, max_retained: int = 0, max_payload: int = 0,
+                 enable: bool = True, store=None):
         self.root = _Node()
         self.count = 0
         self.max_retained = max_retained  # 0 = unlimited
         self.max_payload = max_payload
         self.enable = enable
+        # optional write-through disc store (emqx_retainer_mnesia disc
+        # copies); retained messages then survive a restart
+        self.store = store
+        if store is not None:
+            for msg in store.load().values():
+                self._insert(msg, persist=False)
 
     # ------------------------------------------------------------- store
 
@@ -50,13 +57,17 @@ class Retainer:
             return
         self._insert(msg)
 
-    def _insert(self, msg: Message) -> None:
+    def _insert(self, msg: Message, persist: bool = True) -> None:
         node = self.root
         for w in topiclib.words(msg.topic):
             node = node.children.setdefault(w, _Node())
         if node.msg is None:
             self.count += 1
         node.msg = msg
+        if persist and self.store is not None:
+            self.store.set(msg)
+            if self.store.needs_compact(self.count):
+                self.store.compact(self.walk_all())
 
     def get(self, topic: str) -> Optional[Message]:
         node = self.root
@@ -79,6 +90,10 @@ class Retainer:
             return False
         node.msg = None
         self.count -= 1
+        if self.store is not None:
+            self.store.delete(topic)
+            if self.store.needs_compact(self.count):
+                self.store.compact(self.walk_all())
         for i in range(len(ws) - 1, -1, -1):
             child = path[i + 1]
             if child.msg is not None or child.children:
@@ -88,42 +103,58 @@ class Retainer:
 
     # ------------------------------------------------------------ lookup
 
-    def match_filter(self, filt: str) -> List[Message]:
-        """All retained messages whose topic matches the filter."""
-        fw = topiclib.words(filt)
-        out: List[Message] = []
+    def walk_all(self):
+        """Every retained message, including $-topics (store compaction)."""
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            if n.msg is not None:
+                yield n.msg
+            stack.extend(n.children.values())
 
-        def walk(node: _Node, i: int, root: bool) -> None:
+    def iter_filter(self, filt: str):
+        """Lazily yield retained messages matching the filter.
+
+        A generator so large retained sets can be re-delivered in paced
+        batches without one synchronous full-trie collection blocking
+        the event loop at subscribe time (`emqx_retainer`'s batched
+        mnesia reads).  Each node's children are snapshotted when
+        visited, so concurrent retain/delete between batches is safe
+        (same read-committed looseness as the reference's continuations).
+        """
+        fw = topiclib.words(filt)
+        stack = [(self.root, 0, True)]
+        while stack:
+            node, i, root = stack.pop()
             if i == len(fw):
-                if node.msg is not None:
-                    out.append(node.msg)
-                return
+                if node.msg is not None and not node.msg.expired():
+                    yield node.msg
+                continue
             w = fw[i]
             if w == "#":
-                # matches zero or more levels (but not $-roots from a root #)
-                def subtree(n: _Node, at_root: bool) -> None:
-                    if n.msg is not None:
-                        out.append(n.msg)
-                    for name, c in n.children.items():
+                # matches zero+ levels (but not $-roots from a root #)
+                sub = [(node, True)]
+                while sub:
+                    n, at_root = sub.pop()
+                    if n.msg is not None and not n.msg.expired():
+                        yield n.msg
+                    for name, c in list(n.children.items()):
                         if at_root and root and name.startswith("$"):
                             continue
-                        subtree(c, False)
-
-                subtree(node, True)
-                return
-            if w == "+":
-                for name, c in node.children.items():
+                        sub.append((c, False))
+            elif w == "+":
+                for name, c in list(node.children.items()):
                     if root and name.startswith("$"):
                         continue
-                    walk(c, i + 1, False)
+                    stack.append((c, i + 1, False))
             else:
                 c = node.children.get(w)
                 if c is not None:
-                    walk(c, i + 1, False)
+                    stack.append((c, i + 1, False))
 
-        walk(self.root, 0, True)
-        out = [m for m in out if not m.expired()]
-        return out
+    def match_filter(self, filt: str) -> List[Message]:
+        """All retained messages whose topic matches the filter."""
+        return list(self.iter_filter(filt))
 
     def clean_expired(self) -> int:
         """GC expired retained messages; returns count removed."""

@@ -246,6 +246,14 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "enable": Field("bool", True),
         "max_retained_messages": Field("int", 0, min=0),
         "max_payload_size": Field("bytesize", 1 << 20),
+        "backend": Field("enum", "ram", enum=["ram", "disc"],
+                         desc="disc = retained messages survive restart"),
+        "flow_control_batch": Field(
+            "int", 1000, min=1,
+            desc="retained re-delivery batch size on subscribe"),
+        "flow_control_interval": Field(
+            "duration", 0.05,
+            desc="pause between retained re-delivery batches"),
     },
     "delayed": {"enable": Field("bool", True), "max_delayed_messages": Field("int", 0)},
     "authn": {"enable": Field("bool", False), "allow_anonymous": Field("bool", True)},
@@ -545,4 +553,6 @@ def channel_config_from(conf: Config, zone: Optional[str] = None):
         max_topic_alias=m["max_topic_alias"],
         server_keepalive=m["server_keepalive"] or None,
         max_clientid_len=m["max_clientid_len"],
+        retained_batch=conf.get("retainer.flow_control_batch"),
+        retained_interval=conf.get("retainer.flow_control_interval"),
     )

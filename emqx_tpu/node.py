@@ -68,10 +68,18 @@ class NodeRuntime:
         # ---- broker core (layer 1.7 + device engine) ------------------
         from .broker.retainer import Retainer
 
+        retain_store = None
+        if self.conf.get("retainer.backend") == "disc":
+            from .broker.retain_store import DiscRetainStore
+
+            retain_store = DiscRetainStore(
+                os.path.join(self.conf.get("node.data_dir"), "retained.log")
+            )
         retainer = Retainer(
             max_retained=self.conf.get("retainer.max_retained_messages"),
             max_payload=self.conf.get("retainer.max_payload_size"),
             enable=self.conf.get("retainer.enable"),
+            store=retain_store,
         )
         # engine choice: single-chip TopicMatchEngine (default) or the
         # mesh-sharded engine over every visible device (the v5e-8 path)
@@ -575,6 +583,8 @@ class NodeRuntime:
             await asyncio.to_thread(self.exhook.stop)
         if self.persistence is not None:
             self.persistence.tick()  # final dirty-page flush
+        if self.broker.retainer.store is not None:
+            self.broker.retainer.store.close()
         for drv in self._db_drivers:
             fn = getattr(drv, "stop", None)
             if fn is not None:
@@ -597,6 +607,8 @@ class NodeRuntime:
                 self.delayed.tick()
                 self.monitor.tick()
                 self._refresh_stats()
+                if self.broker.retainer.store is not None:
+                    self.broker.retainer.store.flush()
                 if now - last_hb >= hb_ivl:
                     last_hb = now
                     self.sys_heartbeat.tick()

@@ -1,0 +1,227 @@
+"""Retainer flow-controlled re-delivery + disc persistence.
+
+Round-2 VERDICT #7: paced retained re-delivery on subscribe
+(`emqx_retainer.erl:85-150`) and persistence of retained messages
+across a broker restart (`emqx_retainer_mnesia.erl` disc copies).
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.retain_store import DiscRetainStore
+from emqx_tpu.broker.retainer import Retainer
+
+
+# --------------------------------------------------------------- store
+
+
+def test_store_roundtrip_and_delete(tmp_path):
+    p = str(tmp_path / "r.log")
+    st = DiscRetainStore(p)
+    st.set(Message(topic="a/b", payload=b"x1", qos=1, retain=True,
+                   properties={1: "v", "user": "u"}))
+    st.set(Message(topic="c", payload=b"x2", retain=True))
+    st.set(Message(topic="a/b", payload=b"x3", retain=True))  # overwrite
+    st.delete("c")
+    st.close()
+
+    st2 = DiscRetainStore(p)
+    live = st2.load()
+    assert set(live) == {"a/b"}
+    m = live["a/b"]
+    assert m.payload == b"x3" and m.retain
+    st2.close()
+
+
+def test_store_compaction(tmp_path):
+    p = str(tmp_path / "r.log")
+    st = DiscRetainStore(p, compact_ratio=2)
+    for i in range(50):
+        st.set(Message(topic="t", payload=b"%d" % i, retain=True))
+    st.close()
+    size_before = os.path.getsize(p)
+    st2 = DiscRetainStore(p, compact_ratio=2)
+    live = st2.load()  # 50 records, 1 live -> compacts
+    assert live["t"].payload == b"49"
+    st2.close()
+    assert os.path.getsize(p) < size_before
+    # compacted file still loads
+    st3 = DiscRetainStore(p)
+    assert st3.load()["t"].payload == b"49"
+    st3.close()
+
+
+def test_store_tolerates_truncated_tail(tmp_path):
+    p = str(tmp_path / "r.log")
+    st = DiscRetainStore(p)
+    st.set(Message(topic="ok", payload=b"good", retain=True))
+    st.close()
+    with open(p, "ab") as f:
+        f.write(b"\x01\xff\xff")  # torn partial record (crash mid-write)
+    st2 = DiscRetainStore(p)
+    live = st2.load()
+    assert set(live) == {"ok"}
+    st2.close()
+
+
+def test_retainer_restores_from_store(tmp_path):
+    p = str(tmp_path / "r.log")
+    r1 = Retainer(store=DiscRetainStore(p))
+    r1.on_publish(Message(topic="s/1", payload=b"a", retain=True))
+    r1.on_publish(Message(topic="s/2", payload=b"b", retain=True))
+    r1.on_publish(Message(topic="s/1", payload=b"", retain=True))  # delete
+    r1.store.close()
+
+    r2 = Retainer(store=DiscRetainStore(p))
+    assert r2.count == 1
+    got = r2.match_filter("s/+")
+    assert [m.payload for m in got] == [b"b"]
+    r2.store.close()
+
+
+# ------------------------------------------------------------ e2e paced
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 60))
+    loop.close()
+
+
+def test_paced_redelivery_and_restart_survival(run, tmp_path):
+    """300 retained messages, flow batch 50: all arrive (paced); retained
+    set survives a full node stop/boot cycle on the same data dir."""
+
+    async def main():
+        from emqx_tpu.broker.client import MqttClient
+        from emqx_tpu.node import NodeRuntime
+
+        data = str(tmp_path)
+        conf = {
+            "node": {"data_dir": data},
+            "retainer": {"backend": "disc", "flow_control_batch": 50,
+                         "flow_control_interval": 0.01},
+            "listeners": [{"type": "tcp", "port": 0}],
+            "dashboard": {"listen_port": 0},
+        }
+        rt = NodeRuntime(conf)
+        await rt.start()
+        port = rt.listeners[0].port
+
+        pub = MqttClient("seeder")
+        await pub.connect(port=port)
+        for i in range(300):
+            await pub.publish(f"ret/{i}", b"p%d" % i, qos=0, retain=True)
+        await asyncio.sleep(0.2)  # batched publish path flushes
+        assert rt.broker.retainer.count == 300
+        await pub.disconnect()
+
+        sub = MqttClient("reader")
+        await sub.connect(port=port)
+        await sub.subscribe("ret/#", qos=0)
+        got = set()
+        while len(got) < 300:
+            m = await sub.recv(10)
+            assert m.retain
+            got.add(m.topic)
+        assert len(got) == 300
+        await sub.disconnect()
+        await rt.stop()
+
+        # ---- restart on the same data dir: retained set survives ----
+        rt2 = NodeRuntime(conf)
+        assert rt2.broker.retainer.count == 300
+        await rt2.start()
+        port2 = rt2.listeners[0].port
+        sub2 = MqttClient("reader2")
+        await sub2.connect(port=port2)
+        await sub2.subscribe("ret/7", qos=0)
+        m = await sub2.recv(10)
+        assert m.topic == "ret/7" and m.payload == b"p7"
+        await sub2.disconnect()
+        await rt2.stop()
+
+    run(main())
+
+
+def test_store_property_fidelity(tmp_path):
+    """v5 bytes + user-property-pair properties survive the disc store."""
+    from emqx_tpu.broker.packet import Property
+
+    p = str(tmp_path / "r.log")
+    st = DiscRetainStore(p)
+    props = {
+        Property.CORRELATION_DATA: b"\x00\x01binary",
+        Property.USER_PROPERTY: [("k1", "v1"), ("k2", "v2")],
+        Property.MESSAGE_EXPIRY_INTERVAL: 9999,
+        Property.CONTENT_TYPE: "text/plain",
+    }
+    st.set(Message(topic="p/t", payload=b"x", retain=True,
+                   properties=dict(props)))
+    st.close()
+    got = DiscRetainStore(p).load()["p/t"].properties
+    assert got[Property.CORRELATION_DATA] == b"\x00\x01binary"
+    assert [tuple(x) for x in got[Property.USER_PROPERTY]] == [
+        ("k1", "v1"), ("k2", "v2")]
+    assert got[Property.MESSAGE_EXPIRY_INTERVAL] == 9999
+
+
+def test_runtime_compaction_bounds_log(tmp_path):
+    """Repeated republish of one topic must not grow the log unboundedly
+    between restarts (compaction triggers from the live path)."""
+    p = str(tmp_path / "r.log")
+    r = Retainer(store=DiscRetainStore(p, compact_ratio=8))
+    for i in range(2000):
+        r.on_publish(Message(topic="hot", payload=b"%d" % i, retain=True))
+    r.store.flush()
+    assert r.store._records <= 16  # ratio * live(1) * slack, not 2000
+    r.store.close()
+    r2 = Retainer(store=DiscRetainStore(p))
+    assert r2.count == 1 and r2.get("hot").payload == b"1999"
+    r2.store.close()
+
+
+def test_unsubscribe_stops_paced_tail(run, tmp_path):
+    """UNSUBSCRIBE mid-pace: the retained tail must stop flowing."""
+
+    async def main():
+        from emqx_tpu.broker.client import MqttClient
+        from emqx_tpu.node import NodeRuntime
+
+        rt = NodeRuntime({
+            "node": {"data_dir": str(tmp_path)},
+            "retainer": {"flow_control_batch": 10,
+                         "flow_control_interval": 0.05},
+            "listeners": [{"type": "tcp", "port": 0}],
+            "dashboard": {"listen_port": 0},
+        })
+        await rt.start()
+        port = rt.listeners[0].port
+        from emqx_tpu.broker.message import Message as M
+        for i in range(500):
+            rt.broker.retainer.on_publish(
+                M(topic=f"u/{i}", payload=b"x", retain=True))
+        c = MqttClient("stopper")
+        await c.connect(port=port)
+        await c.subscribe("u/#", qos=0)
+        await c.recv(5)  # first batch flowing
+        await c.unsubscribe("u/#")
+        await asyncio.sleep(0.4)  # several pace intervals
+        # drain whatever was in flight; stream must have stopped well
+        # short of the full 500
+        got = 1
+        try:
+            while True:
+                await asyncio.wait_for(c.recv(0.3), 0.3)
+                got += 1
+        except (asyncio.TimeoutError, TimeoutError):
+            pass
+        assert got < 100, f"paced tail kept flowing: {got}"
+        await c.disconnect()
+        await rt.stop()
+
+    run(main())
