@@ -72,6 +72,16 @@ class Broker:
         # peers — the `emqx_router:do_add_route` replication point)
         self.on_route_added: Optional[callable] = None
         self.on_route_removed: Optional[callable] = None
+        # shared-group membership announcements + remote dispatch hooks
+        # (cluster layer; the mria shared_sub table analog).  A shared
+        # message is delivered by exactly ONE node: the origin picks
+        # local members first (or by the group's strategy), and falls
+        # back to a TARGETED forward to one member-holding peer — the
+        # generic route forward never dispatches shared groups.
+        self.on_shared_added: Optional[callable] = None  # (group, filt)
+        self.on_shared_removed: Optional[callable] = None
+        self.shared_remote_nodes: Optional[callable] = None  # -> Set[str]
+        self.forward_shared: Optional[callable] = None  # (node, msg, g, f)
 
     def _on_discard_session(self, session: Session) -> None:
         """Discarded session: drop its routes (kicked channels skip this)."""
@@ -94,14 +104,23 @@ class Broker:
         route = self._routes.get(fid)
         if route is None:
             route = self._routes[fid] = Route(filt=real)
-            if self.on_route_added is not None:
-                self.on_route_added(real)
         if group is None:
             added = self.subs.add(fid, clientid)
+            # DIRECT routes only ride the generic route table (shared
+            # membership is announced separately — a generic forward
+            # must not reach shared-only nodes)
+            if (
+                added
+                and self.subs.count(fid) == 1
+                and self.on_route_added is not None
+            ):
+                self.on_route_added(real)
         else:
             added = not self.shared.is_member(group, real, clientid)
-            self.shared.subscribe(group, real, clientid)
+            new_group = self.shared.subscribe(group, real, clientid)
             route.groups.add(group)
+            if new_group and self.on_shared_added is not None:
+                self.on_shared_added(group, real)
         if added:
             self._sub_count += 1
         else:
@@ -119,16 +138,22 @@ class Broker:
         if route is not None:
             if group is None:
                 removed = self.subs.remove(fid, clientid)
+                if (
+                    removed
+                    and not self.subs.count(fid)
+                    and self.on_route_removed is not None
+                ):
+                    self.on_route_removed(real)
             else:
                 removed = self.shared.is_member(group, real, clientid)
                 if self.shared.unsubscribe(group, real, clientid):
                     route.groups.discard(group)
+                    if self.on_shared_removed is not None:
+                        self.on_shared_removed(group, real)
             if removed:
                 self._sub_count -= 1
             if not self.subs.count(fid) and not route.groups:
                 del self._routes[fid]
-                if self.on_route_removed is not None:
-                    self.on_route_removed(real)
         if removed:
             # only an actual membership drops an engine reference — an
             # unsubscribe from a never-subscribed client is a no-op
@@ -147,7 +172,26 @@ class Broker:
             self.redispatch_shared_pending(session)
         for f in list(filters):
             self.unsubscribe(clientid, f)
-        self.shared.drop_member(clientid)
+        # stragglers not covered by the filters list: every removed
+        # membership holds one engine ref + one sub count, and an
+        # emptied group must release its route + announcement
+        for group, real, emptied in self.shared.drop_member(clientid):
+            self._sub_count -= 1
+            fid = self.engine.fid_of(real)
+            route = self._routes.get(fid) if fid is not None else None
+            if emptied:
+                if route is not None:
+                    route.groups.discard(group)
+                if self.on_shared_removed is not None:
+                    self.on_shared_removed(group, real)
+            if (
+                route is not None
+                and not self.subs.count(fid)
+                and not route.groups
+            ):
+                del self._routes[fid]
+            self.engine.remove_filter(real)
+        self.metrics.gauge_set("subscriptions.count", self._sub_count)
 
     @property
     def subscription_count(self) -> int:
@@ -206,7 +250,9 @@ class Broker:
                 self.metrics.inc("messages.dropped.no_subscribers")
                 self.hooks.run("message.dropped", (msg, "no_subscribers"))
 
-    def _dispatch(self, msg: Message, fids: Set[int]) -> int:
+    def _dispatch(
+        self, msg: Message, fids: Set[int], include_shared: bool = True
+    ) -> int:
         """Expand matched fids to receivers and deliver (`do_dispatch`).
 
         Expansion is vectorized through the subscriber-shard layer: one
@@ -223,13 +269,21 @@ class Broker:
             n += self._deliver_to(cid, filts, msg)
         # shared groups deliver one-at-a-time with failover so a dead
         # pick redispatches to a peer (`emqx_shared_sub:dispatch` retry)
-        for fid in fids:
-            route = self._routes.get(fid)
-            if route is None:
-                continue
-            for group in route.groups:
-                n += self._dispatch_shared(msg, group, route.filt)
+        if include_shared:
+            for fid in fids:
+                route = self._routes.get(fid)
+                if route is None:
+                    continue
+                for group in route.groups:
+                    n += self._dispatch_shared(msg, group, route.filt)
         return n
+
+    def dispatch_shared_forwarded(self, msg: Message, group: str, filt: str) -> int:
+        """Receiving side of a TARGETED shared forward: deliver to one
+        local member only — the origin owns cluster-wide responsibility
+        for this copy, so no further remote fallback (no loops)."""
+        self.metrics.inc("messages.forward.in")
+        return self._dispatch_shared(msg, group, filt, allow_remote=False)
 
     def _dispatch_shared(
         self,
@@ -237,11 +291,19 @@ class Broker:
         group: str,
         filt: str,
         exclude: Optional[Set[str]] = None,
+        allow_remote: bool = True,
     ) -> int:
         """Deliver to ONE group member, failing over across members until
         a delivery lands (`emqx_shared_sub.erl:118-130`).  The delivered
         copy is tagged with its (group, filter) so pending copies can be
-        redispatched if the member dies before acking."""
+        redispatched if the member dies before acking.
+
+        Cluster order of preference: live local members (per the group's
+        strategy), then a member-holding peer node (targeted forward),
+        then a parked local persistent session.  The `local` strategy
+        (`emqx_shared_sub.erl:61-66`) is this ordering by construction;
+        for the other strategies the local preference is a documented
+        approximation of the reference's cluster-wide member pick."""
         from dataclasses import replace
 
         tried: Set[str] = set(exclude or ())
@@ -274,6 +336,14 @@ class Broker:
                 return n
             tried.add(pick)
             self.shared.member_failed(group, filt, pick)
+        if allow_remote and self.shared_remote_nodes is not None:
+            nodes = list(self.shared_remote_nodes(group, filt))
+            self.shared._rng.shuffle(nodes)  # spread failover load
+            for node in nodes:
+                if self.forward_shared is not None and self.forward_shared(
+                    node, msg, group, filt
+                ):
+                    return 1
         if parked_fallback is not None:
             n = self._deliver_to(parked_fallback, [skey], tagged)
             if n > 0:

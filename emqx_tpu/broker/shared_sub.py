@@ -13,13 +13,26 @@ from __future__ import annotations
 import random
 from typing import Dict, List, Optional, Set, Tuple
 
-STRATEGIES = ("random", "round_robin", "sticky", "hash_clientid", "hash_topic")
+STRATEGIES = (
+    "random", "round_robin", "sticky", "hash_clientid", "hash_topic",
+    "local",
+)
 
 
 class SharedSub:
-    def __init__(self, strategy: str = "random", seed: Optional[int] = None):
-        assert strategy in STRATEGIES, strategy
+    def __init__(self, strategy: str = "random", seed: Optional[int] = None,
+                 group_strategies: Optional[Dict[str, str]] = None):
+        if strategy not in STRATEGIES:
+            raise ValueError(f"unknown shared-sub strategy {strategy!r}")
         self.strategy = strategy
+        # per-group overrides (`emqx_shared_sub.erl:61-66` strategy() is
+        # read per dispatch; the reference configs it per group in 5.x)
+        self.group_strategies: Dict[str, str] = dict(group_strategies or {})
+        for g, st in self.group_strategies.items():
+            if st not in STRATEGIES:
+                raise ValueError(
+                    f"group {g!r}: unknown shared-sub strategy {st!r}"
+                )
         self._rng = random.Random(seed)
         # (group, filter) -> ordered member clientids
         self._groups: Dict[Tuple[str, str], List[str]] = {}
@@ -30,11 +43,13 @@ class SharedSub:
         return clientid in self._groups.get((group, filt), ())
 
     def subscribe(self, group: str, filt: str, clientid: str) -> bool:
-        """Returns True if this (group, filter) is new (needs a route)."""
+        """Returns True if this (group, filter) is newly populated (the
+        caller announces it); a duplicate subscribe returns False."""
         key = (group, filt)
         members = self._groups.setdefault(key, [])
-        if clientid not in members:
-            members.append(clientid)
+        if clientid in members:
+            return False
+        members.append(clientid)
         return len(members) == 1
 
     def unsubscribe(self, group: str, filt: str, clientid: str) -> bool:
@@ -53,13 +68,25 @@ class SharedSub:
             return True
         return False
 
-    def drop_member(self, clientid: str) -> None:
-        """Remove a dead subscriber from every group (nodedown/kick analog)."""
+    def drop_member(self, clientid: str) -> List[Tuple[str, str, bool]]:
+        """Remove a dead subscriber from every group (nodedown/kick
+        analog); returns (group, filter, became_empty) per removed
+        membership so the caller can release refs/routes for each."""
+        removed: List[Tuple[str, str, bool]] = []
         for key in list(self._groups):
-            self.unsubscribe(key[0], key[1], clientid)
+            if clientid in self._groups.get(key, ()):
+                emptied = self.unsubscribe(key[0], key[1], clientid)
+                removed.append((key[0], key[1], emptied))
+        return removed
 
     def groups_for(self, filt: str) -> List[Tuple[str, str]]:
         return [k for k in self._groups if k[1] == filt]
+
+    def strategy_for(self, group: str) -> str:
+        return self.group_strategies.get(group, self.strategy)
+
+    def members(self, group: str, filt: str) -> List[str]:
+        return list(self._groups.get((group, filt), ()))
 
     def pick(
         self,
@@ -81,8 +108,11 @@ class SharedSub:
             members = [m for m in members or () if m not in exclude]
         if not members:
             return None
-        s = self.strategy
-        if s == "random":
+        s = self.strategy_for(group)
+        if s in ("random", "local"):
+            # 'local' restricts the candidate set to this node (the
+            # broker layer handles remote fallback); among local
+            # members it picks uniformly, like the reference
             return self._rng.choice(members)
         if s == "round_robin":
             i = self._rr.get(key, 0) % len(members)

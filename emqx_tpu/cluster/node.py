@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -43,16 +44,23 @@ class ClusterBroker(Broker):
     def publish_many(self, msgs: Sequence[Message]) -> List[int]:
         todo, results = self._prepare_publish(msgs)
         if self.cluster is not None and todo:
-            self.cluster.forward_publish([m for _, m in todo])
+            accepted = [m for _, m in todo]
+            self.cluster.forward_publish(accepted)
+            # shared groups with members ONLY on peers: targeted forward
+            # (exactly one delivery per group cluster-wide)
+            self.cluster.dispatch_remote_shared(accepted)
         self._match_dispatch(todo, results)
         return results
 
     def dispatch_forwarded(self, msg: Message) -> int:
-        """Receiving side of a remote forward: local match+dispatch only —
-        no 'message.publish' hooks, no retain, no re-forward (those ran on
-        the origin node; mirrors `emqx_broker:dispatch/2` on the target)."""
+        """Receiving side of a remote forward: local match+dispatch of
+        DIRECT subscriptions only — shared groups are the origin node's
+        responsibility (targeted forwards), so a generic forward must
+        never trigger a second group pick here.  No 'message.publish'
+        hooks, no retain, no re-forward (those ran on the origin;
+        mirrors `emqx_broker:dispatch/2` on the target)."""
         fids = self.engine.match([msg.topic])[0]
-        n = self._dispatch(msg, fids)
+        n = self._dispatch(msg, fids, include_shared=False)
         self.metrics.inc("messages.forward.in")
         return n
 
@@ -133,6 +141,7 @@ class ClusterNode:
         # local route oplog (this node is its single writer)
         self.seq = 0
         self._local_filters: Set[str] = set()
+        self._shared_rng = random.Random()
         self._status: Dict[str, str] = {}  # peer -> up|down
         self._resyncing: Set[str] = set()
         self._hb_task: Optional[asyncio.Task] = None
@@ -142,6 +151,14 @@ class ClusterNode:
 
         broker.on_route_added = self._route_added
         broker.on_route_removed = self._route_removed
+        # cluster-wide shared-subscription dispatch (one delivery per
+        # group across the cluster): membership rides the same oplog;
+        # shared messages use TARGETED forwards, never the generic one
+        broker.on_shared_added = self._shared_added
+        broker.on_shared_removed = self._shared_removed
+        broker.shared_remote_nodes = lambda g, f: self.remote.shared_nodes(g, f)
+        broker.forward_shared = self.forward_shared
+        self._local_shared: Set[Tuple[str, str]] = set()
         t = self.transport
         t.on_hello = self._on_hello
         t.on_route_op = self._on_route_op
@@ -325,7 +342,17 @@ class ClusterNode:
         self.seq += 1
         self._broadcast_op("del", filt)
 
-    def _broadcast_op(self, op: str, filt: str) -> None:
+    def _shared_added(self, group: str, filt: str) -> None:
+        self._local_shared.add((group, filt))
+        self.seq += 1
+        self._broadcast_op("adds", filt, group)
+
+    def _shared_removed(self, group: str, filt: str) -> None:
+        self._local_shared.discard((group, filt))
+        self.seq += 1
+        self._broadcast_op("dels", filt, group)
+
+    def _broadcast_op(self, op: str, filt: str, group: str = "") -> None:
         frame = tp.pack_json(
             tp.ROUTE_OP,
             {
@@ -334,6 +361,7 @@ class ClusterNode:
                 "seq": self.seq,
                 "op": op,
                 "filt": filt,
+                **({"group": group} if group else {}),
             },
         )
         for link in self.links.values():
@@ -341,7 +369,8 @@ class ClusterNode:
 
     def _on_route_op(self, peer: str, obj: dict) -> None:
         ok = self.remote.apply_op(
-            obj["node"], obj["incarnation"], obj["seq"], obj["op"], obj["filt"]
+            obj["node"], obj["incarnation"], obj["seq"], obj["op"],
+            obj["filt"], obj.get("group", ""),
         )
         if not ok:
             asyncio.get_running_loop().create_task(self._resync(obj["node"]))
@@ -372,7 +401,8 @@ class ClusterNode:
         try:
             resp = await link.request(tp.SNAPSHOT_REQ, {"node": self.name})
             self.remote.load_snapshot(
-                peer, resp["incarnation"], resp["seq"], resp["filters"]
+                peer, resp["incarnation"], resp["seq"], resp["filters"],
+                [tuple(x) for x in resp.get("shared", ())],
             )
             if self._status.get(peer) != "up":
                 self._status[peer] = "up"
@@ -432,6 +462,7 @@ class ClusterNode:
                         resp["incarnation"],
                         resp["seq"],
                         resp["filters"],
+                        [tuple(x) for x in resp.get("shared", ())],
                     )
                     return
         finally:
@@ -448,6 +479,7 @@ class ClusterNode:
             "incarnation": inc_seq[0],
             "seq": inc_seq[1],
             "filters": sorted(self.remote.filters_of(node)),
+            "shared": self.remote.shared_of(node),
         }
 
     def _on_snapshot_req(self, peer: str, obj: dict) -> dict:
@@ -455,6 +487,7 @@ class ClusterNode:
             "incarnation": self.incarnation,
             "seq": self.seq,
             "filters": sorted(self._local_filters),
+            "shared": sorted(self._local_shared),
         }
 
     # ----------------------------------------------------------- forwarding
@@ -530,6 +563,49 @@ class ClusterNode:
                 per_node.setdefault(node, []).append(msg)
         return per_node
 
+    def forward_shared(self, node: str, msg: Message, group: str,
+                       filt: str) -> bool:
+        """Targeted one-way forward: `node` delivers to ONE local member
+        of (group, filt).  Rides the forward frame with a shared tag, so
+        relaying through a core works unchanged."""
+        header, payload = message_to_wire(msg)
+        header["shared_group"] = group
+        header["shared_filt"] = filt
+        link = self.links.get(node)
+        if link is None or not link.connected:
+            relay = self._up_core_link(exclude=node)
+            if relay is None:
+                self.broker.metrics.inc("messages.forward.dropped")
+                return False
+            header["relay_to"] = node
+            ok = relay.send_nowait(tp.pack_forward(header, payload))
+        else:
+            ok = link.send_nowait(tp.pack_forward(header, payload))
+        if ok:
+            self.broker.metrics.inc("messages.forward.shared")
+        else:
+            self.broker.metrics.inc("messages.forward.dropped")
+        return bool(ok)
+
+    def dispatch_remote_shared(self, msgs: Sequence[Message]) -> int:
+        """Origin-side dispatch for shared groups that have NO local
+        member: pick one member-holding peer per (group, filt) and send
+        a targeted forward (groups with local members were already
+        served by the local dispatch, which itself falls back to
+        forward_shared when every local member fails)."""
+        n = 0
+        for msg in msgs:
+            for group, filt in self.remote.match_shared(msg.topic):
+                if self.broker.shared.members(group, filt):
+                    continue  # local dispatch owns this group
+                nodes = sorted(self.remote.shared_nodes(group, filt))
+                if not nodes:
+                    continue
+                node = nodes[self._shared_rng.randrange(len(nodes))]
+                if self.forward_shared(node, msg, group, filt):
+                    n += 1
+        return n
+
     def _on_forward(self, peer: str, header: dict, payload: bytes):
         relay_to = header.pop("relay_to", None)
         if relay_to and relay_to != self.name:
@@ -544,8 +620,15 @@ class ClusterNode:
             else:
                 self.broker.metrics.inc("messages.forward.dropped")
             return None
+        group = header.pop("shared_group", None)
+        filt = header.pop("shared_filt", None)
         msg = message_from_wire(header, payload)
-        n = self.broker.dispatch_forwarded(msg)
+        if group is not None:
+            # targeted shared delivery: local members only (the origin
+            # already owns cluster-wide responsibility for this copy)
+            n = self.broker.dispatch_shared_forwarded(msg, group, filt)
+        else:
+            n = self.broker.dispatch_forwarded(msg)
         return {"n": n} if header.get("id") is not None else None
 
     # ------------------------------------------------------------ rpc plane

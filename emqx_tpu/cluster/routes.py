@@ -30,6 +30,17 @@ class RemoteRoutes:
         self._filters_of: Dict[str, Set[str]] = {}
         # node -> (incarnation, last applied oplog seq)
         self.applied: Dict[str, Tuple[int, int]] = {}
+        # shared-group membership mirror (mria shared_sub table analog):
+        # (group, filt) -> nodes with members; host trie for topic match
+        from ..models.reference import CpuTrieIndex
+
+        self._shared: Dict[Tuple[str, str], Set[str]] = {}
+        self._shared_of: Dict[str, Set[Tuple[str, str]]] = {}
+        self._shared_trie = CpuTrieIndex()
+        self._shared_fids: Dict[str, int] = {}  # filt -> trie id
+        self._sid_back: Dict[int, str] = {}  # trie id -> filt
+        self._shared_groups_of: Dict[str, Set[str]] = {}  # filt -> groups
+        self._next_sid = 0
 
     # ----------------------------------------------------------- mutation
 
@@ -55,17 +66,74 @@ class RemoteRoutes:
                 if not nodes:
                     del self._nodes_of[fid]
 
+    def add_shared(self, node: str, group: str, filt: str) -> None:
+        key = (group, filt)
+        entries = self._shared_of.setdefault(node, set())
+        if key in entries:
+            return
+        entries.add(key)
+        self._shared.setdefault(key, set()).add(node)
+        groups = self._shared_groups_of.setdefault(filt, set())
+        groups.add(group)
+        if filt not in self._shared_fids:
+            sid = self._next_sid
+            self._next_sid += 1
+            self._shared_fids[filt] = sid
+            self._sid_back[sid] = filt
+            self._shared_trie.insert(filt, sid)
+
+    def del_shared(self, node: str, group: str, filt: str) -> None:
+        key = (group, filt)
+        entries = self._shared_of.get(node)
+        if entries is None or key not in entries:
+            return
+        entries.discard(key)
+        nodes = self._shared.get(key)
+        if nodes is not None:
+            nodes.discard(node)
+            if not nodes:
+                del self._shared[key]
+                groups = self._shared_groups_of.get(filt)
+                if groups is not None:
+                    groups.discard(group)
+                    if not groups:
+                        del self._shared_groups_of[filt]
+                        sid = self._shared_fids.pop(filt)
+                        del self._sid_back[sid]
+                        self._shared_trie.delete(filt, sid)
+
+    def shared_nodes(self, group: str, filt: str) -> Set[str]:
+        return set(self._shared.get((group, filt), ()))
+
+    def shared_of(self, node: str) -> List[Tuple[str, str]]:
+        return sorted(self._shared_of.get(node, set()))
+
+    def match_shared(self, topic: str) -> List[Tuple[str, str]]:
+        """(group, filter) pairs with remote members matching `topic`."""
+        out: List[Tuple[str, str]] = []
+        if not self._shared:
+            return out
+        for sid in self._shared_trie.match(topic):
+            filt = self._sid_back[sid]
+            for group in self._shared_groups_of.get(filt, ()):
+                out.append((group, filt))
+        return out
+
     def purge_node(self, node: str) -> int:
         """Drop all routes of a dead node (`emqx_router_helper` cleanup)."""
         filters = list(self._filters_of.get(node, set()))
         for filt in filters:
             self.delete(node, filt)
+        for group, filt in list(self._shared_of.get(node, set())):
+            self.del_shared(node, group, filt)
         self._filters_of.pop(node, None)
+        self._shared_of.pop(node, None)
         self.applied.pop(node, None)
         return len(filters)
 
     def load_snapshot(
-        self, node: str, incarnation: int, seq: int, filters: Sequence[str]
+        self, node: str, incarnation: int, seq: int, filters: Sequence[str],
+        shared: Sequence[Sequence[str]] = (),
     ) -> None:
         """Replace a peer's mirrored set wholesale (bootstrap/catch-up)."""
         old = self._filters_of.get(node, set())
@@ -74,9 +142,18 @@ class RemoteRoutes:
             self.delete(node, filt)
         for filt in new - old:
             self.add(node, filt)
+        old_sh = self._shared_of.get(node, set())
+        new_sh = {(g, f) for g, f in shared}
+        for g, f in old_sh - new_sh:
+            self.del_shared(node, g, f)
+        for g, f in new_sh - old_sh:
+            self.add_shared(node, g, f)
         self.applied[node] = (incarnation, seq)
 
-    def apply_op(self, node: str, incarnation: int, seq: int, op: str, filt: str) -> bool:
+    def apply_op(
+        self, node: str, incarnation: int, seq: int, op: str, filt: str,
+        group: str = "",
+    ) -> bool:
         """Apply one oplog entry; False => gap/restart, caller must resync."""
         inc, applied = self.applied.get(node, (None, None))
         if inc == incarnation and applied is not None and seq <= applied:
@@ -87,8 +164,12 @@ class RemoteRoutes:
             return False
         if op == "add":
             self.add(node, filt)
-        else:
+        elif op == "del":
             self.delete(node, filt)
+        elif op == "adds":  # shared-group membership appears on `node`
+            self.add_shared(node, group, filt)
+        elif op == "dels":
+            self.del_shared(node, group, filt)
         self.applied[node] = (incarnation, seq)
         return True
 

@@ -230,7 +230,11 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "shared_subscription_strategy": Field(
             "enum",
             "random",
-            enum=["random", "round_robin", "sticky", "hash_clientid", "hash_topic"],
+            enum=["random", "round_robin", "sticky", "hash_clientid",
+                  "hash_topic", "local"],
+        ),
+        "shared_subscription_group_strategies": Field(
+            "map", {}, desc="per-group strategy overrides (group -> strategy)"
         ),
         "batch_max": Field("int", 4096, min=1, desc="publish batch tick size"),
         "batch_delay": Field("duration", 0.002),

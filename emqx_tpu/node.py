@@ -100,12 +100,20 @@ class NodeRuntime:
             engine = TopicMatchEngine(
                 space=space, min_batch=self.conf.get("engine.min_batch")
             )
+        from .broker.shared_sub import SharedSub
+
+        shared = SharedSub(
+            strategy=self.conf.get("broker.shared_subscription_strategy"),
+            group_strategies=self.conf.get(
+                "broker.shared_subscription_group_strategies"
+            ),
+        )
         cluster_cfg = self.conf.get("cluster") or {}
         self.cluster = None
         if cluster_cfg.get("enable"):
             from .cluster.node import ClusterBroker, ClusterNode
 
-            self.broker: Broker = ClusterBroker(engine=engine, retainer=retainer)
+            self.broker: Broker = ClusterBroker(engine=engine, retainer=retainer, shared=shared)
             peers = {
                 name: (addr[0], int(addr[1]))
                 for name, addr in (cluster_cfg.get("peers") or {}).items()
@@ -143,7 +151,7 @@ class NodeRuntime:
             # cluster-wide config mutation log (emqx_conf/emqx_cluster_rpc)
             self.cluster_rpc = ClusterRpc(self.cluster)
         else:
-            self.broker = Broker(engine=engine, retainer=retainer)
+            self.broker = Broker(engine=engine, retainer=retainer, shared=shared)
 
         # ---- persistence (5.4 checkpoint/resume) -----------------------
         self.persistence = None
@@ -520,6 +528,20 @@ class NodeRuntime:
                             failed_action=d.get("failed_action", "deny"),
                         ),
                     )
+            # warm the engine's jit before serving: the first match pays
+            # XLA compilation (hundreds of ms), which would otherwise
+            # stall the event loop mid-traffic and trip the OLP shed
+            # (one compile per batch-size bucket; the min_batch bucket
+            # covers interactive publishes, bigger buckets compile lazily)
+            def _warm():
+                eng = self.broker.engine
+                eng.add_filter("$boot/warmup/+")
+                try:
+                    eng.match(["$boot/warmup/x"])
+                finally:
+                    eng.remove_filter("$boot/warmup/+")
+
+            await asyncio.to_thread(_warm)
             if self.persistence is not None:
                 # reload parked sessions (+ their routes) before serving;
                 # expired entries are GC'd by restore()

@@ -203,15 +203,74 @@ def test_rpc_publish_proxy(run):
     run(main())
 
 
-def test_shared_sub_local_pick_after_forward(run):
+def test_shared_sub_remote_only_targeted_forward(run):
+    """A group with members ONLY on a peer gets exactly one targeted
+    forward (shared membership is not a generic route anymore)."""
+
     async def main():
         n0, n1 = await start_cluster(2)
         attach(n1, "g1", "$share/g/job/+")
-        await wait_until(lambda: n0.remote.route_count == 1)
+        await wait_until(lambda: n0.remote.shared_nodes("g", "job/+"))
+        assert n0.remote.route_count == 0  # shared-only: no generic route
         n0.broker.publish(Message(topic="job/1", payload=b"w"))
         await wait_until(
             lambda: n1.broker.metrics.get("messages.delivered") == 1
         )
+        await stop_all([n0, n1])
+
+    run(main())
+
+
+def test_shared_sub_spanning_nodes_single_delivery(run):
+    """Group members on BOTH nodes: each publish delivers to exactly ONE
+    member cluster-wide (regression: generic forwards used to trigger a
+    second group pick on the peer)."""
+
+    async def main():
+        n0, n1 = await start_cluster(2)
+        a = attach(n0, "ma", "$share/g/t/1")
+        b = attach(n1, "mb", "$share/g/t/1")
+        await wait_until(lambda: n1.remote.shared_nodes("g", "t/1"))
+        await wait_until(lambda: n0.remote.shared_nodes("g", "t/1"))
+        for i in range(10):
+            n0.broker.publish(Message(topic="t/1", payload=b"%d" % i))
+        await asyncio.sleep(0.5)
+        total = len(a.got) + len(b.got)
+        assert total == 10, (len(a.got), len(b.got))
+        # origin prefers local members: all landed on n0's member
+        assert len(a.got) == 10
+        await stop_all([n0, n1])
+
+    run(main())
+
+
+def test_shared_sub_local_strategy_prefers_local(run):
+    """strategy 'local': with members on both nodes, the publishing
+    node's member always wins; with no local member, the remote one
+    still gets it (`emqx_shared_sub.erl:61-66`)."""
+
+    async def main():
+        n0, n1 = await start_cluster(2)
+        for n in (n0, n1):
+            n.broker.shared.group_strategies["g"] = "local"
+        a = attach(n0, "la", "$share/g/s/9")
+        b = attach(n1, "lb", "$share/g/s/9")
+        await wait_until(lambda: n0.remote.shared_nodes("g", "s/9"))
+        for i in range(6):
+            n0.broker.publish(Message(topic="s/9", payload=b"x"))
+        await asyncio.sleep(0.3)
+        assert len(a.got) == 6 and len(b.got) == 0
+        # publishing from n1: its local member wins there
+        for i in range(4):
+            n1.broker.publish(Message(topic="s/9", payload=b"y"))
+        await asyncio.sleep(0.3)
+        assert len(b.got) == 4 and len(a.got) == 6
+        # local member gone -> remote member receives via targeted forward
+        n0.broker.cm.channels.pop("la")
+        n0.broker.client_down("la", ["$share/g/s/9"])
+        await wait_until(lambda: not n1.remote.shared_nodes("g", "s/9"))
+        n0.broker.publish(Message(topic="s/9", payload=b"z"))
+        await wait_until(lambda: len(b.got) == 5)
         await stop_all([n0, n1])
 
     run(main())
