@@ -1,0 +1,160 @@
+"""Shared connection-pool base for the bundled DB drivers.
+
+The ecpool analog (`/root/reference/apps/emqx_plugin_libs/src/
+emqx_plugin_libs_pool.erl` + ecpool dep): every connector kind in the
+reference checks a worker out of a bounded pool, runs one command, and
+checks it back in; a dead worker is replaced by a fresh dial.  All the
+bundled wire-protocol drivers (redis/pgsql/mysql/mongodb/ldap) share
+that lifecycle, so it lives here once:
+
+* up to ``pool_size`` connections, created on demand, reused LIFO;
+* checkout blocks (bounded by ``timeout``) when the pool is exhausted;
+* a connection that dies mid-command is dropped, the WHOLE idle pool is
+  flushed (after a server restart every pooled socket is stale, not
+  just the one that failed), and the command retried once on a fresh
+  dial — the eredis/epgsql auto_reconnect behavior;
+* a *server-reported* error (wrong password, SQL error, unknown
+  command) leaves the connection in sync: it is checked back in and
+  the error raised without retry.  Subclasses declare which exception
+  types mean that via ``RECOVERABLE``.
+
+Subclass contract: implement ``_dial() -> conn`` (open socket + auth;
+raise loudly on failure) and give conns a ``close()``; set ``KIND`` and
+``RECOVERABLE``; run commands through ``self._run(lambda conn: ...)``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class PoolStopped(ConnectionError):
+    pass
+
+
+class PooledDriver:
+    KIND = "db"
+    RECOVERABLE: Tuple[type, ...] = ()
+
+    def __init__(self, pool_size: int = 4, timeout: float = 5.0):
+        self.pool_size = int(pool_size)
+        self.timeout = float(timeout)
+        self._idle: List[Any] = []
+        self._n_open = 0
+        self._lock = threading.Condition()
+        self._stopped = False
+
+    # ------------------------------------------------------------- dial
+
+    def _dial(self) -> Any:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @staticmethod
+    def _close_conn(conn: Any) -> None:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- pool
+
+    def _checkout(self) -> Any:
+        deadline = time.monotonic() + self.timeout
+        with self._lock:
+            while True:
+                if self._stopped:
+                    raise PoolStopped(f"{self.KIND} driver stopped")
+                if self._idle:
+                    return self._idle.pop()
+                if self._n_open < self.pool_size:
+                    self._n_open += 1
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(f"{self.KIND} pool exhausted")
+                self._lock.wait(left)
+        try:
+            return self._dial()
+        except Exception:
+            with self._lock:
+                self._n_open -= 1
+                self._lock.notify()
+            raise
+
+    def _checkin(self, conn: Optional[Any]) -> None:
+        with self._lock:
+            if conn is None or self._stopped:
+                self._n_open -= 1
+                if conn is not None:
+                    self._close_conn(conn)
+            else:
+                self._idle.append(conn)
+            self._lock.notify()
+
+    def _flush_idle(self) -> None:
+        """Drop every idle connection: after one socket dies (typically
+        a server restart) the rest of the pool is stale too — the retry
+        must dial fresh, not pop the next dead socket."""
+        with self._lock:
+            for c in self._idle:
+                self._close_conn(c)
+            self._n_open -= len(self._idle)
+            self._idle.clear()
+            self._lock.notify_all()
+
+    def _run(self, fn: Callable[[Any], Any], retryable: bool = True
+             ) -> Any:
+        """Checkout → fn(conn) → checkin, with the retry-once policy.
+
+        ``retryable=False`` is for non-idempotent commands (INSERT,
+        LPUSH, …): a socket that dies mid-command may have executed the
+        write server-side, so re-running it could duplicate it — the
+        stale pool is still flushed, but the error propagates instead
+        of replaying (epgsql/eredis redial without replay either)."""
+        last_err: Optional[Exception] = None
+        for _attempt in range(2):
+            conn = self._checkout()
+            try:
+                out = fn(conn)
+            except self.RECOVERABLE:
+                # server-reported error: the reply parse completed, the
+                # connection is in sync and safe to reuse
+                self._checkin(conn)
+                raise
+            except Exception as e:  # socket died: drop pool (+ retry)
+                self._close_conn(conn)
+                self._checkin(None)
+                self._flush_idle()
+                last_err = e
+                if not retryable:
+                    raise ConnectionError(
+                        f"{self.KIND} command failed (not retried: "
+                        f"non-idempotent): {last_err}"
+                    ) from e
+                continue
+            self._checkin(conn)
+            return out
+        raise ConnectionError(
+            f"{self.KIND} command failed after retry: {last_err}"
+        )
+
+    # --------------------------------------------------------- contract
+
+    def start(self) -> None:
+        """Open one connection eagerly so misconfiguration fails loudly
+        at resource start, not first use.  Clears a previous stop() so
+        the resource manager's stop→start restart cycle works."""
+        with self._lock:
+            self._stopped = False
+        self._checkin(self._checkout())
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            for c in self._idle:
+                self._close_conn(c)
+            self._n_open -= len(self._idle)
+            self._idle.clear()
+            self._lock.notify_all()

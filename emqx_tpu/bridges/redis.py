@@ -16,8 +16,9 @@ decoded Python values (str/int/list/None).
 from __future__ import annotations
 
 import socket
-import threading
-from typing import Any, List, Optional
+from typing import Any, Optional
+
+from .dbpool import PooledDriver
 
 _CRLF = b"\r\n"
 
@@ -140,13 +141,15 @@ class _Conn:
         return self.read_reply()
 
 
-class RedisDriver:
+class RedisDriver(PooledDriver):
     """Pooled Redis client satisfying the emqx_tpu driver contract.
 
-    Pool semantics mirror ecpool's checkout/checkin: up to `pool_size`
-    connections created on demand, reused round-robin; a connection
-    that errors is dropped and the command retried once on a fresh one
-    (the reference's eredis reconnect behavior)."""
+    Pool semantics come from PooledDriver (the ecpool analog): bounded
+    checkout/checkin, retry-once-on-fresh-dial when a socket dies (the
+    reference's eredis reconnect behavior)."""
+
+    KIND = "redis"
+    RECOVERABLE = (RedisError,)
 
     def __init__(
         self,
@@ -159,21 +162,14 @@ class RedisDriver:
         timeout: float = 5.0,
         **_ignored,
     ):
+        super().__init__(pool_size=pool_size, timeout=timeout)
         self.host = host
         self.port = int(port)
         self.password = password
         self.username = username
         self.database = int(database)
-        self.pool_size = int(pool_size)
-        self.timeout = float(timeout)
-        self._idle: List[_Conn] = []
-        self._n_open = 0
-        self._lock = threading.Condition()
-        self._stopped = False
 
-    # ------------------------------------------------------------- pool
-
-    def _connect(self) -> _Conn:
+    def _dial(self) -> _Conn:
         conn = _Conn(self.host, self.port, self.timeout)
         try:
             if self.password is not None:
@@ -188,96 +184,32 @@ class RedisDriver:
             raise
         return conn
 
-    def _checkout(self) -> _Conn:
-        import time as _time
-
-        deadline = _time.monotonic() + self.timeout
-        with self._lock:
-            while True:
-                if self._stopped:
-                    raise RedisError("driver stopped")
-                if self._idle:
-                    return self._idle.pop()
-                if self._n_open < self.pool_size:
-                    self._n_open += 1
-                    break
-                left = deadline - _time.monotonic()
-                if left <= 0:
-                    raise TimeoutError("redis pool exhausted")
-                self._lock.wait(left)
-        try:
-            return self._connect()
-        except Exception:
-            with self._lock:
-                self._n_open -= 1
-                self._lock.notify()
-            raise
-
-    def _checkin(self, conn: Optional[_Conn]) -> None:
-        with self._lock:
-            if conn is None or self._stopped:
-                self._n_open -= 1
-                if conn is not None:
-                    conn.close()
-            else:
-                self._idle.append(conn)
-            self._lock.notify()
-
     # --------------------------------------------------------- contract
 
-    def start(self) -> None:
-        """Open one connection eagerly so misconfiguration fails loudly
-        at resource start, not first use."""
-        self._checkin(self._checkout())
-
-    def stop(self) -> None:
-        with self._lock:
-            self._stopped = True
-            for c in self._idle:
-                c.close()
-            self._n_open -= len(self._idle)
-            self._idle.clear()
-            self._lock.notify_all()
-
-    def _flush_idle(self) -> None:
-        """Drop every idle connection: after one socket dies (typically a
-        server restart) the rest of the pool is stale too — the retry
-        must dial fresh, not pop the next dead socket."""
-        with self._lock:
-            for c in self._idle:
-                c.close()
-            self._n_open -= len(self._idle)
-            self._idle.clear()
-            self._lock.notify_all()
+    # read-only commands are replayed on a fresh dial after a socket
+    # death; writes (LPUSH, SET, ...) are not — they may have executed
+    # server-side before the connection died
+    _READ_COMMANDS = frozenset((
+        "GET", "MGET", "HGET", "HGETALL", "HMGET", "EXISTS", "KEYS",
+        "LRANGE", "SMEMBERS", "SISMEMBER", "ZRANGE", "ZSCORE", "TTL",
+        "TYPE", "STRLEN", "LLEN", "SCARD", "ZCARD", "HLEN", "SCAN",
+        "PING", "ECHO", "INFO", "TIME",
+    ))
 
     def command(self, *args) -> Any:
         """Run one command; HGETALL replies come back as dicts."""
-        last_err: Optional[Exception] = None
-        for _attempt in range(2):  # retry once on a fresh connection
-            conn = self._checkout()
-            try:
-                reply = conn.roundtrip(args)
-            except RedisError:
-                # top-level error reply: the parse completed, the
-                # connection is in sync and safe to reuse
-                self._checkin(conn)
-                raise
-            except Exception as e:  # socket died: drop pool + retry
-                conn.close()
-                self._checkin(None)
-                self._flush_idle()
-                last_err = e
-                continue
-            self._checkin(conn)
-            if (
-                isinstance(reply, list)
-                and args
-                and str(args[0]).upper() == "HGETALL"
-            ):
-                it = iter(reply)
-                return dict(zip(it, it))
-            return reply
-        raise ConnectionError(f"redis command failed after retry: {last_err}")
+        retryable = bool(args) and str(args[0]).upper() in \
+            self._READ_COMMANDS
+        reply = self._run(lambda conn: conn.roundtrip(args),
+                          retryable=retryable)
+        if (
+            isinstance(reply, list)
+            and args
+            and str(args[0]).upper() == "HGETALL"
+        ):
+            it = iter(reply)
+            return dict(zip(it, it))
+        return reply
 
     def health_check(self) -> bool:
         try:

@@ -4,10 +4,11 @@ The reference reaches MySQL/PgSQL/MongoDB/Redis/LDAP through pooled
 Erlang client deps (`rebar.config` ecpool/epgsql/eredis/...;
 `apps/emqx_connector/src/emqx_connector_{mysql,pgsql,redis,mongo}.erl`).
 
-**Redis ships as a REAL bundled driver** (`bridges/redis.py`: RESP wire
-protocol + pooling over stdlib sockets, the eredis analog).  The other
-kinds have no client library in this image, so the framework ships the
-*contract* and an injection point for them:
+**Redis and PostgreSQL ship as REAL bundled drivers** (`bridges/redis.py`:
+RESP wire protocol, the eredis analog; `bridges/pgsql.py`: protocol v3
+with MD5/SCRAM auth + extended queries, the epgsql analog — both pooled
+over stdlib sockets).  The other kinds have no client library in this
+image, so the framework ships the *contract* and an injection point:
 
 * a deployment registers a factory per kind —
   ``register_driver("mysql", lambda **cfg: MyAdapter(cfg))`` — wrapping
@@ -48,11 +49,18 @@ def _redis_factory(**cfg):
     return RedisDriver(**cfg)
 
 
+def _pgsql_factory(**cfg):
+    from .bridges.pgsql import PgDriver
+
+    return PgDriver(**cfg)
+
+
 # Kinds with a REAL bundled implementation (stdlib wire protocol, no
 # external client library).  register_driver() overrides them; the
 # remaining kinds stay injection points until a client is registered.
 _builtin: Dict[str, Callable[..., Any]] = {
     "redis": _redis_factory,
+    "pgsql": _pgsql_factory,
 }
 
 

@@ -1,0 +1,596 @@
+"""Real PostgreSQL wire-protocol driver over scripted sockets.
+
+A threaded in-test server speaks actual protocol v3 (startup, cleartext
+/MD5/SCRAM-SHA-256 auth, extended + simple query) and the bundled
+`PgDriver` drives it through authn, authz, and the connector resource
+layer — no external services, real wire bytes both ways, mirroring the
+reference's epgsql-backed `emqx_connector_pgsql.erl` behavior.
+"""
+
+import asyncio
+import base64
+import hashlib
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from emqx_tpu import drivers
+from emqx_tpu.authn import DbAuthenticator, hash_password
+from emqx_tpu.authz import ALLOW, DENY, NOMATCH, DbSource
+from emqx_tpu.bridges.pgsql import (
+    PgDriver,
+    PgError,
+    PgProtocolError,
+    md5_password,
+    template_to_wire,
+)
+from emqx_tpu.scram import _h, _hmac, _xor, derive_keys
+
+
+def _cstr(b):
+    return b + b"\x00"
+
+
+def _msg(t, payload=b""):
+    return t + struct.pack("!i", len(payload) + 4) + payload
+
+
+_SCRAM_SALT = b"pg-salt-16bytes!"
+_SCRAM_ITER = 4096
+
+# text-format type OIDs the server hands out
+TEXT, INT4, BOOL, FLOAT8 = 25, 23, 16, 701
+
+
+class FakePgServer:
+    """Minimal PostgreSQL v3 backend.
+
+    `handler(sql, args) -> (cols, rows)` supplies results: cols is a
+    list of (name, oid), rows a list of tuples of Optional[str] (text
+    format).  Raising ValueError in the handler produces an
+    ErrorResponse + ReadyForQuery (the in-sync failure path).
+    `fragment=True` dribbles replies in 3-byte chunks."""
+
+    def __init__(self, auth="trust", user="postgres", password=None,
+                 handler=None, fragment=False):
+        self.auth = auth
+        self.user = user
+        self.password = password
+        self.handler = handler or (lambda sql, args: ([("t", INT4)],
+                                                      [("1",)]))
+        self.fragment = fragment
+        self.conn_count = 0
+        self.drop_next = False
+        self.conns = []
+        self.srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self.srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def close(self):
+        self._stop = True
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+    def kill_all(self):
+        for c in self.conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.conns.clear()
+
+    # ------------------------------------------------------------ wire
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                c, _ = self.srv.accept()
+            except OSError:
+                return
+            self.conn_count += 1
+            self.conns.append(c)
+            threading.Thread(target=self._serve, args=(c,),
+                             daemon=True).start()
+
+    def _send(self, c, data):
+        if self.fragment:
+            for i in range(0, len(data), 3):
+                c.sendall(data[i:i + 3])
+                time.sleep(0.0002)
+        else:
+            c.sendall(data)
+
+    def _serve(self, c):
+        buf = b""
+
+        def need(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = c.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+
+        def read_startup():
+            nonlocal buf
+            need(4)
+            (ln,) = struct.unpack("!i", buf[:4])
+            need(ln)
+            payload, buf = buf[4:ln], buf[ln:]
+            assert struct.unpack("!i", payload[:4])[0] == 196608
+            kv = payload[4:].split(b"\x00")
+            pairs = dict(zip(kv[0::2], kv[1::2]))
+            return pairs.get(b"user", b"").decode()
+
+        def read_msg():
+            nonlocal buf
+            need(5)
+            t = buf[:1]
+            (ln,) = struct.unpack("!i", buf[1:5])
+            need(1 + ln)
+            payload, buf = buf[5:1 + ln], buf[1 + ln:]
+            return t, payload
+
+        try:
+            user = read_startup()
+            if not self._authenticate(c, user, read_msg):
+                return
+            self._send(c, _msg(b"S", _cstr(b"server_version")
+                               + _cstr(b"14.0"))
+                       + _msg(b"K", struct.pack("!ii", 1234, 5678))
+                       + _msg(b"Z", b"I"))
+            self._query_loop(c, read_msg)
+        except (ConnectionError, OSError, AssertionError):
+            pass
+        finally:
+            c.close()
+
+    def _authenticate(self, c, user, read_msg):
+        if user != self.user:
+            self._send(c, _msg(b"E", b"SFATAL\x00C28000\x00M"
+                               + _cstr(b"role does not exist")) )
+            return False
+        if self.auth == "trust":
+            self._send(c, _msg(b"R", struct.pack("!i", 0)))
+            return True
+        if self.auth == "clear":
+            self._send(c, _msg(b"R", struct.pack("!i", 3)))
+            t, payload = read_msg()
+            assert t == b"p"
+            if payload.rstrip(b"\x00").decode() == self.password:
+                self._send(c, _msg(b"R", struct.pack("!i", 0)))
+                return True
+        elif self.auth == "md5":
+            salt = b"\x01\x02\x03\x04"
+            self._send(c, _msg(b"R", struct.pack("!i", 5) + salt))
+            t, payload = read_msg()
+            assert t == b"p"
+            want = md5_password(self.user, self.password, salt)
+            if payload.rstrip(b"\x00") == want:
+                self._send(c, _msg(b"R", struct.pack("!i", 0)))
+                return True
+        elif self.auth == "scram":
+            if self._scram(c, read_msg):
+                self._send(c, _msg(b"R", struct.pack("!i", 0)))
+                return True
+            # fall through to the ErrorResponse like clear/md5
+        self._send(c, _msg(b"E", b"SFATAL\x00C28P01\x00M"
+                           + _cstr(b"password authentication failed")))
+        return False
+
+    def _scram(self, c, read_msg):
+        self._send(c, _msg(b"R", struct.pack("!i", 10)
+                           + _cstr(b"SCRAM-SHA-256") + b"\x00"))
+        t, payload = read_msg()
+        assert t == b"p"
+        i = payload.index(b"\x00")
+        assert payload[:i] == b"SCRAM-SHA-256"
+        (ln,) = struct.unpack("!i", payload[i + 1:i + 5])
+        first = payload[i + 5:i + 5 + ln].decode()
+        assert first.startswith("n,,")
+        bare = first[3:]
+        cnonce = dict(a.split("=", 1) for a in bare.split(","))["r"]
+        snonce = cnonce + "SRVNONCE"
+        server_first = (f"r={snonce},"
+                        f"s={base64.b64encode(_SCRAM_SALT).decode()},"
+                        f"i={_SCRAM_ITER}")
+        self._send(c, _msg(b"R", struct.pack("!i", 11)
+                           + server_first.encode()))
+        t, payload = read_msg()
+        assert t == b"p"
+        final = payload.decode()
+        attrs = dict(a.split("=", 1) for a in final.split(","))
+        if attrs["r"] != snonce:
+            return False
+        without_proof = final[:final.rfind(",p=")]
+        auth_msg = (bare + "," + server_first + ","
+                    + without_proof).encode()
+        stored, server_key = derive_keys(
+            self.password.encode(), _SCRAM_SALT, _SCRAM_ITER
+        )
+        client_sig = _hmac(stored, auth_msg)
+        proof = base64.b64decode(attrs["p"])
+        client_key = _xor(proof, client_sig)
+        if _h(client_key) != stored:
+            return False
+        server_sig = _hmac(server_key, auth_msg)
+        v = b"v=" + base64.b64encode(server_sig)
+        self._send(c, _msg(b"R", struct.pack("!i", 12) + v))
+        return True
+
+    # ----------------------------------------------------------- query
+
+    def _query_loop(self, c, read_msg):
+        sql, args = None, []
+        while True:
+            t, payload = read_msg()
+            if self.drop_next:
+                self.drop_next = False
+                c.close()
+                return
+            if t == b"X":
+                return
+            if t == b"Q":
+                self._respond(c, payload.rstrip(b"\x00").decode(), [],
+                              simple=True)
+            elif t == b"P":
+                i = payload.index(b"\x00")
+                j = payload.index(b"\x00", i + 1)
+                sql = payload[i + 1:j].decode()
+            elif t == b"B":
+                off = payload.index(b"\x00") + 1
+                off = payload.index(b"\x00", off) + 1
+                (nfmt,) = struct.unpack("!h", payload[off:off + 2])
+                off += 2 + 2 * nfmt
+                (nargs,) = struct.unpack("!h", payload[off:off + 2])
+                off += 2
+                args = []
+                for _ in range(nargs):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln < 0:
+                        args.append(None)
+                    else:
+                        args.append(payload[off:off + ln].decode())
+                        off += ln
+            elif t == b"S":
+                self._respond(c, sql, args, simple=False)
+                sql, args = None, []
+            # D (describe) and E (execute) need no tracking here
+
+    def _respond(self, c, sql, args, simple):
+        out = b"" if simple else _msg(b"1") + _msg(b"2")
+        try:
+            cols, rows = self.handler(sql, args)
+        except ValueError as e:
+            out += _msg(b"E", b"SERROR\x00C42601\x00M"
+                        + _cstr(str(e).encode()))
+            out += _msg(b"Z", b"I")
+            self._send(c, out)
+            return
+        desc = struct.pack("!h", len(cols))
+        for name, oid in cols:
+            desc += _cstr(name.encode())
+            desc += struct.pack("!ihihih", 0, 0, oid, -1, -1, 0)
+        out += _msg(b"T", desc)
+        for row in rows:
+            d = struct.pack("!h", len(row))
+            for v in row:
+                if v is None:
+                    d += struct.pack("!i", -1)
+                else:
+                    vb = v.encode()
+                    d += struct.pack("!i", len(vb)) + vb
+            out += _msg(b"D", d)
+        out += _msg(b"C", _cstr(b"SELECT %d" % len(rows)))
+        out += _msg(b"Z", b"I")
+        self._send(c, out)
+
+
+@pytest.fixture
+def server():
+    servers = []
+
+    def make(**kw):
+        s = FakePgServer(**kw)
+        servers.append(s)
+        return s
+
+    yield make
+    for s in servers:
+        s.close()
+
+
+# ------------------------------------------------------------ template
+
+
+def test_template_to_wire():
+    sql, order = template_to_wire(
+        "SELECT h FROM u WHERE username = ${username} "
+        "AND clientid = ${clientid} OR peer = ${username}"
+    )
+    assert sql == ("SELECT h FROM u WHERE username = $1 "
+                   "AND clientid = $2 OR peer = $1")
+    assert order == ["username", "clientid"]
+    assert template_to_wire("SELECT 1") == ("SELECT 1", [])
+
+
+def test_md5_password_vector():
+    # md5(md5('secret' + 'bob') + salt) computed independently
+    inner = hashlib.md5(b"secretbob").hexdigest().encode()
+    want = b"md5" + hashlib.md5(inner + b"\x01\x02\x03\x04").hexdigest(
+        ).encode()
+    assert md5_password("bob", "secret", b"\x01\x02\x03\x04") == want
+
+
+# -------------------------------------------------------------- driver
+
+
+def test_query_types_and_params(server):
+    seen = {}
+
+    def handler(sql, args):
+        seen["sql"], seen["args"] = sql, args
+        return (
+            [("name", TEXT), ("n", INT4), ("ok", BOOL),
+             ("score", FLOAT8), ("gone", TEXT)],
+            [("alice", "7", "t", "1.5", None),
+             ("bob", "-2", "f", "0.25", "x")],
+        )
+
+    s = server(handler=handler, fragment=True)
+    d = PgDriver(port=s.port, pool_size=2)
+    rows = d.query("SELECT * FROM t WHERE u = ${username}",
+                   {"username": "alice"})
+    assert seen["sql"] == "SELECT * FROM t WHERE u = $1"
+    assert seen["args"] == ["alice"]
+    assert rows == [
+        {"name": "alice", "n": 7, "ok": True, "score": 1.5, "gone": None},
+        {"name": "bob", "n": -2, "ok": False, "score": 0.25, "gone": "x"},
+    ]
+    assert d.health_check() is True
+    d.stop()
+
+
+def test_auth_cleartext(server):
+    s = server(auth="clear", password="pw")
+    good = PgDriver(port=s.port, password="pw")
+    good.start()
+    assert good.health_check()
+    good.stop()
+    bad = PgDriver(port=s.port, password="nope")
+    with pytest.raises(PgError, match="28P01"):
+        bad.start()
+
+
+def test_auth_md5(server):
+    s = server(auth="md5", password="pw")
+    good = PgDriver(port=s.port, password="pw")
+    good.start()
+    good.stop()
+    with pytest.raises(PgError, match="password authentication"):
+        PgDriver(port=s.port, password="wrong").start()
+
+
+def test_auth_scram(server):
+    s = server(auth="scram", password="sekrit")
+    good = PgDriver(port=s.port, password="sekrit")
+    good.start()
+    assert good.command("SELECT 1") == [{"t": 1}]
+    good.stop()
+    with pytest.raises(PgError, match="password authentication"):
+        PgDriver(port=s.port, password="wrong").start()
+
+
+def test_auth_unknown_role_fails_loudly(server):
+    s = server(user="admin")
+    with pytest.raises(PgError, match="role does not exist"):
+        PgDriver(port=s.port, username="ghost").start()
+
+
+def test_query_error_keeps_connection_in_sync(server):
+    def handler(sql, args):
+        if "boom" in sql:
+            raise ValueError("syntax error at boom")
+        return ([("t", INT4)], [("1",)])
+
+    s = server(handler=handler)
+    d = PgDriver(port=s.port, pool_size=1)
+    with pytest.raises(PgError, match="syntax error"):
+        d.query("SELECT boom", {})
+    # same pooled connection still works: no reconnect happened
+    assert d.query("SELECT 1", {}) == [{"t": 1}]
+    assert s.conn_count == 1
+    d.stop()
+
+
+def test_reconnects_after_peer_close(server):
+    s = server()
+    d = PgDriver(port=s.port, pool_size=1)
+    assert d.query("SELECT 1", {}) == [{"t": 1}]
+    s.drop_next = True
+    assert d.query("SELECT 1", {}) == [{"t": 1}]  # fresh dial + retry
+    assert s.conn_count == 2
+    d.stop()
+
+
+def test_survives_server_restart(server):
+    s = server()
+    d = PgDriver(port=s.port, pool_size=2)
+    c1, c2 = d._checkout(), d._checkout()
+    d._checkin(c1)
+    d._checkin(c2)
+    deadline = time.time() + 2
+    while s.conn_count < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    s.kill_all()
+    time.sleep(0.05)
+    assert d.query("SELECT 1", {}) == [{"t": 1}]
+    d.stop()
+
+
+def test_restart_cycle_after_stop(server):
+    """The resource manager's stop→start restart cycle must work: a
+    stopped pool can be started again (round-3 review finding)."""
+    s = server()
+    d = PgDriver(port=s.port, pool_size=1)
+    d.start()
+    d.stop()
+    assert d.health_check() is False  # stopped
+    d.start()  # restart clears the stopped flag
+    assert d.health_check() is True
+    d.stop()
+
+
+def test_write_not_retried_on_socket_death(server):
+    """A mid-command socket death on a non-idempotent statement must
+    NOT replay it (it may have committed server-side): the error
+    propagates and the pool recovers on the next command."""
+    executed = []
+
+    def handler(sql, args):
+        executed.append(sql)
+        return ([("t", INT4)], [("1",)])
+
+    s = server(handler=handler)
+    d = PgDriver(port=s.port, pool_size=1)
+    assert d.query("SELECT 1", {}) == [{"t": 1}]
+    s.drop_next = True
+    with pytest.raises(ConnectionError, match="not retried"):
+        d.query("INSERT INTO t VALUES (${v})", {"v": "x"})
+    # the INSERT was sent once, never replayed
+    assert not any("INSERT" in sql for sql in executed)
+    # pool recovered: fresh dial on the next (read) command
+    assert d.query("SELECT 1", {}) == [{"t": 1}]
+    # ...and a read IS retried transparently in the same situation
+    s.drop_next = True
+    assert d.query("SELECT 1", {}) == [{"t": 1}]
+    d.stop()
+
+
+def test_non_str_params_coerced(server):
+    seen = {}
+
+    def handler(sql, args):
+        seen["args"] = args
+        return ([("t", INT4)], [("1",)])
+
+    s = server(handler=handler)
+    d = PgDriver(port=s.port)
+    d.query("SELECT * FROM t WHERE n = ${n} AND f = ${f} AND b = ${b}",
+            {"n": 7, "f": 1.5, "b": True})
+    assert seen["args"] == ["7", "1.5", "t"]
+    d.stop()
+
+
+def test_pool_bounded(server):
+    s = server()
+    d = PgDriver(port=s.port, pool_size=2)
+    errs = []
+
+    def hammer():
+        try:
+            for _ in range(10):
+                assert d.health_check()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=hammer) for _ in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    assert s.conn_count <= 2
+    d.stop()
+
+
+# ----------------------------------------------- authn/authz/connector
+
+
+class CI:
+    def __init__(self, username=None, clientid="c1", password=None):
+        self.username = username
+        self.clientid = clientid
+        self.password = password
+        self.peerhost = "127.0.0.1:999"
+
+
+def test_db_authenticator_over_real_sockets(server):
+    salt = b"\x0a\x0b"
+    h = hash_password(b"pw", salt, "sha256")
+
+    def handler(sql, args):
+        assert sql == ("SELECT password_hash, salt, is_superuser "
+                       "FROM mqtt_user WHERE username = $1")
+        if args == ["alice"]:
+            return (
+                [("password_hash", TEXT), ("salt", TEXT),
+                 ("is_superuser", BOOL)],
+                [(h, salt.hex(), "t")],
+            )
+        return ([("password_hash", TEXT)], [])
+
+    s = server(auth="md5", password="dbpw", handler=handler)
+    a = DbAuthenticator(
+        "pgsql",
+        "SELECT password_hash, salt, is_superuser FROM mqtt_user "
+        "WHERE username = ${username}",
+        algorithm="sha256",
+        port=s.port, password="dbpw",
+    )
+    ok, info = a.authenticate(CI(username="alice", password=b"pw"))
+    assert ok == "allow" and info["is_superuser"]
+    bad, _ = a.authenticate(CI(username="alice", password=b"no"))
+    assert bad == "deny"
+    ig, _ = a.authenticate(CI(username="nobody", password=b"pw"))
+    assert ig == "ignore"
+
+
+def test_db_authz_over_real_sockets(server):
+    def handler(sql, args):
+        if args == ["alice"]:
+            return (
+                [("permission", TEXT), ("action", TEXT), ("topic", TEXT)],
+                [("allow", "publish", "tele/+/up"),
+                 ("deny", "all", "forbidden/#")],
+            )
+        return ([("permission", TEXT)], [])
+
+    s = server(handler=handler)
+    src = DbSource(
+        "pgsql",
+        "SELECT permission, action, topic FROM acl WHERE u = ${username}",
+        port=s.port,
+    )
+    ci = CI(username="alice")
+    assert src.authorize(ci, "publish", "tele/3/up") == ALLOW
+    assert src.authorize(ci, "publish", "forbidden/x") == DENY
+    assert src.authorize(ci, "subscribe", "tele/3/up") == NOMATCH
+    assert src.authorize(CI(username="bob"), "publish", "t") == NOMATCH
+
+
+def test_db_connector_resource_layer(server):
+    from emqx_tpu.bridges.connectors import make_connector
+
+    s = server()
+
+    async def main():
+        conn = make_connector("pgsql", port=s.port, pool_size=1)
+        await conn.start()
+        assert await conn.health_check() is True
+        await conn.stop()
+        assert await conn.health_check() is False
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_builtin_pgsql_registered():
+    assert drivers.driver_available("pgsql")
+    assert isinstance(drivers.make_driver("pgsql"), PgDriver)
