@@ -216,6 +216,10 @@ def make_connector(kind: str, **cfg):
         return HttpConnector(**cfg)
     if kind == "mqtt":
         return MqttConnector(**cfg)
-    if kind in drivers.DB_KINDS:
+    if drivers.driver_available(kind):
+        # bundled wire-protocol kinds plus any site-registered kind
         return DbConnector(kind, **cfg)
-    raise ValueError(f"unknown connector kind {kind!r}")
+    raise ValueError(
+        f"unknown connector kind {kind!r} — register a driver for it "
+        f"via emqx_tpu.drivers.register_driver first"
+    )

@@ -4,19 +4,21 @@ The reference reaches MySQL/PgSQL/MongoDB/Redis/LDAP through pooled
 Erlang client deps (`rebar.config` ecpool/epgsql/eredis/...;
 `apps/emqx_connector/src/emqx_connector_{mysql,pgsql,redis,mongo}.erl`).
 
-**Redis and PostgreSQL ship as REAL bundled drivers** (`bridges/redis.py`:
-RESP wire protocol, the eredis analog; `bridges/pgsql.py`: protocol v3
-with MD5/SCRAM auth + extended queries, the epgsql analog — both pooled
-over stdlib sockets).  The other kinds have no client library in this
-image, so the framework ships the *contract* and an injection point:
+**All five kinds ship as REAL bundled wire-protocol drivers**, pooled
+over stdlib sockets (`bridges/dbpool.py`, the ecpool analog):
 
-* a deployment registers a factory per kind —
-  ``register_driver("mysql", lambda **cfg: MyAdapter(cfg))`` — wrapping
-  whatever client library it has (aiomysql, asyncpg, redis-py, ...);
-* authn/authz/bridge components resolve drivers by kind at create time
-  and fail loudly when no driver is registered (matching the previous
-  explicit-unavailable behavior);
-* tests register in-memory fakes, which doubles as the contract spec.
+* redis — RESP (`bridges/redis.py`, the eredis analog);
+* pgsql — protocol v3, MD5/SCRAM auth, extended queries
+  (`bridges/pgsql.py`, the epgsql analog);
+* mysql — v10 handshake, native/caching_sha2 auth, COM_QUERY
+  (`bridges/mysql.py`, the mysql-otp analog);
+* mongodb — OP_MSG + BSON, SCRAM-SHA-256 (`bridges/mongo.py`);
+* ldap — LDAPv3 BER bind/search (`bridges/ldap.py`, the eldap analog).
+
+The registry stays an injection point on top of the builtins:
+``register_driver(kind, factory)`` overrides a bundled driver with a
+site's own client library (aiomysql, asyncpg, redis-py, ...), and
+tests register in-memory fakes, which doubles as the contract spec.
 
 Driver contract (duck-typed; sync because the authn/authz hook chains
 run synchronously in the channel — wrap async clients accordingly):
@@ -55,12 +57,33 @@ def _pgsql_factory(**cfg):
     return PgDriver(**cfg)
 
 
+def _mysql_factory(**cfg):
+    from .bridges.mysql import MySqlDriver
+
+    return MySqlDriver(**cfg)
+
+
+def _mongodb_factory(**cfg):
+    from .bridges.mongo import MongoDriver
+
+    return MongoDriver(**cfg)
+
+
+def _ldap_factory(**cfg):
+    from .bridges.ldap import LdapDriver
+
+    return LdapDriver(**cfg)
+
+
 # Kinds with a REAL bundled implementation (stdlib wire protocol, no
 # external client library).  register_driver() overrides them; the
 # remaining kinds stay injection points until a client is registered.
 _builtin: Dict[str, Callable[..., Any]] = {
     "redis": _redis_factory,
     "pgsql": _pgsql_factory,
+    "mysql": _mysql_factory,
+    "mongodb": _mongodb_factory,
+    "ldap": _ldap_factory,
 }
 
 

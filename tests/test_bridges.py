@@ -69,8 +69,9 @@ def test_resource_lifecycle_and_auto_restart(run):
 
 
 def test_make_connector_gating():
-    with pytest.raises(NotImplementedError):
-        make_connector("mysql")
+    # every DB kind is a bundled driver now; mysql resolves for real
+    conn = make_connector("mysql")
+    assert conn.kind == "mysql"
     with pytest.raises(ValueError):
         make_connector("bogus")
     assert isinstance(make_connector("http", base_url="http://127.0.0.1:1"),

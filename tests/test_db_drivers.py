@@ -83,16 +83,27 @@ def _user_row(password="pw", algorithm="sha256", superuser=False):
 
 
 def test_registry_inject_and_unavailable():
-    assert not drivers.driver_available("mysql")
+    # every kind of the reference's connector set is bundled now; a
+    # kind with no builtin still fails loudly until one is registered
+    assert not drivers.driver_available("oracle")
     with pytest.raises(drivers.DriverUnavailable):
-        drivers.make_driver("mysql")
+        drivers.make_driver("oracle")
+    drivers.register_driver("oracle", lambda **cfg: FakeSqlDriver())
+    try:
+        assert drivers.driver_available("oracle")
+        assert isinstance(drivers.make_driver("oracle"), FakeSqlDriver)
+    finally:
+        drivers.unregister_driver("oracle")
+    assert not drivers.driver_available("oracle")
+    # injection overrides a bundled driver; unregister restores it
+    from emqx_tpu.bridges.mysql import MySqlDriver
+
     drivers.register_driver("mysql", lambda **cfg: FakeSqlDriver())
     try:
-        assert drivers.driver_available("mysql")
         assert isinstance(drivers.make_driver("mysql"), FakeSqlDriver)
     finally:
         drivers.unregister_driver("mysql")
-    assert not drivers.driver_available("mysql")
+    assert isinstance(drivers.make_driver("mysql"), MySqlDriver)
 
 
 def test_db_authn_allow_deny_ignore():
@@ -220,5 +231,12 @@ def test_db_connector_lifecycle():
 
 
 def test_make_connector_without_driver_fails_loud():
-    with pytest.raises(drivers.DriverUnavailable, match="mongodb"):
-        make_connector("mongodb")
+    with pytest.raises(ValueError, match="register_driver"):
+        make_connector("oracle")
+    # a registered custom kind routes through the DB connector layer
+    drivers.register_driver("oracle", lambda **cfg: FakeSqlDriver())
+    try:
+        conn = make_connector("oracle")
+        assert conn.kind == "oracle"
+    finally:
+        drivers.unregister_driver("oracle")
