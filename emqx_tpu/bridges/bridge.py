@@ -3,8 +3,11 @@
 Egress: a 'message.publish' hook matches a local topic filter, renders
 ${placeholder} templates (topic/payload/qos/clientid...), and enqueues
 the render into a bounded buffer drained by an async worker that calls
-the connector — send failures retry with backoff, overflow drops oldest
-(the replayq-backed buffering model, in memory).
+the connector — send failures retry with backoff, overflow drops
+oldest.  With `queue_dir` set the buffer is the disk-backed replay
+queue (`utils/replayq.py`, the replayq analog): messages survive a
+node restart and unconfirmed sends are replayed, like the reference's
+replayq-buffered bridges.
 
 Ingress: the connector subscribes remotely; arriving messages are
 re-published locally under a templated topic.
@@ -14,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import struct
 from collections import deque
 from typing import Callable, Dict, Optional
 
@@ -50,6 +54,8 @@ class EgressBridge:
         max_buffer: int = 10_000,
         retry_interval: float = 1.0,
         send: Optional[Callable] = None,
+        queue_dir: Optional[str] = None,
+        max_queue_bytes: int = 0,
     ):
         self.broker = broker
         self.connector = connector
@@ -57,6 +63,12 @@ class EgressBridge:
         self.remote_topic = remote_topic
         self.payload_template = payload_template
         self.qos = qos
+        self.queue = None
+        if queue_dir is not None:
+            from ..utils.replayq import ReplayQ
+
+            self.queue = ReplayQ(queue_dir,
+                                 max_total_bytes=max_queue_bytes)
         self.buffer: deque = deque(maxlen=max_buffer)
         self.retry_interval = retry_interval
         self.dropped = 0
@@ -80,8 +92,20 @@ class EgressBridge:
                 await self._worker
             except (asyncio.CancelledError, Exception):
                 pass
+        if self.queue is not None:
+            self.queue.close()
 
     # -------------------------------------------------------------- egress
+
+    @staticmethod
+    def _marshal(topic: str, payload: bytes) -> bytes:
+        tb = topic.encode("utf-8")
+        return struct.pack("<I", len(tb)) + tb + payload
+
+    @staticmethod
+    def _unmarshal(item: bytes):
+        (tlen,) = struct.unpack_from("<I", item, 0)
+        return (item[4:4 + tlen].decode("utf-8"), item[4 + tlen:])
 
     def _on_publish(self, msg):
         if not isinstance(msg, Message) or msg.headers.get("bridged"):
@@ -89,40 +113,106 @@ class EgressBridge:
         if not topiclib.match(msg.topic, self.local_filter):
             return None
         env = _msg_env(msg)
-        item = (
-            render_template(self.remote_topic, env, env),
-            render_template(self.payload_template, env, env).encode(),
-        )
-        if len(self.buffer) == self.buffer.maxlen:
-            self.dropped += 1
-        self.buffer.append(item)
+        topic = render_template(self.remote_topic, env, env)
+        payload = render_template(self.payload_template, env, env).encode()
+        if self.queue is not None:
+            try:
+                self.queue.append(self._marshal(topic, payload))
+            except OSError as e:
+                # disk trouble must not propagate into the broker's
+                # publish path — account it like a buffer overflow
+                self.dropped += 1
+                log.warning("bridge queue append failed: %s", e)
+                return None
+        else:
+            if len(self.buffer) == self.buffer.maxlen:
+                self.dropped += 1
+            self.buffer.append((topic, payload))
         self._wake.set()
         return None
 
+    def _buffered(self) -> int:
+        return (self.queue.count() if self.queue is not None
+                else len(self.buffer))
+
+    _POP_BATCH = 32  # amortize the per-ack commit write
+
     async def _run(self) -> None:
         while True:
-            if not self.buffer:
+            if not self._buffered():
                 self._wake.clear()
-                await self._wake.wait()
-            topic, payload = self.buffer[0]
+                if not self._buffered():  # append may race the clear
+                    await self._wake.wait()
             try:
-                await self._send(topic, payload)
-                self.buffer.popleft()
-                self.sent += 1
-            except Exception as e:
+                if self.queue is not None:
+                    await self._drain_queue_batch()
+                else:
+                    await self._drain_mem_one()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # never die silently
                 self.failed += 1
-                log.debug("bridge send failed: %s", e)
+                log.warning("bridge worker error: %s", e)
                 await asyncio.sleep(self.retry_interval)
+
+    async def _drain_mem_one(self) -> None:
+        # pop BEFORE the await: leaving the item at the head lets a
+        # full deque evict the in-flight message mid-send and popleft
+        # would then discard a never-sent one
+        topic, payload = self.buffer.popleft()
+        try:
+            await self._send(topic, payload)
+            self.sent += 1
+        except Exception as e:
+            self.failed += 1
+            log.debug("bridge send failed: %s", e)
+            if len(self.buffer) == self.buffer.maxlen:
+                self.dropped += 1  # retry displaced by newer traffic
+            else:
+                self.buffer.appendleft((topic, payload))
+            await asyncio.sleep(self.retry_interval)
+
+    async def _drain_queue_batch(self) -> None:
+        ack_ref, items = self.queue.pop(self._POP_BATCH)
+        if not items:
+            return
+        seq_before = ack_ref - len(items)  # seqno preceding the batch
+        done = 0  # items fully sent this round
+        try:
+            for item in items:
+                topic, payload = self._unmarshal(item)
+                await self._send(topic, payload)
+                self.sent += 1
+                done += 1
+        except (ValueError, struct.error, UnicodeDecodeError) as e:
+            # damaged record: drop IT (ack past it), keep the rest
+            log.warning("bridge dropping damaged queued record: %s", e)
+            self.dropped += 1
+            self.queue.ack(seq_before + done + 1)
+            self.queue.requeue(ack_ref, items[done + 1:])
+            return
+        except Exception as e:
+            self.failed += 1
+            log.debug("bridge send failed: %s", e)
+            # confirm the delivered prefix, put the rest back
+            if done:
+                self.queue.ack(seq_before + done)
+            self.queue.requeue(ack_ref, items[done:])
+            await asyncio.sleep(self.retry_interval)
+            return
+        self.queue.ack(ack_ref)
 
     async def _send_default(self, topic: str, payload: bytes) -> None:
         await self.connector.publish(topic, payload, qos=self.qos)
 
     def stats(self) -> dict:
+        dropped = self.dropped + (self.queue.dropped
+                                  if self.queue is not None else 0)
         return {
             "sent": self.sent,
             "failed": self.failed,
-            "dropped": self.dropped,
-            "buffered": len(self.buffer),
+            "dropped": dropped,
+            "buffered": self._buffered(),
         }
 
 

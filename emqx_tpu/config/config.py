@@ -362,6 +362,26 @@ STRUCTURED: Dict[str, Any] = {
         "host": Field("str", "127.0.0.1"),
         "port": Field("int", 0, min=0, max=65535),
     }, open=True), desc="protocol gateways (emqx_gateway analog)"),
+    "bridges": ListOf(Struct({
+        "name": Field("str"),
+        "type": Field("enum", "http", enum=["http", "mqtt"],
+                      desc="the reference ships http + mqtt bridges"),
+        "direction": Field("enum", "egress", enum=["egress", "ingress"]),
+        "enable": Field("bool", True),
+        "local_topic": Field("str", "#"),
+        "remote_topic": Field("str", desc="egress target / ingress source"),
+        "payload": Field("str", desc="egress payload template"),
+        "path": Field("str", "/", desc="http webhook path"),
+        "qos": Field("int", 0, min=0, max=2),
+        "durable": Field("bool", False,
+                         desc="buffer through the disk replay queue"),
+        "max_queue_bytes": Field("int", 0, min=0, desc="0 = unbounded"),
+        "max_buffer": Field("int", 10_000, min=1),
+        "retry_interval": Field("duration", 1.0),
+        "health_check_interval": Field("duration", 15.0),
+        "connector": Struct({}, open=True,
+                            desc="connector config (base_url / host / ...)"),
+    }), desc="data bridges (emqx_bridge analog)"),
     "exhook": ListOf(Struct({
         "name": Field("str", "default"),
         "host": Field("str", "127.0.0.1"),

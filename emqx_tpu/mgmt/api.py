@@ -53,6 +53,7 @@ class ManagementApi:
         authn=None,
         authz=None,
         gateways=None,
+        bridges=None,
     ):
         self.broker = broker
         self.node = node
@@ -74,6 +75,7 @@ class ManagementApi:
         self.authn = authn
         self.authz = authz
         self.gateways = gateways
+        self.bridges = bridges
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -126,6 +128,14 @@ class ManagementApi:
         r("PUT", "/telemetry/status", self.telemetry_set, doc="Toggle telemetry")
         r("GET", "/telemetry/data", self.telemetry_data, doc="Telemetry report")
         r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+        r("GET", "/bridges", self.bridges_list,
+          doc="Data bridges with resource status + stats")
+        r("POST", "/bridges", self.bridge_create, doc="Create a bridge")
+        r("GET", "/bridges/{name}", self.bridge_get, doc="One bridge")
+        r("DELETE", "/bridges/{name}", self.bridge_delete,
+          doc="Remove a bridge")
+        r("PUT", "/bridges/{name}/{action}", self.bridge_action,
+          doc="enable|disable|restart a bridge")
         r("GET", "/gateways", self.gateways_list,
           doc="Gateway instances + listen addresses")
         r("GET", "/gateways/{name}/clients", self.gateway_clients,
@@ -574,6 +584,44 @@ class ManagementApi:
     def _gateway_cm(gw):
         ctx = getattr(gw, "ctx", None)
         return getattr(ctx, "cm", None)
+
+    # ------------------------------------------------------------ bridges
+
+    def bridges_list(self, req: Request):
+        return self._need("bridges").list()
+
+    def bridge_get(self, req: Request):
+        info = self._need("bridges").describe(req.params["name"])
+        if info is None:
+            raise HttpError(404, "no such bridge")
+        return info
+
+    async def bridge_create(self, req: Request):
+        mgr = self._need("bridges")
+        body = req.json() or {}
+        if not body.get("name"):
+            raise HttpError(400, "bridge name required")
+        try:
+            await mgr.create(body)
+        except ValueError as e:
+            raise HttpError(400, str(e))
+        return 201, mgr.describe(body["name"])
+
+    async def bridge_delete(self, req: Request):
+        if not await self._need("bridges").remove(req.params["name"]):
+            raise HttpError(404, "no such bridge")
+        return 204, None
+
+    async def bridge_action(self, req: Request):
+        mgr = self._need("bridges")
+        name = req.params["name"]
+        action = req.params["action"]
+        if action not in ("enable", "disable", "restart"):
+            raise HttpError(400, f"unknown action {action!r}")
+        ok = await getattr(mgr, action)(name)
+        if not ok:
+            raise HttpError(404, "no such bridge")
+        return mgr.describe(name)
 
     def gateways_list(self, req: Request):
         reg = self._need("gateways")

@@ -278,6 +278,18 @@ class NodeRuntime:
                 gd.get("name", gd["type"]), self._build_gateway(gd)
             )
 
+        # ---- data bridges (1.9, emqx_bridge analog) -----------------------
+        self.bridges = None
+        bridge_defs = list(self.conf.get("bridges") or [])
+        if bridge_defs:
+            from .bridges.manager import BridgeManager
+
+            self.bridges = BridgeManager(
+                self.broker,
+                data_dir=self.conf.get("node.data_dir"),
+                definitions=bridge_defs,
+            )
+
         # ---- management REST (1.12) ---------------------------------------
         self.tokens = TokenStore(
             ttl_s=self.conf.get("dashboard.token_expired_time")
@@ -305,6 +317,7 @@ class NodeRuntime:
             authn=self.authn,
             authz=self.authz,
             gateways=self.gateways,
+            bridges=self.bridges,
         )
         self.http = HttpApi(
             port=self.conf.get("dashboard.listen_port"),
@@ -550,6 +563,10 @@ class NodeRuntime:
                     log.info("restored %d persistent sessions", n)
             if self.cluster is not None:
                 await self.cluster.start()
+            if self.bridges is not None:
+                # a down endpoint is DISCONNECTED + retried, not a boot
+                # failure (reference bridges start async the same way)
+                await self.bridges.start()
             for lst in self.listeners:
                 await lst.start()
             for name in self.gateways.list():
@@ -601,6 +618,11 @@ class NodeRuntime:
                 log.exception("stopping listener on port %s", lst.port)
         if self.cluster is not None:
             await self.cluster.stop()
+        if self.bridges is not None:
+            try:
+                await self.bridges.stop()
+            except Exception:
+                log.exception("stopping bridges")
         if self.exhook is not None:
             await asyncio.to_thread(self.exhook.stop)
         if self.persistence is not None:

@@ -1,0 +1,247 @@
+"""Disk-backed replay queue — the replayq analog.
+
+The reference buffers bridge traffic through replayq (`rebar.config`
+replayq dep; SURVEY.md §2.3 "disk-backed queue (bridge buffering)"):
+producers append items, a consumer pops a batch, and only an explicit
+`ack` makes consumption durable — after a crash or restart every
+popped-but-unacked item is replayed, so a bridge never loses messages
+it has not confirmed delivered.
+
+Same contract here, stdlib-only:
+
+* ``append(item: bytes)`` — durable once the call returns (written +
+  flushed to the current segment when a directory is configured);
+* ``pop(count, bytes_limit) -> (ack_ref, items)`` — removes items from
+  the in-memory queue but NOT from disk;
+* ``ack(ack_ref)`` — commits the consumed prefix (atomic write of the
+  commit cursor); fully-acked segments are deleted;
+* reopen replays everything after the committed cursor, tolerating a
+  torn tail record (a crash mid-append truncates to the last whole
+  record, verified by per-record CRC32);
+* ``max_total_bytes`` bounds disk use by dropping the OLDEST segment
+  (the reference's default drop-oldest overflow policy).
+
+Without a directory the queue is memory-only (replayq "mem_only"
+mode) with the same API.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+_REC_HDR = struct.Struct("<II")  # length, crc32
+
+
+class ReplayQ:
+    def __init__(
+        self,
+        dir: Optional[str] = None,
+        seg_bytes: int = 4 * 1024 * 1024,
+        max_total_bytes: int = 0,
+    ):
+        self.dir = dir
+        self.seg_bytes = int(seg_bytes)
+        self.max_total_bytes = int(max_total_bytes)
+        self.dropped = 0  # items lost to the overflow policy
+        self._items: Deque[Tuple[int, bytes]] = deque()  # (seqno, item)
+        self._next_seq = 1  # seqno of the next appended item
+        self._acked = 0  # highest durably-consumed seqno
+        self._popped = 0  # highest seqno handed out by pop()
+        self._segs: List[List] = []  # [first, last, path, nbytes]
+        self._disk_bytes = 0  # all segments, tracked incrementally
+        self._cur = None  # open segment file handle
+        self._cur_first = 0
+        self._cur_last = 0
+        self._cur_bytes = 0
+        if self.dir is not None:
+            os.makedirs(self.dir, exist_ok=True)
+            self._recover()
+
+    # ---------------------------------------------------------- recovery
+
+    def _commit_path(self) -> str:
+        return os.path.join(self.dir, "commit")
+
+    def _recover(self) -> None:
+        try:
+            with open(self._commit_path()) as f:
+                self._acked = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            self._acked = 0
+        self._popped = self._acked
+        names = sorted(
+            (n for n in os.listdir(self.dir)
+             if n.startswith("seg.") and n.endswith(".q")),
+            key=lambda n: int(n.split(".")[1]),
+        )
+        seq = 0
+        for name in names:
+            first = int(name.split(".")[1])
+            path = os.path.join(self.dir, name)
+            seq = first - 1
+            records = self._read_segment(path)
+            for item in records:
+                seq += 1
+                if seq > self._acked:
+                    self._items.append((seq, item))
+            if seq <= self._acked:
+                os.unlink(path)  # fully consumed before the crash
+            else:
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    size = 0
+                self._disk_bytes += size
+                self._segs.append([first, seq, path, size])
+        self._next_seq = max(seq, self._acked) + 1
+
+    @staticmethod
+    def _read_segment(path: str) -> List[bytes]:
+        """All intact records; a torn tail (crash mid-append) truncates
+        the list at the last whole, CRC-valid record."""
+        out: List[bytes] = []
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return out
+        off = 0
+        while off + _REC_HDR.size <= len(data):
+            length, crc = _REC_HDR.unpack_from(data, off)
+            end = off + _REC_HDR.size + length
+            if end > len(data):
+                break  # torn write
+            body = data[off + _REC_HDR.size:end]
+            if zlib.crc32(body) != crc:
+                break  # torn/corrupt: stop at the damage
+            out.append(body)
+            off = end
+        return out
+
+    # ----------------------------------------------------------- append
+
+    def append(self, item: bytes) -> int:
+        """Queue one item; returns its seqno."""
+        seq = self._next_seq
+        self._next_seq += 1
+        self._items.append((seq, item))
+        if self.dir is not None:
+            self._write(seq, item)
+        return seq
+
+    def _write(self, seq: int, item: bytes) -> None:
+        if self._cur is None or self._cur_bytes >= self.seg_bytes:
+            self._rotate(seq)
+        rec = _REC_HDR.pack(len(item), zlib.crc32(item)) + item
+        self._cur.write(rec)
+        self._cur.flush()
+        self._cur_bytes += len(rec)
+        self._cur_last = seq
+        # refresh the open segment's span + size in _segs
+        self._segs[-1][1] = seq
+        self._segs[-1][3] += len(rec)
+        self._disk_bytes += len(rec)
+        if self.max_total_bytes:
+            self._enforce_bound()
+
+    def _rotate(self, first_seq: int) -> None:
+        if self._cur is not None:
+            self._cur.close()
+        path = os.path.join(self.dir, f"seg.{first_seq}.q")
+        self._cur = open(path, "ab")
+        self._cur_first = first_seq
+        self._cur_last = first_seq - 1
+        self._cur_bytes = 0
+        self._segs.append([first_seq, first_seq - 1, path, 0])
+
+    def _enforce_bound(self) -> None:
+        """Drop the oldest CLOSED segment while over budget (sizes are
+        tracked incrementally — no per-append stat calls)."""
+        while self._disk_bytes > self.max_total_bytes \
+                and len(self._segs) > 1:
+            first, last, path, size = self._segs.pop(0)
+            self._disk_bytes -= size
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            before = len(self._items)
+            while self._items and self._items[0][0] <= last:
+                self._items.popleft()
+            self.dropped += before - len(self._items)
+            if self._acked < last:
+                self._acked = last
+            if self._popped < last:
+                self._popped = last
+
+    # -------------------------------------------------------------- pop
+
+    def pop(self, count: int = 1, bytes_limit: Optional[int] = None
+            ) -> Tuple[int, List[bytes]]:
+        """Take up to `count` items (and at most `bytes_limit` payload
+        bytes, always ≥1 item).  Returns (ack_ref, items); the items
+        stay on disk until `ack(ack_ref)`."""
+        items: List[bytes] = []
+        taken = 0
+        while self._items and len(items) < count:
+            seq, item = self._items[0]
+            if items and bytes_limit is not None and \
+                    taken + len(item) > bytes_limit:
+                break
+            self._items.popleft()
+            items.append(item)
+            taken += len(item)
+            self._popped = seq
+        return self._popped, items
+
+    def requeue(self, ack_ref: int, items: List[bytes]) -> None:
+        """Return a failed pop to the head of the queue (the items are
+        still on disk; this only restores the in-memory view).  The
+        items must be exactly one pop's batch, ending at ack_ref."""
+        seq = ack_ref
+        for item in reversed(items):
+            if seq > self._acked:
+                self._items.appendleft((seq, item))
+            seq -= 1
+        self._popped = max(seq, self._acked)
+
+    def ack(self, ack_ref: int) -> None:
+        """Commit consumption up to ack_ref (a pop's returned ref)."""
+        if ack_ref <= self._acked:
+            return
+        self._acked = ack_ref
+        if self.dir is None:
+            return
+        tmp = self._commit_path() + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(self._acked))
+        os.replace(tmp, self._commit_path())  # atomic; no fsync — the
+        # queue is at-least-once (like replayq): a crash between ack
+        # and writeback re-delivers a few confirmed items, never loses
+        # unconfirmed ones, and the publish path never blocks on disk
+        # delete fully-acked segments (closing the current one first
+        # if it is among them — a fresh segment opens on next append)
+        while self._segs and self._segs[0][1] <= self._acked:
+            _first, _last, path, size = self._segs.pop(0)
+            self._disk_bytes -= size
+            if self._cur is not None and not self._segs:
+                self._cur.close()
+                self._cur = None
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------ state
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def close(self) -> None:
+        if self._cur is not None:
+            self._cur.close()
+            self._cur = None

@@ -1,0 +1,32 @@
+"""The jiffy-analog guard (SURVEY §2.3: JSON "must be a native module,
+not a Python stand-in").
+
+The framework's JSON hot paths (REST, rules, exhook framed-JSON
+fallback) ride CPython's `_json` C accelerator — the stdlib's native
+scanner/encoder.  These tests pin that the accelerator is actually
+loaded and active, so an interpreter built without it (pure-Python
+json, ~20x slower) fails loudly instead of silently degrading.
+"""
+
+import json
+import json.decoder
+import json.encoder
+import json.scanner
+
+
+def test_c_accelerator_is_active():
+    import _json  # the C extension itself must be importable
+
+    # the stdlib binds these names to the C implementations when the
+    # accelerator is present, and to Python fallbacks when it is not
+    assert json.encoder.c_make_encoder is _json.make_encoder
+    assert json.decoder.c_scanstring is _json.scanstring
+    assert json.scanner.c_make_scanner is _json.make_scanner
+    # and the live entry points actually use them
+    assert json.decoder.scanstring is _json.scanstring
+
+
+def test_roundtrip_through_the_native_path():
+    doc = {"topic": "tele/1/up", "payload": "héllo\n", "qos": 1,
+           "nested": {"a": [1, 2.5, None, True]}}
+    assert json.loads(json.dumps(doc)) == doc

@@ -1,0 +1,186 @@
+"""Disk-backed replay queue (the replayq analog) + durable bridges.
+
+Covers the replayq contract the reference's bridges rely on: durable
+appends, pop-then-ack consumption, replay of unacked items after a
+restart, torn-tail recovery, segment rotation/cleanup, and the
+drop-oldest disk bound — then drives an EgressBridge with a queue_dir
+through a connector outage + process "restart" to show no confirmed
+loss.
+"""
+
+import asyncio
+import os
+import struct
+
+import pytest
+
+from emqx_tpu.utils.replayq import ReplayQ
+
+
+def test_mem_only_pop_ack_requeue():
+    q = ReplayQ()
+    for i in range(5):
+        q.append(b"m%d" % i)
+    assert q.count() == 5
+    ref, items = q.pop(2)
+    assert items == [b"m0", b"m1"]
+    assert q.count() == 3
+    q.requeue(ref, items)
+    assert q.count() == 5
+    ref, items = q.pop(3)
+    assert items == [b"m0", b"m1", b"m2"]
+    q.ack(ref)
+    _, rest = q.pop(10)
+    assert rest == [b"m3", b"m4"]
+
+
+def test_pop_bytes_limit():
+    q = ReplayQ()
+    q.append(b"x" * 100)
+    q.append(b"y" * 100)
+    q.append(b"z" * 100)
+    _, items = q.pop(10, bytes_limit=150)
+    assert len(items) == 1  # second item would exceed the limit
+    _, items = q.pop(10, bytes_limit=5)
+    assert len(items) == 1  # always at least one item
+
+
+def test_disk_roundtrip_and_restart_replay(tmp_path):
+    d = str(tmp_path / "q")
+    q = ReplayQ(d)
+    for i in range(10):
+        q.append(b"item-%02d" % i)
+    ref, items = q.pop(4)
+    q.ack(ref)  # 0..3 confirmed
+    ref2, items2 = q.pop(3)  # 4..6 popped but NOT acked
+    q.close()
+
+    q2 = ReplayQ(d)  # "restart"
+    # unacked items (4..9) replay; acked (0..3) do not
+    _, replayed = q2.pop(100)
+    assert replayed == [b"item-%02d" % i for i in range(4, 10)]
+    q2.close()
+
+
+def test_torn_tail_record_recovered(tmp_path):
+    d = str(tmp_path / "q")
+    q = ReplayQ(d)
+    q.append(b"good-1")
+    q.append(b"good-2")
+    q.close()
+    # simulate a crash mid-append: a truncated record at the tail
+    (seg,) = [n for n in os.listdir(d) if n.startswith("seg.")]
+    with open(os.path.join(d, seg), "ab") as f:
+        f.write(struct.pack("<II", 100, 0) + b"torn")
+    q2 = ReplayQ(d)
+    _, items = q2.pop(10)
+    assert items == [b"good-1", b"good-2"]
+    # and the queue still accepts appends afterwards
+    q2.append(b"after")
+    q2.close()
+    q3 = ReplayQ(d)
+    _, items = q3.pop(10)
+    assert items[-1] == b"after"
+    q3.close()
+
+
+def test_segment_rotation_and_cleanup(tmp_path):
+    d = str(tmp_path / "q")
+    q = ReplayQ(d, seg_bytes=64)  # tiny segments force rotation
+    for i in range(20):
+        q.append(b"payload-%02d-xxxxxxxxxxxx" % i)
+    segs = [n for n in os.listdir(d) if n.startswith("seg.")]
+    assert len(segs) > 1
+    ref, items = q.pop(20)
+    assert len(items) == 20
+    q.ack(ref)
+    segs_after = [n for n in os.listdir(d) if n.startswith("seg.")]
+    assert segs_after == []  # fully-acked segments deleted
+    # queue still usable after all segments were reclaimed
+    q.append(b"fresh")
+    _, items = q.pop(1)
+    assert items == [b"fresh"]
+    q.close()
+
+
+def test_max_total_bytes_drops_oldest(tmp_path):
+    d = str(tmp_path / "q")
+    q = ReplayQ(d, seg_bytes=128, max_total_bytes=300)
+    for i in range(40):
+        q.append(b"record-%03d-aaaaaaaaaaaaaaaa" % i)
+    assert q.dropped > 0
+    _, items = q.pop(100)
+    assert items  # newest survive
+    assert items[-1] == b"record-039-aaaaaaaaaaaaaaaa"
+    assert b"record-000-aaaaaaaaaaaaaaaa" not in items  # oldest gone
+    total = sum(os.path.getsize(os.path.join(d, n))
+                for n in os.listdir(d) if n.startswith("seg."))
+    assert total <= 300 + 128  # bound enforced up to one open segment
+    q.close()
+
+
+def test_commit_file_atomic(tmp_path):
+    d = str(tmp_path / "q")
+    q = ReplayQ(d)
+    q.append(b"a")
+    ref, _ = q.pop(1)
+    q.ack(ref)
+    with open(os.path.join(d, "commit")) as f:
+        assert f.read() == "1"
+    q.close()
+
+
+# ------------------------------------------------------ durable bridge
+
+
+def test_egress_bridge_durable_queue(tmp_path):
+    """Messages published while the connector is down survive a bridge
+    'restart' and deliver afterwards — the replayq-buffered bridge."""
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    from emqx_tpu.bridges.bridge import EgressBridge
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.message import Message
+
+    qdir = str(tmp_path / "bridge-q")
+    delivered = []
+    connector_up = {"v": False}
+
+    async def send(topic, payload):
+        if not connector_up["v"]:
+            raise ConnectionError("connector down")
+        delivered.append((topic, payload))
+
+    async def phase1():
+        broker = Broker()
+        b = EgressBridge(broker, None, "tele/#", send=send,
+                         queue_dir=qdir, retry_interval=0.01)
+        b.start()
+        for i in range(5):
+            broker.publish(Message(topic="tele/%d" % i,
+                                   payload=b"p%d" % i, qos=0))
+        await asyncio.sleep(0.05)  # worker retries against the outage
+        assert delivered == []
+        assert b.stats()["buffered"] >= 4  # one may sit in the retry
+        await b.stop()
+
+    asyncio.new_event_loop().run_until_complete(phase1())
+
+    async def phase2():
+        broker = Broker()
+        b = EgressBridge(broker, None, "tele/#", send=send,
+                         queue_dir=qdir, retry_interval=0.01)
+        connector_up["v"] = True
+        b.start()
+        deadline = asyncio.get_event_loop().time() + 3
+        while len(delivered) < 5 and \
+                asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+        assert [t for t, _ in delivered] == \
+            ["tele/%d" % i for i in range(5)]
+        assert [p for _, p in delivered] == \
+            [b"p%d" % i for i in range(5)]
+        await b.stop()
+
+    asyncio.new_event_loop().run_until_complete(phase2())
