@@ -115,21 +115,26 @@ class EgressBridge:
         env = _msg_env(msg)
         topic = render_template(self.remote_topic, env, env)
         payload = render_template(self.payload_template, env, env).encode()
+        self.enqueue(topic, payload)
+        return None
+
+    def enqueue(self, topic: str, payload: bytes) -> None:
+        """Buffer one item for delivery — the `emqx_bridge:send_message`
+        entry point (rule-engine bridge outputs use it directly)."""
         if self.queue is not None:
             try:
                 self.queue.append(self._marshal(topic, payload))
             except OSError as e:
-                # disk trouble must not propagate into the broker's
+                # disk trouble must not propagate into the caller's
                 # publish path — account it like a buffer overflow
                 self.dropped += 1
                 log.warning("bridge queue append failed: %s", e)
-                return None
+                return
         else:
             if len(self.buffer) == self.buffer.maxlen:
                 self.dropped += 1
             self.buffer.append((topic, payload))
         self._wake.set()
-        return None
 
     def _buffered(self) -> int:
         return (self.queue.count() if self.queue is not None

@@ -165,6 +165,21 @@ class BridgeManager:
                 pass
         m.bridge = None
 
+    # ------------------------------------------------------------- sending
+
+    def send_message(self, name: str, topic: str, payload: bytes) -> None:
+        """The `emqx_bridge:send_message(BridgeId, Selected)` analog
+        (`emqx_rule_runtime.erl:270`): push one message into a named
+        egress bridge's buffer."""
+        m = self._bridges.get(name)
+        if m is None:
+            raise ValueError(f"no such bridge {name!r}")
+        if not m.enabled or m.bridge is None:
+            raise ValueError(f"bridge {name!r} is disabled")
+        if not hasattr(m.bridge, "enqueue"):
+            raise ValueError(f"bridge {name!r} is not an egress bridge")
+        m.bridge.enqueue(topic, payload)
+
     # -------------------------------------------------------------- admin
 
     def names(self) -> List[str]:

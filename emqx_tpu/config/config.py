@@ -269,6 +269,15 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "cache_max_size": Field("int", 32, min=1),
         "cache_ttl": Field("duration", 60.0),
     },
+    "event_message": {
+        "client_connected": Field("bool", False),
+        "client_disconnected": Field("bool", False),
+        "client_subscribed": Field("bool", False),
+        "client_unsubscribed": Field("bool", False),
+        "message_delivered": Field("bool", False),
+        "message_acked": Field("bool", False),
+        "message_dropped": Field("bool", False),
+    },
     "flapping_detect": {
         "enable": Field("bool", False),
         "max_count": Field("int", 15),

@@ -152,6 +152,8 @@ class ManagementApi:
           doc="ACL source chain")
         r("POST", "/authorization/sources/built_in_database/rules",
           self.authz_rule_add, doc="Add a built-in ACL rule")
+        r("POST", "/rule_test", self.rule_test, doc="Test a rule SQL "
+          "against a synthetic event (no side effects)")
         r("GET", "/rules", self.rules_list, doc="Rule list with metrics")
         r("POST", "/rules", self.rule_create, doc="Create a rule")
         r("GET", "/rules/{rule_id}", self.rule_get, doc="One rule")
@@ -793,6 +795,26 @@ class ManagementApi:
             raise HttpError(404, "no such rule")
         return self._rule_info(rule)
 
+    def rule_test(self, req: Request):
+        """POST {sql, context{event_type,...}} -> selected output, 412
+        when the SQL doesn't match (emqx_rule_sqltester analog)."""
+        from ..rules.engine import EvalError, RuleTestNoMatch, rule_sql_test
+        from ..rules.sql import SqlError
+
+        body = req.json() or {}
+        if not body.get("sql"):
+            raise HttpError(400, "sql required")
+        try:
+            return rule_sql_test(body["sql"], body.get("context"))
+        except SqlError as e:
+            raise HttpError(400, f"bad sql: {e}")
+        except (EvalError, ValueError, TypeError) as e:
+            # runtime eval problems (unknown function, bad context
+            # shape) are client errors, not 500s
+            raise HttpError(400, f"sql evaluation failed: {e}")
+        except RuleTestNoMatch as e:
+            raise HttpError(412, str(e))
+
     def rule_create(self, req: Request):
         from ..rules.engine import build_outputs
         from ..rules.sql import SqlError
@@ -813,7 +835,8 @@ class ManagementApi:
             rule = eng.create_rule(
                 rule_id,
                 body["sql"],
-                build_outputs(body.get("outputs")),
+                build_outputs(body.get("outputs"),
+                              lambda: self.bridges),
                 description=body.get("description", ""),
             )
         except SqlError as e:
@@ -837,7 +860,8 @@ class ManagementApi:
                 rule = eng.create_rule(  # replace wholesale
                     rule.rule_id,
                     body.get("sql", rule.sql),
-                    build_outputs(body.get("outputs"))
+                    build_outputs(body.get("outputs"),
+                                  lambda: self.bridges)
                     if "outputs" in body
                     else rule.outputs,
                     description=body.get("description", rule.description),

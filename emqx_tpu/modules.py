@@ -1,5 +1,5 @@
 """Built-in broker modules: delayed publish, topic rewrite, auto-subscribe,
-topic metrics.
+topic metrics, event messages.
 
 Analog of `apps/emqx_modules` (SURVEY.md §2.2): each module is a small
 hook-driven component over the broker core.
@@ -8,6 +8,7 @@ hook-driven component over the broker core.
 from __future__ import annotations
 
 import heapq
+import json
 import re
 import time
 from dataclasses import dataclass, replace
@@ -156,6 +157,127 @@ class AutoSubscribe:
 
     def install(self, hooks: Hooks) -> None:
         hooks.put("client.connected", self.on_client_connected)
+
+
+# ---------------------------------------------------------- event message
+
+class EventMessage:
+    """Publish broker lifecycle events as `$event/...` JSON messages
+    (`apps/emqx_modules/src/emqx_event_message.erl`): each enabled
+    event kind installs one hook that republishes the event payload to
+    its `$event/<kind>` topic for clients to subscribe to."""
+
+    TOPICS = (
+        "client_connected", "client_disconnected",
+        "client_subscribed", "client_unsubscribed",
+        "message_delivered", "message_acked", "message_dropped",
+    )
+
+    def __init__(self, broker: Broker, enabled: Dict[str, bool]):
+        self.broker = broker
+        self.enabled = {k: bool(enabled.get(k)) for k in self.TOPICS}
+
+    def install(self, hooks: Hooks) -> None:
+        on = self.enabled
+        if on["client_connected"]:
+            hooks.put("client.connected", self.on_client_connected)
+        if on["client_disconnected"]:
+            hooks.put("client.disconnected", self.on_client_disconnected)
+        if on["client_subscribed"]:
+            hooks.put("session.subscribed", self.on_client_subscribed)
+        if on["client_unsubscribed"]:
+            hooks.put("session.unsubscribed", self.on_client_unsubscribed)
+        if on["message_delivered"]:
+            hooks.put("message.delivered", self.on_message_delivered)
+        if on["message_acked"]:
+            hooks.put("message.acked", self.on_message_acked)
+        if on["message_dropped"]:
+            hooks.put("message.dropped", self.on_message_dropped)
+
+    def _publish(self, kind: str, payload: Dict) -> None:
+        payload.setdefault("ts", int(time.time() * 1000))
+        self.broker.publish(Message(
+            topic=f"$event/{kind}",
+            payload=json.dumps(payload).encode(),
+            qos=0,
+            from_client="event_message",
+            headers={"sys": True},  # loop guard (reference sys flag)
+        ))
+
+    @staticmethod
+    def _is_event_msg(msg) -> bool:
+        return getattr(msg, "topic", "").startswith("$event/")
+
+    def on_client_connected(self, clientinfo, *_):
+        self._publish("client_connected", {
+            "clientid": clientinfo.clientid,
+            "username": clientinfo.username,
+            "ipaddress": (clientinfo.peerhost or "").split(":")[0],
+            "proto_ver": getattr(clientinfo, "proto_ver", None),
+            "keepalive": getattr(clientinfo, "keepalive", 0),
+            "connected_at": int(time.time() * 1000),
+        })
+        return None
+
+    def on_client_disconnected(self, clientinfo, normal=True, *_):
+        self._publish("client_disconnected", {
+            "clientid": clientinfo.clientid,
+            "username": clientinfo.username,
+            "reason": "normal" if normal else "abnormal",
+            "disconnected_at": int(time.time() * 1000),
+        })
+        return None
+
+    def on_client_subscribed(self, clientid, filt, opts):
+        self._publish("client_subscribed", {
+            "clientid": clientid,
+            "topic": filt,
+            "subopts": {"qos": getattr(opts, "qos", 0)},
+        })
+        return None
+
+    def on_client_unsubscribed(self, clientid, filt):
+        self._publish("client_unsubscribed", {
+            "clientid": clientid,
+            "topic": filt,
+        })
+        return None
+
+    def on_message_delivered(self, clientid, msg):
+        if self._is_event_msg(msg):  # never event-message an event msg
+            return None
+        self._publish("message_delivered", {
+            "from_clientid": msg.from_client,
+            "from_username": msg.from_username,
+            "clientid": clientid,
+            "topic": msg.topic,
+            "payload": msg.payload.decode("utf-8", "replace"),
+            "qos": msg.qos,
+            "retain": msg.retain,
+        })
+        return None
+
+    def on_message_acked(self, clientid, msg):
+        if self._is_event_msg(msg):
+            return None
+        self._publish("message_acked", {
+            "from_clientid": msg.from_client,
+            "clientid": clientid,
+            "topic": msg.topic,
+            "qos": msg.qos,
+        })
+        return None
+
+    def on_message_dropped(self, msg, reason):
+        if msg is None or self._is_event_msg(msg):
+            return None
+        self._publish("message_dropped", {
+            "from_clientid": msg.from_client,
+            "topic": msg.topic,
+            "qos": msg.qos,
+            "reason": reason,
+        })
+        return None
 
 
 # ---------------------------------------------------------- topic metrics

@@ -220,6 +220,16 @@ class NodeRuntime:
         self.auto_subscribe.install(self.broker.hooks)
         self.topic_metrics = TopicMetrics()
         self.topic_metrics.install(self.broker.hooks)
+        from .modules import EventMessage
+
+        ev_conf = {
+            k: self.conf.get(f"event_message.{k}")
+            for k in EventMessage.TOPICS
+        }
+        self.event_message = None
+        if any(ev_conf.values()):
+            self.event_message = EventMessage(self.broker, ev_conf)
+            self.event_message.install(self.broker.hooks)
 
         # ---- observability (1.13) ---------------------------------------
         self.stats = Stats(self.broker)
@@ -236,13 +246,16 @@ class NodeRuntime:
         # ---- rule engine (emqx_rule_engine) ------------------------------
         from .rules.engine import RuleEngine, build_outputs
 
-        # always present so the REST API can create rules at runtime
+        # always present so the REST API can create rules at runtime;
+        # bridge outputs resolve the manager lazily (bridges are built
+        # after rules, and REST can add either at any time)
         self.rule_engine = RuleEngine(self.broker)
+        bridge_lookup = lambda: self.bridges  # noqa: E731
         for idx, rd in enumerate(self.conf.get("rules") or []):
             self.rule_engine.create_rule(
                 rd.get("id", f"rule{idx}"),
                 rd["sql"],
-                build_outputs(rd.get("outputs")),
+                build_outputs(rd.get("outputs"), bridge_lookup),
                 description=rd.get("description", ""),
             )
 

@@ -205,9 +205,39 @@ class Console:
         log.info("[rule console] %s", selected)
 
 
-def build_outputs(defs) -> List[Callable]:
-    """Output definitions ({"type": "republish"|"console", ...}) ->
-    output callables — shared by node-config and REST rule creation."""
+@dataclass
+class BridgeOutput:
+    """Forward the selected output through a named data bridge — the
+    `emqx_bridge:send_message(BridgeId, Selected)` rule output
+    (`emqx_rule_runtime.erl:270`).  The manager is resolved at call
+    time so rule and bridge construction order doesn't matter."""
+
+    name: str
+    manager_lookup: Callable[[], Any]
+
+    def __call__(self, broker: Broker, selected: Dict[str, Any],
+                 env: Dict[str, Any]) -> None:
+        mgr = self.manager_lookup()
+        if mgr is None:
+            raise EvalError("no bridge manager configured")
+        topic = str(selected.get("topic") or env.get("topic") or "")
+        # SELECT * selections carry the raw payload bytes — serialize
+        # them as text like render_template does for republish
+        body = json.dumps(selected, default=_json_bytes)
+        mgr.send_message(self.name, topic, body.encode("utf-8"))
+
+
+def _json_bytes(v: Any) -> str:
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v).decode("utf-8", "replace")
+    return str(v)
+
+
+def build_outputs(defs, bridge_lookup: Optional[Callable] = None
+                  ) -> List[Callable]:
+    """Output definitions ({"type": "republish"|"console"|"bridge",
+    ...}) -> output callables — shared by node-config and REST rule
+    creation."""
     outs: List[Callable] = []
     for od in defs or [{"type": "console"}]:
         if not isinstance(od, dict):
@@ -227,6 +257,11 @@ def build_outputs(defs) -> List[Callable]:
                     retain=bool(od.get("retain", False)),
                 )
             )
+        elif od.get("type") == "bridge":
+            if not od.get("name"):
+                raise ValueError("bridge output requires 'name'")
+            outs.append(BridgeOutput(od["name"],
+                                     bridge_lookup or (lambda: None)))
         else:
             outs.append(Console())
     return outs
@@ -416,15 +451,7 @@ class RuleEngine:
     # core ----------------------------------------------------------------
 
     def _rule_matches_event(self, rule: Rule, event: str, topic: Optional[str]) -> bool:
-        for t in rule.query.topics:
-            mapped = EVENT_TOPICS.get(t)
-            if mapped is not None:
-                if mapped == event:
-                    return True
-            elif event == "message.publish" and topic is not None:
-                if topiclib.match(topic, t):
-                    return True
-        return False
+        return topics_match_event(rule.query.topics, event, topic)
 
     def _apply(self, event: str, env: Dict[str, Any], topic: Optional[str] = None) -> None:
         for rule in self.rules.values():
@@ -450,3 +477,62 @@ class RuleEngine:
                 except Exception:
                     rule.metrics["failed"] += 1
                     log.exception("rule %s output failed", rule.rule_id)
+
+
+def topics_match_event(topics, event: str,
+                       topic: Optional[str]) -> bool:
+    """FROM-clause match, shared by the live hook path and the SQL
+    tester so they cannot diverge: event topics by name, plain filters
+    against the message.publish topic."""
+    for t in topics:
+        mapped = EVENT_TOPICS.get(t)
+        if mapped is not None:
+            if mapped == event:
+                return True
+        elif event == "message.publish" and topic is not None:
+            if topiclib.match(topic, t):
+                return True
+    return False
+
+
+# ------------------------------------------------------------ SQL tester
+
+class RuleTestNoMatch(Exception):
+    """The FROM clause doesn't select the given event, or WHERE filtered
+    it out — the reference's sqltester 412 'SQL Not Match' case."""
+
+
+def rule_sql_test(sql: str, context: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    """Side-effect-free rule evaluation against a synthetic event — the
+    `emqx_rule_sqltester:test/1` analog behind POST /rule_test.
+
+    `context` carries `event_type` (message_publish, client_connected,
+    ...) plus event fields; defaults mirror the reference's test
+    defaults (topic "t/a", payload "{}")."""
+    q = parse_sql(sql)  # SqlError propagates to the API layer (400)
+    if context is not None and not isinstance(context, dict):
+        raise ValueError("context must be an object")
+    ctx = dict(context or {})
+    event_type = str(ctx.pop("event_type", "message_publish"))
+    event = event_type.replace("_", ".", 1)
+    env: Dict[str, Any] = {
+        "event": event,
+        "topic": ctx.get("topic", "t/a"),
+        "payload": ctx.get("payload", "{}"),
+        "clientid": ctx.get("clientid", "c_emqx"),
+        "username": ctx.get("username", "u_emqx"),
+        "qos": ctx.get("qos", 1),
+        "node": "local",
+        "timestamp": int(time.time() * 1000),
+    }
+    env.update(ctx)
+    if not topics_match_event(q.topics, event, str(env["topic"])):
+        raise RuleTestNoMatch(
+            f"SQL does not select event {event!r} topic {env['topic']!r}"
+        )
+    reset_proc_dict()
+    selected = run_select(q, env)
+    if selected is None:
+        raise RuleTestNoMatch("WHERE clause did not match")
+    return selected

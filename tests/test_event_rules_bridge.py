@@ -1,0 +1,308 @@
+"""Event messages, bridge rule outputs, and the SQL tester.
+
+Covers the three `emqx_modules`/`emqx_rule_engine` surfaces added in
+round 3: the `$event/...` lifecycle publisher (`emqx_event_message.erl`
+analog), rules forwarding their selection through a named data bridge
+(`emqx_rule_runtime.erl:270` send_message), and side-effect-free SQL
+testing (`emqx_rule_sqltester` behind POST /rule_test).
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.message import Message
+from emqx_tpu.modules import EventMessage
+from emqx_tpu.node import NodeRuntime
+from emqx_tpu.rules.engine import (
+    RuleTestNoMatch,
+    build_outputs,
+    rule_sql_test,
+)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ------------------------------------------------------- event messages
+
+
+def test_event_message_lifecycle_over_real_mqtt(tmp_path):
+    """An observer subscribed to $event/# sees connect/subscribe/
+    unsubscribe/disconnect events of another client as JSON."""
+
+    async def main():
+        node = NodeRuntime({
+            "node": {"data_dir": str(tmp_path)},
+            "listeners": [{"type": "tcp", "port": 0}],
+            "dashboard": {"listen_port": 0},
+            "event_message": {
+                "client_connected": True,
+                "client_disconnected": True,
+                "client_subscribed": True,
+                "client_unsubscribed": True,
+            },
+        })
+        await node.start()
+        try:
+            port = node.listeners[0].port
+            watcher = MqttClient("watcher")
+            await watcher.connect("127.0.0.1", port)
+            await watcher.subscribe("$event/#")
+
+            other = MqttClient("dev-1", username="u1")
+            await other.connect("127.0.0.1", port)
+            await other.subscribe("tele/1")
+            await other.unsubscribe(["tele/1"])
+            await other.disconnect()
+
+            # 5 events: the watcher's own subscribe + dev-1's four
+            events = []
+            for _ in range(5):
+                m = await watcher.recv(3)
+                events.append((m.topic, json.loads(m.payload)))
+            # filter to dev-1's lifecycle
+            dev = [(t, p) for t, p in events
+                   if p.get("clientid") == "dev-1"]
+            assert [t for t, _ in dev] == [
+                "$event/client_connected",
+                "$event/client_subscribed",
+                "$event/client_unsubscribed",
+                "$event/client_disconnected",
+            ]
+            connected = dev[0][1]
+            assert connected["username"] == "u1"
+            assert connected["ipaddress"] == "127.0.0.1"
+            assert dev[1][1]["topic"] == "tele/1"
+            assert dev[3][1]["reason"] == "normal"
+            await watcher.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_event_message_no_delivery_loop():
+    """message_delivered events must not fire for $event messages
+    themselves (that would recurse forever)."""
+    broker = Broker()
+    ev = EventMessage(broker, {"message_delivered": True,
+                               "client_subscribed": True})
+    ev.install(broker.hooks)
+    published = []
+    orig = broker.publish
+
+    def spy(msg):
+        published.append(msg.topic)
+        return orig(msg)
+
+    broker.publish = spy
+    # a delivered event for a normal message -> one $event publish
+    broker.hooks.run("message.delivered",
+                     ("c1", Message(topic="t/1", payload=b"x", qos=0)))
+    assert published == ["$event/message_delivered"]
+    # a delivered event for an $event message -> nothing
+    broker.hooks.run(
+        "message.delivered",
+        ("c1", Message(topic="$event/client_subscribed",
+                       payload=b"{}", qos=0)),
+    )
+    assert published == ["$event/message_delivered"]
+
+
+# --------------------------------------------------- bridge rule output
+
+
+def test_rule_bridge_output_forwards_selection(tmp_path):
+    """A rule with a bridge output pushes its SELECTed map through the
+    named bridge (send_message analog), riding the bridge's buffer."""
+    from emqx_tpu.bridges.manager import BridgeManager
+    from emqx_tpu.rules.engine import RuleEngine
+
+    async def main():
+        broker = Broker()
+        sent = []
+
+        mgr = BridgeManager(broker, data_dir=str(tmp_path))
+        # a bridge whose local_topic matches nothing: only the rule
+        # output feeds it
+        await mgr.create({
+            "name": "sink", "type": "http", "local_topic": "$none/#",
+            "path": "/hook", "retry_interval": 0.01,
+            "connector": {"base_url": "http://127.0.0.1:1"},
+        })
+        # capture instead of hitting the (dead) connector
+        async def send(topic, payload):
+            sent.append((topic, payload))
+
+        mgr._bridges["sink"].bridge._send = send
+
+        eng = RuleEngine(broker)
+        eng.create_rule(
+            "r1",
+            'SELECT payload.v AS v, topic FROM "tele/#" WHERE payload.v > 3',
+            build_outputs([{"type": "bridge", "name": "sink"}],
+                          lambda: mgr),
+        )
+        broker.publish(Message(topic="tele/1", payload=b'{"v": 7}',
+                               qos=0))
+        broker.publish(Message(topic="tele/1", payload=b'{"v": 1}',
+                               qos=0))  # filtered by WHERE
+        deadline = asyncio.get_event_loop().time() + 2
+        while not sent and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+        assert len(sent) == 1
+        topic, payload = sent[0]
+        assert topic == "tele/1"
+        assert json.loads(payload) == {"v": 7, "topic": "tele/1"}
+        # a disabled bridge makes the output fail (counted, not fatal)
+        await mgr.disable("sink")
+        broker.publish(Message(topic="tele/2", payload=b'{"v": 9}',
+                               qos=0))
+        assert eng.get_rule("r1").metrics["failed"] == 1
+        await mgr.stop()
+
+    run(main())
+
+
+def test_bridge_output_requires_name():
+    with pytest.raises(ValueError, match="requires 'name'"):
+        build_outputs([{"type": "bridge"}])
+
+
+def test_bridge_output_select_star_serializes_bytes(tmp_path):
+    """SELECT * selections carry raw payload bytes; the bridge output
+    must serialize them, not fail on every event (review finding)."""
+    from emqx_tpu.bridges.manager import BridgeManager
+    from emqx_tpu.rules.engine import RuleEngine
+
+    async def main():
+        broker = Broker()
+        sent = []
+        mgr = BridgeManager(broker, data_dir=str(tmp_path))
+        await mgr.create({
+            "name": "sink", "type": "http", "local_topic": "$none/#",
+            "path": "/hook", "retry_interval": 0.01,
+            "connector": {"base_url": "http://127.0.0.1:1"},
+        })
+
+        async def send(topic, payload):
+            sent.append((topic, payload))
+
+        mgr._bridges["sink"].bridge._send = send
+        eng = RuleEngine(broker)
+        eng.create_rule(
+            "star", 'SELECT * FROM "tele/#"',
+            build_outputs([{"type": "bridge", "name": "sink"}],
+                          lambda: mgr),
+        )
+        broker.publish(Message(topic="tele/b", payload=b"\xffraw",
+                               qos=1))
+        deadline = asyncio.get_event_loop().time() + 2
+        while not sent and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+        assert eng.get_rule("star").metrics["failed"] == 0
+        body = json.loads(sent[0][1])
+        assert body["topic"] == "tele/b" and body["qos"] == 1
+        assert "raw" in body["payload"]  # bytes decoded with replace
+        await mgr.stop()
+
+    run(main())
+
+
+# ----------------------------------------------------------- sql tester
+
+
+def test_rule_sql_tester_basics():
+    out = rule_sql_test(
+        'SELECT payload.x AS x, clientid FROM "t/#" WHERE payload.x = 1',
+        {"event_type": "message_publish", "topic": "t/a",
+         "payload": '{"x": 1}', "clientid": "c9"},
+    )
+    assert out == {"x": 1, "clientid": "c9"}
+    # WHERE mismatch -> no-match error
+    with pytest.raises(RuleTestNoMatch, match="WHERE"):
+        rule_sql_test(
+            'SELECT * FROM "t/#" WHERE payload.x = 2',
+            {"topic": "t/a", "payload": '{"x": 1}'},
+        )
+    # FROM mismatch (different event) -> no-match error
+    with pytest.raises(RuleTestNoMatch, match="does not select"):
+        rule_sql_test(
+            'SELECT * FROM "$events/client_connected"',
+            {"event_type": "message_publish", "topic": "t/a"},
+        )
+    # event selectors work
+    out = rule_sql_test(
+        'SELECT clientid FROM "$events/client_connected"',
+        {"event_type": "client_connected", "clientid": "dev7"},
+    )
+    assert out == {"clientid": "dev7"}
+
+
+def test_rule_test_rest_endpoint(tmp_path):
+    async def main():
+        node = NodeRuntime({
+            "node": {"data_dir": str(tmp_path)},
+            "listeners": [{"type": "tcp", "port": 0}],
+            "dashboard": {"listen_port": 0},
+        })
+        await node.start()
+        try:
+            import urllib.request
+
+            port = node.http.port
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v5/login",
+                data=json.dumps({"username": "admin",
+                                 "password": "public"}).encode(),
+                headers={"Content-Type": "application/json"})
+            token = json.loads(await asyncio.to_thread(
+                lambda: urllib.request.urlopen(req).read()))["token"]
+
+            def post(body):
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api/v5/rule_test",
+                    data=json.dumps(body).encode(),
+                    headers={"Authorization": f"Bearer {token}",
+                             "Content-Type": "application/json"})
+                try:
+                    resp = urllib.request.urlopen(r)
+                    return resp.status, json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read() or b"{}")
+
+            st, body = await asyncio.to_thread(post, {
+                "sql": 'SELECT qos + 1 AS q FROM "t/#"',
+                "context": {"topic": "t/x", "qos": 1},
+            })
+            assert (st, body) == (200, {"q": 2})
+            st, _ = await asyncio.to_thread(post, {
+                "sql": 'SELECT * FROM "other/#"',
+                "context": {"topic": "t/x"},
+            })
+            assert st == 412  # SQL not matched, like the reference
+            st, _ = await asyncio.to_thread(post, {"sql": "SELEC nope"})
+            assert st == 400
+            # runtime eval problems are 4xx, not 500 (review finding)
+            st, body = await asyncio.to_thread(post, {
+                "sql": 'SELECT no_such_fn(payload) FROM "t/#"',
+                "context": {"topic": "t/1"},
+            })
+            assert st == 400 and "no_such_fn" in body["message"]
+            st, _ = await asyncio.to_thread(post, {
+                "sql": 'SELECT * FROM "t/#"', "context": "oops",
+            })
+            assert st == 400
+        finally:
+            await node.stop()
+
+    run(main())
