@@ -126,6 +126,7 @@ class Olp:
     def __init__(self, lag_high_s: float = 0.5, cooldown_s: float = 5.0):
         self.lag_high = lag_high_s
         self.cooldown = cooldown_s
+        self.enabled = True  # runtime kill switch (emqx_ctl olp enable)
         self._overloaded_until = 0.0
         self.shed_count = 0
 
@@ -139,10 +140,19 @@ class Olp:
         return time.monotonic() < self._overloaded_until
 
     def should_accept(self) -> bool:
-        if self.overloaded:
+        if self.enabled and self.overloaded:
             self.shed_count += 1
             return False
         return True
+
+    def status(self) -> dict:
+        return {
+            "enable": self.enabled,
+            "overloaded": self.overloaded,
+            "lag_high_s": self.lag_high,
+            "cooldown_s": self.cooldown,
+            "shed_count": self.shed_count,
+        }
 
 
 class Congestion:

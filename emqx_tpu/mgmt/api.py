@@ -54,6 +54,7 @@ class ManagementApi:
         authz=None,
         gateways=None,
         bridges=None,
+        olp=None,
     ):
         self.broker = broker
         self.node = node
@@ -76,6 +77,7 @@ class ManagementApi:
         self.authz = authz
         self.gateways = gateways
         self.bridges = bridges
+        self.olp = olp
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -128,6 +130,13 @@ class ManagementApi:
         r("PUT", "/telemetry/status", self.telemetry_set, doc="Toggle telemetry")
         r("GET", "/telemetry/data", self.telemetry_data, doc="Telemetry report")
         r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+        r("GET", "/olp", self.olp_get, doc="Overload protection status")
+        r("PUT", "/olp", self.olp_put, doc="Enable/disable OLP")
+        r("GET", "/log", self.log_get, doc="Framework log level")
+        r("PUT", "/log", self.log_put, doc="Set framework log level")
+        r("GET", "/vm", self.vm_get, doc="Runtime/process stats")
+        r("POST", "/authorization/cache/clean", self.authz_cache_clean,
+          doc="Drain every connected client's authz verdict cache")
         r("GET", "/bridges", self.bridges_list,
           doc="Data bridges with resource status + stats")
         r("POST", "/bridges", self.bridge_create, doc="Create a bridge")
@@ -586,6 +595,75 @@ class ManagementApi:
     def _gateway_cm(gw):
         ctx = getattr(gw, "ctx", None)
         return getattr(ctx, "cm", None)
+
+    # -------------------------------------------------- olp / log / vm
+
+    def olp_get(self, req: Request):
+        """`emqx_ctl olp status` analog (emqx_olp.erl)."""
+        return self._need("olp").status()
+
+    def olp_put(self, req: Request):
+        olp = self._need("olp")
+        body = req.json() or {}
+        if "enable" in body:
+            olp.enabled = bool(body["enable"])
+        return olp.status()
+
+    _LOG_LEVELS = ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL")
+
+    def log_get(self, req: Request):
+        import logging
+
+        lvl = logging.getLogger("emqx_tpu").getEffectiveLevel()
+        return {"level": logging.getLevelName(lvl)}
+
+    def log_put(self, req: Request):
+        """`emqx_ctl log set-level` analog: runtime level for the whole
+        framework logger tree."""
+        import logging
+
+        level = str((req.json() or {}).get("level", "")).upper()
+        if level not in self._LOG_LEVELS:
+            raise HttpError(
+                400, f"level must be one of {', '.join(self._LOG_LEVELS)}"
+            )
+        logging.getLogger("emqx_tpu").setLevel(level)
+        return {"level": level}
+
+    def vm_get(self, req: Request):
+        """`emqx_ctl vm` analog: process/runtime gauges."""
+        import gc
+        import os
+        import resource
+        import sys
+        import threading
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        try:
+            fds = len(os.listdir("/proc/self/fd"))
+        except OSError:
+            fds = None
+        return {
+            "python": sys.version.split()[0],
+            "pid": os.getpid(),
+            "max_rss_kb": ru.ru_maxrss,
+            "cpu_user_s": ru.ru_utime,
+            "cpu_system_s": ru.ru_stime,
+            "threads": threading.active_count(),
+            "gc_counts": list(gc.get_count()),
+            "open_fds": fds,
+        }
+
+    def authz_cache_clean(self, req: Request):
+        """`emqx_ctl authz cache-clean all` analog: drain the per-channel
+        verdict caches so source changes take effect immediately."""
+        n = 0
+        for ch in list(self.broker.cm.channels.values()):
+            cache = getattr(ch, "authz_cache", None)
+            if cache is not None:
+                cache.drain()
+                n += 1
+        return {"cleaned": n}
 
     # ------------------------------------------------------------ bridges
 

@@ -50,7 +50,9 @@ class Cli:
         self.usage: Dict[str, str] = {}
         for name in ("status", "broker", "clients", "subscriptions", "topics",
                      "publish", "ban", "listeners", "metrics", "stats",
-                     "trace", "cluster", "plugins", "telemetry", "node_dump"):
+                     "trace", "cluster", "plugins", "telemetry", "node_dump",
+                     "vm", "log", "olp", "authz", "bridges", "rules",
+                     "gateways"):
             self.register(name, getattr(self, "cmd_" + name),
                           getattr(getattr(self, "cmd_" + name), "__doc__", ""))
 
@@ -293,6 +295,74 @@ class Cli:
         else:
             self.p(self.usage["telemetry"])
             return 1
+
+
+    def cmd_vm(self, args):
+        """Process/runtime stats (emqx_ctl vm analog)."""
+        for k, v in self._get("/vm").items():
+            self.p(f"{k:<16} {v}")
+
+    def cmd_log(self, args):
+        """log | log set-level <DEBUG|INFO|WARNING|ERROR|CRITICAL>"""
+        if args and args[0] == "set-level":
+            out = self._put("/log", {"level": args[1]})
+            self.p(f"level set to {out['level']}")
+        else:
+            self.p(self._get("/log")["level"])
+
+    def cmd_olp(self, args):
+        """olp status | enable | disable (emqx_ctl olp analog)"""
+        sub = args[0] if args else "status"
+        if sub == "status":
+            for k, v in self._get("/olp").items():
+                self.p(f"{k:<14} {v}")
+        elif sub in ("enable", "disable"):
+            self._put("/olp", {"enable": sub == "enable"})
+            self.p(f"olp {sub}d")
+        else:
+            return 1
+
+    def cmd_authz(self, args):
+        """authz cache-clean — drain all clients' verdict caches"""
+        if args and args[0] == "cache-clean":
+            out = self._post("/authorization/cache/clean")
+            self.p(f"cleaned {out['cleaned']} client caches")
+        else:
+            self.p(self.usage["authz"])
+            return 1
+
+    def cmd_bridges(self, args):
+        """bridges list | enable|disable|restart <name>"""
+        sub = args[0] if args else "list"
+        if sub == "list":
+            for b in self._get("/bridges"):
+                res = b.get("resource") or {}
+                self.p(f"{b['name']:<20} {b['type']} {b['direction']} "
+                       f"enabled={b['enable']} "
+                       f"status={res.get('status')}")
+        elif sub in ("enable", "disable", "restart"):
+            self._put(f"/bridges/{args[1]}/{sub}")
+            self.p(f"bridge {args[1]} {sub}ed")
+        else:
+            return 1
+
+    def cmd_rules(self, args):
+        """rules list | show <id>"""
+        sub = args[0] if args else "list"
+        if sub == "list":
+            for r_ in self._get("/rules")["data"]:
+                self.p(f"{r_['id']:<16} enabled={r_['enabled']} "
+                       f"matched={r_['metrics']['matched']}")
+        elif sub == "show":
+            self.p(json.dumps(self._get(f"/rules/{args[1]}"), indent=2))
+        else:
+            return 1
+
+    def cmd_gateways(self, args):
+        """List protocol gateways."""
+        for g in self._get("/gateways")["data"]:
+            self.p(f"{g['name']:<12} {g['type']} :{g['port']} "
+                   f"clients={g['clients']}")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

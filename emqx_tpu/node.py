@@ -331,6 +331,7 @@ class NodeRuntime:
             authz=self.authz,
             gateways=self.gateways,
             bridges=self.bridges,
+            olp=self.olp,
         )
         self.http = HttpApi(
             port=self.conf.get("dashboard.listen_port"),

