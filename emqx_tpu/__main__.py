@@ -29,7 +29,12 @@ def main(argv=None) -> int:
         help="print the checked effective config and exit",
     )
     ap.add_argument(
-        "--log-level", default="INFO", help="root log level (default INFO)"
+        "--log-level", default=None,
+        help="root log level (overrides the log.level config key)"
+    )
+    ap.add_argument(
+        "--log-format", default=None, choices=("text", "json"),
+        help="line format (overrides the log.format config key)"
     )
     args = ap.parse_args(argv)
 
@@ -42,9 +47,12 @@ def main(argv=None) -> int:
         print(json.dumps(Config(raw).dump(), indent=2, sort_keys=True))
         return 0
 
-    logging.basicConfig(
-        level=getattr(logging, args.log_level.upper(), logging.INFO),
-        format="%(asctime)s [%(levelname)s] %(name)s: %(message)s",
+    from .observe.logfmt import setup_logging
+
+    conf = Config(raw)
+    setup_logging(
+        level=args.log_level or conf.get("log.level"),
+        fmt=args.log_format or conf.get("log.format"),
     )
     node = NodeRuntime(raw)
     try:

@@ -259,7 +259,14 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
             "duration", 0.05,
             desc="pause between retained re-delivery batches"),
     },
-    "delayed": {"enable": Field("bool", True), "max_delayed_messages": Field("int", 0)},
+    "delayed": {
+        "enable": Field("bool", True),
+        "max_delayed_messages": Field("int", 0, min=0,
+                                      desc="0 = unlimited"),
+        "persist": Field("bool", False,
+                         desc="survive restarts (disc mnesia analog); "
+                              "opt-in like retainer.backend=disc"),
+    },
     "authn": {"enable": Field("bool", False), "allow_anonymous": Field("bool", True)},
     "authz": {
         "enable": Field("bool", False),
@@ -268,6 +275,13 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "cache_enable": Field("bool", True),
         "cache_max_size": Field("int", 32, min=1),
         "cache_ttl": Field("duration", 60.0),
+    },
+    "log": {
+        "level": Field("enum", "INFO",
+                       enum=["DEBUG", "INFO", "WARNING", "ERROR",
+                             "CRITICAL"]),
+        "format": Field("enum", "text", enum=["text", "json"],
+                        desc="emqx_logger_jsonfmt analog when json"),
     },
     "event_message": {
         "client_connected": Field("bool", False),

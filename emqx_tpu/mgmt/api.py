@@ -55,6 +55,7 @@ class ManagementApi:
         gateways=None,
         bridges=None,
         olp=None,
+        delayed=None,
     ):
         self.broker = broker
         self.node = node
@@ -78,6 +79,7 @@ class ManagementApi:
         self.gateways = gateways
         self.bridges = bridges
         self.olp = olp
+        self.delayed = delayed
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -130,6 +132,14 @@ class ManagementApi:
         r("PUT", "/telemetry/status", self.telemetry_set, doc="Toggle telemetry")
         r("GET", "/telemetry/data", self.telemetry_data, doc="Telemetry report")
         r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+        r("GET", "/mqtt/delayed", self.delayed_status,
+          doc="Delayed-publish status")
+        r("PUT", "/mqtt/delayed", self.delayed_put,
+          doc="Enable/disable delayed publish, set the cap")
+        r("GET", "/mqtt/delayed/messages", self.delayed_messages,
+          doc="Pending delayed messages")
+        r("DELETE", "/mqtt/delayed/messages/{msgid}",
+          self.delayed_delete, doc="Cancel one delayed message")
         r("GET", "/olp", self.olp_get, doc="Overload protection status")
         r("PUT", "/olp", self.olp_put, doc="Enable/disable OLP")
         r("GET", "/log", self.log_get, doc="Framework log level")
@@ -595,6 +605,33 @@ class ManagementApi:
     def _gateway_cm(gw):
         ctx = getattr(gw, "ctx", None)
         return getattr(ctx, "cm", None)
+
+    # ------------------------------------------------------------ delayed
+
+    def delayed_status(self, req: Request):
+        return self._need("delayed").status()
+
+    def delayed_put(self, req: Request):
+        d = self._need("delayed")
+        body = req.json() or {}
+        if "enable" in body:
+            d.enable = bool(body["enable"])
+        if "max_delayed_messages" in body:
+            try:
+                d.max_delayed_messages = max(
+                    0, int(body["max_delayed_messages"])
+                )
+            except (TypeError, ValueError):
+                raise HttpError(400, "max_delayed_messages must be int")
+        return d.status()
+
+    def delayed_messages(self, req: Request):
+        return paginate(self._need("delayed").list(), req)
+
+    def delayed_delete(self, req: Request):
+        if not self._need("delayed").delete(req.params["msgid"]):
+            raise HttpError(404, "no such delayed message")
+        return 204, None
 
     # -------------------------------------------------- olp / log / vm
 

@@ -29,16 +29,161 @@ class DelayedPublish:
     A publish to `$delayed/5/a/b` is withheld and re-published to `a/b`
     after 5 seconds.  Driven either by `tick()` (tests, housekeeping loop)
     or an asyncio runner.
+
+    With `store_path` set, scheduled messages persist across restarts
+    (the reference keeps them in a disc-copies mnesia table): schedules
+    and completions append to a JSON-lines log, compacted at boot and
+    when completions pile up.  `max_delayed_messages` bounds the table
+    like the reference's config; overflow drops the NEW message and
+    counts it.
     """
 
     PREFIX = "$delayed/"
     MAX_DELAY = 4294967.0
+    _COMPACT_DEAD = 1024  # rewrite the log after this many done-records
 
-    def __init__(self, broker: Broker, enable: bool = True):
+    def __init__(self, broker: Broker, enable: bool = True,
+                 max_delayed_messages: int = 0,
+                 store_path: Optional[str] = None):
         self.broker = broker
         self.enable = enable
+        self.max_delayed_messages = int(max_delayed_messages)
+        self.dropped = 0
         self._heap: List[Tuple[float, int, Message]] = []
         self._seq = 0
+        self._live: Dict[str, Tuple[float, int]] = {}  # msgid -> (due, seq)
+        self._canceled: set = set()  # seqs removed before firing
+        self._store_path = store_path
+        self._store = None
+        self._dead_records = 0
+        if store_path is not None:
+            self._load()
+            self._compact()
+
+    # --------------------------------------------------------- persistence
+
+    @staticmethod
+    def _enc_val(v):
+        import base64
+
+        if isinstance(v, (bytes, bytearray)):
+            return {"__b": base64.b64encode(bytes(v)).decode()}
+        return v
+
+    @staticmethod
+    def _dec_val(v):
+        import base64
+
+        if isinstance(v, dict) and "__b" in v:
+            return base64.b64decode(v["__b"])
+        return v
+
+    @classmethod
+    def _msg_to_rec(cls, msg: Message) -> Dict:
+        import base64
+
+        return {
+            "topic": msg.topic,
+            "payload": base64.b64encode(msg.payload).decode(),
+            "qos": msg.qos,
+            "retain": msg.retain,
+            "dup": msg.dup,
+            "from_client": msg.from_client,
+            "from_username": msg.from_username,
+            "mid": msg.mid.hex(),
+            "timestamp": msg.timestamp,
+            # v5 properties must survive the restart: expiry intervals,
+            # response-topic/correlation-data, user properties
+            "props": {
+                (str(int(k)) if isinstance(k, int) else str(k)):
+                cls._enc_val(v)
+                for k, v in msg.properties.items()
+            },
+        }
+
+    @classmethod
+    def _rec_to_msg(cls, rec: Dict) -> Message:
+        import base64
+
+        props = {}
+        for k, v in (rec.get("props") or {}).items():
+            props[int(k) if k.lstrip("-").isdigit() else k] = \
+                cls._dec_val(v)
+        return Message(
+            topic=rec["topic"],
+            payload=base64.b64decode(rec["payload"]),
+            qos=int(rec.get("qos", 0)),
+            retain=bool(rec.get("retain")),
+            dup=bool(rec.get("dup")),
+            from_client=rec.get("from_client", ""),
+            from_username=rec.get("from_username"),
+            mid=bytes.fromhex(rec["mid"]),
+            timestamp=int(rec.get("timestamp", 0)),
+            properties=props,
+        )
+
+    def _append(self, rec: Dict) -> None:
+        if self._store_path is None:
+            return
+        if self._store is None:
+            self._store = open(self._store_path, "a", encoding="utf-8")
+        self._store.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._store.flush()
+
+    def _load(self) -> None:
+        import os
+
+        if not os.path.exists(self._store_path):
+            return
+        live: Dict[str, Dict] = {}
+        with open(self._store_path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break  # torn tail from a crash mid-append
+                if rec.get("op") == "sched":
+                    live[rec["msg"]["mid"]] = rec
+                else:  # done / cancel
+                    live.pop(rec.get("id", ""), None)
+        for rec in live.values():
+            msg = self._rec_to_msg(rec["msg"])
+            self._schedule(float(rec["due"]), msg, persist=False)
+
+    def _compact(self) -> None:
+        """Rewrite the log with only live schedules (boot + threshold)."""
+        import os
+
+        if self._store_path is None:
+            return
+        if self._store is not None:
+            self._store.close()
+            self._store = None
+        tmp = self._store_path + ".tmp"
+        by_seq = sorted(
+            ((seq, due, mid) for mid, (due, seq) in self._live.items())
+        )
+        msgs = {seq: msg for due, seq, msg in self._heap}
+        with open(tmp, "w", encoding="utf-8") as f:
+            for seq, due, mid in by_seq:
+                if seq in msgs:
+                    f.write(json.dumps(
+                        {"op": "sched", "due": due,
+                         "msg": self._msg_to_rec(msgs[seq])},
+                        separators=(",", ":")) + "\n")
+        os.replace(tmp, self._store_path)
+        self._dead_records = 0
+
+    # ----------------------------------------------------------- schedule
+
+    def _schedule(self, due: float, msg: Message, persist: bool = True
+                  ) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (due, self._seq, msg))
+        self._live[msg.mid.hex()] = (due, self._seq)
+        if persist:
+            self._append({"op": "sched", "due": due,
+                          "msg": self._msg_to_rec(msg)})
 
     def on_message_publish(self, msg: Message):
         if not self.enable or not isinstance(msg, Message):
@@ -54,27 +199,98 @@ class DelayedPublish:
         if not sep or not real:
             return None
         out = replace(msg, topic=real, headers=dict(msg.headers, allow_publish=False, delayed=delay))
-        self._seq += 1
-        heapq.heappush(self._heap, (time.time() + delay, self._seq, replace(out, headers=dict(msg.headers))))
+        from .broker.hooks import STOP
+
+        if self.max_delayed_messages and \
+                len(self._live) >= self.max_delayed_messages:
+            # table full: drop the new message (reference behavior)
+            self.dropped += 1
+            return (STOP, out)
+        self._schedule(time.time() + delay,
+                       replace(out, headers=dict(msg.headers)))
         # STOP the fold (like emqx_delayed): downstream publish hooks (rule
         # engine, metrics) must not observe the withheld message now — they
         # run when tick() republishes it
-        from .broker.hooks import STOP
-
         return (STOP, out)  # broker sees allow_publish=False and drops it
 
     def tick(self, now: Optional[float] = None) -> int:
         now = now if now is not None else time.time()
         n = 0
         while self._heap and self._heap[0][0] <= now:
-            _, _, msg = heapq.heappop(self._heap)
+            due, seq, msg = heapq.heappop(self._heap)
+            if seq in self._canceled:
+                self._canceled.discard(seq)
+                continue
+            self._live.pop(msg.mid.hex(), None)
+            self._append({"op": "done", "id": msg.mid.hex()})
+            if self._store_path is not None:
+                self._dead_records += 1
             self.broker.publish(msg)
             n += 1
+        if self._store_path is not None and \
+                self._dead_records >= self._COMPACT_DEAD:
+            self._compact()
         return n
+
+    # --------------------------------------------------------- management
+
+    def list(self) -> List[Dict]:
+        """Pending messages for GET /mqtt/delayed/messages."""
+        now = time.time()
+        msgs = {seq: (due, msg) for due, seq, msg in self._heap
+                if seq not in self._canceled}
+        out = []
+        for mid, (due, seq) in sorted(self._live.items(),
+                                      key=lambda kv: kv[1][0]):
+            ent = msgs.get(seq)
+            if ent is None:
+                continue
+            _, msg = ent
+            out.append({
+                "msgid": mid,
+                "topic": msg.topic,
+                "qos": msg.qos,
+                "payload_size": len(msg.payload),
+                "from_clientid": msg.from_client,
+                "delayed_remaining": max(0, int(due - now)),
+                "expected_at": int(due * 1000),
+            })
+        return out
+
+    def delete(self, msgid: str) -> bool:
+        """DELETE /mqtt/delayed/messages/{msgid}."""
+        ent = self._live.pop(msgid, None)
+        if ent is None:
+            return False
+        self._canceled.add(ent[1])
+        self._append({"op": "done", "id": msgid})
+        if self._store_path is not None:
+            self._dead_records += 1
+        # lazy heap deletion, but don't let canceled long-delay entries
+        # (and their payloads) dominate memory until their due time
+        if len(self._canceled) > max(64, len(self._live)):
+            self._heap = [(due, seq, msg) for due, seq, msg in self._heap
+                          if seq not in self._canceled]
+            heapq.heapify(self._heap)
+            self._canceled.clear()
+        return True
+
+    def status(self) -> Dict:
+        return {
+            "enable": self.enable,
+            "max_delayed_messages": self.max_delayed_messages,
+            "pending": len(self._live),
+            "dropped": self.dropped,
+        }
+
+    def close(self) -> None:
+        if self._store is not None:
+            self._store.close()
+            self._store = None
 
     @property
     def pending(self) -> int:
-        return len(self._heap)
+        return len(self._live)
 
     def install(self, hooks: Hooks) -> None:
         hooks.put("message.publish", self.on_message_publish, priority=50)

@@ -191,8 +191,19 @@ class NodeRuntime:
             self.authz.install(self.broker.hooks)
 
         # ---- modules (emqx_modules) ------------------------------------
+        delayed_store = None
+        if self.conf.get("delayed.persist"):
+            os.makedirs(self.conf.get("node.data_dir"), exist_ok=True)
+            delayed_store = os.path.join(
+                self.conf.get("node.data_dir"), "delayed.log"
+            )
         self.delayed = DelayedPublish(
-            self.broker, enable=self.conf.get("delayed.enable")
+            self.broker,
+            enable=self.conf.get("delayed.enable"),
+            max_delayed_messages=self.conf.get(
+                "delayed.max_delayed_messages"
+            ),
+            store_path=delayed_store,
         )
         self.delayed.install(self.broker.hooks)
         from .broker.packet import SubOpts
@@ -332,6 +343,7 @@ class NodeRuntime:
             gateways=self.gateways,
             bridges=self.bridges,
             olp=self.olp,
+            delayed=self.delayed,
         )
         self.http = HttpApi(
             port=self.conf.get("dashboard.listen_port"),
@@ -643,6 +655,7 @@ class NodeRuntime:
             self.persistence.tick()  # final dirty-page flush
         if self.broker.retainer.store is not None:
             self.broker.retainer.store.close()
+        self.delayed.close()
         for drv in self._db_drivers:
             fn = getattr(drv, "stop", None)
             if fn is not None:

@@ -1,0 +1,87 @@
+"""Log formatters — the `emqx_logger_jsonfmt` / text formatter analogs.
+
+The reference ships two OTP logger formatters: a structured JSON
+formatter for log aggregation (`emqx_logger_jsonfmt.erl`: one JSON
+object per line, best-effort serialization that never throws out of
+the formatter) and a human text formatter.  Same here, as stdlib
+`logging.Formatter`s selected by the `log.format` config key:
+
+* `JsonFormatter` — one compact JSON object per line: ts (epoch ms),
+  level, logger, msg, plus exception info and any `extra={...}` fields
+  the call site attached; values that json can't encode degrade to
+  `repr` instead of raising (the reference's best_effort_json);
+* `TextFormatter` — the existing human-readable line.
+
+`setup_logging(level, fmt)` configures the root handler; `__main__`
+drives it from `--log-format` / the `log` config section.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any
+
+# attributes of a LogRecord that are NOT call-site extras
+_STD_ATTRS = frozenset(logging.LogRecord(
+    "", 0, "", 0, "", (), None
+).__dict__) | {"message", "asctime", "taskName"}
+
+
+def _best_effort(v: Any) -> Any:
+    """Values json.dumps can't take degrade to repr — the formatter
+    must never raise (emqx_logger_jsonfmt best_effort_json)."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v).decode("utf-8", "replace")
+    if isinstance(v, dict):
+        return {str(k): _best_effort(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_best_effort(x) for x in v]
+    return repr(v)
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            msg = f"format_error: {record.msg!r} % {record.args!r}"
+        out = {
+            "ts": int(record.created * 1000),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": msg,
+        }
+        if record.exc_info and record.exc_info[0] is not None:
+            out["exc"] = self.formatException(record.exc_info)
+        for k, v in record.__dict__.items():
+            if k not in _STD_ATTRS and not k.startswith("_"):
+                out[k] = _best_effort(v)
+        try:
+            return json.dumps(out, ensure_ascii=False,
+                              default=lambda o: repr(o))
+        except Exception:  # pragma: no cover - double best-effort
+            return json.dumps({"ts": out["ts"], "level": out["level"],
+                               "logger": out["logger"],
+                               "msg": "jsonfmt_format_error"})
+
+
+class TextFormatter(logging.Formatter):
+    def __init__(self):
+        super().__init__(
+            "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+        )
+
+
+def setup_logging(level: str = "INFO", fmt: str = "text") -> None:
+    """Configure the root handler once (the logger handler install of
+    `emqx_logger` at boot)."""
+    handler = logging.StreamHandler()
+    handler.setFormatter(JsonFormatter() if fmt == "json"
+                         else TextFormatter())
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))

@@ -1,0 +1,228 @@
+"""Delayed-publish persistence + management (`emqx_delayed.erl`
+disc-copies table + `emqx_delayed_api` /mqtt/delayed surface)."""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.modules import DelayedPublish
+
+
+def _sched(dp, broker, topic, payload, delay=60):
+    broker.publish(Message(topic=f"$delayed/{delay}/{topic}",
+                           payload=payload, qos=1))
+
+
+def test_survives_restart(tmp_path):
+    store = str(tmp_path / "delayed.log")
+    b1 = Broker()
+    d1 = DelayedPublish(b1, store_path=store)
+    d1.install(b1.hooks)
+    _sched(d1, b1, "a/1", b"p1", delay=60)
+    _sched(d1, b1, "a/2", b"p2", delay=0)  # fires before "restart"
+    assert d1.pending == 2
+    fired = d1.tick(time.time() + 0.1)
+    assert fired == 1 and d1.pending == 1
+    d1.close()
+
+    # restart: only the unfired message returns
+    b2 = Broker()
+    got = []
+    b2.hooks.put("message.publish", lambda m: got.append(m.topic)
+                 if isinstance(m, Message) else None)
+    d2 = DelayedPublish(b2, store_path=store)
+    d2.install(b2.hooks)
+    assert d2.pending == 1
+    assert d2.list()[0]["topic"] == "a/1"
+    # overdue after the clock passes: fires with original payload
+    assert d2.tick(time.time() + 120) == 1
+    assert "a/1" in got
+    d2.close()
+
+
+def test_v5_properties_survive_restart(tmp_path):
+    """Expiry/correlation/user properties must not be stripped by the
+    persistence roundtrip (round-3 review finding)."""
+    from emqx_tpu.broker.packet import Property
+
+    store = str(tmp_path / "delayed.log")
+    b = Broker()
+    d = DelayedPublish(b, store_path=store)
+    d.install(b.hooks)
+    b.publish(Message(
+        topic="$delayed/60/req/1", payload=b"ask", qos=1,
+        properties={
+            Property.MESSAGE_EXPIRY_INTERVAL: 300,
+            Property.CORRELATION_DATA: b"\x01\x02",
+            Property.RESPONSE_TOPIC: "resp/1",
+        },
+    ))
+    d.close()
+    got = []
+    b2 = Broker()
+    b2.hooks.put("message.publish", lambda m: got.append(m)
+                 if isinstance(m, Message) else None)
+    d2 = DelayedPublish(b2, store_path=store)
+    d2.install(b2.hooks)
+    d2.tick(time.time() + 120)
+    (msg,) = got
+    assert msg.properties[Property.MESSAGE_EXPIRY_INTERVAL] == 300
+    assert msg.properties[Property.CORRELATION_DATA] == b"\x01\x02"
+    assert msg.properties[Property.RESPONSE_TOPIC] == "resp/1"
+    d2.close()
+
+
+def test_canceled_entries_swept_from_heap():
+    b = Broker()
+    d = DelayedPublish(b)
+    d.install(b.hooks)
+    for i in range(200):
+        _sched(d, b, f"s/{i}", b"x", delay=3600)
+    for row in d.list()[:150]:
+        d.delete(row["msgid"])
+    # lazy deletion must not hold 150 canceled payloads for an hour
+    assert len(d._heap) < 100
+    assert d.pending == 50
+
+
+def test_cancel_persists(tmp_path):
+    store = str(tmp_path / "delayed.log")
+    b = Broker()
+    d = DelayedPublish(b, store_path=store)
+    d.install(b.hooks)
+    _sched(d, b, "x/1", b"boom", delay=60)
+    msgid = d.list()[0]["msgid"]
+    assert d.delete(msgid) is True
+    assert d.delete(msgid) is False
+    assert d.pending == 0
+    assert d.tick(time.time() + 120) == 0  # canceled entry never fires
+    d.close()
+    d2 = DelayedPublish(Broker(), store_path=store)
+    assert d2.pending == 0  # cancellation survived the restart
+    d2.close()
+
+
+def test_torn_tail_tolerated(tmp_path):
+    store = str(tmp_path / "delayed.log")
+    b = Broker()
+    d = DelayedPublish(b, store_path=store)
+    d.install(b.hooks)
+    _sched(d, b, "k/1", b"ok", delay=60)
+    d.close()
+    with open(store, "a", encoding="utf-8") as f:
+        f.write('{"op": "sched", "due"')  # crash mid-append
+    d2 = DelayedPublish(Broker(), store_path=store)
+    assert d2.pending == 1
+    d2.close()
+
+
+def test_max_delayed_messages_drops_new():
+    b = Broker()
+    d = DelayedPublish(b, max_delayed_messages=2)
+    d.install(b.hooks)
+    for i in range(4):
+        _sched(d, b, f"t/{i}", b"x", delay=60)
+    assert d.pending == 2 and d.dropped == 2
+    st = d.status()
+    assert st["pending"] == 2 and st["dropped"] == 2
+
+
+def test_compaction_rewrites_log(tmp_path):
+    store = str(tmp_path / "delayed.log")
+    b = Broker()
+    d = DelayedPublish(b, store_path=store)
+    d._COMPACT_DEAD = 5  # small threshold for the test
+    d.install(b.hooks)
+    for i in range(8):
+        _sched(d, b, f"c/{i}", b"x", delay=0)
+    d.tick(time.time() + 1)  # fires all 8 -> dead records > threshold
+    _sched(d, b, "c/keep", b"x", delay=60)
+    d.close()
+    lines = open(store).read().strip().splitlines()
+    # compacted: only live schedules remain (the keeper)
+    scheds = [json.loads(l) for l in lines if l]
+    assert len([r for r in scheds if r.get("op") == "sched"]) == 1
+    d2 = DelayedPublish(Broker(), store_path=store)
+    assert d2.pending == 1
+    d2.close()
+
+
+def test_rest_surface(tmp_path):
+    from emqx_tpu.node import NodeRuntime
+
+    async def main():
+        node = NodeRuntime({
+            "node": {"data_dir": str(tmp_path)},
+            "delayed": {"persist": True},
+            "listeners": [{"type": "tcp", "port": 0}],
+            "dashboard": {"listen_port": 0},
+        })
+        await node.start()
+        try:
+            import urllib.request
+
+            port = node.http.port
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v5/login",
+                data=json.dumps({"username": "admin",
+                                 "password": "public"}).encode(),
+                headers={"Content-Type": "application/json"})
+            tok = json.loads(await asyncio.to_thread(
+                lambda: urllib.request.urlopen(req).read()))["token"]
+
+            def call(method, path, body=None):
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api/v5{path}",
+                    method=method,
+                    data=json.dumps(body).encode() if body else None,
+                    headers={"Authorization": f"Bearer {tok}",
+                             "Content-Type": "application/json"})
+                try:
+                    resp = urllib.request.urlopen(r)
+                    raw = resp.read()
+                    return resp.status, (json.loads(raw) if raw
+                                         else None)
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read() or b"{}")
+
+            from emqx_tpu.broker.client import MqttClient
+
+            c = MqttClient("dp1")
+            await c.connect("127.0.0.1", node.listeners[0].port)
+            await c.publish("$delayed/300/room/1", b"later", qos=1)
+
+            st, body = await asyncio.to_thread(call, "GET",
+                                               "/mqtt/delayed")
+            assert st == 200 and body["pending"] == 1
+            st, body = await asyncio.to_thread(
+                call, "GET", "/mqtt/delayed/messages")
+            assert body["data"][0]["topic"] == "room/1"
+            assert body["data"][0]["delayed_remaining"] > 290
+            msgid = body["data"][0]["msgid"]
+            st, _ = await asyncio.to_thread(
+                call, "DELETE", f"/mqtt/delayed/messages/{msgid}")
+            assert st == 204
+            st, body = await asyncio.to_thread(call, "GET",
+                                               "/mqtt/delayed")
+            assert body["pending"] == 0
+            st, body = await asyncio.to_thread(
+                call, "PUT", "/mqtt/delayed",
+                {"enable": False, "max_delayed_messages": 5})
+            assert body["enable"] is False
+            assert body["max_delayed_messages"] == 5
+            # disabled: $delayed passes through as a plain topic? no —
+            # the reference still treats the prefix; our module simply
+            # stops withholding, so the raw topic publishes normally
+            await c.disconnect()
+        finally:
+            await node.stop()
+
+    asyncio.new_event_loop().run_until_complete(main())

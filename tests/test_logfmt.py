@@ -1,0 +1,64 @@
+"""JSON/text log formatters (`emqx_logger_jsonfmt` analog)."""
+
+import json
+import logging
+
+from emqx_tpu.observe.logfmt import (
+    JsonFormatter,
+    TextFormatter,
+    setup_logging,
+)
+
+
+def _record(msg, args=(), level=logging.INFO, exc_info=None, extra=None):
+    rec = logging.LogRecord("emqx_tpu.test", level, "f.py", 1, msg,
+                            args, exc_info)
+    for k, v in (extra or {}).items():
+        setattr(rec, k, v)
+    return rec
+
+
+def test_json_line_shape():
+    line = JsonFormatter().format(_record("hello %s", ("world",)))
+    obj = json.loads(line)
+    assert obj["msg"] == "hello world"
+    assert obj["level"] == "info"
+    assert obj["logger"] == "emqx_tpu.test"
+    assert isinstance(obj["ts"], int)
+    assert "\n" not in line  # one object per line
+
+
+def test_json_extras_and_exceptions():
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        import sys
+
+        exc = sys.exc_info()
+    line = JsonFormatter().format(_record(
+        "failed", level=logging.ERROR, exc_info=exc,
+        extra={"clientid": "c1", "blob": b"\xff", "obj": object()},
+    ))
+    obj = json.loads(line)
+    assert obj["level"] == "error"
+    assert "ValueError: boom" in obj["exc"]
+    assert obj["clientid"] == "c1"
+    assert isinstance(obj["blob"], str)  # bytes degraded, not raised
+    assert obj["obj"].startswith("<object")  # repr fallback
+
+
+def test_json_never_raises_on_bad_format_args():
+    line = JsonFormatter().format(_record("%d", ("not-an-int",)))
+    assert "format_error" in json.loads(line)["msg"]
+
+
+def test_setup_logging_switches_formatter():
+    setup_logging("WARNING", "json")
+    root = logging.getLogger()
+    try:
+        assert isinstance(root.handlers[0].formatter, JsonFormatter)
+        assert root.level == logging.WARNING
+        setup_logging("INFO", "text")
+        assert isinstance(root.handlers[0].formatter, TextFormatter)
+    finally:
+        setup_logging("WARNING", "text")  # restore test default

@@ -138,5 +138,5 @@ def test_cli_new_commands(tmp_path):
     assert cli.run(["gateways"]) == 0  # unwraps the "data" envelope
     assert "stomp" in out.getvalue()
     out.truncate(0)
-    assert cli.run(["bridges", "list"]) != 0 or True  # no manager: 404 -> error path
+    assert cli.run(["bridges", "list"]) == 1  # no manager: 404 error path
     logging.getLogger("emqx_tpu").setLevel(logging.WARNING)
