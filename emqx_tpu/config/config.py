@@ -276,6 +276,16 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "cache_max_size": Field("int", 32, min=1),
         "cache_ttl": Field("duration", 60.0),
     },
+    "prometheus": {
+        "enable": Field("bool", False),
+        "push_gateway_server": Field("str", ""),
+        "interval": Field("duration", 15.0),
+    },
+    "statsd": {
+        "enable": Field("bool", False),
+        "server": Field("str", "127.0.0.1:8125"),
+        "flush_time_interval": Field("duration", 10.0),
+    },
     "log": {
         "level": Field("enum", "INFO",
                        enum=["DEBUG", "INFO", "WARNING", "ERROR",

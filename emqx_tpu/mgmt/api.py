@@ -56,6 +56,7 @@ class ManagementApi:
         bridges=None,
         olp=None,
         delayed=None,
+        exporters=None,
     ):
         self.broker = broker
         self.node = node
@@ -80,6 +81,7 @@ class ManagementApi:
         self.bridges = bridges
         self.olp = olp
         self.delayed = delayed
+        self.exporters = exporters
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -132,6 +134,24 @@ class ManagementApi:
         r("PUT", "/telemetry/status", self.telemetry_set, doc="Toggle telemetry")
         r("GET", "/telemetry/data", self.telemetry_data, doc="Telemetry report")
         r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+        r("GET", "/prometheus", self.prometheus_get,
+          doc="Prometheus push-exporter config + counters")
+        r("PUT", "/prometheus", self.prometheus_put,
+          doc="Update the Prometheus push exporter")
+        r("GET", "/prometheus/stats", self.prometheus_stats,
+          doc="Prometheus text exposition (pull mode)")
+        r("GET", "/statsd", self.statsd_get, doc="StatsD exporter config")
+        r("PUT", "/statsd", self.statsd_put, doc="Update the StatsD exporter")
+        r("GET", "/mqtt/retainer", self.retainer_status,
+          doc="Retainer status")
+        r("PUT", "/mqtt/retainer", self.retainer_put,
+          doc="Enable/disable the retainer, set limits")
+        r("GET", "/mqtt/retainer/messages", self.retainer_messages,
+          doc="Retained messages (paginated)")
+        r("GET", "/mqtt/retainer/message/{topic}", self.retainer_get_one,
+          doc="One retained message (topic url-encoded)")
+        r("DELETE", "/mqtt/retainer/message/{topic}",
+          self.retainer_delete_one, doc="Drop one retained message")
         r("GET", "/mqtt/delayed", self.delayed_status,
           doc="Delayed-publish status")
         r("PUT", "/mqtt/delayed", self.delayed_put,
@@ -605,6 +625,99 @@ class ManagementApi:
     def _gateway_cm(gw):
         ctx = getattr(gw, "ctx", None)
         return getattr(ctx, "cm", None)
+
+    # ----------------------------------------------- exporters / retainer
+
+    def prometheus_get(self, req: Request):
+        return self._need("exporters").prometheus_status()
+
+    def prometheus_put(self, req: Request):
+        try:
+            return self._need("exporters").update_prometheus(
+                req.json() or {}
+            )
+        except ValueError as e:
+            raise HttpError(400, str(e))
+
+    def prometheus_stats(self, req: Request):
+        from .http import RawResponse
+
+        return 200, RawResponse(
+            self._need("exporters").render().encode(),
+            content_type="text/plain; version=0.0.4; charset=utf-8",
+        )
+
+    def statsd_get(self, req: Request):
+        return self._need("exporters").statsd_status()
+
+    def statsd_put(self, req: Request):
+        try:
+            return self._need("exporters").update_statsd(req.json() or {})
+        except ValueError as e:
+            raise HttpError(400, str(e))
+
+    def _retainer(self):
+        return self.broker.retainer
+
+    def retainer_status(self, req: Request):
+        rt = self._retainer()
+        return {
+            "enable": rt.enable,
+            "count": rt.count,
+            "max_retained_messages": rt.max_retained,
+            "max_payload_size": rt.max_payload,
+            "backend": "disc" if rt.store is not None else "ram",
+        }
+
+    def retainer_put(self, req: Request):
+        rt = self._retainer()
+        body = req.json() or {}
+        if "enable" in body:
+            rt.enable = bool(body["enable"])
+        for key, attr in (("max_retained_messages", "max_retained"),
+                          ("max_payload_size", "max_payload")):
+            if key in body:
+                try:
+                    val = int(body[key])
+                except (TypeError, ValueError):
+                    raise HttpError(400, f"{key} must be an int")
+                if val < 0:
+                    # 0 means UNLIMITED here; silently clamping a
+                    # negative would invert the caller's intent
+                    raise HttpError(400, f"{key} must be >= 0")
+                setattr(rt, attr, val)
+        return self.retainer_status(req)
+
+    def retainer_messages(self, req: Request):
+        rows = [
+            {
+                "topic": m.topic,
+                "qos": m.qos,
+                "payload_size": len(m.payload),
+                "from_clientid": m.from_client,
+                "publish_at": m.timestamp,
+            }
+            for m in self._retainer().walk_all()
+        ]
+        rows.sort(key=lambda r_: r_["topic"])
+        return paginate(rows, req)
+
+    def retainer_get_one(self, req: Request):
+        m = self._retainer().get(req.params["topic"])
+        if m is None:
+            raise HttpError(404, "no retained message on that topic")
+        return {
+            "topic": m.topic,
+            "qos": m.qos,
+            "payload": base64.b64encode(m.payload).decode(),
+            "from_clientid": m.from_client,
+            "publish_at": m.timestamp,
+        }
+
+    def retainer_delete_one(self, req: Request):
+        if not self._retainer().delete(req.params["topic"]):
+            raise HttpError(404, "no retained message on that topic")
+        return 204, None
 
     # ------------------------------------------------------------ delayed
 

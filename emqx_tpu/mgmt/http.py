@@ -201,7 +201,10 @@ class HttpApi:
     async def _dispatch(self, method: str, target: str, headers: Dict[str, str],
                         body: bytes) -> Tuple[int, Any]:
         parts = urlsplit(target)
-        path = unquote(parts.path)
+        # match on the RAW path: a %2F inside a path param (retained
+        # topic names) must not split into segments; params are
+        # unquoted individually after the match
+        path = parts.path
         query = parse_qs(parts.query)
         matched_path = False
         for route in self.routes:

@@ -253,6 +253,24 @@ class NodeRuntime:
             self.broker, stats=self.stats, node=self.node_name
         )
         self.monitor = MonitorSampler(self.broker)
+        from .observe.exporters import ExporterRuntime
+
+        self.exporters = ExporterRuntime(
+            metrics_fn=lambda: self.broker.metrics.all(),
+            stats_fn=lambda: self.stats.collect(),
+            prometheus={
+                "enable": self.conf.get("prometheus.enable"),
+                "push_gateway_server": self.conf.get(
+                    "prometheus.push_gateway_server"),
+                "interval": self.conf.get("prometheus.interval"),
+            },
+            statsd={
+                "enable": self.conf.get("statsd.enable"),
+                "server": self.conf.get("statsd.server"),
+                "flush_time_interval": self.conf.get(
+                    "statsd.flush_time_interval"),
+            },
+        )
 
         # ---- rule engine (emqx_rule_engine) ------------------------------
         from .rules.engine import RuleEngine, build_outputs
@@ -344,6 +362,7 @@ class NodeRuntime:
             bridges=self.bridges,
             olp=self.olp,
             delayed=self.delayed,
+            exporters=self.exporters,
         )
         self.http = HttpApi(
             port=self.conf.get("dashboard.listen_port"),
@@ -352,6 +371,7 @@ class NodeRuntime:
         self.api.install(self.http)
 
         self._tick_task: Optional[asyncio.Task] = None
+        self._exporter_task: Optional[asyncio.Task] = None
         self._stop_evt: Optional[asyncio.Event] = None
         self.started = False
 
@@ -600,6 +620,11 @@ class NodeRuntime:
             await self.http.start()
             self._stop_evt = asyncio.Event()
             self._tick_task = asyncio.create_task(self._ticker())
+            # separate task: a hung pushgateway (5s timeouts) must not
+            # stall delayed publish / retainer flush / heartbeats
+            self._exporter_task = asyncio.create_task(
+                self._exporter_loop()
+            )
         except BaseException:
             await self._shutdown()
             raise
@@ -624,13 +649,15 @@ class NodeRuntime:
     async def _shutdown(self) -> None:
         """Stop every component that is running; safe on partial starts
         (each component's stop() tolerates never-started state)."""
-        if self._tick_task:
-            self._tick_task.cancel()
-            try:
-                await self._tick_task
-            except (asyncio.CancelledError, Exception):
-                pass
-            self._tick_task = None
+        for attr in ("_tick_task", "_exporter_task"):
+            task = getattr(self, attr)
+            if task:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+                setattr(self, attr, None)
         await self.http.stop()
         for name in self.gateways.list():
             try:
@@ -664,6 +691,17 @@ class NodeRuntime:
                 except Exception:
                     log.exception("stopping db driver %r", drv)
         self.traces.stop_all()
+
+    async def _exporter_loop(self) -> None:
+        """Prometheus/StatsD export cadence, isolated from the node
+        ticker (pushes can block for their full network timeout)."""
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                now = asyncio.get_running_loop().time()
+                await asyncio.to_thread(self.exporters.tick, now)
+            except Exception:
+                log.exception("exporter tick")
 
     async def _ticker(self) -> None:
         """Node-level periodic work: $SYS heartbeats, dashboard sampler,

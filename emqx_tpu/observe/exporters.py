@@ -53,6 +53,133 @@ class PrometheusPush:
             return False
 
 
+class ExporterRuntime:
+    """Config-driven export scheduling — the `emqx_prometheus` +
+    `emqx_statsd` app lifecycles: a push/flush timer each, runtime
+    enable/disable + endpoint updates over REST, and the pull-mode
+    `/prometheus/stats` exposition rendered from the same tables."""
+
+    def __init__(self, metrics_fn, stats_fn,
+                 prometheus: Optional[Dict] = None,
+                 statsd: Optional[Dict] = None):
+        self.metrics_fn = metrics_fn
+        self.stats_fn = stats_fn
+        self.prometheus = {
+            "enable": False, "push_gateway_server": "",
+            "interval": 15.0, **(prometheus or {}),
+        }
+        self.statsd = {
+            "enable": False, "server": "127.0.0.1:8125",
+            "flush_time_interval": 10.0, **(statsd or {}),
+        }
+        self.prom_pushes = 0
+        self.prom_failures = 0
+        self._pusher: Optional[PrometheusPush] = None
+        self._statsd: Optional["StatsdExporter"] = None
+        self._last_prom = 0.0
+        self._last_statsd = 0.0
+        # boot-time validation: bad config is a clear error, not a
+        # traceback from the first tick
+        self._validate(self.prometheus, "interval")
+        self._validate(self.statsd, "flush_time_interval")
+        self._parse_server(self.statsd["server"])
+        self._rebuild()
+
+    @staticmethod
+    def _parse_server(server: str):
+        host, _, port = str(server).partition(":")
+        try:
+            return host or "127.0.0.1", int(port or 8125)
+        except ValueError:
+            raise ValueError(
+                f"statsd server must be host:port, got {server!r}"
+            )
+
+    @staticmethod
+    def _validate(cfg: Dict, interval_key: str) -> None:
+        """Raise ValueError on bad values BEFORE they are committed —
+        a rejected update must not poison later rebuilds or the node
+        ticker."""
+        try:
+            cfg[interval_key] = float(cfg[interval_key])
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"{interval_key} must be a number of seconds, got "
+                f"{cfg[interval_key]!r}"
+            )
+        if cfg[interval_key] <= 0:
+            raise ValueError(f"{interval_key} must be > 0")
+
+    def _rebuild(self) -> None:
+        p = self.prometheus
+        self._pusher = (
+            PrometheusPush(p["push_gateway_server"])
+            if p["enable"] and p["push_gateway_server"] else None
+        )
+        old = self._statsd
+        s = self.statsd
+        if s["enable"]:
+            host, port = self._parse_server(s["server"])
+            self._statsd = StatsdExporter(host, port)
+        else:
+            self._statsd = None
+        if old is not None:
+            old.close()  # don't leak the previous UDP socket
+
+    def update_prometheus(self, changes: Dict) -> Dict:
+        cand = dict(self.prometheus)
+        for k in ("enable", "push_gateway_server", "interval"):
+            if k in changes:
+                cand[k] = changes[k]
+        self._validate(cand, "interval")
+        self.prometheus = cand
+        self._rebuild()
+        return self.prometheus_status()
+
+    def update_statsd(self, changes: Dict) -> Dict:
+        cand = dict(self.statsd)
+        for k in ("enable", "server", "flush_time_interval"):
+            if k in changes:
+                cand[k] = changes[k]
+        self._validate(cand, "flush_time_interval")
+        self._parse_server(cand["server"])  # validate before commit
+        self.statsd = cand
+        self._rebuild()
+        return self.statsd_status()
+
+    def prometheus_status(self) -> Dict:
+        return {**self.prometheus, "pushes": self.prom_pushes,
+                "failures": self.prom_failures}
+
+    def statsd_status(self) -> Dict:
+        return dict(self.statsd)
+
+    def render(self) -> str:
+        """Pull-mode exposition (GET /prometheus/stats)."""
+        return render_prometheus(self.metrics_fn(), self.stats_fn())
+
+    def tick(self, now: float) -> None:
+        """Called off the event loop (pushes block on the network).
+        Locals snapshot the exporters: a concurrent update_* on the
+        event-loop thread may null them mid-tick."""
+        pusher = self._pusher
+        if pusher is not None and \
+                now - self._last_prom >= float(self.prometheus["interval"]):
+            self._last_prom = now
+            ok = pusher.push(self.metrics_fn(), self.stats_fn())
+            self.prom_pushes += 1
+            if not ok:
+                self.prom_failures += 1
+        statsd = self._statsd
+        if statsd is not None and now - self._last_statsd >= \
+                float(self.statsd["flush_time_interval"]):
+            self._last_statsd = now
+            try:
+                statsd.flush(self.metrics_fn(), self.stats_fn())
+            except OSError:
+                pass
+
+
 class StatsdExporter:
     """StatsD line protocol over UDP (`emqx_statsd` analog)."""
 

@@ -1,0 +1,230 @@
+"""Prometheus/StatsD exporter runtime + retainer REST
+(`emqx_prometheus_api`, `emqx_statsd`, `emqx_retainer_api` analogs).
+"""
+
+import asyncio
+import base64
+import json
+import os
+import socket
+import urllib.parse
+
+import pytest
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+from emqx_tpu.observe.exporters import ExporterRuntime
+from emqx_tpu.node import NodeRuntime
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# --------------------------------------------------------- runtime unit
+
+
+def test_exporter_runtime_schedule_and_update():
+    pushes = []
+
+    class FakePusher:
+        def push(self, m, s):
+            pushes.append((m, s))
+            return len(pushes) != 2  # second push "fails"
+
+    rt = ExporterRuntime(lambda: {"m": 1}, lambda: {"g": 2},
+                         prometheus={"enable": True,
+                                     "push_gateway_server": "http://x",
+                                     "interval": 10.0})
+    rt._pusher = FakePusher()
+    rt.tick(100.0)
+    rt.tick(105.0)  # inside the interval: no push
+    rt.tick(110.0)
+    assert len(pushes) == 2
+    st = rt.prometheus_status()
+    assert st["pushes"] == 2 and st["failures"] == 1
+    # runtime disable stops scheduling
+    rt.update_prometheus({"enable": False})
+    rt.tick(130.0)
+    assert len(pushes) == 2
+    # exposition has both tables
+    text = rt.render()
+    assert "emqx_m 1" in text and "emqx_g 2" in text
+
+
+def test_statsd_flush_over_udp():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(2)
+    port = sock.getsockname()[1]
+    rt = ExporterRuntime(lambda: {"messages.received": 7},
+                         lambda: {"connections.count": 3},
+                         statsd={"enable": True,
+                                 "server": f"127.0.0.1:{port}",
+                                 "flush_time_interval": 1.0})
+    rt.tick(50.0)
+    data = sock.recv(65536).decode()
+    assert "messages_received" in data.replace(".", "_") or \
+        "messages.received" in data
+    sock.close()
+
+
+def test_bad_updates_rejected_before_commit():
+    """Invalid values 400 without poisoning later rebuilds (round-3
+    review findings)."""
+    rt = ExporterRuntime(lambda: {}, lambda: {})
+    with pytest.raises(ValueError, match="interval"):
+        rt.update_prometheus({"interval": "15s"})
+    with pytest.raises(ValueError, match="host:port"):
+        rt.update_statsd({"enable": True, "server": "host:abc"})
+    # the rejected values did NOT stick: further updates still work
+    out = rt.update_prometheus({"enable": True,
+                                "push_gateway_server": "http://x"})
+    assert out["enable"] is True and out["interval"] == 15.0
+    out = rt.update_statsd({"enable": True,
+                            "server": "127.0.0.1:8125"})
+    assert out["enable"] is True
+    # boot-time validation is loud too
+    with pytest.raises(ValueError, match="host:port"):
+        ExporterRuntime(lambda: {}, lambda: {},
+                        statsd={"server": "host:abc"})
+
+
+def test_rebuild_closes_previous_statsd_socket():
+    rt = ExporterRuntime(lambda: {}, lambda: {},
+                         statsd={"enable": True,
+                                 "server": "127.0.0.1:8125"})
+    first = rt._statsd
+    rt.update_statsd({"server": "127.0.0.1:8126"})
+    assert rt._statsd is not first
+    assert first._sock.fileno() == -1  # old UDP socket closed
+
+
+def test_tick_race_with_concurrent_disable():
+    """A tick that snapshotted the pusher must survive a concurrent
+    disable nulling self._pusher."""
+    rt = ExporterRuntime(lambda: {}, lambda: {},
+                         prometheus={"enable": True,
+                                     "push_gateway_server": "http://x",
+                                     "interval": 1.0})
+
+    class Pusher:
+        def push(self, m, s):
+            rt.update_prometheus({"enable": False})  # mid-push disable
+            return True
+
+    rt._pusher = Pusher()
+    rt.tick(100.0)  # must not raise
+    assert rt.prom_pushes == 1
+
+
+# ----------------------------------------------------------------- REST
+
+
+def test_rest_exporters_and_retainer(tmp_path):
+    async def main():
+        node = NodeRuntime({
+            "node": {"data_dir": str(tmp_path)},
+            "listeners": [{"type": "tcp", "port": 0}],
+            "dashboard": {"listen_port": 0},
+        })
+        await node.start()
+        try:
+            import urllib.request
+
+            port = node.http.port
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v5/login",
+                data=json.dumps({"username": "admin",
+                                 "password": "public"}).encode(),
+                headers={"Content-Type": "application/json"})
+            tok = json.loads(await asyncio.to_thread(
+                lambda: urllib.request.urlopen(req).read()))["token"]
+
+            def call(method, path, body=None, raw=False):
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api/v5{path}",
+                    method=method,
+                    data=json.dumps(body).encode() if body else None,
+                    headers={"Authorization": f"Bearer {tok}",
+                             "Content-Type": "application/json"})
+                try:
+                    resp = urllib.request.urlopen(r)
+                    data = resp.read()
+                    if raw:
+                        return resp.status, data, dict(resp.headers)
+                    return resp.status, (json.loads(data) if data
+                                         else None)
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read() or b"{}")
+
+            # prometheus config + pull exposition
+            st, body = await asyncio.to_thread(call, "GET",
+                                               "/prometheus")
+            assert st == 200 and body["enable"] is False
+            st, body = await asyncio.to_thread(
+                call, "PUT", "/prometheus",
+                {"enable": True,
+                 "push_gateway_server": "http://gw.internal:9091"})
+            assert body["enable"] is True
+            st, data, headers = await asyncio.to_thread(
+                call, "GET", "/prometheus/stats", None, True)
+            assert st == 200
+            assert headers["Content-Type"].startswith("text/plain")
+            assert b"# TYPE emqx_" in data
+            st, body = await asyncio.to_thread(
+                call, "PUT", "/statsd", {"enable": True,
+                                         "server": "127.0.0.1:8125"})
+            assert body["enable"] is True
+
+            # retained message lifecycle over MQTT + REST
+            from emqx_tpu.broker.client import MqttClient
+
+            c = MqttClient("rc1")
+            await c.connect("127.0.0.1", node.listeners[0].port)
+            await c.publish("building/a/temp", b"21.5", qos=1,
+                            retain=True)
+            st, body = await asyncio.to_thread(call, "GET",
+                                               "/mqtt/retainer")
+            assert body["count"] == 1 and body["backend"] == "ram"
+            st, body = await asyncio.to_thread(
+                call, "GET", "/mqtt/retainer/messages")
+            assert body["data"][0]["topic"] == "building/a/temp"
+            # topic path param with %2F-encoded slashes
+            enc = urllib.parse.quote("building/a/temp", safe="")
+            st, body = await asyncio.to_thread(
+                call, "GET", f"/mqtt/retainer/message/{enc}")
+            assert st == 200
+            assert base64.b64decode(body["payload"]) == b"21.5"
+            st, _ = await asyncio.to_thread(
+                call, "DELETE", f"/mqtt/retainer/message/{enc}")
+            assert st == 204
+            st, body = await asyncio.to_thread(call, "GET",
+                                               "/mqtt/retainer")
+            assert body["count"] == 0
+            st, _ = await asyncio.to_thread(
+                call, "GET", f"/mqtt/retainer/message/{enc}")
+            assert st == 404
+            # runtime limit update
+            st, body = await asyncio.to_thread(
+                call, "PUT", "/mqtt/retainer",
+                {"max_retained_messages": 10})
+            assert body["max_retained_messages"] == 10
+            # negative would silently mean "unlimited": rejected
+            st, _ = await asyncio.to_thread(
+                call, "PUT", "/mqtt/retainer",
+                {"max_retained_messages": -1})
+            assert st == 400
+            # bad exporter updates are client errors, not 500s
+            st, _ = await asyncio.to_thread(
+                call, "PUT", "/prometheus", {"interval": "15s"})
+            assert st == 400
+            st, _ = await asyncio.to_thread(
+                call, "PUT", "/statsd", {"server": "host:abc"})
+            assert st == 400
+            await c.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
