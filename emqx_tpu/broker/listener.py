@@ -437,6 +437,9 @@ class Listener:
             await asyncio.gather(*tasks, return_exceptions=True)
         if self._server:
             await self._server.wait_closed()
+        # a stopped listener reports running=False and can be started
+        # again (REST /listeners/{id}/start)
+        self._server = None
 
     @property
     def current_connections(self) -> int:

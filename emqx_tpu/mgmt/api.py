@@ -57,6 +57,7 @@ class ManagementApi:
         olp=None,
         delayed=None,
         exporters=None,
+        api_keys=None,
     ):
         self.broker = broker
         self.node = node
@@ -82,6 +83,7 @@ class ManagementApi:
         self.olp = olp
         self.delayed = delayed
         self.exporters = exporters
+        self.api_keys = api_keys
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -134,6 +136,16 @@ class ManagementApi:
         r("PUT", "/telemetry/status", self.telemetry_set, doc="Toggle telemetry")
         r("GET", "/telemetry/data", self.telemetry_data, doc="Telemetry report")
         r("GET", "/api-docs", self.api_docs, public=True, doc="OpenAPI document")
+        r("GET", "/api_key", self.api_keys_list, doc="API keys")
+        r("POST", "/api_key", self.api_key_create,
+          doc="Create an API key (secret returned once)")
+        r("GET", "/api_key/{name}", self.api_key_get, doc="One API key")
+        r("PUT", "/api_key/{name}", self.api_key_update,
+          doc="Enable/disable or describe an API key")
+        r("DELETE", "/api_key/{name}", self.api_key_delete,
+          doc="Remove an API key")
+        r("POST", "/listeners/{listener_id}/{action}",
+          self.listener_action, doc="start|stop|restart a listener")
         r("GET", "/prometheus", self.prometheus_get,
           doc="Prometheus push-exporter config + counters")
         r("PUT", "/prometheus", self.prometheus_put,
@@ -283,10 +295,20 @@ class ManagementApi:
     def telemetry_data(self, req: Request):
         return self._need("telemetry").get_telemetry()
 
-    def auth_check(self, token: str) -> bool:
+    def auth_check(self, token: str):
+        """Returns a truthy principal kind ("dashboard"/"api_key") or
+        False — the HTTP layer records it on the request so key
+        management can stay dashboard-only."""
         if self.tokens is None:
-            return True
-        return self.tokens.verify(token) is not None
+            return "dashboard"
+        if self.tokens.verify(token) is not None:
+            return "dashboard"
+        # basic-auth machine credentials (api_key:api_secret) — the
+        # emqx_mgmt_auth application credentials
+        if self.api_keys is not None and \
+                self.api_keys.verify_basic(token):
+            return "api_key"
+        return False
 
     # ---------------------------------------------------------------- auth
 
@@ -625,6 +647,101 @@ class ManagementApi:
     def _gateway_cm(gw):
         ctx = getattr(gw, "ctx", None)
         return getattr(ctx, "cm", None)
+
+    # ------------------------------------------------------------ api_key
+
+    @staticmethod
+    def _dashboard_only(req: Request) -> None:
+        """Machine credentials must not manage credentials: a leaked
+        expiring key could otherwise mint itself a permanent one (the
+        reference's emqx_mgmt_auth forbids this the same way)."""
+        if req.principal == "api_key":
+            raise HttpError(
+                403, "api_key credentials cannot manage api keys"
+            )
+
+    @staticmethod
+    def _check_expired_at(body: Dict):
+        v = body.get("expired_at")
+        if v is not None and not isinstance(v, (int, float)):
+            raise HttpError(
+                400, "expired_at must be a unix timestamp or null"
+            )
+        return v
+
+    def api_keys_list(self, req: Request):
+        self._dashboard_only(req)
+        return self._need("api_keys").list()
+
+    def api_key_create(self, req: Request):
+        self._dashboard_only(req)
+        body = req.json() or {}
+        if not body.get("name"):
+            raise HttpError(400, "name required")
+        try:
+            return 201, self._need("api_keys").create(
+                body["name"],
+                desc=str(body.get("desc", "")),
+                expired_at=self._check_expired_at(body),
+                enable=bool(body.get("enable", True)),
+            )
+        except ValueError as e:
+            raise HttpError(400, str(e))
+
+    def api_key_get(self, req: Request):
+        self._dashboard_only(req)
+        rec = self._need("api_keys").get(req.params["name"])
+        if rec is None:
+            raise HttpError(404, "no such api key")
+        return rec
+
+    def api_key_update(self, req: Request):
+        self._dashboard_only(req)
+        body = req.json() or {}
+        if "expired_at" in body:
+            self._check_expired_at(body)
+        rec = self._need("api_keys").update(
+            req.params["name"],
+            desc=body.get("desc", ...),
+            enable=body.get("enable", ...),
+            expired_at=body.get("expired_at", ...),
+        )
+        if rec is None:
+            raise HttpError(404, "no such api key")
+        return rec
+
+    def api_key_delete(self, req: Request):
+        self._dashboard_only(req)
+        if not self._need("api_keys").delete(req.params["name"]):
+            raise HttpError(404, "no such api key")
+        return 204, None
+
+    # ---------------------------------------------------------- listeners
+
+    async def listener_action(self, req: Request):
+        """start|stop|restart one listener
+        (`emqx_mgmt_api_listeners.erl` manage_listeners)."""
+        lid = req.params["listener_id"]
+        action = req.params["action"]
+        if action not in ("start", "stop", "restart"):
+            raise HttpError(400, f"unknown action {action!r}")
+        target = None
+        for l in self.listeners:
+            if f"tcp:{getattr(l, 'port', '?')}" == lid:
+                target = l
+                break
+        if target is None:
+            raise HttpError(404, f"no such listener {lid!r}")
+        if action in ("stop", "restart") and \
+                getattr(target, "_server", None) is not None:
+            await target.stop()
+        if action in ("start", "restart") and \
+                getattr(target, "_server", None) is None:
+            await target.start()
+        return {
+            "id": f"tcp:{target.port}",
+            "running": getattr(target, "_server", None) is not None,
+        }
 
     # ----------------------------------------------- exporters / retainer
 

@@ -38,6 +38,8 @@ class Request:
     query: Dict[str, List[str]]
     headers: Dict[str, str]
     body: bytes
+    # set by dispatch after auth: "dashboard" | "api_key" | None
+    principal: Optional[str] = None
 
     def json(self) -> Any:
         if not self.body:
@@ -214,16 +216,23 @@ class HttpApi:
             matched_path = True
             if route.method != method:
                 continue
+            principal = None
             if not route.public and self.auth is not None:
                 tok = headers.get("authorization", "")
                 if tok.lower().startswith("bearer "):
                     tok = tok[7:]
                 elif tok.lower().startswith("basic "):
                     tok = tok[6:]
-                if not self.auth(tok):
+                principal = self.auth(tok)
+                if not principal:
                     return 401, {"code": "BAD_TOKEN", "message": "unauthorized"}
             req = Request(method, path, {k: unquote(v) for k, v in m.groupdict().items()},
                           query, headers, body)
+            # who authenticated (truthy auth result): "dashboard" for
+            # admin tokens, "api_key" for machine credentials — some
+            # routes are dashboard-only (key management)
+            req.principal = principal if isinstance(principal, str) \
+                else None
             try:
                 result = route.handler(req)
                 if inspect.isawaitable(result):

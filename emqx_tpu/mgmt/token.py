@@ -92,3 +92,90 @@ class TokenStore:
 
     def revoke(self, token: str) -> None:
         self._revoked.add(token)
+
+
+class ApiKeyStore:
+    """Long-lived machine credentials — the `emqx_mgmt_api_app` /
+    `emqx_mgmt_auth` analog: named API keys used over HTTP basic auth
+    (api_key:api_secret).  The secret is generated once, stored only
+    as salted PBKDF2, and never returned again."""
+
+    def __init__(self):
+        self._keys: Dict[str, Dict] = {}  # name -> record
+        self._by_key: Dict[str, str] = {}  # api_key -> name
+
+    def create(self, name: str, desc: str = "",
+               expired_at: Optional[float] = None,
+               enable: bool = True) -> Dict:
+        if name in self._keys:
+            raise ValueError(f"api key {name!r} exists")
+        api_key = _b64(os.urandom(12))
+        secret = _b64(os.urandom(24))
+        salt = os.urandom(16)
+        self._keys[name] = {
+            "name": name,
+            "api_key": api_key,
+            "salt": salt,
+            "hash": TokenStore._hash(secret, salt),
+            "desc": desc,
+            "enable": bool(enable),
+            "expired_at": expired_at,
+            "created_at": time.time(),
+        }
+        self._by_key[api_key] = name
+        # the ONLY response that carries the secret
+        return {"name": name, "api_key": api_key, "api_secret": secret,
+                "desc": desc, "enable": bool(enable),
+                "expired_at": expired_at}
+
+    def verify(self, api_key: str, secret: str,
+               now: Optional[float] = None) -> bool:
+        name = self._by_key.get(api_key)
+        if name is None:
+            return False
+        rec = self._keys[name]
+        if not rec["enable"]:
+            return False
+        if rec["expired_at"] is not None and \
+                (now if now is not None else time.time()) > rec["expired_at"]:
+            return False
+        return hmac.compare_digest(
+            rec["hash"], TokenStore._hash(secret, rec["salt"])
+        )
+
+    def verify_basic(self, b64cred: str) -> bool:
+        """`Basic base64(api_key:api_secret)` credentials."""
+        try:
+            key, _, secret = base64.b64decode(b64cred).decode().partition(":")
+        except Exception:
+            return False
+        return self.verify(key, secret)
+
+    @staticmethod
+    def _public(rec: Dict) -> Dict:
+        return {k: rec[k] for k in ("name", "api_key", "desc", "enable",
+                                    "expired_at", "created_at")}
+
+    def list(self):
+        return [self._public(r) for r in self._keys.values()]
+
+    def get(self, name: str) -> Optional[Dict]:
+        rec = self._keys.get(name)
+        return self._public(rec) if rec else None
+
+    def update(self, name: str, **changes) -> Optional[Dict]:
+        rec = self._keys.get(name)
+        if rec is None:
+            return None
+        for k in ("desc", "enable", "expired_at"):
+            if k in changes and changes[k] is not ...:
+                rec[k] = changes[k]
+        rec["enable"] = bool(rec["enable"])
+        return self._public(rec)
+
+    def delete(self, name: str) -> bool:
+        rec = self._keys.pop(name, None)
+        if rec is None:
+            return False
+        self._by_key.pop(rec["api_key"], None)
+        return True

@@ -333,6 +333,9 @@ class NodeRuntime:
             )
 
         # ---- management REST (1.12) ---------------------------------------
+        from .mgmt.token import ApiKeyStore
+
+        self.api_keys = ApiKeyStore()
         self.tokens = TokenStore(
             ttl_s=self.conf.get("dashboard.token_expired_time")
         )
@@ -363,6 +366,7 @@ class NodeRuntime:
             olp=self.olp,
             delayed=self.delayed,
             exporters=self.exporters,
+            api_keys=self.api_keys,
         )
         self.http = HttpApi(
             port=self.conf.get("dashboard.listen_port"),
@@ -697,6 +701,8 @@ class NodeRuntime:
         ticker (pushes can block for their full network timeout)."""
         while True:
             await asyncio.sleep(1.0)
+            if not self.exporters.active:
+                continue  # both disabled: skip the thread hop
             try:
                 now = asyncio.get_running_loop().time()
                 await asyncio.to_thread(self.exporters.tick, now)

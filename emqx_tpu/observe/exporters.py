@@ -158,6 +158,12 @@ class ExporterRuntime:
         """Pull-mode exposition (GET /prometheus/stats)."""
         return render_prometheus(self.metrics_fn(), self.stats_fn())
 
+    @property
+    def active(self) -> bool:
+        """Whether a tick would do anything — lets the node skip the
+        per-second thread hop while both exporters are disabled."""
+        return self._pusher is not None or self._statsd is not None
+
     def tick(self, now: float) -> None:
         """Called off the event loop (pushes block on the network).
         Locals snapshot the exporters: a concurrent update_* on the

@@ -1,0 +1,185 @@
+"""API keys (basic-auth machine credentials, `emqx_mgmt_api_app`
+analog) and runtime listener operations (`emqx_mgmt_api_listeners`
+manage_listeners analog)."""
+
+import asyncio
+import base64
+import json
+import os
+import time
+
+import pytest
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+from emqx_tpu.mgmt.token import ApiKeyStore
+from emqx_tpu.node import NodeRuntime
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ----------------------------------------------------------- key store
+
+
+def test_api_key_lifecycle():
+    s = ApiKeyStore()
+    rec = s.create("ci", desc="pipeline", enable=True)
+    assert set(rec) >= {"api_key", "api_secret", "name"}
+    assert s.verify(rec["api_key"], rec["api_secret"]) is True
+    assert s.verify(rec["api_key"], "wrong") is False
+    assert s.verify("ghost", rec["api_secret"]) is False
+    # the secret is never listed again
+    assert "api_secret" not in s.get("ci")
+    assert "hash" not in s.get("ci") and "salt" not in s.get("ci")
+    with pytest.raises(ValueError):
+        s.create("ci")
+    # disable gates verification; re-enable restores it
+    s.update("ci", enable=False)
+    assert s.verify(rec["api_key"], rec["api_secret"]) is False
+    s.update("ci", enable=True)
+    assert s.verify(rec["api_key"], rec["api_secret"]) is True
+    # expiry
+    s.update("ci", expired_at=time.time() - 1)
+    assert s.verify(rec["api_key"], rec["api_secret"]) is False
+    assert s.delete("ci") is True and s.delete("ci") is False
+
+
+def test_basic_credential_parsing():
+    s = ApiKeyStore()
+    rec = s.create("m2m")
+    b64 = base64.b64encode(
+        f"{rec['api_key']}:{rec['api_secret']}".encode()
+    ).decode()
+    assert s.verify_basic(b64) is True
+    assert s.verify_basic("!!!notbase64") is False
+    assert s.verify_basic(base64.b64encode(b"nocolon").decode()) is False
+
+
+# ----------------------------------------------------------------- REST
+
+
+def test_rest_api_keys_and_listener_ops(tmp_path):
+    async def main():
+        node = NodeRuntime({
+            "node": {"data_dir": str(tmp_path)},
+            "listeners": [{"type": "tcp", "port": 0}],
+            "dashboard": {"listen_port": 0},
+        })
+        await node.start()
+        try:
+            import urllib.request
+
+            port = node.http.port
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v5/login",
+                data=json.dumps({"username": "admin",
+                                 "password": "public"}).encode(),
+                headers={"Content-Type": "application/json"})
+            tok = json.loads(await asyncio.to_thread(
+                lambda: urllib.request.urlopen(req).read()))["token"]
+
+            def call(method, path, body=None, auth=None):
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api/v5{path}",
+                    method=method,
+                    data=json.dumps(body).encode() if body else None,
+                    headers={"Authorization": auth or f"Bearer {tok}",
+                             "Content-Type": "application/json"})
+                try:
+                    resp = urllib.request.urlopen(r)
+                    raw = resp.read()
+                    return resp.status, (json.loads(raw) if raw
+                                         else None)
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read() or b"{}")
+
+            # create a key, use it over basic auth
+            st, rec = await asyncio.to_thread(
+                call, "POST", "/api_key", {"name": "ci"})
+            assert st == 201 and "api_secret" in rec
+            basic = "Basic " + base64.b64encode(
+                f"{rec['api_key']}:{rec['api_secret']}".encode()
+            ).decode()
+            st, body = await asyncio.to_thread(
+                call, "GET", "/stats", None, basic)
+            assert st == 200
+            # wrong secret is rejected
+            bad = "Basic " + base64.b64encode(
+                f"{rec['api_key']}:nope".encode()).decode()
+            st, _ = await asyncio.to_thread(call, "GET", "/stats",
+                                            None, bad)
+            assert st == 401
+            # listing never re-exposes the secret
+            st, keys = await asyncio.to_thread(call, "GET", "/api_key")
+            assert st == 200 and "api_secret" not in keys[0]
+            # disable via REST kills the credential
+            st, _ = await asyncio.to_thread(
+                call, "PUT", "/api_key/ci", {"enable": False})
+            st, _ = await asyncio.to_thread(call, "GET", "/stats",
+                                            None, basic)
+            assert st == 401
+            st, _ = await asyncio.to_thread(call, "DELETE",
+                                            "/api_key/ci")
+            assert st == 204
+
+            # a machine credential must NOT manage credentials (a
+            # leaked expiring key could mint itself a permanent one)
+            st, rec2 = await asyncio.to_thread(
+                call, "POST", "/api_key", {"name": "m2m"})
+            basic2 = "Basic " + base64.b64encode(
+                f"{rec2['api_key']}:{rec2['api_secret']}".encode()
+            ).decode()
+            st, _ = await asyncio.to_thread(
+                call, "POST", "/api_key", {"name": "evil"}, basic2)
+            assert st == 403
+            st, _ = await asyncio.to_thread(
+                call, "GET", "/api_key", None, basic2)
+            assert st == 403
+            st, _ = await asyncio.to_thread(
+                call, "DELETE", "/api_key/m2m", None, basic2)
+            assert st == 403
+            # ...but normal routes still work for it
+            st, _ = await asyncio.to_thread(call, "GET", "/metrics",
+                                            None, basic2)
+            assert st == 200
+
+            # non-numeric expiry is a 400, not a latent auth 500
+            st, _ = await asyncio.to_thread(
+                call, "POST", "/api_key",
+                {"name": "bad", "expired_at": "2027-01-01"})
+            assert st == 400
+            st, _ = await asyncio.to_thread(
+                call, "PUT", "/api_key/m2m",
+                {"expired_at": "soon"})
+            assert st == 400
+
+            # listener stop/start over REST
+            from emqx_tpu.broker.client import MqttClient
+
+            mport = node.listeners[0].port
+            lid = f"tcp:{mport}"
+            st, body = await asyncio.to_thread(
+                call, "POST", f"/listeners/{lid}/stop")
+            assert st == 200 and body["running"] is False
+            with pytest.raises(OSError):
+                c = MqttClient("x1")
+                await c.connect("127.0.0.1", mport)
+            st, body = await asyncio.to_thread(
+                call, "POST", f"/listeners/{lid}/restart")
+            assert st == 200 and body["running"] is True
+            c = MqttClient("x2")
+            await c.connect("127.0.0.1", mport)
+            await c.disconnect()
+            st, _ = await asyncio.to_thread(
+                call, "POST", f"/listeners/{lid}/zap")
+            assert st == 400
+            st, _ = await asyncio.to_thread(
+                call, "POST", "/listeners/tcp:1/stop")
+            assert st == 404
+        finally:
+            await node.stop()
+
+    run(main())
