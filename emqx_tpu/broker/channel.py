@@ -81,6 +81,9 @@ class Channel:
         self.keepalive = 0
         self.clean_start = True
         self.expiry_interval = 0
+        self.client_receive_max = 65535  # CONNECT Receive Maximum
+        self.client_max_packet: Optional[int] = None
+        self.client_alias_max = 0  # CONNECT Topic Alias Maximum
         self.will_msg: Optional[Message] = None
         self.will_delay = 0
         self.authz_cache = self.access.make_cache()
@@ -191,6 +194,25 @@ class Channel:
                     p.properties.get(Property.SESSION_EXPIRY_INTERVAL, 0),
                     self.cfg.max_session_expiry,
                 )
+            )
+            # MQTT-3.3.4-9: never exceed the client's Receive Maximum
+            # of concurrent unacked QoS1/2 deliveries
+            rm = p.properties.get(Property.RECEIVE_MAXIMUM)
+            if rm is not None:
+                if not isinstance(rm, int) or rm < 1:
+                    return self._connack_fail(ReasonCode.PROTOCOL_ERROR)
+                self.client_receive_max = rm
+            # MQTT-3.1.2-24/25: never send a packet larger than the
+            # client's Maximum Packet Size (0 is a protocol error)
+            mp = p.properties.get(Property.MAXIMUM_PACKET_SIZE)
+            if mp is not None:
+                if not isinstance(mp, int) or mp < 1:
+                    return self._connack_fail(ReasonCode.PROTOCOL_ERROR)
+                self.client_max_packet = mp
+            # the client's advertised inbound topic-alias window: the
+            # server may substitute aliases for long topics outbound
+            self.client_alias_max = int(
+                p.properties.get(Property.TOPIC_ALIAS_MAXIMUM, 0) or 0
             )
         else:
             self.expiry_interval = 0 if p.clean_start else self.cfg.max_session_expiry
@@ -322,6 +344,12 @@ class Channel:
         session, present = self.broker.cm.open_session(
             p.clean_start, clientid, self._make_session
         )
+        if present:
+            # MQTT-3.3.4-9 applies per CONNECTION: a resumed session
+            # must honor THIS connection's Receive Maximum, not the
+            # previous one's
+            session.inflight.max_size = min(self.cfg.max_inflight,
+                                            self.client_receive_max)
         self.session = session
         self._m("session.resumed" if present else "session.created")
         self.state = CONNECTED
@@ -381,7 +409,8 @@ class Channel:
             clientid=self.clientid,
             clean_start=self.clean_start,
             expiry_interval=self.expiry_interval,
-            max_inflight=self.cfg.max_inflight,
+            max_inflight=min(self.cfg.max_inflight,
+                             self.client_receive_max),
             max_mqueue=self.cfg.max_mqueue,
             upgrade_qos=self.cfg.upgrade_qos,
             retry_interval=self.cfg.retry_interval,
@@ -722,22 +751,71 @@ class Channel:
         if self.v5 and d.sub_ids:
             props[Property.SUBSCRIPTION_IDENTIFIER] = list(d.sub_ids)
         topic = topiclib.strip_mountpoint(self.cfg.mountpoint, msg.topic)
+        # outbound topic aliasing within the client's window
+        # (MQTT-3.3.2-8): decide now, COMMIT only after the size check
+        # passes — a dropped establishing publish must not leave an
+        # alias the client never learned
+        new_alias_topic = None
+        if self.v5 and self.client_alias_max and not d.dup:
+            alias = self.alias_out.get(topic)
+            if alias is not None:
+                props[Property.TOPIC_ALIAS] = alias
+                topic = ""
+            elif len(self.alias_out) < self.client_alias_max:
+                alias = len(self.alias_out) + 1
+                new_alias_topic = topic
+                props[Property.TOPIC_ALIAS] = alias
+        out = pkt.Publish(
+            topic=topic,
+            payload=msg.payload,
+            qos=d.qos,
+            retain=d.retain,
+            dup=d.dup,
+            packet_id=d.packet_id,
+            properties=props,
+        )
+        if self.client_max_packet is not None and \
+                not self._fits_client_packet(out):
+            # MQTT-3.1.2-25: drop, don't send; free the QoS window
+            # slot so the flow doesn't wedge
+            self._m("delivery.dropped.too_large")
+            acts: List[Action] = []
+            if d.qos > 0 and d.packet_id is not None:
+                self.session.inflight.delete(d.packet_id)
+                acts = self._deliveries_out(self.session.dequeue())
+            return acts
+        if new_alias_topic is not None:
+            self.alias_out[new_alias_topic] = \
+                props[Property.TOPIC_ALIAS]
         self._m("packets.publish.sent")
         self._m("messages.sent")
-        return [
-            (
-                "send",
-                pkt.Publish(
-                    topic=topic,
-                    payload=msg.payload,
-                    qos=d.qos,
-                    retain=d.retain,
-                    dup=d.dup,
-                    packet_id=d.packet_id,
-                    properties=props,
-                ),
-            )
-        ]
+        return [("send", out)]
+
+    @staticmethod
+    def _prop_bound(v) -> int:
+        """Upper bound on one property value's serialized size."""
+        if isinstance(v, (bytes, bytearray)):
+            return len(v) + 8
+        if isinstance(v, str):
+            return 4 * len(v) + 8  # worst-case utf-8 expansion
+        if isinstance(v, (list, tuple)):
+            return sum(Channel._prop_bound(x) for x in v) + 8
+        return 16  # ints / varints
+
+    def _fits_client_packet(self, out: "pkt.Publish") -> bool:
+        """Size gate against the client's Maximum Packet Size.  Fast
+        path: an UPPER-bound estimate skips the exact serialize when
+        the packet is clearly small enough; only near-limit packets
+        pay the measuring serialization."""
+        rough = len(out.payload) + 4 * len(out.topic) + 16
+        for v in out.properties.values():
+            rough += self._prop_bound(v)
+        if rough <= self.client_max_packet:
+            return True
+        from . import frame as framelib
+
+        return len(framelib.serialize(out, self.proto_ver)) <= \
+            self.client_max_packet
 
     # ------------------------------------------------------------- timers
 

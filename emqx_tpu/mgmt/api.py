@@ -548,10 +548,20 @@ class ManagementApi:
 
     # ------------------------------------------------------------ listeners
 
+    @staticmethod
+    def _listener_id(l) -> str:
+        """One id scheme for listing AND addressing (type:port, the
+        reference's listener id shape)."""
+        is_ws = type(l).__name__.startswith("Ws")
+        is_tls = getattr(l, "tls", None) is not None
+        kind = ("wss" if is_ws and is_tls else "ws" if is_ws
+                else "ssl" if is_tls else "tcp")
+        return f"{kind}:{getattr(l, 'port', '?')}"
+
     def listeners_get(self, req: Request):
         return [
             {
-                "id": f"tcp:{getattr(l, 'port', '?')}",
+                "id": self._listener_id(l),
                 "type": type(l).__name__,
                 "bind": f"{getattr(l, 'host', '?')}:{getattr(l, 'port', '?')}",
                 "running": getattr(l, "_server", None) is not None,
@@ -676,8 +686,8 @@ class ManagementApi:
     def api_key_create(self, req: Request):
         self._dashboard_only(req)
         body = req.json() or {}
-        if not body.get("name"):
-            raise HttpError(400, "name required")
+        if not body.get("name") or not isinstance(body["name"], str):
+            raise HttpError(400, "name required (string)")
         try:
             return 201, self._need("api_keys").create(
                 body["name"],
@@ -727,7 +737,7 @@ class ManagementApi:
             raise HttpError(400, f"unknown action {action!r}")
         target = None
         for l in self.listeners:
-            if f"tcp:{getattr(l, 'port', '?')}" == lid:
+            if self._listener_id(l) == lid:
                 target = l
                 break
         if target is None:
@@ -739,7 +749,7 @@ class ManagementApi:
                 getattr(target, "_server", None) is None:
             await target.start()
         return {
-            "id": f"tcp:{target.port}",
+            "id": self._listener_id(target),
             "running": getattr(target, "_server", None) is not None,
         }
 

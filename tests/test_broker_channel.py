@@ -438,3 +438,143 @@ def test_metrics_counting(h):
     assert m.get("client.connected") >= 1
     assert m.get("packets.publish.received") >= 1
     assert m.get("messages.dropped.no_subscribers") >= 1
+
+
+def test_client_receive_maximum_caps_inflight(h):
+    """MQTT-3.3.4-9: the server must not exceed the client's CONNECT
+    Receive Maximum of concurrent unacked QoS1 deliveries; the rest
+    queue and flow as acks arrive."""
+    sub = h.connect("rm-sub", props={Property.RECEIVE_MAXIMUM: 2})
+    p = h.connect("rm-pub")
+    sub.handle_in(pkt.Subscribe(packet_id=1,
+                                topic_filters=[("rm/#", SubOpts(qos=1))]))
+    h.clear(sub)
+    for i in range(5):
+        p.handle_in(pkt.Publish(topic="rm/x", payload=b"%d" % i, qos=1,
+                                packet_id=10 + i))
+    pubs = h.sent(sub, PacketType.PUBLISH)
+    assert len(pubs) == 2  # window filled, 3 queued
+    h.clear(sub)
+    sub.handle_in(pkt.PubAck(packet_id=pubs[0].packet_id))
+    more = h.sent(sub, PacketType.PUBLISH)
+    assert len(more) == 1  # one slot freed -> one queued delivery
+    assert more[0].payload == b"2"
+
+
+def test_receive_maximum_zero_is_protocol_error(h):
+    ch = h.connect("rm-bad", props={Property.RECEIVE_MAXIMUM: 0})
+    acks = h.sent(ch, PacketType.CONNACK)
+    assert acks and acks[0].reason_code == ReasonCode.PROTOCOL_ERROR
+
+
+def test_outbound_topic_alias_within_client_window(h):
+    """A client advertising Topic Alias Maximum gets the full topic
+    once, then empty-topic publishes carrying the alias."""
+    sub = h.connect("ta-sub", props={Property.TOPIC_ALIAS_MAXIMUM: 4})
+    p = h.connect("ta-pub")
+    sub.handle_in(pkt.Subscribe(packet_id=1,
+                                topic_filters=[("ta/#", SubOpts(qos=0))]))
+    h.clear(sub)
+    for _ in range(3):
+        p.handle_in(pkt.Publish(topic="ta/very/long/topic",
+                                payload=b"x", qos=0))
+    pubs = h.sent(sub, PacketType.PUBLISH)
+    assert len(pubs) == 3
+    first, second, third = pubs
+    assert first.topic == "ta/very/long/topic"
+    assert first.properties[Property.TOPIC_ALIAS] == 1
+    assert second.topic == "" and third.topic == ""
+    assert second.properties[Property.TOPIC_ALIAS] == 1
+    # a client that advertised NO alias window never sees aliases
+    plain = h.connect("ta-plain")
+    plain.handle_in(pkt.Subscribe(packet_id=1,
+                                  topic_filters=[("ta/#", SubOpts(qos=0))]))
+    h.clear(plain)
+    p.handle_in(pkt.Publish(topic="ta/very/long/topic", payload=b"y",
+                            qos=0))
+    (pub,) = h.sent(plain, PacketType.PUBLISH)
+    assert pub.topic == "ta/very/long/topic"
+    assert Property.TOPIC_ALIAS not in pub.properties
+
+
+def test_outbound_alias_window_bounded(h):
+    sub = h.connect("ta2", props={Property.TOPIC_ALIAS_MAXIMUM: 1})
+    p = h.connect("ta2-pub")
+    sub.handle_in(pkt.Subscribe(packet_id=1,
+                                topic_filters=[("w/#", SubOpts(qos=0))]))
+    h.clear(sub)
+    p.handle_in(pkt.Publish(topic="w/a", payload=b"1", qos=0))
+    p.handle_in(pkt.Publish(topic="w/b", payload=b"2", qos=0))
+    a, b = h.sent(sub, PacketType.PUBLISH)
+    assert a.properties.get(Property.TOPIC_ALIAS) == 1
+    # window exhausted: second topic goes un-aliased with full name
+    assert b.topic == "w/b"
+    assert Property.TOPIC_ALIAS not in b.properties
+
+
+def test_client_maximum_packet_size_enforced(h):
+    """Outbound packets larger than the client's Maximum Packet Size
+    are dropped (MQTT-3.1.2-25), and a dropped QoS1 delivery frees its
+    window slot instead of wedging the flow."""
+    sub = h.connect("mp-sub", props={Property.MAXIMUM_PACKET_SIZE: 128,
+                                     Property.RECEIVE_MAXIMUM: 1})
+    p = h.connect("mp-pub")
+    sub.handle_in(pkt.Subscribe(packet_id=1,
+                                topic_filters=[("mp/#", SubOpts(qos=1))]))
+    h.clear(sub)
+    p.handle_in(pkt.Publish(topic="mp/big", payload=b"z" * 500, qos=1,
+                            packet_id=20))
+    p.handle_in(pkt.Publish(topic="mp/ok", payload=b"small", qos=1,
+                            packet_id=21))
+    pubs = h.sent(sub, PacketType.PUBLISH)
+    # the oversized delivery vanished; the small one flowed through
+    # the freed window slot
+    assert [x.payload for x in pubs] == [b"small"]
+    assert sub.broker.metrics.get("delivery.dropped.too_large") == 1
+
+
+def test_maximum_packet_size_zero_is_protocol_error(h):
+    ch = h.connect("mp-bad", props={Property.MAXIMUM_PACKET_SIZE: 0})
+    acks = h.sent(ch, PacketType.CONNACK)
+    assert acks and acks[0].reason_code == ReasonCode.PROTOCOL_ERROR
+
+
+def test_dropped_establishing_publish_leaves_no_alias(h):
+    """If the alias-establishing publish is dropped for size, the
+    mapping must not be committed — the next delivery resends the full
+    topic (round-3 review finding)."""
+    sub = h.connect("al-drop", props={Property.MAXIMUM_PACKET_SIZE: 64,
+                                      Property.TOPIC_ALIAS_MAXIMUM: 4})
+    p = h.connect("al-pub")
+    sub.handle_in(pkt.Subscribe(packet_id=1,
+                                topic_filters=[("al/#", SubOpts(qos=0))]))
+    h.clear(sub)
+    p.handle_in(pkt.Publish(topic="al/t", payload=b"z" * 200, qos=0))
+    assert h.sent(sub, PacketType.PUBLISH) == []  # dropped
+    assert sub.alias_out == {}  # no phantom alias
+    p.handle_in(pkt.Publish(topic="al/t", payload=b"ok", qos=0))
+    (pub,) = h.sent(sub, PacketType.PUBLISH)
+    assert pub.topic == "al/t"  # full topic, alias established NOW
+    assert pub.properties.get(Property.TOPIC_ALIAS) == 1
+
+
+def test_receive_maximum_applies_on_resume(h):
+    """A resumed session must honor the NEW connection's Receive
+    Maximum, not the previous one's (round-3 review finding)."""
+    s1 = h.connect("rm-resume", clean_start=False,
+                   props={Property.RECEIVE_MAXIMUM: 50,
+                          Property.SESSION_EXPIRY_INTERVAL: 300})
+    s1.handle_in(pkt.Subscribe(packet_id=1,
+                               topic_filters=[("rr/#", SubOpts(qos=1))]))
+    s1.handle_in(pkt.Disconnect())
+    s2 = h.connect("rm-resume", clean_start=False,
+                   props={Property.RECEIVE_MAXIMUM: 1,
+                          Property.SESSION_EXPIRY_INTERVAL: 300})
+    acks = h.sent(s2, PacketType.CONNACK)
+    assert acks[0].session_present
+    h.clear(s2)
+    p = h.connect("rr-pub")
+    for i in range(4):
+        p.handle_in(pkt.Publish(topic="rr/x", payload=b"%d" % i, qos=1,
+                                packet_id=30 + i))
+    assert len(h.sent(s2, PacketType.PUBLISH)) == 1  # new window of 1
