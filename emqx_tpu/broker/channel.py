@@ -407,6 +407,7 @@ class Channel:
     def _make_session(self) -> Session:
         return Session(
             clientid=self.clientid,
+            username=getattr(self.clientinfo, "username", None),
             clean_start=self.clean_start,
             expiry_interval=self.expiry_interval,
             max_inflight=min(self.cfg.max_inflight,
@@ -737,12 +738,19 @@ class Channel:
             self.out_cb(acts)
 
     def _deliveries_out(self, ds) -> List[Action]:
+        """Iterative drain: a dropped too-large delivery frees its
+        window slot and APPENDS the refill to this queue instead of
+        recursing (a long run of queued oversized messages would
+        otherwise blow the recursion limit)."""
+        from collections import deque as _deque
+
         acts: List[Action] = []
-        for d in ds:
-            acts.extend(self._delivery_to_send(d))
+        queue = _deque(ds)
+        while queue:
+            acts.extend(self._delivery_to_send(queue.popleft(), queue))
         return acts
 
-    def _delivery_to_send(self, d) -> List[Action]:
+    def _delivery_to_send(self, d, _followups=None) -> List[Action]:
         if d.message is None:  # pubrel resend
             self._m("packets.pubrel.sent")
             return [("send", pkt.PubRel(packet_id=d.packet_id))]
@@ -779,11 +787,14 @@ class Channel:
             # MQTT-3.1.2-25: drop, don't send; free the QoS window
             # slot so the flow doesn't wedge
             self._m("delivery.dropped.too_large")
-            acts: List[Action] = []
             if d.qos > 0 and d.packet_id is not None:
                 self.session.inflight.delete(d.packet_id)
-                acts = self._deliveries_out(self.session.dequeue())
-            return acts
+                refill = self.session.dequeue()
+                if _followups is not None:
+                    _followups.extend(refill)
+                    return []
+                return self._deliveries_out(refill)
+            return []
         if new_alias_topic is not None:
             self.alias_out[new_alias_topic] = \
                 props[Property.TOPIC_ALIAS]

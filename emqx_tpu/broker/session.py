@@ -52,8 +52,10 @@ class Session:
         max_awaiting_rel: int = 100,
         await_rel_timeout: float = 300.0,
         created_at: Optional[float] = None,
+        username: Optional[str] = None,
     ):
         self.clientid = clientid
+        self.username = username  # last connection's; offline queries
         self.clean_start = clean_start
         self.expiry_interval = expiry_interval
         self.upgrade_qos = upgrade_qos
@@ -247,6 +249,7 @@ class Session:
     def info(self) -> Dict:
         return {
             "clientid": self.clientid,
+            "username": self.username,
             "clean_start": self.clean_start,
             "subscriptions_cnt": len(self.subscriptions),
             "inflight_cnt": len(self.inflight),

@@ -375,21 +375,42 @@ class ManagementApi:
         return out
 
     def clients(self, req: Request):
+        """Query params mirror `emqx_mgmt_api_clients`: like_clientid
+        (fuzzy), username, ip_address, proto_ver, conn_state."""
         like = req.q("like_clientid")
         username = req.q("username")
+        ip = req.q("ip_address")
+        proto = req.q("proto_ver")
+        state = req.q("conn_state")  # connected | disconnected
         rows = []
-        for cid, ch in self.broker.cm.channels.items():
-            if like and like not in cid:
-                continue
-            if username and getattr(getattr(ch, "clientinfo", None), "username", None) != username:
-                continue
-            rows.append(self._client_info(ch))
-        for cid, (session, _exp) in self.broker.cm.pending.items():
-            if like and like not in cid:
-                continue
-            row = {"clientid": cid, "node": self.node, "connected": False}
-            row.update(session.info())
-            rows.append(row)
+        if state != "disconnected":
+            for cid, ch in self.broker.cm.channels.items():
+                if like and like not in cid:
+                    continue
+                ci = getattr(ch, "clientinfo", None)
+                if username and getattr(ci, "username", None) != username:
+                    continue
+                if ip and str(getattr(ci, "peerhost", "") or ""
+                              ).split(":")[0] != ip:
+                    continue
+                if proto and str(getattr(ci, "proto_ver", "")) != proto:
+                    continue
+                rows.append(self._client_info(ch))
+        if state != "connected":
+            for cid, (session, _exp) in self.broker.cm.pending.items():
+                if like and like not in cid:
+                    continue
+                if username and getattr(session, "username",
+                                        None) != username:
+                    continue
+                if ip or proto:
+                    # connection-scoped attributes don't exist for an
+                    # offline session: these filters exclude them
+                    continue
+                row = {"clientid": cid, "node": self.node,
+                       "connected": False}
+                row.update(session.info())
+                rows.append(row)
         return paginate(rows, req)
 
     def _find_client(self, clientid: str):
@@ -425,6 +446,32 @@ class ManagementApi:
         ]
 
     def subscriptions(self, req: Request):
+        """Query params mirror `emqx_mgmt_api_subscriptions`: clientid,
+        topic (exact filter), qos, share (group name), match_topic
+        (filters that would match a given topic name)."""
+        from ..broker import topic as topiclib
+
+        want_cid = req.q("clientid")
+        want_topic = req.q("topic")
+        want_qos = req.q("qos")
+        want_share = req.q("share")
+        match_topic = req.q("match_topic")
+
+        def keep(cid, f, o):
+            if want_cid and cid != want_cid:
+                return False
+            if want_topic and f != want_topic:
+                return False
+            if want_qos is not None and want_qos != "" and \
+                    str(o.qos) != want_qos:
+                return False
+            group, real = topiclib.parse_share(f)
+            if want_share and group != want_share:
+                return False
+            if match_topic and not topiclib.match(match_topic, real):
+                return False
+            return True
+
         rows = []
         seen = set()
         for cid, ch in self.broker.cm.channels.items():
@@ -433,12 +480,14 @@ class ManagementApi:
                 continue
             seen.add(cid)
             for f, o in s.subscriptions.items():
-                rows.append({"clientid": cid, "topic": f, "qos": o.qos,
-                             "node": self.node})
+                if keep(cid, f, o):
+                    rows.append({"clientid": cid, "topic": f,
+                                 "qos": o.qos, "node": self.node})
         for cid, (s, _exp) in self.broker.cm.pending.items():
             for f, o in s.subscriptions.items():
-                rows.append({"clientid": cid, "topic": f, "qos": o.qos,
-                             "node": self.node})
+                if keep(cid, f, o):
+                    rows.append({"clientid": cid, "topic": f,
+                                 "qos": o.qos, "node": self.node})
         return paginate(rows, req)
 
     # --------------------------------------------------------------- routes

@@ -52,7 +52,7 @@ class Cli:
                      "publish", "ban", "listeners", "metrics", "stats",
                      "trace", "cluster", "plugins", "telemetry", "node_dump",
                      "vm", "log", "olp", "authz", "bridges", "rules",
-                     "gateways"):
+                     "gateways", "retainer", "delayed", "api_key"):
             self.register(name, getattr(self, "cmd_" + name),
                           getattr(getattr(self, "cmd_" + name), "__doc__", ""))
 
@@ -228,7 +228,14 @@ class Cli:
             return 1
 
     def cmd_listeners(self, args):
-        """List listeners."""
+        """listeners [start|stop|restart <id>] — list or manage."""
+        if args and args[0] in ("start", "stop", "restart"):
+            if len(args) < 2:
+                self.p("usage: listeners start|stop|restart <id>")
+                return 1
+            out = self._post(f"/listeners/{args[1]}/{args[0]}")
+            self.p(f"{out['id']} running={out['running']}")
+            return
         for row in self._get("/listeners"):
             self.p(f"{row['id']} {row['bind']} running={row['running']} "
                    f"conns={row['current_connections']}")
@@ -363,6 +370,80 @@ class Cli:
         for g in self._get("/gateways")["data"]:
             self.p(f"{g['name']:<12} {g['type']} :{g['port']} "
                    f"clients={g['clients']}")
+
+    def cmd_retainer(self, args):
+        """retainer info | topics | clean [topic] (emqx_retainer_cli)"""
+        sub = args[0] if args else "info"
+        if sub == "info":
+            for k, v in self._get("/mqtt/retainer").items():
+                self.p(f"{k:<22} {v}")
+        elif sub == "topics":
+            for row in self._get("/mqtt/retainer/messages")["data"]:
+                self.p(f"{row['topic']} qos{row['qos']} "
+                       f"{row['payload_size']}B")
+        elif sub == "clean":
+            if len(args) > 1:
+                from urllib.parse import quote
+
+                self._delete(f"/mqtt/retainer/message/"
+                             f"{quote(args[1], safe='')}")
+                self.p(f"cleaned {args[1]}")
+            else:
+                n = 0
+                for row in self._get(
+                    "/mqtt/retainer/messages?limit=10000"
+                )["data"]:
+                    from urllib.parse import quote
+
+                    self._delete(f"/mqtt/retainer/message/"
+                                 f"{quote(row['topic'], safe='')}")
+                    n += 1
+                self.p(f"cleaned {n} retained messages")
+        else:
+            return 1
+
+    def cmd_delayed(self, args):
+        """delayed info | list | cancel <msgid>"""
+        sub = args[0] if args else "info"
+        if sub == "info":
+            for k, v in self._get("/mqtt/delayed").items():
+                self.p(f"{k:<22} {v}")
+        elif sub == "list":
+            for row in self._get("/mqtt/delayed/messages")["data"]:
+                self.p(f"{row['msgid']} {row['topic']} "
+                       f"in {row['delayed_remaining']}s")
+        elif sub == "cancel":
+            if len(args) < 2:
+                self.p("usage: delayed cancel <msgid>")
+                return 1
+            self._delete(f"/mqtt/delayed/messages/{args[1]}")
+            self.p(f"canceled {args[1]}")
+        else:
+            return 1
+
+    def cmd_api_key(self, args):
+        """api_key list | create <name> | enable|disable|delete <name>"""
+        sub = args[0] if args else "list"
+        if sub != "list" and len(args) < 2:
+            self.p(self.usage["api_key"])
+            return 1
+        if sub == "list":
+            for k in self._get("/api_key"):
+                self.p(f"{k['name']:<16} key={k['api_key']} "
+                       f"enabled={k['enable']}")
+        elif sub == "create":
+            rec = self._post("/api_key", {"name": args[1]})
+            self.p(f"api_key: {rec['api_key']}")
+            self.p(f"api_secret: {rec['api_secret']} (shown once)")
+        elif sub in ("enable", "disable"):
+            self._put(f"/api_key/{args[1]}",
+                      {"enable": sub == "enable"})
+            self.p(f"{args[1]} {sub}d")
+        elif sub == "delete":
+            self._delete(f"/api_key/{args[1]}")
+            self.p(f"deleted {args[1]}")
+        else:
+            return 1
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -578,3 +578,33 @@ def test_receive_maximum_applies_on_resume(h):
         p.handle_in(pkt.Publish(topic="rr/x", payload=b"%d" % i, qos=1,
                                 packet_id=30 + i))
     assert len(h.sent(s2, PacketType.PUBLISH)) == 1  # new window of 1
+
+
+def test_many_queued_oversized_drops_iteratively(h):
+    """Draining a long run of queued too-large messages must not
+    recurse per drop (round-3 review finding: RecursionError at
+    ~500 queued oversized messages)."""
+    sub = h.connect("big-run", props={Property.MAXIMUM_PACKET_SIZE: 64,
+                                      Property.RECEIVE_MAXIMUM: 1})
+    p = h.connect("big-pub")
+    sub.handle_in(pkt.Subscribe(packet_id=1,
+                                topic_filters=[("br/#", SubOpts(qos=1))]))
+    h.clear(sub)
+    # one small delivery occupies the window...
+    p.handle_in(pkt.Publish(topic="br/x", payload=b"first", qos=1,
+                            packet_id=2))
+    # ...then 600 oversized + one final small message queue up
+    for i in range(600):
+        p.handle_in(pkt.Publish(topic="br/x", payload=b"z" * 200,
+                                qos=1, packet_id=3))
+    p.handle_in(pkt.Publish(topic="br/x", payload=b"last", qos=1,
+                            packet_id=4))
+    pubs = h.sent(sub, PacketType.PUBLISH)
+    assert [x.payload for x in pubs] == [b"first"]
+    h.clear(sub)
+    # the ack triggers the drain: 600 drops then the small delivery,
+    # all iterative
+    sub.handle_in(pkt.PubAck(packet_id=pubs[0].packet_id))
+    more = h.sent(sub, PacketType.PUBLISH)
+    assert [x.payload for x in more] == [b"last"]
+    assert sub.broker.metrics.get("delivery.dropped.too_large") == 600

@@ -461,3 +461,85 @@ def test_authn_authz_rest_validation(run):
         await srv.stop()
 
     run(main())
+
+
+def test_clients_query_filters(run, tmp_path):
+    """emqx_mgmt_api_clients query params: conn_state, username,
+    ip_address, proto_ver, like_clientid."""
+
+    async def main():
+        b, lst, api, srv, tokens = await make_stack(tmp_path)
+        base = f"http://127.0.0.1:{srv.port}/api/v5"
+        _, body = await asyncio.to_thread(
+            http, "POST", base + "/login",
+            {"username": "admin", "password": "public123"})
+        tok = body["token"]
+
+        async def get(path):
+            st, body = await asyncio.to_thread(http, "GET", base + path,
+                                               None, tok)
+            assert st == 200, (st, body)
+            return body
+
+        a = MqttClient("qf-alpha", username="amy")
+        c2 = MqttClient("qf-beta", username="bob")
+        await a.connect("127.0.0.1", lst.port)
+        await c2.connect("127.0.0.1", lst.port)
+        rows = (await get("/clients?username=amy"))["data"]
+        assert [r["clientid"] for r in rows] == ["qf-alpha"]
+        rows = (await get("/clients?like_clientid=beta"))["data"]
+        assert [r["clientid"] for r in rows] == ["qf-beta"]
+        rows = (await get("/clients?proto_ver=5"))["data"]
+        assert {r["clientid"] for r in rows} == {"qf-alpha", "qf-beta"}
+        rows = (await get("/clients?ip_address=127.0.0.1"))["data"]
+        assert len(rows) == 2
+        rows = (await get("/clients?conn_state=disconnected"))["data"]
+        assert rows == []
+        await a.disconnect()
+        await c2.disconnect()
+        await lst.stop()
+        await srv.stop()
+
+    run(main())
+
+
+def test_subscriptions_query_filters(run, tmp_path):
+    async def main():
+        b, lst, api, srv, tokens = await make_stack(tmp_path)
+        base = f"http://127.0.0.1:{srv.port}/api/v5"
+        _, body = await asyncio.to_thread(
+            http, "POST", base + "/login",
+            {"username": "admin", "password": "public123"})
+        tok = body["token"]
+
+        async def get(path):
+            st, body = await asyncio.to_thread(http, "GET", base + path,
+                                               None, tok)
+            assert st == 200, (st, body)
+            return body
+
+        a = MqttClient("sf-a")
+        c2 = MqttClient("sf-b")
+        await a.connect("127.0.0.1", lst.port)
+        await c2.connect("127.0.0.1", lst.port)
+        await a.subscribe("tele/+/up", qos=1)
+        await a.subscribe("$share/g1/cmd/#", qos=0)
+        await c2.subscribe("tele/1/up", qos=2)
+
+        rows = (await get("/subscriptions?clientid=sf-a"))["data"]
+        assert {r["topic"] for r in rows} == {"tele/+/up",
+                                              "$share/g1/cmd/#"}
+        rows = (await get("/subscriptions?qos=2"))["data"]
+        assert [r["clientid"] for r in rows] == ["sf-b"]
+        rows = (await get("/subscriptions?share=g1"))["data"]
+        assert [r["topic"] for r in rows] == ["$share/g1/cmd/#"]
+        rows = (await get("/subscriptions?match_topic=tele/9/up"))["data"]
+        assert {r["clientid"] for r in rows} == {"sf-a"}
+        rows = (await get("/subscriptions?topic=tele/1/up"))["data"]
+        assert [r["clientid"] for r in rows] == ["sf-b"]
+        await a.disconnect()
+        await c2.disconnect()
+        await lst.stop()
+        await srv.stop()
+
+    run(main())

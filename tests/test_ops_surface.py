@@ -138,5 +138,19 @@ def test_cli_new_commands(tmp_path):
     assert cli.run(["gateways"]) == 0  # unwraps the "data" envelope
     assert "stomp" in out.getvalue()
     out.truncate(0)
+    assert cli.run(["retainer", "info"]) == 0
+    assert "count" in out.getvalue()
+    out.truncate(0)
+    assert cli.run(["delayed", "info"]) == 0
+    assert "pending" in out.getvalue()
+    out.truncate(0)
+    assert cli.run(["api_key", "create", "cli-key"]) == 0
+    assert "shown once" in out.getvalue()
+    out.truncate(0)
+    assert cli.run(["api_key", "list"]) == 0
+    assert "cli-key" in out.getvalue()
+    assert "api_secret" not in out.getvalue()
+    out.truncate(0)
+    assert cli.run(["api_key", "delete", "cli-key"]) == 0
     assert cli.run(["bridges", "list"]) == 1  # no manager: 404 error path
     logging.getLogger("emqx_tpu").setLevel(logging.WARNING)
