@@ -48,7 +48,9 @@ class Banned:
 
     def check(self, ci: ClientInfo) -> bool:
         """True if the client is banned."""
-        host = ci.peerhost.split(":")[0]
+        from ..utils.net import peer_host
+
+        host = peer_host(ci.peerhost)
         return any(
             self.look_up(k, v) is not None
             for k, v in (

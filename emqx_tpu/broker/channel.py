@@ -13,6 +13,7 @@ from __future__ import annotations
 import itertools
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, replace
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -350,6 +351,10 @@ class Channel:
             # previous one's
             session.inflight.max_size = min(self.cfg.max_inflight,
                                             self.client_receive_max)
+            # and carries the LATEST connection's username for
+            # offline-session queries
+            session.username = getattr(self.clientinfo, "username",
+                                       None)
         self.session = session
         self._m("session.resumed" if present else "session.created")
         self.state = CONNECTED
@@ -742,10 +747,8 @@ class Channel:
         window slot and APPENDS the refill to this queue instead of
         recursing (a long run of queued oversized messages would
         otherwise blow the recursion limit)."""
-        from collections import deque as _deque
-
         acts: List[Action] = []
-        queue = _deque(ds)
+        queue = deque(ds)
         while queue:
             acts.extend(self._delivery_to_send(queue.popleft(), queue))
         return acts

@@ -40,6 +40,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional
 
+from .utils.net import peer_host as _peer_host
+
 DB_KINDS = ("mysql", "pgsql", "mongodb", "redis", "ldap")
 
 _registry: Dict[str, Callable[..., Any]] = {}
@@ -130,7 +132,7 @@ def render_vars(clientinfo, extra: Optional[Dict[str, str]] = None
     out = {
         "username": clientinfo.username or "",
         "clientid": clientinfo.clientid or "",
-        "peerhost": (clientinfo.peerhost or "").split(":")[0],
+        "peerhost": _peer_host(clientinfo.peerhost),
     }
     if extra:
         out.update(extra)

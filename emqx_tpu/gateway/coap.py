@@ -245,8 +245,20 @@ class CoapGateway(asyncio.DatagramProtocol):
                 self.ctx.close_session(client)
         self.clients.clear()
         if self.transport is not None:
+            # close() only SCHEDULES the unbind: wait so an immediate
+            # restart can rebind the same port (no EADDRINUSE race)
+            self._closed_evt = asyncio.Event()
             self.transport.close()
+            try:
+                await asyncio.wait_for(self._closed_evt.wait(), 2.0)
+            except asyncio.TimeoutError:
+                pass
             self.transport = None
+
+    def connection_lost(self, exc) -> None:
+        evt = getattr(self, "_closed_evt", None)
+        if evt is not None:
+            evt.set()
 
     async def _sweep_loop(self) -> None:
         """Evict clients idle past the heartbeat window; without this,

@@ -230,8 +230,21 @@ class MqttSnGateway(asyncio.DatagramProtocol):
                 self.ctx.close_session(client)
         self.clients.clear()
         if self.transport is not None:
+            # close() only SCHEDULES the unbind: wait for
+            # connection_lost so an immediate restart can rebind the
+            # same port instead of racing EADDRINUSE
+            self._closed_evt = asyncio.Event()
             self.transport.close()
+            try:
+                await asyncio.wait_for(self._closed_evt.wait(), 2.0)
+            except asyncio.TimeoutError:
+                pass
             self.transport = None
+
+    def connection_lost(self, exc) -> None:
+        evt = getattr(self, "_closed_evt", None)
+        if evt is not None:
+            evt.set()
 
     async def _advertise_loop(self) -> None:
         """Periodic ADVERTISE (gwid + next interval), spec 6.1."""

@@ -14,9 +14,15 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..broker.broker import Broker
+from ..utils.net import peer_host
 from ..broker.message import Message
 from .http import HttpApi, HttpError, Request
 from .token import TokenStore
+
+
+# node version string, parity-shaped like the reference release
+# (`emqx_release.hrl`); one source for /status and /nodes/{name}
+VERSION = "5.0.0-tpu.1"
 
 
 def paginate(items: List[Any], req: Request) -> dict:
@@ -96,6 +102,11 @@ class ManagementApi:
         r("POST", "/logout", self.logout, doc="Revoke the presented token")
         r("GET", "/status", self.status, public=True, doc="Node liveness")
         r("GET", "/nodes", self.nodes, doc="Cluster node list")
+        r("GET", "/nodes/{name}", self.node_get, doc="One node's detail")
+        r("GET", "/nodes/{name}/metrics", self.node_metrics,
+          doc="One node's counters")
+        r("GET", "/nodes/{name}/stats", self.node_stats,
+          doc="One node's gauges")
         r("GET", "/clients", self.clients, doc="List connected clients")
         r("GET", "/clients/{clientid}", self.client_get, doc="One client")
         r("DELETE", "/clients/{clientid}", self.client_kick, doc="Kick a client")
@@ -187,6 +198,8 @@ class ManagementApi:
           doc="Remove a bridge")
         r("PUT", "/bridges/{name}/{action}", self.bridge_action,
           doc="enable|disable|restart a bridge")
+        r("PUT", "/gateways/{name}", self.gateway_update,
+          doc="Enable/disable a gateway (stops/starts its listener)")
         r("GET", "/gateways", self.gateways_list,
           doc="Gateway instances + listen addresses")
         r("GET", "/gateways/{name}/clients", self.gateway_clients,
@@ -334,7 +347,7 @@ class ManagementApi:
         return {
             "node": self.node,
             "status": "running",
-            "version": "5.0.0-tpu.1",
+            "version": VERSION,
             "uptime": int(time.time() - self.started_at),
         }
 
@@ -390,8 +403,9 @@ class ManagementApi:
                 ci = getattr(ch, "clientinfo", None)
                 if username and getattr(ci, "username", None) != username:
                     continue
-                if ip and str(getattr(ci, "peerhost", "") or ""
-                              ).split(":")[0] != ip:
+                if ip and peer_host(
+                    str(getattr(ci, "peerhost", "") or "")
+                ) != ip:
                     continue
                 if proto and str(getattr(ci, "proto_ver", "")) != proto:
                     continue
@@ -412,6 +426,36 @@ class ManagementApi:
                 row.update(session.info())
                 rows.append(row)
         return paginate(rows, req)
+
+    def _require_local_node(self, req: Request) -> None:
+        name = req.params["name"]
+        if name != self.node:
+            raise HttpError(
+                404, f"node {name!r} is not this node; query it directly"
+            )
+
+    def node_get(self, req: Request):
+        """GET /nodes/{name} (`emqx_mgmt_api_nodes` detail)."""
+        self._require_local_node(req)
+        return {
+            "node": self.node,
+            "node_status": "running",
+            "version": VERSION,  # same source as /status
+            "uptime": int(time.time() - self.started_at),
+            "connections": self.broker.cm.connection_count,
+            "subscriptions": self.broker.subscription_count,
+            "routes": self.broker.route_count,
+            "retained": self.broker.retainer.count,
+            "listeners": [self._listener_id(l) for l in self.listeners],
+        }
+
+    def node_metrics(self, req: Request):
+        self._require_local_node(req)
+        return self.broker.metrics.all()
+
+    def node_stats(self, req: Request):
+        self._require_local_node(req)
+        return self.stats_get(req)
 
     def _find_client(self, clientid: str):
         ch = self.broker.cm.lookup(clientid)
@@ -1028,6 +1072,36 @@ class ManagementApi:
         if not ok:
             raise HttpError(404, "no such bridge")
         return mgr.describe(name)
+
+    @staticmethod
+    def _gateway_running(gw) -> bool:
+        """Covers every gateway transport shape: UDP (mqttsn/coap/
+        lwm2m `transport`), TCP (stomp `_server`), dual-socket exproto
+        (`_device_srv`)."""
+        return any(
+            getattr(gw, attr, None) is not None
+            for attr in ("transport", "_server", "_device_srv")
+        )
+
+    async def gateway_update(self, req: Request):
+        """PUT /gateways/{name} {enable} — stop/start the gateway's
+        listener (`emqx_gateway_api` update analog)."""
+        reg = self._need("gateways")
+        gw = reg.lookup(req.params["name"])
+        if gw is None:
+            raise HttpError(404, "no such gateway")
+        body = req.json() or {}
+        if "enable" in body:
+            want = bool(body["enable"])
+            running = self._gateway_running(gw)
+            if want and not running and hasattr(gw, "start"):
+                await gw.start()
+            elif not want and running and hasattr(gw, "stop"):
+                await gw.stop()
+        return {
+            "name": req.params["name"],
+            "enable": self._gateway_running(gw),
+        }
 
     def gateways_list(self, req: Request):
         reg = self._need("gateways")

@@ -389,15 +389,19 @@ class Cli:
                              f"{quote(args[1], safe='')}")
                 self.p(f"cleaned {args[1]}")
             else:
-                n = 0
-                for row in self._get(
-                    "/mqtt/retainer/messages?limit=10000"
-                )["data"]:
-                    from urllib.parse import quote
+                from urllib.parse import quote
 
-                    self._delete(f"/mqtt/retainer/message/"
-                                 f"{quote(row['topic'], safe='')}")
-                    n += 1
+                n = 0
+                while True:  # loop until the store is empty, not one page
+                    rows = self._get(
+                        "/mqtt/retainer/messages?limit=10000"
+                    )["data"]
+                    if not rows:
+                        break
+                    for row in rows:
+                        self._delete(f"/mqtt/retainer/message/"
+                                     f"{quote(row['topic'], safe='')}")
+                        n += 1
                 self.p(f"cleaned {n} retained messages")
         else:
             return 1

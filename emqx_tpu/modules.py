@@ -19,6 +19,7 @@ from .broker.broker import Broker
 from .broker.hooks import Hooks
 from .broker.message import Message
 from .broker.packet import SubOpts
+from .utils.net import peer_host as _peer_host
 
 
 # ------------------------------------------------------------ delayed pub
@@ -428,7 +429,7 @@ class EventMessage:
         self._publish("client_connected", {
             "clientid": clientinfo.clientid,
             "username": clientinfo.username,
-            "ipaddress": (clientinfo.peerhost or "").split(":")[0],
+            "ipaddress": _peer_host(clientinfo.peerhost),
             "proto_ver": getattr(clientinfo, "proto_ver", None),
             "keepalive": getattr(clientinfo, "keepalive", 0),
             "connected_at": int(time.time() * 1000),

@@ -1,0 +1,13 @@
+"""Small shared network helpers."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def peer_host(peername: Optional[str]) -> str:
+    """Host part of a "host:port" peername, IPv6-safe: '::1:54321'
+    splits on the LAST colon, so the address survives intact."""
+    if not peername:
+        return ""
+    return peername.rsplit(":", 1)[0]

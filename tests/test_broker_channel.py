@@ -34,7 +34,7 @@ class Harness:
         self.broker = Broker(engine=make_engine(engine))
 
     def connect(self, clientid, ver=MQTT_V5, clean_start=True, will=None,
-                props=None, keepalive=60):
+                props=None, keepalive=60, username=None):
         ch = Channel(self.broker, peername="127.0.0.1:1")
         ch.outbox = []
         ch.out_cb = ch.outbox.extend
@@ -53,6 +53,7 @@ class Harness:
             clientid=clientid,
             clean_start=clean_start,
             keepalive=keepalive,
+            username=username,
             properties=props or {},
         )
         if will:
@@ -608,3 +609,16 @@ def test_many_queued_oversized_drops_iteratively(h):
     more = h.sent(sub, PacketType.PUBLISH)
     assert [x.payload for x in more] == [b"last"]
     assert sub.broker.metrics.get("delivery.dropped.too_large") == 600
+
+
+def test_resumed_session_updates_username(h):
+    """Offline-session queries report the LAST connection's username
+    (round-3 review finding)."""
+    s1 = h.connect("u-res", clean_start=False,
+                   props={Property.SESSION_EXPIRY_INTERVAL: 300},
+                   username="alice")
+    s1.handle_in(pkt.Disconnect())
+    s2 = h.connect("u-res", clean_start=False,
+                   props={Property.SESSION_EXPIRY_INTERVAL: 300},
+                   username="bob")
+    assert s2.session.username == "bob"
