@@ -43,7 +43,9 @@ class Rule:
         if kind == "username":
             return ci.username == val
         if kind == "ipaddr":
-            host = ci.peerhost.split(":")[0]
+            from .utils.net import peer_host
+
+            host = peer_host(ci.peerhost)
             return fnmatch.fnmatch(host, val)
         return False
 

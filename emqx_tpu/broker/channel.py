@@ -801,6 +801,17 @@ class Channel:
         if new_alias_topic is not None:
             self.alias_out[new_alias_topic] = \
                 props[Property.TOPIC_ALIAS]
+        if (
+            d.qos == 0
+            and not d.dup
+            and d.packet_id is None
+            and topic == msg.topic
+            and props == msg.properties
+        ):
+            # identical wire bytes for every plain-QoS0 receiver of
+            # this message: share one serialization across the fan-out
+            # (the connection layer keys it by proto_ver + retain)
+            out._wire_cache = msg.headers.setdefault("__wire_cache", {})
         self._m("packets.publish.sent")
         self._m("messages.sent")
         return [("send", out)]

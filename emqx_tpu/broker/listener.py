@@ -41,7 +41,9 @@ class Connection:
         limiter=None,
     ):
         peer = writer.get_extra_info("peername")
-        peername = f"{peer[0]}:{peer[1]}" if peer else "?"
+        from ..utils.net import format_peername
+
+        peername = format_peername(peer) if peer else "?"
         self.reader = reader
         self.writer = writer
         self.parser = Parser(max_size=max_packet_size)
@@ -67,7 +69,17 @@ class Connection:
             arg = action[1] if len(action) > 1 else None
             if kind == "send":
                 try:
-                    data = serialize(arg, self.channel.proto_ver)
+                    cache = getattr(arg, "_wire_cache", None)
+                    if cache is not None:
+                        # fan-out fast path: all plain-QoS0 receivers
+                        # of one message share one serialization
+                        key = (self.channel.proto_ver, arg.retain)
+                        data = cache.get(key)
+                        if data is None:
+                            data = serialize(arg, self.channel.proto_ver)
+                            cache[key] = data
+                    else:
+                        data = serialize(arg, self.channel.proto_ver)
                     self.writer.write(data)
                     self.channel.broker.metrics.inc("bytes.sent", len(data))
                 except Exception:

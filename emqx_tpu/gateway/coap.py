@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..broker.access_control import ClientInfo
 from ..broker.broker import Broker
+from ..utils.net import UdpProtocolMixin
 from .core import GatewayContext
 
 log = logging.getLogger("emqx_tpu.gateway.coap")
@@ -213,7 +214,7 @@ class CoapClient:
             self.gateway.drop_client(self)
 
 
-class CoapGateway(asyncio.DatagramProtocol):
+class CoapGateway(UdpProtocolMixin, asyncio.DatagramProtocol):
     def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
                  connection_required: bool = False, heartbeat: float = 30.0):
         self.ctx = GatewayContext(broker, "coap")
@@ -245,20 +246,8 @@ class CoapGateway(asyncio.DatagramProtocol):
                 self.ctx.close_session(client)
         self.clients.clear()
         if self.transport is not None:
-            # close() only SCHEDULES the unbind: wait so an immediate
-            # restart can rebind the same port (no EADDRINUSE race)
-            self._closed_evt = asyncio.Event()
-            self.transport.close()
-            try:
-                await asyncio.wait_for(self._closed_evt.wait(), 2.0)
-            except asyncio.TimeoutError:
-                pass
+            await self._close_transport(self.transport)
             self.transport = None
-
-    def connection_lost(self, exc) -> None:
-        evt = getattr(self, "_closed_evt", None)
-        if evt is not None:
-            evt.set()
 
     async def _sweep_loop(self) -> None:
         """Evict clients idle past the heartbeat window; without this,

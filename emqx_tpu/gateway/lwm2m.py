@@ -40,6 +40,7 @@ from .coap import (
     OPT_OBSERVE, OPT_URI_PATH, OPT_URI_QUERY, OPT_CONTENT_FORMAT,
     CoapMessage, parse, serialize,
 )
+from ..utils.net import UdpProtocolMixin
 from .core import GatewayContext
 
 log = logging.getLogger("emqx_tpu.gateway.lwm2m")
@@ -209,7 +210,7 @@ class Lwm2mEndpoint:
             self.gateway.drop_endpoint(self)
 
 
-class Lwm2mGateway(asyncio.DatagramProtocol):
+class Lwm2mGateway(UdpProtocolMixin, asyncio.DatagramProtocol):
     """UDP server on the LwM2M port (default 5683 in the reference conf)."""
 
     def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
@@ -247,20 +248,8 @@ class Lwm2mGateway(asyncio.DatagramProtocol):
         self.by_addr.clear()
         self.by_location.clear()
         if self.transport is not None:
-            # close() only SCHEDULES the unbind: wait so an immediate
-            # restart can rebind the same port (no EADDRINUSE race)
-            self._closed_evt = asyncio.Event()
-            self.transport.close()
-            try:
-                await asyncio.wait_for(self._closed_evt.wait(), 2.0)
-            except asyncio.TimeoutError:
-                pass
+            await self._close_transport(self.transport)
             self.transport = None
-
-    def connection_lost(self, exc) -> None:
-        evt = getattr(self, "_closed_evt", None)
-        if evt is not None:
-            evt.set()
 
     async def _sweep_loop(self) -> None:
         """Expire registrations whose lifetime lapsed without an update."""

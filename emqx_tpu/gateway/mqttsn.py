@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..broker.access_control import ClientInfo
 from ..broker.broker import Broker
+from ..utils.net import UdpProtocolMixin
 from .core import GatewayContext
 
 log = logging.getLogger("emqx_tpu.gateway.mqttsn")
@@ -185,7 +186,7 @@ class SnClient:
             self.gateway.drop_client(self)
 
 
-class MqttSnGateway(asyncio.DatagramProtocol):
+class MqttSnGateway(UdpProtocolMixin, asyncio.DatagramProtocol):
     def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
                  gateway_id: int = 1, predefined: Optional[Dict[int, str]] = None,
                  advertise_interval: float = 0.0, advertise_addr=None,
@@ -230,21 +231,8 @@ class MqttSnGateway(asyncio.DatagramProtocol):
                 self.ctx.close_session(client)
         self.clients.clear()
         if self.transport is not None:
-            # close() only SCHEDULES the unbind: wait for
-            # connection_lost so an immediate restart can rebind the
-            # same port instead of racing EADDRINUSE
-            self._closed_evt = asyncio.Event()
-            self.transport.close()
-            try:
-                await asyncio.wait_for(self._closed_evt.wait(), 2.0)
-            except asyncio.TimeoutError:
-                pass
+            await self._close_transport(self.transport)
             self.transport = None
-
-    def connection_lost(self, exc) -> None:
-        evt = getattr(self, "_closed_evt", None)
-        if evt is not None:
-            evt.set()
 
     async def _advertise_loop(self) -> None:
         """Periodic ADVERTISE (gwid + next interval), spec 6.1."""

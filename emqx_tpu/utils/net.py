@@ -2,12 +2,53 @@
 
 from __future__ import annotations
 
-from typing import Optional
+import asyncio
+from typing import Optional, Tuple
+
+
+def format_peername(addr: Tuple) -> str:
+    """(host, port[, ...]) socket tuple → canonical peername string.
+    IPv6 hosts get the bracket form so the port can be split back off
+    unambiguously: ('::1', 1883) → '[::1]:1883'."""
+    host, port = addr[0], addr[1]
+    if ":" in str(host):
+        return f"[{host}]:{port}"
+    return f"{host}:{port}"
 
 
 def peer_host(peername: Optional[str]) -> str:
-    """Host part of a "host:port" peername, IPv6-safe: '::1:54321'
-    splits on the LAST colon, so the address survives intact."""
+    """Host part of a peername.  Handles '[v6]:port' (canonical),
+    'v4:port', bare 'v4'/'v6' hosts (UDP gateways store addr[0] with
+    no port), and legacy unbracketed 'v6:port' can't be split safely
+    so it comes back whole."""
     if not peername:
         return ""
-    return peername.rsplit(":", 1)[0]
+    if peername.startswith("["):
+        end = peername.find("]")
+        return peername[1:end] if end > 0 else peername
+    if peername.count(":") > 1:
+        return peername  # bare IPv6 (or unsplittable legacy v6:port)
+    host, sep, port = peername.rpartition(":")
+    if sep and port.isdigit():
+        return host
+    return peername
+
+
+class UdpProtocolMixin:
+    """Shared teardown for asyncio datagram protocols: transport
+    close() only SCHEDULES the unbind, so an immediate restart races
+    EADDRINUSE — `_close_transport` waits for connection_lost."""
+
+    def connection_lost(self, exc) -> None:
+        evt = getattr(self, "_closed_evt", None)
+        if evt is not None:
+            evt.set()
+
+    async def _close_transport(self, transport,
+                               timeout: float = 2.0) -> None:
+        self._closed_evt = asyncio.Event()
+        transport.close()
+        try:
+            await asyncio.wait_for(self._closed_evt.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass

@@ -622,3 +622,53 @@ def test_resumed_session_updates_username(h):
                    props={Property.SESSION_EXPIRY_INTERVAL: 300},
                    username="bob")
     assert s2.session.username == "bob"
+
+
+def test_fanout_wire_cache_correctness(h):
+    """The shared-serialization fast path must never leak wrong bytes:
+    v4 and v5 receivers, and retain-as-published differences, each get
+    their own wire form; QoS1 receivers and modified props are never
+    cached."""
+    from emqx_tpu.broker.frame import Parser, serialize
+
+    v5sub = h.connect("wc-v5", ver=MQTT_V5)
+    v4sub = h.connect("wc-v4", ver=4)
+    rap = h.connect("wc-rap", ver=MQTT_V5)
+    q1 = h.connect("wc-q1", ver=MQTT_V5)
+    v5sub.handle_in(pkt.Subscribe(packet_id=1,
+                                  topic_filters=[("wc/t", SubOpts(qos=0))]))
+    v4sub.handle_in(pkt.Subscribe(packet_id=1,
+                                  topic_filters=[("wc/t", SubOpts(qos=0))]))
+    rap.handle_in(pkt.Subscribe(
+        packet_id=1,
+        topic_filters=[("wc/t", SubOpts(qos=0, retain_as_published=True))],
+    ))
+    q1.handle_in(pkt.Subscribe(packet_id=1,
+                               topic_filters=[("wc/t", SubOpts(qos=1))]))
+    for ch in (v5sub, v4sub, rap, q1):
+        h.clear(ch)
+    p = h.connect("wc-pub")
+    p.handle_in(pkt.Publish(topic="wc/t", payload=b"data", qos=1,
+                            packet_id=9, retain=True))
+
+    def wire(ch):
+        (out,) = h.sent(ch, PacketType.PUBLISH)
+        return out, serialize(out, ch.proto_ver)
+
+    o5, w5 = wire(v5sub)
+    o4, w4 = wire(v4sub)
+    orap, wrap_ = wire(rap)
+    oq1, _ = wire(q1)
+    # plain qos0 receivers share a cache dict, keyed apart by version
+    assert getattr(o5, "_wire_cache", None) is not None
+    assert getattr(o4, "_wire_cache", None) is o5._wire_cache
+    assert w5 != w4  # v5 carries a properties block
+    # RAP receiver keeps retain=True (distinct key), plain ones clear it
+    assert orap.retain is True and o5.retain is False
+    assert wrap_ != w5
+    # QoS1 delivery (packet id) is never cached
+    assert getattr(oq1, "_wire_cache", None) is None
+    # parse back each wire form: the payload/topic survive intact
+    for ver, data in ((5, w5), (4, w4)):
+        (parsed,) = Parser(version=ver).feed(data)
+        assert parsed.topic == "wc/t" and parsed.payload == b"data"

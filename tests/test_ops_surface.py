@@ -246,3 +246,16 @@ def test_mqttsn_gateway_restart_rebinds_same_port():
         await gw.stop()
 
     run(main())
+
+
+def test_peer_host_forms():
+    from emqx_tpu.utils.net import format_peername, peer_host
+
+    assert format_peername(("10.0.0.1", 1883)) == "10.0.0.1:1883"
+    assert format_peername(("::1", 1883, 0, 0)) == "[::1]:1883"
+    assert peer_host("[::1]:1883") == "::1"
+    assert peer_host("10.0.0.1:1883") == "10.0.0.1"
+    assert peer_host("::1") == "::1"            # bare v6 (UDP gateways)
+    assert peer_host("10.0.0.1") == "10.0.0.1"  # bare v4
+    assert peer_host("") == "" and peer_host(None) == ""
+    assert peer_host("fe80::2:1") == "fe80::2:1"  # unsplittable legacy
