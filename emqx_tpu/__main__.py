@@ -12,7 +12,19 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import sys
+
+# Environments that pre-import jax (site hooks) may pin a platform
+# before env vars like JAX_PLATFORMS can apply; this override works
+# post-import as long as the backend hasn't initialized yet, so
+# `EMQX_TPU_JAX_PLATFORM=cpu python -m emqx_tpu ...` reliably runs the
+# engine on CPU (tests, CI, machines without an accelerator).
+_plat = os.environ.get("EMQX_TPU_JAX_PLATFORM")
+if _plat:
+    import jax
+
+    jax.config.update("jax_platforms", _plat)
 
 from .config.config import Config
 from .node import NodeRuntime

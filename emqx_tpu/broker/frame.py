@@ -510,6 +510,22 @@ class Parser:
 
 # -------------------------------------------------------------- serializer
 
+def serialize_cached(p: pkt.Packet, version: int) -> bytes:
+    """Serialize honoring the fan-out fast path: plain-QoS0 PUBLISH
+    packets carry a `_wire_cache` dict shared by every receiver of one
+    message, keyed by (protocol version, retain flag) — one
+    serialization per distinct wire form instead of one per receiver."""
+    cache = getattr(p, "_wire_cache", None)
+    if cache is None:
+        return serialize(p, version)
+    key = (version, p.retain)
+    data = cache.get(key)
+    if data is None:
+        data = serialize(p, version)
+        cache[key] = data
+    return data
+
+
 def serialize(p: pkt.Packet, version: int = pkt.MQTT_V4) -> bytes:
     t = p.type
     v5 = version == pkt.MQTT_V5

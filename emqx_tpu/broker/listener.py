@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Tuple
 from . import packet as pkt
 from .broker import Broker
 from .channel import Action, Channel, ChannelConfig
-from .frame import FrameError, Parser, serialize
+from .frame import FrameError, Parser, serialize, serialize_cached
 from .message import Message
 
 log = logging.getLogger("emqx_tpu.listener")
@@ -69,17 +69,7 @@ class Connection:
             arg = action[1] if len(action) > 1 else None
             if kind == "send":
                 try:
-                    cache = getattr(arg, "_wire_cache", None)
-                    if cache is not None:
-                        # fan-out fast path: all plain-QoS0 receivers
-                        # of one message share one serialization
-                        key = (self.channel.proto_ver, arg.retain)
-                        data = cache.get(key)
-                        if data is None:
-                            data = serialize(arg, self.channel.proto_ver)
-                            cache[key] = data
-                    else:
-                        data = serialize(arg, self.channel.proto_ver)
+                    data = serialize_cached(arg, self.channel.proto_ver)
                     self.writer.write(data)
                     self.channel.broker.metrics.inc("bytes.sent", len(data))
                 except Exception:

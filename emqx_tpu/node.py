@@ -597,11 +597,35 @@ class NodeRuntime:
             # (one compile per batch-size bucket; the min_batch bucket
             # covers interactive publishes, bigger buckets compile lazily)
             def _warm():
+                import jax
+
+                try:
+                    # persistent XLA cache: restarts (and every node
+                    # sharing the data dir) skip recompilation entirely
+                    jax.config.update(
+                        "jax_compilation_cache_dir",
+                        os.path.join(self.conf.get("node.data_dir"),
+                                     "xla_cache"),
+                    )
+                except Exception:
+                    pass
                 eng = self.broker.engine
                 eng.add_filter("$boot/warmup/+")
+                eng.add_filter("$boot/warmup/#")
                 try:
+                    # first match has the add_filter delta pending ->
+                    # compiles the FUSED churn+match kernel; the second
+                    # has none -> compiles the pure-match kernel.  Both
+                    # land in the depth-4 bucket that covers typical
+                    # topics (deeper buckets compile lazily).
+                    eng.match(["$boot/warmup/x"])
                     eng.match(["$boot/warmup/x"])
                 finally:
+                    # remove ONE of the two so entries remain: the
+                    # match still dispatches and warms the fused
+                    # REMOVE path (n_entries==0 would skip the device)
+                    eng.remove_filter("$boot/warmup/#")
+                    eng.match(["$boot/warmup/x"])
                     eng.remove_filter("$boot/warmup/+")
 
             await asyncio.to_thread(_warm)
