@@ -374,13 +374,13 @@ class TopicMatchEngine:
             hcap = B * self._hcap_mult
             # truncate term levels to this batch's real depth: the terms
             # array IS the upload payload (~64 MB/s real link bandwidth).
-            # Rounded UP to a power of two so the kernel compiles at most
-            # log2(max_levels) depth variants instead of one per distinct
+            # Rounded UP to the next EVEN depth so the kernel compiles at
+            # most max_levels/2 variants instead of one per distinct
             # topic depth — a fresh depth otherwise pays a multi-second
-            # XLA compile mid-traffic (and trips the OLP shed)
+            # XLA compile mid-traffic (and trips the OLP shed) — while
+            # wasting at most one level of upload bytes
             L_real = max(1, min(self.space.max_levels, int(nb.length.max())))
-            L_used = min(self.space.max_levels,
-                         1 << (L_real - 1).bit_length())
+            L_used = min(self.space.max_levels, L_real + (L_real & 1))
             pbatch = jax.device_put(
                 pack_topic_batch_np(
                     nb.terms_a[:, :L_used], nb.terms_b[:, :L_used],

@@ -615,11 +615,13 @@ class NodeRuntime:
                 try:
                     # first match has the add_filter delta pending ->
                     # compiles the FUSED churn+match kernel; the second
-                    # has none -> compiles the pure-match kernel.  Both
-                    # land in the depth-4 bucket that covers typical
-                    # topics (deeper buckets compile lazily).
-                    eng.match(["$boot/warmup/x"])
-                    eng.match(["$boot/warmup/x"])
+                    # has none -> compiles the pure-match kernel.  Warm
+                    # both even-depth buckets common traffic hits
+                    # (deeper buckets compile lazily; the persistent
+                    # XLA cache makes this a first-boot-only cost).
+                    eng.match(["$boot/warmup/x"])      # fused, bucket 4
+                    eng.match(["$boot/warmup/x"])      # pure, bucket 4
+                    eng.match(["warm"])                # pure, bucket 2
                 finally:
                     # remove ONE of the two so entries remain: the
                     # match still dispatches and warms the fused
