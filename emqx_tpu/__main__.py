@@ -67,6 +67,12 @@ def main(argv=None) -> int:
         fmt=args.log_format or conf.get("log.format"),
     )
     node = NodeRuntime(raw)
+    # GC tuning is process-global (freeze + thresholds), so it is opted
+    # into only by this dedicated-process entry point — never by embedded
+    # or multi-node-in-one-interpreter usage.  The actual freeze runs at
+    # the END of start(), after boot has built/restored the route tables
+    # and session stores it is meant to exempt from gen-2 sweeps.
+    node.gc_tune_after_boot = True
     try:
         asyncio.run(node.run_forever())
     except KeyboardInterrupt:

@@ -11,15 +11,27 @@ Latency/throughput trade: a batch closes either when `max_batch` messages
 are pending or `max_delay` elapses after the first message of the tick —
 the small-tick policy that keeps p99 inside the latency budget
 (SURVEY.md §7.3).
+
+Pipelined: each tick is SUBMITTED on the event loop (hooks, retain,
+cluster forwards, match dispatch — all non-blocking), then its blocking
+match collect runs in an executor thread while the loop keeps serving
+connections, keepalives and REST, and while the NEXT tick submits — so
+host hashing/upload of tick N overlaps device compute of tick N-1, and a
+device stall can never freeze the node (the reference's dispatch hot loop
+never parks the scheduler either, `emqx_broker.erl:499-524`).  Delivery
+(`publish_finish`) happens back on the loop in tick order.
 """
 
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import List, Optional, Tuple
 
 from .broker import Broker
 from .message import Message
+
+log = logging.getLogger("emqx_tpu.batcher")
 
 
 class PublishBatcher:
@@ -28,53 +40,136 @@ class PublishBatcher:
         broker: Broker,
         max_batch: int = 4096,
         max_delay: float = 0.002,
+        max_inflight: int = 32,
     ):
         self.broker = broker
         self.max_batch = max_batch
         self.max_delay = max_delay
+        # hard ceiling on queued in-flight ticks: past it _run holds new
+        # flushes until the consumer frees a slot (ordering preserved,
+        # tick memory bounded).  Soft pressure is shed earlier via
+        # Olp.pressure_fn, which the node wires to inflight_ticks.
+        self.max_inflight = max_inflight
         self._q: List[Tuple[Message, asyncio.Future]] = []
         self._wakeup: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
+        self._consumer: Optional[asyncio.Task] = None
+        self._ticks_q: Optional[asyncio.Queue] = None
+        # tick whose collect thread was cancelled mid-flight; stop()
+        # finishes it after the executor thread drains
+        self._interrupted: Optional[tuple] = None
         self.ticks = 0
         self.batched_messages = 0
 
     def start(self) -> None:
-        if self._task is None:
+        """(Re)start the tick and consumer tasks.  The tick queue is
+        created once and survives restarts — queued in-flight ticks must
+        never be orphaned (their publish futures would hang QoS acks)."""
+        if self._wakeup is None:
             self._wakeup = asyncio.Event()
+        if self._ticks_q is None:
+            self._ticks_q = asyncio.Queue()
+        if self._task is None or self._task.done():
             self._task = asyncio.create_task(self._run())
+        if self._consumer is None or self._consumer.done():
+            self._consumer = asyncio.create_task(self._consume())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
-        self._flush_now()
+        for t in (self._task, self._consumer):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+        self._task = None
+        self._consumer = None
+        # drain in order: the interrupted tick (waiting for its executor
+        # thread — collect must never run twice concurrently), then the
+        # queued ticks, then the open batch
+        if self._interrupted is not None:
+            batch, pp, done_evt = self._interrupted
+            self._interrupted = None
+            if done_evt is None:
+                # collect never started: run it end-to-end here
+                self._finish_tick(batch, pp)
+            else:
+                # wait OFF the loop; on timeout the thread is wedged on
+                # a dead device — fail the futures, never collect twice
+                done = await asyncio.to_thread(done_evt.wait, 60.0)
+                err = pp.exc if done else TimeoutError(
+                    "publish collect wedged at shutdown"
+                )
+                if err is None:
+                    self._finish_tick(batch, pp, collected=True)
+                else:
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(err)
+        if self._ticks_q is not None:
+            while not self._ticks_q.empty():
+                batch, pp = self._ticks_q.get_nowait()
+                self._finish_tick(batch, pp)
+        self._flush_now(pipelined=False)
 
     def submit(self, msg: Message) -> "asyncio.Future[int]":
         """Queue a message for the next tick; resolves to delivery count."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._q.append((msg, fut))
-        if self._task is None or self._task.done():
-            self._task = None  # restart after a crashed tick
-            self.start()
+        self.start()  # no-op when healthy; restarts a crashed task
         self._wakeup.set()
-        if len(self._q) >= self.max_batch:
+        if (
+            len(self._q) >= self.max_batch
+            and self._ticks_q.qsize() < self.max_inflight
+        ):
+            # at the in-flight ceiling the _run task flushes once room
+            # appears (ordering preserved; memory bounded; Olp pressure
+            # sheds new load meanwhile)
             self._flush_now()
         return fut
 
-    def _flush_now(self) -> None:
-        batch, self._q = self._q, []
+    @property
+    def inflight_ticks(self) -> int:
+        return self._ticks_q.qsize() if self._ticks_q is not None else 0
+
+    def _flush_now(self, pipelined: bool = True) -> None:
+        """Close the open batch and submit it in max_batch-sized ticks
+        (a backlog accumulated during a ceiling wait must not become one
+        giant never-compiled-before batch shape); synchronous end-to-end
+        on the shutdown path (pipelined=False)."""
+        while self._q:
+            self._flush_chunk(pipelined)
+            if pipelined and self._q:
+                # remainder flushes from _run (respecting the ceiling)
+                self._wakeup.set()
+                break
+
+    def _flush_chunk(self, pipelined: bool = True) -> None:
+        batch = self._q[: self.max_batch]
+        self._q = self._q[self.max_batch:]
         if not batch:
             return
         self.ticks += 1
         self.batched_messages += len(batch)
         try:
-            results = self.broker.publish_many([m for m, _ in batch])
+            pp = self.broker.publish_submit([m for m, _ in batch])
         except Exception as e:
             # a failed tick must never strand futures (acks would hang)
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        if pipelined and self._ticks_q is not None:
+            self._ticks_q.put_nowait((batch, pp))
+        else:
+            self._finish_tick(batch, pp)
+
+    def _finish_tick(self, batch, pp, collected: bool = False) -> None:
+        try:
+            if not collected:
+                self.broker.publish_collect(pp)
+            results = self.broker.publish_finish(pp)
+        except Exception as e:
             for _, fut in batch:
                 if not fut.done():
                     fut.set_exception(e)
@@ -83,10 +178,60 @@ class PublishBatcher:
             if not fut.done():
                 fut.set_result(n)
 
-    async def _run(self) -> None:
-        import logging
+    def _collect_tick(self, pp, done_evt) -> None:
+        """Executor-thread body: collect, always signalling completion
+        (stop() waits on the event to avoid a concurrent second collect)."""
+        try:
+            self.broker.publish_collect(pp)
+        except BaseException as e:
+            pp.exc = e  # visible to stop()'s interrupted-tick drain
+            raise
+        finally:
+            done_evt.set()
 
-        log = logging.getLogger("emqx_tpu.batcher")
+    async def _consume(self) -> None:
+        """Collect + deliver ticks in submit order; the blocking collect
+        runs in the default executor so the loop never waits on the
+        device, and delivery happens back on the loop thread."""
+        import threading
+
+        loop = asyncio.get_running_loop()
+        while True:
+            batch, pp = await self._ticks_q.get()
+            done_evt = threading.Event()
+            efut = loop.run_in_executor(None, self._collect_tick, pp, done_evt)
+            try:
+                await efut
+            except asyncio.CancelledError:
+                if efut.cancelled():
+                    # the work item was cancelled BEFORE a pool thread
+                    # picked it up: nothing is running, collect fresh in
+                    # stop()'s drain (evt None marks not-started)
+                    self._interrupted = (batch, pp, None)
+                else:
+                    # the executor thread cannot be interrupted — hand
+                    # the tick to stop(), which waits for the thread and
+                    # then delivers (never two collects on one tick)
+                    self._interrupted = (batch, pp, done_evt)
+                raise
+            except Exception as e:
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            try:
+                results = self.broker.publish_finish(pp)
+            except Exception as e:
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                log.exception("publish finish failed")
+                continue
+            for (m, fut), n in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(n)
+
+    async def _run(self) -> None:
         while True:
             await self._wakeup.wait()
             self._wakeup.clear()
@@ -95,7 +240,15 @@ class PublishBatcher:
             # tick window: let concurrent publishers join the batch
             try:
                 await asyncio.sleep(self.max_delay)
+                # in-flight ceiling: hold the batch until the consumer
+                # frees a slot — the loop stays live, ordering holds,
+                # and tick memory is bounded (Olp.pressure_fn sheds new
+                # load from inflight_ticks well before this point)
+                while self._ticks_q.qsize() >= self.max_inflight:
+                    await asyncio.sleep(self.max_delay)
                 self._flush_now()
+                if self._q:  # arrivals during the ceiling wait
+                    self._wakeup.set()
             except asyncio.CancelledError:
                 self._flush_now()
                 raise

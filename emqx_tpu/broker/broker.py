@@ -34,6 +34,17 @@ from ..models.engine import TopicMatchEngine
 
 
 @dataclass
+class PendingPublish:
+    """An in-flight three-phase publish (submit -> collect -> finish)."""
+
+    todo: List[Tuple[int, Message]]
+    results: List[int]
+    pending: object  # engine _PendingMatch (or None for an empty tick)
+    matched: Optional[List[List[int]]] = None
+    exc: Optional[BaseException] = None  # collect failure (batcher drain)
+
+
+@dataclass
 class Route:
     """Host-side fan-out record for one unique filter (one fid).
 
@@ -213,9 +224,49 @@ class Broker:
         Runs 'message.publish' hooks, retains, matches the whole batch on
         device in one kernel, then dispatches host-side.
         """
+        pp = self.publish_submit(msgs)
+        self.publish_collect(pp)
+        return self.publish_finish(pp)
+
+    # The three-phase publish contract (used by PublishBatcher to pipeline
+    # ticks and keep the engine's blocking collect OFF the event loop —
+    # the reference's dispatch hot loop never parks the scheduler,
+    # `emqx_broker.erl:499-524`):
+    #   submit  (loop thread)   hooks + retain + cluster forwards + match
+    #                           dispatch; returns immediately
+    #   collect (any thread)    blocks on the match result; touches no
+    #                           broker state, so it is executor-safe
+    #   finish  (loop thread)   fid expansion + local delivery
+
+    def publish_submit(self, msgs: Sequence[Message]) -> "PendingPublish":
         todo, results = self._prepare_publish(msgs)
-        self._match_dispatch(todo, results)
-        return results
+        if todo:
+            self._pre_match(todo)
+        pending = (
+            self.engine.match_submit([m.topic for _, m in todo])
+            if todo
+            else None
+        )
+        return PendingPublish(todo, results, pending)
+
+    def publish_collect(self, pp: "PendingPublish") -> "PendingPublish":
+        if pp.pending is not None:
+            pp.matched = self.engine.match_collect_raw(pp.pending)
+        return pp
+
+    def publish_finish(self, pp: "PendingPublish") -> List[int]:
+        if pp.pending is not None:
+            for (i, msg), fids in zip(pp.todo, pp.matched):
+                n = self._dispatch(msg, fids)
+                tp("dispatch_done", topic=msg.topic, mid=msg.mid, receivers=n)
+                pp.results[i] = n
+                if n == 0:
+                    self.metrics.inc("messages.dropped.no_subscribers")
+                    self.hooks.run("message.dropped", (msg, "no_subscribers"))
+        return pp.results
+
+    def _pre_match(self, todo: List[Tuple[int, Message]]) -> None:
+        """Between accept and match: the cluster layer forwards here."""
 
     def _prepare_publish(
         self, msgs: Sequence[Message]
@@ -241,7 +292,8 @@ class Broker:
         """Device-match the accepted batch and deliver locally."""
         if not todo:
             return
-        matched = self.engine.match([m.topic for _, m in todo])
+        pending = self.engine.match_submit([m.topic for _, m in todo])
+        matched = self.engine.match_collect_raw(pending)
         for (i, msg), fids in zip(todo, matched):
             n = self._dispatch(msg, fids)
             tp("dispatch_done", topic=msg.topic, mid=msg.mid, receivers=n)
@@ -251,7 +303,7 @@ class Broker:
                 self.hooks.run("message.dropped", (msg, "no_subscribers"))
 
     def _dispatch(
-        self, msg: Message, fids: Set[int], include_shared: bool = True
+        self, msg: Message, fids, include_shared: bool = True
     ) -> int:
         """Expand matched fids to receivers and deliver (`do_dispatch`).
 

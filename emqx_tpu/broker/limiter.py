@@ -129,6 +129,11 @@ class Olp:
         self.enabled = True  # runtime kill switch (emqx_ctl olp enable)
         self._overloaded_until = 0.0
         self.shed_count = 0
+        # extra pressure source beyond loop lag: the pipelined publish
+        # path keeps the loop responsive even when the device falls
+        # behind, so the batcher's in-flight tick depth must feed the
+        # same shed decision (wired by the node runtime)
+        self.pressure_fn = None  # () -> bool
 
     def note_lag(self, lag_s: float, now: Optional[float] = None) -> None:
         now = now if now is not None else time.monotonic()
@@ -137,7 +142,9 @@ class Olp:
 
     @property
     def overloaded(self) -> bool:
-        return time.monotonic() < self._overloaded_until
+        if time.monotonic() < self._overloaded_until:
+            return True
+        return self.pressure_fn is not None and bool(self.pressure_fn())
 
     def should_accept(self) -> bool:
         if self.enabled and self.overloaded:

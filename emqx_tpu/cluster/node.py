@@ -41,16 +41,15 @@ class ClusterBroker(Broker):
         super().__init__(*a, **kw)
         self.cluster: Optional[ClusterNode] = None
 
-    def publish_many(self, msgs: Sequence[Message]) -> List[int]:
-        todo, results = self._prepare_publish(msgs)
+    def _pre_match(self, todo) -> None:
+        # between accept and match (rides publish_submit, so the batcher's
+        # pipelined path forwards exactly like the synchronous one)
         if self.cluster is not None and todo:
             accepted = [m for _, m in todo]
             self.cluster.forward_publish(accepted)
             # shared groups with members ONLY on peers: targeted forward
             # (exactly one delivery per group cluster-wide)
             self.cluster.dispatch_remote_shared(accepted)
-        self._match_dispatch(todo, results)
-        return results
 
     def dispatch_forwarded(self, msg: Message) -> int:
         """Receiving side of a remote forward: local match+dispatch of

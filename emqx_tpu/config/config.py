@@ -238,6 +238,13 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         ),
         "batch_max": Field("int", 4096, min=1, desc="publish batch tick size"),
         "batch_delay": Field("duration", 0.002),
+        "hybrid": Field(
+            "bool", True,
+            desc="hybrid host/device match arbitration: serve matches from "
+                 "the native host probe whenever the measured device "
+                 "round-trip is slower (degraded link), keeping the HBM "
+                 "mirror warm; false = always device",
+        ),
         "sys_msg_interval": Field("duration", 60.0),
         "sys_heartbeat_interval": Field("duration", 30.0),
     },

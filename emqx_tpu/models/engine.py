@@ -14,6 +14,17 @@ API:
 Filters deeper than the device level cap fall back to a host-side trie —
 the same escape hatch as the reference's depth-bounding compaction
 (`emqx_trie.erl:202-233`).
+
+Hybrid host/device arbitration: the reference never pays a wire to match
+(`emqx_router.erl:127-140` — matching is an in-node ETS walk).  When the
+host<->device link is degraded (measured, not assumed), this engine
+serves matches from a native host-side probe over the SAME table arrays
+the device mirrors (`native/matchhash.cc etpu_match_host` — identical
+shape-enumeration semantics by construction), keeps the HBM mirror warm
+with periodic probe dispatches, and switches back the moment the
+measured device rate beats the host rate.  Device-served batches carry a
+timeout fallback to the host path, so a mid-traffic device stall can
+never block a publish tick behind a multi-second transfer.
 """
 
 from __future__ import annotations
@@ -117,6 +128,13 @@ class TopicMatchEngine:
         self._deep = CpuTrieIndex()
         self._deep_fids: Set[int] = set()
 
+        # native fid -> filter-string registry (C++-owned): backs inline
+        # verification in the fused host match and registry-backed device
+        # verify; None without the native lib (pure-Python fallbacks)
+        from ..ops import native as _native
+
+        self._reg = _native.make_registry()
+
         # exact-match guarantee: verify device hash hits against stored
         # filter words (default on; see match())
         self.verify_matches = True
@@ -127,6 +145,22 @@ class TopicMatchEngine:
         self._dev: Optional[DeviceTables] = None
         self._dev_stale = True
         self._hcap_mult = 1  # sparse-return size factor (doubles on overflow)
+
+        # ---- hybrid host/device arbitration state (see module docstring)
+        # Default OFF at the class level so unit tests exercise the device
+        # path deterministically; the node runtime enables it from config
+        # (broker.hybrid, default true) and bench.py measures both.
+        self.hybrid = False
+        self.rate_host: Optional[float] = None  # EWMA lookups/s, host path
+        self.rate_dev: Optional[float] = None  # EWMA lookups/s, device path
+        self.probe_interval = 10.0  # re-measure the idle path this often (s)
+        self.dev_timeout_floor = 0.25  # min device-collect timeout (s)
+        self.host_serve_count = 0
+        self.dev_serve_count = 0
+        self.dev_timeout_count = 0
+        self._probe = None  # in-flight device probe: (out, t0, n_topics)
+        self._last_dev_meas = 0.0
+        self._last_host_meas = 0.0
         # The match hot path is pure XLA by design.  A Pallas kernel for
         # the hash contraction was built and measured on a real TPU
         # (round-1 commit c2423d1): ~46 ms vs XLA's ~0.03-0.2 ms per
@@ -158,6 +192,8 @@ class TopicMatchEngine:
             self._deep_fids.add(fid)
         else:
             self.tables.insert(ws, fid)
+            if self._reg is not None:
+                self._reg.set_bulk([fid], [self._fbytes[fid]])
         self.epoch += 1
         return fid
 
@@ -188,6 +224,10 @@ class TopicMatchEngine:
                 new_fids.append(fid)
         if new_strs:
             self.tables.bulk_insert(new_strs, new_fids)
+            if self._reg is not None:
+                self._reg.set_bulk(
+                    new_fids, [self._fbytes[f] for f in new_fids]
+                )
         self.epoch += 1
         return fids
 
@@ -208,6 +248,8 @@ class TopicMatchEngine:
             self._deep.delete(filt, fid)
         else:
             self.tables.delete(fid)
+            if self._reg is not None:
+                self._reg.del_bulk([fid])
         self._free_fids.append(fid)
         self.epoch += 1
         return fid
@@ -245,6 +287,8 @@ class TopicMatchEngine:
             self._free_fids.append(fid)
         if dead_fids:
             self.tables.delete_batch(dead_fids)
+            if self._reg is not None:
+                self._reg.del_bulk(dead_fids)
         out: List[int] = []
         new_strs: List[str] = []
         new_fids: List[int] = []
@@ -271,6 +315,10 @@ class TopicMatchEngine:
             out.append(fid)
         if new_strs:
             self.tables.churn_insert(new_strs, new_fids, words=new_words)
+            if self._reg is not None:
+                self._reg.set_bulk(
+                    new_fids, [self._fbytes[f] for f in new_fids]
+                )
         self.epoch += 1
         return out
 
@@ -346,16 +394,45 @@ class TopicMatchEngine:
     # -------------------------------------------------------------- match
 
     def match_submit(self, topics: Sequence[str]) -> "_PendingMatch":
-        """Dispatch the device match WITHOUT blocking.
+        """Dispatch a match WITHOUT blocking (host or device path).
 
-        Pending subscription churn is fused into the same dispatch
-        (`ops.match.fused_step_sparse`), so a churn tick costs the same
-        single device round trip as a pure match tick; the return is the
-        device-compacted [B, K] top-fid block, not the full [B, M] row.
-        Pair with :meth:`match_collect`; submitting batch N before
+        Device path: pending subscription churn is fused into the same
+        dispatch (`ops.match.fused_step_sparse`), so a churn tick costs
+        the same single device round trip as a pure match tick; the
+        return is the device-compacted sparse block, not the full [B, M]
+        row.  Pair with :meth:`match_collect`; submitting batch N before
         collecting batch N-1 overlaps host hashing + upload with device
-        compute (the end-to-end pipeline of round-2 VERDICT weak #1)."""
-        out = pbatch = None
+        compute.
+
+        Host path (hybrid arbitration, module docstring): submit is just
+        a table snapshot — all work (hash, native probe, verify) runs in
+        collect, which the broker executes off the event loop."""
+        if (
+            self.hybrid
+            and self.tables.n_entries
+            and self._host_ok()
+            and self._pick_host()
+        ):
+            self._maybe_probe_device(topics)
+            return _PendingMatch(
+                None, 0, None, None, list(topics),
+                mode="host", snap=self._snapshot(),
+                deep=self._deep_hits(topics),
+            )
+        return self._device_submit(topics)
+
+    def _deep_hits(self, topics: Sequence[str]) -> Optional[List[Set[int]]]:
+        """Deep-filter matches, computed AT SUBMIT on the caller's thread:
+        collect may run on an executor thread while subscribes mutate the
+        deep trie on the loop thread — iterating it there would race."""
+        if not self._deep_fids:
+            return None
+        return [self._deep.match(t) & self._deep_fids for t in topics]
+
+    def _device_submit(self, topics: Sequence[str]) -> "_PendingMatch":
+        import time
+
+        out = pbatch = nb = None
         hcap = 0
         if self.tables.n_entries:
             import jax
@@ -401,28 +478,57 @@ class TopicMatchEngine:
                 pass
         # snapshot THIS tick's table version: later pipelined submits may
         # advance self._dev, and the overflow refetch must not see them
-        return _PendingMatch(out, hcap, pbatch, self._dev, list(topics))
+        return _PendingMatch(
+            out, hcap, pbatch, self._dev, list(topics),
+            mode="device", snap=self._snapshot(), t0=time.monotonic(),
+            deep=self._deep_hits(topics),
+        )
 
     def match_collect(self, pending: "_PendingMatch") -> List[Set[int]]:
         """Block on a submitted match and return verified fid sets."""
+        return [set(x) for x in self.match_collect_raw(pending)]
+
+    def match_collect_raw(self, pending: "_PendingMatch") -> List[List[int]]:
+        """Like match_collect but returns per-topic fid LISTS — the
+        broker's dispatch only iterates, and the engine's hit streams are
+        duplicate-free by construction (one hit per shape per topic; deep
+        fids disjoint from table fids), so skipping 4096 set builds per
+        tick is free throughput on the hot path."""
+        import time
+
+        if pending.mode == "host":
+            t0 = time.monotonic()
+            out = self._host_collect(pending)
+            dt = max(time.monotonic() - t0, 1e-9)
+            self._note_host_rate(len(pending.topics) / dt)
+            self.host_serve_count += 1
+            return out
+
         topics = pending.topics
-        out: List[Set[int]] = [set() for _ in topics]
+        out: List[List[int]] = [[] for _ in topics]
         if pending.out is not None:
             n = len(topics)
-            arr = np.asarray(pending.out)
+            arr = self._timed_fetch(pending)
+            if arr is None:  # device stalled past its budget: host serves
+                self.dev_timeout_count += 1
+                return self._host_collect(pending)
+            self.dev_serve_count += 1
             hcap = pending.hcap
             total = int(arr[-1])
             counts = arr[hcap:-1].view(np.uint16)[:n].astype(np.int64)
             if total > hcap or (counts >= 0xFFFF).any():
-                # more hits than the sparse buffer holds: refetch the full
-                # row set once (against THIS tick's tables) and widen the
-                # next submits
+                # more hits than the sparse buffer holds: recover the full
+                # set once and widen the next submits.  The host probe is
+                # the cheap recovery (same tables, no [B, M] download);
+                # the device refetch remains for hosts without the lib.
+                self._hcap_mult *= 2
+                if self._host_ok() and pending.snap is not None:
+                    return self._host_collect(pending)
                 from ..ops.match import match_batch_packed
 
                 full = np.asarray(
                     match_batch_packed(pending.tables, pending.batch)
                 )[:n]
-                self._hcap_mult *= 2
                 ii, jj = np.nonzero(full >= 0)
                 fids = full[ii, jj]
             else:
@@ -435,11 +541,198 @@ class TopicMatchEngine:
                     self._verify_into(topics, ii, fids, out)
                 else:
                     for i, f in zip(ii.tolist(), fids.tolist()):
-                        out[i].add(int(f))
-        if self._deep_fids:
-            for i, t in enumerate(topics):
-                out[i] |= self._deep.match(t) & self._deep_fids
+                        out[i].append(int(f))
+        self._merge_deep(pending, out)
         return out
+
+    @staticmethod
+    def _merge_deep(pending: "_PendingMatch", out: List[List[int]]) -> None:
+        if pending.deep is not None:
+            for o, hits in zip(out, pending.deep):
+                o.extend(hits)
+
+    # ------------------------------------------------- hybrid arbitration
+
+    def _host_ok(self) -> bool:
+        # the host path is the fused registry probe: both come from the
+        # native lib, so the registry handle IS the availability signal
+        return self._reg is not None
+
+    def _snapshot(self) -> tuple:
+        """Reference-capture the live table arrays: rebuilds REPLACE the
+        numpy arrays, so holding these keeps this tick's version alive
+        (in-place slot writes after the snapshot are benign dirty reads,
+        the same semantics as concurrent ETS mutation in the reference)."""
+        t = self.tables
+        return (t.key_a, t.key_b, t.val, t.log2cap, t.incl, t.k_a, t.k_b,
+                t.min_len, t.max_len, t.wild_root, t.valid)
+
+    def _pick_host(self) -> bool:
+        import time
+
+        if self.rate_host is None or self.rate_dev is None:
+            return True  # measure host first; the probe measures device
+        if self.rate_host >= self.rate_dev:
+            return True
+        # device is winning: refresh the host estimate occasionally
+        return time.monotonic() - self._last_host_meas > self.probe_interval
+
+    def _note_host_rate(self, rps: float) -> None:
+        import time
+
+        self.rate_host = (
+            rps if self.rate_host is None else 0.5 * self.rate_host + 0.5 * rps
+        )
+        self._last_host_meas = time.monotonic()
+
+    def _note_dev_rate(self, rps: float) -> None:
+        import time
+
+        self.rate_dev = (
+            rps if self.rate_dev is None else 0.5 * self.rate_dev + 0.5 * rps
+        )
+        self._last_dev_meas = time.monotonic()
+
+    def _poll_probe(self) -> None:
+        """Harvest a completed device probe (non-blocking)."""
+        import time
+
+        p = self._probe
+        if p is None:
+            return
+        out, t0, n = p
+        try:
+            ready = out is None or out.is_ready()
+        except AttributeError:  # pragma: no cover - older jax: settle now
+            np.asarray(out)
+            ready = True
+        if ready:
+            # completion time is an upper bound (ready since some earlier
+            # tick); ticks are frequent while serving, so the bias is small
+            self._note_dev_rate(n / max(time.monotonic() - t0, 1e-9))
+            self._probe = None
+
+    def _maybe_probe_device(self, topics: Sequence[str]) -> None:
+        """Keep the device mirror warm + the device rate fresh while the
+        host path serves: dispatch this batch to the device (applying any
+        pending churn delta); completion is polled via is_ready() on later
+        ticks — the serving path never waits on it, and no thread blocks
+        inside the runtime (threads stuck in device waits abort at
+        interpreter shutdown)."""
+        import time
+
+        self._poll_probe()
+        if self._probe is not None:
+            return
+        now = time.monotonic()
+        if (
+            self.rate_dev is not None
+            and now - self._last_dev_meas <= self.probe_interval
+        ):
+            return
+        t0 = time.monotonic()
+        try:
+            pend = self._device_submit(list(topics))
+        except Exception:  # pragma: no cover - probe must not break serving
+            import logging
+
+            logging.getLogger("emqx_tpu.engine").exception("device probe")
+            return
+        self._probe = (pend.out, t0, len(pend.topics))
+
+    def _timed_fetch(self, pending: "_PendingMatch") -> Optional[np.ndarray]:
+        """Fetch the device result, bounded by a timeout when a host
+        fallback exists; returns None on timeout (rate decayed so the
+        arbiter flips to the host path).  The wait is an is_ready() poll
+        with a sleep step sized well under the expected completion time,
+        so a fast device pays ~no overhead and a stalled one never wedges
+        a thread in an uninterruptible device wait."""
+        import time
+
+        if not (self.hybrid and self._host_ok() and pending.snap is not None):
+            return np.asarray(pending.out)
+        out = pending.out
+        if not hasattr(out, "is_ready"):  # pragma: no cover - older jax
+            return np.asarray(out)
+        expected = (
+            len(pending.topics) / self.rate_dev if self.rate_dev else None
+        )
+        timeout = max(self.dev_timeout_floor, 4 * expected) if expected else 30.0
+        t0 = pending.t0 or time.monotonic()
+        # deadline anchors at COLLECT entry: under the pipelined batcher a
+        # tick can sit queued behind earlier collects, and that wait must
+        # not be charged against the device's timeout budget.  The rate
+        # sample below still spans submit->completion (the device computed
+        # while queued, so completion-since-submit IS its latency bound);
+        # any pessimism self-corrects through the host-mode probes, which
+        # measure the raw link without queueing.
+        deadline = time.monotonic() + timeout
+        step = min(max((expected or 0.01) / 8, 2e-4), 5e-3)
+        while not out.is_ready():
+            if time.monotonic() > deadline:
+                # decay the device estimate so the arbiter flips host-side;
+                # later probes re-measure the link when it recovers
+                self.rate_dev = max((self.rate_dev or 1.0) * 0.25, 1e-6)
+                self._last_dev_meas = time.monotonic()
+                return None
+            time.sleep(step)
+        self._note_dev_rate(
+            len(pending.topics) / max(time.monotonic() - t0, 1e-9)
+        )
+        return np.asarray(out)
+
+    def _host_collect(self, pending: "_PendingMatch") -> List[List[int]]:
+        """Native host probe over the snapshot tables (hybrid data plane):
+        split+hash+probe+verify in ONE fused native call against the
+        registry (`native/registry.cc etpu_match_host_verified`)."""
+        from ..ops import native
+        from ..ops.tables import PROBE
+
+        topics = pending.topics
+        out: Optional[List[List[int]]] = None
+        snap = pending.snap
+        n = len(topics)
+        if snap is not None and n and self._reg is not None:
+            (key_a, key_b, val, log2cap, incl, k_a, k_b,
+             min_len, max_len, wild_root, valid) = snap
+            vcap = int(valid.sum())
+            if vcap:
+                tbuf, toffs = native.pack_strs(topics)
+                res = native.match_host_verified(
+                    self._reg, tbuf, toffs, n, self.space,
+                    key_a, key_b, val, log2cap, PROBE,
+                    incl, k_a, k_b, min_len, max_len, wild_root, valid,
+                    vcap,
+                )
+                if res is None:  # pragma: no cover - lib raced away
+                    return [
+                        list(s)
+                        for s in self.match_collect(
+                            self._device_submit(topics)
+                        )
+                    ]
+                fids, counts, colls = res
+                for ti, fid in colls:
+                    self._collide(topics[ti], fid)
+                fid_list = fids.tolist()
+                offs = np.zeros(n + 1, dtype=np.int64)
+                np.cumsum(counts, out=offs[1:])
+                ol = offs.tolist()
+                out = [fid_list[ol[i]:ol[i + 1]] for i in range(n)]
+        if out is None:
+            out = [[] for _ in topics]
+        self._merge_deep(pending, out)
+        return out
+
+    def _verify_slow(
+        self, topics: Sequence[str], ii: np.ndarray, fids: np.ndarray
+    ) -> List[List[int]]:
+        """Python-loop verification (no native lib / raced removals)."""
+        tmp: List[Set[int]] = [set() for _ in topics]
+        verify_pairs_into(
+            topics, ii, fids, self._words, self._fbytes, tmp, self._collide
+        )
+        return [list(s) for s in tmp]
 
     def match(self, topics: Sequence[str]) -> List[Set[int]]:
         """Match a publish batch; returns the set of fids per topic.
@@ -463,24 +756,56 @@ class TopicMatchEngine:
         topics: Sequence[str],
         ii: np.ndarray,
         fids: np.ndarray,
-        out: List[Set[int]],
+        out: List[List[int]],
     ) -> None:
-        verify_pairs_into(
-            topics, ii, fids, self._words, self._fbytes, out, self._collide
-        )
+        from ..ops import native
+
+        if self._reg is not None:
+            tbuf, toffs = native.pack_strs(topics)
+            ok = native.verify_pairs_reg(
+                self._reg, tbuf, toffs,
+                np.asarray(ii, dtype=np.int32), np.asarray(fids),
+            )
+            if ok is not None:
+                ii_l = np.asarray(ii).tolist()
+                fid_l = np.asarray(fids).tolist()
+                if ok.all():
+                    for i, f in zip(ii_l, fid_l):
+                        out[i].append(int(f))
+                else:
+                    for i, f, good in zip(ii_l, fid_l, ok.tolist()):
+                        if good:
+                            out[i].append(int(f))
+                        else:
+                            self._collide(topics[int(i)], int(f))
+                return
+        for o, s in zip(out, self._verify_slow(topics, ii, fids)):
+            o.extend(s)
 
     def match_one(self, name: str) -> Set[int]:
         return self.match([name])[0]
 
 
 class _PendingMatch:
-    """An in-flight device match (see TopicMatchEngine.match_submit)."""
+    """An in-flight match (see TopicMatchEngine.match_submit).
 
-    __slots__ = ("out", "hcap", "batch", "tables", "topics")
+    mode "device": `out` is the dispatched sparse result; `snap` enables
+    the host timeout fallback.  mode "host": only `topics` and `snap`
+    are set — the fused native probe runs at collect time."""
 
-    def __init__(self, out, hcap, batch, tables, topics):
+    __slots__ = (
+        "out", "hcap", "batch", "tables", "topics", "mode", "snap", "t0",
+        "deep",
+    )
+
+    def __init__(self, out, hcap, batch, tables, topics,
+                 mode="device", snap=None, t0=None, deep=None):
         self.out = out
         self.hcap = hcap
         self.batch = batch
         self.tables = tables  # table version this tick matched against
         self.topics = topics
+        self.mode = mode
+        self.snap = snap  # host-array snapshot (hybrid fallback/serve)
+        self.t0 = t0
+        self.deep = deep  # deep-filter hits, snapshotted at submit

@@ -25,8 +25,10 @@ _LIB_PATH = os.path.join(os.path.dirname(__file__), "libemqxtpu.so")
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SRCS = [
     os.path.join(_SRC_DIR, "matchhash.cc"),
+    os.path.join(_SRC_DIR, "registry.cc"),
     os.path.join(_SRC_DIR, "bcrypt.cc"),
 ]
+_HDRS = [os.path.join(_SRC_DIR, "pool.h")]
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -89,6 +91,34 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.etpu_verify_pairs.argtypes = [
         _u8p, _i64p, _u8p, _i64p, _i32p, ctypes.c_int32, _u8p,
     ]
+    lib.etpu_reg_new.restype = ctypes.c_void_p
+    lib.etpu_reg_new.argtypes = []
+    lib.etpu_reg_free.restype = None
+    lib.etpu_reg_free.argtypes = [ctypes.c_void_p]
+    lib.etpu_reg_count.restype = ctypes.c_int64
+    lib.etpu_reg_count.argtypes = [ctypes.c_void_p]
+    lib.etpu_reg_set_bulk.restype = None
+    lib.etpu_reg_set_bulk.argtypes = [
+        ctypes.c_void_p, _i32p, ctypes.c_int32, _u8p, _i64p,
+    ]
+    lib.etpu_reg_del_bulk.restype = None
+    lib.etpu_reg_del_bulk.argtypes = [ctypes.c_void_p, _i32p, ctypes.c_int32]
+    lib.etpu_match_host_verified.restype = ctypes.c_int64
+    lib.etpu_match_host_verified.argtypes = [
+        ctypes.c_void_p,
+        _u8p, _i64p, ctypes.c_int32,
+        ctypes.c_int32,
+        _u32p, _u32p, _u32p, _u32p,
+        _u32p, _u32p, _i32p, ctypes.c_int32, ctypes.c_int32,
+        _u32p, _u32p, _u32p, _i32p, _i32p, _u8p, _u8p,
+        ctypes.c_int32, ctypes.c_int32,
+        _i32p, _i32p, ctypes.c_int32,
+        _i32p, ctypes.c_int32, _i32p,
+    ]
+    lib.etpu_verify_pairs_reg.restype = None
+    lib.etpu_verify_pairs_reg.argtypes = [
+        ctypes.c_void_p, _u8p, _i64p, _i32p, _i32p, ctypes.c_int32, _u8p,
+    ]
     lib.etpu_bcrypt_init.restype = None
     lib.etpu_bcrypt_init.argtypes = [_u32p]
     lib.etpu_bcrypt_hash.restype = ctypes.c_int32
@@ -110,7 +140,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             if not os.path.exists(_LIB_PATH) or any(
                 os.path.exists(s)
                 and os.path.getmtime(s) > os.path.getmtime(_LIB_PATH)
-                for s in _SRCS
+                for s in _SRCS + _HDRS
             ):
                 _build()
             if os.path.exists(_LIB_PATH):
@@ -147,6 +177,17 @@ def prep_topics(
     Ca: np.ndarray, Cb: np.ndarray, Ra: np.ndarray, Rb: np.ndarray,
 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
     """Native topic-batch prep: (terms_a, terms_b, lengths, dollar) or None."""
+    out = prep_topics_packed(topics, max_levels, Ca, Cb, Ra, Rb)
+    return None if out is None else out[:4]
+
+
+def prep_topics_packed(
+    topics: List[str], max_levels: int,
+    Ca: np.ndarray, Cb: np.ndarray, Ra: np.ndarray, Rb: np.ndarray,
+):
+    """Like prep_topics, but also returns the packed utf-8 topic buffer
+    (buf, offsets) so later stages (exact-verify) reuse it instead of
+    re-encoding the batch: (ta, tb, ln, dl, buf, offsets) or None."""
     lib = get_lib()
     if lib is None:
         return None
@@ -166,7 +207,7 @@ def prep_topics(
         ta.ctypes.data_as(_u32p), tb.ctypes.data_as(_u32p),
         ln.ctypes.data_as(_i32p), dl.ctypes.data_as(_u8p),
     )
-    return ta, tb, ln, dl.astype(bool)
+    return ta, tb, ln, dl.astype(bool), buf, offsets
 
 
 class FrameScan:
@@ -205,6 +246,11 @@ def scan_frames(buf: bytes, max_size: int, max_frames: int = 256) -> Optional[Fr
 
 def _pack_strs(strs):
     return _pack_blobs([s.encode("utf-8") for s in strs])
+
+
+def pack_strs(strs):
+    """Pack strings into (buf, offsets) for the packed-batch entry points."""
+    return _pack_strs(strs)
 
 
 def filter_keys(filters, max_levels: int, space):
@@ -254,11 +300,20 @@ def verify_pairs(topic_blobs, tidx: np.ndarray, filt_blobs):
     topic_blobs: utf-8 topic strings (indexed by tidx); filt_blobs: one
     utf-8 filter string per pair.  Returns a bool array per pair, or
     None when the lib is absent (caller falls back to Python)."""
+    if get_lib() is None:
+        return None
+    tbuf, toffs = _pack_blobs(topic_blobs)
+    return verify_pairs_packed(tbuf, toffs, tidx, filt_blobs)
+
+
+def verify_pairs_packed(tbuf: np.ndarray, toffs: np.ndarray,
+                        tidx: np.ndarray, filt_blobs):
+    """verify_pairs against an already-packed topic buffer (the packed
+    batch from prep_topics_packed) — skips re-encoding the topics."""
     lib = get_lib()
     if lib is None:
         return None
     n = len(filt_blobs)
-    tbuf, toffs = _pack_blobs(topic_blobs)
     fbuf, foffs = _pack_blobs(filt_blobs)
     tidx = np.ascontiguousarray(tidx.astype(np.int32, copy=False))
     ok = np.zeros(n, dtype=np.uint8)
@@ -268,6 +323,129 @@ def verify_pairs(topic_blobs, tidx: np.ndarray, filt_blobs):
         tidx.ctypes.data_as(_i32p), n, ok.ctypes.data_as(_u8p),
     )
     return ok.astype(bool)
+
+
+class FilterRegistry:
+    """Handle on a C++-owned fid -> filter-string registry.
+
+    The registry backs inline exact-verification in the fused host match
+    (`etpu_match_host_verified`) and registry-backed device-hit verify
+    (`etpu_verify_pairs_reg`), replacing per-call Python blob assembly.
+    Freed via weakref.finalize (safe at interpreter shutdown)."""
+
+    __slots__ = ("ptr", "_finalizer", "__weakref__")
+
+    def __init__(self):
+        import weakref
+
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native lib unavailable")
+        self.ptr = lib.etpu_reg_new()
+        self._finalizer = weakref.finalize(self, lib.etpu_reg_free, self.ptr)
+
+    def set_bulk(self, fids, blobs) -> None:
+        lib = get_lib()
+        n = len(fids)
+        if n == 0:
+            return
+        buf, offs = _pack_blobs(blobs)
+        farr = np.ascontiguousarray(np.asarray(fids, dtype=np.int32))
+        lib.etpu_reg_set_bulk(
+            self.ptr, farr.ctypes.data_as(_i32p), n,
+            buf.ctypes.data_as(_u8p), offs.ctypes.data_as(_i64p),
+        )
+
+    def del_bulk(self, fids) -> None:
+        lib = get_lib()
+        n = len(fids)
+        if n == 0:
+            return
+        farr = np.ascontiguousarray(np.asarray(fids, dtype=np.int32))
+        lib.etpu_reg_del_bulk(self.ptr, farr.ctypes.data_as(_i32p), n)
+
+    def count(self) -> int:
+        return int(get_lib().etpu_reg_count(self.ptr))
+
+
+def make_registry() -> Optional[FilterRegistry]:
+    """A new native filter registry, or None when the lib is absent."""
+    if get_lib() is None:
+        return None
+    return FilterRegistry()
+
+
+def match_host_verified(
+    reg: FilterRegistry,
+    tbuf: np.ndarray, toffs: np.ndarray, B: int,
+    space,
+    key_a: np.ndarray, key_b: np.ndarray, val: np.ndarray,
+    log2cap: int, probe: int,
+    incl: np.ndarray, k_a: np.ndarray, k_b: np.ndarray,
+    min_len: np.ndarray, max_len: np.ndarray,
+    wild_root: np.ndarray, valid: np.ndarray,
+    vcap: int, coll_cap: int = 256,
+):
+    """Fused split+hash+probe+verify over a packed topic batch.
+
+    Returns (fids [total] i32 row-major by topic, counts [B] i32,
+    collisions [(topic_idx, fid), ...]) or None when the lib is absent."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    c = np.ascontiguousarray
+    L = incl.shape[1]
+    M = valid.shape[0]
+    vcap = max(vcap, 1)
+    out_fid = np.empty(B * vcap, dtype=np.int32)
+    out_cnt = np.zeros(max(B, 1), dtype=np.int32)
+    out_coll = np.zeros(2 * coll_cap, dtype=np.int32)
+    n_coll = ctypes.c_int32(0)
+    wr = c(wild_root.astype(np.uint8, copy=False))
+    vd = c(valid.astype(np.uint8, copy=False))
+    lib.etpu_match_host_verified(
+        reg.ptr,
+        c(tbuf).ctypes.data_as(_u8p), c(toffs).ctypes.data_as(_i64p), B,
+        space.max_levels,
+        c(space.C[0]).ctypes.data_as(_u32p), c(space.C[1]).ctypes.data_as(_u32p),
+        c(space.R[0]).ctypes.data_as(_u32p), c(space.R[1]).ctypes.data_as(_u32p),
+        key_a.ctypes.data_as(_u32p), key_b.ctypes.data_as(_u32p),
+        val.ctypes.data_as(_i32p), log2cap, probe,
+        c(incl).ctypes.data_as(_u32p),
+        c(k_a).ctypes.data_as(_u32p), c(k_b).ctypes.data_as(_u32p),
+        c(min_len).ctypes.data_as(_i32p), c(max_len).ctypes.data_as(_i32p),
+        wr.ctypes.data_as(_u8p), vd.ctypes.data_as(_u8p), M, L,
+        out_fid.ctypes.data_as(_i32p), out_cnt.ctypes.data_as(_i32p), vcap,
+        out_coll.ctypes.data_as(_i32p), coll_cap, ctypes.byref(n_coll),
+    )
+    cnt = out_cnt[:B]
+    mat = out_fid.reshape(B, vcap) if B else out_fid.reshape(0, vcap)
+    jj_mask = np.arange(vcap)[None, :] < cnt[:, None]
+    fids = mat[jj_mask]
+    nc = min(n_coll.value, coll_cap)
+    colls = [(int(out_coll[2 * k]), int(out_coll[2 * k + 1]))
+             for k in range(nc)]
+    return fids, cnt, colls
+
+
+def verify_pairs_reg(reg: FilterRegistry, tbuf: np.ndarray, toffs: np.ndarray,
+                     tidx: np.ndarray, fids: np.ndarray):
+    """Registry-backed exact verification of device hash hits; bool per
+    pair, or None when the lib is absent."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(fids)
+    tidx = np.ascontiguousarray(tidx.astype(np.int32, copy=False))
+    farr = np.ascontiguousarray(fids.astype(np.int32, copy=False))
+    ok = np.zeros(max(n, 1), dtype=np.uint8)
+    lib.etpu_verify_pairs_reg(
+        reg.ptr, np.ascontiguousarray(tbuf).ctypes.data_as(_u8p),
+        np.ascontiguousarray(toffs).ctypes.data_as(_i64p),
+        tidx.ctypes.data_as(_i32p), farr.ctypes.data_as(_i32p), n,
+        ok.ctypes.data_as(_u8p),
+    )
+    return ok[:n].astype(bool)
 
 
 def bulk_place(key_a: np.ndarray, key_b: np.ndarray, val: np.ndarray,

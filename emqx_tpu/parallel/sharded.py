@@ -461,29 +461,60 @@ class ShardedMatchEngine:
         return counts
 
     def match(self, topics: Sequence[str]) -> List[Set[int]]:
-        """Broker-facing match: verified fid sets per topic.
+        """Broker-facing match: verified fid sets per topic."""
+        return self.match_collect(self.match_submit(topics))
+
+    def match_submit(self, topics: Sequence[str]) -> "_ShardedPending":
+        """Dispatch the sharded match WITHOUT blocking (three-phase
+        publish contract, broker.publish_submit).  ALL engine-state
+        mutation (delta drain, restack, dest refresh) happens here on
+        the caller's thread; collect only fetches + verifies, so it is
+        executor-safe — the same contract as the single-chip engine.
 
         Uses the compact [D, B, k] device return (`sharded_match_compact`)
-        sized for dispatch; the rare per-chip overflow (one topic matching
-        more than ``kcap`` filters on a single chip) falls back to the
-        full [D, B, M] return for that batch.  Device hits are verified
-        against host filter words exactly like `TopicMatchEngine.match`.
-        """
-        out: List[Set[int]] = [set() for _ in topics]
-        if any(t.n_entries for t in self.shards):
+        sized for dispatch; the rare per-chip overflow (one topic
+        matching more than ``kcap`` filters on a single chip) falls back
+        to the full [D, B, M] return for that batch at collect time,
+        against THIS tick's tables."""
+        deep = (
+            [self._deep.match(t) & self._deep_fids for t in topics]
+            if self._deep_fids
+            else None
+        )  # snapshotted at submit: collect may run on an executor thread
+        if not any(t.n_entries for t in self.shards):
+            return _ShardedPending(None, None, None, 0, list(topics), deep)
+        stacked, _ = self.sync_device()
+        batch, n = self._prep_batch(topics)
+        hits, counts = sharded_match_compact(
+            stacked, batch, mesh=self.mesh, kcap=self.kcap
+        )
+        try:  # start the device->host copy NOW; collect overlaps it
+            hits.copy_to_host_async()
+            counts.copy_to_host_async()
+        except AttributeError:  # pragma: no cover - older jax
+            pass
+        return _ShardedPending(
+            hits, counts, (stacked, batch), n, list(topics), deep
+        )
+
+    def match_collect(self, pending: "_ShardedPending") -> List[Set[int]]:
+        return [set(x) for x in self.match_collect_raw(pending)]
+
+    def match_collect_raw(self, pending: "_ShardedPending") -> List[List[int]]:
+        """Block on a submitted sharded match; verified fid lists."""
+        topics = pending.topics
+        out: List[List[int]] = [[] for _ in topics]
+        if pending.hits is not None:
             from ..models.engine import verify_pairs_into
 
-            stacked, _ = self.sync_device()
-            batch, n = self._prep_batch(topics)
-            hits, counts = sharded_match_compact(
-                stacked, batch, mesh=self.mesh, kcap=self.kcap
-            )
-            hits = np.asarray(hits)[:, :n, :]  # [D, n, k]
-            counts = np.asarray(counts)[:, :n]  # [D, n]
+            n = pending.n
+            hits = np.asarray(pending.hits)[:, :n, :]  # [D, n, k]
+            counts = np.asarray(pending.counts)[:, :n]  # [D, n]
             k = hits.shape[2]
             over = (counts > k).any(axis=0)
             if over.any():
                 # per-chip overflow: splice in the full return for those
+                stacked, batch = pending.snap
                 full = np.asarray(
                     sharded_match_fids(stacked, batch, mesh=self.mesh)
                 )[:, :n, :]
@@ -493,21 +524,26 @@ class ShardedMatchEngine:
                         [hits, np.full(hits.shape[:2] + (pad,), -1,
                                        dtype=hits.dtype)], axis=2
                     )
+                else:
+                    hits = hits.copy()
                 hits[:, over, :] = full[:, over, :]
             _d, bb, jj = np.nonzero(hits >= 0)
             if bb.size:
                 fids = hits[_d, bb, jj]
+                tmp: List[Set[int]] = [set() for _ in topics]
                 if self.verify_matches:
                     verify_pairs_into(
                         topics, bb, fids, self._words, self._fbytes,
-                        out, self._collide,
+                        tmp, self._collide,
                     )
+                    for o, s in zip(out, tmp):
+                        o.extend(s)
                 else:
                     for i, f in zip(bb.tolist(), fids.tolist()):
-                        out[i].add(int(f))
-        if self._deep_fids:
-            for i, t in enumerate(topics):
-                out[i] |= self._deep.match(t) & self._deep_fids
+                        out[i].append(int(f))
+        if pending.deep is not None:
+            for o, hits_i in zip(out, pending.deep):
+                o.extend(hits_i)
         return out
 
     def match_one(self, name: str) -> Set[int]:
@@ -519,6 +555,7 @@ class ShardedMatchEngine:
             self.on_collision(topic, fid)
 
     def match_fids(self, topics: Sequence[str]) -> List[Set[int]]:
+        """Full unverified [D, B, M] fid sets (tests/debug)."""
         stacked, _ = self.sync_device()
         batch, n = self._prep_batch(topics)
         out = np.asarray(sharded_match_fids(stacked, batch, mesh=self.mesh))
@@ -530,3 +567,17 @@ class ShardedMatchEngine:
             for i, t in enumerate(topics):
                 res[i] |= self._deep.match(t) & self._deep_fids
         return res
+
+
+class _ShardedPending:
+    """An in-flight sharded match (see ShardedMatchEngine.match_submit)."""
+
+    __slots__ = ("hits", "counts", "snap", "n", "topics", "deep")
+
+    def __init__(self, hits, counts, snap, n, topics, deep=None):
+        self.hits = hits
+        self.counts = counts
+        self.snap = snap  # (stacked, batch) of THIS tick, for overflow
+        self.n = n
+        self.topics = topics
+        self.deep = deep  # deep-filter hits, snapshotted at submit

@@ -1,0 +1,96 @@
+// Persistent worker pool for the native host hot paths.
+//
+// std::thread spawn costs ~20-50us; the match/hash entry points are called
+// per publish tick (ms scale), so re-spawning 8-16 threads per call wastes
+// a measurable slice of the latency budget.  This pool keeps detached
+// workers parked on a condition variable and hands them chunked index
+// ranges via an atomic cursor.  The singleton is never destroyed (detached
+// threads + intentional leak), so there is no shutdown race with the
+// C++ runtime at interpreter exit.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+class EtpuPool {
+ public:
+  static EtpuPool& inst() {
+    static EtpuPool* p = new EtpuPool();  // never destroyed by design
+    return *p;
+  }
+
+  // Run fn(i0, i1) over [0, n) in chunks; blocks until all chunks finish.
+  // The calling thread participates, so small jobs never context-switch.
+  void parallel_for(int32_t n, int32_t chunk,
+                    const std::function<void(int32_t, int32_t)>& fn) {
+    if (n <= 0) return;
+    if (n <= chunk || nworkers_ == 0) {
+      fn(0, n);
+      return;
+    }
+    std::unique_lock<std::mutex> job_lk(job_mutex_);  // one job at a time
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      fn_ = &fn;
+      n_ = n;
+      chunk_ = chunk;
+      cursor_.store(0, std::memory_order_relaxed);
+      pending_.store(nworkers_, std::memory_order_relaxed);
+      generation_++;
+    }
+    cv_.notify_all();
+    work();  // caller takes chunks too
+    // wait for workers to drain (they decrement pending_ when the cursor
+    // runs out)
+    std::unique_lock<std::mutex> lk(m_);
+    done_cv_.wait(lk, [&] { return pending_.load() == 0; });
+    fn_ = nullptr;
+  }
+
+ private:
+  EtpuPool() {
+    unsigned hw = std::thread::hardware_concurrency();
+    nworkers_ = hw > 16 ? 15 : (hw > 1 ? (int32_t)hw - 1 : 0);
+    for (int32_t i = 0; i < nworkers_; i++) {
+      std::thread([this, gen = 0]() mutable {
+        while (true) {
+          {
+            std::unique_lock<std::mutex> lk(m_);
+            cv_.wait(lk, [&] { return generation_ != gen; });
+            gen = generation_;
+          }
+          work();
+          if (pending_.fetch_sub(1) == 1) {
+            std::lock_guard<std::mutex> lk(m_);
+            done_cv_.notify_all();
+          }
+        }
+      }).detach();
+    }
+  }
+
+  void work() {
+    const std::function<void(int32_t, int32_t)>* fn = fn_;
+    if (!fn) return;
+    while (true) {
+      int32_t i0 = cursor_.fetch_add(chunk_, std::memory_order_relaxed);
+      if (i0 >= n_) break;
+      int32_t i1 = i0 + chunk_ > n_ ? n_ : i0 + chunk_;
+      (*fn)(i0, i1);
+    }
+  }
+
+  std::mutex job_mutex_;
+  std::mutex m_;
+  std::condition_variable cv_, done_cv_;
+  const std::function<void(int32_t, int32_t)>* fn_ = nullptr;
+  int32_t n_ = 0, chunk_ = 1, nworkers_ = 0;
+  uint64_t generation_ = 0;
+  std::atomic<int32_t> cursor_{0};
+  std::atomic<int32_t> pending_{0};
+};

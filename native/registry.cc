@@ -1,0 +1,278 @@
+// Native filter registry + fused host match pipeline.
+//
+// The reference keeps its route/trie tables in ETS (C-implemented shared
+// tables behind the BEAM); the analog here is a C++-owned fid -> filter
+// string registry plus a single-call host match pipeline that does
+// split + hash + table probe + exact verification in one threaded pass
+// over a packed topic batch.  This is the data plane of the hybrid
+// host/device arbitration (models/engine.py): when the host<->device
+// link is degraded the broker matches here, at memory speed, with the
+// same table arrays the device mirrors.
+//
+// Concurrency: the broker mutates the registry from its event-loop
+// thread while match batches run on an executor thread; a shared_mutex
+// gives writers exclusivity and match batches shared access.  Slot
+// writes to the table arrays themselves are benign dirty reads (same
+// semantics as concurrent ETS mutation in the reference's router).
+
+#include <cstdint>
+#include <cstring>
+#include <shared_mutex>
+#include <string>
+#include <vector>
+
+#include "pool.h"
+
+namespace {
+
+struct Registry {
+  std::vector<std::string> strs;  // by fid ("" = absent)
+  std::vector<uint8_t> present;
+  std::shared_mutex mu;
+};
+
+// ---- shared helpers (semantics identical to matchhash.cc) ----
+
+static const uint64_t FNV_OFFSET = 0xcbf29ce484222325ULL;
+static const uint64_t FNV_PRIME = 0x100000001b3ULL;
+static const uint64_t PERTURB = 0xD6E8FEB86659FD93ULL;
+
+static inline uint64_t fnv1a64(const uint8_t* s, uint64_t n) {
+  uint64_t h = FNV_OFFSET;
+  for (uint64_t i = 0; i < n; i++) {
+    h ^= (uint64_t)s[i];
+    h *= FNV_PRIME;
+  }
+  return h;
+}
+
+// Exact MQTT topic-vs-filter match (broker/topic.py match_words semantics;
+// mirror of the logic in matchhash.cc etpu_verify_pairs).
+static bool topic_matches(const uint8_t* t, int64_t tn,
+                          const uint8_t* f, int64_t fn) {
+  int64_t ti = 0, fi = 0;
+  bool first = true;
+  while (true) {
+    int64_t fe = fi;
+    while (fe < fn && f[fe] != '/') fe++;
+    int64_t flen = fe - fi;
+    bool f_hash = (flen == 1 && f[fi] == '#');
+    bool f_plus = (flen == 1 && f[fi] == '+');
+    if (first && tn > 0 && t[0] == '$' && (f_hash || f_plus)) return false;
+    first = false;
+    if (f_hash) return true;
+    if (ti > tn) return false;
+    int64_t te = ti;
+    while (te < tn && t[te] != '/') te++;
+    if (!f_plus) {
+      if (te - ti != flen || std::memcmp(t + ti, f + fi, flen) != 0)
+        return false;
+    }
+    ti = te + 1;
+    fi = fe + 1;
+    bool t_done = ti > tn;
+    bool f_done = fi > fn;
+    if (f_done) return t_done;
+    if (t_done) {
+      int64_t ge = fi;
+      while (ge < fn && f[ge] != '/') ge++;
+      return (ge - fi == 1 && f[fi] == '#');
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* etpu_reg_new() { return new Registry(); }
+
+void etpu_reg_free(void* h) { delete (Registry*)h; }
+
+int64_t etpu_reg_count(void* h) {
+  Registry* r = (Registry*)h;
+  std::shared_lock<std::shared_mutex> lk(r->mu);
+  int64_t n = 0;
+  for (uint8_t p : r->present) n += p;
+  return n;
+}
+
+// Bulk insert/overwrite: fids[i] <- buf[offs[i]:offs[i+1]]
+void etpu_reg_set_bulk(void* h, const int32_t* fids, int32_t n,
+                       const uint8_t* buf, const int64_t* offs) {
+  Registry* r = (Registry*)h;
+  std::unique_lock<std::shared_mutex> lk(r->mu);
+  int32_t maxfid = -1;
+  for (int32_t i = 0; i < n; i++)
+    if (fids[i] > maxfid) maxfid = fids[i];
+  if (maxfid >= (int32_t)r->strs.size()) {
+    size_t cap = r->strs.size() ? r->strs.size() : 1024;
+    while ((int32_t)cap <= maxfid) cap *= 2;
+    r->strs.resize(cap);
+    r->present.resize(cap, 0);
+  }
+  for (int32_t i = 0; i < n; i++) {
+    r->strs[fids[i]].assign((const char*)(buf + offs[i]),
+                            (size_t)(offs[i + 1] - offs[i]));
+    r->present[fids[i]] = 1;
+  }
+}
+
+void etpu_reg_del_bulk(void* h, const int32_t* fids, int32_t n) {
+  Registry* r = (Registry*)h;
+  std::unique_lock<std::shared_mutex> lk(r->mu);
+  for (int32_t i = 0; i < n; i++) {
+    int32_t fid = fids[i];
+    if (fid >= 0 && fid < (int32_t)r->strs.size()) {
+      r->strs[fid].clear();
+      r->strs[fid].shrink_to_fit();
+      r->present[fid] = 0;
+    }
+  }
+}
+
+// ---------------------------------------------------- fused host pipeline
+//
+// One threaded pass per topic: split on '/', hash levels, enumerate valid
+// shapes, probe the open-addressed table, and exact-verify each hit
+// against the registry string — emitting only verified fids.
+//
+//   out_fid   [B * vcap] verified fids, row-major per topic
+//   out_cnt   [B] verified hits per topic
+//   out_coll  [2 * coll_cap] (topic_idx, fid) refuted/raced pairs
+//   n_coll    out: refuted pair count (may exceed coll_cap; excess dropped)
+//
+// Returns total verified hits.
+int64_t etpu_match_host_verified(
+    void* reg_h,
+    const uint8_t* tbuf, const int64_t* toffs, int32_t B,
+    int32_t max_levels,
+    const uint32_t* Ca, const uint32_t* Cb,
+    const uint32_t* Ra, const uint32_t* Rb,
+    const uint32_t* key_a, const uint32_t* key_b, const int32_t* val,
+    int32_t log2cap, int32_t probe,
+    const uint32_t* incl, const uint32_t* k_a, const uint32_t* k_b,
+    const int32_t* min_len, const int32_t* max_len,
+    const uint8_t* wild_root, const uint8_t* valid, int32_t M, int32_t L,
+    int32_t* out_fid, int32_t* out_cnt, int32_t vcap,
+    int32_t* out_coll, int32_t coll_cap, int32_t* n_coll) {
+  Registry* reg = (Registry*)reg_h;
+  std::shared_lock<std::shared_mutex> reg_lk(reg->mu);
+  const uint32_t MIX1 = 0x85EBCA77u, MIX2 = 0x9E3779B1u;
+  const uint32_t cap_mask = (1u << log2cap) - 1;
+  std::atomic<int32_t> coll_cursor{0};
+
+  // valid shape rows, hoisted once (M can exceed the live shape count)
+  std::vector<int32_t> vshapes;
+  vshapes.reserve(M);
+  for (int32_t m = 0; m < M; m++)
+    if (valid[m]) vshapes.push_back(m);
+
+  EtpuPool::inst().parallel_for(B, 64, [&](int32_t i0, int32_t i1) {
+    std::vector<uint32_t> terms_a(L), terms_b(L);
+    std::vector<uint32_t> homes(vshapes.size()), has(vshapes.size()),
+        hbs(vshapes.size());
+    for (int32_t i = i0; i < i1; i++) {
+      const uint8_t* t = tbuf + toffs[i];
+      int64_t tn = toffs[i + 1] - toffs[i];
+      bool dol = (tn > 0 && t[0] == '$');
+      // split + hash levels
+      for (int32_t l = 0; l < L; l++) terms_a[l] = terms_b[l] = 0;
+      int32_t level = 0;
+      int64_t start = 0;
+      for (int64_t p = 0; p <= tn; p++) {
+        if (p == tn || t[p] == '/') {
+          if (level < L) {
+            uint64_t h = fnv1a64(t + start, (uint64_t)(p - start)) ^ PERTURB;
+            terms_a[level] = ((uint32_t)h ^ Ca[level]) * Ra[level];
+            terms_b[level] = ((uint32_t)(h >> 32) ^ Cb[level]) * Rb[level];
+          }
+          level++;
+          start = p + 1;
+        }
+      }
+      int32_t len = (tn == 0) ? 1 : level;
+      // candidate shapes: length/dollar filters + hash combine
+      int32_t ncand = 0;
+      for (int32_t c = 0; c < (int32_t)vshapes.size(); c++) {
+        int32_t m = vshapes[c];
+        if (len < min_len[m] || len > max_len[m]) continue;
+        if (dol && wild_root[m]) continue;
+        const uint32_t* row = incl + (int64_t)m * L;
+        uint32_t ha = k_a[m], hb = k_b[m];
+        for (int32_t l = 0; l < L; l++) {
+          ha += terms_a[l] * row[l];
+          hb += terms_b[l] * row[l];
+        }
+        uint32_t home = ((ha + hb * MIX1) * MIX2) >> (32 - log2cap);
+        __builtin_prefetch(val + home);
+        __builtin_prefetch(key_a + home);
+        __builtin_prefetch(key_b + home);
+        homes[ncand] = home;
+        has[ncand] = ha;
+        hbs[ncand] = hb;
+        ncand++;
+      }
+      // probe + inline exact verification
+      int32_t* row_out = out_fid + (int64_t)i * vcap;
+      int32_t nhit = 0;
+      for (int32_t c = 0; c < ncand; c++) {
+        uint32_t home = homes[c], ha = has[c], hb = hbs[c];
+        for (int32_t off = 0; off < probe; off++) {
+          uint32_t slot = (home + (uint32_t)off) & cap_mask;
+          int32_t v = val[slot];
+          if (v >= 0 && key_a[slot] == ha && key_b[slot] == hb) {
+            bool ok = false;
+            if (v < (int32_t)reg->strs.size() && reg->present[v]) {
+              const std::string& f = reg->strs[v];
+              ok = topic_matches(t, tn, (const uint8_t*)f.data(),
+                                 (int64_t)f.size());
+            }
+            if (ok) {
+              if (nhit < vcap) row_out[nhit++] = v;
+            } else {
+              int32_t k = coll_cursor.fetch_add(1);
+              if (k < coll_cap) {
+                out_coll[2 * k] = i;
+                out_coll[2 * k + 1] = v;
+              }
+            }
+            break;  // one hit per shape, like the device kernel
+          }
+        }
+      }
+      out_cnt[i] = nhit;
+    }
+  });
+  *n_coll = coll_cursor.load();
+  int64_t total = 0;
+  for (int32_t i = 0; i < B; i++) total += out_cnt[i];
+  return total;
+}
+
+// Registry-backed exact verification for DEVICE hash hits: same contract
+// as etpu_verify_pairs but the filter strings come from the registry (no
+// per-call Python blob assembly).
+void etpu_verify_pairs_reg(
+    void* reg_h, const uint8_t* tbuf, const int64_t* toffs,
+    const int32_t* tidx, const int32_t* fids, int32_t n_pairs,
+    uint8_t* out_ok) {
+  Registry* reg = (Registry*)reg_h;
+  std::shared_lock<std::shared_mutex> lk(reg->mu);
+  EtpuPool::inst().parallel_for(n_pairs, 256, [&](int32_t p0, int32_t p1) {
+    for (int32_t p = p0; p < p1; p++) {
+      const uint8_t* t = tbuf + toffs[tidx[p]];
+      int64_t tn = toffs[tidx[p] + 1] - toffs[tidx[p]];
+      int32_t fid = fids[p];
+      bool ok = false;
+      if (fid >= 0 && fid < (int32_t)reg->strs.size() && reg->present[fid]) {
+        const std::string& f = reg->strs[fid];
+        ok = topic_matches(t, tn, (const uint8_t*)f.data(),
+                           (int64_t)f.size());
+      }
+      out_ok[p] = ok ? 1 : 0;
+    }
+  });
+}
+
+}  // extern "C"

@@ -1,0 +1,199 @@
+"""Hybrid host/device match arbitration (models/engine.py).
+
+The reference never pays a wire to match (`emqx_router.erl:127-140`);
+these tests pin the engine's equivalent guarantee: identical results on
+both paths, automatic switching by measured rates, timeout fallback when
+a device-served batch stalls, and device-mirror warm-keeping probes.
+"""
+
+import time
+
+import pytest
+
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.models.engine import TopicMatchEngine
+from emqx_tpu.models.reference import CpuTrieIndex
+from emqx_tpu.ops import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="hybrid host path requires the native lib"
+)
+
+
+def _population(n=3000):
+    import random
+
+    rng = random.Random(7)
+    filters, topics = [], []
+    for i in range(n):
+        ws = ["plant", str(rng.randint(0, 40)), "line", str(i)]
+        r = rng.random()
+        if r < 0.25:
+            ws[rng.choice([1, 3])] = "+"
+        elif r < 0.35:
+            ws = ws[: rng.randint(1, 3)] + ["#"]
+        f = "/".join(ws)
+        filters.append(f)
+    seen, out = set(), []
+    for i, f in enumerate(filters):
+        if f in seen:
+            f += f"/u{i}"
+        seen.add(f)
+        out.append(f)
+    for _ in range(500):
+        topics.append(
+            f"plant/{rng.randint(0, 40)}/line/{rng.randint(0, n)}"
+        )
+    topics += ["$SYS/broker/load", "plant/1/line/2/extra", "a//b", ""]
+    return out, topics
+
+
+def _engine(filters):
+    eng = TopicMatchEngine()
+    fids = eng.add_filters(filters)
+    return eng, fids
+
+
+def test_host_device_parity_and_oracle():
+    filters, topics = _population()
+    eng, fids = _engine(filters)
+    oracle = CpuTrieIndex()
+    for f, fid in zip(filters, fids):
+        oracle.insert(f, fid)
+
+    dev = eng.match(topics)  # hybrid off: device path
+
+    eng.hybrid = True
+    eng.probe_interval = 1e9
+    eng.rate_dev = 1.0
+    eng._last_dev_meas = time.monotonic()
+    eng.rate_host = 1e9  # force host
+    pend = eng.match_submit(topics)
+    assert pend.mode == "host"
+    host = eng.match_collect(pend)
+
+    for i, t in enumerate(topics):
+        expect = oracle.match(t)
+        assert dev[i] == expect, (t, dev[i], expect)
+        assert host[i] == expect, (t, host[i], expect)
+
+
+def test_parity_across_switch_with_churn():
+    """Mutations applied while the host path serves must be visible on
+    both paths afterwards (mirror kept warm via probes/deltas)."""
+    filters, topics = _population(800)
+    eng, _ = _engine(filters)
+    eng.hybrid = True
+    eng.probe_interval = 1e9
+    eng.rate_dev = 1.0
+    eng._last_dev_meas = time.monotonic()
+    eng.rate_host = 1e9
+
+    eng.add_filter("hot/new/+")
+    eng.remove_filter(filters[0])
+    host = eng.match_collect(eng.match_submit(topics + ["hot/new/x"]))
+    assert eng.fid_of("hot/new/+") in host[-1]
+
+    # flip to device: same results
+    eng.hybrid = False
+    dev = eng.match(topics + ["hot/new/x"])
+    assert dev == host
+
+
+def test_arbitration_prefers_faster_path():
+    filters, topics = _population(500)
+    eng, _ = _engine(filters)
+    eng.hybrid = True
+    eng.probe_interval = 1e9
+    now = time.monotonic()
+    eng._last_dev_meas = eng._last_host_meas = now
+
+    eng.rate_host = 1e6
+    eng.rate_dev = 1e3
+    assert eng.match_submit(topics).mode == "host"
+
+    eng.rate_host = 1e3
+    eng.rate_dev = 1e6
+    assert eng.match_submit(topics).mode == "device"
+
+
+def test_rates_unknown_serves_host_and_probes_device():
+    filters, topics = _population(300)
+    eng, _ = _engine(filters)
+    eng.hybrid = True
+    pend = eng.match_submit(topics)
+    assert pend.mode == "host"  # unknown rates: host first, probe device
+    assert eng._probe is not None  # probe dispatched
+    eng.match_collect(pend)
+    assert eng.rate_host is not None
+    # wait for the probe result and harvest it on a later submit
+    deadline = time.time() + 30
+    while eng._probe is not None and time.time() < deadline:
+        eng._poll_probe()
+        time.sleep(0.01)
+    assert eng.rate_dev is not None
+
+
+class _NeverReady:
+    def is_ready(self):
+        return False
+
+
+def test_device_timeout_falls_back_to_host():
+    """A stalled device fetch must not block the tick: the host path
+    serves the same batch from the submit-time snapshot."""
+    filters, topics = _population(400)
+    eng, fids = _engine(filters)
+    oracle = CpuTrieIndex()
+    for f, fid in zip(filters, fids):
+        oracle.insert(f, fid)
+
+    eng.hybrid = True
+    eng.probe_interval = 1e9
+    eng.rate_dev = 1e9  # device believed fast: device serves
+    eng.rate_host = 1.0
+    eng._last_dev_meas = eng._last_host_meas = time.monotonic()
+    eng.dev_timeout_floor = 0.05
+
+    pend = eng.match_submit(topics)
+    assert pend.mode == "device"
+    pend.out = _NeverReady()  # simulate a wedged transfer
+    t0 = time.time()
+    res = eng.match_collect(pend)
+    assert time.time() - t0 < 5.0
+    assert eng.dev_timeout_count == 1
+    assert eng.rate_dev < 1e9  # decayed: arbiter flips host-side
+    for i, t in enumerate(topics):
+        assert res[i] == oracle.match(t)
+
+
+def test_broker_hybrid_end_to_end():
+    """Broker publish through the host-serving engine delivers exactly
+    like the device path."""
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.message import Message
+
+    seen = []
+
+    class _Sink:
+        def __init__(self, cid):
+            self.clientid = cid
+
+        def deliver(self, delivers):
+            seen.extend((self.clientid, f) for f, _ in delivers)
+
+        def kick(self, rc):
+            pass
+
+    b = Broker()
+    b.engine.hybrid = True
+    b.engine.probe_interval = 1e9
+    b.engine.rate_dev = 1.0
+    b.engine._last_dev_meas = time.monotonic()
+    b.engine.rate_host = 1e9
+    for cid, f in [("c1", "s/+/t"), ("c2", "s/1/t"), ("c3", "other/#")]:
+        b.cm.channels[cid] = _Sink(cid)
+        b.subscribe(cid, f, SubOpts(qos=0))
+    n = b.publish(Message(topic="s/1/t", payload=b"x"))
+    assert n == 2
+    assert sorted(seen) == [("c1", "s/+/t"), ("c2", "s/1/t")]

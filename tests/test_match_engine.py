@@ -200,9 +200,12 @@ def test_injected_collision_detected():
 
     # simulate a lane collision: device table still hashes the original
     # filter, but pretend fid actually belongs to an unrelated filter
-    # (_words drives the Python verifier, _fbytes the native one)
+    # (_words drives the Python verifier, _fbytes the blob-based native
+    # one, the registry the fused/registry-backed native one)
     eng._words[fid] = ["not", "related"]
     eng._fbytes[fid] = b"not/related"
+    if eng._reg is not None:
+        eng._reg.set_bulk([fid], [b"not/related"])
     assert eng.match(["sensors/3/temp"])[0] == set()
     assert eng.collision_count == 1
     assert hits == [("sensors/3/temp", fid)]
@@ -221,6 +224,8 @@ def test_broker_counts_collisions():
     fid = b.engine.fid_of("a/+")
     b.engine._words[fid] = ["mismatch"]
     b.engine._fbytes[fid] = b"mismatch"
+    if b.engine._reg is not None:
+        b.engine._reg.set_bulk([fid], [b"mismatch"])
     from emqx_tpu.broker.message import Message
 
     assert b.publish(Message(topic="a/1", payload=b"x")) == 0
