@@ -185,21 +185,104 @@ class TopicMatchEngine:
         ws = topiclib.words(filt)
         self._fids[filt] = fid
         self._refs[fid] = 1
-        self._words[fid] = ws
-        self._fbytes[fid] = filt.encode("utf-8")
         if self._is_deep(ws):
+            self._words[fid] = ws
+            self._fbytes[fid] = filt.encode("utf-8")
             self._deep.insert(filt, fid)
             self._deep_fids.add(fid)
         else:
             self.tables.insert(ws, fid)
             if self._reg is not None:
-                self._reg.set_bulk([fid], [self._fbytes[fid]])
+                # registry owns the string (inline verify); the Python
+                # dicts stay empty for table-resident filters
+                self._reg.set_bulk([fid], [filt.encode("utf-8")])
+            else:
+                self._words[fid] = ws
+                self._fbytes[fid] = filt.encode("utf-8")
         self.epoch += 1
         return fid
 
     def add_filters(self, filts: Sequence[str]) -> List[int]:
         """Bulk add (route-table bootstrap): one native key pass + one
-        device rebuild instead of len(filts) incremental inserts."""
+        device rebuild instead of len(filts) incremental inserts.
+
+        With the native registry present, per-filter Python bookkeeping
+        is the insert-rate ceiling, so the fast path keeps it to the
+        refcount dicts only: no words() split, no utf-8 encode, no
+        _words/_fbytes entries for table-resident filters (the registry
+        owns their strings; deep filters keep the Python-side state
+        their trie fallback needs)."""
+        from ..ops import native
+
+        if self._reg is None or len(filts) < 512:
+            return self._add_filters_slow(filts)
+        fids: List[int] = []
+        new_strs: List[str] = []
+        new_fids: List[int] = []
+        _fids = self._fids
+        refs = self._refs
+        free = self._free_fids
+        nxt = self._next_fid
+        fids_append = fids.append
+        strs_append = new_strs.append
+        nfids_append = new_fids.append
+        for filt in filts:
+            fid = _fids.get(filt)
+            if fid is not None:
+                refs[fid] += 1
+                fids_append(fid)
+                continue
+            if free:
+                fid = free.pop()
+            else:
+                fid = nxt
+                nxt += 1
+            _fids[filt] = fid
+            refs[fid] = 1
+            fids_append(fid)
+            strs_append(filt)
+            nfids_append(fid)
+        self._next_fid = nxt
+        if new_strs:
+            keys = native.filter_keys_packed(
+                new_strs, self.space.max_levels, self.space
+            )
+            ha, hb, plen, plus_mask, has_hash, buf, offs = keys
+            deep_mask = plen > self.space.max_levels
+            if deep_mask.any():
+                for k in np.nonzero(deep_mask)[0].tolist():
+                    filt, fid = new_strs[k], new_fids[k]
+                    ws = topiclib.words(filt)
+                    self._words[fid] = ws
+                    self._fbytes[fid] = filt.encode("utf-8")
+                    self._deep.insert(filt, fid)
+                    self._deep_fids.add(fid)
+                keep = np.nonzero(~deep_mask)[0]
+                kl = keep.tolist()
+                shallow_strs = [new_strs[k] for k in kl]
+                shallow_fids = [new_fids[k] for k in kl]
+                ha, hb, plen, plus_mask, has_hash = (
+                    a[keep] for a in (ha, hb, plen, plus_mask, has_hash)
+                )
+                if shallow_fids:
+                    self.tables.bulk_insert_keys(
+                        shallow_fids, ha, hb, plen, plus_mask, has_hash
+                    )
+                    self._reg.set_bulk(
+                        shallow_fids,
+                        [s.encode("utf-8") for s in shallow_strs],
+                    )
+            else:
+                self.tables.bulk_insert_keys(
+                    new_fids, ha, hb, plen, plus_mask, has_hash
+                )
+                self._reg.set_bulk_packed(new_fids, buf, offs)
+        self.epoch += 1
+        return fids
+
+    def _add_filters_slow(self, filts: Sequence[str]) -> List[int]:
+        """Bulk add without the native registry (pure-Python verify state
+        maintained per filter), or for small batches."""
         fids: List[int] = []
         new_strs: List[str] = []
         new_fids: List[int] = []
@@ -241,8 +324,8 @@ class TopicMatchEngine:
             return None
         del self._refs[fid]
         del self._fids[filt]
-        del self._words[fid]
-        del self._fbytes[fid]
+        self._words.pop(fid, None)
+        self._fbytes.pop(fid, None)
         if fid in self._deep_fids:
             self._deep_fids.discard(fid)
             self._deep.delete(filt, fid)
@@ -277,7 +360,7 @@ class TopicMatchEngine:
                 continue
             del self._refs[fid]
             del self._fids[filt]
-            ws = self._words.pop(fid)
+            self._words.pop(fid, None)
             self._fbytes.pop(fid, None)
             if fid in self._deep_fids:
                 self._deep_fids.discard(fid)
@@ -293,32 +376,73 @@ class TopicMatchEngine:
         new_strs: List[str] = []
         new_fids: List[int] = []
         new_words: List[List[str]] = []
+        has_reg = self._reg is not None
         for filt in adds:
             fid = self._fids.get(filt)
             if fid is not None:
                 self._refs[fid] += 1
                 out.append(fid)
                 continue
-            ws = topiclib.words(filt)
             fid = self._free_fids.pop() if self._free_fids else self._alloc_fid()
             self._fids[filt] = fid
             self._refs[fid] = 1
-            self._words[fid] = ws
-            self._fbytes[fid] = filt.encode("utf-8")
-            if self._is_deep(ws):
-                self._deep.insert(filt, fid)
-                self._deep_fids.add(fid)
-            else:
+            if has_reg:
+                # deep routing + key computation happen in one native
+                # batch pass below — no per-filter words()/encode here
                 new_strs.append(filt)
                 new_fids.append(fid)
-                new_words.append(ws)
+            else:
+                ws = topiclib.words(filt)
+                if self._is_deep(ws):
+                    self._words[fid] = ws
+                    self._fbytes[fid] = filt.encode("utf-8")
+                    self._deep.insert(filt, fid)
+                    self._deep_fids.add(fid)
+                else:
+                    self._words[fid] = ws
+                    self._fbytes[fid] = filt.encode("utf-8")
+                    new_strs.append(filt)
+                    new_fids.append(fid)
+                    new_words.append(ws)
             out.append(fid)
         if new_strs:
-            self.tables.churn_insert(new_strs, new_fids, words=new_words)
-            if self._reg is not None:
-                self._reg.set_bulk(
-                    new_fids, [self._fbytes[f] for f in new_fids]
+            if has_reg:
+                from ..ops import native
+
+                keys = native.filter_keys_packed(
+                    new_strs, self.space.max_levels, self.space
                 )
+                ha, hb, plen, plus_mask, has_hash, buf, offs = keys
+                deep_mask = plen > self.space.max_levels
+                if deep_mask.any():
+                    for k in np.nonzero(deep_mask)[0].tolist():
+                        filt, fid = new_strs[k], new_fids[k]
+                        ws = topiclib.words(filt)
+                        self._words[fid] = ws
+                        self._fbytes[fid] = filt.encode("utf-8")
+                        self._deep.insert(filt, fid)
+                        self._deep_fids.add(fid)
+                    keep = np.nonzero(~deep_mask)[0]
+                    kl = keep.tolist()
+                    sh_strs = [new_strs[k] for k in kl]
+                    sh_fids = [new_fids[k] for k in kl]
+                    ha, hb, plen, plus_mask, has_hash = (
+                        a[keep] for a in (ha, hb, plen, plus_mask, has_hash)
+                    )
+                    if sh_fids:
+                        self.tables.churn_insert_keys(
+                            sh_fids, ha, hb, plen, plus_mask, has_hash
+                        )
+                        self._reg.set_bulk(
+                            sh_fids, [s.encode("utf-8") for s in sh_strs]
+                        )
+                else:
+                    self.tables.churn_insert_keys(
+                        new_fids, ha, hb, plen, plus_mask, has_hash
+                    )
+                    self._reg.set_bulk_packed(new_fids, buf, offs)
+            else:
+                self.tables.churn_insert(new_strs, new_fids, words=new_words)
         self.epoch += 1
         return out
 

@@ -256,6 +256,14 @@ def pack_strs(strs):
 def filter_keys(filters, max_levels: int, space):
     """Native batch filter_key: (ha, hb, plen, plus_mask, has_hash) arrays,
     or None when the lib is absent."""
+    out = filter_keys_packed(filters, max_levels, space)
+    return None if out is None else out[:5]
+
+
+def filter_keys_packed(filters, max_levels: int, space):
+    """filter_keys that also returns the packed utf-8 buffer
+    (..., buf, offsets) so callers can feed the registry without
+    re-encoding the batch."""
     lib = get_lib()
     if lib is None:
         return None
@@ -279,7 +287,7 @@ def filter_keys(filters, max_levels: int, space):
         plen.ctypes.data_as(_i32p), plus_mask.ctypes.data_as(_u32p),
         has_hash.ctypes.data_as(_u8p),
     )
-    return ha, hb, plen, plus_mask, has_hash.astype(bool)
+    return ha, hb, plen, plus_mask, has_hash.astype(bool), buf, offsets
 
 
 def _pack_blobs(blobs):
@@ -345,15 +353,23 @@ class FilterRegistry:
         self._finalizer = weakref.finalize(self, lib.etpu_reg_free, self.ptr)
 
     def set_bulk(self, fids, blobs) -> None:
+        if len(fids) == 0:
+            return
+        buf, offs = _pack_blobs(blobs)
+        self.set_bulk_packed(fids, buf, offs)
+
+    def set_bulk_packed(self, fids, buf: np.ndarray, offs: np.ndarray) -> None:
+        """set_bulk from an already-packed blob buffer (e.g. the packed
+        batch filter_keys_packed produced) — no re-encode, no re-join."""
         lib = get_lib()
         n = len(fids)
         if n == 0:
             return
-        buf, offs = _pack_blobs(blobs)
         farr = np.ascontiguousarray(np.asarray(fids, dtype=np.int32))
         lib.etpu_reg_set_bulk(
             self.ptr, farr.ctypes.data_as(_i32p), n,
-            buf.ctypes.data_as(_u8p), offs.ctypes.data_as(_i64p),
+            np.ascontiguousarray(buf).ctypes.data_as(_u8p),
+            np.ascontiguousarray(offs).ctypes.data_as(_i64p),
         )
 
     def del_bulk(self, fids) -> None:

@@ -108,8 +108,15 @@ class MatchTables:
         # shape -> (descriptor index, refcount)
         self._shapes: Dict[Shape, Tuple[int, int]] = {}
         self._free_desc: List[int] = list(range(desc_cap - 1, -1, -1))
-        # fid -> (ha, hb, shape) for rebuilds and deletes
-        self._entries: Dict[int, Tuple[int, int, Shape]] = {}
+        self._desc_shape: List[Optional[Shape]] = [None] * desc_cap
+        # per-fid entry bookkeeping as ARRAYS (a python dict of tuples
+        # costs ~1 us/insert and ~150 B/entry at 10M routes — the former
+        # round-3 insert bottleneck): key lanes + descriptor index, -1 =
+        # absent, grown by doubling over the max fid seen
+        self._ent_cap = 1024
+        self.ent_ha = np.zeros(self._ent_cap, dtype=np.uint32)
+        self.ent_hb = np.zeros(self._ent_cap, dtype=np.uint32)
+        self.ent_desc = np.full(self._ent_cap, -1, dtype=np.int32)
         self.delta = Delta()
 
     # ------------------------------------------------------------- shapes
@@ -131,6 +138,7 @@ class MatchTables:
         if not self._free_desc:
             raise GrowNeeded("descriptor block full")
         idx = self._free_desc.pop()
+        self._desc_shape[idx] = shape
         ka, kb = self.space.shape_const(shape)
         self.incl[idx] = self._shape_incl_row(shape)
         self.k_a[idx] = ka
@@ -150,8 +158,23 @@ class MatchTables:
             return
         del self._shapes[shape]
         self.valid[idx] = False
+        self._desc_shape[idx] = None
         self._free_desc.append(idx)
         self.delta.desc_dirty = True
+
+    def _ensure_ent_cap(self, max_fid: int) -> None:
+        if max_fid < self._ent_cap:
+            return
+        cap = self._ent_cap
+        while cap <= max_fid:
+            cap *= 2
+        for name in ("ent_ha", "ent_hb", "ent_desc"):
+            arr = getattr(self, name)
+            new = np.full(cap, -1, dtype=arr.dtype) if name == "ent_desc" \
+                else np.zeros(cap, dtype=arr.dtype)
+            new[: self._ent_cap] = arr
+            setattr(self, name, new)
+        self._ent_cap = cap
 
     @property
     def n_shapes(self) -> int:
@@ -207,10 +230,55 @@ class MatchTables:
                         "must refcount per unique filter (models/engine.py)"
                         % PROBE)
                 self._grow_table()
-        self._entries[fid] = (ha, hb, shape)
+        self._ensure_ent_cap(fid)
+        self.ent_ha[fid] = ha
+        self.ent_hb[fid] = hb
+        self.ent_desc[fid] = self._shapes[shape][0]
         self.n_entries += 1
         if self.n_entries * 2 > (1 << self.log2cap):
             self._grow_table()
+
+    def _register_batch(self, fids, ha, hb, plen, plus_mask, has_hash) -> None:
+        """Shape + per-fid bookkeeping for a key batch, vectorized.
+
+        Shapes are deduplicated on a single combined int64 key (axis-wise
+        np.unique sorts rows ~10x slower); per-fid lanes/descriptors land
+        in the entry arrays with two fancy-index stores."""
+        combo = (
+            plen.astype(np.int64)
+            | (plus_mask.astype(np.int64) << 7)
+            | (has_hash.astype(np.int64) << 43)
+        )
+        uniq, inv, counts = np.unique(
+            combo, return_inverse=True, return_counts=True
+        )
+        desc_of = np.empty(len(uniq), dtype=np.int32)
+        for j, key in enumerate(uniq.tolist()):
+            shape = Shape(
+                plen=int(key & 0x7F),
+                plus_mask=int((key >> 7) & 0xFFFFFFFFF),
+                has_hash=bool(key >> 43),
+            )
+            cnt = int(counts[j])
+            ent = self._shapes.get(shape)
+            if ent is not None:
+                idx, rc = ent
+                self._shapes[shape] = (idx, rc + cnt)
+            else:
+                while True:
+                    try:
+                        self._acquire_shape(shape)
+                        break
+                    except GrowNeeded:
+                        self._grow_desc()
+                idx, _one = self._shapes[shape]
+                self._shapes[shape] = (idx, cnt)
+            desc_of[j] = idx
+        fid_arr = np.asarray(fids, dtype=np.int64)
+        self._ensure_ent_cap(int(fid_arr.max()))
+        self.ent_ha[fid_arr] = ha
+        self.ent_hb[fid_arr] = hb
+        self.ent_desc[fid_arr] = desc_of[inv]
 
     def bulk_insert(self, filters: Sequence[str], fids: Sequence[int]) -> None:
         """Insert many filters at once (route-table bootstrap / resync).
@@ -234,41 +302,17 @@ class MatchTables:
                 self.insert(f.split("/"), fid)
             return
         ha, hb, plen, plus_mask, has_hash = out
+        self.bulk_insert_keys(fids, ha, hb, plen, plus_mask, has_hash)
 
-        # shape bookkeeping, one acquire per DISTINCT shape
-        trip = np.stack([plen.astype(np.int64),
-                         plus_mask.astype(np.int64),
-                         has_hash.astype(np.int64)])
-        uniq, counts = np.unique(trip, axis=1, return_counts=True)
-        for j in range(uniq.shape[1]):
-            shape = Shape(plen=int(uniq[0, j]), plus_mask=int(uniq[1, j]),
-                          has_hash=bool(uniq[2, j]))
-            cnt = int(counts[j])
-            ent = self._shapes.get(shape)
-            if ent is not None:
-                idx, rc = ent
-                self._shapes[shape] = (idx, rc + cnt)
-                continue
-            while True:
-                try:
-                    self._acquire_shape(shape)
-                    break
-                except GrowNeeded:
-                    self._grow_desc()
-            idx, _one = self._shapes[shape]
-            self._shapes[shape] = (idx, cnt)
-        shape_cache: Dict[Tuple[int, int, bool], Shape] = {}
-        for i in range(n):
-            key = (int(plen[i]), int(plus_mask[i]), bool(has_hash[i]))
-            shape = shape_cache.get(key)
-            if shape is None:
-                shape = Shape(plen=key[0], plus_mask=key[1], has_hash=key[2])
-                shape_cache[key] = shape
-            self._entries[fids[i]] = (int(ha[i]), int(hb[i]), shape)
-        self.n_entries += n
+    def bulk_insert_keys(self, fids, ha, hb, plen, plus_mask, has_hash) -> None:
+        """bulk_insert for callers that already hold the native key batch
+        (engine.add_filters computes keys once for dedup + deep routing +
+        registry fill — recomputing them here would double the cost)."""
+        self._register_batch(fids, ha, hb, plen, plus_mask, has_hash)
+        self.n_entries += len(fids)
         while self.n_entries * 2 > (1 << self.log2cap):
             self.log2cap += 1
-        self._rebuild()
+        self._rebuild(pending=(ha, hb, np.asarray(fids, dtype=np.int32)))
 
     def churn_insert(self, filters: Sequence[str], fids: Sequence[int],
                      words: Optional[Sequence[Sequence[str]]] = None) -> None:
@@ -294,44 +338,14 @@ class MatchTables:
                 self.insert(w, fid)
             return
         ha, hb, plen, plus_mask, has_hash = out
+        self.churn_insert_keys(fids, ha, hb, plen, plus_mask, has_hash)
 
-        # shape bookkeeping: churn batches hold few distinct shapes —
-        # acquire each once with its count, like bulk_insert
-        trip = np.stack([plen.astype(np.int64),
-                         plus_mask.astype(np.int64),
-                         has_hash.astype(np.int64)])
-        uniq, counts = np.unique(trip, axis=1, return_counts=True)
-        shape_cache: Dict[Tuple[int, int, bool], Shape] = {}
-        for j in range(uniq.shape[1]):
-            key = (int(uniq[0, j]), int(uniq[1, j]), bool(uniq[2, j]))
-            shape = Shape(plen=key[0], plus_mask=key[1], has_hash=key[2])
-            shape_cache[key] = shape
-            cnt = int(counts[j])
-            ent = self._shapes.get(shape)
-            if ent is not None:
-                idx, rc = ent
-                self._shapes[shape] = (idx, rc + cnt)
-                continue
-            while True:
-                try:
-                    self._acquire_shape(shape)
-                    break
-                except GrowNeeded:
-                    self._grow_desc()
-            idx, _one = self._shapes[shape]
-            self._shapes[shape] = (idx, cnt)
-        entries = self._entries
-        ha_l = ha.tolist()
-        hb_l = hb.tolist()
-        plen_l = plen.tolist()
-        plus_l = plus_mask.tolist()
-        hash_l = has_hash.tolist()
-        for i, fid in enumerate(fids):
-            entries[fid] = (
-                ha_l[i],
-                hb_l[i],
-                shape_cache[(plen_l[i], plus_l[i], bool(hash_l[i]))],
-            )
+    def churn_insert_keys(self, fids, ha, hb, plen, plus_mask, has_hash) -> None:
+        """churn_insert for callers holding the native key batch."""
+        from . import native
+
+        n = len(fids)
+        self._register_batch(fids, ha, hb, plen, plus_mask, has_hash)
         self.n_entries += n
 
         if self.n_entries * 2 > (1 << self.log2cap):
@@ -339,10 +353,10 @@ class MatchTables:
             # (entries above already include this batch)
             while self.n_entries * 2 > (1 << self.log2cap):
                 self.log2cap += 1
-            self._rebuild()
+            self._rebuild(pending=(ha, hb, np.asarray(fids, dtype=np.int32)))
             return
 
-        fid_arr = np.asarray(list(fids), dtype=np.int32)
+        fid_arr = np.asarray(fids, dtype=np.int32)
         placed = native.bulk_place_slots(
             self.key_a, self.key_b, self.val, self.log2cap, PROBE,
             ha, hb, fid_arr,
@@ -357,13 +371,15 @@ class MatchTables:
         self.delta.val.extend(int(f) for f in fid_arr[:n_ok])
         if n_ok < n:
             # a probe window filled: grow + native rebuild covers the
-            # remainder (their _entries are registered already) — NOT
-            # _grow_table, whose per-entry Python re-place loop would
-            # stall for tens of seconds at 10M resident entries
+            # remainder — NOT _grow_table, whose per-entry Python
+            # re-place loop would stall for tens of seconds at 10M
+            # resident entries.  The not-yet-placed tail rides the
+            # rebuild's pending batch (the table itself is the entry
+            # store, and [n_ok:] never made it in).
             self.log2cap += 1
             if self.log2cap > MAX_LOG2CAP:
                 raise RuntimeError("match-table growth runaway")
-            self._rebuild()
+            self._rebuild(pending=(ha[n_ok:], hb[n_ok:], fid_arr[n_ok:]))
 
     def delete_batch(self, fids: Sequence[int]) -> None:
         """Vectorized tombstoning for churn ticks: one numpy pass finds
@@ -377,16 +393,19 @@ class MatchTables:
                 self.delete(fid)
             return
         cap = 1 << self.log2cap
-        ha = np.zeros(n, dtype=np.uint32)
-        hb = np.zeros(n, dtype=np.uint32)
-        farr = np.zeros(n, dtype=np.int32)
+        farr = np.asarray(fids, dtype=np.int64)
+        if (farr >= self._ent_cap).any():
+            raise KeyError("filter id missing from table in delete_batch")
+        ha = self.ent_ha[farr]
+        hb = self.ent_hb[farr]
+        descs = self.ent_desc[farr]
+        if (descs < 0).any():  # pragma: no cover - bookkeeping
+            raise KeyError("filter id missing from table in delete_batch")
         shape_counts: Dict[Shape, int] = {}
-        for i, fid in enumerate(fids):
-            a, b, shape = self._entries.pop(fid)
-            ha[i] = a
-            hb[i] = b
-            farr[i] = fid
-            shape_counts[shape] = shape_counts.get(shape, 0) + 1
+        for j, cnt in zip(*np.unique(descs, return_counts=True)):
+            shape_counts[self._desc_shape[int(j)]] = int(cnt)
+        self.ent_desc[farr] = -1
+        farr = farr.astype(np.int32)
         mixed = (ha + hb * np.uint32(_MIX1)) * np.uint32(_MIX2)
         home = (mixed >> np.uint32(32 - self.log2cap)).astype(np.int64)
         windows = (home[:, None] + np.arange(PROBE)[None, :]) & (cap - 1)
@@ -412,21 +431,30 @@ class MatchTables:
             else:
                 del self._shapes[shape]
                 self.valid[idx] = False
+                self._desc_shape[idx] = None
                 self._free_desc.append(idx)
                 self.delta.desc_dirty = True
         self.n_entries -= n
 
-    def _rebuild(self) -> None:
+    def _rebuild(self, pending=None) -> None:
         """Re-place every entry into fresh arrays at the current capacity,
-        growing until placement succeeds; native path when available."""
+        growing until placement succeeds; native path when available.
+
+        The live table arrays ARE the entry store (val >= 0 slots carry
+        every placed key); `pending` is an optional (ha, hb, fids) batch
+        registered in the entry arrays but not yet placed."""
         from . import native
 
-        n = len(self._entries)
-        fids = np.fromiter(self._entries.keys(), dtype=np.int32, count=n)
-        ha = np.fromiter((e[0] for e in self._entries.values()),
-                         dtype=np.uint32, count=n)
-        hb = np.fromiter((e[1] for e in self._entries.values()),
-                         dtype=np.uint32, count=n)
+        live = self.val >= 0
+        ha = self.key_a[live]
+        hb = self.key_b[live]
+        fids = self.val[live]
+        if pending is not None:
+            pha, phb, pfids = pending
+            ha = np.concatenate([ha, pha.astype(np.uint32, copy=False)])
+            hb = np.concatenate([hb, phb.astype(np.uint32, copy=False)])
+            fids = np.concatenate([fids, pfids])
+        n = len(fids)
 
         worst_dup = -1  # computed lazily, once per rebuild (keys are fixed)
 
@@ -482,7 +510,12 @@ class MatchTables:
         self.delta = Delta(rebuilt=True, desc_dirty=True)
 
     def delete(self, fid: int) -> None:
-        ha, hb, shape = self._entries.pop(fid)
+        if fid >= self._ent_cap or self.ent_desc[fid] < 0:
+            raise KeyError(f"filter id {fid} not found in table")
+        ha = int(self.ent_ha[fid])
+        hb = int(self.ent_hb[fid])
+        shape = self._desc_shape[int(self.ent_desc[fid])]
+        self.ent_desc[fid] = -1
         cap = 1 << self.log2cap
         home = bucket_of(ha, hb, self.log2cap)
         for off in range(PROBE):
@@ -516,30 +549,7 @@ class MatchTables:
                 "match-table growth runaway: >%d duplicate keys in one probe "
                 "window (duplicate filter inserts? callers must refcount "
                 "per unique filter like models/engine.py)" % PROBE)
-        cap = 1 << self.log2cap
-        while True:
-            self.key_a = np.zeros(cap, dtype=np.uint32)
-            self.key_b = np.zeros(cap, dtype=np.uint32)
-            self.val = np.full(cap, -1, dtype=np.int32)
-            try:
-                for fid, (ha, hb, _shape) in self._entries.items():
-                    home = bucket_of(ha, hb, self.log2cap)
-                    for off in range(PROBE):
-                        slot = (home + off) & (cap - 1)
-                        if self.val[slot] == -1:
-                            self.key_a[slot] = ha
-                            self.key_b[slot] = hb
-                            self.val[slot] = fid
-                            break
-                    else:
-                        raise GrowNeeded
-                break
-            except GrowNeeded:
-                self.log2cap += 1
-                if self.log2cap > MAX_LOG2CAP:
-                    raise RuntimeError("match-table growth runaway")
-                cap = 1 << self.log2cap
-        self.delta = Delta(rebuilt=True, desc_dirty=True)
+        self._rebuild()
 
     def _grow_desc(self) -> None:
         old = self.desc_cap
@@ -562,6 +572,7 @@ class MatchTables:
         self._free_desc = [
             i for i in range(self.desc_cap - 1, old - 1, -1)
         ] + self._free_desc
+        self._desc_shape.extend([None] * (self.desc_cap - old))
         self.delta.desc_dirty = True
         self.delta.rebuilt = True  # shapes changed size; device must re-init
 

@@ -274,10 +274,8 @@ def test_apply_churn_matches_per_op_path():
         got_s = slow.match(topics)
         # fids differ between engines; compare by filter strings
         def names(eng, sets):
-            return [
-                sorted(eng._words[f] and "/".join(eng._words[f]) for f in s)
-                for s in sets
-            ]
+            rev = {fid: f for f, fid in eng._fids.items()}
+            return [sorted(rev[f] for f in s) for s in sets]
         assert names(fast, got_f) == names(slow, got_s), f"tick {tick}"
     assert fast.n_filters == slow.n_filters
 
