@@ -139,6 +139,45 @@ class Broker:
         self.metrics.gauge_set("subscriptions.count", self._sub_count)
         self.hooks.run("session.subscribed", (clientid, filt, opts))
 
+    def subscribe_bulk(
+        self, clientid: str, filts: Sequence[str], opts: SubOpts
+    ) -> List[int]:
+        """Bulk subscribe for bootstrap paths (persistent-session restore,
+        bench/dryrun loads): one engine.add_filters pass plus batched
+        route/subscriber bookkeeping — semantically identical to calling
+        subscribe() per filter (non-shared filters only; $share prefixes
+        route through the per-op path)."""
+        plain: List[str] = []
+        fids_out: List[int] = []
+        for f in filts:
+            group, real = topiclib.parse_share(f)
+            if group is not None:  # shared: per-op semantics
+                self.subscribe(clientid, f, opts)
+                fids_out.append(self.engine.fid_of(real))
+                continue
+            plain.append(f)
+        if plain:
+            fids = self.engine.add_filters(plain)
+            for f, fid in zip(plain, fids):
+                route = self._routes.get(fid)
+                if route is None:
+                    self._routes[fid] = Route(filt=f)
+                added = self.subs.add(fid, clientid)
+                if (
+                    added
+                    and self.subs.count(fid) == 1
+                    and self.on_route_added is not None
+                ):
+                    self.on_route_added(f)
+                if added:
+                    self._sub_count += 1
+                else:
+                    self.engine.remove_filter(f)  # duplicate membership
+                self.hooks.run("session.subscribed", (clientid, f, opts))
+            fids_out.extend(fids)
+        self.metrics.gauge_set("subscriptions.count", self._sub_count)
+        return fids_out
+
     def unsubscribe(self, clientid: str, filt: str) -> None:
         group, real = topiclib.parse_share(filt)
         fid = self.engine.fid_of(real)
