@@ -148,17 +148,19 @@ class Broker:
         subscribe() per filter (non-shared filters only; $share prefixes
         route through the per-op path)."""
         plain: List[str] = []
-        fids_out: List[int] = []
-        for f in filts:
+        plain_pos: List[int] = []
+        fids_out: List[Optional[int]] = [None] * len(filts)
+        for i, f in enumerate(filts):
             group, real = topiclib.parse_share(f)
             if group is not None:  # shared: per-op semantics
                 self.subscribe(clientid, f, opts)
-                fids_out.append(self.engine.fid_of(real))
+                fids_out[i] = self.engine.fid_of(real)
                 continue
             plain.append(f)
+            plain_pos.append(i)
         if plain:
             fids = self.engine.add_filters(plain)
-            for f, fid in zip(plain, fids):
+            for f, fid, pos in zip(plain, fids, plain_pos):
                 route = self._routes.get(fid)
                 if route is None:
                     self._routes[fid] = Route(filt=f)
@@ -174,7 +176,7 @@ class Broker:
                 else:
                     self.engine.remove_filter(f)  # duplicate membership
                 self.hooks.run("session.subscribed", (clientid, f, opts))
-            fids_out.extend(fids)
+                fids_out[pos] = fid
         self.metrics.gauge_set("subscriptions.count", self._sub_count)
         return fids_out
 

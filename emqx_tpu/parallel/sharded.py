@@ -343,30 +343,76 @@ class ShardedMatchEngine:
     def add_filters(self, filts: Sequence[str]) -> List[int]:
         """Bulk add: one native key pass per SHARD instead of per-filter
         inserts (the mesh analog of TopicMatchEngine.add_filters; fids
-        round-robin over shards so partitions stay balanced)."""
+        round-robin over shards so partitions stay balanced).
+
+        Same commit discipline as add_filter: shard table inserts happen
+        BEFORE any registry state is written, so a failed insert leaves
+        the engine exactly as it was (only the fid allocator is rolled
+        back)."""
+        # plan: dedup against the live registry AND within the batch,
+        # allocating fids but committing nothing yet
         fids: List[int] = []
-        by_shard_strs: List[List[str]] = [[] for _ in range(self.D)]
-        by_shard_fids: List[List[int]] = [[] for _ in range(self.D)]
+        local: Dict[str, int] = {}
+        local_refs: Dict[int, int] = {}
+        plan: List[Tuple[str, int, List[str], bool]] = []
+        popped: List[int] = []
+        next_mark = self._next_fid
         for filt in filts:
             fid = self._fids.get(filt)
             if fid is not None:
-                self._refs[fid] += 1
+                self._refs[fid] += 1  # safe: no insert involved
                 fids.append(fid)
                 continue
-            fid = self._free_fids.pop() if self._free_fids else self._next_fid
-            if fid == self._next_fid:
+            fid = local.get(filt)
+            if fid is not None:
+                local_refs[fid] += 1
+                fids.append(fid)
+                continue
+            if self._free_fids:
+                fid = self._free_fids.pop()
+                popped.append(fid)
+            else:
+                fid = self._next_fid
                 self._next_fid += 1
             ws = topiclib.words(filt)
-            self._fids[filt] = fid
-            self._refs[fid] = 1
-            self._words[fid] = ws
-            self._fbytes[fid] = filt.encode("utf-8")
-            if self.space.shape_of(ws).plen > self.space.max_levels:
-                self._deep.insert(filt, fid)
-                self._deep_fids.add(fid)
-            else:
+            deep = self.space.shape_of(ws).plen > self.space.max_levels
+            local[filt] = fid
+            local_refs[fid] = 1
+            plan.append((filt, fid, ws, deep))
+            fids.append(fid)
+        by_shard_strs: List[List[str]] = [[] for _ in range(self.D)]
+        by_shard_fids: List[List[int]] = [[] for _ in range(self.D)]
+        for filt, fid, ws, deep in plan:
+            if not deep:
                 by_shard_strs[fid % self.D].append(filt)
                 by_shard_fids[fid % self.D].append(fid)
+        done = 0
+        try:
+            for d in range(self.D):
+                if by_shard_strs[d]:
+                    self.shards[d].bulk_insert(
+                        by_shard_strs[d], by_shard_fids[d]
+                    )
+                done = d + 1
+        except BaseException:
+            for dd in range(done):  # unwind shards already inserted
+                for fid in by_shard_fids[dd]:
+                    try:
+                        self.shards[dd].delete(fid)
+                    except KeyError:  # pragma: no cover
+                        pass
+            self._free_fids.extend(reversed(popped))
+            self._next_fid = next_mark
+            raise
+        # commit
+        for filt, fid, ws, deep in plan:
+            self._fids[filt] = fid
+            self._refs[fid] = local_refs[fid]
+            self._words[fid] = ws
+            self._fbytes[fid] = filt.encode("utf-8")
+            if deep:
+                self._deep.insert(filt, fid)
+                self._deep_fids.add(fid)
             if fid >= self._dest_cap:
                 while self._dest_cap <= fid:
                     self._dest_cap *= 2
@@ -374,11 +420,8 @@ class ShardedMatchEngine:
                 nd[: len(self._dest)] = self._dest
                 self._dest = nd
             self._dest[fid] = fid % self.n_sub
-            fids.append(fid)
-        for d in range(self.D):
-            if by_shard_strs[d]:
-                self.shards[d].bulk_insert(by_shard_strs[d], by_shard_fids[d])
-        self._dest_dirty = True
+        if plan:
+            self._dest_dirty = True
         return fids
 
     def remove_filter(self, filt: str) -> Optional[int]:
@@ -595,7 +638,7 @@ class ShardedMatchEngine:
         except AttributeError:  # pragma: no cover - older jax
             pass
         return _ShardedPending(
-            hits, counts, (self._stacked, batch), n, list(topics), deep
+            hits, counts, self._stacked, n, list(topics), deep
         )
 
     def match_collect(self, pending: "_ShardedPending") -> List[Set[int]]:
@@ -618,7 +661,7 @@ class ShardedMatchEngine:
                 # with k widened to the observed max (pow2-rounded so
                 # the kcap-static jit compiles a bounded variant set) —
                 # a [D, B_over, k2] transfer instead of [D, B, M]
-                stacked, _batch = pending.snap
+                stacked = pending.snap  # THIS tick's table version
                 over_idx = np.nonzero(over)[0]
                 sub_topics = [pending.topics[i] for i in over_idx.tolist()]
                 k2 = next_pow2(int(counts[:, over].max()))
@@ -627,15 +670,12 @@ class ShardedMatchEngine:
                     stacked, sub_batch, mesh=self.mesh, kcap=k2
                 )
                 sub_hits = np.asarray(sub_hits)[:, :n_sub, :]
-                pad = sub_hits.shape[2] - k
-                if pad > 0:
-                    hits = np.concatenate(
-                        [hits, np.full(hits.shape[:2] + (pad,), -1,
-                                       dtype=hits.dtype)], axis=2
-                    )
-                else:
-                    hits = hits.copy()
-                hits[:, over_idx, : sub_hits.shape[2]] = sub_hits
+                # overflow implies counts.max() > k, so k2 >= k+1 here
+                hits = np.concatenate(
+                    [hits, np.full(hits.shape[:2] + (k2 - k,), -1,
+                                   dtype=hits.dtype)], axis=2
+                )
+                hits[:, over_idx, :] = sub_hits
             _d, bb, jj = np.nonzero(hits >= 0)
             if bb.size:
                 fids = hits[_d, bb, jj]
@@ -686,7 +726,7 @@ class _ShardedPending:
     def __init__(self, hits, counts, snap, n, topics, deep=None):
         self.hits = hits
         self.counts = counts
-        self.snap = snap  # (stacked, batch) of THIS tick, for overflow
+        self.snap = snap  # stacked tables of THIS tick (overflow refetch)
         self.n = n
         self.topics = topics
         self.deep = deep  # deep-filter hits, snapshotted at submit
