@@ -21,7 +21,7 @@ from . import packet as pkt
 from . import topic as topiclib
 from .access_control import ALLOW, AccessControl, AuthzCache, ClientInfo, DENY, PUB, SUB
 from .broker import Broker
-from .message import Message
+from .message import Message, now_ms
 from .packet import PacketType, Property, ReasonCode, SubOpts
 from .session import Session, SessionError
 
@@ -377,6 +377,15 @@ class Channel:
             if not self.cfg.shared_sub_available:
                 props[Property.SHARED_SUBSCRIPTION_AVAILABLE] = 0
             props[Property.TOPIC_ALIAS_MAXIMUM] = self.cfg.max_topic_alias
+            # the broker's inbound QoS2 window IS its Receive Maximum
+            # (QoS1 publishes are acked synchronously, so only
+            # unreleased QoS2 flows count against it) — advertised so a
+            # conformant client throttles; violators are disconnected
+            # with 0x93 (MQTT-3.3.4-7/9).  0 (= unlimited here) must be
+            # OMITTED: Receive Maximum 0 is a protocol error
+            # (MQTT-3.2.2.3.3), and the u16 property caps at 65535
+            if 0 < self.cfg.max_awaiting_rel <= 0xFFFF:
+                props[Property.RECEIVE_MAXIMUM] = self.cfg.max_awaiting_rel
             if self.expiry_interval != int(
                 p.properties.get(Property.SESSION_EXPIRY_INTERVAL, 0)
             ):
@@ -484,6 +493,19 @@ class Channel:
         try:
             self.session.publish_qos2(p.packet_id)
         except SessionError as e:
+            if (
+                self.v5
+                and e.reason_code == ReasonCode.RECEIVE_MAXIMUM_EXCEEDED
+            ):
+                # client ignored the advertised Receive Maximum: this is
+                # a protocol violation, not flow control — DISCONNECT
+                # 0x93 (MQTT-3.3.4-9; the reference does the same,
+                # emqx_channel handle_in publish error path)
+                self._m("packets.publish.quota_exceeded")
+                return self._close(
+                    ReasonCode.RECEIVE_MAXIMUM_EXCEEDED,
+                    send_disconnect=True,
+                )
             return [("send", pkt.PubRec(packet_id=p.packet_id, reason_code=e.reason_code))]
         return self._pub_ack(msg, p.packet_id, pkt.PubRec, "packets.pubrec.sent")
 
@@ -759,6 +781,14 @@ class Channel:
             return [("send", pkt.PubRel(packet_id=d.packet_id))]
         msg = d.message
         props = dict(msg.properties)
+        if Property.MESSAGE_EXPIRY_INTERVAL in props:
+            # MQTT-3.3.2-6: forward the expiry MINUS the time spent
+            # waiting in the server (expired messages were already
+            # dropped by Session.deliver/dequeue/replay)
+            waited = max(0, (now_ms() - msg.timestamp) // 1000)
+            props[Property.MESSAGE_EXPIRY_INTERVAL] = max(
+                1, int(props[Property.MESSAGE_EXPIRY_INTERVAL]) - int(waited)
+            )
         if self.v5 and d.sub_ids:
             props[Property.SUBSCRIPTION_IDENTIFIER] = list(d.sub_ids)
         topic = topiclib.strip_mountpoint(self.cfg.mountpoint, msg.topic)

@@ -30,7 +30,7 @@ class _Node:
 
 class Retainer:
     def __init__(self, max_retained: int = 0, max_payload: int = 0,
-                 enable: bool = True, store=None):
+                 enable: bool = True, store=None, device_index=None):
         self.root = _Node()
         self.count = 0
         self.max_retained = max_retained  # 0 = unlimited
@@ -39,6 +39,10 @@ class Retainer:
         # optional write-through disc store (emqx_retainer_mnesia disc
         # copies); retained messages then survive a restart
         self.store = store
+        # optional HBM name index (models/retained.py): subscribe-time
+        # wildcard fan-in as ONE device dispatch instead of a trie walk
+        # — the trie stays canonical truth (and the verify oracle)
+        self.index = device_index
         if store is not None:
             for msg in store.load().values():
                 self._insert(msg, persist=False)
@@ -64,6 +68,8 @@ class Retainer:
         if node.msg is None:
             self.count += 1
         node.msg = msg
+        if self.index is not None:
+            self.index.insert(msg.topic)
         if persist and self.store is not None:
             self.store.set(msg)
             if self.store.needs_compact(self.count):
@@ -90,6 +96,8 @@ class Retainer:
             return False
         node.msg = None
         self.count -= 1
+        if self.index is not None:
+            self.index.delete(topic)
         if self.store is not None:
             self.store.delete(topic)
             if self.store.needs_compact(self.count):
@@ -121,7 +129,17 @@ class Retainer:
         mnesia reads).  Each node's children are snapshotted when
         visited, so concurrent retain/delete between batches is safe
         (same read-committed looseness as the reference's continuations).
+
+        With the device index attached, the name set comes from ONE
+        kernel dispatch (models/retained.py) and only the hit topics
+        touch the trie (message fetch + expiry check).
         """
+        if self.index is not None and len(self.index):
+            for t in self.index.lookup(filt):
+                msg = self.get(t)
+                if msg is not None and not msg.expired():
+                    yield msg
+            return
         fw = topiclib.words(filt)
         stack = [(self.root, 0, True)]
         while stack:

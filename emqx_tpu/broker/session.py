@@ -234,12 +234,20 @@ class Session:
         return out
 
     def replay(self) -> List[Delivery]:
-        """On resume: re-send all pending inflight (dup) then drain queue."""
+        """On resume: re-send all pending inflight (dup) then drain queue.
+
+        Messages whose MESSAGE_EXPIRY_INTERVAL lapsed while the client
+        was away are dropped, not re-sent (MQTT-3.3.2-5); a started QoS2
+        release (wait_comp) still completes — the receiver already holds
+        the message."""
         out: List[Delivery] = []
-        for pid, e in self.inflight.items():
+        for pid, e in list(self.inflight.items()):
             if e.phase == "wait_comp":
                 out.append(Delivery(pid, None, 2))
             elif e.message is not None:
+                if e.message.expired():
+                    self.inflight.delete(pid)
+                    continue
                 out.append(Delivery(pid, e.message, e.message.qos, dup=True))
         out.extend(self.dequeue())
         return out

@@ -259,6 +259,11 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "max_payload_size": Field("bytesize", 1 << 20),
         "backend": Field("enum", "ram", enum=["ram", "disc"],
                          desc="disc = retained messages survive restart"),
+        "device_index": Field(
+            "bool", False,
+            desc="index retained topic names in HBM: subscribe-time "
+                 "wildcard fan-in becomes one device dispatch (host trie "
+                 "remains canonical truth + verify oracle)"),
         "flow_control_batch": Field(
             "int", 1000, min=1,
             desc="retained re-delivery batch size on subscribe"),

@@ -229,7 +229,10 @@ class ManagementApi:
         r("GET", "/monitor_current", self.monitor_current,
           doc="Instantaneous levels + last-interval rates")
         r("GET", "/dashboard", self.dashboard_page, public=True,
-          doc="Minimal status dashboard (HTML)")
+          doc="Dashboard frontend (redirects to the overview page)")
+        r("GET", "/dashboard/{page}", self.dashboard_page, public=True,
+          doc="Dashboard frontend pages (overview/clients/subscriptions/"
+              "topics/retained/listeners/metrics)")
 
 
     # -------------------------------------------------------------- plugins
@@ -1376,55 +1379,22 @@ class ManagementApi:
         return self._need("monitor").current()
 
     def dashboard_page(self, req: Request):
-        """Self-contained status page — the dashboard app proper, minus
-        the reference's full SPA: live gauges polled from the same REST
-        endpoints an operator's tooling uses."""
+        """Multi-page dashboard frontend (mgmt/dashboard.py): each page
+        is a thin HTML view over the same REST endpoints operator
+        tooling uses — the reference's packaged SPA, minus the bundler
+        (`apps/emqx_dashboard` serving a built frontend)."""
+        from .dashboard import exists, render
         from .http import RawResponse
 
-        html = f"""<!doctype html>
-<html><head><meta charset="utf-8"><title>{self.node} — emqx_tpu</title>
-<style>
- body {{ font: 14px system-ui, sans-serif; margin: 2rem; color: #222; }}
- h1 {{ font-size: 1.2rem; }}
- .cards {{ display: flex; gap: 1rem; flex-wrap: wrap; }}
- .card {{ border: 1px solid #ddd; border-radius: 8px; padding: 1rem 1.4rem;
-          min-width: 10rem; }}
- .card b {{ display: block; font-size: 1.6rem; }}
- small {{ color: #777; }}
-</style></head>
-<body>
-<h1>emqx_tpu node <code>{self.node}</code></h1>
-<div class="cards">
- <div class="card"><small>connections</small><b id="c">–</b></div>
- <div class="card"><small>subscriptions</small><b id="s">–</b></div>
- <div class="card"><small>topics</small><b id="t">–</b></div>
- <div class="card"><small>msgs in/s</small><b id="in">–</b></div>
- <div class="card"><small>msgs out/s</small><b id="out">–</b></div>
- <div class="card"><small>uptime</small><b id="up">–</b></div>
-</div>
-<p><small>Full API: <a href="api-docs">OpenAPI document</a>.  Charts feed
-from <code>GET /api/v5/monitor</code> (auth required).</small></p>
-<script>
-async function tick() {{
-  try {{
-    const st = await (await fetch('status')).json();
-    document.getElementById('up').textContent = st.uptime + 's';
-    const tok = localStorage.getItem('emqx_tpu_token');
-    if (tok) {{
-      const cur = await (await fetch('monitor_current',
-        {{headers: {{Authorization: 'Bearer ' + tok}}}})).json();
-      document.getElementById('c').textContent = cur.connections;
-      document.getElementById('s').textContent = cur.subscriptions;
-      document.getElementById('t').textContent = cur.topics;
-      document.getElementById('in').textContent = cur.received_rate.toFixed(1);
-      document.getElementById('out').textContent = cur.sent_rate.toFixed(1);
-    }}
-  }} catch (e) {{}}
-}}
-tick(); setInterval(tick, 5000);
-</script>
-</body></html>"""
-        return RawResponse(html.encode())
+        page = req.params.get("page")
+        if page is None:
+            return RawResponse(
+                b"", status=302,
+                headers={"Location": "dashboard/overview"},
+            )
+        if not exists(page):
+            raise HttpError(404, f"no dashboard page {page!r}")
+        return RawResponse(render(page, self.node).encode())
 
     # ------------------------------------------------------------- api-docs
 

@@ -1,0 +1,251 @@
+"""Multi-page dashboard frontend (served by the mgmt HTTP app).
+
+The reference ships a packaged SPA (`apps/emqx_dashboard` serving a
+built frontend); the equivalent here is a small server-rendered shell —
+one layout, one nav, per-page tables — where every page is a thin HTML
+view over the SAME REST endpoints an operator's tooling uses
+(`emqx_mgmt_api_*` analogs in api.py).  No build step, no bundler: the
+pages are the API made visible.
+
+Pages: overview (live gauges + monitor history), clients (+search),
+subscriptions, topics/routes, retained, listeners, metrics, settings
+(token).  Auth: the dashboard token from POST /api/v5/login, held in
+localStorage; 401s route to the login view.
+"""
+
+from __future__ import annotations
+
+_STYLE = """
+ body { font: 14px system-ui, sans-serif; margin: 0; color: #222; }
+ nav { display: flex; gap: .2rem; padding: .6rem 1.2rem; background: #1b2430;
+       align-items: center; flex-wrap: wrap; }
+ nav a { color: #cfd8e3; text-decoration: none; padding: .35rem .7rem;
+         border-radius: 6px; font-size: 13px; }
+ nav a.on, nav a:hover { background: #324055; color: #fff; }
+ nav .brand { color: #7ee0c0; font-weight: 600; margin-right: 1rem; }
+ main { padding: 1.2rem 1.6rem; }
+ .cards { display: flex; gap: 1rem; flex-wrap: wrap; margin-bottom: 1rem; }
+ .card { border: 1px solid #ddd; border-radius: 8px; padding: .8rem 1.2rem;
+         min-width: 9rem; }
+ .card b { display: block; font-size: 1.5rem; }
+ small { color: #777; }
+ table { border-collapse: collapse; width: 100%; margin-top: .8rem; }
+ th, td { text-align: left; padding: .35rem .6rem; border-bottom: 1px solid
+          #eee; font-size: 13px; }
+ th { background: #f7f8fa; position: sticky; top: 0; }
+ input[type=text], input[type=password] { padding: .4rem .6rem;
+   border: 1px solid #ccc; border-radius: 6px; }
+ button { padding: .4rem .9rem; border: 0; border-radius: 6px;
+          background: #1b2430; color: #fff; cursor: pointer; }
+ #err { color: #b00020; }
+ .muted { color: #888; font-size: 12px; }
+"""
+
+_HELPERS = """
+const TOK = () => localStorage.getItem('emqx_tpu_token');
+async function api(path) {
+  // pages live at <base>/dashboard/<page>; the API root is one level up
+  const r = await fetch('..' + path,
+      {headers: {Authorization: 'Bearer ' + TOK()}});
+  if (r.status === 401) { location.href = 'login'; throw new Error('auth'); }
+  if (!r.ok) throw new Error(path + ': HTTP ' + r.status);
+  return r.json();
+}
+// MQTT data (clientids, topics, usernames) is attacker-controlled and
+// MUST be HTML-escaped before hitting innerHTML — a clientid like
+// <img onerror=...> would otherwise run in the operator's session
+const esc = v => String(v).replace(/[&<>"']/g, ch => ({'&': '&amp;',
+  '<': '&lt;', '>': '&gt;', '"': '&quot;', "'": '&#39;'}[ch]));
+function table(el, cols, rows) {
+  const h = ['<table><tr>' + cols.map(c => '<th>' + esc(c) + '</th>')
+             .join('') + '</tr>'];
+  for (const r of rows)
+    h.push('<tr>' + r.map(v => '<td>' + (v === undefined || v === null ?
+           '' : esc(v)) + '</td>').join('') + '</tr>');
+  h.push('</table>');
+  el.innerHTML = h.join('');
+}
+function rowsOf(resp) { return resp.data !== undefined ? resp.data : resp; }
+"""
+
+_PAGES = {
+    "overview": ("Overview", """
+<div class="cards">
+ <div class="card"><small>connections</small><b id="c">–</b></div>
+ <div class="card"><small>subscriptions</small><b id="s">–</b></div>
+ <div class="card"><small>topics</small><b id="t">–</b></div>
+ <div class="card"><small>retained</small><b id="r">–</b></div>
+ <div class="card"><small>msgs in/s</small><b id="in">–</b></div>
+ <div class="card"><small>msgs out/s</small><b id="out">–</b></div>
+ <div class="card"><small>uptime</small><b id="up">–</b></div>
+</div>
+<h3>Cluster</h3><div id="nodes"></div>
+<h3>History <span class="muted">(GET /api/v5/monitor)</span></h3>
+<div id="hist"></div>
+<script>
+async function tick() {
+  try {
+    const st = await (await fetch('../status')).json();
+    document.getElementById('up').textContent = st.uptime + 's';
+    const cur = await api('/monitor_current');
+    for (const [k, id] of [['connections','c'], ['subscriptions','s'],
+                           ['topics','t']])
+      document.getElementById(id).textContent = cur[k];
+    document.getElementById('in').textContent =
+      (cur.received_rate || 0).toFixed(1);
+    document.getElementById('out').textContent =
+      (cur.sent_rate || 0).toFixed(1);
+    api('/mqtt/retainer').then(r => document.getElementById('r')
+      .textContent = r.count ?? r.retained_count ?? '–').catch(() => {});
+    const nodes = rowsOf(await api('/nodes'));
+    table(document.getElementById('nodes'),
+          ['node', 'status', 'connections', 'subscriptions', 'routes'],
+          nodes.map(n => [n.node, n.node_status, n.connections,
+                          n.subscriptions, n.routes]));
+    const hist = rowsOf(await api('/monitor?latest=20'));
+    table(document.getElementById('hist'),
+          ['time', 'connections', 'subscriptions', 'topics',
+           'received', 'sent'],
+          hist.map(h => [new Date(h.time_stamp).toLocaleTimeString(),
+                         h.connections, h.subscriptions, h.topics,
+                         h.received, h.sent]));
+  } catch (e) { console.log(e); }
+}
+tick(); setInterval(tick, 5000);
+</script>"""),
+
+    "clients": ("Clients", """
+<input type="text" id="q" placeholder="filter by clientid...">
+<button onclick="load()">search</button>
+<div id="tbl"></div>
+<script>
+async function load() {
+  const q = document.getElementById('q').value;
+  const resp = await api('/clients' + (q ? '?like_clientid=' +
+                         encodeURIComponent(q) : '?limit=200'));
+  table(document.getElementById('tbl'),
+        ['clientid', 'username', 'peername', 'proto', 'connected',
+         'connected at'],
+        rowsOf(resp).map(c => [c.clientid, c.username, c.peername,
+          c.proto_ver, c.connected, c.connected_at ?
+          new Date(c.connected_at * 1000).toLocaleString() : '']));
+}
+load();
+</script>"""),
+
+    "subscriptions": ("Subscriptions", """
+<input type="text" id="q" placeholder="filter by topic...">
+<button onclick="load()">search</button>
+<div id="tbl"></div>
+<script>
+async function load() {
+  const q = document.getElementById('q').value;
+  const resp = await api('/subscriptions' + (q ? '?match_topic=' +
+                         encodeURIComponent(q) : '?limit=500'));
+  table(document.getElementById('tbl'), ['clientid', 'topic', 'qos'],
+        rowsOf(resp).map(s => [s.clientid, s.topic, s.qos]));
+}
+load();
+</script>"""),
+
+    "topics": ("Topics", """
+<div id="tbl"></div>
+<script>
+api('/topics?limit=500').then(resp =>
+  table(document.getElementById('tbl'), ['topic', 'node'],
+        rowsOf(resp).map(t => [t.topic, t.node])));
+</script>"""),
+
+    "retained": ("Retained", """
+<div id="tbl"></div>
+<script>
+api('/mqtt/retainer/messages?limit=500').then(resp =>
+  table(document.getElementById('tbl'),
+        ['topic', 'qos', 'payload bytes', 'from'],
+        rowsOf(resp).map(m => [m.topic, m.qos, m.payload_size,
+                               m.from_clientid])))
+  .catch(() => document.getElementById('tbl').textContent =
+         'retainer API unavailable');
+</script>"""),
+
+    "listeners": ("Listeners", """
+<div id="tbl"></div><h3>Gateways</h3><div id="gw"></div>
+<script>
+api('/listeners').then(resp =>
+  table(document.getElementById('tbl'),
+        ['id', 'type', 'bind', 'running', 'connections'],
+        rowsOf(resp).map(l => [l.id, l.type, l.bind, l.running,
+                               l.current_connections])));
+api('/gateways').then(resp =>
+  table(document.getElementById('gw'), ['name', 'status'],
+        rowsOf(resp).map(g => [g.name, g.status])))
+  .catch(() => {});
+</script>"""),
+
+    "metrics": ("Metrics", """
+<div id="stats"></div><h3>Counters</h3><div id="tbl"></div>
+<script>
+api('/stats').then(s => {
+  const rows = Object.entries(s).map(([k, v]) => [k, v]);
+  table(document.getElementById('stats'), ['stat', 'value'], rows);
+});
+api('/metrics').then(m => {
+  const rows = Object.entries(m).sort().map(([k, v]) => [k, v]);
+  table(document.getElementById('tbl'), ['metric', 'value'], rows);
+});
+</script>"""),
+
+    "login": ("Login", """
+<h3>Dashboard login</h3>
+<p><input type="text" id="u" placeholder="username" value="admin">
+   <input type="password" id="p" placeholder="password">
+   <button onclick="login()">login</button></p>
+<p id="err"></p>
+<script>
+async function login() {
+  const r = await fetch('../login', {method: 'POST',
+    headers: {'Content-Type': 'application/json'},
+    body: JSON.stringify({username: document.getElementById('u').value,
+                          password: document.getElementById('p').value})});
+  if (!r.ok) { document.getElementById('err').textContent =
+               'login failed (HTTP ' + r.status + ')'; return; }
+  localStorage.setItem('emqx_tpu_token', (await r.json()).token);
+  location.href = 'overview';
+}
+</script>"""),
+}
+
+PAGE_NAMES = [p for p in _PAGES if p != "login"]
+
+
+def render(page: str, node: str) -> str:
+    """Full HTML for one dashboard page (404 handled by caller)."""
+    import html as _html
+
+    node = _html.escape(node)  # config-sourced, but never trust it in HTML
+    title, body = _PAGES[page]
+    nav = "".join(
+        f'<a href="{name}" class="{"on" if name == page else ""}">'
+        f"{_PAGES[name][0]}</a>"
+        for name in PAGE_NAMES
+    )
+    return f"""<!doctype html>
+<html><head><meta charset="utf-8">
+<title>{title} — {node} — emqx_tpu</title>
+<style>{_STYLE}</style></head>
+<body>
+<nav><span class="brand">emqx_tpu</span>{nav}
+ <span style="flex:1"></span>
+ <a href="login">Login</a>
+ <a href="../api-docs">API docs</a>
+</nav>
+<main>
+<h2>{title} <small class="muted">node {node}</small></h2>
+<script>{_HELPERS}</script>
+{body}
+</main>
+</body></html>"""
+
+
+def exists(page: str) -> bool:
+    return page in _PAGES

@@ -83,7 +83,8 @@ class Route:
 
 
 STATUS_TEXT = {
-    200: "OK", 201: "Created", 204: "No Content", 400: "Bad Request",
+    200: "OK", 201: "Created", 204: "No Content", 302: "Found",
+    400: "Bad Request",
     401: "Unauthorized", 404: "Not Found", 405: "Method Not Allowed",
     409: "Conflict", 500: "Internal Server Error", 503: "Service Unavailable",
 }
@@ -91,11 +92,16 @@ STATUS_TEXT = {
 
 class RawResponse:
     """Non-JSON handler result: raw bytes with an explicit content type
-    (the dashboard HTML page, trace log downloads, ...)."""
+    (dashboard HTML pages, trace log downloads, redirects, ...)."""
 
-    def __init__(self, body: bytes, content_type: str = "text/html; charset=utf-8"):
+    def __init__(self, body: bytes,
+                 content_type: str = "text/html; charset=utf-8",
+                 status: Optional[int] = None,
+                 headers: Optional[Dict[str, str]] = None):
         self.body = body
         self.content_type = content_type
+        self.status = status  # None = the dispatch status (200)
+        self.headers = headers or {}
 
 
 class HttpApi:
@@ -180,11 +186,16 @@ class HttpApi:
 
     async def _respond(self, writer, status: int, payload, keep: bool = True) -> None:
         ctype = "application/json"
+        extra = ""
         if payload is None:
             body = b""
         elif isinstance(payload, RawResponse):
             body = payload.body
             ctype = payload.content_type
+            if payload.status is not None:
+                status = payload.status
+            for k, v in payload.headers.items():
+                extra += f"{k}: {v}\r\n"
         elif isinstance(payload, (bytes, bytearray)):
             body = bytes(payload)
         else:
@@ -192,7 +203,7 @@ class HttpApi:
         head = (
             f"HTTP/1.1 {status} {STATUS_TEXT.get(status, 'OK')}\r\n"
             f"Content-Type: {ctype}\r\n"
-            f"Content-Length: {len(body)}\r\n"
+            f"Content-Length: {len(body)}\r\n{extra}"
             f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
         )
         writer.write(head.encode() + body)

@@ -1,0 +1,198 @@
+"""Device-resident retained-message index (subscribe-time wildcard fan-in).
+
+The retainer's lookup direction is the PUBLISH path transposed: one
+wildcard filter against many stored concrete topic names
+(`emqx_retainer_mnesia.erl` walks a mnesia topic table per subscribe).
+Round-3 verdict item 9: this is the same match problem the engine solves
+on device, so spend the kernel surplus on it.
+
+Design: stored names live in HBM as per-level hash-term rows (the same
+`HashSpace` terms the publish path uses, `ops/hashing.py`).  A lookup
+builds the FILTER's shape descriptor host-side (one inclusion row + the
+shape constant) and runs ONE masked-sum dispatch over all rows:
+
+    hit[n] = (sum_l terms_a[n,l] * incl[l]) + K_a == filter_key_a
+           & (lane b likewise) & length-window & ~($-root wildcard rule)
+
+— a [N, L] contraction, embarrassingly parallel, no trie walk.  Hits are
+exact-verified host-side against the stored name strings (the same
+two-lane-collision discipline as the publish engine), so delivery
+correctness never depends on hash luck.  Churn is slot-wise scatter,
+like the route tables; capacity doubles with full re-upload (rare).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..broker import topic as topiclib
+from ..ops import hashing
+
+
+@functools.partial(__import__("jax").jit, static_argnames=())
+def _retained_match(ta, tb, ln, dl, incl, ka, kb, ta_t, tb_t,
+                    min_len, max_len, wild_root):
+    import jax.numpy as jnp
+
+    ha = (ta * incl[None, :]).sum(axis=-1, dtype=jnp.uint32) + ka
+    hb = (tb * incl[None, :]).sum(axis=-1, dtype=jnp.uint32) + kb
+    ok = (
+        (ha == ta_t)
+        & (hb == tb_t)
+        & (ln >= min_len)
+        & (ln <= max_len)
+        & (ln >= 0)  # occupied slot
+        & ~(dl & wild_root)
+    )
+    return ok
+
+
+class RetainedDeviceIndex:
+    """HBM index of retained topic NAMES; lookup(filter) -> names."""
+
+    def __init__(self, space: Optional[hashing.HashSpace] = None,
+                 device=None, cap: int = 1024):
+        self.space = space or hashing.HashSpace()
+        self.device = device
+        L = self.space.max_levels
+        self.cap = cap
+        self.ta = np.zeros((cap, L), dtype=np.uint32)
+        self.tb = np.zeros((cap, L), dtype=np.uint32)
+        self.ln = np.full(cap, -1, dtype=np.int32)  # -1 = empty slot
+        self.dl = np.zeros(cap, dtype=bool)
+        self._topics: List[Optional[str]] = [None] * cap
+        self._slot_of: Dict[str, int] = {}
+        self._free: List[int] = list(range(cap - 1, -1, -1))
+        self._dev = None  # (ta, tb, ln, dl) device arrays
+        self._dirty: Optional[set] = set()  # changed slots; None = rebuild
+        self.verify_matches = True
+        self.collision_count = 0
+        self.lookups = 0
+
+    def __len__(self) -> int:
+        return len(self._slot_of)
+
+    # ----------------------------------------------------------- mutation
+
+    def insert(self, topic: str) -> None:
+        if topic in self._slot_of:
+            return
+        if not self._free:
+            self._grow()
+        slot = self._free.pop()
+        ws = topiclib.words(topic)
+        terms = self.space.topic_terms(ws)
+        self.ta[slot] = terms[0]
+        self.tb[slot] = terms[1]
+        # depth beyond the level cap can't be hashed: deep names are
+        # marked with length > any filter's max plen, so device lookups
+        # never hit them; the retainer's trie remains their (tiny) path
+        self.ln[slot] = len(ws)
+        self.dl[slot] = bool(ws) and ws[0].startswith("$")
+        self._topics[slot] = topic
+        self._slot_of[topic] = slot
+        if self._dirty is not None:
+            self._dirty.add(slot)
+
+    def delete(self, topic: str) -> None:
+        slot = self._slot_of.pop(topic, None)
+        if slot is None:
+            return
+        self.ln[slot] = -1
+        self.ta[slot] = 0
+        self.tb[slot] = 0
+        self.dl[slot] = False
+        self._topics[slot] = None
+        self._free.append(slot)
+        if self._dirty is not None:
+            self._dirty.add(slot)
+
+    def _grow(self) -> None:
+        old = self.cap
+        self.cap *= 2
+        L = self.space.max_levels
+        for name, fill in (("ta", 0), ("tb", 0), ("ln", -1), ("dl", False)):
+            arr = getattr(self, name)
+            shape = (self.cap, L) if arr.ndim == 2 else (self.cap,)
+            new = np.full(shape, fill, dtype=arr.dtype)
+            new[:old] = arr
+            setattr(self, name, new)
+        self._topics.extend([None] * (self.cap - old))
+        self._free.extend(range(self.cap - 1, old - 1, -1))
+        self._dirty = None  # shapes changed: full re-upload
+
+    # --------------------------------------------------------------- sync
+
+    def _sync(self):
+        import jax
+
+        if self._dev is None or self._dirty is None:
+            put = lambda a: jax.device_put(a.copy(), self.device)
+            self._dev = (put(self.ta), put(self.tb),
+                         put(self.ln), put(self.dl))
+            self._dirty = set()
+        elif self._dirty:
+            import jax.numpy as jnp
+
+            slots = np.fromiter(self._dirty, dtype=np.int32,
+                                count=len(self._dirty))
+            ta, tb, ln, dl = self._dev
+            js = jax.device_put(slots, self.device)
+            self._dev = (
+                ta.at[js].set(jax.device_put(self.ta[slots], self.device)),
+                tb.at[js].set(jax.device_put(self.tb[slots], self.device)),
+                ln.at[js].set(jax.device_put(self.ln[slots], self.device)),
+                dl.at[js].set(jax.device_put(self.dl[slots], self.device)),
+            )
+            self._dirty = set()
+        return self._dev
+
+    # ------------------------------------------------------------- lookup
+
+    def lookup(self, filt: str) -> List[str]:
+        """Stored names matching the filter — ONE device dispatch over
+        all rows, exact-verified host-side."""
+        if not self._slot_of:
+            return []
+        fw = topiclib.words(filt)
+        shape = self.space.shape_of(fw)
+        if shape.plen > self.space.max_levels:
+            # deeper than the hash space: host fallback over the (small)
+            # name list — same escape hatch as the engine's deep filters
+            return [t for t in self._slot_of
+                    if topiclib.match_words(topiclib.words(t), fw)]
+        ha, hb, _ = self.space.filter_key(fw)
+        ka, kb = self.space.shape_const(shape)
+        L = self.space.max_levels
+        incl = np.zeros(L, dtype=np.uint32)
+        for l in range(min(shape.plen, L)):
+            if not (shape.plus_mask >> l & 1):
+                incl[l] = 1
+        ta, tb, ln, dl = self._sync()
+        import jax
+
+        put = lambda a: jax.device_put(a, self.device)
+        ok = np.asarray(_retained_match(
+            ta, tb, ln, dl, put(incl),
+            np.uint32(ka), np.uint32(kb),  # filter_key includes K
+            np.uint32(ha), np.uint32(hb),
+            np.int32(shape.min_len()),
+            np.int32(min(shape.max_len(L), np.iinfo(np.int32).max)),
+            np.bool_(shape.wild_root),
+        ))
+        self.lookups += 1
+        out: List[str] = []
+        for slot in np.nonzero(ok)[0].tolist():
+            t = self._topics[slot]
+            if t is None:  # raced delete between sync and fetch
+                continue
+            if self.verify_matches and not topiclib.match_words(
+                topiclib.words(t), fw
+            ):
+                self.collision_count += 1
+                continue
+            out.append(t)
+        return out

@@ -78,11 +78,17 @@ class NodeRuntime:
             retain_store = DiscRetainStore(
                 os.path.join(self.conf.get("node.data_dir"), "retained.log")
             )
+        retain_index = None
+        if self.conf.get("retainer.device_index"):
+            from .models.retained import RetainedDeviceIndex
+
+            retain_index = RetainedDeviceIndex()
         retainer = Retainer(
             max_retained=self.conf.get("retainer.max_retained_messages"),
             max_payload=self.conf.get("retainer.max_payload_size"),
             enable=self.conf.get("retainer.enable"),
             store=retain_store,
+            device_index=retain_index,
         )
         # engine choice: single-chip TopicMatchEngine (default) or the
         # mesh-sharded engine over every visible device (the v5e-8 path)

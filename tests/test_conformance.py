@@ -48,6 +48,33 @@ def env(tmp_path):
     loop.close()
 
 
+@pytest.fixture
+def env2(tmp_path):
+    """Node with a tiny inbound QoS2 window (Receive Maximum tests)."""
+    loop = asyncio.new_event_loop()
+    node = NodeRuntime({
+        "node": {"data_dir": str(tmp_path)},
+        "listeners": [{"type": "tcp", "port": 0}],
+        "dashboard": {"listen_port": 0},
+        "mqtt": {"max_awaiting_rel": 3},
+    })
+    loop.run_until_complete(node.start())
+
+    class Env:
+        pass
+
+    e = Env()
+    e.loop = loop
+    e.node = node
+    e.port = node.listeners[0].port
+    e.run = lambda coro: loop.run_until_complete(
+        asyncio.wait_for(coro, 30)
+    )
+    yield e
+    loop.run_until_complete(node.stop())
+    loop.close()
+
+
 def test_basic_pubsub_all_qos(env):
     """paho 'test_basic': subscribe at qos2, publish at 0/1/2, receive
     all three with the published qos."""
@@ -276,5 +303,383 @@ def test_keepalive_expiry_fires_will(env):
         m = await s.recv(timeout=10)
         assert m.payload == b"expired"
         await s.disconnect()
+
+    env.run(main())
+
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth (verdict item 8): session-expiry, subscription ids,
+# request/response, receive-maximum violation, v5 sub-option rules,
+# aliases, packet-size limits, message expiry, malformed input, takeover.
+# ---------------------------------------------------------------------------
+
+
+def test_session_expiry_interval(env):
+    """v5: session survives within its expiry interval and is gone
+    after it (checked at resume, `emqx_cm` expiry semantics)."""
+
+    async def main():
+        props = {Property.SESSION_EXPIRY_INTERVAL: 1}
+        c = MqttClient("conf-sei", clean_start=True, properties=props)
+        await c.connect("127.0.0.1", env.port)
+        await c.subscribe("sei/t", qos=1)
+        await c.disconnect()
+
+        # immediate resume: session present
+        c2 = MqttClient("conf-sei", clean_start=False, properties=props)
+        ack = await c2.connect("127.0.0.1", env.port)
+        assert ack.session_present
+        await c2.disconnect()
+
+        await asyncio.sleep(1.6)  # past the expiry interval
+        c3 = MqttClient("conf-sei", clean_start=False, properties=props)
+        ack = await c3.connect("127.0.0.1", env.port)
+        assert not ack.session_present
+        await c3.disconnect()
+
+    env.run(main())
+
+
+def test_disconnect_overrides_session_expiry(env):
+    """v5: DISCONNECT may raise a non-zero expiry set at CONNECT
+    (a 0->nonzero override is a protocol error, MQTT-3.14.2-2)."""
+
+    async def main():
+        props = {Property.SESSION_EXPIRY_INTERVAL: 1}
+        c = MqttClient("conf-dei", clean_start=True, properties=props)
+        await c.connect("127.0.0.1", env.port)
+        await c.subscribe("dei/t", qos=1)
+        await c.disconnect(properties={Property.SESSION_EXPIRY_INTERVAL: 300})
+        await asyncio.sleep(1.2)  # beyond the CONNECT interval
+        c2 = MqttClient("conf-dei", clean_start=False, properties=props)
+        ack = await c2.connect("127.0.0.1", env.port)
+        assert ack.session_present  # DISCONNECT raised it to 300
+        await c2.disconnect()
+
+    env.run(main())
+
+
+def test_subscription_identifiers(env):
+    """v5: deliveries carry the SUBSCRIPTION_IDENTIFIER of each matching
+    subscription; overlapping subs carry both ids."""
+
+    async def main():
+        c = MqttClient("conf-sid")
+        await c.connect("127.0.0.1", env.port)
+        await c.subscribe("sid/a", qos=1,
+                          properties={Property.SUBSCRIPTION_IDENTIFIER: 7})
+        await c.subscribe("sid/#", qos=1,
+                          properties={Property.SUBSCRIPTION_IDENTIFIER: 9})
+        p = MqttClient("conf-sid-p")
+        await p.connect("127.0.0.1", env.port)
+        await p.publish("sid/a", b"x", qos=1)
+        ids = set()
+        for _ in range(2):
+            m = await c.recv()
+            v = m.properties.get(Property.SUBSCRIPTION_IDENTIFIER)
+            ids.update(v if isinstance(v, list) else [v])
+        assert ids == {7, 9}, ids
+        await c.disconnect()
+        await p.disconnect()
+
+    env.run(main())
+
+
+def test_request_response_correlation(env):
+    """v5 request/response: RESPONSE_TOPIC + CORRELATION_DATA round-trip
+    untouched through the broker."""
+
+    async def main():
+        responder = MqttClient("conf-rr-s")
+        await responder.connect("127.0.0.1", env.port)
+        await responder.subscribe("rr/req", qos=1)
+        requester = MqttClient("conf-rr-c")
+        await requester.connect("127.0.0.1", env.port)
+        await requester.subscribe("rr/resp/42", qos=1)
+        await requester.publish(
+            "rr/req", b"ask", qos=1,
+            properties={Property.RESPONSE_TOPIC: "rr/resp/42",
+                        Property.CORRELATION_DATA: b"\x01\x02"},
+        )
+        req = await responder.recv()
+        rt = req.properties[Property.RESPONSE_TOPIC]
+        cd = req.properties[Property.CORRELATION_DATA]
+        assert rt == "rr/resp/42" and cd == b"\x01\x02"
+        await responder.publish(rt, b"answer", qos=1,
+                                properties={Property.CORRELATION_DATA: cd})
+        resp = await requester.recv()
+        assert resp.payload == b"answer"
+        assert resp.properties[Property.CORRELATION_DATA] == b"\x01\x02"
+        await responder.disconnect()
+        await requester.disconnect()
+
+    env.run(main())
+
+
+def test_receive_maximum_advertised_and_violation_disconnects(env2):
+    """v5: the broker advertises its Receive Maximum in CONNACK; a
+    client exceeding it with un-released QoS2 flows is disconnected
+    with 0x93 (MQTT-3.3.4-9)."""
+
+    async def main():
+        c = MqttClient("conf-rmax", auto_ack=False)
+        ack = await c.connect("127.0.0.1", env2.port)
+        rmax = ack.properties.get(Property.RECEIVE_MAXIMUM)
+        assert rmax == 3, rmax
+        # fire rmax+1 QoS2 publishes WITHOUT releasing any
+        for i in range(rmax + 1):
+            c._send(pkt.Publish(topic="rm/t", payload=b"x", qos=2,
+                                packet_id=100 + i))
+        await asyncio.wait_for(c.closed.wait(), 10)
+        assert c.disconnect_packet is not None
+        assert c.disconnect_packet.reason_code == 0x93
+
+    env2.run(main())
+
+
+def test_shared_sub_no_local_rejected(env):
+    """v5: No Local on a shared subscription is a protocol error
+    (MQTT-3.8.3-4) — rejected per-filter in the SUBACK."""
+
+    async def main():
+        from emqx_tpu.broker.packet import SubOpts
+
+        c = MqttClient("conf-snl")
+        await c.connect("127.0.0.1", env.port)
+        rcs = await c.subscribe(
+            [("$share/g/snl/t", SubOpts(qos=1, no_local=True))]
+        )
+        assert rcs[0] == 0x82, rcs  # protocol error
+        await c.disconnect()
+
+    env.run(main())
+
+
+def test_no_local_basic(env):
+    """v5 No Local: a publisher with no_local=1 never receives its own
+    messages; another client does."""
+
+    async def main():
+        from emqx_tpu.broker.packet import SubOpts
+
+        a = MqttClient("conf-nl-a")
+        await a.connect("127.0.0.1", env.port)
+        await a.subscribe([("nl/t", SubOpts(qos=1, no_local=True))])
+        b = MqttClient("conf-nl-b")
+        await b.connect("127.0.0.1", env.port)
+        await b.subscribe("nl/t", qos=1)
+        await a.publish("nl/t", b"mine", qos=1)
+        m = await b.recv()
+        assert m.payload == b"mine"
+        with pytest.raises((TimeoutError, asyncio.TimeoutError)):
+            await a.recv(1.0)
+        await a.disconnect()
+        await b.disconnect()
+
+    env.run(main())
+
+
+def test_retain_as_published(env):
+    """v5 Retain As Published: rap=1 preserves the retain flag on
+    forwarded publishes, rap=0 (default) clears it."""
+
+    async def main():
+        from emqx_tpu.broker.packet import SubOpts
+
+        rap = MqttClient("conf-rap1")
+        await rap.connect("127.0.0.1", env.port)
+        await rap.subscribe([("rap/t", SubOpts(qos=1, retain_as_published=True))])
+        norap = MqttClient("conf-rap0")
+        await norap.connect("127.0.0.1", env.port)
+        await norap.subscribe("rap/t", qos=1)
+        p = MqttClient("conf-rap-p")
+        await p.connect("127.0.0.1", env.port)
+        await p.publish("rap/t", b"r", qos=1, retain=True)
+        m1 = await rap.recv()
+        m0 = await norap.recv()
+        assert m1.retain is True
+        assert m0.retain is False
+        for c in (rap, norap, p):
+            await c.disconnect()
+
+    env.run(main())
+
+
+def test_topic_alias_inbound(env):
+    """v5 topic aliases client->broker: an alias-only publish routes to
+    the previously bound topic."""
+
+    async def main():
+        s = MqttClient("conf-ta-s")
+        await s.connect("127.0.0.1", env.port)
+        await s.subscribe("ta/t", qos=1)
+        c = MqttClient("conf-ta-c")
+        await c.connect("127.0.0.1", env.port)
+        c._send(pkt.Publish(topic="ta/t", payload=b"one", qos=0,
+                            properties={Property.TOPIC_ALIAS: 5}))
+        c._send(pkt.Publish(topic="", payload=b"two", qos=0,
+                            properties={Property.TOPIC_ALIAS: 5}))
+        m1 = await s.recv()
+        m2 = await s.recv()
+        assert (m1.payload, m2.payload) == (b"one", b"two")
+        assert m2.topic == "ta/t"
+        await s.disconnect()
+        await c.disconnect()
+
+    env.run(main())
+
+
+def test_maximum_packet_size_outbound(env):
+    """v5: the broker must not send a packet larger than the client's
+    MAXIMUM_PACKET_SIZE — the oversized message is dropped, smaller
+    ones still flow."""
+
+    async def main():
+        small = MqttClient("conf-mps",
+                           properties={Property.MAXIMUM_PACKET_SIZE: 128})
+        await small.connect("127.0.0.1", env.port)
+        await small.subscribe("mps/t", qos=1)
+        p = MqttClient("conf-mps-p")
+        await p.connect("127.0.0.1", env.port)
+        await p.publish("mps/t", b"x" * 4096, qos=1)  # over the cap
+        await p.publish("mps/t", b"ok", qos=1)
+        m = await small.recv()
+        assert m.payload == b"ok"  # big one was dropped, not truncated
+        await small.disconnect()
+        await p.disconnect()
+
+    env.run(main())
+
+
+def test_user_properties_and_content_type_roundtrip(env):
+    async def main():
+        s = MqttClient("conf-up-s")
+        await s.connect("127.0.0.1", env.port)
+        await s.subscribe("up/t", qos=1)
+        p = MqttClient("conf-up-p")
+        await p.connect("127.0.0.1", env.port)
+        await p.publish(
+            "up/t", b"\xf0\x9f\x8c\x8d", qos=1,
+            properties={
+                Property.USER_PROPERTY: [("k1", "v1"), ("k2", "v2")],
+                Property.CONTENT_TYPE: "application/json",
+                Property.PAYLOAD_FORMAT_INDICATOR: 1,
+            },
+        )
+        m = await s.recv()
+        assert m.properties[Property.USER_PROPERTY] == [("k1", "v1"),
+                                                        ("k2", "v2")]
+        assert m.properties[Property.CONTENT_TYPE] == "application/json"
+        assert m.properties[Property.PAYLOAD_FORMAT_INDICATOR] == 1
+        await s.disconnect()
+        await p.disconnect()
+
+    env.run(main())
+
+
+def test_message_expiry_while_queued(env):
+    """v5: a message whose MESSAGE_EXPIRY_INTERVAL lapses while queued
+    for an offline session is never delivered; surviving messages are
+    delivered with the interval decremented."""
+
+    async def main():
+        props = {Property.SESSION_EXPIRY_INTERVAL: 99}
+        c = MqttClient("conf-mei", clean_start=True, properties=props)
+        await c.connect("127.0.0.1", env.port)
+        await c.subscribe("mei/t", qos=1)
+        await c.disconnect()
+
+        p = MqttClient("conf-mei-p")
+        await p.connect("127.0.0.1", env.port)
+        await p.publish("mei/t", b"dies", qos=1,
+                        properties={Property.MESSAGE_EXPIRY_INTERVAL: 1})
+        await p.publish("mei/t", b"lives", qos=1,
+                        properties={Property.MESSAGE_EXPIRY_INTERVAL: 100})
+        await p.disconnect()
+        await asyncio.sleep(1.5)
+
+        c2 = MqttClient("conf-mei", clean_start=False, properties=props)
+        ack = await c2.connect("127.0.0.1", env.port)
+        assert ack.session_present
+        m = await c2.recv()
+        assert m.payload == b"lives"
+        assert m.properties[Property.MESSAGE_EXPIRY_INTERVAL] < 100
+        with pytest.raises((TimeoutError, asyncio.TimeoutError)):
+            await c2.recv(1.0)
+        await c2.disconnect()
+
+    env.run(main())
+
+
+def test_invalid_subscribe_filter_rejected(env):
+    async def main():
+        c = MqttClient("conf-bad-f")
+        await c.connect("127.0.0.1", env.port)
+        rcs = await c.subscribe("a/#/b", qos=1)
+        assert rcs[0] >= 0x80
+        # the connection survives a per-filter rejection
+        rcs = await c.subscribe("a/b", qos=1)
+        assert rcs[0] == 1
+        await c.disconnect()
+
+    env.run(main())
+
+
+def test_publish_to_wildcard_topic_is_error(env):
+    async def main():
+        c = MqttClient("conf-bad-t")
+        await c.connect("127.0.0.1", env.port)
+        await asyncio.sleep(0)
+        c._send(pkt.Publish(topic="bad/+/topic", payload=b"x", qos=1,
+                            packet_id=1))
+        # v5: PUBACK 0x90 (topic name invalid) or disconnect
+        done = asyncio.create_task(c.closed.wait())
+        try:
+            ack = await asyncio.wait_for(
+                c._expect(pkt.PacketType.PUBACK, 1), 5
+            )
+            assert ack.reason_code == 0x90
+        except (TimeoutError, asyncio.TimeoutError):
+            assert c.closed.is_set()
+        finally:
+            done.cancel()
+        await c.close()
+
+    env.run(main())
+
+
+def test_session_takeover_kick(env):
+    """A second CONNECT with the same clientid takes the session over;
+    the first connection gets DISCONNECT 0x8E."""
+
+    async def main():
+        c1 = MqttClient("conf-tko")
+        await c1.connect("127.0.0.1", env.port)
+        c2 = MqttClient("conf-tko")
+        await c2.connect("127.0.0.1", env.port)
+        await asyncio.wait_for(c1.closed.wait(), 10)
+        assert c1.disconnect_packet is not None
+        assert c1.disconnect_packet.reason_code == 0x8E
+        await c2.disconnect()
+
+    env.run(main())
+
+
+def test_large_payload_roundtrip(env):
+    """Multi-frame payloads (well past one TCP segment) survive the
+    incremental parser intact."""
+
+    async def main():
+        s = MqttClient("conf-big-s")
+        await s.connect("127.0.0.1", env.port)
+        await s.subscribe("big/t", qos=1)
+        p = MqttClient("conf-big-p")
+        await p.connect("127.0.0.1", env.port)
+        blob = bytes(range(256)) * 1200  # ~300 KB
+        await p.publish("big/t", blob, qos=1)
+        m = await s.recv(timeout=15)
+        assert m.payload == blob
+        await s.disconnect()
+        await p.disconnect()
 
     env.run(main())

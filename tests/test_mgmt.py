@@ -267,7 +267,7 @@ def test_monitor_endpoints_and_dashboard_page(run):
 
         stt, ctype, page = await asyncio.to_thread(fetch_page)
         assert stt == 200 and ctype.startswith("text/html")
-        assert b"emqx_tpu node" in page
+        assert b"emqx_tpu" in page and b"<nav>" in page
         # unauthenticated monitor stays locked
         st, _ = await asyncio.to_thread(http, "GET", base + "/monitor")
         assert st == 401

@@ -1,0 +1,109 @@
+"""Device-resident retained-name index vs the trie oracle
+(round-3 verdict item 9: retained lookup through the engine).
+"""
+
+import random
+
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.retainer import Retainer
+from emqx_tpu.models.retained import RetainedDeviceIndex
+
+
+def _names(rng, n):
+    out = []
+    for i in range(n):
+        out.append("/".join([
+            "bldg", str(rng.randint(0, 30)), "floor",
+            str(rng.randint(0, 9)), "dev", str(i),
+        ]))
+    return out
+
+
+def test_index_matches_trie_oracle():
+    rng = random.Random(31)
+    idx = RetainedDeviceIndex(cap=64)
+    oracle = Retainer()  # trie-only
+    names = _names(rng, 3000) + ["$SYS/broker/x", "a//b", "", "deep/" * 20 + "x"]
+    for t in names:
+        idx.insert(t)
+        oracle.on_publish(Message(topic=t, payload=b"v", retain=True))
+
+    filters = [
+        "bldg/+/floor/3/dev/+", "bldg/7/#", "#", "+/+/floor/+/dev/10",
+        "bldg/1/floor/2/dev/999", "a/+", "a//b", "+", "$SYS/#",
+        "$SYS/broker/x", "nope/#", "deep/" * 20 + "x",
+    ]
+    for f in filters:
+        got = sorted(idx.lookup(f))
+        want = sorted(m.topic for m in oracle.iter_filter(f))
+        assert got == want, (f, got[:5], want[:5])
+    assert idx.collision_count == 0
+
+
+def test_index_churn_and_growth():
+    rng = random.Random(32)
+    idx = RetainedDeviceIndex(cap=8)  # forces several growths
+    oracle = Retainer()
+    live = set()
+    pool = _names(rng, 400)
+    for tick in range(6):
+        for _ in range(120):
+            t = rng.choice(pool)
+            if t in live:
+                idx.delete(t)
+                oracle.delete(t)
+                live.discard(t)
+            else:
+                idx.insert(t)
+                oracle.on_publish(Message(topic=t, payload=b"v", retain=True))
+                live.add(t)
+        f = rng.choice(["bldg/+/floor/+/dev/+", "bldg/3/#", "#"])
+        got = sorted(idx.lookup(f))
+        want = sorted(m.topic for m in oracle.iter_filter(f))
+        assert got == want, (tick, f)
+    assert len(idx) == len(live)
+
+
+def test_retainer_with_device_index_end_to_end():
+    """Retainer wired with the index serves iter_filter through the
+    kernel path, including zero-payload deletes and $-topic rules."""
+    r = Retainer(device_index=RetainedDeviceIndex(cap=16))
+    for i in range(50):
+        r.on_publish(Message(topic=f"s/{i}/t", payload=b"x", retain=True))
+    r.on_publish(Message(topic="$SYS/hidden", payload=b"x", retain=True))
+    got = sorted(m.topic for m in r.iter_filter("s/+/t"))
+    assert got == sorted(f"s/{i}/t" for i in range(50))
+    assert [m.topic for m in r.iter_filter("#")] and all(
+        not m.topic.startswith("$") for m in r.iter_filter("#")
+    )
+    # zero payload clears, index follows
+    r.on_publish(Message(topic="s/7/t", payload=b"", retain=True))
+    got = sorted(m.topic for m in r.iter_filter("s/+/t"))
+    assert "s/7/t" not in got and len(got) == 49
+    assert len(r.index) == r.count
+
+
+def test_node_config_flag(tmp_path):
+    import asyncio
+
+    from emqx_tpu.node import NodeRuntime
+
+    async def main():
+        node = NodeRuntime({
+            "node": {"data_dir": str(tmp_path)},
+            "listeners": [{"type": "tcp", "port": 0}],
+            "dashboard": {"listen_port": 0},
+            "retainer": {"device_index": True},
+        })
+        await node.start()
+        try:
+            assert node.broker.retainer.index is not None
+            node.broker.publish(
+                Message(topic="cfg/t", payload=b"r", retain=True)
+            )
+            msgs = node.broker.retained_for("cfg/+", rh=0, is_new_sub=True)
+            assert [m.topic for m in msgs] == ["cfg/t"]
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
