@@ -21,7 +21,33 @@ import numpy as np
 
 log = logging.getLogger("emqx_tpu.native")
 
-_LIB_PATH = os.path.join(os.path.dirname(__file__), "libemqxtpu.so")
+
+def _isa_tag() -> str:
+    """Host ISA fingerprint for the build cache: the lib is compiled
+    -march=native, so a .so built on one machine must not be loaded on a
+    host lacking those instructions (SIGILL is not catchable) — the CPU
+    flag set is part of the cache key."""
+    import hashlib
+    import platform
+
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo", "r", encoding="utf-8") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    tag += hashlib.sha1(
+                        " ".join(sorted(line.split(":", 1)[1].split()))
+                        .encode()
+                    ).hexdigest()[:10]
+                    break
+    except OSError:  # pragma: no cover - non-linux
+        pass
+    return tag
+
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(__file__), f"libemqxtpu-{_isa_tag()}.so"
+)
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SRCS = [
     os.path.join(_SRC_DIR, "matchhash.cc"),
@@ -44,16 +70,22 @@ def _build() -> bool:
     srcs = [os.path.abspath(s) for s in _SRCS if os.path.exists(s)]
     if not srcs:
         return False
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-Wall", "-fPIC", "-std=c++17", "-shared",
-             "-pthread", "-o", _LIB_PATH] + srcs,
-            check=True, capture_output=True, timeout=120,
-        )
-        return True
-    except (OSError, subprocess.SubprocessError) as e:
-        log.info("native build unavailable: %s", e)
-        return False
+    base = ["g++", "-O3", "-Wall", "-fPIC", "-std=c++17", "-shared",
+            "-pthread", "-o", _LIB_PATH]
+    # -march=native first: the hash contractions in the host match are
+    # u32 multiply-add loops that vectorize well past the SSE2 baseline;
+    # retried portable if the toolchain rejects it
+    for extra in (["-march=native"], []):
+        try:
+            subprocess.run(
+                base + extra + srcs,
+                check=True, capture_output=True, timeout=120,
+            )
+            return True
+        except (OSError, subprocess.SubprocessError) as e:
+            err = e
+    log.info("native build unavailable: %s", err)
+    return False
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:

@@ -302,6 +302,13 @@ class ShardedMatchEngine:
         self._deep = CpuTrieIndex()
         self._deep_fids: Set[int] = set()
 
+        # native fid -> filter-string registry (same contract as the
+        # single-chip engine): registry-backed device-hit verification,
+        # no per-batch Python blob assembly; None without the native lib
+        from ..ops import native as _native
+
+        self._reg = _native.make_registry()
+
         self._stacked: Optional[DeviceTables] = None
         self._dest_dev: Optional[jax.Array] = None
 
@@ -317,7 +324,8 @@ class ShardedMatchEngine:
             return fid
         fid = self._free_fids[-1] if self._free_fids else self._next_fid
         ws = topiclib.words(filt)
-        if self.space.shape_of(ws).plen > self.space.max_levels:
+        deep = self.space.shape_of(ws).plen > self.space.max_levels
+        if deep:
             self._deep.insert(filt, fid)
             self._deep_fids.add(fid)
         else:
@@ -329,8 +337,11 @@ class ShardedMatchEngine:
             self._next_fid += 1
         self._fids[filt] = fid
         self._refs[fid] = 1
-        self._words[fid] = ws
-        self._fbytes[fid] = filt.encode("utf-8")
+        if deep or self._reg is None:
+            self._words[fid] = ws
+            self._fbytes[fid] = filt.encode("utf-8")
+        else:
+            self._reg.set_bulk([fid], [filt.encode("utf-8")])
         if fid >= self._dest_cap:
             self._dest_cap *= 2
             nd = np.zeros(self._dest_cap, dtype=np.int32)
@@ -405,11 +416,17 @@ class ShardedMatchEngine:
             self._next_fid = next_mark
             raise
         # commit
+        reg_fids: List[int] = []
+        reg_blobs: List[bytes] = []
         for filt, fid, ws, deep in plan:
             self._fids[filt] = fid
             self._refs[fid] = local_refs[fid]
-            self._words[fid] = ws
-            self._fbytes[fid] = filt.encode("utf-8")
+            if deep or self._reg is None:
+                self._words[fid] = ws
+                self._fbytes[fid] = filt.encode("utf-8")
+            else:
+                reg_fids.append(fid)
+                reg_blobs.append(filt.encode("utf-8"))
             if deep:
                 self._deep.insert(filt, fid)
                 self._deep_fids.add(fid)
@@ -420,6 +437,8 @@ class ShardedMatchEngine:
                 nd[: len(self._dest)] = self._dest
                 self._dest = nd
             self._dest[fid] = fid % self.n_sub
+        if reg_fids:
+            self._reg.set_bulk(reg_fids, reg_blobs)
         if plan:
             self._dest_dirty = True
         return fids
@@ -433,13 +452,15 @@ class ShardedMatchEngine:
             return None
         del self._refs[fid]
         del self._fids[filt]
-        del self._words[fid]
-        del self._fbytes[fid]
+        self._words.pop(fid, None)
+        self._fbytes.pop(fid, None)
         if fid in self._deep_fids:
             self._deep_fids.discard(fid)
             self._deep.delete(filt, fid)
         else:
             self.shards[fid % self.D].delete(fid)
+            if self._reg is not None:
+                self._reg.del_bulk([fid])
         self._free_fids.append(fid)
         return fid
 
@@ -679,17 +700,36 @@ class ShardedMatchEngine:
             _d, bb, jj = np.nonzero(hits >= 0)
             if bb.size:
                 fids = hits[_d, bb, jj]
-                tmp: List[Set[int]] = [set() for _ in topics]
-                if self.verify_matches:
-                    verify_pairs_into(
-                        topics, bb, fids, self._words, self._fbytes,
-                        tmp, self._collide,
+                verified = False
+                if self.verify_matches and self._reg is not None:
+                    from ..ops import native
+
+                    tbuf, toffs = native.pack_strs(topics)
+                    ok = native.verify_pairs_reg(
+                        self._reg, tbuf, toffs,
+                        bb.astype(np.int32), fids,
                     )
-                    for o, s in zip(out, tmp):
-                        o.extend(s)
-                else:
-                    for i, f in zip(bb.tolist(), fids.tolist()):
-                        out[i].append(int(f))
+                    if ok is not None:
+                        for i, f, good in zip(
+                            bb.tolist(), fids.tolist(), ok.tolist()
+                        ):
+                            if good:
+                                out[i].append(int(f))
+                            else:
+                                self._collide(topics[i], int(f))
+                        verified = True
+                if not verified:
+                    if self.verify_matches:
+                        tmp: List[Set[int]] = [set() for _ in topics]
+                        verify_pairs_into(
+                            topics, bb, fids, self._words, self._fbytes,
+                            tmp, self._collide,
+                        )
+                        for o, s in zip(out, tmp):
+                            o.extend(s)
+                    else:
+                        for i, f in zip(bb.tolist(), fids.tolist()):
+                            out[i].append(int(f))
         if pending.deep is not None:
             for o, hits_i in zip(out, pending.deep):
                 o.extend(hits_i)
