@@ -197,3 +197,34 @@ def test_broker_hybrid_end_to_end():
     n = b.publish(Message(topic="s/1/t", payload=b"x"))
     assert n == 2
     assert sorted(seen) == [("c1", "s/+/t"), ("c2", "s/1/t")]
+
+
+def test_probe_delta_bounded_under_churn_backlog():
+    """A probe dispatch applies at most a chunk of a huge churn backlog
+    (the upload rides the serving thread); the remainder stays pending
+    and a later device-mode dispatch drains it fully."""
+    # base population large enough that the churn below stays under the
+    # load factor (no rebuild: a rebuild replaces the delta wholesale)
+    filters, topics = _population(40_000)
+    eng, fids = _engine(filters)
+    eng.sync_device()  # clear the bulk-load rebuild flag first
+    eng.hybrid = True
+    eng.probe_interval = 0.0  # probe eagerly
+    eng.rate_host = 1e9  # host serves
+
+    # big churn backlog (> the 8192-slot probe chunk)
+    eng.apply_churn([f"bulkchurn/{i}/+" for i in range(9000)], [])
+    assert len(eng.tables.delta.slots) > 8192
+
+    pend = eng.match_submit(topics)
+    assert pend.mode == "host"
+    assert eng._probe is not None
+    # probe drained only the chunk; the tail is still pending
+    assert 0 < len(eng.tables.delta.slots) <= 9000 - 8192 + 64
+
+    eng.match_collect(pend)
+    # device-mode dispatch drains the rest and matches correctly
+    eng.hybrid = False
+    res = eng.match(["bulkchurn/8999/x", "bulkchurn/1/x"])
+    assert res[0] == {eng.fid_of("bulkchurn/8999/+")}
+    assert res[1] == {eng.fid_of("bulkchurn/1/+")}
