@@ -166,6 +166,9 @@ class TopicMatchEngine:
         # arbiter is unbiased, while a dead link only ever pays small
         # probes
         self._probe_cap = 512
+        # churn-delta slots a single probe dispatch may ship (the rest
+        # stays pending; see _maybe_probe_device's sync policy)
+        self.probe_delta_cap = 8192
         self._last_dev_meas = 0.0
         self._last_host_meas = 0.0
         # The match hot path is pure XLA by design.  A Pallas kernel for
@@ -358,23 +361,29 @@ class TopicMatchEngine:
         the fids assigned to `adds`.
         """
         dead_fids: List[int] = []
+        _fids = self._fids
+        refs = self._refs
+        words = self._words
+        fbytes = self._fbytes
+        deep_fids = self._deep_fids
+        free = self._free_fids
         for filt in removes:
-            fid = self._fids.get(filt)
+            fid = _fids.get(filt)
             if fid is None:
                 continue
-            self._refs[fid] -= 1
-            if self._refs[fid] > 0:
+            refs[fid] -= 1
+            if refs[fid] > 0:
                 continue
-            del self._refs[fid]
-            del self._fids[filt]
-            self._words.pop(fid, None)
-            self._fbytes.pop(fid, None)
-            if fid in self._deep_fids:
-                self._deep_fids.discard(fid)
+            del refs[fid]
+            del _fids[filt]
+            words.pop(fid, None)
+            fbytes.pop(fid, None)
+            if fid in deep_fids:
+                deep_fids.discard(fid)
                 self._deep.delete(filt, fid)
             else:
                 dead_fids.append(fid)
-            self._free_fids.append(fid)
+            free.append(fid)
         if dead_fids:
             self.tables.delete_batch(dead_fids)
             if self._reg is not None:
@@ -384,34 +393,43 @@ class TopicMatchEngine:
         new_fids: List[int] = []
         new_words: List[List[str]] = []
         has_reg = self._reg is not None
+        out_append = out.append
+        strs_append = new_strs.append
+        nfids_append = new_fids.append
+        nxt = self._next_fid
         for filt in adds:
-            fid = self._fids.get(filt)
+            fid = _fids.get(filt)
             if fid is not None:
-                self._refs[fid] += 1
-                out.append(fid)
+                refs[fid] += 1
+                out_append(fid)
                 continue
-            fid = self._free_fids.pop() if self._free_fids else self._alloc_fid()
-            self._fids[filt] = fid
-            self._refs[fid] = 1
+            if free:
+                fid = free.pop()
+            else:
+                fid = nxt
+                nxt += 1
+            _fids[filt] = fid
+            refs[fid] = 1
             if has_reg:
                 # deep routing + key computation happen in one native
                 # batch pass below — no per-filter words()/encode here
-                new_strs.append(filt)
-                new_fids.append(fid)
+                strs_append(filt)
+                nfids_append(fid)
             else:
                 ws = topiclib.words(filt)
                 if self._is_deep(ws):
-                    self._words[fid] = ws
-                    self._fbytes[fid] = filt.encode("utf-8")
+                    words[fid] = ws
+                    fbytes[fid] = filt.encode("utf-8")
                     self._deep.insert(filt, fid)
-                    self._deep_fids.add(fid)
+                    deep_fids.add(fid)
                 else:
-                    self._words[fid] = ws
-                    self._fbytes[fid] = filt.encode("utf-8")
-                    new_strs.append(filt)
-                    new_fids.append(fid)
+                    words[fid] = ws
+                    fbytes[fid] = filt.encode("utf-8")
+                    strs_append(filt)
+                    nfids_append(fid)
                     new_words.append(ws)
-            out.append(fid)
+            out_append(fid)
+        self._next_fid = nxt
         if new_strs:
             if has_reg:
                 from ..ops import native
@@ -771,28 +789,38 @@ class TopicMatchEngine:
         # as the hybrid p99 spike); fast probes escalate the cap so
         # healthy hardware is measured at real batch sizes
         probe_topics = list(topics[: self._probe_cap])
-        # bound the churn delta fused into a probe dispatch: under heavy
-        # churn the backlog since the last probe can reach MBs, and its
-        # upload rides the serving thread (measured: 109 ms p99 at 10M
-        # filters + 5%/s churn).  A probe applies at most a chunk; the
-        # rest stays pending — the mirror is a cache, and device-mode
-        # serving drains the full delta on its first real dispatch
-        d = self.tables.delta
-        cap = 8192
-        if len(d.slots) > cap and not d.rebuilt:
-            from ..ops.tables import Delta
+        # bound what a probe dispatch ships over the (possibly degraded)
+        # link on the SERVING thread.  Under heavy churn the backlog
+        # since the last probe can reach MBs (measured: 109 ms p99 at
+        # 10M filters + 5%/s churn), and a pending rebuild would mean a
+        # full-table re-upload (minutes at tunnel bandwidth).  Policy:
+        #   small delta        -> fuse into the probe (normal)
+        #   medium backlog     -> compress, apply one chunk, keep rest
+        #   huge/rebuilt + big table -> measure on the STALE mirror; a
+        #      real device-mode dispatch (or a shrunken backlog) syncs.
+        # compressed() bounds the backlog itself: fid-reuse churn
+        # rewrites the same slots, so the kept rows never exceed the
+        # live table's slot count.
+        from ..ops.tables import Delta
 
-            self.tables.delta = Delta(
-                slots=d.slots[:cap], key_a=d.key_a[:cap],
-                key_b=d.key_b[:cap], val=d.val[:cap],
-                desc_dirty=d.desc_dirty,
-            )
-            tail = Delta(
-                slots=d.slots[cap:], key_a=d.key_a[cap:],
-                key_b=d.key_b[cap:], val=d.val[cap:],
-            )
-        else:
-            tail = None
+        d = self.tables.delta
+        cap = self.probe_delta_cap
+        tail = None
+        big_table = self.tables.n_entries > 1_000_000
+        if (d.rebuilt or self._dev is None) and big_table:
+            if self._dev is None:
+                return  # no mirror to measure; boot warm/device mode builds it
+            tail = d  # detach: probe matches the stale mirror
+            self.tables.delta = Delta()
+        elif len(d.slots) > cap and not d.rebuilt:
+            d = d.compressed()
+            if len(d.slots) > 4 * cap and big_table:
+                self.tables.delta = Delta(desc_dirty=d.desc_dirty)
+                tail = Delta(slots=d.slots, key_a=d.key_a,
+                             key_b=d.key_b, val=d.val)
+            else:
+                head, tail = d.split(cap)
+                self.tables.delta = head
         t0 = time.monotonic()
         try:
             pend = self._device_submit(probe_topics)
@@ -803,17 +831,9 @@ class TopicMatchEngine:
             return
         finally:
             if tail is not None:
-                cur = self.tables.delta
-                from ..ops.tables import Delta
-
-                self.tables.delta = Delta(
-                    slots=cur.slots + tail.slots,
-                    key_a=cur.key_a + tail.key_a,
-                    key_b=cur.key_b + tail.key_b,
-                    val=cur.val + tail.val,
-                    desc_dirty=cur.desc_dirty or tail.desc_dirty,
-                    rebuilt=cur.rebuilt or tail.rebuilt,
-                )
+                # older writes (an undrained head on the exception path)
+                # precede the detached tail
+                self.tables.delta = self.tables.delta.merge(tail)
         self._probe = (pend.out, t0, len(pend.topics))
 
     def _timed_fetch(self, pending: "_PendingMatch") -> Optional[np.ndarray]:

@@ -76,6 +76,34 @@ class Delta:
             rebuilt=self.rebuilt,
         )
 
+    def split(self, n: int) -> Tuple["Delta", "Delta"]:
+        """(head, tail): the first n slot-writes and the remainder.
+
+        The desc/rebuild flags ride the HEAD (they are tiny or handled
+        wholesale by sync); callers apply head before tail so the
+        slot-write order — and compressed()'s last-write-wins — holds."""
+        head = Delta(
+            slots=self.slots[:n], key_a=self.key_a[:n],
+            key_b=self.key_b[:n], val=self.val[:n],
+            desc_dirty=self.desc_dirty, rebuilt=self.rebuilt,
+        )
+        tail = Delta(
+            slots=self.slots[n:], key_a=self.key_a[n:],
+            key_b=self.key_b[n:], val=self.val[n:],
+        )
+        return head, tail
+
+    def merge(self, newer: "Delta") -> "Delta":
+        """This delta's writes followed by `newer`'s (order preserved)."""
+        return Delta(
+            slots=self.slots + newer.slots,
+            key_a=self.key_a + newer.key_a,
+            key_b=self.key_b + newer.key_b,
+            val=self.val + newer.val,
+            desc_dirty=self.desc_dirty or newer.desc_dirty,
+            rebuilt=self.rebuilt or newer.rebuilt,
+        )
+
 
 class MatchTables:
     """Numpy mirror of the device tables + incremental mutation log."""
@@ -365,10 +393,11 @@ class MatchTables:
             n_ok, slots = 0, np.zeros(0, dtype=np.int32)
         else:
             n_ok, slots = placed
-        self.delta.slots.extend(int(s) for s in slots[:n_ok])
-        self.delta.key_a.extend(int(x) for x in ha[:n_ok])
-        self.delta.key_b.extend(int(x) for x in hb[:n_ok])
-        self.delta.val.extend(int(f) for f in fid_arr[:n_ok])
+        # .tolist() over genexprs: one C conversion pass per column
+        self.delta.slots.extend(slots[:n_ok].tolist())
+        self.delta.key_a.extend(ha[:n_ok].tolist())
+        self.delta.key_b.extend(hb[:n_ok].tolist())
+        self.delta.val.extend(fid_arr[:n_ok].tolist())
         if n_ok < n:
             # a probe window filled: grow + native rebuild covers the
             # remainder — NOT _grow_table, whose per-entry Python

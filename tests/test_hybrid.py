@@ -212,19 +212,20 @@ def test_probe_delta_bounded_under_churn_backlog():
     eng.probe_interval = 0.0  # probe eagerly
     eng.rate_host = 1e9  # host serves
 
-    # big churn backlog (> the 8192-slot probe chunk)
-    eng.apply_churn([f"bulkchurn/{i}/+" for i in range(9000)], [])
-    assert len(eng.tables.delta.slots) > 8192
+    # big churn backlog (> the probe chunk)
+    cap = eng.probe_delta_cap
+    eng.apply_churn([f"bulkchurn/{i}/+" for i in range(cap + 808)], [])
+    assert len(eng.tables.delta.slots) > cap
 
     pend = eng.match_submit(topics)
     assert pend.mode == "host"
     assert eng._probe is not None
     # probe drained only the chunk; the tail is still pending
-    assert 0 < len(eng.tables.delta.slots) <= 9000 - 8192 + 64
+    assert 0 < len(eng.tables.delta.slots) <= 808 + 64
 
     eng.match_collect(pend)
     # device-mode dispatch drains the rest and matches correctly
     eng.hybrid = False
-    res = eng.match(["bulkchurn/8999/x", "bulkchurn/1/x"])
-    assert res[0] == {eng.fid_of("bulkchurn/8999/+")}
+    res = eng.match([f"bulkchurn/{cap + 807}/x", "bulkchurn/1/x"])
+    assert res[0] == {eng.fid_of(f"bulkchurn/{cap + 807}/+")}
     assert res[1] == {eng.fid_of("bulkchurn/1/+")}
