@@ -389,8 +389,13 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     # the HBM mirror warm; on co-located hardware it serves device-side.
     import gc
 
+    # mirror the node runtime's dedicated-process GC tuning (NodeRuntime
+    # start(): freeze the resident object graph, raise gen0 so young-gen
+    # sweeps don't land in the match path's p99)
     gc.collect()
-    gc.freeze()  # mirrors the node runtime's dedicated-process GC tuning
+    gc.freeze()
+    _g0, _g1, _g2 = gc.get_threshold()
+    gc.set_threshold(50_000, _g1, _g2)
     eng.hybrid = True
     eng.match(batches_str[0])  # arbiter measures; probe dispatched
     eng.match(batches_str[1])

@@ -225,7 +225,10 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
             "enum",
             "single",
             enum=["single", "sharded"],
-            desc="match engine: single-chip or mesh-sharded (multi-chip)",
+            desc="match engine: single-chip (with hybrid host/device "
+                 "arbitration, see broker.hybrid) or mesh-sharded — the "
+                 "multi-chip deployment for real ICI meshes, where the "
+                 "device path wins and host arbitration does not apply",
         ),
         "shared_subscription_strategy": Field(
             "enum",
