@@ -19,8 +19,9 @@ Hybrid host/device arbitration: the reference never pays a wire to match
 (`emqx_router.erl:127-140` — matching is an in-node ETS walk).  When the
 host<->device link is degraded (measured, not assumed), this engine
 serves matches from a native host-side probe over the SAME table arrays
-the device mirrors (`native/matchhash.cc etpu_match_host` — identical
-shape-enumeration semantics by construction), keeps the HBM mirror warm
+the device mirrors (`native/registry.cc etpu_match_host_verified` —
+identical shape-enumeration semantics by construction), keeps the HBM
+mirror warm
 with periodic probe dispatches, and switches back the moment the
 measured device rate beats the host rate.  Device-served batches carry a
 timeout fallback to the host path, so a mid-traffic device stall can
@@ -38,7 +39,6 @@ from ..ops import hashing
 from ..ops.match import (
     DeviceTables,
     TopicBatch,
-    match_batch_jit,
     next_pow2 as _next_pow2,
 )
 from ..ops.tables import MatchTables
@@ -179,7 +179,6 @@ class TopicMatchEngine:
         # win either at the 10M-filter target: the probe tables
         # (hundreds of MB) exceed VMEM, so the probe stays HBM random
         # access, which XLA's native gather already is.
-        self._match_fn = match_batch_jit
 
     # ------------------------------------------------------------ mutation
 

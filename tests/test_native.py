@@ -270,3 +270,108 @@ def test_verify_pairs_matches_python_semantics():
     for t, f, got in zip(tlist, flist, ok.tolist()):
         want = topiclib.match_words(topiclib.words(t), topiclib.words(f))
         assert got == want, (t, f, got, want)
+
+
+# ------------------------------------------------------- round-4 natives
+
+def test_registry_set_del_count():
+    from emqx_tpu.ops import native
+
+    reg = native.make_registry()
+    if reg is None:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    reg.set_bulk([0, 5, 3], [b"a/b", b"c/+", b"d/#"])
+    assert reg.count() == 3
+    reg.set_bulk([5], [b"c/changed"])  # overwrite, not a new entry
+    assert reg.count() == 3
+    reg.del_bulk([5, 99])  # unknown fid is a no-op
+    assert reg.count() == 2
+    # growth well past the initial capacity
+    reg.set_bulk(list(range(100, 5000)), [b"x/%d" % i for i in range(100, 5000)])
+    assert reg.count() == 2 + 4900
+
+
+def test_verify_pairs_reg_semantics():
+    from emqx_tpu.ops import native
+
+    reg = native.make_registry()
+    if reg is None:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    reg.set_bulk([0, 1, 2, 3], [b"a/+/c", b"a/#", b"$sys/#", b"x"])
+    topics = ["a/b/c", "a", "$sys/x", "x", ""]
+    tbuf, toffs = native.pack_strs(topics)
+    import numpy as np
+
+    tidx = np.array([0, 0, 1, 2, 3, 0, 2], dtype=np.int32)
+    fids = np.array([0, 1, 1, 2, 3, 3, 99], dtype=np.int32)
+    ok = native.verify_pairs_reg(reg, tbuf, toffs, tidx, fids)
+    #     a/b/c~a/+/c  a/b/c~a/#  a~a/#  $sys/x~$sys/#  x~x  a/b/c~x  absent
+    assert ok.tolist() == [True, True, True, True, True, False, False]
+
+
+def test_match_host_verified_matches_oracle():
+    """The fused native pipeline end-to-end at the native API level,
+    against the exact Python matcher."""
+    import random
+
+    import numpy as np
+
+    from emqx_tpu.broker import topic as topiclib
+    from emqx_tpu.ops import native
+    from emqx_tpu.ops.hashing import HashSpace
+    from emqx_tpu.ops.tables import MatchTables, PROBE
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    rng = random.Random(55)
+    space = HashSpace()
+    t = MatchTables(space)
+    reg = native.make_registry()
+    seen = set()
+    filters = []
+    for i in range(4000):
+        ws = ["f", str(rng.randint(0, 50)), "g", str(i)]
+        r = rng.random()
+        if r < 0.3:
+            ws[rng.choice([1, 3])] = "+"
+        elif r < 0.4:
+            # '#' must stay the LAST level (invalid filters are gated at
+            # SUBSCRIBE and never reach the engine): uniquify BEFORE it
+            ws = ws[: rng.randint(1, 3)] + [f"u{i}", "#"]
+        f = "/".join(ws)
+        if f in seen:
+            continue  # duplicate wildcard pattern: engines refcount these
+        seen.add(f)
+        filters.append(f)
+    for i, f in enumerate(filters):
+        t.insert(topiclib.words(f), i)
+    reg.set_bulk(list(range(len(filters))), [f.encode() for f in filters])
+
+    topics = [f"f/{rng.randint(0, 50)}/g/{rng.randint(0, 4000)}"
+              for _ in range(700)] + ["$f/1/g/2", "f//g/3", ""]
+    tbuf, toffs = native.pack_strs(topics)
+    vcap = int(t.valid.sum())
+    fids, counts, colls = native.match_host_verified(
+        reg, tbuf, toffs, len(topics), space,
+        t.key_a, t.key_b, t.val, t.log2cap, PROBE,
+        t.incl, t.k_a, t.k_b, t.min_len, t.max_len,
+        t.wild_root, t.valid, vcap,
+    )
+    assert colls == []
+    offs = np.zeros(len(topics) + 1, dtype=np.int64)
+    np.cumsum(counts, out=offs[1:])
+    fl = fids.tolist()
+    for i, topic in enumerate(topics):
+        got = set(fl[offs[i]:offs[i + 1]])
+        tw = topiclib.words(topic)
+        want = {
+            fid for fid, f in enumerate(filters)
+            if topiclib.match_words(tw, topiclib.words(f))
+        }
+        assert got == want, (topic, got, want)
