@@ -48,7 +48,7 @@ def _free_ports(n):
     return ports
 
 
-def _write_conf(d, name, mqtt_port, dash_port, cport, peers):
+def _write_conf(d, name, mqtt_port, dash_port, cport, peers, role="core"):
     conf = {
         "node": {"name": name, "data_dir": d},
         "log": {"level": "WARNING"},
@@ -59,6 +59,7 @@ def _write_conf(d, name, mqtt_port, dash_port, cport, peers):
             "enable": True,
             "host": "127.0.0.1",
             "port": cport,
+            "role": role,
             "peers": {p: ["127.0.0.1", pp] for p, pp in peers.items()},
         },
     }
@@ -166,6 +167,89 @@ async def _connected_pair(ports, cid_a="ca", cid_b="cb", **kw):
     b = MqttClient(cid_b, **kw)
     await b.connect(port=ports["mqtt_b"])
     return a, b
+
+
+def test_three_node_core_replicant_topology():
+    """Core/core/replicant in three real processes: a replicant serves
+    subscribers through the core mesh, and survives one core's death
+    (`emqx_conf_schema.erl:328-342` core/replicant topology)."""
+    ports = _free_ports(9)
+    (mq_a, mq_b, mq_c, da, db, dc, ca, cb, cc) = ports
+    dirs = [tempfile.mkdtemp(prefix=f"fvt3_{x}_") for x in ("a", "b", "c")]
+
+    pa = _spawn(_write_conf(dirs[0], "a3@fvt", mq_a, da, ca,
+                            {"b3@fvt": cb, "c3@fvt": cc}))
+    pb = _spawn(_write_conf(dirs[1], "b3@fvt", mq_b, db, cb,
+                            {"a3@fvt": ca, "c3@fvt": cc}))
+    pc = _spawn(_write_conf(dirs[2], "c3@fvt", mq_c, dc, cc,
+                            {"a3@fvt": ca, "b3@fvt": cb},
+                            role="replicant"))
+    procs = [pa, pb, pc]
+    try:
+        async def main():
+            await asyncio.gather(*(_wait_port(p) for p in (mq_a, mq_b, mq_c)))
+            # wait for the mesh as seen from core a
+            deadline = time.monotonic() + 90
+            tok = None
+            while time.monotonic() < deadline:
+                try:
+                    nodes, tok = _rest(da, "/nodes", tok)
+                except Exception:
+                    await asyncio.sleep(0.5)
+                    continue
+                up = {n["node"] for n in nodes
+                      if n["node_status"] == "running"}
+                if {"a3@fvt", "b3@fvt", "c3@fvt"} <= up:
+                    break
+                await asyncio.sleep(0.5)
+            else:
+                raise AssertionError("3-node mesh never formed")
+
+            # replicant subscriber receives publishes from a core
+            sub = MqttClient("r_sub")
+            await sub.connect(port=mq_c)
+            await sub.subscribe("tri/+", qos=1)
+            pub = MqttClient("r_pub")
+            await pub.connect(port=mq_a)
+            async def pub_until(topic, payload):
+                # publish with retries (route replication is async) and
+                # drain the duplicates those retries queue up; a PUBACK
+                # timeout (e.g. while the origin's link to a freshly
+                # killed core times out) just consumes a retry
+                for _ in range(40):
+                    try:
+                        await pub.publish(topic, payload, qos=1)
+                        while True:
+                            m = await sub.recv(0.5)
+                            if m.payload == payload:
+                                return m
+                    except (TimeoutError, asyncio.TimeoutError):
+                        continue
+                return None
+
+            got = await pub_until("tri/x", b"core-to-repl")
+            assert got is not None
+
+            # kill core b: replicant keeps serving through core a
+            pb.send_signal(signal.SIGKILL)
+            pb.wait(timeout=10)
+            await asyncio.sleep(2.0)
+            got = await pub_until("tri/y", b"after-core-death")
+            assert got is not None
+            await sub.disconnect()
+            await pub.disconnect()
+
+        asyncio.run(asyncio.wait_for(main(), 280))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
 
 
 def test_pubsub_both_directions(two_nodes):
