@@ -188,8 +188,9 @@ def test_three_node_core_replicant_topology():
     try:
         async def main():
             await asyncio.gather(*(_wait_port(p) for p in (mq_a, mq_b, mq_c)))
-            # wait for the mesh as seen from core a
-            deadline = time.monotonic() + 90
+            # wait for the mesh as seen from core a (generous: heavily
+            # loaded CI hosts boot three XLA-warming nodes slowly)
+            deadline = time.monotonic() + 150
             tok = None
             while time.monotonic() < deadline:
                 try:
