@@ -161,11 +161,29 @@ async def _boot(mqtt_a, mqtt_b):
     await asyncio.gather(_wait_port(mqtt_a), _wait_port(mqtt_b))
 
 
+async def _connect(cid, port, **kw):
+    """Connect with retries: a loaded host can trip the node's OLP,
+    which sheds new connections by design — the test's job is to wait
+    it out, not to fail."""
+    last = None
+    for attempt in range(6):
+        c = MqttClient(cid, **kw)
+        try:
+            await c.connect(port=port)
+            return c
+        except Exception as e:
+            last = e
+            try:
+                await c.close()
+            except Exception:
+                pass
+            await asyncio.sleep(1.0 + attempt)
+    raise AssertionError(f"connect {cid} never accepted: {last!r}")
+
+
 async def _connected_pair(ports, cid_a="ca", cid_b="cb", **kw):
-    a = MqttClient(cid_a, **kw)
-    await a.connect(port=ports["mqtt_a"])
-    b = MqttClient(cid_b, **kw)
-    await b.connect(port=ports["mqtt_b"])
+    a = await _connect(cid_a, ports["mqtt_a"], **kw)
+    b = await _connect(cid_b, ports["mqtt_b"], **kw)
     return a, b
 
 
@@ -207,11 +225,9 @@ def test_three_node_core_replicant_topology():
                 raise AssertionError("3-node mesh never formed")
 
             # replicant subscriber receives publishes from a core
-            sub = MqttClient("r_sub")
-            await sub.connect(port=mq_c)
+            sub = await _connect("r_sub", mq_c)
             await sub.subscribe("tri/+", qos=1)
-            pub = MqttClient("r_pub")
-            await pub.connect(port=mq_a)
+            pub = await _connect("r_pub", mq_a)
             async def pub_until(topic, payload):
                 # publish with retries (route replication is async) and
                 # drain the duplicates those retries queue up; a PUBACK
@@ -289,8 +305,7 @@ def test_shared_group_single_delivery(two_nodes):
         a, b = await _connected_pair(two_nodes, "sg_a", "sg_b")
         await a.subscribe("$share/g1/sg/t", qos=1)
         await b.subscribe("$share/g1/sg/t", qos=1)
-        pub = MqttClient("sg_pub")
-        await pub.connect(port=two_nodes["mqtt_b"])
+        pub = await _connect("sg_pub", two_nodes["mqtt_b"])
         await asyncio.sleep(1.0)  # let group membership replicate
         n_pub = 10
         for i in range(n_pub):
@@ -317,16 +332,16 @@ def test_shared_group_single_delivery(two_nodes):
 def test_cross_node_takeover(two_nodes):
     async def main():
         props = {pkt.Property.SESSION_EXPIRY_INTERVAL: 300}
-        c1 = MqttClient("tk_roam", clean_start=True, properties=props)
-        await c1.connect(port=two_nodes["mqtt_a"])
+        c1 = await _connect("tk_roam", two_nodes["mqtt_a"],
+                            clean_start=True, properties=props)
         await c1.subscribe("tk/+", qos=1)
         await asyncio.sleep(0.8)  # route replication
         # same clientid connects on node B: cross-node takeover
-        c2 = MqttClient("tk_roam", clean_start=False, properties=props)
-        ack = await c2.connect(port=two_nodes["mqtt_b"])
+        c2 = await _connect("tk_roam", two_nodes["mqtt_b"],
+                            clean_start=False, properties=props)
+        ack = c2.connack
         assert ack.session_present, "takeover must resume the session"
-        pub = MqttClient("tk_pub")
-        await pub.connect(port=two_nodes["mqtt_a"])
+        pub = await _connect("tk_pub", two_nodes["mqtt_a"])
         got = None
         for _ in range(40):
             await pub.publish("tk/1", b"after-takeover", qos=1)
@@ -348,20 +363,20 @@ def test_parked_persistent_session_remote_delivery(two_nodes):
 
     async def main():
         props = {pkt.Property.SESSION_EXPIRY_INTERVAL: 300}
-        parked = MqttClient("parked_b", clean_start=True, properties=props)
-        await parked.connect(port=two_nodes["mqtt_b"])
+        parked = await _connect("parked_b", two_nodes["mqtt_b"],
+                                clean_start=True, properties=props)
         await parked.subscribe("pk/q", qos=1)
         await asyncio.sleep(1.0)  # route replication to A
         await parked.disconnect()  # park: session + route must survive
 
-        pub = MqttClient("pk_pub")
-        await pub.connect(port=two_nodes["mqtt_a"])
+        pub = await _connect("pk_pub", two_nodes["mqtt_a"])
         await pub.publish("pk/q", b"while-parked", qos=1)
         await pub.disconnect()
         await asyncio.sleep(2.0)  # forward + offline enqueue on B
 
-        back = MqttClient("parked_b", clean_start=False, properties=props)
-        ack = await back.connect(port=two_nodes["mqtt_b"])
+        back = await _connect("parked_b", two_nodes["mqtt_b"],
+                              clean_start=False, properties=props)
+        ack = back.connack
         assert ack.session_present
         got = await back.recv(20)
         assert got.payload == b"while-parked"
@@ -376,8 +391,7 @@ def test_sigkill_purges_routes_and_survivor_serves(two_nodes):
 
     async def main():
         # give B a route A knows about
-        bsub = MqttClient("doomed_b")
-        await bsub.connect(port=two_nodes["mqtt_b"])
+        bsub = await _connect("doomed_b", two_nodes["mqtt_b"])
         await bsub.subscribe("doom/+", qos=0)
         await asyncio.sleep(1.0)
 
@@ -403,11 +417,9 @@ def test_sigkill_purges_routes_and_survivor_serves(two_nodes):
         assert purged, nodes
 
         # ...and keep serving local pub/sub
-        s = MqttClient("sv_sub")
-        await s.connect(port=two_nodes["mqtt_a"])
+        s = await _connect("sv_sub", two_nodes["mqtt_a"])
         await s.subscribe("alive/#", qos=1)
-        p = MqttClient("sv_pub")
-        await p.connect(port=two_nodes["mqtt_a"])
+        p = await _connect("sv_pub", two_nodes["mqtt_a"])
         await p.publish("alive/t", b"still-here", qos=1)
         got = await s.recv(10)
         assert got.payload == b"still-here"
