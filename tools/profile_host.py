@@ -1,0 +1,134 @@
+"""Micro-profile the fused native host match path (the hybrid data plane).
+
+Breaks an `engine.match()` host-path tick into measured phases so probe
+optimization work targets the real bucket:
+
+  pack    — Python str batch -> packed utf-8 (buf, offs)
+  native  — etpu_match_host_verified (split+hash+probe+verify in C++)
+  post    — numpy mask/cumsum + per-topic list assembly
+  full    — engine.match_submit/match_collect_raw end-to-end
+
+Run:  python tools/profile_host.py [--config N] [--ticks 512,1024,4096]
+No device needed: the host path is host silicon by design.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build(config: int, subs_cap=None):
+    import random
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "."))
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "benchmod", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    rng = random.Random(1234 + config)
+    if config == 1:
+        return bench.pop_exact_1k(rng)
+    if config == 2:
+        return bench.pop_wild_100k(rng)
+    if config == 3:
+        return bench.pop_mixed(rng, subs_cap or 1_000_000)
+    if config in (4, 5):
+        return bench.pop_mixed(rng, subs_cap or 10_000_000)
+    raise SystemExit(f"unknown config {config}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=2)
+    ap.add_argument("--subs", type=int, default=None)
+    ap.add_argument("--ticks", default="512,1024,2048,4096")
+    ap.add_argument("--iters", type=int, default=50)
+    ns = ap.parse_args()
+
+    from emqx_tpu.models.engine import TopicMatchEngine
+    from emqx_tpu.ops import native
+    from emqx_tpu.ops.tables import PROBE
+
+    filters, topics_fn = build(ns.config, ns.subs)
+    print(f"config {ns.config}: {len(filters):,} filters", file=sys.stderr)
+    eng = TopicMatchEngine()
+    t0 = time.time()
+    eng.add_filters(filters)
+    print(f"insert: {len(filters)/(time.time()-t0):,.0f}/s", file=sys.stderr)
+    # host-only serving: hybrid on, device probes disabled
+    eng.hybrid = True
+    eng.rate_dev = 1.0
+    eng.probe_interval = 1e9
+    eng._last_dev_meas = time.monotonic() + 1e9
+
+    t = eng.tables
+    print(f"shapes live: {int(t.valid.sum())}, log2cap {t.log2cap}, "
+          f"entries {t.n_entries:,}", file=sys.stderr)
+
+    for tick in (int(x) for x in ns.ticks.split(",")):
+        batches = [topics_fn() for _ in range(8)]
+        batches = [(b * ((tick // len(b)) + 1))[:tick] for b in batches]
+
+        # phase timings
+        snap = eng._snapshot()
+        (key_a, key_b, val, log2cap, incl, k_a, k_b,
+         min_len, max_len, wild_root, valid) = snap
+        vcap = int(valid.sum())
+        pack_s = nat_s = post_s = 0.0
+        for i in range(ns.iters):
+            topics = batches[i % 8]
+            p0 = time.perf_counter()
+            tbuf, toffs = native.pack_strs(topics)
+            p1 = time.perf_counter()
+            res = native.match_host_verified(
+                eng._reg, tbuf, toffs, len(topics), eng.space,
+                key_a, key_b, val, log2cap, PROBE,
+                incl, k_a, k_b, min_len, max_len, wild_root, valid, vcap,
+            )
+            p2 = time.perf_counter()
+            fids, counts, colls = res
+            n = len(topics)
+            fid_list = fids.tolist()
+            offs = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(counts, out=offs[1:])
+            ol = offs.tolist()
+            out = [fid_list[ol[k]:ol[k + 1]] for k in range(n)]
+            p3 = time.perf_counter()
+            pack_s += p1 - p0
+            nat_s += p2 - p1
+            post_s += p3 - p2
+
+        # full path (submit+collect) latency distribution
+        lat = []
+        for i in range(ns.iters):
+            b0 = time.perf_counter()
+            eng.match_collect_raw(eng.match_submit(batches[i % 8]))
+            lat.append(time.perf_counter() - b0)
+        lat_ms = np.array(lat) * 1e3
+        total = pack_s + nat_s + post_s
+        per = ns.iters * tick
+        print(
+            f"tick {tick:5d}: pack {pack_s/ns.iters*1e3:7.3f} ms  "
+            f"native {nat_s/ns.iters*1e3:7.3f} ms  "
+            f"post {post_s/ns.iters*1e3:7.3f} ms  | "
+            f"phases {per/total:,.0f}/s  full p50 "
+            f"{np.percentile(lat_ms, 50):.3f} p99 "
+            f"{np.percentile(lat_ms, 99):.3f} ms  "
+            f"full {per/ sum(lat):,.0f}/s"
+        )
+
+
+if __name__ == "__main__":
+    main()
