@@ -892,6 +892,24 @@ class TopicMatchEngine:
              min_len, max_len, wild_root, valid) = snap
             vcap = int(valid.sum())
             if vcap:
+                res2 = native.match_host_lists(
+                    self._reg, topics, self.space,
+                    key_a, key_b, val, log2cap, PROBE,
+                    incl, k_a, k_b, min_len, max_len, wild_root, valid,
+                    vcap,
+                )
+                if res2 is not None:
+                    out, colls = res2
+                    for ti, fid in colls:
+                        self._collide(topics[ti], fid)
+                    # ext rows are tuples; rebuild rather than extend on
+                    # the (rare) deep-filter escape hatch
+                    if pending.deep is not None:
+                        out = [
+                            [*o, *h] if h else o
+                            for o, h in zip(out, pending.deep)
+                        ]
+                    return out
                 tbuf, toffs = native.pack_strs(topics)
                 res = native.match_host_verified(
                     self._reg, tbuf, toffs, n, self.space,

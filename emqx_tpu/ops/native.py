@@ -54,9 +54,11 @@ _SRCS = [
     os.path.join(_SRC_DIR, "registry.cc"),
     os.path.join(_SRC_DIR, "bcrypt.cc"),
 ]
-_HDRS = [os.path.join(_SRC_DIR, "pool.h")]
+_PYMOD_SRC = os.path.join(_SRC_DIR, "pymod.cc")
+_HDRS = [os.path.join(_SRC_DIR, "pool.h"), os.path.join(_SRC_DIR, "match_core.h")]
 
 _lib: Optional[ctypes.CDLL] = None
+_ext = None  # CPython extension view of the same .so (may stay None)
 _tried = False
 _lock = threading.Lock()
 
@@ -72,18 +74,30 @@ def _build() -> bool:
         return False
     base = ["g++", "-O3", "-Wall", "-fPIC", "-std=c++17", "-shared",
             "-pthread", "-o", _LIB_PATH]
+    # The CPython extension face (pymod.cc) rides in the same .so when
+    # Python headers exist; variants without it keep the ctypes paths
+    # alive on header-less machines.
+    pymod: List[List[str]] = []
+    if os.path.exists(_PYMOD_SRC):
+        import sysconfig
+
+        inc = sysconfig.get_paths().get("include")
+        if inc and os.path.exists(os.path.join(inc, "Python.h")):
+            pymod.append([f"-I{inc}", os.path.abspath(_PYMOD_SRC)])
+    pymod.append([])
     # -march=native first: the hash contractions in the host match are
     # u32 multiply-add loops that vectorize well past the SSE2 baseline;
     # retried portable if the toolchain rejects it
-    for extra in (["-march=native"], []):
-        try:
-            subprocess.run(
-                base + extra + srcs,
-                check=True, capture_output=True, timeout=120,
-            )
-            return True
-        except (OSError, subprocess.SubprocessError) as e:
-            err = e
+    for ext in pymod:
+        for extra in (["-march=native"], []):
+            try:
+                subprocess.run(
+                    base + extra + ext + srcs,
+                    check=True, capture_output=True, timeout=120,
+                )
+                return True
+            except (OSError, subprocess.SubprocessError) as e:
+                err = e
     log.info("native build unavailable: %s", err)
     return False
 
@@ -178,6 +192,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             if os.path.exists(_LIB_PATH):
                 _lib = _bind(ctypes.CDLL(_LIB_PATH))
                 log.info("native hot paths loaded (%s)", _LIB_PATH)
+                _load_ext()
         except (OSError, AttributeError) as e:
             # AttributeError: a stale .so missing newer symbols that
             # could not be rebuilt — degrade to pure Python, don't crash
@@ -185,6 +200,31 @@ def get_lib() -> Optional[ctypes.CDLL]:
             log.info("native load failed: %s", e)
         _tried = True
     return _lib
+
+
+def _load_ext() -> None:
+    """Import the CPython extension face of the already-loaded .so (same
+    image in memory: dlopen refcounts the handle, so ctypes and the
+    module share globals/registries)."""
+    global _ext
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_etpu_ext", _LIB_PATH)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _ext = mod
+        log.info("native extension face loaded")
+    except Exception as e:  # built without Python.h: ctypes paths only
+        _ext = None
+        log.info("native extension face unavailable: %s", e)
+
+
+def get_ext():
+    """The CPython extension module view of the native lib, or None."""
+    if not _tried:
+        get_lib()
+    return _ext
 
 
 def available() -> bool:
@@ -474,6 +514,43 @@ def match_host_verified(
     colls = [(int(out_coll[2 * k]), int(out_coll[2 * k + 1]))
              for k in range(nc)]
     return fids, cnt, colls
+
+
+def match_host_lists(
+    reg: FilterRegistry, topics: list, space,
+    key_a: np.ndarray, key_b: np.ndarray, val: np.ndarray,
+    log2cap: int, probe: int,
+    incl: np.ndarray, k_a: np.ndarray, k_b: np.ndarray,
+    min_len: np.ndarray, max_len: np.ndarray,
+    wild_root: np.ndarray, valid: np.ndarray, vcap: int,
+):
+    """Fused host match via the CPython extension: Python topic list in,
+    per-topic fid LISTS out — no numpy masking, no per-call packing glue.
+
+    Returns (rows, collisions) or None when the extension is absent (the
+    caller falls back to match_host_verified).  All array arguments must
+    be C-contiguous (they are the live table arrays, created contiguous);
+    references are held here for the duration of the call.
+    """
+    ext = get_ext()
+    if ext is None or not isinstance(topics, list):
+        return None
+    L = int(incl.shape[1])
+    M = int(valid.shape[0])
+    # keep direct references to every array whose address crosses the
+    # boundary (no inline temporaries: the address must outlive the call)
+    ca, cb = space.C[0], space.C[1]
+    ra, rb = space.R[0], space.R[1]
+    assert incl.flags.c_contiguous and key_a.flags.c_contiguous
+    return ext.match_lists(
+        reg.ptr, topics, space.max_levels,
+        ca.ctypes.data, cb.ctypes.data, ra.ctypes.data, rb.ctypes.data,
+        key_a.ctypes.data, key_b.ctypes.data, val.ctypes.data,
+        log2cap, probe,
+        incl.ctypes.data, k_a.ctypes.data, k_b.ctypes.data,
+        min_len.ctypes.data, max_len.ctypes.data,
+        wild_root.ctypes.data, valid.ctypes.data, M, L, max(vcap, 1),
+    )
 
 
 def verify_pairs_reg(reg: FilterRegistry, tbuf: np.ndarray, toffs: np.ndarray,

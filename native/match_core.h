@@ -1,0 +1,25 @@
+// Shared declaration of the fused host match core (registry.cc) so both
+// the ctypes entry point and the CPython extension (pymod.cc) call one
+// implementation.
+#pragma once
+
+#include <cstdint>
+
+// Opaque registry handle (created by etpu_reg_new).
+extern "C" {
+
+int64_t etpu_match_core(
+    void* reg_h,
+    const uint8_t* tbuf, const int64_t* toffs, int32_t B,
+    int32_t max_levels,
+    const uint32_t* Ca, const uint32_t* Cb,
+    const uint32_t* Ra, const uint32_t* Rb,
+    const uint32_t* key_a, const uint32_t* key_b, const int32_t* val,
+    int32_t log2cap, int32_t probe,
+    const uint32_t* incl, const uint32_t* k_a, const uint32_t* k_b,
+    const int32_t* min_len, const int32_t* max_len,
+    const uint8_t* wild_root, const uint8_t* valid, int32_t M, int32_t L,
+    int32_t* out_fid, int32_t* out_cnt, int32_t vcap,
+    int32_t* out_coll, int32_t coll_cap, int32_t* n_coll);
+
+}  // extern "C"

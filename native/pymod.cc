@@ -1,0 +1,174 @@
+// CPython extension face of the native library (same .so as the ctypes
+// entry points, so both views share one loaded image and one registry).
+//
+// Why an extension on top of ctypes: the broker's host match tick at
+// interactive batch sizes (512) spends as much time in Python glue
+// (utf-8 packing, numpy masking, list assembly) as in the fused C++
+// matcher.  `match_lists` takes the Python topic list and the raw table
+// pointers and returns the per-topic fid lists directly: pack, match,
+// and result assembly all happen here, with the GIL released around the
+// matcher core.  ops/native.py falls back to the ctypes + numpy path
+// when the extension is unavailable (built without Python.h).
+//
+// Array arguments arrive as raw addresses (numpy .ctypes.data ints);
+// the caller keeps the owning arrays alive across the call — the same
+// contract the ctypes entry points already rely on.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "match_core.h"
+
+namespace {
+
+struct Packed {
+  std::vector<uint8_t> buf;
+  std::vector<int64_t> offs;
+};
+
+// Pack a list of str into one utf-8 buffer + offsets. Returns false and
+// sets a Python error on non-str items.
+bool pack_topics(PyObject* topics, Py_ssize_t n, Packed* out) {
+  out->offs.resize(n + 1);
+  out->offs[0] = 0;
+  size_t total = 0;
+  std::vector<const char*> ptrs(n);
+  std::vector<Py_ssize_t> lens(n);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* it = PyList_GET_ITEM(topics, i);  // borrowed
+    Py_ssize_t sz;
+    const char* s = PyUnicode_AsUTF8AndSize(it, &sz);
+    if (s == nullptr) return false;
+    ptrs[i] = s;
+    lens[i] = sz;
+    total += (size_t)sz;
+    out->offs[i + 1] = (int64_t)total;
+  }
+  out->buf.resize(total ? total : 1);
+  uint8_t* dst = out->buf.data();
+  for (Py_ssize_t i = 0; i < n; i++) {
+    std::memcpy(dst + out->offs[i], ptrs[i], (size_t)lens[i]);
+  }
+  return true;
+}
+
+// match_lists(reg, topics, max_levels, Ca, Cb, Ra, Rb,
+//             key_a, key_b, val, log2cap, probe,
+//             incl, k_a, k_b, min_len, max_len, wild_root, valid,
+//             M, L, vcap) -> (list[list[int]], list[(topic_idx, fid)])
+PyObject* match_lists(PyObject* self, PyObject* args) {
+  unsigned long long reg_p, Ca_p, Cb_p, Ra_p, Rb_p, ka_p, kb_p, val_p;
+  unsigned long long incl_p, sk_a_p, sk_b_p, minl_p, maxl_p, wr_p, vd_p;
+  PyObject* topics;
+  int max_levels, log2cap, probe, M, L, vcap;
+  if (!PyArg_ParseTuple(
+          args, "KO!iKKKKKKKiiKKKKKKKiii", &reg_p, &PyList_Type, &topics,
+          &max_levels, &Ca_p, &Cb_p, &Ra_p, &Rb_p, &ka_p, &kb_p, &val_p,
+          &log2cap, &probe, &incl_p, &sk_a_p, &sk_b_p, &minl_p, &maxl_p,
+          &wr_p, &vd_p, &M, &L, &vcap))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(topics);
+  Packed packed;
+  if (!pack_topics(topics, n, &packed)) return nullptr;
+  if (vcap < 1) vcap = 1;
+  std::vector<int32_t> out_fid((size_t)n * vcap);
+  std::vector<int32_t> out_cnt((size_t)(n ? n : 1), 0);
+  const int coll_cap = 256;
+  std::vector<int32_t> out_coll(2 * coll_cap);
+  int32_t n_coll = 0;
+  Py_BEGIN_ALLOW_THREADS;
+  etpu_match_core(
+      (void*)(uintptr_t)reg_p, packed.buf.data(), packed.offs.data(),
+      (int32_t)n, max_levels, (const uint32_t*)(uintptr_t)Ca_p,
+      (const uint32_t*)(uintptr_t)Cb_p, (const uint32_t*)(uintptr_t)Ra_p,
+      (const uint32_t*)(uintptr_t)Rb_p, (const uint32_t*)(uintptr_t)ka_p,
+      (const uint32_t*)(uintptr_t)kb_p, (const int32_t*)(uintptr_t)val_p,
+      log2cap, probe, (const uint32_t*)(uintptr_t)incl_p,
+      (const uint32_t*)(uintptr_t)sk_a_p, (const uint32_t*)(uintptr_t)sk_b_p,
+      (const int32_t*)(uintptr_t)minl_p, (const int32_t*)(uintptr_t)maxl_p,
+      (const uint8_t*)(uintptr_t)wr_p, (const uint8_t*)(uintptr_t)vd_p, M, L,
+      out_fid.data(), out_cnt.data(), vcap, out_coll.data(), coll_cap,
+      &n_coll);
+  Py_END_ALLOW_THREADS;
+
+  // rows are TUPLES (callers only iterate/len them — the broker dispatch
+  // and the engine's raw contract): tuple allocation rides the freelist
+  // and the shared () singleton makes miss topics near-free.
+  PyObject* empty = PyTuple_New(0);
+  if (empty == nullptr) return nullptr;
+  PyObject* rows = PyList_New(n);
+  if (rows == nullptr) {
+    Py_DECREF(empty);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    int32_t cnt = out_cnt[i];
+    PyObject* row;
+    if (cnt == 0) {
+      Py_INCREF(empty);
+      row = empty;
+    } else {
+      row = PyTuple_New(cnt);
+      if (row == nullptr) {
+        Py_DECREF(empty);
+        Py_DECREF(rows);
+        return nullptr;
+      }
+      const int32_t* src = out_fid.data() + (size_t)i * vcap;
+      for (int32_t k = 0; k < cnt; k++) {
+        PyObject* v = PyLong_FromLong(src[k]);
+        if (v == nullptr) {
+          Py_DECREF(row);
+          Py_DECREF(empty);
+          Py_DECREF(rows);
+          return nullptr;
+        }
+        PyTuple_SET_ITEM(row, k, v);
+      }
+    }
+    PyList_SET_ITEM(rows, i, row);
+  }
+  Py_DECREF(empty);
+  int nc = n_coll < coll_cap ? n_coll : coll_cap;
+  PyObject* colls = PyList_New(nc);
+  if (colls == nullptr) {
+    Py_DECREF(rows);
+    return nullptr;
+  }
+  for (int k = 0; k < nc; k++) {
+    PyObject* pair =
+        Py_BuildValue("(ii)", out_coll[2 * k], out_coll[2 * k + 1]);
+    if (pair == nullptr) {
+      Py_DECREF(colls);
+      Py_DECREF(rows);
+      return nullptr;
+    }
+    PyList_SET_ITEM(colls, k, pair);
+  }
+  PyObject* res = Py_BuildValue("(NN)", rows, colls);
+  if (res == nullptr) {
+    Py_DECREF(rows);
+    Py_DECREF(colls);
+  }
+  return res;
+}
+
+PyMethodDef methods[] = {
+    {"match_lists", match_lists, METH_VARARGS,
+     "Fused host match: topic list in, per-topic fid lists out."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moddef = {
+    PyModuleDef_HEAD_INIT, "_etpu_ext",
+    "CPython face of the emqx_tpu native hot paths.", -1, methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__etpu_ext(void) { return PyModule_Create(&moddef); }

@@ -21,6 +21,11 @@
 #include <string>
 #include <vector>
 
+#ifdef __x86_64__
+#include <immintrin.h>
+#endif
+
+#include "match_core.h"
 #include "pool.h"
 
 namespace {
@@ -142,8 +147,14 @@ void etpu_reg_del_bulk(void* h, const int32_t* fids, int32_t n) {
 //   out_coll  [2 * coll_cap] (topic_idx, fid) refuted/raced pairs
 //   n_coll    out: refuted pair count (may exceed coll_cap; excess dropped)
 //
+// Probe order within the window is slot order, first (key_a, key_b,
+// val>=0) match wins — identical to the original scalar loop; the AVX
+// paths only change how non-matching slots are rejected (key_a compare
+// first, one vector op for the whole window, instead of val/key_a/key_b
+// loads per slot).
+//
 // Returns total verified hits.
-int64_t etpu_match_host_verified(
+int64_t etpu_match_core(
     void* reg_h,
     const uint8_t* tbuf, const int64_t* toffs, int32_t B,
     int32_t max_levels,
@@ -159,7 +170,8 @@ int64_t etpu_match_host_verified(
   Registry* reg = (Registry*)reg_h;
   std::shared_lock<std::shared_mutex> reg_lk(reg->mu);
   const uint32_t MIX1 = 0x85EBCA77u, MIX2 = 0x9E3779B1u;
-  const uint32_t cap_mask = (1u << log2cap) - 1;
+  const uint32_t cap = 1u << log2cap;
+  const uint32_t cap_mask = cap - 1;
   std::atomic<int32_t> coll_cursor{0};
 
   // valid shape rows, hoisted once (M can exceed the live shape count)
@@ -167,17 +179,19 @@ int64_t etpu_match_host_verified(
   vshapes.reserve(M);
   for (int32_t m = 0; m < M; m++)
     if (valid[m]) vshapes.push_back(m);
+  const int32_t NV = (int32_t)vshapes.size();
 
   EtpuPool::inst().parallel_for(B, 64, [&](int32_t i0, int32_t i1) {
-    std::vector<uint32_t> terms_a(L), terms_b(L);
-    std::vector<uint32_t> homes(vshapes.size()), has(vshapes.size()),
-        hbs(vshapes.size());
+    // terms need no zeroing between topics: incl rows are 0 beyond each
+    // shape's prefix, and the length filters bound which shapes see a
+    // topic, so stale lanes are always multiplied by 0.
+    std::vector<uint32_t> terms_a(L, 0), terms_b(L, 0);
+    std::vector<uint32_t> homes(NV), has(NV), hbs(NV);
     for (int32_t i = i0; i < i1; i++) {
       const uint8_t* t = tbuf + toffs[i];
       int64_t tn = toffs[i + 1] - toffs[i];
       bool dol = (tn > 0 && t[0] == '$');
       // split + hash levels
-      for (int32_t l = 0; l < L; l++) terms_a[l] = terms_b[l] = 0;
       int32_t level = 0;
       int64_t start = 0;
       for (int64_t p = 0; p <= tn; p++) {
@@ -191,37 +205,80 @@ int64_t etpu_match_host_verified(
           start = p + 1;
         }
       }
+      for (int32_t l = level; l < L; l++) terms_a[l] = terms_b[l] = 0;
       int32_t len = (tn == 0) ? 1 : level;
       // candidate shapes: length/dollar filters + hash combine
       int32_t ncand = 0;
-      for (int32_t c = 0; c < (int32_t)vshapes.size(); c++) {
-        int32_t m = vshapes[c];
-        if (len < min_len[m] || len > max_len[m]) continue;
-        if (dol && wild_root[m]) continue;
-        const uint32_t* row = incl + (int64_t)m * L;
-        uint32_t ha = k_a[m], hb = k_b[m];
-        for (int32_t l = 0; l < L; l++) {
-          ha += terms_a[l] * row[l];
-          hb += terms_b[l] * row[l];
+#if defined(__AVX512F__)
+      if (L == 16) {
+        __m512i ta = _mm512_loadu_si512((const void*)terms_a.data());
+        __m512i tb = _mm512_loadu_si512((const void*)terms_b.data());
+        for (int32_t c = 0; c < NV; c++) {
+          int32_t m = vshapes[c];
+          if (len < min_len[m] || len > max_len[m]) continue;
+          if (dol && wild_root[m]) continue;
+          __m512i row =
+              _mm512_loadu_si512((const void*)(incl + (int64_t)m * 16));
+          uint32_t ha = k_a[m] + (uint32_t)_mm512_reduce_add_epi32(
+                                     _mm512_mullo_epi32(ta, row));
+          uint32_t hb = k_b[m] + (uint32_t)_mm512_reduce_add_epi32(
+                                     _mm512_mullo_epi32(tb, row));
+          uint32_t home = ((ha + hb * MIX1) * MIX2) >> (32 - log2cap);
+          __builtin_prefetch(key_a + home);
+          homes[ncand] = home;
+          has[ncand] = ha;
+          hbs[ncand] = hb;
+          ncand++;
         }
-        uint32_t home = ((ha + hb * MIX1) * MIX2) >> (32 - log2cap);
-        __builtin_prefetch(val + home);
-        __builtin_prefetch(key_a + home);
-        __builtin_prefetch(key_b + home);
-        homes[ncand] = home;
-        has[ncand] = ha;
-        hbs[ncand] = hb;
-        ncand++;
+      } else
+#endif
+      {
+        for (int32_t c = 0; c < NV; c++) {
+          int32_t m = vshapes[c];
+          if (len < min_len[m] || len > max_len[m]) continue;
+          if (dol && wild_root[m]) continue;
+          const uint32_t* row = incl + (int64_t)m * L;
+          uint32_t ha = k_a[m], hb = k_b[m];
+          for (int32_t l = 0; l < L; l++) {
+            ha += terms_a[l] * row[l];
+            hb += terms_b[l] * row[l];
+          }
+          uint32_t home = ((ha + hb * MIX1) * MIX2) >> (32 - log2cap);
+          __builtin_prefetch(key_a + home);
+          homes[ncand] = home;
+          has[ncand] = ha;
+          hbs[ncand] = hb;
+          ncand++;
+        }
       }
-      // probe + inline exact verification
+      // probe + inline exact verification: reject on key_a first (the
+      // selective test — one cache line for the whole window) and touch
+      // key_b/val only on candidate slots
       int32_t* row_out = out_fid + (int64_t)i * vcap;
       int32_t nhit = 0;
       for (int32_t c = 0; c < ncand; c++) {
         uint32_t home = homes[c], ha = has[c], hb = hbs[c];
-        for (int32_t off = 0; off < probe; off++) {
-          uint32_t slot = (home + (uint32_t)off) & cap_mask;
+        uint32_t lanes;  // bitmask of window slots with key_a == ha
+#if defined(__AVX2__)
+        if (probe == 8 && home + 8 <= cap) {
+          __m256i w = _mm256_loadu_si256((const __m256i*)(key_a + home));
+          __m256i eq = _mm256_cmpeq_epi32(w, _mm256_set1_epi32((int32_t)ha));
+          lanes = (uint32_t)_mm256_movemask_ps(_mm256_castsi256_ps(eq));
+        } else
+#endif
+        {
+          lanes = 0;
+          for (int32_t off = 0; off < probe; off++) {
+            uint32_t slot = (home + (uint32_t)off) & cap_mask;
+            if (key_a[slot] == ha) lanes |= 1u << off;
+          }
+        }
+        while (lanes) {
+          uint32_t off = (uint32_t)__builtin_ctz(lanes);
+          lanes &= lanes - 1;
+          uint32_t slot = (home + off) & cap_mask;
           int32_t v = val[slot];
-          if (v >= 0 && key_a[slot] == ha && key_b[slot] == hb) {
+          if (v >= 0 && key_b[slot] == hb) {
             bool ok = false;
             if (v < (int32_t)reg->strs.size() && reg->present[v]) {
               const std::string& f = reg->strs[v];
@@ -248,6 +305,26 @@ int64_t etpu_match_host_verified(
   int64_t total = 0;
   for (int32_t i = 0; i < B; i++) total += out_cnt[i];
   return total;
+}
+
+// ctypes-facing alias (kept stable for ops/native.py).
+int64_t etpu_match_host_verified(
+    void* reg_h,
+    const uint8_t* tbuf, const int64_t* toffs, int32_t B,
+    int32_t max_levels,
+    const uint32_t* Ca, const uint32_t* Cb,
+    const uint32_t* Ra, const uint32_t* Rb,
+    const uint32_t* key_a, const uint32_t* key_b, const int32_t* val,
+    int32_t log2cap, int32_t probe,
+    const uint32_t* incl, const uint32_t* k_a, const uint32_t* k_b,
+    const int32_t* min_len, const int32_t* max_len,
+    const uint8_t* wild_root, const uint8_t* valid, int32_t M, int32_t L,
+    int32_t* out_fid, int32_t* out_cnt, int32_t vcap,
+    int32_t* out_coll, int32_t coll_cap, int32_t* n_coll) {
+  return etpu_match_core(
+      reg_h, tbuf, toffs, B, max_levels, Ca, Cb, Ra, Rb, key_a, key_b, val,
+      log2cap, probe, incl, k_a, k_b, min_len, max_len, wild_root, valid, M,
+      L, out_fid, out_cnt, vcap, out_coll, coll_cap, n_coll);
 }
 
 // Registry-backed exact verification for DEVICE hash hits: same contract

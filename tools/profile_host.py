@@ -57,11 +57,19 @@ def main():
     ap.add_argument("--iters", type=int, default=50)
     ns = ap.parse_args()
 
+    import gc
+
     from emqx_tpu.models.engine import TopicMatchEngine
     from emqx_tpu.ops import native
     from emqx_tpu.ops.tables import PROBE
 
     filters, topics_fn = build(ns.config, ns.subs)
+    # mirror bench.py's node-runtime GC tuning so p99 reflects the match
+    # path, not young-gen sweeps over the resident population
+    gc.collect()
+    gc.freeze()
+    _g0, _g1, _g2 = gc.get_threshold()
+    gc.set_threshold(50_000, _g1, _g2)
     print(f"config {ns.config}: {len(filters):,} filters", file=sys.stderr)
     eng = TopicMatchEngine()
     t0 = time.time()
