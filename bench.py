@@ -52,6 +52,37 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+class ChurnPacer:
+    """Wall-clock churn pacing shared by the CPU baseline and the engine
+    north-star sweep: both sides owe `rate` ops/sec of churn, accrued by
+    elapsed time — ONE implementation so the fairness claim can't drift."""
+
+    def __init__(self, rate: float):
+        self.rate = rate
+        self.last = time.time()
+        self.debt = 0.0
+
+    def owed(self, now: float) -> int:
+        self.debt += (now - self.last) * self.rate
+        self.last = now
+        n = int(self.debt)
+        self.debt -= n
+        return n
+
+
+def pick_north_star(ns_rows, cpu_rps):
+    """(best_row, passed): the highest-throughput row meeting BOTH gates
+    (>=10x CPU and p99 < 2 ms), else the highest-throughput row overall.
+    Single source for the headline JSON and BENCH_TABLE.md."""
+    if not ns_rows:
+        return None, False
+    passing = [r for r in ns_rows
+               if r["p99_ms"] < 2.0 and r["rps"] >= 10 * cpu_rps]
+    if passing:
+        return max(passing, key=lambda r: r["rps"]), True
+    return max(ns_rows, key=lambda r: r["rps"]), False
+
+
 # ------------------------------------------------------------- populations
 
 def pop_exact_1k(rng):
@@ -153,7 +184,15 @@ def pop_zipf(rng, n):
 
 # ------------------------------------------------------------ measurement
 
-def cpu_baseline(filters, topics_fn):
+def cpu_baseline(filters, topics_fn, churn_frac=0.0, churn_pool=None):
+    """Single-threaded CPU dict-trie baseline (the ETS-trie analog).
+
+    When the workload includes churn (config 5: "incremental trie
+    rebuild under load"), the baseline pays the SAME churn rate the
+    engine does — `churn_frac` of the population per second, paced by
+    its own wall clock — so the lookup rate is the effective rate under
+    load on both sides, not match-only for one and match+churn for the
+    other."""
     from emqx_tpu.models.reference import CpuTrieIndex
 
     trie = CpuTrieIndex()
@@ -162,13 +201,33 @@ def cpu_baseline(filters, topics_fn):
         trie.insert(f, i)
     cpu_insert_rps = len(filters) / (time.time() - ins0)
     cpu_topics = topics_fn()[:CPU_LOOKUPS]
+    target_cps = churn_frac * len(filters)  # churn ops/sec to sustain
+    churn_i = 0
+    fid_base = len(filters)
+    present: dict = {}
+    churn_events = 0
+    pacer = ChurnPacer(target_cps)
     m0 = time.time()
+    pacer.last = m0
     hits = 0
-    for t in cpu_topics:
+    for k, t in enumerate(cpu_topics):
         hits += len(trie.match(t))
+        if target_cps and churn_pool and (k & 63) == 63:
+            n_ops = pacer.owed(time.time())
+            for _ in range(n_ops):
+                f = churn_pool[churn_i % len(churn_pool)]
+                fid = present.pop(f, None)
+                if fid is None:
+                    fid = fid_base + churn_i
+                    trie.insert(f, fid)
+                    present[f] = fid
+                else:
+                    trie.delete(f, fid)
+                churn_i += 1
+                churn_events += 1
     cpu_rps = len(cpu_topics) / (time.time() - m0)
     log(f"cpu baseline: insert {cpu_insert_rps:,.0f}/s, lookup {cpu_rps:,.0f}/s "
-        f"({hits} hits)")
+        f"({hits} hits, {churn_events} churn events)")
     return cpu_insert_rps, cpu_rps
 
 
@@ -317,9 +376,8 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
 
     churn_i = 0
 
-    def churn_tick(scale: int = 1):
+    def churn_tick_n(k: int):
         nonlocal churn_i, churn_events
-        k = k_churn * scale
         adds, removes = [], []
         for j in range(k):
             f = churn_pool[(churn_i + j) % len(churn_pool)]
@@ -327,6 +385,9 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
         churn_i += k
         churn_events += k
         eng.apply_churn(adds, removes)
+
+    def churn_tick(scale: int = 1):
+        churn_tick_n(k_churn * scale)
 
     # warmup compiles the e2e shapes (incl. the fused churn dispatch)
     if k_churn:
@@ -437,7 +498,40 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
         f"device={eng.dev_serve_count} timeouts={eng.dev_timeout_count}; "
         f"collisions {eng.collision_count}; sample hits "
         f"{sum(len(s) for s in res)}")
+
+    # -------------------------------------------------- north-star sweep
+    # BASELINE.md gates BOTH throughput (>=10x CPU) and p99 (<2 ms) — at
+    # ONE operating point.  Sweep tick sizes measuring sustained rate AND
+    # per-tick latency at the SAME tick, production hybrid path, churn
+    # paced by wall clock (churn_frac of the population per second, the
+    # workload's definition) so config 5's rate is effective-under-load.
+    ns_rows = []
+    target_cps = churn_frac * len(filters) if churn_pool else 0.0
+    for tick in (512, 1024, 2048, 4096):
+        tb = [b[:tick] for b in batches_str] if tick <= BATCH else None
+        if tb is None:
+            continue
+        eng.match_collect_raw(eng.match_submit(tb[0]))  # warm shape
+        iters = max(30, min(300, int(2_000_000 / tick)))
+        lat = []
+        pacer = ChurnPacer(target_cps)
+        t0 = time.time()
+        pacer.last = t0
+        for i in range(iters):
+            b0 = time.time()
+            if target_cps:
+                n_ops = pacer.owed(b0)
+                if n_ops:
+                    churn_tick_n(n_ops)
+            eng.match_collect_raw(eng.match_submit(tb[i % len(tb)]))
+            lat.append(time.time() - b0)
+        wall = time.time() - t0
+        rate = iters * tick / wall
+        p99 = float(np.percentile(np.array(lat) * 1e3, 99))
+        ns_rows.append({"tick": tick, "rps": rate, "p99_ms": p99})
+        log(f"north-star tick {tick}: {rate:,.0f} lookups/s, p99 {p99:.2f} ms")
     return {
+        "ns_rows": ns_rows,
         "tpu_rps": hyb_rps,  # headline: the production (hybrid) match rate
         "p99_ms": hyb_p99,
         "p99_small_ms": hyb_p99_small,
@@ -454,6 +548,12 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
         "link_up_mbs": up_mbs,
         "link_down_mbs": down_mbs,
         "device": dev.platform,
+        # core-count honesty (VERDICT r4 #2): the CPU baseline is ONE
+        # thread; the host-probe path uses the native pool = all hardware
+        # threads, capped at 16 (pool.h) — on a 1-core host both are 1
+        "host_threads": os.cpu_count() or 1,
+        "match_threads": min(16, os.cpu_count() or 1),
+        "baseline_threads": 1,
     }
 
 
@@ -641,7 +741,8 @@ def run_config(n: int, subs_cap: int | None):
     else:
         raise SystemExit(f"unknown config {n}")
     log(f"== config {n}: {CONFIGS[n][1]} ({len(filters):,} filters) ==")
-    cpu_insert, cpu_rps = cpu_baseline(filters, topics_fn)
+    cpu_insert, cpu_rps = cpu_baseline(filters, topics_fn, churn_frac,
+                                       churn_pool)
     stats = run_engine(filters, topics_fn, churn_frac, churn_pool)
     stats.update({"cpu_rps": cpu_rps, "cpu_insert_rps": cpu_insert,
                   "n_filters": len(filters)})
@@ -652,12 +753,20 @@ def headline_json(n: int, stats: dict) -> str:
     """value/vs_baseline = the PRODUCTION engine.match() rate (hybrid
     arbitration, verify on — what a broker.publish tick actually pays);
     the device-only e2e and raw kernel rates ride along."""
+    best, passed = pick_north_star(stats.get("ns_rows"), stats["cpu_rps"])
     return json.dumps({
         "metric": f"route_lookups_per_sec_{CONFIGS[n][0]}",
         "value": round(stats["tpu_rps"]),
         "unit": "lookups/sec",
         "vs_baseline": round(stats["tpu_rps"] / stats["cpu_rps"], 2),
         "device": stats["device"],
+        "north_star": None if best is None else {
+            "tick": best["tick"],
+            "rps": round(best["rps"]),
+            "vs_baseline": round(best["rps"] / stats["cpu_rps"], 2),
+            "p99_ms": round(best["p99_ms"], 3),
+            "pass": passed,
+        },
         "p99_ms": round(stats["p99_ms"], 3),
         "p99_small_ms": round(stats.get("p99_small_ms", 0), 3),
         "dev_e2e_rps": round(stats["dev_e2e_rps"]),
@@ -806,6 +915,49 @@ def main() -> None:
                 f"| {s['kernel_p99_ms']:.2f} "
                 f"| {s['insert_rps']:,.0f} "
                 f"| {s['insert_rps']/s['cpu_insert_rps']:.1f}x |\n")
+
+        # ---------------------------------------------- north-star table
+        s2 = rows[2]
+        f.write(
+            "\n## North-star operating points (BASELINE.md: >=10x AND "
+            "p99 < 2 ms at ONE tick size)\n\n"
+            "Sustained throughput and per-tick p99 measured at the SAME "
+            "tick size on the production hybrid path (verify on; config "
+            "5 pays its 5%/sec churn inside the measured loop, paced by "
+            "wall clock — and the CPU baseline pays the identical churn "
+            "rate on its trie, per the workload's \"incremental rebuild "
+            "under load\").  Cores: baseline = "
+            f"{s2.get('baseline_threads', 1)} thread; engine host probe "
+            f"= {s2.get('match_threads', 1)} of "
+            f"{s2.get('host_threads', 1)} hardware thread(s) on this "
+            "host — with one core there is no parallel-host upper bound "
+            "beyond the single-thread rate shown, so the speedup column "
+            "is also the engine-vs-parallel-CPU-host ratio.\n\n"
+            "| # | best tick | lookups/s | speedup | p99 ms | >=10x | "
+            "<2ms | gates |\n"
+            "|---|---|---|---|---|---|---|---|\n"
+        )
+        for n, s in rows.items():
+            best, _passed = pick_north_star(s.get("ns_rows"), s["cpu_rps"])
+            if best is None:
+                continue
+            ok10 = best["rps"] >= 10 * s["cpu_rps"]
+            ok2 = best["p99_ms"] < 2.0
+            f.write(
+                f"| {n} | {best['tick']} | {best['rps']:,.0f} "
+                f"| {best['rps']/s['cpu_rps']:.1f}x "
+                f"| {best['p99_ms']:.2f} "
+                f"| {'yes' if ok10 else 'NO'} | {'yes' if ok2 else 'NO'} "
+                f"| {'PASS' if ok10 and ok2 else 'fail'} |\n")
+        f.write(
+            "\nFull sweep (per config: tick -> lookups/s @ p99 ms): "
+        )
+        for n, s in rows.items():
+            nsr = s.get("ns_rows") or []
+            f.write(f"\n- config {n}: " + ", ".join(
+                f"{r['tick']}→{r['rps']:,.0f}@{r['p99_ms']:.2f}"
+                for r in nsr))
+        f.write("\n")
         if sharded is not None:
             s = sharded
             f.write(

@@ -141,7 +141,11 @@ class ClusterNode:
         self.seq = 0
         self._local_filters: Set[str] = set()
         self._shared_rng = random.Random()
-        self._status: Dict[str, str] = {}  # peer -> up|down
+        # pre-seed CONFIGURED peers as down so readiness (`/status`
+        # `ready`: all peer links up) is never vacuously true on a node
+        # whose links are all inbound — the mesh shows as forming, not
+        # formed, until every configured peer's hello lands
+        self._status: Dict[str, str] = dict.fromkeys(self.peers_cfg, "down")
         self._resyncing: Set[str] = set()
         self._hb_task: Optional[asyncio.Task] = None
         self._disc_task: Optional[asyncio.Task] = None

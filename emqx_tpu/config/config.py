@@ -332,6 +332,12 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "name": Field("str", "emqx_tpu@127.0.0.1"),
         "data_dir": Field("str", "data"),
         "cookie": Field("str", "emqxsecretcookie", desc="cluster shared secret"),
+        "xla_cache_dir": Field(
+            "str", "",
+            desc="persistent XLA compile cache; empty = <data_dir>/"
+                 "xla_cache.  Point co-hosted nodes at ONE dir so only "
+                 "the first pays engine warm-up compilation",
+        ),
     },
     "persistent_session_store": {
         "enable": Field("bool", False),

@@ -347,11 +347,23 @@ class ManagementApi:
     # ---------------------------------------------------------------- node
 
     def status(self, req: Request):
+        """Unauthenticated liveness + READINESS (the docker-compose FVT
+        health-check analog: the reference waits on container health
+        before driving clients).  `ready` is true once this node serves
+        traffic (boot — including engine warm-up — finished before the
+        HTTP listener opened) AND every CONFIGURED cluster peer link is
+        up (pre-seeded down at boot).  Cluster-less nodes — and listen-
+        only nodes with no configured peers, which cannot know who will
+        dial in — are ready as soon as they serve; gate mesh formation
+        by polling every member's /status, not just a hub's."""
+        mesh = self.cluster.status() if self.cluster is not None else {}
         return {
             "node": self.node,
             "status": "running",
             "version": VERSION,
             "uptime": int(time.time() - self.started_at),
+            "ready": all(st == "up" for st in mesh.values()),
+            "mesh": mesh,
         }
 
     def nodes(self, req: Request):

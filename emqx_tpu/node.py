@@ -618,12 +618,11 @@ class NodeRuntime:
 
                 try:
                     # persistent XLA cache: restarts (and every node
-                    # sharing the data dir) skip recompilation entirely
-                    jax.config.update(
-                        "jax_compilation_cache_dir",
+                    # sharing the cache dir) skip recompilation entirely
+                    cache = self.conf.get("node.xla_cache_dir") or \
                         os.path.join(self.conf.get("node.data_dir"),
-                                     "xla_cache"),
-                    )
+                                     "xla_cache")
+                    jax.config.update("jax_compilation_cache_dir", cache)
                 except Exception:
                     pass
                 eng = self.broker.engine

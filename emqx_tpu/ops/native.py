@@ -186,7 +186,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             if not os.path.exists(_LIB_PATH) or any(
                 os.path.exists(s)
                 and os.path.getmtime(s) > os.path.getmtime(_LIB_PATH)
-                for s in _SRCS + _HDRS
+                for s in _SRCS + _HDRS + [_PYMOD_SRC]
             ):
                 _build()
             if os.path.exists(_LIB_PATH):

@@ -48,9 +48,15 @@ def _free_ports(n):
     return ports
 
 
+_SHARED_XLA_CACHE = os.path.join(tempfile.gettempdir(), "fvt_xla_cache")
+
+
 def _write_conf(d, name, mqtt_port, dash_port, cport, peers, role="core"):
     conf = {
-        "node": {"name": name, "data_dir": d},
+        # one XLA cache across all FVT nodes: only the first boot on this
+        # host pays engine warm-up compilation (readiness gates on it)
+        "node": {"name": name, "data_dir": d,
+                 "xla_cache_dir": _SHARED_XLA_CACHE},
         "log": {"level": "WARNING"},
         "listeners": [{"type": "tcp", "port": mqtt_port}],
         "dashboard": {"listen_port": dash_port},
@@ -113,6 +119,31 @@ def _rest(dash_port, path, token=None):
     return json.load(urllib.request.urlopen(req, timeout=5)), token
 
 
+async def _wait_ready(dash_ports, timeout=90.0):
+    """Readiness gate (VERDICT r4 #3): poll each node's unauthenticated
+    `/status` until it reports `ready` — boot (incl. engine warm-up)
+    done AND every configured peer link up — the analog of the
+    reference compose file's health-check waits.  Clients only start
+    once EVERY node says so, so they never race mesh formation."""
+    deadline = time.monotonic() + timeout
+    pending = set(dash_ports)
+    while pending:
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"nodes on dash ports {sorted(pending)} never became ready")
+        for port in list(pending):
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api/v5/status")
+                st = json.load(urllib.request.urlopen(req, timeout=3))
+                if st.get("ready"):
+                    pending.discard(port)
+            except Exception:
+                pass
+        if pending:
+            await asyncio.sleep(0.4)
+
+
 @pytest.fixture(scope="module")
 def two_nodes():
     mqtt_a, mqtt_b, dash_a, dash_b, ca, cb = _free_ports(6)
@@ -122,24 +153,9 @@ def two_nodes():
     pb = _spawn(_write_conf(db, "b@fvt", mqtt_b, dash_b, cb, {"a@fvt": ca}))
     try:
         asyncio.run(asyncio.wait_for(_boot(mqtt_a, mqtt_b), 120))
-        # wait for the CLUSTER LINK, not just the listeners: tests assume
-        # an established mesh (under CPU contention dial-back can land
-        # well after the MQTT ports open)
-        deadline = time.monotonic() + 90
-        tok = None
-        up = False
-        while time.monotonic() < deadline:
-            try:
-                nodes, tok = _rest(dash_a, "/nodes", tok)
-            except Exception:
-                time.sleep(0.5)
-                continue
-            peers = [n for n in nodes if n["node"] == "b@fvt"]
-            if peers and peers[0]["node_status"] == "running":
-                up = True
-                break
-            time.sleep(0.5)
-        assert up, "cluster link a@fvt<->b@fvt never came up"
+        # readiness gate, not a time budget: every node must report
+        # ready (mesh up + boot done) before any client traffic
+        asyncio.run(_wait_ready([dash_a, dash_b], timeout=90))
         yield {
             "pa": pa, "pb": pb,
             "mqtt_a": mqtt_a, "mqtt_b": mqtt_b,
@@ -206,23 +222,10 @@ def test_three_node_core_replicant_topology():
     try:
         async def main():
             await asyncio.gather(*(_wait_port(p) for p in (mq_a, mq_b, mq_c)))
-            # wait for the mesh as seen from core a (generous: heavily
-            # loaded CI hosts boot three XLA-warming nodes slowly)
-            deadline = time.monotonic() + 150
-            tok = None
-            while time.monotonic() < deadline:
-                try:
-                    nodes, tok = _rest(da, "/nodes", tok)
-                except Exception:
-                    await asyncio.sleep(0.5)
-                    continue
-                up = {n["node"] for n in nodes
-                      if n["node_status"] == "running"}
-                if {"a3@fvt", "b3@fvt", "c3@fvt"} <= up:
-                    break
-                await asyncio.sleep(0.5)
-            else:
-                raise AssertionError("3-node mesh never formed")
+            # readiness gate on EVERY node's own /status (mesh up from
+            # its side + boot incl. engine warm-up done) — round-3 time
+            # budget restored now that clients can't race formation
+            await _wait_ready([da, db, dc], timeout=90)
 
             # replicant subscriber receives publishes from a core
             sub = await _connect("r_sub", mq_c)

@@ -341,3 +341,82 @@ def test_pipelined_submit_collect_churn_oracle():
         pend.append((eng.match_submit(topics), topics, [ref.match(t) for t in topics]))
         drain()
     drain(force=True)
+
+
+def test_dedup_expansion_matches_oracle():
+    """Batches with repeated topics (>=128 names, >=12.5% duplicates)
+    take the dedup path: match each distinct name once, expand at
+    collect.  Results must be identical to the per-topic oracle on both
+    the device path and the hybrid host path, including deep-trie
+    filters (which are computed per ORIGINAL publish index)."""
+    rng = random.Random(7)
+    eng, ref = make_pair()
+    for i in range(50):
+        f = f"d/{i}/+"
+        ref.insert(f, eng.add_filter(f))
+    deep = "x/" + "/".join(str(i) for i in range(20))  # past the level cap
+    ref.insert(deep, eng.add_filter(deep))
+
+    names = [f"d/{i}/t" for i in range(10)] + [deep]
+    topics = [rng.choice(names) for _ in range(256)]
+    assert len(set(topics)) <= len(topics) - (len(topics) >> 3)
+
+    got = eng.match(topics)
+    for t, g in zip(topics, got):
+        assert g == ref.match(t), t
+
+    eng.hybrid = True
+    eng.rate_dev = 1.0
+    eng.probe_interval = 1e9
+    import time as _time
+
+    eng._last_dev_meas = _time.monotonic() + 1e9
+    got = eng.match(topics)
+    for t, g in zip(topics, got):
+        assert g == ref.match(t), t
+    assert eng.host_serve_count >= 1
+
+
+def test_apply_churn_pure_remove_keeps_free_list():
+    """Regression: a churn tick with no adds (or all-existing adds) must
+    not slice the whole free list (free[-0:]), leak refs entries, or
+    return freed fids."""
+    eng = TopicMatchEngine()
+    fids = eng.add_filters([f"pr/{i}" for i in range(600)])
+    eng.apply_churn([], [f"pr/{i}" for i in range(10)])
+    assert len(eng._free_fids) == 10
+    assert all(f not in eng._refs for f in fids[:10])
+    out = eng.apply_churn([], ["pr/10"])
+    assert out == []
+    assert len(eng._free_fids) == 11
+    # all-existing adds: returns the existing fids, allocates nothing
+    out = eng.apply_churn(["pr/20", "pr/21"], [])
+    assert out == [eng.fid_of("pr/20"), eng.fid_of("pr/21")]
+    assert eng._refs[eng.fid_of("pr/20")] == 2
+
+
+def test_apply_churn_duplicate_removes_decrement_each():
+    """Regression: two removes of the same filter in ONE churn tick must
+    decrement the refcount twice (like two sequential unsubscribes)."""
+    eng = TopicMatchEngine()
+    eng.add_filter("x/y")
+    eng.add_filter("x/y")
+    eng.apply_churn([], ["x/y", "x/y"])
+    assert eng.fid_of("x/y") is None
+    assert eng.n_filters == 0
+    # over-removal caps at zero (extra removes are no-ops)
+    eng.add_filter("z/w")
+    eng.apply_churn([], ["z/w", "z/w", "z/w"])
+    assert eng.fid_of("z/w") is None
+
+
+def test_apply_churn_clears_slow_path_verify_state():
+    """Regression: filters added via the small-batch slow path populate
+    _words/_fbytes even with the native registry; churn removal must
+    clear them so a reused fid never verifies against a stale filter."""
+    eng = TopicMatchEngine()
+    eng.add_filters(["p/q", "r/s"])  # <512: slow path
+    fid = eng.fid_of("p/q")
+    eng.apply_churn([], ["p/q", "r/s"])
+    assert fid not in eng._words
+    assert fid not in eng._fbytes

@@ -44,7 +44,9 @@ def build(config: int, subs_cap=None):
         return bench.pop_wild_100k(rng)
     if config == 3:
         return bench.pop_mixed(rng, subs_cap or 1_000_000)
-    if config in (4, 5):
+    if config == 4:
+        return bench.pop_zipf(rng, subs_cap or 10_000_000)
+    if config == 5:
         return bench.pop_mixed(rng, subs_cap or 10_000_000)
     raise SystemExit(f"unknown config {config}")
 
