@@ -1,0 +1,24 @@
+# Build/check entry points (the reference's `make` + rebar gates analog:
+# /root/reference/Makefile, rebar.config:16-36 dialyzer/xref/elvis).
+
+.PHONY: check lint test test-fast native bench
+
+# static-analysis gate: stdlib implementation (mypy/ruff are not in this
+# image and installs are off-limits — see tools/check.py header)
+lint:
+	python tools/check.py
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -x --ignore=tests/test_cluster_fvt.py
+
+# lint + full suite = the merge gate
+check: lint test
+
+native:
+	$(MAKE) -C native
+
+bench:
+	python bench.py

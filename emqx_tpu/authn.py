@@ -19,7 +19,7 @@ import hmac
 import json
 import os
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .broker.access_control import ALLOW, DENY, ClientInfo

@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .broker import topic as topiclib
-from .broker.access_control import ALLOW, DENY, PUB, SUB, ClientInfo
+from .broker.access_control import ALLOW, DENY, PUB, ClientInfo
 from .broker.hooks import Hooks, STOP
 
 NOMATCH = "nomatch"

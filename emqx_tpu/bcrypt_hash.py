@@ -19,7 +19,6 @@ import ctypes
 import hmac
 import os
 import threading
-from typing import Optional
 
 from .ops import native
 

@@ -24,7 +24,6 @@ Implements:
 from __future__ import annotations
 
 import socket
-import struct
 from typing import Any, Dict, List, Optional, Tuple
 
 from .dbpool import PooledDriver

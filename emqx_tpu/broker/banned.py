@@ -12,7 +12,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
-from .access_control import ALLOW, DENY, ClientInfo
+from .access_control import DENY, ClientInfo
 from .hooks import Hooks, STOP
 
 

@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from . import packet as pkt
 from . import topic as topiclib
-from .access_control import ALLOW, AccessControl, AuthzCache, ClientInfo, DENY, PUB, SUB
+from .access_control import ALLOW, AccessControl, ClientInfo, DENY, PUB, SUB
 from .broker import Broker
 from .message import Message, now_ms
 from .packet import PacketType, Property, ReasonCode, SubOpts
@@ -912,7 +912,26 @@ class Channel:
                     )
                     == ALLOW
                 ):
-                    self.broker.publish(self.will_msg)
+                    if self.will_delay > 0 and self.session.expiry_interval > 0:
+                        # v5 Will Delay Interval: publish when the delay
+                        # passes OR the session ends, whichever first
+                        # (MQTT-3.1.3.2.2); a resume cancels (the CM owns
+                        # the timer — this channel object dies now)
+                        expiry = self.session.expiry_interval
+                        delay = (
+                            self.will_delay
+                            if expiry == 0xFFFFFFFF
+                            else min(self.will_delay, expiry)
+                        )
+                        msg = self.will_msg
+                        broker = self.broker
+                        broker.cm.schedule_will(
+                            self.clientid,
+                            lambda: broker.publish(msg),
+                            time.time() + delay,
+                        )
+                    else:
+                        self.broker.publish(self.will_msg)
                 self.will_msg = None
             if self.session.expiry_interval == 0:
                 # session dies with the connection: clean routes; pending

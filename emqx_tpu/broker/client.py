@@ -10,12 +10,11 @@ from __future__ import annotations
 
 import asyncio
 import ssl
-from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from . import packet as pkt
 from .frame import FrameError, Parser, serialize
-from .packet import MQTT_V4, MQTT_V5, PacketType, Property, SubOpts
+from .packet import MQTT_V5, PacketType, SubOpts
 
 
 class MqttError(Exception):
@@ -105,6 +104,8 @@ class MqttClient:
             c.will_payload = payload
             c.will_qos = qos
             c.will_retain = retain
+            if getattr(self, "will_props", None):
+                c.will_props = dict(self.will_props)
         self._send(c)
         self._read_task = asyncio.create_task(self._read_loop())
         await asyncio.wait_for(self._connected.wait(), 10)

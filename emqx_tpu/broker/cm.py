@@ -35,6 +35,11 @@ class ConnectionManager:
         self.on_park: Optional[Callable[[str, Session, float], None]] = None
         # fires when a parked session is resumed by a reconnect
         self.on_resume: Optional[Callable[[str], None]] = None
+        # v5 Will Delay Interval (MQTT-3.1.3.2.2): a will scheduled at
+        # disconnect, published when the delay passes or the session
+        # ends — whichever first — and cancelled by a resume.
+        # clientid -> (fire closure, fire_at)
+        self.delayed_wills: Dict[str, Tuple[Callable[[], None], float]] = {}
 
     # ------------------------------------------------------------- open
 
@@ -65,6 +70,9 @@ class ConnectionManager:
             if dropped and self.on_discard:
                 tp("session_discarded", clientid=clientid, live=False)
                 self.on_discard(dropped[0])
+            # the OLD session (if any) ends here: its delayed will, if
+            # still pending, publishes now (delay-or-session-end rule)
+            self.fire_will_now(clientid)
             tp("session_created", clientid=clientid)
             return make_session(), False
         if old is not None:
@@ -72,6 +80,7 @@ class ConnectionManager:
             tp("session_takeover_begin", clientid=clientid)
             self._kick(old, ReasonCode.SESSION_TAKEN_OVER)
             tp("session_takeover_end", clientid=clientid)
+            self.cancel_will(clientid)
             return session, True
         ent = self.pending.pop(clientid, None)
         if ent is not None:
@@ -79,6 +88,9 @@ class ConnectionManager:
             if time.time() < expire_at or session.expiry_interval == 0xFFFFFFFF:
                 if self.on_resume:
                     self.on_resume(clientid)
+                # resumed before the will delay elapsed: the will MUST
+                # NOT be sent (MQTT-3.1.3-9)
+                self.cancel_will(clientid)
                 tp("session_resumed", clientid=clientid)
                 return session, True
             if self.on_discard:
@@ -140,22 +152,54 @@ class ConnectionManager:
         ent = self.pending.pop(clientid, None)
         if ent and self.on_discard:
             self.on_discard(ent[0])
+        self.fire_will_now(clientid)  # session ends: delayed will due
 
     def kick_session(self, clientid: str, rc: int = ReasonCode.ADMINISTRATIVE_ACTION) -> bool:
         old = self.channels.get(clientid)
         if old is not None:
             self._kick(old, rc)
             return True
-        return self.pending.pop(clientid, None) is not None
+        if self.pending.pop(clientid, None) is not None:
+            # killing a parked session ends it: its delayed will is due
+            # now, like discard_session/evict_expired (session-end arm)
+            self.fire_will_now(clientid)
+            return True
+        return False
 
     def evict_expired(self, now: Optional[float] = None) -> int:
         now = now if now is not None else time.time()
         dead = [cid for cid, (_s, exp) in self.pending.items() if exp <= now]
         for cid in dead:
             s, _ = self.pending.pop(cid)
+            self.fire_will_now(cid)  # session end precedes any will delay
             if self.on_discard:
                 self.on_discard(s)
+        self.fire_due_wills(now)
         return len(dead)
+
+    # -------------------------------------------------------- delayed wills
+
+    def schedule_will(
+        self, clientid: str, fire: Callable[[], None], fire_at: float
+    ) -> None:
+        self.delayed_wills[clientid] = (fire, fire_at)
+
+    def cancel_will(self, clientid: str) -> bool:
+        return self.delayed_wills.pop(clientid, None) is not None
+
+    def fire_will_now(self, clientid: str) -> None:
+        ent = self.delayed_wills.pop(clientid, None)
+        if ent is not None:
+            ent[0]()
+
+    def fire_due_wills(self, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.time()
+        due = [cid for cid, (_f, at) in self.delayed_wills.items()
+               if at <= now]
+        for cid in due:
+            fire, _ = self.delayed_wills.pop(cid)
+            fire()
+        return len(due)
 
     @property
     def connection_count(self) -> int:

@@ -8,7 +8,6 @@ loops behind the same API (see ops/native).
 
 from __future__ import annotations
 
-import io
 import struct
 from typing import List, Optional, Tuple
 

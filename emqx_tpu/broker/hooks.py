@@ -15,7 +15,7 @@ Callback protocol (pythonized):
 from __future__ import annotations
 
 import bisect
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Tuple
 
 STOP = "stop"
 CONTINUE = "ok"

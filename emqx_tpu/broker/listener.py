@@ -17,13 +17,12 @@ import asyncio
 import logging
 import ssl
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from . import packet as pkt
 from .broker import Broker
 from .channel import Action, Channel, ChannelConfig
 from .frame import FrameError, Parser, serialize, serialize_cached
-from .message import Message
 
 log = logging.getLogger("emqx_tpu.listener")
 

@@ -10,7 +10,7 @@ Prometheus export.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Tuple
+from typing import Dict
 
 # the reference's predefined metric names (subset; extended at runtime)
 PREDEFINED = [

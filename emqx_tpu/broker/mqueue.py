@@ -9,7 +9,7 @@ priority when full.
 from __future__ import annotations
 
 from collections import deque
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, List, Optional
 
 from .message import Message
 

@@ -28,7 +28,7 @@ import json
 import os
 import tempfile
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from .inflight import InflightEntry
 from .message import Message

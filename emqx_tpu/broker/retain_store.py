@@ -19,7 +19,7 @@ import json
 import logging
 import os
 import struct
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Dict, Iterator, Tuple
 
 from .message import Message
 

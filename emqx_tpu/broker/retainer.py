@@ -43,6 +43,17 @@ class Retainer:
         # wildcard fan-in as ONE device dispatch instead of a trie walk
         # — the trie stays canonical truth (and the verify oracle)
         self.index = device_index
+        # host/device arbitration, same policy as the publish engine
+        # (models/engine.py): the index serves while its MEASURED
+        # dispatch latency stays under budget; past it (a degraded
+        # host<->device link) the trie serves and the index is re-probed
+        # every probe_interval so recovery is automatic
+        self.index_lat_budget = 0.05  # seconds per lookup
+        self.probe_interval = 10.0
+        self._index_lat: float = 0.0  # EWMA
+        self._last_index_use = 0.0
+        self.index_serves = 0
+        self.trie_serves = 0
         if store is not None:
             for msg in store.load().values():
                 self._insert(msg, persist=False)
@@ -132,14 +143,31 @@ class Retainer:
 
         With the device index attached, the name set comes from ONE
         kernel dispatch (models/retained.py) and only the hit topics
-        touch the trie (message fetch + expiry check).
+        touch the trie (message fetch + expiry check) — unless the
+        index's measured latency is over budget (degraded link), in
+        which case the trie serves until a periodic re-probe succeeds.
         """
-        if self.index is not None and len(self.index):
-            for t in self.index.lookup(filt):
+        if self.index is not None and len(self.index) and self._index_ok():
+            import time as _time
+
+            t0 = _time.monotonic()
+            names = self.index.lookup(filt)
+            dt = _time.monotonic() - t0
+            if dt <= self.index_lat_budget:
+                # snap down on a good lookup: one outlier (first-lookup
+                # JIT compile, a GC pause) must not bench a healthy
+                # index for several probe windows
+                self._index_lat = dt
+            else:
+                self._index_lat = 0.5 * self._index_lat + 0.5 * dt
+            self._last_index_use = _time.monotonic()
+            self.index_serves += 1
+            for t in names:
                 msg = self.get(t)
                 if msg is not None and not msg.expired():
                     yield msg
             return
+        self.trie_serves += 1
         fw = topiclib.words(filt)
         stack = [(self.root, 0, True)]
         while stack:
@@ -169,6 +197,14 @@ class Retainer:
                 c = node.children.get(w)
                 if c is not None:
                     stack.append((c, i + 1, False))
+
+    def _index_ok(self) -> bool:
+        import time as _time
+
+        if self._index_lat <= self.index_lat_budget:
+            return True
+        # over budget: re-probe occasionally so a recovered link flips back
+        return _time.monotonic() - self._last_index_use > self.probe_interval
 
     def match_filter(self, filt: str) -> List[Message]:
         """All retained messages whose topic matches the filter."""

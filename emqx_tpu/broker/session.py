@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Tuple
 from .inflight import Inflight, InflightEntry
 from .message import Message
 from .mqueue import MQueue
-from .packet import Property, Publish, ReasonCode, SubOpts
+from .packet import ReasonCode, SubOpts
 
 
 class SessionError(Exception):

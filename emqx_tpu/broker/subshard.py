@@ -24,7 +24,7 @@ Dispatch uses the compact matched-fid return, not the device counts.)
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Sequence, Tuple
 
 import numpy as np
 

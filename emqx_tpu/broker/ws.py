@@ -20,7 +20,7 @@ import logging
 import os
 import ssl
 import struct
-from typing import Optional, Tuple
+from typing import Tuple
 
 from .listener import Connection, Listener
 

@@ -14,7 +14,6 @@ pulls the log tail from the coordinator (`cluster_catchup`).
 
 from __future__ import annotations
 
-import asyncio
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .node import ClusterNode

@@ -676,6 +676,9 @@ class ClusterNode:
         ent = cm.pending.pop(cid, None)
         if ent is not None:
             session, expire_at = ent
+            # the session resumes on the peer: its delayed will must NOT
+            # publish here (MQTT-3.1.3-9, same as the local resume path)
+            cm.cancel_will(cid)
             if cm.on_resume:
                 # persistence hook: the on-disc copy must die with the
                 # handoff or a restart would resurrect a stale duplicate

@@ -20,8 +20,8 @@ from __future__ import annotations
 import copy
 import json
 import os
-from dataclasses import dataclass, field as dfield
-from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Union
 
 
 class ConfigError(Exception):

@@ -38,7 +38,7 @@ run synchronously in the channel — wrap async clients accordingly):
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 from .utils.net import peer_host as _peer_host
 

@@ -23,7 +23,6 @@ from __future__ import annotations
 import base64
 import json
 import logging
-import time
 from concurrent import futures
 from typing import Any, Dict, List, Optional
 

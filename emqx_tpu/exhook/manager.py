@@ -23,8 +23,8 @@ import dataclasses
 import logging
 import queue
 import threading
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
 
 from ..broker.access_control import ALLOW, DENY, ClientInfo
 from ..broker.hooks import STOP, Hooks

@@ -15,7 +15,6 @@ import logging
 import os
 import subprocess
 import threading
-from typing import Optional
 
 log = logging.getLogger("emqx_tpu.exhook.proto")
 

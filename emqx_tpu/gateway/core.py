@@ -13,9 +13,9 @@ gateway through emqx_broker.
 from __future__ import annotations
 
 import logging
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
-from ..broker.access_control import AccessControl, ALLOW, DENY, ClientInfo
+from ..broker.access_control import AccessControl, ALLOW, ClientInfo
 from ..broker.broker import Broker
 from ..broker.cm import ConnectionManager
 from ..broker.message import Message

@@ -36,8 +36,8 @@ from ..broker.broker import Broker
 from .coap import (
     ACK, CON, NON, RST,
     GET, POST, PUT, DELETE,
-    CREATED, CHANGED, CONTENT, DELETED, BAD_REQUEST, UNAUTHORIZED, NOT_FOUND,
-    OPT_OBSERVE, OPT_URI_PATH, OPT_URI_QUERY, OPT_CONTENT_FORMAT,
+    CREATED, CHANGED, DELETED, BAD_REQUEST, UNAUTHORIZED, NOT_FOUND,
+    OPT_OBSERVE, OPT_URI_PATH, OPT_CONTENT_FORMAT,
     CoapMessage, parse, serialize,
 )
 from ..utils.net import UdpProtocolMixin

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from typing import Dict, List, Optional, Tuple
 
 from ..broker.access_control import ClientInfo

@@ -38,7 +38,6 @@ from ..broker import topic as topiclib
 from ..ops import hashing
 from ..ops.match import (
     DeviceTables,
-    TopicBatch,
     next_pow2 as _next_pow2,
 )
 from ..ops.tables import MatchTables

@@ -11,7 +11,7 @@ linear scan.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Set
+from typing import Dict, List, Set
 
 from ..broker import topic as topiclib
 

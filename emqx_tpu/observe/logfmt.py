@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import json
 import logging
-import time
 from typing import Any
 
 # attributes of a LogRecord that are NOT call-site extras

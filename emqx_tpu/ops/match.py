@@ -14,7 +14,7 @@ lives in `emqx_tpu.parallel`.
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -57,7 +57,6 @@ from ..ops.match import (
     apply_delta_impl,
     match_batch,
     next_pow2,
-    prepare_topic_batch,
 )
 from ..ops.tables import MatchTables
 from .mesh import FILTER_AXIS, make_mesh
@@ -351,10 +350,18 @@ class ShardedMatchEngine:
         self._dest_dirty = True
         return fid
 
-    def add_filters(self, filts: Sequence[str]) -> List[int]:
+    def add_filters(
+        self, filts: Sequence[str], churn: bool = False
+    ) -> List[int]:
         """Bulk add: one native key pass per SHARD instead of per-filter
         inserts (the mesh analog of TopicMatchEngine.add_filters; fids
         round-robin over shards so partitions stay balanced).
+
+        ``churn=True`` places into the live shard arrays incrementally
+        (`churn_insert`: slot deltas ride the next fused dispatch) —
+        the default ``bulk_insert`` REBUILDS each touched shard, which
+        is right for bootstrap but forces a full mirror re-upload per
+        churn tick (measured: the sharded config-5 p99 driver).
 
         Same commit discipline as add_filter: shard table inserts happen
         BEFORE any registry state is written, so a failed insert leaves
@@ -401,9 +408,14 @@ class ShardedMatchEngine:
         try:
             for d in range(self.D):
                 if by_shard_strs[d]:
-                    self.shards[d].bulk_insert(
-                        by_shard_strs[d], by_shard_fids[d]
-                    )
+                    if churn:
+                        self.shards[d].churn_insert(
+                            by_shard_strs[d], by_shard_fids[d]
+                        )
+                    else:
+                        self.shards[d].bulk_insert(
+                            by_shard_strs[d], by_shard_fids[d]
+                        )
                 done = d + 1
         except BaseException:
             for dd in range(done):  # unwind shards already inserted
@@ -442,6 +454,45 @@ class ShardedMatchEngine:
         if plan:
             self._dest_dirty = True
         return fids
+
+    def apply_churn(
+        self, adds: Sequence[str], removes: Sequence[str]
+    ) -> List[int]:
+        """One churn tick: batched unsubscribes + subscribes.  Removes
+        are grouped per shard and tombstoned in one vectorized
+        `delete_batch` pass each (+ one registry del_bulk) — per-op
+        remove_filter measured ~15k ops/s, an order short of config 5's
+        churn rate.  Shard deltas accumulate and ride the next fused
+        dispatch (`sharded_step_compact`), same as the single-chip
+        engine's fused churn+match contract."""
+        dead_by_shard: List[List[int]] = [[] for _ in range(self.D)]
+        refs = self._refs
+        _fids = self._fids
+        for filt in removes:
+            fid = _fids.get(filt)
+            if fid is None:
+                continue
+            refs[fid] -= 1
+            if refs[fid] > 0:
+                continue
+            del refs[fid]
+            del _fids[filt]
+            self._words.pop(fid, None)
+            self._fbytes.pop(fid, None)
+            if fid in self._deep_fids:
+                self._deep_fids.discard(fid)
+                self._deep.delete(filt, fid)
+            else:
+                dead_by_shard[fid % self.D].append(fid)
+            self._free_fids.append(fid)
+        dead_all: List[int] = []
+        for d, fl in enumerate(dead_by_shard):
+            if fl:
+                self.shards[d].delete_batch(fl)
+                dead_all.extend(fl)
+        if dead_all and self._reg is not None:
+            self._reg.del_bulk(dead_all)
+        return self.add_filters(adds, churn=True)
 
     def remove_filter(self, filt: str) -> Optional[int]:
         fid = self._fids.get(filt)
@@ -562,8 +613,12 @@ class ShardedMatchEngine:
         return slots, ka, kb, vv
 
     def _prep_batch(self, topics: Sequence[str]) -> Tuple[TopicBatch, int]:
-        word_lists = [topiclib.words(t) for t in topics]
-        nb, n = prepare_topic_batch(self.space, word_lists, self.min_batch)
+        # native split+hash fast path (same as the single-chip engine):
+        # the pure-Python words()+hash loop measured 11 us/topic — the
+        # single biggest sharded-tick phase before the dispatch itself
+        from ..ops.match import prepare_topics_raw
+
+        nb, n = prepare_topics_raw(self.space, list(topics), self.min_batch)
         repl = self._repl()
         return TopicBatch(*(jax.device_put(a, repl) for a in nb)), n
 

@@ -27,7 +27,7 @@ from ..broker import topic as topiclib
 from ..broker.broker import Broker
 from ..broker.message import Message
 from .funcs import FUNCS, reset_proc_dict
-from .sql import BinOp, Call, Case, Field, Lit, Not, Query, SelectItem, SqlError, parse_sql
+from .sql import BinOp, Call, Case, Field, Lit, Not, Query, parse_sql
 
 log = logging.getLogger("emqx_tpu.rules")
 

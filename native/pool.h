@@ -57,7 +57,7 @@ class EtpuPool {
     unsigned hw = std::thread::hardware_concurrency();
     nworkers_ = hw > 16 ? 15 : (hw > 1 ? (int32_t)hw - 1 : 0);
     for (int32_t i = 0; i < nworkers_; i++) {
-      std::thread([this, gen = 0]() mutable {
+      std::thread([this, gen = uint64_t{0}]() mutable {
         while (true) {
           {
             std::unique_lock<std::mutex> lk(m_);

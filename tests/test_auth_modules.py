@@ -6,7 +6,6 @@ import hmac
 import json
 import time
 
-import pytest
 
 from emqx_tpu.authn import (
     AuthChain,
@@ -16,18 +15,15 @@ from emqx_tpu.authn import (
 )
 from emqx_tpu.authz import (
     AuthzChain,
-    BuiltInSource,
     ClientAclSource,
     FileSource,
-    HttpSource,
     Rule,
 )
 from emqx_tpu.broker import packet as pkt
-from emqx_tpu.broker.access_control import ALLOW, DENY, ClientInfo
+from emqx_tpu.broker.access_control import ALLOW, DENY
 from emqx_tpu.broker.banned import Banned, Flapping
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.channel import Channel
-from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.packet import MQTT_V5, PacketType, ReasonCode, SubOpts
 from emqx_tpu.modules import (
     AutoSubscribe,

@@ -11,7 +11,6 @@ from emqx_tpu.broker import packet as pkt
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.channel import Channel
 from emqx_tpu.broker.packet import (
-    MQTT_V4,
     MQTT_V5,
     PacketType,
     Property,
@@ -672,3 +671,40 @@ def test_fanout_wire_cache_correctness(h):
     for ver, data in ((5, w5), (4, w4)):
         (parsed,) = Parser(version=ver).feed(data)
         assert parsed.topic == "wc/t" and parsed.payload == b"data"
+
+
+def test_delayed_will_lifecycle_unit():
+    """CM delayed-will bookkeeping: due-fire, resume-cancel, and
+    session-end paths (admin kick of a parked session) all settle the
+    pending entry exactly once."""
+    import time as _t
+
+    from emqx_tpu.broker.cm import ConnectionManager
+
+    fired = []
+    cm = ConnectionManager()
+    cm.schedule_will("c1", lambda: fired.append("c1"), _t.time() + 100)
+    cm.fire_due_wills()  # not due yet
+    assert fired == []
+    cm.fire_due_wills(_t.time() + 200)
+    assert fired == ["c1"]
+    cm.fire_due_wills(_t.time() + 300)  # fires once only
+    assert fired == ["c1"]
+
+    # admin kick of a parked session ends it -> will due immediately
+    class _S:
+        expiry_interval = 100
+        subscriptions = {}
+
+    cm.pending["c2"] = (_S(), _t.time() + 100)
+    cm.schedule_will("c2", lambda: fired.append("c2"), _t.time() + 100)
+    assert cm.kick_session("c2")
+    assert fired == ["c1", "c2"]
+
+    # resume before the delay cancels (MQTT-3.1.3-9)
+    cm.pending["c3"] = (_S(), _t.time() + 100)
+    cm.schedule_will("c3", lambda: fired.append("c3"), _t.time() + 100)
+    s, present = cm.open_session(False, "c3", lambda: _S())
+    assert present and "c3" not in cm.delayed_wills
+    cm.fire_due_wills(_t.time() + 999)
+    assert fired == ["c1", "c2"]

@@ -17,7 +17,6 @@ sockets — the in-repo analog of the reference's docker-compose FVT
 """
 
 import asyncio
-import base64
 import json
 import os
 import signal

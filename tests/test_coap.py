@@ -6,7 +6,6 @@ import pytest
 
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.message import Message
-from emqx_tpu.gateway import coap
 from emqx_tpu.gateway.coap import (
     ACK, CON, NON, RST, GET, POST, DELETE,
     CREATED, CHANGED, CONTENT, DELETED, UNAUTHORIZED, NOT_FOUND,

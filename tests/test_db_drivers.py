@@ -7,13 +7,12 @@ variants.
 """
 
 import asyncio
-import hashlib
 
 import pytest
 
 from emqx_tpu import drivers
-from emqx_tpu.authn import AuthChain, DbAuthenticator, hash_password
-from emqx_tpu.authz import DbSource, AuthzChain, NOMATCH, Rule
+from emqx_tpu.authn import DbAuthenticator, hash_password
+from emqx_tpu.authz import DbSource, AuthzChain, NOMATCH
 from emqx_tpu.broker.access_control import ALLOW, DENY, PUB, SUB, ClientInfo
 from emqx_tpu.bridges.connectors import DbConnector, make_connector
 

@@ -6,7 +6,6 @@ import json
 import os
 import time
 
-import pytest
 
 os.environ.setdefault("XLA_FLAGS",
                       "--xla_force_host_platform_device_count=8")

@@ -8,7 +8,6 @@ into the TPU match provider.
 
 import time
 
-import pytest
 
 from emqx_tpu.broker.access_control import ALLOW, DENY, AccessControl, ClientInfo
 from emqx_tpu.broker.broker import Broker

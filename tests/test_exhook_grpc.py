@@ -12,7 +12,7 @@ import pytest
 
 pytest.importorskip("grpc")
 
-from emqx_tpu.broker.access_control import ALLOW, DENY, PUB, SUB
+from emqx_tpu.broker.access_control import ALLOW, DENY, PUB
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.packet import SubOpts

@@ -9,7 +9,7 @@ import random
 
 import pytest
 
-from emqx_tpu.broker import frame, packet as pkt
+from emqx_tpu.broker import packet as pkt
 from emqx_tpu.broker.frame import FrameError, Parser, serialize
 from emqx_tpu.broker.packet import MQTT_V3, MQTT_V4, MQTT_V5, Property, SubOpts
 

@@ -6,7 +6,7 @@ import time
 import pytest
 
 from emqx_tpu.broker.broker import Broker
-from emqx_tpu.broker.client import MqttClient, MqttError
+from emqx_tpu.broker.client import MqttClient
 from emqx_tpu.broker.limiter import Congestion, Limiter, Olp, TokenBucket
 from emqx_tpu.broker.listener import Listener
 from emqx_tpu.observe import AlarmManager

@@ -11,7 +11,7 @@ import pytest
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.client import MqttClient, MqttError
 from emqx_tpu.broker.listener import Listener
-from emqx_tpu.broker.packet import MQTT_V4, MQTT_V5, Property, ReasonCode, SubOpts
+from emqx_tpu.broker.packet import MQTT_V4, Property, ReasonCode
 
 
 @pytest.fixture

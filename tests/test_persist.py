@@ -9,13 +9,12 @@ reconnects with clean_start=False and replays its pending messages.
 import asyncio
 import time
 
-import pytest
 
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.client import MqttClient
 from emqx_tpu.broker.listener import Listener
 from emqx_tpu.broker.message import Message
-from emqx_tpu.broker.packet import MQTT_V5, Property, SubOpts
+from emqx_tpu.broker.packet import Property, SubOpts
 from emqx_tpu.broker.persist import (
     DiscBackend,
     RamBackend,

@@ -23,7 +23,6 @@ from emqx_tpu.authz import ALLOW, DENY, NOMATCH, DbSource
 from emqx_tpu.bridges.pgsql import (
     PgDriver,
     PgError,
-    PgProtocolError,
     md5_password,
     template_to_wire,
 )

@@ -16,7 +16,7 @@ import pytest
 
 from emqx_tpu import drivers
 from emqx_tpu.authn import DbAuthenticator, hash_password
-from emqx_tpu.authz import ALLOW, DENY, NOMATCH, DbSource
+from emqx_tpu.authz import ALLOW, NOMATCH, DbSource
 from emqx_tpu.bridges.redis import (
     RedisDriver,
     RedisError,

@@ -12,7 +12,6 @@ import asyncio
 import os
 import struct
 
-import pytest
 
 from emqx_tpu.utils.replayq import ReplayQ
 

@@ -6,7 +6,6 @@ Exercised both directly and through full SQL evaluation so the parser
 
 import math
 
-import pytest
 
 from emqx_tpu.rules.engine import RuleEngine
 from emqx_tpu.rules.funcs import FUNCS

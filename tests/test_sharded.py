@@ -3,7 +3,6 @@
 import random
 
 import jax
-import numpy as np
 import pytest
 
 from emqx_tpu.models.reference import BruteForceIndex

@@ -10,7 +10,6 @@ import random
 
 import jax
 import numpy as np
-import pytest
 
 from emqx_tpu.broker.broker import Broker
 from emqx_tpu.broker.message import Message

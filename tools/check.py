@@ -1,0 +1,236 @@
+"""Static-analysis gate (`make check`) — stdlib-only by necessity.
+
+The reference treats dialyzer/xref/elvis as part of the build
+(`/root/reference/rebar.config:16-36`); the analog here would be mypy +
+ruff, but neither ships in this image and installs are off-limits, so
+this gate implements the highest-value checks directly on the stdlib:
+
+  1. syntax: every .py compiles (py_compile)
+  2. undefined names: symtable resolves bindings per scope; a name
+     referenced as an implicit global that is bound neither at module
+     scope nor in builtins is a NameError waiting for its code path
+     (pyflakes' core check)
+  3. AST lints: unused imports, duplicate top-level/class-level defs,
+     mutable default arguments, bare `except:`
+  4. native layer: g++ -fsyntax-only -Wall -Wextra over native/*.cc
+
+Exit code 0 = clean.  `--fix` is intentionally absent: findings are
+either real bugs or deliberate (suppressed via `# check: ignore` on the
+offending line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+import sys
+import symtable
+import sysconfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TARGETS = ["emqx_tpu", "tests", "tools", "bench.py", "__graft_entry__.py"]
+
+# names bound at runtime in ways symtable cannot see
+_KNOWN_GLOBALS = {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__", "__class__",
+    "WindowsError",  # guarded platform use
+}
+
+
+def _py_files():
+    for t in TARGETS:
+        p = os.path.join(REPO, t)
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for f in files:
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def _ignored_lines(src: str):
+    return {
+        i + 1
+        for i, line in enumerate(src.splitlines())
+        if "# check: ignore" in line
+    }
+
+
+def _walk_tables(tab, out):
+    out.append(tab)
+    for child in tab.get_children():
+        _walk_tables(child, out)
+
+
+def check_undefined(path, src, tree, problems, ignored):
+    import builtins
+
+    try:
+        top = symtable.symtable(src, path, "exec")
+    except SyntaxError:
+        return
+    module_names = set(_KNOWN_GLOBALS)
+    for sym in top.get_symbols():
+        module_names.add(sym.get_name())
+    # names star-imported or assigned via exec can't be tracked; skip
+    # modules using either
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and any(
+            a.name == "*" for a in node.names
+        ):
+            return
+    tabs = []
+    _walk_tables(top, tabs)
+    bi = set(dir(builtins))
+    # line numbers for name loads, gathered once from the AST
+    loads = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            loads.setdefault(node.id, node.lineno)
+    for tab in tabs[1:]:  # skip module scope: handled via module_names
+        for sym in tab.get_symbols():
+            name = sym.get_name()
+            if not sym.is_referenced() or sym.is_assigned():
+                continue
+            if sym.is_parameter() or sym.is_imported():
+                continue
+            if sym.is_free():  # bound in an enclosing function scope
+                continue
+            if name in module_names or name in bi:
+                continue
+            line = loads.get(name, tab.get_lineno())
+            if line in ignored:
+                continue
+            problems.append(
+                f"{path}:{line}: undefined name {name!r} "
+                f"(in {tab.get_name()})"
+            )
+
+
+def check_ast_lints(path, src, tree, problems, ignored):
+    # unused imports (module scope only; conservative: any attribute or
+    # name use of the bound name counts, and re-export files are skipped)
+    base = os.path.basename(path)
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # the Name node below it is what binds
+    all_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        for el in node.value.elts:
+                            if isinstance(el, ast.Constant):
+                                all_names.add(el.value)
+    if base != "__init__.py":  # __init__ re-export surfaces are the API
+        for node in tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                if isinstance(node, ast.ImportFrom) \
+                        and node.module == "__future__":
+                    continue
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    if a.name == "*" or name.startswith("_"):
+                        continue
+                    if name not in used and name not in all_names \
+                            and node.lineno not in ignored:
+                        problems.append(
+                            f"{path}:{node.lineno}: unused import {name!r}"
+                        )
+    # duplicate defs, mutable defaults, bare except
+    def dup_scan(body, scope):
+        seen = {}
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                prev = seen.get(node.name)
+                # property/setter & overload pairs share a name legally
+                decs = {
+                    d.attr if isinstance(d, ast.Attribute)
+                    else getattr(d, "id", None)
+                    for d in getattr(node, "decorator_list", [])
+                }
+                if prev is not None and not decs & {"setter", "getter",
+                                                    "deleter", "overload"}:
+                    if node.lineno not in ignored:
+                        problems.append(
+                            f"{path}:{node.lineno}: duplicate definition "
+                            f"of {node.name!r} in {scope} "
+                            f"(first at line {prev})"
+                        )
+                seen[node.name] = node.lineno
+                if isinstance(node, ast.ClassDef):
+                    dup_scan(node.body, f"class {node.name}")
+
+    dup_scan(tree.body, "module")
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) \
+                        and node.lineno not in ignored:
+                    problems.append(
+                        f"{path}:{node.lineno}: mutable default argument "
+                        f"in {node.name!r}"
+                    )
+        elif isinstance(node, ast.ExceptHandler):
+            if node.type is None and node.lineno not in ignored:
+                problems.append(
+                    f"{path}:{node.lineno}: bare `except:` (catches "
+                    "SystemExit/KeyboardInterrupt)"
+                )
+
+
+def check_native(problems):
+    src_dir = os.path.join(REPO, "native")
+    if not os.path.isdir(src_dir):
+        return
+    srcs = sorted(
+        os.path.join(src_dir, f)
+        for f in os.listdir(src_dir)
+        if f.endswith(".cc")
+    )
+    inc = sysconfig.get_paths().get("include") or ""
+    for s in srcs:
+        cmd = ["g++", "-fsyntax-only", "-Wall", "-Wextra",
+               "-Wno-unused-parameter", "-std=c++17", "-march=native"]
+        if inc:
+            cmd.append(f"-I{inc}")
+        r = subprocess.run(cmd + [s], capture_output=True, text=True,
+                           timeout=120)
+        if r.returncode != 0 or r.stderr.strip():
+            problems.append(f"{s}: g++ -Wall -Wextra:\n{r.stderr.strip()}")
+
+
+def main() -> int:
+    problems = []
+    n = 0
+    for path in _py_files():
+        n += 1
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, path)
+        except SyntaxError as e:
+            problems.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        ignored = _ignored_lines(src)
+        check_undefined(path, src, tree, problems, ignored)
+        check_ast_lints(path, src, tree, problems, ignored)
+    check_native(problems)
+    for p in problems:
+        print(p)
+    print(f"\nchecked {n} python files + native/*.cc: "
+          f"{len(problems)} finding(s)", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""Phase breakdown of the mesh-sharded engine's match tick (VERDICT r4
+#5: WHERE do the milliseconds go on the 8-virtual-device CPU mesh?).
+
+Phases per tick:
+  prep      — host words/hash + replicated device_put of the topic batch
+  dispatch  — the pjit'd mesh computation (block_until_ready)
+  fetch     — device->host of the compact [D, B, k] hits + counts
+  verify    — registry-backed exact verification + row assembly
+
+Run: python tools/profile_sharded.py [--subs 100000] [--ticks 512,4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--subs", type=int, default=100_000)
+    ap.add_argument("--ticks", default="512,4096")
+    ap.add_argument("--iters", type=int, default=20)
+    ns = ap.parse_args()
+
+    import gc
+    import importlib.util
+    import random
+
+    spec = importlib.util.spec_from_file_location(
+        "benchmod", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    from emqx_tpu.parallel.sharded import ShardedMatchEngine
+    from emqx_tpu.parallel import sharded as shmod
+
+    rng = random.Random(1236)
+    filters, topics_fn = bench.pop_wild_100k(rng, ns.subs)
+    eng = ShardedMatchEngine(kcap=64)
+    t0 = time.time()
+    eng.add_filters(filters)
+    print(f"insert {len(filters)/(time.time()-t0):,.0f}/s over {eng.D} "
+          f"devices", file=sys.stderr)
+    gc.collect()
+    gc.freeze()
+
+    for tick in (int(x) for x in ns.ticks.split(",")):
+        batches = [topics_fn()[:tick] for _ in range(6)]
+        eng.match(batches[0])  # compile
+        eng.match(batches[1])
+        prep_s = disp_s = fetch_s = verify_s = 0.0
+        lat = []
+        for i in range(ns.iters):
+            topics = batches[i % 6]
+            b0 = time.perf_counter()
+            p0 = time.perf_counter()
+            batch, n = eng._prep_batch(topics)
+            p1 = time.perf_counter()
+            hits, counts = shmod.sharded_match_compact(
+                eng._stacked, batch, mesh=eng.mesh, kcap=eng.kcap
+            )
+            jax.block_until_ready((hits, counts))
+            p2 = time.perf_counter()
+            h = np.asarray(hits)[:, :n, :]
+            c = np.asarray(counts)[:, :n]
+            p3 = time.perf_counter()
+            pend = shmod._ShardedPending(
+                hits, counts, eng._stacked, n, list(topics), None
+            )
+            out = eng.match_collect_raw(pend)
+            p4 = time.perf_counter()
+            prep_s += p1 - p0
+            disp_s += p2 - p1
+            fetch_s += p3 - p2
+            verify_s += p4 - p3
+            lat.append(p4 - b0)
+        it = ns.iters
+        a = np.array(lat) * 1e3
+        print(
+            f"tick {tick:5d}: prep {prep_s/it*1e3:7.2f}  "
+            f"dispatch {disp_s/it*1e3:7.2f}  fetch {fetch_s/it*1e3:7.2f}  "
+            f"verify+asm {verify_s/it*1e3:7.2f} ms | "
+            f"p50 {np.percentile(a,50):.1f} p99 {np.percentile(a,99):.1f} ms "
+            f"-> {it*tick/sum(lat):,.0f} lookups/s"
+        )
+
+
+if __name__ == "__main__":
+    main()
