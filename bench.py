@@ -55,29 +55,52 @@ def log(*a):
 class ChurnPacer:
     """Wall-clock churn pacing shared by the CPU baseline and the engine
     north-star sweep: both sides owe `rate` ops/sec of churn, accrued by
-    elapsed time — ONE implementation so the fairness claim can't drift."""
+    elapsed time — ONE implementation so the fairness claim can't drift.
 
-    def __init__(self, rate: float):
+    The backlog is BOUNDED: when the applier cannot sustain `rate`,
+    unbounded debt would make every loop diverge (each pass accrues more
+    churn than it retires — the config-5 CPU trie at 10M sits right at
+    the 500k ops/s demand).  Debt beyond `max_backlog` seconds' worth is
+    shed and counted in `.shed`, and a single call returns at most
+    `per_call` seconds' worth, so the measured loop always progresses
+    and the table reports the ACHIEVED churn rate honestly."""
+
+    def __init__(self, rate: float, max_backlog: float = 0.25,
+                 per_call: float = 0.02):
         self.rate = rate
         self.last = time.time()
         self.debt = 0.0
+        self.shed = 0
+        self.max_backlog = max_backlog
+        self.per_call = per_call
 
     def owed(self, now: float) -> int:
         self.debt += (now - self.last) * self.rate
         self.last = now
-        n = int(self.debt)
+        cap = self.rate * self.max_backlog
+        if self.debt > cap:
+            self.shed += int(self.debt - cap)
+            self.debt = cap
+        n = min(int(self.debt), max(1, int(self.rate * self.per_call)))
         self.debt -= n
         return n
 
 
-def pick_north_star(ns_rows, cpu_rps):
-    """(best_row, passed): the highest-throughput row meeting BOTH gates
-    (>=10x CPU and p99 < 2 ms), else the highest-throughput row overall.
-    Single source for the headline JSON and BENCH_TABLE.md."""
+def pick_north_star(ns_rows, cpu_rps, churn_target: float = 0.0):
+    """(best_row, passed): the highest-throughput row meeting ALL gates
+    (>=10x CPU, p99 < 2 ms, and — when the workload churns — achieved
+    churn >= 90% of target, so a row cannot buy throughput by shedding
+    its own load), else the highest-throughput row overall.  Single
+    source for the headline JSON and BENCH_TABLE.md."""
     if not ns_rows:
         return None, False
-    passing = [r for r in ns_rows
-               if r["p99_ms"] < 2.0 and r["rps"] >= 10 * cpu_rps]
+    passing = [
+        r for r in ns_rows
+        if r["p99_ms"] < 2.0
+        and r["rps"] >= 10 * cpu_rps
+        and (not churn_target
+             or r.get("churn_rps", 0.0) >= 0.9 * churn_target)
+    ]
     if passing:
         return max(passing, key=lambda r: r["rps"]), True
     return max(ns_rows, key=lambda r: r["rps"]), False
@@ -212,7 +235,7 @@ def cpu_baseline(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     hits = 0
     for k, t in enumerate(cpu_topics):
         hits += len(trie.match(t))
-        if target_cps and churn_pool and (k & 63) == 63:
+        if target_cps and churn_pool and (k & 7) == 7:
             n_ops = pacer.owed(time.time())
             for _ in range(n_ops):
                 f = churn_pool[churn_i % len(churn_pool)]
@@ -227,7 +250,8 @@ def cpu_baseline(filters, topics_fn, churn_frac=0.0, churn_pool=None):
                 churn_events += 1
     cpu_rps = len(cpu_topics) / (time.time() - m0)
     log(f"cpu baseline: insert {cpu_insert_rps:,.0f}/s, lookup {cpu_rps:,.0f}/s "
-        f"({hits} hits, {churn_events} churn events)")
+        f"({hits} hits, {churn_events} churn events, "
+        f"{pacer.shed if target_cps else 0} shed)")
     return cpu_insert_rps, cpu_rps
 
 
@@ -514,6 +538,7 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
         eng.match_collect_raw(eng.match_submit(tb[0]))  # warm shape
         iters = max(30, min(300, int(2_000_000 / tick)))
         lat = []
+        churn_before = churn_events
         pacer = ChurnPacer(target_cps)
         t0 = time.time()
         pacer.last = t0
@@ -528,10 +553,21 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
         wall = time.time() - t0
         rate = iters * tick / wall
         p99 = float(np.percentile(np.array(lat) * 1e3, 99))
-        ns_rows.append({"tick": tick, "rps": rate, "p99_ms": p99})
-        log(f"north-star tick {tick}: {rate:,.0f} lookups/s, p99 {p99:.2f} ms")
+        row = {"tick": tick, "rps": rate, "p99_ms": p99}
+        if target_cps:
+            applied = churn_events - churn_before
+            row["churn_rps"] = applied / wall
+            row["churn_shed"] = pacer.shed
+            log(f"north-star tick {tick}: {rate:,.0f} lookups/s, p99 "
+                f"{p99:.2f} ms; churn {applied/wall:,.0f}/s applied "
+                f"(target {target_cps:,.0f}, shed {pacer.shed})")
+        else:
+            log(f"north-star tick {tick}: {rate:,.0f} lookups/s, "
+                f"p99 {p99:.2f} ms")
+        ns_rows.append(row)
     return {
         "ns_rows": ns_rows,
+        "churn_target": target_cps,
         "tpu_rps": hyb_rps,  # headline: the production (hybrid) match rate
         "p99_ms": hyb_p99,
         "p99_small_ms": hyb_p99_small,
@@ -699,6 +735,7 @@ def run_sharded(subs_cap=None, workload=2):
     ITERS_S = 30
     pending = []
     pacer = ChurnPacer(target_cps)
+    churn_before = churn_i
     r0 = time.time()
     pacer.last = r0
     for i in range(ITERS_S):
@@ -711,9 +748,12 @@ def run_sharded(subs_cap=None, workload=2):
             res = eng.match_collect_raw(pending.pop(0))
     while pending:
         res = eng.match_collect_raw(pending.pop(0))
-    rps = ITERS_S * TICK / (time.time() - r0)
+    wall = time.time() - r0
+    rps = ITERS_S * TICK / wall
+    churn_rps = (churn_i - churn_before) / wall if target_cps else 0.0
     log(f"sharded e2e: {rps:,.0f} lookups/s (p99 {p99:.2f} ms at {TICK}); "
-        f"collisions {eng.collision_count}; churn events {churn_i}; "
+        f"collisions {eng.collision_count}; churn {churn_rps:,.0f}/s "
+        f"applied (target {target_cps:,.0f}, shed {pacer.shed}); "
         f"sample hits {sum(len(s) for s in res)}")
     return {
         "tpu_rps": rps,
@@ -726,6 +766,9 @@ def run_sharded(subs_cap=None, workload=2):
         "n_devices": eng.D,
         "workload": workload,
         "churn_events": churn_i,
+        "churn_rps": churn_rps,
+        "churn_target": target_cps,
+        "churn_shed": pacer.shed,
         "phases": phases,
         "device": "cpu-mesh",
     }
@@ -923,7 +966,8 @@ def headline_json(n: int, stats: dict) -> str:
     """value/vs_baseline = the PRODUCTION engine.match() rate (hybrid
     arbitration, verify on — what a broker.publish tick actually pays);
     the device-only e2e and raw kernel rates ride along."""
-    best, passed = pick_north_star(stats.get("ns_rows"), stats["cpu_rps"])
+    best, passed = pick_north_star(stats.get("ns_rows"), stats["cpu_rps"],
+                               stats.get("churn_target", 0.0))
     return json.dumps({
         "metric": f"route_lookups_per_sec_{CONFIGS[n][0]}",
         "value": round(stats["tpu_rps"]),
@@ -1135,20 +1179,24 @@ def main() -> None:
             "host — with one core there is no parallel-host upper bound "
             "beyond the single-thread rate shown, so the speedup column "
             "is also the engine-vs-parallel-CPU-host ratio.\n\n"
-            "| # | best tick | lookups/s | speedup | p99 ms | >=10x | "
-            "<2ms | gates |\n"
-            "|---|---|---|---|---|---|---|---|\n"
+            "| # | best tick | lookups/s | speedup | p99 ms | churn/s | "
+            ">=10x | <2ms | gates |\n"
+            "|---|---|---|---|---|---|---|---|---|\n"
         )
         for n, s in rows.items():
-            best, _passed = pick_north_star(s.get("ns_rows"), s["cpu_rps"])
+            best, _passed = pick_north_star(s.get("ns_rows"), s["cpu_rps"],
+                                s.get("churn_target", 0.0))
             if best is None:
                 continue
             ok10 = best["rps"] >= 10 * s["cpu_rps"]
             ok2 = best["p99_ms"] < 2.0
+            churn_col = (
+                f"{best['churn_rps']:,.0f}" if "churn_rps" in best else "—"
+            )
             f.write(
                 f"| {n} | {best['tick']} | {best['rps']:,.0f} "
                 f"| {best['rps']/s['cpu_rps']:.1f}x "
-                f"| {best['p99_ms']:.2f} "
+                f"| {best['p99_ms']:.2f} | {churn_col} "
                 f"| {'yes' if ok10 else 'NO'} | {'yes' if ok2 else 'NO'} "
                 f"| {'PASS' if ok10 and ok2 else 'fail'} |\n")
         f.write(
@@ -1176,7 +1224,8 @@ def main() -> None:
                 "DISPATCH PATH's overhead/correctness at scale, not ICI "
                 "speedup — real-mesh numbers need a v5e-8.\n\n"
                 "| workload | filters | lookups/s | vs cpu | p99 ms | "
-                "insert/s | churn events |\n|---|---|---|---|---|---|---|\n"
+                "insert/s | churn/s applied (target) |\n"
+                "|---|---|---|---|---|---|---|\n"
             )
             for w, s in sorted(sharded_rows.items()):
                 f.write(
@@ -1185,7 +1234,7 @@ def main() -> None:
                     f"| {s['tpu_rps']/s['cpu_rps']:.1f}x "
                     f"| {s['p99_ms']:.2f} "
                     f"| {s['insert_rps']:,.0f} "
-                    f"| {s.get('churn_events', 0):,} |\n"
+                    f"| {('%s (%s)' % (format(round(s.get('churn_rps', 0)), ','), format(round(s.get('churn_target', 0)), ','))) if s.get('churn_target') else '—'} |\n"
                 )
             f.write(
                 f"| single-chip hybrid (row 2, tick 4096) "
