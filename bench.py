@@ -217,11 +217,19 @@ def cpu_baseline(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     other."""
     from emqx_tpu.models.reference import CpuTrieIndex
 
-    trie = CpuTrieIndex()
-    ins0 = time.time()
-    for i, f in enumerate(filters):
-        trie.insert(f, i)
-    cpu_insert_rps = len(filters) / (time.time() - ins0)
+    # small populations: a single timed insert is ~1 ms on this host,
+    # inside VM noise — take best-of-5 fresh builds (both sides of the
+    # insert comparison use the same rule; see run_engine)
+    reps = 5 if len(filters) < 10_000 else 1
+    cpu_insert_rps = 0.0
+    for _ in range(reps):
+        trie = CpuTrieIndex()
+        ins0 = time.time()
+        for i, f in enumerate(filters):
+            trie.insert(f, i)
+        cpu_insert_rps = max(
+            cpu_insert_rps, len(filters) / (time.time() - ins0)
+        )
     cpu_topics = topics_fn()[:CPU_LOOKUPS]
     # clean lookup rate first: the kernel/device/insert comparison
     # columns baseline against an UNLOADED trie (config 5's churned rate
@@ -348,6 +356,17 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     ins0 = time.time()
     eng.add_filters(filters)
     insert_rps = len(filters) / (time.time() - ins0)
+    if len(filters) < 10_000:
+        # best-of-5 fresh engines: same noise rule as the cpu side
+        for _ in range(4):
+            e2 = TopicMatchEngine(device=dev)
+            e2.add_filter("$bench/warm")
+            e2.remove_filter("$bench/warm")
+            ins0 = time.time()
+            e2.add_filters(filters)
+            insert_rps = max(
+                insert_rps, len(filters) / (time.time() - ins0)
+            )
     log(f"engine insert (bulk): {insert_rps:,.0f}/s")
     tables = eng.sync_device()
 
@@ -1215,8 +1234,9 @@ def main() -> None:
             "sustained >=90% of the churn target).  Config 5's floor "
             "on this host is churn-apply capacity: 5%/sec of 10M "
             "routes = 500k subscribe/unsubscribe ops/s against ONE "
-            "core — the engine retires ~370k ops/s (the cpu trie "
-            "saturates likewise), so both sides shed load and no tick "
+            "core — the engine's measured apply capacity is the churn/s "
+            "column (the cpu trie saturates likewise), so both sides "
+            "shed load and no tick "
             "size meets the p99 gate while drowning; passing needs "
             "more cores for the route bookkeeping or a lower absolute "
             "churn rate (`python bench.py --config 5 --subs 500000` "

@@ -317,6 +317,29 @@ def scan_frames(buf: bytes, max_size: int, max_frames: int = 256) -> Optional[Fr
 
 
 def _pack_strs(strs):
+    """Pack strings into (buf, offsets): one join+encode + three
+    vectorized passes instead of a per-string encode loop (the loop was
+    half the cost of a small bulk insert).  MQTT forbids U+0000 in
+    topics/filters, so NUL is a safe separator; an embedded NUL is
+    detected by separator count and falls back to the per-string path."""
+    n = len(strs)
+    if n >= 64:
+        try:
+            data = "\x00".join(strs).encode("utf-8")
+        except TypeError:  # non-str entries: caller bug, slow path raises
+            return _pack_blobs([s.encode("utf-8") for s in strs])
+        buf = np.frombuffer(data, dtype=np.uint8)
+        mask = buf == 0
+        sep = np.flatnonzero(mask)
+        if len(sep) == n - 1:
+            offs = np.empty(n + 1, dtype=np.int64)
+            offs[0] = 0
+            offs[1:n] = sep - np.arange(n - 1)
+            offs[n] = len(data) - (n - 1)
+            packed = buf[~mask]
+            if not len(packed):
+                packed = np.zeros(1, dtype=np.uint8)
+            return np.ascontiguousarray(packed), offs
     return _pack_blobs([s.encode("utf-8") for s in strs])
 
 
