@@ -214,12 +214,17 @@ class MqttClient:
     # ------------------------------------------------------------ actions
 
     async def subscribe(
-        self, filters, qos: int = 0, properties: Optional[dict] = None
+        self, filters, qos: int = 0, properties: Optional[dict] = None,
+        retain_handling: int = 0, no_local: bool = False,
+        retain_as_published: bool = False,
     ) -> List[int]:
+        opts = SubOpts(qos=qos, retain_handling=retain_handling,
+                       no_local=no_local,
+                       retain_as_published=retain_as_published)
         if isinstance(filters, str):
-            filters = [(filters, SubOpts(qos=qos))]
+            filters = [filters]
         filters = [
-            (f, SubOpts(qos=qos)) if isinstance(f, str) else (f[0], f[1])
+            (f, opts) if isinstance(f, str) else (f[0], f[1])
             for f in filters
         ]
         pid = self._alloc_pid()

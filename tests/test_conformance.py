@@ -860,3 +860,67 @@ def test_qos2_exactly_once_across_reconnect(env):
         await p.disconnect()
 
     env.run(main())
+
+
+def test_retain_handling_options(env):
+    """v5 Retain Handling (MQTT-3.3.1-9..11): rh=0 sends retained on
+    every subscribe, rh=1 only on NEW subscriptions, rh=2 never."""
+
+    async def main():
+        pub = MqttClient("conf-rh-pub")
+        await pub.connect("127.0.0.1", env.port)
+        await pub.publish("rh/t", b"stored", qos=1, retain=True)
+
+        c = MqttClient("conf-rh")
+        await c.connect("127.0.0.1", env.port)
+        # rh=2: never send retained
+        await c.subscribe("rh/t", qos=1, retain_handling=2)
+        with pytest.raises(asyncio.TimeoutError):
+            await c.recv(0.5)
+        # rh=1 on an EXISTING subscription: still nothing
+        await c.subscribe("rh/t", qos=1, retain_handling=1)
+        with pytest.raises(asyncio.TimeoutError):
+            await c.recv(0.5)
+        # rh=0: always sends
+        await c.subscribe("rh/t", qos=1, retain_handling=0)
+        m = await c.recv()
+        assert m.payload == b"stored" and m.retain
+        await c.unsubscribe(["rh/t"])
+        # rh=1 on a NEW subscription: sends
+        await c.subscribe("rh/t", qos=1, retain_handling=1)
+        m = await c.recv()
+        assert m.payload == b"stored"
+        await c.disconnect()
+        await pub.disconnect()
+
+    env.run(main())
+
+
+def test_unsubscribe_stops_delivery(env):
+    """paho 'test_unsubscribe': after UNSUBACK no further publishes
+    arrive on that filter, and other filters are unaffected."""
+
+    async def main():
+        c = MqttClient("conf-unsub")
+        await c.connect("127.0.0.1", env.port)
+        await c.subscribe("us/a", qos=1)
+        await c.subscribe("us/b", qos=1)
+        pub = MqttClient("conf-unsub-pub")
+        await pub.connect("127.0.0.1", env.port)
+        await pub.publish("us/a", b"one", qos=1)
+        assert (await c.recv()).payload == b"one"
+        codes = await c.unsubscribe(["us/a"])
+        assert codes == [0]
+        await pub.publish("us/a", b"gone", qos=1)
+        await pub.publish("us/b", b"kept", qos=1)
+        m = await c.recv()
+        assert m.payload == b"kept"  # us/a publish was not delivered
+        with pytest.raises(asyncio.TimeoutError):
+            await c.recv(0.5)
+        # unsubscribing an unknown filter: 0x11 No subscription existed
+        codes = await c.unsubscribe(["us/never"])
+        assert codes == [0x11]
+        await c.disconnect()
+        await pub.disconnect()
+
+    env.run(main())
