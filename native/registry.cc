@@ -181,83 +181,29 @@ int64_t etpu_match_core(
     if (valid[m]) vshapes.push_back(m);
   const int32_t NV = (int32_t)vshapes.size();
 
+  // Two-phase candidate batching: phase 1 hashes topics and PREFETCHES
+  // every candidate's probe line; phase 2 probes an accumulated batch.
+  // On big tables (10M filters = hundreds of MB) each probe line is a
+  // DRAM miss — batching the prefetches overlaps those misses with the
+  // next topics' hash compute instead of stalling once per shape.  The
+  // flush threshold is a CANDIDATE count (not a topic count) so the
+  // prefetch distance stays inside cache capacity for any live shape
+  // count NV.
+  constexpr int32_t FLUSH = 64;
   EtpuPool::inst().parallel_for(B, 64, [&](int32_t i0, int32_t i1) {
     // terms need no zeroing between topics: incl rows are 0 beyond each
     // shape's prefix, and the length filters bound which shapes see a
     // topic, so stale lanes are always multiplied by 0.
     std::vector<uint32_t> terms_a(L, 0), terms_b(L, 0);
-    std::vector<uint32_t> homes(NV), has(NV), hbs(NV);
-    for (int32_t i = i0; i < i1; i++) {
-      const uint8_t* t = tbuf + toffs[i];
-      int64_t tn = toffs[i + 1] - toffs[i];
-      bool dol = (tn > 0 && t[0] == '$');
-      // split + hash levels
-      int32_t level = 0;
-      int64_t start = 0;
-      for (int64_t p = 0; p <= tn; p++) {
-        if (p == tn || t[p] == '/') {
-          if (level < L) {
-            uint64_t h = fnv1a64(t + start, (uint64_t)(p - start)) ^ PERTURB;
-            terms_a[level] = ((uint32_t)h ^ Ca[level]) * Ra[level];
-            terms_b[level] = ((uint32_t)(h >> 32) ^ Cb[level]) * Rb[level];
-          }
-          level++;
-          start = p + 1;
-        }
-      }
-      for (int32_t l = level; l < L; l++) terms_a[l] = terms_b[l] = 0;
-      int32_t len = (tn == 0) ? 1 : level;
-      // candidate shapes: length/dollar filters + hash combine
-      int32_t ncand = 0;
-#if defined(__AVX512F__)
-      if (L == 16) {
-        __m512i ta = _mm512_loadu_si512((const void*)terms_a.data());
-        __m512i tb = _mm512_loadu_si512((const void*)terms_b.data());
-        for (int32_t c = 0; c < NV; c++) {
-          int32_t m = vshapes[c];
-          if (len < min_len[m] || len > max_len[m]) continue;
-          if (dol && wild_root[m]) continue;
-          __m512i row =
-              _mm512_loadu_si512((const void*)(incl + (int64_t)m * 16));
-          uint32_t ha = k_a[m] + (uint32_t)_mm512_reduce_add_epi32(
-                                     _mm512_mullo_epi32(ta, row));
-          uint32_t hb = k_b[m] + (uint32_t)_mm512_reduce_add_epi32(
-                                     _mm512_mullo_epi32(tb, row));
-          uint32_t home = ((ha + hb * MIX1) * MIX2) >> (32 - log2cap);
-          __builtin_prefetch(key_a + home);
-          homes[ncand] = home;
-          has[ncand] = ha;
-          hbs[ncand] = hb;
-          ncand++;
-        }
-      } else
-#endif
-      {
-        for (int32_t c = 0; c < NV; c++) {
-          int32_t m = vshapes[c];
-          if (len < min_len[m] || len > max_len[m]) continue;
-          if (dol && wild_root[m]) continue;
-          const uint32_t* row = incl + (int64_t)m * L;
-          uint32_t ha = k_a[m], hb = k_b[m];
-          for (int32_t l = 0; l < L; l++) {
-            ha += terms_a[l] * row[l];
-            hb += terms_b[l] * row[l];
-          }
-          uint32_t home = ((ha + hb * MIX1) * MIX2) >> (32 - log2cap);
-          __builtin_prefetch(key_a + home);
-          homes[ncand] = home;
-          has[ncand] = ha;
-          hbs[ncand] = hb;
-          ncand++;
-        }
-      }
-      // probe + inline exact verification: reject on key_a first (the
-      // selective test — one cache line for the whole window) and touch
-      // key_b/val only on candidate slots
-      int32_t* row_out = out_fid + (int64_t)i * vcap;
-      int32_t nhit = 0;
+    const size_t ccap = (size_t)FLUSH + NV;  // one topic may overshoot
+    std::vector<uint32_t> homes(ccap), has(ccap), hbs(ccap);
+    std::vector<int32_t> c_topic(ccap);
+    int32_t ncand = 0;
+
+    auto probe_batch = [&]() {
       for (int32_t c = 0; c < ncand; c++) {
         uint32_t home = homes[c], ha = has[c], hb = hbs[c];
+        int32_t i = c_topic[c];
         uint32_t lanes;  // bitmask of window slots with key_a == ha
 #if defined(__AVX2__)
         if (probe == 8 && home + 8 <= cap) {
@@ -282,11 +228,14 @@ int64_t etpu_match_core(
             bool ok = false;
             if (v < (int32_t)reg->strs.size() && reg->present[v]) {
               const std::string& f = reg->strs[v];
+              const uint8_t* t = tbuf + toffs[i];
+              int64_t tn = toffs[i + 1] - toffs[i];
               ok = topic_matches(t, tn, (const uint8_t*)f.data(),
                                  (int64_t)f.size());
             }
             if (ok) {
-              if (nhit < vcap) row_out[nhit++] = v;
+              if (out_cnt[i] < vcap)
+                out_fid[(int64_t)i * vcap + out_cnt[i]++] = v;
             } else {
               int32_t k = coll_cursor.fetch_add(1);
               if (k < coll_cap) {
@@ -298,7 +247,85 @@ int64_t etpu_match_core(
           }
         }
       }
-      out_cnt[i] = nhit;
+      ncand = 0;
+    };
+
+    {
+      // ---- phase 1: split + hash + candidate homes + prefetch
+      for (int32_t i = i0; i < i1; i++) {
+        const uint8_t* t = tbuf + toffs[i];
+        int64_t tn = toffs[i + 1] - toffs[i];
+        bool dol = (tn > 0 && t[0] == '$');
+        int32_t level = 0;
+        int64_t start = 0;
+        for (int64_t p = 0; p <= tn; p++) {
+          if (p == tn || t[p] == '/') {
+            if (level < L) {
+              uint64_t h =
+                  fnv1a64(t + start, (uint64_t)(p - start)) ^ PERTURB;
+              terms_a[level] = ((uint32_t)h ^ Ca[level]) * Ra[level];
+              terms_b[level] = ((uint32_t)(h >> 32) ^ Cb[level]) * Rb[level];
+            }
+            level++;
+            start = p + 1;
+          }
+        }
+        for (int32_t l = level; l < L; l++) terms_a[l] = terms_b[l] = 0;
+        int32_t len = (tn == 0) ? 1 : level;
+        out_cnt[i] = 0;
+#if defined(__AVX512F__)
+        if (L == 16) {
+          __m512i ta = _mm512_loadu_si512((const void*)terms_a.data());
+          __m512i tb = _mm512_loadu_si512((const void*)terms_b.data());
+          for (int32_t c = 0; c < NV; c++) {
+            int32_t m = vshapes[c];
+            if (len < min_len[m] || len > max_len[m]) continue;
+            if (dol && wild_root[m]) continue;
+            __m512i row =
+                _mm512_loadu_si512((const void*)(incl + (int64_t)m * 16));
+            uint32_t ha = k_a[m] + (uint32_t)_mm512_reduce_add_epi32(
+                                       _mm512_mullo_epi32(ta, row));
+            uint32_t hb = k_b[m] + (uint32_t)_mm512_reduce_add_epi32(
+                                       _mm512_mullo_epi32(tb, row));
+            uint32_t home = ((ha + hb * MIX1) * MIX2) >> (32 - log2cap);
+            __builtin_prefetch(key_a + home);
+            homes[ncand] = home;
+            has[ncand] = ha;
+            hbs[ncand] = hb;
+            c_topic[ncand] = i;
+            ncand++;
+          }
+        } else
+#endif
+        {
+          for (int32_t c = 0; c < NV; c++) {
+            int32_t m = vshapes[c];
+            if (len < min_len[m] || len > max_len[m]) continue;
+            if (dol && wild_root[m]) continue;
+            const uint32_t* row = incl + (int64_t)m * L;
+            uint32_t ha = k_a[m], hb = k_b[m];
+            for (int32_t l = 0; l < L; l++) {
+              ha += terms_a[l] * row[l];
+              hb += terms_b[l] * row[l];
+            }
+            uint32_t home = ((ha + hb * MIX1) * MIX2) >> (32 - log2cap);
+            __builtin_prefetch(key_a + home);
+            homes[ncand] = home;
+            has[ncand] = ha;
+            hbs[ncand] = hb;
+            c_topic[ncand] = i;
+            ncand++;
+          }
+        }
+        // ---- phase 2 flush: probe + inline exact verification.
+        // Reject on key_a first (the selective test — one cache line
+        // per window) and touch key_b/val only on candidate lanes.
+        // Candidates stay grouped per topic in shape order, preserving
+        // hit order (a topic's candidates never split across flushes:
+        // the check runs between topics).
+        if (ncand >= FLUSH) probe_batch();
+      }
+      probe_batch();
     }
   });
   *n_coll = coll_cursor.load();
