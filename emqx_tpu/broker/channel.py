@@ -48,6 +48,7 @@ class ChannelConfig:
     max_topic_alias: int = 65535
     server_keepalive: Optional[int] = None
     max_clientid_len: int = 65535
+    max_packet_size: int = 1_048_576
     mountpoint: Optional[str] = None
     # retained re-delivery flow control (emqx_retainer.erl:85-150)
     retained_batch: int = 1000
@@ -377,6 +378,11 @@ class Channel:
             if not self.cfg.shared_sub_available:
                 props[Property.SHARED_SUBSCRIPTION_AVAILABLE] = 0
             props[Property.TOPIC_ALIAS_MAXIMUM] = self.cfg.max_topic_alias
+            if self.cfg.max_packet_size < 268_435_455:
+                # advertise the server's inbound limit (a bigger inbound
+                # packet is rejected at the frame scan with 0x95)
+                props[Property.MAXIMUM_PACKET_SIZE] = \
+                    self.cfg.max_packet_size
             # the broker's inbound QoS2 window IS its Receive Maximum
             # (QoS1 publishes are acked synchronously, so only
             # unreleased QoS2 flows count against it) — advertised so a

@@ -22,7 +22,8 @@ from typing import Dict, List, Optional
 from . import packet as pkt
 from .broker import Broker
 from .channel import Action, Channel, ChannelConfig
-from .frame import FrameError, Parser, serialize, serialize_cached
+from .frame import (DEFAULT_MAX_SIZE, FrameError, Parser, serialize,
+                    serialize_cached)
 
 log = logging.getLogger("emqx_tpu.listener")
 
@@ -36,7 +37,7 @@ class Connection:
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
         config: Optional[ChannelConfig] = None,
-        max_packet_size: int = 1_048_576,
+        max_packet_size: Optional[int] = None,
         limiter=None,
     ):
         peer = writer.get_extra_info("peername")
@@ -45,6 +46,12 @@ class Connection:
         peername = format_peername(peer) if peer else "?"
         self.reader = reader
         self.writer = writer
+        if max_packet_size is None:
+            # single source: the zone-merged mqtt.max_packet_size (the
+            # same limit the v5 CONNACK advertises)
+            max_packet_size = (
+                config.max_packet_size if config else DEFAULT_MAX_SIZE
+            )
         self.parser = Parser(max_size=max_packet_size)
         # per-client token buckets chained to the listener's zone roots
         self._bytes_bucket = limiter.client("bytes_in") if limiter else None

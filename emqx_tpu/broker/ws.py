@@ -206,7 +206,12 @@ class WsListener(Listener):
         if not ok:
             writer.close()
             return
-        ws_reader = WsReader(reader, writer)
+        # the WS message cap must track the MQTT packet cap (the v5
+        # CONNACK advertises it): +16 covers the MQTT fixed header so a
+        # packet exactly at the limit survives the WS framing check
+        mps = (self.config.max_packet_size
+               if self.config else MAX_MESSAGE_SIZE)
+        ws_reader = WsReader(reader, writer, max_message_size=mps + 16)
         ws_writer = WsWriter(writer)
         conn = Connection(self.broker, ws_reader, ws_writer, self.config,
                           limiter=self.limiter)

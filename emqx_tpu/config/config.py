@@ -631,6 +631,7 @@ def channel_config_from(conf: Config, zone: Optional[str] = None):
         max_topic_alias=m["max_topic_alias"],
         server_keepalive=m["server_keepalive"] or None,
         max_clientid_len=m["max_clientid_len"],
+        max_packet_size=m["max_packet_size"],
         retained_batch=conf.get("retainer.flow_control_batch"),
         retained_interval=conf.get("retainer.flow_control_interval"),
     )

@@ -924,3 +924,60 @@ def test_unsubscribe_stops_delivery(env):
         await pub.disconnect()
 
     env.run(main())
+
+
+@pytest.fixture
+def env3(tmp_path):
+    """Node with a small inbound max_packet_size."""
+    e = _make_env(tmp_path, {"mqtt": {"max_packet_size": 2048}})
+    yield e
+    _close_env(e)
+
+
+def test_inbound_packet_too_large_disconnects(env3):
+    """mqtt.max_packet_size bounds INBOUND packets: the CONNACK
+    advertises the limit (v5 Maximum Packet Size) and an oversize
+    PUBLISH gets DISCONNECT 0x95 + connection close (MQTT-3.1.2-24)."""
+
+    async def main():
+        c = MqttClient("conf-big")
+        ack = await c.connect("127.0.0.1", env3.port)
+        assert ack.properties[Property.MAXIMUM_PACKET_SIZE] == 2048
+        # within the limit: fine
+        await c.publish("big/ok", b"x" * 1500, qos=1)
+        # over the limit: server disconnects with 0x95
+        c._send(pkt.Publish(topic="big/no", payload=b"x" * 4096, qos=0))
+        await asyncio.wait_for(c.closed.wait(), 10)
+        d = c.disconnect_packet
+        assert d is not None and d.reason_code == 0x95
+
+    env3.run(main())
+
+
+def test_topic_alias_outbound(env):
+    """v5 outbound aliasing: when the CONNECT advertises Topic Alias
+    Maximum, the server substitutes aliases — first delivery carries
+    topic+alias, repeats carry the alias with an EMPTY topic
+    (MQTT-3.3.2-8)."""
+
+    async def main():
+        sub = MqttClient("conf-tao",
+                         properties={Property.TOPIC_ALIAS_MAXIMUM: 5})
+        await sub.connect("127.0.0.1", env.port)
+        await sub.subscribe("tao/deep/long/topic/name", qos=0)
+        p = MqttClient("conf-tao-p")
+        await p.connect("127.0.0.1", env.port)
+        await p.publish("tao/deep/long/topic/name", b"first", qos=0)
+        m1 = await sub.recv()
+        assert m1.topic == "tao/deep/long/topic/name"
+        alias = m1.properties.get(Property.TOPIC_ALIAS)
+        assert alias is not None and 1 <= alias <= 5
+        await p.publish("tao/deep/long/topic/name", b"again", qos=0)
+        m2 = await sub.recv()
+        assert m2.payload == b"again"
+        assert m2.topic == ""  # alias substitutes the name
+        assert m2.properties.get(Property.TOPIC_ALIAS) == alias
+        await sub.disconnect()
+        await p.disconnect()
+
+    env.run(main())
