@@ -49,6 +49,9 @@ class ChannelConfig:
     server_keepalive: Optional[int] = None
     max_clientid_len: int = 65535
     max_packet_size: int = 1_048_576
+    mqueue_store_qos0: bool = True
+    keepalive_backoff: float = 1.5
+    idle_timeout: float = 15.0
     mountpoint: Optional[str] = None
     # retained re-delivery flow control (emqx_retainer.erl:85-150)
     retained_batch: int = 1000
@@ -437,6 +440,7 @@ class Channel:
             retry_interval=self.cfg.retry_interval,
             max_awaiting_rel=self.cfg.max_awaiting_rel,
             await_rel_timeout=self.cfg.await_rel_timeout,
+            store_qos0=self.cfg.mqueue_store_qos0,
         )
 
     # -- PUBLISH ----------------------------------------------------------

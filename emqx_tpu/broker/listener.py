@@ -62,6 +62,11 @@ class Connection:
         self._closing: Optional[int] = None
         self._normal = False
         self._last_rx = time.monotonic()
+        # CONNECT must COMPLETE within mqtt.idle_timeout of accept; a
+        # fixed deadline, so trickled junk bytes cannot extend it
+        self._connect_deadline = self._last_rx + (
+            config.idle_timeout if config else 15.0
+        )
         self._retry_task: Optional[asyncio.Task] = None
         self._paced_tasks: Dict[str, asyncio.Task] = {}
         # asyncio allows only one drain() waiter per transport
@@ -227,17 +232,32 @@ class Connection:
         except (ConnectionResetError, BrokenPipeError):
             self._closing = self._closing or -1
 
-    def _keepalive_timeout(self) -> Optional[float]:
-        ka = self.channel.keepalive
-        if not ka or self.channel.state != "connected":
-            return 30.0
-        return ka * 1.5 - (time.monotonic() - self._last_rx) + 0.05
+    def _deadline_remaining(self) -> Optional[float]:
+        """Seconds until this connection's silence deadline; None = no
+        deadline.  One place for the three-state rule: pre-CONNECT
+        sockets die at a FIXED mqtt.idle_timeout after accept (without
+        the gate a silent — or byte-trickling — socket held a Connection
+        forever); mid enhanced-auth / cluster-sync waits are broker-side
+        and never expire here; connected clients get the keepalive *
+        backoff window, no keepalive = no deadline (MQTT-3.1.2-22)."""
+        ch = self.channel
+        if ch.state == "idle":
+            return self._connect_deadline - time.monotonic()
+        if ch.state != "connected":
+            return None
+        ka = ch.keepalive
+        if not ka:
+            return None
+        return (ka * ch.cfg.keepalive_backoff
+                - (time.monotonic() - self._last_rx))
+
+    def _keepalive_timeout(self) -> float:
+        rem = self._deadline_remaining()
+        return 30.0 if rem is None else rem + 0.05
 
     def _keepalive_expired(self) -> bool:
-        ka = self.channel.keepalive
-        if not ka or self.channel.state != "connected":
-            return False
-        return time.monotonic() - self._last_rx >= ka * 1.5
+        rem = self._deadline_remaining()
+        return rem is not None and rem <= 0
 
     async def _paced_retained(self, real: str, msgs) -> None:
         """Deliver a large retained set in paced batches from the lazy

@@ -632,6 +632,9 @@ def channel_config_from(conf: Config, zone: Optional[str] = None):
         server_keepalive=m["server_keepalive"] or None,
         max_clientid_len=m["max_clientid_len"],
         max_packet_size=m["max_packet_size"],
+        mqueue_store_qos0=m["mqueue_store_qos0"],
+        keepalive_backoff=m["keepalive_backoff"],
+        idle_timeout=m["idle_timeout"],
         retained_batch=conf.get("retainer.flow_control_batch"),
         retained_interval=conf.get("retainer.flow_control_interval"),
     )

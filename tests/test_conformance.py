@@ -981,3 +981,52 @@ def test_topic_alias_outbound(env):
         await p.disconnect()
 
     env.run(main())
+
+
+@pytest.fixture
+def env4(tmp_path):
+    """Node with a short pre-CONNECT idle timeout."""
+    e = _make_env(tmp_path, {"mqtt": {"idle_timeout": 1.0}})
+    yield e
+    _close_env(e)
+
+
+def test_idle_socket_closed_before_connect(env4):
+    """mqtt.idle_timeout: a socket that never sends CONNECT is closed
+    by the server (reference `emqx_connection` idle timer) — without the
+    gate a silent connection held broker resources forever."""
+
+    async def main():
+        r, w = await asyncio.open_connection("127.0.0.1", env4.port)
+        t0 = asyncio.get_event_loop().time()
+        data = await asyncio.wait_for(r.read(), 10)  # EOF = server closed
+        dt = asyncio.get_event_loop().time() - t0
+        assert data == b""
+        assert 0.5 <= dt <= 6.0, dt
+        w.close()
+        # trickled bytes must NOT extend the deadline: feed a valid but
+        # never-completed CONNECT prefix slowly — still closed on time
+        r2, w2 = await asyncio.open_connection("127.0.0.1", env4.port)
+        t0 = asyncio.get_event_loop().time()
+
+        async def trickle():
+            for b in (b"\x10", b"\x20", b"\x00"):  # partial CONNECT
+                await asyncio.sleep(0.4)
+                try:
+                    w2.write(b)
+                except Exception:
+                    return
+        tr = asyncio.ensure_future(trickle())
+        data = await asyncio.wait_for(r2.read(), 10)
+        dt = asyncio.get_event_loop().time() - t0
+        tr.cancel()
+        assert data == b""
+        assert dt <= 6.0, dt
+        w2.close()
+        # a real client connecting within the window is unaffected
+        c = MqttClient("conf-idle-ok")
+        await c.connect("127.0.0.1", env4.port)
+        await c.ping()
+        await c.disconnect()
+
+    env4.run(main())
