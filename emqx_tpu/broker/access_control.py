@@ -40,13 +40,22 @@ class AuthResult(Exception):
 
 
 class AccessControl:
-    def __init__(self, hooks: Hooks, cache_size: int = 32, cache_ttl: float = 60.0):
+    def __init__(self, hooks: Hooks, cache_size: int = 32,
+                 cache_ttl: float = 60.0, cache_enable: bool = True,
+                 deny_action: str = "ignore"):
         self.hooks = hooks
         self.cache_size = cache_size
         self.cache_ttl = cache_ttl
+        self.cache_enable = cache_enable
+        # authz.deny_action: "ignore" answers the op with NOT_AUTHORIZED,
+        # "disconnect" drops the connection (emqx_access_control parity)
+        self.deny_action = deny_action
 
-    def make_cache(self) -> "AuthzCache":
-        """Per-channel verdict cache honoring this facade's settings."""
+    def make_cache(self) -> Optional["AuthzCache"]:
+        """Per-channel verdict cache honoring this facade's settings
+        (None when authz.cache_enable = false)."""
+        if not self.cache_enable:
+            return None
         return AuthzCache(self.cache_size, self.cache_ttl)
 
     # -- authenticate -----------------------------------------------------

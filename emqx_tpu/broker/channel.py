@@ -50,7 +50,7 @@ class ChannelConfig:
     max_clientid_len: int = 65535
     max_packet_size: int = 1_048_576
     mqueue_store_qos0: bool = True
-    keepalive_backoff: float = 1.5
+    keepalive_multiplier: float = 1.5
     idle_timeout: float = 15.0
     mountpoint: Optional[str] = None
     # retained re-delivery flow control (emqx_retainer.erl:85-150)
@@ -68,7 +68,9 @@ class Channel:
         conn_mod: str = "tcp",
     ):
         self.broker = broker
-        self.access = access or AccessControl(broker.hooks)
+        self.access = access or getattr(
+            broker, "access_control", None
+        ) or AccessControl(broker.hooks)
         self.cfg = config or ChannelConfig()
         self.state = IDLE
         self.peername = peername
@@ -475,6 +477,9 @@ class Channel:
 
         if self.access.authorize(self.clientinfo, PUB, topic, self.authz_cache) == DENY:
             self._m("authorization.deny")
+            if self.access.deny_action == "disconnect":
+                return self._close(ReasonCode.NOT_AUTHORIZED,
+                                   send_disconnect=True)
             return self._puberr(p, ReasonCode.NOT_AUTHORIZED)
         self._m("authorization.allow")
 
@@ -656,6 +661,17 @@ class Channel:
                 acts.append(
                     ("retained_paced", real, itertools.chain([nxt], rit))
                 )
+        if (
+            ReasonCode.NOT_AUTHORIZED in codes
+            and self.access.deny_action == "disconnect"
+        ):
+            # authz.deny_action = disconnect applies to SUBSCRIBE too
+            # (emqx_channel check_sub_authzs parity): SUBACK, then drop
+            self._m("packets.suback.sent")
+            return [
+                ("send", pkt.SubAck(packet_id=p.packet_id,
+                                    reason_codes=codes))
+            ] + self._close(ReasonCode.NOT_AUTHORIZED, send_disconnect=True)
         self._m("packets.suback.sent")
         return [("send", pkt.SubAck(packet_id=p.packet_id, reason_codes=codes))] + acts
 

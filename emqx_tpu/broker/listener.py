@@ -59,6 +59,11 @@ class Connection:
         self.channel = Channel(broker, config=config, peername=peername)
         self.channel.out_cb = self._send_actions
         self.channel.on_kick = self._on_kick
+        # slow-consumer accounting for force_shutdown (unflushed bytes)
+        self.channel.conn_buffer_fn = (
+            lambda: writer.transport.get_write_buffer_size()
+        )
+        self.channel.conn_abort_fn = lambda: writer.transport.abort()
         self._closing: Optional[int] = None
         self._normal = False
         self._last_rx = time.monotonic()
@@ -244,11 +249,15 @@ class Connection:
         if ch.state == "idle":
             return self._connect_deadline - time.monotonic()
         if ch.state != "connected":
-            return None
+            if getattr(ch, "_pending_phase2", None) is not None:
+                return None  # broker-side cluster sync: own RPC timeouts
+            # enhanced-auth waits on the CLIENT: the connect deadline
+            # still applies (a silent mid-AUTH socket must not be held)
+            return self._connect_deadline - time.monotonic()
         ka = ch.keepalive
         if not ka:
             return None
-        return (ka * ch.cfg.keepalive_backoff
+        return (ka * ch.cfg.keepalive_multiplier
                 - (time.monotonic() - self._last_rx))
 
     def _keepalive_timeout(self) -> float:
@@ -380,6 +389,8 @@ class Listener:
                                 ch.clientid, pkt.ReasonCode.NOT_AUTHORIZED
                             )
                             continue
+                        if self._force_shutdown_check(ch):
+                            continue
                         acts = ch.handle_retry() + ch.handle_expire_awaiting_rel()
                         if acts:
                             ch.out_cb(acts)
@@ -395,6 +406,42 @@ class Listener:
                     self.broker.retainer.clean_expired()
             except Exception:
                 log.exception("housekeeping tick failed")
+
+    def _force_shutdown_check(self, ch) -> bool:
+        """force_shutdown (emqx_channel force-shutdown policy analog):
+        kill a connection whose unflushed outbound backlog exceeds
+        max_message_queue_len KiB — the reference bounds the channel
+        process's mailbox in messages; this runtime bounds the
+        transport's pending bytes, the closest slow-consumer signal an
+        asyncio transport exposes.  Returns True when the channel was
+        killed."""
+        fs = getattr(self.broker, "force_shutdown", None)
+        if not fs or not fs[0]:
+            return False
+        fn = getattr(ch, "conn_buffer_fn", None)
+        if fn is None:
+            return False
+        try:
+            backlog = fn()
+        except Exception:
+            return False
+        if backlog > fs[1] * 1024:
+            log.warning("force_shutdown: %s outbound backlog %d bytes",
+                        getattr(ch, "clientid", "?"), backlog)
+            self.broker.metrics.inc("channels.force_shutdown")
+            self.broker.cm.kick_session(
+                ch.clientid, pkt.ReasonCode.QUOTA_EXCEEDED
+            )
+            # hard-abort: a graceful close would wait for the very
+            # backlog this kill exists to reclaim
+            abort = getattr(ch, "conn_abort_fn", None)
+            if abort is not None:
+                try:
+                    abort()
+                except Exception:
+                    pass
+            return True
+        return False
 
     async def _on_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter

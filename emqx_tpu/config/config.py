@@ -216,7 +216,9 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "max_awaiting_rel": Field("int", 100, min=0),
         "await_rel_timeout": Field("duration", 300.0),
         "session_expiry_interval": Field("duration", 7200.0),
-        "keepalive_backoff": Field("float", 1.5, min=0.5),
+        "keepalive_multiplier": Field(
+            "float", 1.5, min=1.0,
+            desc="silence window = keepalive * multiplier (the deprecated emqx keepalive_backoff=0.75 meant the SAME 1.5x window via 2*backoff)"),
         "server_keepalive": Field("int", 0, min=0, desc="0 = client value"),
         "idle_timeout": Field("duration", 15.0),
     },
@@ -325,7 +327,9 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
     },
     "force_shutdown": {
         "enable": Field("bool", True),
-        "max_message_queue_len": Field("int", 10000),
+        "max_message_queue_len": Field(
+            "int", 10000,
+            desc="slow-consumer kill threshold, KiB of unflushed outbound (the reference counts mailbox messages)"),
     },
     "stats": {"enable": Field("bool", True)},
     "node": {
@@ -633,7 +637,7 @@ def channel_config_from(conf: Config, zone: Optional[str] = None):
         max_clientid_len=m["max_clientid_len"],
         max_packet_size=m["max_packet_size"],
         mqueue_store_qos0=m["mqueue_store_qos0"],
-        keepalive_backoff=m["keepalive_backoff"],
+        keepalive_multiplier=m["keepalive_multiplier"],
         idle_timeout=m["idle_timeout"],
         retained_batch=conf.get("retainer.flow_control_batch"),
         retained_interval=conf.get("retainer.flow_control_interval"),

@@ -202,6 +202,21 @@ class NodeRuntime:
             self.authz = AuthzChain(default=self.conf.get("authz.no_match"))
             self._build_authz_sources(self.conf.get("authorization") or [])
             self.authz.install(self.broker.hooks)
+        # shared access-control facade: channels inherit the configured
+        # verdict-cache sizing and authz.deny_action (ignore|disconnect)
+        from .broker.access_control import AccessControl
+
+        self.broker.force_shutdown = (
+            bool(self.conf.get("force_shutdown.enable")),
+            int(self.conf.get("force_shutdown.max_message_queue_len")),
+        )
+        self.broker.access_control = AccessControl(
+            self.broker.hooks,
+            cache_size=self.conf.get("authz.cache_max_size"),
+            cache_ttl=self.conf.get("authz.cache_ttl"),
+            cache_enable=self.conf.get("authz.cache_enable"),
+            deny_action=self.conf.get("authz.deny_action"),
+        )
 
         # ---- modules (emqx_modules) ------------------------------------
         delayed_store = None
@@ -776,7 +791,8 @@ class NodeRuntime:
         delayed-publish scheduler, stats gauges.  (Connection-level timers
         live in the listener housekeeping loop.)"""
         hb_ivl = self.conf.get("broker.sys_heartbeat_interval")
-        last_hb = 0.0
+        msg_ivl = self.conf.get("broker.sys_msg_interval")
+        last_hb = last_msg = 0.0
         while True:
             await asyncio.sleep(1.0)
             try:
@@ -789,6 +805,9 @@ class NodeRuntime:
                 if now - last_hb >= hb_ivl:
                     last_hb = now
                     self.sys_heartbeat.tick()
+                if now - last_msg >= msg_ivl:
+                    last_msg = now
+                    self.sys_heartbeat.tick_msgs()
             except Exception:
                 log.exception("node ticker")
 

@@ -45,10 +45,14 @@ class SysHeartbeat:
         )
 
     def tick(self) -> None:
-        """One sys_interval heartbeat."""
+        """One sys_interval heartbeat (version/uptime/datetime)."""
         self._pub("version", VERSION)
         self._pub("uptime", str(int(self.uptime_s)))
         self._pub("datetime", time.strftime("%Y-%m-%d %H:%M:%S"))
+
+    def tick_msgs(self) -> None:
+        """One sys_msg_interval stats/metrics publication (the
+        reference's separate `broker.sys_msg_interval` cadence)."""
         if self.stats is not None:
             self._pub("stats", self.stats.collect())
         self._pub("metrics", self.broker.metrics.all())

@@ -200,3 +200,36 @@ def test_many_clients_fanout(run):
     run(main())
 
     # NOTE: run() wraps with wait_for; sockets torn down with the loop.
+
+
+def test_force_shutdown_slow_consumer_killed():
+    """force_shutdown: a connection whose unflushed outbound backlog
+    exceeds max_message_queue_len KiB is kicked with QUOTA_EXCEEDED;
+    healthy connections are untouched."""
+    from emqx_tpu.broker import packet as pkt
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.broker.listener import Listener
+
+    b = Broker()
+    b.force_shutdown = (True, 1)  # 1 KiB threshold
+    lst = Listener.__new__(Listener)  # helper needs only .broker
+    lst.broker = b
+    kicked = []
+
+    def mk(cid, backlog):
+        ch = Channel(b, peername="t:1")
+        ch.on_kick = lambda rc: kicked.append((cid, rc))
+        ch.handle_in(pkt.Connect(proto_name="MQTT", proto_ver=5,
+                                 clientid=cid))
+        ch.conn_buffer_fn = lambda: backlog
+        return ch
+
+    slow = mk("fs-slow", 10_000_000)
+    ok = mk("fs-ok", 128)
+    assert lst._force_shutdown_check(slow) is True
+    assert kicked == [("fs-slow", pkt.ReasonCode.QUOTA_EXCEEDED)]
+    assert lst._force_shutdown_check(ok) is False
+    # disabled: nothing is killed
+    b.force_shutdown = (False, 1)
+    assert lst._force_shutdown_check(slow) is False

@@ -68,6 +68,7 @@ def test_sys_heartbeat_topics():
     sink = attach(b, "ops", "$SYS/brokers/#")
     hb = SysHeartbeat(b, Stats(b), node="n0")
     hb.tick()
+    hb.tick_msgs()  # stats/metrics ride their own sys_msg_interval
     topics = [m.topic for _, m in sink.got]
     assert "$SYS/brokers/n0/version" in topics
     assert "$SYS/brokers/n0/uptime" in topics
