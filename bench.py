@@ -349,6 +349,10 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     log(f"device: {dev.platform} {dev}")
 
     eng = TopicMatchEngine(device=dev)
+    if os.environ.get("BENCH_NO_FLIGHT"):
+        # A/B the recorder's overhead (acceptance: < 2% on config 1):
+        # BENCH_NO_FLIGHT=1 python bench.py --config 1
+        eng.flight = None
     # lib/registry load + first-call setup is process-lifetime cost, not
     # insert cost — at config 1's 1k filters it was half the timed window
     eng.add_filter("$bench/warm")
@@ -519,6 +523,13 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     eng.hybrid = True
     eng.match(batches_str[0])  # arbiter measures; probe dispatched
     eng.match(batches_str[1])
+    # bucket-derived percentiles over the SAME ticks as the ad-hoc
+    # np.percentile numbers: the engine's hist_tick (observe/flight.py)
+    # is the telemetry production reads, so BENCH and live dashboards
+    # report from one implementation.  (Config 5's churn_tick runs
+    # outside the engine tick, so its wall-clock samples include churn
+    # while the histogram holds pure match ticks.)
+    eng.hist_tick.reset()
     lat = []
     for i in range(E2E_LAT_ITERS):
         if k_churn:
@@ -528,6 +539,8 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
         lat.append(time.time() - b0)
     hyb_p99 = float(np.percentile(np.array(lat) * 1e3, 99))
     hyb_p50 = float(np.percentile(np.array(lat) * 1e3, 50))
+    hist_p50 = eng.hist_tick.quantile(0.50) * 1e3
+    hist_p99 = eng.hist_tick.quantile(0.99) * 1e3
     # interactive-tick latency: the broker's tick is SMALL at interactive
     # publish rates (batch_delay closes it within ~2 ms); a 4096 batch is
     # the throughput shape, 512 is the latency shape
@@ -557,6 +570,12 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
         f"device={eng.dev_serve_count} timeouts={eng.dev_timeout_count}; "
         f"collisions {eng.collision_count}; sample hits "
         f"{sum(len(s) for s in res)}")
+    log(f"flight:  bucket-derived p50 {hist_p50:.2f} / p99 {hist_p99:.2f} ms "
+        f"(ad-hoc {hyb_p50:.2f} / {hyb_p99:.2f}); "
+        f"flips={eng.path_flips} probes={eng.probe_count}"
+        + ("" if eng.flight is None else
+           f"; ring bytes up={eng.flight.bytes_up_total:,} "
+           f"down={eng.flight.bytes_down_total:,}"))
 
     # -------------------------------------------------- north-star sweep
     # BASELINE.md gates BOTH throughput (>=10x CPU) and p99 (<2 ms) — at
@@ -607,6 +626,12 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
         "p99_ms": hyb_p99,
         "p99_small_ms": hyb_p99_small,
         "p50_ms": hyb_p50,
+        # telemetry-plane percentiles (engine hist_tick log2 buckets):
+        # must agree with the ad-hoc numbers within one bucket width
+        "hist_p50_ms": hist_p50,
+        "hist_p99_ms": hist_p99,
+        "path_flips": eng.path_flips,
+        "flight": None if eng.flight is None else eng.flight.summary(),
         "dev_e2e_rps": e2e_rps,
         "dev_p99_ms": e2e_p99,
         "dev_p50_ms": e2e_p50,
@@ -1024,6 +1049,8 @@ def headline_json(n: int, stats: dict) -> str:
         },
         "p99_ms": round(stats["p99_ms"], 3),
         "p99_small_ms": round(stats.get("p99_small_ms", 0), 3),
+        "hist_p50_ms": round(stats.get("hist_p50_ms", 0), 3),
+        "hist_p99_ms": round(stats.get("hist_p99_ms", 0), 3),
         "dev_e2e_rps": round(stats["dev_e2e_rps"]),
         "dev_e2e_vs_baseline": round(
             stats["dev_e2e_rps"] / stats["cpu_rps"], 2

@@ -253,6 +253,27 @@ class Broker:
     def route_count(self) -> int:
         return len(self._routes)
 
+    def sync_engine_metrics(self) -> None:
+        """Copy the match engine's cumulative telemetry counters into the
+        metrics table (engine.* names in PREDEFINED).  The engine owns
+        the counters — they increment on its hot path without touching
+        the broker — and this sync runs at observation points only
+        (stats collect, exporter render, $SYS heartbeat)."""
+        e = self.engine
+        c = self.metrics.counters
+        fl = getattr(e, "flight", None)
+        c["engine.ticks"] = (
+            fl.n if fl is not None
+            else getattr(e, "host_serve_count", 0)
+            + getattr(e, "dev_serve_count", 0)
+        )
+        c["engine.host_serve"] = getattr(e, "host_serve_count", 0)
+        c["engine.dev_serve"] = getattr(e, "dev_serve_count", 0)
+        c["engine.dev_timeout"] = getattr(e, "dev_timeout_count", 0)
+        c["engine.path_flips"] = getattr(e, "path_flips", 0)
+        c["engine.verify_mismatch"] = getattr(e, "collision_count", 0)
+        c["engine.probes"] = getattr(e, "probe_count", 0)
+
     # ---------------------------------------------------------- publish
 
     def publish(self, msg: Message) -> int:

@@ -61,6 +61,16 @@ PREDEFINED = [
     "client.disconnected",
     "client.subscribe",
     "client.unsubscribe",
+    # engine flight-recorder counters (synced from the match engine by
+    # Broker.sync_engine_metrics; exposed as Prometheus counters, e.g.
+    # emqx_engine_path_flips)
+    "engine.ticks",
+    "engine.host_serve",
+    "engine.dev_serve",
+    "engine.dev_timeout",
+    "engine.path_flips",
+    "engine.verify_mismatch",
+    "engine.probes",
 ]
 
 

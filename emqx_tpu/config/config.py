@@ -257,6 +257,12 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "max_levels": Field("int", 16, min=4, max=32, desc="device trie level cap"),
         "min_batch": Field("int", 64, min=1),
         "n_sub_shards": Field("int", 1024, min=8),
+        "flight_ring": Field(
+            "int", 4096, min=0,
+            desc="flight-recorder ring size in ticks (one 56 B struct "
+                 "per match tick: path, arbitration reason, EWMA rates, "
+                 "wire bytes, verify mismatches, churn lag); 0 disables "
+                 "the ring (latency histograms stay on)"),
     },
     "retainer": {
         "enable": Field("bool", True),

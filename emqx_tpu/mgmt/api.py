@@ -119,6 +119,10 @@ class ManagementApi:
         r("POST", "/publish/bulk", self.publish_bulk, doc="Publish a batch")
         r("GET", "/metrics", self.metrics, doc="Counter table")
         r("GET", "/stats", self.stats_get, doc="Gauge table")
+        r("GET", "/engine", self.engine_get,
+          doc="Match-engine telemetry summary (flight recorder plane)")
+        r("GET", "/engine/flight", self.engine_flight,
+          doc="Flight recorder: recent ticks + arbitration flips")
         r("GET", "/alarms", self.alarms_get, doc="Active/history alarms")
         r("DELETE", "/alarms", self.alarms_clear, doc="Clear deactivated alarms")
         r("GET", "/banned", self.banned_get, doc="Ban table")
@@ -599,7 +603,22 @@ class ManagementApi:
     # ------------------------------------------------------- metrics/stats
 
     def metrics(self, req: Request):
+        if hasattr(self.broker, "sync_engine_metrics"):
+            self.broker.sync_engine_metrics()
         return self.broker.metrics.all()
+
+    def engine_get(self, req: Request):
+        from ..observe.flight import engine_summary
+
+        return engine_summary(self.broker.engine)
+
+    def engine_flight(self, req: Request):
+        fl = getattr(self.broker.engine, "flight", None)
+        if fl is None:
+            raise HttpError(404, "flight recorder disabled "
+                                 "(engine.flight_ring=0)")
+        n = int(req.q("n", "32"))
+        return {"recent": fl.recent(n), "flips": fl.flips()}
 
     def stats_get(self, req: Request):
         if self.stats is None:

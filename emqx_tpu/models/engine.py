@@ -35,6 +35,23 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from ..broker import topic as topiclib
+from ..observe.flight import (
+    FlightRecorder,
+    LatencyHistogram,
+    PATH_DEVICE,
+    PATH_HOST,
+    PATHS,
+    R_COLD_MIRROR,
+    R_FORCED,
+    R_HOST_REFRESH,
+    R_LINK_STALL,
+    R_OVERFLOW,
+    R_RATE,
+    R_UNMEASURED,
+    REASONS,
+)
+from ..observe import tracepoints as _tps
+from ..observe.tracepoints import tp
 from ..ops import hashing
 from ..ops.match import (
     DeviceTables,
@@ -170,6 +187,21 @@ class TopicMatchEngine:
         self.probe_delta_cap = 8192
         self._last_dev_meas = 0.0
         self._last_host_meas = 0.0
+
+        # ---- flight recorder + latency histograms (observe/flight.py):
+        # one ring-buffer row per tick (path, reason, rates, wire bytes,
+        # verify mismatches, churn lag) and log2-bucket histograms for
+        # tick latency / probe round-trip / churn apply.  Set flight=None
+        # to disable the ring (engine.flight_ring=0); histograms stay —
+        # they are one bucket increment per tick.
+        self.flight: Optional[FlightRecorder] = FlightRecorder()
+        self.hist_tick = LatencyHistogram()
+        self.hist_probe = LatencyHistogram()
+        self.hist_churn = LatencyHistogram()
+        self.path_flips = 0
+        self.probe_count = 0
+        self._last_served = -1  # PATH_* of the previous tick (flip detect)
+        self._churn_lag = 0.0  # duration of the most recent apply_churn
         # The match hot path is pure XLA by design.  A Pallas kernel for
         # the hash contraction was built and measured on a real TPU
         # (round-1 commit c2423d1): ~46 ms vs XLA's ~0.03-0.2 ms per
@@ -390,6 +422,9 @@ class TopicMatchEngine:
         device mirror still receives a single delta scatter.  Returns
         the fids assigned to `adds`.
         """
+        import time
+
+        t0 = time.monotonic()
         dead_fids: List[int] = []
         _fids = self._fids
         refs = self._refs
@@ -521,6 +556,13 @@ class TopicMatchEngine:
             else:
                 self.tables.churn_insert(new_strs, new_fids, words=new_words)
         self.epoch += 1
+        # churn-apply lag: host-truth apply duration, surfaced per tick
+        # by the flight recorder until the next apply supersedes it
+        dt = time.monotonic() - t0
+        self._churn_lag = dt
+        self.hist_churn.observe(dt)
+        tp("engine.churn", adds=len(adds), removes=len(removes),
+           dt_ms=dt * 1e3, backlog_slots=len(self.tables.delta.slots))
         return out
 
     def _alloc_fid(self) -> int:
@@ -615,9 +657,12 @@ class TopicMatchEngine:
         probe is the host cost, so matching each distinct name once and
         expanding at collect scales both paths by the duplication factor.
         """
+        import time
+
+        t_sub = time.monotonic()
         topics = list(topics)
         expand = None
-        n = len(topics)
+        n_raw = n = len(topics)
         if n >= 128:
             umap: Dict[str, int] = {}
             setd = umap.setdefault
@@ -629,20 +674,24 @@ class TopicMatchEngine:
         # deep hits AFTER dedup: the walk depends only on the name, so
         # duplicates share one trie walk (and one merged row)
         deep = self._deep_hits(topics)
-        if (
-            self.hybrid
-            and self.tables.n_entries
-            and self._host_ok()
-            and self._pick_host()
-        ):
+        reason = 0
+        if self.hybrid and self.tables.n_entries and self._host_ok():
+            reason = self._pick_host()
+        if reason:
             self._maybe_probe_device(topics)
             return _PendingMatch(
                 None, 0, None, None, topics,
-                mode="host", snap=self._snapshot(),
-                deep=deep, expand=expand,
+                mode="host", snap=self._snapshot(), t0=t_sub,
+                deep=deep, expand=expand, reason=reason, n_raw=n_raw,
             )
-        p = self._device_submit(topics, deep=deep)
+        dev_reason = (
+            R_RATE
+            if self.hybrid and self._host_ok() and self.tables.n_entries
+            else R_FORCED
+        )
+        p = self._device_submit(topics, deep=deep, t0=t_sub, reason=dev_reason)
         p.expand = expand
+        p.n_raw = n_raw
         return p
 
     def _deep_hits(self, topics: Sequence[str]) -> Optional[List[Set[int]]]:
@@ -653,13 +702,16 @@ class TopicMatchEngine:
             return None
         return [self._deep.match(t) & self._deep_fids for t in topics]
 
-    def _device_submit(self, topics: Sequence[str], deep="auto") -> "_PendingMatch":
+    def _device_submit(
+        self, topics: Sequence[str], deep="auto", t0=None, reason=R_FORCED
+    ) -> "_PendingMatch":
         import time
 
         if deep == "auto":
             deep = self._deep_hits(topics)
         out = pbatch = nb = None
         hcap = 0
+        bytes_up = 0
         if self.tables.n_entries:
             import jax
 
@@ -671,7 +723,16 @@ class TopicMatchEngine:
             )
 
             delta = self.tables.drain_delta()
+            cold = delta.rebuilt or self._dev is None
             packed = self._sync_descs(delta)
+            if cold:
+                # the mirror was (re)built this tick: the whole table
+                # set rode the wire, and the tick's latency reads
+                # against that, not the steady-state floor
+                reason = R_COLD_MIRROR
+                bytes_up += sum(
+                    int(getattr(a, "nbytes", 0)) for a in self._dev
+                )
             nb, _n = prepare_topics_raw(self.space, topics, self.min_batch)
             B = nb.terms_a.shape[0]
             hcap = B * self._hcap_mult
@@ -684,14 +745,18 @@ class TopicMatchEngine:
             # wasting at most one level of upload bytes
             L_real = max(1, min(self.space.max_levels, int(nb.length.max())))
             L_used = min(self.space.max_levels, L_real + (L_real & 1))
-            pbatch = jax.device_put(
-                pack_topic_batch_np(
-                    nb.terms_a[:, :L_used], nb.terms_b[:, :L_used],
-                    nb.length, nb.dollar,
-                ),
-                self.device,
+            pbatch_np = pack_topic_batch_np(
+                nb.terms_a[:, :L_used], nb.terms_b[:, :L_used],
+                nb.length, nb.dollar,
             )
+            # wire-byte accounting (BENCH_TABLE.md wire floor): the
+            # packed terms array IS the upload payload — 2 hash lanes x
+            # 4 B x L_used levels per topic row, plus length/dollar —
+            # and a fused churn delta rides the same dispatch
+            bytes_up += pbatch_np.nbytes
+            pbatch = jax.device_put(pbatch_np, self.device)
             if packed is not None:
+                bytes_up += packed.nbytes
                 self._dev, out = fused_step_sparse(
                     self._dev, jax.device_put(packed, self.device), pbatch,
                     hcap=hcap,
@@ -706,8 +771,9 @@ class TopicMatchEngine:
         # advance self._dev, and the overflow refetch must not see them
         return _PendingMatch(
             out, hcap, pbatch, self._dev, list(topics),
-            mode="device", snap=self._snapshot(), t0=time.monotonic(),
-            deep=deep,
+            mode="device", snap=self._snapshot(),
+            t0=t0 if t0 is not None else time.monotonic(),
+            deep=deep, reason=reason, bytes_up=bytes_up,
         )
 
     def match_collect(self, pending: "_PendingMatch") -> List[Set[int]]:
@@ -719,7 +785,22 @@ class TopicMatchEngine:
         broker's dispatch only iterates, and the engine's hit streams are
         duplicate-free by construction (one hit per shape per topic; deep
         fids disjoint from table fids), so skipping 4096 set builds per
-        tick is free throughput on the hot path."""
+        tick is free throughput on the hot path.
+
+        Wraps the serving body with the flight-recorder tick record:
+        submit->collect latency, the path that ACTUALLY served (a timeout
+        or overflow may differ from the submit decision), wire bytes, and
+        this tick's verify-mismatch count."""
+        import time
+
+        colls0 = self.collision_count
+        out = self._collect_serve(pending)
+        t1 = time.monotonic()
+        lat = max(t1 - (pending.t0 if pending.t0 is not None else t1), 0.0)
+        self._record_tick(pending, lat, self.collision_count - colls0)
+        return out
+
+    def _collect_serve(self, pending: "_PendingMatch") -> List[List[int]]:
         import time
 
         if pending.mode == "host":
@@ -728,17 +809,22 @@ class TopicMatchEngine:
             dt = max(time.monotonic() - t0, 1e-9)
             self._note_host_rate(len(pending.topics) / dt)
             self.host_serve_count += 1
+            pending.served = PATH_HOST
             return self._finalize(pending, out)
 
         topics = pending.topics
         out: List[List[int]] = [[] for _ in topics]
+        pending.served = PATH_DEVICE
         if pending.out is not None:
             n = len(topics)
             arr = self._timed_fetch(pending)
             if arr is None:  # device stalled past its budget: host serves
                 self.dev_timeout_count += 1
+                pending.served = PATH_HOST
+                pending.reason = R_LINK_STALL
                 return self._finalize(pending, self._host_collect(pending))
             self.dev_serve_count += 1
+            pending.bytes_down += arr.nbytes
             hcap = pending.hcap
             total = int(arr[-1])
             counts = arr[hcap:-1].view(np.uint16)[:n].astype(np.int64)
@@ -748,7 +834,9 @@ class TopicMatchEngine:
                 # the cheap recovery (same tables, no [B, M] download);
                 # the device refetch remains for hosts without the lib.
                 self._hcap_mult *= 2
+                pending.reason = R_OVERFLOW
                 if self._host_ok() and pending.snap is not None:
+                    pending.served = PATH_HOST
                     return self._finalize(
                         pending, self._host_collect(pending)
                     )
@@ -757,6 +845,7 @@ class TopicMatchEngine:
                 full = np.asarray(
                     match_batch_packed(pending.tables, pending.batch)
                 )[:n]
+                pending.bytes_down += full.nbytes
                 ii, jj = np.nonzero(full >= 0)
                 fids = full[ii, jj]
             else:
@@ -771,6 +860,37 @@ class TopicMatchEngine:
                     for i, f in zip(ii.tolist(), fids.tolist()):
                         out[i].append(int(f))
         return self._finalize(pending, out)
+
+    def _record_tick(
+        self, pending: "_PendingMatch", lat_s: float, verify_fail: int
+    ) -> None:
+        """One flight-recorder row + histogram bucket per collected tick
+        (near-zero cost: a struct write and two int adds)."""
+        path = pending.served
+        reason = pending.reason
+        flip = self._last_served >= 0 and self._last_served != path
+        self._last_served = path
+        if flip:
+            self.path_flips += 1
+            tp("engine.flip", path=PATHS[path],
+               reason=REASONS.get(reason, "?"),
+               rate_host=self.rate_host, rate_dev=self.rate_dev)
+        self.hist_tick.observe(lat_s)
+        fl = self.flight
+        if fl is not None:
+            fl.record(
+                n_topics=pending.n_raw or len(pending.topics),
+                n_unique=len(pending.topics),
+                path=path, reason=reason,
+                rate_host=self.rate_host, rate_dev=self.rate_dev,
+                bytes_up=pending.bytes_up, bytes_down=pending.bytes_down,
+                verify_fail=verify_fail,
+                churn_slots=len(self.tables.delta.slots),
+                lat_s=lat_s, churn_lag_s=self._churn_lag,
+            )
+        if _tps._active:  # gate: skip kwarg evaluation when tracing is off
+            tp("engine.tick", path=PATHS[path], n=len(pending.topics),
+               lat_ms=lat_s * 1e3, reason=REASONS.get(reason, "?"))
 
     def _finalize(
         self, pending: "_PendingMatch", out: List[List[int]]
@@ -812,15 +932,19 @@ class TopicMatchEngine:
         return (t.key_a, t.key_b, t.val, t.log2cap, t.incl, t.k_a, t.k_b,
                 t.min_len, t.max_len, t.wild_root, t.valid)
 
-    def _pick_host(self) -> bool:
+    def _pick_host(self) -> int:
+        """0 = device serves; else the R_* reason the host path serves
+        (the code lands in the flight record and the `engine.flip` tp)."""
         import time
 
         if self.rate_host is None or self.rate_dev is None:
-            return True  # measure host first; the probe measures device
+            return R_UNMEASURED  # measure host first; the probe measures device
         if self.rate_host >= self.rate_dev:
-            return True
+            return R_RATE
         # device is winning: refresh the host estimate occasionally
-        return time.monotonic() - self._last_host_meas > self.probe_interval
+        if time.monotonic() - self._last_host_meas > self.probe_interval:
+            return R_HOST_REFRESH
+        return 0
 
     def _note_host_rate(self, rps: float) -> None:
         import time
@@ -856,6 +980,9 @@ class TopicMatchEngine:
             # tick); ticks are frequent while serving, so the bias is small
             dt = max(time.monotonic() - t0, 1e-9)
             self._note_dev_rate(n / dt)
+            self.hist_probe.observe(dt)
+            tp("engine.probe", phase="complete", n=n, dt_ms=dt * 1e3,
+               rate_dev=self.rate_dev)
             if dt < 0.05:
                 self._probe_cap = min(self._probe_cap * 4, 8192)
             elif dt > 0.5:
@@ -931,6 +1058,9 @@ class TopicMatchEngine:
                 # precede the detached tail
                 self.tables.delta = self.tables.delta.merge(tail)
         self._probe = (pend.out, t0, len(pend.topics))
+        self.probe_count += 1
+        tp("engine.probe", phase="dispatch", n=len(pend.topics),
+           stale_mirror=tail is not None, bytes_up=pend.bytes_up)
 
     def _timed_fetch(self, pending: "_PendingMatch") -> Optional[np.ndarray]:
         """Fetch the device result, bounded by a timeout when a host
@@ -966,6 +1096,8 @@ class TopicMatchEngine:
                 # later probes re-measure the link when it recovers
                 self.rate_dev = max((self.rate_dev or 1.0) * 0.25, 1e-6)
                 self._last_dev_meas = time.monotonic()
+                tp("engine.stall", n=len(pending.topics),
+                   timeout_ms=timeout * 1e3, rate_dev=self.rate_dev)
                 return None
             time.sleep(step)
         self._note_dev_rate(
@@ -1093,15 +1225,23 @@ class _PendingMatch:
     the host timeout fallback.  mode "host": only `topics` and `snap`
     are set — the fused native probe runs at collect time.  `topics` is
     the DEDUPLICATED name list when `expand` is set; `deep` aligns with
-    `topics` (per name, deduped or not)."""
+    `topics` (per name, deduped or not).
+
+    Telemetry fields for the flight recorder: `reason` is the R_*
+    arbitration code at submit (may be overwritten at collect by a
+    timeout/overflow), `served` the PATH_* that actually produced the
+    rows, `n_raw` the pre-dedup publish count, `bytes_up`/`bytes_down`
+    the wire bytes this tick shipped."""
 
     __slots__ = (
         "out", "hcap", "batch", "tables", "topics", "mode", "snap", "t0",
-        "deep", "expand",
+        "deep", "expand", "reason", "served", "n_raw", "bytes_up",
+        "bytes_down",
     )
 
     def __init__(self, out, hcap, batch, tables, topics,
-                 mode="device", snap=None, t0=None, deep=None, expand=None):
+                 mode="device", snap=None, t0=None, deep=None, expand=None,
+                 reason=0, n_raw=0, bytes_up=0):
         self.out = out
         self.hcap = hcap
         self.batch = batch
@@ -1112,3 +1252,8 @@ class _PendingMatch:
         self.t0 = t0
         self.deep = deep  # deep-filter hits, snapshotted at submit
         self.expand = expand  # original index -> deduped topics row
+        self.reason = reason
+        self.served = PATH_HOST if mode == "host" else PATH_DEVICE
+        self.n_raw = n_raw
+        self.bytes_up = bytes_up
+        self.bytes_down = 0

@@ -113,6 +113,15 @@ class NodeRuntime:
             # never lose to an in-node matcher when the device link is
             # degraded (the reference matches in-node, emqx_router.erl:127)
             engine.hybrid = bool(self.conf.get("broker.hybrid"))
+        # flight recorder ring (engine.flight_ring; 0 = ring off, the
+        # latency histograms stay — they are one bucket add per tick)
+        ring = int(self.conf.get("engine.flight_ring"))
+        if ring:
+            from .observe.flight import FlightRecorder
+
+            engine.flight = FlightRecorder(ring)
+        else:
+            engine.flight = None
         from .broker.shared_sub import SharedSub
 
         shared = SharedSub(
@@ -275,6 +284,9 @@ class NodeRuntime:
         self.alarms = AlarmManager(self.broker, node=self.node_name)
         self.slow_subs = SlowSubs()
         self.slow_subs.install(self.broker.hooks)
+        # per-tick p99 comes from the engine histogram, not a second
+        # wall-clock sampling path (observe/slow_subs.py docstring)
+        self.slow_subs.attach_tick_hist(self.broker.engine.hist_tick)
         trace_dir = os.path.join(self.conf.get("node.data_dir"), "trace")
         self.traces = TraceManager(self.broker.hooks, directory=trace_dir)
         self.sys_heartbeat = SysHeartbeat(
@@ -284,8 +296,9 @@ class NodeRuntime:
         from .observe.exporters import ExporterRuntime
 
         self.exporters = ExporterRuntime(
-            metrics_fn=lambda: self.broker.metrics.all(),
+            metrics_fn=self._metrics_table,
             stats_fn=lambda: self.stats.collect(),
+            hists_fn=self._engine_histograms,
             prometheus={
                 "enable": self.conf.get("prometheus.enable"),
                 "push_gateway_server": self.conf.get(
@@ -412,6 +425,26 @@ class NodeRuntime:
         self.started = False
 
     # ------------------------------------------------------------ builders
+
+    def _metrics_table(self) -> Dict[str, float]:
+        """Exporter counter source: engine telemetry synced first so
+        Prometheus/StatsD see current engine.* counters."""
+        self.broker.sync_engine_metrics()
+        return self.broker.metrics.all()
+
+    def _engine_histograms(self) -> Dict[str, Any]:
+        """Prometheus histogram table (observe/flight.py log2 buckets)."""
+        e = self.broker.engine
+        out: Dict[str, Any] = {}
+        for name, attr in (
+            ("engine_tick_latency", "hist_tick"),
+            ("engine_probe_latency", "hist_probe"),
+            ("engine_churn_apply_latency", "hist_churn"),
+        ):
+            h = getattr(e, attr, None)
+            if h is not None:
+                out[name] = h
+        return out
 
     def _build_limiter(self) -> Optional[Limiter]:
         rates = {}

@@ -7,6 +7,7 @@ over UDP.  Both are reproduced on the stdlib only (urllib / socket).
 
 from __future__ import annotations
 
+import math
 import re
 import socket
 from typing import Dict, Optional
@@ -17,40 +18,89 @@ def _san(name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
 
 
+def _finite(value) -> bool:
+    try:
+        return math.isfinite(value)
+    except TypeError:
+        return False
+
+
 def render_prometheus(
     metrics: Dict[str, float],
     stats: Optional[Dict[str, float]] = None,
+    histograms: Optional[Dict[str, object]] = None,
     prefix: str = "emqx",
 ) -> str:
-    """Prometheus text exposition of the counter + gauge tables."""
+    """Prometheus text exposition: counters, gauges, and histograms.
+
+    Non-finite values (NaN/inf from a division-by-zero gauge or an
+    unmeasured rate) are SKIPPED — they would otherwise render exposition
+    lines many scrapers reject wholesale, poisoning every other series in
+    the payload.
+
+    `histograms` maps metric name -> an object with `cumulative()`
+    ((upper_edge, cumulative_count) pairs), `.sum` and `.count` — the
+    `observe.flight.LatencyHistogram` contract.  Buckets are rendered
+    cumulatively with `le` labels in SECONDS (Prometheus convention);
+    empty-delta buckets are elided (legal for cumulative histograms) so
+    a 40-bucket log2 histogram stays a handful of lines.
+    """
     lines = []
     for name, value in sorted(metrics.items()):
+        if not _finite(value):
+            continue
         mn = f"{prefix}_{_san(name)}"
         lines.append(f"# TYPE {mn} counter")
         lines.append(f"{mn} {value}")
     for name, value in sorted((stats or {}).items()):
+        if not _finite(value):
+            continue
         mn = f"{prefix}_{_san(name)}"
         lines.append(f"# TYPE {mn} gauge")
         lines.append(f"{mn} {value}")
+    for name, hist in sorted((histograms or {}).items()):
+        mn = f"{prefix}_{_san(name)}"
+        lines.append(f"# TYPE {mn} histogram")
+        prev = 0
+        for edge, cum in hist.cumulative():
+            if cum != prev:  # cumulative: elided buckets lose nothing
+                lines.append(f'{mn}_bucket{{le="{edge:g}"}} {cum}')
+                prev = cum
+        lines.append(f'{mn}_bucket{{le="+Inf"}} {hist.count}')
+        if _finite(hist.sum):
+            lines.append(f"{mn}_sum {hist.sum}")
+        lines.append(f"{mn}_count {hist.count}")
     return "\n".join(lines) + "\n"
 
 
 class PrometheusPush:
-    """Push-gateway exporter (`emqx_prometheus.erl` push mode)."""
+    """Push-gateway exporter (`emqx_prometheus.erl` push mode).
+
+    `push_failures` counts CONSECUTIVE failed pushes (reset on success)
+    so a monitor can alert on a dead gateway instead of the caller
+    polling a silently-returned False."""
 
     def __init__(self, gateway_url: str, job: str = "emqx_tpu", timeout: float = 5.0):
         self.url = gateway_url.rstrip("/") + f"/metrics/job/{job}"
         self.timeout = timeout
+        self.push_failures = 0
 
-    def push(self, metrics: Dict[str, float], stats: Optional[Dict[str, float]] = None) -> bool:
-        body = render_prometheus(metrics, stats).encode()
+    def push(
+        self,
+        metrics: Dict[str, float],
+        stats: Optional[Dict[str, float]] = None,
+        histograms: Optional[Dict[str, object]] = None,
+    ) -> bool:
+        body = render_prometheus(metrics, stats, histograms).encode()
         req = urlrequest.Request(self.url, data=body, method="POST")
         req.add_header("Content-Type", "text/plain")
         try:
             with urlrequest.urlopen(req, timeout=self.timeout) as resp:
-                return 200 <= resp.status < 300
+                ok = 200 <= resp.status < 300
         except Exception:
-            return False
+            ok = False
+        self.push_failures = 0 if ok else self.push_failures + 1
+        return ok
 
 
 class ExporterRuntime:
@@ -59,11 +109,14 @@ class ExporterRuntime:
     enable/disable + endpoint updates over REST, and the pull-mode
     `/prometheus/stats` exposition rendered from the same tables."""
 
-    def __init__(self, metrics_fn, stats_fn,
+    def __init__(self, metrics_fn, stats_fn, hists_fn=None,
                  prometheus: Optional[Dict] = None,
                  statsd: Optional[Dict] = None):
         self.metrics_fn = metrics_fn
         self.stats_fn = stats_fn
+        # histogram table source (name -> LatencyHistogram); rendered
+        # only on the Prometheus surfaces — StatsD has no histogram type
+        self.hists_fn = hists_fn or (lambda: {})
         self.prometheus = {
             "enable": False, "push_gateway_server": "",
             "interval": 15.0, **(prometheus or {}),
@@ -148,15 +201,19 @@ class ExporterRuntime:
         return self.statsd_status()
 
     def prometheus_status(self) -> Dict:
+        p = self._pusher
         return {**self.prometheus, "pushes": self.prom_pushes,
-                "failures": self.prom_failures}
+                "failures": self.prom_failures,
+                "push_failures": getattr(p, "push_failures", 0)}
 
     def statsd_status(self) -> Dict:
         return dict(self.statsd)
 
     def render(self) -> str:
         """Pull-mode exposition (GET /prometheus/stats)."""
-        return render_prometheus(self.metrics_fn(), self.stats_fn())
+        return render_prometheus(
+            self.metrics_fn(), self.stats_fn(), self.hists_fn()
+        )
 
     @property
     def active(self) -> bool:
@@ -172,7 +229,9 @@ class ExporterRuntime:
         if pusher is not None and \
                 now - self._last_prom >= float(self.prometheus["interval"]):
             self._last_prom = now
-            ok = pusher.push(self.metrics_fn(), self.stats_fn())
+            ok = pusher.push(
+                self.metrics_fn(), self.stats_fn(), self.hists_fn()
+            )
             self.prom_pushes += 1
             if not ok:
                 self.prom_failures += 1

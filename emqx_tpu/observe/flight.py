@@ -1,0 +1,341 @@
+"""Engine flight recorder + mergeable log2 latency histograms.
+
+The hybrid engine's whole value is an *arbitration decision* — serve
+each tick from the native host probe or the device dispatch, whichever
+is measured faster (the reference never pays a wire to match,
+`emqx_router.erl:127-140`).  This module makes that decision, and the
+wire bytes it implies, observable after the fact:
+
+* :class:`LatencyHistogram` — fixed log2 buckets (1 us .. ~9 min),
+  numpy counts, mergeable across engines/shards, with p50/p99/p999
+  derivable from the buckets.  One implementation serves live telemetry
+  (Prometheus ``histogram`` exposition, `$SYS` summaries, slow-subs)
+  AND ``bench.py``, so BENCH JSONs and production metrics report from
+  the same code.
+* :class:`FlightRecorder` — a fixed-size ring buffer recording one
+  struct per match tick: size, path chosen, the arbitration reason, the
+  EWMA rates at decision time, bytes shipped up/down (the wire-floor
+  accounting of BENCH_TABLE.md: 2 hash lanes x 4 B x L levels per topic
+  up, the sparse fid block down), dedup factor, verify-mismatch count,
+  and churn-apply lag.  Recording one tick is a single structured-array
+  row write (~1-2 us), far below per-tick latency, so the recorder ships
+  enabled by default (``engine.flight_ring``, 0 disables).
+
+Single-sample updates are lock-free: under the GIL a racing increment
+can at worst lose one count, which is acceptable for telemetry and keeps
+the hot path free of lock acquisition.  ``merge``/``snapshot`` copy.
+"""
+
+from __future__ import annotations
+
+import math
+import pickle
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# ------------------------------------------------------- arbitration reasons
+
+R_NONE = 0          # no decision recorded
+R_RATE = 1          # measured EWMA rates picked this path
+R_UNMEASURED = 2    # rates unknown: host serves first, probe measures device
+R_HOST_REFRESH = 3  # device winning; periodic host re-measure tick
+R_LINK_STALL = 4    # device fetch timed out: host served the same batch
+R_COLD_MIRROR = 5   # device tick paid a full HBM mirror rebuild
+R_OVERFLOW = 6      # sparse-return overflow: host probe recovered the tick
+R_FORCED = 7        # hybrid off / host probe unavailable: path is forced
+
+REASONS = {
+    R_NONE: "",
+    R_RATE: "rate",
+    R_UNMEASURED: "unmeasured",
+    R_HOST_REFRESH: "host-refresh",
+    R_LINK_STALL: "link-stall",
+    R_COLD_MIRROR: "cold-mirror",
+    R_OVERFLOW: "overflow",
+    R_FORCED: "forced",
+}
+
+PATH_HOST = 0
+PATH_DEVICE = 1
+PATHS = ("host", "device")
+
+
+# ------------------------------------------------------------- histograms
+
+class LatencyHistogram:
+    """Fixed log2-bucket latency histogram (seconds in, seconds out).
+
+    Bucket ``i`` counts samples in ``(base * 2**(i-1), base * 2**i]``
+    (bucket 0 is ``<= base``).  With the default ``base=1e-6`` and 40
+    buckets the range is 1 us .. ~9.2 min — every latency this engine
+    can produce.  Buckets are cumulative-friendly and merge by addition,
+    so per-shard histograms aggregate exactly.
+    """
+
+    __slots__ = ("base", "counts", "sum", "count")
+
+    def __init__(self, base: float = 1e-6, n_buckets: int = 40):
+        self.base = base
+        self.counts = np.zeros(n_buckets, dtype=np.int64)
+        self.sum = 0.0
+        self.count = 0
+
+    def _index(self, seconds: float) -> int:
+        r = seconds / self.base
+        if r <= 1.0:
+            return 0
+        return min(len(self.counts) - 1, int(math.ceil(math.log2(r))))
+
+    def observe(self, seconds: float) -> None:
+        self.counts[self._index(seconds)] += 1
+        self.sum += seconds
+        self.count += 1
+
+    def observe_many(self, seconds: Sequence[float]) -> None:
+        a = np.asarray(seconds, dtype=np.float64)
+        if not a.size:
+            return
+        r = np.maximum(a / self.base, 1.0)
+        idx = np.clip(
+            np.ceil(np.log2(r)).astype(np.int64), 0, len(self.counts) - 1
+        )
+        self.counts += np.bincount(idx, minlength=len(self.counts))
+        self.sum += float(a.sum())
+        self.count += int(a.size)
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Add `other`'s samples into self (buckets must line up)."""
+        if other.base != self.base or len(other.counts) != len(self.counts):
+            raise ValueError("histogram bucket layouts differ")
+        self.counts += other.counts
+        self.sum += other.sum
+        self.count += other.count
+        return self
+
+    def reset(self) -> None:
+        self.counts[:] = 0
+        self.sum = 0.0
+        self.count = 0
+
+    def upper_edges(self) -> List[float]:
+        """Bucket upper bounds in seconds (Prometheus `le` values)."""
+        return [self.base * (1 << i) for i in range(len(self.counts))]
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """(upper_edge_seconds, cumulative_count) pairs."""
+        return list(zip(self.upper_edges(), np.cumsum(self.counts).tolist()))
+
+    def quantile(self, q: float) -> float:
+        """Bucket-derived quantile in seconds (upper bucket edge: never
+        under-reports tail latency; the true value lies within one log2
+        bucket width below)."""
+        if self.count <= 0:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts.tolist()):
+            cum += c
+            if cum >= target:
+                return self.base * (1 << i)
+        return self.base * (1 << (len(self.counts) - 1))
+
+    def percentiles_ms(self) -> Dict[str, float]:
+        return {
+            "p50": self.quantile(0.50) * 1e3,
+            "p99": self.quantile(0.99) * 1e3,
+            "p999": self.quantile(0.999) * 1e3,
+        }
+
+    def snapshot(self) -> "LatencyHistogram":
+        h = LatencyHistogram(self.base, len(self.counts))
+        h.counts = self.counts.copy()
+        h.sum = self.sum
+        h.count = self.count
+        return h
+
+
+# ---------------------------------------------------------- flight recorder
+
+# one struct per tick; latencies are stored in microseconds (f4 keeps the
+# row at 56 bytes — the default 4096-tick ring is ~230 KB resident)
+TICK_DTYPE = np.dtype([
+    ("ts", "f8"),            # time.time() at collect completion
+    ("n_topics", "u4"),      # publishes in the tick (pre-dedup)
+    ("n_unique", "u4"),      # distinct names matched (dedup divisor)
+    ("path", "u1"),          # PATH_HOST / PATH_DEVICE
+    ("reason", "u1"),        # R_* arbitration reason
+    ("flip", "u1"),          # 1 = path differs from the previous tick
+    ("_pad", "u1"),
+    ("rate_host", "f4"),     # EWMA lookups/s at decision time
+    ("rate_dev", "f4"),
+    ("bytes_up", "u8"),      # wire bytes: packed terms + delta (+ rebuild)
+    ("bytes_down", "u8"),    # wire bytes: sparse fid return (+ refetch)
+    ("verify_fail", "u4"),   # hash-collision discards within this tick
+    ("churn_slots", "u4"),   # device-sync backlog (delta slots) at collect
+    ("lat_us", "f4"),        # submit -> collect-complete latency
+    ("churn_lag_us", "f4"),  # duration of the most recent apply_churn
+])
+
+
+class FlightRecorder:
+    """Fixed-size ring of per-tick match records (see module docstring).
+
+    `record()` is the only hot-path entry: one row write + counter adds.
+    Everything else (`recent`, `flips`, `summary`, `save`) is offline
+    analysis and copies before decoding.  The object pickles whole, so
+    a recorder can be snapshotted from a live node and inspected later
+    with ``tools/flight_dump.py``.
+    """
+
+    def __init__(self, size: int = 4096):
+        self.size = max(16, int(size))
+        self.buf = np.zeros(self.size, dtype=TICK_DTYPE)
+        self.n = 0  # monotonic tick counter (ring index = n % size)
+        self.path_flips = 0
+        self.host_ticks = 0
+        self.dev_ticks = 0
+        self.bytes_up_total = 0
+        self.bytes_down_total = 0
+        self.verify_fail_total = 0
+        self._last_path = -1
+
+    # ------------------------------------------------------------ hot path
+
+    def record(
+        self,
+        *,
+        n_topics: int,
+        n_unique: int,
+        path: int,
+        reason: int,
+        rate_host: Optional[float],
+        rate_dev: Optional[float],
+        bytes_up: int,
+        bytes_down: int,
+        verify_fail: int,
+        churn_slots: int,
+        lat_s: float,
+        churn_lag_s: float,
+        ts: Optional[float] = None,
+    ) -> bool:
+        """Record one tick; returns True when the path flipped."""
+        flip = self._last_path >= 0 and self._last_path != path
+        self._last_path = path
+        self.buf[self.n % self.size] = (
+            time.time() if ts is None else ts,
+            n_topics, n_unique, path, reason, flip, 0,
+            rate_host or 0.0, rate_dev or 0.0,
+            bytes_up, bytes_down, verify_fail, churn_slots,
+            lat_s * 1e6, churn_lag_s * 1e6,
+        )
+        self.n += 1
+        if flip:
+            self.path_flips += 1
+        if path == PATH_HOST:
+            self.host_ticks += 1
+        else:
+            self.dev_ticks += 1
+        self.bytes_up_total += bytes_up
+        self.bytes_down_total += bytes_down
+        self.verify_fail_total += verify_fail
+        return flip
+
+    # ------------------------------------------------------------- queries
+
+    def _ordered(self) -> np.ndarray:
+        """Ring contents oldest-first (copy)."""
+        if self.n <= self.size:
+            return self.buf[: self.n].copy()
+        i = self.n % self.size
+        return np.concatenate([self.buf[i:], self.buf[:i]])
+
+    @staticmethod
+    def _decode(row) -> Dict:
+        return {
+            "ts": float(row["ts"]),
+            "n_topics": int(row["n_topics"]),
+            "n_unique": int(row["n_unique"]),
+            "path": PATHS[int(row["path"])],
+            "reason": REASONS.get(int(row["reason"]), "?"),
+            "flip": bool(row["flip"]),
+            "rate_host": float(row["rate_host"]),
+            "rate_dev": float(row["rate_dev"]),
+            "bytes_up": int(row["bytes_up"]),
+            "bytes_down": int(row["bytes_down"]),
+            "verify_fail": int(row["verify_fail"]),
+            "churn_slots": int(row["churn_slots"]),
+            "lat_ms": float(row["lat_us"]) / 1e3,
+            "churn_lag_ms": float(row["churn_lag_us"]) / 1e3,
+        }
+
+    def recent(self, k: int = 32) -> List[Dict]:
+        """The last `k` tick records, oldest first, decoded to dicts."""
+        rows = self._ordered()[-k:]
+        return [self._decode(r) for r in rows]
+
+    def flips(self) -> List[Dict]:
+        """Arbitration-flip records still in the ring, oldest first."""
+        rows = self._ordered()
+        return [self._decode(r) for r in rows[rows["flip"] != 0]]
+
+    def summary(self) -> Dict:
+        """Aggregate counters + the newest record (for `$SYS`/REST)."""
+        out = {
+            "ticks": self.n,
+            "ring_size": self.size,
+            "path_flips": self.path_flips,
+            "host_ticks": self.host_ticks,
+            "dev_ticks": self.dev_ticks,
+            "bytes_up": self.bytes_up_total,
+            "bytes_down": self.bytes_down_total,
+            "verify_mismatch": self.verify_fail_total,
+        }
+        if self.n:
+            out["last"] = self._decode(self.buf[(self.n - 1) % self.size])
+        return out
+
+    # ----------------------------------------------------------- save/load
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump(self, f)
+
+    @staticmethod
+    def load(path: str) -> "FlightRecorder":
+        with open(path, "rb") as f:
+            rec = pickle.load(f)
+        if not isinstance(rec, FlightRecorder):
+            raise TypeError(f"{path!r} is not a pickled FlightRecorder")
+        return rec
+
+
+def engine_summary(engine) -> Dict:
+    """One engine telemetry snapshot (the `$SYS/brokers/<node>/engine`
+    payload; see README "Observability" for the schema).  Duck-typed so
+    both the single-chip and the sharded engine feed it."""
+    out: Dict = {
+        "host_serves": getattr(engine, "host_serve_count", 0),
+        "dev_serves": getattr(engine, "dev_serve_count", 0),
+        "dev_timeouts": getattr(engine, "dev_timeout_count", 0),
+        "verify_mismatch": getattr(engine, "collision_count", 0),
+        "path_flips": getattr(engine, "path_flips", 0),
+        "probes": getattr(engine, "probe_count", 0),
+        "rate_host": getattr(engine, "rate_host", None),
+        "rate_dev": getattr(engine, "rate_dev", None),
+        "hybrid": bool(getattr(engine, "hybrid", False)),
+        "n_filters": getattr(engine, "n_filters", 0),
+    }
+    fl = getattr(engine, "flight", None)
+    if fl is not None:
+        out["flight"] = fl.summary()
+    for key, attr in (
+        ("tick_latency_ms", "hist_tick"),
+        ("probe_latency_ms", "hist_probe"),
+        ("churn_apply_ms", "hist_churn"),
+    ):
+        h = getattr(engine, attr, None)
+        if h is not None and h.count:
+            out[key] = h.percentiles_ms()
+    return out

@@ -19,6 +19,10 @@ COUNTER_FIELDS = {
     "received": "messages.received",
     "sent": "messages.sent",
     "dropped": "messages.dropped",
+    # engine flight-recorder counters (synced before sampling): the
+    # dashboard draws match ticks/s and arbitration flips/interval
+    "engine_ticks": "engine.ticks",
+    "engine_flips": "engine.path_flips",
 }
 
 
@@ -37,6 +41,8 @@ class MonitorSampler:
         return now - (now % self.interval) + self.interval
 
     def _counters(self) -> Dict[str, int]:
+        if hasattr(self.broker, "sync_engine_metrics"):
+            self.broker.sync_engine_metrics()
         m = self.broker.metrics
         return {k: int(m.get(v)) for k, v in COUNTER_FIELDS.items()}
 
@@ -55,6 +61,10 @@ class MonitorSampler:
             # per-interval deltas (dashboard draws rates)
             **{k: counters[k] - prev[k] for k in counters},
         }
+        # level: bucket-derived per-tick p99 (observe/flight.py histogram)
+        h = getattr(getattr(self.broker, "engine", None), "hist_tick", None)
+        if h is not None and h.count:
+            s["engine_p99_ms"] = round(h.quantile(0.99) * 1e3, 3)
         self.samples.append(s)
         return s
 

@@ -5,6 +5,15 @@ Per-session EMA + peak delivery latency
 slowest subscribers; entries expire so recovered clients drop out.
 Latency = deliver time - message timestamp, the same definition the
 reference uses for its `latency_stats`.
+
+Broker-side per-TICK latency (the match-path component of delivery
+latency) is NOT re-sampled here: it comes from the engine's
+`hist_tick` log2 histogram (`observe/flight.py`), attached by the node
+via :meth:`SlowSubs.attach_tick_hist`.  Before the flight recorder this
+module's per-message wall-clock samples were the only way to estimate
+the broker's own latency floor; now `tick_percentiles()` derives
+p50/p99/p999 from the same buckets every other surface reports, and the
+per-message path is purely per-CLIENT accounting.
 """
 
 from __future__ import annotations
@@ -42,9 +51,24 @@ class SlowSubs:
         self.expire_s = expire_s
         self.stats: Dict[str, LatencyStats] = {}
         self._table: Dict[str, Tuple[float, float]] = {}  # cid -> (ema, ts)
+        self._tick_hist = None  # engine hist_tick (attach_tick_hist)
 
     def install(self, hooks) -> None:
         hooks.put("message.delivered", self._on_delivered, priority=-400)
+
+    def attach_tick_hist(self, hist) -> None:
+        """Source broker per-tick latency from the engine's histogram
+        (one bucket increment per match tick) instead of this module
+        sampling wall clock per delivered message."""
+        self._tick_hist = hist
+
+    def tick_percentiles(self) -> Optional[dict]:
+        """Engine per-tick latency p50/p99/p999 (ms), bucket-derived;
+        None until a histogram is attached and has samples."""
+        h = self._tick_hist
+        if h is None or not h.count:
+            return None
+        return h.percentiles_ms()
 
     def _on_delivered(self, clientid: str, msg) -> None:
         now_ms = time.time() * 1000.0

@@ -5,10 +5,22 @@ routes.count, subscriptions.count, retained.count...) plus historical
 maxima.  Here `collect()` pulls the current values straight from the
 broker's components; `setstat` allows ad-hoc gauges; `.max` values
 track high-water marks like the reference's `connections.max`.
+
+All table access is serialized by a lock: `setstat` runs from the
+listener housekeeping loop AND the sysmon/node timers concurrently with
+`collect()` on the exporter thread — an unlocked dict snapshot could
+tear a gauge/maximum pair mid-update (the reference gets this for free
+from ETS write serialization).
+
+`collect()` also refreshes the `engine.*` gauge family from the match
+engine's flight-recorder plane (rates, histogram percentiles, wire
+bytes), so every exporter surface — Prometheus, StatsD, `$SYS`, the
+dashboard — reads the same engine telemetry.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
 
@@ -17,17 +29,42 @@ class Stats:
         self.broker = broker
         self._gauges: Dict[str, float] = {}
         self._maxima: Dict[str, float] = {}
+        self._lock = threading.Lock()
 
     def setstat(self, name: str, value: float) -> None:
-        self._gauges[name] = value
-        mx = name + ".max"
-        if value > self._maxima.get(mx, float("-inf")):
-            self._maxima[mx] = value
+        with self._lock:
+            self._gauges[name] = value
+            mx = name + ".max"
+            if value > self._maxima.get(mx, float("-inf")):
+                self._maxima[mx] = value
 
     def getstat(self, name: str) -> Optional[float]:
-        if name.endswith(".max"):
-            return self._maxima.get(name)
-        return self._gauges.get(name)
+        with self._lock:
+            if name.endswith(".max"):
+                return self._maxima.get(name)
+            return self._gauges.get(name)
+
+    def _engine_gauges(self, engine) -> None:
+        """engine.* defaults in the gauge registry (flight-recorder
+        plane; see observe/flight.py)."""
+        rh = getattr(engine, "rate_host", None)
+        rd = getattr(engine, "rate_dev", None)
+        self.setstat("engine.rate_host", float(rh) if rh else 0.0)
+        self.setstat("engine.rate_dev", float(rd) if rd else 0.0)
+        fl = getattr(engine, "flight", None)
+        if fl is not None:
+            self.setstat("engine.ticks", fl.n)
+            self.setstat("engine.path_flips", fl.path_flips)
+            self.setstat("engine.bytes_up", fl.bytes_up_total)
+            self.setstat("engine.bytes_down", fl.bytes_down_total)
+        for key, attr in (
+            ("engine.tick_p99_ms", "hist_tick"),
+            ("engine.probe_p99_ms", "hist_probe"),
+            ("engine.churn_apply_p99_ms", "hist_churn"),
+        ):
+            h = getattr(engine, attr, None)
+            if h is not None and h.count:
+                self.setstat(key, h.quantile(0.99) * 1e3)
 
     def collect(self) -> Dict[str, float]:
         """Refresh broker-derived gauges and return the full table."""
@@ -40,10 +77,16 @@ class Stats:
             self.setstat("topics.count", b.route_count)
             self.setstat("routes.count", b.route_count)
             self.setstat("retained.count", b.retainer.count)
+            engine = getattr(b, "engine", None)
+            if engine is not None:
+                if hasattr(b, "sync_engine_metrics"):
+                    b.sync_engine_metrics()
+                self._engine_gauges(engine)
             cluster = getattr(b, "cluster", None)
             if cluster is not None:
                 self.setstat("cluster.routes.count", cluster.remote.route_count)
                 self.setstat("cluster.nodes.up", len(cluster.up_peers()))
-        out = dict(self._gauges)
-        out.update(self._maxima)
+        with self._lock:
+            out = dict(self._gauges)
+            out.update(self._maxima)
         return out

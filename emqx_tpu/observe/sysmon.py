@@ -52,10 +52,19 @@ class SysHeartbeat:
 
     def tick_msgs(self) -> None:
         """One sys_msg_interval stats/metrics publication (the
-        reference's separate `broker.sys_msg_interval` cadence)."""
+        reference's separate `broker.sys_msg_interval` cadence), plus
+        the engine flight-recorder summary on `$SYS/.../engine` (schema
+        in README "Observability")."""
         if self.stats is not None:
             self._pub("stats", self.stats.collect())
+        if hasattr(self.broker, "sync_engine_metrics"):
+            self.broker.sync_engine_metrics()
         self._pub("metrics", self.broker.metrics.all())
+        engine = getattr(self.broker, "engine", None)
+        if engine is not None and getattr(engine, "hist_tick", None) is not None:
+            from .flight import engine_summary
+
+            self._pub("engine", engine_summary(engine))
 
 
 class OsMon:

@@ -31,6 +31,29 @@ _collectors: List["TraceCollector"] = []
 _lock = threading.Lock()
 _active = False  # fast-path gate: tp() is one bool test when tracing is off
 
+# Every tp("<kind>", ...) emitted from production code (emqx_tpu/**) MUST
+# be registered here — dashboards and trace consumers key on these names,
+# and an unregistered kind is an event nobody can subscribe to by
+# contract.  `tools/check.py` lints call sites against this registry
+# statically (tests may emit ad-hoc kinds; only the package is linted).
+KNOWN_KINDS: Dict[str, str] = {
+    # broker publish path
+    "publish_enter": "message accepted into the publish pipeline",
+    "dispatch_done": "per-message dispatch finished (receivers counted)",
+    # session lifecycle (emqx_cm analog)
+    "session_created": "new session bound to a clientid",
+    "session_resumed": "clean_start=false reattached to a parked session",
+    "session_takeover_begin": "live session stolen by a new connection",
+    "session_takeover_end": "takeover handshake finished",
+    "session_discarded": "session dropped (clean start or kick)",
+    # engine flight recorder (hybrid match arbitration)
+    "engine.tick": "one match tick collected (path/reason/latency)",
+    "engine.flip": "arbitration switched serving path (host<->device)",
+    "engine.probe": "device warm-keeping probe dispatched or harvested",
+    "engine.stall": "device fetch exceeded its timeout budget",
+    "engine.churn": "one apply_churn batch applied to host truth",
+}
+
 
 def tp(kind: str, **fields: Any) -> None:
     """Emit a structured trace event (no-op unless a collector is active)."""

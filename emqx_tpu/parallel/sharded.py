@@ -50,6 +50,14 @@ except AttributeError:  # pragma: no cover
 
 from ..broker import topic as topiclib
 from ..models.reference import CpuTrieIndex
+from ..observe.flight import (
+    FlightRecorder,
+    LatencyHistogram,
+    PATH_DEVICE,
+    R_FORCED,
+)
+from ..observe import tracepoints as _tps
+from ..observe.tracepoints import tp
 from ..ops import hashing
 from ..ops.match import (
     DeviceTables,
@@ -311,6 +319,14 @@ class ShardedMatchEngine:
         self._stacked: Optional[DeviceTables] = None
         self._dest_dev: Optional[jax.Array] = None
 
+        # flight recorder + histograms (observe/flight.py — same plane as
+        # the single-chip engine; the mesh path is always device-served,
+        # so records explain latency/bytes, not arbitration)
+        self.flight: Optional[FlightRecorder] = FlightRecorder()
+        self.hist_tick = LatencyHistogram()
+        self.hist_churn = LatencyHistogram()
+        self._churn_lag = 0.0
+
     # ----------------------------------------------------------- mutation
 
     def fid_of(self, filt: str) -> Optional[int]:
@@ -465,6 +481,9 @@ class ShardedMatchEngine:
         churn rate.  Shard deltas accumulate and ride the next fused
         dispatch (`sharded_step_compact`), same as the single-chip
         engine's fused churn+match contract."""
+        import time
+
+        t0 = time.monotonic()
         dead_by_shard: List[List[int]] = [[] for _ in range(self.D)]
         refs = self._refs
         _fids = self._fids
@@ -492,7 +511,13 @@ class ShardedMatchEngine:
                 dead_all.extend(fl)
         if dead_all and self._reg is not None:
             self._reg.del_bulk(dead_all)
-        return self.add_filters(adds, churn=True)
+        out = self.add_filters(adds, churn=True)
+        dt = time.monotonic() - t0
+        self._churn_lag = dt
+        self.hist_churn.observe(dt)
+        tp("engine.churn", adds=len(adds), removes=len(removes),
+           dt_ms=dt * 1e3)
+        return out
 
     def remove_filter(self, filt: str) -> Optional[int]:
         fid = self._fids.get(filt)
@@ -689,16 +714,26 @@ class ShardedMatchEngine:
         matching more than ``kcap`` filters on a single chip) refetches
         just the overflowing topics at collect time with a widened k,
         against THIS tick's tables — never the full [D, B, M] row."""
+        import time
+
+        t0 = time.monotonic()
         deep = (
             [self._deep.match(t) & self._deep_fids for t in topics]
             if self._deep_fids
             else None
         )  # snapshotted at submit: collect may run on an executor thread
         if not any(t.n_entries for t in self.shards):
-            return _ShardedPending(None, None, None, 0, list(topics), deep)
+            return _ShardedPending(
+                None, None, None, 0, list(topics), deep, t0=t0
+            )
         slots, ka, kb, vv = self._pre_step_sync()
         batch, n = self._prep_batch(topics)
+        # wire-byte accounting (flight recorder): the replicated topic
+        # batch is the upload payload (counted once — replication is the
+        # mesh fabric's job, not the host link's), plus churn deltas
+        bytes_up = sum(int(a.nbytes) for a in batch)
         if slots is not None:
+            bytes_up += slots.nbytes + ka.nbytes + kb.nbytes + vv.nbytes
             put = lambda a: jax.device_put(a, self._shard0())
             self._stacked, hits, counts = sharded_step_compact(
                 self._stacked, put(slots), put(ka), put(kb), put(vv),
@@ -714,20 +749,50 @@ class ShardedMatchEngine:
         except AttributeError:  # pragma: no cover - older jax
             pass
         return _ShardedPending(
-            hits, counts, self._stacked, n, list(topics), deep
+            hits, counts, self._stacked, n, list(topics), deep,
+            t0=t0, bytes_up=bytes_up,
         )
 
     def match_collect(self, pending: "_ShardedPending") -> List[Set[int]]:
         return [set(x) for x in self.match_collect_raw(pending)]
 
     def match_collect_raw(self, pending: "_ShardedPending") -> List[List[int]]:
-        """Block on a submitted sharded match; verified fid lists."""
+        """Block on a submitted sharded match; verified fid lists.
+        Records one flight-recorder row per tick (always device-path on
+        the mesh: host arbitration does not apply across shards)."""
+        import time
+
+        colls0 = self.collision_count
+        out = self._collect_serve(pending)
+        t1 = time.monotonic()
+        lat = max(t1 - (pending.t0 if pending.t0 is not None else t1), 0.0)
+        self.hist_tick.observe(lat)
+        fl = self.flight
+        if fl is not None:
+            fl.record(
+                n_topics=len(pending.topics), n_unique=len(pending.topics),
+                path=PATH_DEVICE, reason=R_FORCED,
+                rate_host=None, rate_dev=None,
+                bytes_up=pending.bytes_up, bytes_down=pending.bytes_down,
+                verify_fail=self.collision_count - colls0,
+                churn_slots=sum(len(t.delta.slots) for t in self.shards),
+                lat_s=lat, churn_lag_s=self._churn_lag,
+            )
+        if _tps._active:  # gate: skip kwarg evaluation when tracing is off
+            tp("engine.tick", path="device", n=len(pending.topics),
+               lat_ms=lat * 1e3, reason="forced")
+        return out
+
+    def _collect_serve(self, pending: "_ShardedPending") -> List[List[int]]:
         topics = pending.topics
         out: List[List[int]] = [[] for _ in topics]
         if pending.hits is not None:
             from ..models.engine import verify_pairs_into
 
             n = pending.n
+            pending.bytes_down += int(pending.hits.nbytes) + int(
+                pending.counts.nbytes
+            )
             hits = np.asarray(pending.hits)[:, :n, :]  # [D, n, k]
             counts = np.asarray(pending.counts)[:, :n]  # [D, n]
             k = hits.shape[2]
@@ -745,6 +810,7 @@ class ShardedMatchEngine:
                 sub_hits, _sub_counts = sharded_match_compact(
                     stacked, sub_batch, mesh=self.mesh, kcap=k2
                 )
+                pending.bytes_down += int(sub_hits.nbytes)
                 sub_hits = np.asarray(sub_hits)[:, :n_sub, :]
                 # overflow implies counts.max() > k, so k2 >= k+1 here
                 hits = np.concatenate(
@@ -816,12 +882,19 @@ class ShardedMatchEngine:
 class _ShardedPending:
     """An in-flight sharded match (see ShardedMatchEngine.match_submit)."""
 
-    __slots__ = ("hits", "counts", "snap", "n", "topics", "deep")
+    __slots__ = (
+        "hits", "counts", "snap", "n", "topics", "deep", "t0", "bytes_up",
+        "bytes_down",
+    )
 
-    def __init__(self, hits, counts, snap, n, topics, deep=None):
+    def __init__(self, hits, counts, snap, n, topics, deep=None,
+                 t0=None, bytes_up=0):
         self.hits = hits
         self.counts = counts
         self.snap = snap  # stacked tables of THIS tick (overflow refetch)
         self.n = n
         self.topics = topics
+        self.t0 = t0
+        self.bytes_up = bytes_up
+        self.bytes_down = 0
         self.deep = deep  # deep-filter hits, snapshotted at submit

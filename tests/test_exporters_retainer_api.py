@@ -29,7 +29,7 @@ def test_exporter_runtime_schedule_and_update():
     pushes = []
 
     class FakePusher:
-        def push(self, m, s):
+        def push(self, m, s, h=None):
             pushes.append((m, s))
             return len(pushes) != 2  # second push "fails"
 
@@ -110,7 +110,7 @@ def test_tick_race_with_concurrent_disable():
                                      "interval": 1.0})
 
     class Pusher:
-        def push(self, m, s):
+        def push(self, m, s, h=None):
             rt.update_prometheus({"enable": False})  # mid-push disable
             return True
 

@@ -1,0 +1,195 @@
+"""Engine flight recorder + log2 latency histograms (observe/flight.py)
+and the tools/flight_dump.py renderer."""
+
+import importlib.util
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from emqx_tpu.observe.flight import (
+    PATH_DEVICE,
+    PATH_HOST,
+    R_LINK_STALL,
+    R_RATE,
+    FlightRecorder,
+    LatencyHistogram,
+    engine_summary,
+)
+
+
+def _load_tool(name):
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "tools", f"{name}.py"
+    )
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------- histogram
+
+
+def test_histogram_buckets_and_quantiles():
+    h = LatencyHistogram()
+    samples = [0.0005, 0.001, 0.002, 0.004, 0.008, 0.1]
+    for s in samples:
+        h.observe(s)
+    assert h.count == len(samples)
+    assert h.sum == pytest.approx(sum(samples))
+    # bucket-derived quantile is the upper edge of the right bucket:
+    # within one log2 bucket width (factor 2) of the exact value
+    # (numpy interpolates between samples, so either side is possible)
+    for q in (0.5, 0.99):
+        exact = float(np.percentile(samples, q * 100))
+        est = h.quantile(q)
+        assert exact / 2 <= est <= 2 * exact
+    p = h.percentiles_ms()
+    assert p["p50"] <= p["p99"] <= p["p999"]
+
+
+def test_histogram_edges_and_extremes():
+    h = LatencyHistogram()
+    h.observe(0.0)        # below base -> bucket 0
+    h.observe(1e-9)
+    h.observe(1e9)        # past the top -> clamped to the last bucket
+    assert h.counts[0] == 2
+    assert h.counts[-1] == 1
+    assert h.quantile(1.0) == h.upper_edges()[-1]
+
+
+def test_histogram_observe_many_matches_observe():
+    a, b = LatencyHistogram(), LatencyHistogram()
+    vals = np.abs(np.random.default_rng(3).normal(0.002, 0.001, 500)) + 1e-7
+    for v in vals:
+        a.observe(float(v))
+    b.observe_many(vals)
+    assert (a.counts == b.counts).all()
+    assert a.count == b.count == 500
+
+
+def test_histogram_merge_and_reset():
+    a, b = LatencyHistogram(), LatencyHistogram()
+    a.observe(0.001)
+    b.observe(0.004)
+    b.observe(0.004)
+    a.merge(b)
+    assert a.count == 3 and a.sum == pytest.approx(0.009)
+    cum = a.cumulative()
+    assert cum[-1][1] == 3  # cumulative reaches the total
+    with pytest.raises(ValueError):
+        a.merge(LatencyHistogram(base=1e-3))
+    a.reset()
+    assert a.count == 0 and not a.counts.any()
+
+
+# -------------------------------------------------------------- recorder
+
+
+def _record(rec, path=PATH_DEVICE, reason=R_RATE, **kw):
+    args = dict(
+        n_topics=100, n_unique=90, path=path, reason=reason,
+        rate_host=1e6, rate_dev=2e6, bytes_up=4096, bytes_down=512,
+        verify_fail=0, churn_slots=0, lat_s=0.002, churn_lag_s=0.0,
+    )
+    args.update(kw)
+    return rec.record(**args)
+
+
+def test_recorder_ring_wraps():
+    rec = FlightRecorder(size=16)
+    for i in range(40):
+        _record(rec, n_topics=i)
+    assert rec.n == 40 and rec.size == 16
+    rows = rec.recent(100)
+    assert len(rows) == 16
+    # oldest-first, newest is tick 39
+    assert [r["n_topics"] for r in rows] == list(range(24, 40))
+    assert rows[-1]["path"] == "device"
+    assert rows[-1]["reason"] == "rate"
+
+
+def test_recorder_flip_detection_and_totals():
+    rec = FlightRecorder(size=64)
+    assert not _record(rec, path=PATH_HOST)   # first tick: no flip
+    assert _record(rec, path=PATH_DEVICE)     # host -> device
+    assert not _record(rec, path=PATH_DEVICE)
+    assert _record(rec, path=PATH_HOST, reason=R_LINK_STALL)
+    assert rec.path_flips == 2
+    assert rec.host_ticks == 2 and rec.dev_ticks == 2
+    assert rec.bytes_up_total == 4 * 4096
+    flips = rec.flips()
+    assert len(flips) == 2
+    assert flips[-1]["reason"] == "link-stall"
+    s = rec.summary()
+    assert s["ticks"] == 4 and s["path_flips"] == 2
+    assert s["last"]["path"] == "host"
+
+
+def test_recorder_pickle_roundtrip(tmp_path):
+    rec = FlightRecorder(size=32)
+    for _ in range(5):
+        _record(rec)
+    p = str(tmp_path / "flight.pkl")
+    rec.save(p)
+    back = FlightRecorder.load(p)
+    assert back.n == 5
+    assert back.recent(5) == rec.recent(5)
+    # wrong payloads are refused loudly
+    bad = str(tmp_path / "bad.pkl")
+    with open(bad, "wb") as f:
+        pickle.dump({"not": "a recorder"}, f)
+    with pytest.raises(TypeError):
+        FlightRecorder.load(bad)
+
+
+def test_engine_summary_duck_typing():
+    class Eng:
+        host_serve_count = 3
+        dev_serve_count = 7
+        dev_timeout_count = 1
+        collision_count = 0
+        path_flips = 2
+        probe_count = 4
+        rate_host = 1e6
+        rate_dev = None
+        hybrid = True
+        n_filters = 10
+        flight = FlightRecorder(size=16)
+        hist_tick = LatencyHistogram()
+
+    Eng.hist_tick.observe(0.001)
+    s = engine_summary(Eng())
+    assert s["host_serves"] == 3 and s["dev_serves"] == 7
+    assert s["path_flips"] == 2 and s["hybrid"] is True
+    assert s["flight"]["ring_size"] == 16
+    assert s["tick_latency_ms"]["p99"] > 0
+
+
+# ------------------------------------------------------------ flight_dump
+
+
+def test_flight_dump_renders_ticks_and_flips(tmp_path):
+    fd = _load_tool("flight_dump")
+    rec = FlightRecorder(size=32)
+    _record(rec, path=PATH_HOST)
+    _record(rec, path=PATH_DEVICE)
+    _record(rec, path=PATH_HOST, reason=R_LINK_STALL, verify_fail=2)
+    out = fd.dump(rec)
+    assert "flight recorder: 3 tick(s)" in out
+    assert "link-stall" in out and "2 flip(s) total" in out
+    # the flip marker rides the reason column
+    assert "link-stall*" in out
+    table = fd.format_ticks(rec, n=2)
+    assert table.count("\n") >= 3  # header + rule + 2 rows
+    assert fd.format_flips(FlightRecorder()) == (
+        "0 flip(s) total, 0 in ring (0 host / 0 device ticks)"
+    )
+    assert fd.format_ticks(FlightRecorder()) == "(no ticks recorded)"
+    # the CLI path: pickled recorder in, text out
+    p = str(tmp_path / "f.pkl")
+    rec.save(p)
+    loaded = fd.FlightRecorder.load(p)
+    assert "link-stall" in fd.dump(loaded, flips_only=True)

@@ -13,6 +13,7 @@ import pytest
 from emqx_tpu.broker.packet import SubOpts
 from emqx_tpu.models.engine import TopicMatchEngine
 from emqx_tpu.models.reference import CpuTrieIndex
+from emqx_tpu.observe.tracepoints import check_trace
 from emqx_tpu.ops import native
 
 pytestmark = pytest.mark.skipif(
@@ -197,6 +198,100 @@ def test_broker_hybrid_end_to_end():
     n = b.publish(Message(topic="s/1/t", payload=b"x"))
     assert n == 2
     assert sorted(seen) == [("c1", "s/+/t"), ("c2", "s/1/t")]
+
+
+def test_link_stall_telemetry_explains_the_flip():
+    """A forced device-link stall must be fully explainable from
+    telemetry alone: trace order engine.probe -> engine.flip ->
+    host-path ticks, and the flight recorder shows the flip tick with
+    reason, EWMA rates at decision time, and the decayed device rate."""
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.observe.exporters import render_prometheus
+
+    filters, topics = _population(400)
+    eng, _ = _engine(filters)
+    # hybrid off: compile BOTH device kernel variants first (fused
+    # churn+match on the first call, pure match on the second — the same
+    # two-call warmup node.py uses) so the traced device tick cannot pay
+    # a fresh XLA compile and trip its own timeout
+    eng.match(topics)
+    eng.match(topics)
+    eng.hybrid = True
+    eng.probe_interval = 1e9
+    eng.dev_timeout_floor = 0.3
+
+    with check_trace() as t:
+        # rates unknown: host serves first and dispatches a device probe
+        pend = eng.match_submit(topics)
+        assert pend.mode == "host"
+        eng.match_collect(pend)
+        # device believed fast: device serves one real tick (flip #1)
+        eng.rate_host = 1.0
+        eng.rate_dev = 1e9
+        eng._last_dev_meas = eng._last_host_meas = time.monotonic()
+        eng.match_collect(eng.match_submit(topics))
+        # now wedge the transfer: the tick falls back to the host path
+        eng.rate_dev = 1e9
+        eng._last_dev_meas = time.monotonic()
+        pend = eng.match_submit(topics)
+        assert pend.mode == "device"
+        pend.out = _NeverReady()
+        eng.match_collect(pend)
+        # decayed rate: subsequent ticks serve host-side
+        eng.match(topics[:64])
+
+    t.assert_order("engine.probe", "engine.flip", "engine.stall")
+    assert t.find("engine.flip", reason="link-stall")
+    stall_ts = t.find("engine.stall")[0]["ts"]
+    host_after = [
+        e for e in t.of_kind("engine.tick")
+        if e["path"] == "host" and e["ts"] > stall_ts
+    ]
+    assert host_after  # host-path ticks follow the stall
+
+    # flight recorder: the stall tick carries reason + rates
+    flips = eng.flight.flips()
+    stall_rows = [f for f in flips if f["reason"] == "link-stall"]
+    assert stall_rows
+    row = stall_rows[-1]
+    assert row["path"] == "host"
+    assert row["rate_host"] > 0 and row["rate_dev"] > 0
+    assert eng.path_flips == eng.flight.path_flips >= 2
+
+    # Prometheus surface: histogram series + the flips counter
+    b = Broker(engine=eng)
+    b.sync_engine_metrics()
+    text = render_prometheus(
+        b.metrics.all(), {}, {"engine_tick_latency": eng.hist_tick}
+    )
+    assert "# TYPE emqx_engine_tick_latency histogram" in text
+    assert 'emqx_engine_tick_latency_bucket{le="+Inf"}' in text
+    assert f"emqx_engine_path_flips {eng.path_flips}" in text
+
+
+def test_flight_wire_floor_accounting():
+    """Flight-recorder byte accounting reproduces the BENCH_TABLE.md
+    wire-floor formula on a known batch: up = 2 hash lanes x 4 B x
+    L_used levels (+ length/dollar words) x padded batch; down = the
+    sparse fid block (hcap fids + u16 counts pairs + total)."""
+    eng = TopicMatchEngine()
+    eng.add_filters([f"plant/{i}/line/+" for i in range(300)])
+    eng.sync_device()  # flush the bootstrap rebuild out of the delta
+
+    topics = [f"plant/{i}/line/9" for i in range(200)]
+    eng.match(topics)
+
+    rec = eng.flight.recent(1)[0]
+    B = 256  # next_pow2(200)
+    L_used = 4  # 4-level topics, already even
+    lanes_bytes = 2 * 4 * L_used * B          # the wire-floor term
+    frame_bytes = 2 * 4 * B                   # length + dollar words
+    assert rec["bytes_up"] == lanes_bytes + frame_bytes
+    hcap = B  # _hcap_mult == 1
+    assert rec["bytes_down"] == 4 * (hcap + B // 2 + 1)
+    assert rec["path"] == "device"
+    assert rec["n_topics"] == 200 and rec["n_unique"] == 200
+    assert rec["verify_fail"] == 0
 
 
 def test_probe_delta_bounded_under_churn_backlog():

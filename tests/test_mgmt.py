@@ -277,6 +277,36 @@ def test_monitor_endpoints_and_dashboard_page(run):
     run(main())
 
 
+def test_engine_flight_endpoints(run, tmp_path):
+    async def main():
+        b, lst, api, srv, tokens = await make_stack(tmp_path)
+        base = f"http://127.0.0.1:{srv.port}/api/v5"
+        b.publish(Message(topic="e/x", payload=b"p"))  # one recorded tick
+        st, body = await asyncio.to_thread(
+            http, "POST", base + "/login",
+            {"username": "admin", "password": "public123"})
+        tok = body["token"]
+        st, summary = await asyncio.to_thread(
+            http, "GET", base + "/engine", None, tok)
+        assert st == 200
+        assert {"host_serves", "dev_serves", "path_flips",
+                "flight"} <= set(summary)
+        assert summary["flight"]["ticks"] >= 1
+        st, fl = await asyncio.to_thread(
+            http, "GET", base + "/engine/flight?n=5", None, tok)
+        assert st == 200 and len(fl["recent"]) >= 1
+        assert fl["recent"][-1]["path"] in ("host", "device")
+        # disabled ring 404s with the config pointer
+        b.engine.flight = None
+        st, _ = await asyncio.to_thread(
+            http, "GET", base + "/engine/flight", None, tok)
+        assert st == 404
+        await srv.stop()
+        await lst.stop()
+
+    run(main())
+
+
 def test_cli_node_dump(tmp_path):
     b = Broker()
     api = ManagementApi(b, node="n0", stats=Stats(b), banned=Banned(),

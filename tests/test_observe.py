@@ -74,6 +74,25 @@ def test_sys_heartbeat_topics():
     assert "$SYS/brokers/n0/uptime" in topics
     stats_msgs = [m for _, m in sink.got if m.topic.endswith("/stats")]
     assert stats_msgs and "connections.count" in json.loads(stats_msgs[0].payload)
+    # engine flight-recorder summary rides the same sys_msg cadence
+    eng_msgs = [m for _, m in sink.got if m.topic.endswith("/engine")]
+    assert eng_msgs
+    payload = json.loads(eng_msgs[0].payload)
+    assert {"host_serves", "dev_serves", "path_flips", "flight"} <= set(payload)
+    assert payload["flight"]["ring_size"] > 0
+
+
+def test_slow_subs_tick_percentiles_from_engine_hist():
+    from emqx_tpu.observe.flight import LatencyHistogram
+
+    ss = SlowSubs()
+    assert ss.tick_percentiles() is None  # nothing attached yet
+    h = LatencyHistogram()
+    ss.attach_tick_hist(h)
+    assert ss.tick_percentiles() is None  # attached but empty
+    h.observe(0.002)
+    p = ss.tick_percentiles()
+    assert p and p["p99"] > 0 and p["p50"] <= p["p999"]
 
 
 def test_alarm_lifecycle_and_sys_publish():
@@ -172,6 +191,109 @@ def test_prometheus_rendering():
     assert "# TYPE emqx_messages_received counter" in out
     assert "emqx_messages_received 5" in out
     assert "emqx_connections_count 2" in out
+
+
+def test_prometheus_skips_non_finite_values():
+    out = render_prometheus(
+        {"ok": 1, "bad_nan": float("nan"), "bad_str": "x"},
+        {"good": 2.5, "bad_inf": float("inf"), "neg_inf": float("-inf")},
+    )
+    assert "emqx_ok 1" in out and "emqx_good 2.5" in out
+    assert "nan" not in out and "inf" not in out
+    assert "bad_str" not in out
+
+
+def test_prometheus_histogram_exposition():
+    from emqx_tpu.observe.flight import LatencyHistogram
+
+    h = LatencyHistogram()
+    for v in (0.001, 0.002, 0.002, 0.050):
+        h.observe(v)
+    out = render_prometheus({}, {}, {"engine_tick_latency": h})
+    assert "# TYPE emqx_engine_tick_latency histogram" in out
+    assert 'emqx_engine_tick_latency_bucket{le="+Inf"} 4' in out
+    assert "emqx_engine_tick_latency_count 4" in out
+    assert f"emqx_engine_tick_latency_sum {h.sum}" in out
+    # cumulative bucket counts are monotonic and end at the total
+    import re
+
+    cums = [int(m) for m in re.findall(r'_bucket\{le="[^+"]+"\} (\d+)', out)]
+    assert cums == sorted(cums) and cums[-1] == 4
+
+
+def test_prometheus_push_failure_counter(monkeypatch):
+    from emqx_tpu.observe import exporters as ex
+
+    p = ex.PrometheusPush("http://gw.internal:9091")
+    calls = {"n": 0}
+
+    def fail(req, timeout):
+        calls["n"] += 1
+        raise OSError("down")
+
+    monkeypatch.setattr(ex.urlrequest, "urlopen", fail)
+    assert p.push({"m": 1}) is False
+    assert p.push({"m": 1}) is False
+    assert p.push_failures == 2 and calls["n"] == 2
+
+    class Resp:
+        status = 200
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(ex.urlrequest, "urlopen", lambda r, timeout: Resp())
+    assert p.push({"m": 1}) is True
+    assert p.push_failures == 0  # consecutive counter resets on success
+
+
+def test_stats_lock_under_concurrent_setstat():
+    import threading
+
+    st = Stats()
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            st.setstat("g", i)
+            i += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                out = st.collect()
+                g = out.get("g")
+                if g is not None:
+                    assert out["g.max"] >= g
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer),
+               threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errs
+
+
+def test_stats_engine_gauges():
+    b = Broker()
+    attach(b, "c1", "e/#")
+    b.publish(Message(topic="e/1", payload=b"x"))
+    out = Stats(b).collect()
+    assert out["engine.ticks"] >= 1
+    assert out["engine.tick_p99_ms"] > 0
+    assert "engine.rate_host" in out and "engine.path_flips" in out
+    # the gauge sync also refreshed the broker's engine.* counters
+    assert b.metrics.get("engine.ticks") >= 1
 
 
 def test_statsd_udp():

@@ -1,5 +1,8 @@
 """Structured trace-point assertions (snabbkaffe ?check_trace analog)."""
 
+import importlib.util
+import os
+
 import pytest
 
 from emqx_tpu.broker.broker import Broker
@@ -8,7 +11,7 @@ from emqx_tpu.broker.message import Message
 from emqx_tpu.broker.packet import SubOpts
 from emqx_tpu.broker.session import Session
 from emqx_tpu.observe.tracepoints import (
-    TraceAssertionError, check_trace, tp,
+    KNOWN_KINDS, TraceAssertionError, check_trace, tp,
 )
 
 
@@ -76,6 +79,50 @@ def test_clean_start_discards():
         cm.open_session(True, "d2", lambda: Session(clientid="d2"))
     t.assert_seen("session_discarded", clientid="d2", live=True)
     t.assert_not_seen("session_takeover_begin")
+
+
+def test_known_kinds_registry_covers_production_call_sites():
+    """tools/check.py lint contract: every literal tp("<kind>") emitted
+    from emqx_tpu/** is registered in KNOWN_KINDS (and the static parse
+    of the registry agrees with the imported one)."""
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "tools", "check.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_tool", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    known = mod.known_tp_kinds()
+    assert known == set(KNOWN_KINDS)  # static parse == runtime registry
+    calls = mod.collect_tp_calls()
+    assert calls, "lint must see the production tp() call sites"
+    unregistered = [(p, l, k) for p, l, k in calls if k not in known]
+    assert not unregistered, unregistered
+    # the engine flight-recorder family is registered
+    assert {"engine.tick", "engine.flip", "engine.probe",
+            "engine.stall", "engine.churn"} <= known
+    # and the lint actually fires on an unknown kind
+    problems = []
+    real = mod.collect_tp_calls
+    mod.collect_tp_calls = lambda: [("x.py", 1, "not_a_kind")]
+    try:
+        mod.check_tracepoints(problems)
+    finally:
+        mod.collect_tp_calls = real
+    assert problems and "not_a_kind" in problems[0]
+
+
+def test_engine_trace_kinds_order_assertion():
+    """assert_order over the engine flight-recorder kinds (the hybrid
+    link-stall scenario drives the real emissions in test_hybrid.py;
+    this pins the assertion helper itself on the same kind names)."""
+    with check_trace() as t:
+        tp("engine.probe", phase="dispatch", n=8)
+        tp("engine.flip", path="host", reason="link-stall")
+        tp("engine.tick", path="host", n=8, lat_ms=1.0, reason="rate")
+    t.assert_order("engine.probe", "engine.flip", "engine.tick")
+    with pytest.raises(TraceAssertionError):
+        t.assert_order("engine.tick", "engine.probe")
 
 
 def test_assertion_failures_are_loud():

@@ -13,6 +13,11 @@ this gate implements the highest-value checks directly on the stdlib:
   3. AST lints: unused imports, duplicate top-level/class-level defs,
      mutable default arguments, bare `except:`
   4. native layer: g++ -fsyntax-only -Wall -Wextra over native/*.cc
+  5. tracepoint registry: every `tp("<kind>", ...)` emitted from
+     production code (emqx_tpu/**) must be registered in
+     `observe/tracepoints.py` KNOWN_KINDS — dashboards and trace
+     consumers key on these names, so an unregistered kind is an event
+     nobody can subscribe to by contract (tests may emit ad-hoc kinds)
 
 Exit code 0 = clean.  `--fix` is intentionally absent: findings are
 either real bugs or deliberate (suppressed via `# check: ignore` on the
@@ -188,6 +193,81 @@ def check_ast_lints(path, src, tree, problems, ignored):
                 )
 
 
+def known_tp_kinds():
+    """KNOWN_KINDS keys, parsed statically from observe/tracepoints.py
+    (no package import: this gate must run on a broken tree)."""
+    path = os.path.join(REPO, "emqx_tpu", "observe", "tracepoints.py")
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), path)
+    for node in ast.walk(tree):
+        tgt = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            tgt = node.target
+        if (
+            isinstance(tgt, ast.Name)
+            and tgt.id == "KNOWN_KINDS"
+            and isinstance(node.value, ast.Dict)
+        ):
+            return {
+                k.value
+                for k in node.value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+    return set()
+
+
+def collect_tp_calls():
+    """(path, lineno, kind) for every literal-kind tp(...) call in the
+    emqx_tpu package."""
+    out = []
+    pkg = os.path.join(REPO, "emqx_tpu")
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path, "r", encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), path)
+                except SyntaxError:
+                    continue  # reported by the syntax pass
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = (
+                    fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None
+                )
+                if (
+                    name == "tp"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    out.append((path, node.lineno, node.args[0].value))
+    return out
+
+
+def check_tracepoints(problems):
+    known = known_tp_kinds()
+    if not known:
+        problems.append(
+            "emqx_tpu/observe/tracepoints.py: KNOWN_KINDS registry missing"
+        )
+        return
+    for path, line, kind in collect_tp_calls():
+        if kind not in known:
+            problems.append(
+                f"{path}:{line}: tp kind {kind!r} not registered in "
+                "observe/tracepoints.py KNOWN_KINDS"
+            )
+
+
 def check_native(problems):
     src_dir = os.path.join(REPO, "native")
     if not os.path.isdir(src_dir):
@@ -224,6 +304,7 @@ def main() -> int:
         ignored = _ignored_lines(src)
         check_undefined(path, src, tree, problems, ignored)
         check_ast_lints(path, src, tree, problems, ignored)
+    check_tracepoints(problems)
     check_native(problems)
     for p in problems:
         print(p)

@@ -1,0 +1,126 @@
+"""Human-readable dump of an engine flight recorder.
+
+The recorder (`emqx_tpu/observe/flight.py`) rings one struct per match
+tick: path served, arbitration reason, EWMA rates at decision time, wire
+bytes up/down, verify mismatches, and churn lag.  This tool renders two
+views:
+
+* a recent-tick table (newest last) — what the engine actually did,
+  tick by tick;
+* the arbitration-flip timeline — every host<->device switch still in
+  the ring, with the reason and the rates that drove it.
+
+Input is a pickled recorder (``FlightRecorder.save(path)`` from a REPL,
+a debug endpoint, or a bench run) — or, from Python, call
+:func:`dump` directly on a LIVE recorder object::
+
+    from tools.flight_dump import dump
+    print(dump(node.broker.engine.flight))
+
+Usage:
+    python tools/flight_dump.py flight.pkl            # both views
+    python tools/flight_dump.py flight.pkl -n 100     # more ticks
+    python tools/flight_dump.py flight.pkl --flips    # timeline only
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from emqx_tpu.observe.flight import FlightRecorder  # noqa: E402
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GB"
+
+
+def _fmt_rate(r: float) -> str:
+    return "-" if not r else f"{r:,.0f}"
+
+
+def format_ticks(rec: FlightRecorder, n: int = 32) -> str:
+    """The last `n` tick records as an aligned table (oldest first)."""
+    rows = rec.recent(n)
+    if not rows:
+        return "(no ticks recorded)"
+    hdr = (f"{'tick':>8} {'path':>6} {'reason':<12} {'n':>6} {'uniq':>6} "
+           f"{'lat ms':>9} {'up':>9} {'down':>9} {'rate_h':>12} "
+           f"{'rate_d':>12} {'vfail':>5} {'churn':>7}")
+    lines = [hdr, "-" * len(hdr)]
+    first_tick = rec.n - len(rows)
+    for i, r in enumerate(rows):
+        lines.append(
+            f"{first_tick + i:>8} {r['path']:>6} "
+            f"{(r['reason'] or '-') + ('*' if r['flip'] else ''):<12} "
+            f"{r['n_topics']:>6} {r['n_unique']:>6} {r['lat_ms']:>9.3f} "
+            f"{_fmt_bytes(r['bytes_up']):>9} "
+            f"{_fmt_bytes(r['bytes_down']):>9} "
+            f"{_fmt_rate(r['rate_host']):>12} "
+            f"{_fmt_rate(r['rate_dev']):>12} "
+            f"{r['verify_fail']:>5} {r['churn_slots']:>7}"
+        )
+    lines.append("(* = arbitration flip on this tick)")
+    return "\n".join(lines)
+
+
+def format_flips(rec: FlightRecorder) -> str:
+    """Arbitration-flip timeline (every path switch still in the ring)."""
+    flips = rec.flips()
+    head = (f"{rec.path_flips} flip(s) total, {len(flips)} in ring "
+            f"({rec.host_ticks} host / {rec.dev_ticks} device ticks)")
+    if not flips:
+        return head
+    lines = [head]
+    for f in flips:
+        lines.append(
+            f"  t={f['ts']:.3f}  -> {f['path']:<6} reason={f['reason']:<12} "
+            f"rate_host={_fmt_rate(f['rate_host'])} "
+            f"rate_dev={_fmt_rate(f['rate_dev'])} "
+            f"lat={f['lat_ms']:.3f} ms"
+        )
+    return "\n".join(lines)
+
+
+def dump(rec: FlightRecorder, n: int = 32, flips_only: bool = False) -> str:
+    """Both views as one string (works on a live recorder)."""
+    parts = []
+    if not flips_only:
+        s = rec.summary()
+        parts.append(
+            f"flight recorder: {s['ticks']} tick(s), ring {s['ring_size']}, "
+            f"bytes up={_fmt_bytes(s['bytes_up'])} "
+            f"down={_fmt_bytes(s['bytes_down'])}, "
+            f"verify mismatches {s['verify_mismatch']}"
+        )
+        parts.append("")
+        parts.append(format_ticks(rec, n))
+        parts.append("")
+    parts.append(format_flips(rec))
+    return "\n".join(parts)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="dump a pickled engine flight recorder")
+    ap.add_argument("path", help="pickled FlightRecorder "
+                                 "(FlightRecorder.save / pickle.dump)")
+    ap.add_argument("-n", type=int, default=32,
+                    help="recent ticks to show (default 32)")
+    ap.add_argument("--flips", action="store_true",
+                    help="arbitration-flip timeline only")
+    ns = ap.parse_args()
+    rec = FlightRecorder.load(ns.path)
+    print(dump(rec, n=ns.n, flips_only=ns.flips))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
