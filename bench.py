@@ -583,6 +583,10 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
     # per-tick latency at the SAME tick, production hybrid path, churn
     # paced by wall clock (churn_frac of the population per second, the
     # workload's definition) so config 5's rate is effective-under-load.
+    # Each tick size runs THREE repetitions and the row is the median-
+    # by-throughput rep (VERDICT r5: a single rep flipped the gate
+    # inside run-to-run noise — 10.2x committed vs 9.5x captured); all
+    # three land in the JSON under "reps" so noise is auditable.
     ns_rows = []
     target_cps = churn_frac * len(filters) if churn_pool else 0.0
     for tick in (512, 1024, 2048, 4096):
@@ -590,34 +594,43 @@ def run_engine(filters, topics_fn, churn_frac=0.0, churn_pool=None):
         if tb is None:
             continue
         eng.match_collect_raw(eng.match_submit(tb[0]))  # warm shape
-        iters = max(30, min(300, int(2_000_000 / tick)))
-        lat = []
-        churn_before = churn_events
-        pacer = ChurnPacer(target_cps)
-        t0 = time.time()
-        pacer.last = t0
-        for i in range(iters):
-            b0 = time.time()
+        iters = max(10, min(100, int(700_000 / tick)))
+        reps = []
+        for _rep in range(3):
+            lat = []
+            churn_before = churn_events
+            pacer = ChurnPacer(target_cps)
+            t0 = time.time()
+            pacer.last = t0
+            for i in range(iters):
+                b0 = time.time()
+                if target_cps:
+                    n_ops = pacer.owed(b0)
+                    if n_ops:
+                        churn_tick_n(n_ops)
+                eng.match_collect_raw(eng.match_submit(tb[i % len(tb)]))
+                lat.append(time.time() - b0)
+            wall = time.time() - t0
+            rep = {
+                "rps": iters * tick / wall,
+                "p99_ms": float(np.percentile(np.array(lat) * 1e3, 99)),
+            }
             if target_cps:
-                n_ops = pacer.owed(b0)
-                if n_ops:
-                    churn_tick_n(n_ops)
-            eng.match_collect_raw(eng.match_submit(tb[i % len(tb)]))
-            lat.append(time.time() - b0)
-        wall = time.time() - t0
-        rate = iters * tick / wall
-        p99 = float(np.percentile(np.array(lat) * 1e3, 99))
-        row = {"tick": tick, "rps": rate, "p99_ms": p99}
+                rep["churn_rps"] = (churn_events - churn_before) / wall
+                rep["churn_shed"] = pacer.shed
+            reps.append(rep)
+        med = sorted(reps, key=lambda r: r["rps"])[1]
+        row = {"tick": tick, **med, "reps": reps}
         if target_cps:
-            applied = churn_events - churn_before
-            row["churn_rps"] = applied / wall
-            row["churn_shed"] = pacer.shed
-            log(f"north-star tick {tick}: {rate:,.0f} lookups/s, p99 "
-                f"{p99:.2f} ms; churn {applied/wall:,.0f}/s applied "
-                f"(target {target_cps:,.0f}, shed {pacer.shed})")
+            log(f"north-star tick {tick}: {row['rps']:,.0f} lookups/s "
+                f"(median of {[round(r['rps']) for r in reps]}), p99 "
+                f"{row['p99_ms']:.2f} ms; churn {row['churn_rps']:,.0f}/s "
+                f"applied (target {target_cps:,.0f}, "
+                f"shed {row['churn_shed']})")
         else:
-            log(f"north-star tick {tick}: {rate:,.0f} lookups/s, "
-                f"p99 {p99:.2f} ms")
+            log(f"north-star tick {tick}: {row['rps']:,.0f} lookups/s "
+                f"(median of {[round(r['rps']) for r in reps]}), "
+                f"p99 {row['p99_ms']:.2f} ms")
         ns_rows.append(row)
     return {
         "ns_rows": ns_rows,
@@ -662,8 +675,11 @@ def run_sharded(subs_cap=None, workload=2):
     cores, so 10M would measure swap, not the dispatch path).
 
     Emits a PHASE BREAKDOWN per tick (VERDICT r4 #5): prep (native
-    split+hash + replicated put), mesh dispatch, device->host fetch,
-    verify+assembly — so the p99 can be read against its actual bucket.
+    split+hash + packed staging upload + dispatch call), device compute,
+    resolve fetch, verify+assembly — so the p99 can be read against its
+    actual bucket — and measures e2e at BOTH pipeline_depth=1 (lock-
+    step) and the engine's window depth, with flight-recorder occupancy,
+    so the pipeline's contribution is a measured ratio, not a claim.
     """
     import os
     import re
@@ -719,26 +735,30 @@ def run_sharded(subs_cap=None, workload=2):
     eng.match(batches[0])
     log(f"first compile+run: {time.time()-c0:.1f}s")
     eng.match(batches[1])
+    # settle the adaptive kcap before any timed window: the shrink
+    # toward observed traffic re-jits the (bounded) kcap variant once,
+    # a first-boot cost that must not land mid-measurement
+    for i in range(eng.kcap_adapt_interval + 2):
+        eng.match(batches[i % 8])
 
-    # phase breakdown (pure match path, no churn)
+    # phase breakdown (pure match path, no churn, lock-step so every
+    # phase is exposed): prep+dispatch = match_submit (native split+hash,
+    # packed staging upload, non-donating mesh dispatch), compute = the
+    # device wait, fetch = resolve (device->host of the live compact
+    # slice + any overflow refetch), verify = registry exact-check + row
+    # assembly.  In the pipelined loop below, compute overlaps the other
+    # three phases of neighboring ticks.
     prep_s = disp_s = fetch_s = verify_s = 0.0
     PH_ITERS = 15
     for i in range(PH_ITERS):
         topics = batches[i % 8]
         p0 = time.perf_counter()
-        batch, nn = eng._prep_batch(topics)
+        pend = eng.match_submit(topics)
         p1 = time.perf_counter()
-        hits, counts = shmod.sharded_match_compact(
-            eng._stacked, batch, mesh=eng.mesh, kcap=eng.kcap
-        )
-        jax.block_until_ready((hits, counts))
+        jax.block_until_ready((pend.hits, pend.counts))
         p2 = time.perf_counter()
-        np.asarray(hits)
-        np.asarray(counts)
+        eng._resolve(pend)
         p3 = time.perf_counter()
-        pend = shmod._ShardedPending(
-            hits, counts, eng._stacked, nn, list(topics), None
-        )
         eng.match_collect_raw(pend)
         p4 = time.perf_counter()
         prep_s += p1 - p0
@@ -752,7 +772,8 @@ def run_sharded(subs_cap=None, workload=2):
         "verify_ms": verify_s / PH_ITERS * 1e3,
     }
     log(f"sharded phases/tick({TICK}): " + "  ".join(
-        f"{k} {v:.2f}" for k, v in phases.items()))
+        f"{k} {v:.2f}" for k, v in phases.items())
+        + f"  (kcap {eng._kcap_dyn})")
 
     # churn helper (workload 5): wall-clock paced, like the north-star
     target_cps = churn_frac * len(filters) if churn_pool else 0.0
@@ -791,32 +812,69 @@ def run_sharded(subs_cap=None, workload=2):
         lat.append(time.time() - b0)
     p99 = float(np.percentile(np.array(lat) * 1e3, 99))
 
-    DEPTH = 3
-    ITERS_S = 30
-    pending = []
-    pacer = ChurnPacer(target_cps)
-    churn_before = churn_i
-    r0 = time.time()
-    pacer.last = r0
-    for i in range(ITERS_S):
-        if target_cps:
-            n_ops = pacer.owed(time.time())
-            if n_ops:
-                churn_tick_n(n_ops)
-        pending.append(eng.match_submit(batches[i % 8]))
-        if len(pending) >= DEPTH:
+    # e2e at depth 1 (lock-step) AND at the engine's pipeline window,
+    # same host, same run — the depth-N/depth-1 ratio is the pipeline's
+    # measured win, and the flight recorder's occupancy column shows how
+    # full the window actually ran.  NOTE: on a 1-hardware-thread host
+    # (this container) every phase serializes onto the same core, so the
+    # ratio reads ~1.0 — the window's overlap needs a second execution
+    # resource (real TPU devices, or host cores for the virtual mesh).
+    from emqx_tpu.observe.flight import FlightRecorder
+
+    ITERS_S = 40
+    depth_rows = {}
+    res = None
+    for depth in (1, eng.pipeline_depth):
+        if depth in depth_rows:
+            continue
+        eng.pipeline_depth = depth
+        eng.flight = FlightRecorder(256)
+        eng.match(batches[0])  # warm (kcap/bucket variants)
+        pending = []
+        pacer = ChurnPacer(target_cps)
+        churn_before = churn_i
+        r0 = time.time()
+        pacer.last = r0
+        for i in range(ITERS_S):
+            if target_cps:
+                n_ops = pacer.owed(time.time())
+                if n_ops:
+                    churn_tick_n(n_ops)
+            pending.append(eng.match_submit(batches[i % 8]))
+            if len(pending) >= depth:
+                res = eng.match_collect_raw(pending.pop(0))
+        while pending:
             res = eng.match_collect_raw(pending.pop(0))
-    while pending:
-        res = eng.match_collect_raw(pending.pop(0))
-    wall = time.time() - r0
-    rps = ITERS_S * TICK / wall
-    churn_rps = (churn_i - churn_before) / wall if target_cps else 0.0
-    log(f"sharded e2e: {rps:,.0f} lookups/s (p99 {p99:.2f} ms at {TICK}); "
-        f"collisions {eng.collision_count}; churn {churn_rps:,.0f}/s "
-        f"applied (target {target_cps:,.0f}, shed {pacer.shed}); "
+        wall = time.time() - r0
+        occ = [r["pipe_occ"] for r in eng.flight.recent(ITERS_S)]
+        depth_rows[depth] = {
+            "depth": depth,
+            "rps": ITERS_S * TICK / wall,
+            "churn_rps": (churn_i - churn_before) / wall
+            if target_cps else 0.0,
+            "churn_shed": pacer.shed,
+            "occ_mean": float(np.mean(occ)) if occ else 0.0,
+        }
+        log(f"sharded e2e depth {depth}: "
+            f"{depth_rows[depth]['rps']:,.0f} lookups/s "
+            f"(occ {depth_rows[depth]['occ_mean']:.1f}/{depth}); "
+            f"churn {depth_rows[depth]['churn_rps']:,.0f}/s applied "
+            f"(target {target_cps:,.0f}, shed {pacer.shed})")
+    d1 = depth_rows[1]
+    dN = depth_rows[max(depth_rows)]
+    rps = dN["rps"]
+    churn_rps = dN["churn_rps"]
+    log(f"sharded e2e: {rps:,.0f} lookups/s at depth {dN['depth']} "
+        f"(depth-1 {d1['rps']:,.0f}, ratio {rps / d1['rps']:.2f}x; "
+        f"p99 {p99:.2f} ms at {TICK}); collisions {eng.collision_count}; "
         f"sample hits {sum(len(s) for s in res)}")
     return {
         "tpu_rps": rps,
+        "rps_depth1": d1["rps"],
+        "pipeline_depth": dN["depth"],
+        "pipeline_ratio": rps / d1["rps"],
+        "occ_mean": dN["occ_mean"],
+        "depth_rows": sorted(depth_rows.values(), key=lambda r: r["depth"]),
         "p99_ms": p99,
         "tick": TICK,
         "insert_rps": insert_rps,
@@ -1046,6 +1104,9 @@ def headline_json(n: int, stats: dict) -> str:
             "vs_baseline": round(best["rps"] / stats["cpu_rps"], 2),
             "p99_ms": round(best["p99_ms"], 3),
             "pass": passed,
+            # all three sweep repetitions (the row above is the median
+            # by rps): the gate can be audited against run-to-run noise
+            "reps": best.get("reps"),
         },
         "p99_ms": round(stats["p99_ms"], 3),
         "p99_small_ms": round(stats.get("p99_small_ms", 0), 3),
@@ -1111,6 +1172,10 @@ def main() -> None:
             "device": stats["device"],
             "n_devices": stats["n_devices"],
             "p99_ms": round(stats["p99_ms"], 3),
+            "rps_depth1": round(stats["rps_depth1"]),
+            "pipeline_depth": stats["pipeline_depth"],
+            "pipeline_ratio": round(stats["pipeline_ratio"], 2),
+            "occ_mean": round(stats["occ_mean"], 1),
         }))
         return
 
@@ -1311,53 +1376,71 @@ def main() -> None:
                 "\n## Mesh-sharded engine (BASELINE workloads, "
                 f"{nd} virtual CPU devices)\n\n"
                 "`broker.engine=sharded` path: fused churn+compact-match "
-                "dispatch over the mesh (`sharded_step_compact`), "
-                "pipelined three deep, exact verification on, tick 512. "
-                " Workloads 3/5 run at 1M resident filters (the virtual "
-                "mesh shares one host's RAM/cores; w5 pays its 5%/sec "
-                "churn inside the loop, paced by wall clock, and so "
-                "does its CPU baseline).  Virtual devices share this "
-                "host's cores, so these rows measure the sharded "
-                "DISPATCH PATH's overhead/correctness at scale, not ICI "
-                "speedup — real-mesh numbers need a v5e-8.\n\n"
-                "| workload | filters | lookups/s | vs cpu | p99 ms | "
-                "insert/s | churn/s applied (target) |\n"
-                "|---|---|---|---|---|---|---|\n"
-            )
-            for w, s in sorted(sharded_rows.items()):
-                f.write(
-                    f"| {w}: {CONFIGS[w][1]} | {s['n_filters']:,} "
-                    f"| {s['tpu_rps']:,.0f} "
-                    f"| {s['tpu_rps']/s['cpu_rps']:.1f}x "
-                    f"| {s['p99_ms']:.2f} "
-                    f"| {s['insert_rps']:,.0f} "
-                    f"| {('%s (%s)' % (format(round(s.get('churn_rps', 0)), ','), format(round(s.get('churn_target', 0)), ','))) if s.get('churn_target') else '—'} |\n"
-                )
-            f.write(
-                f"| single-chip hybrid (row 2, tick 4096) "
-                f"| {rows[2]['n_filters']:,} "
-                f"| {rows[2]['tpu_rps']:,.0f} "
-                f"| {rows[2]['tpu_rps']/rows[2]['cpu_rps']:.1f}x "
-                f"| {rows[2]['p99_ms']:.2f} "
-                f"| {rows[2]['insert_rps']:,.0f} | |\n"
-            )
-            f.write(
-                "\nPhase breakdown per 512-topic tick (pure match; "
-                "VERDICT r4 #5 — prep = native split+hash + replicated "
-                "put, dispatch = the pjit mesh computation, fetch = "
-                "device->host of the compact block, verify = registry "
-                "exact-check + row assembly):\n\n"
-                "| workload | prep ms | dispatch ms | fetch ms | "
-                "verify ms |\n|---|---|---|---|---|\n"
+                "dispatch over the mesh (`sharded_step_compact_packed`), "
+                "pipelined through the engine.pipeline_depth in-flight "
+                "window, exact verification on, tick 512.  One row per "
+                "(workload, depth): depth 1 is the lock-step baseline, "
+                "depth N the pipelined window; occ = mean flight-"
+                "recorder occupancy at submit.  Workloads 3/5 run at 1M "
+                "resident filters (the virtual mesh shares one host's "
+                "RAM/cores; w5 pays its 5%/sec churn inside the loop, "
+                "paced by wall clock, and so does its CPU baseline).  "
+                "Virtual devices share this host's cores, so these rows "
+                "measure the sharded DISPATCH PATH's overhead/"
+                "correctness at scale, not ICI speedup — and on a "
+                "single-hardware-thread host every pipeline phase "
+                "serializes onto one core, so the depth-N/depth-1 ratio "
+                "only exceeds ~1.0 when a second execution resource "
+                "exists (host cores or a real v5e-8 mesh).\n\n"
+                "| workload | filters | depth | lookups/s | vs cpu | "
+                "occ | p99 ms | prep ms | dispatch ms | fetch ms | "
+                "verify ms | insert/s | churn/s applied (target) |\n"
+                "|---|---|---|---|---|---|---|---|---|---|---|---|---|\n"
             )
             for w, s in sorted(sharded_rows.items()):
                 ph = s.get("phases", {})
-                f.write(
-                    f"| {w} | {ph.get('prep_ms', 0):.2f} "
-                    f"| {ph.get('dispatch_ms', 0):.2f} "
-                    f"| {ph.get('fetch_ms', 0):.2f} "
-                    f"| {ph.get('verify_ms', 0):.2f} |\n"
+                churn_col = (
+                    "%s (%s)" % (
+                        format(round(s.get("churn_rps", 0)), ","),
+                        format(round(s.get("churn_target", 0)), ","),
+                    )
+                    if s.get("churn_target") else "—"
                 )
+                for dr in s.get("depth_rows") or [
+                    {"depth": 3, "rps": s["tpu_rps"], "occ_mean": 0.0}
+                ]:
+                    f.write(
+                        f"| {w}: {CONFIGS[w][1]} | {s['n_filters']:,} "
+                        f"| {dr['depth']} "
+                        f"| {dr['rps']:,.0f} "
+                        f"| {dr['rps']/s['cpu_rps']:.1f}x "
+                        f"| {dr['occ_mean']:.1f} "
+                        f"| {s['p99_ms']:.2f} "
+                        f"| {ph.get('prep_ms', 0):.2f} "
+                        f"| {ph.get('dispatch_ms', 0):.2f} "
+                        f"| {ph.get('fetch_ms', 0):.2f} "
+                        f"| {ph.get('verify_ms', 0):.2f} "
+                        f"| {s['insert_rps']:,.0f} "
+                        f"| {churn_col} |\n"
+                    )
+            f.write(
+                f"| single-chip hybrid (row 2, tick 4096) "
+                f"| {rows[2]['n_filters']:,} | — "
+                f"| {rows[2]['tpu_rps']:,.0f} "
+                f"| {rows[2]['tpu_rps']/rows[2]['cpu_rps']:.1f}x | — "
+                f"| {rows[2]['p99_ms']:.2f} | | | | "
+                f"| {rows[2]['insert_rps']:,.0f} | |\n"
+            )
+            f.write(
+                "\nPhases per 512-topic tick, measured LOCK-STEP so "
+                "each is exposed (in the pipelined rows above, dispatch "
+                "overlaps the other phases of neighboring ticks): prep "
+                "= native split+hash + packed staging upload + the "
+                "non-donating mesh dispatch call, dispatch = device "
+                "compute wait, fetch = resolve (live [D, n, k] slice + "
+                "u16 counts + any overflow refetch), verify = registry "
+                "exact-check + row assembly.\n"
+            )
         if retained is not None:
             s = retained
             f.write(

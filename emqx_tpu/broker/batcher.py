@@ -20,6 +20,12 @@ host hashing/upload of tick N overlaps device compute of tick N-1, and a
 device stall can never freeze the node (the reference's dispatch hot loop
 never parks the scheduler either, `emqx_broker.erl:499-524`).  Delivery
 (`publish_finish`) happens back on the loop in tick order.
+
+The engines bound their own submitted-but-unresolved window at
+``engine.pipeline_depth`` (force-resolving the oldest tick past it), so
+``max_inflight`` here only has to be AT LEAST that deep to keep the
+dispatch pipeline fed — the node wires it to
+``max(32, engine.pipeline_depth)``.
 """
 
 from __future__ import annotations

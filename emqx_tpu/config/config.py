@@ -259,10 +259,18 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "n_sub_shards": Field("int", 1024, min=8),
         "flight_ring": Field(
             "int", 4096, min=0,
-            desc="flight-recorder ring size in ticks (one 56 B struct "
+            desc="flight-recorder ring size in ticks (one ~60 B struct "
                  "per match tick: path, arbitration reason, EWMA rates, "
-                 "wire bytes, verify mismatches, churn lag); 0 disables "
-                 "the ring (latency histograms stay on)"),
+                 "wire bytes, verify mismatches, churn lag, pipeline "
+                 "occupancy); 0 disables the ring (latency histograms "
+                 "stay on)"),
+        "pipeline_depth": Field(
+            "int", 4, min=1, max=64,
+            desc="match-dispatch pipeline window: submitted-but-"
+                 "uncollected ticks allowed in flight, so host prep of "
+                 "tick N+1 overlaps device compute of tick N and the "
+                 "async fetch of tick N-1 (churn-fused ticks drain the "
+                 "window and donate the table buffers); 1 = lock-step"),
     },
     "retainer": {
         "enable": Field("bool", True),

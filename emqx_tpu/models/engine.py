@@ -162,6 +162,14 @@ class TopicMatchEngine:
         self._dev_stale = True
         self._hcap_mult = 1  # sparse-return size factor (doubles on overflow)
 
+        # dispatch-pipeline window (engine.pipeline_depth): the single-
+        # chip fused step is already non-donating, so concurrent in-
+        # flight ticks share the device tables by construction — the
+        # engine only tracks occupancy (submitted-but-uncollected ticks)
+        # for the flight recorder and the batcher's pacing
+        self.pipeline_depth = 4
+        self._inflight_n = 0
+
         # ---- hybrid host/device arbitration state (see module docstring)
         # Default OFF at the class level so unit tests exercise the device
         # path deterministically; the node runtime enables it from config
@@ -679,11 +687,12 @@ class TopicMatchEngine:
             reason = self._pick_host()
         if reason:
             self._maybe_probe_device(topics)
-            return _PendingMatch(
+            p = _PendingMatch(
                 None, 0, None, None, topics,
                 mode="host", snap=self._snapshot(), t0=t_sub,
                 deep=deep, expand=expand, reason=reason, n_raw=n_raw,
             )
+            return self._note_inflight(p)
         dev_reason = (
             R_RATE
             if self.hybrid and self._host_ok() and self.tables.n_entries
@@ -692,6 +701,13 @@ class TopicMatchEngine:
         p = self._device_submit(topics, deep=deep, t0=t_sub, reason=dev_reason)
         p.expand = expand
         p.n_raw = n_raw
+        return self._note_inflight(p)
+
+    def _note_inflight(self, p: "_PendingMatch") -> "_PendingMatch":
+        """Window occupancy at submit (flight-recorder telemetry)."""
+        self._inflight_n += 1
+        p.pipe_occ = self._inflight_n
+        p.pipe_depth = self.pipeline_depth
         return p
 
     def _deep_hits(self, topics: Sequence[str]) -> Optional[List[Set[int]]]:
@@ -737,14 +753,11 @@ class TopicMatchEngine:
             B = nb.terms_a.shape[0]
             hcap = B * self._hcap_mult
             # truncate term levels to this batch's real depth: the terms
-            # array IS the upload payload (~64 MB/s real link bandwidth).
-            # Rounded UP to the next EVEN depth so the kernel compiles at
-            # most max_levels/2 variants instead of one per distinct
-            # topic depth — a fresh depth otherwise pays a multi-second
-            # XLA compile mid-traffic (and trips the OLP shed) — while
-            # wasting at most one level of upload bytes
-            L_real = max(1, min(self.space.max_levels, int(nb.length.max())))
-            L_used = min(self.space.max_levels, L_real + (L_real & 1))
+            # array IS the upload payload (~64 MB/s real link bandwidth);
+            # live_levels rounds to even depths to bound kernel variants
+            from ..ops.match import live_levels
+
+            L_used = live_levels(self.space.max_levels, nb.length)
             pbatch_np = pack_topic_batch_np(
                 nb.terms_a[:, :L_used], nb.terms_b[:, :L_used],
                 nb.length, nb.dollar,
@@ -794,7 +807,10 @@ class TopicMatchEngine:
         import time
 
         colls0 = self.collision_count
-        out = self._collect_serve(pending)
+        try:
+            out = self._collect_serve(pending)
+        finally:
+            self._inflight_n = max(0, self._inflight_n - 1)
         t1 = time.monotonic()
         lat = max(t1 - (pending.t0 if pending.t0 is not None else t1), 0.0)
         self._record_tick(pending, lat, self.collision_count - colls0)
@@ -887,6 +903,7 @@ class TopicMatchEngine:
                 verify_fail=verify_fail,
                 churn_slots=len(self.tables.delta.slots),
                 lat_s=lat_s, churn_lag_s=self._churn_lag,
+                pipe_occ=pending.pipe_occ, pipe_depth=pending.pipe_depth,
             )
         if _tps._active:  # gate: skip kwarg evaluation when tracing is off
             tp("engine.tick", path=PATHS[path], n=len(pending.topics),
@@ -1236,7 +1253,7 @@ class _PendingMatch:
     __slots__ = (
         "out", "hcap", "batch", "tables", "topics", "mode", "snap", "t0",
         "deep", "expand", "reason", "served", "n_raw", "bytes_up",
-        "bytes_down",
+        "bytes_down", "pipe_occ", "pipe_depth",
     )
 
     def __init__(self, out, hcap, batch, tables, topics,
@@ -1257,3 +1274,5 @@ class _PendingMatch:
         self.n_raw = n_raw
         self.bytes_up = bytes_up
         self.bytes_down = 0
+        self.pipe_occ = 0  # in-flight ticks at submit (incl. this one)
+        self.pipe_depth = 0  # engine.pipeline_depth at submit

@@ -113,6 +113,10 @@ class NodeRuntime:
             # never lose to an in-node matcher when the device link is
             # degraded (the reference matches in-node, emqx_router.erl:127)
             engine.hybrid = bool(self.conf.get("broker.hybrid"))
+        # match-dispatch pipeline window (engine.pipeline_depth): both
+        # engines bound their submitted-but-uncollected ticks by it, and
+        # the publish batcher's in-flight ceiling is raised to match
+        engine.pipeline_depth = int(self.conf.get("engine.pipeline_depth"))
         # flight recorder ring (engine.flight_ring; 0 = ring off, the
         # latency histograms stay — they are one bucket add per tick)
         ring = int(self.conf.get("engine.flight_ring"))
@@ -347,6 +351,11 @@ class NodeRuntime:
             self.broker,
             max_batch=self.conf.get("broker.batch_max"),
             max_delay=self.conf.get("broker.batch_delay"),
+            # the tick queue must be able to fill the engine's dispatch
+            # window (engine.pipeline_depth), or the pipeline starves
+            max_inflight=max(
+                32, int(self.conf.get("engine.pipeline_depth"))
+            ),
         )
         # the pipelined publish path keeps the loop responsive even when
         # the device falls behind, so loop-lag-based OLP alone can't see

@@ -17,7 +17,8 @@ wire bytes it implies, observable after the fact:
   EWMA rates at decision time, bytes shipped up/down (the wire-floor
   accounting of BENCH_TABLE.md: 2 hash lanes x 4 B x L levels per topic
   up, the sparse fid block down), dedup factor, verify-mismatch count,
-  and churn-apply lag.  Recording one tick is a single structured-array
+  churn-apply lag, and the dispatch-pipeline occupancy/depth the tick
+  saw at submit.  Recording one tick is a single structured-array
   row write (~1-2 us), far below per-tick latency, so the recorder ships
   enabled by default (``engine.flight_ring``, 0 disables).
 
@@ -159,7 +160,7 @@ class LatencyHistogram:
 # ---------------------------------------------------------- flight recorder
 
 # one struct per tick; latencies are stored in microseconds (f4 keeps the
-# row at 56 bytes — the default 4096-tick ring is ~230 KB resident)
+# row at ~60 bytes — the default 4096-tick ring is ~240 KB resident)
 TICK_DTYPE = np.dtype([
     ("ts", "f8"),            # time.time() at collect completion
     ("n_topics", "u4"),      # publishes in the tick (pre-dedup)
@@ -167,15 +168,17 @@ TICK_DTYPE = np.dtype([
     ("path", "u1"),          # PATH_HOST / PATH_DEVICE
     ("reason", "u1"),        # R_* arbitration reason
     ("flip", "u1"),          # 1 = path differs from the previous tick
-    ("_pad", "u1"),
+    ("pipe_occ", "u1"),      # in-flight ticks at submit (incl. this one)
     ("rate_host", "f4"),     # EWMA lookups/s at decision time
     ("rate_dev", "f4"),
     ("bytes_up", "u8"),      # wire bytes: packed terms + delta (+ rebuild)
     ("bytes_down", "u8"),    # wire bytes: sparse fid return (+ refetch)
     ("verify_fail", "u4"),   # hash-collision discards within this tick
-    ("churn_slots", "u4"),   # device-sync backlog (delta slots) at collect
+    ("churn_slots", "u4"),   # delta slots this tick's dispatch shipped
     ("lat_us", "f4"),        # submit -> collect-complete latency
     ("churn_lag_us", "f4"),  # duration of the most recent apply_churn
+    ("pipe_depth", "u1"),    # engine.pipeline_depth at submit
+    ("_pad", "u1"),
 ])
 
 
@@ -219,16 +222,18 @@ class FlightRecorder:
         lat_s: float,
         churn_lag_s: float,
         ts: Optional[float] = None,
+        pipe_occ: int = 0,
+        pipe_depth: int = 0,
     ) -> bool:
         """Record one tick; returns True when the path flipped."""
         flip = self._last_path >= 0 and self._last_path != path
         self._last_path = path
         self.buf[self.n % self.size] = (
             time.time() if ts is None else ts,
-            n_topics, n_unique, path, reason, flip, 0,
+            n_topics, n_unique, path, reason, flip, min(pipe_occ, 255),
             rate_host or 0.0, rate_dev or 0.0,
             bytes_up, bytes_down, verify_fail, churn_slots,
-            lat_s * 1e6, churn_lag_s * 1e6,
+            lat_s * 1e6, churn_lag_s * 1e6, min(pipe_depth, 255), 0,
         )
         self.n += 1
         if flip:
@@ -268,6 +273,8 @@ class FlightRecorder:
             "churn_slots": int(row["churn_slots"]),
             "lat_ms": float(row["lat_us"]) / 1e3,
             "churn_lag_ms": float(row["churn_lag_us"]) / 1e3,
+            "pipe_occ": int(row["pipe_occ"]),
+            "pipe_depth": int(row["pipe_depth"]),
         }
 
     def recent(self, k: int = 32) -> List[Dict]:

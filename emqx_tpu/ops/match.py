@@ -257,6 +257,17 @@ def next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+def live_levels(max_levels: int, lengths: np.ndarray) -> int:
+    """Term levels actually worth uploading for a batch: its real max
+    depth, rounded UP to the next EVEN count so the kernel compiles at
+    most max_levels/2 variants (a fresh depth otherwise pays a
+    multi-second XLA compile mid-traffic) while wasting at most one
+    level of upload bytes.  Shared by the single-chip and sharded
+    submit paths so their wire-floor arithmetic stays identical."""
+    L_real = max(1, min(max_levels, int(lengths.max(initial=1))))
+    return min(max_levels, L_real + (L_real & 1))
+
+
 def prepare_topic_batch(space, word_lists, min_batch: int = 64):
     """Hash + pad a publish batch to a power-of-two size (limits retraces).
 

@@ -25,9 +25,14 @@ Design (BASELINE.json north star, SURVEY.md §5.7/§5.8):
   kept off the broker's delivery path by design;
 * subscription churn reaches the device as per-shard scatter deltas
   (`sharded_apply_delta`) or fused into the match dispatch
-  (`sharded_step_compact` on the broker path, `sharded_step` on the
-  counts path) — no re-upload, mirroring `emqx_router:do_add_route`'s
-  incremental trie mutation.
+  (`sharded_step_compact_packed` on the broker path, `sharded_step` on
+  the counts path) — no re-upload, mirroring `emqx_router:do_add_route`'s
+  incremental trie mutation;
+* THE DISPATCH IS PIPELINED: up to ``engine.pipeline_depth`` ticks may
+  be submitted-but-unresolved at once, sharing the stacked tables
+  through non-donating dispatches; churn-fused ticks donate the table
+  buffers after a window drain.  See ShardedMatchEngine.match_submit
+  and README "Sharded dispatch pipeline".
 
 Everything is jit-compiled over a `jax.sharding.Mesh`; tested on a virtual
 8-device CPU mesh, deployed unchanged on a v5e-8.
@@ -36,6 +41,7 @@ Everything is jit-compiled over a `jax.sharding.Mesh`; tested on a virtual
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
@@ -63,8 +69,10 @@ from ..ops.match import (
     DeviceTables,
     TopicBatch,
     apply_delta_impl,
+    live_levels,
     match_batch,
     next_pow2,
+    unpack_topic_batch,
 )
 from ..ops.tables import MatchTables
 from .mesh import FILTER_AXIS, make_mesh
@@ -246,6 +254,115 @@ def sharded_step_compact(
     )(stacked, delta_slots, delta_ka, delta_kb, delta_val, batch)
 
 
+def _compact_topk(matched: jax.Array, k: int) -> jax.Array:
+    """[B, M] shape-hit rows -> the k largest fids per row, descending,
+    -1 padded — k iterative max+mask passes instead of `jax.lax.top_k`.
+
+    Each shape hits at most one fid (one masked hash per shape), so rows
+    are duplicate-free and the iterative max is exactly top_k.  On the
+    CPU mesh the sort-based `top_k` was ~40% of the whole dispatch
+    (measured: 9.5 ms -> 5.7 ms per 512-topic tick at M=32); with the
+    adaptive kcap keeping k small (4-8 covers steady traffic) the k
+    passes are O(k*B*M) elementwise ops, no sort anywhere."""
+    outs = []
+    m = matched
+    idx = jnp.arange(m.shape[-1], dtype=jnp.int32)[None, :]
+    for _ in range(k):
+        mx = jnp.max(m, axis=-1)
+        outs.append(mx)
+        am = jnp.argmax(m, axis=-1).astype(jnp.int32)
+        m = jnp.where(idx == am[:, None], -1, m)
+    return jnp.stack(outs, axis=-1)  # [B, k]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "kcap"))
+def sharded_match_compact_packed(
+    stacked: DeviceTables,
+    pbatch: jax.Array,  # [B, 2L+2] u32 packed topic batch, replicated
+    *,
+    mesh: Mesh,
+    kcap: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pipelined-dispatch flavor of `sharded_match_compact`:
+
+    * the topic batch arrives as ONE packed u32 array (one host->device
+      transfer instead of four; `ops.match.pack_topic_batch_np` layout),
+    * per-topic counts come back as u16 (saturated at 0xFFFF -> host
+      refetch), halving the counts leg of `bytes_down`,
+    * compaction is the iterative `_compact_topk`, not a sort.
+
+    NOT buffer-donating: up to `engine.pipeline_depth` in-flight ticks
+    share the same stacked tables — donation happens only on churn-fused
+    ticks, after a window drain (`sharded_step_compact_packed`)."""
+    M = stacked.k_a.shape[-1]
+    k = min(kcap, M)
+
+    def local(st, pb):
+        matched = match_batch(_unstack(st), unpack_topic_batch(pb))
+        counts = jnp.minimum(
+            jnp.sum(matched >= 0, axis=-1, dtype=jnp.int32), 0xFFFF
+        ).astype(jnp.uint16)
+        return _compact_topk(matched, k)[None], counts[None]
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(FILTER_AXIS), P()),
+        out_specs=(P(FILTER_AXIS), P(FILTER_AXIS)),
+    )(stacked, pbatch)
+
+
+# Donating: churn-fused ticks run with the in-flight window DRAINED
+# (match_submit), so no pending holds the pre-step table version and the
+# scatter can reuse the table buffers in place instead of paying an
+# on-device copy per churn tick.
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "kcap"), donate_argnums=(0,)
+)
+def sharded_step_compact_packed(
+    stacked: DeviceTables,  # [D, ...] sharded, donated
+    delta_slots: jax.Array,  # [D, K] i32, -1 padded
+    delta_ka: jax.Array,  # [D, K] u32
+    delta_kb: jax.Array,  # [D, K] u32
+    delta_val: jax.Array,  # [D, K] i32
+    pbatch: jax.Array,  # [B, 2L+2] u32, replicated
+    *,
+    mesh: Mesh,
+    kcap: int,
+) -> Tuple[DeviceTables, jax.Array, jax.Array]:
+    """Churn scatter fused with the packed compact match in ONE mesh
+    dispatch (`sharded_step_compact` with the pipelined wire format)."""
+    M = stacked.k_a.shape[-1]
+    k = min(kcap, M)
+
+    def local(st, sl, ka, kb, vv, pb):
+        t = apply_delta_impl(_unstack(st), sl[0], ka[0], kb[0], vv[0])
+        matched = match_batch(t, unpack_topic_batch(pb))
+        counts = jnp.minimum(
+            jnp.sum(matched >= 0, axis=-1, dtype=jnp.int32), 0xFFFF
+        ).astype(jnp.uint16)
+        top = _compact_topk(matched, k)
+        return jax.tree.map(lambda a: a[None], t), top[None], counts[None]
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(FILTER_AXIS),) * 5 + (P(),),
+        out_specs=(P(FILTER_AXIS), P(FILTER_AXIS), P(FILTER_AXIS)),
+    )(stacked, delta_slots, delta_ka, delta_kb, delta_val, pbatch)
+
+
+@functools.partial(jax.jit, static_argnames=("rows",))
+def _slice_live(hits: jax.Array, counts: jax.Array, *, rows: int):
+    """Device-side row slice: fetch only the live topic rows of the
+    padded batch (the padded tail can never match — length -1)."""
+    return hits[:, :rows], counts[:, :rows]
+
+
+def _round_up(n: int, g: int) -> int:
+    return ((n + g - 1) // g) * g
+
+
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def sharded_match_fids(
     stacked: DeviceTables,
@@ -318,6 +435,31 @@ class ShardedMatchEngine:
 
         self._stacked: Optional[DeviceTables] = None
         self._dest_dev: Optional[jax.Array] = None
+
+        # ---- pipelined dispatch window (engine.pipeline_depth) --------
+        # Up to `pipeline_depth` submitted-but-unresolved ticks share the
+        # same (non-donated) stacked tables, so host prep of tick N+1
+        # overlaps device compute of tick N and the async fetch of tick
+        # N-1.  Churn-fused ticks DONATE the tables (no on-device copy),
+        # which requires draining the window first — see match_submit.
+        self.pipeline_depth = 4
+        self._inflight: List["_ShardedPending"] = []
+        # per-(B, L) reusable host staging buffers for the packed topic
+        # batch (the pinned-staging analog: one np buffer per in-flight
+        # tick per bucket, recycled at resolve so pipelined ticks never
+        # rewrite a buffer a still-running device_put may alias)
+        self._staging: Dict[Tuple[int, int], List[np.ndarray]] = {}
+        # adaptive per-chip compact-return cap: k tracks the OBSERVED
+        # per-chip hit maximum (shrinks toward it every
+        # kcap_adapt_interval ticks, regrows on overflow), cutting the
+        # [D, B, k] fetch leg to what traffic actually needs.  kcap from
+        # the constructor stays the steady-state ceiling.
+        self._kcap_ceil = next_pow2(max(1, kcap))
+        self._kcap_floor = min(4, self._kcap_ceil)
+        self._kcap_dyn = min(8, self._kcap_ceil)
+        self._kpeak = 0
+        self._kticks = 0
+        self.kcap_adapt_interval = 64
 
         # flight recorder + histograms (observe/flight.py — same plane as
         # the single-chip engine; the mesh path is always device-served,
@@ -612,6 +754,9 @@ class ShardedMatchEngine:
     def sync_device(self) -> Tuple[DeviceTables, jax.Array]:
         slots, ka, kb, vv = self._pre_step_sync()
         if slots is not None:
+            # the delta scatter donates the stacked tables: every
+            # in-flight pending still references them (overflow refetch)
+            self._drain_window("sync-donate")
             put = lambda a: jax.device_put(a, self._shard0())
             self._stacked = sharded_apply_delta(
                 self._stacked, put(slots), put(ka), put(kb), put(vv), mesh=self.mesh
@@ -647,6 +792,172 @@ class ShardedMatchEngine:
         repl = self._repl()
         return TopicBatch(*(jax.device_put(a, repl) for a in nb)), n
 
+    # ------------------------------------------------- pipelined prep/fetch
+
+    def _acquire_staging(self, key: Tuple[int, int]) -> np.ndarray:
+        pool = self._staging.get(key)
+        if pool:
+            return pool.pop()
+        B, L = key
+        # np.empty is fine: live rows are fully rewritten, and padded
+        # rows only need their length column (-1) — stale terms in the
+        # pad region can never match (min_len kills the row)
+        return np.empty((B, 2 * L + 2), dtype=np.uint32)
+
+    def _release_staging(self, pending: "_ShardedPending") -> None:
+        buf, key = pending.buf, pending.bufkey
+        pending.buf = None
+        if buf is None or key is None:
+            return
+        pool = self._staging.setdefault(key, [])
+        if len(pool) <= self.pipeline_depth + 1:
+            pool.append(buf)
+
+    def _prep_packed(self, topics: Sequence[str]):
+        """Hash + bucket-pad + pack a publish batch into ONE replicated
+        [B, 2L+2] u32 upload (the single-chip wire format,
+        `ops.match.pack_topic_batch_np` layout): one `device_put` per
+        tick instead of four, assembled into a reusable per-bucket
+        staging buffer.  Returns (pbatch, n, B, L, buf, key)."""
+        n = len(topics)
+        ta, tb, ln, dl = hashing.hash_topics(self.space, list(topics))
+        B = max(self.min_batch, next_pow2(max(n, 1)))
+        L = live_levels(self.space.max_levels, ln)
+        key = (B, L)
+        buf = self._acquire_staging(key)
+        buf[:n, :L] = ta[:, :L]
+        buf[:n, L:2 * L] = tb[:, :L]
+        buf[:n, 2 * L] = ln.view(np.uint32)
+        buf[:n, 2 * L + 1] = dl
+        if n < B:
+            buf[n:, 2 * L] = np.uint32(0xFFFFFFFF)  # length -1: never match
+        return jax.device_put(buf, self._repl()), n, B, L, buf, key
+
+    def _fetch_rows(self, n: int, B: int) -> int:
+        """Live rows to fetch for an n-topic tick in a B bucket, rounded
+        so the slice jit compiles at most ~8 variants per bucket."""
+        return min(B, _round_up(max(n, 1), max(self.min_batch, B // 8)))
+
+    def _note_kmax(self, maxc: int) -> None:
+        """Adaptive kcap bookkeeping (see __init__): track the per-chip
+        hit peak; shrink k toward it every kcap_adapt_interval ticks."""
+        if maxc > self._kpeak:
+            self._kpeak = maxc
+        self._kticks += 1
+        if self._kticks >= self.kcap_adapt_interval:
+            tgt = min(
+                self._kcap_ceil,
+                max(self._kcap_floor, next_pow2(max(1, 2 * self._kpeak))),
+            )
+            if tgt < self._kcap_dyn:
+                self._kcap_dyn = tgt
+                tp("engine.kcap", kcap=tgt, peak=self._kpeak)
+            self._kpeak = 0
+            self._kticks = 0
+
+    # ------------------------------------------------- in-flight window
+
+    @property
+    def inflight_ticks(self) -> int:
+        return len(self._inflight)
+
+    def _drain_window(self, reason: str = "drain") -> None:
+        """Resolve every in-flight tick (device fetch + overflow refetch
+        against its own table version).  Must run before any dispatch
+        that DONATES the stacked tables: a donated buffer would yank the
+        table snapshot out from under the pending refetches."""
+        drained = 0
+        while self._inflight:
+            self._resolve(self._inflight[0])
+            drained += 1
+        if drained and _tps._active:
+            tp("engine.pipeline", event="drain", reason=reason, n=drained)
+
+    def _resolve(self, pending: "_ShardedPending", blocking: bool = True) -> bool:
+        """Fetch a pending tick's device results to host (idempotent,
+        thread-safe): the [D, rows, k] hits + u16 counts, plus the rare
+        per-chip-overflow refetch against THIS tick's table snapshot.
+        After resolve the pending holds only numpy data — collect just
+        verifies, and the tick no longer pins device buffers or its
+        staging buffer.  `blocking=False` skips (returns False) when
+        another thread is already resolving this pending."""
+        lk = pending.lock
+        if not lk.acquire(blocking=blocking):
+            return False
+        try:
+            if pending.resolved:
+                return True
+            if pending.hits is not None:
+                n = pending.n
+                pending.bytes_down += int(pending.hits.nbytes) + int(
+                    pending.counts.nbytes
+                )
+                hits = np.asarray(pending.hits)[:, :n, :]  # [D, n, k]
+                counts = np.asarray(pending.counts)[:, :n].astype(np.int32)
+                k = hits.shape[2]
+                self._note_kmax(int(counts.max(initial=0)))
+                over = (counts > k).any(axis=0)
+                if over.any():
+                    hits = self._refetch_overflow(pending, hits, counts, over)
+                pending.hits_np = hits
+                pending.counts_np = counts
+                pending.hits = pending.counts = None
+            pending.snap = None
+            self._release_staging(pending)
+            pending.resolved = True
+            try:
+                self._inflight.remove(pending)
+            except ValueError:
+                pass
+            return True
+        finally:
+            lk.release()
+
+    def _refetch_overflow(
+        self,
+        pending: "_ShardedPending",
+        hits: np.ndarray,
+        counts: np.ndarray,
+        over: np.ndarray,
+    ) -> np.ndarray:
+        """Per-chip compact-return overflow: refetch ONLY the overflowing
+        topics with k widened to the observed max (pow2-rounded so the
+        kcap-static jit compiles a bounded variant set) against THIS
+        tick's table version — a [D, B_over, k2] transfer instead of
+        [D, B, M].  Both transfer legs land in the pending's wire-byte
+        accounting (the BENCH wire floor reads them)."""
+        k = hits.shape[2]
+        snap = pending.snap if pending.snap is not None else self._stacked
+        M = int(snap.k_a.shape[-1])
+        over_idx = np.nonzero(over)[0]
+        sub_topics = [pending.topics[i] for i in over_idx.tolist()]
+        maxc = int(counts[:, over].max())
+        if maxc >= 0xFFFF:  # u16-saturated: the true count is unknown
+            maxc = M
+        k2 = next_pow2(min(max(maxc, k + 1), M))
+        pb, n_sub, B2, _L2, buf2, key2 = self._prep_packed(sub_topics)
+        pending.bytes_up += buf2.nbytes
+        sub_hits, _sub_counts = sharded_match_compact_packed(
+            snap, pb, mesh=self.mesh, kcap=k2
+        )
+        rows = self._fetch_rows(n_sub, B2)
+        if rows < B2:
+            sub_hits, _sub_counts = _slice_live(
+                sub_hits, _sub_counts, rows=rows
+            )
+        pending.bytes_down += int(sub_hits.nbytes)
+        sub = np.asarray(sub_hits)[:, :n_sub, :]
+        self._staging.setdefault(key2, []).append(buf2)
+        k2 = sub.shape[2]  # min(k2, M) inside the kernel
+        grown = np.concatenate(
+            [hits, np.full(hits.shape[:2] + (k2 - k,), -1, dtype=hits.dtype)],
+            axis=2,
+        )
+        grown[:, over_idx, :] = sub
+        # regrow the steady-state cap toward the observed demand
+        self._kcap_dyn = min(max(self._kcap_dyn, k2), self._kcap_ceil)
+        return grown
+
     # -------------------------------------------------------------- match
 
     def match_counts(self, topics: Sequence[str]) -> np.ndarray:
@@ -670,6 +981,7 @@ class ShardedMatchEngine:
         returned ones, so the cached mirror is never left dangling.
         """
         slots, ka, kb, vv = self._pre_step_sync()
+        self._drain_window("step-donate")
         if slots is None:
             K = 16
             slots = np.full((self.D, K), -1, dtype=np.int32)
@@ -707,13 +1019,23 @@ class ShardedMatchEngine:
         the caller's thread; collect only fetches + verifies, so it is
         executor-safe — the same contract as the single-chip engine.
 
+        PIPELINED: up to ``pipeline_depth`` submitted-but-unresolved
+        ticks may be in flight at once, all sharing the same stacked
+        tables through the NON-donating packed match — host prep of tick
+        N+1 overlaps device compute of tick N and the async fetch of
+        tick N-1.  Past the window the oldest tick is force-resolved
+        (its compute is ≥depth ticks old, so the fetch is ~a memcpy).
+
         Pending subscription churn is FUSED into the same dispatch
-        (`sharded_step_compact`), so a churn tick costs one mesh round
-        trip like a pure match tick.  The return is the compact
-        [D, B, k] top-fid block; the rare per-chip overflow (one topic
-        matching more than ``kcap`` filters on a single chip) refetches
-        just the overflowing topics at collect time with a widened k,
-        against THIS tick's tables — never the full [D, B, M] row."""
+        (`sharded_step_compact_packed`), so a churn tick costs one mesh
+        round trip like a pure match tick; churn ticks DONATE the table
+        buffers (no on-device copy), which first drains the window so no
+        pending still references the pre-step table version.  The return
+        is the compact [D, rows, k] top-fid block (live rows only, u16
+        counts); the rare per-chip overflow (one topic matching more
+        than ``k`` filters on a single chip) refetches just the
+        overflowing topics at resolve time with a widened k, against
+        THIS tick's tables — never the full [D, B, M] row."""
         import time
 
         t0 = time.monotonic()
@@ -723,35 +1045,78 @@ class ShardedMatchEngine:
             else None
         )  # snapshotted at submit: collect may run on an executor thread
         if not any(t.n_entries for t in self.shards):
-            return _ShardedPending(
+            p = _ShardedPending(
                 None, None, None, 0, list(topics), deep, t0=t0
             )
+            p.resolved = True
+            return p
         slots, ka, kb, vv = self._pre_step_sync()
-        batch, n = self._prep_batch(topics)
-        # wire-byte accounting (flight recorder): the replicated topic
-        # batch is the upload payload (counted once — replication is the
-        # mesh fabric's job, not the host link's), plus churn deltas
-        bytes_up = sum(int(a.nbytes) for a in batch)
+        churn_slots = int((slots >= 0).sum()) if slots is not None else 0
+        if slots is not None:
+            # donation below invalidates the tables every in-flight tick
+            # still snapshots (overflow refetch): drain the window first
+            self._drain_window("churn-fuse")
+        pbatch, n, B, _L, buf, key = self._prep_packed(topics)
+        # wire-byte accounting (flight recorder): the packed topic batch
+        # is the upload payload (counted once — replication is the mesh
+        # fabric's job, not the host link's), plus churn deltas
+        bytes_up = buf.nbytes
+        kc = self._kcap_dyn
         if slots is not None:
             bytes_up += slots.nbytes + ka.nbytes + kb.nbytes + vv.nbytes
             put = lambda a: jax.device_put(a, self._shard0())
-            self._stacked, hits, counts = sharded_step_compact(
+            self._stacked, hits, counts = sharded_step_compact_packed(
                 self._stacked, put(slots), put(ka), put(kb), put(vv),
-                batch, mesh=self.mesh, kcap=self.kcap,
+                pbatch, mesh=self.mesh, kcap=kc,
             )
         else:
-            hits, counts = sharded_match_compact(
-                self._stacked, batch, mesh=self.mesh, kcap=self.kcap
+            hits, counts = sharded_match_compact_packed(
+                self._stacked, pbatch, mesh=self.mesh, kcap=kc
             )
-        try:  # start the device->host copy NOW; collect overlaps it
+        # fetch slimming: transfer only the live topic rows of the
+        # padded bucket (worth a slice dispatch past ~25% padding)
+        rows = self._fetch_rows(n, B)
+        if rows < B and B - rows >= B // 4:
+            hits, counts = _slice_live(hits, counts, rows=rows)
+        try:  # start the device->host copy NOW; resolve overlaps it
             hits.copy_to_host_async()
             counts.copy_to_host_async()
         except AttributeError:  # pragma: no cover - older jax
             pass
-        return _ShardedPending(
+        p = _ShardedPending(
             hits, counts, self._stacked, n, list(topics), deep,
             t0=t0, bytes_up=bytes_up,
         )
+        p.churn_slots = churn_slots
+        p.buf, p.bufkey = buf, key
+        self._inflight.append(p)
+        p.pipe_occ = len(self._inflight)
+        p.pipe_depth = self.pipeline_depth
+        if len(self._inflight) > self.pipeline_depth:
+            # bound the window: resolve the oldest tick, but ONLY if its
+            # device result is already materialized — the submit thread
+            # is the broker's event loop, and a stalled device must not
+            # freeze it (test_pipeline.py's guarantee).  Past a 4x hard
+            # ceiling memory safety wins and the resolve blocks (OLP has
+            # shed load long before that point).
+            oldest = self._inflight[0]
+            force = len(self._inflight) > 4 * self.pipeline_depth
+            if (force or self._tick_ready(oldest)) and self._resolve(
+                oldest, blocking=force
+            ) and _tps._active:
+                tp("engine.pipeline", event="window-full",
+                   occ=p.pipe_occ, depth=self.pipeline_depth)
+        return p
+
+    @staticmethod
+    def _tick_ready(pending: "_ShardedPending") -> bool:
+        out = pending.hits
+        if out is None:
+            return True
+        try:
+            return bool(out.is_ready())
+        except AttributeError:  # pragma: no cover - older jax
+            return True
 
     def match_collect(self, pending: "_ShardedPending") -> List[Set[int]]:
         return [set(x) for x in self.match_collect_raw(pending)]
@@ -759,7 +1124,11 @@ class ShardedMatchEngine:
     def match_collect_raw(self, pending: "_ShardedPending") -> List[List[int]]:
         """Block on a submitted sharded match; verified fid lists.
         Records one flight-recorder row per tick (always device-path on
-        the mesh: host arbitration does not apply across shards)."""
+        the mesh: host arbitration does not apply across shards), with
+        the pipeline occupancy this tick saw at submit and the churn
+        slots THIS tick's fused dispatch actually shipped (the live
+        delta backlog belongs to the NEXT tick after the submit-time
+        drain)."""
         import time
 
         colls0 = self.collision_count
@@ -775,8 +1144,9 @@ class ShardedMatchEngine:
                 rate_host=None, rate_dev=None,
                 bytes_up=pending.bytes_up, bytes_down=pending.bytes_down,
                 verify_fail=self.collision_count - colls0,
-                churn_slots=sum(len(t.delta.slots) for t in self.shards),
+                churn_slots=pending.churn_slots,
                 lat_s=lat, churn_lag_s=self._churn_lag,
+                pipe_occ=pending.pipe_occ, pipe_depth=pending.pipe_depth,
             )
         if _tps._active:  # gate: skip kwarg evaluation when tracing is off
             tp("engine.tick", path="device", n=len(pending.topics),
@@ -786,38 +1156,14 @@ class ShardedMatchEngine:
     def _collect_serve(self, pending: "_ShardedPending") -> List[List[int]]:
         topics = pending.topics
         out: List[List[int]] = [[] for _ in topics]
-        if pending.hits is not None:
+        if not pending.resolved:
+            # blocking resolve: waits out a concurrent resolver, then
+            # returns with hits_np populated (or None for an empty tick)
+            self._resolve(pending)
+        hits = pending.hits_np  # [D, n, k], overflow already widened
+        if hits is not None:
             from ..models.engine import verify_pairs_into
 
-            n = pending.n
-            pending.bytes_down += int(pending.hits.nbytes) + int(
-                pending.counts.nbytes
-            )
-            hits = np.asarray(pending.hits)[:, :n, :]  # [D, n, k]
-            counts = np.asarray(pending.counts)[:, :n]  # [D, n]
-            k = hits.shape[2]
-            over = (counts > k).any(axis=0)
-            if over.any():
-                # per-chip overflow: refetch ONLY the overflowing topics
-                # with k widened to the observed max (pow2-rounded so
-                # the kcap-static jit compiles a bounded variant set) —
-                # a [D, B_over, k2] transfer instead of [D, B, M]
-                stacked = pending.snap  # THIS tick's table version
-                over_idx = np.nonzero(over)[0]
-                sub_topics = [pending.topics[i] for i in over_idx.tolist()]
-                k2 = next_pow2(int(counts[:, over].max()))
-                sub_batch, n_sub = self._prep_batch(sub_topics)
-                sub_hits, _sub_counts = sharded_match_compact(
-                    stacked, sub_batch, mesh=self.mesh, kcap=k2
-                )
-                pending.bytes_down += int(sub_hits.nbytes)
-                sub_hits = np.asarray(sub_hits)[:, :n_sub, :]
-                # overflow implies counts.max() > k, so k2 >= k+1 here
-                hits = np.concatenate(
-                    [hits, np.full(hits.shape[:2] + (k2 - k,), -1,
-                                   dtype=hits.dtype)], axis=2
-                )
-                hits[:, over_idx, :] = sub_hits
             _d, bb, jj = np.nonzero(hits >= 0)
             if bb.size:
                 fids = hits[_d, bb, jj]
@@ -880,11 +1226,18 @@ class ShardedMatchEngine:
 
 
 class _ShardedPending:
-    """An in-flight sharded match (see ShardedMatchEngine.match_submit)."""
+    """An in-flight sharded match (see ShardedMatchEngine.match_submit).
+
+    Lives in the engine's pipeline window until `_resolve` fetches its
+    device results to `hits_np`/`counts_np` (idempotent under `lock`;
+    collect, a window drain, or a window-full force-resolve may race to
+    do it).  After resolve the pending holds numpy data only — no device
+    buffers, no table snapshot, no staging buffer."""
 
     __slots__ = (
         "hits", "counts", "snap", "n", "topics", "deep", "t0", "bytes_up",
-        "bytes_down",
+        "bytes_down", "churn_slots", "pipe_occ", "pipe_depth", "lock",
+        "resolved", "hits_np", "counts_np", "buf", "bufkey",
     )
 
     def __init__(self, hits, counts, snap, n, topics, deep=None,
@@ -898,3 +1251,12 @@ class _ShardedPending:
         self.bytes_up = bytes_up
         self.bytes_down = 0
         self.deep = deep  # deep-filter hits, snapshotted at submit
+        self.churn_slots = 0  # delta slots THIS tick's dispatch shipped
+        self.pipe_occ = 0  # in-flight ticks at submit (incl. this one)
+        self.pipe_depth = 0  # engine.pipeline_depth at submit
+        self.lock = threading.Lock()
+        self.resolved = False
+        self.hits_np = None  # [D, n, k] after resolve (overflow widened)
+        self.counts_np = None  # [D, n] i32 after resolve
+        self.buf = None  # staging buffer to recycle at resolve
+        self.bufkey = None

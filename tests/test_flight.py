@@ -128,6 +128,17 @@ def test_recorder_flip_detection_and_totals():
     assert s["last"]["path"] == "host"
 
 
+def test_recorder_pipeline_occupancy_fields():
+    rec = FlightRecorder(size=16)
+    _record(rec, pipe_occ=3, pipe_depth=4)
+    _record(rec)  # engines without a window leave the fields at 0
+    rows = rec.recent(2)
+    assert rows[0]["pipe_occ"] == 3 and rows[0]["pipe_depth"] == 4
+    assert rows[1]["pipe_occ"] == 0 and rows[1]["pipe_depth"] == 0
+    _record(rec, pipe_occ=1000, pipe_depth=1000)  # u1 fields saturate
+    assert rec.recent(1)[0]["pipe_occ"] == 255
+
+
 def test_recorder_pickle_roundtrip(tmp_path):
     rec = FlightRecorder(size=32)
     for _ in range(5):
@@ -175,11 +186,14 @@ def test_flight_dump_renders_ticks_and_flips(tmp_path):
     fd = _load_tool("flight_dump")
     rec = FlightRecorder(size=32)
     _record(rec, path=PATH_HOST)
-    _record(rec, path=PATH_DEVICE)
+    _record(rec, path=PATH_DEVICE, pipe_occ=2, pipe_depth=4)
     _record(rec, path=PATH_HOST, reason=R_LINK_STALL, verify_fail=2)
     out = fd.dump(rec)
     assert "flight recorder: 3 tick(s)" in out
     assert "link-stall" in out and "2 flip(s) total" in out
+    # pipeline occupancy column: occ/depth when recorded, '-' otherwise
+    assert "2/4" in out
+    assert " occ" in fd.format_ticks(rec)
     # the flip marker rides the reason column
     assert "link-stall*" in out
     table = fd.format_ticks(rec, n=2)

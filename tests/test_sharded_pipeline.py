@@ -1,0 +1,287 @@
+"""Pipelined sharded dispatch: the multi-tick in-flight window.
+
+PR 2 tentpole: `ShardedMatchEngine` allows up to `pipeline_depth`
+submitted-but-unresolved ticks sharing the same (non-donated) stacked
+tables; churn-fused ticks drain the window and donate the table
+buffers.  These tests drive interleaved submit/collect traces and
+assert the results are IDENTICAL to a lock-step depth-1 engine (oracle
+compare), including churn fused mid-window, out-of-order collects, and
+an overflow refetch while the window is full — plus the flight
+recorder's occupancy fields and the window-bounding force-resolve.
+"""
+
+import random
+
+import jax
+import pytest
+
+from emqx_tpu.models.reference import BruteForceIndex
+from emqx_tpu.parallel.mesh import make_mesh
+from emqx_tpu.parallel.sharded import ShardedMatchEngine
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 cpu devices"
+    return make_mesh()
+
+
+def _engine(mesh, **kw):
+    kw.setdefault("n_sub_shards", 64)
+    kw.setdefault("min_batch", 16)
+    return ShardedMatchEngine(mesh=mesh, **kw)
+
+
+def _population(eng, ref, rng, n=400):
+    for _ in range(n):
+        parts = [rng.choice(["a", "b", "c", "+", "d1"])
+                 for _ in range(rng.randint(1, 5))]
+        if rng.random() < 0.3:
+            parts.append("#")
+        f = "/".join(parts)
+        fid = eng.add_filter(f)
+        ref.insert(f, fid)
+
+
+def _topics(rng, k):
+    return [
+        "/".join(rng.choice(["a", "b", "c", "d1", "x"])
+                 for _ in range(rng.randint(1, 6)))
+        for _ in range(k)
+    ]
+
+
+def test_window_deep_submit_matches_lockstep_oracle(mesh):
+    """K ticks submitted before ANY collect return exactly what a
+    depth-1 engine returns for the same topics."""
+    rng = random.Random(11)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng)
+    eng.pipeline_depth = 4
+    ticks = [_topics(rng, 17) for _ in range(4)]
+    pend = [eng.match_submit(t) for t in ticks]
+    assert eng.inflight_ticks == 4
+    assert [p.pipe_occ for p in pend] == [1, 2, 3, 4]
+    assert all(p.pipe_depth == 4 for p in pend)
+    for ts, p in zip(ticks, pend):
+        got = eng.match_collect(p)
+        for t, g in zip(ts, got):
+            assert g == ref.match(t), t
+    assert eng.inflight_ticks == 0
+
+
+def test_window_full_force_resolves_oldest(mesh):
+    """Past pipeline_depth ready ticks are force-resolved; past the 4x
+    hard ceiling the resolve blocks — either way the window is bounded
+    and collects still return correct rows."""
+    rng = random.Random(12)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng, n=120)
+    eng.pipeline_depth = 2
+    ticks = [_topics(rng, 9) for _ in range(12)]
+    pend = [eng.match_submit(t) for t in ticks]
+    # hard bound: never more than 4x depth unresolved
+    assert eng.inflight_ticks <= 4 * eng.pipeline_depth
+    assert pend[0].resolved  # oldest was force-resolved
+    for ts, p in zip(ticks, pend):
+        got = eng.match_collect(p)
+        for t, g in zip(ts, got):
+            assert g == ref.match(t), t
+
+
+def test_out_of_order_collect(mesh):
+    """Collecting newest-first must not change any tick's result (each
+    pending resolves against its own submit-time snapshot)."""
+    rng = random.Random(13)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng)
+    eng.pipeline_depth = 4
+    ticks = [_topics(rng, 13) for _ in range(4)]
+    pend = [eng.match_submit(t) for t in ticks]
+    for ts, p in reversed(list(zip(ticks, pend))):
+        got = eng.match_collect(p)
+        for t, g in zip(ts, got):
+            assert g == ref.match(t), t
+
+
+def test_churn_fused_mid_window_drains_and_stays_exact(mesh):
+    """Subscribe/unsubscribe churn landing between submits: the fused
+    churn tick drains the window (donation safety), earlier ticks
+    keep their pre-churn results, later ticks see the churn."""
+    rng = random.Random(14)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng, n=200)
+    eng.pipeline_depth = 4
+    for rnd in range(4):
+        pre_ticks = [_topics(rng, 9) for _ in range(3)]
+        pre = [eng.match_submit(t) for t in pre_ticks]
+        pre_want = [[ref.match(t) for t in ts] for ts in pre_ticks]
+        f = f"churn/{rnd}/+"
+        adds, removes = [f], []
+        if rnd >= 2:
+            dead = f"churn/{rnd - 2}/+"
+            removes.append(dead)
+            ref.delete(dead)
+        eng.apply_churn(adds, removes)
+        ref.insert(f, eng.fid_of(f))
+        post_t = _topics(rng, 9) + [f"churn/{rnd}/x", f"churn/{rnd - 2}/x"]
+        post = eng.match_submit(post_t)  # churn-fused: drains the window
+        assert post.churn_slots > 0  # this tick shipped the delta
+        assert all(p.resolved for p in pre)
+        got = eng.match_collect(post)
+        for t, g in zip(post_t, got):
+            assert g == ref.match(t), (rnd, t)
+        for ts, p, want in zip(pre_ticks, pre, pre_want):
+            got = eng.match_collect(p)
+            for t, g, w in zip(ts, got, want):
+                assert g == w, (rnd, t)
+
+
+def test_overflow_refetch_inside_full_window(mesh):
+    """kcap=1 forces the per-chip compact overflow while the window is
+    full; the widened refetch must run against each tick's own table
+    snapshot and both transfer legs must be accounted."""
+    eng = _engine(mesh, kcap=1)
+    fid0 = eng.add_filter("a/b")  # fid 0 -> chip 0
+    for i in range(7):
+        eng.add_filter(f"pad/{i}")
+    fid8 = eng.add_filter("a/+")  # fid 8 -> chip 0: 2 same-chip hits
+    eng.pipeline_depth = 4
+    pend = [eng.match_submit(["a/b", "pad/3"]) for _ in range(4)]
+    for p in pend:
+        up0, down0 = p.bytes_up, p.bytes_down
+        got = eng.match_collect(p)
+        assert got[0] == {fid0, fid8}
+        assert got[1] == {eng.fid_of("pad/3")}
+        # refetch legs were accounted (upload of the sub-batch + the
+        # widened hits download, on top of the normal tick legs)
+        assert p.bytes_down > 0
+        assert p.bytes_up > up0 or up0 > 0
+    # the rows landed in the flight recorder with the refetch bytes
+    rows = eng.flight.recent(4)
+    assert all(r["bytes_down"] > 0 and r["bytes_up"] > 0 for r in rows)
+
+
+def test_flight_records_occupancy_and_tick_churn_slots(mesh):
+    rng = random.Random(15)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng, n=100)
+    eng.pipeline_depth = 3
+    pend = [eng.match_submit(_topics(rng, 5)) for _ in range(3)]
+    for p in pend:
+        eng.match_collect(p)
+    rows = eng.flight.recent(3)
+    assert [r["pipe_occ"] for r in rows] == [1, 2, 3]
+    assert all(r["pipe_depth"] == 3 for r in rows)
+    # churn_slots is the count THIS tick's dispatch shipped, not the
+    # live (next tick's) backlog: a pure-match tick after churn was
+    # already flushed reports 0, the fused tick reports its own slots
+    eng.apply_churn([f"cs/{i}" for i in range(5)], [])
+    p = eng.match_submit(_topics(rng, 5))
+    fused_slots = p.churn_slots
+    eng.match_collect(p)
+    assert fused_slots > 0
+    assert eng.flight.recent(1)[0]["churn_slots"] == fused_slots
+    p2 = eng.match_submit(_topics(rng, 5))
+    eng.match_collect(p2)
+    assert eng.flight.recent(1)[0]["churn_slots"] == 0
+
+
+def test_adaptive_kcap_shrinks_and_regrows(mesh):
+    eng = _engine(mesh, kcap=64)
+    ref = BruteForceIndex()
+    for i in range(40):  # exact filters: at most ONE hit per chip
+        eng.add_filter(f"e/{i}")
+        ref.insert(f"e/{i}", eng.fid_of(f"e/{i}"))
+    eng.kcap_adapt_interval = 8
+    assert eng._kcap_dyn == 8  # starts small, bounded by kcap
+    for r in range(10):  # sparse traffic: shrink toward the observed max
+        eng.match([f"e/{(r + j) % 40}" for j in range(7)])
+    shrunk = eng._kcap_dyn
+    assert shrunk == eng._kcap_floor  # per-chip max here is exactly 1
+    # 6 filters all matching 'wide/x' pinned to ONE chip (fids are
+    # placed fid % D, so stride-8 allocation keeps them on chip 0):
+    # count 6 > k overflows the compact return and regrows k
+    wide = ["wide/x", "wide/+", "wide/#", "+/x", "#", "+/+"]
+    for i, f in enumerate(wide):
+        eng.add_filter(f)
+        ref.insert(f, eng.fid_of(f))
+        if i < len(wide) - 1:
+            for j in range(7):  # pad the other 7 chips
+                pf = f"pad/{i}/{j}"
+                eng.add_filter(pf)
+                ref.insert(pf, eng.fid_of(pf))
+    fids = [eng.fid_of(f) for f in wide]
+    assert len({f % eng.D for f in fids}) == 1, fids  # same chip
+    got = eng.match(["wide/x"])[0]
+    assert got == ref.match("wide/x")
+    assert eng._kcap_dyn > shrunk  # overflow regrew the cap
+    # exactness preserved across shrink/regrow
+    for r in range(3):
+        ts = [f"e/{(r + j) % 40}" for j in range(5)] + ["wide/x", "pad/2/3"]
+        for t, g in zip(ts, eng.match(ts)):
+            assert g == ref.match(t), t
+
+
+def test_pipelined_broker_parity_random_trace(mesh):
+    """The sharded broker with a deep window vs the single-chip broker
+    as oracle, publishes interleaved with subscribes mid-window (the
+    batcher-shaped trace)."""
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+
+    class Sink:
+        def __init__(self, broker, cid):
+            self.clientid = cid
+            self.got = []
+            broker.cm.channels[cid] = self
+
+        def deliver(self, delivers):
+            self.got.extend(delivers)
+
+        def kick(self, rc):
+            pass
+
+    rng = random.Random(17)
+    sh_eng = _engine(mesh, kcap=4)
+    sh_eng.pipeline_depth = 4
+    brokers = {"sh": Broker(engine=sh_eng), "si": Broker()}
+    sinks = {
+        k: {f"c{i}": Sink(b, f"c{i}") for i in range(8)}
+        for k, b in brokers.items()
+    }
+    for step in range(5):
+        for _ in range(15):
+            cid = f"c{rng.randrange(8)}"
+            parts = [rng.choice(["s", "t", "+", "u5"])
+                     for _ in range(rng.randint(1, 4))]
+            f = "/".join(parts)
+            for b in brokers.values():
+                b.subscribe(cid, f, SubOpts(qos=0))
+        topics = [
+            "/".join(rng.choice(["s", "t", "u5", "w"])
+                     for _ in range(rng.randint(1, 4)))
+            for _ in range(6)
+        ]
+        # pipeline publishes through the three-phase contract
+        pps = [
+            brokers["sh"].publish_submit(
+                [Message(topic=t, payload=b"x")]
+            )
+            for t in topics
+        ]
+        for pp in pps:
+            brokers["sh"].publish_collect(pp)
+            brokers["sh"].publish_finish(pp)
+        for t in topics:
+            brokers["si"].publish(Message(topic=t, payload=b"x"))
+        for cid in sinks["sh"]:
+            got_sh = sorted((f, m.topic) for f, m in sinks["sh"][cid].got)
+            got_si = sorted((f, m.topic) for f, m in sinks["si"][cid].got)
+            assert got_sh == got_si, (step, cid)

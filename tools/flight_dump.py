@@ -46,28 +46,38 @@ def _fmt_rate(r: float) -> str:
     return "-" if not r else f"{r:,.0f}"
 
 
+def _fmt_occ(r: dict) -> str:
+    """Pipeline occupancy at submit as occ/depth ('-' before PR 2 rings
+    or engines that never set the fields)."""
+    if not r.get("pipe_depth"):
+        return "-"
+    return f"{r['pipe_occ']}/{r['pipe_depth']}"
+
+
 def format_ticks(rec: FlightRecorder, n: int = 32) -> str:
     """The last `n` tick records as an aligned table (oldest first)."""
     rows = rec.recent(n)
     if not rows:
         return "(no ticks recorded)"
     hdr = (f"{'tick':>8} {'path':>6} {'reason':<12} {'n':>6} {'uniq':>6} "
-           f"{'lat ms':>9} {'up':>9} {'down':>9} {'rate_h':>12} "
-           f"{'rate_d':>12} {'vfail':>5} {'churn':>7}")
+           f"{'occ':>5} {'lat ms':>9} {'up':>9} {'down':>9} "
+           f"{'rate_h':>12} {'rate_d':>12} {'vfail':>5} {'churn':>7}")
     lines = [hdr, "-" * len(hdr)]
     first_tick = rec.n - len(rows)
     for i, r in enumerate(rows):
         lines.append(
             f"{first_tick + i:>8} {r['path']:>6} "
             f"{(r['reason'] or '-') + ('*' if r['flip'] else ''):<12} "
-            f"{r['n_topics']:>6} {r['n_unique']:>6} {r['lat_ms']:>9.3f} "
+            f"{r['n_topics']:>6} {r['n_unique']:>6} "
+            f"{_fmt_occ(r):>5} {r['lat_ms']:>9.3f} "
             f"{_fmt_bytes(r['bytes_up']):>9} "
             f"{_fmt_bytes(r['bytes_down']):>9} "
             f"{_fmt_rate(r['rate_host']):>12} "
             f"{_fmt_rate(r['rate_dev']):>12} "
             f"{r['verify_fail']:>5} {r['churn_slots']:>7}"
         )
-    lines.append("(* = arbitration flip on this tick)")
+    lines.append("(* = arbitration flip on this tick; occ = pipeline "
+                 "occupancy at submit / window depth)")
     return "\n".join(lines)
 
 
