@@ -1,7 +1,7 @@
 # Build/check entry points (the reference's `make` + rebar gates analog:
 # /root/reference/Makefile, rebar.config:16-36 dialyzer/xref/elvis).
 
-.PHONY: check lint test test-fast native bench
+.PHONY: check lint test test-fast native bench restore-bench
 
 # static-analysis gate: stdlib implementation (mypy/ruff are not in this
 # image and installs are off-limits — see tools/check.py header)
@@ -22,3 +22,8 @@ native:
 
 bench:
 	python bench.py
+
+# warm-restart bench: snapshot+WAL restore vs cold table rebuild at
+# 100k filters; writes the restore_ms/rebuild_ms row into BENCH_TABLE.md
+restore-bench:
+	python bench.py --restore

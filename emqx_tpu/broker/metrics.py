@@ -71,6 +71,11 @@ PREDEFINED = [
     "engine.path_flips",
     "engine.verify_mismatch",
     "engine.probes",
+    # table checkpoint & warm restart (checkpoint/manager.py)
+    "engine.ckpt.saves",
+    "engine.ckpt.save_failures",
+    "engine.ckpt.restores",
+    "engine.ckpt.wal_records",
 ]
 
 

@@ -188,6 +188,12 @@ class DiscBackend:
         try:
             with os.fdopen(fd, "w") as f:
                 json.dump(data, f, separators=(",", ":"))
+                # fsync BEFORE the atomic rename: without it a power
+                # loss right after the rename can surface an empty or
+                # partial file as the session snapshot (the same
+                # temp+fsync+rename discipline as checkpoint/store.py)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except BaseException:
             try:

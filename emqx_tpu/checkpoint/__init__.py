@@ -1,0 +1,34 @@
+"""Table checkpoint & warm restart for the device match engine.
+
+The reference survives restarts because route/session truth lives in
+mnesia disc copies; this port's host truth (`MatchTables` + the filter
+registries) previously had to be rebuilt from session files by replaying
+every filter through `add_filters` on boot — at millions of routes, cold
+start is bounded by a full table rebuild plus device re-upload.
+
+This package is the durability subsystem for the engine's table state,
+the same journal+snapshot shape a training stack calls checkpointing:
+
+* `store.py`  — versioned CRC-framed binary snapshots of the table
+  arrays + fid/shape registries (temp+fsync+rename, keep-K retention,
+  fall back to an older snapshot on corruption);
+* `wal.py`    — a churn write-ahead log on `utils/replayq.ReplayQ`:
+  packed (adds, removes) records appended as engine mutations commit,
+  acked atomically when a snapshot lands;
+* `manager.py`— the background checkpointer (driven by the node
+  housekeeping loop: snapshot on interval or WAL-bytes threshold) and
+  `restore()` = newest valid snapshot + WAL-tail replay + ONE bulk
+  device upload instead of per-filter inserts.
+"""
+
+from .store import SnapshotStore, pack_filter_blob, unpack_filter_blob
+from .wal import ChurnWal
+from .manager import CheckpointManager
+
+__all__ = [
+    "SnapshotStore",
+    "ChurnWal",
+    "CheckpointManager",
+    "pack_filter_blob",
+    "unpack_filter_blob",
+]

@@ -1,0 +1,231 @@
+"""Background checkpointer: snapshot cadence, WAL hookup, warm restore.
+
+Driven by the node housekeeping loop (`node.py _ticker`): a snapshot is
+taken when the interval elapses OR the churn WAL's durable backlog
+crosses `wal_max_bytes` — whichever first.  Capture is split from write
+so the node can capture on the event loop (serialized with engine
+mutations — consistent by construction, like a mnesia transaction view)
+and serialize+fsync on a worker thread:
+
+    if mgr.due(now):
+        payload = mgr.capture()                  # loop thread, fast
+        await asyncio.to_thread(mgr.write, payload)   # fsync off-loop
+
+`restore()` is the warm-restart path: load the newest VALID snapshot
+(older ones on corruption), rebuild host truth wholesale
+(`engine.restore_checkpoint` — array adoption + dict zips, no
+re-hashing or re-placement), replay the WAL tail through `apply_churn`,
+and leave the device mirror marked rebuilt so the next dispatch ships
+ONE bulk upload instead of per-filter inserts.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+from ..observe.tracepoints import tp
+from .store import SnapshotStore
+from .wal import ChurnWal
+
+log = logging.getLogger("emqx_tpu.checkpoint")
+
+ALARM_NAME = "engine_checkpoint_failure"
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        engine,
+        directory: str,
+        *,
+        interval: float = 60.0,
+        wal_max_bytes: int = 64 * 1024 * 1024,
+        keep: int = 3,
+        wal_seg_bytes: int = 4 * 1024 * 1024,
+        retained_index=None,
+        metrics=None,
+        alarms=None,
+    ):
+        self.engine = engine
+        self.retained = retained_index
+        self.interval = float(interval)
+        self.wal_max_bytes = int(wal_max_bytes)
+        self.metrics = metrics  # broker Metrics (engine.ckpt.* counters)
+        self.alarms = alarms  # observe.AlarmManager
+        self.store = SnapshotStore(os.path.join(directory, "snap"), keep=keep)
+        self.wal = ChurnWal(os.path.join(directory, "wal"),
+                            seg_bytes=wal_seg_bytes)
+        self._last_snap = time.monotonic()
+        # filter -> refcount as of restore completion: released by
+        # reconcile_sessions() once session restore re-added its own refs
+        self._restored_refs: Optional[Dict[str, int]] = None
+        self.save_count = 0
+        self.save_failures = 0
+        engine.on_churn = self.note_churn
+
+    # ---------------------------------------------------------------- WAL
+
+    def note_churn(self, adds, removes) -> None:
+        """Engine mutation hook: one durable WAL record per commit."""
+        seq = self.wal.append(adds, removes)
+        if self.metrics is not None:
+            self.metrics.inc("engine.ckpt.wal_records")
+        tp("engine.ckpt.wal", seq=seq, adds=len(adds), removes=len(removes))
+
+    # ----------------------------------------------------------- snapshot
+
+    def due(self, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.monotonic()
+        if now - self._last_snap >= self.interval:
+            return True
+        return self.wal.pending_bytes() >= self.wal_max_bytes
+
+    def capture(self):
+        """Snapshot host truth (fast array copies + the WAL watermark).
+        Must run serialized with engine mutations — the event loop, or
+        any caller that owns the engine."""
+        watermark = self.wal.last_seq()
+        arrays, meta = self.engine.export_checkpoint()
+        if self.retained is not None and len(self.retained):
+            r_arr, r_meta = self.retained.export_state()
+            for k, v in r_arr.items():
+                arrays["ret/" + k] = v
+            meta["retained"] = r_meta
+        meta["wal_seq"] = watermark
+        meta["wall_time"] = time.time()
+        return arrays, meta, watermark
+
+    def write(self, payload) -> Optional[str]:
+        """Serialize + fsync a captured payload; ack the WAL through the
+        captured watermark.  Thread-safe vs concurrent appends."""
+        arrays, meta, watermark = payload
+        t0 = time.monotonic()
+        try:
+            path = self.store.save(arrays, meta)
+        except Exception as e:
+            self.save_failures += 1
+            if self.metrics is not None:
+                self.metrics.inc("engine.ckpt.save_failures")
+            if self.alarms is not None:
+                self.alarms.activate(
+                    ALARM_NAME, details={"error": str(e)},
+                    message="engine table checkpoint failed",
+                )
+            log.exception("checkpoint save failed")
+            return None
+        self.wal.ack_through(watermark)
+        self._last_snap = time.monotonic()
+        self.save_count += 1
+        if self.metrics is not None:
+            self.metrics.inc("engine.ckpt.saves")
+        if self.alarms is not None:
+            self.alarms.deactivate(ALARM_NAME)
+        tp("engine.ckpt.save", path=path, wal_seq=watermark,
+           n_filters=self.engine.n_filters,
+           dt_ms=(time.monotonic() - t0) * 1e3)
+        return path
+
+    def checkpoint(self) -> Optional[str]:
+        """Capture + write in one call (tests, shutdown, bench)."""
+        return self.write(self.capture())
+
+    def maybe_checkpoint(self, now: Optional[float] = None) -> Optional[str]:
+        return self.checkpoint() if self.due(now) else None
+
+    # ------------------------------------------------------------ restore
+
+    def restore(self) -> Optional[int]:
+        """Warm restart: newest valid snapshot + WAL-tail replay.
+
+        Returns the restored filter count, or None on a cold start (no
+        usable snapshot AND no replayable WAL base).  The engine's churn
+        hook is detached during replay so replayed records are not
+        re-logged.
+        """
+        t0 = time.monotonic()
+        candidates = self.store.list()
+        loaded = self.store.load_newest()
+        if loaded is None and candidates:
+            # snapshots existed but none passed verification: the WAL
+            # tail's base state is unrecoverable — cold start, keep the
+            # unacked WAL on disk for post-mortem
+            log.error(
+                "all %d snapshot(s) failed verification; cold start",
+                len(candidates),
+            )
+            if self.alarms is not None:
+                self.alarms.activate(
+                    ALARM_NAME,
+                    details={"snapshots": len(candidates)},
+                    message="no loadable engine snapshot; cold start",
+                )
+            return None
+        hook, self.engine.on_churn = self.engine.on_churn, None
+        try:
+            restored_from = None
+            if loaded is not None:
+                arrays, meta, restored_from = loaded
+                self.engine.restore_checkpoint(arrays, meta)
+                if (
+                    self.retained is not None
+                    and meta.get("retained") is not None
+                    and len(self.retained) == 0  # not already rebuilt
+                ):
+                    self.retained.from_state(
+                        {k[4:]: v for k, v in arrays.items()
+                         if k.startswith("ret/")},
+                        meta["retained"],
+                    )
+            replayed = 0
+            for adds, removes in self.wal.replay():
+                self.engine.apply_churn(adds, removes)
+                replayed += 1
+        finally:
+            self.engine.on_churn = hook
+        if restored_from is None and replayed == 0:
+            return None
+        n = self.engine.n_filters
+        self._restored_refs = self.engine.ref_snapshot()
+        if self.metrics is not None:
+            self.metrics.inc("engine.ckpt.restores")
+        tp("engine.ckpt.restore", snapshot=restored_from,
+           wal_records=replayed, n_filters=n,
+           fallbacks=self.store.fallbacks,
+           dt_ms=(time.monotonic() - t0) * 1e3)
+        log.info(
+            "engine warm restore: %d filters from %s + %d WAL record(s) "
+            "in %.1f ms", n, restored_from or "WAL only", replayed,
+            (time.monotonic() - t0) * 1e3,
+        )
+        return n
+
+    def reconcile_sessions(self) -> int:
+        """Release the checkpoint's filter references after session
+        restore re-added its own (node boot order: engine restore ->
+        persistence restore -> reconcile).  The persistence layer is the
+        authority on which subscriptions still exist: filters whose only
+        references came from the checkpoint (their sessions expired
+        while the node was down) drop to zero and leave the table;
+        re-subscribed filters keep exactly their session references —
+        the table stayed warm the whole time (re-subscribing an existing
+        filter is a refcount bump, not a hash+placement).  Returns the
+        number of references released."""
+        refs = self._restored_refs
+        self._restored_refs = None
+        if not refs:
+            return 0
+        removes = []
+        for filt, rc in refs.items():
+            removes.extend([filt] * int(rc))
+        self.engine.apply_churn([], removes)
+        return len(removes)
+
+    # -------------------------------------------------------------- close
+
+    def close(self) -> None:
+        if self.engine.on_churn == self.note_churn:
+            self.engine.on_churn = None
+        self.wal.close()

@@ -1,0 +1,316 @@
+"""Versioned binary snapshot store for the match-engine table state.
+
+One snapshot file carries a JSON meta block plus named numpy arrays
+(the `MatchTables` arrays, the packed filter registry, the retained
+index rows).  The whole payload is CRC32-framed; writes go
+temp + fsync + rename (+ directory fsync) so a power loss mid-write can
+never surface a partial file as the newest snapshot; `load_newest()`
+falls back to the next-older snapshot when the newest fails its frame
+check — the disc-copies discipline of the reference's mnesia tables,
+and the journal+snapshot layout of Pulsar-class brokers (PAPERS.md).
+
+File layout (little-endian):
+
+    magic "ETPUSNAP" | u32 version | u32 payload_crc | u64 payload_len
+    payload:
+        u32 meta_len | meta (JSON, utf-8)
+        u32 n_arrays
+        per array: u16 name_len | name | u16 dtype_len | dtype.str
+                   | u8 ndim | ndim x u64 dims | u64 nbytes | raw bytes
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import tempfile
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observe.tracepoints import tp
+
+MAGIC = b"ETPUSNAP"
+VERSION = 1
+_HDR = struct.Struct("<8sIIQ")  # magic, version, payload crc, payload len
+
+
+class SnapshotError(Exception):
+    """A snapshot file failed its frame/CRC/format check."""
+
+
+# ----------------------------------------------------------- string packing
+
+def pack_str_list(strs: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """(u8 buffer, i64 offsets) for a string list — the registry wire
+    format (`ops.native.pack_strs` contract), reused so a snapshot's
+    packed filter blob feeds `FilterRegistry.set_bulk_packed` directly."""
+    from ..ops.native import pack_strs
+
+    if not strs:
+        return np.zeros(1, dtype=np.uint8), np.zeros(1, dtype=np.int64)
+    return pack_strs(list(strs))
+
+
+def unpack_str_list(buf: np.ndarray, offs: np.ndarray) -> List[str]:
+    data = buf.tobytes()
+    ol = offs.tolist()
+    return [
+        data[ol[i]:ol[i + 1]].decode("utf-8") for i in range(len(ol) - 1)
+    ]
+
+
+def pack_nul_list(strs: Sequence[str]) -> np.ndarray:
+    """String list as ONE NUL-joined u8 array — the snapshot's filter
+    registry format.  MQTT forbids U+0000 in topics/filters (the same
+    invariant `ops.native.pack_strs` and the churn WAL rely on), and
+    UTF-8 never produces a 0x00 byte except for U+0000, so the
+    separator is unambiguous and restore is one C-level decode+split
+    instead of a 100k-iteration Python slice loop."""
+    if not strs:
+        return np.zeros(0, dtype=np.uint8)
+    data = "\x00".join(strs).encode("utf-8")
+    return np.frombuffer(data, dtype=np.uint8).copy()
+
+
+def unpack_nul_list(arr: np.ndarray, n: int) -> List[str]:
+    """Inverse of pack_nul_list; `n` disambiguates [] from [""]."""
+    if n == 0:
+        return []
+    out = arr.tobytes().decode("utf-8").split("\x00")
+    if len(out) != n:
+        raise SnapshotError(
+            f"packed string list holds {len(out)} entries, meta says {n}"
+        )
+    return out
+
+
+def nul_to_packed(arr: np.ndarray, n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """NUL-joined blob -> the (buf, offsets) registry wire format
+    (`FilterRegistry.set_bulk_packed`), three vectorized passes."""
+    if n == 0:
+        return np.zeros(1, dtype=np.uint8), np.zeros(1, dtype=np.int64)
+    mask = arr == 0
+    sep = np.flatnonzero(mask)
+    if len(sep) != n - 1:
+        raise SnapshotError("packed string list separator count mismatch")
+    offs = np.empty(n + 1, dtype=np.int64)
+    offs[0] = 0
+    offs[1:n] = sep - np.arange(n - 1)
+    offs[n] = len(arr) - (n - 1)
+    packed = arr[~mask]
+    if not len(packed):
+        packed = np.zeros(1, dtype=np.uint8)
+    return np.ascontiguousarray(packed), offs
+
+
+def pack_filter_blob(filters: Sequence[str]) -> bytes:
+    """Compressed length-prefixed filter list — the cluster
+    fast-bootstrap wire blob (`cluster/node.py` snapshot resync ships
+    this instead of a JSON string array when a peer is far behind)."""
+    body = b"".join(
+        struct.pack("<I", len(b)) + b
+        for b in (f.encode("utf-8") for f in filters)
+    )
+    return b"CKF1" + struct.pack("<I", len(filters)) + zlib.compress(body, 6)
+
+
+def unpack_filter_blob(blob: bytes) -> List[str]:
+    if blob[:4] != b"CKF1":
+        raise SnapshotError("bad filter-blob magic")
+    (n,) = struct.unpack_from("<I", blob, 4)
+    body = zlib.decompress(blob[8:])
+    out: List[str] = []
+    off = 0
+    for _ in range(n):
+        (ln,) = struct.unpack_from("<I", body, off)
+        off += 4
+        out.append(body[off:off + ln].decode("utf-8"))
+        off += ln
+    if off != len(body):
+        raise SnapshotError("filter blob length mismatch")
+    return out
+
+
+# ---------------------------------------------------------- serialization
+
+def _serialize(arrays: Dict[str, np.ndarray], meta: dict) -> bytes:
+    parts: List[bytes] = []
+    mblob = json.dumps(meta, separators=(",", ":")).encode("utf-8")
+    parts.append(struct.pack("<I", len(mblob)))
+    parts.append(mblob)
+    parts.append(struct.pack("<I", len(arrays)))
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        nb = name.encode("utf-8")
+        db = arr.dtype.str.encode("ascii")
+        parts.append(struct.pack("<H", len(nb)))
+        parts.append(nb)
+        parts.append(struct.pack("<H", len(db)))
+        parts.append(db)
+        parts.append(struct.pack("<B", arr.ndim))
+        for d in arr.shape:
+            parts.append(struct.pack("<Q", d))
+        raw = arr.tobytes()
+        parts.append(struct.pack("<Q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def _deserialize(payload: bytes) -> Tuple[Dict[str, np.ndarray], dict]:
+    off = 0
+
+    def take(fmt):
+        nonlocal off
+        s = struct.Struct(fmt)
+        if off + s.size > len(payload):
+            raise SnapshotError("truncated snapshot payload")
+        vals = s.unpack_from(payload, off)
+        off += s.size
+        return vals if len(vals) > 1 else vals[0]
+
+    mlen = take("<I")
+    try:
+        meta = json.loads(payload[off:off + mlen].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise SnapshotError(f"bad meta block: {e}")
+    off += mlen
+    n_arrays = take("<I")
+    arrays: Dict[str, np.ndarray] = {}
+    for _ in range(n_arrays):
+        nlen = take("<H")
+        name = payload[off:off + nlen].decode("utf-8")
+        off += nlen
+        dlen = take("<H")
+        dtype = np.dtype(payload[off:off + dlen].decode("ascii"))
+        off += dlen
+        ndim = take("<B")
+        shape = tuple(take("<Q") for _ in range(ndim))
+        nbytes = take("<Q")
+        if off + nbytes > len(payload):
+            raise SnapshotError("truncated array block")
+        # zero-copy WRITABLE views: load_file hands us a bytearray, so
+        # restored tables can be mutated in place by later churn without
+        # a per-array copy (the arrays share the payload as their base)
+        arrays[name] = np.frombuffer(
+            payload, dtype=dtype, count=nbytes // max(dtype.itemsize, 1),
+            offset=off,
+        ).reshape(shape)
+        off += nbytes
+    return arrays, meta
+
+
+# ------------------------------------------------------------------- store
+
+class SnapshotStore:
+    """Keep-K snapshot directory with corruption fallback on load."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.dir = directory
+        self.keep = max(1, int(keep))
+        self.fallbacks = 0  # newest-snapshot corruption events survived
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------ listing
+
+    def list(self) -> List[Tuple[int, str]]:
+        """(seq, path) newest first."""
+        out = []
+        for name in os.listdir(self.dir):
+            if not (name.startswith("snap.") and name.endswith(".ckpt")):
+                continue
+            try:
+                seq = int(name.split(".")[1])
+            except (IndexError, ValueError):
+                continue
+            out.append((seq, os.path.join(self.dir, name)))
+        out.sort(reverse=True)
+        return out
+
+    # --------------------------------------------------------------- save
+
+    def save(self, arrays: Dict[str, np.ndarray], meta: dict) -> str:
+        """Write one snapshot atomically; prune past keep-K.  Returns
+        the snapshot path."""
+        payload = _serialize(arrays, meta)
+        hdr = _HDR.pack(MAGIC, VERSION, zlib.crc32(payload), len(payload))
+        existing = self.list()
+        seq = (existing[0][0] + 1) if existing else 1
+        path = os.path.join(self.dir, f"snap.{seq}.ckpt")
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(hdr)
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._fsync_dir()
+        for old_seq, old_path in self.list()[self.keep:]:
+            try:
+                os.unlink(old_path)
+            except OSError:
+                pass
+        return path
+
+    def _fsync_dir(self) -> None:
+        """Make the rename itself durable (best effort off-linux)."""
+        try:
+            dfd = os.open(self.dir, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(dfd)
+        except OSError:
+            pass
+        finally:
+            os.close(dfd)
+
+    # --------------------------------------------------------------- load
+
+    @staticmethod
+    def load_file(path: str) -> Tuple[Dict[str, np.ndarray], dict]:
+        """Parse + verify one snapshot file; SnapshotError on damage."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise SnapshotError(f"unreadable: {e}")
+        if len(data) < _HDR.size:
+            raise SnapshotError("file shorter than header")
+        magic, version, crc, plen = _HDR.unpack_from(data, 0)
+        if magic != MAGIC:
+            raise SnapshotError("bad magic")
+        if version != VERSION:
+            raise SnapshotError(f"unsupported snapshot version {version}")
+        payload = data[_HDR.size:]
+        if len(payload) != plen:
+            raise SnapshotError("payload length mismatch (torn write)")
+        if zlib.crc32(payload) != crc:
+            raise SnapshotError("payload CRC mismatch")
+        # one writable copy of the payload; every array is a view into it
+        return _deserialize(bytearray(payload))
+
+    def load_newest(
+        self,
+    ) -> Optional[Tuple[Dict[str, np.ndarray], dict, str]]:
+        """Newest VALID snapshot (arrays, meta, path), falling back to
+        older files when the newest fails its frame check; None when no
+        loadable snapshot exists."""
+        for i, (seq, path) in enumerate(self.list()):
+            try:
+                arrays, meta = self.load_file(path)
+            except SnapshotError as e:
+                self.fallbacks += 1
+                tp("engine.ckpt.fallback", path=path, seq=seq, error=str(e))
+                continue
+            return arrays, meta, path
+        return None

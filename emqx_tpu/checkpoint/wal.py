@@ -1,0 +1,129 @@
+"""Churn write-ahead log, layered on the disk-backed replay queue.
+
+Every engine mutation between snapshots — subscribes, unsubscribes,
+whole churn ticks — is appended as one packed (adds, removes) record
+through `TopicMatchEngine.on_churn` / `ShardedMatchEngine.on_churn`.
+Records ride `utils/replayq.ReplayQ`, inheriting its durability
+contract: per-record CRC32 framing, torn-tail truncation on reopen, and
+pop-then-ack consumption.  A record is retired ONLY when a snapshot
+that already contains its effect lands (`ack_through` at the snapshot's
+watermark) — so a crash at ANY snapshot/WAL boundary replays exactly
+the committed churn the newest snapshot is missing, never loses it.
+
+Record format: u32 n_adds | u32 n_removes | NUL-joined utf-8 filter
+strings (adds then removes; MQTT forbids U+0000 in filters, the same
+invariant `ops.native.pack_strs` relies on).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from ..utils.replayq import ReplayQ
+
+_CNT = struct.Struct("<II")
+
+
+def pack_ops(adds: Sequence[str], removes: Sequence[str]) -> bytes:
+    body = "\x00".join(list(adds) + list(removes)).encode("utf-8")
+    return _CNT.pack(len(adds), len(removes)) + body
+
+
+def unpack_ops(rec: bytes) -> Tuple[List[str], List[str]]:
+    na, nr = _CNT.unpack_from(rec, 0)
+    if na + nr == 0:
+        return [], []
+    parts = rec[_CNT.size:].decode("utf-8").split("\x00")
+    if len(parts) != na + nr:
+        raise ValueError("churn record count mismatch")
+    return parts[:na], parts[na:]
+
+
+class ChurnWal:
+    """Thread-safe WAL facade over one ReplayQ directory.
+
+    Appends come from the engine's mutation path (the event loop);
+    `ack_through` runs on the checkpointer's writer thread — the lock
+    keeps ReplayQ's segment bookkeeping consistent between them.
+    """
+
+    def __init__(
+        self,
+        directory: Optional[str],
+        seg_bytes: int = 4 * 1024 * 1024,
+        max_total_bytes: int = 0,
+    ):
+        self.q = ReplayQ(directory, seg_bytes=seg_bytes,
+                         max_total_bytes=max_total_bytes)
+        self._lock = threading.Lock()
+        self._last = 0  # highest seqno this process appended or replayed
+        self.records_appended = 0
+
+    # ------------------------------------------------------------- append
+
+    def append(self, adds: Sequence[str], removes: Sequence[str]) -> int:
+        """Durably log one churn record; returns its seqno."""
+        rec = pack_ops(adds, removes)
+        with self._lock:
+            seq = self.q.append(rec)
+            self._last = seq
+            self.records_appended += 1
+        return seq
+
+    def last_seq(self) -> int:
+        """Watermark for `ack_through`: the newest record whose effect a
+        snapshot captured NOW would contain."""
+        with self._lock:
+            return self._last
+
+    # ------------------------------------------------------------- replay
+
+    def replay(self) -> Iterator[Tuple[List[str], List[str]]]:
+        """Yield every unacked (adds, removes) record, oldest first.
+
+        Records stay ON DISK (popped, not acked): until the next
+        snapshot lands, a second crash replays them again — the
+        at-least-once contract; `apply_churn` replay is convergent
+        (duplicate adds bump refcounts the matching duplicate removes
+        release)."""
+        while True:
+            with self._lock:
+                ref, items = self.q.pop(256)
+                if items:
+                    self._last = max(self._last, ref)
+            if not items:
+                return
+            for rec in items:
+                yield unpack_ops(rec)
+
+    # ---------------------------------------------------------------- ack
+
+    def ack_through(self, seq: int) -> None:
+        """Retire records up to `seq` (a snapshot covering them landed).
+
+        Drains the in-memory view first (appends accumulate there —
+        nothing consumes the queue in steady state) and moves the commit
+        cursor to `seq`; records past the watermark stay on disk unacked
+        and replay after a crash."""
+        with self._lock:
+            while True:
+                _ref, items = self.q.pop(1024)
+                if not items:
+                    break
+            self.q.ack(seq)
+
+    # -------------------------------------------------------------- state
+
+    def pending_bytes(self) -> int:
+        with self._lock:
+            return self.q.pending_bytes()
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return self.q.pending_count()
+
+    def close(self) -> None:
+        with self._lock:
+            self.q.close()

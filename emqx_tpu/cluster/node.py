@@ -19,6 +19,7 @@ static cluster discovery (`emqx_conf_schema.erl:148-230`).
 from __future__ import annotations
 
 import asyncio
+import base64
 import logging
 import random
 import time
@@ -32,6 +33,39 @@ from .routes import RemoteRoutes
 from .transport import PeerLink, RpcError, Transport
 
 log = logging.getLogger("emqx_tpu.cluster")
+
+# Route-snapshot responses at or above this many filters ship a packed
+# zlib blob (checkpoint/store.py pack_filter_blob) instead of a JSON
+# string array — the cluster fast-bootstrap path: a peer that is far
+# behind (restart, long partition) receives one compressed table image
+# rather than a per-filter op replay's worth of JSON.  Below it the
+# plain list is cheaper than the compress+base64 round trip.
+SNAPSHOT_BLOB_MIN = 512
+
+
+def _snapshot_filters(resp: dict) -> List[str]:
+    """Filters from a snapshot response — JSON list or packed blob."""
+    filters = resp.get("filters")
+    if filters is None and resp.get("blob") is not None:
+        from ..checkpoint.store import unpack_filter_blob
+
+        filters = unpack_filter_blob(base64.b64decode(resp["blob"]))
+    return list(filters or ())
+
+
+def _pack_snapshot_filters(resp: dict, filters: List[str]) -> dict:
+    """Attach a filter list to a snapshot response, blob-packed when a
+    peer is far enough behind that a wholesale image beats op replay."""
+    if len(filters) >= SNAPSHOT_BLOB_MIN:
+        from ..checkpoint.store import pack_filter_blob
+
+        resp["blob"] = base64.b64encode(
+            pack_filter_blob(filters)
+        ).decode("ascii")
+        resp["n"] = len(filters)
+    else:
+        resp["filters"] = filters
+    return resp
 
 
 class ClusterBroker(Broker):
@@ -404,7 +438,8 @@ class ClusterNode:
         try:
             resp = await link.request(tp.SNAPSHOT_REQ, {"node": self.name})
             self.remote.load_snapshot(
-                peer, resp["incarnation"], resp["seq"], resp["filters"],
+                peer, resp["incarnation"], resp["seq"],
+                _snapshot_filters(resp),
                 [tuple(x) for x in resp.get("shared", ())],
             )
             if self._status.get(peer) != "up":
@@ -464,7 +499,7 @@ class ClusterNode:
                         origin,
                         resp["incarnation"],
                         resp["seq"],
-                        resp["filters"],
+                        _snapshot_filters(resp),
                         [tuple(x) for x in resp.get("shared", ())],
                     )
                     return
@@ -477,21 +512,25 @@ class ClusterNode:
         inc_seq = self.remote.applied.get(node)
         if inc_seq is None:
             return {"known": False}
-        return {
-            "known": True,
-            "incarnation": inc_seq[0],
-            "seq": inc_seq[1],
-            "filters": sorted(self.remote.filters_of(node)),
-            "shared": self.remote.shared_of(node),
-        }
+        return _pack_snapshot_filters(
+            {
+                "known": True,
+                "incarnation": inc_seq[0],
+                "seq": inc_seq[1],
+                "shared": self.remote.shared_of(node),
+            },
+            sorted(self.remote.filters_of(node)),
+        )
 
     def _on_snapshot_req(self, peer: str, obj: dict) -> dict:
-        return {
-            "incarnation": self.incarnation,
-            "seq": self.seq,
-            "filters": sorted(self._local_filters),
-            "shared": sorted(self._local_shared),
-        }
+        return _pack_snapshot_filters(
+            {
+                "incarnation": self.incarnation,
+                "seq": self.seq,
+                "shared": sorted(self._local_shared),
+            },
+            sorted(self._local_filters),
+        )
 
     # ----------------------------------------------------------- forwarding
 

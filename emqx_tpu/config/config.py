@@ -271,6 +271,31 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
                  "tick N+1 overlaps device compute of tick N and the "
                  "async fetch of tick N-1 (churn-fused ticks drain the "
                  "window and donate the table buffers); 1 = lock-step"),
+        # table checkpoint & warm restart (checkpoint/ subsystem)
+        "ckpt.enable": Field(
+            "bool", False,
+            desc="periodic binary snapshots of the match-table state + a "
+                 "churn write-ahead log; boot restores the newest valid "
+                 "snapshot and replays the WAL tail instead of replaying "
+                 "every filter through add_filters"),
+        "ckpt.dir": Field(
+            "str", "",
+            desc="checkpoint directory (snap/ + wal/); empty = "
+                 "<node.data_dir>/ckpt"),
+        "ckpt.interval": Field(
+            "duration", 60.0,
+            desc="snapshot cadence; a snapshot also fires early when the "
+                 "WAL backlog crosses ckpt.wal_max_bytes"),
+        "ckpt.wal_max_bytes": Field(
+            "bytesize", 64 << 20,
+            desc="WAL-backlog threshold that forces a snapshot between "
+                 "intervals"),
+        "ckpt.keep": Field(
+            "int", 3, min=1,
+            desc="snapshots retained; restore falls back to an older one "
+                 "when the newest fails its CRC frame"),
+        "ckpt.wal_seg_bytes": Field(
+            "bytesize", 4 << 20, desc="WAL segment rotation size"),
     },
     "retainer": {
         "enable": Field("bool", True),

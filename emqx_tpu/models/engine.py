@@ -157,6 +157,11 @@ class TopicMatchEngine:
         self.collision_count = 0
         self.on_collision = None  # fn(topic, fid) — metrics hook
 
+        # checkpoint WAL hook (checkpoint/manager.py): called with
+        # (adds, removes) as each mutation commits to host truth, so a
+        # snapshot + the logged tail always reconstructs this state
+        self.on_churn = None
+
         self.epoch = 0  # bumps on every device-visible mutation
         self._dev: Optional[DeviceTables] = None
         self._dev_stale = True
@@ -228,6 +233,10 @@ class TopicMatchEngine:
         fid = self._fids.get(filt)
         if fid is not None:
             self._refs[fid] += 1
+            if self.on_churn is not None:
+                # refcount bumps must reach the WAL too: every replayed
+                # remove decrements, so every increment must be logged
+                self.on_churn([filt], [])
             return fid
         fid = self._free_fids.pop() if self._free_fids else self._alloc_fid()
         ws = topiclib.words(filt)
@@ -248,6 +257,8 @@ class TopicMatchEngine:
                 self._words[fid] = ws
                 self._fbytes[fid] = filt.encode("utf-8")
         self.epoch += 1
+        if self.on_churn is not None:
+            self.on_churn([filt], [])
         return fid
 
     def add_filters(self, filts: Sequence[str]) -> List[int]:
@@ -302,6 +313,8 @@ class TopicMatchEngine:
                 )
                 self._reg.set_bulk_packed(new_fids, buf, offs)
         self.epoch += 1
+        if self.on_churn is not None:
+            self.on_churn(list(filts), [])
         return fids
 
     def _bulk_alloc(
@@ -392,6 +405,8 @@ class TopicMatchEngine:
                     new_fids, [self._fbytes[f] for f in new_fids]
                 )
         self.epoch += 1
+        if self.on_churn is not None:
+            self.on_churn(list(filts), [])
         return fids
 
     def remove_filter(self, filt: str) -> Optional[int]:
@@ -401,6 +416,8 @@ class TopicMatchEngine:
             return None
         self._refs[fid] -= 1
         if self._refs[fid] > 0:
+            if self.on_churn is not None:
+                self.on_churn([], [filt])  # refcount decrement: log it
             return None
         del self._refs[fid]
         del self._fids[filt]
@@ -415,6 +432,8 @@ class TopicMatchEngine:
                 self._reg.del_bulk([fid])
         self._free_fids.append(fid)
         self.epoch += 1
+        if self.on_churn is not None:
+            self.on_churn([], [filt])
         return fid
 
     def apply_churn(
@@ -564,6 +583,8 @@ class TopicMatchEngine:
             else:
                 self.tables.churn_insert(new_strs, new_fids, words=new_words)
         self.epoch += 1
+        if self.on_churn is not None:
+            self.on_churn(list(adds), list(removes))
         # churn-apply lag: host-truth apply duration, surfaced per tick
         # by the flight recorder until the next apply supersedes it
         dt = time.monotonic() - t0
@@ -586,6 +607,119 @@ class TopicMatchEngine:
     @property
     def n_filters(self) -> int:
         return len(self._fids)
+
+    # --------------------------------------------------------- checkpoint
+
+    def ref_snapshot(self) -> Dict[str, int]:
+        """filter -> refcount copy (checkpoint reconcile, tests)."""
+        refs = self._refs
+        return {f: refs[fid] for f, fid in self._fids.items()}
+
+    def export_checkpoint(self) -> Tuple[Dict[str, np.ndarray], dict]:
+        """Host truth as (named arrays, JSON meta) for the snapshot
+        store: the table state (`MatchTables.export_state`) plus the
+        packed filter registry (strings, fids, refcounts, deep flags,
+        free list).  Everything is copied/serialized at capture time so
+        the writer thread never races live mutations."""
+        from ..checkpoint.store import pack_nul_list
+
+        arrays: Dict[str, np.ndarray] = {}
+        t_arr, t_meta = self.tables.export_state()
+        for k, v in t_arr.items():
+            arrays["tab/" + k] = v
+        filts = list(self._fids)
+        fids = np.fromiter(
+            (self._fids[f] for f in filts), dtype=np.int64, count=len(filts)
+        )
+        refs = np.fromiter(
+            (self._refs[int(i)] for i in fids), dtype=np.int64,
+            count=len(filts),
+        )
+        deep = np.fromiter(
+            (int(i) in self._deep_fids for i in fids), dtype=bool,
+            count=len(filts),
+        )
+        arrays.update({
+            "reg/nul": pack_nul_list(filts), "reg/fid": fids,
+            "reg/ref": refs, "reg/deep": deep,
+            "reg/free": np.asarray(self._free_fids, dtype=np.int64),
+        })
+        meta = {
+            "kind": "engine",
+            "tables": t_meta,
+            "max_levels": self.space.max_levels,
+            "next_fid": self._next_fid,
+            "n_filters": len(filts),
+        }
+        return arrays, meta
+
+    def restore_checkpoint(
+        self, arrays: Dict[str, np.ndarray], meta: dict
+    ) -> int:
+        """Adopt a snapshot wholesale: table arrays + registries, no
+        re-hashing and no placement — restore cost is array adoption,
+        dict zips and one registry bulk-set, and the device mirror is
+        marked rebuilt so the next dispatch ships ONE bulk upload."""
+        from ..checkpoint.store import nul_to_packed, unpack_nul_list
+        from ..ops import native as _native
+
+        if meta.get("kind") != "engine":
+            raise ValueError(f"snapshot kind {meta.get('kind')!r} is not "
+                             "a single-chip engine checkpoint")
+        tables = MatchTables.from_state(
+            self.space,
+            {k[4:]: v for k, v in arrays.items() if k.startswith("tab/")},
+            meta["tables"],
+        )
+        n_filts = int(meta["n_filters"])
+        filts = unpack_nul_list(arrays["reg/nul"], n_filts)
+        fids = arrays["reg/fid"].tolist()
+        refs = arrays["reg/ref"].tolist()
+        deep = arrays["reg/deep"]
+        self.tables = tables
+        self._fids = dict(zip(filts, fids))
+        self._refs = dict(zip(fids, refs))
+        self._next_fid = int(meta["next_fid"])
+        self._free_fids = arrays["reg/free"].tolist()
+        self._words = {}
+        self._fbytes = {}
+        self._deep = CpuTrieIndex()
+        self._deep_fids = set()
+        self._reg = _native.make_registry()  # fresh: drop stale entries
+        if deep.any():
+            for k in np.nonzero(deep)[0].tolist():
+                filt, fid = filts[k], fids[k]
+                ws = topiclib.words(filt)
+                self._words[fid] = ws
+                self._fbytes[fid] = filt.encode("utf-8")
+                self._deep.insert(filt, fid)
+                self._deep_fids.add(fid)
+            shallow = np.nonzero(~deep)[0].tolist()
+            sh_fids = [fids[k] for k in shallow]
+            sh_strs = [filts[k] for k in shallow]
+            if self._reg is not None:
+                self._reg.set_bulk(
+                    sh_fids, [s.encode("utf-8") for s in sh_strs]
+                )
+            else:
+                for f, fid in zip(sh_strs, sh_fids):
+                    self._words[fid] = topiclib.words(f)
+                    self._fbytes[fid] = f.encode("utf-8")
+        elif self._reg is not None:
+            if len(filts):
+                # vectorized NUL-strip: the blob becomes the registry
+                # wire format without re-encoding any string
+                buf, offs = nul_to_packed(arrays["reg/nul"], n_filts)
+                self._reg.set_bulk_packed(fids, buf, offs)
+        else:
+            for f, fid in zip(filts, fids):
+                self._words[fid] = topiclib.words(f)
+                self._fbytes[fid] = f.encode("utf-8")
+        self._dev = None  # mirror must rebuild from the restored truth
+        self._dev_stale = True
+        self._probe = None
+        self.epoch += 1
+        return len(filts)
 
     # --------------------------------------------------------------- sync
 

@@ -124,6 +124,51 @@ class RetainedDeviceIndex:
         self._free.extend(range(self.cap - 1, old - 1, -1))
         self._dirty = None  # shapes changed: full re-upload
 
+    # --------------------------------------------------------- checkpoint
+
+    def export_state(self):
+        """(named arrays, meta) for the checkpoint store: term rows plus
+        the packed name list (slot-aligned), copied at capture time."""
+        from ..checkpoint.store import pack_str_list
+
+        slots = sorted(self._slot_of.values())
+        names = [self._topics[s] for s in slots]
+        buf, offs = pack_str_list(names)
+        arrays = {
+            "ta": self.ta.copy(), "tb": self.tb.copy(),
+            "ln": self.ln.copy(), "dl": self.dl.copy(),
+            "slots": np.asarray(slots, dtype=np.int64),
+            "buf": buf, "offs": offs,
+        }
+        return arrays, {"cap": self.cap, "max_levels": self.space.max_levels}
+
+    def from_state(self, arrays, meta) -> int:
+        """Adopt a snapshot wholesale (no re-hashing); the device copy
+        is marked for a full re-upload on the next lookup."""
+        from ..checkpoint.store import unpack_str_list
+
+        if int(meta["max_levels"]) != self.space.max_levels:
+            raise ValueError("retained snapshot max_levels mismatch")
+        self.cap = int(meta["cap"])
+        self.ta = arrays["ta"]
+        self.tb = arrays["tb"]
+        self.ln = arrays["ln"]
+        self.dl = arrays["dl"]
+        names = unpack_str_list(arrays["buf"], arrays["offs"])
+        slots = arrays["slots"].tolist()
+        self._topics = [None] * self.cap
+        self._slot_of = {}
+        for name, slot in zip(names, slots):
+            self._topics[slot] = name
+            self._slot_of[name] = slot
+        occupied = set(slots)
+        self._free = [
+            i for i in range(self.cap - 1, -1, -1) if i not in occupied
+        ]
+        self._dev = None
+        self._dirty = None  # full re-upload
+        return len(names)
+
     # --------------------------------------------------------------- sync
 
     def _sync(self):

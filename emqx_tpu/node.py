@@ -317,6 +317,29 @@ class NodeRuntime:
             },
         )
 
+        # ---- table checkpoint & warm restart (checkpoint/) ---------------
+        # periodic binary snapshots of the engine's table state + a churn
+        # WAL; boot restores the newest valid snapshot and replays the
+        # WAL tail instead of replaying every filter through add_filters
+        self.ckpt = None
+        if self.conf.get("engine.ckpt.enable"):
+            from .checkpoint.manager import CheckpointManager
+
+            cdir = self.conf.get("engine.ckpt.dir") or os.path.join(
+                self.conf.get("node.data_dir"), "ckpt"
+            )
+            self.ckpt = CheckpointManager(
+                self.broker.engine,
+                cdir,
+                interval=self.conf.get("engine.ckpt.interval"),
+                wal_max_bytes=self.conf.get("engine.ckpt.wal_max_bytes"),
+                keep=self.conf.get("engine.ckpt.keep"),
+                wal_seg_bytes=self.conf.get("engine.ckpt.wal_seg_bytes"),
+                retained_index=retain_index,
+                metrics=self.broker.metrics,
+                alarms=self.alarms,
+            )
+
         # ---- rule engine (emqx_rule_engine) ------------------------------
         from .rules.engine import RuleEngine, build_outputs
 
@@ -683,6 +706,16 @@ class NodeRuntime:
                 except Exception:
                     pass
                 eng = self.broker.engine
+                # restore-before-warmup: adopt the newest table snapshot
+                # + WAL tail FIRST, so the warmup matches below ship the
+                # restored tables to the device as ONE bulk upload (the
+                # cold path replays every filter via add_filters instead)
+                if self.ckpt is not None:
+                    n_restored = self.ckpt.restore()
+                    if n_restored:
+                        log.info(
+                            "engine warm restart: %d filters", n_restored
+                        )
                 # warm the DEVICE kernels even when hybrid arbitration
                 # would route these matches host-side
                 hybrid = getattr(eng, "hybrid", False)
@@ -711,10 +744,19 @@ class NodeRuntime:
             await asyncio.to_thread(_warm)
             if self.persistence is not None:
                 # reload parked sessions (+ their routes) before serving;
-                # expired entries are GC'd by restore()
+                # expired entries are GC'd by restore().  With warm
+                # tables every re-subscribe is a refcount bump, not a
+                # hash+placement.
                 n = self.persistence.restore()
                 if n:
                     log.info("restored %d persistent sessions", n)
+                if self.ckpt is not None:
+                    # sessions are the authority on which subscriptions
+                    # still exist: release the checkpoint's references
+                    # (filters whose sessions expired while down drop
+                    # out of the table; re-subscribed ones keep exactly
+                    # their session refs)
+                    await asyncio.to_thread(self.ckpt.reconcile_sessions)
             if self.cluster is not None:
                 await self.cluster.start()
             if self.bridges is not None:
@@ -803,6 +845,12 @@ class NodeRuntime:
             await asyncio.to_thread(self.exhook.stop)
         if self.persistence is not None:
             self.persistence.tick()  # final dirty-page flush
+        if self.ckpt is not None:
+            try:
+                self.ckpt.checkpoint()  # final snapshot: clean WAL handoff
+            except Exception:
+                log.exception("final engine checkpoint")
+            self.ckpt.close()
         if self.broker.retainer.store is not None:
             self.broker.retainer.store.close()
         self.delayed.close()
@@ -850,6 +898,11 @@ class NodeRuntime:
                 if now - last_msg >= msg_ivl:
                     last_msg = now
                     self.sys_heartbeat.tick_msgs()
+                if self.ckpt is not None and self.ckpt.due():
+                    # capture on the loop (serialized with engine
+                    # mutations); serialize + fsync on a worker thread
+                    payload = self.ckpt.capture()
+                    await asyncio.to_thread(self.ckpt.write, payload)
             except Exception:
                 log.exception("node ticker")
 

@@ -54,6 +54,11 @@ KNOWN_KINDS: Dict[str, str] = {
     "engine.churn": "one apply_churn batch applied to host truth",
     "engine.pipeline": "dispatch-window event (drain / window-full)",
     "engine.kcap": "adaptive compact-return cap shrank toward traffic",
+    # table checkpoint & warm restart (checkpoint/ subsystem)
+    "engine.ckpt.save": "table snapshot persisted; WAL acked to watermark",
+    "engine.ckpt.restore": "warm restart: snapshot loaded + WAL tail replayed",
+    "engine.ckpt.fallback": "newest snapshot corrupt; older one restored",
+    "engine.ckpt.wal": "churn record appended to the write-ahead log",
 }
 
 

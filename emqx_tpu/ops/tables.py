@@ -620,6 +620,89 @@ class MatchTables:
         self.delta = Delta()
         return d
 
+    # ------------------------------------------------------- checkpoint
+
+    _STATE_ARRAYS = (
+        "key_a", "key_b", "val", "incl", "k_a", "k_b", "min_len",
+        "max_len", "wild_root", "valid", "ent_ha", "ent_hb", "ent_desc",
+    )
+
+    def export_state(self) -> Tuple[Dict[str, np.ndarray], dict]:
+        """Snapshot the full host truth as (named arrays, JSON meta) for
+        `checkpoint/store.py`.  Arrays are COPIED at capture time: the
+        serializer may run on a writer thread while churn keeps mutating
+        the live arrays in place."""
+        arrays = {name: getattr(self, name).copy()
+                  for name in self._STATE_ARRAYS}
+        n = len(self._shapes)
+        shp_plen = np.zeros(n, dtype=np.int32)
+        shp_mask = np.zeros(n, dtype=np.uint64)
+        shp_hash = np.zeros(n, dtype=bool)
+        shp_idx = np.zeros(n, dtype=np.int32)
+        shp_rc = np.zeros(n, dtype=np.int64)
+        for j, (shape, (idx, rc)) in enumerate(self._shapes.items()):
+            shp_plen[j] = shape.plen
+            shp_mask[j] = shape.plus_mask
+            shp_hash[j] = shape.has_hash
+            shp_idx[j] = idx
+            shp_rc[j] = rc
+        arrays.update(
+            shp_plen=shp_plen, shp_mask=shp_mask, shp_hash=shp_hash,
+            shp_idx=shp_idx, shp_rc=shp_rc,
+        )
+        meta = {
+            "log2cap": self.log2cap,
+            "desc_cap": self.desc_cap,
+            "n_entries": self.n_entries,
+            "max_levels": self.space.max_levels,
+        }
+        return arrays, meta
+
+    @classmethod
+    def from_state(cls, space, arrays: Dict[str, np.ndarray],
+                   meta: dict) -> "MatchTables":
+        """Rebuild a MatchTables wholesale from a snapshot — array
+        adoption plus shape-registry reconstruction, no re-hashing and
+        no placement.  The delta is marked rebuilt so the next
+        `sync_device` ships one bulk upload."""
+        from .hashing import Shape
+
+        if int(meta["max_levels"]) != space.max_levels:
+            raise ValueError(
+                "snapshot max_levels %s != engine %d — table keys are "
+                "not portable across level caps"
+                % (meta["max_levels"], space.max_levels)
+            )
+        t = cls.__new__(cls)
+        t.space = space
+        t.log2cap = int(meta["log2cap"])
+        t.desc_cap = int(meta["desc_cap"])
+        t.n_entries = int(meta["n_entries"])
+        for name in cls._STATE_ARRAYS:
+            setattr(t, name, arrays[name])
+        if len(t.key_a) != (1 << t.log2cap):
+            raise ValueError("snapshot table size != 2**log2cap")
+        if t.incl.shape != (t.desc_cap, space.max_levels):
+            raise ValueError("snapshot descriptor block shape mismatch")
+        t._ent_cap = len(t.ent_ha)
+        t._shapes = {}
+        t._desc_shape = [None] * t.desc_cap
+        for plen, mask, hsh, idx, rc in zip(
+            arrays["shp_plen"].tolist(), arrays["shp_mask"].tolist(),
+            arrays["shp_hash"].tolist(), arrays["shp_idx"].tolist(),
+            arrays["shp_rc"].tolist(),
+        ):
+            shape = Shape(plen=int(plen), plus_mask=int(mask),
+                          has_hash=bool(hsh))
+            t._shapes[shape] = (int(idx), int(rc))
+            t._desc_shape[int(idx)] = shape
+        t._free_desc = [
+            i for i in range(t.desc_cap - 1, -1, -1)
+            if t._desc_shape[i] is None
+        ]
+        t.delta = Delta(rebuilt=True, desc_dirty=True)
+        return t
+
     def device_arrays(self) -> Dict[str, np.ndarray]:
         """The full array set to mirror into HBM."""
         return {

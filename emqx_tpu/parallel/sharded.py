@@ -415,6 +415,10 @@ class ShardedMatchEngine:
         self._next_fid = 0
         self._free_fids: List[int] = []
 
+        # checkpoint WAL hook (checkpoint/manager.py), same contract as
+        # the single-chip engine: (adds, removes) per committed mutation
+        self.on_churn = None
+
         # exact-match guarantee (same contract as TopicMatchEngine)
         self.verify_matches = True
         self.collision_count = 0
@@ -478,6 +482,8 @@ class ShardedMatchEngine:
         fid = self._fids.get(filt)
         if fid is not None:
             self._refs[fid] += 1
+            if self.on_churn is not None:
+                self.on_churn([filt], [])  # refcount bumps reach the WAL
             return fid
         fid = self._free_fids[-1] if self._free_fids else self._next_fid
         ws = topiclib.words(filt)
@@ -506,6 +512,8 @@ class ShardedMatchEngine:
             self._dest = nd
         self._dest[fid] = sub_shard if sub_shard is not None else fid % self.n_sub
         self._dest_dirty = True
+        if self.on_churn is not None:
+            self.on_churn([filt], [])
         return fid
 
     def add_filters(
@@ -611,6 +619,8 @@ class ShardedMatchEngine:
             self._reg.set_bulk(reg_fids, reg_blobs)
         if plan:
             self._dest_dirty = True
+        if self.on_churn is not None:
+            self.on_churn(list(filts), [])
         return fids
 
     def apply_churn(
@@ -653,6 +663,10 @@ class ShardedMatchEngine:
                 dead_all.extend(fl)
         if dead_all and self._reg is not None:
             self._reg.del_bulk(dead_all)
+        if self.on_churn is not None and removes:
+            # the adds side is logged by add_filters below; removes are
+            # applied inline above, so log them first (apply order)
+            self.on_churn([], list(removes))
         out = self.add_filters(adds, churn=True)
         dt = time.monotonic() - t0
         self._churn_lag = dt
@@ -667,6 +681,8 @@ class ShardedMatchEngine:
             return None
         self._refs[fid] -= 1
         if self._refs[fid] > 0:
+            if self.on_churn is not None:
+                self.on_churn([], [filt])  # log the refcount decrement
             return None
         del self._refs[fid]
         del self._fids[filt]
@@ -680,11 +696,132 @@ class ShardedMatchEngine:
             if self._reg is not None:
                 self._reg.del_bulk([fid])
         self._free_fids.append(fid)
+        if self.on_churn is not None:
+            self.on_churn([], [filt])
         return fid
 
     @property
     def n_filters(self) -> int:
         return len(self._fids)
+
+    # --------------------------------------------------------- checkpoint
+
+    def ref_snapshot(self) -> Dict[str, int]:
+        """filter -> refcount copy (checkpoint reconcile, tests)."""
+        refs = self._refs
+        return {f: refs[fid] for f, fid in self._fids.items()}
+
+    def export_checkpoint(self):
+        """Host truth as (named arrays, meta): one per-shard table block
+        each (`tab<d>/...`) plus the global registry + dest map — one
+        snapshot file carries every shard, restored as a unit."""
+        from ..checkpoint.store import pack_nul_list
+
+        arrays: Dict[str, np.ndarray] = {}
+        shard_metas = []
+        for d, t in enumerate(self.shards):
+            t_arr, t_meta = t.export_state()
+            for k, v in t_arr.items():
+                arrays[f"tab{d}/{k}"] = v
+            shard_metas.append(t_meta)
+        filts = list(self._fids)
+        fids = np.fromiter(
+            (self._fids[f] for f in filts), dtype=np.int64, count=len(filts)
+        )
+        refs = np.fromiter(
+            (self._refs[int(i)] for i in fids), dtype=np.int64,
+            count=len(filts),
+        )
+        deep = np.fromiter(
+            (int(i) in self._deep_fids for i in fids), dtype=bool,
+            count=len(filts),
+        )
+        arrays.update({
+            "reg/nul": pack_nul_list(filts), "reg/fid": fids,
+            "reg/ref": refs, "reg/deep": deep,
+            "reg/free": np.asarray(self._free_fids, dtype=np.int64),
+            "reg/dest": self._dest.copy(),
+        })
+        meta = {
+            "kind": "sharded",
+            "n_devices": self.D,
+            "n_sub": self.n_sub,
+            "shards": shard_metas,
+            "max_levels": self.space.max_levels,
+            "next_fid": self._next_fid,
+            "n_filters": len(filts),
+        }
+        return arrays, meta
+
+    def restore_checkpoint(self, arrays, meta) -> int:
+        """Adopt a sharded snapshot wholesale; the stacked device mirror
+        is dropped so the next dispatch restacks from the restored
+        shards in one upload."""
+        from ..checkpoint.store import nul_to_packed, unpack_nul_list
+        from ..ops import native as _native
+
+        if meta.get("kind") != "sharded":
+            raise ValueError(f"snapshot kind {meta.get('kind')!r} is not "
+                             "a sharded engine checkpoint")
+        if int(meta["n_devices"]) != self.D:
+            raise ValueError(
+                "snapshot has %s shards, mesh has %d — fid %% D "
+                "partitioning is not portable" % (meta["n_devices"], self.D)
+            )
+        shards = [
+            MatchTables.from_state(
+                self.space,
+                {k.split("/", 1)[1]: v for k, v in arrays.items()
+                 if k.startswith(f"tab{d}/")},
+                meta["shards"][d],
+            )
+            for d in range(self.D)
+        ]
+        n_filts = int(meta["n_filters"])
+        filts = unpack_nul_list(arrays["reg/nul"], n_filts)
+        fids = arrays["reg/fid"].tolist()
+        refs = arrays["reg/ref"].tolist()
+        deep = arrays["reg/deep"]
+        self.shards = shards
+        self._fids = dict(zip(filts, fids))
+        self._refs = dict(zip(fids, refs))
+        self._next_fid = int(meta["next_fid"])
+        self._free_fids = arrays["reg/free"].tolist()
+        self.n_sub = int(meta["n_sub"])
+        self._dest = arrays["reg/dest"]
+        self._dest_cap = len(self._dest)
+        self._dest_dirty = True
+        self._words = {}
+        self._fbytes = {}
+        self._deep = CpuTrieIndex()
+        self._deep_fids = set()
+        self._reg = _native.make_registry()  # fresh: drop stale entries
+        if not deep.any() and self._reg is not None:
+            if n_filts:
+                buf, offs = nul_to_packed(arrays["reg/nul"], n_filts)
+                self._reg.set_bulk_packed(fids, buf, offs)
+        else:
+            reg_fids: List[int] = []
+            reg_blobs: List[bytes] = []
+            for k, (filt, fid) in enumerate(zip(filts, fids)):
+                if bool(deep[k]):
+                    self._words[fid] = topiclib.words(filt)
+                    self._fbytes[fid] = filt.encode("utf-8")
+                    self._deep.insert(filt, fid)
+                    self._deep_fids.add(fid)
+                elif self._reg is not None:
+                    reg_fids.append(fid)
+                    reg_blobs.append(filt.encode("utf-8"))
+                else:
+                    self._words[fid] = topiclib.words(filt)
+                    self._fbytes[fid] = filt.encode("utf-8")
+            if self._reg is not None and reg_fids:
+                self._reg.set_bulk(reg_fids, reg_blobs)
+        self._stacked = None  # restack from restored shards on next sync
+        self._dest_dev = None
+        self._inflight = []
+        self._staging = {}
+        return len(filts)
 
     # --------------------------------------------------------------- sync
 

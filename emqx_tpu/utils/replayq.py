@@ -241,6 +241,22 @@ class ReplayQ:
     def count(self) -> int:
         return len(self._items)
 
+    def pending_count(self) -> int:
+        """Appended-but-unacked records (including popped-unacked ones) —
+        the durable backlog a consumer still owes an ack for.  The churn
+        WAL's snapshot threshold reads this (`checkpoint/manager.py`)."""
+        return max(0, self._next_seq - 1 - self._acked)
+
+    def pending_bytes(self) -> int:
+        """Byte size of the unacked backlog.  Disk mode reports the live
+        segment bytes (tracked incrementally; includes acked records in
+        a partially-acked segment — an upper bound, which is the safe
+        direction for a flush threshold).  Memory-only mode sums the
+        queued payloads."""
+        if self.dir is not None:
+            return self._disk_bytes
+        return sum(len(item) for _seq, item in self._items)
+
     def close(self) -> None:
         if self._cur is not None:
             self._cur.close()

@@ -129,6 +129,39 @@ def test_commit_file_atomic(tmp_path):
     q.close()
 
 
+def test_pending_accessors(tmp_path):
+    """Public backlog accessors (the churn WAL's snapshot threshold in
+    checkpoint/manager.py reads these)."""
+    # memory-only: pending follows the queued payloads
+    q = ReplayQ()
+    assert q.pending_count() == 0 and q.pending_bytes() == 0
+    q.append(b"abc")
+    q.append(b"defgh")
+    assert q.pending_count() == 2
+    assert q.pending_bytes() == 8
+    ref, _ = q.pop(1)
+    assert q.pending_count() == 2  # popped-but-unacked still pending
+    q.ack(ref)
+    assert q.pending_count() == 1
+
+    # disk mode: bytes track the live segments, survive reopen
+    d = str(tmp_path / "q")
+    q2 = ReplayQ(d)
+    for i in range(5):
+        q2.append(b"x" * 100)
+    assert q2.pending_count() == 5
+    assert q2.pending_bytes() >= 500  # payload + record headers
+    q2.close()
+    q3 = ReplayQ(d)
+    assert q3.pending_count() == 5
+    assert q3.pending_bytes() >= 500
+    ref, items = q3.pop(5)
+    q3.ack(ref)
+    assert q3.pending_count() == 0
+    assert q3.pending_bytes() == 0  # fully-acked segments reclaimed
+    q3.close()
+
+
 # ------------------------------------------------------ durable bridge
 
 

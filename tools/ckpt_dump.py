@@ -1,0 +1,125 @@
+"""Inspect an engine checkpoint directory (snapshots + churn WAL).
+
+Usage:
+    python tools/ckpt_dump.py <ckpt-dir>          # <data_dir>/ckpt
+    python tools/ckpt_dump.py <file.ckpt>         # one snapshot file
+    python tools/ckpt_dump.py <ckpt-dir> --wal 5  # decode 5 WAL records
+
+Prints, per snapshot (newest first): seq, size, frame verdict
+(ok/corrupt), the meta block (kind, filter count, WAL watermark, wall
+time), per-shard table occupancy, and the largest arrays by size.  For
+the WAL: record/byte backlog and a peek at the oldest records.  Reads
+only — safe against a live node's directory (snapshots are immutable
+once renamed in; the WAL peek uses the same torn-tail-tolerant reader
+as recovery).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from emqx_tpu.checkpoint.store import SnapshotStore, SnapshotError  # noqa: E402
+from emqx_tpu.checkpoint.wal import unpack_ops  # noqa: E402
+from emqx_tpu.utils.replayq import ReplayQ  # noqa: E402
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024.0
+    return f"{n} B"
+
+
+def dump_snapshot(path: str, top: int = 8) -> None:
+    size = os.path.getsize(path)
+    try:
+        arrays, meta = SnapshotStore.load_file(path)
+    except SnapshotError as e:
+        print(f"{os.path.basename(path)}  {_fmt_bytes(size)}  CORRUPT: {e}")
+        return
+    wall = meta.get("wall_time")
+    when = (
+        datetime.datetime.fromtimestamp(wall).isoformat(timespec="seconds")
+        if wall else "?"
+    )
+    print(f"{os.path.basename(path)}  {_fmt_bytes(size)}  ok")
+    print(f"  kind={meta.get('kind')}  filters={meta.get('n_filters')}  "
+          f"wal_seq={meta.get('wal_seq')}  next_fid={meta.get('next_fid')}  "
+          f"taken={when}")
+    if meta.get("kind") == "engine":
+        t = meta.get("tables", {})
+        print(f"  tables: n_entries={t.get('n_entries'):,} "
+              f"log2cap={t.get('log2cap')} desc_cap={t.get('desc_cap')} "
+              f"max_levels={t.get('max_levels')}")
+    elif meta.get("kind") == "sharded":
+        occ = [s.get("n_entries", 0) for s in meta.get("shards", [])]
+        print(f"  shards: {len(occ)} x log2cap="
+              f"{[s.get('log2cap') for s in meta.get('shards', [])][:1]}"
+              f" entries={occ} (total {sum(occ):,})")
+    if meta.get("retained") is not None:
+        print(f"  retained index: cap={meta['retained'].get('cap')}")
+    by_size = sorted(arrays.items(), key=lambda kv: -kv[1].nbytes)[:top]
+    for name, arr in by_size:
+        print(f"    {name:<16} {str(arr.dtype):<8} {str(arr.shape):<18} "
+              f"{_fmt_bytes(arr.nbytes)}")
+
+
+def dump_wal(wal_dir: str, peek: int = 3) -> None:
+    if not os.path.isdir(wal_dir):
+        print("wal: (no directory)")
+        return
+    q = ReplayQ(wal_dir)
+    try:
+        print(f"wal: {q.pending_count():,} record(s) pending, "
+              f"{_fmt_bytes(q.pending_bytes())} on disk, "
+              f"acked through seq {q._acked}")
+        shown = 0
+        while shown < peek:
+            _ref, items = q.pop(1)
+            if not items:
+                break
+            try:
+                adds, removes = unpack_ops(items[0])
+                print(f"  record: +{len(adds)} -{len(removes)}"
+                      + (f"  (e.g. +{adds[0]!r})" if adds else "")
+                      + (f" (-{removes[0]!r})" if removes else ""))
+            except (ValueError, UnicodeDecodeError) as e:
+                print(f"  record: undecodable ({e})")
+            shown += 1
+    finally:
+        q.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="checkpoint dir (snap/ + wal/) or one "
+                                 ".ckpt file")
+    ap.add_argument("--wal", type=int, default=3, metavar="N",
+                    help="WAL records to peek at (default 3)")
+    ap.add_argument("--top", type=int, default=8, metavar="N",
+                    help="largest arrays to list per snapshot")
+    ns = ap.parse_args()
+    if os.path.isfile(ns.path):
+        dump_snapshot(ns.path, top=ns.top)
+        return 0
+    snap_dir = os.path.join(ns.path, "snap")
+    if not os.path.isdir(snap_dir):
+        snap_dir = ns.path  # maybe pointed straight at snap/
+    store = SnapshotStore(snap_dir)
+    snaps = store.list()
+    if not snaps:
+        print(f"no snapshots under {snap_dir}")
+    for _seq, path in snaps:
+        dump_snapshot(path, top=ns.top)
+    dump_wal(os.path.join(ns.path, "wal"), peek=ns.wal)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
