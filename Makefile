@@ -1,7 +1,7 @@
 # Build/check entry points (the reference's `make` + rebar gates analog:
 # /root/reference/Makefile, rebar.config:16-36 dialyzer/xref/elvis).
 
-.PHONY: check lint test test-fast native bench restore-bench
+.PHONY: check lint test test-fast native bench restore-bench chaos
 
 # static-analysis gate: stdlib implementation (mypy/ruff are not in this
 # image and installs are off-limits — see tools/check.py header)
@@ -27,3 +27,9 @@ bench:
 # 100k filters; writes the restore_ms/rebuild_ms row into BENCH_TABLE.md
 restore-bench:
 	python bench.py --restore
+
+# multi-seed chaos soak: 3-node cluster + hybrid engine under a seeded
+# fault schedule; asserts no QoS1 forward loss, engine/oracle parity,
+# breaker + alarm lifecycle, spool drain (tools/chaos_soak.py)
+chaos:
+	python tools/chaos_soak.py --seeds 5

@@ -273,6 +273,7 @@ class Broker:
         c["engine.path_flips"] = getattr(e, "path_flips", 0)
         c["engine.verify_mismatch"] = getattr(e, "collision_count", 0)
         c["engine.probes"] = getattr(e, "probe_count", 0)
+        c["engine.breaker_trips"] = getattr(e, "breaker_trips", 0)
 
     # ---------------------------------------------------------- publish
 

@@ -76,6 +76,14 @@ PREDEFINED = [
     "engine.ckpt.save_failures",
     "engine.ckpt.restores",
     "engine.ckpt.wal_records",
+    # self-healing cluster data plane (cluster/node.py forward spool)
+    "messages.forward.spooled",
+    "messages.forward.replayed",
+    "messages.forward.spool_dropped",
+    "messages.forward.dup_dropped",
+    # engine device breaker (models/engine.py; synced like the rest of
+    # the engine.* counters by Broker.sync_engine_metrics)
+    "engine.breaker_trips",
 ]
 
 

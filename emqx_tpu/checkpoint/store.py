@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import fault as _fault
 from ..observe.tracepoints import tp
 
 MAGIC = b"ETPUSNAP"
@@ -234,6 +235,7 @@ class SnapshotStore:
     def save(self, arrays: Dict[str, np.ndarray], meta: dict) -> str:
         """Write one snapshot atomically; prune past keep-K.  Returns
         the snapshot path."""
+        _fault.inject("ckpt.write", err=OSError)
         payload = _serialize(arrays, meta)
         hdr = _HDR.pack(MAGIC, VERSION, zlib.crc32(payload), len(payload))
         existing = self.list()
@@ -279,6 +281,11 @@ class SnapshotStore:
     @staticmethod
     def load_file(path: str) -> Tuple[Dict[str, np.ndarray], dict]:
         """Parse + verify one snapshot file; SnapshotError on damage."""
+        a = _fault.inject("ckpt.read", err=False)
+        if a is not None and a.kind != "delay":
+            # any injected damage surfaces as a frame-check failure, the
+            # exact path load_newest's older-snapshot fallback handles
+            raise SnapshotError(f"fault injected at ckpt.read ({a.kind})")
         try:
             with open(path, "rb") as f:
                 data = f.read()

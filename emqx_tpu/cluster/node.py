@@ -23,16 +23,27 @@ import base64
 import logging
 import random
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from .. import fault as _fault
 from ..broker.broker import Broker
 from ..broker.message import Message
+from ..observe.tracepoints import tp as tracept
+from ..utils.replayq import ReplayQ
 from . import bpapi
 from . import transport as tp
 from .routes import RemoteRoutes
 from .transport import PeerLink, RpcError, Transport
 
 log = logging.getLogger("emqx_tpu.cluster")
+
+# receiver-side forward dedup window: (mid, group, filt) keys of the
+# most recent dispatched QoS>=1 forwards.  Replayed/retried frames
+# (header "replay": true) that hit the window are acked but not
+# re-dispatched, so at-least-once spool replay turns into exactly-once
+# delivery at the receiving broker.
+DEDUP_WINDOW = 8192
 
 # Route-snapshot responses at or above this many filters ship a packed
 # zlib blob (checkpoint/store.py pack_filter_blob) instead of a JSON
@@ -152,6 +163,12 @@ class ClusterNode:
         discovery_ivl: float = 5.0,
         advertise_host: Optional[str] = None,  # dial-back address when
         # the bind host (e.g. 0.0.0.0) is not routable from peers
+        route_hold: float = 5.0,  # keep a down peer's routes this long
+        # before purging (transient flaps spool + replay instead of
+        # losing QoS>=1 forwards to a purged route table)
+        spool_max_bytes: int = 8 << 20,  # per-peer forward-spool bound
+        reconnect_ivl: float = 0.5,  # PeerLink backoff base
+        reconnect_max: float = 15.0,  # PeerLink backoff ceiling
     ):
         assert role in ("core", "replicant"), role
         self.advertise_host = advertise_host
@@ -170,6 +187,25 @@ class ClusterNode:
         self.heartbeat_ivl = heartbeat_ivl
         self.miss_limit = miss_limit
         self.rpc_mode = rpc_mode
+        self.route_hold = float(route_hold)
+        self.spool_max_bytes = int(spool_max_bytes)
+        self.reconnect_ivl = float(reconnect_ivl)
+        self.reconnect_max = float(reconnect_max)
+
+        # per-peer forward spool (replayq-backed): QoS>=1 forwards that
+        # could not ride the wire wait here, bounded by spool_max_bytes
+        # with drop-oldest overflow, and replay (acked, msgid-deduped on
+        # the receiver) when the peer heals
+        self._spools: Dict[str, ReplayQ] = {}
+        self._spool_bytes: Dict[str, int] = {}
+        self.spool_dropped = 0  # records lost to the overflow bound
+        self.replay_timeout = 5.0  # per-record ack wait during replay
+        self._replay_tasks: Dict[str, asyncio.Task] = {}
+        self._purge_tasks: Dict[str, asyncio.Task] = {}
+        self._stopping = False
+        self._seen_fwd: "OrderedDict[Tuple[str, str, str], bool]" = (
+            OrderedDict()
+        )
 
         # local route oplog (this node is its single writer)
         self.seq = 0
@@ -232,7 +268,13 @@ class ClusterNode:
             )
 
     async def stop(self) -> None:
-        for task in (self._hb_task, self._disc_task):
+        self._stopping = True
+        tasks = [self._hb_task, self._disc_task]
+        tasks += list(self._purge_tasks.values())
+        tasks += list(self._replay_tasks.values())
+        self._purge_tasks.clear()
+        self._replay_tasks.clear()
+        for task in tasks:
             if task:
                 task.cancel()
                 try:
@@ -242,6 +284,8 @@ class ClusterNode:
         for link in self.links.values():
             await link.stop()
         await self.transport.stop()
+        for q in self._spools.values():
+            q.close()
 
     def join(self, peer: str, addr: Tuple[str, int]) -> None:
         """Add a peer at runtime (manual `cluster join`).  A changed
@@ -260,7 +304,8 @@ class ClusterNode:
         link = self.links.pop(peer, None)
         if link is not None:
             asyncio.get_running_loop().create_task(link.stop())
-        self._node_down(peer)
+        # explicit leave: no transient-flap grace, purge immediately
+        self._node_down(peer, purge=True)
 
     def _add_link(self, peer: str, addr: Tuple[str, int]) -> None:
         link = PeerLink(
@@ -272,6 +317,8 @@ class ClusterNode:
             on_down=lambda l: self._node_down(l.peer),
             cookie=self.cookie,
             extra_hello=self._hello_extra(),
+            reconnect_ivl=self.reconnect_ivl,
+            reconnect_max=self.reconnect_max,
         )
         self.links[peer] = link
         self._status.setdefault(peer, "down")
@@ -334,18 +381,67 @@ class ClusterNode:
             self._status.pop(link.peer, None)
             asyncio.get_running_loop().create_task(link.stop())
             return
+        self._cancel_purge(link.peer)
         self._status[link.peer] = "up"
         self._misses[link.peer] = 0
+        tracept("cluster.peer.health", peer=link.peer, state="up")
         self.broker.hooks.run("node.up", (link.peer,))
-        # bootstrap that peer's routes
+        # bootstrap that peer's routes, then drain the forward spool
         asyncio.get_running_loop().create_task(self._resync(link.peer))
+        self._kick_replay(link.peer)
 
-    def _node_down(self, peer: str) -> None:
-        if self._status.get(peer) == "down":
+    def _node_down(self, peer: str, purge: bool = False) -> None:
+        """Mark a peer down.  Routes are NOT purged immediately: a
+        transient flap (redial window, brief partition) keeps the routes
+        so QoS>=1 forwards spool instead of un-matching; only after
+        `route_hold` seconds continuously down — or an explicit
+        `purge=True` (leave, takeover) — does the purge run.  The
+        'node.down' hook fires at purge time with the purged count, same
+        contract as before, just `route_hold` later for flaps."""
+        prev = self._status.get(peer)
+        if prev == "down" and not purge:
             return
-        self._status[peer] = "down"
+        if prev != "down":
+            self._status[peer] = "down"
+            tracept("cluster.peer.health", peer=peer, state="down")
+        if purge:
+            self._cancel_purge(peer)
+            self._purge_routes(peer)
+        elif self._stopping:
+            pass  # links tearing down with the node: no purge timers
+        elif peer not in self._purge_tasks:
+            self._purge_tasks[peer] = asyncio.get_running_loop().create_task(
+                self._purge_after_hold(peer)
+            )
+
+    def _purge_routes(self, peer: str) -> None:
         purged = self.remote.purge_node(peer)
         self.broker.hooks.run("node.down", (peer, purged))
+
+    async def _purge_after_hold(self, peer: str) -> None:
+        try:
+            await asyncio.sleep(self.route_hold)
+            if self._status.get(peer) == "down":
+                self._purge_routes(peer)
+        finally:
+            self._purge_tasks.pop(peer, None)
+
+    def _cancel_purge(self, peer: str) -> None:
+        t = self._purge_tasks.pop(peer, None)
+        if t is not None:
+            t.cancel()
+
+    def _peer_recovered(self, peer: str) -> None:
+        """A down peer answered a ping on a still-connected link (paused
+        process, healed partition — no TCP reset, so no _link_up fires):
+        cancel the pending purge, resync its routes (they may have been
+        purged already if the outage outlived route_hold) and drain the
+        spool."""
+        self._cancel_purge(peer)
+        self._status[peer] = "up"
+        tracept("cluster.peer.health", peer=peer, state="up")
+        asyncio.get_running_loop().create_task(self._resync(peer))
+        self._kick_replay(peer)
 
     async def _heartbeat(self) -> None:
         while True:
@@ -355,11 +451,32 @@ class ClusterNode:
                     continue
                 try:
                     await link.request(tp.PING, {}, timeout=self.heartbeat_ivl * 2)
-                    self._misses[peer] = 0
-                except (RpcError, Exception):
-                    self._misses[peer] = self._misses.get(peer, 0) + 1
-                    if self._misses[peer] >= self.miss_limit:
+                except (RpcError, OSError) as e:
+                    # RpcError: timeout / link raced down; OSError: the
+                    # write itself failed on a dying socket.  (The old
+                    # `except (RpcError, Exception)` swallowed everything
+                    # — including bugs in this loop — silently.)
+                    misses = self._misses[peer] = self._misses.get(peer, 0) + 1
+                    tracept("cluster.peer.miss", peer=peer, misses=misses,
+                            error=str(e) or type(e).__name__)
+                    if misses >= self.miss_limit:
                         self._node_down(peer)
+                    elif self._status.get(peer) == "up":
+                        self._status[peer] = "degraded"
+                        tracept("cluster.peer.health", peer=peer,
+                                state="degraded")
+                    continue
+                self._misses[peer] = 0
+                st = self._status.get(peer)
+                if st == "degraded":
+                    self._status[peer] = "up"
+                    tracept("cluster.peer.health", peer=peer, state="up")
+                elif st == "down":
+                    self._peer_recovered(peer)
+                elif self.spool_pending(peer):
+                    # link healthy but spooled backlog remains (e.g. the
+                    # last replay aborted mid-fault): keep draining
+                    self._kick_replay(peer)
 
     def status(self) -> Dict[str, str]:
         return dict(self._status)
@@ -436,7 +553,23 @@ class ClusterNode:
             return
         self._resyncing.add(peer)
         try:
-            resp = await link.request(tp.SNAPSHOT_REQ, {"node": self.name})
+            resp = None
+            for attempt in range(3):
+                try:
+                    resp = await link.request(
+                        tp.SNAPSHOT_REQ, {"node": self.name}
+                    )
+                    break
+                except RpcError:
+                    # idempotent read: a lost frame mid-heal is worth a
+                    # couple of backed-off retries before the next
+                    # route-op gap triggers resync again
+                    if attempt == 2:
+                        raise
+                    await asyncio.sleep(
+                        0.2 * (2 ** attempt)
+                        * (0.5 + self._shared_rng.random())
+                    )
             self.remote.load_snapshot(
                 peer, resp["incarnation"], resp["seq"],
                 _snapshot_filters(resp),
@@ -489,8 +622,9 @@ class ClusterNode:
                 ):
                     continue
                 try:
-                    resp = await link.rpc(
-                        "remote_snapshot", {"node": origin}, timeout=5.0
+                    resp = await self.call_retry(
+                        peer, "remote_snapshot", {"node": origin},
+                        timeout=5.0, retries=2,
                     )
                 except (RpcError, Exception):
                     continue
@@ -532,38 +666,142 @@ class ClusterNode:
             sorted(self._local_filters),
         )
 
+    # -------------------------------------------------------- forward spool
+
+    def spool_pending(self, node: Optional[str] = None) -> int:
+        """Spooled-but-undelivered forward records (one node or all)."""
+        if node is not None:
+            q = self._spools.get(node)
+            return q.pending_count() if q is not None else 0
+        return sum(q.pending_count() for q in self._spools.values())
+
+    def _spool_put(self, node: str, header: dict, payload: bytes) -> None:
+        """Queue one QoS>=1 forward for replay, bounded drop-oldest."""
+        q = self._spools.get(node)
+        if q is None:
+            q = self._spools[node] = ReplayQ()
+            self._spool_bytes[node] = 0
+        body = tp.pack_forward_body(header, payload)
+        while (
+            self._spool_bytes[node] + len(body) > self.spool_max_bytes
+            and q.count()
+        ):
+            ref, items = q.pop(1)
+            q.ack(ref)
+            lost = len(items)
+            self.spool_dropped += lost
+            self._spool_bytes[node] -= sum(len(i) for i in items)
+            self.broker.metrics.inc("messages.forward.spool_dropped", lost)
+            self.broker.metrics.inc("messages.forward.dropped", lost)
+        q.append(body)
+        self._spool_bytes[node] += len(body)
+        self.broker.metrics.inc("messages.forward.spooled")
+        tracept("cluster.forward.spool", node=node, pending=q.count())
+        # link up (queue-full / fault blip rather than a dead peer):
+        # start draining right away instead of waiting for a heal event
+        link = self.links.get(node)
+        if link is not None and link.connected \
+                and self._status.get(node) == "up":
+            self._kick_replay(node)
+
+    def _kick_replay(self, peer: str) -> None:
+        if self._stopping:
+            return
+        if self.spool_pending(peer) and peer not in self._replay_tasks:
+            self._replay_tasks[peer] = asyncio.get_running_loop().create_task(
+                self._replay_spool(peer)
+            )
+
+    async def _replay_spool(self, peer: str) -> None:
+        """Drain one peer's spool over the healed link.  Every record is
+        an ACKED forward (the receiver dedups by msgid, so a retry after
+        a lost ack cannot double-deliver); the queue is only acked past
+        records the peer confirmed, so a mid-replay link loss replays
+        the unconfirmed tail on the next heal."""
+        sent = 0
+        try:
+            q = self._spools.get(peer)
+            while q is not None and q.count():
+                link = self.links.get(peer)
+                if link is None or not link.connected:
+                    return
+                ref, items = q.pop(16)
+                if not items:
+                    return
+                try:
+                    for body in items:
+                        header, payload = tp.unpack_forward(body)
+                        header["replay"] = True
+                        ack = await link.forward_request(
+                            header, payload, timeout=self.replay_timeout
+                        )
+                        if ack is None:
+                            raise RpcError(f"link to {peer} down mid-replay")
+                except (RpcError, ConnectionError, OSError):
+                    q.requeue(ref, items)
+                    return
+                q.ack(ref)
+                sent += len(items)
+                self._spool_bytes[peer] -= sum(len(i) for i in items)
+                await asyncio.sleep(0)  # yield between batches
+        finally:
+            self._replay_tasks.pop(peer, None)
+            if sent:
+                self.broker.metrics.inc("messages.forward.replayed", sent)
+                tracept("cluster.forward.replay", node=peer, n=sent,
+                        drained=self.spool_pending(peer) == 0)
+
     # ----------------------------------------------------------- forwarding
 
     def forward_publish(self, msgs: Sequence[Message]) -> int:
         """Async-mode forward of a publish batch (one remote match kernel).
 
         Fire-and-forget like `forward_async` (`emqx_broker.erl:277-292`);
-        for acked forwarding use `forward_publish_sync`.
+        for acked forwarding use `forward_publish_sync`.  A failed send
+        is never silent: QoS>=1 messages spool for replay on heal,
+        QoS0 ones land in `messages.forward.dropped`.
         """
         per_node = self._match_remote(msgs)
         n = 0
+        metrics = self.broker.metrics
         for node, node_msgs in per_node.items():
             link = self.links.get(node)
-            relay = None
-            if link is None or not link.connected:
-                # no direct link (replicant->replicant): ride via a core
-                relay = self._up_core_link(exclude=node)
-                if relay is None:
-                    self.broker.metrics.inc(
-                        "messages.forward.dropped", len(node_msgs)
-                    )
-                    continue
+            # a peer whose heartbeats are missing ("down") may still hold
+            # a live TCP link (paused process, one-way partition): stop
+            # trusting it — spool instead of queueing into a black hole
+            direct = (
+                link is not None
+                and link.connected
+                and self._status.get(node) != "down"
+            )
+            relay = None if direct else self._up_core_link(exclude=node)
+            blocked = _fault.inject("cluster.forward", err=False) is not None \
+                if _fault.enabled() else False
             for msg in node_msgs:
                 header, payload = message_to_wire(msg)
-                if relay is not None:
-                    header["relay_to"] = node
-                    sent = relay.send_nowait(tp.pack_forward(header, payload))
-                else:
+                sent = False
+                if blocked:
+                    pass
+                elif direct:
                     sent = link.send_nowait(tp.pack_forward(header, payload))
+                elif msg.qos >= 1 and link is not None:
+                    # down direct link: the spool's acked replay is the
+                    # reliable path — an unacked core relay could not be
+                    # deduped against it
+                    pass
+                elif relay is not None:
+                    # no direct link (replicant->replicant), or QoS0 with
+                    # the direct link down: ride via a core
+                    h2 = dict(header, relay_to=node)
+                    sent = relay.send_nowait(tp.pack_forward(h2, payload))
                 if sent:
                     n += 1
+                elif msg.qos >= 1:
+                    self._spool_put(node, header, payload)
+                else:
+                    metrics.inc("messages.forward.dropped")
         if n:
-            self.broker.metrics.inc("messages.forward.out", n)
+            metrics.inc("messages.forward.out", n)
         return n
 
     def _up_core_link(self, exclude: str = ""):
@@ -577,7 +815,10 @@ class ClusterNode:
         return None
 
     async def forward_publish_sync(self, msgs: Sequence[Message]) -> int:
-        """Sync-mode forward: awaits per-message dispatch acks."""
+        """Sync-mode forward: awaits per-message dispatch acks, with a
+        bounded backoff retry per message instead of giving up on the
+        first RpcError (the retry is marked as a replay so the receiver
+        dedups a delivered-but-ack-lost first attempt)."""
         per_node = self._match_remote(msgs)
         delivered = 0
         for node, node_msgs in per_node.items():
@@ -586,12 +827,23 @@ class ClusterNode:
                 continue
             for msg in node_msgs:
                 header, payload = message_to_wire(msg)
-                try:
-                    ack = await link.forward_request(header, payload)
-                except RpcError:
-                    continue
+                ack = None
+                for attempt in range(3):
+                    try:
+                        h = dict(header, replay=True) if attempt else header
+                        ack = await link.forward_request(h, payload)
+                        break
+                    except RpcError:
+                        if attempt == 2:
+                            break
+                        await asyncio.sleep(
+                            0.1 * (2 ** attempt)
+                            * (0.5 + self._shared_rng.random())
+                        )
                 if ack is not None:
                     delivered += ack.get("n", 0)
+                elif msg.qos >= 1:
+                    self._spool_put(node, header, payload)
         if delivered:
             self.broker.metrics.inc("messages.forward.out", delivered)
         return delivered
@@ -614,17 +866,28 @@ class ClusterNode:
         header["shared_group"] = group
         header["shared_filt"] = filt
         link = self.links.get(node)
-        if link is None or not link.connected:
-            relay = self._up_core_link(exclude=node)
-            if relay is None:
-                self.broker.metrics.inc("messages.forward.dropped")
-                return False
-            header["relay_to"] = node
-            ok = relay.send_nowait(tp.pack_forward(header, payload))
-        else:
+        ok = False
+        direct = (
+            link is not None
+            and link.connected
+            and self._status.get(node) != "down"
+        )
+        if direct:
             ok = link.send_nowait(tp.pack_forward(header, payload))
+        elif link is None:
+            relay = self._up_core_link(exclude=node)
+            if relay is not None:
+                h2 = dict(header, relay_to=node)
+                ok = relay.send_nowait(tp.pack_forward(h2, payload))
         if ok:
             self.broker.metrics.inc("messages.forward.shared")
+        elif msg.qos >= 1:
+            # accept responsibility: spool for replay on heal (returning
+            # False would make the caller pick ANOTHER node, and the
+            # replay would then double-deliver to the group)
+            self._spool_put(node, header, payload)
+            self.broker.metrics.inc("messages.forward.shared")
+            ok = True
         else:
             self.broker.metrics.inc("messages.forward.dropped")
         return bool(ok)
@@ -664,6 +927,25 @@ class ClusterNode:
             return None
         group = header.pop("shared_group", None)
         filt = header.pop("shared_filt", None)
+        replay = header.pop("replay", None)
+        mid = header.get("mid")
+        if mid and header.get("qos", 0) >= 1:
+            # exactly-once at this broker across spool replays/retries:
+            # (mid, group, filt) — a generic forward and a targeted
+            # shared forward of the SAME message are distinct deliveries
+            key = (mid, group or "", filt or "")
+            seen = self._seen_fwd
+            if key in seen:
+                seen.move_to_end(key)
+                if replay:
+                    self.broker.metrics.inc("messages.forward.dup_dropped")
+                    return (
+                        {"n": 0} if header.get("id") is not None else None
+                    )
+            else:
+                seen[key] = True
+                if len(seen) > DEDUP_WINDOW:
+                    seen.popitem(last=False)
         msg = message_from_wire(header, payload)
         if group is not None:
             # targeted shared delivery: local members only (the origin
@@ -679,6 +961,10 @@ class ClusterNode:
         link = self.links.get(peer)
         if link is None:
             raise RpcError(f"unknown peer {peer!r}")
+        if _fault.enabled():
+            a = _fault.inject("cluster.rpc", err=RpcError)
+            if a is not None and a.kind == "drop":
+                raise RpcError(f"rpc to {peer} dropped (fault)")
         # bpapi gate: refuse calls the peer announced it cannot serve
         if method in bpapi.CONTRACTS:
             negotiated = self.peer_bpapi.get(peer)
@@ -686,6 +972,31 @@ class ClusterNode:
                 params = dict(params)
                 params["_v"] = bpapi.version_for(negotiated, method)
         return await link.rpc(method, params, timeout)
+
+    async def call_retry(
+        self,
+        peer: str,
+        method: str,
+        params: dict,
+        timeout: float = 5.0,
+        retries: int = 3,
+        backoff: float = 0.2,
+    ) -> dict:
+        """Bounded jittered-backoff retry wrapper for IDEMPOTENT RPCs
+        (snapshot reads, catch-up fetches).  Never use it for state-
+        moving calls like session_takeover: a retry after a lost
+        response would re-execute the move."""
+        for attempt in range(retries + 1):
+            try:
+                return await self.call(peer, method, params, timeout=timeout)
+            except RpcError:
+                if attempt == retries:
+                    raise
+                await asyncio.sleep(
+                    backoff * (2 ** attempt)
+                    * (0.5 + self._shared_rng.random())
+                )
+        raise RpcError("unreachable")  # pragma: no cover
 
     def _rpc_session_takeover(self, peer: str, params: dict) -> dict:
         """Hand a locally-held session (live or parked) to the peer.

@@ -22,8 +22,12 @@ import itertools
 import json
 import logging
 import os
+import random
 import struct
 from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+from .. import fault as _fault
+from ..observe.tracepoints import tp
 
 log = logging.getLogger("emqx_tpu.cluster.transport")
 
@@ -76,9 +80,15 @@ def pack_json(ftype: int, obj: dict) -> bytes:
     return _pack(ftype, json.dumps(obj, separators=(",", ":")).encode())
 
 
-def pack_forward(header: dict, payload: bytes) -> bytes:
+def pack_forward_body(header: dict, payload: bytes) -> bytes:
+    """FORWARD frame body (no length/type prefix) — also the forward
+    spool's on-queue record format (cluster/node.py)."""
     h = json.dumps(header, separators=(",", ":")).encode()
-    return _pack(FORWARD, struct.pack("!H", len(h)) + h + payload)
+    return struct.pack("!H", len(h)) + h + payload
+
+
+def pack_forward(header: dict, payload: bytes) -> bytes:
+    return _pack(FORWARD, pack_forward_body(header, payload))
 
 
 def unpack_forward(body: bytes) -> Tuple[dict, bytes]:
@@ -97,7 +107,15 @@ async def read_frame(reader: asyncio.StreamReader) -> Tuple[int, bytes]:
 
 
 class PeerLink:
-    """Outbound connection to one peer; owns reconnect + request matching."""
+    """Outbound connection to one peer; owns reconnect + request matching.
+
+    Reconnects use jittered exponential backoff (`reconnect_ivl` base
+    doubling to `reconnect_max`, ±50% jitter so a cluster-wide restart
+    does not produce synchronized dial storms) instead of the old fixed
+    0.5 s hammer.  `fails` counts consecutive connect/connection
+    failures; at `breaker_threshold` the link's circuit breaker is open
+    (`health` == "down") — dials continue at the max backoff as the
+    half-open probe, and the first successful HELLO closes it."""
 
     def __init__(
         self,
@@ -110,6 +128,8 @@ class PeerLink:
         reconnect_ivl: float = 0.5,
         cookie: str = "",
         extra_hello: Optional[dict] = None,  # role/addr advertisement
+        reconnect_max: float = 15.0,
+        breaker_threshold: int = 5,
     ):
         self.self_node = self_node
         self.peer = peer
@@ -118,16 +138,39 @@ class PeerLink:
         self.on_up = on_up
         self.on_down = on_down
         self.reconnect_ivl = reconnect_ivl
+        self.reconnect_max = reconnect_max
+        self.breaker_threshold = max(1, int(breaker_threshold))
         self.cookie = cookie
         self.extra_hello = dict(extra_hello or {})
         self._auth_warned = False
         self.connected = False
+        self.fails = 0  # consecutive dial/connection failures
         self.peer_hello: dict = {}
         self._writer: Optional[asyncio.StreamWriter] = None
         self._reqs: Dict[int, asyncio.Future] = {}
         self._req_id = itertools.count(1)
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
+
+    @property
+    def breaker_open(self) -> bool:
+        return not self.connected and self.fails >= self.breaker_threshold
+
+    @property
+    def health(self) -> str:
+        """up (connected) | degraded (reconnecting, breaker closed) |
+        down (breaker open)."""
+        if self.connected:
+            return "up"
+        return "down" if self.fails >= self.breaker_threshold else "degraded"
+
+    def _backoff(self) -> float:
+        """Jittered exponential reconnect delay for the current streak."""
+        d = min(
+            self.reconnect_ivl * (2 ** max(self.fails - 1, 0)),
+            self.reconnect_max,
+        )
+        return d * (0.5 + random.random())
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -145,6 +188,7 @@ class PeerLink:
     async def _run(self) -> None:
         while not self._stopped:
             try:
+                await _fault.ainject("transport.dial", err=ConnectionError)
                 reader, writer = await asyncio.open_connection(*self.addr)
                 self._writer = writer
                 # 1. server opens with HELLO{"challenge": nonce}
@@ -193,6 +237,10 @@ class PeerLink:
                     raise ConnectionError("peer failed cookie verification")
                 self.peer_hello = greeting
                 self.connected = True
+                if self.fails >= self.breaker_threshold:
+                    tp("cluster.peer.health", peer=self.peer, state="up",
+                       breaker="closed", fails=self.fails)
+                self.fails = 0
                 self.on_up(self, self.peer_hello)
                 await self._read_loop(reader)
             except asyncio.CancelledError:
@@ -201,10 +249,14 @@ class PeerLink:
                 pass
             was_up = self.connected
             self._teardown()
+            self.fails += 1
+            if self.fails == self.breaker_threshold:
+                tp("cluster.peer.health", peer=self.peer, state="down",
+                   breaker="open", fails=self.fails)
             if was_up:
                 self.on_down(self)
             if not self._stopped:
-                await asyncio.sleep(self.reconnect_ivl)
+                await asyncio.sleep(self._backoff())
 
     def _teardown(self) -> None:
         self.connected = False
@@ -222,6 +274,10 @@ class PeerLink:
     async def _read_loop(self, reader: asyncio.StreamReader) -> None:
         while True:
             ftype, body = await read_frame(reader)
+            if _fault.enabled():
+                a = await _fault.ainject("transport.recv", err=ConnectionError)
+                if a is not None and a.kind in ("drop", "corrupt"):
+                    continue  # frame lost on the floor
             if ftype in (PONG, RPC_RESP, SNAPSHOT, FORWARD_ACK):
                 obj = json.loads(body)
                 fut = self._reqs.pop(obj.get("id", -1), None)
@@ -234,9 +290,18 @@ class PeerLink:
     # ------------------------------------------------------------ sending
 
     def send_nowait(self, frame: bytes) -> bool:
-        """Fire-and-forget (async forward mode). False if link is down."""
+        """Fire-and-forget (async forward mode). False if link is down
+        or the socket queue refuses the frame — callers must COUNT or
+        SPOOL a False, never ignore it."""
         if not self.connected or self._writer is None:
             return False
+        if _fault.enabled():
+            a = _fault.inject("transport.send", err=ConnectionError)
+            if a is not None:
+                if a.kind == "drop":
+                    return False
+                if a.kind == "corrupt":
+                    frame = a.corrupt(frame)
         try:
             self._writer.write(frame)
             return True
@@ -251,7 +316,14 @@ class PeerLink:
         obj = dict(obj, id=rid)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._reqs[rid] = fut
-        self._writer.write(pack_json(ftype, obj))
+        dropped = None
+        if _fault.enabled():
+            dropped = _fault.inject("transport.send", err=False)
+        if dropped is None or dropped.kind not in ("drop", "error"):
+            # a dropped request frame is simply never written: the
+            # matching response never arrives and the timeout below
+            # surfaces it as an RpcError, exactly like real frame loss
+            self._writer.write(pack_json(ftype, obj))
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
@@ -396,6 +468,12 @@ class Transport:
             await writer.drain()
             while True:
                 ftype, body = await read_frame(reader)
+                if _fault.enabled():
+                    a = await _fault.ainject(
+                        "transport.recv", err=ConnectionError
+                    )
+                    if a is not None and a.kind in ("drop", "corrupt"):
+                        continue  # inbound frame lost on the floor
                 if ftype == RPC_REQ:
                     obj = json.loads(body)
                     pool = self._rpc_pool

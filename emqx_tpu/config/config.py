@@ -332,6 +332,23 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "cache_max_size": Field("int", 32, min=1),
         "cache_ttl": Field("duration", 60.0),
     },
+    "fault": {
+        # seeded fault-injection plane (emqx_tpu/fault/) — chaos testing
+        # only; zero overhead and zero behavior change while disabled
+        "enable": Field("bool", False,
+                        desc="arm the fault-injection plane from "
+                             "fault.spec at boot"),
+        "seed": Field("int", 0,
+                      desc="global fault seed; each site derives its own "
+                           "deterministic PRNG from (seed, site)"),
+        "spec": Field(
+            "map", {},
+            desc="site -> action spec, e.g. {\"transport.send\": "
+                 "{\"action\": \"drop\", \"p\": 0.3}}; sites must be "
+                 "registered in emqx_tpu/fault/sites.py (actions: "
+                 "delay|drop|error|corrupt; fields: p, delay, times, "
+                 "after)"),
+    },
     "prometheus": {
         "enable": Field("bool", False),
         "push_gateway_server": Field("str", ""),
@@ -425,6 +442,15 @@ STRUCTURED: Dict[str, Any] = {
         "role": Field("enum", "core", enum=["core", "replicant"]),
         "rpc_mode": Field("enum", "async", enum=["sync", "async"]),
         "peers": Field("map", desc="name -> [host, port]"),
+        "route_hold": Field(
+            "duration", 5.0,
+            desc="keep a down peer's routes this long before purging; "
+                 "QoS>=1 forwards spool + replay across flaps shorter "
+                 "than this instead of un-matching"),
+        "spool_max_bytes": Field(
+            "bytesize", 8 << 20,
+            desc="per-peer forward-spool bound (drop-oldest overflow, "
+                 "counted + alarmed)"),
         "discovery": Struct({
             "strategy": Field("enum", "static",
                               enum=["static", "dns", "etcd"]),

@@ -1,0 +1,31 @@
+"""Fault-injection plane (see plane.py) + the lint-checked site registry."""
+
+from .plane import (
+    Action,
+    FaultError,
+    FaultPlane,
+    ainject,
+    configure,
+    enabled,
+    inject,
+    mangle,
+    peek,
+    reset,
+    stats,
+)
+from .sites import SITES
+
+__all__ = [
+    "Action",
+    "FaultError",
+    "FaultPlane",
+    "SITES",
+    "ainject",
+    "configure",
+    "enabled",
+    "inject",
+    "mangle",
+    "peek",
+    "reset",
+    "stats",
+]

@@ -1,0 +1,226 @@
+"""Seeded, config-driven fault-injection plane.
+
+The cluster data plane claims to self-heal (PeerLink backoff + breaker,
+forward spool + replay, engine device breaker) — this module is the
+deterministic way to prove it.  A fault *site* is a named point in
+production code; a *schedule* (the `fault.spec` config map, or
+`configure()` at runtime) arms sites with actions:
+
+    fault.configure({
+        "transport.send": {"action": "drop", "p": 0.3},
+        "engine.collect": {"action": "drop"},
+        "transport.dial": {"action": "delay", "delay": 0.5, "times": 10},
+    }, seed=7)
+
+Actions:
+    delay    sleep `delay` seconds (async sites use `ainject`), proceed
+    drop     the call site discards the frame / reports failure
+    error    raise (the site's natural exception type, or FaultError)
+    corrupt  the call site mangles the payload (`Action.corrupt`)
+
+Spec fields per site: `action` (required), `p` (fire probability,
+default 1.0), `delay` (seconds, delay action), `times` (max fires,
+0 = unlimited), `after` (skip the first N arrivals at the site).
+
+Determinism: every site draws from its own PRNG seeded from
+(global seed, site name) — `random.Random(str)` hashes via sha512, so
+the same seed reproduces the same fault sequence across processes and
+platforms.  `tools/chaos_soak.py` runs the same schedule under multiple
+seeds and asserts the healing invariants hold for all of them.
+
+Zero-overhead when disarmed: every entry point is one module-global
+boolean test away from returning — the plane costs nothing on the bench
+hot path until `configure()` arms it.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..observe.tracepoints import tp
+from .sites import SITES
+
+ACTIONS = ("delay", "drop", "error", "corrupt")
+
+
+class FaultError(Exception):
+    """Default exception for `error`-action sites with no natural type."""
+
+
+class Action:
+    """One decided fault firing, interpreted by the call site."""
+
+    __slots__ = ("site", "kind", "delay", "_rng")
+
+    def __init__(self, site: str, kind: str, delay: float, rng: random.Random):
+        self.site = site
+        self.kind = kind
+        self.delay = delay
+        self._rng = rng
+
+    def corrupt(self, data: bytes) -> bytes:
+        """Flip a handful of bytes at PRNG-chosen offsets."""
+        if not data:
+            return data
+        buf = bytearray(data)
+        for _ in range(min(4, len(buf))):
+            buf[self._rng.randrange(len(buf))] ^= 0xFF
+        return bytes(buf)
+
+
+class _Site:
+    __slots__ = ("name", "kind", "p", "delay", "times", "after",
+                 "rng", "fired", "arrivals")
+
+    def __init__(self, name: str, spec: Dict[str, Any], seed: int):
+        kind = spec.get("action")
+        if kind not in ACTIONS:
+            raise ValueError(
+                f"fault site {name!r}: action {kind!r} not in {ACTIONS}"
+            )
+        self.name = name
+        self.kind = kind
+        self.p = float(spec.get("p", 1.0))
+        self.delay = float(spec.get("delay", 0.05))
+        self.times = int(spec.get("times", 0))
+        self.after = int(spec.get("after", 0))
+        self.rng = random.Random(f"{seed}:{name}")
+        self.fired = 0
+        self.arrivals = 0
+
+
+class FaultPlane:
+    """Site table + per-site deterministic decision state."""
+
+    def __init__(self) -> None:
+        self._sites: Dict[str, _Site] = {}
+        self._lock = threading.Lock()
+        self.seed = 0
+
+    def configure(self, spec: Dict[str, Dict[str, Any]], seed: int = 0) -> None:
+        unknown = set(spec) - set(SITES)
+        if unknown:
+            raise ValueError(
+                f"unknown fault sites {sorted(unknown)} "
+                f"(registered: {sorted(SITES)})"
+            )
+        with self._lock:
+            self.seed = int(seed)
+            self._sites = {
+                name: _Site(name, dict(cfg or {}), self.seed)
+                for name, cfg in spec.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sites = {}
+
+    def decide(self, site: str) -> Optional[Action]:
+        s = self._sites.get(site)
+        if s is None:
+            return None
+        with self._lock:
+            s.arrivals += 1
+            if s.arrivals <= s.after:
+                return None
+            if s.times and s.fired >= s.times:
+                return None
+            if s.p < 1.0 and s.rng.random() >= s.p:
+                return None
+            s.fired += 1
+            fired = s.fired
+        tp("fault.inject", site=site, action=s.kind, n=fired)
+        return Action(site, s.kind, s.delay, s.rng)
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                name: {"fired": s.fired, "arrivals": s.arrivals}
+                for name, s in self._sites.items()
+            }
+
+
+_plane = FaultPlane()
+_on = False  # fast-path gate: inject() is one bool test when disarmed
+
+
+def configure(spec: Dict[str, Dict[str, Any]], seed: int = 0) -> None:
+    """Arm the plane with a schedule (validated against SITES)."""
+    global _on
+    _plane.configure(spec, seed=seed)
+    _on = bool(spec)
+
+
+def reset() -> None:
+    """Disarm every site (back to zero-overhead pass-through)."""
+    global _on
+    _plane.reset()
+    _on = False
+
+
+def enabled() -> bool:
+    return _on
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    """Per-site fired/arrival counts (soak assertions, /status surfacing)."""
+    return _plane.stats()
+
+
+def inject(site: str, err: Any = None) -> Optional[Action]:
+    """Synchronous fault point.  Returns None when nothing fires.
+
+    delay   sleeps here, returns the action (call site proceeds)
+    error   raises `err` (FaultError when None); pass ``err=False`` to
+            get the action back instead of raising (sites that must not
+            unwind, e.g. the engine collect path)
+    drop / corrupt   returned for the call site to apply
+    """
+    if not _on:
+        return None
+    a = _plane.decide(site)
+    if a is None:
+        return None
+    if a.kind == "delay":
+        time.sleep(a.delay)
+    elif a.kind == "error" and err is not False:
+        raise (err or FaultError)(f"fault injected at {site}")
+    return a
+
+
+async def ainject(site: str, err: Any = None) -> Optional[Action]:
+    """`inject` for async call sites (delay = asyncio.sleep)."""
+    if not _on:
+        return None
+    a = _plane.decide(site)
+    if a is None:
+        return None
+    if a.kind == "delay":
+        import asyncio
+
+        await asyncio.sleep(a.delay)
+    elif a.kind == "error" and err is not False:
+        raise (err or FaultError)(f"fault injected at {site}")
+    return a
+
+
+def peek(site: str) -> Optional[Action]:
+    """Decide without applying anything: no sleep, no raise.  For sites
+    that interpret every action themselves (probe harvest)."""
+    if not _on:
+        return None
+    return _plane.decide(site)
+
+
+def mangle(site: str, data: bytes) -> bytes:
+    """Corrupt `data` when the site fires with a corrupt action;
+    otherwise return it unchanged (other actions are ignored here)."""
+    if not _on:
+        return data
+    a = _plane.decide(site)
+    if a is not None and a.kind == "corrupt":
+        return a.corrupt(data)
+    return data

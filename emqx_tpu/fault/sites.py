@@ -1,0 +1,43 @@
+"""Fault-site registry.
+
+Every `fault.inject("<site>", ...)` / `fault.ainject` / `fault.peek` /
+`fault.mangle` call in production code (emqx_tpu/**) MUST name a site
+registered here — `tools/check.py` lints call sites against this dict
+statically, the same contract as the tracepoint KNOWN_KINDS registry.
+A site that is not registered cannot be scheduled from `fault.spec`
+config, so an unregistered call site is dead chaos surface by contract.
+
+Site names are stable identifiers: chaos schedules (`tools/chaos_soak.py`,
+`fault.spec` config) and dashboards key on them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+SITES: Dict[str, str] = {
+    # cluster transport (cluster/transport.py)
+    "transport.dial": "PeerLink outbound connect attempt",
+    "transport.send": "outbound frame write on a peer link "
+                      "(drop = send_nowait returns False / request frame "
+                      "lost before the wire)",
+    "transport.recv": "inbound frame on the server handler or the link "
+                      "read loop (drop = frame discarded; error = "
+                      "connection reset)",
+    # forward + rpc planes (cluster/node.py)
+    "cluster.forward": "one destination node's forward batch on the "
+                       "publish path (drop = treat every send as failed)",
+    "cluster.rpc": "outbound cluster RPC call (error/drop = RpcError)",
+    # checkpoint IO (checkpoint/store.py)
+    "ckpt.write": "snapshot store save (error = OSError mid-write)",
+    "ckpt.read": "snapshot file load (any action = frame check failure, "
+                 "exercising the older-snapshot fallback)",
+    # device collect (models/engine.py, parallel/sharded.py)
+    "engine.collect": "single-chip device result fetch (drop/error = "
+                      "simulated link stall: the tick times out to the "
+                      "host path and feeds the device breaker)",
+    "engine.probe": "hybrid warm-keeping probe harvest (drop = probe "
+                    "looks stalled, keeping the breaker open)",
+    "sharded.collect": "sharded engine device resolve (delay only: the "
+                       "mesh path has no host fallback)",
+}

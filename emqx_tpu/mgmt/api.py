@@ -383,7 +383,11 @@ class ManagementApi:
             for peer, st in self.cluster.status().items():
                 out.append({
                     "node": peer,
-                    "node_status": "running" if st == "up" else "stopped",
+                    # degraded = heartbeats missing but below the down
+                    # limit: the peer is still serving
+                    "node_status": (
+                        "running" if st in ("up", "degraded") else "stopped"
+                    ),
                     "routes": len(self.cluster.remote.filters_of(peer)),
                 })
         return out

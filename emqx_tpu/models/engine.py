@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from .. import fault as _fault
 from ..broker import topic as topiclib
 from ..observe.flight import (
     FlightRecorder,
@@ -41,6 +42,7 @@ from ..observe.flight import (
     PATH_DEVICE,
     PATH_HOST,
     PATHS,
+    R_BREAKER,
     R_COLD_MIRROR,
     R_FORCED,
     R_HOST_REFRESH,
@@ -187,6 +189,18 @@ class TopicMatchEngine:
         self.host_serve_count = 0
         self.dev_serve_count = 0
         self.dev_timeout_count = 0
+        # device-path circuit breaker: after `breaker_threshold`
+        # CONSECUTIVE device timeouts the engine stops arbitrating and
+        # serves host-only (reason R_BREAKER) — per-tick fallback alone
+        # would keep re-trying a dead link and paying the timeout floor
+        # every few ticks.  Probes keep running while open; the first
+        # completed probe (or device serve) closes it.  `on_breaker` is
+        # the node-runtime alarm hook (engine_device_degraded).
+        self.breaker_threshold = 3
+        self.breaker_open = False
+        self.breaker_trips = 0
+        self.consec_dev_timeouts = 0
+        self.on_breaker: Optional[object] = None  # fn(open: bool)
         self._probe = None  # in-flight device probe: (out, t0, n_topics)
         # adaptive probe batch: starts small (a probe's terms upload rides
         # the possibly-degraded link on the serving thread), escalates to
@@ -970,10 +984,12 @@ class TopicMatchEngine:
             arr = self._timed_fetch(pending)
             if arr is None:  # device stalled past its budget: host serves
                 self.dev_timeout_count += 1
+                self._note_dev_timeout()
                 pending.served = PATH_HOST
                 pending.reason = R_LINK_STALL
                 return self._finalize(pending, self._host_collect(pending))
             self.dev_serve_count += 1
+            self._note_dev_ok()
             pending.bytes_down += arr.nbytes
             hcap = pending.hcap
             total = int(arr[-1])
@@ -1083,11 +1099,38 @@ class TopicMatchEngine:
         return (t.key_a, t.key_b, t.val, t.log2cap, t.incl, t.k_a, t.k_b,
                 t.min_len, t.max_len, t.wild_root, t.valid)
 
+    def _note_dev_timeout(self) -> None:
+        """One more consecutive device timeout; trip the breaker at the
+        threshold (host-only serving + engine_device_degraded alarm)."""
+        self.consec_dev_timeouts += 1
+        if (
+            not self.breaker_open
+            and self.consec_dev_timeouts >= self.breaker_threshold
+        ):
+            self.breaker_open = True
+            self.breaker_trips += 1
+            tp("engine.breaker", state="open",
+               consec=self.consec_dev_timeouts, rate_dev=self.rate_dev)
+            if self.on_breaker is not None:
+                self.on_breaker(True)
+
+    def _note_dev_ok(self) -> None:
+        """A device round trip completed: reset the streak and close an
+        open breaker (probes re-close it while host-only serving)."""
+        self.consec_dev_timeouts = 0
+        if self.breaker_open:
+            self.breaker_open = False
+            tp("engine.breaker", state="closed", rate_dev=self.rate_dev)
+            if self.on_breaker is not None:
+                self.on_breaker(False)
+
     def _pick_host(self) -> int:
         """0 = device serves; else the R_* reason the host path serves
         (the code lands in the flight record and the `engine.flip` tp)."""
         import time
 
+        if self.breaker_open:
+            return R_BREAKER  # host-only until a probe heals the link
         if self.rate_host is None or self.rate_dev is None:
             return R_UNMEASURED  # measure host first; the probe measures device
         if self.rate_host >= self.rate_dev:
@@ -1120,6 +1163,10 @@ class TopicMatchEngine:
         p = self._probe
         if p is None:
             return
+        if _fault.enabled():
+            a = _fault.peek("engine.probe")
+            if a is not None and a.kind in ("drop", "error"):
+                return  # probe looks stalled: the breaker stays open
         out, t0, n = p
         try:
             ready = out is None or out.is_ready()
@@ -1132,6 +1179,7 @@ class TopicMatchEngine:
             dt = max(time.monotonic() - t0, 1e-9)
             self._note_dev_rate(n / dt)
             self.hist_probe.observe(dt)
+            self._note_dev_ok()  # a live round trip closes the breaker
             tp("engine.probe", phase="complete", n=n, dt_ms=dt * 1e3,
                rate_dev=self.rate_dev)
             if dt < 0.05:
@@ -1224,6 +1272,17 @@ class TopicMatchEngine:
 
         if not (self.hybrid and self._host_ok() and pending.snap is not None):
             return np.asarray(pending.out)
+        if _fault.enabled():
+            # injected link stall: the fetch "times out" immediately —
+            # same decay + host fallback as a real stall, so chaos soaks
+            # can trip the breaker without a real dead device
+            a = _fault.inject("engine.collect", err=False)
+            if a is not None and a.kind in ("drop", "error"):
+                self.rate_dev = max((self.rate_dev or 1.0) * 0.25, 1e-6)
+                self._last_dev_meas = time.monotonic()
+                tp("engine.stall", n=len(pending.topics), timeout_ms=0.0,
+                   rate_dev=self.rate_dev, injected=True)
+                return None
         out = pending.out
         if not hasattr(out, "is_ready"):  # pragma: no cover - older jax
             return np.asarray(out)

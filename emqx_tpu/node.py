@@ -46,6 +46,41 @@ from .psk import PskStore
 log = logging.getLogger("emqx_tpu.node")
 
 
+def poll_health_alarms(engine, cluster, alarms: AlarmManager) -> None:
+    """Raise/clear the self-healing alarms from observed state.
+
+    Polled (node ticker, chaos soak) rather than pushed so the alarm
+    publish — itself a broker publish — never re-enters the engine from
+    a collect thread.  `engine_device_degraded` tracks the device
+    breaker; `cluster_forward_spool_overflow` raises when the bounded
+    forward spool dropped records and clears once the spool has fully
+    drained after a heal."""
+    if getattr(engine, "breaker_open", False):
+        alarms.activate(
+            "engine_device_degraded",
+            details={
+                "consec_timeouts": getattr(engine, "consec_dev_timeouts", 0),
+                "trips": getattr(engine, "breaker_trips", 0),
+            },
+            message="engine device path tripped to host-only serving",
+        )
+    elif alarms.is_active("engine_device_degraded"):
+        alarms.deactivate("engine_device_degraded")
+    if cluster is None:
+        return
+    dropped = getattr(cluster, "spool_dropped", 0)
+    if alarms.is_active("cluster_forward_spool_overflow"):
+        if cluster.spool_pending() == 0:
+            alarms.deactivate("cluster_forward_spool_overflow")
+            cluster._spool_alarm_mark = dropped
+    elif dropped > getattr(cluster, "_spool_alarm_mark", 0):
+        alarms.activate(
+            "cluster_forward_spool_overflow",
+            details={"dropped": dropped},
+            message="forward spool overflow: QoS>=1 forwards dropped",
+        )
+
+
 def _tls_from_dict(d: Dict[str, Any]):
     from .broker.tls import TlsConfig
 
@@ -64,6 +99,15 @@ class NodeRuntime:
         self.conf = Config(raw)
         self.raw = raw
         self.node_name = self.conf.get("node.name")
+        # fault-injection plane (chaos testing): armed before any
+        # component wires up so even boot-path IO sees the schedule
+        if self.conf.get("fault.enable"):
+            from . import fault
+
+            fault.configure(
+                self.conf.get("fault.spec") or {},
+                seed=int(self.conf.get("fault.seed")),
+            )
         # process-global GC tuning at end of boot; opted in by __main__
         # (dedicated broker process) only — see start()
         self.gc_tune_after_boot = False
@@ -171,6 +215,10 @@ class NodeRuntime:
                 discovery=discovery,
                 discovery_ivl=discovery_ivl,
                 advertise_host=cluster_cfg.get("advertise_host"),
+                route_hold=float(cluster_cfg.get("route_hold", 5.0)),
+                spool_max_bytes=int(
+                    cluster_cfg.get("spool_max_bytes", 8 << 20)
+                ),
             )
             from .cluster.cluster_rpc import ClusterRpc
 
@@ -890,6 +938,7 @@ class NodeRuntime:
                 self.delayed.tick()
                 self.monitor.tick()
                 self._refresh_stats()
+                self._poll_health_alarms()
                 if self.broker.retainer.store is not None:
                     self.broker.retainer.store.flush()
                 if now - last_hb >= hb_ivl:
@@ -905,6 +954,12 @@ class NodeRuntime:
                     await asyncio.to_thread(self.ckpt.write, payload)
             except Exception:
                 log.exception("node ticker")
+
+    def _poll_health_alarms(self) -> None:
+        """Self-healing alarms, polled from the ticker so alarm publish
+        (itself a broker publish) never runs on an engine collect
+        thread: the device breaker and the forward-spool overflow."""
+        poll_health_alarms(self.broker.engine, self.cluster, self.alarms)
 
     def _refresh_stats(self) -> None:
         """Periodic gauges (`emqx_stats` setstat points)."""

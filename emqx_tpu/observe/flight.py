@@ -46,6 +46,7 @@ R_LINK_STALL = 4    # device fetch timed out: host served the same batch
 R_COLD_MIRROR = 5   # device tick paid a full HBM mirror rebuild
 R_OVERFLOW = 6      # sparse-return overflow: host probe recovered the tick
 R_FORCED = 7        # hybrid off / host probe unavailable: path is forced
+R_BREAKER = 8       # device breaker open: host-only until a probe heals it
 
 REASONS = {
     R_NONE: "",
@@ -56,6 +57,7 @@ REASONS = {
     R_COLD_MIRROR: "cold-mirror",
     R_OVERFLOW: "overflow",
     R_FORCED: "forced",
+    R_BREAKER: "breaker",
 }
 
 PATH_HOST = 0

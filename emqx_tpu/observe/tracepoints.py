@@ -59,6 +59,14 @@ KNOWN_KINDS: Dict[str, str] = {
     "engine.ckpt.restore": "warm restart: snapshot loaded + WAL tail replayed",
     "engine.ckpt.fallback": "newest snapshot corrupt; older one restored",
     "engine.ckpt.wal": "churn record appended to the write-ahead log",
+    # fault injection + self-healing (fault/, cluster data plane, engine)
+    "fault.inject": "a configured fault fired at a registered site",
+    "cluster.peer.miss": "heartbeat ping to a peer went unanswered",
+    "cluster.peer.health": "peer health transition (up/degraded/down, "
+                           "incl. link breaker open/close)",
+    "cluster.forward.spool": "QoS>=1 forward queued in the replay spool",
+    "cluster.forward.replay": "spooled forwards replayed after a heal",
+    "engine.breaker": "device-path circuit breaker opened or closed",
 }
 
 

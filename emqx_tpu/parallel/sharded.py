@@ -54,6 +54,7 @@ try:  # jax >= 0.4.35 exposes shard_map at top level
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+from .. import fault as _fault
 from ..broker import topic as topiclib
 from ..models.reference import CpuTrieIndex
 from ..observe.flight import (
@@ -1024,6 +1025,10 @@ class ShardedMatchEngine:
         try:
             if pending.resolved:
                 return True
+            if _fault.enabled():
+                # delay-only site (no host fallback on the mesh path):
+                # models a slow collect leg for pipeline-pressure soaks
+                _fault.inject("sharded.collect", err=False)
             if pending.hits is not None:
                 n = pending.n
                 pending.bytes_down += int(pending.hits.nbytes) + int(

@@ -66,6 +66,10 @@ def _write_conf(d, name, mqtt_port, dash_port, cport, peers, role="core"):
             "port": cport,
             "role": role,
             "peers": {p: ["127.0.0.1", pp] for p, pp in peers.items()},
+            # flap tolerance: keep a down peer's routes long enough for
+            # the link-flap test's freeze window (purge still happens —
+            # the SIGKILL test budgets for down-detect + this hold)
+            "route_hold": 30,
         },
     }
     path = os.path.join(d, "conf.json")
@@ -385,6 +389,83 @@ def test_parked_persistent_session_remote_delivery(two_nodes):
         await back.disconnect()
 
     asyncio.run(main())
+
+
+def test_link_flap_spool_replay_no_duplicates(two_nodes):
+    """Link flap via SIGSTOP: freezing node B is a partition with no TCP
+    reset — A's heartbeats go unanswered, B goes down-status, and QoS1
+    forwards published meanwhile spool on A.  SIGCONT heals: pings
+    resume, the spool replays over the still-open socket, and the
+    receiver's msgid dedup collapses replay against whatever the frozen
+    TCP buffer already delivered — the subscriber sees every message
+    EXACTLY once.  Runs before the SIGKILL test (module-ordered), which
+    permanently removes node B."""
+
+    async def main():
+        sub = await _connect("flap_sub", two_nodes["mqtt_b"])
+        await sub.subscribe("flap/+", qos=1)
+        pub = await _connect("flap_pub", two_nodes["mqtt_a"])
+        # route replication is async: retry until one clean delivery
+        got = None
+        for _ in range(40):
+            await pub.publish("flap/0", b"pre", qos=1)
+            try:
+                got = await sub.recv(0.5)
+                break
+            except (TimeoutError, asyncio.TimeoutError):
+                continue
+        assert got is not None and got.payload == b"pre"
+        while True:  # drain retry duplicates of the probe message
+            try:
+                await sub.recv(0.5)
+            except (TimeoutError, asyncio.TimeoutError):
+                break
+
+        payloads = [f"flap-m{i}".encode() for i in range(10)]
+        two_nodes["pb"].send_signal(signal.SIGSTOP)
+        try:
+            # wait until A marks B down (spool mode), then publish into
+            # the outage — these must survive via the forward spool
+            deadline = time.monotonic() + 45
+            tok = None
+            while time.monotonic() < deadline:
+                nodes, tok = _rest(two_nodes["dash_a"], "/nodes", tok)
+                peer = [n for n in nodes if n["node"] == "b@fvt"]
+                if peer and peer[0]["node_status"] == "stopped":
+                    break
+                await asyncio.sleep(0.5)
+            else:
+                raise AssertionError("node A never marked frozen B down")
+            for p in payloads:
+                await pub.publish("flap/1", p, qos=1)
+        finally:
+            two_nodes["pb"].send_signal(signal.SIGCONT)
+
+        # heal: collect everything the subscriber sees, then linger so
+        # any would-be duplicate (TCP-buffered copy + replay) shows up
+        got_payloads = []
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                m = await sub.recv(1.0)
+                got_payloads.append(m.payload)
+            except (TimeoutError, asyncio.TimeoutError):
+                if set(payloads) <= set(got_payloads):
+                    break
+        for _ in range(4):  # linger: catch stragglers/duplicates
+            try:
+                m = await sub.recv(1.0)
+                got_payloads.append(m.payload)
+            except (TimeoutError, asyncio.TimeoutError):
+                pass
+        assert sorted(got_payloads) == sorted(payloads), (
+            f"missing={set(payloads) - set(got_payloads)}, "
+            f"dupes={len(got_payloads) - len(set(got_payloads))}"
+        )
+        await sub.disconnect()
+        await pub.disconnect()
+
+    asyncio.run(asyncio.wait_for(main(), 240))
 
 
 def test_sigkill_purges_routes_and_survivor_serves(two_nodes):

@@ -150,11 +150,13 @@ def test_autoheal_partition_resync(run):
         attach(b, "c1", "t/1")
         await wait_until(lambda: "t/1" in a.remote.filters_of("b0"))
 
-        # partition: kill a's view of b (link down both ways)
+        # partition: kill a's view of b (link down both ways).  purge
+        # explicitly — a plain nodedown now holds routes for route_hold
+        # so transient flaps spool forwards instead of un-matching
         link = a.links["b0"]
         await link.stop()
-        a._node_down("b0")
-        assert a.remote.filters_of("b0") == set()  # purged on nodedown
+        a._node_down("b0", purge=True)
+        assert a.remote.filters_of("b0") == set()  # purged on explicit down
 
         # churn on b while partitioned
         attach(b, "c2", "t/2")

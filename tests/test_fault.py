@@ -1,0 +1,267 @@
+"""Fault-injection plane + self-healing unit tests.
+
+The chaos soak (`make chaos`, tools/chaos_soak.py) proves the
+end-to-end invariants; these tests pin the building blocks — plane
+determinism, action semantics, the engine device breaker + alarm
+lifecycle, and the forward spool (bound, replay, receiver dedup)."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu import fault
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.session import Session
+from emqx_tpu.cluster.node import ClusterBroker, ClusterNode
+from emqx_tpu.node import poll_health_alarms
+from emqx_tpu.observe.alarm import AlarmManager
+from emqx_tpu.observe.tracepoints import check_trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    fault.reset()
+    yield
+    fault.reset()
+
+
+# ------------------------------------------------------------------ plane
+
+def test_unknown_site_rejected():
+    with pytest.raises(ValueError):
+        fault.configure({"no/such/site": {"action": "drop"}})
+
+
+def test_unknown_action_rejected():
+    with pytest.raises(ValueError):
+        fault.configure({"transport.send": {"action": "explode"}})
+
+
+def test_disarmed_is_noop():
+    assert fault.inject("transport.send") is None
+    assert not fault.enabled()
+
+
+def test_deterministic_across_reconfigures():
+    def run_sequence():
+        fault.configure(
+            {"transport.send": {"action": "drop", "p": 0.5}}, seed=42
+        )
+        return [
+            fault.inject("transport.send") is not None for _ in range(64)
+        ]
+
+    first = run_sequence()
+    second = run_sequence()
+    assert first == second
+    assert any(first) and not all(first)  # p=0.5 actually mixes
+
+
+def test_seed_changes_sequence():
+    fault.configure({"transport.send": {"action": "drop", "p": 0.5}}, seed=1)
+    a = [fault.inject("transport.send") is not None for _ in range(64)]
+    fault.configure({"transport.send": {"action": "drop", "p": 0.5}}, seed=2)
+    b = [fault.inject("transport.send") is not None for _ in range(64)]
+    assert a != b
+
+
+def test_times_and_after_windows():
+    fault.configure(
+        {"cluster.rpc": {"action": "drop", "times": 2, "after": 3}}
+    )
+    hits = [fault.inject("cluster.rpc", err=False) is not None
+            for _ in range(10)]
+    assert hits == [False] * 3 + [True, True] + [False] * 5
+    st = fault.stats()["cluster.rpc"]
+    assert st["fired"] == 2 and st["arrivals"] == 10
+
+
+def test_error_action_raises_site_type_and_err_false_returns():
+    fault.configure({"cluster.rpc": {"action": "error"}})
+    with pytest.raises(ConnectionError):
+        fault.inject("cluster.rpc", err=ConnectionError)
+    a = fault.inject("cluster.rpc", err=False)
+    assert a is not None and a.kind == "error"
+    with pytest.raises(fault.FaultError):
+        fault.inject("cluster.rpc")
+
+
+def test_mangle_corrupts_and_fires_tracepoint():
+    fault.configure({"transport.send": {"action": "corrupt"}}, seed=3)
+    data = bytes(range(64))
+    with check_trace() as t:
+        out = fault.mangle("transport.send", data)
+    assert out != data and len(out) == len(data)
+    t.assert_seen("fault.inject", site="transport.send", action="corrupt")
+
+
+# ---------------------------------------------------------- engine breaker
+
+def test_engine_breaker_trip_probe_close_and_alarm():
+    from emqx_tpu.models.engine import TopicMatchEngine
+
+    eng = TopicMatchEngine(min_batch=8)
+    alarms = AlarmManager(node="t")
+    events = []
+    eng.on_breaker = events.append
+    with check_trace() as t:
+        for _ in range(eng.breaker_threshold - 1):
+            eng._note_dev_timeout()
+        assert not eng.breaker_open
+        eng._note_dev_timeout()
+    assert eng.breaker_open and eng.breaker_trips == 1
+    assert events == [True]
+    t.assert_seen("engine.breaker", state="open")
+    poll_health_alarms(eng, None, alarms)
+    assert alarms.is_active("engine_device_degraded")
+    # host-only arbitration while open
+    from emqx_tpu.observe.flight import R_BREAKER
+
+    eng.hybrid = True
+    if eng._host_ok():
+        assert eng._pick_host() == R_BREAKER
+    # a completed device round trip closes it and clears the alarm
+    with check_trace() as t:
+        eng._note_dev_ok()
+    assert not eng.breaker_open and events == [True, False]
+    t.assert_seen("engine.breaker", state="closed")
+    poll_health_alarms(eng, None, alarms)
+    assert not alarms.is_active("engine_device_degraded")
+
+
+# ------------------------------------------------------------ forward spool
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+class Sink:
+    def __init__(self, clientid, session):
+        self.clientid = clientid
+        self.session = session
+        self.got = []
+
+    def deliver(self, items):
+        self.got.extend(items)
+
+    def kick(self, reason_code=0):
+        pass
+
+
+def attach(node, clientid, filt, qos=1):
+    s = Session(clientid=clientid)
+    s.subscriptions[filt] = SubOpts(qos=qos)
+    sink = Sink(clientid, s)
+    node.broker.cm.register_channel(sink)
+    node.broker.subscribe(clientid, filt, SubOpts(qos=qos))
+    return sink
+
+
+async def wait_until(pred, timeout=15.0, ivl=0.02):
+    t = 0.0
+    while not pred():
+        await asyncio.sleep(ivl)
+        t += ivl
+        if t > timeout:
+            raise AssertionError("condition not reached")
+
+
+async def _two_nodes():
+    nodes = []
+    for i in range(2):
+        node = ClusterNode(
+            f"f{i}", ClusterBroker(), heartbeat_ivl=0.2, miss_limit=2,
+            reconnect_ivl=0.1, reconnect_max=0.5,
+        )
+        node.replay_timeout = 0.5
+        await node.start()
+        nodes.append(node)
+    nodes[0].join("f1", ("127.0.0.1", nodes[1].transport.port))
+    nodes[1].join("f0", ("127.0.0.1", nodes[0].transport.port))
+    await wait_until(
+        lambda: all(len(x.up_peers()) == 1 for x in nodes)
+    )
+    return nodes
+
+
+def test_spool_and_replay_exactly_once(run):
+    """QoS1 forwards failing their send spool, replay on heal, and the
+    receiver dedups — every message delivered exactly once."""
+
+    async def main():
+        n0, n1 = await _two_nodes()
+        sink = attach(n1, "c1", "sp/#", qos=1)
+        await wait_until(lambda: "sp/#" in n0.remote.filters_of("f1"))
+        # every direct send fails: QoS1 spools, QoS0 counts as dropped
+        fault.configure({"transport.send": {"action": "drop", "p": 1.0}})
+        for i in range(5):
+            n0.broker.publish(
+                Message(topic="sp/q", payload=f"m{i}".encode(), qos=1)
+            )
+        n0.broker.publish(Message(topic="sp/q", payload=b"q0", qos=0))
+        assert n0.spool_pending("f1") == 5
+        assert n0.broker.metrics.get("messages.forward.spooled") == 5
+        # qos0 is not spooled — it lands in the dropped counter
+        assert n0.broker.metrics.get("messages.forward.dropped") >= 1
+        assert not sink.got
+        fault.reset()
+        await wait_until(lambda: n0.spool_pending("f1") == 0)
+        await wait_until(lambda: len(sink.got) >= 5)
+        await asyncio.sleep(0.5)  # would-be duplicates arrive by now
+        payloads = sorted(m.payload for _f, m in sink.got)
+        assert payloads == [f"m{i}".encode() for i in range(5)]
+        assert n0.broker.metrics.get("messages.forward.replayed") == 5
+        await n0.stop()
+        await n1.stop()
+
+    run(main())
+
+
+def test_spool_overflow_drops_oldest_and_alarms(run):
+    async def main():
+        node = ClusterNode("solo", ClusterBroker(), spool_max_bytes=256)
+        alarms = AlarmManager(node="t")
+        header = {"topic": "x/y", "qos": 1, "mid": "00"}
+        for i in range(64):
+            node._spool_put("ghost", dict(header, mid=f"{i:02x}"),
+                            b"p" * 32)
+        assert node.spool_dropped > 0
+        assert node._spool_bytes["ghost"] <= 256
+        m = node.broker.metrics
+        assert m.get("messages.forward.spool_dropped") == node.spool_dropped
+        poll_health_alarms(node.broker.engine, node, alarms)
+        assert alarms.is_active("cluster_forward_spool_overflow")
+        # drain the spool -> the alarm clears
+        q = node._spools["ghost"]
+        ref, items = q.pop(1000)
+        q.ack(ref)
+        poll_health_alarms(node.broker.engine, node, alarms)
+        assert not alarms.is_active("cluster_forward_spool_overflow")
+
+    run(main())
+
+
+def test_heartbeat_miss_tracepoint_and_degraded(run):
+    """A missed ping emits cluster.peer.miss and degrades the peer
+    before the miss limit downs it; a successful ping restores it."""
+
+    async def main():
+        n0, n1 = await _two_nodes()
+        # every frame write on n0's links vanishes: pings go unanswered
+        fault.configure({"transport.send": {"action": "drop", "p": 1.0}})
+        with check_trace() as t:
+            await wait_until(
+                lambda: n0._status.get("f1") in ("degraded", "down"),
+                timeout=10,
+            )
+        t.assert_seen("cluster.peer.miss", peer="f1")
+        fault.reset()
+        await wait_until(lambda: n0._status.get("f1") == "up", timeout=10)
+        await n0.stop()
+        await n1.stop()
+
+    run(main())

@@ -18,6 +18,10 @@ this gate implements the highest-value checks directly on the stdlib:
      `observe/tracepoints.py` KNOWN_KINDS — dashboards and trace
      consumers key on these names, so an unregistered kind is an event
      nobody can subscribe to by contract (tests may emit ad-hoc kinds)
+  6. fault-site registry: every `fault.inject("<site>", ...)` (and
+     ainject/peek/mangle) in emqx_tpu/** must name a site registered in
+     `fault/sites.py` SITES — chaos schedules key on these names, and
+     an unregistered site can never be armed from config
 
 Exit code 0 = clean.  `--fix` is intentionally absent: findings are
 either real bugs or deliberate (suppressed via `# check: ignore` on the
@@ -268,6 +272,98 @@ def check_tracepoints(problems):
             )
 
 
+FAULT_FNS = {"inject", "ainject", "peek", "mangle"}
+
+
+def known_fault_sites():
+    """SITES keys, parsed statically from fault/sites.py (no import)."""
+    path = os.path.join(REPO, "emqx_tpu", "fault", "sites.py")
+    if not os.path.isfile(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), path)
+    for node in ast.walk(tree):
+        tgt = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            tgt = node.target
+        if (
+            isinstance(tgt, ast.Name)
+            and tgt.id == "SITES"
+            and isinstance(node.value, ast.Dict)
+        ):
+            return {
+                k.value
+                for k in node.value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+    return set()
+
+
+def collect_fault_calls():
+    """(path, lineno, site) for every `fault.<fn>("<site>", ...)` /
+    `_fault.<fn>(...)` call in the package (the fault package itself is
+    the implementation and is exempt)."""
+    out = []
+    pkg = os.path.join(REPO, "emqx_tpu")
+    skip = os.path.join(pkg, "fault")
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        if root.startswith(skip):
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path, "r", encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), path)
+                except SyntaxError:
+                    continue  # reported by the syntax pass
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in FAULT_FNS
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in ("fault", "_fault")
+                ):
+                    continue
+                if (
+                    node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    out.append((path, node.lineno, node.args[0].value))
+                else:
+                    out.append((path, node.lineno, None))  # non-literal
+    return out
+
+
+def check_fault_sites(problems):
+    known = known_fault_sites()
+    calls = collect_fault_calls()
+    if calls and not known:
+        problems.append(
+            "emqx_tpu/fault/sites.py: SITES registry missing"
+        )
+        return
+    for path, line, site in calls:
+        if site is None:
+            problems.append(
+                f"{path}:{line}: fault call with a non-literal site "
+                "(the registry lint needs a string literal)"
+            )
+        elif site not in known:
+            problems.append(
+                f"{path}:{line}: fault site {site!r} not registered in "
+                "emqx_tpu/fault/sites.py SITES"
+            )
+
+
 def check_native(problems):
     src_dir = os.path.join(REPO, "native")
     if not os.path.isdir(src_dir):
@@ -305,6 +401,7 @@ def main() -> int:
         check_undefined(path, src, tree, problems, ignored)
         check_ast_lints(path, src, tree, problems, ignored)
     check_tracepoints(problems)
+    check_fault_sites(problems)
     check_native(problems)
     for p in problems:
         print(p)
