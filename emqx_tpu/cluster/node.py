@@ -449,34 +449,49 @@ class ClusterNode:
             for peer, link in list(self.links.items()):
                 if not link.connected:
                     continue
+                # the heartbeat task is bare (no supervisor): any
+                # exception besides the expected ping failures — e.g. a
+                # bug in the degraded/recovered bookkeeping — must
+                # degrade to a logged skipped beat, not silently kill
+                # peer-health detection for the node's lifetime
                 try:
-                    await link.request(tp.PING, {}, timeout=self.heartbeat_ivl * 2)
-                except (RpcError, OSError) as e:
-                    # RpcError: timeout / link raced down; OSError: the
-                    # write itself failed on a dying socket.  (The old
-                    # `except (RpcError, Exception)` swallowed everything
-                    # — including bugs in this loop — silently.)
-                    misses = self._misses[peer] = self._misses.get(peer, 0) + 1
-                    tracept("cluster.peer.miss", peer=peer, misses=misses,
-                            error=str(e) or type(e).__name__)
-                    if misses >= self.miss_limit:
-                        self._node_down(peer)
-                    elif self._status.get(peer) == "up":
-                        self._status[peer] = "degraded"
+                    try:
+                        await link.request(
+                            tp.PING, {}, timeout=self.heartbeat_ivl * 2
+                        )
+                    except (RpcError, OSError) as e:
+                        # RpcError: timeout / link raced down; OSError:
+                        # the write itself failed on a dying socket
+                        misses = self._misses[peer] = (
+                            self._misses.get(peer, 0) + 1
+                        )
+                        tracept("cluster.peer.miss", peer=peer,
+                                misses=misses,
+                                error=str(e) or type(e).__name__)
+                        if misses >= self.miss_limit:
+                            self._node_down(peer)
+                        elif self._status.get(peer) == "up":
+                            self._status[peer] = "degraded"
+                            tracept("cluster.peer.health", peer=peer,
+                                    state="degraded")
+                        continue
+                    self._misses[peer] = 0
+                    st = self._status.get(peer)
+                    if st == "degraded":
+                        self._status[peer] = "up"
                         tracept("cluster.peer.health", peer=peer,
-                                state="degraded")
-                    continue
-                self._misses[peer] = 0
-                st = self._status.get(peer)
-                if st == "degraded":
-                    self._status[peer] = "up"
-                    tracept("cluster.peer.health", peer=peer, state="up")
-                elif st == "down":
-                    self._peer_recovered(peer)
-                elif self.spool_pending(peer):
-                    # link healthy but spooled backlog remains (e.g. the
-                    # last replay aborted mid-fault): keep draining
-                    self._kick_replay(peer)
+                                state="up")
+                    elif st == "down":
+                        self._peer_recovered(peer)
+                    elif self.spool_pending(peer):
+                        # link healthy but spooled backlog remains (e.g.
+                        # the last replay aborted mid-fault): keep
+                        # draining
+                        self._kick_replay(peer)
+                except Exception:
+                    log.exception(
+                        "heartbeat: bookkeeping for peer %s failed", peer
+                    )
 
     def status(self) -> Dict[str, str]:
         return dict(self._status)
@@ -682,12 +697,18 @@ class ClusterNode:
             q = self._spools[node] = ReplayQ()
             self._spool_bytes[node] = 0
         body = tp.pack_forward_body(header, payload)
+        # drop_oldest (NOT pop+ack) so an overflow during an in-flight
+        # replay batch cannot ack past the replayer's popped-unacked
+        # window — those records stay requeue-able on a mid-replay
+        # failure.  With the whole queue in flight (count()==0) the
+        # bound is exceeded by at most one replay batch.
         while (
             self._spool_bytes[node] + len(body) > self.spool_max_bytes
             and q.count()
         ):
-            ref, items = q.pop(1)
-            q.ack(ref)
+            items = q.drop_oldest(1)
+            if not items:
+                break
             lost = len(items)
             self.spool_dropped += lost
             self._spool_bytes[node] -= sum(len(i) for i in items)
@@ -758,8 +779,10 @@ class ClusterNode:
 
         Fire-and-forget like `forward_async` (`emqx_broker.erl:277-292`);
         for acked forwarding use `forward_publish_sync`.  A failed send
-        is never silent: QoS>=1 messages spool for replay on heal,
-        QoS0 ones land in `messages.forward.dropped`.
+        is never silent: QoS>=1 messages spool for replay on heal when
+        a PeerLink to the node exists; everything else (QoS0, or an
+        unlinked peer whose relay failed) lands in
+        `messages.forward.dropped`.
         """
         per_node = self._match_remote(msgs)
         n = 0
@@ -796,9 +819,13 @@ class ClusterNode:
                     sent = relay.send_nowait(tp.pack_forward(h2, payload))
                 if sent:
                     n += 1
-                elif msg.qos >= 1:
+                elif msg.qos >= 1 and link is not None:
                     self._spool_put(node, header, payload)
                 else:
+                    # QoS0, or a peer we hold no PeerLink for (replicant->
+                    # replicant) whose core relay failed: replay needs a
+                    # direct link, so a spool record for an unlinked peer
+                    # would sit forever — count the loss instead
                     metrics.inc("messages.forward.dropped")
         if n:
             metrics.inc("messages.forward.out", n)
@@ -824,6 +851,11 @@ class ClusterNode:
         for node, node_msgs in per_node.items():
             link = self.links.get(node)
             if link is None:
+                # sync mode has no relay/spool path for unlinked peers:
+                # make the loss visible instead of skipping silently
+                self.broker.metrics.inc(
+                    "messages.forward.dropped", len(node_msgs)
+                )
                 continue
             for msg in node_msgs:
                 header, payload = message_to_wire(msg)
@@ -881,7 +913,7 @@ class ClusterNode:
                 ok = relay.send_nowait(tp.pack_forward(h2, payload))
         if ok:
             self.broker.metrics.inc("messages.forward.shared")
-        elif msg.qos >= 1:
+        elif msg.qos >= 1 and link is not None:
             # accept responsibility: spool for replay on heal (returning
             # False would make the caller pick ANOTHER node, and the
             # replay would then double-deliver to the group)
@@ -889,6 +921,10 @@ class ClusterNode:
             self.broker.metrics.inc("messages.forward.shared")
             ok = True
         else:
+            # QoS0, or an unlinked peer (replicant->replicant) with the
+            # relay down: no spool-replay path exists for it, so report
+            # the failure honestly — the caller may repick another
+            # member node (no double-delivery risk: nothing was queued)
             self.broker.metrics.inc("messages.forward.dropped")
         return bool(ok)
 
@@ -906,9 +942,16 @@ class ClusterNode:
                 nodes = sorted(self.remote.shared_nodes(group, filt))
                 if not nodes:
                     continue
-                node = nodes[self._shared_rng.randrange(len(nodes))]
-                if self.forward_shared(node, msg, group, filt):
-                    n += 1
+                # forward_shared returns False only when it accepted NO
+                # delivery responsibility (nothing sent, nothing
+                # spooled), so trying the next candidate cannot
+                # double-deliver to the group
+                start = self._shared_rng.randrange(len(nodes))
+                for i in range(len(nodes)):
+                    node = nodes[(start + i) % len(nodes)]
+                    if self.forward_shared(node, msg, group, filt):
+                        n += 1
+                        break
         return n
 
     def _on_forward(self, peer: str, header: dict, payload: bytes):
@@ -962,7 +1005,7 @@ class ClusterNode:
         if link is None:
             raise RpcError(f"unknown peer {peer!r}")
         if _fault.enabled():
-            a = _fault.inject("cluster.rpc", err=RpcError)
+            a = await _fault.ainject("cluster.rpc", err=RpcError)
             if a is not None and a.kind == "drop":
                 raise RpcError(f"rpc to {peer} dropped (fault)")
         # bpapi gate: refuse calls the peer announced it cannot serve

@@ -14,6 +14,9 @@ production code; a *schedule* (the `fault.spec` config map, or
 
 Actions:
     delay    sleep `delay` seconds (async sites use `ainject`), proceed
+             — rejected for LOOP_SYNC_SITES (sites.py): a blocking
+             sleep at a sync site on the event loop would freeze the
+             whole loop, not just the targeted path
     drop     the call site discards the frame / reports failure
     error    raise (the site's natural exception type, or FaultError)
     corrupt  the call site mangles the payload (`Action.corrupt`)
@@ -41,7 +44,7 @@ import time
 from typing import Any, Dict, Optional
 
 from ..observe.tracepoints import tp
-from .sites import SITES
+from .sites import LOOP_SYNC_SITES, SITES
 
 ACTIONS = ("delay", "drop", "error", "corrupt")
 
@@ -80,6 +83,13 @@ class _Site:
         if kind not in ACTIONS:
             raise ValueError(
                 f"fault site {name!r}: action {kind!r} not in {ACTIONS}"
+            )
+        if kind == "delay" and name in LOOP_SYNC_SITES:
+            raise ValueError(
+                f"fault site {name!r}: 'delay' runs time.sleep on the "
+                f"asyncio event loop at this sync site, freezing every "
+                f"link/heartbeat/replay — use drop/error/corrupt here, "
+                f"or delay an async site (transport.dial/recv)"
             )
         self.name = name
         self.kind = kind

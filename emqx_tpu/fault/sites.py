@@ -41,3 +41,13 @@ SITES: Dict[str, str] = {
     "sharded.collect": "sharded engine device resolve (delay only: the "
                        "mesh path has no host fallback)",
 }
+
+# Sites whose injector runs SYNCHRONOUSLY on the asyncio event-loop
+# thread (send_nowait/request writes, the forward fan-out): a `delay`
+# action there would time.sleep the whole loop — every link, heartbeat,
+# and replay stalls, not just the targeted site — so `configure()`
+# rejects delay specs for them.  To slow these paths, delay the async
+# sites around them (transport.dial/recv) instead.  ckpt.* runs on
+# worker/boot threads and the engine collect paths block by design
+# (a delay there IS the simulated device stall), so they stay eligible.
+LOOP_SYNC_SITES = frozenset({"transport.send", "cluster.forward"})

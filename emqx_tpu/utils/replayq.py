@@ -51,6 +51,10 @@ class ReplayQ:
         self._next_seq = 1  # seqno of the next appended item
         self._acked = 0  # highest durably-consumed seqno
         self._popped = 0  # highest seqno handed out by pop()
+        # seqnos evicted by drop_oldest() that the ack cursor has not
+        # yet passed: they are gaps in the live seq space, subtracted
+        # from pending_count() and absorbed as acks advance
+        self._drop_gaps: Deque[int] = deque()
         self._segs: List[List] = []  # [first, last, path, nbytes]
         self._disk_bytes = 0  # all segments, tracked incrementally
         self._cur = None  # open segment file handle
@@ -177,6 +181,8 @@ class ReplayQ:
                 self._acked = last
             if self._popped < last:
                 self._popped = last
+            while self._drop_gaps and self._drop_gaps[0] <= self._acked:
+                self._drop_gaps.popleft()
 
     # -------------------------------------------------------------- pop
 
@@ -209,11 +215,56 @@ class ReplayQ:
             seq -= 1
         self._popped = max(seq, self._acked)
 
+    def drop_oldest(self, count: int = 1) -> List[bytes]:
+        """Overflow eviction: remove up to `count` of the oldest UNPOPPED
+        items and return them (caller accounting; they count toward
+        `dropped`).  Unlike pop()+ack(), this never advances the ack
+        cursor past a consumer's popped-but-unacked batch — an in-flight
+        pop() window survives a concurrent eviction and can still be
+        requeued and replayed.  The evicted seqnos become gaps that are
+        absorbed lazily as the ack cursor reaches them (on disk, an
+        unabsorbed gap may re-deliver after a crash — at-least-once,
+        same as a lost ack writeback)."""
+        out: List[bytes] = []
+        while self._items and len(out) < count:
+            seq, item = self._items.popleft()
+            self._drop_gaps.append(seq)
+            out.append(item)
+        if not out:
+            return out
+        self.dropped += len(out)
+        prev = self._acked
+        self._absorb_drop_gaps()
+        if self._acked != prev:
+            self._persist_ack()
+        return out
+
+    def _absorb_drop_gaps(self) -> None:
+        # with no in-flight pop window, the ack cursor may advance over
+        # evicted seqnos adjacent to it (drops always come off the head,
+        # so the gaps it meets are contiguous) — keeps pending_count()
+        # honest and lets disk segments of dropped records be reclaimed
+        while (
+            self._popped == self._acked
+            and self._drop_gaps
+            and self._drop_gaps[0] == self._acked + 1
+        ):
+            self._drop_gaps.popleft()
+            self._acked += 1
+            self._popped = self._acked
+
     def ack(self, ack_ref: int) -> None:
         """Commit consumption up to ack_ref (a pop's returned ref)."""
-        if ack_ref <= self._acked:
-            return
-        self._acked = ack_ref
+        prev = self._acked
+        if ack_ref > self._acked:
+            self._acked = ack_ref
+        while self._drop_gaps and self._drop_gaps[0] <= self._acked:
+            self._drop_gaps.popleft()
+        self._absorb_drop_gaps()
+        if self._acked != prev:
+            self._persist_ack()
+
+    def _persist_ack(self) -> None:
         if self.dir is None:
             return
         tmp = self._commit_path() + ".tmp"
@@ -242,10 +293,11 @@ class ReplayQ:
         return len(self._items)
 
     def pending_count(self) -> int:
-        """Appended-but-unacked records (including popped-unacked ones) —
-        the durable backlog a consumer still owes an ack for.  The churn
-        WAL's snapshot threshold reads this (`checkpoint/manager.py`)."""
-        return max(0, self._next_seq - 1 - self._acked)
+        """Appended-but-unacked records (including popped-unacked ones,
+        excluding drop_oldest() evictions) — the durable backlog a
+        consumer still owes an ack for.  The churn WAL's snapshot
+        threshold reads this (`checkpoint/manager.py`)."""
+        return max(0, self._next_seq - 1 - self._acked - len(self._drop_gaps))
 
     def pending_bytes(self) -> int:
         """Byte size of the unacked backlog.  Disk mode reports the live

@@ -87,6 +87,23 @@ def test_error_action_raises_site_type_and_err_false_returns():
         fault.inject("cluster.rpc")
 
 
+def test_delay_rejected_on_loop_sync_sites():
+    """`delay` would time.sleep the asyncio loop at sync sites
+    (send_nowait, the forward fan-out) — configure() refuses it there;
+    async and worker-thread sites still accept delay."""
+    with pytest.raises(ValueError):
+        fault.configure({"transport.send": {"action": "delay"}})
+    with pytest.raises(ValueError):
+        fault.configure({"cluster.forward": {"action": "delay",
+                                             "delay": 0.1}})
+    fault.configure({
+        "transport.dial": {"action": "delay", "delay": 0.01},
+        "cluster.rpc": {"action": "delay", "delay": 0.01},
+        "ckpt.write": {"action": "delay", "delay": 0.01},
+    })
+    assert fault.enabled()
+
+
 def test_mangle_corrupts_and_fires_tracepoint():
     fault.configure({"transport.send": {"action": "corrupt"}}, seed=3)
     data = bytes(range(64))
@@ -241,6 +258,67 @@ def test_spool_overflow_drops_oldest_and_alarms(run):
         q.ack(ref)
         poll_health_alarms(node.broker.engine, node, alarms)
         assert not alarms.is_active("cluster_forward_spool_overflow")
+
+    run(main())
+
+
+def test_spool_overflow_during_inflight_replay_keeps_batch(run):
+    """Overflow eviction while a replay batch is popped-but-unacked must
+    not commit past the in-flight records: a failed replay still
+    requeues them, and the byte accounting converges to zero when the
+    spool finally drains (no permanently-shrunk capacity)."""
+
+    async def main():
+        node = ClusterNode("solo", ClusterBroker(), spool_max_bytes=512)
+        header = {"topic": "x/y", "qos": 1}
+
+        def put(i):
+            node._spool_put("ghost", dict(header, mid=f"{i:02x}"),
+                            b"p" * 64)
+
+        for i in range(4):
+            put(i)
+        q = node._spools["ghost"]
+        ref, batch = q.pop(2)  # replayer holds two records in flight
+        for i in range(4, 40):  # overflow fires during the in-flight
+            put(i)
+        assert node.spool_dropped > 0
+        q.requeue(ref, batch)  # the replay failed mid-fault
+        delivered = []
+        while q.count():
+            r, items = q.pop(100)
+            delivered.extend(items)
+            q.ack(r)
+            node._spool_bytes["ghost"] -= sum(len(i) for i in items)
+        # the in-flight batch survived the concurrent eviction...
+        assert all(b in delivered for b in batch)
+        # ...and dropped records were debited exactly once: a full
+        # drain leaves zero bytes and zero pending
+        assert node._spool_bytes["ghost"] == 0
+        assert q.pending_count() == 0
+        assert node.spool_pending("ghost") == 0
+
+    run(main())
+
+
+def test_unlinked_peer_forwards_drop_not_spool(run):
+    """QoS>=1 forwards to a peer this node holds no PeerLink for
+    (replicant->replicant with the core relay down) must not spool —
+    nothing would ever replay them.  They count as dropped, and
+    forward_shared reports failure so the caller can repick."""
+
+    async def main():
+        node = ClusterNode("solo", ClusterBroker())
+        msg = Message(topic="a/b", payload=b"x", qos=1)
+        ok = node.forward_shared("ghost", msg, "g1", "a/#")
+        assert ok is False
+        assert node.spool_pending("ghost") == 0
+        assert node.broker.metrics.get("messages.forward.dropped") == 1
+        # generic forward path: route to an unlinked peer, same refusal
+        node.remote.load_snapshot("ghost", 1, 0, ["a/#"], [])
+        assert node.forward_publish([msg]) == 0
+        assert node.spool_pending("ghost") == 0
+        assert node.broker.metrics.get("messages.forward.dropped") == 2
 
     run(main())
 

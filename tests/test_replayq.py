@@ -162,6 +162,52 @@ def test_pending_accessors(tmp_path):
     q3.close()
 
 
+def test_drop_oldest_preserves_inflight_pop_window():
+    """Overflow eviction during an in-flight pop must not commit past
+    the consumer's popped-unacked batch: a failed batch still requeues
+    and replays in full (the spool-overflow-during-replay hazard)."""
+    q = ReplayQ()
+    for i in range(6):
+        q.append(b"m%d" % i)
+    ref, batch = q.pop(4)  # m0..m3 in flight with a consumer
+    assert q.drop_oldest(1) == [b"m4"]  # evicts the oldest UNPOPPED
+    assert q.dropped == 1
+    # pending excludes the evicted record but keeps the in-flight batch
+    assert q.pending_count() == 5
+    q.requeue(ref, batch)  # the in-flight delivery failed
+    ref2, replayed = q.pop(10)
+    assert replayed == [b"m0", b"m1", b"m2", b"m3", b"m5"]
+    q.ack(ref2)
+    assert q.pending_count() == 0 and q.count() == 0
+
+
+def test_drop_oldest_absorbs_without_consumer():
+    """With no in-flight pop window the eviction is committed directly,
+    so pending_count() reflects the drop immediately."""
+    q = ReplayQ()
+    q.append(b"a")
+    q.append(b"b")
+    assert q.drop_oldest(1) == [b"a"]
+    assert q.pending_count() == 1
+    ref, items = q.pop(5)
+    assert items == [b"b"]
+    q.ack(ref)
+    assert q.pending_count() == 0
+
+
+def test_drop_oldest_gap_absorbed_when_inflight_acks():
+    """An eviction gap sitting above the in-flight window is absorbed
+    once that window acks — the backlog converges to zero."""
+    q = ReplayQ()
+    for i in range(3):
+        q.append(b"m%d" % i)
+    ref, batch = q.pop(2)  # m0,m1 in flight
+    assert q.drop_oldest(5) == [b"m2"]  # only unpopped items evict
+    assert q.pending_count() == 2
+    q.ack(ref)  # delivery confirmed
+    assert q.pending_count() == 0 and q.count() == 0
+
+
 # ------------------------------------------------------ durable bridge
 
 
