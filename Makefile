@@ -1,7 +1,8 @@
 # Build/check entry points (the reference's `make` + rebar gates analog:
 # /root/reference/Makefile, rebar.config:16-36 dialyzer/xref/elvis).
 
-.PHONY: check lint test test-fast native bench restore-bench chaos
+.PHONY: check lint test test-fast native bench restore-bench chaos \
+        ds-bench ds-dump ds-soak
 
 # static-analysis gate: stdlib implementation (mypy/ruff are not in this
 # image and installs are off-limits — see tools/check.py header)
@@ -33,3 +34,20 @@ restore-bench:
 # breaker + alarm lifecycle, spool drain (tools/chaos_soak.py)
 chaos:
 	python tools/chaos_soak.py --seeds 5
+
+# offline-fanout bench: N parked sessions x M offline messages —
+# durable-log replay resume vs the legacy per-session JSON snapshot
+# path (park-tick cost + restore + resume latency); writes the
+# BENCH_TABLE.md section
+ds-bench:
+	python bench.py --ds
+
+# inspect a durable-message-log directory (symmetric with ckpt_dump):
+#   make ds-dump DIR=data/ds
+ds-dump:
+	python tools/ds_dump.py $(DIR) --records 3
+
+# ds crash front only: kill -9 a real appender child mid-flush across
+# 5 seeds; committed prefix must replay, (mid) dedup = exactly-once
+ds-soak:
+	python tools/chaos_soak.py --fronts ds --seeds 5

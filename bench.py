@@ -1122,6 +1122,196 @@ def _update_restore_table(s: dict) -> None:
     log("updated BENCH_TABLE.md restore section")
 
 
+def run_ds(n_sessions=500, n_msgs=100):
+    """Offline-fanout replay bench (`ds/`): N parked persistent sessions
+    x M QoS1 offline messages, durable-log cursors vs the legacy
+    per-session JSON snapshot path.
+
+    Measures, per side:
+      * park_tick_ms  — steady-state housekeeping cost with all offline
+        traffic landed: legacy rewrites every dirty session's full
+        mqueue JSON (O(sessions x queue depth)); ds fsyncs the
+        coalesced log tail (O(bytes), and the session files are static);
+      * restore_ms    — boot-path store load (legacy parses N x M
+        messages; ds parses N cursor records);
+      * resume_ms     — first session resume after boot (legacy: the
+        mqueue came with the file; ds: replay M messages from the log);
+      * resume_total_ms = restore + resume — the reconnecting client's
+        actual wait, the acceptance gate's "resume latency".
+
+    Both sides end with the resumed session holding exactly M messages
+    (parity-checked before any number is reported).  Runs on the CPU
+    backend — the work under test is host-side durability IO.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+    from emqx_tpu.broker.persist import DiscBackend, SessionPersistence
+    from emqx_tpu.broker.session import Session
+    from emqx_tpu.config.config import Config
+    from emqx_tpu.ds.manager import DsManager
+
+    def park_all(b, p):
+        for i in range(n_sessions):
+            cid = f"park-{i}"
+            s = Session(clientid=cid, expiry_interval=3600,
+                        max_mqueue=0)
+            s.subscriptions["bench/ds/#"] = SubOpts(qos=1)
+            b.subscribe(cid, "bench/ds/#", SubOpts(qos=1))
+            b.cm.pending[cid] = (s, float("inf"))
+            p._on_park(cid, s, float("inf"))
+
+    def publish_all(b):
+        msgs = [
+            Message(topic=f"bench/ds/{i % 8}",
+                    payload=f"offline-{i:05d}".encode(), qos=1)
+            for i in range(n_msgs)
+        ]
+        for i in range(0, len(msgs), 64):
+            b.publish_many(msgs[i:i + 64])
+
+    def ds_mgr(b, d):
+        conf = Config({"ds": {"enable": True, "shards": 4,
+                              "flush_bytes": 1 << 30}})  # tick-driven
+        mgr = DsManager(b, os.path.join(d, "ds"), conf,
+                        metrics=b.metrics)
+        b.ds = mgr
+        return mgr
+
+    out = {}
+    for mode in ("legacy", "ds"):
+        d = tempfile.mkdtemp(prefix=f"ds-bench-{mode}-")
+        try:
+            b = Broker()
+            mgr = ds_mgr(b, d) if mode == "ds" else None
+            p = SessionPersistence(b, DiscBackend(
+                os.path.join(d, "sess")))
+            park_all(b, p)
+            publish_all(b)
+            # steady-state park tick: everything offline-queued, flush
+            t0 = time.time()
+            p.tick()
+            if mgr is not None:
+                mgr.tick(now=1e18)  # force the interval flush + GC
+            park_tick_ms = (time.time() - t0) * 1e3
+            if mgr is not None:
+                mgr.close()
+
+            # boot: fresh broker restores the store
+            b2 = Broker()
+            mgr2 = ds_mgr(b2, d) if mode == "ds" else None
+            p2 = SessionPersistence(b2, DiscBackend(
+                os.path.join(d, "sess")))
+            t0 = time.time()
+            n_restored = p2.restore()
+            restore_ms = (time.time() - t0) * 1e3
+            assert n_restored == n_sessions, (mode, n_restored)
+
+            # first resume: the reconnecting client's replay
+            t0 = time.time()
+            s, present = b2.cm.open_session(
+                False, "park-0", lambda: Session(clientid="park-0"))
+            resume_ms = (time.time() - t0) * 1e3
+            assert present, mode
+            got = len(s.mqueue) + len(s.inflight)
+            assert got == n_msgs, (mode, got, n_msgs)
+            if mgr2 is not None:
+                mgr2.close()
+            out[mode] = {
+                "park_tick_ms": park_tick_ms,
+                "restore_ms": restore_ms,
+                "resume_ms": resume_ms,
+                "resume_total_ms": restore_ms + resume_ms,
+            }
+            log(f"{mode}: park-tick {park_tick_ms:,.1f} ms, "
+                f"restore {restore_ms:,.1f} ms, "
+                f"resume {resume_ms:,.1f} ms")
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    stats = {
+        "n_sessions": n_sessions,
+        "n_msgs": n_msgs,
+        "legacy": out["legacy"],
+        "ds": out["ds"],
+        "park_tick_speedup":
+            out["legacy"]["park_tick_ms"]
+            / max(out["ds"]["park_tick_ms"], 1e-9),
+        "resume_speedup":
+            out["legacy"]["resume_total_ms"]
+            / max(out["ds"]["resume_total_ms"], 1e-9),
+    }
+    log(f"offline fanout ({n_sessions} sessions x {n_msgs} msgs): "
+        f"park-tick {stats['park_tick_speedup']:.1f}x, "
+        f"resume {stats['resume_speedup']:.1f}x vs legacy snapshots")
+    _update_ds_table(stats)
+    return stats
+
+
+DS_HEADER = "## Durable message log (offline-fanout replay)"
+
+
+def _update_ds_table(s: dict) -> None:
+    """Write the ds-bench section into BENCH_TABLE.md, replacing any
+    previous run's (same ownership contract as the restore section)."""
+    path = "BENCH_TABLE.md"
+    lines = []
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    out, skipping = [], False
+    for line in lines:
+        if line.strip() == DS_HEADER:
+            skipping = True
+            continue
+        if skipping and line.startswith("## "):
+            skipping = False
+        if not skipping:
+            out.append(line)
+    while out and not out[-1].strip():
+        out.pop()
+    leg, ds = s["legacy"], s["ds"]
+    out += [
+        "",
+        DS_HEADER,
+        "",
+        "N parked persistent sessions x M QoS1 offline messages "
+        "(fanout: every message matches every session).  `legacy` = "
+        "per-session JSON mqueue snapshots (`broker/persist.py`), "
+        "re-written whole on every housekeeping tick; `ds` = the "
+        "shared durable log (`emqx_tpu/ds/`): one append per message, "
+        "static cursor-form session files, mqueue rebuilt by cursor "
+        "replay on resume.  park-tick = steady-state flush cost with "
+        "all offline traffic landed; resume = boot restore + first "
+        "session resume (the reconnecting client's wait).  Measured "
+        "by `python bench.py --ds` (`make ds-bench`) on the CPU "
+        "backend — the work under test is host-side durability IO.",
+        "",
+        "| sessions | offline msgs | metric | legacy | ds | speedup |",
+        "|---|---|---|---|---|---|",
+        f"| {s['n_sessions']:,} | {s['n_msgs']:,} | park-tick ms "
+        f"| {leg['park_tick_ms']:,.1f} | {ds['park_tick_ms']:,.1f} "
+        f"| {s['park_tick_speedup']:.1f}x |",
+        f"| {s['n_sessions']:,} | {s['n_msgs']:,} | restore ms "
+        f"| {leg['restore_ms']:,.1f} | {ds['restore_ms']:,.1f} "
+        f"| {leg['restore_ms'] / max(ds['restore_ms'], 1e-9):.1f}x |",
+        f"| {s['n_sessions']:,} | {s['n_msgs']:,} | resume ms "
+        "(restore + replay) "
+        f"| {leg['resume_total_ms']:,.1f} "
+        f"| {ds['resume_total_ms']:,.1f} "
+        f"| {s['resume_speedup']:.1f}x |",
+        "",
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(out))
+    log("updated BENCH_TABLE.md durable-message-log section")
+
+
 def _next_pow2_int(n: int) -> int:
     p = 1
     while p < n:
@@ -1313,7 +1503,29 @@ def main() -> None:
                     help="time snapshot+WAL warm restore vs cold table "
                          "rebuild at 100k filters; writes the "
                          "restore_ms/rebuild_ms row into BENCH_TABLE.md")
+    ap.add_argument("--ds", action="store_true",
+                    help="offline-fanout replay bench: N parked sessions "
+                         "x M offline messages, durable-log cursors vs "
+                         "legacy per-session JSON snapshots; writes the "
+                         "BENCH_TABLE.md section")
     ns = ap.parse_args()
+    if ns.ds:
+        stats = run_ds()
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
+        print(json.dumps({
+            "metric": "ds_offline_fanout_resume_speedup",
+            "value": round(stats["resume_speedup"], 2),
+            "unit": "x_vs_legacy_snapshots",
+            "park_tick_speedup": round(stats["park_tick_speedup"], 2),
+            "legacy_resume_ms": round(
+                stats["legacy"]["resume_total_ms"], 1),
+            "ds_resume_ms": round(stats["ds"]["resume_total_ms"], 1),
+            "n_sessions": stats["n_sessions"],
+            "n_msgs": stats["n_msgs"],
+        }))
+        return
     if ns.restore:
         stats = run_restore(ns.subs or 100_000)
         if ns.emit_stats:

@@ -71,6 +71,10 @@ class Broker:
         self.retainer = retainer or Retainer()
         self.shared = shared or SharedSub()
         self.metrics = metrics or Metrics()
+        # durable message log (ds/DsManager when ds.enable): QoS>=1
+        # publishes reaching parked cursor-holding sessions append to
+        # the shared log instead of per-session mqueues
+        self.ds = None
         self._routes: Dict[int, Route] = {}  # fid -> fan-out record
         self.subs = SubscriberShards()  # fid -> sharded subscriber lists
         self._sub_count = 0
@@ -513,7 +517,18 @@ class Broker:
         if session is None:
             return 0
         # offline persistent session: queue per matched filter, honoring
-        # the same subopts Session.deliver applies online
+        # the same subopts Session.deliver applies online.  With the
+        # durable log enabled and the session holding a replay cursor,
+        # QoS>=1 copies live in the SHARED log instead — appended once
+        # per message (mid-deduped across parked receivers) and
+        # reconstructed by cursor replay on resume; shared-group copies
+        # stay on the in-memory path (exactly-one-member ownership).
+        use_ds = (
+            self.ds is not None
+            and msg.qos >= 1
+            and not msg.headers.get("shared")
+            and session.ds_cursor is not None
+        )
         n = 0
         for f in filts:
             opts = session.subscriptions.get(f)
@@ -521,12 +536,17 @@ class Broker:
                 continue
             if opts.no_local and msg.from_client == session.clientid:
                 continue
+            if use_ds:
+                n += 1
+                continue
             qos = max(msg.qos, opts.qos) if session.upgrade_qos else min(msg.qos, opts.qos)
             from dataclasses import replace
 
             session.enqueue(replace(msg, qos=qos))
             n += 1
         if n:
+            if use_ds:
+                self.ds.on_offline_publish(msg)
             self.metrics.inc("messages.queued", n)
             p = getattr(self, "persistence", None)
             if p is not None:

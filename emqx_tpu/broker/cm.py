@@ -33,8 +33,10 @@ class ConnectionManager:
         self.on_discard: Optional[Callable[[Session], None]] = None
         # fires when a disconnected session is parked (persistence point)
         self.on_park: Optional[Callable[[str, Session, float], None]] = None
-        # fires when a parked session is resumed by a reconnect
-        self.on_resume: Optional[Callable[[str], None]] = None
+        # fires when a parked session is resumed by a reconnect; the
+        # session rides along so the durable-log replay can rebuild its
+        # mqueue before the channel takes over (ds/manager.py)
+        self.on_resume: Optional[Callable[[str, Session], None]] = None
         # v5 Will Delay Interval (MQTT-3.1.3.2.2): a will scheduled at
         # disconnect, published when the delay passes or the session
         # ends — whichever first — and cancelled by a resume.
@@ -87,7 +89,7 @@ class ConnectionManager:
             session, expire_at = ent
             if time.time() < expire_at or session.expiry_interval == 0xFFFFFFFF:
                 if self.on_resume:
-                    self.on_resume(clientid)
+                    self.on_resume(clientid, session)
                 # resumed before the will delay elapsed: the will MUST
                 # NOT be sent (MQTT-3.1.3-9)
                 self.cancel_will(clientid)

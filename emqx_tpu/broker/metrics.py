@@ -76,6 +76,13 @@ PREDEFINED = [
     "engine.ckpt.save_failures",
     "engine.ckpt.restores",
     "engine.ckpt.wal_records",
+    # durable message log (ds/manager.py; gauges ds.bytes|segments|lag
+    # ride the gauge table via DsManager.sync_metrics)
+    "ds.appends",
+    "ds.flushes",
+    "ds.replays",
+    "ds.replayed_messages",
+    "ds.gc_segments",
     # self-healing cluster data plane (cluster/node.py forward spool)
     "messages.forward.spooled",
     "messages.forward.replayed",

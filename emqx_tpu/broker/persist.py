@@ -86,8 +86,17 @@ def message_from_dict(d: dict) -> Message:
     )
 
 
-def session_to_dict(s: Session, expire_at: float) -> dict:
-    return {
+def session_to_dict(
+    s: Session, expire_at: float, cursor: Optional[dict] = None
+) -> dict:
+    """Session snapshot dict.
+
+    Legacy form (cursor=None) embeds the whole mqueue — the
+    O(queue depth) rewrite the durable log replaces.  Cursor form
+    (`ds.enable`) persists only (subscriptions, inflight, dedup,
+    cursor): the mqueue is reconstructed by replaying the shared log
+    from the per-shard cursor on resume (ds/manager.py)."""
+    d = {
         "clientid": s.clientid,
         "expiry_interval": s.expiry_interval,
         "expire_at": None if expire_at == float("inf") else expire_at,
@@ -114,6 +123,10 @@ def session_to_dict(s: Session, expire_at: float) -> dict:
         ],
         "awaiting_rel": list(s.awaiting_rel.keys()),
     }
+    if cursor is not None:
+        del d["mqueue"]
+        d["cursor"] = {str(k): list(v) for k, v in cursor.items()}
+    return d
 
 
 def session_from_dict(d: dict) -> Session:
@@ -147,6 +160,11 @@ def session_from_dict(d: dict) -> Session:
         )
     for pid in d.get("awaiting_rel") or []:
         s.awaiting_rel[pid] = now
+    if d.get("cursor") is not None:
+        s.ds_cursor = {
+            int(k): (int(v[0]), int(v[1]))
+            for k, v in d["cursor"].items()
+        }
     return s
 
 
@@ -244,8 +262,23 @@ class SessionPersistence:
 
     # ------------------------------------------------------- write points
 
+    @property
+    def ds(self):
+        """The broker's durable message log, when enabled (ds/)."""
+        return getattr(self.broker, "ds", None)
+
     def _on_park(self, clientid: str, session: Session, expire_at: float) -> None:
-        self.backend.save(clientid, session_to_dict(session, expire_at))
+        ds = self.ds
+        if ds is not None:
+            # cursor form: the log owns the message bytes from here —
+            # park_session spills QoS>=1 mqueue overflow into the log
+            # (past the cursor) and the record carries no mqueue at all
+            cursor = ds.park_session(session)
+            self.backend.save(
+                clientid, session_to_dict(session, expire_at, cursor=cursor)
+            )
+        else:
+            self.backend.save(clientid, session_to_dict(session, expire_at))
         self._dirty.discard(clientid)
 
     def _on_discard(self, session: Session) -> None:
@@ -255,11 +288,23 @@ class SessionPersistence:
             self._orig_on_discard(session)
 
     def mark_dirty(self, clientid: str) -> None:
+        # cursor-form records are static while parked (offline enqueues
+        # land in the shared log, not the session file): nothing to
+        # re-snapshot on the housekeeping tick
+        if self.ds is not None:
+            return
         if clientid in self.broker.cm.pending:
             self._dirty.add(clientid)
 
-    def on_resume(self, clientid: str) -> None:
-        """Client reconnected: the live channel owns the session now."""
+    def on_resume(
+        self, clientid: str, session: Optional[Session] = None
+    ) -> None:
+        """Client reconnected: the live channel owns the session now.
+        With the durable log enabled, the mqueue is rebuilt here by
+        replaying from the session's park cursor."""
+        ds = self.ds
+        if ds is not None and session is not None:
+            ds.replay_into(session)
         self.backend.delete(clientid)
         self._dirty.discard(clientid)
 
@@ -280,8 +325,19 @@ class SessionPersistence:
     # ------------------------------------------------------------ restore
 
     def restore(self, now: Optional[float] = None) -> int:
-        """Rebuild cm.pending + engine routes from the store (boot path)."""
+        """Rebuild cm.pending + engine routes from the store (boot path).
+
+        One-shot migration: on the first boot with `ds.enable`, a
+        legacy snapshot (embedded mqueue, no cursor) has its queued
+        messages appended to the durable log and its file rewritten in
+        cursor form — the cursor is taken BEFORE the appends, so the
+        session's own resume replays them back.  N legacy sessions
+        holding copies of the same broadcast message append N records
+        (the spill path must not mid-dedup; see DsManager.append), but
+        replay's receiver-side mid dedup still delivers each exactly
+        once per session."""
         now = now if now is not None else time.time()
+        ds = self.ds
         restored = 0
         for data in self.backend.load_all():
             expire_at = data.get("expire_at")
@@ -290,6 +346,12 @@ class SessionPersistence:
                 continue
             session = session_from_dict(data)
             cid = session.clientid
+            if ds is not None and session.ds_cursor is None:
+                cursor = ds.park_session(session)  # migrate: queue -> log
+                self.backend.save(
+                    cid, session_to_dict(session, _expire(expire_at),
+                                         cursor=cursor)
+                )
             self.broker.cm.pending[cid] = (
                 session,
                 expire_at if expire_at is not None else float("inf"),
@@ -297,4 +359,10 @@ class SessionPersistence:
             for filt, opts in session.subscriptions.items():
                 self.broker.subscribe(cid, filt, opts)
             restored += 1
+        if ds is not None:
+            ds.flush_all()  # migrated messages are durable before serving
         return restored
+
+
+def _expire(expire_at: Optional[float]) -> float:
+    return expire_at if expire_at is not None else float("inf")

@@ -210,6 +210,20 @@ class Retainer:
         """All retained messages whose topic matches the filter."""
         return list(self.iter_filter(filt))
 
+    def iter_matching(self, filters):
+        """Lazily yield retained messages matching ANY of the filters,
+        deduplicated by topic — the durable-log gap-recovery source
+        (ds/manager.py): a session whose log window was GC'd away still
+        converges to the last value of every retained topic it holds a
+        filter for."""
+        seen = set()
+        for filt in filters:
+            for msg in self.iter_filter(filt):
+                if msg.topic in seen:
+                    continue
+                seen.add(msg.topic)
+                yield msg
+
     def clean_expired(self) -> int:
         """GC expired retained messages; returns count removed."""
         removed = 0

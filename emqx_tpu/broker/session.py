@@ -69,6 +69,12 @@ class Session:
         self.mqueue = MQueue(max_len=max_mqueue, store_qos0=store_qos0)
         self.awaiting_rel: Dict[int, float] = {}  # inbound qos2 packet ids
         self._next_pid = 1
+        # durable-message-log replay cursor (ds/): per-shard
+        # (generation, offset) taken at park time; None until the
+        # session first parks under an enabled log.  While a cursor is
+        # held, QoS>=1 offline traffic lives in the SHARED log and the
+        # mqueue is rebuilt from it on resume (ds/manager.py).
+        self.ds_cursor: Optional[Dict[int, Tuple[int, int]]] = None
 
     # ------------------------------------------------------ subscriptions
 
@@ -165,6 +171,16 @@ class Session:
 
     def enqueue(self, msg: Message) -> Optional[Message]:
         return self.mqueue.insert(msg)
+
+    def pending_mids(self) -> set:
+        """mids already held by this session (mqueue + unacked
+        inflight) — the receiver-side dedup key the durable-log replay
+        uses so an at-least-once replay converges to exactly-once."""
+        mids = {m.mid for m in self.mqueue.peek_all()}
+        for _pid, e in self.inflight.items():
+            if e.message is not None:
+                mids.add(e.message.mid)
+        return mids
 
     # acks ----------------------------------------------------------------
 

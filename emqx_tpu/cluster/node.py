@@ -1074,8 +1074,11 @@ class ClusterNode:
             cm.cancel_will(cid)
             if cm.on_resume:
                 # persistence hook: the on-disc copy must die with the
-                # handoff or a restart would resurrect a stale duplicate
-                cm.on_resume(cid)
+                # handoff or a restart would resurrect a stale duplicate.
+                # Passing the session also replays the durable log into
+                # its mqueue (logs are node-local; the peer gets the
+                # messages wholesale, not an unreadable cursor)
+                cm.on_resume(cid, session)
             data = session_to_dict(session, expire_at)
             self.broker.client_down(cid, list(session.subscriptions))
             return {"found": True, "live": False, "session": data}

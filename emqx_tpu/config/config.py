@@ -297,6 +297,51 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "ckpt.wal_seg_bytes": Field(
             "bytesize", 4 << 20, desc="WAL segment rotation size"),
     },
+    "ds": {
+        # durable message log (emqx_tpu/ds/ — emqx_durable_storage
+        # analog): parked persistent sessions replay QoS>=1 offline
+        # traffic from a shared, sharded append-only log instead of
+        # per-session mqueue snapshots
+        "enable": Field(
+            "bool", False,
+            desc="append QoS>=1 publishes that match a parked "
+                 "persistent-session subscription to a sharded durable "
+                 "log; parked sessions persist only (subscriptions, "
+                 "inflight, dedup, cursor) and rebuild their mqueue by "
+                 "replaying the log on resume"),
+        "dir": Field(
+            "str", "",
+            desc="log directory (shard-<k>/ segment chains); empty = "
+                 "<node.data_dir>/ds"),
+        "shards": Field(
+            "int", 4, min=1, max=1024,
+            desc="stream shards; shard = matchhash(topic) % shards"),
+        "seg_bytes": Field(
+            "bytesize", 4 << 20,
+            desc="segment roll size; retention GC drops whole sealed "
+                 "segments"),
+        "flush_interval": Field(
+            "duration", 1.0,
+            desc="write-behind fsync cadence (node ticker)"),
+        "flush_bytes": Field(
+            "bytesize", 256 << 10,
+            desc="per-shard buffered-bytes watermark that forces an "
+                 "inline fsync — the documented crash-loss window, in "
+                 "bytes"),
+        "gc_interval": Field(
+            "duration", 30.0,
+            desc="retention GC cadence (node ticker)"),
+        "retention_bytes": Field(
+            "bytesize", 256 << 20,
+            desc="per-shard on-disk cap; sealed generations behind the "
+                 "session min-cursor drop first, then oldest-first "
+                 "(forced; replay reports the gap)"),
+        "retention_ms": Field(
+            "duration", 604800.0,  # 7 days
+
+            desc="hard message age bound, even ahead of a lagging "
+                 "cursor"),
+    },
     "retainer": {
         "enable": Field("bool", True),
         "max_retained_messages": Field("int", 0, min=0),

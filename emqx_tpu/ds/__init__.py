@@ -1,0 +1,37 @@
+"""Durable message log (`emqx_durable_storage` analog).
+
+A log-structured durability subsystem for persistent sessions: QoS>=1
+publishes that match at least one parked persistent-session
+subscription are appended ONCE to a sharded append-only topic stream,
+and parked sessions persist only `(subscriptions, inflight, dedup,
+cursor)` — the mqueue is reconstructed by replaying the shared log
+from the cursor on resume.  This inverts the `broker/persist.py` data
+model (per-session queue snapshots -> shared log + cursors): a million
+parked sessions share the bytes of one stream, the park tick stops
+being O(sessions x queue depth), and the loss window is measured in
+bytes (`ds.flush_bytes`) instead of housekeeping ticks.
+
+Layout:
+  log.py      per-shard CRC32-framed segment files, generation headers,
+              temp+fsync+rename segment rolls, torn-tail recovery
+  buffer.py   per-shard write-behind buffer (flush_interval/flush_bytes
+              watermarks — the bounded-loss contract)
+  iterator.py resumable `(shard, generation, offset)` cursors with
+              server-side topic-filter matching and GC-gap reporting
+  manager.py  broker wiring: dispatch-time append, park/resume replay,
+              retention GC behind the per-shard min-cursor
+"""
+
+from .log import SegmentError, ShardLog
+from .iterator import Cursor, ShardIterator
+from .buffer import WriteBuffer
+from .manager import DsManager
+
+__all__ = [
+    "Cursor",
+    "DsManager",
+    "SegmentError",
+    "ShardIterator",
+    "ShardLog",
+    "WriteBuffer",
+]

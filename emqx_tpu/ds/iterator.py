@@ -1,0 +1,120 @@
+"""Resumable cursors over one shard of the durable message log.
+
+A cursor is `(shard, generation, offset)`: the offset is the resume
+point (global, monotonic per shard); the generation records which
+segment the offset lived in when the cursor was taken, so a cursor
+that lands in a GC-dropped generation is detectable — iteration skips
+to the oldest surviving record and reports the hole in `gap` instead
+of failing or silently rewinding.
+
+Filtering is server-side: records are decoded lazily and matched
+against the session's topic filters through the host golden matcher
+(`broker/topic.py`) BEFORE a Message is materialized, so replaying a
+million-record stream for a session subscribed to one narrow filter
+deserializes one JSON dict per record and builds Messages only for
+hits — the `emqx_ds` "stream + topic-filter iterator" contract.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from ..broker import topic as topiclib
+from ..broker.message import Message
+from ..broker.persist import message_from_dict
+from .log import ShardLog
+
+
+@dataclass
+class Cursor:
+    shard: int
+    generation: int
+    offset: int
+
+    def to_json(self) -> list:
+        return [self.generation, self.offset]
+
+    @staticmethod
+    def from_json(shard: int, v) -> "Cursor":
+        g, o = int(v[0]), int(v[1])
+        return Cursor(shard=shard, generation=g, offset=o)
+
+
+def encode_message(msg: Message) -> bytes:
+    """Log record payload: the session-snapshot JSON message dict (one
+    serialization discipline for both durability planes)."""
+    from ..broker.persist import message_to_dict
+
+    return json.dumps(
+        message_to_dict(msg), separators=(",", ":")
+    ).encode("utf-8")
+
+
+class ShardIterator:
+    """Batched reader over one shard from a cursor, with topic filters.
+
+    `filters` are REAL topic filters (no $share prefix); None = every
+    record.  `next(n)` returns up to n matched messages and advances
+    the cursor past every record it *examined* (matched or not), so a
+    session replaying a busy shared stream makes forward progress even
+    when nothing matches.  `gap` accumulates offsets lost to retention
+    GC underneath the cursor; `exhausted` flips when the durable end
+    was reached.
+    """
+
+    def __init__(
+        self,
+        log: ShardLog,
+        cursor: Cursor,
+        filters: Optional[Sequence[str]] = None,
+        batch_records: int = 512,
+    ):
+        self.log = log
+        self.cursor = cursor
+        self.filter_words = (
+            None if filters is None
+            else [topiclib.words(f) for f in filters]
+        )
+        self.batch_records = batch_records
+        self.gap = 0
+        self.exhausted = False
+
+    def _matches(self, topic: str) -> bool:
+        if self.filter_words is None:
+            return True
+        name = topiclib.words(topic)
+        return any(
+            topiclib.match_words(name, fw) for fw in self.filter_words
+        )
+
+    def next(self, n: int = 256) -> List[Tuple[int, Message]]:
+        """Up to n matched (offset, Message) pairs; [] at durable end."""
+        out: List[Tuple[int, Message]] = []
+        while len(out) < n:
+            recs, next_off, gap = self.log.read_from(
+                self.cursor.offset, self.batch_records
+            )
+            self.gap += gap
+            if not recs:
+                self.exhausted = True
+                break
+            for off, payload in recs:
+                if len(out) >= n:
+                    # batch full mid-segment: resume exactly here
+                    self.cursor = Cursor(
+                        self.log.shard, self.log.generation, off
+                    )
+                    return out
+                try:
+                    d = json.loads(payload.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    continue  # undecodable record: skip, keep offset
+                topic = d.get("topic", "")
+                if self._matches(topic):
+                    out.append((off, message_from_dict(d)))
+            self.cursor = Cursor(
+                self.log.shard, self.log.generation, next_off
+            )
+        return out

@@ -1,0 +1,323 @@
+"""Per-shard append-only segment files for the durable message log.
+
+One shard = one directory of numbered *generations*; one generation =
+one segment file.  The record framing reuses the `checkpoint/store.py`
+discipline — a fixed header, then CRC32-framed records — so the same
+torn-tail reasoning applies: a kill at any byte leaves a prefix of
+whole records plus at most one torn record, which recovery truncates.
+
+File layout (little-endian):
+
+    header:  magic "ETPUDSEG" | u32 version | u32 shard
+             | u64 generation | u64 base_offset
+    record:  u32 payload_crc | u32 payload_len | payload bytes
+
+Offsets are monotonic per shard and global across generations: record
+`i` of a segment holds offset `base_offset + i`.  The ACTIVE segment is
+`seg.<gen>.open` and is appended + fsync'd in place; a segment *roll*
+is flush + fsync + rename to `seg.<gen>.log` (+ directory fsync) — the
+same temp+fsync+rename step the snapshot store uses, so a sealed
+segment can never surface half-rolled.  Sealed generations are
+immutable, which is what lets retention GC drop them as whole files
+behind the session min-cursor (`manager.py`).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+MAGIC = b"ETPUDSEG"
+VERSION = 1
+_HDR = struct.Struct("<8sIIQQ")  # magic, version, shard, generation, base
+_REC = struct.Struct("<II")  # payload crc, payload len
+MAX_RECORD = 64 << 20  # sanity bound against a corrupt length field
+
+
+class SegmentError(Exception):
+    """A segment file failed its header/frame check."""
+
+
+@dataclass
+class SegmentInfo:
+    generation: int
+    base: int  # first offset in this segment
+    count: int  # whole records present
+    nbytes: int  # file size on disk
+    path: str
+    sealed: bool
+    mtime: float
+
+    @property
+    def end(self) -> int:
+        """One past the last offset in this segment."""
+        return self.base + self.count
+
+
+def _scan_segment(path: str, shard: Optional[int] = None):
+    """Parse header + count whole records; returns (info-tuple, good_len).
+
+    `good_len` is the byte length of the valid prefix — a torn final
+    record (short header, short payload, or CRC mismatch) ends the
+    scan there, the recovery contract of `ShardLog._recover`."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < _HDR.size:
+        raise SegmentError("file shorter than segment header")
+    magic, version, seg_shard, gen, base = _HDR.unpack_from(data, 0)
+    if magic != MAGIC:
+        raise SegmentError("bad segment magic")
+    if version != VERSION:
+        raise SegmentError(f"unsupported segment version {version}")
+    if shard is not None and seg_shard != shard:
+        raise SegmentError(f"segment belongs to shard {seg_shard}")
+    off = _HDR.size
+    count = 0
+    while off + _REC.size <= len(data):
+        crc, ln = _REC.unpack_from(data, off)
+        if ln > MAX_RECORD or off + _REC.size + ln > len(data):
+            break  # torn length/payload
+        payload = data[off + _REC.size:off + _REC.size + ln]
+        if zlib.crc32(payload) != crc:
+            break  # torn or corrupt record: everything after is suspect
+        off += _REC.size + ln
+        count += 1
+    return (seg_shard, gen, base, count), off
+
+
+class ShardLog:
+    """One shard's segment chain: sealed generations + one active file."""
+
+    def __init__(self, directory: str, shard: int, seg_bytes: int = 4 << 20):
+        self.dir = directory
+        self.shard = shard
+        self.seg_bytes = max(1, int(seg_bytes))
+        self.segments: List[SegmentInfo] = []  # sealed, ascending gen
+        self._f = None  # active segment handle (append mode)
+        self._active: Optional[SegmentInfo] = None
+        os.makedirs(directory, exist_ok=True)
+        self._recover()
+
+    # ------------------------------------------------------------- recovery
+
+    def _recover(self) -> None:
+        """Adopt sealed segments, truncate+seal any torn active file,
+        then open a fresh generation for new appends."""
+        sealed, opens = [], []
+        for name in os.listdir(self.dir):
+            if name.startswith("seg.") and name.endswith(".log"):
+                sealed.append(os.path.join(self.dir, name))
+            elif name.startswith("seg.") and name.endswith(".open"):
+                opens.append(os.path.join(self.dir, name))
+        for path in sealed:
+            try:
+                (_s, gen, base, count), good = _scan_segment(path, self.shard)
+            except (SegmentError, OSError):
+                continue  # unreadable sealed segment: skipped (gap on read)
+            if count:
+                self.segments.append(SegmentInfo(
+                    gen, base, count, os.path.getsize(path), path, True,
+                    os.path.getmtime(path)))
+            else:
+                _unlink_quiet(path)
+        # a crash can leave the active file torn mid-record: truncate to
+        # the whole-record prefix, then seal it — recovery IS the roll
+        for path in opens:
+            try:
+                (_s, gen, base, count), good = _scan_segment(path, self.shard)
+            except (SegmentError, OSError):
+                _unlink_quiet(path)
+                continue
+            if count == 0:
+                _unlink_quiet(path)
+                continue
+            if good < os.path.getsize(path):
+                with open(path, "r+b") as f:
+                    f.truncate(good)
+                    f.flush()
+                    os.fsync(f.fileno())
+            final = os.path.join(self.dir, f"seg.{gen}.log")
+            os.replace(path, final)
+            self.segments.append(SegmentInfo(
+                gen, base, count, os.path.getsize(final), final, True,
+                os.path.getmtime(final)))
+        self.segments.sort(key=lambda s: s.generation)
+        self._fsync_dir()
+        self._open_active()
+
+    def _open_active(self) -> None:
+        gen = (self.segments[-1].generation + 1) if self.segments else 1
+        base = self.segments[-1].end if self.segments else 0
+        path = os.path.join(self.dir, f"seg.{gen}.open")
+        f = open(path, "wb")
+        f.write(_HDR.pack(MAGIC, VERSION, self.shard, gen, base))
+        f.flush()
+        os.fsync(f.fileno())
+        self._f = f
+        self._active = SegmentInfo(
+            gen, base, 0, _HDR.size, path, False, os.path.getmtime(path))
+
+    def _fsync_dir(self) -> None:
+        try:
+            dfd = os.open(self.dir, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(dfd)
+        except OSError:
+            pass
+        finally:
+            os.close(dfd)
+
+    # -------------------------------------------------------------- append
+
+    @property
+    def generation(self) -> int:
+        return self._active.generation
+
+    @property
+    def next_offset(self) -> int:
+        """Next offset a durable append would take (buffered appends in
+        `WriteBuffer` run ahead of this)."""
+        return self._active.end
+
+    @property
+    def oldest_offset(self) -> int:
+        if self.segments:
+            return self.segments[0].base
+        return self._active.base
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(s.nbytes for s in self.segments) + self._active.nbytes
+
+    def append_payloads(self, items: List[Tuple[int, bytes]]) -> None:
+        """Write (offset, payload) records — offsets MUST continue the
+        shard's sequence (the write-behind buffer guarantees this) —
+        then fsync; rolls the segment past `seg_bytes`."""
+        if not items:
+            return
+        first = items[0][0]
+        if first != self._active.end:
+            raise SegmentError(
+                f"append at offset {first}, expected {self._active.end}")
+        parts = []
+        for _off, payload in items:
+            parts.append(_REC.pack(zlib.crc32(payload), len(payload)))
+            parts.append(payload)
+        blob = b"".join(parts)
+        self._f.write(blob)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._active.count += len(items)
+        self._active.nbytes += len(blob)
+        if self._active.nbytes >= self.seg_bytes:
+            self.roll()
+
+    def roll(self) -> Optional[SegmentInfo]:
+        """Seal the active segment (fsync + rename + dir fsync) and open
+        the next generation.  No-op on an empty active segment."""
+        if self._active.count == 0:
+            return None
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        final = os.path.join(self.dir, f"seg.{self._active.generation}.log")
+        os.replace(self._active.path, final)
+        self._fsync_dir()
+        info = SegmentInfo(
+            self._active.generation, self._active.base, self._active.count,
+            self._active.nbytes, final, True, os.path.getmtime(final))
+        self.segments.append(info)
+        self._open_active()
+        return info
+
+    # ---------------------------------------------------------------- read
+
+    def read_from(
+        self, offset: int, max_records: int = 256
+    ) -> Tuple[List[Tuple[int, bytes]], int, int]:
+        """Durable records starting at `offset`.
+
+        Returns (records, next_offset, gap): `records` is a list of
+        (offset, payload); `gap` is the number of offsets skipped
+        because retention GC dropped the generation they lived in
+        (the cursor lands on the oldest surviving record).  Only
+        fsync'd data is visible — buffered appends are not."""
+        gap = 0
+        oldest = self.oldest_offset
+        if offset < oldest:
+            gap = oldest - offset
+            offset = oldest
+        out: List[Tuple[int, bytes]] = []
+        for seg in [*self.segments, self._active]:
+            if seg.end <= offset or not seg.count:
+                continue
+            if seg.base > offset:
+                # a middle generation was dropped (forced retention):
+                # skip forward and report the hole
+                gap += seg.base - offset
+                offset = seg.base
+            out.extend(self._read_segment(seg, offset,
+                                          max_records - len(out)))
+            if out:
+                offset = out[-1][0] + 1
+            if len(out) >= max_records:
+                break
+        return out, offset, gap
+
+    def _read_segment(
+        self, seg: SegmentInfo, offset: int, limit: int
+    ) -> List[Tuple[int, bytes]]:
+        if limit <= 0:
+            return []
+        try:
+            with open(seg.path, "rb") as f:
+                data = f.read(seg.nbytes)
+        except OSError:
+            return []
+        out: List[Tuple[int, bytes]] = []
+        off, rec_off = _HDR.size, seg.base
+        while off + _REC.size <= len(data) and len(out) < limit:
+            crc, ln = _REC.unpack_from(data, off)
+            if ln > MAX_RECORD or off + _REC.size + ln > len(data):
+                break
+            if rec_off >= offset:
+                payload = data[off + _REC.size:off + _REC.size + ln]
+                if zlib.crc32(payload) != crc:
+                    break  # corrupt mid-file: stop at the valid prefix
+                out.append((rec_off, payload))
+            off += _REC.size + ln
+            rec_off += 1
+        return out
+
+    # ------------------------------------------------------------------ gc
+
+    def drop_generation(self, generation: int) -> bool:
+        """Unlink one SEALED generation (retention GC)."""
+        for i, seg in enumerate(self.segments):
+            if seg.generation == generation:
+                _unlink_quiet(seg.path)
+                del self.segments[i]
+                return True
+        return False
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except (OSError, ValueError):
+                pass
+            self._f.close()
+            self._f = None
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass

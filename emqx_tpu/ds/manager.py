@@ -1,0 +1,343 @@
+"""Durable-message-log manager: broker wiring + retention GC.
+
+The inversion of the `broker/persist.py` data model:
+
+* dispatch time — a QoS>=1 publish that reaches at least one PARKED
+  persistent session (one holding a replay cursor) is appended ONCE to
+  `matchhash(topic) % ds.shards`'s stream (`Broker._deliver_to` calls
+  `on_offline_publish`; a bounded recent-mid table suppresses the
+  duplicate appends N parked receivers would otherwise cause);
+* park time — `park_session` takes the end cursor FIRST, then spills
+  the session's leftover QoS>=1 mqueue overflow into the log (landing
+  past the cursor, so resume replays it back), leaving a session
+  record of only `(subscriptions, inflight, dedup, cursor)`;
+* resume time — `replay_into` rebuilds the mqueue by iterating every
+  shard from the cursor through the session's topic filters, skipping
+  mids already pending (inflight/mqueue) so an in-process resume never
+  duplicates, and falling back to the retainer's current state for
+  filters whose log window was GC'd away (`gap` recovery);
+* GC — the per-shard min-cursor over parked sessions advances as
+  sessions resume/expire; sealed generations fully behind it are
+  dropped whole once `ds.retention_bytes`/`ds.retention_ms` pressure
+  says so, and hard retention can drop unconsumed generations too (the
+  cursor then reports the gap instead of blocking the disk forever).
+
+Config keys are read here (and only here) from the validated schema —
+`tools/check.py` lints that every `ds.*` key this package reads is
+declared in `config/config.py`.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+from ..broker import topic as topiclib
+from ..broker.message import Message
+from ..observe.tracepoints import tp
+from ..ops.hashing import word_hash64
+from .buffer import WriteBuffer
+from .iterator import Cursor, ShardIterator, encode_message
+from .log import ShardLog
+
+_RECENT_MIDS = 8192  # append-dedup window (per manager, all shards)
+
+
+class DsManager:
+    def __init__(self, broker, directory: str, conf, metrics=None):
+        self.broker = broker
+        self.dir = directory
+        self.n_shards = int(conf.get("ds.shards"))
+        self.flush_interval = float(conf.get("ds.flush_interval"))
+        self.flush_bytes = int(conf.get("ds.flush_bytes"))
+        self.gc_interval = float(conf.get("ds.gc_interval"))
+        self.retention_bytes = int(conf.get("ds.retention_bytes"))
+        self.retention_s = float(conf.get("ds.retention_ms"))
+        seg_bytes = int(conf.get("ds.seg_bytes"))
+        self.logs: List[ShardLog] = [
+            ShardLog(os.path.join(directory, f"shard-{k}"), k,
+                     seg_bytes=seg_bytes)
+            for k in range(self.n_shards)
+        ]
+        self.buffers: List[WriteBuffer] = [
+            WriteBuffer(log, flush_bytes=self.flush_bytes)
+            for log in self.logs
+        ]
+        self.metrics = metrics
+        self._recent_mids: "OrderedDict[bytes, int]" = OrderedDict()
+        self._last_flush = 0.0
+        self._last_gc = 0.0
+        self.gc_forced_drops = 0  # generations dropped past live cursors
+
+    # ------------------------------------------------------------- append
+
+    def shard_of(self, topic: str) -> int:
+        """`matchhash(topic) % ds.shards` — the deterministic FNV lane
+        the engine's table keys use, so shard placement survives
+        restarts and agrees across processes."""
+        return word_hash64(topic) % self.n_shards
+
+    def append(
+        self, msg: Message, dedup: bool = True
+    ) -> Optional[Tuple[int, int]]:
+        """Append one message; returns (shard, offset), or None when the
+        mid was appended recently (dispatch reaches this once per parked
+        receiver; the stream wants the message once).  `dedup=False`
+        forces the append — the park-time mqueue spill uses it because
+        its messages may already exist in the log BEFORE the new cursor
+        (replayed-then-reparked), where suppression would lose them."""
+        if dedup and msg.mid in self._recent_mids:
+            return None
+        self._recent_mids[msg.mid] = 1
+        while len(self._recent_mids) > _RECENT_MIDS:
+            self._recent_mids.popitem(last=False)
+        shard = self.shard_of(msg.topic)
+        off = self.buffers[shard].append(encode_message(msg))
+        tp("ds.append", shard=shard, offset=off, topic=msg.topic,
+           mid=msg.mid)
+        if self.metrics is not None:
+            self.metrics.inc("ds.appends")
+        return shard, off
+
+    def on_offline_publish(self, msg: Message) -> None:
+        """Dispatch-time hook (`Broker._deliver_to`): the publish
+        matched a parked persistent session's subscription."""
+        self.append(msg)
+
+    # ------------------------------------------------------------ cursors
+
+    def end_cursor(self) -> Dict[int, Tuple[int, int]]:
+        """Per-shard (generation, next-append offset) this instant —
+        the cursor a session parking NOW resumes from.  Uses the
+        buffered head (not the durable head): appends already buffered
+        happened-before the park."""
+        return {
+            k: (self.logs[k].generation, self.buffers[k].next_offset)
+            for k in range(self.n_shards)
+        }
+
+    def park_session(self, session) -> Dict[int, Tuple[int, int]]:
+        """Take the park cursor, spill QoS>=1 mqueue overflow into the
+        log (past the cursor, so resume replays it), keep QoS0 overflow
+        in memory only.  Returns the cursor; also set on the session."""
+        cursor = self.end_cursor()
+        leftovers = session.mqueue.drain_all()
+        for m in leftovers:
+            if m.qos >= 1 and not m.headers.get("shared"):
+                self.append(m, dedup=False)
+            else:
+                session.mqueue.insert(m)  # QoS0/shared: in-memory only
+        session.ds_cursor = cursor
+        return cursor
+
+    # ------------------------------------------------------------- replay
+
+    def replay_into(self, session, batch: int = 512) -> Tuple[int, int]:
+        """Rebuild the session's mqueue from the log (resume path).
+
+        Returns (messages inserted, offsets lost to GC).  Filters are
+        the session's non-shared subscriptions (shared-group copies are
+        owned by the dispatch-time failover path, never the log); mids
+        already pending in the session are skipped, so an in-process
+        resume (mqueue still warm) converges instead of duplicating.
+        Advances the session's cursor to the durable end."""
+        cursor = getattr(session, "ds_cursor", None)
+        if cursor is None:
+            return 0, 0
+        subs = []  # (real filter words-key, subscription key, opts)
+        for filt, opts in session.subscriptions.items():
+            group, real = topiclib.parse_share(filt)
+            if group is None:
+                subs.append((real, filt, opts))
+        self.flush_all()  # replay must see every buffered append
+        seen = session.pending_mids()
+        n = gap = 0
+        t0 = time.monotonic()
+        for shard in range(self.n_shards):
+            gen, off = cursor.get(shard, (0, 0))
+            it = ShardIterator(
+                self.logs[shard], Cursor(shard, gen, off),
+                filters=[r for r, _f, _o in subs] or None,
+            )
+            if not subs:
+                # no plain filters: nothing can match; fast-forward
+                cursor[shard] = (self.logs[shard].generation,
+                                 self.buffers[shard].next_offset)
+                continue
+            while True:
+                got = it.next(batch)
+                if not got:
+                    break
+                for _offset, msg in got:
+                    if msg.mid in seen or msg.expired():
+                        continue
+                    seen.add(msg.mid)
+                    for real, skey, opts in subs:
+                        if not topiclib.match(msg.topic, real):
+                            continue
+                        if opts.no_local and \
+                                msg.from_client == session.clientid:
+                            continue
+                        qos = (max(msg.qos, opts.qos)
+                               if session.upgrade_qos
+                               else min(msg.qos, opts.qos))
+                        session.mqueue.insert(replace(msg, qos=qos))
+                        n += 1
+            gap += it.gap
+            cursor[shard] = (it.cursor.generation, it.cursor.offset)
+        session.ds_cursor = cursor
+        if gap:
+            n += self._gap_recover(session, [r for r, _f, _o in subs], seen)
+        tp("ds.replay", clientid=session.clientid, messages=n, gap=gap,
+           ms=(time.monotonic() - t0) * 1e3)
+        if self.metrics is not None:
+            self.metrics.inc("ds.replays")
+            self.metrics.inc("ds.replayed_messages", n)
+        return n, gap
+
+    def _gap_recover(self, session, reals: List[str], seen) -> int:
+        """Part of the session's log window was GC'd: deliver the
+        retainer's CURRENT state for its filters so it at least holds
+        the last value of every retained topic it missed (the
+        documented degradation, reported via the replay gap)."""
+        retainer = getattr(self.broker, "retainer", None)
+        if retainer is None:
+            return 0
+        n = 0
+        for msg in retainer.iter_matching(reals):
+            if msg.mid in seen:
+                continue
+            seen.add(msg.mid)
+            session.mqueue.insert(msg)
+            n += 1
+        return n
+
+    # ----------------------------------------------------------- flush/GC
+
+    def flush_all(self) -> int:
+        n = 0
+        for buf in self.buffers:
+            if buf.pending_count():
+                n += buf.flush()
+        if n and self.metrics is not None:
+            self.metrics.inc("ds.flushes")
+        return n
+
+    def min_cursors(self) -> Dict[int, int]:
+        """Per-shard minimum resume offset over parked sessions (the
+        session-GC output retention runs behind).  Shards no parked
+        session holds a cursor into float to the buffered end —
+        everything there is reclaimable."""
+        mins = {k: self.buffers[k].next_offset
+                for k in range(self.n_shards)}
+        for _cid, (session, _exp) in self.broker.cm.pending.items():
+            cur = getattr(session, "ds_cursor", None)
+            if not cur:
+                continue
+            for k, (_g, off) in cur.items():
+                if off < mins.get(k, off + 1):
+                    mins[k] = off
+        return mins
+
+    def gc(self, now: Optional[float] = None) -> int:
+        """Seal + drop generations behind the min-cursor under
+        retention pressure; hard-expire past `ds.retention_ms` even
+        ahead of a lagging cursor (replay then reports the gap)."""
+        now = now if now is not None else time.time()
+        mins = self.min_cursors()
+        dropped = 0
+        for shard, log in enumerate(self.logs):
+            min_off = mins[shard]
+            total = log.total_bytes
+            for seg in list(log.segments):
+                over = (self.retention_bytes > 0
+                        and total > self.retention_bytes)
+                expired = (self.retention_s > 0
+                           and now - seg.mtime > self.retention_s)
+                if not (over or expired):
+                    break  # oldest-first: nothing further is due either
+                consumed = seg.end <= min_off
+                if not consumed:
+                    # hard retention ahead of a lagging cursor: the
+                    # session replays a gap instead of pinning the disk
+                    self.gc_forced_drops += 1
+                total -= seg.nbytes
+                log.drop_generation(seg.generation)
+                dropped += 1
+                tp("ds.gc", shard=shard, generation=seg.generation,
+                   offsets=seg.count, forced=not consumed)
+        if dropped and self.metrics is not None:
+            self.metrics.inc("ds.gc_segments", dropped)
+        return dropped
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Node-ticker cadence: interval flush, periodic GC, gauges."""
+        now = now if now is not None else time.monotonic()
+        if now - self._last_flush >= self.flush_interval:
+            self._last_flush = now
+            self.flush_all()
+        if now - self._last_gc >= self.gc_interval:
+            self._last_gc = now
+            self.gc()
+        self.sync_metrics()
+
+    def sync_metrics(self) -> None:
+        if self.metrics is None:
+            return
+        mins = self.min_cursors()
+        self.metrics.gauge_set(
+            "ds.bytes", sum(log.total_bytes for log in self.logs))
+        self.metrics.gauge_set(
+            "ds.segments",
+            sum(len(log.segments) + 1 for log in self.logs))
+        self.metrics.gauge_set(
+            "ds.lag",
+            max((self.buffers[k].next_offset - mins[k]
+                 for k in range(self.n_shards)), default=0))
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """`GET /ds/stats` shape: per-shard occupancy + cursor lag."""
+        mins = self.min_cursors()
+        shards = []
+        for k, log in enumerate(self.logs):
+            buf = self.buffers[k]
+            shards.append({
+                "shard": k,
+                "generation": log.generation,
+                "oldest_offset": log.oldest_offset,
+                "durable_offset": buf.durable_offset,
+                "next_offset": buf.next_offset,
+                "min_cursor": mins[k],
+                "lag": buf.next_offset - mins[k],
+                "segments": len(log.segments) + 1,
+                "bytes": log.total_bytes,
+                "buffered_bytes": buf.pending_bytes(),
+            })
+        return {
+            "shards": shards,
+            "totals": {
+                "bytes": sum(s["bytes"] for s in shards),
+                "segments": sum(s["segments"] for s in shards),
+                "buffered_bytes": sum(
+                    s["buffered_bytes"] for s in shards),
+                "lag": max((s["lag"] for s in shards), default=0),
+                "gc_forced_drops": self.gc_forced_drops,
+            },
+            "config": {
+                "shards": self.n_shards,
+                "flush_interval": self.flush_interval,
+                "flush_bytes": self.flush_bytes,
+                "retention_bytes": self.retention_bytes,
+                "retention_ms": self.retention_s,
+            },
+        }
+
+    def close(self) -> None:
+        self.flush_all()
+        for log in self.logs:
+            log.close()

@@ -64,6 +64,7 @@ class ManagementApi:
         delayed=None,
         exporters=None,
         api_keys=None,
+        ds=None,
     ):
         self.broker = broker
         self.node = node
@@ -90,6 +91,7 @@ class ManagementApi:
         self.delayed = delayed
         self.exporters = exporters
         self.api_keys = api_keys
+        self.ds = ds
         self.started_at = time.time()
         self.http: Optional[HttpApi] = None
 
@@ -123,6 +125,8 @@ class ManagementApi:
           doc="Match-engine telemetry summary (flight recorder plane)")
         r("GET", "/engine/flight", self.engine_flight,
           doc="Flight recorder: recent ticks + arbitration flips")
+        r("GET", "/ds/stats", self.ds_stats,
+          doc="Durable message log: per-shard occupancy + cursor lag")
         r("GET", "/alarms", self.alarms_get, doc="Active/history alarms")
         r("DELETE", "/alarms", self.alarms_clear, doc="Clear deactivated alarms")
         r("GET", "/banned", self.banned_get, doc="Ban table")
@@ -623,6 +627,12 @@ class ManagementApi:
                                  "(engine.flight_ring=0)")
         n = int(req.q("n", "32"))
         return {"recent": fl.recent(n), "flips": fl.flips()}
+
+    def ds_stats(self, req: Request):
+        if self.ds is None:
+            raise HttpError(404, "durable message log disabled "
+                                 "(ds.enable=false)")
+        return self.ds.stats()
 
     def stats_get(self, req: Request):
         if self.stats is None:

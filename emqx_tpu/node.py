@@ -227,6 +227,23 @@ class NodeRuntime:
         else:
             self.broker = Broker(engine=engine, retainer=retainer, shared=shared)
 
+        # ---- durable message log (ds/) ---------------------------------
+        # parked persistent sessions replay QoS>=1 offline traffic from
+        # a shared, sharded append-only log instead of per-session
+        # mqueue snapshots; wired BEFORE persistence so restore() can
+        # run the one-shot legacy-snapshot migration through it
+        self.ds = None
+        if self.conf.get("ds.enable"):
+            from .ds.manager import DsManager
+
+            ddir = self.conf.get("ds.dir") or os.path.join(
+                self.conf.get("node.data_dir"), "ds"
+            )
+            self.ds = DsManager(
+                self.broker, ddir, self.conf, metrics=self.broker.metrics
+            )
+            self.broker.ds = self.ds
+
         # ---- persistence (5.4 checkpoint/resume) -----------------------
         self.persistence = None
         if self.conf.get("persistent_session_store.enable"):
@@ -492,6 +509,7 @@ class NodeRuntime:
             delayed=self.delayed,
             exporters=self.exporters,
             api_keys=self.api_keys,
+            ds=self.ds,
         )
         self.http = HttpApi(
             port=self.conf.get("dashboard.listen_port"),
@@ -893,6 +911,11 @@ class NodeRuntime:
             await asyncio.to_thread(self.exhook.stop)
         if self.persistence is not None:
             self.persistence.tick()  # final dirty-page flush
+        if self.ds is not None:
+            try:
+                self.ds.close()  # final log flush: clean durable handoff
+            except Exception:
+                log.exception("closing durable message log")
         if self.ckpt is not None:
             try:
                 self.ckpt.checkpoint()  # final snapshot: clean WAL handoff
@@ -941,6 +964,10 @@ class NodeRuntime:
                 self._poll_health_alarms()
                 if self.broker.retainer.store is not None:
                     self.broker.retainer.store.flush()
+                if self.ds is not None:
+                    # interval flush + retention GC off the loop: the
+                    # fsync can block for the device's full write cost
+                    await asyncio.to_thread(self.ds.tick, now)
                 if now - last_hb >= hb_ivl:
                     last_hb = now
                     self.sys_heartbeat.tick()

@@ -59,6 +59,13 @@ KNOWN_KINDS: Dict[str, str] = {
     "engine.ckpt.restore": "warm restart: snapshot loaded + WAL tail replayed",
     "engine.ckpt.fallback": "newest snapshot corrupt; older one restored",
     "engine.ckpt.wal": "churn record appended to the write-ahead log",
+    # durable message log (ds/ subsystem: sharded streams + cursors)
+    "ds.append": "message appended to a shard's durable topic stream",
+    "ds.flush": "write-behind buffer flushed + fsync'd (bytes watermark "
+                "or interval)",
+    "ds.replay": "session resume rebuilt its mqueue from the log cursor",
+    "ds.gc": "retention GC dropped one sealed generation (forced = past "
+             "a lagging cursor; replay reports the gap)",
     # fault injection + self-healing (fault/, cluster data plane, engine)
     "fault.inject": "a configured fault fired at a registered site",
     "cluster.peer.miss": "heartbeat ping to a peer went unanswered",

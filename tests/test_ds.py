@@ -1,0 +1,533 @@
+"""Durable message log (`emqx_tpu/ds/`): segments, cursors, GC races,
+crash boundaries, and the broker park/replay/migration wiring.
+
+The crash-consistency contract under test: a kill at ANY boundary
+(mid-append = torn final record, mid-flush = buffered tail lost,
+mid-segment-roll, mid-GC) leaves exactly the committed prefix — the
+property test drives a seeded op schedule against an in-memory oracle
+of appends and re-opens the log after every simulated crash.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.persist import (
+    DiscBackend,
+    SessionPersistence,
+    session_to_dict,
+)
+from emqx_tpu.broker.session import Session
+from emqx_tpu.config.config import Config
+from emqx_tpu.ds.buffer import WriteBuffer
+from emqx_tpu.ds.iterator import Cursor, ShardIterator, encode_message
+from emqx_tpu.ds.log import _REC, ShardLog
+from emqx_tpu.ds.manager import DsManager
+
+
+def msg(topic="a/b", payload=b"x", qos=1, **kw):
+    return Message(topic=topic, payload=payload, qos=qos, **kw)
+
+
+def ds_conf(**over):
+    d = {"enable": True, "shards": 2, "flush_bytes": 1 << 20,
+         "seg_bytes": 1 << 20}
+    d.update(over)
+    return Config({"ds": d})
+
+
+def mk_manager(tmp_path, broker=None, **over):
+    b = broker or Broker()
+    mgr = DsManager(b, str(tmp_path / "ds"), ds_conf(**over),
+                    metrics=b.metrics)
+    b.ds = mgr
+    return b, mgr
+
+
+# ----------------------------------------------------------- log layer
+
+def test_segment_append_read_roundtrip(tmp_path):
+    log = ShardLog(str(tmp_path), 0)
+    payloads = [f"rec-{i}".encode() for i in range(10)]
+    log.append_payloads(list(enumerate(payloads)))
+    recs, nxt, gap = log.read_from(0, 100)
+    assert [p for _o, p in recs] == payloads
+    assert [o for o, _p in recs] == list(range(10))
+    assert nxt == 10 and gap == 0
+    # mid-stream resume
+    recs, nxt, _ = log.read_from(7, 100)
+    assert [p for _o, p in recs] == payloads[7:]
+    log.close()
+
+
+def test_segment_roll_and_reopen_continues_offsets(tmp_path):
+    log = ShardLog(str(tmp_path), 0, seg_bytes=64)
+    for i in range(20):  # every append crosses the tiny roll threshold
+        log.append_payloads([(i, f"payload-{i:04d}".encode())])
+    assert len(log.segments) >= 10
+    gens = [s.generation for s in log.segments]
+    assert gens == sorted(gens) and len(set(gens)) == len(gens)
+    log.close()
+    # reopen: offsets continue, nothing lost
+    log2 = ShardLog(str(tmp_path), 0, seg_bytes=64)
+    assert log2.next_offset == 20
+    recs, _n, gap = log2.read_from(0, 100)
+    assert len(recs) == 20 and gap == 0
+    log2.append_payloads([(20, b"after-reopen")])
+    recs, _n, _g = log2.read_from(19, 10)
+    assert [p for _o, p in recs] == [b"payload-0019", b"after-reopen"]
+    log2.close()
+
+
+def test_torn_final_record_truncated_on_open(tmp_path):
+    log = ShardLog(str(tmp_path), 0)
+    log.append_payloads([(0, b"whole-1"), (1, b"whole-2")])
+    active = log._active.path
+    log.close()
+    # simulate a kill mid-append: garbage half-record at the tail
+    with open(active, "ab") as f:
+        f.write(_REC.pack(0xDEAD, 100))  # header promises 100 bytes
+        f.write(b"only-a-few")
+    log2 = ShardLog(str(tmp_path), 0)
+    recs, _n, gap = log2.read_from(0, 10)
+    assert [p for _o, p in recs] == [b"whole-1", b"whole-2"]
+    assert gap == 0 and log2.next_offset == 2
+    log2.close()
+
+
+def test_corrupt_crc_ends_scan_at_valid_prefix(tmp_path):
+    log = ShardLog(str(tmp_path), 0)
+    log.append_payloads([(0, b"aaaa"), (1, b"bbbb"), (2, b"cccc")])
+    path = log._active.path
+    log.close()
+    data = bytearray(open(path, "rb").read())
+    # flip one payload byte of the SECOND record
+    data[-5] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(data)
+    log2 = ShardLog(str(tmp_path), 0)
+    recs, _n, _g = log2.read_from(0, 10)
+    assert [p for _o, p in recs] == [b"aaaa", b"bbbb"]  # prefix survives
+    log2.close()
+
+
+def test_drop_generation_creates_gap(tmp_path):
+    log = ShardLog(str(tmp_path), 0, seg_bytes=32)
+    for i in range(6):
+        log.append_payloads([(i, f"g{i}".encode() * 8)])
+    first = log.segments[0]
+    assert log.drop_generation(first.generation)
+    recs, nxt, gap = log.read_from(0, 10)
+    assert gap == first.count
+    assert recs and recs[0][0] == first.end  # resumes at oldest live
+    log.close()
+
+
+# -------------------------------------------------------------- buffer
+
+def test_buffer_flush_on_byte_watermark(tmp_path):
+    log = ShardLog(str(tmp_path), 0)
+    buf = WriteBuffer(log, flush_bytes=64)
+    off = buf.append(b"small")
+    assert off == 0 and buf.pending_count() == 1
+    assert log.next_offset == 0  # buffered, not durable
+    buf.append(b"B" * 100)  # crosses the watermark -> inline flush
+    assert buf.pending_count() == 0
+    assert log.next_offset == 2 and buf.durable_offset == 2
+    log.close()
+
+
+def test_buffer_loss_window_is_bounded_bytes(tmp_path):
+    log = ShardLog(str(tmp_path), 0)
+    buf = WriteBuffer(log, flush_bytes=1 << 20)
+    for i in range(5):
+        buf.append(f"m{i}".encode())
+    assert buf.loss_window() == sum(2 + _REC.size for _ in range(5))
+    buf.flush()
+    assert buf.loss_window() == 0
+    log.close()
+
+
+# ------------------------------------------------------------ iterator
+
+def test_iterator_filters_and_batches(tmp_path):
+    log = ShardLog(str(tmp_path), 0)
+    items = []
+    for i in range(30):
+        topic = f"t/{i % 3}/x"
+        items.append((i, encode_message(msg(topic=topic,
+                                            payload=str(i).encode()))))
+    log.append_payloads(items)
+    it = ShardIterator(log, Cursor(0, 1, 0), filters=["t/1/+"])
+    got = []
+    while True:
+        batch = it.next(4)
+        if not batch:
+            break
+        assert len(batch) <= 4
+        got.extend(m for _o, m in batch)
+    assert [int(m.payload) for m in got] == [i for i in range(30)
+                                             if i % 3 == 1]
+    assert it.exhausted and it.gap == 0
+    # cursor advanced to the durable end: nothing replays twice
+    it2 = ShardIterator(log, it.cursor, filters=None)
+    assert it2.next(10) == []
+    log.close()
+
+
+def test_iterator_cursor_in_dropped_generation_reports_gap(tmp_path):
+    log = ShardLog(str(tmp_path), 0, seg_bytes=48)
+    for i in range(8):
+        log.append_payloads([(i, encode_message(
+            msg(topic="g/t", payload=str(i).encode())))])
+    # cursor parked at 0; GC drops the first two generations mid-iteration
+    it = ShardIterator(log, Cursor(0, 1, 0), filters=["g/#"])
+    dropped_offsets = log.segments[0].count + log.segments[1].count
+    log.drop_generation(log.segments[0].generation)
+    log.drop_generation(log.segments[0].generation)
+    got = []
+    while True:
+        batch = it.next(3)
+        if not batch:
+            break
+        got.extend(int(m.payload) for _o, m in batch)
+    assert it.gap == dropped_offsets
+    assert got == list(range(dropped_offsets, 8))  # oldest live onward
+    log.close()
+
+
+# ------------------------------------------- kill-at-any-boundary property
+
+@pytest.mark.parametrize("seed", range(6))
+def test_kill_at_any_boundary_replays_committed_prefix(tmp_path, seed):
+    """Seeded op schedule (append / flush / roll / GC / CRASH) against
+    an in-memory oracle.  After every crash + reopen, reading from
+    offset 0 yields exactly the oracle's durable list (allowing the
+    documented case: records past the last explicit flush MAY survive
+    if a watermark flush committed them) — no loss below the flush
+    watermark, no duplicates, no reordering."""
+    rng = random.Random(seed)
+    d = str(tmp_path / "shard")
+    log = ShardLog(d, 0, seg_bytes=256)
+    buf = WriteBuffer(log, flush_bytes=128)
+    durable = []  # oracle: known-committed payloads
+    pending = []  # appended, not yet explicitly flushed
+    seq = 0
+    for _step in range(300):
+        op = rng.random()
+        if op < 0.55:
+            payload = f"m-{seq:05d}-{'x' * rng.randrange(40)}".encode()
+            seq += 1
+            buf.append(payload)
+            pending.append(payload)
+            if buf.pending_count() == 0:  # watermark flushed inline
+                durable += pending
+                pending = []
+        elif op < 0.75:  # explicit flush boundary
+            buf.flush()
+            durable += pending
+            pending = []
+        elif op < 0.85:  # segment-roll boundary
+            buf.flush()
+            durable += pending
+            pending = []
+            log.roll()
+        elif op < 0.92 and log.segments:  # GC boundary (oldest gen)
+            g = log.segments[0]
+            # the oldest generation holds the oldest offsets: its
+            # records are exactly the front of the oracle
+            durable = durable[g.count:]
+            log.drop_generation(g.generation)
+        else:  # CRASH: buffered tail dies; maybe a torn record too
+            if rng.random() < 0.5:
+                with open(log._active.path, "ab") as f:
+                    f.write(_REC.pack(0xBAD, 77))
+                    f.write(b"torn" * rng.randrange(1, 5))
+            log._f.close()  # abandon without flush (the kill)
+            log = ShardLog(d, 0, seg_bytes=256)
+            buf = WriteBuffer(log, flush_bytes=128)
+            pending = []
+            recs, _n, _gap = log.read_from(0, 10_000)
+            got = [p for _o, p in recs]
+            assert got == durable, (
+                f"seed {seed}: committed prefix mismatch after crash "
+                f"(want {len(durable)}, got {len(got)})"
+            )
+    log.close()
+
+
+# ------------------------------------------------------ manager wiring
+
+def test_dispatch_appends_once_across_parked_receivers(tmp_path):
+    b, mgr = mk_manager(tmp_path)
+    p = SessionPersistence(b, DiscBackend(str(tmp_path / "sess")))
+    for cid in ("p1", "p2", "p3"):
+        s = Session(clientid=cid, expiry_interval=300)
+        s.subscriptions["fan/#"] = SubOpts(qos=1)
+        b.subscribe(cid, "fan/#", SubOpts(qos=1))
+        b.cm.pending[cid] = (s, float("inf"))
+        p._on_park(cid, s, float("inf"))
+    assert b.publish(msg(topic="fan/x", payload=b"one")) == 3
+    mgr.flush_all()
+    # ONE record despite three parked receivers (mid dedup)
+    assert b.metrics.get("ds.appends") == 1
+    assert sum(log.next_offset for log in mgr.logs) == 1
+    # every session's replay still sees it
+    for cid in ("p1", "p2", "p3"):
+        s = b.cm.pending[cid][0]
+        n, gap = mgr.replay_into(s)
+        assert (n, gap) == (1, 0)
+        assert s.mqueue.peek_all()[0].payload == b"one"
+
+
+def test_qos0_and_shared_copies_stay_off_the_log(tmp_path):
+    b, mgr = mk_manager(tmp_path)
+    p = SessionPersistence(b, DiscBackend(str(tmp_path / "sess")))
+    s = Session(clientid="p1", expiry_interval=300)
+    s.subscriptions["q/#"] = SubOpts(qos=1)
+    b.subscribe("p1", "q/#", SubOpts(qos=1))
+    b.cm.pending["p1"] = (s, float("inf"))
+    p._on_park("p1", s, float("inf"))
+    b.publish(msg(topic="q/zero", payload=b"z", qos=0))
+    assert b.metrics.get("ds.appends") == 0
+    assert len(s.mqueue) == 1  # legacy in-memory path
+
+
+def test_park_spills_mqueue_overflow_into_log(tmp_path):
+    b, mgr = mk_manager(tmp_path)
+    p = SessionPersistence(b, DiscBackend(str(tmp_path / "sess")))
+    s = Session(clientid="c1", expiry_interval=300)
+    s.subscriptions["o/#"] = SubOpts(qos=1)
+    # overflow accumulated while LIVE (inflight window full)
+    for i in range(4):
+        s.mqueue.insert(msg(topic="o/t", payload=f"ov{i}".encode()))
+    s.mqueue.insert(msg(topic="o/t", payload=b"z0", qos=0))
+    p._on_park("c1", s, float("inf"))
+    b.cm.pending["c1"] = (s, float("inf"))
+    assert len(s.mqueue) == 1  # QoS0 stays in memory
+    rec = p.backend.load_all()[0]
+    assert "mqueue" not in rec and "cursor" in rec
+    n, gap = mgr.replay_into(s)
+    assert n == 4 and gap == 0
+    payloads = sorted(m.payload for m in s.mqueue.peek_all())
+    assert payloads == [b"ov0", b"ov1", b"ov2", b"ov3", b"z0"]
+    # replay is idempotent (mid dedup against the warm mqueue)
+    s.ds_cursor = {k: (0, 0) for k in range(mgr.n_shards)}
+    n2, _ = mgr.replay_into(s)
+    assert n2 == 0
+
+
+def test_resume_replay_and_cursor_advance(tmp_path):
+    b, mgr = mk_manager(tmp_path)
+    p = SessionPersistence(b, DiscBackend(str(tmp_path / "sess")))
+
+    class Ch:
+        clientid = "c1"
+        session = Session(clientid="c1", expiry_interval=300)
+
+        def kick(self, rc=0):
+            pass
+
+        def deliver(self, items):
+            pass
+
+    ch = Ch()
+    ch.session.subscriptions["r/#"] = SubOpts(qos=1)
+    b.cm.register_channel(ch)
+    b.subscribe("c1", "r/#", SubOpts(qos=1))
+    b.cm.disconnect_channel(ch)  # park: cursor-form record
+    assert b.publish(msg(topic="r/1", payload=b"m1")) == 1
+    assert b.publish(msg(topic="r/2", payload=b"m2")) == 1
+    assert len(b.cm.pending["c1"][0].mqueue) == 0  # log, not mqueue
+    s, present = b.cm.open_session(
+        False, "c1", lambda: Session(clientid="c1"))
+    assert present
+    assert sorted(m.payload for m in s.mqueue.peek_all()) == [b"m1", b"m2"]
+    # park again: the replayed-but-undrained mqueue re-spills; a second
+    # resume must not lose it (the dedup=False spill contract)
+    b.cm.register_channel(ch)
+    ch.session = s
+    b.cm.disconnect_channel(ch)
+    s2, present = b.cm.open_session(
+        False, "c1", lambda: Session(clientid="c1"))
+    assert present
+    assert sorted(m.payload for m in s2.mqueue.peek_all()) == [b"m1", b"m2"]
+
+
+def test_restart_resume_from_disk(tmp_path):
+    b, mgr = mk_manager(tmp_path)
+    p = SessionPersistence(b, DiscBackend(str(tmp_path / "sess")))
+    s = Session(clientid="c1", expiry_interval=3000)
+    s.subscriptions["d/#"] = SubOpts(qos=1)
+    b.subscribe("c1", "d/#", SubOpts(qos=1))
+    b.cm.pending["c1"] = (s, float("inf"))
+    p._on_park("c1", s, float("inf"))
+    b.publish(msg(topic="d/x", payload=b"while-away"))
+    mgr.close()  # clean shutdown flush
+
+    b2, mgr2 = mk_manager(tmp_path)
+    p2 = SessionPersistence(b2, DiscBackend(str(tmp_path / "sess")))
+    assert p2.restore() == 1
+    s2, present = b2.cm.open_session(
+        False, "c1", lambda: Session(clientid="c1"))
+    assert present
+    assert [m.payload for m in s2.mqueue.peek_all()] == [b"while-away"]
+
+
+def test_legacy_snapshot_migration_to_cursor_form(tmp_path):
+    """Satellite: first boot with ds.enable migrates old-format JSON
+    snapshots — queued messages move into the log, the file is
+    rewritten in cursor form, and resume still delivers everything."""
+    be = DiscBackend(str(tmp_path / "sess"))
+    legacy = Session(clientid="old", expiry_interval=3000)
+    legacy.subscriptions["m/#"] = SubOpts(qos=1)
+    for i in range(3):
+        legacy.mqueue.insert(msg(topic=f"m/{i}", payload=f"q{i}".encode()))
+    data = session_to_dict(legacy, None)  # OLD format: embedded mqueue
+    assert data["mqueue"] and "cursor" not in data
+    be.save("old", data)
+
+    b, mgr = mk_manager(tmp_path)
+    p = SessionPersistence(b, be)
+    assert p.restore() == 1
+    rewritten = be.load_all()[0]
+    assert "cursor" in rewritten and "mqueue" not in rewritten
+    assert b.metrics.get("ds.appends") == 3  # queue -> log
+    s, present = b.cm.open_session(
+        False, "old", lambda: Session(clientid="old"))
+    assert present
+    assert sorted(m.payload for m in s.mqueue.peek_all()) == \
+        [b"q0", b"q1", b"q2"]
+    # the migrated log survives a second restart
+    mgr.close()
+    b2, mgr2 = mk_manager(tmp_path)
+    recs = sum(
+        len(mgr2.logs[k].read_from(0, 100)[0]) for k in range(2)
+    )
+    assert recs == 3
+
+
+def test_gc_advances_behind_min_cursor_and_forced_gap(tmp_path):
+    b, mgr = mk_manager(tmp_path, shards=1, seg_bytes=128,
+                        retention_bytes=256, flush_bytes=64)
+    p = SessionPersistence(b, DiscBackend(str(tmp_path / "sess")))
+    s = Session(clientid="c1", expiry_interval=300)
+    s.subscriptions["g/#"] = SubOpts(qos=1)
+    b.subscribe("c1", "g/#", SubOpts(qos=1))
+    b.cm.pending["c1"] = (s, float("inf"))
+    p._on_park("c1", s, float("inf"))  # cursor at 0
+    for i in range(20):
+        b.publish(msg(topic="g/t", payload=f"payload-{i:03d}".encode()))
+    mgr.flush_all()
+    assert len(mgr.logs[0].segments) > 2
+    # cursor pins offset 0: bytes pressure forces drops past it
+    dropped = mgr.gc()
+    assert dropped > 0 and mgr.gc_forced_drops > 0
+    n, gap = mgr.replay_into(s)
+    assert gap > 0  # the hole is REPORTED, not silent
+    got = [int(m.payload.decode().split("-")[1])
+           for m in s.mqueue.peek_all()]
+    assert got == sorted(got)  # surviving suffix, in order
+    assert n == len(got) and n + gap == 20
+
+    # resumed sessions release the pin: a fresh park-cursor at the end
+    # lets retention reclaim everything
+    del b.cm.pending["c1"]
+    dropped2 = mgr.gc()
+    assert mgr.min_cursors()[0] == mgr.buffers[0].next_offset
+    assert dropped2 >= 0
+
+
+def test_gap_recovery_delivers_current_retained_state(tmp_path):
+    b, mgr = mk_manager(tmp_path, shards=1, seg_bytes=64,
+                        retention_bytes=64, flush_bytes=32)
+    p = SessionPersistence(b, DiscBackend(str(tmp_path / "sess")))
+    s = Session(clientid="c1", expiry_interval=300)
+    s.subscriptions["ret/#"] = SubOpts(qos=1)
+    b.subscribe("c1", "ret/#", SubOpts(qos=1))
+    b.cm.pending["c1"] = (s, float("inf"))
+    p._on_park("c1", s, float("inf"))
+    for i in range(10):
+        b.publish(msg(topic="ret/t", payload=f"v{i}".encode(),
+                      retain=True))
+    mgr.flush_all()
+    mgr.gc()  # hard retention drops generations past the pinned cursor
+    n, gap = mgr.replay_into(s)
+    assert gap > 0
+    payloads = {m.payload for m in s.mqueue.peek_all()}
+    assert b"v9" in payloads  # last retained value recovered
+
+
+def test_manager_stats_and_gauges(tmp_path):
+    b, mgr = mk_manager(tmp_path)
+    s = Session(clientid="c1", expiry_interval=300)
+    s.subscriptions["st/#"] = SubOpts(qos=1)
+    b.subscribe("c1", "st/#", SubOpts(qos=1))
+    b.cm.pending["c1"] = (s, float("inf"))
+    s.ds_cursor = mgr.end_cursor()
+    b.publish(msg(topic="st/x", payload=b"1"))
+    st = mgr.stats()
+    assert len(st["shards"]) == 2
+    assert st["totals"]["lag"] == 1  # one un-replayed append
+    mgr.sync_metrics()
+    assert b.metrics.gauge("ds.lag") == 1
+    assert b.metrics.gauge("ds.segments") == 2.0
+
+
+def test_ds_stats_endpoint(tmp_path):
+    from emqx_tpu.mgmt.api import HttpError, ManagementApi
+
+    b, mgr = mk_manager(tmp_path)
+    api = ManagementApi(b, ds=mgr)
+    out = api.ds_stats(None)
+    assert "shards" in out and out["config"]["shards"] == 2
+    api2 = ManagementApi(Broker())
+    with pytest.raises(HttpError):
+        api2.ds_stats(None)
+
+
+def test_ds_dump_tool_renders(tmp_path, capsys):
+    import importlib.util
+
+    b, mgr = mk_manager(tmp_path)
+    s = Session(clientid="c1", expiry_interval=300)
+    s.subscriptions["#"] = SubOpts(qos=1)
+    s.ds_cursor = mgr.end_cursor()
+    b.cm.pending["c1"] = (s, float("inf"))
+    b.subscribe("c1", "#", SubOpts(qos=1))
+    b.publish(msg(topic="dump/x", payload=b"peekme"))
+    mgr.flush_all()
+    mgr.close()
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "tools", "ds_dump.py")
+    spec = importlib.util.spec_from_file_location("ds_dump_tool", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    import sys as _sys
+
+    argv = _sys.argv
+    _sys.argv = ["ds_dump.py", str(tmp_path / "ds"), "--records", "2"]
+    try:
+        assert mod.main() == 0
+    finally:
+        _sys.argv = argv
+    out = capsys.readouterr().out
+    assert "shard-0" in out and "gen=" in out
+    assert "dump/x" in out  # record peek decoded the topic
+
+
+def test_cursor_json_roundtrip_via_session_dict(tmp_path):
+    s = Session(clientid="c1", expiry_interval=300)
+    cursor = {0: (3, 17), 1: (1, 0)}
+    d = session_to_dict(s, None, cursor=cursor)
+    assert "mqueue" not in d
+    blob = json.loads(json.dumps(d))  # disc round-trip
+    from emqx_tpu.broker.persist import session_from_dict
+
+    s2 = session_from_dict(blob)
+    assert s2.ds_cursor == {0: (3, 17), 1: (1, 0)}

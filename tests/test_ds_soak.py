@@ -1,0 +1,29 @@
+"""Durable-log kill -9 soak (slow tier: `pytest -m slow`).
+
+Drives the `ds` front of tools/chaos_soak.py — a REAL child process
+appending a QoS1 stream is SIGKILLed mid-flush; recovery + session
+resume must replay every committed message at-least-once, with
+receiver-side (mid) dedup making delivery exactly-once.  Kept out of
+tier-1 (`-m 'not slow'`) so the subprocess spawn/kill rounds stay off
+the merge-gate budget; `make ds-soak` runs the full 5-seed sweep.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_ds_kill9_soak_two_seeds():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--fronts", "ds", "--seeds", "2"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, f"ds soak failed:\n{r.stdout}\n{r.stderr}"
+    assert "all 2 seeds passed" in r.stdout

@@ -24,6 +24,14 @@ Three phases per seed, all driven through the fault-injection plane
    failure must surface as the exception the checkpoint manager alarms
    on.
 
+4. ds — durable-message-log crash soak: a REAL child process appends a
+   QoS1 stream through the write-behind buffer, recording (after each
+   fsync'd flush) how far is committed; the parent `kill -9`s it
+   mid-flush at a seeded random moment, recovers the log (torn-tail
+   truncation), and resumes a parked session subscribed to the stream.
+   Invariants: every committed message is replayed AT LEAST once, and
+   receiver-side (mid) dedup makes delivery exactly-once.
+
 Also asserts the disarmed plane is effectively free (sub-microsecond
 per fault point) so it can stay compiled into the bench hot path.
 """
@@ -31,6 +39,9 @@ per fault point) so it can stay compiled into the bench hot path.
 import argparse
 import asyncio
 import os
+import random
+import signal
+import subprocess
 import sys
 import tempfile
 import time
@@ -338,6 +349,122 @@ def ckpt_phase(seed: int, verbose: bool) -> dict:
     return {"fallbacks": 1}
 
 
+# -------------------------------------------------------------------- ds
+
+def _ds_config(shards: int = 2):
+    from emqx_tpu.config.config import Config
+
+    return Config({"ds": {
+        "enable": True,
+        "shards": shards,
+        "flush_bytes": 512,  # small watermark: many flush boundaries
+        "seg_bytes": 4096,   # frequent segment rolls under the stream
+    }})
+
+
+def ds_child(directory: str) -> None:
+    """Child half of the ds front: append a numbered QoS1 stream,
+    flushing every few messages and recording the committed count
+    AFTER each flush returns (so `progress` is always <= what the
+    fsync made durable).  Runs until SIGKILLed by the parent."""
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.ds.manager import DsManager
+
+    mgr = DsManager(Broker(), os.path.join(directory, "ds"), _ds_config())
+    prog = os.path.join(directory, "progress")
+    for i in range(200_000):  # bounded: can't run away if orphaned
+        mgr.append(Message(
+            topic=f"soak/ds/{i % 5}", payload=str(i).encode(), qos=1
+        ))
+        if (i + 1) % 7 == 0:
+            mgr.flush_all()
+            tmp = prog + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(i + 1))
+            os.replace(tmp, prog)
+
+
+def ds_phase(seed: int, verbose: bool) -> dict:
+    rng = random.Random(f"ds:{seed}")
+    d = tempfile.mkdtemp(prefix="chaos_ds_")
+    proc = None
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--ds-child", d],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+        prog = os.path.join(d, "progress")
+        deadline = time.monotonic() + 30
+        while not os.path.exists(prog):
+            if proc.poll() is not None:
+                err = proc.stderr.read().decode(errors="replace")
+                raise SoakFailure(f"ds child died before flushing: {err}")
+            if time.monotonic() > deadline:
+                raise SoakFailure("ds child never flushed")
+            time.sleep(0.01)
+        # let the stream run, then kill -9 at a seeded random moment —
+        # mid-append, mid-flush, mid-roll, whatever is in flight
+        time.sleep(rng.uniform(0.05, 0.8))
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        with open(prog) as f:
+            committed = int(f.read())
+
+        # recovery: reopen the log (torn-tail truncation + re-seal),
+        # resume a parked session subscribed to the whole stream
+        from emqx_tpu.broker.broker import Broker
+        from emqx_tpu.ds.manager import DsManager
+
+        b = Broker()
+        mgr = DsManager(b, os.path.join(d, "ds"), _ds_config())
+        try:
+            session = Session(
+                clientid="soaker", expiry_interval=300, max_mqueue=0
+            )
+            session.subscriptions["soak/ds/#"] = SubOpts(qos=1)
+            session.ds_cursor = {
+                k: (0, 0) for k in range(mgr.n_shards)
+            }
+            n, gap = mgr.replay_into(session)
+            check(gap == 0, f"seed {seed}: unexpected GC gap {gap}")
+            # receiver-side (mid) dedup: at-least-once -> exactly-once
+            seen_mids, seqs = set(), []
+            for m in session.mqueue.peek_all():
+                if m.mid in seen_mids:
+                    continue
+                seen_mids.add(m.mid)
+                seqs.append(int(m.payload))
+            missing = set(range(committed)) - set(seqs)
+            check(
+                not missing,
+                f"seed {seed}: committed messages lost after kill -9 "
+                f"(flushed {committed}, missing {sorted(missing)[:5]})",
+            )
+            check(
+                len(seqs) == len(set(seqs)),
+                f"seed {seed}: duplicate seqs after mid dedup",
+            )
+            out = {
+                "committed": committed,
+                "replayed": n,
+                "delivered": len(seqs),
+                "uncommitted_recovered": len(seqs) - committed,
+            }
+            if verbose:
+                print(f"  ds: {out}")
+            return out
+        finally:
+            mgr.close()
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        import shutil
+
+        shutil.rmtree(d, ignore_errors=True)
+
+
 # -------------------------------------------------------------- overhead
 
 def overhead_check() -> float:
@@ -353,12 +480,28 @@ def overhead_check() -> float:
     return per_call
 
 
+FRONTS = ("cluster", "engine", "ckpt", "ds")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seeds", type=int, default=5,
                     help="number of seeds to soak (1..N)")
+    ap.add_argument("--fronts", default=",".join(FRONTS),
+                    help="comma list of fronts to run "
+                         f"(default: {','.join(FRONTS)})")
+    ap.add_argument("--ds-child", default=None, metavar="DIR",
+                    help=argparse.SUPPRESS)  # internal: ds-front child
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
+    if args.ds_child:
+        ds_child(args.ds_child)
+        return 0
+    fronts = [f.strip() for f in args.fronts.split(",") if f.strip()]
+    unknown = set(fronts) - set(FRONTS)
+    if unknown:
+        print(f"unknown front(s): {sorted(unknown)}", file=sys.stderr)
+        return 2
 
     per_call = overhead_check()
     print(f"disarmed fault point: {per_call * 1e9:.0f} ns/call")
@@ -366,10 +509,16 @@ def main() -> int:
     failures = 0
     for seed in range(1, args.seeds + 1):
         t0 = time.monotonic()
+        cs = es = dss = {}
         try:
-            cs = asyncio.run(cluster_phase(seed, args.verbose))
-            es = engine_phase(seed, args.verbose)
-            ckpt_phase(seed, args.verbose)
+            if "cluster" in fronts:
+                cs = asyncio.run(cluster_phase(seed, args.verbose))
+            if "engine" in fronts:
+                es = engine_phase(seed, args.verbose)
+            if "ckpt" in fronts:
+                ckpt_phase(seed, args.verbose)
+            if "ds" in fronts:
+                dss = ds_phase(seed, args.verbose)
         except SoakFailure as e:
             failures += 1
             print(f"seed {seed}: FAIL — {e}")
@@ -384,9 +533,11 @@ def main() -> int:
             f"(spooled {cs.get('spooled', 0)}, "
             f"replayed {cs.get('replayed', 0)}, "
             f"dedup {cs.get('dup_dropped', 0)}), "
-            f"engine {es.get('mode')} "
+            f"engine {es.get('mode', '-')} "
             f"(timeouts {es.get('dev_timeouts', 0)}, "
-            f"trips {es.get('breaker_trips', 0)})"
+            f"trips {es.get('breaker_trips', 0)}), "
+            f"ds kill-9 (committed {dss.get('committed', 0)}, "
+            f"delivered {dss.get('delivered', 0)})"
         )
     if failures:
         print(f"{failures} seed(s) FAILED")

@@ -22,6 +22,11 @@ this gate implements the highest-value checks directly on the stdlib:
      ainject/peek/mangle) in emqx_tpu/** must name a site registered in
      `fault/sites.py` SITES — chaos schedules key on these names, and
      an unregistered site can never be armed from config
+  7. ds config schema: every `ds.*` config key read in emqx_tpu/ds/
+     (any `.get("ds.<key>")` literal) must be declared in the config
+     schema (`config/config.py` SCHEMA["ds"]) — the inverse direction
+     of the dead-config audit: a key read but never declared always
+     resolves to None and silently disables what it configures
 
 Exit code 0 = clean.  `--fix` is intentionally absent: findings are
 either real bugs or deliberate (suppressed via `# check: ignore` on the
@@ -364,6 +369,91 @@ def check_fault_sites(problems):
             )
 
 
+def known_ds_config_keys():
+    """SCHEMA["ds"] keys, parsed statically from config/config.py."""
+    path = os.path.join(REPO, "emqx_tpu", "config", "config.py")
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), path)
+    for node in ast.walk(tree):
+        tgt = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            tgt = node.target
+        if not (
+            isinstance(tgt, ast.Name)
+            and tgt.id == "SCHEMA"
+            and isinstance(node.value, ast.Dict)
+        ):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if (
+                isinstance(k, ast.Constant)
+                and k.value == "ds"
+                and isinstance(v, ast.Dict)
+            ):
+                return {
+                    f"ds.{f.value}"
+                    for f in v.keys
+                    if isinstance(f, ast.Constant)
+                    and isinstance(f.value, str)
+                }
+    return set()
+
+
+def collect_ds_config_reads():
+    """(path, lineno, key) for every `<x>.get("ds.<key>", ...)` literal
+    in the emqx_tpu/ds/ package."""
+    out = []
+    pkg = os.path.join(REPO, "emqx_tpu", "ds")
+    if not os.path.isdir(pkg):
+        return out
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path, "r", encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), path)
+                except SyntaxError:
+                    continue  # reported by the syntax pass
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not (isinstance(fn, ast.Attribute) and fn.attr == "get"):
+                    continue
+                if (
+                    node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("ds.")
+                ):
+                    out.append((path, node.lineno, node.args[0].value))
+    return out
+
+
+def check_ds_config(problems):
+    reads = collect_ds_config_reads()
+    if not reads:
+        return
+    known = known_ds_config_keys()
+    if not known:
+        problems.append(
+            "emqx_tpu/config/config.py: SCHEMA has no 'ds' namespace but "
+            "emqx_tpu/ds/ reads ds.* config keys"
+        )
+        return
+    for path, line, key in reads:
+        if key not in known:
+            problems.append(
+                f"{path}:{line}: config key {key!r} read but not declared "
+                "in config/config.py SCHEMA['ds']"
+            )
+
+
 def check_native(problems):
     src_dir = os.path.join(REPO, "native")
     if not os.path.isdir(src_dir):
@@ -402,6 +492,7 @@ def main() -> int:
         check_ast_lints(path, src, tree, problems, ignored)
     check_tracepoints(problems)
     check_fault_sites(problems)
+    check_ds_config(problems)
     check_native(problems)
     for p in problems:
         print(p)
