@@ -93,9 +93,12 @@ def session_to_dict(
 
     Legacy form (cursor=None) embeds the whole mqueue — the
     O(queue depth) rewrite the durable log replaces.  Cursor form
-    (`ds.enable`) persists only (subscriptions, inflight, dedup,
-    cursor): the mqueue is reconstructed by replaying the shared log
-    from the per-shard cursor on resume (ds/manager.py)."""
+    (`ds.enable`) persists (subscriptions, inflight, dedup, cursor)
+    plus the RESIDUAL mqueue — the messages the log never owns (QoS0
+    and shared-group QoS>=1 copies, which stay on the in-memory path);
+    everything else is reconstructed by replaying the shared log from
+    the per-shard cursor on resume (ds/manager.py).  The residual is
+    omitted when empty, the common case."""
     d = {
         "clientid": s.clientid,
         "expiry_interval": s.expiry_interval,
@@ -124,7 +127,8 @@ def session_to_dict(
         "awaiting_rel": list(s.awaiting_rel.keys()),
     }
     if cursor is not None:
-        del d["mqueue"]
+        if not d["mqueue"]:
+            del d["mqueue"]
         d["cursor"] = {str(k): list(v) for k, v in cursor.items()}
     return d
 
@@ -288,13 +292,16 @@ class SessionPersistence:
             self._orig_on_discard(session)
 
     def mark_dirty(self, clientid: str) -> None:
-        # cursor-form records are static while parked (offline enqueues
-        # land in the shared log, not the session file): nothing to
-        # re-snapshot on the housekeeping tick
-        if self.ds is not None:
+        ent = self.broker.cm.pending.get(clientid)
+        if ent is None:
             return
-        if clientid in self.broker.cm.pending:
-            self._dirty.add(clientid)
+        if self.ds is not None and not len(ent[0].mqueue):
+            # cursor-form records are static while parked as long as
+            # every offline enqueue lands in the shared log; only a
+            # residual in-memory enqueue (a shared-group QoS>=1 copy,
+            # or QoS0) changes the record and needs a re-snapshot
+            return
+        self._dirty.add(clientid)
 
     def on_resume(
         self, clientid: str, session: Optional[Session] = None
@@ -317,7 +324,13 @@ class SessionPersistence:
                 self._dirty.discard(cid)
                 continue
             session, expire_at = ent
-            self.backend.save(cid, session_to_dict(session, expire_at))
+            # a ds session re-snapshots in cursor form (its cursor
+            # must survive the rewrite — dropping it would migrate the
+            # session afresh on restore and orphan the log window
+            # between its old cursor and the migration-time end)
+            self.backend.save(cid, session_to_dict(
+                session, expire_at,
+                cursor=getattr(session, "ds_cursor", None)))
             self._dirty.discard(cid)
             n += 1
         return n

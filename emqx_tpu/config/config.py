@@ -336,11 +336,10 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
             desc="per-shard on-disk cap; sealed generations behind the "
                  "session min-cursor drop first, then oldest-first "
                  "(forced; replay reports the gap)"),
-        "retention_ms": Field(
+        "retention": Field(
             "duration", 604800.0,  # 7 days
-
-            desc="hard message age bound, even ahead of a lagging "
-                 "cursor"),
+            desc="hard message age bound (duration, bare numbers are "
+                 "seconds), even ahead of a lagging cursor"),
     },
     "retainer": {
         "enable": Field("bool", True),

@@ -2,10 +2,13 @@
 
 A cursor is `(shard, generation, offset)`: the offset is the resume
 point (global, monotonic per shard); the generation records which
-segment the offset lived in when the cursor was taken, so a cursor
-that lands in a GC-dropped generation is detectable — iteration skips
-to the oldest surviving record and reports the hole in `gap` instead
-of failing or silently rewinding.
+segment the offset lived in when the cursor was taken, so a stale
+cursor is detectable — a cursor landing in a GC-dropped generation
+skips to the oldest surviving record and reports the hole in `gap`,
+and a cursor pointing PAST what its generation durably holds (crash
+recovery truncated the generation and a newer one reused the offsets)
+rewinds to the truncation point and reports the lost window as `gap`
+instead of silently skipping the reused offsets' new messages.
 
 Filtering is server-side: records are decoded lazily and matched
 against the session's topic filters through the host golden matcher
@@ -80,6 +83,41 @@ class ShardIterator:
         self.batch_records = batch_records
         self.gap = 0
         self.exhausted = False
+        self._validate_cursor()
+
+    def _validate_cursor(self) -> None:
+        """Check the (generation, offset) pair against the segment
+        chain.  Offsets alone cannot distinguish "resume point" from
+        "post-crash timeline where the offsets were reused for new
+        messages"; the generation can.  Callers must flush the shard's
+        write buffer first (replay does) — buffered appends are ahead
+        of the durable end by design and are not a mismatch."""
+        gen, off = self.cursor.generation, self.cursor.offset
+        log = self.log
+        if gen <= 0:
+            return  # unknown-generation cursor: plain offset seek
+        for seg in [*log.segments, log._active]:
+            if seg.generation != gen:
+                continue
+            if off > seg.end:
+                # crash recovery truncated this generation below the
+                # cursor and reopened at seg.end: records now on disk
+                # in [seg.end, off) are NEW messages on the post-crash
+                # timeline; the pre-crash ones the cursor had advanced
+                # past are the hole.  Rewind and report.
+                self.gap += off - seg.end
+                self.cursor = Cursor(log.shard, gen, seg.end)
+            return
+        if gen > log.generation:
+            # cursor from a lost timeline (log directory replaced or
+            # rolled back wholesale): restart at the oldest surviving
+            # record, reporting everything the cursor thought it had
+            oldest = log.oldest_offset
+            self.gap += max(0, off - oldest)
+            self.cursor = Cursor(
+                log.shard, log.generation_at(oldest), oldest)
+        # else: generation GC'd behind the chain — read_from's offset
+        # accounting reports that hole when the seek lands past it
 
     def _matches(self, topic: str) -> bool:
         if self.filter_words is None:
@@ -104,7 +142,7 @@ class ShardIterator:
                 if len(out) >= n:
                     # batch full mid-segment: resume exactly here
                     self.cursor = Cursor(
-                        self.log.shard, self.log.generation, off
+                        self.log.shard, self.log.generation_at(off), off
                     )
                     return out
                 try:
@@ -115,6 +153,6 @@ class ShardIterator:
                 if self._matches(topic):
                     out.append((off, message_from_dict(d)))
             self.cursor = Cursor(
-                self.log.shard, self.log.generation, next_off
+                self.log.shard, self.log.generation_at(next_off), next_off
             )
         return out

@@ -194,6 +194,21 @@ class ShardLog:
     def total_bytes(self) -> int:
         return sum(s.nbytes for s in self.segments) + self._active.nbytes
 
+    def generation_at(self, offset: int) -> int:
+        """Generation whose segment holds (or will hold) `offset` —
+        the active generation for offsets at/past the active base.
+        Cursors stamp THIS, not the active generation, so a mid-chain
+        cursor names the generation its offset actually lives in and
+        a post-crash (generation, offset) mismatch stays detectable
+        (`ShardIterator._validate_cursor`)."""
+        if offset >= self._active.base:
+            return self._active.generation
+        for seg in reversed(self.segments):
+            if seg.base <= offset:
+                return seg.generation
+        return (self.segments[0].generation if self.segments
+                else self._active.generation)
+
     def append_payloads(self, items: List[Tuple[int, bytes]]) -> None:
         """Write (offset, payload) records — offsets MUST continue the
         shard's sequence (the write-behind buffer guarantees this) —

@@ -18,7 +18,7 @@ The inversion of the `broker/persist.py` data model:
   filters whose log window was GC'd away (`gap` recovery);
 * GC — the per-shard min-cursor over parked sessions advances as
   sessions resume/expire; sealed generations fully behind it are
-  dropped whole once `ds.retention_bytes`/`ds.retention_ms` pressure
+  dropped whole once `ds.retention_bytes`/`ds.retention` pressure
   says so, and hard retention can drop unconsumed generations too (the
   cursor then reports the gap instead of blocking the disk forever).
 
@@ -55,7 +55,7 @@ class DsManager:
         self.flush_bytes = int(conf.get("ds.flush_bytes"))
         self.gc_interval = float(conf.get("ds.gc_interval"))
         self.retention_bytes = int(conf.get("ds.retention_bytes"))
-        self.retention_s = float(conf.get("ds.retention_ms"))
+        self.retention_s = float(conf.get("ds.retention"))
         seg_bytes = int(conf.get("ds.seg_bytes"))
         self.logs: List[ShardLog] = [
             ShardLog(os.path.join(directory, f"shard-{k}"), k,
@@ -113,7 +113,9 @@ class DsManager:
         """Per-shard (generation, next-append offset) this instant —
         the cursor a session parking NOW resumes from.  Uses the
         buffered head (not the durable head): appends already buffered
-        happened-before the park."""
+        happened-before the park.  `park_session` flushes before the
+        cursor is persisted, so the durable end catches up to every
+        cursor that reaches disk."""
         return {
             k: (self.logs[k].generation, self.buffers[k].next_offset)
             for k in range(self.n_shards)
@@ -121,15 +123,24 @@ class DsManager:
 
     def park_session(self, session) -> Dict[int, Tuple[int, int]]:
         """Take the park cursor, spill QoS>=1 mqueue overflow into the
-        log (past the cursor, so resume replays it), keep QoS0 overflow
-        in memory only.  Returns the cursor; also set on the session."""
+        log (past the cursor, so resume replays it), keep QoS0/shared
+        overflow in the in-memory mqueue (persisted as the residual
+        mqueue section of the cursor-form record).  Returns the
+        cursor; also set on the session."""
         cursor = self.end_cursor()
         leftovers = session.mqueue.drain_all()
         for m in leftovers:
             if m.qos >= 1 and not m.headers.get("shared"):
                 self.append(m, dedup=False)
             else:
-                session.mqueue.insert(m)  # QoS0/shared: in-memory only
+                session.mqueue.insert(m)
+        # the persisted cursor must never run ahead of the durable
+        # end: a crash would otherwise recover the log to a lower
+        # offset, hand the lost offsets to NEW post-restart messages,
+        # and this session's resume would silently skip them (its
+        # cursor claims they were already seen).  Flushing here makes
+        # cursor <= durable end at every save point.
+        self.flush_all()
         session.ds_cursor = cursor
         return cursor
 
@@ -230,10 +241,13 @@ class DsManager:
         """Per-shard minimum resume offset over parked sessions (the
         session-GC output retention runs behind).  Shards no parked
         session holds a cursor into float to the buffered end —
-        everything there is reclaimable."""
+        everything there is reclaimable.  Must run on the event loop
+        (like everything that reads cm.pending): resume pops the
+        session from pending before replaying it, so an off-loop
+        snapshot here could GC a generation mid-replay."""
         mins = {k: self.buffers[k].next_offset
                 for k in range(self.n_shards)}
-        for _cid, (session, _exp) in self.broker.cm.pending.items():
+        for _cid, (session, _exp) in list(self.broker.cm.pending.items()):
             cur = getattr(session, "ds_cursor", None)
             if not cur:
                 continue
@@ -244,7 +258,7 @@ class DsManager:
 
     def gc(self, now: Optional[float] = None) -> int:
         """Seal + drop generations behind the min-cursor under
-        retention pressure; hard-expire past `ds.retention_ms` even
+        retention pressure; hard-expire past `ds.retention` even
         ahead of a lagging cursor (replay then reports the gap)."""
         now = now if now is not None else time.time()
         mins = self.min_cursors()
@@ -273,16 +287,35 @@ class DsManager:
             self.metrics.inc("ds.gc_segments", dropped)
         return dropped
 
-    def tick(self, now: Optional[float] = None) -> None:
-        """Node-ticker cadence: interval flush, periodic GC, gauges."""
+    def flush_due(self, now: Optional[float] = None) -> bool:
+        """True (and arms the next interval) when the periodic flush
+        is due.  The node ticker checks this on the loop and runs the
+        fsync-heavy `flush_all` on a worker thread."""
         now = now if now is not None else time.monotonic()
         if now - self._last_flush >= self.flush_interval:
             self._last_flush = now
-            self.flush_all()
+            return True
+        return False
+
+    def tick_gc(self, now: Optional[float] = None) -> None:
+        """Loop-side tick half: periodic retention GC + gauge refresh.
+        Must stay ON the event loop — `min_cursors()` walks cm.pending,
+        which the loop mutates (resume pops entries mid-replay); an
+        off-loop run races that and can GC a generation a resuming
+        session is concurrently replaying."""
+        now = now if now is not None else time.monotonic()
         if now - self._last_gc >= self.gc_interval:
             self._last_gc = now
             self.gc()
         self.sync_metrics()
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Single-threaded convenience (tests/bench/tools): interval
+        flush + GC in one call.  The node splits the two halves —
+        see `flush_due`/`tick_gc`."""
+        if self.flush_due(now):
+            self.flush_all()
+        self.tick_gc(now)
 
     def sync_metrics(self) -> None:
         if self.metrics is None:
@@ -333,7 +366,7 @@ class DsManager:
                 "flush_interval": self.flush_interval,
                 "flush_bytes": self.flush_bytes,
                 "retention_bytes": self.retention_bytes,
-                "retention_ms": self.retention_s,
+                "retention": self.retention_s,
             },
         }
 

@@ -965,9 +965,14 @@ class NodeRuntime:
                 if self.broker.retainer.store is not None:
                     self.broker.retainer.store.flush()
                 if self.ds is not None:
-                    # interval flush + retention GC off the loop: the
-                    # fsync can block for the device's full write cost
-                    await asyncio.to_thread(self.ds.tick, now)
+                    # only the fsync-heavy flush leaves the loop; GC +
+                    # min-cursor + gauges stay ON the loop so the walk
+                    # over cm.pending is serialized with resumes (an
+                    # off-loop min-cursor can miss a session mid-resume
+                    # and GC the generation it is replaying)
+                    if self.ds.flush_due(now):
+                        await asyncio.to_thread(self.ds.flush_all)
+                    self.ds.tick_gc(now)
                 if now - last_hb >= hb_ivl:
                     last_hb = now
                     self.sys_heartbeat.tick()

@@ -311,7 +311,10 @@ def test_park_spills_mqueue_overflow_into_log(tmp_path):
     b.cm.pending["c1"] = (s, float("inf"))
     assert len(s.mqueue) == 1  # QoS0 stays in memory
     rec = p.backend.load_all()[0]
-    assert "mqueue" not in rec and "cursor" in rec
+    assert "cursor" in rec
+    # the in-memory leftover rides along as the residual mqueue
+    # section; the four QoS1 messages live in the log, not the record
+    assert [m["qos"] for m in rec["mqueue"]] == [0]
     n, gap = mgr.replay_into(s)
     assert n == 4 and gap == 0
     payloads = sorted(m.payload for m in s.mqueue.peek_all())
@@ -410,6 +413,118 @@ def test_legacy_snapshot_migration_to_cursor_form(tmp_path):
         len(mgr2.logs[k].read_from(0, 100)[0]) for k in range(2)
     )
     assert recs == 3
+
+
+def test_park_flushes_so_crash_cannot_reuse_cursor_offsets(tmp_path):
+    """Park-time flush: a persisted cursor must never exceed the
+    durable end.  Without it, a crash recovers the log to a lower
+    offset, post-restart appends REUSE the lost offsets, and a parked
+    session whose saved cursor sits past them silently skips every
+    new message in that range on resume."""
+    b, mgr = mk_manager(tmp_path)
+    p = SessionPersistence(b, DiscBackend(str(tmp_path / "sess")))
+    a = Session(clientid="a", expiry_interval=3000)
+    a.subscriptions["t/#"] = SubOpts(qos=1)
+    b.subscribe("a", "t/#", SubOpts(qos=1))
+    b.cm.pending["a"] = (a, float("inf"))
+    p._on_park("a", a, float("inf"))
+    b.publish(msg(topic="t/1", payload=b"m1"))  # buffered for a
+    # session b parks while m1 is still buffered: the park flushes,
+    # so b's saved cursor never points past the durable end
+    sb = Session(clientid="b", expiry_interval=3000)
+    sb.subscriptions["t/#"] = SubOpts(qos=1)
+    b.subscribe("b", "t/#", SubOpts(qos=1))
+    b.cm.pending["b"] = (sb, float("inf"))
+    p._on_park("b", sb, float("inf"))
+    rec = next(r for r in p.backend.load_all() if r["clientid"] == "b")
+    for k, (_gen, off) in ((int(k), v) for k, v in rec["cursor"].items()):
+        assert off <= mgr.logs[k].next_offset  # <= durable end
+    for log in mgr.logs:
+        log._f.close()  # kill -9: any buffered tail dies here
+
+    b2, mgr2 = mk_manager(tmp_path)
+    p2 = SessionPersistence(b2, DiscBackend(str(tmp_path / "sess")))
+    assert p2.restore() == 2
+    b2.publish(msg(topic="t/2", payload=b"m2"))  # post-restart offsets
+    s, present = b2.cm.open_session(
+        False, "b", lambda: Session(clientid="b"))
+    assert present
+    assert [m.payload for m in s.mqueue.peek_all()] == [b"m2"]
+    s, present = b2.cm.open_session(
+        False, "a", lambda: Session(clientid="a"))
+    assert present
+    assert sorted(m.payload for m in s.mqueue.peek_all()) == [b"m1", b"m2"]
+
+
+def test_cursor_past_truncated_generation_reports_gap(tmp_path):
+    """A cursor claiming offsets its generation no longer durably
+    holds (crash truncation + offset reuse) rewinds to the truncation
+    point: the reused offsets' NEW messages are delivered and the
+    lost pre-crash window is REPORTED as gap — never a silent skip."""
+    log = ShardLog(str(tmp_path), 0)
+    log.append_payloads([
+        (i, encode_message(msg(topic="v/t", payload=str(i).encode())))
+        for i in range(3)
+    ])  # generation 1, durable end 3
+    log._f.close()  # kill: pretend offsets 3,4 were buffered and died
+    log = ShardLog(str(tmp_path), 0)  # gen 1 seals at end=3; gen 2 opens
+    log.append_payloads([
+        (i, encode_message(msg(topic="v/t", payload=f"new{i}".encode())))
+        for i in range(3, 5)
+    ])  # post-crash messages REUSE offsets 3,4 (generation 2)
+    it = ShardIterator(log, Cursor(0, 1, 5), filters=["v/#"])
+    assert it.gap == 2  # the lost pre-crash window, reported up front
+    got = [m.payload for _o, m in it.next(10)]
+    assert got == [b"new3", b"new4"]  # reused offsets still delivered
+    log.close()
+
+
+def test_shared_qos1_residual_persists_across_restart(tmp_path):
+    """Shared-group QoS>=1 copies dispatched to a parked session never
+    enter the log (exactly-one-member ownership) — they survive a
+    restart via the residual mqueue section, with mark_dirty + tick
+    re-snapshotting the record like the legacy path."""
+    b, mgr = mk_manager(tmp_path)
+    p = SessionPersistence(b, DiscBackend(str(tmp_path / "sess")))
+    s = Session(clientid="c1", expiry_interval=3000)
+    s.subscriptions["$share/g/s/#"] = SubOpts(qos=1)
+    b.subscribe("c1", "$share/g/s/#", SubOpts(qos=1))
+    b.cm.pending["c1"] = (s, float("inf"))
+    p._on_park("c1", s, float("inf"))
+    assert b.publish(msg(topic="s/1", payload=b"shared-copy")) == 1
+    assert b.metrics.get("ds.appends") == 0  # stayed off the log
+    assert len(s.mqueue) == 1
+    assert p.tick() == 1  # dirty residual re-snapshotted, cursor kept
+    rec = p.backend.load_all()[0]
+    assert "cursor" in rec
+    assert [m["payload"] for m in rec["mqueue"]]
+    mgr.close()
+
+    b2, mgr2 = mk_manager(tmp_path)
+    p2 = SessionPersistence(b2, DiscBackend(str(tmp_path / "sess")))
+    assert p2.restore() == 1
+    s2, present = b2.cm.open_session(
+        False, "c1", lambda: Session(clientid="c1"))
+    assert present
+    assert [m.payload for m in s2.mqueue.peek_all()] == [b"shared-copy"]
+
+
+def test_mark_dirty_skips_log_bound_traffic(tmp_path):
+    """With ds enabled, log-bound offline traffic must NOT re-dirty
+    the session record (that would restore the O(sessions) per-tick
+    rewrite the log exists to kill); only residual in-memory enqueues
+    do."""
+    b, mgr = mk_manager(tmp_path)
+    p = SessionPersistence(b, DiscBackend(str(tmp_path / "sess")))
+    s = Session(clientid="c1", expiry_interval=3000)
+    s.subscriptions["t/#"] = SubOpts(qos=1)
+    b.subscribe("c1", "t/#", SubOpts(qos=1))
+    b.cm.pending["c1"] = (s, float("inf"))
+    p._on_park("c1", s, float("inf"))
+    b.publish(msg(topic="t/1", payload=b"log-bound"))  # -> shared log
+    assert p.tick() == 0  # cursor-form record is static
+    b.publish(msg(topic="t/2", payload=b"q0", qos=0))  # -> residual
+    assert p.tick() == 1
 
 
 def test_gc_advances_behind_min_cursor_and_forced_gap(tmp_path):
