@@ -2,7 +2,7 @@
 # /root/reference/Makefile, rebar.config:16-36 dialyzer/xref/elvis).
 
 .PHONY: check lint test test-fast native bench restore-bench chaos \
-        ds-bench ds-dump ds-soak
+        ds-bench ds-dump ds-soak churn-bench
 
 # static-analysis gate: stdlib implementation (mypy/ruff are not in this
 # image and installs are off-limits — see tools/check.py header)
@@ -51,3 +51,9 @@ ds-dump:
 # 5 seeds; committed prefix must replay, (mid) dedup = exactly-once
 ds-soak:
 	python tools/chaos_soak.py --fronts ds --seeds 5
+
+# churn-apply capacity worker sweep: parallel churn plane vs the serial
+# python-dict path at 1/2/4 pool workers (ETPU_POOL_THREADS pinned per
+# subprocess); writes the BENCH_TABLE.md churn-capacity section
+churn-bench:
+	python bench.py --churn

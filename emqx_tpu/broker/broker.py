@@ -278,6 +278,7 @@ class Broker:
         c["engine.verify_mismatch"] = getattr(e, "collision_count", 0)
         c["engine.probes"] = getattr(e, "probe_count", 0)
         c["engine.breaker_trips"] = getattr(e, "breaker_trips", 0)
+        c["engine.churn_shed"] = getattr(e, "churn_shed", 0)
 
     # ---------------------------------------------------------- publish
 

@@ -65,6 +65,7 @@ PREDEFINED = [
     # Broker.sync_engine_metrics; exposed as Prometheus counters, e.g.
     # emqx_engine_path_flips)
     "engine.ticks",
+    "engine.churn_shed",
     "engine.host_serve",
     "engine.dev_serve",
     "engine.dev_timeout",

@@ -107,6 +107,20 @@ def nul_to_packed(arr: np.ndarray, n: int) -> Tuple[np.ndarray, np.ndarray]:
     return np.ascontiguousarray(packed), offs
 
 
+def packed_to_nul(buf: np.ndarray, offs: np.ndarray, n: int) -> np.ndarray:
+    """(buf, offsets) wire format -> the NUL-joined snapshot blob — the
+    inverse of nul_to_packed, one vectorized scatter (the churn plane
+    exports its registry in packed form; snapshots store NUL-joined)."""
+    if n == 0:
+        return np.zeros(0, dtype=np.uint8)
+    total = int(offs[n])
+    out = np.zeros(total + n - 1, dtype=np.uint8)
+    lens = np.diff(offs[: n + 1])
+    seg = np.repeat(np.arange(n, dtype=np.int64), lens)
+    out[np.arange(total, dtype=np.int64) + seg] = buf[:total]
+    return out
+
+
 def pack_filter_blob(filters: Sequence[str]) -> bytes:
     """Compressed length-prefixed filter list — the cluster
     fast-bootstrap wire blob (`cluster/node.py` snapshot resync ships

@@ -127,6 +127,8 @@ class TopicMatchEngine:
         device=None,
         min_batch: int = 64,
         kcap: int = 32,
+        use_churn_plane: Optional[bool] = None,
+        churn_shards: int = 16,
     ):
         self.space = space or hashing.HashSpace()
         self.tables = MatchTables(self.space)
@@ -152,6 +154,24 @@ class TopicMatchEngine:
         from ..ops import native as _native
 
         self._reg = _native.make_registry()
+
+        # parallel churn plane (native/churn.cc): C++-owned filter ->
+        # (fid, refcount, key) truth sharded by matchhash(filter) %
+        # churn_shards and mutated by the worker pool with the GIL
+        # released — replaces the Python dict bookkeeping that was the
+        # single-core ceiling at config 5's 500k churn ops/s.  When
+        # present it IS the registry of record (_fids/_refs stay empty);
+        # without the native lib the dict paths below remain canonical.
+        self._plane = None
+        if use_churn_plane is None:
+            use_churn_plane = True
+        if use_churn_plane and self._reg is not None:
+            self._plane = _native.make_churn_plane(self.space, churn_shards)
+
+        # churn shed-load visibility: ops the pacing layer dropped
+        # because apply capacity lagged demand (note_churn_shed)
+        self.churn_shed = 0
+        self._churn_shed_rec = 0  # high-water mark already flight-recorded
 
         # exact-match guarantee: verify device hash hits against stored
         # filter words (default on; see match())
@@ -241,9 +261,89 @@ class TopicMatchEngine:
     # ------------------------------------------------------------ mutation
 
     def fid_of(self, filt: str) -> Optional[int]:
+        if self._plane is not None:
+            return self._plane.lookup(filt)
         return self._fids.get(filt)
 
+    def fid_map(self) -> Dict[str, int]:
+        """filter -> fid copy (tests/introspection; O(n))."""
+        if self._plane is not None:
+            return self._plane.fid_map()
+        return dict(self._fids)
+
+    def free_fid_count(self) -> int:
+        if self._plane is not None:
+            return self._plane.free_count()
+        return len(self._free_fids)
+
+    def refcount_of(self, filt: str) -> int:
+        if self._plane is not None:
+            return self._plane.refcount(filt)
+        fid = self._fids.get(filt)
+        return 0 if fid is None else self._refs[fid]
+
+    def note_churn_shed(self, n: int) -> None:
+        """Count churn ops shed upstream (demand exceeded apply
+        capacity): the pacing layer calls this instead of dropping
+        silently, so shed load is visible in the flight recorder, the
+        `engine.churn_shed` counter, and bench JSON."""
+        if n <= 0:
+            return
+        self.churn_shed += n
+        tp("engine.churn.shed", shed=n, total=self.churn_shed)
+
+    # ---- churn-plane fast paths (native/churn.cc; see __init__) -------
+
+    def _plane_deep(self, res, adds, removes) -> None:
+        """Route the plane's deep entries (plen > device level cap) to
+        the host-trie fallback — the plane owns their fid/refcount, the
+        trie + _words/_fbytes own their match truth."""
+        if res.new_deep.any():
+            for k in np.nonzero(res.new_deep)[0].tolist():
+                filt = adds[int(res.new_aidx[k])]
+                fid = int(res.new_fid[k])
+                ws = topiclib.words(filt)
+                self._words[fid] = ws
+                self._fbytes[fid] = filt.encode("utf-8")
+                self._deep.insert(filt, fid)
+                self._deep_fids.add(fid)
+        if res.dead_deep.any():
+            for k in np.nonzero(res.dead_deep)[0].tolist():
+                filt = removes[int(res.dead_ridx[k])]
+                fid = int(res.dead_fid[k])
+                self._deep_fids.discard(fid)
+                self._deep.delete(filt, fid)
+                self._words.pop(fid, None)
+                self._fbytes.pop(fid, None)
+
+    def _plane_churn(self, adds: List[str], removes: List[str]):
+        """One plane tick with in-place table mutation: the native call
+        does bookkeeping + keys + slot clear/place in parallel shards;
+        apply_planned keeps shapes/entries/delta consistent.  Returns
+        the ChurnApply result; callers own epoch/on_churn."""
+        res = self._plane.apply(
+            adds, removes, tables=self.tables, reg=self._reg, place=True
+        )
+        self._plane_deep(res, adds, removes)
+        if len(res.new_fid) or len(res.dead_fid):
+            nk = ~res.new_deep
+            dk = ~res.dead_deep
+            self.tables.apply_planned(
+                res.new_fid[nk], res.new_ha[nk], res.new_hb[nk],
+                res.new_plen[nk], res.new_mask[nk], res.new_hash[nk],
+                res.new_slot[nk],
+                res.dead_fid[dk], res.dead_plen[dk], res.dead_mask[dk],
+                res.dead_hash[dk], res.dead_slot[dk],
+            )
+        return res
+
     def add_filter(self, filt: str) -> int:
+        if self._plane is not None:
+            res = self._plane_churn([filt], [])
+            self.epoch += 1
+            if self.on_churn is not None:
+                self.on_churn([filt], [])
+            return int(res.fids[0])
         fid = self._fids.get(filt)
         if fid is not None:
             self._refs[fid] += 1
@@ -287,6 +387,30 @@ class TopicMatchEngine:
         their trie fallback needs)."""
         from ..ops import native
 
+        if self._plane is not None:
+            if not isinstance(filts, list):
+                filts = list(filts)
+            if len(filts) >= 512:
+                # bootstrap scale: plane bookkeeping (no placement) +
+                # ONE native table rebuild beats incremental placement
+                res = self._plane.apply(filts, [], reg=self._reg,
+                                        place=False)
+                self._plane_deep(res, filts, [])
+                keep = ~res.new_deep
+                nk = res.new_fid[keep]
+                if len(nk):
+                    self.tables.bulk_insert_keys(
+                        nk, res.new_ha[keep], res.new_hb[keep],
+                        res.new_plen[keep], res.new_mask[keep],
+                        res.new_hash[keep],
+                    )
+                out = res.fids.tolist()
+            else:
+                out = self._plane_churn(filts, []).fids.tolist()
+            self.epoch += 1
+            if self.on_churn is not None:
+                self.on_churn(list(filts), [])
+            return out
         if self._reg is None or len(filts) < 512:
             return self._add_filters_slow(filts)
         if not isinstance(filts, list):
@@ -425,6 +549,14 @@ class TopicMatchEngine:
 
     def remove_filter(self, filt: str) -> Optional[int]:
         """Drop one reference; returns the fid if it was fully removed."""
+        if self._plane is not None:
+            if self._plane.lookup(filt) is None:
+                return None  # unknown filter: no mutation, no hook
+            res = self._plane_churn([], [filt])
+            self.epoch += 1
+            if self.on_churn is not None:
+                self.on_churn([], [filt])
+            return int(res.dead_fid[0]) if len(res.dead_fid) else None
         fid = self._fids.get(filt)
         if fid is None:
             return None
@@ -460,10 +592,30 @@ class TopicMatchEngine:
         against 10M routes is ~500k ops/s (BASELINE config 5).  Here the
         adds' key computation and placement run in one native pass
         (matchhash.cc etpu_filter_keys + etpu_bulk_place_slots) and the
-        device mirror still receives a single delta scatter.  Returns
-        the fids assigned to `adds`.
+        device mirror still receives a single delta scatter.  With the
+        churn plane (native/churn.cc) the whole tick — bookkeeping,
+        keys, slot clears/placements — runs sharded on the worker pool
+        with the GIL released; the hook/WAL stream stays ONE serialized
+        call per tick either way.  Returns the fids assigned to `adds`.
         """
         import time
+
+        if self._plane is not None:
+            t0 = time.monotonic()
+            if not isinstance(adds, list):
+                adds = list(adds)
+            if not isinstance(removes, list):
+                removes = list(removes)
+            res = self._plane_churn(adds, removes)
+            self.epoch += 1
+            if self.on_churn is not None:
+                self.on_churn(list(adds), list(removes))
+            dt = time.monotonic() - t0
+            self._churn_lag = dt
+            self.hist_churn.observe(dt)
+            tp("engine.churn", adds=len(adds), removes=len(removes),
+               dt_ms=dt * 1e3, backlog_slots=len(self.tables.delta.slots))
+            return res.fids.tolist()
 
         t0 = time.monotonic()
         dead_fids: List[int] = []
@@ -620,12 +772,22 @@ class TopicMatchEngine:
 
     @property
     def n_filters(self) -> int:
+        if self._plane is not None:
+            return self._plane.count()
         return len(self._fids)
 
     # --------------------------------------------------------- checkpoint
 
     def ref_snapshot(self) -> Dict[str, int]:
         """filter -> refcount copy (checkpoint reconcile, tests)."""
+        if self._plane is not None:
+            buf, offs, _fids, rcs, _dp, _fr, _nx = self._plane.export()
+            data = buf.tobytes()
+            ol = offs.tolist()
+            return {
+                data[ol[i]:ol[i + 1]].decode("utf-8"): int(rc)
+                for i, rc in enumerate(rcs.tolist())
+            }
         refs = self._refs
         return {f: refs[fid] for f, fid in self._fids.items()}
 
@@ -635,35 +797,52 @@ class TopicMatchEngine:
         packed filter registry (strings, fids, refcounts, deep flags,
         free list).  Everything is copied/serialized at capture time so
         the writer thread never races live mutations."""
-        from ..checkpoint.store import pack_nul_list
+        from ..checkpoint.store import pack_nul_list, packed_to_nul
 
         arrays: Dict[str, np.ndarray] = {}
         t_arr, t_meta = self.tables.export_state()
         for k, v in t_arr.items():
             arrays["tab/" + k] = v
-        filts = list(self._fids)
-        fids = np.fromiter(
-            (self._fids[f] for f in filts), dtype=np.int64, count=len(filts)
-        )
-        refs = np.fromiter(
-            (self._refs[int(i)] for i in fids), dtype=np.int64,
-            count=len(filts),
-        )
-        deep = np.fromiter(
-            (int(i) in self._deep_fids for i in fids), dtype=bool,
-            count=len(filts),
-        )
-        arrays.update({
-            "reg/nul": pack_nul_list(filts), "reg/fid": fids,
-            "reg/ref": refs, "reg/deep": deep,
-            "reg/free": np.asarray(self._free_fids, dtype=np.int64),
-        })
+        if self._plane is not None:
+            # the plane is the registry of record: export is one native
+            # walk + a vectorized NUL re-pack, no Python dict iteration
+            buf, offs, pfids, prefs, pdeep, pfree, next_fid = (
+                self._plane.export()
+            )
+            n = len(pfids)
+            arrays.update({
+                "reg/nul": packed_to_nul(buf, offs, n),
+                "reg/fid": pfids.astype(np.int64),
+                "reg/ref": prefs,
+                "reg/deep": pdeep,
+                "reg/free": pfree.astype(np.int64),
+            })
+        else:
+            filts = list(self._fids)
+            n = len(filts)
+            fids = np.fromiter(
+                (self._fids[f] for f in filts), dtype=np.int64, count=n
+            )
+            refs = np.fromiter(
+                (self._refs[int(i)] for i in fids), dtype=np.int64,
+                count=n,
+            )
+            deep = np.fromiter(
+                (int(i) in self._deep_fids for i in fids), dtype=bool,
+                count=n,
+            )
+            arrays.update({
+                "reg/nul": pack_nul_list(filts), "reg/fid": fids,
+                "reg/ref": refs, "reg/deep": deep,
+                "reg/free": np.asarray(self._free_fids, dtype=np.int64),
+            })
+            next_fid = self._next_fid
         meta = {
             "kind": "engine",
             "tables": t_meta,
             "max_levels": self.space.max_levels,
-            "next_fid": self._next_fid,
-            "n_filters": len(filts),
+            "next_fid": next_fid,
+            "n_filters": n,
         }
         return arrays, meta
 
@@ -686,20 +865,57 @@ class TopicMatchEngine:
             meta["tables"],
         )
         n_filts = int(meta["n_filters"])
-        filts = unpack_nul_list(arrays["reg/nul"], n_filts)
-        fids = arrays["reg/fid"].tolist()
-        refs = arrays["reg/ref"].tolist()
         deep = arrays["reg/deep"]
         self.tables = tables
-        self._fids = dict(zip(filts, fids))
-        self._refs = dict(zip(fids, refs))
-        self._next_fid = int(meta["next_fid"])
-        self._free_fids = arrays["reg/free"].tolist()
         self._words = {}
         self._fbytes = {}
         self._deep = CpuTrieIndex()
         self._deep_fids = set()
         self._reg = _native.make_registry()  # fresh: drop stale entries
+        if self._plane is not None:
+            # fresh plane + one parallel ingest (keys recomputed per
+            # shard on the pool) — the dicts stay empty, the plane is
+            # the registry of record
+            self._plane = _native.make_churn_plane(
+                self.space, self._plane.n_shards()
+            )
+            buf, offs = nul_to_packed(arrays["reg/nul"], n_filts)
+            fid_arr = arrays["reg/fid"]
+            self._plane.ingest(buf, offs, fid_arr, arrays["reg/ref"],
+                               arrays["reg/free"], int(meta["next_fid"]))
+            self._fids = {}
+            self._refs = {}
+            self._next_fid = int(meta["next_fid"])
+            self._free_fids = []
+            if deep.any():
+                filts = unpack_nul_list(arrays["reg/nul"], n_filts)
+                fids_l = fid_arr.tolist()
+                for k in np.nonzero(deep)[0].tolist():
+                    filt, fid = filts[k], int(fids_l[k])
+                    ws = topiclib.words(filt)
+                    self._words[fid] = ws
+                    self._fbytes[fid] = filt.encode("utf-8")
+                    self._deep.insert(filt, fid)
+                    self._deep_fids.add(fid)
+                shallow = np.nonzero(~deep)[0].tolist()
+                self._reg.set_bulk(
+                    [fids_l[k] for k in shallow],
+                    [filts[k].encode("utf-8") for k in shallow],
+                )
+            elif n_filts:
+                self._reg.set_bulk_packed(fid_arr, buf, offs)
+            self._dev = None  # mirror must rebuild from the restored truth
+            self._dev_stale = True
+            self._probe = None
+            self.epoch += 1
+            return n_filts
+        filts = unpack_nul_list(arrays["reg/nul"], n_filts)
+        fids = arrays["reg/fid"].tolist()
+        refs = arrays["reg/ref"].tolist()
+        self._fids = dict(zip(filts, fids))
+        self._refs = dict(zip(fids, refs))
+        self._next_fid = int(meta["next_fid"])
+        self._free_fids = arrays["reg/free"].tolist()
         if deep.any():
             for k in np.nonzero(deep)[0].tolist():
                 filt, fid = filts[k], fids[k]
@@ -1044,6 +1260,8 @@ class TopicMatchEngine:
         self.hist_tick.observe(lat_s)
         fl = self.flight
         if fl is not None:
+            shed = self.churn_shed - self._churn_shed_rec
+            self._churn_shed_rec = self.churn_shed
             fl.record(
                 n_topics=pending.n_raw or len(pending.topics),
                 n_unique=len(pending.topics),
@@ -1054,6 +1272,7 @@ class TopicMatchEngine:
                 churn_slots=len(self.tables.delta.slots),
                 lat_s=lat_s, churn_lag_s=self._churn_lag,
                 pipe_occ=pending.pipe_occ, pipe_depth=pending.pipe_depth,
+                churn_shed=shed,
             )
         if _tps._active:  # gate: skip kwarg evaluation when tracing is off
             tp("engine.tick", path=PATHS[path], n=len(pending.topics),

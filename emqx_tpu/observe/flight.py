@@ -181,6 +181,7 @@ TICK_DTYPE = np.dtype([
     ("churn_lag_us", "f4"),  # duration of the most recent apply_churn
     ("pipe_depth", "u1"),    # engine.pipeline_depth at submit
     ("_pad", "u1"),
+    ("churn_shed", "u4"),    # churn ops shed upstream since the last tick
 ])
 
 
@@ -226,6 +227,7 @@ class FlightRecorder:
         ts: Optional[float] = None,
         pipe_occ: int = 0,
         pipe_depth: int = 0,
+        churn_shed: int = 0,
     ) -> bool:
         """Record one tick; returns True when the path flipped."""
         flip = self._last_path >= 0 and self._last_path != path
@@ -236,6 +238,7 @@ class FlightRecorder:
             rate_host or 0.0, rate_dev or 0.0,
             bytes_up, bytes_down, verify_fail, churn_slots,
             lat_s * 1e6, churn_lag_s * 1e6, min(pipe_depth, 255), 0,
+            churn_shed,
         )
         self.n += 1
         if flip:
@@ -273,6 +276,7 @@ class FlightRecorder:
             "bytes_down": int(row["bytes_down"]),
             "verify_fail": int(row["verify_fail"]),
             "churn_slots": int(row["churn_slots"]),
+            "churn_shed": int(row["churn_shed"]),
             "lat_ms": float(row["lat_us"]) / 1e3,
             "churn_lag_ms": float(row["churn_lag_us"]) / 1e3,
             "pipe_occ": int(row["pipe_occ"]),
@@ -329,6 +333,7 @@ def engine_summary(engine) -> Dict:
         "dev_serves": getattr(engine, "dev_serve_count", 0),
         "dev_timeouts": getattr(engine, "dev_timeout_count", 0),
         "verify_mismatch": getattr(engine, "collision_count", 0),
+        "churn_shed": getattr(engine, "churn_shed", 0),
         "path_flips": getattr(engine, "path_flips", 0),
         "probes": getattr(engine, "probe_count", 0),
         "rate_host": getattr(engine, "rate_host", None),

@@ -52,6 +52,7 @@ KNOWN_KINDS: Dict[str, str] = {
     "engine.probe": "device warm-keeping probe dispatched or harvested",
     "engine.stall": "device fetch exceeded its timeout budget",
     "engine.churn": "one apply_churn batch applied to host truth",
+    "engine.churn.shed": "churn ops shed: demand exceeded apply capacity",
     "engine.pipeline": "dispatch-window event (drain / window-full)",
     "engine.kcap": "adaptive compact-return cap shrank toward traffic",
     # table checkpoint & warm restart (checkpoint/ subsystem)

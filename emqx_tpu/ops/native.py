@@ -52,6 +52,7 @@ _SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SRCS = [
     os.path.join(_SRC_DIR, "matchhash.cc"),
     os.path.join(_SRC_DIR, "registry.cc"),
+    os.path.join(_SRC_DIR, "churn.cc"),
     os.path.join(_SRC_DIR, "bcrypt.cc"),
 ]
 _PYMOD_SRC = os.path.join(_SRC_DIR, "pymod.cc")
@@ -164,6 +165,51 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.etpu_verify_pairs_reg.restype = None
     lib.etpu_verify_pairs_reg.argtypes = [
         ctypes.c_void_p, _u8p, _i64p, _i32p, _i32p, ctypes.c_int32, _u8p,
+    ]
+    lib.etpu_pool_width.restype = ctypes.c_int32
+    lib.etpu_pool_width.argtypes = []
+    lib.etpu_churn_new.restype = ctypes.c_void_p
+    lib.etpu_churn_new.argtypes = [
+        ctypes.c_int32, ctypes.c_int32,
+        _u32p, _u32p, _u32p, _u32p, _u32p, _u32p, _u32p, _u32p,
+    ]
+    lib.etpu_churn_free.restype = None
+    lib.etpu_churn_free.argtypes = [ctypes.c_void_p]
+    lib.etpu_churn_count.restype = ctypes.c_int64
+    lib.etpu_churn_count.argtypes = [ctypes.c_void_p]
+    lib.etpu_churn_next_fid.restype = ctypes.c_int32
+    lib.etpu_churn_next_fid.argtypes = [ctypes.c_void_p]
+    lib.etpu_churn_free_count.restype = ctypes.c_int64
+    lib.etpu_churn_free_count.argtypes = [ctypes.c_void_p]
+    lib.etpu_churn_shards.restype = ctypes.c_int32
+    lib.etpu_churn_shards.argtypes = [ctypes.c_void_p]
+    lib.etpu_churn_lookup.restype = ctypes.c_int32
+    lib.etpu_churn_lookup.argtypes = [ctypes.c_void_p, _u8p, ctypes.c_int64]
+    lib.etpu_churn_ref.restype = ctypes.c_int64
+    lib.etpu_churn_ref.argtypes = [ctypes.c_void_p, _u8p, ctypes.c_int64]
+    lib.etpu_churn_apply.restype = ctypes.c_int32
+    lib.etpu_churn_apply.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+        _u8p, _i64p, ctypes.c_int32,
+        _u8p, _i64p, ctypes.c_int32,
+        _u32p, _u32p, _i32p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        _i32p,
+        _i32p, _u32p, _u32p, _i32p, _u32p, _u8p, _i32p, _u8p, _i32p, _i32p,
+        _i32p, _u32p, _u32p, _i32p, _u32p, _u8p, _i32p, _u8p, _i32p, _i32p,
+    ]
+    lib.etpu_churn_export_sizes.restype = None
+    lib.etpu_churn_export_sizes.argtypes = [
+        ctypes.c_void_p, _i64p, _i64p, _i64p,
+    ]
+    lib.etpu_churn_export.restype = None
+    lib.etpu_churn_export.argtypes = [
+        ctypes.c_void_p, _u8p, _i64p, _i32p, _i64p, _u8p, _i32p,
+    ]
+    lib.etpu_churn_ingest.restype = None
+    lib.etpu_churn_ingest.argtypes = [
+        ctypes.c_void_p, _u8p, _i64p, _i32p, _i64p, ctypes.c_int32,
+        _i32p, ctypes.c_int32, ctypes.c_int32,
     ]
     lib.etpu_bcrypt_init.restype = None
     lib.etpu_bcrypt_init.argtypes = [_u32p]
@@ -484,6 +530,246 @@ def make_registry() -> Optional[FilterRegistry]:
     if get_lib() is None:
         return None
     return FilterRegistry()
+
+
+class ChurnApply:
+    """Outputs of one ChurnPlane.apply tick (numpy views, no copies).
+
+    ``fids``: the fid per add, input order.  ``new_*``: truly-new
+    filters in first-occurrence order — key lanes, shape fields, the
+    table slot the plane claimed (-1: unplaced or place=False or deep),
+    deep flag, and the index into the adds batch (for string recovery).
+    ``dead_*``: fully-removed filters in first-decrement order."""
+
+    __slots__ = (
+        "fids", "new_fid", "new_ha", "new_hb", "new_plen", "new_mask",
+        "new_hash", "new_slot", "new_deep", "new_aidx",
+        "dead_fid", "dead_ha", "dead_hb", "dead_plen", "dead_mask",
+        "dead_hash", "dead_slot", "dead_deep", "dead_ridx",
+    )
+
+
+class ChurnPlane:
+    """Handle on the C++ sharded churn-bookkeeping plane (churn.cc).
+
+    Owns the filter -> (fid, refcount, table key) truth, partitioned by
+    matchhash(filter) % n_shards and mutated by the native worker pool
+    with the GIL released.  One `apply` call per churn tick replaces the
+    per-filter Python dict work; the outputs feed
+    `MatchTables.apply_planned` (shape/entry/delta bookkeeping) and the
+    deep-filter trie.  Freed via weakref.finalize."""
+
+    __slots__ = ("ptr", "max_levels", "_finalizer", "__weakref__")
+
+    def __init__(self, space, n_shards: int = 16):
+        import weakref
+
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native lib unavailable")
+        c = np.ascontiguousarray
+        hra = c(space.HR[0]); hrb = c(space.HR[1])
+        self.max_levels = space.max_levels
+        self.ptr = lib.etpu_churn_new(
+            n_shards, space.max_levels,
+            c(space.C[0]).ctypes.data_as(_u32p),
+            c(space.C[1]).ctypes.data_as(_u32p),
+            c(space.R[0]).ctypes.data_as(_u32p),
+            c(space.R[1]).ctypes.data_as(_u32p),
+            c(space.PLUS).ctypes.data_as(_u32p),
+            c(space.HM).ctypes.data_as(_u32p),
+            hra.ctypes.data_as(_u32p), hrb.ctypes.data_as(_u32p),
+        )
+        self._finalizer = weakref.finalize(self, lib.etpu_churn_free, self.ptr)
+
+    # ------------------------------------------------------------ queries
+
+    def count(self) -> int:
+        return int(get_lib().etpu_churn_count(self.ptr))
+
+    def lookup(self, filt: str) -> Optional[int]:
+        ext = get_ext()
+        if ext is not None:
+            return ext.churn_lookup(self.ptr, filt)
+        b = filt.encode("utf-8")
+        buf = (ctypes.c_uint8 * max(len(b), 1)).from_buffer_copy(b or b"\0")
+        fid = get_lib().etpu_churn_lookup(self.ptr, buf, len(b))
+        return None if fid < 0 else fid
+
+    def refcount(self, filt: str) -> int:
+        b = filt.encode("utf-8")
+        buf = (ctypes.c_uint8 * max(len(b), 1)).from_buffer_copy(b or b"\0")
+        return int(get_lib().etpu_churn_ref(self.ptr, buf, len(b)))
+
+    def next_fid(self) -> int:
+        return int(get_lib().etpu_churn_next_fid(self.ptr))
+
+    def free_count(self) -> int:
+        return int(get_lib().etpu_churn_free_count(self.ptr))
+
+    def n_shards(self) -> int:
+        return int(get_lib().etpu_churn_shards(self.ptr))
+
+    # -------------------------------------------------------------- apply
+
+    def apply(self, adds, removes, tables=None, reg=None,
+              place: bool = True) -> ChurnApply:
+        """One churn tick (removes then adds; see churn.cc).
+
+        With ``tables`` (a MatchTables) and ``place=True`` the plane
+        CAS-places new entries into the live table arrays and clears
+        dead slots; the caller still owns shape/entry/delta bookkeeping
+        (`MatchTables.apply_planned`).  ``reg`` maintains the native
+        string registry inline (set new / del dead, non-deep only)."""
+        lib = get_lib()
+        na, nr = len(adds), len(removes)
+        abuf, aoffs = _pack_strs(adds)
+        rbuf, roffs = _pack_strs(removes)
+        r = ChurnApply()
+        out_fid = np.empty(max(na, 1), dtype=np.int32)
+        new_fid = np.empty(max(na, 1), dtype=np.int32)
+        new_ha = np.empty(max(na, 1), dtype=np.uint32)
+        new_hb = np.empty(max(na, 1), dtype=np.uint32)
+        new_plen = np.empty(max(na, 1), dtype=np.int32)
+        new_mask = np.empty(max(na, 1), dtype=np.uint32)
+        new_hash = np.empty(max(na, 1), dtype=np.uint8)
+        new_slot = np.empty(max(na, 1), dtype=np.int32)
+        new_deep = np.empty(max(na, 1), dtype=np.uint8)
+        new_aidx = np.empty(max(na, 1), dtype=np.int32)
+        dead_fid = np.empty(max(nr, 1), dtype=np.int32)
+        dead_ha = np.empty(max(nr, 1), dtype=np.uint32)
+        dead_hb = np.empty(max(nr, 1), dtype=np.uint32)
+        dead_plen = np.empty(max(nr, 1), dtype=np.int32)
+        dead_mask = np.empty(max(nr, 1), dtype=np.uint32)
+        dead_hash = np.empty(max(nr, 1), dtype=np.uint8)
+        dead_slot = np.empty(max(nr, 1), dtype=np.int32)
+        dead_deep = np.empty(max(nr, 1), dtype=np.uint8)
+        dead_ridx = np.empty(max(nr, 1), dtype=np.int32)
+        n_new = ctypes.c_int32(0)
+        n_dead = ctypes.c_int32(0)
+        if tables is not None and place:
+            ka = tables.key_a.ctypes.data_as(_u32p)
+            kb = tables.key_b.ctypes.data_as(_u32p)
+            vv = tables.val.ctypes.data_as(_i32p)
+            log2cap = tables.log2cap
+            from .tables import PROBE as probe
+        else:
+            ka = kb = ctypes.cast(None, _u32p)
+            vv = ctypes.cast(None, _i32p)
+            log2cap, probe, place = 0, 0, False
+        d = lambda a, t: a.ctypes.data_as(t)
+        lib.etpu_churn_apply(
+            self.ptr, reg.ptr if reg is not None else None,
+            d(abuf, _u8p), d(aoffs, _i64p), na,
+            d(rbuf, _u8p), d(roffs, _i64p), nr,
+            ka, kb, vv, log2cap, probe, 1 if place else 0,
+            d(out_fid, _i32p),
+            d(new_fid, _i32p), d(new_ha, _u32p), d(new_hb, _u32p),
+            d(new_plen, _i32p), d(new_mask, _u32p), d(new_hash, _u8p),
+            d(new_slot, _i32p), d(new_deep, _u8p), d(new_aidx, _i32p),
+            ctypes.byref(n_new),
+            d(dead_fid, _i32p), d(dead_ha, _u32p), d(dead_hb, _u32p),
+            d(dead_plen, _i32p), d(dead_mask, _u32p), d(dead_hash, _u8p),
+            d(dead_slot, _i32p), d(dead_deep, _u8p), d(dead_ridx, _i32p),
+            ctypes.byref(n_dead),
+        )
+        k, m = n_new.value, n_dead.value
+        r.fids = out_fid[:na]
+        r.new_fid = new_fid[:k]
+        r.new_ha = new_ha[:k]
+        r.new_hb = new_hb[:k]
+        r.new_plen = new_plen[:k]
+        r.new_mask = new_mask[:k]
+        r.new_hash = new_hash[:k].astype(bool)
+        r.new_slot = new_slot[:k]
+        r.new_deep = new_deep[:k].astype(bool)
+        r.new_aidx = new_aidx[:k]
+        r.dead_fid = dead_fid[:m]
+        r.dead_ha = dead_ha[:m]
+        r.dead_hb = dead_hb[:m]
+        r.dead_plen = dead_plen[:m]
+        r.dead_mask = dead_mask[:m]
+        r.dead_hash = dead_hash[:m].astype(bool)
+        r.dead_slot = dead_slot[:m]
+        r.dead_deep = dead_deep[:m].astype(bool)
+        r.dead_ridx = dead_ridx[:m]
+        return r
+
+    # ---------------------------------------------------- export / ingest
+
+    def export(self):
+        """(buf, offs, fids, rcs, deep, free_fids, next_fid): the full
+        bookkeeping truth as arrays (checkpoint capture, ref_snapshot)."""
+        lib = get_lib()
+        ne = ctypes.c_int64(0)
+        sb = ctypes.c_int64(0)
+        nf = ctypes.c_int64(0)
+        lib.etpu_churn_export_sizes(
+            self.ptr, ctypes.byref(ne), ctypes.byref(sb), ctypes.byref(nf)
+        )
+        n, bytes_, n_free = ne.value, sb.value, nf.value
+        buf = np.empty(max(bytes_, 1), dtype=np.uint8)
+        offs = np.zeros(n + 1, dtype=np.int64)
+        fids = np.empty(max(n, 1), dtype=np.int32)
+        rcs = np.empty(max(n, 1), dtype=np.int64)
+        deep = np.zeros(max(n, 1), dtype=np.uint8)
+        free = np.empty(max(n_free, 1), dtype=np.int32)
+        lib.etpu_churn_export(
+            self.ptr, buf.ctypes.data_as(_u8p), offs.ctypes.data_as(_i64p),
+            fids.ctypes.data_as(_i32p), rcs.ctypes.data_as(_i64p),
+            deep.ctypes.data_as(_u8p), free.ctypes.data_as(_i32p),
+        )
+        return (buf[:bytes_], offs, fids[:n], rcs[:n],
+                deep[:n].astype(bool), free[:n_free], self.next_fid())
+
+    def ingest(self, buf, offs, fids, rcs, free_fids, next_fid) -> None:
+        """Bulk-load (checkpoint restore): keys recomputed natively, in
+        parallel per shard; deep flags rederived from plen."""
+        lib = get_lib()
+        n = len(fids)
+        c = np.ascontiguousarray
+        buf = c(np.asarray(buf, dtype=np.uint8))
+        if not len(buf):
+            buf = np.zeros(1, dtype=np.uint8)
+        offs = c(np.asarray(offs, dtype=np.int64))
+        fids = c(np.asarray(fids, dtype=np.int32))
+        rcs = c(np.asarray(rcs, dtype=np.int64))
+        free = c(np.asarray(free_fids, dtype=np.int32))
+        if not len(free):
+            free = np.zeros(1, dtype=np.int32)
+        lib.etpu_churn_ingest(
+            self.ptr, buf.ctypes.data_as(_u8p), offs.ctypes.data_as(_i64p),
+            fids.ctypes.data_as(_i32p), rcs.ctypes.data_as(_i64p), n,
+            free.ctypes.data_as(_i32p), len(free_fids), next_fid,
+        )
+
+    def fid_map(self):
+        """filter -> fid dict (tests/introspection; O(n) materialize)."""
+        buf, offs, fids, _rcs, _deep, _free, _nx = self.export()
+        data = buf.tobytes()
+        ol = offs.tolist()
+        return {
+            data[ol[i]:ol[i + 1]].decode("utf-8"): int(f)
+            for i, f in enumerate(fids.tolist())
+        }
+
+
+def make_churn_plane(space, n_shards: int = 16) -> Optional[ChurnPlane]:
+    """A new native churn plane, or None when the lib is absent."""
+    if get_lib() is None:
+        return None
+    return ChurnPlane(space, n_shards)
+
+
+def pool_width() -> int:
+    """Worker-pool parallelism (workers + caller thread), 1 w/o the lib.
+
+    Honors ETPU_POOL_THREADS (pool.h): the churn worker-sweep bench pins
+    it per subprocess."""
+    lib = get_lib()
+    if lib is None:
+        return 1
+    return int(lib.etpu_pool_width())
 
 
 def match_host_verified(

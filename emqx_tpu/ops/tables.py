@@ -465,6 +465,92 @@ class MatchTables:
                 self.delta.desc_dirty = True
         self.n_entries -= n
 
+    def apply_planned(
+        self,
+        new_fids, new_ha, new_hb, new_plen, new_mask, new_hash, new_slots,
+        dead_fids, dead_plen, dead_mask, dead_hash, dead_slots,
+    ) -> None:
+        """Adopt one churn tick the native plane already applied to the
+        table ARRAYS (churn.cc etpu_churn_apply: dead slots cleared, new
+        entries CAS-placed), keeping the Python-side bookkeeping — shape
+        refcounts, per-fid entry arrays, n_entries, and the device-
+        mirror Delta — consistent with it.  Dead writes precede new
+        writes in the delta (the plane clears before it places, and
+        compressed()'s last-write-wins depends on that order).  Unplaced
+        news (slot -1: a probe window filled mid-tick) ride a grow +
+        native rebuild, exactly like churn_insert_keys' overflow path.
+
+        All inputs are numpy arrays covering NON-DEEP entries only (deep
+        filters never touch the table; the engine routes them to the
+        host trie)."""
+        n_dead = len(dead_fids)
+        n_new = len(new_fids)
+        if n_dead:
+            dl = np.asarray(dead_slots)
+            live = dl >= 0
+            slots = dl[live].tolist()
+            self.delta.slots.extend(slots)
+            self.delta.key_a.extend([0] * len(slots))
+            self.delta.key_b.extend([0] * len(slots))
+            self.delta.val.extend([-1] * len(slots))
+            combo = (
+                np.asarray(dead_plen, dtype=np.int64)
+                | (np.asarray(dead_mask, dtype=np.int64) << 7)
+                | (np.asarray(dead_hash, dtype=np.int64) << 43)
+            )
+            for key, cnt in zip(*np.unique(combo, return_counts=True)):
+                key = int(key)
+                shape = Shape(
+                    plen=key & 0x7F,
+                    plus_mask=(key >> 7) & 0xFFFFFFFFF,
+                    has_hash=bool(key >> 43),
+                )
+                idx, rc = self._shapes[shape]
+                if rc > int(cnt):
+                    self._shapes[shape] = (idx, rc - int(cnt))
+                else:
+                    del self._shapes[shape]
+                    self.valid[idx] = False
+                    self._desc_shape[idx] = None
+                    self._free_desc.append(idx)
+                    self.delta.desc_dirty = True
+            farr = np.asarray(dead_fids, dtype=np.int64)
+            keep = farr < self._ent_cap
+            self.ent_desc[farr[keep]] = -1
+            self.n_entries -= n_dead
+        if n_new:
+            self._register_batch(
+                new_fids, new_ha, new_hb, new_plen, new_mask, new_hash
+            )
+            self.n_entries += n_new
+            sl = np.asarray(new_slots)
+            placed = sl >= 0
+            self.delta.slots.extend(sl[placed].tolist())
+            self.delta.key_a.extend(np.asarray(new_ha)[placed].tolist())
+            self.delta.key_b.extend(np.asarray(new_hb)[placed].tolist())
+            self.delta.val.extend(np.asarray(new_fids)[placed].tolist())
+        else:
+            placed = None
+        grew = False
+        while self.n_entries * 2 > (1 << self.log2cap):
+            self.log2cap += 1
+            grew = True
+        unplaced = placed is not None and not placed.all()
+        if not grew and unplaced:
+            self.log2cap += 1  # a probe window filled: growth is the fix
+        if self.log2cap > MAX_LOG2CAP:
+            raise RuntimeError("match-table growth runaway")
+        if grew or unplaced:
+            pend = None
+            if unplaced:
+                miss = ~placed
+                pend = (
+                    np.asarray(new_ha)[miss].astype(np.uint32, copy=False),
+                    np.asarray(new_hb)[miss].astype(np.uint32, copy=False),
+                    np.asarray(new_fids, dtype=np.int32)[miss],
+                )
+            self._rebuild(pending=pend)
+
     def _rebuild(self, pending=None) -> None:
         """Re-place every entry into fresh arrays at the current capacity,
         growing until placement succeeds; native path when available.

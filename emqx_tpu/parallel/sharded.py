@@ -398,6 +398,8 @@ class ShardedMatchEngine:
         n_sub_shards: int = 1024,
         min_batch: int = 64,
         kcap: int = 128,
+        use_churn_plane: Optional[bool] = None,
+        churn_shards: int = 16,
     ):
         self.mesh = mesh or make_mesh()
         self.space = space or hashing.HashSpace()
@@ -438,8 +440,42 @@ class ShardedMatchEngine:
 
         self._reg = _native.make_registry()
 
+        # parallel churn plane (native/churn.cc, same contract as the
+        # single-chip engine): sharded filter -> (fid, refcount, key)
+        # truth mutated GIL-free on the worker pool.  The plane runs
+        # WITHOUT table placement here — new keys land per DEVICE shard
+        # through churn_insert_keys so deltas stay per-shard for the
+        # fused mesh dispatch.
+        self._plane = None
+        if use_churn_plane is None:
+            use_churn_plane = True
+        if use_churn_plane and self._reg is not None:
+            self._plane = _native.make_churn_plane(self.space, churn_shards)
+
+        # churn shed-load visibility (note_churn_shed, same contract as
+        # the single-chip engine)
+        self.churn_shed = 0
+        self._churn_shed_rec = 0
+
         self._stacked: Optional[DeviceTables] = None
         self._dest_dev: Optional[jax.Array] = None
+
+        # per-tick topic hash memo (ROADMAP item 3): Zipf production
+        # traffic repeats hot names across ticks, and prep re-pays the
+        # native split+hash for every repeat — memoize (terms, len,
+        # dollar) rows keyed by topic string, reset wholesale at
+        # `topic_memo_cap` entries.  Purely a cache of a pure function
+        # of (topic, space): never invalidated by churn.
+        self.topic_memo_cap = 1 << 16
+        self._memo: Dict[str, int] = {}
+        L = self.space.max_levels
+        self._memo_ta = np.empty((1024, L), dtype=np.uint32)
+        self._memo_tb = np.empty((1024, L), dtype=np.uint32)
+        self._memo_ln = np.empty(1024, dtype=np.int32)
+        self._memo_dl = np.empty(1024, dtype=np.uint8)
+        self._memo_n = 0  # filled rows in the memo arrays
+        self.memo_hits = 0
+        self.memo_misses = 0
 
         # ---- pipelined dispatch window (engine.pipeline_depth) --------
         # Up to `pipeline_depth` submitted-but-unresolved ticks share the
@@ -477,9 +513,111 @@ class ShardedMatchEngine:
     # ----------------------------------------------------------- mutation
 
     def fid_of(self, filt: str) -> Optional[int]:
+        if self._plane is not None:
+            return self._plane.lookup(filt)
         return self._fids.get(filt)
 
+    def fid_map(self) -> Dict[str, int]:
+        """filter -> fid copy (tests/introspection; O(n))."""
+        if self._plane is not None:
+            return self._plane.fid_map()
+        return dict(self._fids)
+
+    def free_fid_count(self) -> int:
+        if self._plane is not None:
+            return self._plane.free_count()
+        return len(self._free_fids)
+
+    def refcount_of(self, filt: str) -> int:
+        if self._plane is not None:
+            return self._plane.refcount(filt)
+        fid = self._fids.get(filt)
+        return 0 if fid is None else self._refs[fid]
+
+    def note_churn_shed(self, n: int) -> None:
+        """Count churn ops shed upstream (demand exceeded apply
+        capacity) — see TopicMatchEngine.note_churn_shed."""
+        if n <= 0:
+            return
+        self.churn_shed += n
+        tp("engine.churn.shed", shed=n, total=self.churn_shed)
+
+    # ---- churn-plane fast paths (native/churn.cc; see __init__) -------
+
+    def _plane_deep(self, res, adds, removes) -> None:
+        """Deep entries -> the host-trie fallback (the plane owns their
+        fid/refcount; _words/_fbytes own their verify strings)."""
+        if res.new_deep.any():
+            for k in np.nonzero(res.new_deep)[0].tolist():
+                filt = adds[int(res.new_aidx[k])]
+                fid = int(res.new_fid[k])
+                self._words[fid] = topiclib.words(filt)
+                self._fbytes[fid] = filt.encode("utf-8")
+                self._deep.insert(filt, fid)
+                self._deep_fids.add(fid)
+        if res.dead_deep.any():
+            for k in np.nonzero(res.dead_deep)[0].tolist():
+                filt = removes[int(res.dead_ridx[k])]
+                fid = int(res.dead_fid[k])
+                self._deep_fids.discard(fid)
+                self._deep.delete(filt, fid)
+                self._words.pop(fid, None)
+                self._fbytes.pop(fid, None)
+
+    def _plane_apply(self, adds, removes, bulk: bool = False):
+        """One plane tick routed to the DEVICE shards: the plane does
+        bookkeeping + keys GIL-free (no placement — tables are
+        per-shard here); deads tombstone via each shard's vectorized
+        delete_batch, news land via churn_insert_keys (or
+        bulk_insert_keys at bootstrap scale) grouped by fid % D.
+        Callers own the on_churn hook calls."""
+        res = self._plane.apply(adds, removes, reg=self._reg, place=False)
+        self._plane_deep(res, adds, removes)
+        if len(res.dead_fid):
+            dk = ~res.dead_deep
+            dead = res.dead_fid[dk]
+            if len(dead):
+                dsh = dead % self.D
+                for d in range(self.D):
+                    part = dead[dsh == d]
+                    if len(part):
+                        self.shards[d].delete_batch(part)
+        if len(res.new_fid):
+            nk = ~res.new_deep
+            nf = res.new_fid[nk]
+            if len(nf):
+                ha, hb = res.new_ha[nk], res.new_hb[nk]
+                plen, mask = res.new_plen[nk], res.new_mask[nk]
+                hsh = res.new_hash[nk]
+                nsh = nf % self.D
+                for d in range(self.D):
+                    m = nsh == d
+                    if m.any():
+                        ins = (self.shards[d].bulk_insert_keys if bulk
+                               else self.shards[d].churn_insert_keys)
+                        ins(nf[m], ha[m], hb[m], plen[m], mask[m], hsh[m])
+            # dest rows for every new fid (incl. deep): fid % n_sub
+            top = int(res.new_fid.max())
+            if top >= self._dest_cap:
+                while self._dest_cap <= top:
+                    self._dest_cap *= 2
+                nd = np.zeros(self._dest_cap, dtype=np.int32)
+                nd[: len(self._dest)] = self._dest
+                self._dest = nd
+            self._dest[res.new_fid] = res.new_fid % self.n_sub
+            self._dest_dirty = True
+        return res
+
     def add_filter(self, filt: str, sub_shard: Optional[int] = None) -> int:
+        if self._plane is not None:
+            res = self._plane_apply([filt], [])
+            fid = int(res.fids[0])
+            if sub_shard is not None:
+                self._dest[fid] = sub_shard
+                self._dest_dirty = True
+            if self.on_churn is not None:
+                self.on_churn([filt], [])
+            return fid
         fid = self._fids.get(filt)
         if fid is not None:
             self._refs[fid] += 1
@@ -534,6 +672,13 @@ class ShardedMatchEngine:
         BEFORE any registry state is written, so a failed insert leaves
         the engine exactly as it was (only the fid allocator is rolled
         back)."""
+        if self._plane is not None:
+            if not isinstance(filts, list):
+                filts = list(filts)
+            res = self._plane_apply(filts, [], bulk=not churn)
+            if self.on_churn is not None:
+                self.on_churn(list(filts), [])
+            return res.fids.tolist()
         # plan: dedup against the live registry AND within the batch,
         # allocating fids but committing nothing yet
         fids: List[int] = []
@@ -633,19 +778,50 @@ class ShardedMatchEngine:
         remove_filter measured ~15k ops/s, an order short of config 5's
         churn rate.  Shard deltas accumulate and ride the next fused
         dispatch (`sharded_step_compact`), same as the single-chip
-        engine's fused churn+match contract."""
+        engine's fused churn+match contract.  With the churn plane the
+        whole tick's bookkeeping runs sharded and GIL-free; the hook
+        stream keeps the same two-record framing as the fallback."""
         import time
+
+        if self._plane is not None:
+            t0 = time.monotonic()
+            if not isinstance(adds, list):
+                adds = list(adds)
+            if not isinstance(removes, list):
+                removes = list(removes)
+            res = self._plane_apply(adds, removes)
+            if self.on_churn is not None and removes:
+                self.on_churn([], list(removes))
+            if self.on_churn is not None:
+                self.on_churn(list(adds), [])
+            dt = time.monotonic() - t0
+            self._churn_lag = dt
+            self.hist_churn.observe(dt)
+            tp("engine.churn", adds=len(adds), removes=len(removes),
+               dt_ms=dt * 1e3)
+            return res.fids.tolist()
 
         t0 = time.monotonic()
         dead_by_shard: List[List[int]] = [[] for _ in range(self.D)]
         refs = self._refs
         _fids = self._fids
-        for filt in removes:
+        # uniq first-occurrence walk with counted decrements — the same
+        # discipline as the single-chip engine (and the churn plane), so
+        # fid-reuse ORDER is identical across all three paths
+        uniq_rem = dict.fromkeys(removes)
+        rem_counts = None
+        if len(uniq_rem) != len(removes):
+            from collections import Counter
+
+            rem_counts = Counter(removes)
+        for filt in uniq_rem:
             fid = _fids.get(filt)
             if fid is None:
                 continue
-            refs[fid] -= 1
-            if refs[fid] > 0:
+            dec = rem_counts[filt] if rem_counts is not None else 1
+            rc = refs[fid]
+            if rc > dec:
+                refs[fid] = rc - dec
                 continue
             del refs[fid]
             del _fids[filt]
@@ -677,6 +853,13 @@ class ShardedMatchEngine:
         return out
 
     def remove_filter(self, filt: str) -> Optional[int]:
+        if self._plane is not None:
+            if self._plane.lookup(filt) is None:
+                return None  # unknown filter: no mutation, no hook
+            res = self._plane_apply([], [filt])
+            if self.on_churn is not None:
+                self.on_churn([], [filt])
+            return int(res.dead_fid[0]) if len(res.dead_fid) else None
         fid = self._fids.get(filt)
         if fid is None:
             return None
@@ -703,12 +886,22 @@ class ShardedMatchEngine:
 
     @property
     def n_filters(self) -> int:
+        if self._plane is not None:
+            return self._plane.count()
         return len(self._fids)
 
     # --------------------------------------------------------- checkpoint
 
     def ref_snapshot(self) -> Dict[str, int]:
         """filter -> refcount copy (checkpoint reconcile, tests)."""
+        if self._plane is not None:
+            buf, offs, _fids, rcs, _dp, _fr, _nx = self._plane.export()
+            data = buf.tobytes()
+            ol = offs.tolist()
+            return {
+                data[ol[i]:ol[i + 1]].decode("utf-8"): int(rc)
+                for i, rc in enumerate(rcs.tolist())
+            }
         refs = self._refs
         return {f: refs[fid] for f, fid in self._fids.items()}
 
@@ -716,7 +909,7 @@ class ShardedMatchEngine:
         """Host truth as (named arrays, meta): one per-shard table block
         each (`tab<d>/...`) plus the global registry + dest map — one
         snapshot file carries every shard, restored as a unit."""
-        from ..checkpoint.store import pack_nul_list
+        from ..checkpoint.store import pack_nul_list, packed_to_nul
 
         arrays: Dict[str, np.ndarray] = {}
         shard_metas = []
@@ -725,32 +918,48 @@ class ShardedMatchEngine:
             for k, v in t_arr.items():
                 arrays[f"tab{d}/{k}"] = v
             shard_metas.append(t_meta)
-        filts = list(self._fids)
-        fids = np.fromiter(
-            (self._fids[f] for f in filts), dtype=np.int64, count=len(filts)
-        )
-        refs = np.fromiter(
-            (self._refs[int(i)] for i in fids), dtype=np.int64,
-            count=len(filts),
-        )
-        deep = np.fromiter(
-            (int(i) in self._deep_fids for i in fids), dtype=bool,
-            count=len(filts),
-        )
-        arrays.update({
-            "reg/nul": pack_nul_list(filts), "reg/fid": fids,
-            "reg/ref": refs, "reg/deep": deep,
-            "reg/free": np.asarray(self._free_fids, dtype=np.int64),
-            "reg/dest": self._dest.copy(),
-        })
+        if self._plane is not None:
+            buf, offs, pfids, prefs, pdeep, pfree, next_fid = (
+                self._plane.export()
+            )
+            n = len(pfids)
+            arrays.update({
+                "reg/nul": packed_to_nul(buf, offs, n),
+                "reg/fid": pfids.astype(np.int64),
+                "reg/ref": prefs,
+                "reg/deep": pdeep,
+                "reg/free": pfree.astype(np.int64),
+                "reg/dest": self._dest.copy(),
+            })
+        else:
+            filts = list(self._fids)
+            n = len(filts)
+            fids = np.fromiter(
+                (self._fids[f] for f in filts), dtype=np.int64, count=n
+            )
+            refs = np.fromiter(
+                (self._refs[int(i)] for i in fids), dtype=np.int64,
+                count=n,
+            )
+            deep = np.fromiter(
+                (int(i) in self._deep_fids for i in fids), dtype=bool,
+                count=n,
+            )
+            arrays.update({
+                "reg/nul": pack_nul_list(filts), "reg/fid": fids,
+                "reg/ref": refs, "reg/deep": deep,
+                "reg/free": np.asarray(self._free_fids, dtype=np.int64),
+                "reg/dest": self._dest.copy(),
+            })
+            next_fid = self._next_fid
         meta = {
             "kind": "sharded",
             "n_devices": self.D,
             "n_sub": self.n_sub,
             "shards": shard_metas,
             "max_levels": self.space.max_levels,
-            "next_fid": self._next_fid,
-            "n_filters": len(filts),
+            "next_fid": next_fid,
+            "n_filters": n,
         }
         return arrays, meta
 
@@ -779,15 +988,8 @@ class ShardedMatchEngine:
             for d in range(self.D)
         ]
         n_filts = int(meta["n_filters"])
-        filts = unpack_nul_list(arrays["reg/nul"], n_filts)
-        fids = arrays["reg/fid"].tolist()
-        refs = arrays["reg/ref"].tolist()
         deep = arrays["reg/deep"]
         self.shards = shards
-        self._fids = dict(zip(filts, fids))
-        self._refs = dict(zip(fids, refs))
-        self._next_fid = int(meta["next_fid"])
-        self._free_fids = arrays["reg/free"].tolist()
         self.n_sub = int(meta["n_sub"])
         self._dest = arrays["reg/dest"]
         self._dest_cap = len(self._dest)
@@ -797,6 +999,46 @@ class ShardedMatchEngine:
         self._deep = CpuTrieIndex()
         self._deep_fids = set()
         self._reg = _native.make_registry()  # fresh: drop stale entries
+        if self._plane is not None:
+            self._plane = _native.make_churn_plane(
+                self.space, self._plane.n_shards()
+            )
+            buf, offs = nul_to_packed(arrays["reg/nul"], n_filts)
+            fid_arr = arrays["reg/fid"]
+            self._plane.ingest(buf, offs, fid_arr, arrays["reg/ref"],
+                               arrays["reg/free"], int(meta["next_fid"]))
+            self._fids = {}
+            self._refs = {}
+            self._next_fid = int(meta["next_fid"])
+            self._free_fids = []
+            if deep.any():
+                filts = unpack_nul_list(arrays["reg/nul"], n_filts)
+                fids_l = fid_arr.tolist()
+                for k in np.nonzero(deep)[0].tolist():
+                    filt, fid = filts[k], int(fids_l[k])
+                    self._words[fid] = topiclib.words(filt)
+                    self._fbytes[fid] = filt.encode("utf-8")
+                    self._deep.insert(filt, fid)
+                    self._deep_fids.add(fid)
+                shallow = np.nonzero(~deep)[0].tolist()
+                self._reg.set_bulk(
+                    [fids_l[k] for k in shallow],
+                    [filts[k].encode("utf-8") for k in shallow],
+                )
+            elif n_filts:
+                self._reg.set_bulk_packed(fid_arr, buf, offs)
+            self._stacked = None  # restack from restored shards
+            self._dest_dev = None
+            self._inflight = []
+            self._staging = {}
+            return n_filts
+        filts = unpack_nul_list(arrays["reg/nul"], n_filts)
+        fids = arrays["reg/fid"].tolist()
+        refs = arrays["reg/ref"].tolist()
+        self._fids = dict(zip(filts, fids))
+        self._refs = dict(zip(fids, refs))
+        self._next_fid = int(meta["next_fid"])
+        self._free_fids = arrays["reg/free"].tolist()
         if not deep.any() and self._reg is not None:
             if n_filts:
                 buf, offs = nul_to_packed(arrays["reg/nul"], n_filts)
@@ -951,14 +1193,67 @@ class ShardedMatchEngine:
         if len(pool) <= self.pipeline_depth + 1:
             pool.append(buf)
 
+    def _memo_grow(self, need: int) -> None:
+        cap = len(self._memo_ln)
+        while cap < need:
+            cap *= 2
+        L = self.space.max_levels
+        for name, shape in (("_memo_ta", (cap, L)), ("_memo_tb", (cap, L)),
+                            ("_memo_ln", (cap,)), ("_memo_dl", (cap,))):
+            old = getattr(self, name)
+            new = np.empty(shape, dtype=old.dtype)
+            new[: len(old)] = old
+            setattr(self, name, new)
+
+    def _hash_topics_memo(self, topics: List[str]):
+        """Batch split+hash through the cross-tick topic memo: repeated
+        topic strings (Zipf traffic, bench batches, retried publishes)
+        fetch their (terms, len, dollar) row from the keyed cache
+        instead of re-paying the native split+hash — the same dedup win
+        submit-time dedup proved on the wire floor, applied to prep.
+        Returns (ta, tb, ln, dl) gathered rows."""
+        memo = self._memo
+        if len(memo) + len(topics) > self.topic_memo_cap:
+            memo.clear()  # wholesale reset: the memo is a pure cache
+            self._memo_n = 0
+        rows = [memo.get(t, -1) for t in topics]
+        miss = [i for i, r in enumerate(rows) if r < 0]
+        if miss:
+            uniq = dict.fromkeys(topics[i] for i in miss)
+            miss_list = list(uniq)
+            mta, mtb, mln, mdl = hashing.hash_topics(self.space, miss_list)
+            base = getattr(self, "_memo_n", 0)
+            need = base + len(miss_list)
+            if need > len(self._memo_ln):
+                self._memo_grow(need)
+            self._memo_ta[base:need] = mta
+            self._memo_tb[base:need] = mtb
+            self._memo_ln[base:need] = mln
+            self._memo_dl[base:need] = mdl
+            for j, t in enumerate(miss_list):
+                memo[t] = base + j
+            self._memo_n = need
+            for i in miss:
+                rows[i] = memo[topics[i]]
+            self.memo_misses += len(miss_list)
+            # hits = rows served from cached lanes (cross-tick repeats
+            # AND in-batch duplicates past each name's first occurrence)
+            self.memo_hits += len(topics) - len(miss_list)
+        else:
+            self.memo_hits += len(topics)
+        ridx = np.asarray(rows, dtype=np.int64)
+        return (self._memo_ta[ridx], self._memo_tb[ridx],
+                self._memo_ln[ridx], self._memo_dl[ridx])
+
     def _prep_packed(self, topics: Sequence[str]):
         """Hash + bucket-pad + pack a publish batch into ONE replicated
         [B, 2L+2] u32 upload (the single-chip wire format,
         `ops.match.pack_topic_batch_np` layout): one `device_put` per
         tick instead of four, assembled into a reusable per-bucket
-        staging buffer.  Returns (pbatch, n, B, L, buf, key)."""
+        staging buffer.  Topic hashing rides the cross-tick memo
+        (`_hash_topics_memo`).  Returns (pbatch, n, B, L, buf, key)."""
         n = len(topics)
-        ta, tb, ln, dl = hashing.hash_topics(self.space, list(topics))
+        ta, tb, ln, dl = self._hash_topics_memo(list(topics))
         B = max(self.min_batch, next_pow2(max(n, 1)))
         L = live_levels(self.space.max_levels, ln)
         key = (B, L)
@@ -1280,6 +1575,8 @@ class ShardedMatchEngine:
         self.hist_tick.observe(lat)
         fl = self.flight
         if fl is not None:
+            shed = self.churn_shed - self._churn_shed_rec
+            self._churn_shed_rec = self.churn_shed
             fl.record(
                 n_topics=len(pending.topics), n_unique=len(pending.topics),
                 path=PATH_DEVICE, reason=R_FORCED,
@@ -1289,6 +1586,7 @@ class ShardedMatchEngine:
                 churn_slots=pending.churn_slots,
                 lat_s=lat, churn_lag_s=self._churn_lag,
                 pipe_occ=pending.pipe_occ, pipe_depth=pending.pipe_depth,
+                churn_shed=shed,
             )
         if _tps._active:  # gate: skip kwarg evaluation when tracing is off
             tp("engine.tick", path="device", n=len(pending.topics),

@@ -1,0 +1,608 @@
+// Parallel churn plane: sharded, GIL-free route bookkeeping.
+//
+// The reference partitions route-table writes across workers
+// (`emqx_router`/mria shards, PAPER.md §1); the analog here is a
+// C++-owned filter -> (fid, refcount, key) registry partitioned by
+// matchhash(filter) % n_shards, mutated by the persistent worker pool
+// (pool.h) with the GIL released (ctypes drops it around every call).
+// One `etpu_churn_apply` call replaces the per-filter Python dict work
+// of `apply_churn` — the measured single-core ceiling at config 5's
+// 500k subscribe/unsubscribe ops/s (BENCH_TABLE.md north-star notes):
+//
+//   partition (parallel): one fnv1a64 pass over the packed batch; the
+//            hash doubles as the shard id AND the map key, so no string
+//            is ever hashed twice;
+//   phase A (parallel over shards): remove decrements + dead harvest
+//            and add lookups (refcount bumps / pending-new dedup) on
+//            open-addressed hash->entry maps — no allocation per op;
+//   phase B (serial, cheap): dead-slot clears (parallel sub-pass) and
+//            fid allocation in INPUT order from the LIFO free list —
+//            bit-for-bit the Python allocator, so fid assignment is
+//            deterministic and identical to the serial oracle;
+//   phase C (parallel over shards): per-new-filter key computation
+//            (match_core.h filter_key_one) + open-addressed table
+//            placement via CAS slot claims;
+//   phase D (serial): registry string set/del for the fused host match.
+//
+// Table writes follow the existing benign-dirty-read model (registry.cc
+// header): claims CAS `val` from -1, clears zero keys BEFORE releasing
+// `val`, and every reader exact-verifies hits against the registry
+// string — a torn slot can only cost a miss or a counted collision,
+// never a false delivery.
+//
+// The caller (ops/tables.py apply_planned) turns the outputs into shape
+// refcounts, entry bookkeeping, and the device-mirror Delta, so the
+// merged delta rides the existing fused delta+match device dispatch
+// unchanged.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "match_core.h"
+#include "pool.h"
+
+namespace {
+
+using etpu::FilterKey;
+
+struct PlaneEnt {
+  std::string str;
+  uint64_t hash64 = 0;  // fnv1a64(str): shard id + map key, computed once
+  uint32_t ha = 0, hb = 0, plus_mask = 0;
+  int32_t fid = -1, rc = 0, plen = 0;
+  uint8_t has_hash = 0, deep = 0, live = 0;
+  uint32_t batch_gen = 0;  // tag: first decrement seen this apply
+  int32_t first_ridx = 0;  // remove index of that first decrement
+};
+
+// Open-addressed hash -> entry-index map (linear probing, tombstones).
+// Python dicts cache each str's hash; this map gets the same economy by
+// keying on the precomputed fnv1a64 and only comparing bytes on a
+// 64-bit hash hit.
+struct EntMap {
+  std::vector<int32_t> slots;  // ent index, -1 empty, -2 tombstone
+  uint32_t mask = 0;
+  int32_t live = 0, tomb = 0;
+
+  void reserve_one(const std::vector<PlaneEnt>& ents) {
+    if (slots.empty()) {
+      slots.assign(16, -1);
+      mask = 15;
+      return;
+    }
+    if ((live + tomb + 1) * 4 <= (int32_t)slots.size() * 3) return;
+    // rebuild (dropping tombstones) at a capacity keeping load <= 1/2;
+    // a tombstone-heavy map may rebuild at the same capacity
+    size_t cap = slots.size();
+    while ((size_t)(live + 1) * 2 >= cap) cap *= 2;
+    std::vector<int32_t> old;
+    old.swap(slots);
+    slots.assign(cap, -1);
+    mask = (uint32_t)cap - 1;
+    tomb = 0;
+    for (int32_t ei : old) {
+      if (ei < 0) continue;
+      uint32_t i = (uint32_t)ents[ei].hash64 & mask;
+      while (slots[i] != -1) i = (i + 1) & mask;
+      slots[i] = ei;
+    }
+  }
+
+  // slot index holding the entry, or -1
+  int32_t find(const std::vector<PlaneEnt>& ents, uint64_t h,
+               const uint8_t* s, int64_t n) const {
+    if (slots.empty()) return -1;
+    uint32_t i = (uint32_t)h & mask;
+    while (true) {
+      int32_t ei = slots[i];
+      if (ei == -1) return -1;
+      if (ei >= 0) {
+        const PlaneEnt& e = ents[ei];
+        if (e.hash64 == h && e.str.size() == (size_t)n &&
+            std::memcmp(e.str.data(), s, (size_t)n) == 0)
+          return (int32_t)i;
+      }
+      i = (i + 1) & mask;
+    }
+  }
+
+  void insert(uint64_t h, int32_t ei) {  // caller ran reserve_one
+    uint32_t i = (uint32_t)h & mask;
+    while (slots[i] >= 0) i = (i + 1) & mask;
+    if (slots[i] == -2) tomb--;
+    slots[i] = ei;
+    live++;
+  }
+
+  void erase_at(int32_t slot) {
+    slots[slot] = -2;
+    tomb++;
+    live--;
+  }
+};
+
+struct PlaneShard {
+  EntMap idx;
+  std::vector<PlaneEnt> ents;
+  std::vector<int32_t> free_ents;
+
+  // per-apply scratch (reused across calls)
+  std::vector<int32_t> my_adds, my_rems;  // batch indices in this shard
+  std::vector<int32_t> pend_first;        // first aidx per pending-new
+  std::vector<int32_t> pend_fid;          // fid assigned in phase B
+  std::vector<int32_t> pend_rc;           // occurrences in this batch
+  std::vector<int32_t> pend_pos;          // output row (aidx rank)
+  std::vector<std::pair<int32_t, int32_t>> pend_dups;  // (aidx, pend id)
+  std::vector<int32_t> dead_ents;         // ent slots killed this apply
+  std::vector<int32_t> pend_slots;        // open-addressed pend id table
+
+  int32_t alloc_ent() {
+    if (!free_ents.empty()) {
+      int32_t e = free_ents.back();
+      free_ents.pop_back();
+      return e;
+    }
+    ents.emplace_back();
+    return (int32_t)ents.size() - 1;
+  }
+};
+
+struct ChurnPlane {
+  int32_t nshards, max_levels;
+  std::vector<PlaneShard> shards;
+  std::vector<uint32_t> Ca, Cb, Ra, Rb, HRa, HRb;
+  uint32_t PLUS[2], HM[2];
+  std::vector<int32_t> free_fids;  // serial-phase only (LIFO, like Python)
+  int32_t next_fid = 0;
+  uint32_t gen = 0;
+  int64_t n_live = 0;
+  // scratch reused across applies: per-item hashes (the partition pass
+  // computes them once; every later lookup reuses them)
+  std::vector<uint64_t> a_hash, r_hash;
+
+  int32_t shard_of(uint64_t h) const {
+    return (int32_t)(h % (uint64_t)nshards);
+  }
+  FilterKey key_of(const uint8_t* s, int64_t n) const {
+    return etpu::filter_key_one(s, n, max_levels, Ca.data(), Cb.data(),
+                                Ra.data(), Rb.data(), PLUS, HM,
+                                HRa.data(), HRb.data());
+  }
+};
+
+constexpr uint32_t MIX1 = 0x85EBCA77u, MIX2 = 0x9E3779B1u;
+
+static inline uint32_t home_of(uint32_t ha, uint32_t hb, int32_t log2cap) {
+  return ((ha + hb * MIX1) * MIX2) >> (32 - log2cap);
+}
+
+// Clear a dying entry's table slot: zero the keys FIRST (probes then
+// skip the slot on key mismatch), release val last — a concurrent
+// placement can only claim the slot after the release, so the clearer
+// never stomps the claimer's key writes.
+static void clear_slot(uint32_t* key_a, uint32_t* key_b, int32_t* val,
+                       int32_t log2cap, int32_t probe,
+                       uint32_t ha, uint32_t hb, int32_t fid,
+                       int32_t* out_slot) {
+  uint32_t cap_mask = (1u << log2cap) - 1;
+  uint32_t home = home_of(ha, hb, log2cap);
+  for (int32_t off = 0; off < probe; off++) {
+    uint32_t slot = (home + (uint32_t)off) & cap_mask;
+    if (__atomic_load_n(&val[slot], __ATOMIC_RELAXED) == fid &&
+        key_a[slot] == ha && key_b[slot] == hb) {
+      key_a[slot] = 0;
+      key_b[slot] = 0;
+      __atomic_store_n(&val[slot], -1, __ATOMIC_RELEASE);
+      *out_slot = (int32_t)slot;
+      return;
+    }
+  }
+  *out_slot = -1;  // not in the table (deep, or raced a rebuild)
+}
+
+// CAS-claim placement (etpu_bulk_place semantics, thread-safe): claim
+// `val` -1 -> fid, then write the keys.  Readers that see the claimed
+// slot before the keys land reject on key mismatch (or exact-verify).
+static int32_t place_slot_cas(uint32_t* key_a, uint32_t* key_b,
+                              int32_t* val, int32_t log2cap, int32_t probe,
+                              uint32_t ha, uint32_t hb, int32_t fid) {
+  uint32_t cap_mask = (1u << log2cap) - 1;
+  uint32_t home = home_of(ha, hb, log2cap);
+  for (int32_t off = 0; off < probe; off++) {
+    uint32_t slot = (home + (uint32_t)off) & cap_mask;
+    int32_t expected = -1;
+    if (__atomic_load_n(&val[slot], __ATOMIC_RELAXED) != -1) continue;
+    if (__atomic_compare_exchange_n(&val[slot], &expected, fid, false,
+                                    __ATOMIC_ACQ_REL, __ATOMIC_RELAXED)) {
+      key_a[slot] = ha;
+      key_b[slot] = hb;
+      return (int32_t)slot;
+    }
+  }
+  return -1;  // window full: caller grows + rebuilds with the pending tail
+}
+
+}  // namespace
+
+extern "C" {
+
+void* etpu_churn_new(int32_t n_shards, int32_t max_levels,
+                     const uint32_t* Ca, const uint32_t* Cb,
+                     const uint32_t* Ra, const uint32_t* Rb,
+                     const uint32_t* PLUS, const uint32_t* HM,
+                     const uint32_t* HRa, const uint32_t* HRb) {
+  ChurnPlane* p = new ChurnPlane();
+  p->nshards = n_shards > 0 ? n_shards : 1;
+  p->max_levels = max_levels;
+  p->shards.resize(p->nshards);
+  p->Ca.assign(Ca, Ca + max_levels);
+  p->Cb.assign(Cb, Cb + max_levels);
+  p->Ra.assign(Ra, Ra + max_levels);
+  p->Rb.assign(Rb, Rb + max_levels);
+  p->HRa.assign(HRa, HRa + max_levels + 1);
+  p->HRb.assign(HRb, HRb + max_levels + 1);
+  p->PLUS[0] = PLUS[0]; p->PLUS[1] = PLUS[1];
+  p->HM[0] = HM[0]; p->HM[1] = HM[1];
+  return p;
+}
+
+void etpu_churn_free(void* h) { delete (ChurnPlane*)h; }
+
+// Effective parallel_for width (workers + caller): the churn bench
+// reports it so capacity rows carry their worker count.
+int32_t etpu_pool_width() { return EtpuPool::inst().width(); }
+
+int64_t etpu_churn_count(void* h) { return ((ChurnPlane*)h)->n_live; }
+
+int32_t etpu_churn_next_fid(void* h) { return ((ChurnPlane*)h)->next_fid; }
+
+int64_t etpu_churn_free_count(void* h) {
+  return (int64_t)((ChurnPlane*)h)->free_fids.size();
+}
+
+int32_t etpu_churn_shards(void* h) { return ((ChurnPlane*)h)->nshards; }
+
+int32_t etpu_churn_lookup(void* h, const uint8_t* s, int64_t n) {
+  ChurnPlane* p = (ChurnPlane*)h;
+  uint64_t hh = etpu::fnv1a64(s, (uint64_t)n);
+  PlaneShard& sh = p->shards[p->shard_of(hh)];
+  int32_t si = sh.idx.find(sh.ents, hh, s, n);
+  return si < 0 ? -1 : sh.ents[sh.idx.slots[si]].fid;
+}
+
+int64_t etpu_churn_ref(void* h, const uint8_t* s, int64_t n) {
+  ChurnPlane* p = (ChurnPlane*)h;
+  uint64_t hh = etpu::fnv1a64(s, (uint64_t)n);
+  PlaneShard& sh = p->shards[p->shard_of(hh)];
+  int32_t si = sh.idx.find(sh.ents, hh, s, n);
+  return si < 0 ? 0 : (int64_t)sh.ents[sh.idx.slots[si]].rc;
+}
+
+// One churn tick: batched removes then adds (the apply_churn contract).
+// Caller-allocated outputs: out_fid [n_adds]; new_* sized n_adds;
+// dead_* sized n_removes.  place=0 skips table writes (the sharded
+// engine places per device shard; bootstrap bulk-rebuilds instead).
+// Returns 0.
+int32_t etpu_churn_apply(
+    void* h, void* reg_h,
+    const uint8_t* abuf, const int64_t* aoffs, int32_t n_adds,
+    const uint8_t* rbuf, const int64_t* roffs, int32_t n_removes,
+    uint32_t* key_a, uint32_t* key_b, int32_t* val,
+    int32_t log2cap, int32_t probe, int32_t place,
+    int32_t* out_fid,
+    int32_t* new_fid, uint32_t* new_ha, uint32_t* new_hb,
+    int32_t* new_plen, uint32_t* new_mask, uint8_t* new_hash,
+    int32_t* new_slot, uint8_t* new_deep, int32_t* new_aidx,
+    int32_t* n_new_out,
+    int32_t* dead_fid, uint32_t* dead_ha, uint32_t* dead_hb,
+    int32_t* dead_plen, uint32_t* dead_mask, uint8_t* dead_hash,
+    int32_t* dead_slot, uint8_t* dead_deep, int32_t* dead_ridx,
+    int32_t* n_dead_out) {
+  ChurnPlane* p = (ChurnPlane*)h;
+  p->gen++;
+  const uint32_t gen = p->gen;
+  const int32_t NS = p->nshards;
+  const bool do_place = place && key_a != nullptr;
+
+  // ---- partition: one parallel hash pass (the hash is kept — it is
+  // also the map key) + a serial scatter of indices
+  p->a_hash.resize(n_adds);
+  p->r_hash.resize(n_removes);
+  EtpuPool::inst().parallel_for(n_adds, 512, [&](int32_t i0, int32_t i1) {
+    for (int32_t i = i0; i < i1; i++)
+      p->a_hash[i] = etpu::fnv1a64(abuf + aoffs[i],
+                                   (uint64_t)(aoffs[i + 1] - aoffs[i]));
+  });
+  EtpuPool::inst().parallel_for(n_removes, 512, [&](int32_t i0, int32_t i1) {
+    for (int32_t i = i0; i < i1; i++)
+      p->r_hash[i] = etpu::fnv1a64(rbuf + roffs[i],
+                                   (uint64_t)(roffs[i + 1] - roffs[i]));
+  });
+  for (int32_t s = 0; s < NS; s++) {
+    PlaneShard& sh = p->shards[s];
+    sh.my_adds.clear(); sh.my_rems.clear();
+    sh.pend_first.clear(); sh.pend_fid.clear(); sh.pend_rc.clear();
+    sh.pend_pos.clear(); sh.pend_dups.clear(); sh.dead_ents.clear();
+  }
+  for (int32_t i = 0; i < n_removes; i++)
+    p->shards[p->shard_of(p->r_hash[i])].my_rems.push_back(i);
+  for (int32_t i = 0; i < n_adds; i++)
+    p->shards[p->shard_of(p->a_hash[i])].my_adds.push_back(i);
+
+  // ---- phase A (parallel): removes, then add lookups, per shard
+  EtpuPool::inst().parallel_for(NS, 1, [&](int32_t s0, int32_t s1) {
+    for (int32_t s = s0; s < s1; s++) {
+      PlaneShard& sh = p->shards[s];
+      for (int32_t ridx : sh.my_rems) {
+        uint64_t hh = p->r_hash[ridx];
+        int32_t si = sh.idx.find(sh.ents, hh, rbuf + roffs[ridx],
+                                 roffs[ridx + 1] - roffs[ridx]);
+        if (si < 0) continue;  // unknown / already dead: no-op
+        PlaneEnt& e = sh.ents[sh.idx.slots[si]];
+        if (e.batch_gen != gen) {  // dead order = FIRST-decrement order,
+          e.batch_gen = gen;       // matching the serial dict.fromkeys walk
+          e.first_ridx = ridx;
+        }
+        if (--e.rc > 0) continue;
+        sh.dead_ents.push_back(sh.idx.slots[si]);
+        sh.idx.erase_at(si);
+      }
+      // pending-new dedup table: open-addressed pend ids over the
+      // SAME precomputed hashes (cleared by size, no rehash cost)
+      size_t pcap = 16;
+      while (pcap < sh.my_adds.size() * 2) pcap *= 2;
+      sh.pend_slots.assign(pcap, -1);
+      const uint32_t pmask = (uint32_t)pcap - 1;
+      for (int32_t aidx : sh.my_adds) {
+        uint64_t hh = p->a_hash[aidx];
+        const uint8_t* s8 = abuf + aoffs[aidx];
+        const int64_t sn = aoffs[aidx + 1] - aoffs[aidx];
+        int32_t si = sh.idx.find(sh.ents, hh, s8, sn);
+        if (si >= 0) {
+          PlaneEnt& e = sh.ents[sh.idx.slots[si]];
+          e.rc++;
+          out_fid[aidx] = e.fid;
+          continue;
+        }
+        uint32_t i = (uint32_t)hh & pmask;
+        int32_t pid = -1;
+        while (true) {
+          int32_t v = sh.pend_slots[i];
+          if (v == -1) break;
+          int32_t fa = sh.pend_first[v];
+          if (p->a_hash[fa] == hh &&
+              aoffs[fa + 1] - aoffs[fa] == sn &&
+              std::memcmp(abuf + aoffs[fa], s8, (size_t)sn) == 0) {
+            pid = v;
+            break;
+          }
+          i = (i + 1) & pmask;
+        }
+        if (pid >= 0) {
+          sh.pend_rc[pid]++;
+          sh.pend_dups.emplace_back(aidx, pid);
+          continue;
+        }
+        pid = (int32_t)sh.pend_first.size();
+        sh.pend_slots[i] = pid;
+        sh.pend_first.push_back(aidx);
+        sh.pend_rc.push_back(1);
+      }
+    }
+  });
+
+  // ---- phase B (serial): dead harvest in first-decrement order, then
+  // fid allocation for pending news in input order (LIFO free list —
+  // exactly the Python allocator, for deterministic fid parity)
+  std::vector<std::pair<int32_t, std::pair<int32_t, int32_t>>> deads;
+  for (int32_t s = 0; s < NS; s++)
+    for (int32_t ei : p->shards[s].dead_ents)
+      deads.push_back({p->shards[s].ents[ei].first_ridx, {s, ei}});
+  std::sort(deads.begin(), deads.end());
+  int32_t n_dead = 0;
+  std::vector<int32_t> reg_del;
+  for (auto& d : deads) {
+    PlaneShard& sh = p->shards[d.second.first];
+    PlaneEnt& e = sh.ents[d.second.second];
+    dead_fid[n_dead] = e.fid;
+    dead_ha[n_dead] = e.ha;
+    dead_hb[n_dead] = e.hb;
+    dead_plen[n_dead] = e.plen;
+    dead_mask[n_dead] = e.plus_mask;
+    dead_hash[n_dead] = e.has_hash;
+    dead_deep[n_dead] = e.deep;
+    dead_ridx[n_dead] = e.first_ridx;
+    dead_slot[n_dead] = -1;
+    if (!e.deep) reg_del.push_back(e.fid);
+    p->free_fids.push_back(e.fid);
+    e = PlaneEnt();  // reclaim the string
+    sh.free_ents.push_back(d.second.second);
+    n_dead++;
+  }
+  // parallel clear pass: dead fids own distinct slots, and placement
+  // (phase C) only runs after this barrier, so clears never race claims
+  if (do_place && n_dead) {
+    EtpuPool::inst().parallel_for(n_dead, 256, [&](int32_t i0, int32_t i1) {
+      for (int32_t i = i0; i < i1; i++)
+        if (!dead_deep[i])
+          clear_slot(key_a, key_b, val, log2cap, probe, dead_ha[i],
+                     dead_hb[i], dead_fid[i], &dead_slot[i]);
+    });
+  }
+  std::vector<std::pair<int32_t, std::pair<int32_t, int32_t>>> news;
+  for (int32_t s = 0; s < NS; s++) {
+    PlaneShard& sh = p->shards[s];
+    sh.pend_fid.resize(sh.pend_first.size());
+    sh.pend_pos.resize(sh.pend_first.size());
+    for (int32_t pid = 0; pid < (int32_t)sh.pend_first.size(); pid++)
+      news.push_back({sh.pend_first[pid], {s, pid}});
+  }
+  std::sort(news.begin(), news.end());
+  int32_t n_new = (int32_t)news.size();
+  for (int32_t k = 0; k < n_new; k++) {
+    PlaneShard& sh = p->shards[news[k].second.first];
+    int32_t pid = news[k].second.second;
+    int32_t fid;
+    if (!p->free_fids.empty()) {
+      fid = p->free_fids.back();
+      p->free_fids.pop_back();
+    } else {
+      fid = p->next_fid++;
+    }
+    sh.pend_fid[pid] = fid;
+    sh.pend_pos[pid] = k;  // output row: global input (aidx) order
+  }
+  p->n_live += n_new - n_dead;
+
+  // ---- phase C (parallel): key computation + map insert + placement
+  EtpuPool::inst().parallel_for(NS, 1, [&](int32_t s0, int32_t s1) {
+    for (int32_t s = s0; s < s1; s++) {
+      PlaneShard& sh = p->shards[s];
+      for (int32_t pid = 0; pid < (int32_t)sh.pend_first.size(); pid++) {
+        int32_t aidx = sh.pend_first[pid];
+        int32_t k = sh.pend_pos[pid];
+        int32_t fid = sh.pend_fid[pid];
+        const uint8_t* s8 = abuf + aoffs[aidx];
+        const int64_t sn = aoffs[aidx + 1] - aoffs[aidx];
+        FilterKey fk = p->key_of(s8, sn);
+        uint8_t deep = fk.plen > p->max_levels ? 1 : 0;
+        int32_t ei = sh.alloc_ent();
+        PlaneEnt& e = sh.ents[ei];
+        e.str.assign((const char*)s8, (size_t)sn);
+        e.hash64 = p->a_hash[aidx];
+        e.ha = fk.ha; e.hb = fk.hb; e.plus_mask = fk.plus_mask;
+        e.fid = fid; e.rc = sh.pend_rc[pid]; e.plen = fk.plen;
+        e.has_hash = fk.has_hash; e.deep = deep; e.live = 1;
+        e.batch_gen = 0;
+        sh.idx.reserve_one(sh.ents);
+        sh.idx.insert(e.hash64, ei);
+        new_fid[k] = fid;
+        new_ha[k] = fk.ha;
+        new_hb[k] = fk.hb;
+        new_plen[k] = fk.plen;
+        new_mask[k] = fk.plus_mask;
+        new_hash[k] = fk.has_hash;
+        new_deep[k] = deep;
+        new_aidx[k] = aidx;
+        new_slot[k] = (do_place && !deep)
+            ? place_slot_cas(key_a, key_b, val, log2cap, probe,
+                             fk.ha, fk.hb, fid)
+            : -1;
+        out_fid[aidx] = fid;
+      }
+      for (auto& du : sh.pend_dups)
+        out_fid[du.first] = sh.pend_fid[du.second];
+    }
+  });
+
+  // ---- phase D (serial): registry string maintenance (fused host
+  // match + device-hit verify read these under the registry lock)
+  if (reg_h != nullptr) {
+    if (!reg_del.empty())
+      etpu_reg_del_bulk(reg_h, reg_del.data(), (int32_t)reg_del.size());
+    std::vector<int32_t> reg_fids;
+    std::vector<uint8_t> blob;
+    std::vector<int64_t> offs(1, 0);
+    for (int32_t k = 0; k < n_new; k++) {
+      if (new_deep[k]) continue;  // deep strings live in the host trie
+      int64_t a = aoffs[new_aidx[k]], b = aoffs[new_aidx[k] + 1];
+      blob.insert(blob.end(), abuf + a, abuf + b);
+      offs.push_back((int64_t)blob.size());
+      reg_fids.push_back(new_fid[k]);
+    }
+    if (!reg_fids.empty())
+      etpu_reg_set_bulk(reg_h, reg_fids.data(), (int32_t)reg_fids.size(),
+                        blob.empty() ? (const uint8_t*)"" : blob.data(),
+                        offs.data());
+  }
+
+  *n_new_out = n_new;
+  *n_dead_out = n_dead;
+  return 0;
+}
+
+// ------------------------------------------------------- export / ingest
+
+void etpu_churn_export_sizes(void* h, int64_t* n_entries,
+                             int64_t* str_bytes, int64_t* n_free) {
+  ChurnPlane* p = (ChurnPlane*)h;
+  int64_t n = 0, bytes = 0;
+  for (auto& sh : p->shards)
+    for (auto& e : sh.ents)
+      if (e.live) {
+        n++;
+        bytes += (int64_t)e.str.size();
+      }
+  *n_entries = n;
+  *str_bytes = bytes;
+  *n_free = (int64_t)p->free_fids.size();
+}
+
+void etpu_churn_export(void* h, uint8_t* buf, int64_t* offs, int32_t* fids,
+                       int64_t* rcs, uint8_t* deep, int32_t* free_out) {
+  ChurnPlane* p = (ChurnPlane*)h;
+  int64_t k = 0, pos = 0;
+  offs[0] = 0;
+  for (auto& sh : p->shards)
+    for (auto& e : sh.ents) {
+      if (!e.live) continue;
+      std::memcpy(buf + pos, e.str.data(), e.str.size());
+      pos += (int64_t)e.str.size();
+      offs[k + 1] = pos;
+      fids[k] = e.fid;
+      rcs[k] = (int64_t)e.rc;
+      deep[k] = e.deep;
+      k++;
+    }
+  for (size_t i = 0; i < p->free_fids.size(); i++)
+    free_out[i] = p->free_fids[i];
+}
+
+// Bulk load (checkpoint restore / snapshot adoption): keys recomputed
+// here, in parallel per shard — restore stays array adoption + one
+// parallel hash pass, no per-filter Python work.
+void etpu_churn_ingest(void* h, const uint8_t* buf, const int64_t* offs,
+                       const int32_t* fids, const int64_t* rcs,
+                       int32_t n, const int32_t* free_fids, int32_t n_free,
+                       int32_t next_fid) {
+  ChurnPlane* p = (ChurnPlane*)h;
+  std::vector<uint64_t> hashes(n);
+  EtpuPool::inst().parallel_for(n, 512, [&](int32_t i0, int32_t i1) {
+    for (int32_t i = i0; i < i1; i++)
+      hashes[i] = etpu::fnv1a64(buf + offs[i],
+                                (uint64_t)(offs[i + 1] - offs[i]));
+  });
+  std::vector<std::vector<int32_t>> by_shard(p->nshards);
+  for (int32_t i = 0; i < n; i++)
+    by_shard[p->shard_of(hashes[i])].push_back(i);
+  EtpuPool::inst().parallel_for(p->nshards, 1, [&](int32_t s0, int32_t s1) {
+    for (int32_t s = s0; s < s1; s++) {
+      PlaneShard& sh = p->shards[s];
+      for (int32_t i : by_shard[s]) {
+        const uint8_t* s8 = buf + offs[i];
+        const int64_t sn = offs[i + 1] - offs[i];
+        FilterKey fk = p->key_of(s8, sn);
+        int32_t ei = sh.alloc_ent();
+        PlaneEnt& e = sh.ents[ei];
+        e.str.assign((const char*)s8, (size_t)sn);
+        e.hash64 = hashes[i];
+        e.ha = fk.ha; e.hb = fk.hb; e.plus_mask = fk.plus_mask;
+        e.fid = fids[i]; e.rc = (int32_t)rcs[i]; e.plen = fk.plen;
+        e.has_hash = fk.has_hash;
+        e.deep = fk.plen > p->max_levels ? 1 : 0;
+        e.live = 1;
+        sh.idx.reserve_one(sh.ents);
+        sh.idx.insert(e.hash64, ei);
+      }
+    }
+  });
+  p->free_fids.assign(free_fids, free_fids + n_free);
+  p->next_fid = next_fid;
+  p->n_live += n;
+}
+
+}  // extern "C"

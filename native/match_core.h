@@ -1,6 +1,8 @@
-// Shared declaration of the fused host match core (registry.cc) so both
-// the ctypes entry point and the CPython extension (pymod.cc) call one
-// implementation.
+// Shared declarations for the native hot paths: the fused host match
+// core (registry.cc), the registry bulk mutators (used by the churn
+// plane), and the inline per-filter key computation shared by
+// matchhash.cc etpu_filter_keys and churn.cc (one implementation so the
+// table-key semantics cannot drift between the bulk and churn paths).
 #pragma once
 
 #include <cstdint>
@@ -22,4 +24,80 @@ int64_t etpu_match_core(
     int32_t* out_fid, int32_t* out_cnt, int32_t vcap,
     int32_t* out_coll, int32_t coll_cap, int32_t* n_coll);
 
+void etpu_reg_set_bulk(void* h, const int32_t* fids, int32_t n,
+                       const uint8_t* buf, const int64_t* offs);
+void etpu_reg_del_bulk(void* h, const int32_t* fids, int32_t n);
+
 }  // extern "C"
+
+// ---- shared hash/key helpers (ops/hashing.py semantics, bit-for-bit) ----
+
+namespace etpu {
+
+constexpr uint64_t kFnvOffset = 0xcbf29ce484222325ULL;
+constexpr uint64_t kFnvPrime = 0x100000001b3ULL;
+// ops/hashing.py _PERTURB: keeps hash("") != 0
+constexpr uint64_t kPerturb = 0xD6E8FEB86659FD93ULL;
+
+static inline uint64_t fnv1a64(const uint8_t* s, uint64_t n) {
+  uint64_t h = kFnvOffset;
+  for (uint64_t i = 0; i < n; i++) {
+    h ^= (uint64_t)s[i];
+    h *= kFnvPrime;
+  }
+  return h;
+}
+
+struct FilterKey {
+  uint32_t ha, hb, plus_mask;
+  int32_t plen;
+  uint8_t has_hash;
+};
+
+// Table key + wildcard shape of one subscription filter —
+// ops/hashing.py HashSpace.filter_key semantics (see matchhash.cc
+// etpu_filter_keys for the contract notes).  plen may exceed
+// max_levels: such filters are DEEP and take the host-trie path.
+static inline FilterKey filter_key_one(
+    const uint8_t* f, int64_t n, int32_t max_levels,
+    const uint32_t* Ca, const uint32_t* Cb,
+    const uint32_t* Ra, const uint32_t* Rb,
+    const uint32_t* PLUS, const uint32_t* HM,
+    const uint32_t* HRa, const uint32_t* HRb) {
+  FilterKey k{0, 0, 0, 0, 0};
+  int64_t start = 0;
+  int32_t level = 0;
+  for (int64_t p = 0; p <= n; p++) {
+    if (p == n || f[p] == '/') {
+      int64_t wlen = p - start;
+      bool last = (p == n);
+      if (last && wlen == 1 && f[start] == '#') {
+        k.has_hash = 1;
+      } else {
+        if (wlen == 1 && f[start] == '+') {
+          if (level < 32) k.plus_mask |= 1u << level;
+          if (level < max_levels) {
+            k.ha += (PLUS[0] ^ Ca[level]) * Ra[level];
+            k.hb += (PLUS[1] ^ Cb[level]) * Rb[level];
+          }
+        } else if (level < max_levels) {
+          uint64_t h = fnv1a64(f + start, (uint64_t)wlen) ^ kPerturb;
+          k.ha += ((uint32_t)h ^ Ca[level]) * Ra[level];
+          k.hb += ((uint32_t)(h >> 32) ^ Cb[level]) * Rb[level];
+        }
+        level++;
+      }
+      start = p + 1;
+    }
+  }
+  // "" splits to one empty level, which the loop above already hashed
+  k.plen = level;
+  if (k.has_hash && k.plen <= max_levels) {
+    k.ha += HM[0] * HRa[k.plen];
+    k.hb += HM[1] * HRb[k.plen];
+  }
+  if (k.ha == 0 && k.hb == 0) k.hb = 1;  // (0,0) = empty-slot sentinel
+  return k;
+}
+
+}  // namespace etpu

@@ -20,22 +20,18 @@
 #include <thread>
 #include <vector>
 
+#include "match_core.h"
+
 extern "C" {
 
 // ---------------------------------------------------------------- fnv1a64
 
-static const uint64_t FNV_OFFSET = 0xcbf29ce484222325ULL;
-static const uint64_t FNV_PRIME = 0x100000001b3ULL;
-// ops/hashing.py _PERTURB: keeps hash("") != 0
-static const uint64_t PERTURB = 0xD6E8FEB86659FD93ULL;
+// shared with registry.cc / churn.cc via match_core.h so the word-hash
+// semantics cannot drift between the prep, match, and churn planes
+static const uint64_t PERTURB = etpu::kPerturb;
 
 static inline uint64_t fnv1a64(const uint8_t* s, uint64_t n) {
-    uint64_t h = FNV_OFFSET;
-    for (uint64_t i = 0; i < n; i++) {
-        h ^= (uint64_t)s[i];
-        h *= FNV_PRIME;
-    }
-    return h;
+    return etpu::fnv1a64(s, n);
 }
 
 uint64_t etpu_fnv1a64(const uint8_t* s, uint64_t n) { return fnv1a64(s, n); }
@@ -186,51 +182,17 @@ void etpu_filter_keys(
     const uint32_t* HRa, const uint32_t* HRb,  // [max_levels+1]
     uint32_t* ha_out, uint32_t* hb_out,
     int32_t* plen_out, uint32_t* plus_mask_out, uint8_t* has_hash_out) {
+    // per-filter key computation shared with the churn plane
+    // (match_core.h filter_key_one) — one implementation, zero drift
     for (int32_t i = 0; i < n_filters; i++) {
-        const uint8_t* f = data + offsets[i];
-        int64_t n = offsets[i + 1] - offsets[i];
-        // split into levels
-        int32_t plen = 0;
-        uint32_t plus_mask = 0;
-        uint32_t ha = 0, hb = 0;
-        uint8_t has_hash = 0;
-        int64_t start = 0;
-        int32_t level = 0;
-        for (int64_t p = 0; p <= n; p++) {
-            if (p == n || f[p] == '/') {
-                int64_t wlen = p - start;
-                bool last = (p == n);
-                if (last && wlen == 1 && f[start] == '#') {
-                    has_hash = 1;
-                } else {
-                    if (wlen == 1 && f[start] == '+') {
-                        if (level < 32) plus_mask |= 1u << level;
-                        if (level < max_levels) {
-                            ha += (PLUS[0] ^ Ca[level]) * Ra[level];
-                            hb += (PLUS[1] ^ Cb[level]) * Rb[level];
-                        }
-                    } else if (level < max_levels) {
-                        uint64_t h = fnv1a64(f + start, (uint64_t)wlen) ^ PERTURB;
-                        ha += ((uint32_t)h ^ Ca[level]) * Ra[level];
-                        hb += ((uint32_t)(h >> 32) ^ Cb[level]) * Rb[level];
-                    }
-                    level++;
-                }
-                start = p + 1;
-            }
-        }
-        // "" splits to one empty level, which the loop above already hashed
-        plen = level;
-        if (has_hash && plen <= max_levels) {
-            ha += HM[0] * HRa[plen];
-            hb += HM[1] * HRb[plen];
-        }
-        if (ha == 0 && hb == 0) hb = 1;
-        ha_out[i] = ha;
-        hb_out[i] = hb;
-        plen_out[i] = plen;
-        plus_mask_out[i] = plus_mask;
-        has_hash_out[i] = has_hash;
+        etpu::FilterKey k = etpu::filter_key_one(
+            data + offsets[i], offsets[i + 1] - offsets[i], max_levels,
+            Ca, Cb, Ra, Rb, PLUS, HM, HRa, HRb);
+        ha_out[i] = k.ha;
+        hb_out[i] = k.hb;
+        plen_out[i] = k.plen;
+        plus_mask_out[i] = k.plus_mask;
+        has_hash_out[i] = k.has_hash;
     }
 }
 

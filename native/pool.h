@@ -12,6 +12,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <functional>
 #include <mutex>
 #include <thread>
@@ -52,9 +53,21 @@ class EtpuPool {
     fn_ = nullptr;
   }
 
+  // worker threads + the calling thread (the effective parallelism of
+  // parallel_for on large jobs; the churn bench reports this)
+  int32_t width() const { return nworkers_ + 1; }
+
  private:
   EtpuPool() {
+    // ETPU_POOL_THREADS pins the pool width (worker sweeps in
+    // `bench.py --churn`, single-thread A/B runs); default: one worker
+    // per hardware thread beyond the caller, capped at 16 total.
     unsigned hw = std::thread::hardware_concurrency();
+    const char* env = std::getenv("ETPU_POOL_THREADS");
+    if (env != nullptr && *env != '\0') {
+      long v = std::strtol(env, nullptr, 10);
+      if (v >= 1 && v <= 64) hw = (unsigned)v;
+    }
     nworkers_ = hw > 16 ? 15 : (hw > 1 ? (int32_t)hw - 1 : 0);
     for (int32_t i = 0; i < nworkers_; i++) {
       std::thread([this, gen = uint64_t{0}]() mutable {

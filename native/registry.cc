@@ -36,19 +36,12 @@ struct Registry {
   std::shared_mutex mu;
 };
 
-// ---- shared helpers (semantics identical to matchhash.cc) ----
+// ---- shared helpers (match_core.h; semantics identical to matchhash.cc)
 
-static const uint64_t FNV_OFFSET = 0xcbf29ce484222325ULL;
-static const uint64_t FNV_PRIME = 0x100000001b3ULL;
-static const uint64_t PERTURB = 0xD6E8FEB86659FD93ULL;
+static const uint64_t PERTURB = etpu::kPerturb;
 
 static inline uint64_t fnv1a64(const uint8_t* s, uint64_t n) {
-  uint64_t h = FNV_OFFSET;
-  for (uint64_t i = 0; i < n; i++) {
-    h ^= (uint64_t)s[i];
-    h *= FNV_PRIME;
-  }
-  return h;
+  return etpu::fnv1a64(s, n);
 }
 
 // Exact MQTT topic-vs-filter match (broker/topic.py match_words semantics;

@@ -1,0 +1,245 @@
+"""Parallel churn plane (native/churn.cc) vs the serial oracle.
+
+The plane replaces the engines' Python dict bookkeeping with sharded,
+GIL-free native state; these tests pin the equivalence contract:
+identical fid assignment (the plane replicates the LIFO allocator
+bit-for-bit), identical refcounts, identical match results, and an
+identical serialized `on_churn` WAL stream — including interleaved
+add/remove of the same filter across shards within one tick, duplicate
+ops in one batch, deep-filter routing, and checkpoint roundtrips.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from emqx_tpu.models.engine import TopicMatchEngine
+from emqx_tpu.ops import native
+from emqx_tpu.parallel.mesh import make_mesh
+from emqx_tpu.parallel.sharded import ShardedMatchEngine
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 cpu devices"
+    return make_mesh()
+
+
+def _hooked(eng):
+    stream = []
+    eng.on_churn = lambda adds, removes: stream.append(
+        (list(adds), list(removes))
+    )
+    return stream
+
+
+def _names(eng, sets):
+    rev = {fid: f for f, fid in eng.fid_map().items()}
+    return [sorted(rev[f] for f in s) for s in sets]
+
+
+def _churn_rounds(rng, rounds=8, ops=300):
+    """Adversarial churn ticks: duplicate adds, duplicate removes,
+    remove+re-add of the same filter in ONE tick, unknown removes,
+    deep filters, shared-prefix filters landing in different shards."""
+    pool = (
+        [f"churn/{i}/+" for i in range(120)]
+        + [f"plant/{i}/t/#" for i in range(60)]
+        + ["/".join(["d"] * 20) + f"/{i}" for i in range(6)]  # deep
+    )
+    for _ in range(rounds):
+        adds, removes = [], []
+        for _ in range(ops):
+            f = rng.choice(pool)
+            r = rng.random()
+            if r < 0.40:
+                adds.append(f)
+            elif r < 0.75:
+                removes.append(f)
+            else:  # same filter both sides of one tick
+                removes.append(f)
+                adds.append(f)
+        if rng.random() < 0.3:  # duplicate bursts
+            f = rng.choice(pool)
+            adds += [f] * 3
+            removes += [f] * 2
+        yield adds, removes
+
+
+def test_plane_vs_serial_oracle_single_chip():
+    rng = random.Random(1234)
+    fast = TopicMatchEngine()  # plane mode (native present)
+    slow = TopicMatchEngine(use_churn_plane=False)
+    assert fast._plane is not None and slow._plane is None
+    s_fast, s_slow = _hooked(fast), _hooked(slow)
+
+    base = [f"base/{i}/+/t" for i in range(2000)]
+    assert fast.add_filters(base) == slow.add_filters(base)
+    for tick, (adds, removes) in enumerate(_churn_rounds(rng)):
+        out_f = fast.apply_churn(adds, removes)
+        out_s = slow.apply_churn(adds, removes)
+        # deterministic LIFO fid parity: assignments match bit-for-bit
+        assert out_f == out_s, f"tick {tick}"
+        assert fast.fid_map() == slow.fid_map(), f"tick {tick}"
+        assert fast.ref_snapshot() == slow.ref_snapshot(), f"tick {tick}"
+        assert fast.free_fid_count() == slow.free_fid_count()
+        topics = [f"churn/{rng.randrange(120)}/x" for _ in range(32)]
+        topics += [f"plant/{rng.randrange(60)}/t/a/b" for _ in range(32)]
+        topics += ["/".join(["d"] * 20) + f"/{rng.randrange(6)}"]
+        assert _names(fast, fast.match(topics)) == \
+            _names(slow, slow.match(topics)), f"tick {tick}"
+    # identical serialized WAL stream (one record per batch, same order)
+    assert s_fast == s_slow
+    assert fast.n_filters == slow.n_filters
+
+
+def test_plane_wal_replay_converges():
+    """Replaying the plane engine's on_churn stream into a fresh engine
+    reconstructs identical truth (the checkpoint/wal.py contract)."""
+    rng = random.Random(77)
+    eng = TopicMatchEngine()
+    stream = _hooked(eng)
+    eng.add_filters([f"w/{i}/+" for i in range(500)])
+    for adds, removes in _churn_rounds(rng, rounds=5, ops=150):
+        eng.apply_churn(adds, removes)
+    replayed = TopicMatchEngine()
+    for adds, removes in stream:
+        replayed.apply_churn(adds, removes)
+    assert replayed.ref_snapshot() == eng.ref_snapshot()
+    assert replayed.fid_map() == eng.fid_map()
+    topics = [f"w/{i}/x" for i in range(0, 500, 7)]
+    assert _names(replayed, replayed.match(topics)) == \
+        _names(eng, eng.match(topics))
+
+
+def test_plane_vs_serial_oracle_sharded(mesh):
+    rng = random.Random(4321)
+    fast = ShardedMatchEngine(mesh=mesh, n_sub_shards=64)
+    slow = ShardedMatchEngine(mesh=mesh, n_sub_shards=64,
+                              use_churn_plane=False)
+    assert fast._plane is not None and slow._plane is None
+    s_fast, s_slow = _hooked(fast), _hooked(slow)
+    base = [f"base/{i}/+" for i in range(800)]
+    assert fast.add_filters(base) == slow.add_filters(base)
+    for tick, (adds, removes) in enumerate(
+        _churn_rounds(rng, rounds=5, ops=200)
+    ):
+        out_f = fast.apply_churn(adds, removes)
+        out_s = slow.apply_churn(adds, removes)
+        assert out_f == out_s, f"tick {tick}"
+        assert fast.fid_map() == slow.fid_map(), f"tick {tick}"
+        assert fast.ref_snapshot() == slow.ref_snapshot(), f"tick {tick}"
+        topics = [f"churn/{rng.randrange(120)}/x" for _ in range(24)]
+        topics += [f"base/{rng.randrange(800)}/q" for _ in range(24)]
+        assert _names(fast, fast.match(topics)) == \
+            _names(slow, slow.match(topics)), f"tick {tick}"
+    # sharded keeps the two-record framing: ([], removes) then (adds, [])
+    assert s_fast == s_slow
+
+
+def test_plane_checkpoint_roundtrip():
+    rng = random.Random(9)
+    eng = TopicMatchEngine()
+    eng.add_filters(
+        [f"c/{i}/+" for i in range(700)]
+        + ["/".join(["deep"] * 20) + "/x"]
+    )
+    for adds, removes in _churn_rounds(rng, rounds=3, ops=100):
+        eng.apply_churn(adds, removes)
+    arrays, meta = eng.export_checkpoint()
+    back = TopicMatchEngine()
+    assert back.restore_checkpoint(arrays, meta) == eng.n_filters
+    assert back.fid_map() == eng.fid_map()
+    assert back.ref_snapshot() == eng.ref_snapshot()
+    topics = [f"c/{i}/z" for i in range(0, 700, 11)]
+    topics.append("/".join(["deep"] * 20) + "/x")
+    assert _names(back, back.match(topics)) == _names(eng, eng.match(topics))
+    # the restored plane keeps allocating where the snapshot left off
+    assert back.add_filter("fresh/after/restore") == \
+        eng.add_filter("fresh/after/restore")
+
+
+def test_plane_remove_semantics():
+    eng = TopicMatchEngine()
+    assert eng._plane is not None
+    assert eng.remove_filter("never/seen") is None
+    fid = eng.add_filter("a/+")
+    assert eng.add_filter("a/+") == fid  # refcount bump
+    assert eng.remove_filter("a/+") is None  # one ref left
+    assert eng.remove_filter("a/+") == fid  # fully removed
+    assert eng.fid_of("a/+") is None
+    assert eng.n_filters == 0
+    # freed fid is reused LIFO
+    assert eng.add_filter("b/+") == fid
+
+
+def test_plane_growth_mid_tick():
+    """A plane churn batch crossing the load factor triggers one
+    rebuild and stays correct (the apply_planned growth path)."""
+    eng = TopicMatchEngine()
+    assert eng._plane is not None
+    eng.add_filters([f"a/{i}" for i in range(100)])
+    eng.sync_device()
+    cap_before = eng.tables.log2cap
+    eng.apply_churn([f"g/{i}/+" for i in range(5000)], [])
+    eng.sync_device()
+    assert eng.tables.log2cap > cap_before
+    assert eng.match(["g/77/zzz"])[0] == {eng.fid_of("g/77/+")}
+    assert eng.match(["a/5"])[0] == {eng.fid_of("a/5")}
+    # and shrink back down through the plane's vectorized delete
+    eng.apply_churn([], [f"g/{i}/+" for i in range(5000)])
+    assert eng.match(["g/77/zzz"])[0] == set()
+    assert eng.n_filters == 100
+
+
+def test_shed_counter_and_flight_row():
+    from emqx_tpu.observe.tracepoints import TraceCollector
+
+    eng = TopicMatchEngine()
+    eng.add_filters([f"s/{i}" for i in range(600)])
+    with TraceCollector() as tc:
+        eng.note_churn_shed(1234)
+        eng.note_churn_shed(0)  # no-op: nothing shed
+    assert eng.churn_shed == 1234
+    shed_evs = tc.of_kind("engine.churn.shed")
+    assert len(shed_evs) == 1 and shed_evs[0]["shed"] == 1234
+    # the next collected tick carries the shed delta in its flight row
+    eng.match(["s/1"])
+    row = eng.flight.recent(1)[0]
+    assert row["churn_shed"] == 1234
+    eng.match(["s/2"])
+    assert eng.flight.recent(1)[0]["churn_shed"] == 0  # delta, not total
+
+
+def test_sharded_topic_hash_memo(mesh):
+    """Repeated topics hit the cross-tick memo and hash identically to
+    the uncached path (pure-function cache)."""
+    eng = ShardedMatchEngine(mesh=mesh, n_sub_shards=64)
+    eng.add_filters([f"m/{i}/+" for i in range(64)])
+    batch = [f"m/{i % 16}/x" for i in range(128)]
+    ta1, tb1, ln1, dl1 = eng._hash_topics_memo(list(batch))
+    assert eng.memo_misses == 16  # in-batch dedup: one miss per name
+    assert eng.memo_hits == 128 - 16
+    ta2, tb2, ln2, dl2 = eng._hash_topics_memo(list(batch))
+    assert eng.memo_misses == 16 and eng.memo_hits == 2 * 128 - 16
+    from emqx_tpu.ops import hashing
+
+    fta, ftb, fln, _fdl = hashing.hash_topics(eng.space, list(batch))
+    np.testing.assert_array_equal(ta1, fta)
+    np.testing.assert_array_equal(tb1, ftb)
+    np.testing.assert_array_equal(ta2, fta)
+    np.testing.assert_array_equal(ln1, fln)
+    # memo reset at capacity keeps serving correct rows
+    eng.topic_memo_cap = 20
+    ta3, _tb3, _ln3, _dl3 = eng._hash_topics_memo(list(batch))
+    np.testing.assert_array_equal(ta3, fta)
+    # and match results stay correct through the memoized prep
+    got = eng.match([f"m/3/x", "m/777/x"])
+    assert got[0] == {eng.fid_of("m/3/+")}
+    assert got[1] == set()

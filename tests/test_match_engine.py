@@ -274,7 +274,7 @@ def test_apply_churn_matches_per_op_path():
         got_s = slow.match(topics)
         # fids differ between engines; compare by filter strings
         def names(eng, sets):
-            rev = {fid: f for f, fid in eng._fids.items()}
+            rev = {fid: f for f, fid in eng.fid_map().items()}
             return [sorted(rev[f] for f in s) for s in sets]
         assert names(fast, got_f) == names(slow, got_s), f"tick {tick}"
     assert fast.n_filters == slow.n_filters
@@ -382,17 +382,17 @@ def test_apply_churn_pure_remove_keeps_free_list():
     not slice the whole free list (free[-0:]), leak refs entries, or
     return freed fids."""
     eng = TopicMatchEngine()
-    fids = eng.add_filters([f"pr/{i}" for i in range(600)])
+    eng.add_filters([f"pr/{i}" for i in range(600)])
     eng.apply_churn([], [f"pr/{i}" for i in range(10)])
-    assert len(eng._free_fids) == 10
-    assert all(f not in eng._refs for f in fids[:10])
+    assert eng.free_fid_count() == 10
+    assert all(eng.fid_of(f"pr/{i}") is None for i in range(10))
     out = eng.apply_churn([], ["pr/10"])
     assert out == []
-    assert len(eng._free_fids) == 11
+    assert eng.free_fid_count() == 11
     # all-existing adds: returns the existing fids, allocates nothing
     out = eng.apply_churn(["pr/20", "pr/21"], [])
     assert out == [eng.fid_of("pr/20"), eng.fid_of("pr/21")]
-    assert eng._refs[eng.fid_of("pr/20")] == 2
+    assert eng.refcount_of("pr/20") == 2
 
 
 def test_apply_churn_duplicate_removes_decrement_each():

@@ -27,6 +27,15 @@ this gate implements the highest-value checks directly on the stdlib:
      schema (`config/config.py` SCHEMA["ds"]) — the inverse direction
      of the dead-config audit: a key read but never declared always
      resolves to None and silently disables what it configures
+  8. churn WAL hook coverage: every PUBLIC mutation path of the two
+     match engines (TopicMatchEngine / ShardedMatchEngine) that touches
+     table or churn-plane state must reference the `on_churn` hook —
+     a mutator that skips the hook silently diverges the checkpoint
+     WAL from host truth (checkpoint/wal.py's exactly-once replay
+     contract).  Private helpers delegate the hook to their public
+     callers; rollback code inside `except` blocks is exempt; an
+     `on_churn` CALL inside a loop is flagged too (the WAL contract is
+     one serialized record per mutation batch, not per item)
 
 Exit code 0 = clean.  `--fix` is intentionally absent: findings are
 either real bugs or deliberate (suppressed via `# check: ignore` on the
@@ -454,6 +463,130 @@ def check_ds_config(problems):
             )
 
 
+ENGINE_CLASSES = {
+    os.path.join("emqx_tpu", "models", "engine.py"): {"TopicMatchEngine"},
+    os.path.join("emqx_tpu", "parallel", "sharded.py"): {
+        "ShardedMatchEngine"
+    },
+}
+TABLE_MUTATORS = {
+    "insert", "delete", "delete_batch", "churn_insert",
+    "churn_insert_keys", "bulk_insert", "bulk_insert_keys",
+    "apply_planned",
+}
+PLANE_HELPERS = {"_plane_churn", "_plane_apply"}
+CHURN_HOOK_EXEMPT = {"restore_checkpoint"}  # state adoption, not churn
+
+
+def _subtree_names(node):
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def _walk_outside_except(node):
+    """Walk a function body skipping `except` handler subtrees (rollback
+    paths legitimately undo mutations without firing the hook)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, ast.ExceptHandler):
+                continue
+            stack.append(child)
+
+
+def _method_mutates(fn) -> bool:
+    """True when fn's body (outside except blocks) calls a table/plane
+    mutator on self state."""
+    for n in _walk_outside_except(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if f.attr in TABLE_MUTATORS:
+            names = _subtree_names(f.value)
+            if "tables" in names or "shards" in names:
+                return True
+        elif f.attr == "apply":
+            if isinstance(f.value, ast.Attribute) \
+                    and f.value.attr == "_plane":
+                return True
+        elif f.attr in PLANE_HELPERS:
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                return True
+    return False
+
+
+def check_churn_hooks(problems):
+    for rel, classes in ENGINE_CLASSES.items():
+        path = os.path.join(REPO, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, path)
+        except SyntaxError:
+            continue  # reported by the syntax pass
+        ignored = _ignored_lines(src)
+        for cls in ast.walk(tree):
+            if not (isinstance(cls, ast.ClassDef) and cls.name in classes):
+                continue
+            methods = [
+                n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            mutating = {m.name for m in methods if _method_mutates(m)}
+            private_mut = {m for m in mutating if m.startswith("_")}
+            for m in methods:
+                if m.name.startswith("_") or m.name in CHURN_HOOK_EXEMPT:
+                    continue
+                direct = m.name in mutating
+                via_helper = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in private_mut
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "self"
+                    for n in _walk_outside_except(m)
+                )
+                if not (direct or via_helper):
+                    continue
+                refs_hook = any(
+                    isinstance(n, ast.Attribute) and n.attr == "on_churn"
+                    for n in ast.walk(m)
+                )
+                if not refs_hook and m.lineno not in ignored:
+                    problems.append(
+                        f"{path}:{m.lineno}: {cls.name}.{m.name} mutates "
+                        "match-table/churn-plane state without firing the "
+                        "on_churn WAL hook"
+                    )
+                # the hook must fire once per batch, never per item
+                for n in ast.walk(m):
+                    if not isinstance(n, (ast.For, ast.AsyncFor, ast.While)):
+                        continue
+                    for c in ast.walk(n):
+                        if (
+                            isinstance(c, ast.Call)
+                            and isinstance(c.func, ast.Attribute)
+                            and c.func.attr == "on_churn"
+                            and c.lineno not in ignored
+                        ):
+                            problems.append(
+                                f"{path}:{c.lineno}: {cls.name}.{m.name} "
+                                "calls on_churn inside a loop (WAL records "
+                                "are one per mutation batch)"
+                            )
+
+
 def check_native(problems):
     src_dir = os.path.join(REPO, "native")
     if not os.path.isdir(src_dir):
@@ -493,6 +626,7 @@ def main() -> int:
     check_tracepoints(problems)
     check_fault_sites(problems)
     check_ds_config(problems)
+    check_churn_hooks(problems)
     check_native(problems)
     for p in problems:
         print(p)

@@ -61,7 +61,8 @@ def format_ticks(rec: FlightRecorder, n: int = 32) -> str:
         return "(no ticks recorded)"
     hdr = (f"{'tick':>8} {'path':>6} {'reason':<12} {'n':>6} {'uniq':>6} "
            f"{'occ':>5} {'lat ms':>9} {'up':>9} {'down':>9} "
-           f"{'rate_h':>12} {'rate_d':>12} {'vfail':>5} {'churn':>7}")
+           f"{'rate_h':>12} {'rate_d':>12} {'vfail':>5} {'churn':>7} "
+           f"{'shed':>7}")
     lines = [hdr, "-" * len(hdr)]
     first_tick = rec.n - len(rows)
     for i, r in enumerate(rows):
@@ -74,7 +75,8 @@ def format_ticks(rec: FlightRecorder, n: int = 32) -> str:
             f"{_fmt_bytes(r['bytes_down']):>9} "
             f"{_fmt_rate(r['rate_host']):>12} "
             f"{_fmt_rate(r['rate_dev']):>12} "
-            f"{r['verify_fail']:>5} {r['churn_slots']:>7}"
+            f"{r['verify_fail']:>5} {r['churn_slots']:>7} "
+            f"{r.get('churn_shed', 0):>7}"
         )
     lines.append("(* = arbitration flip on this tick; occ = pipeline "
                  "occupancy at submit / window depth)")
