@@ -2,7 +2,7 @@
 # /root/reference/Makefile, rebar.config:16-36 dialyzer/xref/elvis).
 
 .PHONY: check lint test test-fast native bench restore-bench chaos \
-        ds-bench ds-dump ds-soak churn-bench
+        ds-bench ds-dump ds-soak churn-bench retained-bench
 
 # static-analysis gate: stdlib implementation (mypy/ruff are not in this
 # image and installs are off-limits — see tools/check.py header)
@@ -28,6 +28,12 @@ bench:
 # 100k filters; writes the restore_ms/rebuild_ms row into BENCH_TABLE.md
 restore-bench:
 	python bench.py --restore
+
+# retained-index sweep: stored names x lookup batch size, host trie vs
+# the bucketed device index (exact parity asserted per filter), with
+# the transfer-free kernel rate and the arbiter's picks recorded
+retained-bench:
+	python bench.py --retained
 
 # multi-seed chaos soak: 3-node cluster + hybrid engine under a seeded
 # fault schedule; asserts no QoS1 forward loss, engine/oracle parity,

@@ -279,6 +279,20 @@ class Broker:
         c["engine.probes"] = getattr(e, "probe_count", 0)
         c["engine.breaker_trips"] = getattr(e, "breaker_trips", 0)
         c["engine.churn_shed"] = getattr(e, "churn_shed", 0)
+        r = self.retainer
+        c["retained.lookups.index"] = r.index_serves
+        c["retained.lookups.trie"] = r.trie_serves
+        c["retained.index.flips"] = r.path_flips
+        c["retained.index.probes"] = r.probe_count
+        idx = r.index
+        if idx is not None:
+            c["retained.index.collisions"] = idx.collision_count
+            c["retained.index.fallbacks"] = idx.fallbacks
+            c["retained.index.refetches"] = idx.refetches
+            self.metrics.gauge_set("retained.index.shapes",
+                                   idx.shape_count)
+            self.metrics.gauge_set("retained.index.entries",
+                                   idx.entry_count)
 
     # ---------------------------------------------------------- publish
 

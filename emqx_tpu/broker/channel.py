@@ -628,6 +628,12 @@ class Channel:
             sids = p.properties.get(Property.SUBSCRIPTION_IDENTIFIER)
             if sids:
                 sub_id = sids[0] if isinstance(sids, list) else sids
+        # pass 1: grant + subscribe + CREATE every retained iterator
+        # before consuming any — with the device retained index the
+        # lookups queue up and the first consumption below flushes the
+        # whole packet's filters as ONE batched index dispatch
+        # (broker/retainer.py), the way publish ticks batch matching
+        rits = []
         for tf, opts in filters:
             rc = self._check_sub(tf, opts)
             codes.append(rc)
@@ -644,14 +650,15 @@ class Channel:
                 self.broker.hooks.run(
                     "session.subscribed", (self.clientid, mounted, granted)
                 )
-            # retained messages (v5 retain-handling; v3 always sends).
-            # Deliveries beyond one batch are paced by the connection
-            # (flow control, `emqx_retainer.erl:85-150`) so a huge
-            # retained set cannot starve the event loop or flood the
-            # socket in one burst.
             rh = granted.retain_handling if self.v5 else 0
-            rit = self.broker.retained_iter(mounted, rh, is_new)
             _g, real = topiclib.parse_share(mounted)
+            rits.append((real, self.broker.retained_iter(mounted, rh, is_new)))
+        # pass 2: retained messages (v5 retain-handling; v3 always
+        # sends).  Deliveries beyond one batch are paced by the
+        # connection (flow control, `emqx_retainer.erl:85-150`) so a
+        # huge retained set cannot starve the event loop or flood the
+        # socket in one burst.
+        for real, rit in rits:
             for rmsg in itertools.islice(rit, self.cfg.retained_batch):
                 rmsg = replace(rmsg, headers=dict(rmsg.headers, retained=True))
                 for d in self.session.deliver([(real, rmsg)]):

@@ -92,6 +92,15 @@ PREDEFINED = [
     # engine device breaker (models/engine.py; synced like the rest of
     # the engine.* counters by Broker.sync_engine_metrics)
     "engine.breaker_trips",
+    # retained device index (broker/retainer.py + models/retained.py;
+    # synced by Broker.sync_engine_metrics at observation points)
+    "retained.lookups.index",
+    "retained.lookups.trie",
+    "retained.index.flips",
+    "retained.index.probes",
+    "retained.index.collisions",
+    "retained.index.fallbacks",
+    "retained.index.refetches",
 ]
 
 

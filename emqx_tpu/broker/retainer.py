@@ -6,18 +6,46 @@ Analog of `apps/emqx_retainer` (`emqx_retainer.erl:85-150`,
 topic names and matching messages are re-delivered, honoring the v5
 retain-handling subscription option.
 
-The lookup direction is the reverse of the publish hot path (wildcard filter
-vs concrete stored names), so it uses a host-side topic-name trie rather
-than the device tables; retained populations are small relative to
-subscription populations and mutate rarely.
+The lookup direction is the reverse of the publish hot path (wildcard
+filter vs concrete stored names).  Two paths serve it:
+
+* the host topic-name **trie** — canonical truth and the verify oracle,
+  output-proportional enumeration;
+* the optional **device index** (`models/retained.py`) — stored names
+  bucketed by masked hash, probed by batched compact dispatches.
+
+Arbitration mirrors the publish engine (`models/engine.py`): each path's
+throughput is EWMA-measured in lookups/s — the trie by a timing wrapper
+around its walk, the index per dispatched batch — and the faster one
+serves.  While the trie serves, the index is re-probed every
+``probe_interval`` seconds with a real lookup batch (non-blocking:
+completion is polled on later lookups), which both re-measures the link
+AND keeps the device mirror warm, so recovery after a degraded-link
+episode is automatic.  While the index serves, the trie rate is
+refreshed periodically the same way.  Path changes emit
+``retained.flip``.
+
+Lookups are BATCHED: ``iter_filter`` enqueues its filter and the first
+generator actually consumed flushes every queued lookup as ONE index
+dispatch — so a multi-filter SUBSCRIBE packet (channel.py collects its
+iterators before consuming), a session resume, or a durable-log
+gap-recovery sweep (`iter_matching`) amortize the dispatch the way
+publish ticks amortize matching.  Filters the index bounces (coarse
+shapes, huge fan-ins, over-cap shape registry) fall to the trie
+per-filter.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
+from ..observe import tracepoints as _tps
+from ..observe.tracepoints import tp
 from . import topic as topiclib
 from .message import Message
+
+_UNSET = object()
 
 
 class _Node:
@@ -28,9 +56,18 @@ class _Node:
         self.msg: Optional[Message] = None
 
 
+class _LookupReq:
+    __slots__ = ("filt", "names")
+
+    def __init__(self, filt: str):
+        self.filt = filt
+        self.names = _UNSET  # list[str] | None (trie serves) | _UNSET
+
+
 class Retainer:
     def __init__(self, max_retained: int = 0, max_payload: int = 0,
-                 enable: bool = True, store=None, device_index=None):
+                 enable: bool = True, store=None, device_index=None,
+                 probe_interval: float = 10.0):
         self.root = _Node()
         self.count = 0
         self.max_retained = max_retained  # 0 = unlimited
@@ -40,22 +77,29 @@ class Retainer:
         # copies); retained messages then survive a restart
         self.store = store
         # optional HBM name index (models/retained.py): subscribe-time
-        # wildcard fan-in as ONE device dispatch instead of a trie walk
-        # — the trie stays canonical truth (and the verify oracle)
+        # wildcard fan-in as batched device dispatches instead of a trie
+        # walk — the trie stays canonical truth (and the verify oracle)
         self.index = device_index
         # host/device arbitration, same policy as the publish engine
-        # (models/engine.py): the index serves while its MEASURED
-        # dispatch latency stays under budget; past it (a degraded
-        # host<->device link) the trie serves and the index is re-probed
-        # every probe_interval so recovery is automatic
-        self.index_lat_budget = 0.05  # seconds per lookup
-        self.probe_interval = 10.0
-        self._index_lat: float = 0.0  # EWMA
-        self._last_index_use = 0.0
+        # (models/engine.py): EWMA lookups/s per path, serve the faster,
+        # probe the loser every probe_interval (probes keep the device
+        # mirror warm)
+        self.probe_interval = probe_interval
+        self.rate_trie: Optional[float] = None
+        self.rate_index: Optional[float] = None
+        self._last_trie_meas = 0.0
+        self._last_index_meas = 0.0
+        self._probe = None  # (pending, t0, n_filters)
+        self.probe_cap = 64
+        self.probe_count = 0
         self.index_serves = 0
         self.trie_serves = 0
+        self.path_flips = 0
+        self._last_path: Optional[str] = None
+        self._pending: List[_LookupReq] = []
         if store is not None:
-            for msg in store.load().values():
+            msgs = store.load().values()
+            for msg in msgs:
                 self._insert(msg, persist=False)
 
     # ------------------------------------------------------------- store
@@ -137,37 +181,170 @@ class Retainer:
         A generator so large retained sets can be re-delivered in paced
         batches without one synchronous full-trie collection blocking
         the event loop at subscribe time (`emqx_retainer`'s batched
-        mnesia reads).  Each node's children are snapshotted when
-        visited, so concurrent retain/delete between batches is safe
-        (same read-committed looseness as the reference's continuations).
-
-        With the device index attached, the name set comes from ONE
-        kernel dispatch (models/retained.py) and only the hit topics
-        touch the trie (message fetch + expiry check) — unless the
-        index's measured latency is over budget (degraded link), in
-        which case the trie serves until a periodic re-probe succeeds.
+        mnesia reads).  With the device index attached the lookup is
+        QUEUED at generator creation and flushed as one batched index
+        dispatch when the first queued generator is consumed — create
+        every subscription's iterator before consuming any (channel.py's
+        SUBSCRIBE handler, `iter_matching`) and the whole set rides one
+        dispatch.
         """
-        if self.index is not None and len(self.index) and self._index_ok():
-            import time as _time
+        if self.index is None:
+            return self._trie_iter(filt)
+        req = _LookupReq(filt)
+        self._pending.append(req)
+        return self._req_iter(req)
 
-            t0 = _time.monotonic()
-            names = self.index.lookup(filt)
-            dt = _time.monotonic() - t0
-            if dt <= self.index_lat_budget:
-                # snap down on a good lookup: one outlier (first-lookup
-                # JIT compile, a GC pause) must not bench a healthy
-                # index for several probe windows
-                self._index_lat = dt
-            else:
-                self._index_lat = 0.5 * self._index_lat + 0.5 * dt
-            self._last_index_use = _time.monotonic()
-            self.index_serves += 1
-            for t in names:
-                msg = self.get(t)
-                if msg is not None and not msg.expired():
-                    yield msg
+    def _req_iter(self, req: _LookupReq):
+        if req.names is _UNSET:
+            self._flush_pending()
+        if req.names is None:
+            yield from self._timed_trie(req.filt)
             return
-        self.trie_serves += 1
+        for t in req.names:
+            msg = self.get(t)
+            if msg is not None and not msg.expired():
+                yield msg
+
+    # ------------------------------------------------- hybrid arbitration
+
+    def _flush_pending(self) -> None:
+        """Serve every queued lookup in one arbitration decision: the
+        measured-faster path takes the batch; index-bounced filters
+        (None results) fall to the trie individually."""
+        reqs, self._pending = self._pending, []
+        if not reqs:
+            return
+        self._poll_probe()
+        n = len(reqs)
+        if self._pick_index():
+            t0 = time.monotonic()
+            res = self.index.lookup_batch([r.filt for r in reqs])
+            dt = max(time.monotonic() - t0, 1e-9)
+            self._note_index_rate(n / dt)
+            served = 0
+            for r, names in zip(reqs, res):
+                r.names = names
+                served += names is not None
+            self.index_serves += served
+            self.trie_serves += n - served
+            self._note_path("index")
+        else:
+            for r in reqs:
+                r.names = None
+            self.trie_serves += n
+            self._note_path("trie")
+            self._maybe_probe_index([r.filt for r in reqs])
+
+    def _pick_index(self) -> bool:
+        if self.index is None or len(self.index) == 0:
+            return False
+        if self.rate_index is None or self.rate_trie is None:
+            # measure the trie first; the probe measures the index
+            return False
+        if self.rate_index <= self.rate_trie:
+            return False
+        # index winning: refresh the trie estimate occasionally
+        if time.monotonic() - self._last_trie_meas > self.probe_interval:
+            return False
+        return True
+
+    def _note_path(self, path: str) -> None:
+        if self._last_path is not None and self._last_path != path:
+            self.path_flips += 1
+            tp("retained.flip", path=path,
+               rate_trie=self.rate_trie, rate_index=self.rate_index)
+        self._last_path = path
+
+    def _note_trie_rate(self, rps: float) -> None:
+        self.rate_trie = (
+            rps if self.rate_trie is None
+            else 0.5 * self.rate_trie + 0.5 * rps
+        )
+        self._last_trie_meas = time.monotonic()
+
+    def _note_index_rate(self, rps: float) -> None:
+        self.rate_index = (
+            rps if self.rate_index is None
+            else 0.5 * self.rate_index + 0.5 * rps
+        )
+        self._last_index_meas = time.monotonic()
+
+    def _timed_trie(self, filt: str):
+        """Trie walk with its in-iterator time accumulated, so the lazy
+        paced consumption pattern still yields an honest rate sample on
+        exhaustion (pauses between batches are not charged)."""
+        it = self._trie_iter(filt)
+        total = 0.0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                msg = next(it)
+            except StopIteration:
+                total += time.perf_counter() - t0
+                self._note_trie_rate(1.0 / max(total, 1e-9))
+                return
+            total += time.perf_counter() - t0
+            yield msg
+
+    def _maybe_probe_index(self, filters: List[str]) -> None:
+        """Keep the device index warm + its rate fresh while the trie
+        serves: dispatch this batch to the index (syncing any pending
+        churn); completion is polled on later lookups — the serving
+        path never waits on it."""
+        if self.index is None or self._probe is not None:
+            return
+        if len(self.index) == 0:
+            return
+        now = time.monotonic()
+        if (
+            self.rate_index is not None
+            and now - self._last_index_meas <= self.probe_interval
+        ):
+            return
+        probe = filters[: self.probe_cap]
+        try:
+            pend = self.index.lookup_submit(probe)
+        except Exception:  # pragma: no cover - probe must not break serving
+            import logging
+
+            logging.getLogger("emqx_tpu.retainer").exception(
+                "retained index probe"
+            )
+            return
+        self._probe = (pend, now, len(probe))
+        self.probe_count += 1
+        if _tps._active:
+            tp("retained.probe", phase="dispatch", n=len(probe))
+
+    def _poll_probe(self) -> None:
+        """Harvest a completed index probe (non-blocking)."""
+        p = self._probe
+        if p is None:
+            return
+        pend, t0, n = p
+        if not pend.is_ready():
+            return
+        try:
+            self.index.lookup_collect(pend)
+        except Exception:  # pragma: no cover
+            self._probe = None
+            return
+        # completion time is an upper bound (ready since some earlier
+        # lookup); lookups are frequent while serving, so the bias is
+        # small — the same estimate the publish engine's probes accept
+        dt = max(time.monotonic() - t0, 1e-9)
+        self._note_index_rate(n / dt)
+        tp("retained.probe", phase="complete", n=n, dt_ms=dt * 1e3,
+           rate_index=self.rate_index)
+        self._probe = None
+
+    # ------------------------------------------------------ trie serving
+
+    def _trie_iter(self, filt: str):
+        """The host trie walk (canonical truth).  Each node's children
+        are snapshotted when visited, so concurrent retain/delete
+        between batches is safe (same read-committed looseness as the
+        reference's continuations)."""
         fw = topiclib.words(filt)
         stack = [(self.root, 0, True)]
         while stack:
@@ -198,14 +375,6 @@ class Retainer:
                 if c is not None:
                     stack.append((c, i + 1, False))
 
-    def _index_ok(self) -> bool:
-        import time as _time
-
-        if self._index_lat <= self.index_lat_budget:
-            return True
-        # over budget: re-probe occasionally so a recovered link flips back
-        return _time.monotonic() - self._last_index_use > self.probe_interval
-
     def match_filter(self, filt: str) -> List[Message]:
         """All retained messages whose topic matches the filter."""
         return list(self.iter_filter(filt))
@@ -215,10 +384,12 @@ class Retainer:
         deduplicated by topic — the durable-log gap-recovery source
         (ds/manager.py): a session whose log window was GC'd away still
         converges to the last value of every retained topic it holds a
-        filter for."""
+        filter for.  All iterators are created up front, so with the
+        device index the whole filter set rides one batched dispatch."""
+        its = [self.iter_filter(f) for f in filters]
         seen = set()
-        for filt in filters:
-            for msg in self.iter_filter(filt):
+        for it in its:
+            for msg in it:
                 if msg.topic in seen:
                     continue
                 seen.add(msg.topic)

@@ -352,6 +352,19 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
             desc="index retained topic names in HBM: subscribe-time "
                  "wildcard fan-in becomes one device dispatch (host trie "
                  "remains canonical truth + verify oracle)"),
+        "probe_interval": Field(
+            "duration", 10.0,
+            desc="while one retained path (trie/device index) serves, "
+                 "re-measure the other at most this often; index probes "
+                 "double as device-mirror warm-keeping"),
+        "index_fanin_max": Field(
+            "int", 4096, min=1,
+            desc="retained filters matching more stored names than this "
+                 "are trie-served (output-proportional enumeration)"),
+        "index_max_shapes": Field(
+            "int", 64, min=1,
+            desc="wildcard shape registry cap of the retained device "
+                 "index; shapes past the cap are trie-served"),
         "flow_control_batch": Field(
             "int", 1000, min=1,
             desc="retained re-delivery batch size on subscribe"),

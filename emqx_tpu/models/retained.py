@@ -3,77 +3,429 @@
 The retainer's lookup direction is the PUBLISH path transposed: one
 wildcard filter against many stored concrete topic names
 (`emqx_retainer_mnesia.erl` walks a mnesia topic table per subscribe).
-Round-3 verdict item 9: this is the same match problem the engine solves
-on device, so spend the kernel surplus on it.
+The first cut of this index (round-3 verdict item 9) ran ONE masked-sum
+dispatch over ALL name rows per single unbatched lookup and downloaded a
+full [cap] hit mask — 9.1 lookups/s at 100k names on the tunneled rig
+(BENCH_TABLE.md), losing to the host trie outright.  This rebuild puts
+the index on the same compact-dispatch machinery that made the publish
+engine win:
 
-Design: stored names live in HBM as per-level hash-term rows (the same
-`HashSpace` terms the publish path uses, `ops/hashing.py`).  A lookup
-builds the FILTER's shape descriptor host-side (one inclusion row + the
-shape constant) and runs ONE masked-sum dispatch over all rows:
+* **Bucketed by masked hash.**  Stored names are keyed per *registered
+  wildcard shape*: a name's key under shape ``s`` is the masked
+  wrap-around sum of its per-level hash terms over ``s``'s included
+  levels plus the shape constant — the publish table's key arithmetic
+  (`ops/hashing.py`), transposed.  Every name matching a filter shares
+  the filter's key, so a lookup's candidate set is ONE equal-key run in
+  a (key -> name-row) array sorted by the lane-a key, found by a
+  device-side binary search — not a sum over every row.  The run is
+  gathered as a contiguous window, so the return is compact BY LAYOUT:
+  no on-device sort or top-k at all.  Shapes register lazily on first
+  lookup (one vectorized host pass + a re-sorted upload, amortized);
+  traffic typically carries tens of distinct shapes.
+* **Batched, packed probes.**  Lookups are batched (the retainer
+  aggregates concurrent subscribe-time lookups the way publish ticks
+  batch publishes): a batch ships as ONE ``[B, 8]`` u32 upload assembled
+  in a recycled per-bucket staging buffer, and returns a live-row-sliced
+  ``[B, k]`` candidate window plus u16-saturated per-filter run lengths.
+  ``k`` is adaptive: it shrinks toward the observed per-filter candidate
+  peak every `kcap_adapt_interval` batches and regrows on overflow; a
+  filter whose run exceeds the shipped ``k`` is refetched alone with a
+  widened ``k`` against the same arrays.
+* **Exact verification.**  Device hits are exact-verified host-side
+  against the stored name strings, so delivery correctness never
+  depends on hash luck — the publish engine's collision discipline.
+* **Honest fallbacks.**  Coarse shapes (no concrete level: ``#``, ``+``,
+  ``+/+`` ...) enumerate the store and are served by the retainer trie,
+  as are filters deeper than the hash space and filters whose fan-in
+  exceeds ``fanin_max`` (output-proportional work the trie does well).
+  `lookup_batch` returns ``None`` for those; the retainer's arbitration
+  (broker/retainer.py) measures both paths and serves from the faster,
+  probing the loser so recovery is automatic.
 
-    hit[n] = (sum_l terms_a[n,l] * incl[l]) + K_a == filter_key_a
-           & (lane b likewise) & length-window & ~($-root wildcard rule)
-
-— a [N, L] contraction, embarrassingly parallel, no trie walk.  Hits are
-exact-verified host-side against the stored name strings (the same
-two-lane-collision discipline as the publish engine), so delivery
-correctness never depends on hash luck.  Churn is slot-wise scatter,
-like the route tables; capacity doubles with full re-upload (rare).
+Churn: an insert appends (key, row) entries for every registered shape
+to a small unsorted tail — scanned host-side with vectorized numpy at
+collect time, so the device mirror stays untouched — that merges into
+the sorted main (one stable sort + re-upload) on overflow.  A delete
+tombstones the name row (``ln = -1``, one scatter slot) and parks it as
+a zombie until a compaction drops its entries, so row slots are never
+re-aliased under live entries.  Capacity doubles with full re-upload
+(rare).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
 from ..broker import topic as topiclib
+from ..observe.flight import FlightRecorder, LatencyHistogram
+from ..observe import tracepoints as _tps
+from ..observe.tracepoints import tp
 from ..ops import hashing
+from ..ops.match import next_pow2
+
+_U32 = 0xFFFFFFFF
+# sorted-main padding key; real keys are remapped off this value so a
+# pad can never extend a real run (see _fix_ka)
+_PAD_KA = 0xFFFFFFFF
 
 
-@functools.partial(__import__("jax").jit, static_argnames=())
-def _retained_match(ta, tb, ln, dl, incl, ka, kb, ta_t, tb_t,
-                    min_len, max_len, wild_root):
+def _fix_ka(ka):
+    """Keep real lane-a keys off the pad sentinel (scalar or array)."""
+    if isinstance(ka, np.ndarray):
+        return np.where(ka == _PAD_KA, np.uint32(_PAD_KA - 1), ka)
+    return ka if ka != _PAD_KA else _PAD_KA - 1
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("kcap",))
+def _retained_probe(eka, ekb, erow, ln, dl, q, *, kcap):
+    """Batched bucket probe: per query row, binary-search the equal-key
+    run in the sorted main, gather a ``kcap``-wide window of candidates,
+    and validity-check each (lane-b key, live row, length window, $-root
+    rule).  Returns (rows [B, kcap] i32 hit row ids -1-masked, counts
+    [B] u16 saturated run lengths).
+
+    ``counts`` is the CANDIDATE run length — an upper bound on hits;
+    counts > kcap means candidates beyond the window were never examined
+    and the host must refetch that filter with a widened kcap.  The
+    window is contiguous by construction (sorted runs), so no on-device
+    compaction is needed."""
+    import jax
     import jax.numpy as jnp
 
-    ha = (ta * incl[None, :]).sum(axis=-1, dtype=jnp.uint32) + ka
-    hb = (tb * incl[None, :]).sum(axis=-1, dtype=jnp.uint32) + kb
-    ok = (
-        (ha == ta_t)
-        & (hb == tb_t)
-        & (ln >= min_len)
-        & (ln <= max_len)
-        & (ln >= 0)  # occupied slot
-        & ~(dl & wild_root)
+    fka = q[:, 0]
+    fkb = q[:, 1]
+    min_len = jax.lax.bitcast_convert_type(q[:, 2], jnp.int32)
+    max_len = jax.lax.bitcast_convert_type(q[:, 3], jnp.int32)
+    flags = q[:, 4]
+    wild_root = (flags & 1) != 0
+    valid = (flags & 2) != 0
+    E = eka.shape[0]
+    lo = jnp.searchsorted(eka, fka, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(eka, fka, side="right").astype(jnp.int32)
+    run = hi - lo
+    idx = lo[:, None] + jnp.arange(kcap, dtype=jnp.int32)[None, :]
+    in_run = idx < hi[:, None]
+    idx_c = jnp.minimum(idx, E - 1)
+    cand_row = jnp.take(erow, idx_c)  # [B, k]
+    cand_kb = jnp.take(ekb, idx_c)
+    safe = jnp.where(cand_row >= 0, cand_row, 0)
+    rln = jnp.take(ln, safe)
+    rdl = jnp.take(dl, safe)
+    hit = (
+        in_run
+        & (cand_kb == fkb[:, None])
+        & (cand_row >= 0)
+        & (rln >= 0)  # tombstoned rows fail here
+        & (rln >= min_len[:, None])
+        & (rln <= max_len[:, None])
+        & ~(rdl & wild_root[:, None])
+        & valid[:, None]
     )
-    return ok
+    rows = jnp.where(hit, cand_row, -1)
+    counts = jnp.where(valid, jnp.minimum(run, 0xFFFF), 0).astype(
+        jnp.uint16
+    )
+    return rows, counts
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("rows",))
+def _slice_live(top, counts, *, rows: int):
+    """Fetch only the live query rows of the padded batch."""
+    return top[:rows], counts[:rows]
+
+
+def _round_up(n: int, g: int) -> int:
+    return ((n + g - 1) // g) * g
+
+
+_UNSET = object()
+
+
+class _RetainedPending:
+    """An in-flight retained lookup batch (see lookup_submit)."""
+
+    __slots__ = (
+        "filters", "fwords", "results", "dev_idx", "shapes", "qka", "qkb",
+        "tail", "top", "counts", "kcap", "n", "t0", "bytes_up",
+        "bytes_down", "buf", "bufkey", "resolved",
+    )
+
+    def __init__(self, filters, fwords, results, dev_idx):
+        self.filters = filters
+        self.fwords = fwords  # split words per filter (verify)
+        self.results = results  # per-filter: list | None (trie) | _UNSET
+        self.dev_idx = dev_idx  # positions routed to the device
+        self.shapes = None  # Shape per dev filter (refetch + tail checks)
+        self.qka = None  # u32 keys per dev filter
+        self.qkb = None
+        self.tail = None  # (tka, tkb, trow) snapshot at submit
+        self.top = None  # device [B, k] i32 (until resolved)
+        self.counts = None  # device [B] u16
+        self.kcap = 0
+        self.n = 0
+        self.t0 = None
+        self.bytes_up = 0
+        self.bytes_down = 0
+        self.buf = None
+        self.bufkey = None
+        self.resolved = False
+
+    def is_ready(self) -> bool:
+        out = self.top
+        if out is None:
+            return True
+        try:
+            return bool(out.is_ready())
+        except AttributeError:  # pragma: no cover - older jax
+            return True
 
 
 class RetainedDeviceIndex:
-    """HBM index of retained topic NAMES; lookup(filter) -> names."""
+    """HBM index of retained topic NAMES; batched lookup(filters) ->
+    per-filter name lists (None = host-trie fallback)."""
 
     def __init__(self, space: Optional[hashing.HashSpace] = None,
-                 device=None, cap: int = 1024):
+                 device=None, cap: int = 1024, tail_cap: int = 1024,
+                 max_shapes: int = 64, fanin_max: int = 4096):
         self.space = space or hashing.HashSpace()
         self.device = device
         L = self.space.max_levels
         self.cap = cap
+        # ---- name rows (host truth; ln/dl mirrored on device) ---------
         self.ta = np.zeros((cap, L), dtype=np.uint32)
         self.tb = np.zeros((cap, L), dtype=np.uint32)
-        self.ln = np.full(cap, -1, dtype=np.int32)  # -1 = empty slot
+        self.ln = np.full(cap, -1, dtype=np.int32)  # -1 = empty/tombstone
         self.dl = np.zeros(cap, dtype=bool)
         self._topics: List[Optional[str]] = [None] * cap
         self._slot_of: Dict[str, int] = {}
         self._free: List[int] = list(range(cap - 1, -1, -1))
-        self._dev = None  # (ta, tb, ln, dl) device arrays
-        self._dirty: Optional[set] = set()  # changed slots; None = rebuild
+        self._zombies: List[int] = []  # deleted rows awaiting compaction
+        # ---- shape registry (lazily registered on first lookup) -------
+        self.max_shapes = max_shapes
+        self.fanin_max = fanin_max
+        self._shapes: Dict[hashing.Shape, int] = {}
+        self._incl_mat = np.zeros((0, L), dtype=np.uint32)  # [S, L]
+        self._k_vec = np.zeros((0, 2), dtype=np.uint32)  # [S] (ka, kb)
+        self._plen_vec = np.zeros(0, dtype=np.int32)
+        self._hash_vec = np.zeros(0, dtype=bool)
+        self._wild_vec = np.zeros(0, dtype=bool)
+        self._reject: Set[hashing.Shape] = set()  # coarse/deep/over-cap
+        # ---- entry plane: sorted main + host-scanned unsorted tail ----
+        self._eka = np.full(16, _PAD_KA, dtype=np.uint32)
+        self._ekb = np.zeros(16, dtype=np.uint32)
+        self._erow = np.full(16, -1, dtype=np.int32)
+        self._e_n = 0
+        self.tail_cap = tail_cap
+        self._tka = np.zeros(tail_cap, dtype=np.uint32)
+        self._tkb = np.zeros(tail_cap, dtype=np.uint32)
+        self._trow = np.full(tail_cap, -1, dtype=np.int32)
+        self._t_n = 0
+        # ---- device mirror + dirtiness --------------------------------
+        self._dev = None  # (eka, ekb, erow, ln, dl)
+        self._dirty_rows: Optional[Set[int]] = None  # None = full upload
+        # ---- lookup batching / staging / adaptive kcap ----------------
+        self.min_batch = 16
+        self._staging: Dict[int, List[np.ndarray]] = {}
+        self._kcap_ceil = 4096
+        self._kcap_floor = 4
+        self._kcap_dyn = 8
+        self._kpeak = 0
+        self._kticks = 0
+        self.kcap_adapt_interval = 64
+        # ---- contract + telemetry -------------------------------------
         self.verify_matches = True
         self.collision_count = 0
-        self.lookups = 0
+        self.lookups = 0  # filters served by the device path
+        self.batches = 0  # dispatches
+        self.fallbacks = 0  # filters bounced to the trie (None results)
+        self.exact_hits = 0  # exact filters served from the host dict
+        self.refetches = 0
+        self.compactions = 0
+        self.merges = 0
+        self.shape_count = 0
+        self.shapes_rejected = 0
+        self.bytes_up_total = 0
+        self.bytes_down_total = 0
+        self.flight: Optional[FlightRecorder] = FlightRecorder(1024)
+        self.hist_lookup = LatencyHistogram()
 
     def __len__(self) -> int:
         return len(self._slot_of)
+
+    @property
+    def entry_count(self) -> int:
+        return self._e_n + self._t_n
+
+    # ----------------------------------------------------------- keying
+
+    def _row_keys(self, terms: np.ndarray, ln: int, dollar: bool):
+        """(ka, kb) of one name row under every registered shape it is
+        length-compatible with (vectorized over shapes)."""
+        if not self._shapes:
+            return None
+        compat = np.where(
+            self._hash_vec, ln >= self._plen_vec, ln == self._plen_vec
+        )
+        if dollar:
+            compat &= ~self._wild_vec
+        if not compat.any():
+            return None
+        im = self._incl_mat[compat]
+        ka = (im * terms[0][None, :]).sum(axis=1, dtype=np.uint32)
+        kb = (im * terms[1][None, :]).sum(axis=1, dtype=np.uint32)
+        kv = self._k_vec[compat]
+        return _fix_ka(ka + kv[:, 0]), kb + kv[:, 1]
+
+    def _filter_key(self, fw: Sequence[str], shape: hashing.Shape):
+        """(ka, kb) of a filter — the same arithmetic as _row_keys (the
+        publish path's filter_key WITHOUT its (0,0) sentinel fixup: the
+        retained entry plane has no empty-slot sentinel to avoid)."""
+        sp = self.space
+        ka, kb = sp.shape_const(shape)
+        for l in range(shape.plen):
+            if not (shape.plus_mask >> l & 1):
+                a, b = sp.word_lanes(fw[l])
+                ka = (ka + sp._term(0, a, l)) & _U32
+                kb = (kb + sp._term(1, b, l)) & _U32
+        return _fix_ka(ka), kb
+
+    # ----------------------------------------------------------- shapes
+
+    @staticmethod
+    def _coarse(shape: hashing.Shape) -> bool:
+        """No concrete included level: the filter matches a whole length
+        class (``#``, ``+``, ``+/+`` ...) — enumeration work the trie
+        does output-proportionally; keying it would put every name in
+        one giant run."""
+        incl = ((1 << shape.plen) - 1) & ~shape.plus_mask
+        return incl == 0
+
+    def _shape_id(self, shape: hashing.Shape) -> Optional[int]:
+        """Registered shape id, registering on first sight; None = this
+        shape is trie-served (coarse / deeper than the hash space / over
+        the registry cap)."""
+        sid = self._shapes.get(shape)
+        if sid is not None:
+            return sid
+        if shape in self._reject:
+            return None
+        if (
+            self._coarse(shape)
+            or shape.plen > self.space.max_levels
+            or len(self._shapes) >= self.max_shapes
+        ):
+            if len(self._reject) < 4096:
+                self._reject.add(shape)
+            self.shapes_rejected += 1
+            tp("retained.shape", event="reject", plen=shape.plen,
+               plus_mask=shape.plus_mask, has_hash=shape.has_hash)
+            return None
+        return self._register_shape(shape)
+
+    def _register_shape(self, shape: hashing.Shape) -> int:
+        """Key every live compatible name under the new shape and merge
+        the entries into the sorted main (one vectorized pass + one
+        sort) — the lazy-registration cost a shape pays once."""
+        t0 = time.monotonic()
+        sid = len(self._shapes)
+        self._shapes[shape] = sid
+        L = self.space.max_levels
+        incl = np.zeros(L, dtype=np.uint32)
+        for l in range(min(shape.plen, L)):
+            if not (shape.plus_mask >> l & 1):
+                incl[l] = 1
+        ka_c, kb_c = self.space.shape_const(shape)
+        self._incl_mat = np.vstack([self._incl_mat, incl[None, :]])
+        self._k_vec = np.vstack([
+            self._k_vec,
+            np.array([[ka_c, kb_c]], dtype=np.uint32),
+        ])
+        self._plen_vec = np.append(self._plen_vec, np.int32(shape.plen))
+        self._hash_vec = np.append(self._hash_vec, shape.has_hash)
+        self._wild_vec = np.append(self._wild_vec, shape.wild_root)
+        self.shape_count = len(self._shapes)
+        # vectorized keys for all live compatible rows
+        occ = np.flatnonzero(self.ln >= 0)
+        if occ.size:
+            lns = self.ln[occ]
+            compat = (lns >= shape.plen) if shape.has_hash else (
+                lns == shape.plen
+            )
+            if shape.wild_root:
+                compat &= ~self.dl[occ]
+            rows = occ[compat]
+            if rows.size:
+                ka = (self.ta[rows] * incl[None, :]).sum(
+                    axis=1, dtype=np.uint32
+                ) + np.uint32(ka_c)
+                kb = (self.tb[rows] * incl[None, :]).sum(
+                    axis=1, dtype=np.uint32
+                ) + np.uint32(kb_c)
+                self._merge_entries(_fix_ka(ka), kb, rows.astype(np.int32))
+        tp("retained.shape", event="register", plen=shape.plen,
+           plus_mask=shape.plus_mask, has_hash=shape.has_hash,
+           entries=self.entry_count, dt_ms=(time.monotonic() - t0) * 1e3)
+        return sid
+
+    # ---------------------------------------------------- entry plane
+
+    def _merge_entries(self, ka, kb, rows) -> None:
+        """Merge new entries AND the tail into the sorted main (one
+        stable sort), dropping entries of tombstoned rows on the way."""
+        parts_ka = [self._eka[: self._e_n], self._tka[: self._t_n]]
+        parts_kb = [self._ekb[: self._e_n], self._tkb[: self._t_n]]
+        parts_row = [self._erow[: self._e_n], self._trow[: self._t_n]]
+        if ka is not None and len(ka):
+            parts_ka.append(ka)
+            parts_kb.append(kb)
+            parts_row.append(rows)
+        aka = np.concatenate(parts_ka)
+        akb = np.concatenate(parts_kb)
+        arow = np.concatenate(parts_row)
+        live = self.ln[arow] >= 0
+        aka, akb, arow = aka[live], akb[live], arow[live]
+        order = np.argsort(aka, kind="stable")
+        n = len(order)
+        ecap = max(16, next_pow2(n))
+        self._eka = np.full(ecap, _PAD_KA, dtype=np.uint32)
+        self._ekb = np.zeros(ecap, dtype=np.uint32)
+        self._erow = np.full(ecap, -1, dtype=np.int32)
+        self._eka[:n] = aka[order]
+        self._ekb[:n] = akb[order]
+        self._erow[:n] = arow[order]
+        self._e_n = n
+        self._t_n = 0
+        self._dirty_rows = None  # full re-upload
+        self.merges += 1
+        if _tps._active:
+            tp("retained.merge", event="merge", entries=n)
+
+    def _tail_append(self, ka, kb, rows) -> None:
+        k = len(ka)
+        if self._t_n + k > self.tail_cap:
+            self._merge_entries(ka, kb, rows)
+            return
+        s = self._t_n
+        self._tka[s:s + k] = ka
+        self._tkb[s:s + k] = kb
+        self._trow[s:s + k] = rows
+        self._t_n += k
+
+    def _compact(self) -> None:
+        """Drop tombstoned rows' entries and recycle their slots."""
+        self._merge_entries(None, None, None)  # live-filter + re-sort
+        for slot in self._zombies:
+            self.ta[slot] = 0
+            self.tb[slot] = 0
+            self.dl[slot] = False
+            self._free.append(slot)
+        self._zombies.clear()
+        self.compactions += 1
+        tp("retained.merge", event="compact", entries=self._e_n)
 
     # ----------------------------------------------------------- mutation
 
@@ -81,34 +433,93 @@ class RetainedDeviceIndex:
         if topic in self._slot_of:
             return
         if not self._free:
-            self._grow()
+            if self._zombies:
+                self._compact()
+            if not self._free:
+                self._grow()
         slot = self._free.pop()
         ws = topiclib.words(topic)
         terms = self.space.topic_terms(ws)
         self.ta[slot] = terms[0]
         self.tb[slot] = terms[1]
-        # depth beyond the level cap can't be hashed: deep names are
-        # marked with length > any filter's max plen, so device lookups
-        # never hit them; the retainer's trie remains their (tiny) path
         self.ln[slot] = len(ws)
         self.dl[slot] = bool(ws) and ws[0].startswith("$")
         self._topics[slot] = topic
         self._slot_of[topic] = slot
-        if self._dirty is not None:
-            self._dirty.add(slot)
+        if self._dirty_rows is not None:
+            self._dirty_rows.add(slot)
+        keys = self._row_keys(terms, len(ws), bool(self.dl[slot]))
+        if keys is not None:
+            ka, kb = keys
+            self._tail_append(
+                ka, kb, np.full(len(ka), slot, dtype=np.int32)
+            )
+
+    def insert_many(self, topics: Sequence[str]) -> None:
+        """Bulk insert (restore/bench): native batch hashing + one
+        vectorized key pass per shape + one merge."""
+        fresh = [t for t in dict.fromkeys(topics) if t not in self._slot_of]
+        if not fresh:
+            return
+        while len(self._free) < len(fresh):
+            if self._zombies:
+                self._compact()
+            if len(self._free) < len(fresh):
+                self._grow()
+        # ln is the TRUE level count (deeper than L still matches '#'
+        # shapes); only the term rows are depth-capped
+        ta, tb, ln, dl = hashing.hash_topics(self.space, fresh)
+        slots = np.empty(len(fresh), dtype=np.int32)
+        for i, t in enumerate(fresh):
+            slot = self._free.pop()
+            slots[i] = slot
+            self._topics[slot] = t
+            self._slot_of[t] = slot
+        self.ta[slots] = ta
+        self.tb[slots] = tb
+        self.ln[slots] = ln
+        self.dl[slots] = dl
+        if self._dirty_rows is not None:
+            self._dirty_rows.update(slots.tolist())
+        if self._shapes:
+            kas, kbs, rows = [], [], []
+            for s in range(len(self._plen_vec)):
+                compat = (
+                    ln >= self._plen_vec[s] if self._hash_vec[s]
+                    else ln == self._plen_vec[s]
+                )
+                if self._wild_vec[s]:
+                    compat = compat & ~dl
+                if not compat.any():
+                    continue
+                incl = self._incl_mat[s]
+                kas.append(_fix_ka(
+                    (ta[compat] * incl[None, :]).sum(1, dtype=np.uint32)
+                    + self._k_vec[s, 0]
+                ))
+                kbs.append(
+                    (tb[compat] * incl[None, :]).sum(1, dtype=np.uint32)
+                    + self._k_vec[s, 1]
+                )
+                rows.append(slots[compat])
+            if kas:
+                self._merge_entries(
+                    np.concatenate(kas), np.concatenate(kbs),
+                    np.concatenate(rows),
+                )
 
     def delete(self, topic: str) -> None:
         slot = self._slot_of.pop(topic, None)
         if slot is None:
             return
-        self.ln[slot] = -1
-        self.ta[slot] = 0
-        self.tb[slot] = 0
-        self.dl[slot] = False
+        self.ln[slot] = -1  # tombstone: kills every entry of this row
         self._topics[slot] = None
-        self._free.append(slot)
-        if self._dirty is not None:
-            self._dirty.add(slot)
+        self._zombies.append(slot)
+        if self._dirty_rows is not None:
+            self._dirty_rows.add(slot)
+        if len(self._zombies) > max(self.tail_cap,
+                                    len(self._slot_of) // 2):
+            self._compact()
 
     def _grow(self) -> None:
         old = self.cap
@@ -122,29 +533,53 @@ class RetainedDeviceIndex:
             setattr(self, name, new)
         self._topics.extend([None] * (self.cap - old))
         self._free.extend(range(self.cap - 1, old - 1, -1))
-        self._dirty = None  # shapes changed: full re-upload
+        self._dirty_rows = None  # shapes changed: full re-upload
 
     # --------------------------------------------------------- checkpoint
 
     def export_state(self):
-        """(named arrays, meta) for the checkpoint store: term rows plus
-        the packed name list (slot-aligned), copied at capture time."""
+        """(named arrays, meta) for the checkpoint store: name rows, the
+        packed name list, the entry plane (tail and zombies folded into
+        a clean sorted main first) and the shape registry — restored
+        wholesale, no re-keying."""
         from ..checkpoint.store import pack_str_list
 
+        if self._zombies:
+            self._compact()
+        elif self._t_n:
+            self._merge_entries(None, None, None)
         slots = sorted(self._slot_of.values())
         names = [self._topics[s] for s in slots]
         buf, offs = pack_str_list(names)
+        sh_sorted = sorted(self._shapes.items(), key=lambda kv: kv[1])
         arrays = {
             "ta": self.ta.copy(), "tb": self.tb.copy(),
             "ln": self.ln.copy(), "dl": self.dl.copy(),
             "slots": np.asarray(slots, dtype=np.int64),
             "buf": buf, "offs": offs,
+            "eka": self._eka[: self._e_n].copy(),
+            "ekb": self._ekb[: self._e_n].copy(),
+            "erow": self._erow[: self._e_n].copy(),
+            "sh_plen": np.asarray(
+                [s.plen for s, _ in sh_sorted], dtype=np.int32
+            ),
+            "sh_mask": np.asarray(
+                [s.plus_mask for s, _ in sh_sorted], dtype=np.uint32
+            ),
+            "sh_hash": np.asarray(
+                [s.has_hash for s, _ in sh_sorted], dtype=bool
+            ),
         }
-        return arrays, {"cap": self.cap, "max_levels": self.space.max_levels}
+        return arrays, {
+            "cap": self.cap, "max_levels": self.space.max_levels,
+            "layout": 2, "e_n": self._e_n,
+        }
 
     def from_state(self, arrays, meta) -> int:
-        """Adopt a snapshot wholesale (no re-hashing); the device copy
-        is marked for a full re-upload on the next lookup."""
+        """Adopt a snapshot wholesale; the device mirror is marked for a
+        full re-upload on the next lookup.  Layout-1 snapshots (the
+        pre-bucketed masked-sum index) carry no entry plane — their name
+        rows are adopted and shapes re-register lazily."""
         from ..checkpoint.store import unpack_str_list
 
         if int(meta["max_levels"]) != self.space.max_levels:
@@ -165,8 +600,57 @@ class RetainedDeviceIndex:
         self._free = [
             i for i in range(self.cap - 1, -1, -1) if i not in occupied
         ]
+        self._zombies = []
+        L = self.space.max_levels
+        self._shapes = {}
+        self._incl_mat = np.zeros((0, L), dtype=np.uint32)
+        self._k_vec = np.zeros((0, 2), dtype=np.uint32)
+        self._plen_vec = np.zeros(0, dtype=np.int32)
+        self._hash_vec = np.zeros(0, dtype=bool)
+        self._wild_vec = np.zeros(0, dtype=bool)
+        self._reject = set()
+        self._t_n = 0
+        self._e_n = 0
+        self._eka = np.full(16, _PAD_KA, dtype=np.uint32)
+        self._ekb = np.zeros(16, dtype=np.uint32)
+        self._erow = np.full(16, -1, dtype=np.int32)
+        if int(meta.get("layout", 1)) >= 2:
+            n = int(meta["e_n"])
+            ecap = max(16, next_pow2(max(n, 1)))
+            self._eka = np.full(ecap, _PAD_KA, dtype=np.uint32)
+            self._ekb = np.zeros(ecap, dtype=np.uint32)
+            self._erow = np.full(ecap, -1, dtype=np.int32)
+            self._eka[:n] = arrays["eka"]
+            self._ekb[:n] = arrays["ekb"]
+            self._erow[:n] = arrays["erow"]
+            self._e_n = n
+            for plen, mask, hh in zip(
+                arrays["sh_plen"].tolist(), arrays["sh_mask"].tolist(),
+                arrays["sh_hash"].tolist(),
+            ):
+                shape = hashing.Shape(
+                    plen=int(plen), plus_mask=int(mask), has_hash=bool(hh)
+                )
+                sid = len(self._shapes)
+                self._shapes[shape] = sid
+                incl = np.zeros(L, dtype=np.uint32)
+                for l in range(min(shape.plen, L)):
+                    if not (shape.plus_mask >> l & 1):
+                        incl[l] = 1
+                ka_c, kb_c = self.space.shape_const(shape)
+                self._incl_mat = np.vstack([self._incl_mat, incl[None, :]])
+                self._k_vec = np.vstack([
+                    self._k_vec,
+                    np.array([[ka_c, kb_c]], dtype=np.uint32),
+                ])
+                self._plen_vec = np.append(
+                    self._plen_vec, np.int32(shape.plen)
+                )
+                self._hash_vec = np.append(self._hash_vec, shape.has_hash)
+                self._wild_vec = np.append(self._wild_vec, shape.wild_root)
+        self.shape_count = len(self._shapes)
         self._dev = None
-        self._dirty = None  # full re-upload
+        self._dirty_rows = None  # full re-upload
         return len(names)
 
     # --------------------------------------------------------------- sync
@@ -174,63 +658,272 @@ class RetainedDeviceIndex:
     def _sync(self):
         import jax
 
-        if self._dev is None or self._dirty is None:
-            put = lambda a: jax.device_put(a.copy(), self.device)
-            self._dev = (put(self.ta), put(self.tb),
-                         put(self.ln), put(self.dl))
-            self._dirty = set()
-        elif self._dirty:
-            import jax.numpy as jnp
-
-            slots = np.fromiter(self._dirty, dtype=np.int32,
-                                count=len(self._dirty))
-            ta, tb, ln, dl = self._dev
+        put = lambda a: jax.device_put(a.copy(), self.device)
+        if self._dev is None or self._dirty_rows is None:
+            self._dev = (
+                put(self._eka), put(self._ekb), put(self._erow),
+                put(self.ln), put(self.dl),
+            )
+            self._dirty_rows = set()
+            return self._dev
+        if self._dirty_rows:
+            slots = np.fromiter(self._dirty_rows, dtype=np.int32,
+                                count=len(self._dirty_rows))
+            eka, ekb, erow, ln, dl = self._dev
             js = jax.device_put(slots, self.device)
             self._dev = (
-                ta.at[js].set(jax.device_put(self.ta[slots], self.device)),
-                tb.at[js].set(jax.device_put(self.tb[slots], self.device)),
+                eka, ekb, erow,
                 ln.at[js].set(jax.device_put(self.ln[slots], self.device)),
                 dl.at[js].set(jax.device_put(self.dl[slots], self.device)),
             )
-            self._dirty = set()
+            self._dirty_rows = set()
         return self._dev
 
     # ------------------------------------------------------------- lookup
 
-    def lookup(self, filt: str) -> List[str]:
-        """Stored names matching the filter — ONE device dispatch over
-        all rows, exact-verified host-side."""
-        if not self._slot_of:
-            return []
-        fw = topiclib.words(filt)
-        shape = self.space.shape_of(fw)
-        if shape.plen > self.space.max_levels:
-            # deeper than the hash space: host fallback over the (small)
-            # name list — same escape hatch as the engine's deep filters
-            return [t for t in self._slot_of
-                    if topiclib.match_words(topiclib.words(t), fw)]
-        ha, hb, _ = self.space.filter_key(fw)
-        ka, kb = self.space.shape_const(shape)
+    def _acquire_staging(self, B: int) -> np.ndarray:
+        pool = self._staging.get(B)
+        if pool:
+            return pool.pop()
+        return np.zeros((B, 8), dtype=np.uint32)
+
+    def _release_staging(self, buf: Optional[np.ndarray],
+                         key: Optional[int]) -> None:
+        if buf is None or key is None:
+            return
+        pool = self._staging.setdefault(key, [])
+        if len(pool) <= 4:
+            pool.append(buf)
+
+    def _note_kmax(self, maxc: int) -> None:
+        """Adaptive kcap: track the per-batch candidate peak; shrink the
+        window toward it every kcap_adapt_interval batches (regrown on
+        overflow by _refetch)."""
+        if maxc > self._kpeak:
+            self._kpeak = maxc
+        self._kticks += 1
+        if self._kticks >= self.kcap_adapt_interval:
+            tgt = min(
+                self._kcap_ceil,
+                max(self._kcap_floor, next_pow2(max(1, 2 * self._kpeak))),
+            )
+            if tgt < self._kcap_dyn:
+                self._kcap_dyn = tgt
+                tp("retained.kcap", kcap=tgt, peak=self._kpeak)
+            self._kpeak = 0
+            self._kticks = 0
+
+    def _pack_query(self, shapes, qka, qkb, buf, n: int) -> None:
+        """Write (ka, kb, min_len, max_len, flags) query rows into the
+        recycled staging buffer; rows past n are marked invalid."""
         L = self.space.max_levels
-        incl = np.zeros(L, dtype=np.uint32)
-        for l in range(min(shape.plen, L)):
-            if not (shape.plus_mask >> l & 1):
-                incl[l] = 1
-        ta, tb, ln, dl = self._sync()
+        i32max = np.iinfo(np.int32).max
+        buf[:n, 0] = qka
+        buf[:n, 1] = qkb
+        for j, shape in enumerate(shapes):
+            buf[j, 2] = np.uint32(np.int32(shape.min_len()))
+            buf[j, 3] = np.uint32(np.int32(min(shape.max_len(L), i32max)))
+            buf[j, 4] = (1 if shape.wild_root else 0) | 2
+        if n < buf.shape[0]:
+            buf[n:, 4] = 0  # valid=0: padded rows count 0, hit nothing
+
+    def lookup_submit(self, filters: Sequence[str]) -> _RetainedPending:
+        """Route + dispatch a lookup batch WITHOUT blocking on results.
+
+        Per filter: exact names answer from the host dict; coarse/deep/
+        over-cap shapes get None (trie serves); everything else rides
+        ONE packed [B, 8] u32 upload into the bucket-probe kernel, with
+        the device->host copy started at submit."""
         import jax
 
-        put = lambda a: jax.device_put(a, self.device)
-        ok = np.asarray(_retained_match(
-            ta, tb, ln, dl, put(incl),
-            np.uint32(ka), np.uint32(kb),  # filter_key includes K
-            np.uint32(ha), np.uint32(hb),
-            np.int32(shape.min_len()),
-            np.int32(min(shape.max_len(L), np.iinfo(np.int32).max)),
-            np.bool_(shape.wild_root),
-        ))
-        self.lookups += 1
+        t0 = time.monotonic()
+        filters = list(filters)
+        fwords = [topiclib.words(f) for f in filters]
+        results: List = [_UNSET] * len(filters)
+        dev_idx: List[int] = []
+        dev_shapes: List[hashing.Shape] = []
+        dev_ka: List[int] = []
+        dev_kb: List[int] = []
+        for i, fw in enumerate(fwords):
+            shape = self.space.shape_of(fw)
+            if shape.plus_mask == 0 and not shape.has_hash:
+                # exact name: one dict hit, no dispatch
+                self.exact_hits += 1
+                results[i] = (
+                    [filters[i]] if filters[i] in self._slot_of else []
+                )
+                continue
+            if self._shape_id(shape) is None:
+                results[i] = None  # trie serves
+                self.fallbacks += 1
+                continue
+            fka, fkb = self._filter_key(fw, shape)
+            dev_idx.append(i)
+            dev_shapes.append(shape)
+            dev_ka.append(fka)
+            dev_kb.append(fkb)
+        p = _RetainedPending(filters, fwords, results, dev_idx)
+        p.t0 = t0
+        if not dev_idx or not self._slot_of:
+            for i in dev_idx:
+                results[i] = []
+            p.resolved = True
+            return p
+        p.shapes = dev_shapes
+        p.qka = np.asarray(dev_ka, dtype=np.uint32)
+        p.qkb = np.asarray(dev_kb, dtype=np.uint32)
+        if self._t_n:
+            t = self._t_n
+            p.tail = (self._tka[:t].copy(), self._tkb[:t].copy(),
+                      self._trow[:t].copy())
+        dev = self._sync()
+        n = len(dev_idx)
+        B = max(self.min_batch, next_pow2(n))
+        buf = self._acquire_staging(B)
+        self._pack_query(dev_shapes, p.qka, p.qkb, buf, n)
+        q = jax.device_put(buf, self.device)
+        kc = self._kcap_dyn
+        top, counts = _retained_probe(*dev, q, kcap=kc)
+        # live-row slicing: fetch only the (rounded) real query rows
+        rows = min(B, _round_up(n, max(self.min_batch, B // 8)))
+        if rows < B and B - rows >= B // 4:
+            top, counts = _slice_live(top, counts, rows=rows)
+        try:  # start the device->host copy NOW; resolve overlaps it
+            top.copy_to_host_async()
+            counts.copy_to_host_async()
+        except AttributeError:  # pragma: no cover - older jax
+            pass
+        p.top, p.counts = top, counts
+        p.kcap = kc
+        p.n = n
+        p.buf, p.bufkey = buf, B
+        p.bytes_up = buf.nbytes
+        return p
+
+    def _refetch(self, pending: _RetainedPending, over_pos, counts):
+        """Per-filter candidate overflow: re-probe ONLY the overflowing
+        filters with kcap widened to the observed run peak (next pow2,
+        bounded by fanin_max — longer runs are trie-served)."""
+        import jax
+
+        dev = self._sync()
+        maxc = int(counts[over_pos].max())
+        k2 = next_pow2(min(max(maxc, pending.kcap + 1), self.fanin_max))
+        shapes2 = [pending.shapes[j] for j in over_pos]
+        n2 = len(over_pos)
+        B2 = max(self.min_batch, next_pow2(n2))
+        buf2 = self._acquire_staging(B2)
+        self._pack_query(shapes2, pending.qka[over_pos],
+                         pending.qkb[over_pos], buf2, n2)
+        q2 = jax.device_put(buf2, self.device)
+        top2, counts2 = _retained_probe(*dev, q2, kcap=k2)
+        pending.bytes_up += buf2.nbytes
+        out_top = np.asarray(top2)[:n2]
+        out_counts = np.asarray(counts2)[:n2].astype(np.int32)
+        pending.bytes_down += int(top2.nbytes) + int(counts2.nbytes)
+        self._release_staging(buf2, B2)
+        self.refetches += 1
+        # regrow the steady-state window toward the observed demand
+        self._kcap_dyn = min(max(self._kcap_dyn, k2), self._kcap_ceil)
+        return out_top, out_counts
+
+    def lookup_collect(
+        self, pending: _RetainedPending
+    ) -> List[Optional[List[str]]]:
+        """Block on a submitted batch: fetch the candidate window,
+        refetch run overflows with a widened kcap, merge host-scanned
+        tail hits, exact-verify host-side, and return per-filter name
+        lists (None = the caller's trie serves that filter)."""
+        results = pending.results
+        if pending.resolved:
+            return results
+        top = np.asarray(pending.top)[: pending.n]
+        counts = np.asarray(pending.counts)[: pending.n].astype(np.int32)
+        pending.bytes_down += int(pending.top.nbytes) + int(
+            pending.counts.nbytes
+        )
+        pending.top = pending.counts = None
+        buf, key = pending.buf, pending.bufkey
+        pending.buf = None
+        self._release_staging(buf, key)
+        self._note_kmax(int(counts.max(initial=0)))
+        # tail hits (host-scanned: the unsorted tail never ships)
+        tails: Dict[int, np.ndarray] = {}
+        if pending.tail is not None:
+            tka, tkb, trow = pending.tail
+            m = (tka[None, :] == pending.qka[:, None]) & (
+                tkb[None, :] == pending.qkb[:, None]
+            )
+            for j in np.nonzero(m.any(axis=1))[0].tolist():
+                tails[j] = trow[m[j]]
+        k = top.shape[1]
+        over = counts > k
+        huge = counts > self.fanin_max
+        if huge.any():
+            for j in np.nonzero(huge)[0].tolist():
+                results[pending.dev_idx[j]] = None  # fan-in: trie serves
+                self.fallbacks += 1
+            over &= ~huge
+        if over.any():
+            over_pos = np.nonzero(over)[0]
+            top2, _counts2 = self._refetch(pending, over_pos, counts)
+            for jj, j in enumerate(over_pos.tolist()):
+                self._finish_one(pending, j, top2[jj], tails.get(j))
+        for j in range(pending.n):
+            i = pending.dev_idx[j]
+            if results[i] is _UNSET:
+                self._finish_one(pending, j, top[j], tails.get(j))
+        pending.resolved = True
+        self.lookups += pending.n
+        self.batches += 1
+        self.bytes_up_total += pending.bytes_up
+        self.bytes_down_total += pending.bytes_down
+        lat = max(time.monotonic() - (pending.t0 or time.monotonic()), 0.0)
+        self.hist_lookup.observe(lat)
+        fl = self.flight
+        if fl is not None:
+            from ..observe.flight import PATH_DEVICE, R_FORCED
+
+            fl.record(
+                n_topics=len(pending.filters), n_unique=pending.n,
+                path=PATH_DEVICE, reason=R_FORCED,
+                rate_host=None, rate_dev=None,
+                bytes_up=pending.bytes_up, bytes_down=pending.bytes_down,
+                verify_fail=0, churn_slots=0,
+                lat_s=lat, churn_lag_s=0.0,
+            )
+        if _tps._active:
+            tp("retained.lookup", n=len(pending.filters),
+               dev=pending.n, lat_ms=lat * 1e3,
+               bytes_up=pending.bytes_up, bytes_down=pending.bytes_down)
+        return results
+
+    def _finish_one(self, pending: _RetainedPending, j: int, rows,
+                    tail_rows) -> None:
+        """Merge one filter's device window + tail candidates, dedupe,
+        and exact-verify against the stored name strings; collisions are
+        counted and discarded."""
+        i = pending.dev_idx[j]
+        fw = pending.fwords[i]
+        shape = pending.shapes[j]
+        cands = rows[rows >= 0]
+        if tail_rows is not None:
+            # the host-scanned tail skipped the kernel validity checks
+            lns = self.ln[tail_rows]
+            ok = (
+                (lns >= 0)
+                & (lns >= shape.min_len())
+                & (lns <= shape.max_len(self.space.max_levels))
+            )
+            if shape.wild_root:
+                ok &= ~self.dl[tail_rows]
+            cands = np.concatenate([cands, tail_rows[ok]])
         out: List[str] = []
-        for slot in np.nonzero(ok)[0].tolist():
+        seen: Set[int] = set()
+        for slot in cands.tolist():
+            if slot in seen:  # cross-shape key-collision duplicates
+                continue
+            seen.add(slot)
             t = self._topics[slot]
             if t is None:  # raced delete between sync and fetch
                 continue
@@ -240,4 +933,17 @@ class RetainedDeviceIndex:
                 self.collision_count += 1
                 continue
             out.append(t)
-        return out
+        pending.results[i] = out
+
+    def lookup_batch(
+        self, filters: Sequence[str]
+    ) -> List[Optional[List[str]]]:
+        """Batched lookup: per-filter stored-name lists; None marks a
+        filter the host trie should serve (coarse shape, over-cap
+        registry, fan-in past fanin_max, deep filter)."""
+        return self.lookup_collect(self.lookup_submit(filters))
+
+    def lookup(self, filt: str) -> Optional[List[str]]:
+        """Single-filter convenience over lookup_batch (same None
+        contract); prefer batching concurrent lookups."""
+        return self.lookup_batch([filt])[0]

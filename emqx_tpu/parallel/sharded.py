@@ -463,11 +463,16 @@ class ShardedMatchEngine:
         # per-tick topic hash memo (ROADMAP item 3): Zipf production
         # traffic repeats hot names across ticks, and prep re-pays the
         # native split+hash for every repeat — memoize (terms, len,
-        # dollar) rows keyed by topic string, reset wholesale at
-        # `topic_memo_cap` entries.  Purely a cache of a pure function
-        # of (topic, space): never invalidated by churn.
+        # dollar) rows keyed by topic string.  Two generations (second
+        # chance): at half-cap the live memo becomes the old generation
+        # and the previous old generation is dropped; an old-generation
+        # hit promotes its row back into the live memo, so the Zipf
+        # head survives eviction while the cold tail ages out.  Purely
+        # a cache of a pure function of (topic, space): never
+        # invalidated by churn.
         self.topic_memo_cap = 1 << 16
         self._memo: Dict[str, int] = {}
+        self._memo_old: Dict[str, int] = {}
         L = self.space.max_levels
         self._memo_ta = np.empty((1024, L), dtype=np.uint32)
         self._memo_tb = np.empty((1024, L), dtype=np.uint32)
@@ -485,6 +490,31 @@ class ShardedMatchEngine:
         # which requires draining the window first — see match_submit.
         self.pipeline_depth = 4
         self._inflight: List["_ShardedPending"] = []
+        # adaptive window clamp: depth N must never underperform depth 1
+        # (BENCH_TABLE mesh w5/w3 regression).  Two signals drive the
+        # EFFECTIVE window: (1) churn-fused ticks drain the window at
+        # submit, so when (nearly) every tick fuses churn the window
+        # never fills and deep submits only add bookkeeping — an EWMA of
+        # the drain fraction clamps to 1 past `drain_clamp`; (2) a
+        # measured A/B cost controller (median submit-to-submit interval
+        # per mode; deep serves only when it measures a real win past
+        # `depth_margin` — real hardware's overlap win clears it, a
+        # serialized host's bookkeeping overhead never does) re-probes
+        # the losing mode every `depth_probe_interval` ticks.
+        self._eff_depth = self.pipeline_depth
+        self.drain_clamp = 0.5  # churn-drain EWMA above this -> eff 1
+        self._drain_ewma = 0.0
+        self.depth_probe_interval = 128  # ticks between loser re-probes
+        self.depth_probe_len = 6  # submit-interval samples per verdict
+        self.depth_margin = 0.05  # deep must win by this to serve
+        self.depth_win_streak = 2  # consecutive winning verdicts needed
+        self._dw_streak = 0
+        self._dw_deep = True  # current A/B mode (deep = configured)
+        self._dw_last: Optional[float] = None  # prior submit timestamp
+        self._dw_samples: List[float] = []
+        self._dw_cost: Dict[bool, Optional[float]] = {True: None,
+                                                      False: None}
+        self._dw_age: Dict[bool, int] = {True: 0, False: 0}
         # per-(B, L) reusable host staging buffers for the packed topic
         # batch (the pinned-staging analog: one np buffer per in-flight
         # tick per bucket, recycled at resolve so pipelined ticks never
@@ -1205,6 +1235,25 @@ class ShardedMatchEngine:
             new[: len(old)] = old
             setattr(self, name, new)
 
+    def _memo_swap(self) -> None:
+        """Second-chance generation swap: the live memo becomes the old
+        generation — its rows compacted to the front of the storage
+        arrays — and the previous old generation (entries unseen for a
+        full generation) is dropped.  Hot topics get promoted back into
+        the live memo on their next hit (`_hash_topics_memo`), so
+        hitting the cap no longer evicts the Zipf head with the tail."""
+        cur = self._memo
+        n = len(cur)
+        if n:
+            idx = np.fromiter(cur.values(), dtype=np.int64, count=n)
+            self._memo_ta[:n] = self._memo_ta[idx]
+            self._memo_tb[:n] = self._memo_tb[idx]
+            self._memo_ln[:n] = self._memo_ln[idx]
+            self._memo_dl[:n] = self._memo_dl[idx]
+        self._memo_old = {t: j for j, t in enumerate(cur)}
+        self._memo = {}
+        self._memo_n = n
+
     def _hash_topics_memo(self, topics: List[str]):
         """Batch split+hash through the cross-tick topic memo: repeated
         topic strings (Zipf traffic, bench batches, retried publishes)
@@ -1212,11 +1261,18 @@ class ShardedMatchEngine:
         instead of re-paying the native split+hash — the same dedup win
         submit-time dedup proved on the wire floor, applied to prep.
         Returns (ta, tb, ln, dl) gathered rows."""
+        if len(self._memo) + len(topics) > self.topic_memo_cap >> 1:
+            self._memo_swap()
         memo = self._memo
-        if len(memo) + len(topics) > self.topic_memo_cap:
-            memo.clear()  # wholesale reset: the memo is a pure cache
-            self._memo_n = 0
-        rows = [memo.get(t, -1) for t in topics]
+        old = self._memo_old
+        rows: List[int] = []
+        for t in topics:
+            r = memo.get(t, -1)
+            if r < 0 and old:
+                r = old.get(t, -1)
+                if r >= 0:
+                    memo[t] = r  # second chance: promote to the live gen
+            rows.append(r)
         miss = [i for i, r in enumerate(rows) if r < 0]
         if miss:
             uniq = dict.fromkeys(topics[i] for i in miss)
@@ -1293,6 +1349,80 @@ class ShardedMatchEngine:
     @property
     def inflight_ticks(self) -> int:
         return len(self._inflight)
+
+    @property
+    def effective_depth(self) -> int:
+        """The adaptively clamped in-flight window bound (<= the
+        configured pipeline_depth)."""
+        return self._eff_depth
+
+    def _depth_window(self, now: float, fused: bool) -> int:
+        """Effective window bound for this tick (see the __init__
+        comment): churn-drain EWMA clamps to 1 when the window can't
+        fill; otherwise a measured A/B over submit-to-submit intervals
+        picks deep vs shallow, deep favored inside depth_margin."""
+        depth = self.pipeline_depth
+        if depth <= 1:
+            self._eff_depth = depth
+            return depth
+        self._drain_ewma += 0.125 * (
+            (1.0 if fused else 0.0) - self._drain_ewma
+        )
+        if self._drain_ewma >= self.drain_clamp:
+            # the drain serializes every tick regardless of the window;
+            # interval samples here would measure churn, not the window
+            self._dw_last = None
+            self._dw_samples.clear()
+            if self._eff_depth != 1:
+                self._eff_depth = 1
+                if _tps._active:
+                    tp("engine.pipeline", event="clamp",
+                       reason="churn-drain", eff=1, depth=depth)
+            return 1
+        last, self._dw_last = self._dw_last, now
+        if last is not None:
+            self._dw_samples.append(now - last)
+            self._dw_age[not self._dw_deep] += 1
+            if len(self._dw_samples) >= self.depth_probe_len:
+                self._dw_cost[self._dw_deep] = float(
+                    np.median(self._dw_samples)
+                )
+                self._dw_samples.clear()
+                self._dw_age[self._dw_deep] = 0
+                other = not self._dw_deep
+                if (
+                    self._dw_cost[other] is None
+                    or self._dw_age[other] > self.depth_probe_interval
+                ):
+                    self._dw_deep = other  # probe the stale mode
+                else:
+                    # both measurements fresh: deep serves only when it
+                    # measures a REAL win (the overlap on parallel
+                    # hardware) on `depth_win_streak` consecutive
+                    # verdicts — on a serialized host the window only
+                    # adds bookkeeping and noisy phantom wins don't
+                    # repeat, so ties clamp to 1 and depth N can never
+                    # underperform depth 1
+                    win = (
+                        self._dw_cost[True]
+                        < self._dw_cost[False] * (1.0 - self.depth_margin)
+                    )
+                    if self._dw_deep or not win:
+                        # count only independent wins (deep cost just
+                        # refreshed); a stale deep cost can lose but
+                        # never score
+                        self._dw_streak = self._dw_streak + 1 if win else 0
+                    deep = self._dw_streak >= self.depth_win_streak
+                    if deep != self._dw_deep and _tps._active:
+                        tp("engine.pipeline", event="clamp",
+                           reason="measured", eff=depth if deep else 1,
+                           depth=depth,
+                           cost_deep=self._dw_cost[True],
+                           cost_shallow=self._dw_cost[False])
+                    self._dw_deep = deep
+        eff = depth if self._dw_deep else 1
+        self._eff_depth = eff
+        return eff
 
     def _drain_window(self, reason: str = "drain") -> None:
         """Resolve every in-flight tick (device fetch + overflow refetch
@@ -1489,6 +1619,7 @@ class ShardedMatchEngine:
             return p
         slots, ka, kb, vv = self._pre_step_sync()
         churn_slots = int((slots >= 0).sum()) if slots is not None else 0
+        eff_depth = self._depth_window(t0, slots is not None)
         if slots is not None:
             # donation below invalidates the tables every in-flight tick
             # still snapshots (overflow refetch): drain the window first
@@ -1529,13 +1660,14 @@ class ShardedMatchEngine:
         self._inflight.append(p)
         p.pipe_occ = len(self._inflight)
         p.pipe_depth = self.pipeline_depth
-        if len(self._inflight) > self.pipeline_depth:
-            # bound the window: resolve the oldest tick, but ONLY if its
-            # device result is already materialized — the submit thread
-            # is the broker's event loop, and a stalled device must not
-            # freeze it (test_pipeline.py's guarantee).  Past a 4x hard
-            # ceiling memory safety wins and the resolve blocks (OLP has
-            # shed load long before that point).
+        if len(self._inflight) > eff_depth:
+            # bound the window (at the adaptively clamped effective
+            # depth): resolve the oldest tick, but ONLY if its device
+            # result is already materialized — the submit thread is the
+            # broker's event loop, and a stalled device must not freeze
+            # it (test_pipeline.py's guarantee).  Past a 4x hard ceiling
+            # (of the CONFIGURED depth) memory safety wins and the
+            # resolve blocks (OLP has shed load long before that point).
             oldest = self._inflight[0]
             force = len(self._inflight) > 4 * self.pipeline_depth
             if (force or self._tick_ready(oldest)) and self._resolve(

@@ -235,10 +235,25 @@ def test_sharded_topic_hash_memo(mesh):
     np.testing.assert_array_equal(tb1, ftb)
     np.testing.assert_array_equal(ta2, fta)
     np.testing.assert_array_equal(ln1, fln)
-    # memo reset at capacity keeps serving correct rows
+    # hitting the cap swaps generations instead of wiping the memo:
+    # the hot set survives via second-chance promotion — every row
+    # still serves from cache (hit-rate stays 100%, zero new misses)
     eng.topic_memo_cap = 20
+    misses_before = eng.memo_misses
     ta3, _tb3, _ln3, _dl3 = eng._hash_topics_memo(list(batch))
     np.testing.assert_array_equal(ta3, fta)
+    assert eng.memo_misses == misses_before  # Zipf head not evicted
+    assert eng.memo_hits == 3 * 128 - 16
+    # one full generation of cold traffic demotes the hot set to the
+    # old gen (it is NOT wiped); its next touch promotes it back with
+    # zero re-hash misses — second-chance survival, the old wholesale
+    # clear() re-paid 16 misses here
+    eng._hash_topics_memo([f"cold/{i}" for i in range(16)])
+    assert all(t in eng._memo_old for t in batch[:16])
+    eng.topic_memo_cap = 1 << 16  # stop forcing a swap every call
+    misses_before = eng.memo_misses
+    eng._hash_topics_memo(list(batch[:16]))
+    assert eng.memo_misses == misses_before
     # and match results stay correct through the memoized prep
     got = eng.match([f"m/3/x", "m/777/x"])
     assert got[0] == {eng.fid_of("m/3/+")}

@@ -285,3 +285,62 @@ def test_pipelined_broker_parity_random_trace(mesh):
             got_sh = sorted((f, m.topic) for f, m in sinks["sh"][cid].got)
             got_si = sorted((f, m.topic) for f, m in sinks["si"][cid].got)
             assert got_sh == got_si, (step, cid)
+
+
+def test_adaptive_window_clamp_churn_drain(mesh):
+    """When (nearly) every tick fuses churn, the drain serializes the
+    window regardless of depth — the churn-drain EWMA clamps the
+    effective window to 1, and it re-opens once churn stops."""
+    rng = random.Random(21)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng, n=100)
+    eng.pipeline_depth = 4
+    assert eng.effective_depth == 4
+    for i in range(12):  # churn EVERY tick
+        eng.apply_churn([f"cl/{i}/+"], [])
+        eng.match(_topics(rng, 4))
+    assert eng.effective_depth == 1
+    # clean ticks decay the EWMA; the window re-opens (the measured A/B
+    # controller then owns the bound)
+    for i in range(12):
+        eng.match(_topics(rng, 4))
+    assert eng._drain_ewma < eng.drain_clamp
+    # correctness is unaffected by the clamp: window-deep submits with
+    # mid-stream churn still match the oracle
+    for f in [f"cl/{i}/+" for i in range(12)]:
+        ref.insert(f, eng.fid_of(f))
+    pend = [eng.match_submit(_topics(rng, 6)) for _ in range(4)]
+    for p in pend:
+        topics = p.topics
+        got = eng.match_collect(p)
+        for t, g in zip(topics, got):
+            assert g == ref.match(t)
+
+
+def test_adaptive_window_clamp_measured(mesh):
+    """The A/B cost controller clamps to 1 when deep measures no real
+    win, and serves deep when it measures one past the margin."""
+    rng = random.Random(22)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng, n=60)
+    eng.pipeline_depth = 4
+    # feed equal-cost measurements: ties must clamp (a serialized host)
+    eng._dw_cost[True] = 0.010
+    eng._dw_cost[False] = 0.010
+    eng._dw_deep = True
+    eng._dw_samples = [0.010] * (eng.depth_probe_len - 1)
+    eng._dw_last = __import__("time").monotonic()
+    eng.match(_topics(rng, 4))  # completes the deep window -> verdict
+    assert eng.effective_depth == 1
+    # deep measurably cheaper (real overlap) on consecutive verdicts:
+    # serves deep again
+    eng._dw_cost[True] = 0.005
+    eng._dw_cost[False] = 0.010
+    eng._dw_deep = True
+    eng._dw_streak = eng.depth_win_streak - 1
+    eng._dw_samples = [0.005] * (eng.depth_probe_len - 1)
+    eng._dw_last = __import__("time").monotonic()
+    eng.match(_topics(rng, 4))
+    assert eng.effective_depth == 4

@@ -286,6 +286,37 @@ def check_tracepoints(problems):
             )
 
 
+# the retained device-index plane (ISSUE 7): retained.* tracepoints are
+# owned by exactly these two modules, and every registered retained.*
+# kind must actually be emitted — a dead registration means the
+# observability the flip depends on silently fell off a rewrite
+RETAINED_TP_FILES = (
+    os.path.join("emqx_tpu", "models", "retained.py"),
+    os.path.join("emqx_tpu", "broker", "retainer.py"),
+)
+
+
+def check_retained_tracepoints(problems):
+    known = {k for k in known_tp_kinds() if k.startswith("retained.")}
+    emitted = {}
+    for path, line, kind in collect_tp_calls():
+        if not kind.startswith("retained."):
+            continue
+        emitted.setdefault(kind, []).append((path, line))
+        rel = os.path.relpath(path, REPO)
+        if rel not in RETAINED_TP_FILES:
+            problems.append(
+                f"{path}:{line}: retained.* tracepoint {kind!r} emitted "
+                "outside models/retained.py / broker/retainer.py"
+            )
+    for kind in sorted(known - set(emitted)):
+        problems.append(
+            "emqx_tpu/observe/tracepoints.py: registered kind "
+            f"{kind!r} is never emitted from models/retained.py or "
+            "broker/retainer.py"
+        )
+
+
 FAULT_FNS = {"inject", "ainject", "peek", "mangle"}
 
 
@@ -624,6 +655,7 @@ def main() -> int:
         check_undefined(path, src, tree, problems, ignored)
         check_ast_lints(path, src, tree, problems, ignored)
     check_tracepoints(problems)
+    check_retained_tracepoints(problems)
     check_fault_sites(problems)
     check_ds_config(problems)
     check_churn_hooks(problems)
