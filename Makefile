@@ -1,13 +1,26 @@
 # Build/check entry points (the reference's `make` + rebar gates analog:
 # /root/reference/Makefile, rebar.config:16-36 dialyzer/xref/elvis).
 
-.PHONY: check lint test test-fast native bench restore-bench chaos \
-        ds-bench ds-dump ds-soak churn-bench retained-bench
+.PHONY: check check-json lint lint-fast test test-fast native bench \
+        restore-bench chaos ds-bench ds-dump ds-soak churn-bench \
+        retained-bench
 
-# static-analysis gate: stdlib implementation (mypy/ruff are not in this
-# image and installs are off-limits — see tools/check.py header)
+# static-analysis gate (tools/analysis/): the dialyzer/xref/elvis
+# analog, stdlib-only — whole-project AST index + call graph, thread-
+# role inference + event-loop blocking-call detector, cross-thread race
+# lint, registry cross-checks, style lints.  Exit 0 = empty error tier
+# and no non-baselined warnings (same contract the old tools/check.py
+# had, now tiered; see README "Static analysis").
 lint:
-	python tools/check.py
+	python -m tools.analysis
+
+# fast iteration: expensive per-file passes limited to `git diff` files
+lint-fast:
+	python -m tools.analysis --changed
+
+# machine-readable findings (CI annotations, dashboards)
+check-json:
+	python -m tools.analysis --json
 
 test:
 	python -m pytest tests/ -q

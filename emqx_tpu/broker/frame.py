@@ -38,7 +38,9 @@ class _Reader:
 
     def __init__(self, buf: bytes, pos: int = 0, end: Optional[int] = None):
         self.buf = buf
-        self.pos = pos
+        # each _Reader is constructed, consumed and dropped inside one
+        # decode() call — it never escapes the decoding thread
+        self.pos = pos  # analysis: owner=local
         self.end = len(buf) if end is None else end
 
     def remaining(self) -> int:

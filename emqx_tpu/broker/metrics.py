@@ -89,6 +89,25 @@ PREDEFINED = [
     "messages.forward.replayed",
     "messages.forward.spool_dropped",
     "messages.forward.dup_dropped",
+    # cluster forward path (broker/broker.py + cluster/node.py): in/out
+    # frames, relays, failures, shared-group redispatch
+    "messages.forward.in",
+    "messages.forward.out",
+    "messages.forward.relayed",
+    "messages.forward.shared",
+    "messages.forward.dropped",
+    "messages.shared.redispatched",
+    "messages.dropped.no_shared_member",
+    # host match-path hash-collision catch (Broker.on_collision hook)
+    "match.hash_collision",
+    # connection lifecycle + overload protection (broker/listener.py,
+    # broker/ws.py)
+    "channels.force_shutdown",
+    "olp.new_conn.shed",
+    "olp.new_conn.rate_limited",
+    # exhook event dispatcher (exhook/manager.py)
+    "exhook.events.dropped",
+    "exhook.events.failed",
     # engine device breaker (models/engine.py; synced like the rest of
     # the engine.* counters by Broker.sync_engine_metrics)
     "engine.breaker_trips",

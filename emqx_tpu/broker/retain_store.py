@@ -19,6 +19,7 @@ import json
 import logging
 import os
 import struct
+import threading
 from typing import Dict, Iterator, Tuple
 
 from .message import Message
@@ -87,6 +88,11 @@ class DiscRetainStore:
     def __init__(self, path: str, compact_ratio: int = 4):
         self.path = path
         self.compact_ratio = compact_ratio
+        # set/delete append on the event loop; flush() runs on the node
+        # ticker's to_thread hop — the handle + record count are shared
+        # across those threads and every access holds this lock
+        # (reentrant: _compact re-enters through set())
+        self._lock = threading.RLock()
         self._records = 0  # total records in the log file
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "ab")
@@ -95,23 +101,28 @@ class DiscRetainStore:
 
     def set(self, msg: Message) -> None:
         """Buffered append (no per-message flush: retained publish rides
-        the event loop; the node ticker calls flush())."""
+        the event loop; the node ticker calls flush() off-loop)."""
         hdr = _msg_header(msg)
-        self._f.write(struct.pack("<BI", _OP_SET, len(hdr)))
-        self._f.write(hdr)
-        self._f.write(struct.pack("<I", len(msg.payload)))
-        self._f.write(msg.payload)
-        self._records += 1
+        with self._lock:
+            self._f.write(struct.pack("<BI", _OP_SET, len(hdr)))  # analysis: allow-blocking(buffered page-cache append, no fsync; flush is off-loop)
+            self._f.write(hdr)  # analysis: allow-blocking(buffered page-cache append)
+            self._f.write(struct.pack("<I", len(msg.payload)))  # analysis: allow-blocking(buffered page-cache append)
+            self._f.write(msg.payload)  # analysis: allow-blocking(buffered page-cache append)
+            self._records += 1
 
     def delete(self, topic: str) -> None:
         hdr = json.dumps({"topic": topic}).encode("utf-8")
-        self._f.write(struct.pack("<BI", _OP_DEL, len(hdr)))
-        self._f.write(hdr)
-        self._records += 1
+        with self._lock:
+            self._f.write(struct.pack("<BI", _OP_DEL, len(hdr)))  # analysis: allow-blocking(buffered page-cache append)
+            self._f.write(hdr)  # analysis: allow-blocking(buffered page-cache append)
+            self._records += 1
 
     def flush(self) -> None:
+        """Flush buffered appends to the OS.  Called from the node
+        ticker via asyncio.to_thread — never on the event loop."""
         try:
-            self._f.flush()
+            with self._lock:
+                self._f.flush()
         except OSError:
             log.exception("retain store flush")
 
@@ -119,30 +130,34 @@ class DiscRetainStore:
         """True when dead records dominate — the Retainer then streams
         its live set through compact() (bounds the log between restarts,
         not just at load)."""
-        return self._records > self.compact_ratio * max(live_count, 1)
+        with self._lock:
+            return self._records > self.compact_ratio * max(live_count, 1)
 
     def compact(self, messages) -> None:
         self._compact({m.topic: m for m in messages})
 
     def close(self) -> None:
         try:
-            self._f.flush()
-            self._f.close()
+            with self._lock:
+                self._f.flush()  # analysis: allow-blocking(shutdown: final flush)
+                self._f.close()
         except OSError:
             pass
 
     # -------------------------------------------------------------- load
 
     def _replay(self) -> Iterator[Tuple[int, dict, bytes]]:
+        # boot-time load: the node constructs the retainer before any
+        # listener serves traffic, so these reads never stall a client
         with open(self.path, "rb") as f:
             while True:
-                head = f.read(5)
+                head = f.read(5)  # analysis: allow-blocking(boot-time load)
                 if len(head) < 5:
                     if head:
                         log.warning("truncated record tail in %s", self.path)
                     return
                 op, hlen = struct.unpack("<BI", head)
-                hdr_raw = f.read(hlen)
+                hdr_raw = f.read(hlen)  # analysis: allow-blocking(boot-time load)
                 if len(hdr_raw) < hlen:
                     log.warning("truncated header in %s", self.path)
                     return
@@ -153,11 +168,11 @@ class DiscRetainStore:
                     return
                 payload = b""
                 if op == _OP_SET:
-                    plen_raw = f.read(4)
+                    plen_raw = f.read(4)  # analysis: allow-blocking(boot-time load)
                     if len(plen_raw) < 4:
                         return
                     (plen,) = struct.unpack("<I", plen_raw)
-                    payload = f.read(plen)
+                    payload = f.read(plen)  # analysis: allow-blocking(boot-time load)
                     if len(payload) < plen:
                         return
                 yield op, hdr, payload
@@ -175,7 +190,8 @@ class DiscRetainStore:
                 live[topic] = _msg_from(hdr, payload)
             else:
                 live.pop(topic, None)
-        self._records = n
+        with self._lock:
+            self._records = n
         live = {t: m for t, m in live.items() if not m.expired()}
         if n > self.compact_ratio * max(len(live), 1):
             self._compact(live)
@@ -183,14 +199,15 @@ class DiscRetainStore:
 
     def _compact(self, live: Dict[str, Message]) -> None:
         tmp = self.path + ".tmp"
-        self._f.close()
-        self._f = open(tmp, "wb")
-        self._records = 0
-        try:
-            for msg in live.values():
-                self.set(msg)
+        with self._lock:
             self._f.close()
-            os.replace(tmp, self.path)
-        finally:
-            self._f = open(self.path, "ab")
+            self._f = open(tmp, "wb")
+            self._records = 0
+            try:
+                for msg in live.values():
+                    self.set(msg)
+                self._f.close()
+                os.replace(tmp, self.path)
+            finally:
+                self._f = open(self.path, "ab")
         log.info("compacted %s to %d retained messages", self.path, len(live))

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import Dict, Optional
 
@@ -58,12 +59,21 @@ class CheckpointManager:
         self.store = SnapshotStore(os.path.join(directory, "snap"), keep=keep)
         self.wal = ChurnWal(os.path.join(directory, "wal"),
                             seg_bytes=wal_seg_bytes)
+        # write() runs on a to_thread worker while due()/stats readers
+        # stay on the loop: every cadence/stat field below is guarded
+        self._lock = threading.Lock()
         self._last_snap = time.monotonic()
         # filter -> refcount as of restore completion: released by
         # reconcile_sessions() once session restore re-added its own refs
         self._restored_refs: Optional[Dict[str, int]] = None
         self.save_count = 0
         self.save_failures = 0
+        # pending alarm transition recorded by write()/restore() (worker
+        # thread) and APPLIED by poll_alarm() on the event loop: the
+        # alarm publish is itself a broker publish and must never run on
+        # the checkpoint worker (same rule as poll_health_alarms)
+        self._alarm_error: Optional[dict] = None
+        self._alarm_dirty = False
         engine.on_churn = self.note_churn
 
     # ---------------------------------------------------------------- WAL
@@ -79,8 +89,9 @@ class CheckpointManager:
 
     def due(self, now: Optional[float] = None) -> bool:
         now = now if now is not None else time.monotonic()
-        if now - self._last_snap >= self.interval:
-            return True
+        with self._lock:
+            if now - self._last_snap >= self.interval:
+                return True
         return self.wal.pending_bytes() >= self.wal_max_bytes
 
     def capture(self):
@@ -106,27 +117,48 @@ class CheckpointManager:
         try:
             path = self.store.save(arrays, meta)
         except Exception as e:
-            self.save_failures += 1
+            with self._lock:
+                self.save_failures += 1
+                self._alarm_error = {
+                    "details": {"error": str(e)},
+                    "message": "engine table checkpoint failed",
+                }
+                self._alarm_dirty = True
             if self.metrics is not None:
                 self.metrics.inc("engine.ckpt.save_failures")
-            if self.alarms is not None:
-                self.alarms.activate(
-                    ALARM_NAME, details={"error": str(e)},
-                    message="engine table checkpoint failed",
-                )
             log.exception("checkpoint save failed")
             return None
         self.wal.ack_through(watermark)
-        self._last_snap = time.monotonic()
-        self.save_count += 1
+        with self._lock:
+            self._last_snap = time.monotonic()
+            self.save_count += 1
+            self._alarm_error = None
+            self._alarm_dirty = True
         if self.metrics is not None:
             self.metrics.inc("engine.ckpt.saves")
-        if self.alarms is not None:
-            self.alarms.deactivate(ALARM_NAME)
         tp("engine.ckpt.save", path=path, wal_seq=watermark,
            n_filters=self.engine.n_filters,
            dt_ms=(time.monotonic() - t0) * 1e3)
         return path
+
+    def poll_alarm(self) -> None:
+        """Apply the pending alarm transition recorded by write()/
+        restore().  Called from the node ticker on the EVENT LOOP: the
+        alarm publish fans out through the whole broker dispatch path
+        (retainer, sessions, cluster forward) and must never run on the
+        checkpoint worker thread."""
+        if self.alarms is None:
+            return
+        with self._lock:
+            if not self._alarm_dirty:
+                return
+            err, self._alarm_dirty = self._alarm_error, False
+        if err is not None:
+            self.alarms.activate(
+                ALARM_NAME, details=err["details"], message=err["message"]
+            )
+        else:
+            self.alarms.deactivate(ALARM_NAME)
 
     def checkpoint(self) -> Optional[str]:
         """Capture + write in one call (tests, shutdown, bench)."""
@@ -156,12 +188,14 @@ class CheckpointManager:
                 "all %d snapshot(s) failed verification; cold start",
                 len(candidates),
             )
-            if self.alarms is not None:
-                self.alarms.activate(
-                    ALARM_NAME,
-                    details={"snapshots": len(candidates)},
-                    message="no loadable engine snapshot; cold start",
-                )
+            # restore() runs on the boot worker (_warm via to_thread):
+            # record the alarm for the first loop-side poll_alarm()
+            with self._lock:
+                self._alarm_error = {
+                    "details": {"snapshots": len(candidates)},
+                    "message": "no loadable engine snapshot; cold start",
+                }
+                self._alarm_dirty = True
             return None
         hook, self.engine.on_churn = self.engine.on_churn, None
         try:

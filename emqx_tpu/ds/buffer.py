@@ -38,15 +38,18 @@ class WriteBuffer:
         return self.log.next_offset
 
     def pending_bytes(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def pending_count(self) -> int:
-        return len(self._items)
+        with self._lock:
+            return len(self._items)
 
     def loss_window(self) -> int:
         """Bytes of appended-but-not-fsync'd payload (the crash
         exposure this instant; bounded by flush_bytes + one record)."""
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def append(self, payload: bytes) -> int:
         """Buffer one record; returns its (pre-assigned) offset.

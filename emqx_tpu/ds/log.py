@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import zlib
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
@@ -64,7 +65,7 @@ def _scan_segment(path: str, shard: Optional[int] = None):
     record (short header, short payload, or CRC mismatch) ends the
     scan there, the recovery contract of `ShardLog._recover`."""
     with open(path, "rb") as f:
-        data = f.read()
+        data = f.read()  # analysis: allow-blocking(boot-time recovery scan; no traffic served yet)
     if len(data) < _HDR.size:
         raise SegmentError("file shorter than segment header")
     magic, version, seg_shard, gen, base = _HDR.unpack_from(data, 0)
@@ -95,6 +96,12 @@ class ShardLog:
         self.dir = directory
         self.shard = shard
         self.seg_bytes = max(1, int(seg_bytes))
+        # appends arrive via WriteBuffer.flush on EITHER the event loop
+        # (inline watermark) or the ticker's to_thread hop, while reads
+        # (resume replay, GC bookkeeping) stay on the loop: every access
+        # to the segment chain + active handle is serialized here.
+        # RLock because append_payloads -> roll nests an acquire.
+        self._lock = threading.RLock()
         self.segments: List[SegmentInfo] = []  # sealed, ascending gen
         self._f = None  # active segment handle (append mode)
         self._active: Optional[SegmentInfo] = None
@@ -105,60 +112,70 @@ class ShardLog:
 
     def _recover(self) -> None:
         """Adopt sealed segments, truncate+seal any torn active file,
-        then open a fresh generation for new appends."""
-        sealed, opens = [], []
-        for name in os.listdir(self.dir):
-            if name.startswith("seg.") and name.endswith(".log"):
-                sealed.append(os.path.join(self.dir, name))
-            elif name.startswith("seg.") and name.endswith(".open"):
-                opens.append(os.path.join(self.dir, name))
-        for path in sealed:
-            try:
-                (_s, gen, base, count), good = _scan_segment(path, self.shard)
-            except (SegmentError, OSError):
-                continue  # unreadable sealed segment: skipped (gap on read)
-            if count:
+        then open a fresh generation for new appends.  Runs once from
+        __init__ (node construction, before the loop serves traffic):
+        the recovery IO below is deliberately synchronous boot work."""
+        with self._lock:
+            sealed, opens = [], []
+            for name in os.listdir(self.dir):
+                if name.startswith("seg.") and name.endswith(".log"):
+                    sealed.append(os.path.join(self.dir, name))
+                elif name.startswith("seg.") and name.endswith(".open"):
+                    opens.append(os.path.join(self.dir, name))
+            for path in sealed:
+                try:
+                    (_s, gen, base, count), good = _scan_segment(
+                        path, self.shard)
+                except (SegmentError, OSError):
+                    continue  # unreadable sealed segment: skip (read gap)
+                if count:
+                    self.segments.append(SegmentInfo(
+                        gen, base, count, os.path.getsize(path), path, True,
+                        os.path.getmtime(path)))
+                else:
+                    _unlink_quiet(path)
+            # a crash can leave the active file torn mid-record: truncate
+            # to the whole-record prefix, then seal it — recovery IS the
+            # roll
+            for path in opens:
+                try:
+                    (_s, gen, base, count), good = _scan_segment(
+                        path, self.shard)
+                except (SegmentError, OSError):
+                    _unlink_quiet(path)
+                    continue
+                if count == 0:
+                    _unlink_quiet(path)
+                    continue
+                if good < os.path.getsize(path):
+                    with open(path, "r+b") as f:
+                        f.truncate(good)  # analysis: allow-blocking(one-shot boot recovery)
+                        f.flush()  # analysis: allow-blocking(one-shot boot recovery)
+                        os.fsync(f.fileno())  # analysis: allow-blocking(one-shot boot recovery)
+                final = os.path.join(self.dir, f"seg.{gen}.log")
+                os.replace(path, final)
                 self.segments.append(SegmentInfo(
-                    gen, base, count, os.path.getsize(path), path, True,
-                    os.path.getmtime(path)))
-            else:
-                _unlink_quiet(path)
-        # a crash can leave the active file torn mid-record: truncate to
-        # the whole-record prefix, then seal it — recovery IS the roll
-        for path in opens:
-            try:
-                (_s, gen, base, count), good = _scan_segment(path, self.shard)
-            except (SegmentError, OSError):
-                _unlink_quiet(path)
-                continue
-            if count == 0:
-                _unlink_quiet(path)
-                continue
-            if good < os.path.getsize(path):
-                with open(path, "r+b") as f:
-                    f.truncate(good)
-                    f.flush()
-                    os.fsync(f.fileno())
-            final = os.path.join(self.dir, f"seg.{gen}.log")
-            os.replace(path, final)
-            self.segments.append(SegmentInfo(
-                gen, base, count, os.path.getsize(final), final, True,
-                os.path.getmtime(final)))
-        self.segments.sort(key=lambda s: s.generation)
-        self._fsync_dir()
-        self._open_active()
+                    gen, base, count, os.path.getsize(final), final, True,
+                    os.path.getmtime(final)))
+            self.segments.sort(key=lambda s: s.generation)
+            self._fsync_dir()
+            self._open_active()
 
     def _open_active(self) -> None:
-        gen = (self.segments[-1].generation + 1) if self.segments else 1
-        base = self.segments[-1].end if self.segments else 0
-        path = os.path.join(self.dir, f"seg.{gen}.open")
-        f = open(path, "wb")
-        f.write(_HDR.pack(MAGIC, VERSION, self.shard, gen, base))
-        f.flush()
-        os.fsync(f.fileno())
-        self._f = f
-        self._active = SegmentInfo(
-            gen, base, 0, _HDR.size, path, False, os.path.getmtime(path))
+        # called under self._lock (boot recovery or a roll mid-flush);
+        # the header write rides the same flush/fsync budget as the
+        # roll that triggered it
+        with self._lock:
+            gen = (self.segments[-1].generation + 1) if self.segments else 1
+            base = self.segments[-1].end if self.segments else 0
+            path = os.path.join(self.dir, f"seg.{gen}.open")
+            f = open(path, "wb")
+            f.write(_HDR.pack(MAGIC, VERSION, self.shard, gen, base))  # analysis: allow-blocking(segment-roll header, rides the flush fsync budget)
+            f.flush()  # analysis: allow-blocking(segment-roll header, rides the flush fsync budget)
+            os.fsync(f.fileno())  # analysis: allow-blocking(segment-roll header, rides the flush fsync budget)
+            self._f = f
+            self._active = SegmentInfo(
+                gen, base, 0, _HDR.size, path, False, os.path.getmtime(path))
 
     def _fsync_dir(self) -> None:
         try:
@@ -166,7 +183,7 @@ class ShardLog:
         except OSError:
             return
         try:
-            os.fsync(dfd)
+            os.fsync(dfd)  # analysis: allow-blocking(directory fsync rides the segment-roll/boot-recovery budget)
         except OSError:
             pass
         finally:
@@ -176,23 +193,29 @@ class ShardLog:
 
     @property
     def generation(self) -> int:
-        return self._active.generation
+        with self._lock:
+            return self._active.generation
 
     @property
     def next_offset(self) -> int:
         """Next offset a durable append would take (buffered appends in
         `WriteBuffer` run ahead of this)."""
-        return self._active.end
+        with self._lock:
+            return self._active.end
 
     @property
     def oldest_offset(self) -> int:
-        if self.segments:
-            return self.segments[0].base
-        return self._active.base
+        with self._lock:
+            if self.segments:
+                return self.segments[0].base
+            return self._active.base
 
     @property
     def total_bytes(self) -> int:
-        return sum(s.nbytes for s in self.segments) + self._active.nbytes
+        with self._lock:
+            return sum(
+                s.nbytes for s in self.segments
+            ) + self._active.nbytes
 
     def generation_at(self, offset: int) -> int:
         """Generation whose segment holds (or will hold) `offset` —
@@ -201,13 +224,14 @@ class ShardLog:
         cursor names the generation its offset actually lives in and
         a post-crash (generation, offset) mismatch stays detectable
         (`ShardIterator._validate_cursor`)."""
-        if offset >= self._active.base:
-            return self._active.generation
-        for seg in reversed(self.segments):
-            if seg.base <= offset:
-                return seg.generation
-        return (self.segments[0].generation if self.segments
-                else self._active.generation)
+        with self._lock:
+            if offset >= self._active.base:
+                return self._active.generation
+            for seg in reversed(self.segments):
+                if seg.base <= offset:
+                    return seg.generation
+            return (self.segments[0].generation if self.segments
+                    else self._active.generation)
 
     def append_payloads(self, items: List[Tuple[int, bytes]]) -> None:
         """Write (offset, payload) records — offsets MUST continue the
@@ -215,40 +239,49 @@ class ShardLog:
         then fsync; rolls the segment past `seg_bytes`."""
         if not items:
             return
-        first = items[0][0]
-        if first != self._active.end:
-            raise SegmentError(
-                f"append at offset {first}, expected {self._active.end}")
-        parts = []
-        for _off, payload in items:
-            parts.append(_REC.pack(zlib.crc32(payload), len(payload)))
-            parts.append(payload)
-        blob = b"".join(parts)
-        self._f.write(blob)
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        self._active.count += len(items)
-        self._active.nbytes += len(blob)
-        if self._active.nbytes >= self.seg_bytes:
-            self.roll()
+        # the fsync below is the ds durability contract: WriteBuffer
+        # batches appends to `ds.flush_bytes` precisely so this runs
+        # once per watermark (inline on the loop) or per ticker flush
+        # (to_thread) — bounded-loss by BYTES, PR 5's design decision
+        with self._lock:
+            first = items[0][0]
+            if first != self._active.end:
+                raise SegmentError(
+                    f"append at offset {first}, "
+                    f"expected {self._active.end}")
+            parts = []
+            for _off, payload in items:
+                parts.append(_REC.pack(zlib.crc32(payload), len(payload)))
+                parts.append(payload)
+            blob = b"".join(parts)
+            self._f.write(blob)  # analysis: allow-blocking(ds durability contract: one batched write per flush_bytes watermark)
+            self._f.flush()  # analysis: allow-blocking(ds durability contract: one batched flush per flush_bytes watermark)
+            os.fsync(self._f.fileno())  # analysis: allow-blocking(ds durability contract: one fsync per flush_bytes watermark)
+            self._active.count += len(items)
+            self._active.nbytes += len(blob)
+            if self._active.nbytes >= self.seg_bytes:
+                self.roll()
 
     def roll(self) -> Optional[SegmentInfo]:
         """Seal the active segment (fsync + rename + dir fsync) and open
         the next generation.  No-op on an empty active segment."""
-        if self._active.count == 0:
-            return None
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        self._f.close()
-        final = os.path.join(self.dir, f"seg.{self._active.generation}.log")
-        os.replace(self._active.path, final)
-        self._fsync_dir()
-        info = SegmentInfo(
-            self._active.generation, self._active.base, self._active.count,
-            self._active.nbytes, final, True, os.path.getmtime(final))
-        self.segments.append(info)
-        self._open_active()
-        return info
+        with self._lock:
+            if self._active.count == 0:
+                return None
+            self._f.flush()  # analysis: allow-blocking(segment seal, once per seg_bytes)
+            os.fsync(self._f.fileno())  # analysis: allow-blocking(segment seal, once per seg_bytes)
+            self._f.close()
+            final = os.path.join(
+                self.dir, f"seg.{self._active.generation}.log")
+            os.replace(self._active.path, final)
+            self._fsync_dir()
+            info = SegmentInfo(
+                self._active.generation, self._active.base,
+                self._active.count, self._active.nbytes, final, True,
+                os.path.getmtime(final))
+            self.segments.append(info)
+            self._open_active()
+            return info
 
     # ---------------------------------------------------------------- read
 
@@ -262,27 +295,28 @@ class ShardLog:
         because retention GC dropped the generation they lived in
         (the cursor lands on the oldest surviving record).  Only
         fsync'd data is visible — buffered appends are not."""
-        gap = 0
-        oldest = self.oldest_offset
-        if offset < oldest:
-            gap = oldest - offset
-            offset = oldest
-        out: List[Tuple[int, bytes]] = []
-        for seg in [*self.segments, self._active]:
-            if seg.end <= offset or not seg.count:
-                continue
-            if seg.base > offset:
-                # a middle generation was dropped (forced retention):
-                # skip forward and report the hole
-                gap += seg.base - offset
-                offset = seg.base
-            out.extend(self._read_segment(seg, offset,
-                                          max_records - len(out)))
-            if out:
-                offset = out[-1][0] + 1
-            if len(out) >= max_records:
-                break
-        return out, offset, gap
+        with self._lock:
+            gap = 0
+            oldest = self.oldest_offset
+            if offset < oldest:
+                gap = oldest - offset
+                offset = oldest
+            out: List[Tuple[int, bytes]] = []
+            for seg in [*self.segments, self._active]:
+                if seg.end <= offset or not seg.count:
+                    continue
+                if seg.base > offset:
+                    # a middle generation was dropped (forced retention):
+                    # skip forward and report the hole
+                    gap += seg.base - offset
+                    offset = seg.base
+                out.extend(self._read_segment(seg, offset,
+                                              max_records - len(out)))
+                if out:
+                    offset = out[-1][0] + 1
+                if len(out) >= max_records:
+                    break
+            return out, offset, gap
 
     def _read_segment(
         self, seg: SegmentInfo, offset: int, limit: int
@@ -291,7 +325,11 @@ class ShardLog:
             return []
         try:
             with open(seg.path, "rb") as f:
-                data = f.read(seg.nbytes)
+                # resume replay is DELIBERATELY serialized with tick_gc
+                # on the loop (PR 5 fix #2: an off-loop replay can race
+                # the min-cursor walk and lose the generation it reads);
+                # the read is bounded by seg_bytes and page-cache-warm
+                data = f.read(seg.nbytes)  # analysis: allow-blocking(replay serialized with GC on the loop by design; bounded by seg_bytes)
         except OSError:
             return []
         out: List[Tuple[int, bytes]] = []
@@ -313,22 +351,24 @@ class ShardLog:
 
     def drop_generation(self, generation: int) -> bool:
         """Unlink one SEALED generation (retention GC)."""
-        for i, seg in enumerate(self.segments):
-            if seg.generation == generation:
-                _unlink_quiet(seg.path)
-                del self.segments[i]
-                return True
-        return False
+        with self._lock:
+            for i, seg in enumerate(self.segments):
+                if seg.generation == generation:
+                    _unlink_quiet(seg.path)
+                    del self.segments[i]
+                    return True
+            return False
 
     def close(self) -> None:
-        if self._f is not None:
-            try:
-                self._f.flush()
-                os.fsync(self._f.fileno())
-            except (OSError, ValueError):
-                pass
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.flush()  # analysis: allow-blocking(shutdown: final durable handoff)
+                    os.fsync(self._f.fileno())  # analysis: allow-blocking(shutdown: final durable handoff)
+                except (OSError, ValueError):
+                    pass
+                self._f.close()
+                self._f = None
 
 
 def _unlink_quiet(path: str) -> None:

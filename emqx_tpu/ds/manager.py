@@ -23,8 +23,9 @@ The inversion of the `broker/persist.py` data model:
   cursor then reports the gap instead of blocking the disk forever).
 
 Config keys are read here (and only here) from the validated schema —
-`tools/check.py` lints that every `ds.*` key this package reads is
-declared in `config/config.py`.
+the static-analysis gate (`tools/analysis/registry.py`) lints every
+config namespace in both directions: a key read must be declared in
+`config/config.py`, a declared key must be read somewhere.
 """
 
 from __future__ import annotations

@@ -129,10 +129,10 @@ class FaultPlane:
             self._sites = {}
 
     def decide(self, site: str) -> Optional[Action]:
-        s = self._sites.get(site)
-        if s is None:
-            return None
         with self._lock:
+            s = self._sites.get(site)
+            if s is None:
+                return None
             s.arrivals += 1
             if s.arrivals <= s.after:
                 return None
@@ -195,7 +195,9 @@ def inject(site: str, err: Any = None) -> Optional[Action]:
     if a is None:
         return None
     if a.kind == "delay":
-        time.sleep(a.delay)
+        # sync injection point: only worker/pool call sites use inject();
+        # every loop-role site goes through ainject (PR 4 fix #3)
+        time.sleep(a.delay)  # analysis: allow-blocking(sync sites are worker-role; loop sites use ainject)
     elif a.kind == "error" and err is not False:
         raise (err or FaultError)(f"fault injected at {site}")
     return a

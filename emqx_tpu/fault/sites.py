@@ -2,8 +2,9 @@
 
 Every `fault.inject("<site>", ...)` / `fault.ainject` / `fault.peek` /
 `fault.mangle` call in production code (emqx_tpu/**) MUST name a site
-registered here — `tools/check.py` lints call sites against this dict
-statically, the same contract as the tracepoint KNOWN_KINDS registry.
+registered here — the static-analysis gate (`tools/analysis/`) lints
+call sites against this dict, the same contract as the tracepoint
+KNOWN_KINDS registry.
 A site that is not registered cannot be scheduled from `fault.spec`
 config, so an unregistered call site is dead chaos surface by contract.
 

@@ -137,11 +137,21 @@ class TopicMatchEngine:
         self.min_batch = max(2, min_batch + (min_batch & 1))
         self.kcap = kcap  # retained for API compat; sparse path sizes by hits
 
+        # ---- engine concurrency contract (cross-thread lint annotations)
+        # Mutation state (tables, registries, fid allocation) has ONE
+        # mutator at a time: runtime churn is serialized on the event
+        # loop; boot warm-restore runs on a to_thread worker BEFORE any
+        # listener serves (the executor join publishes the writes).
+        # Serve-path telemetry (counters, EWMA rates, breaker flags) is
+        # written from collect executor threads and read on the loop as
+        # GIL-atomic int/float/bool stores — the benign-dirty-read model
+        # PR 6 established for the churn plane; a torn read costs one
+        # stat sample, never correctness.
         self._fids: Dict[str, int] = {}  # filter str -> fid
         self._refs: Dict[int, int] = {}  # fid -> refcount
         self._words: Dict[int, List[str]] = {}
         self._fbytes: Dict[int, bytes] = {}  # utf-8 filter strings (native verify)
-        self._next_fid = 0
+        self._next_fid = 0  # analysis: owner=loop
         self._free_fids: List[int] = []
 
         # host fallback for filters deeper than the device level cap
@@ -171,12 +181,12 @@ class TopicMatchEngine:
         # churn shed-load visibility: ops the pacing layer dropped
         # because apply capacity lagged demand (note_churn_shed)
         self.churn_shed = 0
-        self._churn_shed_rec = 0  # high-water mark already flight-recorded
+        self._churn_shed_rec = 0  # high-water mark already flight-recorded  # analysis: owner=any
 
         # exact-match guarantee: verify device hash hits against stored
         # filter words (default on; see match())
         self.verify_matches = True
-        self.collision_count = 0
+        self.collision_count = 0  # analysis: owner=any
         self.on_collision = None  # fn(topic, fid) — metrics hook
 
         # checkpoint WAL hook (checkpoint/manager.py): called with
@@ -184,10 +194,10 @@ class TopicMatchEngine:
         # snapshot + the logged tail always reconstructs this state
         self.on_churn = None
 
-        self.epoch = 0  # bumps on every device-visible mutation
-        self._dev: Optional[DeviceTables] = None
+        self.epoch = 0  # bumps on every device-visible mutation  # analysis: owner=loop
+        self._dev: Optional[DeviceTables] = None  # analysis: owner=loop
         self._dev_stale = True
-        self._hcap_mult = 1  # sparse-return size factor (doubles on overflow)
+        self._hcap_mult = 1  # sparse-return size factor (doubles on overflow)  # analysis: owner=any
 
         # dispatch-pipeline window (engine.pipeline_depth): the single-
         # chip fused step is already non-donating, so concurrent in-
@@ -195,20 +205,20 @@ class TopicMatchEngine:
         # engine only tracks occupancy (submitted-but-uncollected ticks)
         # for the flight recorder and the batcher's pacing
         self.pipeline_depth = 4
-        self._inflight_n = 0
+        self._inflight_n = 0  # analysis: owner=any
 
         # ---- hybrid host/device arbitration state (see module docstring)
         # Default OFF at the class level so unit tests exercise the device
         # path deterministically; the node runtime enables it from config
         # (broker.hybrid, default true) and bench.py measures both.
         self.hybrid = False
-        self.rate_host: Optional[float] = None  # EWMA lookups/s, host path
-        self.rate_dev: Optional[float] = None  # EWMA lookups/s, device path
+        self.rate_host: Optional[float] = None  # EWMA lookups/s, host path  # analysis: owner=any
+        self.rate_dev: Optional[float] = None  # EWMA lookups/s, device path  # analysis: owner=any
         self.probe_interval = 10.0  # re-measure the idle path this often (s)
         self.dev_timeout_floor = 0.25  # min device-collect timeout (s)
-        self.host_serve_count = 0
-        self.dev_serve_count = 0
-        self.dev_timeout_count = 0
+        self.host_serve_count = 0  # analysis: owner=any
+        self.dev_serve_count = 0  # analysis: owner=any
+        self.dev_timeout_count = 0  # analysis: owner=any
         # device-path circuit breaker: after `breaker_threshold`
         # CONSECUTIVE device timeouts the engine stops arbitrating and
         # serves host-only (reason R_BREAKER) — per-tick fallback alone
@@ -217,9 +227,9 @@ class TopicMatchEngine:
         # completed probe (or device serve) closes it.  `on_breaker` is
         # the node-runtime alarm hook (engine_device_degraded).
         self.breaker_threshold = 3
-        self.breaker_open = False
-        self.breaker_trips = 0
-        self.consec_dev_timeouts = 0
+        self.breaker_open = False  # analysis: owner=any
+        self.breaker_trips = 0  # analysis: owner=any
+        self.consec_dev_timeouts = 0  # analysis: owner=any
         self.on_breaker: Optional[object] = None  # fn(open: bool)
         self._probe = None  # in-flight device probe: (out, t0, n_topics)
         # adaptive probe batch: starts small (a probe's terms upload rides
@@ -232,8 +242,8 @@ class TopicMatchEngine:
         # churn-delta slots a single probe dispatch may ship (the rest
         # stays pending; see _maybe_probe_device's sync policy)
         self.probe_delta_cap = 8192
-        self._last_dev_meas = 0.0
-        self._last_host_meas = 0.0
+        self._last_dev_meas = 0.0  # analysis: owner=any
+        self._last_host_meas = 0.0  # analysis: owner=any
 
         # ---- flight recorder + latency histograms (observe/flight.py):
         # one ring-buffer row per tick (path, reason, rates, wire bytes,
@@ -245,10 +255,10 @@ class TopicMatchEngine:
         self.hist_tick = LatencyHistogram()
         self.hist_probe = LatencyHistogram()
         self.hist_churn = LatencyHistogram()
-        self.path_flips = 0
+        self.path_flips = 0  # analysis: owner=any
         self.probe_count = 0
-        self._last_served = -1  # PATH_* of the previous tick (flip detect)
-        self._churn_lag = 0.0  # duration of the most recent apply_churn
+        self._last_served = -1  # PATH_* of the previous tick (flip detect)  # analysis: owner=any
+        self._churn_lag = 0.0  # duration of the most recent apply_churn  # analysis: owner=any
         # The match hot path is pure XLA by design.  A Pallas kernel for
         # the hash contraction was built and measured on a real TPU
         # (round-1 commit c2423d1): ~46 ms vs XLA's ~0.03-0.2 ms per
@@ -1528,7 +1538,10 @@ class TopicMatchEngine:
                 tp("engine.stall", n=len(pending.topics),
                    timeout_ms=timeout * 1e3, rate_dev=self.rate_dev)
                 return None
-            time.sleep(step)
+            # device-collect poll: runs on the batcher's collect
+            # executor thread by contract (publish_collect), never the
+            # loop — the loop awaits the executor future instead
+            time.sleep(step)  # analysis: allow-blocking(collect-executor poll; the batcher keeps this off the loop)
         self._note_dev_rate(
             len(pending.topics) / max(time.monotonic() - t0, 1e-9)
         )

@@ -55,7 +55,10 @@ class CpuTrieIndex:
 
     def __init__(self) -> None:
         self.root = _TrieNode()
-        self.count = 0
+        # mutations ride the engine's single-mutator churn path (loop
+        # at runtime; boot restore on the pre-serving warmup worker) —
+        # the trie itself would need the same contract anyway
+        self.count = 0  # analysis: owner=loop
 
     def insert(self, filt: str, fid: int) -> None:
         node = self.root

@@ -128,8 +128,11 @@ class DelayedPublish:
             return
         if self._store is None:
             self._store = open(self._store_path, "a", encoding="utf-8")
-        self._store.write(json.dumps(rec, separators=(",", ":")) + "\n")
-        self._store.flush()
+        # one JSON line per (rare) delayed-publish schedule: page-cache
+        # append + flush, no fsync — same at-least-once writeback
+        # contract as utils/replayq.py
+        self._store.write(json.dumps(rec, separators=(",", ":")) + "\n")  # analysis: allow-blocking(one page-cache line per delayed schedule, no fsync)
+        self._store.flush()  # analysis: allow-blocking(page-cache flush, no fsync)
 
     def _load(self) -> None:
         import os
@@ -168,7 +171,10 @@ class DelayedPublish:
         with open(tmp, "w", encoding="utf-8") as f:
             for seq, due, mid in by_seq:
                 if seq in msgs:
-                    f.write(json.dumps(
+                    # live-set rewrite: runs at boot or past the dead-
+                    # record threshold; the set is small by construction
+                    # (delayed messages, not broker traffic)
+                    f.write(json.dumps(  # analysis: allow-blocking(compaction of the small delayed-publish live set)
                         {"op": "sched", "due": due,
                          "msg": self._msg_to_rec(msgs[seq])},
                         separators=(",", ":")) + "\n")

@@ -46,7 +46,8 @@ from .psk import PskStore
 log = logging.getLogger("emqx_tpu.node")
 
 
-def poll_health_alarms(engine, cluster, alarms: AlarmManager) -> None:
+def poll_health_alarms(engine, cluster, alarms: AlarmManager,
+                       ckpt=None) -> None:
     """Raise/clear the self-healing alarms from observed state.
 
     Polled (node ticker, chaos soak) rather than pushed so the alarm
@@ -66,6 +67,10 @@ def poll_health_alarms(engine, cluster, alarms: AlarmManager) -> None:
         )
     elif alarms.is_active("engine_device_degraded"):
         alarms.deactivate("engine_device_degraded")
+    if ckpt is not None:
+        # checkpoint write()/restore() run on worker threads and only
+        # RECORD alarm transitions; the publish happens here, on-loop
+        ckpt.poll_alarm()
     if cluster is None:
         return
     dropped = getattr(cluster, "spool_dropped", 0)
@@ -353,7 +358,8 @@ class NodeRuntime:
             self.event_message.install(self.broker.hooks)
 
         # ---- observability (1.13) ---------------------------------------
-        self.stats = Stats(self.broker)
+        self.stats = Stats(self.broker,
+                           enable=bool(self.conf.get("stats.enable")))
         self.alarms = AlarmManager(self.broker, node=self.node_name)
         self.slow_subs = SlowSubs()
         self.slow_subs.install(self.broker.hooks)
@@ -967,7 +973,9 @@ class NodeRuntime:
                 self._refresh_stats()
                 self._poll_health_alarms()
                 if self.broker.retainer.store is not None:
-                    self.broker.retainer.store.flush()
+                    # buffered-append flush can stall on disk pressure:
+                    # keep it off the loop like ds.flush_all/ckpt.write
+                    await asyncio.to_thread(self.broker.retainer.store.flush)
                 if self.ds is not None:
                     # only the fsync-heavy flush leaves the loop; GC +
                     # min-cursor + gauges stay ON the loop so the walk
@@ -995,10 +1003,16 @@ class NodeRuntime:
         """Self-healing alarms, polled from the ticker so alarm publish
         (itself a broker publish) never runs on an engine collect
         thread: the device breaker and the forward-spool overflow."""
-        poll_health_alarms(self.broker.engine, self.cluster, self.alarms)
+        poll_health_alarms(self.broker.engine, self.cluster, self.alarms,
+                           ckpt=self.ckpt)
 
     def _refresh_stats(self) -> None:
-        """Periodic gauges (`emqx_stats` setstat points)."""
+        """Periodic gauges (`emqx_stats` setstat points).  `stats.enable`
+        turns the sampling off wholesale (the reference's emqx_stats
+        enable flag; Stats.collect honors the same switch) — dashboards
+        then show the boot-time zeros."""
+        if not self.stats.enable:
+            return
         b = self.broker
         self.stats.setstat("connections.count", len(b.cm.channels))
         self.stats.setstat(

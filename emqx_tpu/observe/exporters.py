@@ -127,8 +127,13 @@ class ExporterRuntime:
         }
         self.prom_pushes = 0
         self.prom_failures = 0
-        self._pusher: Optional[PrometheusPush] = None
-        self._statsd: Optional["StatsdExporter"] = None
+        # rebuilt on the loop by mgmt config updates, read by tick() on
+        # the exporter thread: the swap is an atomic reference store and
+        # tick snapshots the reference once — at worst one tick pushes
+        # through the just-replaced exporter and its OSError is caught
+        # by the exporter loop (node.py _exporter_loop)
+        self._pusher: Optional[PrometheusPush] = None  # analysis: owner=loop
+        self._statsd: Optional["StatsdExporter"] = None  # analysis: owner=loop
         self._last_prom = 0.0
         self._last_statsd = 0.0
         # boot-time validation: bad config is a clear error, not a

@@ -82,8 +82,11 @@ class LatencyHistogram:
     def __init__(self, base: float = 1e-6, n_buckets: int = 40):
         self.base = base
         self.counts = np.zeros(n_buckets, dtype=np.int64)
-        self.sum = 0.0
-        self.count = 0
+        # observed from serve/collect threads, exported on the loop:
+        # GIL-atomic add per sample; a torn read skews one export tick,
+        # never the histogram invariants (lossy telemetry by design)
+        self.sum = 0.0  # analysis: owner=any
+        self.count = 0  # analysis: owner=any
 
     def _index(self, seconds: float) -> int:
         r = seconds / self.base
@@ -198,14 +201,18 @@ class FlightRecorder:
     def __init__(self, size: int = 4096):
         self.size = max(16, int(size))
         self.buf = np.zeros(self.size, dtype=TICK_DTYPE)
-        self.n = 0  # monotonic tick counter (ring index = n % size)
-        self.path_flips = 0
-        self.host_ticks = 0
-        self.dev_ticks = 0
-        self.bytes_up_total = 0
-        self.bytes_down_total = 0
-        self.verify_fail_total = 0
-        self._last_path = -1
+        # recorded from whichever thread serves the tick (loop or
+        # collect executor), rendered on the loop: the ring is lossy
+        # telemetry by design — a torn counter read skews one dump row,
+        # never engine correctness (see module docstring)
+        self.n = 0  # monotonic tick counter (ring index = n % size)  # analysis: owner=any
+        self.path_flips = 0  # analysis: owner=any
+        self.host_ticks = 0  # analysis: owner=any
+        self.dev_ticks = 0  # analysis: owner=any
+        self.bytes_up_total = 0  # analysis: owner=any
+        self.bytes_down_total = 0  # analysis: owner=any
+        self.verify_fail_total = 0  # analysis: owner=any
+        self._last_path = -1  # analysis: owner=any
 
     # ------------------------------------------------------------ hot path
 

@@ -25,8 +25,13 @@ from typing import Dict, Optional
 
 
 class Stats:
-    def __init__(self, broker=None):
+    def __init__(self, broker=None, enable: bool = True):
         self.broker = broker
+        # `stats.enable` (the reference's emqx_stats update-timer flag):
+        # False freezes SAMPLING — the ticker's setstat points and
+        # collect()'s broker-derived refresh are skipped wholesale, so
+        # dashboards/$SYS show the last (boot-time) values
+        self.enable = enable
         self._gauges: Dict[str, float] = {}
         self._maxima: Dict[str, float] = {}
         self._lock = threading.Lock()
@@ -69,7 +74,7 @@ class Stats:
     def collect(self) -> Dict[str, float]:
         """Refresh broker-derived gauges and return the full table."""
         b = self.broker
-        if b is not None:
+        if b is not None and self.enable:
             cm = b.cm
             self.setstat("connections.count", cm.connection_count)
             self.setstat("sessions.count", cm.session_count)

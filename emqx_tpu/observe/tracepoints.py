@@ -34,8 +34,10 @@ _active = False  # fast-path gate: tp() is one bool test when tracing is off
 # Every tp("<kind>", ...) emitted from production code (emqx_tpu/**) MUST
 # be registered here — dashboards and trace consumers key on these names,
 # and an unregistered kind is an event nobody can subscribe to by
-# contract.  `tools/check.py` lints call sites against this registry
-# statically (tests may emit ad-hoc kinds; only the package is linted).
+# contract.  The static-analysis gate (`tools/analysis/registry.py`)
+# lints call sites against this registry in BOTH directions — emitted
+# kinds must be registered, registrations must be emitted — (tests may
+# emit ad-hoc kinds; only the package is linted).
 KNOWN_KINDS: Dict[str, str] = {
     # broker publish path
     "publish_enter": "message accepted into the publish pipeline",

@@ -92,7 +92,7 @@ def _build() -> bool:
     for ext in pymod:
         for extra in (["-march=native"], []):
             try:
-                subprocess.run(
+                subprocess.run(  # analysis: allow-blocking(one-shot toolchain build at import, before the loop exists)
                     base + extra + ext + srcs,
                     check=True, capture_output=True, timeout=120,
                 )

@@ -114,15 +114,22 @@ class MatchTables:
         log2cap: int = 10,
         desc_cap: int = 32,
     ):
+        # ---- concurrency contract (cross-thread lint annotations): the
+        # tables have ONE mutator at a time — runtime churn is serialized
+        # on the event loop (or the churn plane's serial fid phase), boot
+        # restore runs on a to_thread worker before traffic (executor
+        # join publishes the arrays).  Collect threads only READ, and a
+        # mid-grow reference swap hands them the intact OLD array —
+        # the benign-dirty-read model PR 6 documents.
         self.space = space or HashSpace()
-        self.log2cap = log2cap
-        self.desc_cap = desc_cap
+        self.log2cap = log2cap  # analysis: owner=loop
+        self.desc_cap = desc_cap  # analysis: owner=loop
         L = self.space.max_levels
 
         cap = 1 << log2cap
-        self.key_a = np.zeros(cap, dtype=np.uint32)
-        self.key_b = np.zeros(cap, dtype=np.uint32)
-        self.val = np.full(cap, -1, dtype=np.int32)
+        self.key_a = np.zeros(cap, dtype=np.uint32)  # analysis: owner=loop
+        self.key_b = np.zeros(cap, dtype=np.uint32)  # analysis: owner=loop
+        self.val = np.full(cap, -1, dtype=np.int32)  # analysis: owner=loop
 
         self.incl = np.zeros((desc_cap, L), dtype=np.uint32)
         self.k_a = np.zeros(desc_cap, dtype=np.uint32)
@@ -132,20 +139,20 @@ class MatchTables:
         self.wild_root = np.zeros(desc_cap, dtype=bool)
         self.valid = np.zeros(desc_cap, dtype=bool)
 
-        self.n_entries = 0
+        self.n_entries = 0  # analysis: owner=loop
         # shape -> (descriptor index, refcount)
         self._shapes: Dict[Shape, Tuple[int, int]] = {}
-        self._free_desc: List[int] = list(range(desc_cap - 1, -1, -1))
+        self._free_desc: List[int] = list(range(desc_cap - 1, -1, -1))  # analysis: owner=loop
         self._desc_shape: List[Optional[Shape]] = [None] * desc_cap
         # per-fid entry bookkeeping as ARRAYS (a python dict of tuples
         # costs ~1 us/insert and ~150 B/entry at 10M routes — the former
         # round-3 insert bottleneck): key lanes + descriptor index, -1 =
         # absent, grown by doubling over the max fid seen
-        self._ent_cap = 1024
+        self._ent_cap = 1024  # analysis: owner=loop
         self.ent_ha = np.zeros(self._ent_cap, dtype=np.uint32)
         self.ent_hb = np.zeros(self._ent_cap, dtype=np.uint32)
         self.ent_desc = np.full(self._ent_cap, -1, dtype=np.int32)
-        self.delta = Delta()
+        self.delta = Delta()  # analysis: owner=loop
 
     # ------------------------------------------------------------- shapes
 

@@ -415,7 +415,12 @@ class ShardedMatchEngine:
         self._refs: Dict[int, int] = {}
         self._words: Dict[int, List[str]] = {}
         self._fbytes: Dict[int, bytes] = {}
-        self._next_fid = 0
+        # single-mutator contract (same as TopicMatchEngine / ops/
+        # tables.py): runtime churn is serialized on the event loop,
+        # boot warm-restore runs on the pre-serving to_thread worker;
+        # collect threads only read, and mid-grow array swaps hand them
+        # the intact old array (benign-dirty-read model, PR 6)
+        self._next_fid = 0  # analysis: owner=loop
         self._free_fids: List[int] = []
 
         # checkpoint WAL hook (checkpoint/manager.py), same contract as
@@ -427,7 +432,7 @@ class ShardedMatchEngine:
         self.collision_count = 0
         self.on_collision = None
         self._dest_cap = 1024
-        self._dest = np.zeros(self._dest_cap, dtype=np.int32)
+        self._dest = np.zeros(self._dest_cap, dtype=np.int32)  # analysis: owner=loop
         self._dest_dirty = True
 
         self._deep = CpuTrieIndex()

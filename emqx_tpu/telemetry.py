@@ -44,16 +44,18 @@ class Telemetry:
         self._next_report_at = time.time() + REPORT_INTERVAL
 
     def _load_or_create_uuid(self) -> str:
+        # one-shot boot-time IO on a <64-byte uuid file, before the node
+        # serves traffic; not worth an executor hop
         if self._uuid_path and os.path.exists(self._uuid_path):
             with open(self._uuid_path, "r", encoding="utf-8") as f:
-                val = f.read().strip()
+                val = f.read().strip()  # analysis: allow-blocking(boot-time uuid read)
                 if val:
                     return val
         val = str(uuidlib.uuid4())
         if self._uuid_path:
             tmp = self._uuid_path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
-                f.write(val)
+                f.write(val)  # analysis: allow-blocking(boot-time uuid write)
             os.replace(tmp, self._uuid_path)
         return val
 

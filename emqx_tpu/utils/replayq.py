@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import zlib
 from collections import deque
 from typing import Deque, List, Optional, Tuple
@@ -46,7 +47,13 @@ class ReplayQ:
         self.dir = dir
         self.seg_bytes = int(seg_bytes)
         self.max_total_bytes = int(max_total_bytes)
-        self.dropped = 0  # items lost to the overflow policy
+        # the churn WAL appends on the event loop while ack_through runs
+        # inside the checkpoint worker's write(); bridges mix loop-side
+        # appends with to_thread delivery pops — every cursor/segment
+        # access is serialized here (reentrant: append -> _write ->
+        # _enforce_bound nests)
+        self._lock = threading.RLock()
+        self.dropped = 0  # items lost to the overflow policy  # analysis: owner=any
         self._items: Deque[Tuple[int, bytes]] = deque()  # (seqno, item)
         self._next_seq = 1  # seqno of the next appended item
         self._acked = 0  # highest durably-consumed seqno
@@ -71,37 +78,40 @@ class ReplayQ:
         return os.path.join(self.dir, "commit")
 
     def _recover(self) -> None:
-        try:
-            with open(self._commit_path()) as f:
-                self._acked = int(f.read().strip() or 0)
-        except (OSError, ValueError):
-            self._acked = 0
-        self._popped = self._acked
-        names = sorted(
-            (n for n in os.listdir(self.dir)
-             if n.startswith("seg.") and n.endswith(".q")),
-            key=lambda n: int(n.split(".")[1]),
-        )
-        seq = 0
-        for name in names:
-            first = int(name.split(".")[1])
-            path = os.path.join(self.dir, name)
-            seq = first - 1
-            records = self._read_segment(path)
-            for item in records:
-                seq += 1
-                if seq > self._acked:
-                    self._items.append((seq, item))
-            if seq <= self._acked:
-                os.unlink(path)  # fully consumed before the crash
-            else:
-                try:
-                    size = os.path.getsize(path)
-                except OSError:
-                    size = 0
-                self._disk_bytes += size
-                self._segs.append([first, seq, path, size])
-        self._next_seq = max(seq, self._acked) + 1
+        # runs from __init__ only: construction-time replay, before the
+        # queue is shared with any consumer thread
+        with self._lock:
+            try:
+                with open(self._commit_path()) as f:
+                    self._acked = int(f.read().strip() or 0)  # analysis: allow-blocking(construction-time recovery)
+            except (OSError, ValueError):
+                self._acked = 0
+            self._popped = self._acked
+            names = sorted(
+                (n for n in os.listdir(self.dir)
+                 if n.startswith("seg.") and n.endswith(".q")),
+                key=lambda n: int(n.split(".")[1]),
+            )
+            seq = 0
+            for name in names:
+                first = int(name.split(".")[1])
+                path = os.path.join(self.dir, name)
+                seq = first - 1
+                records = self._read_segment(path)
+                for item in records:
+                    seq += 1
+                    if seq > self._acked:
+                        self._items.append((seq, item))
+                if seq <= self._acked:
+                    os.unlink(path)  # fully consumed before the crash
+                else:
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        size = 0
+                    self._disk_bytes += size
+                    self._segs.append([first, seq, path, size])
+            self._next_seq = max(seq, self._acked) + 1
 
     @staticmethod
     def _read_segment(path: str) -> List[bytes]:
@@ -110,7 +120,7 @@ class ReplayQ:
         out: List[bytes] = []
         try:
             with open(path, "rb") as f:
-                data = f.read()
+                data = f.read()  # analysis: allow-blocking(construction-time recovery replay)
         except OSError:
             return out
         off = 0
@@ -130,59 +140,67 @@ class ReplayQ:
 
     def append(self, item: bytes) -> int:
         """Queue one item; returns its seqno."""
-        seq = self._next_seq
-        self._next_seq += 1
-        self._items.append((seq, item))
-        if self.dir is not None:
-            self._write(seq, item)
-        return seq
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            self._items.append((seq, item))
+            if self.dir is not None:
+                self._write(seq, item)
+            return seq
 
     def _write(self, seq: int, item: bytes) -> None:
-        if self._cur is None or self._cur_bytes >= self.seg_bytes:
-            self._rotate(seq)
-        rec = _REC_HDR.pack(len(item), zlib.crc32(item)) + item
-        self._cur.write(rec)
-        self._cur.flush()
-        self._cur_bytes += len(rec)
-        self._cur_last = seq
-        # refresh the open segment's span + size in _segs
-        self._segs[-1][1] = seq
-        self._segs[-1][3] += len(rec)
-        self._disk_bytes += len(rec)
-        if self.max_total_bytes:
-            self._enforce_bound()
+        with self._lock:
+            if self._cur is None or self._cur_bytes >= self.seg_bytes:
+                self._rotate(seq)
+            rec = _REC_HDR.pack(len(item), zlib.crc32(item)) + item
+            # the replayq durability contract: durable-on-return means
+            # one buffered write + flush into the page cache (NO fsync)
+            # on the appender's thread — the docstring's at-least-once
+            # reasoning depends on exactly this
+            self._cur.write(rec)  # analysis: allow-blocking(replayq contract: page-cache write, no fsync)
+            self._cur.flush()  # analysis: allow-blocking(replayq contract: page-cache flush, no fsync)
+            self._cur_bytes += len(rec)
+            self._cur_last = seq
+            # refresh the open segment's span + size in _segs
+            self._segs[-1][1] = seq
+            self._segs[-1][3] += len(rec)
+            self._disk_bytes += len(rec)
+            if self.max_total_bytes:
+                self._enforce_bound()
 
     def _rotate(self, first_seq: int) -> None:
-        if self._cur is not None:
-            self._cur.close()
-        path = os.path.join(self.dir, f"seg.{first_seq}.q")
-        self._cur = open(path, "ab")
-        self._cur_first = first_seq
-        self._cur_last = first_seq - 1
-        self._cur_bytes = 0
-        self._segs.append([first_seq, first_seq - 1, path, 0])
+        with self._lock:
+            if self._cur is not None:
+                self._cur.close()
+            path = os.path.join(self.dir, f"seg.{first_seq}.q")
+            self._cur = open(path, "ab")
+            self._cur_first = first_seq
+            self._cur_last = first_seq - 1
+            self._cur_bytes = 0
+            self._segs.append([first_seq, first_seq - 1, path, 0])
 
     def _enforce_bound(self) -> None:
         """Drop the oldest CLOSED segment while over budget (sizes are
         tracked incrementally — no per-append stat calls)."""
-        while self._disk_bytes > self.max_total_bytes \
-                and len(self._segs) > 1:
-            first, last, path, size = self._segs.pop(0)
-            self._disk_bytes -= size
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-            before = len(self._items)
-            while self._items and self._items[0][0] <= last:
-                self._items.popleft()
-            self.dropped += before - len(self._items)
-            if self._acked < last:
-                self._acked = last
-            if self._popped < last:
-                self._popped = last
-            while self._drop_gaps and self._drop_gaps[0] <= self._acked:
-                self._drop_gaps.popleft()
+        with self._lock:
+            while self._disk_bytes > self.max_total_bytes \
+                    and len(self._segs) > 1:
+                first, last, path, size = self._segs.pop(0)
+                self._disk_bytes -= size
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                before = len(self._items)
+                while self._items and self._items[0][0] <= last:
+                    self._items.popleft()
+                self.dropped += before - len(self._items)
+                if self._acked < last:
+                    self._acked = last
+                if self._popped < last:
+                    self._popped = last
+                while self._drop_gaps and self._drop_gaps[0] <= self._acked:
+                    self._drop_gaps.popleft()
 
     # -------------------------------------------------------------- pop
 
@@ -191,29 +209,31 @@ class ReplayQ:
         """Take up to `count` items (and at most `bytes_limit` payload
         bytes, always ≥1 item).  Returns (ack_ref, items); the items
         stay on disk until `ack(ack_ref)`."""
-        items: List[bytes] = []
-        taken = 0
-        while self._items and len(items) < count:
-            seq, item = self._items[0]
-            if items and bytes_limit is not None and \
-                    taken + len(item) > bytes_limit:
-                break
-            self._items.popleft()
-            items.append(item)
-            taken += len(item)
-            self._popped = seq
-        return self._popped, items
+        with self._lock:
+            items: List[bytes] = []
+            taken = 0
+            while self._items and len(items) < count:
+                seq, item = self._items[0]
+                if items and bytes_limit is not None and \
+                        taken + len(item) > bytes_limit:
+                    break
+                self._items.popleft()
+                items.append(item)
+                taken += len(item)
+                self._popped = seq
+            return self._popped, items
 
     def requeue(self, ack_ref: int, items: List[bytes]) -> None:
         """Return a failed pop to the head of the queue (the items are
         still on disk; this only restores the in-memory view).  The
         items must be exactly one pop's batch, ending at ack_ref."""
-        seq = ack_ref
-        for item in reversed(items):
-            if seq > self._acked:
-                self._items.appendleft((seq, item))
-            seq -= 1
-        self._popped = max(seq, self._acked)
+        with self._lock:
+            seq = ack_ref
+            for item in reversed(items):
+                if seq > self._acked:
+                    self._items.appendleft((seq, item))
+                seq -= 1
+            self._popped = max(seq, self._acked)
 
     def drop_oldest(self, count: int = 1) -> List[bytes]:
         """Overflow eviction: remove up to `count` of the oldest UNPOPPED
@@ -225,79 +245,89 @@ class ReplayQ:
         absorbed lazily as the ack cursor reaches them (on disk, an
         unabsorbed gap may re-deliver after a crash — at-least-once,
         same as a lost ack writeback)."""
-        out: List[bytes] = []
-        while self._items and len(out) < count:
-            seq, item = self._items.popleft()
-            self._drop_gaps.append(seq)
-            out.append(item)
-        if not out:
+        with self._lock:
+            out: List[bytes] = []
+            while self._items and len(out) < count:
+                seq, item = self._items.popleft()
+                self._drop_gaps.append(seq)
+                out.append(item)
+            if not out:
+                return out
+            self.dropped += len(out)
+            prev = self._acked
+            self._absorb_drop_gaps()
+            if self._acked != prev:
+                self._persist_ack()
             return out
-        self.dropped += len(out)
-        prev = self._acked
-        self._absorb_drop_gaps()
-        if self._acked != prev:
-            self._persist_ack()
-        return out
 
     def _absorb_drop_gaps(self) -> None:
         # with no in-flight pop window, the ack cursor may advance over
         # evicted seqnos adjacent to it (drops always come off the head,
         # so the gaps it meets are contiguous) — keeps pending_count()
         # honest and lets disk segments of dropped records be reclaimed
-        while (
-            self._popped == self._acked
-            and self._drop_gaps
-            and self._drop_gaps[0] == self._acked + 1
-        ):
-            self._drop_gaps.popleft()
-            self._acked += 1
-            self._popped = self._acked
+        with self._lock:
+            while (
+                self._popped == self._acked
+                and self._drop_gaps
+                and self._drop_gaps[0] == self._acked + 1
+            ):
+                self._drop_gaps.popleft()
+                self._acked += 1
+                self._popped = self._acked
 
     def ack(self, ack_ref: int) -> None:
         """Commit consumption up to ack_ref (a pop's returned ref)."""
-        prev = self._acked
-        if ack_ref > self._acked:
-            self._acked = ack_ref
-        while self._drop_gaps and self._drop_gaps[0] <= self._acked:
-            self._drop_gaps.popleft()
-        self._absorb_drop_gaps()
-        if self._acked != prev:
-            self._persist_ack()
+        with self._lock:
+            prev = self._acked
+            if ack_ref > self._acked:
+                self._acked = ack_ref
+            while self._drop_gaps and self._drop_gaps[0] <= self._acked:
+                self._drop_gaps.popleft()
+            self._absorb_drop_gaps()
+            if self._acked != prev:
+                self._persist_ack()
 
     def _persist_ack(self) -> None:
-        if self.dir is None:
-            return
-        tmp = self._commit_path() + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(str(self._acked))
-        os.replace(tmp, self._commit_path())  # atomic; no fsync — the
-        # queue is at-least-once (like replayq): a crash between ack
-        # and writeback re-delivers a few confirmed items, never loses
-        # unconfirmed ones, and the publish path never blocks on disk
-        # delete fully-acked segments (closing the current one first
-        # if it is among them — a fresh segment opens on next append)
-        while self._segs and self._segs[0][1] <= self._acked:
-            _first, _last, path, size = self._segs.pop(0)
-            self._disk_bytes -= size
-            if self._cur is not None and not self._segs:
-                self._cur.close()
-                self._cur = None
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+        with self._lock:
+            if self.dir is None:
+                return
+            tmp = self._commit_path() + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(self._acked))  # analysis: allow-blocking(replayq contract: tiny cursor writeback, no fsync)
+            os.replace(tmp, self._commit_path())  # atomic; no fsync — the
+            # queue is at-least-once (like replayq): a crash between ack
+            # and writeback re-delivers a few confirmed items, never
+            # loses unconfirmed ones, and the publish path never blocks
+            # on disk
+            # delete fully-acked segments (closing the current one first
+            # if it is among them — a fresh segment opens on next append)
+            while self._segs and self._segs[0][1] <= self._acked:
+                _first, _last, path, size = self._segs.pop(0)
+                self._disk_bytes -= size
+                if self._cur is not None and not self._segs:
+                    self._cur.close()
+                    self._cur = None
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     # ------------------------------------------------------------ state
 
     def count(self) -> int:
-        return len(self._items)
+        with self._lock:
+            return len(self._items)
 
     def pending_count(self) -> int:
         """Appended-but-unacked records (including popped-unacked ones,
         excluding drop_oldest() evictions) — the durable backlog a
         consumer still owes an ack for.  The churn WAL's snapshot
         threshold reads this (`checkpoint/manager.py`)."""
-        return max(0, self._next_seq - 1 - self._acked - len(self._drop_gaps))
+        with self._lock:
+            return max(
+                0,
+                self._next_seq - 1 - self._acked - len(self._drop_gaps),
+            )
 
     def pending_bytes(self) -> int:
         """Byte size of the unacked backlog.  Disk mode reports the live
@@ -305,11 +335,13 @@ class ReplayQ:
         a partially-acked segment — an upper bound, which is the safe
         direction for a flush threshold).  Memory-only mode sums the
         queued payloads."""
-        if self.dir is not None:
-            return self._disk_bytes
-        return sum(len(item) for _seq, item in self._items)
+        with self._lock:
+            if self.dir is not None:
+                return self._disk_bytes
+            return sum(len(item) for _seq, item in self._items)
 
     def close(self) -> None:
-        if self._cur is not None:
-            self._cur.close()
-            self._cur = None
+        with self._lock:
+            if self._cur is not None:
+                self._cur.close()
+                self._cur = None

@@ -1,6 +1,5 @@
 """Structured trace-point assertions (snabbkaffe ?check_trace analog)."""
 
-import importlib.util
 import os
 
 import pytest
@@ -82,34 +81,31 @@ def test_clean_start_discards():
 
 
 def test_known_kinds_registry_covers_production_call_sites():
-    """tools/check.py lint contract: every literal tp("<kind>") emitted
-    from emqx_tpu/** is registered in KNOWN_KINDS (and the static parse
-    of the registry agrees with the imported one)."""
-    path = os.path.join(
-        os.path.dirname(__file__), "..", "tools", "check.py"
-    )
-    spec = importlib.util.spec_from_file_location("check_tool", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    """Static-analysis lint contract (tools/analysis/registry.py):
+    every literal tp("<kind>") emitted from emqx_tpu/** is registered
+    in KNOWN_KINDS, every registration is emitted somewhere, and the
+    static parse of the registry agrees with the imported one.  (The
+    lint's detection behavior on doctored trees is pinned by
+    tests/test_analysis.py.)"""
+    from tools.analysis import registry as reg
+    from tools.analysis.index import ProjectIndex
 
-    known = mod.known_tp_kinds()
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    idx = ProjectIndex.build(repo, ["emqx_tpu"])
+
+    known = reg.known_tp_kinds(idx)
     assert known == set(KNOWN_KINDS)  # static parse == runtime registry
-    calls = mod.collect_tp_calls()
+    calls = reg.collect_tp_calls(idx)
     assert calls, "lint must see the production tp() call sites"
     unregistered = [(p, l, k) for p, l, k in calls if k not in known]
     assert not unregistered, unregistered
     # the engine flight-recorder family is registered
     assert {"engine.tick", "engine.flip", "engine.probe",
             "engine.stall", "engine.churn"} <= known
-    # and the lint actually fires on an unknown kind
-    problems = []
-    real = mod.collect_tp_calls
-    mod.collect_tp_calls = lambda: [("x.py", 1, "not_a_kind")]
-    try:
-        mod.check_tracepoints(problems)
-    finally:
-        mod.collect_tp_calls = real
-    assert problems and "not_a_kind" in problems[0]
+    # both directions hold on the real tree: nothing unregistered,
+    # nothing registered-but-never-emitted
+    findings = reg.check_tracepoints(idx)
+    assert [f.render() for f in findings] == []
 
 
 def test_engine_trace_kinds_order_assertion():

@@ -1,0 +1,61 @@
+"""Concurrency-aware static-analysis framework (`make check`).
+
+The reference broker runs dialyzer/xref/elvis as part of the build
+(`rebar.config`); neither ships in this image and installs are
+off-limits, so this package implements the same three analyses —
+whole-program success-typing-style inference, cross-reference checking,
+and style lints — directly on the stdlib, specialized to the four
+concurrency domains this codebase actually has (asyncio event loop,
+executor worker threads, the persistent native worker pool, and the
+GIL-free churn plane).
+
+Shared substrate (`index.py`): ONE parse of the whole tree into an AST
+index + call graph, including `asyncio.create_task` /
+`run_in_executor` / `to_thread` / `threading.Thread` edges and method
+resolution through `self` and constructor-inferred attribute types.
+Every pass below runs on that index.
+
+Pass -> reference analog:
+
+* **roles + blocking-call detector** (`roles.py`) — the dialyzer
+  analog: like success typings propagated from known roots, thread
+  roles (loop / worker / pool) propagate from `async def`, executor
+  targets and native pool entry points through the call graph; a
+  blocking primitive (`time.sleep`, `os.fsync`, file writes,
+  `subprocess.*`, blocking `Lock.acquire`, socket ops) reachable on
+  the loop role without an executor hop is the moral equivalent of a
+  dialyzer "will never return" contract violation.  This pass
+  rediscovers PR 4 fix #3 (`time.sleep` fault action freezing the
+  loop) and PR 5 fix #2 (fsync-heavy GC on the wrong thread) from
+  their pre-fix shapes — both are encoded as regression fixtures in
+  tests/test_analysis.py.
+* **cross-thread state lint** (`races.py`) — the dialyzer race
+  detector (`-Wrace_conditions`) analog: `self.<attr>` written from
+  two roles (or written off-loop, read on-loop) must be guarded by one
+  consistently-held `threading.Lock` or carry an explicit
+  `# analysis: owner=<role>` annotation; `await` under a held
+  threading lock is flagged unconditionally.
+* **registry cross-checks** (`registry.py`) — the xref analog
+  (undefined-function-calls + unused-exports, both directions): config
+  keys vs SCHEMA, metrics counters vs PREDEFINED, alarm
+  activate/deactivate pairing, tracepoints vs KNOWN_KINDS (including
+  dead registrations), fault sites vs SITES.
+* **style lints** (`lints.py`) — the elvis analog: the original checks
+  #1-#4 and #8 (syntax, undefined names, unused imports/dup
+  defs/mutable defaults/bare except, `g++ -fsyntax-only`, churn-WAL
+  hook coverage), ported onto the shared index.
+
+Severity tiers: `error` fails always; `warn` fails unless
+grandfathered in the committed `baseline.json` (`baseline.py`).
+`python -m tools.analysis --json` emits machine-readable findings;
+`--changed` limits per-file passes to `git diff` files.  Stdlib-only.
+
+Annotations (all in source comments, linted for well-formedness):
+
+* ``# analysis: owner=<role>``       — deliberate single-owner attr
+* ``# analysis: allow-blocking(<why>)`` — deliberate blocking call
+* ``# check: ignore``                — suppress any finding on a line
+"""
+
+from .index import ProjectIndex  # noqa: F401
+from .report import ERROR, WARN, Finding, Report  # noqa: F401

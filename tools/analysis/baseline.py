@@ -1,0 +1,64 @@
+"""Committed baseline for grandfathered warnings.
+
+`baseline.json` (next to this module, committed) holds line-number-free
+finding fingerprints.  On a run, a *warn*-tier finding whose
+fingerprint is baselined is reported but does not fail the gate; a new
+warning (not in the file) fails like an error.  Errors are NEVER
+baselineable — the dialyzer ignore-file model: style/debt can be
+grandfathered, contract violations cannot.
+
+`--write-baseline` regenerates the file from the current run's
+non-error findings (sorted, deduplicated) so the diff review shows
+exactly which debts are being accepted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Set
+
+from .report import ERROR, Report
+
+BASELINE_NAME = "baseline.json"
+
+
+def baseline_path(repo: str) -> str:
+    return os.path.join(repo, "tools", "analysis", BASELINE_NAME)
+
+
+def load_baseline(path: str) -> Set[str]:
+    if not os.path.isfile(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return set(data.get("findings", []))
+
+
+def apply_baseline(report: Report, fingerprints: Set[str]) -> None:
+    for f in report.findings:
+        if f.severity != ERROR and f.fingerprint in fingerprints:
+            f.baselined = True
+
+
+def write_baseline(report: Report, path: str) -> List[str]:
+    fps = sorted({
+        f.fingerprint for f in report.findings if f.severity != ERROR
+    })
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "comment": (
+                    "grandfathered static-analysis warnings; "
+                    "regenerate with `python -m tools.analysis "
+                    "--write-baseline` (errors are never baselined)"
+                ),
+                "findings": fps,
+            },
+            f, indent=2, sort_keys=True,
+        )
+        f.write("\n")
+    return fps

@@ -1,0 +1,115 @@
+"""CLI driver: build the index once, run every pass with per-pass
+timing, apply the baseline, render text or `--json`.
+
+Exit code 0 = no errors and no non-baselined warnings (the same
+contract the old `tools/check.py` had, now tiered)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List, Optional, Set
+
+from . import baseline as baseline_mod
+from . import lints, races, registry, roles
+from .index import ProjectIndex
+from .report import Report
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+TARGETS = ["emqx_tpu", "tests", "tools", "bench.py",
+           "__graft_entry__.py"]
+
+
+def changed_files(repo: str) -> Optional[Set[str]]:
+    """Repo-relative paths in `git diff` (worktree + staged) plus
+    untracked files; None when git is unavailable."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo, "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=30,
+        )
+        if out.returncode != 0:
+            return None
+        files = set(out.stdout.split())
+        out2 = subprocess.run(
+            ["git", "-C", repo, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30,
+        )
+        if out2.returncode == 0:
+            files |= set(out2.stdout.split())
+        return files
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.analysis",
+        description="concurrency-aware static analysis gate",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--changed", action="store_true",
+                    help="limit per-file passes to `git diff` files")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate baseline.json from this run's "
+                         "warnings")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file path (default: committed one)")
+    ap.add_argument("--no-native", action="store_true",
+                    help="skip the g++ -fsyntax-only pass")
+    args = ap.parse_args(argv)
+
+    report = Report()
+    with report.timed("index"):
+        idx = ProjectIndex.build(REPO, TARGETS)
+    report.n_files = len(idx.files)
+
+    only: Optional[Set[str]] = None
+    if args.changed:
+        only = changed_files(REPO)
+        if only is None:
+            only = set()  # git unavailable: skip per-file passes
+
+    with report.timed("lints"):
+        report.extend(lints.check_syntax(idx))
+        report.extend(lints.check_undefined(idx, only=only))
+        report.extend(lints.check_ast_lints(idx, only=only))
+        report.extend(lints.check_churn_hooks(idx))
+    with report.timed("registry"):
+        report.extend(registry.check_registries(idx))
+    with report.timed("roles"):
+        role_map = roles.infer_roles(idx)
+        report.extend(roles.check_blocking(idx, role_map))
+    with report.timed("races"):
+        report.extend(races.check_races(idx, role_map))
+    if not args.no_native:
+        with report.timed("native"):
+            report.extend(lints.check_native(REPO, only=only))
+
+    bpath = args.baseline or baseline_mod.baseline_path(REPO)
+    if args.write_baseline:
+        fps = baseline_mod.write_baseline(report, bpath)
+        print(f"wrote {len(fps)} fingerprint(s) to "
+              f"{os.path.relpath(bpath, REPO)}", file=sys.stderr)
+    baseline_mod.apply_baseline(
+        report, baseline_mod.load_baseline(bpath)
+    )
+
+    if args.json:
+        print(report.to_json())
+    else:
+        text = report.render_text()
+        if text:
+            print(text)
+    print(report.render_summary(), file=sys.stderr)
+    return report.exit_code()
+
+
+def main() -> int:
+    return run()

@@ -1,0 +1,768 @@
+"""Whole-project AST index + call graph — the shared substrate.
+
+Every pass used to re-walk the tree (eight `os.walk` + `ast.parse`
+sweeps in the old `tools/check.py`); here the project is parsed ONCE
+into a `ProjectIndex`:
+
+* per file: source, AST, `# check: ignore` / `# analysis:` annotated
+  lines, module name;
+* per module: imports (relative imports resolved against the package),
+  top-level defs, classes with base links;
+* per function: a `FuncInfo` keyed `module:Qual.name`, including nested
+  defs;
+* a call graph with typed edges: plain calls, `asyncio.create_task`
+  targets, executor hops (`asyncio.to_thread`, `run_in_executor`,
+  `threading.Thread(target=...)`, concurrent-futures submits).
+
+Receiver resolution is dialyzer-grade best-effort, not sound:
+
+* `self.m()` resolves through the enclosing class and its project base
+  classes;
+* `x.m()` resolves when `x` is a local bound from a project-class
+  constructor, a parameter whose type was inferred from call sites, or
+  a `self.attr` whose type was inferred the same way (including
+  list-of-T from list comprehensions of constructors, probed through
+  `x[i].m()`);
+* as a last resort a method name defined by exactly ONE project class
+  (and not a generic container verb) resolves by uniqueness.
+
+Attribute/parameter types reach a fixed point over a few rounds: a
+constructor call with typed arguments types the callee's parameters,
+which type the `self.x = param` attributes, which type the next round's
+receivers.  Unresolvable calls simply produce no edge — passes treat
+missing edges as "unknown", never as "safe".
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# call-edge kinds
+CALL = "call"  # same-thread call: roles propagate caller -> callee
+EXECUTOR = "executor"  # to_thread / run_in_executor / Thread: worker hop
+TASK = "task"  # create_task / ensure_future: stays on the loop
+
+# method names too generic for unique-name fallback resolution (they
+# collide with dict/list/file/asyncio verbs on untyped receivers)
+_GENERIC_METHODS = {
+    "get", "put", "set", "add", "remove", "close", "start", "stop",
+    "send", "recv", "write", "read", "flush", "append", "pop", "insert",
+    "clear", "update", "keys", "values", "items", "join", "wait",
+    "acquire", "release", "submit", "match", "delete", "encode",
+    "decode", "count", "copy", "index", "extend", "sort", "split",
+    "strip", "load", "save", "tick", "run", "call", "cancel", "result",
+    "done", "open", "name", "next", "drain", "reset", "stats", "check",
+    "setdefault", "discard", "find", "all", "format", "replace", "info",
+    "warning", "error", "debug", "exception", "lower", "upper",
+}
+
+
+@dataclass
+class FileInfo:
+    path: str  # absolute
+    rel: str  # repo-relative
+    module: str  # dotted ("emqx_tpu.broker.broker", "tools.ckpt_dump")
+    src: str
+    tree: Optional[ast.AST]
+    syntax_error: Optional[Tuple[int, str]] = None
+    ignored_lines: Set[int] = field(default_factory=set)
+    # lineno -> annotation text after "# analysis:" (stripped)
+    annotations: Dict[int, str] = field(default_factory=dict)
+    lines: List[str] = field(default_factory=list)
+
+
+@dataclass
+class FuncInfo:
+    key: str  # "module:Qual.name"
+    module: str
+    qualname: str  # "Class.method" | "fn" | "fn.inner"
+    path: str  # repo-relative
+    lineno: int
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    is_async: bool
+    cls: Optional[str] = None  # enclosing class name, if a method
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str
+    path: str
+    lineno: int
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)  # raw base names
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+    # attr -> set of project class names (inferred)
+    attr_types: Dict[str, Set[str]] = field(default_factory=dict)
+    # attr -> set of project class names for list-of-T attributes
+    attr_elem_types: Dict[str, Set[str]] = field(default_factory=dict)
+
+
+@dataclass
+class Edge:
+    caller: str  # FuncInfo.key
+    callee: str  # FuncInfo.key
+    kind: str  # CALL | EXECUTOR | TASK
+    lineno: int
+
+
+def _is_def(n) -> bool:
+    return isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+
+
+def _attr_chain(node) -> Optional[List[str]]:
+    """Attribute/Name chain as a list, e.g. self.ds.flush_all ->
+    ["self", "ds", "flush_all"]; None for non-trivial receivers."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _unwrap_callable(node):
+    """Peel functools.partial(f, ...) down to f; pass through lambdas
+    and plain callable references."""
+    if isinstance(node, ast.Call):
+        chain = _attr_chain(node.func)
+        if chain and chain[-1] == "partial" and node.args:
+            return _unwrap_callable(node.args[0])
+    return node
+
+
+class ProjectIndex:
+    def __init__(self, repo: str):
+        self.repo = repo
+        self.files: Dict[str, FileInfo] = {}  # rel -> FileInfo
+        self.modules: Dict[str, FileInfo] = {}  # dotted -> FileInfo
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, List[ClassInfo]] = {}  # name -> defs
+        self.class_by_qual: Dict[str, ClassInfo] = {}  # "mod:Cls"
+        self.edges: List[Edge] = []
+        # module -> {local name -> ("module", dotted) | ("symbol",
+        # dotted_module, symbol)}
+        self.imports: Dict[str, Dict[str, tuple]] = {}
+        # method name -> [FuncInfo] across all project classes
+        self.method_index: Dict[str, List[FuncInfo]] = {}
+        # module-level str constants: "module:NAME" -> value
+        self.str_constants: Dict[str, str] = {}
+        # executor-hop target keys (for role roots)
+        self.executor_targets: Set[str] = set()
+
+    # ----------------------------------------------------------- loading
+
+    @classmethod
+    def build(cls, repo: str, targets: List[str]) -> "ProjectIndex":
+        idx = cls(repo)
+        for rel in _iter_py(repo, targets):
+            idx._load_file(rel)
+        idx._index_defs()
+        idx._infer_types()
+        idx._build_edges()
+        return idx
+
+    def _load_file(self, rel: str) -> None:
+        path = os.path.join(self.repo, rel)
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        module = rel[:-3].replace(os.sep, ".")
+        if module.endswith(".__init__"):
+            module = module[: -len(".__init__")]
+        lines = src.splitlines()
+        ignored = set()
+        annotations = {}
+        for i, line in enumerate(lines):
+            if "# check: ignore" in line:
+                ignored.add(i + 1)
+            if "# analysis:" in line:
+                annotations[i + 1] = line.split("# analysis:", 1)[1].strip()
+        try:
+            tree = ast.parse(src, path)
+            err = None
+        except SyntaxError as e:
+            tree, err = None, (e.lineno or 0, e.msg or "syntax error")
+        fi = FileInfo(
+            path=path, rel=rel, module=module, src=src, tree=tree,
+            syntax_error=err, ignored_lines=ignored,
+            annotations=annotations, lines=lines,
+        )
+        self.files[rel] = fi
+        self.modules[module] = fi
+
+    # ---------------------------------------------------------- indexing
+
+    def _index_defs(self) -> None:
+        for fi in self.files.values():
+            if fi.tree is None:
+                continue
+            self.imports[fi.module] = self._collect_imports(fi)
+            self._collect_constants(fi)
+            self._walk_scope(fi, fi.tree.body, prefix="", cls=None)
+
+    def _collect_imports(self, fi: FileInfo) -> Dict[str, tuple]:
+        out: Dict[str, tuple] = {}
+        pkg = fi.module.rsplit(".", 1)[0] if "." in fi.module else ""
+        is_pkg = fi.rel.endswith("__init__.py")
+        if is_pkg:
+            pkg = fi.module
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        "module", a.name if a.asname else
+                        a.name.split(".")[0],
+                    )
+                    if a.asname:
+                        out[a.asname] = ("module", a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                if node.level:
+                    # level 1 = this module's package, 2 = parent, ...
+                    parts = (fi.module if is_pkg else (
+                        fi.module.rsplit(".", 1)[0]
+                        if "." in fi.module else ""
+                    )).split(".")
+                    if node.level - 1 > 0:
+                        parts = parts[: len(parts) - (node.level - 1)]
+                    base = ".".join(p for p in parts if p)
+                    target = (
+                        f"{base}.{node.module}" if node.module else base
+                    )
+                else:
+                    target = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    sub = f"{target}.{a.name}"
+                    if sub in self.modules:
+                        out[local] = ("module", sub)
+                    else:
+                        out[local] = ("symbol", target, a.name)
+        return out
+
+    def _collect_constants(self, fi: FileInfo) -> None:
+        for node in fi.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                self.str_constants[
+                    f"{fi.module}:{node.targets[0].id}"
+                ] = node.value.value
+
+    def _walk_scope(self, fi: FileInfo, body, prefix: str,
+                    cls: Optional[ClassInfo]) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                ci = ClassInfo(
+                    name=node.name, module=fi.module, path=fi.rel,
+                    lineno=node.lineno, node=node,
+                    bases=[
+                        b for b in (
+                            (_attr_chain(base) or [None])[-1]
+                            for base in node.bases
+                        ) if b
+                    ],
+                )
+                self.classes.setdefault(node.name, []).append(ci)
+                self.class_by_qual[f"{fi.module}:{node.name}"] = ci
+                self._walk_scope(fi, node.body, prefix=node.name, cls=ci)
+            elif _is_def(node):
+                qual = f"{prefix}.{node.name}" if prefix else node.name
+                info = FuncInfo(
+                    key=f"{fi.module}:{qual}",
+                    module=fi.module,
+                    qualname=qual,
+                    path=fi.rel,
+                    lineno=node.lineno,
+                    node=node,
+                    is_async=isinstance(node, ast.AsyncFunctionDef),
+                    cls=cls.name if cls is not None else None,
+                )
+                self.funcs[info.key] = info
+                if cls is not None and prefix == cls.name:
+                    cls.methods[node.name] = info
+                    self.method_index.setdefault(node.name, []).append(
+                        info
+                    )
+                self._walk_scope(fi, node.body, prefix=qual, cls=cls)
+
+    # ----------------------------------------------------- type inference
+
+    def _resolve_class_name(
+        self, module: str, chain: List[str]
+    ) -> Optional[ClassInfo]:
+        """Resolve a constructor reference (Name or mod.Name chain) to a
+        project class, through this module's imports."""
+        imports = self.imports.get(module, {})
+        name = chain[-1]
+        if len(chain) == 1:
+            # class defined in this module?
+            ci = self.class_by_qual.get(f"{module}:{name}")
+            if ci is not None:
+                return ci
+            imp = imports.get(name)
+            if imp and imp[0] == "symbol":
+                ci = self.class_by_qual.get(f"{imp[1]}:{imp[2]}")
+                if ci is not None:
+                    return ci
+                # one re-export hop through a package __init__
+                init = self.modules.get(imp[1])
+                if init is not None:
+                    sub = self.imports.get(imp[1], {}).get(imp[2])
+                    if sub and sub[0] == "symbol":
+                        return self.class_by_qual.get(
+                            f"{sub[1]}:{sub[2]}"
+                        )
+            return None
+        head = imports.get(chain[0])
+        if head and head[0] == "module":
+            mod = ".".join([head[1]] + chain[1:-1])
+            return self.class_by_qual.get(f"{mod}:{name}")
+        return None
+
+    def _ctor_of(self, module: str, node) -> Optional[ClassInfo]:
+        """node is a Call: project class it constructs, if any."""
+        if not isinstance(node, ast.Call):
+            return None
+        chain = _attr_chain(node.func)
+        if not chain:
+            return None
+        return self._resolve_class_name(module, chain)
+
+    def _infer_types(self) -> None:
+        """Attr/param types to a fixed point (3 rounds is plenty for
+        the depth of composition in this tree)."""
+        # param types: "module:Qual.name" -> {param: {class names}}
+        self.param_types: Dict[str, Dict[str, Set[str]]] = {}
+        for _ in range(3):
+            changed = self._infer_round()
+            if not changed:
+                break
+
+    def _infer_round(self) -> bool:
+        changed = False
+        self._local_cache = {}  # local types depend on param types
+        for cls_list in self.classes.values():
+            for ci in cls_list:
+                for m in ci.methods.values():
+                    changed |= self._infer_method_attrs(ci, m)
+        # constructor call sites -> __init__ param types
+        for info in self.funcs.values():
+            fi = self.files[info.path]
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self._ctor_of(info.module, node)
+                if target is None:
+                    continue
+                init = self.resolve_method(target.name, "__init__")
+                if init is None:
+                    continue
+                params = [
+                    a.arg for a in init.node.args.args if a.arg != "self"
+                ]
+                slot = self.param_types.setdefault(init.key, {})
+                for i, arg in enumerate(node.args):
+                    if i >= len(params):
+                        break
+                    for t in self._expr_types(info, arg):
+                        s = slot.setdefault(params[i], set())
+                        if t not in s:
+                            s.add(t)
+                            changed = True
+                for kw in node.keywords:
+                    if kw.arg is None or kw.arg not in params:
+                        continue
+                    for t in self._expr_types(info, kw.value):
+                        s = slot.setdefault(kw.arg, set())
+                        if t not in s:
+                            s.add(t)
+                            changed = True
+        return changed
+
+    def _infer_method_attrs(self, ci: ClassInfo, m: FuncInfo) -> bool:
+        changed = False
+        for node in ast.walk(m.node):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            value = node.value
+            if value is None:
+                continue
+            for t in targets:
+                if not (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    continue
+                for typ in self._expr_types(m, value):
+                    s = ci.attr_types.setdefault(t.attr, set())
+                    if typ not in s:
+                        s.add(typ)
+                        changed = True
+                for typ in self._expr_elem_types(m, value):
+                    s = ci.attr_elem_types.setdefault(t.attr, set())
+                    if typ not in s:
+                        s.add(typ)
+                        changed = True
+        return changed
+
+    def _expr_types(self, info: FuncInfo, node) -> Set[str]:
+        """Project class names an expression may evaluate to."""
+        node = _strip_or_none(node)
+        ci = self._ctor_of(info.module, node)
+        if ci is not None:
+            return {ci.name}
+        # parameter or local with an inferred type
+        if isinstance(node, ast.Name):
+            out = set(
+                self.param_types.get(info.key, {}).get(node.id, set())
+            )
+            if node.id not in {
+                a.arg for a in info.node.args.args
+            }:
+                out |= self._local_types(info).get(node.id, set())
+            return out
+        # self.attr of the enclosing class
+        chain = _attr_chain(node)
+        if chain and chain[0] == "self" and len(chain) == 2 \
+                and info.cls is not None:
+            out = set()
+            for ci2 in self.classes.get(info.cls, []):
+                for c in self.class_mro(ci2):
+                    out |= c.attr_types.get(chain[1], set())
+            return out
+        return set()
+
+    def _expr_elem_types(self, info: FuncInfo, node) -> Set[str]:
+        """Element types for list-of-T expressions."""
+        node = _strip_or_none(node)
+        out: Set[str] = set()
+        if isinstance(node, ast.ListComp):
+            ci = self._ctor_of(info.module, node.elt)
+            if ci is not None:
+                out.add(ci.name)
+        elif isinstance(node, (ast.List, ast.Tuple)):
+            for el in node.elts:
+                ci = self._ctor_of(info.module, el)
+                if ci is not None:
+                    out.add(ci.name)
+        return out
+
+    # -------------------------------------------------------- call graph
+
+    def class_mro(self, ci: ClassInfo) -> List[ClassInfo]:
+        out, seen = [ci], {ci.name}
+        queue = list(ci.bases)
+        while queue:
+            b = queue.pop(0)
+            if b in seen:
+                continue
+            seen.add(b)
+            for cand in self.classes.get(b, []):
+                out.append(cand)
+                queue.extend(cand.bases)
+        return out
+
+    def resolve_method(
+        self, cls_name: str, method: str
+    ) -> Optional[FuncInfo]:
+        for ci in self.classes.get(cls_name, []):
+            for c in self.class_mro(ci):
+                if method in c.methods:
+                    return c.methods[method]
+        return None
+
+    def resolve_module_func(self, module: str,
+                            name: str) -> Optional[FuncInfo]:
+        """`module.name` as a function, following ONE package-__init__
+        re-export hop (`emqx_tpu.fault:inject` ->
+        `emqx_tpu.fault.plane:inject`)."""
+        cand = self.funcs.get(f"{module}:{name}")
+        if cand is not None:
+            return cand
+        imp = self.imports.get(module, {}).get(name)
+        if imp and imp[0] == "symbol":
+            return self.funcs.get(f"{imp[1]}:{imp[2]}")
+        if imp and imp[0] == "module":
+            return None
+        return None
+
+    def _resolve_call_targets(
+        self, info: FuncInfo, func_node
+    ) -> List[FuncInfo]:
+        """Best-effort: every FuncInfo a call/callable-reference may
+        land in (multiple when a receiver type is ambiguous)."""
+        func_node = _unwrap_callable(func_node)
+        if isinstance(func_node, ast.Lambda):
+            return []  # body is inline; callers' role covers it
+        chain = _attr_chain(func_node)
+        if not chain:
+            return []
+        imports = self.imports.get(info.module, {})
+        if len(chain) == 1:
+            name = chain[0]
+            # nested def inside this function
+            cand = self.funcs.get(f"{info.module}:{info.qualname}.{name}")
+            if cand is not None:
+                return [cand]
+            # sibling nested def (shared enclosing function)
+            if "." in info.qualname:
+                parent = info.qualname.rsplit(".", 1)[0]
+                cand = self.funcs.get(f"{info.module}:{parent}.{name}")
+                if cand is not None:
+                    return [cand]
+            # module-level function
+            cand = self.funcs.get(f"{info.module}:{name}")
+            if cand is not None:
+                return [cand]
+            # constructor -> __init__
+            ci = self._resolve_class_name(info.module, chain)
+            if ci is not None:
+                init = self.resolve_method(ci.name, "__init__")
+                return [init] if init is not None else []
+            imp = imports.get(name)
+            if imp and imp[0] == "symbol":
+                cand = self.funcs.get(f"{imp[1]}:{imp[2]}")
+                if cand is None:
+                    # one more hop through a package __init__
+                    cand = self.resolve_module_func(imp[1], imp[2])
+                if cand is not None:
+                    return [cand]
+            return []
+        # attribute call: receiver . method
+        method = chain[-1]
+        recv = chain[:-1]
+        out: List[FuncInfo] = []
+        for t in sorted(self._receiver_types(info, recv)):
+            got = self.resolve_method(t, method)
+            if got is not None:
+                out.append(got)
+        if out:
+            return out
+        # module attribute: mod.fn() (with package-__init__ hop)
+        head = imports.get(recv[0])
+        if head and head[0] == "module":
+            mod = ".".join([head[1]] + recv[1:])
+            cand = self.resolve_module_func(mod, method)
+            if cand is not None:
+                return [cand]
+            ci2 = self._resolve_class_name(info.module, chain)
+            if ci2 is not None:
+                init = self.resolve_method(ci2.name, "__init__")
+                return [init] if init is not None else []
+        # constructor via module chain (mod.Class())
+        ci3 = self._resolve_class_name(info.module, chain)
+        if ci3 is not None:
+            init = self.resolve_method(ci3.name, "__init__")
+            return [init] if init is not None else []
+        # unique-method fallback
+        if method not in _GENERIC_METHODS and not method.startswith("__"):
+            cands = self.method_index.get(method, [])
+            if len(cands) == 1:
+                return [cands[0]]
+        return []
+
+    def _receiver_types(
+        self, info: FuncInfo, recv: List[str]
+    ) -> Set[str]:
+        """Project class names `recv` (attr chain w/o the method) may
+        hold.  Walks self.attr(.attr)* through inferred attr types;
+        Subscript receivers are pre-flattened by the edge builder."""
+        types: Set[str] = set()
+        if recv[0] == "self" and info.cls is not None:
+            types = {info.cls}
+            rest = recv[1:]
+        else:
+            # local variable / parameter types
+            pt = self.param_types.get(info.key, {})
+            types = set(pt.get(recv[0], set()))
+            types |= self._local_types(info).get(recv[0], set())
+            rest = recv[1:]
+            if not types:
+                return set()
+        for attr in rest:
+            nxt: Set[str] = set()
+            for t in types:
+                for ci in self.classes.get(t, []):
+                    for c in self.class_mro(ci):
+                        nxt |= c.attr_types.get(attr, set())
+            types = nxt
+            if not types:
+                break
+        return types
+
+    def _local_types(self, info: FuncInfo) -> Dict[str, Set[str]]:
+        cache = getattr(self, "_local_cache", None)
+        if cache is None:
+            cache = self._local_cache = {}
+        got = cache.get(info.key)
+        if got is not None:
+            return got
+        # publish the (initially empty) dict BEFORE filling it: a local
+        # assigned from another local would otherwise recurse forever
+        out: Dict[str, Set[str]] = {}
+        cache[info.key] = out
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                for t in self._expr_types(info, node.value):
+                    out.setdefault(name, set()).add(t)
+                for t in self._expr_elem_types(info, node.value):
+                    out.setdefault(f"{name}[]", set()).add(t)
+        cache[info.key] = out
+        return out
+
+    def _subscript_elem_types(
+        self, info: FuncInfo, node
+    ) -> Set[str]:
+        """Types of x[i] / self.attr[i] receivers via elem-type info."""
+        base = node.value
+        chain = _attr_chain(base)
+        if not chain:
+            return set()
+        if chain[0] == "self" and info.cls is not None and len(chain) == 2:
+            out: Set[str] = set()
+            for ci in self.classes.get(info.cls, []):
+                for c in self.class_mro(ci):
+                    out |= c.attr_elem_types.get(chain[1], set())
+            return out
+        if len(chain) == 1:
+            return self._local_types(info).get(f"{chain[0]}[]", set())
+        return set()
+
+    def _build_edges(self) -> None:
+        for info in self.funcs.values():
+            for node in _walk_own_body(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                self._edge_from_call(info, node)
+
+    def _edge_from_call(self, info: FuncInfo, node: ast.Call) -> None:
+        chain = _attr_chain(node.func)
+        attr = chain[-1] if chain else None
+        # executor hops: asyncio.to_thread(f, ...) /
+        # loop.run_in_executor(pool, f, ...) / Thread(target=f) /
+        # pool_executor.submit(f, ...)
+        if attr == "to_thread" and node.args:
+            self._add_callable_edge(info, node.args[0], EXECUTOR,
+                                    node.lineno)
+            return
+        if attr == "run_in_executor" and len(node.args) >= 2:
+            self._add_callable_edge(info, node.args[1], EXECUTOR,
+                                    node.lineno)
+            return
+        if attr == "Thread" or (chain == ["Thread"]):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    self._add_callable_edge(info, kw.value, EXECUTOR,
+                                            node.lineno)
+            return
+        if attr in ("create_task", "ensure_future") and node.args:
+            inner = node.args[0]
+            if isinstance(inner, ast.Call):
+                self._add_callable_edge(info, inner.func, TASK,
+                                        node.lineno)
+            else:
+                self._add_callable_edge(info, inner, TASK, node.lineno)
+            # fall through: the create_task(...) call itself is loop-side
+        # subscript receiver: self.buffers[k].append(...)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Subscript)
+        ):
+            hit = False
+            for t in self._subscript_elem_types(info, node.func.value):
+                got = self.resolve_method(t, node.func.attr)
+                if got is not None:
+                    self.edges.append(
+                        Edge(info.key, got.key, CALL, node.lineno)
+                    )
+                    hit = True
+            if hit:
+                return
+        for target in self._resolve_call_targets(info, node.func):
+            self.edges.append(
+                Edge(info.key, target.key, CALL, node.lineno)
+            )
+
+    def _add_callable_edge(self, info: FuncInfo, expr, kind: str,
+                           lineno: int) -> None:
+        expr = _unwrap_callable(expr)
+        if isinstance(expr, ast.Lambda):
+            # lambda body runs wherever the hop lands: synthesize no
+            # function, but resolve calls inside the lambda directly
+            for sub in ast.walk(expr.body):
+                if isinstance(sub, ast.Call):
+                    for tgt in self._resolve_call_targets(info, sub.func):
+                        self.edges.append(
+                            Edge(info.key, tgt.key, kind, lineno)
+                        )
+                        if kind == EXECUTOR:
+                            self.executor_targets.add(tgt.key)
+            return
+        for target in self._resolve_call_targets(info, expr):
+            self.edges.append(Edge(info.key, target.key, kind, lineno))
+            if kind == EXECUTOR:
+                self.executor_targets.add(target.key)
+
+
+def _strip_or_none(node):
+    """`x or Default()` / `Default() if c else None` -> the ctor arm."""
+    if isinstance(node, ast.BoolOp):
+        for v in node.values:
+            if isinstance(v, ast.Call):
+                return v
+    if isinstance(node, ast.IfExp):
+        if isinstance(node.body, ast.Call):
+            return node.body
+        if isinstance(node.orelse, ast.Call):
+            return node.orelse
+    return node
+
+
+def _walk_own_body(fn):
+    """Walk a function's body WITHOUT descending into nested defs or
+    classes (they are their own FuncInfo scopes); lambdas stay — their
+    bodies execute in this frame (or wherever the reference lands,
+    handled at the hop sites)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        yield n
+        if _is_def(n) or isinstance(n, ast.ClassDef):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _iter_py(repo: str, targets: List[str]):
+    for t in targets:
+        p = os.path.join(repo, t)
+        if os.path.isfile(p):
+            yield t
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.relpath(
+                        os.path.join(root, f), repo
+                    )

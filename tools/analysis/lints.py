@@ -1,0 +1,371 @@
+"""Checks #1-#4 and #8 of the old `tools/check.py`, ported onto the
+shared index: syntax, undefined names (symtable), AST lints (unused
+imports / duplicate defs / mutable defaults / bare except), native
+`g++ -fsyntax-only`, and the churn-WAL hook coverage lint.  One parse
+of the tree instead of eight."""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+import symtable
+import sysconfig
+from typing import List, Optional, Set
+
+from .index import FileInfo, ProjectIndex
+from .report import ERROR, Finding
+
+_KNOWN_GLOBALS = {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__", "__class__",
+    "WindowsError",  # guarded platform use
+}
+
+
+def check_syntax(idx: ProjectIndex) -> List[Finding]:
+    out = []
+    for rel, fi in idx.files.items():
+        if fi.syntax_error is not None:
+            line, msg = fi.syntax_error
+            out.append(Finding(
+                code="syntax", severity=ERROR, path=rel, line=line,
+                message=f"syntax error: {msg}", ident=msg,
+            ))
+    return out
+
+
+def _walk_tables(tab, out):
+    out.append(tab)
+    for child in tab.get_children():
+        _walk_tables(child, out)
+
+
+def check_undefined(idx: ProjectIndex,
+                    only: Optional[Set[str]] = None) -> List[Finding]:
+    import builtins
+
+    findings: List[Finding] = []
+    bi = set(dir(builtins))
+    for rel, fi in idx.files.items():
+        if fi.tree is None or (only is not None and rel not in only):
+            continue
+        try:
+            top = symtable.symtable(fi.src, fi.path, "exec")
+        except SyntaxError:
+            continue
+        skip = False
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.ImportFrom) and any(
+                a.name == "*" for a in node.names
+            ):
+                skip = True  # star imports defeat binding analysis
+                break
+        if skip:
+            continue
+        module_names = set(_KNOWN_GLOBALS)
+        for sym in top.get_symbols():
+            module_names.add(sym.get_name())
+        loads = {}
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ):
+                loads.setdefault(node.id, node.lineno)
+        tabs = []
+        _walk_tables(top, tabs)
+        for tab in tabs[1:]:
+            for sym in tab.get_symbols():
+                name = sym.get_name()
+                if not sym.is_referenced() or sym.is_assigned():
+                    continue
+                if sym.is_parameter() or sym.is_imported():
+                    continue
+                if sym.is_free():
+                    continue
+                if name in module_names or name in bi:
+                    continue
+                line = loads.get(name, tab.get_lineno())
+                if line in fi.ignored_lines:
+                    continue
+                findings.append(Finding(
+                    code="undefined", severity=ERROR, path=rel,
+                    line=line,
+                    message=(
+                        f"undefined name {name!r} "
+                        f"(in {tab.get_name()})"
+                    ),
+                    ident=f"{tab.get_name()}:{name}",
+                ))
+    return findings
+
+
+def check_ast_lints(idx: ProjectIndex,
+                    only: Optional[Set[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, fi in idx.files.items():
+        if fi.tree is None or (only is not None and rel not in only):
+            continue
+        findings.extend(_lint_file(rel, fi))
+    return findings
+
+
+def _lint_file(rel: str, fi: FileInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    tree, ignored = fi.tree, fi.ignored_lines
+    base = os.path.basename(rel)
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+    all_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        for el in node.value.elts:
+                            if isinstance(el, ast.Constant):
+                                all_names.add(el.value)
+    if base != "__init__.py":  # __init__ re-export surfaces are the API
+        for node in tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                if isinstance(node, ast.ImportFrom) \
+                        and node.module == "__future__":
+                    continue
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    if a.name == "*" or name.startswith("_"):
+                        continue
+                    if name not in used and name not in all_names \
+                            and node.lineno not in ignored:
+                        findings.append(Finding(
+                            code="unused-import", severity=ERROR,
+                            path=rel, line=node.lineno,
+                            message=f"unused import {name!r}",
+                            ident=name,
+                        ))
+
+    def dup_scan(body, scope):
+        seen = {}
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                prev = seen.get(node.name)
+                decs = {
+                    d.attr if isinstance(d, ast.Attribute)
+                    else getattr(d, "id", None)
+                    for d in getattr(node, "decorator_list", [])
+                }
+                if prev is not None and not decs & {"setter", "getter",
+                                                    "deleter",
+                                                    "overload"}:
+                    if node.lineno not in ignored:
+                        findings.append(Finding(
+                            code="duplicate-def", severity=ERROR,
+                            path=rel, line=node.lineno,
+                            message=(
+                                f"duplicate definition of "
+                                f"{node.name!r} in {scope} "
+                                f"(first at line {prev})"
+                            ),
+                            ident=f"{scope}:{node.name}",
+                        ))
+                seen[node.name] = node.lineno
+                if isinstance(node, ast.ClassDef):
+                    dup_scan(node.body, f"class {node.name}")
+
+    dup_scan(tree.body, "module")
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) \
+                        and node.lineno not in ignored:
+                    findings.append(Finding(
+                        code="mutable-default", severity=ERROR,
+                        path=rel, line=node.lineno,
+                        message=(
+                            "mutable default argument in "
+                            f"{node.name!r}"
+                        ),
+                        ident=node.name,
+                    ))
+        elif isinstance(node, ast.ExceptHandler):
+            if node.type is None and node.lineno not in ignored:
+                findings.append(Finding(
+                    code="bare-except", severity=ERROR, path=rel,
+                    line=node.lineno,
+                    message=(
+                        "bare `except:` (catches SystemExit/"
+                        "KeyboardInterrupt)"
+                    ),
+                    ident=f"L{node.lineno}",
+                ))
+    return findings
+
+
+# ------------------------------------------------------- churn WAL hook
+
+ENGINE_CLASSES = {
+    os.path.join("emqx_tpu", "models", "engine.py"): {"TopicMatchEngine"},
+    os.path.join("emqx_tpu", "parallel", "sharded.py"): {
+        "ShardedMatchEngine"
+    },
+}
+TABLE_MUTATORS = {
+    "insert", "delete", "delete_batch", "churn_insert",
+    "churn_insert_keys", "bulk_insert", "bulk_insert_keys",
+    "apply_planned",
+}
+PLANE_HELPERS = {"_plane_churn", "_plane_apply"}
+CHURN_HOOK_EXEMPT = {"restore_checkpoint"}  # state adoption, not churn
+
+
+def _subtree_names(node):
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def _walk_outside_except(node):
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, ast.ExceptHandler):
+                continue
+            stack.append(child)
+
+
+def _method_mutates(fn) -> bool:
+    for n in _walk_outside_except(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if f.attr in TABLE_MUTATORS:
+            names = _subtree_names(f.value)
+            if "tables" in names or "shards" in names:
+                return True
+        elif f.attr == "apply":
+            if isinstance(f.value, ast.Attribute) \
+                    and f.value.attr == "_plane":
+                return True
+        elif f.attr in PLANE_HELPERS:
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                return True
+    return False
+
+
+def check_churn_hooks(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, classes in ENGINE_CLASSES.items():
+        fi = idx.files.get(rel)
+        if fi is None or fi.tree is None:
+            continue
+        ignored = fi.ignored_lines
+        for cls in ast.walk(fi.tree):
+            if not (isinstance(cls, ast.ClassDef)
+                    and cls.name in classes):
+                continue
+            methods = [
+                n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            mutating = {m.name for m in methods if _method_mutates(m)}
+            private_mut = {m for m in mutating if m.startswith("_")}
+            for m in methods:
+                if m.name.startswith("_") or m.name in CHURN_HOOK_EXEMPT:
+                    continue
+                direct = m.name in mutating
+                via_helper = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in private_mut
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "self"
+                    for n in _walk_outside_except(m)
+                )
+                if not (direct or via_helper):
+                    continue
+                refs_hook = any(
+                    isinstance(n, ast.Attribute) and n.attr == "on_churn"
+                    for n in ast.walk(m)
+                )
+                if not refs_hook and m.lineno not in ignored:
+                    findings.append(Finding(
+                        code="churn-hook", severity=ERROR, path=rel,
+                        line=m.lineno,
+                        message=(
+                            f"{cls.name}.{m.name} mutates match-table/"
+                            "churn-plane state without firing the "
+                            "on_churn WAL hook"
+                        ),
+                        ident=f"{cls.name}.{m.name}",
+                    ))
+                for n in ast.walk(m):
+                    if not isinstance(n, (ast.For, ast.AsyncFor,
+                                          ast.While)):
+                        continue
+                    for c in ast.walk(n):
+                        if (
+                            isinstance(c, ast.Call)
+                            and isinstance(c.func, ast.Attribute)
+                            and c.func.attr == "on_churn"
+                            and c.lineno not in ignored
+                        ):
+                            findings.append(Finding(
+                                code="churn-hook-loop", severity=ERROR,
+                                path=rel, line=c.lineno,
+                                message=(
+                                    f"{cls.name}.{m.name} calls "
+                                    "on_churn inside a loop (WAL "
+                                    "records are one per mutation "
+                                    "batch)"
+                                ),
+                                ident=f"{cls.name}.{m.name}:loop",
+                            ))
+    return findings
+
+
+# -------------------------------------------------------------- native
+
+
+def check_native(repo: str,
+                 only: Optional[Set[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    src_dir = os.path.join(repo, "native")
+    if not os.path.isdir(src_dir):
+        return findings
+    srcs = sorted(
+        os.path.join(src_dir, f)
+        for f in os.listdir(src_dir)
+        if f.endswith(".cc")
+    )
+    if only is not None:
+        srcs = [
+            s for s in srcs
+            if os.path.relpath(s, repo) in only
+        ]
+    inc = sysconfig.get_paths().get("include") or ""
+    for s in srcs:
+        cmd = ["g++", "-fsyntax-only", "-Wall", "-Wextra",
+               "-Wno-unused-parameter", "-std=c++17", "-march=native"]
+        if inc:
+            cmd.append(f"-I{inc}")
+        r = subprocess.run(cmd + [s], capture_output=True, text=True,
+                           timeout=120)
+        if r.returncode != 0 or r.stderr.strip():
+            rel = os.path.relpath(s, repo)
+            findings.append(Finding(
+                code="native", severity=ERROR, path=rel, line=1,
+                message=f"g++ -Wall -Wextra:\n{r.stderr.strip()}",
+                ident=os.path.basename(s),
+            ))
+    return findings

@@ -1,0 +1,259 @@
+"""Pass (b): cross-thread state lint.
+
+For every class in the package: collect `self.<attr>` writes and reads
+per method, join with the thread-role classification of each method
+(roles pass).  An attribute is *shared* when
+
+* it is written from >= 2 distinct roles, or
+* it is written off-loop (worker/pool) and read on-loop (or written
+  on-loop and read off-loop);
+
+and a shared attribute must be either
+
+* guarded by ONE consistently-held `threading.Lock`-family attribute in
+  every non-`__init__` access (`with self._lock:` lexically encloses
+  the access), or
+* annotated `# analysis: owner=<role>` on a line that mentions the
+  attribute (typically its `__init__` assignment), asserting a
+  deliberate single-owner / benign-race design with the justification
+  in the surrounding comment.
+
+`__init__`/`__new__` writes are construction (happens-before publish)
+and contribute neither a role nor an unguarded access.  Methods with no
+inferred role are unknown, not safe — they don't create multi-role
+evidence, but an unguarded access in one does not clear a finding
+either.
+
+Also flagged here: `await` while a `threading.Lock` is held (`with
+self._lock: ... await ...`) — the loop parks inside the critical
+section and every worker contending on that lock stalls behind a
+suspended coroutine.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .index import FuncInfo, ProjectIndex, _attr_chain
+from .report import ERROR, Finding
+from .roles import LOOP
+
+_CTOR_METHODS = {"__init__", "__new__", "__post_init__"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+@dataclass
+class _Access:
+    method: str
+    lineno: int
+    is_write: bool
+    locks: frozenset  # lock attr names held at this access
+
+
+@dataclass
+class _ClassState:
+    lock_attrs: Set[str] = field(default_factory=set)
+    accesses: Dict[str, List[_Access]] = field(default_factory=dict)
+    owner_annotated: Dict[str, str] = field(default_factory=dict)
+
+
+def check_races(
+    idx: ProjectIndex,
+    roles: Dict[str, Set[str]],
+    package_prefix: str = "emqx_tpu",
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls_list in idx.classes.values():
+        for ci in cls_list:
+            if not ci.module.startswith(package_prefix):
+                continue
+            st = _collect_class(idx, ci)
+            findings.extend(_judge_class(idx, ci, st, roles))
+            findings.extend(_check_await_under_lock(idx, ci, st))
+    return findings
+
+
+def _collect_class(idx: ProjectIndex, ci) -> _ClassState:
+    st = _ClassState()
+    fi = idx.files[ci.path]
+    # lock attributes: self.x = threading.Lock()/RLock()/Condition()
+    for m in ci.methods.values():
+        for node in ast.walk(m.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                chain = _attr_chain(v.func)
+                if chain and chain[-1] in _LOCK_CTORS:
+                    for t in node.targets:
+                        tc = _attr_chain(t)
+                        if tc and tc[0] == "self" and len(tc) == 2:
+                            st.lock_attrs.add(tc[1])
+    # owner annotations: "# analysis: owner=<role>" on a line that
+    # mentions self.<attr> inside this class's span
+    end = getattr(ci.node, "end_lineno", None) or ci.lineno
+    for lineno, ann in fi.annotations.items():
+        if not (ci.lineno <= lineno <= end):
+            continue
+        if not ann.startswith("owner="):
+            continue
+        role = ann[len("owner="):].split()[0].split("(")[0].strip()
+        line = fi.lines[lineno - 1]
+        # every self.<attr> mentioned on the annotated line
+        try:
+            expr = ast.parse(line.split("#", 1)[0].strip(), mode="exec")
+        except SyntaxError:
+            expr = None
+        names = set()
+        if expr is not None:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Attribute) and isinstance(
+                    n.value, ast.Name
+                ) and n.value.id == "self":
+                    names.add(n.attr)
+        for name in names:
+            st.owner_annotated[name] = role
+    # accesses per method, with the lexical lock-held set
+    for m in ci.methods.values():
+        _collect_accesses(m, st)
+    return st
+
+
+def _collect_accesses(m: FuncInfo, st: _ClassState) -> None:
+    def visit(node, held: frozenset):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                chain = _attr_chain(item.context_expr)
+                if chain and chain[0] == "self" and len(chain) == 2 \
+                        and chain[1] in st.lock_attrs:
+                    inner = inner | {chain[1]}
+            for child in node.body:
+                visit(child, inner)
+            for item in node.items:
+                visit(item.context_expr, held)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes are their own functions
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ) and node.value.id == "self":
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            if node.attr not in st.lock_attrs:
+                st.accesses.setdefault(node.attr, []).append(_Access(
+                    method=m.qualname.split(".")[-1],
+                    lineno=node.lineno,
+                    is_write=is_write,
+                    locks=held,
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for child in ast.iter_child_nodes(m.node):
+        visit(child, frozenset())
+
+
+def _judge_class(idx: ProjectIndex, ci, st: _ClassState,
+                 roles: Dict[str, Set[str]]) -> List[Finding]:
+    findings: List[Finding] = []
+    method_roles: Dict[str, Set[str]] = {}
+    for name, m in ci.methods.items():
+        method_roles[name] = set(roles.get(m.key, set()))
+    fi = idx.files[ci.path]
+    for attr, accesses in sorted(st.accesses.items()):
+        write_roles: Set[str] = set()
+        read_roles: Set[str] = set()
+        for a in accesses:
+            if a.method in _CTOR_METHODS:
+                continue
+            r = method_roles.get(a.method, set())
+            if a.is_write:
+                write_roles |= r
+            else:
+                read_roles |= r
+        shared = (
+            len(write_roles) >= 2
+            or (write_roles - {LOOP} and LOOP in read_roles)
+            or (LOOP in write_roles and read_roles - {LOOP})
+        )
+        if not shared:
+            continue
+        if attr in st.owner_annotated:
+            continue  # deliberate; justification lives at the annotation
+        # consistently-locked: every non-ctor access holds one common lock
+        locked = [
+            a for a in accesses if a.method not in _CTOR_METHODS
+        ]
+        common = None
+        for a in locked:
+            common = set(a.locks) if common is None else common & a.locks
+            if not common:
+                break
+        if common:
+            continue
+        unguarded = [a for a in locked if not a.locks]
+        where = unguarded[0] if unguarded else locked[0]
+        if where.lineno in fi.ignored_lines:
+            continue
+        wr = ",".join(sorted(write_roles)) or "?"
+        rd = ",".join(sorted(read_roles)) or "?"
+        findings.append(Finding(
+            code="race", severity=ERROR, path=ci.path,
+            line=where.lineno,
+            message=(
+                f"{ci.name}.{attr} is written from role(s) [{wr}] and "
+                f"read from [{rd}] without a consistently-held "
+                "threading.Lock — guard every access with one lock or "
+                "annotate the attribute `# analysis: owner=<role>` with "
+                "a justifying comment"
+            ),
+            ident=f"{ci.name}.{attr}",
+        ))
+    return findings
+
+
+def _check_await_under_lock(idx: ProjectIndex, ci,
+                            st: _ClassState) -> List[Finding]:
+    findings: List[Finding] = []
+    fi = idx.files[ci.path]
+    for m in ci.methods.values():
+        if not m.is_async:
+            continue
+
+        def visit(node, held: Optional[str]):
+            if isinstance(node, ast.With):
+                inner = held
+                for item in node.items:
+                    chain = _attr_chain(item.context_expr)
+                    if chain and chain[0] == "self" and len(chain) == 2 \
+                            and chain[1] in st.lock_attrs:
+                        inner = chain[1]
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return
+            if isinstance(node, ast.Await) and held is not None \
+                    and node.lineno not in fi.ignored_lines:
+                findings.append(Finding(
+                    code="await-under-lock", severity=ERROR,
+                    path=ci.path, line=node.lineno,
+                    message=(
+                        f"await while holding threading lock "
+                        f"self.{held} in {ci.name}."
+                        f"{m.qualname.split('.')[-1]} — the coroutine "
+                        "can suspend inside the critical section and "
+                        "stall every thread contending on the lock"
+                    ),
+                    ident=f"{ci.name}.{m.qualname.split('.')[-1]}:{held}",
+                ))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for child in ast.iter_child_nodes(m.node):
+            visit(child, None)
+    return findings

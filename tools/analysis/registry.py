@@ -1,0 +1,537 @@
+"""Pass (c): registry cross-checks, generalized — the xref analog.
+
+xref proves every remote call lands on an exported function AND that
+every export is called; this pass does both directions for every
+name-registry the broker keys runtime behavior on:
+
+* **config**: every literal `*.get("ns.key")` in the package must name
+  a key declared in `config/config.py` SCHEMA (read => declared: a key
+  read but never declared always resolves to the fallback and silently
+  disables what it configures), and every declared key must be read
+  somewhere in emqx_tpu/tools/bench (declared => read: silent no-op
+  config is worse than missing config).  Namespace-wide reads
+  (`conf.get("mqtt")` + `m["max_inflight"]` subscripts) and f-string
+  reads (`conf.get(f"event_message.{k}")`) are tracked; a dynamic read
+  marks the namespace covered for the dead-key direction.
+* **metrics counters**: `.inc("name")` call sites vs the PREDEFINED
+  list in `broker/metrics.py`, both directions.
+* **alarms**: every `alarms.activate("name")` needs a matching
+  `deactivate`/`is_active` somewhere (an alarm nothing ever clears is
+  stuck forever) and vice versa (clearing an alarm nothing raises is
+  dead code).  Module-level string constants are resolved.
+* **tracepoints**: emitted => registered in KNOWN_KINDS (the old check
+  #5) and registered => emitted from production code (dead
+  registrations are events nobody can ever see), plus the retained.*
+  ownership rule from check #7's sibling.
+* **fault sites**: injected => registered in SITES (old check #6);
+  registered-but-never-injected is reported as a warning.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .index import ProjectIndex, _attr_chain
+from .report import ERROR, WARN, Finding
+
+CONFIG_PATH = os.path.join("emqx_tpu", "config", "config.py")
+TRACEPOINTS_PATH = os.path.join("emqx_tpu", "observe", "tracepoints.py")
+METRICS_PATH = os.path.join("emqx_tpu", "broker", "metrics.py")
+SITES_PATH = os.path.join("emqx_tpu", "fault", "sites.py")
+
+# retained.* tracepoints are owned by exactly these two modules (the
+# retained device-index plane, ISSUE 7)
+RETAINED_TP_FILES = (
+    os.path.join("emqx_tpu", "models", "retained.py"),
+    os.path.join("emqx_tpu", "broker", "retainer.py"),
+)
+
+FAULT_FNS = {"inject", "ainject", "peek", "mangle"}
+
+
+# ------------------------------------------------------------ registries
+
+
+def _module_dict_keys(idx: ProjectIndex, rel: str,
+                      var: str) -> Optional[Set[str]]:
+    """Top-level `VAR = {...}` string keys, statically."""
+    fi = idx.files.get(rel)
+    if fi is None or fi.tree is None:
+        return None
+    for node in ast.walk(fi.tree):
+        tgt = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            tgt = node.target
+        if isinstance(tgt, ast.Name) and tgt.id == var and isinstance(
+            node.value, ast.Dict
+        ):
+            return {
+                k.value for k in node.value.keys
+                if isinstance(k, ast.Constant) and isinstance(
+                    k.value, str
+                )
+            }
+    return None
+
+
+def known_tp_kinds(idx: ProjectIndex) -> Set[str]:
+    return _module_dict_keys(idx, TRACEPOINTS_PATH, "KNOWN_KINDS") or set()
+
+
+def known_fault_sites(idx: ProjectIndex) -> Set[str]:
+    return _module_dict_keys(idx, SITES_PATH, "SITES") or set()
+
+
+def schema_keys(idx: ProjectIndex) -> Dict[str, Set[str]]:
+    """SCHEMA as {namespace: {key, ...}} parsed statically."""
+    fi = idx.files.get(CONFIG_PATH)
+    out: Dict[str, Set[str]] = {}
+    if fi is None or fi.tree is None:
+        return out
+    for node in ast.walk(fi.tree):
+        tgt = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            tgt = node.target
+        if not (isinstance(tgt, ast.Name) and tgt.id == "SCHEMA"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and isinstance(v, ast.Dict):
+                out[k.value] = {
+                    f.value for f in v.keys
+                    if isinstance(f, ast.Constant)
+                    and isinstance(f.value, str)
+                }
+    return out
+
+
+def predefined_metrics(idx: ProjectIndex) -> Set[str]:
+    fi = idx.files.get(METRICS_PATH)
+    if fi is None or fi.tree is None:
+        return set()
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "PREDEFINED" and \
+                isinstance(node.value, ast.List):
+            return {
+                el.value for el in node.value.elts
+                if isinstance(el, ast.Constant)
+                and isinstance(el.value, str)
+            }
+    return set()
+
+
+# ----------------------------------------------------------- collectors
+
+
+def _literal_str(idx: ProjectIndex, module: str, node) -> Optional[str]:
+    """A string literal or a module-level str constant by name."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return idx.str_constants.get(f"{module}:{node.id}")
+    return None
+
+
+def collect_config_reads(
+    idx: ProjectIndex, package_prefix: str = "emqx_tpu",
+    extra_prefixes: Tuple[str, ...] = ("tools", "bench"),
+):
+    """Returns (key_reads, ns_dynamic, problems_input):
+
+    * key_reads: {(ns, key): [(rel, line)]} — literal dotted reads plus
+      subscript reads under a namespace-wide get;
+    * ns_dynamic: namespaces read via f-strings/variables (dead-key
+      direction treats every key of such a namespace as read).
+    """
+    schema = schema_keys(idx)
+    key_reads: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+    ns_dynamic: Set[str] = set()
+    nonliteral: List[Tuple[str, int]] = []
+    for rel, fi in idx.files.items():
+        if fi.tree is None:
+            continue
+        mod = fi.module
+        if not (mod.startswith(package_prefix)
+                or mod.startswith(extra_prefixes)):
+            continue
+        # config.py itself: only channel_config_from & friends read
+        # concrete keys; the generic schema machinery uses variables
+        # and is invisible to the literal collector by construction
+        # namespaces read wholesale in this file -> their keys seen as
+        # plain string constants in the file count as key reads
+        ns_whole: Set[str] = set()
+        consts: Dict[str, List[int]] = {}
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ):
+                consts.setdefault(node.value, []).append(
+                    getattr(node, "lineno", 0)
+                )
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr in
+                    ("get", "put")) or not node.args:
+                continue
+            arg = node.args[0]
+            val = _literal_str(idx, mod, arg)
+            if val is not None:
+                ns, _, name = val.partition(".")
+                if ns in schema and name:
+                    if name in schema[ns]:
+                        key_reads.setdefault((ns, name), []).append(
+                            (rel, node.lineno)
+                        )
+                    else:
+                        # undeclared read: recorded with key for the
+                        # read=>declared direction
+                        key_reads.setdefault((ns, name), []).append(
+                            (rel, node.lineno)
+                        )
+                elif val in schema:
+                    ns_whole.add(val)
+            elif isinstance(arg, ast.JoinedStr):
+                # f"ns.{...}" / f"{...}" — extract the static prefix
+                prefix = ""
+                if arg.values and isinstance(arg.values[0], ast.Constant):
+                    prefix = str(arg.values[0].value)
+                ns = prefix.split(".", 1)[0] if "." in prefix else None
+                if ns in schema:
+                    ns_dynamic.add(ns)
+                else:
+                    nonliteral.append((rel, node.lineno))
+        for ns in ns_whole:
+            for key in schema[ns]:
+                # "ckpt.enable"-style nested keys are read as
+                # "engine.ckpt.enable" dotted gets, not subscripts
+                for part in {key, key.split(".")[-1]}:
+                    if part in consts:
+                        key_reads.setdefault((ns, key), []).append(
+                            (rel, consts[part][0])
+                        )
+                        break
+    return key_reads, ns_dynamic, nonliteral
+
+
+def collect_tp_calls(idx: ProjectIndex,
+                     package_prefix: str = "emqx_tpu"):
+    """(rel, lineno, kind) for every literal-kind tp(...) call,
+    including import aliases (`from ..tracepoints import tp as
+    tracept`) and module-attribute calls (`_tps.tp(...)`)."""
+    out = []
+    for rel, fi in idx.files.items():
+        if fi.tree is None or not fi.module.startswith(package_prefix):
+            continue
+        # local names bound to the tp entry point in this module
+        aliases = {"tp"}
+        for local, imp in idx.imports.get(fi.module, {}).items():
+            if imp[0] == "symbol" and imp[2] == "tp" and \
+                    imp[1].endswith("tracepoints"):
+                aliases.add(local)
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else None
+            )
+            if name in aliases and node.args:
+                kind = _literal_str(idx, fi.module, node.args[0])
+                if kind is not None:
+                    out.append((rel, node.lineno, kind))
+    return out
+
+
+def collect_fault_calls(idx: ProjectIndex,
+                        package_prefix: str = "emqx_tpu"):
+    """(rel, lineno, site|None) for fault.<fn>(...) calls outside the
+    fault package itself (None = non-literal site)."""
+    out = []
+    for rel, fi in idx.files.items():
+        if fi.tree is None or not fi.module.startswith(package_prefix):
+            continue
+        if fi.module.startswith("emqx_tpu.fault"):
+            continue
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in FAULT_FNS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("fault", "_fault")
+            ):
+                continue
+            site = (
+                _literal_str(idx, fi.module, node.args[0])
+                if node.args else None
+            )
+            out.append((rel, node.lineno, site))
+    return out
+
+
+def _collect_named_calls(idx: ProjectIndex, attrs: Set[str],
+                         package_prefix: str = "emqx_tpu"):
+    """(rel, lineno, attr, name) for `<x>.<attr>("<name>")` calls."""
+    out = []
+    for rel, fi in idx.files.items():
+        if fi.tree is None or not fi.module.startswith(package_prefix):
+            continue
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr in attrs):
+                continue
+            if not node.args:
+                continue
+            name = _literal_str(idx, fi.module, node.args[0])
+            out.append((rel, node.lineno, fn.attr, name))
+    return out
+
+
+# --------------------------------------------------------------- checks
+
+
+def check_config(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    schema = schema_keys(idx)
+    if not schema:
+        findings.append(Finding(
+            code="cfg-schema", severity=ERROR, path=CONFIG_PATH, line=1,
+            message="SCHEMA dict missing or unparseable", ident="SCHEMA",
+        ))
+        return findings
+    key_reads, ns_dynamic, _nonlit = collect_config_reads(idx)
+    # read => declared
+    for (ns, key), sites in sorted(key_reads.items()):
+        if key not in schema.get(ns, set()):
+            rel, line = sites[0]
+            findings.append(Finding(
+                code="cfg-undeclared", severity=ERROR, path=rel,
+                line=line,
+                message=(
+                    f"config key {ns}.{key!r} read but not declared in "
+                    f"config/config.py SCHEMA[{ns!r}] — it always "
+                    "resolves to the fallback"
+                ),
+                ident=f"{ns}.{key}",
+            ))
+    # declared => read
+    for ns, keys in sorted(schema.items()):
+        if ns in ns_dynamic:
+            continue
+        for key in sorted(keys):
+            if (ns, key) not in key_reads:
+                findings.append(Finding(
+                    code="cfg-dead", severity=WARN, path=CONFIG_PATH,
+                    line=1,
+                    message=(
+                        f"SCHEMA key {ns}.{key} is declared but never "
+                        "read anywhere in emqx_tpu/tools/bench — "
+                        "silent no-op config; wire it or remove it"
+                    ),
+                    ident=f"{ns}.{key}",
+                ))
+    return findings
+
+
+def check_tracepoints(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    known = known_tp_kinds(idx)
+    if not known:
+        findings.append(Finding(
+            code="tp-registry", severity=ERROR, path=TRACEPOINTS_PATH,
+            line=1, message="KNOWN_KINDS registry missing",
+            ident="KNOWN_KINDS",
+        ))
+        return findings
+    calls = collect_tp_calls(idx)
+    emitted: Dict[str, List[Tuple[str, int]]] = {}
+    for rel, line, kind in calls:
+        emitted.setdefault(kind, []).append((rel, line))
+        if kind not in known:
+            findings.append(Finding(
+                code="tp-unregistered", severity=ERROR, path=rel,
+                line=line,
+                message=(
+                    f"tp kind {kind!r} not registered in "
+                    "observe/tracepoints.py KNOWN_KINDS"
+                ),
+                ident=kind,
+            ))
+        if kind.startswith("retained.") and rel not in RETAINED_TP_FILES:
+            findings.append(Finding(
+                code="tp-owner", severity=ERROR, path=rel, line=line,
+                message=(
+                    f"retained.* tracepoint {kind!r} emitted outside "
+                    "models/retained.py / broker/retainer.py"
+                ),
+                ident=kind,
+            ))
+    for kind in sorted(known - set(emitted)):
+        findings.append(Finding(
+            code="tp-dead", severity=ERROR, path=TRACEPOINTS_PATH,
+            line=1,
+            message=(
+                f"registered tracepoint kind {kind!r} is never emitted "
+                "from production code — remove the registration or "
+                "emit it"
+            ),
+            ident=kind,
+        ))
+    return findings
+
+
+def check_fault_sites(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    calls = collect_fault_calls(idx)
+    known = known_fault_sites(idx)
+    if calls and not known:
+        findings.append(Finding(
+            code="fault-registry", severity=ERROR, path=SITES_PATH,
+            line=1, message="SITES registry missing", ident="SITES",
+        ))
+        return findings
+    used: Set[str] = set()
+    for rel, line, site in calls:
+        if site is None:
+            findings.append(Finding(
+                code="fault-nonliteral", severity=ERROR, path=rel,
+                line=line,
+                message=(
+                    "fault call with a non-literal site (the registry "
+                    "lint needs a string literal)"
+                ),
+                ident=f"{rel}:nonliteral",
+            ))
+            continue
+        used.add(site)
+        if site not in known:
+            findings.append(Finding(
+                code="fault-unregistered", severity=ERROR, path=rel,
+                line=line,
+                message=(
+                    f"fault site {site!r} not registered in "
+                    "emqx_tpu/fault/sites.py SITES"
+                ),
+                ident=site,
+            ))
+    for site in sorted(known - used):
+        findings.append(Finding(
+            code="fault-dead", severity=WARN, path=SITES_PATH, line=1,
+            message=(
+                f"fault site {site!r} is registered but never injected "
+                "from production code"
+            ),
+            ident=site,
+        ))
+    return findings
+
+
+def check_metrics(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    declared = predefined_metrics(idx)
+    if not declared:
+        findings.append(Finding(
+            code="metric-registry", severity=ERROR, path=METRICS_PATH,
+            line=1, message="PREDEFINED counter list missing",
+            ident="PREDEFINED",
+        ))
+        return findings
+    incs = _collect_named_calls(idx, {"inc"})
+    used: Set[str] = set()
+    dynamic = False
+    for rel, line, _attr, name in incs:
+        if rel == METRICS_PATH:
+            continue
+        if name is None:
+            dynamic = True
+            continue
+        used.add(name)
+        if name not in declared:
+            findings.append(Finding(
+                code="metric-undeclared", severity=WARN, path=rel,
+                line=line,
+                message=(
+                    f"counter {name!r} incremented but not in "
+                    "broker/metrics.py PREDEFINED — it is invisible "
+                    "until first inc and unorderable in exports"
+                ),
+                ident=name,
+            ))
+    if not dynamic:
+        for name in sorted(declared - used):
+            findings.append(Finding(
+                code="metric-dead", severity=WARN, path=METRICS_PATH,
+                line=1,
+                message=(
+                    f"PREDEFINED counter {name!r} is never incremented "
+                    "by any production code path"
+                ),
+                ident=name,
+            ))
+    return findings
+
+
+def check_alarms(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    calls = _collect_named_calls(
+        idx, {"activate", "deactivate", "is_active"}
+    )
+    activated: Dict[str, Tuple[str, int]] = {}
+    cleared: Dict[str, Tuple[str, int]] = {}
+    for rel, line, attr, name in calls:
+        if name is None or rel.startswith(
+            os.path.join("emqx_tpu", "observe")
+        ):
+            continue  # the AlarmManager itself + observe plumbing
+        if attr == "activate":
+            activated.setdefault(name, (rel, line))
+        else:
+            cleared.setdefault(name, (rel, line))
+    for name, (rel, line) in sorted(activated.items()):
+        if name not in cleared:
+            findings.append(Finding(
+                code="alarm-stuck", severity=WARN, path=rel, line=line,
+                message=(
+                    f"alarm {name!r} is activated but no code path "
+                    "ever deactivates or polls it — once raised it is "
+                    "stuck forever"
+                ),
+                ident=name,
+            ))
+    for name, (rel, line) in sorted(cleared.items()):
+        if name not in activated:
+            findings.append(Finding(
+                code="alarm-dead", severity=WARN, path=rel, line=line,
+                message=(
+                    f"alarm {name!r} is deactivated/polled but never "
+                    "activated anywhere — dead lifecycle code"
+                ),
+                ident=name,
+            ))
+    return findings
+
+
+def check_registries(idx: ProjectIndex) -> List[Finding]:
+    out: List[Finding] = []
+    out.extend(check_config(idx))
+    out.extend(check_tracepoints(idx))
+    out.extend(check_fault_sites(idx))
+    out.extend(check_metrics(idx))
+    out.extend(check_alarms(idx))
+    return out

@@ -1,0 +1,152 @@
+"""Finding model, severity tiers, text/JSON rendering.
+
+A finding is (code, severity, path, line, message, ident).  `ident` is
+the line-number-free fingerprint component: the attribute / function /
+registry-key the finding is about, so a baseline entry survives the file
+shifting underneath it.  Baseline suppression applies to the *warn* tier
+only — errors always fail the gate (the dialyzer model: warnings can be
+grandfathered into an ignore file, type clashes cannot).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+ERROR = "error"
+WARN = "warn"
+
+# --json consumers key on this; bump only with a schema change
+JSON_SCHEMA_VERSION = 1
+
+
+@dataclass
+class Finding:
+    code: str  # e.g. "block", "race", "cfg-dead"
+    severity: str  # ERROR | WARN
+    path: str  # repo-relative
+    line: int
+    message: str
+    ident: str  # stable fingerprint component (no line numbers)
+    baselined: bool = False
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}:{self.path}:{self.ident}"
+
+    def render(self) -> str:
+        tag = "baseline" if self.baselined else self.severity
+        return f"{self.path}:{self.line}: [{tag}] {self.code}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+            "baselined": self.baselined,
+        }
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    timings: Dict[str, float] = field(default_factory=dict)
+    n_files: int = 0
+
+    def add(self, f: Finding) -> None:
+        self.findings.append(f)
+
+    def extend(self, fs: List[Finding]) -> None:
+        self.findings.extend(fs)
+
+    def timed(self, name: str):
+        """`with report.timed("roles"):` — per-pass wall clock."""
+        return _Timer(self, name)
+
+    # ------------------------------------------------------------ results
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    def fresh(self) -> List[Finding]:
+        """Findings that fail the gate: every error + non-baselined warn."""
+        return [
+            f for f in self.findings
+            if f.severity == ERROR or not f.baselined
+        ]
+
+    def exit_code(self) -> int:
+        return 1 if self.fresh() else 0
+
+    # ---------------------------------------------------------- rendering
+
+    def render_text(self) -> str:
+        out = []
+        order = {ERROR: 0, WARN: 1}
+        for f in sorted(
+            self.findings,
+            key=lambda f: (f.baselined, order.get(f.severity, 2),
+                           f.path, f.line),
+        ):
+            out.append(f.render())
+        return "\n".join(out)
+
+    def render_summary(self) -> str:
+        n_err = len(self.errors())
+        n_base = sum(1 for f in self.findings if f.baselined)
+        n_warn = len(self.findings) - n_err - n_base
+        t = " ".join(
+            f"{k}={v * 1e3:.0f}ms" for k, v in self.timings.items()
+        )
+        total = sum(self.timings.values())
+        return (
+            f"checked {self.n_files} files: {n_err} error(s), "
+            f"{n_warn} warning(s), {n_base} baselined  "
+            f"[{t} total={total * 1e3:.0f}ms]"
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "schema_version": JSON_SCHEMA_VERSION,
+                "summary": {
+                    "files": self.n_files,
+                    "errors": len(self.errors()),
+                    "warnings": len(
+                        [f for f in self.findings
+                         if f.severity == WARN and not f.baselined]
+                    ),
+                    "baselined": sum(
+                        1 for f in self.findings if f.baselined
+                    ),
+                    "exit_code": self.exit_code(),
+                },
+                "timings_ms": {
+                    k: round(v * 1e3, 2) for k, v in self.timings.items()
+                },
+                "findings": [f.to_dict() for f in self.findings],
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
+
+class _Timer:
+    def __init__(self, report: Report, name: str):
+        self.report = report
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self.report.timings[self.name] = (
+            self.report.timings.get(self.name, 0.0)
+            + time.monotonic() - self._t0
+        )

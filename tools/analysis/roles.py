@@ -1,0 +1,306 @@
+"""Pass (a): thread-role inference + event-loop blocking-call detector.
+
+Dialyzer infers success typings from known roots; this pass infers
+*thread roles* the same way.  Roots:
+
+* every `async def` body runs on the event loop -> role ``loop``;
+* targets of `asyncio.to_thread` / `loop.run_in_executor` /
+  `threading.Thread(target=...)` run on a worker thread -> ``worker``
+  (the hop CLEARS the caller's loop role — that is the whole point of
+  the hop);
+* functions in `ops/native.py` that enter the GIL-free C++ worker pool
+  (any `lib.etpu_*` call) additionally carry ``pool``;
+* `create_task`/`ensure_future` targets stay ``loop``.
+
+Roles propagate caller -> callee over plain call edges to a fixed
+point.  A function whose role set contains ``loop`` is reachable on the
+event loop without an intervening executor hop; a *blocking primitive*
+inside it stalls every connection, heartbeat and timer on the node —
+exactly the PR 4 fix #3 (`time.sleep` fault action freezing the loop)
+and PR 5 fix #2 (fsync-heavy GC on the wrong thread) class of bug.
+
+Severity: ``error`` when the function is reachable ONLY on the loop
+(no worker/pool path exists — the call definitely blocks the loop);
+``warn`` when the function is multi-role (a loop path exists among
+others; possibly the loop caller is a shutdown/test convenience).
+
+Suppression: `# analysis: allow-blocking(<reason>)` on the offending
+line — the reason is mandatory, an empty one is itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .index import CALL, EXECUTOR, FuncInfo, ProjectIndex, \
+    _attr_chain, _walk_own_body
+from .report import ERROR, WARN, Finding
+
+LOOP = "loop"
+WORKER = "worker"
+POOL = "pool"
+
+# module-level blocking primitives: (head name, attr)
+_BLOCKING_MODULE_CALLS = {
+    ("time", "sleep"),
+    ("os", "fsync"),
+    ("os", "fdatasync"),
+    ("subprocess", "run"),
+    ("subprocess", "call"),
+    ("subprocess", "check_call"),
+    ("subprocess", "check_output"),
+    ("subprocess", "Popen"),
+    ("socket", "create_connection"),
+    ("socket", "getaddrinfo"),
+    ("socket", "gethostbyname"),
+}
+
+# attr calls blocking when the receiver is file-like (bound from open())
+_FILEISH_METHODS = {"write", "flush", "read", "readline", "readlines",
+                    "truncate", "seek"}
+# attr calls blocking when the receiver is socket-like
+_SOCKISH_METHODS = {"recv", "send", "sendall", "accept", "connect",
+                    "makefile"}
+
+
+def infer_roles(idx: ProjectIndex) -> Dict[str, Set[str]]:
+    roles: Dict[str, Set[str]] = {}
+
+    def add(key: str, role: str) -> bool:
+        s = roles.setdefault(key, set())
+        if role in s:
+            return False
+        s.add(role)
+        return True
+
+    # roots
+    for key, info in idx.funcs.items():
+        if info.is_async:
+            add(key, LOOP)
+        if info.module == "emqx_tpu.ops.native" and _enters_native_pool(
+            info
+        ):
+            add(key, POOL)
+    for e in idx.edges:
+        if e.kind == EXECUTOR and e.callee in idx.funcs:
+            add(e.callee, WORKER)
+
+    # propagate over plain call edges to a fixed point
+    out_edges: Dict[str, List] = {}
+    for e in idx.edges:
+        if e.kind == CALL:
+            out_edges.setdefault(e.caller, []).append(e.callee)
+    changed = True
+    while changed:
+        changed = False
+        for caller, callees in out_edges.items():
+            src = roles.get(caller)
+            if not src:
+                continue
+            for callee in callees:
+                info = idx.funcs.get(callee)
+                if info is None:
+                    continue
+                # an async callee runs on the loop regardless of who
+                # schedules it; don't smear the caller's roles onto it
+                if info.is_async:
+                    continue
+                for r in src:
+                    changed |= add(callee, r)
+    return roles
+
+
+def _enters_native_pool(info: FuncInfo) -> bool:
+    for node in _walk_own_body(info.node):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and len(chain) >= 2 and chain[0] in ("lib", "_lib") \
+                    and chain[-1].startswith("etpu_"):
+                return True
+    return False
+
+
+# ------------------------------------------------------------ detection
+
+
+def check_blocking(
+    idx: ProjectIndex,
+    roles: Dict[str, Set[str]],
+    package_prefix: str = "emqx_tpu",
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for key, info in idx.funcs.items():
+        if not info.module.startswith(package_prefix):
+            continue
+        fn_roles = roles.get(key, set())
+        if LOOP not in fn_roles:
+            continue
+        pure_loop = fn_roles == {LOOP}
+        fi = idx.files[info.path]
+        file_vars = _fileish_names(idx, info)
+        sock_vars = _sockish_names(idx, info)
+        lock_vars = _lockish_names(idx, info)
+        event_vars = _eventish_names(idx, info)
+        for node in _walk_own_body(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            desc = _blocking_desc(
+                idx, info, node, file_vars, sock_vars, lock_vars,
+                event_vars,
+            )
+            if desc is None:
+                continue
+            line = node.lineno
+            if line in fi.ignored_lines:
+                continue
+            ann = fi.annotations.get(line, "")
+            if ann.startswith("allow-blocking"):
+                reason = ann[len("allow-blocking"):].strip("(): ")
+                if reason:
+                    continue
+                findings.append(Finding(
+                    code="block-annotation", severity=ERROR,
+                    path=info.path, line=line,
+                    message=(
+                        "allow-blocking annotation without a reason "
+                        "(write `# analysis: allow-blocking(<why>)`)"
+                    ),
+                    ident=f"{info.qualname}:{desc}",
+                ))
+                continue
+            role_s = "/".join(sorted(fn_roles))
+            findings.append(Finding(
+                code="block", severity=ERROR if pure_loop else WARN,
+                path=info.path, line=line,
+                message=(
+                    f"{desc} in {info.qualname} (role: {role_s}) "
+                    "blocks the event loop — move it behind "
+                    "asyncio.to_thread/run_in_executor or annotate "
+                    "`# analysis: allow-blocking(<why>)`"
+                ),
+                ident=f"{info.qualname}:{desc}",
+            ))
+    return findings
+
+
+def _blocking_desc(
+    idx: ProjectIndex, info: FuncInfo, node: ast.Call,
+    file_vars: Set[str], sock_vars: Set[str], lock_vars: Set[str],
+    event_vars: Set[str],
+) -> Optional[str]:
+    chain = _attr_chain(node.func)
+    if not chain:
+        return None
+    if len(chain) == 2 and tuple(chain) in _BLOCKING_MODULE_CALLS:
+        return f"{chain[0]}.{chain[1]}()"
+    attr = chain[-1]
+    recv = ".".join(chain[:-1])
+    if attr in _FILEISH_METHODS and recv in file_vars:
+        return f"file {recv}.{attr}()"
+    if attr in _SOCKISH_METHODS and recv in sock_vars:
+        return f"socket {recv}.{attr}()"
+    if attr == "acquire" and (recv in lock_vars or "lock" in recv.lower()):
+        if not _nonblocking_acquire(node):
+            return f"blocking {recv}.acquire()"
+    if attr == "wait" and recv in event_vars:
+        return f"threading.Event {recv}.wait()"
+    return None
+
+
+def _nonblocking_acquire(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+        if kw.arg == "timeout" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value == 0:
+            return True
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Name):
+            return True  # acquire(blocking=flag): caller decides
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and node.args[0].value is False:
+        return True
+    return False
+
+
+def _bound_from(idx: ProjectIndex, info: FuncInfo, match) -> Set[str]:
+    """Receiver names (locals, `with ... as x`, self.attr dotted paths)
+    bound from a constructor the `match(call_node)` predicate accepts —
+    scanning this function AND, for self attrs, every method of the
+    enclosing class."""
+    out: Set[str] = set()
+
+    def scan(fn_node, allow_self: bool):
+        for n in ast.walk(fn_node):
+            value = None
+            targets = []
+            if isinstance(n, ast.Assign):
+                value, targets = n.value, n.targets
+            elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                value, targets = n.value, [n.target]
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if item.optional_vars is not None and match(
+                        item.context_expr
+                    ):
+                        chain = _attr_chain(item.optional_vars)
+                        if chain:
+                            out.add(".".join(chain))
+                continue
+            if value is None or not match(value):
+                continue
+            for t in targets:
+                chain = _attr_chain(t)
+                if chain is None:
+                    continue
+                if chain[0] == "self" and not allow_self:
+                    continue
+                out.add(".".join(chain))
+
+    scan(info.node, allow_self=True)
+    if info.cls is not None:
+        for ci in idx.classes.get(info.cls, []):
+            if ci.module != info.module:
+                continue
+            for m in ci.methods.values():
+                scan(m.node, allow_self=True)
+    return out
+
+
+def _ctor_match(*names: str):
+    def match(node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        chain = _attr_chain(node.func)
+        return bool(chain) and chain[-1] in names
+    return match
+
+
+def _fileish_names(idx: ProjectIndex, info: FuncInfo) -> Set[str]:
+    return _bound_from(idx, info, _ctor_match("open"))
+
+
+def _sockish_names(idx: ProjectIndex, info: FuncInfo) -> Set[str]:
+    return _bound_from(
+        idx, info, _ctor_match("socket", "create_connection")
+    )
+
+
+def _lockish_names(idx: ProjectIndex, info: FuncInfo) -> Set[str]:
+    return _bound_from(
+        idx, info, _ctor_match("Lock", "RLock", "Condition", "Semaphore",
+                               "BoundedSemaphore")
+    )
+
+
+def _eventish_names(idx: ProjectIndex, info: FuncInfo) -> Set[str]:
+    # only threading.Event (asyncio.Event.wait is awaited, not called)
+    def match(node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        chain = _attr_chain(node.func)
+        if not chain or chain[-1] != "Event":
+            return False
+        return chain[0] == "threading" or len(chain) == 1
+    return _bound_from(idx, info, match)
