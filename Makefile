@@ -3,7 +3,7 @@
 
 .PHONY: check check-json lint lint-fast test test-fast native bench \
         restore-bench chaos ds-bench ds-dump ds-soak churn-bench \
-        retained-bench
+        retained-bench fanout-bench
 
 # static-analysis gate (tools/analysis/): the dialyzer/xref/elvis
 # analog, stdlib-only — whole-project AST index + call graph, thread-
@@ -47,6 +47,13 @@ restore-bench:
 # the transfer-free kernel rate and the arbiter's picks recorded
 retained-bench:
 	python bench.py --retained
+
+# delivery-plane fan-out sweep: one filter, 1k/10k/50k/100k
+# subscribers; expansion vs the full wire path (scatter lane + shared
+# packet prefix) with per-delivery ns; writes the BENCH_TABLE.md
+# section
+fanout-bench:
+	python bench.py --fanout
 
 # multi-seed chaos soak: 3-node cluster + hybrid engine under a seeded
 # fault schedule; asserts no QoS1 forward loss, engine/oracle parity,

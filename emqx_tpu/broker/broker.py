@@ -17,15 +17,16 @@ redesigned around batched device matching:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from . import topic as topiclib
 from .cm import ConnectionManager
+from .delivery import scatter_template
 from .hooks import Hooks
 from .message import Message
 from ..observe.tracepoints import tp
 from .metrics import Metrics
-from .packet import SubOpts
+from .packet import Property, SubOpts
 from .retainer import Retainer
 from .session import Session
 from .shared_sub import SharedSub
@@ -75,9 +76,28 @@ class Broker:
         # publishes reaching parked cursor-holding sessions append to
         # the shared log instead of per-session mqueues
         self.ds = None
+        # sharded asyncio delivery-worker pool (delivery.DeliveryPool,
+        # wired by the node when broker.delivery_workers > 0): dispatch
+        # hands per-connection batches to per-shard queues instead of
+        # walking every receiver on its own call stack; None = deliver
+        # inline (tests, benches, non-async callers)
+        self.delivery = None
         self._routes: Dict[int, Route] = {}  # fid -> fan-out record
         self.subs = SubscriberShards()  # fid -> sharded subscriber lists
         self._sub_count = 0
+        # broadcast scatter-lane cache: uid -> (out_cb, proto_ver,
+        # scatter_plain map) for scatter_fast channels, False for
+        # receivers the general path must serve.  Entries die with the
+        # channel registration (cm.on_channel_change) or the uid slot
+        # (subs.on_uid_released — uids are recycled); the maps inside
+        # an entry are the session's own, mutated in place by
+        # subscribe/unsubscribe, so subscription churn needs no
+        # invalidation here.
+        self._fast_cbs: Dict[int, Any] = {}
+        self.cm.on_channel_change = self._drop_fast_cb
+        self.subs.on_uid_released = (
+            lambda uid: self._fast_cbs.pop(uid, None)
+        )
         self.cm.on_discard = self._on_discard_session
         # exact-match guarantee: surface discarded hash collisions
         self.engine.on_collision = lambda topic, fid: self.metrics.inc(
@@ -97,6 +117,11 @@ class Broker:
         self.on_shared_removed: Optional[callable] = None
         self.shared_remote_nodes: Optional[callable] = None  # -> Set[str]
         self.forward_shared: Optional[callable] = None  # (node, msg, g, f)
+
+    def _drop_fast_cb(self, cid: str) -> None:
+        uid = self.subs._uids.get(cid)
+        if uid is not None:
+            self._fast_cbs.pop(uid, None)
 
     def _on_discard_session(self, session: Session) -> None:
         """Discarded session: drop its routes (kicked channels skip this)."""
@@ -279,6 +304,12 @@ class Broker:
         c["engine.probes"] = getattr(e, "probe_count", 0)
         c["engine.breaker_trips"] = getattr(e, "breaker_trips", 0)
         c["engine.churn_shed"] = getattr(e, "churn_shed", 0)
+        # delivery plane: codec-owned shared-prefix cache telemetry
+        # (frame.PREFIX_STATS) copied at the same observation points
+        from . import frame as framelib
+
+        c["deliver.prefix.hit"] = framelib.PREFIX_STATS["hit"]
+        c["deliver.prefix.miss"] = framelib.PREFIX_STATS["miss"]
         r = self.retainer
         c["retained.lookups.index"] = r.index_serves
         c["retained.lookups.trie"] = r.trie_serves
@@ -338,14 +369,42 @@ class Broker:
 
     def publish_finish(self, pp: "PendingPublish") -> List[int]:
         if pp.pending is not None:
+            # per-connection delivery batches accumulate across the
+            # WHOLE tick (uid -> (cid, ch, [(filt, msg)...])) and flush
+            # once per connection — one vectored write per receiver per
+            # tick instead of one write per (receiver, message)
+            sink: Dict[int, Tuple[str, object, list]] = {}
             for (i, msg), fids in zip(pp.todo, pp.matched):
-                n = self._dispatch(msg, fids)
+                n = self._dispatch(msg, fids, sink=sink)
                 tp("dispatch_done", topic=msg.topic, mid=msg.mid, receivers=n)
                 pp.results[i] = n
                 if n == 0:
                     self.metrics.inc("messages.dropped.no_subscribers")
                     self.hooks.run("message.dropped", (msg, "no_subscribers"))
+            self._flush_deliveries(sink)
         return pp.results
+
+    def _flush_deliveries(
+        self, sink: Dict[int, Tuple[str, object, list]]
+    ) -> None:
+        """Hand each connection's tick batch to its delivery shard (or
+        deliver inline when no pool is wired / the shard pushed back)."""
+        pool = self.delivery
+        for uid, (cid, ch, delivers) in sink.items():
+            if len(delivers) > 1:
+                self.metrics.inc(
+                    "messages.delivered.batched", len(delivers)
+                )
+            if pool is not None:
+                if not pool.submit(uid, cid, ch, delivers):
+                    pool._deliver(cid, ch, delivers)
+            elif self.cm.lookup(cid) is ch:
+                ch.deliver(delivers)
+            else:
+                # receiver vanished mid-tick (hook kicked it): park the
+                # copies in its session rather than dropping them
+                for f, m in delivers:
+                    self.deliver_offline(cid, [f], m)
 
     def _pre_match(self, todo: List[Tuple[int, Message]]) -> None:
         """Between accept and match: the cluster layer forwards here."""
@@ -385,22 +444,48 @@ class Broker:
                 self.hooks.run("message.dropped", (msg, "no_subscribers"))
 
     def _dispatch(
-        self, msg: Message, fids, include_shared: bool = True
+        self, msg: Message, fids, include_shared: bool = True,
+        sink: Optional[Dict[int, Tuple[str, object, list]]] = None,
     ) -> int:
         """Expand matched fids to receivers and deliver (`do_dispatch`).
 
         Expansion is vectorized through the subscriber-shard layer: one
         concatenate over the matched fids' bucket arrays + one grouping
         pass, so per-receiver cost is a single delivery call regardless
-        of fan-out (`emqx_broker.erl:499-524` without per-sub dict ops)."""
+        of fan-out (`emqx_broker.erl:499-524` without per-sub dict ops).
+
+        With `sink` (the tick-scoped per-connection accumulator from
+        publish_finish), online receivers are APPENDED per uid instead
+        of delivered inline — receiver counts, metrics and hooks still
+        settle here at dispatch time; only the wire movement is
+        deferred to the flush/worker stage."""
         fid_filts = []
         for fid in fids:
             route = self._routes.get(fid)
             if route is not None:
                 fid_filts.append((fid, route.filt))
         n = 0
-        for cid, filts in self.subs.expand(fid_filts):
-            n += self._deliver_to(cid, filts, msg)
+        if len(fid_filts) == 1:
+            n += self._scatter_one_filter(msg, fid_filts[0], sink)
+        elif sink is None:
+            for cid, filts in self.subs.expand(fid_filts):
+                n += self._deliver_to(cid, filts, msg)
+        else:
+            lookup = self.cm.lookup
+            minc = self.metrics.inc
+            hrun = self.hooks.run
+            for uid, cid, filts in self.subs.expand_uids(fid_filts):
+                ch = lookup(cid)
+                if ch is None:
+                    n += self.deliver_offline(cid, filts, msg)
+                    continue
+                ent = sink.get(uid)
+                if ent is None:
+                    ent = sink[uid] = (cid, ch, [])
+                ent[2].extend((f, msg) for f in filts)
+                minc("messages.delivered", len(filts))
+                hrun("message.delivered", (cid, msg))
+                n += len(filts)
         # shared groups deliver one-at-a-time with failover so a dead
         # pick redispatches to a peer (`emqx_shared_sub:dispatch` retry)
         if include_shared:
@@ -411,6 +496,105 @@ class Broker:
                 for group in route.groups:
                     n += self._dispatch_shared(msg, group, route.filt)
         return n
+
+    def _scatter_one_filter(
+        self, msg: Message, fid_filt: Tuple[int, str], sink,
+    ) -> int:
+        """Broadcast lane of _dispatch: ONE matched filter, many
+        receivers — the shape that caps alert-to-millions scenarios.
+        Everything receiver-invariant is hoisted out of the loop (the
+        delivers pair-list is shared across receivers: channels never
+        retain or mutate it), per-receiver allocation drops to zero on
+        the online path, and metrics/hook dispatch batch to one update
+        per broadcast when no hook subscribes."""
+        fid, filt = fid_filt
+        uids, cids = self.subs.scatter(fid)
+        if not uids:
+            return 0
+        lookup = self.cm.lookup
+        hooks_live = self.hooks.has("message.delivered")
+        hrun = self.hooks.run
+        dl = [(filt, msg)]  # shared: deliver() treats it as read-only
+        n = 0
+        delivered = 0
+        if sink is None:
+            # plain-receiver fast lane: a QoS0 message without an
+            # expiry rewrite reaches every scatter_fast channel whose
+            # subscription is plain (session.scatter_plain) through ONE
+            # shared action list per proto version — the receiver loop
+            # touches the channel, its plain map, and out_cb, nothing
+            # else (metrics batch below; the packet/message counters a
+            # channel would have incremented live in the same broker
+            # table, so batching is observationally identical)
+            fast_msg = (
+                msg.qos == 0
+                and Property.MESSAGE_EXPIRY_INTERVAL not in msg.properties
+            )
+            retain_inv = msg.retain if msg.headers.get("retained") \
+                else False
+            by_ver: Dict[int, list] = {}
+            scache = None
+            fcbs = self._fast_cbs
+            fget = fcbs.get
+            fastn = 0
+            for uid, cid in zip(uids, cids):
+                ent = fget(uid) if fast_msg else False
+                if ent is None:  # uncached receiver: classify once
+                    ch = lookup(cid)
+                    if ch is None:
+                        n += self.deliver_offline(cid, [filt], msg)
+                        continue
+                    ent = fcbs[uid] = (
+                        (ch.out_cb, ch.proto_ver, ch.scatter_plain)
+                        if getattr(ch, "scatter_fast", False)
+                        else False
+                    )
+                if ent and ent[2].get(filt):
+                    cb, ver, _plain = ent
+                    act = by_ver.get(ver)
+                    if act is None:
+                        if scache is None:
+                            scache = msg.headers.get("__scatter")
+                            if scache is None:
+                                scache = msg.headers["__scatter"] = {}
+                        key = (ver, retain_inv, None)
+                        tent = scache.get(key)
+                        if tent is None:
+                            tent = scache[key] = scatter_template(msg, key)
+                        act = by_ver[ver] = tent[1]
+                    cb(act)
+                    fastn += 1
+                else:
+                    ch = lookup(cid)
+                    if ch is None:
+                        n += self.deliver_offline(cid, [filt], msg)
+                        continue
+                    ch.deliver(dl)
+                if hooks_live:
+                    hrun("message.delivered", (cid, msg))
+                delivered += 1
+            if fastn:
+                self.metrics.inc("packets.publish.sent", fastn)
+                self.metrics.inc("messages.sent", fastn)
+        else:
+            pair = (filt, msg)
+            sget = sink.get
+            for uid, cid in zip(uids, cids):
+                ch = lookup(cid)
+                if ch is None:
+                    n += self.deliver_offline(cid, [filt], msg)
+                    continue
+                ent = sget(uid)
+                if ent is None:
+                    sink[uid] = (cid, ch, [pair])
+                else:
+                    ent[2].append(pair)
+                if hooks_live:
+                    hrun("message.delivered", (cid, msg))
+                delivered += 1
+        if delivered:
+            self.metrics.inc("messages.delivered", delivered)
+        return n + delivered
 
     def dispatch_shared_forwarded(self, msg: Message, group: str, filt: str) -> int:
         """Receiving side of a TARGETED shared forward: deliver to one
@@ -528,6 +712,13 @@ class Broker:
             self.metrics.inc("messages.delivered", len(filts))
             self.hooks.run("message.delivered", (cid, msg))
             return len(filts)
+        return self.deliver_offline(cid, filts, msg)
+
+    def deliver_offline(self, cid: str, filts: List[str],
+                        msg: Message) -> int:
+        """Queue one message for a parked persistent session (also the
+        delivery-worker fallback for a receiver that disconnected
+        between dispatch and drain)."""
         session = self.cm.lookup_session(cid)
         if session is None:
             return 0

@@ -23,6 +23,7 @@ from .access_control import ALLOW, AccessControl, ClientInfo, DENY, PUB, SUB
 from .broker import Broker
 from .message import Message, now_ms
 from .packet import PacketType, Property, ReasonCode, SubOpts
+from .delivery import scatter_template
 from .session import Session, SessionError
 
 Action = Tuple[str, Any]  # ('send', Packet) | ('close', rc|None) | ('connected',)
@@ -115,6 +116,14 @@ class Channel:
         # publish acks are deferred via ('ack_async', future, builder)
         # actions so a whole tick of publishes shares one device match.
         self.publish_fn = None
+        # broadcast scatter lane eligibility (broker._scatter_one_filter):
+        # True once the connection's statics allow receiver-invariant
+        # delivery (no mountpoint/alias/max-packet/upgrade-qos); the
+        # broker then serves this channel's plain QoS0 subscriptions
+        # from a shared action list.  scatter_plain aliases the
+        # session's per-filter map for one-hop access.
+        self.scatter_fast = False
+        self.scatter_plain: Dict[str, bool] = {}
 
     # ------------------------------------------------------------- helpers
 
@@ -362,6 +371,23 @@ class Channel:
             session.username = getattr(self.clientinfo, "username",
                                        None)
         self.session = session
+        if present and not session.scatter_plain and session.subscriptions:
+            # disk-restored sessions write `subscriptions` directly and
+            # skip Session.subscribe — rebuild the plain map here so
+            # resumed receivers rejoin the broadcast fast lane
+            for f, o in session.subscriptions.items():
+                session.scatter_plain[f] = (
+                    not o.no_local
+                    and not o.retain_as_published
+                    and o.sub_id is None
+                )
+        self.scatter_fast = (
+            self.cfg.mountpoint is None
+            and self.client_max_packet is None
+            and not (self.v5 and self.client_alias_max)
+            and not session.upgrade_qos
+        )
+        self.scatter_plain = session.scatter_plain
         self._m("session.resumed" if present else "session.created")
         self.state = CONNECTED
         self.connected_at = time.time()
@@ -793,9 +819,81 @@ class Channel:
 
     def deliver(self, delivers: List[Tuple[str, Message]]) -> None:
         """Called by the broker dispatch; pushes actions to the connection."""
-        acts = self._deliveries_out(self.session.deliver(delivers))
+        acts = self._scatter_deliver(delivers)
+        if acts is None:
+            acts = self._deliveries_out(self.session.deliver(delivers))
         if acts:
             self.out_cb(acts)
+
+    def _scatter_deliver(
+        self, delivers: List[Tuple[str, Message]]
+    ) -> Optional[List[Action]]:
+        """QoS0 broadcast scatter: reuse ONE prebuilt PUBLISH packet
+        (carrying the shared wire prefix) per (proto version, retain,
+        sub-id) wire form across every receiver of a message — the
+        per-receiver cost of the delivery hot loop collapses to two
+        dict lookups and a list append.  Returns None (fall back to the
+        full per-receiver path) whenever any item needs session state
+        or per-receiver bytes: effective QoS > 0 (inflight/packet-id),
+        outbound topic aliasing, a mountpoint strip, or an expiry-
+        interval rewrite.  The fast path is side-effect-free until it
+        commits, so a mid-batch fallback reprocesses the whole batch
+        exactly once."""
+        session = self.session
+        v5 = self.proto_ver == pkt.MQTT_V5
+        if (
+            session is None
+            or self.cfg.mountpoint is not None
+            or (v5 and self.client_alias_max)
+        ):
+            return None
+        subs = session.subscriptions
+        upgrade = session.upgrade_qos
+        acts: Optional[List[Action]] = None
+        n = 0
+        for filt, msg in delivers:
+            opts = subs.get(filt)
+            if opts is None:
+                return None
+            if (msg.qos or opts.qos) if upgrade else \
+                    (msg.qos and opts.qos):
+                return None  # effective qos > 0
+            if Property.MESSAGE_EXPIRY_INTERVAL in msg.properties:
+                return None
+            if opts.no_local and msg.from_client == self.clientid:
+                continue
+            retain = msg.retain if (
+                opts.retain_as_published or msg.headers.get("retained")
+            ) else False
+            key = (self.proto_ver, retain, opts.sub_id if v5 else None)
+            headers = msg.headers
+            cache = headers.get("__scatter")
+            if cache is None:
+                cache = headers["__scatter"] = {}
+            ent = cache.get(key)
+            if ent is None:
+                ent = cache[key] = scatter_template(msg, key)
+            tmpl, act = ent
+            if self.client_max_packet is not None:
+                from . import frame as framelib
+
+                if framelib.exact_publish_size(tmpl, self.proto_ver) > \
+                        self.client_max_packet:
+                    return None  # slow path owns the drop accounting
+            n += 1
+            if acts is None:
+                # the common single-delivery broadcast reuses the
+                # template's cached one-action list outright (borrowed:
+                # materialized below before any mutation)
+                acts = act
+            else:
+                if n == 2:
+                    acts = [acts[0]]  # materialize the borrowed list
+                acts.append(act[0])
+        if n:
+            self._m("packets.publish.sent", n)
+            self._m("messages.sent", n)
+        return acts if acts is not None else []
 
     def _deliveries_out(self, ds) -> List[Action]:
         """Iterative drain: a dropped too-large delivery frees its
@@ -848,6 +946,15 @@ class Channel:
             packet_id=d.packet_id,
             properties=props,
         )
+        if not d.dup and topic == msg.topic and props == msg.properties:
+            # identical wire form (up to version/qos/retain and the
+            # 2-byte packet-id slot) for every such receiver of this
+            # message: share one serialization across the fan-out and
+            # splice only the packet id per receiver (build-once/
+            # scatter-many, frame.publish_prefix).  Attached BEFORE the
+            # size gate so the exact-measure slow path below memoizes
+            # on the same entry.
+            out._wire_prefix = msg.headers.setdefault("__wire_prefix", {})
         if self.client_max_packet is not None and \
                 not self._fits_client_packet(out):
             # MQTT-3.1.2-25: drop, don't send; free the QoS window
@@ -864,17 +971,6 @@ class Channel:
         if new_alias_topic is not None:
             self.alias_out[new_alias_topic] = \
                 props[Property.TOPIC_ALIAS]
-        if (
-            d.qos == 0
-            and not d.dup
-            and d.packet_id is None
-            and topic == msg.topic
-            and props == msg.properties
-        ):
-            # identical wire bytes for every plain-QoS0 receiver of
-            # this message: share one serialization across the fan-out
-            # (the connection layer keys it by proto_ver + retain)
-            out._wire_cache = msg.headers.setdefault("__wire_cache", {})
         self._m("packets.publish.sent")
         self._m("messages.sent")
         return [("send", out)]
@@ -893,8 +989,10 @@ class Channel:
     def _fits_client_packet(self, out: "pkt.Publish") -> bool:
         """Size gate against the client's Maximum Packet Size.  Fast
         path: an UPPER-bound estimate skips the exact serialize when
-        the packet is clearly small enough; only near-limit packets
-        pay the measuring serialization."""
+        the packet is clearly small enough; near-limit packets pay one
+        measuring serialization, memoized on the shared prefix entry
+        when the scatter path is active — identical payloads measure
+        once per wire form, not once per receiver."""
         rough = len(out.payload) + 4 * len(out.topic) + 16
         for v in out.properties.values():
             rough += self._prop_bound(v)
@@ -902,7 +1000,7 @@ class Channel:
             return True
         from . import frame as framelib
 
-        return len(framelib.serialize(out, self.proto_ver)) <= \
+        return framelib.exact_publish_size(out, self.proto_ver) <= \
             self.client_max_packet
 
     # ------------------------------------------------------------- timers

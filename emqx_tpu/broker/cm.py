@@ -31,6 +31,10 @@ class ConnectionManager:
         # disconnected persistent sessions: clientid -> (session, expire_at)
         self.pending: Dict[str, Tuple[Session, float]] = {}
         self.on_discard: Optional[Callable[[Session], None]] = None
+        # fires with the clientid on EVERY channel-registry mutation
+        # (register / unregister / kick): the broker invalidates its
+        # per-uid scatter-callback cache through this
+        self.on_channel_change: Optional[Callable[[str], None]] = None
         # fires when a disconnected session is parked (persistence point)
         self.on_park: Optional[Callable[[str, Session, float], None]] = None
         # fires when a parked session is resumed by a reconnect; the
@@ -103,6 +107,8 @@ class ConnectionManager:
 
     def _kick(self, ch: ChannelLike, rc: int) -> None:
         self.channels.pop(ch.clientid, None)
+        if self.on_channel_change:
+            self.on_channel_change(ch.clientid)
         try:
             ch.kick(rc)
         except Exception:
@@ -112,11 +118,15 @@ class ConnectionManager:
 
     def register_channel(self, ch: ChannelLike) -> None:
         self.channels[ch.clientid] = ch
+        if self.on_channel_change:
+            self.on_channel_change(ch.clientid)
 
     def unregister_channel(self, ch: ChannelLike) -> None:
         cur = self.channels.get(ch.clientid)
         if cur is ch:
             del self.channels[ch.clientid]
+            if self.on_channel_change:
+                self.on_channel_change(ch.clientid)
 
     def disconnect_channel(self, ch: ChannelLike) -> None:
         """Connection closed: park the session if it has an expiry."""

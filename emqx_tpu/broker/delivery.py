@@ -1,0 +1,185 @@
+"""Sharded asyncio delivery-worker pool — the esockd conn-sup analog.
+
+The reference decomposes its listeners into acceptor + connection
+supervisor pools (`esockd_acceptor_sup` / `esockd_connection_sup`,
+PAPER.md §1.3) so one slow socket never serializes the others.  Here the
+same decomposition is applied to the broadcast fan-out hot loop: the
+broker's dispatch stage partitions receivers by connection shard
+(`shard = subscriber-uid % workers`, keeping per-connection packet order
+by construction), appends per-connection delivery batches to per-shard
+queues, and a pool of asyncio worker tasks drains the shards
+concurrently — a 50k-receiver broadcast no longer runs as one
+uninterruptible loop on the dispatch call stack.
+
+Backpressure is per shard and per connection, and NEVER blocks:
+
+* a shard queue past ``queue_max`` items delivers the overflow batch
+  inline on the dispatch path (counted ``deliver.shard.backpressure``)
+  instead of growing without bound;
+* a connection whose transport write buffer exceeds
+  ``backpressure_bytes`` is counted + traced but not awaited — the
+  worker moves on to the next receiver, so a stalled socket cannot
+  head-of-line-block its shard (the force_shutdown policy in
+  listener.py reaps the pathological cases).
+
+A receiver that disconnects between dispatch and drain is re-routed to
+its parked session (offline enqueue) instead of dropped, so a
+mid-broadcast disconnect loses nothing and duplicates nothing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, List, Tuple
+
+from . import packet as pkt
+from .message import Message
+from .packet import Property
+from ..observe.tracepoints import tp
+
+log = logging.getLogger("emqx_tpu.delivery")
+
+
+def scatter_template(msg: Message, key: Tuple[int, bool, Any]) -> tuple:
+    """Build the shared PUBLISH template (and its reusable one-item
+    action list) for one (proto version, retain, sub-id) receiver class
+    of a message — the unit the broadcast scatter lane hands to every
+    receiver of that class (channel._scatter_deliver and
+    broker._scatter_one_filter share these via msg.headers['__scatter'])."""
+    _ver, retain, sub = key
+    props = dict(msg.properties)
+    if sub is not None:
+        props[Property.SUBSCRIPTION_IDENTIFIER] = [sub]
+    tmpl = pkt.Publish(
+        topic=msg.topic,
+        payload=msg.payload,
+        qos=0,
+        retain=retain,
+        dup=False,
+        packet_id=None,
+        properties=props,
+    )
+    # a sub-id makes the properties receiver-class-specific: such
+    # templates hold a PRIVATE prefix dict (the shared per-message dict
+    # assumes props == msg.properties)
+    tmpl._wire_prefix = (
+        msg.headers.setdefault("__wire_prefix", {})
+        if sub is None else {}
+    )
+    return tmpl, [("send", tmpl)]
+
+
+class DeliveryPool:
+    def __init__(
+        self,
+        broker,
+        workers: int = 4,
+        queue_max: int = 4096,
+        backpressure_bytes: int = 1 << 20,
+    ):
+        self.broker = broker
+        self.workers = max(1, int(workers))
+        self.queue_max = queue_max
+        self.backpressure_bytes = backpressure_bytes
+        self._queues: List[asyncio.Queue] = []
+        self._tasks: List[asyncio.Task] = []
+        self.active = False
+        self.batches = 0
+        self.delivered = 0
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self.active:
+            return
+        self._queues = [asyncio.Queue() for _ in range(self.workers)]
+        self._tasks = [
+            asyncio.create_task(self._worker(i)) for i in range(self.workers)
+        ]
+        self.active = True
+
+    async def stop(self) -> None:
+        """Drain every shard queue, then stop the workers.  Queued
+        batches are delivered inline so shutdown loses nothing."""
+        self.active = False
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks = []
+        for q in self._queues:
+            while not q.empty():
+                cid, ch, delivers = q.get_nowait()
+                self._deliver(cid, ch, delivers)
+        self._queues = []
+
+    # ----------------------------------------------------------- dispatch
+
+    def shard_of(self, uid: int) -> int:
+        return uid % self.workers
+
+    def submit(self, uid: int, cid: str, ch, delivers: List[Tuple]) -> bool:
+        """Queue one connection's delivery batch on its shard; returns
+        False when the pool is down or the shard is saturated — the
+        caller must then deliver inline (bounded memory, no silent
+        drops)."""
+        if not self.active:
+            return False
+        q = self._queues[uid % self.workers]
+        if q.qsize() >= self.queue_max:
+            self.broker.metrics.inc("deliver.shard.backpressure")
+            tp("deliver.backpressure", shard=uid % self.workers,
+               depth=q.qsize())
+            return False
+        q.put_nowait((cid, ch, delivers))
+        return True
+
+    # ------------------------------------------------------------ workers
+
+    async def _worker(self, i: int) -> None:
+        q = self._queues[i]
+        drained = 0
+        while True:
+            cid, ch, delivers = await q.get()
+            try:
+                self._deliver(cid, ch, delivers, shard=i)
+            except Exception:
+                log.exception("delivery shard %d: %s", i, cid)
+            drained += 1
+            if q.empty() or drained >= 64:
+                # yield between bursts so other shards (and the
+                # connections' own read loops) interleave with a long
+                # broadcast drain
+                drained = 0
+                await asyncio.sleep(0)
+
+    def _deliver(self, cid: str, ch, delivers: List[Tuple],
+                 shard: int = -1) -> None:
+        live = self.broker.cm.lookup(cid)
+        if live is not ch:
+            # receiver disconnected (or was taken over) mid-broadcast:
+            # the message set is re-routed through the offline path so
+            # a persistent session still gets exactly one copy
+            for filt, msg in delivers:
+                self.broker.deliver_offline(cid, [filt], msg)
+            return
+        ch.deliver(delivers)
+        self.batches += 1
+        self.delivered += len(delivers)
+        tp("deliver.batch", shard=shard, cid=cid, n=len(delivers))
+        buf_fn = getattr(ch, "conn_buffer_fn", None)
+        if buf_fn is not None:
+            try:
+                backlog = buf_fn()
+            except Exception:
+                return
+            if backlog > self.backpressure_bytes:
+                # slow consumer: record it and MOVE ON — the transport
+                # buffers, force_shutdown reaps the extreme cases, and
+                # the rest of the shard keeps flowing
+                self.broker.metrics.inc("deliver.shard.backpressure")
+                tp("deliver.backpressure", cid=cid, bytes=backlog)

@@ -511,20 +511,103 @@ class Parser:
 
 # -------------------------------------------------------------- serializer
 
+# shared-prefix cache telemetry, synced into broker metrics
+# (`deliver.prefix.hit|miss`) by Broker.sync_engine_metrics at
+# observation points — the codec owns the counters, the hot path never
+# touches the metrics table
+PREFIX_STATS = {"hit": 0, "miss": 0}
+
+
+class PublishPrefix:
+    """One shared wire form of a fanned-out PUBLISH.
+
+    The frame is serialized ONCE with a 2-byte placeholder in the
+    packet-id slot; every receiver splices only its own packet id into
+    a copy of the cached bytes (QoS0 has no packet id, so `splice`
+    returns the cached bytes untouched — zero copies).  Byte-parity
+    contract: ``splice(pid)`` is byte-identical to
+    ``serialize(replace(p, packet_id=pid), version)``."""
+
+    __slots__ = ("data", "pid_off")
+
+    def __init__(self, data: bytes, pid_off: Optional[int]):
+        self.data = data
+        self.pid_off = pid_off
+
+    def splice(self, packet_id: Optional[int]) -> bytes:
+        if self.pid_off is None:
+            return self.data
+        if not packet_id:
+            raise FrameError(PROTO_ERR, "qos>0 publish needs packet_id")
+        buf = bytearray(self.data)
+        struct.pack_into(">H", buf, self.pid_off, packet_id)
+        return bytes(buf)
+
+    def __len__(self) -> int:
+        # exact wire size for ANY packet id (the slot is fixed-width)
+        return len(self.data)
+
+
+def publish_prefix(p: "pkt.Publish", version: int) -> PublishPrefix:
+    """Serialize a PUBLISH with a placeholder packet-id slot; mirrors
+    the PUBLISH branch of serialize() field-for-field so the parity
+    contract holds structurally."""
+    v5 = version == pkt.MQTT_V5
+    flags = (int(p.dup) << 3) | ((p.qos & 0x3) << 1) | int(p.retain)
+    body = bytearray()
+    body += _utf8_bytes(p.topic)
+    pid_in_body = None
+    if p.qos > 0:
+        pid_in_body = len(body)
+        body += b"\x00\x00"
+    if v5:
+        body += _serialize_properties(p.properties)
+    body += p.payload
+    rl = _varint_bytes(len(body))
+    data = (
+        bytes([(int(PacketType.PUBLISH) << 4) | flags]) + rl + bytes(body)
+    )
+    pid_off = None if pid_in_body is None else 1 + len(rl) + pid_in_body
+    return PublishPrefix(data, pid_off)
+
+
+def _prefix_entry(p: "pkt.Publish", version: int,
+                  cache: dict) -> PublishPrefix:
+    """The channel attaches one `_wire_prefix` dict per message, shared
+    by every receiver whose (topic, properties, dup) equal the
+    message's — so within a cache the wire form varies only by
+    (version, qos, retain), the key here."""
+    key = (version, p.qos, p.retain)
+    ent = cache.get(key)
+    if ent is None:
+        ent = cache[key] = publish_prefix(p, version)
+        PREFIX_STATS["miss"] += 1
+    else:
+        PREFIX_STATS["hit"] += 1
+    return ent
+
+
 def serialize_cached(p: pkt.Packet, version: int) -> bytes:
-    """Serialize honoring the fan-out fast path: plain-QoS0 PUBLISH
-    packets carry a `_wire_cache` dict shared by every receiver of one
-    message, keyed by (protocol version, retain flag) — one
-    serialization per distinct wire form instead of one per receiver."""
-    cache = getattr(p, "_wire_cache", None)
+    """Serialize honoring the fan-out fast path: PUBLISH packets on the
+    build-once/scatter-many path carry a `_wire_prefix` dict shared by
+    every receiver of one message — one serialization per distinct wire
+    form (proto version x QoS x retain) plus a per-receiver packet-id
+    splice, instead of one full serialization per receiver."""
+    cache = getattr(p, "_wire_prefix", None)
     if cache is None:
         return serialize(p, version)
-    key = (version, p.retain)
-    data = cache.get(key)
-    if data is None:
-        data = serialize(p, version)
-        cache[key] = data
-    return data
+    return _prefix_entry(p, version, cache).splice(p.packet_id)
+
+
+def exact_publish_size(p: "pkt.Publish", version: int) -> int:
+    """Exact serialized size of an outbound PUBLISH, memoized on the
+    shared prefix entry when the scatter path is active — identical
+    payloads measure once per wire form, not once per receiver (the
+    Channel max-packet-size slow path)."""
+    cache = getattr(p, "_wire_prefix", None)
+    if cache is None:
+        return len(serialize(p, version))
+    return len(_prefix_entry(p, version, cache))
 
 
 def serialize(p: pkt.Packet, version: int = pkt.MQTT_V4) -> bytes:

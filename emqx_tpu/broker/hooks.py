@@ -39,8 +39,16 @@ class Hooks:
     def callbacks(self, point: str) -> List[Callable]:
         return [cb for _, _, cb in self._chains.get(point, [])]
 
+    def has(self, point: str) -> bool:
+        """Cheap hot-path gate: lets a fan-out loop skip the per-receiver
+        run() machinery entirely when nothing subscribes to the point."""
+        return bool(self._chains.get(point))
+
     def run(self, point: str, args: Tuple = ()) -> None:
-        for cb in self.callbacks(point):
+        chain = self._chains.get(point)
+        if not chain:  # no subscribers: zero-alloc early out (hot path)
+            return
+        for _, _, cb in list(chain):
             if cb(*args) == STOP:
                 return
 

@@ -31,6 +31,15 @@ class Inflight:
     def is_full(self) -> bool:
         return self.max_size > 0 and len(self._d) >= self.max_size
 
+    def free_slots(self) -> int:
+        """Open window slots; unbounded windows report 65535 (the
+        packet-id space is the true ceiling).  Lets batch deliver
+        pre-count its QoS>0 admissions and allocate packet ids in one
+        pass instead of re-checking is_full per message."""
+        if self.max_size <= 0:
+            return 65535
+        return max(0, self.max_size - len(self._d))
+
     def contain(self, pid: int) -> bool:
         return pid in self._d
 

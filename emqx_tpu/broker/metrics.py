@@ -100,6 +100,14 @@ PREDEFINED = [
     "messages.dropped.no_shared_member",
     # host match-path hash-collision catch (Broker.on_collision hook)
     "match.hash_collision",
+    # delivery plane (broker/delivery.py pool + listener vectored flush
+    # + frame.py shared packet-prefix cache, synced like engine.* by
+    # Broker.sync_engine_metrics)
+    "messages.delivered.batched",
+    "deliver.flush.vectored",
+    "deliver.shard.backpressure",
+    "deliver.prefix.hit",
+    "deliver.prefix.miss",
     # connection lifecycle + overload protection (broker/listener.py,
     # broker/ws.py)
     "channels.force_shutdown",

@@ -65,6 +65,15 @@ class Session:
         self.created_at = created_at if created_at is not None else time.time()
 
         self.subscriptions: Dict[str, SubOpts] = {}
+        # filt -> True when the subscription has no per-receiver
+        # delivery state (no no_local, no retain-as-published, no
+        # sub-id): the broker's broadcast scatter lane delivers these
+        # receivers from ONE shared action list without consulting the
+        # SubOpts at all.  Maintained by subscribe/unsubscribe; restore
+        # paths that write `subscriptions` directly leave entries
+        # absent, which just means the (correct) general path serves
+        # them.
+        self.scatter_plain: Dict[str, bool] = {}
         self.inflight = Inflight(max_inflight)
         self.mqueue = MQueue(max_len=max_mqueue, store_qos0=store_qos0)
         self.awaiting_rel: Dict[int, float] = {}  # inbound qos2 packet ids
@@ -82,9 +91,15 @@ class Session:
         """Returns True if this is a new subscription (vs an update)."""
         is_new = filt not in self.subscriptions
         self.subscriptions[filt] = opts
+        self.scatter_plain[filt] = (
+            not opts.no_local
+            and not opts.retain_as_published
+            and opts.sub_id is None
+        )
         return is_new
 
     def unsubscribe(self, filt: str) -> Optional[SubOpts]:
+        self.scatter_plain.pop(filt, None)
         return self.subscriptions.pop(filt, None)
 
     # ------------------------------------------------- inbound QoS2 dedup
@@ -121,6 +136,24 @@ class Session:
                 return pid
         raise SessionError(ReasonCode.QUOTA_EXCEEDED, "no free packet id")
 
+    def _alloc_pids(self, n: int) -> List[int]:
+        """Allocate n distinct free packet ids in ONE scan of the id
+        space (batched fan-out deliveries pay one cursor walk, not one
+        _alloc_pid call per message).  Ids are handed out in the same
+        order the per-id allocator would."""
+        if n == 1:
+            return [self._alloc_pid()]
+        out: List[int] = []
+        contain = self.inflight.contain
+        for _ in range(65535):
+            pid = self._next_pid
+            self._next_pid = pid % 65535 + 1
+            if not contain(pid):
+                out.append(pid)
+                if len(out) == n:
+                    return out
+        raise SessionError(ReasonCode.QUOTA_EXCEEDED, "no free packet id")
+
     def _effective_qos(self, msg: Message, opts: SubOpts) -> int:
         if self.upgrade_qos:
             return max(msg.qos, opts.qos)
@@ -137,6 +170,12 @@ class Session:
         Returns wire-ready deliveries; overflow goes to the mqueue.
         """
         out: List[Delivery] = []
+        # two-pass so a batch of QoS>0 admissions allocates its packet
+        # ids in ONE id-space scan (_alloc_pids); `free` mirrors the
+        # inflight window so admission decisions match the one-at-a-time
+        # ordering exactly
+        free = self.inflight.free_slots()
+        pend: List[Tuple[int, Message, int, bool, List[int]]] = []
         for filt, msg in delivers:
             opts = self.subscriptions.get(filt)
             if opts is None:
@@ -150,15 +189,20 @@ class Session:
             sub_ids = [opts.sub_id] if opts.sub_id is not None else []
             if qos == 0:
                 out.append(Delivery(None, msg, 0, retain=retain, sub_ids=sub_ids))
-            elif self.inflight.is_full():
+            elif free <= 0:
                 self.mqueue.insert(self._with_qos(msg, qos))
             else:
-                pid = self._alloc_pid()
+                free -= 1
+                pend.append((len(out), msg, qos, retain, sub_ids))
+                out.append(None)  # placeholder filled below
+        if pend:
+            pids = self._alloc_pids(len(pend))
+            for (i, msg, qos, retain, sub_ids), pid in zip(pend, pids):
                 phase = "wait_ack" if qos == 1 else "wait_rec"
                 self.inflight.insert(
                     pid, InflightEntry(phase=phase, message=self._with_qos(msg, qos))
                 )
-                out.append(Delivery(pid, msg, qos, retain=retain, sub_ids=sub_ids))
+                out[i] = Delivery(pid, msg, qos, retain=retain, sub_ids=sub_ids)
         return out
 
     @staticmethod

@@ -79,6 +79,10 @@ class SubscriberShards:
         # fid -> [main bucket, shard buckets...] (shards appear lazily)
         self._fids: Dict[int, List[_Bucket]] = {}
         self._counts: Dict[int, int] = {}
+        # fires when a uid's last subscription drops and the slot goes
+        # back on the free list (uids are RECYCLED — any uid-keyed
+        # side cache must drop the entry here)
+        self.on_uid_released = None
 
     # ------------------------------------------------------------- intern
 
@@ -104,6 +108,8 @@ class SubscriberShards:
             del self._uids[self._cids[uid]]
             self._cids[uid] = ""
             self._free_uids.append(uid)
+            if self.on_uid_released is not None:
+                self.on_uid_released(uid)
 
     def cid_of(self, uid: int) -> str:
         return self._cids[uid]
@@ -182,6 +188,15 @@ class SubscriberShards:
         for uid in self.uids(fid).tolist():
             yield cids[uid]
 
+    def scatter(self, fid: int) -> Tuple[List[int], List[str]]:
+        """One fid's receivers as parallel (uids, clientids) lists —
+        the single-filter broadcast lane: no per-receiver tuple or
+        filter-list allocation (expand_uids pays both to group clients
+        across several matched filters; a broadcast has exactly one)."""
+        uids = self.uids(fid).tolist()
+        cids = self._cids
+        return uids, [cids[u] for u in uids]
+
     def expand(
         self, fid_filts: Sequence[Tuple[int, str]]
     ) -> List[Tuple[str, List[str]]]:
@@ -191,6 +206,16 @@ class SubscriberShards:
         One concatenate + one stable argsort; a client subscribing to k of
         the matched filters appears once with all k (mirrors the reference
         delivering per SubPid after folding shard buckets)."""
+        return [(cid, fl) for _uid, cid, fl in self.expand_uids(fid_filts)]
+
+    def expand_uids(
+        self, fid_filts: Sequence[Tuple[int, str]]
+    ) -> List[Tuple[int, str, List[str]]]:
+        """expand() carrying the interned uid per receiver — the
+        delivery-worker pool shards connections by ``uid % workers``, so
+        dispatch partitions receivers without re-hashing clientid
+        strings (and per-connection packet order is preserved by
+        construction: one uid always lands on one shard)."""
         views: List[np.ndarray] = []
         filts: List[str] = []
         for fid, filt in fid_filts:
@@ -203,7 +228,7 @@ class SubscriberShards:
         cids = self._cids
         if len(views) == 1:
             f = filts[0]
-            return [(cids[uid], [f]) for uid in views[0].tolist()]
+            return [(uid, cids[uid], [f]) for uid in views[0].tolist()]
         all_u = np.concatenate(views)
         seg = np.repeat(
             np.arange(len(views)), [v.size for v in views]
@@ -211,7 +236,7 @@ class SubscriberShards:
         order = np.argsort(all_u, kind="stable")
         su = all_u[order]
         ss = seg[order]
-        out: List[Tuple[str, List[str]]] = []
+        out: List[Tuple[int, str, List[str]]] = []
         i = 0
         n = su.size
         su_l = su.tolist()
@@ -221,6 +246,6 @@ class SubscriberShards:
             uid = su_l[i]
             while j < n and su_l[j] == uid:
                 j += 1
-            out.append((cids[uid], [filts[k] for k in ss_l[i:j]]))
+            out.append((uid, cids[uid], [filts[k] for k in ss_l[i:j]]))
             i = j
         return out

@@ -158,6 +158,14 @@ class WsWriter:
     def write(self, data: bytes) -> None:
         self._writer.write(encode_frame(OP_BINARY, data))
 
+    def writelines(self, bufs) -> None:
+        """Vectored flush parity with the TCP transport: each chunk is
+        its own WS binary message, but all of them reach the socket
+        writer in one call."""
+        self._writer.write(
+            b"".join(encode_frame(OP_BINARY, b) for b in bufs)
+        )
+
     async def drain(self) -> None:
         await self._writer.drain()
 
@@ -307,3 +315,8 @@ async def ws_connect(host: str, port: int, path: str = "/mqtt", ssl=None,
 class WsClientWriter(WsWriter):
     def write(self, data: bytes) -> None:
         self._writer.write(encode_frame(OP_BINARY, data, mask=True))
+
+    def writelines(self, bufs) -> None:
+        self._writer.write(
+            b"".join(encode_frame(OP_BINARY, b, mask=True) for b in bufs)
+        )

@@ -243,6 +243,26 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         ),
         "batch_max": Field("int", 4096, min=1, desc="publish batch tick size"),
         "batch_delay": Field("duration", 0.002),
+        "delivery_workers": Field(
+            "int", 4, min=0, max=64,
+            desc="sharded asyncio delivery-worker pool: broadcast "
+                 "fan-out is partitioned by connection shard "
+                 "(subscriber-uid % workers) and drained concurrently "
+                 "so one stalled socket cannot head-of-line-block a "
+                 "broadcast (esockd conn-sup analog); 0 = deliver "
+                 "inline on the dispatch path"),
+        "delivery_queue_max": Field(
+            "int", 4096, min=1,
+            desc="per-shard delivery queue depth; past it the dispatch "
+                 "path delivers the batch inline (counted "
+                 "deliver.shard.backpressure) instead of growing the "
+                 "queue without bound"),
+        "delivery_backpressure_bytes": Field(
+            "bytesize", 1 << 20,
+            desc="slow-consumer watermark: a connection whose unflushed "
+                 "transport backlog exceeds this is counted + traced "
+                 "(deliver.backpressure) and skipped past, never "
+                 "awaited — force_shutdown reaps the extreme cases"),
         "hybrid": Field(
             "bool", True,
             desc="hybrid host/device match arbitration: serve matches from "

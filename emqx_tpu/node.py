@@ -459,6 +459,21 @@ class NodeRuntime:
         # the device falls behind, so loop-lag-based OLP alone can't see
         # that overload — feed tick depth into the same shed decision
         self.olp.pressure_fn = lambda: self.batcher.inflight_ticks >= 8
+        # sharded delivery-worker pool: broadcast fan-out drains off the
+        # dispatch call stack, partitioned by connection shard
+        self.delivery_pool = None
+        if int(self.conf.get("broker.delivery_workers")) > 0:
+            from .broker.delivery import DeliveryPool
+
+            self.delivery_pool = DeliveryPool(
+                self.broker,
+                workers=int(self.conf.get("broker.delivery_workers")),
+                queue_max=int(self.conf.get("broker.delivery_queue_max")),
+                backpressure_bytes=int(
+                    self.conf.get("broker.delivery_backpressure_bytes")
+                ),
+            )
+            self.broker.delivery = self.delivery_pool
         self.listeners: List[Listener] = []
         for ldef in self.conf.get("listeners") or [{"type": "tcp", "port": 1883}]:
             self.listeners.append(self._build_listener(ldef))
@@ -839,6 +854,8 @@ class NodeRuntime:
                 # a down endpoint is DISCONNECTED + retried, not a boot
                 # failure (reference bridges start async the same way)
                 await self.bridges.start()
+            if self.delivery_pool is not None:
+                self.delivery_pool.start()
             for lst in self.listeners:
                 await lst.start()
             for name in self.gateways.list():
@@ -910,6 +927,11 @@ class NodeRuntime:
                 await lst.stop()
             except Exception:
                 log.exception("stopping listener on port %s", lst.port)
+        if self.delivery_pool is not None:
+            try:
+                await self.delivery_pool.stop()
+            except Exception:
+                log.exception("stopping delivery pool")
         if self.cluster is not None:
             await self.cluster.stop()
         if self.bridges is not None:

@@ -42,6 +42,14 @@ KNOWN_KINDS: Dict[str, str] = {
     # broker publish path
     "publish_enter": "message accepted into the publish pipeline",
     "dispatch_done": "per-message dispatch finished (receivers counted)",
+    # delivery plane (broker/delivery.py worker pool + listener.py
+    # vectored transport flush)
+    "deliver.batch": "one connection's per-tick delivery batch drained "
+                     "by its shard worker",
+    "deliver.backpressure": "a delivery shard (queue depth) or a slow "
+                            "consumer (transport backlog) pushed back",
+    "deliver.flush": "multi-frame action batch flushed to the "
+                     "transport as one vectored write",
     # session lifecycle (emqx_cm analog)
     "session_created": "new session bound to a clientid",
     "session_resumed": "clean_start=false reattached to a parked session",

@@ -140,6 +140,53 @@ def test_roles_propagate_through_call_graph(tmp_path):
     assert role_map["emqx_tpu.chain:c"] == {roles.LOOP}
 
 
+def test_delivery_worker_role_flags_blocking_as_error(tmp_path):
+    """Delivery-shard workers (broker/delivery.py DeliveryPool) carry
+    the `delivery` role on top of `loop`; a blocking call reached from
+    one is still a full ERROR (delivery is loop-side work, not an
+    executor hop), and the role label propagates to sync callees so
+    the finding names the plane it stalls."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/broker/delivery.py": (
+            "import time\n"
+            "class DeliveryPool:\n"
+            "    async def _worker(self, i):\n"
+            "        self._deliver(i)\n"
+            "    def _deliver(self, i):\n"
+            "        time.sleep(0.01)\n"
+        ),
+    })
+    role_map, findings = run_blocking(idx)
+    worker_key = "emqx_tpu.broker.delivery:DeliveryPool._worker"
+    deliver_key = "emqx_tpu.broker.delivery:DeliveryPool._deliver"
+    assert role_map[worker_key] == {roles.LOOP, roles.DELIVERY}
+    assert role_map[deliver_key] == {roles.LOOP, roles.DELIVERY}
+    blocks = [f for f in findings if f.code == "block"]
+    assert len(blocks) == 1
+    assert blocks[0].severity == ERROR  # delivery does NOT soften it
+    assert "delivery" in blocks[0].message
+
+
+def test_delivery_role_not_a_distinct_race_writer(tmp_path):
+    """DELIVERY runs on the loop thread: a state attribute written from
+    a delivery worker and the loop is single-threaded access, not a
+    cross-thread race."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/broker/delivery.py": (
+            "class DeliveryPool:\n"
+            "    def __init__(self):\n"
+            "        self.batches = 0\n"
+            "    async def _worker(self, i):\n"
+            "        self.batches += 1\n"
+            "    async def stop(self):\n"
+            "        self.batches = 0\n"
+        ),
+    })
+    role_map = roles.infer_roles(idx)
+    found = races.check_races(idx, role_map)
+    assert [f for f in found if f.code == "race"] == []
+
+
 def test_allow_blocking_annotation_suppresses(tmp_path):
     idx = build_fixture(tmp_path, {
         "emqx_tpu/annotated.py": (

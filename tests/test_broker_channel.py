@@ -624,11 +624,12 @@ def test_resumed_session_updates_username(h):
 
 
 def test_fanout_wire_cache_correctness(h):
-    """The shared-serialization fast path must never leak wrong bytes:
-    v4 and v5 receivers, and retain-as-published differences, each get
-    their own wire form; QoS1 receivers and modified props are never
-    cached."""
-    from emqx_tpu.broker.frame import Parser, serialize
+    """The shared-prefix fast path must never leak wrong bytes: v4 and
+    v5 receivers, and retain-as-published differences, each get their
+    own wire form (keyed apart within ONE shared per-message cache);
+    QoS1 receivers share the prefix too, with only their packet id
+    spliced per receiver."""
+    from emqx_tpu.broker.frame import Parser, serialize, serialize_cached
 
     v5sub = h.connect("wc-v5", ver=MQTT_V5)
     v4sub = h.connect("wc-v4", ver=4)
@@ -657,18 +658,25 @@ def test_fanout_wire_cache_correctness(h):
     o5, w5 = wire(v5sub)
     o4, w4 = wire(v4sub)
     orap, wrap_ = wire(rap)
-    oq1, _ = wire(q1)
-    # plain qos0 receivers share a cache dict, keyed apart by version
-    assert getattr(o5, "_wire_cache", None) is not None
-    assert getattr(o4, "_wire_cache", None) is o5._wire_cache
+    oq1, wq1 = wire(q1)
+    # every receiver class shares ONE per-message prefix dict; the
+    # (version, qos, retain) key keeps the wire forms apart
+    assert getattr(o5, "_wire_prefix", None) is not None
+    assert getattr(o4, "_wire_prefix", None) is o5._wire_prefix
+    assert getattr(orap, "_wire_prefix", None) is o5._wire_prefix
+    assert getattr(oq1, "_wire_prefix", None) is o5._wire_prefix
     assert w5 != w4  # v5 carries a properties block
     # RAP receiver keeps retain=True (distinct key), plain ones clear it
     assert orap.retain is True and o5.retain is False
     assert wrap_ != w5
-    # QoS1 delivery (packet id) is never cached
-    assert getattr(oq1, "_wire_cache", None) is None
+    # the cached path is byte-identical to the direct serializer for
+    # every receiver class, including the QoS1 packet-id splice
+    for out, ch, ref in ((o5, v5sub, w5), (o4, v4sub, w4),
+                         (orap, rap, wrap_), (oq1, q1, wq1)):
+        assert serialize_cached(out, ch.proto_ver) == ref
+    assert oq1.packet_id is not None
     # parse back each wire form: the payload/topic survive intact
-    for ver, data in ((5, w5), (4, w4)):
+    for ver, data in ((5, w5), (4, w4), (5, wq1)):
         (parsed,) = Parser(version=ver).feed(data)
         assert parsed.topic == "wc/t" and parsed.payload == b"data"
 

@@ -1,0 +1,489 @@
+"""Delivery-plane fan-out: shared packet-prefix cache, scatter lanes,
+vectored flushes, sharded delivery workers (PR 9).
+
+Covers the byte-parity contract of the build-once/scatter-many path
+(prefix + packet-id splice == per-receiver `framelib.serialize` across
+the QoS x proto-version x properties x topic-alias matrix), the batched
+packet-id allocator, the vectored transport flush, and the
+DeliveryPool e2e invariants: no duplicate/missing delivery under a
+mid-broadcast slow consumer and a mid-broadcast disconnect.
+"""
+
+import asyncio
+from dataclasses import replace
+
+import pytest
+
+from emqx_tpu.broker import frame as framelib
+from emqx_tpu.broker import packet as pkt
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.delivery import DeliveryPool, scatter_template
+from emqx_tpu.broker.frame import (
+    PREFIX_STATS, exact_publish_size, publish_prefix, serialize,
+    serialize_cached,
+)
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import (
+    MQTT_V4, MQTT_V5, PacketType, Property, SubOpts,
+)
+from emqx_tpu.broker.session import Session
+from emqx_tpu.observe.tracepoints import check_trace
+
+
+# --------------------------------------------------- byte-parity contract
+
+
+PROP_MATRIX = [
+    {},
+    {Property.MESSAGE_EXPIRY_INTERVAL: 300},
+    {Property.CONTENT_TYPE: "application/json",
+     Property.RESPONSE_TOPIC: "resp/t"},
+    {Property.USER_PROPERTY: [("k1", "v1"), ("k2", "v2")],
+     Property.CORRELATION_DATA: b"\x00\x01\xff"},
+    {Property.SUBSCRIPTION_IDENTIFIER: [7],
+     Property.PAYLOAD_FORMAT_INDICATOR: 1},
+    {Property.TOPIC_ALIAS: 3},  # established-alias wire state
+]
+
+# payload sizes straddle the 1/2/3-byte remaining-length varint edges
+PAYLOAD_SIZES = [0, 1, 90, 127, 128, 200, 16_200, 16_500]
+
+
+def test_prefix_splice_byte_parity_matrix():
+    """prefix.splice(pid) must be byte-identical to a fresh serialize
+    for every (qos, proto, properties, topic/alias, payload) cell —
+    the exactness contract the scatter fan-out rests on."""
+    for ver in (MQTT_V4, MQTT_V5):
+        for qos in (0, 1, 2):
+            for props in PROP_MATRIX:
+                for size in PAYLOAD_SIZES:
+                    topic = "" if Property.TOPIC_ALIAS in props else \
+                        "a/b/cé"
+                    p = pkt.Publish(
+                        topic=topic,
+                        payload=b"\xab" * size,
+                        qos=qos,
+                        retain=(size % 2 == 0),
+                        dup=False,
+                        packet_id=None,
+                        properties=dict(props) if ver == MQTT_V5 else {},
+                    )
+                    prefix = publish_prefix(p, ver)
+                    if qos == 0:
+                        ref = serialize(p, ver)
+                        assert prefix.splice(None) == ref
+                        assert prefix.splice(None) is prefix.data
+                    else:
+                        for pid in (1, 0x1234, 65535):
+                            ref = serialize(
+                                replace(p, packet_id=pid), ver
+                            )
+                            assert prefix.splice(pid) == ref
+                    assert len(prefix) == len(prefix.data)
+
+
+def test_prefix_splice_rejects_missing_pid():
+    p = pkt.Publish(topic="t", payload=b"x", qos=1, packet_id=None)
+    prefix = publish_prefix(p, MQTT_V5)
+    with pytest.raises(framelib.FrameError):
+        prefix.splice(None)
+    with pytest.raises(framelib.FrameError):
+        prefix.splice(0)
+
+
+def test_serialize_cached_shares_one_serialization():
+    """Receivers attaching the same `_wire_prefix` dict pay ONE
+    serialization per (version, qos, retain) wire form; later packets
+    splice only their packet id."""
+    shared = {}
+    base = dict(topic="s/t", payload=b"p" * 64, qos=1, retain=False,
+                dup=False)
+    miss0, hit0 = PREFIX_STATS["miss"], PREFIX_STATS["hit"]
+    outs = []
+    for pid in (10, 11, 12):
+        p = pkt.Publish(packet_id=pid, **base)
+        p._wire_prefix = shared
+        outs.append(serialize_cached(p, MQTT_V5))
+    assert PREFIX_STATS["miss"] - miss0 == 1
+    assert PREFIX_STATS["hit"] - hit0 == 2
+    for pid, data in zip((10, 11, 12), outs):
+        ref = serialize(pkt.Publish(packet_id=pid, **base), MQTT_V5)
+        assert data == ref
+    # distinct version = distinct entry in the SAME dict
+    p4 = pkt.Publish(packet_id=13, **base)
+    p4._wire_prefix = shared
+    assert serialize_cached(p4, MQTT_V4) == serialize(
+        pkt.Publish(packet_id=13, **base), MQTT_V4
+    )
+    assert len(shared) == 2
+
+
+def test_exact_publish_size_memoizes_on_prefix():
+    """The max-packet-size slow path measures identical payloads once
+    per wire form, not once per receiver (satellite #1)."""
+    shared = {}
+    base = dict(topic="big/t", payload=b"q" * 512, qos=1, dup=False)
+    miss0 = PREFIX_STATS["miss"]
+    sizes = []
+    for pid in (1, 2, 3, 4):
+        p = pkt.Publish(packet_id=pid, **base)
+        p._wire_prefix = shared
+        sizes.append(exact_publish_size(p, MQTT_V5))
+    assert PREFIX_STATS["miss"] - miss0 == 1  # measured exactly once
+    ref = len(serialize(pkt.Publish(packet_id=9, **base), MQTT_V5))
+    assert sizes == [ref] * 4
+
+
+def test_prefix_stats_synced_into_metrics():
+    b = Broker()
+    b.sync_engine_metrics()
+    assert b.metrics.get("deliver.prefix.hit") == PREFIX_STATS["hit"]
+    assert b.metrics.get("deliver.prefix.miss") == PREFIX_STATS["miss"]
+
+
+# ----------------------------------------------- batched pid allocation
+
+
+def test_batched_pid_allocation_matches_serial():
+    """A fan-in batch of QoS1 deliveries allocates pids in one scan,
+    bit-for-bit the ids the per-message allocator would hand out."""
+    sa = Session("a", max_inflight=16)
+    sb = Session("b", max_inflight=16)
+    for s in (sa, sb):
+        s.subscribe("t/1", SubOpts(qos=1))
+    msgs = [Message(topic="t/1", payload=bytes([i]), qos=1)
+            for i in range(10)]
+    # serial oracle: one deliver() call per message
+    serial = [d.packet_id for m in msgs for d in sa.deliver([("t/1", m)])]
+    batch = [d.packet_id for d in sb.deliver([("t/1", m) for m in msgs])]
+    assert batch == serial
+    assert len(set(batch)) == len(batch)
+    assert len(sb.inflight) == 10
+
+
+def test_batched_deliver_overflow_to_mqueue_mid_batch():
+    """The window fills mid-batch: later QoS1 items land in the mqueue
+    exactly as the one-at-a-time path would order them."""
+    s = Session("c", max_inflight=3)
+    s.subscribe("t/1", SubOpts(qos=1))
+    msgs = [Message(topic="t/1", payload=bytes([i]), qos=1)
+            for i in range(6)]
+    out = s.deliver([("t/1", m) for m in msgs])
+    assert len(out) == 3 and all(d.packet_id for d in out)
+    assert len(s.mqueue) == 3
+    assert [m.payload for m in s.mqueue.peek_all()] == [
+        bytes([3]), bytes([4]), bytes([5])]
+
+
+def test_batched_pid_allocation_skips_inflight_ids():
+    s = Session("d", max_inflight=0)  # unbounded window
+    s.subscribe("t/1", SubOpts(qos=1))
+    s._next_pid = 65534  # force a wrap mid-batch
+    out = s.deliver([
+        ("t/1", Message(topic="t/1", payload=b"x", qos=1))
+        for _ in range(4)
+    ])
+    assert [d.packet_id for d in out] == [65534, 65535, 1, 2]
+
+
+# ------------------------------------------------------- vectored flush
+
+
+class _RecWriter:
+    """StreamWriter stand-in recording write/writelines calls."""
+
+    def __init__(self):
+        self.calls = []
+
+    def write(self, data):
+        self.calls.append(("write", bytes(data)))
+
+    def writelines(self, bufs):
+        self.calls.append(("writelines", [bytes(b) for b in bufs]))
+
+    def get_extra_info(self, name, default=None):
+        return ("127.0.0.1", 1883)
+
+    def close(self):
+        pass
+
+
+def _bare_connection(broker):
+    """A Connection wired to a recording writer, skipping asyncio."""
+    from emqx_tpu.broker.listener import Connection
+
+    conn = Connection.__new__(Connection)
+    conn.writer = _RecWriter()
+    conn.channel = Channel(broker, peername="t")
+    conn._closing = None
+    conn._normal = False
+    conn._paced_tasks = {}
+    return conn
+
+
+def test_send_actions_vectored_flush():
+    b = Broker()
+    conn = _bare_connection(b)
+    pkts = [pkt.Publish(topic=f"v/{i}", payload=b"x", qos=0)
+            for i in range(3)]
+    with check_trace() as t:
+        conn._send_actions([("send", p) for p in pkts])
+    # one transport call for the whole action batch
+    (kind, bufs), = conn.writer.calls
+    assert kind == "writelines" and len(bufs) == 3
+    assert bufs == [serialize(p, conn.channel.proto_ver) for p in pkts]
+    assert b.metrics.get("deliver.flush.vectored") == 1
+    assert b.metrics.get("bytes.sent") == sum(len(x) for x in bufs)
+    t.assert_seen("deliver.flush", n=1, **{})
+    # single-packet batches stay on the plain write path
+    conn.writer.calls.clear()
+    conn._send_actions([("send", pkts[0])])
+    (kind, _), = conn.writer.calls
+    assert kind == "write"
+    assert b.metrics.get("deliver.flush.vectored") == 1
+
+
+def test_ws_writer_writelines_frames_each_chunk():
+    from emqx_tpu.broker.ws import WsWriter, encode_frame, OP_BINARY
+
+    raw = _RecWriter()
+    w = WsWriter.__new__(WsWriter)
+    w._writer = raw
+    w.writelines([b"aa", b"bb"])
+    (kind, data), = raw.calls
+    assert kind == "write"
+    assert data == encode_frame(OP_BINARY, b"aa") + \
+        encode_frame(OP_BINARY, b"bb")
+
+
+# ------------------------------------------------ scatter lane semantics
+
+
+class _Hub:
+    """Minimal in-process channel harness (single-engine Broker)."""
+
+    def __init__(self):
+        self.broker = Broker()
+
+    def connect(self, cid, ver=MQTT_V5, props=None, **cfg):
+        ch = Channel(self.broker, peername="127.0.0.1:1")
+        ch.outbox = []
+        ch.out_cb = ch.outbox.extend
+        ch.on_kick = lambda rc: None
+        for k, v in cfg.items():
+            setattr(ch.cfg, k, v)
+        ch.handle_in(pkt.Connect(proto_name="MQTT", proto_ver=ver,
+                                 clientid=cid, properties=props or {}))
+        return ch
+
+    @staticmethod
+    def pubs(ch):
+        return [a[1] for a in ch.outbox
+                if a[0] == "send" and a[1].type == PacketType.PUBLISH]
+
+
+def _sub(ch, filt, opts=None, packet_id=1, sub_id=None):
+    props = {}
+    if sub_id is not None:
+        props[Property.SUBSCRIPTION_IDENTIFIER] = [sub_id]
+    ch.handle_in(pkt.Subscribe(packet_id=packet_id,
+                               topic_filters=[(filt, opts or SubOpts(qos=0))],
+                               properties=props))
+    ch.outbox.clear()
+
+
+def test_scatter_lane_respects_receiver_classes():
+    """The broadcast lane must produce exactly the bytes the slow path
+    would for every receiver class: v4/v5, RAP, sub-id, no_local,
+    max-packet-limited, QoS1 grant."""
+    h = _Hub()
+    plain5 = h.connect("sc-v5")
+    plain4 = h.connect("sc-v4", ver=MQTT_V4)
+    rap = h.connect("sc-rap")
+    sid = h.connect("sc-sid")
+    nl = h.connect("sc-nl")
+    small = h.connect("sc-small",
+                      props={Property.MAXIMUM_PACKET_SIZE: 32})
+    q1 = h.connect("sc-q1")
+    _sub(plain5, "sc/t")
+    _sub(plain4, "sc/t")
+    _sub(rap, "sc/t", SubOpts(qos=0, retain_as_published=True))
+    _sub(sid, "sc/t", sub_id=9)
+    _sub(nl, "sc/t", SubOpts(qos=0, no_local=True))
+    _sub(small, "sc/t")
+    _sub(q1, "sc/t", SubOpts(qos=1))
+
+    publisher = h.connect("sc-nl")  # same clientid as nl -> takeover
+    # re-establish nl after the takeover kicked it
+    nl = h.connect("sc-nl2")
+    _sub(nl, "sc/t", SubOpts(qos=0, no_local=True))
+
+    h.broker.publish(Message(topic="sc/t", payload=b"d" * 40, qos=1,
+                             retain=True, from_client="sc-nl2"))
+    (o5,) = h.pubs(plain5)
+    (o4,) = h.pubs(plain4)
+    (orap,) = h.pubs(rap)
+    (osid,) = h.pubs(sid)
+    (oq1,) = h.pubs(q1)
+    assert h.pubs(nl) == []         # no_local suppressed own publish
+    assert h.pubs(small) == []      # exceeded client max packet: dropped
+    assert serialize_cached(o5, MQTT_V5) == serialize(o5, MQTT_V5)
+    assert serialize_cached(o4, MQTT_V4) == serialize(o4, MQTT_V4)
+    assert o5.qos == 0 and o5.retain is False
+    assert orap.retain is True
+    assert osid.properties[Property.SUBSCRIPTION_IDENTIFIER] == [9]
+    assert oq1.qos == 1 and oq1.packet_id is not None
+    assert serialize_cached(oq1, MQTT_V5) == serialize(oq1, MQTT_V5)
+    assert h.broker.metrics.get("delivery.dropped.too_large") == 1
+
+
+def test_scatter_uid_cache_invalidation_on_reconnect():
+    """A receiver that disconnects and reconnects must be served
+    through its NEW channel — the per-uid callback cache cannot go
+    stale (cm registry changes invalidate it)."""
+    h = _Hub()
+    recv = h.connect("inv-r")
+    _sub(recv, "inv/t")
+    others = []
+    for i in range(4):
+        c = h.connect(f"inv-o{i}")
+        _sub(c, "inv/t")
+        others.append(c)
+    h.broker.publish(Message(topic="inv/t", payload=b"one"))
+    assert len(h.pubs(recv)) == 1
+    # replace the channel (same clientid -> takeover path)
+    recv2 = h.connect("inv-r")
+    _sub(recv2, "inv/t", packet_id=2)
+    h.broker.publish(Message(topic="inv/t", payload=b"two"))
+    assert [p.payload for p in h.pubs(recv2)] == [b"two"]
+    # the OLD channel saw nothing new after the takeover
+    assert all(len(h.pubs(o)) == 2 for o in others)
+
+
+def test_scatter_template_classes():
+    msg = Message(topic="st/t", payload=b"z", retain=True,
+                  headers={"retained": True})
+    tmpl, act = scatter_template(msg, (MQTT_V5, True, None))
+    assert act == [("send", tmpl)]
+    assert tmpl.retain is True and tmpl.qos == 0
+    # sub-id template: private prefix dict, props carry the id
+    tmpl2, _ = scatter_template(msg, (MQTT_V5, True, 4))
+    assert tmpl2.properties[Property.SUBSCRIPTION_IDENTIFIER] == [4]
+    assert tmpl2._wire_prefix is not tmpl._wire_prefix
+
+
+# ------------------------------------------------- delivery-worker pool
+
+
+def _pool_broker(workers=2, **kw):
+    b = Broker()
+    b.delivery = DeliveryPool(b, workers=workers, **kw)
+    return b
+
+
+async def _drain_pool(pool):
+    # the workers run on this loop; a couple of yields drain them
+    for _ in range(6):
+        await asyncio.sleep(0)
+    for q in pool._queues:
+        while not q.empty():
+            await asyncio.sleep(0)
+
+
+def test_pool_fanout_exactly_once_with_disconnect_and_slow_consumer():
+    """Mid-broadcast disconnect re-routes to the parked session (no
+    loss, no duplicate); a slow consumer is counted + skipped, never
+    awaited; every healthy receiver gets exactly one copy."""
+
+    async def run():
+        h = _Hub()
+        b = h.broker
+        b.delivery = DeliveryPool(b, workers=2, backpressure_bytes=64)
+        b.delivery.start()
+        chans = []
+        for i in range(8):
+            c = h.connect(f"pl-{i}",
+                          props={Property.SESSION_EXPIRY_INTERVAL: 300})
+            _sub(c, "pl/t", SubOpts(qos=1))
+            chans.append(c)
+        # one slow consumer: transport backlog beyond the watermark
+        chans[3].conn_buffer_fn = lambda: 1 << 20
+        with check_trace() as t:
+            b.publish_many([Message(topic="pl/t", payload=b"m1", qos=1)])
+            # mid-broadcast disconnect: channel 5 goes away AFTER
+            # dispatch queued its batch, BEFORE the worker drained it
+            chans[5].terminate(normal=True)
+            b.cm.disconnect_channel  # (state settled via terminate)
+            await _drain_pool(b.delivery)
+        for i, c in enumerate(chans):
+            if i == 5:
+                continue
+            assert len(h.pubs(c)) == 1, f"receiver {i}"
+        # the disconnected receiver's copy went to its parked session
+        parked = b.cm.lookup_session("pl-5")
+        assert parked is not None
+        assert len(parked.mqueue) + len(parked.inflight) == 1
+        assert b.metrics.get("deliver.shard.backpressure") >= 1
+        t.assert_seen("deliver.batch")
+        t.assert_seen("deliver.backpressure")
+        await b.delivery.stop()
+
+    asyncio.run(run())
+
+
+def test_pool_shard_saturation_falls_back_inline():
+    async def run():
+        h = _Hub()
+        b = h.broker
+        b.delivery = DeliveryPool(b, workers=1, queue_max=1)
+        b.delivery.start()
+        chans = []
+        for i in range(6):
+            c = h.connect(f"sat-{i}")
+            _sub(c, "sat/t")
+            chans.append(c)
+        b.publish_many([Message(topic="sat/t", payload=b"x")])
+        await _drain_pool(b.delivery)
+        assert all(len(h.pubs(c)) == 1 for c in chans)
+        assert b.metrics.get("deliver.shard.backpressure") >= 1
+        await b.delivery.stop()
+
+    asyncio.run(run())
+
+
+def test_pool_preserves_per_connection_order():
+    async def run():
+        h = _Hub()
+        b = h.broker
+        b.delivery = DeliveryPool(b, workers=3)
+        b.delivery.start()
+        c = h.connect("ord-1")
+        _sub(c, "ord/t")
+        b.publish_many([
+            Message(topic="ord/t", payload=bytes([i])) for i in range(5)
+        ])
+        await _drain_pool(b.delivery)
+        assert [p.payload for p in h.pubs(c)] == [
+            bytes([i]) for i in range(5)]
+        # the whole tick flushed as ONE per-connection batch
+        assert b.metrics.get("messages.delivered.batched") == 5
+        await b.delivery.stop()
+
+    asyncio.run(run())
+
+
+def test_pool_stop_drains_queued_batches():
+    async def run():
+        h = _Hub()
+        b = h.broker
+        b.delivery = DeliveryPool(b, workers=2)
+        b.delivery.start()
+        c = h.connect("dr-1")
+        _sub(c, "dr/t")
+        b.publish_many([Message(topic="dr/t", payload=b"last")])
+        # stop BEFORE the workers ran: the batch must still deliver
+        await b.delivery.stop()
+        assert [p.payload for p in h.pubs(c)] == [b"last"]
+
+    asyncio.run(run())

@@ -38,7 +38,7 @@ from typing import Dict, List, Optional, Set
 
 from .index import FuncInfo, ProjectIndex, _attr_chain
 from .report import ERROR, Finding
-from .roles import LOOP
+from .roles import DELIVERY, LOOP
 
 _CTOR_METHODS = {"__init__", "__new__", "__post_init__"}
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}
@@ -161,7 +161,14 @@ def _judge_class(idx: ProjectIndex, ci, st: _ClassState,
     findings: List[Finding] = []
     method_roles: Dict[str, Set[str]] = {}
     for name, m in ci.methods.items():
-        method_roles[name] = set(roles.get(m.key, set()))
+        r = set(roles.get(m.key, set()))
+        # DELIVERY labels loop-side work (asyncio delivery-shard
+        # workers) — same OS thread as LOOP, so it is not a distinct
+        # writer for the cross-THREAD race join
+        if DELIVERY in r:
+            r.discard(DELIVERY)
+            r.add(LOOP)
+        method_roles[name] = r
     fi = idx.files[ci.path]
     for attr, accesses in sorted(st.accesses.items()):
         write_roles: Set[str] = set()

@@ -10,7 +10,11 @@ Dialyzer infers success typings from known roots; this pass infers
   the hop);
 * functions in `ops/native.py` that enter the GIL-free C++ worker pool
   (any `lib.etpu_*` call) additionally carry ``pool``;
-* `create_task`/`ensure_future` targets stay ``loop``.
+* `create_task`/`ensure_future` targets stay ``loop``;
+* async methods of the delivery-worker pool (`broker/delivery.py`
+  DeliveryPool) additionally carry ``delivery`` — still loop-side, the
+  label just names the plane a blocking call would stall (one blocked
+  shard worker head-of-line-blocks its whole fan-out shard).
 
 Roles propagate caller -> callee over plain call edges to a fixed
 point.  A function whose role set contains ``loop`` is reachable on the
@@ -40,6 +44,16 @@ from .report import ERROR, WARN, Finding
 LOOP = "loop"
 WORKER = "worker"
 POOL = "pool"
+# delivery-shard workers (broker/delivery.py DeliveryPool): asyncio
+# tasks draining the per-shard fan-out queues.  They run ON the loop
+# (so LOOP-blocking findings apply with full force), but carry their
+# own role label so a finding inside the broadcast drain path names
+# the plane it stalls — one blocking call there head-of-line-blocks a
+# whole delivery shard, not just one connection.
+DELIVERY = "delivery"
+
+# (module, class) roots whose async methods seed the DELIVERY role
+_DELIVERY_ROOTS = {("emqx_tpu.broker.delivery", "DeliveryPool")}
 
 # module-level blocking primitives: (head name, attr)
 _BLOCKING_MODULE_CALLS = {
@@ -78,6 +92,8 @@ def infer_roles(idx: ProjectIndex) -> Dict[str, Set[str]]:
     for key, info in idx.funcs.items():
         if info.is_async:
             add(key, LOOP)
+            if (info.module, info.cls) in _DELIVERY_ROOTS:
+                add(key, DELIVERY)
         if info.module == "emqx_tpu.ops.native" and _enters_native_pool(
             info
         ):
@@ -136,7 +152,10 @@ def check_blocking(
         fn_roles = roles.get(key, set())
         if LOOP not in fn_roles:
             continue
-        pure_loop = fn_roles == {LOOP}
+        # "pure loop" = no executor/pool path exists; DELIVERY is a
+        # loop-side label, not an escape hatch, so it must not soften
+        # the severity
+        pure_loop = not (fn_roles & {WORKER, POOL})
         fi = idx.files[info.path]
         file_vars = _fileish_names(idx, info)
         sock_vars = _sockish_names(idx, info)
