@@ -1,22 +1,28 @@
 # Build/check entry points (the reference's `make` + rebar gates analog:
 # /root/reference/Makefile, rebar.config:16-36 dialyzer/xref/elvis).
 
-.PHONY: check check-json lint lint-fast test test-fast native bench \
-        restore-bench chaos ds-bench ds-dump ds-soak churn-bench \
-        retained-bench fanout-bench
+.PHONY: check check-json lint lint-fast lint-locks test test-fast \
+        native bench restore-bench chaos ds-bench ds-dump ds-soak \
+        churn-bench retained-bench fanout-bench
 
 # static-analysis gate (tools/analysis/): the dialyzer/xref/elvis
 # analog, stdlib-only — whole-project AST index + call graph, thread-
 # role inference + event-loop blocking-call detector, cross-thread race
-# lint, registry cross-checks, style lints.  Exit 0 = empty error tier
-# and no non-baselined warnings (same contract the old tools/check.py
-# had, now tiered; see README "Static analysis").
+# lint, lock-order graphs + deadlock cycles (lockorder.json), task/
+# resource lifecycle, cancellation safety, registry cross-checks, style
+# lints.  Exit 0 = empty error tier and no non-baselined warnings (same
+# contract the old tools/check.py had, now tiered; see README "Static
+# analysis").
 lint:
 	python -m tools.analysis
 
 # fast iteration: expensive per-file passes limited to `git diff` files
 lint-fast:
 	python -m tools.analysis --changed
+
+# lock-order pass alone (single-pass iteration while reordering locks)
+lint-locks:
+	python -m tools.analysis --only locks --stats
 
 # machine-readable findings (CI annotations, dashboards)
 check-json:

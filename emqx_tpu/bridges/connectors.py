@@ -162,7 +162,9 @@ class MqttConnector:
                     r = self.on_message(msg)
                     if asyncio.iscoroutine(r):
                         await r
-        except (asyncio.CancelledError, Exception):
+        except asyncio.CancelledError:
+            raise  # stop() cancelled the pump: report cancelled, not done
+        except Exception:
             pass
 
 

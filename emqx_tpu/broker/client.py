@@ -133,8 +133,9 @@ class MqttClient:
                     break
                 for p in self._parser.feed(data):
                     await self._handle(p)
-        except (FrameError, ConnectionResetError, asyncio.CancelledError,
-                ssl.SSLError):
+        except asyncio.CancelledError:
+            raise  # cancellation must propagate; cleanup runs in finally
+        except (FrameError, ConnectionResetError, ssl.SSLError):
             # SSLError: server dropped a TLS transport without close_notify
             pass
         finally:

@@ -33,8 +33,11 @@ class Hooks:
         bisect.insort(chain, (-priority, self._seq, cb))
 
     def delete(self, point: str, cb: Callable) -> None:
+        # equality, not identity: `self.m` builds a fresh bound-method
+        # object on every access, so delete(point, self.m) with an `is`
+        # check would never match the one put() stored
         chain = self._chains.get(point, [])
-        self._chains[point] = [e for e in chain if e[2] is not cb]
+        self._chains[point] = [e for e in chain if e[2] != cb]
 
     def callbacks(self, point: str) -> List[Callable]:
         return [cb for _, _, cb in self._chains.get(point, [])]

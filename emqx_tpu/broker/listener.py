@@ -75,6 +75,10 @@ class Connection:
         )
         self._retry_task: Optional[asyncio.Task] = None
         self._paced_tasks: Dict[str, asyncio.Task] = {}
+        # deferred-ack / cluster-sync tasks: retained so the GC cannot
+        # drop them mid-flight; they self-evict on completion and the
+        # stragglers are cancelled at connection shutdown
+        self._io_tasks: set = set()
         # asyncio allows only one drain() waiter per transport
         self._drain_lock = asyncio.Lock()
 
@@ -94,11 +98,9 @@ class Connection:
                     log.exception("serialize/send failed")
             elif kind == "ack_async":
                 fut, builder = action[1], action[2]
-                asyncio.ensure_future(self._ack_when_done(fut, builder))
+                self._spawn_io(self._ack_when_done(fut, builder))
             elif kind == "cluster_sync":
-                asyncio.ensure_future(
-                    self._cluster_sync(action[1], action[2])
-                )
+                self._spawn_io(self._cluster_sync(action[1], action[2]))
             elif kind == "retained_paced":
                 # flow-controlled retained re-delivery on subscribe;
                 # a re-subscribe supersedes the previous paced tail
@@ -144,6 +146,12 @@ class Connection:
             tp("deliver.flush", n=len(bufs), bytes=total)
         except Exception:
             log.exception("vectored send failed")
+
+    def _spawn_io(self, coro) -> asyncio.Task:
+        t = asyncio.ensure_future(coro)
+        self._io_tasks.add(t)
+        t.add_done_callback(self._io_tasks.discard)
+        return t
 
     async def _cluster_sync(self, clientid: str, clean_start: bool) -> None:
         """Run the cross-node discard/takeover (post-auth; see
@@ -315,6 +323,9 @@ class Connection:
         for t in list(self._paced_tasks.values()):
             t.cancel()
         self._paced_tasks.clear()
+        for t in list(self._io_tasks):
+            t.cancel()
+        self._io_tasks.clear()
         try:
             await self._drain()
         except Exception:

@@ -132,8 +132,9 @@ class WsReader:
                         pass
                     break
                 # pongs ignored
-        except (asyncio.IncompleteReadError, ConnectionError,
-                asyncio.CancelledError, ssl.SSLError):
+        except asyncio.CancelledError:
+            raise  # cancellation must propagate; the finally runs either way
+        except (asyncio.IncompleteReadError, ConnectionError, ssl.SSLError):
             # SSLError: close_notify teardown races on a wss transport
             pass
         except FrameTooLarge as e:
@@ -146,6 +147,15 @@ class WsReader:
         if self.closed and self._q.empty():
             return b""
         return await self._q.get()
+
+    def close(self) -> None:
+        """Cancel the frame pump (idempotent).  A half-open socket
+        otherwise keeps the pump task parked in read_frame forever —
+        the transport owner closes the socket itself."""
+        if self._pump is not None:
+            self._pump.cancel()
+            self._pump = None
+        self.closed = True
 
 
 class WsWriter:
@@ -232,6 +242,7 @@ class WsListener(Listener):
         try:
             await conn.run()
         finally:
+            ws_reader.close()
             self._conns.discard(task)
 
     async def _handshake(self, reader, writer) -> bool:

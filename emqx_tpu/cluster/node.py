@@ -219,6 +219,10 @@ class ClusterNode:
         self._resyncing: Set[str] = set()
         self._hb_task: Optional[asyncio.Task] = None
         self._disc_task: Optional[asyncio.Task] = None
+        # one-shot background work (link teardown, resyncs, remote
+        # sweeps): retained here so the GC cannot drop a running task
+        # and stop() can cancel the stragglers; done tasks self-evict
+        self._bg_tasks: Set[asyncio.Task] = set()
         self._misses: Dict[str, int] = {}
         self._roles: Dict[str, str] = {}  # peer -> core|replicant
 
@@ -267,11 +271,29 @@ class ClusterNode:
                 self._discovery_loop()
             )
 
+    def _spawn_bg(self, coro) -> asyncio.Task:
+        """Run a one-shot background coroutine, retained + reaped: the
+        task registry keeps a strong reference until completion and
+        surfaces unexpected failures instead of dropping them."""
+        task = asyncio.get_running_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._reap_bg)
+        return task
+
+    def _reap_bg(self, task: asyncio.Task) -> None:
+        self._bg_tasks.discard(task)
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            log.warning("%s: background task failed: %r", self.name, exc)
+
     async def stop(self) -> None:
         self._stopping = True
         tasks = [self._hb_task, self._disc_task]
         tasks += list(self._purge_tasks.values())
         tasks += list(self._replay_tasks.values())
+        tasks += list(self._bg_tasks)
         self._purge_tasks.clear()
         self._replay_tasks.clear()
         for task in tasks:
@@ -295,7 +317,7 @@ class ClusterNode:
         old = self.links.get(peer)
         if old is not None and old.addr != tuple(addr):
             self.links.pop(peer, None)
-            asyncio.get_running_loop().create_task(old.stop())
+            self._spawn_bg(old.stop())
         if peer not in self.links:
             self._add_link(peer, addr)
 
@@ -303,7 +325,7 @@ class ClusterNode:
         self.peers_cfg.pop(peer, None)
         link = self.links.pop(peer, None)
         if link is not None:
-            asyncio.get_running_loop().create_task(link.stop())
+            self._spawn_bg(link.stop())
         # explicit leave: no transient-flap grace, purge immediately
         self._node_down(peer, purge=True)
 
@@ -379,7 +401,7 @@ class ClusterNode:
             self.links.pop(link.peer, None)
             self.peers_cfg.pop(link.peer, None)
             self._status.pop(link.peer, None)
-            asyncio.get_running_loop().create_task(link.stop())
+            self._spawn_bg(link.stop())
             return
         self._cancel_purge(link.peer)
         self._status[link.peer] = "up"
@@ -387,7 +409,7 @@ class ClusterNode:
         tracept("cluster.peer.health", peer=link.peer, state="up")
         self.broker.hooks.run("node.up", (link.peer,))
         # bootstrap that peer's routes, then drain the forward spool
-        asyncio.get_running_loop().create_task(self._resync(link.peer))
+        self._spawn_bg(self._resync(link.peer))
         self._kick_replay(link.peer)
 
     def _node_down(self, peer: str, purge: bool = False) -> None:
@@ -440,7 +462,7 @@ class ClusterNode:
         self._cancel_purge(peer)
         self._status[peer] = "up"
         tracept("cluster.peer.health", peer=peer, state="up")
-        asyncio.get_running_loop().create_task(self._resync(peer))
+        self._spawn_bg(self._resync(peer))
         self._kick_replay(peer)
 
     async def _heartbeat(self) -> None:
@@ -542,7 +564,7 @@ class ClusterNode:
             obj["filt"], obj.get("group", ""),
         )
         if not ok:
-            asyncio.get_running_loop().create_task(self._resync(obj["node"]))
+            self._spawn_bg(self._resync(obj["node"]))
         # cores relay first-hop ops so nodes without a direct link to the
         # origin (replicant<->replicant) still converge (rlog fan-out)
         if (
@@ -1103,9 +1125,7 @@ class ClusterNode:
             # background — a partition-degraded takeover can leave a
             # second live copy elsewhere, and single-session-per-clientid
             # must converge (registry-based emqx kicks cluster-wide)
-            asyncio.get_running_loop().create_task(
-                self.discard_remote(clientid)
-            )
+            self._spawn_bg(self.discard_remote(clientid))
             return True
 
         async def attempt() -> bool:

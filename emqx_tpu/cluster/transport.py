@@ -244,7 +244,7 @@ class PeerLink:
                 self.on_up(self, self.peer_hello)
                 await self._read_loop(reader)
             except asyncio.CancelledError:
-                return
+                raise  # stop() cancelled us: propagate, don't reconnect
             except Exception:
                 pass
             was_up = self.connected
@@ -508,11 +508,9 @@ class Transport:
                             ack["id"] = header["id"]
                             writer.write(pack_json(FORWARD_ACK, ack))
                     await writer.drain()
-        except (
-            asyncio.IncompleteReadError,
-            ConnectionError,
-            asyncio.CancelledError,
-        ):
+        except asyncio.CancelledError:
+            raise  # server shutdown cancels handlers; finally cleans up
+        except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
             for t in rpc_tasks:

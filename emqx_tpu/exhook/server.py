@@ -63,7 +63,9 @@ class ProviderServer:
                 body = json.dumps(resp, separators=(",", ":")).encode()
                 writer.write(struct.pack("!I", len(body)) + body)
                 await writer.drain()
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            raise  # cancellation must propagate; finally closes the conn
+        except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
             self._conns.discard(writer)

@@ -310,7 +310,9 @@ class StompGateway:
                     if ch.closing:
                         break
                 await writer.drain()
-        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            raise  # gateway stop cancels clients; finally closes the session
+        except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
             self._conns.discard(task)

@@ -170,7 +170,9 @@ class HttpApi:
                 await self._respond(writer, status, payload, keep)
                 if not keep:
                     return
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            raise  # api server stop cancels handlers; finally closes
+        except (asyncio.IncompleteReadError, ConnectionError):
             pass
         except Exception:
             try:

@@ -56,6 +56,7 @@ class DelayedPublish:
         self._canceled: set = set()  # seqs removed before firing
         self._store_path = store_path
         self._store = None
+        self._hooks = None  # set by install(); cleared by close()
         self._dead_records = 0
         if store_path is not None:
             self._load()
@@ -291,6 +292,12 @@ class DelayedPublish:
         }
 
     def close(self) -> None:
+        if self._hooks is not None:
+            # a closed scheduler must stop intercepting $delayed
+            # publishes (its store is gone; withheld messages would
+            # vanish silently)
+            self._hooks.delete("message.publish", self.on_message_publish)
+            self._hooks = None
         if self._store is not None:
             self._store.close()
             self._store = None
@@ -300,6 +307,7 @@ class DelayedPublish:
         return len(self._live)
 
     def install(self, hooks: Hooks) -> None:
+        self._hooks = hooks
         hooks.put("message.publish", self.on_message_publish, priority=50)
 
 

@@ -907,15 +907,15 @@ class NodeRuntime:
     async def _shutdown(self) -> None:
         """Stop every component that is running; safe on partial starts
         (each component's stop() tolerates never-started state)."""
-        for attr in ("_tick_task", "_exporter_task"):
-            task = getattr(self, attr)
+        for task in (self._tick_task, self._exporter_task):
             if task:
                 task.cancel()
                 try:
                     await task
                 except (asyncio.CancelledError, Exception):
                     pass
-                setattr(self, attr, None)
+        self._tick_task = None
+        self._exporter_task = None
         await self.http.stop()
         for name in self.gateways.list():
             try:

@@ -76,6 +76,8 @@ class TraceManager:
                 f.close()
             except OSError:
                 pass
+        if not self.traces:
+            self._release_hooks()
         return spec is not None
 
     def list_traces(self) -> List[TraceSpec]:
@@ -96,6 +98,19 @@ class TraceManager:
         self.hooks.put("client.connected", self._on_connected, priority=-500)
         self.hooks.put("message.delivered", self._on_delivered, priority=-500)
         self._installed = True
+
+    def _release_hooks(self) -> None:
+        """Mirror of _ensure_hooks: the last trace stopping removes the
+        tracer from every hook chain, so an idle tracer costs the
+        publish/deliver paths nothing."""
+        if not self._installed:
+            return
+        self.hooks.delete("message.publish", self._on_publish)
+        self.hooks.delete("session.subscribed", self._on_subscribed)
+        self.hooks.delete("session.unsubscribed", self._on_unsubscribed)
+        self.hooks.delete("client.connected", self._on_connected)
+        self.hooks.delete("message.delivered", self._on_delivered)
+        self._installed = False
 
     def _emit(self, event: str, clientid: str, topic: Optional[str],
               ip: Optional[str], extra: dict) -> None:

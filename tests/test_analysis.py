@@ -16,7 +16,8 @@ import os
 import pytest
 
 from tools.analysis import baseline as baseline_mod
-from tools.analysis import cli, races, registry, roles
+from tools.analysis import cancel, cli, lifecycle, locks, races, \
+    registry, roles
 from tools.analysis.index import ProjectIndex
 from tools.analysis.report import ERROR, WARN, Finding, Report
 
@@ -338,6 +339,487 @@ def test_await_under_threading_lock(tmp_path):
     assert "bad" in locks[0].message
 
 
+# ----------------------------------------------------------- lock ordering
+
+
+def run_locks(idx, order=None):
+    role_map = roles.infer_roles(idx)
+    findings, stats = locks.check_locks(idx, role_map, order=order or [])
+    return findings, stats
+
+
+LOCK_CYCLE = (
+    "import threading\n"
+    "class Wal:\n"
+    "    def __init__(self, q):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.q = q\n"
+    "    def log_rec(self, rec):\n"
+    "        with self._lock:\n"
+    "            self.q.push_rec(rec)\n"
+    "class Queue:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.wal = None\n"
+    "    def push_rec(self, rec):\n"
+    "        with self._lock:\n"
+    "            pass\n"
+    "    def drain(self):\n"
+    "        with self._lock:\n"
+    "            self.wal.log_rec(b'x')\n"
+)
+
+
+def test_lock_cycle_detected(tmp_path):
+    """Wal holds its lock while pushing into Queue; Queue holds its
+    lock while appending to Wal — the classic two-lock inversion, found
+    through the call graph, not lexically."""
+    idx = build_fixture(tmp_path, {"emqx_tpu/deadlock.py": LOCK_CYCLE})
+    findings, stats = run_locks(idx)
+    cyc = [f for f in findings if f.code == "lock-cycle"]
+    assert len(cyc) == 1
+    assert cyc[0].severity == ERROR
+    assert "Wal._lock" in cyc[0].message
+    assert "Queue._lock" in cyc[0].message
+    assert stats["locks"] == 2
+    assert stats["edges"] >= 2
+
+
+def test_lock_cycle_clears_when_acyclic(tmp_path):
+    """Same classes with the Queue->Wal call hoisted out of the
+    critical section: edges one way only, no cycle."""
+    src = LOCK_CYCLE.replace(
+        "    def drain(self):\n"
+        "        with self._lock:\n"
+        "            self.wal.log_rec(b'x')\n",
+        "    def drain(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "        self.wal.log_rec(b'x')\n",
+    )
+    idx = build_fixture(tmp_path, {"emqx_tpu/ok.py": src})
+    findings, _ = run_locks(idx)
+    assert [f for f in findings if f.code == "lock-cycle"] == []
+
+
+def test_lock_order_inversion_and_blessing(tmp_path):
+    """An edge running backwards in lockorder.json is an inversion
+    error; `# analysis: lock-after=<held>` blesses exactly that edge."""
+    src = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self, b):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.b = b\n"
+        "    def op(self):\n"
+        "        with self._lock:\n"
+        "            with self.b._lock:\n"
+        "                pass\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "def build():\n"
+        "    return A(B())\n"
+    )
+    idx = build_fixture(tmp_path, {"emqx_tpu/ord.py": src})
+    # blessed order says B before A: the A->B edge is an inversion
+    findings, _ = run_locks(idx, order=["B._lock", "A._lock"])
+    inv = [f for f in findings if f.code == "lock-order"]
+    assert len(inv) == 1
+    assert inv[0].severity == ERROR
+    assert "lock-after" in inv[0].message
+    # order matching the code: clean
+    findings, _ = run_locks(idx, order=["A._lock", "B._lock"])
+    assert [f for f in findings if f.code == "lock-order"] == []
+    # annotation escape on the inner acquisition line
+    src_ann = src.replace(
+        "        with self._lock:\n"
+        "            with self.b._lock:\n",
+        "        with self._lock:\n"
+        "            with self.b._lock:"
+        "  # analysis: lock-after=A._lock\n",
+    )
+    idx = build_fixture(tmp_path, {"emqx_tpu/ord.py": src_ann})
+    findings, _ = run_locks(idx, order=["B._lock", "A._lock"])
+    assert [f for f in findings if f.code == "lock-order"] == []
+
+
+def test_lockorder_dead_entry_warns(tmp_path):
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/one.py": (
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+        ),
+    })
+    findings, _ = run_locks(idx, order=["A._lock", "Gone._lock"])
+    dead = [f for f in findings if f.code == "lockorder-dead"]
+    assert [f.ident for f in dead] == ["Gone._lock"]
+    assert dead[0].severity == WARN
+
+
+def test_await_under_threading_lock_through_hop(tmp_path):
+    """The split begin()/end() guard: the lock is acquired in one
+    function and released in another, so the races pass's lexical check
+    cannot see the await happening in between — the lock pass tracks
+    holds-on-exit through the call graph."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/hop.py": (
+            "import asyncio, threading\n"
+            "class Buf:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def begin(self):\n"
+            "        self._lock.acquire()\n"
+            "    def end(self):\n"
+            "        self._lock.release()\n"
+            "async def drain(buf):\n"
+            "    buf.begin()\n"
+            "    await asyncio.sleep(0)\n"
+            "    buf.end()\n"
+        ),
+    })
+    findings, stats = run_locks(idx)
+    hop = [f for f in findings if f.code == "await-under-lock-hop"]
+    assert len(hop) == 1
+    assert hop[0].severity == ERROR
+    assert "Buf._lock" in hop[0].message
+    assert "drain" in hop[0].message
+    assert stats["holds_on_exit_fns"] == 1
+    # released before the await: clean
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/hop_ok.py": (
+            "import asyncio, threading\n"
+            "class Buf:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def begin(self):\n"
+            "        self._lock.acquire()\n"
+            "    def end(self):\n"
+            "        self._lock.release()\n"
+            "async def drain(buf):\n"
+            "    buf.begin()\n"
+            "    buf.end()\n"
+            "    await asyncio.sleep(0)\n"
+        ),
+    })
+    findings, _ = run_locks(idx)
+    assert [f for f in findings if f.code == "await-under-lock-hop"] == []
+
+
+def test_lock_reentry_nonreentrant(tmp_path):
+    """`with self._lock: self.helper()` where the helper re-takes the
+    same non-reentrant lock on the same instance = self-deadlock; the
+    RLock variant is legal re-entry."""
+    src = (
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.inner()\n"
+        "    def inner(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    idx = build_fixture(tmp_path, {"emqx_tpu/reent.py": src})
+    findings, _ = run_locks(idx)
+    re_f = [f for f in findings if f.code == "lock-reentry"]
+    assert len(re_f) == 1
+    assert re_f[0].severity == ERROR
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/reent_ok.py": src.replace("threading.Lock()",
+                                            "threading.RLock()"),
+    })
+    findings, _ = run_locks(idx)
+    assert [f for f in findings if f.code == "lock-reentry"] == []
+
+
+# -------------------------------------------------------- task lifecycle
+
+
+def test_unretained_task_flagged(tmp_path):
+    """PR 9-era shape: a bare create_task whose result nobody holds —
+    the GC may collect the task mid-flight and its exception is never
+    observed."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/fire.py": (
+            "import asyncio\n"
+            "class Node:\n"
+            "    async def on_peer_up(self, peer):\n"
+            "        asyncio.get_running_loop().create_task("
+            "self.resync(peer))\n"
+            "    async def resync(self, peer):\n"
+            "        pass\n"
+        ),
+    })
+    findings, stats = lifecycle.check_lifecycle(idx)
+    un = [f for f in findings if f.code == "task-unretained"]
+    assert len(un) == 1
+    assert un[0].severity == ERROR
+    assert "resync" in un[0].message
+    assert stats["spawn_sites"] == 1
+
+
+def test_retained_task_with_cancel_is_clean(tmp_path):
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/kept.py": (
+            "import asyncio\n"
+            "class Node:\n"
+            "    def __init__(self):\n"
+            "        self._task = None\n"
+            "    async def start(self):\n"
+            "        self._task = asyncio.create_task(self.run())\n"
+            "    async def run(self):\n"
+            "        pass\n"
+            "    async def stop(self):\n"
+            "        if self._task:\n"
+            "            self._task.cancel()\n"
+        ),
+    })
+    findings, _ = lifecycle.check_lifecycle(idx)
+    assert [f for f in findings
+            if f.code in ("task-unretained", "task-leak")] == []
+
+
+def test_retained_task_without_cancel_is_leak(tmp_path):
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/leak.py": (
+            "import asyncio\n"
+            "class Node:\n"
+            "    async def start(self):\n"
+            "        self._task = asyncio.create_task(self.run())\n"
+            "    async def run(self):\n"
+            "        pass\n"
+            "    async def stop(self):\n"
+            "        pass\n"
+        ),
+    })
+    findings, _ = lifecycle.check_lifecycle(idx)
+    leaks = [f for f in findings if f.code == "task-leak"]
+    assert len(leaks) == 1
+    assert leaks[0].severity == ERROR
+    assert "Node._task" in leaks[0].message
+
+
+def test_task_cancel_via_iteration_traced(tmp_path):
+    """The registry shape: tasks collected into a dict and cancelled by
+    iterating .values() through a local — the evidence tracer follows
+    the derivation."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/reg.py": (
+            "import asyncio\n"
+            "class Pool:\n"
+            "    def __init__(self):\n"
+            "        self._tasks = {}\n"
+            "    async def start(self, k):\n"
+            "        self._tasks[k] = asyncio.create_task(self.run(k))\n"
+            "    async def run(self, k):\n"
+            "        pass\n"
+            "    async def stop(self):\n"
+            "        for t in list(self._tasks.values()):\n"
+            "            t.cancel()\n"
+        ),
+    })
+    findings, _ = lifecycle.check_lifecycle(idx)
+    assert [f for f in findings if f.code == "task-leak"] == []
+
+
+def test_resource_leak_attr_and_local(tmp_path):
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/res.py": (
+            "class Store:\n"
+            "    def __init__(self, path):\n"
+            "        self._f = open(path, 'ab')\n"
+            "class Reader:\n"
+            "    def scan(self, path):\n"
+            "        f = open(path)\n"
+            "        return f.readline()\n"
+            "    def scan_ok(self, path):\n"
+            "        with open(path) as f:\n"
+            "            return f.readline()\n"
+        ),
+    })
+    findings, _ = lifecycle.check_lifecycle(idx)
+    leaks = {f.ident for f in findings if f.code == "resource-leak"}
+    assert leaks == {"Store._f", "Reader.scan:f"}
+
+
+def test_hook_unpaired_and_lifetime_annotation(tmp_path):
+    src = (
+        "class Module:\n"
+        "    def install(self, hooks):\n"
+        "        self._hooks = hooks\n"
+        "        hooks.put('message.publish', self.on_publish)\n"
+        "    def on_publish(self, msg):\n"
+        "        pass\n"
+        "    def close(self):\n"
+        "        pass\n"
+    )
+    idx = build_fixture(tmp_path, {"emqx_tpu/mod.py": src})
+    findings, _ = lifecycle.check_lifecycle(idx)
+    un = [f for f in findings if f.code == "hook-unpaired"]
+    assert len(un) == 1
+    assert un[0].severity == ERROR
+    # pairing the delete clears it
+    paired = src.replace(
+        "    def close(self):\n        pass\n",
+        "    def close(self):\n"
+        "        self._hooks.delete('message.publish', self.on_publish)\n",
+    )
+    idx = build_fixture(tmp_path, {"emqx_tpu/mod.py": paired})
+    findings, _ = lifecycle.check_lifecycle(idx)
+    assert [f for f in findings if f.code == "hook-unpaired"] == []
+    # ...as does a justified node-lifetime annotation
+    ann = src.replace(
+        "hooks.put('message.publish', self.on_publish)",
+        "hooks.put('message.publish', self.on_publish)"
+        "  # analysis: lifetime=node(installed once at boot)",
+    )
+    idx = build_fixture(tmp_path, {"emqx_tpu/mod.py": ann})
+    findings, _ = lifecycle.check_lifecycle(idx)
+    assert [f for f in findings if f.code == "hook-unpaired"] == []
+
+
+# ------------------------------------------------------- cancellation
+
+
+def run_cancel(idx):
+    role_map = roles.infer_roles(idx)
+    return cancel.check_cancellation(idx, role_map)
+
+
+def test_swallowed_cancellederror_flagged(tmp_path):
+    """The pre-fix _pump_loop shape: `except (CancelledError,
+    Exception): pass` around the drain loop makes task.cancel() a
+    no-op."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/pump.py": (
+            "import asyncio\n"
+            "class Pump:\n"
+            "    async def _pump_loop(self):\n"
+            "        try:\n"
+            "            while True:\n"
+            "                await self.recv()\n"
+            "        except (asyncio.CancelledError, Exception):\n"
+            "            pass\n"
+            "    async def recv(self):\n"
+            "        pass\n"
+        ),
+    })
+    findings, _ = run_cancel(idx)
+    sw = [f for f in findings if f.code == "cancel-swallow"]
+    assert len(sw) == 1
+    assert sw[0].severity == ERROR
+    assert "_pump_loop" in sw[0].message
+
+
+def test_cancel_reraise_is_clean(tmp_path):
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/pump_ok.py": (
+            "import asyncio\n"
+            "class Pump:\n"
+            "    async def _pump_loop(self):\n"
+            "        try:\n"
+            "            while True:\n"
+            "                await self.recv()\n"
+            "        except asyncio.CancelledError:\n"
+            "            raise\n"
+            "        except Exception:\n"
+            "            pass\n"
+            "    async def recv(self):\n"
+            "        pass\n"
+        ),
+    })
+    findings, _ = run_cancel(idx)
+    assert [f for f in findings if f.code == "cancel-swallow"] == []
+
+
+def test_cancel_then_join_reap_idiom_is_clean(tmp_path):
+    """`t.cancel(); try: await t except (CancelledError, Exception):
+    pass` — the shutdown reap; the swallow is the whole point."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/reap.py": (
+            "import asyncio\n"
+            "class Node:\n"
+            "    def __init__(self):\n"
+            "        self._tasks = []\n"
+            "    async def stop(self):\n"
+            "        for t in self._tasks:\n"
+            "            t.cancel()\n"
+            "        for t in self._tasks:\n"
+            "            try:\n"
+            "                await t\n"
+            "            except (asyncio.CancelledError, Exception):\n"
+            "                pass\n"
+        ),
+    })
+    findings, _ = run_cancel(idx)
+    assert [f for f in findings if f.code == "cancel-swallow"] == []
+
+
+def test_bare_except_in_async_flagged(tmp_path):
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/bare.py": (
+            "import asyncio\n"
+            "async def worker(q):\n"
+            "    try:\n"
+            "        await q.get()\n"
+            "    except BaseException:\n"
+            "        pass\n"
+        ),
+    })
+    findings, _ = run_cancel(idx)
+    sw = [f for f in findings if f.code == "cancel-swallow"]
+    assert len(sw) == 1
+    assert "BaseException" in sw[0].message
+
+
+def test_cancel_leak_mutation_pair_around_await(tmp_path):
+    """Worker-drain shape: inflight += 1 / await / inflight -= 1 with
+    no try/finally — a cancellation at the await strands the counter."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/drain.py": (
+            "class Pool:\n"
+            "    def __init__(self):\n"
+            "        self.inflight = 0\n"
+            "    async def _worker(self, item):\n"
+            "        self.inflight += 1\n"
+            "        await self.handle(item)\n"
+            "        self.inflight -= 1\n"
+            "    async def handle(self, item):\n"
+            "        pass\n"
+        ),
+    })
+    findings, _ = run_cancel(idx)
+    leaks = [f for f in findings if f.code == "cancel-leak"]
+    assert len(leaks) == 1
+    assert leaks[0].severity == ERROR
+    assert "self.inflight" in leaks[0].message
+
+
+def test_cancel_leak_try_finally_is_clean(tmp_path):
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/drain_ok.py": (
+            "class Pool:\n"
+            "    def __init__(self):\n"
+            "        self.inflight = 0\n"
+            "    async def _worker(self, item):\n"
+            "        self.inflight += 1\n"
+            "        try:\n"
+            "            await self.handle(item)\n"
+            "        finally:\n"
+            "            self.inflight -= 1\n"
+            "    async def handle(self, item):\n"
+            "        pass\n"
+        ),
+    })
+    findings, _ = run_cancel(idx)
+    assert [f for f in findings if f.code == "cancel-leak"] == []
+
+
 # ---------------------------------------------------- registry cross-check
 
 
@@ -495,10 +977,13 @@ def test_cli_json_schema_stable(tmp_path, monkeypatch, capsys):
                         ["--json", "--no-native"])
     assert code == 1
     doc = json.loads(out)
-    # schema contract: bump JSON_SCHEMA_VERSION on any key change
-    assert doc["schema_version"] == 1
+    # schema contract: bump JSON_SCHEMA_VERSION on any key change.
+    # v2 = the lock-order/lifecycle/cancellation passes' finding kinds
+    # plus the per-pass `stats` section
+    assert doc["schema_version"] == 2
     assert set(doc) == {"schema_version", "summary", "timings_ms",
-                        "findings"}
+                        "findings", "stats"}
+    assert {"index", "locks", "lifecycle", "cancel"} <= set(doc["stats"])
     assert set(doc["summary"]) == {"files", "errors", "warnings",
                                    "baselined", "exit_code"}
     assert doc["summary"]["errors"] == 1
@@ -540,13 +1025,40 @@ def test_cli_changed_mode_runs(tmp_path, monkeypatch, capsys):
     assert code == 0
 
 
+def test_cli_only_single_pass(tmp_path, monkeypatch, capsys):
+    """--only runs just the requested pass: an error another pass
+    would raise (undeclared config read -> registry) is invisible to
+    `--only locks`, and the timing table shows the skipped passes
+    never ran."""
+    files = dict(CLEAN_FILES)
+    files["emqx_tpu/app.py"] = files["emqx_tpu/app.py"].replace(
+        "conf.get('mqtt.k')",
+        "conf.get('mqtt.k')\n    conf.get('mqtt.rogue')",
+    )
+    build_fixture(tmp_path, files)
+    code, out = run_cli(tmp_path, monkeypatch, capsys,
+                        ["--json", "--only", "locks"])
+    assert code == 0
+    doc = json.loads(out)
+    assert doc["findings"] == []
+    assert "registry" not in doc["timings_ms"]
+    assert "locks" in doc["timings_ms"]
+    code, out = run_cli(tmp_path, monkeypatch, capsys,
+                        ["--json", "--only", "registry"])
+    assert code == 1
+    doc = json.loads(out)
+    assert {f["code"] for f in doc["findings"]} >= {"cfg-undeclared"}
+
+
 # ------------------------------------------------------------ repo gate
 
 
 @pytest.mark.slow
 def test_repo_tree_is_clean():
     """The acceptance gate: the real tree has an empty error tier and
-    no fresh warnings (everything is fixed, annotated, or baselined)."""
+    no fresh warnings under ALL passes — roles/races/registry (PR 8)
+    and locks/lifecycle/cancellation (this PR): everything is fixed,
+    annotated, or baselined."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     idx = ProjectIndex.build(repo, cli.TARGETS)
     rep = Report()
@@ -554,7 +1066,42 @@ def test_repo_tree_is_clean():
     rep.extend(roles.check_blocking(idx, role_map))
     rep.extend(races.check_races(idx, role_map))
     rep.extend(registry.check_registries(idx))
+    lk, _ = locks.check_locks(idx, role_map)
+    rep.extend(lk)
+    lf, _ = lifecycle.check_lifecycle(idx)
+    rep.extend(lf)
+    cn, _ = cancel.check_cancellation(idx, role_map)
+    rep.extend(cn)
     baseline_mod.apply_baseline(
         rep, baseline_mod.load_baseline(baseline_mod.baseline_path(repo)))
     errors = [f.render() for f in rep.errors()]
     assert errors == [], "\n".join(errors)
+
+
+@pytest.mark.slow
+def test_repo_lockorder_covers_observed_edges():
+    """Every observed lock-order edge between listed locks runs
+    FORWARD in lockorder.json, and the file has no stale entries —
+    the committed global order stays truthful."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    idx = ProjectIndex.build(repo, ["emqx_tpu"])
+    role_map = roles.infer_roles(idx)
+    la = locks.LockAnalysis(idx, role_map)
+    la.collect_locks()
+    la.scan_all()
+    la.summarize()
+    la.build_edges()
+    order = locks.load_lockorder(locks.lockorder_path(repo))
+    assert order, "lockorder.json must list the blessed global order"
+    pos = {n: i for i, n in enumerate(order)}
+    for name in order:
+        assert name in la.locks, f"stale lockorder entry {name}"
+    for e in la.edges:
+        if e.blessed or e.held == e.acquired:
+            continue
+        ih, ia = pos.get(e.held), pos.get(e.acquired)
+        if ih is not None and ia is not None:
+            assert ih < ia, (
+                f"inversion {e.held} -> {e.acquired} at "
+                f"{e.path}:{e.line}"
+            )

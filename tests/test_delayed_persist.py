@@ -225,3 +225,24 @@ def test_rest_surface(tmp_path):
             await node.stop()
 
     asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_close_unhooks_publish_interception():
+    """A closed scheduler must stop intercepting $delayed publishes:
+    its store is gone, so a still-installed hook would silently eat
+    every scheduled message forever (found by the lifecycle pass's
+    hook-pairing check)."""
+    b = Broker()
+    dp = DelayedPublish(b)
+    dp.install(b.hooks)
+    _sched(dp, b, "a/1", b"p1", delay=60)
+    assert dp.pending == 1
+    dp.close()
+    assert b.hooks.callbacks("message.publish") == []
+    # after close, $delayed publishes flow through to the matcher
+    got = []
+    b.hooks.put("message.publish", lambda m: got.append(m.topic)
+                if isinstance(m, Message) else None)
+    b.publish(Message(topic="$delayed/60/a/2", payload=b"x", qos=1))
+    assert got == ["$delayed/60/a/2"]
+    assert dp.pending == 1  # nothing new withheld

@@ -141,6 +141,29 @@ def test_trace_by_clientid_and_topic(tmp_path):
     assert any(r["event"] == "DELIVER" and r["clientid"] == "bob" for r in t2)
 
 
+def test_trace_hooks_released_when_last_trace_stops(tmp_path):
+    """The tracer unhooks itself when the last trace stops — and
+    Hooks.delete must match BOUND METHODS by equality (`self.m` builds
+    a fresh object per access; an identity check silently deletes
+    nothing, which is exactly how this leak survived until the
+    lifecycle pass)."""
+    b = Broker()
+    before = {p: len(b.hooks.callbacks(p))
+              for p in ("message.publish", "client.connected")}
+    tm = TraceManager(b.hooks, directory=str(tmp_path))
+    tm.start_trace("t1", "topic", "a/#")
+    assert len(b.hooks.callbacks("message.publish")) == \
+        before["message.publish"] + 1
+    tm.stop_all()
+    for p, n in before.items():
+        assert len(b.hooks.callbacks(p)) == n, p
+    # restartable: a new trace re-installs
+    tm.start_trace("t2", "topic", "b/#")
+    assert len(b.hooks.callbacks("message.publish")) == \
+        before["message.publish"] + 1
+    tm.stop_trace("t2")
+
+
 def test_trace_limits(tmp_path):
     b = Broker()
     tm = TraceManager(b.hooks, directory=str(tmp_path))

@@ -44,16 +44,33 @@ Pass -> reference analog:
   #1-#4 and #8 (syntax, undefined names, unused imports/dup
   defs/mutable defaults/bare except, `g++ -fsyntax-only`, churn-WAL
   hook coverage), ported onto the shared index.
+* **lock-order analysis** (`locks.py`) — deadlock freedom: per-lock
+  identities, held-set tracking through `with`/`acquire` and the call
+  graph, cycle detection, the blessed global order in
+  `lockorder.json`, non-reentrant self-deadlocks, and awaits under
+  split-guard (non-lexical) threading locks.
+* **task/resource lifecycle** (`lifecycle.py`) — every
+  `create_task`/`ensure_future` retained + cancel-reachable from
+  teardown, file/socket/executor handles closed, hook and single-slot
+  callback registrations paired with their unregister.
+* **cancellation safety** (`cancel.py`) — swallowed `CancelledError`
+  (outside the cancel-then-join reap idiom) and `finally`-less paired
+  mutations around an `await`.
 
 Severity tiers: `error` fails always; `warn` fails unless
 grandfathered in the committed `baseline.json` (`baseline.py`).
 `python -m tools.analysis --json` emits machine-readable findings;
-`--changed` limits per-file passes to `git diff` files.  Stdlib-only.
+`--changed` limits per-file passes to `git diff` files; `--only
+<pass>` runs one pass; `--stats` prints per-pass node/edge counts.
+Stdlib-only.
 
 Annotations (all in source comments, linted for well-formedness):
 
 * ``# analysis: owner=<role>``       — deliberate single-owner attr
 * ``# analysis: allow-blocking(<why>)`` — deliberate blocking call
+* ``# analysis: lock-after=<name>``  — reviewed lock-order exception
+* ``# analysis: detached-task(<why>)`` — deliberate fire-and-forget
+* ``# analysis: lifetime=node(<why>)`` — process-lifetime callback
 * ``# check: ignore``                — suppress any finding on a line
 """
 

@@ -1,0 +1,358 @@
+"""Pass (g): cancellation safety.
+
+`asyncio.CancelledError` derives from `BaseException` precisely so
+`except Exception` cannot eat it — but `except BaseException`, a bare
+`except`, and an explicit `except (CancelledError, ...)` all can.  A
+loop-role coroutine that swallows cancellation without re-raising turns
+`task.cancel()` into a no-op: shutdown hangs waiting on a task that
+"handled" its own death, or worse, the task keeps running against
+half-torn-down state.  The complementary hazard: paired state mutation
+around an `await` with no `finally` — a cancellation landing at the
+await point leaks the first half of the pair (a counter never
+decremented, a slot never released) because cancellation *is* an
+exception raised at the await.
+
+Checks (both on `async def` bodies — CancelledError is only ever
+raised at an await point, so loop-role coroutines are exactly the
+exposed surface):
+
+* ``cancel-swallow`` (error): an except handler that catches
+  CancelledError (bare, ``BaseException``, or an explicit tuple
+  member) and neither re-raises nor returns the exception outward.
+  The one blessed shape is the *reap* idiom — ``t.cancel()`` followed
+  by ``try: await t except (CancelledError, Exception): pass`` — where
+  the cancellation was initiated by this very function on the task it
+  is awaiting; the pass traces ``.cancel()`` calls in the function and
+  recognizes the join.  `contextlib.suppress(CancelledError)` around
+  such a join is equally blessed; anywhere else it is the same bug.
+* ``cancel-leak`` (error): in one statement block, a retained mutation
+  (``self.x += 1``, ``.add``/``.append``/``.acquire``) followed by an
+  ``await`` and then the inverse mutation (``-=``, ``.discard``/
+  ``.remove``/``.pop``/``.release``) with the await outside any
+  ``try/finally`` that performs the inverse — the worker-drain shape
+  where a cancellation between the pair strands the state forever.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .index import FuncInfo, ProjectIndex, _attr_chain, _walk_own_body
+from .report import ERROR, Finding
+
+# inverse-mutation verb pairs for the cancel-leak check
+_PAIR_VERBS = {
+    "add": {"discard", "remove", "pop", "clear"},
+    "append": {"remove", "pop", "clear"},
+    "acquire": {"release"},
+    "put_nowait": {"get_nowait", "task_done"},
+}
+
+
+def check_cancellation(
+    idx: ProjectIndex,
+    roles: Dict[str, Set[str]],
+    package_prefix: str = "emqx_tpu",
+) -> Tuple[List[Finding], Dict[str, int]]:
+    findings: List[Finding] = []
+    n_handlers = 0
+    n_pairs = 0
+    for key, info in idx.funcs.items():
+        if not info.module.startswith(package_prefix):
+            continue
+        if not info.is_async:
+            continue
+        fi = idx.files[info.path]
+        cancelled = _cancelled_chains(info)
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Try):
+                for h in node.handlers:
+                    if not _catches_cancelled(h):
+                        continue
+                    n_handlers += 1
+                    f = _judge_handler(info, fi, node, h, cancelled)
+                    if f is not None:
+                        findings.append(f)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                f = _judge_suppress(info, fi, node, cancelled)
+                if f is not None:
+                    findings.append(f)
+        got, pairs = _check_pairs(info, fi)
+        findings.extend(got)
+        n_pairs += pairs
+    return findings, {
+        "cancelled_handlers": n_handlers,
+        "mutation_pairs": n_pairs,
+    }
+
+
+# ------------------------------------------------------------ swallowing
+
+
+def _catches_cancelled(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True  # bare except
+    return any(_is_cancelled_type(t) or _is_base_exception(t)
+               for t in _handler_types(h))
+
+
+def _handler_types(h: ast.ExceptHandler):
+    if isinstance(h.type, ast.Tuple):
+        return list(h.type.elts)
+    return [h.type] if h.type is not None else []
+
+
+def _is_cancelled_type(t) -> bool:
+    chain = _attr_chain(t)
+    return bool(chain) and chain[-1] == "CancelledError"
+
+
+def _is_base_exception(t) -> bool:
+    chain = _attr_chain(t)
+    return bool(chain) and chain[-1] == "BaseException"
+
+
+def _reraises(h: ast.ExceptHandler) -> bool:
+    for node in _walk_own_body(h):
+        if isinstance(node, ast.Raise):
+            return True
+    return False
+
+
+def _cancelled_chains(info: FuncInfo) -> Set[str]:
+    """Attr-chain texts `.cancel()` is called on anywhere in this
+    function — the tasks whose cancellation THIS function initiated."""
+    out: Set[str] = set()
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] == "cancel" and len(chain) > 1:
+                out.add(".".join(chain[:-1]))
+    return out
+
+
+def _awaited_chains(body) -> Optional[List[str]]:
+    """If every statement in `body` is (just) an await of a simple
+    chain, return those chains; else None."""
+    out: List[str] = []
+    for stmt in body:
+        value = None
+        if isinstance(stmt, ast.Expr):
+            value = stmt.value
+        elif isinstance(stmt, ast.Assign):
+            value = stmt.value
+        if not isinstance(value, ast.Await):
+            return None
+        chain = _attr_chain(value.value)
+        if chain is None:
+            # await asyncio.wait_for(t, ...) / gather(*ts): treat the
+            # first simple-arg chain as the join target
+            if isinstance(value.value, ast.Call):
+                inner = [
+                    ".".join(c) for c in (
+                        _attr_chain(a) for a in value.value.args
+                    ) if c
+                ]
+                if inner:
+                    out.extend(inner)
+                    continue
+            return None
+        out.append(".".join(chain))
+    return out if out else None
+
+
+def _is_reap(try_node: ast.Try, cancelled: Set[str]) -> bool:
+    chains = _awaited_chains(try_node.body)
+    if not chains:
+        return False
+    return all(c in cancelled for c in chains)
+
+
+def _judge_handler(info: FuncInfo, fi, try_node: ast.Try,
+                   h: ast.ExceptHandler,
+                   cancelled: Set[str]) -> Optional[Finding]:
+    if h.lineno in fi.ignored_lines:
+        return None
+    if _reraises(h):
+        return None
+    if _is_reap(try_node, cancelled):
+        return None
+    what = "bare except" if h.type is None else (
+        "except BaseException"
+        if any(_is_base_exception(t) for t in _handler_types(h))
+        else "except CancelledError"
+    )
+    return Finding(
+        code="cancel-swallow", severity=ERROR, path=info.path,
+        line=h.lineno,
+        message=(
+            f"{what} in {info.qualname} swallows CancelledError "
+            "without re-raising: task.cancel() on this coroutine "
+            "becomes a no-op and shutdown can hang on it — re-raise "
+            "cancellation (`except asyncio.CancelledError: raise`) or "
+            "narrow the handler to `except Exception`"
+        ),
+        ident=f"{info.qualname}:{what}",
+    )
+
+
+def _judge_suppress(info: FuncInfo, fi, node,
+                    cancelled: Set[str]) -> Optional[Finding]:
+    for item in node.items:
+        ctx = item.context_expr
+        if not isinstance(ctx, ast.Call):
+            continue
+        chain = _attr_chain(ctx.func)
+        if not chain or chain[-1] != "suppress":
+            continue
+        if not any(_is_cancelled_type(a) or _is_base_exception(a)
+                   for a in ctx.args):
+            continue
+        if node.lineno in fi.ignored_lines:
+            return None
+        chains = _awaited_chains(node.body)
+        if chains and all(c in cancelled for c in chains):
+            return None  # reap via contextlib.suppress
+        return Finding(
+            code="cancel-swallow", severity=ERROR, path=info.path,
+            line=node.lineno,
+            message=(
+                f"contextlib.suppress(CancelledError) in "
+                f"{info.qualname} outside the cancel-then-join idiom "
+                "swallows cancellation — suppress Exception instead, "
+                "or cancel the awaited task in this function first"
+            ),
+            ident=f"{info.qualname}:suppress",
+        )
+    return None
+
+
+# -------------------------------------------------------- mutation pairs
+
+
+def _mutations(stmt) -> List[Tuple[str, str]]:
+    """(chain, verb) mutations a statement performs at its top level:
+    `self.n += 1` -> (self.n, +=) ; `self.s.add(x)` -> (self.s, add)."""
+    out: List[Tuple[str, str]] = []
+    if isinstance(stmt, ast.AugAssign):
+        chain = _attr_chain(stmt.target)
+        if chain:
+            op = "+=" if isinstance(stmt.op, ast.Add) else (
+                "-=" if isinstance(stmt.op, ast.Sub) else "")
+            if op:
+                out.append((".".join(chain), op))
+    value = stmt.value if isinstance(stmt, ast.Expr) else None
+    if isinstance(value, ast.Call):
+        chain = _attr_chain(value.func)
+        if chain and len(chain) > 1:
+            out.append((".".join(chain[:-1]), chain[-1]))
+    return out
+
+
+def _has_await(stmt) -> bool:
+    if isinstance(stmt, ast.Await):
+        return True
+    for node in _walk_own_body(stmt):
+        if isinstance(node, ast.Await):
+            return True
+    return False
+
+
+def _finally_inverse(stmt, chain: str, inverses: Set[str]) -> bool:
+    """stmt is a Try whose finalbody performs an inverse mutation on
+    `chain` — the protected shape."""
+    if not isinstance(stmt, ast.Try):
+        return False
+    for fstmt in stmt.finalbody:
+        for c, verb in _mutations(fstmt):
+            if c == chain and verb in inverses:
+                return True
+    return False
+
+
+def _inverses_of(verb: str) -> Set[str]:
+    if verb == "+=":
+        return {"-="}
+    return _PAIR_VERBS.get(verb, set())
+
+
+def _check_pairs(info: FuncInfo, fi) -> Tuple[List[Finding], int]:
+    findings: List[Finding] = []
+    pairs = 0
+
+    def scan_block(body: List) -> None:
+        nonlocal pairs
+        # open mutations awaiting their inverse: chain -> (verb, line)
+        open_muts: Dict[str, Tuple[str, int]] = {}
+        awaited_since: Dict[str, int] = {}  # chain -> await line
+        for stmt in body:
+            if isinstance(stmt, ast.Try):
+                # a try with a finally that closes an open pair
+                # protects it; account for that, then recurse
+                for chain in list(open_muts):
+                    verb, line = open_muts[chain]
+                    if _finally_inverse(stmt, chain,
+                                        _inverses_of(verb)):
+                        del open_muts[chain]
+                        awaited_since.pop(chain, None)
+            muts = _mutations(stmt)
+            for chain, verb in muts:
+                inv = _inverses_of(verb)
+                closed = False
+                for oc, (overb, oline) in list(open_muts.items()):
+                    if oc == chain and verb in _inverses_of(overb):
+                        aw = awaited_since.get(chain)
+                        if aw is not None \
+                                and oline not in fi.ignored_lines:
+                            pairs += 1
+                            findings.append(Finding(
+                                code="cancel-leak", severity=ERROR,
+                                path=info.path, line=aw,
+                                message=(
+                                    f"{info.qualname} mutates "
+                                    f"{chain} ({overb} at line "
+                                    f"{oline}) before an await and "
+                                    f"reverts it ({verb}) after, with "
+                                    "no try/finally — a cancellation "
+                                    "landing at the await leaks the "
+                                    "mutation forever; wrap the await "
+                                    "in try/finally with the inverse "
+                                    "in the finally"
+                                ),
+                                ident=f"{info.qualname}:{chain}",
+                            ))
+                        del open_muts[oc]
+                        awaited_since.pop(chain, None)
+                        closed = True
+                        break
+                if not closed and _inverses_of(verb):
+                    open_muts[chain] = (verb, stmt.lineno)
+                    awaited_since.pop(chain, None)
+            if _has_await(stmt) and not isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a try/finally-wrapped await is protected for every
+                # chain its finally reverts (handled above); for open
+                # chains it is the hazard point
+                for chain in open_muts:
+                    awaited_since.setdefault(chain, stmt.lineno)
+            # recurse into nested blocks with a fresh window (pairs
+            # split across sibling blocks are a different shape)
+            for child_body in _child_blocks(stmt):
+                scan_block(child_body)
+
+    scan_block(info.node.body)
+    return findings, pairs
+
+
+def _child_blocks(stmt) -> List[List]:
+    out: List[List] = []
+    for field_name in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, field_name, None)
+        if isinstance(b, list) and b and not isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+            out.append(b)
+    for h in getattr(stmt, "handlers", []) or []:
+        out.append(h.body)
+    return out

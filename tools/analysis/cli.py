@@ -1,6 +1,9 @@
 """CLI driver: build the index once, run every pass with per-pass
 timing, apply the baseline, render text or `--json`.
 
+`--only <pass>` runs a single pass (iteration on one pass shouldn't pay
+the full multi-second run); `--stats` prints per-pass node/edge counts.
+
 Exit code 0 = no errors and no non-baselined warnings (the same
 contract the old `tools/check.py` had, now tiered)."""
 
@@ -13,7 +16,7 @@ import sys
 from typing import List, Optional, Set
 
 from . import baseline as baseline_mod
-from . import lints, races, registry, roles
+from . import cancel, lifecycle, lints, locks, races, registry, roles
 from .index import ProjectIndex
 from .report import Report
 
@@ -22,6 +25,9 @@ REPO = os.path.dirname(
 )
 TARGETS = ["emqx_tpu", "tests", "tools", "bench.py",
            "__graft_entry__.py"]
+
+PASSES = ("lints", "registry", "roles", "races", "locks", "lifecycle",
+          "cancel", "native")
 
 
 def changed_files(repo: str) -> Optional[Set[str]]:
@@ -56,6 +62,10 @@ def run(argv: Optional[List[str]] = None) -> int:
                     help="machine-readable findings on stdout")
     ap.add_argument("--changed", action="store_true",
                     help="limit per-file passes to `git diff` files")
+    ap.add_argument("--only", choices=PASSES, default=None,
+                    help="run a single pass (plus the shared index)")
+    ap.add_argument("--stats", action="store_true",
+                    help="per-pass node/edge counts on stderr")
     ap.add_argument("--write-baseline", action="store_true",
                     help="regenerate baseline.json from this run's "
                          "warnings")
@@ -69,6 +79,12 @@ def run(argv: Optional[List[str]] = None) -> int:
     with report.timed("index"):
         idx = ProjectIndex.build(REPO, TARGETS)
     report.n_files = len(idx.files)
+    report.stats["index"] = {
+        "files": len(idx.files),
+        "functions": len(idx.funcs),
+        "classes": sum(len(v) for v in idx.classes.values()),
+        "call_edges": len(idx.edges),
+    }
 
     only: Optional[Set[str]] = None
     if args.changed:
@@ -76,19 +92,48 @@ def run(argv: Optional[List[str]] = None) -> int:
         if only is None:
             only = set()  # git unavailable: skip per-file passes
 
-    with report.timed("lints"):
-        report.extend(lints.check_syntax(idx))
-        report.extend(lints.check_undefined(idx, only=only))
-        report.extend(lints.check_ast_lints(idx, only=only))
-        report.extend(lints.check_churn_hooks(idx))
-    with report.timed("registry"):
-        report.extend(registry.check_registries(idx))
-    with report.timed("roles"):
-        role_map = roles.infer_roles(idx)
-        report.extend(roles.check_blocking(idx, role_map))
-    with report.timed("races"):
-        report.extend(races.check_races(idx, role_map))
-    if not args.no_native:
+    def want(name: str) -> bool:
+        return args.only is None or args.only == name
+
+    role_map = None
+    if any(want(p) for p in ("roles", "races", "locks", "cancel")):
+        with report.timed("roles"):
+            role_map = roles.infer_roles(idx)
+            report.stats["roles"] = {
+                "roled_functions": len(role_map),
+            }
+
+    if want("lints"):
+        with report.timed("lints"):
+            report.extend(lints.check_syntax(idx))
+            report.extend(lints.check_undefined(idx, only=only))
+            report.extend(lints.check_ast_lints(idx, only=only))
+            report.extend(lints.check_churn_hooks(idx))
+    if want("registry"):
+        with report.timed("registry"):
+            report.extend(registry.check_registries(idx))
+    if want("roles"):
+        with report.timed("roles"):
+            report.extend(roles.check_blocking(idx, role_map))
+    if want("races"):
+        with report.timed("races"):
+            report.extend(races.check_races(idx, role_map))
+    if want("locks"):
+        with report.timed("locks"):
+            got, stats = locks.check_locks(idx, role_map)
+            report.extend(got)
+            report.stats["locks"] = stats
+    if want("lifecycle"):
+        with report.timed("lifecycle"):
+            got, stats = lifecycle.check_lifecycle(idx)
+            report.extend(got)
+            report.stats["lifecycle"] = stats
+    if want("cancel"):
+        with report.timed("cancel"):
+            got, stats = cancel.check_cancellation(idx, role_map)
+            report.extend(got)
+            report.stats["cancel"] = stats
+    if want("native") and not args.no_native:
         with report.timed("native"):
             report.extend(lints.check_native(REPO, only=only))
 
@@ -107,6 +152,8 @@ def run(argv: Optional[List[str]] = None) -> int:
         text = report.render_text()
         if text:
             print(text)
+    if args.stats:
+        print(report.render_stats(), file=sys.stderr)
     print(report.render_summary(), file=sys.stderr)
     return report.exit_code()
 

@@ -1,0 +1,654 @@
+"""Pass (f): task & resource lifecycle.
+
+`asyncio.create_task` keeps only a *weak* reference to the task it
+returns: a task nobody retains can be garbage-collected mid-flight, and
+a task nobody awaits silently eats every exception it raises.  The
+34 create_task/executor sites in this tree are the broker's background
+organs — heartbeats, sweepers, delivery shards, resync pumps — and a
+dropped or leaked one is a silent outage.  This pass enforces the
+lifecycle contract end to end:
+
+* **retention** (``task-unretained``, error): the result of every
+  ``create_task``/``ensure_future`` must go somewhere — a name, a
+  ``self.<attr>``, a container (``.append``/``.add``/dict slot), a
+  registry call argument (the `DeliveryPool` shape), an ``await`` or a
+  ``return``.  A bare expression statement is fire-and-forget: the GC
+  may drop it and its exception is never observed.  Deliberate
+  detachment needs ``# analysis: detached-task(<why>)``.
+* **cancellation reach** (``task-leak``, error): a task retained in
+  ``self.<attr>`` (scalar, list/set, or dict slot) must have a cancel/
+  join path *somewhere in its class* — ``self.<attr>.cancel()``, a
+  ``.cancel()``/``await`` on a local or loop-target traced from the
+  attribute, or ``gather(*self.<attr>)``.  A task that is stored but
+  never cancelled outlives (and silently outlasts) every shutdown.
+* **teardown reach** (``task-cancel-unreachable``, warn): the cancel
+  site must be reachable (over the call graph) from a teardown-shaped
+  entry point (``close``/``stop``/``shutdown``/``__aexit__``/...);
+  a cancel only a request handler can reach still leaks on shutdown.
+* **resources** (``resource-leak``, error): ``self.<attr>`` bound from
+  ``open()``/``socket.socket()``/``ThreadPoolExecutor()`` must reach a
+  ``close``/``shutdown`` in its class; a *local* so bound must be
+  closed in-function, returned, stored, or passed on — `with` blocks
+  satisfy this by construction.
+* **callback pairing** (``hook-unpaired`` / ``slot-unpaired``, error):
+  a class with a teardown method that registers a hook callback
+  (``hooks.put(point, self.cb)``) must also ``hooks.delete`` that
+  point; one that assigns a single-slot callback on a foreign object
+  (``other.on_change = self._cb``) must clear it (``= None``).
+  Registrations that genuinely live for the whole process carry
+  ``# analysis: lifetime=node(<why>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .index import CALL, FuncInfo, ProjectIndex, _attr_chain, \
+    _walk_own_body
+from .report import ERROR, WARN, Finding
+
+_SPAWN = {"create_task", "ensure_future"}
+_TEARDOWN_RE = re.compile(
+    r"(close|stop|shutdown|teardown|unload|uninstall|disable|abort"
+    r"|cancel|__aexit__|__exit__|leave)", re.I,
+)
+_RESOURCE_CTORS = {
+    "open": ("file", ("close",)),
+    "socket": ("socket", ("close",)),
+    "create_connection": ("socket", ("close",)),
+    "ThreadPoolExecutor": ("executor", ("shutdown",)),
+    "ProcessPoolExecutor": ("executor", ("shutdown",)),
+}
+_CLOSE_VERBS = {"close", "shutdown", "aclose"}
+_CONTAINER_ADD = {"append", "add", "put", "put_nowait", "insert"}
+
+
+@dataclass
+class _TaskAttr:
+    cls: str
+    attr: str
+    path: str
+    line: int
+    qual: str  # method that stores it
+
+
+@dataclass
+class _Stats:
+    spawn_sites: int = 0
+    retained_attrs: int = 0
+    resources: int = 0
+    hook_puts: int = 0
+
+    def to_dict(self) -> Dict[str, int]:
+        return {
+            "spawn_sites": self.spawn_sites,
+            "retained_task_attrs": self.retained_attrs,
+            "resource_attrs": self.resources,
+            "hook_registrations": self.hook_puts,
+        }
+
+
+def check_lifecycle(
+    idx: ProjectIndex,
+    package_prefix: str = "emqx_tpu",
+) -> Tuple[List[Finding], Dict[str, int]]:
+    st = _Stats()
+    findings: List[Finding] = []
+    findings += _check_retention(idx, package_prefix, st)
+    findings += _check_task_attrs(idx, package_prefix, st)
+    findings += _check_resources(idx, package_prefix, st)
+    findings += _check_callbacks(idx, package_prefix, st)
+    return findings, st.to_dict()
+
+
+# ---------------------------------------------------------------- retention
+
+
+def _is_spawn(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        # covers asyncio.create_task, loop.create_task AND call-chain
+        # receivers like asyncio.get_running_loop().create_task(...)
+        return f.attr in _SPAWN
+    return isinstance(f, ast.Name) and f.id in _SPAWN
+
+
+def _check_retention(idx: ProjectIndex, prefix: str,
+                     st: _Stats) -> List[Finding]:
+    findings: List[Finding] = []
+    for key, info in idx.funcs.items():
+        if not info.module.startswith(prefix):
+            continue
+        fi = idx.files[info.path]
+        # own-body walks: nested defs are their own FuncInfos and must
+        # not be visited twice
+        for node in _walk_own_body(info.node):
+            if not _is_spawn(node):
+                continue
+            st.spawn_sites += 1
+        for node in _walk_own_body(info.node):
+            # fire-and-forget = an Expr statement whose value IS the
+            # spawn call; every other position (assignment, container
+            # add, argument, await, return, comprehension) retains it
+            if not (isinstance(node, ast.Expr)
+                    and _is_spawn(node.value)):
+                continue
+            lineno = node.value.lineno
+            if lineno in fi.ignored_lines:
+                continue
+            ann = fi.annotations.get(lineno, "")
+            if ann.startswith("detached-task"):
+                reason = ann[len("detached-task"):].strip("(): ")
+                if reason:
+                    continue
+                findings.append(Finding(
+                    code="task-annotation", severity=ERROR,
+                    path=info.path, line=lineno,
+                    message=(
+                        "detached-task annotation without a reason "
+                        "(write `# analysis: detached-task(<why>)`)"
+                    ),
+                    ident=f"{info.qualname}:L-ann",
+                ))
+                continue
+            target = _spawn_target(node.value)
+            findings.append(Finding(
+                code="task-unretained", severity=ERROR,
+                path=info.path, line=lineno,
+                message=(
+                    f"{info.qualname} fires {target} and drops the "
+                    "Task: asyncio holds only a weak reference (the GC "
+                    "can collect it mid-flight) and its exception is "
+                    "never observed — retain it (attr/set/registry) "
+                    "and cancel it on shutdown, or annotate "
+                    "`# analysis: detached-task(<why>)`"
+                ),
+                ident=f"{info.qualname}:{target}",
+            ))
+    return findings
+
+
+def _spawn_target(call: ast.Call) -> str:
+    """Human name of the coroutine being spawned."""
+    if call.args:
+        inner = call.args[0]
+        if isinstance(inner, ast.Call):
+            chain = _attr_chain(inner.func)
+            if chain:
+                return f"create_task({'.'.join(chain)}(...))"
+    return "create_task(...)"
+
+
+# ----------------------------------------------------------- cancel reach
+
+
+def _check_task_attrs(idx: ProjectIndex, prefix: str,
+                      st: _Stats) -> List[Finding]:
+    findings: List[Finding] = []
+    teardown_reach = _teardown_reachable(idx)
+    for cls_list in idx.classes.values():
+        for ci in cls_list:
+            if not ci.module.startswith(prefix):
+                continue
+            stored: Dict[str, _TaskAttr] = {}
+            for m in ci.methods.values():
+                for attr, line in _task_stores(m):
+                    stored.setdefault(attr, _TaskAttr(
+                        ci.name, attr, ci.path, line, m.qualname))
+            if not stored:
+                continue
+            st.retained_attrs += len(stored)
+            cancelled: Dict[str, List[FuncInfo]] = {}
+            for m in ci.methods.values():
+                for attr in _cancel_evidence(m, set(stored)):
+                    cancelled.setdefault(attr, []).append(m)
+            fi = idx.files[ci.path]
+            for attr, ta in sorted(stored.items()):
+                if ta.line in fi.ignored_lines:
+                    continue
+                if fi.annotations.get(ta.line, "").startswith(
+                        "detached-task"):
+                    continue
+                ev = cancelled.get(attr)
+                if not ev:
+                    findings.append(Finding(
+                        code="task-leak", severity=ERROR, path=ci.path,
+                        line=ta.line,
+                        message=(
+                            f"{ci.name}.{attr} retains asyncio task(s) "
+                            "but no method of the class cancels or "
+                            "awaits them — the task outlives every "
+                            "shutdown (add a cancel/join on the "
+                            "close/stop path)"
+                        ),
+                        ident=f"{ci.name}.{attr}",
+                    ))
+                    continue
+                if not any(m.key in teardown_reach for m in ev):
+                    findings.append(Finding(
+                        code="task-cancel-unreachable", severity=WARN,
+                        path=ci.path, line=ta.line,
+                        message=(
+                            f"{ci.name}.{attr} is cancelled only in "
+                            f"{', '.join(m.qualname for m in ev)}, "
+                            "which no close/stop/shutdown-shaped "
+                            "method reaches — shutdown leaks the task"
+                        ),
+                        ident=f"{ci.name}.{attr}:reach",
+                    ))
+    return findings
+
+
+def _task_stores(m: FuncInfo):
+    """(attr, line) pairs where a spawn result lands in self.<attr> —
+    scalar assign, dict slot, or container .append/.add."""
+    for node in ast.walk(m.node):
+        if isinstance(node, ast.Assign) and _is_spawn(node.value):
+            for t in node.targets:
+                attr = _self_attr_of(t)
+                if attr:
+                    yield attr, node.lineno
+        elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.ListComp) and _is_spawn(
+                node.value.elt):
+            for t in node.targets:
+                attr = _self_attr_of(t)
+                if attr:
+                    yield attr, node.lineno
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if (chain and len(chain) == 3 and chain[0] == "self"
+                    and chain[-1] in _CONTAINER_ADD
+                    and any(_is_spawn(a) for a in node.args)):
+                yield chain[1], node.lineno
+
+
+def _self_attr_of(t) -> Optional[str]:
+    """self.<attr> or self.<attr>[k] assignment target -> attr."""
+    if isinstance(t, ast.Subscript):
+        t = t.value
+    chain = _attr_chain(t)
+    if chain and chain[0] == "self" and len(chain) == 2:
+        return chain[1]
+    return None
+
+
+def _cancel_evidence(m: FuncInfo, attrs: Set[str]) -> Set[str]:
+    """Attrs (from `attrs`) this method cancels, awaits or gathers —
+    directly (`self.t.cancel()`), through a local alias, or through a
+    for-target iterating the attr (incl. `.values()`/`list(...)`)."""
+    out: Set[str] = set()
+    derived = _derived_names(m, attrs)
+    for node in ast.walk(m.node):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            if chain[-1] == "cancel":
+                recv = chain[:-1]
+                if recv[0] == "self" and len(recv) == 2 \
+                        and recv[1] in attrs:
+                    out.add(recv[1])
+                elif len(recv) == 1 and recv[0] in derived:
+                    out |= derived[recv[0]]
+            elif chain[-1] == "gather":
+                out |= _attrs_mentioned(node, attrs)
+        elif isinstance(node, ast.Await):
+            chain = _attr_chain(node.value)
+            if chain and chain[0] == "self" and len(chain) == 2 \
+                    and chain[1] in attrs:
+                out.add(chain[1])
+            elif chain and len(chain) == 1 and chain[0] in derived:
+                out |= derived[chain[0]]
+    return out
+
+
+def _derived_names(m: FuncInfo, attrs: Set[str]) -> Dict[str, Set[str]]:
+    """Local names whose value derives from self.<attr>: `t = self.x`,
+    `for t in self.tasks` / `.values()` / `list(self.tasks) + [...]` —
+    a name derived from several attrs carries all of them."""
+    derived: Dict[str, Set[str]] = {}
+
+    def sources(value) -> Set[str]:
+        src = _attrs_mentioned(value, attrs)
+        for n in ast.walk(value):
+            if isinstance(n, ast.Name) and n.id in derived:
+                src |= derived[n.id]
+        return src
+
+    for _ in range(2):  # one extra round for alias-of-alias chains
+        for node in ast.walk(m.node):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                if len(targets) == 1 and isinstance(
+                        targets[0], ast.Name):
+                    src = sources(node.value)
+                    if src:
+                        derived.setdefault(
+                            targets[0].id, set()).update(src)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                src = sources(node.iter)
+                if src and isinstance(node.target, ast.Name):
+                    derived.setdefault(
+                        node.target.id, set()).update(src)
+    return derived
+
+
+def _attrs_mentioned(node, attrs: Set[str]) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and isinstance(
+                n.value, ast.Name) and n.value.id == "self" \
+                and n.attr in attrs:
+            out.add(n.attr)
+    return out
+
+
+def _teardown_reachable(idx: ProjectIndex) -> Set[str]:
+    """Function keys reachable over CALL edges from any teardown-shaped
+    function (by name)."""
+    roots = {
+        key for key, info in idx.funcs.items()
+        if _TEARDOWN_RE.search(info.qualname.split(".")[-1])
+    }
+    out_edges: Dict[str, List[str]] = {}
+    for e in idx.edges:
+        if e.kind == CALL:
+            out_edges.setdefault(e.caller, []).append(e.callee)
+    seen = set(roots)
+    queue = list(roots)
+    while queue:
+        cur = queue.pop()
+        for nxt in out_edges.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                queue.append(nxt)
+    return seen
+
+
+# ------------------------------------------------------------- resources
+
+
+def _resource_ctor(node) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    if not isinstance(node, ast.Call):
+        return None
+    chain = _attr_chain(node.func)
+    if not chain:
+        return None
+    got = _RESOURCE_CTORS.get(chain[-1])
+    if got is None:
+        return None
+    if chain[-1] == "socket" and len(chain) == 1:
+        return None  # bare socket() is ambiguous; socket.socket() isn't
+    return got
+
+
+def _check_resources(idx: ProjectIndex, prefix: str,
+                     st: _Stats) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls_list in idx.classes.values():
+        for ci in cls_list:
+            if not ci.module.startswith(prefix):
+                continue
+            held: Dict[str, Tuple[str, int, str]] = {}
+            for m in ci.methods.values():
+                for node in ast.walk(m.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    res = _resource_ctor(node.value)
+                    if res is None:
+                        continue
+                    for t in node.targets:
+                        attr = _self_attr_of(t)
+                        if attr:
+                            held[attr] = (res[0], node.lineno,
+                                          m.qualname)
+            if not held:
+                continue
+            st.resources += len(held)
+            closed: Set[str] = set()
+            for m in ci.methods.values():
+                derived = _derived_names(m, set(held))
+                for node in ast.walk(m.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    chain = _attr_chain(node.func)
+                    if not chain or chain[-1] not in _CLOSE_VERBS:
+                        continue
+                    if len(chain) == 3 and chain[0] == "self":
+                        closed.add(chain[1])
+                    elif len(chain) == 2 and chain[0] in derived:
+                        # f = self._files.pop(k); f.close()
+                        closed |= derived[chain[0]]
+            fi = idx.files[ci.path]
+            for attr, (kind, line, qual) in sorted(held.items()):
+                if attr in closed or line in fi.ignored_lines:
+                    continue
+                findings.append(Finding(
+                    code="resource-leak", severity=ERROR, path=ci.path,
+                    line=line,
+                    message=(
+                        f"{ci.name}.{attr} holds a {kind} opened in "
+                        f"{qual} but no method of the class closes it "
+                        "— add a close()/shutdown() on the teardown "
+                        "path"
+                    ),
+                    ident=f"{ci.name}.{attr}",
+                ))
+    # function-local resources: opened, never closed, never escapes
+    for key, info in idx.funcs.items():
+        if not info.module.startswith(prefix):
+            continue
+        fi = idx.files[info.path]
+        findings.extend(_check_local_resources(info, fi))
+    return findings
+
+
+def _check_local_resources(info: FuncInfo, fi) -> List[Finding]:
+    findings: List[Finding] = []
+    opened: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            res = _resource_ctor(node.value)
+            if res is not None:
+                opened[node.targets[0].id] = (res[0], node.lineno)
+    if not opened:
+        return findings
+    for node in ast.walk(info.node):
+        # any escape or close clears the name: with-context, close(),
+        # return, attr store, container add, call argument
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and len(chain) == 2 and chain[1] in _CLOSE_VERBS:
+                opened.pop(chain[0], None)
+            for a in list(node.args) + [kw.value for kw in
+                                        node.keywords]:
+                if isinstance(a, ast.Name):
+                    opened.pop(a.id, None)
+        elif isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name):
+            opened.pop(node.value.id, None)
+        elif isinstance(node, ast.Assign):
+            # aliasing or storing the handle hands ownership off:
+            # `self._f = f`, `x = f`, `pair = (f, g)` all escape
+            if not _resource_ctor(node.value):
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Name):
+                        opened.pop(n.id, None)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Name):
+                    opened.pop(item.context_expr.id, None)
+    for name, (kind, line) in sorted(opened.items()):
+        if line in fi.ignored_lines:
+            continue
+        findings.append(Finding(
+            code="resource-leak", severity=ERROR, path=info.path,
+            line=line,
+            message=(
+                f"{info.qualname} opens {kind} {name!r} and neither "
+                "closes it nor hands it off — use a `with` block or "
+                "close it on every path"
+            ),
+            ident=f"{info.qualname}:{name}",
+        ))
+    return findings
+
+
+# ---------------------------------------------------- callback pairing
+
+
+def _check_callbacks(idx: ProjectIndex, prefix: str,
+                     st: _Stats) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls_list in idx.classes.values():
+        for ci in cls_list:
+            if not ci.module.startswith(prefix):
+                continue
+            has_teardown = any(
+                _TEARDOWN_RE.search(name) for name in ci.methods
+            )
+            if not has_teardown:
+                continue  # process-lifetime singleton: nothing to
+                # reach the unregister from
+            fi = idx.files[ci.path]
+            puts: List[Tuple[str, int, str]] = []  # (point, line, qual)
+            deletes: Set[str] = set()
+            slot_sets: List[Tuple[str, str, int, str]] = []
+            slot_clears: Set[Tuple[str, str]] = set()
+            for m in ci.methods.values():
+                for node in ast.walk(m.node):
+                    if isinstance(node, ast.Call):
+                        chain = _attr_chain(node.func)
+                        if not chain or len(chain) < 2:
+                            continue
+                        recv_is_hooks = chain[-2] == "h" or any(
+                            "hook" in c.lower() for c in chain[:-1]
+                        )
+                        if not recv_is_hooks:
+                            continue
+                        point = _str_arg(node, 0)
+                        if chain[-1] == "put" and point and \
+                                _is_self_bound(node, 1):
+                            puts.append((point, node.lineno,
+                                         m.qualname))
+                        elif chain[-1] == "delete" and point:
+                            deletes.add(point)
+                    elif isinstance(node, ast.Assign):
+                        got = _slot_assign(node)
+                        if got is None:
+                            continue
+                        recv, slot, cleared = got
+                        if cleared:
+                            slot_clears.add((recv, slot))
+                        elif _is_self_bound_value(node.value):
+                            slot_sets.append((recv, slot,
+                                              node.lineno, m.qualname))
+            st.hook_puts += len(puts)
+            for point, line, qual in puts:
+                if point in deletes or line in fi.ignored_lines:
+                    continue
+                if fi.annotations.get(line, "").startswith("lifetime="):
+                    continue
+                findings.append(Finding(
+                    code="hook-unpaired", severity=ERROR, path=ci.path,
+                    line=line,
+                    message=(
+                        f"{ci.name}.{qual.split('.')[-1]} registers a "
+                        f"callback on hook point {point!r} but the "
+                        "class (which has a teardown method) never "
+                        "hooks.delete()s it — a stopped instance keeps "
+                        "receiving events; delete it on teardown or "
+                        "annotate `# analysis: lifetime=node(<why>)`"
+                    ),
+                    ident=f"{ci.name}:{point}",
+                ))
+            owned = _owned_attrs(ci)
+            for recv, slot, line, qual in slot_sets:
+                if (recv, slot) in slot_clears \
+                        or line in fi.ignored_lines:
+                    continue
+                if fi.annotations.get(line, "").startswith("lifetime="):
+                    continue
+                root = recv.split(".")[1] if recv.startswith("self.") \
+                    else recv
+                if root in owned:
+                    continue  # the holder dies with us; no dangle
+                findings.append(Finding(
+                    code="slot-unpaired", severity=ERROR, path=ci.path,
+                    line=line,
+                    message=(
+                        f"{ci.name}.{qual.split('.')[-1]} installs a "
+                        f"bound callback into {recv}.{slot} (an object "
+                        "it does not own) and never clears it — the "
+                        "slot keeps this instance alive and firing "
+                        f"after teardown; set {recv}.{slot} = None on "
+                        "close or annotate "
+                        "`# analysis: lifetime=node(<why>)`"
+                    ),
+                    ident=f"{ci.name}:{recv}.{slot}",
+                ))
+    return findings
+
+
+def _str_arg(node: ast.Call, i: int) -> Optional[str]:
+    if len(node.args) > i and isinstance(node.args[i], ast.Constant) \
+            and isinstance(node.args[i].value, str):
+        return node.args[i].value
+    return None
+
+
+def _is_self_bound(node: ast.Call, i: int) -> bool:
+    """Arg i references self (bound method, self itself, or a lambda
+    closing over self) — i.e. registering keeps THIS instance alive."""
+    if len(node.args) <= i:
+        return False
+    return _is_self_bound_value(node.args[i])
+
+
+def _is_self_bound_value(v) -> bool:
+    for n in ast.walk(v):
+        if isinstance(n, ast.Name) and n.id == "self":
+            return True
+    return False
+
+
+def _slot_assign(node: ast.Assign):
+    """`<recv>.on_<slot> = <value>` -> (recv_text, slot, cleared)."""
+    if len(node.targets) != 1:
+        return None
+    t = node.targets[0]
+    if not isinstance(t, ast.Attribute) or not t.attr.startswith("on_"):
+        return None
+    chain = _attr_chain(t)
+    if not chain or len(chain) < 3:
+        return None  # self.on_x = ... assigns OUR slot, not a foreign one
+    recv = ".".join(chain[:-1])
+    cleared = isinstance(node.value, ast.Constant) \
+        and node.value.value is None
+    return recv, t.attr, cleared
+
+
+def _owned_attrs(ci) -> Set[str]:
+    """Attrs assigned from a constructor call in __init__ — objects
+    this class created and therefore owns."""
+    out: Set[str] = set()
+    init = ci.methods.get("__init__")
+    if init is None:
+        return out
+    for node in ast.walk(init.node):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            for t in node.targets:
+                chain = _attr_chain(t)
+                if chain and chain[0] == "self" and len(chain) == 2:
+                    out.add(chain[1])
+    return out

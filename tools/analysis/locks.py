@@ -1,0 +1,699 @@
+"""Pass (e): lock-order analysis — deadlock freedom by construction.
+
+The races pass proves shared state is *locked*; this pass proves the
+locks themselves are taken in one global *order*.  Two threads that
+acquire the same two locks in opposite orders deadlock the moment their
+critical sections overlap — the classic inversion no amount of
+per-attribute locking prevents, and the failure mode every new
+cross-thread subsystem (replicated ds log, sharded prep) risks adding.
+
+Model:
+
+* a **lock identity** is class-qualified (``ChurnWal._lock``) for
+  ``self.<attr> = threading.Lock()/RLock()/Condition()`` attributes —
+  one name per (class, attr), the standard instance-collapsed
+  approximation — or module-qualified (``emqx_tpu.ops.native._lock``)
+  for module-level locks.  ``asyncio.Lock()`` family locks are tracked
+  too (kind ``async``): ordering cycles between coroutines deadlock the
+  loop just as surely, they just park tasks instead of threads.
+* per function, a statement-ordered scan tracks the **held set**
+  through ``with``/``async with`` blocks AND bare ``.acquire()`` /
+  ``.release()`` calls (an acquire with no matching release makes the
+  lock part of the function's *holds-on-exit* summary; a release with
+  no prior acquire, its *releases-on-entry* summary — the
+  begin()/end() split-guard idiom).
+* acquiring M while holding L adds the edge **L -> M**.  Calls resolve
+  through the PR 8 call graph: an edge is added for every lock the
+  callee may acquire transitively (``CALL`` edges and ``EXECUTOR``
+  hops both count — ``await asyncio.to_thread(f)`` while holding L
+  still nests every lock f takes under L in wait-for terms).
+* any cycle in the merged graph is an **error** (``lock-cycle``).
+  Same-name self-edges are excluded: for RLocks re-entry is legal, and
+  for distinct instances of one class the name collapse would make
+  every peer-to-peer call a false cycle.  The one provably-deadlocking
+  shape — re-acquiring a NON-reentrant lock on the same ``self``
+  receiver, directly or through a ``self.method()`` hop chain — is
+  reported separately (``lock-reentry``).
+* ``tools/analysis/lockorder.json`` records the blessed global order.
+  An edge between two listed locks that runs *backwards* is an
+  inversion error (``lock-order``) unless the acquisition line carries
+  ``# analysis: lock-after=<held>`` naming the held lock — the escape
+  documents a reviewed exception in place.  Listed names that match no
+  known lock are flagged (``lockorder-dead``) so the file can't rot.
+* an ``await`` while a *threading* lock is held **non-lexically**
+  (via ``.acquire()`` or a call into a holds-on-exit function) is an
+  error (``await-under-lock-hop``) — the lexical ``with self._lock:
+  ... await`` case is already covered by the races pass; this closes
+  the split-guard hole the lexical check cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .index import CALL, EXECUTOR, FuncInfo, ProjectIndex, _attr_chain, \
+    _walk_own_body
+from .report import ERROR, WARN, Finding
+
+LOCKORDER_NAME = "lockorder.json"
+
+_THREAD_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+                 "BoundedSemaphore"}
+_ASYNC_HEAD = "asyncio"
+
+
+def lockorder_path(repo: str) -> str:
+    return os.path.join(repo, "tools", "analysis", LOCKORDER_NAME)
+
+
+@dataclass
+class LockDef:
+    name: str  # "ChurnWal._lock" | "emqx_tpu.ops.native._lock"
+    kind: str  # "thread" | "async"
+    reentrant: bool
+    path: str
+    line: int
+
+
+@dataclass
+class LockEdge:
+    held: str
+    acquired: str
+    path: str
+    line: int
+    func: str  # qualname of the function holding `held`
+    roles: str = "?"  # thread roles of that function ("loop/worker")
+    blessed: bool = False  # carries a matching lock-after annotation
+
+
+@dataclass
+class _Held:
+    name: str
+    kind: str
+    via: str  # "with" | "acquire" | "call"
+    chain: str  # source receiver text ("self._lock"), "" via call
+
+
+@dataclass
+class _FnScan:
+    """Per-function facts from one statement-ordered walk."""
+    events: List[tuple] = field(default_factory=list)
+    # direct lock names acquired anywhere (with or acquire)
+    acquires: Set[str] = field(default_factory=set)
+    # locks acquired on a literal `self` receiver (for reentry checks)
+    self_acquires: Set[str] = field(default_factory=set)
+    holds_on_exit: Set[str] = field(default_factory=set)
+    releases_on_entry: Set[str] = field(default_factory=set)
+
+
+class LockAnalysis:
+    def __init__(self, idx: ProjectIndex, roles: Dict[str, Set[str]],
+                 package_prefix: str = "emqx_tpu"):
+        self.idx = idx
+        self.roles = roles
+        self.prefix = package_prefix
+        self.locks: Dict[str, LockDef] = {}
+        # class name -> {attr -> lock name}
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        # module -> {global name -> lock name}
+        self.module_locks: Dict[str, Dict[str, str]] = {}
+        self.edges: List[LockEdge] = []
+        self.findings: List[Finding] = []
+        self.scans: Dict[str, _FnScan] = {}
+        self.summary: Dict[str, Set[str]] = {}
+        self.summary_self: Dict[str, Set[str]] = {}
+
+    # ------------------------------------------------------- lock registry
+
+    def collect_locks(self) -> None:
+        for cls_list in self.idx.classes.values():
+            for ci in cls_list:
+                if not ci.module.startswith(self.prefix):
+                    continue
+                for m in ci.methods.values():
+                    for node in ast.walk(m.node):
+                        if not isinstance(node, ast.Assign):
+                            continue
+                        got = self._lock_ctor(node.value)
+                        if got is None:
+                            continue
+                        kind, reentrant = got
+                        for t in node.targets:
+                            tc = _attr_chain(t)
+                            if tc and tc[0] == "self" and len(tc) == 2:
+                                name = f"{ci.name}.{tc[1]}"
+                                self.locks[name] = LockDef(
+                                    name, kind, reentrant, ci.path,
+                                    node.lineno)
+                                self.class_locks.setdefault(
+                                    ci.name, {})[tc[1]] = name
+        for module, fi in self.idx.modules.items():
+            if fi.tree is None or not module.startswith(self.prefix):
+                continue
+            for node in fi.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                got = self._lock_ctor(node.value)
+                if got is None:
+                    continue
+                kind, reentrant = got
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        name = f"{module}.{t.id}"
+                        self.locks[name] = LockDef(
+                            name, kind, reentrant, fi.rel, node.lineno)
+                        self.module_locks.setdefault(
+                            module, {})[t.id] = name
+
+    def _lock_ctor(self, value) -> Optional[Tuple[str, bool]]:
+        if not isinstance(value, ast.Call):
+            return None
+        chain = _attr_chain(value.func)
+        if not chain or chain[-1] not in _THREAD_CTORS:
+            return None
+        if chain[0] == _ASYNC_HEAD:
+            return ("async", False)
+        # bare Lock()/RLock() or threading.Lock(): the threading family
+        return ("thread", chain[-1] == "RLock")
+
+    def _resolve_lock(self, info: FuncInfo, expr) -> List[Tuple[str, str]]:
+        """Lock names (name, chain-text) an acquisition expression may
+        denote.  Handles module globals, self attrs (through the MRO)
+        and attr chains typed by the index (`self.wal._lock`)."""
+        chain = _attr_chain(expr)
+        if not chain:
+            return []
+        text = ".".join(chain)
+        if len(chain) == 1:
+            got = self.module_locks.get(info.module, {}).get(chain[0])
+            return [(got, text)] if got else []
+        attr = chain[-1]
+        out: List[Tuple[str, str]] = []
+        recv = chain[:-1]
+        if recv == ["self"] and info.cls is not None:
+            for ci in self.idx.classes.get(info.cls, []):
+                for c in self.idx.class_mro(ci):
+                    got = self.class_locks.get(c.name, {}).get(attr)
+                    if got:
+                        out.append((got, text))
+                        break
+                if out:
+                    break
+            return out
+        for t in sorted(self.idx._receiver_types(info, recv)):
+            got = self.class_locks.get(t, {}).get(attr)
+            if got:
+                out.append((got, text))
+        if not out:
+            # module attr: mod._lock through imports
+            head = self.idx.imports.get(info.module, {}).get(chain[0])
+            if head and head[0] == "module":
+                mod = ".".join([head[1]] + chain[1:-1])
+                got = self.module_locks.get(mod, {}).get(attr)
+                if got:
+                    out.append((got, text))
+        return out
+
+    # ----------------------------------------------------- per-fn scanning
+
+    def scan_all(self) -> None:
+        for key, info in self.idx.funcs.items():
+            if not info.module.startswith(self.prefix):
+                continue
+            self.scans[key] = self._scan_fn(info)
+
+    def _scan_fn(self, info: FuncInfo) -> _FnScan:
+        sc = _FnScan()
+        held: List[_Held] = []
+
+        def resolve_targets(call: ast.Call):
+            return self.idx._resolve_call_targets(info, call.func)
+
+        def on_acquire(names: List[Tuple[str, str]], via: str,
+                       lineno: int) -> None:
+            for name, chain in names:
+                ld = self.locks[name]
+                sc.acquires.add(name)
+                if chain.startswith("self."):
+                    sc.self_acquires.add(name)
+                sc.events.append(("acq", name, via, chain, lineno,
+                                  list(h.name for h in held)))
+                held.append(_Held(name, ld.kind, via, chain))
+
+        def on_release(names: List[Tuple[str, str]]) -> None:
+            for name, _chain in names:
+                for i in range(len(held) - 1, -1, -1):
+                    if held[i].name == name:
+                        del held[i]
+                        break
+                else:
+                    sc.releases_on_entry.add(name)
+                sc.events.append(("rel", name))
+
+        def visit(node) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return  # nested scopes are their own FuncInfos
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                entered: List[Tuple[str, str]] = []
+                for item in node.items:
+                    ctx = item.context_expr
+                    # `with lock:` or `with self._lock:` (strip a
+                    # trailing .acquire-style call if written as one)
+                    got = self._resolve_lock(info, ctx)
+                    if got:
+                        entered.extend(got)
+                        continue
+                    visit_expr(ctx)
+                on_acquire(entered, "with", node.lineno)
+                for child in node.body:
+                    visit(child)
+                on_release(list(reversed(entered)))
+                return
+            if isinstance(node, ast.Try):
+                for child in node.body:
+                    visit(child)
+                for h in node.handlers:
+                    for child in h.body:
+                        visit(child)
+                for child in node.orelse:
+                    visit(child)
+                for child in node.finalbody:
+                    visit(child)
+                return
+            visit_expr(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        def visit_expr(node) -> None:
+            if isinstance(node, ast.Await):
+                sc.events.append(("await", node.lineno,
+                                  [h.name for h in held
+                                   if h.via != "with"],
+                                  [h.name for h in held]))
+                return
+            if not isinstance(node, ast.Call):
+                return
+            chain = _attr_chain(node.func)
+            attr = chain[-1] if chain else None
+            if attr == "acquire" and chain is not None and len(chain) > 1:
+                got = self._resolve_lock(
+                    info, node.func.value if isinstance(
+                        node.func, ast.Attribute) else None)
+                if got and not _nonblocking(node):
+                    on_acquire(got, "acquire", node.lineno)
+                    return
+            if attr == "release" and chain is not None and len(chain) > 1:
+                got = self._resolve_lock(
+                    info, node.func.value if isinstance(
+                        node.func, ast.Attribute) else None)
+                if got:
+                    on_release(got)
+                    return
+            targets = resolve_targets(node)
+            if targets:
+                recv_self = bool(chain and chain[0] == "self"
+                                 and len(chain) == 2)
+                sc.events.append(("call",
+                                  [t.key for t in targets],
+                                  node.lineno,
+                                  [h.name for h in held],
+                                  recv_self))
+
+        for child in ast.iter_child_nodes(info.node):
+            visit(child)
+        sc.holds_on_exit = {h.name for h in held if h.via == "acquire"}
+        return sc
+
+    # --------------------------------------------------------- summaries
+
+    def summarize(self) -> None:
+        """Transitive lock-acquisition summaries over CALL + EXECUTOR
+        edges, to a fixed point."""
+        out_edges: Dict[str, List[str]] = {}
+        for e in self.idx.edges:
+            if e.kind in (CALL, EXECUTOR):
+                out_edges.setdefault(e.caller, []).append(e.callee)
+        for key, sc in self.scans.items():
+            self.summary[key] = set(sc.acquires)
+            self.summary_self[key] = set(sc.self_acquires)
+        changed = True
+        while changed:
+            changed = False
+            for key in self.scans:
+                s = self.summary[key]
+                for callee in out_edges.get(key, ()):
+                    cs = self.summary.get(callee)
+                    if cs and not cs <= s:
+                        s |= cs
+                        changed = True
+        # self-receiver summaries propagate only through self.m() calls
+        changed = True
+        while changed:
+            changed = False
+            for key, sc in self.scans.items():
+                s = self.summary_self[key]
+                for ev in sc.events:
+                    if ev[0] != "call" or not ev[4]:
+                        continue
+                    for callee in ev[1]:
+                        cs = self.summary_self.get(callee)
+                        if cs and not cs <= s:
+                            s |= cs
+                            changed = True
+
+    # ------------------------------------------------------------- edges
+
+    def build_edges(self) -> None:
+        for key, sc in self.scans.items():
+            info = self.idx.funcs[key]
+            fi = self.idx.files[info.path]
+            # the role label makes the graph per-role: an edge held on
+            # a loop-only function can only collide with worker-held
+            # edges of the same pair, which is exactly the cross-thread
+            # deadlock the cycle/inversion checks exist for
+            role_s = "/".join(sorted(self.roles.get(key, ()))) or "?"
+            for ev in sc.events:
+                if ev[0] == "acq":
+                    _tag, name, _via, chain, lineno, held = ev
+                    ann = _lock_after(fi.annotations.get(lineno, ""))
+                    for h in held:
+                        if h == name:
+                            self._check_reentry(info, name, chain,
+                                                lineno)
+                            continue
+                        self.edges.append(LockEdge(
+                            held=h, acquired=name, path=info.path,
+                            line=lineno, func=info.qualname,
+                            roles=role_s, blessed=(ann == h)))
+                elif ev[0] == "call":
+                    _tag, targets, lineno, held, recv_self = ev
+                    if not held:
+                        continue
+                    ann = _lock_after(fi.annotations.get(lineno, ""))
+                    acq: Set[str] = set()
+                    for t in targets:
+                        acq |= self.summary.get(t, set())
+                    for h in held:
+                        for name in sorted(acq):
+                            if name == h:
+                                if recv_self:
+                                    self._check_reentry_hop(
+                                        info, targets, name, lineno)
+                                continue
+                            self.edges.append(LockEdge(
+                                held=h, acquired=name, path=info.path,
+                                line=lineno, func=info.qualname,
+                                roles=role_s, blessed=(ann == h)))
+
+    def _check_reentry(self, info: FuncInfo, name: str, chain: str,
+                       lineno: int) -> None:
+        ld = self.locks[name]
+        fi = self.idx.files[info.path]
+        if ld.reentrant or ld.kind != "thread":
+            return
+        if not chain.startswith("self."):
+            return  # distinct-instance acquisition is legal
+        if lineno in fi.ignored_lines:
+            return
+        self.findings.append(Finding(
+            code="lock-reentry", severity=ERROR, path=info.path,
+            line=lineno,
+            message=(
+                f"{info.qualname} re-acquires non-reentrant lock "
+                f"{name} already held on the same instance — "
+                "guaranteed self-deadlock (use an RLock or hoist the "
+                "outer acquisition)"
+            ),
+            ident=f"{info.qualname}:{name}",
+        ))
+
+    def _check_reentry_hop(self, info: FuncInfo, targets: List[str],
+                           name: str, lineno: int) -> None:
+        """`with self._lock: self.helper()` where helper re-acquires
+        self._lock: same instance by construction."""
+        ld = self.locks[name]
+        fi = self.idx.files[info.path]
+        if ld.reentrant or ld.kind != "thread":
+            return
+        if lineno in fi.ignored_lines:
+            return
+        if not any(name in self.summary_self.get(t, set())
+                   for t in targets):
+            return
+        self.findings.append(Finding(
+            code="lock-reentry", severity=ERROR, path=info.path,
+            line=lineno,
+            message=(
+                f"{info.qualname} calls a self-method that re-acquires "
+                f"non-reentrant lock {name} already held — guaranteed "
+                "self-deadlock through the call-graph hop"
+            ),
+            ident=f"{info.qualname}:{name}:hop",
+        ))
+
+    # ----------------------------------------------------- graph analysis
+
+    def check_cycles(self) -> None:
+        graph: Dict[str, Set[str]] = {}
+        sites: Dict[Tuple[str, str], LockEdge] = {}
+        for e in self.edges:
+            if e.held == e.acquired:
+                continue
+            graph.setdefault(e.held, set()).add(e.acquired)
+            sites.setdefault((e.held, e.acquired), e)
+        for cyc in _cycles(graph):
+            parts = []
+            for a, b in zip(cyc, cyc[1:] + cyc[:1]):
+                e = sites[(a, b)]
+                parts.append(f"{a} -> {b} at {e.path}:{e.line} "
+                             f"({e.func}, role {e.roles})")
+            first = sites[(cyc[0], cyc[1] if len(cyc) > 1 else cyc[0])]
+            self.findings.append(Finding(
+                code="lock-cycle", severity=ERROR, path=first.path,
+                line=first.line,
+                message=(
+                    "lock-order cycle (deadlock when the critical "
+                    "sections overlap): " + "; ".join(parts)
+                ),
+                ident="/".join(cyc),
+            ))
+
+    def check_order(self, order: List[str]) -> None:
+        pos = {name: i for i, name in enumerate(order)}
+        for name in order:
+            if name not in self.locks:
+                ld_path = os.path.join("tools", "analysis",
+                                       LOCKORDER_NAME)
+                self.findings.append(Finding(
+                    code="lockorder-dead", severity=WARN, path=ld_path,
+                    line=1,
+                    message=(
+                        f"lockorder.json lists {name!r} but no such "
+                        "lock exists in the tree — remove the stale "
+                        "entry"
+                    ),
+                    ident=name,
+                ))
+        seen: Set[Tuple[str, str]] = set()
+        for e in self.edges:
+            if e.blessed or e.held == e.acquired:
+                continue
+            ih, ia = pos.get(e.held), pos.get(e.acquired)
+            if ih is None or ia is None or ih < ia:
+                continue
+            fi = self.idx.files.get(e.path)
+            if fi is not None and e.line in fi.ignored_lines:
+                continue
+            key = (e.held, e.acquired)
+            if key in seen:
+                continue
+            seen.add(key)
+            self.findings.append(Finding(
+                code="lock-order", severity=ERROR, path=e.path,
+                line=e.line,
+                message=(
+                    f"{e.func} (role {e.roles}) acquires {e.acquired} "
+                    f"while holding {e.held}, inverting the blessed "
+                    "global order "
+                    f"({e.held} is #{ih}, {e.acquired} is #{ia} in "
+                    "lockorder.json) — reorder the acquisitions, or "
+                    f"annotate `# analysis: lock-after={e.held}` with "
+                    "a justifying comment"
+                ),
+                ident=f"{e.held}>{e.acquired}",
+            ))
+
+    def check_await_hops(self) -> None:
+        """`await` while a threading lock is held NON-lexically — via
+        `.acquire()` in this function or a call into a holds-on-exit
+        function.  The lexical `with` case is the races pass's."""
+        for key, sc in self.scans.items():
+            info = self.idx.funcs[key]
+            if not info.is_async:
+                continue
+            fi = self.idx.files[info.path]
+            held: List[str] = []
+            for ev in sc.events:
+                if ev[0] == "acq" and ev[2] == "acquire":
+                    held.append(ev[1])
+                elif ev[0] == "rel":
+                    if ev[1] in held:
+                        held.remove(ev[1])
+                elif ev[0] == "call":
+                    for t in ev[1]:
+                        tsc = self.scans.get(t)
+                        if tsc is None:
+                            continue
+                        for name in tsc.holds_on_exit:
+                            held.append(name)
+                        for name in tsc.releases_on_entry:
+                            if name in held:
+                                held.remove(name)
+                elif ev[0] == "await":
+                    _tag, lineno, _nonlex, _all = ev
+                    bad = [n for n in held
+                           if self.locks[n].kind == "thread"]
+                    if not bad or lineno in fi.ignored_lines:
+                        continue
+                    self.findings.append(Finding(
+                        code="await-under-lock-hop", severity=ERROR,
+                        path=info.path, line=lineno,
+                        message=(
+                            f"await in {info.qualname} while threading "
+                            f"lock {bad[0]} is held through a "
+                            "non-lexical acquire (split begin()/end() "
+                            "guard or bare .acquire()) — the coroutine "
+                            "parks inside the critical section"
+                        ),
+                        ident=f"{info.qualname}:{bad[0]}",
+                    ))
+                    held = [n for n in held if n not in bad]
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "locks": len(self.locks),
+            "edges": len(self.edges),
+            "edges_on_loop": sum(
+                1 for e in self.edges if "loop" in e.roles),
+            "edges_off_loop": sum(
+                1 for e in self.edges
+                if "worker" in e.roles or "pool" in e.roles),
+            "functions_scanned": len(self.scans),
+            "holds_on_exit_fns": sum(
+                1 for sc in self.scans.values() if sc.holds_on_exit),
+        }
+
+
+def _nonblocking(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and node.args[0].value is False:
+        return True
+    return False
+
+
+def _lock_after(ann: str) -> Optional[str]:
+    if not ann.startswith("lock-after="):
+        return None
+    return ann[len("lock-after="):].split()[0].strip()
+
+
+def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles, one representative per SCC (Tarjan SCCs, then
+    a shortest cycle inside each non-trivial component) — enough to
+    report every deadlock family exactly once."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in graph and w not in index:
+                index[w] = low[w] = counter[0]
+                counter[0] += 1
+                continue
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    out: List[List[str]] = []
+    for comp in sccs:
+        cset = set(comp)
+        start = min(comp)
+        # BFS shortest cycle through `start` within the SCC
+        best: Optional[List[str]] = None
+        queue: List[List[str]] = [[start]]
+        while queue:
+            path = queue.pop(0)
+            v = path[-1]
+            for w in sorted(graph.get(v, ())):
+                if w == start and len(path) > 1:
+                    best = path
+                    queue = []
+                    break
+                if w in cset and w not in path:
+                    queue.append(path + [w])
+            if best:
+                break
+        out.append(best or comp)
+    return out
+
+
+def load_lockorder(path: str) -> List[str]:
+    if not os.path.isfile(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("order", []))
+
+
+def check_locks(
+    idx: ProjectIndex,
+    roles: Dict[str, Set[str]],
+    package_prefix: str = "emqx_tpu",
+    order: Optional[List[str]] = None,
+) -> Tuple[List[Finding], Dict[str, int]]:
+    la = LockAnalysis(idx, roles, package_prefix)
+    la.collect_locks()
+    la.scan_all()
+    la.summarize()
+    la.build_edges()
+    la.check_cycles()
+    if order is None:
+        order = load_lockorder(lockorder_path(idx.repo))
+    la.check_order(order)
+    la.check_await_hops()
+    return la.findings, la.stats()

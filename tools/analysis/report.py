@@ -18,8 +18,13 @@ from typing import Dict, List
 ERROR = "error"
 WARN = "warn"
 
-# --json consumers key on this; bump only with a schema change
-JSON_SCHEMA_VERSION = 1
+# --json consumers key on this; bump only with a schema change.
+# v2: lock-order / lifecycle / cancellation passes added their finding
+# kinds (lock-cycle, lock-order, lock-reentry, await-under-lock-hop,
+# lockorder-dead, task-unretained, task-leak, task-cancel-unreachable,
+# resource-leak, hook-unpaired, slot-unpaired, cancel-swallow,
+# cancel-leak) and the `stats` section.
+JSON_SCHEMA_VERSION = 2
 
 
 @dataclass
@@ -57,6 +62,8 @@ class Report:
     findings: List[Finding] = field(default_factory=list)
     timings: Dict[str, float] = field(default_factory=dict)
     n_files: int = 0
+    # per-pass node/edge counts (`--stats`): pass -> {label: count}
+    stats: Dict[str, Dict[str, int]] = field(default_factory=dict)
 
     def add(self, f: Finding) -> None:
         self.findings.append(f)
@@ -110,10 +117,18 @@ class Report:
             f"[{t} total={total * 1e3:.0f}ms]"
         )
 
+    def render_stats(self) -> str:
+        out = []
+        for name, counts in self.stats.items():
+            kv = " ".join(f"{k}={v}" for k, v in counts.items())
+            out.append(f"{name}: {kv}")
+        return "\n".join(out)
+
     def to_json(self) -> str:
         return json.dumps(
             {
                 "schema_version": JSON_SCHEMA_VERSION,
+                "stats": self.stats,
                 "summary": {
                     "files": self.n_files,
                     "errors": len(self.errors()),
