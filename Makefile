@@ -3,7 +3,7 @@
 
 .PHONY: check check-json lint lint-fast lint-locks test test-fast \
         native bench restore-bench chaos ds-bench ds-dump ds-soak \
-        churn-bench retained-bench fanout-bench
+        churn-bench retained-bench fanout-bench span-bench
 
 # static-analysis gate (tools/analysis/): the dialyzer/xref/elvis
 # analog, stdlib-only — whole-project AST index + call graph, thread-
@@ -60,6 +60,14 @@ retained-bench:
 # section
 fanout-bench:
 	python bench.py --fanout
+
+# message-lifecycle span attribution: per-stage p50/p99 across
+# hooks/submit/collect/enqueue/wire + the cross-node forward leg + the
+# durable-log ds leg, plus the disarmed-overhead A/B on the fan-out
+# wire path (BENCH_NO_SPANS=1 runs the disarmed leg only); writes the
+# BENCH_TABLE.md "Latency attribution" section
+span-bench:
+	python bench.py --spans
 
 # multi-seed chaos soak: 3-node cluster + hybrid engine under a seeded
 # fault schedule; asserts no QoS1 forward loss, engine/oracle parity,

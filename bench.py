@@ -1786,6 +1786,420 @@ def wire_fanout_rate(n: int) -> float:
     return iters * n / (time.time() - t0)
 
 
+SPANS_HEADER = "## Latency attribution"
+SPAN_OVERHEAD_GATE_PCT = 2.0  # armed@1/64 vs disarmed on the wire path
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def _span_pipeline_attribution(n_subs=512, ticks=200, batch=8):
+    """Drive the FULL three-phase publish pipeline (hooks -> submit ->
+    collect -> enqueue -> wire) plus the durable-log ds leg with spans
+    at sample=1, and return the plane export.  Subscribers are real
+    channels behind the serialize stage (the wire_fanout_rate harness),
+    so the wire stage closes at an honest transport hand-off."""
+    import shutil
+    import tempfile
+
+    from emqx_tpu.broker import packet as pkt
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.broker.frame import serialize_cached
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+    from emqx_tpu.broker.session import Session
+    from emqx_tpu.config.config import Config
+    from emqx_tpu.ds.manager import DsManager
+    from emqx_tpu.observe import spans as spansmod
+
+    class _NullConn:
+        __slots__ = ("channel",)
+
+        def __init__(self, channel):
+            self.channel = channel
+
+        def send_actions(self, actions):
+            for action in actions:
+                if action[0] == "send":
+                    serialize_cached(action[1], self.channel.proto_ver)
+
+    spansmod.configure(sample=1, keep=32)
+    b = Broker()
+    for i in range(n_subs):
+        ch = Channel(b, peername="127.0.0.1:1")
+        ch.out_cb = _NullConn(ch).send_actions
+        ch.on_kick = lambda rc: None
+        ch.handle_in(pkt.Connect(proto_name="MQTT", proto_ver=5,
+                                 clientid=f"s{i}"))
+        ch.handle_in(pkt.Subscribe(
+            packet_id=1, topic_filters=[("wide/t", pkt.SubOpts(qos=0))]
+        ))
+    # parked persistent session with a replay cursor: QoS1 publishes
+    # matching it ride dispatch -> deliver_offline -> ds append (the
+    # "ds" leg), through the real offline path
+    ddir = tempfile.mkdtemp(prefix="span_ds_")
+    try:
+        ds = DsManager(b, ddir, Config({}))
+        b.ds = ds
+        parked = Session(clientid="parked")
+        parked.subscriptions["park/t"] = SubOpts(qos=1)
+        parked.ds_cursor = ds.end_cursor()
+        b.cm.pending["parked"] = (parked, time.time() + 3600)
+        b.subscribe("parked", "park/t", SubOpts(qos=1))
+        t0 = time.time()
+        for _ in range(ticks):
+            msgs = [Message(topic="wide/t", payload=b"x" * 64)
+                    for _ in range(batch - 1)]
+            msgs.append(Message(topic="park/t", payload=b"x" * 64,
+                                qos=1))
+            b.publish_many(msgs)
+        wall_s = time.time() - t0
+        ds.close()
+    finally:
+        shutil.rmtree(ddir, ignore_errors=True)
+    export = spansmod.plane().export()
+    export["pipeline_msgs"] = ticks * batch
+    export["pipeline_wall_s"] = wall_s
+    spansmod.disable()
+    return export
+
+
+async def _span_forward_leg(n_msgs=100):
+    """2-node loopback cluster: sampled publishes on the origin, a
+    subscriber on the peer — the REMOTE broker closes the forward leg
+    (span context rides the FORWARD frame header)."""
+    import asyncio
+
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+    from emqx_tpu.broker.session import Session
+    from emqx_tpu.cluster.node import ClusterBroker, ClusterNode
+    from emqx_tpu.observe import spans as spansmod
+
+    spansmod.configure(sample=1, keep=32)
+    nodes = []
+    for i in range(2):
+        node = ClusterNode(f"span{i}", ClusterBroker(),
+                           heartbeat_ivl=0.5)
+        await node.start()
+        nodes.append(node)
+    n0, n1 = nodes
+    n0.join(n1.name, ("127.0.0.1", n1.transport.port))
+    n1.join(n0.name, ("127.0.0.1", n0.transport.port))
+
+    class _Sink:
+        def __init__(self, clientid, session):
+            self.clientid = clientid
+            self.session = session
+            self.got = []
+
+        def deliver(self, items):
+            self.got.extend(items)
+
+        def kick(self, rc=0):
+            pass
+
+    s = Session(clientid="fw")
+    s.subscriptions["fw/t"] = SubOpts(qos=0)
+    sink = _Sink("fw", s)
+    n1.broker.cm.register_channel(sink)
+    n1.broker.subscribe("fw", "fw/t", SubOpts(qos=0))
+
+    async def _wait(pred, timeout=15.0):
+        t = 0.0
+        while not pred():
+            await asyncio.sleep(0.02)
+            t += 0.02
+            if t > timeout:
+                raise RuntimeError("span forward leg: condition timed out")
+
+    await _wait(lambda: "fw/t" in n0.remote.filters_of(n1.name))
+    for _ in range(n_msgs):
+        n0.broker.publish(Message(topic="fw/t", payload=b"x"))
+        # yield between publishes so forward frames drain as they are
+        # written — the leg then measures transport+dispatch latency,
+        # not the tail of a 100-deep write-buffer burst
+        await asyncio.sleep(0)
+    await _wait(lambda: len(sink.got) >= n_msgs)
+    await _wait(
+        lambda: spansmod.plane().hists["forward"].count >= n_msgs
+    )
+    for node in nodes:
+        await node.stop()
+    export = spansmod.plane().export()
+    spansmod.disable()
+    return export
+
+
+def _span_wire_ab(n=10_000, reps=7, disarmed_only=False):
+    """Armed-at-1/64 vs disarmed A/B on the fan-out wire path, built
+    to survive container noise: ONE shared broker/population (no
+    per-leg heap drift), a gc.collect before each timed loop, and
+    alternating measurement order with per-mode medians — the same
+    interleaved discipline the mesh depth controller uses."""
+    import gc
+
+    from emqx_tpu.broker import packet as pkt
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.channel import Channel
+    from emqx_tpu.broker.frame import serialize_cached
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.observe import spans as spansmod
+
+    class _NullConn:
+        __slots__ = ("channel",)
+
+        def __init__(self, channel):
+            self.channel = channel
+
+        def send_actions(self, actions):
+            for action in actions:
+                if action[0] == "send":
+                    serialize_cached(action[1], self.channel.proto_ver)
+
+    b = Broker()
+    for i in range(n):
+        ch = Channel(b, peername="127.0.0.1:1")
+        ch.out_cb = _NullConn(ch).send_actions
+        ch.on_kick = lambda rc: None
+        ch.handle_in(pkt.Connect(proto_name="MQTT", proto_ver=5,
+                                 clientid=f"w{i}"))
+        ch.handle_in(pkt.Subscribe(
+            packet_id=1, topic_filters=[("wide/t", pkt.SubOpts(qos=0))]
+        ))
+    fid = b.engine.fid_of("wide/t")
+    iters = max(4, 400_000 // n)
+
+    def one_rate() -> float:
+        # pre-build the batch and fence GC out of the timed loop: a
+        # gen-2 sweep landing in one leg but not its pair is the
+        # dominant noise source on this container
+        msgs = [Message(topic="wide/t", payload=b"x" * 128)
+                for _ in range(iters)]
+        b._dispatch(Message(topic="wide/t", payload=b"x" * 128),
+                    {fid})  # warm (fast-cb cache, prefix cache)
+        gc.collect()
+        gc.disable()
+        try:
+            t0 = time.time()
+            for msg in msgs:
+                b._dispatch(msg, {fid})
+            dt = time.time() - t0
+        finally:
+            gc.enable()
+        return iters * n / dt
+
+    one_rate()  # first-touch warmup outside any timed pair
+    dis_rates, armed_rates, pair_deltas = [], [], []
+    for rep in range(reps):
+        order = ((False,) if disarmed_only
+                 else (False, True) if rep % 2 == 0 else (True, False))
+        pair = {}
+        for armed in order:
+            if armed:
+                spansmod.configure(sample=64, keep=64)
+                pair[True] = one_rate()
+                armed_rates.append(pair[True])
+            else:
+                spansmod.disable()
+                pair[False] = one_rate()
+                dis_rates.append(pair[False])
+        if len(pair) == 2:
+            # paired delta: the two legs run back to back, so slow
+            # drift (heap growth, container scheduling) cancels —
+            # medians of independent legs don't converge under the
+            # +-10% per-loop noise this container shows
+            pair_deltas.append(
+                (pair[False] - pair[True]) / pair[False] * 100.0
+            )
+    spansmod.disable()
+    return dis_rates, armed_rates, pair_deltas
+
+
+def _span_boundary_ns(loops: int = 5, iters: int = 200_000) -> float:
+    """Cost of ONE disarmed span boundary (the `spans.armed`
+    module-attribute bool test — the only thing the plane adds to an
+    unsampled path), min over tight loops so scheduler preemption can
+    only inflate, not deflate.  The measured value includes the timing
+    loop's own per-iteration cost, so it is an UPPER bound.  The
+    disarmed-overhead gate is structural: the wire path executes one
+    such check per BROADCAST (scatter lane) or per connection flush
+    batch — never per delivery — so the per-delivery overhead is this
+    number divided by the batch fan-out."""
+    from emqx_tpu.observe import spans as spansmod
+
+    spansmod.disable()
+    best = float("inf")
+    for _ in range(loops):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            if spansmod.armed:
+                raise AssertionError  # disarmed by construction
+        dt = (time.perf_counter() - t0) / iters * 1e9
+        if dt < best:
+            best = dt
+    return best
+
+
+def run_spans(reps: int = 7):
+    """`--spans`: per-plane latency attribution + overhead A/B.
+
+    Three legs: (1) overhead — the `--fanout` wire path at 10k
+    subscribers, one shared population with alternating armed-at-
+    default-1/64 vs disarmed timed loops (`BENCH_NO_SPANS=1` skips the
+    armed legs so an external driver can A/B whole processes the way
+    `BENCH_NO_FLIGHT` does); (2) attribution — the full publish
+    pipeline incl. the ds leg at sample=1; (3) the cross-node forward
+    leg on a 2-node loopback cluster."""
+    import asyncio
+
+    from emqx_tpu.observe import spans as spansmod
+    from emqx_tpu.observe.spans import KNOWN_STAGES
+
+    no_spans = os.environ.get("BENCH_NO_SPANS") == "1"
+    n = 10_000
+    log(f"span overhead A/B: fanout wire path, {n:,} subscribers")
+    dis_rates, armed_rates, pair_deltas = _span_wire_ab(
+        n, reps=3 if no_spans else reps, disarmed_only=no_spans
+    )
+    stats = {"wire_rps_disarmed": _median(dis_rates),
+             "wire_reps_disarmed": [round(r, 1) for r in dis_rates]}
+    if armed_rates:
+        stats["wire_rps_armed"] = _median(armed_rates)
+        stats["wire_reps_armed"] = [round(r, 1) for r in armed_rates]
+        stats["armed_pair_deltas_pct"] = [
+            round(d, 2) for d in pair_deltas
+        ]
+        stats["armed_overhead_pct"] = _median(pair_deltas)
+    # disarmed overhead, structurally: the wire path runs ONE boundary
+    # check per broadcast (scatter lane) / per connection flush batch,
+    # never per delivery — measure the check, divide by the fan-out
+    per_delivery_ns = 1e9 / stats["wire_rps_disarmed"]
+    boundary_ns = _span_boundary_ns()
+    stats["boundary_check_ns"] = round(boundary_ns, 2)
+    stats["per_delivery_ns"] = round(per_delivery_ns, 1)
+    stats["overhead_pct"] = (
+        boundary_ns / (n * per_delivery_ns) * 100.0
+    )
+    # worst case: a non-scatter receiver pays one check per
+    # single-message flush batch (1 check per delivery)
+    stats["overhead_worst_case_pct"] = (
+        boundary_ns / per_delivery_ns * 100.0
+    )
+    if no_spans:
+        return stats
+
+    log("span attribution: full pipeline at sample=1")
+    pipeline = _span_pipeline_attribution()
+    log("span forward leg: 2-node loopback cluster")
+    forward = asyncio.run(_span_forward_leg())
+    # merge: pipeline stages + the cluster run's forward leg
+    stages = dict(pipeline["stages"])
+    stages["forward"] = forward["stages"]["forward"]
+    stats["stages"] = stages
+    stats["stage_p99_ms"] = {
+        s: round(stages[s].get("p99", 0.0), 4)
+        for s in KNOWN_STAGES if stages[s]["count"]
+    }
+    stats["stage_p50_ms"] = {
+        s: round(stages[s].get("p50", 0.0), 4)
+        for s in KNOWN_STAGES if stages[s]["count"]
+    }
+    stats["spans"] = pipeline
+    stats["forward_legs_closed"] = forward["remote_closed"]
+    return stats
+
+
+def _spans_section_lines(s: dict) -> list:
+    from emqx_tpu.observe.spans import KNOWN_STAGES
+
+    lines = [
+        "",
+        SPANS_HEADER,
+        "",
+        "Message-lifecycle span plane (`observe/spans.py`, `python "
+        "bench.py --spans`, `make span-bench`): head-sampled publishes "
+        "stamp a monotonic timestamp at every plane boundary; "
+        "per-stage deltas land in the flight recorder's mergeable log2 "
+        "histograms (p50/p99/p999 are bucket-derived — upper bucket "
+        "edges, never under-reporting the tail).  `hooks` -> `submit` "
+        "-> `collect` -> `enqueue` -> `wire` is the three-phase "
+        "publish pipeline at sample=1; `forward` is the cross-node leg "
+        "closed by the REMOTE broker of a 2-node loopback cluster "
+        "(span context rides the FORWARD frame header); `ds` is the "
+        "parked-session durable-log append leg.  The submit p999 "
+        "bucket catches the first tick's one-off XLA compile.  Render "
+        "the slowest-K span waterfalls with `tools/span_dump.py`.",
+        "",
+        "| stage | samples | p50 ms | p99 ms | p999 ms |",
+        "|---|---|---|---|---|",
+    ]
+    stages = s.get("stages") or {}
+    for stage in KNOWN_STAGES:
+        row = stages.get(stage) or {}
+        if row.get("count"):
+            lines.append(
+                f"| {stage} | {row['count']:,} | {row['p50']:.3f} "
+                f"| {row['p99']:.3f} | {row['p999']:.3f} |"
+            )
+        else:
+            lines.append(f"| {stage} | 0 | - | - | - |")
+    tail = (
+        f"Disarmed overhead on the fan-out wire path (10k "
+        f"subscribers, {s['wire_rps_disarmed']:,.0f} deliveries/s = "
+        f"{s['per_delivery_ns']:,.0f} ns/delivery): the plane adds ONE "
+        f"boundary check (the `spans.armed` attribute test, "
+        f"{s['boundary_check_ns']:.0f} ns) per broadcast / per "
+        f"connection flush batch — never per delivery — i.e. "
+        f"{s['overhead_pct']:.5f}% at this fan-out and "
+        f"{s['overhead_worst_case_pct']:.2f}% worst-case for "
+        f"single-receiver flush batches (gate <= "
+        f"{SPAN_OVERHEAD_GATE_PCT:.0f}%)."
+    )
+    if s.get("armed_overhead_pct") is not None:
+        tail += (
+            f"  Armed at the default 1/64 sampling, the paired "
+            f"wall-clock A/B is indistinguishable from disarmed within "
+            f"this container's noise: median paired delta "
+            f"{s['armed_overhead_pct']:+.2f}% over "
+            f"{len(s['armed_pair_deltas_pct'])} back-to-back pairs "
+            f"(spread {min(s['armed_pair_deltas_pct']):+.1f}% .. "
+            f"{max(s['armed_pair_deltas_pct']):+.1f}%)."
+        )
+    else:
+        tail += "  (BENCH_NO_SPANS=1: armed legs skipped.)"
+    lines += ["", tail, ""]
+    return lines
+
+
+def _update_spans_table(s: dict) -> None:
+    """Replace the latency-attribution section of BENCH_TABLE.md in
+    place (same ownership contract as the fanout/restore sections)."""
+    path = "BENCH_TABLE.md"
+    lines = []
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    out, skipping = [], False
+    for line in lines:
+        if line.strip() == SPANS_HEADER:
+            skipping = True
+            continue
+        if skipping and line.startswith("## "):
+            skipping = False
+        if not skipping:
+            out.append(line)
+    while out and not out[-1].strip():
+        out.pop()
+    out += _spans_section_lines(s)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(out))
+    log("updated BENCH_TABLE.md latency-attribution section")
+
+
 CONFIGS = {
     1: ("exact_1k", "1k exact subs, single-level topics"),
     2: ("wild_100k", "100k subs, 6-level, 20% '+' wildcards"),
@@ -1902,6 +2316,13 @@ def main() -> None:
                          "1k/10k/50k/100k subscribers): expansion vs "
                          "full wire path, per-delivery ns; writes the "
                          "BENCH_TABLE.md section")
+    ap.add_argument("--spans", action="store_true",
+                    help="message-lifecycle span attribution: per-stage "
+                         "p50/p99 across hooks/submit/collect/enqueue/"
+                         "wire + forward + ds, plus the disarmed-"
+                         "overhead A/B on the fan-out wire path "
+                         "(BENCH_NO_SPANS=1 = disarmed leg only); "
+                         "writes the BENCH_TABLE.md section")
     ap.add_argument("--churn-capacity", action="store_true",
                     help="single churn-capacity measurement at the "
                          "current ETPU_POOL_THREADS (the sweep's inner "
@@ -1926,6 +2347,31 @@ def main() -> None:
             "n_resident": best["n_resident"],
             "rows": rows,
             "host_threads": os.cpu_count() or 1,
+        }))
+        return
+    if ns.spans:
+        stats = run_spans()
+        if "stages" in stats:
+            _update_spans_table(stats)
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
+        print(json.dumps({
+            "metric": "span_disarmed_overhead_pct_fanout_wire",
+            "value": round(stats.get("overhead_pct", 0.0), 5),
+            "unit": "pct_of_per_delivery_cost",
+            "gate_pct": SPAN_OVERHEAD_GATE_PCT,
+            "worst_case_pct": round(
+                stats.get("overhead_worst_case_pct", 0.0), 3),
+            "boundary_check_ns": stats.get("boundary_check_ns", 0.0),
+            "per_delivery_ns": stats.get("per_delivery_ns", 0.0),
+            "armed_overhead_pct": round(
+                stats.get("armed_overhead_pct") or 0.0, 2),
+            "wire_rps_disarmed": round(stats["wire_rps_disarmed"], 1),
+            "wire_rps_armed": round(stats.get("wire_rps_armed", 0.0), 1),
+            "stage_p50_ms": stats.get("stage_p50_ms", {}),
+            "stage_p99_ms": stats.get("stage_p99_ms", {}),
+            "forward_legs_closed": stats.get("forward_legs_closed", 0),
         }))
         return
     if ns.fanout:

@@ -24,6 +24,7 @@ from .cm import ConnectionManager
 from .delivery import scatter_template
 from .hooks import Hooks
 from .message import Message
+from ..observe import spans as _spans
 from ..observe.tracepoints import tp
 from .metrics import Metrics
 from .packet import Property, SubOpts
@@ -43,6 +44,9 @@ class PendingPublish:
     pending: object  # engine _PendingMatch (or None for an empty tick)
     matched: Optional[List[List[int]]] = None
     exc: Optional[BaseException] = None  # collect failure (batcher drain)
+    # sampled message-lifecycle span contexts riding this tick
+    # (observe/spans.py; empty when the plane is disarmed)
+    spans: List[object] = field(default_factory=list)
 
 
 @dataclass
@@ -352,7 +356,7 @@ class Broker:
     #   finish  (loop thread)   fid expansion + local delivery
 
     def publish_submit(self, msgs: Sequence[Message]) -> "PendingPublish":
-        todo, results = self._prepare_publish(msgs)
+        todo, results, ticked = self._prepare_publish(msgs)
         if todo:
             self._pre_match(todo)
         pending = (
@@ -360,11 +364,15 @@ class Broker:
             if todo
             else None
         )
-        return PendingPublish(todo, results, pending)
+        for ctx in ticked:
+            _spans.mark(ctx, "submit")
+        return PendingPublish(todo, results, pending, spans=ticked)
 
     def publish_collect(self, pp: "PendingPublish") -> "PendingPublish":
         if pp.pending is not None:
             pp.matched = self.engine.match_collect_raw(pp.pending)
+        for ctx in pp.spans:
+            _spans.mark(ctx, "collect")
         return pp
 
     def publish_finish(self, pp: "PendingPublish") -> List[int]:
@@ -381,6 +389,10 @@ class Broker:
                 if n == 0:
                     self.metrics.inc("messages.dropped.no_subscribers")
                     self.hooks.run("message.dropped", (msg, "no_subscribers"))
+            # delivery-plane hand-off boundary: batches built, shards
+            # (or the inline flush below) take over the wire movement
+            for ctx in pp.spans:
+                _spans.mark(ctx, "enqueue")
             self._flush_deliveries(sink)
         return pp.results
 
@@ -411,11 +423,16 @@ class Broker:
 
     def _prepare_publish(
         self, msgs: Sequence[Message]
-    ) -> Tuple[List[Tuple[int, Message]], List[int]]:
-        """Hook + retain stage; returns the accepted (index, msg) list."""
+    ) -> Tuple[List[Tuple[int, Message]], List[int], List[object]]:
+        """Hook + retain stage; returns the accepted (index, msg) list
+        plus any sampled span contexts (observe/spans.py: head-sampled
+        at ingress, the 'hooks' boundary closes on accept)."""
         todo: List[Tuple[int, Message]] = []
         results = [0] * len(msgs)
+        ticked: List[object] = []
+        sp_on = _spans.enabled()
         for i, msg in enumerate(msgs):
+            ctx = _spans.begin(msg.topic, msg.mid) if sp_on else None
             msg = self.hooks.run_fold("message.publish", (), msg)
             if msg is None or msg.headers.get("allow_publish") is False:
                 self.metrics.inc("messages.dropped")
@@ -424,8 +441,12 @@ class Broker:
             self.retainer.on_publish(msg)
             self.metrics.inc("messages.received")
             tp("publish_enter", topic=msg.topic, mid=msg.mid)
+            if ctx is not None:
+                msg.headers["__span"] = ctx
+                _spans.mark(ctx, "hooks")
+                ticked.append(ctx)
             todo.append((i, msg))
-        return todo, results
+        return todo, results, ticked
 
     def _match_dispatch(
         self, todo: List[Tuple[int, Message]], results: List[int]
@@ -576,6 +597,11 @@ class Broker:
             if fastn:
                 self.metrics.inc("packets.publish.sent", fastn)
                 self.metrics.inc("messages.sent", fastn)
+            if delivered and _spans.armed:
+                # the fast-cb lane bypasses Channel.deliver (the wire
+                # boundary's usual close point): close it here, once
+                # per broadcast, never per receiver
+                _spans.wire(dl)
         else:
             pair = (filt, msg)
             sget = sink.get

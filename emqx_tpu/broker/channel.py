@@ -22,6 +22,7 @@ from . import topic as topiclib
 from .access_control import ALLOW, AccessControl, ClientInfo, DENY, PUB, SUB
 from .broker import Broker
 from .message import Message, now_ms
+from ..observe import spans as _spans
 from .packet import PacketType, Property, ReasonCode, SubOpts
 from .delivery import scatter_template
 from .session import Session, SessionError
@@ -824,6 +825,12 @@ class Channel:
             acts = self._deliveries_out(self.session.deliver(delivers))
         if acts:
             self.out_cb(acts)
+        if _spans.armed:
+            # wire boundary: out_cb flushed this batch to the transport
+            # synchronously; the first receiver closes a sampled span's
+            # wire stage (observe/spans.py — one attribute-load bool
+            # test per flush batch when disarmed)
+            _spans.wire(delivers)
 
     def _scatter_deliver(
         self, delivers: List[Tuple[str, Message]]

@@ -122,6 +122,11 @@ class DeliveryPool:
     def shard_of(self, uid: int) -> int:
         return uid % self.workers
 
+    def queue_depths(self) -> List[int]:
+        """Per-shard queue depth snapshot (contention telemetry:
+        observe/contention.py gauges `deliver.queue_depth*`)."""
+        return [q.qsize() for q in self._queues]
+
     def submit(self, uid: int, cid: str, ch, delivers: List[Tuple]) -> bool:
         """Queue one connection's delivery batch on its shard; returns
         False when the pool is down or the shard is saturated — the

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from .. import fault as _fault
 from ..broker.broker import Broker
 from ..broker.message import Message
+from ..observe import spans as _spans
 from ..observe.tracepoints import tp as tracept
 from ..utils.replayq import ReplayQ
 from . import bpapi
@@ -122,6 +123,14 @@ def message_to_wire(msg: Message) -> Tuple[dict, bytes]:
         "props": {str(k): v for k, v in msg.properties.items()
                   if isinstance(v, (int, str, float, bool))},
     }
+    if _spans.enabled():
+        # sampled message-lifecycle span: carry the origin's ingress
+        # wall-clock so the REMOTE broker can close the cross-node
+        # forward leg (observe/spans.py; survives relays and the spool
+        # since it rides the frame header)
+        ctx = msg.headers.get("__span")
+        if ctx is not None:
+            header["span_t0"] = ctx.wall0
     return header, msg.payload
 
 
@@ -993,6 +1002,7 @@ class ClusterNode:
         group = header.pop("shared_group", None)
         filt = header.pop("shared_filt", None)
         replay = header.pop("replay", None)
+        span_t0 = header.pop("span_t0", None)
         mid = header.get("mid")
         if mid and header.get("qos", 0) >= 1:
             # exactly-once at this broker across spool replays/retries:
@@ -1018,6 +1028,13 @@ class ClusterNode:
             n = self.broker.dispatch_shared_forwarded(msg, group, filt)
         else:
             n = self.broker.dispatch_forwarded(msg)
+        if span_t0 is not None and _spans.enabled():
+            # close + report the cross-node leg HERE, exactly once per
+            # forwarded copy: dedup-dropped replays returned above, so
+            # an at-least-once spool replay still reports one leg
+            _spans.close_remote(span_t0, topic=msg.topic,
+                                mid=header.get("mid") or "",
+                                origin=peer, node=self.name)
         return {"n": n} if header.get("id") is not None else None
 
     # ------------------------------------------------------------ rpc plane

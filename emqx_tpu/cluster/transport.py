@@ -11,6 +11,14 @@ beyond the socket itself (frames are small and length-prefixed).
 Frame layout:  u32 len | u8 type | body
   JSON frames: body = utf-8 JSON
   FORWARD:     body = u16 hlen | JSON header | raw payload bytes
+
+The FORWARD header is an open JSON map; optional fields ride end to
+end through relays and the forward spool without a frame-format bump —
+`relay_to` (core relay target), `shared_group`/`shared_filt` (targeted
+shared delivery), `replay` (spool-replay dedup hint), and `span_t0`
+(message-lifecycle span context: origin publish-ingress wall clock, so
+the remote broker closes and reports the cross-node latency leg —
+observe/spans.py).
 """
 
 from __future__ import annotations

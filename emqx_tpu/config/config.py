@@ -426,6 +426,29 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
                  "delay|drop|error|corrupt; fields: p, delay, times, "
                  "after)"),
     },
+    "observe": {
+        # message-lifecycle span plane + contention telemetry
+        # (observe/spans.py, observe/contention.py)
+        "span_sample": Field(
+            "int", 64, min=0,
+            desc="head-sampling rate for message-lifecycle spans: 1/N "
+                 "publishes carry a span context stamped at every plane "
+                 "boundary (hooks/submit/collect/enqueue/wire + the "
+                 "cross-node forward and durable-log ds legs), deltas "
+                 "into mergeable log2 histograms with bucket-derived "
+                 "p50/p99/p999; 0 disarms the plane (every boundary "
+                 "back to one bool test, fault-plane discipline)"),
+        "span_keep": Field(
+            "int", 64, min=1,
+            desc="slowest-K completed span records kept (full per-stage "
+                 "waterfall) for tools/span_dump.py"),
+        "loop_probe_interval": Field(
+            "duration", 1.0,
+            desc="event-loop lag probe cadence: scheduled-vs-actual "
+                 "wakeup delta into an EWMA gauge + histogram "
+                 "(contention telemetry; GC pauses and queue-depth "
+                 "gauges ride the same monitor)"),
+    },
     "prometheus": {
         "enable": Field("bool", False),
         "push_gateway_server": Field("str", ""),

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..broker import topic as topiclib
 from ..broker.message import Message
+from ..observe import spans as _spans
 from ..observe.tracepoints import tp
 from ..ops.hashing import word_hash64
 from .buffer import WriteBuffer
@@ -101,6 +102,14 @@ class DsManager:
            mid=msg.mid)
         if self.metrics is not None:
             self.metrics.inc("ds.appends")
+        if _spans.enabled():
+            # parked-session leg: the durable append closes a sampled
+            # span (observe/spans.py "ds" stage) — the offline analog
+            # of the wire-flush boundary
+            ctx = msg.headers.get("__span")
+            if ctx is not None:
+                _spans.mark(ctx, "ds")
+                _spans.finish(ctx)
         return shard, off
 
     def on_offline_publish(self, msg: Message) -> None:

@@ -1084,6 +1084,18 @@ class TopicMatchEngine:
         p.pipe_depth = self.pipeline_depth
         return p
 
+    @property
+    def inflight_ticks(self) -> int:
+        """Submitted-but-uncollected ticks right now (contention
+        telemetry: dispatch-window occupancy gauge)."""
+        return self._inflight_n
+
+    @property
+    def delta_backlog(self) -> int:
+        """Churn-delta slots awaiting the next device sync (contention
+        telemetry: churn backlog gauge)."""
+        return len(self.tables.delta.slots)
+
     def _deep_hits(self, topics: Sequence[str]) -> Optional[List[Set[int]]]:
         """Deep-filter matches, computed AT SUBMIT on the caller's thread:
         collect may run on an executor thread while subscribes mutate the

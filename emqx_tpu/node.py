@@ -358,6 +358,22 @@ class NodeRuntime:
             self.event_message.install(self.broker.hooks)
 
         # ---- observability (1.13) ---------------------------------------
+        # message-lifecycle span plane (observe/spans.py): head-sampled
+        # per-plane latency attribution, armed process-wide like the
+        # fault plane (observe.span_sample=0 disarms every boundary)
+        from .observe import spans as _spans
+
+        _spans.configure(
+            sample=int(self.conf.get("observe.span_sample")),
+            keep=int(self.conf.get("observe.span_keep")),
+        )
+        # contention telemetry (observe/contention.py): loop-lag probe +
+        # GC pause tracking + queue-depth gauges, started with the node
+        from .observe.contention import ContentionMonitor
+
+        self.contention = ContentionMonitor(
+            interval=float(self.conf.get("observe.loop_probe_interval"))
+        )
         self.stats = Stats(self.broker,
                            enable=bool(self.conf.get("stats.enable")))
         self.alarms = AlarmManager(self.broker, node=self.node_name)
@@ -372,6 +388,8 @@ class NodeRuntime:
             self.broker, stats=self.stats, node=self.node_name
         )
         self.monitor = MonitorSampler(self.broker)
+        # dashboard series get the loop-lag level alongside engine p99
+        self.monitor.contention = self.contention
         from .observe.exporters import ExporterRuntime
 
         self.exporters = ExporterRuntime(
@@ -556,7 +574,11 @@ class NodeRuntime:
         return self.broker.metrics.all()
 
     def _engine_histograms(self) -> Dict[str, Any]:
-        """Prometheus histogram table (observe/flight.py log2 buckets)."""
+        """Prometheus histogram table (observe/flight.py log2 buckets):
+        engine latencies + per-stage span histograms + contention
+        probes, all through the same NaN-skip exposition path."""
+        from .observe import spans as _spans
+
         e = self.broker.engine
         out: Dict[str, Any] = {}
         for name, attr in (
@@ -567,6 +589,9 @@ class NodeRuntime:
             h = getattr(e, attr, None)
             if h is not None:
                 out[name] = h
+        for stage, h in _spans.stage_histograms().items():
+            out[f"span_stage_{stage}_latency"] = h
+        out.update(self.contention.histograms())
         return out
 
     def _build_limiter(self) -> Optional[Limiter]:
@@ -861,6 +886,8 @@ class NodeRuntime:
             for name in self.gateways.list():
                 await self.gateways.lookup(name).start()
             await self.http.start()
+            # contention probes: loop-lag task + gc.callbacks tracker
+            self.contention.start()
             self._stop_evt = asyncio.Event()
             self._tick_task = asyncio.create_task(self._ticker())
             # separate task: a hung pushgateway (5s timeouts) must not
@@ -916,6 +943,7 @@ class NodeRuntime:
                     pass
         self._tick_task = None
         self._exporter_task = None
+        await self.contention.stop()
         await self.http.stop()
         for name in self.gateways.list():
             try:
@@ -991,6 +1019,12 @@ class NodeRuntime:
             try:
                 now = asyncio.get_running_loop().time()
                 self.delayed.tick()
+                # queue-depth / loop-lag / gc gauges land in the
+                # metrics table before the monitor samples them
+                self.contention.sample(
+                    self.broker, delivery=self.delivery_pool,
+                    batcher=self.batcher,
+                )
                 self.monitor.tick()
                 self._refresh_stats()
                 self._poll_health_alarms()

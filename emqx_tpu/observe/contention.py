@@ -1,0 +1,174 @@
+"""Contention telemetry: loop lag, queue depths, GC pauses.
+
+The span plane (`observe/spans.py`) says where a message spent its
+time; this module says WHY the slow stages were slow — the three
+whole-process contention sources per-plane benches hide:
+
+* **event-loop lag** (`LoopLagProbe`): an asyncio task sleeps a fixed
+  interval and measures scheduled-vs-actual wakeup delta.  Any
+  loop-blocking work (a long dispatch, a mis-threaded fsync, GC) shows
+  up as lag, EWMA-smoothed for gauges and bucketed in the shared log2
+  histogram for p99/p999 — the single most honest "is the loop
+  healthy" number a one-loop broker has.
+* **queue depths** (`ContentionMonitor.sample`): delivery-shard queue
+  depth, publish-batcher in-flight ticks, engine dispatch-window
+  occupancy and churn-delta backlog, exported as gauges through the
+  existing metrics table (Prometheus / `$SYS` / monitor ride along).
+* **GC pauses** (`GcPauseTracker`): `gc.callbacks` start/stop deltas —
+  the collector stops every thread in this runtime, so a gen-2 sweep
+  is invisible to per-stage timing yet inflates every p99 at once.
+
+Everything here is observation-only: probes never touch broker state,
+and sampling runs from the node ticker on the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+import time
+from typing import Dict, Optional
+
+from .flight import LatencyHistogram
+
+
+class LoopLagProbe:
+    """Scheduled-vs-actual tick delta of the running event loop."""
+
+    def __init__(self, interval: float = 1.0):
+        self.interval = max(0.01, float(interval))
+        self.hist = LatencyHistogram()
+        self.ewma_s = 0.0
+        self.samples = 0
+        self.max_lag_s = 0.0
+        self._task: Optional[asyncio.Task] = None
+
+    def note(self, lag_s: float) -> None:
+        """Fold one observed lag sample (probe task or tests)."""
+        lag_s = max(0.0, lag_s)
+        self.hist.observe(lag_s)
+        self.samples += 1
+        self.ewma_s = (
+            lag_s if self.samples == 1
+            else 0.8 * self.ewma_s + 0.2 * lag_s
+        )
+        if lag_s > self.max_lag_s:
+            self.max_lag_s = lag_s
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(self.interval)
+            self.note(loop.time() - t0 - self.interval)
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._run()
+            )
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+class GcPauseTracker:
+    """Cyclic-GC pause accounting via `gc.callbacks`.
+
+    Collections run with the GIL held on whichever thread triggered
+    them, and callbacks fire start/stop in pairs on that thread, so the
+    single `_t0` slot cannot interleave; a torn sample under reentrancy
+    would skew one histogram bucket, never break the tracker."""
+
+    def __init__(self):
+        self.hist = LatencyHistogram()
+        self.pauses = 0  # analysis: owner=any
+        self.max_pause_s = 0.0  # analysis: owner=any
+        self._t0: Optional[float] = None  # analysis: owner=any
+        self._installed = False
+
+    def _cb(self, phase: str, info: Dict) -> None:
+        if phase == "start":
+            self._t0 = time.perf_counter()
+        elif self._t0 is not None:
+            dt = time.perf_counter() - self._t0
+            self._t0 = None
+            self.hist.observe(dt)
+            self.pauses += 1
+            if dt > self.max_pause_s:
+                self.max_pause_s = dt
+
+    def install(self) -> None:
+        if not self._installed:
+            gc.callbacks.append(self._cb)
+            self._installed = True
+
+    def uninstall(self) -> None:
+        if self._installed:
+            try:
+                gc.callbacks.remove(self._cb)
+            except ValueError:
+                pass
+            self._installed = False
+
+
+class ContentionMonitor:
+    """Composition root: loop-lag probe + GC tracker + gauge sampling.
+
+    Built by the node, started/stopped with it; `sample()` runs from
+    the node ticker and lands the queue-depth gauges in the broker's
+    metrics table so every existing export path picks them up."""
+
+    def __init__(self, interval: float = 1.0):
+        self.probe = LoopLagProbe(interval=interval)
+        self.gc = GcPauseTracker()
+
+    def start(self) -> None:
+        self.gc.install()
+        self.probe.start()
+
+    async def stop(self) -> None:
+        await self.probe.stop()
+        self.gc.uninstall()
+
+    def sample(self, broker, delivery=None, batcher=None) -> None:
+        g = broker.metrics.gauge_set
+        g("contention.loop_lag_ms", self.probe.ewma_s * 1e3)
+        if self.probe.hist.count:
+            g("contention.loop_lag_p99_ms",
+              self.probe.hist.quantile(0.99) * 1e3)
+        g("contention.gc_pauses", self.gc.pauses)
+        g("contention.gc_pause_max_ms", self.gc.max_pause_s * 1e3)
+        if delivery is not None:
+            depths = delivery.queue_depths()
+            g("deliver.queue_depth", max(depths, default=0))
+            g("deliver.queue_depth_total", sum(depths))
+        if batcher is not None:
+            g("engine.tick_backlog", batcher.inflight_ticks)
+        e = broker.engine
+        g("engine.inflight_ticks", getattr(e, "inflight_ticks", 0))
+        g("engine.delta_backlog", getattr(e, "delta_backlog", 0))
+
+    def histograms(self) -> Dict[str, LatencyHistogram]:
+        """Prometheus exposition source (node `hists_fn`)."""
+        return {"loop_lag": self.probe.hist, "gc_pause": self.gc.hist}
+
+    def summary(self) -> Dict:
+        out = {
+            "loop_lag_ewma_ms": round(self.probe.ewma_s * 1e3, 4),
+            "loop_lag_max_ms": round(self.probe.max_lag_s * 1e3, 4),
+            "loop_lag_samples": self.probe.samples,
+            "gc_pauses": self.gc.pauses,
+            "gc_pause_max_ms": round(self.gc.max_pause_s * 1e3, 4),
+        }
+        if self.probe.hist.count:
+            out["loop_lag_ms"] = self.probe.hist.percentiles_ms()
+        if self.gc.hist.count:
+            out["gc_pause_ms"] = self.gc.hist.percentiles_ms()
+        return out

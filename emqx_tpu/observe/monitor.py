@@ -23,6 +23,15 @@ COUNTER_FIELDS = {
     # dashboard draws match ticks/s and arbitration flips/interval
     "engine_ticks": "engine.ticks",
     "engine_flips": "engine.path_flips",
+    # parallel churn plane: shed ops/interval (demand past capacity)
+    "engine_churn_shed": "engine.churn_shed",
+    # delivery plane: shared packet-prefix cache traffic + per-tick
+    # batched deliveries (build-once/scatter effectiveness)
+    "prefix_hits": "deliver.prefix.hit",
+    "prefix_misses": "deliver.prefix.miss",
+    "delivered_batched": "messages.delivered.batched",
+    # durable message log: parked-session appends/interval
+    "ds_appends": "ds.appends",
 }
 
 
@@ -35,6 +44,9 @@ class MonitorSampler:
         self.samples: Deque[Dict] = deque(maxlen=retention)
         self._last_counters: Optional[Dict[str, int]] = None
         self._next_at = self._align(time.time())
+        # contention monitor (observe/contention.py), wired by the node:
+        # adds the loop-lag level to every sample when present
+        self.contention = None
 
     def _align(self, now: float) -> float:
         """Whole-interval boundaries like the reference's next_interval."""
@@ -65,6 +77,11 @@ class MonitorSampler:
         h = getattr(getattr(self.broker, "engine", None), "hist_tick", None)
         if h is not None and h.count:
             s["engine_p99_ms"] = round(h.quantile(0.99) * 1e3, 3)
+        # level: event-loop lag EWMA (observe/contention.py probe)
+        if self.contention is not None:
+            s["loop_lag_ms"] = round(
+                self.contention.probe.ewma_s * 1e3, 3
+            )
         self.samples.append(s)
         return s
 

@@ -1,0 +1,328 @@
+"""Message-lifecycle span plane: per-plane latency attribution.
+
+Every bench row isolates one plane; production latency is the SUM of
+planes, and "where did this message spend its 11 ms" needs stage
+attribution that survives the batched publish pipeline and a cross-node
+forward.  This module stamps a span context on a head-sampled fraction
+of publishes at ingress and records one monotonic timestamp per plane
+boundary; the per-stage deltas land in the same mergeable log2
+histograms the flight recorder uses (`observe/flight.py` bucket
+discipline), so stage p50/p99/p999 derive from buckets and one
+implementation serves Prometheus, `$SYS`, `bench.py --spans` and
+`tools/span_dump.py`.
+
+Stages (KNOWN_STAGES is the registry the static-analysis gate lints
+both ways, like tracepoint kinds and fault sites):
+
+    hooks    publish ingress -> 'message.publish' hooks + authz fold +
+             retain accepted the message into the tick
+    submit   accept -> churn/match dispatch submitted (includes the
+             cluster forward fan-out, which rides _pre_match)
+    collect  submit -> device/host match collected (the executor-thread
+             half of the three-phase publish)
+    enqueue  collect -> fid expansion done, per-connection batches
+             handed to the delivery plane
+    wire     enqueue -> FIRST receiver's action batch flushed to its
+             transport (later receivers of the same copy don't re-close
+             the stage)
+    forward  cross-node leg: origin publish ingress -> the REMOTE
+             broker dispatched the forwarded copy.  The span context
+             rides the cluster FORWARD frame header (wall-clock t0 —
+             same-host clock domain; cross-host skew is the usual
+             distributed-tracing caveat) and the remote broker closes
+             and reports the leg exactly once (replayed/relayed dups
+             are dedup-dropped before the close).
+    ds       offline leg: dispatch -> durable-log append (parked
+             persistent-session traffic; closes the span, so a copy
+             that is both delivered live and parked attributes its
+             tail to whichever leg lands first)
+
+Sampling is head-based: ONE decision per message at ingress
+(``observe.span_sample`` = N means 1/N publishes carry a span; 0
+disarms).  Disarmed, every boundary is one module-bool test away from
+returning — the fault-plane discipline — so the hot path pays nothing
+until the plane is armed.  Marks are stage-idempotent (first arrival
+wins) and tolerate the collect mark landing on an executor thread: a
+mark is a list append + one histogram bucket add, lossy-telemetry safe
+under the GIL.
+
+Completed spans feed two bounded record stores: a recent ring and a
+slowest-K keep (``observe.span_keep``) rendered by
+``tools/span_dump.py`` — the tail records are the "where did the slow
+one go" answer the histograms can't give.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .flight import LatencyHistogram
+
+# Every stage recorded by this plane (spans.mark(ctx, "<stage>") /
+# plane.observe_stage("<stage>", dt) in production code) MUST be
+# declared here, and every declared stage must be recorded somewhere —
+# the static-analysis gate (`tools/analysis/registry.py`) lints both
+# directions, the same contract as tracepoint KNOWN_KINDS / fault SITES.
+KNOWN_STAGES: Dict[str, str] = {
+    "hooks": "ingress -> publish hooks/authz/retain accepted",
+    "submit": "accept -> churn/match dispatch submitted (incl. cluster "
+              "forward fan-out)",
+    "collect": "submit -> device/host match collected",
+    "enqueue": "collect -> delivery batches handed to the delivery plane",
+    "wire": "enqueue -> first receiver's frames flushed to the transport",
+    "forward": "origin ingress -> remote broker dispatched the "
+               "forwarded copy (cross-node leg)",
+    "ds": "dispatch -> durable-log append (parked-session leg)",
+}
+
+_RECENT = 256  # completed-span ring (newest-first render)
+
+
+class SpanContext:
+    """One sampled message's lifecycle: monotonic t0 + stage deltas.
+
+    ``wall0`` (time.time at ingress) is what rides a cluster-forward
+    frame so the remote broker can close the cross-node leg without a
+    shared monotonic clock."""
+
+    __slots__ = ("topic", "mid", "t0", "wall0", "last", "stages",
+                 "seen", "finished")
+
+    def __init__(self, topic: str, mid: bytes):
+        now = time.perf_counter()
+        self.topic = topic
+        self.mid = mid
+        self.t0 = now
+        self.wall0 = time.time()
+        self.last = now
+        self.stages: List[Tuple[str, float]] = []  # (stage, delta_s)
+        self.seen: set = set()
+        self.finished = False
+
+    def record(self) -> Dict:
+        return {
+            "topic": self.topic,
+            "mid": self.mid.hex() if self.mid else "",
+            "ts": self.wall0,
+            "total_ms": (self.last - self.t0) * 1e3,
+            "stages": {s: round(d * 1e3, 4) for s, d in self.stages},
+        }
+
+
+class SpanPlane:
+    """Stage histograms + bounded completed-span record stores."""
+
+    def __init__(self, sample: int = 0, keep: int = 64):
+        self.sample = max(0, int(sample))  # 1/N; 0 = disarmed
+        self.keep = max(1, int(keep))
+        self.hists: Dict[str, LatencyHistogram] = {
+            s: LatencyHistogram() for s in KNOWN_STAGES
+        }
+        self.hist_total = LatencyHistogram()
+        # sampling decision runs on the publish ingress (loop) thread;
+        # marks may land from the collect executor — counters are lossy
+        # telemetry under the GIL (flight-recorder discipline)
+        self.started = 0  # analysis: owner=any
+        self.completed = 0  # analysis: owner=any
+        self.remote_closed = 0  # analysis: owner=any
+        self._n = 0  # head-sampling stride counter  # analysis: owner=loop
+        self._lock = threading.Lock()
+        self._recent: deque = deque(maxlen=_RECENT)
+        self._slow: List[Tuple[float, int, Dict]] = []  # min-heap by total
+        self._slow_seq = 0
+
+    # ------------------------------------------------------------ hot path
+
+    def begin(self, topic: str, mid: bytes) -> Optional[SpanContext]:
+        """The one head-sampling decision, at publish ingress."""
+        if not self.sample:
+            return None
+        self._n += 1
+        if self._n % self.sample:
+            return None
+        self.started += 1
+        return SpanContext(topic, mid)
+
+    def observe_stage(self, stage: str, delta_s: float) -> None:
+        self.hists[stage].observe(delta_s)
+
+    # ----------------------------------------------------------- records
+
+    def complete(self, ctx: SpanContext) -> None:
+        self.completed += 1
+        self.hist_total.observe(ctx.last - ctx.t0)
+        rec = ctx.record()
+        with self._lock:
+            self._recent.append(rec)
+            self._slow_seq += 1
+            item = (rec["total_ms"], self._slow_seq, rec)
+            if len(self._slow) < self.keep:
+                heapq.heappush(self._slow, item)
+            elif rec["total_ms"] > self._slow[0][0]:
+                heapq.heapreplace(self._slow, item)
+
+    def close_remote(self, t0_wall: float, topic: str, mid: str,
+                     origin: str, node: str) -> None:
+        """Remote side of a forwarded span: close the cross-node leg."""
+        dt = max(0.0, time.time() - t0_wall)
+        self.observe_stage("forward", dt)
+        self.remote_closed += 1
+        rec = {
+            "topic": topic, "mid": mid, "ts": t0_wall,
+            "total_ms": dt * 1e3,
+            "stages": {"forward": round(dt * 1e3, 4)},
+            "origin": origin, "node": node,
+        }
+        with self._lock:
+            self._recent.append(rec)
+            self._slow_seq += 1
+            item = (rec["total_ms"], self._slow_seq, rec)
+            if len(self._slow) < self.keep:
+                heapq.heappush(self._slow, item)
+            elif rec["total_ms"] > self._slow[0][0]:
+                heapq.heapreplace(self._slow, item)
+
+    # ------------------------------------------------------------ queries
+
+    def stage_counts(self) -> Dict[str, int]:
+        return {s: h.count for s, h in self.hists.items()}
+
+    def percentiles(self) -> Dict[str, Dict[str, float]]:
+        """Bucket-derived per-stage {count, p50/p99/p999 ms}."""
+        out: Dict[str, Dict[str, float]] = {}
+        for s, h in self.hists.items():
+            row = {"count": h.count}
+            if h.count:
+                row.update(h.percentiles_ms())
+            out[s] = row
+        return out
+
+    def summary(self) -> Dict:
+        """The `$SYS/brokers/<node>/spans` payload."""
+        out = {
+            "sample": self.sample,
+            "keep": self.keep,
+            "started": self.started,
+            "completed": self.completed,
+            "remote_closed": self.remote_closed,
+            "stages": self.percentiles(),
+        }
+        if self.hist_total.count:
+            out["total_ms"] = self.hist_total.percentiles_ms()
+        return out
+
+    def slowest(self) -> List[Dict]:
+        """Slowest-K completed spans, slowest first (copies)."""
+        with self._lock:
+            return [rec for _t, _i, rec in
+                    sorted(self._slow, reverse=True)]
+
+    def recent(self, k: int = 32) -> List[Dict]:
+        with self._lock:
+            return list(self._recent)[-k:]
+
+    def export(self) -> Dict:
+        """Full JSON-safe dump (bench emit-stats / span_dump input)."""
+        return {
+            **self.summary(),
+            "slowest": self.slowest(),
+            "recent": self.recent(),
+        }
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.export(), f)
+
+
+# -------------------------------------------------- module-level fast path
+
+_plane = SpanPlane()
+# fast-path gate: every boundary is one module-attribute bool test when
+# disarmed.  Hot call sites read `spans.armed` directly (an attribute
+# load, no call frame); `enabled()` is the same flag behind a function
+# for cold paths and tests.
+armed = False
+
+
+def configure(sample: int = 64, keep: int = 64) -> None:
+    """Arm the plane at 1/`sample` head-sampling (0 disarms)."""
+    global _plane, armed
+    _plane = SpanPlane(sample=sample, keep=keep)
+    armed = sample > 0
+
+
+def disable() -> None:
+    global armed
+    armed = False
+
+
+def enabled() -> bool:
+    return armed
+
+
+def plane() -> SpanPlane:
+    return _plane
+
+
+def begin(topic: str, mid: bytes) -> Optional[SpanContext]:
+    """Sampling decision at publish ingress; None = not sampled.
+    Callers should gate on `enabled()` first (hot loop)."""
+    if not armed:
+        return None
+    return _plane.begin(topic, mid)
+
+
+def mark(ctx: Optional[SpanContext], stage: str) -> None:
+    """Stamp one plane boundary: the delta since the previous mark
+    lands in `stage`'s histogram.  Stage-idempotent (first arrival
+    wins); no-op on finished/unsampled contexts."""
+    if ctx is None or ctx.finished or stage in ctx.seen:
+        return
+    now = time.perf_counter()
+    delta = now - ctx.last
+    ctx.last = now
+    ctx.seen.add(stage)
+    ctx.stages.append((stage, delta))
+    _plane.observe_stage(stage, delta)
+
+
+def finish(ctx: Optional[SpanContext]) -> None:
+    """Close the span and record it (recent ring + slowest-K keep)."""
+    if ctx is None or ctx.finished:
+        return
+    ctx.finished = True
+    _plane.complete(ctx)
+
+
+def wire(delivers: Sequence[Tuple[str, object]]) -> None:
+    """Wire-flush boundary: close the wire stage for any sampled
+    message in this flushed delivery batch (first flush wins).  Called
+    per connection-batch, never per receiver, so the armed cost stays
+    off the per-delivery hot loop."""
+    if not armed:
+        return
+    for _filt, msg in delivers:
+        ctx = msg.headers.get("__span")
+        if ctx is not None:
+            mark(ctx, "wire")
+            finish(ctx)
+
+
+def close_remote(t0_wall: float, topic: str = "", mid: str = "",
+                 origin: str = "", node: str = "") -> None:
+    """Remote broker closes a forwarded span's cross-node leg (called
+    after the forwarded copy dispatched; dedup-dropped replays never
+    reach this, so the leg reports exactly once)."""
+    if not armed:
+        return
+    _plane.close_remote(t0_wall, topic, mid, origin, node)
+
+
+def stage_histograms() -> Dict[str, LatencyHistogram]:
+    """Prometheus exposition source: stage name -> histogram."""
+    return dict(_plane.hists)

@@ -65,6 +65,12 @@ class SysHeartbeat:
             from .flight import engine_summary
 
             self._pub("engine", engine_summary(engine))
+        from . import spans as _spans
+
+        if _spans.enabled():
+            # per-plane latency attribution rides the same cadence:
+            # `$SYS/brokers/<node>/spans` = stage p50/p99/p999 + counts
+            self._pub("spans", _spans.plane().summary())
 
 
 class OsMon:

@@ -1356,6 +1356,13 @@ class ShardedMatchEngine:
         return len(self._inflight)
 
     @property
+    def delta_backlog(self) -> int:
+        """Churn-delta slots awaiting the next device sync, summed over
+        the device shards (contention telemetry: churn backlog gauge —
+        same contract as the single-chip engine's property)."""
+        return sum(len(s.delta.slots) for s in self.shards)
+
+    @property
     def effective_depth(self) -> int:
         """The adaptively clamped in-flight window bound (<= the
         configured pipeline_depth)."""

@@ -868,6 +868,50 @@ def test_registry_cross_check_both_directions(tmp_path):
     assert [f.ident for f in by_code["metric-dead"]] == ["a.dead"]
 
 
+def test_span_stage_registry_both_directions(tmp_path):
+    """Span stages (observe/spans.py KNOWN_STAGES) are linted both
+    ways like tracepoints: an unregistered recorded stage and a
+    declared-but-never-recorded stage are both errors."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/observe/spans.py": (
+            "KNOWN_STAGES = {'hooks': 'd', 'dead_stage': 'd'}\n"
+            "def mark(ctx, stage):\n"
+            "    pass\n"
+        ),
+        "emqx_tpu/pipeline_fixture.py": (
+            "from .observe import spans\n"
+            "def f(ctx):\n"
+            "    spans.mark(ctx, 'hooks')\n"
+            "    spans.mark(ctx, 'ghost')\n"
+        ),
+    })
+    findings = registry.check_span_stages(idx)
+    codes = {(f.code, f.ident) for f in findings}
+    assert ("span-unregistered", "ghost") in codes
+    assert ("span-dead", "dead_stage") in codes
+    assert all(f.severity == ERROR for f in findings)
+    assert len(findings) == 2  # 'hooks' is clean both ways
+
+
+def test_span_stage_nonliteral_is_error(tmp_path):
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/observe/spans.py": (
+            "KNOWN_STAGES = {'hooks': 'd'}\n"
+            "def mark(ctx, stage):\n"
+            "    pass\n"
+        ),
+        "emqx_tpu/pipeline_fixture.py": (
+            "from .observe import spans\n"
+            "def f(ctx, st):\n"
+            "    spans.mark(ctx, 'hooks')\n"
+            "    spans.mark(ctx, st)\n"
+        ),
+    })
+    nonlit = [f for f in registry.check_span_stages(idx)
+              if f.code == "span-nonliteral"]
+    assert len(nonlit) == 1 and nonlit[0].severity == ERROR
+
+
 def test_unregistered_tracepoint_is_error(tmp_path):
     files = dict(REG_FILES)
     files["emqx_tpu/app.py"] = files["emqx_tpu/app.py"].replace(

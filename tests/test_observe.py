@@ -244,6 +244,69 @@ def test_prometheus_histogram_exposition():
     assert cums == sorted(cums) and cums[-1] == 4
 
 
+def test_prometheus_span_and_contention_exposition():
+    """Per-stage span histograms + contention gauges ride the existing
+    exposition path with the NaN-skip discipline (ISSUE 11)."""
+    from emqx_tpu.observe import spans
+    from emqx_tpu.observe.contention import ContentionMonitor
+
+    spans.configure(sample=1, keep=4)
+    try:
+        b = Broker()
+        attach(b, "c1", "sp/#")
+        b.publish(Message(topic="sp/1", payload=b"x"))
+        mon = ContentionMonitor()
+        mon.probe.note(0.002)
+        mon.sample(b)
+        b.metrics.gauge_set("bad.gauge", float("nan"))  # NaN-skip check
+        hists = {
+            f"span_stage_{s}_latency": h
+            for s, h in spans.stage_histograms().items()
+        }
+        hists.update(mon.histograms())
+        out = render_prometheus(b.metrics.counters, b.metrics.gauges,
+                                hists)
+        assert "# TYPE emqx_span_stage_collect_latency histogram" in out
+        assert 'emqx_span_stage_hooks_latency_bucket{le="+Inf"} 1' in out
+        assert "emqx_span_stage_collect_latency_count 1" in out
+        # unsampled stages still expose a well-formed empty histogram
+        assert 'emqx_span_stage_forward_latency_bucket{le="+Inf"} 0' \
+            in out
+        assert "# TYPE emqx_loop_lag histogram" in out
+        assert "# TYPE emqx_gc_pause histogram" in out
+        assert "emqx_contention_loop_lag_ms" in out
+        assert "bad_gauge" not in out  # NaN skipped, payload not poisoned
+    finally:
+        spans.disable()
+
+
+def test_monitor_sampler_covers_new_plane_counters():
+    """MonitorSampler COUNTER_FIELDS covers the PR 6-9 planes (churn
+    shed, prefix cache, batched deliveries, ds appends) and carries the
+    loop-lag level when the contention monitor is wired."""
+    from emqx_tpu.observe.contention import ContentionMonitor
+    from emqx_tpu.observe.monitor import COUNTER_FIELDS, MonitorSampler
+
+    assert {"engine_churn_shed", "prefix_hits", "prefix_misses",
+            "delivered_batched", "ds_appends"} <= set(COUNTER_FIELDS)
+    b = Broker()
+    attach(b, "c1", "m/#")
+    ms = MonitorSampler(b)
+    ms.sample_now()
+    b.publish(Message(topic="m/1", payload=b"x"))
+    b.metrics.inc("ds.appends", 3)
+    s = ms.sample_now()
+    assert s["received"] == 1 and s["ds_appends"] == 3
+    for k in ("engine_churn_shed", "prefix_hits", "prefix_misses",
+              "delivered_batched"):
+        assert k in s, k
+    assert "loop_lag_ms" not in s  # not wired yet
+    ms.contention = ContentionMonitor()
+    ms.contention.probe.note(0.004)
+    s2 = ms.sample_now()
+    assert s2["loop_lag_ms"] == pytest.approx(4.0, rel=0.01)
+
+
 def test_prometheus_push_failure_counter(monkeypatch):
     from emqx_tpu.observe import exporters as ex
 

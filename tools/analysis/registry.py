@@ -25,6 +25,10 @@ name-registry the broker keys runtime behavior on:
   ownership rule from check #7's sibling.
 * **fault sites**: injected => registered in SITES (old check #6);
   registered-but-never-injected is reported as a warning.
+* **span stages**: every stage the message-lifecycle span plane
+  records (`spans.mark(ctx, "<stage>")` / `observe_stage("<stage>",
+  dt)`) must be declared in `observe/spans.py` KNOWN_STAGES and every
+  declared stage must be recorded somewhere — both directions error.
 """
 
 from __future__ import annotations
@@ -40,6 +44,7 @@ CONFIG_PATH = os.path.join("emqx_tpu", "config", "config.py")
 TRACEPOINTS_PATH = os.path.join("emqx_tpu", "observe", "tracepoints.py")
 METRICS_PATH = os.path.join("emqx_tpu", "broker", "metrics.py")
 SITES_PATH = os.path.join("emqx_tpu", "fault", "sites.py")
+SPANS_PATH = os.path.join("emqx_tpu", "observe", "spans.py")
 
 # retained.* tracepoints are owned by exactly these two modules (the
 # retained device-index plane, ISSUE 7)
@@ -84,6 +89,10 @@ def known_tp_kinds(idx: ProjectIndex) -> Set[str]:
 
 def known_fault_sites(idx: ProjectIndex) -> Set[str]:
     return _module_dict_keys(idx, SITES_PATH, "SITES") or set()
+
+
+def known_span_stages(idx: ProjectIndex) -> Set[str]:
+    return _module_dict_keys(idx, SPANS_PATH, "KNOWN_STAGES") or set()
 
 
 def schema_keys(idx: ProjectIndex) -> Dict[str, Set[str]]:
@@ -283,6 +292,49 @@ def collect_fault_calls(idx: ProjectIndex,
     return out
 
 
+def collect_span_marks(idx: ProjectIndex,
+                       package_prefix: str = "emqx_tpu"):
+    """(rel, lineno, stage|None) for every span-stage record point:
+    `spans.mark(ctx, "<stage>")` / `_spans.mark(ctx, "<stage>")`
+    anywhere in the package, plus the plane's own literal record points
+    inside observe/spans.py (bare `mark(ctx, "<stage>")` and
+    `observe_stage("<stage>", dt)` — the wire/forward stages close
+    there).  A non-literal stage collects as None; spans.py's internal
+    plumbing (the generic `observe_stage(stage, ...)` forward inside
+    `mark`) is exempt from the literal requirement."""
+    out = []
+    for rel, fi in idx.files.items():
+        if fi.tree is None or not fi.module.startswith(package_prefix):
+            continue
+        in_spans = rel == SPANS_PATH
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else None
+            )
+            if name == "mark":
+                if isinstance(fn, ast.Attribute):
+                    if not (isinstance(fn.value, ast.Name)
+                            and fn.value.id in ("spans", "_spans")):
+                        continue
+                elif not in_spans:
+                    continue  # unrelated bare mark() elsewhere
+                if len(node.args) >= 2:
+                    out.append((rel, node.lineno, _literal_str(
+                        idx, fi.module, node.args[1]
+                    )))
+            elif name == "observe_stage" and node.args:
+                stage = _literal_str(idx, fi.module, node.args[0])
+                if stage is None and in_spans:
+                    continue  # mark()'s generic forward, by design
+                out.append((rel, node.lineno, stage))
+    return out
+
+
 def _collect_named_calls(idx: ProjectIndex, attrs: Set[str],
                          package_prefix: str = "emqx_tpu"):
     """(rel, lineno, attr, name) for `<x>.<attr>("<name>")` calls."""
@@ -442,6 +494,59 @@ def check_fault_sites(idx: ProjectIndex) -> List[Finding]:
     return findings
 
 
+def check_span_stages(idx: ProjectIndex) -> List[Finding]:
+    """Span-stage registry, both directions (the tracepoint/fault-site
+    contract): every stage recorded by the span plane must be declared
+    in observe/spans.py KNOWN_STAGES, and every declared stage must be
+    recorded somewhere — a dead stage is a latency column dashboards
+    key on that can never fill."""
+    findings: List[Finding] = []
+    marks = collect_span_marks(idx)
+    known = known_span_stages(idx)
+    if marks and not known:
+        findings.append(Finding(
+            code="span-registry", severity=ERROR, path=SPANS_PATH,
+            line=1, message="KNOWN_STAGES registry missing",
+            ident="KNOWN_STAGES",
+        ))
+        return findings
+    used: Set[str] = set()
+    for rel, line, stage in marks:
+        if stage is None:
+            findings.append(Finding(
+                code="span-nonliteral", severity=ERROR, path=rel,
+                line=line,
+                message=(
+                    "span stage record with a non-literal stage name "
+                    "(the registry lint needs a string literal)"
+                ),
+                ident=f"{rel}:nonliteral",
+            ))
+            continue
+        used.add(stage)
+        if stage not in known:
+            findings.append(Finding(
+                code="span-unregistered", severity=ERROR, path=rel,
+                line=line,
+                message=(
+                    f"span stage {stage!r} not declared in "
+                    "observe/spans.py KNOWN_STAGES"
+                ),
+                ident=stage,
+            ))
+    for stage in sorted(known - used):
+        findings.append(Finding(
+            code="span-dead", severity=ERROR, path=SPANS_PATH, line=1,
+            message=(
+                f"span stage {stage!r} is declared but never recorded "
+                "by any production code path — remove the declaration "
+                "or record it"
+            ),
+            ident=stage,
+        ))
+    return findings
+
+
 def check_metrics(idx: ProjectIndex) -> List[Finding]:
     findings: List[Finding] = []
     declared = predefined_metrics(idx)
@@ -532,6 +637,7 @@ def check_registries(idx: ProjectIndex) -> List[Finding]:
     out.extend(check_config(idx))
     out.extend(check_tracepoints(idx))
     out.extend(check_fault_sites(idx))
+    out.extend(check_span_stages(idx))
     out.extend(check_metrics(idx))
     out.extend(check_alarms(idx))
     return out

@@ -1,0 +1,136 @@
+"""Human-readable dump of the message-lifecycle span plane.
+
+The span plane (`emqx_tpu/observe/spans.py`) head-samples publishes and
+stamps a monotonic timestamp at every plane boundary — hooks, submit,
+collect, enqueue, wire, the cross-node forward leg, the durable-log ds
+leg.  This tool renders two views from a JSON export
+(``SpanPlane.save(path)``, ``bench.py --spans --emit-stats``):
+
+* the per-stage attribution table — count and bucket-derived
+  p50/p99/p999 per stage ("where do messages spend their time");
+* the slowest-K span waterfalls — the full stage-by-stage record of
+  the tail messages the histograms can only hint at.
+
+From Python, call :func:`dump` on a live plane::
+
+    from emqx_tpu.observe import spans
+    from tools.span_dump import dump
+    print(dump(spans.plane().export()))
+
+Usage:
+    python tools/span_dump.py spans.json             # both views
+    python tools/span_dump.py spans.json --slow 16   # more tail spans
+    python tools/span_dump.py spans.json --recent    # recent ring too
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from emqx_tpu.observe.spans import KNOWN_STAGES  # noqa: E402
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{v:.3f}"
+
+
+def format_stages(export: dict) -> str:
+    """The per-stage attribution table (declared-stage order)."""
+    stages = export.get("stages") or {}
+    lines = [
+        f"spans: 1/{export.get('sample', '?')} sampled, "
+        f"{export.get('started', 0)} started, "
+        f"{export.get('completed', 0)} completed, "
+        f"{export.get('remote_closed', 0)} remote forward legs",
+        "",
+        f"{'stage':<9} {'count':>8} {'p50 ms':>10} {'p99 ms':>10} "
+        f"{'p999 ms':>10}",
+    ]
+    for stage in KNOWN_STAGES:
+        row = stages.get(stage) or {}
+        n = row.get("count", 0)
+        lines.append(
+            f"{stage:<9} {n:>8} "
+            f"{_ms(row.get('p50') if n else None):>10} "
+            f"{_ms(row.get('p99') if n else None):>10} "
+            f"{_ms(row.get('p999') if n else None):>10}"
+        )
+    total = export.get("total_ms")
+    if total:
+        lines.append(
+            f"{'total':<9} {export.get('completed', 0):>8} "
+            f"{_ms(total.get('p50')):>10} {_ms(total.get('p99')):>10} "
+            f"{_ms(total.get('p999')):>10}"
+        )
+    return "\n".join(lines)
+
+
+def _span_line(rec: dict) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(rec.get("ts", 0)))
+    waterfall = " ".join(
+        f"{stage}={rec['stages'][stage]:.3f}"
+        for stage in KNOWN_STAGES if stage in (rec.get("stages") or {})
+    )
+    origin = f" [{rec['origin']}->{rec['node']}]" if rec.get("origin") \
+        else ""
+    return (
+        f"{ts} {rec.get('total_ms', 0.0):>9.3f}ms "
+        f"{rec.get('topic', '?'):<28}{origin} {waterfall}"
+    )
+
+
+def format_slowest(export: dict, k: int = 8) -> str:
+    """Slowest-K span waterfalls, slowest first (per-stage ms)."""
+    recs = (export.get("slowest") or [])[:k]
+    if not recs:
+        return "no completed spans recorded"
+    return "\n".join(
+        ["slowest spans (per-stage ms):"]
+        + [f"  {_span_line(r)}" for r in recs]
+    )
+
+
+def format_recent(export: dict, k: int = 16) -> str:
+    recs = (export.get("recent") or [])[-k:]
+    if not recs:
+        return "no recent spans"
+    return "\n".join(
+        ["recent spans (oldest first):"]
+        + [f"  {_span_line(r)}" for r in recs]
+    )
+
+
+def dump(export: dict, slow: int = 8, recent: bool = False) -> str:
+    out = [format_stages(export), "", format_slowest(export, slow)]
+    if recent:
+        out += ["", format_recent(export)]
+    return "\n".join(out)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="render a span-plane JSON export"
+    )
+    ap.add_argument("path", help="JSON file from SpanPlane.save / "
+                                 "bench.py --spans --emit-stats")
+    ap.add_argument("--slow", type=int, default=8,
+                    help="tail spans to show (default 8)")
+    ap.add_argument("--recent", action="store_true",
+                    help="also print the recent-span ring")
+    ns = ap.parse_args()
+    with open(ns.path, "r", encoding="utf-8") as f:
+        export = json.load(f)
+    # bench exports nest the plane dump under "spans"
+    if "stages" not in export and "spans" in export:
+        export = export["spans"]
+    print(dump(export, slow=ns.slow, recent=ns.recent))
+
+
+if __name__ == "__main__":
+    main()
