@@ -3,7 +3,7 @@
 
 .PHONY: check check-json lint lint-fast lint-locks test test-fast \
         native bench restore-bench chaos ds-bench ds-dump ds-soak \
-        churn-bench retained-bench fanout-bench span-bench
+        churn-bench retained-bench fanout-bench span-bench prep-bench
 
 # static-analysis gate (tools/analysis/): the dialyzer/xref/elvis
 # analog, stdlib-only — whole-project AST index + call graph, thread-
@@ -97,3 +97,9 @@ ds-soak:
 # subprocess); writes the BENCH_TABLE.md churn-capacity section
 churn-bench:
 	python bench.py --churn
+
+# fused prep op in isolation: native etpu_prep_pack vs the python
+# fallback at B=512/2048 over the sharded workload's Zipf stream;
+# writes the BENCH_TABLE.md fused-prep section
+prep-bench:
+	python bench.py --sharded 2 --prep-only

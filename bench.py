@@ -763,31 +763,44 @@ def run_sharded(subs_cap=None, workload=2):
         eng.match(batches[i % 8])
 
     # phase breakdown (pure match path, no churn, lock-step so every
-    # phase is exposed): prep+dispatch = match_submit (native split+hash,
-    # packed staging upload, non-donating mesh dispatch), compute = the
-    # device wait, fetch = resolve (device->host of the live compact
-    # slice + any overflow refetch), verify = registry exact-check + row
-    # assembly.  In the pipelined loop below, compute overlaps the other
-    # three phases of neighboring ticks.
+    # phase is exposed).  PR 12 re-attribution: prep = the fused native
+    # prep sub-stages ONLY — hash (split+hash+memo+dedup), pack
+    # (staging-buffer gather+pad), submit (group assembly + device_put
+    # handoff) — while the mesh-execute call itself (which on a 1-core
+    # host runs synchronously INSIDE the pjit call and used to be
+    # lumped into "prep", mis-reading as a 7.6 ms prep blob) now lands
+    # in the dispatch column where it belongs.  fetch = resolve
+    # (device->host of the live compact slice + any overflow refetch),
+    # verify = registry exact-check + row assembly.
     prep_s = disp_s = fetch_s = verify_s = 0.0
+    ph_hash = ph_pack = ph_sub = 0.0
     PH_ITERS = 15
     for i in range(PH_ITERS):
         topics = batches[i % 8]
         p0 = time.perf_counter()
         pend = eng.match_submit(topics)
         p1 = time.perf_counter()
-        jax.block_until_ready((pend.hits, pend.counts))
+        g = pend.group
+        if g is not None and g.hits is not None:
+            jax.block_until_ready((g.hits, g.counts))
         p2 = time.perf_counter()
         eng._resolve(pend)
         p3 = time.perf_counter()
         eng.match_collect_raw(pend)
         p4 = time.perf_counter()
-        prep_s += p1 - p0
-        disp_s += p2 - p1
+        sub = pend.prep_hash_s + pend.prep_pack_s + pend.prep_put_s
+        ph_hash += pend.prep_hash_s
+        ph_pack += pend.prep_pack_s
+        ph_sub += pend.prep_put_s
+        prep_s += sub
+        disp_s += max(p1 - p0 - sub, 0.0) + (p2 - p1)
         fetch_s += p3 - p2
         verify_s += p4 - p3
     phases = {
         "prep_ms": prep_s / PH_ITERS * 1e3,
+        "prep_hash_ms": ph_hash / PH_ITERS * 1e3,
+        "prep_pack_ms": ph_pack / PH_ITERS * 1e3,
+        "prep_submit_ms": ph_sub / PH_ITERS * 1e3,
         "dispatch_ms": disp_s / PH_ITERS * 1e3,
         "fetch_ms": fetch_s / PH_ITERS * 1e3,
         "verify_ms": verify_s / PH_ITERS * 1e3,
@@ -853,17 +866,28 @@ def run_sharded(subs_cap=None, workload=2):
     # whichever runs second — each row is the median rep
     res = None
 
+    eng.prep_timeout = 2.0  # bench boxes: never degrade on scheduling
+
     def _window(n_iters):
         """One pipelined window of n_iters ticks (pacer-paced churn).
         The caller-side pending queue is part of the in-flight window,
         so it follows the engine's adaptive effective depth: when the
         clamp says 1 (churn drains every tick, or deep measured slower)
-        holding depth-N resolved ticks would be pure overhead."""
+        holding depth-N resolved ticks would be pure overhead.
+
+        PREP-AHEAD (PR 12): at depth > 1 the loop keeps the engine's
+        prep stage primed `effective_depth` ticks ahead — the worker
+        packs tick N+1..N+depth while tick N's dispatch runs, and
+        consecutive prepped tickets coalesce into ONE mesh dispatch
+        (the depth win the A/B controller measures)."""
         nonlocal res
         pacer = ChurnPacer(target_cps)
         pacer.last = time.time()
         shed = 0
         pending = []
+        tickets = {}
+        next_prep = 0
+        prep_occ = 0.0
         c0 = churn_i
         t0 = time.time()
         for i in range(n_iters):
@@ -874,15 +898,59 @@ def run_sharded(subs_cap=None, workload=2):
                     shed = pacer.shed
                 if n_ops:
                     churn_tick_n(n_ops)
-            pending.append(eng.match_submit(batches[i % 8]))
             eff = max(1, min(eng.pipeline_depth,
                              getattr(eng, "effective_depth",
                                      eng.pipeline_depth)))
+            if eng.pipeline_depth > 1 and (
+                eff > 1 or eng._drain_ewma < eng.drain_clamp
+            ):
+                # prime whenever the LEG is deep and the window can
+                # actually fill (not just when the A/B verdict currently
+                # says deep — tickets must already be prepped when the
+                # controller probes deep mode, or the probe measures a
+                # cold ramp instead of the coalesced steady state).  A
+                # churn-drain clamp (w5: every tick fuses churn and
+                # drains the window) skips priming outright: those
+                # dispatches can never coalesce, so staged tickets
+                # would be pure handoff overhead.
+                ahead = max(eff, 2)
+                next_prep = max(next_prep, i)
+                while next_prep < n_iters and next_prep < i + ahead:
+                    tickets[next_prep] = eng.prep_submit(
+                        batches[next_prep % 8]
+                    )
+                    next_prep += 1
+            prep_occ += eng.prep_ready
+            pending.append(
+                eng.match_submit(batches[i % 8], prep=tickets.pop(i, None))
+            )
             while len(pending) >= eff:
                 res = eng.match_collect_raw(pending.pop(0))
         while pending:
             res = eng.match_collect_raw(pending.pop(0))
-        return time.time() - t0, churn_i - c0, pacer.shed
+        for tk in tickets.values():  # depth clamped mid-run: unused
+            eng.prep_discard(tk)
+        return time.time() - t0, churn_i - c0, pacer.shed, \
+            prep_occ / max(n_iters, 1)
+
+    if eng.pipeline_depth > 1:
+        # warm the coalesced-dispatch kernel variants (the K=2/K=4
+        # group shapes compile on first use — a first-boot cost the
+        # node's persistent XLA cache absorbs in production, which must
+        # not land mid-measurement) with the A/B controller pinned
+        # deep; then reset the controller so each measured leg
+        # discovers its own verdict from scratch
+        saved_streak = eng.depth_win_streak
+        eng.depth_win_streak = 0
+        eng._dw_deep = True
+        eng._dw_cost[False] = float("inf")
+        _window(12)
+        eng.depth_win_streak = saved_streak
+        eng._dw_cost.update({True: None, False: None})
+        eng._dw_samples.clear()
+        eng._dw_last = None
+        eng._dw_streak = 0
+        eng._dw_deep = True
 
     depths = [1] if eng.pipeline_depth == 1 else [1, eng.pipeline_depth]
     rep_rows = {d: [] for d in depths}
@@ -892,14 +960,17 @@ def run_sharded(subs_cap=None, workload=2):
             eng.flight = FlightRecorder(256)
             eng.match(batches[0])  # warm (kcap/bucket variants) + drain
             _window(SETTLE)
-            wall, churn_n, shed = _window(ITERS_S)
+            wall, churn_n, shed, prep_occ = _window(ITERS_S)
             occ = [r["pipe_occ"] for r in eng.flight.recent(ITERS_S)]
+            grp = [r["prep_group"] for r in eng.flight.recent(ITERS_S)]
             rep_rows[depth].append({
                 "depth": depth,
                 "rps": ITERS_S * TICK / wall,
                 "churn_rps": churn_n / wall if target_cps else 0.0,
                 "churn_shed": shed,
                 "occ_mean": float(np.mean(occ)) if occ else 0.0,
+                "prep_occ_mean": prep_occ,
+                "group_mean": float(np.mean(grp)) if grp else 1.0,
             })
     depth_rows = {}
     for depth, rows in rep_rows.items():
@@ -909,6 +980,8 @@ def run_sharded(subs_cap=None, workload=2):
         depth_rows[depth] = row
         log(f"sharded e2e depth {depth}: {row['rps']:,.0f} lookups/s "
             f"(occ {row['occ_mean']:.1f}/{depth}, "
+            f"prep-ahead {row['prep_occ_mean']:.1f}, "
+            f"group {row['group_mean']:.1f}, "
             f"reps {row['rps_reps']}); "
             f"churn {row['churn_rps']:,.0f}/s applied "
             f"(target {target_cps:,.0f}, shed {row['churn_shed']})")
@@ -919,13 +992,19 @@ def run_sharded(subs_cap=None, workload=2):
     log(f"sharded e2e: {rps:,.0f} lookups/s at depth {dN['depth']} "
         f"(depth-1 {d1['rps']:,.0f}, ratio {rps / d1['rps']:.2f}x; "
         f"p99 {p99:.2f} ms at {TICK}); collisions {eng.collision_count}; "
+        f"prep degraded {eng.prep_degraded}; "
         f"sample hits {sum(len(s) for s in res)}")
+    prep_degraded = eng.prep_degraded
+    eng.close()  # prep-ahead worker joined, ticket buffers recycled
     return {
         "tpu_rps": rps,
         "rps_depth1": d1["rps"],
         "pipeline_depth": dN["depth"],
         "pipeline_ratio": rps / d1["rps"],
         "occ_mean": dN["occ_mean"],
+        "prep_occ_mean": dN["prep_occ_mean"],
+        "group_mean": dN["group_mean"],
+        "prep_degraded": prep_degraded,
         "depth_rows": sorted(depth_rows.values(), key=lambda r: r["depth"]),
         "p99_ms": p99,
         "tick": TICK,
@@ -1668,6 +1747,279 @@ def run_fanout(reps: int = 3):
     return stats
 
 
+MESH_HEADER_PREFIX = "## Mesh-sharded engine"
+PREP_HEADER = "## Fused prep op (microbench)"
+
+
+def _mesh_section_lines(sharded_rows: dict, single: dict = None) -> list:
+    """The BENCH_TABLE.md mesh section (shared by the --all writer and
+    the --sharded marker update).  `sharded_rows`: workload -> stats
+    JSON from run_sharded; `single`: optional single-chip config-2
+    stats for the comparison row."""
+    nd = next(iter(sharded_rows.values()))["n_devices"]
+    lines = [
+        "",
+        f"{MESH_HEADER_PREFIX} (BASELINE workloads, {nd} virtual CPU "
+        "devices)",
+        "",
+        "`broker.engine=sharded` path: fused churn+compact-match "
+        "dispatch over the mesh (`sharded_step_compact_packed`), "
+        "pipelined through the engine.pipeline_depth in-flight window "
+        "with the PR 12 fused native prep op (`etpu_prep_pack`: one "
+        "GIL-released split+hash+memo+dedup+pack pass) and the "
+        "prep-ahead stage (a persistent worker preps tick N+1..N+depth "
+        "while tick N's dispatch is in flight; consecutive prepped "
+        "ticks COALESCE into one mesh dispatch, group sizes 1/2/4).  "
+        "Exact verification on, tick 512.  One row per (workload, "
+        "depth): depth 1 is the lock-step baseline, depth N the "
+        "pipelined window; occ = mean flight-recorder occupancy at "
+        "submit, prep = mean prep-ahead tickets ready at submit, grp = "
+        "mean coalesced-dispatch group size.  Workloads 3/5 run at 1M "
+        "resident filters (the virtual mesh shares one host's "
+        "RAM/cores; w5 pays its 5%/sec churn inside the loop, paced by "
+        "wall clock, and so does its CPU baseline).  Virtual devices "
+        "share this host's cores, so these rows measure the sharded "
+        "DISPATCH PATH's overhead/correctness at scale, not ICI "
+        "speedup.  PR 12 note: the old prep column (7.6-9.1 ms) LUMPED "
+        "the synchronous inline portion of the mesh-execute call into "
+        "prep — the re-attributed columns below split real prep work "
+        "(hash/pack/submit, now fused native) from the dispatch call + "
+        "compute, and the coalesced group dispatch is what moves the "
+        "depth-4/depth-1 ratio above 1.0 on this 1-hardware-thread "
+        "host (per-dispatch overhead amortizes over the group; on real "
+        "parallel hardware the overlap win stacks on top).",
+        "",
+        "| workload | filters | depth | lookups/s | vs cpu | occ | "
+        "prep | grp | p99 ms | prep ms | hash/pack/submit | "
+        "dispatch ms | fetch ms | verify ms | insert/s | "
+        "churn/s applied (target) |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
+        "---|",
+    ]
+    for w, s in sorted(sharded_rows.items()):
+        ph = s.get("phases", {})
+        churn_col = (
+            "%s (%s)" % (
+                format(round(s.get("churn_rps", 0)), ","),
+                format(round(s.get("churn_target", 0)), ","),
+            )
+            if s.get("churn_target") else "—"
+        )
+        sub = (f"{ph.get('prep_hash_ms', 0):.3f}/"
+               f"{ph.get('prep_pack_ms', 0):.3f}/"
+               f"{ph.get('prep_submit_ms', 0):.3f}")
+        for dr in s.get("depth_rows") or [
+            {"depth": 3, "rps": s["tpu_rps"], "occ_mean": 0.0}
+        ]:
+            lines.append(
+                f"| {w}: {CONFIGS[w][1]} | {s['n_filters']:,} "
+                f"| {dr['depth']} "
+                f"| {dr['rps']:,.0f} "
+                f"| {dr['rps']/s['cpu_rps']:.1f}x "
+                f"| {dr['occ_mean']:.1f} "
+                f"| {dr.get('prep_occ_mean', 0.0):.1f} "
+                f"| {dr.get('group_mean', 1.0):.1f} "
+                f"| {s['p99_ms']:.2f} "
+                f"| {ph.get('prep_ms', 0):.2f} "
+                f"| {sub} "
+                f"| {ph.get('dispatch_ms', 0):.2f} "
+                f"| {ph.get('fetch_ms', 0):.2f} "
+                f"| {ph.get('verify_ms', 0):.2f} "
+                f"| {s['insert_rps']:,.0f} "
+                f"| {churn_col} |"
+            )
+    if single is not None:
+        lines.append(
+            f"| single-chip hybrid (row 2, tick 4096) "
+            f"| {single['n_filters']:,} | — "
+            f"| {single['tpu_rps']:,.0f} "
+            f"| {single['tpu_rps']/single['cpu_rps']:.1f}x | — | | "
+            f"| {single['p99_ms']:.2f} | | | | | | "
+            f"| {single['insert_rps']:,.0f} | |"
+        )
+    lines.append(
+        "\nPhases per 512-topic tick, measured LOCK-STEP so each is "
+        "exposed (in the pipelined rows above, dispatch overlaps the "
+        "other phases of neighboring ticks): prep = the fused native "
+        "prep op only — hash (split+hash+memo+dedup), pack "
+        "(staging-buffer gather+pad), submit (group assembly + "
+        "device_put handoff) — dispatch = the mesh-execute call + "
+        "device compute wait (the call's synchronous inline portion "
+        "was previously mis-attributed to prep), fetch = resolve "
+        "(live [D, n, k] slice + u16 counts + any overflow refetch), "
+        "verify = registry exact-check + row assembly."
+    )
+    lines.append("")
+    return lines
+
+
+def _stash_path(name: str) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+
+
+def _update_mesh_table(stats: dict) -> None:
+    """Merge one --sharded workload's stats into the BENCH_TABLE.md
+    mesh section (marker replacement, same ownership contract as the
+    fan-out/spans sections).  Per-workload stats stash in
+    BENCH_mesh_w<w>.json so a single-workload re-measure keeps the
+    other rows; BENCH_mesh_single.json (optional) carries the
+    single-chip comparison row."""
+    w = int(stats["workload"])
+    with open(_stash_path(f"BENCH_mesh_w{w}.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(stats, f)
+    sharded_rows = {}
+    for ww in (2, 3, 5):
+        p = _stash_path(f"BENCH_mesh_w{ww}.json")
+        if os.path.exists(p):
+            with open(p, "r", encoding="utf-8") as f:
+                sharded_rows[ww] = json.load(f)
+    single = None
+    sp = _stash_path("BENCH_mesh_single.json")
+    if os.path.exists(sp):
+        with open(sp, "r", encoding="utf-8") as f:
+            single = json.load(f)
+    path = "BENCH_TABLE.md"
+    lines = []
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    out, skipping, replaced = [], False, False
+    for line in lines:
+        if line.strip().startswith(MESH_HEADER_PREFIX):
+            skipping = True
+            if not replaced:
+                replaced = True
+                out.extend(_mesh_section_lines(sharded_rows, single))
+            continue
+        if skipping and line.startswith("## "):
+            skipping = False
+        if not skipping:
+            out.append(line)
+    if not replaced:
+        out.extend(_mesh_section_lines(sharded_rows, single))
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(out) + "\n")
+    log("updated BENCH_TABLE.md mesh-sharded section")
+
+
+def run_prep_only(workload: int = 2):
+    """Fused-native vs python-fallback prep in ISOLATION: the whole
+    prep stage (split+hash+memo+dedup+bucket-pack into the staging
+    buffer) timed per tick at B=512 and B=2048 over the sharded
+    workload's own topic stream — the op's speedup measured without
+    the dispatch path around it (`make prep-bench`)."""
+    from emqx_tpu.ops import hashing
+    from emqx_tpu.ops import native as _native
+    from emqx_tpu.ops.prep import TopicPrep
+
+    rng = random.Random(1236)
+    if workload == 2:
+        _filters, topics_fn = pop_wild_100k(rng, 10_000)
+    else:
+        _filters, topics_fn = pop_mixed(rng, 50_000)
+    space = hashing.HashSpace()
+    rows = []
+    for B in (512, 2048):
+        batches = []
+        while len(batches) < 8:
+            t = topics_fn()
+            while len(t) < B:
+                t = t + topics_fn()
+            batches.append(t[:B])
+        for mode in ("native", "python"):
+            use_native = mode == "native"
+            if use_native and not _native.available():
+                continue
+            prep = TopicPrep(space, min_batch=64, use_native=use_native)
+            for b in batches:  # warm the memo (steady-state Zipf serve)
+                r = prep.pack(list(b))
+                prep.release(r.buf, r.key)
+            reps = 50 if use_native else 20
+            hash_s = pack_s = 0.0
+            t0 = time.perf_counter()
+            for i in range(reps):
+                r = prep.pack(list(batches[i % 8]))
+                hash_s += r.hash_s
+                pack_s += r.pack_s
+                prep.release(r.buf, r.key)
+            dt = time.perf_counter() - t0
+            rows.append({
+                "B": B, "mode": mode,
+                "tick_us": dt / reps * 1e6,
+                "hash_us": hash_s / reps * 1e6,
+                "pack_us": pack_s / reps * 1e6,
+                "topics_per_s": reps * B / dt,
+                "memo_hit_rate": prep.hits / max(prep.hits + prep.misses,
+                                                 1),
+            })
+            log(f"prep-only B={B} {mode}: {dt/reps*1e6:,.0f} us/tick "
+                f"({reps*B/dt:,.0f} topics/s; hash {hash_s/reps*1e6:,.0f} "
+                f"pack {pack_s/reps*1e6:,.0f} us)")
+    by = {(r["B"], r["mode"]): r for r in rows}
+    speedups = {
+        B: by[(B, "python")]["tick_us"] / by[(B, "native")]["tick_us"]
+        for B in (512, 2048)
+        if (B, "native") in by and (B, "python") in by
+    }
+    stats = {"rows": rows, "speedups": speedups,
+             "workload": workload,
+             "pool_width": _pool_width(),
+             "host_threads": os.cpu_count() or 1}
+    _update_prep_table(stats)
+    return stats
+
+
+def _update_prep_table(s: dict) -> None:
+    """Replace the fused-prep microbench section of BENCH_TABLE.md."""
+    lines_new = [
+        "",
+        PREP_HEADER,
+        "",
+        "The whole prep stage in isolation — split + hash + "
+        "two-generation topic memo + in-tick dedup + bucket-padded "
+        "[B, 2L+2] staging pack — fused native (`native/prep.cc "
+        "etpu_prep_pack`, GIL-released, pool width "
+        f"{s['pool_width']}) vs the pure-Python fallback, per 512/2048-"
+        "topic tick over the sharded workload's Zipf topic stream "
+        "(steady-state memo).  `python bench.py --sharded --prep-only` "
+        "(`make prep-bench`).",
+        "",
+        "| B | path | tick us | hash us | pack us | topics/s | "
+        "memo hit rate | native speedup |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in s["rows"]:
+        sp = s["speedups"].get(r["B"])
+        sp_col = (f"{sp:.1f}x" if sp and r["mode"] == "native" else "")
+        lines_new.append(
+            f"| {r['B']} | {r['mode']} | {r['tick_us']:,.0f} "
+            f"| {r['hash_us']:,.0f} | {r['pack_us']:,.0f} "
+            f"| {r['topics_per_s']:,.0f} | {r['memo_hit_rate']:.2f} "
+            f"| {sp_col} |"
+        )
+    path = "BENCH_TABLE.md"
+    lines = []
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    out, skipping = [], False
+    for line in lines:
+        if line.strip() == PREP_HEADER:
+            skipping = True
+            continue
+        if skipping and line.startswith("## "):
+            skipping = False
+        if not skipping:
+            out.append(line)
+    while out and not out[-1].strip():
+        out.pop()
+    out += lines_new
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(out) + "\n")
+    log("updated BENCH_TABLE.md fused-prep section")
+
+
 FANOUT_HEADER = "## Delivery-plane fan-out"
 
 
@@ -2323,6 +2675,12 @@ def main() -> None:
                          "overhead A/B on the fan-out wire path "
                          "(BENCH_NO_SPANS=1 = disarmed leg only); "
                          "writes the BENCH_TABLE.md section")
+    ap.add_argument("--prep-only", action="store_true",
+                    help="fused-native vs python-fallback prep "
+                         "microbench at B=512/2048 over the sharded "
+                         "workload's topic stream (use with --sharded "
+                         "<w> to pick the workload; writes the "
+                         "BENCH_TABLE.md section)")
     ap.add_argument("--churn-capacity", action="store_true",
                     help="single churn-capacity measurement at the "
                          "current ETPU_POOL_THREADS (the sweep's inner "
@@ -2444,6 +2802,23 @@ def main() -> None:
             "batch_rows": s0["batch_rows"],
         }))
         return
+    if ns.prep_only:
+        stats = run_prep_only(ns.sharded if ns.sharded is not None else 2)
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
+        print(json.dumps({
+            "metric": "fused_prep_speedup_b512",
+            "value": round(stats["speedups"].get(512, 0.0), 2),
+            "unit": "x_vs_python_fallback",
+            "speedup_b2048": round(stats["speedups"].get(2048, 0.0), 2),
+            "rows": [
+                {k: (round(v, 2) if isinstance(v, float) else v)
+                 for k, v in r.items()}
+                for r in stats["rows"]
+            ],
+        }))
+        return
     if ns.config is None and ns.sharded is None:
         ns.all = True  # driver contract: plain `python bench.py` = full table
 
@@ -2452,6 +2827,8 @@ def main() -> None:
         if ns.emit_stats:
             with open(ns.emit_stats, "w", encoding="utf-8") as f:
                 json.dump(stats, f)
+        _update_mesh_table(stats)
+        ph = stats.get("phases", {})
         print(json.dumps({
             "metric": f"sharded_route_lookups_per_sec_{CONFIGS[ns.sharded][0]}",
             "value": round(stats["tpu_rps"]),
@@ -2464,6 +2841,13 @@ def main() -> None:
             "pipeline_depth": stats["pipeline_depth"],
             "pipeline_ratio": round(stats["pipeline_ratio"], 2),
             "occ_mean": round(stats["occ_mean"], 1),
+            "prep_occ_mean": round(stats["prep_occ_mean"], 1),
+            "group_mean": round(stats["group_mean"], 1),
+            "prep_ms": round(ph.get("prep_ms", 0.0), 3),
+            "dispatch_ms": round(ph.get("dispatch_ms", 0.0), 3),
+            "prep_degraded": stats["prep_degraded"],
+            "memo_hits": stats["memo_hits"],
+            "memo_misses": stats["memo_misses"],
         }))
         return
 
@@ -2659,76 +3043,22 @@ def main() -> None:
                 for r in nsr))
         f.write("\n")
         if sharded_rows:
-            nd = next(iter(sharded_rows.values()))["n_devices"]
-            f.write(
-                "\n## Mesh-sharded engine (BASELINE workloads, "
-                f"{nd} virtual CPU devices)\n\n"
-                "`broker.engine=sharded` path: fused churn+compact-match "
-                "dispatch over the mesh (`sharded_step_compact_packed`), "
-                "pipelined through the engine.pipeline_depth in-flight "
-                "window, exact verification on, tick 512.  One row per "
-                "(workload, depth): depth 1 is the lock-step baseline, "
-                "depth N the pipelined window; occ = mean flight-"
-                "recorder occupancy at submit.  Workloads 3/5 run at 1M "
-                "resident filters (the virtual mesh shares one host's "
-                "RAM/cores; w5 pays its 5%/sec churn inside the loop, "
-                "paced by wall clock, and so does its CPU baseline).  "
-                "Virtual devices share this host's cores, so these rows "
-                "measure the sharded DISPATCH PATH's overhead/"
-                "correctness at scale, not ICI speedup — and on a "
-                "single-hardware-thread host every pipeline phase "
-                "serializes onto one core, so the depth-N/depth-1 ratio "
-                "only exceeds ~1.0 when a second execution resource "
-                "exists (host cores or a real v5e-8 mesh).\n\n"
-                "| workload | filters | depth | lookups/s | vs cpu | "
-                "occ | p99 ms | prep ms | dispatch ms | fetch ms | "
-                "verify ms | insert/s | churn/s applied (target) |\n"
-                "|---|---|---|---|---|---|---|---|---|---|---|---|---|\n"
-            )
-            for w, s in sorted(sharded_rows.items()):
-                ph = s.get("phases", {})
-                churn_col = (
-                    "%s (%s)" % (
-                        format(round(s.get("churn_rps", 0)), ","),
-                        format(round(s.get("churn_target", 0)), ","),
-                    )
-                    if s.get("churn_target") else "—"
-                )
-                for dr in s.get("depth_rows") or [
-                    {"depth": 3, "rps": s["tpu_rps"], "occ_mean": 0.0}
-                ]:
-                    f.write(
-                        f"| {w}: {CONFIGS[w][1]} | {s['n_filters']:,} "
-                        f"| {dr['depth']} "
-                        f"| {dr['rps']:,.0f} "
-                        f"| {dr['rps']/s['cpu_rps']:.1f}x "
-                        f"| {dr['occ_mean']:.1f} "
-                        f"| {s['p99_ms']:.2f} "
-                        f"| {ph.get('prep_ms', 0):.2f} "
-                        f"| {ph.get('dispatch_ms', 0):.2f} "
-                        f"| {ph.get('fetch_ms', 0):.2f} "
-                        f"| {ph.get('verify_ms', 0):.2f} "
-                        f"| {s['insert_rps']:,.0f} "
-                        f"| {churn_col} |\n"
-                    )
-            f.write(
-                f"| single-chip hybrid (row 2, tick 4096) "
-                f"| {rows[2]['n_filters']:,} | — "
-                f"| {rows[2]['tpu_rps']:,.0f} "
-                f"| {rows[2]['tpu_rps']/rows[2]['cpu_rps']:.1f}x | — "
-                f"| {rows[2]['p99_ms']:.2f} | | | | "
-                f"| {rows[2]['insert_rps']:,.0f} | |\n"
-            )
-            f.write(
-                "\nPhases per 512-topic tick, measured LOCK-STEP so "
-                "each is exposed (in the pipelined rows above, dispatch "
-                "overlaps the other phases of neighboring ticks): prep "
-                "= native split+hash + packed staging upload + the "
-                "non-donating mesh dispatch call, dispatch = device "
-                "compute wait, fetch = resolve (live [D, n, k] slice + "
-                "u16 counts + any overflow refetch), verify = registry "
-                "exact-check + row assembly.\n"
-            )
+            single = {
+                k: rows[2][k]
+                for k in ("n_filters", "tpu_rps", "cpu_rps", "p99_ms",
+                          "insert_rps")
+            }
+            # stash for later single-workload marker updates
+            with open(_stash_path("BENCH_mesh_single.json"), "w",
+                      encoding="utf-8") as sf:
+                json.dump(single, sf)
+            for w, s in sharded_rows.items():
+                with open(_stash_path(f"BENCH_mesh_w{w}.json"), "w",
+                          encoding="utf-8") as sf:
+                    json.dump(s, sf)
+            f.write("\n".join(
+                _mesh_section_lines(sharded_rows, single)
+            ) + "\n")
         if retained is not None:
             f.write(
                 "\n## Retained-index lookup (subscribe-time wildcard "

@@ -57,6 +57,12 @@ class PublishBatcher:
         # Olp.pressure_fn, which the node wires to inflight_ticks.
         self.max_inflight = max_inflight
         self._q: List[Tuple[Message, asyncio.Future]] = []
+        # prep-ahead ticket for the NEXT chunk (sharded engine's prep
+        # pipeline stage): staged at the previous flush so the packed
+        # upload buffer is built while this tick's dispatch is in
+        # flight; the engine validates topics at claim time and
+        # degrades to inline prep on any mismatch
+        self._prep_ticket = None
         self._wakeup: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._consumer: Optional[asyncio.Task] = None
@@ -117,6 +123,10 @@ class PublishBatcher:
                 batch, pp = self._ticks_q.get_nowait()
                 self._finish_tick(batch, pp)
         self._flush_now(pipelined=False)
+        ticket, self._prep_ticket = self._prep_ticket, None
+        if ticket is not None:
+            # the chunk it was staged for flushed unpipelined above
+            self.broker.engine.prep_discard(ticket)
 
     def submit(self, msg: Message) -> "asyncio.Future[int]":
         """Queue a message for the next tick; resolves to delivery count."""
@@ -157,8 +167,18 @@ class PublishBatcher:
             return
         self.ticks += 1
         self.batched_messages += len(batch)
+        ticket, self._prep_ticket = self._prep_ticket, None
+        # stage the next queued chunk's prep while this chunk's
+        # submit+dispatch runs (engines without a prep stage skip this)
+        prep_submit = getattr(self.broker.engine, "prep_submit", None)
+        if pipelined and prep_submit is not None and self._q:
+            self._prep_ticket = prep_submit(
+                [m.topic for m, _ in self._q[: self.max_batch]]
+            )
         try:
-            pp = self.broker.publish_submit([m for m, _ in batch])
+            pp = self.broker.publish_submit(
+                [m for m, _ in batch], prep=ticket
+            )
         except Exception as e:
             # a failed tick must never strand futures (acks would hang)
             for _, fut in batch:

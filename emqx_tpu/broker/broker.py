@@ -308,6 +308,12 @@ class Broker:
         c["engine.probes"] = getattr(e, "probe_count", 0)
         c["engine.breaker_trips"] = getattr(e, "breaker_trips", 0)
         c["engine.churn_shed"] = getattr(e, "churn_shed", 0)
+        # fused-prep topic memo + prep-ahead degrade counters (both
+        # engines carry a TopicPrep; PR 6's bench-JSON-only counters
+        # promoted to first-class metrics)
+        c["engine.memo_hits"] = getattr(e, "memo_hits", 0)
+        c["engine.memo_misses"] = getattr(e, "memo_misses", 0)
+        c["engine.prep_degraded"] = getattr(e, "prep_degraded", 0)
         # delivery plane: codec-owned shared-prefix cache telemetry
         # (frame.PREFIX_STATS) copied at the same observation points
         from . import frame as framelib
@@ -355,15 +361,26 @@ class Broker:
     #                           broker state, so it is executor-safe
     #   finish  (loop thread)   fid expansion + local delivery
 
-    def publish_submit(self, msgs: Sequence[Message]) -> "PendingPublish":
+    def publish_submit(
+        self, msgs: Sequence[Message], prep=None
+    ) -> "PendingPublish":
+        """``prep`` is an optional prep-ahead ticket (the sharded
+        engine's `prep_submit`, staged by PublishBatcher for the next
+        queued chunk): the engine claims it when its topics still match
+        the accepted batch and degrades to inline prep otherwise."""
         todo, results, ticked = self._prepare_publish(msgs)
         if todo:
             self._pre_match(todo)
-        pending = (
-            self.engine.match_submit([m.topic for _, m in todo])
-            if todo
-            else None
-        )
+        pending = None
+        if todo:
+            topics = [m.topic for _, m in todo]
+            pending = (
+                self.engine.match_submit(topics, prep=prep)
+                if prep is not None
+                else self.engine.match_submit(topics)
+            )
+        elif prep is not None:
+            self.engine.prep_discard(prep)
         for ctx in ticked:
             _spans.mark(ctx, "submit")
         return PendingPublish(todo, results, pending, spans=ticked)

@@ -66,6 +66,11 @@ PREDEFINED = [
     # emqx_engine_path_flips)
     "engine.ticks",
     "engine.churn_shed",
+    # fused-prep topic memo (ops/prep.py, PR 6 counters promoted out of
+    # bench JSON; synced by Broker.sync_engine_metrics)
+    "engine.memo_hits",
+    "engine.memo_misses",
+    "engine.prep_degraded",
     "engine.host_serve",
     "engine.dev_serve",
     "engine.dev_timeout",

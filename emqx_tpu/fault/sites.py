@@ -41,6 +41,10 @@ SITES: Dict[str, str] = {
                     "looks stalled, keeping the breaker open)",
     "sharded.collect": "sharded engine device resolve (delay only: the "
                        "mesh path has no host fallback)",
+    # prep-ahead stage (ops/prep.py PrepStage worker)
+    "engine.prep": "prep-ahead worker tick (delay = a stalled prep "
+                   "stage: match_submit's ticket claim times out and "
+                   "degrades to inline prep — the window never freezes)",
 }
 
 # Sites whose injector runs SYNCHRONOUSLY on the asyncio event-loop

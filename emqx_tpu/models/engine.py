@@ -178,6 +178,17 @@ class TopicMatchEngine:
         if use_churn_plane and self._reg is not None:
             self._plane = _native.make_churn_plane(self.space, churn_shards)
 
+        # fused prep front (ops/prep.py): split + hash + two-generation
+        # topic memo + in-tick dedup + bucket-padded pack in ONE native
+        # pass (single-chip adoption of the sharded mesh's fused prep
+        # op; pure-Python fallback when the lib is absent).  Buffers are
+        # packed fresh per tick here (reuse=False): single-chip pendings
+        # hold their pbatch for the pipeline window, so pooled recycling
+        # would alias a live device_put source.
+        from ..ops.prep import TopicPrep
+
+        self._prep = TopicPrep(self.space, min_batch=self.min_batch)
+
         # churn shed-load visibility: ops the pacing layer dropped
         # because apply capacity lagged demand (note_churn_shed)
         self.churn_shed = 0
@@ -291,6 +302,17 @@ class TopicMatchEngine:
             return self._plane.refcount(filt)
         fid = self._fids.get(filt)
         return 0 if fid is None else self._refs[fid]
+
+    # ---- fused-prep topic-memo telemetry (ops/prep.py; synced to the
+    # engine.memo_* metrics counters by Broker.sync_engine_metrics)
+
+    @property
+    def memo_hits(self) -> int:
+        return self._prep.hits
+
+    @property
+    def memo_misses(self) -> int:
+        return self._prep.misses
 
     def note_churn_shed(self, n: int) -> None:
         """Count churn ops shed upstream (demand exceeded apply
@@ -1114,14 +1136,13 @@ class TopicMatchEngine:
         out = pbatch = nb = None
         hcap = 0
         bytes_up = 0
+        prep_res = None
         if self.tables.n_entries:
             import jax
 
             from ..ops.match import (
                 fused_step_sparse,
                 match_batch_sparse,
-                pack_topic_batch_np,
-                prepare_topics_raw,
             )
 
             delta = self.tables.drain_delta()
@@ -1135,25 +1156,21 @@ class TopicMatchEngine:
                 bytes_up += sum(
                     int(getattr(a, "nbytes", 0)) for a in self._dev
                 )
-            nb, _n = prepare_topics_raw(self.space, topics, self.min_batch)
-            B = nb.terms_a.shape[0]
+            # fused prep op (ops/prep.py): split+hash through the topic
+            # memo + bucket-padded pack in one native pass; term levels
+            # truncate to the batch's real (even-rounded) depth — the
+            # packed array IS the upload payload
+            prep_res = self._prep.pack(list(topics), reuse=False)
+            B = prep_res.B
             hcap = B * self._hcap_mult
-            # truncate term levels to this batch's real depth: the terms
-            # array IS the upload payload (~64 MB/s real link bandwidth);
-            # live_levels rounds to even depths to bound kernel variants
-            from ..ops.match import live_levels
-
-            L_used = live_levels(self.space.max_levels, nb.length)
-            pbatch_np = pack_topic_batch_np(
-                nb.terms_a[:, :L_used], nb.terms_b[:, :L_used],
-                nb.length, nb.dollar,
-            )
             # wire-byte accounting (BENCH_TABLE.md wire floor): the
             # packed terms array IS the upload payload — 2 hash lanes x
-            # 4 B x L_used levels per topic row, plus length/dollar —
-            # and a fused churn delta rides the same dispatch
-            bytes_up += pbatch_np.nbytes
-            pbatch = jax.device_put(pbatch_np, self.device)
+            # 4 B x L levels per topic row, plus length/dollar — and a
+            # fused churn delta rides the same dispatch
+            bytes_up += prep_res.buf.nbytes
+            tp0 = time.perf_counter()
+            pbatch = jax.device_put(prep_res.buf, self.device)
+            prep_put_s = time.perf_counter() - tp0
             if packed is not None:
                 bytes_up += packed.nbytes
                 self._dev, out = fused_step_sparse(
@@ -1168,12 +1185,18 @@ class TopicMatchEngine:
                 pass
         # snapshot THIS tick's table version: later pipelined submits may
         # advance self._dev, and the overflow refetch must not see them
-        return _PendingMatch(
+        p = _PendingMatch(
             out, hcap, pbatch, self._dev, list(topics),
             mode="device", snap=self._snapshot(),
             t0=t0 if t0 is not None else time.monotonic(),
             deep=deep, reason=reason, bytes_up=bytes_up,
         )
+        if prep_res is not None:
+            p.prep_hash_s = prep_res.hash_s
+            p.prep_pack_s = prep_res.pack_s
+            p.prep_put_s = prep_put_s
+            p.memo_hits_tick = prep_res.hits
+        return p
 
     def match_collect(self, pending: "_PendingMatch") -> List[Set[int]]:
         """Block on a submitted match and return verified fid sets."""
@@ -1295,6 +1318,10 @@ class TopicMatchEngine:
                 lat_s=lat_s, churn_lag_s=self._churn_lag,
                 pipe_occ=pending.pipe_occ, pipe_depth=pending.pipe_depth,
                 churn_shed=shed,
+                prep_hash_s=pending.prep_hash_s,
+                prep_pack_s=pending.prep_pack_s,
+                prep_submit_s=pending.prep_put_s,
+                memo_hits=pending.memo_hits_tick,
             )
         if _tps._active:  # gate: skip kwarg evaluation when tracing is off
             tp("engine.tick", path=PATHS[path], n=len(pending.topics),
@@ -1690,7 +1717,8 @@ class _PendingMatch:
     __slots__ = (
         "out", "hcap", "batch", "tables", "topics", "mode", "snap", "t0",
         "deep", "expand", "reason", "served", "n_raw", "bytes_up",
-        "bytes_down", "pipe_occ", "pipe_depth",
+        "bytes_down", "pipe_occ", "pipe_depth", "prep_hash_s",
+        "prep_pack_s", "prep_put_s", "memo_hits_tick",
     )
 
     def __init__(self, out, hcap, batch, tables, topics,
@@ -1713,3 +1741,7 @@ class _PendingMatch:
         self.bytes_down = 0
         self.pipe_occ = 0  # in-flight ticks at submit (incl. this one)
         self.pipe_depth = 0  # engine.pipeline_depth at submit
+        self.prep_hash_s = 0.0  # fused-prep sub-stages (flight columns)
+        self.prep_pack_s = 0.0
+        self.prep_put_s = 0.0
+        self.memo_hits_tick = 0  # topic-memo hits within this tick

@@ -984,6 +984,9 @@ class NodeRuntime:
             self.ckpt.close()
         if self.broker.retainer.store is not None:
             self.broker.retainer.store.close()
+        eng_close = getattr(self.broker.engine, "close", None)
+        if eng_close is not None:
+            eng_close()  # prep-ahead stage: worker joined, buffers freed
         self.delayed.close()
         for drv in self._db_drivers:
             fn = getattr(drv, "stop", None)

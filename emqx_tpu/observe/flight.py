@@ -183,8 +183,17 @@ TICK_DTYPE = np.dtype([
     ("lat_us", "f4"),        # submit -> collect-complete latency
     ("churn_lag_us", "f4"),  # duration of the most recent apply_churn
     ("pipe_depth", "u1"),    # engine.pipeline_depth at submit
-    ("_pad", "u1"),
+    ("prep_group", "u1"),    # coalesced-dispatch group size (1 = solo)
     ("churn_shed", "u4"),    # churn ops shed upstream since the last tick
+    # prep sub-stage attribution (PR 12): the formerly opaque prep blob
+    # split so the next prep regression is attributable — hash = split+
+    # hash+memo+dedup, pack = staging-buffer gather+pad, submit = group
+    # assembly + device_put handoff (the mesh-execute call itself lands
+    # in the dispatch phase, where it belongs)
+    ("prep_hash_us", "f4"),
+    ("prep_pack_us", "f4"),
+    ("prep_submit_us", "f4"),
+    ("memo_hits", "u4"),     # topic-memo hits within this tick
 ])
 
 
@@ -235,6 +244,11 @@ class FlightRecorder:
         pipe_occ: int = 0,
         pipe_depth: int = 0,
         churn_shed: int = 0,
+        prep_hash_s: float = 0.0,
+        prep_pack_s: float = 0.0,
+        prep_submit_s: float = 0.0,
+        memo_hits: int = 0,
+        prep_group: int = 1,
     ) -> bool:
         """Record one tick; returns True when the path flipped."""
         flip = self._last_path >= 0 and self._last_path != path
@@ -244,8 +258,10 @@ class FlightRecorder:
             n_topics, n_unique, path, reason, flip, min(pipe_occ, 255),
             rate_host or 0.0, rate_dev or 0.0,
             bytes_up, bytes_down, verify_fail, churn_slots,
-            lat_s * 1e6, churn_lag_s * 1e6, min(pipe_depth, 255), 0,
-            churn_shed,
+            lat_s * 1e6, churn_lag_s * 1e6, min(pipe_depth, 255),
+            min(prep_group, 255), churn_shed,
+            prep_hash_s * 1e6, prep_pack_s * 1e6, prep_submit_s * 1e6,
+            memo_hits,
         )
         self.n += 1
         if flip:
@@ -288,6 +304,11 @@ class FlightRecorder:
             "churn_lag_ms": float(row["churn_lag_us"]) / 1e3,
             "pipe_occ": int(row["pipe_occ"]),
             "pipe_depth": int(row["pipe_depth"]),
+            "prep_hash_ms": float(row["prep_hash_us"]) / 1e3,
+            "prep_pack_ms": float(row["prep_pack_us"]) / 1e3,
+            "prep_submit_ms": float(row["prep_submit_us"]) / 1e3,
+            "memo_hits": int(row["memo_hits"]),
+            "prep_group": int(row["prep_group"]),
         }
 
     def recent(self, k: int = 32) -> List[Dict]:

@@ -63,8 +63,16 @@ KNOWN_KINDS: Dict[str, str] = {
     "engine.stall": "device fetch exceeded its timeout budget",
     "engine.churn": "one apply_churn batch applied to host truth",
     "engine.churn.shed": "churn ops shed: demand exceeded apply capacity",
-    "engine.pipeline": "dispatch-window event (drain / window-full)",
+    "engine.pipeline": "dispatch-window event (drain / window-full / "
+                       "prep-degrade)",
     "engine.kcap": "adaptive compact-return cap shrank toward traffic",
+    # fused prep pipeline (ops/prep.py + parallel/sharded.py): per-tick
+    # sub-stage attribution of the formerly opaque prep phase
+    "engine.prep.hash": "fused prep split+hash+memo+dedup sub-stage",
+    "engine.prep.pack": "fused prep staging-buffer gather+pad sub-stage",
+    "engine.prep.submit": "packed batch handed to the mesh dispatch "
+                          "(group assembly + device_put; group = "
+                          "coalesced prep-ahead ticks in one dispatch)",
     # table checkpoint & warm restart (checkpoint/ subsystem)
     "engine.ckpt.save": "table snapshot persisted; WAL acked to watermark",
     "engine.ckpt.restore": "warm restart: snapshot loaded + WAL tail replayed",

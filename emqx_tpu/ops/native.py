@@ -53,6 +53,7 @@ _SRCS = [
     os.path.join(_SRC_DIR, "matchhash.cc"),
     os.path.join(_SRC_DIR, "registry.cc"),
     os.path.join(_SRC_DIR, "churn.cc"),
+    os.path.join(_SRC_DIR, "prep.cc"),
     os.path.join(_SRC_DIR, "bcrypt.cc"),
 ]
 _PYMOD_SRC = os.path.join(_SRC_DIR, "pymod.cc")
@@ -210,6 +211,31 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.etpu_churn_ingest.argtypes = [
         ctypes.c_void_p, _u8p, _i64p, _i32p, _i64p, ctypes.c_int32,
         _i32p, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.etpu_prep_new.restype = ctypes.c_void_p
+    lib.etpu_prep_new.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, _u32p, _u32p, _u32p, _u32p,
+    ]
+    lib.etpu_prep_free.restype = None
+    lib.etpu_prep_free.argtypes = [ctypes.c_void_p]
+    lib.etpu_prep_set_cap.restype = None
+    lib.etpu_prep_set_cap.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.etpu_prep_stats.restype = None
+    lib.etpu_prep_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.etpu_prep_lookup.restype = ctypes.c_int32
+    lib.etpu_prep_lookup.argtypes = [ctypes.c_void_p, _u8p, ctypes.c_int64]
+    lib.etpu_prep_hash.restype = ctypes.c_int32
+    lib.etpu_prep_hash.argtypes = [
+        ctypes.c_void_p, _u8p, _i64p, ctypes.c_int32, _i64p,
+    ]
+    lib.etpu_prep_pack.restype = None
+    lib.etpu_prep_pack.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        _u32p, _i64p,
+    ]
+    lib.etpu_prep_rows.restype = None
+    lib.etpu_prep_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, _u32p, _u32p, _i32p, _u8p,
     ]
     lib.etpu_bcrypt_init.restype = None
     lib.etpu_bcrypt_init.argtypes = [_u32p]
@@ -752,6 +778,92 @@ class ChurnPlane:
             data[ol[i]:ol[i + 1]].decode("utf-8"): int(f)
             for i, f in enumerate(fids.tolist())
         }
+
+
+class NativePrepPlane:
+    """Handle on the C++ fused prep plane (native/prep.cc).
+
+    Owns the two-generation topic memo + hashed row store; one
+    `hash_batch` + `pack_into` pair per tick replaces the per-topic
+    Python memo walk and the staging-buffer fill — both calls run with
+    the GIL released, parallel over the worker pool.  NOT internally
+    synchronized: callers (ops/prep.py TopicPrep) serialize access
+    behind one lock, like ChurnPlane's single-apply discipline.
+    Freed via weakref.finalize."""
+
+    __slots__ = ("ptr", "max_levels", "_finalizer", "__weakref__")
+
+    def __init__(self, space, cap: int):
+        import weakref
+
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native lib unavailable")
+        c = np.ascontiguousarray
+        self.max_levels = space.max_levels
+        self.ptr = lib.etpu_prep_new(
+            space.max_levels, cap,
+            c(space.C[0]).ctypes.data_as(_u32p),
+            c(space.C[1]).ctypes.data_as(_u32p),
+            c(space.R[0]).ctypes.data_as(_u32p),
+            c(space.R[1]).ctypes.data_as(_u32p),
+        )
+        self._finalizer = weakref.finalize(self, lib.etpu_prep_free, self.ptr)
+
+    def set_cap(self, cap: int) -> None:
+        get_lib().etpu_prep_set_cap(self.ptr, int(cap))
+
+    def stats(self):
+        """(hits, misses, live entries, old entries, stored rows)."""
+        out = np.zeros(8, dtype=np.int64)
+        get_lib().etpu_prep_stats(self.ptr, out.ctypes.data_as(_i64p))
+        return tuple(int(x) for x in out[:5])
+
+    def lookup_gen(self, topic: str) -> int:
+        """Generation holding the topic: 0 live, 1 old-only, -1 absent."""
+        b = topic.encode("utf-8")
+        buf = (ctypes.c_uint8 * max(len(b), 1)).from_buffer_copy(b or b"\0")
+        return int(get_lib().etpu_prep_lookup(self.ptr, buf, len(b)))
+
+    def hash_batch(self, tbuf: np.ndarray, toffs: np.ndarray, n: int):
+        """Memo+split+hash the packed batch; returns
+        (max_len, ns, batch_hits, batch_misses)."""
+        out3 = (ctypes.c_int64 * 3)()
+        maxlen = get_lib().etpu_prep_hash(
+            self.ptr,
+            np.ascontiguousarray(tbuf).ctypes.data_as(_u8p),
+            np.ascontiguousarray(toffs).ctypes.data_as(_i64p),
+            n, ctypes.cast(out3, _i64p),
+        )
+        return int(maxlen), int(out3[0]), int(out3[1]), int(out3[2])
+
+    def pack_into(self, n: int, B: int, L: int, buf: np.ndarray) -> int:
+        """Gather the last hashed batch into buf [B, 2L+2]; returns ns."""
+        ns = ctypes.c_int64(0)
+        get_lib().etpu_prep_pack(
+            self.ptr, n, B, L, buf.ctypes.data_as(_u32p), ctypes.byref(ns)
+        )
+        return int(ns.value)
+
+    def rows(self, n: int):
+        """Full-width (ta, tb, ln, dl) arrays of the last hashed batch."""
+        L = self.max_levels
+        ta = np.empty((n, L), dtype=np.uint32)
+        tb = np.empty((n, L), dtype=np.uint32)
+        ln = np.empty(n, dtype=np.int32)
+        dl = np.empty(n, dtype=np.uint8)
+        get_lib().etpu_prep_rows(
+            self.ptr, n, ta.ctypes.data_as(_u32p), tb.ctypes.data_as(_u32p),
+            ln.ctypes.data_as(_i32p), dl.ctypes.data_as(_u8p),
+        )
+        return ta, tb, ln, dl
+
+
+def make_prep_plane(space, cap: int) -> Optional[NativePrepPlane]:
+    """A new native fused prep plane, or None when the lib is absent."""
+    if get_lib() is None:
+        return None
+    return NativePrepPlane(space, cap)
 
 
 def make_churn_plane(space, n_shards: int = 16) -> Optional[ChurnPlane]:

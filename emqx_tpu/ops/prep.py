@@ -1,0 +1,510 @@
+"""Fused publish-tick prep: split + hash + topic memo + dedup + pack.
+
+Prep was ~80% of a sharded-mesh tick's host time (BENCH_TABLE.md mesh
+phase columns pre-PR 12): per-tick Python memo walks, four gathered
+arrays, and a staging-buffer fill, all GIL-bound.  This module collapses
+the whole stage into ONE native pass (`native/prep.cc etpu_prep_hash` +
+`etpu_prep_pack`, sharing `match_core.h` topic hashing with
+`matchhash.cc`): the two-generation topic memo moves behind the native
+boundary — C++-owned, the ChurnPlane discipline — and the split, hash,
+memo lookup/promotion, in-tick dedup, and bucket-padded `[B, 2L+2]` u32
+buffer fill run GIL-released, parallel over the worker pool.
+
+Two classes:
+
+* :class:`TopicPrep` — the prep op front.  Native plane when the lib is
+  present; otherwise the pure-Python two-generation memo (moved here
+  from `parallel/sharded.py`, PR 7) serves as the lib-less fallback AND
+  as the serial oracle the fused-prep property test pins bit-for-bit
+  (hashes, memo promotion behavior, bucket padding, dedup order).  Also
+  owns the persistent staging-buffer pool ("pre-pinned" per-(B, L)
+  buffers recycled across ticks).
+* :class:`PrepStage` — the prep-ahead pipeline stage: a persistent
+  worker thread that runs `TopicPrep.pack` for tick N+1..N+depth while
+  tick N's dispatch is in flight.  Tickets degrade safely: a stalled
+  worker (fault site ``engine.prep``) makes the consumer fall back to
+  inline prep instead of freezing the dispatch window.
+
+Thread model: `TopicPrep` state mutates under ONE lock (the prep-ahead
+worker and the engine's inline path share the memo); `PrepTicket`
+handoff is an Event + per-ticket lock; the stage's submit-order list is
+only touched on the submitter's thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import native as _native
+from .match import next_pow2
+
+__all__ = ["TopicPrep", "PrepStage", "PrepTicket", "PrepResult"]
+
+
+class PrepResult:
+    """One packed tick: the `[B, 2L+2]` u32 staging buffer plus the
+    sub-stage attribution the flight recorder records per tick."""
+
+    __slots__ = ("buf", "n", "B", "L", "key", "hash_s", "pack_s",
+                 "hits", "misses")
+
+    def __init__(self, buf, n, B, L, key, hash_s, pack_s, hits, misses):
+        self.buf = buf
+        self.n = n
+        self.B = B
+        self.L = L
+        self.key = key  # (B, L): the staging-pool bucket
+        self.hash_s = hash_s  # split+hash+memo+dedup seconds
+        self.pack_s = pack_s  # gather+pad seconds
+        self.hits = hits  # memo hits this tick (in-tick dups included)
+        self.misses = misses  # unique new topics this tick
+
+
+class TopicPrep:
+    """Fused prep front (see module docstring).
+
+    All public entry points serialize on one lock: the prep-ahead worker
+    and the engine's inline path share the memo, and the native plane is
+    not internally synchronized (ChurnPlane discipline).
+    """
+
+    def __init__(self, space, cap: int = 1 << 16, min_batch: int = 64,
+                 use_native: bool = True):
+        self.space = space
+        self.min_batch = min_batch
+        self._lock = threading.Lock()
+        self.plane = _native.make_prep_plane(space, cap) if use_native \
+            else None
+        self._cap = cap
+        # ---- pure-Python fallback memo (PR 7 semantics, bit-for-bit
+        # the native plane's contract; also the property-test oracle).
+        # Every access to this state runs under self._lock — the public
+        # entry points (pack / hash_rows / the counter properties) hold
+        # it around the private memo helpers, which the races pass
+        # cannot see through the call graph, hence the annotations.
+        self._memo: Dict[str, int] = {}  # analysis: owner=any
+        self._memo_old: Dict[str, int] = {}  # analysis: owner=any
+        L = space.max_levels
+        self._memo_ta = np.empty((1024, L), dtype=np.uint32)  # analysis: owner=any
+        self._memo_tb = np.empty((1024, L), dtype=np.uint32)  # analysis: owner=any
+        self._memo_ln = np.empty(1024, dtype=np.int32)  # analysis: owner=any
+        self._memo_dl = np.empty(1024, dtype=np.uint8)  # analysis: owner=any
+        self._memo_n = 0  # filled rows in the memo arrays  # analysis: owner=any
+        self._py_hits = 0  # analysis: owner=any
+        self._py_misses = 0  # analysis: owner=any
+        # ---- persistent staging-buffer pool: per-(B, L) recycled
+        # buffers (np.empty is fine: live rows are fully rewritten and
+        # padded rows only need their length column — stale terms in the
+        # pad region can never match, min_len kills the row)
+        self._bufs: Dict[Tuple[int, int], List[np.ndarray]] = {}
+        self.buf_keep = 8  # per-key retention (>= window depth + slack)
+
+    # ------------------------------------------------------------ counters
+
+    @property
+    def hits(self) -> int:
+        with self._lock:
+            if self.plane is not None:
+                return self.plane.stats()[0]
+            return self._py_hits
+
+    @property
+    def misses(self) -> int:
+        with self._lock:
+            if self.plane is not None:
+                return self.plane.stats()[1]
+            return self._py_misses
+
+    @property
+    def live_n(self) -> int:
+        """Entries in the live memo generation."""
+        with self._lock:
+            if self.plane is not None:
+                return self.plane.stats()[2]
+            return len(self._memo)
+
+    @property
+    def old_n(self) -> int:
+        """Entries in the old (second-chance) generation."""
+        with self._lock:
+            if self.plane is not None:
+                return self.plane.stats()[3]
+            return len(self._memo_old)
+
+    @property
+    def cap(self) -> int:
+        return self._cap
+
+    @cap.setter
+    def cap(self, v: int) -> None:
+        with self._lock:
+            self._cap = int(v)
+            if self.plane is not None:
+                self.plane.set_cap(int(v))
+
+    def memo_gen(self, topic: str) -> int:
+        """Generation holding the topic: 0 live, 1 old-only, -1 absent
+        (tests/introspection)."""
+        with self._lock:
+            if self.plane is not None:
+                return self.plane.lookup_gen(topic)
+            if topic in self._memo:
+                return 0
+            return 1 if topic in self._memo_old else -1
+
+    # ------------------------------------------------------ staging pool
+
+    def acquire(self, key: Tuple[int, int]) -> np.ndarray:
+        with self._lock:
+            pool = self._bufs.get(key)
+            if pool:
+                return pool.pop()
+        B, L = key
+        return np.empty((B, 2 * L + 2), dtype=np.uint32)
+
+    def release(self, buf: Optional[np.ndarray],
+                key: Optional[Tuple[int, int]]) -> None:
+        if buf is None or key is None:
+            return
+        with self._lock:
+            pool = self._bufs.setdefault(key, [])
+            if len(pool) < self.buf_keep:
+                pool.append(buf)
+
+    def reset_buffers(self) -> None:
+        """Drop pooled staging buffers (checkpoint restore: in-flight
+        pendings were discarded, their buffers with them)."""
+        with self._lock:
+            self._bufs = {}
+
+    # ------------------------------------------------------------ prep op
+
+    def _bucket(self, n: int, maxlen: int) -> Tuple[int, int]:
+        """(B, L) for an n-topic batch whose deepest topic has `maxlen`
+        levels — `ops.match.live_levels` arithmetic from the scalar."""
+        B = max(self.min_batch, next_pow2(max(n, 1)))
+        L_real = max(1, min(self.space.max_levels, maxlen))
+        L = min(self.space.max_levels, L_real + (L_real & 1))
+        return B, L
+
+    def pack(self, topics: List[str],
+             reuse: bool = True) -> PrepResult:
+        """ONE fused prep pass: split + hash + memo + in-tick dedup +
+        bucket-padded pack of a publish tick into a `[B, 2L+2]` u32
+        staging buffer (`ops.match.pack_topic_batch_np` layout).
+
+        ``reuse=False`` packs into a fresh buffer outside the pool (for
+        callers whose buffer lifetime outlives the tick, e.g. the
+        single-chip engine's pipelined pendings)."""
+        n = len(topics)
+        with self._lock:
+            if self.plane is not None:
+                t0 = time.perf_counter()
+                tbuf, toffs = _native.pack_strs(topics)
+                maxlen, _ns, bh, bm = self.plane.hash_batch(tbuf, toffs, n)
+                t1 = time.perf_counter()
+                B, L = self._bucket(n, maxlen)
+                key = (B, L)
+                buf = self._acquire_locked(key) if reuse else \
+                    np.empty((B, 2 * L + 2), dtype=np.uint32)
+                self.plane.pack_into(n, B, L, buf)
+                t2 = time.perf_counter()
+                return PrepResult(buf, n, B, L, key, t1 - t0, t2 - t1,
+                                  bh, bm)
+            t0 = time.perf_counter()
+            h0, m0 = self._py_hits, self._py_misses
+            ta, tb, ln, dl = self._hash_topics_memo(topics)
+            h1, m1 = self._py_hits, self._py_misses
+            t1 = time.perf_counter()
+            maxlen = int(ln.max(initial=1)) if n else 1
+            B, L = self._bucket(n, maxlen)
+            key = (B, L)
+            buf = self._acquire_locked(key) if reuse else \
+                np.empty((B, 2 * L + 2), dtype=np.uint32)
+            buf[:n, :L] = ta[:, :L]
+            buf[:n, L:2 * L] = tb[:, :L]
+            buf[:n, 2 * L] = ln.view(np.uint32)
+            buf[:n, 2 * L + 1] = dl
+            if n < B:
+                buf[n:, 2 * L] = np.uint32(0xFFFFFFFF)  # never match
+            t2 = time.perf_counter()
+            return PrepResult(buf, n, B, L, key, t1 - t0, t2 - t1,
+                              h1 - h0, m1 - m0)
+
+    def _acquire_locked(self, key: Tuple[int, int]) -> np.ndarray:
+        pool = self._bufs.get(key)
+        if pool:
+            return pool.pop()
+        B, L = key
+        return np.empty((B, 2 * L + 2), dtype=np.uint32)
+
+    def hash_rows(self, topics: List[str]):
+        """Memoized split+hash returning full-width (ta, tb, ln, dl)
+        arrays — the `TopicBatch` form (mesh `_prep_batch`, tests)."""
+        n = len(topics)
+        with self._lock:
+            if self.plane is not None:
+                tbuf, toffs = _native.pack_strs(topics)
+                self.plane.hash_batch(tbuf, toffs, n)
+                return self.plane.rows(n)
+            return self._hash_topics_memo(topics)
+
+    # ---------------------------------------------- python fallback memo
+    # (PR 7 two-generation second-chance memo, verbatim semantics; the
+    # native plane replicates these observables bit-for-bit and the
+    # property test in tests/test_prep_pack.py holds them together)
+
+    def _memo_grow(self, need: int) -> None:
+        cap = len(self._memo_ln)
+        while cap < need:
+            cap *= 2
+        L = self.space.max_levels
+        for name, shape in (("_memo_ta", (cap, L)), ("_memo_tb", (cap, L)),
+                            ("_memo_ln", (cap,)), ("_memo_dl", (cap,))):
+            old = getattr(self, name)
+            new = np.empty(shape, dtype=old.dtype)
+            new[: len(old)] = old
+            setattr(self, name, new)
+
+    def _memo_swap(self) -> None:
+        """Second-chance generation swap: the live memo becomes the old
+        generation — its rows compacted to the front of the storage
+        arrays — and the previous old generation (entries unseen for a
+        full generation) is dropped.  Hot topics get promoted back into
+        the live memo on their next hit, so hitting the cap no longer
+        evicts the Zipf head with the tail."""
+        cur = self._memo
+        n = len(cur)
+        if n:
+            idx = np.fromiter(cur.values(), dtype=np.int64, count=n)
+            self._memo_ta[:n] = self._memo_ta[idx]
+            self._memo_tb[:n] = self._memo_tb[idx]
+            self._memo_ln[:n] = self._memo_ln[idx]
+            self._memo_dl[:n] = self._memo_dl[idx]
+        self._memo_old = {t: j for j, t in enumerate(cur)}
+        self._memo = {}
+        self._memo_n = n
+
+    def _hash_topics_memo(self, topics: List[str]):
+        """Batch split+hash through the cross-tick topic memo: repeated
+        topic strings (Zipf traffic, bench batches, retried publishes)
+        fetch their (terms, len, dollar) row from the keyed cache
+        instead of re-paying the native split+hash.  Returns
+        (ta, tb, ln, dl) gathered rows."""
+        from . import hashing
+
+        if len(self._memo) + len(topics) > self._cap >> 1:
+            self._memo_swap()
+        memo = self._memo
+        old = self._memo_old
+        rows: List[int] = []
+        for t in topics:
+            r = memo.get(t, -1)
+            if r < 0 and old:
+                r = old.get(t, -1)
+                if r >= 0:
+                    memo[t] = r  # second chance: promote to the live gen
+            rows.append(r)
+        miss = [i for i, r in enumerate(rows) if r < 0]
+        if miss:
+            uniq = dict.fromkeys(topics[i] for i in miss)
+            miss_list = list(uniq)
+            mta, mtb, mln, mdl = hashing.hash_topics(self.space, miss_list)
+            base = self._memo_n
+            need = base + len(miss_list)
+            if need > len(self._memo_ln):
+                self._memo_grow(need)
+            self._memo_ta[base:need] = mta
+            self._memo_tb[base:need] = mtb
+            self._memo_ln[base:need] = mln
+            self._memo_dl[base:need] = mdl
+            for j, t in enumerate(miss_list):
+                memo[t] = base + j
+            self._memo_n = need
+            for i in miss:
+                rows[i] = memo[topics[i]]
+            self._py_misses += len(miss_list)
+            # hits = rows served from cached lanes (cross-tick repeats
+            # AND in-batch duplicates past each name's first occurrence)
+            self._py_hits += len(topics) - len(miss_list)
+        else:
+            self._py_hits += len(topics)
+        ridx = np.asarray(rows, dtype=np.int64)
+        return (self._memo_ta[ridx], self._memo_tb[ridx],
+                self._memo_ln[ridx], self._memo_dl[ridx])
+
+
+# --------------------------------------------------------------- stage
+
+
+class PrepTicket:
+    """One staged prep job (see PrepStage).
+
+    Lifecycle: queued -> done (res set, event fired) -> claimed by the
+    consumer, or abandoned (timeout/mismatch/teardown: the worker's
+    result — if any — returns its buffer to the pool).  ``pending`` is
+    engine-side bookkeeping: the dispatched `_ShardedPending` when this
+    ticket rode a coalesced group dispatch before being claimed."""
+
+    __slots__ = ("topics", "res", "err", "pending", "_evt", "_lock",
+                 "_state")
+
+    def __init__(self, topics: List[str]):
+        self.topics = topics
+        self.res: Optional[PrepResult] = None
+        self.err: Optional[BaseException] = None
+        self.pending = None  # set by the engine on coalesced dispatch
+        self._evt = threading.Event()
+        self._lock = threading.Lock()
+        self._state = "queued"
+
+    def peek(self) -> Optional[PrepResult]:
+        """The result if prepped and unclaimed, without claiming."""
+        with self._lock:
+            return self.res if self._state == "done" else None
+
+    def claim(self, timeout: float) -> Optional[PrepResult]:
+        """Take ownership of the result; None = not ready in time (the
+        ticket is abandoned: a late worker result is discarded, so the
+        consumer can safely prep inline — the degrade contract)."""
+        if not self._evt.wait(timeout):
+            with self._lock:
+                if self._state == "done":  # finished during the race
+                    self._state = "claimed"
+                    return self.res
+                self._state = "abandoned"
+                return None
+        with self._lock:
+            if self._state != "done":
+                return None
+            self._state = "claimed"
+            return self.res
+
+    def abandon(self) -> Optional[PrepResult]:
+        """Mark abandoned; returns the result if one must be recycled."""
+        with self._lock:
+            res, self.res = self.res, None
+            self._state = "abandoned"
+            return res
+
+    def _fulfill(self, res: Optional[PrepResult],
+                 err: Optional[BaseException]) -> bool:
+        """Worker side: publish the result unless already abandoned."""
+        with self._lock:
+            if self._state != "queued":
+                return False  # abandoned while prepping: caller recycles
+            self.res = res
+            self.err = err
+            self._state = "done" if err is None else "failed"
+            self._evt.set()
+            return True
+
+
+class PrepStage:
+    """Prep-ahead pipeline stage: one persistent worker thread running
+    `TopicPrep.pack` for future ticks while the current tick's dispatch
+    is in flight.
+
+    Lifecycle (PR 10 rules): the thread is retained on the stage and
+    joined by :meth:`close`; the queue sentinel is the cancellation
+    signal.  The fault site ``engine.prep`` (delay action) models a
+    stalled prep worker — consumers degrade to inline prep via
+    `PrepTicket.claim`'s timeout, never freezing the dispatch window.
+    """
+
+    def __init__(self, prep: TopicPrep, name: str = "etpu-prep-ahead"):
+        self._prep = prep
+        self._name = name
+        self._q: "queue.Queue[Optional[PrepTicket]]" = queue.Queue()
+        # submitted-but-undispatched tickets in submit order; touched
+        # only on the submitter's thread (the engine's event loop)
+        self._order: List[PrepTicket] = []  # analysis: owner=loop
+        self._thread: Optional[threading.Thread] = None  # analysis: owner=loop
+        self.prepped = 0  # ticks prepped by the worker  # analysis: owner=any
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, topics: List[str]) -> PrepTicket:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name=self._name, daemon=True
+            )
+            self._thread.start()
+        t = PrepTicket(list(topics))
+        self._order.append(t)
+        self._q.put(t)
+        return t
+
+    @property
+    def ready_count(self) -> int:
+        """Tickets prepped and not yet dispatched/claimed (the
+        prep-ahead occupancy the bench column reports)."""
+        return sum(1 for t in self._order if t.peek() is not None)
+
+    def ready_group(self, key: Tuple[int, int],
+                    limit: int) -> List[PrepTicket]:
+        """The prepped-unclaimed-undispatched ticket PREFIX in the same
+        (B, L) bucket — the coalescible group for a dispatch whose head
+        ticket was just consumed.  Stops at the first gap: coalescing
+        must preserve submit order."""
+        out: List[PrepTicket] = []
+        for t in self._order:
+            if len(out) >= limit:
+                break
+            r = t.peek()
+            if r is None or r.key != key or t.pending is not None:
+                break
+            out.append(t)
+        return out
+
+    def consume(self, ticket: PrepTicket) -> None:
+        """Drop a claimed/dispatched/abandoned ticket from the order."""
+        try:
+            self._order.remove(ticket)
+        except ValueError:
+            pass
+
+    # ----------------------------------------------------------- teardown
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Cancel the worker (sentinel + join) and recycle every
+        undispatched ticket's buffer."""
+        th, self._thread = self._thread, None
+        if th is not None and th.is_alive():
+            self._q.put(None)
+            th.join(timeout)
+        for t in self._order:
+            res = t.abandon()
+            if res is not None:
+                self._prep.release(res.buf, res.key)
+        self._order = []
+
+    # ------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        from .. import fault as _fault
+
+        while True:
+            t = self._q.get()
+            if t is None:
+                return  # sentinel: stage closed
+            if _fault.enabled():
+                # delay-only site: models a stalled prep worker; the
+                # consumer's claim() times out and preps inline
+                _fault.inject("engine.prep", err=False)
+            res = err = None
+            try:
+                res = self._prep.pack(t.topics)
+            except BaseException as e:  # surfaced via ticket.err
+                err = e
+            if not t._fulfill(res, err):
+                # abandoned while prepping: recycle the buffer
+                if res is not None:
+                    self._prep.release(res.buf, res.key)
+            else:
+                self.prepped += 1

@@ -70,11 +70,11 @@ from ..ops.match import (
     DeviceTables,
     TopicBatch,
     apply_delta_impl,
-    live_levels,
     match_batch,
     next_pow2,
     unpack_topic_batch,
 )
+from ..ops.prep import PrepStage, PrepTicket, TopicPrep
 from ..ops.tables import MatchTables
 from .mesh import FILTER_AXIS, make_mesh
 
@@ -465,27 +465,25 @@ class ShardedMatchEngine:
         self._stacked: Optional[DeviceTables] = None
         self._dest_dev: Optional[jax.Array] = None
 
-        # per-tick topic hash memo (ROADMAP item 3): Zipf production
-        # traffic repeats hot names across ticks, and prep re-pays the
-        # native split+hash for every repeat — memoize (terms, len,
-        # dollar) rows keyed by topic string.  Two generations (second
-        # chance): at half-cap the live memo becomes the old generation
-        # and the previous old generation is dropped; an old-generation
-        # hit promotes its row back into the live memo, so the Zipf
-        # head survives eviction while the cold tail ages out.  Purely
-        # a cache of a pure function of (topic, space): never
-        # invalidated by churn.
-        self.topic_memo_cap = 1 << 16
-        self._memo: Dict[str, int] = {}
-        self._memo_old: Dict[str, int] = {}
-        L = self.space.max_levels
-        self._memo_ta = np.empty((1024, L), dtype=np.uint32)
-        self._memo_tb = np.empty((1024, L), dtype=np.uint32)
-        self._memo_ln = np.empty(1024, dtype=np.int32)
-        self._memo_dl = np.empty(1024, dtype=np.uint8)
-        self._memo_n = 0  # filled rows in the memo arrays
-        self.memo_hits = 0
-        self.memo_misses = 0
+        # fused prep front (ops/prep.py): split + hash + two-generation
+        # topic memo + in-tick dedup + bucket-padded pack in ONE native
+        # pass (`native/prep.cc`, GIL-released, worker-pool parallel;
+        # pure-Python fallback when the lib is absent).  The memo arrays
+        # live behind the native boundary (C++-owned, the ChurnPlane
+        # discipline) and the staging-buffer pool rides inside it —
+        # persistent per-(B, L) buffers recycled across ticks.
+        self._prep = TopicPrep(self.space, min_batch=min_batch)
+        # prep-ahead pipeline stage (lazily started; see prep_submit):
+        # a persistent worker preps tick N+1..N+depth while tick N's
+        # dispatch is in flight; a stalled worker degrades to inline
+        # prep at match_submit (fault site engine.prep)
+        self._prep_stage: Optional[PrepStage] = None  # analysis: owner=loop
+        self.prep_timeout = 0.25  # claim wait before the inline degrade
+        self.prep_degraded = 0  # stalled/mismatched tickets served inline
+        # registry mutation generation: a coalesced pre-dispatched tick
+        # is claimable only while the tables it matched against are
+        # still current (any churn bumps this and the drain resolves it)
+        self._mut_gen = 0  # analysis: owner=loop
 
         # ---- pipelined dispatch window (engine.pipeline_depth) --------
         # Up to `pipeline_depth` submitted-but-unresolved ticks share the
@@ -509,7 +507,10 @@ class ShardedMatchEngine:
         self._eff_depth = self.pipeline_depth
         self.drain_clamp = 0.5  # churn-drain EWMA above this -> eff 1
         self._drain_ewma = 0.0
-        self.depth_probe_interval = 128  # ticks between loser re-probes
+        self.depth_probe_interval = 64  # ticks between loser re-probes
+        # (64: a stuck verdict re-probes within ~1.5 bench windows —
+        # the coalesced group dispatch only shows its win while deep
+        # actually serves, so the idle mode must get its chance often)
         self.depth_probe_len = 6  # submit-interval samples per verdict
         self.depth_margin = 0.05  # deep must win by this to serve
         self.depth_win_streak = 2  # consecutive winning verdicts needed
@@ -520,11 +521,9 @@ class ShardedMatchEngine:
         self._dw_cost: Dict[bool, Optional[float]] = {True: None,
                                                       False: None}
         self._dw_age: Dict[bool, int] = {True: 0, False: 0}
-        # per-(B, L) reusable host staging buffers for the packed topic
-        # batch (the pinned-staging analog: one np buffer per in-flight
-        # tick per bucket, recycled at resolve so pipelined ticks never
-        # rewrite a buffer a still-running device_put may alias)
-        self._staging: Dict[Tuple[int, int], List[np.ndarray]] = {}
+        # (the per-(B, L) staging-buffer pool lives in self._prep —
+        # recycled at resolve so pipelined ticks never rewrite a buffer
+        # a still-running device_put may alias)
         # adaptive per-chip compact-return cap: k tracks the OBSERVED
         # per-chip hit maximum (shrinks toward it every
         # kcap_adapt_interval ticks, regrows on overflow), cutting the
@@ -644,6 +643,7 @@ class ShardedMatchEngine:
         return res
 
     def add_filter(self, filt: str, sub_shard: Optional[int] = None) -> int:
+        self._mut_gen += 1  # pre-dispatched prepped ticks go stale
         if self._plane is not None:
             res = self._plane_apply([filt], [])
             fid = int(res.fids[0])
@@ -707,6 +707,7 @@ class ShardedMatchEngine:
         BEFORE any registry state is written, so a failed insert leaves
         the engine exactly as it was (only the fid allocator is rolled
         back)."""
+        self._mut_gen += 1  # pre-dispatched prepped ticks go stale
         if self._plane is not None:
             if not isinstance(filts, list):
                 filts = list(filts)
@@ -818,6 +819,8 @@ class ShardedMatchEngine:
         stream keeps the same two-record framing as the fallback."""
         import time
 
+        self._mut_gen += 1  # pre-dispatched prepped ticks go stale
+
         if self._plane is not None:
             t0 = time.monotonic()
             if not isinstance(adds, list):
@@ -888,6 +891,7 @@ class ShardedMatchEngine:
         return out
 
     def remove_filter(self, filt: str) -> Optional[int]:
+        self._mut_gen += 1  # pre-dispatched prepped ticks go stale
         if self._plane is not None:
             if self._plane.lookup(filt) is None:
                 return None  # unknown filter: no mutation, no hook
@@ -1002,6 +1006,7 @@ class ShardedMatchEngine:
         """Adopt a sharded snapshot wholesale; the stacked device mirror
         is dropped so the next dispatch restacks from the restored
         shards in one upload."""
+        self._mut_gen += 1  # pre-dispatched prepped ticks go stale
         from ..checkpoint.store import nul_to_packed, unpack_nul_list
         from ..ops import native as _native
 
@@ -1065,7 +1070,7 @@ class ShardedMatchEngine:
             self._stacked = None  # restack from restored shards
             self._dest_dev = None
             self._inflight = []
-            self._staging = {}
+            self._prep.reset_buffers()
             return n_filts
         filts = unpack_nul_list(arrays["reg/nul"], n_filts)
         fids = arrays["reg/fid"].tolist()
@@ -1098,7 +1103,7 @@ class ShardedMatchEngine:
         self._stacked = None  # restack from restored shards on next sync
         self._dest_dev = None
         self._inflight = []
-        self._staging = {}
+        self._prep.reset_buffers()
         return len(filts)
 
     # --------------------------------------------------------------- sync
@@ -1210,122 +1215,45 @@ class ShardedMatchEngine:
     # ------------------------------------------------- pipelined prep/fetch
 
     def _acquire_staging(self, key: Tuple[int, int]) -> np.ndarray:
-        pool = self._staging.get(key)
-        if pool:
-            return pool.pop()
-        B, L = key
-        # np.empty is fine: live rows are fully rewritten, and padded
-        # rows only need their length column (-1) — stale terms in the
-        # pad region can never match (min_len kills the row)
-        return np.empty((B, 2 * L + 2), dtype=np.uint32)
+        return self._prep.acquire(key)
 
     def _release_staging(self, pending: "_ShardedPending") -> None:
         buf, key = pending.buf, pending.bufkey
         pending.buf = None
-        if buf is None or key is None:
-            return
-        pool = self._staging.setdefault(key, [])
-        if len(pool) <= self.pipeline_depth + 1:
-            pool.append(buf)
+        self._prep.release(buf, key)
 
-    def _memo_grow(self, need: int) -> None:
-        cap = len(self._memo_ln)
-        while cap < need:
-            cap *= 2
-        L = self.space.max_levels
-        for name, shape in (("_memo_ta", (cap, L)), ("_memo_tb", (cap, L)),
-                            ("_memo_ln", (cap,)), ("_memo_dl", (cap,))):
-            old = getattr(self, name)
-            new = np.empty(shape, dtype=old.dtype)
-            new[: len(old)] = old
-            setattr(self, name, new)
+    # ---- topic-memo telemetry/compat (the memo itself lives in the
+    # fused prep plane, ops/prep.py — C++-owned when the lib is present)
 
-    def _memo_swap(self) -> None:
-        """Second-chance generation swap: the live memo becomes the old
-        generation — its rows compacted to the front of the storage
-        arrays — and the previous old generation (entries unseen for a
-        full generation) is dropped.  Hot topics get promoted back into
-        the live memo on their next hit (`_hash_topics_memo`), so
-        hitting the cap no longer evicts the Zipf head with the tail."""
-        cur = self._memo
-        n = len(cur)
-        if n:
-            idx = np.fromiter(cur.values(), dtype=np.int64, count=n)
-            self._memo_ta[:n] = self._memo_ta[idx]
-            self._memo_tb[:n] = self._memo_tb[idx]
-            self._memo_ln[:n] = self._memo_ln[idx]
-            self._memo_dl[:n] = self._memo_dl[idx]
-        self._memo_old = {t: j for j, t in enumerate(cur)}
-        self._memo = {}
-        self._memo_n = n
+    @property
+    def memo_hits(self) -> int:
+        return self._prep.hits
+
+    @property
+    def memo_misses(self) -> int:
+        return self._prep.misses
+
+    @property
+    def topic_memo_cap(self) -> int:
+        return self._prep.cap
+
+    @topic_memo_cap.setter
+    def topic_memo_cap(self, v: int) -> None:
+        self._prep.cap = v
 
     def _hash_topics_memo(self, topics: List[str]):
-        """Batch split+hash through the cross-tick topic memo: repeated
-        topic strings (Zipf traffic, bench batches, retried publishes)
-        fetch their (terms, len, dollar) row from the keyed cache
-        instead of re-paying the native split+hash — the same dedup win
-        submit-time dedup proved on the wire floor, applied to prep.
-        Returns (ta, tb, ln, dl) gathered rows."""
-        if len(self._memo) + len(topics) > self.topic_memo_cap >> 1:
-            self._memo_swap()
-        memo = self._memo
-        old = self._memo_old
-        rows: List[int] = []
-        for t in topics:
-            r = memo.get(t, -1)
-            if r < 0 and old:
-                r = old.get(t, -1)
-                if r >= 0:
-                    memo[t] = r  # second chance: promote to the live gen
-            rows.append(r)
-        miss = [i for i, r in enumerate(rows) if r < 0]
-        if miss:
-            uniq = dict.fromkeys(topics[i] for i in miss)
-            miss_list = list(uniq)
-            mta, mtb, mln, mdl = hashing.hash_topics(self.space, miss_list)
-            base = getattr(self, "_memo_n", 0)
-            need = base + len(miss_list)
-            if need > len(self._memo_ln):
-                self._memo_grow(need)
-            self._memo_ta[base:need] = mta
-            self._memo_tb[base:need] = mtb
-            self._memo_ln[base:need] = mln
-            self._memo_dl[base:need] = mdl
-            for j, t in enumerate(miss_list):
-                memo[t] = base + j
-            self._memo_n = need
-            for i in miss:
-                rows[i] = memo[topics[i]]
-            self.memo_misses += len(miss_list)
-            # hits = rows served from cached lanes (cross-tick repeats
-            # AND in-batch duplicates past each name's first occurrence)
-            self.memo_hits += len(topics) - len(miss_list)
-        else:
-            self.memo_hits += len(topics)
-        ridx = np.asarray(rows, dtype=np.int64)
-        return (self._memo_ta[ridx], self._memo_tb[ridx],
-                self._memo_ln[ridx], self._memo_dl[ridx])
+        """Memoized batch split+hash, full-width rows (tests/TopicBatch
+        path) — delegates to the fused prep front."""
+        return self._prep.hash_rows(list(topics))
 
     def _prep_packed(self, topics: Sequence[str]):
-        """Hash + bucket-pad + pack a publish batch into ONE replicated
-        [B, 2L+2] u32 upload (the single-chip wire format,
-        `ops.match.pack_topic_batch_np` layout): one `device_put` per
-        tick instead of four, assembled into a reusable per-bucket
-        staging buffer.  Topic hashing rides the cross-tick memo
-        (`_hash_topics_memo`).  Returns (pbatch, n, B, L, buf, key)."""
-        n = len(topics)
-        ta, tb, ln, dl = self._hash_topics_memo(list(topics))
-        B = max(self.min_batch, next_pow2(max(n, 1)))
-        L = live_levels(self.space.max_levels, ln)
-        key = (B, L)
-        buf = self._acquire_staging(key)
-        buf[:n, :L] = ta[:, :L]
-        buf[:n, L:2 * L] = tb[:, :L]
-        buf[:n, 2 * L] = ln.view(np.uint32)
-        buf[:n, 2 * L + 1] = dl
-        if n < B:
-            buf[n:, 2 * L] = np.uint32(0xFFFFFFFF)  # length -1: never match
-        return jax.device_put(buf, self._repl()), n, B, L, buf, key
+        """Fused prep + upload of a publish batch: ONE replicated
+        [B, 2L+2] u32 `device_put` from a pooled staging buffer
+        (`ops.prep.TopicPrep.pack`).  Returns (pbatch, n, B, L, buf,
+        key)."""
+        res = self._prep.pack(list(topics))
+        return (jax.device_put(res.buf, self._repl()), res.n, res.B,
+                res.L, res.buf, res.key)
 
     def _fetch_rows(self, n: int, B: int) -> int:
         """Live rows to fetch for an n-topic tick in a B bucket, rounded
@@ -1466,13 +1394,15 @@ class ShardedMatchEngine:
                 # delay-only site (no host fallback on the mesh path):
                 # models a slow collect leg for pipeline-pressure soaks
                 _fault.inject("sharded.collect", err=False)
-            if pending.hits is not None:
-                n = pending.n
-                pending.bytes_down += int(pending.hits.nbytes) + int(
-                    pending.counts.nbytes
-                )
-                hits = np.asarray(pending.hits)[:, :n, :]  # [D, n, k]
-                counts = np.asarray(pending.counts)[:, :n].astype(np.int32)
+            g = pending.group
+            if g is not None:
+                # group-shared dispatch: the device->host materialize
+                # happens ONCE per group (idempotent under the group
+                # lock); each member slices its own row segment
+                pending.bytes_down += g.fetch(self._prep)
+                n, off = pending.n, pending.row_off
+                hits = g.hits_np[:, off:off + n, :]  # [D, n, k]
+                counts = g.counts_np[:, off:off + n].astype(np.int32)
                 k = hits.shape[2]
                 self._note_kmax(int(counts.max(initial=0)))
                 over = (counts > k).any(axis=0)
@@ -1480,7 +1410,7 @@ class ShardedMatchEngine:
                     hits = self._refetch_overflow(pending, hits, counts, over)
                 pending.hits_np = hits
                 pending.counts_np = counts
-                pending.hits = pending.counts = None
+                pending.group = None
             pending.snap = None
             self._release_staging(pending)
             pending.resolved = True
@@ -1526,7 +1456,7 @@ class ShardedMatchEngine:
             )
         pending.bytes_down += int(sub_hits.nbytes)
         sub = np.asarray(sub_hits)[:, :n_sub, :]
-        self._staging.setdefault(key2, []).append(buf2)
+        self._prep.release(buf2, key2)
         k2 = sub.shape[2]  # min(k2, M) inside the kernel
         grown = np.concatenate(
             [hits, np.full(hits.shape[:2] + (k2 - k,), -1, dtype=hits.dtype)],
@@ -1591,7 +1521,71 @@ class ShardedMatchEngine:
         """Broker-facing match: verified fid sets per topic."""
         return self.match_collect(self.match_submit(topics))
 
-    def match_submit(self, topics: Sequence[str]) -> "_ShardedPending":
+    # --------------------------------------------------- prep-ahead stage
+
+    def prep_submit(self, topics: Sequence[str]) -> PrepTicket:
+        """Stage prep for a FUTURE tick on the prep-ahead worker: the
+        packed staging buffer for tick N+k is built (fused native op,
+        GIL-released) while tick N's dispatch is in flight.  Hand the
+        ticket to ``match_submit(topics, prep=ticket)``; a stalled
+        worker degrades to inline prep there (``prep_timeout``), never
+        freezing the dispatch window — the fault site ``engine.prep``
+        exercises exactly that path."""
+        st = self._prep_stage
+        if st is None:
+            st = self._prep_stage = PrepStage(self._prep)
+        return st.submit(list(topics))
+
+    @property
+    def prep_ready(self) -> int:
+        """Tickets prepped-ahead and not yet dispatched (occupancy
+        telemetry for the bench's prep-ahead column)."""
+        st = self._prep_stage
+        return 0 if st is None else st.ready_count
+
+    def close(self) -> None:
+        """Tear down the prep-ahead stage: worker joined via the queue
+        sentinel, undispatched ticket buffers recycled (PR 10 lifecycle
+        discipline).  Idempotent; the stage restarts lazily on the next
+        prep_submit."""
+        st, self._prep_stage = self._prep_stage, None
+        if st is not None:
+            st.close()
+
+    def prep_discard(self, ticket: PrepTicket) -> None:
+        """Abandon a staged ticket whose tick never materialized (e.g.
+        every message of the batch was hook-dropped): the worker's
+        buffer — if it got that far — recycles into the pool."""
+        st = self._prep_stage
+        if st is not None:
+            st.consume(ticket)
+        r = ticket.abandon()
+        if r is not None:
+            self._prep.release(r.buf, r.key)
+
+    def _claim_ticket(self, ticket: PrepTicket, topics: List[str]):
+        """Claim a prep-ahead ticket's result for THIS tick; None means
+        degrade to inline prep (stalled worker / failed pack / topics
+        mismatch).  The ticket is consumed from the stage either way."""
+        st = self._prep_stage
+        if st is not None:
+            st.consume(ticket)
+        r = ticket.claim(self.prep_timeout)
+        if r is not None and ticket.topics == topics:
+            return r
+        if r is not None:  # mismatched topics: recycle the buffer
+            self._prep.release(r.buf, r.key)
+        self.prep_degraded += 1
+        if _tps._active:
+            tp("engine.pipeline", event="prep-degrade",
+               reason="stall" if r is None else "mismatch")
+        return None
+
+    # -------------------------------------------------------------- submit
+
+    def match_submit(
+        self, topics: Sequence[str], prep: Optional[PrepTicket] = None
+    ) -> "_ShardedPending":
         """Dispatch the sharded match WITHOUT blocking (three-phase
         publish contract, broker.publish_submit).  ALL engine-state
         mutation (delta drain, restack, dest refresh) happens here on
@@ -1600,33 +1594,61 @@ class ShardedMatchEngine:
 
         PIPELINED: up to ``pipeline_depth`` submitted-but-unresolved
         ticks may be in flight at once, all sharing the same stacked
-        tables through the NON-donating packed match — host prep of tick
-        N+1 overlaps device compute of tick N and the async fetch of
-        tick N-1.  Past the window the oldest tick is force-resolved
-        (its compute is ≥depth ticks old, so the fetch is ~a memcpy).
+        tables through the NON-donating packed match.  Past the window
+        the oldest tick is force-resolved (its compute is ≥depth ticks
+        old, so the fetch is ~a memcpy).
 
-        Pending subscription churn is FUSED into the same dispatch
-        (`sharded_step_compact_packed`), so a churn tick costs one mesh
-        round trip like a pure match tick; churn ticks DONATE the table
-        buffers (no on-device copy), which first drains the window so no
-        pending still references the pre-step table version.  The return
-        is the compact [D, rows, k] top-fid block (live rows only, u16
-        counts); the rare per-chip overflow (one topic matching more
-        than ``k`` filters on a single chip) refetches just the
-        overflowing topics at resolve time with a widened k, against
-        THIS tick's tables — never the full [D, B, M] row."""
+        PREP-AHEAD + COALESCED DISPATCH: with ``prep`` (a ticket from
+        :meth:`prep_submit`) the packed upload buffer was built by the
+        prep-ahead worker while earlier dispatches were in flight; when
+        several consecutive tickets are already prepped in the same
+        (B, L) bucket and the window has room, they ride ONE mesh
+        dispatch (rows concatenated, group sizes 1/2/4 to bound the jit
+        variant set) — the per-dispatch overhead a serialized host pays
+        per tick amortizes over the group, which is the depth-N win the
+        A/B controller cashes in.  Members are pre-dispatched: their
+        later ``match_submit(prep=ticket)`` call returns the already
+        in-flight pending, valid only while the registry generation is
+        unchanged (any churn bumps it; the drain already resolved the
+        group, and the claim falls back to a fresh dispatch).
+
+        Pending subscription churn is FUSED into the dispatch
+        (`sharded_step_compact_packed`, never coalesced), donating the
+        table buffers after a window drain, as before.  The rare
+        per-chip overflow refetches just the overflowing topics at
+        resolve time against THIS tick's tables."""
         import time
 
         t0 = time.monotonic()
+        topics = list(topics)
+        ticket = prep
+        if ticket is not None and ticket.pending is not None:
+            # pre-dispatched member of an earlier coalesced group
+            p = ticket.pending
+            st = self._prep_stage
+            if st is not None:
+                st.consume(ticket)
+            if p.mut_gen == self._mut_gen and ticket.topics == topics:
+                self._depth_window(t0, False)  # keep the A/B sampled
+                return p
+            # stale (registry mutated since the group dispatch — the
+            # churn drain already resolved it) or mismatched topics:
+            # fall through to a fresh dispatch with inline prep
+            ticket = None
         deep = (
             [self._deep.match(t) & self._deep_fids for t in topics]
             if self._deep_fids
             else None
         )  # snapshotted at submit: collect may run on an executor thread
         if not any(t.n_entries for t in self.shards):
-            p = _ShardedPending(
-                None, None, None, 0, list(topics), deep, t0=t0
-            )
+            if ticket is not None:
+                st = self._prep_stage
+                if st is not None:
+                    st.consume(ticket)
+                r = ticket.abandon()
+                if r is not None:
+                    self._prep.release(r.buf, r.key)
+            p = _ShardedPending(None, 0, topics, deep, t0=t0)
             p.resolved = True
             return p
         slots, ka, kb, vv = self._pre_step_sync()
@@ -1636,42 +1658,127 @@ class ShardedMatchEngine:
             # donation below invalidates the tables every in-flight tick
             # still snapshots (overflow refetch): drain the window first
             self._drain_window("churn-fuse")
-        pbatch, n, B, _L, buf, key = self._prep_packed(topics)
+        # ---- prep: claim the prep-ahead ticket, else pack inline ------
+        res = None
+        ahead = False
+        if ticket is not None:
+            res = self._claim_ticket(ticket, topics)
+            ahead = res is not None
+        if res is None:
+            res = self._prep.pack(topics)
+        n, B, L, key = res.n, res.B, res.L, res.key
+        # ---- coalesce: fold following already-prepped tickets into
+        # this dispatch (pure-match ticks only; group size bounded by
+        # the effective window and rounded down to 1/2/4)
+        extras: List[Tuple[PrepTicket, "PrepResult"]] = []
+        st = self._prep_stage
+        if slots is None and ahead and st is not None and eff_depth > 1:
+            # group members share ONE dispatch's device buffers, so the
+            # group is bounded by the window depth itself (they are the
+            # next ticks' pendings either way); a 2x-occupancy guard
+            # keeps a slow collector from ballooning the in-flight set
+            avail = (max(eff_depth - 1, 0)
+                     if len(self._inflight) < 2 * eff_depth else 0)
+            cand = st.ready_group(key, min(avail, 3))
+            k_total = 1 + len(cand)
+            k_total = 4 if k_total >= 4 else (2 if k_total >= 2 else 1)
+            for t in cand[: k_total - 1]:
+                st.consume(t)
+                r = t.claim(0)  # prepped by construction (peeked)
+                if r is None:  # pragma: no cover - defensive
+                    break
+                extras.append((t, r))
+        K = 1 + len(extras)
+        kc = self._kcap_dyn
+        t_asm = time.perf_counter()
+        if K > 1:
+            # one [K*B, 2L+2] upload for the whole group, assembled in a
+            # pooled buffer; member buffers recycle immediately (copied)
+            gkey = (K * B, L)
+            big = self._prep.acquire(gkey)
+            big[0:B] = res.buf
+            self._prep.release(res.buf, key)
+            for j, (_t, r) in enumerate(extras):
+                big[(j + 1) * B:(j + 2) * B] = r.buf
+                self._prep.release(r.buf, key)
+            pbatch = jax.device_put(big, self._repl())
+        else:
+            big, gkey = None, None
+            pbatch = jax.device_put(res.buf, self._repl())
+        put_s = time.perf_counter() - t_asm
         # wire-byte accounting (flight recorder): the packed topic batch
         # is the upload payload (counted once — replication is the mesh
         # fabric's job, not the host link's), plus churn deltas
-        bytes_up = buf.nbytes
-        kc = self._kcap_dyn
         if slots is not None:
-            bytes_up += slots.nbytes + ka.nbytes + kb.nbytes + vv.nbytes
+            bytes_up = res.buf.nbytes + (
+                slots.nbytes + ka.nbytes + kb.nbytes + vv.nbytes
+            )
             put = lambda a: jax.device_put(a, self._shard0())
             self._stacked, hits, counts = sharded_step_compact_packed(
                 self._stacked, put(slots), put(ka), put(kb), put(vv),
                 pbatch, mesh=self.mesh, kcap=kc,
             )
         else:
+            bytes_up = B * (2 * L + 2) * 4
             hits, counts = sharded_match_compact_packed(
                 self._stacked, pbatch, mesh=self.mesh, kcap=kc
             )
         # fetch slimming: transfer only the live topic rows of the
-        # padded bucket (worth a slice dispatch past ~25% padding)
-        rows = self._fetch_rows(n, B)
-        if rows < B and B - rows >= B // 4:
+        # padded bucket (worth a slice dispatch past ~25% padding).
+        # For a group, rows 0..(K-1)*B are earlier members (kept whole);
+        # only the LAST member's padding can be trimmed.
+        n_last = extras[-1][1].n if extras else n
+        rows = (K - 1) * B + self._fetch_rows(n_last, B)
+        if rows < K * B and K * B - rows >= (K * B) // 4:
             hits, counts = _slice_live(hits, counts, rows=rows)
         try:  # start the device->host copy NOW; resolve overlaps it
             hits.copy_to_host_async()
             counts.copy_to_host_async()
         except AttributeError:  # pragma: no cover - older jax
             pass
+        group = _ShardedGroup(hits, counts, K, host_buf=big, buf_key=gkey)
         p = _ShardedPending(
-            hits, counts, self._stacked, n, list(topics), deep,
-            t0=t0, bytes_up=bytes_up,
+            self._stacked, n, topics, deep, t0=t0, bytes_up=bytes_up,
         )
+        p.group = group
+        p.mut_gen = self._mut_gen
         p.churn_slots = churn_slots
-        p.buf, p.bufkey = buf, key
-        self._inflight.append(p)
-        p.pipe_occ = len(self._inflight)
-        p.pipe_depth = self.pipeline_depth
+        if K == 1:
+            p.buf, p.bufkey = res.buf, key  # recycled at resolve
+        p.prep_hash_s = res.hash_s
+        p.prep_pack_s = res.pack_s
+        p.prep_put_s = put_s / K
+        p.memo_hits_tick = res.hits
+        p.prep_group = K
+        members = [p]
+        for j, (t, r) in enumerate(extras):
+            mdeep = (
+                [self._deep.match(tt) & self._deep_fids
+                 for tt in t.topics]
+                if self._deep_fids else None
+            )
+            mp = _ShardedPending(
+                self._stacked, r.n, list(t.topics), mdeep,
+                t0=t0, bytes_up=B * (2 * L + 2) * 4,
+            )
+            mp.group = group
+            mp.mut_gen = self._mut_gen
+            mp.row_off = (j + 1) * B
+            mp.prep_hash_s = r.hash_s
+            mp.prep_pack_s = r.pack_s
+            mp.prep_put_s = put_s / K
+            mp.memo_hits_tick = r.hits
+            mp.prep_group = K
+            t.pending = mp
+            members.append(mp)
+        for mp in members:
+            self._inflight.append(mp)
+            mp.pipe_occ = len(self._inflight)
+            mp.pipe_depth = self.pipeline_depth
+        if _tps._active:
+            tp("engine.prep.hash", ms=res.hash_s * 1e3, n=n)
+            tp("engine.prep.pack", ms=res.pack_s * 1e3, B=B, L=L)
+            tp("engine.prep.submit", ms=put_s * 1e3, group=K, ahead=ahead)
         if len(self._inflight) > eff_depth:
             # bound the window (at the adaptively clamped effective
             # depth): resolve the oldest tick, but ONLY if its device
@@ -1691,7 +1798,8 @@ class ShardedMatchEngine:
 
     @staticmethod
     def _tick_ready(pending: "_ShardedPending") -> bool:
-        out = pending.hits
+        g = pending.group
+        out = g.hits if g is not None else None
         if out is None:
             return True
         try:
@@ -1731,6 +1839,11 @@ class ShardedMatchEngine:
                 lat_s=lat, churn_lag_s=self._churn_lag,
                 pipe_occ=pending.pipe_occ, pipe_depth=pending.pipe_depth,
                 churn_shed=shed,
+                prep_hash_s=pending.prep_hash_s,
+                prep_pack_s=pending.prep_pack_s,
+                prep_submit_s=pending.prep_put_s,
+                memo_hits=pending.memo_hits_tick,
+                prep_group=pending.prep_group,
             )
         if _tps._active:  # gate: skip kwarg evaluation when tracing is off
             tp("engine.tick", path="device", n=len(pending.topics),
@@ -1809,25 +1922,72 @@ class ShardedMatchEngine:
         return res
 
 
+class _ShardedGroup:
+    """One mesh dispatch shared by K >= 1 in-flight ticks.
+
+    Prep-ahead coalescing (ShardedMatchEngine.match_submit): up to
+    `effective_depth` consecutive prepped ticks ride ONE
+    `sharded_match_compact_packed` call with their rows concatenated;
+    each member `_ShardedPending` slices its own [row_off, row_off + n)
+    segment at resolve.  The device->host materialize happens once,
+    under the group lock (members may race from collect threads)."""
+
+    __slots__ = ("hits", "counts", "k", "lock", "hits_np", "counts_np",
+                 "host_buf", "buf_key", "_share")
+
+    def __init__(self, hits, counts, k, host_buf=None, buf_key=None):
+        self.hits = hits  # device [D, rows, k] until fetched
+        self.counts = counts  # device [D, rows] u16 until fetched
+        self.k = k  # member count (1 = uncoalesced dispatch)
+        self.lock = threading.Lock()
+        self.hits_np = None
+        self.counts_np = None
+        # the coalesced [K*B, 2L+2] upload buffer (K>1 only): device_put
+        # may alias it on the CPU backend, so it recycles only once the
+        # dispatch outputs have materialized (fetch)
+        self.host_buf = host_buf
+        self.buf_key = buf_key
+        self._share = 0
+
+    def fetch(self, prep) -> int:
+        """Materialize the dispatch outputs to host ONCE (idempotent,
+        thread-safe); returns each member's wire-byte share of the
+        download leg."""
+        with self.lock:
+            if self.hits_np is None:
+                total = int(self.hits.nbytes) + int(self.counts.nbytes)
+                self._share = total // self.k
+                self.hits_np = np.asarray(self.hits)
+                self.counts_np = np.asarray(self.counts)
+                self.hits = self.counts = None
+                if self.host_buf is not None:
+                    prep.release(self.host_buf, self.buf_key)
+                    self.host_buf = None
+            return self._share
+
+
 class _ShardedPending:
     """An in-flight sharded match (see ShardedMatchEngine.match_submit).
 
     Lives in the engine's pipeline window until `_resolve` fetches its
     device results to `hits_np`/`counts_np` (idempotent under `lock`;
     collect, a window drain, or a window-full force-resolve may race to
-    do it).  After resolve the pending holds numpy data only — no device
-    buffers, no table snapshot, no staging buffer."""
+    do it).  The device outputs live on the shared `_ShardedGroup` (a
+    group of 1 for uncoalesced dispatches); after resolve the pending
+    holds numpy data only — no device buffers, no table snapshot, no
+    staging buffer."""
 
     __slots__ = (
-        "hits", "counts", "snap", "n", "topics", "deep", "t0", "bytes_up",
-        "bytes_down", "churn_slots", "pipe_occ", "pipe_depth", "lock",
-        "resolved", "hits_np", "counts_np", "buf", "bufkey",
+        "group", "row_off", "snap", "n", "topics", "deep", "t0",
+        "bytes_up", "bytes_down", "churn_slots", "pipe_occ", "pipe_depth",
+        "lock", "resolved", "hits_np", "counts_np", "buf", "bufkey",
+        "mut_gen", "prep_hash_s", "prep_pack_s", "prep_put_s",
+        "memo_hits_tick", "prep_group",
     )
 
-    def __init__(self, hits, counts, snap, n, topics, deep=None,
-                 t0=None, bytes_up=0):
-        self.hits = hits
-        self.counts = counts
+    def __init__(self, snap, n, topics, deep=None, t0=None, bytes_up=0):
+        self.group = None  # shared dispatch handle (None = empty tick)
+        self.row_off = 0  # this tick's first row in the group batch
         self.snap = snap  # stacked tables of THIS tick (overflow refetch)
         self.n = n
         self.topics = topics
@@ -1844,3 +2004,9 @@ class _ShardedPending:
         self.counts_np = None  # [D, n] i32 after resolve
         self.buf = None  # staging buffer to recycle at resolve
         self.bufkey = None
+        self.mut_gen = -1  # registry generation this tick matched against
+        self.prep_hash_s = 0.0  # prep sub-stages (flight tick columns)
+        self.prep_pack_s = 0.0
+        self.prep_put_s = 0.0
+        self.memo_hits_tick = 0  # topic-memo hits within this tick
+        self.prep_group = 1  # coalesced dispatch group size

@@ -48,6 +48,37 @@ static inline uint64_t fnv1a64(const uint8_t* s, uint64_t n) {
   return h;
 }
 
+// Split ONE topic on '/' and emit its per-level mix terms — the inner
+// loop of ops/hashing.py hash_topic_batch, bit-for-bit.  Shared by the
+// batch prep entry (matchhash.cc etpu_prep_topics) and the memoized
+// fused prep plane (prep.cc etpu_prep_hash) so the topic-hash semantics
+// cannot drift between the two prep paths.  `ra`/`rb` rows must be
+// zeroed by the caller for levels >= min(level count, max_levels);
+// levels past max_levels are split (they count toward *ln) but not
+// hashed, matching the device kernel's level cap.
+static inline void topic_terms_one(
+    const uint8_t* t, int64_t n, int32_t max_levels,
+    const uint32_t* Ca, const uint32_t* Cb,
+    const uint32_t* Ra, const uint32_t* Rb,
+    uint32_t* ra, uint32_t* rb, int32_t* ln, uint8_t* dl) {
+  *dl = (n > 0 && t[0] == '$') ? 1 : 0;
+  int32_t level = 0;
+  int64_t start = 0;
+  for (int64_t p = 0; p <= n; p++) {
+    if (p == n || t[p] == '/') {
+      if (level < max_levels) {
+        uint64_t h = fnv1a64(t + start, (uint64_t)(p - start)) ^ kPerturb;
+        ra[level] = ((uint32_t)h ^ Ca[level]) * Ra[level];
+        rb[level] = ((uint32_t)(h >> 32) ^ Cb[level]) * Rb[level];
+      }
+      level++;
+      start = p + 1;
+    }
+  }
+  // "" splits to one empty level, like Python "".split("/") == [""]
+  *ln = (n == 0) ? 1 : level;
+}
+
 struct FilterKey {
   uint32_t ha, hb, plus_mask;
   int32_t plen;

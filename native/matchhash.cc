@@ -28,8 +28,6 @@ extern "C" {
 
 // shared with registry.cc / churn.cc via match_core.h so the word-hash
 // semantics cannot drift between the prep, match, and churn planes
-static const uint64_t PERTURB = etpu::kPerturb;
-
 static inline uint64_t fnv1a64(const uint8_t* s, uint64_t n) {
     return etpu::fnv1a64(s, n);
 }
@@ -57,29 +55,14 @@ static void prep_topics_range(const uint8_t* data, const int64_t* offsets,
                               const uint32_t* Ra, const uint32_t* Rb,
                               uint32_t* ta, uint32_t* tb, int32_t* ln,
                               uint8_t* dl) {
+    // per-topic split+hash shared with the memoized fused prep plane
+    // (match_core.h topic_terms_one) — one implementation, zero drift
     for (int32_t i = i0; i < i1; i++) {
-        const uint8_t* t = data + offsets[i];
-        int64_t n = offsets[i + 1] - offsets[i];
-        dl[i] = (n > 0 && t[0] == '$') ? 1 : 0;
-        uint32_t* ra = ta + (int64_t)i * max_levels;
-        uint32_t* rb = tb + (int64_t)i * max_levels;
-        int32_t level = 0;
-        int64_t start = 0;
-        for (int64_t p = 0; p <= n; p++) {
-            if (p == n || t[p] == '/') {
-                if (level < max_levels) {
-                    uint64_t h = fnv1a64(t + start, (uint64_t)(p - start)) ^ PERTURB;
-                    uint32_t a = (uint32_t)h;
-                    uint32_t b = (uint32_t)(h >> 32);
-                    ra[level] = (a ^ Ca[level]) * Ra[level];
-                    rb[level] = (b ^ Cb[level]) * Rb[level];
-                }
-                level++;
-                start = p + 1;
-            }
-        }
-        // "" splits to one empty level, like Python "".split("/") == [""]
-        ln[i] = (n == 0) ? 1 : level;
+        etpu::topic_terms_one(
+            data + offsets[i], offsets[i + 1] - offsets[i], max_levels,
+            Ca, Cb, Ra, Rb,
+            ta + (int64_t)i * max_levels, tb + (int64_t)i * max_levels,
+            ln + i, dl + i);
     }
 }
 

@@ -1,0 +1,336 @@
+// Fused native prep pipeline: one GIL-released pass over the packed
+// topic blob that splits, hashes, consults/updates the two-generation
+// topic memo, dedups repeated topics within the tick, and writes the
+// bucket-padded [B, 2L+2] u32 upload buffer directly.
+//
+// This replaces the per-tick Python prep of the sharded mesh path
+// (`parallel/sharded.py _hash_topics_memo` + staging-buffer fill): the
+// memo arrays move behind the native boundary — a C++-owned PrepPlane,
+// the ChurnPlane ownership discipline (churn.cc) — and the whole pass
+// runs with the GIL released (ctypes drops it around every call),
+// parallelized over the persistent worker pool (pool.h) with per-worker
+// index slices.
+//
+// The op is split into TWO entry points because the packed level budget
+// L (ops/match.live_levels) depends on the batch's real depth, which is
+// only known after hashing — the caller sizes the staging buffer
+// between the calls:
+//
+//   etpu_prep_hash   swap check + memo lookup (live, then old
+//                    generation with promotion) + in-tick dedup +
+//                    split/hash of the unique misses (parallel) into
+//                    the row store; returns the batch's max level count
+//   etpu_prep_pack   gather the batch's rows into the caller's
+//                    [B, 2L+2] staging buffer (parallel) + pad the tail
+//                    (length 0xFFFFFFFF: the padded row can never match)
+//
+// Memo semantics are bit-for-bit the Python two-generation memo
+// (PR 7, now `ops/prep.py` — the lib-less fallback AND the property-
+// test oracle): the swap condition (live + batch > cap/2), second-
+// chance promotion of old-generation hits, first-occurrence miss order,
+// and the hit/miss counter arithmetic (in-tick duplicates past a
+// name's first occurrence count as hits) all match exactly.
+//
+// Thread-safety: calls on one plane must be externally serialized (the
+// Python wrapper holds one lock) — the plane itself fans work out to
+// the pool but has no internal synchronization, like ChurnPlane.
+
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "match_core.h"
+#include "pool.h"
+
+namespace {
+
+struct MemoEnt {
+  std::string str;
+  uint64_t h64 = 0;  // fnv1a64(topic bytes): map key, computed once
+  int64_t row = -1;  // index into the plane's row store
+};
+
+// Open-addressed hash -> entry map, insertion-ordered entry vector.
+// No per-entry deletes: a whole generation drops at once (swap), so
+// there are no tombstones — the EntMap economy of churn.cc without the
+// erase machinery.
+struct MemoGen {
+  std::vector<int32_t> slots;  // ent index, -1 empty
+  uint32_t mask = 0;
+  std::vector<MemoEnt> ents;   // insertion order
+
+  void reset() {
+    slots.clear();
+    mask = 0;
+    ents.clear();
+  }
+
+  void reserve_one() {
+    if (slots.empty()) {
+      slots.assign(32, -1);
+      mask = 31;
+      return;
+    }
+    if ((ents.size() + 1) * 2 <= slots.size()) return;
+    size_t cap = slots.size() * 2;
+    slots.assign(cap, -1);
+    mask = (uint32_t)cap - 1;
+    for (size_t ei = 0; ei < ents.size(); ei++) {
+      uint32_t i = (uint32_t)ents[ei].h64 & mask;
+      while (slots[i] != -1) i = (i + 1) & mask;
+      slots[i] = (int32_t)ei;
+    }
+  }
+
+  int32_t find(uint64_t h, const uint8_t* s, int64_t n) const {
+    if (slots.empty()) return -1;
+    uint32_t i = (uint32_t)h & mask;
+    while (true) {
+      int32_t ei = slots[i];
+      if (ei == -1) return -1;
+      const MemoEnt& e = ents[ei];
+      if (e.h64 == h && e.str.size() == (size_t)n &&
+          std::memcmp(e.str.data(), s, (size_t)n) == 0)
+        return ei;
+      i = (i + 1) & mask;
+    }
+  }
+
+  void insert(MemoEnt&& e) {
+    reserve_one();
+    uint32_t i = (uint32_t)e.h64 & mask;
+    while (slots[i] != -1) i = (i + 1) & mask;
+    ents.push_back(std::move(e));
+    slots[i] = (int32_t)ents.size() - 1;
+  }
+};
+
+struct PrepPlane {
+  int32_t L = 0;    // HashSpace.max_levels (row width)
+  int64_t cap = 0;  // topic_memo_cap (swap at half)
+  std::vector<uint32_t> Ca, Cb, Ra, Rb;
+
+  // row store shared by both generations (terms zero-padded past the
+  // hashed levels, exactly like ops/hashing.hash_topics output)
+  std::vector<uint32_t> ta, tb;  // [rows_cap * L]
+  std::vector<int32_t> ln;
+  std::vector<uint8_t> dl;
+  int64_t rows_n = 0;
+
+  MemoGen live, old;
+  int64_t hits = 0, misses = 0;
+
+  // per-batch scratch, valid between _hash and _pack
+  std::vector<int64_t> rows;     // per-topic row index
+  std::vector<uint64_t> h64s;    // per-topic memo key
+  std::vector<int32_t> miss_i;   // first-occurrence miss batch indices
+
+  void grow_rows(int64_t need) {
+    int64_t cap_rows = ln.empty() ? 1024 : (int64_t)ln.size();
+    while (cap_rows < need) cap_rows *= 2;
+    if ((int64_t)ln.size() >= cap_rows) return;
+    ta.resize(cap_rows * L);
+    tb.resize(cap_rows * L);
+    ln.resize(cap_rows);
+    dl.resize(cap_rows);
+  }
+
+  // Second-chance generation swap (ops/prep.py _memo_swap, bit-for-bit
+  // observables): the live generation's rows compact to the front of
+  // the row store — gather-then-write, the numpy fancy-index temporary,
+  // so a promoted entry's low source row is never clobbered before it
+  // is read — the previous old generation drops, and the live memo
+  // restarts empty with the compacted generation as `old`.
+  void swap_gens() {
+    int64_t n = (int64_t)live.ents.size();
+    if (n) {
+      std::vector<uint32_t> tta((size_t)n * L), ttb((size_t)n * L);
+      std::vector<int32_t> tln(n);
+      std::vector<uint8_t> tdl(n);
+      for (int64_t j = 0; j < n; j++) {
+        int64_t r = live.ents[j].row;
+        std::memcpy(&tta[j * L], &ta[r * L], (size_t)L * 4);
+        std::memcpy(&ttb[j * L], &tb[r * L], (size_t)L * 4);
+        tln[j] = ln[r];
+        tdl[j] = dl[r];
+        live.ents[j].row = j;
+      }
+      std::memcpy(ta.data(), tta.data(), tta.size() * 4);
+      std::memcpy(tb.data(), ttb.data(), ttb.size() * 4);
+      std::memcpy(ln.data(), tln.data(), tln.size() * 4);
+      std::memcpy(dl.data(), tdl.data(), tdl.size());
+    }
+    old = std::move(live);
+    live.reset();
+    rows_n = n;
+  }
+};
+
+using Clock = std::chrono::steady_clock;
+
+}  // namespace
+
+extern "C" {
+
+void* etpu_prep_new(int32_t max_levels, int64_t cap,
+                    const uint32_t* Ca, const uint32_t* Cb,
+                    const uint32_t* Ra, const uint32_t* Rb) {
+  PrepPlane* p = new PrepPlane();
+  p->L = max_levels;
+  p->cap = cap;
+  p->Ca.assign(Ca, Ca + max_levels);
+  p->Cb.assign(Cb, Cb + max_levels);
+  p->Ra.assign(Ra, Ra + max_levels);
+  p->Rb.assign(Rb, Rb + max_levels);
+  return p;
+}
+
+void etpu_prep_free(void* h) { delete (PrepPlane*)h; }
+
+void etpu_prep_set_cap(void* h, int64_t cap) { ((PrepPlane*)h)->cap = cap; }
+
+// out8: hits, misses, live entries, old entries, row-store rows, 0, 0, 0
+void etpu_prep_stats(void* h, int64_t* out8) {
+  PrepPlane* p = (PrepPlane*)h;
+  out8[0] = p->hits;
+  out8[1] = p->misses;
+  out8[2] = (int64_t)p->live.ents.size();
+  out8[3] = (int64_t)p->old.ents.size();
+  out8[4] = p->rows_n;
+  out8[5] = out8[6] = out8[7] = 0;
+}
+
+// generation holding the topic: 0 live, 1 old (and not live), -1 absent
+int32_t etpu_prep_lookup(void* h, const uint8_t* s, int64_t n) {
+  PrepPlane* p = (PrepPlane*)h;
+  uint64_t h64 = etpu::fnv1a64(s, (uint64_t)n);
+  if (p->live.find(h64, s, n) >= 0) return 0;
+  if (p->old.find(h64, s, n) >= 0) return 1;
+  return -1;
+}
+
+// Memo+hash phase over a packed topic batch: returns the batch's max
+// level count (for the caller's live_levels bucket choice) and leaves
+// the per-topic row map in plane scratch for etpu_prep_pack /
+// etpu_prep_rows.  out3 = {phase ns, batch hits, batch misses}.
+int32_t etpu_prep_hash(void* h, const uint8_t* tbuf, const int64_t* toffs,
+                       int32_t n, int64_t* out3) {
+  PrepPlane* p = (PrepPlane*)h;
+  auto t0 = Clock::now();
+  // swap condition: strict Python parity (ops/prep.py)
+  if ((int64_t)p->live.ents.size() + n > (p->cap >> 1)) p->swap_gens();
+  p->rows.resize(n);
+  p->h64s.resize(n);
+  p->miss_i.clear();
+  // phase 1 (parallel): memo keys — one fnv pass per topic
+  EtpuPool::inst().parallel_for(n, 256, [&](int32_t i0, int32_t i1) {
+    for (int32_t i = i0; i < i1; i++)
+      p->h64s[i] = etpu::fnv1a64(tbuf + toffs[i],
+                                 (uint64_t)(toffs[i + 1] - toffs[i]));
+  });
+  // phase 2 (serial): lookup / promote / in-tick dedup.  Misses insert
+  // into the live generation immediately, so a repeated new topic later
+  // in the tick resolves to the same row (first-occurrence order).
+  for (int32_t i = 0; i < n; i++) {
+    const uint8_t* s = tbuf + toffs[i];
+    int64_t sn = toffs[i + 1] - toffs[i];
+    int32_t ei = p->live.find(p->h64s[i], s, sn);
+    if (ei >= 0) {
+      p->rows[i] = p->live.ents[ei].row;
+      continue;
+    }
+    ei = p->old.find(p->h64s[i], s, sn);
+    if (ei >= 0) {  // second chance: promote into the live generation
+      MemoEnt e = p->old.ents[ei];
+      p->rows[i] = e.row;
+      p->live.insert(std::move(e));
+      continue;
+    }
+    MemoEnt e;
+    e.str.assign((const char*)s, (size_t)sn);
+    e.h64 = p->h64s[i];
+    e.row = p->rows_n + (int64_t)p->miss_i.size();
+    p->rows[i] = e.row;
+    p->live.insert(std::move(e));
+    p->miss_i.push_back(i);
+  }
+  int32_t nmiss = (int32_t)p->miss_i.size();
+  p->grow_rows(p->rows_n + nmiss);
+  // phase 3 (parallel): split+hash the unique misses into the row store
+  // (disjoint rows per miss — no synchronization needed)
+  int32_t L = p->L;
+  EtpuPool::inst().parallel_for(nmiss, 64, [&](int32_t i0, int32_t i1) {
+    for (int32_t k = i0; k < i1; k++) {
+      int32_t i = p->miss_i[k];
+      int64_t r = p->rows_n + k;
+      std::memset(&p->ta[r * L], 0, (size_t)L * 4);
+      std::memset(&p->tb[r * L], 0, (size_t)L * 4);
+      etpu::topic_terms_one(tbuf + toffs[i], toffs[i + 1] - toffs[i], L,
+                            p->Ca.data(), p->Cb.data(), p->Ra.data(),
+                            p->Rb.data(), &p->ta[r * L], &p->tb[r * L],
+                            &p->ln[r], &p->dl[r]);
+    }
+  });
+  p->rows_n += nmiss;
+  p->hits += n - nmiss;
+  p->misses += nmiss;
+  int32_t maxlen = 0;
+  for (int32_t i = 0; i < n; i++) {
+    int32_t l = p->ln[p->rows[i]];
+    if (l > maxlen) maxlen = l;
+  }
+  out3[0] = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                Clock::now() - t0).count();
+  out3[1] = n - nmiss;
+  out3[2] = nmiss;
+  return maxlen;
+}
+
+// Gather phase: write the hashed batch (plane scratch from the last
+// etpu_prep_hash) into the caller's [B, 2L+2] u32 staging buffer —
+// terms_a | terms_b | length (i32 bit view) | dollar — and pad rows
+// [n, B) with length 0xFFFFFFFF (-1: fails every shape's min_len, so a
+// padded row can never match).  Pad rows' other columns are left as-is,
+// the same contract as the recycled Python staging buffers.
+void etpu_prep_pack(void* h, int32_t n, int32_t B, int32_t L,
+                    uint32_t* out, int64_t* out_ns) {
+  PrepPlane* p = (PrepPlane*)h;
+  auto t0 = Clock::now();
+  int32_t maxL = p->L;
+  int32_t W = 2 * L + 2;
+  EtpuPool::inst().parallel_for(n, 128, [&](int32_t i0, int32_t i1) {
+    for (int32_t i = i0; i < i1; i++) {
+      int64_t r = p->rows[i];
+      uint32_t* dst = out + (int64_t)i * W;
+      std::memcpy(dst, &p->ta[r * maxL], (size_t)L * 4);
+      std::memcpy(dst + L, &p->tb[r * maxL], (size_t)L * 4);
+      dst[2 * L] = (uint32_t)p->ln[r];
+      dst[2 * L + 1] = p->dl[r];
+    }
+  });
+  for (int32_t i = n; i < B; i++) out[(int64_t)i * W + 2 * L] = 0xFFFFFFFFu;
+  *out_ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                Clock::now() - t0).count();
+}
+
+// Full-width row gather (the TopicBatch path + the engine's memo-hash
+// compat surface): [n, max_levels] terms + lengths + dollar flags from
+// the last etpu_prep_hash's batch.
+void etpu_prep_rows(void* h, int32_t n, uint32_t* out_ta, uint32_t* out_tb,
+                    int32_t* out_ln, uint8_t* out_dl) {
+  PrepPlane* p = (PrepPlane*)h;
+  int32_t L = p->L;
+  EtpuPool::inst().parallel_for(n, 128, [&](int32_t i0, int32_t i1) {
+    for (int32_t i = i0; i < i1; i++) {
+      int64_t r = p->rows[i];
+      std::memcpy(out_ta + (int64_t)i * L, &p->ta[r * L], (size_t)L * 4);
+      std::memcpy(out_tb + (int64_t)i * L, &p->tb[r * L], (size_t)L * 4);
+      out_ln[i] = p->ln[r];
+      out_dl[i] = p->dl[r];
+    }
+  });
+}
+
+}  // extern "C"

@@ -249,7 +249,8 @@ def test_sharded_topic_hash_memo(mesh):
     # zero re-hash misses — second-chance survival, the old wholesale
     # clear() re-paid 16 misses here
     eng._hash_topics_memo([f"cold/{i}" for i in range(16)])
-    assert all(t in eng._memo_old for t in batch[:16])
+    # memo_gen: 0 = live generation, 1 = old-only, -1 = evicted
+    assert all(eng._prep.memo_gen(t) == 1 for t in batch[:16])
     eng.topic_memo_cap = 1 << 16  # stop forcing a swap every call
     misses_before = eng.memo_misses
     eng._hash_topics_memo(list(batch[:16]))

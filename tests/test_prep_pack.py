@@ -1,0 +1,161 @@
+"""Fused native prep op vs the serial Python oracle (PR 12 tentpole).
+
+`native/prep.cc etpu_prep_hash`/`etpu_prep_pack` must be bit-for-bit
+identical to the pure-Python two-generation memo + staging pack that is
+both the lib-less fallback and the oracle here (`ops/prep.py TopicPrep`
+with use_native=False): the seeded property test drives interleaved
+batches — mixed depths, empty levels, '$'-prefixed names, Zipf repeats,
+a small cap forcing generation swaps mid-stream — and pins the packed
+buffer contents, the hit/miss counter arithmetic (in-tick dedup), the
+memo generation sizes, and second-chance promotion behavior.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from emqx_tpu.ops import hashing, native
+from emqx_tpu.ops.match import pack_topic_batch_np
+from emqx_tpu.ops.prep import TopicPrep
+
+NATIVE = native.available()
+
+
+def _topic_pool(rng, n=200):
+    words = ["a", "bb", "sensor", "d1", "x" * 40, "", "ünïcode"]
+    pool = []
+    for i in range(n):
+        depth = rng.choice([1, 2, 3, 5, 6, 8, 17, 20])  # incl. > max_levels
+        t = "/".join(rng.choice(words) for _ in range(depth))
+        if rng.random() < 0.15:
+            t = "$sys/" + t
+        pool.append(t)
+    pool.append("")  # the empty topic: one empty level
+    pool.append("a//b//")  # empty middle + trailing levels
+    return pool
+
+
+def _zipf_batch(rng, pool, k):
+    # Zipf-ish: heavy head + uniform tail, in-batch repeats guaranteed
+    out = []
+    for _ in range(k):
+        if rng.random() < 0.6:
+            out.append(pool[rng.randrange(1 + len(pool) // 10)])
+        else:
+            out.append(pool[rng.randrange(len(pool))])
+    return out
+
+
+def _assert_same(res_n, res_p, topics):
+    assert (res_n.n, res_n.B, res_n.L) == (res_p.n, res_p.B, res_p.L)
+    n, L = res_n.n, res_n.L
+    np.testing.assert_array_equal(res_n.buf[:n], res_p.buf[:n])
+    # pad rows: only the length column is defined (stale terms can
+    # never match — min_len kills the row)
+    np.testing.assert_array_equal(
+        res_n.buf[n:, 2 * L], res_p.buf[n:, 2 * L]
+    )
+    assert (res_n.hits, res_n.misses) == (res_p.hits, res_p.misses), topics
+
+
+@pytest.mark.skipif(not NATIVE, reason="native lib unavailable")
+def test_fused_prep_matches_python_oracle_property():
+    """Seeded interleaved batches: native plane == Python oracle on
+    every observable, including generation swaps mid-stream."""
+    for seed in (7, 23, 101):
+        rng = random.Random(seed)
+        space = hashing.HashSpace()
+        # small cap: the swap fires every few batches (live + n > cap/2)
+        pn = TopicPrep(space, cap=160, min_batch=16, use_native=True)
+        pp = TopicPrep(space, cap=160, min_batch=16, use_native=False)
+        assert pn.plane is not None
+        pool = _topic_pool(rng)
+        for step in range(30):
+            k = rng.choice([1, 5, 16, 33, 64])
+            topics = _zipf_batch(rng, pool, k)
+            rn = pn.pack(list(topics))
+            rp = pp.pack(list(topics))
+            _assert_same(rn, rp, topics)
+            # memo observables track each other batch by batch
+            assert pn.hits == pp.hits and pn.misses == pp.misses
+            assert pn.live_n == pp.live_n, step
+            assert pn.old_n == pp.old_n, step
+            pn.release(rn.buf, rn.key)
+            pp.release(rp.buf, rp.key)
+        assert pn.misses > 0 and pn.hits > pn.misses  # Zipf head cached
+
+
+@pytest.mark.skipif(not NATIVE, reason="native lib unavailable")
+def test_fused_prep_second_chance_promotion():
+    """A hot topic survives generation swaps via promotion: after a
+    full generation of cold traffic it sits in the old gen, and its
+    next touch promotes it back with zero new misses — identical on
+    both paths."""
+    space = hashing.HashSpace()
+    pn = TopicPrep(space, cap=40, min_batch=16, use_native=True)
+    pp = TopicPrep(space, cap=40, min_batch=16, use_native=False)
+    hot = ["hot/a", "hot/b"]
+    for prep in (pn, pp):
+        prep.pack(list(hot))
+    for r in range(4):
+        cold = [f"cold/{r}/{i}" for i in range(19)]
+        for prep in (pn, pp):
+            prep.pack(list(hot) + cold)
+        assert pn.live_n == pp.live_n and pn.old_n == pp.old_n
+        assert pn.misses == pp.misses
+    # the hot names never re-missed past their first hash
+    assert pn.memo_gen("hot/a") in (0, 1)
+    assert pn.memo_gen("hot/a") == (0 if "hot/a" in pp._memo else 1)
+    m0 = pn.misses
+    for prep in (pn, pp):
+        prep.pack(list(hot))
+    assert pn.misses == m0 == pp.misses  # promotion, not re-hash
+
+
+def test_python_prep_pack_matches_direct_hash():
+    """The packed buffer equals pack_topic_batch_np over the direct
+    (memo-less) hash of the same batch — the wire-format contract."""
+    space = hashing.HashSpace()
+    prep = TopicPrep(space, min_batch=8, use_native=NATIVE)
+    topics = ["a/b", "$sys/x", "", "a//b", "deep/" * 20 + "end", "a/b"]
+    res = prep.pack(list(topics))
+    ta, tb, ln, dl = hashing.hash_topics(space, list(topics))
+    want = pack_topic_batch_np(
+        ta[:, :res.L], tb[:, :res.L], ln, dl.astype(np.uint8)
+    )
+    np.testing.assert_array_equal(res.buf[: res.n], want)
+    assert res.B >= len(topics) and res.B % 2 == 0
+    # pad rows carry the never-match length sentinel
+    assert (res.buf[res.n:, 2 * res.L] == 0xFFFFFFFF).all()
+    # in-tick dedup: the repeated name costs one miss
+    assert res.misses == len(set(topics))
+    assert res.hits == len(topics) - res.misses
+
+
+def test_prep_empty_batch_and_cap_setter():
+    space = hashing.HashSpace()
+    prep = TopicPrep(space, min_batch=8, use_native=NATIVE)
+    res = prep.pack([])
+    assert res.n == 0 and res.B == 8 and res.L == 2
+    assert (res.buf[:, 2 * res.L] == 0xFFFFFFFF).all()
+    prep.cap = 64  # settable mid-stream (native plane follows)
+    assert prep.cap == 64
+    prep.pack(["x/y"])
+    assert prep.misses == 1
+
+
+def test_hash_rows_full_width():
+    """hash_rows returns the TopicBatch-form arrays, identical to the
+    direct hash (full max_levels width)."""
+    space = hashing.HashSpace()
+    prep = TopicPrep(space, use_native=NATIVE)
+    topics = ["a/b/c", "a/b/c", "$d", "", "x/" * 18 + "y"]
+    ta, tb, ln, dl = prep.hash_rows(list(topics))
+    fta, ftb, fln, fdl = hashing.hash_topics(space, list(topics))
+    np.testing.assert_array_equal(ta, fta)
+    np.testing.assert_array_equal(tb, ftb)
+    np.testing.assert_array_equal(ln, fln)
+    np.testing.assert_array_equal(
+        np.asarray(dl, dtype=bool), np.asarray(fdl, dtype=bool)
+    )

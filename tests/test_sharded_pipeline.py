@@ -318,6 +318,146 @@ def test_adaptive_window_clamp_churn_drain(mesh):
             assert g == ref.match(t)
 
 
+def _wait_prepped(tickets, timeout=5.0):
+    """Busy-wait until every ticket is done-and-unclaimed (peek)."""
+    import time as _t
+
+    deadline = _t.monotonic() + timeout
+    while (any(t.peek() is None for t in tickets)
+           and _t.monotonic() < deadline):
+        _t.sleep(0.001)
+    assert all(t.peek() is not None for t in tickets)
+
+
+def test_prep_ahead_window_matches_oracle(mesh):
+    """Prep-ahead tickets + coalesced group dispatch: K ticks prepped
+    on the worker, submitted through their tickets, collected out of
+    order — results identical to the lock-step oracle, and at least one
+    dispatch actually coalesced (group > 1)."""
+    rng = random.Random(31)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng)
+    eng.pipeline_depth = 4
+    try:
+        saw_group = 0
+        for rnd in range(4):
+            ticks = [_topics(rng, 16) for _ in range(4)]
+            tickets = [eng.prep_submit(t) for t in ticks]
+            # let the worker finish so the coalescible suffix is ready
+            _wait_prepped(tickets)
+            pend = [eng.match_submit(t, prep=tk)
+                    for t, tk in zip(ticks, tickets)]
+            saw_group = max(saw_group, max(p.prep_group for p in pend))
+            for ts, p in reversed(list(zip(ticks, pend))):
+                got = eng.match_collect(p)
+                for t, g in zip(ts, got):
+                    assert g == ref.match(t), t
+        assert saw_group > 1  # coalescing engaged at least once
+        assert eng.prep_degraded == 0
+    finally:
+        eng.close()
+
+
+def test_prep_ahead_stale_after_churn(mesh):
+    """A pre-dispatched coalesced member goes stale when the registry
+    mutates before its claim: match_submit redispatches fresh and the
+    result reflects the churn."""
+    rng = random.Random(32)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng, n=120)
+    eng.pipeline_depth = 4
+    try:
+        probe = "stale/check/x"
+        ticks = [_topics(rng, 8) + [probe] for _ in range(3)]
+        tickets = [eng.prep_submit(t) for t in ticks]
+        _wait_prepped(tickets)
+        pre_probe = ref.match(probe)  # pre-churn oracle for the probe
+        p0 = eng.match_submit(ticks[0], prep=tickets[0])
+        assert p0.prep_group >= 2  # members 1.. pre-dispatched
+        # churn lands between the group dispatch and member claims
+        f = "stale/check/+"
+        eng.apply_churn([f], [])
+        ref.insert(f, eng.fid_of(f))
+        p1 = eng.match_submit(ticks[1], prep=tickets[1])
+        got = eng.match_collect(p1)
+        for t, g in zip(ticks[1], got):
+            assert g == ref.match(t), t  # sees the post-churn table
+        # the head tick (dispatched pre-churn) keeps pre-churn results —
+        # the same snapshot semantics as any in-flight window tick
+        got0 = eng.match_collect(p0)
+        assert got0[-1] == pre_probe  # no post-churn fid leaked in
+        p2 = eng.match_submit(ticks[2], prep=tickets[2])
+        for t, g in zip(ticks[2], eng.match_collect(p2)):
+            assert g == ref.match(t), t
+    finally:
+        eng.close()
+
+
+def test_prep_stalled_degrades_inline(mesh):
+    """Fault site engine.prep: a stalled prep-ahead worker must degrade
+    to inline prep at match_submit (prep_timeout), never freezing the
+    window — the dispatch-breaker discipline applied to prep."""
+    from emqx_tpu import fault
+
+    rng = random.Random(33)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng, n=100)
+    eng.prep_timeout = 0.02
+    try:
+        fault.configure({"engine.prep": {"action": "delay", "delay": 0.5}})
+        ts = _topics(rng, 12)
+        tk = eng.prep_submit(ts)
+        p = eng.match_submit(ts, prep=tk)  # claim times out -> inline
+        assert eng.prep_degraded == 1
+        for t, g in zip(ts, eng.match_collect(p)):
+            assert g == ref.match(t), t
+    finally:
+        fault.reset()
+        eng.close()
+
+
+def test_prep_stage_teardown_clean(mesh):
+    """close() joins the worker (cancellation-clean: queue sentinel) and
+    recycles undispatched ticket buffers; the stage restarts lazily."""
+    rng = random.Random(34)
+    eng = _engine(mesh)
+    _population(eng, BruteForceIndex(), rng, n=50)
+    tk = eng.prep_submit(_topics(rng, 8))
+    tk.claim(5.0)
+    st = eng._prep_stage
+    assert st is not None and st._thread is not None
+    th = st._thread
+    eng.close()
+    assert not th.is_alive()
+    assert eng._prep_stage is None
+    eng.close()  # idempotent
+    tk2 = eng.prep_submit(_topics(rng, 8))  # lazily restarts
+    assert tk2.claim(5.0) is not None
+    eng.close()
+
+
+def test_prep_ticket_topics_mismatch_degrades(mesh):
+    """A ticket whose topics no longer match the submitted batch (hook
+    rewrites, batcher drift) is discarded and prep runs inline."""
+    rng = random.Random(35)
+    eng = _engine(mesh)
+    ref = BruteForceIndex()
+    _population(eng, ref, rng, n=80)
+    try:
+        tk = eng.prep_submit(["one/topic"])
+        _wait_prepped([tk])
+        ts = _topics(rng, 5)
+        p = eng.match_submit(ts, prep=tk)
+        assert eng.prep_degraded >= 1
+        for t, g in zip(ts, eng.match_collect(p)):
+            assert g == ref.match(t), t
+    finally:
+        eng.close()
+
+
 def test_adaptive_window_clamp_measured(mesh):
     """The A/B cost controller clamps to 1 when deep measures no real
     win, and serves deep when it measures one past the margin."""

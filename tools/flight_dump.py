@@ -60,7 +60,8 @@ def format_ticks(rec: FlightRecorder, n: int = 32) -> str:
     if not rows:
         return "(no ticks recorded)"
     hdr = (f"{'tick':>8} {'path':>6} {'reason':<12} {'n':>6} {'uniq':>6} "
-           f"{'occ':>5} {'lat ms':>9} {'up':>9} {'down':>9} "
+           f"{'occ':>5} {'lat ms':>9} {'p.hash':>7} {'p.pack':>7} "
+           f"{'p.sub':>7} {'memo':>6} {'grp':>3} {'up':>9} {'down':>9} "
            f"{'rate_h':>12} {'rate_d':>12} {'vfail':>5} {'churn':>7} "
            f"{'shed':>7}")
     lines = [hdr, "-" * len(hdr)]
@@ -71,6 +72,11 @@ def format_ticks(rec: FlightRecorder, n: int = 32) -> str:
             f"{(r['reason'] or '-') + ('*' if r['flip'] else ''):<12} "
             f"{r['n_topics']:>6} {r['n_unique']:>6} "
             f"{_fmt_occ(r):>5} {r['lat_ms']:>9.3f} "
+            f"{r.get('prep_hash_ms', 0):>7.3f} "
+            f"{r.get('prep_pack_ms', 0):>7.3f} "
+            f"{r.get('prep_submit_ms', 0):>7.3f} "
+            f"{r.get('memo_hits', 0):>6} "
+            f"{r.get('prep_group', 0):>3} "
             f"{_fmt_bytes(r['bytes_up']):>9} "
             f"{_fmt_bytes(r['bytes_down']):>9} "
             f"{_fmt_rate(r['rate_host']):>12} "
@@ -79,7 +85,9 @@ def format_ticks(rec: FlightRecorder, n: int = 32) -> str:
             f"{r.get('churn_shed', 0):>7}"
         )
     lines.append("(* = arbitration flip on this tick; occ = pipeline "
-                 "occupancy at submit / window depth)")
+                 "occupancy at submit / window depth; p.hash/p.pack/"
+                 "p.sub = fused-prep sub-stage ms; memo = topic-memo "
+                 "hits this tick; grp = coalesced-dispatch group size)")
     return "\n".join(lines)
 
 
