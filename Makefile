@@ -3,7 +3,8 @@
 
 .PHONY: check check-json lint lint-fast lint-locks test test-fast \
         native bench restore-bench chaos ds-bench ds-dump ds-soak \
-        churn-bench retained-bench fanout-bench span-bench prep-bench
+        churn-bench retained-bench fanout-bench span-bench prep-bench \
+        wire-bench
 
 # static-analysis gate (tools/analysis/): the dialyzer/xref/elvis
 # analog, stdlib-only — whole-project AST index + call graph, thread-
@@ -103,3 +104,12 @@ churn-bench:
 # writes the BENCH_TABLE.md fused-prep section
 prep-bench:
 	python bench.py --sharded 2 --prep-only
+
+# process-sharded wire plane: aggregate wire deliveries/s over real
+# sockets at 0/1/2 wire workers (hub + SO_REUSEPORT worker pool over
+# unix-socket PeerLinks, per-worker occupancy + rep-spread columns);
+# writes the BENCH_TABLE.md section.  On a multi-core host the gate is
+# >=1.8x aggregate at 2 workers vs 1; on a 1-thread container the
+# sweep measures the IPC tax (no-regression at workers=1).
+wire-bench:
+	python bench.py --wire

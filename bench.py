@@ -34,6 +34,7 @@ reference's ETS-trie analog) measured in the same process.
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import os
 import random
@@ -868,7 +869,7 @@ def run_sharded(subs_cap=None, workload=2):
 
     eng.prep_timeout = 2.0  # bench boxes: never degrade on scheduling
 
-    def _window(n_iters):
+    def _window(n_iters, pin_ops=None):
         """One pipelined window of n_iters ticks (pacer-paced churn).
         The caller-side pending queue is part of the in-flight window,
         so it follows the engine's adaptive effective depth: when the
@@ -879,7 +880,16 @@ def run_sharded(subs_cap=None, workload=2):
         prep stage primed `effective_depth` ticks ahead — the worker
         packs tick N+1..N+depth while tick N's dispatch runs, and
         consecutive prepped tickets coalesce into ONE mesh dispatch
-        (the depth win the A/B controller measures)."""
+        (the depth win the A/B controller measures).
+
+        PINNED PACING (`pin_ops`): the wall-clock pacer feeds back —
+        one slow tick accrues more churn debt, which makes the next
+        tick slower — and on w5 that feedback spread the measured reps
+        8.5k–41k lookups/s (PR 12 note).  Measured windows therefore
+        apply a FIXED `pin_ops` churn ops per tick, calibrated from
+        the settle window's wall clock at the same depth, so every rep
+        retires the same work schedule; the achieved churn/s column
+        still reports work/wall honestly."""
         nonlocal res
         pacer = ChurnPacer(target_cps)
         pacer.last = time.time()
@@ -891,7 +901,10 @@ def run_sharded(subs_cap=None, workload=2):
         c0 = churn_i
         t0 = time.time()
         for i in range(n_iters):
-            if target_cps:
+            if target_cps and pin_ops is not None:
+                if pin_ops:
+                    churn_tick_n(pin_ops)
+            elif target_cps:
                 n_ops = pacer.owed(time.time())
                 if pacer.shed > shed:
                     eng.note_churn_shed(pacer.shed - shed)
@@ -959,8 +972,16 @@ def run_sharded(subs_cap=None, workload=2):
             eng.pipeline_depth = depth
             eng.flight = FlightRecorder(256)
             eng.match(batches[0])  # warm (kcap/bucket variants) + drain
-            _window(SETTLE)
-            wall, churn_n, shed, prep_occ = _window(ITERS_S)
+            settle_wall, _, _, _ = _window(SETTLE)
+            # pin the pacer for the measured window: the same per-tick
+            # churn quota on every rep (calibrated at THIS depth from
+            # the settle wall clock) instead of the wall-clock feedback
+            # loop that made w5 depth-leg reps spread 8.5k-41k
+            pin = (
+                max(round(target_cps * settle_wall / SETTLE), 1)
+                if target_cps else None
+            )
+            wall, churn_n, shed, prep_occ = _window(ITERS_S, pin_ops=pin)
             occ = [r["pipe_occ"] for r in eng.flight.recent(ITERS_S)]
             grp = [r["prep_group"] for r in eng.flight.recent(ITERS_S)]
             rep_rows[depth].append({
@@ -977,6 +998,13 @@ def run_sharded(subs_cap=None, workload=2):
         rows = sorted(rows, key=lambda r: r["rps"])
         row = dict(rows[len(rows) // 2])  # median rep
         row["rps_reps"] = [round(r["rps"]) for r in rows]
+        # the row's own noise bar: (max-min)/median over the reps, so
+        # a BENCH_TABLE reader sees how much run-to-run spread the
+        # median hides (the pinned pacer keeps w5 legs comparable)
+        row["rep_spread_pct"] = (
+            (rows[-1]["rps"] - rows[0]["rps"]) / row["rps"] * 100.0
+            if row["rps"] else 0.0
+        )
         depth_rows[depth] = row
         log(f"sharded e2e depth {depth}: {row['rps']:,.0f} lookups/s "
             f"(occ {row['occ_mean']:.1f}/{depth}, "
@@ -1774,10 +1802,16 @@ def _mesh_section_lines(sharded_rows: dict, single: dict = None) -> list:
         "depth): depth 1 is the lock-step baseline, depth N the "
         "pipelined window; occ = mean flight-recorder occupancy at "
         "submit, prep = mean prep-ahead tickets ready at submit, grp = "
-        "mean coalesced-dispatch group size.  Workloads 3/5 run at 1M "
+        "mean coalesced-dispatch group size; rep spread = "
+        "(max-min)/median over the interleaved reps, the row's own "
+        "noise bar.  Workloads 3/5 run at 1M "
         "resident filters (the virtual mesh shares one host's "
-        "RAM/cores; w5 pays its 5%/sec churn inside the loop, paced by "
-        "wall clock, and so does its CPU baseline).  Virtual devices "
+        "RAM/cores; w5 pays its 5%/sec churn inside the loop — the "
+        "settle window calibrates a FIXED per-tick churn quota at the "
+        "measured depth, so measured reps retire identical schedules "
+        "instead of the wall-clock pacer's feedback loop, which "
+        "spread the old depth legs 8.5k-41k; the CPU baseline pays "
+        "the same churn).  Virtual devices "
         "share this host's cores, so these rows measure the sharded "
         "DISPATCH PATH's overhead/correctness at scale, not ICI "
         "speedup.  PR 12 note: the old prep column (7.6-9.1 ms) LUMPED "
@@ -1789,12 +1823,12 @@ def _mesh_section_lines(sharded_rows: dict, single: dict = None) -> list:
         "host (per-dispatch overhead amortizes over the group; on real "
         "parallel hardware the overlap win stacks on top).",
         "",
-        "| workload | filters | depth | lookups/s | vs cpu | occ | "
-        "prep | grp | p99 ms | prep ms | hash/pack/submit | "
-        "dispatch ms | fetch ms | verify ms | insert/s | "
-        "churn/s applied (target) |",
+        "| workload | filters | depth | lookups/s | rep spread | "
+        "vs cpu | occ | prep | grp | p99 ms | prep ms | "
+        "hash/pack/submit | dispatch ms | fetch ms | verify ms | "
+        "insert/s | churn/s applied (target) |",
         "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-        "---|",
+        "---|---|",
     ]
     for w, s in sorted(sharded_rows.items()):
         ph = s.get("phases", {})
@@ -1811,10 +1845,15 @@ def _mesh_section_lines(sharded_rows: dict, single: dict = None) -> list:
         for dr in s.get("depth_rows") or [
             {"depth": 3, "rps": s["tpu_rps"], "occ_mean": 0.0}
         ]:
+            spread = (
+                f"±{dr['rep_spread_pct']:.0f}%"
+                if dr.get("rep_spread_pct") is not None else "—"
+            )
             lines.append(
                 f"| {w}: {CONFIGS[w][1]} | {s['n_filters']:,} "
                 f"| {dr['depth']} "
                 f"| {dr['rps']:,.0f} "
+                f"| {spread} "
                 f"| {dr['rps']/s['cpu_rps']:.1f}x "
                 f"| {dr['occ_mean']:.1f} "
                 f"| {dr.get('prep_occ_mean', 0.0):.1f} "
@@ -1832,7 +1871,7 @@ def _mesh_section_lines(sharded_rows: dict, single: dict = None) -> list:
         lines.append(
             f"| single-chip hybrid (row 2, tick 4096) "
             f"| {single['n_filters']:,} | — "
-            f"| {single['tpu_rps']:,.0f} "
+            f"| {single['tpu_rps']:,.0f} | — "
             f"| {single['tpu_rps']/single['cpu_rps']:.1f}x | — | | "
             f"| {single['p99_ms']:.2f} | | | | | | "
             f"| {single['insert_rps']:,.0f} | |"
@@ -2136,6 +2175,266 @@ def wire_fanout_rate(n: int) -> float:
     for _ in range(iters):
         b._dispatch(Message(topic="wide/t", payload=b"x" * 128), {fid})
     return iters * n / (time.time() - t0)
+
+
+WIRE_HEADER = "## Process-sharded wire plane"
+
+
+async def _wire_run_one(workers: int, duration: float, reps: int,
+                        n_subs: int, n_pubs: int, payload: int) -> dict:
+    """One pool size W through REAL sockets: boot a hub + W wire
+    workers (W=0 = the in-process listener path), attach `n_subs`
+    subscribers to one fan-out filter and `n_pubs` flat-out QoS0
+    publishers, and count PUBLISH packets landing at the subscriber
+    sockets.  Connections round-robin over the per-worker direct ports
+    so the distribution is deterministic (reuseport's 4-tuple hash is
+    opaque for same-host clients) and every cross-worker IPC forward
+    leg is actually exercised."""
+    import tempfile
+
+    from emqx_tpu.broker.client import MqttClient
+    from emqx_tpu.node import NodeRuntime
+
+    d = tempfile.mkdtemp(prefix=f"wirebench{workers}")
+    raw = {
+        "node": {"name": "bench-hub", "data_dir": d,
+                 "xla_cache_dir": os.path.join(
+                     tempfile.gettempdir(), "etpu-bench-xla-cache")},
+        "listeners": [{"type": "tcp", "port": 0}],
+        "dashboard": {"listen_port": 0},
+    }
+    if workers:
+        raw["wire"] = {"workers": workers, "stats_interval": 0.5}
+    rt = NodeRuntime(raw)
+    await rt.start()
+    try:
+        if workers:
+            sup = rt.wire
+            deadline = time.time() + 120
+            while time.time() < deadline and not all(
+                rt.cluster.status().get(h.name) == "up"
+                for h in sup.workers.values()
+            ):
+                await asyncio.sleep(0.2)
+            ports = [h.direct_port for h in sup.workers.values()]
+        else:
+            ports = [rt.listeners[0].port]
+
+        subs = []
+        counts = [0] * n_subs
+        for i in range(n_subs):
+            c = MqttClient(clientid=f"ws{i}")
+            await c.connect(port=ports[i % len(ports)])
+            await c.subscribe("wire/bench", qos=0)
+            subs.append(c)
+        pubs = []
+        for i in range(n_pubs):
+            c = MqttClient(clientid=f"wp{i}")
+            await c.connect(port=ports[i % len(ports)])
+            pubs.append(c)
+        await asyncio.sleep(1.0 if workers else 0.2)  # route fan-out
+
+        stop = asyncio.Event()
+        body = b"x" * payload
+        published = [0]
+
+        async def drain(k: int) -> None:
+            while not stop.is_set():
+                try:
+                    await subs[k].recv(timeout=0.2)
+                except asyncio.TimeoutError:
+                    continue
+                counts[k] += 1
+
+        # CLOSED-LOOP pump: each publish owes n_subs deliveries; the
+        # pump stays at most `credit` deliveries ahead of what the
+        # subscriber sockets actually received.  An open-loop flood
+        # measures bufferbloat (and on an oversubscribed host, collapse
+        # — kernel buffers absorb minutes of backlog); the credit
+        # window self-clocks the offered load to whatever the system
+        # under test can deliver, on any core count.
+        credit = 32 * n_subs
+
+        async def pump(c) -> None:
+            while not stop.is_set():
+                if published[0] * n_subs - sum(counts) > credit:
+                    await asyncio.sleep(0.002)
+                    continue
+                await c.publish("wire/bench", body, qos=0)
+                published[0] += 1
+                # drain() on an under-watermark buffer completes
+                # synchronously (no suspension): yield explicitly so
+                # the subscriber reads sharing this loop make progress
+                await asyncio.sleep(0)
+
+        rep_rates = []
+        for _rep in range(reps):
+            for k in range(n_subs):
+                counts[k] = 0
+            published[0] = 0
+            stop.clear()
+            tasks = [asyncio.ensure_future(drain(k))
+                     for k in range(n_subs)]
+            tasks += [asyncio.ensure_future(pump(c)) for c in pubs]
+            t0 = time.time()
+            await asyncio.sleep(duration)
+            stop.set()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            wall = time.time() - t0
+            rep_rates.append(sum(counts) / wall)
+        rep_rates.sort()
+        med = rep_rates[len(rep_rates) // 2]
+        spread = ((rep_rates[-1] - rep_rates[0]) / med * 100.0) \
+            if med else 0.0
+        per_worker = {}
+        if workers:
+            await asyncio.sleep(1.0)  # one more stats scrape
+            g = rt.broker.metrics.gauges
+            per_worker = {
+                h.idx: {
+                    "conns": g.get(f"wire.worker.{h.idx}.connections",
+                                   0.0),
+                    "sent": (h.last_stats or {}).get(
+                        "messages_sent", 0),
+                }
+                for h in rt.wire.workers.values()
+            }
+        for c in subs + pubs:
+            try:
+                await c.disconnect()
+            except Exception:
+                pass
+        total = sum(s["sent"] for s in per_worker.values()) or 1
+        return {
+            "workers": workers,
+            "rps": med,
+            "reps": [round(r, 1) for r in rep_rates],
+            "rep_spread_pct": spread,
+            "n_subs": n_subs,
+            "n_pubs": n_pubs,
+            # per-worker occupancy: share of wire deliveries each
+            # worker served (from its own messages.sent counter)
+            "occupancy": {
+                str(i): round(s["sent"] / total, 3)
+                for i, s in per_worker.items()
+            },
+            "conns": {
+                str(i): s["conns"] for i, s in per_worker.items()
+            },
+        }
+    finally:
+        await rt.stop()
+
+
+def run_wire(workers_list=(0, 1, 2), duration: float = 4.0,
+             reps: int = 3, n_subs: int = 30, n_pubs: int = 2,
+             payload: int = 128) -> dict:
+    """Process-sharded wire plane sweep: aggregate wire deliveries/s
+    over real TCP sockets at each pool size, vs the in-process (W=0)
+    listener path.  One fresh interpreter per pool size (same reason
+    as the --all config runs: a second engine generation in one
+    process degrades per-call match latency ~1000x).  On a
+    1-hardware-thread container the workers time-share one core, so
+    the W>=2 rows measure IPC overhead, not scaling — the sweep
+    exists so multi-core hosts get an honest ratio from the same
+    command (`make wire-bench`)."""
+    import subprocess
+    import tempfile
+
+    rows = []
+    for w in workers_list:
+        log(f"wire bench: workers={w}")
+        with tempfile.NamedTemporaryFile(suffix=".json",
+                                         delete=False) as tf:
+            stats_path = tf.name
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--wire-one",
+             str(w), "--emit-stats", stats_path],
+            stdout=subprocess.PIPE, timeout=1800,
+        )
+        if r.returncode != 0:
+            log(f"wire bench w{w} failed (rc={r.returncode}); "
+                "row omitted")
+            os.unlink(stats_path)
+            continue
+        with open(stats_path, "r", encoding="utf-8") as f:
+            rows.append(json.load(f))
+        os.unlink(stats_path)
+        log(f"  -> {rows[-1]['rps']:,.0f} deliveries/s "
+            f"(reps {rows[-1]['reps']}, "
+            f"spread {rows[-1]['rep_spread_pct']:.0f}%)")
+    base = rows[0]["rps"] if rows and rows[0]["workers"] == 0 else None
+    for r in rows:
+        r["vs_inproc"] = (r["rps"] / base) if base else None
+    host_threads = os.cpu_count() or 1
+    return {
+        "rows": rows,
+        "host_threads": host_threads,
+        "n_subs": n_subs,
+        "n_pubs": n_pubs,
+        "payload": payload,
+    }
+
+
+def _wire_section_lines(s: dict) -> list:
+    lines = [
+        "",
+        f"{WIRE_HEADER} (aggregate wire deliveries/s, real sockets)",
+        "",
+        f"Hub + W wire-worker PROCESSES (SO_REUSEPORT listener pool, "
+        f"unix-socket PeerLinks, see README): {s['n_subs']} socketed "
+        f"subscribers on one fan-out filter, {s['n_pubs']} flat-out "
+        "QoS0 publishers, connections round-robined over the workers "
+        "so every cross-worker IPC forward leg is exercised.  W=0 is "
+        "the in-process listener path (the pre-wire-plane broker).  "
+        f"Host: {s['host_threads']} hardware thread(s) — on a 1-thread "
+        "host all workers time-share one core, so W>=2 rows measure "
+        "the IPC tax and the >=1.8x-at-2-workers scaling gate needs a "
+        "multi-core host; occupancy = each worker's share of wire "
+        "deliveries (its own messages.sent), the balance check.",
+        "",
+        "| workers | deliveries/s | vs in-process | reps | "
+        "rep spread | per-worker occupancy |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in s["rows"]:
+        occ = " / ".join(
+            f"w{i}:{v:.0%}" for i, v in sorted(r["occupancy"].items())
+        ) or "—"
+        vs = f"{r['vs_inproc']:.2f}x" if r.get("vs_inproc") else "—"
+        lines.append(
+            f"| {r['workers']} | {r['rps']:,.0f} | {vs} "
+            f"| {', '.join(f'{x:,.0f}' for x in r['reps'])} "
+            f"| ±{r['rep_spread_pct']:.0f}% | {occ} |"
+        )
+    lines.append("")
+    return lines
+
+
+def _update_wire_table(s: dict) -> None:
+    """Replace the wire-plane section of BENCH_TABLE.md in place."""
+    path = "BENCH_TABLE.md"
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except FileNotFoundError:
+        text = "# BASELINE.json workload table\n"
+    lines = text.split("\n")
+    out, skip = [], False
+    for ln in lines:
+        if ln.startswith(WIRE_HEADER):
+            skip = True
+            continue
+        if skip and ln.startswith("## "):
+            skip = False
+        if not skip:
+            out.append(ln)
+    while out and out[-1] == "":
+        out.pop()
+    out.extend(_wire_section_lines(s))
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(out) + "\n")
+    log("updated BENCH_TABLE.md wire-plane section")
 
 
 SPANS_HEADER = "## Latency attribution"
@@ -2681,6 +2980,18 @@ def main() -> None:
                          "workload's topic stream (use with --sharded "
                          "<w> to pick the workload; writes the "
                          "BENCH_TABLE.md section)")
+    ap.add_argument("--wire", action="store_true",
+                    help="process-sharded wire plane sweep: aggregate "
+                         "wire deliveries/s over real sockets at "
+                         "0/1/2 wire workers (hub + SO_REUSEPORT "
+                         "worker pool over unix PeerLinks); writes "
+                         "the BENCH_TABLE.md section")
+    ap.add_argument("--wire-workers", default=None,
+                    help="comma-separated pool sizes for --wire "
+                         "(default 0,1,2)")
+    ap.add_argument("--wire-one", default=None, type=int,
+                    help="single wire-plane measurement at this pool "
+                         "size (the sweep's inner subprocess)")
     ap.add_argument("--churn-capacity", action="store_true",
                     help="single churn-capacity measurement at the "
                          "current ETPU_POOL_THREADS (the sweep's inner "
@@ -2705,6 +3016,43 @@ def main() -> None:
             "n_resident": best["n_resident"],
             "rows": rows,
             "host_threads": os.cpu_count() or 1,
+        }))
+        return
+    if ns.wire_one is not None:
+        stats = asyncio.run(_wire_run_one(
+            ns.wire_one, duration=4.0, reps=3, n_subs=30, n_pubs=2,
+            payload=128,
+        ))
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
+        print(json.dumps(stats))
+        return
+    if ns.wire:
+        sizes = tuple(
+            int(x) for x in (ns.wire_workers or "0,1,2").split(",")
+        )
+        stats = run_wire(sizes)
+        _update_wire_table(stats)
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
+        rows = stats["rows"]
+        by_w = {r["workers"]: r for r in rows}
+        best = max(rows, key=lambda r: r["rps"])
+        print(json.dumps({
+            "metric": "wire_deliveries_per_sec_sharded",
+            "value": round(best["rps"], 1),
+            "unit": "deliveries/sec",
+            "workers": best["workers"],
+            "vs_inproc": round(best.get("vs_inproc") or 1.0, 2),
+            "w1_vs_inproc": round(
+                (by_w.get(1) or {}).get("vs_inproc") or 0.0, 2),
+            "host_threads": stats["host_threads"],
+            "rows": [
+                {k: v for k, v in r.items() if k != "conns"}
+                for r in rows
+            ],
         }))
         return
     if ns.spans:

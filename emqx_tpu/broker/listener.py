@@ -354,6 +354,12 @@ class Listener:
         olp=None,
         tls=None,  # TlsConfig: terminate TLS on this listener (ssl type)
         psk_store=None,  # PskStore wired into the TLS handshake (3.13+)
+        reuse_port: bool = False,  # SO_REUSEPORT: wire workers bind the
+        # same port; the kernel load-balances accepts across processes
+        sock_fd: Optional[int] = None,  # pre-bound listening socket
+        # inherited from the wire supervisor (reuseport fallback)
+        max_conn_rate: float = 0.0,  # per-listener accept token bucket
+        # (wire.max_conn_rate); 0 = unlimited
     ):
         self.broker = broker
         self.host = host
@@ -366,6 +372,17 @@ class Listener:
         self.olp = olp
         self.tls = tls
         self.psk_store = psk_store
+        self.reuse_port = reuse_port
+        self.sock_fd = sock_fd
+        self._accept_bucket = None
+        if max_conn_rate and max_conn_rate > 0:
+            from .limiter import TokenBucket
+
+            # burst 2x: a brief legitimate spike (fleet wake) clears,
+            # a sustained reconnect storm sheds at the configured rate
+            self._accept_bucket = TokenBucket(
+                max_conn_rate, burst=max(2 * max_conn_rate, 1.0)
+            )
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
         self._hk_task: Optional[asyncio.Task] = None
@@ -378,13 +395,24 @@ class Listener:
 
             ssl_ctx = make_server_context(self.tls, self.psk_store)
             handshake_timeout = self.tls.handshake_timeout
-        self._server = await asyncio.start_server(
-            self._on_client,
-            self.host,
-            self.port,
-            ssl=ssl_ctx,
-            ssl_handshake_timeout=handshake_timeout,
-        )
+        kw = dict(ssl=ssl_ctx, ssl_handshake_timeout=handshake_timeout)
+        if self.sock_fd is not None:
+            # wire-plane reuseport fallback: adopt the listening socket
+            # the supervisor bound once and passed down (family/type
+            # recovered from the fd) — all workers accept on ONE socket
+            import socket as _socket
+
+            sock = _socket.socket(fileno=self.sock_fd)
+            sock.setblocking(False)
+            self._server = await asyncio.start_server(
+                self._on_client, sock=sock, **kw
+            )
+        else:
+            if self.reuse_port:
+                kw["reuse_port"] = True
+            self._server = await asyncio.start_server(
+                self._on_client, self.host, self.port, **kw
+            )
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]  # resolve port 0
         if self.batcher is not None:
@@ -477,20 +505,38 @@ class Listener:
             return True
         return False
 
-    async def _on_client(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
-    ) -> None:
+    def accept_gate(self, writer) -> bool:
+        """Shed-before-protocol-work gate shared by the TCP and WS
+        accept paths (emqx_olp + esockd limiter ordering): connection
+        cap, loop-lag overload shed, the per-listener accept-rate
+        bucket (`wire.max_conn_rate` — a reconnect storm is refused at
+        the accept boundary instead of stalling the loop with thousands
+        of half-born Connections), then the zone connection limiter.
+        False = socket closed, caller must not build a Connection."""
         if self.max_connections and len(self._conns) >= self.max_connections:
             writer.close()
-            return
+            return False
         if self.olp is not None and not self.olp.should_accept():
             # overloaded: shed before any protocol work (emqx_olp)
             self.broker.metrics.inc("olp.new_conn.shed")
             writer.close()
-            return
+            return False
+        if self._accept_bucket is not None \
+                and not self._accept_bucket.try_consume(1.0):
+            self.broker.metrics.inc("olp.new_conn.rate_limited")
+            tp("olp.accept.shed", port=self.port)
+            writer.close()
+            return False
         if self.limiter is not None and not self.limiter.check("connection"):
             self.broker.metrics.inc("olp.new_conn.rate_limited")
             writer.close()
+            return False
+        return True
+
+    async def _on_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        if not self.accept_gate(writer):
             return
         conn = Connection(
             self.broker, reader, writer, self.config, limiter=self.limiter

@@ -118,6 +118,9 @@ PREDEFINED = [
     "channels.force_shutdown",
     "olp.new_conn.shed",
     "olp.new_conn.rate_limited",
+    # process-sharded wire plane (wire/supervisor.py; the per-worker
+    # wire.worker.<i>.* figures are gauges, not counters)
+    "wire.worker.exits",
     # exhook event dispatcher (exhook/manager.py)
     "exhook.events.dropped",
     "exhook.events.failed",

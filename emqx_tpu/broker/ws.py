@@ -202,17 +202,9 @@ class WsListener(Listener):
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
-        # shed BEFORE any protocol work, same ordering as the TCP listener
-        if self.max_connections and len(self._conns) >= self.max_connections:
-            writer.close()
-            return
-        if self.olp is not None and not self.olp.should_accept():
-            self.broker.metrics.inc("olp.new_conn.shed")
-            writer.close()
-            return
-        if self.limiter is not None and not self.limiter.check("connection"):
-            self.broker.metrics.inc("olp.new_conn.rate_limited")
-            writer.close()
+        # shed BEFORE any protocol work, same gate as the TCP listener
+        # (incl. the wire.max_conn_rate accept bucket)
+        if not self.accept_gate(writer):
             return
         try:
             ok = await asyncio.wait_for(self._handshake(reader, writer), 10)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import logging
+import os
 import random
 import time
 from collections import OrderedDict
@@ -167,6 +168,8 @@ class ClusterNode:
         miss_limit: int = 3,
         rpc_mode: str = "async",  # forward mode: async | sync
         cookie: str = "",  # shared secret gating peer links ("" = open)
+        unix_path: Optional[str] = None,  # serve peer links on a UNIX
+        # socket too (wire-plane IPC: co-hosted workers dial the path)
         role: str = "core",  # core | replicant (mria topology analog)
         discovery=None,  # strategy with discover() -> {name: (host, port)}
         discovery_ivl: float = 5.0,
@@ -189,9 +192,12 @@ class ClusterNode:
         self.role = role
         self.discovery = discovery
         self.discovery_ivl = discovery_ivl
-        self.transport = Transport(name, host, port, cookie=cookie)
+        self.transport = Transport(name, host, port, cookie=cookie,
+                                   unix_path=unix_path)
         self.remote = RemoteRoutes()
-        self.peers_cfg: Dict[str, Tuple[str, int]] = dict(peers or {})
+        self.peers_cfg: Dict[str, Tuple[str, int]] = {
+            n: tp.check_addr(a) for n, a in (peers or {}).items()
+        }
         self.links: Dict[str, PeerLink] = {}
         self.heartbeat_ivl = heartbeat_ivl
         self.miss_limit = miss_limit
@@ -322,6 +328,7 @@ class ClusterNode:
         """Add a peer at runtime (manual `cluster join`).  A changed
         address (peer restarted elsewhere, k8s pod move) replaces the
         old link so reconnects chase the live endpoint."""
+        addr = tp.check_addr(addr)
         self.peers_cfg[peer] = addr
         old = self.links.get(peer)
         if old is not None and old.addr != tuple(addr):
@@ -357,12 +364,17 @@ class ClusterNode:
 
     def _hello_extra(self) -> dict:
         extra = {"role": self.role, "bpapi": bpapi.announce()}
+        if self.transport.unix_path:
+            # co-hosted peers (wire workers) dial back over the unix
+            # path — cheaper than loopback TCP and valid even when the
+            # TCP bind is a wildcard
+            extra["uaddr"] = ["unix", self.transport.unix_path]
         host = self.advertise_host or self.transport.host
         if host not in ("0.0.0.0", "::"):
             # a wildcard bind with no advertise_host is not dialable;
             # omit addr so peers skip dial-back instead of dialing junk
             extra["addr"] = [host, self.transport.port]
-        else:
+        elif not self.transport.unix_path:
             log.warning(
                 "node %s binds %s without advertise_host: peers cannot "
                 "dial back", self.name, host,
@@ -633,8 +645,17 @@ class ClusterNode:
         self.peer_bpapi[peer] = bpapi.negotiate(hello.get("bpapi"))
         # dial back a peer we have no outbound link to (replicants dial
         # cores; the core's return link is how forwards/relays reach
-        # them — mria's replicant attach)
+        # them — mria's replicant attach).  A unix dial-back address
+        # wins over TCP when the path exists here — same-host peer,
+        # no loopback tax.
         addr = hello.get("addr")
+        uaddr = hello.get("uaddr")
+        if (
+            isinstance(uaddr, (list, tuple))
+            and tp.is_unix_addr(uaddr)
+            and os.path.exists(str(uaddr[1]))
+        ):
+            addr = uaddr
         if (
             peer not in self.links
             and isinstance(addr, (list, tuple))
@@ -644,7 +665,7 @@ class ClusterNode:
             )
         ):
             try:
-                self.join(peer, (str(addr[0]), int(addr[1])))
+                self.join(peer, addr)
             except (ValueError, TypeError):
                 pass
         return {

@@ -12,6 +12,13 @@ Frame layout:  u32 len | u8 type | body
   JSON frames: body = utf-8 JSON
   FORWARD:     body = u16 hlen | JSON header | raw payload bytes
 
+Addressing: a peer address is either a ("host", port) TCP endpoint or a
+("unix", path) UNIX-domain endpoint.  The unix variant carries the
+process-sharded wire plane (emqx_tpu/wire/): co-hosted wire workers are
+zero-latency peers, and a local socketpair hop must not pay the TCP
+loopback tax (checksum, nagle, conntrack).  Everything above the dial —
+HELLO auth, frames, RPC matching, reconnect/breaker — is shared.
+
 The FORWARD header is an open JSON map; optional fields ride end to
 end through relays and the forward spool without a frame-format bump —
 `relay_to` (core relay target), `shared_group`/`shared_filt` (targeted
@@ -56,6 +63,29 @@ MAX_FRAME = 64 * 1024 * 1024
 
 class RpcError(Exception):
     pass
+
+
+def is_unix_addr(addr) -> bool:
+    """("unix", <path>) peer addresses dial a UNIX-domain socket."""
+    return (
+        isinstance(addr, (tuple, list))
+        and len(addr) == 2
+        and addr[0] == "unix"
+    )
+
+
+def check_addr(addr) -> Tuple[str, object]:
+    """Normalize a configured peer address: ("unix", path) stays as-is,
+    anything else must coerce to (host, int port)."""
+    if is_unix_addr(addr):
+        return ("unix", str(addr[1]))
+    return (str(addr[0]), int(addr[1]))
+
+
+async def dial(addr) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter]:
+    if is_unix_addr(addr):
+        return await asyncio.open_unix_connection(addr[1])
+    return await asyncio.open_connection(*addr)
 
 
 def hello_auth(cookie: str, node: str, incarnation, nonce: str) -> str:
@@ -197,7 +227,7 @@ class PeerLink:
         while not self._stopped:
             try:
                 await _fault.ainject("transport.dial", err=ConnectionError)
-                reader, writer = await asyncio.open_connection(*self.addr)
+                reader, writer = await dial(self.addr)
                 self._writer = writer
                 # 1. server opens with HELLO{"challenge": nonce}
                 ftype, body = await read_frame(reader)
@@ -376,10 +406,14 @@ class Transport:
     """
 
     def __init__(self, node: str, host: str = "127.0.0.1", port: int = 0,
-                 cookie: str = ""):
+                 cookie: str = "", unix_path: Optional[str] = None):
         self.node = node
         self.host = host
         self.port = port
+        # optional UNIX-domain server alongside the TCP one (wire-plane
+        # IPC): same _handle, same frames — a local peer just dials the
+        # path instead of the port
+        self.unix_path = unix_path
         self.cookie = cookie
         self.on_hello: Callable[[str, dict], dict] = lambda p, h: {}
         self.on_route_op: Callable[[str, dict], None] = lambda p, o: None
@@ -389,6 +423,7 @@ class Transport:
         )
         self.rpc_handlers: Dict[str, Callable] = {}
         self._server: Optional[asyncio.base_events.Server] = None
+        self._unix_server: Optional[asyncio.base_events.Server] = None
         self._inbound: set = set()  # live inbound writers, closed on stop
         # inbound RPCs run on a bounded pool, keyed by peer so one node's
         # requests execute in order (the gen_server serialization the
@@ -406,17 +441,37 @@ class Transport:
             self._handle, self.host, self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.unix_path:
+            # a stale socket file from a kill -9'd predecessor refuses
+            # the bind; the supervisor guarantees single ownership of
+            # the path, so unlink-then-bind is safe here
+            try:
+                os.unlink(self.unix_path)
+            except OSError:
+                pass
+            self._unix_server = await asyncio.start_unix_server(
+                self._handle, path=self.unix_path
+            )
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
+        if self._server is not None or self._unix_server is not None:
             for w in list(self._inbound):
                 try:
                     w.close()
                 except Exception:
                     pass
+        if self._server is not None:
+            self._server.close()
             await self._server.wait_closed()
             self._server = None
+        if self._unix_server is not None:
+            self._unix_server.close()
+            await self._unix_server.wait_closed()
+            self._unix_server = None
+            try:
+                os.unlink(self.unix_path)
+            except OSError:
+                pass
         if self._rpc_pool is not None:
             await self._rpc_pool.stop(drain=False)
             self._rpc_pool = None

@@ -508,6 +508,53 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "message_in_rate": Field("float", 0.0),
         "bytes_in_rate": Field("float", 0.0),
     },
+    "wire": {
+        # process-sharded wire plane (emqx_tpu/wire/): a parent
+        # supervisor forks N wire-worker processes that each bind the
+        # configured MQTT listeners via SO_REUSEPORT and run the full
+        # connection/channel/session/delivery stack, clustered to the
+        # parent (and each other) as zero-latency peers over UNIX-domain
+        # PeerLinks — the esockd acceptor-pool model lifted to whole
+        # processes so the broker scales past one event loop + one GIL
+        "workers": Field(
+            "int", 0, min=0, max=64,
+            desc="wire-worker process count; 0 = serve listeners "
+                 "in-process (single event loop).  The reference sizes "
+                 "acceptor pools at schedulers x 8; here one worker per "
+                 "core is the analog — each worker is a full "
+                 "connection/delivery plane, not just an acceptor"),
+        "reuseport": Field(
+            "bool", True,
+            desc="bind each worker's listeners with SO_REUSEPORT (the "
+                 "kernel load-balances accepts across workers); false "
+                 "= the parent binds each listener once and workers "
+                 "inherit the listening FD (pre-fork accept sharing, "
+                 "the fallback where SO_REUSEPORT is unavailable)"),
+        "ipc_dir": Field(
+            "str", "",
+            desc="UNIX-socket + per-worker state directory; empty = "
+                 "<node.data_dir>/wire (hub.sock, w<i>.sock, w<i>/ "
+                 "data dirs).  Paths must stay under the ~100-byte "
+                 "sun_path limit"),
+        "max_conn_rate": Field(
+            "float", 0.0,
+            desc="per-worker accept-rate token bucket (accepts/sec, "
+                 "burst 2x); past it new sockets are closed before any "
+                 "protocol work and counted in olp.new_conn."
+                 "rate_limited — a reconnect storm sheds instead of "
+                 "stalling the loop.  0 = unlimited"),
+        "restart_backoff": Field(
+            "duration", 0.5,
+            desc="base delay before restarting a dead wire worker; "
+                 "doubles per consecutive crash up to 8x (parked "
+                 "sessions and the parent's forward spool cover the "
+                 "gap)"),
+        "stats_interval": Field(
+            "duration", 2.0,
+            desc="per-worker stats poll cadence (wire_stats RPC over "
+                 "the IPC link) feeding the wire.worker.<i>.* gauges "
+                 "exported via $SYS/metrics, /monitor and Prometheus"),
+    },
     "dashboard": {
         "listen_port": Field("int", 18083),
         "default_username": Field("str", "admin"),
@@ -541,7 +588,18 @@ STRUCTURED: Dict[str, Any] = {
         "advertise_host": Field("str"),
         "role": Field("enum", "core", enum=["core", "replicant"]),
         "rpc_mode": Field("enum", "async", enum=["sync", "async"]),
-        "peers": Field("map", desc="name -> [host, port]"),
+        "peers": Field("map", desc="name -> [host, port] or "
+                                   "[\"unix\", path]"),
+        "unix_path": Field(
+            "str", desc="also serve peer links on this UNIX-domain "
+                        "socket (wire-plane IPC / same-host peers)"),
+        "reconnect_ivl": Field(
+            "duration", 0.5, desc="peer-link reconnect backoff base"),
+        "reconnect_max": Field(
+            "duration", 15.0,
+            desc="peer-link reconnect backoff ceiling (wire-plane hubs "
+                 "default to 2s: a worker respawns in seconds, not on "
+                 "the cross-host partition timescale)"),
         "route_hold": Field(
             "duration", 5.0,
             desc="keep a down peer's routes this long before purging; "

@@ -189,12 +189,27 @@ class NodeRuntime:
         )
         cluster_cfg = self.conf.get("cluster") or {}
         self.cluster = None
-        if cluster_cfg.get("enable"):
+        # process-sharded wire plane (emqx_tpu/wire/): wire.workers > 0
+        # makes this node the HUB of a worker pool — the cluster
+        # machinery must exist (workers are peers over unix sockets)
+        # even when no TCP cluster is configured
+        self._wire_workers = int(self.conf.get("wire.workers"))
+        self.wire = None
+        wire_unix = None
+        if self._wire_workers > 0:
+            wire_unix = os.path.join(
+                self.conf.get("wire.ipc_dir")
+                or os.path.join(self.conf.get("node.data_dir"), "wire"),
+                "hub.sock",
+            )
+            os.makedirs(os.path.dirname(wire_unix), exist_ok=True)
+        if cluster_cfg.get("enable") or self._wire_workers > 0:
             from .cluster.node import ClusterBroker, ClusterNode
+            from .cluster.transport import check_addr
 
             self.broker: Broker = ClusterBroker(engine=engine, retainer=retainer, shared=shared)
             peers = {
-                name: (addr[0], int(addr[1]))
+                name: check_addr(addr)
                 for name, addr in (cluster_cfg.get("peers") or {}).items()
             }
             discovery = None
@@ -212,6 +227,18 @@ class NodeRuntime:
                         if k not in ("strategy", "interval")
                     },
                 )
+            # wire hub links heal on the worker-boot timescale (a few
+            # seconds), not the cross-host partition timescale: the
+            # hub's OUTBOUND link is the forward path INTO a worker, so
+            # its reconnect ceiling stays short unless configured
+            from .wire.supervisor import (HUB_RECONNECT_IVL,
+                                          HUB_RECONNECT_MAX)
+
+            default_ivl, default_max = (
+                (HUB_RECONNECT_IVL, HUB_RECONNECT_MAX)
+                if self._wire_workers > 0 and not cluster_cfg.get("enable")
+                else (0.5, 15.0)
+            )
             self.cluster = ClusterNode(
                 self.node_name,
                 self.broker,
@@ -227,6 +254,13 @@ class NodeRuntime:
                 route_hold=float(cluster_cfg.get("route_hold", 5.0)),
                 spool_max_bytes=int(
                     cluster_cfg.get("spool_max_bytes", 8 << 20)
+                ),
+                unix_path=cluster_cfg.get("unix_path") or wire_unix,
+                reconnect_ivl=float(
+                    cluster_cfg.get("reconnect_ivl", default_ivl)
+                ),
+                reconnect_max=float(
+                    cluster_cfg.get("reconnect_max", default_max)
                 ),
             )
             from .cluster.cluster_rpc import ClusterRpc
@@ -495,6 +529,13 @@ class NodeRuntime:
         self.listeners: List[Listener] = []
         for ldef in self.conf.get("listeners") or [{"type": "tcp", "port": 1883}]:
             self.listeners.append(self._build_listener(ldef))
+        if self._wire_workers > 0:
+            # the worker pool serves the listeners; this node keeps the
+            # defs (REST /listeners reflects the configured ports) but
+            # never binds them itself
+            from .wire.supervisor import WireSupervisor
+
+            self.wire = WireSupervisor(self)
 
         # ---- gateways (1.10) ----------------------------------------------
         from .gateway.core import GatewayRegistry
@@ -615,6 +656,12 @@ class NodeRuntime:
             batcher=self.batcher,
             limiter=self.limiter,
             olp=self.olp,
+            # wire plane: workers bind the shared port via SO_REUSEPORT
+            # (or adopt the supervisor-bound fd), and every listener
+            # carries the accept-rate shed bucket when configured
+            reuse_port=bool(ldef.get("reuseport")),
+            sock_fd=ldef.get("sock_fd"),
+            max_conn_rate=float(self.conf.get("wire.max_conn_rate")),
         )
         tls = None
         if kind in ("ssl", "wss") or ldef.get("ssl"):
@@ -881,8 +928,14 @@ class NodeRuntime:
                 await self.bridges.start()
             if self.delivery_pool is not None:
                 self.delivery_pool.start()
-            for lst in self.listeners:
-                await lst.start()
+            if self.wire is not None:
+                # process-sharded wire plane: the worker pool binds the
+                # configured listeners (reuseport / inherited fd); the
+                # hub serves no MQTT socket of its own
+                await self.wire.start()
+            else:
+                for lst in self.listeners:
+                    await lst.start()
             for name in self.gateways.list():
                 await self.gateways.lookup(name).start()
             await self.http.start()
@@ -950,11 +1003,17 @@ class NodeRuntime:
                 await self.gateways.lookup(name).stop()
             except Exception:
                 log.exception("stopping gateway %s", name)
-        for lst in reversed(self.listeners):
+        if self.wire is not None:
             try:
-                await lst.stop()
+                await self.wire.stop()
             except Exception:
-                log.exception("stopping listener on port %s", lst.port)
+                log.exception("stopping wire supervisor")
+        else:
+            for lst in reversed(self.listeners):
+                try:
+                    await lst.stop()
+                except Exception:
+                    log.exception("stopping listener on port %s", lst.port)
         if self.delivery_pool is not None:
             try:
                 await self.delivery_pool.stop()

@@ -82,6 +82,15 @@ class MonitorSampler:
             s["loop_lag_ms"] = round(
                 self.contention.probe.ewma_s * 1e3, 3
             )
+        # levels: process-sharded wire plane (wire/supervisor.py stats
+        # loop keeps these gauges fresh; absent = wire plane off)
+        gauges = self.broker.metrics.gauges
+        if "wire.workers.alive" in gauges:
+            s["wire_workers_alive"] = int(gauges["wire.workers.alive"])
+            s["wire_connections"] = (
+                int(gauges["wire.connections"])
+                if "wire.connections" in gauges else 0
+            )
         self.samples.append(s)
         return s
 

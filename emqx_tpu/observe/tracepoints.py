@@ -108,6 +108,15 @@ KNOWN_KINDS: Dict[str, str] = {
     "cluster.forward.spool": "QoS>=1 forward queued in the replay spool",
     "cluster.forward.replay": "spooled forwards replayed after a heal",
     "engine.breaker": "device-path circuit breaker opened or closed",
+    # process-sharded wire plane (emqx_tpu/wire/ supervisor + the
+    # accept-path limiter in broker/listener.py)
+    "olp.accept.shed": "accept-rate bucket refused a new socket before "
+                       "any protocol work (wire.max_conn_rate)",
+    "wire.worker.spawn": "wire-worker process spawned (or respawned "
+                         "after a crash, with backoff)",
+    "wire.worker.exit": "wire-worker process exited; sessions park and "
+                        "QoS>=1 forwards spool until the respawn heals "
+                        "the IPC link",
 }
 
 

@@ -1,0 +1,35 @@
+"""Process-sharded wire plane — the esockd acceptor pool lifted to
+whole OS processes.
+
+Every in-loop plane so far (churn pool, delivery shards, prep-ahead)
+still time-slices ONE Python event loop and one GIL; the reference
+scales the wire side with esockd acceptor pools at schedulers x 8 per
+listener (PAPER.md §1.3).  Here the pool members are full broker
+processes: a parent supervisor (`supervisor.WireSupervisor`, running
+inside the parent NodeRuntime) spawns `wire.workers` child processes
+(`python -m emqx_tpu.wire.worker`) that each
+
+* bind the SAME configured MQTT listeners via SO_REUSEPORT (the kernel
+  load-balances accepts across processes), falling back to a single
+  parent-bound listening socket inherited by FD where SO_REUSEPORT is
+  unavailable;
+* run the complete connection/channel/session/delivery stack of a
+  normal node (a worker IS a NodeRuntime);
+* cluster with the parent and each other over UNIX-domain PeerLinks
+  (`cluster/transport.py` unix addressing — no TCP loopback tax), so
+  the local node is just a zero-latency peer: subscriptions replicate
+  through the route oplog, publishes cross processes through the
+  exactly-once FORWARD/spool/dedup path, and cross-process semantics
+  come for free from the existing cluster machinery.
+
+Only transport frames cross the process boundary — the supervisor never
+shares objects with a worker (enforced by the `proc-boundary` pass in
+tools/analysis).  A crashed worker's clients reconnect (the kernel
+rehashes them to surviving workers), its sessions park on disk and
+resume after the supervisor respawns it, and QoS>=1 traffic for it
+spools at the peers until the IPC link heals.
+"""
+
+from .supervisor import WireSupervisor
+
+__all__ = ["WireSupervisor"]

@@ -1,0 +1,459 @@
+"""Wire-worker supervisor: spawn, monitor, restart, and meter the
+process pool serving the MQTT listeners.
+
+Runs inside the parent NodeRuntime.  The parent never shares Python
+state with a worker — a worker is an opaque OS process plus a cluster
+PeerLink over a UNIX socket; everything the supervisor knows about a
+worker it learned from `wire_stats` RPCs or the process table.  The
+`proc-boundary` analysis pass enforces that discipline statically
+(importing `emqx_tpu.wire.worker` anywhere in the parent is an error;
+only the spawn command line below names it).
+
+Crash handling (the esockd supervisor analog, one_for_one): a dead
+worker is respawned with doubling backoff into the SAME identity —
+index, node name, unix socket, data dir, listener sockets — so its
+parked sessions restore from the per-worker persistence/ds planes, the
+peers' forward spools drain into it after the link heals, and the
+receiver-side (mid, group, filt) dedup turns the at-least-once replay
+into exactly-once delivery.  While a worker is down the kernel simply
+stops handing it accepts (SO_REUSEPORT) or the surviving workers win
+the accept race (inherited-FD fallback), so new connections keep
+landing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import logging
+import os
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..observe.tracepoints import tp
+
+log = logging.getLogger("emqx_tpu.wire")
+
+# listener types the shared (reuseport / inherited-FD) plane can carry;
+# others would need per-worker ports and are refused at boot
+SHARDABLE_LISTENERS = ("tcp", "ssl", "ws", "wss")
+
+# parent-side knobs for the hub<->worker links: a worker boots in
+# seconds, so the default 15 s reconnect ceiling would leave the hub's
+# outbound link (the forward path INTO the worker) dark long after the
+# worker is serving
+HUB_RECONNECT_IVL = 0.25
+HUB_RECONNECT_MAX = 2.0
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-granted free TCP port.  SO_REUSEPORT workers must agree on
+    ONE port number up front, so `port: 0` listener defs are resolved
+    here once instead of per worker."""
+    s = socket.socket()
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+@dataclass
+class WorkerHandle:
+    """Parent-side record of one wire worker — identity + process
+    handle + last polled counters.  Never holds worker Python state."""
+
+    idx: int
+    name: str
+    sock_path: str
+    data_dir: str
+    config_path: str
+    direct_port: int  # per-worker private listener (tests/bench target
+    # one specific worker; reuseport hashing is opaque)
+    proc: Optional[subprocess.Popen] = None
+    fails: int = 0  # consecutive crashes (backoff doubles on each)
+    restart_at: float = 0.0
+    last_stats: Dict[str, Any] = field(default_factory=dict)
+    last_accepts: float = 0.0
+    last_poll: float = 0.0
+
+
+class WireSupervisor:
+    def __init__(self, runtime):
+        self.runtime = runtime
+        conf = runtime.conf
+        self.node_name = runtime.node_name
+        self.n = int(conf.get("wire.workers"))
+        self.reuseport = bool(conf.get("wire.reuseport"))
+        self.ipc_dir = conf.get("wire.ipc_dir") or os.path.join(
+            conf.get("node.data_dir"), "wire"
+        )
+        self.restart_backoff = float(conf.get("wire.restart_backoff"))
+        self.stats_interval = float(conf.get("wire.stats_interval"))
+        self.hub_sock = os.path.join(self.ipc_dir, "hub.sock")
+        self.workers: Dict[int, WorkerHandle] = {}
+        self.listener_defs: List[Dict[str, Any]] = []  # resolved, shared
+        self._shared_socks: List[socket.socket] = []
+        self._mon_task: Optional[asyncio.Task] = None
+        self._stats_task: Optional[asyncio.Task] = None
+        self._hk_task: Optional[asyncio.Task] = None
+        self._stopping = False
+
+    # ------------------------------------------------------------ config
+
+    def _prepare(self) -> None:
+        """Blocking boot half (worker thread): resolve the shared
+        listener set, bind fallback sockets, pick per-worker direct
+        ports, build the handles."""
+        os.makedirs(self.ipc_dir, exist_ok=True)
+        self._resolve_listeners()
+        for i in range(self.n):
+            self.workers[i] = WorkerHandle(
+                idx=i,
+                name=f"{self.node_name}#w{i}",
+                sock_path=os.path.join(self.ipc_dir, f"w{i}.sock"),
+                data_dir=os.path.join(self.ipc_dir, f"w{i}"),
+                config_path=os.path.join(self.ipc_dir, f"w{i}.json"),
+                direct_port=free_port(),
+            )
+
+    def _resolve_listeners(self) -> None:
+        """One resolved listener set ALL workers bind: `port: 0` defs
+        get a concrete port here (each worker must land on the same
+        number), and in FD-fallback mode the parent binds each socket
+        once and records the inheritable fd."""
+        raw = self.runtime.raw.get("listeners") or [
+            {"type": "tcp", "port": 1883}
+        ]
+        for ldef in raw:
+            ldef = copy.deepcopy(ldef)
+            kind = ldef.get("type", "tcp")
+            if kind not in SHARDABLE_LISTENERS:
+                raise ValueError(
+                    f"wire plane cannot shard listener type {kind!r}"
+                )
+            if int(ldef.get("port", 1883)) == 0:
+                ldef["port"] = free_port(ldef.get("host", "0.0.0.0"))
+            if self.reuseport:
+                ldef["reuseport"] = True
+            else:
+                ldef["sock_fd"] = self._bind_shared(
+                    ldef.get("host", "0.0.0.0"), int(ldef["port"])
+                )
+            self.listener_defs.append(ldef)
+
+    def _bind_shared(self, host: str, port: int) -> int:
+        """Reuseport fallback: bind + listen ONCE in the parent; every
+        worker inherits the fd and accepts on the shared socket (the
+        classic pre-fork server shape)."""
+        s = socket.create_server(
+            (host, port), backlog=1024, reuse_port=False
+        )
+        s.set_inheritable(True)
+        self._shared_socks.append(s)
+        return s.fileno()
+
+    def worker_raw(self, h: WorkerHandle) -> Dict[str, Any]:
+        """Derive one worker's node config from the parent's raw dict.
+
+        A worker is a full NodeRuntime serving the shared listeners plus
+        a private direct listener, clustered over unix sockets to the
+        hub and its siblings.  Node-singleton planes stay with the
+        parent (REST dashboard port, gateways, bridges, rules, exhook,
+        Prometheus/StatsD push); per-connection planes (authn/authz,
+        rewrite, auto-subscribe, delayed, retainer, limiter) ride along
+        unchanged.  Sessions park on the worker's OWN disc store so a
+        kill -9 recovers through restore() on respawn."""
+        conf = self.runtime.conf
+        base = copy.deepcopy(self.runtime.raw)
+        for parent_only in ("gateways", "bridges", "exhook", "rules"):
+            base.pop(parent_only, None)
+        base.setdefault("node", {})
+        base["node"]["name"] = h.name
+        base["node"]["data_dir"] = h.data_dir
+        # ONE shared XLA compile cache: the first worker pays each
+        # kernel once, the rest (and every respawn) warm-start
+        base["node"]["xla_cache_dir"] = conf.get(
+            "node.xla_cache_dir"
+        ) or os.path.join(conf.get("node.data_dir"), "xla_cache")
+        base["wire"] = {
+            "workers": 0,  # a worker never forks grandchildren
+            "max_conn_rate": conf.get("wire.max_conn_rate"),
+        }
+        base["dashboard"] = dict(
+            base.get("dashboard") or {}, listen_port=0
+        )
+        base["prometheus"] = {"enable": False}
+        base["statsd"] = {"enable": False}
+        # park-on-death: sessions must survive a kill -9'd worker
+        base["persistent_session_store"] = {
+            "enable": True, "on_disc": True,
+        }
+        peers: Dict[str, List[Any]] = {
+            self.runtime.node_name: ["unix", self.hub_sock]
+        }
+        for other in self.workers.values():
+            if other.idx != h.idx:
+                peers[other.name] = ["unix", other.sock_path]
+        base["cluster"] = {
+            "enable": True,
+            "host": "127.0.0.1",
+            "port": 0,
+            "unix_path": h.sock_path,
+            "peers": peers,
+            "reconnect_ivl": HUB_RECONNECT_IVL,
+            "reconnect_max": HUB_RECONNECT_MAX,
+        }
+        base["listeners"] = copy.deepcopy(self.listener_defs) + [
+            {"type": "tcp", "host": "127.0.0.1", "port": h.direct_port}
+        ]
+        return base
+
+    # --------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        await asyncio.to_thread(self._prepare)
+        # configs are written after every handle exists (peer maps name
+        # all siblings), then the processes launch
+        for h in self.workers.values():
+            await asyncio.to_thread(self._spawn, h, self.worker_raw(h))
+            tp("wire.worker.spawn", worker=h.name, respawn=False)
+            self.runtime.cluster.join(h.name, ("unix", h.sock_path))
+        loop = asyncio.get_running_loop()
+        self._mon_task = loop.create_task(self._monitor())
+        self._stats_task = loop.create_task(self._stats_loop())
+        self._hk_task = loop.create_task(self._housekeeping())
+        log.info(
+            "wire plane up: %d workers on %s (%s)",
+            self.n,
+            ", ".join(
+                f"{d.get('type', 'tcp')}:{d['port']}"
+                for d in self.listener_defs
+            ),
+            "reuseport" if self.reuseport else "inherited fd",
+        )
+
+    def _spawn(self, h: WorkerHandle, raw: Dict[str, Any]) -> None:
+        """Blocking spawn half (runs on a worker thread): write the
+        derived config (built on the loop, where the parent Config is
+        mutated), launch the child with the shared listening fds
+        inherited, logs appended to w<i>.log."""
+        os.makedirs(h.data_dir, exist_ok=True)
+        with open(h.config_path, "w", encoding="utf-8") as f:
+            # analysis: allow-blocking(one small config file per spawn,
+            # and _spawn always runs on a to_thread worker)
+            f.write(json.dumps(raw, indent=2, sort_keys=True))
+        env = dict(os.environ)
+        if "EMQX_TPU_JAX_PLATFORM" not in env:
+            # pin children to the parent's RESOLVED backend: site hooks
+            # can pre-pin a child interpreter before env JAX_PLATFORMS
+            # applies, but EMQX_TPU_JAX_PLATFORM is applied in-process
+            # by the worker entry (worker.py), so this is deterministic
+            import jax
+
+            env["EMQX_TPU_JAX_PLATFORM"] = jax.default_backend()
+        pass_fds = tuple(s.fileno() for s in self._shared_socks)
+        logf = open(
+            os.path.join(self.ipc_dir, f"w{h.idx}.log"), "ab"
+        )
+        try:
+            h.proc = subprocess.Popen(
+                [sys.executable, "-m", "emqx_tpu.wire.worker",
+                 "--config", h.config_path],
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                env=env,
+                pass_fds=pass_fds,
+                start_new_session=True,
+            )
+        finally:
+            logf.close()  # the child holds its own dup
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for t in (self._mon_task, self._stats_task, self._hk_task):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+        self._mon_task = self._stats_task = self._hk_task = None
+        for h in self.workers.values():
+            if h.proc is not None and h.proc.poll() is None:
+                try:
+                    h.proc.terminate()
+                except OSError:
+                    pass
+        await asyncio.to_thread(self._reap_all)
+        for s in self._shared_socks:
+            s.close()
+        self._shared_socks.clear()
+
+    def _reap_all(self) -> None:
+        deadline = time.monotonic() + 10.0
+        for h in self.workers.values():
+            p = h.proc
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                p.wait()
+            h.proc = None
+
+    # --------------------------------------------------------- monitors
+
+    async def _monitor(self) -> None:
+        """Process-table watch: reap dead workers, respawn with
+        doubling backoff into the same identity.  The cluster layer
+        handles everything else about a death (link down -> routes held
+        for route_hold -> QoS>=1 spools -> replay + dedup on heal)."""
+        while True:
+            await asyncio.sleep(0.25)
+            now = time.monotonic()
+            for h in self.workers.values():
+                p = h.proc
+                if p is not None and p.poll() is not None:
+                    rc = p.returncode
+                    h.proc = None
+                    h.fails += 1
+                    self.runtime.broker.metrics.inc("wire.worker.exits")
+                    tp("wire.worker.exit", worker=h.name, rc=rc,
+                       fails=h.fails)
+                    log.warning(
+                        "wire worker %s exited rc=%s (crash #%d)",
+                        h.name, rc, h.fails,
+                    )
+                    h.restart_at = now + min(
+                        self.restart_backoff * (2 ** (h.fails - 1)),
+                        self.restart_backoff * 8,
+                    )
+                elif p is None and not self._stopping \
+                        and now >= h.restart_at:
+                    try:
+                        await asyncio.to_thread(
+                            self._spawn, h, self.worker_raw(h)
+                        )
+                    except OSError:
+                        log.exception("respawning wire worker %s", h.name)
+                        h.restart_at = now + self.restart_backoff * 8
+                        continue
+                    tp("wire.worker.spawn", worker=h.name, respawn=True)
+
+    async def _stats_loop(self) -> None:
+        """Per-worker gauges over the IPC link (`wire_stats` RPC): one
+        scrape per interval lands conns / accept rate / shed counts /
+        forward depth in the parent's metrics table, so $SYS metrics,
+        /monitor and the Prometheus exposition all see the pool without
+        any new export path."""
+        cluster = self.runtime.cluster
+        m = self.runtime.broker.metrics
+        while True:
+            await asyncio.sleep(self.stats_interval)
+            alive = 0
+            total_conns = 0.0
+            status = cluster.status()
+            for h in self.workers.values():
+                up = status.get(h.name) == "up"
+                running = h.proc is not None and h.proc.poll() is None
+                if running and up:
+                    alive += 1
+                    h.fails = 0  # healthy link: crash streak over
+                stats = None
+                if up:
+                    try:
+                        stats = await cluster.call(
+                            h.name, "wire_stats", {}, timeout=2.0
+                        )
+                    except Exception:
+                        stats = None
+                g = f"wire.worker.{h.idx}."
+                now = time.monotonic()
+                if stats:
+                    h.last_stats = stats
+                    conns = float(stats.get("connections", 0))
+                    total_conns += conns
+                    m.gauge_set(g + "connections", conns)
+                    accepts = float(stats.get("accepts", 0))
+                    dt = max(now - h.last_poll, 1e-6) \
+                        if h.last_poll else None
+                    if dt is not None:
+                        m.gauge_set(
+                            g + "accept_rate",
+                            max(accepts - h.last_accepts, 0.0) / dt,
+                        )
+                    h.last_accepts = accepts
+                    h.last_poll = now
+                    m.gauge_set(g + "shed", float(stats.get("shed", 0)))
+                    m.gauge_set(
+                        g + "rate_limited",
+                        float(stats.get("rate_limited", 0)),
+                    )
+                    # IPC forward depth: parent->worker spool + the
+                    # worker's own outbound spool backlog
+                    m.gauge_set(
+                        g + "forward_depth",
+                        float(cluster.spool_pending(h.name))
+                        + float(stats.get("spool_pending", 0)),
+                    )
+                else:
+                    m.gauge_set(g + "connections", 0.0)
+                    m.gauge_set(
+                        g + "forward_depth",
+                        float(cluster.spool_pending(h.name)),
+                    )
+            m.gauge_set("wire.workers.alive", float(alive))
+            m.gauge_set("wire.connections", total_conns)
+
+    async def _housekeeping(self) -> None:
+        """The slice of listener housekeeping the parent still needs
+        with no listener of its own running: pending-session eviction,
+        persistence flush, retained GC.  (Channel timers live in the
+        workers' own listener loops.)"""
+        n = 0
+        while True:
+            await asyncio.sleep(1.0)
+            n += 1
+            try:
+                self.runtime.broker.cm.evict_expired()
+                p = self.runtime.persistence
+                if p is not None:
+                    p.tick()
+                if n % 60 == 0:
+                    self.runtime.broker.retainer.clean_expired()
+            except Exception:
+                log.exception("wire supervisor housekeeping")
+
+    # ------------------------------------------------------------ status
+
+    def status(self) -> Dict[str, Any]:
+        link = self.runtime.cluster.status()
+        return {
+            "workers": self.n,
+            "reuseport": self.reuseport,
+            "listeners": [
+                {"type": d.get("type", "tcp"), "port": d["port"]}
+                for d in self.listener_defs
+            ],
+            "pool": [
+                {
+                    "name": h.name,
+                    "pid": h.proc.pid if h.proc is not None else None,
+                    "link": link.get(h.name, "down"),
+                    "direct_port": h.direct_port,
+                    "fails": h.fails,
+                    "stats": h.last_stats,
+                }
+                for h in self.workers.values()
+            ],
+        }

@@ -1,0 +1,88 @@
+"""Wire-worker process entry — `python -m emqx_tpu.wire.worker`.
+
+PROCESS-PRIVATE MODULE: nothing in the parent process may import this
+(the `proc-boundary` analysis pass errors on any such import).  The
+only things that cross the supervisor/worker boundary are the spawn
+command line, the derived JSON config, inherited listening fds, and
+cluster-transport frames over the worker's unix socket.
+
+A worker is a full `NodeRuntime` — the same connection/channel/session/
+delivery stack a standalone node runs — whose derived config (written
+by `supervisor.WireSupervisor.worker_raw`) points its listeners at the
+shared ports (SO_REUSEPORT or inherited fd), parks sessions on its own
+disc store, and clusters it to the hub and sibling workers over
+UNIX-domain PeerLinks.  On top of that it registers the `wire_stats`
+RPC the supervisor scrapes for the per-worker gauges.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+
+def wire_stats(runtime):
+    """The supervisor-facing stats snapshot (everything here is plain
+    numbers — the ONLY state that ever leaves this process)."""
+    b = runtime.broker
+    m = b.metrics
+    cluster = runtime.cluster
+    return {
+        "connections": len(b.cm.channels),
+        "sessions": len(b.cm.channels) + len(b.cm.pending),
+        "subscriptions": b.subscription_count,
+        "accepts": m.get("client.connect"),
+        "shed": m.get("olp.new_conn.shed"),
+        "rate_limited": m.get("olp.new_conn.rate_limited"),
+        "spool_pending": cluster.spool_pending() if cluster else 0,
+        "peers": dict(cluster.status()) if cluster else {},
+        "forward_in": m.get("messages.forward.in"),
+        "forward_out": m.get("messages.forward.out"),
+        "messages_sent": m.get("messages.sent"),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="emqx_tpu.wire.worker")
+    ap.add_argument("--config", "-c", required=True,
+                    help="derived worker config (written by the "
+                         "supervisor)")
+    args = ap.parse_args(argv)
+
+    # same post-import platform override as the node entry point: the
+    # supervisor pins EMQX_TPU_JAX_PLATFORM when the site env doesn't
+    _plat = os.environ.get("EMQX_TPU_JAX_PLATFORM")
+    if _plat:
+        import jax
+
+        jax.config.update("jax_platforms", _plat)
+
+    with open(args.config, "r", encoding="utf-8") as f:
+        raw = json.load(f)
+
+    from ..config.config import Config
+    from ..node import NodeRuntime
+    from ..observe.logfmt import setup_logging
+
+    conf = Config(raw)
+    setup_logging(level=conf.get("log.level"), fmt=conf.get("log.format"))
+    runtime = NodeRuntime(raw)
+    # dedicated process: same GC discipline as `python -m emqx_tpu`
+    # (freeze the boot object graph out of gen-2 sweeps after start())
+    runtime.gc_tune_after_boot = True
+    assert runtime.cluster is not None, "worker config must cluster"
+    runtime.cluster.transport.rpc_handlers["wire_stats"] = (
+        lambda peer, params: wire_stats(runtime)
+    )
+    try:
+        asyncio.run(runtime.run_forever())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

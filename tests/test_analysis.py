@@ -1149,3 +1149,83 @@ def test_repo_lockorder_covers_observed_edges():
                 f"inversion {e.held} -> {e.acquired} at "
                 f"{e.path}:{e.line}"
             )
+
+
+# ------------------------------------------------- proc-boundary (PROC role)
+
+
+def test_proc_role_seeded_not_propagated(tmp_path):
+    """Wire-worker entry-module functions carry PROC; shared code they
+    call does NOT inherit it (a separate process is not a thread — the
+    races pass must never see `proc` as a second writer role)."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/wire/worker.py": (
+            "from ..shared import helper\n"
+            "def main():\n"
+            "    helper()\n"
+        ),
+        "emqx_tpu/shared.py": (
+            "def helper():\n"
+            "    return 1\n"
+        ),
+    })
+    role_map = roles.infer_roles(idx)
+    assert roles.PROC in role_map.get(
+        "emqx_tpu.wire.worker:main", set()
+    )
+    assert roles.PROC not in role_map.get(
+        "emqx_tpu.shared:helper", set()
+    )
+
+
+def test_proc_boundary_import_flagged(tmp_path):
+    """Importing the worker-process module anywhere in the package is
+    cross-process state sharing; the symmetric supervisor import from
+    the worker module errors too."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/wire/worker.py": (
+            "from .supervisor import WireSupervisor\n"
+            "def main():\n"
+            "    return WireSupervisor\n"
+        ),
+        "emqx_tpu/wire/supervisor.py": (
+            "class WireSupervisor:\n"
+            "    pass\n"
+        ),
+        "emqx_tpu/node.py": (
+            "from .wire import worker\n"
+            "def boot():\n"
+            "    return worker\n"
+        ),
+    })
+    got = roles.check_proc_boundary(idx)
+    idents = {f.ident for f in got}
+    assert "emqx_tpu.node->emqx_tpu.wire.worker" in idents
+    assert (
+        "emqx_tpu.wire.worker->emqx_tpu.wire.supervisor" in idents
+    )
+    assert all(f.severity == ERROR for f in got)
+
+
+def test_proc_boundary_clean_spawn_shape(tmp_path):
+    """The legal shape — supervisor spawns by command line, worker
+    imports only shared code — produces no findings."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/wire/worker.py": (
+            "from ..config import load\n"
+            "def main():\n"
+            "    return load()\n"
+        ),
+        "emqx_tpu/wire/supervisor.py": (
+            "import subprocess\n"
+            "import sys\n"
+            "def spawn():\n"
+            "    return subprocess.Popen(\n"
+            "        [sys.executable, '-m', 'emqx_tpu.wire.worker'])\n"
+        ),
+        "emqx_tpu/config.py": (
+            "def load():\n"
+            "    return {}\n"
+        ),
+    })
+    assert roles.check_proc_boundary(idx) == []

@@ -115,6 +115,7 @@ def run(argv: Optional[List[str]] = None) -> int:
     if want("roles"):
         with report.timed("roles"):
             report.extend(roles.check_blocking(idx, role_map))
+            report.extend(roles.check_proc_boundary(idx))
     if want("races"):
         with report.timed("races"):
             report.extend(races.check_races(idx, role_map))

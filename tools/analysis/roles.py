@@ -48,12 +48,26 @@ POOL = "pool"
 # tasks draining the per-shard fan-out queues.  They run ON the loop
 # (so LOOP-blocking findings apply with full force), but carry their
 # own role label so a finding inside the broadcast drain path names
-# the plane it stalls — one blocking call there head-of-line-blocks a
-# whole delivery shard, not just one connection.
+# the plane it stalls — one blocked shard worker head-of-line-blocks
+# its whole fan-out shard.
 DELIVERY = "delivery"
+
+# wire-worker process entry points (emqx_tpu/wire/worker.py): code in
+# these modules runs in a CHILD OS process spawned by the wire
+# supervisor.  The label itself is informational (a separate process
+# has its own loop/GIL); the teeth are `check_proc_boundary` below —
+# cross-process `self.<attr>` sharing is impossible exactly as long as
+# neither side ever imports the other, so only transport frames (and
+# the spawn command line / config file / inherited fds) cross.
+PROC = "proc"
 
 # (module, class) roots whose async methods seed the DELIVERY role
 _DELIVERY_ROOTS = {("emqx_tpu.broker.delivery", "DeliveryPool")}
+
+# modules whose code runs ONLY in a wire-worker child process
+_PROC_ENTRY_MODULES = {"emqx_tpu.wire.worker"}
+# modules whose objects live ONLY in the parent/supervisor process
+_PARENT_ONLY_MODULES = {"emqx_tpu.wire.supervisor"}
 
 # module-level blocking primitives: (head name, attr)
 _BLOCKING_MODULE_CALLS = {
@@ -94,6 +108,8 @@ def infer_roles(idx: ProjectIndex) -> Dict[str, Set[str]]:
             add(key, LOOP)
             if (info.module, info.cls) in _DELIVERY_ROOTS:
                 add(key, DELIVERY)
+        if info.module in _PROC_ENTRY_MODULES:
+            add(key, PROC)
         if info.module == "emqx_tpu.ops.native" and _enters_native_pool(
             info
         ):
@@ -123,6 +139,15 @@ def infer_roles(idx: ProjectIndex) -> Dict[str, Set[str]]:
                 if info.is_async:
                     continue
                 for r in src:
+                    # PROC never propagates: it labels the worker
+                    # PROCESS's entry module, not a thread — shared
+                    # broker code called from a worker entry point runs
+                    # in that process under its own loop/worker roles,
+                    # and smearing `proc` across the call graph would
+                    # fabricate cross-"thread" races between what are
+                    # really two address spaces
+                    if r == PROC:
+                        continue
                     changed |= add(callee, r)
     return roles
 
@@ -199,6 +224,112 @@ def check_blocking(
                     "`# analysis: allow-blocking(<why>)`"
                 ),
                 ident=f"{info.qualname}:{desc}",
+            ))
+    return findings
+
+
+def check_proc_boundary(
+    idx: ProjectIndex, package_prefix: str = "emqx_tpu",
+) -> List[Finding]:
+    """The PROC-role process-boundary lint.
+
+    A wire worker is a separate OS process: any `self.<attr>` (or plain
+    object) the supervisor and a worker both "share" is actually two
+    unrelated copies, and code that compiles against the other side's
+    classes is wrong by construction — the write lands in one process,
+    the read happens in the other.  Python can't share state that was
+    never imported, so the enforceable invariant is exactly that:
+
+    * no production module may import a PROC entry module
+      (`emqx_tpu.wire.worker`) — parent-side code holding worker-side
+      objects is cross-process state sharing, and importing the worker
+      module into the parent is the only way to get one;
+    * a PROC entry module may not import a parent-only module
+      (`emqx_tpu.wire.supervisor`) — the symmetric direction;
+    * call edges across the same boundary pairs are errors too (they
+      catch indirect access through re-exports the import check might
+      attribute to an innocent package module).
+
+    Only transport messages cross the boundary; tests/tools/bench are
+    exempt (they orchestrate both sides from the outside).
+    """
+    findings: List[Finding] = []
+
+    def _target_module(imp: tuple) -> str:
+        # ("module", name) or ("symbol", module, symbol)
+        return imp[1] if len(imp) > 1 else ""
+
+    def _hits(target: str, pool: set) -> bool:
+        return any(
+            target == m or target.startswith(m + ".") for m in pool
+        )
+
+    for mod, imports in sorted(idx.imports.items()):
+        if not mod.startswith(package_prefix):
+            continue
+        fi = next(
+            (f for f in idx.files.values() if f.module == mod), None
+        )
+        rel = fi.rel if fi is not None else mod
+        for _local, imp in sorted(imports.items()):
+            target = _target_module(imp)
+            if mod not in _PROC_ENTRY_MODULES and _hits(
+                target, _PROC_ENTRY_MODULES
+            ):
+                findings.append(Finding(
+                    code="proc-boundary", severity=ERROR, path=rel,
+                    line=1,
+                    message=(
+                        f"{mod} imports worker-process module "
+                        f"{target!r}: wire workers are separate OS "
+                        "processes — cross-process self.<attr> sharing "
+                        "is an error; only transport messages cross "
+                        "the boundary"
+                    ),
+                    ident=f"{mod}->{target}",
+                ))
+            if mod in _PROC_ENTRY_MODULES and _hits(
+                target, _PARENT_ONLY_MODULES
+            ):
+                findings.append(Finding(
+                    code="proc-boundary", severity=ERROR, path=rel,
+                    line=1,
+                    message=(
+                        f"worker-process module {mod} imports "
+                        f"supervisor-side module {target!r}: parent "
+                        "state does not exist in the worker process — "
+                        "only transport messages cross the boundary"
+                    ),
+                    ident=f"{mod}->{target}",
+                ))
+    # call edges across the boundary (indirect sharing through
+    # re-exports): a resolved callee carries its defining module
+    for e in idx.edges:
+        if e.kind != CALL:
+            continue
+        caller = idx.funcs.get(e.caller)
+        callee = idx.funcs.get(e.callee)
+        if caller is None or callee is None:
+            continue
+        pair = None
+        if caller.module in _PROC_ENTRY_MODULES and \
+                callee.module in _PARENT_ONLY_MODULES:
+            pair = (caller, callee, "supervisor-side")
+        elif callee.module in _PROC_ENTRY_MODULES and \
+                caller.module.startswith(package_prefix) and \
+                caller.module not in _PROC_ENTRY_MODULES:
+            pair = (caller, callee, "worker-process")
+        if pair is not None:
+            c, t, side = pair
+            findings.append(Finding(
+                code="proc-boundary", severity=ERROR, path=c.path,
+                line=c.node.lineno,
+                message=(
+                    f"{c.qualname} calls {side} function "
+                    f"{t.qualname} across the wire-worker process "
+                    "boundary — only transport messages cross"
+                ),
+                ident=f"{c.qualname}->{t.qualname}",
             ))
     return findings
 
