@@ -4,7 +4,7 @@
 .PHONY: check check-json lint lint-fast lint-locks test test-fast \
         native bench restore-bench chaos ds-bench ds-dump ds-soak \
         churn-bench retained-bench fanout-bench span-bench prep-bench \
-        wire-bench
+        wire-bench shm-bench
 
 # static-analysis gate (tools/analysis/): the dialyzer/xref/elvis
 # analog, stdlib-only — whole-project AST index + call graph, thread-
@@ -113,3 +113,9 @@ prep-bench:
 # sweep measures the IPC tax (no-regression at workers=1).
 wire-bench:
 	python bench.py --wire
+
+# shared-memory match plane microbench (emqx_tpu/shm/): in-process
+# ring round-trip latency + multi-lane fusion + churn-ack throughput;
+# the cross-process rows live in `make wire-bench`
+shm-bench:
+	python bench.py --shm

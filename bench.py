@@ -2179,9 +2179,29 @@ def wire_fanout_rate(n: int) -> float:
 
 WIRE_HEADER = "## Process-sharded wire plane"
 
+# RSS gate workload: resident filters seeded into the match plane
+# AFTER the throughput reps (so the rps rows stay comparable) to show
+# table bytes are O(1) across the pool in shm mode — override with
+# BENCH_WIRE_RESIDENT
+WIRE_RESIDENT = int(os.environ.get("BENCH_WIRE_RESIDENT", 1_000_000))
+
+
+def _rss_kb(pid: int) -> int:
+    """VmRSS of a live process in kB (0 when unreadable)."""
+    try:
+        with open(f"/proc/{pid}/status", "r", encoding="ascii") as f:
+            for ln in f:
+                if ln.startswith("VmRSS:"):
+                    return int(ln.split()[1])
+    except OSError:
+        pass
+    return 0
+
 
 async def _wire_run_one(workers: int, duration: float, reps: int,
-                        n_subs: int, n_pubs: int, payload: int) -> dict:
+                        n_subs: int, n_pubs: int, payload: int,
+                        shm: bool = True,
+                        resident: int = WIRE_RESIDENT) -> dict:
     """One pool size W through REAL sockets: boot a hub + W wire
     workers (W=0 = the in-process listener path), attach `n_subs`
     subscribers to one fan-out filter and `n_pubs` flat-out QoS0
@@ -2205,6 +2225,9 @@ async def _wire_run_one(workers: int, duration: float, reps: int,
     }
     if workers:
         raw["wire"] = {"workers": workers, "stats_interval": 0.5}
+        # shm=False = the per-process layout (every worker boots its
+        # own device engine), the pre-shared-match baseline
+        raw["shm"] = {"enable": bool(shm)}
     rt = NodeRuntime(raw)
     await rt.start()
     try:
@@ -2299,6 +2322,34 @@ async def _wire_run_one(workers: int, duration: float, reps: int,
                 }
                 for h in rt.wire.workers.values()
             }
+        # cross-worker fusion: in shm mode every worker tick lands as
+        # a foreign group on the HUB engine, whose flight recorder
+        # carries the coalesced group size (`grp` column, prep_group)
+        grp_max, grp_gt1_pct = 0, 0.0
+        if workers and shm and rt.broker.engine.flight is not None:
+            grps = [
+                r["prep_group"]
+                for r in rt.broker.engine.flight.recent(4096)
+            ]
+            if grps:
+                grp_max = max(grps)
+                grp_gt1_pct = (
+                    sum(1 for x in grps if x > 1) / len(grps) * 100.0
+                )
+        # memory gate: seed the resident filter set AFTER the reps (so
+        # rps rows stay comparable) and read per-process RSS — in shm
+        # mode the table lives once on the hub and worker RSS must stay
+        # flat from W=1 to W=2
+        if workers and shm and resident:
+            rt.broker.engine.add_filters(
+                [f"bench/resident/{i}/+" for i in range(resident)]
+            )
+        worker_rss = {}
+        if workers:
+            for h in rt.wire.workers.values():
+                if h.proc is not None and h.proc.poll() is None:
+                    worker_rss[str(h.idx)] = _rss_kb(h.proc.pid) // 1024
+        hub_rss_mb = _rss_kb(os.getpid()) // 1024
         for c in subs + pubs:
             try:
                 await c.disconnect()
@@ -2307,11 +2358,17 @@ async def _wire_run_one(workers: int, duration: float, reps: int,
         total = sum(s["sent"] for s in per_worker.values()) or 1
         return {
             "workers": workers,
+            "shm": bool(shm) if workers else None,
             "rps": med,
             "reps": [round(r, 1) for r in rep_rates],
             "rep_spread_pct": spread,
             "n_subs": n_subs,
             "n_pubs": n_pubs,
+            "resident": resident if (workers and shm) else 0,
+            "grp_max": grp_max,
+            "grp_gt1_pct": round(grp_gt1_pct, 1),
+            "hub_rss_mb": hub_rss_mb,
+            "worker_rss_mb": worker_rss,
             # per-worker occupancy: share of wire deliveries each
             # worker served (from its own messages.sent counter)
             "occupancy": {
@@ -2341,19 +2398,30 @@ def run_wire(workers_list=(0, 1, 2), duration: float = 4.0,
     import subprocess
     import tempfile
 
-    rows = []
+    # every W>0 size runs BOTH engine layouts: shm=off is the
+    # per-process baseline (each worker owns a device engine), shm=on
+    # the shared-match plane — the w1 pair is the no-regression gate
+    cases = []
     for w in workers_list:
-        log(f"wire bench: workers={w}")
+        if w == 0:
+            cases.append((0, True))
+        else:
+            cases.extend([(w, False), (w, True)])
+    rows = []
+    for w, shm in cases:
+        tag = "" if w == 0 else (" shm" if shm else " per-proc")
+        log(f"wire bench: workers={w}{tag}")
         with tempfile.NamedTemporaryFile(suffix=".json",
                                          delete=False) as tf:
             stats_path = tf.name
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--wire-one",
-             str(w), "--emit-stats", stats_path],
+             str(w), "--wire-shm", str(int(shm)),
+             "--emit-stats", stats_path],
             stdout=subprocess.PIPE, timeout=1800,
         )
         if r.returncode != 0:
-            log(f"wire bench w{w} failed (rc={r.returncode}); "
+            log(f"wire bench w{w}{tag} failed (rc={r.returncode}); "
                 "row omitted")
             os.unlink(stats_path)
             continue
@@ -2362,7 +2430,8 @@ def run_wire(workers_list=(0, 1, 2), duration: float = 4.0,
         os.unlink(stats_path)
         log(f"  -> {rows[-1]['rps']:,.0f} deliveries/s "
             f"(reps {rows[-1]['reps']}, "
-            f"spread {rows[-1]['rep_spread_pct']:.0f}%)")
+            f"spread {rows[-1]['rep_spread_pct']:.0f}%, "
+            f"grp_max {rows[-1].get('grp_max', 0)})")
     base = rows[0]["rps"] if rows and rows[0]["workers"] == 0 else None
     for r in rows:
         r["vs_inproc"] = (r["rps"] / base) if base else None
@@ -2387,25 +2456,56 @@ def _wire_section_lines(s: dict) -> list:
         "QoS0 publishers, connections round-robined over the workers "
         "so every cross-worker IPC forward leg is exercised.  W=0 is "
         "the in-process listener path (the pre-wire-plane broker).  "
+        "Engine column: per-proc = every worker boots its own device "
+        "engine (the pre-shm layout); shm = the shared-memory match "
+        "plane (workers submit pre-packed ticks to the hub's single "
+        "engine over SPSC rings).  grp>1 = share of hub dispatches "
+        "that fused ticks from more than one worker (flight-recorder "
+        "prep_group); RSS is measured per process AFTER seeding the "
+        "resident filter set into the match plane — in shm mode the "
+        "table lives ONCE on the hub, so worker RSS stays flat as W "
+        "grows.  "
         f"Host: {s['host_threads']} hardware thread(s) — on a 1-thread "
         "host all workers time-share one core, so W>=2 rows measure "
         "the IPC tax and the >=1.8x-at-2-workers scaling gate needs a "
         "multi-core host; occupancy = each worker's share of wire "
         "deliveries (its own messages.sent), the balance check.",
         "",
-        "| workers | deliveries/s | vs in-process | reps | "
-        "rep spread | per-worker occupancy |",
-        "|---|---|---|---|---|---|",
+        "| workers | engine | deliveries/s | vs in-process | reps | "
+        "rep spread | grp>1 | worker RSS (MB) | hub RSS (MB) | "
+        "occupancy |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in s["rows"]:
         occ = " / ".join(
             f"w{i}:{v:.0%}" for i, v in sorted(r["occupancy"].items())
         ) or "—"
         vs = f"{r['vs_inproc']:.2f}x" if r.get("vs_inproc") else "—"
+        if r["workers"] == 0:
+            eng = "in-proc"
+        else:
+            eng = "shm" if r.get("shm") else "per-proc"
+        grp = (
+            f"{r['grp_gt1_pct']:.0f}% (max {r['grp_max']})"
+            if r.get("grp_max") else "—"
+        )
+        wrss = " / ".join(
+            f"w{i}:{v}" for i, v in
+            sorted((r.get("worker_rss_mb") or {}).items())
+        ) or "—"
         lines.append(
-            f"| {r['workers']} | {r['rps']:,.0f} | {vs} "
+            f"| {r['workers']} | {eng} | {r['rps']:,.0f} | {vs} "
             f"| {', '.join(f'{x:,.0f}' for x in r['reps'])} "
-            f"| ±{r['rep_spread_pct']:.0f}% | {occ} |"
+            f"| ±{r['rep_spread_pct']:.0f}% | {grp} | {wrss} "
+            f"| {r.get('hub_rss_mb', 0)} | {occ} |"
+        )
+    if any(r.get("resident") for r in s["rows"]):
+        res = max(r.get("resident") or 0 for r in s["rows"])
+        lines.append("")
+        lines.append(
+            f"RSS measured with {res:,} resident filters seeded into "
+            "the match plane after the throughput reps (hub-side in "
+            "shm mode: table bytes are O(1) across the pool)."
         )
     lines.append("")
     return lines
@@ -2435,6 +2535,184 @@ def _update_wire_table(s: dict) -> None:
     with open(path, "w", encoding="utf-8") as f:
         f.write("\n".join(out) + "\n")
     log("updated BENCH_TABLE.md wire-plane section")
+
+
+SHM_HEADER = "## Shared-memory match plane"
+
+
+def run_shm(n_filters: int = 2000, ticks: int = 600,
+            batch: int = 16, fuse_ticks: int = 300) -> dict:
+    """In-process microbench of the shm match plane (emqx_tpu/shm/):
+    one hub MatchService + client lanes over REAL shared-memory rings,
+    threads standing in for worker processes — the ring protocol is
+    byte-identical, process isolation is exercised by `--wire` and the
+    chaos tests.  Measures the submit->result round-trip at one lane,
+    cross-lane fusion (two lanes submitting concurrently, group sizes
+    from the service counters), and churn-ack throughput through the
+    same rings."""
+    import threading
+
+    from emqx_tpu.models.engine import TopicMatchEngine
+    from emqx_tpu.ops.hashing import HashSpace
+    from emqx_tpu.shm.client import ShmMatchEngine
+    from emqx_tpu.shm.registry import ShmRegistry
+    from emqx_tpu.shm.service import MatchService
+
+    space = HashSpace()
+    eng = TopicMatchEngine(space=space)
+    reg = ShmRegistry(f"shm-bench-{os.getpid()}")
+    svc = MatchService(eng, reg, slots=64, slot_bytes=65536,
+                       poll_interval=0.0005)
+    regions = [svc.create_lane(i) for i in range(2)]
+    loop = asyncio.new_event_loop()
+
+    def run_loop():
+        asyncio.set_event_loop(loop)
+        svc.start()
+        loop.run_forever()
+
+    th = threading.Thread(target=run_loop, daemon=True)
+    th.start()
+    clients = [
+        ShmMatchEngine(space=space, region=r, slots=64,
+                       slot_bytes=65536, timeout=30.0)
+        for r in regions
+    ]
+    try:
+        # churn-ack throughput: the bulk add rides the churn ring in
+        # 128-filter records, applied once by the hub; "done" = every
+        # local fid mapped to its hub fid (full ack round trip)
+        t0 = time.time()
+        for k, cli in enumerate(clients):
+            cli.add_filters(
+                [f"lane{k}/f{i}/+" for i in range(n_filters)]
+            )
+        deadline = t0 + 120.0
+        while any(c.stats()["unacked"] for c in clients):
+            for c in clients:
+                c.poll()
+            time.sleep(0.001)
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "churn acks did not converge: "
+                    + str([c.stats() for c in clients])
+                )
+        churn_rps = (2 * n_filters) / (time.time() - t0)
+
+        topics = [f"lane0/f{i}/x" for i in range(batch)]
+        clients[0].match(topics)  # warmup: first tick pays the compile
+        lats = []
+        for _ in range(ticks):
+            t1 = time.perf_counter()
+            out = clients[0].match(topics)
+            lats.append(time.perf_counter() - t1)
+            assert all(out), "resident filters must match"
+        lats.sort()
+        p50_us = lats[len(lats) // 2] * 1e6
+        p99_us = lats[int(len(lats) * 0.99)] * 1e6
+
+        # cross-lane fusion: both lanes submit flat out from their own
+        # threads; the drain loop fuses same-geometry ticks into one
+        # device call (groups < ticks)
+        clients[1].match([f"lane1/f{i}/x" for i in range(batch)])
+        ticks0, groups0 = svc.match_ticks, svc.match_groups
+        t2 = time.time()
+
+        def pump(k):
+            tl = [f"lane{k}/f{i}/x" for i in range(batch)]
+            for _ in range(fuse_ticks):
+                clients[k].match(tl)
+
+        threads = [threading.Thread(target=pump, args=(k,))
+                   for k in range(2)]
+        for x in threads:
+            x.start()
+        for x in threads:
+            x.join()
+        fuse_wall = time.time() - t2
+        dticks = svc.match_ticks - ticks0
+        dgroups = svc.match_groups - groups0
+        degraded = sum(c.stats()["degraded"] for c in clients)
+        local = sum(c.stats()["local"] for c in clients)
+        return {
+            "n_filters": 2 * n_filters,
+            "churn_ack_rps": round(churn_rps, 1),
+            "tick_p50_us": round(p50_us, 1),
+            "tick_p99_us": round(p99_us, 1),
+            "batch": batch,
+            "fuse_ticks": dticks,
+            "fuse_groups": dgroups,
+            "fused_pct": round(
+                (1.0 - dgroups / dticks) * 100.0, 1) if dticks else 0.0,
+            "fuse_ticks_per_s": round(dticks / fuse_wall, 1)
+            if fuse_wall else 0.0,
+            "degraded": degraded,
+            "local": local,
+            "host_threads": os.cpu_count() or 1,
+        }
+    finally:
+        fut = asyncio.run_coroutine_threadsafe(svc.stop(), loop)
+        try:
+            fut.result(10)
+        except Exception:
+            pass
+        loop.call_soon_threadsafe(loop.stop)
+        th.join(10)
+        for c in clients:
+            c.close()
+        svc.close()
+        loop.close()
+
+
+def _shm_section_lines(s: dict) -> list:
+    return [
+        "",
+        f"{SHM_HEADER} (in-process ring microbench)",
+        "",
+        "One hub MatchService + 2 client lanes over real "
+        "shared-memory SPSC rings (threads stand in for worker "
+        "processes; the ring protocol is byte-identical).  Round trip "
+        "= TopicPrep pack into the slab -> hub drain -> one device "
+        "call -> result scatter -> worker-side exact verify.  Fused % "
+        "= hub dispatches that coalesced ticks from both lanes into "
+        "one device call when both submit flat out.  Host: "
+        f"{s['host_threads']} hardware thread(s).",
+        "",
+        "| resident filters | churn acks/s | tick p50 | tick p99 "
+        "| 2-lane ticks/s | fused | degraded |",
+        "|---|---|---|---|---|---|---|",
+        f"| {s['n_filters']:,} | {s['churn_ack_rps']:,.0f} "
+        f"| {s['tick_p50_us']:,.0f} µs | {s['tick_p99_us']:,.0f} µs "
+        f"| {s['fuse_ticks_per_s']:,.0f} | {s['fused_pct']:.0f}% "
+        f"| {s['degraded']} |",
+        "",
+    ]
+
+
+def _update_shm_table(s: dict) -> None:
+    """Replace the shm-plane section of BENCH_TABLE.md in place."""
+    path = "BENCH_TABLE.md"
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except FileNotFoundError:
+        text = "# BASELINE.json workload table\n"
+    lines = text.split("\n")
+    out, skip = [], False
+    for ln in lines:
+        if ln.startswith(SHM_HEADER):
+            skip = True
+            continue
+        if skip and ln.startswith("## "):
+            skip = False
+        if not skip:
+            out.append(ln)
+    while out and out[-1] == "":
+        out.pop()
+    out.extend(_shm_section_lines(s))
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(out) + "\n")
+    log("updated BENCH_TABLE.md shm-plane section")
 
 
 SPANS_HEADER = "## Latency attribution"
@@ -2989,9 +3267,22 @@ def main() -> None:
     ap.add_argument("--wire-workers", default=None,
                     help="comma-separated pool sizes for --wire "
                          "(default 0,1,2)")
+    ap.add_argument("--shm", action="store_true",
+                    help="shared-memory match plane microbench: "
+                         "in-process ring round-trip latency, "
+                         "cross-lane fusion and churn-ack throughput "
+                         "(`make shm-bench`); writes the "
+                         "BENCH_TABLE.md section")
     ap.add_argument("--wire-one", default=None, type=int,
                     help="single wire-plane measurement at this pool "
                          "size (the sweep's inner subprocess)")
+    ap.add_argument("--wire-shm", default=1, type=int,
+                    help="--wire-one engine layout: 1 = shared-memory "
+                         "match plane (default), 0 = per-process "
+                         "engines (the pre-shm baseline)")
+    ap.add_argument("--wire-resident", default=WIRE_RESIDENT, type=int,
+                    help="resident filters seeded for the --wire-one "
+                         "RSS measurement (after the throughput reps)")
     ap.add_argument("--churn-capacity", action="store_true",
                     help="single churn-capacity measurement at the "
                          "current ETPU_POOL_THREADS (the sweep's inner "
@@ -3021,12 +3312,26 @@ def main() -> None:
     if ns.wire_one is not None:
         stats = asyncio.run(_wire_run_one(
             ns.wire_one, duration=4.0, reps=3, n_subs=30, n_pubs=2,
-            payload=128,
+            payload=128, shm=bool(ns.wire_shm),
+            resident=ns.wire_resident,
         ))
         if ns.emit_stats:
             with open(ns.emit_stats, "w", encoding="utf-8") as f:
                 json.dump(stats, f)
         print(json.dumps(stats))
+        return
+    if ns.shm:
+        stats = run_shm()
+        _update_shm_table(stats)
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
+        print(json.dumps({
+            "metric": "shm_tick_p50_us",
+            "value": stats["tick_p50_us"],
+            "unit": "us",
+            **{k: v for k, v in stats.items() if k != "tick_p50_us"},
+        }))
         return
     if ns.wire:
         sizes = tuple(
@@ -3038,8 +3343,24 @@ def main() -> None:
             with open(ns.emit_stats, "w", encoding="utf-8") as f:
                 json.dump(stats, f)
         rows = stats["rows"]
-        by_w = {r["workers"]: r for r in rows}
+        by_case = {(r["workers"], bool(r.get("shm"))): r for r in rows}
         best = max(rows, key=lambda r: r["rps"])
+        w1_off = by_case.get((1, False))
+        w1_on = by_case.get((1, True))
+        w2_on = by_case.get((2, True))
+        # no-regression gate: shared-engine w1 vs the per-process path
+        w1_shared_vs_perproc = (
+            round(w1_on["rps"] / w1_off["rps"], 2)
+            if (w1_on and w1_off and w1_off["rps"]) else None
+        )
+        # memory gate: per-worker RSS flat from W=1 to W=2 (shm rows)
+        rss_growth_pct = None
+        if w1_on and w2_on:
+            r1 = list((w1_on.get("worker_rss_mb") or {}).values())
+            r2 = list((w2_on.get("worker_rss_mb") or {}).values())
+            if r1 and r2 and r1[0]:
+                m2 = sorted(r2)[len(r2) // 2]
+                rss_growth_pct = round((m2 / r1[0] - 1.0) * 100.0, 1)
         print(json.dumps({
             "metric": "wire_deliveries_per_sec_sharded",
             "value": round(best["rps"], 1),
@@ -3047,7 +3368,11 @@ def main() -> None:
             "workers": best["workers"],
             "vs_inproc": round(best.get("vs_inproc") or 1.0, 2),
             "w1_vs_inproc": round(
-                (by_w.get(1) or {}).get("vs_inproc") or 0.0, 2),
+                (w1_on or {}).get("vs_inproc") or 0.0, 2),
+            "w1_shared_vs_perproc": w1_shared_vs_perproc,
+            "grp_max_w2": (w2_on or {}).get("grp_max", 0),
+            "grp_gt1_pct_w2": (w2_on or {}).get("grp_gt1_pct", 0.0),
+            "worker_rss_growth_w1_to_w2_pct": rss_growth_pct,
             "host_threads": stats["host_threads"],
             "rows": [
                 {k: v for k, v in r.items() if k != "conns"}

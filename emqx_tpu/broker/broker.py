@@ -314,6 +314,14 @@ class Broker:
         c["engine.memo_hits"] = getattr(e, "memo_hits", 0)
         c["engine.memo_misses"] = getattr(e, "memo_misses", 0)
         c["engine.prep_degraded"] = getattr(e, "prep_degraded", 0)
+        # shared-memory match plane client (shm/client.py): submit and
+        # degrade accounting for an engine-less wire worker
+        if getattr(e, "shm_submits", None) is not None:
+            c["shm.submits"] = e.shm_submits
+            c["shm.degraded"] = e.shm_degraded
+            c["shm.local_serves"] = e.shm_local
+            c["shm.oversize"] = e.shm_oversize
+            c["shm.reregisters"] = e.shm_reregisters
         # delivery plane: codec-owned shared-prefix cache telemetry
         # (frame.PREFIX_STATS) copied at the same observation points
         from . import frame as framelib

@@ -121,6 +121,20 @@ PREDEFINED = [
     # process-sharded wire plane (wire/supervisor.py; the per-worker
     # wire.worker.<i>.* figures are gauges, not counters)
     "wire.worker.exits",
+    # shared-memory match plane (emqx_tpu/shm/): worker-side client
+    # counters (synced by Broker.sync_engine_metrics in each worker)
+    # and hub-side service counters (synced by the wire supervisor's
+    # stats loop)
+    "shm.submits",
+    "shm.degraded",
+    "shm.local_serves",
+    "shm.oversize",
+    "shm.reregisters",
+    "shm.hub.ticks",
+    "shm.hub.groups",
+    "shm.hub.churn_records",
+    "shm.hub.reclaims",
+    "shm.hub.res_drops",
     # exhook event dispatcher (exhook/manager.py)
     "exhook.events.dropped",
     "exhook.events.failed",

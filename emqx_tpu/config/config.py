@@ -45,6 +45,17 @@ class Field:
                     value = int(value)
                 if isinstance(value, bool) or not isinstance(value, int):
                     raise ConfigError(f"{path}: expected int, got {value!r}")
+            elif t == "int_or_auto":
+                # sized-at-boot fields (wire.workers): "auto" resolves
+                # against the host at startup, any int pins it
+                if isinstance(value, str):
+                    if value.lower() == "auto":
+                        return "auto"
+                    value = int(value)
+                if isinstance(value, bool) or not isinstance(value, int):
+                    raise ConfigError(
+                        f"{path}: expected int or \"auto\", got {value!r}"
+                    )
             elif t == "float":
                 value = float(value)
             elif t == "bool":
@@ -86,6 +97,11 @@ class Field:
         single-source-of-truth)."""
         kinds = {
             "int": {"type": "integer"},
+            "int_or_auto": {
+                "oneOf": [{"type": "integer"},
+                          {"type": "string", "enum": ["auto"]}],
+                "x-format": "integer or \"auto\" (sized at boot)",
+            },
             "float": {"type": "number"},
             "bool": {"type": "boolean"},
             "str": {"type": "string"},
@@ -226,7 +242,7 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         "engine": Field(
             "enum",
             "single",
-            enum=["single", "sharded"],
+            enum=["single", "sharded", "shm"],
             desc="match engine: single-chip (with hybrid host/device "
                  "arbitration, see broker.hybrid) or mesh-sharded — the "
                  "multi-chip deployment for real ICI meshes, where the "
@@ -517,12 +533,25 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
         # PeerLinks — the esockd acceptor-pool model lifted to whole
         # processes so the broker scales past one event loop + one GIL
         "workers": Field(
-            "int", 0, min=0, max=64,
+            "int_or_auto", 0, min=0, max=64,
             desc="wire-worker process count; 0 = serve listeners "
                  "in-process (single event loop).  The reference sizes "
                  "acceptor pools at schedulers x 8; here one worker per "
                  "core is the analog — each worker is a full "
-                 "connection/delivery plane, not just an acceptor"),
+                 "connection/delivery plane, not just an acceptor. "
+                 "\"auto\" sizes from os.cpu_count() minus the hub "
+                 "core, clamped by wire.max_workers"),
+        "max_workers": Field(
+            "int", 8, min=1, max=64,
+            desc="upper clamp for workers: \"auto\" (a 128-core host "
+                 "should not fork 127 full broker planes by default)"),
+        "backoff_reset": Field(
+            "duration", 60.0,
+            desc="a worker alive this long counts as healthy: the NEXT "
+                 "respawn returns to the base restart_backoff instead "
+                 "of the doubled crash-streak delay (a flaky-then-"
+                 "stable worker must not pay minutes-long respawns "
+                 "hours later)"),
         "reuseport": Field(
             "bool", True,
             desc="bind each worker's listeners with SO_REUSEPORT (the "
@@ -554,6 +583,44 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
             desc="per-worker stats poll cadence (wire_stats RPC over "
                  "the IPC link) feeding the wire.worker.<i>.* gauges "
                  "exported via $SYS/metrics, /monitor and Prometheus"),
+    },
+    "shm": {
+        # shared-memory match plane (emqx_tpu/shm/): wire workers stop
+        # owning engines and submit pre-packed publish ticks to the
+        # hub's single device engine over per-worker SPSC rings in
+        # multiprocessing.shared_memory — table bytes are O(1) across
+        # the pool and ticks from different workers fuse into one
+        # device dispatch
+        "enable": Field(
+            "bool", True,
+            desc="share the hub's match engine with the wire-worker "
+                 "pool over shared-memory rings; false = every worker "
+                 "boots its own engine (the PR 13 per-process layout)"),
+        "slots": Field(
+            "int", 64, min=4, max=4096,
+            desc="ring depth per direction per worker; a full submit "
+                 "ring degrades the tick to the worker's local trie, "
+                 "it never blocks the wire loop"),
+        "slot_bytes": Field(
+            "bytesize", 65536, min=4096,
+            desc="slot stride (64-byte multiple): header + the packed "
+                 "[B, 2L+2] u32 tick payload; batches too big for a "
+                 "slot serve locally and count in shm.oversize"),
+        "timeout": Field(
+            "duration", 0.05,
+            desc="worker-side wait for a hub match result before the "
+                 "tick degrades to the local host trie; also the hub "
+                 "heartbeat staleness threshold (floored at 250ms) "
+                 "past which workers stop submitting entirely"),
+        "poll_interval": Field(
+            "duration", 0.002,
+            desc="hub drain cadence when every worker ring is idle "
+                 "(under load the service re-polls immediately)"),
+        "region": Field(
+            "str", "",
+            desc="worker-side only (injected into derived configs): "
+                 "the shm/registry.py region name of this worker's "
+                 "slab; empty = the plane is off in this process"),
     },
     "dashboard": {
         "listen_port": Field("int", 18083),

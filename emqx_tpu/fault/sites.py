@@ -45,6 +45,10 @@ SITES: Dict[str, str] = {
     "engine.prep": "prep-ahead worker tick (delay = a stalled prep "
                    "stage: match_submit's ticket claim times out and "
                    "degrades to inline prep — the window never freezes)",
+    # shared-memory match plane (shm/client.py)
+    "shm.submit": "worker-side submit-ring enqueue (drop/error/corrupt "
+                  "= the tick is served from the local host trie — the "
+                  "degrade path the hub-death ladder rides)",
 }
 
 # Sites whose injector runs SYNCHRONOUSLY on the asyncio event-loop

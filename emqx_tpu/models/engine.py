@@ -1698,6 +1698,165 @@ class TopicMatchEngine:
     def match_one(self, name: str) -> Set[int]:
         return self.match([name])[0]
 
+    # --------------------------------------------- foreign ticket intake
+    # (shm match plane: pre-packed ticks from wire workers, no topic
+    # strings — verify and deep serving stay worker-side, the hub
+    # returns raw hash-match runs)
+
+    def foreign_submit(self, reqs) -> "_ForeignPending":
+        """Dispatch a group of PRE-PACKED foreign ticks as one device
+        call.  Each req is ``(buf, n_live)`` where buf is a `[B, 2L+2]`
+        u32 staging array a wire worker's own TopicPrep produced; all
+        members share one (B, L) bucket and K follows the sharded
+        coalescer's 4/2/1 ladder, so ticks from DIFFERENT processes
+        amortize one dispatch (the flight `grp` column).  Pending churn
+        fuses into the same call, exactly like the native submit path."""
+        import time
+
+        t0 = time.monotonic()
+        K = len(reqs)
+        B = int(reqs[0][0].shape[0])
+        if any(r[0].shape != reqs[0][0].shape for r in reqs[1:]):
+            raise ValueError(
+                "foreign group members must share one (B, L) bucket: "
+                + ", ".join(str(tuple(r[0].shape)) for r in reqs)
+            )
+        ns = [int(n) for _, n in reqs]
+        out = pbatch = None
+        hcap = 0
+        bytes_up = 0
+        if self.tables.n_entries:
+            import jax
+
+            from ..ops.match import (
+                fused_step_sparse,
+                match_batch_sparse,
+            )
+
+            delta = self.tables.drain_delta()
+            packed = self._sync_descs(delta)
+            big = reqs[0][0] if K == 1 else np.concatenate(
+                [r[0] for r in reqs], axis=0
+            )
+            hcap = K * B * self._hcap_mult
+            bytes_up += big.nbytes
+            pbatch = jax.device_put(big, self.device)
+            if packed is not None:
+                bytes_up += packed.nbytes
+                self._dev, out = fused_step_sparse(
+                    self._dev, jax.device_put(packed, self.device),
+                    pbatch, hcap=hcap,
+                )
+            else:
+                out = match_batch_sparse(self._dev, pbatch, hcap=hcap)
+            try:
+                out.copy_to_host_async()
+            except AttributeError:  # pragma: no cover - older jax
+                pass
+        p = _ForeignPending(out, hcap, pbatch, self._dev, K, B, ns, t0,
+                            bytes_up)
+        self._inflight_n += 1
+        p.pipe_occ = self._inflight_n
+        p.pipe_depth = self.pipeline_depth
+        return p
+
+    def foreign_collect(self, pending: "_ForeignPending"):
+        """Block on a foreign group; returns ``[(counts, fids)]`` per
+        member in submit order (counts int64[n_j], fids i32 in row
+        order).  Overflow recovers through the dense refetch and widens
+        the next submits, same policy as the native collect."""
+        import time
+
+        try:
+            results = self._foreign_serve(pending)
+        finally:
+            self._inflight_n = max(0, self._inflight_n - 1)
+        lat = max(time.monotonic() - pending.t0, 0.0)
+        self.hist_tick.observe(lat)
+        fl = self.flight
+        if fl is not None:
+            fl.record(
+                n_topics=sum(pending.ns), n_unique=sum(pending.ns),
+                path=PATH_DEVICE, reason=R_FORCED,
+                rate_host=self.rate_host, rate_dev=self.rate_dev,
+                bytes_up=pending.bytes_up,
+                bytes_down=pending.bytes_down, verify_fail=0,
+                churn_slots=len(self.tables.delta.slots),
+                lat_s=lat, churn_lag_s=self._churn_lag,
+                pipe_occ=pending.pipe_occ,
+                pipe_depth=pending.pipe_depth,
+                prep_group=pending.k,
+            )
+        return results
+
+    def _foreign_serve(self, pending: "_ForeignPending"):
+        K, B, ns = pending.k, pending.nb, pending.ns
+        empty = np.empty(0, np.int32)
+        if pending.out is None:  # no resident tables: nothing matches
+            return [(np.zeros(n, np.int64), empty) for n in ns]
+        arr = np.asarray(pending.out)
+        pending.bytes_down += arr.nbytes
+        self.dev_serve_count += 1
+        self._note_dev_ok()
+        hcap = pending.hcap
+        total = int(arr[-1])
+        counts = arr[hcap:-1].view(np.uint16)[: K * B].astype(np.int64)
+        results = []
+        if total > hcap or (counts >= 0xFFFF).any():
+            # sparse buffer overflowed: dense refetch against THIS
+            # tick's table version, widen subsequent submits
+            self._hcap_mult *= 2
+            from ..ops.match import match_batch_packed
+
+            full = np.asarray(
+                match_batch_packed(pending.tables, pending.batch)
+            )
+            pending.bytes_down += full.nbytes
+            for j, n in enumerate(ns):
+                rows = full[j * B: j * B + n]
+                live = rows >= 0
+                results.append((
+                    live.sum(axis=1).astype(np.int64),
+                    rows[live].astype(np.int32),  # row-major: in order
+                ))
+            return results
+        offs = np.zeros(K * B + 1, dtype=np.int64)
+        np.cumsum(counts, out=offs[1:])
+        fids_all = arr[: offs[-1]]
+        for j, n in enumerate(ns):
+            lo, hi = int(offs[j * B]), int(offs[j * B + n])
+            results.append((
+                counts[j * B: j * B + n],
+                np.asarray(fids_all[lo:hi], np.int32),
+            ))
+        return results
+
+
+class _ForeignPending:
+    """An in-flight foreign (shm-plane) group: K same-geometry ticks
+    from wire workers fused into one device dispatch.  `tables`/`batch`
+    pin this tick's device arrays for the overflow refetch, mirroring
+    `_PendingMatch`."""
+
+    __slots__ = ("out", "hcap", "batch", "tables", "k", "nb", "ns",
+                 "t0", "bytes_up", "bytes_down", "pipe_occ",
+                 "pipe_depth")
+
+    def __init__(self, out, hcap, batch, tables, k, nb, ns, t0,
+                 bytes_up):
+        self.out = out
+        self.hcap = hcap
+        self.batch = batch
+        self.tables = tables
+        self.k = k  # group width (the flight `grp` column)
+        self.nb = nb  # per-member padded batch rows B
+        self.ns = ns  # live rows per member
+        self.t0 = t0
+        self.bytes_up = bytes_up
+        self.bytes_down = 0
+        self.pipe_occ = 0
+        self.pipe_depth = 0
+
 
 class _PendingMatch:
     """An in-flight match (see TopicMatchEngine.match_submit).

@@ -148,12 +148,32 @@ class NodeRuntime:
         from .ops.hashing import HashSpace
 
         space = HashSpace(max_levels=self.conf.get("engine.max_levels"))
-        if self.conf.get("broker.engine") == "sharded":
+        self._engine_kind = self.conf.get("broker.engine")
+        if self._engine_kind == "shm" and not self.conf.get("shm.region"):
+            # "shm" is meaningful only with a slab to attach (the wire
+            # supervisor injects shm.region into worker configs); a hub
+            # or standalone node falls back to its own engine
+            self._engine_kind = "single"
+        if self._engine_kind == "sharded":
             from .parallel.sharded import ShardedMatchEngine
 
             engine = ShardedMatchEngine(
                 space=space,
                 n_sub_shards=self.conf.get("engine.n_sub_shards"),
+                min_batch=self.conf.get("engine.min_batch"),
+            )
+        elif self._engine_kind == "shm":
+            # shared-memory match plane (emqx_tpu/shm/): this process
+            # owns NO device planes — ticks ride the hub's engine over
+            # the per-worker rings, O(own subs) memory stays here
+            from .shm.client import ShmMatchEngine
+
+            engine = ShmMatchEngine(
+                space=space,
+                region=self.conf.get("shm.region"),
+                slots=int(self.conf.get("shm.slots")),
+                slot_bytes=int(self.conf.get("shm.slot_bytes")),
+                timeout=float(self.conf.get("shm.timeout")),
                 min_batch=self.conf.get("engine.min_batch"),
             )
         else:
@@ -193,7 +213,16 @@ class NodeRuntime:
         # makes this node the HUB of a worker pool — the cluster
         # machinery must exist (workers are peers over unix sockets)
         # even when no TCP cluster is configured
-        self._wire_workers = int(self.conf.get("wire.workers"))
+        _wk = self.conf.get("wire.workers")
+        if _wk == "auto":
+            # one core stays with the hub (event loop + device planes);
+            # the clamp keeps a many-core host from forking a full
+            # broker plane per core by default
+            _wk = min(
+                max(1, (os.cpu_count() or 2) - 1),
+                int(self.conf.get("wire.max_workers")),
+            )
+        self._wire_workers = int(_wk)
         self.wire = None
         wire_unix = None
         if self._wire_workers > 0:
@@ -449,7 +478,10 @@ class NodeRuntime:
         # WAL; boot restores the newest valid snapshot and replays the
         # WAL tail instead of replaying every filter through add_filters
         self.ckpt = None
-        if self.conf.get("engine.ckpt.enable"):
+        # shm-engine processes have no table state to snapshot: the hub
+        # is registry-of-record (its own ckpt covers the union)
+        if self.conf.get("engine.ckpt.enable") \
+                and self._engine_kind != "shm":
             from .checkpoint.manager import CheckpointManager
 
             cdir = self.conf.get("engine.ckpt.dir") or os.path.join(

@@ -117,6 +117,18 @@ KNOWN_KINDS: Dict[str, str] = {
     "wire.worker.exit": "wire-worker process exited; sessions park and "
                         "QoS>=1 forwards spool until the respawn heals "
                         "the IPC link",
+    # shared-memory match plane (emqx_tpu/shm/)
+    "shm.degrade": "worker's shm client changed serving state "
+                   "(hub-down/hub-up on heartbeat age, or a tick "
+                   "timed out to the local trie)",
+    "shm.reregister": "worker re-registered with the hub after a hub "
+                      "generation bump (rings reset, filters replayed)",
+    "shm.reclaim": "hub dropped a dead worker incarnation's filters "
+                   "(worker generation bump or fresh HELLO)",
+    "shm.churn": "hub applied a worker churn record to the shared "
+                 "engine (registry-of-record write)",
+    "shm.group": "hub fused match ticks from multiple worker lanes "
+                 "into one device dispatch",
 }
 
 

@@ -192,15 +192,24 @@ class TopicPrep:
         L = min(self.space.max_levels, L_real + (L_real & 1))
         return B, L
 
-    def pack(self, topics: List[str],
-             reuse: bool = True) -> PrepResult:
+    def pack(self, topics: List[str], reuse: bool = True,
+             out_alloc=None) -> PrepResult:
         """ONE fused prep pass: split + hash + memo + in-tick dedup +
         bucket-padded pack of a publish tick into a `[B, 2L+2]` u32
         staging buffer (`ops.match.pack_topic_batch_np` layout).
 
         ``reuse=False`` packs into a fresh buffer outside the pool (for
         callers whose buffer lifetime outlives the tick, e.g. the
-        single-chip engine's pipelined pendings)."""
+        single-chip engine's pipelined pendings).
+
+        ``out_alloc`` is the zero-copy hook for the shm match plane: a
+        callable ``(B, L) -> ndarray[B, 2L+2] u32 | None`` invoked once
+        the bucket geometry is known.  When it returns a buffer (e.g. a
+        view straight into a shared-memory ring slot) the batch is
+        packed INTO it with no extra copy and the returned result has
+        ``key=None`` — it must never be pool-released.  Returning None
+        (geometry doesn't fit the slot) falls back to the pool path and
+        the caller can tell by checking ``res.key``."""
         n = len(topics)
         with self._lock:
             if self.plane is not None:
@@ -210,8 +219,12 @@ class TopicPrep:
                 t1 = time.perf_counter()
                 B, L = self._bucket(n, maxlen)
                 key = (B, L)
-                buf = self._acquire_locked(key) if reuse else \
-                    np.empty((B, 2 * L + 2), dtype=np.uint32)
+                buf = out_alloc(B, L) if out_alloc is not None else None
+                if buf is not None:
+                    key = None
+                else:
+                    buf = self._acquire_locked(key) if reuse else \
+                        np.empty((B, 2 * L + 2), dtype=np.uint32)
                 self.plane.pack_into(n, B, L, buf)
                 t2 = time.perf_counter()
                 return PrepResult(buf, n, B, L, key, t1 - t0, t2 - t1,
@@ -224,8 +237,12 @@ class TopicPrep:
             maxlen = int(ln.max(initial=1)) if n else 1
             B, L = self._bucket(n, maxlen)
             key = (B, L)
-            buf = self._acquire_locked(key) if reuse else \
-                np.empty((B, 2 * L + 2), dtype=np.uint32)
+            buf = out_alloc(B, L) if out_alloc is not None else None
+            if buf is not None:
+                key = None
+            else:
+                buf = self._acquire_locked(key) if reuse else \
+                    np.empty((B, 2 * L + 2), dtype=np.uint32)
             buf[:n, :L] = ta[:, :L]
             buf[:n, L:2 * L] = tb[:, :L]
             buf[:n, 2 * L] = ln.view(np.uint32)

@@ -1407,7 +1407,15 @@ class ShardedMatchEngine:
                 self._note_kmax(int(counts.max(initial=0)))
                 over = (counts > k).any(axis=0)
                 if over.any():
-                    hits = self._refetch_overflow(pending, hits, counts, over)
+                    hits = (
+                        self._refetch_overflow_foreign(
+                            pending, hits, counts, over
+                        )
+                        if pending.foreign_rows is not None
+                        else self._refetch_overflow(
+                            pending, hits, counts, over
+                        )
+                    )
                 pending.hits_np = hits
                 pending.counts_np = counts
                 pending.group = None
@@ -1464,6 +1472,54 @@ class ShardedMatchEngine:
         )
         grown[:, over_idx, :] = sub
         # regrow the steady-state cap toward the observed demand
+        self._kcap_dyn = min(max(self._kcap_dyn, k2), self._kcap_ceil)
+        return grown
+
+    def _refetch_overflow_foreign(
+        self,
+        pending: "_ShardedPending",
+        hits: np.ndarray,
+        counts: np.ndarray,
+        over: np.ndarray,
+    ) -> np.ndarray:
+        """Overflow refetch for a FOREIGN (shm-plane) tick: there are no
+        topic strings to re-prep, so the sub-batch is assembled straight
+        from the member's stored packed rows (`foreign_rows`), padded to
+        a fresh pow2 bucket with never-match length sentinels."""
+        k = hits.shape[2]
+        snap = pending.snap if pending.snap is not None else self._stacked
+        M = int(snap.k_a.shape[-1])
+        over_idx = np.nonzero(over)[0]
+        maxc = int(counts[:, over].max())
+        if maxc >= 0xFFFF:  # u16-saturated: the true count is unknown
+            maxc = M
+        k2 = next_pow2(min(max(maxc, k + 1), M))
+        rows_src = pending.foreign_rows
+        W = rows_src.shape[1]  # 2L+2
+        n_sub = int(over_idx.size)
+        B2 = max(self._prep.min_batch, next_pow2(n_sub))
+        buf2 = np.empty((B2, W), dtype=np.uint32)
+        buf2[:n_sub] = rows_src[over_idx]
+        if n_sub < B2:
+            buf2[n_sub:, W - 2] = np.uint32(0xFFFFFFFF)  # never match
+        pending.bytes_up += buf2.nbytes
+        sub_hits, _sub_counts = sharded_match_compact_packed(
+            snap, jax.device_put(buf2, self._repl()),
+            mesh=self.mesh, kcap=k2,
+        )
+        rows = self._fetch_rows(n_sub, B2)
+        if rows < B2:
+            sub_hits, _sub_counts = _slice_live(
+                sub_hits, _sub_counts, rows=rows
+            )
+        pending.bytes_down += int(sub_hits.nbytes)
+        sub = np.asarray(sub_hits)[:, :n_sub, :]
+        k2 = sub.shape[2]  # min(k2, M) inside the kernel
+        grown = np.concatenate(
+            [hits, np.full(hits.shape[:2] + (k2 - k,), -1, dtype=hits.dtype)],
+            axis=2,
+        )
+        grown[:, over_idx, :] = sub
         self._kcap_dyn = min(max(self._kcap_dyn, k2), self._kcap_ceil)
         return grown
 
@@ -1907,6 +1963,138 @@ class ShardedMatchEngine:
         if self.on_collision is not None:
             self.on_collision(topic, fid)
 
+    # --------------------------------------------- foreign ticket intake
+    # (shm match plane: pre-packed ticks from wire workers, no topic
+    # strings — verify and deep serving stay worker-side, the mesh
+    # returns raw hash-match runs)
+
+    def foreign_submit(self, reqs) -> List["_ShardedPending"]:
+        """Dispatch K same-(B, L) PRE-PACKED foreign ticks as ONE mesh
+        call.  Each req is ``(buf, n_live)`` with buf a `[B, 2L+2]` u32
+        staging array packed by a wire worker's own TopicPrep — the
+        coalesced-group machinery now fusing ticks from DIFFERENT
+        processes (the flight `grp` column).  Pending churn fuses into
+        the dispatch exactly like the native submit path; members carry
+        their packed rows (`foreign_rows`) so the overflow refetch
+        works without topic strings."""
+        import time
+
+        t0 = time.monotonic()
+        K = len(reqs)
+        B = int(reqs[0][0].shape[0])
+        L = (int(reqs[0][0].shape[1]) - 2) // 2
+        if any(r[0].shape != reqs[0][0].shape for r in reqs[1:]):
+            raise ValueError(
+                "foreign group members must share one (B, L) bucket: "
+                + ", ".join(str(tuple(r[0].shape)) for r in reqs)
+            )
+        if not any(t.n_entries for t in self.shards):
+            members = []
+            for _buf, n in reqs:
+                p = _ShardedPending(None, int(n), None, None, t0=t0)
+                p.resolved = True
+                members.append(p)
+            return members
+        slots, ka, kb, vv = self._pre_step_sync()
+        churn_slots = int((slots >= 0).sum()) if slots is not None else 0
+        if slots is not None:
+            # donation below invalidates the tables every in-flight tick
+            # still snapshots (overflow refetch): drain the window first
+            self._drain_window("churn-fuse")
+        kc = self._kcap_dyn
+        if K > 1:
+            # one [K*B, 2L+2] upload for the whole group, assembled in a
+            # pooled buffer (the member bufs are the service's copies)
+            gkey = (K * B, L)
+            big = self._prep.acquire(gkey)
+            for j, (buf, _n) in enumerate(reqs):
+                big[j * B:(j + 1) * B] = buf
+            pbatch = jax.device_put(big, self._repl())
+        else:
+            big, gkey = None, None
+            pbatch = jax.device_put(reqs[0][0], self._repl())
+        if slots is not None:
+            bytes_up0 = reqs[0][0].nbytes + (
+                slots.nbytes + ka.nbytes + kb.nbytes + vv.nbytes
+            )
+            put = lambda a: jax.device_put(a, self._shard0())
+            self._stacked, hits, counts = sharded_step_compact_packed(
+                self._stacked, put(slots), put(ka), put(kb), put(vv),
+                pbatch, mesh=self.mesh, kcap=kc,
+            )
+        else:
+            bytes_up0 = B * (2 * L + 2) * 4
+            hits, counts = sharded_match_compact_packed(
+                self._stacked, pbatch, mesh=self.mesh, kcap=kc
+            )
+        # fetch slimming: only the LAST member's padding can be trimmed
+        n_last = int(reqs[-1][1])
+        rows = (K - 1) * B + self._fetch_rows(n_last, B)
+        if rows < K * B and K * B - rows >= (K * B) // 4:
+            hits, counts = _slice_live(hits, counts, rows=rows)
+        try:  # start the device->host copy NOW; resolve overlaps it
+            hits.copy_to_host_async()
+            counts.copy_to_host_async()
+        except AttributeError:  # pragma: no cover - older jax
+            pass
+        group = _ShardedGroup(hits, counts, K, host_buf=big, buf_key=gkey)
+        members = []
+        for j, (buf, n) in enumerate(reqs):
+            p = _ShardedPending(
+                self._stacked, int(n), None, None, t0=t0,
+                bytes_up=bytes_up0 if j == 0 else B * (2 * L + 2) * 4,
+            )
+            p.group = group
+            p.row_off = j * B
+            p.foreign_rows = buf
+            p.mut_gen = self._mut_gen
+            p.prep_group = K
+            if j == 0:
+                p.churn_slots = churn_slots
+            members.append(p)
+            self._inflight.append(p)
+            p.pipe_occ = len(self._inflight)
+            p.pipe_depth = self.pipeline_depth
+        return members
+
+    def foreign_collect(self, members: List["_ShardedPending"]):
+        """Block on a foreign group; returns ``[(counts, fids)]`` per
+        member in submit order (counts int64[n_j], fids i32 grouped per
+        topic row) — UNVERIFIED hash runs, the owning worker verifies
+        against its own filter words."""
+        import time
+
+        results = []
+        for p in members:
+            if not p.resolved:
+                self._resolve(p)
+            lat = max(time.monotonic() - (p.t0 or 0.0), 0.0)
+            self.hist_tick.observe(lat)
+            if p.hits_np is None:
+                results.append(
+                    (np.zeros(p.n, np.int64), np.empty(0, np.int32))
+                )
+            else:
+                h2 = p.hits_np.transpose(1, 0, 2)  # [n, D, k]
+                m2 = h2 >= 0
+                results.append((
+                    m2.sum(axis=(1, 2)).astype(np.int64),
+                    h2[m2].astype(np.int32),  # row-major: per-topic runs
+                ))
+            fl = self.flight
+            if fl is not None:
+                fl.record(
+                    n_topics=p.n, n_unique=p.n,
+                    path=PATH_DEVICE, reason=R_FORCED,
+                    rate_host=None, rate_dev=None,
+                    bytes_up=p.bytes_up, bytes_down=p.bytes_down,
+                    verify_fail=0, churn_slots=p.churn_slots,
+                    lat_s=lat, churn_lag_s=self._churn_lag,
+                    pipe_occ=p.pipe_occ, pipe_depth=p.pipe_depth,
+                    prep_group=p.prep_group,
+                )
+        return results
+
     def match_fids(self, topics: Sequence[str]) -> List[Set[int]]:
         """Full unverified [D, B, M] fid sets (tests/debug)."""
         stacked, _ = self.sync_device()
@@ -1982,7 +2170,7 @@ class _ShardedPending:
         "bytes_up", "bytes_down", "churn_slots", "pipe_occ", "pipe_depth",
         "lock", "resolved", "hits_np", "counts_np", "buf", "bufkey",
         "mut_gen", "prep_hash_s", "prep_pack_s", "prep_put_s",
-        "memo_hits_tick", "prep_group",
+        "memo_hits_tick", "prep_group", "foreign_rows",
     )
 
     def __init__(self, snap, n, topics, deep=None, t0=None, bytes_up=0):
@@ -2010,3 +2198,4 @@ class _ShardedPending:
         self.prep_put_s = 0.0
         self.memo_hits_tick = 0  # topic-memo hits within this tick
         self.prep_group = 1  # coalesced dispatch group size
+        self.foreign_rows = None  # packed rows of a foreign (shm) tick

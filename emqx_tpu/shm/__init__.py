@@ -1,0 +1,39 @@
+"""Shared-memory match plane (PR 14): ONE device engine serving the
+wire-worker pool over zero-copy prep rings.
+
+PR 13 gave every wire worker its own full match engine — filter tables
+duplicated per process, churn bookkeeping run N times, and the single
+device plane the paper is about serving exactly one process.  This
+package moves matching behind the hub: each worker packs its publish
+tick's `[B, 2L+2]` u32 prep buffer (the PR 12 fused prep op) DIRECTLY
+into a per-worker `multiprocessing.shared_memory` slab (SPSC submit
+ring, seqlock'd slot headers, no pickling), the hub's `MatchService`
+drains every worker ring on its event loop and rides the coalesced
+group dispatch so ticks from DIFFERENT workers fuse into one device
+call, and raw fid runs scatter back through per-worker result rings.
+Exact verification stays worker-side (the hub never sees topic
+strings); subscribe/unsubscribe crosses the same rings as churn
+records applied once by the hub engine, the registry-of-record.
+
+Degrade story: every worker keeps a lib-less host-trie mirror of its
+OWN filters (memory O(own subs), not O(all tables)) and serves from it
+past `shm.timeout`, on hub death (heartbeat goes stale), or when the
+`shm.submit` fault site fires.  Ring slots are generation-stamped so a
+kill -9 of either side reclaims cleanly: a respawned worker resets its
+rings and bumps its generation (the hub drops the dead incarnation's
+filters and cursors), a restarted hub bumps its generation (workers
+re-register their filters through a fresh churn stream).
+
+The `tools/analysis` proc-boundary pass blesses THIS package as the
+one allowed cross-process crossing: `multiprocessing.shared_memory`
+anywhere else in the package is an error, and region names must come
+from :mod:`registry` (no ad-hoc names).
+"""
+
+from .client import ShmMatchEngine  # noqa: F401
+from .registry import ShmRegistry, region_name  # noqa: F401
+from .rings import (  # noqa: F401
+    K_CHURN, K_CHURN_ACK, K_HELLO, K_MATCH, K_MATCH_RES,
+    SlabView, slab_bytes,
+)
+from .service import MatchService  # noqa: F401

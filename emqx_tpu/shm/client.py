@@ -1,0 +1,562 @@
+"""Worker-side shared-memory match engine (`broker.engine: "shm"`).
+
+Presents the match-engine API the broker/batcher stack expects
+(`add_filter` / `remove_filter` / `apply_churn` / `match_submit` /
+`match_collect_raw`) but owns NO device planes: a publish tick's fused
+prep buffer is packed straight into the submit ring's slot (zero-copy:
+`TopicPrep.pack(out_alloc=...)` writes the `[B, 2L+2]` u32 batch into
+the slab), the hub's single engine matches it, and raw fid runs come
+back through the result ring.  Table bytes in a worker are therefore
+O(own subscriptions) — the host-trie mirror below — instead of O(all
+tables), which is the whole memory story of the shared plane.
+
+Fid spaces: the worker allocates its OWN local fids (the broker and
+sub-shards in this process only ever see local fids), the hub
+allocates hub fids; churn acks carry the hub fid for every add and the
+client keeps the hub→local map.  A filter whose add has not been acked
+yet is served from the local trie (the `pending` union below), closing
+the subscribe→hub-apply race without blocking the subscribe path.
+
+Degrade ladder (every step counted + traced):
+* result not back within `shm.timeout`, submit ring full, batch too
+  big for a slot, or the `shm.submit` fault site fires → THIS tick is
+  served from the local trie;
+* hub heartbeat stale → every tick serves locally (no per-tick timeout
+  tax) until the heartbeat freshens;
+* hub generation bump (hub restarted) → rings reset + HELLO + full
+  re-register of the local filter set through fresh churn records.
+
+Exact verification is worker-side: hub runs are hash matches only, the
+client checks every mapped fid's filter words against the topic (the
+hub never sees topic strings).  Deep filters (deeper than the device
+level cap) are never device-resident for foreign ticks, so the client
+serves its own deep filters from the trie on every tick.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..broker import topic as topiclib
+from ..fault import plane as _fault
+from ..models.reference import CpuTrieIndex
+from ..observe.flight import PATH_DEVICE, PATH_HOST, LatencyHistogram
+from ..observe.tracepoints import tp
+from ..ops.prep import TopicPrep
+from . import registry
+from .rings import (
+    C_HUB_GEN, C_WORKER_GEN, K_CHURN, K_CHURN_ACK, K_HELLO, K_MATCH,
+    K_MATCH_RES, SlabView,
+)
+
+R_FORCED = 5  # matches models.engine R_FORCED (flight reason code)
+
+
+class _ShmPending:
+    """One in-flight tick: either riding the ring (`tick` set) or
+    already decided local (`mode == "local"`)."""
+
+    __slots__ = ("mode", "tick", "topics", "t0", "deadline", "extra",
+                 "pipe_occ", "pipe_depth")
+
+    def __init__(self, mode, tick, topics, t0, deadline, extra):
+        self.mode = mode  # "shm" | "local"
+        self.tick = tick
+        self.topics = topics
+        self.t0 = t0
+        self.deadline = deadline
+        self.extra = extra  # local fids to union from the trie
+        self.pipe_occ = 0
+        self.pipe_depth = 0
+
+
+class ShmMatchEngine:
+    """Engine-API front over the per-worker submit/result rings."""
+
+    def __init__(self, space, region: str, slots: int, slot_bytes: int,
+                 timeout: float = 0.05, min_batch: int = 64,
+                 use_native: bool = True, attach_retry_s: float = 5.0):
+        self.space = space
+        self.verify_matches = True
+        self.pipeline_depth = 4  # advisory (the hub owns the window)
+        self.flight = None  # node wires a FlightRecorder (or None)
+        self.hist_tick = LatencyHistogram()
+        self.on_collision = None
+        self.on_churn = None  # ckpt WAL hook: hub is registry-of-record
+        self.collision_count = 0
+        self.churn_shed = 0
+        self.prep_degraded = 0
+        self.timeout = float(timeout)
+        self._prep = TopicPrep(space, min_batch=min_batch,
+                               use_native=use_native)
+        # the supervisor creates the slab before spawning us, but a
+        # respawn can race a hub restart: retry the attach briefly
+        deadline = time.monotonic() + attach_retry_s
+        while True:
+            try:
+                seg = registry.attach(region)
+                break
+            except FileNotFoundError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)  # analysis: allow-blocking(boot-time attach retry — the engine is constructed before the node serves any traffic)
+        self._slab = SlabView(seg, slots, slot_bytes)
+        # ---- local registry mirror (own filters ONLY) -----------------
+        self._lk = threading.RLock()
+        self._trie = CpuTrieIndex()
+        self._fids: Dict[str, int] = {}
+        self._refs: Dict[int, int] = {}
+        self._words: Dict[int, List[str]] = {}
+        self._filt: Dict[int, str] = {}
+        self._free: List[int] = []
+        self._next_fid = 0
+        self._deep_loc: Set[int] = set()
+        self._unacked: Set[int] = set()
+        self._hub2loc: Dict[int, int] = {}
+        self._loc2hub: Dict[int, int] = {}
+        # churn seq -> ordered (filt, local fid) adds awaiting their ack
+        self._pending_churn: Dict[int, List[Tuple[str, int]]] = {}
+        # churn records the full ring deferred (FIFO, flushed by poll)
+        self._unsent: List[Tuple[List[Tuple[str, int]], List[str]]] = []
+        self._churn_seq = 0
+        self._tick_seq = 0
+        self._inflight_n = 0
+        self._results: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self._res_lk = threading.Lock()  # result-ring drain (any thread)
+        self._sub_lk = threading.Lock()  # submit-ring writes
+        self._hub_gen = 0
+        self._gen = 0
+        self._hub_down = False
+        # ---- counters (Broker.sync_engine_metrics picks these up) -----
+        self.shm_submits = 0
+        self.shm_degraded = 0   # submitted but served locally (timeout)
+        self.shm_local = 0      # decided local at submit (down/full/big)
+        self.shm_oversize = 0
+        self.shm_reregisters = 0
+        self._attach()
+
+    # ------------------------------------------------------------ attach
+
+    def _attach(self) -> None:
+        """Fresh incarnation handshake: reset both rings (we are the
+        submit producer and the result consumer — after a kill -9 the
+        hub adopts the zeroed cursors), bump our generation stamp, and
+        announce with HELLO so the hub drops the dead incarnation's
+        filter refcounts."""
+        with self._sub_lk, self._res_lk:
+            self._slab.submit.reset()
+            self._slab.result.reset()
+            self._slab.ctrl[C_WORKER_GEN] += 1
+            self._gen = self._slab.worker_gen & 0xFFFFFFFF
+            self._hub_gen = self._slab.hub_gen
+            self._results.clear()
+            w = self._slab.submit.reserve()
+            if w is not None:  # ring just reset: cannot actually be full
+                w.commit(K_HELLO, self._gen, gen=self._gen)
+
+    def _reregister(self) -> None:
+        """Hub restarted (generation bump): replay the whole local
+        filter set — one add per refcount so the hub's counts match —
+        through fresh churn records."""
+        self.shm_reregisters += 1
+        self._attach()
+        with self._lk:
+            self._hub2loc.clear()
+            self._loc2hub.clear()
+            self._pending_churn.clear()
+            self._unsent.clear()  # the full replay supersedes them
+            self._unacked = set(self._refs)
+            adds = []
+            for filt, fid in self._fids.items():
+                adds.extend([(filt, fid)] * self._refs.get(fid, 1))
+            self._send_churn(adds, [])
+        tp("shm.reregister", n=len(self._refs))
+
+    # ----------------------------------------------------------- liveness
+
+    def _hub_ok(self) -> bool:
+        age = self._slab.hub_heartbeat_age_s(time.monotonic_ns())
+        down = age > max(self.timeout, 0.25)
+        if down != self._hub_down:
+            self._hub_down = down
+            tp("shm.degrade", state="hub-down" if down else "hub-up",
+               hb_age_s=round(age, 3))
+        return not down
+
+    def _check_hub_gen(self) -> None:
+        if int(self._slab.ctrl[C_HUB_GEN]) != self._hub_gen \
+                and self._hub_ok():
+            self._reregister()
+
+    # -------------------------------------------------------------- churn
+
+    def _send_churn(self, adds: List[Tuple[str, int]],
+                    removes: List[str]) -> None:
+        """Queue churn records (bounded chunks) and flush what the ring
+        has space for; caller holds self._lk.  A full ring defers
+        records in `_unsent` — flushed on the next poll()/submit, in
+        order — and the affected fids stay in `_unacked` (served from
+        the local trie), so no churn is ever lost, only deferred."""
+        CH = 128  # filters per record (bounded payload)
+        for i in range(0, max(len(adds), len(removes)), CH):
+            a_chunk = adds[i:i + CH]
+            r_chunk = removes[i:i + CH]
+            if a_chunk or r_chunk:
+                self._unsent.append((list(a_chunk), list(r_chunk)))
+        self._flush_churn()
+
+    def _flush_churn(self) -> None:
+        """Push queued churn records while the submit ring has space
+        (caller holds self._lk; FIFO order preserves apply order)."""
+        while self._unsent:
+            a_chunk, r_chunk = self._unsent[0]
+            ab = "\0".join(f for f, _ in a_chunk).encode()
+            rb = "\0".join(r_chunk).encode()
+            need = len(ab) + len(rb)
+            if need > self._slab.submit.payload_cap:
+                if len(a_chunk) + len(r_chunk) > 1:  # split and retry
+                    ha, hr = len(a_chunk) // 2, len(r_chunk) // 2
+                    self._unsent[0:1] = [
+                        (a_chunk[:ha or 1], r_chunk[:hr]),
+                        (a_chunk[ha or 1:], r_chunk[hr:]),
+                    ]
+                    continue
+                self._unsent.pop(0)  # one slot-sized filter string
+                self.churn_shed += 1
+                continue
+            with self._sub_lk:
+                w = self._slab.submit.reserve()
+                if w is None:
+                    self.churn_shed += 1
+                    return  # ring full: retried on next poll/submit
+                self._churn_seq += 1
+                seq = self._churn_seq
+                pay = w.payload_u8(need)
+                if ab:
+                    pay[:len(ab)] = np.frombuffer(ab, np.uint8)
+                if rb:
+                    pay[len(ab):need] = np.frombuffer(rb, np.uint8)
+                w.commit(K_CHURN, seq, a=len(ab), b=len(rb),
+                         nbytes=need, gen=self._gen)
+            self._unsent.pop(0)
+            if a_chunk:
+                self._pending_churn[seq] = list(a_chunk)
+
+    def add_filter(self, filt: str) -> int:
+        with self._lk:
+            fid = self._fids.get(filt)
+            if fid is not None:
+                self._refs[fid] += 1
+                self._send_churn([(filt, fid)], [])
+                return fid
+            fid = self._free.pop() if self._free else self._alloc_fid()
+            ws = topiclib.words(filt)
+            self._fids[filt] = fid
+            self._refs[fid] = 1
+            self._words[fid] = ws
+            self._filt[fid] = filt
+            self._trie.insert(filt, fid)
+            plen = len(ws) - (1 if ws and ws[-1] == "#" else 0)
+            if plen > self.space.max_levels:
+                self._deep_loc.add(fid)
+            self._unacked.add(fid)
+            self._send_churn([(filt, fid)], [])
+            return fid
+
+    def _alloc_fid(self) -> int:
+        fid = self._next_fid
+        self._next_fid += 1
+        return fid
+
+    def add_filters(self, filts: Sequence[str]) -> List[int]:
+        return [self.add_filter(f) for f in filts]
+
+    def remove_filter(self, filt: str) -> Optional[int]:
+        with self._lk:
+            fid = self._fids.get(filt)
+            if fid is None:
+                return None
+            self._refs[fid] -= 1
+            self._send_churn([], [filt])
+            if self._refs[fid] > 0:
+                return None
+            del self._refs[fid]
+            del self._fids[filt]
+            self._trie.delete(filt, fid)
+            self._words.pop(fid, None)
+            self._filt.pop(fid, None)
+            self._deep_loc.discard(fid)
+            self._unacked.discard(fid)
+            hub = self._loc2hub.pop(fid, None)
+            if hub is not None:
+                self._hub2loc.pop(hub, None)
+            self._free.append(fid)
+            return fid
+
+    def apply_churn(self, adds: Sequence[str],
+                    removes: Sequence[str]) -> List[int]:
+        out = []
+        for f in removes:
+            self.remove_filter(f)
+        for f in adds:
+            out.append(self.add_filter(f))
+        return out
+
+    def fid_of(self, filt: str) -> Optional[int]:
+        with self._lk:
+            return self._fids.get(filt)
+
+    def fid_map(self) -> Dict[str, int]:
+        with self._lk:
+            return dict(self._fids)
+
+    def note_churn_shed(self, n: int = 1) -> None:
+        self.churn_shed += n
+
+    # ------------------------------------------------------------- match
+
+    @property
+    def inflight_ticks(self) -> int:
+        return self._inflight_n
+
+    @property
+    def delta_backlog(self) -> int:
+        return len(self._pending_churn)
+
+    @property
+    def memo_hits(self) -> int:
+        return self._prep.hits
+
+    @property
+    def memo_misses(self) -> int:
+        return self._prep.misses
+
+    def poll(self) -> None:
+        """Opportunistically drain the result ring (results + churn
+        acks).  A subscribe-heavy worker that rarely publishes would
+        otherwise leave acks parked until its next match, aging
+        `_unacked` and risking result-ring backpressure on the hub."""
+        with self._res_lk:
+            acks = self._drain_results()
+        for ack_tick, ack_fids in acks:
+            self._apply_ack(ack_tick, ack_fids)
+        if self._unsent and self._hub_ok():
+            with self._lk:
+                self._flush_churn()
+
+    def match_submit(self, topics: Sequence[str]) -> _ShmPending:
+        t0 = time.monotonic()
+        topics = list(topics)
+        self._check_hub_gen()
+        self.poll()
+        with self._lk:
+            extra = (self._deep_loc | self._unacked) \
+                if (self._deep_loc or self._unacked) else None
+        mode = "local"
+        tick = 0
+        a = _fault.inject("shm.submit", err=False) if _fault.enabled() \
+            else None
+        faulted = a is not None and a.kind in ("drop", "error", "corrupt")
+        if not faulted and self._hub_ok():
+            with self._sub_lk:
+                w = self._slab.submit.reserve()
+                if w is not None:
+                    cap32 = self._slab.submit.payload_cap // 4
+
+                    def alloc(B: int, L: int) -> Optional[np.ndarray]:
+                        need = B * (2 * L + 2)
+                        if need > cap32:
+                            return None
+                        return w.payload_u32(need).reshape(B, 2 * L + 2)
+
+                    res = self._prep.pack(topics, out_alloc=alloc)
+                    if res.key is None:  # packed into the slot: submit
+                        self._tick_seq += 1
+                        tick = self._tick_seq
+                        w.commit(K_MATCH, tick, a=res.n, b=res.B,
+                                 c=res.L,
+                                 nbytes=res.B * (2 * res.L + 2) * 4,
+                                 gen=self._gen)
+                        mode = "shm"
+                        self.shm_submits += 1
+                    else:  # batch too deep/wide for a slot
+                        self._prep.release(res.buf, res.key)
+                        self.shm_oversize += 1
+        if mode == "local":
+            self.shm_local += 1
+        p = _ShmPending(mode, tick, topics, t0,
+                        t0 + self.timeout, extra)
+        self._inflight_n += 1
+        p.pipe_occ = self._inflight_n
+        p.pipe_depth = self.pipeline_depth
+        return p
+
+    def match_collect(self, pending: _ShmPending) -> List[Set[int]]:
+        return [set(x) for x in self.match_collect_raw(pending)]
+
+    def match_collect_raw(self, pending: _ShmPending) -> List[List[int]]:
+        colls0 = self.collision_count
+        try:
+            out, path = self._collect_serve(pending)
+        finally:
+            self._inflight_n = max(0, self._inflight_n - 1)
+        lat = max(time.monotonic() - pending.t0, 0.0)
+        self.hist_tick.observe(lat)
+        fl = self.flight
+        if fl is not None:
+            fl.record(
+                n_topics=len(pending.topics),
+                n_unique=len(pending.topics), path=path, reason=R_FORCED,
+                rate_host=None, rate_dev=None, bytes_up=0, bytes_down=0,
+                verify_fail=self.collision_count - colls0,
+                churn_slots=0, lat_s=lat, churn_lag_s=0.0,
+                pipe_occ=pending.pipe_occ, pipe_depth=pending.pipe_depth,
+            )
+        return out
+
+    def _collect_serve(
+        self, pending: _ShmPending
+    ) -> Tuple[List[List[int]], int]:
+        if pending.mode == "shm":
+            got = self._await_result(pending)
+            if got is not None:
+                return self._serve_hub(pending, got), PATH_DEVICE
+            self.shm_degraded += 1
+            tp("shm.degrade", state="tick-timeout", tick=pending.tick)
+        return self._serve_local(pending.topics), PATH_HOST
+
+    def _await_result(self, pending: _ShmPending):
+        """Drain the result ring until our tick lands or the deadline
+        passes.  May run on any collect thread; the drain itself is
+        serialized, the wait spins with a short sleep (the hub's drain
+        cadence is sub-millisecond under load)."""
+        tick = pending.tick
+        while True:
+            # _res_lk is a LEAF lock (lock order: _lk -> _sub_lk ->
+            # _res_lk): the drain only decodes ring records to plain
+            # values; churn acks are applied after release since
+            # _apply_ack takes _lk
+            with self._res_lk:
+                acks = self._drain_results()
+                got = self._results.pop(tick, None)
+            for ack_tick, ack_fids in acks:
+                self._apply_ack(ack_tick, ack_fids)
+            if got is not None:
+                return got
+            now = time.monotonic()
+            if now >= pending.deadline or not self._hub_ok():
+                # sweep expired results occasionally so abandoned ticks
+                # (degraded peers) cannot grow the dict without bound
+                with self._res_lk:
+                    if len(self._results) > 4096:
+                        self._results.clear()
+                return None
+            time.sleep(0.0002)  # analysis: allow-blocking(collect runs on the broker's executor thread — the same blocking-wait contract as the device engines' collect)
+
+    def _drain_results(self) -> List[Tuple[int, List[int]]]:
+        """Decode everything on the result ring (caller holds _res_lk).
+        Returns churn acks as plain (tick, hub fids) values so the
+        caller can apply them after releasing the leaf lock."""
+        acks: List[Tuple[int, List[int]]] = []
+        ring = self._slab.result
+        while True:
+            rec = ring.peek_at(0)
+            if rec is None:
+                return acks
+            if rec.kind == K_MATCH_RES:
+                n = rec.a
+                counts = rec.payload[:4 * n].view(np.uint32).astype(
+                    np.int64
+                )
+                total = int(counts.sum())
+                fids = rec.payload[4 * n:4 * (n + total)].view(
+                    np.int32
+                ).copy()
+                self._results[rec.tick] = (counts, fids)
+            elif rec.kind == K_CHURN_ACK:
+                acks.append((
+                    rec.tick,
+                    rec.payload[:8 * rec.a].view(np.int64).tolist(),
+                ))
+            ring.advance()
+
+    def _apply_ack(self, tick: int, hub_fids: List[int]) -> None:
+        with self._lk:
+            entry = self._pending_churn.pop(tick, None)
+            if entry is None:
+                return
+            for (filt, loc), hub in zip(entry, hub_fids):
+                if self._filt.get(loc) == filt and hub >= 0:
+                    self._hub2loc[int(hub)] = loc
+                    self._loc2hub[loc] = int(hub)
+                    self._unacked.discard(loc)
+
+    def _serve_hub(self, pending: _ShmPending, got) -> List[List[int]]:
+        counts, fids = got
+        topics = pending.topics
+        out: List[List[int]] = []
+        off = 0
+        with self._lk:
+            h2l = self._hub2loc
+            words = self._words
+            for i, t in enumerate(topics):
+                c = int(counts[i]) if i < len(counts) else 0
+                row: List[int] = []
+                if c:
+                    nw = topiclib.words(t)
+                    for f in fids[off:off + c].tolist():
+                        loc = h2l.get(int(f))
+                        if loc is None:
+                            continue  # another worker's filter
+                        ws = words.get(loc)
+                        if ws is None:
+                            continue
+                        if not self.verify_matches or \
+                                topiclib.match_words(nw, ws):
+                            row.append(loc)
+                        else:
+                            self.collision_count += 1
+                            if self.on_collision is not None:
+                                self.on_collision(t, loc)
+                    off += c
+                if pending.extra:
+                    merged = set(row)
+                    merged |= self._trie.match(t) & pending.extra
+                    row = list(merged)
+                out.append(row)
+        return out
+
+    def _serve_local(self, topics: Sequence[str]) -> List[List[int]]:
+        with self._lk:
+            return [sorted(self._trie.match(t)) for t in topics]
+
+    def match(self, topics: Sequence[str]) -> List[Set[int]]:
+        return self.match_collect(self.match_submit(topics))
+
+    def match_one(self, name: str) -> Set[int]:
+        return self.match([name])[0]
+
+    # -------------------------------------------------------------- misc
+
+    @property
+    def n_filters(self) -> int:
+        with self._lk:
+            return len(self._fids)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "submits": self.shm_submits,
+            "degraded": self.shm_degraded,
+            "local": self.shm_local,
+            "oversize": self.shm_oversize,
+            "reregisters": self.shm_reregisters,
+            "filters": self.n_filters,
+            "unacked": len(self._unacked),
+        }
+
+    def close(self) -> None:
+        self._slab.close()

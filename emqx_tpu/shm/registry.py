@@ -1,0 +1,117 @@
+"""Shared-memory region-name registry — the ONLY module allowed to
+construct `multiprocessing.shared_memory.SharedMemory`.
+
+Region names are a cross-process protocol surface: a typo'd or ad-hoc
+name silently attaches two sides to different segments and every read
+sees zeros, which is why the static-analysis gate (`tools/analysis`)
+errors on any `SharedMemory(...)` constructor outside this file.  All
+names derive from one scope string (the hub's wire IPC directory, a
+per-node-instance path) through :func:`region_name`, so two broker
+instances on one host can never collide and a respawned hub finds its
+own stale segments to adopt.
+
+Ownership: the HUB creates and unlinks segments (`ShmRegistry`);
+workers only :func:`attach`.  Attachers are unregistered from the
+CPython resource tracker — otherwise a worker exit would unlink the
+hub's live segment out from under the pool (the 3.10 tracker treats
+every opener as an owner).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from multiprocessing import shared_memory
+from typing import Dict, List
+
+
+def region_name(scope: str, kind: str, idx: int) -> str:
+    """Canonical region name: `etpu_<scope-digest>_<kind><idx>`.
+
+    The digest keys the hub instance (scope = its wire IPC dir), the
+    (kind, idx) pair keys the segment within it — short enough for any
+    platform's shm name limit, unique per node instance on the host.
+    """
+    digest = hashlib.sha1(scope.encode("utf-8", "replace")).hexdigest()[:12]
+    return f"etpu_{digest}_{kind}{idx}"
+
+
+def _untrack(seg: shared_memory.SharedMemory) -> None:
+    """Drop the segment from the resource tracker: the caller attaches
+    to a hub-owned segment and must not unlink it at process exit."""
+    try:  # pragma: no cover - tracker layout is a CPython internal
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(
+            getattr(seg, "_name", "/" + seg.name), "shared_memory"
+        )
+    except Exception:
+        pass
+
+
+def attach(name: str) -> shared_memory.SharedMemory:
+    """Open an existing hub-owned segment (worker side, non-owning)."""
+    seg = shared_memory.SharedMemory(name=name)
+    _untrack(seg)
+    return seg
+
+
+class ShmRegistry:
+    """Hub-side owner of every segment for one node instance.
+
+    `create` adopts (or recreates, on a size mismatch) a stale segment
+    left by a kill -9'd previous incarnation of the same scope, so a
+    hub restart reuses the names its respawned workers were given.
+    """
+
+    def __init__(self, scope: str):
+        self.scope = scope
+        self._owned: List[shared_memory.SharedMemory] = []
+        self.names: Dict[str, str] = {}  # "<kind><idx>" -> region name
+
+    def create(self, kind: str, idx: int,
+               size: int) -> shared_memory.SharedMemory:
+        name = region_name(self.scope, kind, idx)
+        try:
+            seg = shared_memory.SharedMemory(
+                name=name, create=True, size=size
+            )
+        except FileExistsError:
+            # stale segment from a previous incarnation of this scope:
+            # adopt when the geometry still fits, else recreate
+            seg = shared_memory.SharedMemory(name=name)
+            if seg.size < size:
+                seg.unlink()
+                seg.close()
+                seg = shared_memory.SharedMemory(
+                    name=name, create=True, size=size
+                )
+        self._owned.append(seg)
+        self.names[f"{kind}{idx}"] = name
+        return seg
+
+    def close_all(self, unlink: bool = True) -> None:
+        for seg in self._owned:
+            if unlink:
+                # re-register first: when an attacher shares this
+                # process (in-process tests), its _untrack already
+                # removed the tracker cache entry and unlink's own
+                # unregister would make the tracker daemon complain
+                try:  # pragma: no cover - tracker is a CPython internal
+                    from multiprocessing import resource_tracker
+
+                    resource_tracker.register(
+                        getattr(seg, "_name", "/" + seg.name),
+                        "shared_memory",
+                    )
+                except Exception:
+                    pass
+                try:
+                    seg.unlink()
+                except FileNotFoundError:  # pragma: no cover - racing rm
+                    pass
+            try:
+                seg.close()
+            except BufferError:  # pragma: no cover - live views remain
+                pass
+        self._owned.clear()
+        self.names.clear()

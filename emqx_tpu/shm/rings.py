@@ -1,0 +1,251 @@
+"""SPSC rings + control page over one worker's shared-memory slab.
+
+Layout (one slab per worker, created by the hub)::
+
+    [0, 4096)                      control page (u64 cells, below)
+    [4096, 4096 + S*slot)          submit ring   (worker writes, hub reads)
+    [.., .. + S*slot)              result ring   (hub writes, worker reads)
+
+Every ring is strictly single-producer/single-consumer, so the only
+synchronization needed is publication ordering, done seqlock-style per
+slot: the writer stamps the slot's seq ODD (`2*head + 1`) when it
+reserves, fills header fields + payload, stamps it EVEN (`2*head + 2`)
+to publish, THEN advances the shared head cursor.  The reader never
+touches a slot whose seq is not exactly `2*tail + 2`, so a producer
+killed -9 mid-fill leaves an unpublished slot the reader simply never
+sees — reclamation happens wholesale when the respawned producer
+resets the ring and bumps its generation stamp (`SlabView.ctrl`),
+which is how "a worker killed mid-submit leaks no slots" holds.
+
+All cursors (head/tail for both rings) live in the CONTROL PAGE, not
+in either process's Python state: after a kill -9 of either side the
+survivor reads the exact cursor state the dead peer left behind, and a
+ring reset is a handful of u64 stores visible to both sides.
+
+Aligned 8-byte stores from CPython are effectively atomic on every
+platform jax runs on; the seq protocol additionally tolerates torn
+header/payload writes (a torn slot is simply never published).
+
+Payloads are numpy views STRAIGHT INTO the slab — the worker's fused
+prep op packs its `[B, 2L+2]` u32 batch into the slot with zero copies
+and no pickling (`TopicPrep.pack(out_alloc=...)`); the hub copies the
+view once into its device staging assembly and the slot recycles as
+soon as the tail advances.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+CTRL_BYTES = 4096
+SLOT_HDR = 64  # one cache line: u64 seq + u32 gen/kind/tick/a/b/c/nbytes
+
+# control-page u64 cell indices
+C_MAGIC = 0
+C_HUB_GEN = 1        # hub bumps on (re)attach; workers re-register on change
+C_HUB_HB = 2         # hub heartbeat, monotonic ns (worker death detector)
+C_WORKER_GEN = 3     # worker bumps on (re)attach; hub reclaims on change
+C_SUB_HEAD = 4       # submit ring: worker-owned producer cursor
+C_SUB_TAIL = 5       # submit ring: hub-owned consumer cursor
+C_RES_HEAD = 6       # result ring: hub-owned producer cursor
+C_RES_TAIL = 7       # result ring: worker-owned consumer cursor
+C_CHURN_APPLIED = 8  # highest worker churn seq the hub has applied
+
+MAGIC = 0x45545055_00000001  # "ETPU" | layout version
+
+# record kinds (submit ring: MATCH/CHURN/HELLO; result ring: ACK/RES)
+K_MATCH = 1      # a=n live topics, b=B, c=L, payload=[B, 2L+2] u32
+K_CHURN = 2      # tick=churn seq, a=len(adds blob), b=len(removes blob)
+K_HELLO = 3      # fresh worker incarnation: hub drops its old filters
+K_CHURN_ACK = 4  # tick=churn seq, a=n add fids, payload=i64 fids
+K_MATCH_RES = 5  # tick=tick id, a=n, payload=u32 counts[n] + i32 fids
+
+
+def slab_bytes(slots: int, slot_bytes: int) -> int:
+    return CTRL_BYTES + 2 * slots * slot_bytes
+
+
+class Rec:
+    """One published record, viewed in place (reader side).  `payload`
+    aliases the slab — copy anything that outlives the tail advance."""
+
+    __slots__ = ("gen", "kind", "tick", "a", "b", "c", "nbytes",
+                 "payload")
+
+    def __init__(self, gen, kind, tick, a, b, c, nbytes, payload):
+        self.gen = gen
+        self.kind = kind
+        self.tick = tick
+        self.a = a
+        self.b = b
+        self.c = c
+        self.nbytes = nbytes
+        self.payload = payload
+
+
+class Slot:
+    """A reserved (unpublished) slot, writer side.  Fill the payload
+    through `payload_u8`/`payload_u32`, then `commit` publishes."""
+
+    __slots__ = ("_ring", "_i", "_head")
+
+    def __init__(self, ring: "RingView", i: int, head: int):
+        self._ring = ring
+        self._i = i
+        self._head = head
+
+    def payload_u8(self, nbytes: int) -> np.ndarray:
+        return self._ring._pay[self._i][:nbytes]
+
+    def payload_u32(self, count: int) -> np.ndarray:
+        return self._ring._pay[self._i][: count * 4].view(np.uint32)
+
+    def commit(self, kind: int, tick: int, a: int = 0, b: int = 0,
+               c: int = 0, nbytes: int = 0, gen: int = 0) -> None:
+        r = self._ring
+        h = r._hdr[self._i]
+        h[0] = gen & 0xFFFFFFFF
+        h[1] = kind
+        h[2] = tick & 0xFFFFFFFF
+        h[3] = a
+        h[4] = b
+        h[5] = c
+        h[6] = nbytes
+        r._seq[self._i][0] = 2 * self._head + 2  # publish
+        r._ctrl[r._hi] = self._head + 1
+
+
+class RingView:
+    """One SPSC ring over a slab slice; cursors live in the control
+    page so they survive either side's death."""
+
+    def __init__(self, buf, base: int, slots: int, slot_bytes: int,
+                 ctrl: np.ndarray, head_idx: int, tail_idx: int):
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        self.payload_cap = slot_bytes - SLOT_HDR
+        self._ctrl = ctrl
+        self._hi = head_idx
+        self._ti = tail_idx
+        self._seq: List[np.ndarray] = []
+        self._hdr: List[np.ndarray] = []
+        self._pay: List[np.ndarray] = []
+        for i in range(slots):
+            off = base + i * slot_bytes
+            self._seq.append(np.frombuffer(buf, np.uint64, 1, off))
+            self._hdr.append(np.frombuffer(buf, np.uint32, 7, off + 8))
+            self._pay.append(
+                np.frombuffer(buf, np.uint8, self.payload_cap,
+                              off + SLOT_HDR)
+            )
+
+    # ------------------------------------------------------------ cursors
+
+    @property
+    def head(self) -> int:
+        return int(self._ctrl[self._hi])
+
+    @property
+    def tail(self) -> int:
+        return int(self._ctrl[self._ti])
+
+    @property
+    def depth(self) -> int:
+        return max(self.head - self.tail, 0)
+
+    def reset(self) -> None:
+        """Producer-side wholesale reset (fresh incarnation): zero the
+        cursors and every slot seq so no stale publication survives."""
+        self._ctrl[self._hi] = 0
+        self._ctrl[self._ti] = 0
+        for s in self._seq:
+            s[0] = 0
+
+    # ------------------------------------------------------------- writer
+
+    def reserve(self) -> Optional[Slot]:
+        head = self.head
+        if head - self.tail >= self.slots:
+            return None  # full: caller degrades (never blocks)
+        i = head % self.slots
+        self._seq[i][0] = 2 * head + 1  # mark mid-write (seqlock odd)
+        return Slot(self, i, head)
+
+    # ------------------------------------------------------------- reader
+
+    def peek_at(self, k: int = 0) -> Optional[Rec]:
+        """View the record at tail+k without consuming; None when not
+        (yet) published.  k>0 lets the hub decode a whole drain batch
+        before advancing the tail in order."""
+        pos = self.tail + k
+        if pos >= self.head:
+            return None
+        i = pos % self.slots
+        if int(self._seq[i][0]) != 2 * pos + 2:
+            return None  # mid-write or stale incarnation: not published
+        h = self._hdr[i]
+        return Rec(int(h[0]), int(h[1]), int(h[2]), int(h[3]), int(h[4]),
+                   int(h[5]), int(h[6]), self._pay[i])
+
+    def advance(self, k: int = 1) -> None:
+        self._ctrl[self._ti] += k
+
+
+class SlabView:
+    """Typed views over one worker's slab: control page + both rings.
+
+    The same class serves both sides — which ring a process writes is a
+    matter of discipline (worker: submit producer / result consumer;
+    hub: the mirror image), matching the SPSC contract above.
+    """
+
+    def __init__(self, seg, slots: int, slot_bytes: int):
+        if slot_bytes % 64 or slot_bytes <= SLOT_HDR:
+            raise ValueError(
+                f"shm.slot_bytes must be a 64-byte multiple > {SLOT_HDR}"
+                f" (got {slot_bytes})"
+            )
+        need = slab_bytes(slots, slot_bytes)
+        if seg.size < need:
+            raise ValueError(
+                f"shm slab too small: {seg.size} < {need} "
+                f"(slots={slots}, slot_bytes={slot_bytes})"
+            )
+        self.seg = seg
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        buf = seg.buf
+        self.ctrl = np.frombuffer(buf, np.uint64, CTRL_BYTES // 8, 0)
+        self.submit = RingView(buf, CTRL_BYTES, slots, slot_bytes,
+                               self.ctrl, C_SUB_HEAD, C_SUB_TAIL)
+        self.result = RingView(buf, CTRL_BYTES + slots * slot_bytes,
+                               slots, slot_bytes, self.ctrl,
+                               C_RES_HEAD, C_RES_TAIL)
+
+    # generation / liveness cells -------------------------------------
+
+    @property
+    def hub_gen(self) -> int:
+        return int(self.ctrl[C_HUB_GEN])
+
+    @property
+    def worker_gen(self) -> int:
+        return int(self.ctrl[C_WORKER_GEN])
+
+    def hub_heartbeat_age_s(self, now_ns: int) -> float:
+        hb = int(self.ctrl[C_HUB_HB])
+        if hb == 0:
+            return float("inf")
+        return max(now_ns - hb, 0) / 1e9
+
+    def close(self) -> None:
+        # numpy views pin the exported buffer; drop them before close
+        self.ctrl = None
+        self.submit = None
+        self.result = None
+        try:
+            self.seg.close()
+        except BufferError:  # pragma: no cover - a view still live
+            pass

@@ -1,0 +1,367 @@
+"""Hub-side match service: drains every worker's submit ring on the
+hub event loop and feeds the ONE device engine.
+
+The service owns the slabs (created through :class:`ShmRegistry` before
+the workers spawn) and runs as a single asyncio task on the hub loop,
+so every engine mutation — churn application AND match dispatch — stays
+on the loop thread, preserving the engines' single-mutator contract.
+Only the device-sync half of a dispatch (`foreign_collect`) runs on the
+default executor, mirroring how the broker's own collects block.
+
+Drain is three-phase per pass, preserving each ring's record order:
+
+1. walk every published record per lane; churn/hello records are
+   applied to the engine inline (so a match that FOLLOWS a subscribe in
+   its own ring is matched against the updated tables);
+2. match records from all lanes are grouped by packed geometry (B, L)
+   and handed to ``engine.foreign_submit`` in chunks of 4/2/1 — the PR
+   12 coalesced-group machinery now fusing ticks from DIFFERENT
+   processes into one device call (the flight recorder's `grp` column);
+   ``foreign_submit`` copies the slot payloads into its own staging, so
+3. every lane's tail advances immediately and the slots recycle while
+   the device call is still in flight.
+
+Reclamation: a respawned worker resets its rings and bumps its
+generation cell; the service notices the stamp change, drops the dead
+incarnation's filter refcounts from the engine, and resyncs cursors.
+A full result ring never blocks the hub — the reply is dropped and the
+worker's tick times out to its local trie.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observe.tracepoints import tp
+from .registry import ShmRegistry
+from .rings import (
+    C_HUB_GEN, C_HUB_HB, C_MAGIC, C_CHURN_APPLIED, K_CHURN, K_HELLO,
+    K_MATCH, K_CHURN_ACK, K_MATCH_RES, MAGIC, SlabView, slab_bytes,
+)
+
+GROUP_SIZES = (4, 2, 1)  # same ladder as the sharded coalescer
+
+
+class LaneState:
+    """One worker's slab plus the hub's bookkeeping for it."""
+
+    __slots__ = ("idx", "slab", "gen", "filters", "res_lk",
+                 "pending_acks")
+
+    def __init__(self, idx: int, slab: SlabView):
+        self.idx = idx
+        self.slab = slab
+        self.gen = slab.worker_gen
+        # filter -> refcount added by THIS lane (drives reclamation)
+        self.filters: Dict[str, int] = {}
+        self.res_lk = asyncio.Lock()
+        # churn acks that found the result ring full: unlike match
+        # results (worker times out to its local trie and retries the
+        # next tick), a lost ack would leave the worker's fid mapping
+        # un-acked FOREVER, so these retry every drain pass
+        self.pending_acks: List[Tuple[int, List[int]]] = []
+
+
+class _MatchReq:
+    __slots__ = ("lane", "tick", "n", "B", "L", "payload")
+
+    def __init__(self, lane: LaneState, tick: int, n: int, B: int,
+                 L: int, payload: np.ndarray):
+        self.lane = lane
+        self.tick = tick
+        self.n = n
+        self.B = B
+        self.L = L
+        self.payload = payload  # [B, 2L+2] u32 COPY (slot already freed)
+
+
+class MatchService:
+    """Single hub-side drain loop over all worker lanes."""
+
+    def __init__(self, engine, reg: ShmRegistry, slots: int,
+                 slot_bytes: int, poll_interval: float = 0.002):
+        self.engine = engine
+        self.reg = reg
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        self.poll_interval = float(poll_interval)
+        self.lanes: Dict[int, LaneState] = {}
+        # lifecycle state is loop-owned: mutated only here (before the
+        # object is shared) and in start()/stop(), which run on the
+        # loop (threads reach stop() via run_coroutine_threadsafe)
+        self._task: Optional[asyncio.Task] = None  # analysis: owner=loop
+        self._replies: set = set()  # in-flight _collect_reply tasks
+        self._stop = False  # analysis: owner=loop
+        # counters (supervisor mirrors these into broker metrics)
+        self.match_ticks = 0
+        self.match_groups = 0
+        self.churn_records = 0
+        self.churn_filters = 0
+        self.reclaims = 0
+        self.res_drops = 0
+        self.errors = 0
+
+    # ------------------------------------------------------------- lanes
+
+    def create_lane(self, idx: int) -> str:
+        """Create (or adopt) worker `idx`'s slab; returns the region
+        name to hand the worker via its derived config."""
+        seg = self.reg.create("lane", idx,
+                              slab_bytes(self.slots, self.slot_bytes))
+        slab = SlabView(seg, self.slots, self.slot_bytes)
+        # fresh hub incarnation for this lane: reset both rings (we are
+        # about to become submit-consumer / result-producer), bump the
+        # hub generation so an adopted-slab worker re-registers
+        slab.submit.reset()
+        slab.result.reset()
+        slab.ctrl[C_MAGIC] = MAGIC
+        slab.ctrl[C_HUB_GEN] += 1
+        slab.ctrl[C_CHURN_APPLIED] = 0
+        slab.ctrl[C_HUB_HB] = time.monotonic_ns()
+        self.lanes[idx] = LaneState(idx, slab)
+        return self.reg.names[f"lane{idx}"]
+
+    def _drop_lane_filters(self, lane: LaneState, why: str) -> None:
+        # queued acks address the dead incarnation's churn seqs, which
+        # a respawn restarts from zero — never deliver them to the new
+        # incarnation
+        lane.pending_acks.clear()
+        n = sum(lane.filters.values())
+        for filt, cnt in lane.filters.items():
+            for _ in range(cnt):
+                try:
+                    self.engine.remove_filter(filt)
+                except Exception:  # pragma: no cover - engine poisoned
+                    self.errors += 1
+        lane.filters.clear()
+        if n:
+            tp("shm.reclaim", lane=lane.idx, filters=n, why=why)
+
+    def _check_worker_gen(self, lane: LaneState) -> None:
+        gen = lane.slab.worker_gen
+        if gen != lane.gen:
+            # worker respawned: it already reset both rings, so every
+            # in-flight slot of the dead incarnation is reclaimed here
+            self.reclaims += 1
+            self._drop_lane_filters(lane, "worker-gen")
+            lane.gen = gen
+
+    # ------------------------------------------------------------- churn
+
+    def _apply_churn(self, lane: LaneState, rec) -> None:
+        pay = bytes(rec.payload[: rec.a + rec.b])
+        adds = pay[: rec.a].decode().split("\0") if rec.a else []
+        removes = pay[rec.a:].decode().split("\0") if rec.b else []
+        fids: List[int] = []
+        for filt in adds:
+            try:
+                fids.append(int(self.engine.add_filter(filt)))
+                lane.filters[filt] = lane.filters.get(filt, 0) + 1
+            except Exception:  # pragma: no cover - bad filter string
+                self.errors += 1
+                fids.append(-1)
+        for filt in removes:
+            if lane.filters.get(filt, 0) <= 0:
+                continue  # not this lane's (stale incarnation record)
+            try:
+                self.engine.remove_filter(filt)
+                lane.filters[filt] -= 1
+                if not lane.filters[filt]:
+                    del lane.filters[filt]
+            except Exception:  # pragma: no cover
+                self.errors += 1
+        self.churn_records += 1
+        self.churn_filters += len(adds) + len(removes)
+        lane.slab.ctrl[C_CHURN_APPLIED] = rec.tick
+        if adds:
+            self._send_ack(lane, rec.tick, fids)
+        tp("shm.churn", lane=lane.idx, seq=rec.tick, adds=len(adds),
+           removes=len(removes))
+
+    def _send_ack(self, lane: LaneState, seq: int,
+                  fids: List[int]) -> None:
+        lane.pending_acks.append((seq, fids))
+        self._flush_acks(lane)
+
+    def _flush_acks(self, lane: LaneState) -> None:
+        """Write queued churn acks in order until the result ring backs
+        up; a subscribe burst (bulk add_filters) produces acks faster
+        than the worker drains them, and they must all land eventually.
+        Bounded: a worker that stops draining its ring entirely sheds
+        the oldest acks past 4x ring depth (counted in res_drops) and
+        recovers them through a re-register."""
+        while lane.pending_acks:
+            w = lane.slab.result.reserve()
+            if w is None:
+                over = len(lane.pending_acks) - 4 * self.slots
+                if over > 0:
+                    del lane.pending_acks[:over]
+                    self.res_drops += over
+                return
+            seq, fids = lane.pending_acks[0]
+            arr = np.asarray(fids, np.int64)
+            w.payload_u8(arr.nbytes)[:] = arr.view(np.uint8)
+            w.commit(K_CHURN_ACK, seq, a=len(fids), nbytes=arr.nbytes)
+            lane.pending_acks.pop(0)
+
+    # ------------------------------------------------------------- drain
+
+    def _drain_once(self) -> Tuple[int, List[_MatchReq]]:
+        """Phase 1+3: walk every lane's published records in order,
+        applying churn inline and COPYING match payloads, then advance
+        the tails so the slots recycle immediately."""
+        reqs: List[_MatchReq] = []
+        consumed = 0
+        for lane in self.lanes.values():
+            self._check_worker_gen(lane)
+            if lane.pending_acks:  # ring-full leftovers from last pass
+                self._flush_acks(lane)
+            ring = lane.slab.submit
+            k = 0
+            while True:
+                rec = ring.peek_at(k)
+                if rec is None:
+                    break
+                if rec.gen != (lane.gen & 0xFFFFFFFF):
+                    k += 1  # dead incarnation's leftover: skip
+                    continue
+                if rec.kind == K_HELLO:
+                    self._drop_lane_filters(lane, "hello")
+                elif rec.kind == K_CHURN:
+                    self._apply_churn(lane, rec)
+                elif rec.kind == K_MATCH:
+                    pay = rec.payload[: rec.nbytes].view(np.uint32)
+                    buf = pay.reshape(rec.b, 2 * rec.c + 2).copy()
+                    reqs.append(_MatchReq(lane, rec.tick, rec.a,
+                                          rec.b, rec.c, buf))
+                k += 1
+            if k:
+                ring.advance(k)
+                consumed += k
+        return consumed, reqs
+
+    def _dispatch(self, reqs: List[_MatchReq]) -> None:
+        """Phase 2: group by geometry and fuse cross-worker ticks into
+        single engine calls via the foreign-ticket intake."""
+        by_geom: Dict[Tuple[int, int], List[_MatchReq]] = {}
+        for r in reqs:
+            by_geom.setdefault((r.B, r.L), []).append(r)
+        loop = asyncio.get_running_loop()
+        for members in by_geom.values():
+            i = 0
+            while i < len(members):
+                k = 1
+                for g in GROUP_SIZES:
+                    if len(members) - i >= g:
+                        k = g
+                        break
+                chunk = members[i:i + k]
+                i += k
+                try:
+                    handle = self.engine.foreign_submit(
+                        [(r.payload, r.n) for r in chunk]
+                    )
+                except Exception:  # pragma: no cover - engine poisoned
+                    self.errors += 1
+                    continue
+                self.match_ticks += len(chunk)
+                self.match_groups += 1
+                if k > 1:
+                    tp("shm.group", k=k,
+                       lanes=sorted({r.lane.idx for r in chunk}))
+                t = loop.create_task(self._collect_reply(handle, chunk))
+                self._replies.add(t)
+                t.add_done_callback(self._replies.discard)
+
+    async def _collect_reply(self, handle,
+                             chunk: List[_MatchReq]) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            results = await loop.run_in_executor(
+                None, self.engine.foreign_collect, handle
+            )
+        except Exception:  # pragma: no cover - device fault
+            self.errors += 1
+            return
+        for req, (counts, fids) in zip(chunk, results):
+            lane = req.lane
+            async with lane.res_lk:
+                w = lane.slab.result.reserve()
+                need = 4 * req.n + 4 * len(fids)
+                if w is None or need > lane.slab.result.payload_cap:
+                    self.res_drops += 1
+                    continue  # worker times out to its local trie
+                pay = w.payload_u8(need)
+                pay[: 4 * req.n] = np.ascontiguousarray(
+                    counts, np.uint32
+                ).view(np.uint8)
+                if len(fids):
+                    pay[4 * req.n:] = np.ascontiguousarray(
+                        fids, np.int32
+                    ).view(np.uint8)
+                w.commit(K_MATCH_RES, req.tick, a=req.n, nbytes=need)
+
+    # -------------------------------------------------------------- loop
+
+    async def _run(self) -> None:
+        while not self._stop:
+            now = time.monotonic_ns()
+            for lane in self.lanes.values():
+                lane.slab.ctrl[C_HUB_HB] = now
+            try:
+                consumed, reqs = self._drain_once()
+                if reqs:
+                    self._dispatch(reqs)
+            except Exception:  # pragma: no cover - keep the hub alive
+                self.errors += 1
+                consumed = 0
+            if consumed:
+                await asyncio.sleep(0)  # busy: yield and come right back
+            else:
+                await asyncio.sleep(self.poll_interval)
+
+    def start(self) -> None:
+        self._stop = False
+        self._task = asyncio.get_event_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stop = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        # drain in-flight reply tasks: their executor collect may still
+        # be running; waiting (not just cancelling) keeps slab teardown
+        # in close() from racing a result write
+        for t in list(self._replies):
+            t.cancel()
+        if self._replies:
+            await asyncio.gather(*self._replies, return_exceptions=True)
+        self._replies.clear()
+
+    def close(self, unlink: bool = True) -> None:
+        # views must drop either way — a still-mapped slab pins the
+        # segment and turns its eventual GC into a BufferError
+        for lane in self.lanes.values():
+            lane.slab.close()
+        self.lanes.clear()
+        self.reg.close_all(unlink=unlink)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "lanes": len(self.lanes),
+            "ticks": self.match_ticks,
+            "groups": self.match_groups,
+            "churn_records": self.churn_records,
+            "churn_filters": self.churn_filters,
+            "reclaims": self.reclaims,
+            "res_drops": self.res_drops,
+            "errors": self.errors,
+        }

@@ -78,6 +78,8 @@ class WorkerHandle:
     proc: Optional[subprocess.Popen] = None
     fails: int = 0  # consecutive crashes (backoff doubles on each)
     restart_at: float = 0.0
+    healthy_since: float = 0.0  # first up+linked observation this run
+    shm_region: str = ""  # this worker's shm slab (empty = plane off)
     last_stats: Dict[str, Any] = field(default_factory=dict)
     last_accepts: float = 0.0
     last_poll: float = 0.0
@@ -88,13 +90,24 @@ class WireSupervisor:
         self.runtime = runtime
         conf = runtime.conf
         self.node_name = runtime.node_name
-        self.n = int(conf.get("wire.workers"))
+        # runtime resolved "auto" (cpu_count minus the hub core, clamped
+        # by wire.max_workers) at boot
+        self.n = int(runtime._wire_workers)
         self.reuseport = bool(conf.get("wire.reuseport"))
         self.ipc_dir = conf.get("wire.ipc_dir") or os.path.join(
             conf.get("node.data_dir"), "wire"
         )
         self.restart_backoff = float(conf.get("wire.restart_backoff"))
+        self.backoff_reset = float(conf.get("wire.backoff_reset"))
         self.stats_interval = float(conf.get("wire.stats_interval"))
+        # shared-memory match plane (emqx_tpu/shm/): hub-owned slabs +
+        # the drain service feeding the hub's single engine
+        self.shm_enable = bool(conf.get("shm.enable"))
+        self.shm_slots = int(conf.get("shm.slots"))
+        self.shm_slot_bytes = int(conf.get("shm.slot_bytes"))
+        # MatchService once _prepare ran; written at prepare/stop on
+        # the loop, read-only elsewhere — never from worker threads
+        self.service = None  # analysis: owner=loop
         self.hub_sock = os.path.join(self.ipc_dir, "hub.sock")
         self.workers: Dict[int, WorkerHandle] = {}
         self.listener_defs: List[Dict[str, Any]] = []  # resolved, shared
@@ -112,6 +125,19 @@ class WireSupervisor:
         ports, build the handles."""
         os.makedirs(self.ipc_dir, exist_ok=True)
         self._resolve_listeners()
+        if self.shm_enable:
+            from ..shm import ShmRegistry
+            from ..shm.service import MatchService
+
+            self.service = MatchService(
+                self.runtime.broker.engine,
+                ShmRegistry(self.ipc_dir),
+                slots=self.shm_slots,
+                slot_bytes=self.shm_slot_bytes,
+                poll_interval=float(
+                    self.runtime.conf.get("shm.poll_interval")
+                ),
+            )
         for i in range(self.n):
             self.workers[i] = WorkerHandle(
                 idx=i,
@@ -121,6 +147,8 @@ class WireSupervisor:
                 config_path=os.path.join(self.ipc_dir, f"w{i}.json"),
                 direct_port=free_port(),
             )
+            if self.service is not None:
+                self.workers[i].shm_region = self.service.create_lane(i)
 
     def _resolve_listeners(self) -> None:
         """One resolved listener set ALL workers bind: `port: 0` defs
@@ -212,6 +240,21 @@ class WireSupervisor:
         base["listeners"] = copy.deepcopy(self.listener_defs) + [
             {"type": "tcp", "host": "127.0.0.1", "port": h.direct_port}
         ]
+        if h.shm_region:
+            # shared-match topology: the worker attaches the hub-owned
+            # slab instead of booting its own device engine, and has no
+            # table state to checkpoint (the hub is registry-of-record)
+            base["broker"] = dict(base.get("broker") or {},
+                                  engine="shm")
+            base["shm"] = {
+                "enable": True,
+                "region": h.shm_region,
+                "slots": self.shm_slots,
+                "slot_bytes": self.shm_slot_bytes,
+                "timeout": conf.get("shm.timeout"),
+            }
+            base["engine"] = dict(base.get("engine") or {})
+            base["engine"]["ckpt.enable"] = False
         return base
 
     # --------------------------------------------------------- lifecycle
@@ -225,6 +268,8 @@ class WireSupervisor:
             tp("wire.worker.spawn", worker=h.name, respawn=False)
             self.runtime.cluster.join(h.name, ("unix", h.sock_path))
         loop = asyncio.get_running_loop()
+        if self.service is not None:
+            self.service.start()
         self._mon_task = loop.create_task(self._monitor())
         self._stats_task = loop.create_task(self._stats_loop())
         self._hk_task = loop.create_task(self._housekeeping())
@@ -276,6 +321,11 @@ class WireSupervisor:
 
     async def stop(self) -> None:
         self._stopping = True
+        if self.service is not None:
+            try:
+                await self.service.stop()
+            except Exception:
+                log.exception("stopping shm match service")
         for t in (self._mon_task, self._stats_task, self._hk_task):
             if t is not None:
                 t.cancel()
@@ -291,6 +341,12 @@ class WireSupervisor:
                 except OSError:
                     pass
         await asyncio.to_thread(self._reap_all)
+        if self.service is not None:
+            # segments unlink only after every worker is reaped (an
+            # attached child pins the mapping; unlink-then-close is
+            # still safe, but reap-first keeps the teardown ordered)
+            self.service.close()
+            self.service = None
         for s in self._shared_socks:
             s.close()
         self._shared_socks.clear()
@@ -326,8 +382,18 @@ class WireSupervisor:
                 if p is not None and p.poll() is not None:
                     rc = p.returncode
                     h.proc = None
+                    # a worker that stayed healthy past backoff_reset
+                    # ended its crash streak: the NEXT respawn pays the
+                    # base delay again, not the doubled tail a flaky
+                    # boot earned hours ago
+                    if h.healthy_since and (
+                        now - h.healthy_since >= self.backoff_reset
+                    ):
+                        h.fails = 0
+                    h.healthy_since = 0.0
                     h.fails += 1
                     self.runtime.broker.metrics.inc("wire.worker.exits")
+                    self._drop_worker_gauges(h.idx)
                     tp("wire.worker.exit", worker=h.name, rc=rc,
                        fails=h.fails)
                     log.warning(
@@ -368,7 +434,12 @@ class WireSupervisor:
                 running = h.proc is not None and h.proc.poll() is None
                 if running and up:
                     alive += 1
-                    h.fails = 0  # healthy link: crash streak over
+                    # crash-streak reset is TIME-based (wire.backoff_
+                    # reset, judged at the next death in _monitor), not
+                    # instant: a worker that crash-loops slower than
+                    # one stats interval must keep escalating
+                    if not h.healthy_since:
+                        h.healthy_since = time.monotonic()
                 stats = None
                 if up:
                     try:
@@ -414,6 +485,27 @@ class WireSupervisor:
                     )
             m.gauge_set("wire.workers.alive", float(alive))
             m.gauge_set("wire.connections", total_conns)
+            if self.service is not None:
+                # hub-side shm service counters: absolute copies, same
+                # observation-point discipline as sync_engine_metrics
+                st = self.service.stats()
+                c = m.counters
+                c["shm.hub.ticks"] = st["ticks"]
+                c["shm.hub.groups"] = st["groups"]
+                c["shm.hub.churn_records"] = st["churn_records"]
+                c["shm.hub.reclaims"] = st["reclaims"]
+                c["shm.hub.res_drops"] = st["res_drops"]
+                m.gauge_set("shm.lanes", float(st["lanes"]))
+
+    def _drop_worker_gauges(self, idx: int) -> None:
+        """Zero-and-drop a dead worker's per-index gauges: after a
+        respawn gap (or a downsized pool) the index must stop reporting
+        its last scraped values through $SYS//monitor/Prometheus."""
+        m = self.runtime.broker.metrics
+        g = f"wire.worker.{idx}."
+        for k in ("connections", "accept_rate", "shed", "rate_limited",
+                  "forward_depth"):
+            m.gauges.pop(g + k, None)
 
     async def _housekeeping(self) -> None:
         """The slice of listener housekeeping the parent still needs

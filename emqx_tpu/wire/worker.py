@@ -42,6 +42,12 @@ def wire_stats(runtime):
         "forward_in": m.get("messages.forward.in"),
         "forward_out": m.get("messages.forward.out"),
         "messages_sent": m.get("messages.sent"),
+        # shared-memory match plane (shm/client.py): zeros when this
+        # worker runs its own engine (shm.enable=false derivations)
+        "shm_submits": getattr(b.engine, "shm_submits", 0),
+        "shm_degraded": getattr(b.engine, "shm_degraded", 0),
+        "shm_local": getattr(b.engine, "shm_local", 0),
+        "shm_oversize": getattr(b.engine, "shm_oversize", 0),
     }
 
 

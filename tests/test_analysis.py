@@ -1229,3 +1229,61 @@ def test_proc_boundary_clean_spawn_shape(tmp_path):
         ),
     })
     assert roles.check_proc_boundary(idx) == []
+
+
+def test_shm_blessing_import_outside_enclave_flagged(tmp_path):
+    """`multiprocessing.shared_memory` is the one blessed PROC crossing
+    (the emqx_tpu.shm ring enclave); any other production module
+    importing it — module or symbol form — reopens cross-process state
+    sharing without the seqlock/generation invariants and errors."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/shm/registry.py": (
+            "from multiprocessing import shared_memory\n"
+            "def alloc(name):\n"
+            "    return shared_memory.SharedMemory(name, create=True,"
+            " size=8)\n"
+        ),
+        "emqx_tpu/broker.py": (
+            "from multiprocessing import shared_memory\n"
+            "def sneak(name):\n"
+            "    return shared_memory.SharedMemory(name)\n"
+        ),
+        "emqx_tpu/wire/worker.py": (
+            "from multiprocessing.shared_memory import SharedMemory\n"
+            "def sneak2(name):\n"
+            "    return SharedMemory(name)\n"
+        ),
+    })
+    got = roles.check_shm_blessing(idx)
+    mods = {f.ident.split("->")[0] for f in got}
+    assert "emqx_tpu.broker" in mods
+    assert "emqx_tpu.wire.worker" in mods
+    assert not any(m.startswith("emqx_tpu.shm") for m in mods)
+    assert all(f.severity == ERROR for f in got)
+
+
+def test_shm_ctor_outside_registry_flagged(tmp_path):
+    """Even inside the blessed package, SharedMemory construction is
+    pinned to shm/registry.py — region names, stale-segment adoption
+    and resource-tracker untracking live there, so a ctor anywhere
+    else mints a region outside the region_name() scheme."""
+    from tools.analysis import lints
+
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/shm/registry.py": (
+            "from multiprocessing import shared_memory\n"
+            "def alloc(name):\n"
+            "    return shared_memory.SharedMemory(name, create=True,"
+            " size=8)\n"
+        ),
+        "emqx_tpu/shm/rings.py": (
+            "from multiprocessing import shared_memory\n"
+            "def rogue(name):\n"
+            "    return shared_memory.SharedMemory(name)\n"
+        ),
+    })
+    got = lints.check_shm_ctor(idx)
+    assert len(got) == 1
+    assert got[0].code == "shm-ctor"
+    assert got[0].severity == ERROR
+    assert os.path.join("emqx_tpu", "shm", "rings.py") in got[0].path

@@ -1,0 +1,562 @@
+"""Shared-memory match plane tests (emqx_tpu/shm/).
+
+Four tiers: pure-unit ring/registry coverage (seqlock visibility, wrap,
+full-ring backpressure, stale-segment adoption); in-process client +
+hub-service e2e against the CPU trie oracle (hub-served matches, churn
+acks, refcounts, oversize fallback); the chaos front — a worker "kill
+-9" mid-submit must leak no slots (generation-stamp reclaim) and a hub
+death must leave the worker on its host-trie fallback with zero
+lost/dup matches until a hub restart's generation bump re-registers it;
+and the foreign-ticket group intake on both device engines (cross-lane
+ticks fused into one device call).
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from emqx_tpu.models.engine import TopicMatchEngine
+from emqx_tpu.models.reference import CpuTrieIndex
+from emqx_tpu.ops.hashing import HashSpace
+from emqx_tpu.shm.client import ShmMatchEngine
+from emqx_tpu.shm.registry import ShmRegistry, attach, region_name
+from emqx_tpu.shm.rings import (
+    C_HUB_HB, CTRL_BYTES, K_MATCH, SLOT_HDR, SlabView, slab_bytes,
+)
+from emqx_tpu.shm.service import MatchService
+
+SLOTS = 16
+SLOT_BYTES = 65536
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_region_name_scoped_and_stable():
+    a = region_name("/tmp/node-a", "lane", 0)
+    b = region_name("/tmp/node-b", "lane", 0)
+    assert a != b  # two instances on one host never collide
+    assert a == region_name("/tmp/node-a", "lane", 0)
+    assert a.startswith("etpu_") and a.endswith("lane0")
+    assert len(a) <= 31  # macOS PSHMNAMLEN floor, the tightest limit
+
+
+def test_registry_create_adopt_recreate(tmp_path):
+    scope = str(tmp_path)
+    reg = ShmRegistry(scope)
+    seg = reg.create("lane", 0, 4096)
+    seg.buf[:4] = b"keep"
+    # same-scope registry adopts the live segment (hub restart)
+    reg2 = ShmRegistry(scope)
+    seg2 = reg2.create("lane", 0, 4096)
+    assert bytes(seg2.buf[:4]) == b"keep"
+    # a larger request recreates instead of adopting
+    reg3 = ShmRegistry(scope)
+    seg3 = reg3.create("lane", 0, 8192)
+    assert seg3.size >= 8192
+    del seg, seg2
+    reg2._owned.clear()
+    reg._owned.clear()
+    reg3.close_all(unlink=True)
+
+
+def test_attach_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        attach(region_name(str(tmp_path), "lane", 7))
+
+
+# ---------------------------------------------------------------- rings
+
+
+def _slab(tmp_path, slots=4, slot_bytes=1024):
+    reg = ShmRegistry(str(tmp_path))
+    seg = reg.create("lane", 0, slab_bytes(slots, slot_bytes))
+    return reg, SlabView(seg, slots, slot_bytes)
+
+
+def test_slab_geometry_validation(tmp_path):
+    reg = ShmRegistry(str(tmp_path))
+    seg = reg.create("lane", 1, slab_bytes(4, 1024))
+    with pytest.raises(ValueError):
+        SlabView(seg, 4, 1000)  # not 64-aligned
+    with pytest.raises(ValueError):
+        SlabView(seg, 4, 64)  # no payload room
+    with pytest.raises(ValueError):
+        SlabView(seg, 4096, 1024)  # segment too small
+    reg.close_all(unlink=True)
+
+
+def test_ring_roundtrip_and_wrap(tmp_path):
+    reg, slab = _slab(tmp_path, slots=4)
+    ring = slab.submit
+    ring.reset()
+    # 3 full laps exercise wrap-around and cursor monotonicity
+    for i in range(12):
+        w = ring.reserve()
+        assert w is not None
+        pay = np.arange(8, dtype=np.uint32) + i
+        w.payload_u32(8)[:] = pay
+        w.commit(K_MATCH, i, a=1, b=2, c=3, nbytes=32, gen=9)
+        rec = ring.peek_at(0)
+        assert rec is not None
+        assert (rec.kind, rec.tick, rec.a, rec.b, rec.c, rec.gen) == \
+            (K_MATCH, i, 1, 2, 3, 9)
+        assert rec.nbytes == 32
+        got = rec.payload[:32].view(np.uint32)
+        assert np.array_equal(got, pay)
+        ring.advance()
+    assert ring.depth == 0
+    del w, rec, got, ring  # drop views so the segment can unmap
+    slab.close()
+    reg.close_all(unlink=True)
+
+
+def test_ring_full_backpressure(tmp_path):
+    reg, slab = _slab(tmp_path, slots=4)
+    ring = slab.submit
+    ring.reset()
+    for i in range(4):
+        w = ring.reserve()
+        assert w is not None
+        w.commit(K_MATCH, i, nbytes=0)
+    assert ring.reserve() is None  # full: producer must degrade
+    ring.advance(1)
+    w = ring.reserve()
+    assert w is not None
+    del w, ring  # drop views so the segment can unmap
+    slab.close()
+    reg.close_all(unlink=True)
+
+
+def test_ring_reserved_slot_invisible_until_commit(tmp_path):
+    """Seqlock: a reserved-but-uncommitted slot (the kill -9 window)
+    never surfaces to the consumer."""
+    reg, slab = _slab(tmp_path, slots=4)
+    ring = slab.submit
+    ring.reset()
+    w = ring.reserve()
+    assert w is not None
+    assert ring.peek_at(0) is None  # odd seq: write in progress
+    w.commit(K_MATCH, 5, nbytes=0)
+    assert ring.peek_at(0) is not None
+    del w, ring  # drop views so the segment can unmap
+    slab.close()
+    reg.close_all(unlink=True)
+
+
+def test_slab_layout_constants():
+    assert CTRL_BYTES % 64 == 0 and SLOT_HDR == 64
+    assert slab_bytes(8, 1024) == CTRL_BYTES + 2 * 8 * 1024
+
+
+# ------------------------------------------------- in-process hub plane
+
+
+class _Plane:
+    """One hub engine + MatchService on a background loop thread, plus
+    a client factory — the in-process analogue of supervisor + worker."""
+
+    def __init__(self, scope, slots=SLOTS, slot_bytes=SLOT_BYTES,
+                 poll_interval=0.001):
+        self.space = HashSpace()
+        self.engine = TopicMatchEngine(space=self.space)
+        self.reg = ShmRegistry(scope)
+        self.svc = MatchService(self.engine, self.reg, slots=slots,
+                                slot_bytes=slot_bytes,
+                                poll_interval=poll_interval)
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        self.loop = asyncio.new_event_loop()
+        self._thread = None
+        self.clients = []
+
+    def lane(self, idx):
+        return self.svc.create_lane(idx)
+
+    def start(self):
+        def run():
+            asyncio.set_event_loop(self.loop)
+            self.svc.start()
+            self.loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def client(self, region, timeout=60.0):
+        # generous default: the FIRST hub tick of a geometry pays the
+        # device compile; later ticks return in microseconds
+        c = ShmMatchEngine(space=self.space, region=region,
+                           slots=self.slots, slot_bytes=self.slot_bytes,
+                           timeout=timeout)
+        self.clients.append(c)
+        return c
+
+    def kill_hub(self):
+        """Hub "kill -9": stop the loop thread without any shutdown
+        protocol — heartbeat freezes, segments stay mapped."""
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(10)
+        self._thread = None
+
+    def stop(self, unlink=True):
+        if self._thread is not None:
+            fut = asyncio.run_coroutine_threadsafe(
+                self.svc.stop(), self.loop
+            )
+            fut.result(30)
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._thread.join(10)
+        for c in self.clients:
+            c.close()
+        self.svc.close(unlink=unlink)
+        self.loop.close()
+
+
+def _wait(pred, timeout=30.0, ivl=0.01):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError("condition not reached")
+        time.sleep(ivl)
+
+
+def _acked(cli):
+    """Predicate: every churn record the client sent has been acked
+    (poll() drains the acks the hub parked on the result ring)."""
+    def pred():
+        cli.poll()
+        return not cli._unacked
+    return pred
+
+
+def _seed(cli, oracle, n=40):
+    fids = {}
+    pats = ["s/+/t", "s/#", "a/b/c", "a/+/+", "x/#", "deep/+/+/q"]
+    for i in range(n):
+        f = pats[i % len(pats)] if i < len(pats) \
+            else f"p{i}/" + pats[i % len(pats)]
+        fid = cli.add_filter(f)
+        oracle.insert(f, fid)
+        fids[f] = fid
+    return fids
+
+
+TOPICS = ["s/1/t", "s/9/zz", "a/b/c", "a/q/r", "x/y/z", "none/here",
+          "deep/1/2/q"]
+
+
+def test_e2e_hub_serves_vs_oracle(tmp_path):
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        cli = plane.client(region)
+        oracle = CpuTrieIndex()
+        _seed(cli, oracle)
+        _wait(_acked(cli), timeout=10)
+        for _ in range(3):
+            got = cli.match(TOPICS)
+            for t, g in zip(TOPICS, got):
+                assert g == oracle.match(t), t
+        assert cli.shm_submits >= 3
+        assert plane.svc.match_ticks >= 1  # hub really served
+        # raw rows carry no duplicates (zero-dup contract)
+        rows = cli.match_collect_raw(cli.match_submit(TOPICS))
+        for row in rows:
+            assert len(row) == len(set(row))
+    finally:
+        plane.stop()
+
+
+def test_e2e_refcount_and_remove(tmp_path):
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        cli = plane.client(region)
+        fid = cli.add_filter("r/+")
+        assert cli.add_filter("r/+") == fid  # refcounted, same fid
+        _wait(_acked(cli), timeout=10)
+        assert cli.match(["r/1"]) == [{fid}]
+        cli.remove_filter("r/+")
+        assert cli.match(["r/1"]) == [{fid}]  # one ref left
+        cli.remove_filter("r/+")
+        _wait(lambda: cli.match(["r/1"]) == [set()], timeout=10)
+        # hub side drained to zero too
+        _wait(lambda: plane.svc.lanes[0].filters.get("r/+") is None,
+              timeout=10)
+    finally:
+        plane.stop()
+
+
+def test_e2e_oversize_batch_serves_local(tmp_path):
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        cli = plane.client(region)
+        oracle = CpuTrieIndex()
+        _seed(cli, oracle, n=6)
+        big = [f"s/{i}/t" for i in range(4000)]  # > slot payload
+        got = cli.match(big)
+        assert cli.shm_oversize >= 1
+        for t, g in zip(big, got):
+            assert g == oracle.match(t), t
+    finally:
+        plane.stop()
+
+
+def test_fault_site_shm_submit_degrades_local(tmp_path):
+    from emqx_tpu.fault import plane as fault_plane
+
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        cli = plane.client(region)
+        oracle = CpuTrieIndex()
+        _seed(cli, oracle, n=6)
+        _wait(_acked(cli), timeout=10)
+        fault_plane.configure({"shm.submit": {"action": "drop"}})
+        try:
+            before = cli.shm_local
+            got = cli.match(TOPICS)
+            assert cli.shm_local == before + 1
+            for t, g in zip(TOPICS, got):
+                assert g == oracle.match(t), t
+        finally:
+            fault_plane.reset()
+    finally:
+        plane.stop()
+
+
+# ---------------------------------------------------------- chaos front
+
+
+def test_worker_kill9_mid_submit_leaks_no_slots(tmp_path):
+    """Property: a worker killed -9 between reserve and commit leaves
+    odd-seq slots behind; the respawned incarnation's ring reset +
+    generation bump reclaims them — 3x the ring depth of submits must
+    then ride the ring (a single leaked slot would wedge it)."""
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        c1 = plane.client(region)
+        c1.add_filter("dead/+")
+        _wait(_acked(c1), timeout=10)
+        # kill -9 mid-submit: reserve WITHOUT commit, then vanish
+        with c1._sub_lk:
+            assert c1._slab.submit.reserve() is not None
+            assert c1._slab.submit.reserve() is not None
+        reclaims0 = plane.svc.reclaims
+        c2 = plane.client(region)  # respawned incarnation, same lane
+        oracle = CpuTrieIndex()
+        _seed(c2, oracle, n=8)
+        _wait(lambda: plane.svc.reclaims > reclaims0, timeout=10)
+        # dead incarnation's filters dropped from the hub registry
+        _wait(_acked(c2), timeout=10)
+        got = c2.match(["dead/1"])
+        assert got == [oracle.match("dead/1")] == [set()]
+        n = 3 * plane.slots
+        for i in range(n):
+            got = c2.match(TOPICS)
+            for t, g in zip(TOPICS, got):
+                assert g == oracle.match(t), t
+        assert c2.shm_submits >= n  # every tick rode the ring: no leak
+        assert c2.shm_local == 0
+    finally:
+        plane.stop()
+
+
+def test_hub_death_falls_back_then_restart_reregisters(tmp_path):
+    """Hub kill -9: the worker degrades to its host trie (zero lost or
+    duplicated matches vs the oracle throughout); a restarted hub
+    adopting the same segments bumps the hub generation, the worker
+    re-registers and hub serving resumes."""
+    scope = str(tmp_path)
+    plane = _Plane(scope)
+    region = plane.lane(0)
+    plane.start()
+    oracle = CpuTrieIndex()
+    try:
+        cli = plane.client(region, timeout=60.0)
+        _seed(cli, oracle, n=12)
+        _wait(_acked(cli), timeout=10)
+        assert cli.match(TOPICS) == [oracle.match(t) for t in TOPICS]
+
+        plane.kill_hub()
+        cli.timeout = 0.3  # don't wait a minute per degraded tick
+        time.sleep(0.4)  # heartbeat goes stale past max(timeout, 0.25)
+        rr0 = cli.shm_reregisters
+        for _ in range(5):
+            rows = cli.match_collect_raw(cli.match_submit(TOPICS))
+            for t, row in zip(TOPICS, rows):
+                assert len(row) == len(set(row))  # zero dups
+                assert set(row) == oracle.match(t), t  # zero lost
+        assert cli.shm_local >= 4  # heartbeat-stale ticks went local
+
+        # hub restart: new service adopts the same segments (the old
+        # ones were never unlinked), hub generation bumps per lane
+        eng2 = TopicMatchEngine(space=plane.space)
+        svc2 = MatchService(eng2, ShmRegistry(scope), slots=plane.slots,
+                            slot_bytes=plane.slot_bytes,
+                            poll_interval=0.001)
+        region2 = svc2.create_lane(0)
+        assert region2 == region
+        loop2 = asyncio.new_event_loop()
+
+        def run2():
+            asyncio.set_event_loop(loop2)
+            svc2.start()
+            loop2.run_forever()
+
+        t2 = threading.Thread(target=run2, daemon=True)
+        t2.start()
+        try:
+            cli.timeout = 60.0
+            _wait(lambda: cli.match(TOPICS) is not None and
+                  cli.shm_reregisters > rr0, timeout=30)
+            _wait(_acked(cli), timeout=30)
+            got = cli.match(TOPICS)
+            assert got == [oracle.match(t) for t in TOPICS]
+            _wait(lambda: svc2.match_ticks >= 1, timeout=30)
+        finally:
+            fut = asyncio.run_coroutine_threadsafe(svc2.stop(), loop2)
+            fut.result(30)
+            loop2.call_soon_threadsafe(loop2.stop)
+            t2.join(10)
+            svc2.close()
+    finally:
+        plane.stop(unlink=False)
+
+
+# -------------------------------------------------- cross-lane grouping
+
+
+def test_cross_lane_ticks_fuse_into_one_group(tmp_path):
+    """Two lanes submit same-geometry ticks; one drain pass must fuse
+    them into a single foreign device call (the `grp` column)."""
+    from emqx_tpu.observe.tracepoints import TraceCollector
+
+    plane = _Plane(str(tmp_path))
+    r0, r1 = plane.lane(0), plane.lane(1)
+    # NOT started: we drive the drain by hand to make both ticks land
+    # in the same pass
+    now = time.monotonic_ns()
+    for lane in plane.svc.lanes.values():
+        lane.slab.ctrl[C_HUB_HB] = now
+    c0 = plane.client(r0)
+    c1 = plane.client(r1)
+    oracle0, oracle1 = CpuTrieIndex(), CpuTrieIndex()
+
+    async def pump(until, timeout=60.0):
+        t0 = time.monotonic()
+        while not until():
+            _, reqs = plane.svc._drain_once()
+            if reqs:
+                plane.svc._dispatch(reqs)
+            if plane.svc._replies:
+                await asyncio.gather(*list(plane.svc._replies),
+                                     return_exceptions=True)
+            for lane in plane.svc.lanes.values():
+                lane.slab.ctrl[C_HUB_HB] = time.monotonic_ns()
+            await asyncio.sleep(0)
+            assert time.monotonic() - t0 < timeout
+    try:
+        _seed(c0, oracle0, n=6)
+        _seed(c1, oracle1, n=9)
+        loop = plane.loop
+        def both_acked():
+            c0.poll()
+            c1.poll()
+            return not c0._unacked and not c1._unacked
+
+        loop.run_until_complete(pump(both_acked))
+        with TraceCollector() as tc:
+            p0 = c0.match_submit(TOPICS)
+            p1 = c1.match_submit(TOPICS)
+            assert p0.mode == p1.mode == "shm"
+            groups0 = plane.svc.match_groups
+            loop.run_until_complete(pump(
+                lambda: plane.svc.match_ticks >= 2
+            ))
+            assert plane.svc.match_groups == groups0 + 1  # ONE call
+            got0 = c0.match_collect(p0)
+            got1 = c1.match_collect(p1)
+        assert got0 == [oracle0.match(t) for t in TOPICS]
+        assert got1 == [oracle1.match(t) for t in TOPICS]
+        # each worker only maps its OWN fids back (cross-worker rows
+        # are another lane's business via cluster forward)
+        tc.assert_seen("shm.group", k=2)
+    finally:
+        plane.stop()
+
+
+# ------------------------------------------- foreign intake, both engines
+
+
+def _pack(space, topics):
+    from emqx_tpu.ops.prep import TopicPrep
+
+    prep = TopicPrep(space, min_batch=8)
+    res = prep.pack(topics)
+    buf = res.buf[:res.B].copy()
+    prep.release(res.buf, res.key)
+    return buf, res.n
+
+
+def test_foreign_intake_single_chip_vs_oracle():
+    space = HashSpace()
+    eng = TopicMatchEngine(space=space)
+    oracle = CpuTrieIndex()
+    for i, f in enumerate(["f/+", "f/#", "g/h", "g/+", "z/#"]):
+        oracle.insert(f, eng.add_filter(f))
+    # members of one foreign group must share a (B, L) bucket — pick
+    # topic sets with the same max depth so TopicPrep packs them alike
+    t1 = ["f/1", "g/h", "z/x/y"]
+    t2 = ["z/a/b", "f/2", "g/x", "none"]
+    b1, n1 = _pack(space, t1)
+    b2, n2 = _pack(space, t2)
+    assert b1.shape == b2.shape
+    h = eng.foreign_submit([(b1, n1), (b2, n2)])
+    out = eng.foreign_collect(h)
+    assert len(out) == 2
+    for topics, (counts, fids) in zip((t1, t2), out):
+        off = 0
+        assert len(counts) == len(topics)
+        for t, c in zip(topics, counts):
+            got = set(fids[off:off + int(c)].tolist())
+            off += int(c)
+            assert got == oracle.match(t), t
+
+
+def test_foreign_intake_sharded_vs_oracle():
+    import jax
+
+    from emqx_tpu.parallel.mesh import make_mesh
+    from emqx_tpu.parallel.sharded import ShardedMatchEngine
+
+    assert len(jax.devices()) == 8
+    eng = ShardedMatchEngine(mesh=make_mesh(), n_sub_shards=64)
+    oracle = CpuTrieIndex()
+    for f in ["f/+", "f/#", "g/h", "deep/a/b/c/#", "z/+/q"]:
+        oracle.insert(f, eng.add_filter(f))
+    space = eng.space
+    t1 = ["f/1", "g/h", "deep/a/b/c/d"]
+    t2 = ["z/p/q", "f/2", "no/t/at/a/ll", "g/h"]
+    b1, n1 = _pack(space, t1)
+    b2, n2 = _pack(space, t2)
+    assert b1.shape == b2.shape
+    members = eng.foreign_submit([(b1, n1), (b2, n2)])
+    out = eng.foreign_collect(members)
+    assert len(out) == 2
+    for topics, (counts, fids) in zip((t1, t2), out):
+        off = 0
+        for t, c in zip(topics, counts):
+            got = set(fids[off:off + int(c)].tolist())
+            off += int(c)
+            assert got == oracle.match(t), t

@@ -185,6 +185,12 @@ def test_worker_config_derivation(tmp_path):
     raw = sup.worker_raw(h0)
     assert raw["node"]["name"] == "hub#w0"
     assert raw["wire"]["workers"] == 0
+    # shared-match plane: the worker attaches the hub-owned slab
+    # instead of booting its own engine, and never checkpoints tables
+    assert raw["broker"]["engine"] == "shm"
+    assert raw["shm"]["region"] == h0.shm_region
+    assert raw["shm"]["region"] != sup.worker_raw(h1)["shm"]["region"]
+    assert raw["engine"]["ckpt.enable"] is False
     assert raw["persistent_session_store"] == {
         "enable": True, "on_disc": True,
     }
@@ -201,6 +207,9 @@ def test_worker_config_derivation(tmp_path):
     for parent_only in ("gateways", "bridges", "exhook", "rules"):
         assert parent_only not in raw
     assert raw["dashboard"]["listen_port"] == 0
+    if sup.service is not None:
+        sup.service.close()
+        sup.service = None
     # fd fallback: sockets bound once in the parent, fds recorded
     rt2 = _hub_runtime(tmp_path / "fd", workers=1, reuseport=False)
     sup2 = rt2.wire
@@ -212,6 +221,9 @@ def test_worker_config_derivation(tmp_path):
             for d in raw2["listeners"][:-1]
         )
     finally:
+        if sup2.service is not None:
+            sup2.service.close()
+            sup2.service = None
         for s in sup2._shared_socks:
             s.close()
 
@@ -222,6 +234,106 @@ def test_hub_has_cluster_without_cluster_config(tmp_path):
     rt = _hub_runtime(tmp_path, workers=1)
     assert rt.cluster is not None
     assert rt.cluster.transport.unix_path.endswith("hub.sock")
+
+
+def test_workers_auto_sizing_clamped(tmp_path, monkeypatch):
+    """wire.workers "auto" = cpu_count minus the hub core, clamped by
+    wire.max_workers, floored at one worker."""
+    monkeypatch.setattr(os, "cpu_count", lambda: 16)
+    rt = _hub_runtime(tmp_path / "a", workers="auto")
+    assert rt._wire_workers == 8  # default wire.max_workers clamp
+    assert rt.wire.n == 8
+    rt = _hub_runtime(tmp_path / "b", workers="auto", max_workers=3)
+    assert rt._wire_workers == 3
+    monkeypatch.setattr(os, "cpu_count", lambda: 2)
+    rt = _hub_runtime(tmp_path / "c", workers="auto")
+    assert rt._wire_workers == 1
+    monkeypatch.setattr(os, "cpu_count", lambda: None)
+    rt = _hub_runtime(tmp_path / "d", workers="auto")
+    assert rt._wire_workers == 1
+
+
+class _DeadProc:
+    """A worker process object as _monitor sees it post-mortem."""
+
+    returncode = -9
+
+    def poll(self):
+        return -9
+
+
+async def _reap_one(sup, h):
+    """Run the monitor until it reaps h's dead proc, then cancel it."""
+    task = asyncio.ensure_future(sup._monitor())
+    try:
+        await wait_until(lambda: h.proc is None, timeout=10)
+    finally:
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+
+def test_backoff_reset_after_healthy_run(run, tmp_path):
+    """A worker alive past wire.backoff_reset ends its crash streak —
+    the next death pays the base backoff; a shorter healthy run keeps
+    the escalation."""
+    rt = _hub_runtime(tmp_path, workers=1, backoff_reset=5.0)
+    sup = rt.wire
+    sup._prepare()
+    try:
+        sup._stopping = True  # reap-only: the monitor must not respawn
+        h = sup.workers[0]
+        # mid-streak death with no healthy run: keeps escalating
+        h.fails = 3
+        h.proc = _DeadProc()
+        run(_reap_one(sup, h))
+        assert h.fails == 4
+        assert h.healthy_since == 0.0
+        # healthy past the reset window: streak forgiven, this is
+        # crash #1 again and restart_at is the BASE backoff away
+        h.proc = _DeadProc()
+        h.healthy_since = time.monotonic() - 6.0
+        run(_reap_one(sup, h))
+        assert h.fails == 1
+        assert h.restart_at - time.monotonic() <= sup.restart_backoff
+        # healthy, but shorter than the window: streak continues
+        h.proc = _DeadProc()
+        h.healthy_since = time.monotonic() - 1.0
+        run(_reap_one(sup, h))
+        assert h.fails == 2
+    finally:
+        if sup.service is not None:
+            sup.service.close()
+            sup.service = None
+
+
+def test_worker_exit_zeroes_and_drops_gauges(run, tmp_path):
+    """A dead worker's wire.worker.<i>.* gauges drop at reap time so a
+    respawn gap (or a downsized pool) stops reporting stale values;
+    sibling indices are untouched."""
+    rt = _hub_runtime(tmp_path, workers=1)
+    sup = rt.wire
+    sup._prepare()
+    try:
+        sup._stopping = True
+        m = rt.broker.metrics
+        for k in ("connections", "accept_rate", "shed", "rate_limited",
+                  "forward_depth"):
+            m.gauge_set(f"wire.worker.0.{k}", 7.0)
+        m.gauge_set("wire.worker.1.connections", 3.0)
+        exits0 = m.get("wire.worker.exits")
+        h = sup.workers[0]
+        h.proc = _DeadProc()
+        run(_reap_one(sup, h))
+        assert not any(k.startswith("wire.worker.0.") for k in m.gauges)
+        assert m.gauge("wire.worker.1.connections") == 3.0
+        assert m.get("wire.worker.exits") == exits0 + 1
+    finally:
+        if sup.service is not None:
+            sup.service.close()
+            sup.service = None
 
 
 # ------------------------------------------------------------------- e2e

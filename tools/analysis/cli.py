@@ -109,6 +109,7 @@ def run(argv: Optional[List[str]] = None) -> int:
             report.extend(lints.check_undefined(idx, only=only))
             report.extend(lints.check_ast_lints(idx, only=only))
             report.extend(lints.check_churn_hooks(idx))
+            report.extend(lints.check_shm_ctor(idx, only=only))
     if want("registry"):
         with report.timed("registry"):
             report.extend(registry.check_registries(idx))
@@ -116,6 +117,7 @@ def run(argv: Optional[List[str]] = None) -> int:
         with report.timed("roles"):
             report.extend(roles.check_blocking(idx, role_map))
             report.extend(roles.check_proc_boundary(idx))
+            report.extend(roles.check_shm_blessing(idx))
     if want("races"):
         with report.timed("races"):
             report.extend(races.check_races(idx, role_map))

@@ -334,6 +334,50 @@ def check_churn_hooks(idx: ProjectIndex) -> List[Finding]:
     return findings
 
 
+# --------------------------------------------- shm region construction
+
+# the only production file allowed to construct SharedMemory segments:
+# region names, stale-segment adoption and resource-tracker untracking
+# all live there, so a ctor anywhere else mints a region name outside
+# the `region_name()` scheme the supervisor/worker handshake relies on
+SHM_CTOR_FILE = os.path.join("emqx_tpu", "shm", "registry.py")
+
+
+def check_shm_ctor(idx: ProjectIndex,
+                   only: Optional[Set[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, fi in idx.files.items():
+        if fi.tree is None or (only is not None and rel not in only):
+            continue
+        if not rel.startswith("emqx_tpu" + os.sep):
+            continue
+        if rel == SHM_CTOR_FILE:
+            continue
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                getattr(f, "id", None)
+            if name != "SharedMemory":
+                continue
+            if node.lineno in fi.ignored_lines:
+                continue
+            findings.append(Finding(
+                code="shm-ctor", severity=ERROR, path=rel,
+                line=node.lineno,
+                message=(
+                    "SharedMemory constructed outside "
+                    "emqx_tpu/shm/registry.py — every region name "
+                    "must be allocated through ShmRegistry/"
+                    "region_name() (naming scheme, stale-segment "
+                    "adoption, resource-tracker untracking)"
+                ),
+                ident=f"{rel}:L{node.lineno}",
+            ))
+    return findings
+
+
 # -------------------------------------------------------------- native
 
 

@@ -69,6 +69,13 @@ _PROC_ENTRY_MODULES = {"emqx_tpu.wire.worker"}
 # modules whose objects live ONLY in the parent/supervisor process
 _PARENT_ONLY_MODULES = {"emqx_tpu.wire.supervisor"}
 
+# the ONE blessed shared-state crossing of the wire-worker process
+# boundary: the shm match plane (`emqx_tpu/shm/`).  Its rings carry
+# fixed-layout records through seqlock'd slots — every other module
+# must keep to transport frames, so any other import of
+# `multiprocessing.shared_memory` is an unreviewed process crossing.
+_SHM_BLESSED_PREFIX = "emqx_tpu.shm"
+
 # module-level blocking primitives: (head name, attr)
 _BLOCKING_MODULE_CALLS = {
     ("time", "sleep"),
@@ -330,6 +337,54 @@ def check_proc_boundary(
                     "boundary — only transport messages cross"
                 ),
                 ident=f"{c.qualname}->{t.qualname}",
+            ))
+    return findings
+
+
+def check_shm_blessing(
+    idx: ProjectIndex, package_prefix: str = "emqx_tpu",
+) -> List[Finding]:
+    """`multiprocessing.shared_memory` is the ONE blessed PROC crossing.
+
+    Shared memory IS cross-process state sharing — exactly what
+    `check_proc_boundary` exists to forbid — so it gets a single
+    reviewed enclave: `emqx_tpu/shm/`, whose ring layout (seqlock'd
+    slots, generation stamps, cursor control page) makes the sharing
+    crash-safe by construction.  Any other production module importing
+    `multiprocessing.shared_memory` (module or symbol form) reopens the
+    boundary without those invariants, so it is an error here.
+    Tests/tools/bench stay exempt (they orchestrate both sides).
+    """
+    findings: List[Finding] = []
+    for mod, imports in sorted(idx.imports.items()):
+        if not mod.startswith(package_prefix):
+            continue
+        if mod == _SHM_BLESSED_PREFIX or mod.startswith(
+            _SHM_BLESSED_PREFIX + "."
+        ):
+            continue
+        fi = next(
+            (f for f in idx.files.values() if f.module == mod), None
+        )
+        rel = fi.rel if fi is not None else mod
+        for _local, imp in sorted(imports.items()):
+            target = imp[1] if len(imp) > 1 else ""
+            hit = target == "multiprocessing.shared_memory" or \
+                target.startswith("multiprocessing.shared_memory.") or (
+                    target == "multiprocessing" and len(imp) > 2
+                    and imp[2] == "shared_memory"
+                )
+            if not hit:
+                continue
+            findings.append(Finding(
+                code="shm-blessing", severity=ERROR, path=rel, line=1,
+                message=(
+                    f"{mod} imports multiprocessing.shared_memory "
+                    "outside the blessed emqx_tpu.shm package — shared "
+                    "memory is the one reviewed process crossing; go "
+                    "through shm/registry.py + shm/rings.py instead"
+                ),
+                ident=f"{mod}->shared_memory",
             ))
     return findings
 
