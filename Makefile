@@ -4,7 +4,7 @@
 .PHONY: check check-json lint lint-fast lint-locks test test-fast \
         native bench restore-bench chaos ds-bench ds-dump ds-soak \
         churn-bench retained-bench fanout-bench span-bench prep-bench \
-        wire-bench shm-bench
+        wire-bench shm-bench fleet-bench
 
 # static-analysis gate (tools/analysis/): the dialyzer/xref/elvis
 # analog, stdlib-only — whole-project AST index + call graph, thread-
@@ -119,3 +119,10 @@ wire-bench:
 # the cross-process rows live in `make wire-bench`
 shm-bench:
 	python bench.py --shm
+
+# fleet observability: shm-lane span legs over the real hub +
+# 2-wire-worker topology — per-leg attribution, mean-sum
+# reconciliation vs the measured ring round-trip, armed/disarmed
+# overhead A/B; renders via tools/fleet_dump.py
+fleet-bench:
+	python bench.py --spans-shm

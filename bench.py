@@ -3129,6 +3129,325 @@ def _update_spans_table(s: dict) -> None:
     log("updated BENCH_TABLE.md latency-attribution section")
 
 
+SHMSPAN_HEADER = "## Shm-lane attribution"
+# reconciliation gate: sum of per-leg MEANS vs the measured end-to-end
+# ring round-trip mean (same ticks feed both, so this is near-exact;
+# bucket-derived p50/p99 sums can legitimately deviate up to one log2
+# bucket per leg and are display-only)
+SHMSPAN_RECON_GATE_PCT = 15.0
+SHM_LEGS = ("ring_wait", "fuse_wait", "device", "scatter")
+
+
+async def _spans_shm_one(armed: bool, duration: float = 6.0,
+                         n_subs: int = 8, n_pubs: int = 2,
+                         payload: int = 128) -> dict:
+    """One arm of the shm-lane attribution A/B: boot the REAL hub +
+    2-wire-worker shm topology (`worker_raw` derivations inherit the
+    `observe` section, so both workers arm at sample=1 or disarm at
+    0), drive a closed-loop publish pump over the per-worker direct
+    ports, then scrape the supervisor's fleet export — the leg
+    histograms arrive over the same wire_stats RPC production uses, so
+    the bench measures the fleet aggregation path, not an in-process
+    shortcut."""
+    import tempfile
+
+    from emqx_tpu.broker.client import MqttClient
+    from emqx_tpu.node import NodeRuntime
+
+    d = tempfile.mkdtemp(prefix="shmspan")
+    raw = {
+        "node": {"name": "bench-hub", "data_dir": d,
+                 "xla_cache_dir": os.path.join(
+                     tempfile.gettempdir(), "etpu-bench-xla-cache")},
+        "listeners": [{"type": "tcp", "port": 0}],
+        "dashboard": {"listen_port": 0},
+        "wire": {"workers": 2, "stats_interval": 0.5},
+        "shm": {"enable": True},
+        "observe": {"span_sample": 1 if armed else 0},
+    }
+    rt = NodeRuntime(raw)
+    await rt.start()
+    try:
+        sup = rt.wire
+        deadline = time.time() + 120
+        while time.time() < deadline and not all(
+            rt.cluster.status().get(h.name) == "up"
+            for h in sup.workers.values()
+        ):
+            await asyncio.sleep(0.2)
+        ports = [h.direct_port for h in sup.workers.values()]
+
+        subs = []
+        counts = [0] * n_subs
+        for i in range(n_subs):
+            c = MqttClient(clientid=f"ss{i}")
+            await c.connect(port=ports[i % len(ports)])
+            await c.subscribe("shmspan/bench", qos=0)
+            subs.append(c)
+        pubs = []
+        for i in range(n_pubs):
+            c = MqttClient(clientid=f"sp{i}")
+            await c.connect(port=ports[i % len(ports)])
+            pubs.append(c)
+        await asyncio.sleep(1.0)  # route fan-out settles
+
+        stop = asyncio.Event()
+        body = b"x" * payload
+        published = [0]
+
+        async def drain(k: int) -> None:
+            while not stop.is_set():
+                try:
+                    await subs[k].recv(timeout=0.2)
+                except asyncio.TimeoutError:
+                    continue
+                counts[k] += 1
+
+        # same closed-loop credit pump as _wire_run_one: offered load
+        # self-clocks to what the topology delivers, so the armed and
+        # disarmed arms see the same queueing regime
+        credit = 32 * n_subs
+
+        async def pump(c) -> None:
+            while not stop.is_set():
+                if published[0] * n_subs - sum(counts) > credit:
+                    await asyncio.sleep(0.002)
+                    continue
+                await c.publish("shmspan/bench", body, qos=0)
+                published[0] += 1
+                await asyncio.sleep(0)
+
+        tasks = [asyncio.ensure_future(drain(k)) for k in range(n_subs)]
+        tasks += [asyncio.ensure_future(pump(c)) for c in pubs]
+        t0 = time.time()
+        await asyncio.sleep(duration)
+        stop.set()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        wall = time.time() - t0
+        rate = sum(counts) / wall
+        # let two more stats scrapes land so the final cumulative
+        # histograms (incl. the last ticks' legs) reach the supervisor
+        await asyncio.sleep(1.2)
+        fleet = sup.fleet_export()
+        for c in subs + pubs:
+            try:
+                await c.disconnect()
+            except Exception:
+                pass
+        return {
+            "armed": bool(armed),
+            "rps": rate,
+            "published": published[0],
+            "fleet": fleet,
+        }
+    finally:
+        await rt.stop()
+
+
+def run_spans_shm(duration: float = 6.0) -> dict:
+    """`--spans-shm` (`make fleet-bench`): shm-lane span attribution
+    over the real hub + 2-worker topology.  Two subprocess arms (one
+    fresh interpreter each, same hygiene as --wire): armed at
+    sample=1 decomposes every ring round-trip into the
+    ring_wait/fuse_wait/device/scatter legs; disarmed is the A/B
+    reference for the <=2% overhead gate.  Reconciliation gate: the
+    per-leg mean sum must land within SHMSPAN_RECON_GATE_PCT of the
+    measured end-to-end round-trip mean (`hist_ring`)."""
+    import subprocess
+    import tempfile
+
+    from emqx_tpu.observe.flight import LatencyHistogram
+
+    runs = {}
+    for armed in (1, 0):
+        tag = "armed" if armed else "disarmed"
+        log(f"shm-span bench: hub + 2 workers, spans {tag}")
+        with tempfile.NamedTemporaryFile(suffix=".json",
+                                         delete=False) as tf:
+            stats_path = tf.name
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--spans-shm-one", str(armed),
+             "--emit-stats", stats_path],
+            stdout=subprocess.PIPE, timeout=1800,
+        )
+        if r.returncode != 0:
+            os.unlink(stats_path)
+            raise SystemExit(
+                f"shm-span arm '{tag}' failed (rc={r.returncode})"
+            )
+        with open(stats_path, "r", encoding="utf-8") as f:
+            runs[tag] = json.load(f)
+        os.unlink(stats_path)
+        log(f"  -> {runs[tag]['rps']:,.0f} deliveries/s")
+
+    fleet = runs["armed"]["fleet"]
+    fh = fleet.get("fleet_hists") or {}
+
+    def _row(d) -> dict:
+        if not d or not d.get("count"):
+            return {"count": 0}
+        h = LatencyHistogram.from_dict(d)
+        p = h.percentiles_ms()
+        return {
+            "count": h.count,
+            "p50_ms": round(p["p50"], 4),
+            "p99_ms": round(p["p99"], 4),
+            "mean_ms": round(h.sum / h.count * 1e3, 4),
+        }
+
+    legs = {
+        leg: _row(fh.get(f"fleet_span_stage_{leg}_latency"))
+        for leg in SHM_LEGS
+    }
+    ring = _row(fh.get("fleet_shm_ring_roundtrip"))
+    leg_mean_sum = sum(
+        r.get("mean_ms", 0.0) for r in legs.values()
+    )
+    leg_p50_sum = sum(r.get("p50_ms", 0.0) for r in legs.values())
+    leg_p99_sum = sum(r.get("p99_ms", 0.0) for r in legs.values())
+    recon_pct = (
+        abs(leg_mean_sum - ring["mean_ms"]) / ring["mean_ms"] * 100.0
+        if ring.get("mean_ms") else None
+    )
+    # per-worker round-trip rows: the balance check (both workers must
+    # actually have exercised the shm hop, not just one)
+    per_worker = {
+        w.get("name", idx): _row(
+            (w.get("hists") or {}).get("shm_ring_roundtrip")
+        )
+        for idx, w in (fleet.get("workers") or {}).items()
+    }
+    dis_rps = runs["disarmed"]["rps"]
+    armed_rps = runs["armed"]["rps"]
+    overhead_pct = (
+        (dis_rps - armed_rps) / dis_rps * 100.0 if dis_rps else 0.0
+    )
+    hub = fleet.get("hub") or {}
+    hub_stats = hub.get("stats") or {}
+    return {
+        "legs": legs,
+        "ring": ring,
+        "leg_mean_sum_ms": round(leg_mean_sum, 4),
+        "leg_p50_sum_ms": round(leg_p50_sum, 4),
+        "leg_p99_sum_ms": round(leg_p99_sum, 4),
+        "recon_pct": None if recon_pct is None else round(recon_pct, 2),
+        "recon_gate_pct": SHMSPAN_RECON_GATE_PCT,
+        "per_worker_ring": per_worker,
+        "rps_armed": round(armed_rps, 1),
+        "rps_disarmed": round(dis_rps, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_gate_pct": SPAN_OVERHEAD_GATE_PCT,
+        "drain_cycle_ms": hub_stats.get("drain_cycle_ms"),
+        "group_sizes": hub_stats.get("group_sizes"),
+        "fleet": fleet,
+    }
+
+
+def _spans_shm_section_lines(s: dict) -> list:
+    lines = [
+        "",
+        SHMSPAN_HEADER,
+        "",
+        "Shm-hop decomposition of the worker's `collect` stage "
+        "(`python bench.py --spans-shm`, `make fleet-bench`): a real "
+        "hub + 2-wire-worker shm topology under the closed-loop "
+        "publish pump, spans armed at sample=1.  Worker submits stamp "
+        "a monotonic-ns timestamp into the slot header's spare bytes; "
+        "the hub stamps drain/fuse/device-done and ships them back in "
+        "the result record, and the worker decomposes each ring round "
+        "trip into `ring_wait` (slot committed -> hub drain), "
+        "`fuse_wait` (drain -> fused foreign_submit), `device` "
+        "(submit -> collect done) and `scatter` (result committed -> "
+        "worker decode).  Histograms cross the wire_stats RPC and are "
+        "fleet-merged by the supervisor — this table IS the "
+        "production aggregation path (`tools/fleet_dump.py` renders "
+        "the same export).",
+        "",
+        "| leg | samples | p50 ms | p99 ms | mean ms |",
+        "|---|---|---|---|---|",
+    ]
+    for leg in SHM_LEGS:
+        r = s["legs"].get(leg) or {}
+        if r.get("count"):
+            lines.append(
+                f"| {leg} | {r['count']:,} | {r['p50_ms']:.3f} "
+                f"| {r['p99_ms']:.3f} | {r['mean_ms']:.3f} |"
+            )
+        else:
+            lines.append(f"| {leg} | 0 | - | - | - |")
+    ring = s.get("ring") or {}
+    if ring.get("count"):
+        lines.append(
+            f"| ring round-trip (measured) | {ring['count']:,} "
+            f"| {ring['p50_ms']:.3f} | {ring['p99_ms']:.3f} "
+            f"| {ring['mean_ms']:.3f} |"
+        )
+    per_w = ", ".join(
+        f"{name}: {r['mean_ms']:.3f} ms mean over {r['count']:,}"
+        for name, r in sorted(s.get("per_worker_ring", {}).items())
+        if r.get("count")
+    )
+    if s.get("recon_pct") is None:
+        lines += ["", "No armed leg data captured (run too short?).", ""]
+        return lines
+    tail = (
+        f"Reconciliation: per-leg mean sum {s['leg_mean_sum_ms']:.3f} "
+        f"ms vs measured round-trip mean "
+        f"{ring.get('mean_ms', 0.0):.3f} ms = "
+        f"{s['recon_pct']:.2f}% deviation (gate <= "
+        f"{s['recon_gate_pct']:.0f}%; the same ticks feed both sides, "
+        f"so this checks the stamp plumbing end to end).  Armed vs "
+        f"disarmed delivery rate: {s['rps_armed']:,.0f} vs "
+        f"{s['rps_disarmed']:,.0f} deliveries/s = "
+        f"{s['overhead_pct']:+.2f}% span overhead at sample=1 (gate "
+        f"<= {s['overhead_gate_pct']:.0f}%; container-noise dominated)."
+    )
+    if per_w:
+        tail += f"  Per-worker round-trip: {per_w}."
+    dc = s.get("drain_cycle_ms")
+    if dc:
+        tail += (
+            f"  Hub drain cycle p50/p99: {dc.get('p50', 0.0):.3f}/"
+            f"{dc.get('p99', 0.0):.3f} ms."
+        )
+    gs = s.get("group_sizes")
+    if gs:
+        dist = ", ".join(
+            f"{k}: {v}" for k, v in sorted(
+                gs.items(), key=lambda kv: int(kv[0])
+            )
+        )
+        tail += f"  Fusion group sizes (size: dispatches): {dist}."
+    lines += ["", tail, ""]
+    return lines
+
+
+def _update_spans_shm_table(s: dict) -> None:
+    """Replace the shm-lane attribution section of BENCH_TABLE.md in
+    place (same ownership contract as the other sections)."""
+    path = "BENCH_TABLE.md"
+    lines = []
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    out, skipping = [], False
+    for line in lines:
+        if line.strip() == SHMSPAN_HEADER:
+            skipping = True
+            continue
+        if skipping and line.startswith("## "):
+            skipping = False
+        if not skipping:
+            out.append(line)
+    while out and not out[-1].strip():
+        out.pop()
+    out += _spans_shm_section_lines(s)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(out))
+    log("updated BENCH_TABLE.md shm-lane attribution section")
+
+
 CONFIGS = {
     1: ("exact_1k", "1k exact subs, single-level topics"),
     2: ("wild_100k", "100k subs, 6-level, 20% '+' wildcards"),
@@ -3252,6 +3571,19 @@ def main() -> None:
                          "overhead A/B on the fan-out wire path "
                          "(BENCH_NO_SPANS=1 = disarmed leg only); "
                          "writes the BENCH_TABLE.md section")
+    ap.add_argument("--spans-shm", action="store_true",
+                    help="shm-lane span attribution over the real hub "
+                         "+ 2-wire-worker shm topology: per-leg "
+                         "ring_wait/fuse_wait/device/scatter p50/p99, "
+                         "mean-sum reconciliation vs the measured ring "
+                         "round-trip, armed-vs-disarmed overhead A/B "
+                         "(`make fleet-bench`); writes the "
+                         "BENCH_TABLE.md section")
+    ap.add_argument("--spans-shm-one", default=None, type=int,
+                    choices=(0, 1),
+                    help="single shm-span topology run, spans armed "
+                         "(1) or disarmed (0) — the --spans-shm "
+                         "sweep's inner subprocess")
     ap.add_argument("--prep-only", action="store_true",
                     help="fused-native vs python-fallback prep "
                          "microbench at B=512/2048 over the sharded "
@@ -3378,6 +3710,36 @@ def main() -> None:
                 {k: v for k, v in r.items() if k != "conns"}
                 for r in rows
             ],
+        }))
+        return
+    if ns.spans_shm_one is not None:
+        stats = asyncio.run(_spans_shm_one(bool(ns.spans_shm_one)))
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
+        print(json.dumps({k: v for k, v in stats.items()
+                          if k != "fleet"}))
+        return
+    if ns.spans_shm:
+        stats = run_spans_shm()
+        _update_spans_shm_table(stats)
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
+        print(json.dumps({
+            "metric": "shm_leg_recon_deviation_pct",
+            "value": stats.get("recon_pct"),
+            "unit": "pct_vs_measured_roundtrip",
+            "gate_pct": stats["recon_gate_pct"],
+            "overhead_pct": stats["overhead_pct"],
+            "overhead_gate_pct": stats["overhead_gate_pct"],
+            "rps_armed": stats["rps_armed"],
+            "rps_disarmed": stats["rps_disarmed"],
+            "legs": stats["legs"],
+            "ring": stats["ring"],
+            "leg_mean_sum_ms": stats["leg_mean_sum_ms"],
+            "drain_cycle_ms": stats.get("drain_cycle_ms"),
+            "group_sizes": stats.get("group_sizes"),
         }))
         return
     if ns.spans:

@@ -67,6 +67,20 @@ def poll_health_alarms(engine, cluster, alarms: AlarmManager,
         )
     elif alarms.is_active("engine_device_degraded"):
         alarms.deactivate("engine_device_degraded")
+    # shm plane (wire workers): the client's silent fallback to local
+    # matching on a stale hub heartbeat becomes an operator-visible
+    # alarm; clears itself once the heartbeat freshens
+    if getattr(engine, "hub_down", False):
+        alarms.activate(
+            "shm_hub_degraded",
+            details={
+                "degraded_ticks": getattr(engine, "shm_degraded", 0),
+                "local_serves": getattr(engine, "shm_local", 0),
+            },
+            message="shm hub heartbeat stale: matching locally",
+        )
+    elif alarms.is_active("shm_hub_degraded"):
+        alarms.deactivate("shm_hub_degraded")
     if ckpt is not None:
         # checkpoint write()/restore() run on worker threads and only
         # RECORD alarm transitions; the publish happens here, on-loop
@@ -665,6 +679,17 @@ class NodeRuntime:
         for stage, h in _spans.stage_histograms().items():
             out[f"span_stage_{stage}_latency"] = h
         out.update(self.contention.histograms())
+        # shm plane: worker side exports its stamped ring round-trip;
+        # the hub side its drain-cycle gap + the fleet-merged worker
+        # histograms scraped over wire_stats (fleet_* series)
+        h = getattr(e, "hist_ring", None)
+        if h is not None and h.count:
+            out["shm_ring_roundtrip"] = h
+        if self.wire is not None:
+            if self.wire.service is not None \
+                    and self.wire.service.hist_drain.count:
+                out["shm_drain_cycle"] = self.wire.service.hist_drain
+            out.update(self.wire.fleet_histograms())
         return out
 
     def _build_limiter(self) -> Optional[Limiter]:

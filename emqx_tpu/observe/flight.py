@@ -161,6 +161,29 @@ class LatencyHistogram:
         h.count = self.count
         return h
 
+    def to_dict(self) -> Dict:
+        """JSON-safe wire form: what crosses the wire_stats RPC from a
+        wire worker to the supervisor (and lands in bench emit-stats
+        JSONs).  `from_dict` round-trips it; `merge` then aggregates
+        per-process histograms exactly, bucket by bucket."""
+        return {
+            "base": self.base,
+            "counts": self.counts.tolist(),
+            "sum": self.sum,
+            "count": self.count,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "LatencyHistogram":
+        counts = d.get("counts") or []
+        h = cls(base=float(d.get("base", 1e-6)),
+                n_buckets=len(counts) or 40)
+        if counts:
+            h.counts = np.asarray(counts, dtype=np.int64)
+        h.sum = float(d.get("sum", 0.0))
+        h.count = int(d.get("count", 0))
+        return h
+
 
 # ---------------------------------------------------------- flight recorder
 

@@ -37,6 +37,26 @@ both ways, like tracepoint kinds and fault sites):
              that is both delivered live and parked attributes its
              tail to whichever leg lands first)
 
+Shm-lane legs (hub+workers topology, `emqx_tpu/shm/`): a wire worker's
+`collect` stage lumps the whole shared-memory ring round-trip into one
+number, so the slab protocol carries monotonic-ns stamps in the spare
+slot-header bytes (CLOCK_MONOTONIC is system-wide on Linux — hub and
+worker clocks compare directly) and the worker decomposes each
+hub-served tick into per-tick stage observations:
+
+    ring_wait  worker committed the submit slot -> hub's drain pass
+               picked the record off the ring (drain-loop queueing tax)
+    fuse_wait  drain pick-up -> the tick entered a fused foreign_submit
+               group (cross-lane geometry-coalescing wait)
+    device     foreign_submit -> the hub's device collect finished
+    scatter    hub committed the result slot -> the worker's drain
+               decoded it (result-ring return tax)
+
+These are per-TICK observations (the shm client batches topics per
+tick and never sees individual message contexts), recorded straight
+into the stage histograms via `observe_stage` — they decompose the
+worker's `collect` stage rather than ride a SpanContext.
+
 Sampling is head-based: ONE decision per message at ingress
 (``observe.span_sample`` = N means 1/N publishes carry a span; 0
 disarms).  Disarmed, every boundary is one module-bool test away from
@@ -78,6 +98,13 @@ KNOWN_STAGES: Dict[str, str] = {
     "forward": "origin ingress -> remote broker dispatched the "
                "forwarded copy (cross-node leg)",
     "ds": "dispatch -> durable-log append (parked-session leg)",
+    # shared-memory match plane legs (shm/client.py decomposes the ring
+    # round-trip from the slot-header timestamp lane; per-tick, not
+    # per-message — see module docstring)
+    "ring_wait": "submit slot committed -> hub drain picked it up",
+    "fuse_wait": "hub drain pick-up -> fused foreign_submit group",
+    "device": "foreign_submit -> hub device collect finished",
+    "scatter": "result slot committed -> worker drain decoded it",
 }
 
 _RECENT = 256  # completed-span ring (newest-first render)

@@ -129,6 +129,9 @@ KNOWN_KINDS: Dict[str, str] = {
                  "engine (registry-of-record write)",
     "shm.group": "hub fused match ticks from multiple worker lanes "
                  "into one device dispatch",
+    "shm.hub_stale": "hub heartbeat went stale: the worker fell back "
+                     "to all-local matching (shm_hub_degraded alarm "
+                     "raises off the same observation)",
 }
 
 

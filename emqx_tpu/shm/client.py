@@ -44,6 +44,7 @@ import numpy as np
 from ..broker import topic as topiclib
 from ..fault import plane as _fault
 from ..models.reference import CpuTrieIndex
+from ..observe import spans as _spans
 from ..observe.flight import PATH_DEVICE, PATH_HOST, LatencyHistogram
 from ..observe.tracepoints import tp
 from ..ops.prep import TopicPrep
@@ -61,7 +62,7 @@ class _ShmPending:
     already decided local (`mode == "local"`)."""
 
     __slots__ = ("mode", "tick", "topics", "t0", "deadline", "extra",
-                 "pipe_occ", "pipe_depth")
+                 "pipe_occ", "pipe_depth", "t_submit")
 
     def __init__(self, mode, tick, topics, t0, deadline, extra):
         self.mode = mode  # "shm" | "local"
@@ -72,6 +73,10 @@ class _ShmPending:
         self.extra = extra  # local fids to union from the trie
         self.pipe_occ = 0
         self.pipe_depth = 0
+        # monotonic-ns submit stamp shipped in the slot header when the
+        # span plane is armed (0 disarmed): the reply's hub stamps
+        # decompose against this (observe/spans.py shm legs)
+        self.t_submit = 0
 
 
 class ShmMatchEngine:
@@ -93,6 +98,10 @@ class ShmMatchEngine:
         self.timeout = float(timeout)
         self._prep = TopicPrep(space, min_batch=min_batch,
                                use_native=use_native)
+        # end-to-end stamped ring round-trip (submit commit -> result
+        # decode): the reconciliation target the four span legs must
+        # sum to (bench.py shm-lane attribution gate)
+        self.hist_ring = LatencyHistogram()
         # the supervisor creates the slab before spawning us, but a
         # respawn can race a hub restart: retry the attach briefly
         deadline = time.monotonic() + attach_retry_s
@@ -125,7 +134,11 @@ class ShmMatchEngine:
         self._churn_seq = 0
         self._tick_seq = 0
         self._inflight_n = 0
-        self._results: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        # tick -> (counts, fids, hub reply ts, t_recv ns) — the last
+        # two are zeros when the tick's submit was unstamped
+        self._results: Dict[
+            int, Tuple[np.ndarray, np.ndarray, Tuple[int, int, int], int]
+        ] = {}
         self._res_lk = threading.Lock()  # result-ring drain (any thread)
         self._sub_lk = threading.Lock()  # submit-ring writes
         self._hub_gen = 0
@@ -185,7 +198,22 @@ class ShmMatchEngine:
             self._hub_down = down
             tp("shm.degrade", state="hub-down" if down else "hub-up",
                hb_age_s=round(age, 3))
+            if down:
+                # dedicated stale-transition tracepoint: the node's
+                # alarm poll (`shm_hub_degraded`) keys off `hub_down`,
+                # this marks the instant for trace correlation
+                tp("shm.hub_stale", hb_age_s=round(age, 3))
         return not down
+
+    @property
+    def hub_down(self) -> bool:
+        """Current hub-heartbeat verdict, re-evaluated on read (one
+        control-page load): an IDLE worker would otherwise latch the
+        last submit-time verdict and hold the `shm_hub_degraded`
+        alarm raised long after the hub recovered.  Reading through
+        `_hub_ok` also fires the up/down transition tracepoints at
+        the poll that observed the change."""
+        return not self._hub_ok()
 
     def _check_hub_gen(self) -> None:
         if int(self._slab.ctrl[C_HUB_GEN]) != self._hub_gen \
@@ -358,6 +386,7 @@ class ShmMatchEngine:
                 if (self._deep_loc or self._unacked) else None
         mode = "local"
         tick = 0
+        t_sub = 0
         a = _fault.inject("shm.submit", err=False) if _fault.enabled() \
             else None
         faulted = a is not None and a.kind in ("drop", "error", "corrupt")
@@ -377,10 +406,14 @@ class ShmMatchEngine:
                     if res.key is None:  # packed into the slot: submit
                         self._tick_seq += 1
                         tick = self._tick_seq
+                        # span legs: one armed-test per batch; the
+                        # stamp rides the slot header's timestamp lane
+                        t_sub = time.monotonic_ns() if _spans.armed \
+                            else 0
                         w.commit(K_MATCH, tick, a=res.n, b=res.B,
                                  c=res.L,
                                  nbytes=res.B * (2 * res.L + 2) * 4,
-                                 gen=self._gen)
+                                 gen=self._gen, t0=t_sub)
                         mode = "shm"
                         self.shm_submits += 1
                     else:  # batch too deep/wide for a slot
@@ -390,6 +423,7 @@ class ShmMatchEngine:
             self.shm_local += 1
         p = _ShmPending(mode, tick, topics, t0,
                         t0 + self.timeout, extra)
+        p.t_submit = t_sub
         self._inflight_n += 1
         p.pipe_occ = self._inflight_n
         p.pipe_depth = self.pipeline_depth
@@ -424,10 +458,29 @@ class ShmMatchEngine:
         if pending.mode == "shm":
             got = self._await_result(pending)
             if got is not None:
+                if pending.t_submit:
+                    self._observe_legs(pending.t_submit, got[2], got[3])
                 return self._serve_hub(pending, got), PATH_DEVICE
             self.shm_degraded += 1
             tp("shm.degrade", state="tick-timeout", tick=pending.tick)
         return self._serve_local(pending.topics), PATH_HOST
+
+    def _observe_legs(self, t_submit: int, ts: Tuple[int, int, int],
+                      t_recv: int) -> None:
+        """Decompose one stamped ring round-trip into the four shm span
+        legs (stage histograms, per tick).  Every boundary clamps at
+        zero: the stamps come from one system-wide CLOCK_MONOTONIC, but
+        a reply from a pre-stamp hub incarnation ships zeros and is
+        skipped wholesale."""
+        t_drain, t_fuse, t_done = ts
+        if not (t_drain and t_fuse and t_done and t_recv):
+            return
+        p = _spans.plane()
+        p.observe_stage("ring_wait", max(t_drain - t_submit, 0) / 1e9)
+        p.observe_stage("fuse_wait", max(t_fuse - t_drain, 0) / 1e9)
+        p.observe_stage("device", max(t_done - t_fuse, 0) / 1e9)
+        p.observe_stage("scatter", max(t_recv - t_done, 0) / 1e9)
+        self.hist_ring.observe(max(t_recv - t_submit, 0) / 1e9)
 
     def _await_result(self, pending: _ShmPending):
         """Drain the result ring until our tick lands or the deadline
@@ -476,7 +529,10 @@ class ShmMatchEngine:
                 fids = rec.payload[4 * n:4 * (n + total)].view(
                     np.int32
                 ).copy()
-                self._results[rec.tick] = (counts, fids)
+                # t_recv closes the scatter leg; zero when the hub's
+                # reply carries no stamps (submit was unstamped)
+                t_recv = time.monotonic_ns() if rec.ts[0] else 0
+                self._results[rec.tick] = (counts, fids, rec.ts, t_recv)
             elif rec.kind == K_CHURN_ACK:
                 acks.append((
                     rec.tick,
@@ -496,7 +552,7 @@ class ShmMatchEngine:
                     self._unacked.discard(loc)
 
     def _serve_hub(self, pending: _ShmPending, got) -> List[List[int]]:
-        counts, fids = got
+        counts, fids = got[0], got[1]
         topics = pending.topics
         out: List[List[int]] = []
         off = 0

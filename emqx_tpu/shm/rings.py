@@ -41,6 +41,17 @@ import numpy as np
 
 CTRL_BYTES = 4096
 SLOT_HDR = 64  # one cache line: u64 seq + u32 gen/kind/tick/a/b/c/nbytes
+#                + 3x u64 monotonic-ns span timestamps (offset 40)
+
+# Slot-header timestamp lane (observe/spans.py shm legs): the header's
+# spare bytes carry up to three CLOCK_MONOTONIC nanosecond stamps —
+# system-wide on Linux, so hub and worker clocks compare directly.
+# Submit records use ts[0] = worker submit time; result records carry
+# ts[0..2] = hub drain / fuse / device-done.  Zero = unstamped (the
+# span plane disarmed): commit always writes all three cells so a
+# recycled slot can never leak a stale stamp into a fresh record.
+SLOT_TS = 3
+_TS_OFF = 40  # after u64 seq (8) + 7x u32 (28) + 4 pad for u64 align
 
 # control-page u64 cell indices
 C_MAGIC = 0
@@ -72,9 +83,10 @@ class Rec:
     aliases the slab — copy anything that outlives the tail advance."""
 
     __slots__ = ("gen", "kind", "tick", "a", "b", "c", "nbytes",
-                 "payload")
+                 "payload", "ts")
 
-    def __init__(self, gen, kind, tick, a, b, c, nbytes, payload):
+    def __init__(self, gen, kind, tick, a, b, c, nbytes, payload,
+                 ts=(0, 0, 0)):
         self.gen = gen
         self.kind = kind
         self.tick = tick
@@ -83,6 +95,7 @@ class Rec:
         self.c = c
         self.nbytes = nbytes
         self.payload = payload
+        self.ts = ts  # (t0, t1, t2) monotonic ns; 0 = unstamped
 
 
 class Slot:
@@ -103,7 +116,8 @@ class Slot:
         return self._ring._pay[self._i][: count * 4].view(np.uint32)
 
     def commit(self, kind: int, tick: int, a: int = 0, b: int = 0,
-               c: int = 0, nbytes: int = 0, gen: int = 0) -> None:
+               c: int = 0, nbytes: int = 0, gen: int = 0,
+               t0: int = 0, t1: int = 0, t2: int = 0) -> None:
         r = self._ring
         h = r._hdr[self._i]
         h[0] = gen & 0xFFFFFFFF
@@ -113,6 +127,10 @@ class Slot:
         h[4] = b
         h[5] = c
         h[6] = nbytes
+        t = r._ts[self._i]
+        t[0] = t0
+        t[1] = t1
+        t[2] = t2
         r._seq[self._i][0] = 2 * self._head + 2  # publish
         r._ctrl[r._hi] = self._head + 1
 
@@ -131,11 +149,15 @@ class RingView:
         self._ti = tail_idx
         self._seq: List[np.ndarray] = []
         self._hdr: List[np.ndarray] = []
+        self._ts: List[np.ndarray] = []
         self._pay: List[np.ndarray] = []
         for i in range(slots):
             off = base + i * slot_bytes
             self._seq.append(np.frombuffer(buf, np.uint64, 1, off))
             self._hdr.append(np.frombuffer(buf, np.uint32, 7, off + 8))
+            self._ts.append(
+                np.frombuffer(buf, np.uint64, SLOT_TS, off + _TS_OFF)
+            )
             self._pay.append(
                 np.frombuffer(buf, np.uint8, self.payload_cap,
                               off + SLOT_HDR)
@@ -186,8 +208,10 @@ class RingView:
         if int(self._seq[i][0]) != 2 * pos + 2:
             return None  # mid-write or stale incarnation: not published
         h = self._hdr[i]
+        t = self._ts[i]
         return Rec(int(h[0]), int(h[1]), int(h[2]), int(h[3]), int(h[4]),
-                   int(h[5]), int(h[6]), self._pay[i])
+                   int(h[5]), int(h[6]), self._pay[i],
+                   (int(t[0]), int(t[1]), int(t[2])))
 
     def advance(self, k: int = 1) -> None:
         self._ctrl[self._ti] += k

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..observe.flight import LatencyHistogram
 from ..observe.tracepoints import tp
 from .registry import ShmRegistry
 from .rings import (
@@ -67,16 +68,22 @@ class LaneState:
 
 
 class _MatchReq:
-    __slots__ = ("lane", "tick", "n", "B", "L", "payload")
+    __slots__ = ("lane", "tick", "n", "B", "L", "payload", "t_drain",
+                 "t_fuse")
 
     def __init__(self, lane: LaneState, tick: int, n: int, B: int,
-                 L: int, payload: np.ndarray):
+                 L: int, payload: np.ndarray, t_drain: int = 0):
         self.lane = lane
         self.tick = tick
         self.n = n
         self.B = B
         self.L = L
         self.payload = payload  # [B, 2L+2] u32 COPY (slot already freed)
+        # span-leg stamps (monotonic ns; 0 = the submit was unstamped,
+        # i.e. the worker's span plane is disarmed — the reply then
+        # ships zero timestamps and the worker records nothing)
+        self.t_drain = t_drain
+        self.t_fuse = 0
 
 
 class MatchService:
@@ -104,6 +111,12 @@ class MatchService:
         self.reclaims = 0
         self.res_drops = 0
         self.errors = 0
+        # drain/fusion telemetry (fleet observability plane): the
+        # adaptive-fusion controller (ROADMAP item 1) consumes exactly
+        # these — how often the drain loop actually turns, and how much
+        # cross-lane coalescing each pass achieved
+        self.hist_drain = LatencyHistogram()  # drain-cycle gap (s)
+        self.group_sizes: Dict[int, int] = {}  # fused group size -> count
 
     # ------------------------------------------------------------- lanes
 
@@ -216,6 +229,9 @@ class MatchService:
         the tails so the slots recycle immediately."""
         reqs: List[_MatchReq] = []
         consumed = 0
+        # span-leg drain stamp: one clock read per pass, and only when
+        # some record actually carries a submit stamp (armed workers)
+        now_ns = 0
         for lane in self.lanes.values():
             self._check_worker_gen(lane)
             if lane.pending_acks:  # ring-full leftovers from last pass
@@ -236,8 +252,13 @@ class MatchService:
                 elif rec.kind == K_MATCH:
                     pay = rec.payload[: rec.nbytes].view(np.uint32)
                     buf = pay.reshape(rec.b, 2 * rec.c + 2).copy()
+                    t_drain = 0
+                    if rec.ts[0]:
+                        if not now_ns:
+                            now_ns = time.monotonic_ns()
+                        t_drain = now_ns
                     reqs.append(_MatchReq(lane, rec.tick, rec.a,
-                                          rec.b, rec.c, buf))
+                                          rec.b, rec.c, buf, t_drain))
                 k += 1
             if k:
                 ring.advance(k)
@@ -261,6 +282,11 @@ class MatchService:
                         break
                 chunk = members[i:i + k]
                 i += k
+                if any(r.t_drain for r in chunk):
+                    t_fuse = time.monotonic_ns()
+                    for r in chunk:
+                        if r.t_drain:
+                            r.t_fuse = t_fuse
                 try:
                     handle = self.engine.foreign_submit(
                         [(r.payload, r.n) for r in chunk]
@@ -270,6 +296,7 @@ class MatchService:
                     continue
                 self.match_ticks += len(chunk)
                 self.match_groups += 1
+                self.group_sizes[k] = self.group_sizes.get(k, 0) + 1
                 if k > 1:
                     tp("shm.group", k=k,
                        lanes=sorted({r.lane.idx for r in chunk}))
@@ -287,6 +314,8 @@ class MatchService:
         except Exception:  # pragma: no cover - device fault
             self.errors += 1
             return
+        t_done = time.monotonic_ns() \
+            if any(r.t_drain for r in chunk) else 0
         for req, (counts, fids) in zip(chunk, results):
             lane = req.lane
             async with lane.res_lk:
@@ -303,13 +332,25 @@ class MatchService:
                     pay[4 * req.n:] = np.ascontiguousarray(
                         fids, np.int32
                     ).view(np.uint8)
-                w.commit(K_MATCH_RES, req.tick, a=req.n, nbytes=need)
+                # reply stamps ride the result slot's timestamp lane
+                # (zeros for an unstamped submit: the worker records
+                # legs only when it stamped the submit itself)
+                w.commit(K_MATCH_RES, req.tick, a=req.n, nbytes=need,
+                         t0=req.t_drain, t1=req.t_fuse,
+                         t2=t_done if req.t_drain else 0)
 
     # -------------------------------------------------------------- loop
 
     async def _run(self) -> None:
+        last_ns = 0
         while not self._stop:
             now = time.monotonic_ns()
+            # drain-cycle gap: the cadence the submit rings are
+            # actually polled at (back-to-back under load, ~poll_
+            # interval idle) — the upper bound any ring_wait leg pays
+            if last_ns:
+                self.hist_drain.observe((now - last_ns) / 1e9)
+            last_ns = now
             for lane in self.lanes.values():
                 lane.slab.ctrl[C_HUB_HB] = now
             try:
@@ -354,8 +395,22 @@ class MatchService:
         self.lanes.clear()
         self.reg.close_all(unlink=unlink)
 
-    def stats(self) -> Dict[str, int]:
-        return {
+    def lane_stats(self) -> Dict[int, Dict[str, int]]:
+        """Per-lane ring health: occupancy of both rings, queued acks,
+        and the lane's live filter refcount — the `shm.lane.<i>.*`
+        gauges the supervisor exports (and fleet_dump renders)."""
+        out: Dict[int, Dict[str, int]] = {}
+        for idx, lane in self.lanes.items():
+            out[idx] = {
+                "submit_depth": lane.slab.submit.depth,
+                "result_depth": lane.slab.result.depth,
+                "pending_acks": len(lane.pending_acks),
+                "filters": sum(lane.filters.values()),
+            }
+        return out
+
+    def stats(self) -> Dict[str, object]:
+        out = {
             "lanes": len(self.lanes),
             "ticks": self.match_ticks,
             "groups": self.match_groups,
@@ -364,4 +419,8 @@ class MatchService:
             "reclaims": self.reclaims,
             "res_drops": self.res_drops,
             "errors": self.errors,
+            "group_sizes": dict(self.group_sizes),
         }
+        if self.hist_drain.count:
+            out["drain_cycle_ms"] = self.hist_drain.percentiles_ms()
+        return out

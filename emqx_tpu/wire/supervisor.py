@@ -35,6 +35,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ..observe.flight import LatencyHistogram
 from ..observe.tracepoints import tp
 
 log = logging.getLogger("emqx_tpu.wire")
@@ -83,6 +84,11 @@ class WorkerHandle:
     last_stats: Dict[str, Any] = field(default_factory=dict)
     last_accepts: float = 0.0
     last_poll: float = 0.0
+    # fleet observability: the worker's mergeable histograms (latest
+    # scrape, deserialized) + its slowest-span sample — the inputs the
+    # supervisor merges into the fleet view (fleet_histograms below)
+    last_hists: Dict[str, LatencyHistogram] = field(default_factory=dict)
+    last_spans: List[Dict[str, Any]] = field(default_factory=list)
 
 
 class WireSupervisor:
@@ -452,6 +458,30 @@ class WireSupervisor:
                 now = time.monotonic()
                 if stats:
                     h.last_stats = stats
+                    # mergeable per-process histograms (wire_stats
+                    # "hists" wire form): deserialize once per scrape;
+                    # the fleet view merges the LATEST snapshot per
+                    # worker (each is cumulative since worker boot, so
+                    # re-merging every scrape would double-count)
+                    try:
+                        h.last_hists = {
+                            name: LatencyHistogram.from_dict(d)
+                            for name, d in
+                            (stats.get("hists") or {}).items()
+                        }
+                    except (TypeError, ValueError):
+                        h.last_hists = {}
+                    h.last_spans = list(
+                        stats.get("spans_slowest") or []
+                    )
+                    lh = h.last_hists.get("loop_lag")
+                    if lh is not None and lh.count:
+                        m.gauge_set(g + "loop_lag_p99_ms",
+                                    lh.quantile(0.99) * 1e3)
+                    th = h.last_hists.get("engine_tick_latency")
+                    if th is not None and th.count:
+                        m.gauge_set(g + "tick_p99_ms",
+                                    th.quantile(0.99) * 1e3)
                     conns = float(stats.get("connections", 0))
                     total_conns += conns
                     m.gauge_set(g + "connections", conns)
@@ -496,6 +526,24 @@ class WireSupervisor:
                 c["shm.hub.reclaims"] = st["reclaims"]
                 c["shm.hub.res_drops"] = st["res_drops"]
                 m.gauge_set("shm.lanes", float(st["lanes"]))
+                # drain/fusion telemetry: cycle-gap p99 + mean fused
+                # group size (what the adaptive-fusion controller and
+                # the soak gates watch), plus per-lane ring health
+                hd = self.service.hist_drain
+                if hd.count:
+                    m.gauge_set("shm.hub.drain_cycle_p99_ms",
+                                hd.quantile(0.99) * 1e3)
+                gs = st.get("group_sizes") or {}
+                groups = sum(gs.values())
+                if groups:
+                    m.gauge_set(
+                        "shm.hub.group_size_mean",
+                        sum(k * v for k, v in gs.items()) / groups,
+                    )
+                for idx, ls in self.service.lane_stats().items():
+                    for key, val in ls.items():
+                        m.gauge_set(f"shm.lane.{idx}.{key}",
+                                    float(val))
 
     def _drop_worker_gauges(self, idx: int) -> None:
         """Zero-and-drop a dead worker's per-index gauges: after a
@@ -504,8 +552,14 @@ class WireSupervisor:
         m = self.runtime.broker.metrics
         g = f"wire.worker.{idx}."
         for k in ("connections", "accept_rate", "shed", "rate_limited",
-                  "forward_depth"):
+                  "forward_depth", "loop_lag_p99_ms", "tick_p99_ms"):
             m.gauges.pop(g + k, None)
+        h = self.workers.get(idx)
+        if h is not None:
+            # a dead worker's histograms must leave the fleet merge
+            # too, or the merged view keeps reporting its last scrape
+            h.last_hists = {}
+            h.last_spans = []
 
     async def _housekeeping(self) -> None:
         """The slice of listener housekeeping the parent still needs
@@ -525,6 +579,58 @@ class WireSupervisor:
                     self.runtime.broker.retainer.clean_expired()
             except Exception:
                 log.exception("wire supervisor housekeeping")
+
+    # -------------------------------------------------- fleet observability
+
+    def fleet_histograms(self) -> Dict[str, LatencyHistogram]:
+        """Fleet-merged histograms: each worker's latest cumulative
+        snapshot added bucket-by-bucket, keyed `fleet_<name>` so the
+        hub's own `span_stage_*`/`loop_lag` series stay distinct in the
+        same Prometheus exposition (per-worker p99s ride the
+        `wire.worker.<i>.*` gauges; this is the merged view)."""
+        merged: Dict[str, LatencyHistogram] = {}
+        for h in self.workers.values():
+            for name, hist in h.last_hists.items():
+                cur = merged.get(name)
+                if cur is None:
+                    merged[name] = hist.snapshot()
+                else:
+                    try:
+                        cur.merge(hist)
+                    except ValueError:  # pragma: no cover - layout drift
+                        pass
+        return {f"fleet_{name}": hh for name, hh in merged.items()}
+
+    def fleet_export(self) -> Dict[str, Any]:
+        """JSON-safe fleet dump (tools/fleet_dump.py input): per-worker
+        stats + histograms + slowest spans, the merged fleet
+        histograms, and the hub's drain/fusion + per-lane ring health."""
+        workers: Dict[str, Any] = {}
+        for h in self.workers.values():
+            workers[str(h.idx)] = {
+                "name": h.name,
+                "stats": {
+                    k: v for k, v in (h.last_stats or {}).items()
+                    if k not in ("hists", "spans_slowest", "peers")
+                },
+                "hists": {n: hh.to_dict()
+                          for n, hh in h.last_hists.items()},
+                "spans_slowest": list(h.last_spans),
+            }
+        out: Dict[str, Any] = {
+            "schema": "emqx-tpu/fleet-dump/v1",
+            "node": self.node_name,
+            "workers": workers,
+            "fleet_hists": {n: hh.to_dict()
+                            for n, hh in self.fleet_histograms().items()},
+        }
+        if self.service is not None:
+            out["hub"] = {
+                "stats": self.service.stats(),
+                "lanes": {str(i): d for i, d in
+                          self.service.lane_stats().items()},
+            }
+        return out
 
     # ------------------------------------------------------------ status
 

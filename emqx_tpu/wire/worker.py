@@ -24,13 +24,24 @@ import os
 import sys
 
 
+# slowest-span sample shipped per scrape: enough for a fleet waterfall
+# view without growing the RPC frame past a few KB
+SLOW_SPANS_K = 8
+
+
 def wire_stats(runtime):
     """The supervisor-facing stats snapshot (everything here is plain
-    numbers — the ONLY state that ever leaves this process)."""
+    numbers / JSON-safe dicts — the ONLY state that ever leaves this
+    process).  Besides the gauges, each scrape ships the worker's
+    mergeable log2 histograms (`LatencyHistogram.to_dict` wire form:
+    span stages incl. the shm ring legs, loop-lag, GC pauses, engine
+    tick) plus a bounded slowest-K span sample — the supervisor merges
+    them into the fleet-level view (`WireSupervisor.fleet_histograms`)
+    and Prometheus/$SYS//monitor export per-worker AND merged."""
     b = runtime.broker
     m = b.metrics
     cluster = runtime.cluster
-    return {
+    out = {
         "connections": len(b.cm.channels),
         "sessions": len(b.cm.channels) + len(b.cm.pending),
         "subscriptions": b.subscription_count,
@@ -48,7 +59,27 @@ def wire_stats(runtime):
         "shm_degraded": getattr(b.engine, "shm_degraded", 0),
         "shm_local": getattr(b.engine, "shm_local", 0),
         "shm_oversize": getattr(b.engine, "shm_oversize", 0),
+        "shm_reregisters": getattr(b.engine, "shm_reregisters", 0),
+        "shm_hub_down": bool(getattr(b.engine, "hub_down", False)),
     }
+    from ..observe import spans as _spans
+
+    hists = {}
+    for stage, h in _spans.stage_histograms().items():
+        if h.count:
+            hists[f"span_stage_{stage}_latency"] = h.to_dict()
+    for name, h in runtime.contention.histograms().items():
+        if h.count:
+            hists[name] = h.to_dict()
+    for name, attr in (("engine_tick_latency", "hist_tick"),
+                       ("shm_ring_roundtrip", "hist_ring")):
+        h = getattr(b.engine, attr, None)
+        if h is not None and h.count:
+            hists[name] = h.to_dict()
+    out["hists"] = hists
+    if _spans.enabled():
+        out["spans_slowest"] = _spans.plane().slowest()[:SLOW_SPANS_K]
+    return out
 
 
 def main(argv=None) -> int:

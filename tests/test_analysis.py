@@ -893,6 +893,31 @@ def test_span_stage_registry_both_directions(tmp_path):
     assert len(findings) == 2  # 'hooks' is clean both ways
 
 
+def test_span_stage_observe_stage_receiver_agnostic(tmp_path):
+    """The shm-leg stages (ring_wait/fuse_wait/device/scatter) are
+    recorded via `p.observe_stage("<leg>", dt)` on a plane handle, not
+    `spans.mark` — the lint must credit any observe_stage literal
+    regardless of receiver, both directions, or the legs would
+    false-positive as span-dead."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/observe/spans.py": (
+            "KNOWN_STAGES = {'ring_wait': 'd', 'fuse_wait': 'd',"
+            " 'device': 'd', 'scatter': 'd'}\n"
+            "def mark(ctx, stage):\n"
+            "    pass\n"
+        ),
+        "emqx_tpu/leg_fixture.py": (
+            "from .observe import spans\n"
+            "def f(p, dt):\n"
+            "    p.observe_stage('ring_wait', dt)\n"
+            "    p.observe_stage('fuse_wait', dt)\n"
+            "    p.observe_stage('device', dt)\n"
+            "    p.observe_stage('scatter', dt)\n"
+        ),
+    })
+    assert registry.check_span_stages(idx) == []
+
+
 def test_span_stage_nonliteral_is_error(tmp_path):
     idx = build_fixture(tmp_path, {
         "emqx_tpu/observe/spans.py": (

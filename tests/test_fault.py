@@ -147,6 +147,34 @@ def test_engine_breaker_trip_probe_close_and_alarm():
     assert not alarms.is_active("engine_device_degraded")
 
 
+def test_shm_hub_degraded_alarm_lifecycle():
+    """A wire worker's silent local-match fallback on a stale hub
+    heartbeat (shm/client.py `hub_down`) raises the operator-visible
+    alarm through the same health poll, and clears once the heartbeat
+    freshens — engines without an shm plane never trigger it."""
+    class Eng:
+        hub_down = True
+        shm_degraded = 5
+        shm_local = 12
+
+    eng = Eng()
+    alarms = AlarmManager(node="t")
+    poll_health_alarms(eng, None, alarms)
+    a = alarms.is_active("shm_hub_degraded")
+    assert a
+    assert alarms.active["shm_hub_degraded"].details == {
+        "degraded_ticks": 5, "local_serves": 12,
+    }
+    eng.hub_down = False
+    poll_health_alarms(eng, None, alarms)
+    assert not alarms.is_active("shm_hub_degraded")
+    # a plain engine (no shm attributes at all) stays silent
+    from emqx_tpu.models.engine import TopicMatchEngine
+
+    poll_health_alarms(TopicMatchEngine(min_batch=8), None, alarms)
+    assert not alarms.is_active("shm_hub_degraded")
+
+
 # ------------------------------------------------------------ forward spool
 
 @pytest.fixture

@@ -1,0 +1,132 @@
+"""Fleet observability plane (ISSUE 16): mergeable histogram wire
+form, supervisor-side cross-worker aggregation (`fleet_histograms` /
+`fleet_export`), and the fleet_dump renderer.
+
+The supervisor stubs here carry exactly the attributes the aggregation
+methods read (`workers[*].last_hists` etc.) — process spawning is
+covered by tests/test_wire.py; this file pins the merge MATH and the
+export schema, which downstream dashboards gate on.
+"""
+
+import json
+from types import SimpleNamespace
+
+from emqx_tpu.observe.flight import LatencyHistogram
+from emqx_tpu.wire.supervisor import WireSupervisor
+
+
+def _hist(values):
+    h = LatencyHistogram()
+    for v in values:
+        h.observe(v)
+    return h
+
+
+# ------------------------------------------------------------ wire form
+
+
+def test_histogram_wire_roundtrip():
+    h = _hist([0.0001, 0.002, 0.03, 1.5])
+    d = json.loads(json.dumps(h.to_dict()))  # through real JSON
+    h2 = LatencyHistogram.from_dict(d)
+    assert h2.count == h.count and h2.sum == h.sum
+    assert (h2.counts == h.counts).all()
+    assert h2.percentiles_ms() == h.percentiles_ms()
+
+
+def test_histogram_merge_is_exact_bucket_addition():
+    a_vals = [0.001, 0.001, 0.01]
+    b_vals = [0.004, 0.5, 0.0002]
+    merged = _hist(a_vals).merge(_hist(b_vals))
+    whole = _hist(a_vals + b_vals)
+    assert merged.count == whole.count
+    assert merged.sum == whole.sum
+    assert (merged.counts == whole.counts).all()
+    assert merged.percentiles_ms() == whole.percentiles_ms()
+
+
+# ------------------------------------------------- supervisor aggregation
+
+
+def _stub_sup(workers):
+    sup = object.__new__(WireSupervisor)
+    sup.workers = workers
+    sup.node_name = "hub"
+    sup.service = None
+    return sup
+
+
+def test_fleet_histograms_merge_two_workers():
+    """Latest cumulative snapshot per worker, merged bucket-by-bucket
+    and keyed fleet_<name> — NOT accumulated across scrapes (workers
+    ship since-boot histograms; re-adding stale scrapes would
+    double-count)."""
+    w0 = SimpleNamespace(last_hists={
+        "span_stage_ring_wait_latency": _hist([0.001, 0.002]),
+        "loop_lag": _hist([0.01]),
+    })
+    w1 = SimpleNamespace(last_hists={
+        "span_stage_ring_wait_latency": _hist([0.004]),
+    })
+    sup = _stub_sup({0: w0, 1: w1})
+    merged = sup.fleet_histograms()
+    assert set(merged) == {
+        "fleet_span_stage_ring_wait_latency", "fleet_loop_lag",
+    }
+    assert merged["fleet_span_stage_ring_wait_latency"].count == 3
+    assert merged["fleet_loop_lag"].count == 1
+    # merge must not mutate the per-worker snapshots
+    assert w0.last_hists["span_stage_ring_wait_latency"].count == 2
+    # idempotent across scrapes of unchanged state
+    again = sup.fleet_histograms()
+    assert again["fleet_span_stage_ring_wait_latency"].count == 3
+
+
+def test_fleet_export_schema_and_dump_render():
+    w0 = SimpleNamespace(
+        idx=0, name="hub#w0",
+        last_stats={"connections": 3, "hists": {"x": 1},
+                    "spans_slowest": [], "peers": {}},
+        last_hists={"span_stage_ring_wait_latency": _hist([0.001]),
+                    "shm_ring_roundtrip": _hist([0.004])},
+        last_spans=[{"topic": "t/1", "total_ms": 4.0,
+                     "stages": {"ring_wait": 1.0}, "ts": 0.0}],
+    )
+    w1 = SimpleNamespace(
+        idx=1, name="hub#w1",
+        last_stats={"connections": 1},
+        last_hists={"span_stage_ring_wait_latency": _hist([0.002])},
+        last_spans=[],
+    )
+    sup = _stub_sup({0: w0, 1: w1})
+    export = sup.fleet_export()
+    assert export["schema"] == "emqx-tpu/fleet-dump/v1"
+    assert set(export["workers"]) == {"0", "1"}
+    # raw hists/spans never ride the per-worker stats dict twice
+    assert "hists" not in export["workers"]["0"]["stats"]
+    assert export["fleet_hists"][
+        "fleet_span_stage_ring_wait_latency"]["count"] == 2
+    # JSON-safe end to end
+    export = json.loads(json.dumps(export))
+
+    from tools.fleet_dump import dump, to_json
+
+    out = dump(export)
+    assert "ring_wait" in out and "w0" in out and "fleet" in out
+    assert "t/1" in out  # slowest spans carry worker tags
+    j = json.loads(to_json(export))
+    assert j["schema"] == "emqx-tpu/fleet-dump/v1"
+    assert j["fleet_hists"][
+        "fleet_span_stage_ring_wait_latency"]["count"] == 2
+
+
+def test_fleet_dump_reads_bench_nesting():
+    """bench.py --spans-shm-one nests the export under "fleet"; the
+    CLI unnests it (same contract as span_dump's "spans" nesting)."""
+    from tools import fleet_dump
+
+    sup = _stub_sup({})
+    wrapped = {"armed": True, "rps": 1.0, "fleet": sup.fleet_export()}
+    # mimic main()'s unnesting, then render
+    export = wrapped["fleet"] if "workers" not in wrapped else wrapped
+    assert fleet_dump.dump(export).startswith("fleet stages")

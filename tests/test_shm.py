@@ -560,3 +560,111 @@ def test_foreign_intake_sharded_vs_oracle():
             got = set(fids[off:off + int(c)].tolist())
             off += int(c)
             assert got == oracle.match(t), t
+
+
+# ------------------------------------------------- shm-lane span legs
+
+
+SHM_LEGS = ("ring_wait", "fuse_wait", "device", "scatter")
+
+
+@pytest.fixture
+def armed_spans():
+    """Fresh plane at sample=1; always disarmed on the way out so the
+    process-global gate never leaks into other tests."""
+    from emqx_tpu.observe import spans
+    spans.configure(sample=1, keep=8)
+    yield spans
+    spans.disable()
+
+
+def test_e2e_span_leg_decomposition(tmp_path, armed_spans):
+    """Armed, every hub-served tick decomposes into the four shm legs
+    (submit stamp in the slot header, hub drain/fuse/done stamps in
+    the result record) and the per-leg sums reconcile EXACTLY with the
+    measured end-to-end ring round-trip — the same stamps feed both
+    sides, so any drift is a plumbing bug, not noise."""
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        cli = plane.client(region)
+        oracle = CpuTrieIndex()
+        _seed(cli, oracle)
+        _wait(_acked(cli), timeout=10)
+        for _ in range(5):
+            got = cli.match(TOPICS)
+            for t, g in zip(TOPICS, got):
+                assert g == oracle.match(t), t
+        hists = armed_spans.stage_histograms()
+        n = hists["ring_wait"].count
+        assert n >= 5  # every hub-served tick recorded
+        for leg in SHM_LEGS:
+            assert hists[leg].count == n, leg
+            # monotonic stamps on one clock: no negative legs
+            assert hists[leg].sum >= 0.0, leg
+        assert cli.hist_ring.count == n
+        leg_sum = sum(hists[leg].sum for leg in SHM_LEGS)
+        assert leg_sum == pytest.approx(cli.hist_ring.sum, rel=1e-9)
+    finally:
+        plane.stop()
+
+
+def test_span_legs_disarmed_inert(tmp_path):
+    """Disarmed (the default), the slab path stays stamp-free: the
+    submit slots carry zero ts cells, no leg histograms fill, and the
+    round-trip histogram stays empty — while the hub's own drain/
+    fusion telemetry (config-independent) still runs."""
+    from emqx_tpu.observe import spans
+    spans.configure(sample=0)
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        cli = plane.client(region)
+        oracle = CpuTrieIndex()
+        _seed(cli, oracle)
+        _wait(_acked(cli), timeout=10)
+        for _ in range(3):
+            cli.match(TOPICS)
+        for leg in SHM_LEGS:
+            assert spans.stage_histograms()[leg].count == 0, leg
+        assert cli.hist_ring.count == 0
+        # every submit slot this client committed carries zero stamps
+        assert all(int(t[0]) == 0 for t in cli._slab.submit._ts)
+        # hub telemetry is not gated on the span plane
+        assert plane.svc.hist_drain.count >= 1
+    finally:
+        plane.stop()
+
+
+def test_hub_drain_and_fusion_telemetry(tmp_path):
+    """The hub's drain-cycle histogram, fusion group-size distribution
+    and per-lane ring gauges populate from real traffic on two lanes
+    and surface through stats()/lane_stats()."""
+    plane = _Plane(str(tmp_path))
+    regions = [plane.lane(0), plane.lane(1)]
+    plane.start()
+    try:
+        clis = [plane.client(r) for r in regions]
+        oracle = CpuTrieIndex()
+        for cli in clis:
+            _seed(cli, oracle, n=10)
+            _wait(_acked(cli), timeout=10)
+        for _ in range(3):
+            for cli in clis:
+                assert cli.match(TOPICS[:3])  # hub-served ticks
+        st = plane.svc.stats()
+        assert plane.svc.hist_drain.count >= 1
+        assert "drain_cycle_ms" in st and st["drain_cycle_ms"]["p99"] >= 0
+        # every dispatched group counted, sizes >= 1
+        gs = st["group_sizes"]
+        assert gs and all(int(k) >= 1 for k in gs)
+        assert sum(gs.values()) == plane.svc.match_groups
+        lanes = plane.svc.lane_stats()
+        assert set(lanes) == {0, 1}
+        for d in lanes.values():
+            assert d["filters"] > 0
+            assert d["submit_depth"] >= 0 and d["pending_acks"] == 0
+    finally:
+        plane.stop()

@@ -317,6 +317,22 @@ def test_span_dump_render(tmp_path):
     assert "1/1 sampled" in out
 
 
+def test_span_dump_json_schema_pinned(tmp_path):
+    """`--json` re-emit is a downstream contract: schema tag present,
+    stage percentiles addressable at .stages.<stage>.p99."""
+    b = Broker()
+    mk_channel(b, "c0")
+    b.publish_many([Message(topic="a/1", payload=b"x")])
+    path = tmp_path / "spans.json"
+    spans.plane().save(str(path))
+    from tools.span_dump import to_json
+
+    j = json.loads(to_json(json.loads(path.read_text())))
+    assert j["schema"] == "emqx-tpu/span-dump/v1"
+    assert j["stages"]["wire"]["count"] == 1
+    assert "p99" in j["stages"]["wire"]
+
+
 def test_sys_spans_heartbeat():
     """`$SYS/brokers/<node>/spans` rides the sys_msg cadence when the
     plane is armed (same path as the engine summary)."""

@@ -1,0 +1,195 @@
+"""Fleet-wide observability dump: the hub+workers shm topology view.
+
+`tools/span_dump.py` renders ONE process's span plane; this tool
+renders the whole fleet from a `WireSupervisor.fleet_export()` JSON
+(schema `emqx-tpu/fleet-dump/v1`, also written by
+``bench.py --spans-shm --emit-stats``):
+
+* the fleet stage table — per-stage count/p50/p99 for every worker
+  side by side, plus the merged fleet column (histograms merged
+  bucket-by-bucket, `LatencyHistogram.merge`), so a one-worker tail is
+  distinguishable from a fleet-wide one;
+* per-lane ring health — submit/result ring occupancy, queued churn
+  acks and live filter refcounts per shm lane, plus the hub's
+  drain-cycle / fusion-group telemetry;
+* cross-process span waterfalls — each worker's slowest-K spans tagged
+  with the worker that recorded them.
+
+From Python::
+
+    from tools.fleet_dump import dump
+    print(dump(supervisor.fleet_export()))
+
+Usage:
+    python tools/fleet_dump.py fleet.json            # all views
+    python tools/fleet_dump.py fleet.json --slow 16  # more tail spans
+    python tools/fleet_dump.py fleet.json --json     # schema-pinned JSON
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from emqx_tpu.observe.flight import LatencyHistogram  # noqa: E402
+from emqx_tpu.observe.spans import KNOWN_STAGES  # noqa: E402
+
+SCHEMA = "emqx-tpu/fleet-dump/v1"
+
+
+def _hist(d: Optional[Dict]) -> Optional[LatencyHistogram]:
+    if not d:
+        return None
+    try:
+        return LatencyHistogram.from_dict(d)
+    except (TypeError, ValueError):
+        return None
+
+
+def _cell(h: Optional[LatencyHistogram]) -> str:
+    if h is None or not h.count:
+        return f"{'-':>18}"
+    p = h.percentiles_ms()
+    return f"{h.count:>6} {p['p50']:>5.2f}/{p['p99']:>5.2f}"
+
+
+def format_stage_table(export: dict) -> str:
+    """Per-stage count p50/p99 (ms): one column per worker + merged."""
+    workers = export.get("workers") or {}
+    idxs = sorted(workers, key=lambda s: int(s))
+    fleet = export.get("fleet_hists") or {}
+    lines = [
+        f"fleet stages (count p50/p99 ms), node {export.get('node', '?')}:",
+        "stage      " + " ".join(f"{'w' + i:>18}" for i in idxs)
+        + f" {'fleet':>18}",
+    ]
+    for stage in KNOWN_STAGES:
+        key = f"span_stage_{stage}_latency"
+        row = [_cell(_hist((workers[i].get("hists") or {}).get(key)))
+               for i in idxs]
+        row.append(_cell(_hist(fleet.get(f"fleet_{key}"))))
+        if all(c.strip() == "-" for c in row):
+            continue  # stage idle fleet-wide: keep the table tight
+        lines.append(f"{stage:<10} " + " ".join(row))
+    for name, label in (("shm_ring_roundtrip", "ring e2e"),
+                        ("loop_lag", "loop_lag"),
+                        ("gc_pause", "gc_pause"),
+                        ("engine_tick_latency", "tick")):
+        row = [_cell(_hist((workers[i].get("hists") or {}).get(name)))
+               for i in idxs]
+        row.append(_cell(_hist(fleet.get(f"fleet_{name}"))))
+        if any(c.strip() != "-" for c in row):
+            lines.append(f"{label:<10} " + " ".join(row))
+    return "\n".join(lines)
+
+
+def format_lanes(export: dict) -> str:
+    """Hub drain/fusion telemetry + per-lane ring health."""
+    hub = export.get("hub") or {}
+    if not hub:
+        return "no hub telemetry (shm plane off)"
+    st = hub.get("stats") or {}
+    lines = [
+        f"hub: {st.get('ticks', 0)} ticks in {st.get('groups', 0)} "
+        f"fused groups, {st.get('res_drops', 0)} result drops, "
+        f"{st.get('reclaims', 0)} reclaims",
+    ]
+    gs = st.get("group_sizes") or {}
+    if gs:
+        total = sum(gs.values()) or 1
+        dist = " ".join(
+            f"{k}x:{v} ({v / total * 100.0:.0f}%)"
+            for k, v in sorted(gs.items(), key=lambda kv: int(kv[0]))
+        )
+        lines.append(f"fusion group sizes: {dist}")
+    dc = st.get("drain_cycle_ms")
+    if dc:
+        lines.append(
+            f"drain cycle: p50 {dc['p50']:.3f} ms, "
+            f"p99 {dc['p99']:.3f} ms"
+        )
+    lanes = hub.get("lanes") or {}
+    if lanes:
+        lines.append(
+            f"{'lane':<5} {'submit':>7} {'result':>7} {'acks':>6} "
+            f"{'filters':>8}"
+        )
+        for i in sorted(lanes, key=lambda s: int(s)):
+            d = lanes[i]
+            lines.append(
+                f"{i:<5} {d.get('submit_depth', 0):>7} "
+                f"{d.get('result_depth', 0):>7} "
+                f"{d.get('pending_acks', 0):>6} "
+                f"{d.get('filters', 0):>8}"
+            )
+    return "\n".join(lines)
+
+
+def format_waterfalls(export: dict, k: int = 8) -> str:
+    """Cross-process slowest spans, worker-tagged, slowest first."""
+    rows: List[tuple] = []
+    for i, w in (export.get("workers") or {}).items():
+        for rec in w.get("spans_slowest") or []:
+            rows.append((rec.get("total_ms", 0.0), i, rec))
+    if not rows:
+        return "no completed spans reported by any worker"
+    rows.sort(reverse=True, key=lambda r: r[0])
+    lines = ["slowest spans fleet-wide (per-stage ms):"]
+    for total, i, rec in rows[:k]:
+        waterfall = " ".join(
+            f"{s}={rec['stages'][s]:.3f}"
+            for s in KNOWN_STAGES if s in (rec.get("stages") or {})
+        )
+        lines.append(
+            f"  w{i} {total:>9.3f}ms {rec.get('topic', '?'):<28} "
+            f"{waterfall}"
+        )
+    return "\n".join(lines)
+
+
+def dump(export: dict, slow: int = 8) -> str:
+    return "\n\n".join([
+        format_stage_table(export),
+        format_lanes(export),
+        format_waterfalls(export, slow),
+    ])
+
+
+def to_json(export: dict) -> str:
+    """Schema-pinned machine-readable re-emit (CI/soak gates parse
+    this; the pin means a field rename is a breaking change here, not
+    in every downstream jq)."""
+    out = dict(export)
+    out["schema"] = SCHEMA
+    return json.dumps(out, indent=2, sort_keys=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="render a fleet observability export"
+    )
+    ap.add_argument("path", help="JSON from WireSupervisor.fleet_export"
+                                 " / bench.py --spans-shm --emit-stats")
+    ap.add_argument("--slow", type=int, default=8,
+                    help="tail spans to show (default 8)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit schema-pinned JSON instead of tables")
+    ns = ap.parse_args()
+    with open(ns.path, "r", encoding="utf-8") as f:
+        export = json.load(f)
+    # bench exports nest the fleet dump under "fleet"
+    if "workers" not in export and "fleet" in export:
+        export = export["fleet"]
+    if ns.json:
+        print(to_json(export))
+    else:
+        print(dump(export, slow=ns.slow))
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,7 @@ Usage:
     python tools/span_dump.py spans.json             # both views
     python tools/span_dump.py spans.json --slow 16   # more tail spans
     python tools/span_dump.py spans.json --recent    # recent ring too
+    python tools/span_dump.py spans.json --json      # schema-pinned JSON
 """
 
 from __future__ import annotations
@@ -34,6 +35,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from emqx_tpu.observe.spans import KNOWN_STAGES  # noqa: E402
+
+SCHEMA = "emqx-tpu/span-dump/v1"
 
 
 def _ms(v) -> str:
@@ -113,6 +116,16 @@ def dump(export: dict, slow: int = 8, recent: bool = False) -> str:
     return "\n".join(out)
 
 
+def to_json(export: dict) -> str:
+    """Schema-pinned machine-readable re-emit: soak/CI jobs gate on
+    stage p99s from this (`.stages.<stage>.p99`), so the field layout
+    is a contract — a rename is a breaking change HERE, caught by the
+    render test, not discovered in a downstream pipeline."""
+    out = dict(export)
+    out["schema"] = SCHEMA
+    return json.dumps(out, indent=2, sort_keys=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(
         description="render a span-plane JSON export"
@@ -123,13 +136,18 @@ def main() -> None:
                     help="tail spans to show (default 8)")
     ap.add_argument("--recent", action="store_true",
                     help="also print the recent-span ring")
+    ap.add_argument("--json", action="store_true",
+                    help="emit schema-pinned JSON instead of tables")
     ns = ap.parse_args()
     with open(ns.path, "r", encoding="utf-8") as f:
         export = json.load(f)
     # bench exports nest the plane dump under "spans"
     if "stages" not in export and "spans" in export:
         export = export["spans"]
-    print(dump(export, slow=ns.slow, recent=ns.recent))
+    if ns.json:
+        print(to_json(export))
+    else:
+        print(dump(export, slow=ns.slow, recent=ns.recent))
 
 
 if __name__ == "__main__":
