@@ -2201,7 +2201,8 @@ def _rss_kb(pid: int) -> int:
 async def _wire_run_one(workers: int, duration: float, reps: int,
                         n_subs: int, n_pubs: int, payload: int,
                         shm: bool = True,
-                        resident: int = WIRE_RESIDENT) -> dict:
+                        resident: int = WIRE_RESIDENT,
+                        drain: str = "auto") -> dict:
     """One pool size W through REAL sockets: boot a hub + W wire
     workers (W=0 = the in-process listener path), attach `n_subs`
     subscribers to one fan-out filter and `n_pubs` flat-out QoS0
@@ -2226,8 +2227,14 @@ async def _wire_run_one(workers: int, duration: float, reps: int,
     if workers:
         raw["wire"] = {"workers": workers, "stats_interval": 0.5}
         # shm=False = the per-process layout (every worker boots its
-        # own device engine), the pre-shared-match baseline
-        raw["shm"] = {"enable": bool(shm)}
+        # own device engine), the pre-shared-match baseline; `drain`
+        # picks the hub wakeup discipline (poll = the legacy 2ms loop,
+        # auto = doorbell-driven native/thread waiter).  The doorbell
+        # arm arms the adaptive fusion window: a doorbell wakes on the
+        # FIRST commit, so without wait-to-fuse it would trade the
+        # poll loop's accidental batching for unfused passes
+        raw["shm"] = {"enable": bool(shm), "drain": drain,
+                      "fuse_window_us": 0 if drain == "poll" else 500}
     rt = NodeRuntime(raw)
     await rt.start()
     try:
@@ -2261,7 +2268,7 @@ async def _wire_run_one(workers: int, duration: float, reps: int,
         body = b"x" * payload
         published = [0]
 
-        async def drain(k: int) -> None:
+        async def drain_sub(k: int) -> None:
             while not stop.is_set():
                 try:
                     await subs[k].recv(timeout=0.2)
@@ -2296,7 +2303,7 @@ async def _wire_run_one(workers: int, duration: float, reps: int,
                 counts[k] = 0
             published[0] = 0
             stop.clear()
-            tasks = [asyncio.ensure_future(drain(k))
+            tasks = [asyncio.ensure_future(drain_sub(k))
                      for k in range(n_subs)]
             tasks += [asyncio.ensure_future(pump(c)) for c in pubs]
             t0 = time.time()
@@ -2336,6 +2343,18 @@ async def _wire_run_one(workers: int, duration: float, reps: int,
                 grp_gt1_pct = (
                     sum(1 for x in grps if x > 1) / len(grps) * 100.0
                 )
+        # hub drain-engine telemetry (doorbell vs poll A/B columns)
+        hub_drain = {}
+        if workers and shm and rt.wire is not None \
+                and rt.wire.service is not None:
+            st = rt.wire.service.stats()
+            hub_drain = {
+                "drain_mode": st["drain_mode"] or "poll",
+                "fused_share_pct": round(st["fused_share"] * 100.0, 1),
+                "doorbell_wakeups": st["doorbell_wakeups"],
+                "idle_passes": st["idle_passes"],
+                "drain_passes": st["drain_passes"],
+            }
         # memory gate: seed the resident filter set AFTER the reps (so
         # rps rows stay comparable) and read per-process RSS — in shm
         # mode the table lives once on the hub and worker RSS must stay
@@ -2359,6 +2378,8 @@ async def _wire_run_one(workers: int, duration: float, reps: int,
         return {
             "workers": workers,
             "shm": bool(shm) if workers else None,
+            "drain": (drain if (workers and shm) else None),
+            "hub_drain": hub_drain,
             "rps": med,
             "reps": [round(r, 1) for r in rep_rates],
             "rep_spread_pct": spread,
@@ -2400,16 +2421,24 @@ def run_wire(workers_list=(0, 1, 2), duration: float = 4.0,
 
     # every W>0 size runs BOTH engine layouts: shm=off is the
     # per-process baseline (each worker owns a device engine), shm=on
-    # the shared-match plane — the w1 pair is the no-regression gate
+    # the shared-match plane — the w1 pair is the no-regression gate.
+    # The shm layout additionally runs BOTH hub drain disciplines
+    # (poll = legacy 2ms loop, auto = doorbell waiter) for the A/B.
     cases = []
     for w in workers_list:
         if w == 0:
-            cases.append((0, True))
+            cases.append((0, True, "auto"))
         else:
-            cases.extend([(w, False), (w, True)])
+            cases.extend([(w, False, "auto"),
+                          (w, True, "poll"), (w, True, "auto")])
     rows = []
-    for w, shm in cases:
-        tag = "" if w == 0 else (" shm" if shm else " per-proc")
+    for w, shm, drain in cases:
+        if w == 0:
+            tag = ""
+        elif not shm:
+            tag = " per-proc"
+        else:
+            tag = " shm/poll" if drain == "poll" else " shm/doorbell"
         log(f"wire bench: workers={w}{tag}")
         with tempfile.NamedTemporaryFile(suffix=".json",
                                          delete=False) as tf:
@@ -2417,6 +2446,7 @@ def run_wire(workers_list=(0, 1, 2), duration: float = 4.0,
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--wire-one",
              str(w), "--wire-shm", str(int(shm)),
+             "--wire-drain", drain,
              "--emit-stats", stats_path],
             stdout=subprocess.PIPE, timeout=1800,
         )
@@ -2459,7 +2489,11 @@ def _wire_section_lines(s: dict) -> list:
         "Engine column: per-proc = every worker boots its own device "
         "engine (the pre-shm layout); shm = the shared-memory match "
         "plane (workers submit pre-packed ticks to the hub's single "
-        "engine over SPSC rings).  grp>1 = share of hub dispatches "
+        "engine over SPSC rings), run twice for the drain A/B — "
+        "shm/poll is the legacy fixed-interval hub drain loop, "
+        "shm/doorbell the eventfd-driven drain engine (`shm.drain`, "
+        "worker commits ring the parked hub; adaptive fusion window + "
+        "per-lane credit).  grp>1 = share of hub dispatches "
         "that fused ticks from more than one worker (flight-recorder "
         "prep_group); RSS is measured per process AFTER seeding the "
         "resident filter set into the match plane — in shm mode the "
@@ -2483,8 +2517,13 @@ def _wire_section_lines(s: dict) -> list:
         vs = f"{r['vs_inproc']:.2f}x" if r.get("vs_inproc") else "—"
         if r["workers"] == 0:
             eng = "in-proc"
+        elif not r.get("shm"):
+            eng = "per-proc"
         else:
-            eng = "shm" if r.get("shm") else "per-proc"
+            # shm rows carry the hub drain discipline of the A/B
+            mode = (r.get("hub_drain") or {}).get(
+                "drain_mode", r.get("drain") or "auto")
+            eng = "shm/poll" if mode == "poll" else "shm/doorbell"
         grp = (
             f"{r['grp_gt1_pct']:.0f}% (max {r['grp_max']})"
             if r.get("grp_max") else "—"
@@ -2541,18 +2580,23 @@ SHM_HEADER = "## Shared-memory match plane"
 
 
 def run_shm(n_filters: int = 2000, ticks: int = 600,
-            batch: int = 16, fuse_ticks: int = 300) -> dict:
+            batch: int = 16, fuse_ticks: int = 300,
+            drain: str = "auto",
+            fuse_window_us: int = 0) -> dict:
     """In-process microbench of the shm match plane (emqx_tpu/shm/):
     one hub MatchService + client lanes over REAL shared-memory rings,
     threads standing in for worker processes — the ring protocol is
     byte-identical, process isolation is exercised by `--wire` and the
     chaos tests.  Measures the submit->result round-trip at one lane,
     cross-lane fusion (two lanes submitting concurrently, group sizes
-    from the service counters), and churn-ack throughput through the
-    same rings."""
+    from the service counters), churn-ack throughput through the same
+    rings, plus the drain-engine figures of the poll-vs-doorbell A/B:
+    idle hub wakeups/s (the tax the doorbells delete) and the
+    drain-cycle gap under flat-out load."""
     import threading
 
     from emqx_tpu.models.engine import TopicMatchEngine
+    from emqx_tpu.observe.flight import LatencyHistogram
     from emqx_tpu.ops.hashing import HashSpace
     from emqx_tpu.shm.client import ShmMatchEngine
     from emqx_tpu.shm.registry import ShmRegistry
@@ -2560,10 +2604,13 @@ def run_shm(n_filters: int = 2000, ticks: int = 600,
 
     space = HashSpace()
     eng = TopicMatchEngine(space=space)
-    reg = ShmRegistry(f"shm-bench-{os.getpid()}")
+    reg = ShmRegistry(f"shm-bench-{os.getpid()}-{drain}")
     svc = MatchService(eng, reg, slots=64, slot_bytes=65536,
-                       poll_interval=0.0005)
+                       poll_interval=0.0005, drain=drain,
+                       fuse_window_us=fuse_window_us)
     regions = [svc.create_lane(i) for i in range(2)]
+    db_fds = [svc.doorbell_fd(i) if drain != "poll" else None
+              for i in range(2)]
     loop = asyncio.new_event_loop()
 
     def run_loop():
@@ -2575,8 +2622,9 @@ def run_shm(n_filters: int = 2000, ticks: int = 600,
     th.start()
     clients = [
         ShmMatchEngine(space=space, region=r, slots=64,
-                       slot_bytes=65536, timeout=30.0)
-        for r in regions
+                       slot_bytes=65536, timeout=30.0,
+                       doorbell_fd=db_fds[i])
+        for i, r in enumerate(regions)
     ]
     try:
         # churn-ack throughput: the bulk add rides the churn ring in
@@ -2599,6 +2647,14 @@ def run_shm(n_filters: int = 2000, ticks: int = 600,
                 )
         churn_rps = (2 * n_filters) / (time.time() - t0)
 
+        # idle wakeup rate: no traffic for 1s — under poll the drain
+        # loop turns at 1/poll_interval regardless; with doorbells it
+        # parks and only the housekeeping bound (~1/s) turns it
+        idle0 = svc.drain_passes
+        time.sleep(1.0)
+        idle_window = 1.0
+        idle_wakeups_per_s = (svc.drain_passes - idle0) / idle_window
+
         topics = [f"lane0/f{i}/x" for i in range(batch)]
         clients[0].match(topics)  # warmup: first tick pays the compile
         lats = []
@@ -2616,6 +2672,7 @@ def run_shm(n_filters: int = 2000, ticks: int = 600,
         # device call (groups < ticks)
         clients[1].match([f"lane1/f{i}/x" for i in range(batch)])
         ticks0, groups0 = svc.match_ticks, svc.match_groups
+        gap0 = svc.hist_drain.counts.copy()
         t2 = time.time()
 
         def pump(k):
@@ -2634,7 +2691,21 @@ def run_shm(n_filters: int = 2000, ticks: int = 600,
         dgroups = svc.match_groups - groups0
         degraded = sum(c.stats()["degraded"] for c in clients)
         local = sum(c.stats()["local"] for c in clients)
+        # drain-cycle gap during the flat-out phase only (delta
+        # histogram: the idle window's second-long parks stay out)
+        gap = LatencyHistogram()
+        gap.counts = svc.hist_drain.counts - gap0
+        gap.count = int(gap.counts.sum())
+        st = svc.stats()
         return {
+            "drain": drain,
+            "drain_mode": st["drain_mode"] or "poll",
+            "fuse_window_us": fuse_window_us,
+            "fuse_waits": st["fuse_waits"],
+            "idle_wakeups_per_s": round(idle_wakeups_per_s, 1),
+            "doorbell_wakeups": st["doorbell_wakeups"],
+            "drain_gap_p50_us": round(gap.quantile(0.5) * 1e6, 1),
+            "drain_gap_p99_us": round(gap.quantile(0.99) * 1e6, 1),
             "n_filters": 2 * n_filters,
             "churn_ack_rps": round(churn_rps, 1),
             "tick_p50_us": round(p50_us, 1),
@@ -2664,8 +2735,47 @@ def run_shm(n_filters: int = 2000, ticks: int = 600,
         loop.close()
 
 
+def run_shm_ab() -> dict:
+    """The `--shm` drain A/B: the poll and doorbell arms each run in a
+    FRESH interpreter (`--shm-one`, same hygiene as the --wire sweep —
+    a second engine generation in one process degrades per-call match
+    latency ~1000x), poll first so the legacy row is the baseline."""
+    import subprocess
+    import tempfile
+
+    arms = []
+    # the doorbell arm runs with the adaptive fusion window armed
+    # (shm.fuse_window_us): a doorbell wakes the hub on the FIRST
+    # commit, so without the wait-to-fuse window it would trade the
+    # poll loop's accidental batching for unfused single-tick passes
+    for arm, fuse_us in (("poll", 0), ("auto", 500)):
+        log(f"shm bench: drain={arm} fuse_window_us={fuse_us}")
+        with tempfile.NamedTemporaryFile(suffix=".json",
+                                         delete=False) as tf:
+            stats_path = tf.name
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--shm-one",
+             arm, "--shm-fuse-us", str(fuse_us),
+             "--emit-stats", stats_path],
+            stdout=subprocess.PIPE, timeout=1800,
+        )
+        if r.returncode != 0:
+            log(f"shm bench arm {arm} failed (rc={r.returncode}); "
+                "row omitted")
+            os.unlink(stats_path)
+            continue
+        with open(stats_path, "r", encoding="utf-8") as f:
+            arms.append(json.load(f))
+        os.unlink(stats_path)
+        a = arms[-1]
+        log(f"  -> {a['drain_mode']}: {a['fuse_ticks_per_s']:,.0f} "
+            f"ticks/s, fused {a['fused_pct']:.0f}%, idle "
+            f"{a['idle_wakeups_per_s']:,.0f} wakeups/s")
+    return {"arms": arms, "host_threads": os.cpu_count() or 1}
+
+
 def _shm_section_lines(s: dict) -> list:
-    return [
+    lines = [
         "",
         f"{SHM_HEADER} (in-process ring microbench)",
         "",
@@ -2675,18 +2785,35 @@ def _shm_section_lines(s: dict) -> list:
         "= TopicPrep pack into the slab -> hub drain -> one device "
         "call -> result scatter -> worker-side exact verify.  Fused % "
         "= hub dispatches that coalesced ticks from both lanes into "
-        "one device call when both submit flat out.  Host: "
+        "one device call when both submit flat out.  Drain A/B: poll "
+        "= the legacy fixed-interval drain loop (shm.poll_interval), "
+        "native/thread = the doorbell-driven drain engine (worker "
+        "commits ring a parked hub over per-lane eventfds; "
+        "`shm.drain`).  idle wakeups/s = drain passes during a 1 s "
+        "quiet window (the poll tax the doorbells delete); drain gap "
+        "= pass-to-pass latency under flat-out 2-lane load.  Host: "
         f"{s['host_threads']} hardware thread(s).",
         "",
-        "| resident filters | churn acks/s | tick p50 | tick p99 "
-        "| 2-lane ticks/s | fused | degraded |",
-        "|---|---|---|---|---|---|---|",
-        f"| {s['n_filters']:,} | {s['churn_ack_rps']:,.0f} "
-        f"| {s['tick_p50_us']:,.0f} µs | {s['tick_p99_us']:,.0f} µs "
-        f"| {s['fuse_ticks_per_s']:,.0f} | {s['fused_pct']:.0f}% "
-        f"| {s['degraded']} |",
-        "",
+        "| drain | resident filters | churn acks/s | tick p50 "
+        "| tick p99 | 2-lane ticks/s | fused | drain gap p50/p99 "
+        "| idle wakeups/s | degraded |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
+    for a in s["arms"]:
+        mode = a["drain_mode"]
+        if a.get("fuse_window_us"):
+            mode += f" +{a['fuse_window_us']}µs fuse"
+        lines.append(
+            f"| {mode} | {a['n_filters']:,} "
+            f"| {a['churn_ack_rps']:,.0f} "
+            f"| {a['tick_p50_us']:,.0f} µs | {a['tick_p99_us']:,.0f} µs "
+            f"| {a['fuse_ticks_per_s']:,.0f} | {a['fused_pct']:.0f}% "
+            f"| {a['drain_gap_p50_us']:,.0f}/{a['drain_gap_p99_us']:,.0f} µs "
+            f"| {a['idle_wakeups_per_s']:,.0f} "
+            f"| {a['degraded']} |"
+        )
+    lines.append("")
+    return lines
 
 
 def _update_shm_table(s: dict) -> None:
@@ -3140,7 +3267,8 @@ SHM_LEGS = ("ring_wait", "fuse_wait", "device", "scatter")
 
 async def _spans_shm_one(armed: bool, duration: float = 6.0,
                          n_subs: int = 8, n_pubs: int = 2,
-                         payload: int = 128) -> dict:
+                         payload: int = 128,
+                         drain: str = "auto") -> dict:
     """One arm of the shm-lane attribution A/B: boot the REAL hub +
     2-wire-worker shm topology (`worker_raw` derivations inherit the
     `observe` section, so both workers arm at sample=1 or disarm at
@@ -3162,7 +3290,11 @@ async def _spans_shm_one(armed: bool, duration: float = 6.0,
         "listeners": [{"type": "tcp", "port": 0}],
         "dashboard": {"listen_port": 0},
         "wire": {"workers": 2, "stats_interval": 0.5},
-        "shm": {"enable": True},
+        # poll arm keeps the legacy drain loop for the A/B; doorbell
+        # arms ride the fusion window so the ring_wait/fuse_wait split
+        # prices the wakeup discipline, not accidental batching
+        "shm": {"enable": True, "drain": drain,
+                "fuse_window_us": 0 if drain == "poll" else 500},
         "observe": {"span_sample": 1 if armed else 0},
     }
     rt = NodeRuntime(raw)
@@ -3195,7 +3327,7 @@ async def _spans_shm_one(armed: bool, duration: float = 6.0,
         body = b"x" * payload
         published = [0]
 
-        async def drain(k: int) -> None:
+        async def drain_sub(k: int) -> None:
             while not stop.is_set():
                 try:
                     await subs[k].recv(timeout=0.2)
@@ -3217,7 +3349,8 @@ async def _spans_shm_one(armed: bool, duration: float = 6.0,
                 published[0] += 1
                 await asyncio.sleep(0)
 
-        tasks = [asyncio.ensure_future(drain(k)) for k in range(n_subs)]
+        tasks = [asyncio.ensure_future(drain_sub(k))
+                 for k in range(n_subs)]
         tasks += [asyncio.ensure_future(pump(c)) for c in pubs]
         t0 = time.time()
         await asyncio.sleep(duration)
@@ -3234,8 +3367,12 @@ async def _spans_shm_one(armed: bool, duration: float = 6.0,
                 await c.disconnect()
             except Exception:
                 pass
+        svc = getattr(sup, "service", None)
         return {
             "armed": bool(armed),
+            "drain": drain,
+            "drain_mode": (svc.drain_mode or svc.drain)
+            if svc is not None else "",
             "rps": rate,
             "published": published[0],
             "fleet": fleet,
@@ -3259,15 +3396,21 @@ def run_spans_shm(duration: float = 6.0) -> dict:
     from emqx_tpu.observe.flight import LatencyHistogram
 
     runs = {}
-    for armed in (1, 0):
-        tag = "armed" if armed else "disarmed"
-        log(f"shm-span bench: hub + 2 workers, spans {tag}")
+    # three arms: armed doorbell (the decomposition + drain A/B side),
+    # disarmed doorbell (overhead reference), armed poll (the legacy
+    # drain loop priced by the same per-leg stamps)
+    for tag, armed, drain in (("armed", 1, "auto"),
+                              ("disarmed", 0, "auto"),
+                              ("poll", 1, "poll")):
+        log(f"shm-span bench: hub + 2 workers, spans "
+            f"{'armed' if armed else 'disarmed'}, drain={drain}")
         with tempfile.NamedTemporaryFile(suffix=".json",
                                          delete=False) as tf:
             stats_path = tf.name
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
              "--spans-shm-one", str(armed),
+             "--spans-drain", drain,
              "--emit-stats", stats_path],
             stdout=subprocess.PIPE, timeout=1800,
         )
@@ -3325,6 +3468,16 @@ def run_spans_shm(duration: float = 6.0) -> dict:
     )
     hub = fleet.get("hub") or {}
     hub_stats = hub.get("stats") or {}
+    # poll-arm decomposition: the same per-leg stamps under the legacy
+    # drain loop — the ring_wait delta IS the drain-discipline price
+    poll_fleet = runs["poll"]["fleet"]
+    poll_fh = poll_fleet.get("fleet_hists") or {}
+    poll_legs = {
+        leg: _row(poll_fh.get(f"fleet_span_stage_{leg}_latency"))
+        for leg in SHM_LEGS
+    }
+    poll_ring = _row(poll_fh.get("fleet_shm_ring_roundtrip"))
+    poll_hub = (poll_fleet.get("hub") or {}).get("stats") or {}
     return {
         "legs": legs,
         "ring": ring,
@@ -3340,6 +3493,14 @@ def run_spans_shm(duration: float = 6.0) -> dict:
         "overhead_gate_pct": SPAN_OVERHEAD_GATE_PCT,
         "drain_cycle_ms": hub_stats.get("drain_cycle_ms"),
         "group_sizes": hub_stats.get("group_sizes"),
+        "drain_mode": runs["armed"].get("drain_mode", ""),
+        "poll": {
+            "legs": poll_legs,
+            "ring": poll_ring,
+            "rps": round(runs["poll"]["rps"], 1),
+            "drain_cycle_ms": poll_hub.get("drain_cycle_ms"),
+            "group_sizes": poll_hub.get("group_sizes"),
+        },
         "fleet": fleet,
     }
 
@@ -3362,7 +3523,11 @@ def _spans_shm_section_lines(s: dict) -> list:
         "worker decode).  Histograms cross the wire_stats RPC and are "
         "fleet-merged by the supervisor — this table IS the "
         "production aggregation path (`tools/fleet_dump.py` renders "
-        "the same export).",
+        "the same export).  Main table = the doorbell drain engine "
+        "(`shm.drain: auto`, 500 µs fusion window); the drain A/B "
+        "table below re-runs the armed leg under the legacy poll "
+        "loop (`shm.drain: poll`), so the per-leg deltas price the "
+        "wakeup discipline itself.",
         "",
         "| leg | samples | p50 ms | p99 ms | mean ms |",
         "|---|---|---|---|---|",
@@ -3420,6 +3585,44 @@ def _spans_shm_section_lines(s: dict) -> list:
         )
         tail += f"  Fusion group sizes (size: dispatches): {dist}."
     lines += ["", tail, ""]
+    poll = s.get("poll") or {}
+    if poll.get("ring", {}).get("count"):
+        mode = s.get("drain_mode") or "doorbell"
+        lines += [
+            f"Drain A/B (same armed leg, poll loop vs {mode} "
+            "doorbells):",
+            "",
+            "| leg | poll p50 / mean ms | doorbell p50 / mean ms |",
+            "|---|---|---|",
+        ]
+        for leg in SHM_LEGS:
+            p = poll["legs"].get(leg) or {}
+            d = s["legs"].get(leg) or {}
+            if p.get("count") and d.get("count"):
+                lines.append(
+                    f"| {leg} | {p['p50_ms']:.3f} / {p['mean_ms']:.3f}"
+                    f" | {d['p50_ms']:.3f} / {d['mean_ms']:.3f} |"
+                )
+        pring, dring = poll["ring"], s.get("ring") or {}
+        if dring.get("count"):
+            lines.append(
+                "| ring round-trip "
+                f"| {pring['p50_ms']:.3f} / {pring['mean_ms']:.3f} "
+                f"| {dring['p50_ms']:.3f} / {dring['mean_ms']:.3f} |"
+            )
+        ab_tail = (
+            f"Armed delivery rate poll vs doorbell: "
+            f"{poll['rps']:,.0f} vs {s['rps_armed']:,.0f} "
+            "deliveries/s."
+        )
+        pdc, ddc = poll.get("drain_cycle_ms"), s.get("drain_cycle_ms")
+        if pdc and ddc:
+            ab_tail += (
+                f"  Hub drain cycle p50 poll vs doorbell: "
+                f"{pdc.get('p50', 0.0):.3f} vs "
+                f"{ddc.get('p50', 0.0):.3f} ms."
+            )
+        lines += ["", ab_tail, ""]
     return lines
 
 
@@ -3584,6 +3787,10 @@ def main() -> None:
                     help="single shm-span topology run, spans armed "
                          "(1) or disarmed (0) — the --spans-shm "
                          "sweep's inner subprocess")
+    ap.add_argument("--spans-drain", default="auto",
+                    choices=("auto", "poll"),
+                    help="hub drain mode for --spans-shm-one (the "
+                         "--spans-shm sweep's drain A/B arm)")
     ap.add_argument("--prep-only", action="store_true",
                     help="fused-native vs python-fallback prep "
                          "microbench at B=512/2048 over the sharded "
@@ -3615,6 +3822,20 @@ def main() -> None:
     ap.add_argument("--wire-resident", default=WIRE_RESIDENT, type=int,
                     help="resident filters seeded for the --wire-one "
                          "RSS measurement (after the throughput reps)")
+    ap.add_argument("--wire-drain", default="auto",
+                    choices=("auto", "native", "thread", "poll"),
+                    help="--wire-one hub drain discipline (shm.drain) "
+                         "— the sweep runs shm rows at poll AND auto "
+                         "for the doorbell A/B")
+    ap.add_argument("--shm-one", default=None,
+                    choices=("auto", "poll"),
+                    help="single shm-microbench arm at this drain "
+                         "discipline (the --shm A/B's inner "
+                         "subprocess; fresh interpreter per arm so "
+                         "neither pays the other's engine generation)")
+    ap.add_argument("--shm-fuse-us", default=0, type=int,
+                    help="--shm-one adaptive fusion window "
+                         "(shm.fuse_window_us) in µs")
     ap.add_argument("--churn-capacity", action="store_true",
                     help="single churn-capacity measurement at the "
                          "current ETPU_POOL_THREADS (the sweep's inner "
@@ -3645,24 +3866,32 @@ def main() -> None:
         stats = asyncio.run(_wire_run_one(
             ns.wire_one, duration=4.0, reps=3, n_subs=30, n_pubs=2,
             payload=128, shm=bool(ns.wire_shm),
-            resident=ns.wire_resident,
+            resident=ns.wire_resident, drain=ns.wire_drain,
         ))
         if ns.emit_stats:
             with open(ns.emit_stats, "w", encoding="utf-8") as f:
                 json.dump(stats, f)
         print(json.dumps(stats))
         return
+    if ns.shm_one is not None:
+        stats = run_shm(drain=ns.shm_one, fuse_window_us=ns.shm_fuse_us)
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
+        print(json.dumps(stats))
+        return
     if ns.shm:
-        stats = run_shm()
+        stats = run_shm_ab()
         _update_shm_table(stats)
         if ns.emit_stats:
             with open(ns.emit_stats, "w", encoding="utf-8") as f:
                 json.dump(stats, f)
+        best = stats["arms"][-1] if stats["arms"] else {}
         print(json.dumps({
             "metric": "shm_tick_p50_us",
-            "value": stats["tick_p50_us"],
+            "value": best.get("tick_p50_us"),
             "unit": "us",
-            **{k: v for k, v in stats.items() if k != "tick_p50_us"},
+            **{k: v for k, v in stats.items()},
         }))
         return
     if ns.wire:
@@ -3713,7 +3942,8 @@ def main() -> None:
         }))
         return
     if ns.spans_shm_one is not None:
-        stats = asyncio.run(_spans_shm_one(bool(ns.spans_shm_one)))
+        stats = asyncio.run(_spans_shm_one(bool(ns.spans_shm_one),
+                                           drain=ns.spans_drain))
         if ns.emit_stats:
             with open(ns.emit_stats, "w", encoding="utf-8") as f:
                 json.dump(stats, f)
@@ -3740,6 +3970,9 @@ def main() -> None:
             "leg_mean_sum_ms": stats["leg_mean_sum_ms"],
             "drain_cycle_ms": stats.get("drain_cycle_ms"),
             "group_sizes": stats.get("group_sizes"),
+            "drain_mode": stats.get("drain_mode"),
+            "poll": {k: v for k, v in (stats.get("poll") or {}).items()
+                     if k != "legs"},
         }))
         return
     if ns.spans:

@@ -135,6 +135,9 @@ PREDEFINED = [
     "shm.hub.churn_records",
     "shm.hub.reclaims",
     "shm.hub.res_drops",
+    "shm.hub.ack_shed",
+    "shm.hub.credit_exhausted",
+    "shm.hub.doorbell_wakeups",
     # exhook event dispatcher (exhook/manager.py)
     "exhook.events.dropped",
     "exhook.events.failed",

@@ -614,13 +614,57 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
                  "past which workers stop submitting entirely"),
         "poll_interval": Field(
             "duration", 0.002,
-            desc="hub drain cadence when every worker ring is idle "
-                 "(under load the service re-polls immediately)"),
+            desc="POLL-MODE fallback knob (shm.drain: poll): hub drain "
+                 "cadence when every worker ring is idle; the "
+                 "doorbell modes block on lane eventfds instead and "
+                 "never consult this (under load every mode re-drains "
+                 "immediately)"),
+        "drain": Field(
+            "enum", "auto", enum=["auto", "native", "thread", "poll"],
+            desc="hub drain engine: doorbell-driven — workers ring a "
+                 "per-lane eventfd on slot commit and the hub blocks "
+                 "in a dedicated drain thread via native poll(2) over "
+                 "all lane fds ('native', GIL released) or "
+                 "select.poll ('thread'); 'auto' = native when the "
+                 "lib is built else thread; 'poll' = the legacy "
+                 "fixed-cadence asyncio loop (shm.poll_interval)"),
+        "fuse_window_us": Field(
+            "int", 0, min=0, max=10000,
+            desc="adaptive cross-lane fusion window (µs): with >= 2 "
+                 "lanes hot the hub holds a dispatch this long so "
+                 "ticks from different workers coalesce into one "
+                 "device call; auto-collapses to 0 when a single "
+                 "lane is active, so a lone worker's p50 never pays "
+                 "it; 0 = never wait"),
+        "lane_credit": Field(
+            "int", 64, min=0, max=4096,
+            desc="max records drained per lane per pass (round-robin "
+                 "carryover): a flooding worker keeps its surplus in "
+                 "its own ring while siblings drain first; "
+                 "exhaustions count in shm.hub.credit_exhausted and "
+                 "trace as shm.credit; 0 = unlimited"),
+        "pin_cores": Field(
+            "str", "",
+            desc="optional core list/ranges ('0-3', '0,2'): first "
+                 "core pins the hub's drain thread, the rest are "
+                 "assigned round-robin to worker lanes "
+                 "(sched_setaffinity, advisory); empty = no pinning"),
         "region": Field(
             "str", "",
             desc="worker-side only (injected into derived configs): "
                  "the shm/registry.py region name of this worker's "
                  "slab; empty = the plane is off in this process"),
+        "doorbell_fd": Field(
+            "int", -1, min=-1,
+            desc="worker-side only (injected into derived configs): "
+                 "inherited eventfd number of this lane's doorbell "
+                 "(crosses exec via pass_fds); -1 = no doorbell "
+                 "(hub in poll mode)"),
+        "pin_core": Field(
+            "int", -1, min=-1,
+            desc="worker-side only (injected into derived configs): "
+                 "the core this lane pins to, derived from "
+                 "shm.pin_cores; -1 = unpinned"),
     },
     "dashboard": {
         "listen_port": Field("int", 18083),

@@ -189,6 +189,8 @@ class NodeRuntime:
                 slot_bytes=int(self.conf.get("shm.slot_bytes")),
                 timeout=float(self.conf.get("shm.timeout")),
                 min_batch=self.conf.get("engine.min_batch"),
+                doorbell_fd=int(self.conf.get("shm.doorbell_fd")),
+                pin_core=int(self.conf.get("shm.pin_core")),
             )
         else:
             from .models.engine import TopicMatchEngine

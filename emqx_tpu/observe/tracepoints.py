@@ -132,6 +132,14 @@ KNOWN_KINDS: Dict[str, str] = {
     "shm.hub_stale": "hub heartbeat went stale: the worker fell back "
                      "to all-local matching (shm_hub_degraded alarm "
                      "raises off the same observation)",
+    "shm.ack_shed": "hub shed queued churn acks for a worker whose "
+                    "result ring stayed full past 4x ring depth (the "
+                    "stuck-worker tell before its eventual "
+                    "re-register)",
+    "shm.credit": "a lane hit its per-pass drain credit "
+                  "(shm.lane_credit) with records still queued; the "
+                  "surplus carries over round-robin so siblings are "
+                  "not starved",
 }
 
 

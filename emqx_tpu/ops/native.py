@@ -55,6 +55,7 @@ _SRCS = [
     os.path.join(_SRC_DIR, "churn.cc"),
     os.path.join(_SRC_DIR, "prep.cc"),
     os.path.join(_SRC_DIR, "bcrypt.cc"),
+    os.path.join(_SRC_DIR, "drain.cc"),
 ]
 _PYMOD_SRC = os.path.join(_SRC_DIR, "pymod.cc")
 _HDRS = [os.path.join(_SRC_DIR, "pool.h"), os.path.join(_SRC_DIR, "match_core.h")]
@@ -236,6 +237,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.etpu_prep_rows.restype = None
     lib.etpu_prep_rows.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, _u32p, _u32p, _i32p, _u8p,
+    ]
+    lib.etpu_drain_wait.restype = ctypes.c_int32
+    lib.etpu_drain_wait.argtypes = [
+        _i32p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint64),
     ]
     lib.etpu_bcrypt_init.restype = None
     lib.etpu_bcrypt_init.argtypes = [_u32p]
@@ -1038,3 +1044,20 @@ def bulk_place_slots(key_a: np.ndarray, key_b: np.ndarray, val: np.ndarray,
         out_slots.ctypes.data_as(_i32p),
     )
     return n, out_slots
+
+
+def drain_wait(fds: List[int], timeout_ms: int):
+    """Block (GIL released by ctypes) until any doorbell fd is readable,
+    read-clearing every ready eventfd.  Returns (ready_count, ready_mask)
+    — count 0 on timeout, -1 on error — or None when the lib is absent
+    (the drain thread falls back to select.poll)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "etpu_drain_wait"):
+        return None
+    n = len(fds)
+    arr = (ctypes.c_int32 * max(n, 1))(*fds)
+    mask = ctypes.c_uint64(0)
+    rc = lib.etpu_drain_wait(
+        ctypes.cast(arr, _i32p), n, timeout_ms, ctypes.byref(mask)
+    )
+    return int(rc), int(mask.value)

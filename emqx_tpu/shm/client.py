@@ -35,6 +35,7 @@ serves its own deep filters from the trie on every tick.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -49,9 +50,10 @@ from ..observe.flight import PATH_DEVICE, PATH_HOST, LatencyHistogram
 from ..observe.tracepoints import tp
 from ..ops.prep import TopicPrep
 from . import registry
+from .doorbell import Doorbell
 from .rings import (
-    C_HUB_GEN, C_WORKER_GEN, K_CHURN, K_CHURN_ACK, K_HELLO, K_MATCH,
-    K_MATCH_RES, SlabView,
+    C_HUB_GEN, C_HUB_WAIT, C_WORKER_GEN, K_CHURN, K_CHURN_ACK, K_HELLO,
+    K_MATCH, K_MATCH_RES, SlabView,
 )
 
 R_FORCED = 5  # matches models.engine R_FORCED (flight reason code)
@@ -84,8 +86,25 @@ class ShmMatchEngine:
 
     def __init__(self, space, region: str, slots: int, slot_bytes: int,
                  timeout: float = 0.05, min_batch: int = 64,
-                 use_native: bool = True, attach_retry_s: float = 5.0):
+                 use_native: bool = True, attach_retry_s: float = 5.0,
+                 doorbell_fd: Optional[int] = None,
+                 pin_core: Optional[int] = None):
         self.space = space
+        # hub-created doorbell inherited through pass_fds: rung after a
+        # submit-ring publish, but only when the hub armed C_HUB_WAIT —
+        # the flat-out path never pays the write() syscall
+        self._db: Optional[Doorbell] = (
+            Doorbell.open(doorbell_fd)
+            if doorbell_fd is not None and doorbell_fd >= 0 else None
+        )
+        if pin_core is not None and pin_core >= 0:
+            # lane pinning (shm.pin_cores): process-wide — every thread
+            # this worker spawns inherits the mask; advisory like the
+            # hub's drain-thread pin
+            try:
+                os.sched_setaffinity(0, {int(pin_core)})
+            except (AttributeError, OSError, ValueError):  # pragma: no cover
+                pass
         self.verify_matches = True
         self.pipeline_depth = 4  # advisory (the hub owns the window)
         self.flight = None  # node wires a FlightRecorder (or None)
@@ -152,6 +171,17 @@ class ShmMatchEngine:
         self.shm_reregisters = 0
         self._attach()
 
+    # ---------------------------------------------------------- doorbell
+
+    def _ring_hub(self) -> None:
+        """Wake the hub's drain thread if (and only if) it is parked:
+        the armed word is stored by the hub just before it blocks and
+        cleared when it drains, so a busy hub costs no syscall here.  A
+        commit racing the arm is covered hub-side (post-arm ring
+        recheck + the eventfd being level-triggered)."""
+        if self._db is not None and int(self._slab.ctrl[C_HUB_WAIT]):
+            self._db.ring()
+
     # ------------------------------------------------------------ attach
 
     def _attach(self) -> None:
@@ -170,6 +200,7 @@ class ShmMatchEngine:
             w = self._slab.submit.reserve()
             if w is not None:  # ring just reset: cannot actually be full
                 w.commit(K_HELLO, self._gen, gen=self._gen)
+        self._ring_hub()
 
     def _reregister(self) -> None:
         """Hub restarted (generation bump): replay the whole local
@@ -270,6 +301,7 @@ class ShmMatchEngine:
                     pay[len(ab):need] = np.frombuffer(rb, np.uint8)
                 w.commit(K_CHURN, seq, a=len(ab), b=len(rb),
                          nbytes=need, gen=self._gen)
+            self._ring_hub()
             self._unsent.pop(0)
             if a_chunk:
                 self._pending_churn[seq] = list(a_chunk)
@@ -414,6 +446,7 @@ class ShmMatchEngine:
                                  c=res.L,
                                  nbytes=res.B * (2 * res.L + 2) * 4,
                                  gen=self._gen, t0=t_sub)
+                        self._ring_hub()
                         mode = "shm"
                         self.shm_submits += 1
                     else:  # batch too deep/wide for a slot

@@ -1,0 +1,107 @@
+"""Doorbell primitive for the shm match plane (hub wakeup on commit).
+
+One eventfd per lane, created HUB-SIDE next to the lane's slab and
+handed to the worker subprocess through ``pass_fds`` (fd numbers are
+preserved across fork+exec, so the integer in the derived config is the
+fd in the child).  The worker rings it after publishing a submit-ring
+record *when the hub has armed the lane* (``C_HUB_WAIT`` ctrl word) and
+the hub's drain thread blocks in one poll(2) across every lane fd —
+see ``native/drain.cc`` and ``MatchService``.
+
+eventfd is level-triggered for poll: a ring that lands between the
+hub's post-arm recheck and its poll() entry still wakes it.  The
+counter is read-cleared by the waiter; rings are coalesced by the
+kernel (the counter just accumulates), so a flooding worker costs one
+wakeup, not one per commit.
+
+Hosts without ``os.eventfd`` (non-Linux; Python < 3.10) fall back to a
+self-pipe — same poll semantics, one byte per ring, drained in bulk.
+
+The ``tools/analysis`` shm-blessing pass pins eventfd construction to
+this package, the same discipline as the SharedMemory ctor lint: a
+doorbell anywhere else is a new unaudited cross-process channel.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_HAS_EVENTFD = hasattr(os, "eventfd")
+
+
+class Doorbell:
+    """One wakeup channel: ``ring()`` on the producer side, ``fd`` given
+    to poll/``etpu_drain_wait`` and ``clear()`` on the waiter side.
+
+    ``Doorbell()`` creates the underlying eventfd (hub side, one per
+    lane); ``Doorbell.open(fd)`` wraps an inherited fd (worker side) —
+    the wrap does NOT own a pipe read end, so ``close()`` on the open
+    side closes only what it was given.
+    """
+
+    __slots__ = ("fd", "_rd", "_owned")
+
+    def __init__(self, fd: Optional[int] = None, rd: Optional[int] = None,
+                 _create: bool = True):
+        if not _create:
+            self.fd = fd  # type: ignore[assignment]
+            self._rd = rd if rd is not None else fd
+            self._owned = False
+            return
+        if _HAS_EVENTFD:
+            self.fd = os.eventfd(0, os.EFD_NONBLOCK | os.EFD_CLOEXEC)
+            self._rd = self.fd  # eventfd: one fd, both directions
+        else:  # pragma: no cover - non-Linux fallback
+            r, w = os.pipe()
+            os.set_blocking(r, False)
+            os.set_blocking(w, False)
+            self.fd = w       # producer writes here
+            self._rd = r      # waiter polls/drains here
+        self._owned = True
+
+    @classmethod
+    def open(cls, fd: int) -> "Doorbell":
+        """Wrap an inherited doorbell fd (worker side, from pass_fds)."""
+        return cls(fd=fd, _create=False)
+
+    @property
+    def wait_fd(self) -> int:
+        """The fd the waiter polls (== ``fd`` for eventfd)."""
+        return self._rd
+
+    def ring(self) -> None:
+        """Producer-side wakeup; never blocks, never raises on a dead
+        waiter (the degrade ladder owns that detection)."""
+        try:
+            if _HAS_EVENTFD:
+                os.eventfd_write(self.fd, 1)
+            else:  # pragma: no cover - non-Linux fallback
+                os.write(self.fd, b"\x01")
+        except (OSError, ValueError):
+            pass  # full pipe / closed fd: the wakeup is already pending
+
+    def clear(self) -> None:
+        """Waiter-side read-clear (the native path clears inline)."""
+        try:
+            if _HAS_EVENTFD:
+                os.eventfd_read(self._rd)
+            else:  # pragma: no cover - non-Linux fallback
+                while os.read(self._rd, 512):
+                    pass
+        except (BlockingIOError, OSError, ValueError):
+            pass
+
+    def close(self) -> None:
+        if not self._owned:
+            return
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+        if self._rd != self.fd:  # pragma: no cover - pipe fallback
+            try:
+                os.close(self._rd)
+            except OSError:
+                pass
+        self._owned = False

@@ -63,6 +63,13 @@ C_SUB_TAIL = 5       # submit ring: hub-owned consumer cursor
 C_RES_HEAD = 6       # result ring: hub-owned producer cursor
 C_RES_TAIL = 7       # result ring: worker-owned consumer cursor
 C_CHURN_APPLIED = 8  # highest worker churn seq the hub has applied
+C_HUB_WAIT = 9       # doorbell armed word: hub stores 1 before blocking on
+#                      the lane's eventfd, 0 while actively draining — the
+#                      worker only pays the wakeup write() syscall when the
+#                      hub is (about to be) asleep.  The hub re-checks the
+#                      rings AFTER arming, so a commit that races the store
+#                      is either seen by that re-check or rings the
+#                      level-triggered fd before poll() parks.
 
 MAGIC = 0x45545055_00000001  # "ETPU" | layout version
 

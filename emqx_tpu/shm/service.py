@@ -1,5 +1,5 @@
-"""Hub-side match service: drains every worker's submit ring on the
-hub event loop and feeds the ONE device engine.
+"""Hub-side match service: event-driven drain engine over every
+worker's submit ring, feeding the ONE device engine.
 
 The service owns the slabs (created through :class:`ShmRegistry` before
 the workers spawn) and runs as a single asyncio task on the hub loop,
@@ -7,6 +7,34 @@ so every engine mutation — churn application AND match dispatch — stays
 on the loop thread, preserving the engines' single-mutator contract.
 Only the device-sync half of a dispatch (`foreign_collect`) runs on the
 default executor, mirroring how the broker's own collects block.
+
+Wakeup (``shm.drain``): instead of the v1 fixed-cadence poll, the hub
+blocks on per-lane DOORBELLS — one eventfd per lane that the worker
+rings on slot commit (only when the hub armed the lane's ``C_HUB_WAIT``
+ctrl word, so the busy path pays no syscall).  The block happens on a
+dedicated single-thread executor so the loop sleeps for real: the
+waiter calls ``etpu_drain_wait`` (native poll(2) over all lane fds,
+GIL released; mode ``native``) or ``select.poll`` (mode ``thread``),
+in ~100 ms slices that stamp the hub heartbeat so workers never see a
+stale hub mid-wait, returning every ~1 s for housekeeping (worker-gen
+reclaim, ack retries) even if no doorbell ever rings.  ``auto`` picks
+native when the lib is present; ``poll`` keeps the v1 asyncio loop
+(``shm.poll_interval`` cadence) as the portable fallback.  Idle hub
+wakeups drop from ~1/poll_interval to ~1/s.
+
+Fusion (``shm.fuse_window_us``): when >= 2 lanes are hot (a match
+drained within the last 10 ms), a pass whose harvest did not include
+every hot lane waits one fusion window and re-drains before
+dispatching, so cross-worker ticks coalesce into one device call.  The
+window collapses to zero with a single hot lane — p50 never pays for
+fusion nobody gets.
+
+Fairness (``shm.lane_credit``): each pass consumes at most
+``lane_credit`` records per lane, lanes walked in rotating round-robin
+order; a flooding worker leaves its surplus in its own ring (per-ring
+order preserved — the tail never skips) and the pass immediately
+re-runs, so siblings are never starved behind one hot ring
+(exhaustions counted + ``shm.credit`` traced).
 
 Drain is three-phase per pass, preserving each ring's record order:
 
@@ -31,6 +59,9 @@ worker's tick times out to its local trie.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
+import os
+import select
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -38,22 +69,59 @@ import numpy as np
 
 from ..observe.flight import LatencyHistogram
 from ..observe.tracepoints import tp
+from ..ops import native
+from .doorbell import Doorbell
 from .registry import ShmRegistry
 from .rings import (
-    C_HUB_GEN, C_HUB_HB, C_MAGIC, C_CHURN_APPLIED, K_CHURN, K_HELLO,
-    K_MATCH, K_CHURN_ACK, K_MATCH_RES, MAGIC, SlabView, slab_bytes,
+    C_HUB_GEN, C_HUB_HB, C_HUB_WAIT, C_MAGIC, C_CHURN_APPLIED, K_CHURN,
+    K_HELLO, K_MATCH, K_CHURN_ACK, K_MATCH_RES, MAGIC, SlabView,
+    slab_bytes,
 )
 
 GROUP_SIZES = (4, 2, 1)  # same ladder as the sharded coalescer
+
+HOT_NS = 10_000_000      # lane hot = match drained within the last 10 ms
+_HB_SLICE_S = 0.1        # mid-wait heartbeat stamp cadence
+_HOUSEKEEP_S = 1.0       # max block before a housekeeping pass
+_ACK_RETRY_S = 0.005     # wait cap while churn acks are queued
+
+
+def parse_cores(spec: str) -> List[int]:
+    """Parse a ``shm.pin_cores`` spec ("0-3", "0,2,5", mixes) into a
+    core list; empty/invalid pieces are dropped (pinning is advisory)."""
+    cores: List[int] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            if "-" in part:
+                lo, hi = part.split("-", 1)
+                cores.extend(range(int(lo), int(hi) + 1))
+            else:
+                cores.append(int(part))
+        except ValueError:
+            continue
+    return [c for c in cores if c >= 0]
+
+
+def _pin_thread(core: int) -> None:
+    """Pin the CURRENT thread (advisory: failures are silent — a cgroup
+    mask narrower than the spec must not kill the drain engine)."""
+    try:
+        os.sched_setaffinity(0, {core})
+    except (AttributeError, OSError, ValueError):  # pragma: no cover
+        pass
 
 
 class LaneState:
     """One worker's slab plus the hub's bookkeeping for it."""
 
     __slots__ = ("idx", "slab", "gen", "filters", "res_lk",
-                 "pending_acks")
+                 "pending_acks", "doorbell", "last_match_ns")
 
-    def __init__(self, idx: int, slab: SlabView):
+    def __init__(self, idx: int, slab: SlabView,
+                 doorbell: Optional[Doorbell] = None):
         self.idx = idx
         self.slab = slab
         self.gen = slab.worker_gen
@@ -65,6 +133,11 @@ class LaneState:
         # next tick), a lost ack would leave the worker's fid mapping
         # un-acked FOREVER, so these retry every drain pass
         self.pending_acks: List[Tuple[int, List[int]]] = []
+        # wakeup channel the worker rings on commit (hub-created; the
+        # fd crosses to the worker via pass_fds + shm.doorbell_fd)
+        self.doorbell = doorbell
+        # when the lane last had a match drained (fusion hot-tracking)
+        self.last_match_ns = 0
 
 
 class _MatchReq:
@@ -90,12 +163,22 @@ class MatchService:
     """Single hub-side drain loop over all worker lanes."""
 
     def __init__(self, engine, reg: ShmRegistry, slots: int,
-                 slot_bytes: int, poll_interval: float = 0.002):
+                 slot_bytes: int, poll_interval: float = 0.002,
+                 drain: str = "auto", fuse_window_us: int = 0,
+                 lane_credit: int = 64, pin_cores: str = ""):
         self.engine = engine
         self.reg = reg
         self.slots = slots
         self.slot_bytes = slot_bytes
         self.poll_interval = float(poll_interval)
+        self.drain = drain                  # auto | native | thread | poll
+        # resolved at start(); the drain thread only ever DOWNGRADES it
+        # to "thread" when the native lib vanishes mid-run — a str swap
+        # is atomic under the GIL and both readers tolerate either value
+        self.drain_mode = ""  # analysis: owner=any
+        self.fuse_window_us = int(fuse_window_us)
+        self.lane_credit = int(lane_credit)
+        self.pin_cores = parse_cores(pin_cores)
         self.lanes: Dict[int, LaneState] = {}
         # lifecycle state is loop-owned: mutated only here (before the
         # object is shared) and in start()/stop(), which run on the
@@ -103,6 +186,13 @@ class MatchService:
         self._task: Optional[asyncio.Task] = None  # analysis: owner=loop
         self._replies: set = set()  # in-flight _collect_reply tasks
         self._stop = False  # analysis: owner=loop
+        # doorbell wait machinery (modes native/thread): the dedicated
+        # drain thread + the stop doorbell that unparks it at stop().
+        # Both are set once in start() BEFORE the drain thread exists
+        # and cleared only after _exec.shutdown(wait=True) joins it —
+        # the thread never observes a mutation
+        self._exec: Optional[concurrent.futures.ThreadPoolExecutor] = None  # analysis: owner=any
+        self._stop_db: Optional[Doorbell] = None  # analysis: owner=any
         # counters (supervisor mirrors these into broker metrics)
         self.match_ticks = 0
         self.match_groups = 0
@@ -110,7 +200,22 @@ class MatchService:
         self.churn_filters = 0
         self.reclaims = 0
         self.res_drops = 0
+        self.ack_sheds = 0        # churn acks shed by _flush_acks
         self.errors = 0
+        # drain-engine telemetry: passes that found work vs not, how
+        # the loop was woken, credit exhaustions, fusion-window waits
+        self.drain_passes = 0
+        self.idle_passes = 0
+        # the wake-cause pair is bumped on the drain thread (the loop is
+        # parked in run_in_executor while it runs) and read loop-side for
+        # stats — int += is GIL-atomic and a torn read is just a stat
+        self.doorbell_wakeups = 0  # analysis: owner=any
+        self.wait_timeouts = 0  # analysis: owner=any  (housekeeping returns)
+        self.credit_exhausted = 0
+        self.fuse_waits = 0
+        self._more = False         # credit carryover: re-pass immediately
+        self._hot_count = 0        # lanes with a match in the last HOT_NS
+        self._rr = 0               # round-robin lane-walk rotation
         # drain/fusion telemetry (fleet observability plane): the
         # adaptive-fusion controller (ROADMAP item 1) consumes exactly
         # these — how often the drain loop actually turns, and how much
@@ -134,9 +239,25 @@ class MatchService:
         slab.ctrl[C_MAGIC] = MAGIC
         slab.ctrl[C_HUB_GEN] += 1
         slab.ctrl[C_CHURN_APPLIED] = 0
+        slab.ctrl[C_HUB_WAIT] = 0
         slab.ctrl[C_HUB_HB] = time.monotonic_ns()
-        self.lanes[idx] = LaneState(idx, slab)
+        prev = self.lanes.get(idx)
+        db = prev.doorbell if prev is not None else Doorbell()
+        self.lanes[idx] = LaneState(idx, slab, db)
         return self.reg.names[f"lane{idx}"]
+
+    def doorbell_fd(self, idx: int) -> int:
+        """Worker-side (ring) fd of lane `idx`'s doorbell — the integer
+        the supervisor passes through pass_fds + ``shm.doorbell_fd``."""
+        return self.lanes[idx].doorbell.fd
+
+    def lane_core(self, idx: int) -> Optional[int]:
+        """The core lane `idx`'s worker should pin to under
+        ``shm.pin_cores`` (first core is the drain thread's), or None."""
+        if len(self.pin_cores) < 2:
+            return None
+        rest = self.pin_cores[1:]
+        return rest[idx % len(rest)]
 
     def _drop_lane_filters(self, lane: LaneState, why: str) -> None:
         # queued acks address the dead incarnation's churn seqs, which
@@ -205,15 +326,18 @@ class MatchService:
         up; a subscribe burst (bulk add_filters) produces acks faster
         than the worker drains them, and they must all land eventually.
         Bounded: a worker that stops draining its ring entirely sheds
-        the oldest acks past 4x ring depth (counted in res_drops) and
-        recovers them through a re-register."""
+        the oldest acks past 4x ring depth (counted in ack_sheds —
+        `shm.hub.ack_shed`, the stuck-worker tell BEFORE the eventual
+        re-register) and recovers them through that re-register."""
         while lane.pending_acks:
             w = lane.slab.result.reserve()
             if w is None:
                 over = len(lane.pending_acks) - 4 * self.slots
                 if over > 0:
                     del lane.pending_acks[:over]
-                    self.res_drops += over
+                    self.ack_sheds += over
+                    tp("shm.ack_shed", lane=lane.idx, shed=over,
+                       queued=len(lane.pending_acks))
                 return
             seq, fids = lane.pending_acks[0]
             arr = np.asarray(fids, np.int64)
@@ -226,19 +350,42 @@ class MatchService:
     def _drain_once(self) -> Tuple[int, List[_MatchReq]]:
         """Phase 1+3: walk every lane's published records in order,
         applying churn inline and COPYING match payloads, then advance
-        the tails so the slots recycle immediately."""
+        the tails so the slots recycle immediately.
+
+        Fairness: lanes are walked in rotating round-robin order and
+        each lane yields at most ``lane_credit`` records per pass; the
+        surplus stays IN the ring (the tail only ever advances over
+        consumed records, so per-ring order holds) and ``self._more``
+        flags the loop to re-pass immediately instead of sleeping —
+        the flooding lane carries over, the siblings go first."""
         reqs: List[_MatchReq] = []
         consumed = 0
-        # span-leg drain stamp: one clock read per pass, and only when
-        # some record actually carries a submit stamp (armed workers)
-        now_ns = 0
-        for lane in self.lanes.values():
+        self._more = False
+        now_ns = time.monotonic_ns()  # one clock read per pass: span
+        #   drain stamps + fusion hot-tracking share it
+        order = list(self.lanes.values())
+        if len(order) > 1:
+            rot = self._rr % len(order)
+            self._rr += 1
+            order = order[rot:] + order[:rot]
+        credit = self.lane_credit if self.lane_credit > 0 else 0
+        for lane in order:
             self._check_worker_gen(lane)
             if lane.pending_acks:  # ring-full leftovers from last pass
                 self._flush_acks(lane)
             ring = lane.slab.submit
             k = 0
+            taken = 0
             while True:
+                if credit and taken >= credit:
+                    if ring.peek_at(k) is not None:
+                        # surplus carries over; force an immediate
+                        # re-pass so the flooder still drains flat out
+                        self._more = True
+                        self.credit_exhausted += 1
+                        tp("shm.credit", lane=lane.idx,
+                           left=ring.depth - k)
+                    break
                 rec = ring.peek_at(k)
                 if rec is None:
                     break
@@ -252,18 +399,28 @@ class MatchService:
                 elif rec.kind == K_MATCH:
                     pay = rec.payload[: rec.nbytes].view(np.uint32)
                     buf = pay.reshape(rec.b, 2 * rec.c + 2).copy()
-                    t_drain = 0
-                    if rec.ts[0]:
-                        if not now_ns:
-                            now_ns = time.monotonic_ns()
-                        t_drain = now_ns
+                    lane.last_match_ns = now_ns
                     reqs.append(_MatchReq(lane, rec.tick, rec.a,
-                                          rec.b, rec.c, buf, t_drain))
+                                          rec.b, rec.c, buf,
+                                          now_ns if rec.ts[0] else 0))
                 k += 1
+                taken += 1
             if k:
                 ring.advance(k)
                 consumed += k
+        self._hot_count = sum(
+            1 for lane in self.lanes.values()
+            if now_ns - lane.last_match_ns < HOT_NS and lane.last_match_ns
+        )
         return consumed, reqs
+
+    def _effective_window_s(self) -> float:
+        """The adaptive fusion window: ``shm.fuse_window_us`` while >= 2
+        lanes are hot, collapsed to zero for a lone talker (fusion can
+        only ever pair ticks from DIFFERENT lanes)."""
+        if self.fuse_window_us <= 0 or self._hot_count < 2:
+            return 0.0
+        return self.fuse_window_us / 1e6
 
     def _dispatch(self, reqs: List[_MatchReq]) -> None:
         """Phase 2: group by geometry and fuse cross-worker ticks into
@@ -341,36 +498,147 @@ class MatchService:
 
     # -------------------------------------------------------------- loop
 
+    async def _pass(self) -> int:
+        """One drain pass + fusion window + dispatch; returns records
+        consumed.  Sets ``self._more`` when credit left surplus."""
+        consumed, reqs = self._drain_once()
+        if reqs:
+            window = self._effective_window_s()
+            if window > 0:
+                hit = {r.lane.idx for r in reqs}
+                if len(hit) < self._hot_count:
+                    # some hot lane missed this harvest: hold dispatch
+                    # one window so its in-flight tick fuses in
+                    self.fuse_waits += 1
+                    await asyncio.sleep(window)
+                    c2, r2 = self._drain_once()
+                    consumed += c2
+                    reqs += r2
+            self._dispatch(reqs)
+        return consumed
+
     async def _run(self) -> None:
         last_ns = 0
+        evented = self.drain_mode in ("native", "thread")
         while not self._stop:
             now = time.monotonic_ns()
             # drain-cycle gap: the cadence the submit rings are
-            # actually polled at (back-to-back under load, ~poll_
-            # interval idle) — the upper bound any ring_wait leg pays
+            # actually drained at (back-to-back under load; idle gaps
+            # are wakeup-bounded) — the upper bound any ring_wait pays
             if last_ns:
                 self.hist_drain.observe((now - last_ns) / 1e9)
             last_ns = now
             for lane in self.lanes.values():
                 lane.slab.ctrl[C_HUB_HB] = now
+            self.drain_passes += 1
             try:
-                consumed, reqs = self._drain_once()
-                if reqs:
-                    self._dispatch(reqs)
+                consumed = await self._pass()
+            except asyncio.CancelledError:
+                raise
             except Exception:  # pragma: no cover - keep the hub alive
                 self.errors += 1
                 consumed = 0
-            if consumed:
+            if consumed or self._more:
                 await asyncio.sleep(0)  # busy: yield and come right back
+                continue
+            self.idle_passes += 1
+            if evented:
+                await self._block_on_doorbells()
             else:
                 await asyncio.sleep(self.poll_interval)
 
+    # ---------------------------------------------------------- doorbells
+
+    async def _block_on_doorbells(self) -> None:
+        """Idle path: arm every lane's doorbell word, recheck the rings
+        (a commit racing the arm is visible now or rings the level-
+        triggered fd), then park on the dedicated drain thread."""
+        for lane in self.lanes.values():
+            lane.slab.ctrl[C_HUB_WAIT] = 1
+        try:
+            for lane in self.lanes.values():
+                if lane.slab.submit.depth:
+                    return
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(self._exec, self._wait_block)
+        finally:
+            for lane in self.lanes.values():
+                lane.slab.ctrl[C_HUB_WAIT] = 0
+
+    def _wait_block(self) -> None:
+        """Runs ON the drain thread: block across all lane doorbells +
+        the stop doorbell in ~100 ms slices, stamping the hub heartbeat
+        each slice so a blocked hub never looks dead to its workers;
+        returns on any doorbell, on stop, or after ~1 s housekeeping
+        (sooner when churn acks are queued for retry)."""
+        lanes = list(self.lanes.values())
+        fds = [ln.doorbell.wait_fd for ln in lanes]
+        fds.append(self._stop_db.wait_fd)
+        bound = _ACK_RETRY_S if any(ln.pending_acks for ln in lanes) \
+            else _HOUSEKEEP_S
+        deadline = time.monotonic() + bound
+        while not self._stop:
+            ns = time.monotonic_ns()
+            for ln in lanes:
+                ln.slab.ctrl[C_HUB_HB] = ns
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                self.wait_timeouts += 1
+                return
+            slice_ms = max(int(min(remain, _HB_SLICE_S) * 1000), 1)
+            if self._wait_slice(fds, slice_ms):
+                self.doorbell_wakeups += 1
+                return
+
+    def _wait_slice(self, fds: List[int], timeout_ms: int) -> int:
+        """One bounded wait over the doorbell fds; ready fds are
+        read-cleared.  Native when the lib is live, select.poll else."""
+        if self.drain_mode == "native":
+            out = native.drain_wait(fds, timeout_ms)
+            if out is not None:
+                rc, _mask = out
+                return max(rc, 0)
+            # lib vanished mid-run (rebuild race): degrade to poll()
+            self.drain_mode = "thread"
+        p = select.poll()
+        for fd in fds:
+            p.register(fd, select.POLLIN)
+        ready = p.poll(timeout_ms)
+        for fd, _ev in ready:
+            try:
+                os.read(fd, 8)  # eventfd read-clear
+            except (BlockingIOError, OSError):
+                pass
+        return len(ready)
+
+    def _resolve_drain_mode(self) -> str:
+        m = self.drain
+        if m == "auto":
+            m = "native" if native.available() else "thread"
+        if m == "native" and native.drain_wait([], 0) is None:
+            m = "thread"  # requested native, lib absent: thread fallback
+        return m
+
+    # ---------------------------------------------------------- lifecycle
+
     def start(self) -> None:
         self._stop = False
+        self.drain_mode = self._resolve_drain_mode()
+        if self.drain_mode in ("native", "thread"):
+            self._stop_db = Doorbell()
+            self._exec = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="shm-drain"
+            )
+            if self.pin_cores:
+                # pin the drain thread to the first spec'd core (the
+                # single worker thread serves every _wait_block call)
+                self._exec.submit(_pin_thread, self.pin_cores[0])
         self._task = asyncio.get_event_loop().create_task(self._run())
 
     async def stop(self) -> None:
         self._stop = True
+        if self._stop_db is not None:
+            self._stop_db.ring()  # unpark a blocked _wait_block
         if self._task is not None:
             self._task.cancel()
             try:
@@ -378,6 +646,12 @@ class MatchService:
             except (asyncio.CancelledError, Exception):
                 pass
             self._task = None
+        if self._exec is not None:
+            self._exec.shutdown(wait=True)
+            self._exec = None
+        if self._stop_db is not None:
+            self._stop_db.close()
+            self._stop_db = None
         # drain in-flight reply tasks: their executor collect may still
         # be running; waiting (not just cancelling) keeps slab teardown
         # in close() from racing a result write
@@ -392,6 +666,8 @@ class MatchService:
         # segment and turns its eventual GC into a BufferError
         for lane in self.lanes.values():
             lane.slab.close()
+            if lane.doorbell is not None:
+                lane.doorbell.close()
         self.lanes.clear()
         self.reg.close_all(unlink=unlink)
 
@@ -410,6 +686,7 @@ class MatchService:
         return out
 
     def stats(self) -> Dict[str, object]:
+        fused = sum(n for k, n in self.group_sizes.items() if k > 1)
         out = {
             "lanes": len(self.lanes),
             "ticks": self.match_ticks,
@@ -418,8 +695,20 @@ class MatchService:
             "churn_filters": self.churn_filters,
             "reclaims": self.reclaims,
             "res_drops": self.res_drops,
+            "ack_sheds": self.ack_sheds,
             "errors": self.errors,
             "group_sizes": dict(self.group_sizes),
+            "drain_mode": self.drain_mode or self.drain,
+            "drain_passes": self.drain_passes,
+            "idle_passes": self.idle_passes,
+            "doorbell_wakeups": self.doorbell_wakeups,
+            "wait_timeouts": self.wait_timeouts,
+            "credit_exhausted": self.credit_exhausted,
+            "fuse_waits": self.fuse_waits,
+            # fused share: dispatches that coalesced >1 tick — the
+            # number the adaptive window exists to move
+            "fused_share": (fused / self.match_groups
+                            if self.match_groups else 0.0),
         }
         if self.hist_drain.count:
             out["drain_cycle_ms"] = self.hist_drain.percentiles_ms()

@@ -122,6 +122,9 @@ class WireSupervisor:
         self._stats_task: Optional[asyncio.Task] = None
         self._hk_task: Optional[asyncio.Task] = None
         self._stopping = False
+        # idle-wakeup rate sampling state (shm.hub.idle_wakeup_rate)
+        self._last_idle = 0
+        self._last_idle_t = 0.0
 
     # ------------------------------------------------------------ config
 
@@ -135,14 +138,17 @@ class WireSupervisor:
             from ..shm import ShmRegistry
             from ..shm.service import MatchService
 
+            conf = self.runtime.conf
             self.service = MatchService(
                 self.runtime.broker.engine,
                 ShmRegistry(self.ipc_dir),
                 slots=self.shm_slots,
                 slot_bytes=self.shm_slot_bytes,
-                poll_interval=float(
-                    self.runtime.conf.get("shm.poll_interval")
-                ),
+                poll_interval=float(conf.get("shm.poll_interval")),
+                drain=str(conf.get("shm.drain")),
+                fuse_window_us=int(conf.get("shm.fuse_window_us")),
+                lane_credit=int(conf.get("shm.lane_credit")),
+                pin_cores=str(conf.get("shm.pin_cores")),
             )
         for i in range(self.n):
             self.workers[i] = WorkerHandle(
@@ -259,6 +265,16 @@ class WireSupervisor:
                 "slot_bytes": self.shm_slot_bytes,
                 "timeout": conf.get("shm.timeout"),
             }
+            if self.service is not None:
+                if str(conf.get("shm.drain")) != "poll":
+                    # the doorbell eventfd crosses exec via pass_fds
+                    # (fd number preserved), so the child can open the
+                    # same integer it reads from its derived config
+                    base["shm"]["doorbell_fd"] = \
+                        self.service.doorbell_fd(h.idx)
+                core = self.service.lane_core(h.idx)
+                if core is not None:
+                    base["shm"]["pin_core"] = core
             base["engine"] = dict(base.get("engine") or {})
             base["engine"]["ckpt.enable"] = False
         return base
@@ -309,6 +325,11 @@ class WireSupervisor:
 
             env["EMQX_TPU_JAX_PLATFORM"] = jax.default_backend()
         pass_fds = tuple(s.fileno() for s in self._shared_socks)
+        if self.service is not None and h.shm_region \
+                and str(self.runtime.conf.get("shm.drain")) != "poll":
+            # the lane's doorbell rides into the child alongside the
+            # shared listener fds; same fd on every respawn
+            pass_fds += (self.service.doorbell_fd(h.idx),)
         logf = open(
             os.path.join(self.ipc_dir, f"w{h.idx}.log"), "ab"
         )
@@ -525,7 +546,24 @@ class WireSupervisor:
                 c["shm.hub.churn_records"] = st["churn_records"]
                 c["shm.hub.reclaims"] = st["reclaims"]
                 c["shm.hub.res_drops"] = st["res_drops"]
+                c["shm.hub.ack_shed"] = st["ack_sheds"]
+                c["shm.hub.credit_exhausted"] = st["credit_exhausted"]
+                c["shm.hub.doorbell_wakeups"] = st["doorbell_wakeups"]
                 m.gauge_set("shm.lanes", float(st["lanes"]))
+                m.gauge_set("shm.hub.fused_share",
+                            float(st["fused_share"]))
+                # idle-wakeup rate: loop turns that found nothing, per
+                # second since the last scrape — ~1/poll_interval under
+                # the legacy poll loop, ~1/s parked on doorbells
+                now_m = time.monotonic()
+                if self._last_idle_t:
+                    dt = max(now_m - self._last_idle_t, 1e-9)
+                    m.gauge_set(
+                        "shm.hub.idle_wakeup_rate",
+                        max(st["idle_passes"] - self._last_idle, 0) / dt,
+                    )
+                self._last_idle = int(st["idle_passes"])
+                self._last_idle_t = now_m
                 # drain/fusion telemetry: cycle-gap p99 + mean fused
                 # group size (what the adaptive-fusion controller and
                 # the soak gates watch), plus per-lane ring health

@@ -1,0 +1,58 @@
+// Hub-side doorbell wait for the shared-memory match plane.
+//
+// The hub's drain thread blocks here (ctypes releases the GIL for the
+// duration) on one poll(2) across every lane's eventfd plus the stop
+// doorbell.  Workers ring their lane fd on slot commit; the 8-byte
+// counter read below clears the level-triggered state so the next wait
+// blocks again.  A bounded timeout keeps the housekeeping path (worker
+// generation checks, kill -9 reclaim, ack retries) alive even when no
+// doorbell ever rings.
+
+#include <cstdint>
+#include <cerrno>
+#include <poll.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Wait for any of n fds to become readable, then read-clear every ready
+// fd (eventfd semantics: one 8-byte read resets the counter).  Returns
+// the number of ready fds, 0 on timeout, -1 on error (errno preserved
+// by the caller being in-process).  ready_mask (optional, may be null)
+// gets bit i set when fds[i] rang — the hub uses it to mark hot lanes
+// without a full-ring scan.
+int32_t etpu_drain_wait(const int32_t* fds, int32_t n, int32_t timeout_ms,
+                        uint64_t* ready_mask) {
+    if (n <= 0 || n > 64) return -1;
+    struct pollfd pfds[64];
+    for (int32_t i = 0; i < n; ++i) {
+        pfds[i].fd = fds[i];
+        pfds[i].events = POLLIN;
+        pfds[i].revents = 0;
+    }
+    int rc;
+    do {
+        rc = poll(pfds, n, timeout_ms);
+    } while (rc < 0 && errno == EINTR);
+    if (rc <= 0) {
+        if (ready_mask) *ready_mask = 0;
+        return rc < 0 ? -1 : 0;
+    }
+    uint64_t mask = 0;
+    int32_t ready = 0;
+    for (int32_t i = 0; i < n; ++i) {
+        if (pfds[i].revents & (POLLIN | POLLERR | POLLHUP)) {
+            uint64_t buf;
+            // nonblocking read-clear; a worker that died between poll
+            // and read just leaves the counter unread (EAGAIN), fine.
+            ssize_t r = read(pfds[i].fd, &buf, sizeof(buf));
+            (void)r;
+            mask |= (uint64_t)1 << i;
+            ++ready;
+        }
+    }
+    if (ready_mask) *ready_mask = mask;
+    return ready;
+}
+
+}  // extern "C"

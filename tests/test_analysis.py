@@ -1287,6 +1287,41 @@ def test_shm_blessing_import_outside_enclave_flagged(tmp_path):
     assert all(f.severity == ERROR for f in got)
 
 
+def test_shm_blessing_eventfd_outside_enclave_flagged(tmp_path):
+    """eventfd doorbells are the wakeup half of the shm ring protocol:
+    constructing (or ringing/clearing) one outside emqx_tpu/shm/ is an
+    unreviewed wakeup path and errors — both the `os.eventfd` attr form
+    and the `from os import eventfd` bare-name form.  The enclave
+    itself and test/tool modules stay exempt."""
+    idx = build_fixture(tmp_path, {
+        "emqx_tpu/shm/doorbell.py": (
+            "import os\n"
+            "def make():\n"
+            "    return os.eventfd(0)\n"
+            "def ring(fd):\n"
+            "    os.eventfd_write(fd, 1)\n"
+        ),
+        "emqx_tpu/broker.py": (
+            "import os\n"
+            "def sneak():\n"
+            "    return os.eventfd(0)\n"
+        ),
+        "emqx_tpu/wire/worker.py": (
+            "from os import eventfd_write\n"
+            "def sneak2(fd):\n"
+            "    eventfd_write(fd, 1)\n"
+        ),
+    })
+    got = [f for f in roles.check_shm_blessing(idx)
+           if f.ident.split("->")[1].startswith("eventfd")]
+    mods = {f.ident.split("->")[0] for f in got}
+    assert "emqx_tpu.broker" in mods
+    assert "emqx_tpu.wire.worker" in mods
+    assert not any(m.startswith("emqx_tpu.shm") for m in mods)
+    assert all(f.severity == ERROR and f.code == "shm-blessing"
+               for f in got)
+
+
 def test_shm_ctor_outside_registry_flagged(tmp_path):
     """Even inside the blessed package, SharedMemory construction is
     pinned to shm/registry.py — region names, stale-segment adoption

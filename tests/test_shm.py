@@ -160,21 +160,28 @@ class _Plane:
     a client factory — the in-process analogue of supervisor + worker."""
 
     def __init__(self, scope, slots=SLOTS, slot_bytes=SLOT_BYTES,
-                 poll_interval=0.001):
+                 poll_interval=0.001, drain="auto", fuse_window_us=0,
+                 lane_credit=64):
         self.space = HashSpace()
         self.engine = TopicMatchEngine(space=self.space)
         self.reg = ShmRegistry(scope)
         self.svc = MatchService(self.engine, self.reg, slots=slots,
                                 slot_bytes=slot_bytes,
-                                poll_interval=poll_interval)
+                                poll_interval=poll_interval,
+                                drain=drain,
+                                fuse_window_us=fuse_window_us,
+                                lane_credit=lane_credit)
         self.slots = slots
         self.slot_bytes = slot_bytes
         self.loop = asyncio.new_event_loop()
         self._thread = None
         self.clients = []
+        self._lane_of = {}  # region -> lane idx (client() wires doorbells)
 
     def lane(self, idx):
-        return self.svc.create_lane(idx)
+        region = self.svc.create_lane(idx)
+        self._lane_of[region] = idx
+        return region
 
     def start(self):
         def run():
@@ -188,18 +195,29 @@ class _Plane:
     def client(self, region, timeout=60.0):
         # generous default: the FIRST hub tick of a geometry pays the
         # device compile; later ticks return in microseconds
+        idx = self._lane_of.get(region)
+        db_fd = self.svc.doorbell_fd(idx) if idx is not None else None
         c = ShmMatchEngine(space=self.space, region=region,
                            slots=self.slots, slot_bytes=self.slot_bytes,
-                           timeout=timeout)
+                           timeout=timeout, doorbell_fd=db_fd)
         self.clients.append(c)
         return c
 
     def kill_hub(self):
         """Hub "kill -9": stop the loop thread without any shutdown
-        protocol — heartbeat freezes, segments stay mapped."""
+        protocol — heartbeat freezes, segments stay mapped.  A real
+        kill -9 takes the drain thread down with the process, so the
+        doorbell waiter (which stamps the heartbeat mid-wait) is
+        reaped here too — without it the dead hub would look alive
+        for up to one housekeeping bound."""
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._thread.join(10)
         self._thread = None
+        if self.svc._exec is not None:
+            self.svc._stop = True
+            if self.svc._stop_db is not None:
+                self.svc._stop_db.ring()
+            self.svc._exec.shutdown(wait=True)
 
     def stop(self, unlink=True):
         if self._thread is not None:
@@ -668,3 +686,256 @@ def test_hub_drain_and_fusion_telemetry(tmp_path):
             assert d["submit_depth"] >= 0 and d["pending_acks"] == 0
     finally:
         plane.stop()
+
+
+# ------------------------------------------------- doorbell drain engine
+
+
+def test_drain_mode_resolves_and_poll_parity(tmp_path):
+    """`shm.drain: poll` keeps the legacy asyncio loop alive (exact
+    e2e parity with the doorbell suite above, which runs `auto`)."""
+    plane = _Plane(str(tmp_path), drain="poll")
+    region = plane.lane(0)
+    plane.start()
+    try:
+        assert plane.svc.drain_mode == "poll"
+        cli = plane.client(region)
+        oracle = CpuTrieIndex()
+        _seed(cli, oracle, n=10)
+        _wait(_acked(cli), timeout=10)
+        assert cli.match(TOPICS) == [oracle.match(t) for t in TOPICS]
+        assert cli.shm_submits >= 1 and cli.shm_local == 0
+    finally:
+        plane.stop()
+
+
+def _armed(plane, region_client):
+    """Predicate: the hub parked on its doorbells (lane armed word)."""
+    from emqx_tpu.shm.rings import C_HUB_WAIT
+
+    def pred():
+        return int(region_client._slab.ctrl[C_HUB_WAIT]) == 1
+    return pred
+
+
+def test_worker_kill9_while_hub_blocked_on_doorbell(tmp_path):
+    """Kill -9 a worker while the hub is PARKED on its doorbell: the
+    hub must not hang — the respawned incarnation's HELLO rings the
+    (still armed) doorbell, the hub wakes, reclaims the dead
+    incarnation's slots/filters, and serves the new one."""
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        # start() resolves the mode on the loop thread — wait for it
+        _wait(lambda: plane.svc.drain_mode != "", timeout=10)
+        assert plane.svc.drain_mode in ("native", "thread")
+        c1 = plane.client(region)
+        c1.add_filter("park/+")
+        _wait(_acked(c1), timeout=10)
+        # hub goes idle and parks (armed word set by the drain loop)
+        _wait(_armed(plane, c1), timeout=10)
+        # worker dies -9 mid-submit: odd-seq slots left behind, no
+        # commit, no doorbell — the hub stays parked (that's the point)
+        with c1._sub_lk:
+            assert c1._slab.submit.reserve() is not None
+        reclaims0 = plane.svc.reclaims
+        bells0 = plane.svc.doorbell_wakeups
+        c2 = plane.client(region)  # respawn: reset + HELLO + doorbell
+        oracle = CpuTrieIndex()
+        _seed(c2, oracle, n=6)
+        _wait(lambda: plane.svc.reclaims > reclaims0, timeout=10)
+        _wait(_acked(c2), timeout=10)
+        assert plane.svc.doorbell_wakeups > bells0  # it was truly parked
+        assert c2.match(["park/x"]) == [oracle.match("park/x")] == [set()]
+        got = c2.match(TOPICS)
+        assert got == [oracle.match(t) for t in TOPICS]
+        assert c2.shm_local == 0  # every tick rode the ring post-reclaim
+    finally:
+        plane.stop()
+
+
+def test_hub_death_mid_wait_degrades_worker(tmp_path):
+    """Hub killed while PARKED mid-wait: the heartbeat freezes (the
+    drain thread dies with the process) and the client's shm.timeout
+    degrade ladder fires — ticks serve from the local trie, zero lost
+    matches vs the oracle."""
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    oracle = CpuTrieIndex()
+    try:
+        cli = plane.client(region)
+        _seed(cli, oracle, n=8)
+        _wait(_acked(cli), timeout=10)
+        assert cli.match(TOPICS) == [oracle.match(t) for t in TOPICS]
+        _wait(_armed(plane, cli), timeout=10)  # parked mid-wait
+        plane.kill_hub()
+        cli.timeout = 0.3
+        time.sleep(0.4)  # heartbeat goes stale past max(timeout, 0.25)
+        local0 = cli.shm_local
+        for _ in range(3):
+            rows = cli.match_collect_raw(cli.match_submit(TOPICS))
+            for t, row in zip(TOPICS, rows):
+                assert set(row) == oracle.match(t), t
+        assert cli.shm_local > local0  # stale-heartbeat ticks went local
+    finally:
+        plane.stop()
+
+
+def test_fusion_window_adapts_and_collapses(tmp_path):
+    """Unit: the adaptive window opens only with >= 2 hot lanes and
+    collapses to zero for a lone talker (or fuse_window_us 0)."""
+    plane = _Plane(str(tmp_path), fuse_window_us=200)
+    plane.lane(0)
+    plane.lane(1)
+    svc = plane.svc
+    try:
+        now = time.monotonic_ns()
+        l0, l1 = svc.lanes[0], svc.lanes[1]
+        # both lanes hot -> window open
+        l0.last_match_ns = now
+        l1.last_match_ns = now
+        svc._drain_once()  # recomputes _hot_count
+        assert svc._hot_count == 2
+        assert svc._effective_window_s() == pytest.approx(200e-6)
+        # one lane cold -> collapsed
+        l1.last_match_ns = 0
+        svc._drain_once()
+        assert svc._hot_count == 1
+        assert svc._effective_window_s() == 0.0
+        # stale hotness (older than the 10ms hot horizon) -> collapsed
+        from emqx_tpu.shm.service import HOT_NS
+        l0.last_match_ns = now - 2 * HOT_NS
+        l1.last_match_ns = now - 2 * HOT_NS
+        svc._drain_once()
+        assert svc._effective_window_s() == 0.0
+        # fuse_window_us = 0 never opens regardless of hotness
+        svc.fuse_window_us = 0
+        l0.last_match_ns = time.monotonic_ns()
+        l1.last_match_ns = time.monotonic_ns()
+        svc._drain_once()
+        assert svc._effective_window_s() == 0.0
+    finally:
+        plane.stop(unlink=True)
+
+
+def test_fusion_window_merges_lagging_lane(tmp_path):
+    """A pass that harvested only one of two hot lanes holds dispatch
+    one window; the sibling's tick committed DURING the window fuses
+    into the same device group."""
+    plane = _Plane(str(tmp_path), fuse_window_us=50_000)
+    r0, r1 = plane.lane(0), plane.lane(1)
+    # NOT started: we drive _pass() by hand
+    now = time.monotonic_ns()
+    for lane in plane.svc.lanes.values():
+        lane.slab.ctrl[C_HUB_HB] = now
+    c0 = plane.client(r0)
+    c1 = plane.client(r1)
+    svc = plane.svc
+    try:
+        async def go():
+            svc.lanes[0].last_match_ns = time.monotonic_ns()
+            svc.lanes[1].last_match_ns = time.monotonic_ns()
+            p0 = c0.match_submit(TOPICS[:3])
+            assert p0.mode == "shm"
+            t = threading.Timer(
+                0.002, lambda: c1.match_submit(TOPICS[:3]))
+            t.start()
+            waits0 = svc.fuse_waits
+            await svc._pass()
+            t.join()
+            assert svc.fuse_waits == waits0 + 1
+            if svc._replies:
+                await asyncio.gather(*list(svc._replies),
+                                     return_exceptions=True)
+        plane.loop.run_until_complete(go())
+        # both ticks landed in ONE fused group of 2
+        assert svc.group_sizes.get(2, 0) >= 1
+        assert svc.match_ticks == 2 and svc.match_groups == 1
+        assert svc.stats()["fused_share"] == pytest.approx(1.0)
+    finally:
+        plane.stop()
+
+
+def test_lane_credit_prevents_starvation(tmp_path):
+    """One flooding lane, per-pass credit 4: a single pass still
+    harvests the sibling's tick (round-robin fairness), flags the
+    carryover for an immediate re-pass, and later passes drain the
+    flooder's surplus in order."""
+    plane = _Plane(str(tmp_path), lane_credit=4)
+    r0, r1 = plane.lane(0), plane.lane(1)
+    now = time.monotonic_ns()
+    for lane in plane.svc.lanes.values():
+        lane.slab.ctrl[C_HUB_HB] = now
+    c0 = plane.client(r0)
+    c1 = plane.client(r1)
+    svc = plane.svc
+    try:
+        from emqx_tpu.observe.tracepoints import TraceCollector
+        # flood lane 0 with 10 uncollected ticks; lane 1 submits one
+        for _ in range(10):
+            assert c0.match_submit(TOPICS[:2]).mode == "shm"
+        assert c1.match_submit(TOPICS[:2]).mode == "shm"
+        with TraceCollector() as tc:
+            consumed, reqs = svc._drain_once()
+        # HELLOs + 4 credited ticks from lane 0, everything of lane 1
+        by_lane = {}
+        for r in reqs:
+            by_lane[r.lane.idx] = by_lane.get(r.lane.idx, 0) + 1
+        assert by_lane.get(1) == 1          # sibling NOT starved
+        # credit counts ALL records: the flooder's attach HELLO eats
+        # one of its 4, leaving 3 match ticks in the first pass
+        assert by_lane.get(0) == 3          # flooder capped at credit
+        assert svc._more                    # carryover flagged
+        assert svc.credit_exhausted >= 1
+        assert any(e["kind"] == "shm.credit" for e in tc.events)
+        # draining to empty preserves the flooder's ring order
+        total = len(reqs)
+        guard = 0
+        while svc._more:
+            _, more_reqs = svc._drain_once()
+            total += len(more_reqs)
+            guard += 1
+            assert guard < 10
+        assert total == 11
+        ticks0 = [r.tick for r in reqs if r.lane.idx == 0]
+        assert ticks0 == sorted(ticks0)
+    finally:
+        plane.stop()
+
+
+def test_idle_doorbell_wakeups_near_zero(tmp_path):
+    """Parked hub: over an idle window the drain loop turns at the
+    housekeeping cadence (~1/s), not at 1/poll_interval — the ~500/s
+    idle wakeup tax the doorbells exist to delete."""
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        cli = plane.client(region)
+        cli.add_filter("idle/+")
+        _wait(_acked(cli), timeout=10)
+        _wait(_armed(plane, cli), timeout=10)
+        p0 = plane.svc.drain_passes
+        time.sleep(1.0)
+        idle_rate = plane.svc.drain_passes - p0
+        assert idle_rate <= 10  # poll mode would turn ~1000x here
+        # and the plane still serves instantly after the idle window
+        oracle = CpuTrieIndex()
+        _seed(cli, oracle, n=4)
+        _wait(_acked(cli), timeout=10)
+        assert cli.match(TOPICS) == [oracle.match(t) for t in TOPICS]
+    finally:
+        plane.stop()
+
+
+def test_parse_cores():
+    from emqx_tpu.shm.service import parse_cores
+
+    assert parse_cores("") == []
+    assert parse_cores("0") == [0]
+    assert parse_cores("0-3") == [0, 1, 2, 3]
+    assert parse_cores("0,2,5") == [0, 2, 5]
+    assert parse_cores("1-2,7") == [1, 2, 7]
+    assert parse_cores("junk,-1, 3") == [3]

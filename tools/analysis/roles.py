@@ -354,8 +354,16 @@ def check_shm_blessing(
     `multiprocessing.shared_memory` (module or symbol form) reopens the
     boundary without those invariants, so it is an error here.
     Tests/tools/bench stay exempt (they orchestrate both sides).
+
+    The same rule pins the shm doorbell transport: `os.eventfd` /
+    `os.eventfd_write` / `os.eventfd_read` are the wakeup side-channel
+    of the ring protocol (armed-word handshake in shm/doorbell.py, fd
+    inheritance via the supervisor's pass_fds), so any eventfd call in
+    a production module outside `emqx_tpu/shm/` (the C side lives in
+    `native/drain.cc`) is an unreviewed wakeup path and errors too.
     """
     findings: List[Finding] = []
+    findings.extend(_check_eventfd_blessing(idx, package_prefix))
     for mod, imports in sorted(idx.imports.items()):
         if not mod.startswith(package_prefix):
             continue
@@ -385,6 +393,53 @@ def check_shm_blessing(
                     "through shm/registry.py + shm/rings.py instead"
                 ),
                 ident=f"{mod}->shared_memory",
+            ))
+    return findings
+
+
+_EVENTFD_NAMES = {"eventfd", "eventfd_write", "eventfd_read"}
+
+
+def _check_eventfd_blessing(
+    idx: ProjectIndex, package_prefix: str,
+) -> List[Finding]:
+    """Flag eventfd construction/use outside the shm enclave (the
+    doorbell half of the shm-blessing rule — see check_shm_blessing)."""
+    findings: List[Finding] = []
+    for rel in sorted(idx.files):
+        fi = idx.files[rel]
+        mod = fi.module
+        if not mod.startswith(package_prefix):
+            continue
+        if mod == _SHM_BLESSED_PREFIX or mod.startswith(
+            _SHM_BLESSED_PREFIX + "."
+        ):
+            continue
+        if fi.tree is None:
+            continue
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            # os.eventfd*(...) or a bare eventfd*(...) pulled in via
+            # `from os import eventfd...`
+            hit = (len(chain) == 2 and chain[0] == "os"
+                   and chain[1] in _EVENTFD_NAMES) or (
+                len(chain) == 1 and chain[0] in _EVENTFD_NAMES)
+            if not hit or node.lineno in fi.ignored_lines:
+                continue
+            findings.append(Finding(
+                code="shm-blessing", severity=ERROR, path=rel,
+                line=node.lineno,
+                message=(
+                    f"{mod} calls {'.'.join(chain)} outside the "
+                    "blessed emqx_tpu.shm package — eventfd doorbells "
+                    "are part of the reviewed ring protocol; go "
+                    "through shm/doorbell.py instead"
+                ),
+                ident=f"{mod}->{chain[-1]}",
             ))
     return findings
 
