@@ -4,7 +4,7 @@
 .PHONY: check check-json lint lint-fast lint-locks test test-fast \
         native bench restore-bench chaos ds-bench ds-dump ds-soak \
         churn-bench retained-bench fanout-bench span-bench prep-bench \
-        wire-bench shm-bench fleet-bench
+        wire-bench shm-bench fleet-bench repl-soak takeover-bench
 
 # static-analysis gate (tools/analysis/): the dialyzer/xref/elvis
 # analog, stdlib-only — whole-project AST index + call graph, thread-
@@ -92,6 +92,21 @@ ds-dump:
 # 5 seeds; committed prefix must replay, (mid) dedup = exactly-once
 ds-soak:
 	python tools/chaos_soak.py --fronts ds --seeds 5
+
+# ds replication front only: leader/follower child pairs over a real
+# PeerLink, kill -9 the leader mid-flush and the follower mid-ack
+# across 5 seeds; zero loss at/below the replicated watermark, the
+# mirror stays a byte-identical prefix, replay is exactly-once, and a
+# dead follower never blocks the leader's flush path
+repl-soak:
+	python tools/chaos_soak.py --fronts repl --seeds 5
+
+# cursor-handoff takeover bench: a 10k-message parked queue crossing
+# nodes — materialized session ship vs the replicated-mirror cursor
+# handoff (bytes on the wire + takeover latency); writes the
+# BENCH_TABLE.md section
+takeover-bench:
+	python bench.py --takeover
 
 # churn-apply capacity worker sweep: parallel churn plane vs the serial
 # python-dict path at 1/2/4 pool workers (ETPU_POOL_THREADS pinned per

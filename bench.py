@@ -38,6 +38,7 @@ import asyncio
 import json
 import os
 import random
+import statistics
 import sys
 import time
 
@@ -1688,6 +1689,227 @@ def _update_ds_table(s: dict) -> None:
     with open(path, "w", encoding="utf-8") as f:
         f.write("\n".join(out))
     log("updated BENCH_TABLE.md durable-message-log section")
+
+
+def run_takeover(n_msgs=10_000, reps=3):
+    """Cross-node takeover of a parked session with a deep offline
+    queue: materialized session ship vs the replicated-mirror cursor
+    handoff (`ds/repl.py` + session_takeover v2).
+
+    Per mode, a two-node loopback cluster parks one persistent session
+    on the origin, lands `n_msgs` QoS1 messages in its durable log,
+    then the taker runs the real `_query_takeover` RPC and resumes:
+
+      * materialized — no replication plane: the origin replays the log
+        into the mqueue and ships every message inside the RPC response
+        (the pre-repl path, still the fallback);
+      * handoff — both nodes run DsReplicator, replication caught up:
+        the response is the session record + cursor, the queue is
+        rebuilt locally from the taker's mirror.
+
+    Reports the RPC response size (bytes on the wire) and the
+    end-to-end takeover latency (query -> resumed mqueue holding all
+    `n_msgs`), median of `reps` with rep spread.  Parity: both modes
+    must end with exactly `n_msgs` messages queued.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from emqx_tpu.broker.message import Message
+    from emqx_tpu.broker.packet import SubOpts
+    from emqx_tpu.broker.persist import (
+        SessionPersistence,
+        session_from_dict,
+    )
+    from emqx_tpu.broker.session import Session
+    from emqx_tpu.cluster import ClusterBroker, ClusterNode
+    from emqx_tpu.config.config import Config
+    from emqx_tpu.ds.manager import DsManager
+    from emqx_tpu.ds.repl import DsReplicator
+
+    conf_raw = {"enable": True, "shards": 4, "flush_bytes": 1 << 30,
+                "repl.enable": True, "repl.ack_timeout": 5.0,
+                "repl.retry_interval": 0.1}
+
+    async def wait_until(pred, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while not pred():
+            if time.monotonic() > deadline:
+                raise RuntimeError("takeover bench condition timeout")
+            await asyncio.sleep(0.01)
+
+    async def one_rep(d, with_repl):
+        nodes, repls = [], []
+        for name in ("tb-a", "tb-b"):
+            b = ClusterBroker()
+            conf = Config({"ds": dict(conf_raw)})
+            ds = DsManager(b, os.path.join(d, name, "ds"), conf,
+                           metrics=b.metrics)
+            b.ds = ds
+            SessionPersistence(b)
+            node = ClusterNode(name, b, heartbeat_ivl=0.2)
+            repl = DsReplicator(node, ds, conf, metrics=b.metrics) \
+                if with_repl else None
+            await node.start()
+            if repl is not None:
+                repl.start()
+            nodes.append(node)
+            repls.append(repl)
+        na, nb = nodes
+        try:
+            na.join("tb-b", ("127.0.0.1", nb.transport.port))
+            nb.join("tb-a", ("127.0.0.1", na.transport.port))
+            await wait_until(lambda: "tb-b" in na.up_peers()
+                             and "tb-a" in nb.up_peers())
+            # park one persistent session on A, then land the queue
+            cid = "takeover-bench"
+            s = Session(clientid=cid, expiry_interval=3600,
+                        max_mqueue=0)
+            s.subscriptions["bench/to/#"] = SubOpts(qos=1)
+            na.broker.subscribe(cid, "bench/to/#", SubOpts(qos=1))
+            na.broker.cm.pending[cid] = (s, float("inf"))
+            na.broker.persistence._on_park(cid, s, float("inf"))
+            msgs = [
+                Message(topic=f"bench/to/{i % 8}",
+                        payload=f"offline-{i:06d}-{'x' * 48}".encode(),
+                        qos=1)
+                for i in range(n_msgs)
+            ]
+            for i in range(0, len(msgs), 256):
+                na.broker.publish_many(msgs[i:i + 256])
+            na.broker.ds.flush_all()
+            if with_repl:
+                await wait_until(lambda: repls[0].lag() == 0)
+
+            # the measured leg: real RPC query -> local resume replay
+            t0 = time.perf_counter()
+            resp = await nb._query_takeover(cid)
+            assert resp is not None and resp.get("found")
+            wire_bytes = len(json.dumps(
+                resp, separators=(",", ":")).encode())
+            data = resp["session"]
+            session = session_from_dict(data)
+            if resp.get("handoff"):
+                origin = data.get("cursor_node") or ""
+                tail = {int(k): v
+                        for k, v in (resp.get("tail") or {}).items()}
+                if nb.ds_repl is not None and tail:
+                    tail = nb.ds_repl.absorb_tail(origin, tail)
+                session.ds_handoff_tail = tail or None
+            nb.broker.cm.pending[cid] = (session, float("inf"))
+            nb.broker.ds.replay_into(session)
+            takeover_ms = (time.perf_counter() - t0) * 1e3
+            got = len(session.mqueue) + len(session.inflight)
+            assert got == n_msgs, (with_repl, got, n_msgs)
+            assert bool(resp.get("handoff")) == with_repl
+            return wire_bytes, takeover_ms
+        finally:
+            for repl in repls:
+                if repl is not None:
+                    await repl.stop()
+            for node in nodes:
+                await node.stop()
+                node.broker.ds.close()
+
+    out = {}
+    for mode, with_repl in (("materialized", False), ("handoff", True)):
+        byts, times = [], []
+        for _rep in range(reps):
+            d = tempfile.mkdtemp(prefix=f"takeover-{mode}-")
+            try:
+                wb, ms = asyncio.run(one_rep(d, with_repl))
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+            byts.append(wb)
+            times.append(ms)
+        times.sort()
+        out[mode] = {
+            "wire_bytes": int(statistics.median(byts)),
+            "takeover_ms": statistics.median(times),
+            "spread_ms": times[-1] - times[0],
+        }
+        log(f"{mode}: {out[mode]['wire_bytes']:,} B on the wire, "
+            f"takeover {out[mode]['takeover_ms']:,.1f} ms "
+            f"(spread {out[mode]['spread_ms']:,.1f})")
+    stats = {
+        "n_msgs": n_msgs,
+        "reps": reps,
+        "materialized": out["materialized"],
+        "handoff": out["handoff"],
+        "bytes_reduction":
+            out["materialized"]["wire_bytes"]
+            / max(out["handoff"]["wire_bytes"], 1),
+        "latency_speedup":
+            out["materialized"]["takeover_ms"]
+            / max(out["handoff"]["takeover_ms"], 1e-9),
+    }
+    log(f"takeover ({n_msgs:,}-message parked queue): "
+        f"{stats['bytes_reduction']:,.0f}x fewer bytes shipped, "
+        f"{stats['latency_speedup']:.1f}x takeover latency vs "
+        f"materialization")
+    _update_takeover_table(stats)
+    return stats
+
+
+TAKEOVER_HEADER = "## Cross-node takeover (cursor handoff vs " \
+    "materialized queue)"
+
+
+def _update_takeover_table(s: dict) -> None:
+    """Write the takeover-bench section into BENCH_TABLE.md, replacing
+    any previous run's (same ownership contract as the ds section)."""
+    path = "BENCH_TABLE.md"
+    lines = []
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    out, skipping = [], False
+    for line in lines:
+        if line.strip() == TAKEOVER_HEADER:
+            skipping = True
+            continue
+        if skipping and line.startswith("## "):
+            skipping = False
+        if not skipping:
+            out.append(line)
+    while out and not out[-1].strip():
+        out.pop()
+    mat, ho = s["materialized"], s["handoff"]
+    out += [
+        "",
+        TAKEOVER_HEADER,
+        "",
+        "One parked persistent session holding a "
+        f"{s['n_msgs']:,}-message QoS1 offline queue crosses nodes "
+        "over the real `session_takeover` RPC.  `materialized` = the "
+        "origin replays its durable log into the mqueue and ships "
+        "every message in the response (the pre-replication path, "
+        "still the fallback); `handoff` = both nodes run the ds "
+        "replication plane (`ds/repl.py`), the response carries only "
+        "the session record + cursor, and the taker rebuilds the "
+        "queue from its local mirror.  takeover = query -> resumed "
+        "mqueue holding every message, median of "
+        f"{s['reps']} reps (spread = max-min).  Measured by "
+        "`python bench.py --takeover` (`make takeover-bench`) on the "
+        "CPU backend.",
+        "",
+        "| parked msgs | metric | materialized | handoff | gain |",
+        "|---|---|---|---|---|",
+        f"| {s['n_msgs']:,} | RPC response bytes "
+        f"| {mat['wire_bytes']:,} | {ho['wire_bytes']:,} "
+        f"| {s['bytes_reduction']:,.0f}x fewer |",
+        f"| {s['n_msgs']:,} | takeover ms "
+        f"| {mat['takeover_ms']:,.1f} (±{mat['spread_ms']:,.1f}) "
+        f"| {ho['takeover_ms']:,.1f} (±{ho['spread_ms']:,.1f}) "
+        f"| {s['latency_speedup']:.1f}x |",
+        "",
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(out))
+    log("updated BENCH_TABLE.md takeover section")
 
 
 def _next_pow2_int(n: int) -> int:
@@ -3757,6 +3979,12 @@ def main() -> None:
                          "x M offline messages, durable-log cursors vs "
                          "legacy per-session JSON snapshots; writes the "
                          "BENCH_TABLE.md section")
+    ap.add_argument("--takeover", action="store_true",
+                    help="cross-node takeover of a 10k-message parked "
+                         "queue: materialized session ship vs the "
+                         "replicated-mirror cursor handoff (bytes on "
+                         "the wire + latency); writes the "
+                         "BENCH_TABLE.md section")
     ap.add_argument("--churn", action="store_true",
                     help="churn-apply capacity worker sweep (parallel "
                          "churn plane vs python dicts at 1/2/4 workers, "
@@ -4020,6 +4248,24 @@ def main() -> None:
                  for k, v in r.items()}
                 for r in stats["rows"]
             ],
+        }))
+        return
+    if ns.takeover:
+        stats = run_takeover()
+        if ns.emit_stats:
+            with open(ns.emit_stats, "w", encoding="utf-8") as f:
+                json.dump(stats, f)
+        print(json.dumps({
+            "metric": "takeover_bytes_reduction",
+            "value": round(stats["bytes_reduction"], 1),
+            "unit": "x_fewer_bytes_vs_materialized",
+            "latency_speedup": round(stats["latency_speedup"], 2),
+            "materialized_bytes": stats["materialized"]["wire_bytes"],
+            "handoff_bytes": stats["handoff"]["wire_bytes"],
+            "materialized_ms": round(
+                stats["materialized"]["takeover_ms"], 1),
+            "handoff_ms": round(stats["handoff"]["takeover_ms"], 1),
+            "n_msgs": stats["n_msgs"],
         }))
         return
     if ns.ds:

@@ -89,6 +89,15 @@ PREDEFINED = [
     "ds.replays",
     "ds.replayed_messages",
     "ds.gc_segments",
+    # ds append replication (ds/repl.py leader ship / follower mirror +
+    # cluster/node.py cursor-handoff takeover; gauge ds.repl.lag rides
+    # the gauge table via DsManager.sync_metrics)
+    "ds.repl.ranges",
+    "ds.repl.records",
+    "ds.repl.send_failures",
+    "ds.repl.mirror_appends",
+    "ds.repl.catchup_ranges",
+    "ds.repl.handoffs",
     # self-healing cluster data plane (cluster/node.py forward spool)
     "messages.forward.spooled",
     "messages.forward.replayed",

@@ -130,6 +130,12 @@ def session_to_dict(
         if not d["mqueue"]:
             del d["mqueue"]
         d["cursor"] = {str(k): list(v) for k, v in cursor.items()}
+        # cursor-handoff takeover (ds/repl.py): a cursor pointing into
+        # ANOTHER node's log names its origin; replay resolves it
+        # against the local mirror
+        node = getattr(s, "ds_cursor_node", None)
+        if node:
+            d["cursor_node"] = node
     return d
 
 
@@ -169,6 +175,8 @@ def session_from_dict(d: dict) -> Session:
             int(k): (int(v[0]), int(v[1]))
             for k, v in d["cursor"].items()
         }
+        if d.get("cursor_node"):
+            s.ds_cursor_node = d["cursor_node"]
     return s
 
 
@@ -312,6 +320,14 @@ class SessionPersistence:
         ds = self.ds
         if ds is not None and session is not None:
             ds.replay_into(session)
+        self.backend.delete(clientid)
+        self._dirty.discard(clientid)
+
+    def on_handoff(self, clientid: str) -> None:
+        """Session shipped to another node in cursor-handoff form
+        (ds/repl.py): drop the on-disc copy — the taker owns the state
+        now — WITHOUT the replay half of `on_resume`.  Not replaying
+        the queue here is the whole point of the handoff."""
         self.backend.delete(clientid)
         self._dirty.discard(clientid)
 

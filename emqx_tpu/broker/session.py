@@ -84,6 +84,14 @@ class Session:
         # held, QoS>=1 offline traffic lives in the SHARED log and the
         # mqueue is rebuilt from it on resume (ds/manager.py).
         self.ds_cursor: Optional[Dict[int, Tuple[int, int]]] = None
+        # cursor-handoff takeover (ds/repl.py): when the cursor points
+        # into ANOTHER node's log, ds_cursor_node names that origin and
+        # replay resolves it against the local mirror; ds_handoff_tail
+        # holds the shipped unreplicated ranges the mirror could not
+        # absorb (RAM-only, never persisted — its loss is reported as a
+        # replay gap, not silence)
+        self.ds_cursor_node: Optional[str] = None
+        self.ds_handoff_tail: Optional[Dict[int, dict]] = None
 
     # ------------------------------------------------------ subscriptions
 

@@ -32,7 +32,11 @@ CONTRACTS: Dict[str, Tuple[int, int]] = {
     "cluster_catchup": (1, 1),
     "lock_acquire": (1, 1),     # distributed locker (cluster/locker.py)
     "lock_release": (1, 1),
-    "session_takeover": (1, 1),  # cross-node session migration
+    # cross-node session migration; v2 adds the cursor-handoff form:
+    # the caller offers its ds mirror coverage and the origin may
+    # answer with session + unreplicated tail instead of a
+    # materialized queue (ds/repl.py)
+    "session_takeover": (1, 2),
 }
 
 

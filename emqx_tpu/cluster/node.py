@@ -265,6 +265,16 @@ class ClusterNode:
 
         self.locker = DistLocker(self)
         self.peer_bpapi: Dict[str, Dict[str, int]] = {}
+        # ds append-replication plane (ds/repl.py), wired by
+        # attach_ds_repl; enables the v2 cursor-handoff takeover form
+        self.ds_repl = None
+
+    def attach_ds_repl(self, repl) -> None:
+        """Wire the ds replication plane: inbound REPL frames land on
+        the replicator's mirror appends, and takeover calls negotiate
+        the cursor-handoff form against its mirror coverage."""
+        self.ds_repl = repl
+        self.transport.on_repl = repl.handle_repl
 
     # ------------------------------------------------------------- lifecycle
 
@@ -385,7 +395,9 @@ class ClusterNode:
         """Poll the discovery strategy; join newly seen peers.  Cores
         join every discovered node; replicants join cores only — their
         links to other nodes come from cores dialing back."""
-        while True:
+        # `not self._stopping` guards against a swallowed cancellation
+        # (see _heartbeat) leaving stop() awaiting this loop forever
+        while not self._stopping:
             try:
                 found = await asyncio.to_thread(self.discovery.discover)
             except Exception:
@@ -487,7 +499,12 @@ class ClusterNode:
         self._kick_replay(peer)
 
     async def _heartbeat(self) -> None:
-        while True:
+        # `not self._stopping`, not `True`: py3.10 asyncio.wait_for can
+        # swallow a cancellation delivered in the same tick the awaited
+        # future completes (bpo-37658) — inside link.request that turns
+        # stop()'s cancel into a normal PING return and `await task`
+        # would hang forever on a loop that never exits
+        while not self._stopping:
             await asyncio.sleep(self.heartbeat_ivl)
             for peer, link in list(self.links.items()):
                 if not link.connected:
@@ -1132,6 +1149,25 @@ class ClusterNode:
             # the session resumes on the peer: its delayed will must NOT
             # publish here (MQTT-3.1.3-9, same as the local resume path)
             cm.cancel_will(cid)
+            cursor = getattr(session, "ds_cursor", None)
+            ds = getattr(self.broker, "ds", None)
+            if (int(params.get("_v", 1)) >= 2
+                    and params.get("mirror") is not None
+                    and ds is not None and cursor is not None
+                    and getattr(session, "ds_cursor_node", None) is None):
+                # v2 cursor handoff (ds/repl.py): ship the session
+                # record + only the tail the taker's mirror lacks —
+                # O(replication lag), never the materialized queue.
+                # (A cursor already pointing at a THIRD node falls
+                # through to materialization: the taker's mirror of
+                # this node cannot resolve it.)
+                resp = self._handoff_session(
+                    cid, session, expire_at, cursor, ds,
+                    {int(k): (int(v[0]), int(v[1]))
+                     for k, v in params["mirror"].items()},
+                )
+                self.broker.client_down(cid, list(session.subscriptions))
+                return resp
             if cm.on_resume:
                 # persistence hook: the on-disc copy must die with the
                 # handoff or a restart would resurrect a stale duplicate.
@@ -1143,6 +1179,62 @@ class ClusterNode:
             self.broker.client_down(cid, list(session.subscriptions))
             return {"found": True, "live": False, "session": data}
         return {"found": False}
+
+    def _handoff_session(
+        self, cid: str, session, expire_at: float, cursor: dict, ds,
+        mirror: Dict[int, Tuple[int, int]],
+    ) -> dict:
+        """Serving half of the v2 cursor-handoff takeover: per shard,
+        ship only `[max(cursor, mirror_end), durable_end)` — the range
+        the taker's mirror does not already hold.  With replication
+        healthy the tail is empty and the response is O(session
+        record)."""
+        from ..broker.persist import session_to_dict
+
+        ds.flush_all()  # the tail read below must see every append
+        tail: Dict[str, dict] = {}
+        shipped = 0
+        for shard, cur in cursor.items():
+            coff = int(cur[1])
+            shard_log = ds.logs[shard]
+            end = shard_log.next_offset
+            mbase, mend = mirror.get(shard, (end, end))
+            # the mirror only helps if it reaches back to the cursor
+            lo = max(coff, mend) if mbase <= coff else coff
+            if lo >= end:
+                continue
+            records: List[str] = []
+            gap = 0
+            first = lo
+            off = lo
+            while off < end:
+                got, off, g = shard_log.read_from(off, 512)
+                gap += g
+                if not got:
+                    break
+                if not records:
+                    first = got[0][0]
+                records.extend(
+                    base64.b64encode(p).decode("ascii") for _o, p in got
+                )
+            if records or gap:
+                tail[str(shard)] = {
+                    "first": first, "records": records, "gap": gap,
+                }
+                shipped += len(records)
+        data = session_to_dict(session, expire_at, cursor=cursor)
+        data["cursor_node"] = self.name
+        p = getattr(self.broker, "persistence", None)
+        if p is not None:
+            # the on-disc copy dies with the handoff (a restart must
+            # not resurrect a duplicate) — but WITHOUT the replay half
+            # of on_resume; not materializing is the point
+            p.on_handoff(cid)
+        tracept("ds.repl.handoff", clientid=cid, side="serve",
+                shards=len(cursor), tail_records=shipped)
+        self.broker.metrics.inc("ds.repl.handoffs")
+        return {"found": True, "live": False, "handoff": True,
+                "session": data, "tail": tail}
 
     async def import_session(self, clientid: str) -> bool:
         """Pull `clientid`'s session from whichever peer holds it.
@@ -1169,11 +1261,24 @@ class ClusterNode:
         async def attempt() -> bool:
             if clientid in cm.channels or clientid in cm.pending:
                 return True
-            found = await self._query_takeover(clientid)
-            if found is None:
+            resp = await self._query_takeover(clientid)
+            if resp is None:
                 return False
-            data = found
+            data = resp["session"]
             session = session_from_dict(data)
+            if resp.get("handoff"):
+                # cursor-handoff form: fold the shipped tail into our
+                # mirror where contiguous (durable before the client
+                # resumes); the leftovers replay from RAM at resume
+                origin = data.get("cursor_node") or ""
+                tail = {int(k): v
+                        for k, v in (resp.get("tail") or {}).items()}
+                if self.ds_repl is not None and tail:
+                    tail = self.ds_repl.absorb_tail(origin, tail)
+                session.ds_handoff_tail = tail or None
+                tracept("ds.repl.handoff", clientid=clientid,
+                        side="import", origin=origin,
+                        tail_shards=len(tail))
             exp = data.get("expire_at")
             cm.pending[clientid] = (
                 session, exp if exp is not None else float("inf")
@@ -1194,15 +1299,28 @@ class ClusterNode:
     async def _query_takeover(self, clientid: str):
         """Concurrent per-peer takeover query; first found wins (any
         second copy is already removed at its origin by the RPC itself,
-        which also makes duplicates self-heal)."""
+        which also makes duplicates self-heal).  Returns the full found
+        response ({"session": ..., optionally "handoff"/"tail"}).  Each
+        peer is offered this node's ds-mirror coverage OF THAT PEER, so
+        an origin with a replicated log can answer in cursor-handoff
+        form instead of materializing the queue."""
         peers = self.up_peers()
         if not peers:
             return None
+
+        def params_for(peer: str) -> dict:
+            d: dict = {"clientid": clientid}
+            if self.ds_repl is not None:
+                d["mirror"] = {
+                    str(k): [lo, hi]
+                    for k, (lo, hi)
+                    in self.ds_repl.mirror_state(peer).items()
+                }
+            return d
+
         results = await asyncio.gather(
             *(
-                self.call(
-                    p, "session_takeover", {"clientid": clientid}, timeout=3.0
-                )
+                self.call(p, "session_takeover", params_for(p), timeout=3.0)
                 for p in peers
             ),
             return_exceptions=True,
@@ -1211,7 +1329,7 @@ class ClusterNode:
         for resp in results:
             if isinstance(resp, dict) and resp.get("found"):
                 if found is None:
-                    found = resp["session"]
+                    found = resp
         return found
 
     async def discard_remote(self, clientid: str) -> None:

@@ -57,6 +57,8 @@ FORWARD = 7
 FORWARD_ACK = 8
 RPC_REQ = 9
 RPC_RESP = 10
+REPL = 11
+REPL_ACK = 12
 
 MAX_FRAME = 64 * 1024 * 1024
 
@@ -127,6 +129,12 @@ def pack_forward_body(header: dict, payload: bytes) -> bytes:
 
 def pack_forward(header: dict, payload: bytes) -> bytes:
     return _pack(FORWARD, pack_forward_body(header, payload))
+
+
+def pack_repl(header: dict, payload: bytes) -> bytes:
+    """REPL frame: one ds append-replication range (FORWARD body layout —
+    u16 hlen | JSON header | raw record blob; see ds/repl.py)."""
+    return _pack(REPL, pack_forward_body(header, payload))
 
 
 def unpack_forward(body: bytes) -> Tuple[dict, bytes]:
@@ -316,7 +324,7 @@ class PeerLink:
                 a = await _fault.ainject("transport.recv", err=ConnectionError)
                 if a is not None and a.kind in ("drop", "corrupt"):
                     continue  # frame lost on the floor
-            if ftype in (PONG, RPC_RESP, SNAPSHOT, FORWARD_ACK):
+            if ftype in (PONG, RPC_RESP, SNAPSHOT, FORWARD_ACK, REPL_ACK):
                 obj = json.loads(body)
                 fut = self._reqs.pop(obj.get("id", -1), None)
                 if fut is not None and not fut.done():
@@ -393,6 +401,26 @@ class PeerLink:
             self._reqs.pop(rid, None)
             raise RpcError(f"forward timeout on {self.peer}")
 
+    async def repl_request(
+        self, header: dict, payload: bytes, timeout: float = 5.0
+    ) -> Optional[dict]:
+        """Ship one ds replication range and await the follower's
+        durable ack (ds/repl.py); None if the link was down."""
+        if not self.connected or self._writer is None:
+            return None
+        rid = next(self._req_id)
+        header = dict(header, id=rid)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._reqs[rid] = fut
+        if not self.send_nowait(pack_repl(header, payload)):
+            self._reqs.pop(rid, None)
+            return None
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._reqs.pop(rid, None)
+            raise RpcError(f"repl ack timeout on {self.peer}")
+
 
 class Transport:
     """Server side: accepts inbound links, dispatches frames to handlers.
@@ -402,6 +430,7 @@ class Transport:
       on_route_op(peer_name, obj)
       on_snapshot_req(peer_name, obj) -> dict
       on_forward(peer_name, header, payload) -> Optional[dict]  ack fields
+      on_repl(peer_name, header, payload) -> Optional[dict]     ack fields
       rpc_handlers[method](peer_name, params) -> dict | Awaitable[dict]
     """
 
@@ -419,6 +448,12 @@ class Transport:
         self.on_route_op: Callable[[str, dict], None] = lambda p, o: None
         self.on_snapshot_req: Callable[[str, dict], dict] = lambda p, o: {}
         self.on_forward: Callable[[str, dict, bytes], Optional[dict]] = (
+            lambda p, h, b: None
+        )
+        # ds append replication (ds/repl.py mirror appends); the default
+        # never acks, so a leader shipping at a node with no replicator
+        # times out and degrades instead of wedging
+        self.on_repl: Callable[[str, dict, bytes], Optional[dict]] = (
             lambda p, h, b: None
         )
         self.rpc_handlers: Dict[str, Callable] = {}
@@ -570,6 +605,12 @@ class Transport:
                         if ack is not None and header.get("id") is not None:
                             ack["id"] = header["id"]
                             writer.write(pack_json(FORWARD_ACK, ack))
+                    elif ftype == REPL:
+                        header, payload = unpack_forward(body)
+                        ack = self.on_repl(peer_name, header, payload)
+                        if ack is not None and header.get("id") is not None:
+                            ack["id"] = header["id"]
+                            writer.write(pack_json(REPL_ACK, ack))
                     await writer.drain()
         except asyncio.CancelledError:
             raise  # server shutdown cancels handlers; finally cleans up

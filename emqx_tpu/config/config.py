@@ -376,6 +376,34 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
             "duration", 604800.0,  # 7 days
             desc="hard message age bound (duration, bare numbers are "
                  "seconds), even ahead of a lagging cursor"),
+        # leader->follower append replication (ds/repl.py)
+        "repl.enable": Field(
+            "bool", False,
+            desc="replicate each shard's flushed append ranges to an "
+                 "elected follower peer over the cluster PeerLinks; "
+                 "cross-node takeover then resumes from the follower's "
+                 "mirror (cursor handoff) instead of materializing the "
+                 "queue, and node loss preserves everything at/below "
+                 "the replicated watermark"),
+        "repl.ack_timeout": Field(
+            "duration", 2.0,
+            desc="follower-ack wait per shipped range; a timeout "
+                 "degrades that shard to leader-only appends "
+                 "(ds_repl_degraded alarm) without ever blocking the "
+                 "flush path"),
+        "repl.retry_interval": Field(
+            "duration", 1.0,
+            desc="degraded-shard heal probe cadence; catch-up re-ships "
+                 "from the replicated watermark once the follower link "
+                 "returns"),
+        "repl.queue_max": Field(
+            "int", 256, min=1,
+            desc="flushed-but-unshipped ranges buffered per shard; "
+                 "overflow drops the RAM backlog (records stay durable "
+                 "locally) and falls back to a heal-time catch-up read"),
+        "repl.catchup_batch": Field(
+            "int", 512, min=1,
+            desc="records per catch-up read+ship batch after a heal"),
     },
     "retainer": {
         "enable": Field("bool", True),

@@ -32,6 +32,10 @@ class WriteBuffer:
         self._lock = threading.Lock()
         self.next_offset = log.next_offset
         self.flushes = 0
+        # post-flush hook (shard, first_offset, items) — set by the ds
+        # replicator to queue the flushed range for shipment; must never
+        # block (one deque append + a loop wakeup)
+        self.on_flush = None
 
     @property
     def durable_offset(self) -> int:
@@ -75,6 +79,12 @@ class WriteBuffer:
             n_bytes, self._bytes = self._bytes, 0
             self.log.append_payloads(items)
             self.flushes += 1
+            hook = self.on_flush
+            if hook is not None:
+                # inside the lock so ranges reach the replicator in
+                # append order even when the ticker thread and an
+                # inline-watermark flush race
+                hook(self.log.shard, items[0][0], items)
         tp("ds.flush", shard=self.log.shard, records=len(items),
            bytes=n_bytes)
         return len(items)

@@ -92,10 +92,15 @@ def _scan_segment(path: str, shard: Optional[int] = None):
 class ShardLog:
     """One shard's segment chain: sealed generations + one active file."""
 
-    def __init__(self, directory: str, shard: int, seg_bytes: int = 4 << 20):
+    def __init__(self, directory: str, shard: int, seg_bytes: int = 4 << 20,
+                 base: int = 0):
         self.dir = directory
         self.shard = shard
         self.seg_bytes = max(1, int(seg_bytes))
+        # first offset when the chain is empty: a replication MIRROR
+        # (ds/repl.py) starts at the leader's replication base, not 0,
+        # so mirror offsets stay identical to the leader's
+        self._base0 = max(0, int(base))
         # appends arrive via WriteBuffer.flush on EITHER the event loop
         # (inline watermark) or the ticker's to_thread hop, while reads
         # (resume replay, GC bookkeeping) stay on the loop: every access
@@ -167,7 +172,7 @@ class ShardLog:
         # roll that triggered it
         with self._lock:
             gen = (self.segments[-1].generation + 1) if self.segments else 1
-            base = self.segments[-1].end if self.segments else 0
+            base = self.segments[-1].end if self.segments else self._base0
             path = os.path.join(self.dir, f"seg.{gen}.open")
             f = open(path, "wb")
             f.write(_HDR.pack(MAGIC, VERSION, self.shard, gen, base))  # analysis: allow-blocking(segment-roll header, rides the flush fsync budget)

@@ -30,6 +30,8 @@ config namespace in both directions: a key read must be declared in
 
 from __future__ import annotations
 
+import base64
+import json
 import os
 import time
 from collections import OrderedDict
@@ -38,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..broker import topic as topiclib
 from ..broker.message import Message
+from ..broker.persist import message_from_dict
 from ..observe import spans as _spans
 from ..observe.tracepoints import tp
 from ..ops.hashing import word_hash64
@@ -69,6 +72,9 @@ class DsManager:
             for log in self.logs
         ]
         self.metrics = metrics
+        # replication plane (ds/repl.py); DsReplicator sets itself here
+        # at construction — replay then understands handed-off cursors
+        self.repl = None
         self._recent_mids: "OrderedDict[bytes, int]" = OrderedDict()
         self._last_flush = 0.0
         self._last_gc = 0.0
@@ -168,6 +174,12 @@ class DsManager:
         cursor = getattr(session, "ds_cursor", None)
         if cursor is None:
             return 0, 0
+        origin = getattr(session, "ds_cursor_node", None)
+        if origin:
+            # the cursor points into ANOTHER node's log (cursor-handoff
+            # takeover): rebuild from this node's mirror + shipped tail,
+            # then re-home the cursor to the local log
+            return self._replay_handoff(session, origin, batch=batch)
         subs = []  # (real filter words-key, subscription key, opts)
         for filt, opts in session.subscriptions.items():
             group, real = topiclib.parse_share(filt)
@@ -213,6 +225,107 @@ class DsManager:
         if gap:
             n += self._gap_recover(session, [r for r, _f, _o in subs], seen)
         tp("ds.replay", clientid=session.clientid, messages=n, gap=gap,
+           ms=(time.monotonic() - t0) * 1e3)
+        if self.metrics is not None:
+            self.metrics.inc("ds.replays")
+            self.metrics.inc("ds.replayed_messages", n)
+        return n, gap
+
+    def _replay_handoff(
+        self, session, origin: str, batch: int = 512
+    ) -> Tuple[int, int]:
+        """Resume a session imported via cursor handoff (ds/repl.py):
+        the mqueue is rebuilt from this node's MIRROR of the origin's
+        shard logs plus the shipped unreplicated tail — the origin
+        never materialized the queue.  Mirror windows lost to resets
+        and tails the origin could not read count as gaps (recovered
+        via the retainer like any GC gap).  Afterwards the cursor is
+        re-homed to this node's own log end: new offline traffic for
+        the session lands locally from here on."""
+        cursor = dict(getattr(session, "ds_cursor", None) or {})
+        tail = getattr(session, "ds_handoff_tail", None) or {}
+        subs = []
+        for filt, opts in session.subscriptions.items():
+            group, real = topiclib.parse_share(filt)
+            if group is None:
+                subs.append((real, filt, opts))
+        seen = session.pending_mids()
+        n = gap = 0
+        t0 = time.monotonic()
+
+        def deliver(msg) -> int:
+            if msg.mid in seen or msg.expired():
+                return 0
+            seen.add(msg.mid)
+            d = 0
+            for real, _skey, opts in subs:
+                if not topiclib.match(msg.topic, real):
+                    continue
+                if opts.no_local and msg.from_client == session.clientid:
+                    continue
+                qos = (max(msg.qos, opts.qos) if session.upgrade_qos
+                       else min(msg.qos, opts.qos))
+                session.mqueue.insert(replace(msg, qos=qos))
+                d += 1
+            return d
+
+        for shard in sorted(set(cursor) | set(tail)):
+            _gen, off = cursor.get(shard, (0, 0))
+            info = tail.get(shard)
+            # the tail covers [first, ...): bound the mirror read there
+            stop = (int(info["first"])
+                    if info and info.get("records") else None)
+            mirror = (self.repl.mirror_log(origin, shard)
+                      if self.repl is not None else None)
+            if mirror is None and info is None:
+                # no local coverage at all for this shard's window —
+                # an honest gap, not a silent skip
+                gap += 1
+                continue
+            if mirror is not None and subs and (stop is None or stop > off):
+                while True:
+                    got, nxt, g = mirror.read_from(off, batch)
+                    gap += g
+                    if not got:
+                        break
+                    for o, payload in got:
+                        if stop is not None and o >= stop:
+                            break
+                        try:
+                            msg = message_from_dict(
+                                json.loads(payload.decode("utf-8")))
+                        except (ValueError, KeyError):
+                            continue  # torn/alien record: skip
+                        n += deliver(msg)
+                    off = nxt
+                    if stop is not None and off >= stop:
+                        break
+            if stop is not None and off < stop:
+                # coverage hole: the mirror ran dry before the shipped
+                # tail begins (mirror reset/trim raced the handoff) —
+                # reported, never silently skipped
+                gap += stop - off
+            if info:
+                gap += int(info.get("gap", 0))
+                first = int(info.get("first", 0))
+                floor = cursor.get(shard, (0, 0))[1]
+                for i, b64 in enumerate(info.get("records") or []):
+                    if first + i < floor:
+                        continue  # below the park cursor
+                    try:
+                        msg = message_from_dict(json.loads(
+                            base64.b64decode(b64).decode("utf-8")))
+                    except (ValueError, KeyError):
+                        continue
+                    n += deliver(msg)
+        if gap:
+            n += self._gap_recover(session, [r for r, _f, _o in subs],
+                                   seen)
+        session.ds_cursor = self.end_cursor()
+        session.ds_cursor_node = None
+        session.ds_handoff_tail = None
+        tp("ds.replay", clientid=session.clientid, messages=n, gap=gap,
+           handoff=True, origin=origin,
            ms=(time.monotonic() - t0) * 1e3)
         if self.metrics is not None:
             self.metrics.inc("ds.replays")
@@ -340,6 +453,8 @@ class DsManager:
             "ds.lag",
             max((self.buffers[k].next_offset - mins[k]
                  for k in range(self.n_shards)), default=0))
+        if self.repl is not None:
+            self.metrics.gauge_set("ds.repl.lag", self.repl.lag())
 
     # -------------------------------------------------------------- stats
 
@@ -384,3 +499,5 @@ class DsManager:
         self.flush_all()
         for log in self.logs:
             log.close()
+        if self.repl is not None:
+            self.repl.close_mirrors()

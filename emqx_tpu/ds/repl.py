@@ -1,0 +1,566 @@
+"""Per-shard leader->follower replication of durable-log appends.
+
+The ds plane (PR 5) made parked-session delivery durable on ONE node;
+this module replicates it across the cluster so a kill -9 / node loss
+preserves every record at or below a **replicated watermark**, and a
+cross-node takeover becomes a cursor handoff instead of a materialized
+queue ship.
+
+Topology — every node runs one `DsReplicator` playing both roles:
+
+* leader (its own shards): each `WriteBuffer.flush` hands the flushed
+  contiguous range to `offer()` (one deque append inside the shard
+  lock + a loop wakeup — the flush path never blocks on the network).
+  A retained drain task ships ranges over the elected follower's
+  PeerLink as REPL frames (`transport.pack_repl`) and awaits the
+  REPL_ACK carrying the follower's durable end: `watermark[shard]`.
+  Every record at/below the watermark exists fsync'd on two nodes.
+* follower (peers' shards): `handle_repl` appends the range to a
+  mirror ShardLog under `<ds.dir>/mirror/<leader>/shard-<k>` — byte-
+  and offset-identical to the leader's chain, fsync'd BEFORE the ack
+  leaves.  Mirrors left by a previous incarnation are re-adopted at
+  construction, so the takeover path works across restarts.
+
+Follower election is `sorted(up_peers)[shard % n]`, sticky while the
+pick stays up, so a 2-node cluster mirrors everything at the other
+node and larger meshes spread shards.
+
+Degrade ladder (never the flush path's problem):
+
+1. ack timeout / link down / nack -> the shard flips to leader-only
+   appends; the RAM ship-queue is dropped (the records stay durable in
+   the leader's own log) and the `ds_repl_degraded` alarm raises off
+   `degraded` via `poll_health_alarms`.
+2. heal probe every `ds.repl.retry_interval`: when the follower link
+   is back, catch-up re-reads `[watermark, durable_end)` from the
+   leader's log in `ds.repl.catchup_batch` batches and re-ships; the
+   alarm clears when the watermark catches the durable end.
+3. if retention GC already dropped part of that window, the catch-up
+   ships a `reset` range: the follower rebuilds its mirror at the
+   oldest surviving offset and the gap is reported (tp field), never
+   silently absorbed.
+
+Takeover (cluster/node.py `session_takeover` v2) ships the session
+record plus ONLY the per-shard `[cursor|mirror_end, durable_end)` tail
+the taker's mirror lacks — O(replication lag), not O(queue).  The
+taker folds the tail into its mirror where contiguous (durable before
+the client resumes) and `DsManager._replay_handoff` rebuilds the
+mqueue from mirror + tail with the usual mid dedup and honest gap
+reporting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import logging
+import os
+import shutil
+import struct
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Set, Tuple
+
+from .. import fault as _fault
+from ..observe import spans as _spans
+from ..observe.tracepoints import tp
+from .log import SegmentError, ShardLog
+
+log = logging.getLogger("emqx_tpu.ds.repl")
+
+_LEN = struct.Struct("<I")
+
+
+def pack_records(items: List[Tuple[int, bytes]]) -> bytes:
+    """Record blob for one REPL range: repeated `u32 len | payload`.
+    Offsets are implicit — a range is contiguous by construction (the
+    flush hands over exactly the flushed run), so the header's `first`
+    plus position recovers every offset."""
+    parts = []
+    for _off, payload in items:
+        parts.append(_LEN.pack(len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def unpack_records(first: int, blob: bytes) -> List[Tuple[int, bytes]]:
+    out: List[Tuple[int, bytes]] = []
+    pos = 0
+    off = first
+    while pos + _LEN.size <= len(blob):
+        (ln,) = _LEN.unpack_from(blob, pos)
+        pos += _LEN.size
+        if pos + ln > len(blob):
+            break  # torn blob: keep the whole-record prefix
+        out.append((off, blob[pos:pos + ln]))
+        pos += ln
+        off += 1
+    return out
+
+
+class DsReplicator:
+    """Both halves of the replication plane for one node (see module
+    docstring).  Construction wires itself into the ds buffers'
+    `on_flush` hooks and the cluster's REPL frame handler; `start()`
+    (on the running loop) spawns the retained drain task and `stop()`
+    cancels it (PR 10 lifecycle rules)."""
+
+    def __init__(self, cluster, ds, conf, metrics=None) -> None:
+        self.cluster = cluster
+        self.ds = ds
+        self.metrics = metrics if metrics is not None else ds.metrics
+        self.ack_timeout = float(conf.get("ds.repl.ack_timeout"))
+        self.queue_max = int(conf.get("ds.repl.queue_max"))
+        self.catchup_batch = int(conf.get("ds.repl.catchup_batch"))
+        self.retry_interval = float(conf.get("ds.repl.retry_interval"))
+        self.seg_bytes = int(conf.get("ds.seg_bytes"))
+        # ---- leader state -------------------------------------------
+        n = ds.n_shards
+        # replication starts at the durable end as of construction:
+        # records below it predate the plane and are not claimed
+        self.base: Dict[int, int] = {
+            k: ds.logs[k].next_offset for k in range(n)
+        }
+        self.watermark: Dict[int, int] = dict(self.base)
+        self.followers: Dict[int, str] = {}
+        self._degraded: Set[int] = set()
+        # flushed-but-unshipped ranges, appended by offer() from
+        # whatever thread flushed; drained in order by the ship task
+        self._queues: Dict[int, Deque[Tuple[int, list]]] = {
+            k: deque() for k in range(n)
+        }
+        self._qlock = threading.Lock()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._event: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+        self.ships = 0
+        self.degrades = 0
+        # ---- follower state -----------------------------------------
+        self.mirror_dir = os.path.join(ds.dir, "mirror")
+        self.mirrors: Dict[str, Dict[int, ShardLog]] = {}
+        self._adopt_mirrors()
+        # ---- wiring -------------------------------------------------
+        for buf in ds.buffers:
+            buf.on_flush = self.offer
+        ds.repl = self
+        cluster.attach_ds_repl(self)
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Spawn the drain task on the RUNNING loop (after
+        cluster.start())."""
+        self._loop = asyncio.get_running_loop()
+        self._event = asyncio.Event()
+        self._stopping = False
+        self._task = self._loop.create_task(self._run())
+
+    async def stop(self) -> None:
+        # flag BEFORE cancel: if wait_for swallows the cancellation
+        # (py3.10 done-future race, see ClusterNode._heartbeat) the
+        # drain loop still exits at its next condition check
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                log.exception("ds repl drain task died during stop")
+            self._task = None
+        self._event = None
+        self._loop = None
+
+    def close_mirrors(self) -> None:
+        for by in self.mirrors.values():
+            for m in by.values():
+                m.close()
+        self.mirrors.clear()
+
+    # ---------------------------------------------------- leader: intake
+
+    def offer(self, shard: int, first: int, items: list) -> None:
+        """WriteBuffer post-flush hook: queue one flushed range for
+        shipment.  Runs on whatever thread flushed (loop inline or the
+        ticker's to_thread hop) — one lock'd deque append + a loop
+        wakeup, never blocking the flush."""
+        with self._qlock:
+            q = self._queues.get(shard)
+            if q is None:
+                return
+            q.append((first, list(items)))
+            if len(q) > self.queue_max:
+                # bounded backlog: drop the RAM queue whole — the
+                # records stay durable in the leader's own log and the
+                # heal-time catch-up re-reads them from the watermark
+                q.clear()
+                overflow = True
+            else:
+                overflow = False
+        if overflow:
+            self._degrade(shard, "ship-queue overflow")
+        self._wake()
+
+    def _wake(self) -> None:
+        loop, evt = self._loop, self._event
+        if loop is None or evt is None:
+            return
+        try:
+            loop.call_soon_threadsafe(evt.set)
+        except RuntimeError:
+            pass  # loop already closed (shutdown race)
+
+    # ----------------------------------------------------- leader: ship
+
+    async def _run(self) -> None:
+        while not self._stopping:
+            try:
+                await asyncio.wait_for(
+                    self._event.wait(), self.retry_interval
+                )
+            except asyncio.TimeoutError:
+                pass  # heal-probe tick for degraded shards
+            if self._stopping:
+                break
+            self._event.clear()
+            try:
+                await self._drain()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("ds repl drain failed")
+
+    async def _drain(self) -> None:
+        for shard in range(self.ds.n_shards):
+            if shard in self._degraded:
+                await self._try_heal(shard)
+                continue
+            while True:
+                with self._qlock:
+                    q = self._queues[shard]
+                    rng = q.popleft() if q else None
+                if rng is None:
+                    break
+                first, items = rng
+                wm = self.watermark[shard]
+                if first < wm:
+                    # overlap with a catch-up read: trim the resend
+                    items = [(o, p) for o, p in items if o >= wm]
+                    if not items:
+                        continue
+                    first = items[0][0]
+                if first > wm:
+                    # a hole (dropped backlog): catch-up owns the range
+                    self._degrade(shard, "ship-queue hole")
+                    break
+                if not await self._ship(shard, first, items):
+                    break
+
+    def _follower(self, shard: int) -> Optional[str]:
+        """Deterministic per-shard follower over the sorted up-peers,
+        sticky while the current pick stays up so a transient third-
+        node flap does not re-home every mirror."""
+        up = self.cluster.up_peers()
+        cur = self.followers.get(shard)
+        if cur is not None and cur in up:
+            return cur
+        peers = sorted(up)
+        if not peers:
+            return None
+        return peers[shard % len(peers)]
+
+    async def _ship(
+        self, shard: int, first: int, items: list, kind: str = "ship",
+        gap: int = 0,
+    ) -> bool:
+        """Ship one contiguous range; True advanced the watermark."""
+        follower = self._follower(shard)
+        if follower is None:
+            self._degrade(shard, "no follower peer up")
+            return False
+        link = self.cluster.links.get(follower)
+        if link is None or not link.connected:
+            self._degrade(shard, f"link to {follower} down")
+            return False
+        header = {
+            "node": self.cluster.name,
+            "shard": shard,
+            "first": first,
+            "count": len(items),
+        }
+        if kind == "reset":
+            # part of the window was GC'd: the mirror rebuilds at
+            # `first` and the gap below it is reported, not hidden
+            header["reset"] = True
+            header["gap"] = gap
+        t0 = time.perf_counter()
+        try:
+            if _fault.enabled():
+                a = await _fault.ainject(
+                    "ds.repl.send", err=ConnectionError
+                )
+                if a is not None and a.kind == "drop":
+                    raise ConnectionError("ds.repl.send dropped (fault)")
+            ack = await link.repl_request(
+                header, pack_records(items), timeout=self.ack_timeout
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._degrade(shard, f"{type(e).__name__}: {e}")
+            return False
+        if ack is None:
+            self._degrade(shard, f"link to {follower} down")
+            return False
+        if not ack.get("ok"):
+            need = ack.get("need")
+            if need is not None and int(need) < first:
+                # the follower's mirror ends short of this range (fresh
+                # follower / lost disk): pull the watermark back so the
+                # catch-up re-ships from where the mirror actually ends
+                self.watermark[shard] = max(
+                    self.base[shard], min(self.watermark[shard], int(need))
+                )
+                self._degrade(shard, f"follower behind at {need}")
+            else:
+                self._degrade(shard, str(ack.get("error", "nack")))
+            return False
+        end = int(ack.get("end", first + len(items)))
+        self.watermark[shard] = max(self.watermark[shard], end)
+        self.followers[shard] = follower
+        self.ships += 1
+        if _spans.enabled():
+            # the replication hop: leader flush handed off -> follower
+            # mirror fsync'd + acked (per-range, shm-leg style)
+            p = _spans.plane()
+            p.observe_stage("repl", time.perf_counter() - t0)
+        tp("ds.repl.ship", shard=shard, first=first, count=len(items),
+           follower=follower, watermark=end, catchup=(kind != "ship"),
+           gap=gap)
+        if self.metrics is not None:
+            self.metrics.inc("ds.repl.ranges")
+            self.metrics.inc("ds.repl.records", len(items))
+        return True
+
+    def _degrade(self, shard: int, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc("ds.repl.send_failures")
+        if shard in self._degraded:
+            return
+        self._degraded.add(shard)
+        self.degrades += 1
+        log.warning("ds repl shard %d degraded to leader-only: %s",
+                    shard, reason)
+        tp("ds.repl.degrade", shard=shard, state="degraded",
+           reason=reason)
+
+    async def _try_heal(self, shard: int) -> None:
+        """Heal probe for a degraded shard: when the follower link is
+        back, re-read `[watermark, durable_end)` from the leader's own
+        log and re-ship until caught up."""
+        follower = self._follower(shard)
+        if follower is None:
+            return
+        link = self.cluster.links.get(follower)
+        if link is None or not link.connected:
+            return
+        with self._qlock:
+            # queued RAM ranges are a subset of the catch-up window
+            self._queues[shard].clear()
+        shard_log = self.ds.logs[shard]
+        while True:
+            start = self.watermark[shard]
+            records, _nxt, gap = shard_log.read_from(
+                start, self.catchup_batch
+            )
+            if not records:
+                if gap:
+                    # the whole remaining window was GC'd out from
+                    # under the watermark; nothing left to ship
+                    self.watermark[shard] = shard_log.next_offset
+                break
+            kind = "reset" if gap else "catchup"
+            self._degraded.discard(shard)  # let _ship re-degrade on failure
+            ok = await self._ship(shard, records[0][0], records,
+                                  kind=kind, gap=gap)
+            if not ok:
+                return
+            if self.metrics is not None:
+                self.metrics.inc("ds.repl.catchup_ranges")
+            tp("ds.repl.catchup", shard=shard, first=records[0][0],
+               count=len(records), gap=gap)
+        self._degraded.discard(shard)
+        tp("ds.repl.degrade", shard=shard, state="healed")
+        log.info("ds repl shard %d healed (watermark=%d)",
+                 shard, self.watermark[shard])
+
+    # ------------------------------------------------- follower: mirror
+
+    def _adopt_mirrors(self) -> None:
+        """Re-adopt mirror chains left by a previous incarnation — the
+        takeover path reads them after a restart.  One-shot boot work
+        from __init__, like ShardLog._recover."""
+        if not os.path.isdir(self.mirror_dir):
+            return
+        for leader in sorted(os.listdir(self.mirror_dir)):
+            ldir = os.path.join(self.mirror_dir, leader)
+            if not os.path.isdir(ldir):
+                continue
+            for name in sorted(os.listdir(ldir)):
+                if not name.startswith("shard-"):
+                    continue
+                try:
+                    shard = int(name.split("-", 1)[1])
+                except ValueError:
+                    continue
+                try:
+                    self._open_mirror(leader, shard)
+                except (SegmentError, OSError):
+                    log.exception("mirror %s/%s unreadable; skipped",
+                                  leader, name)
+
+    def _open_mirror(
+        self, leader: str, shard: int, base: int = 0, reset: bool = False
+    ) -> ShardLog:
+        by = self.mirrors.setdefault(leader, {})
+        cur = by.get(shard)
+        path = os.path.join(self.mirror_dir, leader, f"shard-{shard}")
+        if reset and cur is not None:
+            cur.close()
+            shutil.rmtree(path, ignore_errors=True)
+            by.pop(shard, None)
+            cur = None
+        if cur is None:
+            cur = ShardLog(path, shard, seg_bytes=self.seg_bytes,
+                           base=base)
+            by[shard] = cur
+        return cur
+
+    def handle_repl(
+        self, peer: str, header: dict, payload: bytes
+    ) -> Optional[dict]:
+        """Transport `on_repl` handler: append one replicated range to
+        the mirror of the leader's shard and ack the durable end.  Runs
+        on the server read loop (like on_forward); the append is one
+        batched write+fsync — the same budget the leader's own flush
+        pays.  Returning None (fault drop) sends no ack: the leader
+        times out and degrades, exactly like real ack loss."""
+        if _fault.enabled():
+            a = _fault.inject("ds.repl.ack", err=False)
+            if a is not None:
+                if a.kind == "drop":
+                    return None
+                if a.kind == "error":
+                    return {"ok": False, "error": "ds.repl.ack fault"}
+        leader = str(header.get("node") or peer)
+        shard = int(header.get("shard", 0))
+        first = int(header.get("first", 0))
+        items = unpack_records(first, payload)
+        try:
+            mirror = self._open_mirror(
+                leader, shard, base=first,
+                reset=bool(header.get("reset")),
+            )
+            end = mirror.next_offset
+            if first > end:
+                return {"ok": False, "need": end}
+            if first < end:
+                items = [(o, p) for o, p in items if o >= end]
+            if items:
+                mirror.append_payloads(items)
+        except (SegmentError, OSError) as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        new_end = mirror.next_offset
+        tp("ds.repl.mirror", leader=leader, shard=shard, first=first,
+           count=len(items), end=new_end)
+        if self.metrics is not None:
+            self.metrics.inc("ds.repl.mirror_appends")
+        return {"ok": True, "end": new_end}
+
+    # ------------------------------------------------ takeover support
+
+    def mirror_state(self, leader: str) -> Dict[int, Tuple[int, int]]:
+        """Per-shard (oldest, end) coverage of this node's mirror of
+        `leader`'s log — the takeover RPC's handoff negotiation."""
+        return {
+            shard: (m.oldest_offset, m.next_offset)
+            for shard, m in self.mirrors.get(leader, {}).items()
+        }
+
+    def mirror_log(self, leader: str, shard: int) -> Optional[ShardLog]:
+        return self.mirrors.get(leader, {}).get(shard)
+
+    def absorb_tail(
+        self, leader: str, tail: Dict[int, dict]
+    ) -> Dict[int, dict]:
+        """Fold a takeover's shipped tail into the local mirror wherever
+        it extends the chain contiguously — making it durable before
+        the client resumes.  Returns the ranges that could not be
+        absorbed (they replay from RAM, surviving only this process)."""
+        rest: Dict[int, dict] = {}
+        for shard, info in tail.items():
+            records = [
+                base64.b64decode(x) for x in (info.get("records") or [])
+            ]
+            first = int(info.get("first", 0))
+            if not records:
+                if info.get("gap"):
+                    rest[shard] = info
+                continue
+            try:
+                mirror = self.mirrors.get(leader, {}).get(shard)
+                if mirror is None:
+                    mirror = self._open_mirror(leader, shard, base=first)
+                if mirror.next_offset == first:
+                    mirror.append_payloads(
+                        [(first + i, p) for i, p in enumerate(records)]
+                    )
+                    if info.get("gap"):
+                        rest[shard] = {
+                            "first": first, "records": [],
+                            "gap": info["gap"],
+                        }
+                    continue
+            except (SegmentError, OSError):
+                log.exception("tail absorb failed for %s shard %d",
+                              leader, shard)
+            rest[shard] = info
+        return rest
+
+    # ------------------------------------------------------ observation
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self._degraded)
+
+    def degraded_shards(self) -> List[int]:
+        return sorted(self._degraded)
+
+    def lag(self) -> int:
+        """Records appended-durably but not yet follower-acked, summed
+        over shards (the watermark exposure this instant)."""
+        return sum(
+            max(0, self.ds.logs[k].next_offset - self.watermark[k])
+            for k in range(self.ds.n_shards)
+        )
+
+    def stats(self) -> dict:
+        return {
+            "base": dict(self.base),
+            "watermark": dict(self.watermark),
+            "followers": dict(self.followers),
+            "degraded": self.degraded_shards(),
+            "lag": self.lag(),
+            "ships": self.ships,
+            "degrades": self.degrades,
+            "mirrors": {
+                leader: {
+                    shard: [m.oldest_offset, m.next_offset]
+                    for shard, m in by.items()
+                }
+                for leader, by in self.mirrors.items()
+            },
+        }

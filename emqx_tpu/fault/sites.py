@@ -49,6 +49,13 @@ SITES: Dict[str, str] = {
     "shm.submit": "worker-side submit-ring enqueue (drop/error/corrupt "
                   "= the tick is served from the local host trie — the "
                   "degrade path the hub-death ladder rides)",
+    # ds append replication (ds/repl.py)
+    "ds.repl.send": "leader-side ship of one flushed range (delay = "
+                    "slow follower hop; drop/error = the ship fails "
+                    "and the shard degrades to leader-only appends)",
+    "ds.repl.ack": "follower-side mirror append + ack (drop = range "
+                   "discarded unacked, the leader times out like real "
+                   "ack loss; error = explicit nack)",
 }
 
 # Sites whose injector runs SYNCHRONOUSLY on the asyncio event-loop
@@ -59,4 +66,6 @@ SITES: Dict[str, str] = {
 # sites around them (transport.dial/recv) instead.  ckpt.* runs on
 # worker/boot threads and the engine collect paths block by design
 # (a delay there IS the simulated device stall), so they stay eligible.
-LOOP_SYNC_SITES = frozenset({"transport.send", "cluster.forward"})
+LOOP_SYNC_SITES = frozenset(
+    {"transport.send", "cluster.forward", "ds.repl.ack"}
+)  # ds.repl.ack fires in the server read-loop's REPL handler

@@ -47,7 +47,7 @@ log = logging.getLogger("emqx_tpu.node")
 
 
 def poll_health_alarms(engine, cluster, alarms: AlarmManager,
-                       ckpt=None) -> None:
+                       ckpt=None, ds_repl=None) -> None:
     """Raise/clear the self-healing alarms from observed state.
 
     Polled (node ticker, chaos soak) rather than pushed so the alarm
@@ -85,6 +85,21 @@ def poll_health_alarms(engine, cluster, alarms: AlarmManager,
         # checkpoint write()/restore() run on worker threads and only
         # RECORD alarm transitions; the publish happens here, on-loop
         ckpt.poll_alarm()
+    # ds replication (ds/repl.py): degraded shards append leader-only
+    # until the follower hop heals; appends never block on this
+    if ds_repl is not None:
+        if ds_repl.degraded:
+            alarms.activate(
+                "ds_repl_degraded",
+                details={
+                    "shards": ds_repl.degraded_shards(),
+                    "lag": ds_repl.lag(),
+                },
+                message="ds replication degraded: appends are "
+                        "leader-only until the follower hop heals",
+            )
+        elif alarms.is_active("ds_repl_degraded"):
+            alarms.deactivate("ds_repl_degraded")
     if cluster is None:
         return
     dropped = getattr(cluster, "spool_dropped", 0)
@@ -331,6 +346,20 @@ class NodeRuntime:
                 self.broker, ddir, self.conf, metrics=self.broker.metrics
             )
             self.broker.ds = self.ds
+
+        # ---- ds append replication (ds/repl.py) ------------------------
+        # leader->follower shipment of flushed ranges + mirror serving;
+        # construction wires the flush hooks and the REPL frame handler,
+        # the drain task starts after cluster.start()
+        self.ds_repl = None
+        if (self.ds is not None and self.cluster is not None
+                and self.conf.get("ds.repl.enable")):
+            from .ds.repl import DsReplicator
+
+            self.ds_repl = DsReplicator(
+                self.cluster, self.ds, self.conf,
+                metrics=self.broker.metrics,
+            )
 
         # ---- persistence (5.4 checkpoint/resume) -----------------------
         self.persistence = None
@@ -981,6 +1010,10 @@ class NodeRuntime:
                     await asyncio.to_thread(self.ckpt.reconcile_sessions)
             if self.cluster is not None:
                 await self.cluster.start()
+            if self.ds_repl is not None:
+                # drain task needs the running loop; the PeerLinks it
+                # ships over exist once cluster.start() returned
+                self.ds_repl.start()
             if self.bridges is not None:
                 # a down endpoint is DISCONNECTED + retried, not a boot
                 # failure (reference bridges start async the same way)
@@ -1078,6 +1111,11 @@ class NodeRuntime:
                 await self.delivery_pool.stop()
             except Exception:
                 log.exception("stopping delivery pool")
+        if self.ds_repl is not None:
+            try:
+                await self.ds_repl.stop()  # before the links it ships over
+            except Exception:
+                log.exception("stopping ds replicator")
         if self.cluster is not None:
             await self.cluster.stop()
         if self.bridges is not None:
@@ -1181,7 +1219,7 @@ class NodeRuntime:
         (itself a broker publish) never runs on an engine collect
         thread: the device breaker and the forward-spool overflow."""
         poll_health_alarms(self.broker.engine, self.cluster, self.alarms,
-                           ckpt=self.ckpt)
+                           ckpt=self.ckpt, ds_repl=self.ds_repl)
 
     def _refresh_stats(self) -> None:
         """Periodic gauges (`emqx_stats` setstat points).  `stats.enable`

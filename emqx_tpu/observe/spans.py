@@ -105,6 +105,9 @@ KNOWN_STAGES: Dict[str, str] = {
     "fuse_wait": "hub drain pick-up -> fused foreign_submit group",
     "device": "foreign_submit -> hub device collect finished",
     "scatter": "result slot committed -> worker drain decoded it",
+    # ds replication hop (ds/repl.py; per shipped range, like the shm
+    # legs per-tick): prices the durability cost of the second node
+    "repl": "leader flush handed off -> follower mirror fsync'd + acked",
 }
 
 _RECENT = 256  # completed-span ring (newest-first render)

@@ -85,6 +85,18 @@ KNOWN_KINDS: Dict[str, str] = {
     "ds.replay": "session resume rebuilt its mqueue from the log cursor",
     "ds.gc": "retention GC dropped one sealed generation (forced = past "
              "a lagging cursor; replay reports the gap)",
+    # ds append replication (ds/repl.py + cluster/node.py takeover)
+    "ds.repl.ship": "leader shipped one flushed range; the follower's "
+                    "ack advanced the replicated watermark",
+    "ds.repl.mirror": "follower appended a replicated range to its "
+                      "mirror shard log (fsync'd before the ack left)",
+    "ds.repl.degrade": "shard replication degraded to leader-only "
+                       "appends, or healed (state field)",
+    "ds.repl.catchup": "heal-time catch-up re-shipped a range read "
+                       "back from the leader's own durable log",
+    "ds.repl.handoff": "cross-node takeover served/imported in cursor-"
+                       "handoff form — session + unreplicated tail, "
+                       "never a materialized queue",
     # retained device index (models/retained.py + broker/retainer.py):
     # bucketed name index probed by batched compact dispatches, trie/
     # index arbitration mirroring the publish engine

@@ -1,0 +1,393 @@
+"""Replicated durable log (`emqx_tpu/ds/repl.py`): leader->follower
+append shipment over PeerLinks, the per-shard replicated watermark,
+the degrade-to-leader-only ladder + `ds_repl_degraded` alarm, and the
+O(1) cursor-handoff takeover (`cluster/node.py` session_takeover v2).
+
+The chaos soak (`make repl-soak`) proves the kill -9 invariants; these
+tests pin the protocol pieces — record blob framing, mirror append
+idempotency, watermark advance, fault-driven degrade/heal, and
+exactly-once delivery across a cursor handoff with and without a
+usable mirror.
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu import fault
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.client import MqttClient
+from emqx_tpu.broker.listener import Listener
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.persist import SessionPersistence
+from emqx_tpu.cluster import ClusterBroker, ClusterNode
+from emqx_tpu.config.config import Config
+from emqx_tpu.ds.manager import DsManager
+from emqx_tpu.ds.repl import DsReplicator, pack_records, unpack_records
+from emqx_tpu.node import poll_health_alarms
+from emqx_tpu.observe.alarm import AlarmManager
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    fault.reset()
+    yield
+    fault.reset()
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield lambda coro: loop.run_until_complete(asyncio.wait_for(coro, 30))
+    loop.close()
+
+
+async def wait_until(pred, timeout=10.0, ivl=0.02):
+    t = 0.0
+    while not pred():
+        await asyncio.sleep(ivl)
+        t += ivl
+        if t > timeout:
+            raise AssertionError("condition not reached")
+
+
+def msg(topic="a/b", payload=b"x", qos=1, **kw):
+    return Message(topic=topic, payload=payload, qos=qos, **kw)
+
+
+def repl_conf(**over):
+    d = {"enable": True, "shards": 2, "flush_bytes": 1 << 20,
+         "seg_bytes": 1 << 20, "repl.enable": True,
+         "repl.ack_timeout": 1.0, "repl.retry_interval": 0.1}
+    d.update(over)
+    return Config({"ds": d})
+
+
+class FakeCluster:
+    """Follower-side unit-test stand-in: handle_repl/absorb_tail never
+    touch links or peers."""
+
+    name = "fake"
+    links: dict = {}
+
+    def up_peers(self):
+        return []
+
+    def attach_ds_repl(self, repl):
+        self.ds_repl = repl
+
+
+def mk_repl(tmp_path, sub="n0", **over):
+    b = Broker()
+    conf = repl_conf(**over)
+    ds = DsManager(b, str(tmp_path / sub / "ds"), conf, metrics=b.metrics)
+    b.ds = ds
+    repl = DsReplicator(FakeCluster(), ds, conf, metrics=b.metrics)
+    return b, ds, repl
+
+
+async def two_repl_nodes(tmp_path, names=("rp-a", "rp-b"),
+                         with_repl=(True, True), **over):
+    """Two full nodes (broker + ds + persistence + cluster + listener),
+    each optionally running a DsReplicator, cross-joined and up."""
+    nodes, listeners, repls = [], [], []
+    for name, wr in zip(names, with_repl):
+        b = ClusterBroker()
+        conf = repl_conf(**over)
+        ds = DsManager(b, str(tmp_path / name / "ds"), conf,
+                       metrics=b.metrics)
+        b.ds = ds
+        SessionPersistence(b)
+        node = ClusterNode(name, b, heartbeat_ivl=0.2)
+        repl = DsReplicator(node, ds, conf, metrics=b.metrics) if wr \
+            else None
+        await node.start()
+        if repl is not None:
+            repl.start()
+        lst = Listener(b, port=0)
+        await lst.start()
+        nodes.append(node)
+        listeners.append(lst)
+        repls.append(repl)
+    a, b = nodes
+    a.join(names[1], ("127.0.0.1", b.transport.port))
+    b.join(names[0], ("127.0.0.1", a.transport.port))
+    await wait_until(
+        lambda: names[1] in a.up_peers() and names[0] in b.up_peers()
+    )
+    return nodes, listeners, repls
+
+
+async def teardown(nodes, listeners, repls):
+    for lst in listeners:
+        await lst.stop()
+    for repl in repls:
+        if repl is not None:
+            await repl.stop()
+    for node in nodes:
+        await node.stop()
+        node.broker.ds.close()
+
+
+# ------------------------------------------------------------ framing
+
+def test_record_blob_roundtrip_and_torn_prefix():
+    items = [(7, b"alpha"), (8, b""), (9, b"x" * 300)]
+    blob = pack_records(items)
+    assert unpack_records(7, blob) == items
+    # torn blob (partial final record): whole-record prefix survives
+    assert unpack_records(7, blob[:-1]) == items[:2]
+    assert unpack_records(0, b"") == []
+
+
+# ---------------------------------------------------- follower mirror
+
+def test_mirror_append_is_idempotent_and_nacks_holes(tmp_path):
+    _b, _ds, repl = mk_repl(tmp_path)
+    blob = pack_records([(0, b"r0"), (1, b"r1")])
+    hdr = {"node": "ldr", "shard": 0, "first": 0, "count": 2}
+    assert repl.handle_repl("ldr", hdr, blob) == {"ok": True, "end": 2}
+    # duplicate retry (ack lost): trimmed, same durable end, no growth
+    assert repl.handle_repl("ldr", hdr, blob) == {"ok": True, "end": 2}
+    mirror = repl.mirror_log("ldr", 0)
+    recs, _n, gap = mirror.read_from(0, 10)
+    assert [p for _o, p in recs] == [b"r0", b"r1"] and gap == 0
+    # a range past the mirror end is a hole: nack with where we are
+    ack = repl.handle_repl(
+        "ldr", {"node": "ldr", "shard": 0, "first": 5, "count": 1},
+        pack_records([(5, b"r5")]))
+    assert ack == {"ok": False, "need": 2}
+    # a reset range rebuilds the mirror at its first offset (GC'd
+    # window below it is the leader's reported gap, not mirror bytes)
+    ack = repl.handle_repl(
+        "ldr", {"node": "ldr", "shard": 0, "first": 5, "count": 1,
+                "reset": True, "gap": 3},
+        pack_records([(5, b"r5")]))
+    assert ack == {"ok": True, "end": 6}
+    assert repl.mirror_state("ldr") == {0: (5, 6)}
+    repl.close_mirrors()
+
+
+def test_mirrors_readopted_across_restart(tmp_path):
+    b, ds, repl = mk_repl(tmp_path)
+    repl.handle_repl(
+        "ldr", {"node": "ldr", "shard": 1, "first": 0, "count": 2},
+        pack_records([(0, b"a"), (1, b"b")]))
+    repl.close_mirrors()
+    ds.close()
+    # a new incarnation over the same ds dir re-adopts the chain —
+    # the takeover path must survive a taker restart
+    _b2, ds2, repl2 = mk_repl(tmp_path)
+    assert repl2.mirror_state("ldr") == {1: (0, 2)}
+    recs, _n, _g = repl2.mirror_log("ldr", 1).read_from(0, 10)
+    assert [p for _o, p in recs] == [b"a", b"b"]
+    repl2.close_mirrors()
+    ds2.close()
+
+
+def test_absorb_tail_contiguous_folds_rest_returned(tmp_path):
+    import base64
+    _b, _ds, repl = mk_repl(tmp_path)
+    repl.handle_repl(
+        "ldr", {"node": "ldr", "shard": 0, "first": 0, "count": 2},
+        pack_records([(0, b"a"), (1, b"b")]))
+    b64 = lambda x: base64.b64encode(x).decode("ascii")  # noqa: E731
+    rest = repl.absorb_tail("ldr", {
+        0: {"first": 2, "records": [b64(b"c"), b64(b"d")], "gap": 0},
+        1: {"first": 9, "records": [b64(b"z")], "gap": 0},  # fresh chain
+    })
+    # shard 0 extended contiguously, shard 1 opened at its base — both
+    # durable now, nothing left to replay from RAM
+    assert rest == {}
+    assert repl.mirror_state("ldr") == {0: (0, 4), 1: (9, 10)}
+    # a non-contiguous range cannot fold (mirror would lie about the
+    # hole): it stays in the RAM rest for the resume to replay
+    rest = repl.absorb_tail("ldr", {
+        0: {"first": 7, "records": [b64(b"q")], "gap": 0},
+    })
+    assert set(rest) == {0} and repl.mirror_state("ldr")[0] == (0, 4)
+    repl.close_mirrors()
+
+
+# ------------------------------------------- leader ship + watermark
+
+def test_ship_advances_watermark_and_mirrors_bytes(run, tmp_path):
+    async def main():
+        (na, nb), lsts, (ra, rb) = await two_repl_nodes(tmp_path)
+        ds = na.broker.ds
+        for i in range(10):
+            ds.append(msg(topic=f"t/{i}", payload=f"p{i}".encode()))
+        ds.flush_all()  # on_flush hook queues the ranges; drain ships
+        await wait_until(lambda: ra.lag() == 0)
+        assert ra.ships >= 1 and not ra.degraded
+        assert na.broker.metrics.get("ds.repl.ranges") >= 1
+        assert na.broker.metrics.get("ds.repl.records") == 10
+        # every shard's mirror on B is byte-identical to A's log
+        for k, shard_log in enumerate(ds.logs):
+            end = shard_log.next_offset
+            assert ra.watermark[k] == end
+            if end == 0:
+                continue
+            mirror = rb.mirror_log("rp-a", k)
+            want, _n, _g = shard_log.read_from(0, 100)
+            got, _n, gap = mirror.read_from(0, 100)
+            assert got == want and gap == 0
+        assert nb.broker.metrics.get("ds.repl.mirror_appends") >= 1
+        await teardown((na, nb), lsts, (ra, rb))
+
+    run(main())
+
+
+def test_fault_degrade_keeps_flushing_then_heals_with_alarm(
+        run, tmp_path):
+    async def main():
+        (na, nb), lsts, (ra, rb) = await two_repl_nodes(tmp_path)
+        ds = na.broker.ds
+        alarms = AlarmManager(node="t")
+        fault.configure({"ds.repl.send": {"action": "drop"}}, seed=7)
+        for i in range(4):
+            ds.append(msg(topic=f"d/{i}", payload=f"p{i}".encode()))
+        ds.flush_all()
+        await wait_until(lambda: ra.degraded)
+        # the flush path never blocks on the dead follower hop:
+        # leader-only appends stay durable locally while degraded
+        for i in range(4, 8):
+            ds.append(msg(topic=f"d/{i}", payload=f"p{i}".encode()))
+        ds.flush_all()
+        assert sum(log.next_offset for log in ds.logs) == 8
+        assert all(b.pending_count() == 0 for b in ds.buffers)
+        assert ra.lag() > 0
+        poll_health_alarms(na.broker.engine, None, alarms, ds_repl=ra)
+        a = alarms.is_active("ds_repl_degraded")
+        assert a and alarms.active["ds_repl_degraded"].details["lag"] > 0
+        # heal: the retry tick catches up [watermark, durable_end)
+        # from the leader's own log and the alarm clears
+        fault.reset()
+        await wait_until(lambda: not ra.degraded and ra.lag() == 0)
+        assert na.broker.metrics.get("ds.repl.catchup_ranges") >= 1
+        poll_health_alarms(na.broker.engine, None, alarms, ds_repl=ra)
+        assert not alarms.is_active("ds_repl_degraded")
+        for k, shard_log in enumerate(ds.logs):
+            if shard_log.next_offset == 0:
+                continue
+            want, _n, _g = shard_log.read_from(0, 100)
+            got, _n, _g = rb.mirror_log("rp-a", k).read_from(0, 100)
+            assert got == want
+        await teardown((na, nb), lsts, (ra, rb))
+
+    run(main())
+
+
+# -------------------------------------------- cursor-handoff takeover
+
+async def _park_and_publish(na, la, n, topic_prefix="inbox/ho-1"):
+    """Park a persistent session on A, then publish n QoS1 messages
+    that land in A's durable log (dispatch-time parked-path append)."""
+    c = MqttClient(clientid="ho-1", clean_start=False,
+                   properties={17: 300})
+    await c.connect(port=la.port)
+    await c.subscribe(f"{topic_prefix}/#", qos=1)
+    await c.close()
+    await asyncio.sleep(0.1)
+    assert na.broker.cm.pending["ho-1"][0].ds_cursor is not None
+    for i in range(n):
+        na.broker.publish(msg(topic=f"{topic_prefix}/{i}",
+                              payload=f"m{i}".encode()))
+    await asyncio.sleep(0.05)
+    na.broker.ds.flush_all()
+
+
+async def _drain_payloads(c, n):
+    got = []
+    for _ in range(n):
+        m = await asyncio.wait_for(c.recv(), 5)
+        got.append(m.payload)
+    # no duplicate straggler: exactly-once means silence after n
+    with pytest.raises(asyncio.TimeoutError):
+        await asyncio.wait_for(c.recv(), 0.3)
+    return got
+
+
+def test_cursor_handoff_takeover_delivers_exactly_once(run, tmp_path):
+    async def main():
+        (na, nb), lsts, (ra, rb) = await two_repl_nodes(tmp_path)
+        await _park_and_publish(na, lsts[0], 6)
+        await wait_until(lambda: ra.lag() == 0)  # fully replicated
+
+        c2 = MqttClient(clientid="ho-1", clean_start=False)
+        ack = await c2.connect(port=lsts[1].port)
+        assert ack.session_present
+        got = await _drain_payloads(c2, 6)
+        assert sorted(got) == sorted(f"m{i}".encode() for i in range(6))
+        # handoff form was used (never the materialized queue) and the
+        # cursor re-homed to B's own log
+        assert na.broker.metrics.get("ds.repl.handoffs") == 1
+        sess = nb.broker.cm.channels["ho-1"].session
+        assert sess.ds_cursor_node is None
+        assert sess.ds_cursor is not None
+        assert "ho-1" not in na.broker.cm.pending
+        await c2.disconnect()
+        await teardown((na, nb), lsts, (ra, rb))
+
+    run(main())
+
+
+def test_takeover_during_repl_partition_no_double_delivery(
+        run, tmp_path):
+    """Replication is degraded (follower hop partitioned) when the
+    takeover runs: the taker's mirror holds only a prefix, the origin
+    ships the unreplicated tail, and delivery is still exactly-once —
+    the mirror window and the shipped tail never overlap-deliver."""
+    async def main():
+        (na, nb), lsts, (ra, rb) = await two_repl_nodes(tmp_path)
+        await _park_and_publish(na, lsts[0], 4)
+        await wait_until(lambda: ra.lag() == 0)  # prefix mirrored
+        fault.configure({"ds.repl.send": {"action": "drop"}}, seed=11)
+        for i in range(4, 7):  # unreplicated suffix (leader-only)
+            na.broker.publish(msg(topic=f"inbox/ho-1/{i}",
+                                  payload=f"m{i}".encode()))
+        await asyncio.sleep(0.05)
+        na.broker.ds.flush_all()
+        await wait_until(lambda: ra.degraded)
+        assert ra.lag() > 0
+
+        c2 = MqttClient(clientid="ho-1", clean_start=False)
+        ack = await c2.connect(port=lsts[1].port)
+        assert ack.session_present
+        got = await _drain_payloads(c2, 7)
+        assert sorted(got) == sorted(f"m{i}".encode() for i in range(7))
+        assert na.broker.metrics.get("ds.repl.handoffs") == 1
+        # the shipped tail was folded into B's mirror (durable before
+        # the client resumed): mirror end covers the suffix too
+        shard_ends = {}
+        for k, log in enumerate(na.broker.ds.logs):
+            if log.next_offset:
+                shard_ends[k] = log.next_offset
+        for k, end in shard_ends.items():
+            assert rb.mirror_log("rp-a", k).next_offset == end
+        fault.reset()
+        await c2.disconnect()
+        await teardown((na, nb), lsts, (ra, rb))
+
+    run(main())
+
+
+def test_takeover_without_mirror_falls_back_to_materialization(
+        run, tmp_path):
+    async def main():
+        # neither node runs a replicator: the v1/materialized path —
+        # the origin replays the log into the mqueue and ships it whole
+        (na, nb), lsts, repls = await two_repl_nodes(
+            tmp_path, with_repl=(False, False))
+        await _park_and_publish(na, lsts[0], 5)
+
+        c2 = MqttClient(clientid="ho-1", clean_start=False)
+        ack = await c2.connect(port=lsts[1].port)
+        assert ack.session_present
+        got = await _drain_payloads(c2, 5)
+        assert sorted(got) == sorted(f"m{i}".encode() for i in range(5))
+        assert na.broker.metrics.get("ds.repl.handoffs") == 0
+        await c2.disconnect()
+        await teardown((na, nb), lsts, repls)
+
+    run(main())

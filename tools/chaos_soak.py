@@ -32,6 +32,18 @@ Three phases per seed, all driven through the fault-injection plane
    Invariants: every committed message is replayed AT LEAST once, and
    receiver-side (mid) dedup makes delivery exactly-once.
 
+5. repl — ds append replication (`make repl-soak`): a leader child
+   streams appends while replicating to a follower child over a real
+   PeerLink; the parent `kill -9`s the LEADER mid-flush in one
+   sub-phase and the FOLLOWER mid-ack in the other, at seeded random
+   moments.  Invariants: every record at/below the leader-recorded
+   replicated watermark exists byte-identical in the follower's
+   recovered mirror (zero loss <= watermark), the mirror is always a
+   prefix of the leader's log (no invention, no reorder), replaying
+   the mirror delivers exactly-once under mid dedup, and a follower
+   kill never blocks the leader's append/flush path (progress keeps
+   advancing while degraded).
+
 Also asserts the disarmed plane is effectively free (sub-microsecond
 per fault point) so it can stay compiled into the bench hot path.
 """
@@ -465,6 +477,280 @@ def ds_phase(seed: int, verbose: bool) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+# ------------------------------------------------------------------ repl
+
+def _repl_config(shards: int = 2):
+    from emqx_tpu.config.config import Config
+
+    return Config({"ds": {
+        "enable": True,
+        "shards": shards,
+        "flush_bytes": 512,   # many flush (= ship) boundaries
+        "seg_bytes": 4096,    # frequent segment rolls under the stream
+        "repl.enable": True,
+        "repl.ack_timeout": 1.0,
+        "repl.retry_interval": 0.1,
+    }})
+
+
+def repl_follower_child(directory: str) -> None:
+    """Follower half of the repl front: a cluster node with a
+    DsReplicator mirroring whatever a leader ships at it.  Publishes
+    its transport port, then idles until SIGKILLed mid-ack."""
+    from emqx_tpu.ds.manager import DsManager
+    from emqx_tpu.ds.repl import DsReplicator
+
+    async def run() -> None:
+        b = ClusterBroker()
+        conf = _repl_config()
+        ds = DsManager(b, os.path.join(directory, "follower-ds"), conf,
+                       metrics=b.metrics)
+        b.ds = ds
+        node = ClusterNode("repl-f", b, heartbeat_ivl=0.2)
+        repl = DsReplicator(node, ds, conf)
+        await node.start()
+        repl.start()
+        port_file = os.path.join(directory, "follower-port")
+        tmp = port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(node.transport.port))
+        os.replace(tmp, port_file)
+        await asyncio.sleep(3600)  # until SIGKILL
+
+    asyncio.run(run())
+
+
+def repl_leader_child(directory: str) -> None:
+    """Leader half: joins the follower, appends a numbered QoS1 stream
+    with explicit flushes (each flush hands the range to the
+    replicator), and records — AFTER each flush returns — the appended
+    count plus a watermark snapshot.  The recorded watermark is always
+    <= what the follower has fsync'd and acked, so it is the loss
+    floor the parent verifies against the recovered mirror."""
+    import json as _json
+
+    from emqx_tpu.ds.manager import DsManager
+    from emqx_tpu.ds.repl import DsReplicator
+
+    async def run() -> None:
+        with open(os.path.join(directory, "follower-port")) as f:
+            port = int(f.read())
+        b = ClusterBroker()
+        conf = _repl_config()
+        ds = DsManager(b, os.path.join(directory, "leader-ds"), conf,
+                       metrics=b.metrics)
+        b.ds = ds
+        node = ClusterNode("repl-l", b, heartbeat_ivl=0.2)
+        repl = DsReplicator(node, ds, conf)
+        await node.start()
+        repl.start()
+        node.join("repl-f", ("127.0.0.1", port))
+        deadline = time.monotonic() + 20
+        while "repl-f" not in node.up_peers():
+            if time.monotonic() > deadline:
+                raise RuntimeError("leader never saw the follower up")
+            await asyncio.sleep(0.01)
+        prog = os.path.join(directory, "progress")
+        for i in range(200_000):  # bounded: can't run away if orphaned
+            ds.append(Message(
+                topic=f"soak/repl/{i % 5}", payload=str(i).encode(),
+                qos=1,
+            ))
+            await asyncio.sleep(0)  # let the drain task ship
+            if (i + 1) % 7 == 0:
+                ds.flush_all()
+                await asyncio.sleep(0.002)  # acks land, watermark moves
+                state = {
+                    "appended": i + 1,
+                    "watermark": {str(k): v
+                                  for k, v in repl.watermark.items()},
+                }
+                tmp = prog + ".tmp"
+                with open(tmp, "w") as f:
+                    _json.dump(state, f)
+                os.replace(tmp, prog)
+
+    asyncio.run(run())
+
+
+def _read_progress(path: str) -> dict:
+    import json as _json
+
+    with open(path) as f:
+        return _json.load(f)
+
+
+def repl_phase(seed: int, verbose: bool) -> dict:
+    """Both kill targets per seed: leader mid-flush, follower mid-ack."""
+    import json as _json
+    import shutil
+
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.persist import message_from_dict
+    from emqx_tpu.ds.log import ShardLog
+    from emqx_tpu.ds.manager import DsManager
+
+    out = {}
+    for victim in ("leader", "follower"):
+        rng = random.Random(f"repl:{seed}:{victim}")
+        d = tempfile.mkdtemp(prefix="chaos_repl_")
+        fproc = lproc = None
+        try:
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            me = os.path.abspath(__file__)
+            fproc = subprocess.Popen(
+                [sys.executable, me, "--repl-follower", d], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            )
+            port_file = os.path.join(d, "follower-port")
+            deadline = time.monotonic() + 30
+            while not os.path.exists(port_file):
+                if fproc.poll() is not None:
+                    err = fproc.stderr.read().decode(errors="replace")
+                    raise SoakFailure(f"repl follower died early: {err}")
+                if time.monotonic() > deadline:
+                    raise SoakFailure("repl follower never listened")
+                time.sleep(0.01)
+            lproc = subprocess.Popen(
+                [sys.executable, me, "--repl-leader", d], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            )
+            prog = os.path.join(d, "progress")
+            deadline = time.monotonic() + 30
+            while not os.path.exists(prog):
+                if lproc.poll() is not None:
+                    err = lproc.stderr.read().decode(errors="replace")
+                    raise SoakFailure(f"repl leader died early: {err}")
+                if time.monotonic() > deadline:
+                    raise SoakFailure("repl leader never flushed")
+                time.sleep(0.01)
+            time.sleep(rng.uniform(0.05, 0.8))
+            if victim == "leader":
+                os.kill(lproc.pid, signal.SIGKILL)
+                lproc.wait()
+                os.kill(fproc.pid, signal.SIGKILL)
+                fproc.wait()
+            else:
+                os.kill(fproc.pid, signal.SIGKILL)
+                fproc.wait()
+                # the leader's flush path must NOT block on the dead
+                # follower hop: appends keep committing while degraded
+                before = _read_progress(prog)["appended"]
+                deadline = time.monotonic() + 15
+                while _read_progress(prog)["appended"] <= before:
+                    if time.monotonic() > deadline:
+                        raise SoakFailure(
+                            f"seed {seed}: leader stopped flushing "
+                            f"after follower kill (blocked at {before})"
+                        )
+                    time.sleep(0.05)
+                os.kill(lproc.pid, signal.SIGKILL)
+                lproc.wait()
+            state = _read_progress(prog)
+            committed = int(state["appended"])
+            wm = {int(k): int(v)
+                  for k, v in state.get("watermark", {}).items()}
+
+            n_shards = 2
+            mirror_root = os.path.join(
+                d, "follower-ds", "mirror", "repl-l")
+            leader_seqs_below_wm = set()
+            all_leader_seqs = set()
+            mirror_records = 0
+            for k in range(n_shards):
+                llog = ShardLog(
+                    os.path.join(d, "leader-ds", f"shard-{k}"), k)
+                lrecs, _n, lgap = llog.read_from(0, 10 ** 6)
+                llog.close()
+                check(lgap == 0,
+                      f"seed {seed}/{victim}: leader log gap {lgap}")
+                for o, p in lrecs:
+                    seq = int(message_from_dict(
+                        _json.loads(p.decode())).payload)
+                    all_leader_seqs.add(seq)
+                    if o < wm.get(k, 0):
+                        leader_seqs_below_wm.add(seq)
+                mpath = os.path.join(mirror_root, f"shard-{k}")
+                if not os.path.isdir(mpath):
+                    check(wm.get(k, 0) == 0,
+                          f"seed {seed}/{victim}: watermark {wm.get(k)}"
+                          f" on shard {k} but no mirror on disk")
+                    continue
+                mlog = ShardLog(mpath, k)
+                mrecs, _n, mgap = mlog.read_from(0, 10 ** 6)
+                mlog.close()
+                check(mgap == 0,
+                      f"seed {seed}/{victim}: mirror gap {mgap}")
+                mirror_records += len(mrecs)
+                # zero loss at/below the watermark, and the mirror is
+                # a byte-identical prefix of the leader's log — the
+                # acked fsync ordering means a kill at ANY moment on
+                # either side cannot break these
+                check(
+                    len(mrecs) >= wm.get(k, 0),
+                    f"seed {seed}/{victim}: shard {k} mirror ends at "
+                    f"{len(mrecs)} < watermark {wm.get(k, 0)}",
+                )
+                check(
+                    mrecs == lrecs[:len(mrecs)],
+                    f"seed {seed}/{victim}: shard {k} mirror diverges "
+                    f"from the leader log",
+                )
+            check(
+                all_leader_seqs >= set(range(committed)),
+                f"seed {seed}/{victim}: leader lost committed records "
+                f"({sorted(set(range(committed)) - all_leader_seqs)[:5]})",
+            )
+
+            # exactly-once: a DsManager pointed at the recovered mirror
+            # (same dir/shard-<k> layout) replays a parked session —
+            # mid dedup turns at-least-once into exactly-once
+            mgr = DsManager(Broker(), mirror_root, _repl_config())
+            try:
+                session = Session(
+                    clientid="repl-soaker", expiry_interval=300,
+                    max_mqueue=0,
+                )
+                session.subscriptions["soak/repl/#"] = SubOpts(qos=1)
+                session.ds_cursor = {
+                    k: (0, 0) for k in range(mgr.n_shards)
+                }
+                mgr.replay_into(session)
+                seen_mids, seqs = set(), []
+                for m in session.mqueue.peek_all():
+                    if m.mid in seen_mids:
+                        continue
+                    seen_mids.add(m.mid)
+                    seqs.append(int(m.payload))
+                check(
+                    len(seqs) == len(set(seqs)),
+                    f"seed {seed}/{victim}: duplicate seqs out of the "
+                    f"mirror replay after mid dedup",
+                )
+                missing = leader_seqs_below_wm - set(seqs)
+                check(
+                    not missing,
+                    f"seed {seed}/{victim}: watermark-covered messages "
+                    f"lost (missing {sorted(missing)[:5]})",
+                )
+            finally:
+                mgr.close()
+            out[victim] = {
+                "committed": committed,
+                "watermark": sum(wm.values()),
+                "mirrored": mirror_records,
+            }
+            if verbose:
+                print(f"  repl/{victim}: {out[victim]}")
+        finally:
+            for proc in (fproc, lproc):
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+            shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 # -------------------------------------------------------------- overhead
 
 def overhead_check() -> float:
@@ -480,7 +766,7 @@ def overhead_check() -> float:
     return per_call
 
 
-FRONTS = ("cluster", "engine", "ckpt", "ds")
+FRONTS = ("cluster", "engine", "ckpt", "ds", "repl")
 
 
 def main() -> int:
@@ -492,10 +778,20 @@ def main() -> int:
                          f"(default: {','.join(FRONTS)})")
     ap.add_argument("--ds-child", default=None, metavar="DIR",
                     help=argparse.SUPPRESS)  # internal: ds-front child
+    ap.add_argument("--repl-leader", default=None, metavar="DIR",
+                    help=argparse.SUPPRESS)  # internal: repl-front child
+    ap.add_argument("--repl-follower", default=None, metavar="DIR",
+                    help=argparse.SUPPRESS)  # internal: repl-front child
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     if args.ds_child:
         ds_child(args.ds_child)
+        return 0
+    if args.repl_leader:
+        repl_leader_child(args.repl_leader)
+        return 0
+    if args.repl_follower:
+        repl_follower_child(args.repl_follower)
         return 0
     fronts = [f.strip() for f in args.fronts.split(",") if f.strip()]
     unknown = set(fronts) - set(FRONTS)
@@ -509,7 +805,7 @@ def main() -> int:
     failures = 0
     for seed in range(1, args.seeds + 1):
         t0 = time.monotonic()
-        cs = es = dss = {}
+        cs = es = dss = rps = {}
         try:
             if "cluster" in fronts:
                 cs = asyncio.run(cluster_phase(seed, args.verbose))
@@ -519,6 +815,8 @@ def main() -> int:
                 ckpt_phase(seed, args.verbose)
             if "ds" in fronts:
                 dss = ds_phase(seed, args.verbose)
+            if "repl" in fronts:
+                rps = repl_phase(seed, args.verbose)
         except SoakFailure as e:
             failures += 1
             print(f"seed {seed}: FAIL — {e}")
@@ -537,7 +835,9 @@ def main() -> int:
             f"(timeouts {es.get('dev_timeouts', 0)}, "
             f"trips {es.get('breaker_trips', 0)}), "
             f"ds kill-9 (committed {dss.get('committed', 0)}, "
-            f"delivered {dss.get('delivered', 0)})"
+            f"delivered {dss.get('delivered', 0)}), "
+            f"repl kill-9 (wm {rps.get('leader', {}).get('watermark', 0)}"
+            f"/{rps.get('follower', {}).get('watermark', 0)})"
         )
     if failures:
         print(f"{failures} seed(s) FAILED")
