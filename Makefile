@@ -4,7 +4,8 @@
 .PHONY: check check-json lint lint-fast lint-locks test test-fast \
         native bench restore-bench chaos ds-bench ds-dump ds-soak \
         churn-bench retained-bench fanout-bench span-bench prep-bench \
-        wire-bench shm-bench fleet-bench repl-soak takeover-bench
+        wire-bench shm-bench fleet-bench repl-soak takeover-bench \
+        semantic-bench
 
 # static-analysis gate (tools/analysis/): the dialyzer/xref/elvis
 # analog, stdlib-only — whole-project AST index + call graph, thread-
@@ -54,6 +55,11 @@ restore-bench:
 # the transfer-free kernel rate and the arbiter's picks recorded
 retained-bench:
 	python bench.py --retained
+
+# semantic subscription plane: device top-k vs host dense scorer sweep
+# + the e2e shm-hub leg (BENCH_TABLE.md "Semantic subscriptions")
+semantic-bench:
+	python bench.py --semantic
 
 # delivery-plane fan-out sweep: one filter, 1k/10k/50k/100k
 # subscribers; expansion vs the full wire path (scatter lane + shared

@@ -47,6 +47,10 @@ class PendingPublish:
     # sampled message-lifecycle span contexts riding this tick
     # (observe/spans.py; empty when the plane is disarmed)
     spans: List[object] = field(default_factory=list)
+    # in-flight semantic-plane tick riding the same three phases
+    # (semantic/plane.py _PendingPlane; None when the plane is off or
+    # has no live queries)
+    sem: Optional[object] = None
 
 
 @dataclass
@@ -121,6 +125,13 @@ class Broker:
         self.on_shared_removed: Optional[callable] = None
         self.shared_remote_nodes: Optional[callable] = None  # -> Set[str]
         self.forward_shared: Optional[callable] = None  # (node, msg, g, f)
+        # semantic subscription plane (semantic/plane.py, wired by the
+        # node when semantic.enable): `$semantic/<query>` filters bypass
+        # the trie/churn plane entirely and live here.  forward_semantic
+        # ships a matched message to the wire worker owning the remote
+        # queries (cluster layer; sem-tagged FORWARD frames).
+        self.semantic = None
+        self.forward_semantic: Optional[callable] = None  # (node, msg, qids)
 
     def _drop_fast_cb(self, cid: str) -> None:
         uid = self.subs._uids.get(cid)
@@ -143,6 +154,19 @@ class Broker:
         a duplicate subscribe (same client, same filter) takes no extra
         reference, so a later unsubscribe can never free a fid that
         routes/subscribers still use."""
+        # semantic filters are a subscription CLASS (the $share/
+        # discipline): they never touch the engine, churn WAL,
+        # checkpoint registry, or route oplog — the plane owns them
+        query = topiclib.parse_semantic(filt)
+        if query is not None:
+            if self.semantic is not None and \
+                    self.semantic.subscribe(clientid, query):
+                self._sub_count += 1
+                self.metrics.gauge_set(
+                    "subscriptions.count", self._sub_count
+                )
+            self.hooks.run("session.subscribed", (clientid, filt, opts))
+            return
         group, real = topiclib.parse_share(filt)
         fid = self.engine.add_filter(real)
         route = self._routes.get(fid)
@@ -184,6 +208,9 @@ class Broker:
         plain_pos: List[int] = []
         fids_out: List[Optional[int]] = [None] * len(filts)
         for i, f in enumerate(filts):
+            if topiclib.parse_semantic(f) is not None:
+                self.subscribe(clientid, f, opts)  # plane, no fid
+                continue
             group, real = topiclib.parse_share(f)
             if group is not None:  # shared: per-op semantics
                 self.subscribe(clientid, f, opts)
@@ -214,6 +241,16 @@ class Broker:
         return fids_out
 
     def unsubscribe(self, clientid: str, filt: str) -> None:
+        query = topiclib.parse_semantic(filt)
+        if query is not None:
+            if self.semantic is not None and \
+                    self.semantic.unsubscribe(clientid, query):
+                self._sub_count -= 1
+                self.metrics.gauge_set(
+                    "subscriptions.count", self._sub_count
+                )
+            self.hooks.run("session.unsubscribed", (clientid, filt))
+            return
         group, real = topiclib.parse_share(filt)
         fid = self.engine.fid_of(real)
         if fid is None:
@@ -276,6 +313,10 @@ class Broker:
             ):
                 del self._routes[fid]
             self.engine.remove_filter(real)
+        # semantic stragglers (filters list incomplete): the plane knows
+        # every query the client still holds
+        if self.semantic is not None:
+            self._sub_count -= self.semantic.client_down(clientid)
         self.metrics.gauge_set("subscriptions.count", self._sub_count)
 
     @property
@@ -342,6 +383,14 @@ class Broker:
                                    idx.shape_count)
             self.metrics.gauge_set("retained.index.entries",
                                    idx.entry_count)
+        # semantic plane: the plane owns its counters (engine's ride
+        # along in local mode), copied at the same observation points
+        if self.semantic is not None:
+            c.update(self.semantic.counters())
+            self.metrics.gauge_set("semantic.queries",
+                                   self.semantic.n_queries)
+            self.metrics.gauge_set("semantic.subscribers",
+                                   self.semantic.n_subs)
 
     # ---------------------------------------------------------- publish
 
@@ -380,6 +429,7 @@ class Broker:
         if todo:
             self._pre_match(todo)
         pending = None
+        sem = None
         if todo:
             topics = [m.topic for _, m in todo]
             pending = (
@@ -387,15 +437,22 @@ class Broker:
                 if prep is not None
                 else self.engine.match_submit(topics)
             )
+            if self.semantic is not None:
+                # meaning-match rides the same tick: device/hub work
+                # overlaps the engine's hash match
+                sem = self.semantic.submit([m.payload for _, m in todo])
         elif prep is not None:
             self.engine.prep_discard(prep)
         for ctx in ticked:
             _spans.mark(ctx, "submit")
-        return PendingPublish(todo, results, pending, spans=ticked)
+        return PendingPublish(todo, results, pending, spans=ticked,
+                              sem=sem)
 
     def publish_collect(self, pp: "PendingPublish") -> "PendingPublish":
         if pp.pending is not None:
             pp.matched = self.engine.match_collect_raw(pp.pending)
+        if pp.sem is not None:
+            self.semantic.collect(pp.sem)  # blocking half, loop-free
         for ctx in pp.spans:
             _spans.mark(ctx, "collect")
         return pp
@@ -407,8 +464,20 @@ class Broker:
             # once per connection — one vectored write per receiver per
             # tick instead of one write per (receiver, message)
             sink: Dict[int, Tuple[str, object, list]] = {}
-            for (i, msg), fids in zip(pp.todo, pp.matched):
+            sem_local: List[List[Tuple[str, str]]] = []
+            if pp.sem is not None:
+                sem_local, sem_remote = self.semantic.finish(pp.sem)
+                fwd = self.forward_semantic
+                for node, qids, k in sem_remote:
+                    # full message to the worker owning the queries —
+                    # the hub only ever saw the embed prefix
+                    if fwd is not None and fwd(node, pp.todo[k][1], qids):
+                        self.metrics.inc("semantic.forwards")
+            for k, ((i, msg), fids) in enumerate(zip(pp.todo, pp.matched)):
                 n = self._dispatch(msg, fids, sink=sink)
+                if k < len(sem_local):
+                    for cid, sfilt in sem_local[k]:
+                        n += self._deliver_to(cid, [sfilt], msg)
                 tp("dispatch_done", topic=msg.topic, mid=msg.mid, receivers=n)
                 pp.results[i] = n
                 if n == 0:
@@ -647,6 +716,20 @@ class Broker:
             self.metrics.inc("messages.delivered", delivered)
         return n + delivered
 
+    def dispatch_semantic_forwarded(self, msg: Message,
+                                    hub_qids: List[int]) -> int:
+        """Receiving side of a sem-tagged cluster forward: the origin
+        worker matched this message against the POOL's query table and
+        we own some of the hits — map the hub's qids to local queries
+        and deliver.  No re-match, no further forwarding (no loops)."""
+        if self.semantic is None:
+            return 0
+        self.metrics.inc("messages.forward.in")
+        n = 0
+        for cid, sfilt in self.semantic.deliver_remote(hub_qids):
+            n += self._deliver_to(cid, [sfilt], msg)
+        return n
+
     def dispatch_shared_forwarded(self, msg: Message, group: str, filt: str) -> int:
         """Receiving side of a TARGETED shared forward: deliver to one
         local member only — the origin owns cluster-wide responsibility
@@ -816,6 +899,8 @@ class Broker:
         """Lazily yield retained messages for a new subscription (v5
         retain-handling); large sets are consumed in paced batches by
         the connection (flow control, `emqx_retainer.erl:85-150`)."""
+        if topiclib.parse_semantic(filt) is not None:
+            return iter(())  # semantic filters match meaning, not names
         group, real = topiclib.parse_share(filt)
         if group is not None:
             return iter(())  # shared subs never get retained messages

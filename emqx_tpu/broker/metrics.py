@@ -98,6 +98,7 @@ PREDEFINED = [
     "ds.repl.mirror_appends",
     "ds.repl.catchup_ranges",
     "ds.repl.handoffs",
+    "ds.repl.mirror_gc",
     # self-healing cluster data plane (cluster/node.py forward spool)
     "messages.forward.spooled",
     "messages.forward.replayed",
@@ -112,6 +113,7 @@ PREDEFINED = [
     "messages.forward.dropped",
     "messages.shared.redispatched",
     "messages.dropped.no_shared_member",
+    "messages.forward.semantic",
     # host match-path hash-collision catch (Broker.on_collision hook)
     "match.hash_collision",
     # delivery plane (broker/delivery.py pool + listener vectored flush
@@ -147,6 +149,10 @@ PREDEFINED = [
     "shm.hub.ack_shed",
     "shm.hub.credit_exhausted",
     "shm.hub.doorbell_wakeups",
+    "shm.hub.sem_ticks",
+    "shm.hub.sem_texts",
+    "shm.hub.sem_res_drops",
+    "shm.hub.sem_churn",
     # exhook event dispatcher (exhook/manager.py)
     "exhook.events.dropped",
     "exhook.events.failed",
@@ -162,6 +168,19 @@ PREDEFINED = [
     "retained.index.collisions",
     "retained.index.fallbacks",
     "retained.index.refetches",
+    # semantic subscription plane (emqx_tpu/semantic/; synced by
+    # Broker.sync_engine_metrics from SemanticPlane.counters())
+    "semantic.queries.added",
+    "semantic.queries.removed",
+    "semantic.deliveries",
+    "semantic.degraded",
+    "semantic.dropped",
+    "semantic.forwards",
+    "semantic.matches.device",
+    "semantic.matches.host",
+    "semantic.flips",
+    "semantic.probes",
+    "semantic.refetches",
 ]
 
 

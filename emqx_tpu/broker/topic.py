@@ -21,6 +21,7 @@ HASH = "#"
 
 SHARE_PREFIX = "$share"
 QUEUE_PREFIX = "$queue"
+SEM_PREFIX = "$semantic"
 
 
 def words(topic: str) -> List[str]:
@@ -116,6 +117,22 @@ def parse_share(topic: str) -> Tuple[Optional[str], str]:
         if real:
             return QUEUE_PREFIX, real
     return None, topic
+
+
+def parse_semantic(topic: str) -> Optional[str]:
+    """Parse a semantic-subscription filter (the `$share/` discipline).
+
+    ``$semantic/<query>`` -> query text (which may itself contain '/');
+    anything else -> None.  Semantic filters are a subscription CLASS,
+    not a topic pattern: they bypass the trie/churn plane entirely
+    (emqx_tpu/semantic/) and never reach the match engine or the route
+    oplog.
+    """
+    if topic.startswith(SEM_PREFIX + "/"):
+        query = topic[len(SEM_PREFIX) + 1 :]
+        if query:
+            return query
+    return None
 
 
 def feed_var(var: str, value: str, topic: str) -> str:

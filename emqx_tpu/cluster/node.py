@@ -997,6 +997,41 @@ class ClusterNode:
             self.broker.metrics.inc("messages.forward.dropped")
         return bool(ok)
 
+    def forward_semantic(self, node: str, msg: Message,
+                         qids: Sequence[int]) -> bool:
+        """Targeted semantic forward: `node` owns hub queries `qids`
+        that matched this publish (the hub's K_SEM_RES "rem" section).
+        The FULL message rides a forward frame tagged with the qids —
+        the receiver maps hub->local and fans out; the hub itself only
+        ever saw the embed prefix.  Same send/relay/spool ladder as
+        :meth:`forward_shared`."""
+        header, payload = message_to_wire(msg)
+        header["sem_qids"] = [int(q) for q in qids]
+        link = self.links.get(node)
+        ok = False
+        direct = (
+            link is not None
+            and link.connected
+            and self._status.get(node) != "down"
+        )
+        if direct:
+            ok = link.send_nowait(tp.pack_forward(header, payload))
+        elif link is None:
+            relay = self._up_core_link(exclude=node)
+            if relay is not None:
+                h2 = dict(header, relay_to=node)
+                ok = relay.send_nowait(tp.pack_forward(h2, payload))
+        if ok:
+            self.broker.metrics.inc("messages.forward.semantic")
+            tracept("semantic.forward", node=node, n=len(qids))
+        elif msg.qos >= 1 and link is not None:
+            self._spool_put(node, header, payload)
+            self.broker.metrics.inc("messages.forward.semantic")
+            ok = True
+        else:
+            self.broker.metrics.inc("messages.forward.dropped")
+        return bool(ok)
+
     def dispatch_remote_shared(self, msgs: Sequence[Message]) -> int:
         """Origin-side dispatch for shared groups that have NO local
         member: pick one member-holding peer per (group, filt) and send
@@ -1039,14 +1074,17 @@ class ClusterNode:
             return None
         group = header.pop("shared_group", None)
         filt = header.pop("shared_filt", None)
+        sem_qids = header.pop("sem_qids", None)
         replay = header.pop("replay", None)
         span_t0 = header.pop("span_t0", None)
         mid = header.get("mid")
         if mid and header.get("qos", 0) >= 1:
             # exactly-once at this broker across spool replays/retries:
-            # (mid, group, filt) — a generic forward and a targeted
-            # shared forward of the SAME message are distinct deliveries
-            key = (mid, group or "", filt or "")
+            # (mid, group, filt) — a generic forward, a targeted shared
+            # forward, and a semantic forward of the SAME message are
+            # distinct deliveries
+            key = (mid, group or "",
+                   filt or ("$semantic" if sem_qids is not None else ""))
             seen = self._seen_fwd
             if key in seen:
                 seen.move_to_end(key)
@@ -1060,7 +1098,11 @@ class ClusterNode:
                 if len(seen) > DEDUP_WINDOW:
                     seen.popitem(last=False)
         msg = message_from_wire(header, payload)
-        if group is not None:
+        if sem_qids is not None:
+            # targeted semantic delivery: this node owns the matched
+            # hub queries (the origin never learns the query texts)
+            n = self.broker.dispatch_semantic_forwarded(msg, sem_qids)
+        elif group is not None:
             # targeted shared delivery: local members only (the origin
             # already owns cluster-wide responsibility for this copy)
             n = self.broker.dispatch_shared_forwarded(msg, group, filt)

@@ -694,6 +694,37 @@ SCHEMA: Dict[str, Dict[str, Field]] = {
                  "the core this lane pins to, derived from "
                  "shm.pin_cores; -1 = unpinned"),
     },
+    "semantic": {
+        # semantic subscription plane (emqx_tpu/semantic/): $semantic/<query>
+        # subscriptions match publishes on payload meaning — a deterministic
+        # feature-hash embedding + device top-k cosine over the hub-resident
+        # query table — instead of topic-name structure
+        "enable": Field(
+            "bool", False,
+            desc="accept $semantic/<query> subscription filters; off = "
+                 "the classifier rejects them and no embedding/query "
+                 "table is ever allocated"),
+        "dim": Field(
+            "int", 256, min=16, max=4096,
+            desc="embedding dimensionality of the feature-hash space; "
+                 "both sides of every cosine (query vector and publish "
+                 "vector) live in this many float32 lanes"),
+        "max_queries": Field(
+            "int", 4096, min=16,
+            desc="device query-table capacity (rows of [dim] f32 in "
+                 "HBM); adds past the cap are rejected and count in "
+                 "semantic.dropped"),
+        "topk": Field(
+            "int", 8, min=1, max=256,
+            desc="matches returned per publish: the top-k queries by "
+                 "cosine above the similarity threshold"),
+        "probe_interval": Field(
+            "duration", 10.0,
+            desc="while one semantic path (device top-k / exact host) "
+                 "serves, re-measure the other at most this often — "
+                 "the same EWMA arbiter contract as "
+                 "retainer.probe_interval"),
+    },
     "dashboard": {
         "listen_port": Field("int", 18083),
         "default_username": Field("str", "admin"),

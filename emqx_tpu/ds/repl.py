@@ -290,6 +290,11 @@ class DsReplicator:
             "shard": shard,
             "first": first,
             "count": len(items),
+            # retention floor: the leader's own log dropped everything
+            # below this, so the mirror may trim sealed segments wholly
+            # behind it — the follower's disk is bounded by the
+            # leader's retention, not by total history
+            "floor": self.ds.logs[shard].oldest_offset,
         }
         if kind == "reset":
             # part of the window was GC'd: the mirror rebuilds at
@@ -474,11 +479,35 @@ class DsReplicator:
         except (SegmentError, OSError) as e:
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
         new_end = mirror.next_offset
+        floor = int(header.get("floor", 0))
+        if floor > 0:
+            self._gc_mirror(mirror, leader, shard, floor)
         tp("ds.repl.mirror", leader=leader, shard=shard, first=first,
            count=len(items), end=new_end)
         if self.metrics is not None:
             self.metrics.inc("ds.repl.mirror_appends")
         return {"ok": True, "end": new_end}
+
+    def _gc_mirror(self, mirror: ShardLog, leader: str, shard: int,
+                   floor: int) -> int:
+        """Trim sealed mirror segments wholly behind the leader's
+        advertised retention floor.  The leader's own log already
+        dropped those offsets (it can never re-ship them, and a
+        takeover serves nothing below the leader's floor), so keeping
+        them would grow the follower's disk with total history instead
+        of the leader's retention window.  Whole sealed generations
+        only — the same unlink granularity as the leader's GC."""
+        dropped = 0
+        for seg in list(mirror.segments):
+            if seg.sealed and seg.end <= floor:
+                if mirror.drop_generation(seg.generation):
+                    dropped += 1
+        if dropped:
+            if self.metrics is not None:
+                self.metrics.inc("ds.repl.mirror_gc", dropped)
+            tp("ds.repl.mirror_gc", leader=leader, shard=shard,
+               floor=floor, dropped=dropped)
+        return dropped
 
     # ------------------------------------------------ takeover support
 

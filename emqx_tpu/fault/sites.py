@@ -49,6 +49,10 @@ SITES: Dict[str, str] = {
     "shm.submit": "worker-side submit-ring enqueue (drop/error/corrupt "
                   "= the tick is served from the local host trie — the "
                   "degrade path the hub-death ladder rides)",
+    "shm.sem.submit": "worker-side K_SEM semantic-tick enqueue "
+                      "(drop/error = the publish is matched by the "
+                      "worker's exact host path over its own queries — "
+                      "the semantic twin of shm.submit's degrade)",
     # ds append replication (ds/repl.py)
     "ds.repl.send": "leader-side ship of one flushed range (delay = "
                     "slow follower hop; drop/error = the ship fails "

@@ -330,6 +330,42 @@ class NodeRuntime:
         else:
             self.broker = Broker(engine=engine, retainer=retainer, shared=shared)
 
+        # ---- semantic subscription plane (emqx_tpu/semantic/) ----------
+        # `$semantic/<query>` filters match publishes on MEANING: the
+        # subscribe path classifies them into this plane ($share-style),
+        # never the trie/churn plane.  A wire worker runs the shm
+        # backend (payload ticks ride K_SEM to the hub's one table);
+        # everything else owns a device-resident SemanticEngine.
+        self.semantic = None
+        if self.conf.get("semantic.enable"):
+            from .semantic.plane import SemanticPlane
+
+            _sdim = int(self.conf.get("semantic.dim"))
+            _stopk = int(self.conf.get("semantic.topk"))
+            if self._engine_kind == "shm":
+                engine.sem_node = self.node_name
+                self.semantic = SemanticPlane(
+                    shm=engine, dim=_sdim, topk=_stopk
+                )
+            else:
+                from .semantic.engine import SemanticEngine
+
+                self.semantic = SemanticPlane(engine=SemanticEngine(
+                    dim=_sdim,
+                    max_queries=int(
+                        self.conf.get("semantic.max_queries")
+                    ),
+                    topk=_stopk,
+                    probe_interval=float(
+                        self.conf.get("semantic.probe_interval")
+                    ),
+                ))
+            self.broker.semantic = self.semantic
+            if self.cluster is not None:
+                # cross-worker hits ride FORWARD frames to the owning
+                # node (the $share forward discipline, qid-addressed)
+                self.broker.forward_semantic = self.cluster.forward_semantic
+
         # ---- durable message log (ds/) ---------------------------------
         # parked persistent sessions replay QoS>=1 offline traffic from
         # a shared, sharded append-only log instead of per-session

@@ -108,6 +108,9 @@ KNOWN_STAGES: Dict[str, str] = {
     # ds replication hop (ds/repl.py; per shipped range, like the shm
     # legs per-tick): prices the durability cost of the second node
     "repl": "leader flush handed off -> follower mirror fsync'd + acked",
+    # semantic subscription plane (semantic/plane.py; per publish that
+    # reached at least one $semantic query)
+    "sem": "publish accepted -> semantic match collected + fanned out",
 }
 
 _RECENT = 256  # completed-span ring (newest-first render)

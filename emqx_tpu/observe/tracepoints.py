@@ -152,6 +152,28 @@ KNOWN_KINDS: Dict[str, str] = {
                   "(shm.lane_credit) with records still queued; the "
                   "surplus carries over round-robin so siblings are "
                   "not starved",
+    "shm.semq": "hub applied a worker semantic-query churn record to "
+                "the shared query table (registry-of-record write, "
+                "the K_SEMQ twin of shm.churn)",
+    # semantic subscription plane (emqx_tpu/semantic/)
+    "semantic.query": "a $semantic query entered or left the query "
+                      "table (worker-local plane or hub registry)",
+    "semantic.degrade": "a publish was matched by the exact host path "
+                        "because the device/hub path was unavailable",
+    "semantic.flip": "the semantic arbiter switched serving path "
+                     "(device top-k <-> exact host) on EWMA rates",
+    "semantic.probe": "idle-path re-measure dispatched by the "
+                      "semantic arbiter (doubles as device warm-keep)",
+    "semantic.refetch": "device top-k overflowed threshold at kcap; "
+                        "dense re-fetch served the tick and kcap "
+                        "widened",
+    "semantic.forward": "origin broker forwarded a publish to a "
+                        "remote node's semantic subscribers by hub "
+                        "query id",
+    # ds append replication mirror retention (ds/repl.py)
+    "ds.repl.mirror_gc": "follower dropped sealed mirror generations "
+                         "wholly below the leader's retention floor "
+                         "(bounded-disk contract)",
 }
 
 

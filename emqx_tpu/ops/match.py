@@ -270,6 +270,38 @@ def compact_topk(matched: jax.Array, k: int) -> jax.Array:
     return jnp.stack(outs, axis=-1)  # [B, k]
 
 
+@functools.partial(jax.jit, static_argnames=("kcap",))
+def semantic_topk(table: jax.Array, valid: jax.Array, batch: jax.Array,
+                  *, kcap: int):
+    """Cosine top-k over a device-resident query-vector table.
+
+    ``table [Q, D]`` rows are pre-normalized query embeddings, ``valid
+    [Q]`` masks live rows, ``batch [B, D]`` pre-normalized publish
+    embeddings; cosine reduces to one matmul — the shape this device is
+    built for.  Returns ``(scores [B, kcap] f32, idxs [B, kcap] i32)``
+    descending per row, dead columns at score -2.0 / idx -1.
+
+    The k extraction is compact_topk's float sibling: kcap iterative
+    max+argmax+mask passes, no sort (duplicate scores are fine — argmax
+    ties break by lowest index, so passes never revisit a column).  kcap
+    is a static arg managed by the engine's adaptive-kcap discipline;
+    membership itself is decided host-side by the exact scorer over
+    these candidates, so float drift here can only cost a refetch,
+    never a wrong match set."""
+    scores = batch @ table.T  # [B, Q]
+    scores = jnp.where(valid[None, :], scores, jnp.float32(-2.0))
+    idx = jnp.arange(scores.shape[-1], dtype=jnp.int32)[None, :]
+    vals, idxs = [], []
+    m = scores
+    for _ in range(kcap):
+        mx = jnp.max(m, axis=-1)
+        am = jnp.argmax(m, axis=-1).astype(jnp.int32)
+        vals.append(mx)
+        idxs.append(jnp.where(mx > jnp.float32(-2.0), am, -1))
+        m = jnp.where(idx == am[:, None], jnp.float32(-2.0), m)
+    return jnp.stack(vals, axis=-1), jnp.stack(idxs, axis=-1)
+
+
 def make_topic_batch(ta: np.ndarray, tb: np.ndarray, ln: np.ndarray, dl: np.ndarray, device=None) -> TopicBatch:
     put = lambda a: jax.device_put(a, device)
     return TopicBatch(put(ta), put(tb), put(ln), put(dl))

@@ -1,0 +1,31 @@
+"""TPU-native semantic subscription plane.
+
+`$semantic/<query>` subscriptions match publishes on MEANING instead of
+topic levels (the Neural Router routing primitive, PAPERS.md arxiv
+2605.25701).  Query vectors live device-resident like the retained-index
+entry plane; publish payloads embed in batches and top-k cosine matches
+ride the same submit/collect split as the hash-match engine, with an
+exact host-side scorer as the honest fallback and the retainer's EWMA
+rate arbiter picking the path.
+
+Layout:
+  embedder.py  deterministic feature-hash/bag-of-ngrams text embedder
+  table.py     query-vector registry + HBM mirror (dirty-row sync)
+  engine.py    submit/collect match engine, adaptive kcap, arbiter
+  plane.py     broker-facing subscription plane (local + shm backends)
+"""
+
+from .embedder import EMBED_PREFIX, SIM_THRESHOLD, embed_batch, embed_text
+from .engine import SemanticEngine
+from .plane import SemanticPlane
+from .table import SemanticTable
+
+__all__ = [
+    "EMBED_PREFIX",
+    "SIM_THRESHOLD",
+    "SemanticEngine",
+    "SemanticPlane",
+    "SemanticTable",
+    "embed_batch",
+    "embed_text",
+]

@@ -1,0 +1,100 @@
+"""Deterministic feature-hash text embedder (the plane's model stub).
+
+The semantic plane's contract is the DISPATCH architecture — device-
+resident query table, batched payload embedding, top-k cosine through
+the submit/collect split — not the embedding model.  This embedder is
+the dependency-free stand-in: lowercase word tokens plus char-3-gram
+shingles, FNV-1a hashed into a fixed-dim signed feature vector, L2
+normalized.  Swapping in a learned encoder changes only this module.
+
+Everything here is bit-deterministic (no `hash()`, which is salted per
+process): the same text embeds to the same vector on every worker, the
+hub, and the test oracle — the property the bit-agreement acceptance
+test leans on.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+import numpy as np
+
+# Only this many payload BYTES are ever embedded: K_SEM ring ticks must
+# stay slot-sized, and bag-of-features saturates long before 2 KiB.
+EMBED_PREFIX = 2048
+
+# Cosine floor for membership: a query matches a publish iff the EXACT
+# host-side cosine is >= this.  The device kernel only NOMINATES
+# candidates (see engine.py), so the constant defines the match set on
+# every path identically.
+SIM_THRESHOLD = 0.30
+
+# Device scores may drift from the host's f32 arithmetic by float
+# reassociation; candidates are safe to trust only when the kcap-th
+# device score is below SIM_THRESHOLD - SIM_MARGIN (else: refetch).
+SIM_MARGIN = 1e-3
+
+_WORD_RE = re.compile(r"[a-z0-9]+")
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _fnv64(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _MASK64
+    return h
+
+
+def _features(text: str) -> List[str]:
+    """Word unigrams + char 3-gram shingles (NUL-prefixed so a 3-letter
+    word and its own shingle land in different hash buckets)."""
+    words = _WORD_RE.findall(text.lower())
+    feats = list(words)
+    for w in words:
+        if len(w) > 3:
+            for i in range(len(w) - 2):
+                feats.append("\x00" + w[i:i + 3])
+    return feats
+
+
+def embed_text(text: str, dim: int, out: Optional[np.ndarray] = None) -> np.ndarray:
+    """One L2-normalized [dim] f32 feature-hash embedding."""
+    if out is None:
+        vec = np.zeros(dim, dtype=np.float32)
+    else:
+        vec = out
+        vec[:] = 0.0
+    for f in _features(text):
+        h = _fnv64(f.encode("utf-8", "surrogatepass"))
+        idx = h % dim
+        vec[idx] += 1.0 if (h >> 63) == 0 else -1.0
+    n = float(np.sqrt(np.dot(vec, vec)))
+    if n > 0.0:
+        vec /= np.float32(n)
+    return vec
+
+
+def embed_batch(texts: List[str], dim: int,
+                out: Optional[np.ndarray] = None) -> np.ndarray:
+    """[B, dim] f32, row b = embed_text(texts[b]).  ``out`` recycles a
+    staging buffer (rows past len(texts) are zeroed: padded rows have
+    norm 0 and cosine 0 against everything, below any threshold)."""
+    if out is None:
+        out = np.zeros((len(texts), dim), dtype=np.float32)
+    for b, t in enumerate(texts):
+        embed_text(t, dim, out=out[b])
+    if out.shape[0] > len(texts):
+        out[len(texts):] = 0.0
+    return out
+
+
+def payload_text(payload: bytes) -> str:
+    """The embeddable view of a publish payload: a bounded UTF-8 prefix
+    with NULs stripped (the shm lane packs texts into NUL-separated
+    blobs, and the embedder never assigns NUL tokens any weight)."""
+    txt = payload[:EMBED_PREFIX].decode("utf-8", "replace")
+    return txt.replace("\x00", " ")

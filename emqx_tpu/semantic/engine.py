@@ -1,0 +1,338 @@
+"""Semantic match engine: batched cosine top-k with an honest oracle.
+
+The dispatch shape is the retained-index probe plane's: publish texts
+embed into a recycled staging buffer, upload as ONE array, and the
+device answers with `(scores, idxs)` candidates under a static adaptive
+``kcap`` (ops.match.semantic_topk).  Membership is then decided HOST-
+side by re-scoring the candidates with the exact numpy arithmetic the
+oracle uses — the device only NOMINATES, so the matched set is
+bit-identical to the exact scorer by construction; float drift can only
+cost a refetch (kcap saturated near the threshold -> dense host scoring
+for that row + a wider kcap next tick).
+
+Path choice between this and the all-host dense scorer is the EWMA
+rate arbiter lifted from broker/retainer.py: serve whichever path
+measures faster, refresh the losing path's rate with bounded probes,
+and count every flip.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observe.tracepoints import tp
+from ..ops.match import next_pow2
+from .embedder import SIM_MARGIN, SIM_THRESHOLD, embed_batch, embed_text
+from .table import SemanticTable
+
+_STAGING_POOL = 4  # recycled upload buffers kept per batch size
+_PROBE_CAP = 64  # biggest batch a rate probe will ship
+
+
+class _PendingSem:
+    """One in-flight device tick (submit/collect split)."""
+
+    __slots__ = ("scores", "idxs", "buf", "B", "n", "kcap", "t0")
+
+    def __init__(self, scores, idxs, buf, B, n, kcap, t0):
+        self.scores = scores
+        self.idxs = idxs
+        self.buf = buf
+        self.B = B
+        self.n = n
+        self.kcap = kcap
+        self.t0 = t0
+
+    def is_ready(self) -> bool:
+        try:
+            return bool(self.scores.is_ready())
+        except Exception:
+            return True
+
+
+class SemanticEngine:
+    """Device-resident query table + arbitrated match dispatch."""
+
+    def __init__(self, dim: int = 256, max_queries: int = 4096,
+                 topk: int = 8, probe_interval: float = 10.0,
+                 threshold: float = SIM_THRESHOLD):
+        self.table = SemanticTable(dim=dim, cap=max_queries)
+        self.topk = int(topk)
+        self.threshold = float(threshold)
+        self.probe_interval = float(probe_interval)
+        self._lk = threading.Lock()
+        # adaptive candidate window (models/retained.py discipline)
+        self._kcap_floor = max(4, next_pow2(self.topk))
+        self._kcap_ceil = min(256, next_pow2(max_queries))
+        self._kcap_dyn = self._kcap_floor
+        self._kmax_peak = 0
+        self._kmax_ticks = 0
+        # EWMA rate arbiter (broker/retainer.py trie-vs-index shape)
+        self.rate_host: Optional[float] = None
+        self.rate_dev: Optional[float] = None
+        self._last_host_meas = 0.0
+        self._last_dev_meas = 0.0
+        self._last_path: Optional[bool] = None
+        self._probe: Optional[Tuple[_PendingSem, float]] = None
+        # telemetry (synced into broker metrics by the plane)
+        self.matches_dev = 0
+        self.matches_host = 0
+        self.path_flips = 0
+        self.probes = 0
+        self.refetches = 0
+        self._staging: Dict[int, List[np.ndarray]] = {}
+
+    # ------------------------------------------------------------- churn
+
+    def add_query(self, text: str, owner: str = "") -> int:
+        with self._lk:
+            return self.table.add(text, owner=owner)
+
+    def remove_query(self, qid: int) -> bool:
+        with self._lk:
+            return self.table.remove(qid)
+
+    def drop_owner(self, owner: str) -> List[int]:
+        with self._lk:
+            return self.table.drop_owner(owner)
+
+    @property
+    def n_queries(self) -> int:
+        return self.table.n_live
+
+    # ----------------------------------------------------------- staging
+
+    def _acquire_staging(self, B: int) -> np.ndarray:
+        pool = self._staging.get(B)
+        try:
+            return pool.pop()  # GIL-atomic; races fall through to alloc
+        except (AttributeError, IndexError):
+            return np.zeros((B, self.table.dim), dtype=np.float32)
+
+    def _release_staging(self, buf: np.ndarray, B: int) -> None:
+        pool = self._staging.setdefault(B, [])
+        if len(pool) < _STAGING_POOL:
+            pool.append(buf)
+
+    # ------------------------------------------------------ device path
+
+    def submit(self, texts: List[str],
+               kcap: Optional[int] = None) -> _PendingSem:
+        """Embed + upload ONE packed batch, dispatch the cosine top-k
+        kernel, start the async result download.  Non-blocking."""
+        from ..ops.match import semantic_topk
+        import jax
+
+        B = max(1, next_pow2(len(texts)))
+        buf = self._acquire_staging(B)
+        embed_batch(texts, self.table.dim, out=buf)
+        kc = int(kcap if kcap is not None else self._kcap_dyn)
+        with self._lk:
+            dev_vecs, dev_valid = self.table.device_tables()
+        scores, idxs = semantic_topk(
+            dev_vecs, dev_valid, jax.device_put(buf), kcap=kc
+        )
+        try:
+            scores.copy_to_host_async()
+            idxs.copy_to_host_async()
+        except Exception:
+            pass
+        return _PendingSem(scores, idxs, buf, B, len(texts),
+                           kc, time.monotonic())
+
+    def collect(self, pend: _PendingSem) -> List[List[Tuple[int, float]]]:
+        """Block on the device result, then decide membership exactly.
+
+        Returns one `[(qid, score), ...]` list per submitted text —
+        threshold-passing queries by descending exact score (qid tie-
+        break), truncated to topk: the oracle's definition verbatim."""
+        s = np.asarray(pend.scores)
+        ix = np.asarray(pend.idxs)
+        out: List[List[Tuple[int, float]]] = []
+        kmax = 0
+        near = self.threshold - SIM_MARGIN
+        with self._lk:
+            for b in range(pend.n):
+                # window saturated with near-threshold candidates: the
+                # device may have ranked a passer out — refetch densely
+                if ix[b, pend.kcap - 1] >= 0 and float(s[b, pend.kcap - 1]) >= near:
+                    self.refetches += 1
+                    kmax = max(kmax, pend.kcap)
+                    self._kcap_dyn = min(
+                        self._kcap_ceil, next_pow2(pend.kcap + 1)
+                    )
+                    tp("semantic.refetch", kcap=pend.kcap,
+                       kcap_next=self._kcap_dyn)
+                    out.append(self._exact_row(pend.buf[b]))
+                    continue
+                row = self._exact_over(
+                    [q for q in ix[b].tolist() if q >= 0], pend.buf[b]
+                )
+                kmax = max(kmax, len(row))
+                out.append(row[: self.topk])
+        self._release_staging(pend.buf, pend.B)
+        self._note_kmax(kmax)
+        return out
+
+    def _note_kmax(self, kmax: int) -> None:
+        """Shrink the candidate window toward 2x the observed peak every
+        64 ticks (the retained-index _note_kmax discipline)."""
+        self._kmax_peak = max(self._kmax_peak, kmax)
+        self._kmax_ticks += 1
+        if self._kmax_ticks >= 64:
+            want = max(self._kcap_floor,
+                       next_pow2(max(1, 2 * self._kmax_peak)))
+            if want < self._kcap_dyn:
+                self._kcap_dyn = want
+            self._kmax_peak = 0
+            self._kmax_ticks = 0
+
+    # -------------------------------------------------------- host path
+
+    def _exact_over(self, qids: List[int],
+                    vec: np.ndarray) -> List[Tuple[int, float]]:
+        """Exact membership over candidate rows.  Deliberately
+        `(rows * vec).sum(axis=1)` and NOT `rows @ vec`: BLAS gemv
+        accumulation order varies with the matrix shape, so a
+        device-nominated candidate subset would score rows at ULP
+        distance from the dense pass — enough to flip membership at
+        the threshold.  Per-row multiply+pairwise-sum depends only on
+        (row, vec), so scores are bit-identical whichever path
+        nominated the row."""
+        live = [q for q in qids if self.table.valid[q]]
+        if not live:
+            return []
+        scores = (self.table.vecs[live] * vec).sum(axis=1)
+        row = [
+            (q, float(sc)) for q, sc in zip(live, scores.tolist())
+            if sc >= self.threshold
+        ]
+        row.sort(key=lambda t: (-t[1], t[0]))
+        return row
+
+    def _exact_row(self, vec: np.ndarray) -> List[Tuple[int, float]]:
+        """Dense exact scorer for ONE embedded text (the oracle)."""
+        rows = np.nonzero(self.table.valid)[0]
+        return self._exact_over(rows.tolist(), vec)[: self.topk]
+
+    def match_exact(self, texts: List[str]) -> List[List[Tuple[int, float]]]:
+        """All-host dense path: embed + score every live query."""
+        out = []
+        with self._lk:
+            for t in texts:
+                vec = embed_text(t, self.table.dim)
+                out.append(self._exact_row(vec))
+        return out
+
+    # ---------------------------------------------------------- arbiter
+
+    def _pick_dev(self) -> bool:
+        if self.table.n_live == 0:
+            return False
+        if self.rate_dev is None or self.rate_host is None:
+            return False
+        if self.rate_dev <= self.rate_host:
+            return False
+        # stale host measurement: serve host once to refresh it
+        if time.monotonic() - self._last_host_meas > self.probe_interval:
+            return False
+        return True
+
+    def _note_host_rate(self, rps: float) -> None:
+        self.rate_host = (
+            rps if self.rate_host is None else 0.5 * self.rate_host + 0.5 * rps
+        )
+        self._last_host_meas = time.monotonic()
+
+    def _note_dev_rate(self, rps: float) -> None:
+        self.rate_dev = (
+            rps if self.rate_dev is None else 0.5 * self.rate_dev + 0.5 * rps
+        )
+        self._last_dev_meas = time.monotonic()
+
+    def _note_path(self, dev: bool) -> None:
+        if self._last_path is not None and self._last_path != dev:
+            self.path_flips += 1
+            tp("semantic.flip", to="device" if dev else "host",
+               rate_dev=self.rate_dev, rate_host=self.rate_host)
+        self._last_path = dev
+
+    def _maybe_probe(self, texts: List[str]) -> None:
+        """Host-serving steady state: ship a bounded non-blocking device
+        probe so rate_dev stays honest (retainer _maybe_probe_index)."""
+        if self._probe is not None:
+            return
+        now = time.monotonic()
+        if self.rate_dev is not None and \
+                now - self._last_dev_meas < self.probe_interval:
+            return
+        probe = texts[:_PROBE_CAP]
+        self.probes += 1
+        tp("semantic.probe", n=len(probe))
+        self._probe = (self.submit(probe), now)
+
+    def _poll_probe(self) -> None:
+        if self._probe is None:
+            return
+        pend, t0 = self._probe
+        if not pend.is_ready():
+            return
+        self._probe = None
+        n = pend.n
+        self.collect(pend)
+        dt = time.monotonic() - t0
+        if dt > 0:
+            self._note_dev_rate(n / dt)
+
+    # ------------------------------------------------------------ match
+
+    def match_submit(self, texts: List[str]):
+        """Arbitrated submit half: device work (when picked) starts NOW
+        so the publish pipeline overlaps it with other planes."""
+        self._poll_probe()
+        if self._pick_dev():
+            return ("dev", texts, self.submit(texts), time.monotonic())
+        return ("host", texts, None, time.monotonic())
+
+    def match_collect(self, handle) -> List[List[Tuple[int, float]]]:
+        """Collect half: resolve the path taken, book its rate."""
+        mode, texts, pend, t0 = handle
+        if mode == "dev":
+            out = self.collect(pend)
+            dt = time.monotonic() - t0
+            if dt > 0:
+                self._note_dev_rate(len(texts) / dt)
+            self.matches_dev += len(texts)
+            self._note_path(True)
+        else:
+            out = self.match_exact(texts)
+            dt = time.monotonic() - t0
+            if dt > 0:
+                self._note_host_rate(len(texts) / dt)
+            self.matches_host += len(texts)
+            self._note_path(False)
+            if self.table.n_live:
+                self._maybe_probe(texts)
+        return out
+
+    def match(self, texts: List[str]) -> List[List[Tuple[int, float]]]:
+        """Arbitrated synchronous match: one `[(qid, exact score)]` list
+        per text.  Used by the hub intake and the test oracle harness."""
+        if not texts:
+            return []
+        return self.match_collect(self.match_submit(texts))
+
+    # -------------------------------------------------------- telemetry
+
+    def counters(self) -> Dict[str, int]:
+        return {
+            "semantic.matches.device": self.matches_dev,
+            "semantic.matches.host": self.matches_host,
+            "semantic.flips": self.path_flips,
+            "semantic.probes": self.probes,
+            "semantic.refetches": self.refetches,
+        }

@@ -1,0 +1,290 @@
+"""Broker-facing semantic subscription plane.
+
+`$semantic/<query>` filters NEVER touch the topic trie, churn plane,
+WAL, checkpoint registry, or cluster route oplog — the subscribe path
+classifies them here (the `$share/` special-case discipline) and the
+plane owns its subscriber maps outright.  Queries survive restarts via
+session persistence re-subscribing through this classifier, not via any
+match-table snapshot.
+
+Two backends share the subscriber bookkeeping:
+
+* **local** — the node owns a :class:`SemanticEngine` (device table +
+  arbiter).  Standalone nodes and the hub run this.
+* **shm** — wire workers.  The worker ships payload ticks to the hub
+  over a K_SEM ring record and NEVER boots an embedding table: it keeps
+  only its OWN queries' vectors (a handful of [dim] rows) for the
+  hub-death exact fallback.  Cross-worker hits come back as per-owner
+  sections and ride the cluster FORWARD frames to the owning worker.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..observe import spans as _spans
+from ..observe.tracepoints import tp
+from .embedder import SIM_THRESHOLD, embed_text, payload_text
+
+SEM_PREFIX = "$semantic"
+
+
+class _PendingPlane:
+    __slots__ = ("mode", "texts", "handle", "t0", "rows", "res")
+
+    def __init__(self, mode: str, texts: List[str], handle, t0: float):
+        self.mode = mode
+        self.texts = texts
+        self.handle = handle
+        self.t0 = t0
+        self.rows = None  # local mode: per-text matched qid lists
+        self.res = None  # shm mode: hub reply records
+
+
+class SemanticPlane:
+    """Subscriber registry + dispatch fan-in for semantic filters."""
+
+    def __init__(self, engine=None, shm=None, dim: int = 256,
+                 topk: int = 8, threshold: float = SIM_THRESHOLD):
+        if (engine is None) == (shm is None):
+            raise ValueError("exactly one of engine/shm backs the plane")
+        self.engine = engine  # SemanticEngine (local mode)
+        self.shm = shm  # ShmMatchEngine (wire-worker mode)
+        self.dim = int(engine.table.dim if engine is not None else dim)
+        self.topk = int(engine.topk if engine is not None else topk)
+        self.threshold = float(
+            engine.threshold if engine is not None else threshold
+        )
+        # qid -> clientids; text -> qid; cid -> {text: qid}
+        self.subs: Dict[int, Set[str]] = {}
+        self._by_text: Dict[str, int] = {}
+        self.by_client: Dict[str, Dict[str, int]] = {}
+        # shm mode: the worker's OWN query rows (text + vector), keyed
+        # by local qid — the entire worker-resident "table"
+        self._own: Dict[int, Tuple[str, np.ndarray]] = {}
+        self._next_lqid = 0
+        self.queries_added = 0
+        self.queries_removed = 0
+        self.deliveries = 0
+        self.degraded = 0
+        self.dropped = 0
+
+    # ------------------------------------------------------ subscription
+
+    @property
+    def n_subs(self) -> int:
+        return sum(len(s) for s in self.subs.values())
+
+    @property
+    def n_queries(self) -> int:
+        return len(self._by_text)
+
+    def subscribe(self, clientid: str, query: str) -> bool:
+        """Register one (client, query) pair; False on resub or a full
+        query table (the subscription is refused, not silently trie'd)."""
+        qid = self._by_text.get(query)
+        if qid is None:
+            qid = self._alloc(query)
+            if qid < 0:
+                self.dropped += 1
+                return False
+            self._by_text[query] = qid
+            self.subs[qid] = set()
+            self.queries_added += 1
+            tp("semantic.query", op="add", qid=qid, n=len(self._by_text))
+        cids = self.subs[qid]
+        if clientid in cids:
+            return False
+        cids.add(clientid)
+        self.by_client.setdefault(clientid, {})[query] = qid
+        return True
+
+    def unsubscribe(self, clientid: str, query: str) -> bool:
+        qid = self.by_client.get(clientid, {}).pop(query, None)
+        if qid is None:
+            return False
+        if not self.by_client.get(clientid):
+            self.by_client.pop(clientid, None)
+        cids = self.subs.get(qid)
+        if cids is not None:
+            cids.discard(clientid)
+            if not cids:
+                del self.subs[qid]
+                del self._by_text[query]
+                self._release(qid)
+                self.queries_removed += 1
+                tp("semantic.query", op="remove", qid=qid,
+                   n=len(self._by_text))
+        return True
+
+    def client_down(self, clientid: str) -> int:
+        """Drop every subscription a disconnecting client holds."""
+        n = 0
+        for query in list(self.by_client.get(clientid, {})):
+            if self.unsubscribe(clientid, query):
+                n += 1
+        return n
+
+    def _alloc(self, query: str) -> int:
+        if self.engine is not None:
+            return self.engine.add_query(query)
+        lqid = self._next_lqid
+        self._next_lqid += 1
+        self._own[lqid] = (query, embed_text(query, self.dim))
+        self.shm.semantic_add(lqid, query)
+        return lqid
+
+    def _release(self, qid: int) -> None:
+        if self.engine is not None:
+            self.engine.remove_query(qid)
+            return
+        self._own.pop(qid, None)
+        self.shm.semantic_remove(qid)
+
+    # --------------------------------------------------------- dispatch
+
+    def active(self) -> bool:
+        """Anything to match against?  Local: any live query.  Worker:
+        any query ANYWHERE in the pool (the hub-maintained C_SEM count)
+        — a publish here may feed a subscriber on another worker."""
+        if self.engine is not None:
+            return self.engine.n_queries > 0
+        return bool(self._own) or self.shm.semantic_active()
+
+    def submit(self, payloads: List[bytes]) -> Optional[_PendingPlane]:
+        """Kick the match for a publish batch; None when the plane has
+        nothing to do.  Pipelinable: device/hub work starts here."""
+        if not payloads or not self.active():
+            return None
+        texts = [payload_text(p) for p in payloads]
+        t0 = time.monotonic()
+        if self.engine is not None:
+            return _PendingPlane(
+                "local", texts, self.engine.match_submit(texts), t0
+            )
+        h = self.shm.semantic_submit(texts)
+        if h is None:  # hub down / ring full / oversize: exact fallback
+            return _PendingPlane("degraded", texts, None, t0)
+        return _PendingPlane("shm", texts, h, t0)
+
+    def collect(self, pend: _PendingPlane) -> _PendingPlane:
+        """Blocking half — executor-safe: resolves the device/hub match
+        without touching the subscriber maps (those mutate on the loop
+        thread; :meth:`finish` reads them there)."""
+        if pend.mode == "local":
+            pend.rows = [
+                [q for q, _ in row]
+                for row in self.engine.match_collect(pend.handle)
+            ]
+        elif pend.mode == "shm":
+            pend.res = self.shm.semantic_collect(pend.handle)
+        return pend
+
+    def finish(self, pend: _PendingPlane):
+        """Loop-thread half: fan matched queries out to subscriber
+        pairs.
+
+        Returns ``(local, remote)``: ``local[i]`` is the
+        ``[(clientid, "$semantic/<query>")]`` list for payload i;
+        ``remote`` is ``[(node, [hub_qid, ...], i)]`` forward orders for
+        queries owned by other wire workers (shm mode only)."""
+        local: List[List[Tuple[str, str]]] = []
+        remote: List[Tuple[str, List[int], int]] = []
+        if pend.mode == "local":
+            for qids in pend.rows or []:
+                local.append(self._fan_local(qids))
+        elif pend.mode == "shm" and pend.res is not None:
+            for i, rec in enumerate(pend.res):
+                own = [
+                    q for q in (
+                        self.shm.semantic_hub2loc(h)
+                        for h in rec.get("own", ())
+                    ) if q is not None
+                ]
+                local.append(self._fan_local(own))
+                for node, qids in (rec.get("rem") or {}).items():
+                    remote.append((node, list(qids), i))
+        else:  # degraded up front, or the hub timed out mid-flight
+            local = self._serve_degraded(pend.texts)
+        for row in local:
+            self.deliveries += len(row)
+        if _spans.enabled():
+            _spans.plane().observe_stage(
+                "sem", time.monotonic() - pend.t0
+            )
+        return local, remote
+
+    def _fan_local(self, qids: List[int]) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        for qid in qids:
+            cids = self.subs.get(qid)
+            if not cids:
+                continue
+            if self.engine is not None:
+                text = self.engine.table.texts.get(qid)
+            else:
+                rec = self._own.get(qid)
+                text = rec[0] if rec else None
+            if text is None:
+                continue
+            topic = SEM_PREFIX + "/" + text
+            out.extend((cid, topic) for cid in cids)
+        return out
+
+    def _serve_degraded(self, texts: List[str]) -> List[List[Tuple[str, str]]]:
+        """Hub unreachable: exact host scoring over the worker's OWN
+        queries — correct for local subscribers, and the only honest
+        answer while the pool table is unreachable."""
+        self.degraded += len(texts)
+        tp("semantic.degrade", n=len(texts), own=len(self._own))
+        out = []
+        for t in texts:
+            vec = embed_text(t, self.dim)
+            row = []
+            for lq, (_q, v) in self._own.items():
+                sc = float(np.dot(v, vec))
+                if sc >= self.threshold:
+                    row.append((sc, lq))
+            row.sort(key=lambda x: (-x[0], x[1]))
+            out.append(self._fan_local([lq for _, lq in row[: self.topk]]))
+        return out
+
+    def deliver_remote(self, hub_qids: List[int]) -> List[Tuple[str, str]]:
+        """Receiver side of a sem-tagged cluster forward: map the hub's
+        qids to this worker's local queries and fan out."""
+        if self.shm is None:
+            return []
+        loc = [
+            q for q in (self.shm.semantic_hub2loc(h) for h in hub_qids)
+            if q is not None
+        ]
+        if len(loc) < len(hub_qids):
+            # an idle worker has no publish traffic driving poll(), so
+            # this query's K_SEMQ_ACK may still sit unread in the
+            # response ring — drain once and retry the unknowns
+            self.shm.poll()
+            loc = [
+                q for q in
+                (self.shm.semantic_hub2loc(h) for h in hub_qids)
+                if q is not None
+            ]
+        out = self._fan_local(loc)
+        self.deliveries += len(out)
+        return out
+
+    # -------------------------------------------------------- telemetry
+
+    def counters(self) -> Dict[str, int]:
+        out = {
+            "semantic.queries.added": self.queries_added,
+            "semantic.queries.removed": self.queries_removed,
+            "semantic.deliveries": self.deliveries,
+            "semantic.degraded": self.degraded,
+            "semantic.dropped": self.dropped,
+        }
+        if self.engine is not None:
+            out.update(self.engine.counters())
+        return out

@@ -1,0 +1,148 @@
+"""Query-vector registry + HBM mirror (the retained entry-plane analog).
+
+One fixed-capacity [max_queries, dim] f32 row table holds every live
+`$semantic/<query>` embedding; rows are refcounted by (owner, text) so
+N subscribers to the same query share one row, and freed rows recycle
+through a free heap.  The device mirror syncs dirty rows by scatter
+(full re-upload only on first touch or bulk churn), mirroring
+models/retained.py's dirty-row discipline — match ticks then dispatch
+on RESIDENT buffers and upload only the publish batch.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..ops.match import next_pow2
+from .embedder import embed_text
+
+# past this many dirty rows a full re-upload beats per-row scatter
+_SCATTER_MAX = 64
+
+
+_scatter_jit = None
+
+
+def _scatter_rows(dev_vecs, dev_valid, rows, vals, flags):
+    """Scatter churned rows into the HBM mirror (padding rows carry an
+    out-of-range index and drop, the apply_delta_packed discipline).
+    jit built lazily so importing the table never drags jax into a
+    process that only runs the host path (wire workers)."""
+    global _scatter_jit
+    if _scatter_jit is None:
+        import jax
+
+        def _impl(dv, dva, r, v, f):
+            return (
+                dv.at[r].set(v, mode="drop"),
+                dva.at[r].set(f, mode="drop"),
+            )
+
+        _scatter_jit = jax.jit(_impl)
+    return _scatter_jit(dev_vecs, dev_valid, rows, vals, flags)
+
+
+class SemanticTable:
+    """Host-of-record query table with a lazily-synced device mirror."""
+
+    def __init__(self, dim: int = 256, cap: int = 4096):
+        self.dim = int(dim)
+        self.cap = int(cap)
+        self.vecs = np.zeros((self.cap, self.dim), dtype=np.float32)
+        self.valid = np.zeros(self.cap, dtype=bool)
+        self.texts: Dict[int, str] = {}
+        self.owners: Dict[int, str] = {}
+        self.refs: Dict[int, int] = {}
+        self._by_key: Dict[Tuple[str, str], int] = {}
+        self._free: List[int] = list(range(self.cap))
+        heapq.heapify(self._free)
+        self.n_live = 0
+        # None = full upload owed; else the set of churned row ids
+        self._dirty: Optional[Set[int]] = None
+        self._dev = None  # (dev_vecs [cap, dim], dev_valid [cap])
+
+    # ------------------------------------------------------------- churn
+
+    def add(self, text: str, owner: str = "") -> int:
+        """Register (or ref) a query; returns its row id, -1 when full."""
+        key = (owner, text)
+        qid = self._by_key.get(key)
+        if qid is not None:
+            self.refs[qid] += 1
+            return qid
+        if not self._free:
+            return -1
+        qid = heapq.heappop(self._free)
+        embed_text(text, self.dim, out=self.vecs[qid])
+        self.valid[qid] = True
+        self.texts[qid] = text
+        self.owners[qid] = owner
+        self.refs[qid] = 1
+        self._by_key[key] = qid
+        self.n_live += 1
+        if self._dirty is not None:
+            self._dirty.add(qid)
+        return qid
+
+    def remove(self, qid: int) -> bool:
+        """Drop one reference; True when the row was actually freed."""
+        if qid not in self.refs:
+            return False
+        self.refs[qid] -= 1
+        if self.refs[qid] > 0:
+            return False
+        del self.refs[qid]
+        self.valid[qid] = False
+        self.vecs[qid] = 0.0
+        del self._by_key[(self.owners.pop(qid), self.texts.pop(qid))]
+        heapq.heappush(self._free, qid)
+        self.n_live -= 1
+        if self._dirty is not None:
+            self._dirty.add(qid)
+        return True
+
+    def drop_owner(self, owner: str) -> List[int]:
+        """Free every row an owner holds, whatever its refcount (hub
+        lane-death reclaim: the worker incarnation is gone, so are its
+        references).  Returns the freed row ids."""
+        gone = [q for q, o in self.owners.items() if o == owner]
+        for qid in gone:
+            self.refs[qid] = 1
+            self.remove(qid)
+        return gone
+
+    def lookup(self, text: str, owner: str = "") -> int:
+        return self._by_key.get((owner, text), -1)
+
+    # ------------------------------------------------------------- device
+
+    def device_tables(self):
+        """The HBM mirror, synced: full upload on first touch (or after
+        bulk churn), per-row scatter for small deltas."""
+        import jax
+
+        if self._dev is None or self._dirty is None \
+                or len(self._dirty) > _SCATTER_MAX:
+            self._dev = (
+                jax.device_put(self.vecs.copy()),
+                jax.device_put(self.valid.copy()),
+            )
+        elif self._dirty:
+            rows = sorted(self._dirty)
+            n = next_pow2(max(1, len(rows)))
+            ridx = np.full(n, self.cap, dtype=np.int32)
+            ridx[: len(rows)] = rows
+            vals = np.zeros((n, self.dim), dtype=np.float32)
+            vals[: len(rows)] = self.vecs[rows]
+            flags = np.zeros(n, dtype=bool)
+            flags[: len(rows)] = self.valid[rows]
+            self._dev = _scatter_rows(*self._dev, ridx, vals, flags)
+        self._dirty = set()
+        return self._dev
+
+    def drop_device(self) -> None:
+        self._dev = None
+        self._dirty = None

@@ -35,6 +35,7 @@ serves its own deep filters from the trie on every tick.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -52,8 +53,9 @@ from ..ops.prep import TopicPrep
 from . import registry
 from .doorbell import Doorbell
 from .rings import (
-    C_HUB_GEN, C_HUB_WAIT, C_WORKER_GEN, K_CHURN, K_CHURN_ACK, K_HELLO,
-    K_MATCH, K_MATCH_RES, SlabView,
+    C_HUB_GEN, C_HUB_WAIT, C_SEM, C_WORKER_GEN, K_CHURN, K_CHURN_ACK,
+    K_HELLO, K_MATCH, K_MATCH_RES, K_SEM, K_SEM_RES, K_SEMQ, K_SEMQ_ACK,
+    SlabView,
 )
 
 R_FORCED = 5  # matches models.engine R_FORCED (flight reason code)
@@ -79,6 +81,18 @@ class _ShmPending:
         # span plane is armed (0 disarmed): the reply's hub stamps
         # decompose against this (observe/spans.py shm legs)
         self.t_submit = 0
+
+
+class _SemPending:
+    """One in-flight semantic payload tick riding the K_SEM lane."""
+
+    __slots__ = ("tick", "n", "t0", "deadline")
+
+    def __init__(self, tick: int, n: int, t0: float, deadline: float):
+        self.tick = tick
+        self.n = n
+        self.t0 = t0
+        self.deadline = deadline
 
 
 class ShmMatchEngine:
@@ -153,6 +167,23 @@ class ShmMatchEngine:
         self._churn_seq = 0
         self._tick_seq = 0
         self._inflight_n = 0
+        # ---- semantic lane (emqx_tpu/semantic/plane.py, shm mode) -----
+        # The worker never boots an embedding table: its queries live
+        # hub-side, registered through K_SEMQ churn (the filter-churn
+        # discipline: unsent queue, per-seq pending adds, ack-built
+        # hub<->local qid maps, full replay on hub generation bump).
+        self.sem_node = ""  # cluster node name, stamped into K_SEMQ
+        self._sem_local: Dict[int, str] = {}  # local qid -> query text
+        self._qhub2loc: Dict[int, int] = {}
+        self._qloc2hub: Dict[int, int] = {}
+        self._pending_semq: Dict[int, List[Tuple[int, str]]] = {}
+        self._semq_unsent: List[
+            Tuple[List[Tuple[int, str]], List[int]]
+        ] = []
+        self._semq_seq = 0
+        # tick -> raw K_SEM_RES payload bytes (JSON decoded in collect,
+        # outside the leaf lock)
+        self._sem_results: Dict[int, bytes] = {}
         # tick -> (counts, fids, hub reply ts, t_recv ns) — the last
         # two are zeros when the tick's submit was unstamped
         self._results: Dict[
@@ -169,6 +200,10 @@ class ShmMatchEngine:
         self.shm_local = 0      # decided local at submit (down/full/big)
         self.shm_oversize = 0
         self.shm_reregisters = 0
+        self.sem_submits = 0
+        self.sem_degraded = 0   # submitted but no hub reply in time
+        self.sem_local = 0      # decided degraded at submit time
+        self.sem_oversize = 0
         self._attach()
 
     # ---------------------------------------------------------- doorbell
@@ -197,6 +232,7 @@ class ShmMatchEngine:
             self._gen = self._slab.worker_gen & 0xFFFFFFFF
             self._hub_gen = self._slab.hub_gen
             self._results.clear()
+            self._sem_results.clear()
             w = self._slab.submit.reserve()
             if w is not None:  # ring just reset: cannot actually be full
                 w.commit(K_HELLO, self._gen, gen=self._gen)
@@ -218,6 +254,14 @@ class ShmMatchEngine:
             for filt, fid in self._fids.items():
                 adds.extend([(filt, fid)] * self._refs.get(fid, 1))
             self._send_churn(adds, [])
+            # replay the semantic query set through fresh K_SEMQ records
+            # (the fresh hub has no memory of our qids)
+            self._qhub2loc.clear()
+            self._qloc2hub.clear()
+            self._pending_semq.clear()
+            self._semq_unsent.clear()
+            if self._sem_local:
+                self._send_semq(list(self._sem_local.items()), [])
         tp("shm.reregister", n=len(self._refs))
 
     # ----------------------------------------------------------- liveness
@@ -377,6 +421,165 @@ class ShmMatchEngine:
     def note_churn_shed(self, n: int = 1) -> None:
         self.churn_shed += n
 
+    # ---------------------------------------------------------- semantic
+
+    def semantic_add(self, lqid: int, text: str) -> None:
+        """Register one of THIS worker's semantic queries with the hub
+        (K_SEMQ churn).  Until the ack lands the query matches nothing
+        hub-side; the plane's own-row exact fallback covers the gap the
+        same way `_unacked` filters ride the local trie."""
+        with self._lk:
+            self._sem_local[lqid] = text
+            self._send_semq([(lqid, text)], [])
+
+    def semantic_remove(self, lqid: int) -> None:
+        with self._lk:
+            if self._sem_local.pop(lqid, None) is None:
+                return
+            hub = self._qloc2hub.pop(lqid, None)
+            if hub is not None:
+                self._qhub2loc.pop(hub, None)
+            self._send_semq([], [lqid])
+
+    def semantic_hub2loc(self, hub_qid: int) -> Optional[int]:
+        with self._lk:
+            return self._qhub2loc.get(int(hub_qid))
+
+    def _send_semq(self, adds: List[Tuple[int, str]],
+                   removes: List[int]) -> None:
+        """Queue semantic query churn (caller holds self._lk); the
+        filter-churn discipline: bounded chunks, FIFO, ring-full defers
+        to `_semq_unsent` and the next poll()/submit flushes."""
+        CH = 64
+        for i in range(0, max(len(adds), len(removes)), CH):
+            a_chunk = adds[i:i + CH]
+            r_chunk = removes[i:i + CH]
+            if a_chunk or r_chunk:
+                self._semq_unsent.append((list(a_chunk), list(r_chunk)))
+        self._flush_semq()
+
+    def _flush_semq(self) -> None:
+        """Push queued K_SEMQ records while the submit ring has space
+        (caller holds self._lk).  Blob element 0 is this worker's node
+        name (c=1) — the hub keys cross-worker forward sections on it."""
+        while self._semq_unsent:
+            a_chunk, r_chunk = self._semq_unsent[0]
+            parts = [self.sem_node]
+            parts.extend(f"{lq}\x01{t}" for lq, t in a_chunk)
+            parts.extend(str(lq) for lq in r_chunk)
+            blob = "\0".join(parts).encode("utf-8", "surrogatepass")
+            if len(blob) > self._slab.submit.payload_cap:
+                if len(a_chunk) + len(r_chunk) > 1:  # split and retry
+                    ha, hr = len(a_chunk) // 2, len(r_chunk) // 2
+                    self._semq_unsent[0:1] = [
+                        (a_chunk[:ha or 1], r_chunk[:hr]),
+                        (a_chunk[ha or 1:], r_chunk[hr:]),
+                    ]
+                    continue
+                self._semq_unsent.pop(0)  # one slot-sized query text
+                self.sem_oversize += 1
+                continue
+            with self._sub_lk:
+                w = self._slab.submit.reserve()
+                if w is None:
+                    return  # ring full: retried on next poll/submit
+                self._semq_seq += 1
+                seq = self._semq_seq
+                pay = w.payload_u8(len(blob))
+                pay[:] = np.frombuffer(blob, np.uint8)
+                w.commit(K_SEMQ, seq, a=len(a_chunk), b=len(r_chunk),
+                         c=1, nbytes=len(blob), gen=self._gen)
+            self._ring_hub()
+            self._semq_unsent.pop(0)
+            if a_chunk:
+                self._pending_semq[seq] = list(a_chunk)
+
+    def _apply_sem_ack(self, seq: int,
+                       pairs: List[Tuple[int, int]]) -> None:
+        with self._lk:
+            if self._pending_semq.pop(seq, None) is None:
+                return
+            for lqid, hub in pairs:
+                if lqid in self._sem_local and hub >= 0:
+                    self._qhub2loc[hub] = lqid
+                    self._qloc2hub[lqid] = hub
+
+    def semantic_active(self) -> bool:
+        """Pool-wide live-query count, hub-maintained (C_SEM): a worker
+        whose publishes could not match ANY subscriber skips the K_SEM
+        tick entirely — the common no-semantic-anywhere case costs one
+        control-page load per publish batch."""
+        return int(self._slab.ctrl[C_SEM]) > 0
+
+    def semantic_submit(self, texts: Sequence[str]):
+        """Ship one batch of embed prefixes to the hub (K_SEM).  None
+        means THIS batch must be served by the caller's exact fallback:
+        hub down, ring full, blob oversize, or a `shm.sem.submit` fault
+        — the match-tick degrade ladder, one rung shorter (no local
+        trie to fall to; the plane owns the own-query fallback)."""
+        t0 = time.monotonic()
+        self._check_hub_gen()
+        self.poll()
+        a = _fault.inject("shm.sem.submit", err=False) \
+            if _fault.enabled() else None
+        if (a is not None and a.kind in ("drop", "error", "corrupt")) \
+                or not self._hub_ok():
+            self.sem_local += 1
+            return None
+        blob = "\0".join(texts).encode("utf-8", "replace")
+        if len(blob) > self._slab.submit.payload_cap:
+            self.sem_oversize += 1
+            self.sem_local += 1
+            return None
+        with self._sub_lk:
+            w = self._slab.submit.reserve()
+            if w is None:
+                self.sem_local += 1
+                return None
+            self._tick_seq += 1
+            tick = self._tick_seq
+            if blob:
+                pay = w.payload_u8(len(blob))
+                pay[:] = np.frombuffer(blob, np.uint8)
+            w.commit(K_SEM, tick, a=len(texts), nbytes=len(blob),
+                     gen=self._gen)
+        self._ring_hub()
+        self.sem_submits += 1
+        return _SemPending(tick, len(texts), t0, t0 + self.timeout)
+
+    def semantic_collect(self, pending: _SemPending):
+        """Await the hub's K_SEM_RES for this tick; None on timeout or
+        a malformed/short reply (callers degrade to exact own-query
+        scoring).  Same drain/leaf-lock contract as `_await_result`."""
+        tick = pending.tick
+        while True:
+            with self._res_lk:
+                acks, semacks = self._drain_results()
+                raw = self._sem_results.pop(tick, None)
+            for ack_tick, ack_fids in acks:
+                self._apply_ack(ack_tick, ack_fids)
+            for seq, pairs in semacks:
+                self._apply_sem_ack(seq, pairs)
+            if raw is not None:
+                try:
+                    res = json.loads(raw.decode("utf-8", "replace"))
+                except ValueError:
+                    res = None
+                if isinstance(res, list) and len(res) == pending.n:
+                    return res
+                self.sem_degraded += 1
+                return None
+            now = time.monotonic()
+            if now >= pending.deadline or not self._hub_ok():
+                # sweep abandoned sem replies alongside match results
+                with self._res_lk:
+                    if len(self._sem_results) > 4096:
+                        self._sem_results.clear()
+                self.sem_degraded += 1
+                tp("shm.degrade", state="sem-timeout", tick=tick)
+                return None
+            time.sleep(0.0002)  # analysis: allow-blocking(collect runs on the broker's executor thread — the same blocking-wait contract as match_collect)
+
     # ------------------------------------------------------------- match
 
     @property
@@ -401,12 +604,15 @@ class ShmMatchEngine:
         otherwise leave acks parked until its next match, aging
         `_unacked` and risking result-ring backpressure on the hub."""
         with self._res_lk:
-            acks = self._drain_results()
+            acks, semacks = self._drain_results()
         for ack_tick, ack_fids in acks:
             self._apply_ack(ack_tick, ack_fids)
-        if self._unsent and self._hub_ok():
+        for seq, pairs in semacks:
+            self._apply_sem_ack(seq, pairs)
+        if (self._unsent or self._semq_unsent) and self._hub_ok():
             with self._lk:
                 self._flush_churn()
+                self._flush_semq()
 
     def match_submit(self, topics: Sequence[str]) -> _ShmPending:
         t0 = time.monotonic()
@@ -527,10 +733,12 @@ class ShmMatchEngine:
             # values; churn acks are applied after release since
             # _apply_ack takes _lk
             with self._res_lk:
-                acks = self._drain_results()
+                acks, semacks = self._drain_results()
                 got = self._results.pop(tick, None)
             for ack_tick, ack_fids in acks:
                 self._apply_ack(ack_tick, ack_fids)
+            for seq, pairs in semacks:
+                self._apply_sem_ack(seq, pairs)
             if got is not None:
                 return got
             now = time.monotonic()
@@ -543,16 +751,20 @@ class ShmMatchEngine:
                 return None
             time.sleep(0.0002)  # analysis: allow-blocking(collect runs on the broker's executor thread — the same blocking-wait contract as the device engines' collect)
 
-    def _drain_results(self) -> List[Tuple[int, List[int]]]:
+    def _drain_results(self) -> Tuple[
+        List[Tuple[int, List[int]]],
+        List[Tuple[int, List[Tuple[int, int]]]],
+    ]:
         """Decode everything on the result ring (caller holds _res_lk).
-        Returns churn acks as plain (tick, hub fids) values so the
+        Returns (churn acks, semantic query acks) as plain values so the
         caller can apply them after releasing the leaf lock."""
         acks: List[Tuple[int, List[int]]] = []
+        semacks: List[Tuple[int, List[Tuple[int, int]]]] = []
         ring = self._slab.result
         while True:
             rec = ring.peek_at(0)
             if rec is None:
-                return acks
+                return acks, semacks
             if rec.kind == K_MATCH_RES:
                 n = rec.a
                 counts = rec.payload[:4 * n].view(np.uint32).astype(
@@ -571,6 +783,25 @@ class ShmMatchEngine:
                     rec.tick,
                     rec.payload[:8 * rec.a].view(np.int64).tolist(),
                 ))
+            elif rec.kind == K_SEM_RES:
+                # raw bytes only under the leaf lock; JSON decodes in
+                # semantic_collect
+                self._sem_results[rec.tick] = bytes(
+                    rec.payload[:rec.nbytes]
+                )
+            elif rec.kind == K_SEMQ_ACK:
+                blob = bytes(rec.payload[:rec.nbytes]).decode(
+                    "utf-8", "replace"
+                )
+                pairs: List[Tuple[int, int]] = []
+                for el in blob.split("\0"):
+                    lq, sep, hub = el.partition("\x01")
+                    if sep:
+                        try:
+                            pairs.append((int(lq), int(hub)))
+                        except ValueError:
+                            pass
+                semacks.append((rec.tick, pairs))
             ring.advance()
 
     def _apply_ack(self, tick: int, hub_fids: List[int]) -> None:
@@ -645,6 +876,10 @@ class ShmMatchEngine:
             "reregisters": self.shm_reregisters,
             "filters": self.n_filters,
             "unacked": len(self._unacked),
+            "sem_submits": self.sem_submits,
+            "sem_degraded": self.sem_degraded,
+            "sem_local": self.sem_local,
+            "sem_oversize": self.sem_oversize,
         }
 
     def close(self) -> None:

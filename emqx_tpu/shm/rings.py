@@ -70,15 +70,30 @@ C_HUB_WAIT = 9       # doorbell armed word: hub stores 1 before blocking on
 #                      rings AFTER arming, so a commit that races the store
 #                      is either seen by that re-check or rings the
 #                      level-triggered fd before poll() parks.
+C_SEM = 10           # hub-maintained POOL-WIDE live semantic-query count,
+#                      mirrored into every lane's control page: a worker
+#                      skips shipping K_SEM payload ticks entirely while
+#                      it reads 0 (no subscriber anywhere could match)
 
 MAGIC = 0x45545055_00000001  # "ETPU" | layout version
 
-# record kinds (submit ring: MATCH/CHURN/HELLO; result ring: ACK/RES)
+# record kinds (submit ring: MATCH/CHURN/HELLO/SEM/SEMQ;
+#               result ring: ACK/RES/SEM_RES/SEMQ_ACK)
 K_MATCH = 1      # a=n live topics, b=B, c=L, payload=[B, 2L+2] u32
 K_CHURN = 2      # tick=churn seq, a=len(adds blob), b=len(removes blob)
 K_HELLO = 3      # fresh worker incarnation: hub drops its old filters
 K_CHURN_ACK = 4  # tick=churn seq, a=n add fids, payload=i64 fids
 K_MATCH_RES = 5  # tick=tick id, a=n, payload=u32 counts[n] + i32 fids
+K_SEM = 6        # semantic payload tick: tick=tick id, a=n texts,
+#                  payload=NUL-separated utf-8 embed prefixes
+K_SEM_RES = 7    # tick=tick id, a=n, payload=json per-text match
+#                  records ({"own": [hub qids], "rem": {node: [qids]}})
+K_SEMQ = 8       # semantic query churn: tick=semq seq, a=n adds,
+#                  b=n removes, payload=NUL blob ("lqid\x01text" adds
+#                  first, then "lqid" removes); c=1 marks the record as
+#                  carrying the worker's node name as blob element 0
+K_SEMQ_ACK = 9   # tick=semq seq, a=n adds, payload=NUL blob of
+#                  "lqid\x01hubqid" pairs (worker builds hub->local map)
 
 
 def slab_bytes(slots: int, slot_bytes: int) -> int:

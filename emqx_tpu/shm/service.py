@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import json
 import os
 import select
 import time
@@ -73,9 +74,9 @@ from ..ops import native
 from .doorbell import Doorbell
 from .registry import ShmRegistry
 from .rings import (
-    C_HUB_GEN, C_HUB_HB, C_HUB_WAIT, C_MAGIC, C_CHURN_APPLIED, K_CHURN,
-    K_HELLO, K_MATCH, K_CHURN_ACK, K_MATCH_RES, MAGIC, SlabView,
-    slab_bytes,
+    C_HUB_GEN, C_HUB_HB, C_HUB_WAIT, C_MAGIC, C_CHURN_APPLIED, C_SEM,
+    K_CHURN, K_HELLO, K_MATCH, K_CHURN_ACK, K_MATCH_RES, K_SEM,
+    K_SEM_RES, K_SEMQ, K_SEMQ_ACK, MAGIC, SlabView, slab_bytes,
 )
 
 GROUP_SIZES = (4, 2, 1)  # same ladder as the sharded coalescer
@@ -118,7 +119,8 @@ class LaneState:
     """One worker's slab plus the hub's bookkeeping for it."""
 
     __slots__ = ("idx", "slab", "gen", "filters", "res_lk",
-                 "pending_acks", "doorbell", "last_match_ns")
+                 "pending_acks", "doorbell", "last_match_ns",
+                 "sem_owner", "sem_l2h", "pending_sem_acks")
 
     def __init__(self, idx: int, slab: SlabView,
                  doorbell: Optional[Doorbell] = None):
@@ -127,6 +129,14 @@ class LaneState:
         self.gen = slab.worker_gen
         # filter -> refcount added by THIS lane (drives reclamation)
         self.filters: Dict[str, int] = {}
+        # semantic lane: owner key queries are registered under (the
+        # worker's node name, K_SEMQ blob element 0; lane-scoped
+        # fallback until it arrives), worker lqid -> hub qid (drives
+        # removes + reclamation), and K_SEMQ_ACK blobs awaiting ring
+        # space (same never-lose-an-ack contract as churn acks)
+        self.sem_owner = f"lane{idx}"
+        self.sem_l2h: Dict[int, int] = {}
+        self.pending_sem_acks: List[Tuple[int, int, bytes]] = []
         self.res_lk = asyncio.Lock()
         # churn acks that found the result ring full: unlike match
         # results (worker times out to its local trie and retries the
@@ -159,6 +169,18 @@ class _MatchReq:
         self.t_fuse = 0
 
 
+class _SemReq:
+    """One K_SEM payload tick: texts decoded at drain time (the slot
+    recycles immediately), matched off-loop, answered per lane."""
+
+    __slots__ = ("lane", "tick", "texts")
+
+    def __init__(self, lane: LaneState, tick: int, texts: List[str]):
+        self.lane = lane
+        self.tick = tick
+        self.texts = texts
+
+
 class MatchService:
     """Single hub-side drain loop over all worker lanes."""
 
@@ -167,6 +189,10 @@ class MatchService:
                  drain: str = "auto", fuse_window_us: int = 0,
                  lane_credit: int = 64, pin_cores: str = ""):
         self.engine = engine
+        # ONE pool-wide SemanticEngine (emqx_tpu/semantic/engine.py),
+        # attached by the supervisor when `semantic.enable` is on: the
+        # only embedding table in the whole fleet lives behind this
+        self.semantic = None
         self.reg = reg
         self.slots = slots
         self.slot_bytes = slot_bytes
@@ -201,6 +227,10 @@ class MatchService:
         self.reclaims = 0
         self.res_drops = 0
         self.ack_sheds = 0        # churn acks shed by _flush_acks
+        self.sem_ticks = 0        # K_SEM ticks answered
+        self.sem_texts = 0        # payload texts matched
+        self.sem_res_drops = 0    # replies lost to a full result ring
+        self.sem_churn = 0        # K_SEMQ records applied
         self.errors = 0
         # drain-engine telemetry: passes that found work vs not, how
         # the loop was woken, credit exhaustions, fusion-window waits
@@ -241,6 +271,9 @@ class MatchService:
         slab.ctrl[C_CHURN_APPLIED] = 0
         slab.ctrl[C_HUB_WAIT] = 0
         slab.ctrl[C_HUB_HB] = time.monotonic_ns()
+        slab.ctrl[C_SEM] = (
+            self.semantic.n_queries if self.semantic is not None else 0
+        )
         prev = self.lanes.get(idx)
         db = prev.doorbell if prev is not None else Doorbell()
         self.lanes[idx] = LaneState(idx, slab, db)
@@ -264,6 +297,7 @@ class MatchService:
         # a respawn restarts from zero — never deliver them to the new
         # incarnation
         lane.pending_acks.clear()
+        lane.pending_sem_acks.clear()
         n = sum(lane.filters.values())
         for filt, cnt in lane.filters.items():
             for _ in range(cnt):
@@ -272,6 +306,18 @@ class MatchService:
                 except Exception:  # pragma: no cover - engine poisoned
                     self.errors += 1
         lane.filters.clear()
+        # the dead incarnation's semantic queries go the same way: its
+        # lqid space restarts from zero on respawn, so every mapping is
+        # stale the moment the gen bumps
+        if lane.sem_l2h and self.semantic is not None:
+            for hub in lane.sem_l2h.values():
+                try:
+                    self.semantic.remove_query(hub)
+                except Exception:  # pragma: no cover
+                    self.errors += 1
+            n += len(lane.sem_l2h)
+        lane.sem_l2h.clear()
+        self._sync_sem_count()
         if n:
             tp("shm.reclaim", lane=lane.idx, filters=n, why=why)
 
@@ -345,9 +391,150 @@ class MatchService:
             w.commit(K_CHURN_ACK, seq, a=len(fids), nbytes=arr.nbytes)
             lane.pending_acks.pop(0)
 
+    # ---------------------------------------------------------- semantic
+
+    def _sync_sem_count(self) -> None:
+        """Mirror the pool-wide live query count into every lane's
+        C_SEM cell: workers gate their K_SEM submits on it, so the
+        no-semantic-anywhere fleet never ships a payload tick."""
+        n = self.semantic.n_queries if self.semantic is not None else 0
+        for lane in self.lanes.values():
+            lane.slab.ctrl[C_SEM] = n
+
+    def _apply_semq(self, lane: LaneState, rec) -> None:
+        """K_SEMQ: register/deregister one worker's semantic queries
+        against the hub table.  Applied inline on the drain pass (the
+        churn discipline: a K_SEM that FOLLOWS the subscribe in the same
+        ring matches against the updated table)."""
+        blob = bytes(rec.payload[: rec.nbytes]).decode("utf-8", "replace")
+        parts = blob.split("\0")
+        if rec.c and parts:
+            if parts[0]:
+                lane.sem_owner = parts[0]
+            parts = parts[1:]
+        adds = parts[: rec.a]
+        removes = parts[rec.a: rec.a + rec.b]
+        pairs: List[Tuple[int, int]] = []
+        for el in adds:
+            lq, sep, text = el.partition("\x01")
+            try:
+                lqid = int(lq)
+            except ValueError:
+                self.errors += 1
+                continue
+            if not sep:
+                continue
+            hub = -1
+            if self.semantic is not None:
+                try:
+                    hub = int(self.semantic.add_query(
+                        text, owner=lane.sem_owner
+                    ))
+                except Exception:  # pragma: no cover - engine poisoned
+                    self.errors += 1
+                    hub = -1
+            if hub >= 0:
+                lane.sem_l2h[lqid] = hub
+            pairs.append((lqid, hub))
+        for el in removes:
+            try:
+                lqid = int(el)
+            except ValueError:
+                continue
+            hub = lane.sem_l2h.pop(lqid, None)
+            if hub is not None and self.semantic is not None:
+                try:
+                    self.semantic.remove_query(hub)
+                except Exception:  # pragma: no cover
+                    self.errors += 1
+        self.sem_churn += 1
+        self._sync_sem_count()
+        if pairs:
+            ab = "\0".join(f"{lq}\x01{hub}" for lq, hub in pairs)
+            lane.pending_sem_acks.append(
+                (rec.tick, len(pairs), ab.encode())
+            )
+            self._flush_sem_acks(lane)
+        tp("shm.semq", lane=lane.idx, seq=rec.tick, adds=len(adds),
+           removes=len(removes),
+           live=self.semantic.n_queries if self.semantic else 0)
+
+    def _flush_sem_acks(self, lane: LaneState) -> None:
+        """K_SEMQ_ACK writer: same ordered/bounded contract as
+        `_flush_acks` — a worker whose un-acked queries never map can
+        never receive a cross-worker forward for them."""
+        while lane.pending_sem_acks:
+            w = lane.slab.result.reserve()
+            if w is None:
+                over = len(lane.pending_sem_acks) - 4 * self.slots
+                if over > 0:
+                    del lane.pending_sem_acks[:over]
+                    self.ack_sheds += over
+                    tp("shm.ack_shed", lane=lane.idx, shed=over,
+                       queued=len(lane.pending_sem_acks))
+                return
+            seq, n, blob = lane.pending_sem_acks[0]
+            w.payload_u8(len(blob))[:] = np.frombuffer(blob, np.uint8)
+            w.commit(K_SEMQ_ACK, seq, a=n, nbytes=len(blob))
+            lane.pending_sem_acks.pop(0)
+
+    def _dispatch_sem(self, reqs: List[_SemReq]) -> None:
+        """Fuse every lane's payload ticks from this pass into ONE
+        engine call (the cross-worker coalescing story, semantic
+        edition) and answer each lane off-loop."""
+        loop = asyncio.get_running_loop()
+        t = loop.create_task(self._collect_sem_reply(reqs))
+        self._replies.add(t)
+        t.add_done_callback(self._replies.discard)
+
+    async def _collect_sem_reply(self, reqs: List[_SemReq]) -> None:
+        texts: List[str] = []
+        for r in reqs:
+            texts.extend(r.texts)
+        loop = asyncio.get_running_loop()
+        try:
+            # engine.match runs the submit/collect split under its own
+            # lock (device top-k or exact host, EWMA-arbitrated) — the
+            # same blocking contract as foreign_collect
+            rows = await loop.run_in_executor(
+                None, self.semantic.match, texts
+            )
+        except Exception:  # pragma: no cover - device fault
+            self.errors += 1
+            return
+        owners = self.semantic.table.owners
+        off = 0
+        for req in reqs:
+            n = len(req.texts)
+            recs = []
+            for row in rows[off: off + n]:
+                own: List[int] = []
+                rem: Dict[str, List[int]] = {}
+                for qid, _score in row:
+                    owner = owners.get(qid, "")
+                    if owner == req.lane.sem_owner:
+                        own.append(int(qid))
+                    elif owner:
+                        rem.setdefault(owner, []).append(int(qid))
+                recs.append({"own": own, "rem": rem})
+            off += n
+            blob = json.dumps(recs, separators=(",", ":")).encode()
+            lane = req.lane
+            async with lane.res_lk:
+                w = lane.slab.result.reserve()
+                if w is None or len(blob) > lane.slab.result.payload_cap:
+                    self.sem_res_drops += 1
+                    continue  # worker times out to its exact fallback
+                w.payload_u8(len(blob))[:] = np.frombuffer(
+                    blob, np.uint8
+                )
+                w.commit(K_SEM_RES, req.tick, a=n, nbytes=len(blob))
+            self.sem_ticks += 1
+            self.sem_texts += n
+
     # ------------------------------------------------------------- drain
 
-    def _drain_once(self) -> Tuple[int, List[_MatchReq]]:
+    def _drain_once(self) -> Tuple[int, List[_MatchReq], List[_SemReq]]:
         """Phase 1+3: walk every lane's published records in order,
         applying churn inline and COPYING match payloads, then advance
         the tails so the slots recycle immediately.
@@ -359,6 +546,7 @@ class MatchService:
         flags the loop to re-pass immediately instead of sleeping —
         the flooding lane carries over, the siblings go first."""
         reqs: List[_MatchReq] = []
+        semreqs: List[_SemReq] = []
         consumed = 0
         self._more = False
         now_ns = time.monotonic_ns()  # one clock read per pass: span
@@ -373,6 +561,8 @@ class MatchService:
             self._check_worker_gen(lane)
             if lane.pending_acks:  # ring-full leftovers from last pass
                 self._flush_acks(lane)
+            if lane.pending_sem_acks:
+                self._flush_sem_acks(lane)
             ring = lane.slab.submit
             k = 0
             taken = 0
@@ -403,6 +593,19 @@ class MatchService:
                     reqs.append(_MatchReq(lane, rec.tick, rec.a,
                                           rec.b, rec.c, buf,
                                           now_ns if rec.ts[0] else 0))
+                elif rec.kind == K_SEMQ:
+                    self._apply_semq(lane, rec)
+                elif rec.kind == K_SEM:
+                    raw = bytes(rec.payload[: rec.nbytes]).decode(
+                        "utf-8", "replace"
+                    )
+                    texts = raw.split("\0") if rec.nbytes else []
+                    if len(texts) < rec.a:
+                        texts += [""] * (rec.a - len(texts))
+                    semreqs.append(
+                        _SemReq(lane, rec.tick, texts[: rec.a])
+                    )
+                    lane.last_match_ns = now_ns
                 k += 1
                 taken += 1
             if k:
@@ -412,7 +615,7 @@ class MatchService:
             1 for lane in self.lanes.values()
             if now_ns - lane.last_match_ns < HOT_NS and lane.last_match_ns
         )
-        return consumed, reqs
+        return consumed, reqs, semreqs
 
     def _effective_window_s(self) -> float:
         """The adaptive fusion window: ``shm.fuse_window_us`` while >= 2
@@ -501,20 +704,25 @@ class MatchService:
     async def _pass(self) -> int:
         """One drain pass + fusion window + dispatch; returns records
         consumed.  Sets ``self._more`` when credit left surplus."""
-        consumed, reqs = self._drain_once()
-        if reqs:
+        consumed, reqs, semreqs = self._drain_once()
+        if reqs or semreqs:
             window = self._effective_window_s()
             if window > 0:
                 hit = {r.lane.idx for r in reqs}
+                hit |= {r.lane.idx for r in semreqs}
                 if len(hit) < self._hot_count:
                     # some hot lane missed this harvest: hold dispatch
                     # one window so its in-flight tick fuses in
                     self.fuse_waits += 1
                     await asyncio.sleep(window)
-                    c2, r2 = self._drain_once()
+                    c2, r2, s2 = self._drain_once()
                     consumed += c2
                     reqs += r2
-            self._dispatch(reqs)
+                    semreqs += s2
+            if reqs:
+                self._dispatch(reqs)
+            if semreqs and self.semantic is not None:
+                self._dispatch_sem(semreqs)
         return consumed
 
     async def _run(self) -> None:
@@ -574,8 +782,9 @@ class MatchService:
         lanes = list(self.lanes.values())
         fds = [ln.doorbell.wait_fd for ln in lanes]
         fds.append(self._stop_db.wait_fd)
-        bound = _ACK_RETRY_S if any(ln.pending_acks for ln in lanes) \
-            else _HOUSEKEEP_S
+        bound = _ACK_RETRY_S \
+            if any(ln.pending_acks or ln.pending_sem_acks
+                   for ln in lanes) else _HOUSEKEEP_S
         deadline = time.monotonic() + bound
         while not self._stop:
             ns = time.monotonic_ns()
@@ -680,8 +889,10 @@ class MatchService:
             out[idx] = {
                 "submit_depth": lane.slab.submit.depth,
                 "result_depth": lane.slab.result.depth,
-                "pending_acks": len(lane.pending_acks),
+                "pending_acks": len(lane.pending_acks)
+                + len(lane.pending_sem_acks),
                 "filters": sum(lane.filters.values()),
+                "sem_queries": len(lane.sem_l2h),
             }
         return out
 
@@ -696,6 +907,12 @@ class MatchService:
             "reclaims": self.reclaims,
             "res_drops": self.res_drops,
             "ack_sheds": self.ack_sheds,
+            "sem_ticks": self.sem_ticks,
+            "sem_texts": self.sem_texts,
+            "sem_res_drops": self.sem_res_drops,
+            "sem_churn": self.sem_churn,
+            "sem_queries": (self.semantic.n_queries
+                            if self.semantic is not None else 0),
             "errors": self.errors,
             "group_sizes": dict(self.group_sizes),
             "drain_mode": self.drain_mode or self.drain,

@@ -150,6 +150,12 @@ class WireSupervisor:
                 lane_credit=int(conf.get("shm.lane_credit")),
                 pin_cores=str(conf.get("shm.pin_cores")),
             )
+            sem = getattr(self.runtime, "semantic", None)
+            if sem is not None and sem.engine is not None:
+                # the pool's ONE embedding table: workers register
+                # queries and ship payload ticks through their lanes;
+                # no worker process ever holds [max_queries, dim] state
+                self.service.semantic = sem.engine
         for i in range(self.n):
             self.workers[i] = WorkerHandle(
                 idx=i,
@@ -549,6 +555,12 @@ class WireSupervisor:
                 c["shm.hub.ack_shed"] = st["ack_sheds"]
                 c["shm.hub.credit_exhausted"] = st["credit_exhausted"]
                 c["shm.hub.doorbell_wakeups"] = st["doorbell_wakeups"]
+                c["shm.hub.sem_ticks"] = st["sem_ticks"]
+                c["shm.hub.sem_texts"] = st["sem_texts"]
+                c["shm.hub.sem_res_drops"] = st["sem_res_drops"]
+                c["shm.hub.sem_churn"] = st["sem_churn"]
+                m.gauge_set("shm.hub.sem_queries",
+                            float(st["sem_queries"]))
                 m.gauge_set("shm.lanes", float(st["lanes"]))
                 m.gauge_set("shm.hub.fused_share",
                             float(st["fused_share"]))

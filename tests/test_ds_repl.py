@@ -168,6 +168,48 @@ def test_mirror_append_is_idempotent_and_nacks_holes(tmp_path):
     repl.close_mirrors()
 
 
+def test_mirror_gc_trims_behind_leader_floor(tmp_path):
+    """Bounded disk: the leader stamps its retention floor on every
+    ship; the follower drops sealed mirror generations wholly below
+    it — without this the mirror holds TOTAL history while the leader
+    holds a retention window."""
+    b, _ds, repl = mk_repl(tmp_path, **{"seg_bytes": 256})
+    # ship enough small records to seal several mirror generations
+    for first in range(0, 40, 4):
+        blob = pack_records(
+            [(first + i, b"r%02d" % (first + i) * 4) for i in range(4)]
+        )
+        hdr = {"node": "ldr", "shard": 0, "first": first, "count": 4}
+        assert repl.handle_repl("ldr", hdr, blob)["ok"]
+    mirror = repl.mirror_log("ldr", 0)
+    assert len(mirror.segments) >= 3  # sealed chain to trim
+    assert mirror.oldest_offset == 0
+
+    # a floor mid-chain: every sealed generation wholly below it goes
+    floor = mirror.segments[1].end
+    blob = pack_records([(40, b"tail")])
+    hdr = {"node": "ldr", "shard": 0, "first": 40, "count": 1,
+           "floor": floor}
+    assert repl.handle_repl("ldr", hdr, blob)["ok"]
+    assert 0 < mirror.oldest_offset <= floor
+    assert b.metrics.get("ds.repl.mirror_gc") >= 2
+    # records at/above the new oldest still read back intact
+    oldest = mirror.oldest_offset
+    recs, _n, _gap = mirror.read_from(oldest, 100)
+    assert recs and recs[0][0] == oldest and recs[-1][0] == 40
+    # stale floor (already trimmed past it): a no-op, never an error
+    gc0 = b.metrics.get("ds.repl.mirror_gc")
+    hdr = {"node": "ldr", "shard": 0, "first": 41, "count": 1, "floor": 1}
+    assert repl.handle_repl("ldr", hdr, pack_records([(41, b"z")]))["ok"]
+    assert b.metrics.get("ds.repl.mirror_gc") == gc0
+    # the ACTIVE segment is never dropped, even wholly below the floor
+    hdr = {"node": "ldr", "shard": 0, "first": 42, "count": 1,
+           "floor": 10_000}
+    assert repl.handle_repl("ldr", hdr, pack_records([(42, b"z")]))["ok"]
+    assert mirror.next_offset == 43
+    repl.close_mirrors()
+
+
 def test_mirrors_readopted_across_restart(tmp_path):
     b, ds, repl = mk_repl(tmp_path)
     repl.handle_repl(

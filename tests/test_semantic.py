@@ -1,0 +1,591 @@
+"""Semantic subscription plane tests (emqx_tpu/semantic/).
+
+Four tiers, mirroring the retained-index and shm test structure:
+embedder determinism; the engine's device-nominates/host-decides
+contract (seeded property test vs an independent dense oracle, under
+query churn, plus the refetch widening and the EWMA arbiter); the
+broker classifier front ($semantic filters never touch the trie, the
+route oplog, or the retained iterator — and a restart re-subscribes
+through the classifier with zero leaked state); and the shm tier
+(worker ships embed prefixes over K_SEM and never boots an embedding
+table, cross-worker hits come back as per-owner sections, hub death
+degrades to exact own-query scoring, a worker kill -9 mid-submit
+leaks no slots).
+"""
+
+import asyncio
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import Message
+from emqx_tpu.broker.packet import SubOpts
+from emqx_tpu.broker.session import Session
+from emqx_tpu.models.engine import TopicMatchEngine
+from emqx_tpu.ops.hashing import HashSpace
+from emqx_tpu.semantic.embedder import (
+    embed_batch, embed_text, payload_text,
+)
+from emqx_tpu.semantic.engine import SemanticEngine
+from emqx_tpu.semantic.plane import SemanticPlane
+from emqx_tpu.shm.registry import ShmRegistry
+from emqx_tpu.shm.service import MatchService
+from emqx_tpu.shm.client import ShmMatchEngine
+
+DIM = 64
+
+
+# ------------------------------------------------------------- embedder
+
+
+def test_embedder_deterministic_and_unit_norm():
+    a = embed_text("gps position update", DIM)
+    b = embed_text("gps position update", DIM)
+    assert a.dtype == np.float32 and a.shape == (DIM,)
+    assert np.array_equal(a, b)  # bit-identical, not just close
+    assert abs(float(np.linalg.norm(a)) - 1.0) < 1e-5
+    # distinct texts land on distinct directions
+    c = embed_text("pasta recipe ideas", DIM)
+    assert float(np.dot(a, c)) < 0.9
+    # batch path writes the same bits as the scalar path
+    out = np.zeros((2, DIM), dtype=np.float32)
+    embed_batch(["gps position update", "pasta recipe ideas"], DIM, out=out)
+    assert np.array_equal(out[0], a) and np.array_equal(out[1], c)
+
+
+def test_payload_text_strips_separator():
+    # NUL is the K_SEM wire separator: it must never survive decode
+    assert "\x00" not in payload_text(b"a\x00b")
+    assert payload_text("temp 21C".encode()) == "temp 21C"
+    payload_text(b"\xff\xfe garbage")  # undecodable bytes never raise
+
+
+# ------------------------------------- device vs oracle (property test)
+
+
+def _oracle(eng, texts):
+    """Independent dense scorer over the live table — the matched-set
+    definition verbatim: threshold passers by (-exact score, qid),
+    truncated to topk."""
+    out = []
+    live = np.nonzero(eng.table.valid)[0].tolist()
+    for t in texts:
+        vec = embed_text(t, eng.table.dim)
+        row = []
+        for q in live:
+            # one row at a time: multiply+row-sum is shape-independent
+            # (the engine's contract), so this is bit-comparable while
+            # sharing none of the engine's batching
+            sc = float((eng.table.vecs[[q]] * vec).sum(axis=1)[0])
+            if sc >= eng.threshold:
+                row.append((q, sc))
+        row.sort(key=lambda x: (-x[1], x[0]))
+        out.append(row[: eng.topk])
+    return out
+
+
+def _force_device(eng):
+    eng.rate_dev, eng.rate_host = 1e9, 1.0
+    eng._last_host_meas = time.monotonic()
+
+
+WORDS = ("gps position update fix sensor temp battery door kitchen "
+         "garage motion alert vibration humidity level tank pump flow "
+         "pressure valve open closed status heartbeat firmware").split()
+
+
+def test_device_matches_bit_agree_with_oracle_under_churn():
+    rng = random.Random(1207)
+    eng = SemanticEngine(dim=DIM, max_queries=128, topk=4,
+                         probe_interval=1e9)
+    _force_device(eng)
+
+    def text():
+        return " ".join(rng.choice(WORDS)
+                        for _ in range(rng.randrange(2, 6)))
+
+    qids = [eng.add_query(text()) for _ in range(40)]
+    for _ in range(30):
+        # churn mid-stream: the device table regathers under the lock
+        if rng.random() < 0.5 and len(qids) > 8:
+            eng.remove_query(qids.pop(rng.randrange(len(qids))))
+        if rng.random() < 0.5:
+            qids.append(eng.add_query(text()))
+        texts = [text() for _ in range(rng.randrange(1, 7))]
+        got = eng.match(texts)
+        want = _oracle(eng, texts)
+        for g, w in zip(got, want):
+            assert [q for q, _ in g] == [q for q, _ in w]
+            # exact scores, not approximately: membership is decided
+            # host-side with the oracle's arithmetic on both paths
+            assert [s for _, s in g] == [s for _, s in w]
+    assert eng.matches_dev > 0  # the device path really served
+
+
+def test_overflow_refetches_densely_and_widens_kcap():
+    eng = SemanticEngine(dim=DIM, max_queries=64, topk=2,
+                         probe_interval=1e9)
+    # 10 near-identical queries: far more threshold passers than the
+    # kcap-floor window can rank
+    for i in range(10):
+        eng.add_query(f"alpha beta gamma delta probe{i}")
+    texts = ["alpha beta gamma delta"]
+    assert len(_oracle(eng, texts)[0]) == eng.topk  # saturated for real
+    kcap0 = eng._kcap_dyn
+    assert kcap0 == 4
+    got = eng.collect(eng.submit(texts, kcap=kcap0))
+    assert got == _oracle(eng, texts)  # dense refetch kept it exact
+    assert eng.refetches >= 1
+    assert eng._kcap_dyn > kcap0  # window widened for the next tick
+
+
+def test_arbiter_flips_paths_and_probes_idle_device():
+    eng = SemanticEngine(dim=DIM, max_queries=32, topk=4,
+                         probe_interval=0.0)
+    eng.add_query("door open alert")
+    # cold start: no rates -> host path, which ships a device probe
+    eng.match(["door open alert"])
+    assert eng.matches_host >= 1 and eng.probes >= 1
+    flips0 = eng.path_flips
+    eng.probe_interval = 1e9  # host rate stays fresh for the flip leg
+    _force_device(eng)
+    eng._probe = None  # park the probe; this tick must go device
+    eng.match(["door open alert"])
+    assert eng.matches_dev >= 1 and eng.path_flips == flips0 + 1
+    eng.rate_dev = 0.5  # device measured slower: flip back
+    eng.match(["door open alert"])
+    assert eng.path_flips == flips0 + 2
+
+
+# --------------------------------------------- broker classifier front
+
+
+class Sink:
+    """Minimal channel: records deliveries (ChannelLike protocol)."""
+
+    def __init__(self, clientid):
+        self.clientid = clientid
+        self.session = Session(clientid=clientid)
+        self.got = []
+
+    def deliver(self, items):
+        self.got.extend(items)
+
+    def kick(self, reason_code=0):
+        pass
+
+
+def _sem_broker():
+    b = Broker()
+    b.semantic = SemanticPlane(
+        engine=SemanticEngine(dim=DIM, max_queries=64, topk=8)
+    )
+    return b
+
+
+def test_classifier_keeps_semantic_out_of_trie_and_oplog():
+    b = _sem_broker()
+    routes_announced = []
+    b.on_route_added = routes_announced.append
+    b.subscribe("c1", "$semantic/gps position update", SubOpts())
+    # the plane owns it; trie, route table, and route oplog never hear
+    assert b.semantic.n_queries == 1
+    assert b.engine.n_filters == 0
+    assert not b._routes and routes_announced == []
+    # ... and a plain filter still routes normally next to it
+    b.subscribe("c1", "room/+/temp", SubOpts())
+    assert routes_announced == ["room/+/temp"] and b.engine.n_filters == 1
+    assert b.semantic.n_queries == 1
+
+
+def test_publish_delivers_on_meaning_with_filter_preserved():
+    b = _sem_broker()
+    sink = Sink("c1")
+    b.cm.register_channel(sink)
+    b.subscribe("c1", "$semantic/gps position update", SubOpts())
+    n = b.publish(Message(topic="dev/42/out",
+                          payload=b"gps position update fix acquired"))
+    assert n == 1 and len(sink.got) == 1
+    filt, msg = sink.got[0]
+    assert filt == "$semantic/gps position update"
+    assert msg.topic == "dev/42/out"  # original topic, untouched
+    # meaning mismatch: same subscriber, nothing delivered
+    assert b.publish(Message(topic="dev/42/out",
+                             payload=b"seven cats purring loudly")) == 0
+    assert len(sink.got) == 1
+
+
+def test_unsubscribe_and_client_down_clean_the_plane():
+    b = _sem_broker()
+    b.subscribe("c1", "$semantic/door open alert", SubOpts())
+    b.subscribe("c1", "$semantic/water leak detected", SubOpts())
+    b.subscribe("c2", "$semantic/door open alert", SubOpts())
+    assert b.semantic.n_queries == 2 and b.semantic.n_subs == 3
+    b.unsubscribe("c1", "$semantic/door open alert")
+    assert b.semantic.n_queries == 2  # c2 still holds the query
+    # client_down with an INCOMPLETE filters list: the plane knows its
+    # own stragglers (session-loss path)
+    b.client_down("c1", [])
+    b.client_down("c2", ["$semantic/door open alert"])
+    assert b.semantic.n_queries == 0 and b.semantic.n_subs == 0
+    assert b.semantic.engine.n_queries == 0  # device rows released
+    assert b._sub_count == 0
+
+
+def test_retained_iter_skips_semantic_filters():
+    b = _sem_broker()
+    b.retainer.on_publish(Message(topic="a/b", payload=b"kept",
+                                  retain=True))
+    assert list(b.retained_iter("$semantic/anything", 0, True)) == []
+
+
+def test_restart_resubscribes_through_classifier_no_leak():
+    """Queries survive a restart via session re-subscribe (the bulk
+    bootstrap path), NOT via any match-table snapshot — and the
+    replayed filters still never touch the trie."""
+    filters = ["$semantic/gps position update", "room/+/temp"]
+    b1 = _sem_broker()
+    fids = b1.subscribe_bulk("c1", filters, SubOpts())
+    assert fids[0] is None and fids[1] is not None  # no fid for the plane
+    assert b1.semantic.n_queries == 1
+    # "restart": a fresh broker + plane, session store replays the subs
+    b2 = _sem_broker()
+    sink = Sink("c1")
+    b2.cm.register_channel(sink)
+    b2.subscribe_bulk("c1", filters, SubOpts())
+    assert b2.semantic.n_queries == 1 and b2.engine.n_filters == 1
+    assert b2.publish(Message(topic="t", payload=b"gps position fix")) == 1
+    # a broker with the plane OFF refuses the class outright: the
+    # filter must not silently become a trie filter
+    b3 = Broker()
+    b3.subscribe("c1", "$semantic/gps position update", SubOpts())
+    assert b3.engine.n_filters == 0 and b3._sub_count == 0
+
+
+# --------------------------------------------------------- shm tier
+
+
+SLOTS = 16
+SLOT_BYTES = 65536
+
+
+class _Plane:
+    """Hub engine + MatchService (with a SemanticEngine attached) on a
+    background loop thread — the in-process supervisor/worker analogue
+    from test_shm.py, semantic edition."""
+
+    def __init__(self, scope, drain="auto", sem_dim=DIM, sem_cap=64):
+        self.space = HashSpace()
+        self.engine = TopicMatchEngine(space=self.space)
+        self.reg = ShmRegistry(scope)
+        self.svc = MatchService(self.engine, self.reg, slots=SLOTS,
+                                slot_bytes=SLOT_BYTES,
+                                poll_interval=0.001, drain=drain)
+        self.svc.semantic = SemanticEngine(dim=sem_dim,
+                                           max_queries=sem_cap,
+                                           topk=8)
+        self.loop = asyncio.new_event_loop()
+        self._thread = None
+        self.clients = []
+        self._lane_of = {}
+
+    def lane(self, idx):
+        region = self.svc.create_lane(idx)
+        self._lane_of[region] = idx
+        return region
+
+    def start(self):
+        def run():
+            asyncio.set_event_loop(self.loop)
+            self.svc.start()
+            self.loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def client(self, region, node="", timeout=60.0):
+        idx = self._lane_of.get(region)
+        db_fd = self.svc.doorbell_fd(idx) if idx is not None else None
+        c = ShmMatchEngine(space=self.space, region=region,
+                           slots=SLOTS, slot_bytes=SLOT_BYTES,
+                           timeout=timeout, doorbell_fd=db_fd)
+        c.sem_node = node
+        self.clients.append(c)
+        return c
+
+    def kill_hub(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(10)
+        self._thread = None
+        if self.svc._exec is not None:
+            self.svc._stop = True
+            if self.svc._stop_db is not None:
+                self.svc._stop_db.ring()
+            self.svc._exec.shutdown(wait=True)
+
+    def stop(self, unlink=True):
+        if self._thread is not None:
+            fut = asyncio.run_coroutine_threadsafe(
+                self.svc.stop(), self.loop
+            )
+            fut.result(30)
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._thread.join(10)
+        for c in self.clients:
+            c.close()
+        self.svc.close(unlink=unlink)
+        self.loop.close()
+
+
+def _wait(pred, timeout=30.0, ivl=0.01):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError("condition not reached")
+        time.sleep(ivl)
+
+
+def _acked(cli, plane):
+    """Predicate: every K_SEMQ add this worker sent has its hub-qid
+    mapping (the plane's remote fan-out depends on it)."""
+    def pred():
+        cli.poll()
+        return len(cli._qloc2hub) == len(plane._own)
+    return pred
+
+
+def test_shm_cross_worker_sections_and_no_worker_table(tmp_path):
+    plane = _Plane(str(tmp_path))
+    rA, rB = plane.lane(0), plane.lane(1)
+    plane.start()
+    try:
+        cA = plane.client(rA, node="wA")
+        cB = plane.client(rB, node="wB")
+        pA = SemanticPlane(shm=cA, dim=DIM, topk=8)
+        pB = SemanticPlane(shm=cB, dim=DIM, topk=8)
+        pA.subscribe("clientA", "gps position update")
+        pB.subscribe("clientB", "kitchen oven temperature")
+        _wait(_acked(cA, pA), timeout=10)
+        _wait(_acked(cB, pB), timeout=10)
+        # the hub owns the ONE pool-wide table; workers hold only their
+        # own rows (no engine, no [max_queries, dim] allocation)
+        assert plane.svc.semantic.n_queries == 2
+        assert pA.engine is None and len(pA._own) == 1
+        _wait(lambda: cB.semantic_active(), timeout=10)
+
+        # B publishes a payload meaning A's query: B's own section is
+        # empty, the hit rides the remote section keyed by A's owner
+        pend = pB.submit([b"gps position update fix acquired"])
+        assert pend is not None and pend.mode == "shm"
+        local, remote = pB.finish(pB.collect(pend))
+        assert local == [[]]
+        assert len(remote) == 1
+        node, hub_qids, k = remote[0]
+        assert node == "wA" and k == 0 and hub_qids
+        # receiver side: hub qids map back to A's local query + client
+        assert pA.deliver_remote(hub_qids) == \
+            [("clientA", "$semantic/gps position update")]
+
+        # B's own query matches locally, nothing forwarded
+        pend = pB.submit([b"kitchen oven temperature rising"])
+        local, remote = pB.finish(pB.collect(pend))
+        assert local == [[("clientB", "$semantic/kitchen oven temperature")]]
+        assert remote == []
+
+        # meaning nobody asked for: empty everywhere
+        pend = pB.submit([b"seven cats purring loudly tonight"])
+        local, remote = pB.finish(pB.collect(pend))
+        assert local == [[]] and remote == []
+
+        # unsubscribe drains the hub table (K_SEMQ remove + refcount)
+        pA.unsubscribe("clientA", "gps position update")
+        _wait(lambda: plane.svc.semantic.n_queries == 1, timeout=10)
+    finally:
+        plane.stop()
+
+
+def test_shm_idle_worker_ack_drained_on_deliver_remote(tmp_path):
+    """A worker with NO publish traffic never polls, so its query's
+    K_SEMQ_ACK sits unread in the response ring — deliver_remote must
+    drain it on demand or a sem-tagged cluster forward silently drops
+    (caught live: cross-worker wire delivery to an idle subscriber)."""
+    plane = _Plane(str(tmp_path))
+    rA = plane.lane(0)
+    plane.start()
+    try:
+        cA = plane.client(rA, node="wA")
+        pA = SemanticPlane(shm=cA, dim=DIM, topk=8)
+        pA.subscribe("clientA", "gps position update")
+        # wait hub-side ONLY: the ack is written but never polled
+        _wait(lambda: plane.svc.semantic.n_queries == 1, timeout=10)
+        assert len(cA._qloc2hub) == 0  # the idle worker hasn't read it
+        hub_qid = int(np.flatnonzero(plane.svc.semantic.table.valid)[0])
+        assert pA.deliver_remote([hub_qid]) == \
+            [("clientA", "$semantic/gps position update")]
+    finally:
+        plane.stop()
+
+
+# Child worker process for the RSS test: attaches to the hub lane over
+# shared memory, subscribes one semantic query, serves a publish round
+# end-to-end, and reports how much its OWN resident set grew doing it.
+# Runs with doorbell_fd=None (the hub is in poll-drain for this test),
+# so nothing but the region name crosses the process boundary.
+_RSS_CHILD = r"""
+import json, resource, sys, time
+
+region, dim = sys.argv[1], int(sys.argv[2])
+
+from emqx_tpu.ops.hashing import HashSpace
+from emqx_tpu.semantic.plane import SemanticPlane
+from emqx_tpu.shm.client import ShmMatchEngine
+
+def rss_kb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+base = rss_kb()
+cli = ShmMatchEngine(space=HashSpace(), region=region, slots=16,
+                     slot_bytes=65536, timeout=30.0, doorbell_fd=None)
+cli.sem_node = "wC"
+plane = SemanticPlane(shm=cli, dim=dim, topk=8)
+plane.subscribe("clientC", "gps position update")
+t0 = time.monotonic()
+while len(cli._qloc2hub) < 1 or not cli.semantic_active():
+    assert time.monotonic() - t0 < 20, "hub never acked the query"
+    cli.poll()
+    time.sleep(0.005)
+pend = plane.submit([b"gps position update fix acquired"])
+assert pend is not None and pend.mode == "shm", pend
+local, remote = plane.finish(plane.collect(pend))
+assert local == [[("clientC", "$semantic/gps position update")]], local
+grew = rss_kb() - base
+print(json.dumps({"grew_kb": grew}))
+# exit WITHOUT unsubscribing: the hub keeps the query's row until the
+# lane is reclaimed, and the parent's publish leg depends on it
+cli.close()
+"""
+
+
+def test_shm_worker_process_rss_no_embedding_table(tmp_path):
+    """The acceptance-criteria RSS leg: a REAL worker process (its own
+    address space, unlike the in-process harness above) serves a
+    $semantic subscription end-to-end while the hub holds a ~32 MB
+    embedding table — and the worker's resident set grows by a small
+    fraction of that, proving no worker-resident table ever exists."""
+    plane = _Plane(str(tmp_path), drain="poll", sem_dim=256,
+                   sem_cap=32768)
+    rA, rC = plane.lane(0), plane.lane(1)
+    plane.start()
+    try:
+        table_kb = plane.svc.semantic.table.vecs.nbytes // 1024
+        assert table_kb >= 32 * 1024  # the table the worker must NOT have
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, "-c", _RSS_CHILD, rC, "256"],
+            capture_output=True, timeout=120, cwd=root, env=env,
+        )
+        assert out.returncode == 0, out.stderr.decode()
+        grew_kb = json.loads(out.stdout.decode().strip().splitlines()[-1])[
+            "grew_kb"]
+        # attach (ring mmap) + one [dim] own-row + bookkeeping: a few
+        # MB at most.  A worker-resident copy of the hub table would
+        # blow straight through this bound.
+        assert grew_kb < table_kb // 4, (grew_kb, table_kb)
+
+        # publish-on-worker-A leg: A's publish matches the CHILD's
+        # query at the hub and comes back as a remote section naming
+        # the child worker — the hub matched on meaning for a process
+        # that is not even alive any more, purely from its table row
+        cA = plane.client(rA, node="wA")
+        pubA = SemanticPlane(shm=cA, dim=256, topk=8)
+        _wait(lambda: cA.semantic_active(), timeout=10)
+        pend = pubA.submit([b"gps position update fix acquired"])
+        assert pend is not None and pend.mode == "shm"
+        local, remote = pubA.finish(pubA.collect(pend))
+        assert local == [[]]
+        assert len(remote) == 1 and remote[0][0] == "wC"
+    finally:
+        plane.stop()
+
+
+def test_shm_pool_idle_skips_the_ring_entirely(tmp_path):
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        cli = plane.client(region, node="w0")
+        p = SemanticPlane(shm=cli, dim=DIM, topk=8)
+        # zero queries anywhere in the pool: C_SEM gates the whole tick
+        assert p.submit([b"any payload at all"]) is None
+        assert cli.sem_submits == 0 and cli.sem_local == 0
+    finally:
+        plane.stop()
+
+
+def test_shm_hub_death_degrades_to_exact_own_queries(tmp_path):
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        cli = plane.client(region, node="w0")
+        p = SemanticPlane(shm=cli, dim=DIM, topk=8)
+        p.subscribe("c1", "door open alert")
+        _wait(_acked(cli, p), timeout=10)
+        plane.kill_hub()
+        cli.timeout = 0.3
+        time.sleep(0.4)  # heartbeat stale past max(timeout, 0.25)
+        pend = p.submit([b"door open alert triggered"])
+        assert pend is not None  # own query keeps the plane active
+        local, remote = p.finish(p.collect(pend))
+        # exact own-row scoring: the local subscriber still matches,
+        # and nothing pretends to know about other workers
+        assert local == [[("c1", "$semantic/door open alert")]]
+        assert remote == []
+        assert p.degraded >= 1
+        assert cli.sem_local >= 1 or cli.sem_degraded >= 1
+    finally:
+        plane.stop(unlink=False)
+
+
+def test_shm_worker_kill9_mid_sem_submit_leaks_no_slots(tmp_path):
+    plane = _Plane(str(tmp_path))
+    region = plane.lane(0)
+    plane.start()
+    try:
+        c1 = plane.client(region, node="w1")
+        p1 = SemanticPlane(shm=c1, dim=DIM, topk=8)
+        p1.subscribe("dead", "ghost query of the dead worker")
+        _wait(_acked(c1, p1), timeout=10)
+        # kill -9 mid-K_SEM: reserve WITHOUT commit, then vanish
+        with c1._sub_lk:
+            assert c1._slab.submit.reserve() is not None
+            assert c1._slab.submit.reserve() is not None
+        reclaims0 = plane.svc.reclaims
+        c2 = plane.client(region, node="w1")  # respawned incarnation
+        p2 = SemanticPlane(shm=c2, dim=DIM, topk=8)
+        p2.subscribe("c2", "door open alert")
+        _wait(lambda: plane.svc.reclaims > reclaims0, timeout=10)
+        _wait(_acked(c2, p2), timeout=10)
+        # the dead incarnation's query left the hub table with the lane
+        _wait(lambda: plane.svc.semantic.n_queries == 1, timeout=10)
+        # 3x the ring depth of sem ticks must then ride the ring — a
+        # single leaked slot would wedge it
+        n = 3 * SLOTS
+        for _ in range(n):
+            pend = p2.submit([b"door open alert now"])
+            assert pend is not None and pend.mode == "shm"
+            local, _remote = p2.finish(p2.collect(pend))
+            assert local == [[("c2", "$semantic/door open alert")]]
+        assert c2.sem_submits >= n and c2.sem_local == 0
+    finally:
+        plane.stop()

@@ -474,7 +474,7 @@ def test_cross_lane_ticks_fuse_into_one_group(tmp_path):
     async def pump(until, timeout=60.0):
         t0 = time.monotonic()
         while not until():
-            _, reqs = plane.svc._drain_once()
+            _, reqs, _ = plane.svc._drain_once()
             if reqs:
                 plane.svc._dispatch(reqs)
             if plane.svc._replies:
@@ -878,7 +878,7 @@ def test_lane_credit_prevents_starvation(tmp_path):
             assert c0.match_submit(TOPICS[:2]).mode == "shm"
         assert c1.match_submit(TOPICS[:2]).mode == "shm"
         with TraceCollector() as tc:
-            consumed, reqs = svc._drain_once()
+            consumed, reqs, _ = svc._drain_once()
         # HELLOs + 4 credited ticks from lane 0, everything of lane 1
         by_lane = {}
         for r in reqs:
@@ -894,7 +894,7 @@ def test_lane_credit_prevents_starvation(tmp_path):
         total = len(reqs)
         guard = 0
         while svc._more:
-            _, more_reqs = svc._drain_once()
+            _, more_reqs, _ = svc._drain_once()
             total += len(more_reqs)
             guard += 1
             assert guard < 10
